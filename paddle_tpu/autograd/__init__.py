@@ -1,0 +1,14 @@
+from paddle_tpu.autograd.tape import (  # noqa: F401
+    TapeNode,
+    enable_grad,
+    grad,
+    is_grad_enabled,
+    no_grad,
+    run_backward,
+    set_grad_enabled,
+)
+
+
+def backward(tensors, grad_tensors=None, retain_graph=False):
+    """paddle.autograd.backward parity."""
+    run_backward(tensors, grad_tensors, retain_graph=retain_graph)

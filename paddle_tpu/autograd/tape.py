@@ -1,0 +1,295 @@
+"""Define-by-run autograd engine.
+
+Capability parity with the reference's eager autograd
+(paddle/fluid/eager/: AutogradMeta autograd_meta.h:61, GradNodeBase
+grad_node_info.h:197, RunBackward backward.cc:105) — re-designed TPU-first:
+
+- The reference codegens a C++ GradNode per op from YAML and hand-writes every
+  backward kernel. Here each recorded node carries a ``jax.vjp`` closure: JAX
+  derives the backward function, XLA compiles it. One mechanism, every op.
+- Nodes form the same reverse DAG; ``run_backward`` executes it in reverse
+  topological order with per-tensor gradient accumulation (the analogue of
+  eager/accumulation/ + GradTensorHolder).
+- The tape is trace-transparent: inside ``jax.jit`` the recorded values are
+  tracers, so ``backward()`` inside a captured train step stays one XLA program.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+import weakref
+from typing import Any, Callable, List, Optional, Sequence
+
+import jax
+
+
+class _GradState(threading.local):
+    def __init__(self):
+        self.enabled = True
+
+
+_grad_state = _GradState()
+
+
+def is_grad_enabled() -> bool:
+    return _grad_state.enabled
+
+
+def set_grad_enabled(mode: bool) -> None:
+    _grad_state.enabled = bool(mode)
+
+
+@contextlib.contextmanager
+def no_grad():
+    """Context manager disabling gradient recording (paddle.no_grad parity)."""
+    prev = _grad_state.enabled
+    _grad_state.enabled = False
+    try:
+        yield
+    finally:
+        _grad_state.enabled = prev
+
+
+@contextlib.contextmanager
+def enable_grad():
+    prev = _grad_state.enabled
+    _grad_state.enabled = True
+    try:
+        yield
+    finally:
+        _grad_state.enabled = prev
+
+
+class TapeNode:
+    """One recorded differentiable op: the GradNodeBase analogue.
+
+    ``vjp_fn`` maps output cotangents -> input cotangents. ``inputs`` are the
+    producing Tensors (strong refs: they pin the subgraph like TensorWrapper
+    does in the reference); ``outputs`` are weakrefs so dead outputs don't keep
+    the graph alive through the node.
+    """
+
+    __slots__ = ("name", "vjp_fn", "inputs", "outputs", "n_outputs", "__weakref__")
+
+    def __init__(self, name: str, vjp_fn: Callable, inputs: Sequence[Any], n_outputs: int):
+        self.name = name
+        self.vjp_fn = vjp_fn
+        self.inputs = list(inputs)
+        self.outputs: List[Optional[weakref.ref]] = [None] * n_outputs
+        self.n_outputs = n_outputs
+
+    def register_output(self, idx: int, tensor) -> None:
+        self.outputs[idx] = weakref.ref(tensor)
+
+    def __repr__(self):
+        return f"TapeNode({self.name}, n_in={len(self.inputs)}, n_out={self.n_outputs})"
+
+
+def _zero_cotangent(val):
+    """Zero cotangent matching jax.vjp's expectation: float0 for non-inexact
+    primals (integer/bool outputs of multi-output ops like topk)."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    if jnp.issubdtype(val.dtype, jnp.inexact):
+        return jnp.zeros_like(val)
+    return np.zeros(val.shape, dtype=jax.dtypes.float0)
+
+
+def _toposort(root_node: TapeNode) -> List[TapeNode]:
+    """Reverse-topological order over the DAG reachable from ``root_node``."""
+    order: List[TapeNode] = []
+    seen = set()
+    # Iterative DFS (graphs can be 10k+ nodes deep for big models).
+    stack: List[tuple] = [(root_node, False)]
+    while stack:
+        node, processed = stack.pop()
+        if processed:
+            order.append(node)
+            continue
+        if id(node) in seen:
+            continue
+        seen.add(id(node))
+        stack.append((node, True))
+        for t in node.inputs:
+            prod = getattr(t, "_node", None)
+            if prod is not None and id(prod) not in seen:
+                stack.append((prod, False))
+    order.reverse()  # producers last -> we walk outputs-first
+    return order
+
+
+def run_backward(tensors, grad_tensors=None, retain_graph: bool = False) -> None:
+    """Reverse-mode execution over the tape (RunBackward backward.cc:105 parity).
+
+    ``tensors``: output Tensors to differentiate. ``grad_tensors``: cotangents
+    (defaults to ones for scalar outputs).
+    """
+    import jax.numpy as jnp
+
+    from paddle_tpu.tensor import Tensor  # local import to avoid cycle
+
+    if not isinstance(tensors, (list, tuple)):
+        tensors = [tensors]
+    if grad_tensors is None:
+        grad_tensors = [None] * len(tensors)
+    elif not isinstance(grad_tensors, (list, tuple)):
+        grad_tensors = [grad_tensors]
+
+    # id(tensor) -> accumulated cotangent (raw jax array)
+    grads: dict = {}
+    roots: List[TapeNode] = []
+    for t, g in zip(tensors, grad_tensors):
+        if t._node is None:
+            if not t.stop_gradient:
+                # Leaf with no history: gradient is just the incoming cotangent.
+                init = g._value if g is not None else jnp.ones_like(t._value)
+                t._accumulate_grad(init)
+            continue
+        if g is None:
+            if t._value.size != 1:
+                raise RuntimeError(
+                    "grad can be implicitly created only for scalar outputs; "
+                    f"got shape {t.shape}. Pass grad_tensors explicitly."
+                )
+            g_val = jnp.ones_like(t._value)
+        else:
+            g_val = g._value if isinstance(g, Tensor) else jnp.asarray(g)
+        key = id(t)
+        grads[key] = grads[key] + g_val if key in grads else g_val
+        roots.append(t._node)
+
+    if not roots:
+        return
+
+    # Merge DAGs from all roots.
+    seen_nodes = set()
+    order: List[TapeNode] = []
+    for r in roots:
+        for n in _toposort(r):
+            if id(n) not in seen_nodes:
+                seen_nodes.add(id(n))
+                order.append(n)
+    # Globally order: nodes later in any chain must run first. _toposort already
+    # returns outputs-first per root; a stable merge suffices because shared
+    # subgraphs appear after their consumers in each list.
+    # (For exactness we re-sort by dependency depth.)
+    depth: dict = {}
+
+    def node_depth(n: TapeNode) -> int:
+        d = depth.get(id(n))
+        if d is not None:
+            return d
+        # depth = 1 + max depth of consumer nodes; computed lazily below instead.
+        return 0
+
+    # Compute consumer-based ordering via Kahn's algorithm on the merged DAG.
+    consumers: dict = {id(n): [] for n in order}
+    indeg: dict = {id(n): 0 for n in order}
+    node_by_id = {id(n): n for n in order}
+    for n in order:
+        for t in n.inputs:
+            prod = getattr(t, "_node", None)
+            if prod is not None and id(prod) in node_by_id:
+                consumers[id(n)].append(id(prod))
+                indeg[id(prod)] += 1
+    ready = [n for n in order if indeg[id(n)] == 0]
+    sched: List[TapeNode] = []
+    while ready:
+        n = ready.pop()
+        sched.append(n)
+        for pid in consumers[id(n)]:
+            indeg[pid] -= 1
+            if indeg[pid] == 0:
+                ready.append(node_by_id[pid])
+
+    for node in sched:
+        # Collect cotangents for this node's outputs.
+        cots = []
+        any_grad = False
+        for i in range(node.n_outputs):
+            ref = node.outputs[i]
+            t = ref() if ref is not None else None
+            if t is not None and id(t) in grads:
+                cots.append(grads.pop(id(t)))
+                any_grad = True
+            else:
+                cots.append(None)
+        if not any_grad:
+            continue
+        # vjp_fn wants the full output cotangent structure; fill Nones w/ zeros.
+        filled = []
+        for i, c in enumerate(cots):
+            if c is None:
+                ref = node.outputs[i]
+                t = ref() if ref is not None else None
+                if t is None:
+                    raise RuntimeError(
+                        f"backward through {node.name}: output {i} was freed but "
+                        "its cotangent is needed; keep a reference or use retain_graph"
+                    )
+                filled.append(_zero_cotangent(t._value))
+            else:
+                filled.append(c)
+        out_cot = tuple(filled) if node.n_outputs > 1 else filled[0]
+        in_cots = node.vjp_fn(out_cot)
+        if not isinstance(in_cots, (list, tuple)):
+            in_cots = (in_cots,)
+        for t, g in zip(node.inputs, in_cots):
+            if g is None:
+                continue
+            if t._node is None:
+                if not t.stop_gradient or getattr(t, "_retain_grads", False):
+                    t._accumulate_grad(g)
+            else:
+                key = id(t)
+                grads[key] = grads[key] + g if key in grads else g
+                if getattr(t, "_retain_grads", False):
+                    t._accumulate_grad(g)
+        if not retain_graph:
+            node.vjp_fn = None  # free residuals eagerly
+
+    # Any remaining cotangents belong to tensors whose producer wasn't visited
+    # (shouldn't happen) — drop them.
+    grads.clear()
+
+
+def grad(outputs, inputs, grad_outputs=None, retain_graph=False, create_graph=False,
+         allow_unused=False):
+    """paddle.grad parity: return grads of ``outputs`` w.r.t. ``inputs`` without
+    touching ``.grad`` fields. Implemented by a private accumulation pass."""
+    from paddle_tpu.tensor import Tensor
+    import jax.numpy as jnp
+
+    if not isinstance(outputs, (list, tuple)):
+        outputs = [outputs]
+    if not isinstance(inputs, (list, tuple)):
+        inputs = [inputs]
+
+    # Temporarily mark inputs to retain grads into a side table.
+    saved = [(t.stop_gradient, getattr(t, "_retain_grads", False), t._grad) for t in inputs]
+    for t in inputs:
+        t._retain_grads = True
+        t._grad = None
+    try:
+        run_backward(list(outputs), grad_tensors=grad_outputs, retain_graph=retain_graph)
+        results = []
+        for t in inputs:
+            if t._grad is None:
+                if not allow_unused:
+                    raise RuntimeError(
+                        "One of the differentiated tensors appears unused in the "
+                        "graph. Set allow_unused=True to return None for it."
+                    )
+                results.append(None)
+            else:
+                g = Tensor._from_value(t._grad)
+                g.stop_gradient = True
+                results.append(g)
+        return results
+    finally:
+        for t, (sg, rg, og) in zip(inputs, saved):
+            t.stop_gradient = sg
+            t._retain_grads = rg
+            t._grad = og

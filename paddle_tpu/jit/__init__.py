@@ -1,0 +1,4 @@
+"""paddle_tpu.jit (parity: python/paddle/jit)."""
+
+from paddle_tpu.jit.api import StaticFunction, TrainStep, not_to_static, to_static  # noqa: F401
+from paddle_tpu.jit.serialization import load, save  # noqa: F401
