@@ -1,0 +1,185 @@
+"""Pipeline parallelism, SPMD-style (parity: python/paddle/distributed/fleet/
+meta_parallel/pipeline_parallel.py:149,459,697 + parallel_layers/pp_layers.py:257
++ p2p_communication.py:52).
+
+TPU-native redesign. The reference runs one process per stage with an
+imperative 1F1B schedule and NCCL isend/irecv of (meta, tensor) pairs. On TPU
+the whole pipeline is ONE compiled SPMD program:
+
+- stage weights live stacked on a leading layer axis, sharded over the mesh's
+  "pp" axis;
+- a ``lax.scan`` over ticks runs the classic pipeline wavefront; activations
+  hop stages via ``lax.ppermute`` (collective-permute on ICI — the hardware's
+  native p2p, replacing SendRecvMeta/isend/irecv);
+- ``jax.grad`` differentiates through scan+ppermute, so the backward pipeline
+  (reverse wavefront) is derived by the compiler instead of hand-scheduled —
+  the schedule is GPipe-shaped with rematerialized blocks
+  (``jax.checkpoint``), giving 1F1B's memory profile without its bookkeeping.
+
+The per-tick wavefront below is the standard JAX pipelining recipe (cf. the
+public scaling-book / praxis formulations), adapted to paddle's API surface.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental.shard_map import shard_map
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def spmd_pipeline(
+    block_fn: Callable[[Any, jax.Array], jax.Array],
+    layer_params: Any,
+    x: jax.Array,
+    *,
+    mesh: Mesh,
+    num_microbatches: int,
+    axis: str = "pp",
+    remat: bool = True,
+):
+    """Run ``x`` through L stacked layers pipelined over the ``axis`` mesh dim.
+
+    layer_params: pytree with leading dim L on every leaf (L = S * layers_per
+    _stage, S = mesh.shape[axis]); sharded P(axis) on dim 0.
+    x: [B, ...] global batch; B % num_microbatches == 0.
+    block_fn(params_one_layer, h) -> h.
+
+    Returns y: [B, ...] (output of the last layer for the full batch).
+    """
+    S = mesh.shape[axis]
+    M = num_microbatches
+    B = x.shape[0]
+    assert B % M == 0, f"batch {B} must divide into {M} microbatches"
+    mb = B // M
+
+    leaves = jax.tree_util.tree_leaves(layer_params)
+    L = leaves[0].shape[0]
+    assert L % S == 0, f"layers {L} must divide stages {S}"
+    lps = L // S
+
+    if remat:
+        block_fn = jax.checkpoint(block_fn)
+
+    def stage_apply(params_local, h):
+        # params_local leaves: [lps, ...] — scan my layers
+        def body(h, p):
+            return block_fn(p, h), None
+
+        h, _ = jax.lax.scan(body, h, params_local)
+        return h
+
+    def pipelined(params_local, x_local):
+        # x_local: [M, mb, ...] replicated over pp (each stage sees the stream)
+        stage = jax.lax.axis_index(axis)
+        T = M + S - 1
+        fwd_perm = [(i, (i + 1) % S) for i in range(S)]
+
+        state = jnp.zeros_like(x_local[0])
+        outputs = jnp.zeros((M,) + x_local.shape[1:], x_local.dtype)
+
+        def tick(carry, t):
+            state, outputs = carry
+            # stage 0 ingests microbatch t (clamped); others use received state
+            feed = jax.lax.dynamic_index_in_dim(
+                x_local, jnp.minimum(t, M - 1), axis=0, keepdims=False
+            )
+            h = jnp.where(stage == 0, feed, state)
+            h = stage_apply(params_local, h)
+            # last stage writes its result for microbatch (t - (S-1))
+            out_idx = jnp.clip(t - (S - 1), 0, M - 1)
+            write = jnp.logical_and(stage == S - 1, t >= S - 1)
+            outputs = jax.lax.cond(
+                write,
+                lambda o: jax.lax.dynamic_update_index_in_dim(o, h, out_idx, axis=0),
+                lambda o: o,
+                outputs,
+            )
+            # hop to next stage
+            state = jax.lax.ppermute(h, axis, fwd_perm)
+            return (state, outputs), None
+
+        (state, outputs), _ = jax.lax.scan(
+            tick, (state, outputs), jnp.arange(T)
+        )
+        return outputs
+
+    # reshape into microbatch stream, replicate over pp axis for the feed
+    x_mb = x.reshape(M, mb, *x.shape[1:])
+
+    in_specs = (
+        jax.tree_util.tree_map(lambda _: P(axis), layer_params),
+        P(),  # microbatch stream replicated across stages
+    )
+    # stack per-stage outputs on a leading pp-sharded axis; only the last
+    # stage's slice is meaningful and the final index pulls exactly it —
+    # no cross-device traffic beyond the pipeline hops themselves.
+    out_specs = P(axis)
+
+    def wrapper(params_local, x_local):
+        # strip the leading sharded dim into [lps, ...] per stage
+        params_local = jax.tree_util.tree_map(
+            lambda a: a.reshape((lps,) + a.shape[1:]), params_local
+        )
+        outs = pipelined(params_local, x_local)
+        return outs[None]  # [1, M, mb, ...] per stage
+
+    y_st = shard_map(
+        wrapper, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+        check_rep=False,
+    )(layer_params, x_mb)  # [S, M, mb, ...]
+    y_mb = y_st[S - 1]
+    return y_mb.reshape(B, *x.shape[1:])
+
+
+# ----------------------------------------------------------------- parity API
+class LayerDesc:
+    """paddle.distributed.fleet.meta_parallel.LayerDesc parity."""
+
+    def __init__(self, layer_cls, *args, **kwargs):
+        self.layer_cls = layer_cls
+        self.args = args
+        self.kwargs = kwargs
+
+    def build_layer(self):
+        return self.layer_cls(*self.args, **self.kwargs)
+
+
+class SharedLayerDesc(LayerDesc):
+    def __init__(self, key, layer_cls, forward_func=None, shared_weight_attr="weight",
+                 *args, **kwargs):
+        super().__init__(layer_cls, *args, **kwargs)
+        self.layer_name = key
+        self.forward_func = forward_func
+        self.shared_weight_attr = shared_weight_attr
+
+
+class PipelineLayer:
+    """Structural parity with pp_layers.py:257 PipelineLayer: holds the layer
+    list and the partition; execution is via the SPMD engine above (used by
+    models/gpt.py) rather than a per-rank runtime."""
+
+    def __init__(self, layers, num_stages=None, topology=None, loss_fn=None,
+                 seg_method="uniform", recompute_interval=0, **kwargs):
+        self.descs = list(layers)
+        self.num_stages = num_stages or 1
+        self.loss_fn = loss_fn
+        self._built = [
+            d.build_layer() if isinstance(d, LayerDesc) else d for d in self.descs
+        ]
+
+    def get_stage_layers(self, stage_id):
+        n = len(self._built)
+        per = (n + self.num_stages - 1) // self.num_stages
+        return self._built[stage_id * per:(stage_id + 1) * per]
+
+    def forward(self, x):
+        for l in self._built:
+            x = l(x) if callable(l) else l.forward(x)
+        return x
+
+    def __call__(self, x):
+        return self.forward(x)
