@@ -91,6 +91,8 @@ class ProcessMesh:
     def __init__(self, mesh=None, dim_names=None, shape=None, process_ids=None):
         if mesh is not None:
             arr = np.asarray(mesh)
+        elif process_ids is not None:
+            arr = np.asarray(process_ids).reshape(shape)
         else:
             arr = np.arange(int(np.prod(shape))).reshape(shape)
         self._shape = list(arr.shape)

@@ -41,17 +41,39 @@ class ReduceOp:
 
 
 class Group:
-    """A communication group = contiguous slice view over the world ranks."""
+    """A communication group over a subset of world ranks.
+
+    ``partition`` — the full list of same-size rank groups this group belongs
+    to (one per peer group along the same topology axis, e.g. all dp groups).
+    The single-controller eager collectives reduce every group of the
+    partition in one XLA program. Defaults to contiguous equal blocks when the
+    ranks form one; otherwise only the listed ranks participate and all other
+    ranks keep their values.
+    """
 
     _next_id = 1
 
-    def __init__(self, ranks: Optional[Sequence[int]] = None, pg=None, name=None):
+    def __init__(self, ranks: Optional[Sequence[int]] = None, pg=None, name=None,
+                 partition: Optional[Sequence[Sequence[int]]] = None):
         world = _env.get_world_size()
         self.ranks = list(ranks) if ranks is not None else list(range(world))
         self.nranks = len(self.ranks)
         self.id = Group._next_id
         Group._next_id += 1
         self.name = name or f"group_{self.id}"
+        if partition is not None:
+            self.partition = [list(g) for g in partition]
+        elif world % self.nranks == 0 and self.ranks == list(
+            range(self.ranks[0], self.ranks[0] + self.nranks)
+        ) and self.ranks[0] % self.nranks == 0:
+            # contiguous aligned block: assume the usual block partition
+            self.partition = [
+                list(range(b, b + self.nranks))
+                for b in range(0, world, self.nranks)
+            ]
+        else:
+            self.partition = [self.ranks]
+        _register_group(self)
 
     @property
     def world_size(self):
@@ -65,6 +87,11 @@ class Group:
 
 
 _default_group: Optional[Group] = None
+_group_registry: dict = {}
+
+
+def _register_group(g: Group) -> None:
+    _group_registry[g.id] = g
 
 
 def _get_group(group: Optional[Group]) -> Group:
@@ -76,12 +103,12 @@ def _get_group(group: Optional[Group]) -> Group:
     return _default_group
 
 
-def new_group(ranks=None, backend=None, timeout=None) -> Group:
-    return Group(ranks)
+def new_group(ranks=None, backend=None, timeout=None, partition=None) -> Group:
+    return Group(ranks, partition=partition)
 
 
 def get_group(gid: int) -> Optional[Group]:
-    return _default_group
+    return _group_registry.get(gid, _default_group)
 
 
 # ---------------------------------------------------------------- primitives
@@ -103,48 +130,52 @@ def _stacked(x: Tensor):
     return v
 
 
-def _group_reshape(v, group: Group):
-    """[world, ...] -> [n_groups, gsize, ...] view metadata (contiguous groups)."""
+def _segment_ids(group: Group):
+    """Per-rank segment id + group-size array for the group's partition.
+
+    Ranks outside every partition group get their own singleton segment, so
+    collectives leave them untouched.
+    """
     world = _env.get_world_size()
-    g = group.nranks
-    if world % g != 0:
-        raise ValueError(f"group size {g} must divide world {world}")
-    return world // g, g
+    seg = [-1] * world
+    size = [1] * world
+    for gi, ranks in enumerate(group.partition):
+        for r in ranks:
+            seg[r] = gi
+            size[r] = len(ranks)
+    nxt = len(group.partition)
+    for r in range(world):
+        if seg[r] < 0:
+            seg[r] = nxt
+            nxt += 1
+    return tuple(seg), tuple(size)
 
 
-@functools.lru_cache(maxsize=None)
-def _grouped_mesh(gsize: int) -> Mesh:
-    """2-D view of the world: (n_groups, group_size). Reductions over the
-    inner axis are exactly contiguous-subgroup collectives."""
-    world = jax.device_count()
-    devs = np.asarray(jax.devices()).reshape(world // gsize, gsize)
-    return Mesh(devs, axis_names=("g", "r"))
-
-
-@functools.partial(jax.jit, static_argnames=("op", "gsize"))
-def _allreduce_impl(v, op, gsize):
-    from jax.experimental.shard_map import shard_map
-
-    mesh = _grouped_mesh(gsize)
-
-    def body(s):
-        # s: [1, ...] local slice; reduce over the inner 'r' axis
-        if op == "avg":
-            return jax.lax.psum(s, "r") / gsize
-        if op == "prod":
-            # psum-based product: magnitude via log-domain sum, sign via
-            # parity of the negative count (zeros give log->-inf->0 naturally)
-            mag = jnp.exp(
-                jax.lax.psum(jnp.log(jnp.abs(s).astype(jnp.float32)), "r")
-            )
-            neg = jax.lax.psum(jnp.where(s < 0, 1.0, 0.0), "r")
-            return (mag * (1.0 - 2.0 * (neg % 2))).astype(s.dtype)
-        red = {"sum": jax.lax.psum, "max": jax.lax.pmax, "min": jax.lax.pmin}
-        return red[op](s, "r")
-
-    return shard_map(
-        body, mesh=mesh, in_specs=P(("g", "r")), out_specs=P(("g", "r"))
-    )(v)
+@functools.partial(jax.jit, static_argnames=("op", "seg", "gsizes"))
+def _allreduce_impl(v, op, seg, gsizes):
+    """Reduce the stacked axis within each segment; every rank of a segment
+    sees the reduced value. Arbitrary (strided) groups supported — under a
+    sharded stacked layout XLA lowers the gathers to ICI collectives."""
+    world = v.shape[0]
+    nseg = max(seg) + 1
+    seg_arr = jnp.asarray(seg)
+    if op == "avg":
+        summed = jax.ops.segment_sum(v, seg_arr, num_segments=nseg)
+        out = jnp.take(summed, seg_arr, axis=0)
+        sizes = jnp.asarray(gsizes, dtype=v.dtype).reshape(
+            (world,) + (1,) * (v.ndim - 1)
+        )
+        return out / sizes
+    if op == "prod":
+        red = jax.ops.segment_prod
+    elif op == "max":
+        red = jax.ops.segment_max
+    elif op == "min":
+        red = jax.ops.segment_min
+    else:
+        red = jax.ops.segment_sum
+    reduced = red(v, seg_arr, num_segments=nseg)
+    return jnp.take(reduced, seg_arr, axis=0)
 
 
 def all_reduce(tensor: Tensor, op=ReduceOp.SUM, group: Optional[Group] = None,
@@ -152,20 +183,37 @@ def all_reduce(tensor: Tensor, op=ReduceOp.SUM, group: Optional[Group] = None,
     """In-place all-reduce over the per-rank axis (paddle semantics)."""
     g = _get_group(group)
     v = _stacked(tensor)
-    out = _allreduce_impl(v, op, g.nranks)
+    seg, sizes = _segment_ids(g)
+    out = _allreduce_impl(v, op, seg, sizes)
+    out = jax.device_put(out, NamedSharding(_world_mesh(), P("world")))
     tensor._replace_value(out)
     return _Task()
 
 
 def all_gather(tensor_list: List[Tensor], tensor: Tensor,
                group: Optional[Group] = None, sync_op=True):
-    """Gather each rank's slice; fills tensor_list (paddle API shape)."""
+    """Gather each group peer's slice; fills tensor_list (paddle API shape).
+
+    Single group covering all ranks -> plain tensors (identical everywhere).
+    Multiple peer groups -> per-rank stacked tensors: entry j's slice for rank
+    r is the value held by the j-th member of r's group.
+    """
     g = _get_group(group)
     v = _stacked(tensor)
-    # result per rank r: concat of all ranks' slices -> same for all ranks
-    for r in range(g.nranks):
-        t = Tensor._from_value(v[r])
-        tensor_list.append(t)
+    if len(g.partition) == 1 and len(g.partition[0]) == v.shape[0]:
+        for r in g.partition[0]:
+            tensor_list.append(Tensor._from_value(v[r]))
+        return _Task()
+    world = v.shape[0]
+    # peer[j][r] = global rank of the j-th member of r's group (self if none)
+    for j in range(g.nranks):
+        idx = list(range(world))
+        for ranks in g.partition:
+            for r in ranks:
+                idx[r] = ranks[j]
+        entry = jnp.take(v, jnp.asarray(idx), axis=0)
+        entry = jax.device_put(entry, NamedSharding(_world_mesh(), P("world")))
+        tensor_list.append(Tensor._from_value(entry))
     return _Task()
 
 
@@ -175,52 +223,54 @@ def all_gather_object(object_list, obj, group=None):
     return _Task()
 
 
-@functools.partial(jax.jit, static_argnames=("gsize",))
-def _reduce_scatter_impl(v, gsize):
-    from jax.experimental.shard_map import shard_map
-
-    mesh = _grouped_mesh(gsize)
-
-    def body(s):
-        # s: [1, gsize, ...]; sum over group then keep my chunk
-        summed = jax.lax.psum(s, "r")
-        idx = jax.lax.axis_index("r")
-        return jax.lax.dynamic_index_in_dim(summed[0], idx, axis=0, keepdims=True)
-
-    return shard_map(body, mesh=mesh, in_specs=P(("g", "r")), out_specs=P(("g", "r")))(v)
+def _local_index_maps(group: Group):
+    """Per-rank (group peers, local index) lookups from the partition."""
+    world = _env.get_world_size()
+    peers = [None] * world
+    local = [0] * world
+    for ranks in group.partition:
+        for j, r in enumerate(ranks):
+            peers[r] = ranks
+            local[r] = j
+    return peers, local
 
 
 def reduce_scatter(tensor: Tensor, tensor_or_tensor_list, op=ReduceOp.SUM,
                    group: Optional[Group] = None, sync_op=True):
-    """Per-rank input [world, gsize, ...] -> per-rank output [world, ...]."""
+    """Per-rank input [world, gsize, ...] -> per-rank output [world, ...]:
+    sum within each group, rank keeps its local chunk."""
     g = _get_group(group)
     src = tensor_or_tensor_list
     if isinstance(src, (list, tuple)):
         v = jnp.stack([t._value for t in src], axis=1)
     else:
         v = _stacked(src)
-    out = _reduce_scatter_impl(v, g.nranks)
+    seg, sizes = _segment_ids(g)
+    summed = _allreduce_impl(v, op, seg, sizes)  # [world, gsize, ...]
+    _, local = _local_index_maps(g)
+    idx = jnp.asarray(local).reshape(v.shape[0], 1, *([1] * (v.ndim - 2)))
+    out = jnp.take_along_axis(summed, idx, axis=1)[:, 0]
+    out = jax.device_put(out, NamedSharding(_world_mesh(), P("world")))
     tensor._replace_value(out)
     return _Task()
 
 
 def all_to_all(out_tensor_list, in_tensor_list, group: Optional[Group] = None,
                sync_op=True):
-    """paddle.distributed.alltoall: rank r sends in[j] to rank j."""
+    """paddle.distributed.alltoall: group member i sends in[j] to member j."""
     g = _get_group(group)
     n = g.nranks
     # stacked encoding: in_tensor_list entries are [world, ...] stacks
     stacked = jnp.stack([_stacked(t) for t in in_tensor_list], axis=1)  # [W,n,...]
     world = stacked.shape[0]
-    # exchange: out[r][j] = in[j][r] within each contiguous group
-    ng = world // n
-    s = stacked.reshape(ng, n, n, *stacked.shape[2:])
-    s = jnp.swapaxes(s, 1, 2)
-    s = s.reshape(world, n, *stacked.shape[2:])
+    peers, local = _local_index_maps(g)
     mesh = _world_mesh()
-    s = jax.device_put(s, NamedSharding(mesh, P("world")))
+    # out[r][j] = in[local(r)] as held by the j-th peer of r's group
     for j in range(n):
-        out_tensor_list.append(Tensor._from_value(s[:, j]))
+        src_rank = [peers[r][j] if peers[r] is not None else r for r in range(world)]
+        entry = stacked[jnp.asarray(src_rank), jnp.asarray(local)]
+        entry = jax.device_put(entry, NamedSharding(mesh, P("world")))
+        out_tensor_list.append(Tensor._from_value(entry))
     return _Task()
 
 
@@ -228,61 +278,85 @@ alltoall = all_to_all
 
 
 def broadcast(tensor: Tensor, src: int, group: Optional[Group] = None, sync_op=True):
+    """Within each partition group, every rank takes the value of the rank at
+    ``src``'s local position (SPMD per-group broadcast; for the default world
+    group this is exactly paddle's broadcast from global rank ``src``)."""
     g = _get_group(group)
     v = _stacked(tensor)
     world = v.shape[0]
-    ng, gsize = _group_reshape(v, g)
-    src_local = g.get_group_rank(src) if g.get_group_rank(src) >= 0 else src
-    vr = v.reshape(ng, gsize, *v.shape[1:])
-    out = jnp.broadcast_to(vr[:, src_local:src_local + 1], vr.shape).reshape(v.shape)
-    mesh = _world_mesh()
-    out = jax.device_put(out, NamedSharding(mesh, P("world")))
+    src_local = g.get_group_rank(src)
+    if src_local < 0:
+        src_local = src
+    peers, _ = _local_index_maps(g)
+    idx = [peers[r][src_local] if peers[r] is not None else r for r in range(world)]
+    out = jnp.take(v, jnp.asarray(idx), axis=0)
+    out = jax.device_put(out, NamedSharding(_world_mesh(), P("world")))
     tensor._replace_value(out)
     return _Task()
 
 
 def reduce(tensor: Tensor, dst: int, op=ReduceOp.SUM, group: Optional[Group] = None,
            sync_op=True):
+    """Only global rank ``dst`` receives the reduced value of its group;
+    everyone else keeps their original tensor (paddle semantics)."""
     g = _get_group(group)
     v = _stacked(tensor)
-    out = _allreduce_impl(v, op, g.nranks)
-    # non-dst ranks keep their original value (paddle semantics)
+    seg, sizes = _segment_ids(g)
+    out = _allreduce_impl(v, op, seg, sizes)
     world = v.shape[0]
-    idx = jnp.arange(world) % g.nranks
-    mask = (idx == dst).reshape(world, *([1] * (v.ndim - 1)))
-    tensor._replace_value(jnp.where(mask, out, v))
+    mask = (jnp.arange(world) == dst).reshape(world, *([1] * (v.ndim - 1)))
+    res = jnp.where(mask, out, v)
+    res = jax.device_put(res, NamedSharding(_world_mesh(), P("world")))
+    tensor._replace_value(res)
     return _Task()
 
 
 def scatter(tensor: Tensor, tensor_list=None, src=0, group: Optional[Group] = None,
             sync_op=True):
+    """Each rank r receives tensor_list[local(r)] *as held by its group's src
+    rank* (the rank at src's local position)."""
     g = _get_group(group)
     if tensor_list is not None:
         stacked = jnp.stack([_stacked(t) for t in tensor_list], axis=1)  # [W,n,...]
-        # each rank r gets tensor_list[r] from src
         world = stacked.shape[0]
-        n = g.nranks
-        idx = jnp.arange(world) % n
-        out = jnp.take_along_axis(
-            stacked, idx.reshape(world, 1, *([1] * (stacked.ndim - 2))), axis=1
-        )[:, 0]
-        mesh = _world_mesh()
-        out = jax.device_put(out, NamedSharding(mesh, P("world")))
+        src_local = g.get_group_rank(src)
+        if src_local < 0:
+            src_local = src
+        peers, local = _local_index_maps(g)
+        src_rank = [
+            peers[r][src_local] if peers[r] is not None else r for r in range(world)
+        ]
+        out = stacked[jnp.asarray(src_rank), jnp.asarray(local)]
+        out = jax.device_put(out, NamedSharding(_world_mesh(), P("world")))
         tensor._replace_value(out)
     return _Task()
 
 
 def send(tensor: Tensor, dst: int, group=None, sync_op=True):
-    _p2p_buffer.append((dst, tensor._value))
+    _p2p_buffer.append({"src": _env.get_rank(), "dst": dst, "value": tensor._value})
     return _Task()
 
 
 def recv(tensor: Tensor, src: int, group=None, sync_op=True):
-    for i, (dst, v) in enumerate(_p2p_buffer):
-        tensor._replace_value(v)
-        _p2p_buffer.pop(i)
-        return _Task()
-    raise RuntimeError("recv without matching send (single-controller p2p)")
+    """Match the oldest buffered send addressed to this rank from ``src``.
+
+    Single-controller note: when one controller plays several ranks,
+    get_rank() is constant, so dst matching degrades to src-only FIFO — pair
+    sends/recvs in program order there (the fleet pipeline does).
+    """
+    me = _env.get_rank()
+    for exact in (True, False):
+        for i, entry in enumerate(_p2p_buffer):
+            if entry["src"] != src:
+                continue
+            if exact and entry["dst"] != me:
+                continue
+            tensor._replace_value(entry["value"])
+            _p2p_buffer.pop(i)
+            return _Task()
+    raise RuntimeError(
+        f"recv(src={src}) without matching send (single-controller p2p)"
+    )
 
 
 _p2p_buffer: list = []

@@ -100,7 +100,8 @@ class ColumnParallelLinear(Layer):
         if self.gather_output:
             out = _constrain(out, P())  # all-gather: replicate the mp shard
         else:
-            out = _constrain(out, P(None, None, "mp"))
+            # shard the last (feature) dim whatever the input rank
+            out = _constrain(out, P(*([None] * (out.ndim - 1)), "mp"))
         return out
 
 
@@ -134,7 +135,7 @@ class RowParallelLinear(Layer):
 
     def forward(self, x):
         if not self.input_is_parallel:
-            x = _constrain(x, P(None, None, "mp"))
+            x = _constrain(x, P(*([None] * (x.ndim - 1)), "mp"))
         out = F.linear(x, self.weight, self.bias)
         return _constrain(out, P())
 

@@ -118,18 +118,29 @@ class HybridCommunicateGroup:
         self._sep_degree = sep_degree
         self.global_rank = _env.get_rank()
 
-        # The mesh: pp outermost (slowest links), mp innermost (fastest ICI).
+        # The mesh mirrors the topology's rank order exactly (device i == rank
+        # i): data outermost, model innermost — mp collectives ride the
+        # shortest ICI hops, matching the reference's rank placement.
         devs = np.asarray(jax.devices()[:ndev]).reshape(
-            pp_degree, dp_degree, sharding_degree, sep_degree, mp_degree
+            dp_degree, pp_degree, sharding_degree, sep_degree, mp_degree
         )
-        self.mesh = Mesh(devs, axis_names=("pp", "dp", "sharding", "sep", "mp"))
+        self.mesh = Mesh(devs, axis_names=("dp", "pp", "sharding", "sep", "mp"))
 
-        # comm groups for the collective API (contiguous encoding)
-        self._mp_group = C.new_group(list(range(mp_degree)))
-        self._dp_group = C.new_group(list(range(dp_degree)))
-        self._pp_group = C.new_group(list(range(pp_degree)))
-        self._sharding_group = C.new_group(list(range(sharding_degree)))
-        self._sep_group = C.new_group(list(range(sep_degree)))
+        # Comm groups: true (possibly strided) rank sets from the topology,
+        # with the full per-axis partition so eager collectives reduce every
+        # peer group in one program.
+        def axis_group(axis_name):
+            partition = self._topo.get_comm_list(axis_name)
+            mine = next(
+                (g for g in partition if self.global_rank in g), partition[0]
+            )
+            return C.new_group(mine, partition=partition)
+
+        self._dp_group = axis_group("data")
+        self._pp_group = axis_group("pipe")
+        self._sharding_group = axis_group("sharding")
+        self._sep_group = axis_group("sep")
+        self._mp_group = axis_group("model")
 
     # paddle topology queries ------------------------------------------------
     def get_parallel_mode(self):

@@ -38,7 +38,7 @@ class DataParallel(Layer):
     def _shard_batch(self, t: Tensor) -> Tensor:
         if self._world <= 1:
             return t
-        if t.shape[0] % self._world == 0:
+        if t.shape and t.shape[0] % self._world == 0:
             v = jax.device_put(
                 t._value, NamedSharding(self._mesh, P("world"))
             )

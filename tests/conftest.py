@@ -1,0 +1,31 @@
+"""Test harness config: force an 8-device virtual CPU platform BEFORE jax
+initializes, so multi-chip sharding paths (Mesh/pjit/shard_map) are exercised
+without TPU hardware — the reference's pattern of testing a hardware backend
+on a fake device (test/custom_runtime/test_collective_process_group_xccl.py).
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+# Semantics tests want exact math; the session default emulates TPU bf16 matmul.
+os.environ.setdefault("JAX_DEFAULT_MATMUL_PRECISION", "highest")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+# A plugin may import jax before this conftest; set config directly too
+# (effective as long as the backend isn't initialized yet).
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_default_matmul_precision", "highest")
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(0)

@@ -1,0 +1,104 @@
+"""Autograd engine tests (reference: eager backward semantics,
+paddle/fluid/eager/backward.cc; numeric oracles are closed forms)."""
+
+import numpy as np
+
+import paddle_tpu as paddle
+
+
+def test_scalar_backward():
+    x = paddle.to_tensor(3.0, stop_gradient=False)
+    y = x * x
+    y.backward()
+    np.testing.assert_allclose(x.grad.numpy(), 6.0)
+
+
+def test_chain_rule():
+    x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+    y = paddle.sum(paddle.exp(x) * x)
+    y.backward()
+    expect = np.exp([1.0, 2.0]) * (1 + np.array([1.0, 2.0]))
+    np.testing.assert_allclose(x.grad.numpy(), expect, rtol=1e-5)
+
+
+def test_grad_accumulation_two_uses():
+    x = paddle.to_tensor(2.0, stop_gradient=False)
+    y = x * x + 3.0 * x  # dy/dx = 2x + 3 = 7
+    y.backward()
+    np.testing.assert_allclose(x.grad.numpy(), 7.0)
+
+
+def test_backward_twice_accumulates():
+    x = paddle.to_tensor(1.0, stop_gradient=False)
+    (x * 2).backward()
+    (x * 2).backward()
+    np.testing.assert_allclose(x.grad.numpy(), 4.0)
+
+
+def test_stop_gradient_blocks():
+    x = paddle.to_tensor(1.0, stop_gradient=False)
+    y = paddle.to_tensor(1.0, stop_gradient=True)
+    z = x * y
+    z.backward()
+    assert x.grad is not None
+    assert y.grad is None
+
+
+def test_detach_cuts_graph():
+    x = paddle.to_tensor(2.0, stop_gradient=False)
+    y = (x * x).detach()
+    z = y * 3.0
+    z.backward()
+    assert x.grad is None
+
+
+def test_no_grad_context():
+    x = paddle.to_tensor(2.0, stop_gradient=False)
+    with paddle.no_grad():
+        y = x * x
+    assert y._node is None
+
+
+def test_matmul_grad():
+    a = np.random.default_rng(0).normal(size=(2, 3)).astype(np.float32)
+    b = np.random.default_rng(1).normal(size=(3, 4)).astype(np.float32)
+    ta = paddle.to_tensor(a, stop_gradient=False)
+    tb = paddle.to_tensor(b, stop_gradient=False)
+    loss = paddle.sum(paddle.matmul(ta, tb))
+    loss.backward()
+    gones = np.ones((2, 4), dtype=np.float32)
+    np.testing.assert_allclose(ta.grad.numpy(), gones @ b.T, rtol=1e-5)
+    np.testing.assert_allclose(tb.grad.numpy(), a.T @ gones, rtol=1e-5)
+
+
+def test_paddle_grad_api():
+    x = paddle.to_tensor(3.0, stop_gradient=False)
+    y = x * x * x
+    (g,) = paddle.grad(y, [x])
+    np.testing.assert_allclose(g.numpy(), 27.0, rtol=1e-6)
+    # .grad untouched
+    assert x.grad is None
+
+
+def test_non_scalar_backward_requires_grad_tensor():
+    x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+    y = x * 2
+    y.backward(paddle.to_tensor([1.0, 1.0]))
+    np.testing.assert_allclose(x.grad.numpy(), [2.0, 2.0])
+
+
+def test_broadcast_grad_reduces():
+    x = paddle.to_tensor([[1.0, 2.0], [3.0, 4.0]], stop_gradient=False)
+    b = paddle.to_tensor([1.0, 1.0], stop_gradient=False)
+    y = paddle.sum(x + b)
+    y.backward()
+    np.testing.assert_allclose(b.grad.numpy(), [2.0, 2.0])
+
+
+def test_retain_grads_intermediate():
+    x = paddle.to_tensor(2.0, stop_gradient=False)
+    y = x * x
+    y.retain_grads()
+    z = y * 3.0
+    z.backward()
+    np.testing.assert_allclose(y.grad.numpy(), 3.0)

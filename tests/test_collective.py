@@ -1,0 +1,167 @@
+"""Eager collective semantics on the 8-device mesh (reference oracles:
+test/collective/collective_allreduce_api.py family). The stacked [world, ...]
+encoding plays all ranks in one controller."""
+
+import numpy as np
+import pytest
+
+import jax
+import paddle_tpu as paddle
+import paddle_tpu.distributed as dist
+from paddle_tpu.distributed import collective as C
+
+requires_8 = pytest.mark.skipif(
+    jax.device_count() < 8, reason="needs 8 virtual devices"
+)
+
+pytestmark = requires_8
+
+
+def _stack(vals):
+    return C.shard_from_host(np.asarray(vals, dtype=np.float32))
+
+
+def setup_module():
+    dist.init_parallel_env()
+
+
+def test_all_reduce_world():
+    t = _stack([float(r) for r in range(8)])
+    dist.all_reduce(t)
+    np.testing.assert_allclose(t.numpy(), [28.0] * 8)
+
+
+def test_all_reduce_max():
+    t = _stack([float(r) for r in range(8)])
+    dist.all_reduce(t, op=dist.ReduceOp.MAX)
+    np.testing.assert_allclose(t.numpy(), [7.0] * 8)
+
+
+def test_all_reduce_contiguous_subgroups():
+    g = C.new_group([0, 1, 2, 3])  # implies blocks {0-3},{4-7}
+    t = _stack([float(r) for r in range(8)])
+    dist.all_reduce(t, group=g)
+    np.testing.assert_allclose(t.numpy(), [6.0] * 4 + [22.0] * 4)
+
+
+def test_all_reduce_strided_subgroups():
+    # dp-style strided partition {0,2,4,6}, {1,3,5,7}
+    g = C.new_group([0, 2, 4, 6], partition=[[0, 2, 4, 6], [1, 3, 5, 7]])
+    t = _stack([float(r) for r in range(8)])
+    dist.all_reduce(t, group=g)
+    expect = [12.0, 16.0] * 4
+    np.testing.assert_allclose(t.numpy(), expect)
+
+
+def test_broadcast():
+    t = _stack([float(r) for r in range(8)])
+    dist.broadcast(t, src=3)
+    np.testing.assert_allclose(t.numpy(), [3.0] * 8)
+
+
+def test_broadcast_subgroups_local_src():
+    g = C.new_group([0, 1, 2, 3])
+    t = _stack([float(r) for r in range(8)])
+    dist.broadcast(t, src=1, group=g)  # local position 1 in each block
+    np.testing.assert_allclose(t.numpy(), [1.0] * 4 + [5.0] * 4)
+
+
+def test_reduce_only_dst_updated():
+    g = C.new_group([4, 5, 6, 7])
+    t = _stack([float(r) for r in range(8)])
+    dist.reduce(t, dst=5, group=g)
+    expect = [0, 1, 2, 3, 4, 22, 6, 7]
+    np.testing.assert_allclose(t.numpy(), expect)
+
+
+def test_all_gather_world():
+    t = _stack([float(r) * 10 for r in range(8)])
+    outs = []
+    dist.all_gather(outs, t)
+    assert len(outs) == 8
+    for j, o in enumerate(outs):
+        np.testing.assert_allclose(o.numpy(), j * 10.0)
+
+
+def test_all_gather_subgroups_stacked():
+    g = C.new_group([0, 1, 2, 3])
+    t = _stack([float(r) for r in range(8)])
+    outs = []
+    dist.all_gather(outs, t, group=g)
+    assert len(outs) == 4
+    # entry j, rank r slice = value of j-th member of r's block
+    np.testing.assert_allclose(outs[1].numpy(), [1.0] * 4 + [5.0] * 4)
+
+
+def test_reduce_scatter():
+    # each rank holds [8] vector of its rank value; group = world (8 ranks),
+    # chunks of size 1 per rank
+    vals = np.tile(np.arange(8.0, dtype=np.float32)[:, None], (1, 8)).reshape(8, 8, 1)
+    t = C.shard_from_host(vals)  # [world, gsize, 1]
+    out = paddle.zeros([8, 1])
+    dist.reduce_scatter(out, t)
+    np.testing.assert_allclose(out.numpy(), np.full((8, 1), 28.0))
+
+
+def test_all_to_all():
+    # rank r's in[j] = r*10 + j; after a2a, rank r's out[j] = j*10 + r
+    ins = []
+    for j in range(8):
+        ins.append(_stack([float(r * 10 + j) for r in range(8)]))
+    outs = []
+    dist.all_to_all(outs, ins)
+    for j in range(8):
+        np.testing.assert_allclose(
+            outs[j].numpy(), [float(j * 10 + r) for r in range(8)]
+        )
+
+
+def test_scatter_from_src():
+    # tensor_list[j] as held by rank s = s*100 + j; src=0 -> rank r gets 0*100+r
+    tl = [_stack([float(s * 100 + j) for s in range(8)]) for j in range(8)]
+    t = paddle.zeros([8])
+    dist.scatter(t, tl, src=0)
+    np.testing.assert_allclose(t.numpy(), [float(r) for r in range(8)])
+
+
+def test_send_recv_matching():
+    a = paddle.to_tensor([1.0])
+    b = paddle.to_tensor([2.0])
+    dist.send(a, dst=1)
+    dist.send(b, dst=2)
+    out = paddle.zeros([1])
+    dist.recv(out, src=0)
+    np.testing.assert_allclose(out.numpy(), [1.0])
+    dist.recv(out, src=0)
+    np.testing.assert_allclose(out.numpy(), [2.0])
+
+
+def test_hybrid_topology_groups_strided():
+    from paddle_tpu.distributed.fleet.topology import HybridCommunicateGroup
+
+    hcg = HybridCommunicateGroup(dp_degree=2, mp_degree=4)
+    dp = hcg.get_data_parallel_group()
+    # topo order (data, pipe, sharding, sep, model): dp peers stride by mp
+    assert dp.partition == [[0, 4], [1, 5], [2, 6], [3, 7]], dp.partition
+    mp = hcg.get_model_parallel_group()
+    assert mp.partition == [[0, 1, 2, 3], [4, 5, 6, 7]]
+    # gradient-style allreduce over the dp axis
+    t = _stack([float(r) for r in range(8)])
+    dist.all_reduce(t, group=dp)
+    np.testing.assert_allclose(t.numpy(), [4.0, 6.0, 8.0, 10.0] * 2)
+
+
+def test_mesh_matches_topology_ranks():
+    from paddle_tpu.distributed.fleet.topology import HybridCommunicateGroup
+
+    hcg = HybridCommunicateGroup(dp_degree=2, mp_degree=2, pp_degree=2)
+    mesh = hcg.get_mesh()
+    assert mesh.devices.shape == (2, 2, 1, 1, 2)
+    # device at mesh coord == topology rank
+    topo = hcg.topology()
+    flat = mesh.devices.flatten()
+    for rank in range(8):
+        assert flat[rank].id == jax.devices()[rank].id
+        assert topo.get_coord(rank) == tuple(
+            np.unravel_index(rank, (2, 2, 1, 1, 2))
+        )

@@ -1,0 +1,104 @@
+"""DataLoader / save-load / AMP / jit.to_static tests."""
+
+import os
+import tempfile
+
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+from paddle_tpu.io import DataLoader, Dataset, TensorDataset
+
+
+class _Sq(Dataset):
+    def __len__(self):
+        return 10
+
+    def __getitem__(self, i):
+        return np.float32(i), np.float32(i * i)
+
+
+def test_dataloader_batches():
+    dl = DataLoader(_Sq(), batch_size=4, shuffle=False, drop_last=False)
+    batches = list(dl)
+    assert len(batches) == 3
+    x, y = batches[0]
+    np.testing.assert_allclose(np.asarray(x.numpy()).ravel(), [0, 1, 2, 3])
+    np.testing.assert_allclose(np.asarray(y.numpy()).ravel(), [0, 1, 4, 9])
+
+
+def test_dataloader_shuffle_epoch():
+    dl = DataLoader(_Sq(), batch_size=10, shuffle=True)
+    (x, _), = list(dl)
+    assert sorted(np.asarray(x.numpy()).ravel().tolist()) == list(range(10))
+
+
+def test_tensor_dataset():
+    a = paddle.to_tensor(np.arange(6, dtype=np.float32))
+    ds = TensorDataset([a])
+    assert len(ds) == 6
+
+
+def test_save_load_state_dict():
+    m = nn.Linear(3, 3)
+    with tempfile.TemporaryDirectory() as d:
+        path = os.path.join(d, "model.pdparams")
+        paddle.save(m.state_dict(), path)
+        loaded = paddle.load(path)
+        m2 = nn.Linear(3, 3)
+        m2.set_state_dict(loaded)
+        np.testing.assert_allclose(m.weight.numpy(), m2.weight.numpy())
+
+
+def test_amp_autocast_low_precision_matmul():
+    with paddle.amp.auto_cast(level="O1"):
+        a = paddle.to_tensor(np.ones((4, 4), dtype=np.float32))
+        b = paddle.to_tensor(np.ones((4, 4), dtype=np.float32))
+        c = paddle.matmul(a, b)
+    assert c.dtype in (paddle.bfloat16, paddle.float16), c.dtype
+
+
+def test_amp_blacklist_stays_fp32():
+    with paddle.amp.auto_cast(level="O1"):
+        x = paddle.to_tensor(np.ones((4,), dtype=np.float32))
+        s = F.softmax(x)
+    assert s.dtype == paddle.float32
+
+
+def test_grad_scaler_roundtrip():
+    m = nn.Linear(2, 1)
+    optim = paddle.optimizer.SGD(learning_rate=0.01, parameters=m.parameters())
+    scaler = paddle.amp.GradScaler(init_loss_scaling=2.0 ** 10)
+    x = paddle.to_tensor(np.ones((4, 2), dtype=np.float32))
+    loss = paddle.sum(m(x))
+    scaled = scaler.scale(loss)
+    scaled.backward()
+    before = m.weight.numpy().copy()
+    scaler.step(optim)
+    scaler.update()
+    optim.clear_grad()
+    after = m.weight.numpy()
+    # step happened, and with UNSCALED gradient (grad of sum over 4 rows = 4)
+    np.testing.assert_allclose(before - after, 0.01 * 4 * np.ones_like(before), rtol=1e-5)
+
+
+def test_to_static_matches_eager():
+    model = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+    x = paddle.to_tensor(np.random.default_rng(0).normal(size=(3, 4)).astype(np.float32))
+    eager = model(x).numpy()
+    fast = paddle.jit.to_static(model)
+    out = fast(x).numpy()
+    np.testing.assert_allclose(out, eager, rtol=1e-5, atol=1e-6)
+    out2 = fast(x).numpy()  # cached path
+    np.testing.assert_allclose(out2, eager, rtol=1e-5, atol=1e-6)
+
+
+def test_to_static_function_decorator():
+    @paddle.jit.to_static
+    def f(a, b):
+        return paddle.matmul(a, b) + 1.0
+
+    a = paddle.to_tensor(np.ones((2, 3), dtype=np.float32))
+    b = paddle.to_tensor(np.ones((3, 2), dtype=np.float32))
+    np.testing.assert_allclose(f(a, b).numpy(), np.full((2, 2), 4.0))

@@ -1,0 +1,145 @@
+"""nn.Layer / functional tests (reference: python/paddle/nn/layer/layers.py:353
+semantics; numeric oracles are numpy closed forms)."""
+
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+
+
+def test_linear_forward():
+    lin = nn.Linear(4, 3)
+    x = paddle.to_tensor(np.ones((2, 4), dtype=np.float32))
+    y = lin(x)
+    assert y.shape == [2, 3]
+    w = lin.weight.numpy()
+    b = lin.bias.numpy()
+    np.testing.assert_allclose(y.numpy(), np.ones((2, 4)) @ w + b, rtol=1e-5)
+
+
+def test_layer_parameters_named():
+    class M(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc1 = nn.Linear(4, 8)
+            self.fc2 = nn.Linear(8, 2)
+
+        def forward(self, x):
+            return self.fc2(F.relu(self.fc1(x)))
+
+    m = M()
+    params = m.parameters()
+    assert len(params) == 4
+    names = dict(m.named_parameters()).keys()
+    assert "fc1.weight" in names and "fc2.bias" in names
+
+
+def test_state_dict_roundtrip():
+    m = nn.Linear(3, 3)
+    sd = m.state_dict()
+    m2 = nn.Linear(3, 3)
+    m2.set_state_dict(sd)
+    np.testing.assert_allclose(m.weight.numpy(), m2.weight.numpy())
+
+
+def test_sublayer_train_eval_mode():
+    m = nn.Sequential(nn.Linear(2, 2), nn.Dropout(0.5))
+    m.eval()
+    assert not m[1].training
+    m.train()
+    assert m[1].training
+
+
+def test_relu_gelu_softmax():
+    x = np.array([-1.0, 0.0, 2.0], dtype=np.float32)
+    t = paddle.to_tensor(x)
+    np.testing.assert_allclose(F.relu(t).numpy(), np.maximum(x, 0))
+    sm = F.softmax(t).numpy()
+    e = np.exp(x - x.max())
+    np.testing.assert_allclose(sm, e / e.sum(), rtol=1e-6)
+    import math
+
+    g = F.gelu(t).numpy()
+    expect = x * 0.5 * (1 + np.array([math.erf(v / math.sqrt(2)) for v in x]))
+    np.testing.assert_allclose(g, expect, rtol=1e-4, atol=1e-5)
+
+
+def test_layernorm():
+    x = np.random.default_rng(0).normal(size=(2, 5)).astype(np.float32)
+    ln = nn.LayerNorm(5)
+    out = ln(paddle.to_tensor(x)).numpy()
+    mu = x.mean(-1, keepdims=True)
+    var = x.var(-1, keepdims=True)
+    np.testing.assert_allclose(out, (x - mu) / np.sqrt(var + 1e-5), rtol=1e-4, atol=1e-5)
+
+
+def test_embedding():
+    emb = nn.Embedding(10, 4)
+    idx = paddle.to_tensor(np.array([[1, 2], [3, 4]], dtype=np.int64))
+    out = emb(idx)
+    assert out.shape == [2, 2, 4]
+    np.testing.assert_allclose(out.numpy()[0, 0], emb.weight.numpy()[1])
+
+
+def test_conv2d_shape():
+    conv = nn.Conv2D(3, 8, kernel_size=3, padding=1)
+    x = paddle.to_tensor(np.zeros((2, 3, 16, 16), dtype=np.float32))
+    assert conv(x).shape == [2, 8, 16, 16]
+
+
+def test_maxpool_avgpool():
+    x = paddle.to_tensor(np.arange(16.0, dtype=np.float32).reshape(1, 1, 4, 4))
+    mp = nn.MaxPool2D(2)(x)
+    ap = nn.AvgPool2D(2)(x)
+    np.testing.assert_allclose(mp.numpy()[0, 0], [[5, 7], [13, 15]])
+    np.testing.assert_allclose(ap.numpy()[0, 0], [[2.5, 4.5], [10.5, 12.5]])
+
+
+def test_cross_entropy_matches_manual():
+    logits = np.random.default_rng(0).normal(size=(4, 7)).astype(np.float32)
+    labels = np.array([0, 3, 6, 2], dtype=np.int64)
+    loss = F.cross_entropy(paddle.to_tensor(logits), paddle.to_tensor(labels))
+    e = np.exp(logits - logits.max(-1, keepdims=True))
+    p = e / e.sum(-1, keepdims=True)
+    expect = -np.log(p[np.arange(4), labels]).mean()
+    np.testing.assert_allclose(loss.numpy(), expect, rtol=1e-5)
+
+
+def test_mse_loss():
+    a = paddle.to_tensor([1.0, 2.0])
+    b = paddle.to_tensor([0.0, 0.0])
+    np.testing.assert_allclose(F.mse_loss(a, b).numpy(), 2.5)
+
+
+def test_multihead_attention_shape():
+    mha = nn.MultiHeadAttention(16, 4)
+    x = paddle.to_tensor(np.random.default_rng(0).normal(size=(2, 6, 16)).astype(np.float32))
+    out = mha(x)
+    assert out.shape == [2, 6, 16]
+
+
+def test_transformer_encoder_layer():
+    layer = nn.TransformerEncoderLayer(d_model=16, nhead=4, dim_feedforward=32)
+    x = paddle.to_tensor(np.random.default_rng(0).normal(size=(2, 5, 16)).astype(np.float32))
+    assert layer(x).shape == [2, 5, 16]
+
+
+def test_training_loop_loss_decreases():
+    """End-to-end slice: MLP regression, loss must drop (SURVEY §7 step 3)."""
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(64, 8)).astype(np.float32)
+    W = rng.normal(size=(8, 1)).astype(np.float32)
+    Y = X @ W
+
+    model = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 1))
+    optim = paddle.optimizer.SGD(learning_rate=0.1, parameters=model.parameters())
+    losses = []
+    for _ in range(30):
+        pred = model(paddle.to_tensor(X))
+        loss = F.mse_loss(pred, paddle.to_tensor(Y))
+        loss.backward()
+        optim.step()
+        optim.clear_grad()
+        losses.append(float(loss.numpy()))
+    assert losses[-1] < losses[0] * 0.2, losses[::10]

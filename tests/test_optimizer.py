@@ -1,0 +1,94 @@
+"""Optimizer tests (reference: python/paddle/optimizer/optimizer.py:122 family;
+oracles are hand-stepped update rules)."""
+
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+
+
+def _quad_param(init=5.0):
+    p = paddle.Parameter(np.array([init], dtype=np.float32))
+    return p
+
+
+def _step(p, optim, n=1):
+    for _ in range(n):
+        loss = paddle.sum(p * p)
+        loss.backward()
+        optim.step()
+        optim.clear_grad()
+    return float(p.numpy()[0])
+
+
+def test_sgd_exact():
+    p = _quad_param(5.0)
+    optim = paddle.optimizer.SGD(learning_rate=0.1, parameters=[p])
+    v = _step(p, optim)
+    np.testing.assert_allclose(v, 5.0 - 0.1 * 10.0, rtol=1e-6)
+
+
+def test_momentum():
+    p = _quad_param(1.0)
+    optim = paddle.optimizer.Momentum(learning_rate=0.1, momentum=0.9, parameters=[p])
+    # velocity v1 = g = 2.0; p1 = 1 - 0.1*2 = 0.8
+    v = _step(p, optim)
+    np.testing.assert_allclose(v, 0.8, rtol=1e-6)
+    # g2 = 1.6, v2 = 0.9*2 + 1.6 = 3.4, p2 = 0.8 - 0.34 = 0.46
+    v = _step(p, optim)
+    np.testing.assert_allclose(v, 0.46, rtol=1e-5)
+
+
+def test_adam_converges():
+    p = _quad_param(3.0)
+    optim = paddle.optimizer.Adam(learning_rate=0.1, parameters=[p])
+    v = _step(p, optim, n=100)
+    assert abs(v) < 0.1
+
+
+def test_adamw_decay():
+    p = _quad_param(3.0)
+    optim = paddle.optimizer.AdamW(learning_rate=0.01, weight_decay=0.1, parameters=[p])
+    v = _step(p, optim, n=5)
+    assert v < 3.0
+
+
+def test_lr_scheduler():
+    sched = paddle.optimizer.lr.StepDecay(learning_rate=0.1, step_size=2, gamma=0.5)
+    p = _quad_param()
+    optim = paddle.optimizer.SGD(learning_rate=sched, parameters=[p])
+    assert np.isclose(optim.get_lr(), 0.1)
+    for i in range(2):
+        _step(p, optim)
+        sched.step()
+    assert np.isclose(optim.get_lr(), 0.05)
+
+
+def test_clear_grad():
+    p = _quad_param()
+    optim = paddle.optimizer.SGD(learning_rate=0.1, parameters=[p])
+    loss = paddle.sum(p * p)
+    loss.backward()
+    assert p.grad is not None
+    optim.clear_grad()
+    assert p.grad is None
+
+
+def test_grad_clip_global_norm():
+    p = paddle.Parameter(np.array([3.0, 4.0], dtype=np.float32))
+    clip = nn.ClipGradByGlobalNorm(1.0)
+    optim = paddle.optimizer.SGD(learning_rate=1.0, parameters=[p], grad_clip=clip)
+    loss = paddle.sum(p * paddle.to_tensor([1.0, 1.0]))
+    loss.backward()  # grad = [1,1], norm=sqrt(2) -> clipped to [1/sqrt2, 1/sqrt2]
+    optim.step()
+    np.testing.assert_allclose(
+        p.numpy(), [3.0 - 1 / np.sqrt(2), 4.0 - 1 / np.sqrt(2)], rtol=1e-5
+    )
+
+
+def test_optimizer_state_dict():
+    p = _quad_param()
+    optim = paddle.optimizer.Adam(learning_rate=0.1, parameters=[p])
+    _step(p, optim, 3)
+    sd = optim.state_dict()
+    assert sd  # non-empty

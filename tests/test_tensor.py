@@ -1,0 +1,154 @@
+"""Tensor semantics tests (reference: test/legacy_test/test_eager_tensor.py
+style — numpy-reference checks)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def test_to_tensor_dtypes():
+    t = paddle.to_tensor([1.0, 2.0, 3.0])
+    assert t.dtype == np.float32
+    assert t.shape == [3]
+    i = paddle.to_tensor([1, 2, 3])
+    assert i.dtype == np.int64 or i.dtype == np.int32
+    np.testing.assert_allclose(t.numpy(), [1.0, 2.0, 3.0])
+
+
+def test_python_float64_downcast():
+    t = paddle.to_tensor(3.14)
+    assert t.dtype == np.float32
+
+
+def test_basic_arithmetic():
+    a = paddle.to_tensor([1.0, 2.0])
+    b = paddle.to_tensor([3.0, 4.0])
+    np.testing.assert_allclose((a + b).numpy(), [4.0, 6.0])
+    np.testing.assert_allclose((a - b).numpy(), [-2.0, -2.0])
+    np.testing.assert_allclose((a * b).numpy(), [3.0, 8.0])
+    np.testing.assert_allclose((a / b).numpy(), [1 / 3, 0.5], rtol=1e-6)
+    np.testing.assert_allclose((a ** 2).numpy(), [1.0, 4.0])
+    np.testing.assert_allclose((-a).numpy(), [-1.0, -2.0])
+    np.testing.assert_allclose((3.0 + a).numpy(), [4.0, 5.0])
+    np.testing.assert_allclose((3.0 - a).numpy(), [2.0, 1.0])
+    np.testing.assert_allclose((6.0 / b).numpy(), [2.0, 1.5])
+
+
+def test_comparison_ops():
+    a = paddle.to_tensor([1.0, 2.0, 3.0])
+    b = paddle.to_tensor([3.0, 2.0, 1.0])
+    np.testing.assert_array_equal((a < b).numpy(), [True, False, False])
+    np.testing.assert_array_equal((a == b).numpy(), [False, True, False])
+    np.testing.assert_array_equal((a >= b).numpy(), [False, True, True])
+
+
+def test_getitem_setitem():
+    t = paddle.to_tensor(np.arange(12.0).reshape(3, 4))
+    np.testing.assert_allclose(t[1].numpy(), [4, 5, 6, 7])
+    np.testing.assert_allclose(t[:, 2].numpy(), [2, 6, 10])
+    np.testing.assert_allclose(t[1:, ::2].numpy(), [[4, 6], [8, 10]])
+    t[0] = 0.0
+    np.testing.assert_allclose(t[0].numpy(), [0, 0, 0, 0])
+    t[2, 3] = 99.0
+    assert t.numpy()[2, 3] == 99.0
+
+
+def test_astype_item_len_iter():
+    t = paddle.to_tensor([1.5, 2.5])
+    assert t.astype("int32").numpy().dtype == np.int32
+    assert len(t) == 2
+    assert paddle.to_tensor(7.0).item() == 7.0
+    vals = [float(x) for x in t]
+    assert vals == [1.5, 2.5]
+
+
+def test_clone_detach():
+    t = paddle.to_tensor([1.0], stop_gradient=False)
+    c = t.clone()
+    d = t.detach()
+    assert d.stop_gradient
+    np.testing.assert_allclose(c.numpy(), t.numpy())
+
+
+def test_shape_size_ndim():
+    t = paddle.to_tensor(np.zeros((2, 3, 4)))
+    assert t.shape == [2, 3, 4]
+    assert t.ndim == 3
+    assert t.size == 24
+    assert t.numel() == 24
+
+
+def test_creation_ops():
+    np.testing.assert_allclose(paddle.zeros([2, 2]).numpy(), np.zeros((2, 2)))
+    np.testing.assert_allclose(paddle.ones([2]).numpy(), [1, 1])
+    np.testing.assert_allclose(paddle.full([2], 5.0).numpy(), [5, 5])
+    np.testing.assert_allclose(paddle.arange(5).numpy(), np.arange(5))
+    np.testing.assert_allclose(
+        paddle.linspace(0, 1, 5).numpy(), np.linspace(0, 1, 5), rtol=1e-6
+    )
+    e = paddle.eye(3)
+    np.testing.assert_allclose(e.numpy(), np.eye(3))
+
+
+def test_manipulation_ops():
+    x = paddle.to_tensor(np.arange(6.0).reshape(2, 3))
+    np.testing.assert_allclose(
+        paddle.reshape(x, [3, 2]).numpy(), np.arange(6.0).reshape(3, 2)
+    )
+    np.testing.assert_allclose(
+        paddle.transpose(x, [1, 0]).numpy(), x.numpy().T
+    )
+    np.testing.assert_allclose(
+        paddle.concat([x, x], axis=0).numpy(), np.concatenate([x.numpy()] * 2, 0)
+    )
+    np.testing.assert_allclose(
+        paddle.stack([x, x], axis=0).numpy(), np.stack([x.numpy()] * 2, 0)
+    )
+    parts = paddle.split(x, 3, axis=1)
+    assert len(parts) == 3
+    np.testing.assert_allclose(parts[1].numpy(), x.numpy()[:, 1:2])
+    np.testing.assert_allclose(
+        paddle.squeeze(paddle.unsqueeze(x, 0), 0).numpy(), x.numpy()
+    )
+
+
+def test_reduction_ops():
+    x = np.arange(6.0).reshape(2, 3)
+    t = paddle.to_tensor(x)
+    np.testing.assert_allclose(paddle.sum(t).numpy(), x.sum())
+    np.testing.assert_allclose(paddle.mean(t, axis=0).numpy(), x.mean(0))
+    np.testing.assert_allclose(paddle.max(t, axis=1).numpy(), x.max(1))
+    np.testing.assert_allclose(paddle.min(t).numpy(), x.min())
+    np.testing.assert_allclose(paddle.prod(t, axis=1).numpy(), x.prod(1))
+    assert paddle.argmax(t).item() == 5
+
+
+def test_linalg():
+    a = np.random.default_rng(0).normal(size=(3, 4)).astype(np.float32)
+    b = np.random.default_rng(1).normal(size=(4, 5)).astype(np.float32)
+    out = paddle.matmul(paddle.to_tensor(a), paddle.to_tensor(b))
+    np.testing.assert_allclose(out.numpy(), a @ b, rtol=1e-5, atol=1e-5)
+
+
+def test_math_unary():
+    x = np.array([0.5, 1.0, 2.0], dtype=np.float32)
+    t = paddle.to_tensor(x)
+    np.testing.assert_allclose(paddle.exp(t).numpy(), np.exp(x), rtol=1e-6)
+    np.testing.assert_allclose(paddle.log(t).numpy(), np.log(x), rtol=1e-6)
+    np.testing.assert_allclose(paddle.sqrt(t).numpy(), np.sqrt(x), rtol=1e-6)
+    np.testing.assert_allclose(paddle.tanh(t).numpy(), np.tanh(x), rtol=1e-6)
+    np.testing.assert_allclose(paddle.abs(paddle.to_tensor(-x)).numpy(), x)
+
+
+def test_inplace_add_():
+    t = paddle.to_tensor([1.0, 2.0])
+    if hasattr(t, "add_"):
+        t.add_(paddle.to_tensor([1.0, 1.0]))
+        np.testing.assert_allclose(t.numpy(), [2.0, 3.0])
+
+
+def test_copy_set_value():
+    t = paddle.to_tensor([1.0, 2.0])
+    t.set_value(np.array([9.0, 9.0], dtype=np.float32))
+    np.testing.assert_allclose(t.numpy(), [9.0, 9.0])
