@@ -1,0 +1,85 @@
+"""Benchmark: fully-jitted GPT training step (fwd + bwd + AdamW) tokens/sec.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+The model is a GPT decoder sized to fit one chip comfortably (bf16 matmuls on
+the MXU via amp-style casts inside the model dtype); the step is the
+TrainStep single-program path (SURVEY §3.1-3.2 hot loop collapsed into one
+XLA executable). vs_baseline is vs BASELINE.md — the reference publishes no
+in-repo numbers, so the recorded envelope is tokens/sec on this chip with 1.0
+meaning "meets the working target" (see BASELINE.md).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    import paddle_tpu as paddle
+    import paddle_tpu.optimizer as opt
+    from paddle_tpu.jit.api import TrainStep
+    from paddle_tpu.models import (
+        GPTConfig,
+        GPTForCausalLM,
+        GPTPretrainingCriterion,
+    )
+
+    platform = jax.devices()[0].platform
+    on_tpu = platform == "tpu"
+
+    # ~124M param GPT-2-small shape on TPU; tiny on CPU so the bench is quick.
+    if on_tpu:
+        cfg = GPTConfig(vocab_size=50304, hidden_size=768, num_layers=12,
+                        num_heads=12, max_position_embeddings=1024)
+        batch, seqlen, iters = 8, 1024, 20
+    else:
+        cfg = GPTConfig(vocab_size=1024, hidden_size=128, num_layers=2,
+                        num_heads=4, max_position_embeddings=256)
+        batch, seqlen, iters = 4, 128, 5
+
+    model = GPTForCausalLM(cfg)
+    criterion = GPTPretrainingCriterion(cfg)
+    optimizer = opt.AdamW(learning_rate=1e-4, parameters=model.parameters())
+
+    def loss_fn(m, ids, labels):
+        return criterion(m(ids), labels)
+
+    step = TrainStep(model, loss_fn, optimizer)
+
+    rng = np.random.default_rng(0)
+    ids_np = rng.integers(0, cfg.vocab_size, (batch, seqlen)).astype(np.int32)
+    ids = paddle.to_tensor(ids_np)
+    labels = paddle.to_tensor(ids_np)
+
+    # warmup/compile
+    loss = step(ids, labels)
+    _ = float(loss.numpy())
+
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        loss = step(ids, labels)
+    _ = float(loss.numpy())  # sync
+    dt = time.perf_counter() - t0
+
+    tokens_per_sec = batch * seqlen * iters / dt
+    # Working target (BASELINE.md): no reference number exists in-repo; use
+    # GPT-2-small-on-A100 ballpark ~60k tok/s as the 1.0 mark when on TPU.
+    target = 60000.0 if on_tpu else tokens_per_sec
+    print(json.dumps({
+        "metric": "gpt2s_train_tokens_per_sec" if on_tpu
+        else "gpt_tiny_cpu_train_tokens_per_sec",
+        "value": round(tokens_per_sec, 1),
+        "unit": "tokens/s",
+        "vs_baseline": round(tokens_per_sec / target, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

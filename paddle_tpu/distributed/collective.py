@@ -265,10 +265,12 @@ def all_to_all(out_tensor_list, in_tensor_list, group: Optional[Group] = None,
     world = stacked.shape[0]
     peers, local = _local_index_maps(g)
     mesh = _world_mesh()
-    # out[r][j] = in[local(r)] as held by the j-th peer of r's group
+    # out[r][j] = in[local(r)] as held by the j-th peer of r's group;
+    # non-members keep their own in[j] untouched
     for j in range(n):
         src_rank = [peers[r][j] if peers[r] is not None else r for r in range(world)]
-        entry = stacked[jnp.asarray(src_rank), jnp.asarray(local)]
+        sel = [local[r] if peers[r] is not None else j for r in range(world)]
+        entry = stacked[jnp.asarray(src_rank), jnp.asarray(sel)]
         entry = jax.device_put(entry, NamedSharding(mesh, P("world")))
         out_tensor_list.append(Tensor._from_value(entry))
     return _Task()
@@ -286,7 +288,7 @@ def broadcast(tensor: Tensor, src: int, group: Optional[Group] = None, sync_op=T
     world = v.shape[0]
     src_local = g.get_group_rank(src)
     if src_local < 0:
-        src_local = src
+        raise ValueError(f"broadcast src rank {src} is not in the group")
     peers, _ = _local_index_maps(g)
     idx = [peers[r][src_local] if peers[r] is not None else r for r in range(world)]
     out = jnp.take(v, jnp.asarray(idx), axis=0)
@@ -321,7 +323,7 @@ def scatter(tensor: Tensor, tensor_list=None, src=0, group: Optional[Group] = No
         world = stacked.shape[0]
         src_local = g.get_group_rank(src)
         if src_local < 0:
-            src_local = src
+            raise ValueError(f"scatter src rank {src} is not in the group")
         peers, local = _local_index_maps(g)
         src_rank = [
             peers[r][src_local] if peers[r] is not None else r for r in range(world)

@@ -4,10 +4,11 @@ topology.py:65 CommunicateTopology, :178 HybridCommunicateGroup; axes list
 :290).
 
 TPU-native: the topology *is* a jax.sharding.Mesh. Axis order in the mesh is
-(pp, dp, sharding, sep, mp) outer→inner so that the mp (tensor-parallel) axis
-maps to adjacent devices — TP collectives are latency-bound and ride the
-shortest ICI hops, while pp crosses the slowest links (the same physical
-placement the reference engineers via its rank order).
+(dp, pp, sharding, sep, mp) outer→inner — matching the topology's rank order
+exactly (device i == rank i), with mp (tensor-parallel) innermost so TP
+collectives, which are latency-bound, ride adjacent devices / shortest ICI
+hops (the same physical placement the reference engineers via its rank
+order).
 """
 
 from __future__ import annotations

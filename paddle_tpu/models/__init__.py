@@ -1,0 +1,14 @@
+"""Model zoo. The reference keeps its NLP flagship models in PaddleNLP (GPT-3,
+LLaMA — the Fleet hybrid-parallel configs cited in BASELINE.md) and vision
+models in-repo (python/paddle/vision/models). Here the NLP flagships live
+in-tree because they are the benchmark/bring-up vehicles for the hybrid
+parallel stack (SURVEY §3.5, §6)."""
+
+from paddle_tpu.models.gpt import (  # noqa: F401
+    GPTConfig,
+    GPTModel,
+    GPTForCausalLM,
+    GPTPretrainingCriterion,
+    gpt_tiny,
+    gpt3_1p3b,
+)

@@ -57,9 +57,9 @@ def flash_attention_fwd(q, k, v, bias=None, causal=False, scale=None):
     if scale is None:
         scale = 1.0 / math.sqrt(q.shape[-1])
     if _use_pallas(q.shape, q.shape[-1]):
-        from paddle_tpu.ops.pallas import flash_attention_tpu as ker
-
         try:
+            from paddle_tpu.ops.pallas import flash_attention_tpu as ker
+
             return ker.flash_attention(q, k, v, bias=bias, causal=causal, scale=scale)
         except Exception:
             pass

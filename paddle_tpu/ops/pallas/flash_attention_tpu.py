@@ -1,0 +1,51 @@
+"""TPU Pallas flash-attention kernel entry (parity:
+phi/kernels/gpu/flash_attn_kernel.cu — fwd+bwd fused attention).
+
+Dispatches to the Pallas MHA kernel family (block-tiled online-softmax
+attention with a custom VJP, i.e. the flash algorithm scheduled for
+MXU/VMEM). Layout at this boundary is paddle's [batch, seq, heads, head_dim];
+the kernel runs [batch, heads, seq, head_dim].
+
+Block sizes: q/k blocks of 512 (or the sequence length if shorter) keep the
+working set within VMEM (~16MB/core) at head_dim 64-256 while giving the MXU
+full 128-lane tiles.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax.numpy as jnp
+from jax.experimental.pallas.ops.tpu.flash_attention import (
+    BlockSizes,
+    flash_attention as _mha,
+)
+
+
+def _block_sizes(sq: int, sk: int) -> BlockSizes:
+    bq = min(512, sq)
+    bk = min(512, sk)
+    return BlockSizes(
+        block_q=bq, block_k_major=bk, block_k=bk, block_b=1,
+        block_q_major_dkv=bq, block_k_major_dkv=bk, block_k_dkv=bk,
+        block_q_dkv=bq, block_k_major_dq=bk, block_k_dq=bk, block_q_dq=bq,
+    )
+
+
+def flash_attention(q, k, v, bias=None, causal=False, scale=1.0):
+    """q, k, v: [B, S, H, D] -> out [B, S, H, D]."""
+    qt = jnp.transpose(q, (0, 2, 1, 3))
+    kt = jnp.transpose(k, (0, 2, 1, 3))
+    vt = jnp.transpose(v, (0, 2, 1, 3))
+    ab = None
+    if bias is not None:
+        # the kernel computes (qk + ab) * sm_scale; our contract is
+        # qk * scale + bias, so pre-divide the bias by scale
+        b_, h_, sq_, sk_ = (qt.shape[0], qt.shape[1], qt.shape[2], kt.shape[2])
+        ab = jnp.broadcast_to(
+            bias.astype(jnp.float32) / float(scale), (b_, h_, sq_, sk_))
+    out = _mha(
+        qt, kt, vt, ab=ab, causal=causal, sm_scale=float(scale),
+        block_sizes=_block_sizes(qt.shape[2], kt.shape[2]),
+    )
+    return jnp.transpose(out, (0, 2, 1, 3))
