@@ -65,6 +65,10 @@ from paddle_tpu import amp  # noqa: E402,F401
 from paddle_tpu import jit  # noqa: E402,F401
 from paddle_tpu import autograd  # noqa: E402,F401
 from paddle_tpu import device  # noqa: E402,F401
+from paddle_tpu import metric  # noqa: E402,F401
+from paddle_tpu import vision  # noqa: E402,F401
+from paddle_tpu import hapi  # noqa: E402,F401
+from paddle_tpu.hapi.model import Model  # noqa: E402,F401
 from paddle_tpu.framework.io import load, save  # noqa: E402,F401
 
 __version__ = "0.1.0"

@@ -1,3 +1,8 @@
+from paddle_tpu.autograd.py_layer import (  # noqa: F401
+    LegacyPyLayer,
+    PyLayer,
+    PyLayerContext,
+)
 from paddle_tpu.autograd.tape import (  # noqa: F401
     TapeNode,
     enable_grad,

@@ -106,6 +106,11 @@ class GPTEmbeddings(nn.Layer):
     def forward(self, input_ids, position_ids=None):
         if position_ids is None:
             seq_len = input_ids.shape[-1]
+            if seq_len > self._cfg.max_position_embeddings:
+                raise ValueError(
+                    f"sequence length {seq_len} exceeds "
+                    f"max_position_embeddings {self._cfg.max_position_embeddings}"
+                )
             position_ids = paddle.arange(0, seq_len, dtype="int32")
         h = self.word_embeddings(input_ids) + self.position_embeddings(position_ids)
         return self.dropout(_seq_constrain(h, self._cfg))

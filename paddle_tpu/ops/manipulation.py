@@ -24,6 +24,11 @@ def _static_ints(v):
     return [int(i) if not isinstance(i, Tensor) else int(i.item()) for i in v]
 
 
+@register_op("cast", category="manipulation")
+def cast(x, dtype, name=None):
+    return x.astype(dtype)
+
+
 @register_op("reshape", category="manipulation")
 def reshape(x, shape, name=None):
     shape = _static_ints(shape)

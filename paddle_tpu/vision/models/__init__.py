@@ -1,0 +1,27 @@
+"""Model zoo (parity: python/paddle/vision/models/__init__.py)."""
+
+from paddle_tpu.vision.models.lenet import LeNet  # noqa: F401
+from paddle_tpu.vision.models.alexnet import AlexNet, alexnet  # noqa: F401
+from paddle_tpu.vision.models.resnet import (  # noqa: F401
+    ResNet,
+    resnet18,
+    resnet34,
+    resnet50,
+    resnet101,
+    resnet152,
+    wide_resnet50_2,
+    wide_resnet101_2,
+)
+from paddle_tpu.vision.models.vgg import (  # noqa: F401
+    VGG,
+    vgg11,
+    vgg13,
+    vgg16,
+    vgg19,
+)
+from paddle_tpu.vision.models.mobilenet import (  # noqa: F401
+    MobileNetV1,
+    MobileNetV2,
+    mobilenet_v1,
+    mobilenet_v2,
+)

@@ -1,0 +1,141 @@
+"""Vision models/transforms, hapi Model.fit, metrics, PyLayer (reference test
+patterns: test/legacy_test/test_vision_models.py, test_model.py,
+test_metrics.py, test_pylayer_op.py)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.vision import models, transforms
+from paddle_tpu.vision.datasets import FakeData
+
+
+def test_lenet_forward():
+    m = models.LeNet()
+    x = paddle.to_tensor(np.random.randn(2, 1, 28, 28).astype(np.float32))
+    out = m(x)
+    assert out.shape == [2, 10]
+
+
+@pytest.mark.parametrize("ctor", [models.resnet18, models.mobilenet_v2])
+def test_imagenet_models_forward(ctor):
+    m = ctor(num_classes=7)
+    m.eval()
+    x = paddle.to_tensor(np.random.randn(1, 3, 64, 64).astype(np.float32))
+    out = m(x)
+    assert out.shape == [1, 7]
+
+
+def test_resnet50_param_count():
+    # reference resnet50 has 25.557M params; ours must match the architecture
+    m = models.resnet50(num_classes=1000)
+    n = sum(int(np.prod(p.shape)) for p in m.parameters())
+    assert abs(n - 25_557_032) < 10_000, n
+
+
+def test_transforms_pipeline():
+    t = transforms.Compose([
+        transforms.Resize(40),
+        transforms.CenterCrop(32),
+        transforms.RandomHorizontalFlip(0.5),
+        transforms.ToTensor(),
+        transforms.Normalize(mean=[0.5, 0.5, 0.5], std=[0.5, 0.5, 0.5]),
+    ])
+    img = (np.random.rand(50, 60, 3) * 255).astype(np.uint8)
+    out = t(img)
+    assert out.shape == (3, 32, 32)
+    assert out.dtype == np.float32
+
+
+def test_metrics_accuracy():
+    m = paddle.metric.Accuracy(topk=(1, 2))
+    pred = paddle.to_tensor(np.array([[0.1, 0.9, 0.0], [0.8, 0.1, 0.1]],
+                                     dtype=np.float32))
+    label = paddle.to_tensor(np.array([[1], [2]], dtype=np.int64))
+    correct = m.compute(pred, label)
+    m.update(correct)
+    top1, top2 = m.accumulate()
+    assert abs(top1 - 0.5) < 1e-6
+    assert abs(top2 - 0.5) < 1e-6
+
+
+def test_hapi_fit_loss_drops():
+    train = FakeData(num_samples=64, image_shape=(1, 28, 28), num_classes=10)
+    model = paddle.Model(models.LeNet())
+    opt = paddle.optimizer.Adam(learning_rate=1e-3,
+                                parameters=model.parameters())
+    model.prepare(opt, nn.CrossEntropyLoss(), paddle.metric.Accuracy())
+    model.fit(train, epochs=2, batch_size=16, verbose=0)
+    logs = model.evaluate(train, batch_size=16, verbose=0)
+    assert logs["eval_loss"] < 2.5
+
+
+def test_hapi_save_load(tmp_path):
+    model = paddle.Model(models.LeNet())
+    opt = paddle.optimizer.SGD(parameters=model.parameters())
+    model.prepare(opt, nn.CrossEntropyLoss())
+    p = str(tmp_path / "ckpt")
+    model.save(p)
+    model2 = paddle.Model(models.LeNet())
+    model2.prepare(paddle.optimizer.SGD(parameters=model2.parameters()),
+                   nn.CrossEntropyLoss())
+    model2.load(p)
+    x = paddle.to_tensor(np.random.randn(2, 1, 28, 28).astype(np.float32))
+    np.testing.assert_allclose(model.network(x).numpy(),
+                               model2.network(x).numpy(), rtol=1e-6)
+
+
+def test_pylayer_custom_backward():
+    from paddle_tpu.autograd import PyLayer
+
+    class Cube(PyLayer):
+        @staticmethod
+        def forward(ctx, x):
+            ctx.save_for_backward(x)
+            return x * x * x
+
+        @staticmethod
+        def backward(ctx, dy):
+            (x,) = ctx.saved_tensor()
+            return 3 * x * x * dy
+
+    x = paddle.to_tensor(np.array([2.0, -1.0], dtype=np.float32),
+                         stop_gradient=False)
+    y = Cube.apply(x)
+    loss = paddle.sum(y)
+    loss.backward()
+    np.testing.assert_allclose(x.grad.numpy(), 3 * np.array([4.0, 1.0]),
+                               rtol=1e-6)
+
+
+def test_pylayer_multi_inout():
+    from paddle_tpu.autograd import PyLayer
+
+    class AddMul(PyLayer):
+        @staticmethod
+        def forward(ctx, a, b):
+            ctx.save_for_backward(a, b)
+            return a + b, a * b
+
+        @staticmethod
+        def backward(ctx, ds, dp):
+            a, b = ctx.saved_tensor()
+            return ds + dp * b, ds + dp * a
+
+    a = paddle.to_tensor(np.array([2.0], np.float32), stop_gradient=False)
+    b = paddle.to_tensor(np.array([3.0], np.float32), stop_gradient=False)
+    s, p = AddMul.apply(a, b)
+    (s + p).backward()
+    np.testing.assert_allclose(a.grad.numpy(), [4.0])  # 1 + b
+    np.testing.assert_allclose(b.grad.numpy(), [3.0])  # 1 + a
+
+
+def test_nms():
+    from paddle_tpu.vision.ops import nms
+
+    boxes = paddle.to_tensor(np.array([
+        [0, 0, 10, 10], [1, 1, 11, 11], [20, 20, 30, 30]], dtype=np.float32))
+    scores = paddle.to_tensor(np.array([0.9, 0.8, 0.7], dtype=np.float32))
+    keep = nms(boxes, iou_threshold=0.5, scores=scores)
+    np.testing.assert_array_equal(sorted(keep.numpy().tolist()), [0, 2])
