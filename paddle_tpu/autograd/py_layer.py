@@ -20,7 +20,6 @@ class PyLayerContext:
     def __init__(self):
         self._saved: List[Any] = []
         self._non_diff_ids = set()
-        self._keep_alive: List[Any] = []
         self.not_inplace_tensors = ()
         self.materialize_grads = True
 
@@ -119,10 +118,9 @@ class PyLayer(metaclass=PyLayerMeta):
             t = o if isinstance(o, Tensor) else Tensor._from_value(o)
             node.register_output(i, t)
             if id(o) in ctx._non_diff_ids:
-                # non-differentiable output: stays a grad sink (its cotangent
-                # zero-fills in backward); ctx pins it so the weakref in the
-                # node's output slot outlives user code dropping it
-                ctx._keep_alive.append(t)
+                # non-differentiable output: its cotangent zero-fills in
+                # backward from the registered aval
+                pass
             else:
                 t.stop_gradient = False
                 t._node = node

@@ -70,31 +70,36 @@ class TapeNode:
     the graph alive through the node.
     """
 
-    __slots__ = ("name", "vjp_fn", "inputs", "outputs", "n_outputs", "__weakref__")
+    __slots__ = ("name", "vjp_fn", "inputs", "outputs", "out_avals",
+                 "n_outputs", "__weakref__")
 
     def __init__(self, name: str, vjp_fn: Callable, inputs: Sequence[Any], n_outputs: int):
         self.name = name
         self.vjp_fn = vjp_fn
         self.inputs = list(inputs)
         self.outputs: List[Optional[weakref.ref]] = [None] * n_outputs
+        # (shape, dtype) per output so zero cotangents can be materialized
+        # even after the output Tensor dies (dropped aux outputs are common)
+        self.out_avals: List[Optional[tuple]] = [None] * n_outputs
         self.n_outputs = n_outputs
 
     def register_output(self, idx: int, tensor) -> None:
         self.outputs[idx] = weakref.ref(tensor)
+        self.out_avals[idx] = (tensor._value.shape, tensor._value.dtype)
 
     def __repr__(self):
         return f"TapeNode({self.name}, n_in={len(self.inputs)}, n_out={self.n_outputs})"
 
 
-def _zero_cotangent(val):
-    """Zero cotangent matching jax.vjp's expectation: float0 for non-inexact
-    primals (integer/bool outputs of multi-output ops like topk)."""
+def _zero_cotangent_aval(shape, dtype):
+    """Zero cotangent from a stored (shape, dtype) — the output Tensor may be
+    dead (e.g. dropped aux outputs of multi-output ops)."""
     import jax.numpy as jnp
     import numpy as np
 
-    if jnp.issubdtype(val.dtype, jnp.inexact):
-        return jnp.zeros_like(val)
-    return np.zeros(val.shape, dtype=jax.dtypes.float0)
+    if jnp.issubdtype(dtype, jnp.inexact):
+        return jnp.zeros(shape, dtype)
+    return np.zeros(shape, dtype=jax.dtypes.float0)
 
 
 def _toposort(root_node: TapeNode) -> List[TapeNode]:
@@ -222,14 +227,13 @@ def run_backward(tensors, grad_tensors=None, retain_graph: bool = False) -> None
         filled = []
         for i, c in enumerate(cots):
             if c is None:
-                ref = node.outputs[i]
-                t = ref() if ref is not None else None
-                if t is None:
+                aval = node.out_avals[i]
+                if aval is None:
                     raise RuntimeError(
-                        f"backward through {node.name}: output {i} was freed but "
-                        "its cotangent is needed; keep a reference or use retain_graph"
+                        f"backward through {node.name}: output {i} was never "
+                        "registered; cannot materialize its zero cotangent"
                     )
-                filled.append(_zero_cotangent(t._value))
+                filled.append(_zero_cotangent_aval(*aval))
             else:
                 filled.append(c)
         out_cot = tuple(filled) if node.n_outputs > 1 else filled[0]

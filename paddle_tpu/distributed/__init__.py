@@ -51,3 +51,8 @@ from paddle_tpu.distributed.env import (  # noqa: F401
 )
 from paddle_tpu.distributed.parallel import DataParallel  # noqa: F401
 from paddle_tpu.distributed.sharding import group_sharded_parallel  # noqa: F401
+from paddle_tpu.distributed import checkpoint  # noqa: F401,E402
+from paddle_tpu.distributed.checkpoint import (  # noqa: F401,E402
+    load_state_dict,
+    save_state_dict,
+)

@@ -165,7 +165,7 @@ class EarlyStopping(Callback):
             self.min_delta *= -1
         else:
             self.monitor_op = np.greater
-        self.best = None
+        self.best = baseline
         self.wait = 0
         self.stopped_epoch = 0
 

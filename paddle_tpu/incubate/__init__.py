@@ -1,0 +1,4 @@
+"""paddle.incubate parity (reference: python/paddle/incubate/)."""
+
+from paddle_tpu.incubate import nn  # noqa: F401
+from paddle_tpu.incubate import distributed  # noqa: F401

@@ -1,0 +1,287 @@
+"""Fused ops (parity: python/paddle/incubate/nn/functional/ — fused_rms_norm,
+fused_rotary_position_embedding, swiglu, fused_linear, fused_bias_act,
+masked_multihead_attention; GPU kernels live in phi/kernels/fusion/gpu/).
+
+TPU-native: each "fused" op is expressed as one jnp composition — XLA fuses
+the elementwise chains into the surrounding matmuls on its own, so these are
+semantically-fused ops whose fusion is delegated to the compiler; the
+attention entries route to the Pallas flash kernel."""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.dispatch import apply
+from paddle_tpu.nn import functional as F
+from paddle_tpu.ops.pallas.flash_attention import scaled_dot_product_attention
+from paddle_tpu.tensor import Tensor
+
+
+def fused_rms_norm(x, norm_weight=None, norm_bias=None, epsilon=1e-6,
+                   begin_norm_axis=-1, bias=None, residual=None,
+                   quant_scale=-1, **kwargs):
+    """fused_rms_norm (incubate/nn/functional/fused_rms_norm.py): optional
+    bias+residual add fused ahead of the norm. Returns (out, residual_out)
+    when residual is given, else out."""
+
+    def f(xv, *rest):
+        it = iter(rest)
+        b = next(it) if bias is not None else None
+        r = next(it) if residual is not None else None
+        w = next(it) if norm_weight is not None else None
+        nb = next(it) if norm_bias is not None else None
+        h = xv
+        if b is not None:
+            h = h + b
+        if r is not None:
+            h = h + r
+        residual_out = h
+        axes = tuple(range(begin_norm_axis % h.ndim, h.ndim))
+        var = jnp.mean(jnp.square(h.astype(jnp.float32)), axis=axes,
+                       keepdims=True)
+        out = (h.astype(jnp.float32) * jax.lax.rsqrt(var + epsilon)).astype(h.dtype)
+        if w is not None:
+            out = out * w
+        if nb is not None:
+            out = out + nb
+        if residual is not None:
+            return out, residual_out
+        return out
+
+    args = [x]
+    for t in (bias, residual, norm_weight, norm_bias):
+        if t is not None:
+            args.append(t)
+    return apply("fused_rms_norm", f, *args)
+
+
+def fused_layer_norm(x, norm_weight=None, norm_bias=None, epsilon=1e-5,
+                     begin_norm_axis=-1, bias=None, residual=None, **kwargs):
+    def f(xv, *rest):
+        it = iter(rest)
+        b = next(it) if bias is not None else None
+        r = next(it) if residual is not None else None
+        w = next(it) if norm_weight is not None else None
+        nb = next(it) if norm_bias is not None else None
+        h = xv
+        if b is not None:
+            h = h + b
+        if r is not None:
+            h = h + r
+        residual_out = h
+        hf = h.astype(jnp.float32)
+        axes = tuple(range(begin_norm_axis % h.ndim, h.ndim))
+        mean = jnp.mean(hf, axis=axes, keepdims=True)
+        var = jnp.var(hf, axis=axes, keepdims=True)
+        out = ((hf - mean) * jax.lax.rsqrt(var + epsilon)).astype(h.dtype)
+        if w is not None:
+            out = out * w
+        if nb is not None:
+            out = out + nb
+        if residual is not None:
+            return out, residual_out
+        return out
+
+    args = [x]
+    for t in (bias, residual, norm_weight, norm_bias):
+        if t is not None:
+            args.append(t)
+    return apply("fused_layer_norm", f, *args)
+
+
+def swiglu(x, y=None, name=None):
+    """swiglu (incubate/nn/functional/swiglu.py): silu(x) * y; when y is None,
+    x is split in half on the last dim."""
+
+    if y is None:
+        def f(xv):
+            a, b = jnp.split(xv, 2, axis=-1)
+            return jax.nn.silu(a) * b
+
+        return apply("swiglu", f, x)
+
+    return apply("swiglu", lambda a, b: jax.nn.silu(a) * b, x, y)
+
+
+def _rope_rotate_half(x):
+    x1, x2 = jnp.split(x, 2, axis=-1)
+    return jnp.concatenate([-x2, x1], axis=-1)
+
+
+def _rope_rotate_interleaved(x):
+    x1 = x[..., 0::2]
+    x2 = x[..., 1::2]
+    return jnp.stack([-x2, x1], axis=-1).reshape(x.shape)
+
+
+def fused_rotary_position_embedding(q, k=None, v=None, sin=None, cos=None,
+                                    position_ids=None, use_neox_rotary_style=True,
+                                    time_major=False, rotary_emb_base=10000.0,
+                                    name=None):
+    """fused_rotary_position_embedding (incubate/nn/functional): applies RoPE
+    to q/k (and v for parity; paddle rotates v too when given). Layout
+    [batch, seq, heads, head_dim]. Returns tuple matching given inputs."""
+
+    given = [t for t in (q, k, v) if t is not None]
+    n_given = len(given)
+
+    def f(*vals):
+        tensors = list(vals[:n_given])
+        rest = list(vals[n_given:])
+        it = iter(rest)
+        sin_v = next(it) if sin is not None else None
+        cos_v = next(it) if cos is not None else None
+        pos = next(it) if position_ids is not None else None
+
+        head_dim = tensors[0].shape[-1]
+        seq_len = tensors[0].shape[1]
+        if sin_v is None:
+            inv = 1.0 / (rotary_emb_base ** (
+                jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim))
+            t_ = jnp.arange(seq_len, dtype=jnp.float32)
+            freqs = jnp.outer(t_, inv)  # [S, D/2]
+            if use_neox_rotary_style:
+                emb = jnp.concatenate([freqs, freqs], axis=-1)
+            else:
+                emb = jnp.repeat(freqs, 2, axis=-1)
+            sin_v = jnp.sin(emb)
+            cos_v = jnp.cos(emb)
+        else:
+            sin_v = jnp.reshape(sin_v, sin_v.shape[-2:])
+            cos_v = jnp.reshape(cos_v, cos_v.shape[-2:])
+        if pos is not None:
+            sin_v = jnp.take(sin_v, pos, axis=0)  # [B?, S, D]
+            cos_v = jnp.take(cos_v, pos, axis=0)
+        # broadcast to [B, S, H, D]
+        while sin_v.ndim < 4:
+            sin_v = sin_v[None] if sin_v.ndim == 2 else sin_v[:, :, None, :]
+        while cos_v.ndim < 4:
+            cos_v = cos_v[None] if cos_v.ndim == 2 else cos_v[:, :, None, :]
+        rot = (_rope_rotate_half if use_neox_rotary_style
+               else _rope_rotate_interleaved)
+        outs = []
+        for t in tensors:
+            dt = t.dtype
+            tf = t.astype(jnp.float32)
+            outs.append((tf * cos_v + rot(tf) * sin_v).astype(dt))
+        return tuple(outs) if len(outs) > 1 else outs[0]
+
+    args = list(given)
+    for t in (sin, cos, position_ids):
+        if t is not None:
+            args.append(t)
+    out = apply("fused_rotary_position_embedding", f, *args)
+    if not isinstance(out, tuple):
+        out = (out,)
+    res = []
+    i = 0
+    for t in (q, k, v):
+        if t is None:
+            res.append(None)
+        else:
+            res.append(out[i])
+            i += 1
+    return tuple(res)
+
+
+def fused_linear(x, weight, bias=None, transpose_weight=False, name=None):
+    """fused_linear (fused_matmul_bias): one matmul+bias epilogue."""
+    if transpose_weight:
+        from paddle_tpu.ops.linalg import matmul
+
+        out = matmul(x, weight, transpose_y=True)
+        return out + bias if bias is not None else out
+    return F.linear(x, weight, bias)
+
+
+def fused_bias_act(x, bias=None, act_method="gelu", **kwargs):
+    def f(xv, *rest):
+        h = xv + rest[0] if rest else xv
+        if act_method in ("gelu", "geglu"):
+            return jax.nn.gelu(h)
+        if act_method in ("swiglu",):
+            a, b = jnp.split(h, 2, axis=-1)
+            return jax.nn.silu(a) * b
+        if act_method == "relu":
+            return jax.nn.relu(h)
+        if act_method == "silu":
+            return jax.nn.silu(h)
+        raise ValueError(f"unknown act {act_method}")
+
+    args = [x] + ([bias] if bias is not None else [])
+    return apply("fused_bias_act", f, *args)
+
+
+def fused_multi_head_attention(x, qkv_weight, linear_weight, pre_layer_norm=False,
+                               pre_ln_scale=None, pre_ln_bias=None, ln_scale=None,
+                               ln_bias=None, pre_ln_epsilon=1e-5, qkv_bias=None,
+                               linear_bias=None, cache_kv=None, attn_mask=None,
+                               dropout_rate=0.0, attn_dropout_rate=0.0,
+                               ln_epsilon=1e-5, training=True, num_heads=None,
+                               name=None):
+    """FusedMultiHeadAttention functional path (fused_transformer.py:189).
+    qkv_weight: [3, num_heads, head_dim, embed_dim] (paddle layout)."""
+
+    def f(xv, qkv_w, lin_w, *rest):
+        it = iter(rest)
+        pls = next(it) if pre_ln_scale is not None else None
+        plb = next(it) if pre_ln_bias is not None else None
+        lns = next(it) if ln_scale is not None else None
+        lnb = next(it) if ln_bias is not None else None
+        qkv_b = next(it) if qkv_bias is not None else None
+        lin_b = next(it) if linear_bias is not None else None
+        mask = next(it) if attn_mask is not None else None
+
+        residual = xv
+        h = xv
+        if pre_layer_norm:
+            mu = jnp.mean(h, axis=-1, keepdims=True)
+            var = jnp.var(h, axis=-1, keepdims=True)
+            h = (h - mu) * jax.lax.rsqrt(var + pre_ln_epsilon)
+            if pls is not None:
+                h = h * pls
+            if plb is not None:
+                h = h + plb
+        three, nh, hd, emb = qkv_w.shape
+        w = qkv_w.reshape(3 * nh * hd, emb).T  # [emb, 3*nh*hd]
+        qkv = h @ w
+        if qkv_b is not None:
+            qkv = qkv + qkv_b.reshape(-1)
+        b, s, _ = qkv.shape
+        qkv = qkv.reshape(b, s, 3, nh, hd)
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        from paddle_tpu.ops.pallas.flash_attention import flash_attention_fwd
+
+        out = flash_attention_fwd(q, k, v, bias=mask, causal=False,
+                                  scale=1.0 / math.sqrt(hd))
+        out = out.reshape(b, s, nh * hd)
+        out = out @ lin_w
+        if lin_b is not None:
+            out = out + lin_b
+        out = residual + out
+        if not pre_layer_norm:
+            mu = jnp.mean(out, axis=-1, keepdims=True)
+            var = jnp.var(out, axis=-1, keepdims=True)
+            out = (out - mu) * jax.lax.rsqrt(var + ln_epsilon)
+            if lns is not None:
+                out = out * lns
+            if lnb is not None:
+                out = out + lnb
+        return out
+
+    args = [x, qkv_weight, linear_weight]
+    for t in (pre_ln_scale, pre_ln_bias, ln_scale, ln_bias, qkv_bias,
+              linear_bias, attn_mask):
+        if t is not None:
+            args.append(t)
+    return apply("fused_multi_head_attention", f, *args)
+
+
+def masked_multihead_attention(x, cache_kv=None, *args, **kwargs):
+    raise NotImplementedError(
+        "decode-time masked_multihead_attention lands with the serving path; "
+        "use scaled_dot_product_attention with explicit kv cache meanwhile"
+    )

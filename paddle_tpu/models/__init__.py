@@ -12,3 +12,12 @@ from paddle_tpu.models.gpt import (  # noqa: F401
     gpt_tiny,
     gpt3_1p3b,
 )
+from paddle_tpu.models.llama import (  # noqa: F401
+    LlamaConfig,
+    LlamaForCausalLM,
+    LlamaModel,
+    LlamaPretrainingCriterion,
+    llama_tiny,
+    llama2_7b,
+    llama2_13b,
+)

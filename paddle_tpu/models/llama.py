@@ -1,0 +1,201 @@
+"""LLaMA decoder LM — second flagship (the reference's auto-parallel test
+fixture semi_auto_llama.py / BASELINE.md #5 PaddleNLP LLaMA-2 pretrain).
+
+RMSNorm + RoPE + SwiGLU + grouped-query attention, TP-sharded via the fleet
+mp layers, flash attention through the Pallas kernel, optional sep-axis
+sequence sharding for long context (same scheme as models/gpt.py)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+from paddle_tpu.distributed.fleet.mp_layers import (
+    ColumnParallelLinear,
+    RowParallelLinear,
+    VocabParallelEmbedding,
+)
+from paddle_tpu.incubate.nn import functional as IF
+from paddle_tpu.models.gpt import GPTPretrainingCriterion, _seq_constrain
+from paddle_tpu.nn import initializer as I
+from paddle_tpu.nn.param_attr import ParamAttr
+from paddle_tpu.ops.pallas.flash_attention import scaled_dot_product_attention
+
+
+@dataclass
+class LlamaConfig:
+    vocab_size: int = 32000
+    hidden_size: int = 4096
+    intermediate_size: int = 11008
+    num_layers: int = 32
+    num_heads: int = 32
+    num_key_value_heads: int = 0  # 0 -> MHA (== num_heads)
+    max_position_embeddings: int = 4096
+    rms_norm_eps: float = 1e-6
+    rope_base: float = 10000.0
+    initializer_range: float = 0.02
+    tie_word_embeddings: bool = False
+    sequence_parallel: bool = False
+
+    def __post_init__(self):
+        if not self.num_key_value_heads:
+            self.num_key_value_heads = self.num_heads
+
+    # gpt._seq_constrain reads this field name
+    @property
+    def hidden_dropout(self):
+        return 0.0
+
+
+def llama_tiny(**kw) -> LlamaConfig:
+    cfg = dict(vocab_size=1024, hidden_size=128, intermediate_size=352,
+               num_layers=2, num_heads=4, num_key_value_heads=2,
+               max_position_embeddings=256)
+    cfg.update(kw)
+    return LlamaConfig(**cfg)
+
+
+def llama2_7b(**kw) -> LlamaConfig:
+    return LlamaConfig(**kw)
+
+
+def llama2_13b(**kw) -> LlamaConfig:
+    cfg = dict(hidden_size=5120, intermediate_size=13824, num_layers=40,
+               num_heads=40)
+    cfg.update(kw)
+    return LlamaConfig(**cfg)
+
+
+class LlamaRMSNorm(nn.Layer):
+    def __init__(self, hidden_size, epsilon=1e-6):
+        super().__init__()
+        self.weight = self.create_parameter(
+            shape=[hidden_size],
+            default_initializer=I.Constant(1.0),
+        )
+        self.epsilon = epsilon
+
+    def forward(self, x):
+        return IF.fused_rms_norm(x, norm_weight=self.weight,
+                                 epsilon=self.epsilon)
+
+
+class LlamaAttention(nn.Layer):
+    """GQA attention; q heads sharded over mp via column-parallel projection,
+    kv heads repeated up to q heads post-RoPE."""
+
+    def __init__(self, cfg: LlamaConfig):
+        super().__init__()
+        self.num_heads = cfg.num_heads
+        self.num_kv_heads = cfg.num_key_value_heads
+        self.head_dim = cfg.hidden_size // cfg.num_heads
+        self.rope_base = cfg.rope_base
+        q_size = cfg.num_heads * self.head_dim
+        kv_size = cfg.num_key_value_heads * self.head_dim
+        self.q_proj = ColumnParallelLinear(cfg.hidden_size, q_size,
+                                           has_bias=False, gather_output=False)
+        self.k_proj = ColumnParallelLinear(cfg.hidden_size, kv_size,
+                                           has_bias=False, gather_output=False)
+        self.v_proj = ColumnParallelLinear(cfg.hidden_size, kv_size,
+                                           has_bias=False, gather_output=False)
+        self.o_proj = RowParallelLinear(q_size, cfg.hidden_size, has_bias=False,
+                                        input_is_parallel=True)
+
+    def forward(self, hidden, position_ids=None):
+        b, s, _ = hidden.shape
+        q = paddle.reshape(self.q_proj(hidden), [b, s, self.num_heads,
+                                                 self.head_dim])
+        k = paddle.reshape(self.k_proj(hidden), [b, s, self.num_kv_heads,
+                                                 self.head_dim])
+        v = paddle.reshape(self.v_proj(hidden), [b, s, self.num_kv_heads,
+                                                 self.head_dim])
+        q, k, _ = IF.fused_rotary_position_embedding(
+            q, k, position_ids=position_ids, rotary_emb_base=self.rope_base)
+        if self.num_kv_heads != self.num_heads:
+            rep = self.num_heads // self.num_kv_heads
+            k = paddle.repeat_interleave(k, rep, axis=2)
+            v = paddle.repeat_interleave(v, rep, axis=2)
+        out = scaled_dot_product_attention(q, k, v, is_causal=True)
+        out = paddle.reshape(out, [b, s, self.num_heads * self.head_dim])
+        return self.o_proj(out)
+
+
+class LlamaMLP(nn.Layer):
+    def __init__(self, cfg: LlamaConfig):
+        super().__init__()
+        self.gate_proj = ColumnParallelLinear(
+            cfg.hidden_size, cfg.intermediate_size, has_bias=False,
+            gather_output=False)
+        self.up_proj = ColumnParallelLinear(
+            cfg.hidden_size, cfg.intermediate_size, has_bias=False,
+            gather_output=False)
+        self.down_proj = RowParallelLinear(
+            cfg.intermediate_size, cfg.hidden_size, has_bias=False,
+            input_is_parallel=True)
+
+    def forward(self, x):
+        return self.down_proj(IF.swiglu(self.gate_proj(x), self.up_proj(x)))
+
+
+class LlamaDecoderLayer(nn.Layer):
+    def __init__(self, cfg: LlamaConfig):
+        super().__init__()
+        self.input_layernorm = LlamaRMSNorm(cfg.hidden_size, cfg.rms_norm_eps)
+        self.self_attn = LlamaAttention(cfg)
+        self.post_attention_layernorm = LlamaRMSNorm(cfg.hidden_size,
+                                                     cfg.rms_norm_eps)
+        self.mlp = LlamaMLP(cfg)
+        self._cfg = cfg
+
+    def forward(self, x, position_ids=None):
+        x = x + self.self_attn(self.input_layernorm(x), position_ids)
+        x = x + self.mlp(self.post_attention_layernorm(x))
+        return _seq_constrain(x, self._cfg)
+
+
+class LlamaModel(nn.Layer):
+    def __init__(self, cfg: LlamaConfig):
+        super().__init__()
+        self.config = cfg
+        self.embed_tokens = VocabParallelEmbedding(
+            cfg.vocab_size, cfg.hidden_size,
+            weight_attr=ParamAttr(initializer=I.Normal(0.0, cfg.initializer_range)),
+        )
+        self.layers = nn.LayerList(
+            [LlamaDecoderLayer(cfg) for _ in range(cfg.num_layers)])
+        self.norm = LlamaRMSNorm(cfg.hidden_size, cfg.rms_norm_eps)
+
+    def forward(self, input_ids, position_ids=None):
+        if input_ids.shape[-1] > self.config.max_position_embeddings:
+            raise ValueError(
+                f"sequence length {input_ids.shape[-1]} exceeds "
+                f"max_position_embeddings {self.config.max_position_embeddings}")
+        h = _seq_constrain(self.embed_tokens(input_ids), self.config)
+        for layer in self.layers:
+            h = layer(h, position_ids)
+        return self.norm(h)
+
+
+class LlamaForCausalLM(nn.Layer):
+    def __init__(self, cfg: LlamaConfig):
+        super().__init__()
+        self.llama = LlamaModel(cfg)
+        self.config = cfg
+        if cfg.tie_word_embeddings:
+            self.lm_head = None
+        else:
+            self.lm_head = ColumnParallelLinear(
+                cfg.hidden_size, cfg.vocab_size, has_bias=False,
+                gather_output=False)
+
+    def forward(self, input_ids, position_ids=None):
+        h = self.llama(input_ids, position_ids)
+        if self.lm_head is None:
+            w = self.llama.embed_tokens.weight
+            return paddle.matmul(h, w, transpose_y=True)
+        return self.lm_head(h)
+
+
+LlamaPretrainingCriterion = GPTPretrainingCriterion
