@@ -69,6 +69,8 @@ from paddle_tpu import metric  # noqa: E402,F401
 from paddle_tpu import vision  # noqa: E402,F401
 from paddle_tpu import hapi  # noqa: E402,F401
 from paddle_tpu.hapi.model import Model  # noqa: E402,F401
+from paddle_tpu import profiler  # noqa: E402,F401
+from paddle_tpu.framework.flags import get_flags, set_flags  # noqa: E402,F401
 from paddle_tpu.framework.io import load, save  # noqa: E402,F401
 
 __version__ = "0.1.0"

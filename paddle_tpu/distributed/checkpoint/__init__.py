@@ -28,6 +28,38 @@ _METADATA_FILE = "0.metadata"
 _pending: list = []
 
 
+def _process_index() -> int:
+    import jax
+
+    return jax.process_index()
+
+
+def _metadata_paths(path: str):
+    """All metadata fragments in a checkpoint dir (one per writing process;
+    single-process checkpoints have just 0.metadata)."""
+    return sorted(
+        os.path.join(path, f) for f in os.listdir(path)
+        if f.endswith(".metadata")
+    )
+
+
+def _load_merged_metadata(path: str) -> Metadata:
+    md = Metadata()
+    paths = _metadata_paths(path)
+    if not paths:
+        raise FileNotFoundError(f"no *.metadata file in checkpoint {path}")
+    for p in paths:
+        with open(p) as f:
+            frag = Metadata.from_json(f.read())
+        for name, tm in frag.state_dict_metadata.items():
+            if name in md.state_dict_metadata:
+                md.state_dict_metadata[name].shards.extend(tm.shards)
+            else:
+                md.state_dict_metadata[name] = tm
+        md.flat_mapping.update(frag.flat_mapping)
+    return md
+
+
 def _value_of(v):
     return v._value if isinstance(v, Tensor) else v
 
@@ -50,6 +82,7 @@ def save_state_dict(state_dict: Dict, path: str, process_group=None,
     import jax
 
     os.makedirs(path, exist_ok=True)
+    pidx = _process_index()
     flat = _flatten(state_dict)
     md = Metadata()
     writes = []  # (file, np.ndarray)
@@ -58,9 +91,11 @@ def save_state_dict(state_dict: Dict, path: str, process_group=None,
         if arr is None:
             continue
         if not isinstance(arr, jax.Array):
+            if pidx != coordinator_rank:
+                continue  # host arrays are replicated; coordinator writes
             arr = np.asarray(arr)
             tm = TensorMetadata(list(arr.shape), str(arr.dtype))
-            fn = f"{name}.0.distcp"
+            fn = f"{name}.{pidx}.0.distcp"
             tm.shards.append(LocalTensorMetadata(
                 [0] * arr.ndim, list(arr.shape), str(arr.dtype), fn))
             writes.append((os.path.join(path, fn), arr))
@@ -68,8 +103,12 @@ def save_state_dict(state_dict: Dict, path: str, process_group=None,
             continue
         tm = TensorMetadata(list(arr.shape), str(arr.dtype))
         seen = set()
+        fully_replicated = arr.sharding.is_fully_replicated
+        if fully_replicated and pidx != coordinator_rank:
+            continue  # one copy is enough; coordinator owns it
         for shard in arr.addressable_shards:
-            # one file per distinct shard (replicas write once)
+            # one file per distinct shard on this process (replicas once);
+            # file names are process-qualified so hosts never collide
             idx = tuple(
                 (s.start or 0, s.stop if s.stop is not None else dim)
                 for s, dim in zip(shard.index, arr.shape)
@@ -79,17 +118,19 @@ def save_state_dict(state_dict: Dict, path: str, process_group=None,
             seen.add(idx)
             local = np.asarray(shard.data)
             offset = [s[0] for s in idx] if idx else [0] * arr.ndim
-            fn = f"{name}.{len(tm.shards)}.distcp"
+            fn = f"{name}.{pidx}.{len(tm.shards)}.distcp"
             tm.shards.append(LocalTensorMetadata(
                 offset, list(local.shape), str(arr.dtype), fn))
             writes.append((os.path.join(path, fn), local))
-        md.state_dict_metadata[name] = tm
+        if tm.shards:
+            md.state_dict_metadata[name] = tm
 
     def do_writes():
         for fn, arr in writes:
             np.save(fn + ".npy", arr, allow_pickle=False)
             os.replace(fn + ".npy", fn)
-        with open(os.path.join(path, _METADATA_FILE), "w") as f:
+        # one metadata fragment per process; load merges all fragments
+        with open(os.path.join(path, f"{pidx}.metadata"), "w") as f:
             f.write(md.to_json())
 
     if async_save:
@@ -131,7 +172,7 @@ def _read_region(path: str, tm: TensorMetadata, region) -> np.ndarray:
     r_stop = [s.stop for s in region]
     out = np.empty([b - a for a, b in zip(r_start, r_stop)],
                    dtype=_np_dtype(tm.dtype))
-    filled = np.zeros(out.shape, dtype=bool) if tm.shards else None
+    filled = np.zeros(out.shape, dtype=bool)
     for shard in tm.shards:
         s_start = shard.global_offset
         s_stop = [o + l for o, l in zip(s_start, shard.local_shape)]
@@ -143,9 +184,8 @@ def _read_region(path: str, tm: TensorMetadata, region) -> np.ndarray:
         src = tuple(slice(l - c, h - c) for l, h, c in zip(lo, hi, s_start))
         dst = tuple(slice(l - a, h - a) for l, h, a in zip(lo, hi, r_start))
         out[dst] = data[src]
-        if filled is not None:
-            filled[dst] = True
-    if filled is not None and not filled.all():
+        filled[dst] = True
+    if out.size and not filled.all():
         raise ValueError(
             f"checkpoint shards do not cover requested region {region}")
     return out
@@ -163,8 +203,7 @@ def load_state_dict(state_dict: Dict, path: str, process_group=None,
     read only the slices each device shard needs."""
     import jax
 
-    with open(os.path.join(path, _METADATA_FILE)) as f:
-        md = Metadata.from_json(f.read())
+    md = _load_merged_metadata(path)
     flat = _flatten(state_dict)
     for name, target in flat.items():
         tm = md.state_dict_metadata.get(name)
@@ -200,5 +239,4 @@ def load_state_dict(state_dict: Dict, path: str, process_group=None,
 
 
 def get_checkpoint_metadata(path: str) -> Metadata:
-    with open(os.path.join(path, _METADATA_FILE)) as f:
-        return Metadata.from_json(f.read())
+    return _load_merged_metadata(path)

@@ -1,0 +1,113 @@
+"""Launcher implementation (reference: launch/main.py:21 + controllers/)."""
+
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import subprocess
+import sys
+import time
+
+
+def _parse_args(argv=None):
+    p = argparse.ArgumentParser(
+        prog="python -m paddle_tpu.distributed.launch",
+        description="launch a (multi-process) training job",
+    )
+    p.add_argument("--nnodes", type=str, default="1",
+                   help="number of nodes (or range lo:hi for elastic)")
+    p.add_argument("--nproc_per_node", type=int, default=1,
+                   help="processes per node (CPU testing; on TPU keep 1 "
+                        "process per host and let jax own all local chips)")
+    p.add_argument("--master", type=str, default=None,
+                   help="coordinator endpoint ip:port (jax.distributed)")
+    p.add_argument("--rank", type=int,
+                   default=int(os.environ.get("PADDLE_NODE_RANK", 0)),
+                   help="node rank")
+    p.add_argument("--job_id", type=str, default="default")
+    p.add_argument("--log_dir", type=str, default=None)
+    p.add_argument("--devices", "--gpus", type=str, default=None,
+                   help="visible device ids")
+    p.add_argument("training_script", type=str)
+    p.add_argument("training_script_args", nargs=argparse.REMAINDER)
+    return p.parse_args(argv)
+
+
+def _child_env(args, local_rank: int, world_size: int, global_rank: int):
+    env = dict(os.environ)
+    env.update({
+        "PADDLE_TRAINER_ID": str(global_rank),
+        "PADDLE_TRAINERS_NUM": str(world_size),
+        "PADDLE_LOCAL_RANK": str(local_rank),
+        "PADDLE_NNODES": str(args.nnodes),
+        "PADDLE_JOB_ID": args.job_id,
+        "PADDLE_RANK_IN_NODE": str(local_rank),
+    })
+    if args.master:
+        env["PADDLE_MASTER"] = args.master
+        env["JAX_COORDINATOR_ADDRESS"] = args.master
+        env["JAX_PROCESS_ID"] = str(global_rank)
+        env["JAX_NUM_PROCESSES"] = str(world_size)
+    if args.nproc_per_node > 1:
+        # CPU multi-process testing: give each child its own device slice
+        env.setdefault("JAX_PLATFORMS", "cpu")
+    return env
+
+
+def launch(args=None):
+    args = args or _parse_args()
+    try:
+        nnodes = int(str(args.nnodes).split(":")[0])
+    except ValueError:
+        nnodes = 1
+    world = nnodes * args.nproc_per_node
+
+    if args.nproc_per_node == 1:
+        # single proc per host: exec in-place (the TPU path)
+        env = _child_env(args, 0, world, args.rank)
+        os.environ.update(env)
+        sys.argv = [args.training_script] + list(args.training_script_args)
+        with open(args.training_script) as f:
+            code = compile(f.read(), args.training_script, "exec")
+        globs = {"__name__": "__main__", "__file__": args.training_script}
+        exec(code, globs)
+        return 0
+
+    procs = []
+    log_dir = args.log_dir
+    if log_dir:
+        os.makedirs(log_dir, exist_ok=True)
+    for lr in range(args.nproc_per_node):
+        grank = args.rank * args.nproc_per_node + lr
+        env = _child_env(args, lr, world, grank)
+        stdout = (open(os.path.join(log_dir, f"worker.{grank}.log"), "w")
+                  if log_dir else None)
+        procs.append(subprocess.Popen(
+            [sys.executable, args.training_script] + args.training_script_args,
+            env=env, stdout=stdout,
+            stderr=subprocess.STDOUT if stdout else None,
+        ))
+
+    def _kill(*_):
+        for p in procs:
+            if p.poll() is None:
+                p.terminate()
+
+    signal.signal(signal.SIGTERM, _kill)
+    rc = 0
+    try:
+        for p in procs:
+            rc = p.wait() or rc
+    except KeyboardInterrupt:
+        _kill()
+        rc = 1
+    return rc
+
+
+def main():
+    sys.exit(launch(_parse_args()))
+
+
+if __name__ == "__main__":
+    main()

@@ -15,6 +15,7 @@ import jax
 import jax.numpy as jnp
 
 from paddle_tpu.core.dispatch import apply
+from paddle_tpu.framework import random as _rng
 from paddle_tpu.nn import functional as F
 from paddle_tpu.ops.pallas.flash_attention import scaled_dot_product_attention
 from paddle_tpu.tensor import Tensor
@@ -141,8 +142,14 @@ def fused_rotary_position_embedding(q, k=None, v=None, sin=None, cos=None,
         if sin_v is None:
             inv = 1.0 / (rotary_emb_base ** (
                 jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim))
-            t_ = jnp.arange(seq_len, dtype=jnp.float32)
-            freqs = jnp.outer(t_, inv)  # [S, D/2]
+            if pos is not None:
+                # compute angles from the given positions directly: exact for
+                # arbitrary offsets (incremental decode, packed sequences)
+                t_ = pos.astype(jnp.float32)  # [S] or [B, S]
+                freqs = t_[..., None] * inv  # [..., S, D/2]
+            else:
+                t_ = jnp.arange(seq_len, dtype=jnp.float32)
+                freqs = jnp.outer(t_, inv)  # [S, D/2]
             if use_neox_rotary_style:
                 emb = jnp.concatenate([freqs, freqs], axis=-1)
             else:
@@ -152,9 +159,14 @@ def fused_rotary_position_embedding(q, k=None, v=None, sin=None, cos=None,
         else:
             sin_v = jnp.reshape(sin_v, sin_v.shape[-2:])
             cos_v = jnp.reshape(cos_v, cos_v.shape[-2:])
-        if pos is not None:
-            sin_v = jnp.take(sin_v, pos, axis=0)  # [B?, S, D]
-            cos_v = jnp.take(cos_v, pos, axis=0)
+            if pos is not None:
+                sq = sin_v.shape[0]
+                oob = pos >= sq
+                sin_v = jnp.take(sin_v, pos, axis=0)  # [B?, S, D]
+                cos_v = jnp.take(cos_v, pos, axis=0)
+                # clamp-masking would be silent; zero out so misuse is visible
+                sin_v = jnp.where(oob[..., None], jnp.nan, sin_v)
+                cos_v = jnp.where(oob[..., None], jnp.nan, cos_v)
         # broadcast to [B, S, H, D]
         while sin_v.ndim < 4:
             sin_v = sin_v[None] if sin_v.ndim == 2 else sin_v[:, :, None, :]
@@ -225,6 +237,15 @@ def fused_multi_head_attention(x, qkv_weight, linear_weight, pre_layer_norm=Fals
     """FusedMultiHeadAttention functional path (fused_transformer.py:189).
     qkv_weight: [3, num_heads, head_dim, embed_dim] (paddle layout)."""
 
+    if cache_kv is not None:
+        raise NotImplementedError(
+            "cache_kv decode path lands with the serving stack; run the "
+            "prefill-style full-sequence call meanwhile")
+    if num_heads is not None and num_heads != qkv_weight.shape[1]:
+        raise ValueError(
+            f"num_heads={num_heads} does not match qkv_weight head dim "
+            f"{qkv_weight.shape[1]}")
+
     def f(xv, qkv_w, lin_w, *rest):
         it = iter(rest)
         pls = next(it) if pre_ln_scale is not None else None
@@ -257,10 +278,18 @@ def fused_multi_head_attention(x, qkv_weight, linear_weight, pre_layer_norm=Fals
 
         out = flash_attention_fwd(q, k, v, bias=mask, causal=False,
                                   scale=1.0 / math.sqrt(hd))
+        if attn_dropout_rate > 0.0 and training:
+            keep = jax.random.bernoulli(
+                _rng.next_key(), 1.0 - attn_dropout_rate, out.shape)
+            out = jnp.where(keep, out / (1.0 - attn_dropout_rate), 0.0)
         out = out.reshape(b, s, nh * hd)
         out = out @ lin_w
         if lin_b is not None:
             out = out + lin_b
+        if dropout_rate > 0.0 and training:
+            keep = jax.random.bernoulli(
+                _rng.next_key(), 1.0 - dropout_rate, out.shape)
+            out = jnp.where(keep, out / (1.0 - dropout_rate), 0.0)
         out = residual + out
         if not pre_layer_norm:
             mu = jnp.mean(out, axis=-1, keepdims=True)

@@ -57,6 +57,10 @@ class GPTConfig:
     tie_word_embeddings: bool = True
     # sequence-parallel: constrain seq dim of activations over the sep axis
     sequence_parallel: bool = False
+    # long-context: exact ring attention over the sep axis (KV blocks rotate
+    # on the ICI ring; O(S/N) memory per chip) instead of letting GSPMD
+    # all-gather the sharded KV
+    use_ring_attention: bool = False
 
     def __post_init__(self):
         if not self.intermediate_size:
@@ -77,6 +81,23 @@ def gpt3_1p3b(**kw) -> "GPTConfig":
                max_position_embeddings=2048)
     cfg.update(kw)
     return GPTConfig(**cfg)
+
+
+def _attention(q, k, v, cfg, dropout_p=0.0, training=True):
+    """Route to ring attention when configured and a sep>1 mesh is live."""
+    if getattr(cfg, "use_ring_attention", False):
+        hcg = topo.get_hybrid_communicate_group()
+        if hcg is not None and hcg.get_sep_parallel_world_size() > 1:
+            from paddle_tpu.ops.ring_attention import ring_flash_attention
+
+            out = ring_flash_attention(q, k, v, causal=True,
+                                       mesh=hcg.get_mesh())
+            if dropout_p > 0.0 and training:
+                # same output-dropout the flash path applies
+                out = F.dropout(out, p=dropout_p, training=True)
+            return out
+    return scaled_dot_product_attention(
+        q, k, v, is_causal=True, dropout_p=dropout_p, training=training)
 
 
 def _seq_constrain(x, cfg: GPTConfig):
@@ -136,10 +157,7 @@ class GPTAttention(nn.Layer):
         qkv = self.qkv_proj(hidden)  # [b, s, 3h] (mp-sharded last dim)
         qkv = paddle.reshape(qkv, [b, s, self.num_heads, 3 * self.head_dim])
         q, k, v = paddle.split(qkv, 3, axis=-1)  # [b, s, nh, hd] each
-        out = scaled_dot_product_attention(
-            q, k, v, is_causal=True, dropout_p=self.attn_dropout_p,
-            training=self.training,
-        )
+        out = _attention(q, k, v, self._cfg, self.attn_dropout_p, self.training)
         out = paddle.reshape(out, [b, s, h])
         return self.out_proj(out)
 

@@ -18,7 +18,11 @@ from paddle_tpu.distributed.fleet.mp_layers import (
     VocabParallelEmbedding,
 )
 from paddle_tpu.incubate.nn import functional as IF
-from paddle_tpu.models.gpt import GPTPretrainingCriterion, _seq_constrain
+from paddle_tpu.models.gpt import (
+    GPTPretrainingCriterion,
+    _attention,
+    _seq_constrain,
+)
 from paddle_tpu.nn import initializer as I
 from paddle_tpu.nn.param_attr import ParamAttr
 from paddle_tpu.ops.pallas.flash_attention import scaled_dot_product_attention
@@ -38,6 +42,7 @@ class LlamaConfig:
     initializer_range: float = 0.02
     tie_word_embeddings: bool = False
     sequence_parallel: bool = False
+    use_ring_attention: bool = False
 
     def __post_init__(self):
         if not self.num_key_value_heads:
@@ -68,18 +73,8 @@ def llama2_13b(**kw) -> LlamaConfig:
     return LlamaConfig(**cfg)
 
 
-class LlamaRMSNorm(nn.Layer):
-    def __init__(self, hidden_size, epsilon=1e-6):
-        super().__init__()
-        self.weight = self.create_parameter(
-            shape=[hidden_size],
-            default_initializer=I.Constant(1.0),
-        )
-        self.epsilon = epsilon
-
-    def forward(self, x):
-        return IF.fused_rms_norm(x, norm_weight=self.weight,
-                                 epsilon=self.epsilon)
+# nn.RMSNorm already implements the float32-upcast rsqrt normalization
+LlamaRMSNorm = nn.RMSNorm
 
 
 class LlamaAttention(nn.Layer):
@@ -102,6 +97,7 @@ class LlamaAttention(nn.Layer):
                                            has_bias=False, gather_output=False)
         self.o_proj = RowParallelLinear(q_size, cfg.hidden_size, has_bias=False,
                                         input_is_parallel=True)
+        self._cfg = cfg
 
     def forward(self, hidden, position_ids=None):
         b, s, _ = hidden.shape
@@ -117,7 +113,7 @@ class LlamaAttention(nn.Layer):
             rep = self.num_heads // self.num_kv_heads
             k = paddle.repeat_interleave(k, rep, axis=2)
             v = paddle.repeat_interleave(v, rep, axis=2)
-        out = scaled_dot_product_attention(q, k, v, is_causal=True)
+        out = _attention(q, k, v, self._cfg)
         out = paddle.reshape(out, [b, s, self.num_heads * self.head_dim])
         return self.o_proj(out)
 
