@@ -83,6 +83,27 @@ def save_state_dict(state_dict: Dict, path: str, process_group=None,
 
     os.makedirs(path, exist_ok=True)
     pidx = _process_index()
+    # clear this process's stale fragment + shard files from any prior save;
+    # the coordinator additionally clears fragments of processes beyond the
+    # current world (world shrank between saves)
+    own = {f"{pidx}.metadata"}
+    if pidx == coordinator_rank:
+        n_proc = jax.process_count()
+        for p in _metadata_paths(path):
+            frag_idx = os.path.basename(p).split(".")[0]
+            if frag_idx.isdigit() and int(frag_idx) >= n_proc:
+                own.add(os.path.basename(p))
+    for frag in own:
+        fp = os.path.join(path, frag)
+        if os.path.exists(fp):
+            with open(fp) as f:
+                old = Metadata.from_json(f.read())
+            for tm in old.state_dict_metadata.values():
+                for shard in tm.shards:
+                    sf = os.path.join(path, shard.file_name)
+                    if os.path.exists(sf):
+                        os.remove(sf)
+            os.remove(fp)
     flat = _flatten(state_dict)
     md = Metadata()
     writes = []  # (file, np.ndarray)

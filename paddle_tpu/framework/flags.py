@@ -12,14 +12,17 @@ from typing import Any, Dict, Iterable, Union
 
 
 class _FlagInfo:
-    __slots__ = ("name", "value", "default", "doc", "typ")
+    __slots__ = ("name", "value", "default", "doc", "typ", "on_set")
 
-    def __init__(self, name, default, doc):
+    def __init__(self, name, default, doc, on_set=None):
         self.name = name
         self.default = default
         self.doc = doc
         self.typ = type(default)
+        self.on_set = on_set
         self.value = self._from_env(default)
+        if on_set is not None and self.value != default:
+            on_set(self.value)
 
     def _from_env(self, default):
         raw = os.environ.get(self.name)
@@ -37,11 +40,11 @@ def _coerce(raw: str, typ):
 _REGISTRY: Dict[str, _FlagInfo] = {}
 
 
-def define_flag(name: str, default: Any, doc: str = "") -> None:
+def define_flag(name: str, default: Any, doc: str = "", on_set=None) -> None:
     if not name.startswith("FLAGS_"):
         name = "FLAGS_" + name
     if name not in _REGISTRY:
-        _REGISTRY[name] = _FlagInfo(name, default, doc)
+        _REGISTRY[name] = _FlagInfo(name, default, doc, on_set)
 
 
 def get_flags(flags: Union[str, Iterable[str]]) -> Dict[str, Any]:
@@ -65,6 +68,8 @@ def set_flags(flags: Dict[str, Any]) -> None:
             raise ValueError(f"unknown flag {f}")
         info = _REGISTRY[key]
         info.value = _coerce(v, info.typ) if isinstance(v, str) else info.typ(v)
+        if info.on_set is not None:
+            info.on_set(info.value)
 
 
 def flag_names():
@@ -73,10 +78,25 @@ def flag_names():
 
 # ---- core flags (the subset of the reference's exported flags that have
 # meaning on this substrate) ----
+
+def _set_check_nan_inf(v: bool):
+    from paddle_tpu.amp import debugging
+
+    debugging._state.check_nan_inf = bool(v)
+
+
+def _set_use_flash_attention(v: bool):
+    from paddle_tpu.ops.pallas import flash_attention as fa
+
+    fa._FLASH_ENABLED = bool(v)
+
+
 define_flag("FLAGS_check_nan_inf", False,
-            "check every op output for NaN/Inf (program_interpreter.cc:1131)")
+            "check every op output for NaN/Inf (program_interpreter.cc:1131)",
+            on_set=_set_check_nan_inf)
 define_flag("FLAGS_use_flash_attention", True,
-            "route attention through the Pallas flash kernel on TPU")
+            "route attention through the Pallas flash kernel on TPU",
+            on_set=_set_use_flash_attention)
 define_flag("FLAGS_embedding_deterministic", False,
             "deterministic embedding grad accumulation")
 define_flag("FLAGS_cudnn_deterministic", False,

@@ -13,6 +13,7 @@ from __future__ import annotations
 import itertools
 import queue
 import threading
+import time
 from typing import Callable, Optional
 
 import jax.numpy as jnp
@@ -56,10 +57,13 @@ class DataLoader:
                  batch_sampler=None, batch_size=1, shuffle=False, drop_last=False,
                  collate_fn=None, num_workers=0, use_buffer_reader=True,
                  prefetch_factor=2, use_shared_memory=True, timeout=0,
-                 worker_init_fn=None, persistent_workers=False):
+                 worker_init_fn=None, persistent_workers=False,
+                 use_process_workers=False):
         self.dataset = dataset
         self.collate_fn = collate_fn or default_collate_fn
         self.num_workers = num_workers
+        self.use_process_workers = use_process_workers
+        self.use_shared_memory = use_shared_memory
         self.prefetch_factor = max(prefetch_factor, 1)
         self.worker_init_fn = worker_init_fn
         self.timeout = timeout
@@ -91,6 +95,8 @@ class DataLoader:
     def __iter__(self):
         if self._iterable_mode:
             yield from self._iter_iterable()
+        elif self.num_workers > 0 and self.use_process_workers:
+            yield from self._iter_process()
         elif self.num_workers > 0:
             yield from self._iter_threaded()
         else:
@@ -190,3 +196,157 @@ class DataLoader:
                 pass
             for _ in threads:
                 task_q.put(None)
+
+    # ------------------------------------------------- process workers (shm)
+    def _iter_process(self):
+        """Multiprocess workers shipping batches through the native
+        shared-memory ring (src/shm_ring.cc — the mmap_allocator.cc
+        analogue). Workers run dataset code + numpy collation only (no jax);
+        the parent wraps arrays into Tensors. Falls back to threads when the
+        native library is unavailable."""
+        from paddle_tpu import native
+
+        if native.lib() is None or not self.use_shared_memory:
+            yield from self._iter_threaded()
+            return
+        if self.collate_fn is not default_collate_fn:
+            # custom collate may build Tensors (jax) — unsafe in forked
+            # workers; honor its semantics on the threaded path instead
+            import warnings
+
+            warnings.warn(
+                "DataLoader: custom collate_fn is incompatible with process "
+                "workers; falling back to threaded workers")
+            yield from self._iter_threaded()
+            return
+
+        import multiprocessing
+        import os
+        import pickle
+
+        L = native.lib()
+        batches = list(self.batch_sampler)
+        W = self.num_workers
+        ring_cap = 64 << 20  # 64 MB per worker
+        names = [f"/pt_dl_{os.getpid()}_{id(self)}_{w}" for w in range(W)]
+        rings = [L.shm_ring_open(n.encode(), ring_cap, 1) for n in names]
+        if any(not r for r in rings):
+            for r, n in zip(rings, names):
+                if r:
+                    L.shm_ring_close(r)
+            yield from self._iter_threaded()
+            return
+
+        ctx = multiprocessing.get_context("fork")
+
+        def worker_main(wid, my_batches):
+            # child: attach to the ring, fetch + collate to numpy, push
+            from paddle_tpu import native as _n
+
+            Lc = _n.lib()
+            ring = Lc.shm_ring_open(names[wid].encode(), ring_cap, 0)
+            if not ring:
+                os._exit(1)
+            try:
+                if self.worker_init_fn is not None:
+                    self.worker_init_fn(wid)
+                for idx, b in my_batches:
+                    samples = [self.dataset[i] for i in b]
+                    payload = pickle.dumps((idx, _np_collate(samples)),
+                                           protocol=pickle.HIGHEST_PROTOCOL)
+                    rc = Lc.shm_ring_push(ring, payload, len(payload))
+                    if rc == -2:
+                        raise RuntimeError(
+                            f"batch {idx} pickles to {len(payload)} bytes, "
+                            f"larger than the {ring_cap >> 20} MB shm ring; "
+                            "reduce batch_size or raise ring capacity")
+                    if rc != 0:
+                        break
+            except BaseException as e:  # ship the error to the parent
+                payload = pickle.dumps((-1, repr(e)))
+                Lc.shm_ring_push(ring, payload, len(payload))
+            finally:
+                Lc.shm_ring_mark_closed(ring)
+            os._exit(0)
+
+        assignments = [[] for _ in range(W)]
+        for i, b in enumerate(batches):
+            assignments[i % W].append((i, b))
+        procs = [ctx.Process(target=worker_main, args=(w, assignments[w]),
+                             daemon=True) for w in range(W)]
+        for p in procs:
+            p.start()
+
+        import ctypes
+
+        results: dict = {}
+        done_rings = set()
+        buf_cap = ring_cap
+        buf = (ctypes.c_char * buf_cap)()
+        try:
+            for want in range(len(batches)):
+                while want not in results:
+                    progressed = False
+                    for w in range(W):
+                        if w in done_rings:
+                            continue
+                        avail = L.shm_ring_try_peek(rings[w])
+                        if avail == -3:  # empty: is the worker still alive?
+                            if not procs[w].is_alive():
+                                done_rings.add(w)
+                            continue
+                        if avail < 0:
+                            done_rings.add(w)
+                            continue
+                        n = L.shm_ring_pop(rings[w], buf, buf_cap)
+                        if n < 0:
+                            done_rings.add(w)
+                            continue
+                        idx, data = pickle.loads(bytes(buf[:n]))
+                        if idx == -1:
+                            raise RuntimeError(f"DataLoader worker died: {data}")
+                        results[idx] = data
+                        progressed = True
+                    if not progressed:
+                        if len(done_rings) == W and want not in results:
+                            raise RuntimeError(
+                                "DataLoader workers exited before producing "
+                                "all batches (a worker may have been killed)")
+                        time.sleep(0.0005)  # rings empty: brief backoff
+                yield _wrap_np(results.pop(want))
+        finally:
+            for r in rings:
+                L.shm_ring_close(r)
+            for p in procs:
+                p.join(timeout=5)
+                if p.is_alive():
+                    p.terminate()
+
+
+def _np_collate(batch):
+    """Collate samples into nested numpy (no jax — safe in forked workers)."""
+    sample = batch[0]
+    if isinstance(sample, np.ndarray):
+        return np.stack(batch)
+    if isinstance(sample, (int, np.integer)):
+        return np.asarray(batch, np.int64)
+    if isinstance(sample, (float, np.floating)):
+        return np.asarray(batch, np.float32)
+    if isinstance(sample, (list, tuple)):
+        return [_np_collate(list(g)) for g in zip(*batch)]
+    if isinstance(sample, dict):
+        return {k: _np_collate([s[k] for s in batch]) for k in sample}
+    if isinstance(sample, (str, bytes)):
+        return list(batch)
+    raise TypeError(f"cannot collate type {type(sample)} in process workers")
+
+
+def _wrap_np(data):
+    """numpy tree -> Tensor tree (parent side)."""
+    if isinstance(data, np.ndarray):
+        return Tensor._from_value(jnp.asarray(data))
+    if isinstance(data, list):
+        return [_wrap_np(d) for d in data]
+    if isinstance(data, dict):
+        return {k: _wrap_np(v) for k, v in data.items()}
+    return data

@@ -90,12 +90,9 @@ def _attention(q, k, v, cfg, dropout_p=0.0, training=True):
         if hcg is not None and hcg.get_sep_parallel_world_size() > 1:
             from paddle_tpu.ops.ring_attention import ring_flash_attention
 
-            out = ring_flash_attention(q, k, v, causal=True,
-                                       mesh=hcg.get_mesh())
-            if dropout_p > 0.0 and training:
-                # same output-dropout the flash path applies
-                out = F.dropout(out, p=dropout_p, training=True)
-            return out
+            return ring_flash_attention(q, k, v, dropout=dropout_p,
+                                        causal=True, mesh=hcg.get_mesh(),
+                                        training=training)
     return scaled_dot_product_attention(
         q, k, v, is_causal=True, dropout_p=dropout_p, training=training)
 

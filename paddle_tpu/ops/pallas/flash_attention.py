@@ -23,7 +23,13 @@ from paddle_tpu.framework import random as rng
 from paddle_tpu.tensor import Tensor
 
 
+# toggled by FLAGS_use_flash_attention (framework/flags.py)
+_FLASH_ENABLED = True
+
+
 def _use_pallas(q_shape, head_dim) -> bool:
+    if not _FLASH_ENABLED:
+        return False
     try:
         dev = jax.devices()[0].platform
     except Exception:

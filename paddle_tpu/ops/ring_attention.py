@@ -118,10 +118,11 @@ def ring_attention(q, k, v, *, mesh: Mesh, axis: str = "sep",
 
 
 def ring_flash_attention(query, key, value, dropout=0.0, causal=True,
-                         mesh=None, axis="sep", name=None):
+                         mesh=None, axis="sep", training=True, name=None):
     """Tensor-level entry (paddle flash_attention-shaped signature)."""
     from paddle_tpu.core.dispatch import apply
     from paddle_tpu.distributed.fleet import topology as topo
+    from paddle_tpu.framework import random as rng
 
     if mesh is None:
         hcg = topo.get_hybrid_communicate_group()
@@ -132,6 +133,12 @@ def ring_flash_attention(query, key, value, dropout=0.0, causal=True,
         mesh = hcg.get_mesh()
 
     def f(qv, kv, vv):
-        return ring_attention(qv, kv, vv, mesh=mesh, axis=axis, causal=causal)
+        out = ring_attention(qv, kv, vv, mesh=mesh, axis=axis, causal=causal)
+        if dropout > 0.0 and training:
+            # output dropout, matching the flash path's approximation
+            keep = jax.random.bernoulli(rng.next_key(), 1.0 - dropout,
+                                        out.shape)
+            out = jnp.where(keep, out / (1.0 - dropout), 0.0).astype(out.dtype)
+        return out
 
     return apply("ring_flash_attention", f, query, key, value)

@@ -158,11 +158,15 @@ class Profiler:
 
     # ------------------------------------------------------------- lifecycle
     def start(self):
-        _recorder.enabled = not self._timer_only
         self._state = (self._scheduler(self.step_num)
                        if self._scheduler else ProfilerState.RECORD)
+        self._sync_recorder()
         self._maybe_start_device_trace()
         self._step_t0 = time.perf_counter()
+
+    def _sync_recorder(self):
+        _recorder.enabled = (not self._timer_only) and self._state in (
+            ProfilerState.RECORD, ProfilerState.RECORD_AND_RETURN)
 
     def _maybe_start_device_trace(self):
         if self._timer_only or self._device_tracing:
@@ -170,8 +174,10 @@ class Profiler:
         if self._state in (ProfilerState.RECORD, ProfilerState.RECORD_AND_RETURN):
             import jax
 
-            self._trace_dir = self._trace_dir or os.path.join(
-                os.getcwd(), "profiler_log")
+            import tempfile
+
+            self._trace_dir = self._trace_dir or tempfile.mkdtemp(
+                prefix="paddle_tpu_xplane_")
             try:
                 jax.profiler.start_trace(self._trace_dir)
                 self._device_tracing = True
@@ -201,6 +207,7 @@ class Profiler:
                         new_state == ProfilerState.CLOSED:
                     self._snapshot()
                 self._state = new_state
+                self._sync_recorder()
                 self._maybe_start_device_trace()
 
     def _snapshot(self):

@@ -1,0 +1,122 @@
+"""Native C++ components: TCPStore, shm ring, process DataLoader (reference
+patterns: TCPStore used in test_dist_base rendezvous; shared-memory transport
+in io/dataloader tests)."""
+
+import ctypes
+import os
+import threading
+
+import numpy as np
+import pytest
+
+import paddle_tpu.native as native
+from paddle_tpu.distributed.store import TCPStore
+
+
+requires_native = pytest.mark.skipif(
+    native.lib() is None, reason="no C++ toolchain")
+
+
+def test_tcpstore_set_get_add():
+    s = TCPStore("127.0.0.1", 0, is_master=True, world_size=1)
+    s.set("alpha", b"abc")
+    assert s.get("alpha") == b"abc"
+    assert s.add("n", 3) == 3
+    assert s.add("n", -1) == 2
+    assert s.check("alpha") is True
+    assert s.check("missing") is False
+    assert s.delete_key("alpha") is True
+    assert s.check("alpha") is False
+
+
+def test_tcpstore_two_clients_barrier():
+    master = TCPStore("127.0.0.1", 0, is_master=True, world_size=2)
+    port = master.port
+    errors = []
+
+    def rank1():
+        try:
+            c = TCPStore("127.0.0.1", port, is_master=False, world_size=2)
+            c.set("from1", b"hi")
+            c.barrier()
+        except Exception as e:  # pragma: no cover
+            errors.append(e)
+
+    t = threading.Thread(target=rank1)
+    t.start()
+    master.wait("from1")
+    assert master.get("from1") == b"hi"
+    master.barrier()
+    t.join(timeout=30)
+    assert not errors
+
+
+def test_tcpstore_blocking_get():
+    s = TCPStore("127.0.0.1", 0, is_master=True, world_size=1)
+    port = s.port
+    got = []
+
+    def reader():
+        c = TCPStore("127.0.0.1", port, is_master=False, world_size=1)
+        got.append(c.get("late"))  # blocks until set
+
+    t = threading.Thread(target=reader)
+    t.start()
+    import time
+
+    time.sleep(0.1)
+    s.set("late", b"now")
+    t.join(timeout=30)
+    assert got == [b"now"]
+
+
+@requires_native
+def test_shm_ring_roundtrip():
+    L = native.lib()
+    name = f"/pt_test_ring_{os.getpid()}".encode()
+    ring = L.shm_ring_open(name, 1 << 16, 1)
+    assert ring
+    try:
+        payloads = [os.urandom(n) for n in (1, 100, 5000)]
+        for p in payloads:
+            assert L.shm_ring_push(ring, p, len(p)) == 0
+        buf = (ctypes.c_char * (1 << 16))()
+        for p in payloads:
+            n = L.shm_ring_pop(ring, buf, 1 << 16)
+            assert n == len(p)
+            assert bytes(buf[:n]) == p
+    finally:
+        L.shm_ring_close(ring)
+
+
+@requires_native
+def test_shm_ring_wraparound():
+    L = native.lib()
+    name = f"/pt_test_wrap_{os.getpid()}".encode()
+    cap = 256
+    ring = L.shm_ring_open(name, cap, 1)
+    buf = (ctypes.c_char * cap)()
+    try:
+        # push/pop enough to wrap several times
+        for i in range(50):
+            p = bytes([i % 256]) * (40 + i % 17)
+            assert L.shm_ring_push(ring, p, len(p)) == 0
+            n = L.shm_ring_pop(ring, buf, cap)
+            assert bytes(buf[:n]) == p
+    finally:
+        L.shm_ring_close(ring)
+
+
+@requires_native
+def test_process_dataloader_matches_sync():
+    from paddle_tpu.io import DataLoader
+    from paddle_tpu.vision.datasets import FakeData
+
+    ds = FakeData(num_samples=24, image_shape=(1, 6, 6), num_classes=3)
+    proc = list(DataLoader(ds, batch_size=6, num_workers=2,
+                           use_process_workers=True))
+    sync = list(DataLoader(ds, batch_size=6, num_workers=0))
+    assert len(proc) == len(sync) == 4
+    for (xa, ya), (xb, yb) in zip(proc, sync):
+        np.testing.assert_allclose(xa.numpy(), xb.numpy())
+        np.testing.assert_array_equal(ya.numpy(), yb.numpy())
