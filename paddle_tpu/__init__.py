@@ -70,6 +70,13 @@ from paddle_tpu import vision  # noqa: E402,F401
 from paddle_tpu import hapi  # noqa: E402,F401
 from paddle_tpu.hapi.model import Model  # noqa: E402,F401
 from paddle_tpu import profiler  # noqa: E402,F401
+from paddle_tpu import fft  # noqa: E402,F401
+from paddle_tpu import distribution  # noqa: E402,F401
+from paddle_tpu import sparse  # noqa: E402,F401
+from paddle_tpu import quantization  # noqa: E402,F401
+from paddle_tpu import static  # noqa: E402,F401
+from paddle_tpu import hub  # noqa: E402,F401
+from paddle_tpu import onnx  # noqa: E402,F401
 from paddle_tpu.framework.flags import get_flags, set_flags  # noqa: E402,F401
 from paddle_tpu.framework.io import load, save  # noqa: E402,F401
 

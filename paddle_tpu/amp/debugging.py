@@ -14,7 +14,10 @@ import threading
 import jax.numpy as jnp
 
 
-class _DebugState(threading.local):
+class _DebugState:
+    """Process-global (the reference's FLAGS_check_nan_inf is a process-wide
+    flag, not per-thread)."""
+
     def __init__(self):
         self.check_nan_inf = False
 

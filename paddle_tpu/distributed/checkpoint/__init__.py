@@ -81,6 +81,7 @@ def save_state_dict(state_dict: Dict, path: str, process_group=None,
     """Write sharded checkpoint at ``path`` (a directory)."""
     import jax
 
+    wait_async_save()  # never race an in-flight async writer's files
     os.makedirs(path, exist_ok=True)
     pidx = _process_index()
     # clear this process's stale fragment + shard files from any prior save;

@@ -46,16 +46,33 @@ class TCPStore:
 
     # ------------------------------------------------------------ transport
     def _connect(self):
-        if self._lib is not None:
-            self._fd = self._lib.tcpstore_connect(
-                self.host.encode(), self.port, self._timeout_ms)
-            if self._fd < 0:
-                raise ConnectionError(
-                    f"TCPStore: cannot connect {self.host}:{self.port}")
-        else:
-            self._sock = socket.create_connection((self.host, self.port),
-                                                  timeout=self._timeout_ms / 1000)
-            self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        """Retry until the master binds (reference TCPStore semantics: the
+        whole timeout budget applies to establishment, not one attempt)."""
+        import time
+
+        deadline = time.monotonic() + self._timeout_ms / 1000
+        last_err = None
+        while time.monotonic() < deadline:
+            try:
+                if self._lib is not None:
+                    fd = self._lib.tcpstore_connect(
+                        self.host.encode(), self.port, self._timeout_ms)
+                    if fd >= 0:
+                        self._fd = fd
+                        return
+                    last_err = ConnectionError("connect failed")
+                else:
+                    self._sock = socket.create_connection(
+                        (self.host, self.port), timeout=5)
+                    self._sock.settimeout(self._timeout_ms / 1000)
+                    self._sock.setsockopt(socket.IPPROTO_TCP,
+                                          socket.TCP_NODELAY, 1)
+                    return
+            except OSError as e:
+                last_err = e
+            time.sleep(0.25)
+        raise ConnectionError(
+            f"TCPStore: cannot connect {self.host}:{self.port}: {last_err}")
 
     # --------------------------------------------------------------- client
     def set(self, key: str, value) -> None:
@@ -72,13 +89,14 @@ class TCPStore:
             import ctypes
 
             cap = 1 << 20
-            buf = (ctypes.c_char * cap)()
-            n = self._lib.tcpstore_get(self._fd, key.encode(), buf, cap)
-            if n < 0:
-                raise RuntimeError("TCPStore.get failed")
-            if n > cap:
-                raise RuntimeError(f"TCPStore value too large ({n} bytes)")
-            return bytes(buf[: n])
+            while True:
+                buf = (ctypes.c_char * cap)()
+                n = self._lib.tcpstore_get(self._fd, key.encode(), buf, cap)
+                if n < 0:
+                    raise RuntimeError("TCPStore.get failed")
+                if n <= cap:
+                    return bytes(buf[: n])
+                cap = int(n)  # value larger than buffer: re-issue full-size
         return self._py_op(2, key)
 
     def add(self, key: str, amount: int = 1) -> int:
@@ -111,10 +129,13 @@ class TCPStore:
         return self._py_op(6, key) == b"\x01"
 
     def barrier(self, tag: str = "barrier") -> None:
+        """Reusable barrier: each call belongs to round (n-1)//world_size of
+        its tag, signalled by a per-round done key."""
         n = self.add(f"{tag}/count", 1)
-        if n == self.world_size:
-            self.set(f"{tag}/done", b"1")
-        self.wait(f"{tag}/done")
+        rnd = (n - 1) // self.world_size
+        if n == (rnd + 1) * self.world_size:
+            self.set(f"{tag}/done/{rnd}", b"1")
+        self.wait(f"{tag}/done/{rnd}")
 
     def __del__(self):
         try:

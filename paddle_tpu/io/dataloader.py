@@ -206,6 +206,9 @@ class DataLoader:
         native library is unavailable."""
         from paddle_tpu import native
 
+        if self.batch_sampler is None:  # batch_size=None: per-sample mode
+            yield from self._iter_sync()
+            return
         if native.lib() is None or not self.use_shared_memory:
             yield from self._iter_threaded()
             return
@@ -293,8 +296,15 @@ class DataLoader:
                         avail = L.shm_ring_try_peek(rings[w])
                         if avail == -3:  # empty: is the worker still alive?
                             if not procs[w].is_alive():
-                                done_rings.add(w)
-                            continue
+                                # worker pushes before exiting — re-peek so a
+                                # record landed between peek and is_alive()
+                                # isn't dropped
+                                avail = L.shm_ring_try_peek(rings[w])
+                                if avail < 0:
+                                    done_rings.add(w)
+                                    continue
+                            else:
+                                continue
                         if avail < 0:
                             done_rings.add(w)
                             continue

@@ -40,6 +40,11 @@ struct Store {
   int listen_fd = -1;
   std::thread accept_thread;
   bool stopping = false;
+  // connection bookkeeping so stop() can wake + join every handler before
+  // the Store is freed (no use-after-free on shutdown)
+  std::mutex conn_mu;
+  std::vector<std::thread> conn_threads;
+  std::vector<int> conn_fds;
 };
 
 bool read_all(int fd, void* buf, size_t n) {
@@ -144,7 +149,8 @@ void serve_conn(Store* s, int fd) {
       break;
     }
   }
-  ::close(fd);
+  // fd is closed by tcpstore_server_stop (closing here could race stop()'s
+  // shutdown() against a reused descriptor number)
 }
 
 }  // namespace
@@ -173,7 +179,13 @@ void* tcpstore_server_start(int port) {
     for (;;) {
       int cfd = ::accept(s->listen_fd, nullptr, nullptr);
       if (cfd < 0) break;  // listen socket closed -> shutdown
-      std::thread(serve_conn, s, cfd).detach();
+      std::lock_guard<std::mutex> g(s->conn_mu);
+      if (s->stopping) {
+        ::close(cfd);
+        break;
+      }
+      s->conn_fds.push_back(cfd);
+      s->conn_threads.emplace_back(serve_conn, s, cfd);
     }
   });
   return s;
@@ -198,6 +210,15 @@ void tcpstore_server_stop(void* handle) {
   ::shutdown(s->listen_fd, SHUT_RDWR);
   ::close(s->listen_fd);
   if (s->accept_thread.joinable()) s->accept_thread.join();
+  {
+    // wake handlers blocked in read() and join them all before freeing
+    std::lock_guard<std::mutex> g(s->conn_mu);
+    s->stopping = true;
+    for (int fd : s->conn_fds) ::shutdown(fd, SHUT_RDWR);
+  }
+  for (auto& t : s->conn_threads)
+    if (t.joinable()) t.join();
+  for (int fd : s->conn_fds) ::close(fd);
   delete s;
 }
 

@@ -1,0 +1,196 @@
+"""paddle.quantization parity (reference: python/paddle/quantization/ —
+QuantConfig config.py, QAT qat.py, PTQ ptq.py, observers in observer/,
+fake quanters in quanters/).
+
+TPU-native: fake-quant simulates int8 on the fly inside the XLA program
+(quant-dequant folds into the surrounding matmul epilogues); the
+straight-through estimator keeps training differentiable — the same
+simulated-quantization scheme the reference's QAT pass inserts."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import paddle_tpu.nn as pnn
+from paddle_tpu.autograd.py_layer import PyLayer
+from paddle_tpu.core.dispatch import apply
+from paddle_tpu.tensor import Tensor
+
+
+def quantize_linear(x, scale, zero_point=0.0, bit_length=8, axis=None):
+    qmax = 2 ** (bit_length - 1) - 1
+    qmin = -(2 ** (bit_length - 1))
+
+    def f(v, s):
+        q = jnp.round(v / s + zero_point)
+        return jnp.clip(q, qmin, qmax)
+
+    return apply("quantize_linear", f, x, scale)
+
+
+def dequantize_linear(x, scale, zero_point=0.0, bit_length=8, axis=None):
+    return apply("dequantize_linear", lambda q, s: (q - zero_point) * s,
+                 x, scale)
+
+
+class _FakeQuantSTE(PyLayer):
+    """Fake quant with straight-through gradient."""
+
+    @staticmethod
+    def forward(ctx, x, scale, bit_length=8):
+        qmax = 2 ** (bit_length - 1) - 1
+        qmin = -(2 ** (bit_length - 1))
+        import paddle_tpu as paddle
+
+        q = paddle.clip(paddle.round(x / scale), float(qmin), float(qmax))
+        return q * scale
+
+    @staticmethod
+    def backward(ctx, dy):
+        return dy, None
+
+
+class BaseObserver(pnn.Layer):
+    def __init__(self, quant_bits=8):
+        super().__init__()
+        self.quant_bits = quant_bits
+        self._scale = None
+
+    def scales(self):
+        return self._scale
+
+    def bit_length(self):
+        return self.quant_bits
+
+
+class AbsmaxObserver(BaseObserver):
+    """observer/abs_max.py parity: running abs-max calibration."""
+
+    def __init__(self, quant_bits=8):
+        super().__init__(quant_bits)
+        self._absmax = 0.0
+
+    def forward(self, x):
+        cur = float(np.abs(np.asarray(x.numpy())).max()) if x.numel() else 0.0
+        self._absmax = max(self._absmax, cur)
+        self._scale = self._absmax / (2 ** (self.quant_bits - 1) - 1) or 1e-8
+        return x
+
+
+class FakeQuanterWithAbsMaxObserver(pnn.Layer):
+    """quanters/abs_max.py parity: QAT fake-quant node with EMA abs-max."""
+
+    def __init__(self, moving_rate=0.9, quant_bits=8, **kwargs):
+        super().__init__()
+        self.moving_rate = moving_rate
+        self.quant_bits = quant_bits
+        self._ema = None
+
+    def forward(self, x):
+        cur = float(np.abs(np.asarray(x.detach().numpy())).max() or 1e-8)
+        self._ema = cur if self._ema is None else \
+            self.moving_rate * self._ema + (1 - self.moving_rate) * cur
+        scale = self._ema / (2 ** (self.quant_bits - 1) - 1)
+        import paddle_tpu as paddle
+
+        return _FakeQuantSTE.apply(x, paddle.to_tensor(np.float32(scale)),
+                                   self.quant_bits)
+
+
+class QuantConfig:
+    """config.py parity: maps layers -> quanter factories."""
+
+    def __init__(self, activation=None, weight=None):
+        self.activation = activation
+        self.weight = weight
+        self._type_configs = {}
+
+    def add_type_config(self, layer_type, activation=None, weight=None):
+        if not isinstance(layer_type, (list, tuple)):
+            layer_type = [layer_type]
+        for lt in layer_type:
+            self._type_configs[lt] = (activation or self.activation,
+                                      weight or self.weight)
+
+    def _config_for(self, layer):
+        for lt, cfg in self._type_configs.items():
+            if isinstance(layer, lt):
+                return cfg
+        if self.activation or self.weight:
+            if isinstance(layer, (pnn.Linear, pnn.Conv2D)):
+                return (self.activation, self.weight)
+        return None
+
+
+class QuantedLayer(pnn.Layer):
+    """Wrapper inserting activation/weight fake-quant around a layer."""
+
+    def __init__(self, layer, act_quanter, weight_quanter):
+        super().__init__()
+        self.inner = layer
+        self.act_quanter = act_quanter
+        self.weight_quanter = weight_quanter
+
+    def forward(self, x):
+        if self.act_quanter is not None:
+            x = self.act_quanter(x)
+        if self.weight_quanter is not None and hasattr(self.inner, "weight"):
+            w = self.inner.weight
+            qw = self.weight_quanter(w)
+            orig = w._value
+            w._replace_value(qw._value, getattr(qw, "_node", None))
+            try:
+                return self.inner(x)
+            finally:
+                w._replace_value(orig)
+        return self.inner(x)
+
+
+def _apply_config(model, config: QuantConfig, factory):
+    for name, child in list(model._sub_layers.items()):
+        cfg = config._config_for(child)
+        if cfg is not None:
+            act_f, w_f = cfg
+            model._sub_layers[name] = QuantedLayer(
+                child, factory(act_f), factory(w_f))
+        else:
+            _apply_config(child, config, factory)
+    return model
+
+
+class QAT:
+    """qat.py parity: insert trainable fake-quant nodes."""
+
+    def __init__(self, config: QuantConfig):
+        self.config = config
+
+    def quantize(self, model, inplace=False):
+        def factory(f):
+            if f is None:
+                return None
+            return f() if callable(f) else f
+
+        return _apply_config(model, self.config, factory)
+
+
+class PTQ:
+    """ptq.py parity: insert observers; calibrate with representative data,
+    then convert()."""
+
+    def __init__(self, config: QuantConfig):
+        self.config = config
+
+    def quantize(self, model, inplace=False):
+        def factory(f):
+            if f is None:
+                return None
+            return f() if callable(f) else f
+
+        return _apply_config(model, self.config, factory)
+
+    def convert(self, model, inplace=False):
+        return model

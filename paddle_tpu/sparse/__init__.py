@@ -1,0 +1,165 @@
+"""paddle.sparse parity (reference: phi SparseCooTensor/SparseCsrTensor
+paddle/phi/core/sparse_coo_tensor.h + python/paddle/sparse/).
+
+TPU-native: COO tensors ride jax.experimental.sparse.BCOO (XLA-lowered
+gather/scatter kernels); CSR is kept as an index-format view that converts
+through COO — TPUs have no sparse MMA, so (as with the reference's
+non-cuSPARSE fallbacks) compute happens via BCOO matmul/elementwise
+lowerings."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import sparse as jsparse
+
+from paddle_tpu.core.dispatch import apply
+from paddle_tpu.tensor import Tensor
+
+
+class SparseCooTensor(Tensor):
+    """Tensor whose _value is a BCOO array (dense ops must densify first)."""
+
+    def __init__(self, bcoo):
+        self._value = bcoo
+        self.stop_gradient = True
+        self._node = None
+        self._grad = None
+        self.name = ""
+        self.persistable = False
+
+    @classmethod
+    def _from_bcoo(cls, bcoo):
+        return cls(bcoo)
+
+    @property
+    def shape(self):
+        return list(self._value.shape)
+
+    def is_sparse(self):
+        return True
+
+    def is_sparse_coo(self):
+        return True
+
+    def indices(self):
+        return Tensor._from_value(jnp.swapaxes(self._value.indices, 0, 1))
+
+    def values(self):
+        return Tensor._from_value(self._value.data)
+
+    def nnz(self):
+        return int(self._value.nse)
+
+    def to_dense(self):
+        return Tensor._from_value(self._value.todense())
+
+    def numpy(self):
+        return np.asarray(self._value.todense())
+
+    def __repr__(self):
+        return (f"SparseCooTensor(shape={self.shape}, nnz={self.nnz()}, "
+                f"dtype={self._value.dtype})")
+
+
+def sparse_coo_tensor(indices, values, shape=None, dtype=None, place=None,
+                      stop_gradient=True):
+    """paddle.sparse.sparse_coo_tensor: indices [ndim, nnz], values [nnz]."""
+    idx = indices._value if isinstance(indices, Tensor) else jnp.asarray(indices)
+    val = values._value if isinstance(values, Tensor) else jnp.asarray(values)
+    if dtype is not None:
+        from paddle_tpu.framework.dtype import convert_dtype
+
+        val = val.astype(convert_dtype(dtype))
+    idx = jnp.swapaxes(idx.astype(jnp.int32), 0, 1)  # BCOO wants [nnz, ndim]
+    if shape is None:
+        shape = tuple(int(i) + 1 for i in jnp.max(idx, axis=0))
+    bcoo = jsparse.BCOO((val, idx), shape=tuple(shape))
+    return SparseCooTensor._from_bcoo(bcoo)
+
+
+def sparse_csr_tensor(crows, cols, values, shape, dtype=None, place=None,
+                      stop_gradient=True):
+    """CSR constructor; stored as COO internally (no sparse MMA on TPU)."""
+    crows_np = np.asarray(crows._value if isinstance(crows, Tensor) else crows)
+    cols_np = np.asarray(cols._value if isinstance(cols, Tensor) else cols)
+    rows = np.repeat(np.arange(len(crows_np) - 1), np.diff(crows_np))
+    indices = np.stack([rows, cols_np])
+    return sparse_coo_tensor(indices, values, shape, dtype)
+
+
+def is_sparse(x):
+    return isinstance(x, SparseCooTensor)
+
+
+def to_dense(x):
+    return x.to_dense() if is_sparse(x) else x
+
+
+def to_sparse_coo(x, sparse_dim=None):
+    bcoo = jsparse.BCOO.fromdense(x._value)
+    return SparseCooTensor._from_bcoo(bcoo)
+
+
+def _binary(name, fn):
+    def op(x, y, name_arg=None):
+        if is_sparse(x) and is_sparse(y):
+            out = fn(x._value.todense(), y._value.todense())
+            return SparseCooTensor._from_bcoo(jsparse.BCOO.fromdense(out))
+        xa = x._value.todense() if is_sparse(x) else x._value
+        ya = y._value.todense() if is_sparse(y) else y._value
+        return Tensor._from_value(fn(xa, ya))
+
+    op.__name__ = name
+    return op
+
+
+add = _binary("sparse_add", jnp.add)
+subtract = _binary("sparse_subtract", jnp.subtract)
+multiply = _binary("sparse_multiply", jnp.multiply)
+divide = _binary("sparse_divide", jnp.divide)
+
+
+def matmul(x, y, name=None):
+    """sparse @ dense via BCOO dot_general (XLA gather-based lowering)."""
+    if is_sparse(x):
+        yv = y._value.todense() if is_sparse(y) else y._value
+        out = x._value @ yv
+        return Tensor._from_value(out)
+    if is_sparse(y):
+        return Tensor._from_value(x._value @ y._value.todense())
+    return Tensor._from_value(x._value @ y._value)
+
+
+def _unary_on_values(name, fn):
+    def op(x, name_arg=None):
+        if is_sparse(x):
+            b = x._value
+            return SparseCooTensor._from_bcoo(
+                jsparse.BCOO((fn(b.data), b.indices), shape=b.shape))
+        return Tensor._from_value(fn(x._value))
+
+    op.__name__ = name
+    return op
+
+
+relu = _unary_on_values("sparse_relu", jax.nn.relu)
+sin = _unary_on_values("sparse_sin", jnp.sin)
+tanh = _unary_on_values("sparse_tanh", jnp.tanh)
+sqrt = _unary_on_values("sparse_sqrt", jnp.sqrt)
+abs = _unary_on_values("sparse_abs", jnp.abs)  # noqa: A001
+neg = _unary_on_values("sparse_neg", jnp.negative)
+
+
+def pow(x, factor, name=None):  # noqa: A001
+    if is_sparse(x):
+        b = x._value
+        return SparseCooTensor._from_bcoo(
+            jsparse.BCOO((jnp.power(b.data, factor), b.indices), shape=b.shape))
+    return Tensor._from_value(jnp.power(x._value, factor))
+
+
+class nn:  # namespace shim: paddle.sparse.nn.functional.relu etc.
+    class functional:
+        relu = staticmethod(relu)

@@ -1,0 +1,149 @@
+"""fft / distribution / sparse / quantization / static (reference patterns:
+test/legacy_test/test_fft.py, test/distribution/, test_sparse_*.py,
+test/quantization/)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+
+
+def test_fft_roundtrip(rng):
+    x = rng.standard_normal(16).astype(np.float32)
+    back = paddle.fft.ifft(paddle.fft.fft(paddle.to_tensor(x)))
+    np.testing.assert_allclose(back.numpy().real, x, atol=1e-5)
+
+
+def test_rfft_matches_numpy(rng):
+    x = rng.standard_normal((4, 16)).astype(np.float32)
+    out = paddle.fft.rfft(paddle.to_tensor(x))
+    np.testing.assert_allclose(out.numpy(), np.fft.rfft(x), rtol=1e-4,
+                               atol=1e-4)
+
+
+def test_fft2_and_shift(rng):
+    x = rng.standard_normal((8, 8)).astype(np.float32)
+    out = paddle.fft.fftshift(paddle.fft.fft2(paddle.to_tensor(x)))
+    ref = np.fft.fftshift(np.fft.fft2(x))
+    np.testing.assert_allclose(out.numpy(), ref, rtol=1e-4, atol=1e-4)
+
+
+def test_normal_distribution_moments():
+    paddle.seed(0)
+    d = paddle.distribution.Normal(2.0, 3.0)
+    s = d.sample((20000,)).numpy()
+    assert abs(s.mean() - 2.0) < 0.1
+    assert abs(s.std() - 3.0) < 0.1
+    # analytic entropy
+    ent = float(d.entropy().numpy())
+    assert abs(ent - (0.5 + 0.5 * np.log(2 * np.pi) + np.log(3.0))) < 1e-5
+
+
+def test_normal_kl_closed_form():
+    p = paddle.distribution.Normal(0.0, 1.0)
+    q = paddle.distribution.Normal(1.0, 2.0)
+    kl = float(paddle.distribution.kl_divergence(p, q).numpy())
+    expected = np.log(2.0) + (1 + 1) / (2 * 4) - 0.5
+    assert abs(kl - expected) < 1e-5
+
+
+def test_categorical_log_prob():
+    d = paddle.distribution.Categorical(
+        logits=paddle.to_tensor(np.log(np.array([0.2, 0.3, 0.5], np.float32))))
+    lp = d.log_prob(paddle.to_tensor(np.array([2], np.int64)))
+    np.testing.assert_allclose(lp.numpy(), [np.log(0.5)], rtol=1e-5)
+
+
+def test_beta_kl_vs_sampling():
+    p = paddle.distribution.Beta(2.0, 3.0)
+    q = paddle.distribution.Beta(3.0, 2.0)
+    kl = float(paddle.distribution.kl_divergence(p, q).numpy())
+    assert kl > 0
+    kl_self = float(paddle.distribution.kl_divergence(p, p).numpy())
+    assert abs(kl_self) < 1e-6
+
+
+def test_distribution_log_prob_grad():
+    mu = paddle.to_tensor(np.float32(0.5), stop_gradient=False)
+    d = paddle.distribution.Normal(mu, 1.0)
+    lp = d.log_prob(paddle.to_tensor(np.float32(2.0)))
+    lp.backward()
+    # d/dmu log N(2; mu, 1) = (2 - mu) = 1.5
+    np.testing.assert_allclose(mu.grad.numpy(), 1.5, rtol=1e-5)
+
+
+def test_sparse_coo_roundtrip():
+    st = paddle.sparse.sparse_coo_tensor(
+        [[0, 0, 2], [0, 3, 1]], [1.0, 2.0, 3.0], shape=[3, 4])
+    assert st.nnz() == 3
+    dense = st.to_dense().numpy()
+    assert dense[0, 0] == 1.0 and dense[0, 3] == 2.0 and dense[2, 1] == 3.0
+    back = paddle.sparse.to_sparse_coo(paddle.to_tensor(dense))
+    np.testing.assert_allclose(back.to_dense().numpy(), dense)
+
+
+def test_sparse_csr_and_matmul(rng):
+    dense = np.array([[1, 0, 2], [0, 0, 3]], np.float32)
+    st = paddle.sparse.sparse_csr_tensor(
+        [0, 2, 3], [0, 2, 2], [1.0, 2.0, 3.0], shape=[2, 3])
+    np.testing.assert_allclose(st.to_dense().numpy(), dense)
+    y = rng.standard_normal((3, 5)).astype(np.float32)
+    out = paddle.sparse.matmul(st, paddle.to_tensor(y))
+    np.testing.assert_allclose(out.numpy(), dense @ y, rtol=1e-5, atol=1e-5)
+
+
+def test_sparse_unary_keeps_structure():
+    st = paddle.sparse.sparse_coo_tensor([[0], [1]], [-2.0], shape=[2, 2])
+    r = paddle.sparse.relu(st)
+    assert r.nnz() == 1
+    assert r.to_dense().numpy()[0, 1] == 0.0
+
+
+def test_qat_fake_quant_trains():
+    from paddle_tpu.quantization import (
+        FakeQuanterWithAbsMaxObserver,
+        QAT,
+        QuantConfig,
+    )
+
+    cfg = QuantConfig(activation=FakeQuanterWithAbsMaxObserver,
+                      weight=FakeQuanterWithAbsMaxObserver)
+    model = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 2))
+    qmodel = QAT(cfg).quantize(model)
+    opt = paddle.optimizer.Adam(learning_rate=1e-2,
+                                parameters=qmodel.parameters())
+    rng = np.random.default_rng(0)
+    x = paddle.to_tensor(rng.standard_normal((16, 8)).astype(np.float32))
+    y = paddle.to_tensor(rng.integers(0, 2, (16,)).astype(np.int64))
+    ce = nn.CrossEntropyLoss()
+    first = None
+    for _ in range(30):
+        loss = ce(qmodel(x), y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        if first is None:
+            first = float(loss.numpy())
+    assert float(loss.numpy()) < first
+
+
+def test_quantize_dequantize_roundtrip(rng):
+    from paddle_tpu.quantization import dequantize_linear, quantize_linear
+
+    x = rng.standard_normal(100).astype(np.float32)
+    scale = paddle.to_tensor(np.float32(np.abs(x).max() / 127))
+    q = quantize_linear(paddle.to_tensor(x), scale)
+    deq = dequantize_linear(q, scale)
+    assert np.abs(deq.numpy() - x).max() < float(scale.numpy())
+
+
+def test_static_executor():
+    from paddle_tpu import static
+
+    spec = static.InputSpec([None, 4], "float32", name="x")
+    assert spec.shape == (None, 4)
+    prog = static.Program.from_callable(lambda x: x * 2 + 1)
+    exe = static.Executor()
+    out = exe.run(prog, feed={"x": np.ones((2, 4), np.float32)})
+    np.testing.assert_allclose(out[0], 3.0)

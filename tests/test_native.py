@@ -51,6 +51,35 @@ def test_tcpstore_two_clients_barrier():
     assert not errors
 
 
+def test_tcpstore_barrier_reusable():
+    master = TCPStore("127.0.0.1", 0, is_master=True, world_size=2)
+    port = master.port
+    order = []
+
+    def rank1():
+        c = TCPStore("127.0.0.1", port, is_master=False, world_size=2)
+        c.barrier()
+        order.append("r1-b1")
+        c.barrier()
+        order.append("r1-b2")
+
+    t = threading.Thread(target=rank1)
+    t.start()
+    master.barrier()
+    order.append("r0-b1")
+    master.barrier()
+    order.append("r0-b2")
+    t.join(timeout=30)
+    assert len(order) == 4  # both barriers released both sides
+
+
+def test_tcpstore_large_value():
+    s = TCPStore("127.0.0.1", 0, is_master=True, world_size=1)
+    big = b"z" * (3 << 20)  # 3 MB > native 1 MB first-try buffer
+    s.set("big", big)
+    assert s.get("big") == big
+
+
 def test_tcpstore_blocking_get():
     s = TCPStore("127.0.0.1", 0, is_master=True, world_size=1)
     port = s.port
