@@ -46,7 +46,11 @@ def main():
 
     model = GPTForCausalLM(cfg)
     criterion = GPTPretrainingCriterion(cfg)
-    optimizer = opt.AdamW(learning_rate=1e-4, parameters=model.parameters())
+    optimizer = opt.AdamW(learning_rate=1e-4, parameters=model.parameters(),
+                          multi_precision=True)
+    if on_tpu:
+        # bf16 params on the MXU with fp32 master weights in the update
+        model, optimizer = paddle.amp.decorate(model, optimizer, level="O2")
 
     def loss_fn(m, ids, labels):
         return criterion(m(ids), labels)

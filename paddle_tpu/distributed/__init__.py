@@ -56,3 +56,8 @@ from paddle_tpu.distributed.checkpoint import (  # noqa: F401,E402
     load_state_dict,
     save_state_dict,
 )
+from paddle_tpu.distributed import auto_tuner  # noqa: F401,E402
+from paddle_tpu.distributed.store import (  # noqa: F401,E402
+    TCPStore,
+    create_or_get_global_tcp_store,
+)

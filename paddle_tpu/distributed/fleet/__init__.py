@@ -28,3 +28,4 @@ from paddle_tpu.distributed.fleet.topology import (  # noqa: F401
     CommunicateTopology,
     HybridCommunicateGroup,
 )
+from paddle_tpu.distributed.fleet import elastic  # noqa: F401,E402

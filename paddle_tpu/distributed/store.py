@@ -40,39 +40,65 @@ class TCPStore:
         self.host = host
         self.port = port
         self.world_size = world_size
-        self._fd = None
-        self._sock = None
-        self._connect()
+        # one connection PER THREAD: clients are shared across threads (the
+        # elastic heartbeat) and a blocking wait() must not starve them
+        self._local = threading.local()
+        self._all_conns = []
+        self._conns_mu = threading.Lock()
+        self._conn()  # connect eagerly so constructor errors surface here
 
     # ------------------------------------------------------------ transport
-    def _connect(self):
-        """Retry until the master binds (reference TCPStore semantics: the
-        whole timeout budget applies to establishment, not one attempt)."""
+    def _conn(self):
+        """This thread's connection, established on first use with retry
+        until the master binds (reference TCPStore semantics: the timeout
+        budget covers establishment, bounded per attempt)."""
+        c = getattr(self._local, "conn", None)
+        if c is not None:
+            return c
         import time
 
         deadline = time.monotonic() + self._timeout_ms / 1000
         last_err = None
         while time.monotonic() < deadline:
+            remaining_ms = max(int((deadline - time.monotonic()) * 1000), 1)
+            attempt_ms = min(remaining_ms, 5000)
             try:
                 if self._lib is not None:
                     fd = self._lib.tcpstore_connect(
-                        self.host.encode(), self.port, self._timeout_ms)
+                        self.host.encode(), self.port, attempt_ms)
                     if fd >= 0:
-                        self._fd = fd
-                        return
+                        self._local.conn = ("fd", fd)
+                        with self._conns_mu:
+                            self._all_conns.append(("fd", fd))
+                        return self._local.conn
                     last_err = ConnectionError("connect failed")
                 else:
-                    self._sock = socket.create_connection(
-                        (self.host, self.port), timeout=5)
-                    self._sock.settimeout(self._timeout_ms / 1000)
-                    self._sock.setsockopt(socket.IPPROTO_TCP,
-                                          socket.TCP_NODELAY, 1)
-                    return
+                    sock = socket.create_connection(
+                        (self.host, self.port), timeout=attempt_ms / 1000)
+                    sock.settimeout(self._timeout_ms / 1000)
+                    sock.setsockopt(socket.IPPROTO_TCP,
+                                    socket.TCP_NODELAY, 1)
+                    self._local.conn = ("sock", sock)
+                    with self._conns_mu:
+                        self._all_conns.append(("sock", sock))
+                    return self._local.conn
             except OSError as e:
                 last_err = e
             time.sleep(0.25)
         raise ConnectionError(
             f"TCPStore: cannot connect {self.host}:{self.port}: {last_err}")
+
+    @property
+    def _fd(self):
+        kind, c = self._conn()
+        assert kind == "fd"
+        return c
+
+    @property
+    def _sock(self):
+        kind, c = self._conn()
+        assert kind == "sock"
+        return c
 
     # --------------------------------------------------------------- client
     def set(self, key: str, value) -> None:
@@ -139,13 +165,15 @@ class TCPStore:
 
     def __del__(self):
         try:
-            if self._lib is not None:
-                if self._fd is not None and self._fd >= 0:
-                    self._lib.tcpstore_close(self._fd)
-                if self._server:
-                    self._lib.tcpstore_server_stop(self._server)
-            elif self._sock is not None:
-                self._sock.close()
+            with self._conns_mu:
+                conns, self._all_conns = self._all_conns, []
+            for kind, c in conns:
+                if kind == "fd" and self._lib is not None:
+                    self._lib.tcpstore_close(c)
+                elif kind == "sock":
+                    c.close()
+            if self._lib is not None and self._server:
+                self._lib.tcpstore_server_stop(self._server)
         except Exception:
             pass
 

@@ -15,11 +15,11 @@ def _norm(norm):
     return norm if norm in ("backward", "forward", "ortho") else "backward"
 
 
-def _op(name, fn):
-    def op(x, n=None, axis=-1, norm="backward", name_arg=None):
-        return apply(name, lambda a: fn(a, n=n, axis=axis, norm=_norm(norm)), x)
+def _op(op_name, fn):
+    def op(x, n=None, axis=-1, norm="backward", name=None):
+        return apply(op_name, lambda a: fn(a, n=n, axis=axis, norm=_norm(norm)), x)
 
-    op.__name__ = name
+    op.__name__ = op_name
     return op
 
 
@@ -31,11 +31,11 @@ hfft = _op("hfft", jnp.fft.hfft)
 ihfft = _op("ihfft", jnp.fft.ihfft)
 
 
-def _op2(name, fn):
-    def op(x, s=None, axes=(-2, -1), norm="backward", name_arg=None):
-        return apply(name, lambda a: fn(a, s=s, axes=axes, norm=_norm(norm)), x)
+def _op2(op_name, fn):
+    def op(x, s=None, axes=(-2, -1), norm="backward", name=None):
+        return apply(op_name, lambda a: fn(a, s=s, axes=axes, norm=_norm(norm)), x)
 
-    op.__name__ = name
+    op.__name__ = op_name
     return op
 
 
@@ -45,11 +45,11 @@ rfft2 = _op2("rfft2", jnp.fft.rfft2)
 irfft2 = _op2("irfft2", jnp.fft.irfft2)
 
 
-def _opn(name, fn):
-    def op(x, s=None, axes=None, norm="backward", name_arg=None):
-        return apply(name, lambda a: fn(a, s=s, axes=axes, norm=_norm(norm)), x)
+def _opn(op_name, fn):
+    def op(x, s=None, axes=None, norm="backward", name=None):
+        return apply(op_name, lambda a: fn(a, s=s, axes=axes, norm=_norm(norm)), x)
 
-    op.__name__ = name
+    op.__name__ = op_name
     return op
 
 

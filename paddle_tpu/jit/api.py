@@ -128,13 +128,16 @@ class TrainStep:
         self._loss_fn = loss_fn
         self._opt = optimizer
         self._params = [p for p in optimizer._parameter_list if p.trainable]
-        # eager state init so shapes are known before trace
+        # eager state init so shapes are known before trace; master weights
+        # (multi_precision) materialize here so the jitted step carries them
         for p in self._params:
             optimizer._state.setdefault(id(p), optimizer._init_state(p))
-        donate_argnums = (0, 1) if donate else ()
+            optimizer._master(p)
+        donate_argnums = (0, 1, 2) if donate else ()
         self._jitted = jax.jit(self._step, donate_argnums=donate_argnums)
 
-    def _step(self, param_vals, opt_states, buffer_vals, batch_vals, lr, key):
+    def _step(self, param_vals, opt_states, master_vals, buffer_vals,
+              batch_vals, lr, key):
         params = self._params
         _, buffers_dict = collect_state(self._model)
         buffers = [b for b in buffers_dict.values() if b is not None]
@@ -152,36 +155,51 @@ class TrainStep:
         # grad clip (pure, works on tracers)
         if self._opt._grad_clip is not None:
             grads = self._opt._grad_clip._clip_arrays(grads)
-        new_params, new_states = [], []
-        for p, pv, g, st in zip(params, param_vals, grads, opt_states):
+        new_params, new_states, new_masters = [], [], []
+        for p, pv, g, st, mv in zip(params, param_vals, grads, opt_states,
+                                    master_vals):
             if g is None:
                 new_params.append(pv)
                 new_states.append(st)
+                new_masters.append(mv)
                 continue
+            target = mv if mv is not None else pv
             np_, ns = self._opt._apply_one(
-                pv, g.astype(pv.dtype), lr, st, self._opt._decay_for(p)
+                target, g.astype(target.dtype), lr, st,
+                self._opt._decay_for(p)
             )
-            new_params.append(np_)
+            if mv is not None:  # update fp32 master, cast back to param dtype
+                new_masters.append(np_)
+                new_params.append(np_.astype(pv.dtype))
+            else:
+                new_masters.append(None)
+                new_params.append(np_)
             new_states.append(ns)
-        return loss_val, new_params, new_states, new_buffer_vals
+        return loss_val, new_params, new_states, new_masters, new_buffer_vals
 
     def __call__(self, *batch):
         params = self._params
         param_vals = [p._value for p in params]
         opt_states = [self._opt._state[id(p)] for p in params]
+        master_vals = [self._opt._master_weights.get(id(p)) for p in params]
         _, buffers_dict = collect_state(self._model)
         buffers = [b for b in buffers_dict.values() if b is not None]
         buffer_vals = [b._value for b in buffers]
         batch_vals = tree_unwrap(batch)
         lr = jnp.asarray(self._opt.get_lr(), jnp.float32)
         key = rng.next_key()
-        loss_val, new_params, new_states, new_buffer_vals = self._jitted(
-            param_vals, opt_states, buffer_vals, batch_vals, lr, key
-        )
+        loss_val, new_params, new_states, new_masters, new_buffer_vals = \
+            self._jitted(
+                param_vals, opt_states, master_vals, buffer_vals, batch_vals,
+                lr, key
+            )
         for p, v in zip(params, new_params):
             p._replace_value(v)
         for p, st in zip(params, new_states):
             self._opt._state[id(p)] = st
+        for p, mv in zip(params, new_masters):
+            if mv is not None:
+                self._opt._master_weights[id(p)] = mv
         for b, v in zip(buffers, new_buffer_vals):
             b._replace_value(v)
         self._opt._step_count += 1

@@ -41,10 +41,12 @@ struct Store {
   std::thread accept_thread;
   bool stopping = false;
   // connection bookkeeping so stop() can wake + join every handler before
-  // the Store is freed (no use-after-free on shutdown)
+  // the Store is freed (no use-after-free on shutdown); finished slots are
+  // reaped by the accept loop so transient clients don't leak fds/threads
   std::mutex conn_mu;
   std::vector<std::thread> conn_threads;
-  std::vector<int> conn_fds;
+  std::vector<int> conn_fds;        // -1 = handler finished, fd closed
+  std::vector<bool> conn_done;
 };
 
 bool read_all(int fd, void* buf, size_t n) {
@@ -81,7 +83,7 @@ bool write_field(int fd, const void* buf, uint32_t len) {
   return len == 0 || write_all(fd, buf, len);
 }
 
-void serve_conn(Store* s, int fd) {
+void serve_conn(Store* s, int fd, size_t slot) {
   int one = 1;
   setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
   for (;;) {
@@ -149,8 +151,13 @@ void serve_conn(Store* s, int fd) {
       break;
     }
   }
-  // fd is closed by tcpstore_server_stop (closing here could race stop()'s
-  // shutdown() against a reused descriptor number)
+  // close the fd under conn_mu (stop() takes the same lock before its
+  // shutdown() sweep, so it never touches a reused descriptor number) and
+  // mark the slot so the accept loop reaps this thread
+  std::lock_guard<std::mutex> g(s->conn_mu);
+  ::close(fd);
+  s->conn_fds[slot] = -1;
+  s->conn_done[slot] = true;
 }
 
 }  // namespace
@@ -184,8 +191,16 @@ void* tcpstore_server_start(int port) {
         ::close(cfd);
         break;
       }
+      // reap finished handlers (fd already closed by serve_conn)
+      for (size_t i = 0; i < s->conn_done.size(); ++i) {
+        if (s->conn_done[i] && s->conn_threads[i].joinable()) {
+          s->conn_threads[i].join();
+        }
+      }
+      size_t slot = s->conn_fds.size();
       s->conn_fds.push_back(cfd);
-      s->conn_threads.emplace_back(serve_conn, s, cfd);
+      s->conn_done.push_back(false);
+      s->conn_threads.emplace_back(serve_conn, s, cfd, slot);
     }
   });
   return s;
@@ -214,11 +229,13 @@ void tcpstore_server_stop(void* handle) {
     // wake handlers blocked in read() and join them all before freeing
     std::lock_guard<std::mutex> g(s->conn_mu);
     s->stopping = true;
-    for (int fd : s->conn_fds) ::shutdown(fd, SHUT_RDWR);
+    for (int fd : s->conn_fds)
+      if (fd >= 0) ::shutdown(fd, SHUT_RDWR);
   }
   for (auto& t : s->conn_threads)
     if (t.joinable()) t.join();
-  for (int fd : s->conn_fds) ::close(fd);
+  for (int fd : s->conn_fds)
+    if (fd >= 0) ::close(fd);
   delete s;
 }
 

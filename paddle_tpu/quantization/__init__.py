@@ -21,20 +21,31 @@ from paddle_tpu.core.dispatch import apply
 from paddle_tpu.tensor import Tensor
 
 
+def _channel_scale(s, ndim, axis):
+    """Reshape a per-channel scale vector to broadcast along ``axis``."""
+    if axis is None or s.ndim == 0:
+        return s
+    shape = [1] * ndim
+    shape[axis] = s.shape[0]
+    return s.reshape(shape)
+
+
 def quantize_linear(x, scale, zero_point=0.0, bit_length=8, axis=None):
     qmax = 2 ** (bit_length - 1) - 1
     qmin = -(2 ** (bit_length - 1))
 
     def f(v, s):
-        q = jnp.round(v / s + zero_point)
+        q = jnp.round(v / _channel_scale(s, v.ndim, axis) + zero_point)
         return jnp.clip(q, qmin, qmax)
 
     return apply("quantize_linear", f, x, scale)
 
 
 def dequantize_linear(x, scale, zero_point=0.0, bit_length=8, axis=None):
-    return apply("dequantize_linear", lambda q, s: (q - zero_point) * s,
-                 x, scale)
+    def f(q, s):
+        return (q - zero_point) * _channel_scale(s, q.ndim, axis)
+
+    return apply("dequantize_linear", f, x, scale)
 
 
 class _FakeQuantSTE(PyLayer):

@@ -102,8 +102,8 @@ def to_sparse_coo(x, sparse_dim=None):
     return SparseCooTensor._from_bcoo(bcoo)
 
 
-def _binary(name, fn):
-    def op(x, y, name_arg=None):
+def _binary(op_name, fn):
+    def op(x, y, name=None):
         if is_sparse(x) and is_sparse(y):
             out = fn(x._value.todense(), y._value.todense())
             return SparseCooTensor._from_bcoo(jsparse.BCOO.fromdense(out))
@@ -111,7 +111,7 @@ def _binary(name, fn):
         ya = y._value.todense() if is_sparse(y) else y._value
         return Tensor._from_value(fn(xa, ya))
 
-    op.__name__ = name
+    op.__name__ = op_name
     return op
 
 
@@ -132,15 +132,15 @@ def matmul(x, y, name=None):
     return Tensor._from_value(x._value @ y._value)
 
 
-def _unary_on_values(name, fn):
-    def op(x, name_arg=None):
+def _unary_on_values(op_name, fn):
+    def op(x, name=None):
         if is_sparse(x):
             b = x._value
             return SparseCooTensor._from_bcoo(
                 jsparse.BCOO((fn(b.data), b.indices), shape=b.shape))
         return Tensor._from_value(fn(x._value))
 
-    op.__name__ = name
+    op.__name__ = op_name
     return op
 
 

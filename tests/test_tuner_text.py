@@ -1,0 +1,92 @@
+"""Auto-tuner, elastic manager, text ops (reference patterns:
+test/auto_tuner/, fleet elastic tests, test_viterbi_decode_op.py)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.distributed.auto_tuner import (
+    AutoTuner,
+    ModelSpec,
+    TunerConfig,
+    estimate_cost,
+    generate_candidates,
+    prune,
+)
+
+
+def test_tuner_candidates_cover_world():
+    model = ModelSpec(hidden_size=512, num_layers=8, global_batch_size=8)
+    cands = generate_candidates(8, model)
+    assert cands and all(c.world() == 8 for c in cands)
+
+
+def test_tuner_prune_respects_divisibility():
+    model = ModelSpec(hidden_size=100, num_layers=7, global_batch_size=8)
+    kept = prune(generate_candidates(8, model), model)
+    for c in kept:
+        assert 100 % c.mp_degree == 0
+        assert 7 % c.pp_degree == 0
+
+
+def test_tuner_search_picks_lowest_cost():
+    model = ModelSpec(hidden_size=1024, num_layers=12, global_batch_size=8)
+    tuner = AutoTuner(8, model)
+    best = tuner.search()
+    assert best.world() == 8
+    assert best.estimated_cost <= tuner.history[-1].estimated_cost
+
+
+def test_tuner_measured_trials():
+    model = ModelSpec(hidden_size=512, num_layers=8, global_batch_size=8)
+
+    # fake trial: dp-heavy configs "run fastest"
+    def trial(c: TunerConfig):
+        return 1.0 / c.dp_degree
+
+    tuner = AutoTuner(8, model, trial_fn=trial, max_trials=5)
+    best = tuner.search()
+    assert best.measured_time == min(c.measured_time for c in tuner.history)
+
+
+def test_elastic_manager_membership(monkeypatch):
+    from paddle_tpu.distributed import store as store_mod
+    from paddle_tpu.distributed.fleet.elastic import ElasticManager, ElasticStatus
+
+    s = store_mod.TCPStore("127.0.0.1", 0, is_master=True, world_size=1)
+    monkeypatch.setenv("PADDLE_ELASTIC_NP", "1:4")
+    m = ElasticManager(store=s, heartbeat_interval=0.05)
+    m.register()
+    assert m.watch() == ElasticStatus.HOLD
+    import time
+
+    time.sleep(0.15)
+    assert 0 in m.alive_members()
+    # simulate a peer joining: generation bumps -> restart signal
+    s.add("elastic/generation", 1)
+    assert m.watch() == ElasticStatus.RESTART
+    m.stop()
+
+
+def test_viterbi_decode_recovers_planted_path():
+    emis = np.full((2, 5, 4), -8.0, np.float32)
+    paths_true = [[0, 1, 2, 3, 1], [3, 3, 0, 2, 2]]
+    for b in range(2):
+        for t, tag in enumerate(paths_true[b]):
+            emis[b, t, tag] = 4.0
+    trans = np.zeros((4, 4), np.float32)
+    scores, paths = paddle.text.viterbi_decode(
+        paddle.to_tensor(emis), paddle.to_tensor(trans),
+        paddle.to_tensor(np.array([5, 5])))
+    assert paths.numpy().tolist() == paths_true
+    np.testing.assert_allclose(scores.numpy(), 20.0, rtol=1e-5)
+
+
+def test_viterbi_transitions_matter():
+    # emissions tie two tags; transitions break the tie
+    emis = np.zeros((1, 3, 2), np.float32)
+    trans = np.array([[5.0, -5.0], [-5.0, -5.0]], np.float32)  # stay at 0
+    _, paths = paddle.text.viterbi_decode(
+        paddle.to_tensor(emis), paddle.to_tensor(trans),
+        paddle.to_tensor(np.array([3])))
+    assert paths.numpy()[0].tolist() == [0, 0, 0]
