@@ -77,6 +77,7 @@ from paddle_tpu import quantization  # noqa: E402,F401
 from paddle_tpu import static  # noqa: E402,F401
 from paddle_tpu import hub  # noqa: E402,F401
 from paddle_tpu import text  # noqa: E402,F401
+from paddle_tpu import audio  # noqa: E402,F401
 from paddle_tpu import onnx  # noqa: E402,F401
 from paddle_tpu.framework.flags import get_flags, set_flags  # noqa: E402,F401
 from paddle_tpu.framework.io import load, save  # noqa: E402,F401

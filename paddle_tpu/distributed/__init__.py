@@ -61,3 +61,4 @@ from paddle_tpu.distributed.store import (  # noqa: F401,E402
     TCPStore,
     create_or_get_global_tcp_store,
 )
+from paddle_tpu.distributed import rpc  # noqa: F401,E402

@@ -175,4 +175,6 @@ class AutoTuner:
             self.history.append(c)
             if c.measured_time < best_t:
                 best, best_t = c, c.measured_time
+        if best is None:  # every trial failed: fall back to estimated best
+            best = cands[0]
         return best

@@ -58,14 +58,20 @@ class ElasticManager:
 
     # ------------------------------------------------------------ membership
     def _generation(self) -> int:
-        try:
-            import struct
+        """A transient store error must NOT look like a scale event: return
+        the last known generation on failure."""
+        import struct
 
+        try:
             if self.store.check("elastic/generation"):
-                return struct.unpack("<q", self.store.get("elastic/generation"))[0]
+                gen = struct.unpack(
+                    "<q", self.store.get("elastic/generation"))[0]
+                self._last_known_gen = gen
+                return gen
+            return 0
         except Exception:
-            pass
-        return 0
+            return getattr(self, "_last_known_gen",
+                           getattr(self, "_generation_at_start", 0))
 
     def register(self):
         """Announce membership; bump the generation so peers see the change."""

@@ -21,3 +21,19 @@ from paddle_tpu.models.llama import (  # noqa: F401
     llama2_7b,
     llama2_13b,
 )
+from paddle_tpu.models.bert import (  # noqa: F401
+    BertConfig,
+    BertForPretraining,
+    BertForSequenceClassification,
+    BertModel,
+    bert_base,
+    bert_large,
+    bert_tiny,
+)
+from paddle_tpu.models.vit import (  # noqa: F401
+    ViTConfig,
+    VisionTransformer,
+    vit_base_patch16_224,
+    vit_large_patch16_224,
+    vit_tiny,
+)

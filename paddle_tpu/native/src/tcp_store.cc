@@ -191,16 +191,25 @@ void* tcpstore_server_start(int port) {
         ::close(cfd);
         break;
       }
-      // reap finished handlers (fd already closed by serve_conn)
+      // reuse a finished handler's slot (joining its thread) so long-lived
+      // servers don't grow per transient client
+      size_t slot = s->conn_fds.size();
       for (size_t i = 0; i < s->conn_done.size(); ++i) {
-        if (s->conn_done[i] && s->conn_threads[i].joinable()) {
-          s->conn_threads[i].join();
+        if (s->conn_done[i]) {
+          if (s->conn_threads[i].joinable()) s->conn_threads[i].join();
+          slot = i;
+          break;
         }
       }
-      size_t slot = s->conn_fds.size();
-      s->conn_fds.push_back(cfd);
-      s->conn_done.push_back(false);
-      s->conn_threads.emplace_back(serve_conn, s, cfd, slot);
+      if (slot == s->conn_fds.size()) {
+        s->conn_fds.push_back(cfd);
+        s->conn_done.push_back(false);
+        s->conn_threads.emplace_back(serve_conn, s, cfd, slot);
+      } else {
+        s->conn_fds[slot] = cfd;
+        s->conn_done[slot] = false;
+        s->conn_threads[slot] = std::thread(serve_conn, s, cfd, slot);
+      }
     }
   });
   return s;

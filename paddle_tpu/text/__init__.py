@@ -55,18 +55,27 @@ class Imdb(Dataset):
             raise FileNotFoundError(
                 f"{data_file} not found (downloads unavailable offline)")
         self.docs, self.labels = [], []
+        # the vocabulary always comes from the TRAIN split so train/test
+        # share word ids (paddle imdb.py builds word_idx from train only)
         freq = {}
         texts = []
         with tarfile.open(data_file, "r:*") as tf:
             for m in tf.getmembers():
                 parts = m.name.split("/")
-                if len(parts) >= 4 and parts[1] == mode and \
-                        parts[2] in ("pos", "neg") and m.name.endswith(".txt"):
-                    words = tf.extractfile(m).read().decode(
-                        "utf-8", "ignore").lower().split()
-                    texts.append((words, 0 if parts[2] == "neg" else 1))
+                if len(parts) < 4 or parts[2] not in ("pos", "neg") or \
+                        not m.name.endswith(".txt"):
+                    continue
+                is_train = parts[1] == "train"
+                is_mine = parts[1] == mode
+                if not (is_train or is_mine):
+                    continue
+                words = tf.extractfile(m).read().decode(
+                    "utf-8", "ignore").lower().split()
+                if is_train:
                     for w in words:
                         freq[w] = freq.get(w, 0) + 1
+                if is_mine:
+                    texts.append((words, 0 if parts[2] == "neg" else 1))
         self.word_idx = {
             w: i for i, (w, c) in enumerate(
                 sorted(freq.items(), key=lambda kv: -kv[1]))
@@ -107,20 +116,31 @@ def viterbi_decode(potentials, transition_params, lengths,
 
     def f(emis, trans, lens):
         B, T, N = emis.shape
+        lens = lens.astype(jnp.int32)
+        ident = jnp.broadcast_to(jnp.arange(N)[None, :], (B, N))
+        if include_bos_eos_tag:
+            # paddle convention: the last two tags are start/stop; the start
+            # row seeds position 0, the stop column closes each sequence
+            alpha0 = emis[:, 0] + trans[-2][None, :]
+        else:
+            alpha0 = emis[:, 0]
 
-        def step(carry, x):
+        def step(carry, xt):
             alpha, = carry
-            # alpha [B, N] -> scores via transition to each next tag
+            x, t = xt
             scores = alpha[:, :, None] + trans[None, :, :] + x[:, None, :]
             best_prev = jnp.argmax(scores, axis=1)  # [B, N]
             alpha_new = jnp.max(scores, axis=1)
+            valid = (t < lens)[:, None]  # freeze past each sequence's end
+            alpha_new = jnp.where(valid, alpha_new, alpha)
+            best_prev = jnp.where(valid, best_prev, ident)
             return (alpha_new,), best_prev
 
-        alpha0 = emis[:, 0]
+        ts = jnp.arange(1, T)
         (alpha,), backptrs = jax.lax.scan(
-            step, (alpha0,), jnp.swapaxes(emis[:, 1:], 0, 1))
-        # mask alphas beyond each sequence's length handled by taking the
-        # argmax at position lengths-1; for simplicity require full-length
+            step, (alpha0,), (jnp.swapaxes(emis[:, 1:], 0, 1), ts))
+        if include_bos_eos_tag:
+            alpha = alpha + trans[:, -1][None, :]
         scores = jnp.max(alpha, axis=-1)
         last = jnp.argmax(alpha, axis=-1)  # [B]
 
@@ -130,10 +150,14 @@ def viterbi_decode(potentials, transition_params, lengths,
             return prev, tag
 
         # scan emits the tag at each position T-1..1 (the carry before each
-        # hop); the final carry is the tag at position 0
+        # hop); the final carry is the tag at position 0. Frozen (padding)
+        # steps carry identity backpointers so the real suffix is preserved.
         first, path_rev = jax.lax.scan(backtrack, last, backptrs[::-1])
         paths = jnp.concatenate(
             [first[:, None], path_rev[::-1].T], axis=1)  # [B, T]
+        # zero out positions past each sequence's length
+        pos = jnp.arange(T)[None, :]
+        paths = jnp.where(pos < lens[:, None], paths, 0)
         return scores, paths.astype(jnp.int64)
 
     return apply("viterbi_decode", f, potentials, transition_params, lengths)

@@ -1,0 +1,131 @@
+"""BERT, ViT, audio features, RPC (reference patterns: PaddleNLP bert tests,
+PaddleClas vit tests, test/legacy_test/test_audio_functions.py, rpc tests)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+
+
+def test_bert_classification_trains(rng):
+    from paddle_tpu.models import BertForSequenceClassification, bert_tiny
+
+    cfg = bert_tiny(num_layers=1)
+    m = BertForSequenceClassification(cfg, num_classes=2)
+    opt = paddle.optimizer.Adam(learning_rate=1e-3, parameters=m.parameters())
+    ids = paddle.to_tensor(
+        rng.integers(0, cfg.vocab_size, (8, 12)).astype(np.int32))
+    # plant the signal: class = whether token 0 is < vocab/2
+    labels = paddle.to_tensor(
+        (ids.numpy()[:, 0] < cfg.vocab_size // 2).astype(np.int64))
+    ce = nn.CrossEntropyLoss()
+    first = None
+    for _ in range(25):
+        loss = ce(m(ids), labels)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        if first is None:
+            first = float(loss.numpy())
+    assert float(loss.numpy()) < first * 0.7
+
+
+def test_bert_attention_mask_effect(rng):
+    from paddle_tpu.models import BertModel, bert_tiny
+
+    cfg = bert_tiny(num_layers=1)
+    m = BertModel(cfg)
+    m.eval()
+    ids = paddle.to_tensor(
+        rng.integers(0, cfg.vocab_size, (1, 8)).astype(np.int32))
+    full = paddle.to_tensor(np.ones((1, 8), np.int32))
+    half = paddle.to_tensor(
+        np.array([[1, 1, 1, 1, 0, 0, 0, 0]], np.int32))
+    h_full, _ = m(ids, attention_mask=full)
+    h_half, _ = m(ids, attention_mask=half)
+    # masking the tail must change the first token's representation
+    assert np.abs(h_full.numpy()[0, 0] - h_half.numpy()[0, 0]).max() > 1e-5
+
+
+def test_bert_pretraining_heads(rng):
+    from paddle_tpu.models import BertForPretraining, bert_tiny
+
+    cfg = bert_tiny(num_layers=1)
+    m = BertForPretraining(cfg)
+    ids = paddle.to_tensor(
+        rng.integers(0, cfg.vocab_size, (2, 8)).astype(np.int32))
+    mlm, nsp = m(ids)
+    assert mlm.shape == [2, 8, cfg.vocab_size]
+    assert nsp.shape == [2, 2]
+
+
+def test_vit_forward_and_patch_count(rng):
+    from paddle_tpu.models import VisionTransformer, vit_tiny
+
+    cfg = vit_tiny()
+    m = VisionTransformer(cfg)
+    m.eval()
+    x = paddle.to_tensor(rng.standard_normal((2, 3, 32, 32)).astype(np.float32))
+    out = m(x)
+    assert out.shape == [2, 10]
+    assert cfg.num_patches == 16  # (32/8)^2
+
+
+def test_vit_base_param_count():
+    from paddle_tpu.models import VisionTransformer, vit_base_patch16_224
+
+    m = VisionTransformer(vit_base_patch16_224())
+    n = sum(int(np.prod(p.shape)) for p in m.parameters())
+    # ViT-B/16: ~86.6M params
+    assert abs(n - 86_567_656) < 200_000, n
+
+
+def test_spectrogram_peak_bin():
+    from paddle_tpu.audio.features import Spectrogram
+
+    sr, f = 8000, 1000.0
+    t = np.arange(8000) / sr
+    x = paddle.to_tensor(np.sin(2 * np.pi * f * t).astype(np.float32)[None])
+    spec = Spectrogram(n_fft=256, hop_length=128)(x).numpy()[0]
+    peak_bin = spec.mean(axis=1).argmax()
+    expected = round(f * 256 / sr)
+    assert abs(int(peak_bin) - expected) <= 1
+
+
+def test_melspectrogram_shapes_and_mono():
+    from paddle_tpu.audio.features import LogMelSpectrogram, MelSpectrogram
+
+    x = paddle.to_tensor(np.random.randn(2, 4000).astype(np.float32))
+    mel = MelSpectrogram(sr=8000, n_fft=256, n_mels=40)(x)
+    assert mel.shape[0] == 2 and mel.shape[1] == 40
+    logmel = LogMelSpectrogram(sr=8000, n_fft=256, n_mels=40)(x)
+    assert logmel.shape == mel.shape
+
+
+def test_mfcc_dct_orthonormal():
+    from paddle_tpu.audio.functional import create_dct
+
+    d = create_dct(13, 40).numpy()  # [40, 13]
+    gram = d.T @ d
+    np.testing.assert_allclose(gram, np.eye(13), atol=1e-5)
+
+
+def test_rpc_sync_async_and_exceptions():
+    import operator
+
+    import paddle_tpu.distributed.rpc as rpc
+    from paddle_tpu.distributed.store import TCPStore
+
+    store = TCPStore("127.0.0.1", 0, is_master=True, world_size=1)
+    rpc.init_rpc("w0", rank=0, world_size=1, store=store)
+    try:
+        assert rpc.rpc_sync("w0", operator.add, args=(2, 3)) == 5
+        fut = rpc.rpc_async("w0", operator.mul, args=(6, 7))
+        assert fut.wait(30) == 42
+        with pytest.raises(ZeroDivisionError):
+            rpc.rpc_sync("w0", operator.truediv, args=(1, 0))
+        info = rpc.get_worker_info()
+        assert info.name == "w0" and info.rank == 0
+    finally:
+        rpc.shutdown()
