@@ -13,9 +13,9 @@ TPU-native: ZeRO stages are *placement decisions*, not runtimes.
                     them — the reference's segment-aware prefetching falls out
                     of XLA scheduling).
 
-The flags set here are consumed by jit-compiled train steps
-(paddle_tpu.distributed.engine.DistTrainStep) which lay out states/params with
-the corresponding NamedShardings.
+The placements applied here are sticky: jit.TrainStep threads the committed
+shardings of params/optimizer-states/master-weights through the compiled
+step, so the ZeRO layout persists across updates.
 """
 
 from __future__ import annotations
@@ -73,13 +73,15 @@ def group_sharded_parallel(model, optimizer, level, scaler=None, group=None,
         optimizer._sharding_axis = axis
 
     if mesh.shape[axis] > 1:
-        # stage >=1: shard existing optimizer states
+        # stage >=1: shard existing optimizer states + fp32 master weights
         for key, st in list(optimizer._state.items()):
             optimizer._state[key] = {
                 k: shard_array(v, mesh, axis) if hasattr(v, "shape") and v.ndim > 0
                 else v
                 for k, v in st.items()
             }
+        for key, mv in list(optimizer._master_weights.items()):
+            optimizer._master_weights[key] = shard_array(mv, mesh, axis)
         if level == "p_g_os":
             for p in model.parameters():
                 p._replace_value(shard_array(p._value, mesh, axis))

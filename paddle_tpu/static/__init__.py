@@ -127,5 +127,13 @@ def load(program, path, **kwargs):
         "static.load: use paddle.jit.load instead")
 
 
-def nn():  # pragma: no cover - namespace placeholder
-    raise NotImplementedError("static graph layers: use paddle.nn in dygraph")
+class nn:
+    """static.nn namespace: the control-flow ops the reference's static
+    graphs rely on (conditional_block/while/select — SURVEY §2.6)."""
+
+    from paddle_tpu.ops.control_flow import (  # noqa: F401
+        case,
+        cond,
+        switch_case,
+        while_loop,
+    )

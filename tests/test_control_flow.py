@@ -1,0 +1,120 @@
+"""Control-flow op semantics (reference: fluid/operators/controlflow/ —
+SURVEY §2.6 requires these preserved explicitly; test patterns from
+test/legacy_test/test_cond.py, test_while_loop_op.py, test_switch_case.py)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.static import nn as snn
+
+
+def test_cond_both_branches_and_grads():
+    for val, expect, g in ((2.0, 6.0, 3.0), (0.5, 2.5, 5.0)):
+        x = paddle.to_tensor(np.array([val], np.float32), stop_gradient=False)
+        out = snn.cond(paddle.sum(x) > 1.0,
+                       lambda a: a * 3, lambda a: a * 5, (x,))
+        paddle.sum(out).backward()
+        assert out.numpy()[0] == pytest.approx(expect)
+        assert x.grad.numpy()[0] == pytest.approx(g)
+
+
+def test_cond_multi_output():
+    x = paddle.to_tensor(np.array([1.0, 2.0], np.float32))
+    s, p = snn.cond(paddle.to_tensor(True),
+                    lambda a: (a + 1, a * 2),
+                    lambda a: (a - 1, a / 2), (x,))
+    np.testing.assert_allclose(s.numpy(), [2.0, 3.0])
+    np.testing.assert_allclose(p.numpy(), [2.0, 4.0])
+
+
+def test_while_loop_accumulates():
+    i = paddle.to_tensor(np.array(1, np.int32))
+    acc = paddle.to_tensor(np.array(0, np.int32))
+    _, acc2 = snn.while_loop(lambda i, a: i <= 10,
+                             lambda i, a: [i + 1, a + i], [i, acc])
+    assert int(acc2.numpy()) == 55
+
+
+def test_while_loop_tensor_state():
+    v = paddle.to_tensor(np.ones(4, np.float32))
+    n = paddle.to_tensor(np.array(0, np.int32))
+    n2, v2 = snn.while_loop(
+        lambda n, v: n < 3, lambda n, v: [n + 1, v * 2], [n, v])
+    np.testing.assert_allclose(v2.numpy(), 8.0)
+
+
+def test_switch_case_with_default():
+    def mk(c):
+        return lambda: paddle.to_tensor(np.float32(c))
+
+    out = snn.switch_case(paddle.to_tensor(np.array(7, np.int32)),
+                          [mk(1), mk(2)], default=mk(-1))
+    assert float(out.numpy()) == -1.0
+    out = snn.switch_case(paddle.to_tensor(np.array(0, np.int32)),
+                          [mk(1), mk(2)], default=mk(-1))
+    assert float(out.numpy()) == 1.0
+
+
+def test_switch_case_dict_keys():
+    def mk(c):
+        return lambda: paddle.to_tensor(np.float32(c))
+
+    out = snn.switch_case(paddle.to_tensor(np.array(5, np.int32)),
+                          {2: mk(20), 5: mk(50)}, default=mk(-1))
+    assert float(out.numpy()) == 50.0
+
+
+def test_cond_closure_params_get_grads():
+    import paddle_tpu.nn as nn
+
+    paddle.seed(0)
+    lin_a, lin_b = nn.Linear(4, 4), nn.Linear(4, 4)
+    x = paddle.to_tensor(np.ones((2, 4), np.float32))
+    out = snn.cond(paddle.to_tensor(True),
+                   lambda v: lin_a(v), lambda v: lin_b(v), (x,))
+    paddle.sum(out).backward()
+    ga = lin_a.weight.grad
+    assert ga is not None and float(np.abs(ga.numpy()).sum()) > 0
+    gb = lin_b.weight.grad
+    assert gb is None or float(np.abs(gb.numpy()).sum()) == 0
+
+
+def test_while_loop_closure_params_get_grads():
+    import paddle_tpu.nn as nn
+
+    paddle.seed(0)
+    lin = nn.Linear(4, 4)
+    x = paddle.to_tensor(np.ones((2, 4), np.float32))
+    i = paddle.to_tensor(np.array(0, np.int32))
+    _, h = snn.while_loop(lambda i, v: i < 3,
+                          lambda i, v: [i + 1, paddle.tanh(lin(v))], [i, x])
+    paddle.sum(h).backward()
+    g = lin.weight.grad
+    assert g is not None and float(np.abs(g.numpy()).sum()) > 0
+
+
+def test_cond_inside_jit():
+    from paddle_tpu.jit import to_static
+
+    @to_static
+    def f(a):
+        return snn.cond(paddle.sum(a) > 0,
+                        lambda b: b + 1, lambda b: b - 1, (a,))
+
+    assert f(paddle.to_tensor(np.array([3.0], np.float32))).numpy()[0] == 4.0
+    assert f(paddle.to_tensor(np.array([-3.0], np.float32))).numpy()[0] == -4.0
+
+
+def test_while_inside_jit_grad():
+    from paddle_tpu.jit import to_static
+
+    @to_static
+    def geom(x):
+        i = paddle.to_tensor(np.array(0, np.int32))
+        _, out = snn.while_loop(lambda i, v: i < 3,
+                                lambda i, v: [i + 1, v * x], [i, x])
+        return paddle.sum(out)
+
+    x = paddle.to_tensor(np.array([2.0], np.float32))
+    assert float(geom(x).numpy()) == 16.0  # x * x^3
