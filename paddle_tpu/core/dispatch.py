@@ -44,6 +44,12 @@ def _check_numerics(name, out):
                 debugging.check_numerics(v, name)
 
 
+# When control-flow discovery is active, every Tensor consumed by an op is
+# recorded here so closure-captured tensors become vjp primals (see
+# ops/control_flow._discover_params).
+_consumed_watchers: list = []
+
+
 def apply(name: str, raw_fn: Callable, *args, differentiable: bool = True, **kwargs):
     """Execute ``raw_fn`` (a pure jax function) on mixed Tensor/python args.
 
@@ -53,6 +59,10 @@ def apply(name: str, raw_fn: Callable, *args, differentiable: bool = True, **kwa
     from paddle_tpu.tensor import Tensor
 
     tensor_idx = [i for i, a in enumerate(args) if _is_tensor(a)]
+    if _consumed_watchers:
+        watcher = _consumed_watchers[-1]
+        for i in tensor_idx:
+            watcher.consumed.append(args[i])
     vals = [a._value if _is_tensor(a) else a for a in args]
 
     # AMP O1: cast float inputs per white/black list (amp/auto_cast.py parity
@@ -106,17 +116,29 @@ def apply(name: str, raw_fn: Callable, *args, differentiable: bool = True, **kwa
 def _wrap_outputs(name: str, out, node):
     from paddle_tpu.tensor import Tensor
 
+    if _consumed_watchers:
+        # tensors produced while a discovery watcher is active are branch-
+        # internal, not closure captures
+        watcher = _consumed_watchers[-1]
+
+        def _note(t):
+            watcher.produced.add(id(t))
+            return t
+    else:
+        def _note(t):
+            return t
+
     if isinstance(out, tuple):
         results = []
         for i, o in enumerate(out):
-            t = Tensor._from_value(o)
+            t = _note(Tensor._from_value(o))
             t.stop_gradient = node is None
             if node is not None:
                 t._node = node
                 node.register_output(i, t)
             results.append(t)
         return tuple(results)
-    t = Tensor._from_value(out)
+    t = _note(Tensor._from_value(out))
     t.stop_gradient = node is None
     if node is not None:
         t._node = node

@@ -44,41 +44,54 @@ def _tensor_leaves(tree):
 
 
 def _discover_params(branch_fns, operand_tree):
-    """Find trainable leaf Tensors (layer parameters) the branch functions
-    capture by closure: run each branch once eagerly and walk the recorded
-    tape graph back to its leaves. Closure-captured params would otherwise
-    trace as constants and receive no gradients (unlike the reference's
-    cond, whose branch programs own their parameters)."""
+    """Find every Tensor the branch functions consume by closure: run each
+    branch once eagerly with a dispatch watcher recording all Tensor op
+    inputs. Captured tensors (params AND intermediate activations) would
+    otherwise trace as constants and receive no gradients (unlike the
+    reference's cond, whose branch programs own their inputs). The captured
+    tensors join the control-flow node as vjp primals; the tape then
+    continues backward into their own producers.
+
+    Skipped entirely when gradients are disabled (inference): the branch
+    would run once for nothing."""
+    if not tape.is_grad_enabled():
+        return []
+    from paddle_tpu.core import dispatch as _dispatch
+
+    class _Watcher:
+        __slots__ = ("consumed", "produced")
+
+        def __init__(self):
+            self.consumed = []
+            self.produced = set()
+
     operand_ids = {id(t) for t in _tensor_leaves(operand_tree)}
     found, found_ids = [], set()
     for fn in branch_fns:
+        watcher = _Watcher()
+        _dispatch._consumed_watchers.append(watcher)
         try:
-            out = fn()
+            fn()
         except Exception as e:
             import warnings
 
             warnings.warn(
                 f"control-flow branch {getattr(fn, '__name__', fn)!r} raised "
                 f"during eager parameter discovery ({e!r}); closure-captured "
-                "parameters of this branch will NOT receive gradients")
+                "tensors of this branch will NOT receive gradients")
             continue
-        stack = list(_tensor_leaves(out))
-        seen = set()
-        while stack:
-            t = stack.pop()
-            if id(t) in operand_ids:
-                continue  # stop at the block's inputs: upstream graph is
-                # differentiated through the operand cotangents, not here
-            node = getattr(t, "_node", None)
-            if node is None:
-                if not t.stop_gradient and id(t) not in found_ids:
-                    found_ids.add(id(t))
-                    found.append(t)
+        finally:
+            _dispatch._consumed_watchers.pop()
+        for t in watcher.consumed:
+            if (id(t) in operand_ids or id(t) in found_ids
+                    or id(t) in watcher.produced):
                 continue
-            if id(node) in seen:
+            # differentiable boundary tensors only: trainable leaves or
+            # tensors with history
+            if t.stop_gradient and getattr(t, "_node", None) is None:
                 continue
-            seen.add(id(node))
-            stack.extend(node.inputs)
+            found_ids.add(id(t))
+            found.append(t)
     return found
 
 
@@ -268,11 +281,9 @@ def switch_case(branch_index, branch_fns, default=None, name=None):
     dense = jnp.argmax((idx_map == idx_scalar).astype(jnp.int32))
     if default is not None:
         fns = fns + [default]
-        dense = jnp.where(matched, dense, len(fns) - 1)
-    else:
-        # reference semantics: fall back to the max-key branch
-        dense = jnp.where(matched, dense, len(fns) - 1)
-    idx_val = dense
+    # unmatched -> the default when given, else (reference semantics) the
+    # max-key branch — both live at the last slot
+    idx_val = jnp.where(matched, dense, len(fns) - 1)
 
     def raw(_):
         return jax.lax.switch(jnp.reshape(idx_val, ()).astype(jnp.int32),
@@ -284,20 +295,30 @@ def switch_case(branch_index, branch_fns, default=None, name=None):
 
 
 def case(pred_fn_pairs, default=None, name=None):
-    """paddle.static.nn.case parity: first true predicate's fn runs; chained
-    over cond."""
+    """paddle.static.nn.case parity: first true predicate's fn runs.
+
+    Lowered to ONE switch over the first-true index (a chained-cond encoding
+    would evaluate later branches an exponential number of times through the
+    nested discovery/trace passes)."""
     pairs = list(pred_fn_pairs)
     if not pairs:
         if default is None:
             raise ValueError("case needs at least one (pred, fn) pair or a "
                              "default")
         return default()
-
-    def build(i):
-        pred, fn = pairs[i]
-        if i == len(pairs) - 1:
-            tail = default if default is not None else fn
-            return cond(pred, fn, tail, ())
-        return cond(pred, fn, lambda: build(i + 1), ())
-
-    return build(0)
+    preds = jnp.stack([
+        jnp.reshape(p._value if isinstance(p, Tensor) else jnp.asarray(p), ())
+        .astype(bool)
+        for p, _ in pairs
+    ])
+    any_true = jnp.any(preds)
+    first_true = jnp.argmax(preds.astype(jnp.int32))
+    fns = [f for _, f in pairs]
+    if default is not None:
+        fns = fns + [default]
+        idx = jnp.where(any_true, first_true, len(fns) - 1)
+    else:
+        # reference: fall through to the last fn when nothing matches
+        idx = jnp.where(any_true, first_true, len(fns) - 1)
+    return switch_case(Tensor._from_value(idx.astype(jnp.int32)),
+                       dict(enumerate(fns)))

@@ -6,9 +6,10 @@ attention with a custom VJP, i.e. the flash algorithm scheduled for
 MXU/VMEM). Layout at this boundary is paddle's [batch, seq, heads, head_dim];
 the kernel runs [batch, heads, seq, head_dim].
 
-Block sizes: q/k blocks of 512 (or the sequence length if shorter) keep the
-working set within VMEM (~16MB/core) at head_dim 64-256 while giving the MXU
-full 128-lane tiles.
+Block sizes: block_q 1024 / block_k 512 (clamped to the sequence) measured
+fastest on-chip for the GPT-2 shapes (99k vs 96k tokens/s end-to-end against
+512/512; 1024/1024 overflows VMEM-friendly tiling and drops to 66k) — larger
+q blocks amortize the KV loop while k stays within VMEM at head_dim 64-256.
 """
 
 from __future__ import annotations
@@ -22,9 +23,17 @@ from jax.experimental.pallas.ops.tpu.flash_attention import (
 )
 
 
+def _largest_dividing_block(n: int, cap: int) -> int:
+    for b in (1024, 512, 256, 128):
+        if b <= cap and n % b == 0:
+            return b
+    return min(n, cap)
+
+
 def _block_sizes(sq: int, sk: int) -> BlockSizes:
-    bq = min(512, sq)
-    bk = min(512, sk)
+    # largest dividing block ≤ cap: seq 1536 gets 512, not a failing 1024
+    bq = _largest_dividing_block(sq, 1024)
+    bk = _largest_dividing_block(sk, 512)
     return BlockSizes(
         block_q=bq, block_k_major=bk, block_k=bk, block_b=1,
         block_q_major_dkv=bq, block_k_major_dkv=bk, block_k_dkv=bk,
