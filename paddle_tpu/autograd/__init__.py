@@ -1,3 +1,10 @@
+from paddle_tpu.autograd.functional import (  # noqa: F401
+    hessian,
+    jacobian,
+    jvp,
+    vhp,
+    vjp,
+)
 from paddle_tpu.autograd.py_layer import (  # noqa: F401
     LegacyPyLayer,
     PyLayer,

@@ -250,7 +250,8 @@ class GPTPretrainingCriterion(nn.Layer):
             valid = lb2 != ignore_index
             lb_safe = jnp.where(valid, lb2, 0)
             m = jax.lax.stop_gradient(jnp.max(lg2, axis=-1, keepdims=True))
-            shifted = (lg2 - m).astype(jnp.float32)
+            # subtract AFTER the f32 cast so the shift itself is exact
+            shifted = lg2.astype(jnp.float32) - m.astype(jnp.float32)
             lse = jnp.log(jnp.sum(jnp.exp(shifted), axis=-1))
             picked = jnp.take_along_axis(
                 shifted, lb_safe[:, None], axis=-1)[:, 0]

@@ -71,7 +71,12 @@ def _discover_params(branch_fns, operand_tree):
         watcher = _Watcher()
         _dispatch._consumed_watchers.append(watcher)
         try:
-            fn()
+            out = fn()
+            # pass-through captures: pre-existing tensors RETURNED by the
+            # branch without any op touching them are consumed too
+            for t in _tensor_leaves(out):
+                if id(t) not in watcher.produced:
+                    watcher.consumed.append(t)
         except Exception as e:
             import warnings
 
@@ -316,9 +321,7 @@ def case(pred_fn_pairs, default=None, name=None):
     fns = [f for _, f in pairs]
     if default is not None:
         fns = fns + [default]
-        idx = jnp.where(any_true, first_true, len(fns) - 1)
-    else:
-        # reference: fall through to the last fn when nothing matches
-        idx = jnp.where(any_true, first_true, len(fns) - 1)
+    # nothing matched -> the default when given, else (reference) the last fn
+    idx = jnp.where(any_true, first_true, len(fns) - 1)
     return switch_case(Tensor._from_value(idx.astype(jnp.int32)),
                        dict(enumerate(fns)))

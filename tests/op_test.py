@@ -1,0 +1,107 @@
+"""OpTest harness (parity: test/legacy_test/op_test.py:418 OpTest —
+check_output runs each op through multiple runtimes and compares against a
+NumPy reference; check_grad numerically differentiates).
+
+Runtimes here: eager dispatch and the jit-captured path (the eager/PIR
+analogue pair); gradients check the tape backward against central
+differences."""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, Sequence
+
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.jit import to_static
+from paddle_tpu.tensor import Tensor
+
+
+class OpTest:
+    """Subclass sets: self.op (callable), self.inputs (dict name->ndarray),
+    self.attrs (dict), self.ref (numpy reference fn over the inputs)."""
+
+    op: Callable = None
+    attrs: Dict = {}
+
+    def _tensors(self, stop_gradient=True):
+        return {
+            k: paddle.to_tensor(v, stop_gradient=stop_gradient)
+            for k, v in self.inputs.items()
+        }
+
+    def _run_eager(self, tensors):
+        return type(self).op(**tensors, **self.attrs)
+
+    def _run_jit(self):
+        names = list(self.inputs)
+        op = type(self).op
+        attrs = self.attrs
+
+        def fn(*args):
+            return op(**dict(zip(names, args)), **attrs)
+
+        jitted = to_static(fn)
+        return jitted(*[paddle.to_tensor(self.inputs[n]) for n in names])
+
+    @staticmethod
+    def _to_np(out):
+        if isinstance(out, Tensor):
+            return [np.asarray(out.numpy())]
+        if isinstance(out, (list, tuple)):
+            return [np.asarray(o.numpy()) for o in out if isinstance(o, Tensor)]
+        return [np.asarray(out)]
+
+    def check_output(self, rtol=1e-5, atol=1e-6):
+        """Eager and jit paths must both match the numpy reference."""
+        ref_out = self.ref(**self.inputs, **self.attrs)
+        if not isinstance(ref_out, (list, tuple)):
+            ref_out = [ref_out]
+        for name, runner in (
+            ("eager", lambda: self._run_eager(self._tensors())),
+            ("jit", self._run_jit),
+        ):
+            got = self._to_np(runner())
+            assert len(got) == len(ref_out), (
+                f"[{name}] output arity {len(got)} != ref {len(ref_out)}")
+            for g, r in zip(got, ref_out):
+                np.testing.assert_allclose(
+                    g, r, rtol=rtol, atol=atol,
+                    err_msg=f"[{name}] mismatch vs numpy reference")
+
+    def check_grad(self, inputs_to_check: Sequence[str], output_reduce=None,
+                   eps=1e-3, rtol=1e-2, atol=1e-3):
+        """Tape backward vs central finite differences of a scalar loss."""
+        reduce = output_reduce or (lambda out: paddle.sum(
+            out if isinstance(out, Tensor) else out[0]))
+
+        # analytic grads
+        tensors = self._tensors(stop_gradient=True)
+        for n in inputs_to_check:
+            tensors[n].stop_gradient = False
+        loss = reduce(self._run_eager(tensors))
+        loss.backward()
+        analytic = {n: np.asarray(tensors[n].grad.numpy(), dtype=np.float64)
+                    for n in inputs_to_check}
+
+        # numeric grads
+        def scalar(inputs_np):
+            ts = {k: paddle.to_tensor(v) for k, v in inputs_np.items()}
+            out = reduce(type(self).op(**ts, **self.attrs))
+            return float(np.asarray(out.numpy(), dtype=np.float64))
+
+        for n in inputs_to_check:
+            base = {k: v.copy() for k, v in self.inputs.items()}
+            flat = base[n].reshape(-1)
+            num = np.zeros_like(flat, dtype=np.float64)
+            for i in range(flat.size):
+                orig = flat[i]
+                flat[i] = orig + eps
+                f_plus = scalar(base)
+                flat[i] = orig - eps
+                f_minus = scalar(base)
+                flat[i] = orig
+                num[i] = (f_plus - f_minus) / (2 * eps)
+            np.testing.assert_allclose(
+                analytic[n].reshape(-1), num, rtol=rtol, atol=atol,
+                err_msg=f"analytic vs numeric grad mismatch for '{n}'")
