@@ -149,14 +149,26 @@ class GPTAttention(nn.Layer):
         self.attn_dropout_p = cfg.attention_dropout
         self._cfg = cfg
 
-    def forward(self, hidden):
+    def forward(self, hidden, cache=None):
         b, s, h = hidden.shape
         qkv = self.qkv_proj(hidden)  # [b, s, 3h] (mp-sharded last dim)
         qkv = paddle.reshape(qkv, [b, s, self.num_heads, 3 * self.head_dim])
         q, k, v = paddle.split(qkv, 3, axis=-1)  # [b, s, nh, hd] each
+        new_cache = None
+        if cache is not None:
+            # incremental decode: prepend cached K/V; causality against the
+            # full prefix comes from the unequal-length causal mask
+            ck, cv = cache
+            if ck is not None:
+                k = paddle.concat([ck, k], axis=1)
+                v = paddle.concat([cv, v], axis=1)
+            new_cache = (k, v)
         out = _attention(q, k, v, self._cfg, self.attn_dropout_p, self.training)
         out = paddle.reshape(out, [b, s, h])
-        return self.out_proj(out)
+        out = self.out_proj(out)
+        if cache is not None:
+            return out, new_cache
+        return out
 
 
 class GPTMLP(nn.Layer):
@@ -181,7 +193,12 @@ class GPTDecoderLayer(nn.Layer):
         self.dropout = nn.Dropout(cfg.hidden_dropout)
         self._cfg = cfg
 
-    def forward(self, x):
+    def forward(self, x, cache=None):
+        if cache is not None:
+            a, new_cache = self.attn(self.ln_1(x), cache)
+            x = x + self.dropout(a)
+            x = x + self.dropout(self.mlp(self.ln_2(x)))
+            return _seq_constrain(x, self._cfg), new_cache
         x = x + self.dropout(self.attn(self.ln_1(x)))
         x = x + self.dropout(self.mlp(self.ln_2(x)))
         return _seq_constrain(x, self._cfg)
@@ -195,8 +212,14 @@ class GPTModel(nn.Layer):
         self.h = nn.LayerList([GPTDecoderLayer(cfg) for _ in range(cfg.num_layers)])
         self.ln_f = nn.LayerNorm(cfg.hidden_size, epsilon=cfg.layer_norm_eps)
 
-    def forward(self, input_ids, position_ids=None):
+    def forward(self, input_ids, position_ids=None, caches=None):
         h = self.embeddings(input_ids, position_ids)
+        if caches is not None:
+            new_caches = []
+            for blk, c in zip(self.h, caches):
+                h, nc = blk(h, c)
+                new_caches.append(nc)
+            return self.ln_f(h), new_caches
         for blk in self.h:
             h = blk(h)
         return self.ln_f(h)
@@ -215,12 +238,27 @@ class GPTForCausalLM(nn.Layer):
                 cfg.hidden_size, cfg.vocab_size, has_bias=False,
                 gather_output=False)
 
-    def forward(self, input_ids, position_ids=None):
-        h = self.gpt(input_ids, position_ids)
+    def forward(self, input_ids, position_ids=None, caches=None):
+        if caches is not None:
+            h, new_caches = self.gpt(input_ids, position_ids, caches)
+        else:
+            h = self.gpt(input_ids, position_ids)
         if self.config.tie_word_embeddings:
             w = self.gpt.embeddings.word_embeddings.weight  # [V, H] mp-sharded on V
-            return paddle.matmul(h, w, transpose_y=True)
-        return self.lm_head(h)
+            logits = paddle.matmul(h, w, transpose_y=True)
+        else:
+            logits = self.lm_head(h)
+        if caches is not None:
+            return logits, new_caches
+        return logits
+
+    def generate(self, input_ids, max_new_tokens=32, temperature=1.0,
+                 top_k=0, eos_token_id=None, seed=None):
+        from paddle_tpu.models.generation import greedy_or_sample
+
+        return greedy_or_sample(self, input_ids, self.config.num_layers,
+                                max_new_tokens, temperature, top_k,
+                                eos_token_id, seed)
 
 
 class GPTPretrainingCriterion(nn.Layer):

@@ -99,7 +99,7 @@ class LlamaAttention(nn.Layer):
                                         input_is_parallel=True)
         self._cfg = cfg
 
-    def forward(self, hidden, position_ids=None):
+    def forward(self, hidden, position_ids=None, cache=None):
         b, s, _ = hidden.shape
         q = paddle.reshape(self.q_proj(hidden), [b, s, self.num_heads,
                                                  self.head_dim])
@@ -109,13 +109,24 @@ class LlamaAttention(nn.Layer):
                                                  self.head_dim])
         q, k, _ = IF.fused_rotary_position_embedding(
             q, k, position_ids=position_ids, rotary_emb_base=self.rope_base)
+        new_cache = None
+        if cache is not None:
+            # cached K/V are already rotated for their absolute positions
+            ck, cv = cache
+            if ck is not None:
+                k = paddle.concat([ck, k], axis=1)
+                v = paddle.concat([cv, v], axis=1)
+            new_cache = (k, v)
         if self.num_kv_heads != self.num_heads:
             rep = self.num_heads // self.num_kv_heads
             k = paddle.repeat_interleave(k, rep, axis=2)
             v = paddle.repeat_interleave(v, rep, axis=2)
         out = _attention(q, k, v, self._cfg)
         out = paddle.reshape(out, [b, s, self.num_heads * self.head_dim])
-        return self.o_proj(out)
+        out = self.o_proj(out)
+        if cache is not None:
+            return out, new_cache
+        return out
 
 
 class LlamaMLP(nn.Layer):
@@ -145,7 +156,13 @@ class LlamaDecoderLayer(nn.Layer):
         self.mlp = LlamaMLP(cfg)
         self._cfg = cfg
 
-    def forward(self, x, position_ids=None):
+    def forward(self, x, position_ids=None, cache=None):
+        if cache is not None:
+            a, new_cache = self.self_attn(
+                self.input_layernorm(x), position_ids, cache)
+            x = x + a
+            x = x + self.mlp(self.post_attention_layernorm(x))
+            return _seq_constrain(x, self._cfg), new_cache
         x = x + self.self_attn(self.input_layernorm(x), position_ids)
         x = x + self.mlp(self.post_attention_layernorm(x))
         return _seq_constrain(x, self._cfg)
@@ -163,12 +180,18 @@ class LlamaModel(nn.Layer):
             [LlamaDecoderLayer(cfg) for _ in range(cfg.num_layers)])
         self.norm = LlamaRMSNorm(cfg.hidden_size, cfg.rms_norm_eps)
 
-    def forward(self, input_ids, position_ids=None):
+    def forward(self, input_ids, position_ids=None, caches=None):
         if input_ids.shape[-1] > self.config.max_position_embeddings:
             raise ValueError(
                 f"sequence length {input_ids.shape[-1]} exceeds "
                 f"max_position_embeddings {self.config.max_position_embeddings}")
         h = _seq_constrain(self.embed_tokens(input_ids), self.config)
+        if caches is not None:
+            new_caches = []
+            for layer, c in zip(self.layers, caches):
+                h, nc = layer(h, position_ids, c)
+                new_caches.append(nc)
+            return self.norm(h), new_caches
         for layer in self.layers:
             h = layer(h, position_ids)
         return self.norm(h)
@@ -186,12 +209,27 @@ class LlamaForCausalLM(nn.Layer):
                 cfg.hidden_size, cfg.vocab_size, has_bias=False,
                 gather_output=False)
 
-    def forward(self, input_ids, position_ids=None):
-        h = self.llama(input_ids, position_ids)
+    def forward(self, input_ids, position_ids=None, caches=None):
+        if caches is not None:
+            h, new_caches = self.llama(input_ids, position_ids, caches)
+        else:
+            h = self.llama(input_ids, position_ids)
         if self.lm_head is None:
             w = self.llama.embed_tokens.weight
-            return paddle.matmul(h, w, transpose_y=True)
-        return self.lm_head(h)
+            logits = paddle.matmul(h, w, transpose_y=True)
+        else:
+            logits = self.lm_head(h)
+        if caches is not None:
+            return logits, new_caches
+        return logits
+
+    def generate(self, input_ids, max_new_tokens=32, temperature=1.0,
+                 top_k=0, eos_token_id=None, seed=None):
+        from paddle_tpu.models.generation import greedy_or_sample
+
+        return greedy_or_sample(self, input_ids, self.config.num_layers,
+                                max_new_tokens, temperature, top_k,
+                                eos_token_id, seed)
 
 
 LlamaPretrainingCriterion = GPTPretrainingCriterion
