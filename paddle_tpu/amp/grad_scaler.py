@@ -83,6 +83,13 @@ class GradScaler:
         self._found_inf = False
         self._unscaled = False
 
+    def _update_from_found_inf(self, found_inf: bool):
+        """Dynamic-scale update driven by a jit-computed finiteness flag
+        (jit.TrainStep performs scale/unscale/skip inside the compiled
+        step and reports the outcome here)."""
+        self._found_inf = bool(found_inf)
+        self.update()
+
     def minimize(self, optimizer, scaled_loss):
         scaled_loss.backward()
         self.step(optimizer)

@@ -123,10 +123,34 @@ class TrainStep:
     """
 
     def __init__(self, model: Layer, loss_fn: Callable, optimizer,
-                 donate: bool = True):
+                 donate: bool = True, scaler=None):
         self._model = model
         self._loss_fn = loss_fn
         self._opt = optimizer
+        # amp.GradScaler: loss scaling + skip-on-inf + dynamic scale update,
+        # all inside the compiled step (the reference's scaler.step path).
+        # Scale/good/bad counters live as DEVICE arrays updated in-graph so
+        # the hot loop never syncs to host; the scaler object reads them
+        # lazily through get_loss_scaling().
+        self._scaler = scaler if (scaler is not None and
+                                  scaler.is_enable()) else None
+        if self._scaler is not None:
+            s = self._scaler
+            self._scaler_state = (
+                jnp.asarray(s.get_loss_scaling(), jnp.float32),
+                jnp.asarray(s._good_steps, jnp.int32),
+                jnp.asarray(s._bad_steps, jnp.int32),
+            )
+            step_self = self
+
+            def _lazy_scale():
+                sc, good, bad = step_self._scaler_state
+                s._scale = float(sc)
+                s._good_steps = int(good)
+                s._bad_steps = int(bad)
+                return s._scale
+
+            s.get_loss_scaling = _lazy_scale
         self._params = [p for p in optimizer._parameter_list if p.trainable]
         # eager state init so shapes are known before trace; master weights
         # (multi_precision) materialize here so the jitted step carries them
@@ -137,7 +161,7 @@ class TrainStep:
         self._jitted = jax.jit(self._step, donate_argnums=donate_argnums)
 
     def _step(self, param_vals, opt_states, master_vals, buffer_vals,
-              batch_vals, lr, key):
+              batch_vals, lr, key, scale=None):
         params = self._params
         _, buffers_dict = collect_state(self._model)
         buffers = [b for b in buffers_dict.values() if b is not None]
@@ -148,10 +172,39 @@ class TrainStep:
                 p._grad = None
                 p.stop_gradient = False
             loss = self._loss_fn(self._model, *args)
-            loss.backward()
+            if scale is not None:
+                (loss * scale[0].astype(loss.dtype)).backward()
+            else:
+                loss.backward()
             grads = [p._grad for p in params]
             new_buffer_vals = [b._value for b in buffers]
             loss_val = loss._value
+        found_inf = None
+        new_scaler_state = None
+        if scale is not None:
+            scale_v, good, bad = scale
+            # unscale + joint finiteness check (scaler.unscale_ semantics)
+            inv = (1.0 / scale_v).astype(jnp.float32)
+            grads = [None if g is None else g.astype(jnp.float32) * inv
+                     for g in grads]
+            finite = jnp.asarray(True)
+            for g in grads:
+                if g is not None:
+                    finite = jnp.logical_and(finite,
+                                             jnp.all(jnp.isfinite(g)))
+            found_inf = jnp.logical_not(finite)
+            # dynamic scale update, in-graph (GradScaler.update semantics)
+            s = self._scaler
+            bad2 = jnp.where(found_inf, bad + 1, 0)
+            good2 = jnp.where(found_inf, 0, good + 1)
+            dec = bad2 >= s._decr_every_n
+            inc = good2 >= s._incr_every_n_steps
+            scale2 = jnp.where(
+                dec, jnp.maximum(scale_v * s._decr_ratio, 1.0),
+                jnp.where(inc, scale_v * s._incr_ratio, scale_v))
+            new_scaler_state = (scale2,
+                                jnp.where(inc, 0, good2).astype(jnp.int32),
+                                jnp.where(dec, 0, bad2).astype(jnp.int32))
         # grad clip (pure, works on tracers)
         if self._opt._grad_clip is not None:
             grads = self._opt._grad_clip._clip_arrays(grads)
@@ -168,6 +221,11 @@ class TrainStep:
                 target, g.astype(target.dtype), lr, st,
                 self._opt._decay_for(p)
             )
+            if found_inf is not None:
+                # skip the whole update on non-finite grads (scaler.step)
+                np_ = jnp.where(found_inf, target, np_)
+                ns = jax.tree_util.tree_map(
+                    lambda new, old: jnp.where(found_inf, old, new), ns, st)
             if mv is not None:  # update fp32 master, cast back to param dtype
                 new_masters.append(np_)
                 new_params.append(np_.astype(pv.dtype))
@@ -175,7 +233,8 @@ class TrainStep:
                 new_masters.append(None)
                 new_params.append(np_)
             new_states.append(ns)
-        return loss_val, new_params, new_states, new_masters, new_buffer_vals
+        return (loss_val, new_params, new_states, new_masters,
+                new_buffer_vals, new_scaler_state)
 
     def __call__(self, *batch):
         params = self._params
@@ -188,11 +247,12 @@ class TrainStep:
         batch_vals = tree_unwrap(batch)
         lr = jnp.asarray(self._opt.get_lr(), jnp.float32)
         key = rng.next_key()
-        loss_val, new_params, new_states, new_masters, new_buffer_vals = \
-            self._jitted(
-                param_vals, opt_states, master_vals, buffer_vals, batch_vals,
-                lr, key
-            )
+        scale = self._scaler_state if self._scaler is not None else None
+        (loss_val, new_params, new_states, new_masters, new_buffer_vals,
+         new_scaler_state) = self._jitted(
+            param_vals, opt_states, master_vals, buffer_vals, batch_vals,
+            lr, key, scale
+        )
         for p, v in zip(params, new_params):
             p._replace_value(v)
         for p, st in zip(params, new_states):
@@ -203,6 +263,8 @@ class TrainStep:
         for b, v in zip(buffers, new_buffer_vals):
             b._replace_value(v)
         self._opt._step_count += 1
+        if new_scaler_state is not None:
+            self._scaler_state = new_scaler_state  # device-side, no sync
         if hasattr(self._opt._lr, "step"):
             pass  # caller drives scheduler.step() as in paddle
         return Tensor._from_value(loss_val)

@@ -194,14 +194,14 @@ class GPTDecoderLayer(nn.Layer):
         self._cfg = cfg
 
     def forward(self, x, cache=None):
+        a = self.attn(self.ln_1(x), cache)
+        new_cache = None
         if cache is not None:
-            a, new_cache = self.attn(self.ln_1(x), cache)
-            x = x + self.dropout(a)
-            x = x + self.dropout(self.mlp(self.ln_2(x)))
-            return _seq_constrain(x, self._cfg), new_cache
-        x = x + self.dropout(self.attn(self.ln_1(x)))
+            a, new_cache = a
+        x = x + self.dropout(a)
         x = x + self.dropout(self.mlp(self.ln_2(x)))
-        return _seq_constrain(x, self._cfg)
+        x = _seq_constrain(x, self._cfg)
+        return (x, new_cache) if cache is not None else x
 
 
 class GPTModel(nn.Layer):
@@ -214,15 +214,15 @@ class GPTModel(nn.Layer):
 
     def forward(self, input_ids, position_ids=None, caches=None):
         h = self.embeddings(input_ids, position_ids)
-        if caches is not None:
-            new_caches = []
-            for blk, c in zip(self.h, caches):
-                h, nc = blk(h, c)
+        new_caches = [] if caches is not None else None
+        for i, blk in enumerate(self.h):
+            if caches is not None:
+                h, nc = blk(h, caches[i])
                 new_caches.append(nc)
-            return self.ln_f(h), new_caches
-        for blk in self.h:
-            h = blk(h)
-        return self.ln_f(h)
+            else:
+                h = blk(h)
+        h = self.ln_f(h)
+        return (h, new_caches) if caches is not None else h
 
 
 class GPTForCausalLM(nn.Layer):

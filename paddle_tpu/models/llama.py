@@ -157,15 +157,14 @@ class LlamaDecoderLayer(nn.Layer):
         self._cfg = cfg
 
     def forward(self, x, position_ids=None, cache=None):
+        a = self.self_attn(self.input_layernorm(x), position_ids, cache)
+        new_cache = None
         if cache is not None:
-            a, new_cache = self.self_attn(
-                self.input_layernorm(x), position_ids, cache)
-            x = x + a
-            x = x + self.mlp(self.post_attention_layernorm(x))
-            return _seq_constrain(x, self._cfg), new_cache
-        x = x + self.self_attn(self.input_layernorm(x), position_ids)
+            a, new_cache = a
+        x = x + a
         x = x + self.mlp(self.post_attention_layernorm(x))
-        return _seq_constrain(x, self._cfg)
+        x = _seq_constrain(x, self._cfg)
+        return (x, new_cache) if cache is not None else x
 
 
 class LlamaModel(nn.Layer):
@@ -186,15 +185,15 @@ class LlamaModel(nn.Layer):
                 f"sequence length {input_ids.shape[-1]} exceeds "
                 f"max_position_embeddings {self.config.max_position_embeddings}")
         h = _seq_constrain(self.embed_tokens(input_ids), self.config)
-        if caches is not None:
-            new_caches = []
-            for layer, c in zip(self.layers, caches):
-                h, nc = layer(h, position_ids, c)
+        new_caches = [] if caches is not None else None
+        for i, layer in enumerate(self.layers):
+            if caches is not None:
+                h, nc = layer(h, position_ids, caches[i])
                 new_caches.append(nc)
-            return self.norm(h), new_caches
-        for layer in self.layers:
-            h = layer(h, position_ids)
-        return self.norm(h)
+            else:
+                h = layer(h, position_ids)
+        h = self.norm(h)
+        return (h, new_caches) if caches is not None else h
 
 
 class LlamaForCausalLM(nn.Layer):

@@ -25,3 +25,22 @@ from paddle_tpu.vision.models.mobilenet import (  # noqa: F401
     mobilenet_v1,
     mobilenet_v2,
 )
+from paddle_tpu.vision.models.densenet import (  # noqa: F401
+    DenseNet,
+    densenet121,
+    densenet161,
+    densenet169,
+    densenet201,
+)
+from paddle_tpu.vision.models.small_nets import (  # noqa: F401
+    GoogLeNet,
+    ShuffleNetV2,
+    SqueezeNet,
+    googlenet,
+    shufflenet_v2_x0_5,
+    shufflenet_v2_x1_0,
+    shufflenet_v2_x1_5,
+    shufflenet_v2_x2_0,
+    squeezenet1_0,
+    squeezenet1_1,
+)

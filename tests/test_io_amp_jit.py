@@ -102,3 +102,41 @@ def test_to_static_function_decorator():
     a = paddle.to_tensor(np.ones((2, 3), dtype=np.float32))
     b = paddle.to_tensor(np.ones((3, 2), dtype=np.float32))
     np.testing.assert_allclose(f(a, b).numpy(), np.full((2, 2), 4.0))
+
+
+def test_trainstep_with_gradscaler_skip_and_rescale(rng):
+    import paddle_tpu.optimizer as opt
+    from paddle_tpu.jit.api import TrainStep
+
+    paddle.seed(0)
+    model = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 1))
+    optimizer = opt.SGD(learning_rate=1e-2, parameters=model.parameters())
+    scaler = paddle.amp.GradScaler(
+        init_loss_scaling=2.0 ** 10, decr_every_n_nan_or_inf=1,
+        incr_every_n_steps=3)
+    mse = nn.MSELoss()
+    step = TrainStep(model, lambda m, x, y: mse(m(x), y), optimizer,
+                     scaler=scaler)
+    x = paddle.to_tensor(rng.standard_normal((8, 8)).astype(np.float32))
+    y = paddle.to_tensor(rng.standard_normal((8, 1)).astype(np.float32))
+    w0 = model[0].weight.numpy().copy()
+    for _ in range(3):
+        step(x, y)
+    assert not np.allclose(model[0].weight.numpy(), w0)
+    assert scaler.get_loss_scaling() == 2.0 ** 11
+    w1 = model[0].weight.numpy().copy()
+    xbad = paddle.to_tensor(np.full((8, 8), 1e38, np.float32))
+    step(xbad, y)  # inf grads: update skipped, scale halves
+    np.testing.assert_allclose(model[0].weight.numpy(), w1)
+    assert scaler.get_loss_scaling() == 2.0 ** 10
+
+
+def test_vision_zoo_extended_forward(rng):
+    from paddle_tpu.vision import models as M
+
+    x = paddle.to_tensor(rng.standard_normal((1, 3, 64, 64)).astype(np.float32))
+    for ctor in (M.densenet121, M.squeezenet1_1, M.shufflenet_v2_x0_5,
+                 M.googlenet):
+        m = ctor(num_classes=4)
+        m.eval()
+        assert m(x).shape == [1, 4]
