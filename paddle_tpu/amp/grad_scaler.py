@@ -39,12 +39,14 @@ class GradScaler:
     def scale(self, var):
         if not self._enable:
             return var
-        return var * self._scale
+        # get_loss_scaling() is the sync point when a jitted TrainStep holds
+        # the authoritative device-side state
+        return var * self.get_loss_scaling()
 
     def unscale_(self, optimizer):
         if not self._enable or self._unscaled:
             return
-        inv = 1.0 / self._scale
+        inv = 1.0 / self.get_loss_scaling()
         found = False
         for p in optimizer._parameter_list:
             if p._grad is not None:
@@ -83,19 +85,13 @@ class GradScaler:
         self._found_inf = False
         self._unscaled = False
 
-    def _update_from_found_inf(self, found_inf: bool):
-        """Dynamic-scale update driven by a jit-computed finiteness flag
-        (jit.TrainStep performs scale/unscale/skip inside the compiled
-        step and reports the outcome here)."""
-        self._found_inf = bool(found_inf)
-        self.update()
-
     def minimize(self, optimizer, scaled_loss):
         scaled_loss.backward()
         self.step(optimizer)
         self.update()
 
     def state_dict(self):
+        self.get_loss_scaling()  # sync device-side state if a TrainStep owns it
         return {
             "scale": self._scale,
             "incr_ratio": self._incr_ratio,

@@ -11,6 +11,7 @@ built — see paddle_tpu/lib/).
 from paddle_tpu.io.dataset import (  # noqa: F401
     ChainDataset,
     ComposeDataset,
+    ConcatDataset,
     Dataset,
     IterableDataset,
     Subset,

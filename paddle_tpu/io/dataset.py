@@ -117,3 +117,33 @@ def random_split(dataset, lengths, generator=None):
         out.append(Subset(dataset, indices[offset:offset + l]))
         offset += l
     return out
+
+
+class ConcatDataset(Dataset):
+    """paddle.io.ConcatDataset parity."""
+
+    def __init__(self, datasets):
+        self.datasets = list(datasets)
+        if not self.datasets:
+            raise ValueError("ConcatDataset needs at least one dataset")
+        self._sizes = [len(d) for d in self.datasets]
+        self._offsets = []
+        total = 0
+        for s in self._sizes:
+            self._offsets.append(total)
+            total += s
+        self._total = total
+
+    def __getitem__(self, idx):
+        orig = idx
+        if idx < 0:
+            idx += self._total
+        if idx < 0 or idx >= self._total:
+            raise IndexError(orig)
+        for d, off, size in zip(self.datasets, self._offsets, self._sizes):
+            if idx < off + size:
+                return d[idx - off]
+        raise IndexError(orig)
+
+    def __len__(self):
+        return self._total

@@ -1002,3 +1002,208 @@ def sequence_mask(x, maxlen=None, dtype="int64", name=None):
             x, differentiable=False,
         )
     return apply("sequence_mask", f, x, differentiable=False)
+
+
+def pairwise_distance(x, y, p=2.0, epsilon=1e-6, keepdim=False, name=None):
+    """F.pairwise_distance parity."""
+
+    def f(a, b):
+        d = a - b + epsilon  # paddle/torch: ||x - y + eps||_p
+        out = jnp.sum(jnp.abs(d) ** p, axis=-1) ** (1.0 / p)
+        return out[..., None] if keepdim else out
+
+    return apply("pairwise_distance", f, x, y)
+
+
+def huber_loss(input, label, delta=1.0, reduction="mean", name=None):
+    """F.smooth_l1/huber loss parity (quadratic near zero, linear beyond)."""
+
+    def f(i, l):
+        d = jnp.abs(i - l)
+        loss = jnp.where(d <= delta, 0.5 * d * d, delta * (d - 0.5 * delta))
+        if reduction == "mean":
+            return jnp.mean(loss)
+        if reduction == "sum":
+            return jnp.sum(loss)
+        return loss
+
+    return apply("huber_loss", f, input, label)
+
+
+def poisson_nll_loss(input, label, log_input=True, full=False, epsilon=1e-8,
+                     reduction="mean", name=None):
+    """F.poisson_nll_loss parity."""
+
+    def f(i, l):
+        if log_input:
+            loss = jnp.exp(i) - l * i
+        else:
+            loss = i - l * jnp.log(i + epsilon)
+        if full:
+            stirling = l * jnp.log(l + epsilon) - l + \
+                0.5 * jnp.log(2 * jnp.pi * (l + epsilon))
+            loss = loss + jnp.where(l > 1, stirling, 0.0)
+        if reduction == "mean":
+            return jnp.mean(loss)
+        if reduction == "sum":
+            return jnp.sum(loss)
+        return loss
+
+    return apply("poisson_nll_loss", f, input, label)
+
+
+def affine_grid(theta, out_shape, align_corners=True, name=None):
+    """F.affine_grid parity: theta [N, 2, 3] -> grid [N, H, W, 2]."""
+    if hasattr(out_shape, "numpy"):
+        out_shape = [int(v) for v in out_shape.numpy()]
+    N, C, H, W = out_shape
+
+    def f(th):
+        if align_corners:
+            ys = jnp.linspace(-1.0, 1.0, H)
+            xs = jnp.linspace(-1.0, 1.0, W)
+        else:
+            ys = (jnp.arange(H) + 0.5) * 2.0 / H - 1.0
+            xs = (jnp.arange(W) + 0.5) * 2.0 / W - 1.0
+        gy, gx = jnp.meshgrid(ys, xs, indexing="ij")
+        base = jnp.stack([gx, gy, jnp.ones_like(gx)], axis=-1)  # [H, W, 3]
+        return jnp.einsum("nij,hwj->nhwi", th, base)  # [N, H, W, 2]
+
+    return apply("affine_grid", f, theta)
+
+
+def grid_sample(x, grid, mode="bilinear", padding_mode="zeros",
+                align_corners=True, name=None):
+    """F.grid_sample parity: x [N,C,H,W], grid [N,Hg,Wg,2] in [-1,1]."""
+
+    def f(xa, g):
+        N, C, H, W = xa.shape
+        gx, gy = g[..., 0], g[..., 1]
+        if align_corners:
+            fx = (gx + 1) * (W - 1) / 2
+            fy = (gy + 1) * (H - 1) / 2
+        else:
+            fx = ((gx + 1) * W - 1) / 2
+            fy = ((gy + 1) * H - 1) / 2
+
+        def gather2d(ix, iy):
+            ixc = jnp.clip(ix, 0, W - 1)
+            iyc = jnp.clip(iy, 0, H - 1)
+            out = xa[jnp.arange(N)[:, None, None], :, iyc, ixc]  # [N,Hg,Wg,C]
+            if padding_mode == "zeros":
+                valid = ((ix >= 0) & (ix < W) & (iy >= 0) &
+                         (iy < H))[..., None]
+                out = jnp.where(valid, out, 0.0)
+            return out
+
+        if mode == "nearest":
+            out = gather2d(jnp.round(fx).astype(jnp.int32),
+                           jnp.round(fy).astype(jnp.int32))
+        else:
+            x0 = jnp.floor(fx).astype(jnp.int32)
+            y0 = jnp.floor(fy).astype(jnp.int32)
+            wx = (fx - x0)[..., None]
+            wy = (fy - y0)[..., None]
+            out = (gather2d(x0, y0) * (1 - wx) * (1 - wy)
+                   + gather2d(x0 + 1, y0) * wx * (1 - wy)
+                   + gather2d(x0, y0 + 1) * (1 - wx) * wy
+                   + gather2d(x0 + 1, y0 + 1) * wx * wy)
+        return jnp.moveaxis(out, -1, 1)  # [N,C,Hg,Wg]
+
+    return apply("grid_sample", f, x, grid)
+
+
+def fold(x, output_sizes, kernel_sizes, strides=1, paddings=0, dilations=1,
+         name=None):
+    """F.fold parity: [N, C*kh*kw, L] col buffer -> [N, C, H, W] (sum of
+    overlapping patches — the inverse of unfold)."""
+    kh, kw = (kernel_sizes if isinstance(kernel_sizes, (list, tuple))
+              else (kernel_sizes, kernel_sizes))
+    sh, sw = (strides if isinstance(strides, (list, tuple))
+              else (strides, strides))
+    ph, pw = (paddings if isinstance(paddings, (list, tuple))
+              else (paddings, paddings))
+    dh, dw = (dilations if isinstance(dilations, (list, tuple))
+              else (dilations, dilations))
+    H, W = output_sizes
+
+    def f(col):
+        N, ckk, L = col.shape
+        C = ckk // (kh * kw)
+        eff_kh = dh * (kh - 1) + 1
+        eff_kw = dw * (kw - 1) + 1
+        n_h = (H + 2 * ph - eff_kh) // sh + 1
+        n_w = (W + 2 * pw - eff_kw) // sw + 1
+        col = col.reshape(N, C, kh, kw, n_h, n_w)
+        out = jnp.zeros((N, C, H + 2 * ph, W + 2 * pw), col.dtype)
+        for i in range(kh):
+            for j in range(kw):
+                ys = i * dh + sh * jnp.arange(n_h)
+                xs = j * dw + sw * jnp.arange(n_w)
+                out = out.at[:, :, ys[:, None], xs[None, :]].add(
+                    col[:, :, i, j])
+        return out[:, :, ph:ph + H, pw:pw + W]
+
+    return apply("fold", f, x)
+
+
+def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
+             reduction="mean", norm_by_times=False, name=None):
+    """F.ctc_loss parity (phi warpctc kernel analogue): the standard CTC
+    alpha recursion in log space as a lax.scan over time."""
+
+    def f(lp, lab, in_len, lab_len):
+        # paddle layout: log_probs [T, B, V] (logsoftmax'd), labels [B, S]
+        T, B, V = lp.shape
+        S = lab.shape[1]
+        ext = 2 * S + 1  # blank-interleaved target length
+        NEG = -1e30
+
+        lab = lab.astype(jnp.int32)
+        # extended label sequence: blank, l1, blank, l2, ... blank
+        ext_labels = jnp.full((B, ext), blank, jnp.int32)
+        ext_labels = ext_labels.at[:, 1::2].set(lab)
+        # can skip from s-2 to s when the ext label differs and is not blank
+        skip = jnp.concatenate(
+            [jnp.zeros((B, 2), bool),
+             ext_labels[:, 2:] != ext_labels[:, :-2]], axis=1)
+        can_skip = skip & (ext_labels != blank)
+
+        def emit(t):
+            # [B, ext] log prob of each extended label at time t
+            return jnp.take_along_axis(lp[t], ext_labels, axis=1)
+
+        alpha0 = jnp.full((B, ext), NEG)
+        alpha0 = alpha0.at[:, 0].set(emit(0)[:, 0])
+        alpha0 = alpha0.at[:, 1].set(jnp.where(S > 0, emit(0)[:, 1], NEG))
+
+        def step(alpha, t):
+            a_prev1 = jnp.concatenate(
+                [jnp.full((B, 1), NEG), alpha[:, :-1]], axis=1)
+            a_prev2 = jnp.concatenate(
+                [jnp.full((B, 2), NEG), alpha[:, :-2]], axis=1)
+            a_prev2 = jnp.where(can_skip, a_prev2, NEG)
+            merged = jnp.logaddexp(jnp.logaddexp(alpha, a_prev1), a_prev2)
+            new = merged + emit(t)
+            # freeze past each sequence's input length
+            active = (t < in_len)[:, None]
+            return jnp.where(active, new, alpha), None
+
+        alpha, _ = jax.lax.scan(step, alpha0, jnp.arange(1, T))
+        # total prob: last blank or last label position, per true lab_len
+        last = 2 * lab_len.astype(jnp.int32)  # index of final blank
+        ll_final = jnp.take_along_axis(alpha, last[:, None], axis=1)[:, 0]
+        ll_label = jnp.take_along_axis(
+            alpha, jnp.maximum(last - 1, 0)[:, None], axis=1)[:, 0]
+        nll = -jnp.logaddexp(ll_final,
+                             jnp.where(lab_len > 0, ll_label, NEG))
+        if norm_by_times:
+            nll = nll / jnp.maximum(in_len.astype(nll.dtype), 1.0)
+        if reduction == "mean":
+            return jnp.mean(nll / jnp.maximum(lab_len.astype(nll.dtype), 1.0))
+        if reduction == "sum":
+            return jnp.sum(nll)
+        return nll
+
+    return apply("ctc_loss", f, log_probs, labels, input_lengths,
+                 label_lengths)

@@ -605,3 +605,39 @@ def shard_index(input, index_num, nshards, shard_id, ignore_value=-1):
         return jnp.where(shard == shard_id, local, ignore_value)
 
     return apply("shard_index", f, input, differentiable=False)
+
+
+@register_op("diff", category="manipulation")
+def diff(x, n=1, axis=-1, prepend=None, append=None, name=None):
+    args = [x]
+    if prepend is not None:
+        args.append(prepend)
+    if append is not None:
+        args.append(append)
+
+    def f(a, *rest):
+        it = iter(rest)
+        pre = next(it) if prepend is not None else None
+        app = next(it) if append is not None else None
+        return jnp.diff(a, n=n, axis=axis, prepend=pre, append=app)
+
+    return apply("diff", f, *args)
+
+
+@register_op("unfold", category="manipulation")
+def unfold(x, axis, size, step, name=None):
+    """paddle.unfold (tensor sliding windows along axis)."""
+
+    def f(a):
+        ax = axis % a.ndim
+        length = a.shape[ax]
+        n_windows = (length - size) // step + 1
+        idx = jnp.arange(n_windows)[:, None] * step + jnp.arange(size)[None, :]
+        out = jnp.take(a, idx.reshape(-1), axis=ax)
+        shape = list(a.shape)
+        shape[ax:ax + 1] = [n_windows, size]
+        out = out.reshape(shape)
+        # paddle puts the window dim last
+        return jnp.moveaxis(out, ax + 1, -1)
+
+    return apply("unfold", f, x)

@@ -281,3 +281,40 @@ def allclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
         y,
         differentiable=False,
     )
+
+
+@register_op("trapezoid", category="math")
+def trapezoid(y, x=None, dx=None, axis=-1, name=None):
+    if x is not None:
+        return apply("trapezoid",
+                     lambda yv, xv: jnp.trapezoid(yv, xv, axis=axis), y, x)
+    return apply("trapezoid",
+                 lambda yv: jnp.trapezoid(yv, dx=dx or 1.0, axis=axis), y)
+
+
+@register_op("renorm", category="math")
+def renorm(x, p, axis, max_norm, name=None):
+    def f(a):
+        ax = axis % a.ndim
+        dims = tuple(i for i in range(a.ndim) if i != ax)
+        norms = jnp.sum(jnp.abs(a) ** p, axis=dims, keepdims=True) ** (1.0 / p)
+        factor = jnp.where(norms > max_norm, max_norm / (norms + 1e-7), 1.0)
+        return a * factor
+
+    return apply("renorm", f, x)
+
+
+@register_op("cdist", category="math")
+def cdist(x, y, p=2.0, compute_mode="use_mm_for_euclid_dist_if_necessary",
+          name=None):
+    def f(a, b):
+        if p == 2.0:
+            # (a-b)^2 = a^2 + b^2 - 2ab: one matmul instead of a broadcast
+            a2 = jnp.sum(a * a, -1, keepdims=True)
+            b2 = jnp.sum(b * b, -1, keepdims=True)
+            sq = a2 + jnp.swapaxes(b2, -1, -2) - 2 * (a @ jnp.swapaxes(b, -1, -2))
+            return jnp.sqrt(jnp.maximum(sq, 0.0))
+        d = jnp.abs(a[..., :, None, :] - b[..., None, :, :])
+        return jnp.sum(d ** p, axis=-1) ** (1.0 / p)
+
+    return apply("cdist", f, x, y)

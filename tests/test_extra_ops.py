@@ -1,0 +1,127 @@
+"""Late-round op additions: diff/trapezoid/unfold/renorm/cdist,
+grid_sample/affine_grid/fold, huber/poisson-nll/pairwise, CTC loss
+(reference patterns: test_ctc_loss_op.py brute-force small cases,
+test_grid_sample_op.py identity transforms)."""
+
+import itertools
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+
+
+def test_diff_trapezoid(rng):
+    x = paddle.to_tensor(np.array([1.0, 3.0, 6.0, 10.0], np.float32))
+    np.testing.assert_allclose(paddle.diff(x).numpy(), [2.0, 3.0, 4.0])
+    np.testing.assert_allclose(
+        float(paddle.trapezoid(x).numpy()),
+        np.trapezoid([1.0, 3.0, 6.0, 10.0]))
+
+
+def test_unfold_windows():
+    u = paddle.unfold(
+        paddle.to_tensor(np.arange(10.0, dtype=np.float32)), 0, 4, 2)
+    assert u.shape == [4, 4]
+    np.testing.assert_allclose(u.numpy()[0], [0, 1, 2, 3])
+    np.testing.assert_allclose(u.numpy()[-1], [6, 7, 8, 9])
+
+
+def test_renorm_caps_norms(rng):
+    x = paddle.to_tensor(rng.standard_normal((4, 8)).astype(np.float32) * 10)
+    out = paddle.renorm(x, 2.0, 0, 1.0).numpy()
+    norms = np.linalg.norm(out, axis=1)
+    assert (norms <= 1.0 + 1e-4).all()
+
+
+def test_cdist_euclidean(rng):
+    a = rng.standard_normal((3, 4)).astype(np.float32)
+    b = rng.standard_normal((5, 4)).astype(np.float32)
+    d = paddle.cdist(paddle.to_tensor(a), paddle.to_tensor(b)).numpy()
+    ref = np.sqrt(((a[:, None] - b[None]) ** 2).sum(-1))
+    np.testing.assert_allclose(d, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_grid_sample_identity(rng):
+    img = paddle.to_tensor(rng.standard_normal((1, 2, 5, 5)).astype(np.float32))
+    theta = paddle.to_tensor(
+        np.array([[[1.0, 0, 0], [0, 1.0, 0]]], np.float32))
+    grid = F.affine_grid(theta, [1, 2, 5, 5])
+    out = F.grid_sample(img, grid)
+    np.testing.assert_allclose(out.numpy(), img.numpy(), rtol=1e-4, atol=1e-5)
+
+
+def test_grid_sample_shift_zeros_padding(rng):
+    img = paddle.to_tensor(np.ones((1, 1, 4, 4), np.float32))
+    # shift fully out of bounds -> zeros under zeros padding
+    theta = paddle.to_tensor(
+        np.array([[[1.0, 0, 4.0], [0, 1.0, 4.0]]], np.float32))
+    grid = F.affine_grid(theta, [1, 1, 4, 4])
+    out = F.grid_sample(img, grid)
+    np.testing.assert_allclose(out.numpy(), 0.0)
+
+
+def test_fold_inverts_sum_of_patches():
+    # non-overlapping 2x2 patches: fold reassembles exactly
+    col = np.arange(16, dtype=np.float32).reshape(1, 4, 4)  # C=1, kh*kw=4, L=4
+    out = F.fold(paddle.to_tensor(col), (4, 4), 2, strides=2).numpy()
+    assert out.shape == (1, 1, 4, 4)
+    # patch L ordering: row-major over output grid
+    np.testing.assert_allclose(out[0, 0, :2, :2],
+                               col[0, :, 0].reshape(2, 2))
+
+
+def test_huber_and_poisson_losses(rng):
+    x = paddle.to_tensor(np.array([0.1, 2.0], np.float32))
+    y = paddle.to_tensor(np.array([0.0, 0.0], np.float32))
+    h = float(F.huber_loss(x, y, delta=1.0, reduction="none").numpy()[1])
+    assert abs(h - (2.0 - 0.5)) < 1e-5  # linear branch: delta*(|d|-delta/2)
+    p = F.poisson_nll_loss(paddle.to_tensor(np.array([0.5], np.float32)),
+                           paddle.to_tensor(np.array([2.0], np.float32)))
+    np.testing.assert_allclose(float(p.numpy()),
+                               np.exp(0.5) - 2 * 0.5, rtol=1e-5)
+
+
+def test_ctc_loss_matches_brute_force():
+    T, V = 3, 3
+    lp = np.log(np.full((T, 1, V), 1 / V, np.float32))
+    for target in ([1], [1, 2], [2]):
+        S = len(target)
+        lab = np.zeros((1, 2), np.int64)
+        lab[0, :S] = target
+        loss = F.ctc_loss(
+            paddle.to_tensor(lp), paddle.to_tensor(lab),
+            paddle.to_tensor(np.array([T])),
+            paddle.to_tensor(np.array([S])), reduction="none")
+        p = 0.0
+        for path in itertools.product(range(V), repeat=T):
+            col = [k for k, g in itertools.groupby(path) if k != 0]
+            if col == target:
+                p += (1 / V) ** T
+        np.testing.assert_allclose(float(loss.numpy()[0]), -np.log(p),
+                                   rtol=1e-4)
+
+
+def test_ctc_loss_grad_flows(rng):
+    lp_np = rng.standard_normal((4, 2, 5)).astype(np.float32)
+    lp_np = lp_np - np.log(np.exp(lp_np).sum(-1, keepdims=True))
+    lp = paddle.to_tensor(lp_np, stop_gradient=False)
+    lab = paddle.to_tensor(np.array([[1, 2], [3, 0]], np.int64))
+    loss = F.ctc_loss(lp, lab, paddle.to_tensor(np.array([4, 4])),
+                      paddle.to_tensor(np.array([2, 1])))
+    loss.backward()
+    g = lp.grad.numpy()
+    assert np.isfinite(g).all() and np.abs(g).sum() > 0
+
+
+def test_concat_dataset():
+    from paddle_tpu.io import ConcatDataset, TensorDataset
+
+    a = TensorDataset([paddle.to_tensor(np.arange(3, dtype=np.float32))])
+    b = TensorDataset([paddle.to_tensor(np.arange(10.0, 12.0,
+                                                  dtype=np.float32))])
+    cat = ConcatDataset([a, b])
+    assert len(cat) == 5
+    assert float(cat[0][0].numpy()) == 0.0
+    assert float(cat[3][0].numpy()) == 10.0
