@@ -1,0 +1,180 @@
+"""Second batch of OpTest-harness op tests (conv/pool/norm/embedding/
+reduction/index families)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+from tests.op_test import OpTest
+
+rng = np.random.default_rng(7)
+
+
+class TestConv2DOp(OpTest):
+    op = staticmethod(F.conv2d)
+    attrs = {"stride": 1, "padding": 1}
+    inputs = {
+        "x": rng.standard_normal((2, 3, 8, 8)).astype(np.float32),
+        "weight": rng.standard_normal((4, 3, 3, 3)).astype(np.float32) * 0.2,
+    }
+
+    @staticmethod
+    def ref(x, weight, stride, padding):
+        assert stride == 1  # ref only covers the unit-stride case
+        N, C, H, W = x.shape
+        O, _, kh, kw = weight.shape
+        xp = np.pad(x, ((0, 0), (0, 0), (padding, padding),
+                        (padding, padding)))
+        out = np.zeros((N, O, H, W), np.float32)
+        for i in range(H):
+            for j in range(W):
+                patch = xp[:, :, i:i + kh, j:j + kw]
+                out[:, :, i, j] = np.einsum("nchw,ochw->no", patch, weight)
+        return out
+
+    def test(self):
+        self.check_output(rtol=1e-4, atol=1e-4)
+        self.check_grad(["weight"], rtol=3e-2, atol=3e-2, eps=1e-2)
+
+
+class TestMaxPoolOp(OpTest):
+    op = staticmethod(F.max_pool2d)
+    attrs = {"kernel_size": 2, "stride": 2}
+    inputs = {"x": rng.standard_normal((1, 2, 4, 4)).astype(np.float32)}
+
+    @staticmethod
+    def ref(x, kernel_size, stride):
+        assert kernel_size == stride  # ref only covers the tiled case
+        k = kernel_size
+        N, C, H, W = x.shape
+        return x.reshape(N, C, H // k, k, W // k, k).max((3, 5))
+
+    def test(self):
+        self.check_output()
+        self.check_grad(["x"])
+
+
+class TestEmbeddingOp(OpTest):
+    op = staticmethod(F.embedding)
+    attrs = {}
+    inputs = {
+        "x": np.array([1, 0, 3], np.int64),
+        "weight": rng.standard_normal((5, 4)).astype(np.float32),
+    }
+
+    @staticmethod
+    def ref(x, weight):
+        return weight[x]
+
+    def test(self):
+        self.check_output()
+        self.check_grad(["weight"])
+
+
+class TestSiluOp(OpTest):
+    op = staticmethod(F.silu)
+    attrs = {}
+    inputs = {"x": rng.standard_normal((6,)).astype(np.float32)}
+
+    @staticmethod
+    def ref(x):
+        return x / (1 + np.exp(-x))
+
+    def test(self):
+        self.check_output(rtol=1e-5, atol=1e-6)
+        self.check_grad(["x"])
+
+
+class TestMeanOp(OpTest):
+    op = staticmethod(paddle.mean)
+    attrs = {"axis": 1, "keepdim": True}
+    inputs = {"x": rng.standard_normal((3, 5)).astype(np.float32)}
+
+    @staticmethod
+    def ref(x, axis, keepdim):
+        return x.mean(axis=axis, keepdims=keepdim)
+
+    def test(self):
+        self.check_output()
+        self.check_grad(["x"])
+
+
+class TestVarOp(OpTest):
+    op = staticmethod(paddle.var)
+    attrs = {"axis": 0}
+    inputs = {"x": rng.standard_normal((6, 3)).astype(np.float32)}
+
+    @staticmethod
+    def ref(x, axis):
+        return x.var(axis=axis, ddof=1)  # paddle defaults to unbiased
+
+    def test(self):
+        self.check_output(rtol=1e-4, atol=1e-5)
+        self.check_grad(["x"])
+
+
+class TestClipOp(OpTest):
+    op = staticmethod(paddle.clip)
+    attrs = {"min": -0.5, "max": 0.5}
+    inputs = {"x": rng.standard_normal((8,)).astype(np.float32)}
+
+    @staticmethod
+    def ref(x, min, max):
+        return np.clip(x, min, max)
+
+    def test(self):
+        self.check_output()
+        self.check_grad(["x"])
+
+
+class TestIndexSelectOp(OpTest):
+    op = staticmethod(paddle.index_select)
+    attrs = {"axis": 1}
+    inputs = {
+        "x": rng.standard_normal((3, 6)).astype(np.float32),
+        "index": np.array([0, 5, 2], np.int64),
+    }
+
+    @staticmethod
+    def ref(x, index, axis):
+        return np.take(x, index, axis=axis)
+
+    def test(self):
+        self.check_output()
+        self.check_grad(["x"])
+
+
+class TestPowOp(OpTest):
+    op = staticmethod(paddle.pow)
+    attrs = {"y": 3.0}
+    # strictly positive base: independent of global rng consumption order
+    inputs = {"x": (np.abs(np.random.default_rng(11).standard_normal(5))
+                    + 0.5).astype(np.float32)}
+
+    @staticmethod
+    def ref(x, y):
+        return x ** y
+
+    def test(self):
+        self.check_output(rtol=1e-4, atol=1e-4)
+        self.check_grad(["x"], rtol=2e-2)
+
+
+class TestSoftmaxWithCEOp(OpTest):
+    op = staticmethod(F.softmax_with_cross_entropy)
+    attrs = {}
+    inputs = {
+        "logits": rng.standard_normal((4, 6)).astype(np.float32),
+        "label": rng.integers(0, 6, (4, 1)).astype(np.int64),
+    }
+
+    @staticmethod
+    def ref(logits, label):
+        m = logits.max(-1, keepdims=True)
+        lse = np.log(np.exp(logits - m).sum(-1, keepdims=True)) + m
+        return lse - np.take_along_axis(logits, label, axis=-1)
+
+    def test(self):
+        self.check_output(rtol=1e-4, atol=1e-5)
+        self.check_grad(["logits"])  # harness default handles tuple outputs
