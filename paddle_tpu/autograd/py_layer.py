@@ -112,7 +112,26 @@ class PyLayer(metaclass=PyLayerMeta):
                     vals.append(g)
             return tuple(vals)
 
+        def diff_vjp(cot_tensors):
+            # create_graph path: re-run the user's backward with recording ON
+            # so the produced cotangents chain into saved input tensors'
+            # graphs (grad-of-grad through custom ops, PyTorch-style caveat:
+            # intermediates saved from the no-grad forward are constants)
+            gin = cls.backward(ctx, *cot_tensors)
+            if not isinstance(gin, (tuple, list)):
+                gin = (gin,)
+            out = []
+            for g in gin:
+                if g is None or isinstance(g, Tensor):
+                    out.append(g)
+                else:
+                    t = Tensor._from_value(g)
+                    t.stop_gradient = True
+                    out.append(t)
+            return out
+
         node = tape.TapeNode(cls.__name__, vjp_fn, in_tensors, len(outs))
+        node.diff_vjp = diff_vjp
         results = []
         for i, o in enumerate(outs):
             t = o if isinstance(o, Tensor) else Tensor._from_value(o)

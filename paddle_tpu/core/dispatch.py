@@ -110,6 +110,11 @@ def apply(name: str, raw_fn: Callable, *args, differentiable: bool = True, **kwa
     _check_numerics(name, out)
     n_out = len(out) if isinstance(out, tuple) else 1
     node = tape.TapeNode(name, vjp_fn, in_tensors, n_out)
+    # double-backward (create_graph): keep the primal so the reverse step can
+    # be re-linearized through this dispatch, recording its own tape
+    node.primal_fn = fn_of_tensors
+    node.primal_out_tuple = isinstance(out, tuple)
+    node.primal_dtypes = [p.dtype for p in primals]
     return _wrap_outputs(name, out, node=node)
 
 

@@ -153,6 +153,8 @@ def _record(name, raw_fn, operand_tree, captured_params=()):
         return vjp_fn(tuple(cots))
 
     node = tape.TapeNode(name, vjp_tupled, leaves, len(out_leaves))
+    node.primal_fn = out_flat_fn
+    node.primal_out_tuple = True
     wrapped_leaves = []
     for i, v in enumerate(out_leaves):
         t = Tensor._from_value(v)
