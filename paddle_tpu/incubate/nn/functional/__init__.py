@@ -309,8 +309,77 @@ def fused_multi_head_attention(x, qkv_weight, linear_weight, pre_layer_norm=Fals
     return apply("fused_multi_head_attention", f, *args)
 
 
-def masked_multihead_attention(x, cache_kv=None, *args, **kwargs):
-    raise NotImplementedError(
-        "decode-time masked_multihead_attention lands with the serving path; "
-        "use scaled_dot_product_attention with explicit kv cache meanwhile"
-    )
+def masked_multihead_attention(x, cache_kv=None, bias=None, src_mask=None,
+                               cum_offsets=None, sequence_lengths=None,
+                               rotary_tensor=None, beam_cache_offset=None,
+                               **kwargs):
+    """Decode-phase attention of one query token against a dense static KV
+    cache (reference: incubate/nn/functional/masked_multihead_attention —
+    same parameter order — kernel
+    phi/kernels/fusion/gpu/masked_multihead_attention_kernel.cu).
+
+    ``x``: [B, 3, H, D] (or [B, 3*H*D]) fused QKV for the new token;
+    ``cache_kv``: [2, B, max_len, H, D] preallocated cache;
+    ``sequence_lengths``: [B] tokens already cached. Returns
+    (out [B, H*D], new_cache_kv)."""
+    from paddle_tpu.models.kv_cache import _static_cache_raw
+
+    if cache_kv is None or sequence_lengths is None:
+        raise ValueError("cache_kv and sequence_lengths are required")
+    unsupported = {"cum_offsets": cum_offsets, "rotary_tensor": rotary_tensor,
+                   "beam_cache_offset": beam_cache_offset,
+                   "src_mask": src_mask}
+    for name, val in unsupported.items():
+        if val is not None:
+            raise NotImplementedError(
+                f"masked_multihead_attention: {name} is not supported on "
+                "this backend")
+    for name in ("qkv_out_scale", "out_shift", "out_smooth"):
+        if kwargs.get(name) is not None:
+            raise NotImplementedError(
+                f"masked_multihead_attention: quantization arg {name} is "
+                "not supported on this backend")
+
+    n_bias = 1 if bias is not None else 0
+
+    def f(xv, ckv, lens, *rest):
+        B = xv.shape[0]
+        H, D = ckv.shape[3], ckv.shape[4]
+        qkv = xv.reshape(B, 3, H, D)
+        if n_bias:
+            qkv = qkv + rest[0].reshape(1, 3, H, D)
+        q = qkv[:, 0][:, None]  # [B, 1, H, D]
+        k = qkv[:, 1][:, None]
+        v = qkv[:, 2][:, None]
+        out, ck2, cv2, _ = _static_cache_raw(
+            q, k, v, ckv[0], ckv[1], lens.astype(jnp.int32))
+        return out[:, 0].reshape(B, H * D), jnp.stack([ck2, cv2])
+
+    args = [x, cache_kv, sequence_lengths] + ([bias] if bias is not None else [])
+    return apply("masked_multihead_attention", f, *args, differentiable=False)
+
+
+def block_multihead_attention(qkv, key_cache, value_cache, seq_lens,
+                              block_tables, **kwargs):
+    """Paged (block-table) KV-cache attention (reference:
+    incubate/nn/functional/block_multihead_attention, kernel
+    phi/kernels/fusion/gpu/block_multi_head_attention_kernel.cu — the
+    vLLM-style serving attention).
+
+    ``qkv``: [B, S, 3, H, D] new tokens; ``key_cache``/``value_cache``:
+    [num_blocks, block_size, H, D] pools; ``seq_lens``: [B] cached lengths;
+    ``block_tables``: [B, max_blocks] int32. Returns
+    (out [B, S, H*D], new_key_cache, new_value_cache)."""
+    from paddle_tpu.models.kv_cache import _paged_cache_raw
+
+    def f(qkv_v, kp, vp, lens, tables):
+        B, S = qkv_v.shape[0], qkv_v.shape[1]
+        H, D = qkv_v.shape[3], qkv_v.shape[4]
+        q, k, v = qkv_v[:, :, 0], qkv_v[:, :, 1], qkv_v[:, :, 2]
+        out, kp2, vp2, _ = _paged_cache_raw(
+            q, k, v, kp, vp, tables.astype(jnp.int32),
+            lens.astype(jnp.int32))
+        return out.reshape(B, S, H * D), kp2, vp2
+
+    return apply("block_multihead_attention", f, qkv, key_cache, value_cache,
+                 seq_lens, block_tables, differentiable=False)

@@ -47,6 +47,8 @@ def tree_unwrap(obj):
         return obj._value
     if isinstance(obj, dict):
         return {k: tree_unwrap(v) for k, v in obj.items()}
+    if isinstance(obj, tuple) and hasattr(obj, "_fields"):  # namedtuple
+        return type(obj)(*(tree_unwrap(v) for v in obj))
     if isinstance(obj, (list, tuple)):
         return type(obj)(tree_unwrap(v) for v in obj)
     return obj
@@ -58,6 +60,8 @@ def tree_wrap(obj):
         return Tensor._from_value(obj)
     if isinstance(obj, dict):
         return {k: tree_wrap(v) for k, v in obj.items()}
+    if isinstance(obj, tuple) and hasattr(obj, "_fields"):  # namedtuple
+        return type(obj)(*(tree_wrap(v) for v in obj))
     if isinstance(obj, (list, tuple)):
         return type(obj)(tree_wrap(v) for v in obj)
     return obj

@@ -30,6 +30,13 @@ from paddle_tpu.models.bert import (  # noqa: F401
     bert_large,
     bert_tiny,
 )
+from paddle_tpu.models.kv_cache import (  # noqa: F401
+    BlockAllocator,
+    PagedCacheSlot,
+    StaticCacheSlot,
+    make_static_cache,
+)
+from paddle_tpu.models.serving import DecodeEngine  # noqa: F401
 from paddle_tpu.models.vit import (  # noqa: F401
     ViTConfig,
     VisionTransformer,

@@ -31,6 +31,7 @@ from paddle_tpu.distributed.fleet.mp_layers import (
     VocabParallelEmbedding,
     _constrain,
 )
+from paddle_tpu.models import kv_cache
 from paddle_tpu.nn import initializer as I
 from paddle_tpu.nn.param_attr import ParamAttr
 from paddle_tpu.ops.pallas.flash_attention import scaled_dot_product_attention
@@ -154,6 +155,12 @@ class GPTAttention(nn.Layer):
         qkv = self.qkv_proj(hidden)  # [b, s, 3h] (mp-sharded last dim)
         qkv = paddle.reshape(qkv, [b, s, self.num_heads, 3 * self.head_dim])
         q, k, v = paddle.split(qkv, 3, axis=-1)  # [b, s, nh, hd] each
+        if isinstance(cache, (kv_cache.StaticCacheSlot, kv_cache.PagedCacheSlot)):
+            # serving path: static-shape cache write + length-masked attention
+            # (one compiled program for every decode step)
+            out, new_cache = kv_cache.cache_update_attend(q, k, v, cache)
+            out = paddle.reshape(out, [b, s, h])
+            return self.out_proj(out), new_cache
         new_cache = None
         if cache is not None:
             # incremental decode: prepend cached K/V; causality against the

@@ -18,6 +18,7 @@ from paddle_tpu.distributed.fleet.mp_layers import (
     VocabParallelEmbedding,
 )
 from paddle_tpu.incubate.nn import functional as IF
+from paddle_tpu.models import kv_cache
 from paddle_tpu.models.gpt import (
     GPTPretrainingCriterion,
     _attention,
@@ -109,6 +110,12 @@ class LlamaAttention(nn.Layer):
                                                  self.head_dim])
         q, k, _ = IF.fused_rotary_position_embedding(
             q, k, position_ids=position_ids, rotary_emb_base=self.rope_base)
+        if isinstance(cache, (kv_cache.StaticCacheSlot, kv_cache.PagedCacheSlot)):
+            # serving path: cache holds KV heads; GQA repeat happens inside
+            # the masked-attention op
+            out, new_cache = kv_cache.cache_update_attend(q, k, v, cache)
+            out = paddle.reshape(out, [b, s, self.num_heads * self.head_dim])
+            return self.o_proj(out), new_cache
         new_cache = None
         if cache is not None:
             # cached K/V are already rotated for their absolute positions

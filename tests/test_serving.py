@@ -1,0 +1,197 @@
+"""Serving path: static/paged KV caches, jitted DecodeEngine, fused serving
+attention ops. Oracles: the eager concat-cache generate() path (itself
+verified cached==full-context) and naive numpy attention."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.models import (
+    DecodeEngine,
+    GPTForCausalLM,
+    LlamaForCausalLM,
+    gpt_tiny,
+    llama_tiny,
+)
+
+
+def _gpt():
+    paddle.seed(7)
+    return GPTForCausalLM(gpt_tiny(num_layers=2))
+
+
+def _llama():
+    paddle.seed(11)
+    return LlamaForCausalLM(llama_tiny())
+
+
+def test_engine_matches_eager_greedy_gpt():
+    model = _gpt()
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, 1000, (2, 12))
+    eager = model.generate(paddle.to_tensor(ids.astype(np.int64)),
+                           max_new_tokens=8, temperature=0.0)
+    engine = DecodeEngine(model, max_seq_len=64, temperature=0.0)
+    out = engine.generate(ids, max_new_tokens=8)
+    eager_np = np.asarray(eager.numpy())
+    for i in range(2):
+        np.testing.assert_array_equal(out[i], eager_np[i])
+
+
+def test_engine_matches_eager_greedy_llama():
+    model = _llama()
+    rng = np.random.default_rng(1)
+    ids = rng.integers(0, 1024, (2, 10))
+    eager = model.generate(paddle.to_tensor(ids.astype(np.int64)),
+                           max_new_tokens=6, temperature=0.0)
+    engine = DecodeEngine(model, max_seq_len=64, temperature=0.0)
+    out = engine.generate(ids, max_new_tokens=6)
+    eager_np = np.asarray(eager.numpy())
+    for i in range(2):
+        np.testing.assert_array_equal(out[i], eager_np[i])
+
+
+def test_engine_ragged_batch_matches_individual():
+    """Two prompts of different lengths in one padded batch must decode the
+    same tokens as each prompt alone."""
+    model = _gpt()
+    rng = np.random.default_rng(2)
+    a = rng.integers(0, 1000, 11)
+    b = rng.integers(0, 1000, 5)
+    engine = DecodeEngine(model, max_seq_len=64, temperature=0.0)
+
+    batch = np.zeros((2, 11), np.int64)
+    batch[0] = a
+    batch[1, :5] = b
+    out = engine.generate(batch, seq_lens=[11, 5], max_new_tokens=6)
+
+    solo_a = engine.generate(a[None, :], max_new_tokens=6)[0]
+    solo_b = engine.generate(b[None, :], max_new_tokens=6)[0]
+    np.testing.assert_array_equal(out[0], solo_a)
+    np.testing.assert_array_equal(out[1], solo_b)
+
+
+def test_paged_engine_matches_dense():
+    model = _gpt()
+    rng = np.random.default_rng(3)
+    ids = rng.integers(0, 1000, (2, 9))
+    dense = DecodeEngine(model, max_seq_len=64, temperature=0.0)
+    paged = DecodeEngine(model, max_seq_len=64, temperature=0.0,
+                         use_paged=True, block_size=8)
+    out_d = dense.generate(ids, max_new_tokens=7)
+    out_p = paged.generate(ids, max_new_tokens=7)
+    for d, p in zip(out_d, out_p):
+        np.testing.assert_array_equal(d, p)
+
+
+def test_engine_eos_trims():
+    model = _gpt()
+    rng = np.random.default_rng(4)
+    ids = rng.integers(0, 1000, (1, 8))
+    engine = DecodeEngine(model, max_seq_len=64, temperature=0.0)
+    base = engine.generate(ids, max_new_tokens=6)[0]
+    eos = int(base[9])  # second generated token becomes "eos"
+    out = engine.generate(ids, max_new_tokens=6, eos_token_id=eos)[0]
+    assert out[-1] == eos
+    assert len(out) == 10
+    np.testing.assert_array_equal(out, base[:10])
+
+
+def test_engine_sampled_decoding_runs():
+    model = _gpt()
+    rng = np.random.default_rng(5)
+    ids = rng.integers(0, 1000, (2, 8))
+    engine = DecodeEngine(model, max_seq_len=64, temperature=0.8, top_k=5)
+    out = engine.generate(ids, max_new_tokens=5)
+    assert all(len(o) == 13 for o in out)
+    assert all(o.min() >= 0 and o.max() < 1024 for o in out)
+
+
+def test_decode_step_no_recompile():
+    """Every decode step after the first must hit the jit program cache."""
+    model = _gpt()
+    rng = np.random.default_rng(6)
+    ids = rng.integers(0, 1000, (1, 8))
+    engine = DecodeEngine(model, max_seq_len=64, temperature=0.0)
+    engine.generate(ids, max_new_tokens=4)
+    sizes = engine._sf._jitted._cache_size()
+    engine.generate(ids, max_new_tokens=12)
+    assert engine._sf._jitted._cache_size() == sizes  # prefill+decode reused
+
+
+def _naive_decode_attention(q, ck, cv, lens):
+    """numpy oracle: one query vs cached prefix (incl. the new token)."""
+    B, _, H, D = q.shape
+    out = np.zeros((B, H, D), np.float32)
+    for b in range(B):
+        L = lens[b] + 1
+        for h in range(H):
+            s = (ck[b, :L, h] @ q[b, 0, h]) / np.sqrt(D)
+            p = np.exp(s - s.max())
+            p /= p.sum()
+            out[b, h] = p @ cv[b, :L, h]
+    return out
+
+
+def test_masked_multihead_attention_op():
+    from paddle_tpu.incubate.nn import functional as IF
+
+    rng = np.random.default_rng(7)
+    B, H, D, ML = 2, 3, 8, 16
+    lens = np.array([5, 9], np.int32)
+    cache = np.zeros((2, B, ML, H, D), np.float32)
+    for b in range(B):
+        cache[:, b, :lens[b]] = rng.standard_normal((2, lens[b], H, D))
+    x = rng.standard_normal((B, 3, H, D)).astype(np.float32)
+
+    out, new_cache = IF.masked_multihead_attention(
+        paddle.to_tensor(x), paddle.to_tensor(cache),
+        sequence_lengths=paddle.to_tensor(lens))
+
+    nc = np.asarray(new_cache.numpy())
+    # new token K/V written at position lens[b]
+    for b in range(B):
+        np.testing.assert_allclose(nc[0, b, lens[b]], x[b, 1], rtol=1e-6)
+        np.testing.assert_allclose(nc[1, b, lens[b]], x[b, 2], rtol=1e-6)
+    oracle = _naive_decode_attention(
+        x[:, 0:1], nc[0], nc[1], lens).reshape(B, H * D)
+    np.testing.assert_allclose(np.asarray(out.numpy()), oracle,
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_block_multihead_attention_op_matches_dense():
+    from paddle_tpu.incubate.nn import functional as IF
+    from paddle_tpu.models.kv_cache import BlockAllocator
+
+    rng = np.random.default_rng(8)
+    B, H, D, bs = 2, 2, 4, 4
+    lens = np.array([6, 3], np.int32)
+    alloc = BlockAllocator(num_blocks=8, block_size=bs)
+    tables = np.full((B, 3), -1, np.int32)
+    for b in range(B):
+        blks = alloc.allocate(lens[b] + 1)
+        tables[b, :len(blks)] = blks
+
+    kp = np.zeros((8, bs, H, D), np.float32)
+    vp = np.zeros((8, bs, H, D), np.float32)
+    dense_k = np.zeros((B, 12, H, D), np.float32)
+    dense_v = np.zeros((B, 12, H, D), np.float32)
+    for b in range(B):
+        for t in range(lens[b]):
+            kv = rng.standard_normal((2, H, D)).astype(np.float32)
+            blk, off = tables[b, t // bs], t % bs
+            kp[blk, off], vp[blk, off] = kv[0], kv[1]
+            dense_k[b, t], dense_v[b, t] = kv[0], kv[1]
+
+    qkv = rng.standard_normal((B, 1, 3, H, D)).astype(np.float32)
+    out, kp2, vp2 = IF.block_multihead_attention(
+        paddle.to_tensor(qkv), paddle.to_tensor(kp), paddle.to_tensor(vp),
+        paddle.to_tensor(lens), paddle.to_tensor(tables))
+
+    for b in range(B):
+        dense_k[b, lens[b]] = qkv[b, 0, 1]
+        dense_v[b, lens[b]] = qkv[b, 0, 2]
+    oracle = _naive_decode_attention(
+        qkv[:, :, 0], dense_k, dense_v, lens).reshape(B, 1, H * D)
+    np.testing.assert_allclose(np.asarray(out.numpy()), oracle,
+                               rtol=1e-4, atol=1e-5)
