@@ -43,6 +43,44 @@ def shard_array(arr, mesh: Mesh, axis_name: str):
     return jax.device_put(arr, NamedSharding(mesh, spec))
 
 
+def to_host_memory(arr):
+    """Move an array to pinned host memory (CPU offload), keeping its
+    sharding. The reference's GroupShardedOptimizerStage2 offload keeps fp32
+    states in CPU tensors (group_sharded_storage.py); on TPU the idiomatic
+    equivalent is the XLA memories API — states live in pinned_host and XLA
+    streams them over PCIe when the update runs."""
+    if not hasattr(arr, "sharding"):
+        return arr
+    try:
+        host = arr.sharding.with_memory_kind("pinned_host")
+        return jax.device_put(arr, host)
+    except Exception:
+        return arr  # backend without memory-kind support
+
+
+def to_device_memory(arr):
+    """Inverse of to_host_memory: stream a pinned-host array back to device
+    memory for compute."""
+    if not hasattr(arr, "sharding"):
+        return arr
+    try:
+        if arr.sharding.memory_kind in (None, "device"):
+            return arr
+        return jax.device_put(arr, arr.sharding.with_memory_kind("device"))
+    except Exception:
+        return arr
+
+
+def _offload_state(optimizer):
+    for key, st in list(optimizer._state.items()):
+        optimizer._state[key] = {
+            k: to_host_memory(v) if hasattr(v, "shape") else v
+            for k, v in st.items()
+        }
+    for key, mv in list(optimizer._master_weights.items()):
+        optimizer._master_weights[key] = to_host_memory(mv)
+
+
 def group_sharded_parallel(model, optimizer, level, scaler=None, group=None,
                            offload=False, sync_buffers=False, buffer_max_size=2**23,
                            segment_size=2**20, sync_comm=False,
@@ -85,6 +123,11 @@ def group_sharded_parallel(model, optimizer, level, scaler=None, group=None,
         if level == "p_g_os":
             for p in model.parameters():
                 p._replace_value(shard_array(p._value, mesh, axis))
+    if offload:
+        # optimizer states + fp32 masters live in pinned host memory; the
+        # eager step and jit.TrainStep both keep them there across updates
+        optimizer._offload = True
+        _offload_state(optimizer)
     if scaler is not None:
         return model, optimizer, scaler
     return model, optimizer

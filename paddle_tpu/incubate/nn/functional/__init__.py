@@ -372,6 +372,12 @@ def block_multihead_attention(qkv, key_cache, value_cache, seq_lens,
     (out [B, S, H*D], new_key_cache, new_value_cache)."""
     from paddle_tpu.models.kv_cache import _paged_cache_raw
 
+    for name, val in kwargs.items():
+        if val is not None:
+            raise NotImplementedError(
+                f"block_multihead_attention: {name} is not supported on "
+                "this backend")
+
     def f(qkv_v, kp, vp, lens, tables):
         B, S = qkv_v.shape[0], qkv_v.shape[1]
         H, D = qkv_v.shape[3], qkv_v.shape[4]

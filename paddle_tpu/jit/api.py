@@ -32,11 +32,23 @@ class StaticFunction:
     """Callable wrapping (layer?, fn) with a cached jax.jit program."""
 
     def __init__(self, fn: Callable, layer: Optional[Layer] = None,
-                 full_graph: bool = True, donate_buffers: bool = True):
+                 full_graph: bool = True, donate_buffers: bool = False,
+                 donate_args: bool = False):
+        """``donate_buffers`` donates the layer's buffer values (safe when no
+        caller holds the previous values — they are replaced by the call's
+        write-back). ``donate_args`` donates the positional-argument buffers:
+        only for callers that never reuse an argument array after the call
+        (e.g. the serving decode loop threading KV caches through)."""
         self._fn = fn
         self._layer = layer
         functools.update_wrapper(self, fn, updated=[])
-        self._jitted = jax.jit(self._traced, static_argnames=("training",))
+        donate = ()
+        if donate_buffers:
+            donate += (1,)
+        if donate_args:
+            donate += (2,)
+        self._jitted = jax.jit(self._traced, static_argnames=("training",),
+                               donate_argnums=donate)
         self.forward = self.__call__
 
     # The traced program: pure function of (param_vals, buffer_vals, args, key)
@@ -157,11 +169,62 @@ class TrainStep:
         for p in self._params:
             optimizer._state.setdefault(id(p), optimizer._init_state(p))
             optimizer._master(p)
-        donate_argnums = (0, 1, 2) if donate else ()
-        self._jitted = jax.jit(self._step, donate_argnums=donate_argnums)
+        if getattr(optimizer, "_offload", False):
+            # states initialized above live on device; move them to their
+            # pinned-host residence before the layout is baked into the jit
+            from paddle_tpu.distributed.sharding import _offload_state
+
+            _offload_state(optimizer)
+        self._donate_argnums = (0, 1, 2) if donate else ()
+        self._jitted = None  # built at first call (out_shardings need state)
+
+    def _build_jit(self, opt_states, master_vals, n_buffers, has_scaler):
+        """Compile-time layout: when the optimizer is ZeRO-offloaded, pin the
+        state/master outputs to their (pinned_host) input shardings so the
+        compiled hot loop keeps them in host memory across steps."""
+        out_shardings = None
+        self._offload_sh = None
+        self._offload_post = False
+        if getattr(self._opt, "_offload", False):
+            def shard_of(v):
+                return v.sharding if hasattr(v, "sharding") else None
+
+            st_sh = [jax.tree_util.tree_map(shard_of, st) for st in opt_states]
+            mv_sh = [shard_of(mv) if mv is not None else None
+                     for mv in master_vals]
+            self._offload_sh = (st_sh, mv_sh)
+            if jax.default_backend() == "cpu":
+                # CPU PJRT can't annotate host placement inside compiled
+                # programs (annotate_device_placement unimplemented): fall
+                # back to eager re-offload after each step. On TPU the
+                # out_shardings pin states to pinned_host inside the step.
+                self._offload_post = True
+                self._offload_sh = None
+            else:
+                out_shardings = (None, [None] * len(self._params), st_sh,
+                                 mv_sh, [None] * n_buffers,
+                                 (None, None, None) if has_scaler else None)
+        self._jitted = jax.jit(self._step,
+                               donate_argnums=self._donate_argnums,
+                               out_shardings=out_shardings)
 
     def _step(self, param_vals, opt_states, master_vals, buffer_vals,
               batch_vals, lr, key, scale=None):
+        if self._offload_sh is not None:
+            # ZeRO offload: stream pinned-host states/masters to device for
+            # the update (XLA overlaps the PCIe copies with compute); the
+            # jit's out_shardings pin the results back to host
+            st_sh, mv_sh = self._offload_sh
+
+            def to_dev(v, sh):
+                if sh is None or sh.memory_kind in (None, "device"):
+                    return v
+                return jax.device_put(v, sh.with_memory_kind("device"))
+
+            opt_states = [jax.tree_util.tree_map(to_dev, st, sh)
+                          for st, sh in zip(opt_states, st_sh)]
+            master_vals = [mv if mv is None else to_dev(mv, sh)
+                           for mv, sh in zip(master_vals, mv_sh)]
         params = self._params
         _, buffers_dict = collect_state(self._model)
         buffers = [b for b in buffers_dict.values() if b is not None]
@@ -177,6 +240,10 @@ class TrainStep:
             else:
                 loss.backward()
             grads = [p._grad for p in params]
+            # don't let grad tracers outlive the trace: a later eager
+            # backward/step would consume leaked tracers
+            for p in params:
+                p._grad = None
             new_buffer_vals = [b._value for b in buffers]
             loss_val = loss._value
         found_inf = None
@@ -248,6 +315,19 @@ class TrainStep:
         lr = jnp.asarray(self._opt.get_lr(), jnp.float32)
         key = rng.next_key()
         scale = self._scaler_state if self._scaler is not None else None
+        if self._jitted is None:
+            self._build_jit(opt_states, master_vals, len(buffer_vals),
+                            scale is not None)
+        if self._offload_post:
+            # CPU fallback: states rest in pinned host between steps but the
+            # compiled step wants uniform (device) memory spaces — stream in
+            # eagerly, stream out in the write-back below
+            from paddle_tpu.distributed.sharding import to_device_memory
+
+            opt_states = [jax.tree_util.tree_map(to_device_memory, st)
+                          for st in opt_states]
+            master_vals = [mv if mv is None else to_device_memory(mv)
+                           for mv in master_vals]
         (loss_val, new_params, new_states, new_masters, new_buffer_vals,
          new_scaler_state) = self._jitted(
             param_vals, opt_states, master_vals, buffer_vals, batch_vals,
@@ -255,6 +335,15 @@ class TrainStep:
         )
         for p, v in zip(params, new_params):
             p._replace_value(v)
+        if self._offload_post:
+            from paddle_tpu.distributed.sharding import to_host_memory
+
+            new_states = [
+                jax.tree_util.tree_map(to_host_memory, st)
+                for st in new_states
+            ]
+            new_masters = [mv if mv is None else to_host_memory(mv)
+                           for mv in new_masters]
         for p, st in zip(params, new_states):
             self._opt._state[id(p)] = st
         for p, mv in zip(params, new_masters):

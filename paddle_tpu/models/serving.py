@@ -70,7 +70,11 @@ class DecodeEngine:
         self.block_size = block_size
         self.num_blocks = num_blocks
         self.cache_dtype = cache_dtype
-        self._sf = StaticFunction(self._forward_sample, layer=model)
+        # donate_args: the decode loop threads cache buffers through the
+        # compiled step and never reuses an input array after the call, so
+        # the KV caches update in place (no 2x cache residency)
+        self._sf = StaticFunction(self._forward_sample, layer=model,
+                                  donate_args=True)
 
     # ---- compiled step -------------------------------------------------
 
@@ -117,15 +121,16 @@ class DecodeEngine:
         table = np.full((batch, max_blocks), -1, np.int32)
         for i, blks in enumerate(per_seq_blocks):
             table[i, :len(blks)] = blks
-        table_t = paddle.to_tensor(table)
-        pos = paddle.zeros([batch], dtype="int32")
         slots = []
         for _ in range(self.num_layers):
             kp = paddle.zeros([n_blocks, self.block_size, self.num_kv_heads,
                                self.head_dim], dtype=self.cache_dtype)
             vp = paddle.zeros([n_blocks, self.block_size, self.num_kv_heads,
                                self.head_dim], dtype=self.cache_dtype)
-            slots.append(PagedCacheSlot(kp, vp, table_t, pos))
+            # per-layer copies: cache args are donated to the compiled step,
+            # and a buffer must not appear twice in a donated pytree
+            slots.append(PagedCacheSlot(kp, vp, paddle.to_tensor(table),
+                                        paddle.zeros([batch], dtype="int32")))
         return slots, alloc, per_seq_blocks
 
     # ---- serving loop --------------------------------------------------
@@ -173,15 +178,16 @@ class DecodeEngine:
                 gather = paddle.to_tensor(lens - 1)
                 next_ids, caches = self._sf(ids, pos_ids, caches, gather)
                 # prefill advanced pos by the padded width; the true valid
-                # length is the prompt length (pad rows are masked out)
-                lens_t = paddle.to_tensor(lens)
-                caches = [c._replace(pos=lens_t) for c in caches]
+                # length is the prompt length (pad rows are masked out).
+                # Per-layer pos copies: donated pytrees must not repeat a
+                # buffer.
+                caches = [c._replace(pos=paddle.to_tensor(lens))
+                          for c in caches]
 
                 out_tokens = [np.asarray(next_ids.numpy())]
                 finished = np.zeros(B, dtype=bool)
                 if eos_token_id is not None:
                     finished |= out_tokens[0] == eos_token_id
-                zero_gather = paddle.to_tensor(np.zeros(B, np.int32))
                 cur_lens = lens.copy()
 
                 for _ in range(1, max_new_tokens):
@@ -190,6 +196,8 @@ class DecodeEngine:
                     tok = paddle.reshape(next_ids, [B, 1])
                     # per-batch absolute positions for RoPE / pos-embedding
                     p = paddle.reshape(paddle.to_tensor(cur_lens), [B, 1])
+                    # fresh every step: args are donated to the compiled call
+                    zero_gather = paddle.to_tensor(np.zeros(B, np.int32))
                     next_ids, caches = self._sf(tok, p, caches, zero_gather)
                     cur_lens += 1
                     step_np = np.asarray(next_ids.numpy())

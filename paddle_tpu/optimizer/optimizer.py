@@ -89,19 +89,42 @@ class Optimizer:
     def step(self):
         lr = jnp.asarray(self.get_lr(), jnp.float32)
         self._step_count += 1
+        offload = getattr(self, "_offload", False)
+        if offload:
+            from paddle_tpu.distributed.sharding import (
+                to_device_memory,
+                to_host_memory,
+            )
         for p, g in self._clipped_grads():
             state = self._state.setdefault(id(p), self._init_state(p))
             master = self._master(p)
             target = master if master is not None else p._value
+            if offload:
+                # stream host-resident state in for the update; eager jnp
+                # math cannot mix host and device memory spaces
+                state = {k: to_device_memory(v) if hasattr(v, "shape") else v
+                         for k, v in state.items()}
+                target = to_device_memory(target)
             if g.dtype != target.dtype:
                 g = g.astype(target.dtype)
             new_target, state_update = self._apply_one(
                 target, g, lr, state, self._decay_for(p)
             )
+            if offload:
+                # keep optimizer states / fp32 masters resident in pinned
+                # host memory across steps (ZeRO offload semantics)
+                state_update = {
+                    k: to_host_memory(v) if hasattr(v, "shape") else v
+                    for k, v in state_update.items()
+                }
+                if master is not None:
+                    new_target_dev = new_target
+                    new_target = to_host_memory(new_target)
             self._state[id(p)] = state_update
             if master is not None:
                 self._master_weights[id(p)] = new_target
-                p._replace_value(new_target.astype(p.dtype))
+                src = new_target_dev if offload else new_target
+                p._replace_value(src.astype(p.dtype))
             else:
                 p._replace_value(new_target)
 

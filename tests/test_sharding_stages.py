@@ -119,3 +119,53 @@ def test_stage1_with_bf16_master_weights():
         assert losses[-1] < losses[0]
     finally:
         topo.set_hybrid_communicate_group(None)
+
+
+def test_offload_eager_step_keeps_states_on_host():
+    """offload=True: optimizer states + fp32 masters live in pinned_host
+    memory and stay there across eager steps; params stay in device memory.
+    (reference: group_sharded offload, group_sharded_storage.py)"""
+    paddle.seed(3)
+    model = nn.Sequential(nn.Linear(8, 8))
+    optimizer = opt.AdamW(learning_rate=1e-2, parameters=model.parameters())
+    model, optimizer = group_sharded_parallel(model, optimizer, "os",
+                                             offload=True)
+    assert optimizer._offload
+    rng = np.random.default_rng(0)
+    x = paddle.to_tensor(rng.standard_normal((4, 8)).astype(np.float32))
+    mse = nn.MSELoss()
+    y = paddle.to_tensor(np.zeros((4, 8), np.float32))
+    for _ in range(2):
+        loss = mse(model(x), y)
+        loss.backward()
+        optimizer.step()
+        optimizer.clear_grad()
+    for st in optimizer._state.values():
+        for v in st.values():
+            if hasattr(v, "sharding"):
+                assert v.sharding.memory_kind == "pinned_host", v.sharding
+    for p in model.parameters():
+        assert p._value.sharding.memory_kind == "device"
+
+
+def test_offload_matches_unoffloaded_losses():
+    m1, o1 = _build(seed=4)
+    ref = _train(m1, o1)
+    m2, o2 = _build(seed=4)
+    m2, o2 = group_sharded_parallel(m2, o2, "os", offload=True)
+    losses = _train(m2, o2)
+    np.testing.assert_allclose(losses, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_offload_trainstep_keeps_states_on_host():
+    """The compiled TrainStep must return states pinned to host memory so
+    the hot loop never migrates them to device residence."""
+    m, o = _build(seed=5)
+    m, o = group_sharded_parallel(m, o, "os", offload=True)
+    _train(m, o, steps=3)
+    for p in o._parameter_list:
+        st = o._state[id(p)]
+        for v in st.values():
+            if hasattr(v, "sharding"):
+                assert v.sharding.memory_kind == "pinned_host", v.sharding
+        assert p._value.sharding.memory_kind == "device"
