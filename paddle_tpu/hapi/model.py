@@ -18,6 +18,7 @@ from paddle_tpu.hapi.callbacks import config_callbacks
 from paddle_tpu.io.dataloader import DataLoader
 from paddle_tpu.io.dataset import Dataset
 from paddle_tpu.metric import Metric
+from paddle_tpu.nn.layer_base import Layer
 from paddle_tpu.tensor import Tensor
 
 
@@ -36,11 +37,15 @@ class Model:
         self._loss = None
         self._metrics = []
         self.stop_training = False
+        self._fast_step = None  # None=unbuilt, False=eager fallback latched
+        self._fast_step_key = None
 
     # ------------------------------------------------------------- prepare
     def prepare(self, optimizer=None, loss=None, metrics=None, amp_configs=None):
         self._optimizer = optimizer
         self._loss = loss
+        self._fast_step = None  # re-arm the compiled fast path
+        self._fast_step_key = None
         self._metrics = _to_list(metrics)
         for m in self._metrics:
             if not isinstance(m, Metric):
@@ -61,6 +66,28 @@ class Model:
         self.network.train()
         inputs = _to_list(inputs)
         labels = _to_list(labels)
+        has_accumulated = any(
+            p._grad is not None
+            for p in getattr(self._optimizer, "_parameter_list", ())
+        ) if self._optimizer is not None else False
+        if update and self._optimizer is not None and not has_accumulated:
+            # (accumulated grads from update=False batches must go through
+            # the eager tape — the compiled step computes this batch only)
+            fast = self._fast_train_step(len(inputs))
+            if fast is not None:
+                try:
+                    loss, outputs = fast(*inputs, *labels)
+                except Exception as e:
+                    # non-jittable network/loss (host-side control flow,
+                    # .numpy() in forward, ...): eager fallback until the
+                    # next prepare() re-arms it
+                    warnings.warn(
+                        f"hapi fast path disabled, falling back to eager "
+                        f"train_batch: {type(e).__name__}: {e}")
+                    self._fast_step = False
+                else:
+                    metrics = self._update_metrics(outputs, labels)
+                    return [float(np.asarray(loss.numpy()))], metrics
         outputs = self.network(*inputs)
         loss = self._compute_loss(outputs, labels)
         loss.backward()
@@ -69,6 +96,32 @@ class Model:
             self._optimizer.clear_grad()
         metrics = self._update_metrics(outputs, labels)
         return [float(np.asarray(loss.numpy()))], metrics
+
+    def _fast_train_step(self, n_inputs):
+        """Cached jit.TrainStep running forward+backward+update as ONE XLA
+        program (the reference's Model-with-to_static fast path,
+        hapi/model.py — here it is the default: jax tracing needs no source
+        transform). Returns None once the eager fallback is latched."""
+        if self._fast_step is False:
+            return None
+        key = (id(self.network), id(self._optimizer), id(self._loss), n_inputs)
+        if self._fast_step is not None and self._fast_step_key == key:
+            return self._fast_step
+        if not isinstance(self.network, Layer) or not callable(self._loss):
+            self._fast_step = False
+            return None
+
+        def loss_fn(net, *batch):
+            ins, labs = batch[:n_inputs], list(batch[n_inputs:])
+            outs = net(*ins)
+            return self._compute_loss(outs, labs), outs
+
+        from paddle_tpu.jit.api import TrainStep
+
+        self._fast_step = TrainStep(self.network, loss_fn, self._optimizer,
+                                    has_aux=True)
+        self._fast_step_key = key
+        return self._fast_step
 
     def eval_batch(self, inputs, labels=None):
         self.network.eval()

@@ -135,10 +135,14 @@ class TrainStep:
     """
 
     def __init__(self, model: Layer, loss_fn: Callable, optimizer,
-                 donate: bool = True, scaler=None):
+                 donate: bool = True, scaler=None, has_aux: bool = False):
+        """``has_aux``: loss_fn returns (loss, aux) — aux (any Tensor pytree,
+        e.g. model outputs for metrics) is threaded out of the compiled step
+        and returned alongside the loss."""
         self._model = model
         self._loss_fn = loss_fn
         self._opt = optimizer
+        self._has_aux = has_aux
         # amp.GradScaler: loss scaling + skip-on-inf + dynamic scale update,
         # all inside the compiled step (the reference's scaler.step path).
         # Scale/good/bad counters live as DEVICE arrays updated in-graph so
@@ -167,7 +171,8 @@ class TrainStep:
         # eager state init so shapes are known before trace; master weights
         # (multi_precision) materialize here so the jitted step carries them
         for p in self._params:
-            optimizer._state.setdefault(id(p), optimizer._init_state(p))
+            if id(p) not in optimizer._state:
+                optimizer._state[id(p)] = optimizer._init_state(p)
             optimizer._master(p)
         if getattr(optimizer, "_offload", False):
             # states initialized above live on device; move them to their
@@ -203,7 +208,8 @@ class TrainStep:
             else:
                 out_shardings = (None, [None] * len(self._params), st_sh,
                                  mv_sh, [None] * n_buffers,
-                                 (None, None, None) if has_scaler else None)
+                                 (None, None, None) if has_scaler else None,
+                                 None)
         self._jitted = jax.jit(self._step,
                                donate_argnums=self._donate_argnums,
                                out_shardings=out_shardings)
@@ -234,7 +240,9 @@ class TrainStep:
             for p in params:
                 p._grad = None
                 p.stop_gradient = False
-            loss = self._loss_fn(self._model, *args)
+            res = self._loss_fn(self._model, *args)
+            loss, aux = res if self._has_aux else (res, None)
+            aux_vals = tree_unwrap(aux)
             if scale is not None:
                 (loss * scale[0].astype(loss.dtype)).backward()
             else:
@@ -301,7 +309,7 @@ class TrainStep:
                 new_params.append(np_)
             new_states.append(ns)
         return (loss_val, new_params, new_states, new_masters,
-                new_buffer_vals, new_scaler_state)
+                new_buffer_vals, new_scaler_state, aux_vals)
 
     def __call__(self, *batch):
         params = self._params
@@ -329,7 +337,7 @@ class TrainStep:
             master_vals = [mv if mv is None else to_device_memory(mv)
                            for mv in master_vals]
         (loss_val, new_params, new_states, new_masters, new_buffer_vals,
-         new_scaler_state) = self._jitted(
+         new_scaler_state, aux_vals) = self._jitted(
             param_vals, opt_states, master_vals, buffer_vals, batch_vals,
             lr, key, scale
         )
@@ -356,4 +364,7 @@ class TrainStep:
             self._scaler_state = new_scaler_state  # device-side, no sync
         if hasattr(self._opt._lr, "step"):
             pass  # caller drives scheduler.step() as in paddle
-        return Tensor._from_value(loss_val)
+        loss_t = Tensor._from_value(loss_val)
+        if self._has_aux:
+            return loss_t, tree_wrap(aux_vals)
+        return loss_t

@@ -96,7 +96,9 @@ class Optimizer:
                 to_host_memory,
             )
         for p, g in self._clipped_grads():
-            state = self._state.setdefault(id(p), self._init_state(p))
+            if id(p) not in self._state:
+                self._state[id(p)] = self._init_state(p)
+            state = self._state[id(p)]
             master = self._master(p)
             target = master if master is not None else p._value
             if offload:
@@ -117,14 +119,11 @@ class Optimizer:
                     k: to_host_memory(v) if hasattr(v, "shape") else v
                     for k, v in state_update.items()
                 }
-                if master is not None:
-                    new_target_dev = new_target
-                    new_target = to_host_memory(new_target)
             self._state[id(p)] = state_update
             if master is not None:
-                self._master_weights[id(p)] = new_target
-                src = new_target_dev if offload else new_target
-                p._replace_value(src.astype(p.dtype))
+                self._master_weights[id(p)] = (
+                    to_host_memory(new_target) if offload else new_target)
+                p._replace_value(new_target.astype(p.dtype))
             else:
                 p._replace_value(new_target)
 

@@ -169,3 +169,30 @@ def test_offload_trainstep_keeps_states_on_host():
             if hasattr(v, "sharding"):
                 assert v.sharding.memory_kind == "pinned_host", v.sharding
         assert p._value.sharding.memory_kind == "device"
+
+
+def test_offload_multi_precision_eager_steps():
+    """bf16 params + fp32 host-resident masters: repeated eager steps must
+    not rebuild state against the offloaded master (init-once guard)."""
+    paddle.seed(11)
+    model = nn.Sequential(nn.Linear(8, 8))
+    for p in model.parameters():
+        p._replace_value(p._value.astype("bfloat16"))
+    optimizer = opt.AdamW(learning_rate=1e-2,
+                          parameters=model.parameters(),
+                          multi_precision=True)
+    model, optimizer = group_sharded_parallel(model, optimizer, "os",
+                                              offload=True)
+    rng = np.random.default_rng(0)
+    x = paddle.to_tensor(rng.standard_normal((4, 8)).astype(np.float32))
+    mse = nn.MSELoss()
+    y = paddle.to_tensor(np.zeros((4, 8), np.float32))
+    for _ in range(3):
+        loss = mse(model(x).astype("float32"), y)
+        loss.backward()
+        optimizer.step()
+        optimizer.clear_grad()
+    for mv in optimizer._master_weights.values():
+        assert mv.sharding.memory_kind == "pinned_host"
+    for p in model.parameters():
+        assert p._value.sharding.memory_kind == "device"

@@ -139,3 +139,99 @@ def test_nms():
     scores = paddle.to_tensor(np.array([0.9, 0.8, 0.7], dtype=np.float32))
     keep = nms(boxes, iou_threshold=0.5, scores=scores)
     np.testing.assert_array_equal(sorted(keep.numpy().tolist()), [0, 2])
+
+
+def test_hapi_fast_path_engages_and_matches_eager():
+    """train_batch must route through the jitted TrainStep and produce the
+    same losses as the eager tape path."""
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((32, 8)).astype(np.float32)
+    y = rng.integers(0, 4, (32, 1))
+
+    def build():
+        paddle.seed(5)
+        net = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+        m = paddle.Model(net)
+        m.prepare(paddle.optimizer.Adam(learning_rate=1e-2,
+                                        parameters=net.parameters()),
+                  nn.CrossEntropyLoss(), paddle.metric.Accuracy())
+        return m
+
+    m_fast = build()
+    losses_fast = []
+    for i in range(4):
+        xb = paddle.to_tensor(x[i * 8:(i + 1) * 8])
+        yb = paddle.to_tensor(y[i * 8:(i + 1) * 8])
+        loss, metrics = m_fast.train_batch([xb], [yb])
+        losses_fast.append(loss[0])
+    # fast path engaged (not latched to eager fallback)
+    assert m_fast._fast_step not in (None, False)
+    assert metrics and 0.0 <= metrics[0] <= 1.0
+
+    m_eager = build()
+    m_eager._fast_step = False  # force eager
+    losses_eager = []
+    for i in range(4):
+        xb = paddle.to_tensor(x[i * 8:(i + 1) * 8])
+        yb = paddle.to_tensor(y[i * 8:(i + 1) * 8])
+        loss, _ = m_eager.train_batch([xb], [yb])
+        losses_eager.append(loss[0])
+    np.testing.assert_allclose(losses_fast, losses_eager, rtol=1e-4, atol=1e-5)
+
+
+def test_hapi_fast_path_falls_back_on_nonjittable():
+    """A forward that syncs to host must latch the eager fallback and still
+    train correctly."""
+
+    class Weird(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc = nn.Linear(4, 2)
+
+        def forward(self, x):
+            h = self.fc(x)
+            # host sync: not traceable
+            _ = float(np.asarray(h.numpy()).sum())
+            return h
+
+    net = Weird()
+    m = paddle.Model(net)
+    m.prepare(paddle.optimizer.SGD(learning_rate=0.1,
+                                   parameters=net.parameters()),
+              nn.CrossEntropyLoss())
+    xb = paddle.to_tensor(np.random.randn(4, 4).astype(np.float32))
+    yb = paddle.to_tensor(np.array([[0], [1], [0], [1]]))
+    loss1, _ = m.train_batch([xb], [yb])
+    assert m._fast_step is False
+    loss2, _ = m.train_batch([xb], [yb])
+    assert np.isfinite(loss1[0]) and np.isfinite(loss2[0])
+
+
+def test_hapi_grad_accumulation_matches_eager():
+    """update=False accumulation must not be dropped by the fast path."""
+    rng = np.random.default_rng(1)
+    x1 = paddle.to_tensor(rng.standard_normal((8, 6)).astype(np.float32))
+    x2 = paddle.to_tensor(rng.standard_normal((8, 6)).astype(np.float32))
+    y1 = paddle.to_tensor(rng.integers(0, 3, (8, 1)))
+    y2 = paddle.to_tensor(rng.integers(0, 3, (8, 1)))
+
+    def build():
+        paddle.seed(9)
+        net = nn.Linear(6, 3)
+        m = paddle.Model(net)
+        m.prepare(paddle.optimizer.SGD(learning_rate=0.1,
+                                       parameters=net.parameters()),
+                  nn.CrossEntropyLoss())
+        return m, net
+
+    m_a, net_a = build()
+    m_a.train_batch([x1], [y1], update=False)
+    m_a.train_batch([x2], [y2], update=True)
+
+    m_b, net_b = build()
+    m_b._fast_step = False
+    m_b.train_batch([x1], [y1], update=False)
+    m_b.train_batch([x2], [y2], update=True)
+
+    np.testing.assert_allclose(net_a.weight.numpy(), net_b.weight.numpy(),
+                               rtol=1e-5, atol=1e-6)
