@@ -37,16 +37,21 @@ def recompute(function, *args, use_reentrant=True, preserve_rng_state=True,
     def raw(*vals):
         n = len(tensor_args)
         arg_vals, param_vals = vals[:n], vals[n:]
+        from paddle_tpu.autograd import tape
         from paddle_tpu.jit.functional import swap_values
 
         wrapped = iter(tree_wrap(list(arg_vals)))
         call_args = [next(wrapped) if isinstance(a, Tensor) else a for a in args]
-        if extra_params:
-            with swap_values(extra_params, list(param_vals)):
-                out = function(*call_args, **kwargs)
-                return tree_unwrap(out)
-        out = function(*call_args, **kwargs)
-        return tree_unwrap(out)
+        # the outer jax.vjp differentiates this whole rematerialized body;
+        # per-op tape recording inside it would nest vjp-in-vjp (breaking
+        # custom-vjp kernels like pallas flash attention) for no benefit
+        with tape.no_grad():
+            if extra_params:
+                with swap_values(extra_params, list(param_vals)):
+                    out = function(*call_args, **kwargs)
+                    return tree_unwrap(out)
+            out = function(*call_args, **kwargs)
+            return tree_unwrap(out)
 
     ckpt = jax.checkpoint(raw)
     return apply("recompute", ckpt, *all_inputs)

@@ -2,3 +2,5 @@
 
 from paddle_tpu.incubate import nn  # noqa: F401
 from paddle_tpu.incubate import distributed  # noqa: F401
+from paddle_tpu.incubate import optimizer  # noqa: F401
+from paddle_tpu.incubate import asp  # noqa: F401

@@ -1,0 +1,5 @@
+"""paddle.incubate.optimizer parity."""
+
+from paddle_tpu.incubate.optimizer.distributed_fused_lamb import (  # noqa: F401
+    DistributedFusedLamb,
+)

@@ -49,6 +49,10 @@ class StaticFunction:
             donate += (2,)
         self._jitted = jax.jit(self._traced, static_argnames=("training",),
                                donate_argnums=donate)
+        # grad path: same pure program, no donation (fwd runs under jax.vjp)
+        self._jitted_nodonate = (
+            self._jitted if not donate
+            else jax.jit(self._traced, static_argnames=("training",)))
         self.forward = self.__call__
 
     # The traced program: pure function of (param_vals, buffer_vals, args, key)
@@ -78,6 +82,8 @@ class StaticFunction:
         return list(p.values()), [t for t in b.values() if t is not None]
 
     def __call__(self, *args, **kwargs):
+        from paddle_tpu.autograd import tape as _tape
+
         params, buffers = self._state_tensors()
         param_vals = [p._value for p in params]
         buffer_vals = [b._value for b in buffers]
@@ -85,13 +91,63 @@ class StaticFunction:
         kwarg_vals = tree_unwrap(kwargs)
         key = rng.next_key()
         training = self._layer.training if self._layer is not None else False
-        out_vals, new_buffer_vals = self._jitted(
-            param_vals, buffer_vals, arg_vals, kwarg_vals, key, training
-        )
-        # write back mutated buffers (BN running stats etc.)
+
+        orig_leaves = jax.tree_util.tree_leaves(
+            (args, kwargs), is_leaf=lambda x: isinstance(x, Tensor))
+        arg_tensors = [l for l in orig_leaves if isinstance(l, Tensor)]
+        diff_params = [p for p in params if not p.stop_gradient]
+        needs_grad = _tape.is_grad_enabled() and (
+            diff_params or any(not t.stop_gradient for t in arg_tensors))
+
+        if not needs_grad:
+            out_vals, new_buffer_vals = self._jitted(
+                param_vals, buffer_vals, arg_vals, kwarg_vals, key, training
+            )
+            for b, v in zip(buffers, new_buffer_vals):
+                b._replace_value(v)
+            return tree_wrap(out_vals)
+
+        # differentiable path: ONE tape node spanning the whole compiled
+        # program (paddle's to_static-training parity: loss.backward()
+        # through a @to_static forward). The vjp runs the same XLA program.
+        (out_vals, new_buffer_vals), vjp_fn = jax.vjp(
+            lambda pv, av, kv: self._jitted_nodonate(
+                pv, buffer_vals, av, kv, key, training),
+            param_vals, arg_vals, kwarg_vals)
+        out_leaves, out_treedef = jax.tree_util.tree_flatten(out_vals)
+        buf_zero = jax.tree_util.tree_map(jnp.zeros_like, new_buffer_vals)
+        in_tensors = list(params) + arg_tensors
+        n_out = len(out_leaves)
+
+        def node_vjp(out_cot):
+            import jax.dtypes
+
+            cots = out_cot if isinstance(out_cot, tuple) else (out_cot,)
+            cot_tree = jax.tree_util.tree_unflatten(out_treedef, list(cots))
+            pv_cot, av_cot, kv_cot = vjp_fn((cot_tree, buf_zero))
+            # align arg cotangents with the Tensor leaves of (args, kwargs):
+            # non-Tensor numeric leaves produce float0 cots that are dropped
+            cot_leaves = jax.tree_util.tree_leaves((av_cot, kv_cot))
+            arg_cots = [c for o, c in zip(orig_leaves, cot_leaves)
+                        if isinstance(o, Tensor)]
+
+            def clean(c):
+                return None if c.dtype == jax.dtypes.float0 else c
+
+            return tuple(clean(c) for c in list(pv_cot) + arg_cots)
+
+        node = tape.TapeNode(getattr(self._fn, "__name__", "to_static"),
+                             node_vjp, in_tensors, n_out)
+        wrapped = []
+        for i, v in enumerate(out_leaves):
+            t = Tensor._from_value(v)
+            t.stop_gradient = False
+            t._node = node
+            node.register_output(i, t)
+            wrapped.append(t)
         for b, v in zip(buffers, new_buffer_vals):
             b._replace_value(v)
-        return tree_wrap(out_vals)
+        return jax.tree_util.tree_unflatten(out_treedef, wrapped)
 
     @property
     def program_cache(self):
@@ -102,15 +158,32 @@ def to_static(function=None, input_spec=None, build_strategy=None,
               backend=None, full_graph=True):
     """paddle.jit.to_static parity: decorator or direct call on fn/Layer."""
 
+    def _ast(fn):
+        """Rewrite data-dependent if/while into cond/while_loop ops (the
+        dy2static AST pass); identity when nothing needs rewriting or the
+        source is unavailable."""
+        from paddle_tpu.jit import dy2static
+
+        try:
+            out = dy2static.ast_transform(fn)
+        except Exception:
+            return fn
+        return out if out is not None else fn
+
     def decorate(obj):
         if isinstance(obj, Layer):
-            sf = StaticFunction(obj.forward, layer=obj, full_graph=full_graph)
+            if isinstance(obj.forward, StaticFunction):
+                return obj  # already static — idempotent re-decoration
+            func = getattr(obj.forward, "__func__", None)
+            fwd = _ast(func).__get__(obj) if func is not None else obj.forward
+            sf = StaticFunction(fwd, layer=obj, full_graph=full_graph)
             obj.forward = sf
             return obj
         layer = getattr(obj, "__self__", None)
         if isinstance(layer, Layer):
-            return StaticFunction(obj, layer=layer, full_graph=full_graph)
-        return StaticFunction(obj, layer=None, full_graph=full_graph)
+            fn = _ast(obj.__func__).__get__(layer)
+            return StaticFunction(fn, layer=layer, full_graph=full_graph)
+        return StaticFunction(_ast(obj), layer=None, full_graph=full_graph)
 
     if function is not None:
         return decorate(function)
@@ -364,6 +437,9 @@ class TrainStep:
             self._scaler_state = new_scaler_state  # device-side, no sync
         if hasattr(self._opt._lr, "step"):
             pass  # caller drives scheduler.step() as in paddle
+        hook = getattr(self._opt, "_post_step_hook", None)
+        if hook is not None:
+            hook()  # e.g. ASP re-masking (the wrapper's step() is bypassed)
         loss_t = Tensor._from_value(loss_val)
         if self._has_aux:
             return loss_t, tree_wrap(aux_vals)

@@ -1,0 +1,313 @@
+"""dy2static AST control-flow transformer (parity:
+python/paddle/jit/dy2static/transformers/ifelse_transformer.py and the
+while-loop transformer under jit/dy2static/transformers/).
+
+jax tracing already captures trace-time Python control flow; what it cannot
+capture is *data-dependent* branching on traced values. This pass closes
+that gap the way the reference's AST path does: ``if``/``while`` whose
+predicate is a Tensor are rewritten into ``paddle.static.nn.cond`` /
+``while_loop`` calls (lowering to lax.cond/lax.while_loop), while plain
+Python predicates keep exact Python semantics through the same runtime
+helpers.
+
+Unsupported inside a transformed block (left untransformed, as in eager):
+``return`` / ``break`` / ``continue`` — matching the subset the builder
+documents; the reference handles these with early-exit flags.
+"""
+
+from __future__ import annotations
+
+import ast
+import inspect
+import textwrap
+from typing import Callable, List, Tuple
+
+from paddle_tpu.tensor import Tensor
+
+
+class _Undefined:
+    """Placeholder for names not yet bound when a branch runs (the
+    reference's UndefinedVar)."""
+
+    __slots__ = ()
+
+    def __repr__(self):
+        return "UNDEF"
+
+
+UNDEF = _Undefined()
+
+
+def convert_ifelse(pred, true_fn: Callable, false_fn: Callable, vars: Tuple):
+    """Runtime dispatch: Tensor predicate -> compiled cond; Python value ->
+    plain branch (identical semantics to the untransformed code)."""
+    if isinstance(pred, Tensor):
+        from paddle_tpu.ops import control_flow
+
+        # UNDEF placeholders (names unbound before the if) cannot enter the
+        # traced cond: strip them from the operands, re-inject inside the
+        # branches, and require both branches to produce real values
+        undef = {i for i, v in enumerate(vars) if v is UNDEF}
+        live = tuple(v for i, v in enumerate(vars) if i not in undef)
+
+        def wrap(fn):
+            def inner(*live_vs):
+                it = iter(live_vs)
+                full = [UNDEF if i in undef else next(it)
+                        for i in range(len(vars))]
+                out = fn(*full)
+                if any(v is UNDEF for v in out):
+                    raise RuntimeError(
+                        "dy2static cond: a variable assigned in only one "
+                        "branch is undefined in the other; assign it in both "
+                        "branches or before the if")
+                return tuple(out)
+            return inner
+
+        return control_flow.cond(pred, wrap(true_fn), wrap(false_fn),
+                                 operands=live)
+    return true_fn(*vars) if pred else false_fn(*vars)
+
+
+def convert_while(cond_fn: Callable, body_fn: Callable, vars: Tuple):
+    """Runtime dispatch for while: Tensor condition -> while_loop op."""
+    first = cond_fn(*vars)
+    if isinstance(first, Tensor):
+        import paddle_tpu as paddle
+        from paddle_tpu.ops import control_flow
+
+        # numeric loop carries become Tensors (they must be traced values
+        # for lax.while_loop; matches the reference's variable promotion)
+        vars = tuple(paddle.to_tensor(v)
+                     if isinstance(v, (int, float, bool)) else v
+                     for v in vars)
+        # body-local temps (unbound before the loop) can't be loop carries:
+        # keep them out of the carry, re-inject UNDEF each iteration (the
+        # body assigns them before use; their post-loop value is dropped)
+        undef = {i for i, v in enumerate(vars) if v is UNDEF}
+        if undef:
+            live = [v for i, v in enumerate(vars) if i not in undef]
+
+            def full_args(live_vs):
+                it = iter(live_vs)
+                return [UNDEF if i in undef else next(it)
+                        for i in range(len(vars))]
+
+            def cond2(*live_vs):
+                return cond_fn(*full_args(live_vs))
+
+            def body2(*live_vs):
+                out = body_fn(*full_args(live_vs))
+                return [o for i, o in enumerate(out) if i not in undef]
+
+            res = control_flow.while_loop(cond2, body2, live)
+            it = iter(res)
+            return tuple(UNDEF if i in undef else next(it)
+                         for i in range(len(vars)))
+        out = control_flow.while_loop(cond_fn, body_fn, list(vars))
+        return tuple(out)
+    vars = tuple(vars)
+    cur = bool(first)
+    while cur:
+        vars = tuple(body_fn(*vars))
+        cur = bool(cond_fn(*vars))
+    return vars
+
+
+def _assigned_names(nodes: List[ast.stmt]) -> List[str]:
+    """Names stored anywhere in the statement list (order-stable)."""
+    found: List[str] = []
+
+    class V(ast.NodeVisitor):
+        def visit_Name(self, node):
+            if (isinstance(node.ctx, ast.Store) and node.id not in found
+                    and not node.id.startswith("__dy2s_")):
+                found.append(node.id)
+            self.generic_visit(node)
+
+        def visit_FunctionDef(self, node):
+            if node.name not in found and not node.name.startswith("__dy2s_"):
+                found.append(node.name)
+            # don't descend: inner function bodies have their own scope
+
+        visit_AsyncFunctionDef = visit_FunctionDef
+
+        def visit_Lambda(self, node):
+            pass
+
+    v = V()
+    for n in nodes:
+        v.visit(n)
+    return found
+
+
+def _has_escape(nodes: List[ast.stmt]) -> bool:
+    """return/break/continue anywhere in the block (excluding nested defs)."""
+
+    class V(ast.NodeVisitor):
+        found = False
+
+        def visit_Return(self, node):
+            self.found = True
+
+        def visit_Break(self, node):
+            self.found = True
+
+        def visit_Continue(self, node):
+            self.found = True
+
+        def visit_FunctionDef(self, node):
+            pass
+
+        visit_AsyncFunctionDef = visit_FunctionDef
+
+        def visit_Lambda(self, node):
+            pass
+
+    v = V()
+    for n in nodes:
+        v.visit(n)
+    return v.found
+
+
+def _name(id_, ctx):
+    return ast.Name(id=id_, ctx=ctx)
+
+
+def _guard_stmts(names: List[str]) -> List[ast.stmt]:
+    """try: <name>\nexcept (NameError, UnboundLocalError): <name> = UNDEF"""
+    out = []
+    for n in names:
+        out.append(ast.Try(
+            body=[ast.Expr(value=_name(n, ast.Load()))],
+            handlers=[ast.ExceptHandler(
+                type=ast.Tuple(elts=[_name("NameError", ast.Load()),
+                                     _name("UnboundLocalError", ast.Load())],
+                               ctx=ast.Load()),
+                name=None,
+                body=[ast.Assign(targets=[_name(n, ast.Store())],
+                                 value=ast.Attribute(
+                                     value=_name("_dy2s", ast.Load()),
+                                     attr="UNDEF", ctx=ast.Load()))])],
+            orelse=[], finalbody=[]))
+    return out
+
+
+class ControlFlowTransformer(ast.NodeTransformer):
+    def __init__(self):
+        self._n = 0
+
+    def _fresh(self, kind):
+        self._n += 1
+        return f"__dy2s_{kind}_{self._n}"
+
+    def _branch_fn(self, fname: str, names: List[str],
+                   body: List[ast.stmt]) -> ast.FunctionDef:
+        ret = ast.Return(value=ast.Tuple(
+            elts=[_name(n, ast.Load()) for n in names], ctx=ast.Load()))
+        return ast.FunctionDef(
+            name=fname,
+            args=ast.arguments(
+                posonlyargs=[], args=[ast.arg(arg=n) for n in names],
+                vararg=None, kwonlyargs=[], kw_defaults=[], kwarg=None,
+                defaults=[]),
+            body=(body or [ast.Pass()]) + [ret],
+            decorator_list=[])
+
+    def visit_If(self, node: ast.If):
+        self.generic_visit(node)
+        if _has_escape(node.body) or _has_escape(node.orelse):
+            return node
+        names = _assigned_names(node.body + node.orelse)
+        if not names:
+            return node
+        tname = self._fresh("true")
+        fname = self._fresh("false")
+        tfn = self._branch_fn(tname, names, node.body)
+        ffn = self._branch_fn(fname, names, node.orelse)
+        call = ast.Assign(
+            targets=[ast.Tuple(elts=[_name(n, ast.Store()) for n in names],
+                               ctx=ast.Store())],
+            value=ast.Call(
+                func=ast.Attribute(value=_name("_dy2s", ast.Load()),
+                                   attr="convert_ifelse", ctx=ast.Load()),
+                args=[node.test,
+                      _name(tname, ast.Load()), _name(fname, ast.Load()),
+                      ast.Tuple(elts=[_name(n, ast.Load()) for n in names],
+                                ctx=ast.Load())],
+                keywords=[]))
+        return _guard_stmts(names) + [tfn, ffn, call]
+
+    def visit_While(self, node: ast.While):
+        self.generic_visit(node)
+        if _has_escape(node.body) or node.orelse:
+            return node
+        names = _assigned_names(node.body)
+        if not names:
+            return node
+        cname = self._fresh("cond")
+        bname = self._fresh("body")
+        cfn = ast.FunctionDef(
+            name=cname,
+            args=ast.arguments(
+                posonlyargs=[], args=[ast.arg(arg=n) for n in names],
+                vararg=None, kwonlyargs=[], kw_defaults=[], kwarg=None,
+                defaults=[]),
+            body=[ast.Return(value=node.test)],
+            decorator_list=[])
+        bfn = self._branch_fn(bname, names, node.body)
+        call = ast.Assign(
+            targets=[ast.Tuple(elts=[_name(n, ast.Store()) for n in names],
+                               ctx=ast.Store())],
+            value=ast.Call(
+                func=ast.Attribute(value=_name("_dy2s", ast.Load()),
+                                   attr="convert_while", ctx=ast.Load()),
+                args=[_name(cname, ast.Load()), _name(bname, ast.Load()),
+                      ast.Tuple(elts=[_name(n, ast.Load()) for n in names],
+                                ctx=ast.Load())],
+                keywords=[]))
+        return _guard_stmts(names) + [cfn, bfn, call]
+
+
+def ast_transform(fn: Callable):
+    """Rewrite data-dependent if/while in ``fn`` (returns a new function, or
+    ``None`` when the function cannot be transformed — closures, no source,
+    lambdas)."""
+    if getattr(fn, "__closure__", None):
+        return None
+    try:
+        src = textwrap.dedent(inspect.getsource(fn))
+    except (OSError, TypeError):
+        return None
+    try:
+        tree = ast.parse(src)
+    except SyntaxError:
+        return None
+    fdef = tree.body[0]
+    if not isinstance(fdef, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        return None
+    fdef.decorator_list = []  # the decorator is being applied right now
+    t = ControlFlowTransformer()
+    new_tree = t.visit(tree)
+    if t._n == 0:
+        return fn  # nothing to rewrite
+    ast.fix_missing_locations(new_tree)
+    import paddle_tpu.jit.dy2static as _dy2s_mod
+
+    class _LiveGlobals(dict):
+        """Falls back to the function's LIVE module globals so names defined
+        after decoration (forward refs, monkeypatches) resolve at call
+        time."""
+
+        def __missing__(self, key):
+            return fn.__globals__[key]
+
+    ns = _LiveGlobals()
+    ns["_dy2s"] = _dy2s_mod
+    code = compile(new_tree, filename=f"<dy2static {fn.__qualname__}>",
+                   mode="exec")
+    exec(code, ns)
+    new_fn = ns[fdef.name]
+    new_fn.__defaults__ = fn.__defaults__
+    new_fn.__kwdefaults__ = fn.__kwdefaults__
+    return new_fn

@@ -30,14 +30,47 @@ def _largest_dividing_block(n: int, cap: int) -> int:
     return min(n, cap)
 
 
+import os
+
+# forward blocks: measured fastest for GPT-2 shapes (module docstring);
+# backward (dkv/dq) blocks tuned separately — overridable for sweeps
+_BWD_CAPS = None
+
+
+def _bwd_caps():
+    global _BWD_CAPS
+    if _BWD_CAPS is None:
+        env = os.environ.get("PADDLE_TPU_FLASH_BWD_BLOCKS", "")
+        _BWD_CAPS = (1024, 512, 1024, 512)  # q_dkv, k_dkv, q_dq, k_dq
+        if env:
+            try:
+                parts = [int(x) for x in env.split(",")]
+                if len(parts) != 4 or any(p <= 0 for p in parts):
+                    raise ValueError(env)
+                _BWD_CAPS = tuple(parts)
+            except ValueError:
+                import warnings
+
+                warnings.warn(
+                    "PADDLE_TPU_FLASH_BWD_BLOCKS must be 4 positive ints "
+                    f"'q_dkv,k_dkv,q_dq,k_dq'; got {env!r} — using defaults")
+    return _BWD_CAPS
+
+
 def _block_sizes(sq: int, sk: int) -> BlockSizes:
     # largest dividing block ≤ cap: seq 1536 gets 512, not a failing 1024
     bq = _largest_dividing_block(sq, 1024)
     bk = _largest_dividing_block(sk, 512)
+    cq_dkv, ck_dkv, cq_dq, ck_dq = _bwd_caps()
+    bq_dkv = _largest_dividing_block(sq, cq_dkv)
+    bk_dkv = _largest_dividing_block(sk, ck_dkv)
+    bq_dq = _largest_dividing_block(sq, cq_dq)
+    bk_dq = _largest_dividing_block(sk, ck_dq)
     return BlockSizes(
         block_q=bq, block_k_major=bk, block_k=bk, block_b=1,
-        block_q_major_dkv=bq, block_k_major_dkv=bk, block_k_dkv=bk,
-        block_q_dkv=bq, block_k_major_dq=bk, block_k_dq=bk, block_q_dq=bq,
+        block_q_major_dkv=bq_dkv, block_k_major_dkv=bk_dkv,
+        block_k_dkv=bk_dkv, block_q_dkv=bq_dkv,
+        block_k_major_dq=bk_dq, block_k_dq=bk_dq, block_q_dq=bq_dq,
     )
 
 

@@ -1,0 +1,120 @@
+"""ASP n:m sparsity mask simulation (reference: incubate/asp/asp.py;
+test model test/asp/test_asp_pruning_*.py — masks hold through training)."""
+
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.optimizer as opt
+from paddle_tpu.incubate import asp
+
+
+def test_mask_1d_is_2_of_4():
+    paddle.seed(0)
+    net = nn.Linear(16, 8)
+    masks = asp.prune_model(net, n=2, m=4, mask_algo="mask_1d")
+    assert masks
+    w = np.asarray(net.weight.numpy())
+    groups = w.reshape(-1, 4)
+    nz = (groups != 0).sum(axis=1)
+    assert (nz <= 2).all()
+    assert abs(asp.calculate_density(net.weight) - 0.5) < 0.1
+
+
+def test_mask_2d_greedy_rowcol_constraint():
+    paddle.seed(1)
+    net = nn.Linear(8, 8)
+    asp.prune_model(net, n=2, m=4, mask_algo="mask_2d_greedy")
+    w = np.asarray(net.weight.numpy()).reshape(8, 8)
+    for i0 in range(0, 8, 4):
+        for j0 in range(0, 8, 4):
+            blk = w[i0:i0 + 4, j0:j0 + 4]
+            assert ((blk != 0).sum(axis=0) <= 2).all()
+            assert ((blk != 0).sum(axis=1) <= 2).all()
+
+
+def test_masks_hold_through_training():
+    paddle.seed(2)
+    net = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+    optimizer = asp.decorate(opt.Adam(learning_rate=1e-2,
+                                      parameters=net.parameters()))
+    asp.prune_model(net, n=2, m=4)
+    zero_masks = {
+        id(p): np.asarray(p.numpy()) == 0
+        for p in net.parameters() if len(p.shape) >= 2
+    }
+    rng = np.random.default_rng(0)
+    x = paddle.to_tensor(rng.standard_normal((8, 8)).astype(np.float32))
+    y = paddle.to_tensor(rng.standard_normal((8, 4)).astype(np.float32))
+    mse = nn.MSELoss()
+    for _ in range(4):
+        loss = mse(net(x), y)
+        loss.backward()
+        optimizer.step()
+        optimizer.clear_grad()
+    for p in net.parameters():
+        if id(p) in zero_masks:
+            w = np.asarray(p.numpy())
+            assert (w[zero_masks[id(p)]] == 0).all()
+            # non-masked entries actually trained
+            assert (w[~zero_masks[id(p)]] != 0).any()
+
+
+def test_excluded_layers():
+    paddle.seed(3)
+    net = nn.Sequential(nn.Linear(8, 8), nn.Linear(8, 8))
+    asp.set_excluded_layers(net, ["0"])
+    asp.prune_model(net, n=2, m=4)
+    w0 = np.asarray(net[0].weight.numpy())
+    w1 = np.asarray(net[1].weight.numpy())
+    assert (w0 != 0).all()  # excluded: untouched
+    assert (w1 == 0).any()
+    asp.reset_excluded_layers(net)
+
+
+def test_masks_hold_through_hapi_fast_path():
+    """The hapi compiled TrainStep bypasses optimizer.step(); the ASP
+    post-step hook must still re-apply masks."""
+    paddle.seed(4)
+    net = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+    optimizer = asp.decorate(opt.Adam(learning_rate=1e-2,
+                                      parameters=net.parameters()))
+    asp.prune_model(net, n=2, m=4)
+    zeros = {id(p): np.asarray(p.numpy()) == 0
+             for p in net.parameters() if len(p.shape) >= 2}
+    m = paddle.Model(net)
+    m.prepare(optimizer, nn.MSELoss())
+    rng = np.random.default_rng(0)
+    x = paddle.to_tensor(rng.standard_normal((8, 8)).astype(np.float32))
+    y = paddle.to_tensor(rng.standard_normal((8, 4)).astype(np.float32))
+    for _ in range(4):
+        m.train_batch([x], [y])
+    for p in net.parameters():
+        if id(p) in zeros:
+            assert (np.asarray(p.numpy())[zeros[id(p)]] == 0).all()
+
+
+def test_masks_hold_through_trainstep():
+    """jit.TrainStep bypasses the wrapper's step(); the post-step hook must
+    still re-mask."""
+    from paddle_tpu.jit.api import TrainStep
+
+    paddle.seed(5)
+    net = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+    optimizer = asp.decorate(opt.Adam(learning_rate=1e-2,
+                                      parameters=net.parameters()))
+    asp.prune_model(net, n=2, m=4)
+    zeros = {id(p): np.asarray(p.numpy()) == 0
+             for p in net.parameters() if len(p.shape) >= 2}
+    mse = nn.MSELoss()
+    step = TrainStep(net, lambda m, a, b: mse(m(a), b), optimizer)
+    rng = np.random.default_rng(0)
+    x = paddle.to_tensor(rng.standard_normal((8, 8)).astype(np.float32))
+    y = paddle.to_tensor(rng.standard_normal((8, 4)).astype(np.float32))
+    for _ in range(3):
+        step(x, y)
+    for p in net.parameters():
+        if id(p) in zeros:
+            assert (np.asarray(p.numpy())[zeros[id(p)]] == 0).all()
+    assert optimizer._step_count == 3  # setattr forwards to inner
+    assert optimizer._inner._step_count == 3

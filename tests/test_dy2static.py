@@ -1,0 +1,257 @@
+"""dy2static AST transformer: data-dependent if/while captured into the
+compiled program (reference: jit/dy2static/transformers/, tests
+test/dygraph_to_static/test_ifelse.py, test_while_op.py)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.jit.dy2static import UNDEF, ast_transform, convert_ifelse
+
+
+def test_plain_python_semantics_preserved():
+    def f(x, flag):
+        if flag > 2:  # python int predicate: stays python
+            y = x * 2
+        else:
+            y = x - 1
+        return y
+
+    g = ast_transform(f)
+    x = paddle.to_tensor(np.float32(3.0))
+    np.testing.assert_allclose(g(x, 5).numpy(), 6.0)
+    np.testing.assert_allclose(g(x, 0).numpy(), 2.0)
+
+
+def test_tensor_if_executes_data_dependently():
+    def f(x):
+        if (x.sum() > 0):
+            y = x * 2
+        else:
+            y = -x
+        return y
+
+    g = ast_transform(f)
+    pos = paddle.to_tensor(np.array([1.0, 2.0], np.float32))
+    neg = paddle.to_tensor(np.array([-1.0, -2.0], np.float32))
+    np.testing.assert_allclose(g(pos).numpy(), [2.0, 4.0])
+    np.testing.assert_allclose(g(neg).numpy(), [1.0, 2.0])
+
+
+def test_tensor_if_inside_jit_single_program():
+    import jax
+
+    def f(x):
+        if (x.sum() > 0):
+            y = x * 2
+        else:
+            y = -x
+        return y
+
+    sf = paddle.jit.to_static(f)
+    pos = paddle.to_tensor(np.array([1.0, 2.0], np.float32))
+    neg = paddle.to_tensor(np.array([-1.0, -2.0], np.float32))
+    # same compiled program serves BOTH branches: data-dependent lax.cond
+    np.testing.assert_allclose(np.asarray(sf(pos).numpy()), [2.0, 4.0])
+    np.testing.assert_allclose(np.asarray(sf(neg).numpy()), [1.0, 2.0])
+
+
+def test_tensor_while_loop():
+    def f(x):
+        s = x * 0
+        while (s.sum() < 10):
+            s = s + x
+        return s
+
+    g = ast_transform(f)
+    x = paddle.to_tensor(np.array([3.0], np.float32))
+    np.testing.assert_allclose(g(x).numpy(), [12.0])
+
+
+def test_python_while_untouched():
+    def f(x, n):
+        i = 0
+        while i < n:  # python loop: unrolled at trace time
+            x = x + 1
+            i = i + 1
+        return x
+
+    g = ast_transform(f)
+    x = paddle.to_tensor(np.float32(0.0))
+    np.testing.assert_allclose(g(x, 3).numpy(), 3.0)
+
+
+def test_branch_gradients_flow():
+    def f(x):
+        if (x.sum() > 0):
+            y = x * x
+        else:
+            y = x * 3
+        return y.sum()
+
+    g = ast_transform(f)
+    x = paddle.to_tensor(np.array([2.0, 1.0], np.float32),
+                         stop_gradient=False)
+    loss = g(x)
+    loss.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [4.0, 2.0])
+
+
+def test_var_defined_in_branch_only():
+    def f(x):
+        if (x.sum() > 0):
+            z = x * 2
+        else:
+            z = x * 5
+        return z
+
+    g = ast_transform(f)
+    x = paddle.to_tensor(np.array([1.0], np.float32))
+    np.testing.assert_allclose(g(x).numpy(), [2.0])
+
+
+def test_nested_if():
+    def f(x):
+        if (x.sum() > 0):
+            if (x.sum() > 10):
+                y = x * 100
+            else:
+                y = x * 2
+        else:
+            y = -x
+        return y
+
+    g = ast_transform(f)
+    np.testing.assert_allclose(
+        g(paddle.to_tensor(np.array([20.0], np.float32))).numpy(), [2000.0])
+    np.testing.assert_allclose(
+        g(paddle.to_tensor(np.array([1.0], np.float32))).numpy(), [2.0])
+    np.testing.assert_allclose(
+        g(paddle.to_tensor(np.array([-1.0], np.float32))).numpy(), [1.0])
+
+
+def test_return_inside_branch_left_alone():
+    def f(x, flag):
+        if flag:
+            return x * 2
+        return x
+
+    g = ast_transform(f)  # escape => untransformed, python semantics
+    x = paddle.to_tensor(np.float32(3.0))
+    np.testing.assert_allclose(g(x, True).numpy(), 6.0)
+    np.testing.assert_allclose(g(x, False).numpy(), 3.0)
+
+
+def test_layer_forward_transformed():
+    import paddle_tpu.nn as nn
+
+    class Net(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc = nn.Linear(4, 4)
+
+        def forward(self, x):
+            h = self.fc(x)
+            if (h.sum() > 0):
+                out = h * 2
+            else:
+                out = h - 1
+            return out
+
+    paddle.seed(0)
+    net = Net()
+    x = paddle.to_tensor(np.random.randn(2, 4).astype(np.float32))
+    eager = Net.forward(net, x)  # untransformed python path (concrete pred)
+    net2 = paddle.jit.to_static(net)
+    out = net2(x)
+    np.testing.assert_allclose(np.asarray(out.numpy()),
+                               np.asarray(eager.numpy()), rtol=1e-6)
+
+
+def test_while_with_body_local_temp():
+    """Temps assigned only inside the loop body must not break the
+    transform (python predicate) or the carry (tensor predicate)."""
+    def f(x, n):
+        i = 0
+        while i < n:
+            tmp = x * 2
+            x = tmp - x + 1
+            i = i + 1
+        return x
+
+    g = ast_transform(f)
+    x = paddle.to_tensor(np.float32(0.0))
+    np.testing.assert_allclose(g(x, 3).numpy(), 3.0)
+
+    def h(x):
+        while (x.sum() < 5):
+            tmp = x + 1
+            x = tmp
+        return x
+
+    g2 = ast_transform(h)
+    np.testing.assert_allclose(
+        g2(paddle.to_tensor(np.array([0.0], np.float32))).numpy(), [5.0])
+
+
+def test_to_static_redecoration_idempotent():
+    import paddle_tpu.nn as nn
+
+    paddle.seed(0)
+    net = nn.Linear(4, 4)
+    net = paddle.jit.to_static(net)
+    net = paddle.jit.to_static(net)  # must not crash
+    x = paddle.to_tensor(np.random.randn(2, 4).astype(np.float32))
+    assert net(x).shape == [2, 4]
+
+
+def test_to_static_backward_trains():
+    """loss.backward() through a @to_static forward must populate parameter
+    grads (paddle to_static-training parity: one tape node spans the whole
+    compiled program)."""
+    import paddle_tpu.nn as nn
+    import paddle_tpu.optimizer as popt
+
+    paddle.seed(0)
+    net = paddle.jit.to_static(nn.Sequential(nn.Linear(6, 12), nn.Tanh(),
+                                             nn.Linear(12, 6)))
+    o = popt.Adam(learning_rate=5e-3, parameters=net.parameters())
+    rng = np.random.default_rng(0)
+    x = paddle.to_tensor(rng.standard_normal((16, 6)).astype(np.float32))
+    y = paddle.to_tensor((np.asarray(x.numpy()) * 0.5).astype(np.float32))
+    mse = nn.MSELoss()
+    losses = []
+    for _ in range(15):
+        loss = mse(net(x), y)
+        loss.backward()
+        o.step()
+        o.clear_grad()
+        losses.append(float(loss.numpy()))
+    assert losses[-1] < losses[0] * 0.5, losses
+
+    # input gradients flow too
+    xg = paddle.to_tensor(rng.standard_normal((4, 6)).astype(np.float32),
+                          stop_gradient=False)
+    out = net(xg).sum()
+    out.backward()
+    assert xg.grad is not None and np.isfinite(xg.grad.numpy()).all()
+
+
+def _fwd_ref_fn(x):
+    if (x.sum() > 0):
+        y = _helper_late(x)  # noqa: F821 — defined later, at call time
+    else:
+        y = -x
+    return y
+
+
+def test_forward_reference_resolves():
+    """Names defined after decoration must resolve at call time (live
+    module globals, not a snapshot)."""
+    g = ast_transform(_fwd_ref_fn)
+    globals()["_helper_late"] = lambda x: x * 10  # defined AFTER transform
+    try:
+        x = paddle.to_tensor(np.array([2.0], np.float32))
+        np.testing.assert_allclose(g(x).numpy(), [20.0])
+    finally:
+        del globals()["_helper_late"]
