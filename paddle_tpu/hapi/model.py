@@ -86,9 +86,7 @@ class Model:
                         f"train_batch: {type(e).__name__}: {e}")
                     self._fast_step = False
                 else:
-                    hook = getattr(self._optimizer, "_post_step_hook", None)
-                    if hook is not None:
-                        hook()  # e.g. ASP re-masking after the compiled step
+                    # (TrainStep.__call__ already ran any _post_step_hook)
                     metrics = self._update_metrics(outputs, labels)
                     return [float(np.asarray(loss.numpy()))], metrics
         outputs = self.network(*inputs)

@@ -167,3 +167,5 @@ class DistributedFusedLamb(Optimizer):
             if v is not None:
                 setattr(self, attr,
                         v._value if isinstance(v, Tensor) else jnp.asarray(v))
+        # restored buffers arrive replicated; re-establish the ZeRO layout
+        self._shard_flat_buffers()

@@ -109,14 +109,25 @@ class StaticFunction:
 
         # differentiable path: ONE tape node spanning the whole compiled
         # program (paddle's to_static-training parity: loss.backward()
-        # through a @to_static forward). The vjp runs the same XLA program.
+        # through a @to_static forward). The vjp runs the same XLA program,
+        # differentiating only the trainable params (frozen ones are closed
+        # over like buffers — no wasted backward compute/residuals).
+        diff_idx = [i for i, p in enumerate(params) if not p.stop_gradient]
+        diff_set = set(diff_idx)
+        diff_vals = [param_vals[i] for i in diff_idx]
+
+        def call(dpv, av, kv):
+            it = iter(dpv)
+            pv = [next(it) if i in diff_set else param_vals[i]
+                  for i in range(len(params))]
+            return self._jitted_nodonate(pv, buffer_vals, av, kv, key,
+                                         training)
+
         (out_vals, new_buffer_vals), vjp_fn = jax.vjp(
-            lambda pv, av, kv: self._jitted_nodonate(
-                pv, buffer_vals, av, kv, key, training),
-            param_vals, arg_vals, kwarg_vals)
+            call, diff_vals, arg_vals, kwarg_vals)
         out_leaves, out_treedef = jax.tree_util.tree_flatten(out_vals)
         buf_zero = jax.tree_util.tree_map(jnp.zeros_like, new_buffer_vals)
-        in_tensors = list(params) + arg_tensors
+        in_tensors = [params[i] for i in diff_idx] + arg_tensors
         n_out = len(out_leaves)
 
         def node_vjp(out_cot):

@@ -87,16 +87,21 @@ def save(layer, path, input_spec=None, **configs):
     with open(path + ".pdiparams", "wb") as f:
         pickle.dump(param_payload, f, protocol=4)
     with open(path + ".meta", "wb") as f:
-        pickle.dump({"param_names": names}, f, protocol=4)
+        pickle.dump({
+            "param_names": names,
+            "input_specs": [(tuple(s.shape), str(np.dtype(s.dtype)))
+                            for s in specs],
+        }, f, protocol=4)
 
 
 class TranslatedLayer:
     """Loaded inference program (parity: paddle.jit.TranslatedLayer)."""
 
-    def __init__(self, exported, params, param_names):
+    def __init__(self, exported, params, param_names, input_specs=None):
         self._exported = exported
         self._params = params
         self._param_names = param_names
+        self._input_specs = input_specs or []
         self.training = False
 
     def __call__(self, *inputs):
@@ -126,4 +131,5 @@ def load(path, **configs) -> TranslatedLayer:
         params = pickle.load(f)
     with open(path + ".meta", "rb") as f:
         meta = pickle.load(f)
-    return TranslatedLayer(exported, params, meta["param_names"])
+    return TranslatedLayer(exported, params, meta["param_names"],
+                           meta.get("input_specs"))

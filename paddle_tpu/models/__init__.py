@@ -30,6 +30,14 @@ from paddle_tpu.models.bert import (  # noqa: F401
     bert_large,
     bert_tiny,
 )
+from paddle_tpu.models.ernie import (  # noqa: F401
+    ErnieConfig,
+    ErnieForMaskedLM,
+    ErnieForSequenceClassification,
+    ErnieModel,
+    ernie_base,
+    ernie_tiny,
+)
 from paddle_tpu.models.kv_cache import (  # noqa: F401
     BlockAllocator,
     PagedCacheSlot,

@@ -106,8 +106,50 @@ def flash_attention(query, key, value, dropout=0.0, causal=False,
     return out, None
 
 
-def flash_attn_unpadded(qkv_or_q, *args, **kwargs):
-    raise NotImplementedError(
-        "varlen flash attention lands with the Pallas ragged kernel; "
-        "pad + mask via scaled_dot_product_attention meanwhile"
-    )
+def flash_attn_unpadded(query, key, value, cu_seqlens_q, cu_seqlens_k,
+                        max_seqlen_q=None, max_seqlen_k=None, scale=None,
+                        dropout=0.0, causal=False, return_softmax=False,
+                        fixed_seed_offset=None, rng_name="", training=True,
+                        name=None):
+    """Varlen (packed) attention (parity:
+    python/paddle/nn/functional/flash_attention.py:455 flash_attn_unpadded,
+    kernel phi/kernels/gpu/flash_attn_kernel.cu varlen path).
+
+    ``query/key/value``: [total_tokens, num_heads, head_dim] — sequences
+    packed back-to-back; ``cu_seqlens_*``: [batch+1] int32 cumulative
+    lengths. Attention is segment-masked so tokens only attend within their
+    own sequence (XLA fuses the mask into the softmax; a Pallas splash
+    ragged kernel is the drop-in upgrade path)."""
+    if scale is None:
+        scale = 1.0 / math.sqrt(query.shape[-1])
+
+    def f(q, k, v, cu_q, cu_k):
+        tq = q.shape[0]
+        tk = k.shape[0]
+        # segment id per token: index of the sequence it belongs to
+        seg_q = jnp.searchsorted(cu_q, jnp.arange(tq), side="right") - 1
+        seg_k = jnp.searchsorted(cu_k, jnp.arange(tk), side="right") - 1
+        logits = jnp.einsum("qhd,khd->hqk", q.astype(jnp.float32),
+                            k.astype(jnp.float32)) * scale
+        mask = seg_q[:, None] == seg_k[None, :]
+        if causal:
+            # position within the sequence (works for equal q/k packing)
+            pos_q = jnp.arange(tq) - cu_q[seg_q]
+            pos_k = jnp.arange(tk) - cu_k[seg_k]
+            mask = mask & (pos_k[None, :] <= pos_q[:, None])
+        logits = jnp.where(mask[None, :, :], logits, -jnp.inf)
+        probs = jax.nn.softmax(logits, axis=-1)
+        # fully-masked rows (padding) produce NaN from softmax(-inf): zero
+        probs = jnp.where(mask[None, :, :], probs, 0.0)
+        if dropout > 0.0 and training:
+            keep = jax.random.bernoulli(rng.next_key(), 1.0 - dropout,
+                                        probs.shape)
+            probs = jnp.where(keep, probs / (1.0 - dropout), 0.0)
+        out = jnp.einsum("hqk,khd->qhd", probs.astype(v.dtype), v)
+        return out
+
+    out = apply("flash_attn_unpadded", f, query, key, value,
+                cu_seqlens_q, cu_seqlens_k)
+    # second element is the softmax placeholder (not materialized, as in the
+    # reference when return_softmax=False; fused path never exposes it)
+    return out, None

@@ -1,0 +1,219 @@
+"""Third OpTest batch: linalg / loss / activation / normalization / padding
+families (reference coverage model: test/legacy_test/test_*_op.py)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+from tests.op_test import OpTest
+
+rng = np.random.default_rng(11)
+
+
+class TestMatmulTransposeOp(OpTest):
+    op = staticmethod(paddle.matmul)
+    attrs = {"transpose_y": True}
+    inputs = {
+        "x": rng.standard_normal((3, 4, 5)).astype(np.float32),
+        "y": rng.standard_normal((3, 6, 5)).astype(np.float32),
+    }
+
+    @staticmethod
+    def ref(x, y, transpose_y):
+        return np.matmul(x, np.swapaxes(y, -1, -2))
+
+    def test(self):
+        self.check_output(rtol=1e-5)
+        self.check_grad(["x", "y"], rtol=2e-2, atol=2e-2, eps=1e-2)
+
+
+class TestSoftmaxOp(OpTest):
+    op = staticmethod(F.softmax)
+    attrs = {"axis": -1}
+    inputs = {"x": rng.standard_normal((4, 7)).astype(np.float32)}
+
+    @staticmethod
+    def ref(x, axis):
+        e = np.exp(x - x.max(axis, keepdims=True))
+        return e / e.sum(axis, keepdims=True)
+
+    def test(self):
+        self.check_output(rtol=1e-5)
+        self.check_grad(["x"], rtol=2e-2, atol=2e-2, eps=1e-2)
+
+
+class TestLogSoftmaxOp(OpTest):
+    op = staticmethod(F.log_softmax)
+    attrs = {"axis": -1}
+    inputs = {"x": rng.standard_normal((3, 9)).astype(np.float32)}
+
+    @staticmethod
+    def ref(x, axis):
+        m = x.max(axis, keepdims=True)
+        return x - m - np.log(np.exp(x - m).sum(axis, keepdims=True))
+
+    def test(self):
+        self.check_output(rtol=1e-5)
+        self.check_grad(["x"], rtol=2e-2, atol=2e-2, eps=1e-2)
+
+
+class TestSiluOp(OpTest):
+    op = staticmethod(F.silu)
+    attrs = {}
+    inputs = {"x": rng.standard_normal((5, 6)).astype(np.float32)}
+
+    @staticmethod
+    def ref(x):
+        return x / (1.0 + np.exp(-x))
+
+    def test(self):
+        self.check_output(rtol=1e-5)
+        self.check_grad(["x"], rtol=2e-2, atol=2e-2, eps=1e-2)
+
+
+class TestMishOp(OpTest):
+    op = staticmethod(F.mish)
+    attrs = {}
+    inputs = {"x": rng.standard_normal((4, 4)).astype(np.float32)}
+
+    @staticmethod
+    def ref(x):
+        return x * np.tanh(np.log1p(np.exp(x)))
+
+    def test(self):
+        self.check_output(rtol=1e-5)
+        self.check_grad(["x"], rtol=2e-2, atol=2e-2, eps=1e-2)
+
+
+class TestSmoothL1Op(OpTest):
+    op = staticmethod(F.smooth_l1_loss)
+    attrs = {"reduction": "mean"}
+    inputs = {
+        "input": rng.standard_normal((6, 3)).astype(np.float32),
+        "label": rng.standard_normal((6, 3)).astype(np.float32),
+    }
+
+    @staticmethod
+    def ref(input, label, reduction):
+        d = np.abs(input - label)
+        # paddle smooth_l1 uses delta=1.0
+        out = np.where(d < 1.0, 0.5 * d * d, d - 0.5)
+        return out.mean()
+
+    def test(self):
+        self.check_output(rtol=1e-5)
+        self.check_grad(["input"], rtol=2e-2, atol=2e-2, eps=1e-2)
+
+
+class TestKLDivOp(OpTest):
+    op = staticmethod(F.kl_div)
+    attrs = {"reduction": "mean"}
+    inputs = {
+        "input": np.log(rng.uniform(0.1, 1.0, (4, 5)).astype(np.float32)),
+        "label": rng.uniform(0.1, 1.0, (4, 5)).astype(np.float32),
+    }
+
+    @staticmethod
+    def ref(input, label, reduction):
+        return (label * (np.log(label) - input)).mean()
+
+    def test(self):
+        self.check_output(rtol=1e-5)
+
+
+class TestTriangularSolveOp(OpTest):
+    op = staticmethod(paddle.linalg.triangular_solve)
+    attrs = {"upper": False}
+    inputs = {
+        "x": np.tril(rng.standard_normal((4, 4)).astype(np.float32))
+        + 4 * np.eye(4, dtype=np.float32),
+        "y": rng.standard_normal((4, 2)).astype(np.float32),
+    }
+
+    @staticmethod
+    def ref(x, y, upper):
+        import scipy.linalg
+
+        return scipy.linalg.solve_triangular(x, y, lower=True)
+
+    def test(self):
+        try:
+            import scipy  # noqa: F401
+        except ImportError:
+            pytest.skip("scipy unavailable")
+        self.check_output(rtol=1e-4, atol=1e-5)
+
+
+class TestPadOp(OpTest):
+    op = staticmethod(F.pad)
+    attrs = {"pad": [1, 2], "mode": "constant", "value": 0.5}
+    inputs = {"x": rng.standard_normal((3, 4)).astype(np.float32)}
+
+    @staticmethod
+    def ref(x, pad, mode, value):
+        return np.pad(x, ((0, 0), (pad[0], pad[1])), constant_values=value)
+
+    def test(self):
+        self.check_output()
+        self.check_grad(["x"])
+
+
+class TestCumprodOp(OpTest):
+    op = staticmethod(paddle.cumprod)
+    attrs = {"dim": 1}
+    inputs = {"x": rng.uniform(0.5, 1.5, (3, 5)).astype(np.float32)}
+
+    @staticmethod
+    def ref(x, dim):
+        return np.cumprod(x, axis=dim)
+
+    def test(self):
+        self.check_output(rtol=1e-5)
+        self.check_grad(["x"], rtol=2e-2, atol=2e-2, eps=1e-2)
+
+
+class TestLogcumsumexpOp(OpTest):
+    op = staticmethod(paddle.logcumsumexp)
+    attrs = {"axis": 1}
+    inputs = {"x": rng.standard_normal((2, 6)).astype(np.float32)}
+
+    @staticmethod
+    def ref(x, axis):
+        return np.log(np.cumsum(np.exp(x), axis=axis))
+
+    def test(self):
+        self.check_output(rtol=1e-5)
+
+
+class TestDiffOp(OpTest):
+    op = staticmethod(paddle.diff)
+    attrs = {"axis": -1}
+    inputs = {"x": rng.standard_normal((3, 7)).astype(np.float32)}
+
+    @staticmethod
+    def ref(x, axis):
+        return np.diff(x, axis=axis)
+
+    def test(self):
+        self.check_output()
+        self.check_grad(["x"])
+
+
+class TestRenormOp(OpTest):
+    op = staticmethod(paddle.renorm)
+    attrs = {"p": 2.0, "axis": 0, "max_norm": 1.0}
+    inputs = {"x": rng.standard_normal((4, 6)).astype(np.float32) * 2}
+
+    @staticmethod
+    def ref(x, p, axis, max_norm):
+        out = x.copy()
+        for i in range(x.shape[axis]):
+            row = np.take(x, i, axis=axis)
+            n = np.linalg.norm(row.ravel(), ord=p)
+            if n > max_norm:
+                out[i] = row * (max_norm / n)
+        return out
+
+    def test(self):
+        self.check_output(rtol=1e-5)
