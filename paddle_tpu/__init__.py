@@ -81,6 +81,7 @@ from paddle_tpu import audio  # noqa: E402,F401
 from paddle_tpu import onnx  # noqa: E402,F401
 from paddle_tpu import inference  # noqa: E402,F401
 from paddle_tpu.ops import linalg  # noqa: E402,F401
+from paddle_tpu import utils  # noqa: E402,F401
 from paddle_tpu.framework.flags import get_flags, set_flags  # noqa: E402,F401
 from paddle_tpu.framework.io import load, save  # noqa: E402,F401
 

@@ -27,7 +27,7 @@ from typing import Any, Callable, Optional
 
 import jax
 import jax.numpy as jnp
-from jax.experimental.shard_map import shard_map
+from paddle_tpu.ops.ring_attention import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 

@@ -141,6 +141,10 @@ class Predictor:
         """Execute. Either pass ``inputs`` (list of ndarrays, returned as
         ndarrays — the modern python API) or use the handle protocol."""
         if inputs is not None:
+            if len(inputs) != len(self._input_names):
+                raise ValueError(
+                    f"model expects {len(self._input_names)} inputs, "
+                    f"got {len(inputs)}")
             for n, a in zip(self._input_names, inputs):
                 self._inputs[n].copy_from_cpu(np.asarray(a))
         xs = [self._inputs[n]._value for n in self._input_names]

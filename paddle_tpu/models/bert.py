@@ -73,7 +73,8 @@ class BertEmbeddings(nn.Layer):
         self.dropout = nn.Dropout(cfg.hidden_dropout)
         self._cfg = cfg
 
-    def forward(self, input_ids, token_type_ids=None, position_ids=None):
+    def forward(self, input_ids, token_type_ids=None, position_ids=None,
+                extra_embedding=None):
         seq_len = input_ids.shape[-1]
         if seq_len > self._cfg.max_position_embeddings:
             raise ValueError(
@@ -85,6 +86,9 @@ class BertEmbeddings(nn.Layer):
             self.position_embeddings(position_ids)
         if token_type_ids is not None:
             h = h + self.token_type_embeddings(token_type_ids)
+        if extra_embedding is not None:
+            # ERNIE-style additional input embedding (task type etc.)
+            h = h + extra_embedding
         return self.dropout(self.layer_norm(h))
 
 
@@ -140,12 +144,13 @@ class BertModel(nn.Layer):
         self.pooler = nn.Linear(cfg.hidden_size, cfg.hidden_size)
 
     def forward(self, input_ids, token_type_ids=None, position_ids=None,
-                attention_mask=None):
+                attention_mask=None, extra_embedding=None):
         if attention_mask is not None:
             # [B, S] 1/0 -> additive [B, 1, 1, S]
             m = paddle.unsqueeze(attention_mask.astype("float32"), [1, 2])
             attention_mask = (m - 1.0) * 1e4
-        h = self.embeddings(input_ids, token_type_ids, position_ids)
+        h = self.embeddings(input_ids, token_type_ids, position_ids,
+                            extra_embedding)
         for layer in self.encoder:
             h = layer(h, attention_mask)
         pooled = paddle.tanh(self.pooler(h[:, 0]))

@@ -58,27 +58,13 @@ class ErnieModel(nn.Layer):
 
     def forward(self, input_ids, token_type_ids=None, position_ids=None,
                 attention_mask=None, task_type_ids=None):
-        if attention_mask is not None:
-            # [B, S] 1/0 -> additive [B, 1, 1, S] (BertModel convention)
-            m = paddle.unsqueeze(attention_mask.astype("float32"), [1, 2])
-            attention_mask = (m - 1.0) * 1e4
-        emb = self.bert.embeddings
-        h = emb.word_embeddings(input_ids)
-        seq_len = input_ids.shape[-1]
-        if position_ids is None:
-            position_ids = paddle.arange(0, seq_len, dtype="int32")
-        h = h + emb.position_embeddings(position_ids)
-        if token_type_ids is not None:
-            h = h + emb.token_type_embeddings(token_type_ids)
+        extra = None
         if self.config.use_task_id:
             if task_type_ids is None:
                 task_type_ids = paddle.zeros_like(input_ids)
-            h = h + self.task_type_embeddings(task_type_ids)
-        h = emb.dropout(emb.layer_norm(h))
-        for layer in self.bert.encoder:
-            h = layer(h, attention_mask)
-        pooled = paddle.tanh(self.bert.pooler(h[:, 0]))
-        return h, pooled
+            extra = self.task_type_embeddings(task_type_ids)
+        return self.bert(input_ids, token_type_ids, position_ids,
+                         attention_mask, extra_embedding=extra)
 
 
 class ErnieForSequenceClassification(nn.Layer):

@@ -133,10 +133,17 @@ def flash_attn_unpadded(query, key, value, cu_seqlens_q, cu_seqlens_k,
                             k.astype(jnp.float32)) * scale
         mask = seg_q[:, None] == seg_k[None, :]
         if causal:
-            # position within the sequence (works for equal q/k packing)
+            # positions aligned to sequence ENDS so unequal q/k packings
+            # (decode: 1 query vs L cached keys) mask correctly — the
+            # reference kernel's causal convention for varlen
             pos_q = jnp.arange(tq) - cu_q[seg_q]
             pos_k = jnp.arange(tk) - cu_k[seg_k]
-            mask = mask & (pos_k[None, :] <= pos_q[:, None])
+            # k-length and q-length of each QUERY's segment: query i may see
+            # keys with pos_k <= pos_q[i] + (len_k - len_q)
+            len_q = cu_q[seg_q + 1] - cu_q[seg_q]
+            len_k = cu_k[seg_q + 1] - cu_k[seg_q]
+            shift = (len_k - len_q)[:, None]
+            mask = mask & (pos_k[None, :] <= pos_q[:, None] + shift)
         logits = jnp.where(mask[None, :, :], logits, -jnp.inf)
         probs = jax.nn.softmax(logits, axis=-1)
         # fully-masked rows (padding) produce NaN from softmax(-inf): zero

@@ -30,7 +30,7 @@ try:
     def shard_map(f, mesh, in_specs, out_specs, check_rep=False):
         return _shard_map(f, mesh=mesh, in_specs=in_specs,
                           out_specs=out_specs, check_vma=check_rep)
-except ImportError:  # pragma: no cover
+except ImportError:  # pragma: no cover — jax < 0.8
     from jax.experimental.shard_map import shard_map as _legacy
 
     def shard_map(f, mesh, in_specs, out_specs, check_rep=False):

@@ -1,0 +1,93 @@
+"""Custom C++ op extension (parity: python/paddle/utils/cpp_extension +
+test/custom_op/ custom_relu pattern): JIT-compile, run, differentiate."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.utils import cpp_extension
+
+SRC = r"""
+#include <cstdint>
+#include <cmath>
+
+extern "C" void custom_relu_fwd(const float* x, float* y, int64_t n) {
+  for (int64_t i = 0; i < n; ++i) y[i] = x[i] > 0.f ? x[i] : 0.f;
+}
+
+extern "C" void custom_relu_bwd(const float* x, const float* dy, float* dx,
+                                int64_t n) {
+  for (int64_t i = 0; i < n; ++i) dx[i] = x[i] > 0.f ? dy[i] : 0.f;
+}
+
+extern "C" void custom_sqr_fwd(const float* x, float* y, int64_t n) {
+  for (int64_t i = 0; i < n; ++i) y[i] = x[i] * x[i];
+}
+"""
+
+
+@pytest.fixture(scope="module")
+def ops(tmp_path_factory):
+    d = tmp_path_factory.mktemp("ext")
+    src = d / "custom_ops.cc"
+    src.write_text(SRC)
+    return cpp_extension.load(name="custom_jit_ops", sources=[str(src)])
+
+
+def test_custom_op_forward(ops):
+    x = np.array([-1.0, 0.5, 2.0, -3.0], np.float32)
+    out = ops.custom_relu(paddle.to_tensor(x))
+    np.testing.assert_allclose(out.numpy(), np.maximum(x, 0))
+
+
+def test_custom_op_backward(ops):
+    x = paddle.to_tensor(np.array([-1.0, 0.5, 2.0], np.float32),
+                         stop_gradient=False)
+    y = ops.custom_relu(x).sum()
+    y.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [0.0, 1.0, 1.0])
+
+
+def test_custom_op_inside_jit(ops):
+    def f(x):
+        return ops.custom_relu(x) * 2
+
+    sf = paddle.jit.to_static(f)
+    x = paddle.to_tensor(np.array([-2.0, 3.0], np.float32))
+    np.testing.assert_allclose(np.asarray(sf(x).numpy()), [0.0, 6.0])
+
+
+def test_custom_op_without_bwd_not_differentiable(ops):
+    x = paddle.to_tensor(np.array([2.0], np.float32), stop_gradient=False)
+    out = ops.custom_sqr(x)
+    np.testing.assert_allclose(out.numpy(), [4.0])
+    assert out.stop_gradient  # recorded as non-differentiable
+
+
+def test_custom_op_in_layer_training(ops):
+    import paddle_tpu.nn as nn
+
+    paddle.seed(0)
+
+    class Net(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc = nn.Linear(4, 4)
+
+        def forward(self, x):
+            return ops.custom_relu(self.fc(x))
+
+    net = Net()
+    opt = paddle.optimizer.SGD(learning_rate=0.1,
+                               parameters=net.parameters())
+    x = paddle.to_tensor(np.random.randn(8, 4).astype(np.float32))
+    y = paddle.to_tensor(np.random.randn(8, 4).astype(np.float32))
+    mse = nn.MSELoss()
+    l0 = None
+    for _ in range(5):
+        loss = mse(net(x), y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        l0 = l0 or float(loss.numpy())
+    assert float(loss.numpy()) < l0

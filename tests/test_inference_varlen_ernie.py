@@ -113,3 +113,55 @@ def test_ernie_masked_lm_shape():
         np.random.default_rng(0).integers(0, 1000, (2, 12)).astype(np.int64))
     out = model(ids)
     assert out.shape == [2, 12, 1024]
+
+
+def test_flash_attn_unpadded_decode_packing():
+    """Unequal q/k packing (1 query vs L cached keys per sequence): causal
+    alignment to sequence ends means each query sees ALL its keys."""
+    from paddle_tpu.ops.pallas.flash_attention import flash_attn_unpadded
+    import math
+
+    rng = np.random.default_rng(2)
+    H, D = 2, 4
+    klens = [5, 3]
+    cu_q = np.array([0, 1, 2], np.int32)
+    cu_k = np.concatenate([[0], np.cumsum(klens)]).astype(np.int32)
+    q = rng.standard_normal((2, H, D)).astype(np.float32)
+    k = rng.standard_normal((sum(klens), H, D)).astype(np.float32)
+    v = rng.standard_normal((sum(klens), H, D)).astype(np.float32)
+
+    out, _ = flash_attn_unpadded(
+        paddle.to_tensor(q), paddle.to_tensor(k), paddle.to_tensor(v),
+        paddle.to_tensor(cu_q), paddle.to_tensor(cu_k), causal=True)
+    out_np = np.asarray(out.numpy())
+
+    for b in range(2):
+        lo, hi = cu_k[b], cu_k[b + 1]
+        for h in range(H):
+            s = (k[lo:hi, h] @ q[b, h]) / math.sqrt(D)
+            p = np.exp(s - s.max()); p /= p.sum()
+            np.testing.assert_allclose(out_np[b, h], p @ v[lo:hi, h],
+                                       rtol=1e-4, atol=1e-5)
+
+
+def test_ernie_rejects_overlong_sequence():
+    from paddle_tpu.models import ErnieModel
+
+    model = ErnieModel(ernie_tiny())
+    ids = paddle.to_tensor(np.zeros((1, 256), np.int64))  # max is 128
+    with pytest.raises(ValueError):
+        model(ids)
+
+
+def test_predictor_validates_input_count(tmp_path):
+    paddle.seed(0)
+    net = nn.Linear(4, 2)
+    net.eval()
+    x = paddle.to_tensor(np.random.randn(2, 4).astype(np.float32))
+    prefix = str(tmp_path / "m")
+    paddle.jit.save(net, prefix, input_spec=[x])
+    from paddle_tpu import inference
+
+    pred = inference.create_predictor(inference.Config(prefix))
+    with pytest.raises(ValueError):
+        pred.run([np.zeros((2, 4), np.float32), np.zeros((2, 4), np.float32)])

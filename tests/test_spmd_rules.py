@@ -1,0 +1,115 @@
+"""SPMD sharding-propagation oracles: GSPMD must propagate shardings the way
+the reference's explicit per-op rules do (paddle/phi/infermeta/spmd_rules/
+{matmul,embedding,layer_norm,reduction,elementwise}.cc) — SURVEY §2.1 says
+those rules serve as test oracles for the GSPMD-delegation design."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+requires_8 = pytest.mark.skipif(
+    jax.device_count() < 8, reason="needs 8 virtual devices")
+
+
+def _mesh():
+    return Mesh(np.array(jax.devices()[:8]).reshape(2, 4), ("dp", "mp"))
+
+
+def _spec_of(arr):
+    return arr.sharding.spec
+
+
+@requires_8
+def test_matmul_row_parallel_propagates_batch_shard():
+    # matmul.cc rule: x[M(dp), K] @ w[K, N] -> out[M(dp), N]
+    mesh = _mesh()
+    x = jax.device_put(np.ones((8, 16), np.float32),
+                       NamedSharding(mesh, P("dp", None)))
+    w = jax.device_put(np.ones((16, 4), np.float32),
+                       NamedSharding(mesh, P(None, None)))
+    out = jax.jit(jnp.matmul)(x, w)
+    assert _spec_of(out) == P("dp", None), _spec_of(out)
+
+
+@requires_8
+def test_matmul_column_parallel_propagates_out_shard():
+    # matmul.cc rule: x[M, K] @ w[K, N(mp)] -> out[M, N(mp)]
+    mesh = _mesh()
+    x = jax.device_put(np.ones((8, 16), np.float32),
+                       NamedSharding(mesh, P(None, None)))
+    w = jax.device_put(np.ones((16, 8), np.float32),
+                       NamedSharding(mesh, P(None, "mp")))
+    out = jax.jit(jnp.matmul)(x, w)
+    assert _spec_of(out) == P(None, "mp"), _spec_of(out)
+
+
+@requires_8
+def test_matmul_contracting_shard_allreduces():
+    # matmul.cc rule: x[M, K(mp)] @ w[K(mp), N] -> out partial over mp,
+    # resolved by an all-reduce; the materialized output must be correct
+    # and mp-unsharded (row-parallel linear semantics)
+    mesh = _mesh()
+    rng = np.random.default_rng(0)
+    xv = rng.standard_normal((4, 8)).astype(np.float32)
+    wv = rng.standard_normal((8, 4)).astype(np.float32)
+    x = jax.device_put(xv, NamedSharding(mesh, P(None, "mp")))
+    w = jax.device_put(wv, NamedSharding(mesh, P("mp", None)))
+    out = jax.jit(jnp.matmul)(x, w)
+    np.testing.assert_allclose(np.asarray(out), xv @ wv, rtol=1e-5)
+    spec = _spec_of(out)
+    assert "mp" not in jax.tree_util.tree_leaves(spec), spec
+
+
+@requires_8
+def test_elementwise_preserves_sharding():
+    # elementwise.cc rule: unary ops pass the input dist_attr through
+    mesh = _mesh()
+    x = jax.device_put(np.ones((8, 8), np.float32),
+                       NamedSharding(mesh, P("dp", "mp")))
+    out = jax.jit(jnp.tanh)(x)
+    assert _spec_of(out) == P("dp", "mp"), _spec_of(out)
+
+
+@requires_8
+def test_reduction_removes_reduced_axis_shard():
+    # reduction.cc rule: sum over a sharded axis -> partial -> all-reduced;
+    # sum over an unsharded axis keeps the batch shard
+    mesh = _mesh()
+    x = jax.device_put(np.ones((8, 16), np.float32),
+                       NamedSharding(mesh, P("dp", None)))
+    out = jax.jit(lambda a: jnp.sum(a, axis=1))(x)
+    assert _spec_of(out) == P("dp"), _spec_of(out)
+
+
+@requires_8
+def test_layer_norm_keeps_batch_shard():
+    # layer_norm.cc rule: normalized (last) dims replicated, batch dims
+    # keep their shard
+    mesh = _mesh()
+    x = jax.device_put(np.random.rand(8, 16).astype(np.float32),
+                       NamedSharding(mesh, P("dp", None)))
+
+    def ln(a):
+        mu = a.mean(-1, keepdims=True)
+        var = ((a - mu) ** 2).mean(-1, keepdims=True)
+        return (a - mu) * jax.lax.rsqrt(var + 1e-5)
+
+    out = jax.jit(ln)(x)
+    assert _spec_of(out) == P("dp", None), _spec_of(out)
+
+
+@requires_8
+def test_embedding_vocab_sharded_gather_correct():
+    # embedding.cc rule: vocab-sharded table gather -> partial(sum) output
+    # resolved to replicated; values must match the unsharded gather
+    mesh = _mesh()
+    rng = np.random.default_rng(1)
+    table = rng.standard_normal((64, 8)).astype(np.float32)
+    ids = rng.integers(0, 64, (4, 6))
+    t = jax.device_put(table, NamedSharding(mesh, P("mp", None)))
+    ids_d = jax.device_put(ids, NamedSharding(mesh, P(None, None)))
+    out = jax.jit(lambda tb, i: tb[i])(t, ids_d)
+    np.testing.assert_allclose(np.asarray(out), table[ids], rtol=1e-6)
