@@ -511,35 +511,102 @@ def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
                     "conv3d")
 
 
-def conv2d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0,
-                     groups=1, dilation=1, data_format="NCHW", output_size=None,
-                     name=None):
-    strides = _pair(stride, 2)
-    dilations = _pair(dilation, 2)
-    p = _pair(padding, 2)
-    pad = [(pi, pi) for pi in p] if not isinstance(padding, str) else padding.upper()
+def _conv_transpose_nd(x, weight, bias, stride, padding, output_padding,
+                       groups, dilation, data_format, nd, name,
+                       output_size=None):
+    """Transposed conv as the gradient-style conv: spatially-flipped,
+    in/out-swapped kernel over the stride-dilated input
+    (lax.conv_general_dilated with lhs_dilation — the canonical XLA lowering;
+    reference kernel: phi conv2d_transpose/conv3d_transpose).
 
-    dn = jax.lax.conv_dimension_numbers(
-        x._value.shape, weight._value.shape,
-        ("NCHW", "IOHW", "NCHW") if data_format == "NCHW" else ("NHWC", "IOHW", "NHWC"),
-    )
+    paddle weight layout: [C_in, C_out/groups, *k]. Output spatial size:
+    (in-1)*stride - 2*pad + dilation*(k-1) + 1 + output_padding.
+    """
+    strides = _pair(stride, nd)
+    dilations = _pair(dilation, nd)
+    channels_last = not data_format.startswith("NC")
+    spatial = "DHW"[-nd:]
+    lhs_spec = ("N" + spatial + "C") if channels_last else ("NC" + spatial)
+    ksp = weight._value.shape[2:]
+    if isinstance(padding, str):
+        if padding.upper() == "VALID":
+            p = [0] * nd
+        elif padding.upper() == "SAME":
+            # out = in * stride: total pad = d*(k-1) + 1 - s (clamped)
+            p = [max(dilations[i] * (ksp[i] - 1) + 1 - strides[i], 0) // 2
+                 for i in range(nd)]
+        else:
+            raise ValueError(padding)
+    else:
+        p = _pair(padding, nd)
+    if output_size is not None:
+        # derive output_padding from the requested spatial size (paddle's
+        # output_size knob): op = out - ((in-1)*s - 2p + d*(k-1) + 1)
+        in_sp = (x._value.shape[1:1 + nd] if channels_last
+                 else x._value.shape[2:2 + nd])
+        out_sp = list(output_size)[-nd:]
+        op = []
+        for i in range(nd):
+            base = ((in_sp[i] - 1) * strides[i] - 2 * p[i]
+                    + dilations[i] * (ksp[i] - 1) + 1)
+            opi = int(out_sp[i]) - base
+            if not 0 <= opi < strides[i] + dilations[i]:
+                raise ValueError(
+                    f"output_size {out_sp} unreachable (dim {i}: base {base})")
+            op.append(opi)
+    else:
+        op = _pair(output_padding, nd)
 
     def f(a, w, *b):
-        out = jax.lax.conv_transpose(
-            a, w, strides=strides,
-            padding=pad if isinstance(pad, str) else pad,
-            rhs_dilation=dilations, dimension_numbers=dn,
-            transpose_kernel=True,
-        )
+        cin = w.shape[0]
+        cog = w.shape[1]  # C_out / groups
+        k = w.shape[2:]
+        # [C_in, C_out/g, *k] -> [g, C_in/g, C_out/g, *k] -> swap ->
+        # [C_out, C_in/g, *k], then flip spatial taps
+        wg = w.reshape((groups, cin // groups, cog) + k)
+        wg = jnp.swapaxes(wg, 1, 2).reshape((groups * cog, cin // groups) + k)
+        wg = jnp.flip(wg, axis=tuple(range(2, 2 + nd)))
+        pad = [(dilations[i] * (k[i] - 1) - p[i],
+                dilations[i] * (k[i] - 1) - p[i] + op[i]) for i in range(nd)]
+        dn = jax.lax.conv_dimension_numbers(
+            a.shape, wg.shape, (lhs_spec, "OI" + spatial, lhs_spec))
+        out = jax.lax.conv_general_dilated(
+            a, wg, window_strides=(1,) * nd, padding=pad,
+            lhs_dilation=strides, rhs_dilation=dilations,
+            dimension_numbers=dn, feature_group_count=groups)
         if b:
-            ch_axis = 1 if data_format == "NCHW" else 3
             shape = [1] * out.ndim
+            ch_axis = out.ndim - 1 if channels_last else 1
             shape[ch_axis] = b[0].size
             out = out + b[0].reshape(shape)
         return out.astype(a.dtype)
 
     args = [bias] if bias is not None else []
-    return apply("conv2d_transpose", f, x, weight, *args)
+    return apply(name, f, x, weight, *args)
+
+
+def conv2d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0,
+                     groups=1, dilation=1, data_format="NCHW", output_size=None,
+                     name=None):
+    return _conv_transpose_nd(x, weight, bias, stride, padding, output_padding,
+                              groups, dilation, data_format, 2,
+                              "conv2d_transpose", output_size=output_size)
+
+
+def conv1d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1,
+                     data_format="NCL", output_size=None, name=None):
+    return _conv_transpose_nd(x, weight, bias, stride, padding, output_padding,
+                              groups, dilation, data_format, 1,
+                              "conv1d_transpose", output_size=output_size)
+
+
+def conv3d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1,
+                     data_format="NCDHW", output_size=None, name=None):
+    return _conv_transpose_nd(x, weight, bias, stride, padding, output_padding,
+                              groups, dilation, data_format, 3,
+                              "conv3d_transpose", output_size=output_size)
 
 
 # ------------------------------------------------------------------- pooling
