@@ -157,29 +157,237 @@ class SharedLayerDesc(LayerDesc):
         self.shared_weight_attr = shared_weight_attr
 
 
+def hetero_spmd_pipeline(stage_fns, x, y, *, mesh, num_microbatches,
+                         act_shape, act_dtype, axis="pp"):
+    """GPipe wavefront over HETEROGENEOUS stages (embedding / blocks / head).
+
+    stage_fns[s](h, x_m, y_m) -> (h_out, loss_m): h_out must have the uniform
+    inter-stage activation shape ``act_shape`` for every stage; only the last
+    stage returns a nonzero loss_m. Stage dispatch is a lax.switch on the
+    device's pp index — XLA's HLO conditional runs only the taken branch, so
+    each device executes exactly its own stage's computation (the SPMD
+    equivalent of the reference's per-rank PipelineLayer partition,
+    pp_layers.py:257). Stage params ride in via Python closure (replicated);
+    shard_map autodiff psums their cotangents, so each stage's grads emerge
+    correct and the rest zero.
+
+    Returns mean loss over microbatches (a scalar).
+    """
+    S = mesh.shape[axis]
+    M = num_microbatches
+    B = x.shape[0]
+    assert B % M == 0
+    mb = B // M
+    assert len(stage_fns) == S
+
+    def pipelined(x_local, y_local):
+        stage = jax.lax.axis_index(axis)
+        T = M + S - 1
+        fwd_perm = [(i, (i + 1) % S) for i in range(S)]
+        state = jnp.zeros((mb,) + tuple(act_shape), act_dtype)
+
+        def tick(carry, t):
+            state, loss_acc = carry
+            slot = jnp.clip(t - stage, 0, M - 1)
+            x_m = jax.lax.dynamic_index_in_dim(x_local, slot, 0,
+                                               keepdims=False)
+            y_m = jax.lax.dynamic_index_in_dim(y_local, slot, 0,
+                                               keepdims=False)
+            branches = [
+                (lambda h, xm, ym, fn=fn: fn(h, xm, ym)) for fn in stage_fns
+            ]
+            h_out, loss_m = jax.lax.switch(stage, branches, state, x_m, y_m)
+            # only count losses for valid wavefront slots on the last stage
+            valid = jnp.logical_and(t >= S - 1, t - (S - 1) <= M - 1)
+            loss_acc = loss_acc + jnp.where(
+                jnp.logical_and(stage == S - 1, valid),
+                loss_m.astype(jnp.float32), 0.0)
+            state = jax.lax.ppermute(h_out, axis, fwd_perm)
+            return (state, loss_acc), None
+
+        (_, loss_acc), _ = jax.lax.scan(
+            tick, (state, jnp.zeros((), jnp.float32)), jnp.arange(T))
+        return jax.lax.psum(loss_acc, axis)[None] / M
+
+    x_mb = x.reshape(M, mb, *x.shape[1:])
+    y_mb = y.reshape(M, mb, *y.shape[1:])
+    loss = shard_map(
+        pipelined, mesh=mesh, in_specs=(P(), P()), out_specs=P(axis),
+        check_rep=False,
+    )(x_mb, y_mb)
+    return loss[0]
+
+
 class PipelineLayer:
-    """Structural parity with pp_layers.py:257 PipelineLayer: holds the layer
-    list and the partition; execution is via the SPMD engine above (used by
-    models/gpt.py) rather than a per-rank runtime."""
+    """PipelineLayer parity (pp_layers.py:257): builds the layer list,
+    partitions it into stages (get_stage_layers), honors SharedLayerDesc
+    weight sharing by key, and executes train_batch through the heterogeneous
+    SPMD pipeline engine above."""
 
     def __init__(self, layers, num_stages=None, topology=None, loss_fn=None,
                  seg_method="uniform", recompute_interval=0, **kwargs):
         self.descs = list(layers)
         self.num_stages = num_stages or 1
         self.loss_fn = loss_fn
-        self._built = [
-            d.build_layer() if isinstance(d, LayerDesc) else d for d in self.descs
-        ]
+        self.seg_method = seg_method
+        self._shared = {}  # SharedLayerDesc key -> built layer (weight tying)
+        self._built = []
+        for d in self.descs:
+            if isinstance(d, SharedLayerDesc):
+                d._is_reuse = d.layer_name in self._shared
+                if not d._is_reuse:
+                    self._shared[d.layer_name] = d.build_layer()
+                self._built.append((d, self._shared[d.layer_name]))
+            elif isinstance(d, LayerDesc):
+                self._built.append((d, d.build_layer()))
+            else:
+                self._built.append((None, d))
+        self._stage_bounds = self._segment()
+
+    def _segment(self):
+        """Uniform partition bounds (seg_method='uniform'); 'layer:<cls>'
+        splits at occurrences of a class name like the reference."""
+        n = len(self._built)
+        S = self.num_stages
+        if self.seg_method.startswith("layer:"):
+            cls_name = self.seg_method.split(":", 1)[1]
+            marks = [i for i, (_, l) in enumerate(self._built)
+                     if type(l).__name__ == cls_name]
+            if len(marks) >= S:
+                # first stage starts at 0; later stages start at marks
+                step = len(marks) // S
+                starts = [0] + [marks[i * step] for i in range(1, S)]
+                return starts + [n]
+        # balanced bounds (never leaves a trailing stage empty for n >= S)
+        return [round(i * n / S) for i in range(S)] + [n]
 
     def get_stage_layers(self, stage_id):
-        n = len(self._built)
-        per = (n + self.num_stages - 1) // self.num_stages
-        return self._built[stage_id * per:(stage_id + 1) * per]
+        lo = self._stage_bounds[stage_id]
+        hi = self._stage_bounds[stage_id + 1]
+        return [l for _, l in self._built[lo:hi]]
+
+    def get_stage_entries(self, stage_id):
+        """(desc, layer) pairs — descs carry SharedLayerDesc.forward_func."""
+        lo = self._stage_bounds[stage_id]
+        hi = self._stage_bounds[stage_id + 1]
+        return self._built[lo:hi]
+
+    def shared_weight_infos(self):
+        """key -> list of (desc, layer); all entries of a key share params."""
+        out = {}
+        for d, l in self._built:
+            if isinstance(d, SharedLayerDesc):
+                out.setdefault(d.layer_name, []).append((d, l))
+        return out
+
+    def parameters(self):
+        seen, params = set(), []
+        for _, l in self._built:
+            if hasattr(l, "parameters"):
+                for p in l.parameters():
+                    if id(p) not in seen:
+                        seen.add(id(p))
+                        params.append(p)
+        return params
+
+    def _run_entries(self, entries, x):
+        for d, l in entries:
+            if (isinstance(d, SharedLayerDesc) and d.forward_func is not None
+                    and getattr(d, "_is_reuse", False)):
+                # reference semantics (pp_layers.py): the REUSE occurrence of
+                # a shared layer runs forward_func(layer, x) — e.g. the tied
+                # embedding head doing x @ embedding.weight.T
+                x = d.forward_func(l, x)
+            else:
+                x = l(x) if callable(l) else l.forward(x)
+        return x
 
     def forward(self, x):
-        for l in self._built:
-            x = l(x) if callable(l) else l.forward(x)
+        # eager parity path: run the stages in order THROUGH the partition
+        for s in range(self.num_stages):
+            x = self._run_entries(self.get_stage_entries(s), x)
         return x
 
     def __call__(self, x):
         return self.forward(x)
+
+    def train_batch(self, data, optimizer, mesh=None, num_microbatches=None,
+                    axis="pp"):
+        """Run one pipelined train step: forward through the stage partition
+        on the pp mesh axis, autodiff backward, optimizer step. Returns loss.
+
+        Mirrors PipelineParallel.train_batch (pipeline_parallel.py:697); the
+        schedule is the SPMD wavefront (1F1B's memory profile via remat);
+        heterogeneous stages dispatch by lax.switch.
+        """
+        from paddle_tpu.jit.functional import swap_values
+        from paddle_tpu.tensor import Tensor
+
+        x, y = data
+        xv = x._value if isinstance(x, Tensor) else jnp.asarray(x)
+        yv = y._value if isinstance(y, Tensor) else jnp.asarray(y)
+        if mesh is None:
+            from paddle_tpu.distributed.fleet import topology as topo
+            hcg = topo.get_hybrid_communicate_group()
+            mesh = hcg.get_mesh()
+        S = mesh.shape[axis]
+        assert S == self.num_stages, (S, self.num_stages)
+        M = num_microbatches or S
+
+        # collect every distinct parameter across stages (shared layers
+        # contribute once — weight tying preserved)
+        params = self.parameters()
+        pvals = [p._value for p in params]
+        loss_fn = self.loss_fn
+
+        # uniform activation shape = stage-0 output on one microbatch
+        mb = xv.shape[0] // M
+
+        def stage_fn_of(s):
+            entries = self.get_stage_entries(s)
+            is_first = s == 0
+            is_last = s == self.num_stages - 1
+
+            def fn(h, x_m, y_m):
+                inp = Tensor._from_value(x_m if is_first else h)
+                out = self._run_entries(entries, inp)
+                if is_last:
+                    loss = loss_fn(out, Tensor._from_value(y_m))
+                    lv = loss._value if isinstance(loss, Tensor) else loss
+                    # activation carry unused after the last stage
+                    return jnp.zeros(act_shape_full, act_dtype), lv
+                return out._value, jnp.zeros((), jnp.float32)
+
+            return fn
+
+        # infer the inter-stage activation shape from stage 0
+        def stage0_shape(pv, x_m):
+            with swap_values(params, list(pv)):
+                out = self._run_entries(self.get_stage_entries(0),
+                                        Tensor._from_value(x_m))
+                return out._value
+
+        probe = jax.eval_shape(stage0_shape, pvals, xv[:mb])
+        act_shape_full = probe.shape
+        act_dtype = probe.dtype
+        act_shape = probe.shape[1:]
+
+        def loss_of(pv, xv, yv):
+            with swap_values(params, list(pv)):
+                fns = [stage_fn_of(s) for s in range(self.num_stages)]
+                return hetero_spmd_pipeline(
+                    fns, xv, yv, mesh=mesh, num_microbatches=M,
+                    act_shape=act_shape, act_dtype=act_dtype, axis=axis)
+
+        loss, grads = jax.value_and_grad(loss_of)(pvals, xv, yv)
+        for p, g in zip(params, grads):
+            if g is not None:
+                # strip the pp-mesh sharding the shard_map transpose attaches
+                # — otherwise the updated params carry an Auto-mesh sharding
+                # (or a committed device) that clashes with the next trace
+                p.grad = Tensor._from_value(jnp.asarray(jax.device_get(g)))
+            else:
+                p.grad = None
+        optimizer.step()
+        optimizer.clear_grad()
+        return Tensor._from_value(loss)

@@ -1,0 +1,310 @@
+"""Round-2 pipeline schedule tests (VERDICT #3):
+- schedule tables (FThenB / 1F1B / zero-bubble): dependency validity, memory
+  high-water (1F1B < FThenB), bubble (ZB < 1F1B), tick-unit formulas
+  (VPP < GPipe);
+- schedule-table SPMD engine: grads match jax.grad of the unpipelined loss;
+- interleaved (VPP) pipeline: output matches sequential layer stack;
+- PipelineLayer: real partition + shared embeddings + train_batch on the
+  8-device mesh matching a non-pipelined step.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from paddle_tpu.distributed.fleet.pipeline_schedules import (
+    B_OP,
+    F_OP,
+    IDLE,
+    W_OP,
+    PipelineSchedule,
+    gpipe_tick_units,
+    interleave_params,
+    make_pipeline_schedule,
+    schedule_pipeline_grads,
+    spmd_pipeline_interleaved,
+    vpp_tick_units,
+)
+
+S, M = 4, 8
+
+
+def _mesh():
+    devs = np.asarray(jax.devices()[:S])
+    return Mesh(devs.reshape(S), axis_names=("pp",))
+
+
+def _check_dependencies(sched: PipelineSchedule):
+    """F(s,m) strictly after F(s-1,m); B(s,m) after B(s+1,m) (or after own F
+    on the last stage); W after own B. Message latency: 1 tick."""
+    T, S_ = sched.op.shape
+    f_t = {}
+    b_t = {}
+    for t in range(T):
+        for s in range(S_):
+            op, m = int(sched.op[t, s]), int(sched.slot[t, s])
+            if op == F_OP:
+                if s > 0:
+                    assert (s - 1, m) in f_t and f_t[(s - 1, m)] < t, (s, m, t)
+                f_t[(s, m)] = t
+            elif op == B_OP:
+                assert (s, m) in f_t and f_t[(s, m)] <= t
+                if s < S_ - 1:
+                    assert (s + 1, m) in b_t and b_t[(s + 1, m)] < t
+                b_t[(s, m)] = t
+            elif op == W_OP:
+                assert (s, m) in b_t and b_t[(s, m)] <= t
+    # completeness: every (s, m) ran F and B
+    for s in range(S_):
+        for m in range(sched.num_microbatches):
+            assert (s, m) in f_t and (s, m) in b_t
+
+
+def test_schedule_tables_valid():
+    for policy in ("FThenB", "1F1B", "zero_bubble"):
+        sched = make_pipeline_schedule(S, M, policy)
+        _check_dependencies(sched)
+        if sched.split_bw:
+            n_w = (sched.op == W_OP).sum()
+            assert n_w == S * M  # every B has a matching W
+
+
+def test_1f1b_memory_beats_fthenb():
+    ft = make_pipeline_schedule(S, M, "FThenB")
+    ob = make_pipeline_schedule(S, M, "1F1B")
+    assert ob.peak_in_flight() < ft.peak_in_flight()
+    assert ft.peak_in_flight() == M
+    assert ob.peak_in_flight() == S  # stage 0 holds at most S
+
+
+def test_zero_bubble_fills_bubbles():
+    ob = make_pipeline_schedule(S, M, "1F1B")
+    zb = make_pipeline_schedule(S, M, "zero_bubble")
+    assert zb.bubble_fraction() < ob.bubble_fraction()
+
+
+def test_vpp_tick_units_beat_gpipe():
+    for V in (2, 4):
+        assert vpp_tick_units(S, M, V) < gpipe_tick_units(S, M, V)
+
+
+def _stack_params(L, D, key):
+    return jax.random.normal(key, (L, D, D), jnp.float32) * (1.0 / np.sqrt(D))
+
+
+def _block(p, h):
+    return jnp.tanh(h @ p)
+
+
+def _loss(h, y):
+    return jnp.mean((h - y) ** 2)
+
+
+@pytest.mark.parametrize("policy", ["FThenB", "1F1B", "zero_bubble"])
+def test_engine_grads_match_autodiff(policy):
+    mesh = _mesh()
+    L, D, B = S, 8, M * 2
+    w = _stack_params(L, D, jax.random.PRNGKey(0))
+    x = jax.random.normal(jax.random.PRNGKey(1), (B, D), jnp.float32)
+    y = jax.random.normal(jax.random.PRNGKey(2), (B, D), jnp.float32)
+    w = jax.device_put(w, NamedSharding(mesh, P("pp")))
+
+    sched = make_pipeline_schedule(S, M, policy)
+    loss, grads = jax.jit(
+        lambda w_, x_, y_: schedule_pipeline_grads(
+            _block, _loss, w_, x_, y_, mesh=mesh, schedule=sched)
+    )(w, x, y)
+
+    def ref_loss(w_, x_, y_):
+        h = x_
+        for i in range(L):
+            h = _block(w_[i], h)
+        # engine averages per-microbatch losses; each microbatch loss is a
+        # mean over its rows, so with equal microbatches this equals the
+        # mean over per-microbatch means
+        hs = h.reshape(M, B // M, D)
+        ys = y_.reshape(M, B // M, D)
+        return jnp.mean(jax.vmap(_loss)(hs, ys))
+
+    ref_l, ref_g = jax.value_and_grad(ref_loss)(w, x, y)
+    np.testing.assert_allclose(float(loss), float(ref_l), rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(grads), np.asarray(ref_g),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_interleaved_matches_sequential():
+    mesh = _mesh()
+    V = 2
+    L, D, B = S * V, 8, M * 2
+    w = _stack_params(L, D, jax.random.PRNGKey(3))
+    x = jax.random.normal(jax.random.PRNGKey(4), (B, D), jnp.float32)
+
+    def ref(w_, x_):
+        h = x_
+        for i in range(L):
+            h = _block(w_[i], h)
+        return h
+
+    w_perm = interleave_params(w, S, V)
+    w_sh = jax.device_put(w_perm, NamedSharding(mesh, P("pp")))
+    out = jax.jit(lambda w_, x_: spmd_pipeline_interleaved(
+        _block, w_, x_, mesh=mesh, num_microbatches=M,
+        num_virtual_stages=V))(w_sh, x)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref(w, x)),
+                               rtol=2e-5, atol=1e-5)
+
+
+def test_interleaved_grads_flow():
+    mesh = _mesh()
+    V = 2
+    L, D, B = S * V, 8, M
+    w = interleave_params(_stack_params(L, D, jax.random.PRNGKey(5)), S, V)
+    w = jax.device_put(w, NamedSharding(mesh, P("pp")))
+    x = jax.random.normal(jax.random.PRNGKey(6), (B, D), jnp.float32)
+
+    def loss(w_):
+        y = spmd_pipeline_interleaved(_block, w_, x, mesh=mesh,
+                                      num_microbatches=M,
+                                      num_virtual_stages=V)
+        return jnp.mean(y ** 2)
+
+    lv, g = jax.jit(jax.value_and_grad(loss))(w)
+    assert np.isfinite(float(lv))
+    assert float(jnp.abs(g).sum()) > 0
+
+
+# ------------------------------------------------------- PipelineLayer real
+
+
+def test_pipeline_layer_partition_and_shared():
+    import paddle_tpu.nn as nn
+    from paddle_tpu.distributed.fleet.pipeline import (
+        LayerDesc,
+        PipelineLayer,
+        SharedLayerDesc,
+    )
+
+    descs = (
+        [SharedLayerDesc("emb", nn.Linear, in_features=4, out_features=8)]
+        + [LayerDesc(nn.Linear, in_features=8, out_features=8)
+           for _ in range(5)]
+        + [SharedLayerDesc("emb", nn.Linear, in_features=4, out_features=8)]
+        + [LayerDesc(nn.Linear, in_features=8, out_features=8)]
+    )
+    pl = PipelineLayer(descs, num_stages=4)
+    # partition covers all layers, in order, exactly once
+    got = [l for s in range(4) for l in pl.get_stage_layers(s)]
+    assert len(got) == len(descs)
+    # shared key 'emb' built once: both entries are the same object
+    shared = pl.shared_weight_infos()["emb"]
+    assert shared[0][1] is shared[1][1]
+    # distinct params: 6 unique linears x2 (w, b)
+    assert len(pl.parameters()) == 7 * 2
+
+
+def test_gpt_through_partitioned_pipeline_layer():
+    """GPT train_batch through a real PipelineLayer partition: embeddings on
+    stage 0, decoder blocks in the middle, final-LN+head+CE on the last stage
+    (VERDICT #3 done-criterion)."""
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+    import paddle_tpu.optimizer as opt
+    from paddle_tpu.distributed.fleet.pipeline import LayerDesc, PipelineLayer
+    from paddle_tpu.models.gpt import (
+        GPTDecoderLayer,
+        GPTEmbeddings,
+        gpt_tiny,
+    )
+
+    cfg = gpt_tiny(hidden_size=16, num_layers=4, num_heads=2, vocab_size=32,
+                   max_position_embeddings=16)
+
+    class Head(nn.Layer):
+        def __init__(self, cfg):
+            super().__init__()
+            self.ln = nn.LayerNorm(cfg.hidden_size)
+            self.proj = nn.Linear(cfg.hidden_size, cfg.vocab_size,
+                                  bias_attr=False)
+
+        def forward(self, h):
+            return self.proj(self.ln(h))
+
+    class CE(nn.Layer):
+        def forward(self, logits, labels):
+            import paddle_tpu.nn.functional as F
+            return F.cross_entropy(
+                logits.reshape([-1, logits.shape[-1]]),
+                labels.reshape([-1])).mean()
+
+    paddle.framework.random.seed(11)
+    descs = ([LayerDesc(GPTEmbeddings, cfg)]
+             + [LayerDesc(GPTDecoderLayer, cfg) for _ in range(cfg.num_layers)]
+             + [LayerDesc(Head, cfg)])
+    pl = PipelineLayer(descs, num_stages=S, loss_fn=CE())
+    o = opt.AdamW(learning_rate=1e-3, parameters=pl.parameters())
+
+    rng2 = np.random.default_rng(1)
+    ids = rng2.integers(0, cfg.vocab_size, (M, 8)).astype(np.int32)
+    labels = rng2.integers(0, cfg.vocab_size, (M, 8)).astype(np.int32)
+    mesh = _mesh()
+    losses = []
+    for _ in range(3):
+        loss = pl.train_batch(
+            (paddle.to_tensor(ids), paddle.to_tensor(labels)), o,
+            mesh=mesh, num_microbatches=4)
+        losses.append(float(loss.numpy()))
+    assert all(np.isfinite(losses))
+    assert losses[-1] < losses[0]  # training moves
+
+
+def test_pipeline_layer_train_batch_matches_single():
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+    import paddle_tpu.optimizer as opt
+    from paddle_tpu.distributed.fleet.pipeline import (
+        LayerDesc,
+        PipelineLayer,
+    )
+
+    rng = np.random.default_rng(0)
+    D = 8
+    xs = rng.normal(size=(M, D)).astype(np.float32)
+    ys = rng.normal(size=(M, D)).astype(np.float32)
+
+    def build(seed):
+        paddle.framework.random.seed(seed)
+        descs = [LayerDesc(nn.Linear, in_features=D, out_features=D)
+                 for _ in range(S)]
+        return PipelineLayer(descs, num_stages=S, loss_fn=nn.MSELoss())
+
+    mesh = _mesh()
+    pl = build(7)
+    ref = build(7)  # same seed -> same init
+    for p, q in zip(pl.parameters(), ref.parameters()):
+        np.testing.assert_allclose(p.numpy(), q.numpy())
+
+    o1 = opt.SGD(learning_rate=0.1, parameters=pl.parameters())
+    loss = pl.train_batch((paddle.to_tensor(xs), paddle.to_tensor(ys)), o1,
+                          mesh=mesh, num_microbatches=4)
+
+    # reference: plain eager forward + backward on the full batch,
+    # averaging per-microbatch losses like the pipeline does
+    o2 = opt.SGD(learning_rate=0.1, parameters=ref.parameters())
+    mb = M // 4
+    total = None
+    for i in range(4):
+        out = ref.forward(paddle.to_tensor(xs[i * mb:(i + 1) * mb]))
+        li = nn.MSELoss()(out, paddle.to_tensor(ys[i * mb:(i + 1) * mb]))
+        total = li if total is None else total + li
+    total = total / 4
+    total.backward()
+    o2.step()
+    o2.clear_grad()
+
+    np.testing.assert_allclose(float(loss.numpy()), float(total.numpy()),
+                               rtol=1e-5)
+    for p, q in zip(pl.parameters(), ref.parameters()):
+        np.testing.assert_allclose(p.numpy(), q.numpy(), rtol=1e-4,
+                                   atol=1e-5)
