@@ -8,7 +8,31 @@ jit / distributed / amp / autograd subpackages.
 
 from __future__ import annotations
 
+import os as _os
 import sys as _sys
+
+# Multi-process bring-up MUST precede any jax backend use (jax.distributed's
+# hard requirement), so when the launcher's rendezvous env is present the
+# coordination service starts here — before anything below touches jax.
+# (Reference analogue: init_parallel_env's TCPStore bootstrap,
+# python/paddle/distributed/parallel.py:1101; on TPU pods jax.distributed IS
+# the coordination service.)
+if (_os.environ.get("JAX_COORDINATOR_ADDRESS")
+        and int(_os.environ.get("JAX_NUM_PROCESSES", "1")) > 1):
+    import jax as _jax
+
+    try:
+        _jax.distributed.initialize(
+            coordinator_address=_os.environ["JAX_COORDINATOR_ADDRESS"],
+            num_processes=int(_os.environ["JAX_NUM_PROCESSES"]),
+            process_id=int(_os.environ.get("JAX_PROCESS_ID", "0")),
+        )
+    except RuntimeError as _e:
+        # tolerate ONLY double-initialization; rendezvous failures and
+        # "backend already used" must surface — swallowing them would let N
+        # trainers run as silent singletons
+        if "only be called once" not in str(_e):
+            raise
 
 from paddle_tpu.framework import dtype as _dtype_mod
 from paddle_tpu.framework.dtype import (  # noqa: F401

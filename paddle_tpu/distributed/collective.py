@@ -116,6 +116,94 @@ def _world_mesh() -> Mesh:
     return _env.get_world_mesh()
 
 
+# ------------------------------------------------- multi-controller backend
+#
+# When the job runs as N OS processes (jax.distributed / the launcher with
+# --nproc_per_node > 1), "rank" means PROCESS (the reference's trainer rank)
+# and collectives move data across processes. The recipe: (1) assemble a
+# global [nprocs, ...] array — one row per process, hosted on each process's
+# first local device (one row per PROCESS even when a process owns several
+# chips); (2) run the same group-aware reduction/permutation the
+# single-controller path uses, replicated out; (3) every process reads its
+# own row. XLA's cross-host collectives (gRPC on CPU, ICI/DCN on TPU pods)
+# replace ProcessGroupNCCL.
+
+
+def _is_multiproc() -> bool:
+    return jax.process_count() > 1
+
+
+@functools.lru_cache(maxsize=1)
+def _proc_mesh() -> Mesh:
+    """One-device-per-process mesh (rank axis = process axis)."""
+    firsts = {}
+    for d in jax.devices():
+        firsts.setdefault(d.process_index, d)
+    devs = [firsts[p] for p in sorted(firsts)]
+    return Mesh(np.asarray(devs), axis_names=("world",))
+
+
+def _global_stack(v):
+    """Assemble [nprocs, ...]: this process's value as its row."""
+    mesh = _proc_mesh()
+    nproc = jax.process_count()
+    sharding = NamedSharding(mesh, P("world"))
+    local_dev = [d for d in mesh.devices.flat
+                 if d.process_index == jax.process_index()][0]
+    locals_ = [jax.device_put(v[None], local_dev)]
+    return jax.make_array_from_single_device_arrays(
+        (nproc,) + v.shape, sharding, locals_)
+
+
+@functools.lru_cache(maxsize=64)
+def _mp_jitted(static_key):
+    """Cached jitted [world,...]->[world,...] programs per (kind, params)."""
+    mesh = _proc_mesh()
+    kind = static_key[0]
+    if kind == "allreduce":
+        _, op, seg, gsizes = static_key
+
+        def fn(a):
+            return _allreduce_segments(a, op, seg, gsizes)
+    elif kind == "gather":
+        def fn(a):
+            return a
+    elif kind == "permute":
+        _, idx = static_key
+
+        def fn(a):
+            return jnp.take(a, jnp.asarray(idx), axis=0)
+    else:
+        raise ValueError(kind)
+    return jax.jit(fn, out_shardings=NamedSharding(mesh, P()))
+
+
+def _mp_collect(static_key, v):
+    garr = _global_stack(v)
+    out = _mp_jitted(static_key)(garr)
+    return np.asarray(out.addressable_data(0))
+
+
+def _mp_allreduce_full(v, op, group=None):
+    g = _get_group(group)
+    seg, sizes = _segment_ids(g)
+    return _mp_collect(("allreduce", op, seg, sizes), v)
+
+
+def _multiproc_allreduce(v, op, group=None):
+    rank = jax.process_index()
+    return _mp_allreduce_full(v, op, group)[rank]
+
+
+def _multiproc_allgather(v):
+    return _mp_collect(("gather",), v)
+
+
+def _multiproc_permute(v, idx):
+    rank = jax.process_index()
+    return _mp_collect(("permute", tuple(idx)), v)[rank]
+
+
 def _stacked(x: Tensor):
     """Validate/return the per-rank stacked payload [world, ...]."""
     v = x._value
@@ -151,8 +239,7 @@ def _segment_ids(group: Group):
     return tuple(seg), tuple(size)
 
 
-@functools.partial(jax.jit, static_argnames=("op", "seg", "gsizes"))
-def _allreduce_impl(v, op, seg, gsizes):
+def _allreduce_segments(v, op, seg, gsizes):
     """Reduce the stacked axis within each segment; every rank of a segment
     sees the reduced value. Arbitrary (strided) groups supported — under a
     sharded stacked layout XLA lowers the gathers to ICI collectives."""
@@ -178,9 +265,18 @@ def _allreduce_impl(v, op, seg, gsizes):
     return jnp.take(reduced, seg_arr, axis=0)
 
 
+_allreduce_impl = functools.partial(
+    jax.jit, static_argnames=("op", "seg", "gsizes"))(_allreduce_segments)
+
+
 def all_reduce(tensor: Tensor, op=ReduceOp.SUM, group: Optional[Group] = None,
                sync_op=True):
     """In-place all-reduce over the per-rank axis (paddle semantics)."""
+    if _is_multiproc():
+        out = _multiproc_allreduce(np.asarray(jax.device_get(tensor._value)),
+                                   op, group)
+        tensor._replace_value(jnp.asarray(out))
+        return _Task()
     g = _get_group(group)
     v = _stacked(tensor)
     seg, sizes = _segment_ids(g)
@@ -198,6 +294,16 @@ def all_gather(tensor_list: List[Tensor], tensor: Tensor,
     Multiple peer groups -> per-rank stacked tensors: entry j's slice for rank
     r is the value held by the j-th member of r's group.
     """
+    if _is_multiproc():
+        g = _get_group(group)
+        gathered = _multiproc_allgather(
+            np.asarray(jax.device_get(tensor._value)))
+        rank = jax.process_index()
+        my_group = next((rs for rs in g.partition if rank in rs),
+                        [rank])
+        for r in my_group:
+            tensor_list.append(Tensor._from_value(jnp.asarray(gathered[r])))
+        return _Task()
     g = _get_group(group)
     v = _stacked(tensor)
     if len(g.partition) == 1 and len(g.partition[0]) == v.shape[0]:
@@ -240,6 +346,22 @@ def reduce_scatter(tensor: Tensor, tensor_or_tensor_list, op=ReduceOp.SUM,
     """Per-rank input [world, gsize, ...] -> per-rank output [world, ...]:
     sum within each group, rank keeps its local chunk."""
     g = _get_group(group)
+    if _is_multiproc():
+        src = tensor_or_tensor_list
+        if isinstance(src, (list, tuple)):
+            v = np.stack([np.asarray(jax.device_get(t._value)) for t in src])
+        else:
+            v = np.asarray(jax.device_get(src._value))  # [gsize, ...]
+        full = _multiproc_allgather(v)  # [world, gsize, ...]
+        rank = jax.process_index()
+        seg, _ = _segment_ids(g)
+        _, local = _local_index_maps(g)
+        rows = [r for r in range(full.shape[0]) if seg[r] == seg[rank]]
+        red = {"sum": np.sum, "avg": np.mean, "max": np.max, "min": np.min,
+               "prod": np.prod}[op]
+        summed = red(full[rows], axis=0)
+        tensor._replace_value(jnp.asarray(summed[local[rank]]))
+        return _Task()
     src = tensor_or_tensor_list
     if isinstance(src, (list, tuple)):
         v = jnp.stack([t._value for t in src], axis=1)
@@ -259,6 +381,17 @@ def all_to_all(out_tensor_list, in_tensor_list, group: Optional[Group] = None,
                sync_op=True):
     """paddle.distributed.alltoall: group member i sends in[j] to member j."""
     g = _get_group(group)
+    if _is_multiproc():
+        v = np.stack([np.asarray(jax.device_get(t._value))
+                      for t in in_tensor_list])  # [n, ...]
+        full = _multiproc_allgather(v)  # [world, n, ...]
+        rank = jax.process_index()
+        my_group = next((rs for rs in g.partition if rank in rs), [rank])
+        my_local = my_group.index(rank)
+        for j, peer in enumerate(my_group):
+            out_tensor_list.append(
+                Tensor._from_value(jnp.asarray(full[peer, my_local])))
+        return _Task()
     n = g.nranks
     # stacked encoding: in_tensor_list entries are [world, ...] stacks
     stacked = jnp.stack([_stacked(t) for t in in_tensor_list], axis=1)  # [W,n,...]
@@ -283,6 +416,19 @@ def broadcast(tensor: Tensor, src: int, group: Optional[Group] = None, sync_op=T
     """Within each partition group, every rank takes the value of the rank at
     ``src``'s local position (SPMD per-group broadcast; for the default world
     group this is exactly paddle's broadcast from global rank ``src``)."""
+    if _is_multiproc():
+        g = _get_group(group)
+        world = jax.process_count()
+        src_local = g.get_group_rank(src)
+        if src_local < 0:
+            raise ValueError(f"broadcast src rank {src} is not in the group")
+        peers, _ = _local_index_maps(g)
+        idx = [peers[r][src_local] if peers[r] is not None else r
+               for r in range(world)]
+        out = _multiproc_permute(
+            np.asarray(jax.device_get(tensor._value)), idx)
+        tensor._replace_value(jnp.asarray(out))
+        return _Task()
     g = _get_group(group)
     v = _stacked(tensor)
     world = v.shape[0]
@@ -301,6 +447,13 @@ def reduce(tensor: Tensor, dst: int, op=ReduceOp.SUM, group: Optional[Group] = N
            sync_op=True):
     """Only global rank ``dst`` receives the reduced value of its group;
     everyone else keeps their original tensor (paddle semantics)."""
+    if _is_multiproc():
+        v = np.asarray(jax.device_get(tensor._value))
+        full = _mp_allreduce_full(v, op, group)
+        rank = jax.process_index()
+        if rank == dst:
+            tensor._replace_value(jnp.asarray(full[rank]))
+        return _Task()
     g = _get_group(group)
     v = _stacked(tensor)
     seg, sizes = _segment_ids(g)
@@ -318,6 +471,14 @@ def scatter(tensor: Tensor, tensor_list=None, src=0, group: Optional[Group] = No
     """Each rank r receives tensor_list[local(r)] *as held by its group's src
     rank* (the rank at src's local position)."""
     g = _get_group(group)
+    if _is_multiproc():
+        chunks = np.stack([np.asarray(jax.device_get(t._value))
+                           for t in (tensor_list or [tensor])])
+        full = _multiproc_allgather(chunks)  # [world, n, ...]
+        rank = jax.process_index()
+        _, local = _local_index_maps(g)
+        tensor._replace_value(jnp.asarray(full[src, local[rank]]))
+        return _Task()
     if tensor_list is not None:
         stacked = jnp.stack([_stacked(t) for t in tensor_list], axis=1)  # [W,n,...]
         world = stacked.shape[0]
@@ -335,6 +496,13 @@ def scatter(tensor: Tensor, tensor_list=None, src=0, group: Optional[Group] = No
 
 
 def send(tensor: Tensor, dst: int, group=None, sync_op=True):
+    if _is_multiproc():
+        # symmetric exchange: every process contributes its buffer; the
+        # receiver picks the sender's row in its matching recv(). Requires
+        # all processes to reach the send/recv point together (the pipeline
+        # pattern); arbitrary sparse p2p needs a dedicated channel.
+        _multiproc_allgather(np.asarray(jax.device_get(tensor._value)))
+        return _Task()
     _p2p_buffer.append({"src": _env.get_rank(), "dst": dst, "value": tensor._value})
     return _Task()
 
@@ -346,6 +514,10 @@ def recv(tensor: Tensor, src: int, group=None, sync_op=True):
     get_rank() is constant, so dst matching degrades to src-only FIFO — pair
     sends/recvs in program order there (the fleet pipeline does).
     """
+    if _is_multiproc():
+        full = _multiproc_allgather(np.asarray(jax.device_get(tensor._value)))
+        tensor._replace_value(jnp.asarray(full[src]))
+        return _Task()
     me = _env.get_rank()
     for exact in (True, False):
         for i, entry in enumerate(_p2p_buffer):
@@ -365,6 +537,9 @@ _p2p_buffer: list = []
 
 
 def barrier(group=None):
+    if _is_multiproc():
+        _multiproc_allreduce(np.zeros((), np.float32), "sum")
+        return _Task()
     jax.effects_barrier()
     return _Task()
 

@@ -379,7 +379,18 @@ class PipelineLayer:
                     fns, xv, yv, mesh=mesh, num_microbatches=M,
                     act_shape=act_shape, act_dtype=act_dtype, axis=axis)
 
-        loss, grads = jax.value_and_grad(loss_of)(pvals, xv, yv)
+        # compile once per (shapes, mesh, M): re-tracing the whole pipeline
+        # per step would dominate the loop
+        key = (xv.shape, str(xv.dtype), yv.shape, str(yv.dtype), M, axis,
+               tuple(mesh.shape.items()),
+               tuple(d.id for d in mesh.devices.flat))
+        cache = getattr(self, "_tb_cache", None)
+        if cache is None:
+            cache = self._tb_cache = {}
+        step_fn = cache.get(key)
+        if step_fn is None:
+            step_fn = cache[key] = jax.jit(jax.value_and_grad(loss_of))
+        loss, grads = step_fn(pvals, xv, yv)
         for p, g in zip(params, grads):
             if g is not None:
                 # strip the pp-mesh sharding the shard_map transpose attaches

@@ -112,9 +112,7 @@ class Tensor:
     def grad(self) -> Optional["Tensor"]:
         if self._grad is None:
             return None
-        g = Tensor._from_value(self._grad)
-        g.stop_gradient = True
-        return g
+        return _GradView._of(self)
 
     @grad.setter
     def grad(self, value):
@@ -302,6 +300,46 @@ class Tensor:
 jax.tree_util.register_pytree_node(
     Tensor,
     lambda t: t._tree_flatten(),
+    lambda aux, children: Tensor._tree_unflatten(aux, children),
+)
+
+
+class _GradView(Tensor):
+    """Write-through view of a tensor's gradient.
+
+    Paddle's eager ``param.grad`` aliases the stored gradient: in-place ops
+    (``dist.all_reduce(p.grad)``, ``scaler.unscale_``) mutate the real grad.
+    This view reproduces that aliasing — ``_value`` reads/writes the owner's
+    ``_grad`` directly, so every access observes the current gradient.
+    """
+
+    @property
+    def _value(self):
+        return self._owner._grad
+
+    @_value.setter
+    def _value(self, v):
+        self._owner._grad = v
+
+    @classmethod
+    def _of(cls, owner: "Tensor") -> "_GradView":
+        g = cls.__new__(cls)
+        g._owner = owner  # must precede any _value access
+        g.stop_gradient = True
+        g._grad = None
+        g._node = None
+        g._retain_grads = False
+        g.name = ""
+        g.persistable = False
+        g.trainable = False
+        return g
+
+
+# flattening a grad view yields its current value; unflattening produces a
+# plain Tensor (the view identity is not meaningful across a jit boundary)
+jax.tree_util.register_pytree_node(
+    _GradView,
+    lambda t: ((t._value,), (t.stop_gradient,)),
     lambda aux, children: Tensor._tree_unflatten(aux, children),
 )
 

@@ -1,0 +1,91 @@
+"""Multi-process DP trainer, run under paddle_tpu.distributed.launch.
+
+The reference's multi-rank test pattern (test/legacy_test/test_dist_base.py:
+952): N trainer processes rendezvous over env vars, run collectives and a DP
+train step, and the harness compares loss curves against a single-process
+run. Here the rendezvous is jax.distributed (the TPU pod coordinator); on
+CPU the cross-process collectives ride the distributed runtime.
+
+Prints one JSON line: {"rank", "world", "allreduce", "gathered", "losses"}.
+"""
+
+import json
+import os
+import sys
+
+# one local CPU device per process — the pod-like topology
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+import paddle_tpu as paddle  # noqa: E402
+import paddle_tpu.distributed as dist  # noqa: E402
+import paddle_tpu.nn as nn  # noqa: E402
+import paddle_tpu.optimizer as opt  # noqa: E402
+
+
+def main():
+    env = dist.init_parallel_env()
+    rank = jax.process_index()
+    world = jax.device_count()
+
+    # 1. collective sanity: sum of (rank + 1) over ranks
+    x = paddle.to_tensor(np.asarray([float(rank + 1)], np.float32))
+    dist.all_reduce(x)
+    allreduce_val = float(x.numpy()[0])
+
+    gathered = []
+    dist.all_gather(gathered, paddle.to_tensor(
+        np.asarray([float(rank * 10)], np.float32)))
+    gathered_vals = [float(t.numpy()[0]) for t in gathered]
+
+    b = paddle.to_tensor(np.asarray([float(rank)], np.float32))
+    dist.broadcast(b, src=0)
+    bcast_val = float(b.numpy()[0])
+
+    # 2. DP train step: identical init on every rank (same seed), each rank
+    # trains on its shard, grads allreduce-averaged each step
+    paddle.framework.random.seed(1234)
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(32, 8)).astype(np.float32)
+    W = rng.normal(size=(8, 1)).astype(np.float32)
+    Y = (X @ W).astype(np.float32)
+
+    model = nn.Sequential(nn.Linear(8, 16), nn.Tanh(), nn.Linear(16, 1))
+    optimizer = opt.SGD(learning_rate=0.1, parameters=model.parameters())
+    lossfn = nn.MSELoss()
+
+    shard = slice(rank * (32 // world), (rank + 1) * (32 // world))
+    xs = paddle.to_tensor(X[shard])
+    ys = paddle.to_tensor(Y[shard])
+
+    losses = []
+    for _ in range(5):
+        out = model(xs)
+        loss = lossfn(out, ys)
+        loss.backward()
+        for p in model.parameters():
+            if p.grad is not None:
+                dist.all_reduce(p.grad, op=dist.ReduceOp.AVG)
+        optimizer.step()
+        optimizer.clear_grad()
+        # global loss = average of per-shard losses
+        lt = paddle.to_tensor(np.asarray([float(loss.numpy())], np.float32))
+        dist.all_reduce(lt, op=dist.ReduceOp.AVG)
+        losses.append(float(lt.numpy()[0]))
+
+    print(json.dumps({
+        "rank": rank, "world": world, "allreduce": allreduce_val,
+        "gathered": gathered_vals, "broadcast": bcast_val,
+        "losses": losses,
+    }))
+    sys.stdout.flush()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,98 @@
+"""Multi-process distributed test tier (VERDICT #4).
+
+Spawns 2 REAL OS processes through paddle_tpu.distributed.launch, each with
+its own single-device CPU jax runtime, rendezvoused by jax.distributed —
+the reference's TestDistBase pattern (test/legacy_test/test_dist_base.py:952
+spawning trainers with env rendezvous and comparing loss curves).
+"""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+TRAINER = os.path.join(REPO, "tests", "dist_dp_trainer.py")
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _launch(nproc, log_dir):
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)          # children pick their own device count
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    cmd = [sys.executable, "-m", "paddle_tpu.distributed.launch",
+           "--nproc_per_node", str(nproc),
+           "--master", f"127.0.0.1:{_free_port()}",
+           "--log_dir", log_dir, TRAINER]
+    proc = subprocess.run(cmd, env=env, capture_output=True, text=True,
+                          timeout=420, cwd=REPO)
+    assert proc.returncode == 0, (
+        f"launch failed rc={proc.returncode}\nstdout: {proc.stdout[-2000:]}\n"
+        f"stderr: {proc.stderr[-2000:]}\n" + "".join(
+            f"--- {f}:\n" + open(os.path.join(log_dir, f)).read()[-2000:]
+            for f in sorted(os.listdir(log_dir))))
+    results = []
+    for f in sorted(os.listdir(log_dir)):
+        for line in open(os.path.join(log_dir, f)):
+            line = line.strip()
+            if line.startswith("{"):
+                results.append(json.loads(line))
+    return results
+
+
+def _single_proc_losses():
+    """Same model/data/seed, one process, full batch, 5 steps."""
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+    import paddle_tpu.optimizer as opt
+
+    paddle.framework.random.seed(1234)
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(32, 8)).astype(np.float32)
+    W = rng.normal(size=(8, 1)).astype(np.float32)
+    Y = (X @ W).astype(np.float32)
+    model = nn.Sequential(nn.Linear(8, 16), nn.Tanh(), nn.Linear(16, 1))
+    optimizer = opt.SGD(learning_rate=0.1, parameters=model.parameters())
+    lossfn = nn.MSELoss()
+    losses = []
+    for _ in range(5):
+        loss = lossfn(model(paddle.to_tensor(X)), paddle.to_tensor(Y))
+        loss.backward()
+        optimizer.step()
+        optimizer.clear_grad()
+        losses.append(float(loss.numpy()))
+    return losses
+
+
+@pytest.mark.slow
+def test_two_process_dp_matches_single_proc(tmp_path):
+    results = _launch(2, str(tmp_path))
+    assert len(results) == 2, results
+    by_rank = {r["rank"]: r for r in results}
+    assert set(by_rank) == {0, 1}
+    for r in results:
+        assert r["world"] == 2
+        # allreduce of (rank+1): 1 + 2 = 3
+        assert r["allreduce"] == pytest.approx(3.0)
+        assert r["gathered"] == [0.0, 10.0]
+        assert r["broadcast"] == 0.0
+    # both ranks agree on the global loss curve
+    np.testing.assert_allclose(by_rank[0]["losses"], by_rank[1]["losses"],
+                               rtol=1e-6)
+    # and it matches the single-process full-batch run (TestDistBase check):
+    # avg of half-batch MSE grads == full-batch MSE grad for equal shards
+    single = _single_proc_losses()
+    np.testing.assert_allclose(by_rank[0]["losses"], single, rtol=2e-4,
+                               atol=1e-5)
