@@ -41,6 +41,7 @@ class StaticFunction:
         (e.g. the serving decode loop threading KV caches through)."""
         self._fn = fn
         self._layer = layer
+        self._full_graph = full_graph
         functools.update_wrapper(self, fn, updated=[])
         donate = ()
         if donate_buffers:
@@ -82,6 +83,18 @@ class StaticFunction:
         return list(p.values()), [t for t in b.values() if t is not None]
 
     def __call__(self, *args, **kwargs):
+        if not self._full_graph:
+            # SOT-style contract: constructs tracing can't swallow fall back
+            # to eager instead of erroring (paddle's full_graph=False)
+            from paddle_tpu.jit.sot import _graph_break_types
+
+            try:
+                return self._call_impl(*args, **kwargs)
+            except _graph_break_types():
+                return self._fn(*args, **kwargs)
+        return self._call_impl(*args, **kwargs)
+
+    def _call_impl(self, *args, **kwargs):
         from paddle_tpu.autograd import tape as _tape
 
         params, buffers = self._state_tensors()

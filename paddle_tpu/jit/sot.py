@@ -1,0 +1,138 @@
+"""Minimal SOT tier: guarded capture with graph-break fallback.
+
+Reference: python/paddle/jit/sot/ (22K LoC) — a CPython bytecode simulator
+(PEP-523 eval-frame hook pybind/eval_frame.c:439, opcode executor
+jit/sot/opcode_translator/executor/) that captures subgraphs, guards them on
+input properties, and falls back to eager at unsupported constructs.
+
+TPU-native scope note: on XLA the unit of compilation is a traced function,
+so this tier implements SOT's *contract* at function granularity:
+
+- **guards**: each capture is keyed on the function's code object version,
+  tensor arg structures (shape/dtype/stop_gradient), non-tensor arg values,
+  and closure cell values. A guard miss re-captures (multiple specializations
+  coexist, like SOT's guard chains).
+- **graph breaks**: constructs tracing cannot swallow (data-dependent python
+  branching that survives the AST pass, `.numpy()` materialization, python
+  side effects on traced values) raise during capture; the frame is then
+  marked and permanently executed eagerly — SOT's fallback path.
+- the AST pass (dy2static.ast_transform) plays the role of SOT's control-flow
+  capture; this module adds the guard/dispatch/fallback machinery.
+
+Bytecode-level sub-function graph breaks (splitting ONE frame into several
+compiled regions) are intentionally out of scope — on TPU the win of partial
+graphs is small because XLA recompiles whole traces anyway.
+"""
+
+from __future__ import annotations
+
+import types
+from typing import Any, Callable, Dict, Optional, Tuple
+
+from paddle_tpu.tensor import Tensor
+
+
+class GuardError(Exception):
+    pass
+
+
+def _guard_of_value(v) -> Tuple:
+    if isinstance(v, Tensor):
+        return ("T", tuple(v.shape), str(v.dtype), bool(v.stop_gradient))
+    if isinstance(v, (int, float, bool, str, bytes, type(None))):
+        return ("P", v)
+    if isinstance(v, (list, tuple)):
+        return ("L", tuple(_guard_of_value(x) for x in v))
+    if isinstance(v, dict):
+        return ("D", tuple(sorted(
+            (k, _guard_of_value(x)) for k, x in v.items())))
+    # opaque objects guard on identity (module/layer instances)
+    return ("O", id(v))
+
+
+def _closure_guard(fn: Callable) -> Tuple:
+    cells = getattr(fn, "__closure__", None) or ()
+    out = []
+    for c in cells:
+        try:
+            out.append(_guard_of_value(c.cell_contents))
+        except ValueError:  # empty cell
+            out.append(("E",))
+    return tuple(out)
+
+
+class _Frame:
+    """Per-code-object capture state: guard table + fallback flag."""
+
+    def __init__(self, fn: Callable):
+        self.fn = fn
+        self.specializations: Dict[Tuple, Callable] = {}
+        self.fallback = False  # permanent graph break
+        self.breaks = 0
+
+    def guard_key(self, args, kwargs) -> Tuple:
+        return (
+            tuple(_guard_of_value(a) for a in args),
+            tuple(sorted((k, _guard_of_value(v)) for k, v in kwargs.items())),
+            _closure_guard(self.fn),
+        )
+
+
+_GRAPH_BREAK_TYPES: Tuple[type, ...] = ()
+
+
+def _graph_break_types():
+    global _GRAPH_BREAK_TYPES
+    if not _GRAPH_BREAK_TYPES:
+        import jax
+
+        types_ = [jax.errors.TracerArrayConversionError,
+                  jax.errors.TracerBoolConversionError,
+                  jax.errors.ConcretizationTypeError,
+                  jax.errors.TracerIntegerConversionError]
+        _GRAPH_BREAK_TYPES = tuple(types_)
+    return _GRAPH_BREAK_TYPES
+
+
+def symbolic_translate(fn: Optional[Callable] = None, *, train=None,
+                       build_strategy=None):
+    """paddle.jit.sot.symbolic_translate parity: wrap ``fn`` in the guarded
+    capture machinery. Usable as decorator or call."""
+    if fn is None:
+        return lambda f: symbolic_translate(f)
+
+    from paddle_tpu.jit.api import to_static
+
+    frame = _Frame(fn)
+
+    def dispatch(*args, **kwargs):
+        if frame.fallback:
+            return fn(*args, **kwargs)
+        key = frame.guard_key(args, kwargs)
+        compiled = frame.specializations.get(key)
+        if compiled is None:
+            # full_graph=True: trace failures must surface HERE so the
+            # frame's permanent-fallback bookkeeping engages (full_graph=
+            # False would swallow them inside StaticFunction per call,
+            # re-paying the trace cost every time)
+            compiled = to_static(fn, full_graph=True)
+            frame.specializations[key] = compiled
+        try:
+            return compiled(*args, **kwargs)
+        except _graph_break_types():
+            # graph break: this frame resists tracing — permanent eager
+            frame.fallback = True
+            frame.breaks += 1
+            frame.specializations.pop(key, None)
+            return fn(*args, **kwargs)
+
+    dispatch.__name__ = getattr(fn, "__name__", "sot_fn")
+    dispatch.__wrapped__ = fn
+    dispatch._sot_frame = frame  # introspection for tests/debugging
+    return dispatch
+
+
+def sot_stats(wrapped) -> dict:
+    f: _Frame = wrapped._sot_frame
+    return {"specializations": len(f.specializations),
+            "fallback": f.fallback, "breaks": f.breaks}
