@@ -20,6 +20,11 @@ from paddle_tpu.distributed.auto_parallel import (  # noqa: F401
     shard_layer,
     shard_tensor,
 )
+from paddle_tpu.distributed.auto_parallel.static_engine import (  # noqa: F401
+    DistModel,
+    Engine,
+    to_static,
+)
 from paddle_tpu.distributed.collective import (  # noqa: F401
     Group,
     ReduceOp,
