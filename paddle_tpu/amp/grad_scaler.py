@@ -47,15 +47,31 @@ class GradScaler:
         if not self._enable or self._unscaled:
             return
         inv = 1.0 / self.get_loss_scaling()
-        found = False
+        # found_inf stays DEVICE-SIDE: one fused reduction across all grads,
+        # no host sync per parameter (reference keeps found_inf on device,
+        # python/paddle/amp/grad_scaler.py:619; the old per-param bool() was
+        # a host round-trip per tensor per step)
+        found = None
         for p in optimizer._parameter_list:
             if p._grad is not None:
                 g = p._grad.astype(jnp.float32) * inv
-                if not bool(jnp.all(jnp.isfinite(g))):
-                    found = True
+                chunk = ~jnp.all(jnp.isfinite(g))
+                found = chunk if found is None else (found | chunk)
                 p._grad = g.astype(p._grad.dtype)
-        self._found_inf = found
+        self._found_inf_device = (found if found is not None
+                                  else jnp.asarray(False))
         self._unscaled = True
+
+    @property
+    def _found_inf(self):
+        # host materialization happens HERE, once, at the decision point
+        dev = getattr(self, "_found_inf_device", None)
+        return bool(dev) if dev is not None else False
+
+    @_found_inf.setter
+    def _found_inf(self, v):
+        # plain python bool — no device work for construction/reset paths
+        self._found_inf_device = bool(v)
 
     def step(self, optimizer):
         if not self._enable:

@@ -3,3 +3,4 @@
 from paddle_tpu.incubate.optimizer.distributed_fused_lamb import (  # noqa: F401
     DistributedFusedLamb,
 )
+from paddle_tpu.incubate.optimizer.fused_adamw import FusedAdamW  # noqa: F401

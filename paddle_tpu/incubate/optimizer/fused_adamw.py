@@ -1,0 +1,172 @@
+"""FusedAdamW: AdamW whose step is ONE Pallas kernel over the flat
+parameter space (kernel: ops/pallas/fused_adamw.py).
+
+Reference capability: multi-tensor fused optimizer updates
+(distributed_fused_lamb's flat-buffer pattern, phi fused adam). The flat
+fp32 master buffer, moments, and per-element decay coefficients persist
+across steps; each step flattens the incoming grads, runs the kernel
+(in-place via buffer aliasing), and scatters the updated values back into
+the (possibly bf16) parameters.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.optimizer.optimizer import AdamW
+from paddle_tpu.ops.pallas.fused_adamw import (
+    fused_adamw_flat,
+    pad_flat,
+    use_fused_adamw,
+)
+
+
+class FusedAdamW(AdamW):
+    """The ENTIRE step — grad flatten, Pallas kernel, scatter-back — is one
+    jitted program, so the eager hot loop pays a single dispatch instead of
+    one per parameter (the multi-tensor-apply win; stock eager AdamW issues
+    ~4 ops per parameter per step)."""
+
+    def __init__(self, *args, block_rows=512, **kwargs):
+        super().__init__(*args, **kwargs)
+        self._block_rows = block_rows
+        self._flat = None
+        self._jitted_step = None
+
+    def _build_flat(self, pairs):
+        old = self._flat
+        params = [p for p, _ in pairs]
+        flat_p, sizes, padded = pad_flat([p._value for p in params])
+        flat_m = jnp.zeros_like(flat_p)
+        flat_v = jnp.zeros_like(flat_p)
+        wd_pieces = [jnp.full(s, float(self._decay_for(p)), jnp.float32)
+                     for (p, _), s in zip(pairs, sizes)]
+        flat_wd, _, _ = pad_flat(wd_pieces)
+        b1pow = jnp.asarray(self._beta1, jnp.float32)
+        b2pow = jnp.asarray(self._beta2, jnp.float32)
+        if old is not None:
+            # the grad-bearing param set changed (layers frozen/unfrozen):
+            # CARRY OVER moments + fp32 master segments for surviving params
+            # instead of silently resetting optimizer state mid-training
+            old_off = {}
+            off = 0
+            for pid, n in zip(old["ids"], old["sizes"]):
+                old_off[pid] = (off, n)
+                off += n
+            off = 0
+            for p, n in zip(params, sizes):
+                hit = old_off.get(id(p))
+                if hit is not None and hit[1] == n:
+                    oo, _ = hit
+                    flat_m = flat_m.at[off:off + n].set(old["m"][oo:oo + n])
+                    flat_v = flat_v.at[off:off + n].set(old["v"][oo:oo + n])
+                    flat_p = flat_p.at[off:off + n].set(old["p"][oo:oo + n])
+                off += n
+            b1pow = old["b1pow"]
+            b2pow = old["b2pow"]
+        self._flat = {
+            "p": flat_p, "m": flat_m, "v": flat_v, "wd": flat_wd,
+            "sizes": sizes, "padded": padded,
+            "ids": [id(p) for p in params],
+            "shapes": [tuple(p.shape) for p in params],
+            "dtypes": [p.dtype for p in params],
+            "b1pow": b1pow,
+            "b2pow": b2pow,
+        }
+        sizes_t = tuple(sizes)
+        shapes_t = tuple(self._flat["shapes"])
+        dtypes_t = tuple(str(d) for d in self._flat["dtypes"])
+        beta1, beta2, eps = self._beta1, self._beta2, self._epsilon
+        block_rows = self._block_rows
+        interpret = not use_fused_adamw()
+
+        @jax.jit  # no donation: the tunneled backend mishandles donated+aliased buffers
+        def step_impl(flat_p, gvals, flat_m, flat_v, flat_wd, lr, b1p, b2p):
+            flat_g, _, _ = pad_flat(gvals)
+            new_p, new_m, new_v = fused_adamw_flat(
+                flat_p, flat_g, flat_m, flat_v, flat_wd, lr, b1p, b2p,
+                beta1=beta1, beta2=beta2, eps=eps,
+                block_rows=block_rows, interpret=interpret)
+            outs = []
+            off = 0
+            for n, shp, dt in zip(sizes_t, shapes_t, dtypes_t):
+                outs.append(new_p[off:off + n].reshape(shp).astype(dt))
+                off += n
+            return new_p, new_m, new_v, outs
+
+        self._jitted_step = step_impl
+
+    def step(self):
+        lr = jnp.asarray(self.get_lr(), jnp.float32)
+        self._step_count += 1
+        pairs = list(self._clipped_grads())
+        if not pairs:
+            return
+        if self._flat is None or self._flat["ids"] != [id(p) for p, _ in pairs]:
+            self._build_flat(pairs)
+        st = self._flat
+        # pass device arrays through untouched. NB: do not duck-type on
+        # `_value` here — jax.Array has an INTERNAL ._value property that
+        # materializes the array to host numpy (a full download on remote
+        # backends)
+        from paddle_tpu.tensor import Tensor
+        gvals = [g._value if isinstance(g, Tensor) else g for _, g in pairs]
+        st["p"], st["m"], st["v"], new_vals = self._jitted_step(
+            st["p"], gvals, st["m"], st["v"], st["wd"], lr,
+            st["b1pow"], st["b2pow"])
+        st["b1pow"] = st["b1pow"] * self._beta1
+        st["b2pow"] = st["b2pow"] * self._beta2
+        for (p, _), v in zip(pairs, new_vals):
+            p._replace_value(v)
+
+    # ------------------------------------------------------ checkpointing
+    def state_dict(self):
+        """Flat-buffer state (the per-param base-class dict would be empty)."""
+        from paddle_tpu.tensor import Tensor
+
+        sd = {"step_count": self._step_count}
+        if self._flat is not None:
+            st = self._flat
+            sd["fused"] = {
+                "p": Tensor._from_value(st["p"]),
+                "m": Tensor._from_value(st["m"]),
+                "v": Tensor._from_value(st["v"]),
+                "b1pow": Tensor._from_value(st["b1pow"]),
+                "b2pow": Tensor._from_value(st["b2pow"]),
+                "sizes": list(st["sizes"]),
+            }
+        from paddle_tpu.optimizer import lr as lr_mod
+        if isinstance(self._lr, lr_mod.LRScheduler):
+            sd["LR_Scheduler"] = self._lr.state_dict()
+        return sd
+
+    def set_state_dict(self, state_dict):
+        from paddle_tpu.tensor import Tensor
+
+        self._step_count = state_dict.get("step_count", 0)
+        fused = state_dict.get("fused")
+        if fused is not None:
+            # rebuild layout from the CURRENT params (same model/order),
+            # then overwrite the buffers with the checkpointed state
+            pairs = [(p, None) for p in self._parameter_list if p.trainable]
+            self._build_flat(pairs)
+            unwrap = lambda t: t._value if isinstance(t, Tensor) \
+                else jnp.asarray(t)
+            if list(fused["sizes"]) != list(self._flat["sizes"]):
+                raise ValueError(
+                    "FusedAdamW.set_state_dict: parameter layout mismatch "
+                    f"(ckpt {fused['sizes'][:3]}..., "
+                    f"model {self._flat['sizes'][:3]}...)")
+            for k in ("p", "m", "v", "b1pow", "b2pow"):
+                self._flat[k] = unwrap(fused[k])
+            # push restored master params back into the live parameters
+            off = 0
+            for (p, _), n in zip(pairs, self._flat["sizes"]):
+                piece = self._flat["p"][off:off + n].reshape(p.shape)
+                p._replace_value(piece.astype(p.dtype))
+                off += n
+        from paddle_tpu.optimizer import lr as lr_mod
+        if "LR_Scheduler" in state_dict and isinstance(self._lr,
+                                                       lr_mod.LRScheduler):
+            self._lr.set_state_dict(state_dict["LR_Scheduler"])

@@ -1,0 +1,153 @@
+"""Fused AdamW Pallas kernel tests (VERDICT #8): numerics vs the formula and
+vs the stock AdamW optimizer; runs through the Pallas interpreter on CPU.
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.optimizer as opt
+from paddle_tpu.incubate.optimizer import FusedAdamW
+from paddle_tpu.ops.pallas.fused_adamw import fused_adamw_flat, pad_flat
+
+
+def _np_adamw(p, g, m, v, lr, b1p, b2p, beta1, beta2, eps, wd):
+    m2 = beta1 * m + (1 - beta1) * g
+    v2 = beta2 * v + (1 - beta2) * g * g
+    mh = m2 / (1 - b1p)
+    vh = v2 / (1 - b2p)
+    p2 = p * (1 - lr * wd)
+    return p2 - lr * mh / (np.sqrt(vh) + eps), m2, v2
+
+
+def test_kernel_matches_formula():
+    rng = np.random.default_rng(0)
+    n = 8 * 128 * 3
+    p = rng.normal(size=n).astype(np.float32)
+    g = rng.normal(size=n).astype(np.float32)
+    m = rng.normal(size=n).astype(np.float32) * 0.1
+    v = np.abs(rng.normal(size=n)).astype(np.float32) * 0.01
+    wd = np.where(rng.random(n) > 0.5, 0.01, 0.0).astype(np.float32)
+
+    out_p, out_m, out_v = fused_adamw_flat(
+        p, g, m, v, wd, 1e-3, 0.9, 0.999, interpret=True)
+    ref_p, ref_m, ref_v = _np_adamw(p, g, m, v, 1e-3, 0.9, 0.999,
+                                    0.9, 0.999, 1e-8, wd)
+    np.testing.assert_allclose(np.asarray(out_p), ref_p, rtol=1e-5, atol=1e-7)
+    np.testing.assert_allclose(np.asarray(out_m), ref_m, rtol=1e-6, atol=1e-8)
+    np.testing.assert_allclose(np.asarray(out_v), ref_v, rtol=1e-6, atol=1e-8)
+
+
+def test_kernel_multiblock_grid():
+    rng = np.random.default_rng(1)
+    n = 8 * 128 * 8
+    arrs = [rng.normal(size=n).astype(np.float32) for _ in range(4)]
+    p, g, m, v = arrs
+    v = np.abs(v) * 0.01
+    wd = np.zeros(n, np.float32)
+    small = fused_adamw_flat(p, g, m, v, wd, 1e-3, 0.9, 0.999,
+                             block_rows=8, interpret=True)
+    big = fused_adamw_flat(p, g, m, v, wd, 1e-3, 0.9, 0.999,
+                           block_rows=64, interpret=True)
+    np.testing.assert_allclose(np.asarray(small[0]), np.asarray(big[0]),
+                               rtol=1e-6)
+
+
+def test_fused_optimizer_matches_stock_adamw():
+    rng = np.random.default_rng(2)
+    X = rng.normal(size=(16, 8)).astype(np.float32)
+    Y = rng.normal(size=(16, 1)).astype(np.float32)
+
+    def build(fused):
+        paddle.framework.random.seed(5)
+        m = nn.Sequential(nn.Linear(8, 32), nn.Tanh(), nn.Linear(32, 1))
+        cls = FusedAdamW if fused else opt.AdamW
+        o = cls(learning_rate=1e-2, parameters=m.parameters(),
+                weight_decay=0.01)
+        return m, o
+
+    m1, o1 = build(True)
+    m2, o2 = build(False)
+    lossfn = nn.MSELoss()
+    for _ in range(4):
+        for m, o in ((m1, o1), (m2, o2)):
+            loss = lossfn(m(paddle.to_tensor(X)), paddle.to_tensor(Y))
+            loss.backward()
+            o.step()
+            o.clear_grad()
+    for p, q in zip(m1.parameters(), m2.parameters()):
+        np.testing.assert_allclose(p.numpy(), q.numpy(), rtol=2e-4,
+                                   atol=2e-6)
+
+
+def test_state_dict_roundtrip():
+    rng = np.random.default_rng(3)
+    X = rng.normal(size=(8, 4)).astype(np.float32)
+    Y = rng.normal(size=(8, 1)).astype(np.float32)
+
+    def build():
+        paddle.framework.random.seed(9)
+        m = nn.Linear(4, 1)
+        o = FusedAdamW(learning_rate=1e-2, parameters=m.parameters())
+        return m, o
+
+    m1, o1 = build()
+    lossfn = nn.MSELoss()
+    for _ in range(3):
+        loss = lossfn(m1(paddle.to_tensor(X)), paddle.to_tensor(Y))
+        loss.backward()
+        o1.step()
+        o1.clear_grad()
+    sd = o1.state_dict()
+
+    m2, o2 = build()
+    o2.set_state_dict(sd)
+    # continue training both; trajectories must stay identical
+    for m, o in ((m1, o1), (m2, o2)):
+        loss = lossfn(m(paddle.to_tensor(X)), paddle.to_tensor(Y))
+        loss.backward()
+        o.step()
+        o.clear_grad()
+    for p, q in zip(m1.parameters(), m2.parameters()):
+        np.testing.assert_allclose(p.numpy(), q.numpy(), rtol=1e-6)
+
+
+def test_param_set_change_preserves_moments():
+    rng = np.random.default_rng(4)
+    X = rng.normal(size=(8, 4)).astype(np.float32)
+    Y = rng.normal(size=(8, 1)).astype(np.float32)
+    paddle.framework.random.seed(10)
+    m = nn.Sequential(nn.Linear(4, 8), nn.Tanh(), nn.Linear(8, 1))
+    o = FusedAdamW(learning_rate=1e-2, parameters=m.parameters())
+    lossfn = nn.MSELoss()
+    for _ in range(3):
+        loss = lossfn(m(paddle.to_tensor(X)), paddle.to_tensor(Y))
+        loss.backward()
+        o.step()
+        o.clear_grad()
+    import jax.numpy as jnp
+    m_before = np.asarray(o._flat["m"])
+    b1p_before = float(o._flat["b1pow"])
+    assert np.abs(m_before).max() > 0
+    # freeze the first layer: grad-bearing set shrinks
+    for p in m[0].parameters():
+        p.stop_gradient = True
+        p.trainable = False
+    loss = lossfn(m(paddle.to_tensor(X)), paddle.to_tensor(Y))
+    loss.backward()
+    o.step()
+    # surviving params kept their (nonzero) moments and the pow chain
+    assert float(o._flat["b1pow"]) < b1p_before  # advanced, not reset
+    assert np.abs(np.asarray(o._flat["m"])).max() > 0
+
+
+def test_pad_flat_roundtrip():
+    import jax.numpy as jnp
+    a = np.arange(10, dtype=np.float32)
+    b = np.arange(6, dtype=np.float32).reshape(2, 3)
+    flat, sizes, padded = pad_flat([jnp.asarray(a), jnp.asarray(b)])
+    assert padded % (8 * 128) == 0
+    assert sizes == [10, 6]
+    np.testing.assert_allclose(np.asarray(flat[:10]), a)
+    np.testing.assert_allclose(np.asarray(flat[10:16]).reshape(2, 3), b)
