@@ -40,11 +40,10 @@ class FusedAdamW(AdamW):
         flat_p, sizes, padded = pad_flat([p._value for p in params])
         flat_m = jnp.zeros_like(flat_p)
         flat_v = jnp.zeros_like(flat_p)
-        wd_pieces = [jnp.full(s, float(self._decay_for(p)), jnp.float32)
-                     for (p, _), s in zip(pairs, sizes)]
-        flat_wd, _, _ = pad_flat(wd_pieces)
-        b1pow = jnp.asarray(self._beta1, jnp.float32)
-        b2pow = jnp.asarray(self._beta2, jnp.float32)
+        flat_wd, wd_sig = self._wd_buffer(params, sizes)
+        # PER-ELEMENT pow chains: new params start their own correction
+        b1pow = jnp.full_like(flat_p, self._beta1)
+        b2pow = jnp.full_like(flat_p, self._beta2)
         if old is not None:
             # the grad-bearing param set changed (layers frozen/unfrozen):
             # CARRY OVER moments + fp32 master segments for surviving params
@@ -62,9 +61,11 @@ class FusedAdamW(AdamW):
                     flat_m = flat_m.at[off:off + n].set(old["m"][oo:oo + n])
                     flat_v = flat_v.at[off:off + n].set(old["v"][oo:oo + n])
                     flat_p = flat_p.at[off:off + n].set(old["p"][oo:oo + n])
+                    b1pow = b1pow.at[off:off + n].set(
+                        old["b1pow"][oo:oo + n])
+                    b2pow = b2pow.at[off:off + n].set(
+                        old["b2pow"][oo:oo + n])
                 off += n
-            b1pow = old["b1pow"]
-            b2pow = old["b2pow"]
         self._flat = {
             "p": flat_p, "m": flat_m, "v": flat_v, "wd": flat_wd,
             "sizes": sizes, "padded": padded,
@@ -73,6 +74,7 @@ class FusedAdamW(AdamW):
             "dtypes": [p.dtype for p in params],
             "b1pow": b1pow,
             "b2pow": b2pow,
+            "wd_sig": wd_sig,
         }
         sizes_t = tuple(sizes)
         shapes_t = tuple(self._flat["shapes"])
@@ -84,7 +86,7 @@ class FusedAdamW(AdamW):
         @jax.jit  # no donation: the tunneled backend mishandles donated+aliased buffers
         def step_impl(flat_p, gvals, flat_m, flat_v, flat_wd, lr, b1p, b2p):
             flat_g, _, _ = pad_flat(gvals)
-            new_p, new_m, new_v = fused_adamw_flat(
+            new_p, new_m, new_v, nb1, nb2 = fused_adamw_flat(
                 flat_p, flat_g, flat_m, flat_v, flat_wd, lr, b1p, b2p,
                 beta1=beta1, beta2=beta2, eps=eps,
                 block_rows=block_rows, interpret=interpret)
@@ -93,9 +95,18 @@ class FusedAdamW(AdamW):
             for n, shp, dt in zip(sizes_t, shapes_t, dtypes_t):
                 outs.append(new_p[off:off + n].reshape(shp).astype(dt))
                 off += n
-            return new_p, new_m, new_v, outs
+            return new_p, new_m, new_v, nb1, nb2, outs
 
         self._jitted_step = step_impl
+
+    def _wd_buffer(self, params, sizes):
+        """Per-element decay buffer + its python signature (re-evaluated
+        every step so runtime decay changes — p.no_weight_decay toggles,
+        apply_decay_param_fun — take effect like stock AdamW)."""
+        sig = tuple(float(self._decay_for(p)) for p in params)
+        pieces = [jnp.full(s, c, jnp.float32) for c, s in zip(sig, sizes)]
+        flat_wd, _, _ = pad_flat(pieces)
+        return flat_wd, sig
 
     def step(self):
         lr = jnp.asarray(self.get_lr(), jnp.float32)
@@ -106,17 +117,20 @@ class FusedAdamW(AdamW):
         if self._flat is None or self._flat["ids"] != [id(p) for p, _ in pairs]:
             self._build_flat(pairs)
         st = self._flat
+        params = [p for p, _ in pairs]
+        wd_sig = tuple(float(self._decay_for(p)) for p in params)
+        if wd_sig != st["wd_sig"]:
+            st["wd"], st["wd_sig"] = self._wd_buffer(params, st["sizes"])
         # pass device arrays through untouched. NB: do not duck-type on
         # `_value` here — jax.Array has an INTERNAL ._value property that
         # materializes the array to host numpy (a full download on remote
         # backends)
         from paddle_tpu.tensor import Tensor
         gvals = [g._value if isinstance(g, Tensor) else g for _, g in pairs]
-        st["p"], st["m"], st["v"], new_vals = self._jitted_step(
+        (st["p"], st["m"], st["v"], st["b1pow"], st["b2pow"],
+         new_vals) = self._jitted_step(
             st["p"], gvals, st["m"], st["v"], st["wd"], lr,
             st["b1pow"], st["b2pow"])
-        st["b1pow"] = st["b1pow"] * self._beta1
-        st["b2pow"] = st["b2pow"] * self._beta2
         for (p, _), v in zip(pairs, new_vals):
             p._replace_value(v)
 
@@ -146,6 +160,46 @@ class FusedAdamW(AdamW):
 
         self._step_count = state_dict.get("step_count", 0)
         fused = state_dict.get("fused")
+        if fused is None and state_dict.get("states"):
+            # stock-AdamW-format checkpoint: reconstruct the flat buffers
+            # from the per-param moment1/moment2/step entries (drop-in
+            # resume path; silently zeroing moments would be a trap)
+            pairs = [(p, None) for p in self._parameter_list if p.trainable]
+            self._build_flat(pairs)
+            st = self._flat
+            unwrap = lambda t: t._value if isinstance(t, Tensor) \
+                else jnp.asarray(t)
+            states = state_dict["states"]
+            off_map = {}
+            off = 0
+            for (p, _), n in zip(pairs, st["sizes"]):
+                off_map[id(p)] = (off, n)
+                off += n
+            for p, entry in zip(self._parameter_list, states):
+                loc = off_map.get(id(p))
+                if entry is None or loc is None:
+                    continue
+                off, n = loc
+                m1 = unwrap(entry["moment1"]).reshape(-1).astype(jnp.float32)
+                m2 = unwrap(entry["moment2"]).reshape(-1).astype(jnp.float32)
+                step = int(unwrap(entry["step"]))
+                st["m"] = st["m"].at[off:off + n].set(m1)
+                st["v"] = st["v"].at[off:off + n].set(m2)
+                # after t recorded steps, the NEXT update's input pow is
+                # beta^(t+1) (phi input convention)
+                st["b1pow"] = st["b1pow"].at[off:off + n].set(
+                    float(self._beta1) ** (step + 1))
+                st["b2pow"] = st["b2pow"].at[off:off + n].set(
+                    float(self._beta2) ** (step + 1))
+            masters = state_dict.get("master_weights") or []
+            for p, mw in zip(self._parameter_list, masters):
+                loc = off_map.get(id(p))
+                if mw is None or loc is None:
+                    continue
+                off, n = loc
+                st["p"] = st["p"].at[off:off + n].set(
+                    unwrap(mw).reshape(-1).astype(jnp.float32))
+            return
         if fused is not None:
             # rebuild layout from the CURRENT params (same model/order),
             # then overwrite the buffers with the checkpointed state

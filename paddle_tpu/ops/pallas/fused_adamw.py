@@ -41,16 +41,17 @@ def use_fused_adamw() -> bool:
 
 
 def _adamw_kernel(beta1, beta2, eps,
-                  lr_ref, b1p_ref, b2p_ref,
-                  p_ref, g_ref, m_ref, v_ref, wd_ref,
-                  op_ref, om_ref, ov_ref):
+                  lr_ref,
+                  p_ref, g_ref, m_ref, v_ref, wd_ref, b1p_ref, b2p_ref,
+                  op_ref, om_ref, ov_ref, ob1_ref, ob2_ref):
     lr = lr_ref[0]
-    b1p = b1p_ref[0]
-    b2p = b2p_ref[0]
     g = g_ref[:]
     m = beta1 * m_ref[:] + (1.0 - beta1) * g
     v = beta2 * v_ref[:] + (1.0 - beta2) * g * g
-    # bias correction with the incoming pow accumulators (phi convention)
+    # PER-ELEMENT pow accumulators (phi input convention): params that join
+    # the grad-bearing set later restart their own bias-correction chain
+    b1p = b1p_ref[:]
+    b2p = b2p_ref[:]
     m_hat = m / (1.0 - b1p)
     v_hat = v / (1.0 - b2p)
     p = p_ref[:]
@@ -58,6 +59,8 @@ def _adamw_kernel(beta1, beta2, eps,
     op_ref[:] = p - lr * m_hat / (jnp.sqrt(v_hat) + eps)
     om_ref[:] = m
     ov_ref[:] = v
+    ob1_ref[:] = b1p * beta1
+    ob2_ref[:] = b2p * beta2
 
 
 @functools.partial(
@@ -69,8 +72,10 @@ def fused_adamw_flat(p, g, m, v, wd, lr, b1pow, b2pow, *,
     """One AdamW step over flat fp32 buffers.
 
     p/g/m/v/wd: [N] float32 (N padded to a multiple of 8*128 by the caller —
-    see pad_flat). lr/b1pow/b2pow: scalars (b*pow are the incoming
-    accumulators, beta-initialized at step 1). Returns (p', m', v').
+    see pad_flat). lr: scalar. b1pow/b2pow: [N] per-element incoming pow
+    accumulators (beta-initialized at each element's step 1) — per-element
+    so late-joining params restart their own bias-correction chain.
+    Returns (p', m', v', b1pow', b2pow').
     """
     n = p.shape[0]
     assert n % (8 * _LANES) == 0, n
@@ -87,10 +92,14 @@ def fused_adamw_flat(p, g, m, v, wd, lr, b1pow, b2pow, *,
     def as2d(a):
         a = a.reshape(rows, _LANES)
         if rows_p != rows:
+            # zero padding is safe even for the pow chains: 1/(1-0) = 1 and
+            # padded outputs are discarded by unpad()
             a = jnp.pad(a, ((0, rows_p - rows), (0, 0)))
         return a
-    scalars = [jnp.asarray(s, jnp.float32).reshape(1)
-               for s in (lr, b1pow, b2pow)]
+
+    lr_arr = jnp.asarray(lr, jnp.float32).reshape(1)
+    b1pow = jnp.broadcast_to(jnp.asarray(b1pow, jnp.float32), (n,))
+    b2pow = jnp.broadcast_to(jnp.asarray(b2pow, jnp.float32), (n,))
 
     kernel = functools.partial(_adamw_kernel, float(beta1), float(beta2),
                                float(eps))
@@ -98,18 +107,22 @@ def fused_adamw_flat(p, g, m, v, wd, lr, b1pow, b2pow, *,
     scalar_spec = pl.BlockSpec(memory_space=(
         pltpu.SMEM if (pltpu is not None and not interpret) else None))
 
-    out_p, out_m, out_v = pl.pallas_call(
+    out_p, out_m, out_v, out_b1, out_b2 = pl.pallas_call(
         kernel,
         grid=grid,
-        in_specs=[scalar_spec, scalar_spec, scalar_spec,
-                  row_spec, row_spec, row_spec, row_spec, row_spec],
-        out_specs=[row_spec, row_spec, row_spec],
-        out_shape=[jax.ShapeDtypeStruct(shape2d, jnp.float32)] * 3,
-        input_output_aliases={3: 0, 5: 1, 6: 2},  # p->p', m->m', v->v'
+        in_specs=[scalar_spec,
+                  row_spec, row_spec, row_spec, row_spec, row_spec,
+                  row_spec, row_spec],
+        out_specs=[row_spec] * 5,
+        out_shape=[jax.ShapeDtypeStruct(shape2d, jnp.float32)] * 5,
+        # p->p', m->m', v->v', b1p->b1p', b2p->b2p'
+        input_output_aliases={1: 0, 3: 1, 4: 2, 6: 3, 7: 4},
         interpret=interpret,
-    )(*scalars, as2d(p), as2d(g), as2d(m), as2d(v), as2d(wd))
+    )(lr_arr, as2d(p), as2d(g), as2d(m), as2d(v), as2d(wd),
+      as2d(b1pow), as2d(b2pow))
     unpad = lambda a: a.reshape(rows_p * _LANES)[:n]
-    return unpad(out_p), unpad(out_m), unpad(out_v)
+    return (unpad(out_p), unpad(out_m), unpad(out_v),
+            unpad(out_b1), unpad(out_b2))
 
 
 def pad_flat(arrs, pad_multiple=8 * _LANES):

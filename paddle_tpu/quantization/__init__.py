@@ -101,6 +101,14 @@ class FakeQuanterWithAbsMaxObserver(pnn.Layer):
         self.quant_bits = quant_bits
         self._ema = None
 
+    def scales(self):
+        if self._ema is None:
+            return None
+        return self._ema / (2 ** (self.quant_bits - 1) - 1)
+
+    def bit_length(self):
+        return self.quant_bits
+
     def forward(self, x):
         cur = float(np.abs(np.asarray(x.detach().numpy())).max() or 1e-8)
         self._ema = cur if self._ema is None else \
@@ -173,8 +181,77 @@ def _apply_config(model, config: QuantConfig, factory):
     return model
 
 
+class QuantizedInferenceLayer(pnn.Layer):
+    """Inference-time int8 simulation produced by convert(): the weight is
+    STORED as int8 (+ fp scale) and dequantized on the fly; activations pass
+    through a frozen-scale quant-dequant. On TPU the dequant folds into the
+    surrounding matmul (the weight-only-int8 serving pattern; reference:
+    the ONNX-exportable quantized program QAT.convert emits)."""
+
+    def __init__(self, qlayer: "QuantedLayer"):
+        super().__init__()
+        self.inner = qlayer.inner
+        self.act_scale = None
+        self.act_bits = 8
+        if qlayer.act_quanter is not None:
+            s = qlayer.act_quanter.scales()
+            self.act_scale = float(s) if s is not None else None
+            self.act_bits = qlayer.act_quanter.bit_length()
+        self.qweight = None
+        self.w_scale = None
+        if qlayer.weight_quanter is not None and hasattr(qlayer.inner,
+                                                         "weight"):
+            w = qlayer.inner.weight._value
+            bits = qlayer.weight_quanter.bit_length()
+            s = qlayer.weight_quanter.scales()
+            scale = (float(s) if s is not None
+                     else float(jnp.max(jnp.abs(w))) / (2 ** (bits - 1) - 1))
+            scale = scale or 1e-8
+            qmax = 2 ** (bits - 1) - 1
+            self.qweight = Tensor._from_value(
+                jnp.clip(jnp.round(w / scale), -qmax, qmax).astype(jnp.int8))
+            self.w_scale = scale
+
+    def forward(self, x):
+        import paddle_tpu as paddle
+
+        if self.act_scale is not None:
+            qmax = float(2 ** (self.act_bits - 1) - 1)
+            q = paddle.clip(paddle.round(x / self.act_scale), -qmax, qmax)
+            x = q * self.act_scale
+        if self.qweight is not None:
+            w = self.inner.weight
+            orig = w._value
+            w._replace_value(
+                (self.qweight._value.astype(jnp.float32)
+                 * self.w_scale).astype(orig.dtype))
+            try:
+                return self.inner(x)
+            finally:
+                w._replace_value(orig)
+        return self.inner(x)
+
+
+def _convert_tree(model, inplace):
+    if not inplace:
+        import copy
+
+        model = copy.deepcopy(model)  # preserve the observed/QAT model
+
+    def walk(m):
+        for name, child in list(m._sub_layers.items()):
+            if isinstance(child, QuantedLayer):
+                m._sub_layers[name] = QuantizedInferenceLayer(child)
+            else:
+                walk(child)
+
+    walk(model)
+    return model
+
+
 class QAT:
-    """qat.py parity: insert trainable fake-quant nodes."""
+    """qat.py parity: insert trainable fake-quant nodes; convert() swaps
+    them for the int8-sim inference layers with frozen scales."""
 
     def __init__(self, config: QuantConfig):
         self.config = config
@@ -186,6 +263,9 @@ class QAT:
             return f() if callable(f) else f
 
         return _apply_config(model, self.config, factory)
+
+    def convert(self, model, inplace=False):
+        return _convert_tree(model, inplace)
 
 
 class PTQ:
@@ -203,5 +283,39 @@ class PTQ:
 
         return _apply_config(model, self.config, factory)
 
+    def calibrate(self, model, loader, steps=None):
+        """Run representative data through the observed model (the PTQ
+        calibration loop; reference ptq.py sampling pass). Accepts a
+        DataLoader-like iterable yielding batches or (x, ...) tuples."""
+        model.eval()
+        n = 0
+        for batch in loader:
+            x = batch[0] if isinstance(batch, (list, tuple)) else batch
+            model(x)
+            n += 1
+            if steps is not None and n >= steps:
+                break
+        return n
+
     def convert(self, model, inplace=False):
-        return model
+        return _convert_tree(model, inplace)
+
+
+def collect_scales(model, prefix=""):
+    """All calibrated scales in the (observed or converted) model —
+    {layer_path: {"act": s, "weight": s}}."""
+    out = {}
+    for name, child in model._sub_layers.items():
+        path = f"{prefix}.{name}" if prefix else name
+        if isinstance(child, QuantedLayer):
+            entry = {}
+            if child.act_quanter is not None:
+                entry["act"] = child.act_quanter.scales()
+            if child.weight_quanter is not None:
+                entry["weight"] = child.weight_quanter.scales()
+            out[path] = entry
+        elif isinstance(child, QuantizedInferenceLayer):
+            out[path] = {"act": child.act_scale, "weight": child.w_scale}
+        else:
+            out.update(collect_scales(child, path))
+    return out
