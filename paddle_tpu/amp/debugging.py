@@ -47,10 +47,16 @@ def collect_operator_stats():
 
 
 def check_numerics(tensor, op_name: str = ""):
-    """Raise if tensor contains NaN/Inf (eager check)."""
+    """Raise if tensor contains NaN/Inf (eager check). Under tracing the
+    value is abstract — the compiled-path checkify instrumentation
+    (jit/api.py) covers it instead."""
+    import jax
+
     from paddle_tpu.tensor import Tensor
 
     val = tensor._value if isinstance(tensor, Tensor) else tensor
+    if isinstance(val, jax.core.Tracer):
+        return tensor
     if jnp.issubdtype(val.dtype, jnp.inexact):
         if not bool(jnp.all(jnp.isfinite(val))):
             raise FloatingPointError(

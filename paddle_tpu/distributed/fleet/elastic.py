@@ -120,7 +120,17 @@ class ElasticManager:
         return self.watch() == ElasticStatus.RESTART
 
     def exit_for_restart(self):
-        """Exit with the protocol code so the launcher relaunches us."""
+        """Exit with the protocol code so the launcher relaunches us. The
+        current alive membership is written to PADDLE_ELASTIC_WORLD_FILE (if
+        set) so the supervisor respawns with the post-scale world size."""
+        world_file = os.environ.get("PADDLE_ELASTIC_WORLD_FILE")
+        if world_file:
+            try:
+                n = max(len(self.alive_members()), 1)
+                with open(world_file, "w") as f:
+                    f.write(str(min(max(n, self.np_lo), self.np_hi)))
+            except Exception:
+                pass
         self.stop()
         os._exit(ELASTIC_EXIT_CODE)
 

@@ -74,20 +74,28 @@ def launch(args=None):
         exec(code, globs)
         return 0
 
-    procs = []
     log_dir = args.log_dir
     if log_dir:
         os.makedirs(log_dir, exist_ok=True)
-    for lr in range(args.nproc_per_node):
-        grank = args.rank * args.nproc_per_node + lr
-        env = _child_env(args, lr, world, grank)
-        stdout = (open(os.path.join(log_dir, f"worker.{grank}.log"), "w")
-                  if log_dir else None)
-        procs.append(subprocess.Popen(
-            [sys.executable, args.training_script] + args.training_script_args,
-            env=env, stdout=stdout,
-            stderr=subprocess.STDOUT if stdout else None,
-        ))
+
+    def _spawn(world_size, attempt):
+        procs = []
+        for lr in range(args.nproc_per_node):
+            grank = args.rank * args.nproc_per_node + lr
+            env = _child_env(args, lr, world_size, grank)
+            stdout = (open(os.path.join(
+                log_dir, f"worker.{grank}.log"
+                if attempt == 0 else f"worker.{grank}.r{attempt}.log"), "w")
+                if log_dir else None)
+            procs.append(subprocess.Popen(
+                [sys.executable, args.training_script]
+                + args.training_script_args,
+                env=env, stdout=stdout,
+                stderr=subprocess.STDOUT if stdout else None,
+            ))
+        return procs
+
+    procs = _spawn(world, 0)
 
     def _kill(*_):
         for p in procs:
@@ -95,10 +103,50 @@ def launch(args=None):
                 p.terminate()
 
     signal.signal(signal.SIGTERM, _kill)
+    # elastic supervision (reference: launch controllers + ElasticManager
+    # exit-code protocol, fleet/elastic/manager.py:32): a worker exiting
+    # with ELASTIC_EXIT_CODE asks for a relaunch. The supervisor POLLS so
+    # one worker stuck in a collective cannot block the requested relaunch
+    # (it gets terminated); the new world size comes from the world-file a
+    # departing worker writes (PADDLE_ELASTIC_WORLD_FILE), since membership
+    # lives in the trainers' store, not the launcher.
+    from paddle_tpu.distributed.fleet.elastic import ELASTIC_EXIT_CODE
+
+    elastic = bool(os.environ.get("PADDLE_ELASTIC_NP"))
+    world_file = os.environ.get("PADDLE_ELASTIC_WORLD_FILE")
+    max_restarts = int(os.environ.get("PADDLE_ELASTIC_MAX_RESTARTS", "3"))
+    attempt = 0
     rc = 0
     try:
-        for p in procs:
-            rc = p.wait() or rc
+        while True:
+            want_restart = False
+            while True:
+                rcs = [p.poll() for p in procs]
+                if elastic and any(r == ELASTIC_EXIT_CODE for r in rcs
+                                   if r is not None):
+                    want_restart = True
+                    break
+                if all(r is not None for r in rcs):
+                    break
+                time.sleep(0.2)
+            if want_restart and attempt < max_restarts:
+                attempt += 1
+                _kill()
+                for p in procs:
+                    try:
+                        p.wait(timeout=30)
+                    except subprocess.TimeoutExpired:
+                        p.kill()
+                if world_file and os.path.exists(world_file):
+                    try:
+                        world = int(open(world_file).read().strip())
+                    except ValueError:
+                        pass
+                procs = _spawn(world, attempt)
+                continue
+            rcs = [p.wait() for p in procs]
+            rc = next((r for r in rcs if r), 0)
+            break
     except KeyboardInterrupt:
         _kill()
         rc = 1

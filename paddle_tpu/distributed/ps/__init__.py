@@ -203,10 +203,14 @@ class MemoryDenseTable:
             self._value -= self.lr * np.asarray(grad, np.float32)
 
     def save(self, path):
-        np.save(path, self._value)
+        # file-object form: np.save(path_str) would append ".npy" and break
+        # the save/load roundtrip for arbitrary paths
+        with open(path, "wb") as f:
+            np.save(f, self._value)
 
     def load(self, path):
-        self._value = np.load(path)
+        with open(path, "rb") as f:
+            self._value = np.load(f)
 
 
 # ---------------------------------------------------------------- PS server

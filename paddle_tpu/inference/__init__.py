@@ -50,28 +50,59 @@ class Config:
             self._prefix = p
         self._flags: Dict[str, object] = {}
 
-    # --- knobs (recorded; XLA owns the actual optimization pipeline) ---
+    # --- knobs ---------------------------------------------------------
+    # Each knob is either APPLIED (has a real effect on this backend) or
+    # ABSORBED (the concern it configures is owned by XLA — fusion, memory
+    # planning, engine selection). summary() reports which is which, so the
+    # deployment surface is honest instead of silently recording.
+    _ABSORBED = {"use_gpu", "memory_optim", "ir_optim", "mkldnn"}
+
     def enable_use_gpu(self, memory_pool_init_size_mb=100, device_id=0,
                        precision=PrecisionType.Float32):
-        self._flags["use_gpu"] = True
+        self._flags["use_gpu"] = True  # device selection is jax-global
 
     def disable_gpu(self):
         self._flags["use_gpu"] = False
 
     def enable_memory_optim(self, x=True):
+        # XLA's buffer assignment IS the memory optimizer; weights are
+        # uploaded once and reused (TranslatedLayer caches device arrays)
         self._flags["memory_optim"] = x
 
     def switch_ir_optim(self, x=True):
-        self._flags["ir_optim"] = x
+        self._flags["ir_optim"] = x  # XLA pass pipeline always runs
 
     def set_cpu_math_library_num_threads(self, n):
+        """APPLIED best-effort: caps XLA:CPU intra-op threads. Must run
+        before the jax backend initializes (process start); afterwards it
+        only records."""
+        import os
+
+        import jax
+
         self._flags["cpu_threads"] = n
+        try:
+            initialized = jax._src.xla_bridge._backends  # noqa: SLF001
+        except Exception:
+            initialized = True
+        if not initialized:
+            flags = os.environ.get("XLA_FLAGS", "")
+            os.environ["XLA_FLAGS"] = (
+                f"{flags} --xla_cpu_multi_thread_eigen=true "
+                f"intra_op_parallelism_threads={n}").strip()
+        else:
+            self._flags["cpu_threads_note"] = "backend already up; recorded"
 
     def enable_mkldnn(self):
         self._flags["mkldnn"] = True
 
     def disable_glog_info(self):
+        """APPLIED: silences jax/XLA info logging."""
+        import logging
+
         self._flags["glog"] = False
+        for name in ("jax", "jax._src.xla_bridge", "jax._src.dispatch"):
+            logging.getLogger(name).setLevel(logging.WARNING)
 
     def enable_tensorrt_engine(self, *a, **k):
         raise NotImplementedError(
@@ -88,7 +119,11 @@ class Config:
         return (self._prefix or "") + ".pdiparams"
 
     def summary(self):
-        return "\n".join(f"{k}: {v}" for k, v in self._flags.items())
+        lines = []
+        for k, v in self._flags.items():
+            tag = "absorbed-by-XLA" if k in self._ABSORBED else "applied"
+            lines.append(f"{k}: {v} [{tag}]")
+        return "\n".join(lines)
 
 
 class InferTensor:

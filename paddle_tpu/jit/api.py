@@ -50,6 +50,7 @@ class StaticFunction:
             donate += (2,)
         self._jitted = jax.jit(self._traced, static_argnames=("training",),
                                donate_argnums=donate)
+        self._jitted_checked = None  # built lazily when nan/inf debug is on
         # grad path: same pure program, no donation (fwd runs under jax.vjp)
         self._jitted_nodonate = (
             self._jitted if not donate
@@ -113,9 +114,30 @@ class StaticFunction:
             diff_params or any(not t.stop_gradient for t in arg_tensors))
 
         if not needs_grad:
-            out_vals, new_buffer_vals = self._jitted(
-                param_vals, buffer_vals, arg_vals, kwarg_vals, key, training
-            )
+            from paddle_tpu.amp import debugging as _dbg
+
+            if _dbg.check_numerics_enabled():
+                # the COMPILED-path numerics sanitizer (reference checks per
+                # instruction in the interpreter, program_interpreter.cc:1131)
+                # — checkify instruments every float op inside the program;
+                # err.throw() is the one host sync, debug mode only
+                if self._jitted_checked is None:
+                    from jax.experimental import checkify as _checkify
+
+                    # checkify erases the signature, so `training` must be
+                    # marked static POSITIONALLY (arg 5 of the bound method)
+                    self._jitted_checked = jax.jit(
+                        _checkify.checkify(self._traced,
+                                           errors=_checkify.float_checks),
+                        static_argnums=(5,))
+                err, (out_vals, new_buffer_vals) = self._jitted_checked(
+                    param_vals, buffer_vals, arg_vals, kwarg_vals, key,
+                    training)
+                err.throw()
+            else:
+                out_vals, new_buffer_vals = self._jitted(
+                    param_vals, buffer_vals, arg_vals, kwarg_vals, key,
+                    training)
             for b, v in zip(buffers, new_buffer_vals):
                 b._replace_value(v)
             return tree_wrap(out_vals)
@@ -307,6 +329,7 @@ class TrainStep:
                                  mv_sh, [None] * n_buffers,
                                  (None, None, None) if has_scaler else None,
                                  None)
+        self._out_shardings = out_shardings
         self._jitted = jax.jit(self._step,
                                donate_argnums=self._donate_argnums,
                                out_shardings=out_shardings)
@@ -433,11 +456,34 @@ class TrainStep:
                           for st in opt_states]
             master_vals = [mv if mv is None else to_device_memory(mv)
                            for mv in master_vals]
-        (loss_val, new_params, new_states, new_masters, new_buffer_vals,
-         new_scaler_state, aux_vals) = self._jitted(
-            param_vals, opt_states, master_vals, buffer_vals, batch_vals,
-            lr, key, scale
-        )
+        from paddle_tpu.amp import debugging as _dbg
+
+        if _dbg.check_numerics_enabled():
+            # compiled-path sanitizer for the TRAINING hot loop: checkify
+            # instruments every float op of fwd+bwd+update (the reference's
+            # per-instruction FLAGS_check_nan_inf); debug mode only
+            if getattr(self, "_jitted_checked", None) is None:
+                from jax.experimental import checkify as _checkify
+
+                # keep the offload out_shardings: the debug step must not
+                # migrate pinned-host optimizer state into HBM
+                osh = getattr(self, "_out_shardings", None)
+                self._jitted_checked = jax.jit(
+                    _checkify.checkify(self._step,
+                                       errors=_checkify.float_checks),
+                    out_shardings=(None, osh) if osh is not None else None)
+            err, (loss_val, new_params, new_states, new_masters,
+                  new_buffer_vals, new_scaler_state, aux_vals) = \
+                self._jitted_checked(
+                    param_vals, opt_states, master_vals, buffer_vals,
+                    batch_vals, lr, key, scale)
+            err.throw()
+        else:
+            (loss_val, new_params, new_states, new_masters, new_buffer_vals,
+             new_scaler_state, aux_vals) = self._jitted(
+                param_vals, opt_states, master_vals, buffer_vals, batch_vals,
+                lr, key, scale
+            )
         for p, v in zip(params, new_params):
             p._replace_value(v)
         if self._offload_post:

