@@ -1,0 +1,240 @@
+"""Round-2 op-test depth (VERDICT r1 weak #5): a table-driven OpTest sweep.
+
+Each CASES entry runs through the OpTest harness (eager + jit vs numpy,
+central-difference gradients). TOLERANCES is the tolerance-governance
+analogue of the reference's test/white_list/op_accuracy_white_list.py:
+every op gets the strict default unless it is explicitly listed with a
+justification.
+"""
+
+import numpy as np
+import pytest
+import scipy.special
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+from tests.op_test import OpTest
+
+rng = np.random.default_rng(7)
+
+
+def _f32(*shape, positive=False, lo=-2.0, hi=2.0):
+    a = rng.uniform(lo, hi, shape).astype(np.float32)
+    if positive:
+        a = np.abs(a) + 0.5
+    return a
+
+
+# op-accuracy governance: name -> (rtol, atol, why)
+TOLERANCES = {
+    "lgamma": (1e-4, 1e-5, "polynomial approximation differs from scipy"),
+    "digamma": (1e-4, 1e-5, "polynomial approximation differs from scipy"),
+    "erfinv": (1e-4, 1e-5, "iterative inverse"),
+    "logsumexp": (1e-5, 1e-6, "reduction order"),
+    "matrix_power": (1e-4, 1e-5, "repeated matmul accumulates"),
+    "pinv": (1e-4, 1e-4, "svd-based"),
+    "dist": (1e-5, 1e-6, "norm reduction order"),
+}
+_DEFAULT_TOL = (1e-6, 1e-7)
+
+# (name, op, inputs, attrs, ref, grad_keys)
+CASES = [
+    # ---------------------------------------------------------- unary math
+    ("erf", paddle.erf, {"x": _f32(3, 4)}, {}, scipy.special.erf, ["x"]),
+    ("erfinv", paddle.erfinv, {"x": _f32(3, 4, lo=-0.9, hi=0.9)}, {},
+     scipy.special.erfinv, ["x"]),
+    ("lgamma", paddle.lgamma, {"x": _f32(3, 4, positive=True)}, {},
+     scipy.special.gammaln, ["x"]),
+    ("digamma", paddle.digamma, {"x": _f32(3, 4, positive=True)}, {},
+     scipy.special.digamma, ["x"]),
+    ("expm1", paddle.expm1, {"x": _f32(3, 4)}, {}, np.expm1, ["x"]),
+    ("log1p", paddle.log1p, {"x": _f32(3, 4, positive=True)}, {},
+     np.log1p, ["x"]),
+    ("rsqrt", paddle.rsqrt, {"x": _f32(3, 4, positive=True)}, {},
+     lambda x: 1.0 / np.sqrt(x), ["x"]),
+    ("sinh", paddle.sinh, {"x": _f32(3, 4)}, {}, np.sinh, ["x"]),
+    ("cosh", paddle.cosh, {"x": _f32(3, 4)}, {}, np.cosh, ["x"]),
+    ("asinh", paddle.asinh, {"x": _f32(3, 4)}, {}, np.arcsinh, ["x"]),
+    ("acosh", paddle.acosh, {"x": _f32(3, 4, positive=True, lo=1.5, hi=3)},
+     {}, np.arccosh, ["x"]),
+    ("atanh", paddle.atanh, {"x": _f32(3, 4, lo=-0.8, hi=0.8)}, {},
+     np.arctanh, ["x"]),
+    ("floor", paddle.floor, {"x": _f32(3, 4)}, {}, np.floor, None),
+    ("ceil", paddle.ceil, {"x": _f32(3, 4)}, {}, np.ceil, None),
+    ("round", paddle.round, {"x": _f32(3, 4)}, {}, np.round, None),
+    ("trunc", paddle.trunc, {"x": _f32(3, 4)}, {}, np.trunc, None),
+    ("frac", paddle.frac, {"x": _f32(3, 4)}, {},
+     lambda x: x - np.trunc(x), ["x"]),
+    ("sign", paddle.sign, {"x": _f32(3, 4)}, {}, np.sign, None),
+    ("reciprocal", paddle.reciprocal, {"x": _f32(3, 4, positive=True)}, {},
+     lambda x: 1.0 / x, ["x"]),
+    ("square", paddle.square, {"x": _f32(3, 4)}, {}, np.square, ["x"]),
+    ("angle", paddle.angle, {"x": _f32(3, 4)}, {}, np.angle, None),
+    # --------------------------------------------------------- binary math
+    ("atan2", paddle.atan2, {"x": _f32(3, 4), "y": _f32(3, 4)}, {},
+     np.arctan2, ["x", "y"]),
+    ("heaviside", paddle.heaviside, {"x": _f32(3, 4), "y": _f32(3, 4)}, {},
+     np.heaviside, None),
+    ("fmax", paddle.fmax, {"x": _f32(3, 4), "y": _f32(3, 4)}, {},
+     np.fmax, None),
+    ("fmin", paddle.fmin, {"x": _f32(3, 4), "y": _f32(3, 4)}, {},
+     np.fmin, None),
+    ("hypot", paddle.hypot, {"x": _f32(3, 4), "y": _f32(3, 4)}, {},
+     np.hypot, ["x", "y"]),
+    ("copysign", paddle.copysign, {"x": _f32(3, 4), "y": _f32(3, 4)}, {},
+     np.copysign, None),
+    ("logaddexp", paddle.logaddexp, {"x": _f32(3, 4), "y": _f32(3, 4)}, {},
+     np.logaddexp, ["x", "y"]),
+    ("remainder", paddle.remainder,
+     {"x": _f32(3, 4), "y": _f32(3, 4, positive=True)}, {},
+     np.remainder, None),
+    # ---------------------------------------------------------- reductions
+    ("logsumexp", paddle.logsumexp, {"x": _f32(3, 5)}, {"axis": 1},
+     lambda x, axis: scipy.special.logsumexp(x, axis=axis), ["x"]),
+    ("prod", paddle.prod, {"x": _f32(3, 4, positive=True)}, {"axis": 1},
+     lambda x, axis: np.prod(x, axis=axis), ["x"]),
+    ("amax", paddle.amax, {"x": _f32(3, 4)}, {"axis": 1},
+     lambda x, axis: np.max(x, axis=axis), None),
+    ("amin", paddle.amin, {"x": _f32(3, 4)}, {"axis": 1},
+     lambda x, axis: np.min(x, axis=axis), None),
+    ("nansum", paddle.nansum, {"x": _f32(3, 4)}, {"axis": 1},
+     lambda x, axis: np.nansum(x, axis=axis), ["x"]),
+    ("nanmean", paddle.nanmean, {"x": _f32(3, 4)}, {"axis": 1},
+     lambda x, axis: np.nanmean(x, axis=axis), ["x"]),
+    ("median", paddle.median, {"x": _f32(1, 7)}, {"axis": 1},
+     lambda x, axis: np.median(x, axis=axis), None),
+    ("std", paddle.std, {"x": _f32(3, 6)}, {"axis": 1},
+     lambda x, axis: np.std(x, axis=axis, ddof=1), ["x"]),
+    ("var", paddle.var, {"x": _f32(3, 6)}, {"axis": 1},
+     lambda x, axis: np.var(x, axis=axis, ddof=1), ["x"]),
+    ("count_nonzero", paddle.count_nonzero,
+     {"x": (np.asarray([[0, 1, 2], [3, 0, 0]], np.float32))}, {"axis": 1},
+     lambda x, axis: np.count_nonzero(x, axis=axis), None),
+    # -------------------------------------------------------- manipulation
+    ("tile", paddle.tile, {"x": _f32(2, 3)}, {"repeat_times": [2, 2]},
+     lambda x, repeat_times: np.tile(x, repeat_times), ["x"]),
+    ("roll", paddle.roll, {"x": _f32(3, 4)}, {"shifts": 1, "axis": 1},
+     lambda x, shifts, axis: np.roll(x, shifts, axis), ["x"]),
+    ("flip", paddle.flip, {"x": _f32(3, 4)}, {"axis": [1]},
+     lambda x, axis: np.flip(x, axis), ["x"]),
+    ("rot90", paddle.rot90, {"x": _f32(3, 4)}, {},
+     lambda x: np.rot90(x), ["x"]),
+    ("broadcast_to", paddle.broadcast_to, {"x": _f32(1, 4)},
+     {"shape": [3, 4]},
+     lambda x, shape: np.broadcast_to(x, shape), ["x"]),
+    ("flatten", paddle.flatten, {"x": _f32(2, 3, 4)}, {},
+     lambda x: x.reshape(-1), None),
+    ("tril", paddle.tril, {"x": _f32(4, 4)}, {}, np.tril, ["x"]),
+    ("triu", paddle.triu, {"x": _f32(4, 4)}, {}, np.triu, ["x"]),
+    ("diagonal", paddle.diagonal, {"x": _f32(4, 4)}, {},
+     lambda x: np.diagonal(x), None),
+    ("trace", paddle.trace, {"x": _f32(4, 4)}, {},
+     lambda x: np.trace(x), ["x"]),
+    ("diagflat", paddle.diagflat, {"x": _f32(4)}, {}, np.diagflat, None),
+    ("take_along_axis", paddle.take_along_axis,
+     {"arr": _f32(3, 4),
+      "indices": rng.integers(0, 4, (3, 2)).astype(np.int64)}, {"axis": 1},
+     lambda arr, indices, axis: np.take_along_axis(arr, indices, axis),
+     None),
+    ("index_select", paddle.index_select,
+     {"x": _f32(4, 3), "index": np.asarray([0, 2], np.int64)}, {"axis": 0},
+     lambda x, index, axis: np.take(x, index, axis), None),
+    ("repeat_interleave", paddle.repeat_interleave, {"x": _f32(2, 3)},
+     {"repeats": 2, "axis": 1},
+     lambda x, repeats, axis: np.repeat(x, repeats, axis), None),
+    ("cumsum", paddle.cumsum, {"x": _f32(3, 4)}, {"axis": 1},
+     lambda x, axis: np.cumsum(x, axis), ["x"]),
+    ("cumprod", paddle.cumprod, {"x": _f32(3, 4, positive=True)},
+     {"dim": 1},
+     lambda x, dim: np.cumprod(x, dim), ["x"]),
+    ("cummax", lambda x, axis: paddle.cummax(x, axis=axis)[0],
+     {"x": _f32(3, 4)}, {"axis": 1},
+     lambda x, axis: np.maximum.accumulate(x, axis), None),
+    ("cummin", lambda x, axis: paddle.cummin(x, axis=axis)[0],
+     {"x": _f32(3, 4)}, {"axis": 1},
+     lambda x, axis: np.minimum.accumulate(x, axis), None),
+    # ------------------------------------------------------------- linalg
+    ("matrix_power", paddle.linalg.matrix_power, {"x": _f32(3, 3)},
+     {"n": 3}, lambda x, n: np.linalg.matrix_power(x, n), None),
+    ("det", paddle.linalg.det, {"x": _f32(3, 3) + 2 * np.eye(3, dtype=np.float32)},
+     {}, np.linalg.det, None),
+    ("pinv", paddle.linalg.pinv, {"x": _f32(4, 3)}, {},
+     np.linalg.pinv, None),
+    ("dist", paddle.dist, {"x": _f32(3, 4), "y": _f32(3, 4)}, {"p": 2},
+     lambda x, y, p: np.linalg.norm((x - y).ravel(), ord=p), ["x", "y"]),
+    # ------------------------------------------------------------- losses
+    ("mse_loss", F.mse_loss, {"input": _f32(4, 3), "label": _f32(4, 3)},
+     {}, lambda input, label: np.mean((input - label) ** 2), ["input"]),
+    ("l1_loss", F.l1_loss, {"input": _f32(4, 3), "label": _f32(4, 3)},
+     {}, lambda input, label: np.mean(np.abs(input - label)), None),
+    ("log_loss", __import__(
+        "paddle_tpu.ops.extra_math", fromlist=["log_loss"]).log_loss,
+     {"input": _f32(4, 1, lo=0.1, hi=0.9), "label": _f32(4, 1, lo=0, hi=1)},
+     {},
+     lambda input, label: -label * np.log(input + 1e-4)
+     - (1 - label) * np.log(1 - input + 1e-4), ["input"]),
+    # --------------------------------------------------------- activation
+    ("glu", F.glu, {"x": _f32(3, 8)}, {},
+     lambda x: x[:, :4] * (1 / (1 + np.exp(-x[:, 4:]))), ["x"]),
+    ("softplus", F.softplus, {"x": _f32(3, 4)}, {},
+     lambda x: np.log1p(np.exp(x)), ["x"]),
+    ("hardswish", F.hardswish, {"x": _f32(3, 4, lo=-4, hi=4)}, {},
+     lambda x: x * np.clip(x + 3, 0, 6) / 6, ["x"]),
+    ("elu", F.elu, {"x": _f32(3, 4)}, {"alpha": 1.0},
+     lambda x, alpha: np.where(x > 0, x, alpha * np.expm1(x)), ["x"]),
+    ("celu", F.celu, {"x": _f32(3, 4)}, {"alpha": 1.2},
+     lambda x, alpha: np.maximum(x, 0)
+     + np.minimum(0, alpha * np.expm1(x / alpha)), ["x"]),
+    ("selu", F.selu, {"x": _f32(3, 4)}, {},
+     lambda x: np.where(x > 0, 1.0507009873554805 * x,
+                        1.0507009873554805 * 1.6732632423543772
+                        * np.expm1(x)), ["x"]),
+    ("mish", F.mish, {"x": _f32(3, 4)}, {},
+     lambda x: x * np.tanh(np.log1p(np.exp(x))), ["x"]),
+    ("logsigmoid", F.log_sigmoid, {"x": _f32(3, 4)}, {},
+     lambda x: -np.log1p(np.exp(-x)), ["x"]),
+]
+
+CASES = [c for c in CASES if c[1] is not None]
+
+
+def _ref_takes_attrs(fn, attrs):
+    if not attrs:
+        return False
+    import inspect
+
+    try:
+        sig = inspect.signature(fn)
+    except (TypeError, ValueError):
+        return False  # ufuncs etc.: positional inputs only
+    return any(k in sig.parameters for k in attrs)
+
+
+def _make_ref(ref_fn, input_keys, attrs):
+    takes_attrs = _ref_takes_attrs(ref_fn, attrs)
+
+    def ref(**kw):
+        pos = [kw[k] for k in input_keys]
+        if takes_attrs:
+            return ref_fn(*pos, **attrs)
+        return ref_fn(*pos)
+
+    return ref
+
+
+@pytest.mark.parametrize("case", CASES, ids=[c[0] for c in CASES])
+def test_op_against_numpy(case):
+    name, op, inputs, attrs, ref_fn, grad_keys = case
+    rtol, atol = TOLERANCES.get(name, _DEFAULT_TOL)[:2]
+
+    class T(OpTest):
+        pass
+
+    T.op = staticmethod(op)
+    T.attrs = attrs
+    t = T()
+    t.inputs = inputs
+    t.ref = staticmethod(_make_ref(ref_fn, list(inputs), attrs))
+    t.check_output(rtol=rtol, atol=atol)
+    if grad_keys:
+        t.check_grad(grad_keys)
