@@ -180,3 +180,41 @@ from paddle_tpu.ops import optim_ops  # noqa: E402,F401
 from paddle_tpu.ops import random_ops  # noqa: E402,F401
 from paddle_tpu.ops import rnn_ops  # noqa: E402,F401
 from paddle_tpu.ops import signal_quant_ops  # noqa: E402,F401
+
+
+def _synthesize_inplace_variants():
+    """Register the reference's ``op_`` inplace aliases (97 ops carry an
+    `inplace:` schema key, e.g. relu -> relu_): the wrapper runs the base op
+    and writes the result back into the first Tensor argument — paddle's
+    eager inplace semantics on an immutable-array substrate (the Tensor
+    wrapper swaps its buffer; XLA sees a pure program either way)."""
+    from paddle_tpu.ops.ref_manifest import REFERENCE_SCHEMA
+    from paddle_tpu.ops.registry import _REGISTRY
+    from paddle_tpu.tensor import Tensor
+
+    def make(base_fn, inplace_name):
+        def op_(x, *args, **kwargs):
+            out = base_fn(x, *args, **kwargs)
+            first = out[0] if isinstance(out, (tuple, list)) else out
+            if isinstance(x, Tensor) and isinstance(first, Tensor):
+                x._replace_value(first._value, getattr(first, "_node", None))
+                if isinstance(out, (tuple, list)):
+                    return type(out)([x] + list(out[1:]))
+                return x
+            return out
+
+        op_.__name__ = inplace_name
+        return op_
+
+    for name, meta in REFERENCE_SCHEMA.items():
+        if not meta.get("inplace") or name.endswith("_"):
+            continue
+        inplace_name = name + "_"
+        if inplace_name in _REGISTRY or name not in _REGISTRY:
+            continue
+        spec = _REGISTRY[name]
+        register_op(inplace_name, differentiable=spec.differentiable,
+                    category=spec.category)(make(spec.fn, inplace_name))
+
+
+_synthesize_inplace_variants()
