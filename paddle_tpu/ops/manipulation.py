@@ -339,12 +339,17 @@ def index_put(x, indices, value, accumulate=False, name=None):
     return apply("index_put", f, x, value)
 
 
-@register_op("masked_select", category="manipulation", differentiable=False)
+@register_op("masked_select", category="manipulation")
 def masked_select(x, mask, name=None):
-    # dynamic output shape: eager-only (matches reference's data-dependent op)
-    a = np.asarray(x._value)
+    # dynamic output shape: eager-only (matches reference's data-dependent
+    # op). Differentiable via a concrete gather: the selected flat indices
+    # are computed outside the trace, the values come from jnp.take whose
+    # vjp scatters the cotangent back (reference masked_select_grad).
     m = np.asarray(mask._value)
-    return Tensor._from_value(jnp.asarray(a[np.broadcast_to(m, a.shape)]))
+    m = np.broadcast_to(m, tuple(x.shape))
+    flat_idx = jnp.asarray(np.nonzero(m.reshape(-1))[0])
+    return apply("masked_select",
+                 lambda a: jnp.take(a.reshape(-1), flat_idx), x)
 
 
 @register_op("masked_fill", category="manipulation")

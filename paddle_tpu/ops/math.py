@@ -185,9 +185,7 @@ def cumprod(x, dim=None, dtype=None, name=None):
     return apply("cumprod", f, x)
 
 
-def _cum_extremum(x, axis, cmp):
-    a = x._value if axis is not None else x._value.reshape(-1)
-    ax = axis if axis is not None else 0
+def _cum_extremum_idx(a, ax, cmp):
     v = jax.lax.associative_scan(cmp, a, axis=ax)
     # index where the running extremum was last attained: scan keeping the
     # newest index whenever the current element equals the running extremum
@@ -197,17 +195,33 @@ def _cum_extremum(x, axis, cmp):
     idx = jax.lax.associative_scan(
         lambda c, n: jnp.where(n >= 0, n, c), marked, axis=ax
     )
-    return Tensor._from_value(v), Tensor._from_value(idx)
+    return idx
 
 
-@register_op("cummax", differentiable=False)
+def _cum_extremum(x, axis, cmp, opname):
+    """(values, indices); the VALUES path differentiates: indices compute
+    non-differentiably, values re-gather from x via take_along_axis whose
+    vjp scatters the cotangent back (the reference's cummax_grad)."""
+    ax = axis if axis is not None else 0
+
+    def f(a):
+        if axis is None:
+            a = a.reshape(-1)
+        idx = jax.lax.stop_gradient(_cum_extremum_idx(a, ax, cmp))
+        vals = jnp.take_along_axis(a, idx, axis=ax)
+        return vals, idx
+
+    return apply(opname, f, x)
+
+
+@register_op("cummax")
 def cummax(x, axis=None, dtype="int64", name=None):
-    return _cum_extremum(x, axis, jnp.maximum)
+    return _cum_extremum(x, axis, jnp.maximum, "cummax")
 
 
-@register_op("cummin", differentiable=False)
+@register_op("cummin")
 def cummin(x, axis=None, dtype="int64", name=None):
-    return _cum_extremum(x, axis, jnp.minimum)
+    return _cum_extremum(x, axis, jnp.minimum, "cummin")
 
 
 @register_op("logcumsumexp")

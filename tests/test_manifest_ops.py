@@ -677,3 +677,22 @@ def test_fft_ops():
     np.testing.assert_allclose(out, np.fft.rfft(x), rtol=1e-4, atol=1e-5)
     back = signal_quant_ops.fft_c2r(t(np.fft.rfft(x).astype(np.complex64)))
     np.testing.assert_allclose(back.numpy(), x, rtol=1e-4, atol=1e-5)
+
+
+def test_cummax_and_masked_select_grads():
+    x = paddle.to_tensor(np.asarray([3.0, 1.0, 5.0, 2.0], np.float32),
+                         stop_gradient=False)
+    vals, idx = paddle.cummax(x, axis=0)
+    np.testing.assert_allclose(vals.numpy(), [3, 3, 5, 5])
+    np.testing.assert_array_equal(idx.numpy(), [0, 0, 2, 2])
+    vals.sum().backward()
+    # d/dx of [3,3,5,5].sum(): x0 contributes twice, x2 twice
+    np.testing.assert_allclose(x.grad.numpy(), [2, 0, 2, 0])
+
+    y = paddle.to_tensor(np.asarray([[1.0, 2.0], [3.0, 4.0]], np.float32),
+                         stop_gradient=False)
+    mask = paddle.to_tensor(np.asarray([[True, False], [False, True]]))
+    sel = paddle.masked_select(y, mask)
+    np.testing.assert_allclose(sel.numpy(), [1.0, 4.0])
+    sel.sum().backward()
+    np.testing.assert_allclose(y.grad.numpy(), [[1, 0], [0, 1]])

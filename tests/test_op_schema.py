@@ -20,11 +20,8 @@ from paddle_tpu.ops.registry import all_ops
 # jax.vjp derives gradients the reference never hand-wrote)
 NON_DIFF_EXCEPTIONS = {
     "argsort": "returns indices; values-path grad is a permutation gather, covered by sort",
-    "cummax": "grad needs the argmax indices output; values path niche",
-    "cummin": "same as cummax",
     "eig": "complex eigendecomposition vjp unsupported on this substrate",
     "lu": "pivoted-LU vjp not provided by jax; lu_unpack covers use",
-    "masked_select": "data-dependent output shape; eager-only op",
     "mode": "returns (values, indices); indices dominate usage",
     "poisson": "sampling op; reference's grad is a zero-pass-through",
     "exponential_": "sampling op; reference's grad is zero",
