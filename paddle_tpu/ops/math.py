@@ -200,15 +200,20 @@ def _cum_extremum_idx(a, ax, cmp):
 
 def _cum_extremum(x, axis, cmp, opname):
     """(values, indices); the VALUES path differentiates: indices compute
-    non-differentiably, values re-gather from x via take_along_axis whose
-    vjp scatters the cotangent back (the reference's cummax_grad)."""
+    non-differentiably, the gradient flows through a take_along_axis gather
+    whose vjp scatters the cotangent back (the reference's cummax_grad),
+    while the FORWARD value is the direct scan — preserving NaN propagation
+    (a straight-through residual keeps both)."""
     ax = axis if axis is not None else 0
 
     def f(a):
         if axis is None:
             a = a.reshape(-1)
+        v = jax.lax.associative_scan(cmp, a, axis=ax)
         idx = jax.lax.stop_gradient(_cum_extremum_idx(a, ax, cmp))
-        vals = jnp.take_along_axis(a, idx, axis=ax)
+        gathered = jnp.take_along_axis(a, idx, axis=ax)
+        # forward == v (NaN-propagating scan); backward == gather vjp
+        vals = gathered + jax.lax.stop_gradient(v - gathered)
         return vals, idx
 
     return apply(opname, f, x)

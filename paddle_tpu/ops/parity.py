@@ -185,19 +185,33 @@ from paddle_tpu.ops import signal_quant_ops  # noqa: E402,F401
 def _synthesize_inplace_variants():
     """Register the reference's ``op_`` inplace aliases (97 ops carry an
     `inplace:` schema key, e.g. relu -> relu_): the wrapper runs the base op
-    and writes the result back into the first Tensor argument — paddle's
+    and writes the result back into the aliased Tensor argument — paddle's
     eager inplace semantics on an immutable-array substrate (the Tensor
-    wrapper swaps its buffer; XLA sees a pure program either way)."""
+    wrapper swaps its buffer; XLA sees a pure program either way).
+
+    Correctness constraints (review r2): an op is synthesized ONLY when the
+    schema's aliased input is provably our fn's first parameter (ops with
+    other alias layouts — where_: x not cond; cross_entropy_with_softmax_:
+    output index 1 — get explicit implementations or none), and mutating a
+    tensor that REQUIRES GRAD raises, like the reference's
+    "leaf Variable that requires grad is used in an in-place operation"
+    guard — the object-identity tape cannot alias a tensor as both input
+    and output of one node, and silently dropping the gradient would be
+    worse than refusing."""
+    import inspect
+    import re as _re
+
     from paddle_tpu.ops.ref_manifest import REFERENCE_SCHEMA
     from paddle_tpu.ops.registry import _REGISTRY
     from paddle_tpu.tensor import Tensor
 
     def make(base_fn, inplace_name):
         def op_(x, *args, **kwargs):
+            _guard_inplace_grad(x, inplace_name)
             out = base_fn(x, *args, **kwargs)
             first = out[0] if isinstance(out, (tuple, list)) else out
             if isinstance(x, Tensor) and isinstance(first, Tensor):
-                x._replace_value(first._value, getattr(first, "_node", None))
+                x._replace_value(first._value)
                 if isinstance(out, (tuple, list)):
                     return type(out)([x] + list(out[1:]))
                 return x
@@ -213,8 +227,48 @@ def _synthesize_inplace_variants():
         if inplace_name in _REGISTRY or name not in _REGISTRY:
             continue
         spec = _REGISTRY[name]
-        register_op(inplace_name, differentiable=spec.differentiable,
+        pairs = _re.findall(r"\(\s*(\w+)\s*->\s*(\w+)\s*\)",
+                            str(meta["inplace"]))
+        if not pairs:
+            continue
+        src = pairs[0][0]
+        try:
+            params = list(inspect.signature(spec.fn).parameters)
+        except (TypeError, ValueError):
+            continue
+        # only the provable layout: the aliased input IS our first param
+        # (name match or the ubiquitous x/input naming), single alias pair
+        if len(pairs) != 1 or not params:
+            continue
+        if src != params[0] and not (src in ("x", "input")
+                                     and params[0] in ("x", "input")):
+            continue
+        register_op(inplace_name, differentiable=False,
                     category=spec.category)(make(spec.fn, inplace_name))
 
 
+def _guard_inplace_grad(x, opname):
+    from paddle_tpu.autograd import tape
+    from paddle_tpu.tensor import Tensor
+
+    if (isinstance(x, Tensor) and not x.stop_gradient
+            and tape.is_grad_enabled()):
+        raise RuntimeError(
+            f"{opname}: a Tensor that requires grad is used in an in-place "
+            f"operation (reference semantics forbid this for leaves); use "
+            f"the out-of-place op `{opname.rstrip('_')}` for autograd")
+
+
 _synthesize_inplace_variants()
+
+
+@register_op("where_", category="manipulation", differentiable=False)
+def where_(condition, x, y, name=None):
+    """Explicit inplace where (schema alias is `x -> out`, NOT the first
+    arg): mutates and returns x."""
+    from paddle_tpu.ops.registry import _REGISTRY
+
+    _guard_inplace_grad(x, "where_")
+    out = _REGISTRY["where"].fn(condition, x, y)
+    x._replace_value(out._value)
+    return x

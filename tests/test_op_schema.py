@@ -82,6 +82,27 @@ def test_inplace_semantics_mutate_first_arg():
     np.testing.assert_allclose(y.numpy(), [2.0, 4.0])
 
 
+def test_inplace_on_grad_tensor_raises():
+    # reference semantics: in-place on a tensor that requires grad errors
+    # instead of silently dropping the gradient
+    reg = all_ops()
+    x = paddle.to_tensor(np.asarray([-1.0, 2.0], np.float32),
+                         stop_gradient=False)
+    with pytest.raises(RuntimeError, match="in-place"):
+        reg["relu_"].fn(x)
+
+
+def test_where_inplace_mutates_x_not_condition():
+    reg = all_ops()
+    cond = paddle.to_tensor(np.asarray([True, False]))
+    a = paddle.to_tensor(np.asarray([1.0, 2.0], np.float32))
+    b = paddle.to_tensor(np.asarray([8.0, 9.0], np.float32))
+    out = reg["where_"].fn(cond, a, b)
+    assert out is a
+    np.testing.assert_allclose(a.numpy(), [1.0, 9.0])
+    assert cond.numpy().dtype == np.bool_  # condition untouched
+
+
 def test_optional_and_view_metadata_accessible():
     # spot checks that the schema round-tripped the YAML keys
     assert REFERENCE_SCHEMA["dropout"]["optional"] == "seed_tensor"

@@ -30,10 +30,6 @@ def _spec_of(arr):
     return tuple(arr.sharding.spec)
 
 
-def _run(fn, *args, out_spec_constraint=None):
-    return jax.jit(fn)(*args)
-
-
 # --------------------------------------------------------- elementwise-like
 
 
