@@ -61,7 +61,12 @@ def init_parallel_env(strategy=None):
                 coordinator_address=coord, num_processes=nprocs, process_id=proc_id
             )
         _state.mesh = _build_world_mesh()
-        _state.world_size = jax.device_count()
+        # multi-controller: trainer rank/world are PROCESS-based (a process
+        # may own several chips — reference trainer semantics); single
+        # controller: the device axis plays the ranks
+        _state.world_size = (jax.process_count()
+                             if jax.process_count() > 1
+                             else jax.device_count())
         _state.rank = jax.process_index()
         _state.initialized = True
     return ParallelEnv()

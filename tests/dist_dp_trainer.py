@@ -13,8 +13,11 @@ import json
 import os
 import sys
 
-# one local CPU device per process — the pod-like topology
-os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+# local CPU devices per process: 1 = pod-like (one chip per worker);
+# >1 exercises the multi-chip-per-host path (collectives must count one row
+# per PROCESS, not per device)
+_LOCAL = os.environ.get("PADDLE_TEST_LOCAL_DEVICES", "1")
+os.environ["XLA_FLAGS"] = f"--xla_force_host_platform_device_count={_LOCAL}"
 os.environ["JAX_PLATFORMS"] = "cpu"
 
 import jax  # noqa: E402
@@ -32,7 +35,7 @@ import paddle_tpu.optimizer as opt  # noqa: E402
 def main():
     env = dist.init_parallel_env()
     rank = jax.process_index()
-    world = jax.device_count()
+    world = jax.process_count()  # trainer rank semantics = processes
 
     # 1. collective sanity: sum of (rank + 1) over ranks
     x = paddle.to_tensor(np.asarray([float(rank + 1)], np.float32))

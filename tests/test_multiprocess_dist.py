@@ -27,10 +27,11 @@ def _free_port():
     return port
 
 
-def _launch(nproc, log_dir):
+def _launch(nproc, log_dir, local_devices=1):
     env = dict(os.environ)
     env.pop("XLA_FLAGS", None)          # children pick their own device count
     env["JAX_PLATFORMS"] = "cpu"
+    env["PADDLE_TEST_LOCAL_DEVICES"] = str(local_devices)
     env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
     cmd = [sys.executable, "-m", "paddle_tpu.distributed.launch",
            "--nproc_per_node", str(nproc),
@@ -74,6 +75,22 @@ def _single_proc_losses():
         optimizer.clear_grad()
         losses.append(float(loss.numpy()))
     return losses
+
+
+@pytest.mark.slow
+def test_multi_device_per_process_collectives(tmp_path):
+    """2 processes x 2 local devices: rank semantics stay PER PROCESS —
+    all_reduce of (rank+1) must be 3, not a per-device overcount (the
+    multi-chip-per-host layout of a real TPU pod)."""
+    results = _launch(2, str(tmp_path), local_devices=2)
+    assert len(results) == 2, results
+    for r in results:
+        assert r["world"] == 2
+        assert r["allreduce"] == pytest.approx(3.0)
+        assert r["gathered"] == [0.0, 10.0]
+    by_rank = {r["rank"]: r for r in results}
+    np.testing.assert_allclose(by_rank[0]["losses"], by_rank[1]["losses"],
+                               rtol=1e-6)
 
 
 @pytest.mark.slow
