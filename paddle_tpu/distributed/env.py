@@ -84,7 +84,10 @@ def get_world_mesh() -> Mesh:
 
 def get_world_size() -> int:
     if not _state.initialized:
-        return int(os.environ.get("PADDLE_TRAINERS_NUM", jax.device_count()))
+        # mirror the initialized rule: process-based in multi-controller
+        default = (jax.process_count() if jax.process_count() > 1
+                   else jax.device_count())
+        return int(os.environ.get("PADDLE_TRAINERS_NUM", default))
     return _state.world_size
 
 

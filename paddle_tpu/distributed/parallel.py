@@ -38,7 +38,16 @@ class DataParallel(Layer):
     def _shard_batch(self, t: Tensor) -> Tensor:
         if self._world <= 1:
             return t
-        if t.shape and t.shape[0] % self._world == 0:
+        import jax as _jax
+
+        if _jax.process_count() > 1:
+            # multi-controller: each process already holds ITS shard; grad
+            # sync happens through the eager collectives — placing a local
+            # batch as a global array over a cross-process mesh would be
+            # wrong (world_size is process-based, the mesh is device-based)
+            return t
+        n_dev = self._mesh.devices.size
+        if t.shape and n_dev and t.shape[0] % n_dev == 0:
             v = jax.device_put(
                 t._value, NamedSharding(self._mesh, P("world"))
             )
