@@ -1,0 +1,108 @@
+"""nn.LSTM / GRU / SimpleRNN layer tests (paddle layer API over the
+lax.scan recurrence), validated against torch's cuDNN-convention RNNs
+(same gate orders / weight layouts)."""
+
+import numpy as np
+import pytest
+import torch
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+
+
+def _copy_weights(pt, ours, num_layers, bidirect, gates):
+    # identical naming convention: weight_ih_l{n}[_reverse] etc.
+    D = 2 if bidirect else 1
+    for layer in range(num_layers):
+        for d in range(D):
+            sfx = f"l{layer}" + ("_reverse" if d else "")
+            for nm in ("weight_ih", "weight_hh", "bias_ih", "bias_hh"):
+                src = getattr(pt, f"{nm}_{sfx}").detach().numpy()
+                getattr(ours, f"{nm}_{sfx}").set_value(src)
+
+
+@pytest.mark.parametrize("bidirect", [False, True])
+def test_lstm_matches_torch(bidirect):
+    B, T, I, H, L = 2, 5, 4, 6, 2
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(B, T, I)).astype(np.float32)
+
+    pt = torch.nn.LSTM(I, H, num_layers=L, batch_first=True,
+                       bidirectional=bidirect)
+    ours = nn.LSTM(I, H, num_layers=L,
+                   direction="bidirect" if bidirect else "forward")
+    _copy_weights(pt, ours, L, bidirect, 4)
+
+    ref, (h_ref, c_ref) = pt(torch.from_numpy(x))
+    out, (h, c) = ours(paddle.to_tensor(x))
+    np.testing.assert_allclose(out.numpy(), ref.detach().numpy(),
+                               rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(h.numpy(), h_ref.detach().numpy(),
+                               rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(c.numpy(), c_ref.detach().numpy(),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_gru_matches_torch():
+    B, T, I, H = 2, 5, 4, 6
+    rng = np.random.default_rng(1)
+    x = rng.normal(size=(B, T, I)).astype(np.float32)
+    pt = torch.nn.GRU(I, H, batch_first=True)
+    ours = nn.GRU(I, H)
+    _copy_weights(pt, ours, 1, False, 3)
+    ref, h_ref = pt(torch.from_numpy(x))
+    out, h = ours(paddle.to_tensor(x))
+    np.testing.assert_allclose(out.numpy(), ref.detach().numpy(),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_simple_rnn_matches_torch():
+    B, T, I, H = 2, 4, 3, 5
+    rng = np.random.default_rng(2)
+    x = rng.normal(size=(B, T, I)).astype(np.float32)
+    pt = torch.nn.RNN(I, H, batch_first=True, nonlinearity="tanh")
+    ours = nn.SimpleRNN(I, H, activation="tanh")
+    _copy_weights(pt, ours, 1, False, 1)
+    ref, _ = pt(torch.from_numpy(x))
+    out, _ = ours(paddle.to_tensor(x))
+    np.testing.assert_allclose(out.numpy(), ref.detach().numpy(),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_lstm_trains():
+    paddle.framework.random.seed(0)
+    m = nn.LSTM(4, 8)
+    head = nn.Linear(8, 1)
+    import paddle_tpu.optimizer as opt
+
+    o = opt.Adam(learning_rate=1e-2,
+                 parameters=m.parameters() + head.parameters())
+    rng = np.random.default_rng(3)
+    x = rng.normal(size=(8, 6, 4)).astype(np.float32)
+    y = rng.normal(size=(8, 1)).astype(np.float32)
+    lossfn = nn.MSELoss()
+    losses = []
+    for _ in range(8):
+        out, (h, c) = m(paddle.to_tensor(x))
+        pred = head(out[:, -1])
+        loss = lossfn(pred, paddle.to_tensor(y))
+        loss.backward()
+        o.step()
+        o.clear_grad()
+        losses.append(float(loss.numpy()))
+    assert losses[-1] < 0.5 * losses[0]
+
+
+def test_cells_single_step():
+    B, I, H = 3, 4, 5
+    rng = np.random.default_rng(4)
+    x = paddle.to_tensor(rng.normal(size=(B, I)).astype(np.float32))
+    cell = nn.LSTMCell(I, H)
+    h, (h2, c2) = cell(x)
+    assert h.shape == [B, H] and c2.shape == [B, H]
+    gcell = nn.GRUCell(I, H)
+    h, _ = gcell(x)
+    assert h.shape == [B, H]
+    scell = nn.SimpleRNNCell(I, H)
+    h, _ = scell(x)
+    assert h.shape == [B, H]
