@@ -31,6 +31,14 @@ import numpy as np
 from paddle_tpu.ops.ring_attention import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
+# megatron f/g conjugate collectives for manual-mode TP blocks live in
+# fleet/mp_ops.py; re-exported here because hybrid TP x PP block_fns are
+# this engine's main manual-mode consumer
+from paddle_tpu.distributed.fleet.mp_ops import (  # noqa: F401
+    mp_identity as megatron_identity,
+    mp_reduce as megatron_reduce,
+)
+
 IDLE, F_OP, B_OP, W_OP = 0, 1, 2, 3
 _OP_COST = {IDLE: 1.0, F_OP: 1.0, B_OP: 2.0, W_OP: 1.0}
 # B in a fused schedule (dgrad+wgrad together) costs ~2 F-units; in a split
@@ -164,11 +172,18 @@ def schedule_pipeline_grads(
     mesh: Mesh,
     schedule: PipelineSchedule,
     axis: str = "pp",
+    param_specs: Any = None,
 ):
     """Execute fwd+bwd per the schedule table; returns (mean_loss, grads).
 
     layer_params leaves: [L, ...] with L = S * layers_per_stage, sharded
-    P(axis). x: [B, ...] microbatched inputs (uniform activation shape
+    P(axis) by default. ``param_specs`` (optional pytree of PartitionSpecs,
+    FIRST entry must be the pipeline axis) enables hybrid TP x PP: other
+    entries shard each stage's weights over a model axis, and block_fn is
+    then responsible for its own model-axis collectives — use the
+    mp_identity/mp_reduce (megatron f/g) pair from fleet/mp_ops, NOT plain
+    lax.psum (its manual-mode transpose double-counts cotangents).
+    x: [B, ...] microbatched inputs (uniform activation shape
     through stages; stage 0 consumes x directly). y: [B, ...] labels consumed
     by loss_fn at the last stage. Gradients are rematerialized (B and W
     re-run the stage forward from the saved stage input), giving 1F1B's
@@ -344,12 +359,15 @@ def schedule_pipeline_grads(
     x_mb = x.reshape(M, mb, *x.shape[1:])
     y_mb = y.reshape(M, mb, *y.shape[1:])
 
-    in_specs = (
-        jax.tree_util.tree_map(lambda _: P(axis), layer_params),
-        P(), P(),
-    )
-    out_specs = (P(axis),
-                 jax.tree_util.tree_map(lambda _: P(axis), layer_params))
+    # hybrid TP x PP: caller may give per-leaf specs whose FIRST entry is
+    # the pipeline axis and whose other entries shard inside the stage (the
+    # Fleet HybridParallel layout); block_fn is then responsible for its own
+    # model-axis collectives (megatron psum) — shard_map runs manual over
+    # every mesh axis
+    p_specs = (param_specs if param_specs is not None
+               else jax.tree_util.tree_map(lambda _: P(axis), layer_params))
+    in_specs = (p_specs, P(), P())
+    out_specs = (P(axis), p_specs)
 
     loss_st, grads = shard_map(
         engine, mesh=mesh, in_specs=in_specs, out_specs=out_specs,

@@ -175,6 +175,67 @@ def test_interleaved_grads_flow():
     assert float(jnp.abs(g).sum()) > 0
 
 
+def test_hybrid_tp_pp_schedule_engine():
+    """Fleet HybridParallel layout (BASELINE config #4 shape): 2 pipeline
+    stages x 4-way tensor parallel on one 2x4 mesh. Megatron MLP blocks
+    (column-sharded w1, row-sharded w2, psum over mp) run inside the 1F1B
+    schedule engine; loss and grads must match the unsharded reference."""
+    S_pp, mp = 2, 4
+    mesh = Mesh(np.asarray(jax.devices()[:8]).reshape(S_pp, mp),
+                ("pp", "mp"))
+    D, H, M_mb, B = 8, 16, 4, 8
+    k1, k2, k3, k4 = jax.random.split(jax.random.PRNGKey(0), 4)
+    w1 = jax.random.normal(k1, (S_pp, D, H), jnp.float32) * 0.3
+    w2 = jax.random.normal(k2, (S_pp, H, D), jnp.float32) * 0.3
+    x = jax.random.normal(k3, (B, D), jnp.float32)
+    y = jax.random.normal(k4, (B, D), jnp.float32)
+
+    from paddle_tpu.distributed.fleet.pipeline_schedules import (
+        megatron_identity,
+        megatron_reduce,
+    )
+
+    def block_mp(p, h):
+        # megatron MLP: f(identity-fwd/allreduce-bwd) at the input, column
+        # shard -> gelu -> row shard, g(allreduce-fwd/identity-bwd) at the
+        # output — the reference's _c_identity/_c_allreduce conjugate pair
+        a, b = p
+        h = megatron_identity(h, "mp")
+        hidden = jax.nn.gelu(h @ a)          # [mb, H/mp] local
+        out = hidden @ b                     # partial [mb, D]
+        return megatron_reduce(out, "mp")
+
+    def block_ref(p, h):
+        a, b = p
+        return jnp.einsum("bh,hd->bd", jax.nn.gelu(h @ a), b)
+
+    sched = make_pipeline_schedule(S_pp, M_mb, "1F1B")
+    w1_sh = jax.device_put(w1, NamedSharding(mesh, P("pp", None, "mp")))
+    w2_sh = jax.device_put(w2, NamedSharding(mesh, P("pp", "mp", None)))
+
+    loss, (g1, g2) = jax.jit(
+        lambda a, b, x_, y_: schedule_pipeline_grads(
+            block_mp, _loss, (a, b), x_, y_, mesh=mesh, schedule=sched,
+            param_specs=(P("pp", None, "mp"), P("pp", "mp", None)))
+    )(w1_sh, w2_sh, x, y)
+
+    def ref_loss(a, b, x_, y_):
+        h = x_
+        for i in range(S_pp):
+            h = block_ref((a[i], b[i]), h)
+        hs = h.reshape(M_mb, B // M_mb, D)
+        ys = y_.reshape(M_mb, B // M_mb, D)
+        return jnp.mean(jax.vmap(_loss)(hs, ys))
+
+    ref_l, (ref_g1, ref_g2) = jax.value_and_grad(
+        ref_loss, argnums=(0, 1))(w1, w2, x, y)
+    np.testing.assert_allclose(float(loss), float(ref_l), rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(g1), np.asarray(ref_g1),
+                               rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(g2), np.asarray(ref_g2),
+                               rtol=1e-4, atol=1e-5)
+
+
 # ------------------------------------------------------- PipelineLayer real
 
 
