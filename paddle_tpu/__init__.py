@@ -108,6 +108,13 @@ from paddle_tpu.ops import linalg  # noqa: E402,F401
 from paddle_tpu import utils  # noqa: E402,F401
 from paddle_tpu.framework.flags import get_flags, set_flags  # noqa: E402,F401
 from paddle_tpu.framework.io import load, save  # noqa: E402,F401
+from paddle_tpu.framework.tensor_array import (  # noqa: E402,F401
+    TensorArray,
+    array_length,
+    array_read,
+    array_write,
+    create_array,
+)
 from paddle_tpu.ops import parity as _op_parity  # noqa: E402,F401  (registers ref-named ops)
 
 __version__ = "0.1.0"

@@ -204,3 +204,54 @@ class SimpleRNNCell(_CellBase):
         h2 = apply("simple_rnn_cell", self._cell, inputs, h, self.weight_ih,
                    self.weight_hh, self.bias_ih, self.bias_hh)
         return h2, h2
+
+
+class RNN(Layer):
+    """paddle.nn.RNN parity: run ANY cell over time (rnn.py:RNN). The cell's
+    forward(inputs_t, states) -> (output_t, new_states)."""
+
+    def __init__(self, cell, is_reverse=False, time_major=False):
+        super().__init__()
+        self.cell = cell
+        self.is_reverse = is_reverse
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        from paddle_tpu.ops import manipulation
+
+        x = inputs
+        if not self.time_major:
+            x = manipulation.transpose(x, [1, 0, 2])
+        T = x.shape[0]
+        steps = range(T - 1, -1, -1) if self.is_reverse else range(T)
+        states = initial_states
+        outs = []
+        for t in steps:
+            out_t, states = self.cell(x[t], states)
+            outs.append(out_t)
+        if self.is_reverse:
+            outs = outs[::-1]
+        from paddle_tpu.ops import manipulation as M
+
+        out = M.stack(outs, axis=0)
+        if not self.time_major:
+            out = M.transpose(out, [1, 0, 2])
+        return out, states
+
+
+class BiRNN(Layer):
+    """paddle.nn.BiRNN parity: forward + backward cells, concat outputs."""
+
+    def __init__(self, cell_fw, cell_bw, time_major=False):
+        super().__init__()
+        self.rnn_fw = RNN(cell_fw, is_reverse=False, time_major=time_major)
+        self.rnn_bw = RNN(cell_bw, is_reverse=True, time_major=time_major)
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        from paddle_tpu.ops import manipulation as M
+
+        s_fw, s_bw = (initial_states if initial_states is not None
+                      else (None, None))
+        out_fw, st_fw = self.rnn_fw(inputs, s_fw)
+        out_bw, st_bw = self.rnn_bw(inputs, s_bw)
+        return M.concat([out_fw, out_bw], axis=-1), (st_fw, st_bw)

@@ -106,3 +106,29 @@ def test_cells_single_step():
     scell = nn.SimpleRNNCell(I, H)
     h, _ = scell(x)
     assert h.shape == [B, H]
+
+
+def test_generic_rnn_and_birnn():
+    B, T, I, H = 2, 4, 3, 5
+    rng = np.random.default_rng(5)
+    x = paddle.to_tensor(rng.normal(size=(B, T, I)).astype(np.float32))
+    cell = nn.GRUCell(I, H)
+    rnn = nn.RNN(cell)
+    out, st = rnn(x)
+    assert out.shape == [B, T, H]
+    # reverse consistency: BiRNN concat of fw/bw
+    bi = nn.BiRNN(nn.GRUCell(I, H), nn.GRUCell(I, H))
+    out2, (sf, sb) = bi(x)
+    assert out2.shape == [B, T, 2 * H]
+
+
+def test_tensor_array_ops():
+    arr = paddle.create_array()
+    t0 = paddle.to_tensor(np.asarray([1.0], np.float32))
+    t1 = paddle.to_tensor(np.asarray([2.0], np.float32))
+    paddle.array_write(t0, 0, arr)
+    paddle.array_write(t1, 3, arr)
+    assert int(paddle.array_length(arr).numpy()) == 4
+    np.testing.assert_allclose(paddle.array_read(arr, 3).numpy(), [2.0])
+    with pytest.raises(IndexError):
+        paddle.array_read(arr, 1)
