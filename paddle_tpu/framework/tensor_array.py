@@ -58,4 +58,6 @@ def array_read(array: TensorArray, i) -> Tensor:
 def array_length(array: TensorArray):
     import jax.numpy as jnp
 
-    return Tensor._from_value(jnp.asarray(array.length(), jnp.int64))
+    # int32: x64 is disabled on this substrate (explicit int64 would only
+    # emit a truncation warning per call)
+    return Tensor._from_value(jnp.asarray(array.length(), jnp.int32))

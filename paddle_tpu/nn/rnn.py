@@ -217,11 +217,15 @@ class RNN(Layer):
         self.time_major = time_major
 
     def forward(self, inputs, initial_states=None, sequence_length=None):
-        from paddle_tpu.ops import manipulation
+        from paddle_tpu.ops import manipulation as M
 
+        if sequence_length is not None:
+            raise NotImplementedError(
+                "nn.RNN: per-sequence length masking is not implemented; "
+                "pad-free batches only (pack via DataLoader bucketing)")
         x = inputs
         if not self.time_major:
-            x = manipulation.transpose(x, [1, 0, 2])
+            x = M.transpose(x, [1, 0, 2])
         T = x.shape[0]
         steps = range(T - 1, -1, -1) if self.is_reverse else range(T)
         states = initial_states
@@ -231,8 +235,6 @@ class RNN(Layer):
             outs.append(out_t)
         if self.is_reverse:
             outs = outs[::-1]
-        from paddle_tpu.ops import manipulation as M
-
         out = M.stack(outs, axis=0)
         if not self.time_major:
             out = M.transpose(out, [1, 0, 2])

@@ -56,6 +56,10 @@ def rnn(x, pre_state, weight_list, sequence_length=None, dropout_prob=0.0,
     with shape [L*D, B, H]. weight_list: per layer+direction
     [wi, wh, bi, bh] flattened in the reference's order.
     """
+    if sequence_length is not None:
+        raise NotImplementedError(
+            "rnn: per-sequence length masking is not implemented; pad-free "
+            "batches only")
     is_lstm = mode == "LSTM"
     cell = _CELLS[mode]
     D = 2 if is_bidirec else 1
