@@ -55,23 +55,29 @@ def rnn(x, pre_state, weight_list, sequence_length=None, dropout_prob=0.0,
     x: [T, B, I] (time-major, as the reference kernel). pre_state: (h0[, c0])
     with shape [L*D, B, H]. weight_list: per layer+direction
     [wi, wh, bi, bh] flattened in the reference's order.
+
+    ``sequence_length`` ([B] ints): steps past a sequence's length are
+    MASKED — the carry freezes at the last valid step (final states are the
+    states at t = len-1) and padded outputs are zeroed, matching the
+    reference kernel's variable-length contract. The mask rides inside the
+    scan (a where per step — XLA fuses it into the cell body).
     """
-    if sequence_length is not None:
-        raise NotImplementedError(
-            "rnn: per-sequence length masking is not implemented; pad-free "
-            "batches only")
     is_lstm = mode == "LSTM"
     cell = _CELLS[mode]
     D = 2 if is_bidirec else 1
 
     h0 = pre_state[0]
     c0 = pre_state[1] if is_lstm else None
-    wvals = [w._value if isinstance(w, Tensor) else jnp.asarray(w)
-             for w in weight_list]
+    has_len = sequence_length is not None
 
     def f(xv, h0v, *rest):
-        c0v = rest[0] if is_lstm else None
-        wl = list(rest[1:]) if is_lstm else list(rest)
+        pos = 0
+        c0v = rest[pos] if is_lstm else None
+        pos += 1 if is_lstm else 0
+        lens = rest[pos] if has_len else None
+        pos += 1 if has_len else 0
+        wl = list(rest[pos:])
+        T = xv.shape[0]
         out = xv
         hs, cs = [], []
         for layer in range(num_layers):
@@ -82,21 +88,41 @@ def rnn(x, pre_state, weight_list, sequence_length=None, dropout_prob=0.0,
                 hh = h0v[li]
                 cc = c0v[li] if is_lstm else None
                 seq = out if d == 0 else out[::-1]
+                # time index per scanned step (reversed for the bwd pass)
+                ts = (jnp.arange(T) if d == 0
+                      else jnp.arange(T - 1, -1, -1))
+
+                def mask(t, new, old):
+                    if lens is None:
+                        return new
+                    valid = (t < lens).reshape(-1, 1)
+                    return jnp.where(valid, new, old)
+
+                def zero_pad(t, y):
+                    if lens is None:
+                        return y
+                    return jnp.where((t < lens).reshape(-1, 1), y,
+                                     jnp.zeros_like(y))
 
                 if is_lstm:
-                    def step(carry, xt):
+                    def step(carry, xt_t):
+                        xt, t = xt_t
                         h, c = carry
                         h2, c2 = cell(xt, h, c, wi, wh, bi, bh)
-                        return (h2, c2), h2
+                        h2 = mask(t, h2, h)
+                        c2 = mask(t, c2, c)
+                        return (h2, c2), zero_pad(t, h2)
 
-                    (hT, cT), ys = jax.lax.scan(step, (hh, cc), seq)
+                    (hT, cT), ys = jax.lax.scan(step, (hh, cc), (seq, ts))
                     cs.append(cT)
                 else:
-                    def step(h, xt):
+                    def step(h, xt_t):
+                        xt, t = xt_t
                         h2 = cell(xt, h, wi, wh, bi, bh)
-                        return h2, h2
+                        h2 = mask(t, h2, h)
+                        return h2, zero_pad(t, h2)
 
-                    hT, ys = jax.lax.scan(step, hh, seq)
+                    hT, ys = jax.lax.scan(step, hh, (seq, ts))
                 hs.append(hT)
                 layer_outs.append(ys if d == 0 else ys[::-1])
             out = (jnp.concatenate(layer_outs, axis=-1) if is_bidirec
@@ -106,7 +132,8 @@ def rnn(x, pre_state, weight_list, sequence_length=None, dropout_prob=0.0,
             state.append(jnp.stack(cs))
         return (out, *state)
 
-    args = [x, h0] + ([c0] if is_lstm else []) + list(weight_list)
+    args = ([x, h0] + ([c0] if is_lstm else [])
+            + ([sequence_length] if has_len else []) + list(weight_list))
     res = apply("rnn", f, *args)
     return res
 

@@ -132,3 +132,35 @@ def test_tensor_array_ops():
     np.testing.assert_allclose(paddle.array_read(arr, 3).numpy(), [2.0])
     with pytest.raises(IndexError):
         paddle.array_read(arr, 1)
+
+
+def test_lstm_sequence_length_masking():
+    """Variable-length contract vs torch pack_padded_sequence: padded steps
+    zeroed in output, final state frozen at each sequence's last valid step."""
+    B, T, I, H = 3, 6, 4, 5
+    rng = np.random.default_rng(6)
+    x = rng.normal(size=(B, T, I)).astype(np.float32)
+    lens = np.asarray([6, 3, 1], np.int64)
+    x_masked = x.copy()
+    for b, l in enumerate(lens):
+        x_masked[b, l:] = 0
+
+    pt = torch.nn.LSTM(I, H, batch_first=True)
+    ours = nn.LSTM(I, H)
+    _copy_weights(pt, ours, 1, False, 4)
+
+    packed = torch.nn.utils.rnn.pack_padded_sequence(
+        torch.from_numpy(x), torch.from_numpy(lens), batch_first=True,
+        enforce_sorted=False)
+    packed_out, (h_ref, c_ref) = pt(packed)
+    ref_out, _ = torch.nn.utils.rnn.pad_packed_sequence(
+        packed_out, batch_first=True, total_length=T)
+
+    out, (h, c) = ours(paddle.to_tensor(x),
+                       sequence_length=paddle.to_tensor(lens))
+    np.testing.assert_allclose(out.numpy(), ref_out.detach().numpy(),
+                               rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(h.numpy(), h_ref.detach().numpy(),
+                               rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(c.numpy(), c_ref.detach().numpy(),
+                               rtol=1e-4, atol=1e-5)
