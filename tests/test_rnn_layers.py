@@ -164,3 +164,39 @@ def test_lstm_sequence_length_masking():
                                rtol=1e-4, atol=1e-5)
     np.testing.assert_allclose(c.numpy(), c_ref.detach().numpy(),
                                rtol=1e-4, atol=1e-5)
+
+
+@pytest.mark.parametrize("kind", ["lstm_bidir_2l", "gru", "simple"])
+def test_variable_length_other_configs(kind):
+    """Masking coverage for the subtle paths: bidirectional/multi-layer
+    (reversed time indices + carry freeze) and the non-LSTM scan branch."""
+    B, T, I, H = 3, 5, 4, 6
+    rng = np.random.default_rng(7)
+    x = rng.normal(size=(B, T, I)).astype(np.float32)
+    lens = np.asarray([5, 3, 2], np.int64)
+
+    if kind == "lstm_bidir_2l":
+        pt = torch.nn.LSTM(I, H, num_layers=2, batch_first=True,
+                           bidirectional=True)
+        ours = nn.LSTM(I, H, num_layers=2, direction="bidirect")
+        _copy_weights(pt, ours, 2, True, 4)
+    elif kind == "gru":
+        pt = torch.nn.GRU(I, H, batch_first=True)
+        ours = nn.GRU(I, H)
+        _copy_weights(pt, ours, 1, False, 3)
+    else:
+        pt = torch.nn.RNN(I, H, batch_first=True, nonlinearity="tanh")
+        ours = nn.SimpleRNN(I, H)
+        _copy_weights(pt, ours, 1, False, 1)
+
+    packed = torch.nn.utils.rnn.pack_padded_sequence(
+        torch.from_numpy(x), torch.from_numpy(lens), batch_first=True,
+        enforce_sorted=False)
+    packed_out, _ = pt(packed)
+    ref_out, _ = torch.nn.utils.rnn.pad_packed_sequence(
+        packed_out, batch_first=True, total_length=T)
+
+    out, _ = ours(paddle.to_tensor(x),
+                  sequence_length=paddle.to_tensor(lens))
+    np.testing.assert_allclose(out.numpy(), ref_out.detach().numpy(),
+                               rtol=1e-3, atol=1e-4)
