@@ -339,15 +339,21 @@ def index_put(x, indices, value, accumulate=False, name=None):
     return apply("index_put", f, x, value)
 
 
+def _mask_flat_indices(x, mask):
+    """Concrete mask -> flat indices into x (shared by masked_select /
+    masked_scatter; eager ops, data-dependent shape)."""
+    m = np.asarray(mask._value if isinstance(mask, Tensor) else mask)
+    m = np.broadcast_to(m, tuple(x.shape))
+    return jnp.asarray(np.nonzero(m.reshape(-1))[0])
+
+
 @register_op("masked_select", category="manipulation")
 def masked_select(x, mask, name=None):
     # dynamic output shape: eager-only (matches reference's data-dependent
     # op). Differentiable via a concrete gather: the selected flat indices
     # are computed outside the trace, the values come from jnp.take whose
     # vjp scatters the cotangent back (reference masked_select_grad).
-    m = np.asarray(mask._value)
-    m = np.broadcast_to(m, tuple(x.shape))
-    flat_idx = jnp.asarray(np.nonzero(m.reshape(-1))[0])
+    flat_idx = _mask_flat_indices(x, mask)
     return apply("masked_select",
                  lambda a: jnp.take(a.reshape(-1), flat_idx), x)
 
@@ -646,3 +652,124 @@ def unfold(x, axis, size, step, name=None):
         return jnp.moveaxis(out, ax + 1, -1)
 
     return apply("unfold", f, x)
+
+
+# ---------------------------------------------- round-2 API-surface sweep
+
+
+@register_op("take", category="manipulation")
+def take(x, index, mode="raise", name=None):
+    """Flat-index gather (paddle.take). Modes follow numpy/paddle exactly:
+    'raise' errors on out-of-range (checked eagerly on the concrete index),
+    'wrap' applies modulo, 'clip' clamps (negatives to 0)."""
+    n = int(np.prod(x.shape)) if x.shape else 1
+    if mode == "raise":
+        iv = index._value if isinstance(index, Tensor) else np.asarray(index)
+        icheck = np.asarray(iv)
+        if icheck.size and (icheck.min() < -n or icheck.max() >= n):
+            raise IndexError(
+                f"take: index out of range for tensor of {n} elements")
+
+    def f(a, i):
+        flat = a.reshape(-1)
+        if mode == "wrap":
+            i = i % n
+        elif mode == "clip":
+            i = jnp.clip(i, 0, n - 1)
+        else:  # raise: bounds pre-checked; wrap negatives like numpy
+            i = jnp.where(i < 0, i + n, i)
+        return flat[i]
+
+    return apply("take", f, x, index)
+
+
+@register_op("masked_scatter", category="manipulation")
+def masked_scatter(x, mask, value, name=None):
+    """Fill mask positions from value's leading elements (paddle
+    masked_scatter). Mask is concrete (eager op, like masked_select)."""
+    flat_idx = _mask_flat_indices(x, mask)
+
+    def f(a, v):
+        return a.reshape(-1).at[flat_idx].set(
+            v.reshape(-1)[: flat_idx.shape[0]]).reshape(a.shape)
+
+    return apply("masked_scatter", f, x, value)
+
+
+@register_op("index_fill", category="manipulation")
+def index_fill(x, index, axis, fill_value, name=None):
+    import builtins
+
+    def f(a, i):
+        # NB: `slice` is shadowed by the paddle slice op in this module
+        idx = [builtins.slice(None)] * a.ndim
+        idx[axis] = i
+        return a.at[tuple(idx)].set(fill_value)
+
+    return apply("index_fill", f, x, index)
+
+
+@register_op("unflatten", category="manipulation")
+def unflatten(x, axis, shape, name=None):
+    def f(a):
+        ax = axis % a.ndim
+        new = list(a.shape[:ax]) + list(shape) + list(a.shape[ax + 1:])
+        return a.reshape(new)
+
+    return apply("unflatten", f, x)
+
+
+@register_op("select_scatter", category="manipulation")
+def select_scatter(x, values, axis, index, name=None):
+    import builtins
+
+    def f(a, v):
+        idx = [builtins.slice(None)] * a.ndim
+        idx[axis] = index
+        return a.at[tuple(idx)].set(v)
+
+    return apply("select_scatter", f, x, values)
+
+
+@register_op("slice_scatter", category="manipulation")
+def slice_scatter(x, value, axes, starts, ends, strides=None, name=None):
+    import builtins
+
+    strides = strides or [1] * len(axes)
+
+    def f(a, v):
+        idx = [builtins.slice(None)] * a.ndim
+        for ax, s, e, st in zip(axes, starts, ends, strides):
+            idx[ax] = builtins.slice(int(s), int(e), int(st))
+        return a.at[tuple(idx)].set(v)
+
+    return apply("slice_scatter", f, x, value)
+
+
+@register_op("column_stack", category="manipulation")
+def column_stack(xs, name=None):
+    return apply("column_stack", lambda *vs: jnp.column_stack(vs), *xs)
+
+
+@register_op("row_stack", category="manipulation")
+def row_stack(xs, name=None):
+    return apply("row_stack", lambda *vs: jnp.vstack(vs), *xs)
+
+
+def _make_nsplit(opname, jfn):
+    @register_op(opname, category="manipulation")
+    def op(x, num_or_indices, name=None):
+        n = (num_or_indices if isinstance(num_or_indices, int)
+             else list(num_or_indices))
+        # through apply() so gradients/AMP/numerics hooks engage (review
+        # r2: bypassing it silently dropped grads)
+        out = apply(opname, lambda a: tuple(jfn(a, n)), x)
+        return list(out) if isinstance(out, (tuple, list)) else [out]
+
+    op.__name__ = opname
+    return op
+
+
+hsplit = _make_nsplit("hsplit", jnp.hsplit)
+vsplit = _make_nsplit("vsplit", jnp.vsplit)
+dsplit = _make_nsplit("dsplit", jnp.dsplit)

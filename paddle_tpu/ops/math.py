@@ -337,3 +337,52 @@ def cdist(x, y, p=2.0, compute_mode="use_mm_for_euclid_dist_if_necessary",
         return jnp.sum(d ** p, axis=-1) ** (1.0 / p)
 
     return apply("cdist", f, x, y)
+
+
+# ---------------------------------------------- round-2 API-surface sweep
+# (prominent paddle.* functions probed missing in r2; one-liners on jnp)
+
+sinc = _unary("sinc", jnp.sinc)
+isposinf = _unary("isposinf", jnp.isposinf, differentiable=False)
+isneginf = _unary("isneginf", jnp.isneginf, differentiable=False)
+isreal = _unary("isreal", jnp.isreal, differentiable=False)
+xlogy = _binary("xlogy", lambda a, b: jax.scipy.special.xlogy(a, b))
+
+
+@register_op("frexp", differentiable=False)
+def frexp(x, name=None):
+    def f(a):
+        m, e = jnp.frexp(a)
+        return m, e.astype(jnp.int32)
+
+    return apply("frexp", f, x, differentiable=False)
+
+
+@register_op("pdist")
+def pdist(x, p=2.0, name=None):
+    """Condensed pairwise distances of rows (upper triangle, row-major)."""
+    def f(a):
+        n = a.shape[0]
+        d = jnp.abs(a[:, None, :] - a[None, :, :])
+        if p == 2.0:
+            full = jnp.sqrt(jnp.maximum(jnp.sum(d * d, -1), 0.0))
+        else:
+            full = jnp.sum(d ** p, -1) ** (1.0 / p)
+        iu = jnp.triu_indices(n, 1)
+        return full[iu]
+
+    return apply("pdist", f, x)
+
+
+@register_op("nanquantile")
+def nanquantile(x, q, axis=None, keepdim=False, name=None):
+    return apply(
+        "nanquantile",
+        lambda a: jnp.nanquantile(a, q, axis=axis, keepdims=keepdim), x)
+
+
+@register_op("vander", differentiable=False)
+def vander(x, n=None, increasing=False, name=None):
+    return apply("vander",
+                 lambda a: jnp.vander(a, N=n, increasing=increasing), x,
+                 differentiable=False)

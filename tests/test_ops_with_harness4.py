@@ -238,3 +238,81 @@ def test_op_against_numpy(case):
     t.check_output(rtol=rtol, atol=atol)
     if grad_keys:
         t.check_grad(grad_keys)
+
+
+def test_round2_api_surface_sweep():
+    """The r2 API probe additions: quick numpy pins for each."""
+    import paddle_tpu as paddle
+    from paddle_tpu.ops import manipulation as M
+
+    x = np.arange(12, dtype=np.float32).reshape(3, 4)
+    t = paddle.to_tensor
+
+    np.testing.assert_allclose(paddle.sinc(t(x / 7)).numpy(),
+                               np.sinc(x / 7), rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(
+        paddle.xlogy(t(x), t(x + 1)).numpy(),
+        scipy.special.xlogy(x, x + 1), rtol=1e-5, atol=1e-6)
+    assert bool(paddle.isposinf(t(np.asarray([np.inf]))).numpy()[0])
+    assert bool(paddle.isneginf(t(np.asarray([-np.inf]))).numpy()[0])
+    m, e = paddle.frexp(t(np.asarray([8.0], np.float32)))
+    assert float(m.numpy()[0]) == 0.5 and int(e.numpy()[0]) == 4
+
+    d = paddle.pdist(t(np.asarray([[0.0, 0], [3, 4], [0, 1]], np.float32)))
+    np.testing.assert_allclose(d.numpy(), [5.0, 1.0, np.sqrt(18)], rtol=1e-5)
+
+    np.testing.assert_allclose(
+        paddle.vander(t(np.asarray([1.0, 2, 3], np.float32)), 3).numpy(),
+        np.vander([1.0, 2, 3], 3), rtol=1e-6)
+    np.testing.assert_allclose(
+        paddle.nanquantile(t(x), 0.5).numpy(), np.nanquantile(x, 0.5),
+        rtol=1e-6)
+
+    np.testing.assert_allclose(
+        M.take(t(x), t(np.asarray([0, -1]))).numpy(), [0.0, 11.0])
+    out = M.masked_scatter(
+        t(np.zeros((2, 2), np.float32)),
+        t(np.asarray([[True, False], [False, True]])),
+        t(np.asarray([7.0, 8.0], np.float32)))
+    np.testing.assert_allclose(out.numpy(), [[7, 0], [0, 8]])
+    out = M.index_fill(t(x.copy()), t(np.asarray([1])), 0, -1.0)
+    assert np.all(out.numpy()[1] == -1.0)
+    assert M.unflatten(t(x), 1, [2, 2]).shape == [3, 2, 2]
+    out = M.select_scatter(t(x.copy()), t(np.full(4, 9.0, np.float32)), 0, 1)
+    assert np.all(out.numpy()[1] == 9.0)
+    out = M.slice_scatter(t(x.copy()), t(np.full((3, 2), 5.0, np.float32)),
+                          [1], [0], [2])
+    assert np.all(out.numpy()[:, :2] == 5.0)
+    cs = M.column_stack([t(np.ones(3, np.float32)),
+                         t(np.zeros(3, np.float32))])
+    assert cs.shape == [3, 2]
+    rs = M.row_stack([t(np.ones((1, 3), np.float32)),
+                      t(np.zeros((1, 3), np.float32))])
+    assert rs.shape == [2, 3]
+    hs = M.hsplit(t(x), 2)
+    assert len(hs) == 2 and hs[0].shape == [3, 2]
+    vs = M.vsplit(t(x), 3)
+    assert len(vs) == 3
+    ds = M.dsplit(t(x.reshape(3, 2, 2)), 2)
+    assert len(ds) == 2
+
+
+def test_take_modes_and_split_grads():
+    import paddle_tpu as paddle
+    from paddle_tpu.ops import manipulation as M
+
+    t = paddle.to_tensor
+    a = np.arange(6, dtype=np.float32)
+    np.testing.assert_allclose(
+        M.take(t(a), t(np.asarray([7, 8])), mode="wrap").numpy(), [1.0, 2.0])
+    np.testing.assert_allclose(
+        M.take(t(a), t(np.asarray([-1, 100])), mode="clip").numpy(),
+        [0.0, 5.0])
+    with pytest.raises(IndexError):
+        M.take(t(a), t(np.asarray([100])))
+    # hsplit gradient flows
+    x = t(np.arange(12, dtype=np.float32).reshape(3, 4), stop_gradient=False)
+    parts = M.hsplit(x, 2)
+    (parts[0].sum() + 2 * parts[1].sum()).backward()
+    np.testing.assert_allclose(x.grad.numpy()[:, :2], 1.0)
+    np.testing.assert_allclose(x.grad.numpy()[:, 2:], 2.0)
