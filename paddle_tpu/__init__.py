@@ -141,7 +141,9 @@ def is_compiled_with_xpu() -> bool:
 def is_compiled_with_tpu() -> bool:
     import jax
 
-    return any(d.platform in ("tpu", "axon") for d in jax.devices())
+    from paddle_tpu.device import is_tpu_like
+
+    return any(is_tpu_like(d) for d in jax.devices())
 
 
 def set_default_dtype(d):

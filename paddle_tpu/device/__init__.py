@@ -9,6 +9,23 @@ from __future__ import annotations
 import jax
 
 
+# Platform names that mean "a real TPU-class chip is attached": "tpu" is
+# the stock PJRT name; tunneled/proxied chips may report a different
+# platform string (e.g. "axon") while still being TPU-class hardware, so
+# every Pallas/perf gate must use THIS predicate, never `platform == "tpu"`.
+_TPU_LIKE_PLATFORMS = ("tpu", "axon")
+
+
+def is_tpu_like(device=None) -> bool:
+    """True when the (first) device is TPU-class hardware — the single
+    gate for Pallas kernels and TPU-only fast paths."""
+    try:
+        d = device if device is not None else jax.devices()[0]
+        return d.platform in _TPU_LIKE_PLATFORMS
+    except Exception:
+        return False
+
+
 def get_all_device_type():
     return sorted({d.platform for d in jax.devices()})
 

@@ -179,9 +179,17 @@ def _mp_jitted(static_key):
 
 
 def _mp_collect(static_key, v):
-    garr = _global_stack(v)
-    out = _mp_jitted(static_key)(garr)
-    return np.asarray(out.addressable_data(0))
+    """Blocking multi-controller collective, guarded by the comm watchdog:
+    a dead peer raises CommTimeoutError within FLAGS_comm_timeout_s instead
+    of hanging the survivor (reference: comm_task_manager.h:37)."""
+    from paddle_tpu.distributed.watchdog import run_with_watchdog
+
+    def run():
+        garr = _global_stack(v)
+        out = _mp_jitted(static_key)(garr)
+        return np.asarray(out.addressable_data(0))
+
+    return run_with_watchdog(run, desc=str(static_key[0]))
 
 
 def _mp_allreduce_full(v, op, group=None):

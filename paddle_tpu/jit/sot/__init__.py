@@ -1,27 +1,30 @@
-"""Minimal SOT tier: guarded capture with graph-break fallback.
+"""SOT tier: bytecode-level capture with guards, graph breaks, and
+function-level fallback.
 
 Reference: python/paddle/jit/sot/ (22K LoC) — a CPython bytecode simulator
 (PEP-523 eval-frame hook pybind/eval_frame.c:439, opcode executor
 jit/sot/opcode_translator/executor/) that captures subgraphs, guards them on
 input properties, and falls back to eager at unsupported constructs.
 
-TPU-native scope note: on XLA the unit of compilation is a traced function,
-so this tier implements SOT's *contract* at function granularity:
+This package implements the contract in two tiers:
+
+1. **bytecode tier** (`bytecode.py`): a CPython 3.12 opcode executor with
+   lazy tensor regions — a frame containing `.numpy()` / `float()` /
+   tensor-dependent branching becomes compiled-region -> eager gap ->
+   compiled-region (sub-function graph breaks), with compiled regions
+   cached by statement signature and whole-frame guard chains for
+   break-free frames.
+2. **function tier** (this module): guarded whole-frame to_static capture
+   with permanent-eager fallback, used when the bytecode tier declines a
+   frame (unsupported opcode, generator, autograd interplay) — the
+   original round-2 machinery.
 
 - **guards**: each capture is keyed on the function's code object version,
   tensor arg structures (shape/dtype/stop_gradient), non-tensor arg values,
-  and closure cell values. A guard miss re-captures (multiple specializations
-  coexist, like SOT's guard chains).
-- **graph breaks**: constructs tracing cannot swallow (data-dependent python
-  branching that survives the AST pass, `.numpy()` materialization, python
-  side effects on traced values) raise during capture; the frame is then
-  marked and permanently executed eagerly — SOT's fallback path.
-- the AST pass (dy2static.ast_transform) plays the role of SOT's control-flow
-  capture; this module adds the guard/dispatch/fallback machinery.
-
-Bytecode-level sub-function graph breaks (splitting ONE frame into several
-compiled regions) are intentionally out of scope — on TPU the win of partial
-graphs is small because XLA recompiles whole traces anyway.
+  and closure cell values. A guard miss re-captures (multiple
+  specializations coexist, like SOT's guard chains).
+- **graph breaks**: at bytecode tier, per-site (region split); at function
+  tier, constructs tracing cannot swallow mark the frame permanently eager.
 """
 
 from __future__ import annotations
@@ -62,13 +65,15 @@ def _closure_guard(fn: Callable) -> Tuple:
 
 
 class _Frame:
-    """Per-code-object capture state: guard table + fallback flag."""
+    """Per-code-object capture state: guard table + fallback flags."""
 
     def __init__(self, fn: Callable):
         self.fn = fn
         self.specializations: Dict[Tuple, Callable] = {}
-        self.fallback = False  # permanent graph break
-        self.breaks = 0
+        self.fallback = False          # permanent eager (function tier broke)
+        self.bytecode_declined = False  # bytecode tier unsupported
+        self.breaks = 0                # function-tier breaks
+        self.captured: Optional[object] = None  # bytecode CapturedFrame
 
     def guard_key(self, args, kwargs) -> Tuple:
         return (
@@ -94,14 +99,27 @@ def _graph_break_types():
     return _GRAPH_BREAK_TYPES
 
 
+def _autograd_live(args, kwargs) -> bool:
+    from paddle_tpu.autograd import tape
+
+    if not tape.is_grad_enabled():
+        return False
+    import jax
+
+    leaves = jax.tree_util.tree_leaves(
+        (args, kwargs), is_leaf=lambda x: isinstance(x, Tensor))
+    return any(isinstance(t, Tensor) and not t.stop_gradient for t in leaves)
+
+
 def symbolic_translate(fn: Optional[Callable] = None, *, train=None,
                        build_strategy=None):
-    """paddle.jit.sot.symbolic_translate parity: wrap ``fn`` in the guarded
-    capture machinery. Usable as decorator or call."""
+    """paddle.jit.sot.symbolic_translate parity: wrap ``fn`` in the
+    two-tier capture machinery. Usable as decorator or call."""
     if fn is None:
         return lambda f: symbolic_translate(f)
 
     from paddle_tpu.jit.api import to_static
+    from paddle_tpu.jit.sot.bytecode import BytecodeUnsupported, CapturedFrame
 
     frame = _Frame(fn)
 
@@ -109,6 +127,18 @@ def symbolic_translate(fn: Optional[Callable] = None, *, train=None,
         if frame.fallback:
             return fn(*args, **kwargs)
         key = frame.guard_key(args, kwargs)
+
+        # tier 1: bytecode executor (inference frames; autograd frames go
+        # to the function tier where to_static owns the grad story)
+        if not frame.bytecode_declined and not _autograd_live(args, kwargs):
+            if frame.captured is None:
+                frame.captured = CapturedFrame(fn)
+            try:
+                return frame.captured(key, args, kwargs)
+            except BytecodeUnsupported:
+                frame.bytecode_declined = True  # fall through
+
+        # tier 2: whole-frame guarded capture
         compiled = frame.specializations.get(key)
         if compiled is None:
             # full_graph=True: trace failures must surface HERE so the
@@ -134,5 +164,13 @@ def symbolic_translate(fn: Optional[Callable] = None, *, train=None,
 
 def sot_stats(wrapped) -> dict:
     f: _Frame = wrapped._sot_frame
-    return {"specializations": len(f.specializations),
-            "fallback": f.fallback, "breaks": f.breaks}
+    cap = f.captured
+    return {
+        "specializations": len(f.specializations) + (
+            len(cap.chain) if cap is not None else 0),
+        "fallback": f.fallback, "breaks": f.breaks,
+        "bytecode": cap is not None and not f.bytecode_declined,
+        "bytecode_breaks": cap.total_breaks if cap is not None else 0,
+        "regions_compiled": cap.regions_compiled if cap is not None else 0,
+        "interpreted_calls": cap.interpreted_calls if cap is not None else 0,
+    }

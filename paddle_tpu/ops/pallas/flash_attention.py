@@ -26,15 +26,19 @@ from paddle_tpu.tensor import Tensor
 # toggled by FLAGS_use_flash_attention (framework/flags.py)
 _FLASH_ENABLED = True
 
+# evidence trail: "pallas" | "xla" — set on every flash_attention_fwd trace
+# so tests/bench can assert the Pallas kernel is actually selected (a silent
+# platform-gate mismatch disabled it for a full round once).
+_last_path = None
+_warned_fallback = False
+
 
 def _use_pallas(q_shape, head_dim) -> bool:
     if not _FLASH_ENABLED:
         return False
-    try:
-        dev = jax.devices()[0].platform
-    except Exception:
-        return False
-    if dev not in ("tpu",):
+    from paddle_tpu.device import is_tpu_like
+
+    if not is_tpu_like():
         return False
     # block-divisibility: seq multiples of 128, head_dim multiple of 128 not
     # required (we pad head_dim inside the kernel wrapper if needed)
@@ -60,15 +64,28 @@ def _attention_reference(q, k, v, bias, causal, scale):
 
 def flash_attention_fwd(q, k, v, bias=None, causal=False, scale=None):
     """Raw jax-level flash attention entry (arrays in, array out)."""
+    global _last_path, _warned_fallback
     if scale is None:
         scale = 1.0 / math.sqrt(q.shape[-1])
     if _use_pallas(q.shape, q.shape[-1]):
         try:
             from paddle_tpu.ops.pallas import flash_attention_tpu as ker
 
-            return ker.flash_attention(q, k, v, bias=bias, causal=causal, scale=scale)
+            out = ker.flash_attention(q, k, v, bias=bias, causal=causal, scale=scale)
+            _last_path = "pallas"
+            return out
         except Exception:
-            pass
+            # a TPU-like chip that can't run the kernel is a bug, not a
+            # fallback case — shout so it can't silently cost a round of perf
+            if not _warned_fallback:
+                import traceback
+                import warnings
+
+                _warned_fallback = True
+                warnings.warn(
+                    "Pallas flash-attention selected but FAILED; falling back "
+                    "to XLA attention:\n" + traceback.format_exc())
+    _last_path = "xla"
     return _attention_reference(q, k, v, bias, causal, scale)
 
 

@@ -34,10 +34,9 @@ _DEFAULT_BLOCK_ROWS = 512  # 512*128 fp32 = 256 KiB per buffer in VMEM
 
 
 def use_fused_adamw() -> bool:
-    try:
-        return jax.devices()[0].platform in ("tpu", "axon")
-    except Exception:
-        return False
+    from paddle_tpu.device import is_tpu_like
+
+    return is_tpu_like()
 
 
 def _adamw_kernel(beta1, beta2, eps,

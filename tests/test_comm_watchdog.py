@@ -1,0 +1,103 @@
+"""Comm watchdog tests (VERDICT r2 missing #5 / next-round #9).
+
+Reference: paddle/phi/core/distributed/comm_task_manager.h:37 — background
+timeout/error detection for collectives. The drill kills one rank between
+two collectives and asserts the survivor RAISES within the timeout instead
+of hanging (the round-2 behavior).
+"""
+
+import os
+import socket
+import subprocess
+import sys
+import time
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+WORKER = os.path.join(REPO, "tests", "watchdog_worker.py")
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+# ------------------------------------------------------------- unit level
+
+
+def test_watchdog_times_out_a_stuck_call():
+    from paddle_tpu.distributed.watchdog import (
+        CommTimeoutError,
+        run_with_watchdog,
+    )
+
+    t0 = time.monotonic()
+    with pytest.raises(CommTimeoutError):
+        run_with_watchdog(lambda: time.sleep(60), timeout=1.0, desc="stuck")
+    assert time.monotonic() - t0 < 10
+
+
+def test_watchdog_passes_results_and_errors_through():
+    from paddle_tpu.distributed.watchdog import run_with_watchdog
+
+    assert run_with_watchdog(lambda: 41 + 1, timeout=5.0) == 42
+
+    class Boom(RuntimeError):
+        pass
+
+    def bad():
+        raise Boom("inner")
+
+    with pytest.raises(Boom):
+        run_with_watchdog(bad, timeout=5.0)
+
+
+def test_watchdog_disabled_runs_inline():
+    from paddle_tpu.distributed.watchdog import run_with_watchdog
+
+    assert run_with_watchdog(lambda: "x", timeout=0) == "x"
+
+
+# ------------------------------------------------------------ process drill
+
+
+@pytest.mark.slow
+def test_dead_peer_raises_on_survivor():
+    port = _free_port()
+    env_base = dict(os.environ)
+    env_base.pop("XLA_FLAGS", None)
+    env_base["JAX_PLATFORMS"] = "cpu"
+    env_base["PYTHONPATH"] = REPO + os.pathsep + env_base.get("PYTHONPATH", "")
+    env_base["PADDLE_MASTER"] = f"127.0.0.1:{port}"
+    env_base["PADDLE_TRAINERS_NUM"] = "2"
+
+    procs = []
+    for rank in range(2):
+        env = dict(env_base)
+        env["PADDLE_TRAINER_ID"] = str(rank)
+        procs.append(subprocess.Popen(
+            [sys.executable, WORKER], env=env, cwd=REPO,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True))
+
+    t0 = time.monotonic()
+    outs = []
+    for p in procs:
+        try:
+            out, _ = p.communicate(timeout=180)
+        except subprocess.TimeoutExpired:
+            p.kill()
+            out, _ = p.communicate()
+            pytest.fail(f"worker hung (no watchdog): {out[-2000:]}")
+        outs.append(out)
+    wall = time.monotonic() - t0
+
+    r0 = outs[0]
+    assert "warmup ok" in r0, r0[-2000:]
+    assert ("CAUGHT_TIMEOUT" in r0) or ("CAUGHT_ERROR" in r0), r0[-2000:]
+    assert "UNEXPECTED_COMPLETION" not in r0
+    # the survivor surfaced the failure well inside the drill budget
+    assert wall < 150, f"took {wall:.0f}s"

@@ -1,0 +1,67 @@
+"""Pin the flash-attention Pallas platform gate (VERDICT r2 weak #1).
+
+Round 2's kernel was silently disabled on the bench chip because the gate
+checked `platform == "tpu"` while the tunneled chip reports "axon". These
+tests pin the shared `is_tpu_like` predicate and that `_use_pallas` selects
+the kernel on every TPU-like platform name (and never on CPU), so a rename
+of the platform string can't silently cost a round of perf again.
+"""
+
+import jax
+import pytest
+
+from paddle_tpu import device as pdev
+from paddle_tpu.ops.pallas import flash_attention as fa
+from paddle_tpu.ops.pallas import fused_adamw
+
+
+class _FakeDev:
+    def __init__(self, platform):
+        self.platform = platform
+
+
+@pytest.mark.parametrize("platform", ["tpu", "axon"])
+def test_is_tpu_like_accepts_tpu_class_platforms(platform):
+    assert pdev.is_tpu_like(_FakeDev(platform))
+
+
+@pytest.mark.parametrize("platform", ["cpu", "gpu", "cuda"])
+def test_is_tpu_like_rejects_host_platforms(platform):
+    assert not pdev.is_tpu_like(_FakeDev(platform))
+
+
+@pytest.mark.parametrize("platform", ["tpu", "axon"])
+def test_use_pallas_selected_on_tpu_like(monkeypatch, platform):
+    monkeypatch.setattr(
+        jax, "devices", lambda *a, **k: [_FakeDev(platform)])
+    # block-divisible GPT-ish shape: batch 2, seq 1024, heads 12, dim 64
+    assert fa._use_pallas((2, 1024, 12, 64), 64)
+
+
+def test_use_pallas_rejected_on_cpu(monkeypatch):
+    monkeypatch.setattr(jax, "devices", lambda *a, **k: [_FakeDev("cpu")])
+    assert not fa._use_pallas((2, 1024, 12, 64), 64)
+
+
+def test_use_pallas_rejects_non_block_shapes(monkeypatch):
+    monkeypatch.setattr(jax, "devices", lambda *a, **k: [_FakeDev("tpu")])
+    assert not fa._use_pallas((2, 1000, 12, 64), 64)   # seq % 128 != 0
+    assert not fa._use_pallas((2, 1024, 12, 48), 48)   # odd head_dim
+
+
+def test_fused_adamw_gate_uses_shared_predicate(monkeypatch):
+    monkeypatch.setattr(jax, "devices", lambda *a, **k: [_FakeDev("axon")])
+    assert fused_adamw.use_fused_adamw()
+    monkeypatch.setattr(jax, "devices", lambda *a, **k: [_FakeDev("cpu")])
+    assert not fused_adamw.use_fused_adamw()
+
+
+def test_flash_fwd_records_selected_path():
+    """On the CPU test platform the XLA path must run and be recorded; the
+    bench asserts `_last_path == "pallas"` on the real chip via the same
+    hook."""
+    import jax.numpy as jnp
+
+    q = jnp.zeros((1, 128, 2, 64), jnp.float32)
+    fa.flash_attention_fwd(q, q, q)
+    assert fa._last_path == "xla"

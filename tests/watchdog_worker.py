@@ -1,0 +1,66 @@
+"""Worker for the comm-watchdog drill (run by test_comm_watchdog.py).
+
+Rank 1 rendezvouses, completes one warm-up collective, then DIES.
+Rank 0 then enters a second collective that can never complete; the
+watchdog must raise CommTimeoutError (or surface the backend's peer error)
+instead of hanging forever — the reference CommTaskManager contract.
+"""
+
+import os
+import sys
+import time
+
+import numpy as np
+
+
+def main():
+    rank = int(os.environ["PADDLE_TRAINER_ID"])
+    world = int(os.environ["PADDLE_TRAINERS_NUM"])
+    coord = os.environ["PADDLE_MASTER"]
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.distributed.initialize(coordinator_address=coord, num_processes=world,
+                               process_id=rank)
+
+    from paddle_tpu.framework import flags as _flags
+    import paddle_tpu.distributed as dist
+    from paddle_tpu.distributed import env as _env
+    from paddle_tpu.distributed.watchdog import CommTimeoutError
+
+    _env.init_parallel_env()
+    _flags.set_flags({"FLAGS_comm_timeout_s": 8.0})
+
+    from paddle_tpu.tensor import Tensor
+    import jax.numpy as jnp
+
+    # warm-up collective: both ranks participate
+    v = Tensor._from_value(jnp.asarray(np.full((4,), rank + 1, np.float32)))
+    dist.all_reduce(v)
+    print(f"[rank {rank}] warmup ok: {np.asarray(v.numpy()).tolist()}",
+          flush=True)
+
+    if rank == 1:
+        print("[rank 1] dying before the second collective", flush=True)
+        sys.stdout.flush()
+        os._exit(0)
+
+    # rank 0: enter a collective no peer will join
+    t0 = time.monotonic()
+    try:
+        w = Tensor._from_value(jnp.asarray(np.ones((4,), np.float32)))
+        dist.all_reduce(w)
+        print("[rank 0] UNEXPECTED_COMPLETION", flush=True)
+    except CommTimeoutError as e:
+        dt = time.monotonic() - t0
+        print(f"[rank 0] CAUGHT_TIMEOUT after {dt:.1f}s: {e}", flush=True)
+    except Exception as e:
+        dt = time.monotonic() - t0
+        print(f"[rank 0] CAUGHT_ERROR after {dt:.1f}s: "
+              f"{type(e).__name__}: {e}", flush=True)
+    os._exit(0)  # comm thread may still be blocked; don't wait on it
+
+
+if __name__ == "__main__":
+    main()
