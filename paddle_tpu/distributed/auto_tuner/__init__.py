@@ -11,6 +11,7 @@ whole trial jobs; on a single controller the trial is a jitted step."""
 from __future__ import annotations
 
 import itertools
+import json
 import math
 from dataclasses import dataclass, field
 from typing import Callable, List, Optional
@@ -26,6 +27,7 @@ class TunerConfig:
     micro_batch_size: int = 1
     estimated_cost: float = 0.0
     measured_time: Optional[float] = None
+    trial_error: Optional[str] = None
 
     def degrees(self):
         return (self.dp_degree, self.mp_degree, self.pp_degree,
@@ -143,6 +145,65 @@ def estimate_cost(c: TunerConfig, model: ModelSpec,
     return (t_compute + t_mp + t_sep + t_dp) / max(1 - bubble, 1e-3)
 
 
+def subprocess_trial_fn(model: ModelSpec, steps: int = 3,
+                        timeout: float = 600.0,
+                        trial_args: Optional[dict] = None):
+    """Build a trial_fn that MEASURES a candidate by spawning a real trial
+    job (reference: the tuner launches whole distributed jobs per
+    candidate, tuner.py:21) on a virtual CPU mesh sized to the config's
+    world — each trial is its own process with its own XLA device count,
+    so compile failures/OOMs are isolated and simply score inf.
+    """
+    import os
+    import subprocess
+    import sys
+
+    extra = trial_args or {}
+
+    def run(cfg: TunerConfig) -> float:
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        flags = [f for f in env.get("XLA_FLAGS", "").split()
+                 if "host_platform_device_count" not in f]
+        flags.append(
+            f"--xla_force_host_platform_device_count={cfg.world()}")
+        env["XLA_FLAGS"] = " ".join(flags)
+        # invoke by FILE PATH: `-m` would import the paddle_tpu package
+        # (and initialize the jax backend) before the trial can pin the
+        # cpu platform + virtual device count
+        trial_path = os.path.join(os.path.dirname(__file__), "trial.py")
+        repo_root = os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__)))))
+        env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
+        cmd = [sys.executable, trial_path,
+               "--dp", str(cfg.dp_degree), "--mp", str(cfg.mp_degree),
+               "--pp", str(cfg.pp_degree),
+               "--sharding", str(cfg.sharding_degree),
+               "--sep", str(cfg.sep_degree),
+               "--micro-batch", str(cfg.micro_batch_size),
+               "--hidden", str(extra.get("hidden", min(model.hidden_size, 64))),
+               "--layers", str(extra.get("layers", min(model.num_layers, 2))),
+               "--seq", str(extra.get("seq", min(model.seq_len, 32))),
+               "--vocab", str(extra.get("vocab", min(model.vocab_size, 256))),
+               "--steps", str(steps)]
+        proc = subprocess.run(cmd, env=env, capture_output=True, text=True,
+                              timeout=timeout)
+        if proc.returncode != 0:
+            raise RuntimeError(
+                f"trial {cfg.degrees()} failed rc={proc.returncode}: "
+                f"{proc.stderr[-500:]}")
+        for line in reversed(proc.stdout.strip().splitlines()):
+            line = line.strip()
+            if line.startswith("{"):
+                payload = json.loads(line)
+                if "measured_time_ms" in payload:
+                    return float(payload["measured_time_ms"])
+                raise RuntimeError(f"trial error: {payload}")
+        raise RuntimeError(f"trial produced no result: {proc.stdout[-300:]}")
+
+    return run
+
+
 class AutoTuner:
     """tuner.py:21 parity: generate -> prune -> rank -> (optionally) measure."""
 
@@ -170,8 +231,9 @@ class AutoTuner:
         for c in cands[: self.max_trials]:
             try:
                 c.measured_time = float(self.trial_fn(c))
-            except Exception:
+            except Exception as e:  # failed trial scores inf, reason kept
                 c.measured_time = float("inf")
+                c.trial_error = f"{type(e).__name__}: {e}"[:500]
             self.history.append(c)
             if c.measured_time < best_t:
                 best, best_t = c, c.measured_time

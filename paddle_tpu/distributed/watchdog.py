@@ -9,16 +9,19 @@ peer hangs every survivor silently. The watchdog runs each blocking
 multi-controller collective on a worker thread and bounds the wait:
 
 - on timeout, the caller raises ``CommTimeoutError`` naming the operation
-  (the reference's timeout path);
-- when ``FLAGS_comm_async_error_handling`` is on (default), a timeout also
-  tears the process down (``os._exit``) after a grace period, the analogue
-  of the reference's async-error-handling abort — a rank that cannot
-  communicate must not linger half-alive in a collective job (the launcher
-  / elastic manager observes the death and relaunches).
+  (the reference's timeout path) and the communicator is POISONED: every
+  subsequent watchdog-guarded collective raises immediately. The blocked
+  worker thread cannot be cancelled and may complete the real collective
+  later, consuming the peers' matching op — retrying after a timeout would
+  desynchronize collective ordering job-wide, which is exactly what the
+  reference avoids by aborting the NCCL communicator. Restart the job.
+- when ``FLAGS_comm_async_error_handling`` is enabled (off by default), a
+  timeout instead tears the process down (``os._exit(134)``), the analogue
+  of the reference's async-error-handling abort — the launcher / elastic
+  manager observes the death and relaunches.
 
-The worker thread that is still blocked inside XLA cannot be cancelled
-(neither can a hung ncclAllReduce — the reference aborts the communicator
-instead); it is marked daemon so process teardown is never blocked.
+The worker thread that is still blocked inside XLA is marked daemon so
+process teardown is never blocked.
 """
 
 from __future__ import annotations
@@ -44,6 +47,18 @@ class CommTimeoutError(RuntimeError):
     """A collective did not complete within the watchdog timeout."""
 
 
+# once any collective times out, the communicator's ordering can no longer
+# be trusted (the blocked thread may consume a peer's later op) — poisoned,
+# like an aborted NCCL communicator
+_poisoned: Optional[str] = None
+
+
+def reset_poison() -> None:
+    """Clear the poisoned state (tests / full comm re-initialization)."""
+    global _poisoned
+    _poisoned = None
+
+
 def comm_timeout() -> float:
     try:
         return float(_flags.get_flags("FLAGS_comm_timeout_s")
@@ -58,6 +73,12 @@ def run_with_watchdog(fn: Callable[[], Any], *, timeout: Optional[float] = None,
 
     ``timeout`` None -> FLAGS_comm_timeout_s; <= 0 -> unguarded direct call.
     """
+    global _poisoned
+    if _poisoned is not None:
+        raise CommTimeoutError(
+            f"communicator poisoned by an earlier timeout ({_poisoned}); "
+            f"collective ordering is no longer trustworthy — restart the "
+            f"job / re-init the process group")
     t = comm_timeout() if timeout is None else float(timeout)
     if t <= 0:
         return fn()
@@ -95,6 +116,7 @@ def run_with_watchdog(fn: Callable[[], Any], *, timeout: Optional[float] = None,
             traceback.print_stack(file=sys.stderr)
             sys.stderr.flush()
             os._exit(134)
+        _poisoned = desc
         raise CommTimeoutError(msg)
     if error:
         raise error[0]

@@ -44,6 +44,28 @@ class FusedAdamW(AdamW):
         # PER-ELEMENT pow chains: new params start their own correction
         b1pow = jnp.full_like(flat_p, self._beta1)
         b2pow = jnp.full_like(flat_p, self._beta2)
+        if old is None and self._state:
+            # the optimizer previously ran through TrainStep's per-param
+            # path (or a stock-format resume): seed the flat buffers from
+            # the per-param moments instead of silently zeroing them
+            off = 0
+            for p, n in zip(params, sizes):
+                st = self._state.get(id(p))
+                if st is not None and "moment1" in st:
+                    flat_m = flat_m.at[off:off + n].set(
+                        jnp.ravel(st["moment1"]).astype(jnp.float32))
+                    flat_v = flat_v.at[off:off + n].set(
+                        jnp.ravel(st["moment2"]).astype(jnp.float32))
+                    step = int(st.get("step", 0))
+                    b1pow = b1pow.at[off:off + n].set(
+                        float(self._beta1) ** (step + 1))
+                    b2pow = b2pow.at[off:off + n].set(
+                        float(self._beta2) ** (step + 1))
+                mw = self._master_weights.get(id(p))
+                if mw is not None:
+                    flat_p = flat_p.at[off:off + n].set(
+                        jnp.ravel(mw).astype(jnp.float32))
+                off += n
         if old is not None:
             # the grad-bearing param set changed (layers frozen/unfrozen):
             # CARRY OVER moments + fp32 master segments for surviving params
@@ -136,9 +158,13 @@ class FusedAdamW(AdamW):
 
     # ------------------------------------------------------ checkpointing
     def state_dict(self):
-        """Flat-buffer state (the per-param base-class dict would be empty)."""
+        """Flat-buffer state when the eager fused loop ran; the per-param
+        base-class dict when the optimizer was driven through TrainStep's
+        per-param path (where the flat buffers are never built)."""
         from paddle_tpu.tensor import Tensor
 
+        if self._flat is None and self._state:
+            return super().state_dict()
         sd = {"step_count": self._step_count}
         if self._flat is not None:
             st = self._flat

@@ -334,6 +334,19 @@ class OpcodeExecutor:
             return self.tracer.new_input(v)
         return v
 
+    def _wrap_value(self, v):
+        """Wrap tensors for deferral WITHOUT breaking container identity:
+        mutable containers (list/dict) pass through UNCHANGED — rebuilding
+        them would make in-frame mutations (`acc.append(...)`) invisible to
+        the caller; tensors inside them simply run eagerly, which is
+        correct, just uncaptured. Tuples are immutable, so recursing into
+        them is safe."""
+        if isinstance(v, Tensor) and not _is_sparse(v):
+            return self.tracer.new_input(v)
+        if type(v) is tuple:
+            return tuple(self._wrap_value(i) for i in v)
+        return v
+
     def _concrete(self, v):
         """Materialize a value (tree) for eager execution."""
         return _map_tree(v, lambda st: self.tracer.materialize(st))
@@ -342,7 +355,12 @@ class OpcodeExecutor:
         """Decline BEFORE any execution when the frame contains opcodes the
         executor has no handler for — a mid-run decline would fall back to
         the function tier and re-execute python side effects already
-        performed during interpretation."""
+        performed during interpretation. Runtime constructs that need host
+        values (unknown tensor attrs, tensor unpack/containment/iteration)
+        are handled as graph breaks, and name errors propagate with eager
+        semantics, so the only REMAINING mid-run declines are exotic
+        (STORE_SUBSCR on a tensor, the instruction-count limit) — those
+        frames may re-run side effects through the fallback."""
         if self.code.co_flags & (0x20 | 0x80 | 0x100):
             raise BytecodeUnsupported("generator/coroutine frame")
         for inst in self.insts:
@@ -366,13 +384,12 @@ class OpcodeExecutor:
         for k, v in bound.arguments.items():
             param = sig.parameters[k]
             if param.kind == inspect.Parameter.VAR_POSITIONAL:
-                self.locals[k] = tuple(
-                    _map_tree_tensors(list(v), self._wrap_in))
+                self.locals[k] = tuple(self._wrap_value(i) for i in v)
             elif param.kind == inspect.Parameter.VAR_KEYWORD:
-                self.locals[k] = {kk: self._wrap_in(vv)
+                self.locals[k] = {kk: self._wrap_value(vv)
                                   for kk, vv in v.items()}
             else:
-                self.locals[k] = _map_tree_tensors(v, self._wrap_in)
+                self.locals[k] = self._wrap_value(v)
 
         idx = 0
         steps = 0
@@ -399,7 +416,10 @@ class OpcodeExecutor:
         _collect_syms(args, syms)
         _collect_syms(kwargs, syms)
         if isinstance(fn, SymTensor):
-            raise BytecodeUnsupported("calling a tensor value")
+            # calling a tensor value: materialize and call — usually a
+            # TypeError, which is exactly eager semantics
+            self.tracer.breaks += 1
+            fn = self.tracer.materialize(fn)
         if not syms:
             # pure python call — execute right here (eager semantics);
             # user exceptions propagate as-is (converting them to a decline
@@ -441,8 +461,9 @@ class OpcodeExecutor:
         return self.call_value(getattr(self_v, name), args, kwargs)
 
     def _reseed(self, out):
-        """Wrap eager-gap outputs: tensors become fresh region inputs."""
-        return _map_tree_tensors(out, self._wrap_in)
+        """Wrap eager-gap outputs: tensors become fresh region inputs
+        (identity-preserving for mutable containers, like _wrap_value)."""
+        return self._wrap_value(out)
 
     def binary(self, opfn, a, b):
         if isinstance(a, SymTensor) or isinstance(b, SymTensor):
@@ -493,7 +514,10 @@ class OpcodeExecutor:
 
     def op_LOAD_FAST(self, inst):
         if inst.argval not in self.locals:
-            raise BytecodeUnsupported(f"unbound local {inst.argval}")
+            # real eager semantics, not a frame decline
+            raise UnboundLocalError(
+                f"cannot access local variable '{inst.argval}' where it is "
+                f"not associated with a value")
         self.push(self.locals[inst.argval])
         return None
 
@@ -527,7 +551,7 @@ class OpcodeExecutor:
         elif name in self.builtins:
             self.push(self.builtins[name])
         else:
-            raise BytecodeUnsupported(f"global {name} not found")
+            raise NameError(f"name '{name}' is not defined")
         return None
 
     def op_LOAD_DEREF(self, inst):
@@ -535,8 +559,7 @@ class OpcodeExecutor:
                                self.code.co_freevars):
             if cname == inst.argval:
                 try:
-                    self.push(_map_tree_tensors(cell.cell_contents,
-                                                self._wrap_in))
+                    self.push(self._wrap_value(cell.cell_contents))
                     return None
                 except ValueError:
                     raise BytecodeUnsupported("empty closure cell")
@@ -597,7 +620,10 @@ class OpcodeExecutor:
         b = self.pop()
         a = self.pop()
         if isinstance(a, SymTensor) or isinstance(b, SymTensor):
-            raise BytecodeUnsupported("tensor containment")
+            # containment needs host values: graph break, not a decline
+            self.tracer.breaks += 1
+            a = self._concrete(a)
+            b = self._concrete(b)
         r = a in b
         self.push((not r) if inst.arg else r)
         return None
@@ -688,7 +714,9 @@ class OpcodeExecutor:
     def op_UNPACK_SEQUENCE(self, inst):
         seq = self.pop()
         if isinstance(seq, SymTensor):
-            raise BytecodeUnsupported("unpacking a tensor")
+            # unpack rows of a materialized tensor (graph break)
+            self.tracer.breaks += 1
+            seq = [self._wrap_in(r) for r in self.tracer.materialize(seq)]
         items = list(seq)
         if len(items) != inst.arg:
             raise BytecodeUnsupported("unpack arity mismatch")
@@ -811,7 +839,10 @@ class _BoundSym:
 
 def _sym_attr(tracer: RegionTracer, st: SymTensor, name: str):
     """Attribute access on a deferred tensor: metadata resolves from the
-    aval without materializing; data attributes record/break."""
+    aval without materializing; everything else is a GRAPH BREAK (the
+    tensor materializes and the real attribute is read) — never a frame
+    decline, which would re-run already-executed side effects through the
+    fallback tier."""
     if name == "shape":
         return list(st.aval.shape)
     if name == "ndim":
@@ -832,7 +863,9 @@ def _sym_attr(tracer: RegionTracer, st: SymTensor, name: str):
         return tracer.record(("call", _transpose_T), (st,), {})
     if name == "stop_gradient":
         return True
-    raise BytecodeUnsupported(f"tensor attr {name}")
+    tracer.breaks += 1
+    out = getattr(tracer.materialize(st), name)
+    return tracer.new_input(out) if isinstance(out, Tensor) else out
 
 
 def _transpose_T(t: Tensor):
@@ -844,14 +877,6 @@ def _is_sparse(t) -> bool:
     return cls in ("SparseCooTensor", "SparseCsrTensor")
 
 
-def _map_tree_tensors(x, fn):
-    if isinstance(x, Tensor):
-        return fn(x)
-    if isinstance(x, (list, tuple)):
-        return type(x)(_map_tree_tensors(i, fn) for i in x)
-    if isinstance(x, dict):
-        return {k: _map_tree_tensors(v, fn) for k, v in x.items()}
-    return x
 
 
 def _recordable(fn) -> bool:

@@ -372,7 +372,15 @@ def _binary(op_name, fn):
                     shape=bx.shape)
                 # unbounded sum_duplicates: exact-union nse, no padding
                 return SparseCooTensor._from_bcoo(cat.sum_duplicates())
-            out = fn(_as_coo(x)._value.todense(), _as_coo(y)._value.todense())
+            dx = _as_coo(x)._value.todense()
+            dy = _as_coo(y)._value.todense()
+            out = fn(dx, dy)
+            if fn is jnp.divide:
+                # restrict to the union pattern: without the mask every
+                # implicit-zero position evaluates 0/0 = NaN and the result
+                # densifies into stored NaNs
+                union = (dx != 0) | (dy != 0)
+                out = jnp.where(union, out, jnp.zeros((), out.dtype))
             return SparseCooTensor._from_bcoo(jsparse.BCOO.fromdense(out))
         xa = _as_coo(x)._value.todense() if xs else x._value
         ya = _as_coo(y)._value.todense() if ys else y._value
@@ -588,7 +596,8 @@ sync_batch_norm = batch_norm  # single-controller: same stats (psum inside
 def fused_attention(q, k, v, sparse_mask, key_padding_mask=None,
                     attn_mask=None, name=None):
     """Sparse-mask attention (reference sparse fused_attention_kernel):
-    q,k,v dense [B, H, S, D]; sparse_mask gives the attended positions.
+    q,k,v dense [B, H, S, D]; sparse_mask gives the attended positions;
+    key_padding_mask [B, S] (nonzero = valid key) excludes padding keys.
     TPU path: dense flash-style attention with the mask materialized from
     the sparse pattern — no block-sparse MMA on TPU."""
     qa, ka, va = q._value, k._value, v._value
@@ -598,6 +607,10 @@ def fused_attention(q, k, v, sparse_mask, key_padding_mask=None,
     mb = _as_coo(sparse_mask)._value
     mask = mb.todense() != 0
     mask = jnp.broadcast_to(mask, logits.shape)
+    if key_padding_mask is not None:
+        kp = key_padding_mask._value if isinstance(key_padding_mask, Tensor) \
+            else jnp.asarray(key_padding_mask)
+        mask = mask & (kp != 0)[:, None, None, :]
     if attn_mask is not None:
         logits = logits + attn_mask._value.astype(jnp.float32)
     logits = jnp.where(mask, logits, -jnp.inf)

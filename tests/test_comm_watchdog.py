@@ -29,6 +29,15 @@ def _free_port():
 # ------------------------------------------------------------- unit level
 
 
+@pytest.fixture(autouse=True)
+def _fresh_watchdog():
+    from paddle_tpu.distributed import watchdog
+
+    watchdog.reset_poison()
+    yield
+    watchdog.reset_poison()
+
+
 def test_watchdog_times_out_a_stuck_call():
     from paddle_tpu.distributed.watchdog import (
         CommTimeoutError,
@@ -39,6 +48,21 @@ def test_watchdog_times_out_a_stuck_call():
     with pytest.raises(CommTimeoutError):
         run_with_watchdog(lambda: time.sleep(60), timeout=1.0, desc="stuck")
     assert time.monotonic() - t0 < 10
+
+
+def test_watchdog_poisons_subsequent_collectives():
+    """After a timeout the blocked thread may later consume a peer's op —
+    retrying would desync collective ordering, so the communicator refuses
+    further work (NCCL comm-abort semantics)."""
+    from paddle_tpu.distributed.watchdog import (
+        CommTimeoutError,
+        run_with_watchdog,
+    )
+
+    with pytest.raises(CommTimeoutError):
+        run_with_watchdog(lambda: time.sleep(60), timeout=1.0, desc="first")
+    with pytest.raises(CommTimeoutError, match="poisoned"):
+        run_with_watchdog(lambda: 1, timeout=5.0, desc="second")
 
 
 def test_watchdog_passes_results_and_errors_through():

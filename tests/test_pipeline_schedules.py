@@ -369,3 +369,41 @@ def test_pipeline_layer_train_batch_matches_single():
     for p, q in zip(pl.parameters(), ref.parameters()):
         np.testing.assert_allclose(p.numpy(), q.numpy(), rtol=1e-4,
                                    atol=1e-5)
+
+
+def test_hetero_train_batch_shards_exclusive_params():
+    """VERDICT r3 #10: the heterogeneous PipelineLayer path must NOT
+    replicate stage weights — each device holds only its own stage's flat
+    buffer (1/S of the exclusive total, up to padding) plus the shared
+    (tied) params, which replicate by design like the reference's
+    SharedLayerDesc pair."""
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+    import paddle_tpu.optimizer as opt
+    from paddle_tpu.distributed.fleet.pipeline import (
+        LayerDesc,
+        PipelineLayer,
+    )
+
+    rng = np.random.default_rng(3)
+    D = 16
+    xs = rng.normal(size=(M, D)).astype(np.float32)
+    ys = rng.normal(size=(M, D)).astype(np.float32)
+    paddle.framework.random.seed(5)
+    descs = [LayerDesc(nn.Linear, in_features=D, out_features=D)
+             for _ in range(S)]
+    pl = PipelineLayer(descs, num_stages=S, loss_fn=nn.MSELoss())
+    o = opt.SGD(learning_rate=0.05, parameters=pl.parameters())
+    loss = pl.train_batch((paddle.to_tensor(xs), paddle.to_tensor(ys)), o,
+                          mesh=_mesh(), num_microbatches=4)
+    assert np.isfinite(float(loss.numpy()))
+
+    lay = pl._last_param_layout
+    total = lay["exclusive_total"] * 4
+    per_dev = lay["per_device_bytes"]
+    # per-device exclusive bytes ~= total/S (equal stages here: exact)
+    assert per_dev * S <= total * 1.25, lay
+    assert per_dev <= total // S + 4 * 128, lay
+    assert lay["stacked_spec"] == ("pp",)
+    # no shared layers in this model
+    assert lay["shared_bytes"] == 0

@@ -203,3 +203,45 @@ def test_executor_declines_generators_directly():
     except BytecodeUnsupported:
         raised = True
     assert raised
+
+
+def test_unknown_tensor_attr_is_a_break_not_a_decline():
+    """Reading a non-metadata tensor attribute mid-frame materializes the
+    tensor (graph break) instead of declining the frame — a decline after
+    side effects would re-run them through the fallback tier (review r3)."""
+    calls = []
+
+    def fn(x):
+        calls.append(1)          # python side effect
+        y = x + 1.0
+        g = y.grad               # unknown attr -> break, NOT decline
+        return y * 2.0 if g is None else y
+
+    w = symbolic_translate(fn)
+    x = t([1.0, 2.0])
+    out = w(x)
+    np.testing.assert_allclose(out.numpy(), (np.asarray([1.0, 2.0]) + 1) * 2)
+    assert len(calls) == 1, "side effect must run exactly once"
+    st = sot_stats(w)
+    assert st["bytecode"] and st["bytecode_breaks"] >= 1
+
+
+def test_user_exception_propagates_once():
+    calls = []
+
+    def boom():
+        calls.append(1)
+        raise ValueError("user error")
+
+    def fn(x):
+        boom()
+        return x
+
+    w = symbolic_translate(fn)
+    try:
+        w(t([1.0]))
+        raised = False
+    except ValueError:
+        raised = True
+    assert raised
+    assert len(calls) == 1, "user code must not be re-executed by a fallback"

@@ -229,3 +229,30 @@ def test_sparse_fused_attention(rng):
     p = np.exp(logits - logits.max(-1, keepdims=True))
     p = p / p.sum(-1, keepdims=True)
     np.testing.assert_allclose(out, p @ v.numpy(), rtol=1e-4, atol=1e-5)
+
+
+def test_divide_same_pattern_no_nan_densification():
+    """divide(sparse, sparse) must not turn implicit zeros into stored
+    NaNs (0/0 at every empty position) — review r3 finding."""
+    x = sp.sparse_coo_tensor([[0], [0]], [2.0], shape=[2, 2])
+    out = sp.divide(x, x)
+    got = out.numpy()
+    assert got[0, 0] == pytest.approx(1.0)
+    assert not np.isnan(got).any()
+    assert out.nnz() <= 2  # no NaN densification
+
+
+def test_fused_attention_key_padding_mask(rng):
+    B, H, S, D = 1, 1, 4, 8
+    q = paddle.to_tensor(rng.normal(size=(B, H, S, D)).astype(np.float32))
+    k = paddle.to_tensor(rng.normal(size=(B, H, S, D)).astype(np.float32))
+    v = paddle.to_tensor(rng.normal(size=(B, H, S, D)).astype(np.float32))
+    full = sp.to_sparse_coo(paddle.to_tensor(np.ones((S, S), np.float32)))
+    kp = paddle.to_tensor(np.asarray([[1, 1, 0, 0]], np.float32))
+    out = sp.fused_attention(q, k, v, full, key_padding_mask=kp).numpy()
+    # reference: softmax over the first two keys only
+    logits = (q.numpy() @ np.swapaxes(k.numpy(), -1, -2)) / np.sqrt(D)
+    logits[..., 2:] = -np.inf
+    p = np.exp(logits - logits.max(-1, keepdims=True))
+    p = p / p.sum(-1, keepdims=True)
+    np.testing.assert_allclose(out, p @ v.numpy(), rtol=1e-4, atol=1e-5)
