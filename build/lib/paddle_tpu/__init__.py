@@ -1,0 +1,171 @@
+"""paddle_tpu: a TPU-native deep-learning framework with PaddlePaddle's
+capability surface, built on JAX/XLA/Pallas/pjit.
+
+Top-level namespace mirrors ``paddle.*`` (python/paddle/__init__.py in the
+reference): tensor factories and math at the root, with nn / optimizer / io /
+jit / distributed / amp / autograd subpackages.
+"""
+
+from __future__ import annotations
+
+import os as _os
+import sys as _sys
+
+# Multi-process bring-up MUST precede any jax backend use (jax.distributed's
+# hard requirement), so when the launcher's rendezvous env is present the
+# coordination service starts here — before anything below touches jax.
+# (Reference analogue: init_parallel_env's TCPStore bootstrap,
+# python/paddle/distributed/parallel.py:1101; on TPU pods jax.distributed IS
+# the coordination service.)
+if (_os.environ.get("JAX_COORDINATOR_ADDRESS")
+        and int(_os.environ.get("JAX_NUM_PROCESSES", "1")) > 1):
+    import jax as _jax
+
+    try:
+        _jax.distributed.initialize(
+            coordinator_address=_os.environ["JAX_COORDINATOR_ADDRESS"],
+            num_processes=int(_os.environ["JAX_NUM_PROCESSES"]),
+            process_id=int(_os.environ.get("JAX_PROCESS_ID", "0")),
+        )
+    except RuntimeError as _e:
+        # tolerate ONLY double-initialization; rendezvous failures and
+        # "backend already used" must surface — swallowing them would let N
+        # trainers run as silent singletons
+        if "only be called once" not in str(_e):
+            raise
+
+from paddle_tpu.framework import dtype as _dtype_mod
+from paddle_tpu.framework.dtype import (  # noqa: F401
+    bfloat16,
+    bool_,
+    complex64,
+    complex128,
+    float8_e4m3fn,
+    float8_e5m2,
+    float16,
+    float32,
+    float64,
+    int8,
+    int16,
+    int32,
+    int64,
+    uint8,
+    uint16,
+    uint32,
+    uint64,
+)
+from paddle_tpu.framework.random import get_rng_state, seed, set_rng_state  # noqa: F401
+from paddle_tpu.tensor import Parameter, Tensor, to_tensor  # noqa: F401
+from paddle_tpu.autograd import (  # noqa: F401
+    enable_grad,
+    grad,
+    is_grad_enabled,
+    no_grad,
+    set_grad_enabled,
+)
+
+# ops must import after Tensor so method patching runs
+from paddle_tpu import ops as _ops  # noqa: E402
+from paddle_tpu.ops import creation as _creation  # noqa: E402
+from paddle_tpu.ops import registry as _registry  # noqa: F401,E402
+
+_THIS = _sys.modules[__name__]
+
+# Re-export every registered op at the top level (paddle.add, paddle.matmul, ...)
+for _ns in (_ops.math, _ops.creation, _ops.manipulation, _ops.reduction,
+            _ops.comparison, _ops.linalg):
+    for _name in dir(_ns):
+        if _name.startswith("_"):
+            continue
+        _fn = getattr(_ns, _name)
+        if callable(_fn) and not hasattr(_THIS, _name):
+            setattr(_THIS, _name, _fn)
+
+# Subpackages (imported lazily to keep startup fast and avoid cycles)
+from paddle_tpu import nn  # noqa: E402,F401
+from paddle_tpu import optimizer  # noqa: E402,F401
+from paddle_tpu import io  # noqa: E402,F401
+from paddle_tpu import amp  # noqa: E402,F401
+from paddle_tpu import jit  # noqa: E402,F401
+from paddle_tpu import autograd  # noqa: E402,F401
+from paddle_tpu import device  # noqa: E402,F401
+from paddle_tpu import metric  # noqa: E402,F401
+from paddle_tpu import vision  # noqa: E402,F401
+from paddle_tpu import hapi  # noqa: E402,F401
+from paddle_tpu.hapi.model import Model  # noqa: E402,F401
+from paddle_tpu import profiler  # noqa: E402,F401
+from paddle_tpu import fft  # noqa: E402,F401
+from paddle_tpu import distribution  # noqa: E402,F401
+from paddle_tpu import sparse  # noqa: E402,F401
+from paddle_tpu import quantization  # noqa: E402,F401
+from paddle_tpu import static  # noqa: E402,F401
+from paddle_tpu import hub  # noqa: E402,F401
+from paddle_tpu import text  # noqa: E402,F401
+from paddle_tpu import audio  # noqa: E402,F401
+from paddle_tpu import onnx  # noqa: E402,F401
+from paddle_tpu import inference  # noqa: E402,F401
+from paddle_tpu.ops import linalg  # noqa: E402,F401
+from paddle_tpu import utils  # noqa: E402,F401
+from paddle_tpu.framework.flags import get_flags, set_flags  # noqa: E402,F401
+from paddle_tpu.framework.io import load, save  # noqa: E402,F401
+from paddle_tpu.framework.tensor_array import (  # noqa: E402,F401
+    TensorArray,
+    array_length,
+    array_read,
+    array_write,
+    create_array,
+)
+from paddle_tpu.ops import parity as _op_parity  # noqa: E402,F401  (registers ref-named ops)
+
+__version__ = "0.1.0"
+
+
+def disable_static():  # paddle parity: we are always dygraph-first
+    pass
+
+
+def enable_static():
+    raise NotImplementedError(
+        "paddle_tpu is dygraph-first; use paddle_tpu.jit.to_static for graphs"
+    )
+
+
+def is_compiled_with_cuda() -> bool:
+    return False
+
+
+def is_compiled_with_xpu() -> bool:
+    return False
+
+
+def is_compiled_with_tpu() -> bool:
+    import jax
+
+    from paddle_tpu.device import is_tpu_like
+
+    return any(is_tpu_like(d) for d in jax.devices())
+
+
+def set_default_dtype(d):
+    from paddle_tpu.framework import dtype as dt
+
+    dt._default_dtype = dt.convert_dtype(d)
+
+
+def get_default_dtype():
+    from paddle_tpu.framework import dtype as dt
+
+    return getattr(dt, "_default_dtype", dt.float32)
+
+
+def set_device(device_str: str):
+    """paddle.device.set_device parity — placement is sharding-driven on TPU;
+    this only validates the name."""
+    return device_str
+
+
+def get_device() -> str:
+    import jax
+
+    d = jax.devices()[0]
+    return f"{d.platform}:{d.id}"

@@ -1,0 +1,20 @@
+"""AMP op lists (parity: python/paddle/amp/amp_lists.py:30-108).
+
+White list: ops that are numerically safe and fast in low precision (MXU ops).
+Black list: ops that must stay fp32. Everything else runs in the incoming dtype.
+"""
+
+WHITE_LIST = {
+    "conv1d", "conv2d", "conv3d", "conv2d_transpose",
+    "matmul", "mm", "bmm", "mv", "addmm", "linear",
+    "einsum", "scaled_dot_product_attention",
+}
+
+BLACK_LIST = {
+    "exp", "square", "log", "log2", "log10", "log1p", "mean", "sum", "softmax",
+    "log_softmax", "cross_entropy", "softmax_with_cross_entropy", "nll_loss",
+    "binary_cross_entropy", "bce_with_logits", "kl_div", "cosine_similarity",
+    "layer_norm", "rms_norm", "batch_norm", "group_norm", "instance_norm",
+    "norm", "dist", "logsumexp", "logcumsumexp", "erfinv", "pow",
+    "cumsum", "cumprod", "var", "std", "mse_loss", "l1_loss", "smooth_l1_loss",
+}

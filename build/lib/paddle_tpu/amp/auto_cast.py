@@ -1,0 +1,81 @@
+"""AMP autocast (parity: python/paddle/amp/auto_cast.py:383 amp_guard).
+
+O1: per-op cast driven by white/black lists (the reference enforces this in the
+generated ad_funcs, eager_gen.py:1885; here the dispatch layer consults the amp
+state). O2: cast the whole model's params to the amp dtype with fp32 master
+weights in the optimizer. On TPU the amp dtype of choice is bfloat16 — no loss
+scaling needed, which is why GradScaler defaults to a no-op for bf16.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+
+import jax.numpy as jnp
+
+from paddle_tpu.amp import amp_lists
+from paddle_tpu.framework import dtype as dtypes
+
+
+class _AmpState(threading.local):
+    def __init__(self):
+        self.enabled = False
+        self.dtype = jnp.bfloat16
+        self.level = "O1"
+        self.white_list = amp_lists.WHITE_LIST
+        self.black_list = amp_lists.BLACK_LIST
+
+
+_state = _AmpState()
+
+
+def amp_state() -> _AmpState:
+    return _state
+
+
+@contextlib.contextmanager
+def auto_cast(enable=True, custom_white_list=None, custom_black_list=None,
+              level="O1", dtype="bfloat16", use_promote=True):
+    """paddle.amp.auto_cast parity."""
+    prev = (_state.enabled, _state.dtype, _state.level, _state.white_list,
+            _state.black_list)
+    _state.enabled = enable
+    _state.dtype = dtypes.convert_dtype(dtype)
+    _state.level = level
+    white = set(amp_lists.WHITE_LIST)
+    black = set(amp_lists.BLACK_LIST)
+    if custom_white_list:
+        white |= set(custom_white_list)
+        black -= set(custom_white_list)
+    if custom_black_list:
+        black |= set(custom_black_list)
+        white -= set(custom_black_list)
+    _state.white_list = white
+    _state.black_list = black
+    try:
+        yield
+    finally:
+        (_state.enabled, _state.dtype, _state.level, _state.white_list,
+         _state.black_list) = prev
+
+
+amp_guard = auto_cast
+
+
+def decorate(models, optimizers=None, level="O2", dtype="bfloat16",
+             master_weight=None, save_dtype=None):
+    """paddle.amp.decorate parity: O2 casts model params to the amp dtype."""
+    single_model = not isinstance(models, (list, tuple))
+    model_list = [models] if single_model else list(models)
+    if level == "O2":
+        for m in model_list:
+            m.to(dtype=dtype)
+        if optimizers is not None:
+            opt_list = [optimizers] if not isinstance(optimizers, (list, tuple)) \
+                else list(optimizers)
+            for opt in opt_list:
+                opt._multi_precision = True
+    if optimizers is None:
+        return models
+    return models, optimizers

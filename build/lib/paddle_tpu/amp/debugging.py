@@ -1,0 +1,65 @@
+"""Numerics debugging (parity: python/paddle/amp/debugging.py + the
+FLAGS_check_nan_inf machinery, program_interpreter.cc:1131 /
+eager/nan_inf_utils.h:38).
+
+TPU-native: per-op NaN/Inf checks hook the same dispatch seam the tape uses;
+under jit, jax.debug/checkify covers the compiled path.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+
+import jax.numpy as jnp
+
+
+class _DebugState:
+    """Process-global (the reference's FLAGS_check_nan_inf is a process-wide
+    flag, not per-thread)."""
+
+    def __init__(self):
+        self.check_nan_inf = False
+
+
+_state = _DebugState()
+
+
+def enable_operator_stats_collection():
+    _state.check_nan_inf = True
+
+
+def disable_operator_stats_collection():
+    _state.check_nan_inf = False
+
+
+def check_numerics_enabled() -> bool:
+    return _state.check_nan_inf
+
+
+@contextlib.contextmanager
+def collect_operator_stats():
+    enable_operator_stats_collection()
+    try:
+        yield
+    finally:
+        disable_operator_stats_collection()
+
+
+def check_numerics(tensor, op_name: str = ""):
+    """Raise if tensor contains NaN/Inf (eager check). Under tracing the
+    value is abstract — the compiled-path checkify instrumentation
+    (jit/api.py) covers it instead."""
+    import jax
+
+    from paddle_tpu.tensor import Tensor
+
+    val = tensor._value if isinstance(tensor, Tensor) else tensor
+    if isinstance(val, jax.core.Tracer):
+        return tensor
+    if jnp.issubdtype(val.dtype, jnp.inexact):
+        if not bool(jnp.all(jnp.isfinite(val))):
+            raise FloatingPointError(
+                f"NaN or Inf detected in output of op '{op_name}'"
+            )
+    return tensor

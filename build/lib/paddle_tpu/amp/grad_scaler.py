@@ -1,0 +1,129 @@
+"""Loss scaling (parity: python/paddle/amp/grad_scaler.py:619 GradScaler).
+
+Dynamic loss scaling for fp16; bf16 on TPU has fp32's exponent range so scaling
+degenerates to identity (matching the reference's recommendation)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.tensor import Tensor
+
+
+class GradScaler:
+    def __init__(self, enable=True, init_loss_scaling=2.0**15, incr_ratio=2.0,
+                 decr_ratio=0.5, incr_every_n_steps=1000,
+                 decr_every_n_nan_or_inf=2, use_dynamic_loss_scaling=True):
+        self._enable = enable
+        self._scale = float(init_loss_scaling)
+        self._incr_ratio = incr_ratio
+        self._decr_ratio = decr_ratio
+        self._incr_every_n_steps = incr_every_n_steps
+        self._decr_every_n = decr_every_n_nan_or_inf
+        self._dynamic = use_dynamic_loss_scaling
+        self._good_steps = 0
+        self._bad_steps = 0
+        self._found_inf = False
+        self._unscaled = False
+
+    def is_enable(self):
+        return self._enable
+
+    def is_use_dynamic_loss_scaling(self):
+        return self._dynamic
+
+    def get_loss_scaling(self):
+        return self._scale
+
+    def scale(self, var):
+        if not self._enable:
+            return var
+        # get_loss_scaling() is the sync point when a jitted TrainStep holds
+        # the authoritative device-side state
+        return var * self.get_loss_scaling()
+
+    def unscale_(self, optimizer):
+        if not self._enable or self._unscaled:
+            return
+        inv = 1.0 / self.get_loss_scaling()
+        # found_inf stays DEVICE-SIDE: one fused reduction across all grads,
+        # no host sync per parameter (reference keeps found_inf on device,
+        # python/paddle/amp/grad_scaler.py:619; the old per-param bool() was
+        # a host round-trip per tensor per step)
+        found = None
+        for p in optimizer._parameter_list:
+            if p._grad is not None:
+                g = p._grad.astype(jnp.float32) * inv
+                chunk = ~jnp.all(jnp.isfinite(g))
+                found = chunk if found is None else (found | chunk)
+                p._grad = g.astype(p._grad.dtype)
+        self._found_inf_device = (found if found is not None
+                                  else jnp.asarray(False))
+        self._unscaled = True
+
+    @property
+    def _found_inf(self):
+        # host materialization happens HERE, once, at the decision point
+        dev = getattr(self, "_found_inf_device", None)
+        return bool(dev) if dev is not None else False
+
+    @_found_inf.setter
+    def _found_inf(self, v):
+        # plain python bool — no device work for construction/reset paths
+        self._found_inf_device = bool(v)
+
+    def step(self, optimizer):
+        if not self._enable:
+            optimizer.step()
+            return
+        if not self._unscaled:
+            self.unscale_(optimizer)
+        if not self._found_inf:
+            optimizer.step()
+
+    def update(self):
+        if not self._enable or not self._dynamic:
+            self._unscaled = False
+            return
+        if self._found_inf:
+            self._bad_steps += 1
+            self._good_steps = 0
+            if self._bad_steps >= self._decr_every_n:
+                self._scale = max(self._scale * self._decr_ratio, 1.0)
+                self._bad_steps = 0
+        else:
+            self._good_steps += 1
+            self._bad_steps = 0
+            if self._good_steps >= self._incr_every_n_steps:
+                self._scale *= self._incr_ratio
+                self._good_steps = 0
+        self._found_inf = False
+        self._unscaled = False
+
+    def minimize(self, optimizer, scaled_loss):
+        scaled_loss.backward()
+        self.step(optimizer)
+        self.update()
+
+    def state_dict(self):
+        self.get_loss_scaling()  # sync device-side state if a TrainStep owns it
+        return {
+            "scale": self._scale,
+            "incr_ratio": self._incr_ratio,
+            "decr_ratio": self._decr_ratio,
+            "incr_every_n_steps": self._incr_every_n_steps,
+            "decr_every_n_nan_or_inf": self._decr_every_n,
+            "good_steps": self._good_steps,
+            "bad_steps": self._bad_steps,
+        }
+
+    def load_state_dict(self, state_dict):
+        self._scale = state_dict.get("scale", self._scale)
+        self._good_steps = state_dict.get("good_steps", 0)
+        self._bad_steps = state_dict.get("bad_steps", 0)
+
+    set_state_dict = load_state_dict
+
+
+AmpScaler = GradScaler

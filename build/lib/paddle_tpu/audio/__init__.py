@@ -1,0 +1,10 @@
+"""paddle.audio parity (reference: python/paddle/audio/ — features/
+Spectrogram, MelSpectrogram, LogMelSpectrogram, MFCC; functional window/mel
+utilities).
+
+All transforms are jnp compositions (frame -> window -> rFFT -> mel filter
+matmul) so they lower to XLA and run on the accelerator inside training
+pipelines."""
+
+from paddle_tpu.audio import features  # noqa: F401
+from paddle_tpu.audio import functional  # noqa: F401

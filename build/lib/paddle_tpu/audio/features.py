@@ -1,0 +1,109 @@
+"""Audio feature layers (reference: python/paddle/audio/features/layers.py —
+Spectrogram, MelSpectrogram, LogMelSpectrogram, MFCC)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+import paddle_tpu.nn as nn
+from paddle_tpu.audio import functional as AF
+from paddle_tpu.core.dispatch import apply
+from paddle_tpu.tensor import Tensor
+
+
+def _stft(x, n_fft, hop_length, win, center, pad_mode):
+    """x [..., T] -> complex [..., n_fft//2+1, frames]."""
+    if center:
+        pad = [(0, 0)] * (x.ndim - 1) + [(n_fft // 2, n_fft // 2)]
+        x = jnp.pad(x, pad, mode=pad_mode)
+    T = x.shape[-1]
+    n_frames = 1 + (T - n_fft) // hop_length
+    idx = (jnp.arange(n_frames)[:, None] * hop_length
+           + jnp.arange(n_fft)[None, :])
+    frames = x[..., idx]  # [..., frames, n_fft]
+    frames = frames * win
+    spec = jnp.fft.rfft(frames, axis=-1)  # [..., frames, n_fft//2+1]
+    return jnp.swapaxes(spec, -1, -2)
+
+
+class Spectrogram(nn.Layer):
+    def __init__(self, n_fft=512, hop_length=None, win_length=None,
+                 window="hann", power=2.0, center=True, pad_mode="reflect",
+                 dtype="float32"):
+        super().__init__()
+        self.n_fft = n_fft
+        self.hop_length = hop_length or n_fft // 4
+        win_length = win_length or n_fft
+        w = AF.get_window(window, win_length, dtype=dtype)._value
+        if win_length < n_fft:  # zero-pad window to n_fft
+            pad = (n_fft - win_length) // 2
+            w = jnp.pad(w, (pad, n_fft - win_length - pad))
+        self.window = w
+        self.power = power
+        self.center = center
+        self.pad_mode = pad_mode
+
+    def forward(self, x):
+        def f(v):
+            spec = _stft(v, self.n_fft, self.hop_length, self.window,
+                         self.center, self.pad_mode)
+            return jnp.abs(spec) ** self.power
+
+        return apply("spectrogram", f, x)
+
+
+class MelSpectrogram(nn.Layer):
+    def __init__(self, sr=22050, n_fft=512, hop_length=None, win_length=None,
+                 window="hann", power=2.0, center=True, pad_mode="reflect",
+                 n_mels=64, f_min=50.0, f_max=None, htk=False, norm="slaney",
+                 dtype="float32"):
+        super().__init__()
+        self.spectrogram = Spectrogram(n_fft, hop_length, win_length, window,
+                                       power, center, pad_mode, dtype)
+        self.fbank = AF.compute_fbank_matrix(
+            sr, n_fft, n_mels, f_min, f_max, htk, norm, dtype)._value
+
+    def forward(self, x):
+        spec = self.spectrogram(x)
+        return apply("mel_spectrogram",
+                     lambda s: jnp.einsum("mf,...ft->...mt", self.fbank, s),
+                     spec)
+
+
+class LogMelSpectrogram(nn.Layer):
+    def __init__(self, sr=22050, n_fft=512, hop_length=None, win_length=None,
+                 window="hann", power=2.0, center=True, pad_mode="reflect",
+                 n_mels=64, f_min=50.0, f_max=None, htk=False, norm="slaney",
+                 ref_value=1.0, amin=1e-10, top_db=None, dtype="float32"):
+        super().__init__()
+        self.mel = MelSpectrogram(sr, n_fft, hop_length, win_length, window,
+                                  power, center, pad_mode, n_mels, f_min,
+                                  f_max, htk, norm, dtype)
+        self.ref_value = ref_value
+        self.amin = amin
+        self.top_db = top_db
+
+    def forward(self, x):
+        return AF.power_to_db(self.mel(x), self.ref_value, self.amin,
+                              self.top_db)
+
+
+class MFCC(nn.Layer):
+    def __init__(self, sr=22050, n_mfcc=40, n_fft=512, hop_length=None,
+                 win_length=None, window="hann", power=2.0, center=True,
+                 pad_mode="reflect", n_mels=64, f_min=50.0, f_max=None,
+                 htk=False, norm="slaney", ref_value=1.0, amin=1e-10,
+                 top_db=None, dtype="float32"):
+        super().__init__()
+        self.log_mel = LogMelSpectrogram(
+            sr, n_fft, hop_length, win_length, window, power, center,
+            pad_mode, n_mels, f_min, f_max, htk, norm, ref_value, amin,
+            top_db, dtype)
+        self.dct = AF.create_dct(n_mfcc, n_mels, dtype=dtype)._value
+
+    def forward(self, x):
+        lm = self.log_mel(x)
+        # dct: [n_mels, n_mfcc]; log-mel: [..., n_mels, frames]
+        return apply("mfcc",
+                     lambda s: jnp.einsum("nk,...nt->...kt", self.dct, s),
+                     lm)

@@ -1,0 +1,110 @@
+"""Audio functional utilities (reference: python/paddle/audio/functional/ —
+window functions window.py, mel utilities functional.py)."""
+
+from __future__ import annotations
+
+import math
+
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.tensor import Tensor
+
+
+def get_window(window: str, win_length: int, fftbins: bool = True,
+               dtype: str = "float64"):
+    """functional.get_window parity (hann/hamming/blackman/bohman/kaiser...)."""
+    N = win_length if not fftbins else win_length + 1
+    n = np.arange(N)
+    if window in ("hann", "hanning"):
+        w = 0.5 - 0.5 * np.cos(2 * np.pi * n / (N - 1))
+    elif window == "hamming":
+        w = 0.54 - 0.46 * np.cos(2 * np.pi * n / (N - 1))
+    elif window == "blackman":
+        w = (0.42 - 0.5 * np.cos(2 * np.pi * n / (N - 1))
+             + 0.08 * np.cos(4 * np.pi * n / (N - 1)))
+    elif window in ("rect", "boxcar", "rectangular"):
+        w = np.ones(N)
+    elif window == "bartlett":
+        w = 1 - np.abs(2 * n / (N - 1) - 1)
+    else:
+        raise ValueError(f"unsupported window {window!r}")
+    if fftbins:
+        w = w[:-1]
+    return Tensor._from_value(jnp.asarray(w.astype(np.dtype(dtype))))
+
+
+def hz_to_mel(freq, htk: bool = False):
+    f = np.asarray(freq, dtype=np.float64)
+    if htk:
+        return 2595.0 * np.log10(1.0 + f / 700.0)
+    # slaney
+    f_min, f_sp = 0.0, 200.0 / 3
+    mel = (f - f_min) / f_sp
+    min_log_hz = 1000.0
+    min_log_mel = (min_log_hz - f_min) / f_sp
+    logstep = math.log(6.4) / 27.0
+    return np.where(f >= min_log_hz,
+                    min_log_mel + np.log(np.maximum(f, 1e-10) / min_log_hz) / logstep,
+                    mel)
+
+
+def mel_to_hz(mel, htk: bool = False):
+    m = np.asarray(mel, dtype=np.float64)
+    if htk:
+        return 700.0 * (10.0 ** (m / 2595.0) - 1.0)
+    f_min, f_sp = 0.0, 200.0 / 3
+    freqs = f_min + f_sp * m
+    min_log_hz = 1000.0
+    min_log_mel = (min_log_hz - f_min) / f_sp
+    logstep = math.log(6.4) / 27.0
+    return np.where(m >= min_log_mel,
+                    min_log_hz * np.exp(logstep * (m - min_log_mel)), freqs)
+
+
+def mel_frequencies(n_mels: int, f_min: float, f_max: float, htk: bool = False):
+    mels = np.linspace(hz_to_mel(f_min, htk), hz_to_mel(f_max, htk), n_mels)
+    return mel_to_hz(mels, htk)
+
+
+def compute_fbank_matrix(sr: int, n_fft: int, n_mels: int = 64,
+                         f_min: float = 0.0, f_max=None, htk: bool = False,
+                         norm: str = "slaney", dtype: str = "float32"):
+    """Triangular mel filterbank [n_mels, n_fft//2+1] (functional parity)."""
+    f_max = f_max or sr / 2.0
+    fft_freqs = np.linspace(0, sr / 2.0, n_fft // 2 + 1)
+    mel_f = mel_frequencies(n_mels + 2, f_min, f_max, htk)
+    fdiff = np.diff(mel_f)
+    ramps = mel_f[:, None] - fft_freqs[None, :]
+    lower = -ramps[:-2] / fdiff[:-1, None]
+    upper = ramps[2:] / fdiff[1:, None]
+    fb = np.maximum(0, np.minimum(lower, upper))
+    if norm == "slaney":
+        enorm = 2.0 / (mel_f[2: n_mels + 2] - mel_f[:n_mels])
+        fb *= enorm[:, None]
+    return Tensor._from_value(jnp.asarray(fb.astype(np.dtype(dtype))))
+
+
+def create_dct(n_mfcc: int, n_mels: int, norm: str = "ortho",
+               dtype: str = "float32"):
+    n = np.arange(n_mels)
+    k = np.arange(n_mfcc)[:, None]
+    dct = np.cos(np.pi / n_mels * (n + 0.5) * k)
+    if norm == "ortho":
+        dct[0] *= 1.0 / math.sqrt(2)
+        dct *= math.sqrt(2.0 / n_mels)
+    return Tensor._from_value(jnp.asarray(dct.T.astype(np.dtype(dtype))))
+
+
+def power_to_db(spect, ref_value: float = 1.0, amin: float = 1e-10,
+                top_db=80.0):
+    from paddle_tpu.core.dispatch import apply
+
+    def f(s):
+        log_spec = 10.0 * jnp.log10(jnp.maximum(s, amin))
+        log_spec = log_spec - 10.0 * math.log10(max(ref_value, amin))
+        if top_db is not None:
+            log_spec = jnp.maximum(log_spec, log_spec.max() - top_db)
+        return log_spec
+
+    return apply("power_to_db", f, spect)

@@ -1,0 +1,26 @@
+from paddle_tpu.autograd.functional import (  # noqa: F401
+    hessian,
+    jacobian,
+    jvp,
+    vhp,
+    vjp,
+)
+from paddle_tpu.autograd.py_layer import (  # noqa: F401
+    LegacyPyLayer,
+    PyLayer,
+    PyLayerContext,
+)
+from paddle_tpu.autograd.tape import (  # noqa: F401
+    TapeNode,
+    enable_grad,
+    grad,
+    is_grad_enabled,
+    no_grad,
+    run_backward,
+    set_grad_enabled,
+)
+
+
+def backward(tensors, grad_tensors=None, retain_graph=False):
+    """paddle.autograd.backward parity."""
+    run_backward(tensors, grad_tensors, retain_graph=retain_graph)

@@ -1,0 +1,133 @@
+"""Functional higher-order autograd (parity: paddle.incubate.autograd /
+paddle.autograd functional API — jacobian, hessian, jvp, vjp, vhp; reference
+python/paddle/autograd/functional.py + incubate/autograd/primapi.py).
+
+TPU-native: these ARE jax transforms. The tape covers first-order
+define-by-run; for higher-order the user supplies a pure function over
+Tensors and jax.jacfwd/jacrev/jvp/vjp compose arbitrarily (the reference
+needed the prim/composite-VJP machinery for this — SURVEY §2.2)."""
+
+from __future__ import annotations
+
+from typing import Callable, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.autograd import tape
+
+
+def _Tensor():
+    # lazy: tensor.py imports autograd at module load (tape), so importing
+    # Tensor at this module's top level would be circular
+    from paddle_tpu.tensor import Tensor
+
+    return Tensor
+
+
+def _as_list(x):
+    return list(x) if isinstance(x, (list, tuple)) else [x]
+
+
+def _pure(func):
+    """Lift a Tensor->Tensor callable to a pure jax function (runs the tape
+    machinery under trace; gradient state is not mutated)."""
+
+    def fn(*vals):
+        with tape.no_grad():
+            ins = [_Tensor()._from_value(v) for v in vals]
+            out = func(*ins)
+        if isinstance(out, (list, tuple)):
+            outs = [o._value for o in out]
+            return outs[0] if len(outs) == 1 else tuple(outs)
+        return out._value
+
+    return fn
+
+
+def _vals(xs):
+    return [x._value if isinstance(x, _Tensor()) else jnp.asarray(x)
+            for x in _as_list(xs)]
+
+
+def _wrap(tree):
+    return jax.tree_util.tree_map(_Tensor()._from_value, tree)
+
+
+def jacobian(func: Callable, xs, create_graph=False, allow_unused=False,
+             batch_axis=None):
+    """paddle.autograd.jacobian parity (reverse mode)."""
+    vals = _vals(xs)
+    fn = _pure(func)
+    jac = jax.jacrev(fn, argnums=tuple(range(len(vals))))(*vals)
+    out = _wrap(jac)
+    if not isinstance(xs, (list, tuple)):
+        return out[0] if isinstance(out, tuple) else out
+    return out
+
+
+def hessian(func: Callable, xs, create_graph=False, allow_unused=False,
+            batch_axis=None):
+    """paddle.autograd.hessian parity (forward-over-reverse)."""
+    vals = _vals(xs)
+    fn = _pure(func)
+    hes = jax.jacfwd(jax.jacrev(fn, argnums=tuple(range(len(vals)))),
+                     argnums=tuple(range(len(vals))))(*vals)
+    out = _wrap(hes)
+    if not isinstance(xs, (list, tuple)):
+        # single input: hessian is out[0][0]
+        return out[0][0] if isinstance(out, tuple) else out
+    return out
+
+
+def jvp(func: Callable, xs, v=None):
+    """Jacobian-vector product (forward mode)."""
+    vals = _vals(xs)
+    fn = _pure(func)
+    if v is None:
+        tangents = [jnp.ones_like(x) for x in vals]
+    else:
+        tangents = _vals(v)
+    primals_out, tangents_out = jax.jvp(fn, tuple(vals), tuple(tangents))
+    return _wrap(primals_out), _wrap(tangents_out)
+
+
+def vjp(func: Callable, xs, v=None):
+    """vector-Jacobian product (reverse mode)."""
+    vals = _vals(xs)
+    fn = _pure(func)
+    primals_out, vjp_fn = jax.vjp(fn, *vals)
+    if v is None:
+        cot = jax.tree_util.tree_map(jnp.ones_like, primals_out)
+    else:
+        cot_list = _vals(v)
+        cot = cot_list[0] if not isinstance(primals_out, tuple) else \
+            tuple(cot_list)
+    grads = vjp_fn(cot)
+    wrapped = _wrap(list(grads))
+    if not isinstance(xs, (list, tuple)):
+        wrapped = wrapped[0]
+    return _wrap(primals_out), wrapped
+
+
+def vhp(func: Callable, xs, v=None):
+    """vector-Hessian product: forward-over-reverse on a scalar func."""
+    vals = _vals(xs)
+    fn = _pure(func)
+
+    def val_and_grad(*args):
+        value, grads = jax.value_and_grad(
+            fn, argnums=tuple(range(len(vals))))(*args)
+        return grads, value
+
+    if v is None:
+        tangents = [jnp.ones_like(x) for x in vals]
+    else:
+        tangents = _vals(v)
+    # one trace yields the function value (aux primal) and the H·v tangents
+    (grads, func_out), (vhp_out, _) = jax.jvp(
+        val_and_grad, tuple(vals), tuple(tangents))
+    wrapped = _wrap(list(vhp_out))
+    if not isinstance(xs, (list, tuple)):
+        wrapped = wrapped[0]
+    return _wrap(func_out), wrapped

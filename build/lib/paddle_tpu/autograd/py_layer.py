@@ -1,0 +1,152 @@
+"""paddle.autograd.PyLayer — user-defined differentiable ops (parity:
+python/paddle/autograd/py_layer.py; C++ side pylayer GradNode in
+paddle/fluid/eager/pylayer/).
+
+TPU-native: forward runs eagerly (un-recorded); a TapeNode is registered whose
+vjp closure calls the user's ``backward``, so custom ops join the same reverse
+DAG as jax.vjp-derived nodes and trace cleanly inside jit-captured steps.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List
+
+from paddle_tpu.autograd import tape
+
+
+class PyLayerContext:
+    """ctx passed to forward/backward (paddle.autograd.PyLayerContext)."""
+
+    def __init__(self):
+        self._saved: List[Any] = []
+        self._non_diff_ids = set()
+        self.not_inplace_tensors = ()
+        self.materialize_grads = True
+
+    def save_for_backward(self, *tensors):
+        self._saved = list(tensors)
+
+    def saved_tensor(self):
+        return tuple(self._saved)
+
+    # paddle also exposes these knobs; accepted for API parity
+    def mark_not_inplace(self, *tensors):
+        self.not_inplace_tensors = tensors
+
+    def mark_non_differentiable(self, *tensors):
+        for t in tensors:
+            t.stop_gradient = True
+            self._non_diff_ids.add(id(t))
+
+    def set_materialize_grads(self, value: bool):
+        self.materialize_grads = bool(value)
+
+
+class PyLayerMeta(type):
+    pass
+
+
+class PyLayer(metaclass=PyLayerMeta):
+    """Subclass with @staticmethod forward(ctx, *args) / backward(ctx, *grads).
+
+    Usage (identical to paddle)::
+
+        class Cube(PyLayer):
+            @staticmethod
+            def forward(ctx, x):
+                ctx.save_for_backward(x)
+                return x * x * x
+            @staticmethod
+            def backward(ctx, dy):
+                x, = ctx.saved_tensor()
+                return 3 * x * x * dy
+
+        y = Cube.apply(x)
+    """
+
+    @staticmethod
+    def forward(ctx, *args, **kwargs):  # pragma: no cover - abstract
+        raise NotImplementedError
+
+    @staticmethod
+    def backward(ctx, *grads):  # pragma: no cover - abstract
+        raise NotImplementedError
+
+    @classmethod
+    def apply(cls, *args, **kwargs):
+        from paddle_tpu.tensor import Tensor
+
+        ctx = PyLayerContext()
+        in_tensors = [a for a in list(args) + list(kwargs.values())
+                      if isinstance(a, Tensor)]
+        needs_grad = tape.is_grad_enabled() and any(
+            not t.stop_gradient for t in in_tensors
+        )
+        # forward body is not recorded: its backward is user-supplied
+        with tape.no_grad():
+            out = cls.forward(ctx, *args, **kwargs)
+
+        is_tuple = isinstance(out, (tuple, list))
+        outs = list(out) if is_tuple else [out]
+        if not needs_grad:
+            return tuple(outs) if is_tuple else outs[0]
+
+        def vjp_fn(out_cot):
+            cots = out_cot if isinstance(out_cot, tuple) else (out_cot,)
+            wrapped = []
+            for c in cots:
+                t = Tensor._from_value(c)
+                t.stop_gradient = True
+                wrapped.append(t)
+            with tape.no_grad():
+                gin = cls.backward(ctx, *wrapped)
+            if not isinstance(gin, (tuple, list)):
+                gin = (gin,)
+            vals = []
+            for g in gin:
+                if g is None:
+                    vals.append(None)
+                elif isinstance(g, Tensor):
+                    vals.append(g._value)
+                else:
+                    vals.append(g)
+            return tuple(vals)
+
+        def diff_vjp(cot_tensors):
+            # create_graph path: re-run the user's backward with recording ON
+            # so the produced cotangents chain into saved input tensors'
+            # graphs (grad-of-grad through custom ops, PyTorch-style caveat:
+            # intermediates saved from the no-grad forward are constants)
+            gin = cls.backward(ctx, *cot_tensors)
+            if not isinstance(gin, (tuple, list)):
+                gin = (gin,)
+            out = []
+            for g in gin:
+                if g is None or isinstance(g, Tensor):
+                    out.append(g)
+                else:
+                    t = Tensor._from_value(g)
+                    t.stop_gradient = True
+                    out.append(t)
+            return out
+
+        node = tape.TapeNode(cls.__name__, vjp_fn, in_tensors, len(outs))
+        node.diff_vjp = diff_vjp
+        results = []
+        for i, o in enumerate(outs):
+            t = o if isinstance(o, Tensor) else Tensor._from_value(o)
+            node.register_output(i, t)
+            if id(o) in ctx._non_diff_ids:
+                # non-differentiable output: its cotangent zero-fills in
+                # backward from the registered aval
+                pass
+            else:
+                t.stop_gradient = False
+                t._node = node
+            results.append(t)
+        return tuple(results) if is_tuple else results[0]
+
+
+# legacy alias used by some paddle code
+class LegacyPyLayer(PyLayer):
+    pass

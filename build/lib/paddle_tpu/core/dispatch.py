@@ -1,0 +1,151 @@
+"""Eager op dispatch: the KernelFactory analogue, collapsed.
+
+The reference routes every eager op through generated ``*_ad_func`` C++
+(eager_gen.py) -> phi API -> KernelFactory::SelectKernelOrThrowError
+(paddle/phi/core/kernel_factory.h:316). On TPU there is exactly one backend —
+XLA — so dispatch collapses to: unwrap Tensors, call the jax function, wrap
+outputs, and (when gradients are required) record a TapeNode whose vjp closure
+is derived by ``jax.vjp``. Op identity/metadata lives in
+``paddle_tpu.ops.registry`` (the ops.yaml analogue).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.autograd import tape
+
+
+def _is_tensor(x) -> bool:
+    from paddle_tpu.tensor import Tensor
+
+    return isinstance(x, Tensor)
+
+
+def _amp_state():
+    try:
+        from paddle_tpu.amp.auto_cast import amp_state
+
+        return amp_state()
+    except ImportError:
+        return None
+
+
+def _check_numerics(name, out):
+    from paddle_tpu.amp import debugging
+
+    if debugging.check_numerics_enabled():
+        vals = out if isinstance(out, tuple) else (out,)
+        for v in vals:
+            if hasattr(v, "dtype") and jnp.issubdtype(v.dtype, jnp.inexact):
+                debugging.check_numerics(v, name)
+
+
+# When control-flow discovery is active, every Tensor consumed by an op is
+# recorded here so closure-captured tensors become vjp primals (see
+# ops/control_flow._discover_params).
+_consumed_watchers: list = []
+
+
+def apply(name: str, raw_fn: Callable, *args, differentiable: bool = True, **kwargs):
+    """Execute ``raw_fn`` (a pure jax function) on mixed Tensor/python args.
+
+    Tensors among ``args`` are unwrapped positionally; kwargs are passed through
+    verbatim (they must be static/non-tensor). Returns Tensor(s).
+    """
+    from paddle_tpu.tensor import Tensor
+
+    tensor_idx = [i for i, a in enumerate(args) if _is_tensor(a)]
+    if _consumed_watchers:
+        watcher = _consumed_watchers[-1]
+        for i in tensor_idx:
+            watcher.consumed.append(args[i])
+    vals = [a._value if _is_tensor(a) else a for a in args]
+
+    # AMP O1: cast float inputs per white/black list (amp/auto_cast.py parity
+    # with the reference's ad_func AMP branch, eager_gen.py:1885)
+    amp = _amp_state()
+    if amp is not None and amp.enabled:
+        if name in amp.white_list:
+            for i in tensor_idx:
+                if vals[i].dtype == jnp.float32:
+                    vals[i] = vals[i].astype(amp.dtype)
+        elif name in amp.black_list:
+            for i in tensor_idx:
+                if vals[i].dtype in (jnp.float16, jnp.bfloat16):
+                    vals[i] = vals[i].astype(jnp.float32)
+        else:
+            # promote: if inputs mix low/full precision, unify to fp32
+            dts = {vals[i].dtype for i in tensor_idx
+                   if jnp.issubdtype(vals[i].dtype, jnp.floating)}
+            if jnp.float32 in dts and (jnp.float16 in dts or jnp.bfloat16 in dts):
+                for i in tensor_idx:
+                    if vals[i].dtype in (jnp.float16, jnp.bfloat16):
+                        vals[i] = vals[i].astype(jnp.float32)
+
+    needs_grad = (
+        differentiable
+        and tape.is_grad_enabled()
+        and any(not args[i].stop_gradient for i in tensor_idx)
+    )
+
+    if not needs_grad:
+        out = raw_fn(*vals, **kwargs)
+        _check_numerics(name, out)
+        return _wrap_outputs(name, out, node=None)
+
+    in_tensors = [args[i] for i in tensor_idx]
+
+    def fn_of_tensors(*tvals):
+        v = list(vals)
+        for i, tv in zip(tensor_idx, tvals):
+            v[i] = tv
+        return raw_fn(*v, **kwargs)
+
+    primals = [vals[i] for i in tensor_idx]
+    out, vjp_fn = jax.vjp(fn_of_tensors, *primals)
+    _check_numerics(name, out)
+    n_out = len(out) if isinstance(out, tuple) else 1
+    node = tape.TapeNode(name, vjp_fn, in_tensors, n_out)
+    # double-backward (create_graph): keep the primal so the reverse step can
+    # be re-linearized through this dispatch, recording its own tape
+    node.primal_fn = fn_of_tensors
+    node.primal_out_tuple = isinstance(out, tuple)
+    node.primal_dtypes = [p.dtype for p in primals]
+    return _wrap_outputs(name, out, node=node)
+
+
+def _wrap_outputs(name: str, out, node):
+    from paddle_tpu.tensor import Tensor
+
+    if _consumed_watchers:
+        # tensors produced while a discovery watcher is active are branch-
+        # internal, not closure captures
+        watcher = _consumed_watchers[-1]
+
+        def _note(t):
+            watcher.produced.add(id(t))
+            return t
+    else:
+        def _note(t):
+            return t
+
+    if isinstance(out, tuple):
+        results = []
+        for i, o in enumerate(out):
+            t = _note(Tensor._from_value(o))
+            t.stop_gradient = node is None
+            if node is not None:
+                t._node = node
+                node.register_output(i, t)
+            results.append(t)
+        return tuple(results)
+    t = _note(Tensor._from_value(out))
+    t.stop_gradient = node is None
+    if node is not None:
+        t._node = node
+        node.register_output(0, t)
+    return t

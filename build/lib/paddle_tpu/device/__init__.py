@@ -1,0 +1,74 @@
+"""Device management (parity: python/paddle/device/__init__.py:265 set_device).
+
+On TPU, "device" selection is degenerate: there is one device type and
+placement is controlled by shardings; these APIs exist for source parity.
+"""
+
+from __future__ import annotations
+
+import jax
+
+
+# Platform names that mean "a real TPU-class chip is attached": "tpu" is
+# the stock PJRT name; tunneled/proxied chips may report a different
+# platform string (e.g. "axon") while still being TPU-class hardware, so
+# every Pallas/perf gate must use THIS predicate, never `platform == "tpu"`.
+_TPU_LIKE_PLATFORMS = ("tpu", "axon")
+
+
+def is_tpu_like(device=None) -> bool:
+    """True when the (first) device is TPU-class hardware — the single
+    gate for Pallas kernels and TPU-only fast paths."""
+    try:
+        d = device if device is not None else jax.devices()[0]
+        return d.platform in _TPU_LIKE_PLATFORMS
+    except Exception:
+        return False
+
+
+def get_all_device_type():
+    return sorted({d.platform for d in jax.devices()})
+
+
+def get_available_device():
+    return [f"{d.platform}:{d.id}" for d in jax.devices()]
+
+
+def get_device():
+    d = jax.devices()[0]
+    return f"{d.platform}:{d.id}"
+
+
+def set_device(device):
+    return device
+
+
+def device_count():
+    return jax.device_count()
+
+
+def is_compiled_with_cuda():
+    return False
+
+
+def synchronize(device=None):
+    """Block until all async device work completes (cuda.synchronize parity)."""
+    for d in jax.live_arrays():
+        try:
+            d.block_until_ready()
+        except Exception:
+            pass
+
+
+class Stream:
+    """XLA executes a single ordered stream per device; exposed for parity."""
+
+    def __init__(self, device=None, priority=2):
+        self.device = device
+
+    def synchronize(self):
+        synchronize()
+
+
+def current_stream(device=None):
+    return Stream(device)

@@ -1,0 +1,69 @@
+"""paddle_tpu.distributed (parity: python/paddle/distributed).
+
+Backend summary (SURVEY §2.4): the reference's NCCL/Gloo/BKCL ProcessGroups +
+kernel CommContexts + TCPStore bootstrap collapse onto ONE TPU-native seam —
+XLA collectives over the ICI/DCN device mesh, bootstrapped by jax.distributed.
+The Python API surface (dist.*, fleet.*, auto_parallel) is kept paddle-shaped.
+"""
+
+from paddle_tpu.distributed import auto_parallel  # noqa: F401
+from paddle_tpu.distributed import fleet  # noqa: F401
+from paddle_tpu.distributed import sharding  # noqa: F401
+from paddle_tpu.distributed.auto_parallel import (  # noqa: F401
+    Partial,
+    Placement,
+    ProcessMesh,
+    Replicate,
+    Shard,
+    dtensor_from_fn,
+    reshard,
+    shard_layer,
+    shard_tensor,
+)
+from paddle_tpu.distributed.auto_parallel.static_engine import (  # noqa: F401
+    DistModel,
+    Engine,
+    to_static,
+)
+from paddle_tpu.distributed.collective import (  # noqa: F401
+    Group,
+    ReduceOp,
+    all_gather,
+    all_gather_object,
+    all_reduce,
+    all_to_all,
+    alltoall,
+    barrier,
+    broadcast,
+    get_group,
+    local_value,
+    new_group,
+    recv,
+    reduce,
+    reduce_scatter,
+    scatter,
+    send,
+    shard_from_host,
+    wait,
+)
+from paddle_tpu.distributed.env import (  # noqa: F401
+    ParallelEnv,
+    get_rank,
+    get_world_mesh,
+    get_world_size,
+    init_parallel_env,
+    is_initialized,
+)
+from paddle_tpu.distributed.parallel import DataParallel  # noqa: F401
+from paddle_tpu.distributed.sharding import group_sharded_parallel  # noqa: F401
+from paddle_tpu.distributed import checkpoint  # noqa: F401,E402
+from paddle_tpu.distributed.checkpoint import (  # noqa: F401,E402
+    load_state_dict,
+    save_state_dict,
+)
+from paddle_tpu.distributed import auto_tuner  # noqa: F401,E402
+from paddle_tpu.distributed.store import (  # noqa: F401,E402
+    TCPStore,
+    create_or_get_global_tcp_store,
+)
+from paddle_tpu.distributed import rpc  # noqa: F401,E402

@@ -1,0 +1,228 @@
+"""Auto-parallel / DistTensor API (parity: python/paddle/distributed/
+auto_parallel/ — ProcessMesh process_mesh.py:72, shard_tensor/reshard/
+shard_layer api.py:131,579,678; C++ DistTensor dist_tensor.h:39, placements
+placement_types.h; per-op SPMD rules phi/infermeta/spmd_rules/).
+
+TPU-native: this maps ~1:1 onto jax.sharding —
+  ProcessMesh       -> jax.sharding.Mesh
+  Placement Shard(d)-> PartitionSpec entry naming a mesh axis on dim d
+  Replicate         -> None in the spec
+  Partial           -> pending-reduction state (XLA tracks it internally;
+                       surfaced for API parity)
+  shard_tensor      -> jax.device_put(NamedSharding)
+  reshard           -> jax.device_put (XLA emits the collective conversion —
+                       the reference's reshard_funcs/ table of 20+ hand-written
+                       conversions collapses into GSPMD)
+  SPMD rules        -> GSPMD sharding propagation (reference rules serve as
+                       test oracles, see tests/test_auto_parallel.py)
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Union
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from paddle_tpu.nn.layer_base import Layer
+from paddle_tpu.tensor import Tensor
+
+
+class Placement:
+    def is_shard(self, dim=None):
+        return False
+
+    def is_replicate(self):
+        return False
+
+    def is_partial(self):
+        return False
+
+
+class Shard(Placement):
+    def __init__(self, dim: int):
+        self.dim = dim
+
+    def is_shard(self, dim=None):
+        return dim is None or dim == self.dim
+
+    def get_dim(self):
+        return self.dim
+
+    def __repr__(self):
+        return f"Shard(dim={self.dim})"
+
+    def __eq__(self, other):
+        return isinstance(other, Shard) and other.dim == self.dim
+
+    def __hash__(self):
+        return hash(("shard", self.dim))
+
+
+class Replicate(Placement):
+    def is_replicate(self):
+        return True
+
+    def __repr__(self):
+        return "Replicate()"
+
+    def __eq__(self, other):
+        return isinstance(other, Replicate)
+
+    def __hash__(self):
+        return hash("replicate")
+
+
+class Partial(Placement):
+    def __init__(self, reduce_type="sum"):
+        self.reduce_type = reduce_type
+
+    def is_partial(self):
+        return True
+
+    def __repr__(self):
+        return f"Partial({self.reduce_type})"
+
+
+class ProcessMesh:
+    """paddle.distributed.ProcessMesh parity wrapping jax.sharding.Mesh."""
+
+    def __init__(self, mesh=None, dim_names=None, shape=None, process_ids=None):
+        if mesh is not None:
+            arr = np.asarray(mesh)
+        elif process_ids is not None:
+            arr = np.asarray(process_ids).reshape(shape)
+        else:
+            arr = np.arange(int(np.prod(shape))).reshape(shape)
+        self._shape = list(arr.shape)
+        self._process_ids = arr.flatten().tolist()
+        self._dim_names = list(dim_names) if dim_names else [
+            f"d{i}" for i in range(arr.ndim)
+        ]
+        devs = np.asarray(jax.devices())[np.asarray(self._process_ids)].reshape(
+            arr.shape
+        )
+        self._jax_mesh = Mesh(devs, axis_names=tuple(self._dim_names))
+
+    @property
+    def shape(self):
+        return self._shape
+
+    @property
+    def ndim(self):
+        return len(self._shape)
+
+    @property
+    def dim_names(self):
+        return self._dim_names
+
+    @property
+    def process_ids(self):
+        return self._process_ids
+
+    @property
+    def mesh(self):
+        return np.asarray(self._process_ids).reshape(self._shape)
+
+    def get_dim_size(self, name):
+        return self._shape[self._dim_names.index(name)]
+
+    def get_mesh_with_dim(self, dim_name, index=None):
+        axis = self._dim_names.index(dim_name)
+        arr = self.mesh
+        moved = np.moveaxis(arr, axis, 0)
+        names = [dim_name] + [n for n in self._dim_names if n != dim_name]
+        if index is not None:
+            return ProcessMesh(moved[index], names[1:])
+        return ProcessMesh(moved, names)
+
+    def jax_mesh(self) -> Mesh:
+        return self._jax_mesh
+
+    def __eq__(self, other):
+        return (
+            isinstance(other, ProcessMesh)
+            and self._shape == other._shape
+            and self._process_ids == other._process_ids
+        )
+
+    def __repr__(self):
+        return f"ProcessMesh(shape={self._shape}, dim_names={self._dim_names})"
+
+
+def _placements_to_spec(placements: Sequence[Placement], mesh: ProcessMesh,
+                        ndim: int) -> P:
+    """[Placement per mesh dim] -> PartitionSpec over tensor dims."""
+    entries: List = [None] * ndim
+    for mesh_dim, pl in enumerate(placements):
+        if isinstance(pl, Shard):
+            d = pl.dim
+            axis_name = mesh.dim_names[mesh_dim]
+            if entries[d] is None:
+                entries[d] = axis_name
+            elif isinstance(entries[d], tuple):
+                entries[d] = entries[d] + (axis_name,)
+            else:
+                entries[d] = (entries[d], axis_name)
+    return P(*entries)
+
+
+def shard_tensor(data, mesh: ProcessMesh, placements, dtype=None,
+                 stop_gradient=None) -> Tensor:
+    """paddle.distributed.shard_tensor parity (api.py:131)."""
+    t = data if isinstance(data, Tensor) else Tensor(data, dtype=dtype)
+    spec = _placements_to_spec(placements, mesh, t._value.ndim)
+    v = jax.device_put(t._value, NamedSharding(mesh.jax_mesh(), spec))
+    out = Tensor._from_value(v)
+    out.stop_gradient = t.stop_gradient if stop_gradient is None else stop_gradient
+    out.placements = list(placements)
+    out.process_mesh = mesh
+    return out
+
+
+def reshard(dist_tensor: Tensor, mesh: ProcessMesh, placements) -> Tensor:
+    """paddle.distributed.reshard parity (api.py:579): XLA emits the
+    sharding-conversion collective (all-gather / all-to-all / slice)."""
+    spec = _placements_to_spec(placements, mesh, dist_tensor._value.ndim)
+    v = jax.device_put(
+        dist_tensor._value, NamedSharding(mesh.jax_mesh(), spec)
+    )
+    out = Tensor._from_value(v)
+    out.stop_gradient = dist_tensor.stop_gradient
+    out.placements = list(placements)
+    out.process_mesh = mesh
+    return out
+
+
+def dtensor_from_fn(fn, mesh: ProcessMesh, placements, *args, **kwargs) -> Tensor:
+    return shard_tensor(fn(*args, **kwargs), mesh, placements)
+
+
+def shard_layer(layer: Layer, process_mesh: ProcessMesh, shard_fn=None,
+                input_fn=None, output_fn=None) -> Layer:
+    """paddle.distributed.shard_layer parity (api.py:678)."""
+    if shard_fn is None:
+        def shard_fn(name, sublayer, mesh):
+            for pname, p in sublayer._parameters.items():
+                if p is None:
+                    continue
+                placements = [Replicate() for _ in range(mesh.ndim)]
+                sharded = shard_tensor(p, mesh, placements)
+                p._replace_value(sharded._value)
+
+    for name, sub in layer.named_sublayers(include_self=True):
+        shard_fn(name, sub, process_mesh)
+    if input_fn is not None:
+        layer.register_forward_pre_hook(
+            lambda l, inp: input_fn(inp, process_mesh)
+        )
+    if output_fn is not None:
+        layer.register_forward_post_hook(
+            lambda l, inp, out: output_fn(out, process_mesh)
+        )
+    return layer
+
+
+def get_placement_of(tensor: Tensor):
+    return getattr(tensor, "placements", None)

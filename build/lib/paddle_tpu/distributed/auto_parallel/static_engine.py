@@ -1,0 +1,478 @@
+"""Auto-parallel static mode: Engine / dist.to_static (VERDICT #6).
+
+Parity targets:
+- Engine: python/paddle/distributed/auto_parallel/static/engine.py:68
+  (prepare/fit/evaluate/predict over a compiled distributed program)
+- dist.to_static -> DistModel: auto_parallel/api.py:2345
+- completion pass: auto_parallel/static/completion.py (annotate every
+  tensor's dist attributes from the user's partial annotations)
+- cost model: auto_parallel/static/cost/ (comm + compute estimates driving
+  mesh-dim assignment)
+
+TPU-native redesign. The reference builds a serialized static program, runs
+completion over every op, partitions it per rank, and inserts reshard ops.
+On XLA the analogous pipeline is:
+
+1. **completion** = choose NamedShardings for the *boundary* (params, data,
+   optimizer state); GSPMD propagates through every interior op during
+   compilation — the reference's per-op completion pass IS the GSPMD
+   propagation pass here (SURVEY §7 stance; explicit rule oracles in
+   tests/test_spmd_rules.py).
+2. **cost model** = a first-order estimate (per-device FLOPs + grad-allreduce
+   bytes + param-allgather bytes) that picks which mesh axis carries the
+   batch and whether large weights shard over a model axis.
+3. **partitioner/executor** = ONE jitted train step whose inputs carry the
+   chosen shardings; XLA emits the collectives the reference's reshard pass
+   would have inserted.
+4. **pipeline route** (r3): ``pp_axis`` + a fleet PipelineLayer model runs
+   through the heterogeneous schedule engine (hybrid dp x pp in one
+   program; stage-exclusive params sharded over pp). TP placements come
+   from the cost model (``choose_tp_placements``) on the GSPMD path;
+   TP *inside* the pp schedule engine is the fleet tier's ``param_specs``
+   route (tests/test_pipeline_schedules.py) — the Engine does not yet
+   compose all three axes in a single program.
+5. **cross-mesh reshard** = ``dist.reshard`` moves a tensor between
+   ProcessMeshes (disjoint device sets, different topologies) via
+   device_put — the reference's reshard_funcs library collapses into the
+   runtime's transfer engine (tests/test_auto_parallel_engine.py).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import jax
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from paddle_tpu.distributed.auto_parallel import (
+    ProcessMesh,
+    Replicate,
+    Shard,
+    _placements_to_spec,
+)
+from paddle_tpu.nn.layer_base import Layer
+from paddle_tpu.tensor import Tensor
+
+
+# ---------------------------------------------------------------- completion
+
+
+def complete_annotations(model: Layer, mesh: ProcessMesh,
+                         batch_axis: Optional[str] = None):
+    """Completion pass: give every parameter a full placement annotation.
+
+    User-annotated params (shard_tensor placements) are kept; unannotated
+    params become Replicate on every mesh dim. Returns
+    {param_id: placements}. Interior activations are completed by GSPMD at
+    compile time (reference: static/completion.py walks ops instead).
+    """
+    out = {}
+    for p in model.parameters():
+        pls = getattr(p, "placements", None)
+        if pls is None:
+            pls = [Replicate() for _ in range(mesh.ndim)]
+        out[id(p)] = list(pls)
+    return out
+
+
+# ---------------------------------------------------------------- cost model
+
+
+class CostEstimate:
+    def __init__(self, flops_per_dev, comm_bytes, detail):
+        self.flops_per_dev = flops_per_dev
+        self.comm_bytes = comm_bytes
+        self.detail = detail
+
+    # v5p-ish roofline constants; only RATIOS matter for ranking
+    _FLOPS = 459e12
+    _ICI_BW = 100e9
+
+    @property
+    def time(self):
+        return self.flops_per_dev / self._FLOPS + self.comm_bytes / self._ICI_BW
+
+    def __repr__(self):
+        return (f"CostEstimate(flops/dev={self.flops_per_dev:.3g}, "
+                f"comm={self.comm_bytes:.3g}B, t={self.time:.3g}s)")
+
+
+def estimate_cost(model: Layer, mesh: ProcessMesh, batch_axis: str,
+                  batch_size: int, seq_len: int = 1) -> CostEstimate:
+    """First-order step cost for a given batch-axis assignment: dense-param
+    FLOPs scale 1/dp; replicated params pay a grad all-reduce over dp;
+    dp = size of the chosen batch axis (reference: static/cost/ estimators)."""
+    dp = mesh.get_dim_size(batch_axis)
+    n_params = 0
+    n_replicated = 0
+    sharded_bytes = 0.0
+    for p in model.parameters():
+        n = int(np.prod(p.shape)) if p.shape else 1
+        n_params += n
+        pls = getattr(p, "placements", None)
+        if pls and any(isinstance(x, Shard) for x in pls):
+            sharded_bytes += 4.0 * n  # allgather traffic for sharded weights
+        else:
+            n_replicated += n  # only replicated params pay the allreduce
+    tokens = batch_size * seq_len
+    flops = 6.0 * n_params * tokens  # fwd+bwd dense estimate
+    grad_allreduce = (2.0 * 4.0 * n_replicated * (dp - 1) / dp
+                      if dp > 1 else 0.0)
+    return CostEstimate(flops / dp, grad_allreduce + sharded_bytes,
+                        {"dp": dp, "batch_axis": batch_axis})
+
+
+def choose_batch_axis(model: Layer, mesh: ProcessMesh, batch_size: int,
+                      seq_len: int = 1, exclude=()) -> str:
+    """Pick the mesh axis that carries the batch: lowest first-order cost
+    among axes that divide the batch (axes in ``exclude`` — pp/tp — never
+    carry data)."""
+    cands = [name for name in mesh.dim_names
+             if name not in exclude
+             and batch_size % mesh.get_dim_size(name) == 0]
+    if not cands:
+        avail = [n for n in mesh.dim_names if n not in exclude]
+        return avail[0] if avail else mesh.dim_names[0]
+    costs = {name: estimate_cost(model, mesh, name, batch_size, seq_len).time
+             for name in cands}
+    return min(costs, key=costs.get)
+
+
+def choose_tp_placements(model: Layer, mesh: ProcessMesh, tp_axis: str,
+                         batch_size: int, seq_len: int = 1,
+                         min_weight_bytes: int = 1 << 20):
+    """Cost-model TP assignment (reference: static/cost/ estimators feeding
+    the partitioner's weight-sharding decision): shard a large 2-D weight
+    over ``tp_axis`` when the per-step activation collective it induces
+    costs less than the HBM/compute saved by holding 1/tp of the weight.
+
+    First-order rule per weight W[d_in, d_out] at tp degree t:
+    - sharding saves (t-1)/t of the weight's memory traffic AND removes it
+      from the dp grad all-reduce;
+    - it adds one all-reduce (or all-gather pair) of the layer's activation,
+      ~2 * batch * seq * d_out * 4 bytes per step over ICI.
+    Weights below ``min_weight_bytes`` never shard (collective latency
+    dominates). Returns {param_id: placements} for params that should
+    shard; callers merge into complete_annotations' output. The LAST dim is
+    sharded (column-parallel) — the megatron f/g orientation whose
+    activation collective sits after the pair, matching mp_layers.py.
+    """
+    t = mesh.get_dim_size(tp_axis)
+    if t <= 1:
+        return {}
+    out = {}
+    tokens = batch_size * seq_len
+    tp_dim = mesh.dim_names.index(tp_axis)
+    for p in model.parameters():
+        if len(p.shape) != 2:
+            continue
+        if getattr(p, "placements", None) is not None:
+            continue  # explicit shard_tensor annotations are kept, not overridden
+        n = int(np.prod(p.shape))
+        wbytes = 4.0 * n
+        if wbytes < min_weight_bytes:
+            continue
+        d_out = int(p.shape[-1])
+        if d_out % t != 0:
+            continue
+        # saved: weight traffic + dp grad allreduce share; added: activation
+        # allreduce over the tp group
+        saved = wbytes * (t - 1) / t * 3.0      # fwd read + bwd read + grad
+        added = 2.0 * 4.0 * tokens * d_out * (t - 1) / t
+        if saved > added:
+            pls = [Replicate() for _ in range(mesh.ndim)]
+            pls[tp_dim] = Shard(len(p.shape) - 1)
+            out[id(p)] = pls
+    return out
+
+
+# -------------------------------------------------------------------- Engine
+
+
+class DistModel:
+    """dist.to_static result (api.py:2345 parity): calling it runs ONE
+    compiled distributed step (train/eval per .train()/.eval())."""
+
+    def __init__(self, layer: Layer, loader, loss=None, optimizer=None,
+                 strategy=None, mesh: Optional[ProcessMesh] = None,
+                 batch_axis: Optional[str] = None,
+                 pp_axis: Optional[str] = None,
+                 tp_axis: Optional[str] = None,
+                 num_microbatches: Optional[int] = None):
+        from paddle_tpu.jit.api import TrainStep
+
+        self._layer = layer
+        self._loader = loader
+        self._loss = loss
+        self._opt = optimizer
+        self._mode = "train" if optimizer is not None else "predict"
+        self._mesh = mesh or _infer_mesh(layer)
+        self._engine_meta = {}
+        self._pp_axis = pp_axis
+        self._num_microbatches = num_microbatches
+
+        from paddle_tpu.distributed.fleet.pipeline import PipelineLayer
+
+        self._is_pipeline = isinstance(layer, PipelineLayer)
+        if pp_axis is not None and not self._is_pipeline:
+            raise ValueError(
+                "pp_axis routes training through the pipeline schedule "
+                "engine and needs a fleet PipelineLayer model (stage "
+                "partition + shared-weight descs); wrap the layer list in "
+                "PipelineLayer(descs, num_stages=mesh[pp_axis])")
+        if self._is_pipeline:
+            if self._mesh is None:
+                raise ValueError(
+                    "a PipelineLayer DistModel needs a ProcessMesh with a "
+                    "pipeline axis")
+            if pp_axis is None:
+                # default like train_batch: a dim literally named "pp",
+                # else the one matching num_stages
+                if "pp" in self._mesh.dim_names:
+                    pp_axis = "pp"
+                else:
+                    fits = [a for a in self._mesh.dim_names
+                            if self._mesh.get_dim_size(a)
+                            == layer.num_stages]
+                    if not fits:
+                        raise ValueError(
+                            f"no mesh axis matches the PipelineLayer's "
+                            f"{layer.num_stages} stages; pass pp_axis=")
+                    pp_axis = fits[0]
+                self._pp_axis = pp_axis
+
+        if self._mesh is not None and not self._is_pipeline:
+            # completion order matters: (1) the cost model assigns large
+            # 2-D weights to the tp axis and WRITES the placements onto the
+            # params, so (2) complete_annotations and (3) the batch-axis
+            # costing both see them; then materialize as NamedShardings
+            sample = _peek_batch(loader)
+            if tp_axis is not None and sample is not None:
+                bsz = sample[0].shape[0]
+                seq = sample[0].shape[1] if sample[0].ndim > 1 else 1
+                tp_ann = choose_tp_placements(layer, self._mesh, tp_axis,
+                                              bsz, seq)
+                for p in layer.parameters():
+                    if id(p) in tp_ann:
+                        p.placements = tp_ann[id(p)]
+                        p.process_mesh = self._mesh
+            ann = complete_annotations(layer, self._mesh)
+            jm = self._mesh.jax_mesh()
+            for p in layer.parameters():
+                spec = _placements_to_spec(ann[id(p)], self._mesh,
+                                           p._value.ndim)
+                p._replace_value(jax.device_put(
+                    p._value, NamedSharding(jm, spec)))
+            # cost-model choice of the data axis (only when not given, and
+            # only from loaders that can be re-iterated — peeking a one-shot
+            # generator would eat its first batch); pp/tp axes never carry
+            # data, and non-dividing axes are filtered inside
+            if batch_axis is None:
+                if sample is not None:
+                    bsz = sample[0].shape[0]
+                    seq = sample[0].shape[1] if sample[0].ndim > 1 else 1
+                    batch_axis = choose_batch_axis(
+                        layer, self._mesh, bsz, seq,
+                        exclude=tuple(a for a in (pp_axis, tp_axis)
+                                      if a is not None))
+                else:
+                    batch_axis = self._mesh.dim_names[0]
+        elif self._mesh is not None and batch_axis is None:
+            # pipeline route: the data axis is any non-pp axis (or none)
+            others = [a for a in self._mesh.dim_names if a != pp_axis]
+            batch_axis = others[0] if others else None
+        self._batch_axis = batch_axis
+
+        if optimizer is not None and loss is not None and not self._is_pipeline:
+            def loss_fn(m, *batch):
+                *xs, y = batch
+                out = m(*xs)
+                return loss(out, y)
+
+            self._step = TrainStep(layer, loss_fn, optimizer)
+        elif self._is_pipeline and optimizer is not None:
+            self._step = "pipeline"  # routed through train_batch
+        else:
+            self._step = None
+
+    # -------------------------------------------------------------- modes
+    def train(self):
+        self._mode = "train"
+        self._layer.train()
+
+    def eval(self):
+        self._mode = "eval"
+        self._layer.eval()
+
+    def predict(self):
+        self._mode = "predict"
+        self._layer.eval()
+
+    def dist_main_program(self, mode=None):  # introspection parity
+        return self._step
+
+    def _shard_batch(self, t: Tensor) -> Tensor:
+        if self._mesh is None or self._batch_axis is None:
+            return t
+        v = t._value
+        # only shard elements whose leading dim actually divides over the
+        # batch axis (scalars / broadcast masks stay replicated)
+        if v.ndim == 0 or v.shape[0] % self._mesh.get_dim_size(
+                self._batch_axis) != 0:
+            return t
+        jm = self._mesh.jax_mesh()
+        spec = P(self._batch_axis, *([None] * (v.ndim - 1)))
+        return Tensor._from_value(
+            jax.device_put(v, NamedSharding(jm, spec)))
+
+    def __call__(self, *batch):
+        batch = [b if isinstance(b, Tensor) else Tensor(b) for b in batch]
+        if self._is_pipeline:
+            if self._mode == "train":
+                if self._step != "pipeline":
+                    raise RuntimeError(
+                        "pipeline DistModel needs an optimizer to train")
+                # pp route: the schedule engine owns sharding (params over
+                # the pp axis, microbatch rows over the dp axis); dp only
+                # engages when the per-microbatch rows divide over it
+                x, y = batch
+                M = (self._num_microbatches
+                     or self._mesh.get_dim_size(self._pp_axis))
+                dp_axis = self._batch_axis
+                if dp_axis is not None:
+                    dp = self._mesh.get_dim_size(dp_axis)
+                    if x.shape[0] % (M * dp) != 0:
+                        dp_axis = None  # fall back to pp-only, still correct
+                return self._layer.train_batch(
+                    (x, y), self._opt, mesh=self._mesh.jax_mesh(),
+                    num_microbatches=M, axis=self._pp_axis, dp_axis=dp_axis)
+            # eval: run the stage partition eagerly + the layer's loss;
+            # predict: plain forward
+            if self._mode == "eval" and len(batch) > 1 \
+                    and self._layer.loss_fn is not None:
+                out = self._layer.forward(batch[0])
+                return self._layer.loss_fn(out, batch[-1])
+            return self._layer.forward(batch[0])
+        batch = [self._shard_batch(b) for b in batch]
+        if self._mode == "train":
+            if self._step is None:
+                raise RuntimeError("DistModel needs loss+optimizer to train")
+            return self._step(*batch)
+        if self._mode == "eval" and self._loss is not None and len(batch) > 1:
+            out = self._layer(*batch[:-1])
+            return self._loss(out, batch[-1])
+        # predict: every batch element is a model input
+        return self._layer(*batch)
+
+
+def to_static(layer: Layer, loader=None, loss=None, optimizer=None,
+              strategy=None, mesh: Optional[ProcessMesh] = None,
+              batch_axis: Optional[str] = None, pp_axis: Optional[str] = None,
+              tp_axis: Optional[str] = None,
+              num_microbatches: Optional[int] = None) -> DistModel:
+    """paddle.distributed.to_static parity (auto_parallel/api.py:2345).
+
+    ``pp_axis`` routes a PipelineLayer model through the schedule engine
+    (hybrid dp x pp in one program); ``tp_axis`` lets the cost model shard
+    large 2-D weights over that axis (GSPMD inserts the collectives)."""
+    return DistModel(layer, loader, loss, optimizer, strategy, mesh,
+                     batch_axis, pp_axis=pp_axis, tp_axis=tp_axis,
+                     num_microbatches=num_microbatches)
+
+
+class Engine:
+    """Auto-parallel static Engine (static/engine.py:68 parity):
+    prepare -> fit/evaluate/predict over the compiled distributed step."""
+
+    def __init__(self, model: Layer, loss=None, optimizer=None, metrics=None,
+                 strategy=None, mesh: Optional[ProcessMesh] = None,
+                 pp_axis: Optional[str] = None, tp_axis: Optional[str] = None,
+                 num_microbatches: Optional[int] = None):
+        self._model = model
+        self._loss = loss
+        self._opt = optimizer
+        self._metrics = metrics or []
+        self._strategy = strategy
+        self._mesh = mesh
+        self._pp_axis = pp_axis
+        self._tp_axis = tp_axis
+        self._num_microbatches = num_microbatches
+        self._dist_model: Optional[DistModel] = None
+        self.history: List[float] = []
+
+    def prepare(self, loader=None, mode="train"):
+        # rebuild when the cached model lacks what this mode needs (e.g.
+        # evaluate() before fit() must not lose the optimizer forever)
+        need_opt = mode == "train" and self._opt is not None
+        if self._dist_model is None or (need_opt
+                                        and self._dist_model._step is None):
+            self._dist_model = to_static(
+                self._model, loader, self._loss,
+                self._opt if mode == "train" else None,
+                self._strategy, self._mesh,
+                pp_axis=self._pp_axis, tp_axis=self._tp_axis,
+                num_microbatches=self._num_microbatches)
+        return self._dist_model
+
+    def fit(self, train_data, epochs=1, steps_per_epoch=None, verbose=0,
+            log_freq=10):
+        dm = self.prepare(train_data, "train")
+        dm.train()
+        for _ in range(epochs):
+            for step, batch in enumerate(train_data):
+                if steps_per_epoch is not None and step >= steps_per_epoch:
+                    break
+                batch = batch if isinstance(batch, (list, tuple)) else [batch]
+                loss = dm(*batch)
+                self.history.append(float(np.asarray(loss.numpy())))
+        return self.history
+
+    def evaluate(self, eval_data, steps=None):
+        dm = self.prepare(eval_data, "eval")
+        dm.eval()
+        losses = []
+        for step, batch in enumerate(eval_data):
+            if steps is not None and step >= steps:
+                break
+            batch = batch if isinstance(batch, (list, tuple)) else [batch]
+            losses.append(float(np.asarray(dm(*batch).numpy())))
+        return {"loss": float(np.mean(losses)) if losses else None}
+
+    def predict(self, data, steps=None):
+        dm = self.prepare(data, "predict")
+        dm.predict()
+        outs = []
+        for step, batch in enumerate(data):
+            if steps is not None and step >= steps:
+                break
+            batch = batch if isinstance(batch, (list, tuple)) else [batch]
+            outs.append(dm(*batch))
+        return outs
+
+
+# ------------------------------------------------------------------ helpers
+
+
+def _infer_mesh(layer: Layer) -> Optional[ProcessMesh]:
+    for p in layer.parameters():
+        m = getattr(p, "process_mesh", None)
+        if m is not None:
+            return m
+    return None
+
+
+def _peek_batch(loader):
+    if loader is None:
+        return None
+    try:
+        it = iter(loader)
+    except TypeError:
+        return None
+    if it is loader:
+        return None  # one-shot iterable: peeking would consume a batch
+    try:
+        batch = next(it)
+    except StopIteration:
+        return None
+    return batch if isinstance(batch, (list, tuple)) else [batch]

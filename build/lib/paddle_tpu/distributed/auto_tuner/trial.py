@@ -1,0 +1,147 @@
+"""Auto-tuner trial worker (parity: the trial jobs
+python/paddle/distributed/auto_tuner/tuner.py:21 launches per candidate —
+each trial runs a real training step under the candidate's parallel config
+and reports measured step time).
+
+Run by FILE PATH (``python .../auto_tuner/trial.py --dp 2 --mp 2 ...``) —
+NOT ``-m`` — inside an environment whose XLA device count >= the config's
+world size (the parent sets ``--xla_force_host_platform_device_count``);
+``-m`` would import the paddle_tpu package and initialize the jax backend
+before this script can pin the cpu platform. Prints one JSON line
+``{"measured_time_ms": X}`` on success.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--dp", type=int, default=1)
+    ap.add_argument("--mp", type=int, default=1)
+    ap.add_argument("--pp", type=int, default=1)
+    ap.add_argument("--sharding", type=int, default=1)
+    ap.add_argument("--sep", type=int, default=1)
+    ap.add_argument("--micro-batch", type=int, default=1)
+    ap.add_argument("--hidden", type=int, default=64)
+    ap.add_argument("--layers", type=int, default=2)
+    ap.add_argument("--heads", type=int, default=4)
+    ap.add_argument("--seq", type=int, default=32)
+    ap.add_argument("--vocab", type=int, default=256)
+    ap.add_argument("--steps", type=int, default=3)
+    args = ap.parse_args(argv)
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    world = args.dp * args.mp * args.pp * args.sharding * args.sep
+    if jax.device_count() < world:
+        print(json.dumps({"error": f"need {world} devices, "
+                                   f"have {jax.device_count()}"}))
+        return 3
+
+    import numpy as np
+
+    import paddle_tpu as paddle
+    import paddle_tpu.optimizer as opt
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from paddle_tpu.distributed.fleet import topology as topo
+    from paddle_tpu.jit.api import TrainStep
+    from paddle_tpu.models import (
+        GPTForCausalLM,
+        GPTPretrainingCriterion,
+        gpt_tiny,
+    )
+
+    if args.pp > 1:
+        # pp trials measure the 1F1B schedule engine over a block stack of
+        # the same hidden size (the hybrid TrainStep path is dp/sep/mp)
+        return _pp_trial(args)
+
+    hcg = topo.HybridCommunicateGroup(
+        dp_degree=args.dp * args.sharding, mp_degree=args.mp, pp_degree=1,
+        sharding_degree=1, sep_degree=args.sep)
+    topo.set_hybrid_communicate_group(hcg)
+    cfg = gpt_tiny(hidden_size=args.hidden, num_layers=args.layers,
+                   num_heads=args.heads, vocab_size=args.vocab,
+                   max_position_embeddings=max(args.seq * args.sep, 32),
+                   sequence_parallel=(args.sep > 1),
+                   use_ring_attention=(args.sep > 1))
+    model = GPTForCausalLM(cfg)
+    criterion = GPTPretrainingCriterion(cfg)
+    optimizer = opt.AdamW(learning_rate=1e-4, parameters=model.parameters())
+    if args.sharding > 1:
+        from paddle_tpu.distributed.sharding import group_sharded_parallel
+
+        model, optimizer = group_sharded_parallel(model, optimizer, "os_g")
+
+    def loss_fn(m, ids, labels):
+        return criterion(m(ids), labels)
+
+    step = TrainStep(model, loss_fn, optimizer)
+    batch = args.micro_batch * args.dp * args.sharding
+    seqlen = args.seq * args.sep
+    rng = np.random.default_rng(0)
+    ids_np = rng.integers(0, cfg.vocab_size, (batch, seqlen)).astype(np.int32)
+    mesh = hcg.get_mesh()
+    import jax.numpy as jnp
+
+    spec = P("dp", "sep") if args.sep > 1 else P("dp", None)
+    ids = paddle.Tensor._from_value(
+        jax.device_put(jnp.asarray(ids_np), NamedSharding(mesh, spec)))
+
+    loss = step(ids, ids)  # compile + warm
+    float(np.asarray(loss.numpy()))
+    t0 = time.perf_counter()
+    for _ in range(args.steps):
+        loss = step(ids, ids)
+    float(np.asarray(loss.numpy()))
+    dt = (time.perf_counter() - t0) / args.steps * 1000
+    print(json.dumps({"measured_time_ms": round(dt, 3)}))
+    return 0
+
+
+def _pp_trial(args):
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+    from paddle_tpu.distributed.fleet.pipeline_schedules import (
+        make_pipeline_schedule,
+        schedule_pipeline_grads,
+    )
+
+    S, D = args.pp, args.hidden
+    M = max(args.micro_batch, S)
+    mesh = Mesh(np.asarray(jax.devices()[:S]), axis_names=("pp",))
+    w = jax.device_put(
+        jax.random.normal(jax.random.PRNGKey(0), (S, D, D), jnp.float32)
+        * 0.1, NamedSharding(mesh, P("pp")))
+    x = jnp.ones((M * 2, D), jnp.float32)
+    y = jnp.zeros((M * 2, D), jnp.float32)
+    sched = make_pipeline_schedule(S, M, "1F1B")
+
+    def block(p, h):
+        return jnp.tanh(h @ p)
+
+    f = jax.jit(lambda w_, x_, y_: schedule_pipeline_grads(
+        block, lambda h, t: jnp.mean((h - t) ** 2), w_, x_, y_,
+        mesh=mesh, schedule=sched))
+    loss, grads = f(w, x, y)
+    float(loss)
+    t0 = time.perf_counter()
+    for _ in range(args.steps):
+        loss, grads = f(w, x, y)
+    float(loss)
+    dt = (time.perf_counter() - t0) / args.steps * 1000
+    print(json.dumps({"measured_time_ms": round(dt, 3)}))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,51 @@
+"""Checkpoint metadata (parity: python/paddle/distributed/checkpoint/
+metadata.py — LocalTensorMetadata/LocalTensorIndex/Metadata).
+
+A checkpoint is a directory of shard files plus one JSON metadata file
+mapping each logical tensor to its shards: global shape, dtype, and for every
+shard the global offset + local shape + file. Load-time resharding reads any
+source layout into any target sharding from this mapping."""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from dataclasses import dataclass, field
+from typing import Dict, List
+
+
+@dataclass
+class LocalTensorMetadata:
+    global_offset: List[int]
+    local_shape: List[int]
+    dtype: str
+    file_name: str
+
+
+@dataclass
+class TensorMetadata:
+    global_shape: List[int]
+    dtype: str
+    shards: List[LocalTensorMetadata] = field(default_factory=list)
+
+
+@dataclass
+class Metadata:
+    state_dict_metadata: Dict[str, TensorMetadata] = field(default_factory=dict)
+    flat_mapping: Dict[str, str] = field(default_factory=dict)
+
+    def to_json(self) -> str:
+        return json.dumps(dataclasses.asdict(self), indent=1)
+
+    @classmethod
+    def from_json(cls, text: str) -> "Metadata":
+        raw = json.loads(text)
+        md = cls()
+        md.flat_mapping = raw.get("flat_mapping", {})
+        for name, tm in raw["state_dict_metadata"].items():
+            md.state_dict_metadata[name] = TensorMetadata(
+                global_shape=tm["global_shape"],
+                dtype=tm["dtype"],
+                shards=[LocalTensorMetadata(**s) for s in tm["shards"]],
+            )
+        return md

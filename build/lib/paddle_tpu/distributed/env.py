@@ -1,0 +1,129 @@
+"""Distributed environment (parity: python/paddle/distributed/parallel.py:945
+init_parallel_env + ParallelEnv; bootstrap store tcp_store.h:121).
+
+TPU-native bring-up: the reference rendezvouses ranks over a TCPStore and
+builds NCCL communicators lazily (SURVEY §3.4). Here the coordination service
+is ``jax.distributed`` (TPU pod coordinator) for multi-host, and the device
+fabric is described by one global ``jax.sharding.Mesh``. "rank"/"world_size"
+keep their meaning:
+
+- multi-host (one controller per host): rank = jax.process_index()
+- single-controller SPMD: the per-device axis of the global mesh plays the
+  role of ranks; eager collectives operate over it via shard_map.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Optional
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+
+class _DistState:
+    def __init__(self):
+        self.initialized = False
+        self.mesh: Optional[Mesh] = None
+        self.world_size = 1
+        self.rank = 0
+
+
+_state = _DistState()
+_lock = threading.Lock()
+
+
+def _build_world_mesh() -> Mesh:
+    devs = np.asarray(jax.devices())
+    return Mesh(devs, axis_names=("world",))
+
+
+def init_parallel_env(strategy=None):
+    """paddle.distributed.init_parallel_env parity.
+
+    Reads the launcher's env (PADDLE_TRAINER_ID / PADDLE_TRAINERS_NUM, kept
+    for API parity with launch/main.py) or jax.distributed for multi-host;
+    builds the flat world mesh used by the eager collective API.
+    """
+    with _lock:
+        if _state.initialized:
+            return ParallelEnv()
+        # multi-host: initialize the jax coordination service if env asks
+        coord = os.environ.get("PADDLE_MASTER") or os.environ.get(
+            "JAX_COORDINATOR_ADDRESS"
+        )
+        nprocs = int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+        proc_id = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+        if coord and nprocs > 1 and jax.process_count() == 1:
+            jax.distributed.initialize(
+                coordinator_address=coord, num_processes=nprocs, process_id=proc_id
+            )
+        _state.mesh = _build_world_mesh()
+        # multi-controller: trainer rank/world are PROCESS-based (a process
+        # may own several chips — reference trainer semantics); single
+        # controller: the device axis plays the ranks
+        _state.world_size = (jax.process_count()
+                             if jax.process_count() > 1
+                             else jax.device_count())
+        _state.rank = jax.process_index()
+        _state.initialized = True
+    return ParallelEnv()
+
+
+def is_initialized() -> bool:
+    return _state.initialized
+
+
+def get_world_mesh() -> Mesh:
+    if _state.mesh is None:
+        init_parallel_env()
+    return _state.mesh
+
+
+def get_world_size() -> int:
+    if not _state.initialized:
+        # mirror the initialized rule: process-based in multi-controller
+        default = (jax.process_count() if jax.process_count() > 1
+                   else jax.device_count())
+        return int(os.environ.get("PADDLE_TRAINERS_NUM", default))
+    return _state.world_size
+
+
+def get_rank() -> int:
+    if not _state.initialized:
+        return int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+    return _state.rank
+
+
+class ParallelEnv:
+    """paddle.distributed.ParallelEnv parity."""
+
+    @property
+    def rank(self):
+        return get_rank()
+
+    @property
+    def world_size(self):
+        return get_world_size()
+
+    @property
+    def device_id(self):
+        return 0
+
+    @property
+    def current_endpoint(self):
+        return os.environ.get("PADDLE_CURRENT_ENDPOINT", "127.0.0.1:0")
+
+    @property
+    def trainer_endpoints(self):
+        return os.environ.get("PADDLE_TRAINER_ENDPOINTS", "").split(",")
+
+    @property
+    def nranks(self):
+        return get_world_size()
+
+    @property
+    def local_rank(self):
+        return get_rank()

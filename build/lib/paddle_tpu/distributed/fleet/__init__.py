@@ -1,0 +1,31 @@
+"""paddle_tpu.distributed.fleet (parity: python/paddle/distributed/fleet)."""
+
+from paddle_tpu.distributed.fleet import meta_parallel  # noqa: F401
+from paddle_tpu.distributed.fleet import utils  # noqa: F401
+from paddle_tpu.distributed.fleet.fleet import (  # noqa: F401
+    DistributedStrategy,
+    distributed_model,
+    distributed_optimizer,
+    get_hybrid_communicate_group,
+    init,
+    is_initialized,
+    worker_index,
+    worker_num,
+)
+from paddle_tpu.distributed.fleet.mp_layers import (  # noqa: F401
+    ColumnParallelLinear,
+    ParallelCrossEntropy,
+    RowParallelLinear,
+    VocabParallelEmbedding,
+)
+from paddle_tpu.distributed.fleet.pipeline import (  # noqa: F401
+    LayerDesc,
+    PipelineLayer,
+    SharedLayerDesc,
+    spmd_pipeline,
+)
+from paddle_tpu.distributed.fleet.topology import (  # noqa: F401
+    CommunicateTopology,
+    HybridCommunicateGroup,
+)
+from paddle_tpu.distributed.fleet import elastic  # noqa: F401,E402

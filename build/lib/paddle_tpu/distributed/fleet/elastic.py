@@ -1,0 +1,147 @@
+"""Elastic training manager (parity: python/paddle/distributed/fleet/elastic/
+manager.py:124 ElasticManager, exit-code protocol :32-39).
+
+TPU-native: the reference watches an ETCD server for membership; here the
+rendezvous substrate is the framework's own TCPStore (native C++), and on TPU
+pods the platform's coordination service restarts whole slices — so the
+manager's job is membership registration, health heartbeat, and the
+scale-event exit-code protocol that tells the launcher to relaunch with a new
+world size."""
+
+from __future__ import annotations
+
+import os
+import signal
+import threading
+import time
+from typing import Optional
+
+# exit-code protocol (manager.py:32-39)
+ELASTIC_EXIT_CODE = 101  # relaunch me with the new world
+ELASTIC_AUTO_PARALLEL_EXIT_CODE = 102
+
+
+class ElasticStatus:
+    COMPLETED = "completed"
+    ERROR = "error"
+    HOLD = "hold"
+    RESTART = "restart"
+    EXIT = "exit"
+
+
+class ElasticManager:
+    def __init__(self, args=None, store=None, heartbeat_interval: float = 5.0):
+        from paddle_tpu.distributed.store import (
+            TCPStore,
+            create_or_get_global_tcp_store,
+        )
+
+        self.store = store or create_or_get_global_tcp_store()
+        self.rank = int(os.environ.get("PADDLE_TRAINER_ID", 0))
+        self.world_size = int(os.environ.get("PADDLE_TRAINERS_NUM", 1))
+        elastic = os.environ.get("PADDLE_ELASTIC_NP", "")
+        # "2:4" = scale between 2 and 4 nodes; empty = fixed world
+        if ":" in elastic:
+            lo, hi = elastic.split(":")
+            self.np_lo, self.np_hi = int(lo), int(hi)
+            self.enable = True
+        elif elastic:
+            self.np_lo = self.np_hi = int(elastic)
+            self.enable = True
+        else:
+            self.np_lo = self.np_hi = self.world_size
+            self.enable = False
+        self._interval = heartbeat_interval
+        self._stop = threading.Event()
+        self._hb_thread: Optional[threading.Thread] = None
+        self._generation_at_start = self._generation()
+
+    # ------------------------------------------------------------ membership
+    def _generation(self) -> int:
+        """A transient store error must NOT look like a scale event: return
+        the last known generation on failure."""
+        import struct
+
+        try:
+            if self.store.check("elastic/generation"):
+                gen = struct.unpack(
+                    "<q", self.store.get("elastic/generation"))[0]
+                self._last_known_gen = gen
+                return gen
+            return 0
+        except Exception:
+            return getattr(self, "_last_known_gen",
+                           getattr(self, "_generation_at_start", 0))
+
+    def register(self):
+        """Announce membership; bump the generation so peers see the change."""
+        self.store.set(f"elastic/member/{self.rank}",
+                       str(time.time()).encode())
+        self.store.add("elastic/generation", 1)
+        self._generation_at_start = self._generation()
+        if self._hb_thread is None:
+            self._hb_thread = threading.Thread(target=self._heartbeat,
+                                               daemon=True)
+            self._hb_thread.start()
+
+    def _heartbeat(self):
+        while not self._stop.wait(self._interval):
+            try:
+                self.store.set(f"elastic/heartbeat/{self.rank}",
+                               str(time.time()).encode())
+            except Exception:
+                return
+
+    def alive_members(self, timeout: float = 30.0):
+        now = time.time()
+        alive = []
+        for r in range(self.np_hi):
+            key = f"elastic/heartbeat/{r}"
+            try:
+                if self.store.check(key):
+                    t = float(self.store.get(key).decode())
+                    if now - t < timeout:
+                        alive.append(r)
+            except Exception:
+                continue
+        return alive
+
+    # ------------------------------------------------------------- lifecycle
+    def watch(self) -> str:
+        """One poll step: detect scale events (generation bump by a joining /
+        leaving member)."""
+        if not self.enable:
+            return ElasticStatus.COMPLETED
+        if self._generation() != self._generation_at_start:
+            return ElasticStatus.RESTART
+        return ElasticStatus.HOLD
+
+    def should_restart(self) -> bool:
+        return self.watch() == ElasticStatus.RESTART
+
+    def exit_for_restart(self):
+        """Exit with the protocol code so the launcher relaunches us. The
+        current alive membership is written to PADDLE_ELASTIC_WORLD_FILE (if
+        set) so the supervisor respawns with the post-scale world size."""
+        world_file = os.environ.get("PADDLE_ELASTIC_WORLD_FILE")
+        if world_file:
+            try:
+                n = max(len(self.alive_members()), 1)
+                with open(world_file, "w") as f:
+                    f.write(str(min(max(n, self.np_lo), self.np_hi)))
+            except Exception:
+                pass
+        self.stop()
+        os._exit(ELASTIC_EXIT_CODE)
+
+    def signal_handler(self, sigint, frame):  # manager.py parity surface
+        self.stop()
+        signal.default_int_handler(sigint, frame)
+
+    def stop(self):
+        self._stop.set()
+
+    def exit(self, completed=True):
+        self.stop()
+        self.store.set(f"elastic/member/{self.rank}/done",
+                       b"1" if completed else b"0")

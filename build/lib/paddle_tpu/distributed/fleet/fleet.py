@@ -1,0 +1,118 @@
+"""Fleet facade (parity: python/paddle/distributed/fleet/fleet.py:167 init,
+:1326 distributed_optimizer; DistributedStrategy
+framework/distributed_strategy.proto).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from paddle_tpu.distributed import env as _env
+from paddle_tpu.distributed.fleet import topology as topo
+
+
+class DistributedStrategy:
+    """Subset of the reference's proto-backed strategy: the knobs that matter
+    on TPU. Unknown attributes are accepted and stored (proto parity)."""
+
+    def __init__(self):
+        self.hybrid_configs = {
+            "dp_degree": 1,
+            "mp_degree": 1,
+            "pp_degree": 1,
+            "sharding_degree": 1,
+            "sep_degree": 1,
+        }
+        self.pipeline_configs = {"accumulate_steps": 1, "micro_batch_size": 1}
+        self.amp = False
+        self.amp_configs = {}
+        self.recompute = False
+        self.recompute_configs = {}
+        self.sharding = False
+        self.sharding_configs = {}
+        self.gradient_merge = False
+        self.gradient_merge_configs = {}
+        self.find_unused_parameters = False
+
+    def __setattr__(self, k, v):
+        if k == "hybrid_configs" and hasattr(self, "hybrid_configs"):
+            merged = dict(self.__dict__["hybrid_configs"])
+            merged.update(v)
+            self.__dict__["hybrid_configs"] = merged
+        else:
+            self.__dict__[k] = v
+
+
+class _FleetState:
+    def __init__(self):
+        self.initialized = False
+        self.strategy: Optional[DistributedStrategy] = None
+        self.hcg: Optional[topo.HybridCommunicateGroup] = None
+
+
+_fleet = _FleetState()
+
+
+def init(role_maker=None, is_collective=False, strategy=None, log_level="INFO"):
+    """fleet.init parity: builds the HybridCommunicateGroup + hybrid mesh."""
+    strategy = strategy or DistributedStrategy()
+    _env.init_parallel_env()
+    hc = strategy.hybrid_configs
+    hcg = topo.HybridCommunicateGroup(
+        dp_degree=hc.get("dp_degree", 1),
+        mp_degree=hc.get("mp_degree", 1),
+        pp_degree=hc.get("pp_degree", 1),
+        sharding_degree=hc.get("sharding_degree", 1),
+        sep_degree=hc.get("sep_degree", 1),
+    )
+    topo.set_hybrid_communicate_group(hcg)
+    _fleet.initialized = True
+    _fleet.strategy = strategy
+    _fleet.hcg = hcg
+    return None
+
+
+def is_initialized():
+    return _fleet.initialized
+
+
+def get_hybrid_communicate_group():
+    return _fleet.hcg
+
+
+def worker_index():
+    return _env.get_rank()
+
+
+def worker_num():
+    return _env.get_world_size()
+
+
+def distributed_model(model):
+    """fleet.distributed_model parity: wrap per active topology axes."""
+    from paddle_tpu.distributed.fleet import meta_parallel as mp
+
+    hcg = _fleet.hcg
+    if hcg is None:
+        return model
+    if hcg.get_pipe_parallel_world_size() > 1:
+        return mp.PipelineParallel(model, hcg, _fleet.strategy)
+    if hcg.get_model_parallel_world_size() > 1:
+        return mp.TensorParallel(model, hcg, _fleet.strategy)
+    if hcg.get_sep_parallel_world_size() > 1:
+        return mp.SegmentParallel(model, hcg, _fleet.strategy)
+    if hcg.get_sharding_parallel_world_size() > 1:
+        return mp.ShardingParallel(model, hcg, _fleet.strategy)
+    if hcg.get_data_parallel_world_size() > 1:
+        from paddle_tpu.distributed.parallel import DataParallel
+
+        return DataParallel(model)
+    return model
+
+
+def distributed_optimizer(optimizer, strategy=None):
+    """fleet.distributed_optimizer parity (fleet.py:1326): on TPU the hybrid
+    grad sync is emitted by GSPMD inside the compiled step, so the optimizer
+    passes through with topology metadata attached."""
+    optimizer._hcg = _fleet.hcg
+    return optimizer

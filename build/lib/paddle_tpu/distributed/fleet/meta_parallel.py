@@ -1,0 +1,129 @@
+"""Meta-parallel wrappers (parity: python/paddle/distributed/fleet/
+meta_parallel/{tensor_parallel.py:32, pipeline_parallel.py:149,
+segment_parallel.py, sharding_parallel.py}).
+
+Under SPMD these wrappers are thin: the heavy lifting is in the layers'
+shardings (mp_layers), the pipeline engine (pipeline.py), and the mesh. Each
+wrapper shards the incoming batch over its data-like axes and keeps paddle's
+train_batch-style API.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from paddle_tpu.nn.layer_base import Layer
+from paddle_tpu.tensor import Tensor
+
+
+class _MetaParallelBase(Layer):
+    def __init__(self, layers, hcg, strategy=None):
+        super().__init__()
+        self._layers = layers
+        self._hcg = hcg
+        self._strategy = strategy
+
+    def forward(self, *inputs, **kwargs):
+        return self._layers(*inputs, **kwargs)
+
+    def parameters(self, include_sublayers=True):
+        return self._layers.parameters(include_sublayers)
+
+    def named_parameters(self, prefix="", include_sublayers=True):
+        return self._layers.named_parameters(prefix, include_sublayers)
+
+    def state_dict(self, *args, **kwargs):
+        return self._layers.state_dict(*args, **kwargs)
+
+    def set_state_dict(self, state_dict, *args, **kwargs):
+        return self._layers.set_state_dict(state_dict, *args, **kwargs)
+
+
+class TensorParallel(_MetaParallelBase):
+    """TP wrapper: batch sharded over dp, params already mp-sharded by the
+    mp_layers; parameter broadcast across dp is a replication device_put."""
+
+    def _shard_batch(self, t):
+        mesh = self._hcg.get_mesh()
+        if t.shape and t.shape[0] % mesh.shape["dp"] == 0:
+            v = jax.device_put(
+                t._value,
+                NamedSharding(mesh, P(("dp",), *([None] * (t._value.ndim - 1)))),
+            )
+            out = Tensor._from_value(v)
+            out.stop_gradient = t.stop_gradient
+            return out
+        return t
+
+    def forward(self, *inputs, **kwargs):
+        inputs = tuple(
+            self._shard_batch(i) if isinstance(i, Tensor) else i for i in inputs
+        )
+        return self._layers(*inputs, **kwargs)
+
+
+class SegmentParallel(_MetaParallelBase):
+    """sep wrapper: shards the sequence dim (dim 1) over the sep axis
+    (segment_parallel.py:26-40 broadcast semantics fall out of replication)."""
+
+    def _shard_batch(self, t):
+        mesh = self._hcg.get_mesh()
+        if t._value.ndim >= 2 and t.shape[1] % mesh.shape["sep"] == 0:
+            spec = [None] * t._value.ndim
+            spec[1] = "sep"
+            v = jax.device_put(t._value, NamedSharding(mesh, P(*spec)))
+            out = Tensor._from_value(v)
+            out.stop_gradient = t.stop_gradient
+            return out
+        return t
+
+    def forward(self, *inputs, **kwargs):
+        inputs = tuple(
+            self._shard_batch(i) if isinstance(i, Tensor) else i for i in inputs
+        )
+        return self._layers(*inputs, **kwargs)
+
+
+class ShardingParallel(_MetaParallelBase):
+    pass
+
+
+class PipelineParallel(_MetaParallelBase):
+    """PP wrapper exposing train_batch (pipeline_parallel.py:697).
+
+    Requires the wrapped model to implement the stacked-stage protocol:
+    ``pipeline_forward(x, num_microbatches)`` built on
+    fleet.pipeline.spmd_pipeline (see models/gpt.py). The schedule is the
+    compiled SPMD wavefront, not a per-rank interpreter.
+    """
+
+    def __init__(self, layers, hcg, strategy=None):
+        super().__init__(layers, hcg, strategy)
+        cfg = (strategy.pipeline_configs if strategy else {}) or {}
+        self._micro_batches = cfg.get("accumulate_steps", 1)
+
+    def forward(self, *inputs, **kwargs):
+        if hasattr(self._layers, "pipeline_forward"):
+            return self._layers.pipeline_forward(
+                *inputs, num_microbatches=self._micro_batches, **kwargs
+            )
+        return self._layers(*inputs, **kwargs)
+
+    def train_batch(self, data, optimizer, lr_scheduler=None, scaler=None):
+        x, y = data
+        loss = self._layers.pipeline_loss(
+            x, y, num_microbatches=self._micro_batches
+        ) if hasattr(self._layers, "pipeline_loss") else None
+        if loss is None:
+            out = self.forward(x)
+            import paddle_tpu.nn.functional as F
+
+            loss = F.cross_entropy(out, y)
+        loss.backward()
+        optimizer.step()
+        optimizer.clear_grad()
+        if lr_scheduler is not None:
+            lr_scheduler.step()
+        return loss

@@ -1,0 +1,54 @@
+"""Manual-mode tensor-parallel collectives (reference:
+python/paddle/distributed/fleet/layers/mpu/mp_ops.py — the
+_c_identity/_c_allreduce conjugate pair every Megatron block is built from).
+
+These are for shard_map MANUAL code (the pipeline schedule engine, custom
+kernels); the GSPMD path (fleet/mp_layers.py) doesn't need them — sharding
+constraints let XLA insert collectives with correct transposes. Under
+manual mode `lax.psum` transposes to another psum, which double-counts
+cotangents whenever the loss is computed replicated on every model-parallel
+member, hence the explicit conjugate pair:
+
+- ``mp_reduce``  (Megatron "g"): all-reduce forward, identity backward —
+  at a row-parallel output.
+- ``mp_identity`` (Megatron "f"): identity forward, all-reduce backward —
+  at a column-parallel input.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
+def mp_reduce(x, axis_name: str):
+    return jax.lax.psum(x, axis_name)
+
+
+def _mp_reduce_fwd(x, axis_name):
+    return jax.lax.psum(x, axis_name), None
+
+
+def _mp_reduce_bwd(axis_name, _, ct):
+    return (ct,)
+
+
+mp_reduce.defvjp(_mp_reduce_fwd, _mp_reduce_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
+def mp_identity(x, axis_name: str):
+    return x
+
+
+def _mp_identity_fwd(x, axis_name):
+    return x, None
+
+
+def _mp_identity_bwd(axis_name, _, ct):
+    return (jax.lax.psum(ct, axis_name),)
+
+
+mp_identity.defvjp(_mp_identity_fwd, _mp_identity_bwd)

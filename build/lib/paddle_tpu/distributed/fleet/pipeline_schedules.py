@@ -1,0 +1,526 @@
+"""Pipeline schedules: FThenB, 1F1B, interleaved (VPP), zero-bubble.
+
+Parity targets:
+- 1F1B / FThenB runtimes:
+  python/paddle/distributed/fleet/meta_parallel/pipeline_parallel.py:459,697
+- interleaved/VPP: pipeline_parallel.py:1010 (PipelineParallelWithInterleave)
+- zero-bubble:
+  python/paddle/distributed/passes/pipeline_scheduler_pass/pipeline_zero_bubble.py
+
+TPU-native redesign. The reference hand-schedules per-rank processes with NCCL
+p2p. Here a schedule is a *static table* op[t, s] ∈ {IDLE, F, B, W} + slot[t, s]
+produced by an event-driven simulator (make_pipeline_schedule). One compiled
+SPMD engine (schedule_pipeline_grads) executes any table: a lax.scan over
+ticks where each device lax.switch-es on its opcode — F runs the stage block,
+B recomputes + produces the input-cotangent (dgrad), W produces the
+weight-cotangent (wgrad; zero-bubble's filler work), and activations /
+cotangents hop stages via lax.ppermute (collective-permute on ICI). Splitting
+B/W is exactly what zero-bubble needs and what XLA's HLO conditional makes
+free: only the taken branch executes per device per tick.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.ops.ring_attention import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+# megatron f/g conjugate collectives for manual-mode TP blocks live in
+# fleet/mp_ops.py; re-exported here because hybrid TP x PP block_fns are
+# this engine's main manual-mode consumer
+from paddle_tpu.distributed.fleet.mp_ops import (  # noqa: F401
+    mp_identity as megatron_identity,
+    mp_reduce as megatron_reduce,
+)
+
+IDLE, F_OP, B_OP, W_OP = 0, 1, 2, 3
+_OP_COST = {IDLE: 1.0, F_OP: 1.0, B_OP: 2.0, W_OP: 1.0}
+# B in a fused schedule (dgrad+wgrad together) costs ~2 F-units; in a split
+# (zero-bubble) schedule B=dgrad and W=wgrad each cost ~1.
+
+
+@dataclasses.dataclass
+class PipelineSchedule:
+    """Static schedule table + stats."""
+
+    policy: str
+    num_stages: int
+    num_microbatches: int
+    op: np.ndarray    # [T, S] int opcodes
+    slot: np.ndarray  # [T, S] microbatch index per op (0 when IDLE)
+    split_bw: bool    # True when B is dgrad-only and W ops exist
+
+    @property
+    def num_ticks(self) -> int:
+        return self.op.shape[0]
+
+    def bubble_fraction(self) -> float:
+        """Weighted idle fraction: idle-time / total-time, where F=1, W=1,
+        B=2 (fused) or 1 (split)."""
+        b_cost = 1.0 if self.split_bw else 2.0
+        cost = {IDLE: 0.0, F_OP: 1.0, B_OP: b_cost, W_OP: 1.0}
+        busy = sum(cost[int(self.op[t, s])]
+                   for s in range(self.num_stages)
+                   for t in range(self.num_ticks))
+        # wall-clock: each tick is as long as its most expensive op anywhere
+        # (the scan step is a lock-step SPMD program)
+        wall = sum(max(max(cost[int(self.op[t, s])]
+                           for s in range(self.num_stages)), 1.0)
+                   for t in range(self.num_ticks))
+        return 1.0 - busy / (wall * self.num_stages)
+
+    def peak_in_flight(self) -> int:
+        """Max number of microbatches with F done but B not yet done on any
+        stage — the activation-memory high-water mark (1F1B < FThenB)."""
+        peak = 0
+        for s in range(self.num_stages):
+            live = 0
+            for t in range(self.num_ticks):
+                if self.op[t, s] == F_OP:
+                    live += 1
+                elif self.op[t, s] == B_OP:
+                    live -= 1
+                peak = max(peak, live)
+        return peak
+
+
+def make_pipeline_schedule(num_stages: int, num_microbatches: int,
+                           policy: str = "1F1B") -> PipelineSchedule:
+    """Event-driven list scheduling honoring pipeline dependencies.
+
+    Dependencies: F(s,m) after F(s-1,m); B(S-1,m) after F(S-1,m);
+    B(s,m) after B(s+1,m); W(s,m) after B(s,m). A message produced at tick t
+    is consumable from tick t+1 (one-hop ppermute latency).
+    """
+    S, M = num_stages, num_microbatches
+    policy = policy.upper().replace("-", "_")
+    split_bw = policy in ("ZERO_BUBBLE", "ZB", "ZBH1")
+    f_done = [[-1] * M for _ in range(S)]   # tick F completed
+    b_done = [[-1] * M for _ in range(S)]
+    w_queue: List[List[int]] = [[] for _ in range(S)]
+    next_f = [0] * S
+    next_b = [0] * S
+    ops: List[List[Tuple[int, int]]] = []  # per tick: per stage (op, slot)
+
+    def in_flight(s):
+        return next_f[s] - next_b[s]
+
+    # 1F1B in-flight cap: stage s holds at most S - s live microbatches
+    def flight_cap(s):
+        if policy == "F_THEN_B" or policy == "FTHENB":
+            return M
+        return S - s
+
+    t = 0
+    while (any(m < M for m in next_b)
+           or any(w_queue[s] for s in range(S))):
+        row = []
+        for s in range(S):
+            op, slot = IDLE, 0
+            m_f, m_b = next_f[s], next_b[s]
+            can_f = (m_f < M
+                     and (s == 0 or (f_done[s - 1][m_f] >= 0
+                                     and f_done[s - 1][m_f] < t))
+                     and in_flight(s) < flight_cap(s))
+            can_b = (m_b < M and f_done[s][m_b] >= 0
+                     and (s == S - 1 or (b_done[s + 1][m_b] >= 0
+                                         and b_done[s + 1][m_b] < t)))
+            prefer_b = policy != "F_THEN_B" and policy != "FTHENB" \
+                and in_flight(s) >= flight_cap(s)
+            if can_b and (prefer_b or not can_f):
+                op, slot = B_OP, m_b
+                b_done[s][m_b] = t
+                next_b[s] += 1
+                if split_bw:
+                    w_queue[s].append(m_b)
+            elif can_f:
+                op, slot = F_OP, m_f
+                f_done[s][m_f] = t
+                next_f[s] += 1
+            elif split_bw and w_queue[s]:
+                op, slot = W_OP, w_queue[s].pop(0)
+            row.append((op, slot))
+        ops.append(row)
+        t += 1
+        if t > 20 * (M + S) * 3:
+            raise RuntimeError("schedule simulation did not converge")
+
+    op_arr = np.asarray([[o for o, _ in row] for row in ops], np.int32)
+    slot_arr = np.asarray([[m for _, m in row] for row in ops], np.int32)
+    return PipelineSchedule(policy=policy, num_stages=S, num_microbatches=M,
+                            op=op_arr, slot=slot_arr, split_bw=split_bw)
+
+
+# ---------------------------------------------------------------------------
+# Schedule-table-driven SPMD engine (fwd + bwd, manual VJP)
+# ---------------------------------------------------------------------------
+
+
+def schedule_pipeline_grads(
+    block_fn: Callable[[Any, jax.Array], jax.Array],
+    loss_fn: Callable[[jax.Array, jax.Array], jax.Array],
+    layer_params: Any,
+    x: jax.Array,
+    y: jax.Array,
+    *,
+    mesh: Mesh,
+    schedule: PipelineSchedule,
+    axis: str = "pp",
+    param_specs: Any = None,
+):
+    """Execute fwd+bwd per the schedule table; returns (mean_loss, grads).
+
+    layer_params leaves: [L, ...] with L = S * layers_per_stage, sharded
+    P(axis) by default. ``param_specs`` (optional pytree of PartitionSpecs,
+    FIRST entry must be the pipeline axis) enables hybrid TP x PP: other
+    entries shard each stage's weights over a model axis, and block_fn is
+    then responsible for its own model-axis collectives — use the
+    mp_identity/mp_reduce (megatron f/g) pair from fleet/mp_ops, NOT plain
+    lax.psum (its manual-mode transpose double-counts cotangents).
+    x: [B, ...] microbatched inputs (uniform activation shape
+    through stages; stage 0 consumes x directly). y: [B, ...] labels consumed
+    by loss_fn at the last stage. Gradients are rematerialized (B and W
+    re-run the stage forward from the saved stage input), giving 1F1B's
+    memory profile; B emits only the input-cotangent and W only the
+    weight-cotangent, so zero-bubble tables genuinely fill bubbles with W.
+    """
+    S = schedule.num_stages
+    M = schedule.num_microbatches
+    assert mesh.shape[axis] == S
+    B = x.shape[0]
+    assert B % M == 0
+    mb = B // M
+
+    leaves = jax.tree_util.tree_leaves(layer_params)
+    L = leaves[0].shape[0]
+    assert L % S == 0
+    lps = L // S
+
+    op_tab = jnp.asarray(schedule.op)      # [T, S]
+    slot_tab = jnp.asarray(schedule.slot)  # [T, S]
+    T = schedule.num_ticks
+
+    # receive tables: what did my neighbor process last tick?
+    # fwd msg from s-1 (an F there) / bwd msg from s+1 (a B there)
+    prev_f_mask = np.zeros((T, S), bool)
+    prev_f_slot = np.zeros((T, S), np.int32)
+    prev_b_mask = np.zeros((T, S), bool)
+    prev_b_slot = np.zeros((T, S), np.int32)
+    for t in range(1, T):
+        for s in range(S):
+            if s > 0 and schedule.op[t - 1, s - 1] == F_OP:
+                prev_f_mask[t, s] = True
+                prev_f_slot[t, s] = schedule.slot[t - 1, s - 1]
+            if s < S - 1 and schedule.op[t - 1, s + 1] == B_OP:
+                prev_b_mask[t, s] = True
+                prev_b_slot[t, s] = schedule.slot[t - 1, s + 1]
+    prev_f_mask = jnp.asarray(prev_f_mask)
+    prev_f_slot = jnp.asarray(prev_f_slot)
+    prev_b_mask = jnp.asarray(prev_b_mask)
+    prev_b_slot = jnp.asarray(prev_b_slot)
+
+    fwd_perm = [(i, (i + 1) % S) for i in range(S)]
+    bwd_perm = [((i + 1) % S, i) for i in range(S)]
+
+    def stage_forward(params_local, h):
+        def body(h, p):
+            return block_fn(p, h), None
+
+        h, _ = jax.lax.scan(body, h, params_local)
+        return h
+
+    def engine(params_local, x_local, y_local):
+        stage = jax.lax.axis_index(axis)
+        params_local = jax.tree_util.tree_map(
+            lambda a: a.reshape((lps,) + a.shape[1:]), params_local)
+        act_shape = (M,) + x_local.shape[1:]
+
+        state = dict(
+            acts=jnp.zeros(act_shape, x_local.dtype),    # saved stage inputs
+            gouts=jnp.zeros(act_shape, x_local.dtype),   # saved out-cotangents
+            fmsg=jnp.zeros(x_local.shape[1:], x_local.dtype),
+            bmsg=jnp.zeros(x_local.shape[1:], x_local.dtype),
+            pgrad=jax.tree_util.tree_map(jnp.zeros_like, params_local),
+            loss=jnp.zeros((), jnp.float32),
+        )
+
+        def do_idle(state, m, t):
+            z = jnp.zeros(x_local.shape[1:], x_local.dtype)
+            return state, z, z
+
+        def do_f(state, m, t):
+            h_in = jnp.where(stage == 0,
+                             jax.lax.dynamic_index_in_dim(
+                                 x_local, m, 0, keepdims=False),
+                             jax.lax.dynamic_index_in_dim(
+                                 state["acts"], m, 0, keepdims=False))
+            acts = jax.lax.dynamic_update_index_in_dim(
+                state["acts"], h_in, m, 0)
+            h_out = stage_forward(params_local, h_in)
+
+            # last stage only: loss + self-seeded output cotangent (the cond
+            # keeps the loss vjp off the other stages' F ticks)
+            y_m = jax.lax.dynamic_index_in_dim(y_local, m, 0, keepdims=False)
+            is_last = stage == S - 1
+
+            def seed(args):
+                gouts, loss = args
+                loss_m, lvjp = jax.vjp(lambda hh: loss_fn(hh, y_m), h_out)
+                # total loss is the MEAN over microbatches: seed with 1/M
+                (g_seed,) = lvjp(jnp.full((), 1.0 / M, loss_m.dtype))
+                gouts = jax.lax.dynamic_update_index_in_dim(
+                    gouts, g_seed.astype(x_local.dtype), m, 0)
+                return gouts, loss + loss_m.astype(jnp.float32)
+
+            gouts, loss = jax.lax.cond(
+                is_last, seed, lambda a: a, (state["gouts"], state["loss"]))
+            state = dict(state, acts=acts, gouts=gouts, loss=loss)
+            z = jnp.zeros(x_local.shape[1:], x_local.dtype)
+            return state, h_out, z
+
+        def do_b(state, m, t):
+            # dgrad: cotangent wrt the stage input; g_out comes from the
+            # mailbox (stored at receive time / seeded by own F on last stage)
+            h_in = jax.lax.dynamic_index_in_dim(
+                state["acts"], m, 0, keepdims=False)
+            g_out = jax.lax.dynamic_index_in_dim(
+                state["gouts"], m, 0, keepdims=False)
+            if schedule.split_bw:
+                # dgrad only; wgrad deferred to a W tick
+                _, hvjp = jax.vjp(
+                    lambda hh: stage_forward(params_local, hh), h_in)
+                (g_in,) = hvjp(g_out)
+            else:
+                # fused B: one vjp (one rematerialized forward) yields both
+                _, vjp = jax.vjp(stage_forward, params_local, h_in)
+                gp, g_in = vjp(g_out)
+                pgrad = jax.tree_util.tree_map(
+                    jnp.add, state["pgrad"], gp)
+                state = dict(state, pgrad=pgrad)
+            return state, jnp.zeros(x_local.shape[1:], x_local.dtype), g_in
+
+        def do_w(state, m, t):
+            h_in = jax.lax.dynamic_index_in_dim(
+                state["acts"], m, 0, keepdims=False)
+            g_out = jax.lax.dynamic_index_in_dim(
+                state["gouts"], m, 0, keepdims=False)
+            _, pvjp = jax.vjp(lambda pp: stage_forward(pp, h_in), params_local)
+            (gp,) = pvjp(g_out)
+            pgrad = jax.tree_util.tree_map(jnp.add, state["pgrad"], gp)
+            z = jnp.zeros(x_local.shape[1:], x_local.dtype)
+            return dict(state, pgrad=pgrad), z, z
+
+        def tick(state, t):
+            op = op_tab[t, stage]
+            m = slot_tab[t, stage]
+            state, fsend, bsend = jax.lax.switch(
+                op, [do_idle, do_f, do_b, do_w], state, m, t)
+            # hop: activations forward, cotangents backward (uniform
+            # collectives — every device participates every tick)
+            fmsg = jax.lax.ppermute(fsend, axis, fwd_perm)
+            bmsg = jax.lax.ppermute(bsend, axis, bwd_perm)
+            # mailbox delivery at t+1 (tables are shifted by one already)
+            return dict(state, fmsg=fmsg, bmsg=bmsg), None
+
+        def deliver_then_tick(state, t):
+            # store messages received at the END of tick t-1 into mailboxes
+            fm = prev_f_mask[t, stage]
+            fs = prev_f_slot[t, stage]
+            acts = jax.lax.cond(
+                fm,
+                lambda a: jax.lax.dynamic_update_index_in_dim(
+                    a, state["fmsg"], fs, 0),
+                lambda a: a,
+                state["acts"])
+            bm = prev_b_mask[t, stage]
+            bs = prev_b_slot[t, stage]
+            gouts = jax.lax.cond(
+                bm,
+                lambda g: jax.lax.dynamic_update_index_in_dim(
+                    g, state["bmsg"], bs, 0),
+                lambda g: g,
+                state["gouts"])
+            state = dict(state, acts=acts, gouts=gouts)
+            return tick(state, t)
+
+        state, _ = jax.lax.scan(deliver_then_tick, state, jnp.arange(T))
+
+        # stage-s grads live on device s; the P(axis) out_spec reassembles
+        # the per-stage [lps, ...] blocks into the global [L, ...] layout
+        loss = jax.lax.psum(state["loss"], axis) / M
+        return loss[None], state["pgrad"]
+
+    x_mb = x.reshape(M, mb, *x.shape[1:])
+    y_mb = y.reshape(M, mb, *y.shape[1:])
+
+    # hybrid TP x PP: caller may give per-leaf specs whose FIRST entry is
+    # the pipeline axis and whose other entries shard inside the stage (the
+    # Fleet HybridParallel layout); block_fn is then responsible for its own
+    # model-axis collectives (megatron psum) — shard_map runs manual over
+    # every mesh axis
+    p_specs = (param_specs if param_specs is not None
+               else jax.tree_util.tree_map(lambda _: P(axis), layer_params))
+    in_specs = (p_specs, P(), P())
+    out_specs = (P(axis), p_specs)
+
+    loss_st, grads = shard_map(
+        engine, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+        check_rep=False,
+    )(layer_params, x_mb, y_mb)
+    return loss_st[0], grads
+
+
+# ---------------------------------------------------------------------------
+# Interleaved / VPP circular pipeline (autodiff path)
+# ---------------------------------------------------------------------------
+
+
+def spmd_pipeline_interleaved(
+    block_fn: Callable[[Any, jax.Array], jax.Array],
+    layer_params: Any,
+    x: jax.Array,
+    *,
+    mesh: Mesh,
+    num_microbatches: int,
+    num_virtual_stages: int,
+    axis: str = "pp",
+    remat: bool = True,
+):
+    """Interleaved (VPP) pipeline: each device holds V chunks; global stage
+    order is chunk-major (chunk v on device s = global stage v*S + s), so a
+    microbatch circles the ring V times (reference:
+    PipelineParallelWithInterleave, pipeline_parallel.py:1010).
+
+    Wall-clock in layer-units: M*V + S - 1 vs GPipe's (M + S - 1)*V — the
+    bubble shrinks by V. Requires M >= S (slot stream validity).
+
+    layer_params leaves: [L, ...], L = S * V * layers_per_chunk.
+    """
+    S = mesh.shape[axis]
+    V = num_virtual_stages
+    M = num_microbatches
+    B = x.shape[0]
+    assert B % M == 0 and M >= S, (B, M, S)
+    mb = B // M
+
+    leaves = jax.tree_util.tree_leaves(layer_params)
+    L = leaves[0].shape[0]
+    assert L % (S * V) == 0
+    lpc = L // (S * V)  # layers per chunk
+
+    if remat:
+        block_fn = jax.checkpoint(block_fn)
+
+    T = M * V + S - 1
+    fwd_perm = [(i, (i + 1) % S) for i in range(S)]
+
+    def chunk_apply(chunk_params, h):
+        def body(h, p):
+            return block_fn(p, h), None
+
+        h, _ = jax.lax.scan(body, h, chunk_params)
+        return h
+
+    def pipelined(params_local, x_local):
+        # params_local leaves: [V, lpc, ...] after reshape; chunk-major:
+        # chunk v of device s = global layers [(v*S + s)*lpc, ...)
+        stage = jax.lax.axis_index(axis)
+        state = jnp.zeros_like(x_local[0])
+        wrapped = jnp.zeros((M,) + x_local.shape[1:], x_local.dtype)
+        outputs = jnp.zeros((M,) + x_local.shape[1:], x_local.dtype)
+
+        def tick(carry, t):
+            state, wrapped, outputs = carry
+            j = t - stage                      # my slot this tick
+            valid = jnp.logical_and(j >= 0, j < M * V)
+            v = jnp.clip(j // M, 0, V - 1)     # chunk index
+            m = jnp.clip(j % M, 0, M - 1)      # microbatch index
+            # input: stage 0 chunk 0 <- feed; stage 0 chunk>0 <- wrapped[m];
+            # others <- ring state
+            feed = jax.lax.dynamic_index_in_dim(x_local, m, 0, keepdims=False)
+            wrap_in = jax.lax.dynamic_index_in_dim(wrapped, m, 0,
+                                                   keepdims=False)
+            h = jnp.where(stage == 0, jnp.where(v == 0, feed, wrap_in), state)
+            chunk_params = jax.tree_util.tree_map(
+                lambda a: jax.lax.dynamic_index_in_dim(a, v, 0,
+                                                       keepdims=False),
+                params_local)
+            h = chunk_apply(chunk_params, h)
+            h = jnp.where(valid, h, state)
+            # last device, last chunk -> output; otherwise hop the ring
+            write_out = jnp.logical_and(
+                jnp.logical_and(stage == S - 1, v == V - 1), valid)
+            outputs = jax.lax.cond(
+                write_out,
+                lambda o: jax.lax.dynamic_update_index_in_dim(o, h, m, 0),
+                lambda o: o, outputs)
+            nxt = jax.lax.ppermute(h, axis, fwd_perm)
+            # device 0 stores ring-wrapped activations for its next chunk
+            sender_j = t - (S - 1)             # slot device S-1 just finished
+            sender_v = jnp.clip(sender_j // M, 0, V - 1)
+            sender_m = jnp.clip(sender_j % M, 0, M - 1)
+            store = jnp.logical_and(
+                stage == 0,
+                jnp.logical_and(sender_j >= 0, sender_v < V - 1))
+            wrapped = jax.lax.cond(
+                store,
+                lambda wbuf: jax.lax.dynamic_update_index_in_dim(
+                    wbuf, nxt, sender_m, 0),
+                lambda wbuf: wbuf, wrapped)
+            return (nxt, wrapped, outputs), None
+
+        (state, wrapped, outputs), _ = jax.lax.scan(
+            tick, (state, wrapped, outputs), jnp.arange(T))
+        return outputs[None]
+
+    x_mb = x.reshape(M, mb, *x.shape[1:])
+    in_specs = (jax.tree_util.tree_map(lambda _: P(axis), layer_params), P())
+    out_specs = P(axis)
+
+    def wrapper(params_local, x_local):
+        # device-local leaves arrive as [L/S, ...] = [V*lpc, ...] but in
+        # GLOBAL chunk-major order the device's chunks are strided: global
+        # layer (v*S + s)*lpc + k. Reorganize: the P(axis) shard gives layers
+        # [s*L/S, (s+1)*L/S) — contiguous, NOT chunk-major. So expect the
+        # caller to pass params already chunk-major-permuted (see
+        # interleave_params), making the local slice [V, lpc, ...].
+        params_local = jax.tree_util.tree_map(
+            lambda a: a.reshape((V, lpc) + a.shape[1:]), params_local)
+        return pipelined(params_local, x_local)
+
+    y_st = shard_map(wrapper, mesh=mesh, in_specs=in_specs,
+                     out_specs=out_specs, check_rep=False)(layer_params, x_mb)
+    y_mb = y_st[S - 1]
+    return y_mb.reshape(B, *x.shape[1:])
+
+
+def interleave_params(layer_params: Any, num_stages: int,
+                      num_virtual_stages: int):
+    """Permute [L, ...] stacked params from layer order into the layout
+    spmd_pipeline_interleaved expects: device s's shard holds its V chunks
+    contiguously ([s] <- chunks v*S+s for v in 0..V)."""
+    S, V = num_stages, num_virtual_stages
+
+    def permute(a):
+        L = a.shape[0]
+        lpc = L // (S * V)
+        blocks = a.reshape(V, S, lpc, *a.shape[1:])   # [v, s, k, ...]
+        return jnp.swapaxes(blocks, 0, 1).reshape(a.shape)  # [s, v, k, ...]
+
+    return jax.tree_util.tree_map(permute, layer_params)
+
+
+def gpipe_tick_units(S: int, M: int, V: int = 1) -> int:
+    """GPipe forward wall-clock in layer-units (each tick runs V*lpc layers)."""
+    return (M + S - 1) * V
+
+
+def vpp_tick_units(S: int, M: int, V: int) -> int:
+    """Interleaved forward wall-clock in layer-units."""
+    return M * V + S - 1

@@ -1,0 +1,2 @@
+from paddle_tpu.distributed.fleet.utils.recompute import recompute  # noqa: F401
+from paddle_tpu.distributed.fleet.utils import sequence_parallel_utils  # noqa: F401

@@ -1,0 +1,57 @@
+"""Activation recompute (parity: python/paddle/distributed/fleet/recompute/
+recompute.py:109 RecomputeFunction + recompute_hybrid.py).
+
+TPU-native: ``jax.checkpoint`` IS recompute — residuals are dropped and the
+forward re-runs inside the backward, scheduled by XLA. The reference's RNG
+state tracker (parallel_layers/random.py) is unnecessary: dropout keys are
+functional inputs, so replayed forwards see identical randomness by
+construction.
+"""
+
+from __future__ import annotations
+
+import jax
+
+from paddle_tpu.core.dispatch import apply
+from paddle_tpu.jit.functional import tree_unwrap, tree_wrap
+from paddle_tpu.tensor import Tensor
+
+
+def recompute(function, *args, use_reentrant=True, preserve_rng_state=True,
+              **kwargs):
+    """paddle.distributed.fleet.utils.recompute parity.
+
+    ``function``'s tensor args are rematerialized; parameters captured by
+    closure are threaded as explicit checkpoint inputs so their activations
+    are also dropped.
+    """
+    # collect closure params if function is a Layer (common case)
+    layer = getattr(function, "__self__", None)
+    if layer is None and hasattr(function, "parameters"):
+        layer = function
+    extra_params = list(layer.parameters()) if layer is not None else []
+
+    tensor_args = [a for a in args if isinstance(a, Tensor)]
+    all_inputs = tensor_args + extra_params
+
+    def raw(*vals):
+        n = len(tensor_args)
+        arg_vals, param_vals = vals[:n], vals[n:]
+        from paddle_tpu.autograd import tape
+        from paddle_tpu.jit.functional import swap_values
+
+        wrapped = iter(tree_wrap(list(arg_vals)))
+        call_args = [next(wrapped) if isinstance(a, Tensor) else a for a in args]
+        # the outer jax.vjp differentiates this whole rematerialized body;
+        # per-op tape recording inside it would nest vjp-in-vjp (breaking
+        # custom-vjp kernels like pallas flash attention) for no benefit
+        with tape.no_grad():
+            if extra_params:
+                with swap_values(extra_params, list(param_vals)):
+                    out = function(*call_args, **kwargs)
+                    return tree_unwrap(out)
+            out = function(*call_args, **kwargs)
+            return tree_unwrap(out)
+
+    ckpt = jax.checkpoint(raw)
+    return apply("recompute", ckpt, *all_inputs)

@@ -1,0 +1,98 @@
+"""Megatron-style sequence parallelism (parity: python/paddle/distributed/
+fleet/utils/sequence_parallel_utils.py:41-80 — ScatterOp, GatherOp,
+ReduceScatterOp, AllGatherOp, mark_as_sequence_parallel_parameter).
+
+TPU-native: scatter/gather along the sequence dim inside the TP group are
+sharding-constraint flips between P(seq=None) and P(seq="mp") — GSPMD lowers
+them to the same all-gather / reduce-scatter the reference issues by hand,
+but can fuse them with the adjacent matmuls (the allgather-overlap its
+pass library chases, auto_parallel_sequence_parallel_optimization.py).
+"""
+
+from __future__ import annotations
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from paddle_tpu.core.dispatch import apply
+from paddle_tpu.distributed.fleet import topology as topo
+from paddle_tpu.tensor import Tensor
+
+
+def _mesh():
+    hcg = topo.get_hybrid_communicate_group()
+    return hcg.get_mesh() if hcg is not None else None
+
+
+def _constrain_seq(x: Tensor, shard: bool) -> Tensor:
+    mesh = _mesh()
+    if mesh is None or mesh.shape["mp"] <= 1:
+        return x
+    spec = [None] * x._value.ndim
+    if shard:
+        spec[0] = "mp"  # sequence-major [s, b, h] layout, reference convention
+    return apply(
+        "seq_parallel_constraint",
+        lambda v: jax.lax.with_sharding_constraint(
+            v, NamedSharding(mesh, P(*spec))
+        ),
+        x,
+    )
+
+
+class ScatterOp:
+    """Split the sequence dim across the TP group (forward scatter,
+    backward all-gather — autodiff of the constraint gives this for free)."""
+
+    @staticmethod
+    def apply(input, axis=0):
+        return _constrain_seq(input, shard=True)
+
+
+class GatherOp:
+    """All-gather the sequence dim (forward gather, backward scatter)."""
+
+    @staticmethod
+    def apply(input, axis=0):
+        return _constrain_seq(input, shard=False)
+
+
+class AllGatherOp:
+    @staticmethod
+    def apply(input):
+        return _constrain_seq(input, shard=False)
+
+
+class ReduceScatterOp:
+    """Partial-sum activations -> reduce-scatter over seq (XLA emits it when
+    the producer is a row-parallel matmul and the consumer wants the shard)."""
+
+    @staticmethod
+    def apply(input):
+        return _constrain_seq(input, shard=True)
+
+
+def scatter(input, axis=0):
+    return ScatterOp.apply(input, axis)
+
+
+def all_gather(input, axis=0):
+    return GatherOp.apply(input, axis)
+
+
+def mark_as_sequence_parallel_parameter(parameter):
+    parameter.sequence_parallel = True
+
+
+def is_sequence_parallel_parameter(parameter):
+    return getattr(parameter, "sequence_parallel", False)
+
+
+def create_fused_allreduce_gradient_hooks(model, accumulation_steps):
+    return []
+
+
+def register_sequence_parallel_allreduce_hooks(model, accumulation_steps=1,
+                                               fuse_sequence_parallel_allreduce=False):
+    # GSPMD already reduces sequence-parallel param grads over mp; no hooks.
+    pass

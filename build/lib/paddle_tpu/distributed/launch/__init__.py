@@ -1,0 +1,13 @@
+"""paddle.distributed.launch parity (reference:
+python/paddle/distributed/launch/ — collective controller, pod/container
+model, env-var rendezvous PADDLE_TRAINER_ID / PADDLE_TRAINERS_NUM /
+PADDLE_CURRENT_ENDPOINT consumed at parallel.py:1043-1047).
+
+TPU-native: on a TPU pod each *host* runs one controller process and
+jax.distributed handles rendezvous via the pod coordination service, so the
+launcher's job collapses to: set the paddle-shaped env vars, initialize
+jax.distributed when a coordinator is configured, and exec the training
+script (optionally once per local device group for multi-process CPU
+testing — the reference's multi-process-single-host test pattern)."""
+
+from paddle_tpu.distributed.launch.main import launch, main  # noqa: F401

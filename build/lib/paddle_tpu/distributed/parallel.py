@@ -1,0 +1,82 @@
+"""DataParallel (parity: python/paddle/distributed/parallel.py:202 +
+EagerReducer collective/reducer.h:88).
+
+TPU-native: under SPMD there is no reducer — params are replicated over the
+"dp"/"world" mesh axis, the batch is sharded over it, and XLA emits ONE fused
+gradient all-reduce per step (better than 25MB-bucketed NCCL calls: the
+compiler schedules the reduce to overlap the backward pass). The wrapper
+shards incoming batches and keeps paddle's API surface.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from paddle_tpu.distributed import env as _env
+from paddle_tpu.nn.layer_base import Layer
+from paddle_tpu.tensor import Tensor
+
+
+class DataParallel(Layer):
+    def __init__(self, layers, strategy=None, comm_buffer_size=25,
+                 last_comm_buffer_size=1, find_unused_parameters=False,
+                 group=None):
+        super().__init__()
+        self._layers = layers
+        _env.init_parallel_env()
+        self._mesh = _env.get_world_mesh()
+        self._world = _env.get_world_size()
+        # replicate params across the world axis explicitly
+        if self._world > 1:
+            for p in layers.parameters():
+                p._replace_value(
+                    jax.device_put(p._value, NamedSharding(self._mesh, P()))
+                )
+
+    def _shard_batch(self, t: Tensor) -> Tensor:
+        if self._world <= 1:
+            return t
+        import jax as _jax
+
+        if _jax.process_count() > 1:
+            # multi-controller: each process already holds ITS shard; grad
+            # sync happens through the eager collectives — placing a local
+            # batch as a global array over a cross-process mesh would be
+            # wrong (world_size is process-based, the mesh is device-based)
+            return t
+        n_dev = self._mesh.devices.size
+        if t.shape and n_dev and t.shape[0] % n_dev == 0:
+            v = jax.device_put(
+                t._value, NamedSharding(self._mesh, P("world"))
+            )
+            out = Tensor._from_value(v)
+            out.stop_gradient = t.stop_gradient
+            return out
+        return t
+
+    def forward(self, *inputs, **kwargs):
+        inputs = tuple(
+            self._shard_batch(i) if isinstance(i, Tensor) else i for i in inputs
+        )
+        return self._layers(*inputs, **kwargs)
+
+    # transparent passthroughs (paddle API parity)
+    def parameters(self, include_sublayers=True):
+        return self._layers.parameters(include_sublayers)
+
+    def named_parameters(self, prefix="", include_sublayers=True):
+        return self._layers.named_parameters(prefix, include_sublayers)
+
+    def state_dict(self, *args, **kwargs):
+        return self._layers.state_dict(*args, **kwargs)
+
+    def set_state_dict(self, state_dict, *args, **kwargs):
+        return self._layers.set_state_dict(state_dict, *args, **kwargs)
+
+    def scale_loss(self, loss):
+        return loss
+
+    def apply_collective_grads(self):
+        pass  # GSPMD emits the gradient all-reduce inside the step program

@@ -1,0 +1,189 @@
+"""paddle.distributed.rpc parity (reference: python/paddle/distributed/rpc/
+— init_rpc/rpc_sync/rpc_async/shutdown over a brpc agent,
+paddle/fluid/distributed/rpc/rpc_agent.h).
+
+TPU-native: control-plane RPC rides the framework's native TCPStore (the
+same transport bootstrapping collectives) instead of a second brpc stack —
+each worker runs a poller thread; requests/results are pickled payloads
+under rpc/ keys. Functions must be importable (module-level) on the callee,
+matching the reference's contract."""
+
+from __future__ import annotations
+
+import pickle
+import threading
+import time
+import uuid
+from dataclasses import dataclass
+from typing import Any, Optional
+
+_agent: Optional["_RpcAgent"] = None
+
+
+@dataclass
+class WorkerInfo:
+    name: str
+    rank: int
+
+
+class _Future:
+    def __init__(self, default_timeout=None):
+        self._ev = threading.Event()
+        self._value = None
+        self._exc = None
+        self._default_timeout = default_timeout
+
+    def _set(self, value=None, exc=None):
+        self._value = value
+        self._exc = exc
+        self._ev.set()
+
+    def wait(self, timeout=None):
+        if timeout is None:
+            timeout = self._default_timeout
+        if not self._ev.wait(timeout):
+            raise TimeoutError("rpc future timed out")
+        if self._exc is not None:
+            raise self._exc
+        return self._value
+
+    def done(self):
+        return self._ev.is_set()
+
+
+class _RpcAgent:
+    def __init__(self, name, rank, world_size, store):
+        self.name = name
+        self.rank = rank
+        self.world_size = world_size
+        self.store = store
+        # session id isolates this rpc epoch: a re-init on the same store
+        # must never replay a previous epoch's requests
+        if rank == 0:
+            self.session = uuid.uuid4().hex[:12]
+            store.set("rpc/session", self.session.encode())
+        else:
+            store.wait("rpc/session")
+            self.session = store.get("rpc/session").decode()
+        self._pfx = f"rpc/{self.session}"
+        self.store.set(f"{self._pfx}/worker/{rank}", name.encode())
+        self._stop = threading.Event()
+        self._futures = {}
+        self._poller = threading.Thread(target=self._poll, daemon=True)
+        self._poller.start()
+
+    def _poll(self):
+        seq_seen = 0
+        while not self._stop.is_set():
+            # incoming requests for me
+            key = f"{self._pfx}/req/{self.rank}/{seq_seen}"
+            if self.store.check(key):
+                payload = self.store.get(key)
+                self.store.delete_key(key)
+                req_id, fn, args, kwargs, caller = pickle.loads(payload)
+                try:
+                    result = (True, fn(*args, **kwargs))
+                except Exception as e:  # ship the exception back
+                    result = (False, e)
+                self.store.set(f"{self._pfx}/res/{req_id}",
+                               pickle.dumps(result))
+                seq_seen += 1
+                continue
+            # results for my outstanding calls
+            for req_id, fut in list(self._futures.items()):
+                rkey = f"{self._pfx}/res/{req_id}"
+                if self.store.check(rkey):
+                    ok, value = pickle.loads(self.store.get(rkey))
+                    self.store.delete_key(rkey)
+                    fut._set(value if ok else None,
+                             None if ok else value)
+                    del self._futures[req_id]
+            time.sleep(0.005)
+
+    def resolve(self, to) -> int:
+        if isinstance(to, int):
+            return to
+        for r in range(self.world_size):
+            key = f"{self._pfx}/worker/{r}"
+            if self.store.check(key) and self.store.get(key).decode() == to:
+                return r
+        raise ValueError(f"unknown rpc worker {to!r}")
+
+    def call(self, to, fn, args, kwargs, timeout=None) -> _Future:
+        rank = self.resolve(to)
+        req_id = uuid.uuid4().hex
+        fut = _Future(default_timeout=timeout)
+        self._futures[req_id] = fut
+        n = self.store.add(f"{self._pfx}/seq/{rank}", 1) - 1
+        self.store.set(
+            f"{self._pfx}/req/{rank}/{n}",
+            pickle.dumps((req_id, fn, tuple(args or ()), dict(kwargs or {}),
+                          self.rank)))
+        return fut
+
+    def shutdown(self):
+        self._stop.set()
+        self._poller.join(timeout=5)
+
+
+def init_rpc(name, rank=None, world_size=None, master_endpoint=None,
+             store=None):
+    """rpc.init_rpc parity."""
+    global _agent
+    import os
+
+    from paddle_tpu.distributed.store import (
+        TCPStore,
+        create_or_get_global_tcp_store,
+    )
+
+    rank = rank if rank is not None else int(
+        os.environ.get("PADDLE_TRAINER_ID", 0))
+    world_size = world_size if world_size is not None else int(
+        os.environ.get("PADDLE_TRAINERS_NUM", 1))
+    if store is None:
+        if master_endpoint is not None:
+            host, _, port = master_endpoint.partition(":")
+            store = TCPStore(host, int(port), is_master=(rank == 0),
+                             world_size=world_size)
+        else:
+            store = create_or_get_global_tcp_store()
+    _agent = _RpcAgent(name, rank, world_size, store)
+    return _agent
+
+
+def rpc_sync(to, fn, args=None, kwargs=None, timeout=60):
+    """Blocking remote call."""
+    return rpc_async(to, fn, args, kwargs).wait(timeout)
+
+
+def rpc_async(to, fn, args=None, kwargs=None, timeout=None) -> _Future:
+    if _agent is None:
+        raise RuntimeError("call rpc.init_rpc first")
+    return _agent.call(to, fn, args, kwargs, timeout=timeout)
+
+
+def get_worker_info(name=None) -> WorkerInfo:
+    if _agent is None:
+        raise RuntimeError("call rpc.init_rpc first")
+    if name is None:
+        return WorkerInfo(_agent.name, _agent.rank)
+    return WorkerInfo(name, _agent.resolve(name))
+
+
+def get_all_worker_infos():
+    if _agent is None:
+        raise RuntimeError("call rpc.init_rpc first")
+    infos = []
+    for r in range(_agent.world_size):
+        key = f"{_agent._pfx}/worker/{r}"
+        if _agent.store.check(key):
+            infos.append(WorkerInfo(_agent.store.get(key).decode(), r))
+    return infos
+
+
+def shutdown():
+    global _agent
+    if _agent is not None:
+        _agent.shutdown()
+        _agent = None

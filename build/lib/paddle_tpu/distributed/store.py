@@ -1,0 +1,294 @@
+"""TCPStore (parity: phi/core/distributed/store/tcp_store.h:121; python use
+at parallel.py:1101 create_or_get_global_tcp_store).
+
+Backed by the native C++ server/client (paddle_tpu/native/src/tcp_store.cc);
+a pure-Python client/server fallback keeps the API alive without a C++
+toolchain."""
+
+from __future__ import annotations
+
+import os
+import socket
+import struct
+import threading
+from typing import Optional
+
+from paddle_tpu import native
+
+_GLOBAL_STORE: Optional["TCPStore"] = None
+
+
+class TCPStore:
+    """KV store: set/get/add/wait/check/delete_key + barrier helper."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 is_master: bool = False, world_size: int = 1,
+                 timeout: int = 900):
+        self._lib = native.lib()
+        self._timeout_ms = timeout * 1000
+        self._server = None
+        self._py_server = None
+        if is_master:
+            if self._lib is not None:
+                self._server = self._lib.tcpstore_server_start(port)
+                if not self._server:
+                    raise RuntimeError(f"TCPStore: cannot bind port {port}")
+                port = self._lib.tcpstore_server_port(self._server)
+            else:
+                self._py_server = _PyServer(port)
+                port = self._py_server.port
+        self.host = host
+        self.port = port
+        self.world_size = world_size
+        # one connection PER THREAD: clients are shared across threads (the
+        # elastic heartbeat) and a blocking wait() must not starve them
+        self._local = threading.local()
+        self._all_conns = []
+        self._conns_mu = threading.Lock()
+        self._conn()  # connect eagerly so constructor errors surface here
+
+    # ------------------------------------------------------------ transport
+    def _conn(self):
+        """This thread's connection, established on first use with retry
+        until the master binds (reference TCPStore semantics: the timeout
+        budget covers establishment, bounded per attempt)."""
+        c = getattr(self._local, "conn", None)
+        if c is not None:
+            return c
+        import time
+
+        deadline = time.monotonic() + self._timeout_ms / 1000
+        last_err = None
+        while time.monotonic() < deadline:
+            remaining_ms = max(int((deadline - time.monotonic()) * 1000), 1)
+            attempt_ms = min(remaining_ms, 5000)
+            try:
+                if self._lib is not None:
+                    fd = self._lib.tcpstore_connect(
+                        self.host.encode(), self.port, attempt_ms)
+                    if fd >= 0:
+                        self._local.conn = ("fd", fd)
+                        with self._conns_mu:
+                            self._all_conns.append(("fd", fd))
+                        return self._local.conn
+                    last_err = ConnectionError("connect failed")
+                else:
+                    sock = socket.create_connection(
+                        (self.host, self.port), timeout=attempt_ms / 1000)
+                    sock.settimeout(self._timeout_ms / 1000)
+                    sock.setsockopt(socket.IPPROTO_TCP,
+                                    socket.TCP_NODELAY, 1)
+                    self._local.conn = ("sock", sock)
+                    with self._conns_mu:
+                        self._all_conns.append(("sock", sock))
+                    return self._local.conn
+            except OSError as e:
+                last_err = e
+            time.sleep(0.25)
+        raise ConnectionError(
+            f"TCPStore: cannot connect {self.host}:{self.port}: {last_err}")
+
+    @property
+    def _fd(self):
+        kind, c = self._conn()
+        assert kind == "fd"
+        return c
+
+    @property
+    def _sock(self):
+        kind, c = self._conn()
+        assert kind == "sock"
+        return c
+
+    # --------------------------------------------------------------- client
+    def set(self, key: str, value) -> None:
+        data = value if isinstance(value, bytes) else str(value).encode()
+        if self._lib is not None:
+            rc = self._lib.tcpstore_set(self._fd, key.encode(), data, len(data))
+            if rc != 0:
+                raise RuntimeError("TCPStore.set failed")
+        else:
+            self._py_op(1, key, data)
+
+    def get(self, key: str) -> bytes:
+        if self._lib is not None:
+            import ctypes
+
+            cap = 1 << 20
+            while True:
+                buf = (ctypes.c_char * cap)()
+                n = self._lib.tcpstore_get(self._fd, key.encode(), buf, cap)
+                if n < 0:
+                    raise RuntimeError("TCPStore.get failed")
+                if n <= cap:
+                    return bytes(buf[: n])
+                cap = int(n)  # value larger than buffer: re-issue full-size
+        return self._py_op(2, key)
+
+    def add(self, key: str, amount: int = 1) -> int:
+        if self._lib is not None:
+            r = self._lib.tcpstore_add(self._fd, key.encode(), amount)
+            if r == -(2 ** 63):
+                raise RuntimeError("TCPStore.add failed")
+            return int(r)
+        return struct.unpack("<q", self._py_op(3, key,
+                                               struct.pack("<q", amount)))[0]
+
+    def wait(self, keys) -> None:
+        if isinstance(keys, str):
+            keys = [keys]
+        for k in keys:
+            if self._lib is not None:
+                if self._lib.tcpstore_wait(self._fd, k.encode()) != 0:
+                    raise RuntimeError("TCPStore.wait failed")
+            else:
+                self._py_op(4, k)
+
+    def check(self, key: str) -> bool:
+        if self._lib is not None:
+            return self._lib.tcpstore_check(self._fd, key.encode()) == 1
+        return self._py_op(5, key) == b"\x01"
+
+    def delete_key(self, key: str) -> bool:
+        if self._lib is not None:
+            return self._lib.tcpstore_delete(self._fd, key.encode()) == 1
+        return self._py_op(6, key) == b"\x01"
+
+    def barrier(self, tag: str = "barrier") -> None:
+        """Reusable barrier: each call belongs to round (n-1)//world_size of
+        its tag, signalled by a per-round done key."""
+        n = self.add(f"{tag}/count", 1)
+        rnd = (n - 1) // self.world_size
+        if n == (rnd + 1) * self.world_size:
+            self.set(f"{tag}/done/{rnd}", b"1")
+        self.wait(f"{tag}/done/{rnd}")
+
+    def __del__(self):
+        try:
+            with self._conns_mu:
+                conns, self._all_conns = self._all_conns, []
+            for kind, c in conns:
+                if kind == "fd" and self._lib is not None:
+                    self._lib.tcpstore_close(c)
+                elif kind == "sock":
+                    c.close()
+            if self._lib is not None and self._server:
+                self._lib.tcpstore_server_stop(self._server)
+        except Exception:
+            pass
+
+    # ------------------------------------------- pure-python wire fallback
+    def _py_op(self, op: int, key: str, payload: bytes = b"") -> bytes:
+        s = self._sock
+        kb = key.encode()
+        msg = bytes([op]) + struct.pack("<I", len(kb)) + kb
+        if op == 1:
+            msg += struct.pack("<I", len(payload)) + payload
+        elif op == 3:
+            msg += payload
+        s.sendall(msg)
+        if op == 2:
+            (ln,) = struct.unpack("<I", self._recv(4))
+            return self._recv(ln)
+        if op == 3:
+            return self._recv(8)
+        return self._recv(1)
+
+    def _recv(self, n: int) -> bytes:
+        out = b""
+        while len(out) < n:
+            chunk = self._sock.recv(n - len(out))
+            if not chunk:
+                raise ConnectionError("TCPStore connection closed")
+            out += chunk
+        return out
+
+
+class _PyServer:
+    """Python fallback server speaking the same protocol as tcp_store.cc."""
+
+    def __init__(self, port: int):
+        self._data = {}
+        self._cv = threading.Condition()
+        self._srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._srv.bind(("0.0.0.0", port))
+        self._srv.listen(128)
+        self.port = self._srv.getsockname()[1]
+        threading.Thread(target=self._accept, daemon=True).start()
+
+    def _accept(self):
+        while True:
+            try:
+                conn, _ = self._srv.accept()
+            except OSError:
+                return
+            threading.Thread(target=self._serve, args=(conn,),
+                             daemon=True).start()
+
+    def _serve(self, conn):
+        def recv(n):
+            out = b""
+            while len(out) < n:
+                c = conn.recv(n - len(out))
+                if not c:
+                    raise ConnectionError
+                out += c
+            return out
+
+        try:
+            while True:
+                op = recv(1)[0]
+                (kl,) = struct.unpack("<I", recv(4))
+                key = recv(kl).decode()
+                if op == 1:
+                    (vl,) = struct.unpack("<I", recv(4))
+                    val = recv(vl)
+                    with self._cv:
+                        self._data[key] = val
+                        self._cv.notify_all()
+                    conn.sendall(b"\x01")
+                elif op in (2, 4):
+                    with self._cv:
+                        self._cv.wait_for(lambda: key in self._data)
+                        val = self._data[key]
+                    if op == 2:
+                        conn.sendall(struct.pack("<I", len(val)) + val)
+                    else:
+                        conn.sendall(b"\x01")
+                elif op == 3:
+                    (delta,) = struct.unpack("<q", recv(8))
+                    with self._cv:
+                        cur = struct.unpack(
+                            "<q", self._data.get(key, b"\x00" * 8))[0]
+                        new = cur + delta
+                        self._data[key] = struct.pack("<q", new)
+                        self._cv.notify_all()
+                    conn.sendall(struct.pack("<q", new))
+                elif op == 5:
+                    conn.sendall(b"\x01" if key in self._data else b"\x00")
+                elif op == 6:
+                    with self._cv:
+                        existed = self._data.pop(key, None) is not None
+                        self._cv.notify_all()
+                    conn.sendall(b"\x01" if existed else b"\x00")
+                else:
+                    return
+        except (ConnectionError, OSError):
+            pass
+        finally:
+            conn.close()
+
+
+def create_or_get_global_tcp_store() -> TCPStore:
+    """parallel.py:1101 parity: rank 0 hosts, everyone connects."""
+    global _GLOBAL_STORE
+    if _GLOBAL_STORE is not None:
+        return _GLOBAL_STORE
+    rank = int(os.environ.get("PADDLE_TRAINER_ID", 0))
+    world = int(os.environ.get("PADDLE_TRAINERS_NUM", 1))
+    ep = os.environ.get("PADDLE_MASTER", "127.0.0.1:0")
+    host, _, port = ep.partition(":")
+    _GLOBAL_STORE = TCPStore(host or "127.0.0.1", int(port or 0),
+                             is_master=(rank == 0), world_size=world)
+    return _GLOBAL_STORE

@@ -1,0 +1,123 @@
+"""Collective communication watchdog (parity:
+paddle/phi/core/distributed/comm_task_manager.h:37 CommTaskManager +
+comm_task.h:36 — background threads that detect NCCL collective
+timeout/async errors and surface them instead of hanging the job).
+
+TPU-native shape: XLA's cross-process collectives (gRPC on CPU meshes,
+ICI/DCN on pods) block the calling host thread with no timeout — a dead
+peer hangs every survivor silently. The watchdog runs each blocking
+multi-controller collective on a worker thread and bounds the wait:
+
+- on timeout, the caller raises ``CommTimeoutError`` naming the operation
+  (the reference's timeout path) and the communicator is POISONED: every
+  subsequent watchdog-guarded collective raises immediately. The blocked
+  worker thread cannot be cancelled and may complete the real collective
+  later, consuming the peers' matching op — retrying after a timeout would
+  desynchronize collective ordering job-wide, which is exactly what the
+  reference avoids by aborting the NCCL communicator. Restart the job.
+- when ``FLAGS_comm_async_error_handling`` is enabled (off by default), a
+  timeout instead tears the process down (``os._exit(134)``), the analogue
+  of the reference's async-error-handling abort — the launcher / elastic
+  manager observes the death and relaunches.
+
+The worker thread that is still blocked inside XLA is marked daemon so
+process teardown is never blocked.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Any, Callable, Optional
+
+from paddle_tpu.framework import flags as _flags
+
+_flags.define_flag(
+    "FLAGS_comm_timeout_s", 300.0,
+    "Seconds a multi-controller collective may block before the watchdog "
+    "raises CommTimeoutError (0 disables the watchdog).")
+_flags.define_flag(
+    "FLAGS_comm_async_error_handling", False,
+    "When a collective times out, exit the process (exit code 134) after "
+    "raising, so the launcher/elastic tier relaunches instead of leaving a "
+    "half-hung rank. Mirrors the reference's async error handling.")
+
+
+class CommTimeoutError(RuntimeError):
+    """A collective did not complete within the watchdog timeout."""
+
+
+# once any collective times out, the communicator's ordering can no longer
+# be trusted (the blocked thread may consume a peer's later op) — poisoned,
+# like an aborted NCCL communicator
+_poisoned: Optional[str] = None
+
+
+def reset_poison() -> None:
+    """Clear the poisoned state (tests / full comm re-initialization)."""
+    global _poisoned
+    _poisoned = None
+
+
+def comm_timeout() -> float:
+    try:
+        return float(_flags.get_flags("FLAGS_comm_timeout_s")
+                     ["FLAGS_comm_timeout_s"])
+    except Exception:
+        return 300.0
+
+
+def run_with_watchdog(fn: Callable[[], Any], *, timeout: Optional[float] = None,
+                      desc: str = "collective") -> Any:
+    """Run a blocking collective with a bounded wait.
+
+    ``timeout`` None -> FLAGS_comm_timeout_s; <= 0 -> unguarded direct call.
+    """
+    global _poisoned
+    if _poisoned is not None:
+        raise CommTimeoutError(
+            f"communicator poisoned by an earlier timeout ({_poisoned}); "
+            f"collective ordering is no longer trustworthy — restart the "
+            f"job / re-init the process group")
+    t = comm_timeout() if timeout is None else float(timeout)
+    if t <= 0:
+        return fn()
+
+    result: list = []
+    error: list = []
+    done = threading.Event()
+
+    def worker():
+        try:
+            result.append(fn())
+        except BaseException as e:  # surfaced on the caller thread
+            error.append(e)
+        finally:
+            done.set()
+
+    th = threading.Thread(target=worker, daemon=True,
+                          name=f"comm-watchdog:{desc}")
+    th.start()
+    if not done.wait(t):
+        import jax
+
+        rank = jax.process_index() if jax.process_count() > 1 else 0
+        msg = (f"[rank {rank}] collective '{desc}' timed out after {t:.0f}s "
+               f"— a peer is dead or desynchronized (reference: "
+               f"CommTaskManager timeout detection). The blocked comm "
+               f"thread cannot be cancelled; restart the job or enable "
+               f"elastic relaunch.")
+        if _flags.get_flags("FLAGS_comm_async_error_handling")[
+                "FLAGS_comm_async_error_handling"]:
+            import sys
+            import traceback
+
+            sys.stderr.write(msg + "\n")
+            traceback.print_stack(file=sys.stderr)
+            sys.stderr.flush()
+            os._exit(134)
+        _poisoned = desc
+        raise CommTimeoutError(msg)
+    if error:
+        raise error[0]
+    return result[0]

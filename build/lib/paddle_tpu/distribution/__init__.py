@@ -1,0 +1,456 @@
+"""paddle.distribution parity (reference: python/paddle/distribution/ —
+Distribution base, Normal, Uniform, Bernoulli, Categorical, Beta, Dirichlet,
+Gamma, Exponential, Laplace, LogNormal, Gumbel, Multinomial, kl_divergence
+registry kl.py).
+
+Sampling draws from the framework RNG (framework/random.py) so sampled
+programs stay reproducible under seed() and traceable under jit."""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.dispatch import apply
+from paddle_tpu.framework import random as rng
+from paddle_tpu.tensor import Tensor
+
+
+def _val(x):
+    if isinstance(x, Tensor):
+        return x._value
+    return jnp.asarray(x, jnp.float32)
+
+
+def _wrap(v):
+    return Tensor._from_value(v)
+
+
+class Distribution:
+    def __init__(self, batch_shape=(), event_shape=()):
+        self._batch_shape = tuple(batch_shape)
+        self._event_shape = tuple(event_shape)
+
+    @property
+    def batch_shape(self):
+        return self._batch_shape
+
+    @property
+    def event_shape(self):
+        return self._event_shape
+
+    def sample(self, shape=()):
+        raise NotImplementedError
+
+    def rsample(self, shape=()):
+        return self.sample(shape)
+
+    def log_prob(self, value):
+        raise NotImplementedError
+
+    def prob(self, value):
+        return apply("dist_prob", jnp.exp, self.log_prob(value))
+
+    def entropy(self):
+        raise NotImplementedError
+
+    def kl_divergence(self, other):
+        return kl_divergence(self, other)
+
+
+class Normal(Distribution):
+    def __init__(self, loc, scale, name=None):
+        # keep Tensor params so log_prob/sample differentiate through them
+        self._loc_t = loc if isinstance(loc, Tensor) else None
+        self._scale_t = scale if isinstance(scale, Tensor) else None
+        self.loc = _val(loc)
+        self.scale = _val(scale)
+        super().__init__(jnp.broadcast_shapes(self.loc.shape, self.scale.shape))
+
+    def _param_args(self):
+        return [t for t in (self._loc_t, self._scale_t) if t is not None]
+
+    def _params(self, rest):
+        it = iter(rest)
+        loc = next(it) if self._loc_t is not None else self.loc
+        scale = next(it) if self._scale_t is not None else self.scale
+        return loc, scale
+
+    @property
+    def mean(self):
+        return _wrap(jnp.broadcast_to(self.loc, self.batch_shape))
+
+    @property
+    def variance(self):
+        return _wrap(jnp.broadcast_to(self.scale ** 2, self.batch_shape))
+
+    @property
+    def stddev(self):
+        return _wrap(jnp.broadcast_to(self.scale, self.batch_shape))
+
+    def sample(self, shape=()):
+        shape = tuple(shape) + self.batch_shape
+        eps = jax.random.normal(rng.next_key(), shape)
+        params = self._param_args()
+        if not params:
+            return _wrap(self.loc + self.scale * eps)
+
+        def f(*rest):
+            loc, scale = self._params(rest)
+            return loc + scale * eps
+
+        return apply("normal_sample", f, *params)
+
+    rsample = sample
+
+    def log_prob(self, value):
+        def f(v, *rest):
+            loc, scale = self._params(rest)
+            var = scale ** 2
+            return (-((v - loc) ** 2) / (2 * var)
+                    - jnp.log(scale) - 0.5 * math.log(2 * math.pi))
+
+        return apply("normal_log_prob", f, value, *self._param_args())
+
+    def entropy(self):
+        h = 0.5 + 0.5 * math.log(2 * math.pi) + jnp.log(self.scale)
+        return _wrap(jnp.broadcast_to(h, self.batch_shape))
+
+    def cdf(self, value):
+        def f(v):
+            return 0.5 * (1 + jax.lax.erf((v - self.loc) /
+                                          (self.scale * math.sqrt(2))))
+
+        return apply("normal_cdf", f, value)
+
+
+class Uniform(Distribution):
+    def __init__(self, low, high, name=None):
+        self.low = _val(low)
+        self.high = _val(high)
+        super().__init__(jnp.broadcast_shapes(self.low.shape, self.high.shape))
+
+    def sample(self, shape=()):
+        shape = tuple(shape) + self.batch_shape
+        u = jax.random.uniform(rng.next_key(), shape)
+        return _wrap(self.low + (self.high - self.low) * u)
+
+    rsample = sample
+
+    def log_prob(self, value):
+        def f(v):
+            inside = (v >= self.low) & (v < self.high)
+            return jnp.where(inside, -jnp.log(self.high - self.low), -jnp.inf)
+
+        return apply("uniform_log_prob", f, value)
+
+    def entropy(self):
+        return _wrap(jnp.broadcast_to(jnp.log(self.high - self.low),
+                                      self.batch_shape))
+
+
+class Bernoulli(Distribution):
+    def __init__(self, probs=None, logits=None, name=None):
+        if (probs is None) == (logits is None):
+            raise ValueError("pass exactly one of probs/logits")
+        if probs is not None:
+            self.probs = _val(probs)
+            self.logits = jnp.log(self.probs) - jnp.log1p(-self.probs)
+        else:
+            self.logits = _val(logits)
+            self.probs = jax.nn.sigmoid(self.logits)
+        super().__init__(self.probs.shape)
+
+    def sample(self, shape=()):
+        shape = tuple(shape) + self.batch_shape
+        return _wrap(jax.random.bernoulli(
+            rng.next_key(), self.probs, shape).astype(jnp.float32))
+
+    def log_prob(self, value):
+        def f(v):
+            return v * jnp.log(self.probs + 1e-37) + \
+                (1 - v) * jnp.log1p(-self.probs + 1e-37)
+
+        return apply("bernoulli_log_prob", f, value)
+
+    def entropy(self):
+        p = self.probs
+        h = -(p * jnp.log(p + 1e-37) + (1 - p) * jnp.log1p(-p + 1e-37))
+        return _wrap(h)
+
+
+class Categorical(Distribution):
+    def __init__(self, logits=None, probs=None, name=None):
+        if logits is not None:
+            self.logits = _val(logits)
+            self.probs = jax.nn.softmax(self.logits, axis=-1)
+        else:
+            self.probs = _val(probs)
+            self.probs = self.probs / jnp.sum(self.probs, -1, keepdims=True)
+            self.logits = jnp.log(self.probs + 1e-37)
+        super().__init__(self.probs.shape[:-1])
+
+    def sample(self, shape=()):
+        shape = tuple(shape) + self.batch_shape
+        return _wrap(jax.random.categorical(
+            rng.next_key(), self.logits, shape=shape))
+
+    def log_prob(self, value):
+        def f(v):
+            logp = jax.nn.log_softmax(self.logits, axis=-1)
+            vi = v.astype(jnp.int32)
+            if logp.ndim == 1:  # batchless: v is a vector of samples
+                return jnp.take(logp, vi)
+            return jnp.take_along_axis(logp, vi[..., None], axis=-1)[..., 0]
+
+        return apply("categorical_log_prob", f, value)
+
+    def entropy(self):
+        logp = jax.nn.log_softmax(self.logits, axis=-1)
+        return _wrap(-jnp.sum(self.probs * logp, axis=-1))
+
+
+class Beta(Distribution):
+    def __init__(self, alpha, beta, name=None):
+        self.alpha = _val(alpha)
+        self.beta = _val(beta)
+        super().__init__(jnp.broadcast_shapes(self.alpha.shape, self.beta.shape))
+
+    def sample(self, shape=()):
+        shape = tuple(shape) + self.batch_shape
+        return _wrap(jax.random.beta(rng.next_key(), self.alpha, self.beta,
+                                     shape))
+
+    def log_prob(self, value):
+        def f(v):
+            from jax.scipy.special import betaln
+
+            return ((self.alpha - 1) * jnp.log(v) +
+                    (self.beta - 1) * jnp.log1p(-v) -
+                    betaln(self.alpha, self.beta))
+
+        return apply("beta_log_prob", f, value)
+
+    def entropy(self):
+        from jax.scipy.special import betaln, digamma
+
+        a, b = self.alpha, self.beta
+        h = (betaln(a, b) - (a - 1) * digamma(a) - (b - 1) * digamma(b)
+             + (a + b - 2) * digamma(a + b))
+        return _wrap(jnp.broadcast_to(h, self.batch_shape))
+
+
+class Dirichlet(Distribution):
+    def __init__(self, concentration, name=None):
+        self.concentration = _val(concentration)
+        super().__init__(self.concentration.shape[:-1],
+                         self.concentration.shape[-1:])
+
+    def sample(self, shape=()):
+        shape = tuple(shape) + self.batch_shape
+        return _wrap(jax.random.dirichlet(
+            rng.next_key(), self.concentration, shape))
+
+    def log_prob(self, value):
+        def f(v):
+            from jax.scipy.special import gammaln
+
+            a = self.concentration
+            return (jnp.sum((a - 1) * jnp.log(v), -1)
+                    + gammaln(jnp.sum(a, -1)) - jnp.sum(gammaln(a), -1))
+
+        return apply("dirichlet_log_prob", f, value)
+
+
+class Gamma(Distribution):
+    def __init__(self, concentration, rate, name=None):
+        self.concentration = _val(concentration)
+        self.rate = _val(rate)
+        super().__init__(jnp.broadcast_shapes(self.concentration.shape,
+                                              self.rate.shape))
+
+    def sample(self, shape=()):
+        shape = tuple(shape) + self.batch_shape
+        g = jax.random.gamma(rng.next_key(), self.concentration, shape)
+        return _wrap(g / self.rate)
+
+    def log_prob(self, value):
+        def f(v):
+            from jax.scipy.special import gammaln
+
+            a, b = self.concentration, self.rate
+            return a * jnp.log(b) + (a - 1) * jnp.log(v) - b * v - gammaln(a)
+
+        return apply("gamma_log_prob", f, value)
+
+
+class Exponential(Distribution):
+    def __init__(self, rate, name=None):
+        self.rate = _val(rate)
+        super().__init__(self.rate.shape)
+
+    def sample(self, shape=()):
+        shape = tuple(shape) + self.batch_shape
+        return _wrap(jax.random.exponential(rng.next_key(), shape) / self.rate)
+
+    def log_prob(self, value):
+        return apply("exponential_log_prob",
+                     lambda v: jnp.log(self.rate) - self.rate * v, value)
+
+    def entropy(self):
+        return _wrap(jnp.broadcast_to(1 - jnp.log(self.rate), self.batch_shape))
+
+
+class Laplace(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _val(loc)
+        self.scale = _val(scale)
+        super().__init__(jnp.broadcast_shapes(self.loc.shape, self.scale.shape))
+
+    def sample(self, shape=()):
+        shape = tuple(shape) + self.batch_shape
+        return _wrap(self.loc + self.scale *
+                     jax.random.laplace(rng.next_key(), shape))
+
+    def log_prob(self, value):
+        def f(v):
+            return -jnp.abs(v - self.loc) / self.scale - \
+                jnp.log(2 * self.scale)
+
+        return apply("laplace_log_prob", f, value)
+
+
+class LogNormal(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _val(loc)
+        self.scale = _val(scale)
+        self._normal = Normal(loc, scale)
+        super().__init__(jnp.broadcast_shapes(self.loc.shape, self.scale.shape))
+
+    def sample(self, shape=()):
+        return apply("lognormal_sample", jnp.exp, self._normal.sample(shape))
+
+    def log_prob(self, value):
+        def f(v):
+            logv = jnp.log(v)
+            var = self.scale ** 2
+            return (-((logv - self.loc) ** 2) / (2 * var) - logv
+                    - jnp.log(self.scale) - 0.5 * math.log(2 * math.pi))
+
+        return apply("lognormal_log_prob", f, value)
+
+
+class Gumbel(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _val(loc)
+        self.scale = _val(scale)
+        super().__init__(jnp.broadcast_shapes(self.loc.shape, self.scale.shape))
+
+    def sample(self, shape=()):
+        shape = tuple(shape) + self.batch_shape
+        return _wrap(self.loc + self.scale *
+                     jax.random.gumbel(rng.next_key(), shape))
+
+    def log_prob(self, value):
+        def f(v):
+            z = (v - self.loc) / self.scale
+            return -(z + jnp.exp(-z)) - jnp.log(self.scale)
+
+        return apply("gumbel_log_prob", f, value)
+
+
+class Multinomial(Distribution):
+    def __init__(self, total_count, probs, name=None):
+        self.total_count = int(total_count)
+        self.probs = _val(probs)
+        super().__init__(self.probs.shape[:-1], self.probs.shape[-1:])
+
+    def sample(self, shape=()):
+        cat = Categorical(probs=_wrap(self.probs))
+        draws = cat.sample((self.total_count,) + tuple(shape))._value
+        k = self.probs.shape[-1]
+        counts = jax.nn.one_hot(draws, k).sum(axis=0)
+        return _wrap(counts)
+
+    def log_prob(self, value):
+        def f(v):
+            from jax.scipy.special import gammaln
+
+            logp = jnp.log(self.probs + 1e-37)
+            return (gammaln(jnp.asarray(self.total_count + 1.0))
+                    - jnp.sum(gammaln(v + 1.0), -1)
+                    + jnp.sum(v * logp, -1))
+
+        return apply("multinomial_log_prob", f, value)
+
+
+# ------------------------------------------------------------- KL divergence
+_KL_REGISTRY = {}
+
+
+def register_kl(cls_p, cls_q):
+    def deco(fn):
+        _KL_REGISTRY[(cls_p, cls_q)] = fn
+        return fn
+
+    return deco
+
+
+def kl_divergence(p: Distribution, q: Distribution):
+    for (cp, cq), fn in _KL_REGISTRY.items():
+        if isinstance(p, cp) and isinstance(q, cq):
+            return fn(p, q)
+    raise NotImplementedError(
+        f"no KL registered for ({type(p).__name__}, {type(q).__name__})")
+
+
+@register_kl(Normal, Normal)
+def _kl_normal(p, q):
+    p_args = p._param_args()
+    q_args = q._param_args()
+
+    def f(*rest):
+        p_loc, p_scale = p._params(rest[: len(p_args)])
+        q_loc, q_scale = q._params(rest[len(p_args):])
+        var_p, var_q = p_scale ** 2, q_scale ** 2
+        return (jnp.log(q_scale / p_scale) +
+                (var_p + (p_loc - q_loc) ** 2) / (2 * var_q) - 0.5)
+
+    if not p_args and not q_args:
+        return _wrap(f())
+    return apply("kl_normal_normal", f, *p_args, *q_args)
+
+
+@register_kl(Uniform, Uniform)
+def _kl_uniform(p, q):
+    kl = jnp.log((q.high - q.low) / (p.high - p.low))
+    outside = (p.low < q.low) | (p.high > q.high)
+    return _wrap(jnp.where(outside, jnp.inf, kl))
+
+
+@register_kl(Categorical, Categorical)
+def _kl_categorical(p, q):
+    logp = jax.nn.log_softmax(p.logits, -1)
+    logq = jax.nn.log_softmax(q.logits, -1)
+    return _wrap(jnp.sum(p.probs * (logp - logq), -1))
+
+
+@register_kl(Bernoulli, Bernoulli)
+def _kl_bernoulli(p, q):
+    a, b = p.probs, q.probs
+    kl = a * (jnp.log(a + 1e-37) - jnp.log(b + 1e-37)) + \
+        (1 - a) * (jnp.log1p(-a + 1e-37) - jnp.log1p(-b + 1e-37))
+    return _wrap(kl)
+
+
+@register_kl(Beta, Beta)
+def _kl_beta(p, q):
+    from jax.scipy.special import betaln, digamma
+
+    a1, b1, a2, b2 = p.alpha, p.beta, q.alpha, q.beta
+    kl = (betaln(a2, b2) - betaln(a1, b1)
+          + (a1 - a2) * digamma(a1) + (b1 - b2) * digamma(b1)
+          + (a2 - a1 + b2 - b1) * digamma(a1 + b1))
+    return _wrap(kl)

@@ -1,0 +1,75 @@
+"""paddle.fft parity (reference: python/paddle/fft.py). All transforms lower
+to XLA's FFT HLO via jnp.fft and join the autograd tape through the standard
+dispatch."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from paddle_tpu.core.dispatch import apply
+from paddle_tpu.tensor import Tensor
+
+
+def _norm(norm):
+    # paddle uses "backward"/"forward"/"ortho" like numpy
+    return norm if norm in ("backward", "forward", "ortho") else "backward"
+
+
+def _op(op_name, fn):
+    def op(x, n=None, axis=-1, norm="backward", name=None):
+        return apply(op_name, lambda a: fn(a, n=n, axis=axis, norm=_norm(norm)), x)
+
+    op.__name__ = op_name
+    return op
+
+
+fft = _op("fft", jnp.fft.fft)
+ifft = _op("ifft", jnp.fft.ifft)
+rfft = _op("rfft", jnp.fft.rfft)
+irfft = _op("irfft", jnp.fft.irfft)
+hfft = _op("hfft", jnp.fft.hfft)
+ihfft = _op("ihfft", jnp.fft.ihfft)
+
+
+def _op2(op_name, fn):
+    def op(x, s=None, axes=(-2, -1), norm="backward", name=None):
+        return apply(op_name, lambda a: fn(a, s=s, axes=axes, norm=_norm(norm)), x)
+
+    op.__name__ = op_name
+    return op
+
+
+fft2 = _op2("fft2", jnp.fft.fft2)
+ifft2 = _op2("ifft2", jnp.fft.ifft2)
+rfft2 = _op2("rfft2", jnp.fft.rfft2)
+irfft2 = _op2("irfft2", jnp.fft.irfft2)
+
+
+def _opn(op_name, fn):
+    def op(x, s=None, axes=None, norm="backward", name=None):
+        return apply(op_name, lambda a: fn(a, s=s, axes=axes, norm=_norm(norm)), x)
+
+    op.__name__ = op_name
+    return op
+
+
+fftn = _opn("fftn", jnp.fft.fftn)
+ifftn = _opn("ifftn", jnp.fft.ifftn)
+rfftn = _opn("rfftn", jnp.fft.rfftn)
+irfftn = _opn("irfftn", jnp.fft.irfftn)
+
+
+def fftfreq(n, d=1.0, dtype=None, name=None):
+    return Tensor._from_value(jnp.fft.fftfreq(n, d).astype(dtype or jnp.float32))
+
+
+def rfftfreq(n, d=1.0, dtype=None, name=None):
+    return Tensor._from_value(jnp.fft.rfftfreq(n, d).astype(dtype or jnp.float32))
+
+
+def fftshift(x, axes=None, name=None):
+    return apply("fftshift", lambda a: jnp.fft.fftshift(a, axes=axes), x)
+
+
+def ifftshift(x, axes=None, name=None):
+    return apply("ifftshift", lambda a: jnp.fft.ifftshift(a, axes=axes), x)

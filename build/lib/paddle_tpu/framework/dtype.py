@@ -1,0 +1,93 @@
+"""Data types for the framework.
+
+Capability parity with the reference's ``phi/common`` DataType
+(reference: paddle/phi/common/data_type.h) — but TPU-native: every dtype is a
+``jnp.dtype`` and bfloat16 is first-class (it is the MXU's native matmul input
+type). There is no Place/Backend enum: device placement is carried by the
+``jax.Array``'s sharding, and "backend dispatch" is XLA's job.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+# Canonical dtype table. Names follow the reference's public API
+# (paddle.float32, ...); values are jax dtypes so they flow straight into XLA.
+float16 = jnp.float16
+bfloat16 = jnp.bfloat16
+float32 = jnp.float32
+float64 = jnp.float64
+int8 = jnp.int8
+int16 = jnp.int16
+int32 = jnp.int32
+int64 = jnp.int64
+uint8 = jnp.uint8
+uint16 = jnp.uint16
+uint32 = jnp.uint32
+uint64 = jnp.uint64
+bool_ = jnp.bool_
+complex64 = jnp.complex64
+complex128 = jnp.complex128
+float8_e4m3fn = jnp.float8_e4m3fn
+float8_e5m2 = jnp.float8_e5m2
+
+_ALIASES = {
+    "float16": float16,
+    "fp16": float16,
+    "half": float16,
+    "bfloat16": bfloat16,
+    "bf16": bfloat16,
+    "float32": float32,
+    "fp32": float32,
+    "float": float32,
+    "float64": float64,
+    "fp64": float64,
+    "double": float64,
+    "int8": int8,
+    "int16": int16,
+    "int32": int32,
+    "int": int32,
+    "int64": int64,
+    "long": int64,
+    "uint8": uint8,
+    "uint16": uint16,
+    "uint32": uint32,
+    "uint64": uint64,
+    "bool": bool_,
+    "complex64": complex64,
+    "complex128": complex128,
+    "float8_e4m3fn": float8_e4m3fn,
+    "float8_e5m2": float8_e5m2,
+}
+
+FLOATING = {float16, bfloat16, float32, float64, float8_e4m3fn, float8_e5m2}
+INTEGER = {int8, int16, int32, int64, uint8, uint16, uint32, uint64}
+COMPLEX = {complex64, complex128}
+
+
+def convert_dtype(dtype) -> jnp.dtype:
+    """Normalize any user-provided dtype spec to a jnp dtype."""
+    if dtype is None:
+        return None
+    if isinstance(dtype, str):
+        if dtype not in _ALIASES:
+            raise TypeError(f"Unknown dtype string: {dtype!r}")
+        return jnp.dtype(_ALIASES[dtype])
+    return jnp.dtype(dtype)
+
+
+def dtype_name(dtype) -> str:
+    return jnp.dtype(dtype).name
+
+
+def is_floating_point(dtype) -> bool:
+    return jnp.issubdtype(jnp.dtype(dtype), np.floating)
+
+
+def is_integer(dtype) -> bool:
+    return jnp.issubdtype(jnp.dtype(dtype), np.integer)
+
+
+def is_complex(dtype) -> bool:
+    return jnp.issubdtype(jnp.dtype(dtype), np.complexfloating)

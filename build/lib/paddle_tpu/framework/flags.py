@@ -1,0 +1,110 @@
+"""Runtime flag registry (parity: the reference's gflags-free registry —
+PHI_DEFINE_EXPORTED_* macros paddle/common/flags.h:373, runtime get/set via
+paddle.set_flags/get_flags through pybind global_value_getter_setter.cc).
+
+Flags are registered with a default + doc, overridable by FLAGS_* env vars at
+import (same convention the reference parses at init)."""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, Iterable, Union
+
+
+class _FlagInfo:
+    __slots__ = ("name", "value", "default", "doc", "typ", "on_set")
+
+    def __init__(self, name, default, doc, on_set=None):
+        self.name = name
+        self.default = default
+        self.doc = doc
+        self.typ = type(default)
+        self.on_set = on_set
+        self.value = self._from_env(default)
+        if on_set is not None and self.value != default:
+            on_set(self.value)
+
+    def _from_env(self, default):
+        raw = os.environ.get(self.name)
+        if raw is None:
+            return default
+        return _coerce(raw, self.typ)
+
+
+def _coerce(raw: str, typ):
+    if typ is bool:
+        return raw.lower() in ("1", "true", "yes", "on")
+    return typ(raw)
+
+
+_REGISTRY: Dict[str, _FlagInfo] = {}
+
+
+def define_flag(name: str, default: Any, doc: str = "", on_set=None) -> None:
+    if not name.startswith("FLAGS_"):
+        name = "FLAGS_" + name
+    if name not in _REGISTRY:
+        _REGISTRY[name] = _FlagInfo(name, default, doc, on_set)
+
+
+def get_flags(flags: Union[str, Iterable[str]]) -> Dict[str, Any]:
+    """paddle.get_flags parity."""
+    if isinstance(flags, str):
+        flags = [flags]
+    out = {}
+    for f in flags:
+        key = f if f.startswith("FLAGS_") else "FLAGS_" + f
+        if key not in _REGISTRY:
+            raise ValueError(f"unknown flag {f}")
+        out[f] = _REGISTRY[key].value
+    return out
+
+
+def set_flags(flags: Dict[str, Any]) -> None:
+    """paddle.set_flags parity."""
+    for f, v in flags.items():
+        key = f if f.startswith("FLAGS_") else "FLAGS_" + f
+        if key not in _REGISTRY:
+            raise ValueError(f"unknown flag {f}")
+        info = _REGISTRY[key]
+        info.value = _coerce(v, info.typ) if isinstance(v, str) else info.typ(v)
+        if info.on_set is not None:
+            info.on_set(info.value)
+
+
+def flag_names():
+    return sorted(_REGISTRY)
+
+
+# ---- core flags (the subset of the reference's exported flags that have
+# meaning on this substrate) ----
+
+def _set_check_nan_inf(v: bool):
+    from paddle_tpu.amp import debugging
+
+    debugging._state.check_nan_inf = bool(v)
+
+
+def _set_use_flash_attention(v: bool):
+    from paddle_tpu.ops.pallas import flash_attention as fa
+
+    fa._FLASH_ENABLED = bool(v)
+
+
+define_flag("FLAGS_check_nan_inf", False,
+            "check every op output for NaN/Inf (program_interpreter.cc:1131)",
+            on_set=_set_check_nan_inf)
+define_flag("FLAGS_use_flash_attention", True,
+            "route attention through the Pallas flash kernel on TPU",
+            on_set=_set_use_flash_attention)
+define_flag("FLAGS_embedding_deterministic", False,
+            "deterministic embedding grad accumulation")
+define_flag("FLAGS_cudnn_deterministic", False,
+            "parity alias: deterministic kernels (XLA is deterministic)")
+define_flag("FLAGS_max_inflight_microbatches", 4,
+            "pipeline schedule in-flight microbatch bound")
+define_flag("FLAGS_allocator_strategy", "auto_growth",
+            "parity: allocator strategy (XLA BFC allocator manages HBM)")
+define_flag("FLAGS_fraction_of_gpu_memory_to_use", 0.92,
+            "parity alias of XLA_PYTHON_CLIENT_MEM_FRACTION")
+define_flag("FLAGS_log_level", "INFO", "framework log level")

@@ -1,0 +1,71 @@
+"""paddle.save / paddle.load (parity: python/paddle/framework/io.py:743,985).
+
+Serialization format: pickle of nested containers with Tensors converted to
+numpy arrays (so checkpoints are portable and framework-version independent),
+matching the reference's pickle-compatible state-dict format. Large-scale
+sharded/async checkpointing lives in paddle_tpu.distributed.checkpoint (orbax).
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+from typing import Any
+
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.tensor import Tensor
+
+_PROTOCOL = 4
+
+
+def _to_serializable(obj):
+    if isinstance(obj, Tensor):
+        arr = np.asarray(obj._value)
+        return _TensorPayload(arr, stop_gradient=obj.stop_gradient)
+    if isinstance(obj, dict):
+        return {k: _to_serializable(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        t = type(obj)
+        return t(_to_serializable(v) for v in obj)
+    return obj
+
+
+def _from_serializable(obj, return_numpy=False):
+    if isinstance(obj, _TensorPayload):
+        if return_numpy:
+            return obj.array
+        t = Tensor._from_value(jnp.asarray(obj.array))
+        t.stop_gradient = obj.stop_gradient
+        return t
+    if isinstance(obj, dict):
+        return {k: _from_serializable(v, return_numpy) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        t = type(obj)
+        return t(_from_serializable(v, return_numpy) for v in obj)
+    return obj
+
+
+class _TensorPayload:
+    __slots__ = ("array", "stop_gradient")
+
+    def __init__(self, array, stop_gradient=True):
+        self.array = array
+        self.stop_gradient = stop_gradient
+
+
+def save(obj: Any, path: str, protocol: int = _PROTOCOL, **configs):
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    payload = _to_serializable(obj)
+    with open(path, "wb") as f:
+        pickle.dump(payload, f, protocol=protocol)
+
+
+def load(path: str, **configs) -> Any:
+    return_numpy = configs.get("return_numpy", False)
+    with open(path, "rb") as f:
+        payload = pickle.load(f)
+    return _from_serializable(payload, return_numpy=return_numpy)

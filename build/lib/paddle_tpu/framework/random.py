@@ -1,0 +1,63 @@
+"""RNG state management.
+
+Parity with the reference's generator (paddle/phi/core/generator.h, python
+paddle.seed) — TPU-native: state is a jax PRNG key, not a stateful Philox
+engine. Random ops split the global key per call in eager mode; inside a
+captured graph (to_static / TrainStep) a *traced* key can be pushed so that
+randomness (dropout noise) is threaded functionally through the XLA program and
+varies per step.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+
+import jax
+
+
+class _RngState(threading.local):
+    def __init__(self):
+        self.key = jax.random.key(0)
+        self.traced_key = None  # set inside captured graphs
+        self.counter = 0
+
+
+_state = _RngState()
+
+
+def seed(s: int) -> None:
+    """paddle.seed parity."""
+    _state.key = jax.random.key(int(s))
+    _state.counter = 0
+
+
+def get_rng_state():
+    return (_state.key, _state.counter)
+
+
+def set_rng_state(st) -> None:
+    _state.key, _state.counter = st
+
+
+def next_key():
+    """Return a fresh PRNG key for one random op."""
+    if _state.traced_key is not None:
+        # Functional path: fold a trace-time counter into the traced key so
+        # multiple random ops in one program get distinct streams.
+        _state.counter += 1
+        return jax.random.fold_in(_state.traced_key, _state.counter)
+    _state.key, sub = jax.random.split(_state.key)
+    return sub
+
+
+@contextlib.contextmanager
+def traced_key(key):
+    """Thread a (possibly traced) key through random ops inside a capture."""
+    prev, prev_ctr = _state.traced_key, _state.counter
+    _state.traced_key = key
+    _state.counter = 0
+    try:
+        yield
+    finally:
+        _state.traced_key, _state.counter = prev, prev_ctr

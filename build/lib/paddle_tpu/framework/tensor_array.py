@@ -1,0 +1,63 @@
+"""TensorArray (reference: phi TensorArray core type + the
+array_write/array_read/array_length/create_array op family,
+python/paddle/tensor/array.py).
+
+TPU-native: inside compiled control flow, loop-carried sequences are scan
+outputs (jaxpr already models them); the EAGER TensorArray here is the
+dynamic-length container the reference exposes, with the paddle op surface.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+from paddle_tpu.tensor import Tensor
+
+
+class TensorArray(list):
+    """A dynamically-sized array of Tensors (LoDTensorArray parity)."""
+
+    def write(self, index: int, value: Tensor):
+        index = int(index)
+        while len(self) <= index:
+            self.append(None)
+        self[index] = value
+        return self
+
+    def read(self, index: int) -> Tensor:
+        v = self[int(index)]
+        if v is None:
+            raise IndexError(f"TensorArray slot {index} was never written")
+        return v
+
+    def length(self) -> int:
+        return len(self)
+
+
+def create_array(dtype="float32", initialized_list=None):
+    """paddle.tensor.create_array parity."""
+    arr = TensorArray()
+    for t in initialized_list or ():
+        arr.append(t if isinstance(t, Tensor) else Tensor(t))
+    return arr
+
+
+def array_write(x: Tensor, i, array: Optional[TensorArray] = None):
+    if array is None:
+        array = TensorArray()
+    idx = int(i.numpy()) if isinstance(i, Tensor) else int(i)
+    array.write(idx, x)
+    return array
+
+
+def array_read(array: TensorArray, i) -> Tensor:
+    idx = int(i.numpy()) if isinstance(i, Tensor) else int(i)
+    return array.read(idx)
+
+
+def array_length(array: TensorArray):
+    import jax.numpy as jnp
+
+    # int32: x64 is disabled on this substrate (explicit int64 would only
+    # emit a truncation warning per call)
+    return Tensor._from_value(jnp.asarray(array.length(), jnp.int32))

@@ -1,0 +1,234 @@
+"""hapi callbacks (parity: python/paddle/hapi/callbacks.py — Callback,
+ProgBarLogger, ModelCheckpoint, EarlyStopping, LRScheduler)."""
+
+from __future__ import annotations
+
+import numbers
+import os
+import time
+
+import numpy as np
+
+
+class Callback:
+    def __init__(self):
+        self.model = None
+        self.params = {}
+
+    def set_params(self, params):
+        self.params = params or {}
+
+    def set_model(self, model):
+        self.model = model
+
+    def on_train_begin(self, logs=None):
+        pass
+
+    def on_train_end(self, logs=None):
+        pass
+
+    def on_eval_begin(self, logs=None):
+        pass
+
+    def on_eval_end(self, logs=None):
+        pass
+
+    def on_predict_begin(self, logs=None):
+        pass
+
+    def on_predict_end(self, logs=None):
+        pass
+
+    def on_epoch_begin(self, epoch, logs=None):
+        pass
+
+    def on_epoch_end(self, epoch, logs=None):
+        pass
+
+    def on_train_batch_begin(self, step, logs=None):
+        pass
+
+    def on_train_batch_end(self, step, logs=None):
+        pass
+
+    def on_eval_batch_begin(self, step, logs=None):
+        pass
+
+    def on_eval_batch_end(self, step, logs=None):
+        pass
+
+    def on_predict_batch_begin(self, step, logs=None):
+        pass
+
+    def on_predict_batch_end(self, step, logs=None):
+        pass
+
+
+class CallbackList:
+    def __init__(self, callbacks):
+        self.callbacks = list(callbacks)
+
+    def set_params(self, params):
+        for c in self.callbacks:
+            c.set_params(params)
+
+    def set_model(self, model):
+        for c in self.callbacks:
+            c.set_model(model)
+
+    def __getattr__(self, name):
+        if name.startswith("on_"):
+            def call(*args, **kwargs):
+                for c in self.callbacks:
+                    getattr(c, name)(*args, **kwargs)
+            return call
+        raise AttributeError(name)
+
+
+def _fmt(v):
+    if isinstance(v, numbers.Number):
+        return f"{v:.4f}"
+    if isinstance(v, (list, tuple, np.ndarray)):
+        return "[" + ", ".join(_fmt(x) for x in np.ravel(v)) + "]"
+    return str(v)
+
+
+class ProgBarLogger(Callback):
+    """Console logger (hapi/callbacks.py ProgBarLogger)."""
+
+    def __init__(self, log_freq=1, verbose=2):
+        super().__init__()
+        self.log_freq = log_freq
+        self.verbose = verbose
+
+    def on_train_begin(self, logs=None):
+        self.epochs = self.params.get("epochs")
+        self.steps = self.params.get("steps")
+
+    def on_epoch_begin(self, epoch, logs=None):
+        self.epoch = epoch
+        self._t0 = time.time()
+        if self.verbose and self.epochs:
+            print(f"Epoch {epoch + 1}/{self.epochs}")
+
+    def _print(self, step, logs, prefix="step"):
+        metrics = ", ".join(
+            f"{k}: {_fmt(v)}" for k, v in (logs or {}).items())
+        total = f"/{self.steps}" if self.steps else ""
+        print(f"  {prefix} {step + 1}{total} - {metrics}")
+
+    def on_train_batch_end(self, step, logs=None):
+        if self.verbose > 1 and (step + 1) % self.log_freq == 0:
+            self._print(step, logs)
+
+    def on_epoch_end(self, epoch, logs=None):
+        if self.verbose:
+            dt = time.time() - self._t0
+            metrics = ", ".join(f"{k}: {_fmt(v)}" for k, v in (logs or {}).items())
+            print(f"  epoch {epoch + 1} done in {dt:.1f}s - {metrics}")
+
+    def on_eval_end(self, logs=None):
+        if self.verbose:
+            metrics = ", ".join(f"{k}: {_fmt(v)}" for k, v in (logs or {}).items())
+            print(f"  eval - {metrics}")
+
+
+class ModelCheckpoint(Callback):
+    def __init__(self, save_freq=1, save_dir=None):
+        super().__init__()
+        self.save_freq = save_freq
+        self.save_dir = save_dir
+
+    def on_epoch_end(self, epoch, logs=None):
+        if self.model is not None and self.save_dir and (
+                epoch + 1) % self.save_freq == 0:
+            path = os.path.join(self.save_dir, str(epoch))
+            self.model.save(path)
+
+    def on_train_end(self, logs=None):
+        if self.model is not None and self.save_dir:
+            self.model.save(os.path.join(self.save_dir, "final"))
+
+
+class EarlyStopping(Callback):
+    def __init__(self, monitor="loss", mode="auto", patience=0, verbose=1,
+                 min_delta=0, baseline=None, save_best_model=True):
+        super().__init__()
+        self.monitor = monitor
+        self.patience = patience
+        self.verbose = verbose
+        self.min_delta = abs(min_delta)
+        self.baseline = baseline
+        self.save_best_model = save_best_model
+        if mode == "min" or (mode == "auto" and "loss" in monitor):
+            self.monitor_op = np.less
+            self.min_delta *= -1
+        else:
+            self.monitor_op = np.greater
+        self.best = baseline
+        self.wait = 0
+        self.stopped_epoch = 0
+
+    def on_eval_end(self, logs=None):
+        logs = logs or {}
+        # eval logs are keyed with an "eval_" prefix; accept either form
+        value = logs.get(self.monitor)
+        if value is None:
+            value = logs.get("eval_" + self.monitor)
+        if value is None:
+            return
+        value = np.ravel(np.asarray(value))[0]
+        if self.best is None or self.monitor_op(value - self.min_delta, self.best):
+            self.best = value
+            self.wait = 0
+            if self.save_best_model and self.model is not None and \
+                    self.params.get("save_dir"):
+                self.model.save(os.path.join(self.params["save_dir"], "best_model"))
+        else:
+            self.wait += 1
+            if self.wait >= self.patience:
+                if self.model is not None:
+                    self.model.stop_training = True
+                if self.verbose:
+                    print(f"early stopping: {self.monitor} plateaued at {self.best}")
+
+
+class LRScheduler(Callback):
+    """Steps the optimizer's LRScheduler (by_step or by_epoch)."""
+
+    def __init__(self, by_step=True, by_epoch=False):
+        super().__init__()
+        assert by_step != by_epoch, "exactly one of by_step/by_epoch"
+        self.by_step = by_step
+
+    def _sched(self):
+        opt = getattr(self.model, "_optimizer", None)
+        lr = getattr(opt, "_lr", None)
+        return lr if hasattr(lr, "step") else None
+
+    def on_train_batch_end(self, step, logs=None):
+        s = self._sched()
+        if self.by_step and s is not None:
+            s.step()
+
+    def on_epoch_end(self, epoch, logs=None):
+        s = self._sched()
+        if not self.by_step and s is not None:
+            s.step()
+
+
+def config_callbacks(callbacks=None, model=None, epochs=None, steps=None,
+                     log_freq=2, verbose=2, save_freq=1, save_dir=None,
+                     metrics=None, mode="train"):
+    cbks = list(callbacks or [])
+    if not any(isinstance(c, ProgBarLogger) for c in cbks) and verbose:
+        cbks.append(ProgBarLogger(log_freq, verbose=verbose))
+    if save_dir and not any(isinstance(c, ModelCheckpoint) for c in cbks):
+        cbks.append(ModelCheckpoint(save_freq, save_dir))
+    clist = CallbackList(cbks)
+    clist.set_model(model)
+    clist.set_params({
+        "epochs": epochs, "steps": steps, "verbose": verbose,
+        "metrics": metrics or [], "save_dir": save_dir,
+    })
+    return clist

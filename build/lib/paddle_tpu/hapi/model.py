@@ -1,0 +1,281 @@
+"""paddle.Model — high-level train/eval/predict loop (parity:
+python/paddle/hapi/model.py:1052 Model, :1750 fit).
+
+TPU-native: train_batch drives the same eager tape the reference's dygraph
+mode does; when the model/loss are jit-friendly the inner step can be wrapped
+by jit.TrainStep for a fully-compiled hot loop (paddle's to_static analogue is
+automatic here because every op is XLA anyway)."""
+
+from __future__ import annotations
+
+import os
+import warnings
+
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.hapi.callbacks import config_callbacks
+from paddle_tpu.io.dataloader import DataLoader
+from paddle_tpu.io.dataset import Dataset
+from paddle_tpu.metric import Metric
+from paddle_tpu.nn.layer_base import Layer
+from paddle_tpu.tensor import Tensor
+
+
+def _to_list(x):
+    if x is None:
+        return []
+    return list(x) if isinstance(x, (list, tuple)) else [x]
+
+
+class Model:
+    def __init__(self, network, inputs=None, labels=None):
+        self.network = network
+        self._inputs = inputs
+        self._labels = labels
+        self._optimizer = None
+        self._loss = None
+        self._metrics = []
+        self.stop_training = False
+        self._fast_step = None  # None=unbuilt, False=eager fallback latched
+        self._fast_step_key = None
+
+    # ------------------------------------------------------------- prepare
+    def prepare(self, optimizer=None, loss=None, metrics=None, amp_configs=None):
+        self._optimizer = optimizer
+        self._loss = loss
+        self._fast_step = None  # re-arm the compiled fast path
+        self._fast_step_key = None
+        self._metrics = _to_list(metrics)
+        for m in self._metrics:
+            if not isinstance(m, Metric):
+                raise TypeError(f"metrics must be paddle.metric.Metric, got {m}")
+        self._amp_configs = amp_configs
+
+    # --------------------------------------------------------------- steps
+    def _compute_loss(self, outputs, labels):
+        outs = _to_list(outputs)
+        labs = _to_list(labels)
+        if callable(self._loss):
+            loss = self._loss(*(outs + labs))
+        else:
+            raise RuntimeError("prepare() a loss before train/eval")
+        return loss
+
+    def train_batch(self, inputs, labels=None, update=True):
+        self.network.train()
+        inputs = _to_list(inputs)
+        labels = _to_list(labels)
+        has_accumulated = any(
+            p._grad is not None
+            for p in getattr(self._optimizer, "_parameter_list", ())
+        ) if self._optimizer is not None else False
+        if update and self._optimizer is not None and not has_accumulated:
+            # (accumulated grads from update=False batches must go through
+            # the eager tape — the compiled step computes this batch only)
+            fast = self._fast_train_step(len(inputs))
+            if fast is not None:
+                try:
+                    loss, outputs = fast(*inputs, *labels)
+                except Exception as e:
+                    # non-jittable network/loss (host-side control flow,
+                    # .numpy() in forward, ...): eager fallback until the
+                    # next prepare() re-arms it
+                    warnings.warn(
+                        f"hapi fast path disabled, falling back to eager "
+                        f"train_batch: {type(e).__name__}: {e}")
+                    self._fast_step = False
+                else:
+                    # (TrainStep.__call__ already ran any _post_step_hook)
+                    metrics = self._update_metrics(outputs, labels)
+                    return [float(np.asarray(loss.numpy()))], metrics
+        outputs = self.network(*inputs)
+        loss = self._compute_loss(outputs, labels)
+        loss.backward()
+        if update and self._optimizer is not None:
+            self._optimizer.step()
+            self._optimizer.clear_grad()
+        metrics = self._update_metrics(outputs, labels)
+        return [float(np.asarray(loss.numpy()))], metrics
+
+    def _fast_train_step(self, n_inputs):
+        """Cached jit.TrainStep running forward+backward+update as ONE XLA
+        program (the reference's Model-with-to_static fast path,
+        hapi/model.py — here it is the default: jax tracing needs no source
+        transform). Returns None once the eager fallback is latched."""
+        if self._fast_step is False:
+            return None
+        key = (id(self.network), id(self._optimizer), id(self._loss), n_inputs)
+        if self._fast_step is not None and self._fast_step_key == key:
+            return self._fast_step
+        if not isinstance(self.network, Layer) or not callable(self._loss):
+            self._fast_step = False
+            return None
+
+        def loss_fn(net, *batch):
+            ins, labs = batch[:n_inputs], list(batch[n_inputs:])
+            outs = net(*ins)
+            return self._compute_loss(outs, labs), outs
+
+        from paddle_tpu.jit.api import TrainStep
+
+        self._fast_step = TrainStep(self.network, loss_fn, self._optimizer,
+                                    has_aux=True)
+        self._fast_step_key = key
+        return self._fast_step
+
+    def eval_batch(self, inputs, labels=None):
+        self.network.eval()
+        inputs = _to_list(inputs)
+        labels = _to_list(labels)
+        with paddle.no_grad():
+            outputs = self.network(*inputs)
+            loss = self._compute_loss(outputs, labels)
+        metrics = self._update_metrics(outputs, labels)
+        return [float(np.asarray(loss.numpy()))], metrics
+
+    def predict_batch(self, inputs):
+        self.network.eval()
+        inputs = _to_list(inputs)
+        with paddle.no_grad():
+            outputs = self.network(*inputs)
+        return [o.numpy() if isinstance(o, Tensor) else o
+                for o in _to_list(outputs)]
+
+    def _update_metrics(self, outputs, labels):
+        res = []
+        outs = _to_list(outputs)
+        for m in self._metrics:
+            pre = m.compute(*(outs + labels))
+            if not isinstance(pre, (list, tuple)):
+                pre = [pre]
+            m.update(*pre)
+            res.append(m.accumulate())
+        return res
+
+    def _metric_logs(self, loss, prefix=""):
+        logs = {prefix + "loss": loss}
+        for m in self._metrics:
+            names = m.name()
+            vals = m.accumulate()
+            if isinstance(names, str):
+                names, vals = [names], [vals]
+            if not isinstance(vals, (list, tuple)):
+                vals = [vals]
+            for n, v in zip(names, vals):
+                logs[prefix + n] = v
+        return logs
+
+    # ----------------------------------------------------------------- fit
+    def _as_loader(self, data, batch_size, shuffle, num_workers, drop_last=False):
+        if data is None or isinstance(data, DataLoader):
+            return data
+        if isinstance(data, Dataset):
+            return DataLoader(data, batch_size=batch_size, shuffle=shuffle,
+                              num_workers=num_workers, drop_last=drop_last)
+        return data  # assume iterable of batches
+
+    def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
+            eval_freq=1, log_freq=10, save_dir=None, save_freq=1, verbose=2,
+            drop_last=False, shuffle=True, num_workers=0, callbacks=None):
+        loader = self._as_loader(train_data, batch_size, shuffle, num_workers,
+                                 drop_last)
+        eval_loader = self._as_loader(eval_data, batch_size, False, num_workers)
+        steps = len(loader) if hasattr(loader, "__len__") else None
+        cbks = config_callbacks(
+            callbacks, model=self, epochs=epochs, steps=steps,
+            log_freq=log_freq, verbose=verbose, save_freq=save_freq,
+            save_dir=save_dir, metrics=[m.name() for m in self._metrics],
+        )
+        self.stop_training = False
+        cbks.on_train_begin()
+        for epoch in range(epochs):
+            if self.stop_training:
+                break
+            cbks.on_epoch_begin(epoch)
+            for m in self._metrics:
+                m.reset()
+            logs = {}
+            for step, batch in enumerate(loader):
+                cbks.on_train_batch_begin(step)
+                ins, labs = self._split_batch(batch)
+                loss, _ = self.train_batch(ins, labs)
+                logs = self._metric_logs(loss[0])
+                cbks.on_train_batch_end(step, logs)
+            cbks.on_epoch_end(epoch, logs)
+            if eval_loader is not None and (epoch + 1) % eval_freq == 0:
+                self._run_eval(eval_loader, cbks)
+        cbks.on_train_end()
+
+    def _split_batch(self, batch):
+        if isinstance(batch, (list, tuple)) and len(batch) >= 2:
+            return _to_list(batch[0]) if isinstance(batch[0], (list, tuple)) \
+                else [batch[0]], _to_list(batch[1:]) if len(batch) > 2 \
+                else _to_list(batch[1])
+        return [batch], []
+
+    def _run_eval(self, loader, cbks):
+        cbks.on_eval_begin()
+        for m in self._metrics:
+            m.reset()
+        logs = {}
+        for step, batch in enumerate(loader):
+            cbks.on_eval_batch_begin(step)
+            ins, labs = self._split_batch(batch)
+            loss, _ = self.eval_batch(ins, labs)
+            logs = self._metric_logs(loss[0], prefix="eval_")
+            cbks.on_eval_batch_end(step, logs)
+        cbks.on_eval_end(logs)
+        return logs
+
+    def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2,
+                 num_workers=0, callbacks=None):
+        loader = self._as_loader(eval_data, batch_size, False, num_workers)
+        cbks = config_callbacks(
+            callbacks, model=self, log_freq=log_freq, verbose=verbose,
+            metrics=[m.name() for m in self._metrics], mode="eval",
+        )
+        return self._run_eval(loader, cbks)
+
+    def predict(self, test_data, batch_size=1, num_workers=0, stack_outputs=False,
+                verbose=1, callbacks=None):
+        loader = self._as_loader(test_data, batch_size, False, num_workers)
+        outputs = []
+        for batch in loader:
+            ins, _ = self._split_batch(batch)
+            outputs.append(self.predict_batch(ins))
+        if stack_outputs and outputs:
+            n_out = len(outputs[0])
+            return [np.concatenate([o[i] for o in outputs]) for i in range(n_out)]
+        return outputs
+
+    # ---------------------------------------------------------- persistence
+    def save(self, path, training=True):
+        dirname = os.path.dirname(path)
+        if dirname:
+            os.makedirs(dirname, exist_ok=True)
+        paddle.save(self.network.state_dict(), path + ".pdparams")
+        if training and self._optimizer is not None:
+            paddle.save(self._optimizer.state_dict(), path + ".pdopt")
+
+    def load(self, path, skip_mismatch=False, reset_optimizer=False):
+        state = paddle.load(path + ".pdparams")
+        self.network.set_state_dict(state)
+        opt_path = path + ".pdopt"
+        if not reset_optimizer and self._optimizer is not None and \
+                os.path.exists(opt_path):
+            self._optimizer.set_state_dict(paddle.load(opt_path))
+
+    def parameters(self, *args, **kwargs):
+        return self.network.parameters(*args, **kwargs)
+
+    def summary(self, input_size=None, dtype=None):
+        n_params = sum(int(np.prod(p.shape)) for p in self.network.parameters())
+        trainable = sum(int(np.prod(p.shape)) for p in self.network.parameters()
+                        if p.trainable)
+        info = {
+            "total_params": n_params,
+            "trainable_params": trainable,
+        }
+        print(f"Total params: {n_params:,} (trainable {trainable:,})")
+        return info

@@ -1,0 +1,39 @@
+"""paddle.hub parity (reference: python/paddle/hub.py). Offline environment:
+only the local-source path works (hub.load from a local directory with a
+hubconf.py); remote github/gitee sources raise."""
+
+from __future__ import annotations
+
+import importlib.util
+import os
+import sys
+
+
+def _load_hubconf(repo_dir):
+    path = os.path.join(repo_dir, "hubconf.py")
+    if not os.path.exists(path):
+        raise FileNotFoundError(f"no hubconf.py in {repo_dir}")
+    spec = importlib.util.spec_from_file_location("hubconf", path)
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules["hubconf"] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def list(repo_dir, source="local", force_reload=False):  # noqa: A001
+    if source != "local":
+        raise RuntimeError("only source='local' is available offline")
+    mod = _load_hubconf(repo_dir)
+    return [n for n in dir(mod) if not n.startswith("_") and callable(getattr(mod, n))]
+
+
+def help(repo_dir, model, source="local", force_reload=False):  # noqa: A001
+    if source != "local":
+        raise RuntimeError("only source='local' is available offline")
+    return getattr(_load_hubconf(repo_dir), model).__doc__
+
+
+def load(repo_dir, model, *args, source="local", force_reload=False, **kwargs):
+    if source != "local":
+        raise RuntimeError("only source='local' is available offline")
+    return getattr(_load_hubconf(repo_dir), model)(*args, **kwargs)

@@ -1,0 +1,6 @@
+"""paddle.incubate parity (reference: python/paddle/incubate/)."""
+
+from paddle_tpu.incubate import nn  # noqa: F401
+from paddle_tpu.incubate import distributed  # noqa: F401
+from paddle_tpu.incubate import optimizer  # noqa: F401
+from paddle_tpu.incubate import asp  # noqa: F401

@@ -1,0 +1,166 @@
+"""ASP — automatic n:m structured sparsity (parity:
+python/paddle/incubate/asp/asp.py decorate:216 / prune_model:302, mask algos
+in asp/utils.py).
+
+TPU note (SURVEY §2.6): TPUs have no sparse-MMA unit, so n:m sparsity here is
+*mask simulation*: masks are computed with the reference's algorithms
+(mask_1d / mask_2d_greedy over m-element groups), applied to the weights, and
+re-applied after every optimizer step so pruned weights stay zero through
+training — the same training-time semantics the reference guarantees, with
+dense math underneath.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.nn.layer_base import Layer
+from paddle_tpu.tensor import Tensor
+
+import weakref
+
+_excluded_layers: Dict[int, set] = {}
+# id(param) -> (weakref to param, device-resident mask in the param dtype);
+# weakrefs let pruned models be garbage-collected (entry dropped on death)
+_masks: Dict[int, tuple] = {}
+
+
+def set_excluded_layers(model, layer_names):
+    _excluded_layers[id(model)] = set(layer_names)
+
+
+def reset_excluded_layers(model=None):
+    if model is None:
+        _excluded_layers.clear()
+    else:
+        _excluded_layers.pop(id(model), None)
+
+
+def calculate_density(x) -> float:
+    arr = np.asarray(x.numpy() if isinstance(x, Tensor) else x)
+    return float(np.count_nonzero(arr)) / max(arr.size, 1)
+
+
+def _mask_1d(mat: np.ndarray, n: int, m: int) -> np.ndarray:
+    """Keep the n largest-|w| entries of every m-element group along the
+    last axis (reference get_mask_1d)."""
+    flat = mat.reshape(-1, m)
+    keep = np.argsort(-np.abs(flat), axis=1)[:, :n]
+    mask = np.zeros_like(flat, dtype=np.float32)
+    np.put_along_axis(mask, keep, 1.0, axis=1)
+    return mask.reshape(mat.shape)
+
+
+def _mask_2d_greedy(mat: np.ndarray, n: int, m: int) -> np.ndarray:
+    """Greedy m x m block mask with n:m along both rows and columns
+    (reference get_mask_2d_greedy semantics)."""
+    h, w = mat.shape
+    mask = np.zeros_like(mat, dtype=np.float32)
+    for i0 in range(0, h, m):
+        for j0 in range(0, w, m):
+            blk = np.abs(mat[i0:i0 + m, j0:j0 + m])
+            bm = np.zeros_like(blk)
+            order = np.dstack(np.unravel_index(
+                np.argsort(-blk, axis=None), blk.shape))[0]
+            row_cnt = np.zeros(blk.shape[0], np.int32)
+            col_cnt = np.zeros(blk.shape[1], np.int32)
+            for r, c in order:
+                if row_cnt[r] < n and col_cnt[c] < n:
+                    bm[r, c] = 1.0
+                    row_cnt[r] += 1
+                    col_cnt[c] += 1
+            mask[i0:i0 + m, j0:j0 + m] = bm
+    return mask
+
+
+_MASK_ALGOS = {
+    "mask_1d": _mask_1d,
+    "mask_2d_greedy": _mask_2d_greedy,
+    "mask_2d_best": _mask_2d_greedy,  # greedy stands in for the exhaustive variant
+}
+
+
+def _prunable_params(model) -> List[tuple]:
+    """(name, param) for weights ASP supports: 2D+ weights of Linear/Conv."""
+    excluded = _excluded_layers.get(id(model), set())
+    out = []
+    for lname, layer in model.named_sublayers():
+        if lname in excluded:
+            continue
+        w = getattr(layer, "weight", None)
+        if w is None or len(w.shape) < 2:
+            continue
+        out.append((lname, w))
+    if not out:  # model may itself be a leaf layer with a weight
+        w = getattr(model, "weight", None)
+        if w is not None and len(w.shape) >= 2:
+            out.append(("", w))
+    return out
+
+
+def prune_model(model, n=2, m=4, mask_algo="mask_1d", with_mask=True):
+    """Compute and apply n:m masks to every supported weight. Returns
+    {param_name: mask Tensor} like the reference."""
+    algo = _MASK_ALGOS[mask_algo]
+    result = {}
+    for name, w in _prunable_params(model):
+        arr = np.asarray(w.numpy())
+        mat = arr.reshape(arr.shape[0], -1)
+        if mat.shape[1] % m:
+            continue  # group-indivisible weights are skipped (reference)
+        mask = algo(mat, n, m).reshape(arr.shape)
+        mask_dev = paddle.to_tensor(mask.astype(arr.dtype))._value
+        w._replace_value(w._value * mask_dev)
+        if with_mask:
+            key = id(w)
+            _masks[key] = (
+                weakref.ref(w, lambda _, k=key: _masks.pop(k, None)),
+                mask_dev)
+        result[name + (".weight" if name else "weight")] = \
+            Tensor._from_value(paddle.to_tensor(mask)._value)
+    return result
+
+
+def _apply_masks():
+    """Re-zero pruned entries of every masked parameter (device-resident
+    masks: no host round-trip in the per-step hot path)."""
+    for ref, mask_dev in list(_masks.values()):
+        p = ref()
+        if p is None:
+            continue
+        m = (mask_dev if mask_dev.dtype == p._value.dtype
+             else mask_dev.astype(p._value.dtype))
+        p._replace_value(p._value * m)
+
+
+class OptimizerWithSparsityGuarantee:
+    """Wraps an optimizer so masks are re-applied after every step
+    (reference ASPHelper._decorate semantics). Exposes ``_post_step_hook``
+    so compiled train steps that bypass ``step()`` (hapi fast path,
+    jit.TrainStep) can preserve the sparsity guarantee."""
+
+    def __init__(self, optimizer):
+        object.__setattr__(self, "_inner", optimizer)
+
+    def __getattr__(self, item):
+        return getattr(self._inner, item)
+
+    def __setattr__(self, item, value):
+        # writes forward too: step counters etc. must land on the inner
+        # optimizer (TrainStep does `opt._step_count += 1`)
+        setattr(self._inner, item, value)
+
+    def _post_step_hook(self):
+        _apply_masks()
+
+    def step(self):
+        self._inner.step()
+        _apply_masks()
+
+
+def decorate(optimizer):
+    """paddle.incubate.asp.decorate parity."""
+    return OptimizerWithSparsityGuarantee(optimizer)

@@ -1,0 +1,20 @@
+"""Mixture-of-Experts with expert parallelism (parity:
+python/paddle/incubate/distributed/models/moe/moe_layer.py:263 MoELayer,
+gates in moe/gate/, dispatch via global_scatter/global_gather
+python/paddle/distributed/utils/moe_utils.py:20,153).
+
+TPU-native: dispatch/combine are einsums against a one-hot capacity-bucketed
+routing tensor (the GShard formulation). Under pjit with tokens sharded on
+dp/sep and experts sharded on the mp (or a dedicated ep) mesh axis, GSPMD
+lowers the dispatch einsum to the same all-to-all the reference's
+global_scatter performs — but fused and overlapped by XLA."""
+
+from paddle_tpu.incubate.distributed.models.moe.gate import (  # noqa: F401
+    BaseGate,
+    GShardGate,
+    NaiveGate,
+    SwitchGate,
+)
+from paddle_tpu.incubate.distributed.models.moe.moe_layer import (  # noqa: F401
+    MoELayer,
+)

@@ -1,0 +1,110 @@
+"""MoE gates (parity: python/paddle/incubate/distributed/models/moe/gate/ —
+naive_gate.py, gshard_gate.py, switch_gate.py)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu.nn as nn
+from paddle_tpu.core.dispatch import apply
+from paddle_tpu.tensor import Tensor
+
+
+class BaseGate(nn.Layer):
+    def __init__(self, num_expert, world_size=1):
+        super().__init__()
+        self.world_size = world_size
+        self.num_expert = num_expert
+        self.tot_expert = num_expert * world_size
+        self.loss = None
+
+    def get_loss(self, clear=True):
+        loss = self.loss
+        if clear:
+            self.loss = None
+        return loss
+
+
+class NaiveGate(BaseGate):
+    """Linear router + top-k softmax (naive_gate.py)."""
+
+    def __init__(self, d_model, num_expert, world_size=1, topk=2):
+        super().__init__(num_expert, world_size)
+        self.gate = nn.Linear(d_model, self.tot_expert)
+        self.top_k = topk
+
+    def forward(self, inp):
+        logits = self.gate(inp)  # [T, E]
+
+        def f(g):
+            val, idx = jax.lax.top_k(g, self.top_k)
+            return jax.nn.softmax(val, axis=-1), idx
+
+        gate_score, gate_idx = apply("naive_gate_topk", f, logits)
+        return gate_idx, gate_score
+
+
+def _load_balance_loss(gates_softmax, expert_mask, num_experts):
+    """GShard aux loss: num_experts * sum(mean_prob_e * frac_tokens_e)."""
+    me = jnp.mean(gates_softmax, axis=0)            # [E] mean router prob
+    ce = jnp.mean(expert_mask.astype(jnp.float32), axis=0)  # [E] token frac
+    return num_experts * jnp.sum(me * ce)
+
+
+class GShardGate(BaseGate):
+    """Top-2 gate with load-balancing aux loss (gshard_gate.py)."""
+
+    def __init__(self, d_model, num_expert, world_size=1, topk=2,
+                 capacity=(1.2, 2.4), group=None):
+        super().__init__(num_expert, world_size)
+        self.gate = nn.Linear(d_model, self.tot_expert)
+        self.top_k = topk
+        self.capacity = capacity
+
+    def forward(self, inp):
+        logits = self.gate(inp)
+
+        def f(g):
+            probs = jax.nn.softmax(g, axis=-1)
+            val, idx = jax.lax.top_k(probs, self.top_k)
+            mask1 = jax.nn.one_hot(idx[:, 0], self.tot_expert)
+            aux = _load_balance_loss(probs, mask1, self.tot_expert)
+            return val / jnp.sum(val, axis=-1, keepdims=True), idx, aux
+
+        score, idx, aux = apply("gshard_gate", f, logits)
+        self.loss = aux
+        return idx, score
+
+
+class SwitchGate(BaseGate):
+    """Top-1 switch gate (switch_gate.py)."""
+
+    def __init__(self, d_model, num_expert, world_size=1, topk=1,
+                 switch_eps=0.1, capacity=(1.2, 2.4), group=None):
+        super().__init__(num_expert, world_size)
+        self.gate = nn.Linear(d_model, self.tot_expert)
+        self.top_k = 1
+        self.switch_eps = switch_eps
+
+    def forward(self, inp):
+        logits = self.gate(inp)
+
+        def f(g, key):
+            if self.training:
+                noise = jax.random.uniform(
+                    key, g.shape, minval=1 - self.switch_eps,
+                    maxval=1 + self.switch_eps)
+                g = g * noise
+            probs = jax.nn.softmax(g, axis=-1)
+            val, idx = jax.lax.top_k(probs, 1)
+            mask = jax.nn.one_hot(idx[:, 0], self.tot_expert)
+            aux = _load_balance_loss(probs, mask, self.tot_expert)
+            return val, idx, aux
+
+        from paddle_tpu.framework import random as rng
+
+        key = rng.next_key()
+        score, idx, aux = apply("switch_gate", lambda g: f(g, key), logits)
+        self.loss = aux
+        return idx, score

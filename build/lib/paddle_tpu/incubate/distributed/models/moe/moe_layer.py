@@ -1,0 +1,92 @@
+"""MoELayer (parity: moe_layer.py:263). GShard-style einsum dispatch; see
+package docstring for the all-to-all mapping."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.core.dispatch import apply
+from paddle_tpu.incubate.distributed.models.moe.gate import BaseGate, NaiveGate
+from paddle_tpu.tensor import Tensor
+
+
+class MoELayer(nn.Layer):
+    """Mixture of experts over a list of expert Layers.
+
+    Args mirror the reference (moe_layer.py:263): d_model, experts (LayerList),
+    gate (BaseGate or dict config), moe_group/mp_group accepted for API parity
+    (mesh placement supersedes them), recompute_interval.
+
+    Routing: top-k gate -> capacity-bucketed one-hot dispatch [T, E, C] ->
+    per-expert forward on [E, C, D] -> weighted combine. Tokens over capacity
+    are dropped (their combine weight is zero), matching GShard semantics.
+    """
+
+    def __init__(self, d_model, experts, gate=None, moe_group=None,
+                 mp_group=None, recompute_interval=0, capacity_factor=1.2,
+                 **kwargs):
+        super().__init__()
+        self.d_model = d_model
+        if isinstance(experts, (list, tuple)):
+            experts = nn.LayerList(list(experts))
+        self.experts = experts
+        self.num_expert = len(experts)
+        self.capacity_factor = capacity_factor
+        if gate is None or isinstance(gate, dict):
+            cfg = gate or {}
+            self.gate = NaiveGate(d_model, self.num_expert,
+                                  topk=cfg.get("top_k", 2))
+        else:
+            assert isinstance(gate, BaseGate)
+            self.gate = gate
+        self.recompute_interval = recompute_interval
+
+    def forward(self, inp):
+        orig_shape = inp.shape
+        x = paddle.reshape(inp, [-1, self.d_model])  # [T, D]
+        gate_idx, gate_score = self.gate(x)  # [T, k] each
+        T = x.shape[0]
+        E = self.num_expert
+        k = self.gate.top_k
+        capacity = max(int(self.capacity_factor * T * k / E), 1)
+
+        def build_route(idx):
+            # positions within each expert's buffer, per (token, k) assignment
+            flat_idx = idx.reshape(-1)  # [T*k] expert ids, token-major
+            onehot = jax.nn.one_hot(flat_idx, E, dtype=jnp.int32)  # [T*k, E]
+            # slot within the assigned expert's buffer: running count - 1
+            pos = jnp.sum(jnp.cumsum(onehot, axis=0) * onehot, axis=-1) - 1
+            keep = pos < capacity
+            disp = (
+                jax.nn.one_hot(flat_idx, E)[:, :, None]
+                * jax.nn.one_hot(jnp.clip(pos, 0, capacity - 1), capacity)[:, None, :]
+            ) * keep[:, None, None].astype(jnp.float32)  # [T*k, E, C]
+            return disp.reshape(T, k, E, capacity)
+
+        # routing tensor depends only on integer indices: non-differentiable
+        route = apply("moe_route", build_route, gate_idx, differentiable=False)
+        # combine weights differentiate through the gate scores
+        combine = apply(
+            "moe_combine",
+            lambda r, s: jnp.sum(r * s[:, :, None, None], axis=1),
+            route.detach(), gate_score,
+        )  # [T, E, C]
+        # dispatch tokens: [E, C, D]
+        expert_in = apply(
+            "moe_scatter",
+            lambda r, xv: jnp.einsum("tkec,td->ecd", r, xv),
+            route.detach(), x,
+        )
+        # run experts (unrolled; E is small and XLA parallelizes the matmuls)
+        outs = []
+        for e in range(E):
+            outs.append(self.experts[e](expert_in[e]))
+        expert_out = paddle.stack(outs, axis=0)  # [E, C, D]
+        out = apply(
+            "moe_gather", lambda c, eo: jnp.einsum("tec,ecd->td", c, eo),
+            combine, expert_out,
+        )
+        return paddle.reshape(out, orig_shape)

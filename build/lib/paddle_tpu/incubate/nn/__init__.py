@@ -1,0 +1,3 @@
+"""incubate.nn (fused layers + functional)."""
+
+from paddle_tpu.incubate.nn import functional  # noqa: F401

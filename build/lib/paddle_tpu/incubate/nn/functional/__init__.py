@@ -1,0 +1,391 @@
+"""Fused ops (parity: python/paddle/incubate/nn/functional/ — fused_rms_norm,
+fused_rotary_position_embedding, swiglu, fused_linear, fused_bias_act,
+masked_multihead_attention; GPU kernels live in phi/kernels/fusion/gpu/).
+
+TPU-native: each "fused" op is expressed as one jnp composition — XLA fuses
+the elementwise chains into the surrounding matmuls on its own, so these are
+semantically-fused ops whose fusion is delegated to the compiler; the
+attention entries route to the Pallas flash kernel."""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.dispatch import apply
+from paddle_tpu.framework import random as _rng
+from paddle_tpu.nn import functional as F
+from paddle_tpu.ops.pallas.flash_attention import scaled_dot_product_attention
+from paddle_tpu.tensor import Tensor
+
+
+def fused_rms_norm(x, norm_weight=None, norm_bias=None, epsilon=1e-6,
+                   begin_norm_axis=-1, bias=None, residual=None,
+                   quant_scale=-1, **kwargs):
+    """fused_rms_norm (incubate/nn/functional/fused_rms_norm.py): optional
+    bias+residual add fused ahead of the norm. Returns (out, residual_out)
+    when residual is given, else out."""
+
+    def f(xv, *rest):
+        it = iter(rest)
+        b = next(it) if bias is not None else None
+        r = next(it) if residual is not None else None
+        w = next(it) if norm_weight is not None else None
+        nb = next(it) if norm_bias is not None else None
+        h = xv
+        if b is not None:
+            h = h + b
+        if r is not None:
+            h = h + r
+        residual_out = h
+        axes = tuple(range(begin_norm_axis % h.ndim, h.ndim))
+        var = jnp.mean(jnp.square(h.astype(jnp.float32)), axis=axes,
+                       keepdims=True)
+        out = (h.astype(jnp.float32) * jax.lax.rsqrt(var + epsilon)).astype(h.dtype)
+        if w is not None:
+            out = out * w
+        if nb is not None:
+            out = out + nb
+        if residual is not None:
+            return out, residual_out
+        return out
+
+    args = [x]
+    for t in (bias, residual, norm_weight, norm_bias):
+        if t is not None:
+            args.append(t)
+    return apply("fused_rms_norm", f, *args)
+
+
+def fused_layer_norm(x, norm_weight=None, norm_bias=None, epsilon=1e-5,
+                     begin_norm_axis=-1, bias=None, residual=None, **kwargs):
+    def f(xv, *rest):
+        it = iter(rest)
+        b = next(it) if bias is not None else None
+        r = next(it) if residual is not None else None
+        w = next(it) if norm_weight is not None else None
+        nb = next(it) if norm_bias is not None else None
+        h = xv
+        if b is not None:
+            h = h + b
+        if r is not None:
+            h = h + r
+        residual_out = h
+        hf = h.astype(jnp.float32)
+        axes = tuple(range(begin_norm_axis % h.ndim, h.ndim))
+        mean = jnp.mean(hf, axis=axes, keepdims=True)
+        var = jnp.var(hf, axis=axes, keepdims=True)
+        out = ((hf - mean) * jax.lax.rsqrt(var + epsilon)).astype(h.dtype)
+        if w is not None:
+            out = out * w
+        if nb is not None:
+            out = out + nb
+        if residual is not None:
+            return out, residual_out
+        return out
+
+    args = [x]
+    for t in (bias, residual, norm_weight, norm_bias):
+        if t is not None:
+            args.append(t)
+    return apply("fused_layer_norm", f, *args)
+
+
+def swiglu(x, y=None, name=None):
+    """swiglu (incubate/nn/functional/swiglu.py): silu(x) * y; when y is None,
+    x is split in half on the last dim."""
+
+    if y is None:
+        def f(xv):
+            a, b = jnp.split(xv, 2, axis=-1)
+            return jax.nn.silu(a) * b
+
+        return apply("swiglu", f, x)
+
+    return apply("swiglu", lambda a, b: jax.nn.silu(a) * b, x, y)
+
+
+def _rope_rotate_half(x):
+    x1, x2 = jnp.split(x, 2, axis=-1)
+    return jnp.concatenate([-x2, x1], axis=-1)
+
+
+def _rope_rotate_interleaved(x):
+    x1 = x[..., 0::2]
+    x2 = x[..., 1::2]
+    return jnp.stack([-x2, x1], axis=-1).reshape(x.shape)
+
+
+def fused_rotary_position_embedding(q, k=None, v=None, sin=None, cos=None,
+                                    position_ids=None, use_neox_rotary_style=True,
+                                    time_major=False, rotary_emb_base=10000.0,
+                                    name=None):
+    """fused_rotary_position_embedding (incubate/nn/functional): applies RoPE
+    to q/k (and v for parity; paddle rotates v too when given). Layout
+    [batch, seq, heads, head_dim]. Returns tuple matching given inputs."""
+
+    given = [t for t in (q, k, v) if t is not None]
+    n_given = len(given)
+
+    def f(*vals):
+        tensors = list(vals[:n_given])
+        rest = list(vals[n_given:])
+        it = iter(rest)
+        sin_v = next(it) if sin is not None else None
+        cos_v = next(it) if cos is not None else None
+        pos = next(it) if position_ids is not None else None
+
+        head_dim = tensors[0].shape[-1]
+        seq_len = tensors[0].shape[1]
+        if sin_v is None:
+            inv = 1.0 / (rotary_emb_base ** (
+                jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim))
+            if pos is not None:
+                # compute angles from the given positions directly: exact for
+                # arbitrary offsets (incremental decode, packed sequences)
+                t_ = pos.astype(jnp.float32)  # [S] or [B, S]
+                freqs = t_[..., None] * inv  # [..., S, D/2]
+            else:
+                t_ = jnp.arange(seq_len, dtype=jnp.float32)
+                freqs = jnp.outer(t_, inv)  # [S, D/2]
+            if use_neox_rotary_style:
+                emb = jnp.concatenate([freqs, freqs], axis=-1)
+            else:
+                emb = jnp.repeat(freqs, 2, axis=-1)
+            sin_v = jnp.sin(emb)
+            cos_v = jnp.cos(emb)
+        else:
+            sin_v = jnp.reshape(sin_v, sin_v.shape[-2:])
+            cos_v = jnp.reshape(cos_v, cos_v.shape[-2:])
+            if pos is not None:
+                sq = sin_v.shape[0]
+                oob = pos >= sq
+                sin_v = jnp.take(sin_v, pos, axis=0)  # [B?, S, D]
+                cos_v = jnp.take(cos_v, pos, axis=0)
+                # clamp-masking would be silent; zero out so misuse is visible
+                sin_v = jnp.where(oob[..., None], jnp.nan, sin_v)
+                cos_v = jnp.where(oob[..., None], jnp.nan, cos_v)
+        # broadcast to [B, S, H, D]
+        while sin_v.ndim < 4:
+            sin_v = sin_v[None] if sin_v.ndim == 2 else sin_v[:, :, None, :]
+        while cos_v.ndim < 4:
+            cos_v = cos_v[None] if cos_v.ndim == 2 else cos_v[:, :, None, :]
+        rot = (_rope_rotate_half if use_neox_rotary_style
+               else _rope_rotate_interleaved)
+        outs = []
+        for t in tensors:
+            dt = t.dtype
+            tf = t.astype(jnp.float32)
+            outs.append((tf * cos_v + rot(tf) * sin_v).astype(dt))
+        return tuple(outs) if len(outs) > 1 else outs[0]
+
+    args = list(given)
+    for t in (sin, cos, position_ids):
+        if t is not None:
+            args.append(t)
+    out = apply("fused_rotary_position_embedding", f, *args)
+    if not isinstance(out, tuple):
+        out = (out,)
+    res = []
+    i = 0
+    for t in (q, k, v):
+        if t is None:
+            res.append(None)
+        else:
+            res.append(out[i])
+            i += 1
+    return tuple(res)
+
+
+def fused_linear(x, weight, bias=None, transpose_weight=False, name=None):
+    """fused_linear (fused_matmul_bias): one matmul+bias epilogue."""
+    if transpose_weight:
+        from paddle_tpu.ops.linalg import matmul
+
+        out = matmul(x, weight, transpose_y=True)
+        return out + bias if bias is not None else out
+    return F.linear(x, weight, bias)
+
+
+def fused_bias_act(x, bias=None, act_method="gelu", **kwargs):
+    def f(xv, *rest):
+        h = xv + rest[0] if rest else xv
+        if act_method in ("gelu", "geglu"):
+            return jax.nn.gelu(h)
+        if act_method in ("swiglu",):
+            a, b = jnp.split(h, 2, axis=-1)
+            return jax.nn.silu(a) * b
+        if act_method == "relu":
+            return jax.nn.relu(h)
+        if act_method == "silu":
+            return jax.nn.silu(h)
+        raise ValueError(f"unknown act {act_method}")
+
+    args = [x] + ([bias] if bias is not None else [])
+    return apply("fused_bias_act", f, *args)
+
+
+def fused_multi_head_attention(x, qkv_weight, linear_weight, pre_layer_norm=False,
+                               pre_ln_scale=None, pre_ln_bias=None, ln_scale=None,
+                               ln_bias=None, pre_ln_epsilon=1e-5, qkv_bias=None,
+                               linear_bias=None, cache_kv=None, attn_mask=None,
+                               dropout_rate=0.0, attn_dropout_rate=0.0,
+                               ln_epsilon=1e-5, training=True, num_heads=None,
+                               name=None):
+    """FusedMultiHeadAttention functional path (fused_transformer.py:189).
+    qkv_weight: [3, num_heads, head_dim, embed_dim] (paddle layout)."""
+
+    if cache_kv is not None:
+        raise NotImplementedError(
+            "cache_kv decode path lands with the serving stack; run the "
+            "prefill-style full-sequence call meanwhile")
+    if num_heads is not None and num_heads != qkv_weight.shape[1]:
+        raise ValueError(
+            f"num_heads={num_heads} does not match qkv_weight head dim "
+            f"{qkv_weight.shape[1]}")
+
+    def f(xv, qkv_w, lin_w, *rest):
+        it = iter(rest)
+        pls = next(it) if pre_ln_scale is not None else None
+        plb = next(it) if pre_ln_bias is not None else None
+        lns = next(it) if ln_scale is not None else None
+        lnb = next(it) if ln_bias is not None else None
+        qkv_b = next(it) if qkv_bias is not None else None
+        lin_b = next(it) if linear_bias is not None else None
+        mask = next(it) if attn_mask is not None else None
+
+        residual = xv
+        h = xv
+        if pre_layer_norm:
+            mu = jnp.mean(h, axis=-1, keepdims=True)
+            var = jnp.var(h, axis=-1, keepdims=True)
+            h = (h - mu) * jax.lax.rsqrt(var + pre_ln_epsilon)
+            if pls is not None:
+                h = h * pls
+            if plb is not None:
+                h = h + plb
+        three, nh, hd, emb = qkv_w.shape
+        w = qkv_w.reshape(3 * nh * hd, emb).T  # [emb, 3*nh*hd]
+        qkv = h @ w
+        if qkv_b is not None:
+            qkv = qkv + qkv_b.reshape(-1)
+        b, s, _ = qkv.shape
+        qkv = qkv.reshape(b, s, 3, nh, hd)
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        from paddle_tpu.ops.pallas.flash_attention import flash_attention_fwd
+
+        out = flash_attention_fwd(q, k, v, bias=mask, causal=False,
+                                  scale=1.0 / math.sqrt(hd))
+        if attn_dropout_rate > 0.0 and training:
+            keep = jax.random.bernoulli(
+                _rng.next_key(), 1.0 - attn_dropout_rate, out.shape)
+            out = jnp.where(keep, out / (1.0 - attn_dropout_rate), 0.0)
+        out = out.reshape(b, s, nh * hd)
+        out = out @ lin_w
+        if lin_b is not None:
+            out = out + lin_b
+        if dropout_rate > 0.0 and training:
+            keep = jax.random.bernoulli(
+                _rng.next_key(), 1.0 - dropout_rate, out.shape)
+            out = jnp.where(keep, out / (1.0 - dropout_rate), 0.0)
+        out = residual + out
+        if not pre_layer_norm:
+            mu = jnp.mean(out, axis=-1, keepdims=True)
+            var = jnp.var(out, axis=-1, keepdims=True)
+            out = (out - mu) * jax.lax.rsqrt(var + ln_epsilon)
+            if lns is not None:
+                out = out * lns
+            if lnb is not None:
+                out = out + lnb
+        return out
+
+    args = [x, qkv_weight, linear_weight]
+    for t in (pre_ln_scale, pre_ln_bias, ln_scale, ln_bias, qkv_bias,
+              linear_bias, attn_mask):
+        if t is not None:
+            args.append(t)
+    return apply("fused_multi_head_attention", f, *args)
+
+
+def masked_multihead_attention(x, cache_kv=None, bias=None, src_mask=None,
+                               cum_offsets=None, sequence_lengths=None,
+                               rotary_tensor=None, beam_cache_offset=None,
+                               **kwargs):
+    """Decode-phase attention of one query token against a dense static KV
+    cache (reference: incubate/nn/functional/masked_multihead_attention —
+    same parameter order — kernel
+    phi/kernels/fusion/gpu/masked_multihead_attention_kernel.cu).
+
+    ``x``: [B, 3, H, D] (or [B, 3*H*D]) fused QKV for the new token;
+    ``cache_kv``: [2, B, max_len, H, D] preallocated cache;
+    ``sequence_lengths``: [B] tokens already cached. Returns
+    (out [B, H*D], new_cache_kv)."""
+    from paddle_tpu.models.kv_cache import _static_cache_raw
+
+    if cache_kv is None or sequence_lengths is None:
+        raise ValueError("cache_kv and sequence_lengths are required")
+    unsupported = {"cum_offsets": cum_offsets, "rotary_tensor": rotary_tensor,
+                   "beam_cache_offset": beam_cache_offset,
+                   "src_mask": src_mask}
+    for name, val in unsupported.items():
+        if val is not None:
+            raise NotImplementedError(
+                f"masked_multihead_attention: {name} is not supported on "
+                "this backend")
+    for name in ("qkv_out_scale", "out_shift", "out_smooth"):
+        if kwargs.get(name) is not None:
+            raise NotImplementedError(
+                f"masked_multihead_attention: quantization arg {name} is "
+                "not supported on this backend")
+
+    n_bias = 1 if bias is not None else 0
+
+    def f(xv, ckv, lens, *rest):
+        B = xv.shape[0]
+        H, D = ckv.shape[3], ckv.shape[4]
+        qkv = xv.reshape(B, 3, H, D)
+        if n_bias:
+            qkv = qkv + rest[0].reshape(1, 3, H, D)
+        q = qkv[:, 0][:, None]  # [B, 1, H, D]
+        k = qkv[:, 1][:, None]
+        v = qkv[:, 2][:, None]
+        out, ck2, cv2, _ = _static_cache_raw(
+            q, k, v, ckv[0], ckv[1], lens.astype(jnp.int32))
+        return out[:, 0].reshape(B, H * D), jnp.stack([ck2, cv2])
+
+    args = [x, cache_kv, sequence_lengths] + ([bias] if bias is not None else [])
+    return apply("masked_multihead_attention", f, *args, differentiable=False)
+
+
+def block_multihead_attention(qkv, key_cache, value_cache, seq_lens,
+                              block_tables, **kwargs):
+    """Paged (block-table) KV-cache attention (reference:
+    incubate/nn/functional/block_multihead_attention, kernel
+    phi/kernels/fusion/gpu/block_multi_head_attention_kernel.cu — the
+    vLLM-style serving attention).
+
+    ``qkv``: [B, S, 3, H, D] new tokens; ``key_cache``/``value_cache``:
+    [num_blocks, block_size, H, D] pools; ``seq_lens``: [B] cached lengths;
+    ``block_tables``: [B, max_blocks] int32. Returns
+    (out [B, S, H*D], new_key_cache, new_value_cache)."""
+    from paddle_tpu.models.kv_cache import _paged_cache_raw
+
+    for name, val in kwargs.items():
+        if val is not None:
+            raise NotImplementedError(
+                f"block_multihead_attention: {name} is not supported on "
+                "this backend")
+
+    def f(qkv_v, kp, vp, lens, tables):
+        B, S = qkv_v.shape[0], qkv_v.shape[1]
+        H, D = qkv_v.shape[3], qkv_v.shape[4]
+        q, k, v = qkv_v[:, :, 0], qkv_v[:, :, 1], qkv_v[:, :, 2]
+        out, kp2, vp2, _ = _paged_cache_raw(
+            q, k, v, kp, vp, tables.astype(jnp.int32),
+            lens.astype(jnp.int32))
+        return out.reshape(B, S, H * D), kp2, vp2
+
+    return apply("block_multihead_attention", f, qkv, key_cache, value_cache,
+                 seq_lens, block_tables, differentiable=False)

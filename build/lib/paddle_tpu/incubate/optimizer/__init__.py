@@ -1,0 +1,6 @@
+"""paddle.incubate.optimizer parity."""
+
+from paddle_tpu.incubate.optimizer.distributed_fused_lamb import (  # noqa: F401
+    DistributedFusedLamb,
+)
+from paddle_tpu.incubate.optimizer.fused_adamw import FusedAdamW  # noqa: F401

@@ -1,0 +1,217 @@
+"""paddle.inference — deployment API (parity:
+paddle/fluid/inference/api/analysis_predictor.h:105 AnalysisPredictor,
+python surface python/paddle/inference/).
+
+TPU-native: the "analysis + IR pass + engine" pipeline collapses onto the
+exported StableHLO program (jit.save) compiled by XLA — there is no separate
+optimization pass stack to configure, so Config's tuning knobs are accepted
+for API compatibility and recorded but have no effect (XLA owns fusion,
+layout, and memory planning). Predictor::Run executes the deserialized
+program as one compiled call with zero-copy device arrays.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as np
+
+import jax.numpy as jnp
+
+
+class PrecisionType:
+    Float32 = 0
+    Half = 1
+    Bfloat16 = 2
+    Int8 = 3
+
+
+class PlaceType:
+    UNK = -1
+    CPU = 0
+    GPU = 1
+    XPU = 2
+    CUSTOM = 3
+
+
+class Config:
+    """paddle.inference.Config parity: holds model paths + knobs."""
+
+    def __init__(self, prog_file: Optional[str] = None,
+                 params_file: Optional[str] = None):
+        # jit.save writes <path>.stablehlo/.pdiparams/.meta: accept either
+        # the bare prefix or the .stablehlo/.pdmodel file name
+        self._prefix = None
+        if prog_file is not None:
+            p = prog_file
+            for suf in (".stablehlo", ".pdmodel", ".json"):
+                if p.endswith(suf):
+                    p = p[: -len(suf)]
+            self._prefix = p
+        self._flags: Dict[str, object] = {}
+
+    # --- knobs ---------------------------------------------------------
+    # Each knob is either APPLIED (has a real effect on this backend) or
+    # ABSORBED (the concern it configures is owned by XLA — fusion, memory
+    # planning, engine selection). summary() reports which is which, so the
+    # deployment surface is honest instead of silently recording.
+    _ABSORBED = {"use_gpu", "memory_optim", "ir_optim", "mkldnn"}
+
+    def enable_use_gpu(self, memory_pool_init_size_mb=100, device_id=0,
+                       precision=PrecisionType.Float32):
+        self._flags["use_gpu"] = True  # device selection is jax-global
+
+    def disable_gpu(self):
+        self._flags["use_gpu"] = False
+
+    def enable_memory_optim(self, x=True):
+        # XLA's buffer assignment IS the memory optimizer; weights are
+        # uploaded once and reused (TranslatedLayer caches device arrays)
+        self._flags["memory_optim"] = x
+
+    def switch_ir_optim(self, x=True):
+        self._flags["ir_optim"] = x  # XLA pass pipeline always runs
+
+    def set_cpu_math_library_num_threads(self, n):
+        """APPLIED best-effort: caps XLA:CPU intra-op threads. Must run
+        before the jax backend initializes (process start); afterwards it
+        only records."""
+        import os
+
+        import jax
+
+        self._flags["cpu_threads"] = n
+        try:
+            initialized = jax._src.xla_bridge._backends  # noqa: SLF001
+        except Exception:
+            initialized = True
+        if not initialized:
+            flags = os.environ.get("XLA_FLAGS", "")
+            os.environ["XLA_FLAGS"] = (
+                f"{flags} --xla_cpu_multi_thread_eigen=true "
+                f"intra_op_parallelism_threads={n}").strip()
+        else:
+            self._flags["cpu_threads_note"] = "backend already up; recorded"
+
+    def enable_mkldnn(self):
+        self._flags["mkldnn"] = True
+
+    def disable_glog_info(self):
+        """APPLIED: silences jax/XLA info logging."""
+        import logging
+
+        self._flags["glog"] = False
+        for name in ("jax", "jax._src.xla_bridge", "jax._src.dispatch"):
+            logging.getLogger(name).setLevel(logging.WARNING)
+
+    def enable_tensorrt_engine(self, *a, **k):
+        raise NotImplementedError(
+            "TensorRT is CUDA-specific; the XLA-compiled program is already "
+            "the optimized engine on this backend")
+
+    def model_dir(self):
+        return self._prefix
+
+    def prog_file(self):
+        return (self._prefix or "") + ".stablehlo"
+
+    def params_file(self):
+        return (self._prefix or "") + ".pdiparams"
+
+    def summary(self):
+        lines = []
+        for k, v in self._flags.items():
+            tag = "absorbed-by-XLA" if k in self._ABSORBED else "applied"
+            lines.append(f"{k}: {v} [{tag}]")
+        return "\n".join(lines)
+
+
+class InferTensor:
+    """Input/output handle (paddle.inference.Tensor parity):
+    copy_from_cpu / copy_to_cpu / shape."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self._value = None
+
+    def copy_from_cpu(self, arr: np.ndarray):
+        self._value = jnp.asarray(arr)
+
+    def reshape(self, shape):
+        if self._value is not None:
+            self._value = jnp.reshape(self._value, shape)
+
+    def copy_to_cpu(self) -> np.ndarray:
+        return np.asarray(self._value)
+
+    def shape(self) -> List[int]:
+        return list(self._value.shape) if self._value is not None else []
+
+
+class Predictor:
+    """paddle.inference.Predictor over a jit.save'd StableHLO program."""
+
+    def __init__(self, config: Config):
+        from paddle_tpu.jit.serialization import load
+
+        if config._prefix is None:
+            raise ValueError("Config needs a model path (jit.save prefix)")
+        self._layer = load(config._prefix)
+        if not self._layer._input_specs:
+            raise RuntimeError(
+                "model metadata lacks input_specs (saved with an older "
+                "jit.save); re-save the model to use paddle.inference")
+        n_in = len(self._layer._input_specs)
+        self._input_names = [f"x{i}" for i in range(n_in)]
+        self._inputs = {n: InferTensor(n) for n in self._input_names}
+        self._outputs: List[InferTensor] = []
+
+    def get_input_names(self) -> List[str]:
+        return list(self._input_names)
+
+    def get_input_handle(self, name: str) -> InferTensor:
+        return self._inputs[name]
+
+    def run(self, inputs: Optional[list] = None):
+        """Execute. Either pass ``inputs`` (list of ndarrays, returned as
+        ndarrays — the modern python API) or use the handle protocol."""
+        if inputs is not None:
+            if len(inputs) != len(self._input_names):
+                raise ValueError(
+                    f"model expects {len(self._input_names)} inputs, "
+                    f"got {len(inputs)}")
+            for n, a in zip(self._input_names, inputs):
+                self._inputs[n].copy_from_cpu(np.asarray(a))
+        xs = [self._inputs[n]._value for n in self._input_names]
+        if any(x is None for x in xs):
+            missing = [n for n in self._input_names
+                       if self._inputs[n]._value is None]
+            raise RuntimeError(f"inputs not set: {missing}")
+        out = self._layer(*xs)
+        outs = out if isinstance(out, (list, tuple)) else [out]
+        self._outputs = []
+        for i, o in enumerate(outs):
+            t = InferTensor(f"out{i}")
+            t._value = o._value if hasattr(o, "_value") else jnp.asarray(o)
+            self._outputs.append(t)
+        if inputs is not None:
+            return [np.asarray(t._value) for t in self._outputs]
+
+    def get_output_names(self) -> List[str]:
+        return [t.name for t in self._outputs] or ["out0"]
+
+    def get_output_handle(self, name: str) -> InferTensor:
+        for t in self._outputs:
+            if t.name == name:
+                return t
+        raise KeyError(name)
+
+
+def create_predictor(config: Config) -> Predictor:
+    return Predictor(config)
+
+
+def get_version() -> str:
+    import paddle_tpu
+
+    return getattr(paddle_tpu, "__version__", "0.0.0") + "-tpu-inference"

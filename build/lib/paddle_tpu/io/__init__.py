@@ -1,0 +1,30 @@
+"""paddle_tpu.io (parity: python/paddle/io — reader.py:216 DataLoader +
+io/dataloader/ worker/sampler/collate).
+
+TPU-native data path: the bottleneck is host->HBM transfer, so the DataLoader
+pipelines collation on worker threads and keeps a device-prefetch depth of
+``prefetch_factor`` batches (the analogue of the reference's multiprocess
+workers + shared-memory transport; a C++ packing core backs the hot path when
+built — see paddle_tpu/lib/).
+"""
+
+from paddle_tpu.io.dataset import (  # noqa: F401
+    ChainDataset,
+    ComposeDataset,
+    ConcatDataset,
+    Dataset,
+    IterableDataset,
+    Subset,
+    TensorDataset,
+    random_split,
+)
+from paddle_tpu.io.sampler import (  # noqa: F401
+    BatchSampler,
+    DistributedBatchSampler,
+    RandomSampler,
+    Sampler,
+    SequenceSampler,
+    SubsetRandomSampler,
+    WeightedRandomSampler,
+)
+from paddle_tpu.io.dataloader import DataLoader, default_collate_fn  # noqa: F401

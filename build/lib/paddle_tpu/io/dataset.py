@@ -1,0 +1,149 @@
+"""Datasets (parity: python/paddle/io/dataloader/dataset.py)."""
+
+from __future__ import annotations
+
+import bisect
+
+import numpy as np
+
+
+class Dataset:
+    def __getitem__(self, idx):
+        raise NotImplementedError
+
+    def __len__(self):
+        raise NotImplementedError
+
+
+class IterableDataset(Dataset):
+    def __iter__(self):
+        raise NotImplementedError
+
+    def __getitem__(self, idx):
+        raise RuntimeError("IterableDataset is not indexable")
+
+    def __len__(self):
+        raise RuntimeError("IterableDataset has no len()")
+
+
+class TensorDataset(Dataset):
+    def __init__(self, tensors):
+        from paddle_tpu.tensor import Tensor
+
+        assert all(
+            t.shape[0] == tensors[0].shape[0] for t in tensors
+        ), "all tensors must share dim 0"
+        self.tensors = tensors
+
+    def __getitem__(self, idx):
+        return tuple(t[idx] for t in self.tensors)
+
+    def __len__(self):
+        return self.tensors[0].shape[0]
+
+
+class ComposeDataset(Dataset):
+    def __init__(self, datasets):
+        self.datasets = list(datasets)
+        assert all(len(d) == len(self.datasets[0]) for d in self.datasets)
+
+    def __len__(self):
+        return len(self.datasets[0])
+
+    def __getitem__(self, idx):
+        out = []
+        for d in self.datasets:
+            sample = d[idx]
+            if isinstance(sample, (list, tuple)):
+                out.extend(sample)
+            else:
+                out.append(sample)
+        return tuple(out)
+
+
+class ChainDataset(IterableDataset):
+    def __init__(self, datasets):
+        self.datasets = list(datasets)
+
+    def __iter__(self):
+        for d in self.datasets:
+            yield from d
+
+
+class ConcatDataset(Dataset):
+    def __init__(self, datasets):
+        self.datasets = list(datasets)
+        self.cumulative_sizes = np.cumsum([len(d) for d in self.datasets]).tolist()
+
+    def __len__(self):
+        return self.cumulative_sizes[-1]
+
+    def __getitem__(self, idx):
+        if idx < 0:
+            idx = len(self) + idx
+        ds_idx = bisect.bisect_right(self.cumulative_sizes, idx)
+        start = 0 if ds_idx == 0 else self.cumulative_sizes[ds_idx - 1]
+        return self.datasets[ds_idx][idx - start]
+
+
+class Subset(Dataset):
+    def __init__(self, dataset, indices):
+        self.dataset = dataset
+        self.indices = list(indices)
+
+    def __getitem__(self, idx):
+        return self.dataset[self.indices[idx]]
+
+    def __len__(self):
+        return len(self.indices)
+
+
+def random_split(dataset, lengths, generator=None):
+    import random as _random
+
+    if sum(lengths) != len(dataset):
+        # fraction support
+        if all(0 < l < 1 for l in lengths):
+            total = len(dataset)
+            lengths = [int(l * total) for l in lengths]
+            lengths[-1] = total - sum(lengths[:-1])
+        else:
+            raise ValueError("sum of lengths != dataset size")
+    indices = list(range(len(dataset)))
+    _random.shuffle(indices)
+    out = []
+    offset = 0
+    for l in lengths:
+        out.append(Subset(dataset, indices[offset:offset + l]))
+        offset += l
+    return out
+
+
+class ConcatDataset(Dataset):
+    """paddle.io.ConcatDataset parity."""
+
+    def __init__(self, datasets):
+        self.datasets = list(datasets)
+        if not self.datasets:
+            raise ValueError("ConcatDataset needs at least one dataset")
+        self._sizes = [len(d) for d in self.datasets]
+        self._offsets = []
+        total = 0
+        for s in self._sizes:
+            self._offsets.append(total)
+            total += s
+        self._total = total
+
+    def __getitem__(self, idx):
+        orig = idx
+        if idx < 0:
+            idx += self._total
+        if idx < 0 or idx >= self._total:
+            raise IndexError(orig)
+        for d, off, size in zip(self.datasets, self._offsets, self._sizes):
+            if idx < off + size:
+                return d[idx - off]
+        raise IndexError(orig)
+
+    def __len__(self):
+        return self._total

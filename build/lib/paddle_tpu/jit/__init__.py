@@ -1,0 +1,8 @@
+"""paddle_tpu.jit (parity: python/paddle/jit)."""
+
+from paddle_tpu.jit.api import StaticFunction, TrainStep, not_to_static, to_static  # noqa: F401
+from paddle_tpu.jit.serialization import load, save  # noqa: F401
+from paddle_tpu.jit import sot  # noqa: F401
+from paddle_tpu.jit.sot import symbolic_translate  # noqa: F401
+
+from paddle_tpu.ops.control_flow import case, cond, switch_case, while_loop  # noqa: F401,E402

@@ -1,0 +1,752 @@
+"""dy2static AST control-flow transformer (parity:
+python/paddle/jit/dy2static/transformers/ifelse_transformer.py and the
+while-loop transformer under jit/dy2static/transformers/).
+
+jax tracing already captures trace-time Python control flow; what it cannot
+capture is *data-dependent* branching on traced values. This pass closes
+that gap the way the reference's AST path does: ``if``/``while`` whose
+predicate is a Tensor are rewritten into ``paddle.static.nn.cond`` /
+``while_loop`` calls (lowering to lax.cond/lax.while_loop), while plain
+Python predicates keep exact Python semantics through the same runtime
+helpers.
+
+Unsupported inside a transformed block (left untransformed, as in eager):
+``return`` / ``break`` / ``continue`` — matching the subset the builder
+documents; the reference handles these with early-exit flags.
+"""
+
+from __future__ import annotations
+
+import ast
+import inspect
+import textwrap
+from typing import Callable, List, Tuple
+
+from paddle_tpu.tensor import Tensor
+
+
+class _Undefined:
+    """Placeholder for names not yet bound when a branch runs (the
+    reference's UndefinedVar)."""
+
+    __slots__ = ()
+
+    def __repr__(self):
+        return "UNDEF"
+
+
+UNDEF = _Undefined()
+
+
+def convert_ifelse(pred, true_fn: Callable, false_fn: Callable, vars: Tuple):
+    """Runtime dispatch: Tensor predicate -> compiled cond; Python value ->
+    plain branch (identical semantics to the untransformed code)."""
+    if isinstance(pred, Tensor):
+        import jax
+        import jax.numpy as jnp
+
+        from paddle_tpu.ops import control_flow
+
+        # UNDEF placeholders (names unbound before the if) cannot enter the
+        # traced cond: strip them from the operands and re-inject inside the
+        # branches. Outputs a branch leaves UNDEF are filled with zeros of
+        # the OTHER branch's aval (the reference's UndefinedVar fill,
+        # return/undefined_var transformers) — sound because python
+        # semantics make later reads reachable only under the defining
+        # branch's condition; outputs UNDEF in BOTH branches stay out of the
+        # cond and come back as UNDEF.
+        undef = {i for i, v in enumerate(vars) if v is UNDEF}
+        live = tuple(v for i, v in enumerate(vars) if i not in undef)
+
+        if not undef:
+            # fast path: no placeholders anywhere, no probe needed
+            def plain(fn):
+                def inner(*vs):
+                    return tuple(fn(*vs))
+
+                return inner
+
+            return control_flow.cond(pred, plain(true_fn), plain(false_fn),
+                                     operands=live)
+
+        def run_full(fn, live_vs):
+            it = iter(live_vs)
+            full = [UNDEF if i in undef else next(it)
+                    for i in range(len(vars))]
+            return list(fn(*full))
+
+        tensor_pos = [i for i, v in enumerate(live)
+                      if isinstance(v, Tensor)]
+        tset = set(tensor_pos)
+        tvals = [live[i]._value for i in tensor_pos]
+
+        def probe(fn):
+            def p(*tv):
+                it = iter(tv)
+                lv = [Tensor._from_value(next(it)) if i in tset else live[i]
+                      for i in range(len(live))]
+                out = run_full(fn, lv)
+                return [None if o is UNDEF else o for o in out]
+
+            return jax.eval_shape(p, *tvals)
+
+        probe_t = probe(true_fn)
+        probe_f = probe(false_fn)
+        both_undef = {i for i in range(len(probe_t))
+                      if probe_t[i] is None and probe_f[i] is None}
+
+        def _aval(x):
+            v = x._value if isinstance(x, Tensor) else x
+            return v  # ShapeDtypeStruct
+
+        def fill_wrap(fn, other_probe):
+            def inner(*live_vs):
+                out = run_full(fn, live_vs)
+                res = []
+                for i, o in enumerate(out):
+                    if i in both_undef:
+                        continue
+                    if o is UNDEF:
+                        sd = _aval(other_probe[i])
+                        res.append(Tensor._from_value(
+                            jnp.zeros(sd.shape, sd.dtype)))
+                    else:
+                        res.append(o)
+                return tuple(res)
+
+            return inner
+
+        cond_out = control_flow.cond(pred, fill_wrap(true_fn, probe_f),
+                                     fill_wrap(false_fn, probe_t),
+                                     operands=live)
+        if not isinstance(cond_out, (list, tuple)):
+            cond_out = (cond_out,)
+        it = iter(cond_out)
+        return tuple(UNDEF if i in both_undef else next(it)
+                     for i in range(len(vars)))
+    return true_fn(*vars) if pred else false_fn(*vars)
+
+
+def convert_while(cond_fn: Callable, body_fn: Callable, vars: Tuple):
+    """Runtime dispatch for while: Tensor condition -> while_loop op."""
+    first = cond_fn(*vars)
+    if isinstance(first, Tensor):
+        return _traced_while(cond_fn, body_fn, vars)
+    vars = tuple(vars)
+    cur = first
+    while True:
+        if isinstance(cur, Tensor):
+            # the predicate became traced mid-loop (e.g. an early-exit flag
+            # produced by a compiled cond): promote the remaining iterations
+            return _traced_while(cond_fn, body_fn, vars)
+        if not cur:
+            break
+        vars = tuple(body_fn(*vars))
+        cur = cond_fn(*vars)
+    return vars
+
+
+def _traced_while(cond_fn: Callable, body_fn: Callable, vars: Tuple):
+    import paddle_tpu as paddle
+    from paddle_tpu.ops import control_flow
+
+    # numeric loop carries become Tensors (they must be traced values
+    # for lax.while_loop; matches the reference's variable promotion)
+    vars = tuple(paddle.to_tensor(v)
+                 if isinstance(v, (int, float, bool)) else v
+                 for v in vars)
+    # body-local temps (unbound before the loop) can't be loop carries:
+    # keep them out of the carry, re-inject UNDEF each iteration (the
+    # body assigns them before use; their post-loop value is dropped)
+    undef = {i for i, v in enumerate(vars) if v is UNDEF}
+    if undef:
+        live = [v for i, v in enumerate(vars) if i not in undef]
+
+        def full_args(live_vs):
+            it = iter(live_vs)
+            return [UNDEF if i in undef else next(it)
+                    for i in range(len(vars))]
+
+        def cond2(*live_vs):
+            return cond_fn(*full_args(live_vs))
+
+        def body2(*live_vs):
+            out = body_fn(*full_args(live_vs))
+            return [o for i, o in enumerate(out) if i not in undef]
+
+        res = control_flow.while_loop(cond2, body2, live)
+        it = iter(res)
+        return tuple(UNDEF if i in undef else next(it)
+                     for i in range(len(vars)))
+    out = control_flow.while_loop(cond_fn, body_fn, list(vars))
+    return tuple(out)
+
+
+def convert_to_sequence(it):
+    """Normalize a for-loop iterable: Tensors iterate their leading dim;
+    ranges stay lazy; other iterables materialize to a list (python
+    semantics preserved)."""
+    if isinstance(it, (Tensor, range, list, tuple)):
+        return it
+    return list(it)
+
+
+def convert_len(seq):
+    if isinstance(seq, Tensor):
+        return seq.shape[0]
+    return len(seq)
+
+
+def convert_getitem(seq, idx):
+    if isinstance(seq, range):
+        # range(start, stop, step)[i] with a Tensor index: compute directly
+        if isinstance(idx, Tensor):
+            return seq.start + idx * seq.step
+        return seq[idx]
+    if isinstance(seq, (list, tuple)) and isinstance(idx, Tensor):
+        import paddle_tpu as paddle
+
+        return paddle.to_tensor(list(seq))[idx]
+    return seq[idx]
+
+
+def logical_not(x):
+    if isinstance(x, Tensor):
+        import paddle_tpu as paddle
+
+        return paddle.logical_not(x)
+    return not x
+
+
+def logical_and(a, b):
+    if isinstance(a, Tensor) or isinstance(b, Tensor):
+        import paddle_tpu as paddle
+
+        return paddle.logical_and(paddle.to_tensor(a), paddle.to_tensor(b))
+    return a and b
+
+
+def logical_or(a, b):
+    if isinstance(a, Tensor) or isinstance(b, Tensor):
+        import paddle_tpu as paddle
+
+        return paddle.logical_or(paddle.to_tensor(a), paddle.to_tensor(b))
+    return a or b
+
+
+def convert_return_ifelse(pred, true_fn: Callable, false_fn: Callable,
+                          vars: Tuple):
+    """Both-branches-return if: the whole statement becomes the function's
+    return value (reference: return_transformer.py early-exit case). Branch
+    fns take the surrounding locals as args (so branch-local reassignment
+    cannot shadow them into UnboundLocalError)."""
+    if isinstance(pred, Tensor):
+        from paddle_tpu.ops import control_flow
+
+        live = tuple(v for v in vars if v is not UNDEF)
+        live_idx = [i for i, v in enumerate(vars) if v is not UNDEF]
+
+        def wrap(fn):
+            def inner(*live_vs):
+                it = iter(live_vs)
+                full = [vars[i] if i not in live_idx else next(it)
+                        for i in range(len(vars))]
+                return fn(*full)
+            return inner
+
+        return control_flow.cond(pred, wrap(true_fn), wrap(false_fn),
+                                 operands=live)
+    return true_fn(*vars) if pred else false_fn(*vars)
+
+
+def loop_continue(brk, test_thunk):
+    """Loop-continuation test after break-desugaring, with python-side
+    short-circuit: once the break flag is a concrete True the original test
+    is NOT re-evaluated (it may only be safe under the loop invariant,
+    e.g. bounds-checked indexing)."""
+    if isinstance(brk, Tensor):
+        # traced: both operands must be evaluated (XLA clamps OOB gathers)
+        return logical_and(test_thunk(), logical_not(brk))
+    if brk:
+        return False
+    return test_thunk()
+
+
+def is_tensor(x):
+    return isinstance(x, Tensor)
+
+
+def any_tensor(*xs):
+    return any(isinstance(x, Tensor) for x in xs)
+
+
+def _assigned_names(nodes: List[ast.stmt]) -> List[str]:
+    """Names stored anywhere in the statement list (order-stable)."""
+    found: List[str] = []
+
+    class V(ast.NodeVisitor):
+        def visit_Name(self, node):
+            if (isinstance(node.ctx, ast.Store) and node.id not in found
+                    and not node.id.startswith("__dy2s_")):
+                found.append(node.id)
+            self.generic_visit(node)
+
+        def visit_FunctionDef(self, node):
+            if node.name not in found and not node.name.startswith("__dy2s_"):
+                found.append(node.name)
+            # don't descend: inner function bodies have their own scope
+
+        visit_AsyncFunctionDef = visit_FunctionDef
+
+        def visit_Lambda(self, node):
+            pass
+
+    v = V()
+    for n in nodes:
+        v.visit(n)
+    return found
+
+
+def _scan_escapes(nodes: List[ast.stmt], kinds) -> bool:
+    """Any of the given escape-node kinds in the block, excluding nested
+    function bodies AND nested loops' break/continue (those belong to the
+    inner loop)."""
+
+    class V(ast.NodeVisitor):
+        found = False
+
+        def visit_Return(self, node):
+            if ast.Return in kinds:
+                self.found = True
+
+        def visit_Break(self, node):
+            if ast.Break in kinds:
+                self.found = True
+
+        def visit_Continue(self, node):
+            if ast.Continue in kinds:
+                self.found = True
+
+        def visit_While(self, node):
+            # descend only for Return (break/continue bind to inner loop)
+            if ast.Return in kinds:
+                for s in node.body + node.orelse:
+                    self.visit(s)
+
+        visit_For = visit_While
+
+        def visit_FunctionDef(self, node):
+            pass
+
+        visit_AsyncFunctionDef = visit_FunctionDef
+
+        def visit_Lambda(self, node):
+            pass
+
+    v = V()
+    for n in nodes:
+        v.visit(n)
+    return v.found
+
+
+def _has_escape(nodes: List[ast.stmt]) -> bool:
+    return _scan_escapes(nodes, (ast.Return, ast.Break, ast.Continue))
+
+
+def _has_return(nodes: List[ast.stmt]) -> bool:
+    return _scan_escapes(nodes, (ast.Return,))
+
+
+def _has_break_continue(nodes: List[ast.stmt]) -> bool:
+    return _scan_escapes(nodes, (ast.Break, ast.Continue))
+
+
+def range_cond(i, stop, step):
+    """Continuation test of a desugared range-for (handles negative step)."""
+    if isinstance(step, Tensor) or isinstance(i, Tensor) \
+            or isinstance(stop, Tensor):
+        import paddle_tpu as paddle
+
+        i_t = paddle.to_tensor(i)
+        stop_t = paddle.to_tensor(stop)
+        step_t = paddle.to_tensor(step)
+        return paddle.logical_or(
+            paddle.logical_and(step_t > 0, i_t < stop_t),
+            paddle.logical_and(step_t < 0, i_t > stop_t))
+    return i < stop if step > 0 else i > stop
+
+
+def _name(id_, ctx):
+    return ast.Name(id=id_, ctx=ctx)
+
+
+def _guard_stmts(names: List[str]) -> List[ast.stmt]:
+    """try: <name>\nexcept (NameError, UnboundLocalError): <name> = UNDEF"""
+    out = []
+    for n in names:
+        out.append(ast.Try(
+            body=[ast.Expr(value=_name(n, ast.Load()))],
+            handlers=[ast.ExceptHandler(
+                type=ast.Tuple(elts=[_name("NameError", ast.Load()),
+                                     _name("UnboundLocalError", ast.Load())],
+                               ctx=ast.Load()),
+                name=None,
+                body=[ast.Assign(targets=[_name(n, ast.Store())],
+                                 value=ast.Attribute(
+                                     value=_name("_dy2s", ast.Load()),
+                                     attr="UNDEF", ctx=ast.Load()))])],
+            orelse=[], finalbody=[]))
+    return out
+
+
+def _dy2s_call(attr, *args):
+    return ast.Call(
+        func=ast.Attribute(value=_name("_dy2s", ast.Load()), attr=attr,
+                           ctx=ast.Load()),
+        args=list(args), keywords=[])
+
+
+def _assign(name, value):
+    return ast.Assign(targets=[_name(name, ast.Store())], value=value)
+
+
+def _const(v):
+    return ast.Constant(value=v)
+
+
+class _LoopEscapeRewriter:
+    """Rewrite break/continue belonging to ONE loop into flag assignments
+    with guarded continuations (reference:
+    jit/dy2static/transformers/break_continue_transformer.py).
+
+    ``break``    -> <brk> = True
+    ``continue`` -> <cont> = True
+    and every statement after a construct that may set a flag is wrapped in
+    ``if _dy2s.logical_not(_dy2s.logical_or(brk, cont)): ...`` so the rest
+    of the iteration is skipped — which the if-transformer then compiles
+    when the flags are traced values.
+    """
+
+    def __init__(self, brk: str, cont: str):
+        self.brk = brk
+        self.cont = cont
+        self.used = False
+
+    def _guard(self, rest: List[ast.stmt]) -> ast.If:
+        test = _dy2s_call(
+            "logical_not",
+            _dy2s_call("logical_or", _name(self.brk, ast.Load()),
+                       _name(self.cont, ast.Load())))
+        return ast.If(test=test, body=rest, orelse=[])
+
+    def rewrite_block(self, stmts: List[ast.stmt]):
+        """Returns (new_stmts, may_escape)."""
+        out: List[ast.stmt] = []
+        for i, s in enumerate(stmts):
+            if isinstance(s, ast.Break):
+                self.used = True
+                out.append(_assign(self.brk, _const(True)))
+                return out, True  # rest of the block is unreachable
+            if isinstance(s, ast.Continue):
+                self.used = True
+                out.append(_assign(self.cont, _const(True)))
+                return out, True
+            if isinstance(s, ast.If):
+                body2, e1 = self.rewrite_block(s.body)
+                orelse2, e2 = self.rewrite_block(s.orelse)
+                out.append(ast.If(test=s.test, body=body2 or [ast.Pass()],
+                                  orelse=orelse2))
+                if e1 or e2:
+                    rest, esc = self.rewrite_block(stmts[i + 1:])
+                    if rest:
+                        out.append(self._guard(rest))
+                    return out, True
+            else:
+                # nested loops own their break/continue — leave untouched
+                out.append(s)
+        return out, False
+
+
+class ControlFlowTransformer(ast.NodeTransformer):
+    def __init__(self):
+        self._n = 0
+
+    def _fresh(self, kind):
+        self._n += 1
+        return f"__dy2s_{kind}_{self._n}"
+
+    def _fresh_flag(self, kind):
+        # NO __dy2s_ prefix: flags must be visible to _assigned_names so
+        # they become loop carries / branch outputs
+        self._n += 1
+        return f"__flag_{kind}_{self._n}"
+
+    def _branch_fn(self, fname: str, names: List[str],
+                   body: List[ast.stmt]) -> ast.FunctionDef:
+        ret = ast.Return(value=ast.Tuple(
+            elts=[_name(n, ast.Load()) for n in names], ctx=ast.Load()))
+        return ast.FunctionDef(
+            name=fname,
+            args=ast.arguments(
+                posonlyargs=[], args=[ast.arg(arg=n) for n in names],
+                vararg=None, kwonlyargs=[], kw_defaults=[], kwarg=None,
+                defaults=[]),
+            body=(body or [ast.Pass()]) + [ret],
+            decorator_list=[])
+
+    def _returns_on_all_paths(self, body: List[ast.stmt]) -> bool:
+        return bool(body) and isinstance(body[-1], ast.Return)
+
+    def visit_If(self, node: ast.If):
+        self.generic_visit(node)
+        # both-branches-return: the if IS the function's return value
+        if (self._returns_on_all_paths(node.body)
+                and self._returns_on_all_paths(node.orelse)
+                and not any(isinstance(n, (ast.Break, ast.Continue))
+                            for b in (node.body, node.orelse)
+                            for s in b for n in ast.walk(s))):
+            # branch fns take the locals they (re)assign as ARGS — a branch
+            # that rebinds an outer local must not shadow it into
+            # UnboundLocalError on the read side
+            names = _assigned_names(node.body + node.orelse)
+
+            def branch(body, fname):
+                ret = body[-1]
+                stmts = body[:-1] + [ast.Return(
+                    value=ret.value if ret.value is not None else _const(None))]
+                return ast.FunctionDef(
+                    name=fname,
+                    args=ast.arguments(
+                        posonlyargs=[], args=[ast.arg(arg=n) for n in names],
+                        vararg=None, kwonlyargs=[], kw_defaults=[],
+                        kwarg=None, defaults=[]),
+                    body=stmts, decorator_list=[])
+
+            tname = self._fresh("rtrue")
+            fname = self._fresh("rfalse")
+            return _guard_stmts(names) + [
+                branch(node.body, tname), branch(node.orelse, fname),
+                ast.Return(value=_dy2s_call(
+                    "convert_return_ifelse", node.test,
+                    _name(tname, ast.Load()), _name(fname, ast.Load()),
+                    ast.Tuple(elts=[_name(n, ast.Load()) for n in names],
+                              ctx=ast.Load())))]
+        if _has_escape(node.body) or _has_escape(node.orelse):
+            return node
+        names = _assigned_names(node.body + node.orelse)
+        if not names:
+            return node
+        tname = self._fresh("true")
+        fname = self._fresh("false")
+        tfn = self._branch_fn(tname, names, node.body)
+        ffn = self._branch_fn(fname, names, node.orelse)
+        call = ast.Assign(
+            targets=[ast.Tuple(elts=[_name(n, ast.Store()) for n in names],
+                               ctx=ast.Store())],
+            value=ast.Call(
+                func=ast.Attribute(value=_name("_dy2s", ast.Load()),
+                                   attr="convert_ifelse", ctx=ast.Load()),
+                args=[node.test,
+                      _name(tname, ast.Load()), _name(fname, ast.Load()),
+                      ast.Tuple(elts=[_name(n, ast.Load()) for n in names],
+                                ctx=ast.Load())],
+                keywords=[]))
+        return _guard_stmts(names) + [tfn, ffn, call]
+
+    def visit_While(self, node: ast.While):
+        self.generic_visit(node)
+        if _has_return(node.body) or node.orelse:
+            return node  # return-in-loop: eager fallback (documented subset)
+        if _has_break_continue(node.body):
+            # break/continue -> early-exit flags + guarded continuations
+            brk = self._fresh_flag("brk")
+            cont = self._fresh_flag("cont")
+            rw = _LoopEscapeRewriter(brk, cont)
+            body2, _ = rw.rewrite_block(node.body)
+            if _has_break_continue(body2):
+                # break/continue inside constructs the rewriter doesn't
+                # handle (try/with): leave the loop eager
+                return node
+            # short-circuit test: after a concrete break the original test
+            # must NOT re-run (may only be safe under the loop invariant)
+            new_test = _dy2s_call(
+                "loop_continue", _name(brk, ast.Load()),
+                ast.Lambda(args=ast.arguments(
+                    posonlyargs=[], args=[], vararg=None, kwonlyargs=[],
+                    kw_defaults=[], kwarg=None, defaults=[]),
+                    body=node.test))
+            new_body = [_assign(cont, _const(False))] + body2
+            new_while = ast.While(test=new_test, body=new_body, orelse=[])
+            prologue = [_assign(brk, _const(False)),
+                        _assign(cont, _const(False))]
+            converted = self.visit_While(new_while)
+            if not isinstance(converted, list):
+                converted = [converted]
+            return prologue + converted
+        names = _assigned_names(node.body)
+        if not names:
+            return node
+        cname = self._fresh("cond")
+        bname = self._fresh("body")
+        cfn = ast.FunctionDef(
+            name=cname,
+            args=ast.arguments(
+                posonlyargs=[], args=[ast.arg(arg=n) for n in names],
+                vararg=None, kwonlyargs=[], kw_defaults=[], kwarg=None,
+                defaults=[]),
+            body=[ast.Return(value=node.test)],
+            decorator_list=[])
+        bfn = self._branch_fn(bname, names, node.body)
+        call = ast.Assign(
+            targets=[ast.Tuple(elts=[_name(n, ast.Store()) for n in names],
+                               ctx=ast.Store())],
+            value=ast.Call(
+                func=ast.Attribute(value=_name("_dy2s", ast.Load()),
+                                   attr="convert_while", ctx=ast.Load()),
+                args=[_name(cname, ast.Load()), _name(bname, ast.Load()),
+                      ast.Tuple(elts=[_name(n, ast.Load()) for n in names],
+                                ctx=ast.Load())],
+                keywords=[]))
+        return _guard_stmts(names) + [cfn, bfn, call]
+
+    def visit_For(self, node: ast.For):
+        """Desugar ``for`` to an index-while when the iterable/trip-count is
+        data-dependent (reference: transformers/loop_transformer.py). The
+        rewrite dispatches AT RUNTIME: tensor iterables take the compiled
+        while path; everything else (lists, generators, static ranges) runs
+        the ORIGINAL python loop — laziness/side-effect order preserved."""
+        import copy
+
+        self.generic_visit(node)
+        if node.orelse or _has_return(node.body):
+            return node
+        if not isinstance(node.target, ast.Name):
+            return node  # tuple unpack targets: python fallback
+        tgt = node.target.id
+        idx = self._fresh_flag("idx")
+        prologue: List[ast.stmt] = []
+
+        is_range = (isinstance(node.iter, ast.Call)
+                    and isinstance(node.iter.func, ast.Name)
+                    and node.iter.func.id == "range"
+                    and not node.iter.keywords
+                    and 1 <= len(node.iter.args) <= 3)
+        if is_range:
+            # evaluate start/stop/step ONCE without constructing
+            # range(Tensor); dispatch on whether any bound is traced
+            a = node.iter.args
+            start = a[0] if len(a) >= 2 else _const(0)
+            stop = a[1] if len(a) >= 2 else a[0]
+            step = a[2] if len(a) == 3 else _const(1)
+            start_n = self._fresh_flag("start")
+            stop_n = self._fresh_flag("stop")
+            step_n = self._fresh_flag("step")
+            prologue += [_assign(start_n, start), _assign(stop_n, stop),
+                         _assign(step_n, step)]
+            dispatch = _dy2s_call("any_tensor", _name(start_n, ast.Load()),
+                                  _name(stop_n, ast.Load()),
+                                  _name(step_n, ast.Load()))
+            python_iter = ast.Call(
+                func=_name("range", ast.Load()),
+                args=[_name(start_n, ast.Load()), _name(stop_n, ast.Load()),
+                      _name(step_n, ast.Load())], keywords=[])
+            init_idx = _assign(idx, _name(start_n, ast.Load()))
+            test = _dy2s_call("range_cond", _name(idx, ast.Load()),
+                              _name(stop_n, ast.Load()),
+                              _name(step_n, ast.Load()))
+            head = [_assign(tgt, _name(idx, ast.Load()))]
+            inc = ast.BinOp(left=_name(idx, ast.Load()), op=ast.Add(),
+                            right=_name(step_n, ast.Load()))
+        else:
+            seq_n = self._fresh_flag("seq")
+            len_n = self._fresh_flag("len")
+            prologue += [_assign(seq_n, node.iter)]
+            dispatch = _dy2s_call("is_tensor", _name(seq_n, ast.Load()))
+            python_iter = _name(seq_n, ast.Load())
+            init_idx = _assign(idx, _const(0))
+            test = ast.Compare(left=_name(idx, ast.Load()), ops=[ast.Lt()],
+                               comparators=[_name(len_n, ast.Load())])
+            head = [_assign(tgt, _dy2s_call("convert_getitem",
+                                            _name(seq_n, ast.Load()),
+                                            _name(idx, ast.Load())))]
+            inc = ast.BinOp(left=_name(idx, ast.Load()), op=ast.Add(),
+                            right=_const(1))
+
+        # python arm: the untouched original loop (keeps its break/continue)
+        python_for = ast.For(target=copy.deepcopy(node.target),
+                             iter=python_iter,
+                             body=copy.deepcopy(node.body), orelse=[])
+
+        # tensor arm: index-while with flags for break/continue
+        body = node.body
+        tensor_arm: List[ast.stmt] = [init_idx]
+        if not is_range:
+            tensor_arm.append(_assign(len_n, _dy2s_call(
+                "convert_len", _name(seq_n, ast.Load()))))
+        if _has_break_continue(body):
+            # handled here (not by visit_While) because the index increment
+            # must run even when `continue` fires — python for semantics
+            brk = self._fresh_flag("brk")
+            cont = self._fresh_flag("cont")
+            rw = _LoopEscapeRewriter(brk, cont)
+            body2, _ = rw.rewrite_block(body)
+            if _has_break_continue(body2):
+                return node  # try/with-nested escapes: eager fallback
+            body = [_assign(cont, _const(False))] + body2
+            test = _dy2s_call(
+                "logical_and", test,
+                _dy2s_call("logical_not", _name(brk, ast.Load())))
+            tensor_arm += [_assign(brk, _const(False)),
+                           _assign(cont, _const(False))]
+        new_body = head + body + [_assign(idx, inc)]
+        new_while = ast.While(test=test, body=new_body, orelse=[])
+        converted = self.visit_While(new_while)
+        if not isinstance(converted, list):
+            converted = [converted]
+        tensor_arm += converted
+        return prologue + [ast.If(test=dispatch, body=tensor_arm,
+                                  orelse=[python_for])]
+
+
+def ast_transform(fn: Callable):
+    """Rewrite data-dependent if/while in ``fn`` (returns a new function, or
+    ``None`` when the function cannot be transformed — closures, no source,
+    lambdas)."""
+    if getattr(fn, "__closure__", None):
+        return None
+    try:
+        src = textwrap.dedent(inspect.getsource(fn))
+    except (OSError, TypeError):
+        return None
+    try:
+        tree = ast.parse(src)
+    except SyntaxError:
+        return None
+    fdef = tree.body[0]
+    if not isinstance(fdef, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        return None
+    fdef.decorator_list = []  # the decorator is being applied right now
+    t = ControlFlowTransformer()
+    new_tree = t.visit(tree)
+    if t._n == 0:
+        return fn  # nothing to rewrite
+    ast.fix_missing_locations(new_tree)
+    import paddle_tpu.jit.dy2static as _dy2s_mod
+
+    class _LiveGlobals(dict):
+        """Falls back to the function's LIVE module globals so names defined
+        after decoration (forward refs, monkeypatches) resolve at call
+        time."""
+
+        def __missing__(self, key):
+            return fn.__globals__[key]
+
+    ns = _LiveGlobals()
+    ns["_dy2s"] = _dy2s_mod
+    code = compile(new_tree, filename=f"<dy2static {fn.__qualname__}>",
+                   mode="exec")
+    exec(code, ns)
+    new_fn = ns[fdef.name]
+    new_fn.__defaults__ = fn.__defaults__
+    new_fn.__kwdefaults__ = fn.__kwdefaults__
+    return new_fn

@@ -1,0 +1,139 @@
+"""jit.save / jit.load (parity: python/paddle/jit/api.py jit.save -> inference
+program + params; PIR serialization fluid/pir/serialize_deserialize/).
+
+TPU-native format: the traced program is serialized as **StableHLO** via
+jax.export (the PIR-program analogue — stable, versioned, runnable without
+Python model code), params ride alongside as a pickled state dict.
+
+Layout:  <path>.stablehlo   serialized exported program
+         <path>.pdiparams   parameter payload (paddle-shaped extension)
+         <path>.meta        input structure metadata
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.jit.api import StaticFunction
+from paddle_tpu.jit.functional import tree_unwrap
+from paddle_tpu.nn.layer_base import Layer
+from paddle_tpu.tensor import Tensor
+
+
+def save(layer, path, input_spec=None, **configs):
+    """Serialize ``layer`` (or a to_static function) + example inputs.
+
+    ``input_spec``: list of example Tensors (or jax.ShapeDtypeStruct) defining
+    the traced signature, required unless the layer was already called.
+    """
+    if isinstance(layer, Layer):
+        fn = layer.forward
+        target = layer
+    else:
+        fn = layer
+        target = getattr(layer, "_layer", None)
+
+    if input_spec is None:
+        raise ValueError("jit.save requires input_spec (example inputs)")
+
+    specs = []
+    for s in input_spec:
+        if isinstance(s, Tensor):
+            specs.append(jax.ShapeDtypeStruct(tuple(s.shape), s.dtype))
+        elif isinstance(s, jax.ShapeDtypeStruct):
+            specs.append(s)
+        else:
+            arr = np.asarray(s)
+            specs.append(jax.ShapeDtypeStruct(arr.shape, arr.dtype))
+
+    # Build a pure inference function over (params, *inputs)
+    if target is not None:
+        target.eval()
+        params = dict(target.named_parameters())
+        buffers = {k: v for k, v in target.named_buffers() if v is not None}
+        state = {**params, **buffers}
+        names = list(state.keys())
+
+        def pure(state_vals, *xs):
+            from paddle_tpu.jit.functional import swap_values, tree_wrap
+
+            tensors = [state[n] for n in names]
+            with swap_values(tensors, state_vals):
+                out = fn(*tree_wrap(list(xs)))
+            return tree_unwrap(out)
+
+        state_vals = [state[n]._value for n in names]
+        state_specs = [jax.ShapeDtypeStruct(tuple(v.shape), v.dtype) for v in state_vals]
+        exported = jax.export.export(jax.jit(pure))(state_specs, *specs)
+        param_payload = {n: np.asarray(v) for n, v in zip(names, state_vals)}
+    else:
+        def pure(*xs):
+            from paddle_tpu.jit.functional import tree_wrap
+
+            return tree_unwrap(fn(*tree_wrap(list(xs))))
+
+        exported = jax.export.export(jax.jit(pure))(*specs)
+        param_payload = {}
+        names = []
+
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    with open(path + ".stablehlo", "wb") as f:
+        f.write(exported.serialize())
+    with open(path + ".pdiparams", "wb") as f:
+        pickle.dump(param_payload, f, protocol=4)
+    with open(path + ".meta", "wb") as f:
+        pickle.dump({
+            "param_names": names,
+            "input_specs": [(tuple(s.shape), str(np.dtype(s.dtype)))
+                            for s in specs],
+        }, f, protocol=4)
+
+
+class TranslatedLayer:
+    """Loaded inference program (parity: paddle.jit.TranslatedLayer)."""
+
+    def __init__(self, exported, params, param_names, input_specs=None):
+        self._exported = exported
+        self._params = params
+        self._param_names = param_names
+        self._input_specs = input_specs or []
+        self.training = False
+
+    def __call__(self, *inputs):
+        xs = [i._value if isinstance(i, Tensor) else jnp.asarray(i) for i in inputs]
+        if self._param_names:
+            if getattr(self, "_state_vals", None) is None:
+                # upload weights ONCE; re-converting per call would pay a
+                # host->device transfer for every Predictor.run
+                self._state_vals = [jnp.asarray(self._params[n])
+                                    for n in self._param_names]
+            out = self._exported.call(self._state_vals, *xs)
+        else:
+            out = self._exported.call(*xs)
+        if isinstance(out, (list, tuple)):
+            return type(out)(Tensor._from_value(o) for o in out)
+        return Tensor._from_value(out)
+
+    forward = __call__
+
+    def eval(self):
+        return self
+
+    def parameters(self):
+        return [Tensor._from_value(jnp.asarray(v)) for v in self._params.values()]
+
+
+def load(path, **configs) -> TranslatedLayer:
+    with open(path + ".stablehlo", "rb") as f:
+        exported = jax.export.deserialize(f.read())
+    with open(path + ".pdiparams", "rb") as f:
+        params = pickle.load(f)
+    with open(path + ".meta", "rb") as f:
+        meta = pickle.load(f)
+    return TranslatedLayer(exported, params, meta["param_names"],
+                           meta.get("input_specs"))

@@ -1,0 +1,176 @@
+"""SOT tier: bytecode-level capture with guards, graph breaks, and
+function-level fallback.
+
+Reference: python/paddle/jit/sot/ (22K LoC) — a CPython bytecode simulator
+(PEP-523 eval-frame hook pybind/eval_frame.c:439, opcode executor
+jit/sot/opcode_translator/executor/) that captures subgraphs, guards them on
+input properties, and falls back to eager at unsupported constructs.
+
+This package implements the contract in two tiers:
+
+1. **bytecode tier** (`bytecode.py`): a CPython 3.12 opcode executor with
+   lazy tensor regions — a frame containing `.numpy()` / `float()` /
+   tensor-dependent branching becomes compiled-region -> eager gap ->
+   compiled-region (sub-function graph breaks), with compiled regions
+   cached by statement signature and whole-frame guard chains for
+   break-free frames.
+2. **function tier** (this module): guarded whole-frame to_static capture
+   with permanent-eager fallback, used when the bytecode tier declines a
+   frame (unsupported opcode, generator, autograd interplay) — the
+   original round-2 machinery.
+
+- **guards**: each capture is keyed on the function's code object version,
+  tensor arg structures (shape/dtype/stop_gradient), non-tensor arg values,
+  and closure cell values. A guard miss re-captures (multiple
+  specializations coexist, like SOT's guard chains).
+- **graph breaks**: at bytecode tier, per-site (region split); at function
+  tier, constructs tracing cannot swallow mark the frame permanently eager.
+"""
+
+from __future__ import annotations
+
+import types
+from typing import Any, Callable, Dict, Optional, Tuple
+
+from paddle_tpu.tensor import Tensor
+
+
+class GuardError(Exception):
+    pass
+
+
+def _guard_of_value(v) -> Tuple:
+    if isinstance(v, Tensor):
+        return ("T", tuple(v.shape), str(v.dtype), bool(v.stop_gradient))
+    if isinstance(v, (int, float, bool, str, bytes, type(None))):
+        return ("P", v)
+    if isinstance(v, (list, tuple)):
+        return ("L", tuple(_guard_of_value(x) for x in v))
+    if isinstance(v, dict):
+        return ("D", tuple(sorted(
+            (k, _guard_of_value(x)) for k, x in v.items())))
+    # opaque objects guard on identity (module/layer instances)
+    return ("O", id(v))
+
+
+def _closure_guard(fn: Callable) -> Tuple:
+    cells = getattr(fn, "__closure__", None) or ()
+    out = []
+    for c in cells:
+        try:
+            out.append(_guard_of_value(c.cell_contents))
+        except ValueError:  # empty cell
+            out.append(("E",))
+    return tuple(out)
+
+
+class _Frame:
+    """Per-code-object capture state: guard table + fallback flags."""
+
+    def __init__(self, fn: Callable):
+        self.fn = fn
+        self.specializations: Dict[Tuple, Callable] = {}
+        self.fallback = False          # permanent eager (function tier broke)
+        self.bytecode_declined = False  # bytecode tier unsupported
+        self.breaks = 0                # function-tier breaks
+        self.captured: Optional[object] = None  # bytecode CapturedFrame
+
+    def guard_key(self, args, kwargs) -> Tuple:
+        return (
+            tuple(_guard_of_value(a) for a in args),
+            tuple(sorted((k, _guard_of_value(v)) for k, v in kwargs.items())),
+            _closure_guard(self.fn),
+        )
+
+
+_GRAPH_BREAK_TYPES: Tuple[type, ...] = ()
+
+
+def _graph_break_types():
+    global _GRAPH_BREAK_TYPES
+    if not _GRAPH_BREAK_TYPES:
+        import jax
+
+        types_ = [jax.errors.TracerArrayConversionError,
+                  jax.errors.TracerBoolConversionError,
+                  jax.errors.ConcretizationTypeError,
+                  jax.errors.TracerIntegerConversionError]
+        _GRAPH_BREAK_TYPES = tuple(types_)
+    return _GRAPH_BREAK_TYPES
+
+
+def _autograd_live(args, kwargs) -> bool:
+    from paddle_tpu.autograd import tape
+
+    if not tape.is_grad_enabled():
+        return False
+    import jax
+
+    leaves = jax.tree_util.tree_leaves(
+        (args, kwargs), is_leaf=lambda x: isinstance(x, Tensor))
+    return any(isinstance(t, Tensor) and not t.stop_gradient for t in leaves)
+
+
+def symbolic_translate(fn: Optional[Callable] = None, *, train=None,
+                       build_strategy=None):
+    """paddle.jit.sot.symbolic_translate parity: wrap ``fn`` in the
+    two-tier capture machinery. Usable as decorator or call."""
+    if fn is None:
+        return lambda f: symbolic_translate(f)
+
+    from paddle_tpu.jit.api import to_static
+    from paddle_tpu.jit.sot.bytecode import BytecodeUnsupported, CapturedFrame
+
+    frame = _Frame(fn)
+
+    def dispatch(*args, **kwargs):
+        if frame.fallback:
+            return fn(*args, **kwargs)
+        key = frame.guard_key(args, kwargs)
+
+        # tier 1: bytecode executor (inference frames; autograd frames go
+        # to the function tier where to_static owns the grad story)
+        if not frame.bytecode_declined and not _autograd_live(args, kwargs):
+            if frame.captured is None:
+                frame.captured = CapturedFrame(fn)
+            try:
+                return frame.captured(key, args, kwargs)
+            except BytecodeUnsupported:
+                frame.bytecode_declined = True  # fall through
+
+        # tier 2: whole-frame guarded capture
+        compiled = frame.specializations.get(key)
+        if compiled is None:
+            # full_graph=True: trace failures must surface HERE so the
+            # frame's permanent-fallback bookkeeping engages (full_graph=
+            # False would swallow them inside StaticFunction per call,
+            # re-paying the trace cost every time)
+            compiled = to_static(fn, full_graph=True)
+            frame.specializations[key] = compiled
+        try:
+            return compiled(*args, **kwargs)
+        except _graph_break_types():
+            # graph break: this frame resists tracing — permanent eager
+            frame.fallback = True
+            frame.breaks += 1
+            frame.specializations.pop(key, None)
+            return fn(*args, **kwargs)
+
+    dispatch.__name__ = getattr(fn, "__name__", "sot_fn")
+    dispatch.__wrapped__ = fn
+    dispatch._sot_frame = frame  # introspection for tests/debugging
+    return dispatch
+
+
+def sot_stats(wrapped) -> dict:
+    f: _Frame = wrapped._sot_frame
+    cap = f.captured
+    return {
+        "specializations": len(f.specializations) + (
+            len(cap.chain) if cap is not None else 0),
+        "fallback": f.fallback, "breaks": f.breaks,
+        "bytecode": cap is not None and not f.bytecode_declined,
+        "bytecode_breaks": cap.total_breaks if cap is not None else 0,
+        "regions_compiled": cap.regions_compiled if cap is not None else 0,
+        "interpreted_calls": cap.interpreted_calls if cap is not None else 0,
+    }

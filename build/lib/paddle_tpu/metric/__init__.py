@@ -1,0 +1,194 @@
+"""paddle.metric parity (reference: python/paddle/metric/metrics.py —
+Metric base, Accuracy, Precision, Recall, Auc).
+
+Metrics accumulate in host numpy (they sit outside the jitted step, exactly
+like the reference keeps them out of the CUDA graph)."""
+
+from __future__ import annotations
+
+import abc
+
+import numpy as np
+
+
+def _np(x):
+    if hasattr(x, "numpy"):
+        return np.asarray(x.numpy())
+    return np.asarray(x)
+
+
+class Metric(abc.ABC):
+    """Base class (metrics.py Metric)."""
+
+    def __init__(self):
+        pass
+
+    @abc.abstractmethod
+    def reset(self):
+        ...
+
+    @abc.abstractmethod
+    def update(self, *args):
+        ...
+
+    @abc.abstractmethod
+    def accumulate(self):
+        ...
+
+    @abc.abstractmethod
+    def name(self):
+        ...
+
+    def compute(self, *args):
+        """Optional pre-processing hook run on step outputs (may return
+        tensors; results feed update())."""
+        return args
+
+
+class Accuracy(Metric):
+    """Top-k accuracy (metrics.py Accuracy)."""
+
+    def __init__(self, topk=(1,), name=None):
+        super().__init__()
+        self.topk = (topk,) if isinstance(topk, int) else tuple(topk)
+        self.maxk = max(self.topk)
+        self._name = name or "acc"
+        self.reset()
+
+    def compute(self, pred, label, *args):
+        pred_np = _np(pred)
+        label_np = _np(label)
+        if label_np.ndim == pred_np.ndim and label_np.shape[-1] > 1:
+            label_np = np.argmax(label_np, axis=-1)
+        elif label_np.ndim == pred_np.ndim:
+            label_np = label_np.squeeze(-1)
+        order = np.argsort(-pred_np, axis=-1)[..., : self.maxk]
+        correct = order == label_np[..., None]
+        return correct
+
+    def update(self, correct, *args):
+        correct = _np(correct)
+        num_samples = int(np.prod(correct.shape[:-1])) or 1
+        accs = []
+        for k in self.topk:
+            c = correct[..., :k].any(axis=-1).sum()
+            accs.append(float(c) / num_samples)
+            self.total[self.topk.index(k)] += float(c)
+        self.count += num_samples
+        return accs[0] if len(accs) == 1 else accs
+
+    def reset(self):
+        self.total = [0.0] * len(self.topk)
+        self.count = 0
+
+    def accumulate(self):
+        res = [t / max(self.count, 1) for t in self.total]
+        return res[0] if len(res) == 1 else res
+
+    def name(self):
+        if len(self.topk) == 1:
+            return [self._name]
+        return [f"{self._name}_top{k}" for k in self.topk]
+
+
+class Precision(Metric):
+    """Binary precision (metrics.py Precision)."""
+
+    def __init__(self, name="precision"):
+        super().__init__()
+        self._name = name
+        self.reset()
+
+    def update(self, preds, labels):
+        preds = _np(preds).reshape(-1)
+        labels = _np(labels).reshape(-1)
+        pred_pos = np.round(preds).astype(np.int64) == 1
+        self.tp += int(np.sum(pred_pos & (labels == 1)))
+        self.fp += int(np.sum(pred_pos & (labels == 0)))
+
+    def reset(self):
+        self.tp = 0
+        self.fp = 0
+
+    def accumulate(self):
+        denom = self.tp + self.fp
+        return self.tp / denom if denom else 0.0
+
+    def name(self):
+        return self._name
+
+
+class Recall(Metric):
+    """Binary recall (metrics.py Recall)."""
+
+    def __init__(self, name="recall"):
+        super().__init__()
+        self._name = name
+        self.reset()
+
+    def update(self, preds, labels):
+        preds = _np(preds).reshape(-1)
+        labels = _np(labels).reshape(-1)
+        pred_pos = np.round(preds).astype(np.int64) == 1
+        self.tp += int(np.sum(pred_pos & (labels == 1)))
+        self.fn += int(np.sum(~pred_pos & (labels == 1)))
+
+    def reset(self):
+        self.tp = 0
+        self.fn = 0
+
+    def accumulate(self):
+        denom = self.tp + self.fn
+        return self.tp / denom if denom else 0.0
+
+    def name(self):
+        return self._name
+
+
+class Auc(Metric):
+    """ROC AUC via threshold buckets (metrics.py Auc)."""
+
+    def __init__(self, curve="ROC", num_thresholds=4095, name="auc"):
+        super().__init__()
+        self.num_thresholds = num_thresholds
+        self._name = name
+        self.reset()
+
+    def update(self, preds, labels):
+        preds = _np(preds)
+        labels = _np(labels).reshape(-1)
+        if preds.ndim == 2 and preds.shape[1] == 2:
+            preds = preds[:, 1]
+        preds = preds.reshape(-1)
+        idx = np.clip(
+            (preds * self.num_thresholds).astype(np.int64), 0, self.num_thresholds
+        )
+        n = self.num_thresholds + 1
+        pos = labels != 0
+        self._stat_pos += np.bincount(idx[pos], minlength=n)
+        self._stat_neg += np.bincount(idx[~pos], minlength=n)
+
+    def reset(self):
+        self._stat_pos = np.zeros(self.num_thresholds + 1, np.int64)
+        self._stat_neg = np.zeros(self.num_thresholds + 1, np.int64)
+
+    def accumulate(self):
+        tot_pos = float(self._stat_pos.sum())
+        tot_neg = float(self._stat_neg.sum())
+        if tot_pos == 0 or tot_neg == 0:
+            return 0.0
+        # walk thresholds high->low accumulating TPR/FPR trapezoids
+        area = 0.0
+        pos = neg = 0.0
+        prev_tpr = prev_fpr = 0.0
+        for i in range(self.num_thresholds, -1, -1):
+            pos += self._stat_pos[i]
+            neg += self._stat_neg[i]
+            tpr = pos / tot_pos
+            fpr = neg / tot_neg
+            area += (fpr - prev_fpr) * (tpr + prev_tpr) / 2.0
+            prev_tpr, prev_fpr = tpr, fpr
+        return area
+
+    def name(self):
+        return self._name

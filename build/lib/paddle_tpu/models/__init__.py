@@ -1,0 +1,54 @@
+"""Model zoo. The reference keeps its NLP flagship models in PaddleNLP (GPT-3,
+LLaMA — the Fleet hybrid-parallel configs cited in BASELINE.md) and vision
+models in-repo (python/paddle/vision/models). Here the NLP flagships live
+in-tree because they are the benchmark/bring-up vehicles for the hybrid
+parallel stack (SURVEY §3.5, §6)."""
+
+from paddle_tpu.models.gpt import (  # noqa: F401
+    GPTConfig,
+    GPTModel,
+    GPTForCausalLM,
+    GPTPretrainingCriterion,
+    gpt_tiny,
+    gpt3_1p3b,
+)
+from paddle_tpu.models.llama import (  # noqa: F401
+    LlamaConfig,
+    LlamaForCausalLM,
+    LlamaModel,
+    LlamaPretrainingCriterion,
+    llama_tiny,
+    llama2_7b,
+    llama2_13b,
+)
+from paddle_tpu.models.bert import (  # noqa: F401
+    BertConfig,
+    BertForPretraining,
+    BertForSequenceClassification,
+    BertModel,
+    bert_base,
+    bert_large,
+    bert_tiny,
+)
+from paddle_tpu.models.ernie import (  # noqa: F401
+    ErnieConfig,
+    ErnieForMaskedLM,
+    ErnieForSequenceClassification,
+    ErnieModel,
+    ernie_base,
+    ernie_tiny,
+)
+from paddle_tpu.models.kv_cache import (  # noqa: F401
+    BlockAllocator,
+    PagedCacheSlot,
+    StaticCacheSlot,
+    make_static_cache,
+)
+from paddle_tpu.models.serving import DecodeEngine  # noqa: F401
+from paddle_tpu.models.vit import (  # noqa: F401
+    ViTConfig,
+    VisionTransformer,
+    vit_base_patch16_224,
+    vit_large_patch16_224,
+    vit_tiny,
+)

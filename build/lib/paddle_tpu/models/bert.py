@@ -1,0 +1,193 @@
+"""BERT / ERNIE-style encoder LM (BASELINE.md #3 fine-tune vehicle; the
+reference's fixture is the PaddleNLP BERT-base stack over
+python/paddle/nn/layer/transformer.py encoder layers).
+
+TP-aware through the same fleet mp layers as GPT; pooler + MLM/NSP and
+sequence-classification heads included for the fine-tune path."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+from paddle_tpu.models.gpt import _seq_constrain
+from paddle_tpu.nn import initializer as I
+from paddle_tpu.nn.param_attr import ParamAttr
+from paddle_tpu.ops.pallas.flash_attention import scaled_dot_product_attention
+
+
+@dataclass
+class BertConfig:
+    vocab_size: int = 30522
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    intermediate_size: int = 3072
+    max_position_embeddings: int = 512
+    type_vocab_size: int = 2
+    hidden_dropout: float = 0.1
+    attention_dropout: float = 0.1
+    layer_norm_eps: float = 1e-12
+    initializer_range: float = 0.02
+    sequence_parallel: bool = False
+
+    # _seq_constrain compatibility
+    @property
+    def use_ring_attention(self):
+        return False
+
+
+def bert_tiny(**kw) -> BertConfig:
+    cfg = dict(vocab_size=1024, hidden_size=128, num_layers=2, num_heads=4,
+               intermediate_size=352, max_position_embeddings=128,
+               hidden_dropout=0.0, attention_dropout=0.0)
+    cfg.update(kw)
+    return BertConfig(**cfg)
+
+
+def bert_base(**kw) -> BertConfig:
+    return BertConfig(**kw)
+
+
+def bert_large(**kw) -> BertConfig:
+    cfg = dict(hidden_size=1024, num_layers=24, num_heads=16,
+               intermediate_size=4096)
+    cfg.update(kw)
+    return BertConfig(**cfg)
+
+
+class BertEmbeddings(nn.Layer):
+    def __init__(self, cfg: BertConfig):
+        super().__init__()
+        init = ParamAttr(initializer=I.Normal(0.0, cfg.initializer_range))
+        self.word_embeddings = nn.Embedding(cfg.vocab_size, cfg.hidden_size,
+                                            weight_attr=init)
+        self.position_embeddings = nn.Embedding(
+            cfg.max_position_embeddings, cfg.hidden_size, weight_attr=init)
+        self.token_type_embeddings = nn.Embedding(
+            cfg.type_vocab_size, cfg.hidden_size, weight_attr=init)
+        self.layer_norm = nn.LayerNorm(cfg.hidden_size,
+                                       epsilon=cfg.layer_norm_eps)
+        self.dropout = nn.Dropout(cfg.hidden_dropout)
+        self._cfg = cfg
+
+    def forward(self, input_ids, token_type_ids=None, position_ids=None,
+                extra_embedding=None):
+        seq_len = input_ids.shape[-1]
+        if seq_len > self._cfg.max_position_embeddings:
+            raise ValueError(
+                f"sequence length {seq_len} exceeds max_position_embeddings "
+                f"{self._cfg.max_position_embeddings}")
+        if position_ids is None:
+            position_ids = paddle.arange(0, seq_len, dtype="int32")
+        h = self.word_embeddings(input_ids) + \
+            self.position_embeddings(position_ids)
+        if token_type_ids is not None:
+            h = h + self.token_type_embeddings(token_type_ids)
+        if extra_embedding is not None:
+            # ERNIE-style additional input embedding (task type etc.)
+            h = h + extra_embedding
+        return self.dropout(self.layer_norm(h))
+
+
+class BertSelfAttention(nn.Layer):
+    def __init__(self, cfg: BertConfig):
+        super().__init__()
+        self.num_heads = cfg.num_heads
+        self.head_dim = cfg.hidden_size // cfg.num_heads
+        self.qkv = nn.Linear(cfg.hidden_size, 3 * cfg.hidden_size)
+        self.out = nn.Linear(cfg.hidden_size, cfg.hidden_size)
+        self.dropout_p = cfg.attention_dropout
+
+    def forward(self, x, attn_mask=None):
+        b, s, h = x.shape
+        qkv = paddle.reshape(self.qkv(x), [b, s, self.num_heads,
+                                           3 * self.head_dim])
+        q, k, v = paddle.split(qkv, 3, axis=-1)
+        out = scaled_dot_product_attention(
+            q, k, v, attn_mask=attn_mask, dropout_p=self.dropout_p,
+            is_causal=False, training=self.training)
+        return self.out(paddle.reshape(out, [b, s, h]))
+
+
+class BertLayer(nn.Layer):
+    """Post-norm encoder block (BERT convention)."""
+
+    def __init__(self, cfg: BertConfig):
+        super().__init__()
+        self.attention = BertSelfAttention(cfg)
+        self.attn_norm = nn.LayerNorm(cfg.hidden_size,
+                                      epsilon=cfg.layer_norm_eps)
+        self.fc1 = nn.Linear(cfg.hidden_size, cfg.intermediate_size)
+        self.fc2 = nn.Linear(cfg.intermediate_size, cfg.hidden_size)
+        self.ffn_norm = nn.LayerNorm(cfg.hidden_size,
+                                     epsilon=cfg.layer_norm_eps)
+        self.dropout = nn.Dropout(cfg.hidden_dropout)
+        self._cfg = cfg
+
+    def forward(self, x, attn_mask=None):
+        x = self.attn_norm(x + self.dropout(self.attention(x, attn_mask)))
+        ffn = self.fc2(F.gelu(self.fc1(x)))
+        x = self.ffn_norm(x + self.dropout(ffn))
+        return _seq_constrain(x, self._cfg)
+
+
+class BertModel(nn.Layer):
+    def __init__(self, cfg: BertConfig):
+        super().__init__()
+        self.config = cfg
+        self.embeddings = BertEmbeddings(cfg)
+        self.encoder = nn.LayerList([BertLayer(cfg)
+                                     for _ in range(cfg.num_layers)])
+        self.pooler = nn.Linear(cfg.hidden_size, cfg.hidden_size)
+
+    def forward(self, input_ids, token_type_ids=None, position_ids=None,
+                attention_mask=None, extra_embedding=None):
+        if attention_mask is not None:
+            # [B, S] 1/0 -> additive [B, 1, 1, S]
+            m = paddle.unsqueeze(attention_mask.astype("float32"), [1, 2])
+            attention_mask = (m - 1.0) * 1e4
+        h = self.embeddings(input_ids, token_type_ids, position_ids,
+                            extra_embedding)
+        for layer in self.encoder:
+            h = layer(h, attention_mask)
+        pooled = paddle.tanh(self.pooler(h[:, 0]))
+        return h, pooled
+
+
+class BertForSequenceClassification(nn.Layer):
+    def __init__(self, cfg: BertConfig, num_classes=2):
+        super().__init__()
+        self.bert = BertModel(cfg)
+        self.dropout = nn.Dropout(cfg.hidden_dropout)
+        self.classifier = nn.Linear(cfg.hidden_size, num_classes)
+
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None):
+        _, pooled = self.bert(input_ids, token_type_ids,
+                              attention_mask=attention_mask)
+        return self.classifier(self.dropout(pooled))
+
+
+class BertForPretraining(nn.Layer):
+    """MLM (tied decoder) + NSP heads."""
+
+    def __init__(self, cfg: BertConfig):
+        super().__init__()
+        self.bert = BertModel(cfg)
+        self.transform = nn.Linear(cfg.hidden_size, cfg.hidden_size)
+        self.transform_norm = nn.LayerNorm(cfg.hidden_size,
+                                           epsilon=cfg.layer_norm_eps)
+        self.nsp = nn.Linear(cfg.hidden_size, 2)
+        self.mlm_bias = self.create_parameter(
+            shape=[cfg.vocab_size], is_bias=True)
+
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None):
+        h, pooled = self.bert(input_ids, token_type_ids,
+                              attention_mask=attention_mask)
+        t = self.transform_norm(F.gelu(self.transform(h)))
+        w = self.bert.embeddings.word_embeddings.weight
+        mlm_logits = paddle.matmul(t, w, transpose_y=True) + self.mlm_bias
+        nsp_logits = self.nsp(pooled)
+        return mlm_logits, nsp_logits

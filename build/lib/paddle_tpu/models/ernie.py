@@ -1,0 +1,102 @@
+"""ERNIE model family (BASELINE.md benchmark vehicle #3; reference keeps
+ERNIE in PaddleNLP — architecture is BERT-style transformer encoder with an
+extra task-type embedding, ERNIE-2.0/3.0 continual-pretraining heads).
+
+TPU-native: built on the BertModel encoder stack (models/bert.py — flash
+attention, sep-axis sequence parallel) with ERNIE's task embedding added to
+the input sum.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.models.bert import (
+    BertConfig,
+    BertModel,
+)
+from paddle_tpu.nn import initializer as I
+from paddle_tpu.nn.param_attr import ParamAttr
+
+
+@dataclass
+class ErnieConfig(BertConfig):
+    task_type_vocab_size: int = 3
+    use_task_id: bool = True
+
+
+def ernie_tiny(**kw) -> ErnieConfig:
+    cfg = dict(vocab_size=1024, hidden_size=128, num_layers=2, num_heads=4,
+               intermediate_size=352, max_position_embeddings=128,
+               hidden_dropout=0.0, attention_dropout=0.0)
+    cfg.update(kw)
+    return ErnieConfig(**cfg)
+
+
+def ernie_base(**kw) -> ErnieConfig:
+    """ERNIE-3.0-base shape (PaddleNLP ernie-3.0-base-zh)."""
+    cfg = dict(vocab_size=40000, hidden_size=768, num_layers=12,
+               num_heads=12, intermediate_size=3072,
+               max_position_embeddings=2048)
+    cfg.update(kw)
+    return ErnieConfig(**cfg)
+
+
+class ErnieModel(nn.Layer):
+    """BERT encoder + task-type embedding (ERNIE's input representation)."""
+
+    def __init__(self, cfg: ErnieConfig):
+        super().__init__()
+        self.bert = BertModel(cfg)
+        self.config = cfg
+        if cfg.use_task_id:
+            init = ParamAttr(initializer=I.Normal(0.0, cfg.initializer_range))
+            self.task_type_embeddings = nn.Embedding(
+                cfg.task_type_vocab_size, cfg.hidden_size, weight_attr=init)
+
+    def forward(self, input_ids, token_type_ids=None, position_ids=None,
+                attention_mask=None, task_type_ids=None):
+        extra = None
+        if self.config.use_task_id:
+            if task_type_ids is None:
+                task_type_ids = paddle.zeros_like(input_ids)
+            extra = self.task_type_embeddings(task_type_ids)
+        return self.bert(input_ids, token_type_ids, position_ids,
+                         attention_mask, extra_embedding=extra)
+
+
+class ErnieForSequenceClassification(nn.Layer):
+    def __init__(self, cfg: ErnieConfig, num_classes: int = 2):
+        super().__init__()
+        self.ernie = ErnieModel(cfg)
+        self.dropout = nn.Dropout(cfg.hidden_dropout)
+        self.classifier = nn.Linear(cfg.hidden_size, num_classes)
+
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None,
+                task_type_ids=None):
+        out = self.ernie(input_ids, token_type_ids,
+                         attention_mask=attention_mask,
+                         task_type_ids=task_type_ids)
+        pooled = out[1] if isinstance(out, tuple) else out[:, 0]
+        return self.classifier(self.dropout(pooled))
+
+
+class ErnieForMaskedLM(nn.Layer):
+    def __init__(self, cfg: ErnieConfig):
+        super().__init__()
+        self.ernie = ErnieModel(cfg)
+        self.transform = nn.Linear(cfg.hidden_size, cfg.hidden_size)
+        self.layer_norm = nn.LayerNorm(cfg.hidden_size,
+                                       epsilon=cfg.layer_norm_eps)
+        self.decoder = nn.Linear(cfg.hidden_size, cfg.vocab_size)
+
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None,
+                task_type_ids=None):
+        out = self.ernie(input_ids, token_type_ids,
+                         attention_mask=attention_mask,
+                         task_type_ids=task_type_ids)
+        h = out[0] if isinstance(out, tuple) else out
+        h = self.layer_norm(nn.functional.gelu(self.transform(h)))
+        return self.decoder(h)

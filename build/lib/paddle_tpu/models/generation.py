@@ -1,0 +1,92 @@
+"""Incremental decoding with KV cache (capability parity: the reference's
+decoder-serving fused ops — masked_multihead_attention / block_multihead
+_attention in incubate/nn/functional — re-expressed as cached attention +
+a sampling loop; SURVEY §2.6 'decoder-serving included').
+
+Greedy / temperature / top-k sampling. The prefill step processes the whole
+prompt once and fills the per-layer KV caches; each decode step then runs a
+single-token forward against the cached keys/values."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.tensor import Tensor
+
+
+def _sample_next(logits_np: np.ndarray, temperature: float, top_k: int,
+                 rand) -> np.ndarray:
+    """logits [B, V] -> next ids [B]."""
+    if temperature <= 0.0:
+        return logits_np.argmax(-1)
+    logits = logits_np / max(temperature, 1e-6)
+    if top_k and top_k > 0:
+        top_k = min(top_k, logits.shape[-1])
+        kth = np.partition(logits, -top_k, axis=-1)[:, -top_k][:, None]
+        logits = np.where(logits < kth, -np.inf, logits)
+    logits = logits - logits.max(-1, keepdims=True)
+    probs = np.exp(logits)
+    probs /= probs.sum(-1, keepdims=True)
+    return np.array([rand.choice(probs.shape[-1], p=p) for p in probs])
+
+
+def greedy_or_sample(model, input_ids, num_layers: int,
+                     max_new_tokens: int = 32, temperature: float = 1.0,
+                     top_k: int = 0, eos_token_id: Optional[int] = None,
+                     seed: Optional[int] = None):
+    """Generate tokens autoregressively. ``model(input_ids, position_ids,
+    caches)`` must return (logits, new_caches) when caches is given.
+
+    temperature<=0 means greedy decoding. Returns [B, prompt+new] ids."""
+    was_training = model.training
+    model.eval()
+    rand = np.random.default_rng(seed)
+    try:
+        ids_np = np.asarray(input_ids.numpy()
+                            if isinstance(input_ids, Tensor) else input_ids)
+        if ids_np.ndim == 1:
+            ids_np = ids_np[None, :]
+        B, prompt_len = ids_np.shape
+        if max_new_tokens <= 0:
+            return paddle.to_tensor(ids_np.astype(np.int64))
+        max_pos = getattr(model.config, "max_position_embeddings", None)
+        if max_pos is not None and prompt_len + max_new_tokens > max_pos:
+            raise ValueError(
+                f"prompt ({prompt_len}) + max_new_tokens ({max_new_tokens}) "
+                f"exceeds max_position_embeddings ({max_pos})")
+
+        with paddle.no_grad():
+            # prefill: whole prompt, empty caches
+            caches = [(None, None)] * num_layers
+            logits, caches = model(
+                paddle.to_tensor(ids_np.astype(np.int32)), None, caches)
+            next_np = _sample_next(
+                np.asarray(logits.numpy())[:, -1].astype(np.float64),
+                temperature, top_k, rand)
+            out = [ids_np, next_np[:, None]]
+            finished = np.zeros(B, dtype=bool)
+            if eos_token_id is not None:
+                finished |= next_np == eos_token_id
+
+            for step in range(1, max_new_tokens):
+                if finished.all():
+                    break
+                pos = prompt_len + step - 1
+                tok = paddle.to_tensor(out[-1].astype(np.int32))
+                logits, caches = model(
+                    tok, paddle.to_tensor(np.array([pos], np.int32)), caches)
+                next_np = _sample_next(
+                    np.asarray(logits.numpy())[:, -1].astype(np.float64),
+                    temperature, top_k, rand)
+                if eos_token_id is not None:
+                    next_np = np.where(finished, eos_token_id, next_np)
+                    finished |= next_np == eos_token_id
+                out.append(next_np[:, None])
+        return paddle.to_tensor(
+            np.concatenate(out, axis=1).astype(np.int64))
+    finally:
+        if was_training:
+            model.train()

@@ -1,0 +1,199 @@
+"""Static-shape and paged KV caches for incremental decoding.
+
+Capability parity with the reference's serving attention kernels —
+masked_multihead_attention (dense static cache, one query token against a
+preallocated prefix buffer) and block_multihead_attention (paged KV pool
+addressed through block tables), phi/kernels/fusion/gpu/ and
+python/paddle/incubate/nn/functional/ — re-designed TPU-first:
+
+- Caches are preallocated to a static max length so every decode step is the
+  SAME XLA program (no shape-driven recompiles); writes are per-batch
+  ``lax.dynamic_update_slice`` and validity comes from a length mask.
+- The paged variant keeps K/V in a block pool indexed by per-sequence block
+  tables (vLLM-style), enabling continuous batching without moving memory;
+  gathers ride XLA's fused gather, not pointer chasing.
+"""
+
+from __future__ import annotations
+
+import math
+from collections import namedtuple
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.dispatch import apply
+from paddle_tpu.tensor import Tensor
+
+# k, v: [B, max_len, KVH, D]; pos: [B] int32 — number of tokens already cached
+StaticCacheSlot = namedtuple("StaticCacheSlot", ["k", "v", "pos"])
+
+# k_pool, v_pool: [num_blocks, block_size, KVH, D]; block_table: [B, max_blocks]
+# int32 (block ids, -1 = unallocated); pos: [B] int32
+PagedCacheSlot = namedtuple("PagedCacheSlot", ["k_pool", "v_pool",
+                                               "block_table", "pos"])
+
+_NEG = -1e30
+
+
+def _repeat_kv(x, n_heads):
+    """GQA: repeat KV heads up to the query head count."""
+    kvh = x.shape[2]
+    if kvh == n_heads:
+        return x
+    return jnp.repeat(x, n_heads // kvh, axis=2)
+
+
+def _masked_attention(q, keys, values, pos):
+    """q [B,s,H,D] against keys/values [B,L,H,D] valid where
+    k_idx <= pos[b] + q_idx (causal over the static buffer)."""
+    B, s, H, D = q.shape
+    L = keys.shape[1]
+    scores = jnp.einsum("bshd,blhd->bhsl", q.astype(jnp.float32),
+                        keys.astype(jnp.float32)) / math.sqrt(D)
+    k_idx = jnp.arange(L)[None, None, None, :]
+    q_idx = jnp.arange(s)[None, None, :, None]
+    mask = k_idx <= (pos[:, None, None, None] + q_idx)
+    scores = jnp.where(mask, scores, _NEG)
+    attn = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhsl,blhd->bshd", attn, values.astype(q.dtype))
+
+
+def _static_cache_raw(qv, kv, vv, ck, cv, pos):
+    """Write new K/V at per-batch offsets, then length-masked attention."""
+    n_heads = qv.shape[2]
+
+    def write(c, new):
+        def w1(cb, nb, p):
+            return jax.lax.dynamic_update_slice(
+                cb, nb.astype(cb.dtype), (p, 0, 0))
+        return jax.vmap(w1)(c, new, pos)
+
+    ck2 = write(ck, kv)
+    cv2 = write(cv, vv)
+    out = _masked_attention(qv, _repeat_kv(ck2, n_heads),
+                            _repeat_kv(cv2, n_heads), pos)
+    return out, ck2, cv2, pos + qv.shape[1]
+
+
+def static_cache_update_attend(q, k, v, slot: StaticCacheSlot):
+    """Cache-write + attend for one forward chunk (prefill or decode step).
+
+    q [B,s,H,D]; k/v [B,s,KVH,D] (already RoPE-rotated where applicable);
+    returns (out [B,s,H,D], new slot). The masked_multihead_attention
+    analogue over a dense static cache."""
+    out, ck2, cv2, pos2 = apply(
+        "static_cache_attention", _static_cache_raw, q, k, v,
+        slot.k, slot.v, slot.pos)
+    return out, StaticCacheSlot(ck2, cv2, pos2)
+
+
+def _paged_cache_raw(qv, kv, vv, k_pool, v_pool, block_table, pos):
+    """Paged write + gather + masked attention (decode: s small, usually 1)."""
+    B, s, n_heads, D = qv.shape
+    block_size = k_pool.shape[1]
+    max_blocks = block_table.shape[1]
+    L = max_blocks * block_size
+
+    # scatter the s new tokens of each sequence into their pages
+    def write(pool, new):
+        # token t of batch b lands in pool[block_table[b, (pos[b]+t)//bs],
+        #                                  (pos[b]+t)%bs]
+        tok_pos = pos[:, None] + jnp.arange(s)[None, :]          # [B, s]
+        blk_slot = tok_pos // block_size
+        blk = jnp.take_along_axis(block_table,
+                                  jnp.clip(blk_slot, 0, max_blocks - 1),
+                                  axis=1)                        # [B, s]
+        off = tok_pos % block_size                               # [B, s]
+        flat = pool.reshape(-1, *pool.shape[2:])                 # [NB*bs, H, D]
+        idx = (blk * block_size + off).reshape(-1)               # [B*s]
+        # unallocated (-1) or out-of-table positions must NOT wrap into
+        # another sequence's block: route them out of bounds and drop
+        valid = ((blk >= 0) & (blk_slot < max_blocks)).reshape(-1)
+        idx = jnp.where(valid, idx, flat.shape[0])
+        return flat.at[idx].set(
+            new.reshape(-1, *new.shape[2:]).astype(pool.dtype),
+            mode="drop",
+        ).reshape(pool.shape)
+
+    k_pool2 = write(k_pool, kv)
+    v_pool2 = write(v_pool, vv)
+
+    # gather this sequence's pages into a contiguous [B, L, KVH, D] view
+    def gather(pool):
+        safe = jnp.maximum(block_table, 0)                       # [B, MB]
+        pages = pool[safe]                                       # [B, MB, bs, H, D]
+        return pages.reshape(B, L, *pool.shape[2:])
+
+    keys = gather(k_pool2)
+    values = gather(v_pool2)
+    out = _masked_attention(qv, _repeat_kv(keys, n_heads),
+                            _repeat_kv(values, n_heads), pos)
+    return out, k_pool2, v_pool2, pos + s
+
+
+def paged_cache_update_attend(q, k, v, slot: PagedCacheSlot):
+    """block_multihead_attention analogue: write into the block pool through
+    the block table, then attend over the gathered pages."""
+    out, kp2, vp2, pos2 = apply(
+        "paged_cache_attention", _paged_cache_raw, q, k, v,
+        slot.k_pool, slot.v_pool, slot.block_table, slot.pos)
+    return out, PagedCacheSlot(kp2, vp2, slot.block_table, pos2)
+
+
+def cache_update_attend(q, k, v, slot):
+    """Dispatch on cache-slot type (shared by every model's serving branch)."""
+    if isinstance(slot, StaticCacheSlot):
+        return static_cache_update_attend(q, k, v, slot)
+    if isinstance(slot, PagedCacheSlot):
+        return paged_cache_update_attend(q, k, v, slot)
+    raise TypeError(f"not a cache slot: {type(slot)!r}")
+
+
+def make_static_cache(num_layers: int, batch: int, max_len: int,
+                      kv_heads: int, head_dim: int,
+                      dtype="bfloat16") -> List[StaticCacheSlot]:
+    """Preallocate dense decode caches (one slot per layer)."""
+    import paddle_tpu as paddle
+
+    slots = []
+    for _ in range(num_layers):
+        k = paddle.zeros([batch, max_len, kv_heads, head_dim], dtype=dtype)
+        v = paddle.zeros([batch, max_len, kv_heads, head_dim], dtype=dtype)
+        pos = paddle.zeros([batch], dtype="int32")
+        slots.append(StaticCacheSlot(k, v, pos))
+    return slots
+
+
+class BlockAllocator:
+    """Host-side free-list allocator for KV pool blocks (the vLLM block
+    manager role). Pure bookkeeping — device state is only the block table."""
+
+    def __init__(self, num_blocks: int, block_size: int):
+        self.block_size = block_size
+        self.num_blocks = num_blocks
+        self._free = list(range(num_blocks - 1, -1, -1))
+
+    def num_free(self) -> int:
+        return len(self._free)
+
+    def allocate(self, n_tokens: int) -> List[int]:
+        need = (n_tokens + self.block_size - 1) // self.block_size
+        if need > len(self._free):
+            raise RuntimeError(
+                f"KV pool exhausted: need {need} blocks, {len(self._free)} free")
+        return [self._free.pop() for _ in range(need)]
+
+    def extend(self, blocks: List[int], cur_tokens: int, add_tokens: int):
+        """Grow a sequence's block list to cover add_tokens more tokens."""
+        have = len(blocks) * self.block_size
+        while cur_tokens + add_tokens > have:
+            if not self._free:
+                raise RuntimeError("KV pool exhausted on extend")
+            blocks.append(self._free.pop())
+            have += self.block_size
+        return blocks
+
+    def free(self, blocks: List[int]):
+        self._free.extend(blocks)

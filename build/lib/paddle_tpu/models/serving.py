@@ -1,0 +1,224 @@
+"""Batched serving engine: jit-compiled incremental decoding.
+
+Capability parity with the reference's decoder-serving stack (fused
+masked/block multi-head attention ops + FusedMultiTransformer serving layers,
+python/paddle/incubate/nn/layer/fused_transformer.py:994,
+phi/kernels/fusion/gpu/) — re-designed TPU-first:
+
+- KV caches are preallocated static-shape buffers (dense, or a paged block
+  pool with block tables), so prefill compiles once per length bucket and
+  EVERY decode step is one cached XLA program — zero recompiles in the
+  serving loop.
+- Sampling (greedy / temperature / top-k) happens in-graph on device; the
+  host loop only feeds back token ids.
+- Per-sequence lengths are device-side vectors: one engine step serves a
+  ragged batch (right-padded prompts, different completion lengths).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.core.dispatch import apply
+from paddle_tpu.framework import random as rng
+from paddle_tpu.jit.api import StaticFunction
+from paddle_tpu.models.kv_cache import (
+    BlockAllocator,
+    PagedCacheSlot,
+    StaticCacheSlot,
+    make_static_cache,
+)
+from paddle_tpu.tensor import Tensor
+
+
+def _bucket(n: int, lo: int = 16) -> int:
+    b = lo
+    while b < n:
+        b *= 2
+    return b
+
+
+class DecodeEngine:
+    """Continuous-decode engine over a causal LM.
+
+    ``model(input_ids, position_ids, caches)`` must return
+    ``(logits, new_caches)`` when caches are given (GPTForCausalLM /
+    LlamaForCausalLM contract). Sampling config is fixed at construction
+    (it is baked into the compiled step).
+    """
+
+    def __init__(self, model, max_seq_len: int = 512,
+                 temperature: float = 0.0, top_k: int = 0,
+                 use_paged: bool = False, block_size: int = 16,
+                 num_blocks: Optional[int] = None,
+                 cache_dtype: str = "float32"):
+        cfg = model.config
+        self.model = model
+        self.num_layers = cfg.num_layers
+        self.num_kv_heads = getattr(cfg, "num_key_value_heads", None) or cfg.num_heads
+        self.head_dim = cfg.hidden_size // cfg.num_heads
+        self.max_seq_len = min(max_seq_len,
+                               getattr(cfg, "max_position_embeddings", max_seq_len))
+        self.temperature = float(temperature)
+        self.top_k = int(top_k)
+        self.use_paged = use_paged
+        self.block_size = block_size
+        self.num_blocks = num_blocks
+        self.cache_dtype = cache_dtype
+        # donate_args: the decode loop threads cache buffers through the
+        # compiled step and never reuses an input array after the call, so
+        # the KV caches update in place (no 2x cache residency)
+        self._sf = StaticFunction(self._forward_sample, layer=model,
+                                  donate_args=True)
+
+    # ---- compiled step -------------------------------------------------
+
+    def _forward_sample(self, ids, position_ids, caches, gather_idx):
+        """One model chunk (prefill or single decode token) + in-graph
+        sampling of the next id at each sequence's last valid logit row."""
+        logits, new_caches = self.model(ids, position_ids, caches)
+        temp, k = self.temperature, self.top_k
+        key = rng.next_key() if temp > 0 else None
+
+        def pick(lv, gi):
+            last = jnp.take_along_axis(
+                lv, gi[:, None, None].astype(jnp.int32),
+                axis=1)[:, 0, :]  # [B, V]
+            l = last.astype(jnp.float32)
+            if temp <= 0:
+                return jnp.argmax(l, axis=-1).astype(jnp.int32)
+            l = l / max(temp, 1e-6)
+            if k and k > 0:
+                kk = min(k, l.shape[-1])
+                kth = jax.lax.top_k(l, kk)[0][..., -1:]
+                l = jnp.where(l < kth, -jnp.inf, l)
+            return jax.random.categorical(key, l, axis=-1).astype(jnp.int32)
+
+        next_ids = apply("sample_next", pick, logits, gather_idx,
+                         differentiable=False)
+        return next_ids, new_caches
+
+    # ---- cache construction -------------------------------------------
+
+    def _dense_caches(self, batch: int) -> List[StaticCacheSlot]:
+        return make_static_cache(self.num_layers, batch, self.max_seq_len,
+                                 self.num_kv_heads, self.head_dim,
+                                 self.cache_dtype)
+
+    def _paged_caches(self, batch: int, tokens_per_seq: int):
+        n_blocks = self.num_blocks
+        if n_blocks is None:
+            per_seq = -(-tokens_per_seq // self.block_size)
+            n_blocks = batch * per_seq
+        alloc = BlockAllocator(n_blocks, self.block_size)
+        per_seq_blocks = [alloc.allocate(tokens_per_seq) for _ in range(batch)]
+        max_blocks = max(len(b) for b in per_seq_blocks)
+        table = np.full((batch, max_blocks), -1, np.int32)
+        for i, blks in enumerate(per_seq_blocks):
+            table[i, :len(blks)] = blks
+        slots = []
+        for _ in range(self.num_layers):
+            kp = paddle.zeros([n_blocks, self.block_size, self.num_kv_heads,
+                               self.head_dim], dtype=self.cache_dtype)
+            vp = paddle.zeros([n_blocks, self.block_size, self.num_kv_heads,
+                               self.head_dim], dtype=self.cache_dtype)
+            # per-layer copies: cache args are donated to the compiled step,
+            # and a buffer must not appear twice in a donated pytree
+            slots.append(PagedCacheSlot(kp, vp, paddle.to_tensor(table),
+                                        paddle.zeros([batch], dtype="int32")))
+        return slots, alloc, per_seq_blocks
+
+    # ---- serving loop --------------------------------------------------
+
+    def generate(self, input_ids, seq_lens=None, max_new_tokens: int = 32,
+                 eos_token_id: Optional[int] = None) -> List[np.ndarray]:
+        """Batch generation. ``input_ids``: [B, P] right-padded prompt ids
+        (ndarray or Tensor); ``seq_lens``: [B] true prompt lengths (defaults
+        to full width). Returns a list of B 1-D arrays (prompt + completion,
+        trimmed at EOS)."""
+        was_training = self.model.training
+        self.model.eval()
+        try:
+            ids_np = np.asarray(input_ids.numpy()
+                                if isinstance(input_ids, Tensor) else input_ids)
+            if ids_np.ndim == 1:
+                ids_np = ids_np[None, :]
+            B, P = ids_np.shape
+            lens = (np.full(B, P, np.int32) if seq_lens is None
+                    else np.asarray(seq_lens, np.int32))
+            if P > self.max_seq_len:
+                raise ValueError(
+                    f"prompt width ({P}) exceeds max_seq_len "
+                    f"({self.max_seq_len})")
+            total = int(lens.max()) + max_new_tokens
+            if total > self.max_seq_len:
+                raise ValueError(
+                    f"prompt+new ({total}) exceeds max_seq_len "
+                    f"({self.max_seq_len})")
+
+            # pad prompts to a length bucket to bound prefill recompiles
+            Pb = min(_bucket(P), self.max_seq_len)
+            if Pb > P:
+                ids_np = np.pad(ids_np, ((0, 0), (0, Pb - P)))
+
+            if self.use_paged:
+                caches, alloc, blocks = self._paged_caches(
+                    B, max(Pb, total))
+            else:
+                caches = self._dense_caches(B)
+
+            with paddle.no_grad():
+                ids = paddle.to_tensor(ids_np.astype(np.int32))
+                pos_ids = paddle.to_tensor(np.arange(Pb, dtype=np.int32))
+                gather = paddle.to_tensor(lens - 1)
+                next_ids, caches = self._sf(ids, pos_ids, caches, gather)
+                # prefill advanced pos by the padded width; the true valid
+                # length is the prompt length (pad rows are masked out).
+                # Per-layer pos copies: donated pytrees must not repeat a
+                # buffer.
+                caches = [c._replace(pos=paddle.to_tensor(lens))
+                          for c in caches]
+
+                out_tokens = [np.asarray(next_ids.numpy())]
+                finished = np.zeros(B, dtype=bool)
+                if eos_token_id is not None:
+                    finished |= out_tokens[0] == eos_token_id
+                cur_lens = lens.copy()
+
+                for _ in range(1, max_new_tokens):
+                    if finished.all():
+                        break
+                    tok = paddle.reshape(next_ids, [B, 1])
+                    # per-batch absolute positions for RoPE / pos-embedding
+                    p = paddle.reshape(paddle.to_tensor(cur_lens), [B, 1])
+                    # fresh every step: args are donated to the compiled call
+                    zero_gather = paddle.to_tensor(np.zeros(B, np.int32))
+                    next_ids, caches = self._sf(tok, p, caches, zero_gather)
+                    cur_lens += 1
+                    step_np = np.asarray(next_ids.numpy())
+                    if eos_token_id is not None:
+                        step_np = np.where(finished, eos_token_id, step_np)
+                        finished |= step_np == eos_token_id
+                    out_tokens.append(step_np)
+
+            gen = np.stack(out_tokens, axis=1)  # [B, T]
+            results = []
+            for i in range(B):
+                seq = np.concatenate([ids_np[i, :lens[i]], gen[i]])
+                if eos_token_id is not None:
+                    hits = np.where(gen[i] == eos_token_id)[0]
+                    if hits.size:
+                        seq = seq[:lens[i] + hits[0] + 1]
+                results.append(seq.astype(np.int64))
+            if self.use_paged:
+                for blks in blocks:
+                    alloc.free(blks)
+            return results
+        finally:
+            if was_training:
+                self.model.train()

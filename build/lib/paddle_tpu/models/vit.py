@@ -1,0 +1,115 @@
+"""Vision Transformer (BASELINE.md #2 ViT-base vehicle; reference ships ViT
+in PaddleClas over the same nn.TransformerEncoder stack).
+
+Patch embedding is one conv (stride = patch size) — exactly the shape the
+MXU wants; encoder reuses the BERT-style pre-norm block."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+from paddle_tpu.nn import initializer as I
+from paddle_tpu.nn.param_attr import ParamAttr
+from paddle_tpu.ops.pallas.flash_attention import scaled_dot_product_attention
+
+
+@dataclass
+class ViTConfig:
+    image_size: int = 224
+    patch_size: int = 16
+    in_channels: int = 3
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    mlp_ratio: float = 4.0
+    num_classes: int = 1000
+    dropout: float = 0.0
+    layer_norm_eps: float = 1e-6
+
+    @property
+    def num_patches(self):
+        return (self.image_size // self.patch_size) ** 2
+
+
+def vit_tiny(**kw) -> ViTConfig:
+    cfg = dict(image_size=32, patch_size=8, hidden_size=64, num_layers=2,
+               num_heads=4, num_classes=10)
+    cfg.update(kw)
+    return ViTConfig(**cfg)
+
+
+def vit_base_patch16_224(**kw) -> ViTConfig:
+    return ViTConfig(**kw)
+
+
+def vit_large_patch16_224(**kw) -> ViTConfig:
+    cfg = dict(hidden_size=1024, num_layers=24, num_heads=16)
+    cfg.update(kw)
+    return ViTConfig(**cfg)
+
+
+class ViTBlock(nn.Layer):
+    """Pre-norm transformer block."""
+
+    def __init__(self, cfg: ViTConfig):
+        super().__init__()
+        self.norm1 = nn.LayerNorm(cfg.hidden_size, epsilon=cfg.layer_norm_eps)
+        self.num_heads = cfg.num_heads
+        self.head_dim = cfg.hidden_size // cfg.num_heads
+        self.qkv = nn.Linear(cfg.hidden_size, 3 * cfg.hidden_size)
+        self.proj = nn.Linear(cfg.hidden_size, cfg.hidden_size)
+        self.norm2 = nn.LayerNorm(cfg.hidden_size, epsilon=cfg.layer_norm_eps)
+        mlp_dim = int(cfg.hidden_size * cfg.mlp_ratio)
+        self.fc1 = nn.Linear(cfg.hidden_size, mlp_dim)
+        self.fc2 = nn.Linear(mlp_dim, cfg.hidden_size)
+        self.dropout = nn.Dropout(cfg.dropout)
+
+    def forward(self, x):
+        b, s, h = x.shape
+        y = self.norm1(x)
+        qkv = paddle.reshape(self.qkv(y), [b, s, self.num_heads,
+                                           3 * self.head_dim])
+        q, k, v = paddle.split(qkv, 3, axis=-1)
+        attn = scaled_dot_product_attention(q, k, v, is_causal=False,
+                                            training=self.training)
+        x = x + self.dropout(self.proj(paddle.reshape(attn, [b, s, h])))
+        x = x + self.dropout(self.fc2(F.gelu(self.fc1(self.norm2(x)))))
+        return x
+
+
+class VisionTransformer(nn.Layer):
+    def __init__(self, cfg: ViTConfig):
+        super().__init__()
+        self.config = cfg
+        self.patch_embed = nn.Conv2D(
+            cfg.in_channels, cfg.hidden_size, cfg.patch_size,
+            stride=cfg.patch_size)
+        init = ParamAttr(initializer=I.Normal(0.0, 0.02))
+        self.cls_token = self.create_parameter(
+            shape=[1, 1, cfg.hidden_size], attr=init)
+        self.pos_embed = self.create_parameter(
+            shape=[1, cfg.num_patches + 1, cfg.hidden_size], attr=init)
+        self.blocks = nn.LayerList([ViTBlock(cfg)
+                                    for _ in range(cfg.num_layers)])
+        self.norm = nn.LayerNorm(cfg.hidden_size, epsilon=cfg.layer_norm_eps)
+        if cfg.num_classes > 0:
+            self.head = nn.Linear(cfg.hidden_size, cfg.num_classes)
+
+    def forward(self, x):
+        b = x.shape[0]
+        p = self.patch_embed(x)  # [B, H, gh, gw]
+        p = paddle.transpose(paddle.flatten(p, 2), [0, 2, 1])  # [B, N, H]
+        cls = paddle.expand(self.cls_token, [b, 1, self.config.hidden_size])
+        h = paddle.concat([cls, p], axis=1) + self.pos_embed
+        for blk in self.blocks:
+            h = blk(h)
+        h = self.norm(h)
+        if self.config.num_classes > 0:
+            return self.head(h[:, 0])
+        return h
+
+
+ViT = VisionTransformer

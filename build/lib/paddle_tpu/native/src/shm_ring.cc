@@ -1,0 +1,199 @@
+// Shared-memory ring buffer for DataLoader batch transport (capability
+// parity: paddle/fluid/memory/allocation/mmap_allocator.cc — the reference
+// moves worker-process batches through shared memory instead of pickling
+// over pipes; this is the TPU build's native equivalent, used by
+// io.DataLoader's multiprocess mode).
+//
+// Layout in the shm segment:
+//   [u64 head][u64 tail][u64 capacity][u64 closed][data bytes ...]
+// Single-producer/single-consumer per ring (the loader opens one ring per
+// worker). Records are length-prefixed (u64). Futex-free: readers/writers
+// spin with short sleeps — batch cadence (ms) makes this cheap and portable.
+
+#include <fcntl.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <cerrno>
+#include <cstdint>
+#include <cstring>
+#include <ctime>
+#include <cstdio>
+
+namespace {
+
+struct Header {
+  std::atomic<uint64_t> head;   // next write offset (mod capacity)
+  std::atomic<uint64_t> tail;   // next read offset (mod capacity)
+  std::atomic<uint64_t> capacity;
+  std::atomic<uint64_t> closed;
+};
+
+struct Ring {
+  Header* hdr;
+  uint8_t* data;
+  size_t map_len;
+  int fd;
+  bool owner;
+  char name[256];
+};
+
+void nap() {
+  timespec ts{0, 200000};  // 200us
+  nanosleep(&ts, nullptr);
+}
+
+uint64_t used(const Header* h) {
+  return h->head.load(std::memory_order_acquire) -
+         h->tail.load(std::memory_order_acquire);
+}
+
+}  // namespace
+
+extern "C" {
+
+// Create (owner=1) or attach (owner=0) a ring of `capacity` data bytes.
+void* shm_ring_open(const char* name, uint64_t capacity, int owner) {
+  int flags = owner ? (O_CREAT | O_EXCL | O_RDWR) : O_RDWR;
+  int fd = shm_open(name, flags, 0600);
+  if (fd < 0 && owner && errno == EEXIST) {
+    shm_unlink(name);
+    fd = shm_open(name, flags, 0600);
+  }
+  if (fd < 0) return nullptr;
+  size_t map_len = sizeof(Header) + capacity;
+  if (owner && ftruncate(fd, static_cast<off_t>(map_len)) != 0) {
+    ::close(fd);
+    shm_unlink(name);
+    return nullptr;
+  }
+  if (!owner) {
+    struct stat st{};
+    if (fstat(fd, &st) != 0 || static_cast<size_t>(st.st_size) < sizeof(Header)) {
+      ::close(fd);
+      return nullptr;
+    }
+    map_len = static_cast<size_t>(st.st_size);
+  }
+  void* mem = mmap(nullptr, map_len, PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
+  if (mem == MAP_FAILED) {
+    ::close(fd);
+    if (owner) shm_unlink(name);
+    return nullptr;
+  }
+  auto* r = new Ring();
+  r->hdr = static_cast<Header*>(mem);
+  r->data = static_cast<uint8_t*>(mem) + sizeof(Header);
+  r->map_len = map_len;
+  r->fd = fd;
+  r->owner = owner != 0;
+  std::snprintf(r->name, sizeof(r->name), "%s", name);
+  if (owner) {
+    r->hdr->head.store(0);
+    r->hdr->tail.store(0);
+    r->hdr->capacity.store(capacity);
+    r->hdr->closed.store(0);
+  }
+  return r;
+}
+
+void shm_ring_close(void* handle) {
+  auto* r = static_cast<Ring*>(handle);
+  r->hdr->closed.store(1, std::memory_order_release);
+  munmap(r->hdr, r->map_len);
+  ::close(r->fd);
+  if (r->owner) shm_unlink(r->name);
+  delete r;
+}
+
+void shm_ring_mark_closed(void* handle) {
+  static_cast<Ring*>(handle)->hdr->closed.store(1, std::memory_order_release);
+}
+
+// Blocking push of one length-prefixed record. Returns 0, or -1 if closed.
+int shm_ring_push(void* handle, const uint8_t* buf, uint64_t len) {
+  auto* r = static_cast<Ring*>(handle);
+  Header* h = r->hdr;
+  uint64_t cap = h->capacity.load(std::memory_order_relaxed);
+  uint64_t need = len + 8;
+  if (need > cap) return -2;  // record larger than ring
+  while (cap - used(h) < need) {
+    if (h->closed.load(std::memory_order_acquire)) return -1;
+    nap();
+  }
+  uint64_t head = h->head.load(std::memory_order_relaxed);
+  auto put = [&](const void* src, uint64_t n) {
+    uint64_t off = head % cap;
+    uint64_t first = n < cap - off ? n : cap - off;
+    std::memcpy(r->data + off, src, first);
+    if (n > first)
+      std::memcpy(r->data, static_cast<const uint8_t*>(src) + first, n - first);
+    head += n;
+  };
+  put(&len, 8);
+  put(buf, len);
+  h->head.store(head, std::memory_order_release);
+  return 0;
+}
+
+// Returns next record length (waits for one), -1 if closed+empty.
+int64_t shm_ring_peek(void* handle) {
+  auto* r = static_cast<Ring*>(handle);
+  Header* h = r->hdr;
+  uint64_t cap = h->capacity.load(std::memory_order_relaxed);
+  while (used(h) < 8) {
+    if (h->closed.load(std::memory_order_acquire) && used(h) == 0) return -1;
+    nap();
+  }
+  uint64_t tail = h->tail.load(std::memory_order_relaxed);
+  uint64_t len;
+  uint64_t off = tail % cap;
+  uint64_t first = 8 < cap - off ? 8 : cap - off;
+  std::memcpy(&len, r->data + off, first);
+  if (first < 8)
+    std::memcpy(reinterpret_cast<uint8_t*>(&len) + first, r->data, 8 - first);
+  return static_cast<int64_t>(len);
+}
+
+// Non-blocking peek: record length, -1 closed+empty, -3 empty.
+int64_t shm_ring_try_peek(void* handle) {
+  auto* r = static_cast<Ring*>(handle);
+  Header* h = r->hdr;
+  if (used(h) < 8) {
+    if (h->closed.load(std::memory_order_acquire) && used(h) == 0) return -1;
+    return -3;
+  }
+  return shm_ring_peek(handle);
+}
+
+// Pop one record into out (cap bytes). Returns record length or -1.
+int64_t shm_ring_pop(void* handle, uint8_t* out, uint64_t out_cap) {
+  int64_t len64 = shm_ring_peek(handle);
+  if (len64 < 0) return len64;
+  auto* r = static_cast<Ring*>(handle);
+  Header* h = r->hdr;
+  uint64_t cap = h->capacity.load(std::memory_order_relaxed);
+  uint64_t len = static_cast<uint64_t>(len64);
+  while (used(h) < 8 + len) {
+    if (h->closed.load(std::memory_order_acquire)) return -1;
+    nap();
+  }
+  uint64_t tail = h->tail.load(std::memory_order_relaxed) + 8;
+  auto take = [&](void* dst, uint64_t n) {
+    uint64_t off = tail % cap;
+    uint64_t first = n < cap - off ? n : cap - off;
+    std::memcpy(dst, r->data + off, first);
+    if (n > first)
+      std::memcpy(static_cast<uint8_t*>(dst) + first, r->data, n - first);
+    tail += n;
+  };
+  uint64_t n = len < out_cap ? len : out_cap;
+  take(out, n);
+  tail += len - n;  // skip any tail we couldn't fit
+  static_cast<Ring*>(handle)->hdr->tail.store(tail, std::memory_order_release);
+  return static_cast<int64_t>(len);
+}
+
+}  // extern "C"

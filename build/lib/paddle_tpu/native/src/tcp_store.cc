@@ -1,0 +1,332 @@
+// TCPStore: rendezvous key-value store (capability parity:
+// paddle/phi/core/distributed/store/tcp_store.h:121 TCPStore + tcp_utils).
+//
+// The reference bootstraps NCCL communicators through a rank-0-hosted TCP
+// store (set/get/add/wait). On TPU pods jax.distributed plays that role for
+// the runtime itself, but the framework still exposes the store API for user
+// code, launchers and elastic coordination — implemented here natively, one
+// epoll-free thread per connection (bootstrap traffic is tiny), exported via
+// a C ABI consumed with ctypes (no pybind11 in this image).
+//
+// Protocol: 1-byte op, then length-prefixed fields (u32 little-endian).
+//   op 1 SET   key, value          -> u8 ack
+//   op 2 GET   key                 -> u32 len + bytes (blocks until present)
+//   op 3 ADD   key, i64 delta      -> i64 new value
+//   op 4 WAIT  key                 -> u8 ack when present
+//   op 5 CHECK key                 -> u8 present?1:0
+//   op 6 DELETE key                -> u8 existed?1:0
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <map>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace {
+
+struct Store {
+  std::map<std::string, std::vector<uint8_t>> data;
+  std::mutex mu;
+  std::condition_variable cv;
+  int listen_fd = -1;
+  std::thread accept_thread;
+  bool stopping = false;
+  // connection bookkeeping so stop() can wake + join every handler before
+  // the Store is freed (no use-after-free on shutdown); finished slots are
+  // reaped by the accept loop so transient clients don't leak fds/threads
+  std::mutex conn_mu;
+  std::vector<std::thread> conn_threads;
+  std::vector<int> conn_fds;        // -1 = handler finished, fd closed
+  std::vector<bool> conn_done;
+};
+
+bool read_all(int fd, void* buf, size_t n) {
+  auto* p = static_cast<uint8_t*>(buf);
+  while (n > 0) {
+    ssize_t r = ::read(fd, p, n);
+    if (r <= 0) return false;
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+bool write_all(int fd, const void* buf, size_t n) {
+  const auto* p = static_cast<const uint8_t*>(buf);
+  while (n > 0) {
+    ssize_t r = ::write(fd, p, n);
+    if (r <= 0) return false;
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+bool read_field(int fd, std::vector<uint8_t>* out) {
+  uint32_t len;
+  if (!read_all(fd, &len, 4)) return false;
+  out->resize(len);
+  return len == 0 || read_all(fd, out->data(), len);
+}
+
+bool write_field(int fd, const void* buf, uint32_t len) {
+  if (!write_all(fd, &len, 4)) return false;
+  return len == 0 || write_all(fd, buf, len);
+}
+
+void serve_conn(Store* s, int fd, size_t slot) {
+  int one = 1;
+  setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  for (;;) {
+    uint8_t op;
+    if (!read_all(fd, &op, 1)) break;
+    std::vector<uint8_t> key;
+    if (!read_field(fd, &key)) break;
+    std::string k(key.begin(), key.end());
+    if (op == 1) {  // SET
+      std::vector<uint8_t> val;
+      if (!read_field(fd, &val)) break;
+      {
+        std::lock_guard<std::mutex> g(s->mu);
+        s->data[k] = std::move(val);
+      }
+      s->cv.notify_all();
+      uint8_t ack = 1;
+      if (!write_all(fd, &ack, 1)) break;
+    } else if (op == 2 || op == 4) {  // GET / WAIT (blocking)
+      std::unique_lock<std::mutex> g(s->mu);
+      s->cv.wait(g, [&] { return s->stopping || s->data.count(k) > 0; });
+      if (s->stopping) break;
+      if (op == 2) {
+        auto& v = s->data[k];
+        if (!write_field(fd, v.data(), static_cast<uint32_t>(v.size()))) break;
+      } else {
+        g.unlock();
+        uint8_t ack = 1;
+        if (!write_all(fd, &ack, 1)) break;
+      }
+    } else if (op == 3) {  // ADD
+      int64_t delta;
+      if (!read_all(fd, &delta, 8)) break;
+      int64_t result;
+      {
+        std::lock_guard<std::mutex> g(s->mu);
+        int64_t cur = 0;
+        auto it = s->data.find(k);
+        if (it != s->data.end() && it->second.size() == 8) {
+          std::memcpy(&cur, it->second.data(), 8);
+        }
+        result = cur + delta;
+        std::vector<uint8_t> v(8);
+        std::memcpy(v.data(), &result, 8);
+        s->data[k] = std::move(v);
+      }
+      s->cv.notify_all();
+      if (!write_all(fd, &result, 8)) break;
+    } else if (op == 5) {  // CHECK
+      uint8_t present;
+      {
+        std::lock_guard<std::mutex> g(s->mu);
+        present = s->data.count(k) ? 1 : 0;
+      }
+      if (!write_all(fd, &present, 1)) break;
+    } else if (op == 6) {  // DELETE
+      uint8_t existed;
+      {
+        std::lock_guard<std::mutex> g(s->mu);
+        existed = s->data.erase(k) ? 1 : 0;
+      }
+      s->cv.notify_all();
+      if (!write_all(fd, &existed, 1)) break;
+    } else {
+      break;
+    }
+  }
+  // close the fd under conn_mu (stop() takes the same lock before its
+  // shutdown() sweep, so it never touches a reused descriptor number) and
+  // mark the slot so the accept loop reaps this thread
+  std::lock_guard<std::mutex> g(s->conn_mu);
+  ::close(fd);
+  s->conn_fds[slot] = -1;
+  s->conn_done[slot] = true;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Returns an opaque server handle, or null on failure. Binds 0.0.0.0:port
+// (port 0 = ephemeral; use tcpstore_server_port to discover).
+void* tcpstore_server_start(int port) {
+  int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) return nullptr;
+  int one = 1;
+  setsockopt(fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = htonl(INADDR_ANY);
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  if (::bind(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) != 0 ||
+      ::listen(fd, 128) != 0) {
+    ::close(fd);
+    return nullptr;
+  }
+  auto* s = new Store();
+  s->listen_fd = fd;
+  s->accept_thread = std::thread([s] {
+    for (;;) {
+      int cfd = ::accept(s->listen_fd, nullptr, nullptr);
+      if (cfd < 0) break;  // listen socket closed -> shutdown
+      std::lock_guard<std::mutex> g(s->conn_mu);
+      if (s->stopping) {
+        ::close(cfd);
+        break;
+      }
+      // reuse a finished handler's slot (joining its thread) so long-lived
+      // servers don't grow per transient client
+      size_t slot = s->conn_fds.size();
+      for (size_t i = 0; i < s->conn_done.size(); ++i) {
+        if (s->conn_done[i]) {
+          if (s->conn_threads[i].joinable()) s->conn_threads[i].join();
+          slot = i;
+          break;
+        }
+      }
+      if (slot == s->conn_fds.size()) {
+        s->conn_fds.push_back(cfd);
+        s->conn_done.push_back(false);
+        s->conn_threads.emplace_back(serve_conn, s, cfd, slot);
+      } else {
+        s->conn_fds[slot] = cfd;
+        s->conn_done[slot] = false;
+        s->conn_threads[slot] = std::thread(serve_conn, s, cfd, slot);
+      }
+    }
+  });
+  return s;
+}
+
+int tcpstore_server_port(void* handle) {
+  auto* s = static_cast<Store*>(handle);
+  sockaddr_in addr{};
+  socklen_t len = sizeof(addr);
+  if (getsockname(s->listen_fd, reinterpret_cast<sockaddr*>(&addr), &len) != 0)
+    return -1;
+  return ntohs(addr.sin_port);
+}
+
+void tcpstore_server_stop(void* handle) {
+  auto* s = static_cast<Store*>(handle);
+  {
+    std::lock_guard<std::mutex> g(s->mu);
+    s->stopping = true;
+  }
+  s->cv.notify_all();
+  ::shutdown(s->listen_fd, SHUT_RDWR);
+  ::close(s->listen_fd);
+  if (s->accept_thread.joinable()) s->accept_thread.join();
+  {
+    // wake handlers blocked in read() and join them all before freeing
+    std::lock_guard<std::mutex> g(s->conn_mu);
+    s->stopping = true;
+    for (int fd : s->conn_fds)
+      if (fd >= 0) ::shutdown(fd, SHUT_RDWR);
+  }
+  for (auto& t : s->conn_threads)
+    if (t.joinable()) t.join();
+  for (int fd : s->conn_fds)
+    if (fd >= 0) ::close(fd);
+  delete s;
+}
+
+// ---- client ----
+
+int tcpstore_connect(const char* host, int port, int timeout_ms) {
+  int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) return -1;
+  timeval tv{timeout_ms / 1000, (timeout_ms % 1000) * 1000};
+  setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  if (inet_pton(AF_INET, host, &addr.sin_addr) != 1) {
+    ::close(fd);
+    return -1;
+  }
+  if (::connect(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) != 0) {
+    ::close(fd);
+    return -1;
+  }
+  int one = 1;
+  setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  return fd;
+}
+
+void tcpstore_close(int fd) { ::close(fd); }
+
+int tcpstore_set(int fd, const char* key, const uint8_t* val, uint32_t len) {
+  uint8_t op = 1;
+  if (!write_all(fd, &op, 1)) return -1;
+  if (!write_field(fd, key, static_cast<uint32_t>(strlen(key)))) return -1;
+  if (!write_field(fd, val, len)) return -1;
+  uint8_t ack;
+  return read_all(fd, &ack, 1) ? 0 : -1;
+}
+
+// Returns value length (>=0) or -1; writes at most cap bytes into out.
+int64_t tcpstore_get(int fd, const char* key, uint8_t* out, uint32_t cap) {
+  uint8_t op = 2;
+  if (!write_all(fd, &op, 1)) return -1;
+  if (!write_field(fd, key, static_cast<uint32_t>(strlen(key)))) return -1;
+  uint32_t len;
+  if (!read_all(fd, &len, 4)) return -1;
+  std::vector<uint8_t> buf(len);
+  if (len > 0 && !read_all(fd, buf.data(), len)) return -1;
+  uint32_t n = len < cap ? len : cap;
+  if (n > 0) std::memcpy(out, buf.data(), n);
+  return static_cast<int64_t>(len);
+}
+
+int64_t tcpstore_add(int fd, const char* key, int64_t delta) {
+  uint8_t op = 3;
+  if (!write_all(fd, &op, 1)) return INT64_MIN;
+  if (!write_field(fd, key, static_cast<uint32_t>(strlen(key)))) return INT64_MIN;
+  if (!write_all(fd, &delta, 8)) return INT64_MIN;
+  int64_t result;
+  return read_all(fd, &result, 8) ? result : INT64_MIN;
+}
+
+int tcpstore_wait(int fd, const char* key) {
+  uint8_t op = 4;
+  if (!write_all(fd, &op, 1)) return -1;
+  if (!write_field(fd, key, static_cast<uint32_t>(strlen(key)))) return -1;
+  uint8_t ack;
+  return read_all(fd, &ack, 1) ? 0 : -1;
+}
+
+int tcpstore_check(int fd, const char* key) {
+  uint8_t op = 5;
+  if (!write_all(fd, &op, 1)) return -1;
+  if (!write_field(fd, key, static_cast<uint32_t>(strlen(key)))) return -1;
+  uint8_t present;
+  return read_all(fd, &present, 1) ? present : -1;
+}
+
+int tcpstore_delete(int fd, const char* key) {
+  uint8_t op = 6;
+  if (!write_all(fd, &op, 1)) return -1;
+  if (!write_field(fd, key, static_cast<uint32_t>(strlen(key)))) return -1;
+  uint8_t existed;
+  return read_all(fd, &existed, 1) ? existed : -1;
+}
+
+}  // extern "C"

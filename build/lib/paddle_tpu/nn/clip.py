@@ -1,0 +1,74 @@
+"""Gradient clipping (parity: python/paddle/nn/clip.py)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+class ClipGradBase:
+    def _clip_arrays(self, grads):
+        raise NotImplementedError
+
+    def __call__(self, params_grads):
+        # static-graph style API parity
+        return params_grads
+
+
+class ClipGradByValue(ClipGradBase):
+    def __init__(self, max, min=None):
+        self.max = float(max)
+        self.min = float(min) if min is not None else -self.max
+
+    def _clip_arrays(self, grads):
+        return [jnp.clip(g, self.min, self.max) for g in grads]
+
+
+class ClipGradByNorm(ClipGradBase):
+    def __init__(self, clip_norm):
+        self.clip_norm = float(clip_norm)
+
+    def _clip_arrays(self, grads):
+        out = []
+        for g in grads:
+            norm = jnp.sqrt(jnp.sum(jnp.square(g.astype(jnp.float32))))
+            scale = jnp.minimum(1.0, self.clip_norm / jnp.maximum(norm, 1e-12))
+            out.append((g.astype(jnp.float32) * scale).astype(g.dtype))
+        return out
+
+
+class ClipGradByGlobalNorm(ClipGradBase):
+    def __init__(self, clip_norm, group_name="default_group", auto_skip_clip=False):
+        self.clip_norm = float(clip_norm)
+
+    def _clip_arrays(self, grads):
+        global_sq = sum(jnp.sum(jnp.square(g.astype(jnp.float32))) for g in grads)
+        global_norm = jnp.sqrt(global_sq)
+        scale = self.clip_norm / jnp.maximum(global_norm, self.clip_norm)
+        return [(g.astype(jnp.float32) * scale).astype(g.dtype) for g in grads]
+
+
+def clip_grad_norm_(parameters, max_norm, norm_type=2.0, error_if_nonfinite=False):
+    """torch-style utility also exposed by paddle.nn.utils."""
+    from paddle_tpu.tensor import Tensor
+
+    if isinstance(parameters, Tensor):
+        parameters = [parameters]
+    grads = [p._grad for p in parameters if p._grad is not None]
+    if not grads:
+        return Tensor(jnp.zeros(()))
+    if norm_type == float("inf"):
+        total = jnp.max(jnp.stack([jnp.max(jnp.abs(g)) for g in grads]))
+    else:
+        total = jnp.power(
+            sum(jnp.sum(jnp.power(jnp.abs(g.astype(jnp.float32)), norm_type))
+                for g in grads),
+            1.0 / norm_type,
+        )
+    scale = jnp.minimum(max_norm / (total + 1e-6), 1.0)
+    i = 0
+    for p in parameters:
+        if p._grad is not None:
+            p._grad = (p._grad.astype(jnp.float32) * scale).astype(p._grad.dtype)
+            i += 1
+    return Tensor._from_value(total)

@@ -1,0 +1,1276 @@
+"""nn.functional (parity: python/paddle/nn/functional/*).
+
+Convs/pools map to lax.conv_general_dilated / reduce_window — these lower
+straight onto the MXU/VPU; norms and activations are jnp compositions that XLA
+fuses into surrounding matmuls (replacing the reference's hand-fused CUDA
+kernels in phi/kernels/fusion/).
+"""
+
+from __future__ import annotations
+
+import math as _math
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.core.dispatch import apply
+from paddle_tpu.framework import random as rng
+from paddle_tpu.tensor import Tensor
+
+# --------------------------------------------------------------- activations
+
+
+def _unary(name, fn):
+    def op(x, name_arg=None, **kwargs):
+        return apply(name, lambda a: fn(a, **kwargs), x)
+
+    op.__name__ = name
+    return op
+
+
+relu = _unary("relu", jax.nn.relu)
+relu6 = _unary("relu6", jax.nn.relu6)
+selu = _unary(
+    "selu", lambda a, scale=1.0507009873554805, alpha=1.6732632423543772:
+    scale * jnp.where(a > 0, a, alpha * jnp.expm1(a))
+)
+silu = _unary("silu", jax.nn.silu)
+sigmoid = _unary("sigmoid", jax.nn.sigmoid)
+log_sigmoid = _unary("log_sigmoid", jax.nn.log_sigmoid)
+softsign = _unary("softsign", jax.nn.soft_sign)
+tanhshrink = _unary("tanhshrink", lambda a: a - jnp.tanh(a))
+tanh = _unary("tanh", jnp.tanh)
+mish = _unary("mish", lambda a: a * jnp.tanh(jax.nn.softplus(a)))
+hardswish = _unary("hardswish", lambda a: a * jnp.clip(a + 3, 0, 6) / 6)
+hardsigmoid = _unary("hardsigmoid", lambda a, slope=1 / 6, offset=0.5:
+                     jnp.clip(a * slope + offset, 0, 1))
+
+
+def swish(x, name=None):
+    return silu(x)
+
+
+def elu(x, alpha=1.0, name=None):
+    return apply("elu", lambda a: jnp.where(a > 0, a, alpha * jnp.expm1(a)), x)
+
+
+def celu(x, alpha=1.0, name=None):
+    return apply("celu", lambda a: jax.nn.celu(a, alpha=alpha), x)
+
+
+def leaky_relu(x, negative_slope=0.01, name=None):
+    return apply("leaky_relu", lambda a: jax.nn.leaky_relu(a, negative_slope), x)
+
+
+def prelu(x, weight, data_format="NCHW", name=None):
+    def f(a, w):
+        if w.size == 1:
+            return jnp.where(a > 0, a, w.reshape(()) * a)
+        shape = [1] * a.ndim
+        ch_axis = 1 if data_format[1] == "C" else a.ndim - 1
+        shape[ch_axis] = w.size
+        return jnp.where(a > 0, a, w.reshape(shape) * a)
+
+    return apply("prelu", f, x, weight)
+
+
+def rrelu(x, lower=1 / 8.0, upper=1 / 3.0, training=True, name=None):
+    if not training:
+        return apply("rrelu", lambda a: jnp.where(a > 0, a, (lower + upper) / 2 * a), x)
+
+    def f(a):
+        slope = jax.random.uniform(rng.next_key(), a.shape, jnp.float32, lower, upper)
+        return jnp.where(a > 0, a, slope.astype(a.dtype) * a)
+
+    return apply("rrelu", f, x)
+
+
+def gelu(x, approximate=False, name=None):
+    return apply("gelu", lambda a: jax.nn.gelu(a, approximate=approximate), x)
+
+
+def hardtanh(x, min=-1.0, max=1.0, name=None):
+    return apply("hardtanh", lambda a: jnp.clip(a, min, max), x)
+
+
+def hardshrink(x, threshold=0.5, name=None):
+    return apply(
+        "hardshrink", lambda a: jnp.where(jnp.abs(a) > threshold, a, 0.0).astype(a.dtype), x
+    )
+
+
+def softshrink(x, threshold=0.5, name=None):
+    return apply(
+        "softshrink",
+        lambda a: jnp.where(a > threshold, a - threshold,
+                            jnp.where(a < -threshold, a + threshold, 0.0)).astype(a.dtype),
+        x,
+    )
+
+
+def softplus(x, beta=1.0, threshold=20.0, name=None):
+    return apply(
+        "softplus",
+        lambda a: jnp.where(a * beta > threshold, a, jax.nn.softplus(a * beta) / beta),
+        x,
+    )
+
+
+def thresholded_relu(x, threshold=1.0, value=0.0, name=None):
+    return apply(
+        "thresholded_relu",
+        lambda a: jnp.where(a > threshold, a, jnp.asarray(value, a.dtype)),
+        x,
+    )
+
+
+def softmax(x, axis=-1, dtype=None, name=None):
+    def f(a):
+        if dtype is not None:
+            a = a.astype(dtype)
+        return jax.nn.softmax(a, axis=axis)
+
+    return apply("softmax", f, x)
+
+
+def log_softmax(x, axis=-1, dtype=None, name=None):
+    def f(a):
+        if dtype is not None:
+            a = a.astype(dtype)
+        return jax.nn.log_softmax(a, axis=axis)
+
+    return apply("log_softmax", f, x)
+
+
+def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1, name=None):
+    def f(a):
+        g = jax.random.gumbel(rng.next_key(), a.shape, jnp.float32).astype(a.dtype)
+        y = jax.nn.softmax((a + g) / temperature, axis=axis)
+        if hard:
+            idx = jnp.argmax(y, axis=axis, keepdims=True)
+            y_hard = jnp.zeros_like(y)
+            y_hard = jnp.put_along_axis(y_hard, idx, 1.0, axis=axis, inplace=False)
+            y = y_hard - jax.lax.stop_gradient(y) + y
+        return y
+
+    return apply("gumbel_softmax", f, x)
+
+
+def glu(x, axis=-1, name=None):
+    return apply("glu", lambda a: jax.nn.glu(a, axis=axis), x)
+
+
+def maxout(x, groups, axis=1, name=None):
+    def f(a):
+        ax = axis if axis >= 0 else a.ndim + axis
+        c = a.shape[ax]
+        new_shape = a.shape[:ax] + (c // groups, groups) + a.shape[ax + 1:]
+        return jnp.max(a.reshape(new_shape), axis=ax + 1)
+
+    return apply("maxout", f, x)
+
+
+# -------------------------------------------------------------------- linear
+
+
+def linear(x, weight, bias=None, name=None):
+    """paddle linear: weight is [in_features, out_features]."""
+    if bias is None:
+        return apply("linear", lambda a, w: jnp.matmul(a, w), x, weight)
+    return apply("linear", lambda a, w, b: jnp.matmul(a, w) + b, x, weight, bias)
+
+
+def embedding(x, weight, padding_idx=None, sparse=False, name=None, max_norm=None,
+              norm_type=2.0, scale_grad_by_freq=False):
+    def f(i, w):
+        out = jnp.take(w, i, axis=0)
+        if padding_idx is not None:
+            mask = (i == padding_idx)[..., None]
+            out = jnp.where(mask, 0.0, out)
+        return out
+
+    return apply("embedding", lambda ival, w: f(ival, w), x.detach(), weight)
+
+
+def one_hot(x, num_classes, name=None):
+    from paddle_tpu.ops import manipulation
+
+    return manipulation.one_hot(x, num_classes)
+
+
+def label_smooth(label, prior_dist=None, epsilon=0.1, name=None):
+    def f(l):
+        k = l.shape[-1]
+        if prior_dist is not None:
+            return (1 - epsilon) * l + epsilon * prior_dist._value
+        return (1 - epsilon) * l + epsilon / k
+
+    return apply("label_smooth", f, label)
+
+
+# ------------------------------------------------------------------- dropout
+
+
+def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train", name=None):
+    if not training or p == 0.0:
+        return x if isinstance(x, Tensor) else Tensor(x)
+
+    def f(a):
+        shape = list(a.shape)
+        if axis is not None:
+            axes = [axis] if isinstance(axis, int) else list(axis)
+            shape = [s if i in axes else 1 for i, s in enumerate(shape)]
+        keep = jax.random.bernoulli(rng.next_key(), 1.0 - p, tuple(shape))
+        if mode == "upscale_in_train":
+            return jnp.where(keep, a / (1.0 - p), 0.0).astype(a.dtype)
+        return jnp.where(keep, a, 0.0).astype(a.dtype)
+
+    return apply("dropout", f, x)
+
+
+def dropout2d(x, p=0.5, training=True, data_format="NCHW", name=None):
+    axis = [0, 1] if data_format == "NCHW" else [0, 3]
+    return dropout(x, p=p, axis=axis, training=training)
+
+
+def dropout3d(x, p=0.5, training=True, data_format="NCDHW", name=None):
+    axis = [0, 1] if data_format == "NCDHW" else [0, 4]
+    return dropout(x, p=p, axis=axis, training=training)
+
+
+def alpha_dropout(x, p=0.5, training=True, name=None):
+    if not training or p == 0.0:
+        return x
+    alpha = 1.6732632423543772
+    scale = 1.0507009873554805
+    alpha_p = -alpha * scale
+
+    def f(a):
+        keep = jax.random.bernoulli(rng.next_key(), 1.0 - p, a.shape)
+        q = 1.0 - p
+        a_coef = (q + alpha_p**2 * q * p) ** -0.5
+        b_coef = -a_coef * alpha_p * p
+        return (a_coef * jnp.where(keep, a, alpha_p) + b_coef).astype(a.dtype)
+
+    return apply("alpha_dropout", f, x)
+
+
+# ------------------------------------------------------------------- normalize
+
+
+def normalize(x, p=2, axis=1, epsilon=1e-12, name=None):
+    return apply(
+        "normalize",
+        lambda a: a / jnp.maximum(
+            jnp.power(jnp.sum(jnp.power(jnp.abs(a), p), axis=axis, keepdims=True), 1.0 / p),
+            epsilon,
+        ),
+        x,
+    )
+
+
+def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-05, name=None):
+    if isinstance(normalized_shape, int):
+        normalized_shape = [normalized_shape]
+    n_axes = len(normalized_shape)
+
+    def f(a, *wb):
+        axes = tuple(range(a.ndim - n_axes, a.ndim))
+        mean = jnp.mean(a, axis=axes, keepdims=True)
+        var = jnp.var(a, axis=axes, keepdims=True)
+        out = (a - mean) * jax.lax.rsqrt(var + epsilon)
+        i = 0
+        if weight is not None:
+            out = out * wb[i]
+            i += 1
+        if bias is not None:
+            out = out + wb[i]
+        return out
+
+    args = [t for t in (weight, bias) if t is not None]
+    return apply("layer_norm", f, x, *args)
+
+
+def rms_norm(x, weight=None, epsilon=1e-6, name=None):
+    """RMSNorm (reference: fused_rms_norm in incubate/nn/functional)."""
+
+    def f(a, *w):
+        var = jnp.mean(jnp.square(a.astype(jnp.float32)), axis=-1, keepdims=True)
+        out = (a.astype(jnp.float32) * jax.lax.rsqrt(var + epsilon)).astype(a.dtype)
+        if w:
+            out = out * w[0]
+        return out
+
+    args = [weight] if weight is not None else []
+    return apply("rms_norm", f, x, *args)
+
+
+def batch_norm(x, running_mean, running_var, weight=None, bias=None, training=False,
+               momentum=0.9, epsilon=1e-05, data_format="NCHW", use_global_stats=None,
+               name=None):
+    ch_axis = 1 if data_format.startswith("NC") else x.ndim - 1
+    reduce_axes = tuple(i for i in range(x.ndim) if i != ch_axis)
+    use_batch_stats = training and not use_global_stats
+
+    if use_batch_stats:
+        def f(a, *wb):
+            mean = jnp.mean(a, axis=reduce_axes)
+            var = jnp.var(a, axis=reduce_axes)
+            shape = [1] * a.ndim
+            shape[ch_axis] = a.shape[ch_axis]
+            out = (a - mean.reshape(shape)) * jax.lax.rsqrt(var.reshape(shape) + epsilon)
+            i = 0
+            if weight is not None:
+                out = out * wb[i].reshape(shape)
+                i += 1
+            if bias is not None:
+                out = out + wb[i].reshape(shape)
+            return out, mean, var
+
+        args = [t for t in (weight, bias) if t is not None]
+        out, batch_mean, batch_var = apply("batch_norm", f, x, *args)
+        # update running stats (dygraph mutation, mirrors reference semantics)
+        if running_mean is not None:
+            running_mean._replace_value(
+                momentum * running_mean._value + (1 - momentum) * batch_mean._value
+            )
+        if running_var is not None:
+            n = int(np.prod([x.shape[i] for i in reduce_axes]))
+            unbiased = batch_var._value * (n / max(n - 1, 1))
+            running_var._replace_value(
+                momentum * running_var._value + (1 - momentum) * unbiased
+            )
+        return out
+
+    def f_eval(a, m, v, *wb):
+        shape = [1] * a.ndim
+        shape[ch_axis] = a.shape[ch_axis]
+        out = (a - m.reshape(shape)) * jax.lax.rsqrt(v.reshape(shape) + epsilon)
+        i = 0
+        if weight is not None:
+            out = out * wb[i].reshape(shape)
+            i += 1
+        if bias is not None:
+            out = out + wb[i].reshape(shape)
+        return out
+
+    args = [t for t in (weight, bias) if t is not None]
+    return apply("batch_norm", f_eval, x, running_mean.detach(), running_var.detach(), *args)
+
+
+def instance_norm(x, running_mean=None, running_var=None, weight=None, bias=None,
+                  use_input_stats=True, momentum=0.9, eps=1e-05, data_format="NCHW",
+                  name=None):
+    ch_axis = 1 if data_format.startswith("NC") else x.ndim - 1
+    spatial_axes = tuple(i for i in range(2, x.ndim)) if ch_axis == 1 else \
+        tuple(i for i in range(1, x.ndim - 1))
+
+    def f(a, *wb):
+        mean = jnp.mean(a, axis=spatial_axes, keepdims=True)
+        var = jnp.var(a, axis=spatial_axes, keepdims=True)
+        out = (a - mean) * jax.lax.rsqrt(var + eps)
+        shape = [1] * a.ndim
+        shape[ch_axis] = a.shape[ch_axis]
+        i = 0
+        if weight is not None:
+            out = out * wb[i].reshape(shape)
+            i += 1
+        if bias is not None:
+            out = out + wb[i].reshape(shape)
+        return out
+
+    args = [t for t in (weight, bias) if t is not None]
+    return apply("instance_norm", f, x, *args)
+
+
+def group_norm(x, num_groups, epsilon=1e-05, weight=None, bias=None,
+               data_format="NCHW", name=None):
+    def f(a, *wb):
+        if data_format == "NCHW" or data_format.startswith("NC"):
+            n, c = a.shape[0], a.shape[1]
+            spatial = a.shape[2:]
+            g = a.reshape(n, num_groups, c // num_groups, *spatial)
+            axes = tuple(range(2, g.ndim))
+            mean = jnp.mean(g, axis=axes, keepdims=True)
+            var = jnp.var(g, axis=axes, keepdims=True)
+            out = ((g - mean) * jax.lax.rsqrt(var + epsilon)).reshape(a.shape)
+            shape = [1, c] + [1] * len(spatial)
+        else:
+            n, c = a.shape[0], a.shape[-1]
+            spatial = a.shape[1:-1]
+            g = a.reshape(n, *spatial, num_groups, c // num_groups)
+            axes = tuple(range(1, g.ndim - 2)) + (g.ndim - 1,)
+            mean = jnp.mean(g, axis=axes, keepdims=True)
+            var = jnp.var(g, axis=axes, keepdims=True)
+            out = ((g - mean) * jax.lax.rsqrt(var + epsilon)).reshape(a.shape)
+            shape = [1] * (a.ndim - 1) + [c]
+        i = 0
+        if weight is not None:
+            out = out * wb[i].reshape(shape)
+            i += 1
+        if bias is not None:
+            out = out + wb[i].reshape(shape)
+        return out
+
+    args = [t for t in (weight, bias) if t is not None]
+    return apply("group_norm", f, x, *args)
+
+
+def local_response_norm(x, size, alpha=1e-4, beta=0.75, k=1.0, data_format="NCHW",
+                        name=None):
+    def f(a):
+        ch_axis = 1 if data_format.startswith("NC") else a.ndim - 1
+        sq = jnp.square(a)
+        sq = jnp.moveaxis(sq, ch_axis, -1)
+        pad_lo = (size - 1) // 2
+        pad_hi = size - 1 - pad_lo
+        padded = jnp.pad(sq, [(0, 0)] * (sq.ndim - 1) + [(pad_lo, pad_hi)])
+        windows = jnp.stack(
+            [padded[..., i:i + sq.shape[-1]] for i in range(size)], axis=0
+        )
+        acc = jnp.sum(windows, axis=0)
+        acc = jnp.moveaxis(acc, -1, ch_axis)
+        return a / jnp.power(k + alpha * acc, beta)
+
+    return apply("local_response_norm", f, x)
+
+
+# ---------------------------------------------------------------------- conv
+
+
+def _pair(v, n):
+    if isinstance(v, (list, tuple)):
+        return tuple(v)
+    return (v,) * n
+
+
+def _conv_nd(x, weight, bias, stride, padding, dilation, groups, data_format, nd,
+             name="conv"):
+    strides = _pair(stride, nd)
+    dilations = _pair(dilation, nd)
+    if isinstance(padding, str):
+        pad = padding.upper()  # SAME / VALID
+    elif isinstance(padding, (list, tuple)) and len(padding) == nd and \
+            isinstance(padding[0], (list, tuple)):
+        pad = [tuple(p) for p in padding]
+    else:
+        p = _pair(padding, nd)
+        if len(p) == 2 * nd:
+            pad = [(p[2 * i], p[2 * i + 1]) for i in range(nd)]
+        else:
+            pad = [(pi, pi) for pi in p]
+
+    if data_format in ("NCHW", "NCL", "NCDHW"):
+        spatial = "DHW"[-nd:]
+        lhs_spec = "NC" + spatial
+        out_spec = "NC" + spatial
+    else:
+        spatial = "DHW"[-nd:]
+        lhs_spec = "N" + spatial + "C"
+        out_spec = "N" + spatial + "C"
+    rhs_spec = "OI" + spatial
+    dn = jax.lax.conv_dimension_numbers(
+        x._value.shape, weight._value.shape, (lhs_spec, rhs_spec, out_spec)
+    )
+
+    def f(a, w, *b):
+        out = jax.lax.conv_general_dilated(
+            a, w, window_strides=strides, padding=pad,
+            rhs_dilation=dilations, dimension_numbers=dn,
+            feature_group_count=groups,
+            preferred_element_type=jnp.float32 if a.dtype == jnp.float32 else None,
+        )
+        if b:
+            shape = [1] * out.ndim
+            ch_axis = 1 if out_spec.startswith("NC") else out.ndim - 1
+            shape[ch_axis] = b[0].size
+            out = out + b[0].reshape(shape)
+        return out.astype(a.dtype)
+
+    args = [bias] if bias is not None else []
+    return apply(name, f, x, weight, *args)
+
+
+def conv1d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCL", name=None):
+    return _conv_nd(x, weight, bias, stride, padding, dilation, groups, data_format, 1,
+                    "conv1d")
+
+
+def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCHW", name=None):
+    return _conv_nd(x, weight, bias, stride, padding, dilation, groups, data_format, 2,
+                    "conv2d")
+
+
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCDHW", name=None):
+    return _conv_nd(x, weight, bias, stride, padding, dilation, groups, data_format, 3,
+                    "conv3d")
+
+
+def _conv_transpose_nd(x, weight, bias, stride, padding, output_padding,
+                       groups, dilation, data_format, nd, name,
+                       output_size=None):
+    """Transposed conv as the gradient-style conv: spatially-flipped,
+    in/out-swapped kernel over the stride-dilated input
+    (lax.conv_general_dilated with lhs_dilation — the canonical XLA lowering;
+    reference kernel: phi conv2d_transpose/conv3d_transpose).
+
+    paddle weight layout: [C_in, C_out/groups, *k]. Output spatial size:
+    (in-1)*stride - 2*pad + dilation*(k-1) + 1 + output_padding.
+    """
+    strides = _pair(stride, nd)
+    dilations = _pair(dilation, nd)
+    channels_last = not data_format.startswith("NC")
+    spatial = "DHW"[-nd:]
+    lhs_spec = ("N" + spatial + "C") if channels_last else ("NC" + spatial)
+    ksp = weight._value.shape[2:]
+    if isinstance(padding, str):
+        if padding.upper() == "VALID":
+            p = [0] * nd
+        elif padding.upper() == "SAME":
+            # out = in * stride: total pad = d*(k-1) + 1 - s (clamped)
+            p = [max(dilations[i] * (ksp[i] - 1) + 1 - strides[i], 0) // 2
+                 for i in range(nd)]
+        else:
+            raise ValueError(padding)
+    else:
+        p = _pair(padding, nd)
+    if output_size is not None:
+        # derive output_padding from the requested spatial size (paddle's
+        # output_size knob): op = out - ((in-1)*s - 2p + d*(k-1) + 1)
+        in_sp = (x._value.shape[1:1 + nd] if channels_last
+                 else x._value.shape[2:2 + nd])
+        out_sp = list(output_size)[-nd:]
+        op = []
+        for i in range(nd):
+            base = ((in_sp[i] - 1) * strides[i] - 2 * p[i]
+                    + dilations[i] * (ksp[i] - 1) + 1)
+            opi = int(out_sp[i]) - base
+            if not 0 <= opi < strides[i] + dilations[i]:
+                raise ValueError(
+                    f"output_size {out_sp} unreachable (dim {i}: base {base})")
+            op.append(opi)
+    else:
+        op = _pair(output_padding, nd)
+
+    def f(a, w, *b):
+        cin = w.shape[0]
+        cog = w.shape[1]  # C_out / groups
+        k = w.shape[2:]
+        # [C_in, C_out/g, *k] -> [g, C_in/g, C_out/g, *k] -> swap ->
+        # [C_out, C_in/g, *k], then flip spatial taps
+        wg = w.reshape((groups, cin // groups, cog) + k)
+        wg = jnp.swapaxes(wg, 1, 2).reshape((groups * cog, cin // groups) + k)
+        wg = jnp.flip(wg, axis=tuple(range(2, 2 + nd)))
+        pad = [(dilations[i] * (k[i] - 1) - p[i],
+                dilations[i] * (k[i] - 1) - p[i] + op[i]) for i in range(nd)]
+        dn = jax.lax.conv_dimension_numbers(
+            a.shape, wg.shape, (lhs_spec, "OI" + spatial, lhs_spec))
+        out = jax.lax.conv_general_dilated(
+            a, wg, window_strides=(1,) * nd, padding=pad,
+            lhs_dilation=strides, rhs_dilation=dilations,
+            dimension_numbers=dn, feature_group_count=groups)
+        if b:
+            shape = [1] * out.ndim
+            ch_axis = out.ndim - 1 if channels_last else 1
+            shape[ch_axis] = b[0].size
+            out = out + b[0].reshape(shape)
+        return out.astype(a.dtype)
+
+    args = [bias] if bias is not None else []
+    return apply(name, f, x, weight, *args)
+
+
+def conv2d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0,
+                     groups=1, dilation=1, data_format="NCHW", output_size=None,
+                     name=None):
+    return _conv_transpose_nd(x, weight, bias, stride, padding, output_padding,
+                              groups, dilation, data_format, 2,
+                              "conv2d_transpose", output_size=output_size)
+
+
+def conv1d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1,
+                     data_format="NCL", output_size=None, name=None):
+    return _conv_transpose_nd(x, weight, bias, stride, padding, output_padding,
+                              groups, dilation, data_format, 1,
+                              "conv1d_transpose", output_size=output_size)
+
+
+def conv3d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1,
+                     data_format="NCDHW", output_size=None, name=None):
+    return _conv_transpose_nd(x, weight, bias, stride, padding, output_padding,
+                              groups, dilation, data_format, 3,
+                              "conv3d_transpose", output_size=output_size)
+
+
+# ------------------------------------------------------------------- pooling
+
+
+def _pool_nd(x, kernel, stride, padding, nd, reducer, init, data_format, ceil_mode,
+             name, average=False, exclusive=True):
+    ks = _pair(kernel, nd)
+    st = _pair(stride if stride is not None else kernel, nd)
+    p = _pair(padding, nd)
+
+    channel_first = data_format.startswith("NC")
+    if channel_first:
+        window = (1, 1) + ks
+        strides = (1, 1) + st
+        pads = ((0, 0), (0, 0)) + tuple((pi, pi) for pi in p)
+    else:
+        window = (1,) + ks + (1,)
+        strides = (1,) + st + (1,)
+        pads = ((0, 0),) + tuple((pi, pi) for pi in p) + ((0, 0),)
+
+    def f(a):
+        out = jax.lax.reduce_window(a, init, reducer, window, strides, pads)
+        if average:
+            if exclusive:
+                ones = jnp.ones_like(a)
+                counts = jax.lax.reduce_window(
+                    ones, 0.0, jax.lax.add, window, strides, pads
+                )
+                out = out / counts
+            else:
+                out = out / float(np.prod(ks))
+        return out
+
+    return apply(name, f, x)
+
+
+def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCL", name=None):
+    return _pool_nd(x, kernel_size, stride, padding, 1, jax.lax.max, -jnp.inf,
+                    data_format, ceil_mode, "max_pool1d")
+
+
+def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCHW", name=None):
+    return _pool_nd(x, kernel_size, stride, padding, 2, jax.lax.max, -jnp.inf,
+                    data_format, ceil_mode, "max_pool2d")
+
+
+def max_pool3d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCDHW", name=None):
+    return _pool_nd(x, kernel_size, stride, padding, 3, jax.lax.max, -jnp.inf,
+                    data_format, ceil_mode, "max_pool3d")
+
+
+def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True,
+               ceil_mode=False, data_format="NCL", name=None):
+    return _pool_nd(x, kernel_size, stride, padding, 1, jax.lax.add, 0.0,
+                    data_format, ceil_mode, "avg_pool1d", average=True,
+                    exclusive=exclusive)
+
+
+def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCHW", name=None):
+    return _pool_nd(x, kernel_size, stride, padding, 2, jax.lax.add, 0.0,
+                    data_format, ceil_mode, "avg_pool2d", average=True,
+                    exclusive=exclusive)
+
+
+def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCDHW", name=None):
+    return _pool_nd(x, kernel_size, stride, padding, 3, jax.lax.add, 0.0,
+                    data_format, ceil_mode, "avg_pool3d", average=True,
+                    exclusive=exclusive)
+
+
+def adaptive_avg_pool2d(x, output_size, data_format="NCHW", name=None):
+    out_hw = _pair(output_size, 2)
+
+    def f(a):
+        if data_format == "NCHW":
+            n, c, h, w = a.shape
+            oh = out_hw[0] or h
+            ow = out_hw[1] or w
+            a5 = a.reshape(n, c, oh, h // oh, ow, w // ow)
+            return jnp.mean(a5, axis=(3, 5))
+        n, h, w, c = a.shape
+        oh, ow = out_hw[0] or h, out_hw[1] or w
+        a5 = a.reshape(n, oh, h // oh, ow, w // ow, c)
+        return jnp.mean(a5, axis=(2, 4))
+
+    return apply("adaptive_avg_pool2d", f, x)
+
+
+def adaptive_max_pool2d(x, output_size, return_mask=False, name=None):
+    out_hw = _pair(output_size, 2)
+
+    def f(a):
+        n, c, h, w = a.shape
+        oh = out_hw[0] or h
+        ow = out_hw[1] or w
+        a5 = a.reshape(n, c, oh, h // oh, ow, w // ow)
+        return jnp.max(a5, axis=(3, 5))
+
+    return apply("adaptive_max_pool2d", f, x)
+
+
+def adaptive_avg_pool1d(x, output_size, name=None):
+    def f(a):
+        n, c, l = a.shape
+        o = output_size
+        return jnp.mean(a.reshape(n, c, o, l // o), axis=3)
+
+    return apply("adaptive_avg_pool1d", f, x)
+
+
+# -------------------------------------------------------------------- losses
+
+
+def _reduce_loss(loss, reduction):
+    if reduction == "mean":
+        return jnp.mean(loss)
+    if reduction == "sum":
+        return jnp.sum(loss)
+    return loss
+
+
+def mse_loss(input, label, reduction="mean", name=None):
+    return apply(
+        "mse_loss", lambda a, b: _reduce_loss(jnp.square(a - b), reduction), input, label
+    )
+
+
+def l1_loss(input, label, reduction="mean", name=None):
+    return apply(
+        "l1_loss", lambda a, b: _reduce_loss(jnp.abs(a - b), reduction), input, label
+    )
+
+
+def smooth_l1_loss(input, label, reduction="mean", delta=1.0, name=None):
+    def f(a, b):
+        d = jnp.abs(a - b)
+        loss = jnp.where(d < delta, 0.5 * d * d, delta * (d - 0.5 * delta))
+        return _reduce_loss(loss, reduction)
+
+    return apply("smooth_l1_loss", f, input, label)
+
+
+def cross_entropy(input, label, weight=None, ignore_index=-100, reduction="mean",
+                  soft_label=False, axis=-1, use_softmax=True, label_smoothing=0.0,
+                  name=None):
+    """paddle.nn.functional.cross_entropy parity
+    (reference: python/paddle/nn/functional/loss.py cross_entropy)."""
+
+    def f(logits, lab, *w):
+        if use_softmax:
+            logp = jax.nn.log_softmax(logits, axis=axis)
+        else:
+            logp = jnp.log(jnp.maximum(logits, 1e-30))
+        n_classes = logits.shape[axis]
+        if soft_label:
+            soft = lab
+            if label_smoothing > 0:
+                soft = soft * (1 - label_smoothing) + label_smoothing / n_classes
+            loss = -jnp.sum(soft * logp, axis=axis)
+        else:
+            lab_i = lab.astype(jnp.int32)
+            if lab_i.ndim == logp.ndim:
+                lab_i = jnp.squeeze(lab_i, axis)
+            valid = lab_i != ignore_index
+            lab_safe = jnp.where(valid, lab_i, 0)
+            picked = jnp.take_along_axis(
+                logp, jnp.expand_dims(lab_safe, axis), axis=axis
+            )
+            loss = -jnp.squeeze(picked, axis)
+            if label_smoothing > 0:
+                smooth_loss = -jnp.mean(logp, axis=axis)
+                loss = (1 - label_smoothing) * loss + label_smoothing * smooth_loss
+            if w:
+                loss = loss * jnp.take(w[0], lab_safe)
+            loss = jnp.where(valid, loss, 0.0)
+            if reduction == "mean":
+                if w:
+                    denom = jnp.sum(jnp.where(valid, jnp.take(w[0], lab_safe), 0.0))
+                else:
+                    denom = jnp.maximum(jnp.sum(valid.astype(loss.dtype)), 1.0)
+                return jnp.sum(loss) / denom
+        return _reduce_loss(loss, reduction)
+
+    args = [label.detach() if not soft_label else label]
+    if weight is not None:
+        args.append(weight)
+    return apply("cross_entropy", f, input, *args)
+
+
+def softmax_with_cross_entropy(logits, label, soft_label=False, ignore_index=-100,
+                               numeric_stable_mode=True, return_softmax=False,
+                               axis=-1):
+    loss = cross_entropy(logits, label, soft_label=soft_label,
+                         ignore_index=ignore_index, reduction="none", axis=axis)
+    from paddle_tpu.ops import manipulation
+
+    loss = manipulation.unsqueeze(loss, axis)
+    if return_softmax:
+        return loss, softmax(logits, axis=axis)
+    return loss
+
+
+def nll_loss(input, label, weight=None, ignore_index=-100, reduction="mean", name=None):
+    def f(logp, lab, *w):
+        lab_i = lab.astype(jnp.int32)
+        valid = lab_i != ignore_index
+        lab_safe = jnp.where(valid, lab_i, 0)
+        picked = jnp.take_along_axis(logp, jnp.expand_dims(lab_safe, 1), axis=1)
+        loss = -jnp.squeeze(picked, 1)
+        if w:
+            loss = loss * jnp.take(w[0], lab_safe)
+        loss = jnp.where(valid, loss, 0.0)
+        if reduction == "mean":
+            denom = jnp.sum(jnp.take(w[0], lab_safe) * valid) if w else \
+                jnp.maximum(jnp.sum(valid.astype(loss.dtype)), 1.0)
+            return jnp.sum(loss) / denom
+        return _reduce_loss(loss, reduction)
+
+    args = [label.detach()]
+    if weight is not None:
+        args.append(weight)
+    return apply("nll_loss", f, input, *args)
+
+
+def binary_cross_entropy(input, label, weight=None, reduction="mean", name=None):
+    def f(p, l, *w):
+        eps = 1e-12
+        loss = -(l * jnp.log(jnp.maximum(p, eps)) +
+                 (1 - l) * jnp.log(jnp.maximum(1 - p, eps)))
+        if w:
+            loss = loss * w[0]
+        return _reduce_loss(loss, reduction)
+
+    args = [label]
+    if weight is not None:
+        args.append(weight)
+    return apply("binary_cross_entropy", f, input, *args)
+
+
+def binary_cross_entropy_with_logits(logit, label, weight=None, reduction="mean",
+                                     pos_weight=None, name=None):
+    def f(z, l, *extra):
+        loss = jnp.maximum(z, 0) - z * l + jnp.log1p(jnp.exp(-jnp.abs(z)))
+        i = 0
+        if pos_weight is not None:
+            pw = extra[i]
+            i += 1
+            log_sig = jax.nn.log_sigmoid(z)
+            log_one_minus = jax.nn.log_sigmoid(-z)
+            loss = -(pw * l * log_sig + (1 - l) * log_one_minus)
+        if weight is not None:
+            loss = loss * extra[i]
+        return _reduce_loss(loss, reduction)
+
+    args = [label]
+    if pos_weight is not None:
+        args.append(pos_weight)
+    if weight is not None:
+        args.append(weight)
+    return apply("bce_with_logits", f, logit, *args)
+
+
+def kl_div(input, label, reduction="mean", log_target=False, name=None):
+    def f(logp, t):
+        if log_target:
+            loss = jnp.exp(t) * (t - logp)
+        else:
+            loss = t * (jnp.log(jnp.maximum(t, 1e-12)) - logp)
+        if reduction == "batchmean":
+            return jnp.sum(loss) / logp.shape[0]
+        return _reduce_loss(loss, reduction)
+
+    return apply("kl_div", f, input, label)
+
+
+def margin_ranking_loss(input, other, label, margin=0.0, reduction="mean", name=None):
+    return apply(
+        "margin_ranking_loss",
+        lambda a, b, l: _reduce_loss(jnp.maximum(0.0, -l * (a - b) + margin), reduction),
+        input, other, label,
+    )
+
+
+def cosine_similarity(x1, x2, axis=1, eps=1e-8):
+    def f(a, b):
+        dot = jnp.sum(a * b, axis=axis)
+        na = jnp.sqrt(jnp.sum(a * a, axis=axis))
+        nb = jnp.sqrt(jnp.sum(b * b, axis=axis))
+        return dot / jnp.maximum(na * nb, eps)
+
+    return apply("cosine_similarity", f, x1, x2)
+
+
+def cosine_embedding_loss(input1, input2, label, margin=0.0, reduction="mean", name=None):
+    def f(a, b, l):
+        cos = jnp.sum(a * b, axis=1) / jnp.maximum(
+            jnp.linalg.norm(a, axis=1) * jnp.linalg.norm(b, axis=1), 1e-12
+        )
+        loss = jnp.where(l == 1, 1 - cos, jnp.maximum(0.0, cos - margin))
+        return _reduce_loss(loss, reduction)
+
+    return apply("cosine_embedding_loss", f, input1, input2, label.detach())
+
+
+def triplet_margin_loss(input, positive, negative, margin=1.0, p=2.0, epsilon=1e-6,
+                        swap=False, reduction="mean", name=None):
+    def f(a, pos, neg):
+        dp = jnp.power(jnp.sum(jnp.power(jnp.abs(a - pos), p), axis=-1), 1 / p)
+        dn = jnp.power(jnp.sum(jnp.power(jnp.abs(a - neg), p), axis=-1), 1 / p)
+        if swap:
+            dsn = jnp.power(jnp.sum(jnp.power(jnp.abs(pos - neg), p), axis=-1), 1 / p)
+            dn = jnp.minimum(dn, dsn)
+        return _reduce_loss(jnp.maximum(dp - dn + margin, 0.0), reduction)
+
+    return apply("triplet_margin_loss", f, input, positive, negative)
+
+
+def hinge_embedding_loss(input, label, margin=1.0, reduction="mean", name=None):
+    return apply(
+        "hinge_embedding_loss",
+        lambda a, l: _reduce_loss(
+            jnp.where(l == 1, a, jnp.maximum(0.0, margin - a)), reduction
+        ),
+        input, label.detach(),
+    )
+
+
+def square_error_cost(input, label):
+    return apply("square_error_cost", lambda a, b: jnp.square(a - b), input, label)
+
+
+# ----------------------------------------------------------------- attention
+
+
+def scaled_dot_product_attention(query, key, value, attn_mask=None, dropout_p=0.0,
+                                 is_causal=False, training=True, name=None):
+    """Flash-attention entry point. [B, S, H, D] layout (paddle convention).
+
+    On TPU this routes to the Pallas flash kernel (ops/pallas/flash_attention);
+    elsewhere falls back to an XLA-fused reference implementation.
+    """
+    from paddle_tpu.ops.pallas import flash_attention as fa
+
+    return fa.scaled_dot_product_attention(
+        query, key, value, attn_mask=attn_mask, dropout_p=dropout_p,
+        is_causal=is_causal, training=training,
+    )
+
+
+# -------------------------------------------------------------- interpolation
+
+
+def interpolate(x, size=None, scale_factor=None, mode="nearest", align_corners=False,
+                align_mode=0, data_format="NCHW", name=None):
+    def f(a):
+        if data_format == "NCHW":
+            n, c, h, w = a.shape
+            if size is not None:
+                oh, ow = size
+            else:
+                sf = scale_factor if isinstance(scale_factor, (list, tuple)) else \
+                    (scale_factor, scale_factor)
+                oh, ow = int(h * sf[0]), int(w * sf[1])
+            method = {"nearest": "nearest", "bilinear": "bilinear", "bicubic": "bicubic",
+                      "area": "linear", "linear": "linear", "trilinear": "trilinear"}[mode]
+            out = jax.image.resize(a, (n, c, oh, ow), method=method)
+            return out.astype(a.dtype)
+        n, h, w, c = a.shape
+        if size is not None:
+            oh, ow = size
+        else:
+            sf = scale_factor if isinstance(scale_factor, (list, tuple)) else \
+                (scale_factor, scale_factor)
+            oh, ow = int(h * sf[0]), int(w * sf[1])
+        return jax.image.resize(a, (n, oh, ow, c), method=mode).astype(a.dtype)
+
+    return apply("interpolate", f, x)
+
+
+def upsample(x, size=None, scale_factor=None, mode="nearest", align_corners=False,
+             align_mode=0, data_format="NCHW", name=None):
+    return interpolate(x, size, scale_factor, mode, align_corners, align_mode,
+                       data_format)
+
+
+def pixel_shuffle(x, upscale_factor, data_format="NCHW", name=None):
+    r = upscale_factor
+
+    def f(a):
+        n, c, h, w = a.shape
+        oc = c // (r * r)
+        out = a.reshape(n, oc, r, r, h, w)
+        out = jnp.transpose(out, (0, 1, 4, 2, 5, 3))
+        return out.reshape(n, oc, h * r, w * r)
+
+    return apply("pixel_shuffle", f, x)
+
+
+def pixel_unshuffle(x, downscale_factor, data_format="NCHW", name=None):
+    r = downscale_factor
+
+    def f(a):
+        n, c, h, w = a.shape
+        out = a.reshape(n, c, h // r, r, w // r, r)
+        out = jnp.transpose(out, (0, 1, 3, 5, 2, 4))
+        return out.reshape(n, c * r * r, h // r, w // r)
+
+    return apply("pixel_unshuffle", f, x)
+
+
+def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+    ks = _pair(kernel_sizes, 2)
+    st = _pair(strides, 2)
+    pd = _pair(paddings, 2)
+    dl = _pair(dilations, 2)
+
+    def f(a):
+        n, c, h, w = a.shape
+        patches = jax.lax.conv_general_dilated_patches(
+            a, filter_shape=ks, window_strides=st,
+            padding=[(pd[0], pd[0]), (pd[1], pd[1])], rhs_dilation=dl,
+            dimension_numbers=("NCHW", "OIHW", "NCHW"),
+        )
+        return patches.reshape(n, c * ks[0] * ks[1], -1)
+
+    return apply("unfold", f, x)
+
+
+# --------------------------------------------------------------------- padding
+
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None):
+    from paddle_tpu.ops import manipulation
+
+    return manipulation.pad(x, pad, mode=mode, value=value, data_format=data_format)
+
+
+def zeropad2d(x, padding, data_format="NCHW", name=None):
+    return pad(x, padding, mode="constant", value=0.0, data_format=data_format)
+
+
+# ------------------------------------------------------------------ sequence
+
+def sequence_mask(x, maxlen=None, dtype="int64", name=None):
+    def f(lengths):
+        m = maxlen if maxlen is not None else int(lengths.max())
+        ar = jnp.arange(m)
+        return (ar[None, :] < lengths[:, None]).astype(dtype)
+
+    if maxlen is None:
+        m = int(np.asarray(x._value).max())
+        return apply(
+            "sequence_mask",
+            lambda lengths: (jnp.arange(m)[None, :] < lengths[:, None]).astype(dtype),
+            x, differentiable=False,
+        )
+    return apply("sequence_mask", f, x, differentiable=False)
+
+
+def pairwise_distance(x, y, p=2.0, epsilon=1e-6, keepdim=False, name=None):
+    """F.pairwise_distance parity."""
+
+    def f(a, b):
+        d = a - b + epsilon  # paddle/torch: ||x - y + eps||_p
+        out = jnp.sum(jnp.abs(d) ** p, axis=-1) ** (1.0 / p)
+        return out[..., None] if keepdim else out
+
+    return apply("pairwise_distance", f, x, y)
+
+
+def huber_loss(input, label, delta=1.0, reduction="mean", name=None):
+    """F.smooth_l1/huber loss parity (quadratic near zero, linear beyond)."""
+
+    def f(i, l):
+        d = jnp.abs(i - l)
+        loss = jnp.where(d <= delta, 0.5 * d * d, delta * (d - 0.5 * delta))
+        if reduction == "mean":
+            return jnp.mean(loss)
+        if reduction == "sum":
+            return jnp.sum(loss)
+        return loss
+
+    return apply("huber_loss", f, input, label)
+
+
+def poisson_nll_loss(input, label, log_input=True, full=False, epsilon=1e-8,
+                     reduction="mean", name=None):
+    """F.poisson_nll_loss parity."""
+
+    def f(i, l):
+        if log_input:
+            loss = jnp.exp(i) - l * i
+        else:
+            loss = i - l * jnp.log(i + epsilon)
+        if full:
+            stirling = l * jnp.log(l + epsilon) - l + \
+                0.5 * jnp.log(2 * jnp.pi * (l + epsilon))
+            loss = loss + jnp.where(l > 1, stirling, 0.0)
+        if reduction == "mean":
+            return jnp.mean(loss)
+        if reduction == "sum":
+            return jnp.sum(loss)
+        return loss
+
+    return apply("poisson_nll_loss", f, input, label)
+
+
+def affine_grid(theta, out_shape, align_corners=True, name=None):
+    """F.affine_grid parity: theta [N, 2, 3] -> grid [N, H, W, 2]."""
+    if hasattr(out_shape, "numpy"):
+        out_shape = [int(v) for v in out_shape.numpy()]
+    N, C, H, W = out_shape
+
+    def f(th):
+        if align_corners:
+            ys = jnp.linspace(-1.0, 1.0, H)
+            xs = jnp.linspace(-1.0, 1.0, W)
+        else:
+            ys = (jnp.arange(H) + 0.5) * 2.0 / H - 1.0
+            xs = (jnp.arange(W) + 0.5) * 2.0 / W - 1.0
+        gy, gx = jnp.meshgrid(ys, xs, indexing="ij")
+        base = jnp.stack([gx, gy, jnp.ones_like(gx)], axis=-1)  # [H, W, 3]
+        return jnp.einsum("nij,hwj->nhwi", th, base)  # [N, H, W, 2]
+
+    return apply("affine_grid", f, theta)
+
+
+def grid_sample(x, grid, mode="bilinear", padding_mode="zeros",
+                align_corners=True, name=None):
+    """F.grid_sample parity: x [N,C,H,W], grid [N,Hg,Wg,2] in [-1,1]."""
+
+    def f(xa, g):
+        N, C, H, W = xa.shape
+        gx, gy = g[..., 0], g[..., 1]
+        if align_corners:
+            fx = (gx + 1) * (W - 1) / 2
+            fy = (gy + 1) * (H - 1) / 2
+        else:
+            fx = ((gx + 1) * W - 1) / 2
+            fy = ((gy + 1) * H - 1) / 2
+
+        def gather2d(ix, iy):
+            ixc = jnp.clip(ix, 0, W - 1)
+            iyc = jnp.clip(iy, 0, H - 1)
+            out = xa[jnp.arange(N)[:, None, None], :, iyc, ixc]  # [N,Hg,Wg,C]
+            if padding_mode == "zeros":
+                valid = ((ix >= 0) & (ix < W) & (iy >= 0) &
+                         (iy < H))[..., None]
+                out = jnp.where(valid, out, 0.0)
+            return out
+
+        if mode == "nearest":
+            out = gather2d(jnp.round(fx).astype(jnp.int32),
+                           jnp.round(fy).astype(jnp.int32))
+        else:
+            x0 = jnp.floor(fx).astype(jnp.int32)
+            y0 = jnp.floor(fy).astype(jnp.int32)
+            wx = (fx - x0)[..., None]
+            wy = (fy - y0)[..., None]
+            out = (gather2d(x0, y0) * (1 - wx) * (1 - wy)
+                   + gather2d(x0 + 1, y0) * wx * (1 - wy)
+                   + gather2d(x0, y0 + 1) * (1 - wx) * wy
+                   + gather2d(x0 + 1, y0 + 1) * wx * wy)
+        return jnp.moveaxis(out, -1, 1)  # [N,C,Hg,Wg]
+
+    return apply("grid_sample", f, x, grid)
+
+
+def fold(x, output_sizes, kernel_sizes, strides=1, paddings=0, dilations=1,
+         name=None):
+    """F.fold parity: [N, C*kh*kw, L] col buffer -> [N, C, H, W] (sum of
+    overlapping patches — the inverse of unfold)."""
+    kh, kw = (kernel_sizes if isinstance(kernel_sizes, (list, tuple))
+              else (kernel_sizes, kernel_sizes))
+    sh, sw = (strides if isinstance(strides, (list, tuple))
+              else (strides, strides))
+    ph, pw = (paddings if isinstance(paddings, (list, tuple))
+              else (paddings, paddings))
+    dh, dw = (dilations if isinstance(dilations, (list, tuple))
+              else (dilations, dilations))
+    H, W = output_sizes
+
+    def f(col):
+        N, ckk, L = col.shape
+        C = ckk // (kh * kw)
+        eff_kh = dh * (kh - 1) + 1
+        eff_kw = dw * (kw - 1) + 1
+        n_h = (H + 2 * ph - eff_kh) // sh + 1
+        n_w = (W + 2 * pw - eff_kw) // sw + 1
+        col = col.reshape(N, C, kh, kw, n_h, n_w)
+        out = jnp.zeros((N, C, H + 2 * ph, W + 2 * pw), col.dtype)
+        for i in range(kh):
+            for j in range(kw):
+                ys = i * dh + sh * jnp.arange(n_h)
+                xs = j * dw + sw * jnp.arange(n_w)
+                out = out.at[:, :, ys[:, None], xs[None, :]].add(
+                    col[:, :, i, j])
+        return out[:, :, ph:ph + H, pw:pw + W]
+
+    return apply("fold", f, x)
+
+
+def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
+             reduction="mean", norm_by_times=False, name=None):
+    """F.ctc_loss parity (phi warpctc kernel analogue): the standard CTC
+    alpha recursion in log space as a lax.scan over time."""
+
+    def f(lp, lab, in_len, lab_len):
+        # paddle layout: log_probs [T, B, V] (logsoftmax'd), labels [B, S]
+        T, B, V = lp.shape
+        S = lab.shape[1]
+        ext = 2 * S + 1  # blank-interleaved target length
+        NEG = -1e30
+
+        lab = lab.astype(jnp.int32)
+        # extended label sequence: blank, l1, blank, l2, ... blank
+        ext_labels = jnp.full((B, ext), blank, jnp.int32)
+        ext_labels = ext_labels.at[:, 1::2].set(lab)
+        # can skip from s-2 to s when the ext label differs and is not blank
+        skip = jnp.concatenate(
+            [jnp.zeros((B, 2), bool),
+             ext_labels[:, 2:] != ext_labels[:, :-2]], axis=1)
+        can_skip = skip & (ext_labels != blank)
+
+        def emit(t):
+            # [B, ext] log prob of each extended label at time t
+            return jnp.take_along_axis(lp[t], ext_labels, axis=1)
+
+        alpha0 = jnp.full((B, ext), NEG)
+        alpha0 = alpha0.at[:, 0].set(emit(0)[:, 0])
+        alpha0 = alpha0.at[:, 1].set(jnp.where(S > 0, emit(0)[:, 1], NEG))
+
+        def step(alpha, t):
+            a_prev1 = jnp.concatenate(
+                [jnp.full((B, 1), NEG), alpha[:, :-1]], axis=1)
+            a_prev2 = jnp.concatenate(
+                [jnp.full((B, 2), NEG), alpha[:, :-2]], axis=1)
+            a_prev2 = jnp.where(can_skip, a_prev2, NEG)
+            merged = jnp.logaddexp(jnp.logaddexp(alpha, a_prev1), a_prev2)
+            new = merged + emit(t)
+            # freeze past each sequence's input length
+            active = (t < in_len)[:, None]
+            return jnp.where(active, new, alpha), None
+
+        alpha, _ = jax.lax.scan(step, alpha0, jnp.arange(1, T))
+        # total prob: last blank or last label position, per true lab_len
+        last = 2 * lab_len.astype(jnp.int32)  # index of final blank
+        ll_final = jnp.take_along_axis(alpha, last[:, None], axis=1)[:, 0]
+        ll_label = jnp.take_along_axis(
+            alpha, jnp.maximum(last - 1, 0)[:, None], axis=1)[:, 0]
+        nll = -jnp.logaddexp(ll_final,
+                             jnp.where(lab_len > 0, ll_label, NEG))
+        if norm_by_times:
+            nll = nll / jnp.maximum(in_len.astype(nll.dtype), 1.0)
+        if reduction == "mean":
+            return jnp.mean(nll / jnp.maximum(lab_len.astype(nll.dtype), 1.0))
+        if reduction == "sum":
+            return jnp.sum(nll)
+        return nll
+
+    return apply("ctc_loss", f, log_probs, labels, input_lengths,
+                 label_lengths)

@@ -1,0 +1,165 @@
+"""Weight initializers (parity: python/paddle/nn/initializer/)."""
+
+from __future__ import annotations
+
+import math as _math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.framework import dtype as dtypes
+from paddle_tpu.framework import random as rng
+
+
+class Initializer:
+    def __call__(self, shape, dtype):
+        raise NotImplementedError
+
+
+class Constant(Initializer):
+    def __init__(self, value=0.0):
+        self.value = value
+
+    def __call__(self, shape, dtype):
+        return jnp.full(shape, self.value, dtype=dtype)
+
+
+class Normal(Initializer):
+    def __init__(self, mean=0.0, std=1.0):
+        self.mean, self.std = mean, std
+
+    def __call__(self, shape, dtype):
+        return (
+            jax.random.normal(rng.next_key(), shape, dtype=jnp.float32) * self.std
+            + self.mean
+        ).astype(dtype)
+
+
+class TruncatedNormal(Initializer):
+    def __init__(self, mean=0.0, std=1.0, a=-2.0, b=2.0):
+        self.mean, self.std, self.a, self.b = mean, std, a, b
+
+    def __call__(self, shape, dtype):
+        return (
+            jax.random.truncated_normal(rng.next_key(), self.a, self.b, shape, jnp.float32)
+            * self.std
+            + self.mean
+        ).astype(dtype)
+
+
+class Uniform(Initializer):
+    def __init__(self, low=-1.0, high=1.0):
+        self.low, self.high = low, high
+
+    def __call__(self, shape, dtype):
+        return jax.random.uniform(
+            rng.next_key(), shape, dtype=jnp.float32, minval=self.low, maxval=self.high
+        ).astype(dtype)
+
+
+def _fans(shape):
+    if len(shape) == 0:
+        return 1, 1
+    if len(shape) == 1:
+        return shape[0], shape[0]
+    if len(shape) == 2:
+        # paddle Linear weights are [in, out]
+        return shape[0], shape[1]
+    receptive = int(np.prod(shape[2:]))
+    return shape[1] * receptive, shape[0] * receptive
+
+
+class XavierNormal(Initializer):
+    def __init__(self, fan_in=None, fan_out=None, gain=1.0):
+        self.fan_in, self.fan_out, self.gain = fan_in, fan_out, gain
+
+    def __call__(self, shape, dtype):
+        fi, fo = _fans(shape)
+        fi = self.fan_in or fi
+        fo = self.fan_out or fo
+        std = self.gain * _math.sqrt(2.0 / (fi + fo))
+        return (jax.random.normal(rng.next_key(), shape, jnp.float32) * std).astype(dtype)
+
+
+class XavierUniform(Initializer):
+    def __init__(self, fan_in=None, fan_out=None, gain=1.0):
+        self.fan_in, self.fan_out, self.gain = fan_in, fan_out, gain
+
+    def __call__(self, shape, dtype):
+        fi, fo = _fans(shape)
+        fi = self.fan_in or fi
+        fo = self.fan_out or fo
+        limit = self.gain * _math.sqrt(6.0 / (fi + fo))
+        return jax.random.uniform(
+            rng.next_key(), shape, jnp.float32, minval=-limit, maxval=limit
+        ).astype(dtype)
+
+
+class KaimingNormal(Initializer):
+    def __init__(self, fan_in=None, negative_slope=0.0, nonlinearity="relu"):
+        self.fan_in, self.negative_slope, self.nonlinearity = fan_in, negative_slope, nonlinearity
+
+    def __call__(self, shape, dtype):
+        fi, _ = _fans(shape)
+        fi = self.fan_in or fi
+        gain = _math.sqrt(2.0 / (1 + self.negative_slope**2))
+        std = gain / _math.sqrt(fi)
+        return (jax.random.normal(rng.next_key(), shape, jnp.float32) * std).astype(dtype)
+
+
+class KaimingUniform(Initializer):
+    def __init__(self, fan_in=None, negative_slope=0.0, nonlinearity="relu"):
+        self.fan_in, self.negative_slope, self.nonlinearity = fan_in, negative_slope, nonlinearity
+
+    def __call__(self, shape, dtype):
+        fi, _ = _fans(shape)
+        fi = self.fan_in or fi
+        gain = _math.sqrt(2.0 / (1 + self.negative_slope**2))
+        limit = gain * _math.sqrt(3.0 / fi)
+        return jax.random.uniform(
+            rng.next_key(), shape, jnp.float32, minval=-limit, maxval=limit
+        ).astype(dtype)
+
+
+class Assign(Initializer):
+    def __init__(self, value):
+        self.value = value
+
+    def __call__(self, shape, dtype):
+        from paddle_tpu.tensor import Tensor
+
+        v = self.value._value if isinstance(self.value, Tensor) else np.asarray(self.value)
+        return jnp.asarray(v, dtype=dtype).reshape(shape)
+
+
+class Orthogonal(Initializer):
+    def __init__(self, gain=1.0):
+        self.gain = gain
+
+    def __call__(self, shape, dtype):
+        return (
+            jax.nn.initializers.orthogonal(scale=self.gain)(
+                rng.next_key(), shape, jnp.float32
+            )
+        ).astype(dtype)
+
+
+class Dirac(Initializer):
+    def __init__(self, groups=1):
+        self.groups = groups
+
+    def __call__(self, shape, dtype):
+        return jax.nn.initializers.delta_orthogonal()(rng.next_key(), shape, jnp.float32).astype(dtype)
+
+
+def calculate_gain(nonlinearity, param=None):
+    if nonlinearity == "tanh":
+        return 5.0 / 3
+    if nonlinearity == "relu":
+        return _math.sqrt(2.0)
+    if nonlinearity == "leaky_relu":
+        return _math.sqrt(2.0 / (1 + (param or 0.01) ** 2))
+    if nonlinearity == "selu":
+        return 3.0 / 4
+    return 1.0

@@ -1,0 +1,259 @@
+"""Recurrent layers: SimpleRNN / LSTM / GRU (+ single-step cells).
+
+Parity: python/paddle/nn/layer/rnn.py (RNNBase :1284, LSTM :1580, GRU :1720,
+LSTMCell/GRUCell/SimpleRNNCell). Recurrence executes through ops/rnn_ops
+(lax.scan — the cell body compiles once, per-step matmuls ride the MXU).
+
+Paddle conventions honored: batch_first via ``time_major`` (paddle default
+is batch-major [B, T, *]); weights per layer/direction are
+weight_ih/weight_hh/bias_ih/bias_hh with gate order i,f,g,o (LSTM) and
+r,z,n (GRU, torch/paddle "RNN-relu style" reset-before-matmul).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import numpy as np
+
+import paddle_tpu.nn.initializer as I
+from paddle_tpu.nn.layer_base import Layer
+from paddle_tpu.nn.param_attr import ParamAttr
+from paddle_tpu.ops import rnn_ops
+from paddle_tpu.tensor import Tensor
+
+import jax.numpy as jnp
+
+
+class _RNNBase(Layer):
+    _GATES = {"LSTM": 4, "GRU": 3, "RNN_TANH": 1, "RNN_RELU": 1}
+
+    def __init__(self, mode, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 weight_ih_attr=None, weight_hh_attr=None,
+                 bias_ih_attr=None, bias_hh_attr=None, name=None):
+        super().__init__()
+        if direction not in ("forward", "bidirect", "bidirectional"):
+            raise ValueError(direction)
+        self.mode = mode
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.num_layers = num_layers
+        self.time_major = time_major
+        self.bidirect = direction in ("bidirect", "bidirectional")
+        D = 2 if self.bidirect else 1
+        G = self._GATES[mode]
+        k = 1.0 / math.sqrt(hidden_size)
+        init = I.Uniform(-k, k)
+        self._weights = []
+        for layer in range(num_layers):
+            in_sz = input_size if layer == 0 else hidden_size * D
+            for d in range(D):
+                suffix = f"{layer}" + ("_reverse" if d else "")
+                w_ih = self.create_parameter(
+                    [G * hidden_size, in_sz],
+                    attr=ParamAttr._to_attr(weight_ih_attr),
+                    default_initializer=init)
+                w_hh = self.create_parameter(
+                    [G * hidden_size, hidden_size],
+                    attr=ParamAttr._to_attr(weight_hh_attr),
+                    default_initializer=init)
+                b_ih = self.create_parameter(
+                    [G * hidden_size], attr=ParamAttr._to_attr(bias_ih_attr),
+                    is_bias=True, default_initializer=init)
+                b_hh = self.create_parameter(
+                    [G * hidden_size], attr=ParamAttr._to_attr(bias_hh_attr),
+                    is_bias=True, default_initializer=init)
+                for nm, p in (("weight_ih_l", w_ih), ("weight_hh_l", w_hh),
+                              ("bias_ih_l", b_ih), ("bias_hh_l", b_hh)):
+                    setattr(self, nm + suffix, p)
+                self._weights += [w_ih, w_hh, b_ih, b_hh]
+
+    def _zero_state(self, batch):
+        D = 2 if self.bidirect else 1
+        return jnp.zeros((self.num_layers * D, batch, self.hidden_size),
+                         jnp.float32)
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        x = inputs
+        if not self.time_major:
+            from paddle_tpu.ops import manipulation
+
+            x = manipulation.transpose(x, [1, 0, 2])
+        batch = x.shape[1]
+        is_lstm = self.mode == "LSTM"
+        if initial_states is None:
+            h0 = Tensor._from_value(self._zero_state(batch))
+            states = (h0, Tensor._from_value(self._zero_state(batch))) \
+                if is_lstm else (h0,)
+        else:
+            states = (initial_states if isinstance(initial_states,
+                                                   (tuple, list))
+                      else (initial_states,))
+        res = rnn_ops.rnn(x, tuple(states), self._weights,
+                          sequence_length=sequence_length,
+                          is_bidirec=self.bidirect,
+                          num_layers=self.num_layers, mode=self.mode)
+        out, *final = res
+        if not self.time_major:
+            from paddle_tpu.ops import manipulation
+
+            out = manipulation.transpose(out, [1, 0, 2])
+        if is_lstm:
+            return out, (final[0], final[1])
+        return out, final[0]
+
+    def extra_repr(self):
+        return (f"{self.input_size}, {self.hidden_size}, "
+                f"num_layers={self.num_layers}, mode={self.mode}")
+
+
+class SimpleRNN(_RNNBase):
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 activation="tanh", **kw):
+        mode = "RNN_TANH" if activation == "tanh" else "RNN_RELU"
+        super().__init__(mode, input_size, hidden_size, num_layers,
+                         direction, time_major, dropout, **kw)
+
+
+class LSTM(_RNNBase):
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0, **kw):
+        super().__init__("LSTM", input_size, hidden_size, num_layers,
+                         direction, time_major, dropout, **kw)
+
+
+class GRU(_RNNBase):
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0, **kw):
+        super().__init__("GRU", input_size, hidden_size, num_layers,
+                         direction, time_major, dropout, **kw)
+
+
+class _CellBase(Layer):
+    def __init__(self, mode, input_size, hidden_size, **kw):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        G = _RNNBase._GATES[mode]
+        k = 1.0 / math.sqrt(hidden_size)
+        init = I.Uniform(-k, k)
+        self.weight_ih = self.create_parameter([G * hidden_size, input_size],
+                                               default_initializer=init)
+        self.weight_hh = self.create_parameter([G * hidden_size, hidden_size],
+                                               default_initializer=init)
+        self.bias_ih = self.create_parameter([G * hidden_size], is_bias=True,
+                                             default_initializer=init)
+        self.bias_hh = self.create_parameter([G * hidden_size], is_bias=True,
+                                             default_initializer=init)
+
+    def _zeros(self, batch):
+        return Tensor._from_value(
+            jnp.zeros((batch, self.hidden_size), jnp.float32))
+
+
+class LSTMCell(_CellBase):
+    def __init__(self, input_size, hidden_size, **kw):
+        super().__init__("LSTM", input_size, hidden_size, **kw)
+
+    def forward(self, inputs, states=None):
+        from paddle_tpu.core.dispatch import apply
+
+        if states is None:
+            states = (self._zeros(inputs.shape[0]),) * 2
+        h, c = states
+
+        def f(x, hh, cc, wi, wh, bi, bh):
+            return rnn_ops._lstm_cell(x, hh, cc, wi, wh, bi, bh)
+
+        h2, c2 = apply("lstm_cell", f, inputs, h, c, self.weight_ih,
+                       self.weight_hh, self.bias_ih, self.bias_hh)
+        return h2, (h2, c2)
+
+
+class GRUCell(_CellBase):
+    def __init__(self, input_size, hidden_size, **kw):
+        super().__init__("GRU", input_size, hidden_size, **kw)
+
+    def forward(self, inputs, states=None):
+        from paddle_tpu.core.dispatch import apply
+
+        h = states if states is not None else self._zeros(inputs.shape[0])
+
+        def f(x, hh, wi, wh, bi, bh):
+            return rnn_ops._gru_cell(x, hh, wi, wh, bi, bh)
+
+        h2 = apply("gru_cell", f, inputs, h, self.weight_ih, self.weight_hh,
+                   self.bias_ih, self.bias_hh)
+        return h2, h2
+
+
+class SimpleRNNCell(_CellBase):
+    def __init__(self, input_size, hidden_size, activation="tanh", **kw):
+        mode = "RNN_TANH" if activation == "tanh" else "RNN_RELU"
+        super().__init__(mode, input_size, hidden_size, **kw)
+        self._cell = (rnn_ops._tanh_cell if activation == "tanh"
+                      else rnn_ops._relu_cell)
+
+    def forward(self, inputs, states=None):
+        from paddle_tpu.core.dispatch import apply
+
+        h = states if states is not None else self._zeros(inputs.shape[0])
+        h2 = apply("simple_rnn_cell", self._cell, inputs, h, self.weight_ih,
+                   self.weight_hh, self.bias_ih, self.bias_hh)
+        return h2, h2
+
+
+class RNN(Layer):
+    """paddle.nn.RNN parity: run ANY cell over time (rnn.py:RNN). The cell's
+    forward(inputs_t, states) -> (output_t, new_states)."""
+
+    def __init__(self, cell, is_reverse=False, time_major=False):
+        super().__init__()
+        self.cell = cell
+        self.is_reverse = is_reverse
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        from paddle_tpu.ops import manipulation as M
+
+        if sequence_length is not None:
+            raise NotImplementedError(
+                "nn.RNN: per-sequence length masking is not implemented; "
+                "pad-free batches only (pack via DataLoader bucketing)")
+        x = inputs
+        if not self.time_major:
+            x = M.transpose(x, [1, 0, 2])
+        T = x.shape[0]
+        steps = range(T - 1, -1, -1) if self.is_reverse else range(T)
+        states = initial_states
+        outs = []
+        for t in steps:
+            out_t, states = self.cell(x[t], states)
+            outs.append(out_t)
+        if self.is_reverse:
+            outs = outs[::-1]
+        out = M.stack(outs, axis=0)
+        if not self.time_major:
+            out = M.transpose(out, [1, 0, 2])
+        return out, states
+
+
+class BiRNN(Layer):
+    """paddle.nn.BiRNN parity: forward + backward cells, concat outputs."""
+
+    def __init__(self, cell_fw, cell_bw, time_major=False):
+        super().__init__()
+        self.rnn_fw = RNN(cell_fw, is_reverse=False, time_major=time_major)
+        self.rnn_bw = RNN(cell_bw, is_reverse=True, time_major=time_major)
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        from paddle_tpu.ops import manipulation as M
+
+        s_fw, s_bw = (initial_states if initial_states is not None
+                      else (None, None))
+        out_fw, st_fw = self.rnn_fw(inputs, s_fw)
+        out_bw, st_bw = self.rnn_bw(inputs, s_bw)
+        return M.concat([out_fw, out_bw], axis=-1), (st_fw, st_bw)

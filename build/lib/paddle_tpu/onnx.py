@@ -1,0 +1,12 @@
+"""paddle.onnx parity surface (reference: python/paddle/onnx/export.py →
+paddle2onnx). The TPU-native interchange format is StableHLO (jit.save);
+ONNX export requires the external paddle2onnx converter which is not in this
+image, so export() raises with the supported alternative."""
+
+
+def export(layer, path, input_spec=None, opset_version=9, **configs):
+    raise NotImplementedError(
+        "ONNX export needs the external paddle2onnx package; the TPU-native "
+        "interchange path is paddle.jit.save (StableHLO + params), which "
+        "paddle.jit.load restores"
+    )

@@ -1,0 +1,223 @@
+"""Op namespace assembly + Tensor method binding.
+
+Mirrors the reference's monkey-patching of eager Tensor methods
+(python/paddle/base/dygraph/tensor_patch_methods.py) so that
+``x.sum()``, ``x + y``, ``x[idx]`` behave like paddle.Tensor.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.ops import (  # noqa: F401
+    comparison,
+    creation,
+    linalg,
+    manipulation,
+    math,
+    reduction,
+)
+from paddle_tpu.ops.registry import all_ops, get_op, op_count  # noqa: F401
+from paddle_tpu.core.dispatch import apply
+from paddle_tpu.tensor import Tensor
+
+_NAMESPACES = (math, creation, manipulation, reduction, comparison, linalg)
+
+
+def __getattr__(name):
+    for ns in _NAMESPACES:
+        if hasattr(ns, name):
+            return getattr(ns, name)
+    raise AttributeError(f"module 'paddle_tpu.ops' has no attribute {name!r}")
+
+
+def _unwrap_index(item):
+    """Convert an indexing expression possibly containing Tensors to raw form."""
+    if isinstance(item, Tensor):
+        v = item._value
+        return v
+    if isinstance(item, tuple):
+        return tuple(_unwrap_index(i) for i in item)
+    if isinstance(item, list):
+        return [_unwrap_index(i) for i in item]
+    if isinstance(item, slice):
+        return slice(
+            _unwrap_index(item.start) if isinstance(item.start, Tensor) else item.start,
+            _unwrap_index(item.stop) if isinstance(item.stop, Tensor) else item.stop,
+            _unwrap_index(item.step) if isinstance(item.step, Tensor) else item.step,
+        )
+    return item
+
+
+def _getitem(self, item):
+    raw = _unwrap_index(item)
+    return apply("getitem", lambda a: a[raw], self)
+
+
+def _setitem(self, item, value):
+    raw = _unwrap_index(item)
+    if isinstance(value, Tensor):
+        out = apply("setitem", lambda a, v: a.at[raw].set(v.astype(a.dtype)), self, value)
+    else:
+        out = apply("setitem", lambda a: a.at[raw].set(value), self)
+    self._replace_value(out._value, out._node)
+    if out._node is not None:
+        # the node's output weakref must now track self
+        out._node.register_output(0, self)
+        self.stop_gradient = False
+
+
+def _coerce_other(self, other):
+    if isinstance(other, Tensor):
+        return other
+    return other  # python scalars / numpy arrays pass straight to jnp
+
+
+def _binop(opname, jax_fn, reverse=False):
+    def fn(self, other):
+        other = _coerce_other(self, other)
+        if reverse:
+            if isinstance(other, Tensor):
+                return apply(opname, jax_fn, other, self)
+            return apply(opname, lambda a: jax_fn(other, a), self)
+        if isinstance(other, Tensor):
+            return apply(opname, jax_fn, self, other)
+        return apply(opname, lambda a: jax_fn(a, other), self)
+
+    return fn
+
+
+def _patch_tensor_methods():
+    T = Tensor
+    # arithmetic operators
+    T.__add__ = _binop("add", jnp.add)
+    T.__radd__ = _binop("add", jnp.add, reverse=True)
+    T.__sub__ = _binop("subtract", jnp.subtract)
+    T.__rsub__ = _binop("subtract", jnp.subtract, reverse=True)
+    T.__mul__ = _binop("multiply", jnp.multiply)
+    T.__rmul__ = _binop("multiply", jnp.multiply, reverse=True)
+    T.__truediv__ = _binop("divide", jnp.true_divide)
+    T.__rtruediv__ = _binop("divide", jnp.true_divide, reverse=True)
+    T.__floordiv__ = _binop("floor_divide", jnp.floor_divide)
+    T.__rfloordiv__ = _binop("floor_divide", jnp.floor_divide, reverse=True)
+    T.__mod__ = _binop("remainder", jnp.remainder)
+    T.__rmod__ = _binop("remainder", jnp.remainder, reverse=True)
+    T.__pow__ = _binop("pow", jnp.power)
+    T.__rpow__ = _binop("pow", jnp.power, reverse=True)
+    T.__matmul__ = lambda self, other: linalg.matmul(self, other)
+    T.__rmatmul__ = lambda self, other: linalg.matmul(Tensor(other), self)
+    T.__neg__ = lambda self: math.neg(self)
+    T.__abs__ = lambda self: math.abs(self)
+    # comparisons (elementwise, like paddle)
+    T.__eq__ = _binop("equal", jnp.equal)
+    T.__ne__ = _binop("not_equal", jnp.not_equal)
+    T.__lt__ = _binop("less_than", jnp.less)
+    T.__le__ = _binop("less_equal", jnp.less_equal)
+    T.__gt__ = _binop("greater_than", jnp.greater)
+    T.__ge__ = _binop("greater_equal", jnp.greater_equal)
+    # bitwise/logical
+    T.__and__ = _binop("bitwise_and", jnp.bitwise_and)
+    T.__or__ = _binop("bitwise_or", jnp.bitwise_or)
+    T.__xor__ = _binop("bitwise_xor", jnp.bitwise_xor)
+    T.__invert__ = lambda self: comparison.bitwise_not(self)
+    T.__lshift__ = _binop("bitwise_left_shift", jnp.left_shift)
+    T.__rshift__ = _binop("bitwise_right_shift", jnp.right_shift)
+    # indexing
+    T.__getitem__ = _getitem
+    T.__setitem__ = _setitem
+
+    # method delegation to ops
+    method_map = {}
+    for ns in _NAMESPACES:
+        for name in dir(ns):
+            if name.startswith("_"):
+                continue
+            fn = getattr(ns, name)
+            if callable(fn) and not isinstance(fn, type):
+                method_map[name] = fn
+    skip = {"einsum", "meshgrid", "zeros", "ones", "full", "arange", "linspace",
+            "eye", "empty", "rand", "randn", "randint", "randperm", "uniform",
+            "normal", "standard_normal", "scatter_nd", "broadcast_tensors",
+            "is_tensor", "logspace", "multi_dot"}
+    for name, fn in method_map.items():
+        if name in skip or hasattr(T, name):
+            continue
+        setattr(T, name, _make_method(fn))
+
+    # explicit overrides / extras
+    T.matmul = lambda self, y, transpose_x=False, transpose_y=False, name=None: \
+        linalg.matmul(self, y, transpose_x, transpose_y)
+    T.reshape = lambda self, shape, name=None: manipulation.reshape(self, shape)
+    T.transpose = lambda self, perm, name=None: manipulation.transpose(self, perm)
+    T.sum = lambda self, axis=None, keepdim=False, dtype=None, name=None: \
+        reduction.sum(self, axis=axis, keepdim=keepdim, dtype=dtype)
+    T.mean = lambda self, axis=None, keepdim=False, name=None: \
+        reduction.mean(self, axis=axis, keepdim=keepdim)
+    T.max = lambda self, axis=None, keepdim=False, name=None: \
+        reduction.max(self, axis=axis, keepdim=keepdim)
+    T.min = lambda self, axis=None, keepdim=False, name=None: \
+        reduction.min(self, axis=axis, keepdim=keepdim)
+    T.add = lambda self, y, name=None: math.add(self, y)
+    T.subtract = lambda self, y, name=None: math.subtract(self, y)
+    T.multiply = lambda self, y, name=None: math.multiply(self, y)
+    T.divide = lambda self, y, name=None: math.divide(self, y)
+    T.pow = lambda self, y, name=None: math.pow(self, y)
+    T.scale = lambda self, scale=1.0, bias=0.0, bias_after_scale=True, act=None, \
+        name=None: math.scale(self, scale, bias, bias_after_scale, act)
+    T.unsqueeze = lambda self, axis, name=None: manipulation.unsqueeze(self, axis)
+    T.squeeze = lambda self, axis=None, name=None: manipulation.squeeze(self, axis)
+    T.flatten = lambda self, start_axis=0, stop_axis=-1, name=None: \
+        manipulation.flatten(self, start_axis, stop_axis)
+    T.mm = lambda self, y, name=None: linalg.matmul(self, y)
+    T.dot = lambda self, y, name=None: linalg.dot(self, y)
+    T.norm = lambda self, p="fro", axis=None, keepdim=False, name=None: \
+        reduction.norm(self, p=p, axis=axis, keepdim=keepdim)
+
+    # in-place variants (functionalized mutation)
+    def _make_inplace(fn):
+        def inplace(self, *args, **kwargs):
+            out = fn(self, *args, **kwargs)
+            self._replace_value(out._value, out._node)
+            if out._node is not None:
+                out._node.register_output(0, self)
+                self.stop_gradient = False
+            return self
+
+        return inplace
+
+    for base in ("add", "subtract", "multiply", "divide", "clip", "scale", "exp",
+                 "sqrt", "rsqrt", "floor", "ceil", "round", "reciprocal", "tanh",
+                 "sigmoid", "abs", "remainder", "pow"):
+        src = getattr(T, base)
+        setattr(T, base + "_", _make_inplace(src))
+
+    def zero_(self):
+        self._replace_value(jnp.zeros_like(self._value))
+        return self
+
+    def fill_(self, value):
+        self._replace_value(jnp.full_like(self._value, value))
+        return self
+
+    T.zero_ = zero_
+    T.fill_ = fill_
+    T.uniform_ = lambda self, min=-1.0, max=1.0, seed=0: (
+        self._replace_value(creation.uniform(self.shape, self.dtype, min, max, seed)._value)
+        or self
+    )
+    T.normal_ = lambda self, mean=0.0, std=1.0: (
+        self._replace_value((creation.randn(self.shape, self.dtype) * std + mean)._value)
+        or self
+    )
+
+
+def _make_method(fn):
+    def method(self, *args, **kwargs):
+        return fn(self, *args, **kwargs)
+
+    method.__name__ = fn.__name__
+    return method
+
+
+_patch_tensor_methods()

@@ -1,0 +1,60 @@
+"""Comparison & logic ops (parity: python/paddle/tensor/logic.py)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from paddle_tpu.core.dispatch import apply
+from paddle_tpu.ops.registry import register_op
+from paddle_tpu.tensor import Tensor
+
+
+def _cmp(name, jax_fn):
+    def op(x, y, name_arg=None):
+        return apply(name, jax_fn, x, y, differentiable=False)
+
+    op.__name__ = name
+    return register_op(name, category="logic", differentiable=False)(op)
+
+
+equal = _cmp("equal", lambda a, b: jnp.equal(a, b))
+not_equal = _cmp("not_equal", lambda a, b: jnp.not_equal(a, b))
+greater_than = _cmp("greater_than", lambda a, b: jnp.greater(a, b))
+greater_equal = _cmp("greater_equal", lambda a, b: jnp.greater_equal(a, b))
+less_than = _cmp("less_than", lambda a, b: jnp.less(a, b))
+less_equal = _cmp("less_equal", lambda a, b: jnp.less_equal(a, b))
+logical_and = _cmp("logical_and", lambda a, b: jnp.logical_and(a, b))
+logical_or = _cmp("logical_or", lambda a, b: jnp.logical_or(a, b))
+logical_xor = _cmp("logical_xor", lambda a, b: jnp.logical_xor(a, b))
+bitwise_and = _cmp("bitwise_and", lambda a, b: jnp.bitwise_and(a, b))
+bitwise_or = _cmp("bitwise_or", lambda a, b: jnp.bitwise_or(a, b))
+bitwise_xor = _cmp("bitwise_xor", lambda a, b: jnp.bitwise_xor(a, b))
+bitwise_left_shift = _cmp("bitwise_left_shift", lambda a, b: jnp.left_shift(a, b))
+bitwise_right_shift = _cmp("bitwise_right_shift", lambda a, b: jnp.right_shift(a, b))
+
+
+@register_op("logical_not", category="logic", differentiable=False)
+def logical_not(x, name=None):
+    return apply("logical_not", jnp.logical_not, x, differentiable=False)
+
+
+@register_op("bitwise_not", category="logic", differentiable=False)
+def bitwise_not(x, name=None):
+    return apply("bitwise_not", jnp.bitwise_not, x, differentiable=False)
+
+
+@register_op("equal_all", category="logic", differentiable=False)
+def equal_all(x, y, name=None):
+    if x.shape != y.shape:
+        return Tensor._from_value(jnp.asarray(False))
+    return apply("equal_all", lambda a, b: jnp.all(a == b), x, y, differentiable=False)
+
+
+@register_op("is_empty", category="logic", differentiable=False)
+def is_empty(x, name=None):
+    return Tensor._from_value(jnp.asarray(x.size == 0))
+
+
+@register_op("is_tensor", category="logic", differentiable=False)
+def is_tensor(x):
+    return isinstance(x, Tensor)

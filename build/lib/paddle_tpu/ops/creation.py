@@ -1,0 +1,236 @@
+"""Tensor creation ops (parity: python/paddle/tensor/creation.py + random.py)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.core.dispatch import apply
+from paddle_tpu.framework import dtype as dtypes
+from paddle_tpu.framework import random as rng
+from paddle_tpu.ops.registry import register_op
+from paddle_tpu.tensor import Tensor
+
+
+def _dt(dtype, default=jnp.float32):
+    d = dtypes.convert_dtype(dtype)
+    return d if d is not None else default
+
+
+def _shape(shape):
+    if isinstance(shape, Tensor):
+        return tuple(int(s) for s in np.asarray(shape._value))
+    if isinstance(shape, (int, np.integer)):
+        return (int(shape),)
+    return tuple(int(s) for s in shape)
+
+
+@register_op("zeros", category="creation")
+def zeros(shape, dtype=None):
+    return Tensor._from_value(jnp.zeros(_shape(shape), dtype=_dt(dtype)))
+
+
+@register_op("ones", category="creation")
+def ones(shape, dtype=None):
+    return Tensor._from_value(jnp.ones(_shape(shape), dtype=_dt(dtype)))
+
+
+@register_op("full", category="creation")
+def full(shape, fill_value, dtype=None):
+    if isinstance(fill_value, Tensor):
+        fill_value = fill_value.item()
+    return Tensor._from_value(jnp.full(_shape(shape), fill_value, dtype=_dt(dtype)))
+
+
+@register_op("empty", category="creation")
+def empty(shape, dtype=None):
+    return zeros(shape, dtype)
+
+
+@register_op("zeros_like", category="creation")
+def zeros_like(x, dtype=None):
+    return Tensor._from_value(jnp.zeros_like(x._value, dtype=dtypes.convert_dtype(dtype)))
+
+
+@register_op("ones_like", category="creation")
+def ones_like(x, dtype=None):
+    return Tensor._from_value(jnp.ones_like(x._value, dtype=dtypes.convert_dtype(dtype)))
+
+
+@register_op("full_like", category="creation")
+def full_like(x, fill_value, dtype=None):
+    return Tensor._from_value(
+        jnp.full_like(x._value, fill_value, dtype=dtypes.convert_dtype(dtype))
+    )
+
+
+@register_op("empty_like", category="creation")
+def empty_like(x, dtype=None):
+    return zeros_like(x, dtype)
+
+
+@register_op("arange", category="creation")
+def arange(start=0, end=None, step=1, dtype=None):
+    if end is None:
+        start, end = 0, start
+    for v in (start, end, step):
+        if isinstance(v, float):
+            dtype = dtype or jnp.float32
+    d = dtypes.convert_dtype(dtype)
+    if d is None:
+        d = jnp.int64
+    return Tensor._from_value(jnp.arange(start, end, step, dtype=d))
+
+
+@register_op("linspace", category="creation")
+def linspace(start, stop, num, dtype=None):
+    return Tensor._from_value(jnp.linspace(start, stop, int(num), dtype=_dt(dtype)))
+
+
+@register_op("logspace", category="creation")
+def logspace(start, stop, num, base=10.0, dtype=None):
+    return Tensor._from_value(jnp.logspace(start, stop, int(num), base=base, dtype=_dt(dtype)))
+
+
+@register_op("eye", category="creation")
+def eye(num_rows, num_columns=None, dtype=None):
+    return Tensor._from_value(jnp.eye(num_rows, num_columns, dtype=_dt(dtype)))
+
+
+@register_op("diag", category="creation")
+def diag(x, offset=0, padding_value=0):
+    def f(v):
+        if v.ndim == 1:
+            out = jnp.diag(v, k=offset)
+            if padding_value != 0:
+                mask = jnp.eye(out.shape[0], out.shape[1], k=offset, dtype=bool)
+                out = jnp.where(mask, out, jnp.asarray(padding_value, out.dtype))
+            return out
+        return jnp.diagonal(v, offset=offset)
+
+    return apply("diag", f, x)
+
+
+@register_op("diagflat", category="creation")
+def diagflat(x, offset=0):
+    return apply("diagflat", lambda v: jnp.diagflat(v, k=offset), x)
+
+
+@register_op("tril", category="creation")
+def tril(x, diagonal=0):
+    return apply("tril", lambda v: jnp.tril(v, k=diagonal), x)
+
+
+@register_op("triu", category="creation")
+def triu(x, diagonal=0):
+    return apply("triu", lambda v: jnp.triu(v, k=diagonal), x)
+
+
+@register_op("meshgrid", category="creation")
+def meshgrid(*args):
+    if len(args) == 1 and isinstance(args[0], (list, tuple)):
+        args = tuple(args[0])
+    outs = jnp.meshgrid(*[a._value for a in args], indexing="ij")
+    return [Tensor._from_value(o) for o in outs]
+
+
+@register_op("assign", category="creation")
+def assign(x, output=None):
+    val = x._value if isinstance(x, Tensor) else jnp.asarray(x)
+    if output is not None:
+        output._replace_value(val)
+        return output
+    return Tensor._from_value(val)
+
+
+@register_op("clone", category="creation")
+def clone(x):
+    return apply("clone", lambda v: v + 0, x)
+
+
+@register_op("tolist", category="creation", differentiable=False)
+def tolist(x):
+    return x.tolist()
+
+
+# ----------------------------------------------------------------- random ops
+@register_op("rand", category="random", differentiable=False)
+def rand(shape, dtype=None):
+    return Tensor._from_value(
+        jax.random.uniform(rng.next_key(), _shape(shape), dtype=_dt(dtype))
+    )
+
+
+@register_op("randn", category="random", differentiable=False)
+def randn(shape, dtype=None):
+    return Tensor._from_value(
+        jax.random.normal(rng.next_key(), _shape(shape), dtype=_dt(dtype))
+    )
+
+
+@register_op("uniform", category="random", differentiable=False)
+def uniform(shape, dtype=None, min=-1.0, max=1.0, seed=0):
+    key = jax.random.key(seed) if seed else rng.next_key()
+    return Tensor._from_value(
+        jax.random.uniform(key, _shape(shape), dtype=_dt(dtype), minval=min, maxval=max)
+    )
+
+
+@register_op("normal", category="random", differentiable=False)
+def normal(mean=0.0, std=1.0, shape=None):
+    if isinstance(mean, Tensor) or isinstance(std, Tensor):
+        m = mean._value if isinstance(mean, Tensor) else mean
+        s = std._value if isinstance(std, Tensor) else std
+        sh = jnp.broadcast_shapes(jnp.shape(m), jnp.shape(s))
+        return Tensor._from_value(jax.random.normal(rng.next_key(), sh) * s + m)
+    return Tensor._from_value(
+        jax.random.normal(rng.next_key(), _shape(shape if shape is not None else [1])) * std + mean
+    )
+
+
+@register_op("randint", category="random", differentiable=False)
+def randint(low=0, high=None, shape=(1,), dtype=None):
+    if high is None:
+        low, high = 0, low
+    d = dtypes.convert_dtype(dtype) or jnp.int64
+    return Tensor._from_value(
+        jax.random.randint(rng.next_key(), _shape(shape), low, high, dtype=d)
+    )
+
+
+@register_op("randperm", category="random", differentiable=False)
+def randperm(n, dtype=None):
+    d = dtypes.convert_dtype(dtype) or jnp.int64
+    return Tensor._from_value(jax.random.permutation(rng.next_key(), n).astype(d))
+
+
+@register_op("bernoulli", category="random", differentiable=False)
+def bernoulli(x):
+    return apply(
+        "bernoulli",
+        lambda v: jax.random.bernoulli(rng.next_key(), v).astype(v.dtype),
+        x,
+        differentiable=False,
+    )
+
+
+@register_op("multinomial", category="random", differentiable=False)
+def multinomial(x, num_samples=1, replacement=False):
+    def f(v):
+        logits = jnp.log(jnp.maximum(v, 1e-30))
+        if replacement:
+            return jax.random.categorical(
+                rng.next_key(), logits, axis=-1, shape=(*v.shape[:-1], num_samples)
+            ).astype(jnp.int64)
+        # without replacement: Gumbel top-k trick
+        g = jax.random.gumbel(rng.next_key(), v.shape)
+        _, idx = jax.lax.top_k(logits + g, num_samples)
+        return idx.astype(jnp.int64)
+
+    return apply("multinomial", f, x, differentiable=False)
+
+
+@register_op("standard_normal", category="random", differentiable=False)
+def standard_normal(shape, dtype=None):
+    return randn(shape, dtype)

@@ -1,0 +1,754 @@
+"""Detection / vision ops from the reference manifest.
+
+Reference kernels: paddle/phi/kernels/{cpu,gpu}/{roi_align,box_coder,yolo_box,
+prior_box,matrix_nms,...}_kernel and legacy fluid detection ops. Geometry ops
+(roi_align, box_coder, yolo_box, prior_box) are differentiable jnp
+compositions; NMS-family ops with data-dependent output shapes run host-side
+numpy, matching the reference's CPU kernels for the same ops.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.core.dispatch import apply
+from paddle_tpu.ops.registry import register_op
+from paddle_tpu.tensor import Tensor
+
+
+def _np_of(t):
+    return np.asarray(t.numpy() if isinstance(t, Tensor) else t)
+
+
+# ------------------------------------------------------------- RoI pooling
+
+
+@register_op("roi_align")
+def roi_align(x, boxes, boxes_num=None, output_size=(1, 1), spatial_scale=1.0,
+              sampling_ratio=-1, aligned=True, name=None):
+    """RoI Align (phi roi_align_kernel): bilinear-sampled average per bin."""
+    out_h, out_w = (output_size if isinstance(output_size, (tuple, list))
+                    else (output_size, output_size))
+
+    def f(feat, rois):
+        n, c, h, w = feat.shape
+        offset = 0.5 if aligned else 0.0
+        x1 = rois[:, 0] * spatial_scale - offset
+        y1 = rois[:, 1] * spatial_scale - offset
+        x2 = rois[:, 2] * spatial_scale - offset
+        y2 = rois[:, 3] * spatial_scale - offset
+        rw = jnp.maximum(x2 - x1, 1e-3 if aligned else 1.0)
+        rh = jnp.maximum(y2 - y1, 1e-3 if aligned else 1.0)
+        bin_h = rh / out_h
+        bin_w = rw / out_w
+        sr = sampling_ratio if sampling_ratio > 0 else 2
+        # sample grid: [R, out_h, sr] y coords, [R, out_w, sr] x coords
+        iy = (jnp.arange(out_h).reshape(1, -1, 1)
+              + (jnp.arange(sr).reshape(1, 1, -1) + 0.5) / sr)
+        ys = y1.reshape(-1, 1, 1) + iy * bin_h.reshape(-1, 1, 1)
+        ix = (jnp.arange(out_w).reshape(1, -1, 1)
+              + (jnp.arange(sr).reshape(1, 1, -1) + 0.5) / sr)
+        xs = x1.reshape(-1, 1, 1) + ix * bin_w.reshape(-1, 1, 1)
+
+        def bilinear(img, yy, xx):
+            # img [c,h,w]; yy [oh,sr]; xx [ow,sr] -> [c, oh, sr, ow, sr]
+            y0 = jnp.floor(yy)
+            x0 = jnp.floor(xx)
+            wy1 = (yy - y0)
+            wx1 = (xx - x0)
+            acc = 0.0
+            for dy, wy in ((0, 1 - wy1), (1, wy1)):
+                for dx, wx in ((0, 1 - wx1), (1, wx1)):
+                    yi = jnp.clip((y0 + dy).astype(jnp.int32), 0, h - 1)
+                    xi = jnp.clip((x0 + dx).astype(jnp.int32), 0, w - 1)
+                    v = img[:, yi][:, :, :, xi]  # [c, oh, sr, ow, sr]
+                    wgt = (wy[:, :, None, None] * wx[None, None, :, :])
+                    acc = acc + v * wgt[None]
+            return acc
+
+        # batch index of each roi: boxes are [R, 4] + boxes_num gives counts
+        if boxes_num is not None:
+            counts = boxes_num._value if isinstance(boxes_num, Tensor) \
+                else jnp.asarray(boxes_num)
+            batch_idx = jnp.repeat(jnp.arange(counts.shape[0]), counts,
+                                   total_repeat_length=rois.shape[0])
+        else:
+            batch_idx = jnp.zeros(rois.shape[0], jnp.int32)
+        imgs = feat[batch_idx]  # [R, c, h, w]
+        sampled = jax.vmap(bilinear)(imgs, ys, xs)  # [R,c,oh,sr,ow,sr]
+        return jnp.mean(sampled, axis=(3, 5))
+
+    return apply("roi_align", f, x, boxes)
+
+
+@register_op("roi_pool")
+def roi_pool(x, boxes, boxes_num=None, output_size=(1, 1), spatial_scale=1.0,
+             name=None):
+    """RoI max pool (phi roi_pool_kernel): integer bins, max per bin —
+    computed with a fixed sample grid + max (dense, XLA-friendly)."""
+    out_h, out_w = (output_size if isinstance(output_size, (tuple, list))
+                    else (output_size, output_size))
+
+    def f(feat, rois):
+        n, c, h, w = feat.shape
+        x1 = jnp.round(rois[:, 0] * spatial_scale)
+        y1 = jnp.round(rois[:, 1] * spatial_scale)
+        x2 = jnp.round(rois[:, 2] * spatial_scale)
+        y2 = jnp.round(rois[:, 3] * spatial_scale)
+        rh = jnp.maximum(y2 - y1 + 1, 1.0)
+        rw = jnp.maximum(x2 - x1 + 1, 1.0)
+        # dense sample grid (2x per bin) then max
+        sr = 4
+        ys = y1.reshape(-1, 1, 1) + (jnp.arange(out_h).reshape(1, -1, 1)
+             + (jnp.arange(sr).reshape(1, 1, -1)) / sr) * (rh / out_h).reshape(-1, 1, 1)
+        xs = x1.reshape(-1, 1, 1) + (jnp.arange(out_w).reshape(1, -1, 1)
+             + (jnp.arange(sr).reshape(1, 1, -1)) / sr) * (rw / out_w).reshape(-1, 1, 1)
+        yi = jnp.clip(ys.astype(jnp.int32), 0, h - 1)
+        xi = jnp.clip(xs.astype(jnp.int32), 0, w - 1)
+        if boxes_num is not None:
+            counts = boxes_num._value if isinstance(boxes_num, Tensor) \
+                else jnp.asarray(boxes_num)
+            batch_idx = jnp.repeat(jnp.arange(counts.shape[0]), counts,
+                                   total_repeat_length=rois.shape[0])
+        else:
+            batch_idx = jnp.zeros(rois.shape[0], jnp.int32)
+        imgs = feat[batch_idx]
+
+        def onebox(img, yy, xx):
+            v = img[:, yy][:, :, :, xx]  # [c, oh, sr, ow, sr]
+            return jnp.max(v, axis=(2, 4))
+
+        return jax.vmap(onebox)(imgs, yi, xi)
+
+    return apply("roi_pool", f, x, boxes)
+
+
+@register_op("psroi_pool")
+def psroi_pool(x, boxes, boxes_num=None, output_size=7, spatial_scale=1.0,
+               output_channels=None, name=None):
+    """Position-sensitive RoI pooling (phi psroi_pool_kernel): channel group
+    (i,j) feeds output bin (i,j); average within bin."""
+    osz = output_size if isinstance(output_size, int) else output_size[0]
+
+    def f(feat, rois):
+        n, c, h, w = feat.shape
+        oc = output_channels or c // (osz * osz)
+        x1 = rois[:, 0] * spatial_scale
+        y1 = rois[:, 1] * spatial_scale
+        rw = jnp.maximum((rois[:, 2] - rois[:, 0]) * spatial_scale, 0.1)
+        rh = jnp.maximum((rois[:, 3] - rois[:, 1]) * spatial_scale, 0.1)
+        sr = 4
+        ys = y1.reshape(-1, 1, 1) + (jnp.arange(osz).reshape(1, -1, 1)
+             + (jnp.arange(sr).reshape(1, 1, -1) + 0.5) / sr) * (rh / osz).reshape(-1, 1, 1)
+        xs = x1.reshape(-1, 1, 1) + (jnp.arange(osz).reshape(1, -1, 1)
+             + (jnp.arange(sr).reshape(1, 1, -1) + 0.5) / sr) * (rw / osz).reshape(-1, 1, 1)
+        yi = jnp.clip(ys.astype(jnp.int32), 0, h - 1)
+        xi = jnp.clip(xs.astype(jnp.int32), 0, w - 1)
+        if boxes_num is not None:
+            counts = boxes_num._value if isinstance(boxes_num, Tensor) \
+                else jnp.asarray(boxes_num)
+            batch_idx = jnp.repeat(jnp.arange(counts.shape[0]), counts,
+                                   total_repeat_length=rois.shape[0])
+        else:
+            batch_idx = jnp.zeros(rois.shape[0], jnp.int32)
+        # regroup channels [oc, osz, osz]
+        imgs = feat[batch_idx].reshape(-1, oc, osz, osz, h, w)
+
+        def onebox(img, yy, xx):
+            # img [oc, osz, osz, h, w]
+            oh = jnp.arange(osz)
+            # bin (i,j) uses channel slice [:, i, j]
+            def bin_ij(i, j):
+                v = img[:, i, j][:, yy[i]][:, :, xx[j]]
+                return jnp.mean(v, axis=(1, 2))
+            rows = jax.vmap(lambda i: jax.vmap(lambda j: bin_ij(i, j))(oh))(oh)
+            return rows.transpose(2, 0, 1)  # [oc, osz, osz]
+
+        return jax.vmap(onebox)(imgs, yi, xi)
+
+    return apply("psroi_pool", f, x, boxes)
+
+
+# ------------------------------------------------------------- box algebra
+
+
+@register_op("box_coder")
+def box_coder(prior_box, prior_box_var, target_box,
+              code_type="encode_center_size", box_normalized=True, axis=0,
+              variance=None, name=None):
+    def f(pb, tb, *pbv_t):
+        pbv = pbv_t[0] if pbv_t else None
+        norm = 0.0 if box_normalized else 1.0
+        pw = pb[:, 2] - pb[:, 0] + norm
+        ph = pb[:, 3] - pb[:, 1] + norm
+        pcx = pb[:, 0] + pw * 0.5
+        pcy = pb[:, 1] + ph * 0.5
+        if code_type.startswith("encode"):
+            tw = tb[:, 2] - tb[:, 0] + norm
+            th = tb[:, 3] - tb[:, 1] + norm
+            tcx = tb[:, 0] + tw * 0.5
+            tcy = tb[:, 1] + th * 0.5
+            out = jnp.stack([(tcx - pcx) / pw, (tcy - pcy) / ph,
+                             jnp.log(tw / pw), jnp.log(th / ph)], -1)
+            if pbv is not None:
+                out = out / pbv
+            elif variance:
+                out = out / jnp.asarray(variance)
+            return out
+        # decode: target_box [N, 4] deltas (axis=0 semantics)
+        d = tb
+        if pbv is not None:
+            d = d * pbv
+        elif variance:
+            d = d * jnp.asarray(variance)
+        ocx = d[..., 0] * pw + pcx
+        ocy = d[..., 1] * ph + pcy
+        ow = jnp.exp(d[..., 2]) * pw
+        oh = jnp.exp(d[..., 3]) * ph
+        return jnp.stack([ocx - ow * 0.5, ocy - oh * 0.5,
+                          ocx + ow * 0.5 - norm, ocy + oh * 0.5 - norm], -1)
+
+    args = (prior_box, target_box) + (
+        (prior_box_var,) if prior_box_var is not None else ())
+    return apply("box_coder", f, *args)
+
+
+@register_op("box_clip")
+def box_clip(input, im_info, name=None):
+    def f(boxes, info):
+        h, w = info[0, 0], info[0, 1]
+        x1 = jnp.clip(boxes[..., 0], 0, w - 1)
+        y1 = jnp.clip(boxes[..., 1], 0, h - 1)
+        x2 = jnp.clip(boxes[..., 2], 0, w - 1)
+        y2 = jnp.clip(boxes[..., 3], 0, h - 1)
+        return jnp.stack([x1, y1, x2, y2], -1)
+
+    return apply("box_clip", f, input, im_info)
+
+
+@register_op("prior_box", differentiable=False)
+def prior_box(input, image, min_sizes, max_sizes=None, aspect_ratios=(1.0,),
+              variances=(0.1, 0.1, 0.2, 0.2), flip=False, clip=False,
+              steps=(0.0, 0.0), offset=0.5, min_max_aspect_ratios_order=False,
+              name=None):
+    """SSD prior boxes (phi prior_box_kernel)."""
+    fh, fw = input.shape[2], input.shape[3]
+    ih, iw = image.shape[2], image.shape[3]
+    step_h = steps[1] or ih / fh
+    step_w = steps[0] or iw / fw
+    ars = [1.0]
+    for ar in aspect_ratios:
+        if not any(abs(ar - e) < 1e-6 for e in ars):
+            ars.append(ar)
+            if flip:
+                ars.append(1.0 / ar)
+    boxes = []
+    for ms in min_sizes:
+        boxes.append((ms, ms))
+        if max_sizes:
+            for xs in max_sizes:
+                boxes.append((np.sqrt(ms * xs), np.sqrt(ms * xs)))
+        for ar in ars:
+            if abs(ar - 1.0) < 1e-6:
+                continue
+            boxes.append((ms * np.sqrt(ar), ms / np.sqrt(ar)))
+    cx = (np.arange(fw) + offset) * step_w
+    cy = (np.arange(fh) + offset) * step_h
+    cxg, cyg = np.meshgrid(cx, cy)
+    out = np.zeros((fh, fw, len(boxes), 4), np.float32)
+    for i, (bw, bh) in enumerate(boxes):
+        out[:, :, i, 0] = (cxg - bw / 2) / iw
+        out[:, :, i, 1] = (cyg - bh / 2) / ih
+        out[:, :, i, 2] = (cxg + bw / 2) / iw
+        out[:, :, i, 3] = (cyg + bh / 2) / ih
+    if clip:
+        out = np.clip(out, 0, 1)
+    var = np.tile(np.asarray(variances, np.float32),
+                  (fh, fw, len(boxes), 1))
+    return (Tensor._from_value(jnp.asarray(out)),
+            Tensor._from_value(jnp.asarray(var)))
+
+
+# ------------------------------------------------------------------- YOLO
+
+
+@register_op("yolo_box")
+def yolo_box(x, img_size, anchors, class_num, conf_thresh=0.01,
+             downsample_ratio=32, clip_bbox=True, scale_x_y=1.0,
+             iou_aware=False, iou_aware_factor=0.5, name=None):
+    """YOLOv3 box decode (phi yolo_box_kernel)."""
+    an = np.asarray(anchors, np.float32).reshape(-1, 2)
+    na = an.shape[0]
+
+    def f(pred, imsz):
+        n, c, h, w = pred.shape
+        stride = 5 + class_num
+        p = pred.reshape(n, na, stride, h, w)
+        gx = jnp.arange(w).reshape(1, 1, 1, w)
+        gy = jnp.arange(h).reshape(1, 1, h, 1)
+        sx = jax.nn.sigmoid(p[:, :, 0]) * scale_x_y - (scale_x_y - 1) / 2
+        sy = jax.nn.sigmoid(p[:, :, 1]) * scale_x_y - (scale_x_y - 1) / 2
+        bx = (gx + sx) / w
+        by = (gy + sy) / h
+        bw = jnp.exp(p[:, :, 2]) * an[:, 0].reshape(1, na, 1, 1) / (w * downsample_ratio)
+        bh = jnp.exp(p[:, :, 3]) * an[:, 1].reshape(1, na, 1, 1) / (h * downsample_ratio)
+        conf = jax.nn.sigmoid(p[:, :, 4])
+        probs = jax.nn.sigmoid(p[:, :, 5:]) * conf[:, :, None]
+        imh = imsz[:, 0].reshape(-1, 1, 1, 1).astype(pred.dtype)
+        imw = imsz[:, 1].reshape(-1, 1, 1, 1).astype(pred.dtype)
+        x1 = (bx - bw / 2) * imw
+        y1 = (by - bh / 2) * imh
+        x2 = (bx + bw / 2) * imw
+        y2 = (by + bh / 2) * imh
+        if clip_bbox:
+            x1 = jnp.clip(x1, 0, imw - 1)
+            y1 = jnp.clip(y1, 0, imh - 1)
+            x2 = jnp.clip(x2, 0, imw - 1)
+            y2 = jnp.clip(y2, 0, imh - 1)
+        boxes = jnp.stack([x1, y1, x2, y2], -1).reshape(n, -1, 4)
+        scores = probs.transpose(0, 1, 3, 4, 2).reshape(n, -1, class_num)
+        keep = conf.reshape(n, -1, 1) >= conf_thresh
+        boxes = jnp.where(keep, boxes, 0.0)
+        scores = jnp.where(keep, scores, 0.0)
+        return boxes, scores
+
+    return apply("yolo_box", f, x, img_size)
+
+
+@register_op("yolo_box_head")
+def yolo_box_head(x, anchors, class_num, name=None):
+    def f(a):
+        return jax.nn.sigmoid(a)
+
+    return apply("yolo_box_head", f, x)
+
+
+@register_op("yolo_box_post", differentiable=False)
+def yolo_box_post(boxes0, boxes1, boxes2, image_shape, image_scale,
+                  anchors0=None, anchors1=None, anchors2=None, class_num=80,
+                  conf_thresh=0.01, downsample_ratio0=32, downsample_ratio1=16,
+                  downsample_ratio2=8, clip_bbox=True, scale_x_y=1.0,
+                  nms_threshold=0.45, name=None):
+    """Multi-scale YOLO postprocess + NMS; host-side (dynamic out shape)."""
+    allb, alls = [], []
+    for t in (boxes0, boxes1, boxes2):
+        v = _np_of(t)
+        allb.append(v[..., :4].reshape(-1, 4))
+        alls.append(v[..., 4:].reshape(-1, v.shape[-1] - 4))
+    bx = np.concatenate(allb)
+    sc = np.concatenate(alls).max(-1)
+    keep = _nms_np(bx, sc, nms_threshold)
+    out = np.concatenate([sc[keep, None], bx[keep]], -1).astype(np.float32)
+    return (Tensor._from_value(jnp.asarray(out)),
+            Tensor._from_value(jnp.asarray(np.asarray([len(keep)], np.int32))))
+
+
+@register_op("yolo_loss")
+def yolo_loss(x, gt_box, gt_label, gt_score=None, anchors=(), anchor_mask=(),
+              class_num=1, ignore_thresh=0.7, downsample_ratio=32,
+              use_label_smooth=True, scale_x_y=1.0, name=None):
+    """YOLOv3 training loss (phi yolo_loss_kernel): coordinate MSE +
+    objectness/class BCE with best-anchor assignment."""
+    an = np.asarray(anchors, np.float32).reshape(-1, 2)
+    mask = list(anchor_mask)
+    na = len(mask)
+
+    def f(pred, gbox, glabel):
+        n, c, h, w = pred.shape
+        stride = 5 + class_num
+        p = pred.reshape(n, na, stride, h, w)
+        input_size = downsample_ratio * h
+        # decode pred xywh in grid units
+        px = jax.nn.sigmoid(p[:, :, 0])
+        py = jax.nn.sigmoid(p[:, :, 1])
+        pw = p[:, :, 2]
+        ph = p[:, :, 3]
+        pobj = p[:, :, 4]
+        pcls = p[:, :, 5:]
+        # gt: [n, B, 4] (cx, cy, w, h) normalized
+        B = gbox.shape[1]
+        gi = jnp.clip((gbox[:, :, 0] * w).astype(jnp.int32), 0, w - 1)
+        gj = jnp.clip((gbox[:, :, 1] * h).astype(jnp.int32), 0, h - 1)
+        # best anchor per gt by IoU of (w,h)
+        gwh = gbox[:, :, 2:4] * input_size  # pixels
+        awh = jnp.asarray(an)  # [A, 2]
+        inter = (jnp.minimum(gwh[:, :, None, 0], awh[None, None, :, 0])
+                 * jnp.minimum(gwh[:, :, None, 1], awh[None, None, :, 1]))
+        union = (gwh[:, :, None, 0] * gwh[:, :, None, 1]
+                 + awh[None, None, :, 0] * awh[None, None, :, 1] - inter)
+        iou = inter / jnp.maximum(union, 1e-9)
+        best = jnp.argmax(iou, -1)  # [n, B] global anchor index
+        valid = (gbox[:, :, 2] > 0) & (gbox[:, :, 3] > 0)
+        loss = jnp.zeros((n,), pred.dtype)
+        for k, m in enumerate(mask):
+            sel = valid & (best == m)  # [n, B]
+            tx = gbox[:, :, 0] * w - gi
+            ty = gbox[:, :, 1] * h - gj
+            tw = jnp.log(jnp.maximum(gwh[:, :, 0] / an[m, 0], 1e-9))
+            th = jnp.log(jnp.maximum(gwh[:, :, 1] / an[m, 1], 1e-9))
+            scale = 2.0 - gbox[:, :, 2] * gbox[:, :, 3]
+            pxk = px[:, k][jnp.arange(n)[:, None], gj, gi]
+            pyk = py[:, k][jnp.arange(n)[:, None], gj, gi]
+            pwk = pw[:, k][jnp.arange(n)[:, None], gj, gi]
+            phk = ph[:, k][jnp.arange(n)[:, None], gj, gi]
+            coord = scale * ((pxk - tx) ** 2 + (pyk - ty) ** 2
+                             + (pwk - tw) ** 2 + (phk - th) ** 2)
+            loss = loss + jnp.sum(jnp.where(sel, coord, 0.0), 1)
+            # objectness target 1 at assigned cells
+            obj_t = jnp.zeros((n, h, w), pred.dtype)
+            obj_t = obj_t.at[jnp.arange(n)[:, None], gj, gi].max(
+                sel.astype(pred.dtype))
+            pob = pobj[:, k]
+            bce = jnp.maximum(pob, 0) - pob * obj_t + jnp.log1p(
+                jnp.exp(-jnp.abs(pob)))
+            loss = loss + jnp.sum(bce, (1, 2))
+            # class loss at assigned cells
+            smooth = 1.0 / class_num if use_label_smooth else 0.0
+            onehot = jax.nn.one_hot(glabel, class_num, dtype=pred.dtype)
+            onehot = onehot * (1 - smooth * class_num) + smooth \
+                if use_label_smooth else onehot
+            pck = pcls[:, k][jnp.arange(n)[:, None], :, gj, gi]  # [n,B,cls]
+            cbce = jnp.maximum(pck, 0) - pck * onehot + jnp.log1p(
+                jnp.exp(-jnp.abs(pck)))
+            loss = loss + jnp.sum(
+                jnp.where(sel[..., None], cbce, 0.0), (1, 2))
+        return loss
+
+    return apply("yolo_loss", f, x, gt_box, gt_label)
+
+
+# ----------------------------------------------------------- NMS variants
+
+
+def _nms_np(boxes, scores, iou_thr, top_k=-1):
+    order = np.argsort(-scores)
+    if top_k > 0:
+        order = order[:top_k]
+    keep = []
+    while order.size:
+        i = order[0]
+        keep.append(i)
+        if order.size == 1:
+            break
+        xx1 = np.maximum(boxes[i, 0], boxes[order[1:], 0])
+        yy1 = np.maximum(boxes[i, 1], boxes[order[1:], 1])
+        xx2 = np.minimum(boxes[i, 2], boxes[order[1:], 2])
+        yy2 = np.minimum(boxes[i, 3], boxes[order[1:], 3])
+        inter = np.maximum(xx2 - xx1, 0) * np.maximum(yy2 - yy1, 0)
+        a1 = (boxes[i, 2] - boxes[i, 0]) * (boxes[i, 3] - boxes[i, 1])
+        a2 = ((boxes[order[1:], 2] - boxes[order[1:], 0])
+              * (boxes[order[1:], 3] - boxes[order[1:], 1]))
+        iou = inter / np.maximum(a1 + a2 - inter, 1e-9)
+        order = order[1:][iou <= iou_thr]
+    return np.asarray(keep, np.int64)
+
+
+@register_op("multiclass_nms3", differentiable=False)
+def multiclass_nms3(bboxes, scores, rois_num=None, score_threshold=0.05,
+                    nms_top_k=1000, keep_top_k=100, nms_threshold=0.45,
+                    normalized=True, nms_eta=1.0, background_label=-1,
+                    name=None):
+    """Per-class NMS (phi multiclass_nms3). Host-side (dynamic shapes)."""
+    bx = _np_of(bboxes)   # [N, M, 4]
+    sc = _np_of(scores)   # [N, C, M]
+    outs, idxs, counts = [], [], []
+    for b in range(bx.shape[0]):
+        dets = []
+        for c in range(sc.shape[1]):
+            if c == background_label:
+                continue
+            m = sc[b, c] > score_threshold
+            if not m.any():
+                continue
+            cand_idx = np.nonzero(m)[0]
+            keep = _nms_np(bx[b][cand_idx], sc[b, c][cand_idx],
+                           nms_threshold, nms_top_k)
+            for k in keep:
+                gi = cand_idx[k]
+                dets.append((c, sc[b, c, gi], *bx[b, gi], gi))
+        dets.sort(key=lambda d: -d[1])
+        dets = dets[:keep_top_k]
+        counts.append(len(dets))
+        for d in dets:
+            outs.append(d[:6])
+            idxs.append(b * bx.shape[1] + d[6])
+    out = np.asarray(outs, np.float32).reshape(-1, 6)
+    return (Tensor._from_value(jnp.asarray(out)),
+            Tensor._from_value(jnp.asarray(np.asarray(idxs, np.int64))),
+            Tensor._from_value(jnp.asarray(np.asarray(counts, np.int32))))
+
+
+@register_op("matrix_nms", differentiable=False)
+def matrix_nms(bboxes, scores, score_threshold=0.05, post_threshold=0.0,
+               nms_top_k=400, keep_top_k=100, use_gaussian=False,
+               gaussian_sigma=2.0, background_label=-1, normalized=True,
+               name=None):
+    """Matrix NMS (phi matrix_nms_kernel): parallel soft-decay of scores."""
+    bx = _np_of(bboxes)
+    sc = _np_of(scores)
+    outs, idxs, counts = [], [], []
+    for b in range(bx.shape[0]):
+        dets = []
+        for c in range(sc.shape[1]):
+            if c == background_label:
+                continue
+            m = sc[b, c] > score_threshold
+            if not m.any():
+                continue
+            cand = np.nonzero(m)[0]
+            s = sc[b, c][cand]
+            order = np.argsort(-s)[:nms_top_k]
+            cand, s = cand[order], s[order]
+            bb = bx[b][cand]
+            # pairwise IoU (upper triangle: j suppressed by i<j)
+            x1 = np.maximum(bb[:, None, 0], bb[None, :, 0])
+            y1 = np.maximum(bb[:, None, 1], bb[None, :, 1])
+            x2 = np.minimum(bb[:, None, 2], bb[None, :, 2])
+            y2 = np.minimum(bb[:, None, 3], bb[None, :, 3])
+            inter = np.maximum(x2 - x1, 0) * np.maximum(y2 - y1, 0)
+            area = (bb[:, 2] - bb[:, 0]) * (bb[:, 3] - bb[:, 1])
+            iou = inter / np.maximum(area[:, None] + area[None] - inter, 1e-9)
+            iou = np.triu(iou, 1)
+            max_iou = iou.max(0)  # per j: worst overlap with higher-scored
+            comp = iou.max(1, initial=0)
+            if use_gaussian:
+                decay = np.exp(-(iou ** 2 - comp[:, None] ** 2) / gaussian_sigma).min(0)
+            else:
+                decay = ((1 - iou) / np.maximum(1 - comp[:, None], 1e-9)).min(0)
+            s2 = s * decay
+            for k in range(len(cand)):
+                if s2[k] >= post_threshold:
+                    dets.append((c, s2[k], *bb[k], cand[k]))
+        dets.sort(key=lambda d: -d[1])
+        dets = dets[:keep_top_k]
+        counts.append(len(dets))
+        for d in dets:
+            outs.append(d[:6])
+            idxs.append(d[6])
+    out = np.asarray(outs, np.float32).reshape(-1, 6)
+    return (Tensor._from_value(jnp.asarray(out)),
+            Tensor._from_value(jnp.asarray(np.asarray(counts, np.int32))),
+            Tensor._from_value(jnp.asarray(np.asarray(idxs, np.int64))))
+
+
+@register_op("generate_proposals", differentiable=False)
+def generate_proposals(scores, bbox_deltas, im_shape, anchors, variances,
+                       pre_nms_top_n=6000, post_nms_top_n=1000,
+                       nms_thresh=0.5, min_size=0.1, eta=1.0,
+                       pixel_offset=False, name=None):
+    """RPN proposal generation (phi generate_proposals_v2): decode deltas on
+    anchors, clip, filter small, NMS. Host-side."""
+    sc = _np_of(scores)        # [N, A, H, W]
+    bd = _np_of(bbox_deltas)   # [N, A*4, H, W]
+    ims = _np_of(im_shape)     # [N, 2]
+    an = _np_of(anchors).reshape(-1, 4)
+    var = _np_of(variances).reshape(-1, 4)
+    n = sc.shape[0]
+    rois, roi_scores, counts = [], [], []
+    for b in range(n):
+        s = sc[b].transpose(1, 2, 0).reshape(-1)
+        d = bd[b].reshape(-1, 4, sc.shape[2], sc.shape[3]) \
+              .transpose(2, 3, 0, 1).reshape(-1, 4)
+        order = np.argsort(-s)[:pre_nms_top_n]
+        s, d, a, v = s[order], d[order], an[order % an.shape[0]], var[order % an.shape[0]]
+        aw = a[:, 2] - a[:, 0] + (0 if not pixel_offset else 1)
+        ah = a[:, 3] - a[:, 1] + (0 if not pixel_offset else 1)
+        acx = a[:, 0] + aw / 2
+        acy = a[:, 1] + ah / 2
+        cx = v[:, 0] * d[:, 0] * aw + acx
+        cy = v[:, 1] * d[:, 1] * ah + acy
+        w = np.exp(np.minimum(v[:, 2] * d[:, 2], 10)) * aw
+        h = np.exp(np.minimum(v[:, 3] * d[:, 3], 10)) * ah
+        boxes = np.stack([cx - w / 2, cy - h / 2, cx + w / 2, cy + h / 2], -1)
+        boxes[:, 0::2] = np.clip(boxes[:, 0::2], 0, ims[b, 1] - 1)
+        boxes[:, 1::2] = np.clip(boxes[:, 1::2], 0, ims[b, 0] - 1)
+        keep_sz = ((boxes[:, 2] - boxes[:, 0] >= min_size)
+                   & (boxes[:, 3] - boxes[:, 1] >= min_size))
+        boxes, s = boxes[keep_sz], s[keep_sz]
+        keep = _nms_np(boxes, s, nms_thresh)[:post_nms_top_n]
+        rois.append(boxes[keep])
+        roi_scores.append(s[keep])
+        counts.append(len(keep))
+    return (Tensor._from_value(jnp.asarray(np.concatenate(rois).astype(np.float32))),
+            Tensor._from_value(jnp.asarray(np.concatenate(roi_scores).astype(np.float32))),
+            Tensor._from_value(jnp.asarray(np.asarray(counts, np.int32))))
+
+
+@register_op("bipartite_match", differentiable=False)
+def bipartite_match(dist_mat, match_type="bipartite", dist_threshold=0.5,
+                    name=None):
+    """Greedy bipartite matching (fluid bipartite_match_op). Host-side."""
+    d = _np_of(dist_mat).copy()  # [rows(pred), cols(gt)] — per batch flat
+    rows, cols = d.shape
+    match_idx = np.full(cols, -1, np.int64)
+    match_dist = np.zeros(cols, np.float32)
+    used_r, used_c = set(), set()
+    while len(used_c) < min(rows, cols):
+        flat = np.argmax(np.where(
+            np.isin(np.arange(rows), list(used_r)).reshape(-1, 1)
+            | np.isin(np.arange(cols), list(used_c)).reshape(1, -1),
+            -np.inf, d))
+        r, c = divmod(int(flat), cols)
+        if d[r, c] <= 0:
+            break
+        match_idx[c] = r
+        match_dist[c] = d[r, c]
+        used_r.add(r)
+        used_c.add(c)
+    if match_type == "per_prediction":
+        for c in range(cols):
+            if match_idx[c] == -1:
+                r = int(np.argmax(d[:, c]))
+                if d[r, c] >= dist_threshold:
+                    match_idx[c] = r
+                    match_dist[c] = d[r, c]
+    return (Tensor._from_value(jnp.asarray(match_idx.reshape(1, -1))),
+            Tensor._from_value(jnp.asarray(match_dist.reshape(1, -1))))
+
+
+@register_op("detection_map", differentiable=False)
+def detection_map(detect_res, label, has_state=None, pos_count=None,
+                  true_pos=None, false_pos=None, overlap_threshold=0.5,
+                  class_num=None, background_label=0, evaluate_difficult=True,
+                  ap_type="integral", name=None):
+    """mAP metric (fluid detection_map_op). Host-side simplified single-batch
+    AP: per class, match detections to gt by IoU, integrate PR."""
+    det = _np_of(detect_res)  # [M, 6] label, score, x1,y1,x2,y2
+    gt = _np_of(label)        # [G, 6] label, x1..y2(,difficult)
+    classes = np.unique(gt[:, 0]).astype(int)
+    aps = []
+    for c in classes:
+        if c == background_label:
+            continue
+        dc = det[det[:, 0] == c]
+        gc = gt[gt[:, 0] == c]
+        if len(gc) == 0:
+            continue
+        order = np.argsort(-dc[:, 1])
+        dc = dc[order]
+        matched = np.zeros(len(gc), bool)
+        tp = np.zeros(len(dc))
+        fp = np.zeros(len(dc))
+        for i, dd in enumerate(dc):
+            best, bj = 0.0, -1
+            for j, gg in enumerate(gc):
+                x1 = max(dd[2], gg[1]); y1 = max(dd[3], gg[2])
+                x2 = min(dd[4], gg[3]); y2 = min(dd[5], gg[4])
+                inter = max(x2 - x1, 0) * max(y2 - y1, 0)
+                a1 = (dd[4] - dd[2]) * (dd[5] - dd[3])
+                a2 = (gg[3] - gg[1]) * (gg[4] - gg[2])
+                iou = inter / max(a1 + a2 - inter, 1e-9)
+                if iou > best:
+                    best, bj = iou, j
+            if best >= overlap_threshold and not matched[bj]:
+                tp[i] = 1
+                matched[bj] = True
+            else:
+                fp[i] = 1
+        ctp = np.cumsum(tp)
+        cfp = np.cumsum(fp)
+        rec = ctp / len(gc)
+        prec = ctp / np.maximum(ctp + cfp, 1e-9)
+        ap = 0.0
+        for t in np.arange(0, 1.01, 0.1) if ap_type == "11point" else [None]:
+            if ap_type == "11point":
+                p = prec[rec >= t].max() if (rec >= t).any() else 0
+                ap += p / 11
+            else:
+                for i in range(len(rec)):
+                    ap += prec[i] * (rec[i] - (rec[i - 1] if i else 0))
+        aps.append(ap)
+    m = float(np.mean(aps)) if aps else 0.0
+    return Tensor._from_value(jnp.asarray(m, jnp.float32))
+
+
+@register_op("ctc_align", differentiable=False)
+def ctc_align(input, input_length=None, blank=0, merge_repeated=True,
+              padding_value=0, name=None):
+    """CTC decode alignment (fluid ctc_align_op): collapse repeats, drop
+    blanks; padded output."""
+    ids = _np_of(input)
+    lens = (_np_of(input_length).reshape(-1) if input_length is not None
+            else np.full(ids.shape[0], ids.shape[1]))
+    out = np.full_like(ids, padding_value)
+    out_lens = np.zeros(ids.shape[0], np.int64)
+    for b in range(ids.shape[0]):
+        prev = None
+        k = 0
+        for t in range(int(lens[b])):
+            v = ids[b, t]
+            if v != blank and not (merge_repeated and prev == v):
+                out[b, k] = v
+                k += 1
+            prev = v
+        out_lens[b] = k
+    return (Tensor._from_value(jnp.asarray(out)),
+            Tensor._from_value(jnp.asarray(out_lens)))
+
+
+@register_op("crf_decoding", differentiable=False)
+def crf_decoding(emission, transition, label=None, length=None, name=None):
+    """Linear-chain CRF Viterbi decode (phi crf_decoding kernel) via
+    paddle_tpu.text.viterbi_decode."""
+    from paddle_tpu.text import viterbi_decode
+    em = emission if emission._value.ndim == 3 else \
+        Tensor._from_value(emission._value[None])
+    # transition: rows 0/1 are start/stop in fluid layout
+    trans = Tensor._from_value(transition._value[2:])
+    lens = length if length is not None else Tensor._from_value(
+        jnp.full((em._value.shape[0],), em._value.shape[1], jnp.int64))
+    scores, path = viterbi_decode(em, trans, lens)
+    return path
+
+
+@register_op("chunk_eval", differentiable=False)
+def chunk_eval(inference, label, seq_length=None, num_chunk_types=1,
+               chunk_scheme="IOB", excluded_chunk_types=None, name=None):
+    """Chunking precision/recall/F1 (fluid chunk_eval_op). Host-side IOB
+    chunk extraction."""
+    def chunks(tags):
+        res = []
+        start = None
+        cur_type = None
+        n_types = num_chunk_types
+        for i, t in enumerate(tags):
+            t = int(t)
+            if chunk_scheme == "IOB":
+                # tag = type*2 (B) / type*2+1 (I); last id = O
+                if t == n_types * 2:
+                    tag_type, flag = None, "O"
+                else:
+                    tag_type, flag = t // 2, ("B" if t % 2 == 0 else "I")
+                if flag == "B" or (flag == "I" and tag_type != cur_type):
+                    if start is not None:
+                        res.append((start, i, cur_type))
+                    start, cur_type = (i, tag_type) if flag != "O" else (None, None)
+                elif flag == "O":
+                    if start is not None:
+                        res.append((start, i, cur_type))
+                    start, cur_type = None, None
+        if start is not None:
+            res.append((start, len(tags), cur_type))
+        return set(res)
+
+    inf = _np_of(inference)
+    lab = _np_of(label)
+    lens = (_np_of(seq_length).reshape(-1) if seq_length is not None
+            else np.full(inf.shape[0], inf.shape[-1]))
+    tp = n_inf = n_lab = 0
+    inf2 = inf.reshape(len(lens), -1)
+    lab2 = lab.reshape(len(lens), -1)
+    for b in range(len(lens)):
+        ci = chunks(inf2[b, :int(lens[b])])
+        cl = chunks(lab2[b, :int(lens[b])])
+        tp += len(ci & cl)
+        n_inf += len(ci)
+        n_lab += len(cl)
+    prec = tp / n_inf if n_inf else 0.0
+    rec = tp / n_lab if n_lab else 0.0
+    f1 = 2 * prec * rec / (prec + rec) if prec + rec else 0.0
+    mk = lambda v, dt=jnp.float32: Tensor._from_value(jnp.asarray(v, dt))
+    return (mk(prec), mk(rec), mk(f1), mk(n_inf, jnp.int64),
+            mk(n_lab, jnp.int64), mk(tp, jnp.int64))

@@ -1,0 +1,556 @@
+"""Fused ops from fused_ops.yaml, expressed as compositions.
+
+On GPU the reference hand-writes these as single CUDA kernels
+(paddle/phi/kernels/fusion/gpu/); on TPU the idiomatic equivalent is a jnp
+composition that XLA fuses — the op exists so every fused_ops.yaml entry has
+a callable with the same contract. Attention-family entries route to the
+Pallas flash kernels (ops/pallas/).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.core.dispatch import apply
+from paddle_tpu.ops.registry import register_op
+from paddle_tpu.tensor import Tensor
+
+# ----------------------------------------------------------- linear family
+
+
+@register_op("fc")
+def fc(input, w, bias=None, in_num_col_dims=1, activation_type="", name=None):
+    def f(*args):
+        a, wt = args[0], args[1]
+        a2 = a.reshape((int(np.prod(a.shape[:in_num_col_dims])), -1))
+        out = a2 @ wt
+        if len(args) > 2:
+            out = out + args[2]
+        if activation_type == "relu":
+            out = jax.nn.relu(out)
+        return out.reshape(a.shape[:in_num_col_dims] + (wt.shape[1],))
+
+    args = (input, w) + ((bias,) if bias is not None else ())
+    return apply("fc", f, *args)
+
+
+@register_op("gemm_epilogue")
+def gemm_epilogue(x, y, bias, trans_x=False, trans_y=False, activation="none",
+                  name=None):
+    """cuBLASLt epilogue-fused GEMM analogue (matmul+bias+act in one XLA
+    fusion)."""
+    def f(a, b, c):
+        if trans_x:
+            a = jnp.swapaxes(a, -1, -2)
+        if trans_y:
+            b = jnp.swapaxes(b, -1, -2)
+        out = a @ b + c
+        if activation in ("relu",):
+            out = jax.nn.relu(out)
+        elif activation in ("gelu",):
+            out = jax.nn.gelu(out)
+        return out
+
+    return apply("gemm_epilogue", f, x, y, bias)
+
+
+@register_op("fused_linear_param_grad_add")
+def fused_linear_param_grad_add(x, dout, dweight=None, dbias=None,
+                                multi_precision=True, has_bias=True,
+                                name=None):
+    """dW += x^T @ dout; db += sum(dout) in one pass (reference:
+    fused_linear_param_grad_add_kernel)."""
+    def f(a, d, *accs):
+        a2 = a.reshape(-1, a.shape[-1])
+        d2 = d.reshape(-1, d.shape[-1])
+        dw = a2.T @ d2
+        db = jnp.sum(d2, 0)
+        if accs:
+            dw = dw + accs[0]
+            if len(accs) > 1:
+                db = db + accs[1]
+        return (dw, db) if has_bias else (dw,)
+
+    accs = tuple(t for t in (dweight, dbias) if t is not None)
+    return apply("fused_linear_param_grad_add", f, x, dout, *accs)
+
+
+# ------------------------------------------------------- elementwise fusion
+
+
+def _fused_eltwise(opname, fn):
+    @register_op(opname)
+    def op(x, y, axis=-1, scale=1.0, name=None):
+        return apply(opname, fn, x, y)
+
+    op.__name__ = opname
+    return op
+
+
+fused_elementwise_add = _fused_eltwise("fused_elementwise_add", jnp.add)
+fused_elementwise_sub = _fused_eltwise("fused_elementwise_sub", jnp.subtract)
+fused_elementwise_mul = _fused_eltwise("fused_elementwise_mul", jnp.multiply)
+fused_elementwise_div = _fused_eltwise("fused_elementwise_div", jnp.true_divide)
+
+_ACTS = {"relu": jax.nn.relu, "gelu": jax.nn.gelu, "sigmoid": jax.nn.sigmoid,
+         "tanh": jnp.tanh, "": lambda v: v, "none": lambda v: v,
+         "scale": lambda v: v, "add": None}
+
+
+@register_op("fused_elemwise_activation")
+def fused_elemwise_activation(x, y, functor_list=("elementwise_add", "relu"),
+                              axis=-1, scale=0.0, save_intermediate_out=False,
+                              name=None):
+    def f(a, b):
+        inter = a + b if "add" in functor_list[0] else a * b
+        act = next((v for k, v in _ACTS.items() if k and k in functor_list[1]),
+                   lambda v: v)
+        out = act(inter)
+        return (out, inter) if save_intermediate_out else out
+
+    return apply("fused_elemwise_activation", f, x, y)
+
+
+@register_op("fused_elemwise_add_activation")
+def fused_elemwise_add_activation(x, y, functor_list=("elementwise_add", "relu"),
+                                  axis=-1, scale=0.0,
+                                  save_intermediate_out=False, name=None):
+    return fused_elemwise_activation(x, y, functor_list, axis, scale,
+                                     save_intermediate_out)
+
+
+@register_op("fused_dropout_add")
+def fused_dropout_add(x, y, p=0.5, training=True, mode="upscale_in_train",
+                      seed=None, name=None):
+    from paddle_tpu.nn import functional as F
+    dropped = F.dropout(x, p=p, training=training, mode=mode)
+    return apply("fused_dropout_add", jnp.add, dropped, y)
+
+
+# ------------------------------------------------------------- norm fusion
+
+
+@register_op("skip_layernorm")
+def skip_layernorm(x, y, scale, bias, epsilon=1e-5, begin_norm_axis=-1,
+                   name=None):
+    def f(a, b, s, bb):
+        h = a + b
+        mu = jnp.mean(h, -1, keepdims=True)
+        var = jnp.var(h, -1, keepdims=True)
+        return (h - mu) / jnp.sqrt(var + epsilon) * s + bb
+
+    return apply("skip_layernorm", f, x, y, scale, bias)
+
+
+@register_op("fused_bias_dropout_residual_layer_norm")
+def fused_bias_dropout_residual_layer_norm(
+        x, residual, bias=None, ln_scale=None, ln_bias=None,
+        dropout_rate=0.5, ln_epsilon=1e-5, is_test=False, name=None):
+    from paddle_tpu.nn import functional as F
+    h = x if bias is None else apply("bias_add", jnp.add, x, bias)
+    h = F.dropout(h, p=dropout_rate, training=not is_test)
+    h = apply("residual_add", jnp.add, h, residual)
+    return F.layer_norm(h, normalized_shape=h.shape[-1:],
+                        weight=ln_scale, bias=ln_bias, epsilon=ln_epsilon)
+
+
+@register_op("fused_embedding_eltwise_layernorm")
+def fused_embedding_eltwise_layernorm(ids, embs, bias, scale, epsilon=1e-5,
+                                      name=None):
+    def f(b, s, *args):
+        k = len(args) // 2
+        idv, embv = args[:k], args[k:]
+        h = sum(e[i] for i, e in zip(idv, embv))
+        mu = jnp.mean(h, -1, keepdims=True)
+        var = jnp.var(h, -1, keepdims=True)
+        return (h - mu) / jnp.sqrt(var + epsilon) * s + b
+
+    return apply("fused_embedding_eltwise_layernorm", f, bias, scale,
+                 *ids, *embs)
+
+
+@register_op("fused_fc_elementwise_layernorm")
+def fused_fc_elementwise_layernorm(x, w, y, bias0=None, scale=None, bias1=None,
+                                   x_num_col_dims=1, epsilon=1e-5,
+                                   begin_norm_axis=1, name=None):
+    h = fc(x, w, bias0, in_num_col_dims=x_num_col_dims)
+    def f(a, b, *sb):
+        v = a + b
+        mu = jnp.mean(v, -1, keepdims=True)
+        var = jnp.var(v, -1, keepdims=True)
+        out = (v - mu) / jnp.sqrt(var + epsilon)
+        if sb:
+            out = out * sb[0] + (sb[1] if len(sb) > 1 else 0.0)
+        return out
+
+    sb = tuple(t for t in (scale, bias1) if t is not None)
+    return apply("fused_fc_elementwise_layernorm", f, h, y, *sb)
+
+
+@register_op("fused_batch_norm_act")
+def fused_batch_norm_act(x, mean, variance, scale, bias, momentum=0.9,
+                         epsilon=1e-5, act_type="relu", name=None):
+    from paddle_tpu.nn import functional as F
+    out = F.batch_norm(x, mean, variance, scale, bias, training=True,
+                       momentum=momentum, epsilon=epsilon)
+    return apply("bn_act", _ACTS.get(act_type, jax.nn.relu), out)
+
+
+@register_op("fused_bn_add_activation")
+def fused_bn_add_activation(x, z, mean, variance, scale, bias, momentum=0.9,
+                            epsilon=1e-5, act_type="relu", name=None):
+    from paddle_tpu.nn import functional as F
+    out = F.batch_norm(x, mean, variance, scale, bias, training=True,
+                       momentum=momentum, epsilon=epsilon)
+    out = apply("bn_add", jnp.add, out, z)
+    return apply("bn_act", _ACTS.get(act_type, jax.nn.relu), out)
+
+
+@register_op("fused_conv2d_add_act")
+def fused_conv2d_add_act(input, filter, bias=None, residual=None, strides=1,
+                         paddings=0, dilations=1, groups=1, activation="relu",
+                         data_format="NCHW", name=None):
+    from paddle_tpu.nn import functional as F
+    out = F.conv2d(input, filter, bias, stride=strides, padding=paddings,
+                   dilation=dilations, groups=groups, data_format=data_format)
+    if residual is not None:
+        out = apply("conv_res_add", jnp.add, out, residual)
+    return apply("conv_act", _ACTS.get(activation, jax.nn.relu), out)
+
+
+@register_op("fused_scale_bias_add_relu")
+def fused_scale_bias_add_relu(x1, scale1, bias1, x2, scale2=None, bias2=None,
+                              fuse_dual=False, exhaustive_search=False,
+                              name=None):
+    def f(*args):
+        a, s1, b1, c = args[:4]
+        out = a * s1 + b1
+        if fuse_dual and len(args) > 4:
+            out = out + (c * args[4] + args[5])
+        else:
+            out = out + c
+        return jax.nn.relu(out)
+
+    args = (x1, scale1, bias1, x2) + (
+        (scale2, bias2) if fuse_dual and scale2 is not None else ())
+    return apply("fused_scale_bias_add_relu", f, *args)
+
+
+@register_op("add_group_norm_silu")
+def add_group_norm_silu(x, residual=None, scale=None, bias=None, groups=32,
+                        epsilon=1e-5, activation="silu", name=None):
+    from paddle_tpu.nn import functional as F
+    h = x if residual is None else apply("gn_add", jnp.add, x, residual)
+    out = F.group_norm(h, num_groups=groups, weight=scale, bias=bias,
+                       epsilon=epsilon)
+    if activation == "silu":
+        out = apply("gn_silu", jax.nn.silu, out)
+    return out
+
+
+@register_op("squeeze_excitation_block")
+def squeeze_excitation_block(x, filter_squeeze, filter_excitation,
+                             act_type=("relu", "sigmoid"), name=None):
+    """SE block (squeeze -> 1x1 reduce -> act -> 1x1 expand -> gate)."""
+    def f(a, ws, we):
+        se = jnp.mean(a, axis=(2, 3), keepdims=True)
+        se = jax.nn.relu(jax.lax.conv_general_dilated(
+            se, ws, (1, 1), "VALID",
+            dimension_numbers=("NCHW", "OIHW", "NCHW")))
+        se = jax.nn.sigmoid(jax.lax.conv_general_dilated(
+            se, we, (1, 1), "VALID",
+            dimension_numbers=("NCHW", "OIHW", "NCHW")))
+        return a * se
+
+    return apply("squeeze_excitation_block", f, x, filter_squeeze,
+                 filter_excitation)
+
+
+# -------------------------------------------------------------- attention
+
+
+@register_op("fused_softmax_mask")
+def fused_softmax_mask(x, mask, name=None):
+    return apply("fused_softmax_mask",
+                 lambda a, m: jax.nn.softmax(a + m, axis=-1), x, mask)
+
+
+@register_op("fused_softmax_mask_upper_triangle")
+def fused_softmax_mask_upper_triangle(x, name=None):
+    def f(a):
+        s = a.shape[-1]
+        causal = jnp.tril(jnp.ones((s, s), bool))
+        return jax.nn.softmax(jnp.where(causal, a, -1e9), axis=-1)
+
+    return apply("fused_softmax_mask_upper_triangle", f, x)
+
+
+@register_op("multihead_matmul")
+def multihead_matmul(input, w, bias, bias_qk=None, transpose_q=False,
+                     transpose_k=True, transpose_v=False, alpha=1.0,
+                     head_number=1, name=None):
+    """TensorRT-era fused QKV attention (qkv packed in one weight)."""
+    def f(*args):
+        a, wt, b = args[0], args[1], args[2]
+        bqk = args[3] if len(args) > 3 else None
+        bsz, seq, hidden = a.shape
+        qkv = a @ wt.reshape(hidden, -1) + b.reshape(-1)
+        q, k, v = jnp.split(qkv.reshape(bsz, seq, 3, -1), 3, axis=2)
+        hd = q.shape[-1] // head_number
+        resh = lambda t: t.reshape(bsz, seq, head_number, hd).transpose(0, 2, 1, 3)
+        q, k, v = resh(q[:, :, 0]), resh(k[:, :, 0]), resh(v[:, :, 0])
+        scores = (q @ k.transpose(0, 1, 3, 2)) * alpha
+        if bqk is not None:
+            scores = scores + bqk
+        probs = jax.nn.softmax(scores, -1)
+        out = (probs @ v).transpose(0, 2, 1, 3).reshape(bsz, seq, -1)
+        return out
+
+    args = (input, w, bias) + ((bias_qk,) if bias_qk is not None else ())
+    return apply("multihead_matmul", f, *args)
+
+
+@register_op("fused_dot_product_attention")
+def fused_dot_product_attention(q, k, v, mask=None, scaling_factor=None,
+                                dropout_probability=0.0, is_training=True,
+                                is_causal_masking=False, name=None):
+    from paddle_tpu.ops.pallas.flash_attention import scaled_dot_product_attention
+    return scaled_dot_product_attention(q, k, v, attn_mask=mask,
+                                        is_causal=is_causal_masking)
+
+
+@register_op("flash_attn")
+def flash_attn(q, k, v, fixed_seed_offset=None, attn_mask=None,
+               dropout=0.0, causal=False, return_softmax=False, name=None):
+    from paddle_tpu.ops.pallas.flash_attention import flash_attention
+    return flash_attention(q, k, v, causal=causal)
+
+
+@register_op("flash_attn_qkvpacked")
+def flash_attn_qkvpacked(qkv, fixed_seed_offset=None, attn_mask=None,
+                         dropout=0.0, causal=False, return_softmax=False,
+                         name=None):
+    from paddle_tpu.ops.pallas.flash_attention import flash_attention
+    qs, ks, vs = (Tensor._from_value(qkv._value[:, :, i]) for i in range(3))
+    return flash_attention(qs, ks, vs, causal=causal)
+
+
+@register_op("flash_attn_unpadded")
+def flash_attn_unpadded(q, k, v, cu_seqlens_q, cu_seqlens_k, max_seqlen_q,
+                        max_seqlen_k, scale=None, dropout=0.0, causal=False,
+                        return_softmax=False, name=None):
+    from paddle_tpu.ops.pallas.flash_attention import flash_attn_unpadded as fu
+    return fu(q, k, v, cu_seqlens_q, cu_seqlens_k, max_seqlen_q, max_seqlen_k,
+              scale=scale, causal=causal)
+
+
+@register_op("flash_attn_varlen_qkvpacked")
+def flash_attn_varlen_qkvpacked(qkv, cu_seqlens_q, cu_seqlens_k, max_seqlen_q,
+                                max_seqlen_k, scale=None, dropout=0.0,
+                                causal=False, return_softmax=False, name=None):
+    qs, ks, vs = (Tensor._from_value(qkv._value[:, i]) for i in range(3))
+    from paddle_tpu.ops.pallas.flash_attention import flash_attn_unpadded as fu
+    return fu(qs, ks, vs, cu_seqlens_q, cu_seqlens_k, max_seqlen_q,
+              max_seqlen_k, scale=scale, causal=causal)
+
+
+@register_op("flash_attn_with_sparse_mask")
+def flash_attn_with_sparse_mask(q, k, v, attn_mask_start_row_indices,
+                                dropout=0.0, causal=False,
+                                attn_mask_start_row=0, return_softmax=False,
+                                name=None):
+    """Sparse-row-mask flash attention: rows before start_row_indices[b,h,col]
+    are masked out. Computed as dense attention with the expanded mask (XLA
+    fuses); parity target is the capability, not the CUDA kernel."""
+    def f(qv, kv, vv, srv):
+        b, s, h, d = qv.shape
+        qh = qv.transpose(0, 2, 1, 3)
+        kh = kv.transpose(0, 2, 1, 3)
+        vh = vv.transpose(0, 2, 1, 3)
+        scores = qh @ kh.transpose(0, 1, 3, 2) / np.sqrt(d)
+        rows = jnp.arange(s).reshape(1, 1, s, 1)
+        mask = rows >= srv[:, :, None, :]  # mask rows >= start_row (per col)
+        if causal:
+            mask = mask | (rows < jnp.arange(s).reshape(1, 1, 1, s))
+        scores = jnp.where(mask, -1e9, scores)
+        out = jax.nn.softmax(scores, -1) @ vh
+        return out.transpose(0, 2, 1, 3)
+
+    return apply("flash_attn_with_sparse_mask", f, q, k, v,
+                 attn_mask_start_row_indices)
+
+
+@register_op("memory_efficient_attention")
+def memory_efficient_attention(query, key, value, bias=None, cu_seqlens_q=None,
+                               cu_seqlens_k=None, causal=False, dropout_p=0.0,
+                               scale=None, training=True, name=None):
+    from paddle_tpu.ops.pallas.flash_attention import scaled_dot_product_attention
+    return scaled_dot_product_attention(query, key, value, attn_mask=bias,
+                                        is_causal=causal)
+
+
+@register_op("variable_length_memory_efficient_attention")
+def variable_length_memory_efficient_attention(query, key, value, seq_lens,
+                                               kv_seq_lens, mask=None,
+                                               scale=None, causal=False,
+                                               pre_cache_length=0, name=None):
+    """Variable-length attention over [B, H, S, D] layout with per-batch
+    lengths (reference: variable_length_memory_efficient_attention_kernel)."""
+    def f(qv, kv, vv, sl, kl, *mm):
+        b, h, s, d = qv.shape
+        sc = scale if scale is not None else 1.0 / np.sqrt(d)
+        scores = qv @ kv.transpose(0, 1, 3, 2) * sc
+        cols = jnp.arange(kv.shape[2]).reshape(1, 1, 1, -1)
+        valid = cols < kl.reshape(-1, 1, 1, 1)
+        if mm:
+            scores = scores + mm[0]
+        if causal:
+            rows = jnp.arange(s).reshape(1, 1, s, 1)
+            valid = valid & (cols <= rows)
+        scores = jnp.where(valid, scores, -1e9)
+        return jax.nn.softmax(scores, -1) @ vv
+
+    args = (query, key, value, seq_lens, kv_seq_lens) + (
+        (mask,) if mask is not None else ())
+    return apply("variable_length_memory_efficient_attention", f, *args)
+
+
+@register_op("fused_multi_transformer")
+def fused_multi_transformer(x, ln_scales, ln_biases, qkv_weights, qkv_biases,
+                            out_weights, out_biases, ffn_ln_scales,
+                            ffn_ln_biases, ffn1_weights, ffn1_biases,
+                            ffn2_weights, ffn2_biases, pre_layer_norm=True,
+                            epsilon=1e-5, dropout_rate=0.0, act_method="gelu",
+                            normalize_before=True, name=None):
+    """Whole-stack fused transformer (reference:
+    fused_multi_transformer_op.cu). Layer loop of pre-LN attention + FFN;
+    XLA fuses each block."""
+    from paddle_tpu.nn import functional as F
+    from paddle_tpu.ops.pallas.flash_attention import scaled_dot_product_attention
+    h = x
+    n_layers = len(qkv_weights)
+    for i in range(n_layers):
+        ln = F.layer_norm(h, normalized_shape=h.shape[-1:],
+                          weight=ln_scales[i], bias=ln_biases[i],
+                          epsilon=epsilon)
+        qkvw = qkv_weights[i]
+        b, s, hid = ln.shape
+        # qkv weight: [3, nhead, dhead, hidden]
+        three, nh, dh, _ = qkvw.shape
+        qkv = apply("qkv_proj",
+                    lambda a, w: jnp.einsum("bsh,tndh->bstnd", a, w),
+                    ln, qkvw)
+        if qkv_biases is not None and qkv_biases[i] is not None:
+            qkv = apply("qkv_bias", lambda a, bb: a + bb, qkv, qkv_biases[i])
+        q = Tensor._from_value(qkv._value[:, :, 0])
+        k = Tensor._from_value(qkv._value[:, :, 1])
+        v = Tensor._from_value(qkv._value[:, :, 2])
+        attn = scaled_dot_product_attention(q, k, v, is_causal=True)
+        attn = apply("attn_merge", lambda a: a.reshape(b, s, -1), attn)
+        attn = apply("attn_out", lambda a, w: a @ w.reshape(-1, w.shape[-1]),
+                     attn, out_weights[i])
+        if out_biases is not None and out_biases[i] is not None:
+            attn = apply("attn_out_bias", jnp.add, attn, out_biases[i])
+        h = apply("attn_residual", jnp.add, h, attn)
+        ffn_ln = F.layer_norm(h, normalized_shape=h.shape[-1:],
+                              weight=ffn_ln_scales[i], bias=ffn_ln_biases[i],
+                              epsilon=epsilon)
+        act = _ACTS.get(act_method, jax.nn.gelu)
+
+        def ffn1_f(a, w, *bb):
+            out = a @ w
+            if bb:
+                out = out + bb[0]
+            return act(out)
+
+        f1args = (ffn_ln, ffn1_weights[i]) + (
+            (ffn1_biases[i],)
+            if ffn1_biases is not None and ffn1_biases[i] is not None else ())
+        f1 = apply("ffn1", ffn1_f, *f1args)
+        f2 = apply("ffn2", lambda a, w: a @ w, f1, ffn2_weights[i])
+        if ffn2_biases is not None and ffn2_biases[i] is not None:
+            f2 = apply("ffn2_bias", jnp.add, f2, ffn2_biases[i])
+        h = apply("ffn_residual", jnp.add, h, f2)
+    return h
+
+
+@register_op("fused_token_prune", differentiable=False)
+def fused_token_prune(attn, x, mask, new_mask, keep_first_token=True,
+                      keep_order=False, name=None):
+    """Prune tokens by attention score (reference: fused_token_prune_op.cu):
+    keep the top new_seq tokens by column-summed attention."""
+    def f(at, xv, m, nm):
+        new_len = nm.shape[2]
+        scores = jnp.sum(at, axis=(1, 2))  # [B, S]
+        if keep_first_token:
+            scores = scores.at[:, 0].set(jnp.inf)
+        idx = jnp.argsort(-scores, axis=1)[:, :new_len]
+        if keep_order:
+            idx = jnp.sort(idx, axis=1)
+        gathered = jnp.take_along_axis(xv, idx[:, :, None], axis=1)
+        return gathered, idx.astype(jnp.int64)
+
+    return apply("fused_token_prune", f, attn, x, mask, new_mask)
+
+
+@register_op("rank_attention")
+def rank_attention(x, rank_offset, rank_param, max_rank=3, max_size=0,
+                   name=None):
+    """Rank-aware attention for ranking models (reference:
+    rank_attention_op.cu). Per-row block-matmul with rank-selected params."""
+    def f(xv, ro, rp):
+        ins_num, x_dim = xv.shape
+        para_col = rp.shape[1]
+        block = x_dim  # per-rank block rows
+        rank_idx = jnp.maximum(ro[:, 0].astype(jnp.int32), 0)
+        out = jnp.zeros((ins_num, para_col), xv.dtype)
+        # select the rank block of parameters per instance and matmul
+        starts = rank_idx * block
+        gather_rows = starts[:, None] + jnp.arange(block)[None, :]
+        pblk = rp[jnp.clip(gather_rows, 0, rp.shape[0] - 1)]  # [ins, block, col]
+        return jnp.einsum("id,idc->ic", xv, pblk)
+
+    return apply("rank_attention", f, x, rank_offset, rank_param)
+
+
+@register_op("qkv_unpack_mha")
+def qkv_unpack_mha(qkv, cache_kv=None, num_heads=1, name=None):
+    """Unpack a packed QKV tensor into (q, k, v) heads."""
+    def f(a):
+        b, s, three_h = a.shape
+        hid = three_h // 3
+        q, k, v = jnp.split(a, 3, axis=-1)
+        return q, k, v
+
+    return apply("qkv_unpack_mha", f, qkv)
+
+
+@register_op("blha_get_max_len", differentiable=False)
+def blha_get_max_len(seq_lens_encoder, seq_lens_decoder, batch_size=None,
+                     name=None):
+    e = seq_lens_encoder._value
+    d = seq_lens_decoder._value
+    return (Tensor._from_value(jnp.max(e).reshape(1)),
+            Tensor._from_value(jnp.max(d).reshape(1)))
+
+
+@register_op("correlation")
+def correlation(x, y, pad_size=4, kernel_size=1, max_displacement=4,
+                stride1=1, stride2=1, corr_type_multiply=1, name=None):
+    """FlowNet correlation layer (reference: correlation_op.cu): inner
+    products between patches of x and displaced patches of y."""
+    def f(a, b):
+        n, c, h, w = a.shape
+        d = max_displacement
+        bp = jnp.pad(b, ((0, 0), (0, 0), (d, d), (d, d)))
+        outs = []
+        for dy in range(-d, d + 1, stride2):
+            for dx in range(-d, d + 1, stride2):
+                shifted = jax.lax.dynamic_slice(
+                    bp, (0, 0, d + dy, d + dx), (n, c, h, w))
+                outs.append(jnp.mean(a * shifted, axis=1))
+        return jnp.stack(outs, axis=1)
+
+    return apply("correlation", f, x, y)

@@ -1,0 +1,229 @@
+"""Linear algebra ops (parity: python/paddle/tensor/linalg.py, paddle.linalg).
+
+matmul is THE op on TPU: it lands on the MXU. Everything here defers to
+jnp/jnp.linalg so XLA picks the systolic-array path; bf16 inputs stay bf16.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.dispatch import apply
+from paddle_tpu.ops.registry import register_op
+from paddle_tpu.tensor import Tensor
+
+
+@register_op("matmul", category="linalg")
+def matmul(x, y, transpose_x=False, transpose_y=False, name=None):
+    def f(a, b):
+        if transpose_x:
+            a = jnp.swapaxes(a, -1, -2) if a.ndim >= 2 else a
+        if transpose_y:
+            b = jnp.swapaxes(b, -1, -2) if b.ndim >= 2 else b
+        return jnp.matmul(a, b)
+
+    return apply("matmul", f, x, y)
+
+
+@register_op("mm", category="linalg")
+def mm(input, mat2, name=None):
+    return matmul(input, mat2)
+
+
+@register_op("bmm", category="linalg")
+def bmm(x, y, name=None):
+    return apply("bmm", lambda a, b: jnp.matmul(a, b), x, y)
+
+
+@register_op("dot", category="linalg")
+def dot(x, y, name=None):
+    return apply(
+        "dot",
+        lambda a, b: jnp.sum(a * b, axis=-1),
+        x,
+        y,
+    )
+
+
+@register_op("mv", category="linalg")
+def mv(x, vec, name=None):
+    return apply("mv", lambda a, v: jnp.matmul(a, v), x, vec)
+
+
+@register_op("addmm", category="linalg")
+def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):
+    return apply(
+        "addmm", lambda i, a, b: beta * i + alpha * jnp.matmul(a, b), input, x, y
+    )
+
+
+@register_op("matrix_transpose", category="linalg")
+def matrix_transpose(x, name=None):
+    return apply("matrix_transpose", lambda a: jnp.swapaxes(a, -1, -2), x)
+
+
+@register_op("cholesky", category="linalg")
+def cholesky(x, upper=False, name=None):
+    def f(a):
+        L = jnp.linalg.cholesky(a)
+        return jnp.swapaxes(L, -1, -2).conj() if upper else L
+
+    return apply("cholesky", f, x)
+
+
+@register_op("cholesky_solve", category="linalg")
+def cholesky_solve(x, y, upper=False, name=None):
+    def f(b, L):
+        return jax.scipy.linalg.cho_solve((L, not upper), b)
+
+    return apply("cholesky_solve", f, x, y)
+
+
+@register_op("inverse", category="linalg", aliases=("inv",))
+def inverse(x, name=None):
+    return apply("inverse", jnp.linalg.inv, x)
+
+
+@register_op("pinv", category="linalg")
+def pinv(x, rcond=1e-15, hermitian=False, name=None):
+    return apply("pinv", lambda a: jnp.linalg.pinv(a, rtol=rcond, hermitian=hermitian), x)
+
+
+@register_op("solve", category="linalg")
+def solve(x, y, name=None):
+    return apply("solve", jnp.linalg.solve, x, y)
+
+
+@register_op("triangular_solve", category="linalg")
+def triangular_solve(x, y, upper=True, transpose=False, unitriangular=False, name=None):
+    def f(a, b):
+        return jax.scipy.linalg.solve_triangular(
+            a, b, lower=not upper, trans=1 if transpose else 0,
+            unit_diagonal=unitriangular,
+        )
+
+    return apply("triangular_solve", f, x, y)
+
+
+@register_op("lstsq", category="linalg", differentiable=False)
+def lstsq(x, y, rcond=None, driver=None, name=None):
+    sol, res, rank, sv = jnp.linalg.lstsq(x._value, y._value, rcond=rcond)
+    return (
+        Tensor._from_value(sol),
+        Tensor._from_value(res),
+        Tensor._from_value(rank),
+        Tensor._from_value(sv),
+    )
+
+
+@register_op("qr", category="linalg")
+def qr(x, mode="reduced", name=None):
+    out = apply("qr", lambda a: tuple(jnp.linalg.qr(a, mode=mode)), x)
+    return out
+
+
+@register_op("svd", category="linalg")
+def svd(x, full_matrices=False, name=None):
+    def f(a):
+        u, s, vh = jnp.linalg.svd(a, full_matrices=full_matrices)
+        return u, s, jnp.swapaxes(vh, -1, -2).conj()
+
+    return apply("svd", f, x)
+
+
+@register_op("eig", category="linalg", differentiable=False)
+def eig(x, name=None):
+    w, v = jnp.linalg.eig(jax.device_put(x._value, jax.devices("cpu")[0]))
+    return Tensor._from_value(w), Tensor._from_value(v)
+
+
+@register_op("eigh", category="linalg")
+def eigh(x, UPLO="L", name=None):
+    out = apply("eigh", lambda a: tuple(jnp.linalg.eigh(a, UPLO=UPLO)), x)
+    return out
+
+
+@register_op("eigvals", category="linalg", differentiable=False)
+def eigvals(x, name=None):
+    w = jnp.linalg.eigvals(jax.device_put(x._value, jax.devices("cpu")[0]))
+    return Tensor._from_value(w)
+
+
+@register_op("eigvalsh", category="linalg")
+def eigvalsh(x, UPLO="L", name=None):
+    return apply("eigvalsh", lambda a: jnp.linalg.eigvalsh(a, UPLO=UPLO), x)
+
+
+@register_op("det", category="linalg")
+def det(x, name=None):
+    return apply("det", jnp.linalg.det, x)
+
+
+@register_op("slogdet", category="linalg")
+def slogdet(x, name=None):
+    def f(a):
+        sign, logdet = jnp.linalg.slogdet(a)
+        return jnp.stack([sign, logdet])
+
+    return apply("slogdet", f, x)
+
+
+@register_op("matrix_rank", category="linalg", differentiable=False)
+def matrix_rank(x, tol=None, hermitian=False, name=None):
+    return apply(
+        "matrix_rank",
+        lambda a: jnp.linalg.matrix_rank(a, rtol=tol).astype(jnp.int64),
+        x,
+        differentiable=False,
+    )
+
+
+@register_op("matrix_power", category="linalg")
+def matrix_power(x, n, name=None):
+    return apply("matrix_power", lambda a: jnp.linalg.matrix_power(a, n), x)
+
+
+@register_op("lu", category="linalg", differentiable=False)
+def lu(x, pivot=True, get_infos=False, name=None):
+    lu_mat, piv = jax.scipy.linalg.lu_factor(x._value)
+    results = [Tensor._from_value(lu_mat), Tensor._from_value(piv.astype(jnp.int32) + 1)]
+    if get_infos:
+        results.append(Tensor._from_value(jnp.zeros((), jnp.int32)))
+    return tuple(results)
+
+
+@register_op("multi_dot", category="linalg")
+def multi_dot(x, name=None):
+    return apply("multi_dot", lambda *vs: jnp.linalg.multi_dot(vs), *x)
+
+
+@register_op("histogram", category="linalg", differentiable=False)
+def histogram(input, bins=100, min=0, max=0, weight=None, density=False, name=None):
+    def f(a):
+        lo, hi = (min, max) if (min != 0 or max != 0) else (a.min(), a.max())
+        h, _ = jnp.histogram(a, bins=bins, range=(lo, hi), density=density)
+        return h if density else h.astype(jnp.int64)
+
+    return apply("histogram", f, input, differentiable=False)
+
+
+@register_op("bincount", category="linalg", differentiable=False)
+def bincount(x, weights=None, minlength=0, name=None):
+    import numpy as np
+
+    arr = np.asarray(x._value)
+    w = np.asarray(weights._value) if weights is not None else None
+    return Tensor._from_value(jnp.asarray(np.bincount(arr, weights=w, minlength=minlength)))
+
+
+@register_op("corrcoef", category="linalg")
+def corrcoef(x, rowvar=True, name=None):
+    return apply("corrcoef", lambda a: jnp.corrcoef(a, rowvar=rowvar), x)
+
+
+@register_op("cov", category="linalg")
+def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None, name=None):
+    return apply(
+        "cov", lambda a: jnp.cov(a, rowvar=rowvar, ddof=1 if ddof else 0), x
+    )

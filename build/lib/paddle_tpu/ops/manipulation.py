@@ -1,0 +1,775 @@
+"""Shape/index manipulation ops (parity: python/paddle/tensor/manipulation.py).
+
+Gather/scatter map to XLA gather/scatter which tile natively on TPU; views are
+value-semantic (XLA has no aliasing), matching the reference's behavior for
+every non-inplace op.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.core.dispatch import apply
+from paddle_tpu.ops.registry import register_op
+from paddle_tpu.tensor import Tensor
+
+
+def _static_ints(v):
+    if isinstance(v, Tensor):
+        return [int(i) for i in np.asarray(v._value)]
+    if isinstance(v, (int, np.integer)):
+        return int(v)
+    return [int(i) if not isinstance(i, Tensor) else int(i.item()) for i in v]
+
+
+@register_op("cast", category="manipulation")
+def cast(x, dtype, name=None):
+    return x.astype(dtype)
+
+
+@register_op("reshape", category="manipulation")
+def reshape(x, shape, name=None):
+    shape = _static_ints(shape)
+    return apply("reshape", lambda a: jnp.reshape(a, shape), x)
+
+
+@register_op("reshape_", category="manipulation")
+def reshape_(x, shape, name=None):
+    out = reshape(x, shape)
+    x._replace_value(out._value, out._node)
+    return x
+
+
+@register_op("transpose", category="manipulation")
+def transpose(x, perm, name=None):
+    perm = _static_ints(perm)
+    return apply("transpose", lambda a: jnp.transpose(a, perm), x)
+
+
+@register_op("t", category="manipulation")
+def t(x, name=None):
+    return apply("t", lambda a: a.T if a.ndim >= 2 else a, x)
+
+
+@register_op("moveaxis", category="manipulation")
+def moveaxis(x, source, destination, name=None):
+    return apply("moveaxis", lambda a: jnp.moveaxis(a, source, destination), x)
+
+
+@register_op("swapaxes", category="manipulation", aliases=("transpose_swap",))
+def swapaxes(x, axis0, axis1, name=None):
+    return apply("swapaxes", lambda a: jnp.swapaxes(a, axis0, axis1), x)
+
+
+@register_op("concat", category="manipulation")
+def concat(x, axis=0, name=None):
+    if isinstance(axis, Tensor):
+        axis = int(axis.item())
+    tensors = list(x)
+    return apply("concat", lambda *vs: jnp.concatenate(vs, axis=axis), *tensors)
+
+
+@register_op("stack", category="manipulation")
+def stack(x, axis=0, name=None):
+    tensors = list(x)
+    return apply("stack", lambda *vs: jnp.stack(vs, axis=axis), *tensors)
+
+
+@register_op("split", category="manipulation")
+def split(x, num_or_sections, axis=0, name=None):
+    if isinstance(axis, Tensor):
+        axis = int(axis.item())
+    n = x._value.shape[axis]
+    if isinstance(num_or_sections, int):
+        sections = None
+        num = num_or_sections
+        out = apply("split", lambda a: tuple(jnp.split(a, num, axis=axis)), x)
+    else:
+        sizes = _static_ints(num_or_sections)
+        # paddle allows one -1 entry
+        if -1 in sizes:
+            known = sum(s for s in sizes if s != -1)
+            sizes = [s if s != -1 else n - known for s in sizes]
+        offsets = np.cumsum(sizes)[:-1].tolist()
+        out = apply("split", lambda a: tuple(jnp.split(a, offsets, axis=axis)), x)
+    return list(out) if isinstance(out, tuple) else [out]
+
+
+@register_op("chunk", category="manipulation")
+def chunk(x, chunks, axis=0, name=None):
+    return split(x, chunks, axis=axis)
+
+
+@register_op("unbind", category="manipulation")
+def unbind(x, axis=0, name=None):
+    n = x._value.shape[axis]
+    out = apply(
+        "unbind",
+        lambda a: tuple(jnp.squeeze(s, axis) for s in jnp.split(a, n, axis=axis)),
+        x,
+    )
+    return list(out) if isinstance(out, tuple) else [out]
+
+
+@register_op("squeeze", category="manipulation")
+def squeeze(x, axis=None, name=None):
+    def f(a):
+        if axis is None:
+            return jnp.squeeze(a)
+        ax = _static_ints(axis)
+        ax = [ax] if isinstance(ax, int) else ax
+        ax = tuple(a_ for a_ in ax if a.shape[a_] == 1)
+        return jnp.squeeze(a, axis=ax) if ax else a
+
+    return apply("squeeze", f, x)
+
+
+@register_op("unsqueeze", category="manipulation")
+def unsqueeze(x, axis, name=None):
+    ax = _static_ints(axis)
+    ax = [ax] if isinstance(ax, int) else ax
+
+    def f(a):
+        out = a
+        for i in sorted(ax):
+            out = jnp.expand_dims(out, i)
+        return out
+
+    return apply("unsqueeze", f, x)
+
+
+@register_op("flatten", category="manipulation")
+def flatten(x, start_axis=0, stop_axis=-1, name=None):
+    def f(a):
+        nd = a.ndim
+        s = start_axis if start_axis >= 0 else nd + start_axis
+        e = stop_axis if stop_axis >= 0 else nd + stop_axis
+        new_shape = a.shape[:s] + (-1,) + a.shape[e + 1:]
+        return jnp.reshape(a, new_shape)
+
+    return apply("flatten", f, x)
+
+
+@register_op("expand", category="manipulation")
+def expand(x, shape, name=None):
+    shape = _static_ints(shape)
+
+    def f(a):
+        tgt = list(shape)
+        # -1 entries keep the original dim
+        offset = len(tgt) - a.ndim
+        for i in range(len(tgt)):
+            if tgt[i] == -1:
+                tgt[i] = a.shape[i - offset]
+        return jnp.broadcast_to(a, tgt)
+
+    return apply("expand", f, x)
+
+
+@register_op("broadcast_to", category="manipulation")
+def broadcast_to(x, shape, name=None):
+    return expand(x, shape)
+
+
+@register_op("expand_as", category="manipulation")
+def expand_as(x, y, name=None):
+    return apply("expand_as", lambda a, b: jnp.broadcast_to(a, b.shape), x, y.detach())
+
+
+@register_op("broadcast_tensors", category="manipulation")
+def broadcast_tensors(inputs, name=None):
+    out = apply(
+        "broadcast_tensors", lambda *vs: tuple(jnp.broadcast_arrays(*vs)), *inputs
+    )
+    return list(out)
+
+
+@register_op("tile", category="manipulation")
+def tile(x, repeat_times, name=None):
+    reps = _static_ints(repeat_times)
+    return apply("tile", lambda a: jnp.tile(a, reps), x)
+
+
+@register_op("repeat_interleave", category="manipulation")
+def repeat_interleave(x, repeats, axis=None, name=None):
+    if isinstance(repeats, Tensor):
+        reps = jnp.asarray(np.asarray(repeats._value))
+        total = int(np.asarray(repeats._value).sum())
+        return apply(
+            "repeat_interleave",
+            lambda a: jnp.repeat(a, reps, axis=axis, total_repeat_length=total),
+            x,
+        )
+    return apply("repeat_interleave", lambda a: jnp.repeat(a, repeats, axis=axis), x)
+
+
+@register_op("flip", category="manipulation")
+def flip(x, axis, name=None):
+    ax = _static_ints(axis)
+    return apply("flip", lambda a: jnp.flip(a, axis=ax), x)
+
+
+@register_op("rot90", category="manipulation")
+def rot90(x, k=1, axes=(0, 1), name=None):
+    return apply("rot90", lambda a: jnp.rot90(a, k=k, axes=tuple(axes)), x)
+
+
+@register_op("roll", category="manipulation")
+def roll(x, shifts, axis=None, name=None):
+    return apply("roll", lambda a: jnp.roll(a, shifts, axis=axis), x)
+
+
+@register_op("gather", category="manipulation")
+def gather(x, index, axis=0, name=None):
+    if isinstance(axis, Tensor):
+        axis = int(axis.item())
+    return apply("gather", lambda a, i: jnp.take(a, i, axis=axis), x, index)
+
+
+@register_op("gather_nd", category="manipulation")
+def gather_nd(x, index, name=None):
+    def f(a, idx):
+        k = idx.shape[-1]
+        flat_idx = tuple(jnp.moveaxis(idx, -1, 0))
+        return a[flat_idx]
+
+    return apply("gather_nd", f, x, index)
+
+
+@register_op("take_along_axis", category="manipulation")
+def take_along_axis(arr, indices, axis, broadcast=True, name=None):
+    return apply(
+        "take_along_axis", lambda a, i: jnp.take_along_axis(a, i, axis=axis), arr, indices
+    )
+
+
+@register_op("put_along_axis", category="manipulation")
+def put_along_axis(arr, indices, values, axis, reduce="assign", include_self=True,
+                   broadcast=True, name=None):
+    def f(a, i, v):
+        v = jnp.broadcast_to(v, i.shape).astype(a.dtype)
+        if reduce == "assign":
+            return jnp.put_along_axis(a, i, v, axis=axis, inplace=False)
+        dims = jnp.ogrid[tuple(slice(s) for s in i.shape)]
+        ax = axis if axis >= 0 else a.ndim + axis
+        dims = list(dims)
+        dims[ax] = i
+        at = a.at[tuple(dims)]
+        if reduce in ("add", "sum"):
+            return at.add(v)
+        if reduce in ("mul", "multiply"):
+            return at.multiply(v)
+        if reduce == "amax":
+            return at.max(v)
+        if reduce == "amin":
+            return at.min(v)
+        raise ValueError(f"unsupported reduce {reduce}")
+
+    return apply("put_along_axis", f, arr, indices, values)
+
+
+@register_op("scatter", category="manipulation")
+def scatter(x, index, updates, overwrite=True, name=None):
+    def f(a, i, u):
+        i = i.reshape(-1)
+        if overwrite:
+            return a.at[i].set(u)
+        # paddle semantics: zero the target rows then accumulate
+        zeroed = a.at[i].set(jnp.zeros_like(u))
+        return zeroed.at[i].add(u)
+
+    return apply("scatter", f, x, index, updates)
+
+
+@register_op("scatter_nd_add", category="manipulation")
+def scatter_nd_add(x, index, updates, name=None):
+    def f(a, idx, u):
+        flat_idx = tuple(jnp.moveaxis(idx, -1, 0))
+        return a.at[flat_idx].add(u)
+
+    return apply("scatter_nd_add", f, x, index, updates)
+
+
+@register_op("scatter_nd", category="manipulation")
+def scatter_nd(index, updates, shape, name=None):
+    shp = _static_ints(shape)
+
+    def f(idx, u):
+        zeros = jnp.zeros(shp, dtype=u.dtype)
+        flat_idx = tuple(jnp.moveaxis(idx, -1, 0))
+        return zeros.at[flat_idx].add(u)
+
+    return apply("scatter_nd", f, index, updates)
+
+
+@register_op("index_select", category="manipulation")
+def index_select(x, index, axis=0, name=None):
+    return apply("index_select", lambda a, i: jnp.take(a, i, axis=axis), x, index)
+
+
+@register_op("index_sample", category="manipulation")
+def index_sample(x, index):
+    return apply(
+        "index_sample", lambda a, i: jnp.take_along_axis(a, i, axis=1), x, index
+    )
+
+
+@register_op("index_add", category="manipulation")
+def index_add(x, index, axis, value, name=None):
+    def f(a, i, v):
+        ax = axis if axis >= 0 else a.ndim + axis
+        am = jnp.moveaxis(a, ax, 0)
+        vm = jnp.moveaxis(v, ax, 0)
+        out = am.at[i].add(vm)
+        return jnp.moveaxis(out, 0, ax)
+
+    return apply("index_add", f, x, index, value)
+
+
+@register_op("index_put", category="manipulation")
+def index_put(x, indices, value, accumulate=False, name=None):
+    idx_vals = tuple(i._value if isinstance(i, Tensor) else i for i in indices)
+
+    def f(a, v):
+        at = a.at[idx_vals]
+        return at.add(v) if accumulate else at.set(v)
+
+    return apply("index_put", f, x, value)
+
+
+def _mask_flat_indices(x, mask):
+    """Concrete mask -> flat indices into x (shared by masked_select /
+    masked_scatter; eager ops, data-dependent shape)."""
+    m = np.asarray(mask._value if isinstance(mask, Tensor) else mask)
+    m = np.broadcast_to(m, tuple(x.shape))
+    return jnp.asarray(np.nonzero(m.reshape(-1))[0])
+
+
+@register_op("masked_select", category="manipulation")
+def masked_select(x, mask, name=None):
+    # dynamic output shape: eager-only (matches reference's data-dependent
+    # op). Differentiable via a concrete gather: the selected flat indices
+    # are computed outside the trace, the values come from jnp.take whose
+    # vjp scatters the cotangent back (reference masked_select_grad).
+    flat_idx = _mask_flat_indices(x, mask)
+    return apply("masked_select",
+                 lambda a: jnp.take(a.reshape(-1), flat_idx), x)
+
+
+@register_op("masked_fill", category="manipulation")
+def masked_fill(x, mask, value, name=None):
+    v = value._value if isinstance(value, Tensor) else value
+    return apply(
+        "masked_fill", lambda a, m: jnp.where(m, jnp.asarray(v, a.dtype), a), x, mask
+    )
+
+
+@register_op("where", category="manipulation")
+def where(condition, x=None, y=None, name=None):
+    if x is None and y is None:
+        return nonzero(condition, as_tuple=True)
+    return apply(
+        "where",
+        lambda c, a, b: jnp.where(c, a, b),
+        condition,
+        x if isinstance(x, Tensor) else Tensor(x),
+        y if isinstance(y, Tensor) else Tensor(y),
+    )
+
+
+@register_op("nonzero", category="manipulation", differentiable=False)
+def nonzero(x, as_tuple=False, name=None):
+    arr = np.asarray(x._value)
+    nz = np.nonzero(arr)
+    if as_tuple:
+        return tuple(Tensor._from_value(jnp.asarray(i[:, None], jnp.int64)) for i in nz)
+    return Tensor._from_value(jnp.asarray(np.stack(nz, axis=1), jnp.int64))
+
+
+@register_op("slice", category="manipulation")
+def slice(x, axes, starts, ends, name=None):
+    axes = _static_ints(axes)
+    starts = _static_ints(starts)
+    ends = _static_ints(ends)
+
+    def f(a):
+        sl = [jnp.s_[:]] * a.ndim
+        for ax, s, e in zip(axes, starts, ends):
+            sl[ax] = jnp.s_[s:e]
+        return a[tuple(sl)]
+
+    return apply("slice", f, x)
+
+
+@register_op("strided_slice", category="manipulation")
+def strided_slice(x, axes, starts, ends, strides, name=None):
+    axes = _static_ints(axes)
+    starts = _static_ints(starts)
+    ends = _static_ints(ends)
+    strides = _static_ints(strides)
+
+    def f(a):
+        sl = [jnp.s_[:]] * a.ndim
+        for ax, s, e, st in zip(axes, starts, ends, strides):
+            sl[ax] = jnp.s_[s:e:st]
+        return a[tuple(sl)]
+
+    return apply("strided_slice", f, x)
+
+
+@register_op("pad", category="manipulation")
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None):
+    pad = _static_ints(pad)
+
+    def f(a):
+        nd = a.ndim
+        if len(pad) == 2 * nd:
+            # paddle order: dim-wise (low0, high0, low1, high1, ...)? Actually
+            # paddle.nn.functional.pad with len==2*nd applies to all dims in order
+            widths = [(pad[2 * i], pad[2 * i + 1]) for i in range(nd)]
+        else:
+            # NCHW-style: pad applies to trailing spatial dims, reversed pairs
+            n_spatial = len(pad) // 2
+            widths = [(0, 0)] * (nd - n_spatial)
+            for i in range(n_spatial):
+                widths.append((pad[2 * (n_spatial - 1 - i)], pad[2 * (n_spatial - 1 - i) + 1]))
+            if data_format.endswith("C") and nd > 2:  # NHWC/NLC/NDHWC: channel last
+                widths = [(0, 0)] + widths[2:] + [(0, 0)]
+        jmode = {"constant": "constant", "reflect": "reflect", "replicate": "edge",
+                 "circular": "wrap"}[mode]
+        if jmode == "constant":
+            return jnp.pad(a, widths, mode=jmode, constant_values=value)
+        return jnp.pad(a, widths, mode=jmode)
+
+    return apply("pad", f, x)
+
+
+@register_op("sort", category="manipulation")
+def sort(x, axis=-1, descending=False, stable=False, name=None):
+    def f(a):
+        out = jnp.sort(a, axis=axis, stable=stable)
+        return jnp.flip(out, axis=axis) if descending else out
+
+    return apply("sort", f, x)
+
+
+@register_op("argsort", category="manipulation", differentiable=False)
+def argsort(x, axis=-1, descending=False, stable=False, name=None):
+    def f(a):
+        out = jnp.argsort(a, axis=axis, stable=stable, descending=descending)
+        return out.astype(jnp.int64)
+
+    return apply("argsort", f, x, differentiable=False)
+
+
+@register_op("topk", category="manipulation")
+def topk(x, k, axis=-1, largest=True, sorted=True, name=None):
+    if isinstance(k, Tensor):
+        k = int(k.item())
+
+    def f(a):
+        ax = axis if axis >= 0 else a.ndim + axis
+        am = jnp.moveaxis(a, ax, -1)
+        if largest:
+            v, i = jax.lax.top_k(am, k)
+        else:
+            v, i = jax.lax.top_k(-am, k)
+            v = -v
+        return jnp.moveaxis(v, -1, ax), jnp.moveaxis(i, -1, ax).astype(jnp.int64)
+
+    return apply("topk", f, x)
+
+
+@register_op("unique", category="manipulation", differentiable=False)
+def unique(x, return_index=False, return_inverse=False, return_counts=False,
+           axis=None, dtype="int64", name=None):
+    arr = np.asarray(x._value)
+    res = np.unique(arr, return_index=return_index, return_inverse=return_inverse,
+                    return_counts=return_counts, axis=axis)
+    if not isinstance(res, tuple):
+        return Tensor._from_value(jnp.asarray(res))
+    outs = [Tensor._from_value(jnp.asarray(r)) for r in res]
+    return tuple(outs)
+
+
+@register_op("unique_consecutive", category="manipulation", differentiable=False)
+def unique_consecutive(x, return_inverse=False, return_counts=False, axis=None,
+                       dtype="int64", name=None):
+    arr = np.asarray(x._value)
+    if axis is None:
+        arr = arr.reshape(-1)
+        keep = np.concatenate([[True], arr[1:] != arr[:-1]])
+    else:
+        sub = np.moveaxis(arr, axis, 0)
+        keep = np.concatenate(
+            [[True], np.any(sub[1:] != sub[:-1], axis=tuple(range(1, sub.ndim)))]
+        )
+        out = np.compress(keep, arr, axis=axis)
+        return Tensor._from_value(jnp.asarray(out))
+    out = arr[keep]
+    results = [Tensor._from_value(jnp.asarray(out))]
+    if return_inverse:
+        inv = np.cumsum(keep) - 1
+        results.append(Tensor._from_value(jnp.asarray(inv, np.int64)))
+    if return_counts:
+        idx = np.nonzero(keep)[0]
+        counts = np.diff(np.concatenate([idx, [arr.size]]))
+        results.append(Tensor._from_value(jnp.asarray(counts, np.int64)))
+    return results[0] if len(results) == 1 else tuple(results)
+
+
+@register_op("one_hot", category="manipulation", differentiable=False)
+def one_hot(x, num_classes, name=None):
+    return apply(
+        "one_hot",
+        lambda i: jax.nn.one_hot(i, num_classes, dtype=jnp.float32),
+        x,
+        differentiable=False,
+    )
+
+
+@register_op("searchsorted", category="manipulation", differentiable=False)
+def searchsorted(sorted_sequence, values, out_int32=False, right=False, name=None):
+    def f(s, v):
+        side = "right" if right else "left"
+        if s.ndim == 1:
+            out = jnp.searchsorted(s, v, side=side)
+        else:
+            out = jax.vmap(lambda ss, vv: jnp.searchsorted(ss, vv, side=side))(
+                s.reshape(-1, s.shape[-1]), v.reshape(-1, v.shape[-1])
+            ).reshape(v.shape)
+        return out.astype(jnp.int32 if out_int32 else jnp.int64)
+
+    return apply("searchsorted", f, sorted_sequence, values, differentiable=False)
+
+
+@register_op("bucketize", category="manipulation", differentiable=False)
+def bucketize(x, sorted_sequence, out_int32=False, right=False, name=None):
+    return searchsorted(sorted_sequence, x, out_int32=out_int32, right=right)
+
+
+@register_op("as_strided", category="manipulation")
+def as_strided(x, shape, stride, offset=0, name=None):
+    # XLA has no strided views; emulate with gather for the common cases
+    shape = _static_ints(shape)
+    stride = _static_ints(stride)
+
+    def f(a):
+        flat = a.reshape(-1)
+        idx = jnp.asarray(offset)
+        grids = jnp.meshgrid(*[jnp.arange(s) for s in shape], indexing="ij")
+        lin = sum(g * s for g, s in zip(grids, stride)) + offset
+        return flat[lin.reshape(-1)].reshape(shape)
+
+    return apply("as_strided", f, x)
+
+
+@register_op("view", category="manipulation")
+def view(x, shape_or_dtype, name=None):
+    if isinstance(shape_or_dtype, (list, tuple)):
+        return reshape(x, shape_or_dtype)
+    return x.astype(shape_or_dtype)
+
+
+@register_op("atleast_1d", category="manipulation")
+def atleast_1d(*inputs, name=None):
+    outs = [apply("atleast_1d", jnp.atleast_1d, t) for t in inputs]
+    return outs[0] if len(outs) == 1 else outs
+
+
+@register_op("atleast_2d", category="manipulation")
+def atleast_2d(*inputs, name=None):
+    outs = [apply("atleast_2d", jnp.atleast_2d, t) for t in inputs]
+    return outs[0] if len(outs) == 1 else outs
+
+
+@register_op("atleast_3d", category="manipulation")
+def atleast_3d(*inputs, name=None):
+    outs = [apply("atleast_3d", jnp.atleast_3d, t) for t in inputs]
+    return outs[0] if len(outs) == 1 else outs
+
+
+@register_op("tensordot", category="manipulation")
+def tensordot(x, y, axes=2, name=None):
+    return apply("tensordot", lambda a, b: jnp.tensordot(a, b, axes=axes), x, y)
+
+
+@register_op("einsum", category="manipulation")
+def einsum(equation, *operands):
+    return apply("einsum", lambda *vs: jnp.einsum(equation, *vs), *operands)
+
+
+@register_op("numel", category="manipulation", differentiable=False)
+def numel(x, name=None):
+    return Tensor._from_value(jnp.asarray(x.size, jnp.int64))
+
+
+@register_op("shard_index", category="manipulation", differentiable=False)
+def shard_index(input, index_num, nshards, shard_id, ignore_value=-1):
+    size = (index_num + nshards - 1) // nshards
+
+    def f(i):
+        shard = i // size
+        local = i % size
+        return jnp.where(shard == shard_id, local, ignore_value)
+
+    return apply("shard_index", f, input, differentiable=False)
+
+
+@register_op("diff", category="manipulation")
+def diff(x, n=1, axis=-1, prepend=None, append=None, name=None):
+    args = [x]
+    if prepend is not None:
+        args.append(prepend)
+    if append is not None:
+        args.append(append)
+
+    def f(a, *rest):
+        it = iter(rest)
+        pre = next(it) if prepend is not None else None
+        app = next(it) if append is not None else None
+        return jnp.diff(a, n=n, axis=axis, prepend=pre, append=app)
+
+    return apply("diff", f, *args)
+
+
+@register_op("unfold", category="manipulation")
+def unfold(x, axis, size, step, name=None):
+    """paddle.unfold (tensor sliding windows along axis)."""
+
+    def f(a):
+        ax = axis % a.ndim
+        length = a.shape[ax]
+        n_windows = (length - size) // step + 1
+        idx = jnp.arange(n_windows)[:, None] * step + jnp.arange(size)[None, :]
+        out = jnp.take(a, idx.reshape(-1), axis=ax)
+        shape = list(a.shape)
+        shape[ax:ax + 1] = [n_windows, size]
+        out = out.reshape(shape)
+        # paddle puts the window dim last
+        return jnp.moveaxis(out, ax + 1, -1)
+
+    return apply("unfold", f, x)
+
+
+# ---------------------------------------------- round-2 API-surface sweep
+
+
+@register_op("take", category="manipulation")
+def take(x, index, mode="raise", name=None):
+    """Flat-index gather (paddle.take). Modes follow numpy/paddle exactly:
+    'raise' errors on out-of-range (checked eagerly on the concrete index),
+    'wrap' applies modulo, 'clip' clamps (negatives to 0)."""
+    n = int(np.prod(x.shape)) if x.shape else 1
+    if mode == "raise":
+        iv = index._value if isinstance(index, Tensor) else np.asarray(index)
+        icheck = np.asarray(iv)
+        if icheck.size and (icheck.min() < -n or icheck.max() >= n):
+            raise IndexError(
+                f"take: index out of range for tensor of {n} elements")
+
+    def f(a, i):
+        flat = a.reshape(-1)
+        if mode == "wrap":
+            i = i % n
+        elif mode == "clip":
+            i = jnp.clip(i, 0, n - 1)
+        else:  # raise: bounds pre-checked; wrap negatives like numpy
+            i = jnp.where(i < 0, i + n, i)
+        return flat[i]
+
+    return apply("take", f, x, index)
+
+
+@register_op("masked_scatter", category="manipulation")
+def masked_scatter(x, mask, value, name=None):
+    """Fill mask positions from value's leading elements (paddle
+    masked_scatter). Mask is concrete (eager op, like masked_select)."""
+    flat_idx = _mask_flat_indices(x, mask)
+
+    def f(a, v):
+        return a.reshape(-1).at[flat_idx].set(
+            v.reshape(-1)[: flat_idx.shape[0]]).reshape(a.shape)
+
+    return apply("masked_scatter", f, x, value)
+
+
+@register_op("index_fill", category="manipulation")
+def index_fill(x, index, axis, fill_value, name=None):
+    import builtins
+
+    def f(a, i):
+        # NB: `slice` is shadowed by the paddle slice op in this module
+        idx = [builtins.slice(None)] * a.ndim
+        idx[axis] = i
+        return a.at[tuple(idx)].set(fill_value)
+
+    return apply("index_fill", f, x, index)
+
+
+@register_op("unflatten", category="manipulation")
+def unflatten(x, axis, shape, name=None):
+    def f(a):
+        ax = axis % a.ndim
+        new = list(a.shape[:ax]) + list(shape) + list(a.shape[ax + 1:])
+        return a.reshape(new)
+
+    return apply("unflatten", f, x)
+
+
+@register_op("select_scatter", category="manipulation")
+def select_scatter(x, values, axis, index, name=None):
+    import builtins
+
+    def f(a, v):
+        idx = [builtins.slice(None)] * a.ndim
+        idx[axis] = index
+        return a.at[tuple(idx)].set(v)
+
+    return apply("select_scatter", f, x, values)
+
+
+@register_op("slice_scatter", category="manipulation")
+def slice_scatter(x, value, axes, starts, ends, strides=None, name=None):
+    import builtins
+
+    strides = strides or [1] * len(axes)
+
+    def f(a, v):
+        idx = [builtins.slice(None)] * a.ndim
+        for ax, s, e, st in zip(axes, starts, ends, strides):
+            idx[ax] = builtins.slice(int(s), int(e), int(st))
+        return a.at[tuple(idx)].set(v)
+
+    return apply("slice_scatter", f, x, value)
+
+
+@register_op("column_stack", category="manipulation")
+def column_stack(xs, name=None):
+    return apply("column_stack", lambda *vs: jnp.column_stack(vs), *xs)
+
+
+@register_op("row_stack", category="manipulation")
+def row_stack(xs, name=None):
+    return apply("row_stack", lambda *vs: jnp.vstack(vs), *xs)
+
+
+def _make_nsplit(opname, jfn):
+    @register_op(opname, category="manipulation")
+    def op(x, num_or_indices, name=None):
+        n = (num_or_indices if isinstance(num_or_indices, int)
+             else list(num_or_indices))
+        # through apply() so gradients/AMP/numerics hooks engage (review
+        # r2: bypassing it silently dropped grads)
+        out = apply(opname, lambda a: tuple(jfn(a, n)), x)
+        return list(out) if isinstance(out, (tuple, list)) else [out]
+
+    op.__name__ = opname
+    return op
+
+
+hsplit = _make_nsplit("hsplit", jnp.hsplit)
+vsplit = _make_nsplit("vsplit", jnp.vsplit)
+dsplit = _make_nsplit("dsplit", jnp.dsplit)

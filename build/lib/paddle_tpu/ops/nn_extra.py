@@ -1,0 +1,653 @@
+"""NN ops completing the reference manifest: interpolation variants, indexed/
+fractional/lp pooling, unpooling, conv variants, shuffles, sequence ops, and
+margin-softmax losses.
+
+Reference kernels: paddle/phi/kernels/{cpu,gpu}/{bilinear_interp,pool2d,
+max_pool2d_with_index,unpool,deformable_conv,spectral_norm,temporal_shift,
+margin_cross_entropy,...}_kernel. Implementations are lax/jnp compositions
+(reduce_window, conv_general_dilated_patches, scatter) that XLA maps onto
+MXU/VPU; no scalar loops.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.core.dispatch import apply
+from paddle_tpu.framework import random as rng
+from paddle_tpu.ops.registry import register_op
+from paddle_tpu.tensor import Tensor
+
+# ------------------------------------------------------------ interpolation
+
+
+def _resize(x, out_spatial, method, data_format="NCHW"):
+    def f(a):
+        if data_format.startswith("NC"):
+            shape = a.shape[:2] + tuple(out_spatial)
+        else:
+            shape = (a.shape[0],) + tuple(out_spatial) + (a.shape[-1],)
+        return jax.image.resize(a, shape, method=method).astype(a.dtype)
+
+    return apply("interp", f, x)
+
+
+def _out_spatial(x, ndim_sp, size, scale, data_format):
+    if size is not None:
+        return [int(s) for s in size]
+    sf = scale if isinstance(scale, (list, tuple)) else [scale] * ndim_sp
+    sp = x.shape[2:2 + ndim_sp] if data_format.startswith("NC") \
+        else x.shape[1:1 + ndim_sp]
+    return [int(d * s) for d, s in zip(sp, sf)]
+
+
+def _make_interp(opname, method, ndim_sp):
+    @register_op(opname)
+    def op(x, out_size=None, size=None, scale_factor=None, scale=None,
+           align_corners=False, align_mode=0, data_format="NCHW", name=None):
+        sz = out_size if out_size is not None else size
+        sc = scale_factor if scale_factor is not None else scale
+        return _resize(x, _out_spatial(x, ndim_sp, sz, sc, data_format),
+                       method, data_format)
+
+    op.__name__ = opname
+    return op
+
+
+linear_interp = _make_interp("linear_interp", "linear", 1)
+bilinear_interp = _make_interp("bilinear_interp", "bilinear", 2)
+bicubic_interp = _make_interp("bicubic_interp", "bicubic", 2)
+nearest_interp = _make_interp("nearest_interp", "nearest", 2)
+trilinear_interp = _make_interp("trilinear_interp", "trilinear", 3)
+
+
+# ------------------------------------------------------------------ pooling
+
+
+def _pair(v, n=2):
+    return list(v) if isinstance(v, (list, tuple)) else [v] * n
+
+
+@register_op("pool2d")
+def pool2d(x, kernel_size, stride=None, padding=0, pooling_type="max",
+           ceil_mode=False, exclusive=True, adaptive=False,
+           global_pooling=False, data_format="NCHW", name=None):
+    from paddle_tpu.nn import functional as F
+    if global_pooling:
+        red = jnp.max if pooling_type == "max" else jnp.mean
+        return apply("pool2d", lambda a: red(a, axis=(2, 3), keepdims=True), x)
+    if adaptive:
+        return (F.adaptive_max_pool2d(x, kernel_size) if pooling_type == "max"
+                else F.adaptive_avg_pool2d(x, kernel_size))
+    fn = F.max_pool2d if pooling_type == "max" else F.avg_pool2d
+    return fn(x, kernel_size, stride=stride or kernel_size, padding=padding,
+              ceil_mode=ceil_mode)
+
+
+@register_op("pool3d")
+def pool3d(x, kernel_size, stride=None, padding=0, pooling_type="max",
+           ceil_mode=False, exclusive=True, adaptive=False,
+           global_pooling=False, data_format="NCDHW", name=None):
+    from paddle_tpu.nn import functional as F
+    if global_pooling:
+        red = jnp.max if pooling_type == "max" else jnp.mean
+        return apply("pool3d", lambda a: red(a, axis=(2, 3, 4), keepdims=True), x)
+    fn = F.max_pool3d if pooling_type == "max" else F.avg_pool3d
+    return fn(x, kernel_size, stride=stride or kernel_size, padding=padding,
+              ceil_mode=ceil_mode)
+
+
+def _pool_patches(a, ksize, stride, padding, nd):
+    """[N, C*prod(k), *out_spatial] sliding windows via XLA's patch extractor."""
+    return jax.lax.conv_general_dilated_patches(
+        a, filter_shape=ksize, window_strides=stride,
+        padding=[(p, p) for p in padding])
+
+
+def _max_pool_with_index(x, kernel_size, stride, padding, nd, opname):
+    k = _pair(kernel_size, nd)
+    s = _pair(stride or kernel_size, nd)
+    p = _pair(padding, nd)
+
+    def f(a):
+        n, c = a.shape[:2]
+        sp = a.shape[2:]
+        patches = _pool_patches(a, k, s, p, nd)  # [N, C*K, *out]
+        out_sp = patches.shape[2:]
+        K = int(np.prod(k))
+        patches = patches.reshape(n, c, K, *out_sp)
+        vals = jnp.max(patches, axis=2)
+        arg = jnp.argmax(patches, axis=2)  # index within window
+        # convert window-local argmax to flat spatial index in the input
+        if nd == 2:
+            oy = jnp.arange(out_sp[0]).reshape(-1, 1)
+            ox = jnp.arange(out_sp[1]).reshape(1, -1)
+            wy = arg // k[1]
+            wx = arg % k[1]
+            iy = oy * s[0] - p[0] + wy
+            ix = ox * s[1] - p[1] + wx
+            flat = iy * sp[1] + ix
+        else:
+            oz = jnp.arange(out_sp[0]).reshape(-1, 1, 1)
+            oy = jnp.arange(out_sp[1]).reshape(1, -1, 1)
+            ox = jnp.arange(out_sp[2]).reshape(1, 1, -1)
+            wz = arg // (k[1] * k[2])
+            wy = (arg // k[2]) % k[1]
+            wx = arg % k[2]
+            iz = oz * s[0] - p[0] + wz
+            iy = oy * s[1] - p[1] + wy
+            ix = ox * s[2] - p[2] + wx
+            flat = (iz * sp[1] + iy) * sp[2] + ix
+        return vals, flat.astype(jnp.int32)
+
+    return apply(opname, f, x)
+
+
+@register_op("max_pool2d_with_index")
+def max_pool2d_with_index(x, kernel_size, stride=None, padding=0,
+                          global_pooling=False, adaptive=False, name=None):
+    return _max_pool_with_index(x, kernel_size, stride, padding, 2,
+                                "max_pool2d_with_index")
+
+
+@register_op("max_pool3d_with_index")
+def max_pool3d_with_index(x, kernel_size, stride=None, padding=0,
+                          global_pooling=False, adaptive=False, name=None):
+    return _max_pool_with_index(x, kernel_size, stride, padding, 3,
+                                "max_pool3d_with_index")
+
+
+@register_op("max_pool2d_v2")
+def max_pool2d_v2(x, kernel_size, stride=None, padding=0, data_format="NCHW",
+                  global_pooling=False, adaptive=False, name=None):
+    return _max_pool_with_index(x, kernel_size, stride, padding, 2,
+                                "max_pool2d_v2")
+
+
+@register_op("lp_pool2d")
+def lp_pool2d(x, norm_type, kernel_size, stride=None, padding=0,
+              ceil_mode=False, data_format="NCHW", name=None):
+    k = _pair(kernel_size)
+    s = _pair(stride or kernel_size)
+    p = _pair(padding)
+
+    def f(a):
+        powed = jnp.abs(a) ** norm_type
+        summed = jax.lax.reduce_window(
+            powed, 0.0, jax.lax.add, (1, 1) + tuple(k), (1, 1) + tuple(s),
+            [(0, 0), (0, 0)] + [(pp, pp) for pp in p])
+        return summed ** (1.0 / norm_type)
+
+    return apply("lp_pool2d", f, x)
+
+
+def _fractional_indices(in_sz, out_sz, u):
+    """Fractional-pooling split points (Graham 2014 pseudo-random sequence)."""
+    alpha = in_sz / out_sz
+    idx = jnp.floor(alpha * (jnp.arange(out_sz, dtype=jnp.float32) + u))
+    idx = jnp.clip(idx.astype(jnp.int32), 0, in_sz - 1)
+    end = jnp.floor(alpha * (jnp.arange(1, out_sz + 1, dtype=jnp.float32) + u))
+    end = jnp.clip(end.astype(jnp.int32), 1, in_sz)
+    return idx, end
+
+
+def _fractional_max_pool(x, output_size, random_u, nd, opname):
+    def f(a):
+        sp = a.shape[2:]
+        u = random_u if random_u is not None else 0.5
+        # gather per output cell by max over the [start, end) span; spans have
+        # bounded length ceil(alpha)+1, so gather a fixed window and mask
+        outs = a
+        for d in range(nd):
+            in_sz, out_sz = sp[d], int(output_size[d])
+            start, end = _fractional_indices(in_sz, out_sz, u)
+            span = int(np.ceil(in_sz / out_sz)) + 1
+            gather_idx = jnp.clip(
+                start[:, None] + jnp.arange(span)[None, :], 0, in_sz - 1)
+            win = jnp.take(outs, gather_idx.reshape(-1), axis=2 + d)
+            shp = list(outs.shape)
+            shp[2 + d:2 + d + 1] = [out_sz, span]
+            win = win.reshape(shp)
+            valid = (start[:, None] + jnp.arange(span)[None, :]) < end[:, None]
+            vshape = [1] * win.ndim
+            vshape[2 + d] = out_sz
+            vshape[3 + d] = span
+            win = jnp.where(valid.reshape(vshape), win, -jnp.inf)
+            outs = jnp.max(win, axis=3 + d)
+        return outs.astype(a.dtype)
+
+    return apply(opname, f, x)
+
+
+@register_op("fractional_max_pool2d")
+def fractional_max_pool2d(x, output_size, kernel_size=None, random_u=None,
+                          return_mask=False, name=None):
+    return _fractional_max_pool(x, _pair(output_size), random_u, 2,
+                                "fractional_max_pool2d")
+
+
+@register_op("fractional_max_pool3d")
+def fractional_max_pool3d(x, output_size, kernel_size=None, random_u=None,
+                          return_mask=False, name=None):
+    return _fractional_max_pool(x, _pair(output_size, 3), random_u, 3,
+                                "fractional_max_pool3d")
+
+
+def _unpool_nd(x, indices, output_size, nd, opname):
+    def f(a, idx):
+        n, c = a.shape[:2]
+        out_sp = tuple(int(s) for s in output_size)
+        flat_len = int(np.prod(out_sp))
+        out = jnp.zeros((n, c, flat_len), a.dtype)
+        flat_vals = a.reshape(n, c, -1)
+        flat_idx = idx.reshape(n, c, -1)
+        out = jax.vmap(jax.vmap(lambda o, i, v: o.at[i].set(v)))(
+            out, flat_idx, flat_vals)
+        return out.reshape((n, c) + out_sp)
+
+    return apply(opname, f, x, indices)
+
+
+@register_op("unpool")
+def unpool(x, indices, kernel_size=None, stride=None, padding=0,
+           output_size=None, data_format="NCHW", name=None):
+    if output_size is None:
+        k = _pair(kernel_size)
+        s = _pair(stride or kernel_size)
+        output_size = [x.shape[2] * s[0], x.shape[3] * s[1]]
+    return _unpool_nd(x, indices, output_size[-2:], 2, "unpool")
+
+
+@register_op("unpool3d")
+def unpool3d(x, indices, kernel_size=None, stride=None, padding=0,
+             output_size=None, data_format="NCDHW", name=None):
+    if output_size is None:
+        k = _pair(kernel_size, 3)
+        s = _pair(stride or kernel_size, 3)
+        output_size = [x.shape[2] * s[0], x.shape[3] * s[1], x.shape[4] * s[2]]
+    return _unpool_nd(x, indices, output_size[-3:], 3, "unpool3d")
+
+
+# ----------------------------------------------------------- conv variants
+
+
+@register_op("depthwise_conv2d")
+def depthwise_conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1,
+                     groups=None, data_format="NCHW", name=None):
+    from paddle_tpu.nn import functional as F
+    return F.conv2d(x, weight, bias, stride=stride, padding=padding,
+                    dilation=dilation, groups=groups or x.shape[1],
+                    data_format=data_format)
+
+
+@register_op("conv3d_transpose")
+def conv3d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, dilation=1, groups=1,
+                     output_size=None, data_format="NCDHW", name=None):
+    from paddle_tpu.nn import functional as F
+    return F.conv3d_transpose(x, weight, bias, stride=stride, padding=padding,
+                              output_padding=output_padding, groups=groups,
+                              dilation=dilation, output_size=output_size,
+                              data_format=data_format)
+
+
+@register_op("depthwise_conv2d_transpose")
+def depthwise_conv2d_transpose(x, weight, bias=None, stride=1, padding=0,
+                               output_padding=0, dilation=1, groups=None,
+                               output_size=None, data_format="NCHW", name=None):
+    from paddle_tpu.nn import functional as F
+    ch_axis = 1 if data_format.startswith("NC") else x.ndim - 1
+    return F.conv2d_transpose(x, weight, bias, stride=stride, padding=padding,
+                              output_padding=output_padding,
+                              dilation=dilation,
+                              groups=groups or x.shape[ch_axis],
+                              output_size=output_size,
+                              data_format=data_format)
+
+
+@register_op("conv2d_transpose_bias")
+def conv2d_transpose_bias(x, weight, bias, stride=1, padding=0,
+                          output_padding=0, dilation=1, groups=1,
+                          output_size=None, data_format="NCHW", name=None):
+    from paddle_tpu.nn import functional as F
+    return F.conv2d_transpose(x, weight, bias, stride=stride, padding=padding,
+                              output_padding=output_padding,
+                              dilation=dilation, groups=groups,
+                              output_size=output_size,
+                              data_format=data_format)
+
+
+@register_op("deformable_conv")
+def deformable_conv(x, offset, weight, mask=None, stride=1, padding=0,
+                    dilation=1, deformable_groups=1, groups=1, im2col_step=64,
+                    name=None):
+    """Deformable conv v1/v2 (phi deformable_conv_kernel): bilinear-sample
+    input at offset-shifted taps, then a dense matmul over sampled patches.
+    The sampling is a gather — XLA lowers it to dynamic-gathers; the
+    contraction stays on the MXU."""
+    s = _pair(stride)
+    p = _pair(padding)
+    d = _pair(dilation)
+
+    def f(*args):
+        a, off, w = args[0], args[1], args[2]
+        msk = args[3] if len(args) > 3 else None
+        n, cin, h, wd = a.shape
+        cout, _, kh, kw = w.shape
+        oh = (h + 2 * p[0] - d[0] * (kh - 1) - 1) // s[0] + 1
+        ow = (wd + 2 * p[1] - d[1] * (kw - 1) - 1) // s[1] + 1
+        K = kh * kw
+        # base sampling grid [oh, ow, K]
+        gy = jnp.arange(oh) * s[0] - p[0]
+        gx = jnp.arange(ow) * s[1] - p[1]
+        ky = jnp.arange(kh) * d[0]
+        kx = jnp.arange(kw) * d[1]
+        base_y = gy[:, None, None] + ky[None, None, :].repeat(kw, -1).reshape(1, 1, K)
+        base_x = gx[None, :, None] + jnp.tile(kx, kh).reshape(1, 1, K)
+        # offsets: [n, 2*dg*K, oh, ow] -> y/x per tap
+        off = off.reshape(n, deformable_groups, K, 2, oh, ow)
+        oy = off[:, :, :, 0].transpose(0, 1, 3, 4, 2)  # [n, dg, oh, ow, K]
+        ox = off[:, :, :, 1].transpose(0, 1, 3, 4, 2)
+        sy = base_y[None, None] + oy
+        sx = base_x[None, None] + ox
+        # bilinear sample: [n, dg, cpg, oh, ow, K]
+        cpg = cin // deformable_groups
+        ag = a.reshape(n, deformable_groups, cpg, h, wd)
+
+        def sample(img, yy, xx):
+            y0 = jnp.floor(yy)
+            x0 = jnp.floor(xx)
+            wy = yy - y0
+            wx = xx - x0
+            out = 0.0
+            for dy, wy_ in ((0, 1 - wy), (1, wy)):
+                for dx, wx_ in ((0, 1 - wx), (1, wx)):
+                    yi = (y0 + dy).astype(jnp.int32)
+                    xi = (x0 + dx).astype(jnp.int32)
+                    valid = ((yi >= 0) & (yi < h) & (xi >= 0) & (xi < wd))
+                    yc = jnp.clip(yi, 0, h - 1)
+                    xc = jnp.clip(xi, 0, wd - 1)
+                    v = img[:, yc, xc]  # [cpg, oh, ow, K]
+                    out = out + jnp.where(valid[None], v, 0.0) * (wy_ * wx_)[None]
+            return out
+
+        sampled = jax.vmap(jax.vmap(sample))(ag, sy, sx)  # n,dg,cpg,oh,ow,K
+        if msk is not None:
+            m = msk.reshape(n, deformable_groups, K, oh, ow)
+            m = m.transpose(0, 1, 3, 4, 2)  # n,dg,oh,ow,K
+            sampled = sampled * m[:, :, None]
+        cols = sampled.reshape(n, cin, oh, ow, K)
+        wk = w.reshape(cout, cin // groups, K)
+        if groups == 1:
+            out = jnp.einsum("nchwk,ock->nohw", cols, wk)
+        else:
+            cols_g = cols.reshape(n, groups, cin // groups, oh, ow, K)
+            wk_g = wk.reshape(groups, cout // groups, cin // groups, K)
+            out = jnp.einsum("ngchwk,gock->ngohw", cols_g, wk_g)
+            out = out.reshape(n, cout, oh, ow)
+        return out
+
+    args = (x, offset, weight) + ((mask,) if mask is not None else ())
+    return apply("deformable_conv", f, *args)
+
+
+# ------------------------------------------------------ shuffles & padding
+
+
+@register_op("channel_shuffle")
+def channel_shuffle(x, groups, data_format="NCHW", name=None):
+    def f(a):
+        if data_format == "NCHW":
+            n, c, h, w = a.shape
+            return a.reshape(n, groups, c // groups, h, w) \
+                    .swapaxes(1, 2).reshape(n, c, h, w)
+        n, h, w, c = a.shape
+        return a.reshape(n, h, w, groups, c // groups) \
+                .swapaxes(3, 4).reshape(n, h, w, c)
+
+    return apply("channel_shuffle", f, x)
+
+
+@register_op("shuffle_channel")
+def shuffle_channel(x, group=1, name=None):
+    return channel_shuffle(x, group)
+
+
+@register_op("pad3d")
+def pad3d(x, paddings, mode="constant", value=0.0, data_format="NCDHW",
+          name=None):
+    def f(a):
+        # paddings: [l, r, t, b, front, back] on (W, H, D)
+        pw, ph, pd = paddings[0:2], paddings[2:4], paddings[4:6]
+        if data_format == "NCDHW":
+            cfg = [(0, 0), (0, 0), tuple(pd), tuple(ph), tuple(pw)]
+        else:
+            cfg = [(0, 0), tuple(pd), tuple(ph), tuple(pw), (0, 0)]
+        jmode = {"constant": "constant", "reflect": "reflect",
+                 "replicate": "edge", "circular": "wrap"}[mode]
+        if jmode == "constant":
+            return jnp.pad(a, cfg, mode="constant", constant_values=value)
+        return jnp.pad(a, cfg, mode=jmode)
+
+    return apply("pad3d", f, x)
+
+
+@register_op("temporal_shift")
+def temporal_shift(x, seg_num, shift_ratio=0.25, data_format="NCHW", name=None):
+    """TSM temporal shift (phi temporal_shift_kernel)."""
+    def f(a):
+        nt, c, h, w = a.shape
+        n = nt // seg_num
+        v = a.reshape(n, seg_num, c, h, w)
+        fold = int(c * shift_ratio)
+        left = jnp.concatenate([v[:, 1:, :fold], jnp.zeros_like(v[:, :1, :fold])], 1)
+        right = jnp.concatenate([jnp.zeros_like(v[:, :1, fold:2 * fold]),
+                                 v[:, :-1, fold:2 * fold]], 1)
+        rest = v[:, :, 2 * fold:]
+        return jnp.concatenate([left, right, rest], axis=2).reshape(nt, c, h, w)
+
+    return apply("temporal_shift", f, x)
+
+
+# ------------------------------------------------------------ sequence ops
+
+
+@register_op("sequence_pool")
+def sequence_pool(x, lengths=None, pool_type="SUM", pad_value=0.0, name=None):
+    """Padded-batch sequence pooling ([B, T, ...] + lengths), covering the
+    reference's LoD sequence_pool capability (phi sequence_pool kernel)."""
+    pool_type = pool_type.upper()
+
+    def f(a, ln):
+        t = a.shape[1]
+        mask = (jnp.arange(t)[None, :] < ln[:, None])
+        mshape = mask.shape + (1,) * (a.ndim - 2)
+        m = mask.reshape(mshape)
+        if pool_type == "SUM":
+            return jnp.sum(a * m, axis=1)
+        if pool_type == "AVERAGE":
+            return jnp.sum(a * m, axis=1) / jnp.maximum(
+                ln.reshape((-1,) + (1,) * (a.ndim - 2)), 1)
+        if pool_type == "SQRT":
+            return jnp.sum(a * m, axis=1) / jnp.sqrt(jnp.maximum(
+                ln.reshape((-1,) + (1,) * (a.ndim - 2)), 1).astype(a.dtype))
+        if pool_type == "MAX":
+            return jnp.max(jnp.where(m, a, -jnp.inf), axis=1)
+        if pool_type == "LAST":
+            idx = jnp.maximum(ln - 1, 0)
+            return jnp.take_along_axis(
+                a, idx.reshape((-1, 1) + (1,) * (a.ndim - 2)), axis=1)[:, 0]
+        if pool_type == "FIRST":
+            return a[:, 0]
+        raise ValueError(pool_type)
+
+    if lengths is None:
+        lengths = Tensor._from_value(
+            jnp.full((x.shape[0],), x.shape[1], jnp.int32))
+    return apply("sequence_pool", f, x, lengths)
+
+
+@register_op("sequence_conv")
+def sequence_conv(x, weight, lengths=None, context_length=3, context_start=None,
+                  padding_trainable=False, name=None):
+    """Context-window conv over padded sequences [B, T, D] (phi sequence_conv).
+    weight: [context_length * D, out]."""
+    start = -(context_length // 2) if context_start is None else context_start
+
+    def f(a, w):
+        b, t, dim = a.shape
+        cols = []
+        for i in range(context_length):
+            shift = start + i
+            rolled = jnp.roll(a, -shift, axis=1)
+            idx = jnp.arange(t) + shift
+            valid = ((idx >= 0) & (idx < t)).reshape(1, t, 1)
+            cols.append(jnp.where(valid, rolled, 0.0))
+        ctx = jnp.concatenate(cols, axis=-1)  # [B, T, ctx*D]
+        return ctx @ w
+
+    return apply("sequence_conv", f, x, weight)
+
+
+# ---------------------------------------------------------- spectral norm
+
+
+@register_op("spectral_norm")
+def spectral_norm(weight, u, v, dim=0, power_iters=1, eps=1e-12, name=None):
+    """Spectral normalization (phi spectral_norm_kernel): power iteration on
+    the reshaped weight matrix; returns W / sigma."""
+    def f(w, uu, vv):
+        wm = jnp.moveaxis(w, dim, 0).reshape(w.shape[dim], -1)
+        for _ in range(power_iters):
+            vv = wm.T @ uu
+            vv = vv / (jnp.linalg.norm(vv) + eps)
+            uu = wm @ vv
+            uu = uu / (jnp.linalg.norm(uu) + eps)
+        sigma = uu @ wm @ vv
+        return w / sigma
+
+    return apply("spectral_norm", f, weight, u, v)
+
+
+@register_op("sync_batch_norm_")
+def sync_batch_norm_(x, mean, variance, scale, bias, is_test=False,
+                     momentum=0.9, epsilon=1e-5, data_format="NCHW",
+                     use_global_stats=False, trainable_statistics=False,
+                     name=None):
+    """Cross-replica batch norm. Under jit+shard_map the mean/var reductions
+    become psums automatically (GSPMD); eager single-process path is plain BN
+    (reference: sync_batch_norm kernel's NCCL allreduce of statistics)."""
+    from paddle_tpu.nn import functional as F
+    return F.batch_norm(x, mean, variance, scale, bias, training=not is_test,
+                        momentum=momentum, epsilon=epsilon,
+                        data_format=data_format)
+
+
+# ---------------------------------------------------- margin-based softmax
+
+
+@register_op("margin_cross_entropy")
+def margin_cross_entropy(logits, label, margin1=1.0, margin2=0.5, margin3=0.0,
+                         scale=64.0, return_softmax=False, reduction=None,
+                         name=None):
+    """ArcFace/CosFace margin softmax CE (phi margin_cross_entropy_kernel):
+    cos(m1*theta + m2) - m3 applied to the target logit, then scaled CE."""
+    def f(lg, lb):
+        theta = jnp.arccos(jnp.clip(lg, -1.0, 1.0))
+        marged = jnp.cos(margin1 * theta + margin2) - margin3
+        onehot = jax.nn.one_hot(lb, lg.shape[-1], dtype=lg.dtype)
+        out = jnp.where(onehot > 0, marged, lg) * scale
+        logp = jax.nn.log_softmax(out, axis=-1)
+        loss = -jnp.sum(onehot * logp, axis=-1, keepdims=True)
+        sm = jnp.exp(logp)
+        return loss, sm
+
+    loss, sm = apply("margin_cross_entropy", f, logits, label)
+    return (loss, sm) if return_softmax else loss
+
+
+@register_op("class_center_sample", differentiable=False)
+def class_center_sample(label, num_classes, num_samples, group=None, name=None):
+    """Sample negative class centers (PartialFC). Host-side np sampling —
+    matches the reference's CPU path (phi class_center_sample_kernel)."""
+    lab = np.asarray(label.numpy() if isinstance(label, Tensor) else label)
+    pos = np.unique(lab)
+    if len(pos) >= num_samples:
+        sampled = pos
+    else:
+        rest = np.setdiff1d(np.arange(num_classes), pos)
+        extra = np.random.default_rng(0).choice(
+            rest, num_samples - len(pos), replace=False)
+        sampled = np.sort(np.concatenate([pos, extra]))
+    remap = np.full(num_classes, -1, np.int64)
+    remap[sampled] = np.arange(len(sampled))
+    return (Tensor._from_value(jnp.asarray(remap[lab])),
+            Tensor._from_value(jnp.asarray(sampled)))
+
+
+@register_op("hsigmoid_loss")
+def hsigmoid_loss(x, label, num_classes, weight, bias=None, path_table=None,
+                  path_code=None, is_sparse=False, name=None):
+    """Hierarchical sigmoid loss over a complete binary tree (default) or a
+    custom path table (phi hsigmoid_loss_kernel)."""
+    code_len = int(np.ceil(np.log2(max(num_classes, 2))))
+
+    def default_paths(lb):
+        # complete-binary-tree paths: node ids from the root, codes = bits
+        codes = []
+        nodes = []
+        cur = lb + num_classes  # leaves occupy [num_classes, 2*num_classes)
+        for _ in range(code_len):
+            codes.append(cur % 2)
+            cur = cur // 2
+            nodes.append(cur)
+        return (jnp.stack(nodes[::-1], -1) - 1,  # internal node index
+                jnp.stack(codes[::-1], -1).astype(jnp.float32))
+
+    def f(a, lb, w, *rest):
+        bias_v = rest[0] if bias is not None else None
+        if path_table is not None:
+            nodes = path_table._value
+            codes = path_code._value.astype(a.dtype)
+            valid = (nodes >= 0)
+            nodes = jnp.maximum(nodes, 0)
+        else:
+            nodes, codes = default_paths(lb)
+            valid = jnp.ones_like(codes, bool)
+        wn = w[nodes]                       # [B, L, D]
+        logit = jnp.einsum("bld,bd->bl", wn, a)
+        if bias_v is not None:
+            logit = logit + bias_v.reshape(-1)[nodes]
+        # sigmoid CE per node: code==1 means "go right" target
+        ce = jnp.maximum(logit, 0) - logit * codes + jnp.log1p(
+            jnp.exp(-jnp.abs(logit)))
+        ce = jnp.where(valid, ce, 0.0)
+        return jnp.sum(ce, axis=-1, keepdims=True)
+
+    args = (x, label, weight) + ((bias,) if bias is not None else ())
+    return apply("hsigmoid_loss", f, *args)
+
+
+@register_op("top_p_sampling", differentiable=False)
+def top_p_sampling(x, ps, threshold=None, seed=None, name=None):
+    """Nucleus sampling (phi top_p_sampling fused kernel): per-row sort,
+    cumulative-probability cutoff, categorical draw from the nucleus."""
+    key = rng.next_key() if seed in (None, 0, -1) else jax.random.PRNGKey(seed)
+
+    def f(probs, p):
+        order = jnp.argsort(-probs, axis=-1)
+        sorted_p = jnp.take_along_axis(probs, order, -1)
+        cum = jnp.cumsum(sorted_p, -1)
+        keep = cum - sorted_p < p.reshape(-1, 1)
+        keep = keep.at[..., 0].set(True)
+        masked = jnp.where(keep, sorted_p, 0.0)
+        masked = masked / jnp.sum(masked, -1, keepdims=True)
+        draw = jax.random.categorical(key, jnp.log(masked + 1e-20), axis=-1)
+        ids = jnp.take_along_axis(order, draw[..., None], -1)
+        scores = jnp.take_along_axis(probs, ids, -1)
+        return scores, ids.astype(jnp.int64)
+
+    scores, ids = apply("top_p_sampling", f, x, ps)
+    return ids, scores

@@ -1,0 +1,396 @@
+"""Optimizer-update ops from the reference manifest (sgd_, adam_, lamb_, ...).
+
+Reference kernels: paddle/phi/kernels/{cpu,gpu}/{sgd,adam,adamw,lamb,...}
+_kernel.cc/cu. These are the op-level (eager/registry) entry points that
+mutate param/state tensors in place and return them, mirroring the inplace
+`op_`-suffix YAML entries. The jitted TrainStep path uses
+paddle_tpu.optimizer.* (functional, fused) instead — same math, fused by XLA.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from paddle_tpu.ops.registry import register_op
+from paddle_tpu.tensor import Tensor
+
+
+def _v(t):
+    return t._value if isinstance(t, Tensor) else jnp.asarray(t)
+
+
+def _scalar(t):
+    v = _v(t)
+    return v.reshape(()) if hasattr(v, "reshape") else v
+
+
+def _set(t, val):
+    t._value = val.astype(t._value.dtype)
+    return t
+
+
+@register_op("sgd_", differentiable=False)
+def sgd_(param, learning_rate, grad, master_param=None,
+         multi_precision=False, name=None):
+    lr = _scalar(learning_rate)
+    return _set(param, _v(param) - lr * _v(grad)), master_param
+
+
+@register_op("momentum_", differentiable=False)
+def momentum_(param, grad, velocity, learning_rate, master_param=None,
+              mu=0.9, use_nesterov=False, regularization_method="",
+              regularization_coeff=0.0, multi_precision=False,
+              rescale_grad=1.0, name=None):
+    lr = _scalar(learning_rate)
+    g = _v(grad) * rescale_grad
+    if regularization_method == "l2_decay":
+        g = g + regularization_coeff * _v(param)
+    v_new = mu * _v(velocity) + g
+    step = (g + mu * v_new) if use_nesterov else v_new
+    _set(velocity, v_new)
+    return _set(param, _v(param) - lr * step), velocity, master_param
+
+
+@register_op("merged_momentum_", differentiable=False)
+def merged_momentum_(params, grads, velocitys, learning_rate,
+                     master_params=None, mu=0.9, use_nesterov=False, **kw):
+    for i, (p, g, v) in enumerate(zip(params, grads, velocitys)):
+        momentum_(p, g, v, learning_rate, mu=mu, use_nesterov=use_nesterov)
+    return params, velocitys, master_params
+
+
+@register_op("adam_", differentiable=False)
+def adam_(param, grad, learning_rate, moment1, moment2, beta1_pow, beta2_pow,
+          master_param=None, skip_update=None, beta1=0.9, beta2=0.999,
+          epsilon=1e-8, lazy_mode=False, min_row_size_to_use_multithread=1000,
+          multi_precision=False, use_global_beta_pow=False, name=None,
+          moment2_max=None, amsgrad=False):
+    if skip_update is not None and bool(_v(skip_update)):
+        return param, moment1, moment2, beta1_pow, beta2_pow, master_param
+    lr = _scalar(learning_rate)
+    g = _v(grad)
+    m1 = beta1 * _v(moment1) + (1 - beta1) * g
+    m2 = beta2 * _v(moment2) + (1 - beta2) * g * g
+    # phi adam kernel convention: bias correction uses the INPUT pow
+    # accumulators (the python optimizer initializes them to beta1/beta2),
+    # and the kernel multiplies them by beta afterward
+    b1p = _v(beta1_pow) * 1.0
+    b2p = _v(beta2_pow) * 1.0
+    lr_t = lr * jnp.sqrt(1 - b2p) / (1 - b1p)
+    denom = m2
+    if amsgrad and moment2_max is not None:
+        mmax = jnp.maximum(_v(moment2_max), m2)
+        _set(moment2_max, mmax)
+        denom = mmax
+    new_p = _v(param) - lr_t.reshape(()) * m1 / (jnp.sqrt(denom) + epsilon)
+    _set(moment1, m1)
+    _set(moment2, m2)
+    _set(beta1_pow, b1p * beta1)
+    _set(beta2_pow, b2p * beta2)
+    return _set(param, new_p), moment1, moment2, beta1_pow, beta2_pow, master_param
+
+
+@register_op("merged_adam_", differentiable=False)
+def merged_adam_(params, grads, learning_rate, moment1s, moment2s, beta1_pows,
+                 beta2_pows, master_params=None, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, **kw):
+    for p, g, m1, m2, b1, b2 in zip(params, grads, moment1s, moment2s,
+                                    beta1_pows, beta2_pows):
+        adam_(p, g, learning_rate, m1, m2, b1, b2,
+              beta1=beta1, beta2=beta2, epsilon=epsilon)
+    return params, moment1s, moment2s, beta1_pows, beta2_pows, master_params
+
+
+@register_op("adamw_", differentiable=False)
+def adamw_(param, grad, learning_rate, moment1, moment2, beta1_pow, beta2_pow,
+           master_param=None, skip_update=None, beta1=0.9, beta2=0.999,
+           epsilon=1e-8, lr_ratio=1.0, coeff=0.01, with_decay=True,
+           lazy_mode=False, multi_precision=False, **kw):
+    if with_decay:
+        lr = _scalar(learning_rate) * lr_ratio
+        _set(param, _v(param) * (1 - lr * coeff))
+    return adam_(param, grad, learning_rate, moment1, moment2, beta1_pow,
+                 beta2_pow, master_param=master_param, skip_update=skip_update,
+                 beta1=beta1, beta2=beta2, epsilon=epsilon)
+
+
+@register_op("adamax_", differentiable=False)
+def adamax_(param, grad, learning_rate, moment, inf_norm, beta1_pow,
+            master_param=None, beta1=0.9, beta2=0.999, epsilon=1e-8,
+            multi_precision=False, name=None):
+    lr = _scalar(learning_rate)
+    g = _v(grad)
+    m = beta1 * _v(moment) + (1 - beta1) * g
+    inf = jnp.maximum(beta2 * _v(inf_norm), jnp.abs(g) + epsilon)
+    b1p = _v(beta1_pow) * 1.0  # input convention (see adam_)
+    new_p = _v(param) - (lr / (1 - b1p)).reshape(()) * m / inf
+    _set(moment, m)
+    _set(inf_norm, inf)
+    _set(beta1_pow, b1p * beta1)
+    return _set(param, new_p), moment, inf_norm, beta1_pow, master_param
+
+
+@register_op("adagrad_", differentiable=False)
+def adagrad_(param, grad, moment, learning_rate, master_param=None,
+             epsilon=1e-6, multi_precision=False, name=None):
+    lr = _scalar(learning_rate)
+    g = _v(grad)
+    mom = _v(moment) + g * g
+    _set(moment, mom)
+    return (_set(param, _v(param) - lr * g / (jnp.sqrt(mom) + epsilon)),
+            moment, master_param)
+
+
+@register_op("decayed_adagrad", differentiable=False)
+def decayed_adagrad(param, grad, moment, learning_rate, decay=0.95,
+                    epsilon=1e-6, name=None):
+    lr = _scalar(learning_rate)
+    g = _v(grad)
+    mom = decay * _v(moment) + (1 - decay) * g * g
+    _set(moment, mom)
+    return (_set(param, _v(param) - lr * g / (jnp.sqrt(mom) + epsilon)), moment)
+
+
+@register_op("adadelta_", differentiable=False)
+def adadelta_(param, grad, avg_squared_grad, avg_squared_update,
+              learning_rate, master_param=None, rho=0.95, epsilon=1e-6,
+              multi_precision=False, name=None):
+    lr = _scalar(learning_rate)
+    g = _v(grad)
+    eg = rho * _v(avg_squared_grad) + (1 - rho) * g * g
+    delta = jnp.sqrt(_v(avg_squared_update) + epsilon) / jnp.sqrt(eg + epsilon) * g
+    eu = rho * _v(avg_squared_update) + (1 - rho) * delta * delta
+    _set(avg_squared_grad, eg)
+    _set(avg_squared_update, eu)
+    return (_set(param, _v(param) - lr * delta), avg_squared_grad,
+            avg_squared_update, master_param)
+
+
+@register_op("rmsprop_", differentiable=False)
+def rmsprop_(param, mean_square, grad, moment, learning_rate, mean_grad=None,
+             master_param=None, epsilon=1e-10, decay=0.9, momentum=0.0,
+             centered=False, multi_precision=False, name=None):
+    lr = _scalar(learning_rate)
+    g = _v(grad)
+    ms = decay * _v(mean_square) + (1 - decay) * g * g
+    if centered and mean_grad is not None:
+        mg = decay * _v(mean_grad) + (1 - decay) * g
+        denom = ms - mg * mg
+        _set(mean_grad, mg)
+    else:
+        denom = ms
+    mom = momentum * _v(moment) + lr * g / jnp.sqrt(denom + epsilon)
+    _set(mean_square, ms)
+    _set(moment, mom)
+    return (_set(param, _v(param) - mom), mean_square, moment, mean_grad,
+            master_param)
+
+
+@register_op("asgd_", differentiable=False)
+def asgd_(param, grad, learning_rate, d, y, n, master_param=None,
+          multi_precision=False, name=None):
+    """ASGD (phi asgd_kernel): d += grad - y; y = grad; p -= lr/n * d."""
+    lr = _scalar(learning_rate)
+    g = _v(grad)
+    d_new = _v(d) - _v(y) + g
+    _set(d, d_new)
+    _set(y, g)
+    return (_set(param, _v(param) - (lr / _scalar(n)) * d_new), d, y,
+            master_param)
+
+
+@register_op("nadam_", differentiable=False)
+def nadam_(param, grad, learning_rate, momentum_decay_pow, beta2_pow,
+           mu_product, moment1, moment2, master_param=None, beta1=0.9,
+           beta2=0.999, epsilon=1e-8, momentum_decay=0.004,
+           multi_precision=False, name=None):
+    lr = _scalar(learning_rate)
+    g = _v(grad)
+    # input convention (see adam_): use incoming accumulators, update after
+    mdp = _v(momentum_decay_pow) * 1.0
+    b2p = _v(beta2_pow) * 1.0
+    mu_t = beta1 * (1 - 0.5 * mdp)
+    mu_t1 = beta1 * (1 - 0.5 * mdp * 0.96 ** momentum_decay)
+    mu_prod = _v(mu_product) * mu_t
+    m1 = beta1 * _v(moment1) + (1 - beta1) * g
+    m2 = beta2 * _v(moment2) + (1 - beta2) * g * g
+    m1_hat = mu_t1 * m1 / (1 - mu_prod * mu_t1) + (1 - mu_t) * g / (1 - mu_prod)
+    m2_hat = m2 / (1 - b2p)
+    _set(momentum_decay_pow, mdp * 0.96 ** momentum_decay)
+    _set(beta2_pow, b2p * beta2)
+    _set(mu_product, mu_prod)
+    _set(moment1, m1)
+    _set(moment2, m2)
+    new_p = _v(param) - lr * m1_hat / (jnp.sqrt(m2_hat) + epsilon)
+    return (_set(param, new_p), momentum_decay_pow, beta2_pow, mu_product,
+            moment1, moment2, master_param)
+
+
+@register_op("radam_", differentiable=False)
+def radam_(param, grad, learning_rate, beta1_pow, beta2_pow, rho,
+           moment1, moment2, master_param=None, beta1=0.9, beta2=0.999,
+           epsilon=1e-8, multi_precision=False, name=None):
+    lr = _scalar(learning_rate)
+    g = _v(grad)
+    b1p = _v(beta1_pow) * 1.0  # input convention (see adam_)
+    b2p = _v(beta2_pow) * 1.0
+    rho_inf = 2.0 / (1 - beta2) - 1
+    m1 = beta1 * _v(moment1) + (1 - beta1) * g
+    m2 = beta2 * _v(moment2) + (1 - beta2) * g * g
+    t = jnp.log(b2p) / jnp.log(beta2)  # step count recovered from beta2^t
+    step_rho = rho_inf - 2.0 * t * b2p / (1 - b2p)
+    m1_hat = m1 / (1 - b1p)
+    r = jnp.sqrt(((step_rho - 4) * (step_rho - 2) * rho_inf)
+                 / jnp.maximum((rho_inf - 4) * (rho_inf - 2) * step_rho, 1e-12))
+    adaptive = r * m1_hat / (jnp.sqrt(m2 / (1 - b2p)) + epsilon)
+    sgd_step = m1_hat
+    new_p = _v(param) - lr * jnp.where(step_rho > 5.0, adaptive, sgd_step)
+    _set(beta1_pow, b1p * beta1)
+    _set(beta2_pow, b2p * beta2)
+    _set(moment1, m1)
+    _set(moment2, m2)
+    return (_set(param, new_p), beta1_pow, beta2_pow, rho, moment1, moment2,
+            master_param)
+
+
+@register_op("rprop_", differentiable=False)
+def rprop_(param, grad, prev, learning_rate, master_param=None,
+           learning_rate_range=(1e-6, 50.0), etas=(0.5, 1.2), name=None):
+    """Rprop (phi rprop_kernel): per-weight step sizes adapted by grad-sign
+    agreement; learning_rate here is the per-weight step tensor."""
+    g = _v(grad)
+    pg = _v(prev)
+    step = _v(learning_rate)
+    sign = jnp.sign(g * pg)
+    eta_minus, eta_plus = etas
+    lo, hi = learning_rate_range
+    step_new = jnp.clip(
+        jnp.where(sign > 0, step * eta_plus,
+                  jnp.where(sign < 0, step * eta_minus, step)), lo, hi)
+    g_eff = jnp.where(sign < 0, jnp.zeros_like(g), g)
+    _set(prev, g_eff)
+    _set(learning_rate, step_new)
+    return (_set(param, _v(param) - jnp.sign(g_eff) * step_new), prev,
+            learning_rate, master_param)
+
+
+@register_op("lamb_", differentiable=False)
+def lamb_(param, grad, learning_rate, moment1, moment2, beta1_pow, beta2_pow,
+          master_param=None, skip_update=None, weight_decay=0.01, beta1=0.9,
+          beta2=0.999, epsilon=1e-6, always_adapt=False,
+          multi_precision=False, name=None):
+    if skip_update is not None and bool(_v(skip_update)):
+        return param, moment1, moment2, beta1_pow, beta2_pow, master_param
+    lr = _scalar(learning_rate)
+    g = _v(grad)
+    p = _v(param)
+    m1 = beta1 * _v(moment1) + (1 - beta1) * g
+    m2 = beta2 * _v(moment2) + (1 - beta2) * g * g
+    b1p = _v(beta1_pow) * 1.0  # input convention (see adam_)
+    b2p = _v(beta2_pow) * 1.0
+    update = (m1 / (1 - b1p)) / (jnp.sqrt(m2 / (1 - b2p)) + epsilon) \
+        + weight_decay * p
+    w_norm = jnp.linalg.norm(p)
+    u_norm = jnp.linalg.norm(update)
+    trust = jnp.where((w_norm > 0) & (u_norm > 0), w_norm / u_norm, 1.0)
+    _set(moment1, m1)
+    _set(moment2, m2)
+    _set(beta1_pow, b1p * beta1)
+    _set(beta2_pow, b2p * beta2)
+    return (_set(param, p - lr * trust * update), moment1, moment2,
+            beta1_pow, beta2_pow, master_param)
+
+
+@register_op("average_accumulates_", differentiable=False)
+def average_accumulates_(param, in_sum_1, in_sum_2, in_sum_3,
+                         in_num_accumulates, in_old_num_accumulates,
+                         in_num_updates, average_window=10000,
+                         max_average_window=10000, min_average_window=10000,
+                         name=None):
+    """ModelAverage accumulators (phi average_accumulates_kernel)."""
+    num_acc = int(_v(in_num_accumulates)) + 1
+    num_upd = int(_v(in_num_updates)) + 1
+    old = int(_v(in_old_num_accumulates))
+    _set(in_sum_1, _v(in_sum_1) + _v(param))
+    if num_acc > max_average_window or num_acc > average_window * num_upd:
+        _set(in_sum_2, _v(in_sum_2) + _v(in_sum_1))
+        _set(in_sum_1, jnp.zeros_like(_v(in_sum_1)))
+        old += num_acc
+        num_acc = 0
+        if old > max_average_window:
+            _set(in_sum_3, _v(in_sum_2))
+            _set(in_sum_2, jnp.zeros_like(_v(in_sum_2)))
+            old = 0
+    in_num_accumulates._value = jnp.asarray(num_acc, jnp.int64)
+    in_old_num_accumulates._value = jnp.asarray(old, jnp.int64)
+    in_num_updates._value = jnp.asarray(num_upd, jnp.int64)
+    return (in_sum_1, in_sum_2, in_sum_3, in_num_accumulates,
+            in_old_num_accumulates, in_num_updates)
+
+
+@register_op("check_finite_and_unscale_", differentiable=False)
+def check_finite_and_unscale_(xs, scale, name=None):
+    """AMP unscale (phi check_finite_and_unscale_kernel): xs /= scale;
+    found_inf = any nonfinite. found_inf stays device-side (no host sync)."""
+    inv = 1.0 / _scalar(scale)
+    found = jnp.asarray(False)
+    for x in xs:
+        v = _v(x) * inv
+        found = found | ~jnp.all(jnp.isfinite(v))
+        _set(x, v)
+    return xs, Tensor._from_value(found)
+
+
+@register_op("update_loss_scaling_", differentiable=False)
+def update_loss_scaling_(xs, found_inf, prev_loss_scaling, in_good_steps,
+                         in_bad_steps, incr_every_n_steps=1000,
+                         decr_every_n_nan_or_inf=2, incr_ratio=2.0,
+                         decr_ratio=0.5, stop_update=False, name=None):
+    """Dynamic loss-scale state machine (phi update_loss_scaling_kernel)."""
+    fi = _v(found_inf)
+    scale = _scalar(prev_loss_scaling)
+    good = _scalar(in_good_steps)
+    bad = _scalar(in_bad_steps)
+    bad_new = jnp.where(fi, bad + 1, 0)
+    good_new = jnp.where(fi, 0, good + 1)
+    decr = bad_new >= decr_every_n_nan_or_inf
+    incr = good_new >= incr_every_n_steps
+    scale_new = jnp.where(decr, jnp.maximum(scale * decr_ratio, 1.0),
+                          jnp.where(incr, scale * incr_ratio, scale))
+    good_new = jnp.where(incr, 0, good_new)
+    bad_new = jnp.where(decr, 0, bad_new)
+    if not stop_update:
+        _set(prev_loss_scaling, scale_new)
+        in_good_steps._value = good_new.astype(jnp.int32)
+        in_bad_steps._value = bad_new.astype(jnp.int32)
+    if fi:
+        for x in xs:
+            _set(x, jnp.zeros_like(_v(x)))
+    return xs, prev_loss_scaling, in_good_steps, in_bad_steps
+
+
+@register_op("distributed_fused_lamb_init", differentiable=False)
+def distributed_fused_lamb_init(params, grads, beta1=0.9, beta2=0.999,
+                                apply_weight_decay=None, alignment=128,
+                                rank=0, nranks=1, name=None):
+    """Flatten params/grads into aligned fused buffers + zeroed moments
+    (reference: fusion/gpu/distributed_fused_lamb_init_kernel.cu). Returns
+    (fp32 fused param, fp32 fused grad, moment1, moment2, beta1pow, beta2pow,
+    per-param views)."""
+    flats = [jnp.ravel(_v(p)).astype(jnp.float32) for p in params]
+    sizes = [f.shape[0] for f in flats]
+    pad = lambda f: jnp.pad(f, (0, (-f.shape[0]) % alignment))
+    fused_p = jnp.concatenate([pad(f) for f in flats]) if flats else jnp.zeros((0,))
+    fused_g = jnp.zeros_like(fused_p)
+    views = []
+    off = 0
+    for p, n in zip(params, sizes):
+        aligned = n + ((-n) % alignment)
+        views.append(Tensor._from_value(
+            fused_p[off:off + n].reshape(p.shape)))
+        off += aligned
+    mk = lambda: Tensor._from_value(jnp.zeros_like(fused_p))
+    return (Tensor._from_value(fused_p), Tensor._from_value(fused_g),
+            mk(), mk(),
+            Tensor._from_value(jnp.ones((), jnp.float32)),
+            Tensor._from_value(jnp.ones((), jnp.float32)), views)

@@ -1,0 +1,316 @@
+"""Op-parity bookkeeping against the reference schema YAML.
+
+The reference defines its op surface in /root/reference/paddle/phi/ops/yaml/
+{ops,fused_ops,sparse_ops}.yaml (538 unique ops). ``ref_manifest.REFERENCE_OPS``
+is the checked-in extraction; this module (a) documents the justified skip
+set, and (b) registers implementations that live outside ``paddle_tpu.ops``
+(nn.functional, incubate, sparse, text, fft, ...) under their reference op
+names so the parity audit (tests/test_op_parity.py) sees them.
+
+Skip policy: an op is skipped only when its *capability* is vendor-bound
+(XPU/NPU/oneDNN/cuDNN-handle kernels), stream-semantics-bound (CUDA stream
+sync has no analogue under XLA's compiled schedule), or belongs to the
+CPU parameter-server runtime's sparse-feature pipeline. Everything else is
+implemented, even when XLA would have fused the composition anyway.
+"""
+
+from __future__ import annotations
+
+import importlib
+
+from paddle_tpu.ops.ref_manifest import REFERENCE_OPS
+from paddle_tpu.ops.registry import register_op
+
+# --------------------------------------------------------------------------
+# Justified skips. name -> reason. Kept small and auditable on purpose.
+# --------------------------------------------------------------------------
+
+SKIPPED_OPS = {}
+
+for _n, _cat in REFERENCE_OPS.items():
+    if _n.endswith("_xpu"):
+        # e.g. fc_xpu, conv2d_xpu, ... (fused_ops.yaml): hand-fused kernels
+        # for the Kunlun XPU vendor backend; the generic op covers the
+        # capability and XLA performs the fusion on TPU.
+        SKIPPED_OPS[_n] = "Kunlun-XPU vendor fused kernel; generic op + XLA fusion covers it"
+
+SKIPPED_OPS.update({
+    "npu_identity": "Ascend-NPU vendor format op",
+    "cudnn_lstm": "cuDNN handle-bound kernel; capability provided by the generic rnn/lstm ops",
+    "c_sync_calc_stream": "CUDA stream sync; XLA's compiled schedule has no user-visible streams",
+    "c_sync_comm_stream": "CUDA stream sync; same as c_sync_calc_stream",
+    "dgc": "deep-gradient-compression sparse allreduce (NCCL-era); out of scope on ICI collectives",
+    "dgc_momentum": "companion op of dgc",
+    "pyramid_hash": "parameter-server sparse-feature hashing (CPU PS runtime)",
+    "tdm_child": "tree-deep-match PS op (CPU PS runtime)",
+    "tdm_sampler": "tree-deep-match PS op (CPU PS runtime)",
+    "shuffle_batch": "PS-runtime in-batch shuffling op",
+    "graph_khop_sampler": "data-dependent-shape graph sampling; host-side in the dataloader on TPU",
+    "graph_sample_neighbors": "same as graph_khop_sampler",
+    "weighted_sample_neighbors": "same as graph_khop_sampler",
+    "reindex_graph": "companion of the graph samplers",
+    "fusion_gru": "oneDNN CPU fusion kernel; gru covers the capability",
+    "fusion_lstm": "oneDNN CPU fusion kernel; lstm covers the capability",
+    "fusion_repeated_fc_relu": "oneDNN CPU fusion kernel",
+    "fusion_seqconv_eltadd_relu": "oneDNN CPU sequence fusion kernel",
+    "fusion_seqexpand_concat_fc": "oneDNN CPU sequence fusion kernel",
+    "fusion_seqpool_cvm_concat": "oneDNN CPU sequence fusion kernel (CVM is a PS-era feature)",
+    "fusion_squared_mat_sub": "oneDNN CPU fusion kernel",
+    "self_dp_attention": "oneDNN CPU fused attention; scaled_dot_product_attention covers it",
+    "fusion_group": "CUDA codegen'd elementwise group (CINN-era); XLA fusion is the substrate",
+    "fusion_transpose_flatten_concat": "cuDNN-layout fusion; transpose+flatten+concat compose",
+    "fused_dconv_drelu_dbn": "cuDNN-frontend backward-fusion kernel",
+    "fused_scale_bias_relu_conv_bn": "cuDNN-frontend forward-fusion kernel",
+    "conv3d_implicit_gemm": "CUTLASS implicit-GEMM variant; conv3d covers the capability",
+    "sparse_attention": "cuSPARSE block-sparse attention; TPU path is flash/ring attention",
+    "decode_jpeg": "nvJPEG device decode; no image codec in this environment (dataloader decodes host-side)",
+    "moe": "monolithic fused-MoE kernel; MoELayer + (assign_pos/number_count/...) cover the capability",
+    "data": "PIR program-construction feed op; the jaxpr substrate has no analogue",
+    "depend": "PIR scheduling-edge op; XLA dependency graph is the substrate",
+})
+
+# --------------------------------------------------------------------------
+# Registration of ops implemented outside paddle_tpu.ops.*
+# (ref_name, "module:attr"). Name differences from the reference YAML are
+# noted inline; semantics are the paddle API semantics of the same kernel.
+# --------------------------------------------------------------------------
+
+_EXISTING = [
+    # activations (nn/functional.py)
+    ("relu", "paddle_tpu.nn.functional:relu"),
+    ("relu6", "paddle_tpu.nn.functional:relu6"),
+    ("selu", "paddle_tpu.nn.functional:selu"),
+    ("silu", "paddle_tpu.nn.functional:silu"),
+    ("celu", "paddle_tpu.nn.functional:celu"),
+    ("elu", "paddle_tpu.nn.functional:elu"),
+    ("gelu", "paddle_tpu.nn.functional:gelu"),
+    ("mish", "paddle_tpu.nn.functional:mish"),
+    ("swish", "paddle_tpu.nn.functional:swish"),
+    ("maxout", "paddle_tpu.nn.functional:maxout"),
+    ("leaky_relu", "paddle_tpu.nn.functional:leaky_relu"),
+    ("prelu", "paddle_tpu.nn.functional:prelu"),
+    ("rrelu", "paddle_tpu.nn.functional:rrelu"),
+    ("hardtanh", "paddle_tpu.nn.functional:hardtanh"),
+    ("hardshrink", "paddle_tpu.nn.functional:hardshrink"),
+    ("hardsigmoid", "paddle_tpu.nn.functional:hardsigmoid"),
+    ("softshrink", "paddle_tpu.nn.functional:softshrink"),
+    ("softsign", "paddle_tpu.nn.functional:softsign"),
+    ("thresholded_relu", "paddle_tpu.nn.functional:thresholded_relu"),
+    ("logsigmoid", "paddle_tpu.nn.functional:log_sigmoid"),
+    ("tanh_shrink", "paddle_tpu.nn.functional:tanhshrink"),
+    ("softmax", "paddle_tpu.nn.functional:softmax"),
+    ("log_softmax", "paddle_tpu.nn.functional:log_softmax"),
+    ("gumbel_softmax", "paddle_tpu.nn.functional:gumbel_softmax"),
+    # norms
+    ("layer_norm", "paddle_tpu.nn.functional:layer_norm"),
+    ("group_norm", "paddle_tpu.nn.functional:group_norm"),
+    ("instance_norm", "paddle_tpu.nn.functional:instance_norm"),
+    ("batch_norm_", "paddle_tpu.nn.functional:batch_norm"),
+    ("rms_norm", "paddle_tpu.nn.functional:rms_norm"),
+    # convs / pools / shaping
+    ("conv2d", "paddle_tpu.nn.functional:conv2d"),
+    ("conv3d", "paddle_tpu.nn.functional:conv3d"),
+    ("conv2d_transpose", "paddle_tpu.nn.functional:conv2d_transpose"),
+    ("fold", "paddle_tpu.nn.functional:fold"),
+    ("pixel_shuffle", "paddle_tpu.nn.functional:pixel_shuffle"),
+    ("pixel_unshuffle", "paddle_tpu.nn.functional:pixel_unshuffle"),
+    ("affine_grid", "paddle_tpu.nn.functional:affine_grid"),
+    ("grid_sample", "paddle_tpu.nn.functional:grid_sample"),
+    # dropout / misc nn
+    ("dropout", "paddle_tpu.nn.functional:dropout"),
+    ("label_smooth", "paddle_tpu.nn.functional:label_smooth"),
+    ("sequence_mask", "paddle_tpu.nn.functional:sequence_mask"),
+    # losses (paddle name -> ref kernel name)
+    ("nll_loss", "paddle_tpu.nn.functional:nll_loss"),
+    ("huber_loss", "paddle_tpu.nn.functional:huber_loss"),
+    ("kldiv_loss", "paddle_tpu.nn.functional:kl_div"),
+    ("bce_loss", "paddle_tpu.nn.functional:binary_cross_entropy"),
+    ("sigmoid_cross_entropy_with_logits",
+     "paddle_tpu.nn.functional:binary_cross_entropy_with_logits"),
+    ("cross_entropy_with_softmax",
+     "paddle_tpu.nn.functional:softmax_with_cross_entropy"),
+    ("warpctc", "paddle_tpu.nn.functional:ctc_loss"),
+    ("square_error_cost", "paddle_tpu.nn.functional:square_error_cost"),
+    # vision / text
+    ("nms", "paddle_tpu.vision.ops:nms"),
+    ("viterbi_decode", "paddle_tpu.text:viterbi_decode"),
+    # sparse
+    ("sparse_coo_tensor", "paddle_tpu.sparse:sparse_coo_tensor"),
+    ("to_dense", "paddle_tpu.sparse:to_dense"),
+    ("to_sparse_coo", "paddle_tpu.sparse:to_sparse_coo"),
+    # incubate fused ops
+    ("swiglu", "paddle_tpu.incubate.nn.functional:swiglu"),
+    ("fused_bias_act", "paddle_tpu.incubate.nn.functional:fused_bias_act"),
+    ("fused_rotary_position_embedding",
+     "paddle_tpu.incubate.nn.functional:fused_rotary_position_embedding"),
+    ("fused_attention",
+     "paddle_tpu.incubate.nn.functional:fused_multi_head_attention"),
+    ("masked_multihead_attention_",
+     "paddle_tpu.incubate.nn.functional:masked_multihead_attention"),
+    ("block_multihead_attention_",
+     "paddle_tpu.incubate.nn.functional:block_multihead_attention"),
+    ("fused_bias_residual_layernorm",
+     "paddle_tpu.incubate.nn.functional:fused_layer_norm"),
+    # inplace-variant creation
+    ("full_", "paddle_tpu:full"),
+]
+
+_CATEGORY_DEFAULT = {"core": "nn", "fused": "fused", "sparse": "sparse"}
+
+
+def _register_existing():
+    for ref_name, path in _EXISTING:
+        mod_name, attr = path.split(":")
+        fn = getattr(importlib.import_module(mod_name), attr)
+        cat = _CATEGORY_DEFAULT.get(REFERENCE_OPS.get(ref_name, "core"), "nn")
+        register_op(ref_name, category=cat)(fn)
+
+
+_register_existing()
+
+# Family modules implementing the rest of the manifest, imported for their
+# registration side effects. Registration happens once at `import paddle_tpu`
+# — the same static-registry model as the reference's PD_REGISTER_KERNEL
+# (cheap: module definitions only, no jax compilation at import).
+from paddle_tpu.ops import detection_ops  # noqa: E402,F401
+from paddle_tpu.ops import extra_math  # noqa: E402,F401
+from paddle_tpu.ops import fused_compose  # noqa: E402,F401
+from paddle_tpu.ops import nn_extra  # noqa: E402,F401
+from paddle_tpu.ops import optim_ops  # noqa: E402,F401
+from paddle_tpu.ops import random_ops  # noqa: E402,F401
+from paddle_tpu.ops import rnn_ops  # noqa: E402,F401
+from paddle_tpu.ops import signal_quant_ops  # noqa: E402,F401
+
+
+def _synthesize_inplace_variants():
+    """Register the reference's ``op_`` inplace aliases (97 ops carry an
+    `inplace:` schema key, e.g. relu -> relu_): the wrapper runs the base op
+    and writes the result back into the aliased Tensor argument — paddle's
+    eager inplace semantics on an immutable-array substrate (the Tensor
+    wrapper swaps its buffer; XLA sees a pure program either way).
+
+    Correctness constraints (review r2): an op is synthesized ONLY when the
+    schema's aliased input is provably our fn's first parameter (ops with
+    other alias layouts — where_: x not cond; cross_entropy_with_softmax_:
+    output index 1 — get explicit implementations or none), and mutating a
+    tensor that REQUIRES GRAD raises, like the reference's
+    "leaf Variable that requires grad is used in an in-place operation"
+    guard — the object-identity tape cannot alias a tensor as both input
+    and output of one node, and silently dropping the gradient would be
+    worse than refusing."""
+    import inspect
+    import re as _re
+
+    from paddle_tpu.ops.ref_manifest import REFERENCE_SCHEMA
+    from paddle_tpu.ops.registry import _REGISTRY
+    from paddle_tpu.tensor import Tensor
+
+    def make(base_fn, inplace_name):
+        def op_(x, *args, **kwargs):
+            _guard_inplace_grad(x, inplace_name)
+            out = base_fn(x, *args, **kwargs)
+            first = out[0] if isinstance(out, (tuple, list)) else out
+            if isinstance(x, Tensor) and isinstance(first, Tensor):
+                x._replace_value(first._value)
+                if isinstance(out, (tuple, list)):
+                    return type(out)([x] + list(out[1:]))
+                return x
+            return out
+
+        op_.__name__ = inplace_name
+        return op_
+
+    for name, meta in REFERENCE_SCHEMA.items():
+        if not meta.get("inplace") or name.endswith("_"):
+            continue
+        inplace_name = name + "_"
+        if inplace_name in _REGISTRY or name not in _REGISTRY:
+            continue
+        spec = _REGISTRY[name]
+        pairs = _re.findall(r"\(\s*(\w+)\s*->\s*(\w+)\s*\)",
+                            str(meta["inplace"]))
+        if not pairs:
+            continue
+        src = pairs[0][0]
+        try:
+            params = list(inspect.signature(spec.fn).parameters)
+        except (TypeError, ValueError):
+            continue
+        # only the provable layout: the aliased input IS our first param
+        # (name match or the ubiquitous x/input naming), single alias pair
+        if len(pairs) != 1 or not params:
+            continue
+        if src != params[0] and not (src in ("x", "input")
+                                     and params[0] in ("x", "input")):
+            continue
+        register_op(inplace_name, differentiable=False,
+                    category=spec.category)(make(spec.fn, inplace_name))
+
+
+def _guard_inplace_grad(x, opname):
+    from paddle_tpu.autograd import tape
+    from paddle_tpu.tensor import Tensor
+
+    if (isinstance(x, Tensor) and not x.stop_gradient
+            and tape.is_grad_enabled()):
+        raise RuntimeError(
+            f"{opname}: a Tensor that requires grad is used in an in-place "
+            f"operation (reference semantics forbid this for leaves); use "
+            f"the out-of-place op `{opname.rstrip('_')}` for autograd")
+
+
+_synthesize_inplace_variants()
+
+
+# --------------------------------------------------------------------------
+# Sparse VARIANT audit (ref_manifest.SPARSE_VARIANT_OPS — the 51
+# sparse_ops.yaml rows, tracked separately from the dense names they often
+# collide with). Every row must be implemented in paddle_tpu.sparse or
+# justified-skipped here; tests/test_sparse_ops.py enforces the partition
+# and exercises the implementations.
+# --------------------------------------------------------------------------
+
+SPARSE_IMPLEMENTED = {
+    # sparse yaml name -> attr in paddle_tpu.sparse
+    'abs': 'abs', 'acos': 'acos', 'acosh': 'acosh', 'asin': 'asin',
+    'asinh': 'asinh', 'atan': 'atan', 'atanh': 'atanh', 'expm1': 'expm1',
+    'isnan': 'isnan', 'leaky_relu': 'leaky_relu', 'log1p': 'log1p',
+    'relu': 'relu', 'relu6': 'relu6', 'sin': 'sin', 'sinh': 'sinh',
+    'sqrt': 'sqrt', 'square': 'square', 'tan': 'tan', 'tanh': 'tanh',
+    'pow': 'pow', 'scale': 'scale', 'cast': 'cast',
+    'add': 'add', 'subtract': 'subtract', 'multiply': 'multiply',
+    'divide': 'divide', 'divide_scalar': 'divide_scalar',
+    'matmul': 'matmul', 'masked_matmul': 'masked_matmul', 'mv': 'mv',
+    'addmm': 'addmm',
+    'sum': 'sum', 'softmax': 'softmax',
+    'reshape': 'reshape', 'transpose': 'transpose', 'slice': 'slice',
+    'coalesce': 'coalesce', 'mask_as': 'mask_as', 'full_like': 'full_like',
+    'values': 'values', 'indices': 'indices',
+    'sparse_coo_tensor': 'sparse_coo_tensor', 'to_dense': 'to_dense',
+    'to_sparse_coo': 'to_sparse_coo', 'to_sparse_csr': 'to_sparse_csr',
+    'batch_norm_': 'batch_norm', 'sync_batch_norm_': 'sync_batch_norm',
+    'fused_attention': 'fused_attention',
+}
+
+SPARSE_SKIPPED = {
+    'conv3d': "submanifold sparse 3-D conv: gather-MMA kernel family "
+              "(reference routes to CUTLASS); TPU MXU has no sparse-gather "
+              "matmul path and a dense-densify fallback would be dishonest "
+              "perf-wise — densify explicitly via to_dense() + nn.functional"
+              ".conv3d instead",
+    'conv3d_implicit_gemm': "CUTLASS implicit-GEMM variant of sparse conv3d",
+    'maxpool': "sparse 3-D maxpool rides the same submanifold "
+               "rulebook/gather machinery as sparse conv3d",
+}
+
+
+@register_op("where_", category="manipulation", differentiable=False)
+def where_(condition, x, y, name=None):
+    """Explicit inplace where (schema alias is `x -> out`, NOT the first
+    arg): mutates and returns x."""
+    from paddle_tpu.ops.registry import _REGISTRY
+
+    _guard_inplace_grad(x, "where_")
+    out = _REGISTRY["where"].fn(condition, x, y)
+    x._replace_value(out._value)
+    return x

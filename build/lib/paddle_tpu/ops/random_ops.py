@@ -1,0 +1,99 @@
+"""Random-sampling ops from the reference manifest (gaussian, dirichlet, ...).
+
+Reference kernels: paddle/phi/kernels/{cpu,gpu}/{gaussian,dirichlet,poisson,
+truncated_gaussian_random,...}_kernel. On TPU these map to jax.random with
+keys drawn from the framework's global generator (framework/random.py), which
+plays the role of the reference's per-device Generator state.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.framework import random as rng
+from paddle_tpu.framework.dtype import convert_dtype
+from paddle_tpu.ops.registry import register_op
+from paddle_tpu.tensor import Tensor
+
+
+def _key(seed=0):
+    return jax.random.PRNGKey(seed) if seed else rng.next_key()
+
+
+def _shape(s):
+    return tuple(int(v) for v in s)
+
+
+@register_op("gaussian", differentiable=False)
+def gaussian(shape, mean=0.0, std=1.0, seed=0, dtype="float32", name=None):
+    dt = convert_dtype(dtype)
+    out = mean + std * jax.random.normal(_key(seed), _shape(shape), dt)
+    return Tensor._from_value(out)
+
+
+@register_op("truncated_gaussian_random", differentiable=False)
+def truncated_gaussian_random(shape, mean=0.0, std=1.0, seed=0, a=-2.0, b=2.0,
+                              dtype="float32", name=None):
+    dt = convert_dtype(dtype)
+    out = mean + std * jax.random.truncated_normal(
+        _key(seed), a, b, _shape(shape), dt)
+    return Tensor._from_value(out)
+
+
+@register_op("binomial", differentiable=False)
+def binomial(count, prob, name=None):
+    c = count._value if isinstance(count, Tensor) else jnp.asarray(count)
+    p = prob._value if isinstance(prob, Tensor) else jnp.asarray(prob)
+    out = jax.random.binomial(_key(), c.astype(jnp.float32), p)
+    return Tensor._from_value(out.astype(jnp.int64))
+
+
+@register_op("poisson", differentiable=False)
+def poisson(x, name=None):
+    lam = x._value
+    out = jax.random.poisson(_key(), lam).astype(lam.dtype)
+    return Tensor._from_value(out)
+
+
+@register_op("dirichlet", differentiable=False)
+def dirichlet(alpha, name=None):
+    a = alpha._value
+    out = jax.random.dirichlet(_key(), a)
+    return Tensor._from_value(out.astype(a.dtype))
+
+
+@register_op("standard_gamma", differentiable=False)
+def standard_gamma(x, name=None):
+    a = x._value
+    out = jax.random.gamma(_key(), a)
+    return Tensor._from_value(out.astype(a.dtype))
+
+
+@register_op("exponential_", differentiable=False)
+def exponential_(x, lam=1.0, name=None):
+    u = jax.random.exponential(_key(), x._value.shape, jnp.float32) / lam
+    x._value = u.astype(x._value.dtype)
+    return x
+
+
+@register_op("uniform_inplace", differentiable=False)
+def uniform_inplace(x, min=-1.0, max=1.0, seed=0, diag_num=0, diag_step=0,
+                    diag_val=1.0, name=None):
+    out = jax.random.uniform(_key(seed), x._value.shape, jnp.float32,
+                             min, max)
+    if diag_num:
+        flat = out.reshape(-1)
+        idx = jnp.arange(diag_num) * (diag_step + 1)
+        flat = flat.at[idx].set(diag_val)
+        out = flat.reshape(out.shape)
+    x._value = out.astype(x._value.dtype)
+    return x
+
+
+@register_op("gaussian_inplace", differentiable=False)
+def gaussian_inplace(x, mean=0.0, std=1.0, seed=0, name=None):
+    out = mean + std * jax.random.normal(_key(seed), x._value.shape, jnp.float32)
+    x._value = out.astype(x._value.dtype)
+    return x

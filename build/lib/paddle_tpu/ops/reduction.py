@@ -1,0 +1,195 @@
+"""Reduction & search ops (parity: python/paddle/tensor/{math,search,stat}.py)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.dispatch import apply
+from paddle_tpu.ops.registry import register_op
+from paddle_tpu.tensor import Tensor
+
+
+def _norm_axis(axis):
+    if isinstance(axis, Tensor):
+        axis = axis.tolist()
+    if isinstance(axis, (list, tuple)):
+        return tuple(int(a) for a in axis)
+    return axis
+
+
+def _reduce(name, jax_fn, differentiable=True):
+    def op(x, axis=None, keepdim=False, name_arg=None, dtype=None):
+        ax = _norm_axis(axis)
+        kw = {}
+        if dtype is not None:
+            kw["dtype"] = dtype
+        return apply(
+            name, lambda a: jax_fn(a, axis=ax, keepdims=keepdim, **kw), x,
+            differentiable=differentiable,
+        )
+
+    op.__name__ = name
+    return register_op(name, category="reduction", differentiable=differentiable)(op)
+
+
+sum = _reduce("sum", jnp.sum)
+mean = _reduce("mean", jnp.mean)
+prod = _reduce("prod", jnp.prod)
+max = _reduce("max", jnp.max)
+min = _reduce("min", jnp.min)
+amax = _reduce("amax", jnp.max)
+amin = _reduce("amin", jnp.min)
+all = _reduce("all", jnp.all, differentiable=False)
+any = _reduce("any", jnp.any, differentiable=False)
+nansum = _reduce("nansum", jnp.nansum)
+nanmean = _reduce("nanmean", jnp.nanmean)
+
+
+@register_op("std", category="reduction")
+def std(x, axis=None, unbiased=True, keepdim=False, name=None):
+    return apply(
+        "std",
+        lambda a: jnp.std(a, axis=_norm_axis(axis), ddof=1 if unbiased else 0, keepdims=keepdim),
+        x,
+    )
+
+
+@register_op("var", category="reduction")
+def var(x, axis=None, unbiased=True, keepdim=False, name=None):
+    return apply(
+        "var",
+        lambda a: jnp.var(a, axis=_norm_axis(axis), ddof=1 if unbiased else 0, keepdims=keepdim),
+        x,
+    )
+
+
+@register_op("median", category="reduction")
+def median(x, axis=None, keepdim=False, mode="avg", name=None):
+    return apply(
+        "median", lambda a: jnp.median(a, axis=_norm_axis(axis), keepdims=keepdim), x
+    )
+
+
+@register_op("nanmedian", category="reduction")
+def nanmedian(x, axis=None, keepdim=False, name=None):
+    return apply(
+        "nanmedian", lambda a: jnp.nanmedian(a, axis=_norm_axis(axis), keepdims=keepdim), x
+    )
+
+
+@register_op("quantile", category="reduction")
+def quantile(x, q, axis=None, keepdim=False, interpolation="linear", name=None):
+    return apply(
+        "quantile",
+        lambda a: jnp.quantile(
+            a, jnp.asarray(q), axis=_norm_axis(axis), keepdims=keepdim, method=interpolation
+        ),
+        x,
+    )
+
+
+@register_op("argmax", category="reduction", differentiable=False)
+def argmax(x, axis=None, keepdim=False, dtype="int64", name=None):
+    def f(a):
+        out = jnp.argmax(a, axis=axis, keepdims=keepdim if axis is not None else False)
+        return out.astype(dtype or jnp.int64)
+
+    return apply("argmax", f, x, differentiable=False)
+
+
+@register_op("argmin", category="reduction", differentiable=False)
+def argmin(x, axis=None, keepdim=False, dtype="int64", name=None):
+    def f(a):
+        out = jnp.argmin(a, axis=axis, keepdims=keepdim if axis is not None else False)
+        return out.astype(dtype or jnp.int64)
+
+    return apply("argmin", f, x, differentiable=False)
+
+
+@register_op("count_nonzero", category="reduction", differentiable=False)
+def count_nonzero(x, axis=None, keepdim=False, name=None):
+    return apply(
+        "count_nonzero",
+        lambda a: jnp.count_nonzero(a, axis=_norm_axis(axis), keepdims=keepdim).astype(jnp.int64),
+        x,
+        differentiable=False,
+    )
+
+
+@register_op("norm", category="reduction")
+def norm(x, p="fro", axis=None, keepdim=False, name=None):
+    def f(a):
+        ax = _norm_axis(axis)
+        if p == "fro" or (p == 2 and ax is None):
+            return jnp.sqrt(jnp.sum(jnp.square(a), axis=ax, keepdims=keepdim))
+        if p == float("inf"):
+            return jnp.max(jnp.abs(a), axis=ax, keepdims=keepdim)
+        if p == float("-inf"):
+            return jnp.min(jnp.abs(a), axis=ax, keepdims=keepdim)
+        if p == 0:
+            return jnp.sum((a != 0).astype(a.dtype), axis=ax, keepdims=keepdim)
+        return jnp.power(
+            jnp.sum(jnp.power(jnp.abs(a), p), axis=ax, keepdims=keepdim), 1.0 / p
+        )
+
+    return apply("norm", f, x)
+
+
+@register_op("dist", category="reduction")
+def dist(x, y, p=2, name=None):
+    def f(a, b):
+        d = jnp.abs(a - b)
+        if p == 0:
+            return jnp.sum((d != 0).astype(a.dtype))
+        if p == float("inf"):
+            return jnp.max(d)
+        if p == float("-inf"):
+            return jnp.min(d)
+        return jnp.power(jnp.sum(jnp.power(d, p)), 1.0 / p)
+
+    return apply("dist", f, x, y)
+
+
+@register_op("kthvalue", category="reduction")
+def kthvalue(x, k, axis=-1, keepdim=False, name=None):
+    def f(a):
+        srt = jnp.sort(a, axis=axis)
+        idx = jnp.argsort(a, axis=axis)
+        vals = jnp.take(srt, k - 1, axis=axis)
+        inds = jnp.take(idx, k - 1, axis=axis).astype(jnp.int64)
+        if keepdim:
+            vals = jnp.expand_dims(vals, axis)
+            inds = jnp.expand_dims(inds, axis)
+        return vals, inds
+
+    return apply("kthvalue", f, x)
+
+
+@register_op("mode", category="reduction", differentiable=False)
+def mode(x, axis=-1, keepdim=False, name=None):
+    def f(a):
+        ax = axis if axis >= 0 else a.ndim + axis
+        am = jnp.moveaxis(a, ax, -1)
+        # pairwise occurrence counts along the reduced axis (n is typically small)
+        counts = jnp.sum(
+            (am[..., :, None] == am[..., None, :]).astype(jnp.int32), axis=-1
+        )
+        # paddle returns the largest value among the most frequent; bias argmax
+        # toward larger values by tie-breaking on sorted order
+        order = jnp.argsort(am, axis=-1)
+        counts_sorted = jnp.take_along_axis(counts, order, axis=-1)
+        # last occurrence of the max count in sorted order = largest such value
+        rev = counts_sorted[..., ::-1]
+        best_rev = jnp.argmax(rev, axis=-1, keepdims=True)
+        best_sorted = am.shape[-1] - 1 - best_rev
+        idx = jnp.take_along_axis(order, best_sorted, axis=-1)
+        vals = jnp.take_along_axis(am, idx, axis=-1)
+        vals = jnp.moveaxis(vals, -1, ax)
+        idx = jnp.moveaxis(idx, -1, ax).astype(jnp.int64)
+        if not keepdim:
+            vals = jnp.squeeze(vals, ax)
+            idx = jnp.squeeze(idx, ax)
+        return vals, idx
+
+    return apply("mode", f, x, differentiable=False)

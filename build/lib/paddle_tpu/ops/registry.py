@@ -1,0 +1,54 @@
+"""Op registry: the single source of op identity/metadata.
+
+Analogue of the reference's op schema YAML (paddle/phi/ops/yaml/ops.yaml — 445
+ops) + KernelFactory name map. Instead of YAML->C++ codegen, each op registers
+an ``OpSpec`` at definition time; the registry powers introspection, parity
+audits (tests compare against the reference's op list), and future frontends.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Callable, Dict, Optional, Sequence
+
+
+@dataclass
+class OpSpec:
+    name: str
+    fn: Callable
+    differentiable: bool = True
+    inplace_variant: Optional[str] = None  # e.g. add -> add_
+    category: str = "math"
+    doc: str = ""
+    aliases: Sequence[str] = field(default_factory=tuple)
+
+
+_REGISTRY: Dict[str, OpSpec] = {}
+
+
+def register_op(name: str, *, differentiable: bool = True, category: str = "math",
+                aliases: Sequence[str] = (), doc: str = ""):
+    """Decorator registering a public op into the registry."""
+
+    def deco(fn):
+        spec = OpSpec(name=name, fn=fn, differentiable=differentiable,
+                      category=category, doc=doc or (fn.__doc__ or ""),
+                      aliases=tuple(aliases))
+        _REGISTRY[name] = spec
+        for a in aliases:
+            _REGISTRY[a] = spec
+        return fn
+
+    return deco
+
+
+def get_op(name: str) -> OpSpec:
+    return _REGISTRY[name]
+
+
+def all_ops() -> Dict[str, OpSpec]:
+    return dict(_REGISTRY)
+
+
+def op_count() -> int:
+    return len({id(s) for s in _REGISTRY.values()})

@@ -1,0 +1,144 @@
+"""Ring attention: sequence-parallel exact attention over the ``sep`` mesh
+axis (long-context capability; reference achieves long context with its sep
+topology axis + flash attention — SURVEY §5 "Long-context" — which on TPU
+composes into this: KV blocks rotate around the ring while each device keeps
+only its local Q/KV shard, so sequence length scales with the number of
+devices at O(S/N) memory per chip).
+
+Mechanism: shard_map over the sep axis; each of the N steps runs a
+flash-style online-softmax block update of the local Q against the currently
+held KV block, then ``lax.ppermute``s KV to the next device — the collective
+rides the ICI ring, overlapping with the block matmuls. Causality is enforced
+block-wise (source-rank > my-rank blocks contribute nothing; the diagonal
+block applies the in-block triangular mask). jax.grad differentiates through
+the scan + ppermute, and jax.checkpoint bounds backward memory.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+try:
+    from jax import shard_map as _shard_map  # jax >= 0.8 name
+
+    def shard_map(f, mesh, in_specs, out_specs, check_rep=False):
+        return _shard_map(f, mesh=mesh, in_specs=in_specs,
+                          out_specs=out_specs, check_vma=check_rep)
+except ImportError:  # pragma: no cover — jax < 0.8
+    from jax.experimental.shard_map import shard_map as _legacy
+
+    def shard_map(f, mesh, in_specs, out_specs, check_rep=False):
+        return _legacy(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                       check_rep=check_rep)
+
+_NEG = -1e30
+
+
+def _block_update(q, k, v, bias, o, l, m, scale):
+    """One flash block: online-softmax accumulate (all f32).
+
+    q [B,Sq,H,D]; k,v [B,Sk,H,D]; bias [Sq,Sk] additive (0 / -1e30);
+    o [B,H,Sq,D]; l,m [B,H,Sq].
+    """
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale + bias  # [B,H,Sq,Sk]
+    m_blk = jnp.max(s, axis=-1)
+    m_new = jnp.maximum(m, m_blk)
+    p = jnp.exp(s - m_new[..., None])
+    alpha = jnp.exp(m - m_new)
+    l_new = l * alpha + jnp.sum(p, axis=-1)
+    o_new = o * alpha[..., None] + jnp.einsum("bhqk,bkhd->bhqd", p, v)
+    return o_new, l_new, m_new
+
+
+def _ring_attention_local(q, k, v, axis_name, causal, scale):
+    """Runs on each device inside shard_map; q/k/v are LOCAL seq blocks."""
+    n = jax.lax.axis_size(axis_name)
+    my = jax.lax.axis_index(axis_name)
+    b, sq, h, d = q.shape
+    sk = k.shape[1]
+    qf = q.astype(jnp.float32)
+
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    row = jax.lax.broadcasted_iota(jnp.int32, (sq, sk), 0)
+    col = jax.lax.broadcasted_iota(jnp.int32, (sq, sk), 1)
+    tri = jnp.where(row >= col, 0.0, _NEG).astype(jnp.float32)
+    zeros = jnp.zeros((sq, sk), jnp.float32)
+    neg = jnp.full((sq, sk), _NEG, jnp.float32)
+
+    @jax.checkpoint
+    def step_compute(qf, kv, src, o, l, m):
+        kf, vf = kv
+        if causal:
+            # src < my: full block; src == my: triangular; src > my: masked out
+            bias = jnp.where(src < my, zeros, jnp.where(src == my, tri, neg))
+        else:
+            bias = zeros
+        return _block_update(qf, kf.astype(jnp.float32),
+                             vf.astype(jnp.float32), bias, o, l, m, scale)
+
+    def body(t, carry):
+        o, l, m, kv = carry
+        src = (my - t) % n  # rank whose KV block we currently hold
+        o, l, m = step_compute(qf, kv, src, o, l, m)
+        kv = jax.lax.ppermute(kv, axis_name, perm)
+        return o, l, m, kv
+
+    o0 = jnp.zeros((b, h, sq, d), jnp.float32)
+    l0 = jnp.zeros((b, h, sq), jnp.float32)
+    m0 = jnp.full((b, h, sq), _NEG, jnp.float32)
+    o, l, m, _ = jax.lax.fori_loop(0, n, body, (o0, l0, m0, (k, v)))
+    out = o / jnp.maximum(l, 1e-30)[..., None]
+    return jnp.einsum("bhqd->bqhd", out).astype(q.dtype)
+
+
+def ring_attention(q, k, v, *, mesh: Mesh, axis: str = "sep",
+                   causal: bool = True, scale: Optional[float] = None,
+                   batch_axis: Optional[str] = "dp"):
+    """Exact attention with the sequence dim sharded over ``axis``.
+
+    q, k, v: [B, S, H, D] jax arrays (global view, S sharded over ``axis``).
+    Returns [B, S, H, D] with the same sharding.
+    """
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    b_ax = batch_axis if (batch_axis and batch_axis in mesh.shape) else None
+    spec = P(b_ax, axis, None, None)
+    fn = functools.partial(
+        _ring_attention_local, axis_name=axis, causal=causal, scale=scale)
+    return shard_map(
+        fn, mesh, in_specs=(spec, spec, spec), out_specs=spec,
+    )(q, k, v)
+
+
+def ring_flash_attention(query, key, value, dropout=0.0, causal=True,
+                         mesh=None, axis="sep", training=True, name=None):
+    """Tensor-level entry (paddle flash_attention-shaped signature)."""
+    from paddle_tpu.core.dispatch import apply
+    from paddle_tpu.distributed.fleet import topology as topo
+    from paddle_tpu.framework import random as rng
+
+    if mesh is None:
+        hcg = topo.get_hybrid_communicate_group()
+        if hcg is None or hcg.get_sep_parallel_world_size() <= 1:
+            raise RuntimeError(
+                "ring_flash_attention needs a hybrid group with sep > 1 "
+                "(or pass mesh= explicitly)")
+        mesh = hcg.get_mesh()
+
+    def f(qv, kv, vv):
+        out = ring_attention(qv, kv, vv, mesh=mesh, axis=axis, causal=causal)
+        if dropout > 0.0 and training:
+            # output dropout, matching the flash path's approximation
+            keep = jax.random.bernoulli(rng.next_key(), 1.0 - dropout,
+                                        out.shape)
+            out = jnp.where(keep, out / (1.0 - dropout), 0.0).astype(out.dtype)
+        return out
+
+    return apply("ring_flash_attention", f, query, key, value)

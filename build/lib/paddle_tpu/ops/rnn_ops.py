@@ -1,0 +1,234 @@
+"""Recurrent ops: rnn / lstm / gru / gru_unit (reference: phi rnn_kernel,
+fluid gru/lstm ops; cudnn_lstm capability is covered by the same path).
+
+Recurrence is a lax.scan over time — XLA compiles the cell body once and the
+per-step matmuls run on the MXU. Multi-layer and bidirectional variants
+compose scans.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.core.dispatch import apply
+from paddle_tpu.ops.registry import register_op
+from paddle_tpu.tensor import Tensor
+
+
+def _lstm_cell(x, h, c, wi, wh, bi, bh):
+    g = x @ wi.T + h @ wh.T + bi + bh
+    i, f, gg, o = jnp.split(g, 4, axis=-1)
+    i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
+    c_new = f * c + i * jnp.tanh(gg)
+    return o * jnp.tanh(c_new), c_new
+
+
+def _gru_cell(x, h, wi, wh, bi, bh):
+    xr, xz, xn = jnp.split(x @ wi.T + bi, 3, axis=-1)
+    hr, hz, hn = jnp.split(h @ wh.T + bh, 3, axis=-1)
+    r = jax.nn.sigmoid(xr + hr)
+    z = jax.nn.sigmoid(xz + hz)
+    n = jnp.tanh(xn + r * hn)
+    return (1 - z) * n + z * h
+
+
+def _tanh_cell(x, h, wi, wh, bi, bh):
+    return jnp.tanh(x @ wi.T + h @ wh.T + bi + bh)
+
+
+def _relu_cell(x, h, wi, wh, bi, bh):
+    return jax.nn.relu(x @ wi.T + h @ wh.T + bi + bh)
+
+
+_CELLS = {"LSTM": _lstm_cell, "GRU": _gru_cell, "RNN_TANH": _tanh_cell,
+          "RNN_RELU": _relu_cell}
+
+
+@register_op("rnn")
+def rnn(x, pre_state, weight_list, sequence_length=None, dropout_prob=0.0,
+        is_bidirec=False, input_size=None, hidden_size=None, num_layers=1,
+        mode="LSTM", seed=0, is_test=False, name=None):
+    """Multi-layer (bi)directional recurrence (phi rnn_kernel).
+
+    x: [T, B, I] (time-major, as the reference kernel). pre_state: (h0[, c0])
+    with shape [L*D, B, H]. weight_list: per layer+direction
+    [wi, wh, bi, bh] flattened in the reference's order.
+
+    ``sequence_length`` ([B] ints): steps past a sequence's length are
+    MASKED — the carry freezes at the last valid step (final states are the
+    states at t = len-1) and padded outputs are zeroed, matching the
+    reference kernel's variable-length contract. The mask rides inside the
+    scan (a where per step — XLA fuses it into the cell body).
+    """
+    is_lstm = mode == "LSTM"
+    cell = _CELLS[mode]
+    D = 2 if is_bidirec else 1
+
+    h0 = pre_state[0]
+    c0 = pre_state[1] if is_lstm else None
+    has_len = sequence_length is not None
+
+    def f(xv, h0v, *rest):
+        pos = 0
+        c0v = rest[pos] if is_lstm else None
+        pos += 1 if is_lstm else 0
+        lens = rest[pos] if has_len else None
+        pos += 1 if has_len else 0
+        wl = list(rest[pos:])
+        T = xv.shape[0]
+        out = xv
+        hs, cs = [], []
+        for layer in range(num_layers):
+            layer_outs = []
+            for d in range(D):
+                li = layer * D + d
+                wi, wh, bi, bh = wl[li * 4: li * 4 + 4]
+                hh = h0v[li]
+                cc = c0v[li] if is_lstm else None
+                seq = out if d == 0 else out[::-1]
+                # time index per scanned step (reversed for the bwd pass)
+                ts = (jnp.arange(T) if d == 0
+                      else jnp.arange(T - 1, -1, -1))
+
+                def mask(t, new, old):
+                    if lens is None:
+                        return new
+                    valid = (t < lens).reshape(-1, 1)
+                    return jnp.where(valid, new, old)
+
+                def zero_pad(t, y):
+                    if lens is None:
+                        return y
+                    return jnp.where((t < lens).reshape(-1, 1), y,
+                                     jnp.zeros_like(y))
+
+                if is_lstm:
+                    def step(carry, xt_t):
+                        xt, t = xt_t
+                        h, c = carry
+                        h2, c2 = cell(xt, h, c, wi, wh, bi, bh)
+                        h2 = mask(t, h2, h)
+                        c2 = mask(t, c2, c)
+                        return (h2, c2), zero_pad(t, h2)
+
+                    (hT, cT), ys = jax.lax.scan(step, (hh, cc), (seq, ts))
+                    cs.append(cT)
+                else:
+                    def step(h, xt_t):
+                        xt, t = xt_t
+                        h2 = cell(xt, h, wi, wh, bi, bh)
+                        h2 = mask(t, h2, h)
+                        return h2, zero_pad(t, h2)
+
+                    hT, ys = jax.lax.scan(step, hh, (seq, ts))
+                hs.append(hT)
+                layer_outs.append(ys if d == 0 else ys[::-1])
+            out = (jnp.concatenate(layer_outs, axis=-1) if is_bidirec
+                   else layer_outs[0])
+        state = [jnp.stack(hs)]
+        if is_lstm:
+            state.append(jnp.stack(cs))
+        return (out, *state)
+
+    args = ([x, h0] + ([c0] if is_lstm else [])
+            + ([sequence_length] if has_len else []) + list(weight_list))
+    res = apply("rnn", f, *args)
+    return res
+
+
+@register_op("lstm")
+def lstm(x, h0, c0, weight_list, is_bidirec=False, num_layers=1,
+         sequence_length=None, name=None):
+    return rnn(x, (h0, c0), weight_list, sequence_length=sequence_length,
+               is_bidirec=is_bidirec, num_layers=num_layers, mode="LSTM")
+
+
+@register_op("gru")
+def gru(x, h0, weight_list, is_bidirec=False, num_layers=1,
+        sequence_length=None, name=None):
+    return rnn(x, (h0,), weight_list, sequence_length=sequence_length,
+               is_bidirec=is_bidirec, num_layers=num_layers, mode="GRU")
+
+
+@register_op("gru_unit")
+def gru_unit(input, hidden_prev, weight, bias=None, activation="tanh",
+             gate_activation="sigmoid", origin_mode=False, name=None):
+    """Single GRU step, fluid gru_unit_op layout: weight [H, 3H] packing
+    update/reset gates then candidate."""
+    def f(*args):
+        x, h, w = args[0], args[1], args[2]
+        b = args[3] if len(args) > 3 else 0.0
+        H = h.shape[-1]
+        # fluid layout: x already = input @ W_x + b, split [u, r, c]
+        xu, xr, xc = jnp.split(x + (b if not np.isscalar(b) else 0.0), 3, -1)
+        hu = h @ w[:, :H]
+        hr = h @ w[:, H:2 * H]
+        u = jax.nn.sigmoid(xu + hu)
+        r = jax.nn.sigmoid(xr + hr)
+        hc = (r * h) @ w[:, 2 * H:]
+        c = jnp.tanh(xc + hc)
+        if origin_mode:
+            h_new = u * h + (1 - u) * c
+        else:
+            h_new = (1 - u) * h + u * c
+        return h_new, r * h, c
+
+    args = (input, hidden_prev, weight) + ((bias,) if bias is not None else ())
+    return apply("gru_unit", f, *args)
+
+
+@register_op("warprnnt")
+def warprnnt(logits, labels, logit_lengths, label_lengths, blank=0,
+             fastemit_lambda=0.0, name=None):
+    """RNN-T loss (phi warprnnt kernel): forward-variable dynamic program
+    over the (T, U) lattice as nested lax.scans."""
+    def f(lg, lb, tl, ul):
+        # lg: [B, T, U+1, V] log-probs, lb: [B, U]
+        lp = jax.nn.log_softmax(lg, axis=-1)
+        B, T, U1, V = lp.shape
+
+        def one(lpb, lbb, tb, ub):
+            # alpha: [T, U+1]
+            blank_lp = lpb[:, :, blank]                     # [T, U+1]
+            lbl_lp = jnp.take_along_axis(
+                lpb[:, :-1], lbb[None, :, None], axis=2)[..., 0]  # [T, U]
+
+            minus_inf = jnp.float32(-1e30)
+
+            def row(alpha_prev, t):
+                # alpha_prev: [U+1] row t-1
+                def col(carry, u):
+                    # emit from left (same t, u-1) or step time (t-1, u)
+                    from_blank = alpha_prev[u] + blank_lp[t - 1, u]
+                    from_label = jnp.where(
+                        u > 0, carry + lbl_lp[t, u - 1], minus_inf)
+                    val = jnp.logaddexp(from_blank, from_label)
+                    return val, val
+
+                first = alpha_prev[0] + blank_lp[t - 1, 0]
+                _, row_vals = jax.lax.scan(
+                    col, first, jnp.arange(1, U1))
+                return jnp.concatenate([first[None], row_vals]), None
+
+            # alpha row 0: only label emissions
+            def col0(carry, u):
+                val = carry + lbl_lp[0, u - 1]
+                return val, val
+
+            _, r0 = jax.lax.scan(col0, jnp.float32(0.0), jnp.arange(1, U1))
+            alpha0 = jnp.concatenate([jnp.zeros((1,)), r0])
+
+            def scan_t(alpha_prev, t):
+                alpha_new, _ = row(alpha_prev, t)
+                return alpha_new, alpha_new
+
+            alphaT, rows = jax.lax.scan(scan_t, alpha0, jnp.arange(1, T))
+            all_alpha = jnp.concatenate([alpha0[None], rows], 0)  # [T, U+1]
+            final = all_alpha[tb - 1, ub] + blank_lp[tb - 1, ub]
+            return -final
+
+        return jax.vmap(one)(lp, lb, tl, ul)
+
+    return apply("warprnnt", f, logits, labels, logit_lengths, label_lengths)

@@ -1,0 +1,679 @@
+"""Signal-processing, quantization, graph-message, MoE-routing, collective,
+sparse, and numerics-debug ops completing the reference manifest.
+
+Reference kernels cited per op. Quant ops implement the fake-quant math of
+paddle/phi/kernels/{cpu,gpu}/fake_quantize_kernel; graph ops implement
+send_u_recv / send_ue_recv / send_uv (phi graph_send_* kernels) via XLA
+segment reductions; collective c_* ops route to paddle_tpu.distributed.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.core.dispatch import apply
+from paddle_tpu.ops.registry import register_op
+from paddle_tpu.tensor import Tensor
+
+# ------------------------------------------------------------------ signal
+
+
+@register_op("frame")
+def frame(x, frame_length, hop_length, axis=-1, name=None):
+    """Slice overlapping frames (phi frame_kernel): [..., T] ->
+    [..., frame_length, num_frames] (axis=-1)."""
+    def f(a):
+        t = a.shape[axis]
+        n = 1 + (t - frame_length) // hop_length
+        starts = jnp.arange(n) * hop_length
+        idx = starts[None, :] + jnp.arange(frame_length)[:, None]  # [fl, n]
+        out = jnp.take(a, idx.reshape(-1), axis=axis)
+        if axis in (-1, a.ndim - 1):
+            return out.reshape(a.shape[:-1] + (frame_length, n))
+        return out.reshape((frame_length, n) + a.shape[1:])
+
+    return apply("frame", f, x)
+
+
+@register_op("overlap_add")
+def overlap_add(x, hop_length, axis=-1, name=None):
+    """Inverse of frame (phi overlap_add_kernel)."""
+    def f(a):
+        # [..., frame_length, n]
+        fl, n = a.shape[-2], a.shape[-1]
+        t = (n - 1) * hop_length + fl
+        lead = a.shape[:-2]
+        flat = a.reshape((-1, fl, n))
+
+        def one(fr):
+            out = jnp.zeros((t,), a.dtype)
+            starts = jnp.arange(n) * hop_length
+            idx = (starts[None, :] + jnp.arange(fl)[:, None]).reshape(-1)
+            return out.at[idx].add(fr.reshape(-1))
+
+        out = jax.vmap(one)(flat)
+        return out.reshape(lead + (t,))
+
+    return apply("overlap_add", f, x)
+
+
+@register_op("stft")
+def stft(x, n_fft, hop_length=None, win_length=None, window=None, center=True,
+         pad_mode="reflect", normalized=False, onesided=True, name=None):
+    """STFT (phi stft_kernel): frame + window + rFFT."""
+    hop = hop_length or n_fft // 4
+    wl = win_length or n_fft
+
+    def f(a, *w):
+        sig = a
+        if center:
+            sig = jnp.pad(sig, [(0, 0)] * (sig.ndim - 1)
+                          + [(n_fft // 2, n_fft // 2)], mode=pad_mode)
+        t = sig.shape[-1]
+        n = 1 + (t - n_fft) // hop
+        starts = jnp.arange(n) * hop
+        idx = starts[:, None] + jnp.arange(n_fft)[None, :]
+        frames = sig[..., idx]  # [..., n, n_fft]
+        if w:
+            win = w[0]
+            if wl < n_fft:
+                pad = (n_fft - wl) // 2
+                win = jnp.pad(win, (pad, n_fft - wl - pad))
+            frames = frames * win
+        spec = jnp.fft.rfft(frames, n=n_fft) if onesided \
+            else jnp.fft.fft(frames, n=n_fft)
+        if normalized:
+            spec = spec / jnp.sqrt(jnp.asarray(n_fft, spec.real.dtype))
+        return jnp.swapaxes(spec, -1, -2)  # [..., freq, frames]
+
+    args = (x,) + ((window,) if window is not None else ())
+    return apply("stft", f, *args)
+
+
+def _fft_norm(norm, n, forward):
+    if norm == "ortho":
+        return 1.0 / np.sqrt(n)
+    if (norm == "forward") == forward:
+        return 1.0 / n
+    return 1.0
+
+
+@register_op("fft_c2c")
+def fft_c2c(x, axes=(-1,), normalization="backward", forward=True, name=None):
+    def f(a):
+        fn = jnp.fft.fftn if forward else jnp.fft.ifftn
+        out = fn(a, axes=tuple(axes), norm=normalization if normalization
+                 in ("ortho", "forward", "backward") else None)
+        return out
+
+    return apply("fft_c2c", f, x)
+
+
+@register_op("fft_r2c")
+def fft_r2c(x, axes=(-1,), normalization="backward", forward=True,
+            onesided=True, name=None):
+    def f(a):
+        if onesided:
+            return jnp.fft.rfftn(a, axes=tuple(axes), norm=normalization)
+        return jnp.fft.fftn(a.astype(jnp.complex64), axes=tuple(axes),
+                            norm=normalization)
+
+    return apply("fft_r2c", f, x)
+
+
+@register_op("fft_c2r")
+def fft_c2r(x, axes=(-1,), normalization="backward", forward=False,
+            last_dim_size=0, name=None):
+    def f(a):
+        n = last_dim_size or 2 * (a.shape[axes[-1]] - 1)
+        return jnp.fft.irfftn(a, s=(n,), axes=tuple(axes), norm=normalization)
+
+    return apply("fft_c2r", f, x)
+
+
+# ------------------------------------------------------------ quantization
+
+
+def _qrange(bits):
+    return float(2 ** (bits - 1) - 1)
+
+
+@register_op("fake_quantize_abs_max", differentiable=False)
+def fake_quantize_abs_max(x, bit_length=8, round_type=0, name=None):
+    qmax = _qrange(bit_length)
+
+    def f(a):
+        scale = jnp.max(jnp.abs(a))
+        q = jnp.clip(jnp.round(a / jnp.maximum(scale, 1e-12) * qmax),
+                     -qmax, qmax)
+        return q, scale.reshape(1)
+
+    out, scale = apply("fake_quantize_abs_max", f, x)
+    return out, scale
+
+
+@register_op("fake_quantize_dequantize_abs_max")
+def fake_quantize_dequantize_abs_max(x, bit_length=8, round_type=0, name=None):
+    qmax = _qrange(bit_length)
+
+    def f(a):
+        scale = jnp.max(jnp.abs(a))
+        s = jnp.maximum(scale, 1e-12)
+        q = jnp.clip(jnp.round(a / s * qmax), -qmax, qmax)
+        return q * s / qmax, scale.reshape(1)
+
+    return apply("fake_quantize_dequantize_abs_max", f, x)
+
+
+@register_op("fake_channel_wise_quantize_abs_max", differentiable=False)
+def fake_channel_wise_quantize_abs_max(x, bit_length=8, quant_axis=0,
+                                       round_type=0, name=None):
+    qmax = _qrange(bit_length)
+
+    def f(a):
+        axes = tuple(i for i in range(a.ndim) if i != quant_axis)
+        scale = jnp.max(jnp.abs(a), axis=axes)
+        shp = [1] * a.ndim
+        shp[quant_axis] = -1
+        s = jnp.maximum(scale, 1e-12).reshape(shp)
+        q = jnp.clip(jnp.round(a / s * qmax), -qmax, qmax)
+        return q, scale
+
+    return apply("fake_channel_wise_quantize_abs_max", f, x)
+
+
+@register_op("fake_channel_wise_quantize_dequantize_abs_max")
+def fake_channel_wise_quantize_dequantize_abs_max(x, bit_length=8,
+                                                  quant_axis=0, round_type=0,
+                                                  name=None):
+    qmax = _qrange(bit_length)
+
+    def f(a):
+        axes = tuple(i for i in range(a.ndim) if i != quant_axis)
+        scale = jnp.max(jnp.abs(a), axis=axes)
+        shp = [1] * a.ndim
+        shp[quant_axis] = -1
+        s = jnp.maximum(scale, 1e-12).reshape(shp)
+        q = jnp.clip(jnp.round(a / s * qmax), -qmax, qmax)
+        return q * s / qmax, scale
+
+    return apply("fake_channel_wise_quantize_dequantize_abs_max", f, x)
+
+
+@register_op("fake_quantize_range_abs_max", differentiable=False)
+def fake_quantize_range_abs_max(x, in_scale, iter=None, window_size=10000,
+                                bit_length=8, is_test=False, round_type=0,
+                                name=None):
+    qmax = _qrange(bit_length)
+
+    def f(a, sc):
+        cur = jnp.max(jnp.abs(a))
+        scale = jnp.where(is_test, sc.reshape(()), jnp.maximum(cur, sc.reshape(())))
+        q = jnp.clip(jnp.round(a / jnp.maximum(scale, 1e-12) * qmax),
+                     -qmax, qmax)
+        return q, scale.reshape(1)
+
+    return apply("fake_quantize_range_abs_max", f, x, in_scale)
+
+
+@register_op("fake_quantize_moving_average_abs_max", differentiable=False)
+def fake_quantize_moving_average_abs_max(x, in_scale, in_accum=None,
+                                         in_state=None, moving_rate=0.9,
+                                         bit_length=8, is_test=False,
+                                         round_type=0, name=None):
+    qmax = _qrange(bit_length)
+
+    def f(a, sc):
+        cur = jnp.max(jnp.abs(a))
+        scale = jnp.where(is_test, sc.reshape(()),
+                          moving_rate * sc.reshape(()) + (1 - moving_rate) * cur)
+        q = jnp.clip(jnp.round(a / jnp.maximum(scale, 1e-12) * qmax),
+                     -qmax, qmax)
+        return q, scale.reshape(1)
+
+    return apply("fake_quantize_moving_average_abs_max", f, x, in_scale)
+
+
+@register_op("fake_quantize_dequantize_moving_average_abs_max")
+def fake_quantize_dequantize_moving_average_abs_max(
+        x, in_scale, in_accum=None, in_state=None, moving_rate=0.9,
+        bit_length=8, is_test=False, round_type=0, name=None):
+    qmax = _qrange(bit_length)
+
+    def f(a, sc):
+        cur = jnp.max(jnp.abs(a))
+        scale = jnp.where(is_test, sc.reshape(()),
+                          moving_rate * sc.reshape(()) + (1 - moving_rate) * cur)
+        s = jnp.maximum(scale, 1e-12)
+        q = jnp.clip(jnp.round(a / s * qmax), -qmax, qmax)
+        return q * s / qmax, scale.reshape(1)
+
+    return apply("fake_quantize_dequantize_moving_average_abs_max", f, x,
+                 in_scale)
+
+
+@register_op("fake_dequantize_max_abs")
+def fake_dequantize_max_abs(x, scale, max_range, name=None):
+    return apply("fake_dequantize_max_abs",
+                 lambda a, s: a * s.reshape(()) / max_range, x, scale)
+
+
+@register_op("fake_channel_wise_dequantize_max_abs")
+def fake_channel_wise_dequantize_max_abs(x, scales, quant_bits=(8,),
+                                         quant_axis=0, x_num_col_dims=1,
+                                         name=None):
+    def f(a, s):
+        shp = [1] * a.ndim
+        shp[quant_axis] = -1
+        return a * s.reshape(shp) / _qrange(quant_bits[0])
+
+    return apply("fake_channel_wise_dequantize_max_abs", f, x, scales)
+
+
+@register_op("dequantize_abs_max")
+def dequantize_abs_max(x, scale, max_range, name=None):
+    return apply("dequantize_abs_max",
+                 lambda a, s: a.astype(jnp.float32) * s.reshape(()) / max_range,
+                 x, scale)
+
+
+@register_op("dequantize_log")
+def dequantize_log(x, dict_data, name=None):
+    """Log-quantized dequantize (fluid dequantize_log_op): values are indices
+    into a lookup dict; sign encoded by >=128."""
+    def f(a, d):
+        idx = a.astype(jnp.int32)
+        neg = idx >= 128
+        pos_idx = jnp.where(neg, idx - 128, idx)
+        vals = d[pos_idx]
+        return jnp.where(neg, -vals, vals)
+
+    return apply("dequantize_log", f, x, dict_data)
+
+
+@register_op("weight_quantize", differentiable=False)
+def weight_quantize(x, algo="weight_only_int8", arch=None, group_size=-1,
+                    name=None):
+    """Per-output-channel int8 weight quantization (phi weight_quantize)."""
+    def f(w):
+        scale = jnp.max(jnp.abs(w), axis=0)
+        q = jnp.clip(jnp.round(w / jnp.maximum(scale, 1e-12)[None, :] * 127),
+                     -127, 127).astype(jnp.int8)
+        return q, scale
+
+    return apply("weight_quantize", f, x)
+
+
+@register_op("weight_dequantize")
+def weight_dequantize(x, scale, algo="weight_only_int8", group_size=-1,
+                      name=None):
+    return apply("weight_dequantize",
+                 lambda q, s: q.astype(jnp.float32) * s[None, :] / 127.0,
+                 x, scale)
+
+
+@register_op("weight_only_linear")
+def weight_only_linear(x, weight, bias=None, weight_scale=None,
+                       weight_dtype="int8", arch=None, group_size=-1,
+                       name=None):
+    """Weight-only-quantized linear (phi weight_only_linear_kernel):
+    dequantize int8 weights on the fly, matmul in activation dtype."""
+    def f(*args):
+        a, w, s = args[0], args[1], args[2]
+        wd = w.astype(a.dtype) * (s[None, :] / 127.0).astype(a.dtype)
+        out = a @ wd
+        if len(args) > 3:
+            out = out + args[3]
+        return out
+
+    args = (x, weight, weight_scale) + ((bias,) if bias is not None else ())
+    return apply("weight_only_linear", f, *args)
+
+
+@register_op("llm_int8_linear")
+def llm_int8_linear(x, weight, bias=None, weight_scale=None,
+                    threshold=6.0, name=None):
+    return weight_only_linear(x, weight, bias, weight_scale)
+
+
+@register_op("apply_per_channel_scale")
+def apply_per_channel_scale(x, scales, name=None):
+    return apply("apply_per_channel_scale", lambda a, s: a * s, x, scales)
+
+
+@register_op("quantize_linear", differentiable=False, aliases=())
+def quantize_linear(x, scale, zero_point, bit_length=8, quant_axis=-1,
+                    round_type=0, is_test=True, only_observer=False,
+                    name=None):
+    qmax = _qrange(bit_length)
+
+    def f(a, s, z):
+        if quant_axis >= 0:
+            shp = [1] * a.ndim
+            shp[quant_axis] = -1
+            s = s.reshape(shp)
+        return jnp.clip(jnp.round(a / jnp.maximum(s, 1e-12)), -qmax, qmax)
+
+    return apply("quantize_linear", f, x, scale, zero_point)
+
+
+# ------------------------------------------------------------- graph ops
+
+
+def _segment_reduce(vals, dst, num_nodes, reduce_op):
+    if reduce_op in ("SUM", "ADD", "MEAN"):
+        out = jax.ops.segment_sum(vals, dst, num_segments=num_nodes)
+        if reduce_op == "MEAN":
+            cnt = jax.ops.segment_sum(jnp.ones_like(dst, vals.dtype), dst,
+                                      num_segments=num_nodes)
+            out = out / jnp.maximum(cnt, 1).reshape(
+                (-1,) + (1,) * (vals.ndim - 1))
+        return out
+    if reduce_op == "MAX":
+        return jax.ops.segment_max(vals, dst, num_segments=num_nodes)
+    if reduce_op == "MIN":
+        return jax.ops.segment_min(vals, dst, num_segments=num_nodes)
+    raise ValueError(reduce_op)
+
+
+@register_op("send_u_recv")
+def send_u_recv(x, src_index, dst_index, reduce_op="SUM", out_size=None,
+                name=None):
+    """Graph message passing (phi graph_send_recv): gather src features,
+    segment-reduce at dst."""
+    n = out_size or x.shape[0]
+
+    def f(a, si, di):
+        msgs = a[si]
+        return _segment_reduce(msgs, di, n, reduce_op.upper())
+
+    return apply("send_u_recv", f, x, src_index, dst_index)
+
+
+@register_op("send_ue_recv")
+def send_ue_recv(x, y, src_index, dst_index, message_op="ADD",
+                 reduce_op="SUM", out_size=None, name=None):
+    n = out_size or x.shape[0]
+
+    def f(a, e, si, di):
+        msgs = a[si]
+        if message_op.upper() in ("ADD", "SUM"):
+            msgs = msgs + e
+        else:
+            msgs = msgs * e
+        return _segment_reduce(msgs, di, n, reduce_op.upper())
+
+    return apply("send_ue_recv", f, x, y, src_index, dst_index)
+
+
+@register_op("send_uv")
+def send_uv(x, y, src_index, dst_index, message_op="ADD", name=None):
+    def f(a, b, si, di):
+        u = a[si]
+        v = b[di]
+        return u + v if message_op.upper() in ("ADD", "SUM") else u * v
+
+    return apply("send_uv", f, x, y, src_index, dst_index)
+
+
+@register_op("segment_pool")
+def segment_pool(x, segment_ids, pooltype="SUM", name=None):
+    def f(a, ids):
+        n = int(np.asarray(jax.device_get(ids)).max()) + 1 if ids.size else 0
+        return _segment_reduce(a, ids, n, pooltype.upper())
+
+    return apply("segment_pool", f, x, segment_ids)
+
+
+# ------------------------------------------------------------- MoE routing
+
+
+@register_op("number_count", differentiable=False)
+def number_count(numbers, upper_range, name=None):
+    v = numbers._value.reshape(-1)
+    return Tensor._from_value(jnp.bincount(v, length=upper_range))
+
+
+@register_op("assign_pos", differentiable=False)
+def assign_pos(x, cum_count, eff_num_len=None, name=None):
+    """Token positions grouped by expert (fluid assign_pos_op): stable sort
+    of token indices by expert id."""
+    ids = x._value.reshape(-1)
+    order = jnp.argsort(ids, stable=True)
+    return Tensor._from_value(order.astype(jnp.int64))
+
+
+@register_op("limit_by_capacity", differentiable=False)
+def limit_by_capacity(expert_count, capacity, n_worker=1, name=None):
+    ec = expert_count._value
+    cap = capacity._value if isinstance(capacity, Tensor) else jnp.asarray(capacity)
+    return Tensor._from_value(jnp.minimum(ec, cap))
+
+
+@register_op("prune_gate_by_capacity", differentiable=False)
+def prune_gate_by_capacity(gate_idx, expert_count, n_expert=1, n_worker=1,
+                           name=None):
+    """Drop tokens over expert capacity (fluid prune_gate_by_capacity_op):
+    tokens beyond an expert's count become -1."""
+    gi = gate_idx._value.reshape(-1)
+    ec = expert_count._value.reshape(-1)
+    order = jnp.argsort(gi, stable=True)
+    ranked = gi[order]
+    # rank within expert = position - first position of that expert
+    first = jnp.searchsorted(ranked, jnp.arange(ec.shape[0]))
+    rank_in_expert = jnp.arange(gi.shape[0]) - first[ranked]
+    keep_sorted = rank_in_expert < ec[ranked]
+    keep = jnp.zeros_like(keep_sorted).at[order].set(keep_sorted)
+    return Tensor._from_value(jnp.where(keep, gi, -1))
+
+
+@register_op("random_routing", differentiable=False)
+def random_routing(prob, topk_value, topk_idx, name=None):
+    """2nd-expert stochastic routing (fluid random_routing_op): keep expert 2
+    with probability proportional to its gate value."""
+    from paddle_tpu.framework import random as rng
+    p = prob._value
+    v = topk_value._value
+    idx = topk_idx._value
+    u = jax.random.uniform(rng.next_key(), p.shape)
+    keep = (v[:, 1] * 2.0) > u.reshape(-1)
+    new_idx = idx.at[:, 1].set(jnp.where(keep, idx[:, 1], -1))
+    return Tensor._from_value(new_idx)
+
+
+# ------------------------------------------------------------ collectives
+
+
+def _register_collective(opname, fn):
+    register_op(opname, differentiable=False)(fn)
+    return fn
+
+
+def _c_allreduce(reduce_kind):
+    def op(x, ring_id=0, use_calc_stream=False, use_model_parallel=False,
+           name=None):
+        import paddle_tpu.distributed as dist
+        op_map = {"sum": dist.ReduceOp.SUM, "max": dist.ReduceOp.MAX,
+                  "min": dist.ReduceOp.MIN, "prod": dist.ReduceOp.PROD}
+        dist.all_reduce(x, op=op_map[reduce_kind])
+        return x
+
+    op.__name__ = f"c_allreduce_{reduce_kind}"
+    return op
+
+
+for _kind in ("sum", "max", "min", "prod"):
+    _register_collective(f"c_allreduce_{_kind}", _c_allreduce(_kind))
+
+
+def c_allgather(x, ring_id=0, nranks=1, use_calc_stream=False, name=None):
+    import paddle_tpu.distributed as dist
+    outs = []
+    dist.all_gather(outs, x)
+    from paddle_tpu.ops import manipulation
+    return manipulation.concat(outs, axis=0)
+
+
+_register_collective("c_allgather", c_allgather)
+
+
+def c_broadcast(x, root=0, ring_id=0, use_calc_stream=False, name=None):
+    import paddle_tpu.distributed as dist
+    dist.broadcast(x, src=root)
+    return x
+
+
+_register_collective("c_broadcast", c_broadcast)
+
+
+def c_concat(x, rank=0, nranks=1, ring_id=0, use_calc_stream=False,
+             use_model_parallel=True, name=None):
+    """Concat along the last dim across the model-parallel group."""
+    import paddle_tpu.distributed as dist
+    outs = []
+    dist.all_gather(outs, x)
+    from paddle_tpu.ops import manipulation
+    return manipulation.concat(outs, axis=-1)
+
+
+_register_collective("c_concat", c_concat)
+
+
+def c_identity(x, ring_id=0, use_calc_stream=False, use_model_parallel=True,
+               name=None):
+    return x
+
+
+_register_collective("c_identity", c_identity)
+
+
+def c_reduce_sum(x, root_id=0, ring_id=0, use_calc_stream=False, name=None):
+    import paddle_tpu.distributed as dist
+    dist.reduce(x, dst=root_id)
+    return x
+
+
+_register_collective("c_reduce_sum", c_reduce_sum)
+
+
+# ------------------------------------------------------------------ sparse
+
+
+@register_op("coalesce", differentiable=False)
+def coalesce(x, name=None):
+    """Merge duplicate COO indices (phi sparse coalesce_kernel)."""
+    from paddle_tpu.sparse import SparseCooTensor, sparse_coo_tensor
+    idx = np.asarray(jax.device_get(x.indices()._value))
+    vals = np.asarray(jax.device_get(x.values()._value))
+    flat = np.ravel_multi_index(idx, x.shape[:idx.shape[0]])
+    uniq, inv = np.unique(flat, return_inverse=True)
+    merged = np.zeros((len(uniq),) + vals.shape[1:], vals.dtype)
+    np.add.at(merged, inv, vals)
+    new_idx = np.stack(np.unravel_index(uniq, x.shape[:idx.shape[0]]))
+    return sparse_coo_tensor(new_idx, merged, x.shape)
+
+
+@register_op("indices", differentiable=False)
+def sparse_indices(x, name=None):
+    return x.indices()
+
+
+@register_op("values")
+def sparse_values(x, name=None):
+    return x.values()
+
+
+@register_op("to_sparse_csr", differentiable=False)
+def to_sparse_csr(x, name=None):
+    from paddle_tpu import sparse as sp
+    dense = x.to_dense() if hasattr(x, "to_dense") else x
+    v = np.asarray(jax.device_get(
+        dense._value if isinstance(dense, Tensor) else dense))
+    nz = np.nonzero(v)
+    crows = np.zeros(v.shape[0] + 1, np.int64)
+    np.add.at(crows, nz[0] + 1, 1)
+    crows = np.cumsum(crows)
+    return sp.sparse_csr_tensor(crows, nz[1], v[nz], v.shape)
+
+
+@register_op("masked_matmul")
+def masked_matmul(x, y, mask, name=None):
+    """Sparse-output matmul: dense x@y evaluated only at mask's nonzeros
+    (phi sparse masked_matmul_kernel). Computed dense + gather (SDDMM on TPU
+    rides the MXU; sparsity is a masking of the output)."""
+    from paddle_tpu.sparse import sparse_coo_tensor
+    xv = x._value if isinstance(x, Tensor) else jnp.asarray(x)
+    yv = y._value if isinstance(y, Tensor) else jnp.asarray(y)
+    out = xv @ yv
+    idx = mask.indices()._value
+    vals = out[tuple(idx)]
+    return sparse_coo_tensor(idx, vals, out.shape)
+
+
+@register_op("mask_as")
+def mask_as(x, mask, name=None):
+    """Mask a dense tensor by a sparse tensor's pattern (phi sparse
+    mask_as_kernel)."""
+    from paddle_tpu.sparse import sparse_coo_tensor
+    xv = x._value if isinstance(x, Tensor) else jnp.asarray(x)
+    idx = mask.indices()._value
+    vals = xv[tuple(idx)]
+    return sparse_coo_tensor(idx, vals, xv.shape)
+
+
+@register_op("maxpool")
+def sparse_maxpool(x, kernel_sizes, paddings=(0,), dilations=(1,),
+                   strides=(1,), name=None):
+    """Sparse 3-D maxpool (phi sparse pool_kernel): densify -> reduce_window
+    -> resparsify (TPU has no sparse conv units; dense windows on VPU)."""
+    from paddle_tpu.sparse import to_sparse_coo
+    dense = x.to_dense()
+    v = dense._value if isinstance(dense, Tensor) else jnp.asarray(dense)
+    k = list(kernel_sizes)
+    s = list(strides) if len(list(strides)) == 3 else [strides[0]] * 3
+    p = list(paddings) if len(list(paddings)) == 3 else [paddings[0]] * 3
+    # NDHWC layout
+    out = jax.lax.reduce_window(
+        v, -jnp.inf, jax.lax.max, (1, *k, 1), (1, *s, 1),
+        [(0, 0)] + [(pp, pp) for pp in p] + [(0, 0)])
+    return to_sparse_coo(Tensor._from_value(out), sparse_dim=4)
+
+
+# ------------------------------------------------------- numerics debugging
+
+
+@register_op("check_numerics", differentiable=False)
+def check_numerics(tensor, op_type="", var_name="", check_nan_inf_level=0,
+                   stack_height_limit=-1, output_dir="", name=None):
+    v = tensor._value
+    num_nan = jnp.sum(jnp.isnan(v))
+    num_inf = jnp.sum(jnp.isinf(v))
+    num_zero = jnp.sum(v == 0)
+    return (Tensor._from_value(jnp.stack([num_nan, num_inf, num_zero])
+                               .astype(jnp.int64)),
+            Tensor._from_value(jnp.stack([
+                jnp.max(jnp.where(jnp.isfinite(v), v, -jnp.inf)),
+                jnp.min(jnp.where(jnp.isfinite(v), v, jnp.inf)),
+                jnp.mean(jnp.where(jnp.isfinite(v), v, 0.0))]).astype(jnp.float32)))
+
+
+@register_op("enable_check_model_nan_inf", differentiable=False)
+def enable_check_model_nan_inf(x=None, flag=1, name=None):
+    from paddle_tpu.amp import debugging
+    debugging.enable_operator_stats_collection()
+    return x
+
+
+@register_op("disable_check_model_nan_inf", differentiable=False)
+def disable_check_model_nan_inf(x=None, flag=0, name=None):
+    from paddle_tpu.amp import debugging
+    debugging.disable_operator_stats_collection()
+    return x
+
+
+@register_op("read_file", differentiable=False)
+def read_file(filename, name=None):
+    data = np.fromfile(filename, dtype=np.uint8)
+    return Tensor._from_value(jnp.asarray(data))

@@ -1,0 +1,14 @@
+"""paddle_tpu.optimizer (parity: python/paddle/optimizer)."""
+
+from paddle_tpu.optimizer import lr  # noqa: F401
+from paddle_tpu.optimizer.optimizer import (  # noqa: F401
+    SGD,
+    Adagrad,
+    Adam,
+    Adamax,
+    AdamW,
+    Lamb,
+    Momentum,
+    Optimizer,
+    RMSProp,
+)

@@ -1,0 +1,446 @@
+"""Optimizers (parity: python/paddle/optimizer/optimizer.py:122 Optimizer base,
+adam.py, adamw.py, momentum.py, lamb.py, etc.).
+
+TPU-native: each update rule is one jitted jax function over (param, grad,
+state) — XLA fuses the whole parameter update into a couple of kernels; scalar
+hyperparameters are passed as traced arrays so LR changes never recompile.
+Master weights for bf16/fp16 params (the reference's multi_precision flag) keep
+an fp32 shadow exactly like phi's fused kernels do.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.autograd import no_grad
+from paddle_tpu.framework import dtype as dtypes
+from paddle_tpu.optimizer import lr as lr_mod
+from paddle_tpu.tensor import Parameter, Tensor
+
+
+class Optimizer:
+    def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None, multi_precision=False):
+        self._lr = learning_rate
+        if parameters is None:
+            raise ValueError(
+                "parameters is required in dygraph mode "
+                "(pass model.parameters())"
+            )
+        self._parameter_list = list(parameters)
+        self._weight_decay = 0.0 if weight_decay is None else weight_decay
+        self._grad_clip = grad_clip
+        self._multi_precision = multi_precision
+        # per-parameter state: id(param) -> dict of jax arrays
+        self._state: Dict[int, dict] = {}
+        self._master_weights: Dict[int, jax.Array] = {}
+        self._step_count = 0
+
+    # -------------------------------------------------------------------- lr
+    def get_lr(self) -> float:
+        if isinstance(self._lr, lr_mod.LRScheduler):
+            return self._lr()
+        return float(self._lr)
+
+    def set_lr(self, value: float):
+        if isinstance(self._lr, lr_mod.LRScheduler):
+            raise RuntimeError("cannot set_lr when using an LRScheduler")
+        self._lr = float(value)
+
+    def set_lr_scheduler(self, scheduler):
+        self._lr = scheduler
+
+    # ------------------------------------------------------------------ grads
+    def clear_grad(self, set_to_zero: bool = False):
+        for p in self._parameter_list:
+            p.clear_gradient(set_to_zero)
+
+    clear_gradients = clear_grad
+
+    def _clipped_grads(self):
+        """Return [(param, grad_array)] after grad clipping."""
+        pairs = [
+            (p, p._grad) for p in self._parameter_list
+            if p._grad is not None and p.trainable
+        ]
+        if self._grad_clip is not None and pairs:
+            grads = [g for _, g in pairs]
+            grads = self._grad_clip._clip_arrays(grads)
+            pairs = [(p, g) for (p, _), g in zip(pairs, grads)]
+        return pairs
+
+    def _master(self, p):
+        """fp32 master weight for low-precision params (multi_precision)."""
+        if not self._multi_precision:
+            return None
+        if p.dtype in (jnp.float16, jnp.bfloat16):
+            key = id(p)
+            if key not in self._master_weights:
+                self._master_weights[key] = p._value.astype(jnp.float32)
+            return self._master_weights[key]
+        return None
+
+    # ------------------------------------------------------------------- step
+    @no_grad()
+    def step(self):
+        lr = jnp.asarray(self.get_lr(), jnp.float32)
+        self._step_count += 1
+        offload = getattr(self, "_offload", False)
+        if offload:
+            from paddle_tpu.distributed.sharding import (
+                to_device_memory,
+                to_host_memory,
+            )
+        for p, g in self._clipped_grads():
+            if id(p) not in self._state:
+                self._state[id(p)] = self._init_state(p)
+            state = self._state[id(p)]
+            master = self._master(p)
+            target = master if master is not None else p._value
+            if offload:
+                # stream host-resident state in for the update; eager jnp
+                # math cannot mix host and device memory spaces
+                state = {k: to_device_memory(v) if hasattr(v, "shape") else v
+                         for k, v in state.items()}
+                target = to_device_memory(target)
+            if g.dtype != target.dtype:
+                g = g.astype(target.dtype)
+            new_target, state_update = self._apply_one(
+                target, g, lr, state, self._decay_for(p)
+            )
+            if offload:
+                # keep optimizer states / fp32 masters resident in pinned
+                # host memory across steps (ZeRO offload semantics)
+                state_update = {
+                    k: to_host_memory(v) if hasattr(v, "shape") else v
+                    for k, v in state_update.items()
+                }
+            self._state[id(p)] = state_update
+            if master is not None:
+                self._master_weights[id(p)] = (
+                    to_host_memory(new_target) if offload else new_target)
+                p._replace_value(new_target.astype(p.dtype))
+            else:
+                p._replace_value(new_target)
+
+    def minimize(self, loss, startup_program=None, parameters=None, no_grad_set=None):
+        loss.backward()
+        self.step()
+        return None, None
+
+    def _decay_for(self, p) -> float:
+        wd = self._weight_decay
+        if hasattr(wd, "__call__") and not isinstance(wd, (int, float)):
+            return float(wd(p))
+        if getattr(p, "no_weight_decay", False):
+            return 0.0
+        return float(wd)
+
+    # ---------------------------------------------------------- subclass API
+    def _init_state(self, p) -> dict:
+        return {}
+
+    def _apply_one(self, param, grad, lr, state, weight_decay):
+        raise NotImplementedError
+
+    # ------------------------------------------------------------ checkpoint
+    def state_dict(self):
+        sd = {"step_count": self._step_count, "states": [], "master_weights": []}
+        for p in self._parameter_list:
+            st = self._state.get(id(p))
+            sd["states"].append(
+                {k: Tensor._from_value(v) for k, v in st.items()} if st else None
+            )
+            mw = self._master_weights.get(id(p))
+            sd["master_weights"].append(Tensor._from_value(mw) if mw is not None else None)
+        if isinstance(self._lr, lr_mod.LRScheduler):
+            sd["LR_Scheduler"] = self._lr.state_dict()
+        return sd
+
+    def set_state_dict(self, state_dict):
+        self._step_count = state_dict.get("step_count", 0)
+        states = state_dict.get("states", [])
+        masters = state_dict.get("master_weights", [])
+        for p, st in zip(self._parameter_list, states):
+            if st is not None:
+                self._state[id(p)] = {
+                    k: (v._value if isinstance(v, Tensor) else jnp.asarray(v))
+                    for k, v in st.items()
+                }
+        for p, mw in zip(self._parameter_list, masters):
+            if mw is not None:
+                self._master_weights[id(p)] = (
+                    mw._value if isinstance(mw, Tensor) else jnp.asarray(mw)
+                )
+        if "LR_Scheduler" in state_dict and isinstance(self._lr, lr_mod.LRScheduler):
+            self._lr.set_state_dict(state_dict["LR_Scheduler"])
+
+
+# --------------------------------------------------------------- jitted rules
+@jax.jit
+def _sgd_update(p, g, lr, wd):
+    g = g + wd * p
+    return p - lr.astype(p.dtype) * g
+
+
+@jax.jit
+def _momentum_update(p, g, vel, lr, mu, wd, use_nesterov):
+    g = g + wd * p
+    v_new = mu * vel + g
+    upd = jnp.where(use_nesterov, g + mu * v_new, v_new)
+    return p - lr.astype(p.dtype) * upd, v_new
+
+
+@jax.jit
+def _adam_update(p, g, m, v, step, lr, beta1, beta2, eps, wd):
+    g = g + wd * p
+    m_new = beta1 * m + (1 - beta1) * g
+    v_new = beta2 * v + (1 - beta2) * jnp.square(g)
+    bc1 = 1 - beta1 ** step
+    bc2 = 1 - beta2 ** step
+    m_hat = m_new / bc1
+    v_hat = v_new / bc2
+    return (
+        p - (lr.astype(p.dtype) * m_hat / (jnp.sqrt(v_hat) + eps)).astype(p.dtype),
+        m_new,
+        v_new,
+    )
+
+
+@jax.jit
+def _adamw_update(p, g, m, v, step, lr, beta1, beta2, eps, wd):
+    # decoupled weight decay
+    m_new = beta1 * m + (1 - beta1) * g
+    v_new = beta2 * v + (1 - beta2) * jnp.square(g)
+    bc1 = 1 - beta1 ** step
+    bc2 = 1 - beta2 ** step
+    m_hat = m_new / bc1
+    v_hat = v_new / bc2
+    lrp = lr.astype(p.dtype)
+    p_new = p - lrp * wd * p - lrp * m_hat / (jnp.sqrt(v_hat) + eps)
+    return p_new, m_new, v_new
+
+
+@jax.jit
+def _adagrad_update(p, g, acc, lr, eps, wd):
+    g = g + wd * p
+    acc_new = acc + jnp.square(g)
+    return p - lr.astype(p.dtype) * g / (jnp.sqrt(acc_new) + eps), acc_new
+
+
+@jax.jit
+def _rmsprop_update(p, g, acc, lr, rho, eps, mom, vel, wd):
+    g = g + wd * p
+    acc_new = rho * acc + (1 - rho) * jnp.square(g)
+    v_new = mom * vel + lr.astype(p.dtype) * g / jnp.sqrt(acc_new + eps)
+    return p - v_new, acc_new, v_new
+
+
+@jax.jit
+def _lamb_update(p, g, m, v, step, lr, beta1, beta2, eps, wd):
+    m_new = beta1 * m + (1 - beta1) * g
+    v_new = beta2 * v + (1 - beta2) * jnp.square(g)
+    m_hat = m_new / (1 - beta1 ** step)
+    v_hat = v_new / (1 - beta2 ** step)
+    r = m_hat / (jnp.sqrt(v_hat) + eps) + wd * p
+    w_norm = jnp.linalg.norm(p)
+    r_norm = jnp.linalg.norm(r)
+    trust = jnp.where((w_norm > 0) & (r_norm > 0), w_norm / r_norm, 1.0)
+    return p - lr.astype(p.dtype) * trust * r, m_new, v_new
+
+
+class SGD(Optimizer):
+    def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None,
+                 grad_clip=None, multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name,
+                         multi_precision)
+
+    def _apply_one(self, param, grad, lr, state, wd):
+        return _sgd_update(param, grad, lr, jnp.asarray(wd, param.dtype)), state
+
+
+class Momentum(Optimizer):
+    def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None,
+                 use_nesterov=False, weight_decay=None, grad_clip=None,
+                 multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name,
+                         multi_precision)
+        self._momentum = momentum
+        self._use_nesterov = use_nesterov
+
+    def _init_state(self, p):
+        base = self._master(p)
+        ref = base if base is not None else p._value
+        return {"velocity": jnp.zeros_like(ref)}
+
+    def _apply_one(self, param, grad, lr, state, wd):
+        p_new, v_new = _momentum_update(
+            param, grad, state["velocity"], lr,
+            jnp.asarray(self._momentum, param.dtype),
+            jnp.asarray(wd, param.dtype),
+            jnp.asarray(self._use_nesterov),
+        )
+        return p_new, {"velocity": v_new}
+
+
+class Adam(Optimizer):
+    _update = staticmethod(_adam_update)
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-08,
+                 parameters=None, weight_decay=None, grad_clip=None, lazy_mode=False,
+                 multi_precision=False, use_multi_tensor=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name,
+                         multi_precision)
+        self._beta1 = beta1
+        self._beta2 = beta2
+        self._epsilon = epsilon
+
+    def _init_state(self, p):
+        base = self._master(p)
+        ref = base if base is not None else p._value
+        return {
+            "moment1": jnp.zeros_like(ref),
+            "moment2": jnp.zeros_like(ref),
+            "step": jnp.zeros((), jnp.int32),
+        }
+
+    def _apply_one(self, param, grad, lr, state, wd):
+        step = state["step"] + 1
+        p_new, m_new, v_new = self._update(
+            param, grad, state["moment1"], state["moment2"], step.astype(param.dtype),
+            lr, jnp.asarray(self._beta1, param.dtype),
+            jnp.asarray(self._beta2, param.dtype),
+            jnp.asarray(self._epsilon, param.dtype),
+            jnp.asarray(wd, param.dtype),
+        )
+        return p_new, {"moment1": m_new, "moment2": v_new, "step": step}
+
+
+class AdamW(Adam):
+    _update = staticmethod(_adamw_update)
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-08,
+                 parameters=None, weight_decay=0.01, lr_ratio=None,
+                 apply_decay_param_fun=None, grad_clip=None, lazy_mode=False,
+                 multi_precision=False, name=None):
+        super().__init__(learning_rate, beta1, beta2, epsilon, parameters,
+                         weight_decay, grad_clip, lazy_mode, multi_precision,
+                         name=name)
+        self._apply_decay_param_fun = apply_decay_param_fun
+
+    def _decay_for(self, p):
+        if self._apply_decay_param_fun is not None and not \
+                self._apply_decay_param_fun(p.name):
+            return 0.0
+        return super()._decay_for(p)
+
+
+class Adagrad(Optimizer):
+    def __init__(self, learning_rate=0.001, epsilon=1e-06, parameters=None,
+                 weight_decay=None, grad_clip=None, initial_accumulator_value=0.0,
+                 multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name,
+                         multi_precision)
+        self._epsilon = epsilon
+        self._init_acc = initial_accumulator_value
+
+    def _init_state(self, p):
+        return {"moment": jnp.full_like(p._value, self._init_acc)}
+
+    def _apply_one(self, param, grad, lr, state, wd):
+        p_new, acc = _adagrad_update(
+            param, grad, state["moment"], lr,
+            jnp.asarray(self._epsilon, param.dtype), jnp.asarray(wd, param.dtype),
+        )
+        return p_new, {"moment": acc}
+
+
+class RMSProp(Optimizer):
+    def __init__(self, learning_rate=0.001, rho=0.95, epsilon=1e-06, momentum=0.0,
+                 centered=False, parameters=None, weight_decay=None, grad_clip=None,
+                 multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name,
+                         multi_precision)
+        self._rho = rho
+        self._epsilon = epsilon
+        self._momentum = momentum
+
+    def _init_state(self, p):
+        return {
+            "mean_square": jnp.zeros_like(p._value),
+            "velocity": jnp.zeros_like(p._value),
+        }
+
+    def _apply_one(self, param, grad, lr, state, wd):
+        p_new, acc, vel = _rmsprop_update(
+            param, grad, state["mean_square"], lr,
+            jnp.asarray(self._rho, param.dtype),
+            jnp.asarray(self._epsilon, param.dtype),
+            jnp.asarray(self._momentum, param.dtype),
+            state["velocity"], jnp.asarray(wd, param.dtype),
+        )
+        return p_new, {"mean_square": acc, "velocity": vel}
+
+
+class Lamb(Optimizer):
+    def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01, beta1=0.9,
+                 beta2=0.999, epsilon=1e-06, parameters=None, grad_clip=None,
+                 exclude_from_weight_decay_fn=None, multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, lamb_weight_decay, grad_clip,
+                         name, multi_precision)
+        self._beta1 = beta1
+        self._beta2 = beta2
+        self._epsilon = epsilon
+        self._exclude_fn = exclude_from_weight_decay_fn
+
+    def _init_state(self, p):
+        return {
+            "moment1": jnp.zeros_like(p._value),
+            "moment2": jnp.zeros_like(p._value),
+            "step": jnp.zeros((), jnp.int32),
+        }
+
+    def _decay_for(self, p):
+        if self._exclude_fn is not None and self._exclude_fn(p):
+            return 0.0
+        return float(self._weight_decay)
+
+    def _apply_one(self, param, grad, lr, state, wd):
+        step = state["step"] + 1
+        p_new, m_new, v_new = _lamb_update(
+            param, grad, state["moment1"], state["moment2"], step.astype(param.dtype),
+            lr, jnp.asarray(self._beta1, param.dtype),
+            jnp.asarray(self._beta2, param.dtype),
+            jnp.asarray(self._epsilon, param.dtype),
+            jnp.asarray(wd, param.dtype),
+        )
+        return p_new, {"moment1": m_new, "moment2": v_new, "step": step}
+
+
+class Adamax(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-08,
+                 parameters=None, weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+
+    def _init_state(self, p):
+        return {
+            "moment": jnp.zeros_like(p._value),
+            "inf_norm": jnp.zeros_like(p._value),
+            "step": jnp.zeros((), jnp.int32),
+        }
+
+    def _apply_one(self, param, grad, lr, state, wd):
+        step = state["step"] + 1
+        b1 = jnp.asarray(self._beta1, param.dtype)
+        b2 = jnp.asarray(self._beta2, param.dtype)
+        g = grad + jnp.asarray(wd, param.dtype) * param
+        m = b1 * state["moment"] + (1 - b1) * g
+        u = jnp.maximum(b2 * state["inf_norm"], jnp.abs(g))
+        p_new = param - (lr.astype(param.dtype) / (1 - b1 ** step.astype(param.dtype))) \
+            * m / (u + self._epsilon)
+        return p_new, {"moment": m, "inf_norm": u, "step": step}

@@ -1,0 +1,321 @@
+"""paddle.quantization parity (reference: python/paddle/quantization/ —
+QuantConfig config.py, QAT qat.py, PTQ ptq.py, observers in observer/,
+fake quanters in quanters/).
+
+TPU-native: fake-quant simulates int8 on the fly inside the XLA program
+(quant-dequant folds into the surrounding matmul epilogues); the
+straight-through estimator keeps training differentiable — the same
+simulated-quantization scheme the reference's QAT pass inserts."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import paddle_tpu.nn as pnn
+from paddle_tpu.autograd.py_layer import PyLayer
+from paddle_tpu.core.dispatch import apply
+from paddle_tpu.tensor import Tensor
+
+
+def _channel_scale(s, ndim, axis):
+    """Reshape a per-channel scale vector to broadcast along ``axis``."""
+    if axis is None or s.ndim == 0:
+        return s
+    shape = [1] * ndim
+    shape[axis] = s.shape[0]
+    return s.reshape(shape)
+
+
+def quantize_linear(x, scale, zero_point=0.0, bit_length=8, axis=None):
+    qmax = 2 ** (bit_length - 1) - 1
+    qmin = -(2 ** (bit_length - 1))
+
+    def f(v, s):
+        q = jnp.round(v / _channel_scale(s, v.ndim, axis) + zero_point)
+        return jnp.clip(q, qmin, qmax)
+
+    return apply("quantize_linear", f, x, scale)
+
+
+def dequantize_linear(x, scale, zero_point=0.0, bit_length=8, axis=None):
+    def f(q, s):
+        return (q - zero_point) * _channel_scale(s, q.ndim, axis)
+
+    return apply("dequantize_linear", f, x, scale)
+
+
+class _FakeQuantSTE(PyLayer):
+    """Fake quant with straight-through gradient."""
+
+    @staticmethod
+    def forward(ctx, x, scale, bit_length=8):
+        qmax = 2 ** (bit_length - 1) - 1
+        qmin = -(2 ** (bit_length - 1))
+        import paddle_tpu as paddle
+
+        q = paddle.clip(paddle.round(x / scale), float(qmin), float(qmax))
+        return q * scale
+
+    @staticmethod
+    def backward(ctx, dy):
+        return dy, None
+
+
+class BaseObserver(pnn.Layer):
+    def __init__(self, quant_bits=8):
+        super().__init__()
+        self.quant_bits = quant_bits
+        self._scale = None
+
+    def scales(self):
+        return self._scale
+
+    def bit_length(self):
+        return self.quant_bits
+
+
+class AbsmaxObserver(BaseObserver):
+    """observer/abs_max.py parity: running abs-max calibration."""
+
+    def __init__(self, quant_bits=8):
+        super().__init__(quant_bits)
+        self._absmax = 0.0
+
+    def forward(self, x):
+        cur = float(np.abs(np.asarray(x.numpy())).max()) if x.numel() else 0.0
+        self._absmax = max(self._absmax, cur)
+        self._scale = self._absmax / (2 ** (self.quant_bits - 1) - 1) or 1e-8
+        return x
+
+
+class FakeQuanterWithAbsMaxObserver(pnn.Layer):
+    """quanters/abs_max.py parity: QAT fake-quant node with EMA abs-max."""
+
+    def __init__(self, moving_rate=0.9, quant_bits=8, **kwargs):
+        super().__init__()
+        self.moving_rate = moving_rate
+        self.quant_bits = quant_bits
+        self._ema = None
+
+    def scales(self):
+        if self._ema is None:
+            return None
+        return self._ema / (2 ** (self.quant_bits - 1) - 1)
+
+    def bit_length(self):
+        return self.quant_bits
+
+    def forward(self, x):
+        cur = float(np.abs(np.asarray(x.detach().numpy())).max() or 1e-8)
+        self._ema = cur if self._ema is None else \
+            self.moving_rate * self._ema + (1 - self.moving_rate) * cur
+        scale = self._ema / (2 ** (self.quant_bits - 1) - 1)
+        import paddle_tpu as paddle
+
+        return _FakeQuantSTE.apply(x, paddle.to_tensor(np.float32(scale)),
+                                   self.quant_bits)
+
+
+class QuantConfig:
+    """config.py parity: maps layers -> quanter factories."""
+
+    def __init__(self, activation=None, weight=None):
+        self.activation = activation
+        self.weight = weight
+        self._type_configs = {}
+
+    def add_type_config(self, layer_type, activation=None, weight=None):
+        if not isinstance(layer_type, (list, tuple)):
+            layer_type = [layer_type]
+        for lt in layer_type:
+            self._type_configs[lt] = (activation or self.activation,
+                                      weight or self.weight)
+
+    def _config_for(self, layer):
+        for lt, cfg in self._type_configs.items():
+            if isinstance(layer, lt):
+                return cfg
+        if self.activation or self.weight:
+            if isinstance(layer, (pnn.Linear, pnn.Conv2D)):
+                return (self.activation, self.weight)
+        return None
+
+
+class QuantedLayer(pnn.Layer):
+    """Wrapper inserting activation/weight fake-quant around a layer."""
+
+    def __init__(self, layer, act_quanter, weight_quanter):
+        super().__init__()
+        self.inner = layer
+        self.act_quanter = act_quanter
+        self.weight_quanter = weight_quanter
+
+    def forward(self, x):
+        if self.act_quanter is not None:
+            x = self.act_quanter(x)
+        if self.weight_quanter is not None and hasattr(self.inner, "weight"):
+            w = self.inner.weight
+            qw = self.weight_quanter(w)
+            orig = w._value
+            w._replace_value(qw._value, getattr(qw, "_node", None))
+            try:
+                return self.inner(x)
+            finally:
+                w._replace_value(orig)
+        return self.inner(x)
+
+
+def _apply_config(model, config: QuantConfig, factory):
+    for name, child in list(model._sub_layers.items()):
+        cfg = config._config_for(child)
+        if cfg is not None:
+            act_f, w_f = cfg
+            model._sub_layers[name] = QuantedLayer(
+                child, factory(act_f), factory(w_f))
+        else:
+            _apply_config(child, config, factory)
+    return model
+
+
+class QuantizedInferenceLayer(pnn.Layer):
+    """Inference-time int8 simulation produced by convert(): the weight is
+    STORED as int8 (+ fp scale) and dequantized on the fly; activations pass
+    through a frozen-scale quant-dequant. On TPU the dequant folds into the
+    surrounding matmul (the weight-only-int8 serving pattern; reference:
+    the ONNX-exportable quantized program QAT.convert emits)."""
+
+    def __init__(self, qlayer: "QuantedLayer"):
+        super().__init__()
+        self.inner = qlayer.inner
+        self.act_scale = None
+        self.act_bits = 8
+        if qlayer.act_quanter is not None:
+            s = qlayer.act_quanter.scales()
+            self.act_scale = float(s) if s is not None else None
+            self.act_bits = qlayer.act_quanter.bit_length()
+        self.qweight = None
+        self.w_scale = None
+        if qlayer.weight_quanter is not None and hasattr(qlayer.inner,
+                                                         "weight"):
+            w = qlayer.inner.weight._value
+            bits = qlayer.weight_quanter.bit_length()
+            s = qlayer.weight_quanter.scales()
+            scale = (float(s) if s is not None
+                     else float(jnp.max(jnp.abs(w))) / (2 ** (bits - 1) - 1))
+            scale = scale or 1e-8
+            qmax = 2 ** (bits - 1) - 1
+            self.qweight = Tensor._from_value(
+                jnp.clip(jnp.round(w / scale), -qmax, qmax).astype(jnp.int8))
+            self.w_scale = scale
+
+    def forward(self, x):
+        import paddle_tpu as paddle
+
+        if self.act_scale is not None:
+            qmax = float(2 ** (self.act_bits - 1) - 1)
+            q = paddle.clip(paddle.round(x / self.act_scale), -qmax, qmax)
+            x = q * self.act_scale
+        if self.qweight is not None:
+            w = self.inner.weight
+            orig = w._value
+            w._replace_value(
+                (self.qweight._value.astype(jnp.float32)
+                 * self.w_scale).astype(orig.dtype))
+            try:
+                return self.inner(x)
+            finally:
+                w._replace_value(orig)
+        return self.inner(x)
+
+
+def _convert_tree(model, inplace):
+    if not inplace:
+        import copy
+
+        model = copy.deepcopy(model)  # preserve the observed/QAT model
+
+    def walk(m):
+        for name, child in list(m._sub_layers.items()):
+            if isinstance(child, QuantedLayer):
+                m._sub_layers[name] = QuantizedInferenceLayer(child)
+            else:
+                walk(child)
+
+    walk(model)
+    return model
+
+
+class QAT:
+    """qat.py parity: insert trainable fake-quant nodes; convert() swaps
+    them for the int8-sim inference layers with frozen scales."""
+
+    def __init__(self, config: QuantConfig):
+        self.config = config
+
+    def quantize(self, model, inplace=False):
+        def factory(f):
+            if f is None:
+                return None
+            return f() if callable(f) else f
+
+        return _apply_config(model, self.config, factory)
+
+    def convert(self, model, inplace=False):
+        return _convert_tree(model, inplace)
+
+
+class PTQ:
+    """ptq.py parity: insert observers; calibrate with representative data,
+    then convert()."""
+
+    def __init__(self, config: QuantConfig):
+        self.config = config
+
+    def quantize(self, model, inplace=False):
+        def factory(f):
+            if f is None:
+                return None
+            return f() if callable(f) else f
+
+        return _apply_config(model, self.config, factory)
+
+    def calibrate(self, model, loader, steps=None):
+        """Run representative data through the observed model (the PTQ
+        calibration loop; reference ptq.py sampling pass). Accepts a
+        DataLoader-like iterable yielding batches or (x, ...) tuples."""
+        model.eval()
+        n = 0
+        for batch in loader:
+            x = batch[0] if isinstance(batch, (list, tuple)) else batch
+            model(x)
+            n += 1
+            if steps is not None and n >= steps:
+                break
+        return n
+
+    def convert(self, model, inplace=False):
+        return _convert_tree(model, inplace)
+
+
+def collect_scales(model, prefix=""):
+    """All calibrated scales in the (observed or converted) model —
+    {layer_path: {"act": s, "weight": s}}."""
+    out = {}
+    for name, child in model._sub_layers.items():
+        path = f"{prefix}.{name}" if prefix else name
+        if isinstance(child, QuantedLayer):
+            entry = {}
+            if child.act_quanter is not None:
+                entry["act"] = child.act_quanter.scales()
+            if child.weight_quanter is not None:
+                entry["weight"] = child.weight_quanter.scales()
+            out[path] = entry
+        elif isinstance(child, QuantizedInferenceLayer):
+            out[path] = {"act": child.act_scale, "weight": child.w_scale}
+        else:
+            out.update(collect_scales(child, path))
+    return out

@@ -1,0 +1,139 @@
+"""paddle.static shim (reference: python/paddle/static/ + base/framework.py
+Program:5810, base/executor.py Executor:1179).
+
+TPU-native deviation, stated up front: the reference's static mode mutates a
+global ProgramDesc while Python runs; XLA's staging IS the static mode here,
+so ``Program`` wraps a traced jax function (built from a dygraph callable via
+``paddle.jit.to_static`` / ``Program.from_callable``) and ``Executor.run``
+executes the compiled program. ``InputSpec`` matches the reference's
+static.InputSpec surface. Code that builds programs op-by-op under
+``program_guard`` should migrate to tracing a function — the capability
+(compile once, run many, save/load) is preserved."""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.framework.dtype import convert_dtype
+from paddle_tpu.tensor import Tensor
+
+
+class InputSpec:
+    """static.InputSpec parity (shape with None for dynamic dims, dtype,
+    name)."""
+
+    def __init__(self, shape, dtype="float32", name=None, stop_gradient=False):
+        self.shape = tuple(shape)
+        self.dtype = convert_dtype(dtype)
+        self.name = name
+        self.stop_gradient = stop_gradient
+
+    @classmethod
+    def from_tensor(cls, tensor, name=None):
+        return cls(tensor.shape, tensor.dtype, name)
+
+    @classmethod
+    def from_numpy(cls, ndarray, name=None):
+        return cls(ndarray.shape, str(ndarray.dtype), name)
+
+    def _aval(self, batch=1):
+        shape = tuple(batch if d is None else d for d in self.shape)
+        return jax.ShapeDtypeStruct(shape, self.dtype)
+
+    def __repr__(self):
+        return f"InputSpec(shape={self.shape}, dtype={self.dtype}, name={self.name})"
+
+
+class Program:
+    """A staged computation: traced callable + captured state."""
+
+    def __init__(self, fn=None, input_specs=None):
+        self._fn = fn
+        self._input_specs = input_specs or []
+        self._jitted = jax.jit(fn) if fn is not None else None
+
+    @classmethod
+    def from_callable(cls, fn, input_specs=None):
+        return cls(fn, input_specs)
+
+    def clone(self, for_test=False):
+        return Program(self._fn, self._input_specs)
+
+    def __repr__(self):
+        return f"Program(fn={getattr(self._fn, '__name__', None)})"
+
+
+_default_main = Program()
+_default_startup = Program()
+
+
+def default_main_program():
+    return _default_main
+
+
+def default_startup_program():
+    return _default_startup
+
+
+class program_guard:
+    """Accepted for source compatibility; tracing replaces graph mutation."""
+
+    def __init__(self, main_program=None, startup_program=None):
+        self.main = main_program
+
+    def __enter__(self):
+        return self.main
+
+    def __exit__(self, *exc):
+        return False
+
+
+def data(name, shape, dtype="float32", lod_level=0):
+    """static.data parity: returns an InputSpec-like placeholder."""
+    return InputSpec(shape, dtype, name)
+
+
+class Executor:
+    """static.Executor parity over jitted programs."""
+
+    def __init__(self, place=None):
+        self.place = place
+
+    def run(self, program=None, feed=None, fetch_list=None, **kwargs):
+        if program is None or program._fn is None:
+            raise ValueError(
+                "Executor.run needs a Program built from a callable "
+                "(Program.from_callable or paddle.jit.to_static)")
+        feed = feed or {}
+        vals = {k: (v._value if isinstance(v, Tensor) else jnp.asarray(v))
+                for k, v in feed.items()}
+        out = program._jitted(**vals)
+        if not isinstance(out, (tuple, list)):
+            out = [out]
+        return [np.asarray(o) for o in out]
+
+
+def save(program, path, **kwargs):
+    raise NotImplementedError(
+        "static.save: use paddle.jit.save on the traced layer instead")
+
+
+def load(program, path, **kwargs):
+    raise NotImplementedError(
+        "static.load: use paddle.jit.load instead")
+
+
+class nn:
+    """static.nn namespace: the control-flow ops the reference's static
+    graphs rely on (conditional_block/while/select — SURVEY §2.6)."""
+
+    from paddle_tpu.ops.control_flow import (  # noqa: F401
+        case,
+        cond,
+        switch_case,
+        while_loop,
+    )

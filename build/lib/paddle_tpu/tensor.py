@@ -1,0 +1,374 @@
+"""The Tensor: a mutable, autograd-tracked handle over an immutable jax.Array.
+
+Capability parity with the reference's eager ``paddle.Tensor``
+(paddle/phi/api/include/tensor.h + pybind/eager.cc + AutogradMeta
+paddle/fluid/eager/autograd_meta.h:61) — re-designed for TPU/XLA:
+
+- The payload ``_value`` is an immutable ``jax.Array`` (or a jax tracer while
+  inside a captured graph). Mutation (in-place ops, ``__setitem__``) is
+  *functionalized*: a new array is computed and swapped into the handle, so
+  dygraph keeps Paddle's mutable semantics while everything under ``jit``
+  remains purely functional for XLA.
+- ``stop_gradient`` defaults to True (Paddle semantics); ``Parameter`` flips it.
+- ``backward()`` drives the tape engine in paddle_tpu.autograd.tape.
+- No Place: device residency is the jax.Array's sharding; ``.cuda()``-style
+  moves map to ``jax.device_put``.
+
+Most operator methods are monkey-bound by ``paddle_tpu.ops`` at import time,
+mirroring the reference's monkey_patch of Tensor methods
+(python/paddle/base/dygraph/tensor_patch_methods.py).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.autograd import tape
+from paddle_tpu.framework import dtype as dtypes
+
+
+class Tensor:
+    __slots__ = (
+        "_value",
+        "stop_gradient",
+        "_grad",
+        "_node",
+        "_retain_grads",
+        "name",
+        "persistable",
+        "trainable",
+        "__weakref__",
+        "__dict__",
+    )
+
+    # Let Tensor win against numpy arrays in mixed binary ops.
+    __array_priority__ = 100.0
+
+    def __init__(self, data=None, dtype=None, place=None, stop_gradient=True):
+        dtype = dtypes.convert_dtype(dtype)
+        if data is None:
+            self._value = jnp.zeros((), dtype=dtype or jnp.float32)
+        elif isinstance(data, Tensor):
+            self._value = data._value if dtype is None else data._value.astype(dtype)
+        elif isinstance(data, (jax.Array,)) or hasattr(data, "dtype") and hasattr(data, "aval"):
+            self._value = data if dtype is None else data.astype(dtype)
+        else:
+            arr = np.asarray(data)
+            # Paddle default: python floats -> float32, ints -> int64.
+            if dtype is None:
+                if arr.dtype == np.float64:
+                    arr = arr.astype(np.float32)
+                self._value = jnp.asarray(arr)
+            else:
+                self._value = jnp.asarray(arr, dtype=dtype)
+        self.stop_gradient = stop_gradient
+        self._grad = None
+        self._node = None
+        self._retain_grads = False
+        self.name = ""
+        self.persistable = False
+        self.trainable = not stop_gradient
+
+    # ---------------------------------------------------------------- factory
+    @classmethod
+    def _from_value(cls, value) -> "Tensor":
+        t = cls.__new__(cls)
+        t._value = value
+        t.stop_gradient = True
+        t._grad = None
+        t._node = None
+        t._retain_grads = False
+        t.name = ""
+        t.persistable = False
+        t.trainable = False
+        return t
+
+    # ------------------------------------------------------------- properties
+    @property
+    def shape(self):
+        return list(self._value.shape)
+
+    @property
+    def ndim(self):
+        return self._value.ndim
+
+    @property
+    def dtype(self):
+        return self._value.dtype
+
+    @property
+    def size(self):
+        return int(np.prod(self._value.shape)) if self._value.shape else 1
+
+    @property
+    def is_leaf(self) -> bool:
+        return self._node is None
+
+    @property
+    def grad(self) -> Optional["Tensor"]:
+        if self._grad is None:
+            return None
+        return _GradView._of(self)
+
+    @grad.setter
+    def grad(self, value):
+        if value is None:
+            self._grad = None
+        else:
+            self._grad = value._value if isinstance(value, Tensor) else jnp.asarray(value)
+
+    @property
+    def T(self):
+        from paddle_tpu import ops
+
+        return ops.manipulation.transpose(self, list(range(self.ndim))[::-1])
+
+    @property
+    def place(self):
+        devs = getattr(self._value, "devices", None)
+        if callable(devs):
+            try:
+                return next(iter(self._value.devices()))
+            except Exception:
+                return None
+        return None
+
+    # --------------------------------------------------------------- autograd
+    def backward(self, grad_tensor=None, retain_graph: bool = False):
+        tape.run_backward([self], [grad_tensor], retain_graph=retain_graph)
+
+    def retain_grads(self):
+        self._retain_grads = True
+
+    def clear_grad(self):
+        self._grad = None
+
+    def clear_gradient(self, set_to_zero: bool = False):
+        if set_to_zero and self._grad is not None:
+            self._grad = jnp.zeros_like(self._grad)
+        else:
+            self._grad = None
+
+    def _accumulate_grad(self, g):
+        if isinstance(g, np.ndarray) and g.dtype == jax.dtypes.float0:
+            return
+        if self._grad is None:
+            self._grad = g
+        else:
+            self._grad = self._grad + g
+
+    def detach(self) -> "Tensor":
+        t = Tensor._from_value(self._value)
+        t.stop_gradient = True
+        return t
+
+    def detach_(self) -> "Tensor":
+        self._node = None
+        self.stop_gradient = True
+        return self
+
+    def clone(self) -> "Tensor":
+        from paddle_tpu.core.dispatch import apply
+
+        return apply("clone", lambda x: x + 0, self)
+
+    # ------------------------------------------------------------ value moves
+    def _replace_value(self, new_value, node=None):
+        """Functionalized in-place update: swap payload (and producer node)."""
+        self._value = new_value
+        self._node = node
+        if node is None:
+            # keep stop_gradient as-is; history is cut
+            pass
+
+    def copy_(self, other, blocking: bool = True):
+        src = other._value if isinstance(other, Tensor) else jnp.asarray(other)
+        self._value = src.astype(self._value.dtype)
+        self._node = None
+        return self
+
+    def set_value(self, value):
+        return self.copy_(value)
+
+    # ------------------------------------------------------------- conversion
+    def numpy(self) -> np.ndarray:
+        return np.asarray(self._value)
+
+    def item(self):
+        return self._value.item()
+
+    def tolist(self):
+        return np.asarray(self._value).tolist()
+
+    def astype(self, dtype) -> "Tensor":
+        from paddle_tpu.core.dispatch import apply
+
+        dt = dtypes.convert_dtype(dtype)
+        return apply("cast", lambda x: x.astype(dt), self)
+
+    def cast(self, dtype) -> "Tensor":
+        return self.astype(dtype)
+
+    def numel(self) -> int:
+        return self.size
+
+    def element_size(self) -> int:
+        return jnp.dtype(self.dtype).itemsize
+
+    def cpu(self) -> "Tensor":
+        cpu_dev = jax.devices("cpu")[0] if jax.devices("cpu") else None
+        t = Tensor._from_value(jax.device_put(self._value, cpu_dev))
+        t.stop_gradient = self.stop_gradient
+        return t
+
+    def to(self, *args, **kwargs) -> "Tensor":
+        # to(dtype) / to(device) / to(device, dtype)
+        out = self
+        for a in list(args) + list(kwargs.values()):
+            if isinstance(a, str) and a in ("cpu", "gpu", "tpu"):
+                continue  # single-process: residency managed by shardings
+            try:
+                out = out.astype(a)
+            except TypeError:
+                pass
+        return out
+
+    def pin_memory(self) -> "Tensor":
+        return self
+
+    # ------------------------------------------------------------------ misc
+    def dim(self):
+        return self.ndim
+
+    def rank(self):
+        return self.ndim
+
+    def __len__(self):
+        if self.ndim == 0:
+            raise TypeError("len() of a 0-d tensor")
+        return self._value.shape[0]
+
+    def __bool__(self):
+        return bool(self._value)
+
+    def __int__(self):
+        return int(self._value)
+
+    def __float__(self):
+        return float(self._value)
+
+    def __index__(self):
+        return int(self._value)
+
+    def __hash__(self):
+        return id(self)
+
+    def __repr__(self):
+        grad_str = "" if self.stop_gradient else ", stop_gradient=False"
+        try:
+            val = np.asarray(self._value)
+            return (
+                f"Tensor(shape={self.shape}, dtype={dtypes.dtype_name(self.dtype)}"
+                f"{grad_str},\n       {val})"
+            )
+        except Exception:
+            return f"Tensor(shape={self.shape}, dtype={self.dtype}{grad_str}, traced)"
+
+    def __iter__(self):
+        for i in range(len(self)):
+            yield self[i]
+
+    # __getitem__/__setitem__ and arithmetic operators are bound in
+    # paddle_tpu.ops._patch_tensor_methods().
+
+    # jax pytree integration: Tensors flatten to their payload so whole
+    # modules/optimizer states can cross the jit boundary.
+    def _tree_flatten(self):
+        return (self._value,), (self.stop_gradient,)
+
+    @classmethod
+    def _tree_unflatten(cls, aux, children):
+        t = cls._from_value(children[0])
+        t.stop_gradient = aux[0]
+        return t
+
+
+jax.tree_util.register_pytree_node(
+    Tensor,
+    lambda t: t._tree_flatten(),
+    lambda aux, children: Tensor._tree_unflatten(aux, children),
+)
+
+
+class _GradView(Tensor):
+    """Write-through view of a tensor's gradient.
+
+    Paddle's eager ``param.grad`` aliases the stored gradient: in-place ops
+    (``dist.all_reduce(p.grad)``, ``scaler.unscale_``) mutate the real grad.
+    This view reproduces that aliasing — ``_value`` reads/writes the owner's
+    ``_grad`` directly, so every access observes the current gradient.
+    """
+
+    @property
+    def _value(self):
+        return self._owner._grad
+
+    @_value.setter
+    def _value(self, v):
+        self._owner._grad = v
+
+    @classmethod
+    def _of(cls, owner: "Tensor") -> "_GradView":
+        g = cls.__new__(cls)
+        g._owner = owner  # must precede any _value access
+        g.stop_gradient = True
+        g._grad = None
+        g._node = None
+        g._retain_grads = False
+        g.name = ""
+        g.persistable = False
+        g.trainable = False
+        return g
+
+
+# flattening a grad view yields its current value; unflattening produces a
+# plain Tensor (the view identity is not meaningful across a jit boundary)
+jax.tree_util.register_pytree_node(
+    _GradView,
+    lambda t: ((t._value,), (t.stop_gradient,)),
+    lambda aux, children: Tensor._tree_unflatten(aux, children),
+)
+
+
+class Parameter(Tensor):
+    """Trainable tensor (python/paddle/base/framework.py EagerParamBase parity)."""
+
+    def __init__(self, data=None, dtype=None, trainable=True, name=""):
+        super().__init__(data, dtype=dtype, stop_gradient=not trainable)
+        self.trainable = trainable
+        self.persistable = True
+        self.name = name
+
+    @classmethod
+    def _from_value(cls, value):
+        t = super()._from_value.__func__(cls, value)
+        return t
+
+    def __repr__(self):
+        return "Parameter containing:\n" + super().__repr__()
+
+
+jax.tree_util.register_pytree_node(
+    Parameter,
+    lambda t: t._tree_flatten(),
+    lambda aux, children: Parameter._tree_unflatten(aux, children),
+)
+
+
+def to_tensor(data, dtype=None, place=None, stop_gradient=True) -> Tensor:
+    """paddle.to_tensor parity (python/paddle/tensor/creation.py)."""
+    return Tensor(data, dtype=dtype, place=place, stop_gradient=stop_gradient)

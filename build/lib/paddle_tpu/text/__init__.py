@@ -1,0 +1,163 @@
+"""paddle.text parity (reference: python/paddle/text/ — datasets + viterbi).
+
+Datasets parse the reference's own archive formats from local paths (no
+downloads offline); ViterbiDecoder is the real compute op (phi
+viterbi_decode kernel parity) as a lax.scan over the trellis."""
+
+from __future__ import annotations
+
+import os
+import tarfile
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.core.dispatch import apply
+from paddle_tpu.io.dataset import Dataset
+from paddle_tpu.tensor import Tensor
+
+
+class UCIHousing(Dataset):
+    """uci_housing.py parity: 13-feature regression from the local data
+    file (housing.data whitespace format)."""
+
+    def __init__(self, data_file=None, mode="train", download=True):
+        data_file = data_file or os.path.expanduser(
+            "~/.cache/paddle_tpu/housing.data")
+        if not os.path.exists(data_file):
+            raise FileNotFoundError(
+                f"{data_file} not found (downloads unavailable offline)")
+        raw = np.loadtxt(data_file).astype(np.float32)
+        x, y = raw[:, :-1], raw[:, -1:]
+        x = (x - x.mean(0)) / (x.std(0) + 1e-8)
+        n_train = int(len(x) * 0.8)
+        if mode == "train":
+            self.x, self.y = x[:n_train], y[:n_train]
+        else:
+            self.x, self.y = x[n_train:], y[n_train:]
+
+    def __getitem__(self, idx):
+        return self.x[idx], self.y[idx]
+
+    def __len__(self):
+        return len(self.x)
+
+
+class Imdb(Dataset):
+    """imdb.py parity: sentiment classification from the local aclImdb
+    tarball; tokenization is whitespace + frequency vocab (cutoff)."""
+
+    def __init__(self, data_file=None, mode="train", cutoff=150, download=True):
+        data_file = data_file or os.path.expanduser(
+            "~/.cache/paddle_tpu/aclImdb_v1.tar.gz")
+        if not os.path.exists(data_file):
+            raise FileNotFoundError(
+                f"{data_file} not found (downloads unavailable offline)")
+        self.docs, self.labels = [], []
+        # the vocabulary always comes from the TRAIN split so train/test
+        # share word ids (paddle imdb.py builds word_idx from train only)
+        freq = {}
+        texts = []
+        with tarfile.open(data_file, "r:*") as tf:
+            for m in tf.getmembers():
+                parts = m.name.split("/")
+                if len(parts) < 4 or parts[2] not in ("pos", "neg") or \
+                        not m.name.endswith(".txt"):
+                    continue
+                is_train = parts[1] == "train"
+                is_mine = parts[1] == mode
+                if not (is_train or is_mine):
+                    continue
+                words = tf.extractfile(m).read().decode(
+                    "utf-8", "ignore").lower().split()
+                if is_train:
+                    for w in words:
+                        freq[w] = freq.get(w, 0) + 1
+                if is_mine:
+                    texts.append((words, 0 if parts[2] == "neg" else 1))
+        self.word_idx = {
+            w: i for i, (w, c) in enumerate(
+                sorted(freq.items(), key=lambda kv: -kv[1]))
+            if c >= cutoff
+        }
+        unk = len(self.word_idx)
+        for words, label in texts:
+            self.docs.append(np.asarray(
+                [self.word_idx.get(w, unk) for w in words], np.int64))
+            self.labels.append(label)
+
+    def __getitem__(self, idx):
+        return self.docs[idx], np.int64(self.labels[idx])
+
+    def __len__(self):
+        return len(self.docs)
+
+
+class ViterbiDecoder:
+    """paddle.text.ViterbiDecoder parity: CRF decode over emissions with a
+    transition matrix; returns (scores, best paths)."""
+
+    def __init__(self, transitions, include_bos_eos_tag=True, name=None):
+        self.transitions = transitions
+        self.include_bos_eos_tag = include_bos_eos_tag
+
+    def __call__(self, potentials, lengths):
+        return viterbi_decode(potentials, self.transitions, lengths,
+                              self.include_bos_eos_tag)
+
+
+def viterbi_decode(potentials, transition_params, lengths,
+                   include_bos_eos_tag=True, name=None):
+    """Trellis max-sum via lax.scan (phi viterbi_decode kernel parity).
+
+    potentials [B, T, N]; transition_params [N, N]; lengths [B].
+    Returns (scores [B], paths [B, T])."""
+
+    def f(emis, trans, lens):
+        B, T, N = emis.shape
+        lens = lens.astype(jnp.int32)
+        ident = jnp.broadcast_to(jnp.arange(N)[None, :], (B, N))
+        if include_bos_eos_tag:
+            # paddle convention: the last two tags are start/stop; the start
+            # row seeds position 0, the stop column closes each sequence
+            alpha0 = emis[:, 0] + trans[-2][None, :]
+        else:
+            alpha0 = emis[:, 0]
+
+        def step(carry, xt):
+            alpha, = carry
+            x, t = xt
+            scores = alpha[:, :, None] + trans[None, :, :] + x[:, None, :]
+            best_prev = jnp.argmax(scores, axis=1)  # [B, N]
+            alpha_new = jnp.max(scores, axis=1)
+            valid = (t < lens)[:, None]  # freeze past each sequence's end
+            alpha_new = jnp.where(valid, alpha_new, alpha)
+            best_prev = jnp.where(valid, best_prev, ident)
+            return (alpha_new,), best_prev
+
+        ts = jnp.arange(1, T)
+        (alpha,), backptrs = jax.lax.scan(
+            step, (alpha0,), (jnp.swapaxes(emis[:, 1:], 0, 1), ts))
+        if include_bos_eos_tag:
+            alpha = alpha + trans[:, -1][None, :]
+        scores = jnp.max(alpha, axis=-1)
+        last = jnp.argmax(alpha, axis=-1)  # [B]
+
+        def backtrack(carry, bp):
+            tag = carry
+            prev = jnp.take_along_axis(bp, tag[:, None], axis=1)[:, 0]
+            return prev, tag
+
+        # scan emits the tag at each position T-1..1 (the carry before each
+        # hop); the final carry is the tag at position 0. Frozen (padding)
+        # steps carry identity backpointers so the real suffix is preserved.
+        first, path_rev = jax.lax.scan(backtrack, last, backptrs[::-1])
+        paths = jnp.concatenate(
+            [first[:, None], path_rev[::-1].T], axis=1)  # [B, T]
+        # zero out positions past each sequence's length
+        pos = jnp.arange(T)[None, :]
+        paths = jnp.where(pos < lens[:, None], paths, 0)
+        return scores, paths.astype(jnp.int64)
+
+    return apply("viterbi_decode", f, potentials, transition_params, lengths)

@@ -1,0 +1,3 @@
+"""paddle.utils parity."""
+
+from paddle_tpu.utils import cpp_extension  # noqa: F401

@@ -1,0 +1,15 @@
+"""paddle.vision parity (reference: python/paddle/vision/)."""
+
+from paddle_tpu.vision import datasets  # noqa: F401
+from paddle_tpu.vision import models  # noqa: F401
+from paddle_tpu.vision import transforms  # noqa: F401
+from paddle_tpu.vision import ops  # noqa: F401
+
+
+def set_image_backend(backend):
+    if backend not in ("pil", "cv2", "tensor", "numpy"):
+        raise ValueError(f"unknown image backend {backend}")
+
+
+def get_image_backend():
+    return "numpy"

@@ -1,0 +1,161 @@
+"""Datasets (parity: python/paddle/vision/datasets/ — MNIST, FashionMNIST,
+Cifar10/100). Downloads are unavailable in this offline environment: datasets
+read already-present files (same formats the reference downloads), and
+``FakeData`` provides a deterministic synthetic set for tests/benchmarks."""
+
+from __future__ import annotations
+
+import gzip
+import os
+import pickle
+import struct
+import tarfile
+
+import numpy as np
+
+from paddle_tpu.io.dataset import Dataset
+
+
+class FakeData(Dataset):
+    """Deterministic synthetic image classification data (test vehicle; the
+    reference tests similarly fabricate numpy batches)."""
+
+    def __init__(self, num_samples=256, image_shape=(1, 28, 28), num_classes=10,
+                 transform=None, seed=0):
+        self.num_samples = num_samples
+        self.image_shape = tuple(image_shape)
+        self.num_classes = num_classes
+        self.transform = transform
+        rng = np.random.default_rng(seed)
+        self._images = rng.standard_normal(
+            (num_samples,) + self.image_shape).astype(np.float32)
+        self._labels = rng.integers(
+            0, num_classes, (num_samples, 1)).astype(np.int64)
+
+    def __getitem__(self, idx):
+        img = self._images[idx]
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, self._labels[idx]
+
+    def __len__(self):
+        return self.num_samples
+
+
+class MNIST(Dataset):
+    """MNIST from local IDX files (vision/datasets/mnist.py parity).
+
+    Pass ``image_path``/``label_path`` pointing at (optionally gzipped)
+    idx3/idx1 files."""
+
+    NAME = "mnist"
+
+    def __init__(self, image_path=None, label_path=None, mode="train",
+                 transform=None, download=True, backend=None, root=None):
+        self.mode = mode.lower()
+        self.transform = transform
+        root = root or os.path.expanduser("~/.cache/paddle_tpu/" + self.NAME)
+        tag = "train" if self.mode == "train" else "t10k"
+        image_path = image_path or os.path.join(root, f"{tag}-images-idx3-ubyte.gz")
+        label_path = label_path or os.path.join(root, f"{tag}-labels-idx1-ubyte.gz")
+        if not os.path.exists(image_path):
+            raise FileNotFoundError(
+                f"{image_path} not found; downloads are unavailable offline — "
+                "place the idx files there or use vision.datasets.FakeData"
+            )
+        self.images = self._read_images(image_path)
+        self.labels = self._read_labels(label_path)
+
+    @staticmethod
+    def _open(path):
+        return gzip.open(path, "rb") if path.endswith(".gz") else open(path, "rb")
+
+    def _read_images(self, path):
+        with self._open(path) as f:
+            magic, n, rows, cols = struct.unpack(">IIII", f.read(16))
+            assert magic == 2051, f"bad idx3 magic {magic}"
+            data = np.frombuffer(f.read(n * rows * cols), dtype=np.uint8)
+        return data.reshape(n, rows, cols)
+
+    def _read_labels(self, path):
+        with self._open(path) as f:
+            magic, n = struct.unpack(">II", f.read(8))
+            assert magic == 2049, f"bad idx1 magic {magic}"
+            return np.frombuffer(f.read(n), dtype=np.uint8).astype(np.int64)
+
+    def __getitem__(self, idx):
+        img = self.images[idx][:, :, None]  # HWC
+        if self.transform is not None:
+            img = self.transform(img)
+        else:
+            img = img.astype(np.float32)
+        return img, np.array([self.labels[idx]], dtype=np.int64)
+
+    def __len__(self):
+        return len(self.labels)
+
+
+class FashionMNIST(MNIST):
+    NAME = "fashion-mnist"
+
+
+class Cifar10(Dataset):
+    """CIFAR-10 from a local python-version tarball (vision/datasets/cifar.py)."""
+
+    _n_fine = 10
+
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 download=True, backend=None):
+        self.mode = mode.lower()
+        self.transform = transform
+        data_file = data_file or os.path.expanduser(
+            "~/.cache/paddle_tpu/cifar-10-python.tar.gz")
+        if not os.path.exists(data_file):
+            raise FileNotFoundError(
+                f"{data_file} not found; downloads unavailable offline — "
+                "place the tarball there or use vision.datasets.FakeData"
+            )
+        self.data, self.labels = self._load(data_file)
+
+    def _batch_names(self):
+        if self.mode == "train":
+            return [f"data_batch_{i}" for i in range(1, 6)]
+        return ["test_batch"]
+
+    def _label_key(self):
+        return b"labels"
+
+    def _load(self, path):
+        images, labels = [], []
+        names = self._batch_names()
+        with tarfile.open(path, "r:*") as tf:
+            for m in tf.getmembers():
+                base = os.path.basename(m.name)
+                if base in names:
+                    d = pickle.load(tf.extractfile(m), encoding="bytes")
+                    images.append(d[b"data"])
+                    labels.extend(d[self._label_key()])
+        data = np.concatenate(images).reshape(-1, 3, 32, 32)
+        data = np.transpose(data, (0, 2, 3, 1))  # HWC
+        return data, np.asarray(labels, dtype=np.int64)
+
+    def __getitem__(self, idx):
+        img = self.data[idx]
+        if self.transform is not None:
+            img = self.transform(img)
+        else:
+            img = img.astype(np.float32)
+        return img, np.array([self.labels[idx]], dtype=np.int64)
+
+    def __len__(self):
+        return len(self.labels)
+
+
+class Cifar100(Cifar10):
+    _n_fine = 100
+
+    def _batch_names(self):
+        return ["train"] if self.mode == "train" else ["test"]
+
+    def _label_key(self):
+        return b"fine_labels"

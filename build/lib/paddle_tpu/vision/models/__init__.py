@@ -1,0 +1,46 @@
+"""Model zoo (parity: python/paddle/vision/models/__init__.py)."""
+
+from paddle_tpu.vision.models.lenet import LeNet  # noqa: F401
+from paddle_tpu.vision.models.alexnet import AlexNet, alexnet  # noqa: F401
+from paddle_tpu.vision.models.resnet import (  # noqa: F401
+    ResNet,
+    resnet18,
+    resnet34,
+    resnet50,
+    resnet101,
+    resnet152,
+    wide_resnet50_2,
+    wide_resnet101_2,
+)
+from paddle_tpu.vision.models.vgg import (  # noqa: F401
+    VGG,
+    vgg11,
+    vgg13,
+    vgg16,
+    vgg19,
+)
+from paddle_tpu.vision.models.mobilenet import (  # noqa: F401
+    MobileNetV1,
+    MobileNetV2,
+    mobilenet_v1,
+    mobilenet_v2,
+)
+from paddle_tpu.vision.models.densenet import (  # noqa: F401
+    DenseNet,
+    densenet121,
+    densenet161,
+    densenet169,
+    densenet201,
+)
+from paddle_tpu.vision.models.small_nets import (  # noqa: F401
+    GoogLeNet,
+    ShuffleNetV2,
+    SqueezeNet,
+    googlenet,
+    shufflenet_v2_x0_5,
+    shufflenet_v2_x1_0,
+    shufflenet_v2_x1_5,
+    shufflenet_v2_x2_0,
+    squeezenet1_0,
+    squeezenet1_1,
+)

@@ -1,0 +1,266 @@
+"""SqueezeNet, ShuffleNetV2, GoogLeNet (parity:
+python/paddle/vision/models/{squeezenet,shufflenetv2,googlenet}.py)."""
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+
+
+# ------------------------------------------------------------- SqueezeNet
+class _Fire(nn.Layer):
+    def __init__(self, in_c, squeeze, e1, e3):
+        super().__init__()
+        self.squeeze = nn.Conv2D(in_c, squeeze, 1)
+        self.relu = nn.ReLU()
+        self.expand1 = nn.Conv2D(squeeze, e1, 1)
+        self.expand3 = nn.Conv2D(squeeze, e3, 3, padding=1)
+
+    def forward(self, x):
+        s = self.relu(self.squeeze(x))
+        return paddle.concat(
+            [self.relu(self.expand1(s)), self.relu(self.expand3(s))], axis=1)
+
+
+class SqueezeNet(nn.Layer):
+    def __init__(self, version="1.1", num_classes=1000, with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        if version == "1.0":
+            self.features = nn.Sequential(
+                nn.Conv2D(3, 96, 7, stride=2), nn.ReLU(),
+                nn.MaxPool2D(3, stride=2),
+                _Fire(96, 16, 64, 64), _Fire(128, 16, 64, 64),
+                _Fire(128, 32, 128, 128), nn.MaxPool2D(3, stride=2),
+                _Fire(256, 32, 128, 128), _Fire(256, 48, 192, 192),
+                _Fire(384, 48, 192, 192), _Fire(384, 64, 256, 256),
+                nn.MaxPool2D(3, stride=2), _Fire(512, 64, 256, 256),
+            )
+        else:
+            self.features = nn.Sequential(
+                nn.Conv2D(3, 64, 3, stride=2), nn.ReLU(),
+                nn.MaxPool2D(3, stride=2),
+                _Fire(64, 16, 64, 64), _Fire(128, 16, 64, 64),
+                nn.MaxPool2D(3, stride=2),
+                _Fire(128, 32, 128, 128), _Fire(256, 32, 128, 128),
+                nn.MaxPool2D(3, stride=2),
+                _Fire(256, 48, 192, 192), _Fire(384, 48, 192, 192),
+                _Fire(384, 64, 256, 256), _Fire(512, 64, 256, 256),
+            )
+        if num_classes > 0:
+            self.classifier = nn.Sequential(
+                nn.Dropout(0.5),
+                nn.Conv2D(512, num_classes, 1),
+                nn.ReLU(),
+            )
+        self.pool = nn.AdaptiveAvgPool2D((1, 1))
+
+    def forward(self, x):
+        x = self.features(x)
+        if self.num_classes > 0:
+            x = self.classifier(x)
+        if self.with_pool:
+            x = self.pool(x)
+            return nn.Flatten(1)(x)
+        return x
+
+
+def squeezenet1_0(pretrained=False, **kwargs):
+    if pretrained:
+        raise RuntimeError("pretrained weights unavailable offline")
+    return SqueezeNet("1.0", **kwargs)
+
+
+def squeezenet1_1(pretrained=False, **kwargs):
+    if pretrained:
+        raise RuntimeError("pretrained weights unavailable offline")
+    return SqueezeNet("1.1", **kwargs)
+
+
+# ----------------------------------------------------------- ShuffleNetV2
+def _channel_shuffle(x, groups):
+    b, c, h, w = x.shape
+    x = paddle.reshape(x, [b, groups, c // groups, h, w])
+    x = paddle.transpose(x, [0, 2, 1, 3, 4])
+    return paddle.reshape(x, [b, c, h, w])
+
+
+def _act_layer(act):
+    return nn.Swish() if act == "swish" else nn.ReLU()
+
+
+class _ShuffleUnit(nn.Layer):
+    def __init__(self, in_c, out_c, stride, act="relu"):
+        super().__init__()
+        self.stride = stride
+        branch_c = out_c // 2
+        if stride > 1:
+            self.branch1 = nn.Sequential(
+                nn.Conv2D(in_c, in_c, 3, stride=stride, padding=1,
+                          groups=in_c, bias_attr=False),
+                nn.BatchNorm2D(in_c),
+                nn.Conv2D(in_c, branch_c, 1, bias_attr=False),
+                nn.BatchNorm2D(branch_c), _act_layer(act),
+            )
+            b2_in = in_c
+        else:
+            self.branch1 = None
+            b2_in = in_c // 2
+        self.branch2 = nn.Sequential(
+            nn.Conv2D(b2_in, branch_c, 1, bias_attr=False),
+            nn.BatchNorm2D(branch_c), _act_layer(act),
+            nn.Conv2D(branch_c, branch_c, 3, stride=stride, padding=1,
+                      groups=branch_c, bias_attr=False),
+            nn.BatchNorm2D(branch_c),
+            nn.Conv2D(branch_c, branch_c, 1, bias_attr=False),
+            nn.BatchNorm2D(branch_c), _act_layer(act),
+        )
+
+    def forward(self, x):
+        if self.stride == 1:
+            half = x.shape[1] // 2
+            x1, x2 = x[:, :half], x[:, half:]
+            out = paddle.concat([x1, self.branch2(x2)], axis=1)
+        else:
+            out = paddle.concat([self.branch1(x), self.branch2(x)], axis=1)
+        return _channel_shuffle(out, 2)
+
+
+class ShuffleNetV2(nn.Layer):
+    _stage_out = {
+        0.5: [24, 48, 96, 192, 1024],
+        1.0: [24, 116, 232, 464, 1024],
+        1.5: [24, 176, 352, 704, 1024],
+        2.0: [24, 244, 488, 976, 2048],
+    }
+
+    def __init__(self, scale=1.0, act="relu", num_classes=1000, with_pool=True):
+        super().__init__()
+        chans = self._stage_out[scale]
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        self.stem = nn.Sequential(
+            nn.Conv2D(3, chans[0], 3, stride=2, padding=1, bias_attr=False),
+            nn.BatchNorm2D(chans[0]), _act_layer(act),
+            nn.MaxPool2D(3, stride=2, padding=1),
+        )
+        stages = []
+        in_c = chans[0]
+        for i, repeats in enumerate((4, 8, 4)):
+            out_c = chans[i + 1]
+            stages.append(_ShuffleUnit(in_c, out_c, 2, act))
+            for _ in range(repeats - 1):
+                stages.append(_ShuffleUnit(out_c, out_c, 1, act))
+            in_c = out_c
+        self.stages = nn.Sequential(*stages)
+        self.conv_last = nn.Sequential(
+            nn.Conv2D(in_c, chans[-1], 1, bias_attr=False),
+            nn.BatchNorm2D(chans[-1]), _act_layer(act),
+        )
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D((1, 1))
+        if num_classes > 0:
+            self.fc = nn.Linear(chans[-1], num_classes)
+
+    def forward(self, x):
+        x = self.conv_last(self.stages(self.stem(x)))
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = nn.Flatten(1)(x)
+            x = self.fc(x)
+        return x
+
+
+def shufflenet_v2_x0_5(pretrained=False, **kwargs):
+    if pretrained:
+        raise RuntimeError("pretrained weights unavailable offline")
+    return ShuffleNetV2(scale=0.5, **kwargs)
+
+
+def shufflenet_v2_x1_0(pretrained=False, **kwargs):
+    if pretrained:
+        raise RuntimeError("pretrained weights unavailable offline")
+    return ShuffleNetV2(scale=1.0, **kwargs)
+
+
+def shufflenet_v2_x1_5(pretrained=False, **kwargs):
+    if pretrained:
+        raise RuntimeError("pretrained weights unavailable offline")
+    return ShuffleNetV2(scale=1.5, **kwargs)
+
+
+def shufflenet_v2_x2_0(pretrained=False, **kwargs):
+    if pretrained:
+        raise RuntimeError("pretrained weights unavailable offline")
+    return ShuffleNetV2(scale=2.0, **kwargs)
+
+
+# -------------------------------------------------------------- GoogLeNet
+class _Inception(nn.Layer):
+    def __init__(self, in_c, c1, c3r, c3, c5r, c5, proj):
+        super().__init__()
+        self.b1 = nn.Sequential(nn.Conv2D(in_c, c1, 1), nn.ReLU())
+        self.b2 = nn.Sequential(
+            nn.Conv2D(in_c, c3r, 1), nn.ReLU(),
+            nn.Conv2D(c3r, c3, 3, padding=1), nn.ReLU())
+        self.b3 = nn.Sequential(
+            nn.Conv2D(in_c, c5r, 1), nn.ReLU(),
+            nn.Conv2D(c5r, c5, 5, padding=2), nn.ReLU())
+        self.b4 = nn.Sequential(
+            nn.MaxPool2D(3, stride=1, padding=1),
+            nn.Conv2D(in_c, proj, 1), nn.ReLU())
+
+    def forward(self, x):
+        return paddle.concat(
+            [self.b1(x), self.b2(x), self.b3(x), self.b4(x)], axis=1)
+
+
+class GoogLeNet(nn.Layer):
+    def __init__(self, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        self.stem = nn.Sequential(
+            nn.Conv2D(3, 64, 7, stride=2, padding=3), nn.ReLU(),
+            nn.MaxPool2D(3, stride=2, padding=1),
+            nn.Conv2D(64, 64, 1), nn.ReLU(),
+            nn.Conv2D(64, 192, 3, padding=1), nn.ReLU(),
+            nn.MaxPool2D(3, stride=2, padding=1),
+        )
+        self.inc3 = nn.Sequential(
+            _Inception(192, 64, 96, 128, 16, 32, 32),
+            _Inception(256, 128, 128, 192, 32, 96, 64),
+            nn.MaxPool2D(3, stride=2, padding=1),
+        )
+        self.inc4 = nn.Sequential(
+            _Inception(480, 192, 96, 208, 16, 48, 64),
+            _Inception(512, 160, 112, 224, 24, 64, 64),
+            _Inception(512, 128, 128, 256, 24, 64, 64),
+            _Inception(512, 112, 144, 288, 32, 64, 64),
+            _Inception(528, 256, 160, 320, 32, 128, 128),
+            nn.MaxPool2D(3, stride=2, padding=1),
+        )
+        self.inc5 = nn.Sequential(
+            _Inception(832, 256, 160, 320, 32, 128, 128),
+            _Inception(832, 384, 192, 384, 48, 128, 128),
+        )
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D((1, 1))
+        if num_classes > 0:
+            self.dropout = nn.Dropout(0.2)
+            self.fc = nn.Linear(1024, num_classes)
+
+    def forward(self, x):
+        x = self.inc5(self.inc4(self.inc3(self.stem(x))))
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = nn.Flatten(1)(x)
+            x = self.fc(self.dropout(x))
+        return x
+
+
+def googlenet(pretrained=False, **kwargs):
+    if pretrained:
+        raise RuntimeError("pretrained weights unavailable offline")
+    return GoogLeNet(**kwargs)

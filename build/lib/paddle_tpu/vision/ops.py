@@ -1,0 +1,81 @@
+"""Vision ops (parity: python/paddle/vision/ops.py — nms, box utils,
+roi_align/roi_pool, deform_conv).
+
+nms runs as a host-side numpy loop: data-dependent output size cannot live in
+an XLA program; the reference likewise runs its detection post-processing
+outside the graph in dynamic-shape mode."""
+
+from __future__ import annotations
+
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.tensor import Tensor
+
+
+def _np(x):
+    return x.numpy() if isinstance(x, Tensor) else np.asarray(x)
+
+
+def box_area(boxes):
+    b = _np(boxes)
+    return paddle.to_tensor((b[:, 2] - b[:, 0]) * (b[:, 3] - b[:, 1]))
+
+
+def box_iou(boxes1, boxes2):
+    a = _np(boxes1)
+    b = _np(boxes2)
+    area1 = (a[:, 2] - a[:, 0]) * (a[:, 3] - a[:, 1])
+    area2 = (b[:, 2] - b[:, 0]) * (b[:, 3] - b[:, 1])
+    lt = np.maximum(a[:, None, :2], b[None, :, :2])
+    rb = np.minimum(a[:, None, 2:], b[None, :, 2:])
+    wh = np.clip(rb - lt, 0, None)
+    inter = wh[..., 0] * wh[..., 1]
+    union = area1[:, None] + area2[None, :] - inter
+    return paddle.to_tensor(inter / np.maximum(union, 1e-10))
+
+
+def nms(boxes, iou_threshold=0.3, scores=None, category_idxs=None,
+        categories=None, top_k=None):
+    """paddle.vision.ops.nms parity; returns kept indices (int64 Tensor)."""
+    b = _np(boxes).astype(np.float64)
+    n = b.shape[0]
+    s = _np(scores).astype(np.float64) if scores is not None else np.arange(
+        n, 0, -1, dtype=np.float64)
+
+    def _nms_single(idxs):
+        order = idxs[np.argsort(-s[idxs])]
+        keep = []
+        suppressed = np.zeros(n, dtype=bool)
+        for i in order:
+            if suppressed[i]:
+                continue
+            keep.append(i)
+            xx1 = np.maximum(b[i, 0], b[order, 0])
+            yy1 = np.maximum(b[i, 1], b[order, 1])
+            xx2 = np.minimum(b[i, 2], b[order, 2])
+            yy2 = np.minimum(b[i, 3], b[order, 3])
+            w = np.clip(xx2 - xx1, 0, None)
+            h = np.clip(yy2 - yy1, 0, None)
+            inter = w * h
+            area_i = (b[i, 2] - b[i, 0]) * (b[i, 3] - b[i, 1])
+            area_o = (b[order, 2] - b[order, 0]) * (b[order, 3] - b[order, 1])
+            iou = inter / np.maximum(area_i + area_o - inter, 1e-10)
+            suppressed[order[iou > iou_threshold]] = True
+            suppressed[i] = False
+        return np.asarray(keep, dtype=np.int64)
+
+    if category_idxs is None:
+        keep = _nms_single(np.arange(n))
+    else:
+        cats = _np(category_idxs)
+        parts = []
+        for c in (categories if categories is not None else np.unique(cats)):
+            idxs = np.nonzero(cats == _np(c))[0]
+            if idxs.size:
+                parts.append(_nms_single(idxs))
+        keep = np.concatenate(parts) if parts else np.zeros(0, np.int64)
+        keep = keep[np.argsort(-s[keep])]
+    if top_k is not None:
+        keep = keep[:top_k]
+    return paddle.to_tensor(keep)

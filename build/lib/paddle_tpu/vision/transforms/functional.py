@@ -1,0 +1,157 @@
+"""Functional image transforms (parity: python/paddle/vision/transforms/
+functional.py). Arrays are numpy HWC uint8/float; ToTensor produces CHW
+float32 — preprocessing stays on host (feeds the device via DataLoader),
+exactly as the reference keeps PIL/cv2 work off-accelerator."""
+
+from __future__ import annotations
+
+import numbers
+
+import numpy as np
+
+
+def _as_hwc(img):
+    img = np.asarray(img)
+    if img.ndim == 2:
+        img = img[:, :, None]
+    return img
+
+
+def to_tensor(img, data_format="CHW"):
+    """uint8 HWC [0,255] -> float32 CHW [0,1] (functional.to_tensor)."""
+    img = _as_hwc(img)
+    if img.dtype == np.uint8:
+        img = img.astype(np.float32) / 255.0
+    else:
+        img = img.astype(np.float32)
+    if data_format == "CHW":
+        img = np.transpose(img, (2, 0, 1))
+    return img
+
+
+def normalize(img, mean, std, data_format="CHW", to_rgb=False):
+    img = np.asarray(img, dtype=np.float32)
+    mean = np.asarray(mean, dtype=np.float32)
+    std = np.asarray(std, dtype=np.float32)
+    if data_format == "CHW":
+        mean = mean.reshape(-1, 1, 1)
+        std = std.reshape(-1, 1, 1)
+    return (img - mean) / std
+
+
+def _interp_resize(img, h, w):
+    """Bilinear resize without external deps."""
+    img = _as_hwc(img).astype(np.float32)
+    H, W, C = img.shape
+    if (H, W) == (h, w):
+        return img
+    ys = (np.arange(h) + 0.5) * H / h - 0.5
+    xs = (np.arange(w) + 0.5) * W / w - 0.5
+    y0 = np.clip(np.floor(ys).astype(int), 0, H - 1)
+    y1 = np.clip(y0 + 1, 0, H - 1)
+    x0 = np.clip(np.floor(xs).astype(int), 0, W - 1)
+    x1 = np.clip(x0 + 1, 0, W - 1)
+    wy = np.clip(ys - y0, 0, 1)[:, None, None]
+    wx = np.clip(xs - x0, 0, 1)[None, :, None]
+    top = img[y0][:, x0] * (1 - wx) + img[y0][:, x1] * wx
+    bot = img[y1][:, x0] * (1 - wx) + img[y1][:, x1] * wx
+    return top * (1 - wy) + bot * wy
+
+
+def resize(img, size, interpolation="bilinear"):
+    img = _as_hwc(img)
+    H, W, _ = img.shape
+    if isinstance(size, int):
+        # short side to `size`, keep aspect
+        if H < W:
+            h, w = size, int(round(W * size / H))
+        else:
+            h, w = int(round(H * size / W)), size
+    else:
+        h, w = size
+    out = _interp_resize(img, h, w)
+    if img.dtype == np.uint8:
+        out = np.clip(out, 0, 255).astype(np.uint8)
+    return out
+
+
+def crop(img, top, left, height, width):
+    img = _as_hwc(img)
+    return img[top:top + height, left:left + width]
+
+
+def center_crop(img, output_size):
+    img = _as_hwc(img)
+    if isinstance(output_size, numbers.Number):
+        output_size = (int(output_size), int(output_size))
+    H, W, _ = img.shape
+    th, tw = output_size
+    top = max((H - th) // 2, 0)
+    left = max((W - tw) // 2, 0)
+    return crop(img, top, left, th, tw)
+
+
+def hflip(img):
+    return _as_hwc(img)[:, ::-1]
+
+
+def vflip(img):
+    return _as_hwc(img)[::-1]
+
+
+def pad(img, padding, fill=0, padding_mode="constant"):
+    img = _as_hwc(img)
+    if isinstance(padding, numbers.Number):
+        pl = pr = pt = pb = int(padding)
+    elif len(padding) == 2:
+        pl, pt = padding
+        pr, pb = padding
+    else:
+        pl, pt, pr, pb = padding
+    if padding_mode == "constant":
+        return np.pad(img, ((pt, pb), (pl, pr), (0, 0)), constant_values=fill)
+    return np.pad(img, ((pt, pb), (pl, pr), (0, 0)), mode=padding_mode)
+
+
+def adjust_brightness(img, factor):
+    img = _as_hwc(img)
+    out = img.astype(np.float32) * factor
+    if img.dtype == np.uint8:
+        return np.clip(out, 0, 255).astype(np.uint8)
+    return out
+
+
+def adjust_contrast(img, factor):
+    img = _as_hwc(img)
+    mean = img.astype(np.float32).mean()
+    out = (img.astype(np.float32) - mean) * factor + mean
+    if img.dtype == np.uint8:
+        return np.clip(out, 0, 255).astype(np.uint8)
+    return out
+
+
+def to_grayscale(img, num_output_channels=1):
+    img = _as_hwc(img).astype(np.float32)
+    if img.shape[2] == 1:
+        gray = img
+    else:
+        gray = (0.299 * img[:, :, 0] + 0.587 * img[:, :, 1]
+                + 0.114 * img[:, :, 2])[:, :, None]
+    return np.repeat(gray, num_output_channels, axis=2)
+
+
+def rotate(img, angle, interpolation="nearest", expand=False, center=None, fill=0):
+    """Nearest-neighbor rotation (degrees counter-clockwise)."""
+    img = _as_hwc(img)
+    H, W, C = img.shape
+    theta = np.deg2rad(angle)
+    cy, cx = ((H - 1) / 2.0, (W - 1) / 2.0) if center is None else center
+    yy, xx = np.mgrid[0:H, 0:W]
+    ys = (yy - cy) * np.cos(theta) - (xx - cx) * np.sin(theta) + cy
+    xs = (yy - cy) * np.sin(theta) + (xx - cx) * np.cos(theta) + cx
+    yi = np.round(ys).astype(int)
+    xi = np.round(xs).astype(int)
+    valid = (yi >= 0) & (yi < H) & (xi >= 0) & (xi < W)
+    out = np.full_like(img, fill)
+    out[valid] = img[yi[valid], xi[valid]]
+    return out

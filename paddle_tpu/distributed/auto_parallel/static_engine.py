@@ -24,6 +24,17 @@ On XLA the analogous pipeline is:
 3. **partitioner/executor** = ONE jitted train step whose inputs carry the
    chosen shardings; XLA emits the collectives the reference's reshard pass
    would have inserted.
+4. **pipeline route** (r3): ``pp_axis`` + a fleet PipelineLayer model runs
+   through the heterogeneous schedule engine (hybrid dp x pp in one
+   program; stage-exclusive params sharded over pp). TP placements come
+   from the cost model (``choose_tp_placements``) on the GSPMD path;
+   TP *inside* the pp schedule engine is the fleet tier's ``param_specs``
+   route (tests/test_pipeline_schedules.py) — the Engine does not yet
+   compose all three axes in a single program.
+5. **cross-mesh reshard** = ``dist.reshard`` moves a tensor between
+   ProcessMeshes (disjoint device sets, different topologies) via
+   device_put — the reference's reshard_funcs library collapses into the
+   runtime's transfer engine (tests/test_auto_parallel_engine.py).
 """
 
 from __future__ import annotations
@@ -113,17 +124,67 @@ def estimate_cost(model: Layer, mesh: ProcessMesh, batch_axis: str,
 
 
 def choose_batch_axis(model: Layer, mesh: ProcessMesh, batch_size: int,
-                      seq_len: int = 1) -> str:
+                      seq_len: int = 1, exclude=()) -> str:
     """Pick the mesh axis that carries the batch: lowest first-order cost
-    among axes that divide the batch (the cost model's only discrete choice
-    once param placements are fixed)."""
+    among axes that divide the batch (axes in ``exclude`` — pp/tp — never
+    carry data)."""
     cands = [name for name in mesh.dim_names
-             if batch_size % mesh.get_dim_size(name) == 0]
+             if name not in exclude
+             and batch_size % mesh.get_dim_size(name) == 0]
     if not cands:
-        return mesh.dim_names[0]
+        avail = [n for n in mesh.dim_names if n not in exclude]
+        return avail[0] if avail else mesh.dim_names[0]
     costs = {name: estimate_cost(model, mesh, name, batch_size, seq_len).time
              for name in cands}
     return min(costs, key=costs.get)
+
+
+def choose_tp_placements(model: Layer, mesh: ProcessMesh, tp_axis: str,
+                         batch_size: int, seq_len: int = 1,
+                         min_weight_bytes: int = 1 << 20):
+    """Cost-model TP assignment (reference: static/cost/ estimators feeding
+    the partitioner's weight-sharding decision): shard a large 2-D weight
+    over ``tp_axis`` when the per-step activation collective it induces
+    costs less than the HBM/compute saved by holding 1/tp of the weight.
+
+    First-order rule per weight W[d_in, d_out] at tp degree t:
+    - sharding saves (t-1)/t of the weight's memory traffic AND removes it
+      from the dp grad all-reduce;
+    - it adds one all-reduce (or all-gather pair) of the layer's activation,
+      ~2 * batch * seq * d_out * 4 bytes per step over ICI.
+    Weights below ``min_weight_bytes`` never shard (collective latency
+    dominates). Returns {param_id: placements} for params that should
+    shard; callers merge into complete_annotations' output. The LAST dim is
+    sharded (column-parallel) — the megatron f/g orientation whose
+    activation collective sits after the pair, matching mp_layers.py.
+    """
+    t = mesh.get_dim_size(tp_axis)
+    if t <= 1:
+        return {}
+    out = {}
+    tokens = batch_size * seq_len
+    tp_dim = mesh.dim_names.index(tp_axis)
+    for p in model.parameters():
+        if len(p.shape) != 2:
+            continue
+        if getattr(p, "placements", None) is not None:
+            continue  # explicit shard_tensor annotations are kept, not overridden
+        n = int(np.prod(p.shape))
+        wbytes = 4.0 * n
+        if wbytes < min_weight_bytes:
+            continue
+        d_out = int(p.shape[-1])
+        if d_out % t != 0:
+            continue
+        # saved: weight traffic + dp grad allreduce share; added: activation
+        # allreduce over the tp group
+        saved = wbytes * (t - 1) / t * 3.0      # fwd read + bwd read + grad
+        added = 2.0 * 4.0 * tokens * d_out * (t - 1) / t
+        if saved > added:
+            pls = [Replicate() for _ in range(mesh.ndim)]
+            pls[tp_dim] = Shard(len(p.shape) - 1)
+            out[id(p)] = pls
+    return out
 
 
 # -------------------------------------------------------------------- Engine
@@ -135,7 +196,10 @@ class DistModel:
 
     def __init__(self, layer: Layer, loader, loss=None, optimizer=None,
                  strategy=None, mesh: Optional[ProcessMesh] = None,
-                 batch_axis: Optional[str] = None):
+                 batch_axis: Optional[str] = None,
+                 pp_axis: Optional[str] = None,
+                 tp_axis: Optional[str] = None,
+                 num_microbatches: Optional[int] = None):
         from paddle_tpu.jit.api import TrainStep
 
         self._layer = layer
@@ -145,10 +209,54 @@ class DistModel:
         self._mode = "train" if optimizer is not None else "predict"
         self._mesh = mesh or _infer_mesh(layer)
         self._engine_meta = {}
+        self._pp_axis = pp_axis
+        self._num_microbatches = num_microbatches
 
-        if self._mesh is not None:
-            # completion: every param gets full placements; materialize them
-            # as NamedShardings so GSPMD sees the boundary layout
+        from paddle_tpu.distributed.fleet.pipeline import PipelineLayer
+
+        self._is_pipeline = isinstance(layer, PipelineLayer)
+        if pp_axis is not None and not self._is_pipeline:
+            raise ValueError(
+                "pp_axis routes training through the pipeline schedule "
+                "engine and needs a fleet PipelineLayer model (stage "
+                "partition + shared-weight descs); wrap the layer list in "
+                "PipelineLayer(descs, num_stages=mesh[pp_axis])")
+        if self._is_pipeline:
+            if self._mesh is None:
+                raise ValueError(
+                    "a PipelineLayer DistModel needs a ProcessMesh with a "
+                    "pipeline axis")
+            if pp_axis is None:
+                # default like train_batch: a dim literally named "pp",
+                # else the one matching num_stages
+                if "pp" in self._mesh.dim_names:
+                    pp_axis = "pp"
+                else:
+                    fits = [a for a in self._mesh.dim_names
+                            if self._mesh.get_dim_size(a)
+                            == layer.num_stages]
+                    if not fits:
+                        raise ValueError(
+                            f"no mesh axis matches the PipelineLayer's "
+                            f"{layer.num_stages} stages; pass pp_axis=")
+                    pp_axis = fits[0]
+                self._pp_axis = pp_axis
+
+        if self._mesh is not None and not self._is_pipeline:
+            # completion order matters: (1) the cost model assigns large
+            # 2-D weights to the tp axis and WRITES the placements onto the
+            # params, so (2) complete_annotations and (3) the batch-axis
+            # costing both see them; then materialize as NamedShardings
+            sample = _peek_batch(loader)
+            if tp_axis is not None and sample is not None:
+                bsz = sample[0].shape[0]
+                seq = sample[0].shape[1] if sample[0].ndim > 1 else 1
+                tp_ann = choose_tp_placements(layer, self._mesh, tp_axis,
+                                              bsz, seq)
+                for p in layer.parameters():
+                    if id(p) in tp_ann:
+                        p.placements = tp_ann[id(p)]
+                        p.process_mesh = self._mesh
             ann = complete_annotations(layer, self._mesh)
             jm = self._mesh.jax_mesh()
             for p in layer.parameters():
@@ -158,25 +266,35 @@ class DistModel:
                     p._value, NamedSharding(jm, spec)))
             # cost-model choice of the data axis (only when not given, and
             # only from loaders that can be re-iterated — peeking a one-shot
-            # generator would eat its first batch)
+            # generator would eat its first batch); pp/tp axes never carry
+            # data, and non-dividing axes are filtered inside
             if batch_axis is None:
-                sample = _peek_batch(loader)
                 if sample is not None:
                     bsz = sample[0].shape[0]
                     seq = sample[0].shape[1] if sample[0].ndim > 1 else 1
-                    batch_axis = choose_batch_axis(layer, self._mesh, bsz,
-                                                   seq)
+                    batch_axis = choose_batch_axis(
+                        layer, self._mesh, bsz, seq,
+                        exclude=tuple(a for a in (pp_axis, tp_axis)
+                                      if a is not None))
                 else:
                     batch_axis = self._mesh.dim_names[0]
+        elif self._mesh is not None and batch_axis is None:
+            # pipeline route: the data axis is any axis not reserved for
+            # pipeline OR tensor parallelism
+            others = [a for a in self._mesh.dim_names
+                      if a not in (pp_axis, tp_axis)]
+            batch_axis = others[0] if others else None
         self._batch_axis = batch_axis
 
-        if optimizer is not None and loss is not None:
+        if optimizer is not None and loss is not None and not self._is_pipeline:
             def loss_fn(m, *batch):
                 *xs, y = batch
                 out = m(*xs)
                 return loss(out, y)
 
             self._step = TrainStep(layer, loss_fn, optimizer)
+        elif self._is_pipeline and optimizer is not None:
+            self._step = "pipeline"  # routed through train_batch
         else:
             self._step = None
 
@@ -212,6 +330,32 @@ class DistModel:
 
     def __call__(self, *batch):
         batch = [b if isinstance(b, Tensor) else Tensor(b) for b in batch]
+        if self._is_pipeline:
+            if self._mode == "train":
+                if self._step != "pipeline":
+                    raise RuntimeError(
+                        "pipeline DistModel needs an optimizer to train")
+                # pp route: the schedule engine owns sharding (params over
+                # the pp axis, microbatch rows over the dp axis); dp only
+                # engages when the per-microbatch rows divide over it
+                x, y = batch
+                M = (self._num_microbatches
+                     or self._mesh.get_dim_size(self._pp_axis))
+                dp_axis = self._batch_axis
+                if dp_axis is not None:
+                    dp = self._mesh.get_dim_size(dp_axis)
+                    if x.shape[0] % (M * dp) != 0:
+                        dp_axis = None  # fall back to pp-only, still correct
+                return self._layer.train_batch(
+                    (x, y), self._opt, mesh=self._mesh.jax_mesh(),
+                    num_microbatches=M, axis=self._pp_axis, dp_axis=dp_axis)
+            # eval: run the stage partition eagerly + the layer's loss;
+            # predict: plain forward
+            if self._mode == "eval" and len(batch) > 1 \
+                    and self._layer.loss_fn is not None:
+                out = self._layer.forward(batch[0])
+                return self._layer.loss_fn(out, batch[-1])
+            return self._layer.forward(batch[0])
         batch = [self._shard_batch(b) for b in batch]
         if self._mode == "train":
             if self._step is None:
@@ -226,10 +370,17 @@ class DistModel:
 
 def to_static(layer: Layer, loader=None, loss=None, optimizer=None,
               strategy=None, mesh: Optional[ProcessMesh] = None,
-              batch_axis: Optional[str] = None) -> DistModel:
-    """paddle.distributed.to_static parity (auto_parallel/api.py:2345)."""
+              batch_axis: Optional[str] = None, pp_axis: Optional[str] = None,
+              tp_axis: Optional[str] = None,
+              num_microbatches: Optional[int] = None) -> DistModel:
+    """paddle.distributed.to_static parity (auto_parallel/api.py:2345).
+
+    ``pp_axis`` routes a PipelineLayer model through the schedule engine
+    (hybrid dp x pp in one program); ``tp_axis`` lets the cost model shard
+    large 2-D weights over that axis (GSPMD inserts the collectives)."""
     return DistModel(layer, loader, loss, optimizer, strategy, mesh,
-                     batch_axis)
+                     batch_axis, pp_axis=pp_axis, tp_axis=tp_axis,
+                     num_microbatches=num_microbatches)
 
 
 class Engine:
@@ -237,13 +388,18 @@ class Engine:
     prepare -> fit/evaluate/predict over the compiled distributed step."""
 
     def __init__(self, model: Layer, loss=None, optimizer=None, metrics=None,
-                 strategy=None, mesh: Optional[ProcessMesh] = None):
+                 strategy=None, mesh: Optional[ProcessMesh] = None,
+                 pp_axis: Optional[str] = None, tp_axis: Optional[str] = None,
+                 num_microbatches: Optional[int] = None):
         self._model = model
         self._loss = loss
         self._opt = optimizer
         self._metrics = metrics or []
         self._strategy = strategy
         self._mesh = mesh
+        self._pp_axis = pp_axis
+        self._tp_axis = tp_axis
+        self._num_microbatches = num_microbatches
         self._dist_model: Optional[DistModel] = None
         self.history: List[float] = []
 
@@ -256,7 +412,9 @@ class Engine:
             self._dist_model = to_static(
                 self._model, loader, self._loss,
                 self._opt if mode == "train" else None,
-                self._strategy, self._mesh)
+                self._strategy, self._mesh,
+                pp_axis=self._pp_axis, tp_axis=self._tp_axis,
+                num_microbatches=self._num_microbatches)
         return self._dist_model
 
     def fit(self, train_data, epochs=1, steps_per_epoch=None, verbose=0,

@@ -1,1 +1,5 @@
 from paddle_tpu.framework import dtype, random  # noqa: F401
+from paddle_tpu.framework.string_tensor import (  # noqa: F401
+    StringTensor,
+    to_string_tensor,
+)

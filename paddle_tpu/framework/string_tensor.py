@@ -1,0 +1,125 @@
+"""StringTensor (parity: paddle/phi/core/string_tensor.h — the host-side
+string tensor type backing the faster-tokenizer op family, with the
+`strings_lower` / `strings_upper` kernels from
+paddle/phi/kernels/strings/).
+
+TPU-native stance: strings never touch the accelerator (no XLA dtype);
+the type is a HOST container with tensor shape semantics whose ops
+(lower/upper/encode) run on CPU — exactly the reference's design, where
+StringTensor lives on CPUPlace and feeds tokenizers whose int outputs then
+go to the device.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+class StringTensor:
+    """N-D tensor of python strings (host-resident)."""
+
+    def __init__(self, data, name: str = ""):
+        arr = np.asarray(data, dtype=object)
+        # normalize bytes -> str
+        flat = arr.ravel()
+        for i, v in enumerate(flat):
+            if isinstance(v, bytes):
+                flat[i] = v.decode("utf-8")
+            elif not isinstance(v, str):
+                flat[i] = str(v)
+        self._data = flat.reshape(arr.shape)
+        self.name = name
+
+    # ------------------------------------------------------------ metadata
+    @property
+    def shape(self):
+        return list(self._data.shape)
+
+    @property
+    def ndim(self):
+        return self._data.ndim
+
+    def numel(self):
+        return int(self._data.size)
+
+    @property
+    def dtype(self):
+        return "pstring"  # the reference's dtype name (phi::dtype::pstring)
+
+    @property
+    def place(self):
+        return "Place(cpu)"  # strings are host-only by design
+
+    # ------------------------------------------------------------ access
+    def numpy(self):
+        return self._data.copy()
+
+    def tolist(self):
+        return self._data.tolist()
+
+    def __getitem__(self, idx):
+        out = self._data[idx]
+        if isinstance(out, str):
+            return out
+        return StringTensor(out)
+
+    def __len__(self):
+        return len(self._data)
+
+    def __iter__(self):
+        for i in range(len(self._data)):
+            yield self[i]
+
+    def __eq__(self, other):
+        if isinstance(other, StringTensor):
+            other = other._data
+        return np.asarray(self._data == other)
+
+    def __repr__(self):
+        return (f"StringTensor(shape={self.shape}, "
+                f"data={self._data.tolist()!r})")
+
+    # ------------------------------------------------------------ kernels
+    def _map(self, fn):
+        flat = self._data.ravel()
+        out = np.asarray([fn(s) for s in flat], dtype=object)
+        t = StringTensor.__new__(StringTensor)
+        t._data = out.reshape(self._data.shape)
+        t.name = self.name
+        return t
+
+    def lower(self, use_utf8_encoding: bool = True):
+        """strings_lower kernel parity (utf-8 aware lowercasing)."""
+        return self._map(lambda s: s.lower())
+
+    def upper(self, use_utf8_encoding: bool = True):
+        return self._map(lambda s: s.upper())
+
+    def strip(self):
+        return self._map(lambda s: s.strip())
+
+    def byte_length(self, encoding: str = "utf-8"):
+        """Lengths in bytes as a device int32 tensor (the string->number
+        boundary where data re-enters the accelerator)."""
+        import jax.numpy as jnp
+
+        from paddle_tpu.tensor import Tensor
+
+        flat = [len(s.encode(encoding)) for s in self._data.ravel()]
+        return Tensor._from_value(
+            jnp.asarray(np.asarray(flat, np.int32).reshape(
+                self._data.shape)))
+
+
+def to_string_tensor(data, name: str = "") -> StringTensor:
+    """Constructor mirroring the reference's C++ API entry
+    (strings_api `to_string_tensor`)."""
+    return StringTensor(data, name)
+
+
+def strings_lower(x: StringTensor, use_utf8_encoding: bool = True):
+    return x.lower(use_utf8_encoding)
+
+
+def strings_upper(x: StringTensor, use_utf8_encoding: bool = True):
+    return x.upper(use_utf8_encoding)

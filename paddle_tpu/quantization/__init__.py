@@ -92,6 +92,99 @@ class AbsmaxObserver(BaseObserver):
         return x
 
 
+class HistObserver(BaseObserver):
+    """observer/hist.py parity: histogram calibration — the scale comes
+    from the value at a coverage percentile of the accumulated |x|
+    histogram instead of the raw max (outlier-robust PTQ)."""
+
+    def __init__(self, quant_bits=8, bins_count=2048, percent=0.999):
+        super().__init__(quant_bits)
+        self.bins = bins_count
+        self.percent = percent
+        self._hist = np.zeros(bins_count, np.float64)
+        self._hist_max = 1e-8
+
+    def forward(self, x):
+        v = np.abs(np.asarray(x.numpy())).ravel()
+        if v.size == 0:
+            return x
+        mx = float(v.max())
+        if mx > self._hist_max:
+            # re-bin the old histogram onto the wider range: old bin i's
+            # center value (i+0.5)/bins*old_max lands at new index
+            # (i+0.5)*old_max/new_max — already a bin index, no extra *bins
+            ratio = self._hist_max / mx
+            old = self._hist
+            self._hist = np.zeros(self.bins, np.float64)
+            idx = np.minimum(((np.arange(self.bins) + 0.5) * ratio)
+                             .astype(int), self.bins - 1)
+            np.add.at(self._hist, idx, old)
+            self._hist_max = mx
+        h, _ = np.histogram(v, bins=self.bins, range=(0.0, self._hist_max))
+        self._hist += h
+        total = self._hist.sum()
+        cdf = np.cumsum(self._hist) / total
+        k = int(np.searchsorted(cdf, self.percent))
+        thr = (k + 1) / self.bins * self._hist_max
+        self._scale = thr / (2 ** (self.quant_bits - 1) - 1) or 1e-8
+        return x
+
+
+class KLObserver(BaseObserver):
+    """observer/kl.py parity: KL-divergence threshold search (TensorRT-style
+    entropy calibration) over the accumulated |x| histogram."""
+
+    def __init__(self, quant_bits=8, bins_count=2048):
+        super().__init__(quant_bits)
+        self.bins = bins_count
+        self._hist = np.zeros(bins_count, np.float64)
+        self._hist_max = 1e-8
+
+    def forward(self, x):
+        v = np.abs(np.asarray(x.numpy())).ravel()
+        if v.size == 0:
+            return x
+        self._hist_max = max(self._hist_max, float(v.max()))
+        h, _ = np.histogram(v, bins=self.bins, range=(0.0, self._hist_max))
+        self._hist += h
+        self._scale = self._kl_threshold() / (
+            2 ** (self.quant_bits - 1) - 1) or 1e-8
+        return x
+
+    def _kl_threshold(self):
+        """Scan candidate clip points; pick the one minimizing
+        KL(P_ref || Q_quant) (the reference's calibration loop)."""
+        levels = 2 ** (self.quant_bits - 1)  # 128 for int8
+        hist = self._hist
+        best_kl, best_i = np.inf, self.bins
+        start = max(levels, self.bins // 16)
+        for i in range(start, self.bins + 1, max(1, self.bins // 128)):
+            p = hist[:i].copy()
+            p[-1] += hist[i:].sum()  # clip outliers into the last bin
+            if p.sum() == 0:
+                continue
+            # quantize the first i bins down to `levels` buckets
+            chunk = i / levels
+            edges = (np.arange(i) / chunk).astype(int)
+            q = np.zeros(levels)
+            np.add.at(q, edges, hist[:i])
+            counts = np.bincount(edges, minlength=levels).astype(np.float64)
+            # expand q back, spreading each bucket over its nonzero bins
+            nz = hist[:i] > 0
+            bucket_nz = np.zeros(levels)
+            np.add.at(bucket_nz, edges, nz.astype(np.float64))
+            expand = np.where(
+                nz, q[edges] / np.maximum(bucket_nz[edges], 1), 0.0)
+            pp = p / p.sum()
+            qq = expand / max(expand.sum(), 1e-12)
+            mask = pp > 0
+            kl = float(np.sum(pp[mask] * np.log(
+                pp[mask] / np.maximum(qq[mask], 1e-12))))
+            if kl < best_kl:
+                best_kl, best_i = kl, i
+        return best_i / self.bins * self._hist_max
+
+
 class FakeQuanterWithAbsMaxObserver(pnn.Layer):
     """quanters/abs_max.py parity: QAT fake-quant node with EMA abs-max."""
 

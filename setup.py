@@ -1,0 +1,7 @@
+"""Thin setup.py shim (metadata lives in pyproject.toml; reference keeps a
+large imperative setup.py because it compiles the C++ tree at build time —
+here the native pieces build lazily via paddle_tpu.native / cpp_extension)."""
+
+from setuptools import setup
+
+setup()

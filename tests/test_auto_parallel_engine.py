@@ -135,3 +135,185 @@ def test_engine_fit_evaluate_gpt_fixture():
     assert history[-1] < history[0]  # training moves
     ev = eng.evaluate(loader())
     assert np.isfinite(ev["loss"])
+
+
+def test_cross_mesh_reshard():
+    """VERDICT r3 #7: reshard the SAME tensor across different
+    ProcessMeshes — disjoint device sets and different topologies — with
+    value preservation (the reference's reshard_funcs library capability;
+    XLA device_put emits the transfers/collectives)."""
+    import jax
+
+    devs = jax.devices()
+    m_a = dist.ProcessMesh(shape=[4], dim_names=["x"],
+                           process_ids=[d.id for d in devs[:4]])
+    m_b = dist.ProcessMesh(shape=[2, 2], dim_names=["p", "q"],
+                           process_ids=[d.id for d in devs[4:8]])
+    rng = np.random.default_rng(0)
+    val = rng.normal(size=(8, 8)).astype(np.float32)
+    t = dist.shard_tensor(paddle.to_tensor(val), m_a, [dist.Shard(0)])
+    dev_a = {d.id for d in t._value.sharding.device_set}
+    assert dev_a == {d.id for d in devs[:4]}
+
+    # cross-mesh: different device set AND different topology/placements
+    t2 = dist.reshard(t, m_b, [dist.Shard(0), dist.Shard(1)])
+    np.testing.assert_allclose(np.asarray(t2._value), val)
+    dev_b = {d.id for d in t2._value.sharding.device_set}
+    assert dev_b == {d.id for d in devs[4:8]}
+    assert dev_a.isdisjoint(dev_b)
+
+    # back again with a placement change (Shard -> Replicate)
+    t3 = dist.reshard(t2, m_a, [dist.Replicate()])
+    np.testing.assert_allclose(np.asarray(t3._value), val)
+    assert t3.process_mesh is m_a
+
+
+def test_cost_model_chooses_tp_for_large_weights():
+    from paddle_tpu.distributed.auto_parallel.static_engine import (
+        choose_tp_placements,
+    )
+
+    mesh = dist.ProcessMesh(shape=[2, 4], dim_names=["dp", "mp"])
+    paddle.framework.random.seed(1)
+
+    class Net(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.big = nn.Linear(1024, 1024)   # 4 MB weight: shard
+            self.small = nn.Linear(8, 8)       # tiny: keep replicated
+
+        def forward(self, x):
+            return self.small(self.big(x)[..., :8])
+
+    net = Net()
+    ann = choose_tp_placements(net, mesh, "mp", batch_size=8, seq_len=1)
+    big_w = net.big.weight
+    small_w = net.small.weight
+    assert id(big_w) in ann, "large weight must shard over the tp axis"
+    assert id(small_w) not in ann, "tiny weight must stay replicated"
+    pls = ann[id(big_w)]
+    assert isinstance(pls[1], dist.Shard) and pls[1].get_dim() == 1
+
+
+def test_engine_pp_gpt_matches_dygraph():
+    """VERDICT r3 #7 done-criterion: the GPT fixture trains through the
+    Engine with a pp axis (schedule engine) on a pp x dp mesh, and the
+    loss trajectory matches a plain single-device dygraph run of the same
+    stages (same seed/params)."""
+    import jax
+
+    from paddle_tpu.distributed.fleet.pipeline import (
+        LayerDesc,
+        PipelineLayer,
+    )
+    from paddle_tpu.models.gpt import (
+        GPTDecoderLayer,
+        GPTEmbeddings,
+        gpt_tiny,
+    )
+
+    cfg = gpt_tiny(hidden_size=16, num_layers=3, num_heads=2, vocab_size=32,
+                   max_position_embeddings=16)
+
+    class Head(nn.Layer):
+        def __init__(self, cfg):
+            super().__init__()
+            self.ln = nn.LayerNorm(cfg.hidden_size)
+            self.proj = nn.Linear(cfg.hidden_size, cfg.vocab_size,
+                                  bias_attr=False)
+
+        def forward(self, h):
+            return self.proj(self.ln(h))
+
+    class CE(nn.Layer):
+        def forward(self, logits, labels):
+            import paddle_tpu.nn.functional as F
+            return F.cross_entropy(
+                logits.reshape([-1, logits.shape[-1]]),
+                labels.reshape([-1])).mean()
+
+    def build():
+        paddle.framework.random.seed(21)
+        descs = ([LayerDesc(GPTEmbeddings, cfg)]
+                 + [LayerDesc(GPTDecoderLayer, cfg)
+                    for _ in range(cfg.num_layers)]
+                 + [LayerDesc(Head, cfg)])
+        return PipelineLayer(descs, num_stages=2, loss_fn=CE())
+
+    rng = np.random.default_rng(5)
+    B, T = 8, 8
+    ids = rng.integers(0, cfg.vocab_size, (B, T)).astype(np.int32)
+    labels = rng.integers(0, cfg.vocab_size, (B, T)).astype(np.int32)
+
+    mesh = dist.ProcessMesh(shape=[2, 2], dim_names=["pp", "dp"])
+    pl = build()
+    o1 = opt.SGD(learning_rate=0.05, parameters=pl.parameters())
+    eng = dist.Engine(pl, optimizer=o1, mesh=mesh, pp_axis="pp",
+                      num_microbatches=4)
+
+    def loader():
+        class L:
+            def __iter__(self):
+                yield [paddle.to_tensor(ids), paddle.to_tensor(labels)]
+
+        return L()
+
+    hist = eng.fit(loader(), epochs=2)
+    assert len(hist) == 2
+
+    # reference: eager run of the SAME stage partition, same microbatch
+    # loss averaging, single device
+    ref = build()
+    o2 = opt.SGD(learning_rate=0.05, parameters=ref.parameters())
+    mb = B // 4
+    ce = CE()
+    ref_losses = []
+    for _ in range(2):
+        total = None
+        for i in range(4):
+            out = ref.forward(paddle.to_tensor(ids[i * mb:(i + 1) * mb]))
+            li = ce(out, paddle.to_tensor(labels[i * mb:(i + 1) * mb]))
+            total = li if total is None else total + li
+        loss = total / 4
+        loss.backward()
+        o2.step()
+        o2.clear_grad()
+        ref_losses.append(float(loss.numpy()))
+
+    np.testing.assert_allclose(hist, ref_losses, rtol=2e-4, atol=1e-5)
+
+
+def test_engine_pipeline_evaluate_and_default_pp_axis():
+    """Review r3: Engine.evaluate on a PipelineLayer must not crash, and a
+    PipelineLayer DistModel defaults pp_axis to the 'pp' mesh dim."""
+    from paddle_tpu.distributed.fleet.pipeline import (
+        LayerDesc,
+        PipelineLayer,
+    )
+
+    D = 8
+    paddle.framework.random.seed(3)
+    descs = [LayerDesc(nn.Linear, in_features=D, out_features=D)
+             for _ in range(2)]
+    pl = PipelineLayer(descs, num_stages=2, loss_fn=nn.MSELoss())
+    o = opt.SGD(learning_rate=0.05, parameters=pl.parameters())
+    mesh = dist.ProcessMesh(shape=[2, 2], dim_names=["pp", "dp"])
+    eng = dist.Engine(pl, optimizer=o, mesh=mesh, num_microbatches=2)
+
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(4, D)).astype(np.float32)
+    Y = rng.normal(size=(4, D)).astype(np.float32)
+
+    def loader():
+        class L:
+            def __iter__(self):
+                yield [paddle.to_tensor(X), paddle.to_tensor(Y)]
+
+        return L()
+
+    hist = eng.fit(loader(), epochs=1)  # pp_axis defaulted to "pp"
+    assert np.isfinite(hist[0])
+    ev = eng.evaluate(loader())
+    assert np.isfinite(ev["loss"])
+    preds = eng.predict(loader())
+    assert list(preds[0].shape) == [4, D]

@@ -1,0 +1,239 @@
+"""paddle.onnx.export tests (reference: python/paddle/onnx/export.py).
+
+No `onnx` package exists in this image, so the test carries a minimal
+protobuf wire-format DECODER and a tiny ONNX graph interpreter: the
+exported file is parsed back, its structure checked, and the graph
+executed numerically against the live paddle model.
+"""
+
+import struct
+
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+
+
+# ----------------------------------------------------------- mini decoder
+
+def _read_varint(buf, i):
+    shift, val = 0, 0
+    while True:
+        b = buf[i]
+        i += 1
+        val |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return val, i
+        shift += 7
+
+
+def _fields(buf):
+    """Yield (field_number, wire_type, value) for one message."""
+    i = 0
+    while i < len(buf):
+        key, i = _read_varint(buf, i)
+        field, wire = key >> 3, key & 7
+        if wire == 0:
+            v, i = _read_varint(buf, i)
+        elif wire == 2:
+            ln, i = _read_varint(buf, i)
+            v = buf[i:i + ln]
+            i += ln
+        elif wire == 5:
+            v = buf[i:i + 4]
+            i += 4
+        else:
+            raise ValueError(f"wire type {wire}")
+        yield field, wire, v
+
+
+def _decode_model(blob):
+    model = {"opset": None, "graph": None, "producer": None}
+    for f, w, v in _fields(blob):
+        if f == 2:
+            model["producer"] = v.decode()
+        elif f == 7:
+            model["graph"] = _decode_graph(v)
+        elif f == 8:
+            for f2, _, v2 in _fields(v):
+                if f2 == 2:
+                    model["opset"] = v2
+    return model
+
+
+def _decode_graph(buf):
+    g = {"nodes": [], "inits": {}, "inputs": [], "outputs": []}
+    for f, w, v in _fields(buf):
+        if f == 1:
+            g["nodes"].append(_decode_node(v))
+        elif f == 5:
+            name, arr = _decode_tensor(v)
+            g["inits"][name] = arr
+        elif f == 11:
+            g["inputs"].append(_decode_value_info(v))
+        elif f == 12:
+            g["outputs"].append(_decode_value_info(v))
+    return g
+
+
+def _decode_node(buf):
+    n = {"inputs": [], "outputs": [], "op": None, "attrs": {}}
+    for f, w, v in _fields(buf):
+        if f == 1:
+            n["inputs"].append(v.decode())
+        elif f == 2:
+            n["outputs"].append(v.decode())
+        elif f == 4:
+            n["op"] = v.decode()
+        elif f == 5:
+            name, val = _decode_attr(v)
+            n["attrs"][name] = val
+    return n
+
+
+def _decode_attr(buf):
+    name, fval, ival, ints = None, None, None, []
+    for f, w, v in _fields(buf):
+        if f == 1:
+            name = v.decode()
+        elif f == 2:
+            fval = struct.unpack("<f", v)[0]
+        elif f == 3:
+            ival = v
+        elif f == 8:
+            ints.append(v)
+    if ints:
+        return name, ints
+    return name, fval if fval is not None else ival
+
+
+def _decode_tensor(buf):
+    dims, name, raw, dt = [], None, b"", 1
+    for f, w, v in _fields(buf):
+        if f == 1:
+            dims.append(v)
+        elif f == 2:
+            dt = v
+        elif f == 8:
+            name = v.decode()
+        elif f == 9:
+            raw = v
+    dtype = "<f4" if dt == 1 else "<i8"
+    return name, np.frombuffer(raw, dtype).reshape(dims)
+
+
+def _decode_value_info(buf):
+    for f, w, v in _fields(buf):
+        if f == 1:
+            return v.decode()
+    return None
+
+
+# ------------------------------------------------------- tiny interpreter
+
+def _run_graph(g, x):
+    env = dict(g["inits"])
+    env[g["inputs"][0]] = x
+    for n in g["nodes"]:
+        ins = [env[i] for i in n["inputs"]]
+        op = n["op"]
+        if op == "Gemm":
+            out = ins[0] @ ins[1] + ins[2]
+        elif op == "MatMul":
+            out = ins[0] @ ins[1]
+        elif op == "Relu":
+            out = np.maximum(ins[0], 0)
+        elif op == "Tanh":
+            out = np.tanh(ins[0])
+        elif op == "Sigmoid":
+            out = 1 / (1 + np.exp(-ins[0]))
+        elif op == "Softmax":
+            e = np.exp(ins[0] - ins[0].max(-1, keepdims=True))
+            out = e / e.sum(-1, keepdims=True)
+        elif op == "LayerNormalization":
+            eps = n["attrs"].get("epsilon", 1e-5)
+            m = ins[0].mean(-1, keepdims=True)
+            var = ins[0].var(-1, keepdims=True)
+            out = (ins[0] - m) / np.sqrt(var + eps) * ins[1] + ins[2]
+        elif op == "Flatten":
+            out = ins[0].reshape(ins[0].shape[0], -1)
+        elif op == "Identity":
+            out = ins[0]
+        else:
+            raise NotImplementedError(op)
+        env[n["outputs"][0]] = out
+    return env[g["outputs"][0]]
+
+
+# ------------------------------------------------------------------ tests
+
+def test_export_mlp_roundtrip(tmp_path):
+    paddle.framework.random.seed(0)
+    model = nn.Sequential(
+        nn.Linear(8, 16), nn.ReLU(), nn.LayerNorm(16),
+        nn.Linear(16, 4), nn.Softmax(),
+    )
+    model.eval()
+    path = paddle.onnx.export(model, str(tmp_path / "mlp"),
+                              input_spec=[[2, 8]])
+    blob = open(path, "rb").read()
+    m = _decode_model(blob)
+    assert m["producer"] == "paddle_tpu"
+    assert m["opset"] == 17
+    g = m["graph"]
+    ops = [n["op"] for n in g["nodes"]]
+    assert ops == ["Gemm", "Relu", "LayerNormalization", "Gemm",
+                   "Softmax", "Identity"]
+    assert g["inputs"] == ["input"] and g["outputs"] == ["output"]
+
+    # numeric equivalence against the live model
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(2, 8)).astype(np.float32)
+    got = _run_graph(g, x)
+    ref = model(paddle.to_tensor(x)).numpy()
+    np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_export_unsupported_layer_raises(tmp_path):
+    import pytest
+
+    class Weird(nn.Layer):
+        def forward(self, x):
+            return x
+
+    with pytest.raises(NotImplementedError, match="jit.save"):
+        paddle.onnx.export(nn.Sequential(Weird()), str(tmp_path / "w"),
+                           input_spec=[[1, 4]])
+
+
+def test_export_conv_pool_stack(tmp_path):
+    """Conv/pool stack exports with the documented handler set; 3-D Linear
+    lowers to MatMul+Add (Gemm is rank-2 only)."""
+    paddle.framework.random.seed(1)
+    model = nn.Sequential(
+        nn.Conv2D(3, 4, 3, stride=1, padding=1),
+        nn.ReLU(),
+        nn.MaxPool2D(2),
+        nn.AvgPool2D(2),
+        nn.Flatten(),
+        nn.Linear(4 * 2 * 2, 4),
+    )
+    model.eval()
+    path = paddle.onnx.export(model, str(tmp_path / "conv"),
+                              input_spec=[[1, 3, 8, 8]])
+    g = _decode_model(open(path, "rb").read())["graph"]
+    ops = [n["op"] for n in g["nodes"]]
+    assert ops == ["Conv", "Relu", "MaxPool", "AveragePool", "Flatten",
+                   "Gemm", "Identity"]
+    pool = g["nodes"][2]
+    assert pool["attrs"]["kernel_shape"] == [2, 2]
+
+    # ND linear path
+    model2 = nn.Sequential(nn.Linear(8, 8), nn.GELU())
+    model2.eval()
+    p2 = paddle.onnx.export(model2, str(tmp_path / "nd"),
+                            input_spec=[[1, 4, 8]])
+    g2 = _decode_model(open(p2, "rb").read())["graph"]
+    ops2 = [n["op"] for n in g2["nodes"]]
+    assert ops2[:2] == ["MatMul", "Add"]       # rank-3: no Gemm
+    assert "Erf" in ops2                        # decomposed gelu

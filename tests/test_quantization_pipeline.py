@@ -120,3 +120,62 @@ def test_ptq_calibration_produces_scales_and_converts():
     denom = np.abs(fp_out).mean() + 1e-6
     assert np.mean(np.abs(out - fp_out)) / denom < 0.2
     assert np.mean(np.argmax(out, -1) == np.argmax(fp_out, -1)) > 0.8
+
+
+def test_hist_observer_robust_to_outliers():
+    """NOTES_r2 gap: histogram calibration — one extreme outlier must not
+    blow up the scale the way absmax does."""
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu.quantization import AbsmaxObserver, HistObserver
+
+    rng = np.random.default_rng(0)
+    data = rng.normal(size=(4096,)).astype(np.float32)
+    data[0] = 1000.0  # outlier
+    t = paddle.to_tensor(data)
+    absmax = AbsmaxObserver()
+    hist = HistObserver(percent=0.999)
+    absmax(t)
+    hist(t)
+    assert absmax.scales() > 5.0          # ruined by the outlier
+    assert hist.scales() < 0.1            # percentile clips it
+
+
+def test_kl_observer_reasonable_threshold():
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu.quantization import KLObserver
+
+    rng = np.random.default_rng(1)
+    data = rng.normal(size=(8192,)).astype(np.float32)
+    t = paddle.to_tensor(data)
+    obs = KLObserver()
+    obs(t)
+    # int8 scale for a unit gaussian should land near |x|max/127 ~ 0.03,
+    # and the KL threshold must be within the observed range
+    s = obs.scales()
+    assert 0.005 < s < 0.05, s
+
+
+def test_hist_observer_rebins_on_range_expansion():
+    """Review r3: when a later batch widens the range, the accumulated
+    histogram must re-bin to the new range (not pile old mass into the top
+    bin, which would blow up the percentile threshold)."""
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu.quantization import HistObserver
+
+    rng = np.random.default_rng(2)
+    obs = HistObserver(percent=0.99)
+    small = rng.uniform(0, 0.1, 8192).astype(np.float32)
+    obs(paddle.to_tensor(small))
+    s1 = obs.scales()
+    # second batch doubles the range; the bulk of mass is still <= 0.1
+    obs(paddle.to_tensor(np.concatenate(
+        [small, np.asarray([0.2], np.float32)])))
+    s2 = obs.scales()
+    # correct re-binning keeps the 99% threshold near 0.1, NOT near 0.2
+    assert s2 < 1.5 * s1, (s1, s2)

@@ -152,3 +152,25 @@ def test_copy_set_value():
     t = paddle.to_tensor([1.0, 2.0])
     t.set_value(np.array([9.0, 9.0], dtype=np.float32))
     np.testing.assert_allclose(t.numpy(), [9.0, 9.0])
+
+
+def test_string_tensor_basic():
+    """StringTensor parity (phi/core/string_tensor.h + strings kernels):
+    host-resident string tensor with lower/upper and the int boundary."""
+    from paddle_tpu.framework import StringTensor, to_string_tensor
+
+    st = to_string_tensor([["Hello", "World"], ["TPU", "Paddle"]])
+    assert st.shape == [2, 2]
+    assert st.dtype == "pstring"
+    assert st.numel() == 4
+    low = st.lower()
+    assert low.tolist() == [["hello", "world"], ["tpu", "paddle"]]
+    up = st.upper()
+    assert up.tolist() == [["HELLO", "WORLD"], ["TPU", "PADDLE"]]
+    # original untouched (functional kernels)
+    assert st.tolist()[0][0] == "Hello"
+    assert st[0][1] == "World"
+    # bytes decode + non-ascii utf8 length
+    st2 = StringTensor([b"abc", "é"])
+    bl = st2.byte_length()
+    assert bl.numpy().tolist() == [3, 2]
