@@ -191,6 +191,51 @@ def bench_bert(on_tpu):
           tokens_per_sec, "tokens/s", target, flops_per_iter, dt, iters)
 
 
+def bench_ernie(on_tpu):
+    """ERNIE-3.0-base fine-tune shape — BASELINE.json's north-star metric
+    (tokens/sec/chip; reference target: match Paddle-on-A100 step time)."""
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+    import paddle_tpu.optimizer as opt
+    from paddle_tpu.jit.api import TrainStep
+    from paddle_tpu.models.ernie import ErnieForSequenceClassification, ernie_base
+
+    if on_tpu:
+        cfg = ernie_base()
+        batch, seqlen, iters = 32, 384, 10
+    else:
+        from paddle_tpu.models.ernie import ErnieConfig
+        cfg = ErnieConfig(vocab_size=512, hidden_size=64, num_layers=2,
+                          num_heads=2, intermediate_size=128,
+                          max_position_embeddings=64)
+        batch, seqlen, iters = 2, 32, 3
+
+    model = ErnieForSequenceClassification(cfg, num_classes=2)
+    optimizer = opt.AdamW(learning_rate=2e-5, parameters=model.parameters(),
+                          multi_precision=True)
+    if on_tpu:
+        model, optimizer = paddle.amp.decorate(model, optimizer, level="O2")
+    ce = nn.CrossEntropyLoss()
+
+    def loss_fn(m, ids, labels):
+        return ce(m(ids), labels)
+
+    step = TrainStep(model, loss_fn, optimizer)
+    rng = np.random.default_rng(3)
+    ids = paddle.to_tensor(
+        rng.integers(0, cfg.vocab_size, (batch, seqlen)).astype(np.int32))
+    labels = paddle.to_tensor(rng.integers(0, 2, (batch,)).astype(np.int64))
+
+    dt = _time_step(step, (ids, labels), iters)
+    tokens_per_sec = batch * seqlen * iters / dt
+    flops_per_iter = 6.0 * _count_params(model) * batch * seqlen
+    # Paddle-on-A100 ERNIE-3.0-base fine-tune ballpark ~50k tok/s as 1.0
+    target = 50000.0 if on_tpu else tokens_per_sec
+    _emit("ernie3_base_ft_tokens_per_sec" if on_tpu
+          else "ernie_tiny_cpu_ft_tokens_per_sec",
+          tokens_per_sec, "tokens/s", target, flops_per_iter, dt, iters)
+
+
 def bench_fused_adamw(on_tpu):
     """Eager optimizer-step speedup: hand-written Pallas fused AdamW (one
     jitted program over the flat parameter space) vs per-param stock AdamW."""
@@ -307,7 +352,7 @@ def main():
 
     on_tpu = is_tpu_like()
 
-    for fn in (bench_resnet50, bench_bert, bench_fused_adamw,
+    for fn in (bench_resnet50, bench_bert, bench_ernie, bench_fused_adamw,
                bench_fused_adamw_trainstep):
         try:
             fn(on_tpu)

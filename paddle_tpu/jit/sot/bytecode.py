@@ -357,10 +357,11 @@ class OpcodeExecutor:
         the function tier and re-execute python side effects already
         performed during interpretation. Runtime constructs that need host
         values (unknown tensor attrs, tensor unpack/containment/iteration)
-        are handled as graph breaks, and name errors propagate with eager
-        semantics, so the only REMAINING mid-run declines are exotic
-        (STORE_SUBSCR on a tensor, the instruction-count limit) — those
-        frames may re-run side effects through the fallback."""
+        are handled as graph breaks (including STORE_SUBSCR on tensors,
+        which flushes pending statements first), and name errors propagate
+        with eager semantics, so the only REMAINING mid-run decline is the
+        instruction-count limit — such a frame may re-run side effects
+        through the fallback."""
         if self.code.co_flags & (0x20 | 0x80 | 0x100):
             raise BytecodeUnsupported("generator/coroutine frame")
         for inst in self.insts:
@@ -822,10 +823,26 @@ class OpcodeExecutor:
         idx = self.pop()
         obj = self.pop()
         val = self.pop()
-        if isinstance(obj, SymTensor) or isinstance(idx, SymTensor) \
-                or isinstance(val, SymTensor):
-            raise BytecodeUnsupported("tensor subscript store")
-        obj[idx] = val
+        if isinstance(obj, SymTensor):
+            # in-place tensor write: graph break — FLUSH FIRST so pending
+            # statements that read this symbol see the pre-mutation value
+            # (flush resolves lazily through tracer.concrete), then mutate
+            # the live Tensor (functional buffer swap)
+            self.tracer.breaks += 1
+            self.tracer.flush()
+            t = self.tracer.materialize(obj)
+            t[self._concrete(idx)] = self._concrete(val)
+            return None
+        if isinstance(obj, Tensor):
+            # raw (unwrapped) Tensor target — e.g. the result of a
+            # pure-python paddle.zeros call: same flush-then-write break
+            self.tracer.breaks += 1
+            self.tracer.flush()
+            obj[self._concrete(idx)] = self._concrete(val)
+            return None
+        # python container: store the value as-is (SymTensor is a fine
+        # dict/list element; it materializes if the container escapes)
+        obj[self._concrete(idx) if isinstance(idx, SymTensor) else idx] = val
         return None
 
 

@@ -259,6 +259,30 @@ class GPTForCausalLM(nn.Layer):
             return logits, new_caches
         return logits
 
+    def loss_fused(self, input_ids, labels, position_ids=None,
+                   num_chunks=8, ignore_index=-100):
+        """Memory-efficient training loss: lm-head matmul + softmax-CE fused
+        through the vocab-chunked online-logsumexp kernel — the [T, V]
+        logits tensor (2.4 GB at bench shape) never materializes
+        (incubate/nn/functional/fused_linear_ce.py). Tied-embedding models
+        only (the chunked weight IS the embedding matrix)."""
+        from paddle_tpu.core.dispatch import apply
+        from paddle_tpu.incubate.nn.functional.fused_linear_ce import (
+            fused_linear_cross_entropy,
+        )
+
+        assert self.config.tie_word_embeddings, "fused loss needs tied head"
+        h = self.gpt(input_ids, position_ids)
+        w = self.gpt.embeddings.word_embeddings.weight
+
+        def f(hv, wv, lv):
+            T = hv.shape[0] * hv.shape[1]
+            return fused_linear_cross_entropy(
+                hv.reshape(T, hv.shape[-1]), wv, lv.reshape(T),
+                num_chunks, ignore_index)
+
+        return apply("fused_linear_cross_entropy", f, h, w, labels)
+
     def generate(self, input_ids, max_new_tokens=32, temperature=1.0,
                  top_k=0, eos_token_id=None, seed=None):
         from paddle_tpu.models.generation import greedy_or_sample

@@ -245,3 +245,47 @@ def test_user_exception_propagates_once():
         raised = True
     assert raised
     assert len(calls) == 1, "user code must not be re-executed by a fallback"
+
+
+def test_tensor_setitem_is_a_break():
+    def fn(x):
+        a = x * 2.0
+        a[0] = 7.0          # in-place write -> graph break
+        return a + 1.0
+
+    w = symbolic_translate(fn)
+    x = t([1.0, 2.0, 3.0])
+    np.testing.assert_allclose(w(x).numpy(), fn(x).numpy(), rtol=1e-6)
+    st = sot_stats(w)
+    assert st["bytecode"] and st["bytecode_breaks"] >= 1
+
+
+def test_setitem_after_pending_read_keeps_order():
+    """Review r3: the in-place write must flush pending statements first —
+    an earlier-recorded read of the same symbol sees the PRE-mutation
+    value (eager semantics)."""
+    def fn(x):
+        a = x * 2.0
+        float(a.numpy()[0])     # materialize a
+        c = a + 1.0             # pending read of a
+        a[0] = 100.0            # in-place write
+        return c
+
+    w = symbolic_translate(fn)
+    x = t([1.0, 2.0, 3.0])
+    np.testing.assert_allclose(w(x).numpy(), fn(x).numpy(), rtol=1e-6)
+    np.testing.assert_allclose(w(t([1.0, 2.0, 3.0])).numpy(), [3.0, 5.0, 7.0])
+
+
+def test_setitem_into_raw_tensor_target():
+    """Storing a deferred value into a tensor created by a pure-python call
+    (never symbolized) must break+write, not crash."""
+    def fn(x):
+        buf = paddle.zeros([3])
+        buf[0] = x[0] * 2.0
+        return buf + 1.0
+
+    w = symbolic_translate(fn)
+    x = t([4.0, 5.0])
+    np.testing.assert_allclose(w(x).numpy(), fn(x).numpy(), rtol=1e-6)
+    np.testing.assert_allclose(w(t([4.0, 5.0])).numpy(), [9.0, 1.0, 1.0])
