@@ -27,10 +27,11 @@ On XLA the analogous pipeline is:
 4. **pipeline route** (r3): ``pp_axis`` + a fleet PipelineLayer model runs
    through the heterogeneous schedule engine (hybrid dp x pp in one
    program; stage-exclusive params sharded over pp). TP placements come
-   from the cost model (``choose_tp_placements``) on the GSPMD path;
-   TP *inside* the pp schedule engine is the fleet tier's ``param_specs``
-   route (tests/test_pipeline_schedules.py) — the Engine does not yet
-   compose all three axes in a single program.
+   from the cost model (``choose_tp_placements``) on the GSPMD path. Full
+   dp x tp x pp composition in ONE program lives in the fleet schedule
+   engine (``schedule_pipeline_grads(..., param_specs=, dp_axis=)``,
+   equality-tested on a 2x2x2 mesh); the Engine's PipelineLayer route
+   composes dp x pp and hands tp-in-pp models to that tier.
 5. **cross-mesh reshard** = ``dist.reshard`` moves a tensor between
    ProcessMeshes (disjoint device sets, different topologies) via
    device_put — the reference's reshard_funcs library collapses into the

@@ -173,6 +173,7 @@ def schedule_pipeline_grads(
     schedule: PipelineSchedule,
     axis: str = "pp",
     param_specs: Any = None,
+    dp_axis: str = None,
 ):
     """Execute fwd+bwd per the schedule table; returns (mean_loss, grads).
 
@@ -185,7 +186,10 @@ def schedule_pipeline_grads(
     lax.psum (its manual-mode transpose double-counts cotangents).
     x: [B, ...] microbatched inputs (uniform activation shape
     through stages; stage 0 consumes x directly). y: [B, ...] labels consumed
-    by loss_fn at the last stage. Gradients are rematerialized (B and W
+    by loss_fn at the last stage. ``dp_axis`` (r3): a mesh axis sharding each
+    microbatch's ROWS — full dp x tp x pp hybrid in ONE program when combined
+    with param_specs; dp grad reduction is an explicit psum inside the
+    engine (loss and grads become means over dp shards). Gradients are rematerialized (B and W
     re-run the stage forward from the saved stage input), giving 1F1B's
     memory profile; B emits only the input-cotangent and W only the
     weight-cotangent, so zero-bubble tables genuinely fill bubbles with W.
@@ -196,6 +200,12 @@ def schedule_pipeline_grads(
     B = x.shape[0]
     assert B % M == 0
     mb = B // M
+    if dp_axis is not None:
+        dp = mesh.shape[dp_axis]
+        assert mb % dp == 0, (
+            f"per-microbatch rows ({B}//{M}={mb}) must divide over "
+            f"dp_axis '{dp_axis}' (size {dp}); adjust batch or "
+            f"num_microbatches")
 
     leaves = jax.tree_util.tree_leaves(layer_params)
     L = leaves[0].shape[0]
@@ -354,7 +364,13 @@ def schedule_pipeline_grads(
         # stage-s grads live on device s; the P(axis) out_spec reassembles
         # the per-stage [lps, ...] blocks into the global [L, ...] layout
         loss = jax.lax.psum(state["loss"], axis) / M
-        return loss[None], state["pgrad"]
+        pgrad = state["pgrad"]
+        if dp_axis is not None:
+            dp = mesh.shape[dp_axis]
+            loss = jax.lax.psum(loss, dp_axis) / dp
+            pgrad = jax.tree_util.tree_map(
+                lambda g: jax.lax.psum(g, dp_axis) / dp, pgrad)
+        return loss[None], pgrad
 
     x_mb = x.reshape(M, mb, *x.shape[1:])
     y_mb = y.reshape(M, mb, *y.shape[1:])
@@ -366,7 +382,8 @@ def schedule_pipeline_grads(
     # every mesh axis
     p_specs = (param_specs if param_specs is not None
                else jax.tree_util.tree_map(lambda _: P(axis), layer_params))
-    in_specs = (p_specs, P(), P())
+    data_spec = P(None, dp_axis) if dp_axis is not None else P()
+    in_specs = (p_specs, data_spec, data_spec)
     out_specs = (P(axis), p_specs)
 
     loss_st, grads = shard_map(
