@@ -62,6 +62,9 @@ class GPTConfig:
     # on the ICI ring; O(S/N) memory per chip) instead of letting GSPMD
     # all-gather the sharded KV
     use_ring_attention: bool = False
+    # alternative sep strategy: Ulysses all-to-all (heads reshard over sep,
+    # full-sequence flash per head group; needs num_heads % sep == 0)
+    use_ulysses_attention: bool = False
 
     def __post_init__(self):
         if not self.intermediate_size:
@@ -85,15 +88,22 @@ def gpt3_1p3b(**kw) -> "GPTConfig":
 
 
 def _attention(q, k, v, cfg, dropout_p=0.0, training=True):
-    """Route to ring attention when configured and a sep>1 mesh is live."""
-    if getattr(cfg, "use_ring_attention", False):
-        hcg = topo.get_hybrid_communicate_group()
-        if hcg is not None and hcg.get_sep_parallel_world_size() > 1:
-            from paddle_tpu.ops.ring_attention import ring_flash_attention
+    """Route to a sequence-parallel attention strategy when configured and
+    a sep>1 mesh is live: ring (KV rotation, O(S/N) memory) or ulysses
+    (all-to-all head resharding, full-S flash per head group)."""
+    hcg = topo.get_hybrid_communicate_group()
+    sep_live = hcg is not None and hcg.get_sep_parallel_world_size() > 1
+    if sep_live and getattr(cfg, "use_ulysses_attention", False):
+        from paddle_tpu.ops.ulysses_attention import ulysses_flash_attention
 
-            return ring_flash_attention(q, k, v, dropout=dropout_p,
-                                        causal=True, mesh=hcg.get_mesh(),
-                                        training=training)
+        return ulysses_flash_attention(q, k, v, causal=True,
+                                       dropout=dropout_p, training=training)
+    if sep_live and getattr(cfg, "use_ring_attention", False):
+        from paddle_tpu.ops.ring_attention import ring_flash_attention
+
+        return ring_flash_attention(q, k, v, dropout=dropout_p,
+                                    causal=True, mesh=hcg.get_mesh(),
+                                    training=training)
     return scaled_dot_product_attention(
         q, k, v, is_causal=True, dropout_p=dropout_p, training=training)
 

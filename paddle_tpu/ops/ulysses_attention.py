@@ -1,0 +1,105 @@
+"""Ulysses (DeepSpeed-style) all-to-all sequence parallelism: the second
+long-context strategy alongside ring attention (ops/ring_attention.py).
+
+Reference context: the reference scales sequence length with its ``sep``
+topology axis + flash attention (SURVEY §5 long-context). Two TPU-native
+realizations of that axis exist here:
+
+- **ring** (ops/ring_attention.py): KV blocks rotate on the ICI ring;
+  memory O(S/N) per chip, comm N x (K+V block) per layer.
+- **ulysses** (this module): two ``all_to_all``s reshard activations from
+  sequence-sharded [B, S/N, H, D] to HEAD-sharded [B, S, H/N, D], run the
+  full-sequence flash kernel locally per head group, and reshard back.
+  Comm is 4 all-to-alls per layer (q, k, v in; out back — each moving the
+  activation once over ICI), compute is the unmodified Pallas flash kernel
+  at full sequence length; requires num_heads % N == 0.
+
+Ulysses wins when heads are plentiful and the flash kernel's full-S tiling
+beats ring's per-step block updates; ring wins when S is so long that even
+one head group's full-S attention exceeds memory, or when H < N. Both are
+exact — equality-tested against dense attention.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from paddle_tpu.ops.ring_attention import shard_map
+
+
+def _a2a_seq_to_heads(x, axis):
+    # [B, S/N, H, D] local -> [B, S, H/N, D] local
+    return jax.lax.all_to_all(x, axis, split_axis=2, concat_axis=1,
+                              tiled=True)
+
+
+def _a2a_heads_to_seq(x, axis):
+    # [B, S, H/N, D] local -> [B, S/N, H, D] local
+    return jax.lax.all_to_all(x, axis, split_axis=1, concat_axis=2,
+                              tiled=True)
+
+
+def ulysses_attention(q, k, v, *, mesh: Mesh, axis: str = "sep",
+                      causal: bool = False, scale=None,
+                      batch_axis: str = None):
+    """Exact attention over sequence-sharded q/k/v [B, S, H, D] (global
+    shapes; the S dim sharded over ``axis``; ``batch_axis`` keeps an
+    existing dp sharding of B through the op instead of all-gathering it).
+    Returns the output with the same sharding. num_heads must divide the
+    axis size."""
+    n = mesh.shape[axis]
+    B, S, H, D = q.shape
+    assert H % n == 0, (
+        f"ulysses needs num_heads ({H}) divisible by the '{axis}' axis "
+        f"({n}); use ring attention for H < N")
+    if scale is None:
+        scale = 1.0 / math.sqrt(D)
+
+    from paddle_tpu.ops.pallas.flash_attention import flash_attention_fwd
+
+    def inner(q_, k_, v_):
+        qh = _a2a_seq_to_heads(q_, axis)
+        kh = _a2a_seq_to_heads(k_, axis)
+        vh = _a2a_seq_to_heads(v_, axis)
+        out = flash_attention_fwd(qh, kh, vh, causal=causal, scale=scale)
+        return _a2a_heads_to_seq(out.astype(q_.dtype), axis)
+
+    spec = P(batch_axis, axis)
+    return shard_map(inner, mesh=mesh, in_specs=(spec, spec, spec),
+                     out_specs=spec)(q, k, v)
+
+
+def ulysses_flash_attention(query, key, value, *, axis: str = "sep",
+                            dropout=0.0, causal=False, training=True,
+                            mesh: Mesh = None, batch_axis: str = "dp"):
+    """Tensor-level entry mirroring ring_flash_attention's signature: reads
+    the hybrid topology's mesh (or an explicit ``mesh``), applies Ulysses
+    all-to-all SP, then output dropout like the ring/dense paths."""
+    from paddle_tpu.core.dispatch import apply
+    from paddle_tpu.distributed.fleet import topology as topo
+    from paddle_tpu.framework import random as rng
+
+    if mesh is None:
+        hcg = topo.get_hybrid_communicate_group()
+        if hcg is None or hcg.get_sep_parallel_world_size() <= 1:
+            raise RuntimeError(
+                "ulysses_flash_attention needs a hybrid group with sep > 1 "
+                "(or pass mesh= explicitly)")
+        mesh = hcg.get_mesh()
+    b_ax = batch_axis if batch_axis in mesh.shape else None
+
+    def f(q, k, v):
+        out = ulysses_attention(q, k, v, mesh=mesh, axis=axis,
+                                causal=causal, batch_axis=b_ax)
+        if dropout > 0.0 and training:
+            keep = jax.random.bernoulli(rng.next_key(), 1.0 - dropout,
+                                        out.shape)
+            out = jnp.where(keep, out / (1.0 - dropout), 0.0).astype(
+                out.dtype)
+        return out
+
+    return apply("ulysses_flash_attention", f, query, key, value)

@@ -166,3 +166,116 @@ def test_launch_single_proc(tmp_path):
         cwd="/root/repo",
     )
     assert "LAUNCH_OK" in out.stdout, out.stdout + out.stderr
+
+
+@requires_8
+@pytest.mark.parametrize("causal", [False, True])
+def test_ulysses_attention_matches_reference(causal, rng):
+    """Ulysses all-to-all SP (the second long-context strategy): exact
+    equality with dense attention for H % N == 0."""
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+    from paddle_tpu.ops.ulysses_attention import ulysses_attention
+
+    mesh = Mesh(np.asarray(jax.devices()[:4]), ("sep",))
+    B, S, H, D = 2, 32, 4, 8  # H=4 divides N=4
+    q, k, v = (rng.standard_normal((B, S, H, D)).astype(np.float32)
+               for _ in range(3))
+    sh = NamedSharding(mesh, P(None, "sep"))
+    qd, kd, vd = (jax.device_put(x, sh) for x in (q, k, v))
+    out = jax.jit(
+        lambda a, b, c: ulysses_attention(a, b, c, mesh=mesh, causal=causal)
+    )(qd, kd, vd)
+    np.testing.assert_allclose(
+        np.asarray(out), _ref_attention(q, k, v, causal), rtol=1e-4,
+        atol=1e-5)
+
+
+@requires_8
+def test_ulysses_attention_grads(rng):
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+    from paddle_tpu.ops.ulysses_attention import ulysses_attention
+
+    mesh = Mesh(np.asarray(jax.devices()[:4]), ("sep",))
+    B, S, H, D = 1, 16, 4, 8
+    q, k, v = (rng.standard_normal((B, S, H, D)).astype(np.float32)
+               for _ in range(3))
+    sh = NamedSharding(mesh, P(None, "sep"))
+    qd, kd, vd = (jax.device_put(x, sh) for x in (q, k, v))
+
+    def loss(a, b, c):
+        return jnp.mean(
+            ulysses_attention(a, b, c, mesh=mesh, causal=True) ** 2)
+
+    def ref_loss(a, b, c):
+        B_, S_, H_, D_ = a.shape
+        s = jnp.einsum("bqhd,bkhd->bhqk", a, b) / np.sqrt(D_)
+        mask = jnp.tril(jnp.ones((S_, S_), bool))
+        s = jnp.where(mask, s, -1e30)
+        p = jax.nn.softmax(s, axis=-1)
+        return jnp.mean(jnp.einsum("bhqk,bkhd->bqhd", p, c) ** 2)
+
+    g = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))(qd, kd, vd)
+    g_ref = jax.grad(ref_loss, argnums=(0, 1, 2))(
+        jnp.asarray(q), jnp.asarray(k), jnp.asarray(v))
+    for got, ref in zip(g, g_ref):
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                   rtol=1e-4, atol=1e-5)
+
+
+@requires_8
+def test_ulysses_rejects_indivisible_heads(rng):
+    from jax.sharding import Mesh
+    from paddle_tpu.ops.ulysses_attention import ulysses_attention
+
+    mesh = Mesh(np.asarray(jax.devices()[:4]), ("sep",))
+    q = jnp.zeros((1, 16, 3, 8), jnp.float32)  # 3 heads, N=4
+    with pytest.raises(AssertionError, match="ring attention"):
+        ulysses_attention(q, q, q, mesh=mesh)
+
+
+@requires_8
+def test_gpt_hybrid_ulysses_matches_single_device():
+    """GPT dp x sep with Ulysses attention == single-device run (the same
+    two-step oracle the dryrun uses for the ring path)."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from paddle_tpu.distributed.fleet import topology as topo
+    from paddle_tpu.jit.api import TrainStep
+    import paddle_tpu.optimizer as opt
+    from paddle_tpu.models import (
+        GPTForCausalLM,
+        GPTPretrainingCriterion,
+        gpt_tiny,
+    )
+
+    def make_cfg(**kw):
+        return gpt_tiny(hidden_size=64, num_layers=2, num_heads=4,
+                        vocab_size=128, max_position_embeddings=64, **kw)
+
+    rng = np.random.default_rng(0)
+    ids_np = rng.integers(0, 128, (4, 32)).astype(np.int32)
+
+    def two_steps(model, ids):
+        criterion = GPTPretrainingCriterion()
+        o = opt.AdamW(learning_rate=1e-3, parameters=model.parameters())
+        step = TrainStep(model, lambda m, i, l: criterion(m(i), l), o)
+        return [float(np.asarray(step(ids, ids).numpy())) for _ in range(2)]
+
+    paddle.framework.random.seed(77)
+    ref = GPTForCausalLM(make_cfg())
+    sd0 = {k: np.array(v.numpy()) for k, v in ref.state_dict().items()}
+    ref_losses = two_steps(ref, paddle.to_tensor(ids_np))
+
+    hcg = topo.HybridCommunicateGroup(dp_degree=2, mp_degree=1, pp_degree=1,
+                                      sharding_degree=1, sep_degree=4)
+    topo.set_hybrid_communicate_group(hcg)
+    try:
+        model = GPTForCausalLM(make_cfg(sequence_parallel=True,
+                                        use_ulysses_attention=True))
+        model.set_state_dict(sd0)
+        mesh = hcg.get_mesh()
+        ids = paddle.Tensor._from_value(jax.device_put(
+            jnp.asarray(ids_np), NamedSharding(mesh, P("dp", "sep"))))
+        got = two_steps(model, ids)
+    finally:
+        topo.set_hybrid_communicate_group(None)
+    np.testing.assert_allclose(got, ref_losses, rtol=2e-4)
