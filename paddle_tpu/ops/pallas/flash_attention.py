@@ -31,6 +31,28 @@ _FLASH_ENABLED = True
 # platform-gate mismatch disabled it for a full round once).
 _last_path = None
 _warned_fallback = False
+_warned_fallback_splash = False
+
+
+def _dropout(x, p, training):
+    """Inverted dropout (shared by every attention path)."""
+    if p <= 0.0 or not training:
+        return x
+    keep = jax.random.bernoulli(rng.next_key(), 1.0 - p, x.shape)
+    return jnp.where(keep, x / (1.0 - p), 0.0).astype(x.dtype)
+
+
+def _warn_kernel_fallback(name, flag_name):
+    """Warn ONCE per path when a TPU-class chip fails its kernel — a
+    silent fallback cost a full round of perf once."""
+    import traceback
+    import warnings
+
+    if globals()[flag_name]:
+        return
+    globals()[flag_name] = True
+    warnings.warn(f"{name} selected but FAILED; falling back to the XLA "
+                  "formulation:\n" + traceback.format_exc())
 
 
 def _use_pallas(q_shape, head_dim) -> bool:
@@ -64,7 +86,7 @@ def _attention_reference(q, k, v, bias, causal, scale):
 
 def flash_attention_fwd(q, k, v, bias=None, causal=False, scale=None):
     """Raw jax-level flash attention entry (arrays in, array out)."""
-    global _last_path, _warned_fallback
+    global _last_path
     if scale is None:
         scale = 1.0 / math.sqrt(q.shape[-1])
     if _use_pallas(q.shape, q.shape[-1]):
@@ -77,14 +99,8 @@ def flash_attention_fwd(q, k, v, bias=None, causal=False, scale=None):
         except Exception:
             # a TPU-like chip that can't run the kernel is a bug, not a
             # fallback case — shout so it can't silently cost a round of perf
-            if not _warned_fallback:
-                import traceback
-                import warnings
-
-                _warned_fallback = True
-                warnings.warn(
-                    "Pallas flash-attention selected but FAILED; falling back "
-                    "to XLA attention:\n" + traceback.format_exc())
+            _warn_kernel_fallback("Pallas flash-attention",
+                                  "_warned_fallback")
     _last_path = "xla"
     return _attention_reference(q, k, v, bias, causal, scale)
 
@@ -99,10 +115,7 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None, dropout_p=0.
         if bias is not None and bias.dtype == jnp.bool_:
             bias = jnp.where(bias, 0.0, -jnp.inf).astype(jnp.float32)
         out = flash_attention_fwd(q, k, v, bias=bias, causal=is_causal, scale=scale)
-        if dropout_p > 0.0 and training:
-            keep = jax.random.bernoulli(rng.next_key(), 1.0 - dropout_p, out.shape)
-            out = jnp.where(keep, out / (1.0 - dropout_p), 0.0).astype(out.dtype)
-        return out
+        return _dropout(out, dropout_p, training)
 
     args = [query, key, value]
     if attn_mask is not None:
@@ -123,6 +136,42 @@ def flash_attention(query, key, value, dropout=0.0, causal=False,
     return out, None
 
 
+def _use_splash_varlen(tq, tk, d) -> bool:
+    """Gate for the Pallas SPLASH kernel on the varlen path: TPU-class
+    chip, self-attention packing (tq == tk), block-divisible total length,
+    MXU-friendly head dim."""
+    if not _FLASH_ENABLED:
+        return False
+    from paddle_tpu.device import is_tpu_like
+
+    return (is_tpu_like() and tq == tk and tq % 128 == 0
+            and d in (64, 128, 256))
+
+
+def _splash_varlen(q, k, v, seg_q, seg_k, causal, scale):
+    """Segment-masked packed attention via the Pallas splash kernel
+    (block-sparse: fully-masked blocks are never computed — the real
+    upgrade over the dense [T, T] mask). q/k/v: [T, H, D]."""
+    from jax.experimental.pallas.ops.tpu.splash_attention import (
+        splash_attention_kernel as _sk,
+        splash_attention_mask as _sm,
+    )
+
+    T, H, D = q.shape
+    mask_cls = _sm.CausalMask if causal else _sm.FullMask
+    mask = _sm.MultiHeadMask([mask_cls((T, T)) for _ in range(H)])
+    kernel = _sk.make_splash_mha_single_device(mask)
+    seg = _sk.SegmentIds(q=seg_q.astype(jnp.int32),
+                         kv=seg_k.astype(jnp.int32))
+    # splash computes softmax(q @ k^T) with segment/causal masking and NO
+    # internal scale knob on this entry: fold the scale into q
+    qh = jnp.swapaxes(q, 0, 1).astype(jnp.float32) * scale
+    kh = jnp.swapaxes(k, 0, 1).astype(jnp.float32)
+    vh = jnp.swapaxes(v, 0, 1).astype(jnp.float32)
+    out = kernel(qh, kh, vh, segment_ids=seg)  # [H, T, D]
+    return jnp.swapaxes(out, 0, 1).astype(q.dtype)
+
+
 def flash_attn_unpadded(query, key, value, cu_seqlens_q, cu_seqlens_k,
                         max_seqlen_q=None, max_seqlen_k=None, scale=None,
                         dropout=0.0, causal=False, return_softmax=False,
@@ -134,11 +183,34 @@ def flash_attn_unpadded(query, key, value, cu_seqlens_q, cu_seqlens_k,
 
     ``query/key/value``: [total_tokens, num_heads, head_dim] — sequences
     packed back-to-back; ``cu_seqlens_*``: [batch+1] int32 cumulative
-    lengths. Attention is segment-masked so tokens only attend within their
-    own sequence (XLA fuses the mask into the softmax; a Pallas splash
-    ragged kernel is the drop-in upgrade path)."""
+    lengths. Attention is segment-masked so tokens only attend within
+    their own sequence. On TPU-class chips with self-attention packing the
+    Pallas SPLASH kernel runs it block-sparsely (masked blocks skipped);
+    elsewhere an XLA-fused dense-mask formulation is the fallback (also
+    the decode path, whose causal convention aligns unequal q/k packings
+    to sequence ends)."""
     if scale is None:
         scale = 1.0 / math.sqrt(query.shape[-1])
+    # splash needs PROVABLY identical q/k packings (its CausalMask is
+    # absolute-position; the end-aligned decode convention is dense-only).
+    # Concrete cu tensors compare by value host-side (tiny arrays); traced
+    # ones fall back to object identity.
+    same_packing = cu_seqlens_q is cu_seqlens_k
+    if not same_packing:
+        try:
+            import numpy as _np
+
+            a = cu_seqlens_q._value if isinstance(cu_seqlens_q, Tensor) \
+                else cu_seqlens_q
+            b = cu_seqlens_k._value if isinstance(cu_seqlens_k, Tensor) \
+                else cu_seqlens_k
+            if not (isinstance(a, jax.core.Tracer)
+                    or isinstance(b, jax.core.Tracer)):
+                same_packing = (a.shape == b.shape
+                                and bool(_np.array_equal(_np.asarray(a),
+                                                         _np.asarray(b))))
+        except Exception:
+            same_packing = False
 
     def f(q, k, v, cu_q, cu_k):
         tq = q.shape[0]
@@ -146,6 +218,21 @@ def flash_attn_unpadded(query, key, value, cu_seqlens_q, cu_seqlens_k,
         # segment id per token: index of the sequence it belongs to
         seg_q = jnp.searchsorted(cu_q, jnp.arange(tq), side="right") - 1
         seg_k = jnp.searchsorted(cu_k, jnp.arange(tk), side="right") - 1
+        global _last_path
+        if (_use_splash_varlen(tq, tk, q.shape[-1]) and same_packing
+                and not (dropout > 0.0 and training)):
+            # same_packing: splash's CausalMask is absolute-position; the
+            # end-aligned decode convention (cu_q != cu_k) must use the
+            # dense path. dropout: attention-dropout applies to the PROBS,
+            # which splash never materializes — train-with-dropout keeps
+            # the dense formulation for exact reference semantics.
+            try:
+                out = _splash_varlen(q, k, v, seg_q, seg_k, causal, scale)
+                _last_path = "splash"
+                return out
+            except Exception:
+                _warn_kernel_fallback("splash varlen kernel",
+                                      "_warned_fallback_splash")
         logits = jnp.einsum("qhd,khd->hqk", q.astype(jnp.float32),
                             k.astype(jnp.float32)) * scale
         mask = seg_q[:, None] == seg_k[None, :]
@@ -165,11 +252,9 @@ def flash_attn_unpadded(query, key, value, cu_seqlens_q, cu_seqlens_k,
         probs = jax.nn.softmax(logits, axis=-1)
         # fully-masked rows (padding) produce NaN from softmax(-inf): zero
         probs = jnp.where(mask[None, :, :], probs, 0.0)
-        if dropout > 0.0 and training:
-            keep = jax.random.bernoulli(rng.next_key(), 1.0 - dropout,
-                                        probs.shape)
-            probs = jnp.where(keep, probs / (1.0 - dropout), 0.0)
+        probs = _dropout(probs, dropout, training)
         out = jnp.einsum("hqk,khd->qhd", probs.astype(v.dtype), v)
+        _last_path = "xla"
         return out
 
     out = apply("flash_attn_unpadded", f, query, key, value,

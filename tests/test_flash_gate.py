@@ -65,3 +65,43 @@ def test_flash_fwd_records_selected_path():
     q = jnp.zeros((1, 128, 2, 64), jnp.float32)
     fa.flash_attention_fwd(q, q, q)
     assert fa._last_path == "xla"
+
+
+def test_splash_varlen_gate(monkeypatch):
+    """The varlen splash path engages only on TPU-class chips with
+    self-attention packing and block-divisible totals; CPU tests always
+    take the dense-mask fallback."""
+    monkeypatch.setattr(jax, "devices", lambda *a, **k: [_FakeDev("tpu")])
+    assert fa._use_splash_varlen(512, 512, 64)
+    assert not fa._use_splash_varlen(512, 500, 64)   # cross-packing decode
+    assert not fa._use_splash_varlen(500, 500, 64)   # not block-divisible
+    assert not fa._use_splash_varlen(512, 512, 48)   # odd head dim
+    monkeypatch.setattr(jax, "devices", lambda *a, **k: [_FakeDev("cpu")])
+    assert not fa._use_splash_varlen(512, 512, 64)
+
+
+def test_varlen_dense_fallback_still_exact_on_cpu(rng):
+    import numpy as np
+
+    import paddle_tpu as paddle
+
+    T, H, D = 8, 2, 4
+    cu = np.asarray([0, 3, 8], np.int32)
+    q = rng.normal(size=(T, H, D)).astype(np.float32)
+    out, _ = fa.flash_attn_unpadded(
+        paddle.to_tensor(q), paddle.to_tensor(q), paddle.to_tensor(q),
+        paddle.to_tensor(cu), paddle.to_tensor(cu), causal=True)
+    got = np.asarray(out.numpy())
+    # block-diagonal causal reference
+    ref = np.zeros_like(q)
+    for s in range(2):
+        a, b = cu[s], cu[s + 1]
+        blk = q[a:b]
+        L = b - a
+        sc = np.einsum("qhd,khd->hqk", blk, blk) / np.sqrt(D)
+        mask = np.tril(np.ones((L, L), bool))
+        sc = np.where(mask, sc, -np.inf)
+        p = np.exp(sc - sc.max(-1, keepdims=True))
+        p /= p.sum(-1, keepdims=True)
+        ref[a:b] = np.einsum("hqk,khd->qhd", p, blk)
+    np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-5)
