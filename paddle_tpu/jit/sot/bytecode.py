@@ -524,9 +524,26 @@ class OpcodeExecutor:
 
     op_LOAD_FAST_CHECK = op_LOAD_FAST
 
-    def op_STORE_FAST(self, inst):
-        self.locals[inst.argval] = self.pop()
+    def op_LOAD_FAST_AND_CLEAR(self, inst):
+        # 3.12 inlined-comprehension prologue: save (possibly unbound) outer
+        # binding; the epilogue's STORE_FAST restores it (_NULL = unbound)
+        self.push(self.locals.pop(inst.argval, _NULL))
         return None
+
+    def op_STORE_FAST(self, inst):
+        v = self.pop()
+        if v is _NULL:  # restoring an unbound comprehension saved-slot
+            self.locals.pop(inst.argval, None)
+        else:
+            self.locals[inst.argval] = v
+        return None
+
+    def op_RERAISE(self, inst):
+        # only reachable through CPython's exception tables, which this
+        # linear interpreter never enters (exceptions raised by called
+        # python code propagate natively through the CALL handlers)
+        raise RuntimeError(
+            "sot bytecode executor reached RERAISE on the linear path")
 
     def op_DELETE_FAST(self, inst):
         self.locals.pop(inst.argval, None)

@@ -6,7 +6,7 @@ ONNX wire format is plain protobuf, so this module hand-encodes the
 ModelProto subset needed for inference-graph interchange and walks the
 layer tree to emit nodes. Supported layer set (the common Sequential
 inference stack): Linear, ReLU, Sigmoid, Tanh, Softmax, GELU (decomposed
-to Erf for broad opset reach), LayerNorm (opset >= 17), Flatten, Dropout
+to Erf for broad opset reach), LayerNorm (opset >= 17), BatchNorm (NCHW), Flatten, Dropout
 (identity at inference), Conv2D, MaxPool2D, AvgPool2D. Anything else
 raises with the StableHLO alternative (`paddle.jit.save`), which remains
 the full-fidelity interchange path.
@@ -258,6 +258,28 @@ def _emit_layer(layer, x: str, rank: int, em: _Emitter):
                    _attr_ints("pads", pad + pad),
                    _attr_int("group", getattr(layer, "groups", 1) or 1)]))
         return out, 4
+    if cls in ("BatchNorm1D", "BatchNorm2D", "BatchNorm3D"):
+        if not layer.data_format.startswith("NC"):
+            raise NotImplementedError("ONNX BatchNorm export expects NC*")
+        C = layer.num_features
+        # non-affine BN (weight_attr/bias_attr=False): ONNX requires
+        # scale/B inputs, so emit identity params
+        scale = em.add_init(
+            "bn_scale",
+            np.asarray(layer.weight.numpy()) if layer.weight is not None
+            else np.ones(C, np.float32))
+        bias = em.add_init(
+            "bn_bias",
+            np.asarray(layer.bias.numpy()) if layer.bias is not None
+            else np.zeros(C, np.float32))
+        mean = em.add_init("bn_mean", np.asarray(layer._mean.numpy()))
+        var = em.add_init("bn_var", np.asarray(layer._variance.numpy()))
+        out = em.fresh("batchnorm")
+        em.nodes.append(_node(
+            "BatchNormalization", [x, scale, bias, mean, var], [out],
+            attrs=[_attr_float("epsilon", layer.epsilon),
+                   _attr_float("momentum", layer.momentum)]))
+        return out, rank
     if cls in ("MaxPool2D", "AvgPool2D"):
         if getattr(layer, "data_format", "NCHW") != "NCHW":
             raise NotImplementedError("ONNX Pool export expects NCHW")

@@ -157,6 +157,24 @@ def _run_graph(g, x):
             out = (ins[0] - m) / np.sqrt(var + eps) * ins[1] + ins[2]
         elif op == "Flatten":
             out = ins[0].reshape(ins[0].shape[0], -1)
+        elif op == "Conv":
+            from jax import lax
+
+            pads = n["attrs"].get("pads", [0, 0, 0, 0])
+            strides = n["attrs"].get("strides", [1, 1])
+            pad2 = [(pads[0], pads[2]), (pads[1], pads[3])]
+            out = np.asarray(lax.conv_general_dilated(
+                ins[0], ins[1], tuple(strides), pad2,
+                dimension_numbers=("NCHW", "OIHW", "NCHW")))
+            if len(ins) > 2:
+                out = out + ins[2].reshape(1, -1, 1, 1)
+        elif op == "BatchNormalization":
+            x_, s_, b_, m_, v_ = ins
+            eps = n["attrs"].get("epsilon", 1e-5)
+            shape = (1, -1) + (1,) * (x_.ndim - 2)
+            out = (x_ - m_.reshape(shape)) / np.sqrt(
+                v_.reshape(shape) + eps) * s_.reshape(shape) \
+                + b_.reshape(shape)
         elif op == "Identity":
             out = ins[0]
         else:
@@ -237,3 +255,30 @@ def test_export_conv_pool_stack(tmp_path):
     ops2 = [n["op"] for n in g2["nodes"]]
     assert ops2[:2] == ["MatMul", "Add"]       # rank-3: no Gemm
     assert "Erf" in ops2                        # decomposed gelu
+
+
+def test_export_batchnorm_numeric(tmp_path):
+    paddle.framework.random.seed(2)
+    model = nn.Sequential(
+        nn.Conv2D(3, 4, 3, padding=1),
+        nn.BatchNorm2D(4),
+        nn.ReLU(),
+        nn.Flatten(),
+        nn.Linear(4 * 4 * 4, 2),
+    )
+    model.eval()
+    # give BN non-trivial running stats
+    x_np = np.random.default_rng(3).normal(size=(2, 3, 4, 4)) \
+        .astype(np.float32)
+    model.train()
+    model(paddle.to_tensor(x_np))
+    model.eval()
+
+    path = paddle.onnx.export(model, str(tmp_path / "bn"),
+                              input_spec=[[2, 3, 4, 4]])
+    g = _decode_model(open(path, "rb").read())["graph"]
+    ops = [n["op"] for n in g["nodes"]]
+    assert "BatchNormalization" in ops
+    got = _run_graph(g, x_np)
+    ref = model(paddle.to_tensor(x_np)).numpy()
+    np.testing.assert_allclose(got, ref, rtol=1e-3, atol=1e-4)

@@ -289,3 +289,32 @@ def test_setitem_into_raw_tensor_target():
     x = t([4.0, 5.0])
     np.testing.assert_allclose(w(x).numpy(), fn(x).numpy(), rtol=1e-6)
     np.testing.assert_allclose(w(t([4.0, 5.0])).numpy(), [9.0, 1.0, 1.0])
+
+
+def test_list_comprehension_frames_capture():
+    """3.12 inlines list comprehensions (PEP 709) — the executor handles
+    LOAD_FAST_AND_CLEAR/RERAISE so such frames no longer decline."""
+    def fn(x, ns):
+        scaled = [x * n for n in ns]
+        total = scaled[0]
+        for s in scaled[1:]:
+            total = total + s
+        return total * 0.5
+
+    w = symbolic_translate(fn)
+    x = t([1.0, 2.0])
+    np.testing.assert_allclose(w(x, [1, 2, 3]).numpy(),
+                               fn(x, [1, 2, 3]).numpy(), rtol=1e-6)
+    st = sot_stats(w)
+    assert st["bytecode"], "comprehension frame must stay on bytecode tier"
+
+
+def test_comprehension_variable_shadowing_restored():
+    def fn(x, n):
+        vals = [n * 10 for n in range(3)]      # shadows the parameter n
+        return x * n + float(sum(vals))        # n must be restored
+
+    w = symbolic_translate(fn)
+    x = t([1.0])
+    np.testing.assert_allclose(w(x, 7).numpy(), fn(x, 7).numpy())
+    assert sot_stats(w)["bytecode"]
