@@ -32,6 +32,7 @@ _FLASH_ENABLED = True
 _last_path = None
 _warned_fallback = False
 _warned_fallback_splash = False
+_warned_traced_cu = False
 
 
 def _dropout(x, p, training):
@@ -196,6 +197,7 @@ def flash_attn_unpadded(query, key, value, cu_seqlens_q, cu_seqlens_k,
     # Concrete cu tensors compare by value host-side (tiny arrays); traced
     # ones fall back to object identity.
     same_packing = cu_seqlens_q is cu_seqlens_k
+    traced_cu = False
     if not same_packing:
         try:
             import numpy as _np
@@ -209,6 +211,8 @@ def flash_attn_unpadded(query, key, value, cu_seqlens_q, cu_seqlens_k,
                 same_packing = (a.shape == b.shape
                                 and bool(_np.array_equal(_np.asarray(a),
                                                          _np.asarray(b))))
+            else:
+                traced_cu = True
         except Exception:
             same_packing = False
 
@@ -219,8 +223,9 @@ def flash_attn_unpadded(query, key, value, cu_seqlens_q, cu_seqlens_k,
         seg_q = jnp.searchsorted(cu_q, jnp.arange(tq), side="right") - 1
         seg_k = jnp.searchsorted(cu_k, jnp.arange(tk), side="right") - 1
         global _last_path
-        if (_use_splash_varlen(tq, tk, q.shape[-1]) and same_packing
-                and not (dropout > 0.0 and training)):
+        splash_eligible = (_use_splash_varlen(tq, tk, q.shape[-1])
+                           and not (dropout > 0.0 and training))
+        if splash_eligible and same_packing:
             # same_packing: splash's CausalMask is absolute-position; the
             # end-aligned decode convention (cu_q != cu_k) must use the
             # dense path. dropout: attention-dropout applies to the PROBS,
@@ -254,7 +259,29 @@ def flash_attn_unpadded(query, key, value, cu_seqlens_q, cu_seqlens_k,
         probs = jnp.where(mask[None, :, :], probs, 0.0)
         probs = _dropout(probs, dropout, training)
         out = jnp.einsum("hqk,khd->qhd", probs.astype(v.dtype), v)
-        _last_path = "xla"
+        if traced_cu and splash_eligible:
+            # splash was skipped because traced cu_seqlens couldn't be
+            # PROVEN equal — make that observable (benches watch
+            # _last_path; the notice fires once, on its own flag so it
+            # never suppresses the real kernel-FAILED warning). Note the
+            # packings may be GENUINELY different (then dense is the only
+            # correct path) — we can't tell under tracing, so the advice
+            # is conditional.
+            _last_path = "xla-traced-cu"
+            global _warned_traced_cu
+            if not _warned_traced_cu:
+                import warnings
+
+                _warned_traced_cu = True
+                warnings.warn(
+                    "splash varlen kernel skipped: cu_seqlens are traced so "
+                    "equal packing could not be proven. IF your q/k packings "
+                    "are identical, pass the same object (or concrete "
+                    "arrays) for cu_seqlens_q/k to enable the kernel; if "
+                    "they differ, the dense path is the correct one and "
+                    "this notice is expected.")
+        else:
+            _last_path = "xla"
         return out
 
     out = apply("flash_attn_unpadded", f, query, key, value,

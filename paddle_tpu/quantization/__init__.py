@@ -92,6 +92,25 @@ class AbsmaxObserver(BaseObserver):
         return x
 
 
+def _accumulate_hist(obs, v):
+    """Add |x| values to obs._hist, widening obs._hist_max first if needed.
+
+    Widening re-bins the accumulated counts onto the new range: old bin
+    i's center value (i+0.5)/bins*old_max lands at new index
+    (i+0.5)*old_max/new_max — already a bin index, no extra *bins."""
+    mx = float(v.max())
+    if mx > obs._hist_max:
+        ratio = obs._hist_max / mx
+        old = obs._hist
+        obs._hist = np.zeros(obs.bins, np.float64)
+        idx = np.minimum(((np.arange(obs.bins) + 0.5) * ratio)
+                         .astype(int), obs.bins - 1)
+        np.add.at(obs._hist, idx, old)
+        obs._hist_max = mx
+    h, _ = np.histogram(v, bins=obs.bins, range=(0.0, obs._hist_max))
+    obs._hist += h
+
+
 class HistObserver(BaseObserver):
     """observer/hist.py parity: histogram calibration — the scale comes
     from the value at a coverage percentile of the accumulated |x|
@@ -108,20 +127,7 @@ class HistObserver(BaseObserver):
         v = np.abs(np.asarray(x.numpy())).ravel()
         if v.size == 0:
             return x
-        mx = float(v.max())
-        if mx > self._hist_max:
-            # re-bin the old histogram onto the wider range: old bin i's
-            # center value (i+0.5)/bins*old_max lands at new index
-            # (i+0.5)*old_max/new_max — already a bin index, no extra *bins
-            ratio = self._hist_max / mx
-            old = self._hist
-            self._hist = np.zeros(self.bins, np.float64)
-            idx = np.minimum(((np.arange(self.bins) + 0.5) * ratio)
-                             .astype(int), self.bins - 1)
-            np.add.at(self._hist, idx, old)
-            self._hist_max = mx
-        h, _ = np.histogram(v, bins=self.bins, range=(0.0, self._hist_max))
-        self._hist += h
+        _accumulate_hist(self, v)
         total = self._hist.sum()
         cdf = np.cumsum(self._hist) / total
         k = int(np.searchsorted(cdf, self.percent))
@@ -144,9 +150,7 @@ class KLObserver(BaseObserver):
         v = np.abs(np.asarray(x.numpy())).ravel()
         if v.size == 0:
             return x
-        self._hist_max = max(self._hist_max, float(v.max()))
-        h, _ = np.histogram(v, bins=self.bins, range=(0.0, self._hist_max))
-        self._hist += h
+        _accumulate_hist(self, v)
         self._scale = self._kl_threshold() / (
             2 ** (self.quant_bits - 1) - 1) or 1e-8
         return x

@@ -9,6 +9,7 @@ executed numerically against the live paddle model.
 import struct
 
 import numpy as np
+import pytest
 
 import paddle_tpu as paddle
 import paddle_tpu.nn as nn
@@ -282,3 +283,21 @@ def test_export_batchnorm_numeric(tmp_path):
     got = _run_graph(g, x_np)
     ref = model(paddle.to_tensor(x_np)).numpy()
     np.testing.assert_allclose(got, ref, rtol=1e-3, atol=1e-4)
+
+
+def test_export_onnxruntime_integration(tmp_path):
+    """Load an exported model with onnxruntime when it is importable.
+
+    The wire-format decoder above is written in-repo; this cross-checks
+    against an independent implementation (skips when ort is absent)."""
+    ort = pytest.importorskip("onnxruntime")
+    paddle.framework.random.seed(7)
+    model = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+    model.eval()
+    path = paddle.onnx.export(model, str(tmp_path / "ort"),
+                              input_spec=[[3, 8]])
+    sess = ort.InferenceSession(path, providers=["CPUExecutionProvider"])
+    x_np = np.random.default_rng(11).normal(size=(3, 8)).astype(np.float32)
+    (got,) = sess.run(None, {sess.get_inputs()[0].name: x_np})
+    ref = model(paddle.to_tensor(x_np)).numpy()
+    np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-5)

@@ -179,3 +179,32 @@ def test_hist_observer_rebins_on_range_expansion():
     s2 = obs.scales()
     # correct re-binning keeps the 99% threshold near 0.1, NOT near 0.2
     assert s2 < 1.5 * s1, (s1, s2)
+
+
+def test_kl_observer_rebins_on_range_expansion():
+    """Advisor r3 (medium): KLObserver must re-bin accumulated counts when
+    a later batch widens _hist_max — otherwise old counts binned under the
+    narrow range are reinterpreted on the wider one, skewing the KL scale.
+
+    Oracle: feeding batches incrementally must give (nearly) the same
+    scale as feeding the concatenated data to a fresh observer."""
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu.quantization import KLObserver
+
+    rng = np.random.default_rng(3)
+    a = rng.normal(0, 0.05, 8192).astype(np.float32)
+    b = rng.normal(0, 1.0, 8192).astype(np.float32)  # 20x wider range
+
+    inc = KLObserver()
+    inc(paddle.to_tensor(a))
+    inc(paddle.to_tensor(b))
+
+    oracle = KLObserver()
+    oracle(paddle.to_tensor(np.concatenate([a, b])))
+
+    # rebinning preserves where the mass sits; without it the narrow
+    # batch's counts land on wrong bins and shift the KL threshold
+    assert abs(inc.scales() - oracle.scales()) < 0.25 * oracle.scales(), \
+        (inc.scales(), oracle.scales())
