@@ -251,6 +251,14 @@ def run_backward(tensors, grad_tensors=None, retain_graph: bool = False) -> None
                     )
                 filled.append(_zero_cotangent_aval(*aval))
             else:
+                aval = node.out_avals[i]
+                if (aval is not None and hasattr(c, "dtype")
+                        and c.dtype != aval[1]):
+                    # accumulate in the PRIMAL output dtype (mixed-precision
+                    # graphs feed bf16 cotangents into fp32 producers when a
+                    # downstream autocast's implicit cast sits inside a
+                    # multi-op node, e.g. a recompute block)
+                    c = c.astype(aval[1])
                 filled.append(c)
         out_cot = (tuple(filled)
                    if node.n_outputs > 1 or node.primal_out_tuple

@@ -1,0 +1,378 @@
+"""Engine-driven dp x mp x pp: the FULL GPT train step as ONE program.
+
+Parity target: the reference's static Engine parallelizes data, tensor and
+pipeline axes inside one distributed program
+(python/paddle/distributed/auto_parallel/static/engine.py:68 +
+parallelizer_v2.py). TPU-native formulation:
+
+- the decoder stack runs inside the fleet schedule engine
+  (``schedule_pipeline_grads``) under ``shard_map``: stages ride the pp
+  ring, megatron-style column/row sharded weights ride the mp axis with
+  explicit f/g collectives, microbatch rows shard over dp;
+- the embedding runs OUTSIDE the shard_map in the same jit (GSPMD), chained
+  differentiably through the engine's ``return_x_grad`` input-cotangent;
+- the final layernorm + tied LM head + loss run at the LAST stage via the
+  engine's ``head_params`` hook;
+- the AdamW update applies leaf-wise to the stacked [L, ...] parameter
+  pytree in the same compiled step, so optimizer state inherits the
+  pp x mp shardings (sharding-stage-1 for free).
+
+A model opts in by exposing ``hybrid_parallel_plan(mp)`` (GPTForCausalLM
+does); ``Engine``/``dist.to_static`` route through ``HybridTrainStep`` when
+the model has a plan and the mesh carries pp + mp axes.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def _ln(x, w, b, eps):
+    mu = jnp.mean(x, axis=-1, keepdims=True)
+    var = jnp.var(x, axis=-1, keepdims=True)
+    return (x - mu) / jnp.sqrt(var + eps) * w + b
+
+
+class GPTHybridPlan:
+    """Stacked-parameter view of a GPTForCausalLM for the schedule engine.
+
+    Extracts [L, ...] leaves from the eager modules (so initialization is
+    IDENTICAL to the dygraph model), provides the megatron block_fn /
+    embed_fn / head_fn, and the PartitionSpecs wiring pp + mp."""
+
+    def __init__(self, model, mp_size: int, pp_axis: str = "pp",
+                 mp_axis: str = "mp"):
+        cfg = model.config
+        assert cfg.tie_word_embeddings, "hybrid plan assumes tied head"
+        assert cfg.num_heads % mp_size == 0, (cfg.num_heads, mp_size)
+        assert cfg.hidden_size % cfg.num_heads == 0
+        assert not (cfg.hidden_dropout or cfg.attention_dropout), (
+            "hybrid plan's block_fn implements no dropout; set both "
+            "dropout rates to 0 (or train through the dygraph path)")
+        self.model = model
+        self.cfg = cfg
+        self.mp = mp_size
+        self.pp_axis = pp_axis
+        self.mp_axis = mp_axis
+        self.eps = cfg.layer_norm_eps
+        # largest chunking <= 8 that divides the vocab
+        self.loss_num_chunks = next(
+            c for c in (8, 4, 2, 1) if cfg.vocab_size % c == 0)
+
+        gpt = model.gpt
+        emb = gpt.embeddings
+        # .copy(): device_put aliases same-device shards, so capturing the
+        # raw param buffers would let the donated step delete the EAGER
+        # model's storage out from under it
+        self.embed_params = {
+            "word": emb.word_embeddings.weight._value.copy(),
+            "pos": emb.position_embeddings.weight._value.copy(),
+        }
+        # tied head: "word" is NOT stored here (it would alias the embed
+        # buffer and break donation); the step splices ep["word"] in before
+        # handing head params to the engine
+        self.head_params = {
+            "lnf_w": gpt.ln_f.weight._value.copy(),
+            "lnf_b": gpt.ln_f.bias._value.copy(),
+        }
+        blocks = list(gpt.h)
+        self.num_layers = len(blocks)
+
+        def stack(get):
+            return jnp.stack([get(b)._value for b in blocks])
+
+        self.stacked = {
+            "ln1_w": stack(lambda b: b.ln_1.weight),
+            "ln1_b": stack(lambda b: b.ln_1.bias),
+            "qkv_w": stack(lambda b: b.attn.qkv_proj.weight),
+            "qkv_b": stack(lambda b: b.attn.qkv_proj.bias),
+            "out_w": stack(lambda b: b.attn.out_proj.weight),
+            "out_b": stack(lambda b: b.attn.out_proj.bias),
+            "ln2_w": stack(lambda b: b.ln_2.weight),
+            "ln2_b": stack(lambda b: b.ln_2.bias),
+            "fcin_w": stack(lambda b: b.mlp.fc_in.weight),
+            "fcin_b": stack(lambda b: b.mlp.fc_in.bias),
+            "fcout_w": stack(lambda b: b.mlp.fc_out.weight),
+            "fcout_b": stack(lambda b: b.mlp.fc_out.bias),
+        }
+        pp, mp = pp_axis, mp_axis
+        self.param_specs = {
+            "ln1_w": P(pp, None), "ln1_b": P(pp, None),
+            "qkv_w": P(pp, None, mp), "qkv_b": P(pp, mp),      # column
+            "out_w": P(pp, mp, None), "out_b": P(pp, None),    # row
+            "ln2_w": P(pp, None), "ln2_b": P(pp, None),
+            "fcin_w": P(pp, None, mp), "fcin_b": P(pp, mp),    # column
+            "fcout_w": P(pp, mp, None), "fcout_b": P(pp, None),  # row
+        }
+        self.head_specs = {"lnf_w": P(), "lnf_b": P(), "word": P()}
+
+    # ------------------------------------------------------------ functions
+
+    def embed_fn(self, ep, ids):
+        s = ids.shape[-1]
+        return ep["word"][ids] + ep["pos"][jnp.arange(s)]
+
+    def block_fn(self, p, h):
+        """One decoder layer on a [mb, s, H] activation; column/row weights
+        are LOCAL mp shards; f/g collectives are the megatron pair."""
+        from paddle_tpu.distributed.fleet.mp_ops import mp_identity, mp_reduce
+
+        cfg, mp = self.cfg, self.mp
+        nh_loc = cfg.num_heads // mp
+        hd = cfg.hidden_size // cfg.num_heads
+        ax = self.mp_axis
+
+        a = _ln(h, p["ln1_w"], p["ln1_b"], self.eps)
+        a = mp_identity(a, ax) if mp > 1 else a
+        qkv = a @ p["qkv_w"] + p["qkv_b"]           # [mb, s, 3H/mp]
+        b_, s_, _ = qkv.shape
+        qkv = qkv.reshape(b_, s_, nh_loc, 3 * hd)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        scale = 1.0 / math.sqrt(hd)
+        logits = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                            k.astype(jnp.float32)) * scale
+        mask = jnp.tril(jnp.ones((s_, s_), bool))
+        logits = jnp.where(mask[None, None], logits, -jnp.inf)
+        probs = jax.nn.softmax(logits, axis=-1).astype(v.dtype)
+        attn = jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+        attn = attn.reshape(b_, s_, nh_loc * hd)    # local H/mp features
+        out = attn @ p["out_w"]                     # partial [mb, s, H]
+        out = mp_reduce(out, ax) if mp > 1 else out
+        h = h + out + p["out_b"]
+
+        m = _ln(h, p["ln2_w"], p["ln2_b"], self.eps)
+        m = mp_identity(m, ax) if mp > 1 else m
+        hidden = jax.nn.gelu(m @ p["fcin_w"] + p["fcin_b"], approximate=True)
+        mo = hidden @ p["fcout_w"]                  # partial [mb, s, H]
+        mo = mp_reduce(mo, ax) if mp > 1 else mo
+        return h + mo + p["fcout_b"]
+
+    def head_fn(self, h, y, hp):
+        from paddle_tpu.incubate.nn.functional.fused_linear_ce import (
+            fused_linear_cross_entropy,
+        )
+
+        h = _ln(h, hp["lnf_w"], hp["lnf_b"], self.eps)
+        # vocab-chunked online-logsumexp tied head: the [mb*s, V] fp32
+        # logits never materialize at the last stage (they'd dominate the
+        # stage's memory at north-star vocab, and again per-microbatch in
+        # the engine's vjp replay)
+        d = h.shape[-1]
+        return fused_linear_cross_entropy(
+            h.reshape(-1, d), hp["word"], y.reshape(-1),
+            self.loss_num_chunks)
+
+    # ----------------------------------------------------------- residency
+
+    def shard_params(self, mesh: Mesh):
+        self.stacked = {
+            k: jax.device_put(v, NamedSharding(mesh, self.param_specs[k]))
+            for k, v in self.stacked.items()
+        }
+        rep = NamedSharding(mesh, P())
+        self.embed_params = {k: jax.device_put(v, rep)
+                             for k, v in self.embed_params.items()}
+        self.head_params = {k: jax.device_put(v, rep)
+                            for k, v in self.head_params.items()}
+
+    def write_back(self):
+        """Sync the trained stacked/embed/head values into the eager model
+        params (host round-trip; call after fit, not per step)."""
+        gpt = self.model.gpt
+        from paddle_tpu.tensor import Tensor
+
+        def put(param, val):
+            param._replace_value(jnp.asarray(np.asarray(jax.device_get(val)),
+                                             param._value.dtype))
+
+        put(gpt.embeddings.word_embeddings.weight,
+            self.embed_params["word"])
+        put(gpt.embeddings.position_embeddings.weight,
+            self.embed_params["pos"])
+        put(gpt.ln_f.weight, self.head_params["lnf_w"])
+        put(gpt.ln_f.bias, self.head_params["lnf_b"])
+        names = [("ln1_w", lambda b: b.ln_1.weight),
+                 ("ln1_b", lambda b: b.ln_1.bias),
+                 ("qkv_w", lambda b: b.attn.qkv_proj.weight),
+                 ("qkv_b", lambda b: b.attn.qkv_proj.bias),
+                 ("out_w", lambda b: b.attn.out_proj.weight),
+                 ("out_b", lambda b: b.attn.out_proj.bias),
+                 ("ln2_w", lambda b: b.ln_2.weight),
+                 ("ln2_b", lambda b: b.ln_2.bias),
+                 ("fcin_w", lambda b: b.mlp.fc_in.weight),
+                 ("fcin_b", lambda b: b.mlp.fc_in.bias),
+                 ("fcout_w", lambda b: b.mlp.fc_out.weight),
+                 ("fcout_b", lambda b: b.mlp.fc_out.bias)]
+        for key, get in names:
+            host = np.asarray(jax.device_get(self.stacked[key]))
+            for i, blk in enumerate(self.model.gpt.h):
+                put(get(blk), host[i])
+
+
+class HybridTrainStep:
+    """One jitted dp x mp x pp train step: embed -> schedule-engine decoder
+    stack -> head/loss -> AdamW over every parameter group.
+
+    ``optimizer`` supplies the AdamW hyperparameters (an
+    ``paddle.optimizer.AdamW`` instance); its state lives HERE as sharded
+    pytrees (stacked leaves inherit the pp x mp specs)."""
+
+    def __init__(self, model, mesh: Mesh, optimizer, *,
+                 pp_axis: str = "pp", mp_axis: str = "mp",
+                 dp_axis: Optional[str] = None,
+                 num_microbatches: Optional[int] = None,
+                 policy: str = "1F1B"):
+        from paddle_tpu.distributed.fleet.pipeline_schedules import (
+            make_pipeline_schedule,
+        )
+
+        S = mesh.shape[pp_axis]
+        mp = mesh.shape[mp_axis] if mp_axis in mesh.shape else 1
+        assert model.config.num_layers % S == 0, \
+            (model.config.num_layers, S)
+        self.plan = GPTHybridPlan(model, mp, pp_axis, mp_axis)
+        self.plan.shard_params(mesh)
+        self.mesh = mesh
+        self.pp_axis, self.mp_axis, self.dp_axis = pp_axis, mp_axis, dp_axis
+        self.M = num_microbatches or S
+        self.schedule = make_pipeline_schedule(S, self.M, policy)
+        self._opt = optimizer
+        self._lr = optimizer.get_lr
+        self._beta1 = optimizer._beta1
+        self._beta2 = optimizer._beta2
+        self._eps = optimizer._epsilon
+        # fail LOUDLY on optimizer settings this route does not apply —
+        # silently dropping a grad clip / decay filter would train a
+        # different model than the dygraph path
+        if getattr(optimizer, "_grad_clip", None) is not None:
+            raise NotImplementedError(
+                "HybridTrainStep does not apply grad_clip yet; use the "
+                "dygraph TrainStep or drop the clip")
+        if getattr(optimizer, "_apply_decay_param_fun", None) is not None:
+            raise NotImplementedError(
+                "HybridTrainStep applies uniform weight decay; "
+                "apply_decay_param_fun is not supported on this route")
+        wd = optimizer._weight_decay
+        if wd is not None and not isinstance(wd, (int, float)):
+            raise NotImplementedError(
+                "HybridTrainStep needs a scalar weight_decay")
+        self._wd = float(wd or 0.0)
+        self._moment_dtype = getattr(optimizer, "_moment_dtype", None)
+
+        mdt = self._moment_dtype
+        # zeros_like: moments inherit the pp x mp shardings shard_params
+        # just applied (full-size unsharded state would OOM at scale)
+        zeros = lambda t: jax.tree_util.tree_map(
+            lambda a: jnp.zeros_like(a, dtype=mdt or a.dtype), t)
+        self.opt_state = {
+            "m_e": zeros(self.plan.embed_params),
+            "v_e": zeros(self.plan.embed_params),
+            "m_s": zeros(self.plan.stacked),
+            "v_s": zeros(self.plan.stacked),
+            "m_h": zeros(self.plan.head_params),
+            "v_h": zeros(self.plan.head_params),
+            "step": jnp.zeros((), jnp.int32),
+        }
+        self._jitted = {}  # dp_axis_eff -> compiled step
+        self._dirty = False  # trained since last sync_model()
+
+    def _adamw(self, p, g, m, v, step, lr):
+        from paddle_tpu.optimizer.optimizer import _adamw_update
+
+        p_new, m_new, v_new = _adamw_update(
+            p, g, m.astype(p.dtype), v.astype(p.dtype),
+            step.astype(p.dtype), lr,
+            jnp.asarray(self._beta1, p.dtype),
+            jnp.asarray(self._beta2, p.dtype),
+            jnp.asarray(self._eps, p.dtype),
+            jnp.asarray(self._wd, p.dtype))
+        return p_new, m_new.astype(m.dtype), v_new.astype(v.dtype)
+
+    def _build(self, dp_axis_eff):
+        from paddle_tpu.distributed.fleet.pipeline_schedules import (
+            schedule_pipeline_grads,
+        )
+
+        plan = self.plan
+
+        def step(ep, sp, hp, opt_state, x, y, lr):
+            h0 = plan.embed_fn(ep, x)
+            hp_full = dict(hp, word=ep["word"])  # tied head, spliced in-jit
+            loss, sg, hg, dh0 = schedule_pipeline_grads(
+                plan.block_fn, plan.head_fn, sp, h0, y,
+                mesh=self.mesh, schedule=self.schedule, axis=self.pp_axis,
+                param_specs=plan.param_specs, dp_axis=dp_axis_eff,
+                head_params=hp_full, head_specs=plan.head_specs,
+                return_x_grad=True)
+            _, evjp = jax.vjp(lambda e: plan.embed_fn(e, x), ep)
+            (eg,) = evjp(dh0)
+            # tied head: embedding-word grads come from BOTH the lookup and
+            # the last stage's logits matmul
+            eg = dict(eg, word=eg["word"] + hg["word"])
+
+            nstep = opt_state["step"] + 1
+            new_ep, new_ms, new_vs = {}, {}, {}
+            m_e, v_e = {}, {}
+            for k in ep:
+                ep_k, m_k, v_k = self._adamw(
+                    ep[k], eg[k], opt_state["m_e"][k], opt_state["v_e"][k],
+                    nstep, lr)
+                new_ep[k], m_e[k], v_e[k] = ep_k, m_k, v_k
+            new_sp, m_s, v_s = {}, {}, {}
+            for k in sp:
+                sp_k, m_k, v_k = self._adamw(
+                    sp[k], sg[k], opt_state["m_s"][k], opt_state["v_s"][k],
+                    nstep, lr)
+                new_sp[k], m_s[k], v_s[k] = sp_k, m_k, v_k
+            new_hp, m_h, v_h = {}, {}, {}
+            for k in hp:
+                hp_k, m_k, v_k = self._adamw(
+                    hp[k], hg[k], opt_state["m_h"][k], opt_state["v_h"][k],
+                    nstep, lr)
+                new_hp[k], m_h[k], v_h[k] = hp_k, m_k, v_k
+            new_state = {"m_e": m_e, "v_e": v_e, "m_s": m_s, "v_s": v_s,
+                         "m_h": m_h, "v_h": v_h, "step": nstep}
+            return loss, new_ep, new_sp, new_hp, new_state
+
+        self._jitted[dp_axis_eff] = jax.jit(step, donate_argnums=(0, 1, 2, 3))
+
+    def __call__(self, x, y):
+        from paddle_tpu.tensor import Tensor
+
+        xv = x._value if isinstance(x, Tensor) else jnp.asarray(x)
+        yv = y._value if isinstance(y, Tensor) else jnp.asarray(y)
+        # partial last batches whose per-microbatch rows don't divide the dp
+        # axis fall back to a pp x mp-only program (same math, still one
+        # compiled step) instead of aborting mid-epoch
+        dp_eff = self.dp_axis
+        if dp_eff is not None:
+            dp = self.mesh.shape[dp_eff]
+            if xv.shape[0] % (self.M * dp) != 0:
+                dp_eff = None
+        if xv.shape[0] % self.M != 0:
+            raise ValueError(
+                f"batch {xv.shape[0]} must divide into "
+                f"{self.M} microbatches")
+        if dp_eff not in self._jitted:
+            self._build(dp_eff)
+        lr = jnp.asarray(self._lr(), jnp.float32)
+        loss, ep, sp, hp, st = self._jitted[dp_eff](
+            self.plan.embed_params, self.plan.stacked,
+            self.plan.head_params, self.opt_state, xv, yv, lr)
+        self.plan.embed_params = ep
+        self.plan.stacked = sp
+        self.plan.head_params = hp
+        self.opt_state = st
+        self._dirty = True
+        return Tensor._from_value(loss)
+
+    def sync_model(self):
+        if self._dirty:
+            self.plan.write_back()
+            self._dirty = False

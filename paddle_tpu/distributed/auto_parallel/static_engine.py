@@ -215,6 +215,48 @@ class DistModel:
 
         from paddle_tpu.distributed.fleet.pipeline import PipelineLayer
 
+        # full dp x mp x pp route: models exposing hybrid_parallel_plan()
+        # (GPTForCausalLM) + a mesh carrying pp AND tp axes run the WHOLE
+        # train step — embed, schedule-engine decoder stack, head, AdamW —
+        # as one program (HybridTrainStep)
+        self._is_hybrid = (
+            hasattr(layer, "hybrid_parallel_plan") and pp_axis is not None
+            and tp_axis is not None and self._mesh is not None)
+        if self._is_hybrid:
+            # the hybrid route trains with the plan's own fused
+            # cross-entropy head; a custom loss callable would be silently
+            # ignored — fail loudly unless it IS the standard criterion
+            from paddle_tpu.models import GPTPretrainingCriterion
+
+            if loss is not None and not isinstance(
+                    loss, GPTPretrainingCriterion):
+                raise NotImplementedError(
+                    "the dp x mp x pp hybrid route computes its own fused "
+                    "softmax cross-entropy at the last stage; pass "
+                    "loss=None or a GPTPretrainingCriterion (custom losses "
+                    "need the dygraph/pipeline routes)")
+            jm = self._mesh.jax_mesh()
+            dp_cands = [a for a in self._mesh.dim_names
+                        if a not in (pp_axis, tp_axis)]
+            self._batch_axis = (batch_axis if batch_axis is not None
+                                else (dp_cands[0] if dp_cands else None))
+            if optimizer is not None:
+                from paddle_tpu.distributed.auto_parallel.hybrid import (
+                    HybridTrainStep,
+                )
+
+                self._step = HybridTrainStep(
+                    layer, jm, optimizer, pp_axis=pp_axis, mp_axis=tp_axis,
+                    dp_axis=self._batch_axis,
+                    num_microbatches=num_microbatches)
+            else:
+                # eval/predict before fit: nothing trained yet — the eager
+                # model serves forwards directly (Engine.prepare rebuilds
+                # with the optimizer when fit() needs the train step)
+                self._step = None
+            self._is_pipeline = False
+            return
+
         self._is_pipeline = isinstance(layer, PipelineLayer)
         if pp_axis is not None and not self._is_pipeline:
             raise ValueError(
@@ -331,6 +373,21 @@ class DistModel:
 
     def __call__(self, *batch):
         batch = [b if isinstance(b, Tensor) else Tensor(b) for b in batch]
+        if getattr(self, "_is_hybrid", False):
+            if self._mode == "train":
+                if self._step is None:
+                    raise RuntimeError(
+                        "hybrid DistModel needs an optimizer to train")
+                return self._step(*batch)
+            # eval/predict: sync trained weights into the eager model (the
+            # step's dirty flag makes repeat calls free), then run its
+            # ordinary forward
+            if self._step is not None:
+                self._step.sync_model()
+            if self._mode == "eval" and self._loss is not None \
+                    and len(batch) > 1:
+                return self._loss(self._layer(*batch[:-1]), batch[-1])
+            return self._layer(*batch)
         if self._is_pipeline:
             if self._mode == "train":
                 if self._step != "pipeline":

@@ -174,8 +174,21 @@ def schedule_pipeline_grads(
     axis: str = "pp",
     param_specs: Any = None,
     dp_axis: str = None,
+    head_params: Any = None,
+    head_specs: Any = None,
+    return_x_grad: bool = False,
 ):
     """Execute fwd+bwd per the schedule table; returns (mean_loss, grads).
+
+    ``head_params`` (optional pytree): extra parameters consumed by
+    ``loss_fn(h, y, head_params)`` at the LAST stage (final layernorm, the
+    tied/untied LM head). Their grads are returned as a third element
+    (psum'd over pp — other stages contribute zeros — and meaned over dp).
+    ``head_specs``: PartitionSpecs for head_params leaves (default
+    replicated). ``return_x_grad``: also return dLoss/dx ([B, ...] like x)
+    so a caller can chain a differentiable embedding in FRONT of the
+    pipeline in the same program — the Engine's full dp x mp x pp GPT route
+    (embed outside, decoder stack inside, head at the last stage).
 
     layer_params leaves: [L, ...] with L = S * layers_per_stage, sharded
     P(axis) by default. ``param_specs`` (optional pytree of PartitionSpecs,
@@ -197,6 +210,15 @@ def schedule_pipeline_grads(
     S = schedule.num_stages
     M = schedule.num_microbatches
     assert mesh.shape[axis] == S
+    has_head = head_params is not None
+    if has_head:
+        def loss3(h, y_, hp):
+            return loss_fn(h, y_, hp)
+    else:
+        head_params = {}  # empty pytree: the head path becomes a no-op
+
+        def loss3(h, y_, hp):
+            return loss_fn(h, y_)
     B = x.shape[0]
     assert B % M == 0
     mb = B // M
@@ -245,7 +267,7 @@ def schedule_pipeline_grads(
         h, _ = jax.lax.scan(body, h, params_local)
         return h
 
-    def engine(params_local, x_local, y_local):
+    def engine(params_local, head_local, x_local, y_local):
         stage = jax.lax.axis_index(axis)
         params_local = jax.tree_util.tree_map(
             lambda a: a.reshape((lps,) + a.shape[1:]), params_local)
@@ -259,6 +281,9 @@ def schedule_pipeline_grads(
             pgrad=jax.tree_util.tree_map(jnp.zeros_like, params_local),
             loss=jnp.zeros((), jnp.float32),
         )
+        state["hgrad"] = jax.tree_util.tree_map(jnp.zeros_like, head_local)
+        if return_x_grad:
+            state["xgrad"] = jnp.zeros(act_shape, x_local.dtype)
 
         def do_idle(state, m, t):
             z = jnp.zeros(x_local.shape[1:], x_local.dtype)
@@ -279,18 +304,25 @@ def schedule_pipeline_grads(
             y_m = jax.lax.dynamic_index_in_dim(y_local, m, 0, keepdims=False)
             is_last = stage == S - 1
 
+            # the no-head case is head_params == {} (empty pytree): the vjp
+            # and tree_map over it are no-ops, so ONE seed closure covers
+            # both (loss_fn is wrapped to a 3-arg form up front)
             def seed(args):
-                gouts, loss = args
-                loss_m, lvjp = jax.vjp(lambda hh: loss_fn(hh, y_m), h_out)
+                gouts, loss, hgrad = args
+                loss_m, lvjp = jax.vjp(
+                    lambda hh, hp: loss3(hh, y_m, hp), h_out, head_local)
                 # total loss is the MEAN over microbatches: seed with 1/M
-                (g_seed,) = lvjp(jnp.full((), 1.0 / M, loss_m.dtype))
+                g_seed, g_head = lvjp(jnp.full((), 1.0 / M, loss_m.dtype))
                 gouts = jax.lax.dynamic_update_index_in_dim(
                     gouts, g_seed.astype(x_local.dtype), m, 0)
-                return gouts, loss + loss_m.astype(jnp.float32)
+                hgrad = jax.tree_util.tree_map(jnp.add, hgrad, g_head)
+                return gouts, loss + loss_m.astype(jnp.float32), hgrad
 
-            gouts, loss = jax.lax.cond(
-                is_last, seed, lambda a: a, (state["gouts"], state["loss"]))
-            state = dict(state, acts=acts, gouts=gouts, loss=loss)
+            gouts, loss, hgrad = jax.lax.cond(
+                is_last, seed, lambda a: a,
+                (state["gouts"], state["loss"], state["hgrad"]))
+            state = dict(state, acts=acts, gouts=gouts, loss=loss,
+                         hgrad=hgrad)
             z = jnp.zeros(x_local.shape[1:], x_local.dtype)
             return state, h_out, z
 
@@ -313,6 +345,15 @@ def schedule_pipeline_grads(
                 pgrad = jax.tree_util.tree_map(
                     jnp.add, state["pgrad"], gp)
                 state = dict(state, pgrad=pgrad)
+            if return_x_grad:
+                # stage 0's input cotangent IS dLoss/dx for microbatch m
+                xgrad = jax.lax.cond(
+                    stage == 0,
+                    lambda xg: jax.lax.dynamic_update_index_in_dim(
+                        xg, g_in, m, 0),
+                    lambda xg: xg,
+                    state["xgrad"])
+                state = dict(state, xgrad=xgrad)
             return state, jnp.zeros(x_local.shape[1:], x_local.dtype), g_in
 
         def do_w(state, m, t):
@@ -365,12 +406,31 @@ def schedule_pipeline_grads(
         # the per-stage [lps, ...] blocks into the global [L, ...] layout
         loss = jax.lax.psum(state["loss"], axis) / M
         pgrad = state["pgrad"]
+        # only the last stage computed head grads; the psum broadcasts
+        # them (zeros elsewhere) so the out_spec can omit the pp axis
+        hgrad = jax.tree_util.tree_map(
+            lambda g: jax.lax.psum(g, axis), state["hgrad"])
+        xgrad = state.get("xgrad")
+        if xgrad is not None:
+            # only stage 0 holds input cotangents
+            xgrad = jax.lax.psum(xgrad, axis)
         if dp_axis is not None:
             dp = mesh.shape[dp_axis]
             loss = jax.lax.psum(loss, dp_axis) / dp
             pgrad = jax.tree_util.tree_map(
                 lambda g: jax.lax.psum(g, dp_axis) / dp, pgrad)
-        return loss[None], pgrad
+            hgrad = jax.tree_util.tree_map(
+                lambda g: jax.lax.psum(g, dp_axis) / dp, hgrad)
+            if xgrad is not None:
+                # each dp shard keeps ITS rows' cotangents, scaled by the
+                # dp-mean weight of its shard loss
+                xgrad = xgrad / dp
+        out = [loss[None], pgrad]
+        if has_head:
+            out.append(hgrad)
+        if return_x_grad:
+            out.append(xgrad)
+        return tuple(out)
 
     x_mb = x.reshape(M, mb, *x.shape[1:])
     y_mb = y.reshape(M, mb, *y.shape[1:])
@@ -383,13 +443,26 @@ def schedule_pipeline_grads(
     p_specs = (param_specs if param_specs is not None
                else jax.tree_util.tree_map(lambda _: P(axis), layer_params))
     data_spec = P(None, dp_axis) if dp_axis is not None else P()
-    in_specs = (p_specs, data_spec, data_spec)
-    out_specs = (P(axis), p_specs)
+    h_specs = (head_specs if head_specs is not None
+               else jax.tree_util.tree_map(lambda _: P(), head_params))
+    in_specs = (p_specs, h_specs, data_spec, data_spec)
+    out_specs = [P(axis), p_specs]
+    if has_head:
+        out_specs.append(h_specs)
+    if return_x_grad:
+        out_specs.append(data_spec)
 
-    loss_st, grads = shard_map(
-        engine, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+    res = shard_map(
+        engine, mesh=mesh, in_specs=in_specs, out_specs=tuple(out_specs),
         check_rep=False,
-    )(layer_params, x_mb, y_mb)
+    )(layer_params, head_params, x_mb, y_mb)
+    loss_st, grads = res[0], res[1]
+    extra = list(res[2:])
+    if return_x_grad:
+        xg = extra.pop()
+        extra.append(xg.reshape(x.shape))
+    if extra:
+        return (loss_st[0], grads, *extra)
     return loss_st[0], grads
 
 

@@ -18,12 +18,18 @@ from paddle_tpu.tensor import Tensor
 
 
 def recompute(function, *args, use_reentrant=True, preserve_rng_state=True,
-              **kwargs):
+              policy=None, **kwargs):
     """paddle.distributed.fleet.utils.recompute parity.
 
     ``function``'s tensor args are rematerialized; parameters captured by
     closure are threaded as explicit checkpoint inputs so their activations
     are also dropped.
+
+    ``policy``: None (drop everything — the reference's full recompute) or
+    a name from ``jax.checkpoint_policies`` (e.g. ``"dots_saveable"`` keeps
+    matmul outputs so only cheap elementwise work replays — the
+    recompute_granularity="core_attn" spirit of the reference's
+    fleet.utils.recompute_hybrid, expressed as an XLA remat policy).
     """
     # collect closure params if function is a Layer (common case)
     layer = getattr(function, "__self__", None)
@@ -53,5 +59,10 @@ def recompute(function, *args, use_reentrant=True, preserve_rng_state=True,
             out = function(*call_args, **kwargs)
             return tree_unwrap(out)
 
-    ckpt = jax.checkpoint(raw)
+    if policy is None:
+        ckpt = jax.checkpoint(raw)
+    else:
+        pol = policy if callable(policy) else \
+            getattr(jax.checkpoint_policies, policy)
+        ckpt = jax.checkpoint(raw, policy=pol)
     return apply("recompute", ckpt, *all_inputs)

@@ -34,7 +34,8 @@ def _parse_args(argv=None):
     return p.parse_args(argv)
 
 
-def _child_env(args, local_rank: int, world_size: int, global_rank: int):
+def _child_env(args, local_rank: int, world_size: int, global_rank: int,
+               coordinator: str = None):
     env = dict(os.environ)
     env.update({
         "PADDLE_TRAINER_ID": str(global_rank),
@@ -46,13 +47,50 @@ def _child_env(args, local_rank: int, world_size: int, global_rank: int):
     })
     if args.master:
         env["PADDLE_MASTER"] = args.master
-        env["JAX_COORDINATOR_ADDRESS"] = args.master
+        env["JAX_COORDINATOR_ADDRESS"] = coordinator or args.master
         env["JAX_PROCESS_ID"] = str(global_rank)
         env["JAX_NUM_PROCESSES"] = str(world_size)
     if args.nproc_per_node > 1:
         # CPU multi-process testing: give each child its own device slice
         env.setdefault("JAX_PLATFORMS", "cpu")
     return env
+
+
+def _rendezvous_nodes(args, nnodes: int):
+    """Multi-node rendezvous in the LAUNCHER (reference:
+    launch/controllers/master.py — the master process's KV service), so
+    trainer processes are born with the coordination env already set and
+    jax.distributed can initialize before any backend use.
+
+    Node 0's launcher hosts the TCPStore at ``--master`` and publishes a
+    fresh coordinator endpoint (same host, free port) that node 0's
+    TRAINER will bind at jax.distributed.initialize; every launcher
+    registers its node and blocks until the cluster is complete. Returns
+    (store, coordinator) — the store must outlive the job (trainers use it
+    for app-level barriers via PADDLE_MASTER)."""
+    import socket
+
+    from paddle_tpu.distributed.store import TCPStore
+
+    host, port = args.master.rsplit(":", 1)
+    is_master = args.rank == 0
+    store = TCPStore(host, int(port), is_master=is_master,
+                     world_size=nnodes, timeout=300)
+    if is_master:
+        # bind-close-publish (the torchrun dance): a tiny window exists in
+        # which another process could steal the freed port before node 0's
+        # trainer binds the coordinator there; in-launcher elastic restarts
+        # reuse the address (gRPC rebinds with SO_REUSEADDR), while a full
+        # multi-node relaunch goes through a fresh rendezvous/port
+        s = socket.socket()
+        s.bind((host, 0))
+        coord = f"{host}:{s.getsockname()[1]}"
+        s.close()
+        store.set("rdzv/coordinator", coord)
+    store.set(f"rdzv/node{args.rank}", "up")
+    store.wait([f"rdzv/node{r}" for r in range(nnodes)])
+    coord = store.get("rdzv/coordinator").decode()
+    return store, coord
 
 
 def launch(args=None):
@@ -63,7 +101,15 @@ def launch(args=None):
         nnodes = 1
     world = nnodes * args.nproc_per_node
 
-    if args.nproc_per_node == 1:
+    # multi-node: rendezvous in the launcher, then ALWAYS spawn children
+    # (exec-in-place would initialize this process's backend before the
+    # trainer's jax.distributed bring-up). The store must stay referenced:
+    # node 0's launcher hosts it for the trainers' app-level barriers.
+    rdzv_store = coordinator = None
+    if args.master and nnodes > 1:
+        rdzv_store, coordinator = _rendezvous_nodes(args, nnodes)
+
+    if args.nproc_per_node == 1 and rdzv_store is None:
         # single proc per host: exec in-place (the TPU path)
         env = _child_env(args, 0, world, args.rank)
         os.environ.update(env)
@@ -82,7 +128,7 @@ def launch(args=None):
         procs = []
         for lr in range(args.nproc_per_node):
             grank = args.rank * args.nproc_per_node + lr
-            env = _child_env(args, lr, world_size, grank)
+            env = _child_env(args, lr, world_size, grank, coordinator)
             stdout = (open(os.path.join(
                 log_dir, f"worker.{grank}.log"
                 if attempt == 0 else f"worker.{grank}.r{attempt}.log"), "w")

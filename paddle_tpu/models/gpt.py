@@ -65,6 +65,10 @@ class GPTConfig:
     # alternative sep strategy: Ulysses all-to-all (heads reshard over sep,
     # full-sequence flash per head group; needs num_heads % sep == 0)
     use_ulysses_attention: bool = False
+    # activation recompute per decoder layer (reference: fleet recompute /
+    # recompute_granularity): None/"" = off, "full" = drop everything,
+    # any jax.checkpoint_policies name (e.g. "dots_saveable") = selective
+    recompute: str | None = None
 
     def __post_init__(self):
         if not self.intermediate_size:
@@ -232,10 +236,20 @@ class GPTModel(nn.Layer):
     def forward(self, input_ids, position_ids=None, caches=None):
         h = self.embeddings(input_ids, position_ids)
         new_caches = [] if caches is not None else None
+        remat = self.config.recompute if (self.config.recompute
+                                          and self.training
+                                          and caches is None) else None
         for i, blk in enumerate(self.h):
             if caches is not None:
                 h, nc = blk(h, caches[i])
                 new_caches.append(nc)
+            elif remat:
+                from paddle_tpu.distributed.fleet.utils.recompute import (
+                    recompute,
+                )
+
+                h = recompute(blk, h,
+                              policy=None if remat == "full" else remat)
             else:
                 h = blk(h)
         h = self.ln_f(h)
@@ -292,6 +306,14 @@ class GPTForCausalLM(nn.Layer):
                 num_chunks, ignore_index)
 
         return apply("fused_linear_cross_entropy", f, h, w, labels)
+
+    def hybrid_parallel_plan(self, mp_size, pp_axis="pp", mp_axis="mp"):
+        """Stacked-parameter plan for the one-program dp x mp x pp Engine
+        route (auto_parallel/hybrid.py; reference: static Engine +
+        parallelizer_v2 composing all axes in one program)."""
+        from paddle_tpu.distributed.auto_parallel.hybrid import GPTHybridPlan
+
+        return GPTHybridPlan(self, mp_size, pp_axis, mp_axis)
 
     def generate(self, input_ids, max_new_tokens=32, temperature=1.0,
                  top_k=0, eos_token_id=None, seed=None):

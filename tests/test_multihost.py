@@ -1,0 +1,160 @@
+"""Multi-host drill (VERDICT r3 missing #3).
+
+Two coordinator-connected "hosts" — 2 launcher processes, each spawning a
+trainer with its OWN 2-device CPU set — rendezvous through the launcher's
+TCPStore (the reference master.py pattern: the LAUNCHER runs the KV
+service and births trainers with the coordination env already set), join
+one jax.distributed job, and run a DP training job whose loss curve must
+equal the single-host run. Then host 1 is killed mid-job and both hosts
+are relaunched; trainers resume from the step checkpoint and the stitched
+trajectory still equals the uninterrupted run (reference:
+fleet/elastic/manager.py relaunch flow)."""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+WORKER = os.path.join(REPO, "tests", "multihost_worker.py")
+STEPS = 5
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _spawn_hosts(ckpt_dir, log_dir, die_at=-1, attempt=0):
+    """One launcher per 'host'; each spawns its trainer after the
+    TCPStore node rendezvous."""
+    master = f"127.0.0.1:{_free_port()}"
+    procs = []
+    for rank in range(2):
+        env = dict(os.environ)
+        # flags must be in the spawn env: a site hook that imports jax at
+        # interpreter start would bake XLA_FLAGS before the worker
+        # module's own os.environ writes could run
+        env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+        env["JAX_PLATFORMS"] = "cpu"
+        # this container's TPU-tunnel site hook (gated on this var)
+        # replaces the CPU client and breaks multi-controller bring-up —
+        # the trainers must run on the stock CPU backend
+        env.pop("PALLAS_AXON_POOL_IPS", None)
+        env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+        env.update({"MH_DEVS": "2", "MH_CKPT": ckpt_dir,
+                    "MH_STEPS": str(STEPS), "MH_DIE_AT": str(die_at),
+                    "MH_ATTEMPT": str(attempt)})
+        hdir = os.path.join(log_dir, f"a{attempt}", f"host{rank}")
+        os.makedirs(hdir, exist_ok=True)
+        procs.append(subprocess.Popen(
+            [sys.executable, "-m", "paddle_tpu.distributed.launch",
+             "--nnodes", "2", "--master", master, "--rank", str(rank),
+             "--log_dir", hdir, WORKER],
+            env=env, cwd=REPO, stdout=subprocess.DEVNULL,
+            stderr=subprocess.DEVNULL))
+    return procs
+
+
+def _losses(log_dir):
+    out = {}
+    for root, _, files in os.walk(log_dir):
+        for f in files:
+            for line in open(os.path.join(root, f)):
+                line = line.strip()
+                if line.startswith("{"):
+                    rec = json.loads(line)
+                    if "loss" in rec:
+                        out[rec["step"]] = rec["loss"]
+    return out
+
+
+def _single_host_losses():
+    """Oracle: same model/data/seed, ONE process, full batch with DP
+    semantics (mean of shard losses / shard grads)."""
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+    import paddle_tpu.optimizer as opt
+
+    paddle.framework.random.seed(1234)
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(32, 8)).astype(np.float32)
+    W = rng.normal(size=(8, 1)).astype(np.float32)
+    Y = (X @ W).astype(np.float32)
+    model = nn.Sequential(nn.Linear(8, 16), nn.Tanh(), nn.Linear(16, 1))
+    optimizer = opt.SGD(learning_rate=0.1, parameters=model.parameters())
+    lossfn = nn.MSELoss()
+    losses = []
+    for _ in range(STEPS):
+        half = [lossfn(model(paddle.to_tensor(X[i * 16:(i + 1) * 16])),
+                       paddle.to_tensor(Y[i * 16:(i + 1) * 16]))
+                for i in range(2)]
+        loss = (half[0] + half[1]) / 2.0
+        loss.backward()
+        optimizer.step()
+        optimizer.clear_grad()
+        losses.append(float(loss.numpy()))
+    return losses
+
+
+def _dump_logs(log_dir):
+    out = []
+    for root, _, files in os.walk(log_dir):
+        for f in files:
+            out.append(f"--- {f}:\n"
+                       + open(os.path.join(root, f)).read()[-1500:])
+    return "\n".join(out)
+
+
+@pytest.mark.slow
+def test_two_hosts_dp_equals_single_host(tmp_path):
+    ckpt = str(tmp_path / "ckpt")
+    os.makedirs(ckpt)
+    logs = str(tmp_path / "logs")
+    procs = _spawn_hosts(ckpt, logs)
+    rcs = [p.wait(timeout=360) for p in procs]
+    assert rcs == [0, 0], _dump_logs(logs)
+    got = _losses(logs)
+    ref = _single_host_losses()
+    assert sorted(got) == list(range(STEPS)), (got, _dump_logs(logs))
+    np.testing.assert_allclose([got[i] for i in range(STEPS)], ref,
+                               rtol=1e-5)
+
+
+@pytest.mark.slow
+def test_host_failure_elastic_relaunch(tmp_path):
+    """Host 1 dies after step 1; both hosts are relaunched and resume from
+    the step-1 checkpoint. The stitched loss trajectory equals the
+    uninterrupted run."""
+    ckpt = str(tmp_path / "ckpt")
+    os.makedirs(ckpt)
+    logs = str(tmp_path / "logs")
+
+    procs = _spawn_hosts(ckpt, logs, die_at=1)
+    assert procs[1].wait(timeout=360) == 77  # simulated host failure
+    # host 0 is stuck in the dead-peer collective: the relaunch flow
+    # terminates the survivor (the launcher's SIGTERM handler reaps its
+    # trainer) before restarting the cluster
+    procs[0].terminate()
+    try:
+        procs[0].wait(timeout=60)
+    except subprocess.TimeoutExpired:
+        procs[0].kill()
+        procs[0].wait(timeout=30)
+
+    procs = _spawn_hosts(ckpt, logs, attempt=1)
+    rcs = [p.wait(timeout=360) for p in procs]
+    assert rcs == [0, 0], _dump_logs(logs)
+
+    got = _losses(logs)  # attempt-0 steps 0..1 + attempt-1 steps 2..4
+    ref = _single_host_losses()
+    assert sorted(got) == list(range(STEPS)), (got, _dump_logs(logs))
+    np.testing.assert_allclose([got[i] for i in range(STEPS)], ref,
+                               rtol=1e-5)
