@@ -1,20 +1,77 @@
-"""AMP op lists (parity: python/paddle/amp/amp_lists.py:30-108).
+"""AMP op lists, per amp dtype and level (parity:
+python/paddle/amp/amp_lists.py:30-108 — WHITE_LIST / ONLY_FP16_WHITE_LIST /
+FP16_BLACK_LIST / EXTRA_BLACK_LIST and the white_list()/black_list()
+level tables).
 
-White list: ops that are numerically safe and fast in low precision (MXU ops).
-Black list: ops that must stay fp32. Everything else runs in the incoming dtype.
+Names are THIS framework's dispatch op names (core/dispatch.apply), not the
+reference's legacy op ids.
+
+- White: numerically safe and MXU-bound — always run in the amp dtype.
+- Black: range/precision sensitive (logs, exps, reductions, norms, losses)
+  — always run fp32.
+- Extra black: low-precision GRADIENTS are slower or lossier than fp32
+  (interp resamplers, embedding lookups, scatter) — fp32 at O1/O2, like
+  the reference's EXTRA_BLACK_LIST.
+- OD level: white ops low-precision, EVERYTHING else fp32.
 """
 
+# safe + performance-critical in both fp16 and bf16
 WHITE_LIST = {
     "conv1d", "conv2d", "conv3d", "conv2d_transpose",
     "matmul", "mm", "bmm", "mv", "addmm", "linear",
-    "einsum", "scaled_dot_product_attention",
+    "einsum", "scaled_dot_product_attention", "flash_attn",
+    "flash_attn_unpadded", "max_pool2d",
+    "fused_rotary_position_embedding",
 }
 
-BLACK_LIST = {
-    "exp", "square", "log", "log2", "log10", "log1p", "mean", "sum", "softmax",
-    "log_softmax", "cross_entropy", "softmax_with_cross_entropy", "nll_loss",
-    "binary_cross_entropy", "bce_with_logits", "kl_div", "cosine_similarity",
-    "layer_norm", "rms_norm", "batch_norm", "group_norm", "instance_norm",
-    "norm", "dist", "logsumexp", "logcumsumexp", "erfinv", "pow",
-    "cumsum", "cumprod", "var", "std", "mse_loss", "l1_loss", "smooth_l1_loss",
+# fp16-capable fused kernels whose bf16 variants the reference never wired
+ONLY_FP16_WHITE_LIST = {
+    "fused_attention",
+    "fused_feedforward",
+    "fake_quantize_dequantize_abs_max",
+    "fake_quantize_dequantize_moving_average_abs_max",
 }
+
+# numerically dangerous in HALF precision; effects observable downstream
+FP16_BLACK_LIST = {
+    "tan", "acos", "asin", "sinh", "cosh", "atanh", "tanhshrink", "erfinv",
+    "exp", "expm1", "log", "log2", "log10", "log1p", "reciprocal", "rsqrt",
+    "pow", "square", "sum", "mean", "prod", "cumsum", "cumprod", "dist",
+    "p_norm", "norm", "renorm", "var", "std", "logsumexp", "logcumsumexp",
+    "group_norm", "layer_norm", "rms_norm", "batch_norm", "instance_norm",
+    "softmax", "softmin", "softplus", "log_softmax",
+    "softmax_with_cross_entropy", "softmax_cross_entropy_fused",
+    "fused_linear_cross_entropy",
+    "sigmoid_cross_entropy_with_logits", "cross_entropy", "nll_loss",
+    "huber_loss", "triplet_margin_loss", "log_loss", "hsigmoid_loss",
+    "margin_cross_entropy", "binary_cross_entropy", "bce_with_logits",
+    "kl_div", "cosine_similarity", "mse_loss", "l1_loss", "smooth_l1_loss",
+}
+
+# grad perf/precision worse than fp32 (reference EXTRA_BLACK_LIST)
+EXTRA_BLACK_LIST = {
+    "interpolate", "upsample", "grid_sample", "embedding", "scatter",
+    "scatter_nd_add", "put_along_axis",
+}
+
+FP16_WHITE_LIST = WHITE_LIST | ONLY_FP16_WHITE_LIST
+BF16_WHITE_LIST = set(WHITE_LIST)
+BF16_BLACK_LIST = set(FP16_BLACK_LIST)
+
+# kept for back-compat with callers that import the flat names
+BLACK_LIST = FP16_BLACK_LIST | EXTRA_BLACK_LIST
+
+
+def white_list(dtype: str = "bfloat16"):
+    """The effective white set for the amp dtype — reference
+    amp_lists.white_list() table (identical across levels there too)."""
+    return FP16_WHITE_LIST if str(dtype) in ("float16", "fp16") \
+        else BF16_WHITE_LIST
+
+
+def black_list(dtype: str = "bfloat16"):
+    """The effective black set for the amp dtype. (The OD rule — every op
+    outside the white list runs fp32 — is open-ended and enforced by the
+    dispatch layer's level check, not by enumerating ops here.)"""
+    return (FP16_BLACK_LIST if str(dtype) in ("float16", "fp16")
+            else BF16_BLACK_LIST) | EXTRA_BLACK_LIST

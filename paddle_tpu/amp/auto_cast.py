@@ -23,8 +23,9 @@ class _AmpState(threading.local):
         self.enabled = False
         self.dtype = jnp.bfloat16
         self.level = "O1"
-        self.white_list = amp_lists.WHITE_LIST
-        self.black_list = amp_lists.BLACK_LIST
+        self.use_promote = True
+        self.white_list = amp_lists.white_list()
+        self.black_list = amp_lists.black_list()
 
 
 _state = _AmpState()
@@ -37,14 +38,25 @@ def amp_state() -> _AmpState:
 @contextlib.contextmanager
 def auto_cast(enable=True, custom_white_list=None, custom_black_list=None,
               level="O1", dtype="bfloat16", use_promote=True):
-    """paddle.amp.auto_cast parity."""
-    prev = (_state.enabled, _state.dtype, _state.level, _state.white_list,
-            _state.black_list)
-    _state.enabled = enable
+    """paddle.amp.auto_cast parity.
+
+    Lists are per dtype: fp16 gets the ONLY_FP16 white additions; both
+    dtypes share the range-sensitive black list + EXTRA_BLACK grads
+    (reference amp_lists.py:30-108). ``level="OD"``: white ops run in the
+    amp dtype, everything else fp32. ``use_promote`` (default True):
+    unlisted ops with MIXED low/full-precision inputs promote to fp32;
+    with False they follow the low-precision side instead (fp32 operands
+    cast down to the amp dtype)."""
+    if level not in ("O0", "OD", "O1", "O2"):
+        raise ValueError(f"level must be O0/OD/O1/O2, got {level!r}")
+    prev = (_state.enabled, _state.dtype, _state.level, _state.use_promote,
+            _state.white_list, _state.black_list)
+    _state.enabled = enable and level != "O0"
     _state.dtype = dtypes.convert_dtype(dtype)
     _state.level = level
-    white = set(amp_lists.WHITE_LIST)
-    black = set(amp_lists.BLACK_LIST)
+    _state.use_promote = use_promote
+    white = set(amp_lists.white_list(dtype))
+    black = set(amp_lists.black_list(dtype))
     if custom_white_list:
         white |= set(custom_white_list)
         black -= set(custom_white_list)
@@ -56,8 +68,8 @@ def auto_cast(enable=True, custom_white_list=None, custom_black_list=None,
     try:
         yield
     finally:
-        (_state.enabled, _state.dtype, _state.level, _state.white_list,
-         _state.black_list) = prev
+        (_state.enabled, _state.dtype, _state.level, _state.use_promote,
+         _state.white_list, _state.black_list) = prev
 
 
 amp_guard = auto_cast

@@ -73,18 +73,31 @@ def apply(name: str, raw_fn: Callable, *args, differentiable: bool = True, **kwa
             for i in tensor_idx:
                 if vals[i].dtype == jnp.float32:
                     vals[i] = vals[i].astype(amp.dtype)
-        elif name in amp.black_list:
+        elif name in amp.black_list or getattr(amp, "level", "O1") == "OD":
+            # black ops — and at OD level EVERY non-white op — run fp32
             for i in tensor_idx:
                 if vals[i].dtype in (jnp.float16, jnp.bfloat16):
                     vals[i] = vals[i].astype(jnp.float32)
         else:
-            # promote: if inputs mix low/full precision, unify to fp32
-            dts = {vals[i].dtype for i in tensor_idx
+            # membership by NAME: a set of np.dtype objects does not hash-
+            # match the jnp scalar types (`jnp.float32 in {dtype('float32')}`
+            # is False), which silently killed this branch before r4
+            dts = {jnp.dtype(vals[i].dtype).name for i in tensor_idx
                    if jnp.issubdtype(vals[i].dtype, jnp.floating)}
-            if jnp.float32 in dts and (jnp.float16 in dts or jnp.bfloat16 in dts):
+            mixed = "float32" in dts and ("float16" in dts
+                                          or "bfloat16" in dts)
+            if mixed and getattr(amp, "use_promote", True):
+                # promote: mixed low/full precision unifies to fp32
                 for i in tensor_idx:
                     if vals[i].dtype in (jnp.float16, jnp.bfloat16):
                         vals[i] = vals[i].astype(jnp.float32)
+            elif mixed:
+                # use_promote=False: unlisted ops FOLLOW the low-precision
+                # inputs (fp32 operands cast down) — jax's own promotion
+                # would otherwise silently widen to fp32
+                for i in tensor_idx:
+                    if vals[i].dtype == jnp.float32:
+                        vals[i] = vals[i].astype(amp.dtype)
 
     needs_grad = (
         differentiable
