@@ -120,18 +120,39 @@ from paddle_tpu.ops import parity as _op_parity  # noqa: E402,F401  (registers r
 __version__ = "0.1.0"
 
 
-def disable_static():  # paddle parity: we are always dygraph-first
-    pass
+def disable_static():
+    from paddle_tpu.static import _disable_static
+
+    _disable_static()
 
 
 def enable_static():
-    raise NotImplementedError(
-        "paddle_tpu is dygraph-first; use paddle_tpu.jit.to_static for graphs"
-    )
+    """r4: the imperative program-building mode is real (paddle.static
+    Variables + program_guard + Executor); classic static scripts run
+    unmodified. Dygraph remains the default and TPU-idiomatic mode."""
+    from paddle_tpu.static import _enable_static
+
+    _enable_static()
+
+
+def in_dynamic_mode() -> bool:
+    from paddle_tpu.static import in_static_mode
+
+    return not in_static_mode()
 
 
 def is_compiled_with_cuda() -> bool:
     return False
+
+
+class CPUPlace:
+    """Device-place parity token (classic static scripts pass one to
+    Executor; device selection is jax's on this backend)."""
+
+
+class CustomPlace:
+    def __init__(self, name="tpu", idx=0):
+        self.name, self.idx = name, idx
 
 
 def is_compiled_with_xpu() -> bool:

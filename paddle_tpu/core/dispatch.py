@@ -58,6 +58,13 @@ def apply(name: str, raw_fn: Callable, *args, differentiable: bool = True, **kwa
     """
     from paddle_tpu.tensor import Tensor
 
+    # static program building (paddle.static): ops over symbolic Variables
+    # append to the current Program instead of executing
+    if any(getattr(a, "_is_static_var", False) for a in args):
+        from paddle_tpu.static import record_static_op
+
+        return record_static_op(name, raw_fn, args, kwargs)
+
     tensor_idx = [i for i, a in enumerate(args) if _is_tensor(a)]
     if _consumed_watchers:
         watcher = _consumed_watchers[-1]

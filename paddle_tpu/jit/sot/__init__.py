@@ -99,18 +99,6 @@ def _graph_break_types():
     return _GRAPH_BREAK_TYPES
 
 
-def _autograd_live(args, kwargs) -> bool:
-    from paddle_tpu.autograd import tape
-
-    if not tape.is_grad_enabled():
-        return False
-    import jax
-
-    leaves = jax.tree_util.tree_leaves(
-        (args, kwargs), is_leaf=lambda x: isinstance(x, Tensor))
-    return any(isinstance(t, Tensor) and not t.stop_gradient for t in leaves)
-
-
 def symbolic_translate(fn: Optional[Callable] = None, *, train=None,
                        build_strategy=None):
     """paddle.jit.sot.symbolic_translate parity: wrap ``fn`` in the
@@ -128,9 +116,11 @@ def symbolic_translate(fn: Optional[Callable] = None, *, train=None,
             return fn(*args, **kwargs)
         key = frame.guard_key(args, kwargs)
 
-        # tier 1: bytecode executor (inference frames; autograd frames go
-        # to the function tier where to_static owns the grad story)
-        if not frame.bytecode_declined and not _autograd_live(args, kwargs):
+        # tier 1: bytecode executor. r4: training frames too — a region
+        # flush under a live tape records ONE TapeNode whose vjp
+        # differentiates the whole region (bytecode.py RegionTracer.flush),
+        # so mid-frame breaks coexist with correct grads.
+        if not frame.bytecode_declined:
             if frame.captured is None:
                 frame.captured = CapturedFrame(fn)
             try:

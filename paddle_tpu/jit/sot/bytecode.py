@@ -31,9 +31,12 @@ rather than resume-function rewriting:
   ``BytecodeUnsupported`` and the caller falls back to the function-level
   tier (whole-frame to_static / eager).
 
-Scope: inference-style frames (no tape interplay: the caller routes frames
-needing autograd to the function tier, where TrainStep/to_static own the
-grad story).
+Scope (r4): inference AND training frames. Under a live tape, a region
+flush routes through ``core.dispatch.apply`` as ONE taped op — the tape
+records a single node whose vjp differentiates the whole region — so a
+train-step frame with a mid-frame ``.numpy()`` runs region-compiled with
+correct grads. CPython 3.12 only; generators/unsupported opcodes decline
+to the function tier.
 """
 
 from __future__ import annotations
@@ -128,6 +131,22 @@ def _map_tree(x, fn):
     return x
 
 
+def _promote_tensors(x, tracer):
+    """Raw Tensors reaching a recorded statement (LOAD_GLOBAL/LOAD_ATTR —
+    model params, captured constants) become region INPUTS, not baked
+    constants: they join the vjp primals (grads flow to attribute-accessed
+    params) and the region cache key (no stale-value baking)."""
+    if isinstance(x, Tensor):
+        return tracer.new_input(x)
+    if isinstance(x, SymTensor):
+        return x
+    if isinstance(x, (list, tuple)):
+        return type(x)(_promote_tensors(i, tracer) for i in x)
+    if isinstance(x, dict):
+        return {k: _promote_tensors(v, tracer) for k, v in x.items()}
+    return x
+
+
 def _collect_syms(x, acc):
     if isinstance(x, SymTensor):
         acc.append(x.sym)
@@ -175,17 +194,26 @@ class RegionTracer:
         self.breaks = 0
 
     def new_input(self, tensor: Tensor) -> SymTensor:
+        known = getattr(self, "_input_syms", None)
+        if known is None:
+            known = self._input_syms = {}
+        hit = known.get(id(tensor))
+        if hit is not None:
+            return SymTensor(hit, self.avals[hit])
         sym = self._next_sym
         self._next_sym += 1
         self.concrete[sym] = tensor
         aval = jax.ShapeDtypeStruct(tuple(tensor._value.shape),
                                     tensor._value.dtype)
         self.avals[sym] = aval
+        known[id(tensor)] = sym
         return SymTensor(sym, aval)
 
     def record(self, fn_desc, args, kwargs) -> Any:
         """Try to record a tensor op; returns SymTensor(s) on success,
         raises GraphBreak when the op needs concrete values."""
+        args = _promote_tensors(args, self)
+        kwargs = _promote_tensors(kwargs, self)
         in_syms: List[int] = []
         _collect_syms(args, in_syms)
         _collect_syms(kwargs, in_syms)
@@ -263,8 +291,8 @@ class RegionTracer:
         stmts = list(self.pending)
 
         sig = self._region_signature(in_syms)
-        replay = _REGION_CACHE.get(sig)
-        if replay is None:
+        cached = _REGION_CACHE.get(sig)
+        if cached is None:
             def replay_fn(in_vals):
                 env = {s: Tensor._from_value(v)
                        for s, v in zip(in_syms, in_vals)}
@@ -286,16 +314,41 @@ class RegionTracer:
                             env[sym] = t
                 return [env[s]._value for s in out_syms]
 
-            replay = jax.jit(replay_fn)
-            _REGION_CACHE[sig] = replay
+            cached = (jax.jit(replay_fn), replay_fn)
+            _REGION_CACHE[sig] = cached
             self.regions_compiled += 1
         else:
             _REGION_CACHE_HITS += 1
+        replay_jit, replay_raw = cached
 
-        in_vals = [self.concrete[s]._value for s in in_syms]
-        out_vals = replay(in_vals)
-        for sym, v in zip(out_syms, out_vals):
-            self.concrete[sym] = Tensor._from_value(v)
+        in_tensors = [self.concrete[s] for s in in_syms]
+        from paddle_tpu.autograd import tape as _tape
+
+        if _tape.is_grad_enabled() and any(not t.stop_gradient
+                                           for t in in_tensors):
+            # TRAINING frame (r4, VERDICT missing #5): flush the region as
+            # ONE taped op — dispatch.apply records a single TapeNode whose
+            # vjp differentiates the whole region, so grads flow through
+            # region-compiled frames exactly as through eager ops
+            from paddle_tpu.core.dispatch import apply
+
+            def raw(*vals):
+                # the JITTED replay: jax.vjp through pjit keeps both the
+                # forward and the linearized backward compiled (re-using
+                # replay_raw here would re-trace the whole dispatch stack
+                # per training step)
+                return tuple(replay_jit(list(vals)))
+
+            outs = apply("sot_region", raw, *in_tensors)
+            if not isinstance(outs, tuple):
+                outs = (outs,)
+            for sym, t in zip(out_syms, outs):
+                self.concrete[sym] = t
+        else:
+            in_vals = [t._value for t in in_tensors]
+            out_vals = replay_jit(in_vals)
+            for sym, v in zip(out_syms, out_vals):
+                self.concrete[sym] = Tensor._from_value(v)
         self.pending = []
 
     def materialize(self, st: SymTensor) -> Tensor:

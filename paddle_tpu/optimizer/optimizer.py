@@ -27,10 +27,15 @@ class Optimizer:
                  grad_clip=None, name=None, multi_precision=False):
         self._lr = learning_rate
         if parameters is None:
-            raise ValueError(
-                "parameters is required in dygraph mode "
-                "(pass model.parameters())"
-            )
+            from paddle_tpu.static import is_building
+
+            if not is_building():
+                raise ValueError(
+                    "parameters is required in dygraph mode "
+                    "(pass model.parameters())"
+                )
+            # static building: minimize() binds the program's parameters
+            parameters = []
         self._parameter_list = list(parameters)
         self._weight_decay = 0.0 if weight_decay is None else weight_decay
         self._grad_clip = grad_clip
@@ -128,6 +133,12 @@ class Optimizer:
                 p._replace_value(new_target)
 
     def minimize(self, loss, startup_program=None, parameters=None, no_grad_set=None):
+        if getattr(loss, "_is_static_var", False):
+            # static-mode: record this optimizer into the loss's program;
+            # Executor.run stages backward + update (static/__init__.py)
+            loss.program._optimizer = self
+            loss.program._loss = loss
+            return None, None
         loss.backward()
         self.step()
         return None, None

