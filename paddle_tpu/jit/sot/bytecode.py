@@ -190,6 +190,7 @@ class RegionTracer:
         self.concrete: Dict[int, Tensor] = {}   # sym -> live Tensor
         self.pending: List[Statement] = []
         self.avals: Dict[int, Any] = {}
+        self.stops: Dict[int, bool] = {}        # sym -> stop_gradient
         self.regions_compiled = 0
         self.breaks = 0
 
@@ -206,6 +207,7 @@ class RegionTracer:
         aval = jax.ShapeDtypeStruct(tuple(tensor._value.shape),
                                     tensor._value.dtype)
         self.avals[sym] = aval
+        self.stops[sym] = bool(tensor.stop_gradient)
         known[id(tensor)] = sym
         return SymTensor(sym, aval)
 
@@ -251,10 +253,12 @@ class RegionTracer:
 
         stmt_outs = []
         out_sts = []
+        out_stop = all(self.stops.get(s, True) for s in in_syms)
         for av in out_avals:
             sym = self._next_sym
             self._next_sym += 1
             self.avals[sym] = av
+            self.stops[sym] = out_stop
             stmt_outs.append(sym)
             out_sts.append(SymTensor(sym, av))
         self.pending.append(Statement(fn_desc, args, kwargs, stmt_outs))
@@ -314,12 +318,12 @@ class RegionTracer:
                             env[sym] = t
                 return [env[s]._value for s in out_syms]
 
-            cached = (jax.jit(replay_fn), replay_fn)
+            cached = jax.jit(replay_fn)
             _REGION_CACHE[sig] = cached
             self.regions_compiled += 1
         else:
             _REGION_CACHE_HITS += 1
-        replay_jit, replay_raw = cached
+        replay_jit = cached
 
         in_tensors = [self.concrete[s] for s in in_syms]
         from paddle_tpu.autograd import tape as _tape
@@ -334,9 +338,8 @@ class RegionTracer:
 
             def raw(*vals):
                 # the JITTED replay: jax.vjp through pjit keeps both the
-                # forward and the linearized backward compiled (re-using
-                # replay_raw here would re-trace the whole dispatch stack
-                # per training step)
+                # forward and the linearized backward compiled (an unjitted
+                # replay would re-trace the whole dispatch stack per step)
                 return tuple(replay_jit(list(vals)))
 
             outs = apply("sot_region", raw, *in_tensors)
@@ -949,7 +952,9 @@ def _sym_attr(tracer: RegionTracer, st: SymTensor, name: str):
     if name == "T":
         return tracer.record(("call", _transpose_T), (st,), {})
     if name == "stop_gradient":
-        return True
+        # tracked through recording (inputs: the concrete tensor's flag;
+        # outputs: all-inputs-stop) — training frames branch on this
+        return tracer.stops.get(st.sym, True)
     tracer.breaks += 1
     out = getattr(tracer.materialize(st), name)
     return tracer.new_input(out) if isinstance(out, Tensor) else out

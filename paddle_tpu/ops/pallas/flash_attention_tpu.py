@@ -24,7 +24,7 @@ from jax.experimental.pallas.ops.tpu.flash_attention import (
 
 
 def _largest_dividing_block(n: int, cap: int) -> int:
-    for b in (1024, 512, 256, 128):
+    for b in (2048, 1024, 512, 256, 128):
         if b <= cap and n % b == 0:
             return b
     return min(n, cap)
@@ -57,10 +57,36 @@ def _bwd_caps():
     return _BWD_CAPS
 
 
+_FWD_CAPS = None
+
+
+def _fwd_caps():
+    global _FWD_CAPS
+    if _FWD_CAPS is None:
+        env = os.environ.get("PADDLE_TPU_FLASH_FWD_BLOCKS", "")
+        # r4 S=2048 sweep (GPT-2s b6 fused-CE end-to-end): 1024/512 stays
+        # fastest (see NOTES_r4); the caps remain overridable for sweeps
+        _FWD_CAPS = (1024, 512)
+        if env:
+            try:
+                parts = [int(x) for x in env.split(",")]
+                if len(parts) != 2 or any(p <= 0 for p in parts):
+                    raise ValueError(env)
+                _FWD_CAPS = tuple(parts)
+            except ValueError:
+                import warnings
+
+                warnings.warn(
+                    "PADDLE_TPU_FLASH_FWD_BLOCKS must be 2 positive ints "
+                    f"'q,k'; got {env!r} — using defaults")
+    return _FWD_CAPS
+
+
 def _block_sizes(sq: int, sk: int) -> BlockSizes:
     # largest dividing block ≤ cap: seq 1536 gets 512, not a failing 1024
-    bq = _largest_dividing_block(sq, 1024)
-    bk = _largest_dividing_block(sk, 512)
+    cq, ck = _fwd_caps()
+    bq = _largest_dividing_block(sq, cq)
+    bk = _largest_dividing_block(sk, ck)
     cq_dkv, ck_dkv, cq_dq, ck_dq = _bwd_caps()
     bq_dkv = _largest_dividing_block(sq, cq_dkv)
     bk_dkv = _largest_dividing_block(sk, ck_dkv)

@@ -400,10 +400,17 @@ class Executor:
         feed_names = sorted(feed.keys())
         feed_vals = [np.asarray(feed[k]._value if isinstance(feed[k], Tensor)
                                 else feed[k]) for k in feed_names]
+        # the runner bakes in the optimizer ALGORITHM and its clip/decay
+        # config — key on their identities so replacing the optimizer (or
+        # its clip) after a run retraces instead of reusing stale updates
         key = (tuple(feed_names),
                tuple((v.shape, str(v.dtype)) for v in feed_vals),
                tuple(v.name for v in fetch_vars), train,
-               len(program.ops))
+               len(program.ops),
+               (id(opt), type(opt).__name__,
+                id(getattr(opt, "_grad_clip", None)),
+                repr(getattr(opt, "_weight_decay", None))) if train
+               else None)
         runner = program._run_cache.get(key)
         if runner is None:
             runner = self._build_runner(program, feed_names, fetch_vars,

@@ -445,3 +445,30 @@ def test_training_frame_attribute_params_get_grads():
         np.asarray(lin.weight.numpy()) * 2.0))
     v2 = float(wrapped(paddle.to_tensor(x_np)).numpy())
     assert abs(v1 - v2) > 1e-6, (v1, v2)
+
+
+def test_sym_stop_gradient_tracks_inputs():
+    """Review r4: frames branching on .stop_gradient must see the real
+    flag (it was hard-coded True pre-r4, unobservable then because
+    training frames never reached the bytecode tier)."""
+    def frame(w, x):
+        h = paddle.matmul(x, w)
+        if not h.stop_gradient:          # python branch on the sym flag
+            h = h * 2.0
+        return paddle.mean(h)
+
+    rng = np.random.default_rng(5)
+    x_np = rng.standard_normal((2, 3)).astype(np.float32)
+    w_t = paddle.to_tensor(rng.standard_normal((3, 3)).astype(np.float32),
+                           stop_gradient=False)
+    ref = float(frame(w_t, paddle.to_tensor(x_np)).numpy())
+    wrapped = symbolic_translate(frame)
+    got = float(wrapped(w_t, paddle.to_tensor(x_np)).numpy())
+    np.testing.assert_allclose(got, ref, rtol=1e-6)
+
+    # frozen weights take the other branch
+    w_f = paddle.to_tensor(np.asarray(w_t.numpy()))  # stop_gradient=True
+    ref_f = float(frame(w_f, paddle.to_tensor(x_np)).numpy())
+    got_f = float(wrapped(w_f, paddle.to_tensor(x_np)).numpy())
+    np.testing.assert_allclose(got_f, ref_f, rtol=1e-6)
+    assert abs(ref - ref_f * 2.0) < 1e-5  # branches genuinely differ
