@@ -154,6 +154,49 @@ def bench_gpt3_1p3b(on_tpu):
           tokens_per_sec, "tokens/s", None, flops_per_iter, dt, iters)
 
 
+def bench_llama13b_layer(on_tpu):
+    """BASELINE.md config #5 slice: one LLaMA-2-13B decoder LAYER
+    (h=5120, ffn 13824, 40 heads) full jitted train step on-chip. The 13B
+    model needs a pod (26 GB of bf16 params alone); the per-layer number
+    is the single-chip-measurable building block — the sharded composition
+    is exercised by dryrun_multichip's hybrid engine at tiny shape."""
+    import paddle_tpu as paddle
+    import paddle_tpu.optimizer as opt
+    from paddle_tpu.jit.api import TrainStep
+    from paddle_tpu.models.llama import LlamaDecoderLayer, llama2_13b, llama_tiny
+
+    if on_tpu:
+        cfg = llama2_13b(max_position_embeddings=2048)
+        batch, seqlen, iters = 1, 2048, 10
+    else:
+        cfg = llama_tiny()
+        batch, seqlen, iters = 1, 64, 3
+
+    layer = LlamaDecoderLayer(cfg)
+    n_params = _count_params(layer)
+    optimizer = opt.AdamW(learning_rate=1e-4,
+                          parameters=layer.parameters(),
+                          moment_dtype="bfloat16")
+
+    def loss_fn(m, x):
+        with paddle.amp.auto_cast(level="O1"):
+            out = m(x)
+        return paddle.mean(out * out)
+
+    step = TrainStep(layer, loss_fn, optimizer)
+    rng = np.random.default_rng(5)
+    x = paddle.to_tensor(
+        rng.normal(size=(batch, seqlen, cfg.hidden_size))
+        .astype(np.float32) * 0.1)
+
+    dt = _time_step(step, (x,), iters)
+    flops_per_iter = 6.0 * n_params * batch * seqlen
+    _emit("llama13b_layer_train_tokens_per_sec" if on_tpu
+          else "llama_tiny_layer_cpu_tokens_per_sec",
+          batch * seqlen * iters / dt, "tokens/s", None,
+          flops_per_iter, dt, iters)
+
+
 def bench_resnet50(on_tpu):
     import paddle_tpu as paddle
     import paddle_tpu.nn as nn
@@ -429,13 +472,16 @@ def main():
 
     on_tpu = is_tpu_like()
 
+    import gc
+
     for fn in (bench_chip_ceilings, bench_resnet50, bench_bert, bench_ernie,
                bench_fused_adamw, bench_fused_adamw_trainstep,
-               bench_gpt3_1p3b):
+               bench_llama13b_layer, bench_gpt3_1p3b):
         try:
             fn(on_tpu)
         except Exception as e:  # secondary metrics must not kill the headline
             print(json.dumps({"metric": fn.__name__, "error": str(e)[:200]}))
+        gc.collect()  # big per-bench device state must not leak forward
     bench_gpt(on_tpu)  # headline LAST (tail-parsed by the driver)
 
 

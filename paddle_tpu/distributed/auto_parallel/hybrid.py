@@ -39,12 +39,33 @@ def _ln(x, w, b, eps):
     return (x - mu) / jnp.sqrt(var + eps) * w + b
 
 
+# stacked-key -> eager per-block parameter (single source for extraction,
+# name recording and write-back)
+_BLOCK_LEAVES = (
+    ("ln1_w", lambda b: b.ln_1.weight),
+    ("ln1_b", lambda b: b.ln_1.bias),
+    ("qkv_w", lambda b: b.attn.qkv_proj.weight),
+    ("qkv_b", lambda b: b.attn.qkv_proj.bias),
+    ("out_w", lambda b: b.attn.out_proj.weight),
+    ("out_b", lambda b: b.attn.out_proj.bias),
+    ("ln2_w", lambda b: b.ln_2.weight),
+    ("ln2_b", lambda b: b.ln_2.bias),
+    ("fcin_w", lambda b: b.mlp.fc_in.weight),
+    ("fcin_b", lambda b: b.mlp.fc_in.bias),
+    ("fcout_w", lambda b: b.mlp.fc_out.weight),
+    ("fcout_b", lambda b: b.mlp.fc_out.bias),
+)
+
+
 class GPTHybridPlan:
     """Stacked-parameter view of a GPTForCausalLM for the schedule engine.
 
     Extracts [L, ...] leaves from the eager modules (so initialization is
     IDENTICAL to the dygraph model), provides the megatron block_fn /
     embed_fn / head_fn, and the PartitionSpecs wiring pp + mp."""
+
+    # embedding leaf whose weight doubles as the LM head (None = untied)
+    tied_key = "word"
 
     def __init__(self, model, mp_size: int, pp_axis: str = "pp",
                  mp_axis: str = "mp"):
@@ -84,22 +105,19 @@ class GPTHybridPlan:
         blocks = list(gpt.h)
         self.num_layers = len(blocks)
 
-        def stack(get):
-            return jnp.stack([get(b)._value for b in blocks])
-
         self.stacked = {
-            "ln1_w": stack(lambda b: b.ln_1.weight),
-            "ln1_b": stack(lambda b: b.ln_1.bias),
-            "qkv_w": stack(lambda b: b.attn.qkv_proj.weight),
-            "qkv_b": stack(lambda b: b.attn.qkv_proj.bias),
-            "out_w": stack(lambda b: b.attn.out_proj.weight),
-            "out_b": stack(lambda b: b.attn.out_proj.bias),
-            "ln2_w": stack(lambda b: b.ln_2.weight),
-            "ln2_b": stack(lambda b: b.ln_2.bias),
-            "fcin_w": stack(lambda b: b.mlp.fc_in.weight),
-            "fcin_b": stack(lambda b: b.mlp.fc_in.bias),
-            "fcout_w": stack(lambda b: b.mlp.fc_out.weight),
-            "fcout_b": stack(lambda b: b.mlp.fc_out.bias),
+            key: jnp.stack([get(b)._value for b in blocks])
+            for key, get in _BLOCK_LEAVES
+        }
+        # underlying eager-param names: apply_decay_param_fun keys on them
+        self.embed_names = {
+            "word": emb.word_embeddings.weight.name,
+            "pos": emb.position_embeddings.weight.name,
+        }
+        self.head_names = {"lnf_w": gpt.ln_f.weight.name,
+                           "lnf_b": gpt.ln_f.bias.name}
+        self.stacked_names = {
+            key: [get(b).name for b in blocks] for key, get in _BLOCK_LEAVES
         }
         pp, mp = pp_axis, mp_axis
         self.param_specs = {
@@ -197,21 +215,194 @@ class GPTHybridPlan:
             self.embed_params["pos"])
         put(gpt.ln_f.weight, self.head_params["lnf_w"])
         put(gpt.ln_f.bias, self.head_params["lnf_b"])
-        names = [("ln1_w", lambda b: b.ln_1.weight),
-                 ("ln1_b", lambda b: b.ln_1.bias),
-                 ("qkv_w", lambda b: b.attn.qkv_proj.weight),
-                 ("qkv_b", lambda b: b.attn.qkv_proj.bias),
-                 ("out_w", lambda b: b.attn.out_proj.weight),
-                 ("out_b", lambda b: b.attn.out_proj.bias),
-                 ("ln2_w", lambda b: b.ln_2.weight),
-                 ("ln2_b", lambda b: b.ln_2.bias),
-                 ("fcin_w", lambda b: b.mlp.fc_in.weight),
-                 ("fcin_b", lambda b: b.mlp.fc_in.bias),
-                 ("fcout_w", lambda b: b.mlp.fc_out.weight),
-                 ("fcout_b", lambda b: b.mlp.fc_out.bias)]
-        for key, get in names:
+        for key, get in _BLOCK_LEAVES:
             host = np.asarray(jax.device_get(self.stacked[key]))
             for i, blk in enumerate(self.model.gpt.h):
+                put(get(blk), host[i])
+
+
+def _rms(x, w, eps):
+    """RMSNorm with the same cast order as nn.functional.rms_norm (fp32
+    normalize, cast back, THEN scale)."""
+    xf = x.astype(jnp.float32)
+    var = jnp.mean(jnp.square(xf), axis=-1, keepdims=True)
+    return (xf * jax.lax.rsqrt(var + eps)).astype(x.dtype) * w
+
+
+def _rope_neox(t, base):
+    """Neox-style RoPE on [b, s, h, d], fp32 math, training positions 0..s-1
+    (same numerics as incubate fused_rotary_position_embedding)."""
+    d, s = t.shape[-1], t.shape[1]
+    inv = 1.0 / (base ** (jnp.arange(0, d, 2, dtype=jnp.float32) / d))
+    freqs = jnp.outer(jnp.arange(s, dtype=jnp.float32), inv)
+    emb = jnp.concatenate([freqs, freqs], axis=-1)            # [s, d]
+    sin = jnp.sin(emb)[None, :, None, :]
+    cos = jnp.cos(emb)[None, :, None, :]
+    tf = t.astype(jnp.float32)
+    x1, x2 = jnp.split(tf, 2, axis=-1)
+    rot = jnp.concatenate([-x2, x1], axis=-1)
+    return (tf * cos + rot * sin).astype(t.dtype)
+
+
+# stacked-key -> eager per-block parameter for LlamaDecoderLayer
+_LLAMA_BLOCK_LEAVES = (
+    ("in_w", lambda b: b.input_layernorm.weight),
+    ("q_w", lambda b: b.self_attn.q_proj.weight),
+    ("k_w", lambda b: b.self_attn.k_proj.weight),
+    ("v_w", lambda b: b.self_attn.v_proj.weight),
+    ("o_w", lambda b: b.self_attn.o_proj.weight),
+    ("post_w", lambda b: b.post_attention_layernorm.weight),
+    ("gate_w", lambda b: b.mlp.gate_proj.weight),
+    ("up_w", lambda b: b.mlp.up_proj.weight),
+    ("down_w", lambda b: b.mlp.down_proj.weight),
+)
+
+
+class LlamaHybridPlan:
+    """LlamaForCausalLM through the same one-program dp x mp x pp route
+    (BASELINE.md config #5: PaddleNLP LLaMA-2 pretrain under auto_parallel;
+    reference fixture test/auto_parallel/semi_auto_llama.py).
+
+    RMSNorm + neox RoPE + GQA + SwiGLU block under megatron column/row
+    sharding; untied fused-CE head (tied supported via ``tied_key``)."""
+
+    def __init__(self, model, mp_size: int, pp_axis: str = "pp",
+                 mp_axis: str = "mp"):
+        cfg = model.config
+        assert cfg.num_heads % mp_size == 0, (cfg.num_heads, mp_size)
+        assert cfg.num_key_value_heads % mp_size == 0, (
+            cfg.num_key_value_heads, mp_size)
+        assert cfg.hidden_size % cfg.num_heads == 0
+        assert cfg.intermediate_size % mp_size == 0, (
+            cfg.intermediate_size, mp_size)
+        self.model = model
+        self.cfg = cfg
+        self.mp = mp_size
+        self.pp_axis, self.mp_axis = pp_axis, mp_axis
+        self.eps = cfg.rms_norm_eps
+        self.tied_key = "word" if cfg.tie_word_embeddings else None
+        self.loss_num_chunks = next(
+            c for c in (8, 4, 2, 1) if cfg.vocab_size % c == 0)
+
+        lm = model.llama
+        self.embed_params = {"word": lm.embed_tokens.weight._value.copy()}
+        self.embed_names = {"word": lm.embed_tokens.weight.name}
+        self.head_params = {"norm_w": lm.norm.weight._value.copy()}
+        self.head_names = {"norm_w": lm.norm.weight.name}
+        if not cfg.tie_word_embeddings:
+            self.head_params["head_w"] = model.lm_head.weight._value.copy()
+            self.head_names["head_w"] = model.lm_head.weight.name
+        blocks = list(lm.layers)
+        self.num_layers = len(blocks)
+        self.stacked = {
+            key: jnp.stack([get(b)._value for b in blocks])
+            for key, get in _LLAMA_BLOCK_LEAVES
+        }
+        self.stacked_names = {
+            key: [get(b).name for b in blocks]
+            for key, get in _LLAMA_BLOCK_LEAVES
+        }
+        pp, mp = pp_axis, mp_axis
+        self.param_specs = {
+            "in_w": P(pp, None),
+            "q_w": P(pp, None, mp), "k_w": P(pp, None, mp),   # column
+            "v_w": P(pp, None, mp),
+            "o_w": P(pp, mp, None),                           # row
+            "post_w": P(pp, None),
+            "gate_w": P(pp, None, mp), "up_w": P(pp, None, mp),
+            "down_w": P(pp, mp, None),
+        }
+        self.head_specs = {k: P() for k in self.head_params}
+        if self.tied_key:
+            self.head_specs["word"] = P()
+
+    # ------------------------------------------------------------ functions
+
+    def embed_fn(self, ep, ids):
+        return ep["word"][ids]
+
+    def block_fn(self, p, h):
+        """One LLaMA decoder layer; column/row weights are LOCAL mp shards
+        with the megatron f/g pair (GQA heads shard contiguously, so the
+        local kv repeat equals the global head mapping)."""
+        from paddle_tpu.distributed.fleet.mp_ops import mp_identity, mp_reduce
+
+        cfg, mp = self.cfg, self.mp
+        nh = cfg.num_heads // mp
+        nkv = cfg.num_key_value_heads // mp
+        hd = cfg.hidden_size // cfg.num_heads
+        ax = self.mp_axis
+
+        a = _rms(h, p["in_w"], self.eps)
+        a = mp_identity(a, ax) if mp > 1 else a
+        b_, s_, _ = a.shape
+        q = (a @ p["q_w"]).reshape(b_, s_, nh, hd)
+        k = (a @ p["k_w"]).reshape(b_, s_, nkv, hd)
+        v = (a @ p["v_w"]).reshape(b_, s_, nkv, hd)
+        q = _rope_neox(q, cfg.rope_base)
+        k = _rope_neox(k, cfg.rope_base)
+        if nkv != nh:
+            rep = nh // nkv
+            k = jnp.repeat(k, rep, axis=2)
+            v = jnp.repeat(v, rep, axis=2)
+        scale = 1.0 / math.sqrt(hd)
+        logits = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                            k.astype(jnp.float32)) * scale
+        mask = jnp.tril(jnp.ones((s_, s_), bool))
+        logits = jnp.where(mask[None, None], logits, -jnp.inf)
+        probs = jax.nn.softmax(logits, axis=-1).astype(v.dtype)
+        attn = jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+        out = attn.reshape(b_, s_, nh * hd) @ p["o_w"]
+        out = mp_reduce(out, ax) if mp > 1 else out
+        h = h + out
+
+        m = _rms(h, p["post_w"], self.eps)
+        m = mp_identity(m, ax) if mp > 1 else m
+        hidden = jax.nn.silu(m @ p["gate_w"]) * (m @ p["up_w"])
+        mo = hidden @ p["down_w"]
+        mo = mp_reduce(mo, ax) if mp > 1 else mo
+        return h + mo
+
+    def head_fn(self, h, y, hp):
+        from paddle_tpu.incubate.nn.functional.fused_linear_ce import (
+            fused_linear_cross_entropy,
+        )
+
+        h = _rms(h, hp["norm_w"], self.eps)
+        # fused CE wants [V, D]; the untied lm_head stores [D, V] (paddle
+        # Linear layout) — the transpose fuses into the chunked matmul
+        w = hp["word"] if self.tied_key else hp["head_w"].T
+        d = h.shape[-1]
+        return fused_linear_cross_entropy(
+            h.reshape(-1, d), w, y.reshape(-1), self.loss_num_chunks)
+
+    # ----------------------------------------------------------- residency
+
+    def shard_params(self, mesh: Mesh):
+        self.stacked = {
+            k: jax.device_put(v, NamedSharding(mesh, self.param_specs[k]))
+            for k, v in self.stacked.items()
+        }
+        rep = NamedSharding(mesh, P())
+        self.embed_params = {k: jax.device_put(v, rep)
+                             for k, v in self.embed_params.items()}
+        self.head_params = {k: jax.device_put(v, rep)
+                            for k, v in self.head_params.items()}
+
+    def write_back(self):
+        lm = self.model.llama
+
+        def put(param, val):
+            param._replace_value(jnp.asarray(np.asarray(jax.device_get(val)),
+                                             param._value.dtype))
+
+        put(lm.embed_tokens.weight, self.embed_params["word"])
+        put(lm.norm.weight, self.head_params["norm_w"])
+        if not self.cfg.tie_word_embeddings:
+            put(self.model.lm_head.weight, self.head_params["head_w"])
+        for key, get in _LLAMA_BLOCK_LEAVES:
+            host = np.asarray(jax.device_get(self.stacked[key]))
+            for i, blk in enumerate(lm.layers):
                 put(get(blk), host[i])
 
 
@@ -236,7 +427,12 @@ class HybridTrainStep:
         mp = mesh.shape[mp_axis] if mp_axis in mesh.shape else 1
         assert model.config.num_layers % S == 0, \
             (model.config.num_layers, S)
-        self.plan = GPTHybridPlan(model, mp, pp_axis, mp_axis)
+        # the model supplies its plan (GPT -> GPTHybridPlan,
+        # LLaMA -> LlamaHybridPlan); legacy direct use falls back to GPT
+        if hasattr(model, "hybrid_parallel_plan"):
+            self.plan = model.hybrid_parallel_plan(mp, pp_axis, mp_axis)
+        else:
+            self.plan = GPTHybridPlan(model, mp, pp_axis, mp_axis)
         self.plan.shard_params(mesh)
         self.mesh = mesh
         self.pp_axis, self.mp_axis, self.dp_axis = pp_axis, mp_axis, dp_axis
@@ -247,22 +443,47 @@ class HybridTrainStep:
         self._beta1 = optimizer._beta1
         self._beta2 = optimizer._beta2
         self._eps = optimizer._epsilon
-        # fail LOUDLY on optimizer settings this route does not apply —
-        # silently dropping a grad clip / decay filter would train a
-        # different model than the dygraph path
-        if getattr(optimizer, "_grad_clip", None) is not None:
+        # optimizer settings this route cannot honor still fail LOUDLY —
+        # silently dropping them would train a different model than the
+        # dygraph path
+        from paddle_tpu.nn.clip import (
+            ClipGradByGlobalNorm, ClipGradByNorm, ClipGradByValue,
+        )
+
+        clip = getattr(optimizer, "_grad_clip", None)
+        if clip is not None and not isinstance(
+                clip, (ClipGradByGlobalNorm, ClipGradByNorm,
+                       ClipGradByValue)):
             raise NotImplementedError(
-                "HybridTrainStep does not apply grad_clip yet; use the "
-                "dygraph TrainStep or drop the clip")
-        if getattr(optimizer, "_apply_decay_param_fun", None) is not None:
-            raise NotImplementedError(
-                "HybridTrainStep applies uniform weight decay; "
-                "apply_decay_param_fun is not supported on this route")
+                f"HybridTrainStep supports the built-in grad clips, "
+                f"got {type(clip).__name__}")
+        self._clip = clip
         wd = optimizer._weight_decay
         if wd is not None and not isinstance(wd, (int, float)):
             raise NotImplementedError(
                 "HybridTrainStep needs a scalar weight_decay")
         self._wd = float(wd or 0.0)
+        # apply_decay_param_fun filters decay per PARAM NAME; stacked [L,...]
+        # leaves share one update, so the filter must agree across layers
+        decay_fun = getattr(optimizer, "_apply_decay_param_fun", None)
+
+        def wd_for(name):
+            if decay_fun is not None and not decay_fun(name):
+                return 0.0
+            return self._wd
+
+        plan = self.plan
+        self._wd_e = {k: wd_for(n) for k, n in plan.embed_names.items()}
+        self._wd_h = {k: wd_for(n) for k, n in plan.head_names.items()}
+        self._wd_s = {}
+        for k, layer_names in plan.stacked_names.items():
+            per_layer = {wd_for(n) for n in layer_names}
+            if len(per_layer) > 1:
+                raise NotImplementedError(
+                    f"apply_decay_param_fun disagrees across layers for "
+                    f"stacked leaf {k!r}; the hybrid route updates all "
+                    f"layers of a leaf with one decay setting")
+            self._wd_s[k] = per_layer.pop()
         self._moment_dtype = getattr(optimizer, "_moment_dtype", None)
 
         mdt = self._moment_dtype
@@ -282,7 +503,7 @@ class HybridTrainStep:
         self._jitted = {}  # dp_axis_eff -> compiled step
         self._dirty = False  # trained since last sync_model()
 
-    def _adamw(self, p, g, m, v, step, lr):
+    def _adamw(self, p, g, m, v, step, lr, wd=None):
         from paddle_tpu.optimizer.optimizer import _adamw_update
 
         p_new, m_new, v_new = _adamw_update(
@@ -291,7 +512,7 @@ class HybridTrainStep:
             jnp.asarray(self._beta1, p.dtype),
             jnp.asarray(self._beta2, p.dtype),
             jnp.asarray(self._eps, p.dtype),
-            jnp.asarray(self._wd, p.dtype))
+            jnp.asarray(self._wd if wd is None else wd, p.dtype))
         return p_new, m_new.astype(m.dtype), v_new.astype(v.dtype)
 
     def _build(self, dp_axis_eff):
@@ -301,9 +522,13 @@ class HybridTrainStep:
 
         plan = self.plan
 
+        tk = getattr(plan, "tied_key", None)
+
         def step(ep, sp, hp, opt_state, x, y, lr):
             h0 = plan.embed_fn(ep, x)
-            hp_full = dict(hp, word=ep["word"])  # tied head, spliced in-jit
+            # tied head: the embedding leaf doubles as the LM head weight,
+            # spliced in-jit so the buffers never alias across donation
+            hp_full = dict(hp, **{tk: ep[tk]}) if tk else hp
             loss, sg, hg, dh0 = schedule_pipeline_grads(
                 plan.block_fn, plan.head_fn, sp, h0, y,
                 mesh=self.mesh, schedule=self.schedule, axis=self.pp_axis,
@@ -312,9 +537,36 @@ class HybridTrainStep:
                 return_x_grad=True)
             _, evjp = jax.vjp(lambda e: plan.embed_fn(e, x), ep)
             (eg,) = evjp(dh0)
-            # tied head: embedding-word grads come from BOTH the lookup and
-            # the last stage's logits matmul
-            eg = dict(eg, word=eg["word"] + hg["word"])
+            if tk:
+                # tied grads: lookup path + last stage's logits matmul
+                eg = dict(eg, **{tk: eg[tk] + hg[tk]})
+
+            if self._clip is not None:
+                from paddle_tpu.nn.clip import ClipGradByNorm
+
+                if isinstance(self._clip, ClipGradByNorm):
+                    # per-TENSOR norms: a stacked [L, ...] leaf is L dygraph
+                    # params, so clip per layer (vmap over the layer axis)
+                    one = lambda g: self._clip._clip_arrays([g])[0]
+                    eg = {k: one(g) for k, g in eg.items()}
+                    sg = {k: jax.vmap(one)(g) for k, g in sg.items()}
+                    hg = {k: (one(g) if k in hp else g)
+                          for k, g in hg.items()}
+                else:
+                    # one flat pass over the SAME per-param grad set the
+                    # dygraph path clips (tied word appears once, in eg), so
+                    # a global-norm clip matches dygraph exactly; ByValue is
+                    # elementwise so grouping is immaterial
+                    e_keys = sorted(eg)
+                    s_keys = sorted(sg)
+                    h_keys = sorted(k for k in hg if k in hp)
+                    flat = ([eg[k] for k in e_keys] + [sg[k] for k in s_keys]
+                            + [hg[k] for k in h_keys])
+                    flat = self._clip._clip_arrays(flat)
+                    n_e, n_s = len(e_keys), len(s_keys)
+                    eg = dict(zip(e_keys, flat[:n_e]))
+                    sg = dict(zip(s_keys, flat[n_e:n_e + n_s]))
+                    hg = dict(hg, **dict(zip(h_keys, flat[n_e + n_s:])))
 
             nstep = opt_state["step"] + 1
             new_ep, new_ms, new_vs = {}, {}, {}
@@ -322,19 +574,19 @@ class HybridTrainStep:
             for k in ep:
                 ep_k, m_k, v_k = self._adamw(
                     ep[k], eg[k], opt_state["m_e"][k], opt_state["v_e"][k],
-                    nstep, lr)
+                    nstep, lr, self._wd_e[k])
                 new_ep[k], m_e[k], v_e[k] = ep_k, m_k, v_k
             new_sp, m_s, v_s = {}, {}, {}
             for k in sp:
                 sp_k, m_k, v_k = self._adamw(
                     sp[k], sg[k], opt_state["m_s"][k], opt_state["v_s"][k],
-                    nstep, lr)
+                    nstep, lr, self._wd_s[k])
                 new_sp[k], m_s[k], v_s[k] = sp_k, m_k, v_k
             new_hp, m_h, v_h = {}, {}, {}
             for k in hp:
                 hp_k, m_k, v_k = self._adamw(
                     hp[k], hg[k], opt_state["m_h"][k], opt_state["v_h"][k],
-                    nstep, lr)
+                    nstep, lr, self._wd_h[k])
                 new_hp[k], m_h[k], v_h[k] = hp_k, m_k, v_k
             new_state = {"m_e": m_e, "v_e": v_e, "m_s": m_s, "v_s": v_s,
                          "m_h": m_h, "v_h": v_h, "step": nstep}

@@ -53,6 +53,11 @@ def _child_env(args, local_rank: int, world_size: int, global_rank: int,
     if args.nproc_per_node > 1:
         # CPU multi-process testing: give each child its own device slice
         env.setdefault("JAX_PLATFORMS", "cpu")
+    if env.get("JAX_PLATFORMS", "").startswith("cpu"):
+        # a CPU child must never touch the TPU tunnel: the axon
+        # sitecustomize would rebind jax to the tunnel in the fresh
+        # interpreter even against JAX_PLATFORMS=cpu (NOTES_r4 gotcha)
+        env.pop("PALLAS_AXON_POOL_IPS", None)
     return env
 
 

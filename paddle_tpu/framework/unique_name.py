@@ -1,0 +1,67 @@
+"""Unique-name generation (parity: python/paddle/base/unique_name.py:22-130).
+
+Every ``Parameter`` gets a process-unique ``.name`` at creation (the
+reference's ``EagerParamBase`` does the same via
+``unique_name.generate("_eager_param_base")``, framework.py:7629), which is
+what ``apply_decay_param_fun`` / parameter-group APIs key on. ``switch`` /
+``guard`` reset or scope the counters the way the reference does.
+"""
+
+from __future__ import annotations
+
+import collections
+from contextlib import contextmanager
+
+
+class UniqueNameGenerator:
+    def __init__(self, prefix: str | None = None):
+        self.ids = collections.defaultdict(int)
+        self.prefix = prefix or ""
+
+    def __call__(self, key: str) -> str:
+        return self.generate(key)
+
+    def generate(self, key: str) -> str:
+        n = self.ids[key]
+        self.ids[key] += 1
+        return f"{self.prefix}{key}_{n}"
+
+    def clone(self) -> "UniqueNameGenerator":
+        ret = UniqueNameGenerator(self.prefix)
+        ret.ids = collections.defaultdict(int, self.ids)
+        return ret
+
+
+generator = UniqueNameGenerator()
+
+
+def generate(key: str) -> str:
+    """fc -> fc_0, fc_1, ... (process-wide counters, one per key)."""
+    return generator(key)
+
+
+# dygraph has no ignorable-key distinction here: one compiled-trace world
+generate_with_ignorable_key = generate
+
+
+def switch(new_generator: UniqueNameGenerator | None = None):
+    """Replace the global generator, returning the old one."""
+    global generator
+    old = generator
+    generator = new_generator if new_generator is not None \
+        else UniqueNameGenerator()
+    return old
+
+
+@contextmanager
+def guard(new_generator=None):
+    """Scope a fresh (or prefixed, when given a str) generator."""
+    if isinstance(new_generator, (str, bytes)):
+        if isinstance(new_generator, bytes):
+            new_generator = new_generator.decode()
+        new_generator = UniqueNameGenerator(new_generator)
+    old = switch(new_generator)
+    try:
+        yield
+    finally:
+        switch(old)

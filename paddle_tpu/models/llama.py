@@ -237,5 +237,15 @@ class LlamaForCausalLM(nn.Layer):
                                 max_new_tokens, temperature, top_k,
                                 eos_token_id, seed)
 
+    def hybrid_parallel_plan(self, mp_size, pp_axis="pp", mp_axis="mp"):
+        """One-program dp x mp x pp Engine route (BASELINE.md config #5:
+        LLaMA-2 pretrain under auto_parallel; reference
+        test/auto_parallel/semi_auto_llama.py)."""
+        from paddle_tpu.distributed.auto_parallel.hybrid import (
+            LlamaHybridPlan,
+        )
+
+        return LlamaHybridPlan(self, mp_size, pp_axis, mp_axis)
+
 
 LlamaPretrainingCriterion = GPTPretrainingCriterion

@@ -351,7 +351,12 @@ class Parameter(Tensor):
         super().__init__(data, dtype=dtype, stop_gradient=not trainable)
         self.trainable = trainable
         self.persistable = True
-        self.name = name
+        # every param gets a process-unique name (reference EagerParamBase,
+        # framework.py:7629) — apply_decay_param_fun and param groups key
+        # on it, so colliding empty names would silently merge params
+        from paddle_tpu.framework import unique_name
+
+        self.name = name or unique_name.generate("_eager_param_base")
 
     @classmethod
     def _from_value(cls, value):

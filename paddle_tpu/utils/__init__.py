@@ -1,3 +1,49 @@
-"""paddle.utils parity."""
+"""paddle.utils parity (reference python/paddle/utils/__init__.py:15-57)."""
 
-from paddle_tpu.utils import cpp_extension  # noqa: F401
+from __future__ import annotations
+
+import re
+
+from paddle_tpu.utils import cpp_extension, unique_name  # noqa: F401
+from paddle_tpu.utils.deprecated import deprecated  # noqa: F401
+from paddle_tpu.utils.install_check import run_check  # noqa: F401
+from paddle_tpu.utils.lazy_import import try_import  # noqa: F401
+
+__all__ = ["deprecated", "run_check", "require_version", "try_import"]
+
+
+def _version_tuple(v: str, what: str):
+    if not re.fullmatch(r"\d+(\.\d+){0,3}", v):
+        raise ValueError(
+            f"The value of {what} in require_version must be in format "
+            f"like '1.4' or '1.4.0', but received {v!r}.")
+    parts = [int(x) for x in v.split(".")]
+    return tuple(parts + [0] * (4 - len(parts)))
+
+
+def require_version(min_version: str, max_version: str | None = None) -> None:
+    """Raise unless installed version is within [min_version, max_version]
+    (parity: python/paddle/base/framework.py:519)."""
+    import paddle_tpu
+
+    if not isinstance(min_version, str):
+        raise TypeError(
+            f"The type of 'min_version' in require_version must be str, "
+            f"but received {type(min_version)}.")
+    if not isinstance(max_version, (str, type(None))):
+        raise TypeError(
+            f"The type of 'max_version' in require_version must be str or "
+            f"type(None), but received {type(max_version)}.")
+    installed = _version_tuple(
+        re.sub(r"[^0-9.].*$", "", paddle_tpu.__version__), "__version__")
+    lo = _version_tuple(min_version, "'min_version'")
+    if installed < lo:
+        raise Exception(
+            f"PaddlePaddle version {paddle_tpu.__version__} is installed, "
+            f"but version >= {min_version} is required.")
+    if max_version is not None:
+        hi = _version_tuple(max_version, "'max_version'")
+        if installed > hi:
+            raise Exception(
+                f"PaddlePaddle version {paddle_tpu.__version__} is "
+                f"installed, but version <= {max_version} is required.")

@@ -1,0 +1,43 @@
+"""paddle.utils.deprecated (parity: python/paddle/utils/deprecated.py).
+
+Decorator that marks an API deprecated: appends a note to the docstring and
+emits a DeprecationWarning once per call site category.
+"""
+
+from __future__ import annotations
+
+import functools
+import warnings
+
+
+def deprecated(update_to: str = "", since: str = "", reason: str = "",
+               level: int = 1):
+    """Decorate an API as deprecated.
+
+    level 0: no warning; 1: warn on call; 2: raise on call.
+    """
+
+    def decorator(func):
+        msg = f"API \"{func.__module__}.{func.__name__}\" is deprecated"
+        if since:
+            msg += f" since {since}"
+        if update_to:
+            msg += f", and will be removed in future versions. Please use "\
+                   f"\"{update_to}\" instead"
+        if reason:
+            msg += f". Reason: {reason}"
+        note = f"\n\n.. warning:: {msg}\n"
+        func.__doc__ = (func.__doc__ or "") + note
+
+        @functools.wraps(func)
+        def wrapper(*args, **kwargs):
+            if level == 2:
+                raise RuntimeError(
+                    f"{msg}. This API is removed at this level.")
+            if level == 1:
+                warnings.warn(msg, DeprecationWarning, stacklevel=2)
+            return func(*args, **kwargs)
+
+        return wrapper
+
+    return decorator

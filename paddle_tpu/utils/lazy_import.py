@@ -1,0 +1,22 @@
+"""paddle.utils.try_import (parity: python/paddle/utils/lazy_import.py)."""
+
+from __future__ import annotations
+
+import importlib
+
+
+def try_import(module_name: str, err_msg: str | None = None):
+    """Import a module, raising an informative ImportError on failure."""
+    install_name = module_name.split(".")[0]
+    if module_name == "cv2":
+        install_name = "opencv-python"
+    try:
+        return importlib.import_module(module_name)
+    except ImportError:
+        if err_msg is None:
+            err_msg = (
+                f"Failed importing {module_name}. This likely means that "
+                f"some paddle modules require additional dependencies that "
+                f"have to be manually installed (usually with "
+                f"`pip install {install_name}`). ")
+        raise ImportError(err_msg)

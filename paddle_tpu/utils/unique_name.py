@@ -1,0 +1,10 @@
+"""paddle.utils.unique_name (parity: python/paddle/utils/unique_name.py)."""
+
+from paddle_tpu.framework.unique_name import (  # noqa: F401
+    generate,
+    generate_with_ignorable_key,
+    guard,
+    switch,
+)
+
+__all__ = ["generate", "switch", "guard"]

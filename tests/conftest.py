@@ -7,6 +7,12 @@ on a fake device (test/custom_runtime/test_collective_process_group_xccl.py).
 import os
 
 os.environ["JAX_PLATFORMS"] = "cpu"
+# The axon tunnel plugin's sitecustomize binds jax to the tunnel in any
+# FRESH interpreter whose env carries PALLAS_AXON_POOL_IPS — even with
+# JAX_PLATFORMS=cpu (NOTES_r4 container gotcha). The CPU tier (and every
+# subprocess it spawns: launcher drills, multihost workers, trial runners)
+# must not depend on tunnel liveness, so drop it from the inherited env.
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
 # Semantics tests want exact math; the session default emulates TPU bf16 matmul.
 os.environ.setdefault("JAX_DEFAULT_MATMUL_PRECISION", "highest")
 flags = os.environ.get("XLA_FLAGS", "")

@@ -1,0 +1,111 @@
+"""paddle.utils surface: unique_name, deprecated, require_version,
+try_import, run_check (reference python/paddle/utils/__init__.py:15-57),
+and the Parameter auto-naming they enable (EagerParamBase parity,
+base/framework.py:7629)."""
+
+import warnings
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.utils import (
+    deprecated,
+    require_version,
+    run_check,
+    try_import,
+    unique_name,
+)
+
+
+def test_unique_name_generate_and_guard():
+    with unique_name.guard():
+        assert unique_name.generate("fc") == "fc_0"
+        assert unique_name.generate("fc") == "fc_1"
+        assert unique_name.generate("conv") == "conv_0"
+        with unique_name.guard("prefix_"):
+            assert unique_name.generate("fc") == "prefix_fc_0"
+        # inner guard scoped away: outer counters resume
+        assert unique_name.generate("fc") == "fc_2"
+
+
+def test_unique_name_switch_roundtrip():
+    old = unique_name.switch()
+    try:
+        a = unique_name.generate("x")
+        assert a == "x_0"
+    finally:
+        unique_name.switch(old)
+
+
+def test_parameters_auto_named_and_distinct():
+    m = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+    names = [p.name for p in m.parameters()]
+    assert all(names), names
+    assert len(set(names)) == len(names), names
+
+
+def test_param_attr_name_still_wins():
+    from paddle_tpu.nn.param_attr import ParamAttr
+
+    lin = nn.Linear(3, 3, weight_attr=ParamAttr(name="my_weight"))
+    assert lin.weight.name == "my_weight"
+
+
+def test_apply_decay_param_fun_keyed_on_names():
+    m = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+    decay = {p.name for p in m.parameters() if p.ndim > 1}
+    opt = paddle.optimizer.AdamW(
+        parameters=m.parameters(), weight_decay=0.1,
+        apply_decay_param_fun=lambda n: n in decay)
+    assert opt._decay_for(m[0].weight) == 0.1
+    assert opt._decay_for(m[0].bias) == 0.0
+
+
+def test_deprecated_decorator_warns_and_annotates():
+    @deprecated(update_to="paddle.new_api", since="2.0", reason="renamed")
+    def legacy(x):
+        """Original doc."""
+        return x + 1
+
+    assert "deprecated" in legacy.__doc__
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        assert legacy(1) == 2
+    assert any(issubclass(x.category, DeprecationWarning) for x in w)
+
+
+def test_deprecated_level2_raises():
+    @deprecated(level=2)
+    def gone():
+        pass
+
+    with pytest.raises(RuntimeError):
+        gone()
+
+
+def test_require_version():
+    require_version("0.0.1")
+    require_version("0.0.1", "99.0")
+    with pytest.raises(Exception, match="required"):
+        require_version("99.0")
+    with pytest.raises(TypeError):
+        require_version(1)
+    with pytest.raises(ValueError):
+        require_version("not-a-version")
+
+
+def test_try_import():
+    assert try_import("numpy") is np
+    with pytest.raises(ImportError, match="pip install"):
+        try_import("definitely_not_a_module_xyz")
+
+
+def test_run_check_multi_device(capsys):
+    run_check()
+    out = capsys.readouterr().out
+    assert "works well on 1" in out
+    # conftest forces 8 virtual devices: the DP check must have run
+    assert "8" in out
+    assert "installed successfully" in out
