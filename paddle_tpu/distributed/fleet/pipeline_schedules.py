@@ -45,6 +45,21 @@ _OP_COST = {IDLE: 1.0, F_OP: 1.0, B_OP: 2.0, W_OP: 1.0}
 # (zero-bubble) schedule B=dgrad and W=wgrad each cost ~1.
 
 
+def _peak_in_flight(op: np.ndarray, num_stages: int, num_ticks: int) -> int:
+    """Activation-memory high-water mark: max count of microbatches with F
+    done but B pending on any one device column of the [T, S] op table."""
+    peak = 0
+    for s in range(num_stages):
+        live = 0
+        for t in range(num_ticks):
+            if op[t, s] == F_OP:
+                live += 1
+            elif op[t, s] == B_OP:
+                live -= 1
+            peak = max(peak, live)
+    return peak
+
+
 @dataclasses.dataclass
 class PipelineSchedule:
     """Static schedule table + stats."""
@@ -78,16 +93,7 @@ class PipelineSchedule:
     def peak_in_flight(self) -> int:
         """Max number of microbatches with F done but B not yet done on any
         stage — the activation-memory high-water mark (1F1B < FThenB)."""
-        peak = 0
-        for s in range(self.num_stages):
-            live = 0
-            for t in range(self.num_ticks):
-                if self.op[t, s] == F_OP:
-                    live += 1
-                elif self.op[t, s] == B_OP:
-                    live -= 1
-                peak = max(peak, live)
-        return peak
+        return _peak_in_flight(self.op, self.num_stages, self.num_ticks)
 
 
 def make_pipeline_schedule(num_stages: int, num_microbatches: int,
@@ -739,3 +745,402 @@ def gpipe_tick_units(S: int, M: int, V: int = 1) -> int:
 def vpp_tick_units(S: int, M: int, V: int) -> int:
     """Interleaved forward wall-clock in layer-units."""
     return M * V + S - 1
+
+
+# ---------------------------------------------------------------------------
+# ZB-V: zero-bubble with TWO chunks per device in a V placement
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class ZBVSchedule:
+    """Static two-chunk schedule: op/chunk/slot per (tick, device).
+
+    Virtual stage v lives on device v (chunk 0) for v < S, and on device
+    2S-1-v (chunk 1) otherwise — the "V" placement of the reference's
+    zero-bubble pass family (pipeline_scheduler_pass/pipeline_zero_bubble.py):
+    a microbatch descends the device line, turns around on the LAST device,
+    and ascends back, so the loss stage sits on device 0 and every device
+    holds one early + one late virtual stage (balanced activation memory)."""
+
+    num_stages: int
+    num_microbatches: int
+    op: np.ndarray     # [T, S] opcodes (IDLE/F/B/W)
+    chunk: np.ndarray  # [T, S] chunk index (0/1) of the op
+    slot: np.ndarray   # [T, S] microbatch index
+
+    @property
+    def num_ticks(self) -> int:
+        return self.op.shape[0]
+
+    def wall_units(self) -> float:
+        """Lock-step wall with split-B/W costs (F=1, B=1, W=1)."""
+        return float(self.num_ticks)
+
+    def peak_in_flight(self) -> int:
+        """Max microbatches with F done but B pending, summed over a
+        device's two chunks (ZB-V's memory claim: same peak as 1F1B)."""
+        return _peak_in_flight(self.op, self.num_stages, self.num_ticks)
+
+
+def make_zbv_schedule(num_stages: int, num_microbatches: int,
+                      mem_cap: Optional[int] = None) -> ZBVSchedule:
+    """Greedy list scheduling over 2S virtual stages in the V placement.
+
+    Split B/W (B = dgrad only, W = wgrad backfill) with priorities
+    B > F > W per device per tick, deeper virtual stages first (finish
+    microbatches before admitting new ones). Only chunk-0 F — ADMISSION of
+    a new microbatch into the device — is memory-capped (default S):
+    chunk-1 F moves a microbatch toward its B and must never be blocked
+    (capping it deadlocks the drain). Per-device in-flight peaks at
+    ~cap + 2 — the 1F1B class, not the 2S of naively stacked chunks.
+    Messages produced at tick t are consumable from t+1 (one ppermute
+    hop; chunk turnarounds on device S-1 / device 0 are local but obey
+    the same latency for uniformity)."""
+    S, M = num_stages, num_microbatches
+    V = 2 * S
+    cap = mem_cap if mem_cap is not None else S
+    f_done = [[-1] * M for _ in range(V)]
+    b_done = [[-1] * M for _ in range(V)]
+    w_queue: List[List[int]] = [[] for _ in range(V)]
+    next_f = [0] * V
+    next_b = [0] * V
+    rows = []
+    t = 0
+    while (any(next_b[v] < M for v in range(V))
+           or any(w_queue[v] for v in range(V))):
+        row = []
+        for d in range(S):
+            vstages = (d, 2 * S - 1 - d)   # chunk 0, chunk 1
+            infl = sum(next_f[v] - next_b[v] for v in vstages)
+            chosen = None
+            # B first, deeper virtual stage first (keeps the dgrad chain —
+            # the critical path — moving)
+            for v in sorted(vstages, reverse=True):
+                m = next_b[v]
+                if (m < M and f_done[v][m] >= 0
+                        and (v == V - 1
+                             or (0 <= b_done[v + 1][m] < t))):
+                    chosen = (B_OP, v, m)
+                    break
+            if chosen is None:
+                # F next, deeper virtual stage first; only chunk-0 F
+                # (admission) is memory-capped
+                for v in sorted(vstages, reverse=True):
+                    m = next_f[v]
+                    if (m < M and (v >= S or infl < cap)
+                            and (v == 0 or (0 <= f_done[v - 1][m] < t))):
+                        chosen = (F_OP, v, m)
+                        break
+            if chosen is None:
+                # W backfill, oldest pending first, late chunk first
+                for v in sorted(vstages, reverse=True):
+                    if w_queue[v]:
+                        chosen = (W_OP, v, w_queue[v].pop(0))
+                        break
+            if chosen is None:
+                row.append((IDLE, 0, 0))
+                continue
+            op, v, m = chosen
+            if op == F_OP:
+                f_done[v][m] = t
+                next_f[v] += 1
+            elif op == B_OP:
+                b_done[v][m] = t
+                next_b[v] += 1
+                w_queue[v].append(m)
+            row.append((op, 0 if v < S else 1, m))
+        rows.append(row)
+        t += 1
+        if t > 40 * (M + S) * 3:
+            raise RuntimeError("ZB-V schedule simulation did not converge")
+
+    return ZBVSchedule(
+        num_stages=S, num_microbatches=M,
+        op=np.asarray([[o for o, _, _ in r] for r in rows], np.int32),
+        chunk=np.asarray([[c for _, c, _ in r] for r in rows], np.int32),
+        slot=np.asarray([[m for _, _, m in r] for r in rows], np.int32))
+
+
+def zbv_params(layer_params: Any, num_stages: int):
+    """Permute [L, ...] stacked params into ZB-V device layout: device d's
+    P(axis) shard holds [vstage d's layers, vstage 2S-1-d's layers]."""
+    S = num_stages
+
+    def permute(a):
+        L = a.shape[0]
+        lpc = L // (2 * S)
+        blocks = a.reshape(2 * S, lpc, *a.shape[1:])
+        order = []
+        for d in range(S):
+            order.extend([d, 2 * S - 1 - d])
+        return jnp.concatenate([blocks[v] for v in order], axis=0)
+
+    return jax.tree_util.tree_map(permute, layer_params)
+
+
+def zbv_unpermute(grads: Any, num_stages: int):
+    """Inverse of zbv_params: ZB-V device layout back to layer order."""
+    S = num_stages
+
+    def invert(a):
+        L = a.shape[0]
+        lpc = L // (2 * S)
+        blocks = a.reshape(2 * S, lpc, *a.shape[1:])
+        inv = [0] * (2 * S)
+        pos = 0
+        for d in range(S):
+            inv[d] = pos
+            inv[2 * S - 1 - d] = pos + 1
+            pos += 2
+        return jnp.concatenate([blocks[inv[v]] for v in range(2 * S)],
+                               axis=0)
+
+    return jax.tree_util.tree_map(invert, grads)
+
+
+def schedule_pipeline_grads_zbv(
+    block_fn: Callable[[Any, jax.Array], jax.Array],
+    loss_fn: Callable[[jax.Array, jax.Array], jax.Array],
+    layer_params: Any,
+    x: jax.Array,
+    y: jax.Array,
+    *,
+    mesh: Mesh,
+    schedule: ZBVSchedule,
+    axis: str = "pp",
+):
+    """Execute a ZB-V table: two chunks per device, split B/W, V routing.
+
+    layer_params must be in ``zbv_params`` layout ([L, ...] with device d's
+    shard = [chunk-0 layers, chunk-1 layers]); returned grads use the same
+    layout (``zbv_unpermute`` restores layer order). Loss is the mean over
+    microbatches, computed where the LAST virtual stage lives: device 0,
+    chunk 1 — ZB-V's signature turnaround.
+
+    Message routing per (op, chunk): F0 hops forward (turnaround on device
+    S-1 feeds its own chunk 1 locally), F1 hops backward (device 0 runs
+    the loss instead), B1 hops forward (turnaround on device S-1 feeds its
+    own chunk 0), B0 hops backward (device 0 terminates). One ppermute
+    pair per tick, same as the single-chunk engine.
+    """
+    S = schedule.num_stages
+    M = schedule.num_microbatches
+    assert mesh.shape[axis] == S
+    B = x.shape[0]
+    assert B % M == 0
+    mb = B // M
+
+    leaves = jax.tree_util.tree_leaves(layer_params)
+    L = leaves[0].shape[0]
+    assert L % (2 * S) == 0
+    lpc = L // (2 * S)
+
+    T = schedule.num_ticks
+    # opcode2 = op + 3*chunk for non-idle ops: [idle, f0, b0, w0, f1, b1, w1]
+    op2_tab = jnp.asarray(schedule.op
+                          + 3 * schedule.chunk * (schedule.op > 0))
+    slot_tab = jnp.asarray(schedule.slot)
+
+    # receive tables (deliveries at tick t of messages produced at t-1):
+    #   fwd channel (from device s-1): F0 -> my chunk-0 acts,
+    #                                  B1 -> my chunk-1 gouts
+    #   bwd channel (from device s+1): F1 -> my chunk-1 acts,
+    #                                  B0 -> my chunk-0 gouts
+    # turnaround ops on device S-1 (F0, B1) and terminal ops on device 0
+    # (F1 = loss, B0) are handled locally, never via the ring.
+    rf_act0 = np.zeros((T, S), bool)
+    rf_gout1 = np.zeros((T, S), bool)
+    rb_act1 = np.zeros((T, S), bool)
+    rb_gout0 = np.zeros((T, S), bool)
+    r_slot_f = np.zeros((T, S), np.int32)
+    r_slot_b = np.zeros((T, S), np.int32)
+    for t in range(1, T):
+        for s in range(S):
+            if s > 0:
+                o, c = schedule.op[t - 1, s - 1], schedule.chunk[t - 1, s - 1]
+                if o == F_OP and c == 0:
+                    rf_act0[t, s] = True
+                    r_slot_f[t, s] = schedule.slot[t - 1, s - 1]
+                elif o == B_OP and c == 1:
+                    rf_gout1[t, s] = True
+                    r_slot_f[t, s] = schedule.slot[t - 1, s - 1]
+            if s < S - 1:
+                o, c = schedule.op[t - 1, s + 1], schedule.chunk[t - 1, s + 1]
+                if o == F_OP and c == 1:
+                    rb_act1[t, s] = True
+                    r_slot_b[t, s] = schedule.slot[t - 1, s + 1]
+                elif o == B_OP and c == 0:
+                    rb_gout0[t, s] = True
+                    r_slot_b[t, s] = schedule.slot[t - 1, s + 1]
+    rf_act0 = jnp.asarray(rf_act0)
+    rf_gout1 = jnp.asarray(rf_gout1)
+    rb_act1 = jnp.asarray(rb_act1)
+    rb_gout0 = jnp.asarray(rb_gout0)
+    r_slot_f = jnp.asarray(r_slot_f)
+    r_slot_b = jnp.asarray(r_slot_b)
+
+    fwd_perm = [(i, (i + 1) % S) for i in range(S)]
+    bwd_perm = [((i + 1) % S, i) for i in range(S)]
+
+    def chunk_forward(ck, h):
+        def body(h, p):
+            return block_fn(p, h), None
+
+        h, _ = jax.lax.scan(body, h, ck)
+        return h
+
+    def engine(params_local, x_local, y_local):
+        stage = jax.lax.axis_index(axis)
+        p0 = jax.tree_util.tree_map(lambda a: a[:lpc], params_local)
+        p1 = jax.tree_util.tree_map(lambda a: a[lpc:], params_local)
+        act_shape = (M,) + x_local.shape[1:]
+        zmsg = jnp.zeros(x_local.shape[1:], x_local.dtype)
+
+        state = dict(
+            acts0=jnp.zeros(act_shape, x_local.dtype),
+            acts1=jnp.zeros(act_shape, x_local.dtype),
+            gouts0=jnp.zeros(act_shape, x_local.dtype),
+            gouts1=jnp.zeros(act_shape, x_local.dtype),
+            fmsg=zmsg, bmsg=zmsg,
+            pg0=jax.tree_util.tree_map(jnp.zeros_like, p0),
+            pg1=jax.tree_util.tree_map(jnp.zeros_like, p1),
+            loss=jnp.zeros((), jnp.float32),
+        )
+
+        def do_idle(state, m):
+            return state, zmsg, zmsg
+
+        def do_f0(state, m):
+            h_in = jnp.where(stage == 0,
+                             jax.lax.dynamic_index_in_dim(
+                                 x_local, m, 0, keepdims=False),
+                             jax.lax.dynamic_index_in_dim(
+                                 state["acts0"], m, 0, keepdims=False))
+            acts0 = jax.lax.dynamic_update_index_in_dim(
+                state["acts0"], h_in, m, 0)
+            h_out = chunk_forward(p0, h_in)
+            # turnaround: the last device feeds its own chunk 1
+            acts1 = jax.lax.cond(
+                stage == S - 1,
+                lambda a: jax.lax.dynamic_update_index_in_dim(
+                    a, h_out, m, 0),
+                lambda a: a, state["acts1"])
+            return dict(state, acts0=acts0, acts1=acts1), h_out, zmsg
+
+        def do_f1(state, m):
+            h_in = jax.lax.dynamic_index_in_dim(
+                state["acts1"], m, 0, keepdims=False)
+            h_out = chunk_forward(p1, h_in)
+            y_m = jax.lax.dynamic_index_in_dim(y_local, m, 0, keepdims=False)
+
+            def seed(args):
+                gouts1, loss = args
+                loss_m, lvjp = jax.vjp(lambda hh: loss_fn(hh, y_m), h_out)
+                (g_seed,) = lvjp(jnp.full((), 1.0 / M, loss_m.dtype))
+                gouts1 = jax.lax.dynamic_update_index_in_dim(
+                    gouts1, g_seed.astype(x_local.dtype), m, 0)
+                return gouts1, loss + loss_m.astype(jnp.float32)
+
+            # device 0 hosts the LAST virtual stage: loss + self-seed
+            gouts1, loss = jax.lax.cond(
+                stage == 0, seed, lambda a: a,
+                (state["gouts1"], state["loss"]))
+            return dict(state, gouts1=gouts1, loss=loss), zmsg, h_out
+
+        def do_b0(state, m):
+            h_in = jax.lax.dynamic_index_in_dim(
+                state["acts0"], m, 0, keepdims=False)
+            g_out = jax.lax.dynamic_index_in_dim(
+                state["gouts0"], m, 0, keepdims=False)
+            _, hvjp = jax.vjp(lambda hh: chunk_forward(p0, hh), h_in)
+            (g_in,) = hvjp(g_out)
+            return state, zmsg, g_in
+
+        def do_b1(state, m):
+            h_in = jax.lax.dynamic_index_in_dim(
+                state["acts1"], m, 0, keepdims=False)
+            g_out = jax.lax.dynamic_index_in_dim(
+                state["gouts1"], m, 0, keepdims=False)
+            _, hvjp = jax.vjp(lambda hh: chunk_forward(p1, hh), h_in)
+            (g_in,) = hvjp(g_out)
+            # turnaround: the last device feeds its own chunk 0
+            gouts0 = jax.lax.cond(
+                stage == S - 1,
+                lambda g: jax.lax.dynamic_update_index_in_dim(
+                    g, g_in, m, 0),
+                lambda g: g, state["gouts0"])
+            return dict(state, gouts0=gouts0), g_in, zmsg
+
+        def do_w0(state, m):
+            h_in = jax.lax.dynamic_index_in_dim(
+                state["acts0"], m, 0, keepdims=False)
+            g_out = jax.lax.dynamic_index_in_dim(
+                state["gouts0"], m, 0, keepdims=False)
+            _, pvjp = jax.vjp(lambda pp: chunk_forward(pp, h_in), p0)
+            (gp,) = pvjp(g_out)
+            pg0 = jax.tree_util.tree_map(jnp.add, state["pg0"], gp)
+            return dict(state, pg0=pg0), zmsg, zmsg
+
+        def do_w1(state, m):
+            h_in = jax.lax.dynamic_index_in_dim(
+                state["acts1"], m, 0, keepdims=False)
+            g_out = jax.lax.dynamic_index_in_dim(
+                state["gouts1"], m, 0, keepdims=False)
+            _, pvjp = jax.vjp(lambda pp: chunk_forward(pp, h_in), p1)
+            (gp,) = pvjp(g_out)
+            pg1 = jax.tree_util.tree_map(jnp.add, state["pg1"], gp)
+            return dict(state, pg1=pg1), zmsg, zmsg
+
+        def tick(state, t):
+            # deliver last tick's ring messages into mailboxes
+            sf = r_slot_f[t, stage]
+            sb = r_slot_b[t, stage]
+            acts0 = jax.lax.cond(
+                rf_act0[t, stage],
+                lambda a: jax.lax.dynamic_update_index_in_dim(
+                    a, state["fmsg"], sf, 0),
+                lambda a: a, state["acts0"])
+            gouts1 = jax.lax.cond(
+                rf_gout1[t, stage],
+                lambda g: jax.lax.dynamic_update_index_in_dim(
+                    g, state["fmsg"], sf, 0),
+                lambda g: g, state["gouts1"])
+            acts1 = jax.lax.cond(
+                rb_act1[t, stage],
+                lambda a: jax.lax.dynamic_update_index_in_dim(
+                    a, state["bmsg"], sb, 0),
+                lambda a: a, state["acts1"])
+            gouts0 = jax.lax.cond(
+                rb_gout0[t, stage],
+                lambda g: jax.lax.dynamic_update_index_in_dim(
+                    g, state["bmsg"], sb, 0),
+                lambda g: g, state["gouts0"])
+            state = dict(state, acts0=acts0, acts1=acts1,
+                         gouts0=gouts0, gouts1=gouts1)
+
+            op2 = op2_tab[t, stage]
+            m = slot_tab[t, stage]
+            state, fsend, bsend = jax.lax.switch(
+                op2, [do_idle, do_f0, do_b0, do_w0, do_f1, do_b1, do_w1],
+                state, m)
+            fmsg = jax.lax.ppermute(fsend, axis, fwd_perm)
+            bmsg = jax.lax.ppermute(bsend, axis, bwd_perm)
+            return dict(state, fmsg=fmsg, bmsg=bmsg), None
+
+        state, _ = jax.lax.scan(tick, state, jnp.arange(T))
+
+        loss = jax.lax.psum(state["loss"], axis) / M
+        pgrad = jax.tree_util.tree_map(
+            lambda a, b: jnp.concatenate([a, b], axis=0),
+            state["pg0"], state["pg1"])
+        return loss[None], pgrad
+
+    x_mb = x.reshape(M, mb, *x.shape[1:])
+    y_mb = y.reshape(M, mb, *y.shape[1:])
+    p_specs = jax.tree_util.tree_map(lambda _: P(axis), layer_params)
+    loss_st, grads = shard_map(
+        engine, mesh=mesh, in_specs=(p_specs, P(), P()),
+        out_specs=(P(axis), p_specs), check_rep=False,
+    )(layer_params, x_mb, y_mb)
+    return loss_st[0], grads
