@@ -467,10 +467,66 @@ def bench_chip_ceilings(on_tpu):
     print(json.dumps(out))
 
 
-def main():
-    from paddle_tpu.device import is_tpu_like
+def _probe_backend(timeout_s=180):
+    """Resolve the platform name in a THROWAWAY subprocess with a timeout.
 
-    on_tpu = is_tpu_like()
+    On the tunneled chip a dead tunnel makes jax.devices() hang forever
+    (not raise); probing in-process would hang this whole bench with zero
+    output for the driver to record. The subprocess inherits the same
+    tunnel config, so a DEAD-at-probe-time tunnel is reliably caught; a
+    tunnel that flaps dead between probe exit and the benches' first
+    backend use can still hang the parent — that residual window is
+    accepted (an in-process watchdog can't preempt a hung PJRT call).
+    """
+    import subprocess
+    import sys
+
+    env = dict(os.environ)
+    if env.get("JAX_PLATFORMS", "").startswith("cpu"):
+        # explicit CPU request: tunnel liveness is irrelevant, and the
+        # axon sitecustomize would stall the probe on a dead tunnel
+        env.pop("PALLAS_AXON_POOL_IPS", None)
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; print(jax.devices()[0].platform)"],
+            capture_output=True, text=True, timeout=timeout_s, env=env)
+        lines = r.stdout.strip().splitlines()
+        if r.returncode == 0 and lines:
+            return lines[-1]
+        return None
+    except Exception:
+        # TimeoutExpired, but also OSError/MemoryError spawning the probe:
+        # every probe failure must fall through to the bench_error line —
+        # an uncaught exception here reproduces the zero-output hang this
+        # guard exists to prevent
+        return None
+
+
+def main():
+    # probe BEFORE any paddle_tpu/jax-touching import: import-time device
+    # touches would hang this process on a dead tunnel before the guard runs
+    plat = _probe_backend()
+    if plat is None:
+        print(json.dumps({
+            "metric": "bench_error", "value": 0, "unit": "none",
+            "vs_baseline": None,
+            "error": "device backend unreachable (dead tunnel?) - "
+                     "probe subprocess hung/failed",
+        }))
+        return
+    if plat == "cpu":
+        # pin the PARENT too: the axon sitecustomize may have set the
+        # in-config jax_platforms to "axon,cpu" at interpreter start, in
+        # which case the benches' first backend use would still dial the
+        # tunnel despite the probe having voted cpu (probe env != parent
+        # config). Import alone doesn't init backends, so the pin holds.
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    from paddle_tpu.device import is_tpu_like_platform
+
+    on_tpu = is_tpu_like_platform(plat)
 
     import gc
 

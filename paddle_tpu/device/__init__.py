@@ -16,12 +16,18 @@ import jax
 _TPU_LIKE_PLATFORMS = ("tpu", "axon")
 
 
+def is_tpu_like_platform(name: str) -> bool:
+    """True when a PJRT platform NAME means TPU-class hardware — for
+    callers that resolved the name out-of-process (e.g. bench's probe)."""
+    return name in _TPU_LIKE_PLATFORMS
+
+
 def is_tpu_like(device=None) -> bool:
     """True when the (first) device is TPU-class hardware — the single
     gate for Pallas kernels and TPU-only fast paths."""
     try:
         d = device if device is not None else jax.devices()[0]
-        return d.platform in _TPU_LIKE_PLATFORMS
+        return is_tpu_like_platform(d.platform)
     except Exception:
         return False
 

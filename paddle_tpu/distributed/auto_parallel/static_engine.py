@@ -223,18 +223,20 @@ class DistModel:
             hasattr(layer, "hybrid_parallel_plan") and pp_axis is not None
             and tp_axis is not None and self._mesh is not None)
         if self._is_hybrid:
-            # the hybrid route trains with the plan's own fused
-            # cross-entropy head; a custom loss callable would be silently
-            # ignored — fail loudly unless it IS the standard criterion
+            # standard pretraining criteria ride the plan's fused
+            # (logits-free) cross-entropy head; any OTHER callable routes
+            # through the dense-logits custom head (r4 — same math as the
+            # dygraph criterion, materializes [mb, s, V] at the last stage)
+            # (LlamaPretrainingCriterion is a module-level alias of this
+            # same class — one isinstance covers both model families)
             from paddle_tpu.models import GPTPretrainingCriterion
 
-            if loss is not None and not isinstance(
-                    loss, GPTPretrainingCriterion):
+            std = isinstance(loss, GPTPretrainingCriterion)
+            if loss is not None and not std and not callable(loss):
                 raise NotImplementedError(
-                    "the dp x mp x pp hybrid route computes its own fused "
-                    "softmax cross-entropy at the last stage; pass "
-                    "loss=None or a GPTPretrainingCriterion (custom losses "
-                    "need the dygraph/pipeline routes)")
+                    "hybrid-route loss must be a pretraining criterion or "
+                    "a callable loss(logits, labels)")
+            custom_loss = loss if (loss is not None and not std) else None
             jm = self._mesh.jax_mesh()
             dp_cands = [a for a in self._mesh.dim_names
                         if a not in (pp_axis, tp_axis)]
@@ -245,10 +247,17 @@ class DistModel:
                     HybridTrainStep,
                 )
 
+                # reference parity: DistributedStrategy.pipeline_configs
+                # carries the schedule under "schedule_mode" (FThenB/1F1B/
+                # ZB*/ZBV — pipeline_scheduler_pass naming)
+                pcfg = (getattr(strategy, "pipeline_configs", None) or {}
+                        ) if strategy is not None else {}
                 self._step = HybridTrainStep(
                     layer, jm, optimizer, pp_axis=pp_axis, mp_axis=tp_axis,
                     dp_axis=self._batch_axis,
-                    num_microbatches=num_microbatches)
+                    num_microbatches=num_microbatches,
+                    policy=pcfg.get("schedule_mode", "1F1B"),
+                    loss_fn=custom_loss)
             else:
                 # eval/predict before fit: nothing trained yet — the eager
                 # model serves forwards directly (Engine.prepare rebuilds

@@ -45,6 +45,67 @@ _OP_COST = {IDLE: 1.0, F_OP: 1.0, B_OP: 2.0, W_OP: 1.0}
 # (zero-bubble) schedule B=dgrad and W=wgrad each cost ~1.
 
 
+def _engine_outputs(state, pgrad, *, axis, mesh, dp_axis, M,
+                    has_head, return_x_grad):
+    """Shared post-scan reduction for both schedule engines: loss mean over
+    microbatches (psum over pp), head-grad / input-cotangent broadcast
+    psums (only one stage computed them — zeros elsewhere), dp means."""
+    loss = jax.lax.psum(state["loss"], axis) / M
+    hgrad = jax.tree_util.tree_map(
+        lambda g: jax.lax.psum(g, axis), state["hgrad"])
+    xgrad = state.get("xgrad")
+    if xgrad is not None:
+        xgrad = jax.lax.psum(xgrad, axis)
+    if dp_axis is not None:
+        dp = mesh.shape[dp_axis]
+        loss = jax.lax.psum(loss, dp_axis) / dp
+        pgrad = jax.tree_util.tree_map(
+            lambda g: jax.lax.psum(g, dp_axis) / dp, pgrad)
+        hgrad = jax.tree_util.tree_map(
+            lambda g: jax.lax.psum(g, dp_axis) / dp, hgrad)
+        if xgrad is not None:
+            # each dp shard keeps ITS rows' cotangents at dp-mean weight
+            xgrad = xgrad / dp
+    out = [loss[None], pgrad]
+    if has_head:
+        out.append(hgrad)
+    if return_x_grad:
+        out.append(xgrad)
+    return tuple(out)
+
+
+def _run_schedule_engine(engine, layer_params, head_params, x, y, *, mesh,
+                         M, mb, axis, param_specs, dp_axis, head_specs,
+                         has_head, return_x_grad):
+    """Shared spec assembly + shard_map dispatch + result unpacking for
+    both schedule engines (single-chunk and ZB-V)."""
+    x_mb = x.reshape(M, mb, *x.shape[1:])
+    y_mb = y.reshape(M, mb, *y.shape[1:])
+    p_specs = (param_specs if param_specs is not None
+               else jax.tree_util.tree_map(lambda _: P(axis), layer_params))
+    data_spec = P(None, dp_axis) if dp_axis is not None else P()
+    h_specs = (head_specs if head_specs is not None
+               else jax.tree_util.tree_map(lambda _: P(), head_params))
+    in_specs = (p_specs, h_specs, data_spec, data_spec)
+    out_specs = [P(axis), p_specs]
+    if has_head:
+        out_specs.append(h_specs)
+    if return_x_grad:
+        out_specs.append(data_spec)
+    res = shard_map(
+        engine, mesh=mesh, in_specs=in_specs, out_specs=tuple(out_specs),
+        check_rep=False,
+    )(layer_params, head_params, x_mb, y_mb)
+    loss_st, grads = res[0], res[1]
+    extra = list(res[2:])
+    if return_x_grad:
+        xg = extra.pop()
+        extra.append(xg.reshape(x.shape))
+    if extra:
+        return (loss_st[0], grads, *extra)
+    return loss_st[0], grads
+
+
 def _peak_in_flight(op: np.ndarray, num_stages: int, num_ticks: int) -> int:
     """Activation-memory high-water mark: max count of microbatches with F
     done but B pending on any one device column of the [T, S] op table."""
@@ -535,66 +596,20 @@ def schedule_pipeline_grads(
 
         # stage-s grads live on device s; the P(axis) out_spec reassembles
         # the per-stage [lps, ...] blocks into the global [L, ...] layout
-        loss = jax.lax.psum(state["loss"], axis) / M
-        pgrad = state["pgrad"]
-        # only the last stage computed head grads; the psum broadcasts
-        # them (zeros elsewhere) so the out_spec can omit the pp axis
-        hgrad = jax.tree_util.tree_map(
-            lambda g: jax.lax.psum(g, axis), state["hgrad"])
-        xgrad = state.get("xgrad")
-        if xgrad is not None:
-            # only stage 0 holds input cotangents
-            xgrad = jax.lax.psum(xgrad, axis)
-        if dp_axis is not None:
-            dp = mesh.shape[dp_axis]
-            loss = jax.lax.psum(loss, dp_axis) / dp
-            pgrad = jax.tree_util.tree_map(
-                lambda g: jax.lax.psum(g, dp_axis) / dp, pgrad)
-            hgrad = jax.tree_util.tree_map(
-                lambda g: jax.lax.psum(g, dp_axis) / dp, hgrad)
-            if xgrad is not None:
-                # each dp shard keeps ITS rows' cotangents, scaled by the
-                # dp-mean weight of its shard loss
-                xgrad = xgrad / dp
-        out = [loss[None], pgrad]
-        if has_head:
-            out.append(hgrad)
-        if return_x_grad:
-            out.append(xgrad)
-        return tuple(out)
-
-    x_mb = x.reshape(M, mb, *x.shape[1:])
-    y_mb = y.reshape(M, mb, *y.shape[1:])
+        return _engine_outputs(
+            state, state["pgrad"], axis=axis, mesh=mesh, dp_axis=dp_axis,
+            M=M, has_head=has_head, return_x_grad=return_x_grad)
 
     # hybrid TP x PP: caller may give per-leaf specs whose FIRST entry is
     # the pipeline axis and whose other entries shard inside the stage (the
     # Fleet HybridParallel layout); block_fn is then responsible for its own
     # model-axis collectives (megatron psum) — shard_map runs manual over
     # every mesh axis
-    p_specs = (param_specs if param_specs is not None
-               else jax.tree_util.tree_map(lambda _: P(axis), layer_params))
-    data_spec = P(None, dp_axis) if dp_axis is not None else P()
-    h_specs = (head_specs if head_specs is not None
-               else jax.tree_util.tree_map(lambda _: P(), head_params))
-    in_specs = (p_specs, h_specs, data_spec, data_spec)
-    out_specs = [P(axis), p_specs]
-    if has_head:
-        out_specs.append(h_specs)
-    if return_x_grad:
-        out_specs.append(data_spec)
-
-    res = shard_map(
-        engine, mesh=mesh, in_specs=in_specs, out_specs=tuple(out_specs),
-        check_rep=False,
-    )(layer_params, head_params, x_mb, y_mb)
-    loss_st, grads = res[0], res[1]
-    extra = list(res[2:])
-    if return_x_grad:
-        xg = extra.pop()
-        extra.append(xg.reshape(x.shape))
-    if extra:
-        return (loss_st[0], grads, *extra)
-    return loss_st[0], grads
+    return _run_schedule_engine(
+        engine, layer_params, head_params, x, y, mesh=mesh, M=M, mb=mb,
+        axis=axis, param_specs=param_specs, dp_axis=dp_axis,
+        head_specs=head_specs, has_head=has_head,
+        return_x_grad=return_x_grad)
 
 
 # ---------------------------------------------------------------------------
@@ -909,6 +924,11 @@ def schedule_pipeline_grads_zbv(
     mesh: Mesh,
     schedule: ZBVSchedule,
     axis: str = "pp",
+    param_specs: Any = None,
+    dp_axis: str = None,
+    head_params: Any = None,
+    head_specs: Any = None,
+    return_x_grad: bool = False,
 ):
     """Execute a ZB-V table: two chunks per device, split B/W, V routing.
 
@@ -923,13 +943,35 @@ def schedule_pipeline_grads_zbv(
     the loss instead), B1 hops forward (turnaround on device S-1 feeds its
     own chunk 0), B0 hops backward (device 0 terminates). One ppermute
     pair per tick, same as the single-chunk engine.
+
+    ``param_specs`` / ``dp_axis`` / ``head_params`` / ``head_specs`` /
+    ``return_x_grad`` carry the same contract as
+    ``schedule_pipeline_grads`` (hybrid TP inside blocks, dp row sharding
+    with in-engine psum means, a head consumed by ``loss_fn(h, y, hp)`` at
+    the last virtual stage, and the dLoss/dx hook for a chained embedding)
+    — with the ZB-V twists that the head runs on device 0 (chunk 1) and
+    the input cotangent also terminates on device 0 (chunk 0).
     """
     S = schedule.num_stages
     M = schedule.num_microbatches
     assert mesh.shape[axis] == S
+    has_head = head_params is not None
+    if has_head:
+        def loss3(h, y_, hp):
+            return loss_fn(h, y_, hp)
+    else:
+        head_params = {}  # empty pytree: the head path becomes a no-op
+
+        def loss3(h, y_, hp):
+            return loss_fn(h, y_)
     B = x.shape[0]
     assert B % M == 0
     mb = B // M
+    if dp_axis is not None:
+        dp = mesh.shape[dp_axis]
+        assert mb % dp == 0, (
+            f"per-microbatch rows ({B}//{M}={mb}) must divide over "
+            f"dp_axis '{dp_axis}' (size {dp})")
 
     leaves = jax.tree_util.tree_leaves(layer_params)
     L = leaves[0].shape[0]
@@ -990,7 +1032,7 @@ def schedule_pipeline_grads_zbv(
         h, _ = jax.lax.scan(body, h, ck)
         return h
 
-    def engine(params_local, x_local, y_local):
+    def engine(params_local, head_local, x_local, y_local):
         stage = jax.lax.axis_index(axis)
         p0 = jax.tree_util.tree_map(lambda a: a[:lpc], params_local)
         p1 = jax.tree_util.tree_map(lambda a: a[lpc:], params_local)
@@ -1005,8 +1047,11 @@ def schedule_pipeline_grads_zbv(
             fmsg=zmsg, bmsg=zmsg,
             pg0=jax.tree_util.tree_map(jnp.zeros_like, p0),
             pg1=jax.tree_util.tree_map(jnp.zeros_like, p1),
+            hgrad=jax.tree_util.tree_map(jnp.zeros_like, head_local),
             loss=jnp.zeros((), jnp.float32),
         )
+        if return_x_grad:
+            state["xgrad"] = jnp.zeros(act_shape, x_local.dtype)
 
         def do_idle(state, m):
             return state, zmsg, zmsg
@@ -1035,18 +1080,21 @@ def schedule_pipeline_grads_zbv(
             y_m = jax.lax.dynamic_index_in_dim(y_local, m, 0, keepdims=False)
 
             def seed(args):
-                gouts1, loss = args
-                loss_m, lvjp = jax.vjp(lambda hh: loss_fn(hh, y_m), h_out)
-                (g_seed,) = lvjp(jnp.full((), 1.0 / M, loss_m.dtype))
+                gouts1, loss, hgrad = args
+                loss_m, lvjp = jax.vjp(
+                    lambda hh, hp: loss3(hh, y_m, hp), h_out, head_local)
+                g_seed, g_head = lvjp(jnp.full((), 1.0 / M, loss_m.dtype))
                 gouts1 = jax.lax.dynamic_update_index_in_dim(
                     gouts1, g_seed.astype(x_local.dtype), m, 0)
-                return gouts1, loss + loss_m.astype(jnp.float32)
+                hgrad = jax.tree_util.tree_map(jnp.add, hgrad, g_head)
+                return gouts1, loss + loss_m.astype(jnp.float32), hgrad
 
-            # device 0 hosts the LAST virtual stage: loss + self-seed
-            gouts1, loss = jax.lax.cond(
+            # device 0 hosts the LAST virtual stage: loss + head + self-seed
+            gouts1, loss, hgrad = jax.lax.cond(
                 stage == 0, seed, lambda a: a,
-                (state["gouts1"], state["loss"]))
-            return dict(state, gouts1=gouts1, loss=loss), zmsg, h_out
+                (state["gouts1"], state["loss"], state["hgrad"]))
+            return (dict(state, gouts1=gouts1, loss=loss, hgrad=hgrad),
+                    zmsg, h_out)
 
         def do_b0(state, m):
             h_in = jax.lax.dynamic_index_in_dim(
@@ -1055,6 +1103,16 @@ def schedule_pipeline_grads_zbv(
                 state["gouts0"], m, 0, keepdims=False)
             _, hvjp = jax.vjp(lambda hh: chunk_forward(p0, hh), h_in)
             (g_in,) = hvjp(g_out)
+            if return_x_grad:
+                # device 0 chunk 0 IS global stage 0: its input cotangent
+                # is dLoss/dx for microbatch m (the bwd send terminates)
+                xgrad = jax.lax.cond(
+                    stage == 0,
+                    lambda xg: jax.lax.dynamic_update_index_in_dim(
+                        xg, g_in, m, 0),
+                    lambda xg: xg,
+                    state["xgrad"])
+                state = dict(state, xgrad=xgrad)
             return state, zmsg, g_in
 
         def do_b1(state, m):
@@ -1130,17 +1188,17 @@ def schedule_pipeline_grads_zbv(
 
         state, _ = jax.lax.scan(tick, state, jnp.arange(T))
 
-        loss = jax.lax.psum(state["loss"], axis) / M
+        # device d's grad shard is [chunk-0, chunk-1] concatenated — the
+        # zbv_params layout the P(axis) out_spec reassembles
         pgrad = jax.tree_util.tree_map(
             lambda a, b: jnp.concatenate([a, b], axis=0),
             state["pg0"], state["pg1"])
-        return loss[None], pgrad
+        return _engine_outputs(
+            state, pgrad, axis=axis, mesh=mesh, dp_axis=dp_axis,
+            M=M, has_head=has_head, return_x_grad=return_x_grad)
 
-    x_mb = x.reshape(M, mb, *x.shape[1:])
-    y_mb = y.reshape(M, mb, *y.shape[1:])
-    p_specs = jax.tree_util.tree_map(lambda _: P(axis), layer_params)
-    loss_st, grads = shard_map(
-        engine, mesh=mesh, in_specs=(p_specs, P(), P()),
-        out_specs=(P(axis), p_specs), check_rep=False,
-    )(layer_params, x_mb, y_mb)
-    return loss_st[0], grads
+    return _run_schedule_engine(
+        engine, layer_params, head_params, x, y, mesh=mesh, M=M, mb=mb,
+        axis=axis, param_specs=param_specs, dp_axis=dp_axis,
+        head_specs=head_specs, has_head=has_head,
+        return_x_grad=return_x_grad)

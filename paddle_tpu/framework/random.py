@@ -18,12 +18,22 @@ import jax
 
 class _RngState(threading.local):
     def __init__(self):
-        self.key = jax.random.key(0)
+        # key is created LAZILY: jax.random.key materializes a device array,
+        # and an import-time device touch both hangs `import paddle_tpu`
+        # when the tunneled backend is unreachable and forces backend init
+        # on processes that never use the framework RNG
+        self.key = None
         self.traced_key = None  # set inside captured graphs
         self.counter = 0
 
 
 _state = _RngState()
+
+
+def _global_key():
+    if _state.key is None:
+        _state.key = jax.random.key(0)
+    return _state.key
 
 
 def seed(s: int) -> None:
@@ -33,7 +43,7 @@ def seed(s: int) -> None:
 
 
 def get_rng_state():
-    return (_state.key, _state.counter)
+    return (_global_key(), _state.counter)
 
 
 def set_rng_state(st) -> None:
@@ -47,7 +57,7 @@ def next_key():
         # multiple random ops in one program get distinct streams.
         _state.counter += 1
         return jax.random.fold_in(_state.traced_key, _state.counter)
-    _state.key, sub = jax.random.split(_state.key)
+    _state.key, sub = jax.random.split(_global_key())
     return sub
 
 

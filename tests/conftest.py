@@ -23,8 +23,12 @@ if "xla_force_host_platform_device_count" not in flags:
 
 import jax  # noqa: E402
 
-# A plugin may import jax before this conftest; set config directly too
-# (effective as long as the backend isn't initialized yet).
+# The env vars above are NOT enough when something imported jax before this
+# conftest — in particular the axon sitecustomize, whose register() sets the
+# effective jax_platforms to "axon,cpu" in-config, so first backend use
+# would still dial the tunnel (and hang forever when it's dead — liveness
+# flaps). Backends are not yet initialized at conftest time, so an explicit
+# config update pins CPU.
 jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_default_matmul_precision", "highest")
 

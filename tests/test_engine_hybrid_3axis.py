@@ -194,3 +194,119 @@ def test_hybrid_step_grad_clip_and_decay_fun():
     ids = paddle.to_tensor(ids_np)
     dygraph = [float(dstep(ids, ids).numpy()) for _ in range(STEPS)]
     np.testing.assert_allclose(hybrid, dygraph, rtol=2e-4, atol=1e-5)
+
+
+def test_zbv_hybrid_step_loss_equality_2x2x2():
+    """policy="ZBV": the zero-bubble V schedule (two chunks per device)
+    drives the SAME one-program dp x mp x pp route — loss trajectory and
+    synced-back weights match dygraph. Closes the 'ZB-V not wired into
+    HybridTrainStep' r4 gap."""
+    from jax.sharding import Mesh
+    from paddle_tpu.distributed.auto_parallel.hybrid import HybridTrainStep
+
+    paddle.framework.random.seed(3)
+    # 4 layers: ZB-V needs num_layers % (2*pp) == 0 (one early + one late
+    # chunk per device)
+    model = GPTForCausalLM(gpt_tiny(num_layers=4))
+    ids_np = _data()
+
+    mesh = Mesh(np.asarray(jax.devices()[:8]).reshape(2, 2, 2),
+                ("pp", "mp", "dp"))
+    optimizer = opt.AdamW(learning_rate=LR, weight_decay=WD,
+                          parameters=model.parameters())
+    step = HybridTrainStep(model, mesh, optimizer, pp_axis="pp",
+                           mp_axis="mp", dp_axis="dp", num_microbatches=4,
+                           policy="ZBV")
+    assert step._zbv and step.schedule.num_microbatches == 4
+    hybrid = [float(step(ids_np, ids_np).numpy()) for _ in range(STEPS)]
+
+    dygraph = _dygraph_losses(model, ids_np)
+    np.testing.assert_allclose(hybrid, dygraph, rtol=2e-4, atol=1e-5)
+
+    # sync_model restores LAYER order through zbv_unpermute before
+    # write_back: the synced eager model must score like the dygraph model
+    # at the same point in training (after STEPS steps)
+    step.sync_model()
+    criterion = GPTPretrainingCriterion(model.config)
+    ids = paddle.to_tensor(ids_np)
+    synced = float(criterion(model(ids), ids).numpy())
+
+    paddle.framework.random.seed(3)
+    model2 = GPTForCausalLM(gpt_tiny(num_layers=4))
+    _dygraph_losses(model2, ids_np)  # trains model2 in place for STEPS
+    synced_dy = float(criterion(model2(ids), ids).numpy())
+    np.testing.assert_allclose(synced, synced_dy, rtol=2e-4, atol=1e-5)
+
+
+def test_engine_fit_zbv_schedule_mode():
+    """Engine honors DistributedStrategy.pipeline_configs["schedule_mode"]
+    (reference: pipeline_scheduler_pass naming): "ZBV" routes the hybrid
+    step through the V schedule, reproducing the dygraph loss history."""
+    from paddle_tpu.distributed.auto_parallel import ProcessMesh
+    from paddle_tpu.distributed.auto_parallel.static_engine import Engine
+    from paddle_tpu.distributed.fleet.fleet import DistributedStrategy
+
+    paddle.framework.random.seed(4)
+    model = GPTForCausalLM(gpt_tiny(num_layers=4))
+    ids_np = _data()
+    mesh = ProcessMesh(np.arange(8).reshape(2, 2, 2),
+                       dim_names=["pp", "mp", "dp"])
+    strategy = DistributedStrategy()
+    strategy.pipeline_configs = {"schedule_mode": "ZBV"}
+
+    optimizer = opt.AdamW(learning_rate=LR, weight_decay=WD,
+                          parameters=model.parameters())
+    loader = [(paddle.to_tensor(ids_np), paddle.to_tensor(ids_np))
+              for _ in range(STEPS)]
+    eng = Engine(model, optimizer=optimizer, mesh=mesh, strategy=strategy,
+                 pp_axis="pp", tp_axis="mp", num_microbatches=4)
+    history = eng.fit(loader, epochs=1)
+    assert eng._dist_model._step._zbv
+    assert len(history) == STEPS
+
+    paddle.framework.random.seed(4)
+    model2 = GPTForCausalLM(gpt_tiny(num_layers=4))
+    dygraph = _dygraph_losses(model2, ids_np)
+    np.testing.assert_allclose(history, dygraph, rtol=2e-4, atol=1e-5)
+
+
+def test_hybrid_step_custom_loss_equality():
+    """A label-smoothed CE — inexpressible by the fused head — routes
+    through the dense-logits custom head and reproduces the dygraph
+    trajectory (r4: closes the 'custom losses raise loudly' gap)."""
+    import paddle_tpu.nn.functional as F
+    from jax.sharding import Mesh
+    from paddle_tpu.distributed.auto_parallel.hybrid import HybridTrainStep
+    from paddle_tpu.jit.api import TrainStep
+
+    paddle.framework.random.seed(5)
+    model = GPTForCausalLM(gpt_tiny())
+    ids_np = _data()
+    mesh = Mesh(np.asarray(jax.devices()[:8]).reshape(2, 2, 2),
+                ("pp", "mp", "dp"))
+    optimizer = opt.AdamW(learning_rate=LR, weight_decay=WD,
+                          parameters=model.parameters())
+
+    # ONE callable under the dygraph criterion contract (paddle Tensors
+    # in, scalar Tensor out) serves both the engine and the dygraph path
+    def smooth_ce(logits, labels):
+        v = logits.shape[-1]
+        return F.cross_entropy(logits.reshape((-1, v)),
+                               labels.reshape((-1,)),
+                               label_smoothing=0.1)
+
+    step = HybridTrainStep(model, mesh, optimizer, pp_axis="pp",
+                           mp_axis="mp", dp_axis="dp", num_microbatches=2,
+                           loss_fn=smooth_ce)
+    hybrid = [float(step(ids_np, ids_np).numpy()) for _ in range(STEPS)]
+
+    criterion_opt = opt.AdamW(learning_rate=LR, weight_decay=WD,
+                              parameters=model.parameters())
+
+    def dy_loss(m, ids, labels):
+        return smooth_ce(m(ids), labels)
+
+    dstep = TrainStep(model, dy_loss, criterion_opt)
+    ids = paddle.to_tensor(ids_np)
+    dygraph = [float(dstep(ids, ids).numpy()) for _ in range(STEPS)]
+    np.testing.assert_allclose(hybrid, dygraph, rtol=2e-4, atol=1e-5)
