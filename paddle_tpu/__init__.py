@@ -102,6 +102,7 @@ from paddle_tpu import static  # noqa: E402,F401
 from paddle_tpu import hub  # noqa: E402,F401
 from paddle_tpu import text  # noqa: E402,F401
 from paddle_tpu import audio  # noqa: E402,F401
+from paddle_tpu import geometric  # noqa: E402,F401
 from paddle_tpu import onnx  # noqa: E402,F401
 from paddle_tpu import inference  # noqa: E402,F401
 from paddle_tpu.ops import linalg  # noqa: E402,F401

@@ -1,0 +1,47 @@
+"""paddle.geometric parity — graph-learning ops, TPU-native.
+
+Reference: python/paddle/geometric/__init__.py (segment math
+geometric/math.py:23-197, message passing
+geometric/message_passing/send_recv.py:36,187,392, reindex
+geometric/reindex.py:25,139, sampling geometric/sampling/neighbors.py:23,172).
+
+Design: the dense message-passing/segment ops are jax segment reductions
+dispatched through the op layer (tape-differentiable, jit-able with a
+static ``out_size``); graph reindex/sampling are HOST ops by design —
+integer graph preprocessing belongs on CPU feeding the device, exactly
+as the reference runs them on the DataLoader side for GPU.
+"""
+
+from paddle_tpu.geometric.math import (  # noqa: F401
+    segment_max,
+    segment_mean,
+    segment_min,
+    segment_sum,
+)
+from paddle_tpu.geometric.message_passing import (  # noqa: F401
+    send_u_recv,
+    send_ue_recv,
+    send_uv,
+)
+from paddle_tpu.geometric.reindex import (  # noqa: F401
+    reindex_graph,
+    reindex_heter_graph,
+)
+from paddle_tpu.geometric.sampling import (  # noqa: F401
+    sample_neighbors,
+    weighted_sample_neighbors,
+)
+
+__all__ = [
+    "segment_sum",
+    "segment_mean",
+    "segment_min",
+    "segment_max",
+    "send_u_recv",
+    "send_ue_recv",
+    "send_uv",
+    "reindex_graph",
+    "reindex_heter_graph",
+    "sample_neighbors",
+    "weighted_sample_neighbors",
+]

@@ -1,0 +1,15 @@
+"""Shared host-side helpers for the geometric graph-preprocessing ops."""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def as_np(t):
+    return np.asarray(t.numpy() if hasattr(t, "numpy") else t)
+
+
+def wrap(arr):
+    from paddle_tpu.tensor import Tensor
+
+    return Tensor(np.ascontiguousarray(arr))

@@ -1,0 +1,81 @@
+"""Segment reductions (reference: python/paddle/geometric/math.py:23-197).
+
+Paddle semantics: output has max(segment_ids)+1 rows; ids must be sorted
+ascending in the reference's CPU kernel but the math is order-independent
+here (jax segment ops accept unsorted ids); EMPTY segments produce 0 for
+every reduce (the reference fills missing ids with 0 — including min/max,
+where jax's identity would be +/-inf).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.dispatch import apply
+
+
+def _num_segments(segment_ids, out_size=None):
+    if out_size is not None:
+        n = int(out_size) if not hasattr(out_size, "numpy") else int(
+            out_size.numpy())
+        if n > 0:
+            return n
+    ids = segment_ids.numpy() if hasattr(segment_ids, "numpy") else segment_ids
+    import numpy as np
+
+    return int(np.max(np.asarray(ids))) + 1 if len(ids) else 0
+
+
+def _segment_reduce(x, ids, n, mode):
+    """Shared segment-reduction core (paddle empty-segment-yields-0
+    semantics for every mode incl. min/max) — also the reduce stage of
+    the geometric message-passing ops."""
+    ids = ids.astype(jnp.int32)
+    if mode == "sum":
+        return jax.ops.segment_sum(x, ids, num_segments=n)
+    cnt = jax.ops.segment_sum(jnp.ones((x.shape[0],), x.dtype), ids,
+                              num_segments=n)
+    shape = (n,) + (1,) * (x.ndim - 1)
+    has = (cnt > 0).reshape(shape)
+    if mode == "mean":
+        s = jax.ops.segment_sum(x, ids, num_segments=n)
+        return jnp.where(has, s / jnp.maximum(cnt, 1).reshape(shape), 0)
+    if mode == "min":
+        m = jax.ops.segment_min(x, ids, num_segments=n)
+    elif mode == "max":
+        m = jax.ops.segment_max(x, ids, num_segments=n)
+    else:
+        raise ValueError(f"unsupported reduce_op {mode!r}")
+    return jnp.where(has, m, 0)
+
+
+def _segment(name, data, segment_ids, n, mode):
+    def f(x, ids):
+        return _segment_reduce(x, ids, n, mode)
+
+    return apply(name, f, data, segment_ids)
+
+
+def segment_sum(data, segment_ids, name=None):
+    """paddle.geometric.segment_sum (math.py:23)."""
+    return _segment("segment_sum", data, segment_ids,
+                    _num_segments(segment_ids), "sum")
+
+
+def segment_mean(data, segment_ids, name=None):
+    """paddle.geometric.segment_mean (math.py:80)."""
+    return _segment("segment_mean", data, segment_ids,
+                    _num_segments(segment_ids), "mean")
+
+
+def segment_min(data, segment_ids, name=None):
+    """paddle.geometric.segment_min (math.py:139)."""
+    return _segment("segment_min", data, segment_ids,
+                    _num_segments(segment_ids), "min")
+
+
+def segment_max(data, segment_ids, name=None):
+    """paddle.geometric.segment_max (math.py:197)."""
+    return _segment("segment_max", data, segment_ids,
+                    _num_segments(segment_ids), "max")

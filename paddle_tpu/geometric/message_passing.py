@@ -1,0 +1,84 @@
+"""Message passing (reference: geometric/message_passing/send_recv.py).
+
+send_u_recv gathers node features along edges and segment-reduces them at
+the destinations without materializing a dense adjacency; send_ue_recv
+fuses an edge-feature op into the message; send_uv emits per-edge
+features. All three are single fused jax programs under the op layer
+(gather + segment reduce — XLA fuses the pair), tape-differentiable.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from paddle_tpu.core.dispatch import apply
+from paddle_tpu.geometric.math import _segment_reduce as _reduce
+
+_MSG_OPS = {
+    "add": jnp.add,
+    "sub": jnp.subtract,
+    "mul": jnp.multiply,
+    "div": jnp.divide,
+}
+
+
+def _align_edge_feature(y, msg):
+    """Reference reshape_lhs_rhs parity: a per-edge y whose trailing dims
+    are missing vs the message gets unsqueezed to broadcast per edge."""
+    if y.ndim < msg.ndim and y.shape[0] == msg.shape[0]:
+        return y.reshape(y.shape + (1,) * (msg.ndim - y.ndim))
+    return y
+
+
+def _out_rows(x, out_size):
+    """Reference semantics (send_recv.py docstring example 3): without
+    out_size the output keeps x's row count — dangling high-numbered
+    nodes get zero rows, NOT a truncated max(dst)+1 table."""
+    if out_size is None:
+        return x.shape[0]
+    n = int(out_size) if not hasattr(out_size, "numpy") else int(
+        out_size.numpy())
+    return n if n > 0 else x.shape[0]
+
+
+def send_u_recv(x, src_index, dst_index, reduce_op="sum", out_size=None,
+                name=None):
+    """paddle.geometric.send_u_recv (send_recv.py:36)."""
+    if reduce_op not in ("sum", "mean", "max", "min"):
+        raise ValueError(f"unsupported reduce_op {reduce_op!r}")
+    n = _out_rows(x, out_size)
+
+    def f(xv, src, dst):
+        return _reduce(xv[src.astype(jnp.int32)], dst, n, reduce_op)
+
+    return apply("send_u_recv", f, x, src_index, dst_index)
+
+
+def send_ue_recv(x, y, src_index, dst_index, message_op="add",
+                 reduce_op="sum", out_size=None, name=None):
+    """paddle.geometric.send_ue_recv (send_recv.py:187)."""
+    if message_op not in _MSG_OPS:
+        raise ValueError(f"unsupported message_op {message_op!r}")
+    if reduce_op not in ("sum", "mean", "max", "min"):
+        raise ValueError(f"unsupported reduce_op {reduce_op!r}")
+    n = _out_rows(x, out_size)
+
+    def f(xv, yv, src, dst):
+        msg = xv[src.astype(jnp.int32)]
+        return _reduce(_MSG_OPS[message_op](msg, _align_edge_feature(yv, msg)),
+                       dst, n, reduce_op)
+
+    return apply("send_ue_recv", f, x, y, src_index, dst_index)
+
+
+def send_uv(x, y, src_index, dst_index, message_op="add", name=None):
+    """paddle.geometric.send_uv (send_recv.py:392): per-edge features
+    x[src] op y[dst] — no reduction."""
+    if message_op not in _MSG_OPS:
+        raise ValueError(f"unsupported message_op {message_op!r}")
+
+    def f(xv, yv, src, dst):
+        return _MSG_OPS[message_op](xv[src.astype(jnp.int32)],
+                                    yv[dst.astype(jnp.int32)])
+
+    return apply("send_uv", f, x, y, src_index, dst_index)

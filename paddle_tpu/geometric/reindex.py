@@ -1,0 +1,65 @@
+"""Graph reindex (reference: geometric/reindex.py:25 reindex_graph, :139
+reindex_heter_graph).
+
+Host ops by design: integer id-compaction is CPU-side graph preprocessing
+(the reference's value_buffer/index_buffer hashtable knobs are GPU-only
+plumbing and are accepted-and-ignored here, as the reference itself does
+on CPU). Fully vectorized — np.unique compaction, no per-edge Python loop
+(a sampled subgraph batch can carry millions of neighbor entries).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from paddle_tpu.geometric._host import as_np, wrap
+
+
+def _reindex(x, neighbor_list):
+    """Compact ids to [0, N) in first-appearance order over
+    [x, *neighbor_list]; x's ids (assumed unique) keep positions 0..len-1.
+
+    Returns (per-list reindexed neighbors, out_nodes)."""
+    x = as_np(x).reshape(-1)
+    all_ids = np.concatenate([x] + neighbor_list) if neighbor_list else x
+    uniq, first_pos = np.unique(all_ids, return_index=True)
+    # first-appearance order: sort unique values by where they first occur
+    # (x occupies the front of all_ids, so its ids land at ranks 0..len-1)
+    order = np.argsort(first_pos, kind="stable")
+    out_nodes = uniq[order]
+    rank = np.empty(len(uniq), dtype=np.int64)
+    rank[order] = np.arange(len(uniq))
+    srcs = []
+    for neigh in neighbor_list:
+        # value -> sorted-unique position -> first-appearance rank
+        srcs.append(rank[np.searchsorted(uniq, neigh)].astype(x.dtype))
+    return srcs, out_nodes.astype(x.dtype)
+
+
+def _dst_from_count(x_len, count_list, dtype):
+    return [np.repeat(np.arange(x_len, dtype=dtype), as_np(c).astype(np.int64))
+            for c in count_list]
+
+
+def reindex_graph(x, neighbors, count, value_buffer=None, index_buffer=None,
+                  name=None):
+    """paddle.geometric.reindex_graph (reindex.py:25): compact the ids of
+    ``x`` + ``neighbors`` to [0, N); returns (reindex_src, reindex_dst,
+    out_nodes) with input nodes occupying the front of out_nodes."""
+    xv = as_np(x).reshape(-1)
+    srcs, out_nodes = _reindex(x, [as_np(neighbors).reshape(-1)])
+    (dst,) = _dst_from_count(len(xv), [count], xv.dtype)
+    return wrap(srcs[0]), wrap(dst), wrap(out_nodes)
+
+
+def reindex_heter_graph(x, neighbors, count, value_buffer=None,
+                        index_buffer=None, name=None):
+    """paddle.geometric.reindex_heter_graph (reindex.py:139): one shared
+    id space across the per-edge-type neighbor sets; outputs concatenate
+    the per-type reindexed edges."""
+    xv = as_np(x).reshape(-1)
+    neighbor_list = [as_np(n).reshape(-1) for n in neighbors]
+    srcs, out_nodes = _reindex(x, neighbor_list)
+    dsts = _dst_from_count(len(xv), list(count), xv.dtype)
+    return (wrap(np.concatenate(srcs)), wrap(np.concatenate(dsts)),
+            wrap(out_nodes))

@@ -1,0 +1,78 @@
+"""Neighbor sampling (reference: geometric/sampling/neighbors.py:23
+sample_neighbors, :172 weighted_sample_neighbors).
+
+Host ops by design (CSC graph sampling is DataLoader-side preprocessing);
+randomness draws from the framework RNG so paddle.seed reproduces runs.
+Uniform sampling without replacement; weighted sampling uses the
+Efraimidis–Spirakis exponential-key trick (the reference's GPU kernel
+solves the same weighted-reservoir problem).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from paddle_tpu.geometric._host import as_np as _as_np, wrap as _wrap
+
+
+def _np_rng():
+    import jax
+
+    from paddle_tpu.framework import random as frandom
+
+    key = frandom.next_key()
+    seed = int(np.asarray(jax.random.key_data(key)).reshape(-1)[-1])
+    return np.random.default_rng(seed & 0x7FFFFFFF)
+
+
+def _sample(row, colptr, input_nodes, sample_size, eids, return_eids,
+            weight=None):
+    row = _as_np(row).reshape(-1)
+    colptr = _as_np(colptr).reshape(-1)
+    nodes = _as_np(input_nodes).reshape(-1)
+    eids_np = _as_np(eids).reshape(-1) if eids is not None else None
+    if return_eids and eids_np is None:
+        raise ValueError("return_eids=True needs eids")
+    w = _as_np(weight).reshape(-1) if weight is not None else None
+    rng = _np_rng()
+
+    out_n, out_c, out_e = [], [], []
+    for n in nodes:
+        lo, hi = int(colptr[n]), int(colptr[n + 1])
+        deg = hi - lo
+        if sample_size < 0 or deg <= sample_size:
+            pick = np.arange(lo, hi)
+        elif w is not None:
+            # Efraimidis–Spirakis: top-k of u^(1/w) == top-k of log(u)/w
+            keys = np.log(rng.random(deg)) / np.maximum(w[lo:hi], 1e-30)
+            pick = lo + np.argpartition(-keys, sample_size - 1)[:sample_size]
+        else:
+            pick = lo + rng.choice(deg, size=sample_size, replace=False)
+        out_n.append(row[pick])
+        out_c.append(len(pick))
+        if return_eids:
+            out_e.append(eids_np[pick])
+
+    neighbors = (np.concatenate(out_n) if out_n
+                 else np.empty(0, row.dtype))
+    counts = np.asarray(out_c, dtype=np.int32)
+    if return_eids:
+        e = np.concatenate(out_e) if out_e else np.empty(0, eids_np.dtype)
+        return _wrap(neighbors), _wrap(counts), _wrap(e)
+    return _wrap(neighbors), _wrap(counts)
+
+
+def sample_neighbors(row, colptr, input_nodes, sample_size=-1, eids=None,
+                     return_eids=False, perm_buffer=None, name=None):
+    """paddle.geometric.sample_neighbors (neighbors.py:23). perm_buffer
+    (GPU fisher-yates plumbing) is accepted-and-ignored, as on the
+    reference's CPU path."""
+    return _sample(row, colptr, input_nodes, sample_size, eids, return_eids)
+
+
+def weighted_sample_neighbors(row, colptr, weight, input_nodes,
+                              sample_size=-1, eids=None, return_eids=False,
+                              name=None):
+    """paddle.geometric.weighted_sample_neighbors (neighbors.py:172)."""
+    return _sample(row, colptr, input_nodes, sample_size, eids, return_eids,
+                   weight=weight)

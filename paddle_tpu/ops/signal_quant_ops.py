@@ -362,67 +362,54 @@ def quantize_linear(x, scale, zero_point, bit_length=8, quant_axis=-1,
 # ------------------------------------------------------------- graph ops
 
 
-def _segment_reduce(vals, dst, num_nodes, reduce_op):
-    if reduce_op in ("SUM", "ADD", "MEAN"):
-        out = jax.ops.segment_sum(vals, dst, num_segments=num_nodes)
-        if reduce_op == "MEAN":
-            cnt = jax.ops.segment_sum(jnp.ones_like(dst, vals.dtype), dst,
-                                      num_segments=num_nodes)
-            out = out / jnp.maximum(cnt, 1).reshape(
-                (-1,) + (1,) * (vals.ndim - 1))
-        return out
-    if reduce_op == "MAX":
-        return jax.ops.segment_max(vals, dst, num_segments=num_nodes)
-    if reduce_op == "MIN":
-        return jax.ops.segment_min(vals, dst, num_segments=num_nodes)
-    raise ValueError(reduce_op)
+def _reduce_name(op):
+    # phi spelling -> geometric spelling: reduce ops accept SUM/ADD alias
+    return {"ADD": "sum"}.get(op.upper(), op.lower())
+
+
+def _message_name(op):
+    return {"SUM": "add"}.get(op.upper(), op.lower())
 
 
 @register_op("send_u_recv")
 def send_u_recv(x, src_index, dst_index, reduce_op="SUM", out_size=None,
                 name=None):
-    """Graph message passing (phi graph_send_recv): gather src features,
-    segment-reduce at dst."""
-    n = out_size or x.shape[0]
+    """Graph message passing (phi graph_send_recv) — delegates to the
+    paddle.geometric implementation (the single source of the paddle
+    semantics: x-row default out size, empty segments yield 0)."""
+    from paddle_tpu import geometric
 
-    def f(a, si, di):
-        msgs = a[si]
-        return _segment_reduce(msgs, di, n, reduce_op.upper())
-
-    return apply("send_u_recv", f, x, src_index, dst_index)
+    return geometric.send_u_recv(x, src_index, dst_index,
+                                 reduce_op=_reduce_name(reduce_op),
+                                 out_size=out_size)
 
 
 @register_op("send_ue_recv")
 def send_ue_recv(x, y, src_index, dst_index, message_op="ADD",
                  reduce_op="SUM", out_size=None, name=None):
-    n = out_size or x.shape[0]
+    from paddle_tpu import geometric
 
-    def f(a, e, si, di):
-        msgs = a[si]
-        if message_op.upper() in ("ADD", "SUM"):
-            msgs = msgs + e
-        else:
-            msgs = msgs * e
-        return _segment_reduce(msgs, di, n, reduce_op.upper())
-
-    return apply("send_ue_recv", f, x, y, src_index, dst_index)
+    return geometric.send_ue_recv(
+        x, y, src_index, dst_index,
+        message_op=_message_name(message_op),
+        reduce_op=_reduce_name(reduce_op), out_size=out_size)
 
 
 @register_op("send_uv")
 def send_uv(x, y, src_index, dst_index, message_op="ADD", name=None):
-    def f(a, b, si, di):
-        u = a[si]
-        v = b[di]
-        return u + v if message_op.upper() in ("ADD", "SUM") else u * v
+    from paddle_tpu import geometric
 
-    return apply("send_uv", f, x, y, src_index, dst_index)
+    return geometric.send_uv(x, y, src_index, dst_index,
+                             message_op=_message_name(message_op))
 
 
 @register_op("segment_pool")
 def segment_pool(x, segment_ids, pooltype="SUM", name=None):
+    from paddle_tpu.geometric.math import _segment_reduce
+
     def f(a, ids):
         n = int(np.asarray(jax.device_get(ids)).max()) + 1 if ids.size else 0
-        return _segment_reduce(a, ids, n, pooltype.upper())
+        return _segment_reduce(a, ids, n, _reduce_name(pooltype))
 
     return apply("segment_pool", f, x, segment_ids)
 

@@ -310,3 +310,38 @@ def test_hybrid_step_custom_loss_equality():
     ids = paddle.to_tensor(ids_np)
     dygraph = [float(dstep(ids, ids).numpy()) for _ in range(STEPS)]
     np.testing.assert_allclose(hybrid, dygraph, rtol=2e-4, atol=1e-5)
+
+
+def test_llama_zbv_hybrid_step_loss_equality():
+    """LLaMA (GQA + SwiGLU + untied head) on the ZB-V schedule: the second
+    model family through the V-placement engine, equality vs dygraph."""
+    from jax.sharding import Mesh
+    from paddle_tpu.distributed.auto_parallel.hybrid import HybridTrainStep
+    from paddle_tpu.jit.api import TrainStep
+    from paddle_tpu.models.llama import (
+        LlamaForCausalLM,
+        LlamaPretrainingCriterion,
+        llama_tiny,
+    )
+
+    paddle.framework.random.seed(6)
+    model = LlamaForCausalLM(llama_tiny(num_layers=4))
+    ids_np = _data()
+
+    mesh = Mesh(np.asarray(jax.devices()[:8]).reshape(2, 2, 2),
+                ("pp", "mp", "dp"))
+    optimizer = opt.AdamW(learning_rate=LR, weight_decay=WD,
+                          parameters=model.parameters())
+    step = HybridTrainStep(model, mesh, optimizer, pp_axis="pp",
+                           mp_axis="mp", dp_axis="dp", num_microbatches=4,
+                           policy="ZBV")
+    assert step._zbv
+    hybrid = [float(step(ids_np, ids_np).numpy()) for _ in range(STEPS)]
+
+    criterion = LlamaPretrainingCriterion(model.config)
+    optimizer2 = opt.AdamW(learning_rate=LR, weight_decay=WD,
+                           parameters=model.parameters())
+    dstep = TrainStep(model, lambda m, i, t: criterion(m(i), t), optimizer2)
+    ids = paddle.to_tensor(ids_np)
+    dygraph = [float(dstep(ids, ids).numpy()) for _ in range(STEPS)]
+    np.testing.assert_allclose(hybrid, dygraph, rtol=2e-4, atol=1e-5)

@@ -1,0 +1,212 @@
+"""paddle.geometric parity: segment math, message passing, reindex,
+sampling. Expected values come straight from the reference docstring
+examples (python/paddle/geometric/math.py, message_passing/send_recv.py,
+reindex.py, sampling/neighbors.py)."""
+
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu import geometric as G
+
+
+def _t(a, dtype=None):
+    return paddle.to_tensor(np.asarray(a, dtype=dtype))
+
+
+# ---------------------------------------------------------------- segment ops
+
+def test_segment_sum_mean_min_max():
+    data = _t([[1.0, 2.0, 3.0], [3.0, 2.0, 1.0], [4.0, 5.0, 6.0]],
+              np.float32)
+    ids = _t([0, 0, 1], np.int32)
+    np.testing.assert_allclose(G.segment_sum(data, ids).numpy(),
+                               [[4, 4, 4], [4, 5, 6]])
+    np.testing.assert_allclose(G.segment_mean(data, ids).numpy(),
+                               [[2, 2, 2], [4, 5, 6]])
+    np.testing.assert_allclose(G.segment_min(data, ids).numpy(),
+                               [[1, 2, 1], [4, 5, 6]])
+    np.testing.assert_allclose(G.segment_max(data, ids).numpy(),
+                               [[3, 2, 3], [4, 5, 6]])
+
+
+def test_segment_empty_segment_fills_zero():
+    # id 1 has no rows: every reduce (incl. min/max) yields 0 there, not inf
+    data = _t([[1.0, 2.0], [5.0, 6.0]], np.float32)
+    ids = _t([0, 2], np.int32)
+    for op in (G.segment_sum, G.segment_mean, G.segment_min, G.segment_max):
+        out = op(data, ids).numpy()
+        assert out.shape == (3, 2)
+        np.testing.assert_allclose(out[1], [0.0, 0.0])
+
+
+def test_segment_sum_grad():
+    data = _t([[1.0, 2.0], [3.0, 4.0], [5.0, 6.0]], np.float32)
+    data.stop_gradient = False
+    ids = _t([0, 0, 1], np.int32)
+    out = G.segment_sum(data, ids)
+    out.sum().backward()
+    np.testing.assert_allclose(data.grad.numpy(), np.ones((3, 2)))
+
+
+# ----------------------------------------------------------- message passing
+
+def test_send_u_recv_docstring_examples():
+    x = _t([[0, 2, 3], [1, 4, 5], [2, 6, 7]], np.float32)
+    src = _t([0, 1, 2, 0], np.int32)
+    dst = _t([1, 2, 1, 0], np.int32)
+    np.testing.assert_allclose(
+        G.send_u_recv(x, src, dst, reduce_op="sum").numpy(),
+        [[0, 2, 3], [2, 8, 10], [1, 4, 5]])
+    # out_size truncation keeps only the first rows (docstring example 2)
+    src2 = _t([0, 2, 0], np.int32)
+    dst2 = _t([1, 1, 0], np.int32)
+    np.testing.assert_allclose(
+        G.send_u_recv(x, src2, dst2, reduce_op="sum", out_size=2).numpy(),
+        [[0, 2, 3], [2, 8, 10]])
+    # docstring example 3: WITHOUT out_size the output keeps x's row
+    # count — the dangling node 2 gets a zero row
+    np.testing.assert_allclose(
+        G.send_u_recv(x, src2, dst2, reduce_op="sum").numpy(),
+        [[0, 2, 3], [2, 8, 10], [0, 0, 0]])
+
+
+def test_send_u_recv_mean_max_min():
+    x = _t([[0, 2, 3], [1, 4, 5], [2, 6, 7]], np.float32)
+    src = _t([0, 1, 2, 0], np.int32)
+    dst = _t([1, 2, 1, 0], np.int32)
+    np.testing.assert_allclose(
+        G.send_u_recv(x, src, dst, reduce_op="mean").numpy(),
+        [[0, 2, 3], [1, 4, 5], [1, 4, 5]])
+    np.testing.assert_allclose(
+        G.send_u_recv(x, src, dst, reduce_op="max").numpy(),
+        [[0, 2, 3], [2, 6, 7], [1, 4, 5]])
+    np.testing.assert_allclose(
+        G.send_u_recv(x, src, dst, reduce_op="min").numpy(),
+        [[0, 2, 3], [0, 2, 3], [1, 4, 5]])
+
+
+def test_send_ue_recv_docstring_example():
+    x = _t([[0, 2, 3], [1, 4, 5], [2, 6, 7]], np.float32)
+    y = _t([1.0, 1.0, 1.0], np.float32)  # feature-broadcast edge term
+    src = _t([0, 1, 2, 0], np.int32)
+    dst = _t([1, 2, 1, 0], np.int32)
+    np.testing.assert_allclose(
+        G.send_ue_recv(x, y, src, dst, "add", "sum").numpy(),
+        [[1, 3, 4], [4, 10, 12], [2, 5, 6]])
+
+
+def test_send_ue_recv_per_edge_feature():
+    x = _t([[1.0, 1.0], [2.0, 2.0]], np.float32)
+    e = _t([10.0, 100.0, 1000.0], np.float32)  # one scalar per edge
+    src = _t([0, 1, 0], np.int32)
+    dst = _t([0, 0, 1], np.int32)
+    np.testing.assert_allclose(
+        G.send_ue_recv(x, e, src, dst, "mul", "sum").numpy(),
+        [[10 + 200, 10 + 200], [1000, 1000]])
+
+
+def test_send_uv():
+    x = _t([[0, 2, 3], [1, 4, 5], [2, 6, 7]], np.float32)
+    y = _t([[1, 1, 1], [2, 2, 2], [3, 3, 3]], np.float32)
+    src = _t([0, 1, 2, 0], np.int32)
+    dst = _t([1, 2, 1, 0], np.int32)
+    np.testing.assert_allclose(
+        G.send_uv(x, y, src, dst, "add").numpy(),
+        [[2, 4, 5], [4, 7, 8], [4, 8, 9], [1, 3, 4]])
+
+
+def test_message_passing_grad_flows():
+    x = _t(np.random.default_rng(0).normal(size=(4, 3)).astype(np.float32))
+    x.stop_gradient = False
+    src = _t([0, 1, 2, 3, 0], np.int32)
+    dst = _t([1, 0, 3, 2, 2], np.int32)
+    out = G.send_u_recv(x, src, dst, reduce_op="sum")
+    out.sum().backward()
+    # node 0 feeds two edges, others one
+    np.testing.assert_allclose(x.grad.numpy()[0], [2, 2, 2])
+    np.testing.assert_allclose(x.grad.numpy()[1], [1, 1, 1])
+
+
+# ------------------------------------------------------------------- reindex
+
+def test_reindex_graph_docstring_example():
+    x = _t([0, 1, 2], np.int64)
+    neighbors = _t([8, 9, 0, 4, 7, 6, 7], np.int64)
+    count = _t([2, 3, 2], np.int32)
+    src, dst, nodes = G.reindex_graph(x, neighbors, count)
+    np.testing.assert_array_equal(src.numpy(), [3, 4, 0, 5, 6, 7, 6])
+    np.testing.assert_array_equal(dst.numpy(), [0, 0, 1, 1, 1, 2, 2])
+    np.testing.assert_array_equal(nodes.numpy(), [0, 1, 2, 8, 9, 4, 7, 6])
+
+
+def test_reindex_heter_graph():
+    x = _t([0, 1, 2], np.int64)
+    n1 = _t([8, 9, 0, 4, 7, 6, 7], np.int64)
+    c1 = _t([2, 3, 2], np.int32)
+    n2 = _t([0, 2, 3, 5, 1], np.int64)
+    c2 = _t([1, 3, 1], np.int32)
+    src, dst, nodes = G.reindex_heter_graph(x, [n1, n2], [c1, c2])
+    # shared id space: nodes = [0,1,2, 8,9,4,7,6, 3,5]
+    np.testing.assert_array_equal(nodes.numpy(), [0, 1, 2, 8, 9, 4, 7, 6,
+                                                  3, 5])
+    np.testing.assert_array_equal(src.numpy()[:7], [3, 4, 0, 5, 6, 7, 6])
+    np.testing.assert_array_equal(src.numpy()[7:], [0, 2, 8, 9, 1])
+    np.testing.assert_array_equal(dst.numpy(),
+                                  [0, 0, 1, 1, 1, 2, 2, 0, 1, 1, 1, 2])
+
+
+# ------------------------------------------------------------------ sampling
+
+def _csc():
+    # graph: neighbors-in-CSC; node n's in-neighbors = row[colptr[n]:colptr[n+1]]
+    row = np.asarray([3, 7, 0, 9, 1, 4, 5, 6, 2, 8], np.int64)
+    colptr = np.asarray([0, 2, 4, 8, 10, 10], np.int64)
+    return _t(row), _t(colptr)
+
+
+def test_sample_neighbors_full_and_partial():
+    row, colptr = _csc()
+    nodes = _t([0, 2, 4], np.int64)
+    paddle.seed(0)
+    neigh, cnt = G.sample_neighbors(row, colptr, nodes, sample_size=-1)
+    np.testing.assert_array_equal(cnt.numpy(), [2, 4, 0])
+    np.testing.assert_array_equal(neigh.numpy(), [3, 7, 1, 4, 5, 6])
+
+    neigh, cnt = G.sample_neighbors(row, colptr, nodes, sample_size=2)
+    np.testing.assert_array_equal(cnt.numpy(), [2, 2, 0])
+    # sampled node-2 neighbors are a 2-subset of its true neighbor set
+    assert set(neigh.numpy()[2:4]) <= {1, 4, 5, 6}
+
+
+def test_sample_neighbors_eids_and_reproducibility():
+    row, colptr = _csc()
+    nodes = _t([2], np.int64)
+    eids = _t(np.arange(10), np.int64)
+    paddle.seed(7)
+    n1, c1, e1 = G.sample_neighbors(row, colptr, nodes, sample_size=3,
+                                    eids=eids, return_eids=True)
+    # eids pick the same positions as the neighbors
+    np.testing.assert_array_equal(row.numpy()[e1.numpy()], n1.numpy())
+    paddle.seed(7)
+    n2, _, _ = G.sample_neighbors(row, colptr, nodes, sample_size=3,
+                                  eids=eids, return_eids=True)
+    np.testing.assert_array_equal(n1.numpy(), n2.numpy())
+
+
+def test_weighted_sample_neighbors_bias():
+    row, colptr = _csc()
+    nodes = _t([2], np.int64)
+    # node 2's neighbors sit at CSC positions 4..8 -> row[4:8] = [1, 4, 5,
+    # 6]; weight is per-EDGE (CSC position), heavy mass on position 5 ->
+    # neighbor row[5] == 4
+    weight = _t(np.asarray([1, 1, 1, 1, 0.001, 1000.0, 0.001, 1, 1, 1],
+                           np.float32))
+    paddle.seed(1)
+    hits = 0
+    for _ in range(20):
+        neigh, cnt = G.weighted_sample_neighbors(
+            row, colptr, weight, nodes, sample_size=1)
+        assert cnt.numpy()[0] == 1
+        if neigh.numpy()[0] == 4:
+            hits += 1
+    assert hits >= 18, f"heavy-weight neighbor sampled only {hits}/20"
