@@ -103,6 +103,12 @@ from paddle_tpu import hub  # noqa: E402,F401
 from paddle_tpu import text  # noqa: E402,F401
 from paddle_tpu import audio  # noqa: E402,F401
 from paddle_tpu import geometric  # noqa: E402,F401
+from paddle_tpu import regularizer  # noqa: E402,F401
+from paddle_tpu import signal  # noqa: E402,F401
+from paddle_tpu import reader  # noqa: E402,F401
+from paddle_tpu import callbacks  # noqa: E402,F401
+from paddle_tpu import sysconfig  # noqa: E402,F401
+from paddle_tpu.batch import batch  # noqa: E402,F401
 from paddle_tpu import onnx  # noqa: E402,F401
 from paddle_tpu import inference  # noqa: E402,F401
 from paddle_tpu.ops import linalg  # noqa: E402,F401

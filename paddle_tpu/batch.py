@@ -1,0 +1,25 @@
+"""paddle.batch parity (reference: python/paddle/batch.py): wrap a sample
+reader into a minibatch reader."""
+
+from __future__ import annotations
+
+__all__ = ["batch"]
+
+
+def batch(reader, batch_size, drop_last=False):
+    """Yield lists of ``batch_size`` samples from ``reader`` (a callable
+    returning an iterable, the legacy reader protocol)."""
+    if batch_size <= 0:
+        raise ValueError(f"batch_size should be positive, got {batch_size}")
+
+    def batch_reader():
+        buf = []
+        for sample in reader():
+            buf.append(sample)
+            if len(buf) == batch_size:
+                yield buf
+                buf = []
+        if buf and not drop_last:
+            yield buf
+
+    return batch_reader

@@ -1,0 +1,15 @@
+"""paddle.callbacks parity (reference: python/paddle/callbacks/__init__.py
+— re-exports of the hapi callbacks)."""
+
+from paddle_tpu.hapi.callbacks import (  # noqa: F401
+    Callback,
+    EarlyStopping,
+    LRScheduler,
+    ModelCheckpoint,
+    ProgBarLogger,
+    ReduceLROnPlateau,
+    VisualDL,
+)
+
+__all__ = ["Callback", "ProgBarLogger", "ModelCheckpoint", "VisualDL",
+           "LRScheduler", "EarlyStopping", "ReduceLROnPlateau"]

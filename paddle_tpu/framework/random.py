@@ -61,6 +61,18 @@ def next_key():
     return sub
 
 
+def np_rng():
+    """A numpy Generator seeded from the framework RNG stream — host-side
+    randomness (data pipeline shuffles, graph sampling) that reproduces
+    under paddle.seed."""
+    import jax
+    import numpy as np
+
+    key = next_key()
+    seed = int(np.asarray(jax.random.key_data(key)).reshape(-1)[-1])
+    return np.random.default_rng(seed & 0x7FFFFFFF)
+
+
 @contextlib.contextmanager
 def traced_key(key):
     """Thread a (possibly traced) key through random ops inside a capture."""

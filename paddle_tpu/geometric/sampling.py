@@ -16,13 +16,9 @@ from paddle_tpu.geometric._host import as_np as _as_np, wrap as _wrap
 
 
 def _np_rng():
-    import jax
+    from paddle_tpu.framework.random import np_rng
 
-    from paddle_tpu.framework import random as frandom
-
-    key = frandom.next_key()
-    seed = int(np.asarray(jax.random.key_data(key)).reshape(-1)[-1])
-    return np.random.default_rng(seed & 0x7FFFFFFF)
+    return np_rng()
 
 
 def _sample(row, colptr, input_nodes, sample_size, eids, return_eids,

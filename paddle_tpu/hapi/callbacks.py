@@ -232,3 +232,91 @@ def config_callbacks(callbacks=None, model=None, epochs=None, steps=None,
         "metrics": metrics or [], "save_dir": save_dir,
     })
     return clist
+
+
+class ReduceLROnPlateau(Callback):
+    """Reduce lr when a monitored metric plateaus (reference
+    hapi/callbacks.py:1172): after ``patience`` epochs without
+    improvement, lr *= factor (floored at min_lr), then ``cooldown``
+    epochs of grace."""
+
+    def __init__(self, monitor="loss", factor=0.1, patience=10, verbose=1,
+                 mode="auto", min_delta=0, cooldown=0, min_lr=0):
+        super().__init__()
+        self.monitor = monitor
+        self.factor = float(factor)
+        self.patience = patience
+        self.verbose = verbose
+        self.min_delta = abs(min_delta)
+        self.cooldown = cooldown
+        self.min_lr = min_lr
+        if mode == "auto":
+            mode = "max" if "acc" in monitor else "min"
+        self.mode = mode
+        self._reset()
+
+    def _reset(self):
+        self.best = -np.inf if self.mode == "max" else np.inf
+        self.wait = 0
+        self.cooldown_counter = 0
+
+    def _improved(self, cur):
+        if self.mode == "max":
+            return cur > self.best + self.min_delta
+        return cur < self.best - self.min_delta
+
+    def on_train_begin(self, logs=None):
+        self._reset()
+
+    def on_epoch_end(self, epoch, logs=None):
+        logs = logs or {}
+        cur = logs.get(self.monitor)
+        if cur is None:
+            return
+        if isinstance(cur, (list, tuple, np.ndarray)):
+            cur = float(np.asarray(cur).reshape(-1)[0])
+        if self.cooldown_counter > 0:
+            self.cooldown_counter -= 1
+            self.wait = 0
+        if self._improved(cur):
+            self.best = cur
+            self.wait = 0
+            return
+        if self.cooldown_counter > 0:
+            return
+        self.wait += 1
+        if self.wait >= self.patience:
+            opt = getattr(self.model, "_optimizer", None)
+            if opt is None:
+                return
+            old = float(opt.get_lr())
+            new = max(old * self.factor, self.min_lr)
+            if new < old:
+                try:
+                    opt.set_lr(new)
+                except RuntimeError:
+                    # scheduler-backed lr cannot be overridden (reference
+                    # warns and skips non-float lr rather than aborting fit)
+                    import warnings
+
+                    warnings.warn(
+                        "ReduceLROnPlateau: optimizer lr is driven by an "
+                        "LRScheduler; skipping plateau reduction")
+                    return
+                if self.verbose:
+                    print(f"Epoch {epoch}: ReduceLROnPlateau reducing "
+                          f"learning rate to {new}.")
+            self.cooldown_counter = self.cooldown
+            self.wait = 0
+
+
+class VisualDL(Callback):
+    """VisualDL logger (reference hapi/callbacks.py:883). The visualdl
+    package is not available on this image; instantiating raises with
+    that exact explanation rather than failing deep inside fit()."""
+
+    def __init__(self, log_dir):
+        raise ImportError(
+            "VisualDL is not installed in this environment; use "
+            "paddle.callbacks.ProgBarLogger / your own Callback for "
+            "logging, or install visualdl")

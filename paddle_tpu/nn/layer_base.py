@@ -129,6 +129,7 @@ class Layer:
         init = default_initializer
         name = None
         learning_rate = 1.0
+        regularizer = None
         if attr is not None and attr is not False:
             from paddle_tpu.nn.param_attr import ParamAttr
 
@@ -136,6 +137,7 @@ class Layer:
                 init = attr.initializer or init
                 name = attr.name
                 learning_rate = attr.learning_rate
+                regularizer = getattr(attr, "regularizer", None)
             elif isinstance(attr, I.Initializer):
                 init = attr
         if init is None:
@@ -143,6 +145,10 @@ class Layer:
         value = init(tuple(shape), dtype)
         p = Parameter(value, trainable=True, name=name or "")
         p.optimize_attr = {"learning_rate": learning_rate}
+        if regularizer is not None:
+            # per-param paddle.regularizer override, honored by
+            # Optimizer.step (optimizer.py step loop)
+            p.regularizer = regularizer
         return p
 
     def create_tensor(self, name=None, persistable=False, dtype=None):

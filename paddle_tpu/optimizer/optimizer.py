@@ -19,10 +19,16 @@ import jax.numpy as jnp
 from paddle_tpu.autograd import no_grad
 from paddle_tpu.framework import dtype as dtypes
 from paddle_tpu.optimizer import lr as lr_mod
+from paddle_tpu.regularizer import WeightDecayRegularizer
 from paddle_tpu.tensor import Parameter, Tensor
 
 
 class Optimizer:
+    # True on optimizers whose float weight_decay is DECOUPLED from the
+    # gradient (AdamW): a grad-penalty regularizer then composes with it
+    # instead of replacing it
+    _decoupled_wd = False
+
     def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None,
                  grad_clip=None, name=None, multi_precision=False):
         self._lr = learning_rate
@@ -114,8 +120,22 @@ class Optimizer:
                 target = to_device_memory(target)
             if g.dtype != target.dtype:
                 g = g.astype(target.dtype)
+            # paddle.regularizer semantics: a WeightDecayRegularizer (per
+            # param, else optimizer-level) appends its penalty to the GRAD.
+            # Coupled-decay optimizers (float wd == L2 grad penalty) then
+            # zero their plain decay for that param; AdamW's decay is
+            # DECOUPLED and orthogonal — the reference applies both.
+            reg = getattr(p, "regularizer", None)
+            if reg is None and isinstance(self._weight_decay,
+                                          WeightDecayRegularizer):
+                reg = self._weight_decay
+            if isinstance(reg, WeightDecayRegularizer):
+                g = reg._append(g, target)
+                wd = self._decay_for(p) if self._decoupled_wd else 0.0
+            else:
+                wd = self._decay_for(p)
             new_target, state_update = self._apply_one(
-                target, g, lr, state, self._decay_for(p)
+                target, g, lr, state, wd
             )
             if offload:
                 # keep optimizer states / fp32 masters resident in pinned
@@ -348,11 +368,20 @@ class Adam(Optimizer):
 
 class AdamW(Adam):
     _update = staticmethod(_adamw_update)
+    _decoupled_wd = True
 
     def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-08,
                  parameters=None, weight_decay=0.01, lr_ratio=None,
                  apply_decay_param_fun=None, grad_clip=None, lazy_mode=False,
                  multi_precision=False, name=None, moment_dtype=None):
+        from paddle_tpu.regularizer import WeightDecayRegularizer
+
+        if isinstance(weight_decay, WeightDecayRegularizer):
+            # reference AdamW restricts weight_decay to float/Tensor — its
+            # decay is DECOUPLED, not a grad-penalty regularizer
+            raise TypeError(
+                "AdamW weight_decay must be a float (decoupled decay); "
+                "use Adam/Momentum/SGD with a paddle.regularizer")
         super().__init__(learning_rate, beta1, beta2, epsilon, parameters,
                          weight_decay, grad_clip, lazy_mode, multi_precision,
                          name=name, moment_dtype=moment_dtype)

@@ -1,0 +1,195 @@
+"""paddle.reader parity (reference: python/paddle/reader/decorator.py) —
+the legacy reader-decorator toolkit. multiprocess_reader is served by the
+threaded buffered() on this platform (the DataLoader owns real worker
+processes; reference decorator.py:498)."""
+
+from __future__ import annotations
+
+import itertools
+import queue as _queue
+import threading
+
+__all__ = ["cache", "map_readers", "shuffle", "chain", "compose",
+           "buffered", "firstn", "xmap_readers", "multiprocess_reader",
+           "ComposeNotAligned"]
+
+
+class ComposeNotAligned(ValueError):
+    pass
+
+
+def cache(reader):
+    """Cache all samples in memory on first pass (decorator.py:45)."""
+    all_data = []
+    filled = []
+
+    def rd():
+        if not filled:
+            all_data.extend(reader())
+            filled.append(True)
+        return iter(all_data)
+
+    return rd
+
+
+def map_readers(func, *readers):
+    """Zip readers and map func over the tuples (decorator.py:86)."""
+    def rd():
+        its = [r() for r in readers]
+        for sample in zip(*its):
+            yield func(*sample)
+
+    return rd
+
+
+def shuffle(reader, buf_size):
+    """Buffered shuffle using the framework RNG (decorator.py:127)."""
+    def rd():
+        from paddle_tpu.framework.random import np_rng
+
+        rng = np_rng()
+        buf = []
+        for e in reader():
+            buf.append(e)
+            if len(buf) >= buf_size:
+                rng.shuffle(buf)
+                yield from buf
+                buf = []
+        if buf:
+            rng.shuffle(buf)
+            yield from buf
+
+    return rd
+
+
+def chain(*readers):
+    """Concatenate readers (decorator.py:172)."""
+    def rd():
+        return itertools.chain(*[r() for r in readers])
+
+    return rd
+
+
+def compose(*readers, **kwargs):
+    """Yield flattened tuples across readers (decorator.py:235).
+    ``check_alignment=True`` (default) raises ComposeNotAligned when the
+    readers differ in length; False silently truncates at the shortest."""
+    check_alignment = kwargs.pop("check_alignment", True)
+
+    def make_tuple(x):
+        return x if isinstance(x, tuple) else (x,)
+
+    def rd():
+        its = [r() for r in readers]
+        if not check_alignment:
+            for items in zip(*its):
+                yield sum((make_tuple(i) for i in items), ())
+            return
+        for items in itertools.zip_longest(*its):
+            if any(i is None for i in items):
+                raise ComposeNotAligned(
+                    "outputs of readers are not aligned")
+            yield sum((make_tuple(i) for i in items), ())
+
+    return rd
+
+
+def buffered(reader, size):
+    """Read-ahead through a bounded queue on a worker thread
+    (decorator.py:292). A reader exception propagates to the consumer —
+    a silently truncated stream would train on partial data."""
+    end = object()
+
+    def rd():
+        q = _queue.Queue(maxsize=size)
+        err = []
+
+        def fill():
+            try:
+                for d in reader():
+                    q.put(d)
+            except BaseException as e:  # noqa: BLE001 — re-raised below
+                err.append(e)
+            finally:
+                q.put(end)
+
+        t = threading.Thread(target=fill, daemon=True)
+        t.start()
+        while True:
+            e = q.get()
+            if e is end:
+                break
+            yield e
+        if err:
+            raise err[0]
+
+    return rd
+
+
+def firstn(reader, n):
+    """First n samples (decorator.py:357)."""
+    def rd():
+        return itertools.islice(reader(), n)
+
+    return rd
+
+
+def xmap_readers(mapper, reader, process_num, buffer_size, order=False):
+    """Parallel map over samples with worker THREADS (decorator.py:402 —
+    the reference uses threads here too); ``order`` preserves input
+    order."""
+    def rd():
+        src = enumerate(reader())
+        lock = threading.Lock()
+        out_q = _queue.Queue(maxsize=max(int(buffer_size), 1))
+        done = object()
+        errors = []
+
+        def worker():
+            try:
+                while True:
+                    with lock:
+                        item = next(src, None)
+                    if item is None:
+                        return
+                    i, sample = item
+                    out_q.put((i, mapper(sample)))
+            except BaseException as e:  # noqa: BLE001 — re-raised below
+                errors.append(e)
+            finally:
+                # ALWAYS post the sentinel: a worker dying without it
+                # deadlocks the consumer loop forever
+                out_q.put(done)
+
+        threads = [threading.Thread(target=worker, daemon=True)
+                   for _ in range(process_num)]
+        for t in threads:
+            t.start()
+        finished, results, next_i = 0, {}, 0
+        while finished < len(threads):
+            e = out_q.get()
+            if e is done:
+                finished += 1
+                continue
+            i, mapped = e
+            if not order:
+                yield mapped
+            else:
+                results[i] = mapped
+                while next_i in results:
+                    yield results.pop(next_i)
+                    next_i += 1
+        if errors:
+            raise errors[0]
+        if order:
+            for i in sorted(results):
+                yield results[i]
+
+    return rd
+
+
+def multiprocess_reader(readers, use_pipe=True, queue_size=1000):
+    """Reference decorator.py:498 — fan-in multiple readers. Served with
+    threads on this platform (io.DataLoader owns real worker processes)."""
+    del use_pipe
+    return buffered(chain(*readers), queue_size)

@@ -1,0 +1,50 @@
+"""paddle.regularizer parity (reference: python/paddle/regularizer.py:23
+__all__ = ['L1Decay', 'L2Decay']).
+
+Reference semantics: a WeightDecayRegularizer passed as an optimizer's
+``weight_decay`` (or attached per-parameter) appends its penalty to the
+GRADIENT before the update — L2: g += coeff * p; L1: g += coeff *
+sign(p). This is distinct from AdamW's decoupled float decay (which the
+reference's AdamW restricts to float/Tensor, as does ours).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+__all__ = ["L1Decay", "L2Decay", "WeightDecayRegularizer"]
+
+
+class WeightDecayRegularizer:
+    """Base class (reference base/regularizer.py). Subclasses implement
+    ``_append(grad, param) -> grad``."""
+
+    coeff: float = 0.0
+
+    def _append(self, grad, param):
+        raise NotImplementedError
+
+    def __repr__(self):
+        return f"{type(self).__name__}, coeff={self.coeff}"
+
+
+class L2Decay(WeightDecayRegularizer):
+    """loss += 0.5 * coeff * sum(p^2)  =>  g += coeff * p."""
+
+    def __init__(self, coeff=0.0):
+        self.coeff = float(coeff)
+
+    def _append(self, grad, param):
+        return grad + jnp.asarray(self.coeff, grad.dtype) * param.astype(
+            grad.dtype)
+
+
+class L1Decay(WeightDecayRegularizer):
+    """loss += coeff * sum(|p|)  =>  g += coeff * sign(p)."""
+
+    def __init__(self, coeff=0.0):
+        self.coeff = float(coeff)
+
+    def _append(self, grad, param):
+        return grad + jnp.asarray(self.coeff, grad.dtype) * jnp.sign(
+            param).astype(grad.dtype)

@@ -1,0 +1,72 @@
+"""paddle.signal parity (reference: python/paddle/signal.py — stft :246,
+istft :423).
+
+stft rides the registered op (ops/signal_quant_ops.py); istft is the
+least-squares overlap-add inverse with window-envelope normalization
+(the NOLA-conditioned Griffin-Lim optimal estimate the reference
+documents).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.dispatch import apply
+from paddle_tpu.ops.signal_quant_ops import stft  # noqa: F401
+
+__all__ = ["stft", "istft"]
+
+
+def istft(x, n_fft, hop_length=None, win_length=None, window=None,
+          center=True, normalized=False, onesided=True, length=None,
+          return_complex=False, name=None):
+    """Inverse STFT (signal.py:423): x is [..., n_fft//2+1 | n_fft,
+    num_frames] complex; returns the least-squares overlap-add signal."""
+    hop = hop_length or n_fft // 4
+    wl = win_length or n_fft
+
+    def f(spec, *w):
+        sp = jnp.swapaxes(spec, -1, -2)  # [..., frames, freq]
+        if normalized:
+            sp = sp * jnp.sqrt(jnp.asarray(n_fft, jnp.float32))
+        if onesided:
+            frames = jnp.fft.irfft(sp, n=n_fft)
+        else:
+            frames = jnp.fft.ifft(sp, n=n_fft)
+            if not return_complex:
+                frames = frames.real
+        if w:
+            win = w[0].astype(frames.real.dtype)
+            if wl < n_fft:
+                pad = (n_fft - wl) // 2
+                win = jnp.pad(win, (pad, n_fft - wl - pad))
+        else:
+            win = jnp.ones((n_fft,), frames.real.dtype)
+        frames = frames * win
+
+        n = frames.shape[-2]
+        t = (n - 1) * hop + n_fft
+        starts = jnp.arange(n) * hop
+        idx = (starts[:, None] + jnp.arange(n_fft)[None, :]).reshape(-1)
+
+        lead = frames.shape[:-2]
+        flat = frames.reshape((-1, n, n_fft))
+
+        def one(fr):
+            return jnp.zeros((t,), fr.dtype).at[idx].add(fr.reshape(-1))
+
+        sig = jax.vmap(one)(flat).reshape(lead + (t,))
+        # least-squares normalization: divide by the summed squared-window
+        # envelope (NOLA guarantees it is nonzero where signal exists)
+        env = jnp.zeros((t,), win.dtype).at[idx].add(
+            jnp.tile(win * win, (n,)))
+        sig = sig / jnp.maximum(env, jnp.asarray(1e-11, env.dtype))
+        if center:
+            sig = sig[..., n_fft // 2: t - n_fft // 2]
+        if length is not None:
+            sig = sig[..., :length]
+        return sig
+
+    args = (x,) + ((window,) if window is not None else ())
+    return apply("istft", f, *args)

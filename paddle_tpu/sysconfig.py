@@ -1,0 +1,21 @@
+"""paddle.sysconfig parity (reference: python/paddle/sysconfig.py:17)."""
+
+import os
+
+__all__ = ["get_include", "get_lib"]
+
+
+def _pkg_root():
+    return os.path.dirname(os.path.abspath(__file__))
+
+
+def get_include():
+    """Directory containing the framework's C/C++ headers (the native
+    runtime components' sources live under native/)."""
+    return os.path.join(_pkg_root(), "include")
+
+
+def get_lib():
+    """Directory containing the framework's shared libraries (built
+    native/ artifacts)."""
+    return os.path.join(_pkg_root(), "libs")
