@@ -26,7 +26,23 @@ def fused_rms_norm(x, norm_weight=None, norm_bias=None, epsilon=1e-6,
                    quant_scale=-1, **kwargs):
     """fused_rms_norm (incubate/nn/functional/fused_rms_norm.py): optional
     bias+residual add fused ahead of the norm. Returns (out, residual_out)
-    when residual is given, else out."""
+    when residual is given, else out.
+
+    The plain weight-only last-axis case routes through
+    ``ops.pallas.fused_rms_norm.rms_norm_routed`` — the hand-written
+    Pallas kernel on TPU-class chips (one HBM pass each way, fp32 row
+    rstd saved as the backward residual), XLA composition otherwise;
+    path selection is observable via that module's ``_last_path``.
+    nn.functional.rms_norm (the models' path) routes there too."""
+    simple = (norm_weight is not None and norm_bias is None
+              and bias is None and residual is None
+              and begin_norm_axis in (-1, getattr(x, "ndim", 0) - 1))
+    if simple:
+        from paddle_tpu.ops.pallas.fused_rms_norm import rms_norm_routed
+
+        return apply("fused_rms_norm",
+                     lambda xv, wv: rms_norm_routed(xv, wv, epsilon),
+                     x, norm_weight)
 
     def f(xv, *rest):
         it = iter(rest)

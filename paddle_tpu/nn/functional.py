@@ -294,14 +294,14 @@ def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-05, name=
 
 
 def rms_norm(x, weight=None, epsilon=1e-6, name=None):
-    """RMSNorm (reference: fused_rms_norm in incubate/nn/functional)."""
+    """RMSNorm (reference: fused_rms_norm in incubate/nn/functional).
+    Routes through the hand-written Pallas kernel on TPU-class chips
+    (ops/pallas/fused_rms_norm.py) — this is the path nn.RMSNorm and the
+    LLaMA models take."""
+    from paddle_tpu.ops.pallas.fused_rms_norm import rms_norm_routed
 
     def f(a, *w):
-        var = jnp.mean(jnp.square(a.astype(jnp.float32)), axis=-1, keepdims=True)
-        out = (a.astype(jnp.float32) * jax.lax.rsqrt(var + epsilon)).astype(a.dtype)
-        if w:
-            out = out * w[0]
-        return out
+        return rms_norm_routed(a, w[0] if w else None, epsilon)
 
     args = [weight] if weight is not None else []
     return apply("rms_norm", f, x, *args)

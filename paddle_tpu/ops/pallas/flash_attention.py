@@ -33,6 +33,7 @@ _last_path = None
 _warned_fallback = False
 _warned_fallback_splash = False
 _warned_traced_cu = False
+_warned_fallback_rms = False  # set via _warn_kernel_fallback from fused_rms_norm
 
 
 def _dropout(x, p, training):
