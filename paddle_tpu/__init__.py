@@ -124,7 +124,9 @@ from paddle_tpu.framework.tensor_array import (  # noqa: E402,F401
 )
 from paddle_tpu.ops import parity as _op_parity  # noqa: E402,F401  (registers ref-named ops)
 
-__version__ = "0.1.0"
+from paddle_tpu import version  # noqa: E402,F401
+
+__version__ = version.full_version
 
 
 def disable_static():

@@ -206,3 +206,18 @@ def test_per_param_regularizer_and_adamw_compose():
     decay_only = w0 * (1 - 0.1 * 0.01)
     assert not np.allclose(lin2.weight.numpy(), decay_only)
     assert not np.allclose(lin2.weight.numpy(), w0)
+
+
+def test_version_module():
+    import re
+
+    import paddle_tpu.version as v
+
+    assert re.fullmatch(r"\d+\.\d+\.\d+([a-z]+\d+)?", v.full_version)
+    assert v.full_version.startswith(f"{v.major}.{v.minor}.")
+    assert paddle.__version__ == v.full_version
+    assert v.cuda() == "False" and v.cudnn() == "False"
+    # a resolved commit is a full 40-char sha; anything else must be the
+    # explicit Unknown fallback (no partial/garbled strings)
+    assert v.commit == "Unknown" or re.fullmatch(r"[0-9a-f]{40}", v.commit)
+    v.show()  # must not raise
