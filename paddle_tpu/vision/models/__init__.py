@@ -9,6 +9,12 @@ from paddle_tpu.vision.models.resnet import (  # noqa: F401
     resnet50,
     resnet101,
     resnet152,
+    resnext50_32x4d,
+    resnext50_64x4d,
+    resnext101_32x4d,
+    resnext101_64x4d,
+    resnext152_32x4d,
+    resnext152_64x4d,
     wide_resnet50_2,
     wide_resnet101_2,
 )
@@ -24,6 +30,16 @@ from paddle_tpu.vision.models.mobilenet import (  # noqa: F401
     MobileNetV2,
     mobilenet_v1,
     mobilenet_v2,
+)
+from paddle_tpu.vision.models.mobilenetv3 import (  # noqa: F401
+    MobileNetV3Large,
+    MobileNetV3Small,
+    mobilenet_v3_large,
+    mobilenet_v3_small,
+)
+from paddle_tpu.vision.models.inceptionv3 import (  # noqa: F401
+    InceptionV3,
+    inception_v3,
 )
 from paddle_tpu.vision.models.densenet import (  # noqa: F401
     DenseNet,

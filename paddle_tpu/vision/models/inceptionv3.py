@@ -1,0 +1,197 @@
+"""InceptionV3 (parity: python/paddle/vision/models/inceptionv3.py:36-600).
+
+TPU note: the asymmetric (1,7)/(7,1) factorized convs lower to XLA convs
+directly; branch concats are channel-axis concat of independently-
+convolved tensors, which XLA schedules as parallel contractions.
+"""
+
+import math
+
+import paddle_tpu.nn as nn
+from paddle_tpu.nn import ParamAttr
+from paddle_tpu.nn import initializer as I
+from paddle_tpu.ops.manipulation import concat
+
+
+class _ConvBN(nn.Layer):
+    def __init__(self, in_c, out_c, kernel, stride=1, padding=0):
+        super().__init__()
+        self.conv = nn.Conv2D(in_c, out_c, kernel, stride=stride,
+                              padding=padding, bias_attr=False)
+        self.bn = nn.BatchNorm2D(out_c)
+        self.act = nn.ReLU()
+
+    def forward(self, x):
+        return self.act(self.bn(self.conv(x)))
+
+
+class InceptionStem(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.conv_1a_3x3 = _ConvBN(3, 32, 3, stride=2)
+        self.conv_2a_3x3 = _ConvBN(32, 32, 3)
+        self.conv_2b_3x3 = _ConvBN(32, 64, 3, padding=1)
+        self.max_pool = nn.MaxPool2D(kernel_size=3, stride=2, padding=0)
+        self.conv_3b_1x1 = _ConvBN(64, 80, 1)
+        self.conv_4a_3x3 = _ConvBN(80, 192, 3)
+
+    def forward(self, x):
+        x = self.conv_2b_3x3(self.conv_2a_3x3(self.conv_1a_3x3(x)))
+        x = self.conv_4a_3x3(self.conv_3b_1x1(self.max_pool(x)))
+        return self.max_pool(x)
+
+
+class InceptionA(nn.Layer):
+    def __init__(self, num_channels, pool_features):
+        super().__init__()
+        self.branch1x1 = _ConvBN(num_channels, 64, 1)
+        self.branch5x5_1 = _ConvBN(num_channels, 48, 1)
+        self.branch5x5_2 = _ConvBN(48, 64, 5, padding=2)
+        self.branch3x3dbl_1 = _ConvBN(num_channels, 64, 1)
+        self.branch3x3dbl_2 = _ConvBN(64, 96, 3, padding=1)
+        self.branch3x3dbl_3 = _ConvBN(96, 96, 3, padding=1)
+        self.branch_pool = nn.AvgPool2D(kernel_size=3, stride=1, padding=1,
+                                        exclusive=False)
+        self.branch_pool_conv = _ConvBN(num_channels, pool_features, 1)
+
+    def forward(self, x):
+        b1 = self.branch1x1(x)
+        b5 = self.branch5x5_2(self.branch5x5_1(x))
+        b3 = self.branch3x3dbl_3(self.branch3x3dbl_2(self.branch3x3dbl_1(x)))
+        bp = self.branch_pool_conv(self.branch_pool(x))
+        return concat([b1, b5, b3, bp], axis=1)
+
+
+class InceptionB(nn.Layer):
+    def __init__(self, num_channels):
+        super().__init__()
+        self.branch3x3 = _ConvBN(num_channels, 384, 3, stride=2)
+        self.branch3x3dbl_1 = _ConvBN(num_channels, 64, 1)
+        self.branch3x3dbl_2 = _ConvBN(64, 96, 3, padding=1)
+        self.branch3x3dbl_3 = _ConvBN(96, 96, 3, stride=2)
+        self.branch_pool = nn.MaxPool2D(kernel_size=3, stride=2)
+
+    def forward(self, x):
+        b3 = self.branch3x3(x)
+        bd = self.branch3x3dbl_3(self.branch3x3dbl_2(self.branch3x3dbl_1(x)))
+        return concat([b3, bd, self.branch_pool(x)], axis=1)
+
+
+class InceptionC(nn.Layer):
+    def __init__(self, num_channels, channels_7x7):
+        super().__init__()
+        c7 = channels_7x7
+        self.branch1x1 = _ConvBN(num_channels, 192, 1)
+        self.branch7x7_1 = _ConvBN(num_channels, c7, 1)
+        self.branch7x7_2 = _ConvBN(c7, c7, (1, 7), padding=(0, 3))
+        self.branch7x7_3 = _ConvBN(c7, 192, (7, 1), padding=(3, 0))
+        self.branch7x7dbl_1 = _ConvBN(num_channels, c7, 1)
+        self.branch7x7dbl_2 = _ConvBN(c7, c7, (7, 1), padding=(3, 0))
+        self.branch7x7dbl_3 = _ConvBN(c7, c7, (1, 7), padding=(0, 3))
+        self.branch7x7dbl_4 = _ConvBN(c7, c7, (7, 1), padding=(3, 0))
+        self.branch7x7dbl_5 = _ConvBN(c7, 192, (1, 7), padding=(0, 3))
+        self.branch_pool = nn.AvgPool2D(kernel_size=3, stride=1, padding=1,
+                                        exclusive=False)
+        self.branch_pool_conv = _ConvBN(num_channels, 192, 1)
+
+    def forward(self, x):
+        b1 = self.branch1x1(x)
+        b7 = self.branch7x7_3(self.branch7x7_2(self.branch7x7_1(x)))
+        bd = self.branch7x7dbl_5(self.branch7x7dbl_4(self.branch7x7dbl_3(
+            self.branch7x7dbl_2(self.branch7x7dbl_1(x)))))
+        bp = self.branch_pool_conv(self.branch_pool(x))
+        return concat([b1, b7, bd, bp], axis=1)
+
+
+class InceptionD(nn.Layer):
+    def __init__(self, num_channels):
+        super().__init__()
+        self.branch3x3_1 = _ConvBN(num_channels, 192, 1)
+        self.branch3x3_2 = _ConvBN(192, 320, 3, stride=2)
+        self.branch7x7x3_1 = _ConvBN(num_channels, 192, 1)
+        self.branch7x7x3_2 = _ConvBN(192, 192, (1, 7), padding=(0, 3))
+        self.branch7x7x3_3 = _ConvBN(192, 192, (7, 1), padding=(3, 0))
+        self.branch7x7x3_4 = _ConvBN(192, 192, 3, stride=2)
+        self.branch_pool = nn.MaxPool2D(kernel_size=3, stride=2)
+
+    def forward(self, x):
+        b3 = self.branch3x3_2(self.branch3x3_1(x))
+        b7 = self.branch7x7x3_4(self.branch7x7x3_3(self.branch7x7x3_2(
+            self.branch7x7x3_1(x))))
+        return concat([b3, b7, self.branch_pool(x)], axis=1)
+
+
+class InceptionE(nn.Layer):
+    def __init__(self, num_channels):
+        super().__init__()
+        self.branch1x1 = _ConvBN(num_channels, 320, 1)
+        self.branch3x3_1 = _ConvBN(num_channels, 384, 1)
+        self.branch3x3_2a = _ConvBN(384, 384, (1, 3), padding=(0, 1))
+        self.branch3x3_2b = _ConvBN(384, 384, (3, 1), padding=(1, 0))
+        self.branch3x3dbl_1 = _ConvBN(num_channels, 448, 1)
+        self.branch3x3dbl_2 = _ConvBN(448, 384, 3, padding=1)
+        self.branch3x3dbl_3a = _ConvBN(384, 384, (1, 3), padding=(0, 1))
+        self.branch3x3dbl_3b = _ConvBN(384, 384, (3, 1), padding=(1, 0))
+        self.branch_pool = nn.AvgPool2D(kernel_size=3, stride=1, padding=1,
+                                        exclusive=False)
+        self.branch_pool_conv = _ConvBN(num_channels, 192, 1)
+
+    def forward(self, x):
+        b1 = self.branch1x1(x)
+        y = self.branch3x3_1(x)
+        b3 = concat([self.branch3x3_2a(y), self.branch3x3_2b(y)], axis=1)
+        z = self.branch3x3dbl_2(self.branch3x3dbl_1(x))
+        bd = concat([self.branch3x3dbl_3a(z), self.branch3x3dbl_3b(z)],
+                    axis=1)
+        bp = self.branch_pool_conv(self.branch_pool(x))
+        return concat([b1, b3, bd, bp], axis=1)
+
+
+class InceptionV3(nn.Layer):
+    """inceptionv3.py:488."""
+
+    def __init__(self, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        self.inception_stem = InceptionStem()
+        blocks = []
+        for ch, pool_f in zip([192, 256, 288], [32, 64, 64]):
+            blocks.append(InceptionA(ch, pool_f))
+        blocks.append(InceptionB(288))
+        for ch, c7 in zip([768] * 4, [128, 160, 160, 192]):
+            blocks.append(InceptionC(ch, c7))
+        blocks.append(InceptionD(768))
+        for ch in [1280, 2048]:
+            blocks.append(InceptionE(ch))
+        self.inception_block_list = nn.LayerList(blocks)
+        if with_pool:
+            self.avg_pool = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.dropout = nn.Dropout(p=0.2, mode="downscale_in_infer")
+            stdv = 1.0 / math.sqrt(2048.0)
+            self.fc = nn.Linear(
+                2048, num_classes,
+                weight_attr=ParamAttr(initializer=I.Uniform(-stdv, stdv)),
+                bias_attr=ParamAttr())
+
+    def forward(self, x):
+        x = self.inception_stem(x)
+        for block in self.inception_block_list:
+            x = block(x)
+        if self.with_pool:
+            x = self.avg_pool(x)
+        if self.num_classes > 0:
+            x = x.reshape((-1, 2048))
+            x = self.dropout(x)
+            x = self.fc(x)
+        return x
+
+
+def inception_v3(pretrained=False, **kwargs):
+    """inceptionv3.py:588."""
+    if pretrained:
+        raise RuntimeError(
+            "pretrained weights are not downloadable in this environment; "
+            "load a local state dict with paddle.load + set_state_dict")
+    return InceptionV3(**kwargs)

@@ -1,0 +1,170 @@
+"""MobileNetV3 Small/Large (parity: python/paddle/vision/models/
+mobilenetv3.py:183,275,328 — InvertedResidual blocks with squeeze-excite
+and hardswish). Depthwise convs lower to XLA feature-group convolutions;
+SE's global pool + two 1x1 convs fuse into the surrounding elementwise
+chain."""
+
+import paddle_tpu.nn as nn
+
+
+def _make_divisible(v, divisor=8, min_value=None):
+    if min_value is None:
+        min_value = divisor
+    new_v = max(min_value, int(v + divisor / 2) // divisor * divisor)
+    if new_v < 0.9 * v:
+        new_v += divisor
+    return new_v
+
+
+class _ConvBNAct(nn.Sequential):
+    def __init__(self, in_c, out_c, kernel=3, stride=1, groups=1, act=None):
+        layers = [
+            nn.Conv2D(in_c, out_c, kernel, stride=stride,
+                      padding=(kernel - 1) // 2, groups=groups,
+                      bias_attr=False),
+            nn.BatchNorm2D(out_c, epsilon=0.001, momentum=0.99),
+        ]
+        if act is not None:
+            layers.append(act())
+        super().__init__(*layers)
+
+
+class _SqueezeExcite(nn.Layer):
+    def __init__(self, channels, squeeze):
+        super().__init__()
+        self.pool = nn.AdaptiveAvgPool2D(1)
+        self.fc1 = nn.Conv2D(channels, squeeze, 1)
+        self.relu = nn.ReLU()
+        self.fc2 = nn.Conv2D(squeeze, channels, 1)
+        self.hsig = nn.Hardsigmoid()
+
+    def forward(self, x):
+        s = self.hsig(self.fc2(self.relu(self.fc1(self.pool(x)))))
+        return x * s
+
+
+class _InvertedResidualV3(nn.Layer):
+    def __init__(self, in_c, kernel, exp_c, out_c, use_se, act, stride,
+                 scale):
+        super().__init__()
+        in_c = _make_divisible(in_c * scale)
+        exp_c = _make_divisible(exp_c * scale)
+        out_c = _make_divisible(out_c * scale)
+        act_layer = nn.Hardswish if act == "hardswish" else nn.ReLU
+        self.use_res = stride == 1 and in_c == out_c
+        self.expand = in_c != exp_c
+        if self.expand:
+            self.expand_conv = _ConvBNAct(in_c, exp_c, 1, act=act_layer)
+        self.bottleneck_conv = _ConvBNAct(exp_c, exp_c, kernel,
+                                          stride=stride, groups=exp_c,
+                                          act=act_layer)
+        self.use_se = use_se
+        if use_se:
+            self.mid_se = _SqueezeExcite(exp_c, _make_divisible(exp_c // 4))
+        self.linear_conv = _ConvBNAct(exp_c, out_c, 1, act=None)
+
+    def forward(self, x):
+        h = self.expand_conv(x) if self.expand else x
+        h = self.bottleneck_conv(h)
+        if self.use_se:
+            h = self.mid_se(h)
+        h = self.linear_conv(h)
+        return x + h if self.use_res else h
+
+
+# (in, kernel, expanded, out, use_se, act, stride)
+_SMALL = [
+    (16, 3, 16, 16, True, "relu", 2),
+    (16, 3, 72, 24, False, "relu", 2),
+    (24, 3, 88, 24, False, "relu", 1),
+    (24, 5, 96, 40, True, "hardswish", 2),
+    (40, 5, 240, 40, True, "hardswish", 1),
+    (40, 5, 240, 40, True, "hardswish", 1),
+    (40, 5, 120, 48, True, "hardswish", 1),
+    (48, 5, 144, 48, True, "hardswish", 1),
+    (48, 5, 288, 96, True, "hardswish", 2),
+    (96, 5, 576, 96, True, "hardswish", 1),
+    (96, 5, 576, 96, True, "hardswish", 1),
+]
+_LARGE = [
+    (16, 3, 16, 16, False, "relu", 1),
+    (16, 3, 64, 24, False, "relu", 2),
+    (24, 3, 72, 24, False, "relu", 1),
+    (24, 5, 72, 40, True, "relu", 2),
+    (40, 5, 120, 40, True, "relu", 1),
+    (40, 5, 120, 40, True, "relu", 1),
+    (40, 3, 240, 80, False, "hardswish", 2),
+    (80, 3, 200, 80, False, "hardswish", 1),
+    (80, 3, 184, 80, False, "hardswish", 1),
+    (80, 3, 184, 80, False, "hardswish", 1),
+    (80, 3, 480, 112, True, "hardswish", 1),
+    (112, 3, 672, 112, True, "hardswish", 1),
+    (112, 5, 672, 160, True, "hardswish", 2),
+    (160, 5, 960, 160, True, "hardswish", 1),
+    (160, 5, 960, 160, True, "hardswish", 1),
+]
+
+
+class MobileNetV3(nn.Layer):
+    def __init__(self, config, last_channel, scale=1.0, num_classes=1000,
+                 with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        first_c = _make_divisible(config[0][0] * scale)
+        last_in = _make_divisible(config[-1][3] * scale)
+        last_out = last_in * 6
+        self.conv = _ConvBNAct(3, first_c, 3, stride=2, act=nn.Hardswish)
+        self.blocks = nn.Sequential(
+            *[_InvertedResidualV3(*cfg, scale) for cfg in config])
+        self.lastconv = _ConvBNAct(last_in, last_out, 1, act=nn.Hardswish)
+        if with_pool:
+            self.avgpool = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.classifier = nn.Sequential(
+                nn.Linear(last_out, last_channel),
+                nn.Hardswish(),
+                nn.Dropout(p=0.2),
+                nn.Linear(last_channel, num_classes),
+            )
+
+    def forward(self, x):
+        x = self.lastconv(self.blocks(self.conv(x)))
+        if self.with_pool:
+            x = self.avgpool(x)
+        if self.num_classes > 0:
+            x = nn.Flatten(1)(x)
+            x = self.classifier(x)
+        return x
+
+
+class MobileNetV3Small(MobileNetV3):
+    """mobilenetv3.py:275."""
+
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__(_SMALL, _make_divisible(1024 * scale), scale,
+                         num_classes, with_pool)
+
+
+class MobileNetV3Large(MobileNetV3):
+    """mobilenetv3.py:328."""
+
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__(_LARGE, _make_divisible(1280 * scale), scale,
+                         num_classes, with_pool)
+
+
+def mobilenet_v3_small(pretrained=False, scale=1.0, **kwargs):
+    if pretrained:
+        raise RuntimeError(
+            "pretrained weights are not downloadable in this environment; "
+            "load a local state dict with paddle.load + set_state_dict")
+    return MobileNetV3Small(scale=scale, **kwargs)
+
+
+def mobilenet_v3_large(pretrained=False, scale=1.0, **kwargs):
+    if pretrained:
+        raise RuntimeError(
+            "pretrained weights are not downloadable in this environment; "
+            "load a local state dict with paddle.load + set_state_dict")
+    return MobileNetV3Large(scale=scale, **kwargs)

@@ -172,3 +172,35 @@ def wide_resnet50_2(pretrained=False, **kwargs):
 
 def wide_resnet101_2(pretrained=False, **kwargs):
     return _resnet(BottleneckBlock, 101, width=128, pretrained=pretrained, **kwargs)
+
+
+def resnext50_32x4d(pretrained=False, **kwargs):
+    """ResNeXt-50 32x4d (reference resnet.py:531): grouped bottleneck,
+    width-per-group 4."""
+    return _resnet(BottleneckBlock, 50, width=4, pretrained=pretrained,
+                   groups=32, **kwargs)
+
+
+def resnext50_64x4d(pretrained=False, **kwargs):
+    return _resnet(BottleneckBlock, 50, width=4, pretrained=pretrained,
+                   groups=64, **kwargs)
+
+
+def resnext101_32x4d(pretrained=False, **kwargs):
+    return _resnet(BottleneckBlock, 101, width=4, pretrained=pretrained,
+                   groups=32, **kwargs)
+
+
+def resnext101_64x4d(pretrained=False, **kwargs):
+    return _resnet(BottleneckBlock, 101, width=4, pretrained=pretrained,
+                   groups=64, **kwargs)
+
+
+def resnext152_32x4d(pretrained=False, **kwargs):
+    return _resnet(BottleneckBlock, 152, width=4, pretrained=pretrained,
+                   groups=32, **kwargs)
+
+
+def resnext152_64x4d(pretrained=False, **kwargs):
+    return _resnet(BottleneckBlock, 152, width=4, pretrained=pretrained,
+                   groups=64, **kwargs)
