@@ -8,3 +8,9 @@ pipelines."""
 
 from paddle_tpu.audio import features  # noqa: F401
 from paddle_tpu.audio import functional  # noqa: F401
+from paddle_tpu.audio import backends  # noqa: F401
+from paddle_tpu.audio.backends import (  # noqa: F401
+    info,
+    load,
+    save,
+)

@@ -1,0 +1,137 @@
+"""paddle.audio.backends parity (reference: audio/backends/wave_backend.py
+— the stdlib-wave PCM16 backend, which is also the reference's only
+in-tree backend; soundfile-based backends register externally).
+
+get_current_backend/list_available_backends/set_backend mirror
+init_backend.py with "wave_backend" as the sole in-image option.
+"""
+
+from __future__ import annotations
+
+import wave
+from dataclasses import dataclass
+
+import numpy as np
+
+from paddle_tpu.tensor import Tensor
+
+__all__ = ["AudioInfo", "info", "load", "save", "get_current_backend",
+           "list_available_backends", "set_backend"]
+
+
+@dataclass
+class AudioInfo:
+    sample_rate: int
+    num_frames: int
+    num_channels: int
+    bits_per_sample: int
+    encoding: str
+
+
+def _open(filepath):
+    """Returns (wave_reader, file_obj, owned). Only files WE opened are
+    closed on failure — a caller-passed handle stays the caller's to
+    manage. Truncated/invalid files raise NotImplementedError uniformly
+    (wave raises EOFError, not just wave.Error, on empty input)."""
+    owned = not hasattr(filepath, "read")
+    file_obj = open(filepath, "rb") if owned else filepath
+    try:
+        f = wave.open(file_obj)
+        if f.getsampwidth() != 2:
+            raise NotImplementedError(
+                f"{8 * f.getsampwidth()}-bit wav: the in-image backend "
+                "reads PCM16 .wav only (reference wave_backend contract)")
+        return f, file_obj, owned
+    except (wave.Error, EOFError):
+        if owned:
+            file_obj.close()
+        raise NotImplementedError(
+            "the in-image backend reads PCM16 .wav only (reference "
+            "wave_backend contract); install a soundfile backend for "
+            "other formats")
+    except Exception:
+        if owned:
+            file_obj.close()
+        raise
+
+
+def info(filepath) -> AudioInfo:
+    """audio/backends/wave_backend.py:37."""
+    f, obj, owned = _open(filepath)
+    try:
+        return AudioInfo(f.getframerate(), f.getnframes(), f.getnchannels(),
+                         f.getsampwidth() * 8, "PCM_S")
+    finally:
+        if owned:
+            obj.close()
+
+
+def load(filepath, frame_offset=0, num_frames=-1, normalize=True,
+         channels_first=True):
+    """audio/backends/wave_backend.py:89: (tensor, sample_rate); float32
+    in (-1, 1) when normalize else raw int16; (channels, time) when
+    channels_first."""
+    f, obj, owned = _open(filepath)
+    try:
+        channels = f.getnchannels()
+        sr = f.getframerate()
+        raw = f.readframes(f.getnframes())
+    finally:
+        if owned:
+            obj.close()
+    data = np.frombuffer(raw, dtype=np.int16).reshape(-1, channels)
+    if frame_offset:
+        data = data[frame_offset:]
+    if num_frames is not None and num_frames > -1:
+        data = data[:num_frames]
+    if normalize:
+        out = (data.astype(np.float32) / 32768.0)
+    else:
+        out = data.copy()
+    if channels_first:
+        out = out.T
+    return Tensor(np.ascontiguousarray(out)), sr
+
+
+def save(filepath, src, sample_rate, channels_first=True,
+         encoding="PCM_S", bits_per_sample=16):
+    """audio/backends/wave_backend.py:168: write PCM16 wav."""
+    if bits_per_sample != 16 or encoding != "PCM_S":
+        raise ValueError("the wave backend writes PCM_S 16-bit only")
+    a = np.asarray(src.numpy() if hasattr(src, "numpy") else src)
+    if channels_first:
+        a = a.T  # -> (time, channels)
+    if a.ndim == 1:
+        a = a[:, None]
+    if np.issubdtype(a.dtype, np.integer):
+        if a.dtype != np.int16:
+            # the (-1,1)-normalize path would square-wave integer input
+            raise TypeError(
+                f"integer audio must be int16 for the PCM16 wave backend, "
+                f"got {a.dtype}")
+    else:
+        a = np.clip(a, -1.0, 1.0 - 1.0 / 32768.0)
+        a = (a * 32768.0).astype(np.int16)
+    # wave.open accepts file-like objects directly; str() on one would
+    # create a junk file named after its repr
+    target = filepath if hasattr(filepath, "write") else str(filepath)
+    with wave.open(target, "wb") as f:
+        f.setnchannels(a.shape[1])
+        f.setsampwidth(2)
+        f.setframerate(int(sample_rate))
+        f.writeframes(np.ascontiguousarray(a).tobytes())
+
+
+def get_current_backend() -> str:
+    return "wave_backend"
+
+
+def list_available_backends():
+    return ["wave_backend"]
+
+
+def set_backend(backend_name: str):
+    if backend_name != "wave_backend":
+        raise NotImplementedError(
+            f"backend {backend_name!r} unavailable; only wave_backend is "
+            "shipped in-image")

@@ -67,12 +67,25 @@ def mel_frequencies(n_mels: int, f_min: float, f_max: float, htk: bool = False):
     return mel_to_hz(mels, htk)
 
 
+def _fft_bin_freqs(sr, n_fft):
+    """The fft-bin center frequencies — the ONE definition shared by
+    fft_frequencies and compute_fbank_matrix."""
+    return np.linspace(0, sr / 2.0, n_fft // 2 + 1)
+
+
+def fft_frequencies(sr: int, n_fft: int, dtype: str = "float32"):
+    """Fourier bin center frequencies (reference audio/functional
+    functional.py:165)."""
+    return Tensor._from_value(
+        jnp.asarray(_fft_bin_freqs(sr, n_fft).astype(np.dtype(dtype))))
+
+
 def compute_fbank_matrix(sr: int, n_fft: int, n_mels: int = 64,
                          f_min: float = 0.0, f_max=None, htk: bool = False,
                          norm: str = "slaney", dtype: str = "float32"):
     """Triangular mel filterbank [n_mels, n_fft//2+1] (functional parity)."""
     f_max = f_max or sr / 2.0
-    fft_freqs = np.linspace(0, sr / 2.0, n_fft // 2 + 1)
+    fft_freqs = _fft_bin_freqs(sr, n_fft)
     mel_f = mel_frequencies(n_mels + 2, f_min, f_max, htk)
     fdiff = np.diff(mel_f)
     ramps = mel_f[:, None] - fft_freqs[None, :]

@@ -221,3 +221,67 @@ def test_version_module():
     # explicit Unknown fallback (no partial/garbled strings)
     assert v.commit == "Unknown" or re.fullmatch(r"[0-9a-f]{40}", v.commit)
     v.show()  # must not raise
+
+
+def test_audio_wave_backend_round_trip(tmp_path):
+    import paddle_tpu.audio as A
+
+    sr = 16000
+    t = np.arange(sr // 4) / sr
+    sig = np.stack([np.sin(2 * np.pi * 440 * t),
+                    np.sin(2 * np.pi * 220 * t)]).astype(np.float32)
+    path = tmp_path / "tone.wav"
+    A.save(str(path), paddle.to_tensor(sig), sr)
+
+    meta = A.backends.info(str(path))
+    assert (meta.sample_rate, meta.num_channels,
+            meta.bits_per_sample) == (sr, 2, 16)
+    out, sr2 = A.load(str(path))
+    assert sr2 == sr and out.shape[0] == 2
+    np.testing.assert_allclose(np.asarray(out.numpy()), sig, atol=1e-3)
+    # raw int16 + frame windowing
+    raw, _ = A.load(str(path), frame_offset=10, num_frames=100,
+                    normalize=False)
+    assert raw.numpy().dtype == np.int16 and raw.shape[1] == 100
+
+    f = A.functional.fft_frequencies(16000, 512)
+    assert f.shape[0] == 257 and float(f.numpy()[-1]) == 8000.0
+    assert A.backends.get_current_backend() == "wave_backend"
+
+
+def test_audio_backend_error_semantics(tmp_path):
+    import io
+    import wave as _wave
+
+    import paddle_tpu.audio as A
+
+    # non-16-bit wavs are rejected, not misread
+    p8 = tmp_path / "pcm8.wav"
+    with _wave.open(str(p8), "wb") as f:
+        f.setnchannels(1)
+        f.setsampwidth(1)
+        f.setframerate(8000)
+        f.writeframes(bytes(100))
+    with pytest.raises(NotImplementedError):
+        A.load(str(p8))
+    # truncated garbage raises uniformly
+    bad = tmp_path / "bad.wav"
+    bad.write_bytes(b"RIFF")
+    with pytest.raises(NotImplementedError):
+        A.backends.info(str(bad))
+    # caller-owned handles stay open
+    p = tmp_path / "tone.wav"
+    A.save(str(p), paddle.to_tensor(np.zeros((1, 64), np.float32)), 8000)
+    h = open(p, "rb")
+    A.backends.info(h)
+    assert not h.closed
+    h.close()
+    # integer non-int16 input is rejected, not square-waved
+    with pytest.raises(TypeError):
+        A.save(str(p), np.array([[1000, -1000]], np.int32), 8000)
+    # file-like save target works
+    buf = io.BytesIO()
+    A.save(buf, paddle.to_tensor(np.zeros((1, 64), np.float32)), 8000)
+    buf.seek(0)
+    out, sr = A.load(buf)
+    assert sr == 8000 and out.shape == [1, 64]
