@@ -1,3 +1,10 @@
 """incubate.nn (fused layers + functional)."""
 
 from paddle_tpu.incubate.nn import functional  # noqa: F401
+from paddle_tpu.incubate.nn.layer import (  # noqa: F401
+    FusedBiasDropoutResidualLayerNorm,
+    FusedFeedForward,
+    FusedMultiHeadAttention,
+    FusedMultiTransformer,
+    FusedTransformerEncoderLayer,
+)

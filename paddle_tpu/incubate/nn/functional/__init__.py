@@ -21,6 +21,41 @@ from paddle_tpu.ops.pallas.flash_attention import scaled_dot_product_attention
 from paddle_tpu.tensor import Tensor
 
 
+def _dropout_raw(h, rate, training, mode="upscale_in_train"):
+    """Shared raw-array dropout for the fused ops (paddle mode semantics:
+    upscale_in_train scales kept values by 1/(1-p) in training;
+    downscale_in_infer keeps training values unscaled and scales by (1-p)
+    at inference)."""
+    if rate <= 0.0:
+        return h
+    if not training:
+        return h * (1.0 - rate) if mode == "downscale_in_infer" else h
+    keep = jax.random.bernoulli(_rng.next_key(), 1.0 - rate, h.shape)
+    kept = h if mode == "downscale_in_infer" else h / (1.0 - rate)
+    return jnp.where(keep, kept, 0.0)
+
+
+def _layer_norm_raw(h, scale, bias, eps):
+    """Shared raw-array last-axis layernorm (fp32 accumulation)."""
+    hf = h.astype(jnp.float32)
+    mu = jnp.mean(hf, axis=-1, keepdims=True)
+    var = jnp.var(hf, axis=-1, keepdims=True)
+    out = ((hf - mu) * jax.lax.rsqrt(var + eps)).astype(h.dtype)
+    if scale is not None:
+        out = out * scale
+    if bias is not None:
+        out = out + bias
+    return out
+
+
+def _act_raw(h, name):
+    # paddle activation parity: "gelu" is the EXACT erf form (jax's
+    # default is the tanh approximation)
+    if name == "gelu":
+        return jax.nn.gelu(h, approximate=False)
+    return getattr(jax.nn, name)(h)
+
+
 def fused_rms_norm(x, norm_weight=None, norm_bias=None, epsilon=1e-6,
                    begin_norm_axis=-1, bias=None, residual=None,
                    quant_scale=-1, **kwargs):
@@ -294,18 +329,12 @@ def fused_multi_head_attention(x, qkv_weight, linear_weight, pre_layer_norm=Fals
 
         out = flash_attention_fwd(q, k, v, bias=mask, causal=False,
                                   scale=1.0 / math.sqrt(hd))
-        if attn_dropout_rate > 0.0 and training:
-            keep = jax.random.bernoulli(
-                _rng.next_key(), 1.0 - attn_dropout_rate, out.shape)
-            out = jnp.where(keep, out / (1.0 - attn_dropout_rate), 0.0)
+        out = _dropout_raw(out, attn_dropout_rate, training)
         out = out.reshape(b, s, nh * hd)
         out = out @ lin_w
         if lin_b is not None:
             out = out + lin_b
-        if dropout_rate > 0.0 and training:
-            keep = jax.random.bernoulli(
-                _rng.next_key(), 1.0 - dropout_rate, out.shape)
-            out = jnp.where(keep, out / (1.0 - dropout_rate), 0.0)
+        out = _dropout_raw(out, dropout_rate, training)
         out = residual + out
         if not pre_layer_norm:
             mu = jnp.mean(out, axis=-1, keepdims=True)
@@ -405,3 +434,67 @@ def block_multihead_attention(qkv, key_cache, value_cache, seq_lens,
 
     return apply("block_multihead_attention", f, qkv, key_cache, value_cache,
                  seq_lens, block_tables, differentiable=False)
+
+
+def fused_bias_dropout_residual_layer_norm(x, residual, bias=None,
+                                           ln_scale=None, ln_bias=None,
+                                           dropout_rate=0.5, ln_epsilon=1e-5,
+                                           training=True, mode="upscale_in_train",
+                                           name=None):
+    """ln(residual + dropout(x + bias)) in one op
+    (incubate/nn/functional/fused_bias_dropout_residual_layer_norm)."""
+
+    def f(xv, rv, *rest):
+        it = iter(rest)
+        b = next(it) if bias is not None else None
+        s = next(it) if ln_scale is not None else None
+        lb = next(it) if ln_bias is not None else None
+        h = xv if b is None else xv + b
+        h = _dropout_raw(h, dropout_rate, training, mode)
+        return _layer_norm_raw(rv + h, s, lb, ln_epsilon)
+
+    args = [x, residual]
+    for t in (bias, ln_scale, ln_bias):
+        if t is not None:
+            args.append(t)
+    return apply("fused_bias_dropout_residual_layer_norm", f, *args)
+
+
+def fused_feedforward(x, linear1_weight, linear2_weight, linear1_bias=None,
+                      linear2_bias=None, ln1_scale=None, ln1_bias=None,
+                      ln2_scale=None, ln2_bias=None, dropout1_rate=0.5,
+                      dropout2_rate=0.5, activation="relu",
+                      ln1_epsilon=1e-5, ln2_epsilon=1e-5,
+                      pre_layer_norm=False, training=True, name=None):
+    """residual + dropout2(linear2(dropout1(act(linear1(ln?(x)))))) with
+    pre/post layernorm (incubate/nn/functional/fused_feedforward)."""
+
+    def f(xv, w1, w2, *rest):
+        it = iter(rest)
+        b1 = next(it) if linear1_bias is not None else None
+        b2 = next(it) if linear2_bias is not None else None
+        s1 = next(it) if ln1_scale is not None else None
+        lb1 = next(it) if ln1_bias is not None else None
+        s2 = next(it) if ln2_scale is not None else None
+        lb2 = next(it) if ln2_bias is not None else None
+        residual = xv
+        h = _layer_norm_raw(xv, s1, lb1, ln1_epsilon) if pre_layer_norm \
+            else xv
+        h = h @ w1
+        if b1 is not None:
+            h = h + b1
+        h = _dropout_raw(_act_raw(h, activation), dropout1_rate, training)
+        h = h @ w2
+        if b2 is not None:
+            h = h + b2
+        h = residual + _dropout_raw(h, dropout2_rate, training)
+        if not pre_layer_norm:
+            h = _layer_norm_raw(h, s2, lb2, ln2_epsilon)
+        return h
+
+    args = [x, linear1_weight, linear2_weight]
+    for t in (linear1_bias, linear2_bias, ln1_scale, ln1_bias, ln2_scale,
+              ln2_bias):
+        if t is not None:
+            args.append(t)
+    return apply("fused_feedforward", f, *args)

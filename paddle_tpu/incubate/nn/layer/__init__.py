@@ -1,0 +1,7 @@
+from paddle_tpu.incubate.nn.layer.fused_transformer import (  # noqa: F401
+    FusedBiasDropoutResidualLayerNorm,
+    FusedFeedForward,
+    FusedMultiHeadAttention,
+    FusedMultiTransformer,
+    FusedTransformerEncoderLayer,
+)

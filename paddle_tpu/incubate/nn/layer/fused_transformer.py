@@ -1,0 +1,284 @@
+"""Fused transformer layers (parity: python/paddle/incubate/nn/layer/
+fused_transformer.py — FusedMultiHeadAttention:189, FusedFeedForward:483,
+FusedTransformerEncoderLayer:697, FusedMultiTransformer:994,
+FusedBiasDropoutResidualLayerNorm:120).
+
+TPU-native: each layer owns paddle-layout parameters and calls the
+incubate functional ops, whose compositions XLA fuses (attention rides
+the Pallas flash kernel). FusedMultiTransformer runs the prefill-style
+full-sequence path; the cache_kv decode path raises with the serving
+stack, matching the functional's stance.
+"""
+
+from __future__ import annotations
+
+import math
+
+import paddle_tpu.nn as nn
+from paddle_tpu.incubate.nn import functional as incubate_f
+from paddle_tpu.nn import initializer as I
+
+
+def _param(layer, shape, is_bias=False, init=None, attr=None):
+    """Create a parameter honoring a caller ParamAttr; attr=False means
+    "no parameter" (paddle bias_attr=False) -> returns None."""
+    if attr is False:
+        return None
+    return layer.create_parameter(
+        shape, attr=attr, is_bias=is_bias,
+        default_initializer=init or (I.Constant(0.0) if is_bias
+                                     else I.XavierUniform()))
+
+
+class FusedBiasDropoutResidualLayerNorm(nn.Layer):
+    """fused_transformer.py:120: ln(residual + dropout(x + bias))."""
+
+    def __init__(self, embed_dim, dropout_rate=0.5, weight_attr=None,
+                 bias_attr=None, epsilon=1e-5, name=None):
+        super().__init__()
+        assert embed_dim > 0
+        self._dropout_rate = dropout_rate
+        self._epsilon = epsilon
+        self.linear_bias = _param(self, [embed_dim], is_bias=True,
+                                  attr=bias_attr)
+        self.ln_scale = _param(self, [embed_dim], init=I.Constant(1.0),
+                               attr=weight_attr)
+        self.ln_bias = _param(self, [embed_dim], is_bias=True)
+
+    def forward(self, x, residual):
+        return incubate_f.fused_bias_dropout_residual_layer_norm(
+            x, residual, bias=self.linear_bias, ln_scale=self.ln_scale,
+            ln_bias=self.ln_bias, dropout_rate=self._dropout_rate,
+            ln_epsilon=self._epsilon, training=self.training)
+
+    def extra_repr(self):
+        return (f"embed_dim={self.linear_bias.shape[0]}, "
+                f"dropout_rate={self._dropout_rate}, "
+                f"epsilon={self._epsilon}")
+
+
+class FusedMultiHeadAttention(nn.Layer):
+    """fused_transformer.py:189: pre/post-LN fused self-attention with
+    residual; qkv_weight in the paddle [3, num_heads, head_dim, embed_dim]
+    layout."""
+
+    def __init__(self, embed_dim, num_heads, dropout_rate=0.5,
+                 attn_dropout_rate=0.5, kdim=None, vdim=None,
+                 normalize_before=False, need_weights=False,
+                 qkv_weight_attr=None, qkv_bias_attr=None,
+                 linear_weight_attr=None, linear_bias_attr=None,
+                 pre_ln_scale_attr=None, pre_ln_bias_attr=None,
+                 ln_scale_attr=None, ln_bias_attr=None, epsilon=1e-5,
+                 nranks=1, ring_id=-1, transpose_qkv_wb=False, name=None):
+        super().__init__()
+        assert embed_dim > 0 and num_heads > 0
+        assert embed_dim % num_heads == 0, (embed_dim, num_heads)
+        if need_weights:
+            raise NotImplementedError(
+                "need_weights is unsupported (the reference fused op does "
+                "not return attention weights either)")
+        if (kdim not in (None, embed_dim)) or (vdim not in (None, embed_dim)):
+            raise NotImplementedError(
+                "fused attention requires kdim == vdim == embed_dim "
+                "(reference fused_transformer.py contract)")
+        if transpose_qkv_wb:
+            raise NotImplementedError("transpose_qkv_wb layout unsupported")
+        self.embed_dim = embed_dim
+        self.num_heads = num_heads
+        head_dim = embed_dim // num_heads
+        self.normalize_before = normalize_before
+        self._dropout_rate = dropout_rate
+        self._attn_dropout_rate = attn_dropout_rate
+        self._epsilon = epsilon
+        bound = 1.0 / math.sqrt(embed_dim)
+        self.qkv_weight = _param(
+            self, [3, num_heads, head_dim, embed_dim],
+            init=I.Uniform(-bound, bound), attr=qkv_weight_attr)
+        self.qkv_bias = _param(self, [3, num_heads, head_dim], is_bias=True,
+                               attr=qkv_bias_attr)
+        self.linear_weight = _param(self, [embed_dim, embed_dim],
+                                    init=I.Uniform(-bound, bound),
+                                    attr=linear_weight_attr)
+        self.linear_bias = _param(self, [embed_dim], is_bias=True,
+                                  attr=linear_bias_attr)
+        if normalize_before:
+            self.pre_ln_scale = _param(self, [embed_dim],
+                                       init=I.Constant(1.0),
+                                       attr=pre_ln_scale_attr)
+            self.pre_ln_bias = _param(self, [embed_dim], is_bias=True,
+                                      attr=pre_ln_bias_attr)
+            self.ln_scale = self.ln_bias = None
+        else:
+            self.pre_ln_scale = self.pre_ln_bias = None
+            self.ln_scale = _param(self, [embed_dim], init=I.Constant(1.0),
+                                   attr=ln_scale_attr)
+            self.ln_bias = _param(self, [embed_dim], is_bias=True,
+                                  attr=ln_bias_attr)
+
+    def forward(self, query, key=None, value=None, attn_mask=None,
+                cache=None):
+        if key is not None or value is not None:
+            raise NotImplementedError(
+                "fused self-attention only (key/value must be None, as in "
+                "the reference fused op)")
+        return incubate_f.fused_multi_head_attention(
+            query, self.qkv_weight, self.linear_weight,
+            pre_layer_norm=self.normalize_before,
+            pre_ln_scale=self.pre_ln_scale, pre_ln_bias=self.pre_ln_bias,
+            ln_scale=self.ln_scale, ln_bias=self.ln_bias,
+            pre_ln_epsilon=self._epsilon, qkv_bias=self.qkv_bias,
+            linear_bias=self.linear_bias, cache_kv=cache,
+            attn_mask=attn_mask, dropout_rate=self._dropout_rate,
+            attn_dropout_rate=self._attn_dropout_rate,
+            ln_epsilon=self._epsilon, training=self.training,
+            num_heads=self.num_heads)
+
+    def extra_repr(self):
+        return (f"embed_dim={self.embed_dim}, num_heads={self.num_heads}, "
+                f"normalize_before={self.normalize_before}")
+
+
+class FusedFeedForward(nn.Layer):
+    """fused_transformer.py:483: residual + dropout(linear2(dropout(
+    act(linear1(ln?(x)))))) with pre/post layernorm."""
+
+    def __init__(self, d_model, dim_feedforward, dropout_rate=0.1,
+                 epsilon=1e-5, activation="relu", act_dropout_rate=None,
+                 normalize_before=False, linear1_weight_attr=None,
+                 linear1_bias_attr=None, linear2_weight_attr=None,
+                 linear2_bias_attr=None, ln1_scale_attr=None,
+                 ln1_bias_attr=None, ln2_scale_attr=None,
+                 ln2_bias_attr=None, nranks=1, ring_id=-1, name=None):
+        super().__init__()
+        assert d_model > 0 and dim_feedforward > 0
+        self._d_model = d_model
+        self._dropout_rate = dropout_rate
+        self._act_dropout_rate = (dropout_rate if act_dropout_rate is None
+                                  else act_dropout_rate)
+        self._activation = activation
+        self._epsilon = epsilon
+        self._normalize_before = normalize_before
+        b1 = 1.0 / math.sqrt(d_model)
+        b2 = 1.0 / math.sqrt(dim_feedforward)
+        self.linear1_weight = _param(self, [d_model, dim_feedforward],
+                                     init=I.Uniform(-b1, b1),
+                                     attr=linear1_weight_attr)
+        self.linear1_bias = _param(self, [dim_feedforward], is_bias=True,
+                                   attr=linear1_bias_attr)
+        self.linear2_weight = _param(self, [dim_feedforward, d_model],
+                                     init=I.Uniform(-b2, b2),
+                                     attr=linear2_weight_attr)
+        self.linear2_bias = _param(self, [d_model], is_bias=True,
+                                   attr=linear2_bias_attr)
+        if normalize_before:
+            self._ln1_scale = _param(self, [d_model], init=I.Constant(1.0),
+                                     attr=ln1_scale_attr)
+            self._ln1_bias = _param(self, [d_model], is_bias=True,
+                                    attr=ln1_bias_attr)
+            self._ln2_scale = self._ln2_bias = None
+        else:
+            self._ln1_scale = self._ln1_bias = None
+            self._ln2_scale = _param(self, [d_model], init=I.Constant(1.0),
+                                     attr=ln2_scale_attr)
+            self._ln2_bias = _param(self, [d_model], is_bias=True,
+                                    attr=ln2_bias_attr)
+
+    def forward(self, src, cache=None):
+        return incubate_f.fused_feedforward(
+            src, self.linear1_weight, self.linear2_weight,
+            linear1_bias=self.linear1_bias, linear2_bias=self.linear2_bias,
+            ln1_scale=self._ln1_scale, ln1_bias=self._ln1_bias,
+            ln2_scale=self._ln2_scale, ln2_bias=self._ln2_bias,
+            dropout1_rate=self._act_dropout_rate,
+            dropout2_rate=self._dropout_rate,
+            activation=self._activation, ln1_epsilon=self._epsilon,
+            ln2_epsilon=self._epsilon,
+            pre_layer_norm=self._normalize_before, training=self.training)
+
+    def extra_repr(self):
+        return (f"d_model={self._d_model}, "
+                f"dropout_rate={self._dropout_rate}, "
+                f"activation={self._activation}, "
+                f"normalize_before={self._normalize_before}")
+
+
+class FusedTransformerEncoderLayer(nn.Layer):
+    """fused_transformer.py:697: fused attention + fused FFN."""
+
+    def __init__(self, d_model, nhead, dim_feedforward, dropout_rate=0.1,
+                 activation="relu", attn_dropout_rate=None,
+                 act_dropout_rate=None, normalize_before=False,
+                 weight_attr=None, bias_attr=None, epsilon=1e-5):
+        super().__init__()
+        assert d_model > 0 and nhead > 0 and dim_feedforward > 0
+        attn_dropout_rate = (dropout_rate if attn_dropout_rate is None
+                             else attn_dropout_rate)
+        self.fused_attn = FusedMultiHeadAttention(
+            d_model, nhead, dropout_rate=dropout_rate,
+            attn_dropout_rate=attn_dropout_rate,
+            normalize_before=normalize_before, epsilon=epsilon,
+            qkv_weight_attr=weight_attr, linear_weight_attr=weight_attr,
+            qkv_bias_attr=bias_attr, linear_bias_attr=bias_attr)
+        self.ffn = FusedFeedForward(
+            d_model, dim_feedforward, dropout_rate=dropout_rate,
+            activation=activation, act_dropout_rate=act_dropout_rate,
+            normalize_before=normalize_before, epsilon=epsilon,
+            linear1_weight_attr=weight_attr, linear2_weight_attr=weight_attr,
+            linear1_bias_attr=bias_attr, linear2_bias_attr=bias_attr)
+
+    def forward(self, src, src_mask=None, cache=None):
+        if cache is not None:
+            raise NotImplementedError(
+                "cache decode path lands with the serving stack")
+        return self.ffn(self.fused_attn(src, attn_mask=src_mask))
+
+
+class FusedMultiTransformer(nn.Layer):
+    """fused_transformer.py:994: a stack of fused pre-LN decoder layers.
+    The prefill-style full-sequence path runs; the incremental cache_kvs
+    decode path raises (serving stack), matching the functional ops."""
+
+    def __init__(self, embed_dim, num_heads, dim_feedforward,
+                 dropout_rate=0.0, activation="gelu",
+                 normalize_before=True, ln_scale_attrs=None,
+                 ln_bias_attrs=None, qkv_weight_attrs=None,
+                 qkv_bias_attrs=None, linear_weight_attrs=None,
+                 linear_bias_attrs=None, ffn_ln_scale_attrs=None,
+                 ffn_ln_bias_attrs=None, ffn1_weight_attrs=None,
+                 ffn1_bias_attrs=None, ffn2_weight_attrs=None,
+                 ffn2_bias_attrs=None, epsilon=1e-5, num_layers=-1,
+                 nranks=1, trans_qkvw=True, ring_id=-1, name=None):
+        super().__init__()
+        if not normalize_before:
+            raise NotImplementedError(
+                "FusedMultiTransformer is pre-LN only (reference "
+                "fused_transformer.py:994 same restriction)")
+        if not trans_qkvw:
+            raise NotImplementedError(
+                "trans_qkvw=False layout unsupported")
+        if num_layers < 0:
+            num_layers = (len(qkv_weight_attrs)
+                          if isinstance(qkv_weight_attrs, (list, tuple))
+                          else 1)
+        self.num_layers = num_layers
+        self.layers = nn.LayerList([
+            FusedTransformerEncoderLayer(
+                embed_dim, num_heads, dim_feedforward,
+                dropout_rate=dropout_rate, activation=activation,
+                normalize_before=True, epsilon=epsilon)
+            for _ in range(num_layers)
+        ])
+
+    def forward(self, src, attn_mask=None, caches=None, time_step=None,
+                **kwargs):
+        unsupported = {k: v for k, v in kwargs.items() if v is not None}
+        if caches is not None or time_step is not None or unsupported:
+            raise NotImplementedError(
+                "the serving-path arguments "
+                f"{['caches', 'time_step'] + sorted(unsupported)} are "
+                "unsupported here; run the full-sequence prefill call "
+                "(cache_kvs decode lands with the serving stack)")
+        h = src
+        for layer in self.layers:
+            h = layer(h, src_mask=attn_mask)
+        return h

@@ -1,0 +1,156 @@
+"""Fused transformer LAYER classes (reference incubate/nn/layer/
+fused_transformer.py): numeric equality of each fused layer against a
+plain unfused composition built from the same parameters, plus a short
+training drill through the encoder layer."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.incubate import nn as inn
+
+B, S, D, H, FF = 2, 8, 32, 4, 64
+EPS = 1e-5
+
+
+def _ln(h, s, b, eps=EPS):
+    mu = h.mean(-1, keepdims=True)
+    var = h.var(-1, keepdims=True)
+    return (h - mu) / np.sqrt(var + eps) * s + b
+
+
+def _x():
+    return np.random.default_rng(0).normal(size=(B, S, D)).astype(np.float32)
+
+
+def test_fused_mha_matches_unfused_postln():
+    paddle.seed(1)
+    layer = inn.FusedMultiHeadAttention(D, H, dropout_rate=0.0,
+                                        attn_dropout_rate=0.0)
+    layer.eval()
+    x = _x()
+    out = np.asarray(layer(paddle.to_tensor(x)).numpy())
+
+    qkv_w = np.asarray(layer.qkv_weight.numpy())   # [3, H, hd, D]
+    qkv_b = np.asarray(layer.qkv_bias.numpy())     # [3, H, hd]
+    lin_w = np.asarray(layer.linear_weight.numpy())
+    lin_b = np.asarray(layer.linear_bias.numpy())
+    lns = np.asarray(layer.ln_scale.numpy())
+    lnb = np.asarray(layer.ln_bias.numpy())
+
+    hd = D // H
+    qkv = x @ qkv_w.reshape(3 * H * hd, D).T + qkv_b.reshape(-1)
+    qkv = qkv.reshape(B, S, 3, H, hd)
+    q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+    # [B, H, S, hd]
+    q, k, v = (t.transpose(0, 2, 1, 3) for t in (q, k, v))
+    logits = q @ k.transpose(0, 1, 3, 2) / np.sqrt(hd)
+    w = np.asarray(jax.nn.softmax(jnp.asarray(logits), axis=-1))
+    attn = (w @ v).transpose(0, 2, 1, 3).reshape(B, S, D)
+    ref = _ln(x + (attn @ lin_w + lin_b), lns, lnb)
+    np.testing.assert_allclose(out, ref, rtol=2e-4, atol=2e-5)
+
+
+def test_fused_ffn_matches_unfused_preln():
+    paddle.seed(2)
+    layer = inn.FusedFeedForward(D, FF, dropout_rate=0.0,
+                                 normalize_before=True)
+    layer.eval()
+    x = _x()
+    out = np.asarray(layer(paddle.to_tensor(x)).numpy())
+
+    w1 = np.asarray(layer.linear1_weight.numpy())
+    b1 = np.asarray(layer.linear1_bias.numpy())
+    w2 = np.asarray(layer.linear2_weight.numpy())
+    b2 = np.asarray(layer.linear2_bias.numpy())
+    s1 = np.asarray(layer._ln1_scale.numpy())
+    lb1 = np.asarray(layer._ln1_bias.numpy())
+    h = _ln(x, s1, lb1)
+    ref = x + (np.maximum(h @ w1 + b1, 0.0) @ w2 + b2)
+    np.testing.assert_allclose(out, ref, rtol=2e-4, atol=2e-5)
+
+
+def test_fused_bias_dropout_residual_ln():
+    paddle.seed(3)
+    layer = inn.FusedBiasDropoutResidualLayerNorm(D, dropout_rate=0.0)
+    layer.eval()
+    x, r = _x(), _x() * 0.5
+    out = np.asarray(layer(paddle.to_tensor(x),
+                           paddle.to_tensor(r)).numpy())
+    ref = _ln(r + x + np.asarray(layer.linear_bias.numpy()),
+              np.asarray(layer.ln_scale.numpy()),
+              np.asarray(layer.ln_bias.numpy()))
+    np.testing.assert_allclose(out, ref, rtol=2e-4, atol=2e-5)
+
+
+def test_encoder_layer_trains():
+    paddle.seed(4)
+    import paddle_tpu.optimizer as opt
+
+    enc = inn.FusedTransformerEncoderLayer(D, H, FF, dropout_rate=0.0)
+    head = nn.Linear(D, 2)
+    params = enc.parameters() + head.parameters()
+    o = opt.Adam(learning_rate=5e-3, parameters=params)
+    ce = nn.CrossEntropyLoss()
+    x = paddle.to_tensor(_x())
+    y = paddle.to_tensor((np.arange(B) % 2).astype(np.int64))
+    first = last = None
+    for _ in range(8):
+        pooled = enc(x).mean(axis=1)
+        loss = ce(head(pooled), y)
+        loss.backward()
+        o.step()
+        o.clear_grad()
+        v = float(np.asarray(loss.numpy()))
+        first = first if first is not None else v
+        last = v
+    assert last < first, (first, last)
+
+
+def test_multi_transformer_stacks():
+    paddle.seed(5)
+    mt = inn.FusedMultiTransformer(D, H, FF, num_layers=3)
+    mt.eval()
+    out = mt(paddle.to_tensor(_x()))
+    assert tuple(out.shape) == (B, S, D)
+    with pytest.raises(NotImplementedError):
+        mt(paddle.to_tensor(_x()), caches=[1])
+
+
+def test_gelu_is_exact_and_bias_attr_false():
+    from paddle_tpu.incubate.nn import functional as incubate_f
+
+    # exact-erf gelu, not the tanh approximation
+    h = jnp.asarray(np.linspace(-3, 3, 7, dtype=np.float32))
+    out = incubate_f._act_raw(h, "gelu")
+    exact = np.asarray(jax.nn.gelu(h, approximate=False))
+    approx = np.asarray(jax.nn.gelu(h, approximate=True))
+    np.testing.assert_allclose(np.asarray(out), exact, rtol=1e-6)
+    assert not np.allclose(np.asarray(out), approx, rtol=1e-6, atol=0)
+
+    # bias_attr=False drops the projection biases (paddle contract)
+    layer = inn.FusedMultiHeadAttention(D, H, qkv_bias_attr=False,
+                                        linear_bias_attr=False,
+                                        dropout_rate=0.0,
+                                        attn_dropout_rate=0.0)
+    assert layer.qkv_bias is None and layer.linear_bias is None
+    layer.eval()
+    out = layer(paddle.to_tensor(_x()))
+    assert np.isfinite(np.asarray(out.numpy())).all()
+
+
+def test_bdrln_downscale_in_infer_mode():
+    from paddle_tpu.incubate.nn import functional as incubate_f
+
+    x, r = _x(), _x()
+    # inference in downscale mode scales the non-residual term by (1-p)
+    out = incubate_f.fused_bias_dropout_residual_layer_norm(
+        paddle.to_tensor(x), paddle.to_tensor(r), dropout_rate=0.5,
+        training=False, mode="downscale_in_infer")
+    ref = _ln(r + 0.5 * x, np.ones(D, np.float32), np.zeros(D, np.float32))
+    np.testing.assert_allclose(np.asarray(out.numpy()), ref, rtol=2e-4,
+                               atol=2e-5)
