@@ -238,7 +238,7 @@ def make_pipeline_schedule(num_stages: int, num_microbatches: int,
 
 
 def _optimal_zb_schedule(S: int, M: int, state_cap: int = 600_000):
-    """Exact min-weighted-wall split-B/W schedule via Dijkstra.
+    """Exact min-weighted-wall split-B/W schedule via A*.
 
     State per stage: (F count, B count, W count) as of the START of a
     tick. A message produced at tick t is consumable from t+1 — exactly
@@ -246,19 +246,38 @@ def _optimal_zb_schedule(S: int, M: int, state_cap: int = 600_000):
     no extra latency bookkeeping is needed (an earlier cut subtracted the
     last tick's production, silently imposing 2-tick latency). Tick cost
     = max over stages of op cost (F=1, B=2, W=1, all-idle tick=1) — the
-    lock-step SPMD wall model of bubble_fraction(). Returns None when the
-    state space would exceed ``state_cap`` (caller falls back to greedy).
+    lock-step SPMD wall model of bubble_fraction().
+
+    r4 late: plain Dijkstra capped out at S=2/small-S=3; an admissible
+    heuristic (each tick's cost >= any single stage's op cost in it, so
+    the remaining wall >= any stage's remaining weighted work:
+    h = max_s [(M-nf) + 2(M-nb) + (M-nw)]) keeps the search exact while
+    pruning enough to solve S=4 meshes. Returns None once ``state_cap``
+    states have been expanded (caller falls back to greedy, which stays
+    deterministic across machines — no wall-clock deadlines).
     """
     import heapq
 
-    # reachable per-stage count combos are monotone nf >= nb >= nw
+    # instant fallback for clearly-intractable spaces (combos = reachable
+    # monotone (nf,nb,nw) count triples per stage); mid-size spaces get a
+    # bounded A* whose expansion cap keeps setup time to ~minutes worst
+    # case — schedule search runs once per training job
     combos = (M + 1) * (M + 2) * (M + 3) // 6
-    if combos ** S > state_cap:
+    # 1e9 admits the largest config the bounded search actually SOLVES on
+    # a slow core (S4 M8, combos^S = 7.4e8, ~1 min); past it the search
+    # would only burn minutes before hitting the cap and falling back —
+    # the guard makes that fallback instant instead
+    if combos ** S > 1e9:
         return None
 
     cost_of = {IDLE: 0.0, F_OP: 1.0, B_OP: 2.0, W_OP: 1.0}
     start = ((0, 0, 0),) * S
     goal = ((M, M, M),) * S
+
+    def h(state):
+        # admissible lower bound on the remaining lock-step wall
+        return max((M - nf) + 2 * (M - nb) + (M - nw)
+                   for nf, nb, nw in state)
 
     def feasible_ops(state, s):
         nf, nb, nw = state[s]
@@ -289,11 +308,13 @@ def _optimal_zb_schedule(S: int, M: int, state_cap: int = 600_000):
 
     dist = {start: 0.0}
     prev_of = {start: None}
-    heap = [(0.0, 0, start)]
+    heap = [(h(start), 0, start)]
     tie = 1
+    expanded = 0
     while heap:
-        d, _, state = heapq.heappop(heap)
-        if d > dist.get(state, float("inf")):
+        f, _, state = heapq.heappop(heap)
+        d = dist.get(state, float("inf"))
+        if f > d + h(state):
             continue
         if state == goal:
             # reconstruct tick list
@@ -304,19 +325,22 @@ def _optimal_zb_schedule(S: int, M: int, state_cap: int = 600_000):
                 ticks.append(choice)
             ticks.reverse()
             return _table_from_choices(S, M, ticks)
+        expanded += 1
+        if expanded > state_cap or len(dist) > 4 * state_cap:
+            # expansion cap bounds TIME; the dist bound caps MEMORY (each
+            # expansion can push up to 4^S-1 successors)
+            return None
         per_stage = [feasible_ops(state, s) for s in range(S)]
         for choice in itertools.product(*per_stage):
-            if all(op == IDLE for op in choice) :
+            if all(op == IDLE for op in choice):
                 continue
             nxt = step_state(state, choice)
             nd = d + max(max(cost_of[op] for op in choice), 1.0)
             if nd < dist.get(nxt, float("inf")):
                 dist[nxt] = nd
                 prev_of[nxt] = (state, choice)
-                heapq.heappush(heap, (nd, tie, nxt))
+                heapq.heappush(heap, (nd + h(nxt), tie, nxt))
                 tie += 1
-        if len(dist) > state_cap:
-            return None
     return None
 
 

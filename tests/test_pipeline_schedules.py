@@ -478,11 +478,13 @@ def _weighted_wall(sched):
         for t in range(sched.num_ticks))
 
 
-@pytest.mark.parametrize("cfg", [(2, 4), (2, 6), (2, 8), (3, 4), (3, 6)])
+@pytest.mark.parametrize("cfg", [(2, 4), (2, 6), (2, 8), (3, 4), (3, 6),
+                                 (4, 4)])
 def test_zb_opt_beats_greedy_wall(cfg):
     """r4 (VERDICT weak #5): the exact min-wall search strictly improves on
-    the greedy ZB-H1 placement for small configs (it aligns cost-2 B ticks
-    across stages, which the greedy cannot)."""
+    the greedy ZB-H1 placement (it aligns cost-2 B ticks across stages,
+    which the greedy cannot). r4 late: the A* heuristic extends exactness
+    to 4-stage meshes (S4 M4: 24 vs greedy 25; S4 M8 offline: 38 vs 45)."""
     S_, M_ = cfg
     opt = make_pipeline_schedule(S_, M_, "ZB_OPT")
     greedy = make_pipeline_schedule(S_, M_, "ZBH1")
@@ -494,9 +496,16 @@ def test_zb_opt_beats_greedy_wall(cfg):
 
 
 def test_zb_opt_falls_back_when_state_space_large():
-    big = make_pipeline_schedule(4, 8, "ZB_OPT")
+    # combos**S guard: instantly-greedy for clearly-intractable spaces
+    big = make_pipeline_schedule(4, 12, "ZB_OPT")
     assert big.policy in ("ZBH1",)  # greedy fallback, still valid
     _check_dependencies(big)
+    # the in-search expansion cap also terminates cleanly (None -> greedy)
+    from paddle_tpu.distributed.fleet.pipeline_schedules import (
+        _optimal_zb_schedule,
+    )
+
+    assert _optimal_zb_schedule(4, 8, state_cap=100) is None
 
 
 def test_zb_opt_engine_grads_match_autodiff():
