@@ -13,6 +13,7 @@ import numpy as np
 from paddle_tpu.ops import (  # noqa: F401
     comparison,
     creation,
+    extra_math,
     linalg,
     manipulation,
     math,

@@ -646,3 +646,304 @@ def gather_tree(ids, parents, name=None):
         return outs[::-1]
 
     return apply("gather_tree", f, ids, parents)
+
+
+# --------------------------------------------------------------------------
+# r4 API-breadth sweep: the remaining top-level paddle.* tensor functions
+# (reference python/paddle/tensor/{manipulation,math,creation,random,attribute,
+# einsum}.py — each cited per op)
+# --------------------------------------------------------------------------
+
+
+def block_diag(inputs, name=None):
+    """paddle.block_diag (tensor/creation.py): 2-D block-diagonal stack."""
+    def f(*mats):
+        mats = [m.reshape(1, 1) if m.ndim == 0
+                else (m.reshape(1, -1) if m.ndim == 1 else m) for m in mats]
+        rows = sum(m.shape[0] for m in mats)
+        cols = sum(m.shape[1] for m in mats)
+        out = jnp.zeros((rows, cols), mats[0].dtype)
+        r = c = 0
+        for m in mats:
+            out = jax.lax.dynamic_update_slice(out, m, (r, c))
+            r += m.shape[0]
+            c += m.shape[1]
+        return out
+
+    return apply("block_diag", f, *inputs)
+
+
+def tensor_split(x, num_or_indices, axis=0, name=None):
+    """paddle.tensor_split (tensor/manipulation.py): numpy array_split
+    semantics — uneven splits allowed."""
+    def split_points(n):
+        if isinstance(num_or_indices, int):
+            k = num_or_indices
+            base, extra = divmod(n, k)
+            sizes = [base + 1] * extra + [base] * (k - extra)
+            pts, acc = [], 0
+            for s in sizes[:-1]:
+                acc += s
+                pts.append(acc)
+            return pts
+        return list(num_or_indices)
+
+    # shape metadata only — never materialize the array
+    n = x.shape[axis]
+    ndim = len(x.shape)
+    pts = split_points(n)
+    pieces = []
+    prev = 0
+    for p in pts + [n]:
+        idx = [slice(None)] * ndim
+        idx[axis] = slice(prev, p)
+        pieces.append(apply("tensor_split", lambda a, sl=tuple(idx): a[sl], x))
+        prev = p
+    return pieces
+
+
+def hstack(x, name=None):
+    """paddle.hstack (tensor/manipulation.py)."""
+    def f(*ts):
+        return jnp.hstack(ts)
+
+    return apply("hstack", f, *x)
+
+
+def vstack(x, name=None):
+    def f(*ts):
+        return jnp.vstack(ts)
+
+    return apply("vstack", f, *x)
+
+
+def dstack(x, name=None):
+    def f(*ts):
+        return jnp.dstack(ts)
+
+    return apply("dstack", f, *x)
+
+
+def sgn(x, name=None):
+    """paddle.sgn (tensor/math.py): sign for real, x/|x| for complex."""
+    def f(a):
+        if jnp.iscomplexobj(a):
+            mag = jnp.abs(a)
+            return jnp.where(mag == 0, 0, a / jnp.maximum(mag, 1e-38))
+        return jnp.sign(a)
+
+    return apply("sgn", f, x)
+
+
+def signbit(x, name=None):
+    """paddle.signbit (tensor/math.py)."""
+    return apply("signbit", lambda a: jnp.signbit(a), x)
+
+
+def polar(abs, angle, name=None):  # noqa: A002 — paddle arg name
+    """paddle.polar (tensor/creation.py): abs * exp(1j*angle); complex128
+    for float64 inputs, complex64 otherwise (reference promotion)."""
+    def f(r, t):
+        cdt = (jnp.complex128 if r.dtype == jnp.float64
+               else jnp.complex64)
+        return (r * jnp.cos(t) + 1j * r * jnp.sin(t)).astype(cdt)
+
+    return apply("polar", f, abs, angle)
+
+
+def view_as(x, other, name=None):
+    """paddle.view_as (tensor/manipulation.py): reshape to other's shape."""
+    shp = tuple(other.shape)
+    return apply("view_as", lambda a: a.reshape(shp), x)
+
+
+def isin(x, test_x, assume_unique=False, invert=False, name=None):
+    """paddle.isin (tensor/search.py)."""
+    def f(a, t):
+        out = jnp.isin(a, t.reshape(-1))
+        return ~out if invert else out
+
+    return apply("isin", f, x, test_x, differentiable=False)
+
+
+def floor_mod(x, y, name=None):
+    """paddle.floor_mod == paddle.remainder (tensor/math.py alias)."""
+    return apply("floor_mod", lambda a, b: jnp.mod(a, b), x, y)
+
+
+def broadcast_shape(x_shape, y_shape):
+    """paddle.broadcast_shape (tensor/manipulation.py) — pure shape math."""
+    return list(np.broadcast_shapes(tuple(x_shape), tuple(y_shape)))
+
+
+def is_floating_point(x):
+    """paddle.is_floating_point (tensor/attribute.py). `.dtype` exists on
+    Tensor and jax.Array alike — never touch `._value` (host round-trip
+    on the tunneled backend)."""
+    return jnp.issubdtype(jnp.dtype(x.dtype), jnp.floating)
+
+
+def is_complex(x):
+    return jnp.issubdtype(jnp.dtype(x.dtype), jnp.complexfloating)
+
+
+def is_integer(x):
+    return jnp.issubdtype(jnp.dtype(x.dtype), jnp.integer)
+
+
+def diagonal_scatter(x, y, offset=0, axis1=0, axis2=1, name=None):
+    """paddle.diagonal_scatter (tensor/manipulation.py): write y onto the
+    selected diagonal of x."""
+    def f(a, b):
+        n = min(a.shape[axis1], a.shape[axis2])
+        if offset >= 0:
+            i = jnp.arange(min(n, a.shape[axis2] - offset))
+            rows, cols = i, i + offset
+        else:
+            i = jnp.arange(min(n, a.shape[axis1] + offset))
+            rows, cols = i - offset, i
+        # move axis1/axis2 to the front so the .at indexing is general
+        am = jnp.moveaxis(a, (axis1, axis2), (0, 1))
+        bm = jnp.moveaxis(b, -1, 0) if b.ndim > 1 else b
+        am = am.at[rows, cols].set(bm)
+        return jnp.moveaxis(am, (0, 1), (axis1, axis2))
+
+    return apply("diagonal_scatter", f, x, y)
+
+
+def cumulative_trapezoid(y, x=None, dx=None, axis=-1, name=None):
+    """paddle.cumulative_trapezoid (tensor/math.py)."""
+    def f(yv, *rest):
+        d = dx if dx is not None else 1.0
+        yv1 = jnp.take(yv, jnp.arange(1, yv.shape[axis]), axis=axis)
+        yv0 = jnp.take(yv, jnp.arange(0, yv.shape[axis] - 1), axis=axis)
+        if rest:
+            xv = rest[0]
+            x1 = jnp.take(xv, jnp.arange(1, xv.shape[axis]), axis=axis)
+            x0 = jnp.take(xv, jnp.arange(0, xv.shape[axis] - 1), axis=axis)
+            d = x1 - x0
+        return jnp.cumsum((yv0 + yv1) / 2.0 * d, axis=axis)
+
+    args = (y,) if x is None else (y, x)
+    return apply("cumulative_trapezoid", f, *args)
+
+
+def combinations(x, r=2, with_replacement=False, name=None):
+    """paddle.combinations (tensor/math.py): r-combinations of a 1-D
+    tensor's elements."""
+    import itertools as _it
+
+    n = x.shape[0]
+    picker = (_it.combinations_with_replacement if with_replacement
+              else _it.combinations)
+    idx = np.asarray(list(picker(range(n), r)), np.int32).reshape(-1, r)
+
+    def f(a):
+        return a[jnp.asarray(idx)]
+
+    return apply("combinations", f, x)
+
+
+def histogramdd(x, bins=10, ranges=None, density=False, weights=None,
+                name=None):
+    """paddle.histogramdd (tensor/linalg.py): D-dimensional histogram.
+    Host computation (np.histogramdd) — binning is data-dependent."""
+    xv = np.asarray(x.numpy() if hasattr(x, "numpy") else x)
+    wv = (np.asarray(weights.numpy() if hasattr(weights, "numpy")
+                     else weights) if weights is not None else None)
+    if isinstance(bins, (list, tuple)) and len(bins) and hasattr(
+            bins[0], "numpy"):
+        bins = [np.asarray(b.numpy()) for b in bins]
+    hist, edges = np.histogramdd(xv, bins=bins, range=ranges,
+                                 density=density, weights=wv)
+    return (Tensor(hist.astype(np.float32)),
+            [Tensor(e.astype(np.float32)) for e in edges])
+
+
+def gammainc(x, y, name=None):
+    """paddle.gammainc: regularized lower incomplete gamma."""
+    return apply("gammainc", lambda a, b: jax.scipy.special.gammainc(a, b),
+                 x, y)
+
+
+def multigammaln(x, p, name=None):
+    """paddle.multigammaln (tensor/math.py)."""
+    def f(a):
+        j = jnp.arange(1, p + 1, dtype=a.dtype)
+        return (p * (p - 1) / 4.0 * jnp.log(jnp.pi)
+                + jnp.sum(jax.scipy.special.gammaln(
+                    a[..., None] + (1.0 - j) / 2.0), axis=-1))
+
+    return apply("multigammaln", f, x)
+
+
+def log_normal(mean=1.0, std=2.0, shape=None, dtype=None, name=None):
+    """paddle.log_normal (tensor/random.py): exp(normal(mean, std))."""
+    from paddle_tpu.framework import random as _rng_mod
+
+    def f():
+        key = _rng_mod.next_key()
+        samp = mean + std * jax.random.normal(
+            key, tuple(shape or (1,)), jnp.float32)
+        return jnp.exp(samp)
+
+    return apply("log_normal", f, differentiable=False)
+
+
+
+
+
+def randint_like(x, low=0, high=None, dtype=None, name=None):
+    """paddle.randint_like (tensor/random.py)."""
+    from paddle_tpu.framework import random as _rng_mod
+
+    if high is None:
+        low, high = 0, low
+
+    def f(a):
+        key = _rng_mod.next_key()
+        return jax.random.randint(key, a.shape, low, high,
+                                  dtype=jnp.int32)
+
+    out = apply("randint_like", f, x, differentiable=False)
+    # reference semantics: default dtype is X's dtype, not int32
+    return out.astype(x.dtype if dtype is None else dtype)
+
+
+class _DTypeInfo:
+    def __init__(self, np_info, kind):
+        self.min = (int(np_info.min) if kind == "i" else float(np_info.min))
+        self.max = (int(np_info.max) if kind == "i" else float(np_info.max))
+        self.bits = np_info.bits
+        self.dtype = str(np_info.dtype)
+        if kind == "f":
+            self.eps = float(np_info.eps)
+            self.tiny = float(np_info.tiny)
+            self.smallest_normal = float(np_info.tiny)
+            self.resolution = float(np_info.resolution)
+
+    def __repr__(self):
+        return f"{type(self).__name__}({self.dtype})"
+
+
+def iinfo(dtype):
+    """paddle.iinfo (python/paddle/framework/dtype.py iinfo parity)."""
+    from paddle_tpu.framework import dtype as _dt
+
+    return _DTypeInfo(np.iinfo(np.dtype(_dt.convert_dtype(dtype))), "i")
+
+
+def finfo(dtype):
+    """paddle.finfo."""
+    from paddle_tpu.framework import dtype as _dt
+
+    name = _dt.convert_dtype(dtype)
+    try:
+        info = np.finfo(np.dtype(name))
+    except (TypeError, ValueError):
+        # numpy's finfo rejects the ml_dtypes-registered types (bfloat16,
+        # fp8) even though np.dtype resolves them
+        import ml_dtypes
+
+        info = ml_dtypes.finfo(name)
+    return _DTypeInfo(info, "f")

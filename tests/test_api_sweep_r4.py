@@ -1,0 +1,179 @@
+"""r4 top-level API sweep: paddle.* must cover the reference __init__'s
+full __all__ (418 names), with behavioral pins for the newly added ops
+(reference python/paddle/tensor/* cited per op)."""
+
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+_REF_INIT = "/root/reference/python/paddle/__init__.py"
+
+
+@pytest.mark.skipif(not os.path.exists(_REF_INIT),
+                    reason="reference tree unavailable")
+def test_top_level_all_coverage():
+    import ast
+
+    names = []
+    for node in ast.walk(ast.parse(open(_REF_INIT).read())):
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if getattr(t, "id", None) == "__all__":
+                    names = [ast.literal_eval(e) for e in node.value.elts]
+    assert len(names) > 400
+    missing = [n for n in names if not hasattr(paddle, n)]
+    assert missing == [], missing
+
+
+def test_block_diag_and_stacks():
+    a = paddle.to_tensor(np.ones((2, 2), np.float32))
+    b = paddle.to_tensor(np.full((1, 3), 2.0, np.float32))
+    out = paddle.block_diag([a, b]).numpy()
+    assert out.shape == (3, 5)
+    np.testing.assert_allclose(out[:2, :2], 1.0)
+    np.testing.assert_allclose(out[2, 2:], 2.0)
+    assert out[:2, 2:].sum() == 0
+
+    v = [paddle.to_tensor(np.arange(3, dtype=np.float32)) for _ in range(2)]
+    assert paddle.hstack(v).shape == [6]
+    assert paddle.vstack(v).shape == [2, 3]
+    assert paddle.dstack(v).shape == [1, 3, 2]
+
+
+def test_tensor_split_uneven():
+    x = paddle.to_tensor(np.arange(7, dtype=np.int32))
+    parts = paddle.tensor_split(x, 3)
+    assert [p.shape[0] for p in parts] == [3, 2, 2]
+    np.testing.assert_array_equal(parts[0].numpy(), [0, 1, 2])
+    parts = paddle.tensor_split(x, [2, 5])
+    assert [p.shape[0] for p in parts] == [2, 3, 2]
+
+
+def test_isin_sgn_signbit_polar():
+    x = paddle.to_tensor(np.asarray([1, 3, 5], np.int32))
+    t = paddle.to_tensor(np.asarray([3, 5, 9], np.int32))
+    np.testing.assert_array_equal(paddle.isin(x, t).numpy(),
+                                  [False, True, True])
+    np.testing.assert_array_equal(
+        paddle.isin(x, t, invert=True).numpy(), [True, False, False])
+    np.testing.assert_allclose(
+        paddle.sgn(paddle.to_tensor(np.asarray([-2.0, 0.0, 7.0],
+                                               np.float32))).numpy(),
+        [-1, 0, 1])
+    np.testing.assert_array_equal(
+        paddle.signbit(paddle.to_tensor(
+            np.asarray([-1.0, 0.0, 2.0], np.float32))).numpy(),
+        [True, False, False])
+    p = paddle.polar(paddle.to_tensor(np.asarray([1.0, 2.0], np.float32)),
+                     paddle.to_tensor(np.asarray([0.0, np.pi / 2],
+                                                 np.float32)))
+    np.testing.assert_allclose(p.numpy(),
+                               [1 + 0j, 2j], atol=1e-6)
+
+
+def test_diagonal_scatter_and_view_as():
+    x = paddle.zeros((3, 3))
+    y = paddle.to_tensor(np.asarray([1.0, 2.0, 3.0], np.float32))
+    out = paddle.diagonal_scatter(x, y).numpy()
+    np.testing.assert_allclose(np.diag(out), [1, 2, 3])
+    v = paddle.view_as(paddle.to_tensor(np.arange(6, dtype=np.float32)),
+                       paddle.zeros((2, 3)))
+    assert v.shape == [2, 3]
+
+
+def test_cumulative_trapezoid_and_combinations():
+    y = paddle.to_tensor(np.asarray([1.0, 2.0, 3.0], np.float32))
+    np.testing.assert_allclose(
+        paddle.cumulative_trapezoid(y).numpy(), [1.5, 4.0])
+    c = paddle.combinations(paddle.to_tensor(
+        np.asarray([10, 20, 30], np.int32)), 2)
+    np.testing.assert_array_equal(c.numpy(),
+                                  [[10, 20], [10, 30], [20, 30]])
+
+
+def test_histogramdd_and_info():
+    pts = paddle.to_tensor(np.random.default_rng(0).normal(
+        size=(100, 2)).astype(np.float32))
+    hist, edges = paddle.histogramdd(pts, bins=5)
+    assert hist.shape == [5, 5] and len(edges) == 2
+    assert float(hist.numpy().sum()) == 100.0
+    assert paddle.iinfo(paddle.int8).max == 127
+    fi = paddle.finfo(paddle.float32)
+    assert fi.bits == 32 and fi.eps < 1e-6
+    bi = paddle.finfo(paddle.bfloat16)
+    assert bi.bits == 16
+
+
+def test_random_families_reproducible():
+    paddle.seed(0)
+    lam = paddle.to_tensor(np.full((4,), 5.0, np.float32))
+    p1 = paddle.poisson(lam).numpy()
+    paddle.seed(0)
+    p2 = paddle.poisson(lam).numpy()
+    np.testing.assert_array_equal(p1, p2)
+    n = paddle.to_tensor(np.full((4,), 10.0, np.float32))
+    pr = paddle.to_tensor(np.full((4,), 0.5, np.float32))
+    b = paddle.binomial(n, pr).numpy()
+    assert ((b >= 0) & (b <= 10)).all()
+    g = paddle.standard_gamma(paddle.to_tensor(
+        np.full((8,), 2.0, np.float32))).numpy()
+    assert (g > 0).all()
+    r = paddle.randint_like(paddle.zeros((3, 3)), 2, 9).numpy()
+    assert ((r >= 2) & (r < 9)).all()
+
+
+def test_inplace_variants_and_guard():
+    x = paddle.to_tensor(np.asarray([0.5, -0.5], np.float32))
+    ret = paddle.tanh_(x)
+    assert ret is x
+    np.testing.assert_allclose(x.numpy(), np.tanh([0.5, -0.5]), rtol=1e-6)
+    # r4-synthesized set: multiply_ / greater_than_ / nan_to_num_
+    y = paddle.to_tensor(np.asarray([2.0, 4.0], np.float32))
+    paddle.multiply_(y, paddle.to_tensor(np.asarray([3.0, 0.5],
+                                                    np.float32)))
+    np.testing.assert_allclose(y.numpy(), [6.0, 2.0])
+    z = paddle.to_tensor(np.asarray([np.nan, 1.0], np.float32))
+    paddle.nan_to_num_(z)
+    assert np.isfinite(z.numpy()).all()
+    # in-place random fill is seed-reproducible and keeps shape
+    paddle.seed(1)
+    a = paddle.zeros((64,))
+    paddle.normal_(a)
+    paddle.seed(1)
+    b = paddle.zeros((64,))
+    paddle.normal_(b)
+    np.testing.assert_array_equal(a.numpy(), b.numpy())
+    assert abs(float(a.numpy().std()) - 1.0) < 0.35
+    # grad-requiring leaves refuse in-place mutation (reference guard)
+    w = paddle.to_tensor(np.ones(2, np.float32))
+    w.stop_gradient = False
+    with pytest.raises(RuntimeError):
+        paddle.tanh_(w)
+
+
+def test_misc_api_names():
+    assert int(paddle.rank(paddle.zeros((2, 3, 4))).numpy()) == 3
+    p = paddle.create_parameter([4, 2], "float32")
+    assert p.shape == [4, 2] and not p.stop_gradient
+    assert paddle.broadcast_shape([2, 1, 3], [4, 3]) == [2, 4, 3]
+    assert paddle.is_floating_point(paddle.zeros((1,)))
+    assert not paddle.is_integer(paddle.zeros((1,)))
+    with paddle.LazyGuard():
+        import paddle_tpu.nn as nn
+
+        lin = nn.Linear(2, 2)
+    assert lin.weight.shape == [2, 2]
+    from paddle_tpu import nn as nn2
+
+    net = nn2.Sequential(nn2.Linear(8, 4), nn2.ReLU(), nn2.Linear(4, 2))
+    fl = paddle.flops(net, [1, 8])
+    assert fl == 8 * 4 + 4 * 2
+    with pytest.raises(RuntimeError):
+        paddle.CUDAPlace(0)
+    paddle.set_printoptions(precision=4)
+    paddle.disable_signal_handler()
+    st = paddle.get_cuda_rng_state()
+    paddle.set_cuda_rng_state(st)
