@@ -102,6 +102,12 @@ class TapeNode:
         return f"TapeNode({self.name}, n_in={len(self.inputs)}, n_out={self.n_outputs})"
 
 
+def _jnp_inexact(dtype):
+    import jax.numpy as jnp
+
+    return jnp.issubdtype(dtype, jnp.inexact)
+
+
 def _zero_cotangent_aval(shape, dtype):
     """Zero cotangent from a stored (shape, dtype) — the output Tensor may be
     dead (e.g. dropped aux outputs of multi-output ops)."""
@@ -252,7 +258,13 @@ def run_backward(tensors, grad_tensors=None, retain_graph: bool = False) -> None
                 filled.append(_zero_cotangent_aval(*aval))
             else:
                 aval = node.out_avals[i]
-                if (aval is not None and hasattr(c, "dtype")
+                if aval is not None and not _jnp_inexact(aval[1]):
+                    # integer/bool outputs (argmax masks, index tensors)
+                    # carry no gradient: jax.vjp wants a float0 zero here,
+                    # and casting whatever propagated in (float0 bytes,
+                    # a stray float zero) to the int dtype explodes
+                    c = _zero_cotangent_aval(*aval)
+                elif (aval is not None and hasattr(c, "dtype")
                         and c.dtype != aval[1]):
                     # accumulate in the PRIMAL output dtype (mixed-precision
                     # graphs feed bf16 cotangents into fp32 producers when a

@@ -1274,3 +1274,28 @@ def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
 
     return apply("ctc_loss", f, log_probs, labels, input_lengths,
                  label_lengths)
+
+# r4 functional closure (pooling/loss/misc behind the remaining nn.*
+# layer classes) lives in functional_r4 to keep this file navigable
+from paddle_tpu.nn.functional_r4 import (  # noqa: F401,E402
+    adaptive_avg_pool3d,
+    adaptive_max_pool1d,
+    adaptive_max_pool3d,
+    bilinear,
+    channel_shuffle,
+    fractional_max_pool2d,
+    fractional_max_pool3d,
+    gaussian_nll_loss,
+    hsigmoid_loss,
+    lp_pool1d,
+    lp_pool2d,
+    max_pool_with_mask,
+    max_unpool1d,
+    max_unpool2d,
+    max_unpool3d,
+    multi_label_soft_margin_loss,
+    multi_margin_loss,
+    rnnt_loss,
+    soft_margin_loss,
+    triplet_margin_with_distance_loss,
+)

@@ -1,0 +1,499 @@
+"""r4 nn.functional closure (reference python/paddle/nn/functional/*):
+the remaining pooling / loss / misc functionals behind the 47 missing
+nn.* layer classes. Pure jnp/lax compositions under the op layer.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.core.dispatch import apply
+
+
+def _nd(v, n):
+    if isinstance(v, (list, tuple)):
+        return tuple(v)
+    return (v,) * n
+
+
+def _window_patches(a, kernel, stride, padding, nd):
+    """[N, C, *spatial] -> (patches [N, C, prod(k), *out_spatial],
+    flat_src_index [same]) via conv_general_dilated_patches."""
+    k = _nd(kernel, nd)
+    s = _nd(stride or kernel, nd)
+    p = _nd(padding, nd)
+    pads = [(pi, pi) for pi in p]
+    n, c = a.shape[:2]
+    patches = jax.lax.conv_general_dilated_patches(
+        a, filter_shape=k, window_strides=s, padding=pads)
+    # patches: [N, C*prod(k), *out]; regroup to [N, C, prod(k), *out]
+    out_sp = patches.shape[2:]
+    patches = patches.reshape((n, c, int(np.prod(k))) + out_sp)
+
+    # flat source index of each in-window element, computed ANALYTICALLY
+    # in int32 (a float index grid loses exactness past 2^24 elements);
+    # padding cells get -1
+    sp = a.shape[2:]
+    k_offsets = np.stack(np.meshgrid(
+        *[np.arange(ki) for ki in k], indexing="ij"), -1).reshape(-1, nd)
+    out_grids = np.stack(np.meshgrid(
+        *[np.arange(o) for o in out_sp], indexing="ij"), -1)  # [*out, nd]
+    # src coordinate per (k_offset, out_pos) and dim
+    coords = (out_grids[None] * np.asarray(s) - np.asarray(p)
+              + k_offsets.reshape((-1,) + (1,) * nd + (nd,)))
+    valid = np.all((coords >= 0) & (coords < np.asarray(sp)), axis=-1)
+    strides_flat = np.cumprod((list(sp[1:]) + [1])[::-1])[::-1]
+    flat = np.tensordot(coords, strides_flat, axes=([-1], [0]))
+    flat = np.where(valid, flat, -1).astype(np.int32)
+    idx_patches = jnp.asarray(flat)[None, None]  # [1,1,prod(k),*out]
+    return patches, idx_patches
+
+
+def _max_pool_with_mask(a, kernel, stride, padding, nd):
+    patches, idx = _window_patches(a, kernel, stride, padding, nd)
+    filled = jnp.where(idx < 0, -jnp.inf, patches)
+    out = jnp.max(filled, axis=2)
+    arg = jnp.argmax(filled, axis=2)
+    mask = jnp.take_along_axis(
+        jnp.broadcast_to(idx, patches.shape), arg[:, :, None], axis=2
+    )[:, :, 0]
+    return out, mask.astype(jnp.int32)
+
+
+def max_pool_with_mask(x, kernel_size, stride=None, padding=0, nd=2,
+                       name=None):
+    """Shared return_mask pooling core: (pooled, flat spatial argmax)."""
+    def f(a):
+        return _max_pool_with_mask(a, kernel_size, stride, padding, nd)
+
+    return apply("max_pool_with_mask", f, x)
+
+
+def _unpool(name, nd):
+    def fn(x, indices, kernel_size=None, stride=None, padding=0,
+           output_size=None, data_format=None, name=None):
+        def f(a, idx):
+            n, c = a.shape[:2]
+            if output_size is not None:
+                out_sp = tuple(output_size)[-nd:]
+            else:
+                k = _nd(kernel_size, nd)
+                s = _nd(stride or kernel_size, nd)
+                p = _nd(padding, nd)
+                out_sp = tuple(
+                    (a.shape[2 + i] - 1) * s[i] - 2 * p[i] + k[i]
+                    for i in range(nd))
+            flat = jnp.zeros((n, c, int(np.prod(out_sp))), a.dtype)
+            ii = idx.reshape(n, c, -1).astype(jnp.int32)
+            vv = a.reshape(n, c, -1)
+            flat = flat.at[
+                jnp.arange(n)[:, None, None],
+                jnp.arange(c)[None, :, None], ii].set(vv)
+            return flat.reshape((n, c) + out_sp)
+
+        return apply(name, f, x, indices)
+
+    fn.__name__ = name
+    return fn
+
+
+max_unpool1d = _unpool("max_unpool1d", 1)
+max_unpool2d = _unpool("max_unpool2d", 2)
+max_unpool3d = _unpool("max_unpool3d", 3)
+
+
+def _adaptive_bins(n_in, n_out):
+    """floor/ceil adaptive-pool bin boundaries (any size, not just exact
+    multiples)."""
+    return [(i * n_in) // n_out for i in range(n_out)] + [n_in]
+
+
+def adaptive_max_pool1d(x, output_size, return_mask=False, name=None):
+    def f(a):
+        n, c, l = a.shape
+        o = output_size if isinstance(output_size, int) else output_size[0]
+        bnd = _adaptive_bins(l, o)
+        outs, args = [], []
+        for i in range(o):
+            win = a[:, :, bnd[i]:bnd[i + 1]]
+            outs.append(jnp.max(win, axis=2))
+            if return_mask:
+                args.append(jnp.argmax(win, axis=2) + bnd[i])
+        out = jnp.stack(outs, axis=-1)
+        if return_mask:
+            return out, jnp.stack(args, axis=-1).astype(jnp.int32)
+        return out
+
+    return apply("adaptive_max_pool1d", f, x)
+
+
+def _adaptive_pool3d(a, osz, reducer):
+    n, c, d, h, w = a.shape
+    od, oh, ow = (osz[0] or d), (osz[1] or h), (osz[2] or w)
+    if d % od == 0 and h % oh == 0 and w % ow == 0:
+        a8 = a.reshape(n, c, od, d // od, oh, h // oh, ow, w // ow)
+        return reducer(a8, axis=(3, 5, 7))
+    db = _adaptive_bins(d, od)
+    hb = _adaptive_bins(h, oh)
+    wb = _adaptive_bins(w, ow)
+    out = jnp.zeros((n, c, od, oh, ow), a.dtype)
+    for di in range(od):
+        for i in range(oh):
+            for j in range(ow):
+                win = a[:, :, db[di]:db[di + 1], hb[i]:hb[i + 1],
+                        wb[j]:wb[j + 1]]
+                out = out.at[:, :, di, i, j].set(
+                    reducer(win, axis=(2, 3, 4)))
+    return out
+
+
+def adaptive_avg_pool3d(x, output_size, data_format="NCDHW", name=None):
+    osz = _nd(output_size, 3)
+    return apply("adaptive_avg_pool3d",
+                 lambda a: _adaptive_pool3d(a, osz, jnp.mean), x)
+
+
+def adaptive_max_pool3d(x, output_size, return_mask=False, name=None):
+    if return_mask:
+        raise NotImplementedError(
+            "adaptive_max_pool3d return_mask is not implemented; use "
+            "max_pool_with_mask for unpooling indices")
+    osz = _nd(output_size, 3)
+    return apply("adaptive_max_pool3d",
+                 lambda a: _adaptive_pool3d(a, osz, jnp.max), x)
+
+
+def lp_pool1d(x, norm_type, kernel_size, stride=None, padding=0,
+              ceil_mode=False, data_format="NCL", name=None):
+    """(sum of x^p over window)^(1/p) (reference lp_pool1d)."""
+    from paddle_tpu.nn import functional as F
+
+    p = float(norm_type)
+    powed = apply("lp_pool1d", lambda a: jnp.abs(a) ** p, x)
+    pooled = F.avg_pool1d(powed, kernel_size, stride, padding,
+                          exclusive=False, ceil_mode=ceil_mode,
+                          data_format=data_format)
+    k = kernel_size if isinstance(kernel_size, int) else kernel_size[0]
+    return apply("lp_pool1d", lambda a: (a * k) ** (1.0 / p), pooled)
+
+
+def lp_pool2d(x, norm_type, kernel_size, stride=None, padding=0,
+              ceil_mode=False, data_format="NCHW", name=None):
+    from paddle_tpu.nn import functional as F
+
+    p = float(norm_type)
+    powed = apply("lp_pool2d", lambda a: jnp.abs(a) ** p, x)
+    pooled = F.avg_pool2d(powed, kernel_size, stride, padding,
+                          ceil_mode=ceil_mode, exclusive=False,
+                          data_format=data_format)
+    k = _nd(kernel_size, 2)
+    area = k[0] * k[1]
+    return apply("lp_pool2d", lambda a: (a * area) ** (1.0 / p), pooled)
+
+
+def fractional_max_pool2d(x, output_size, kernel_size=None,
+                          random_u=None, return_mask=False, name=None):
+    """Fractional max pooling (reference fractional_max_pool2d):
+    pseudo-random pooling-region boundaries from one uniform draw u."""
+    if return_mask:
+        raise NotImplementedError(
+            "fractional_max_pool2d return_mask is not implemented")
+    osz = _nd(output_size, 2)
+
+    def bounds(n_in, n_out, u):
+        alpha = n_in / n_out
+        # the standard fractional pooling index sequence
+        return [int(np.ceil(alpha * (i + u))) - int(np.ceil(alpha * u))
+                for i in range(n_out + 1)]
+
+    def f(a):
+        n, c, h, w = a.shape
+        oh, ow = osz
+        if random_u is not None:
+            u = float(random_u)
+        else:
+            from paddle_tpu.framework.random import np_rng
+
+            u = float(np_rng().random())
+        hb = bounds(h, oh, u)
+        wb = bounds(w, ow, u)
+        rows = []
+        for i in range(oh):
+            cols = []
+            for j in range(ow):
+                win = a[:, :, hb[i]:max(hb[i + 1], hb[i] + 1),
+                        wb[j]:max(wb[j + 1], wb[j] + 1)]
+                cols.append(jnp.max(win, axis=(2, 3)))
+            rows.append(jnp.stack(cols, axis=-1))
+        return jnp.stack(rows, axis=-2)
+
+    return apply("fractional_max_pool2d", f, x)
+
+
+def fractional_max_pool3d(x, output_size, kernel_size=None,
+                          random_u=None, return_mask=False, name=None):
+    if return_mask:
+        raise NotImplementedError(
+            "fractional_max_pool3d return_mask is not implemented")
+    osz = _nd(output_size, 3)
+
+    def f(a):
+        n, c, d, h, w = a.shape
+        od, oh, ow = osz
+        if random_u is not None:
+            u = float(random_u)
+        else:
+            from paddle_tpu.framework.random import np_rng
+
+            u = float(np_rng().random())
+
+        def bounds(n_in, n_out):
+            alpha = n_in / n_out
+            return [int(np.ceil(alpha * (i + u)))
+                    - int(np.ceil(alpha * u)) for i in range(n_out + 1)]
+
+        db, hb, wb = bounds(d, od), bounds(h, oh), bounds(w, ow)
+        out = jnp.zeros((n, c, od, oh, ow), a.dtype)
+        for di in range(od):
+            for i in range(oh):
+                for j in range(ow):
+                    win = a[:, :, db[di]:max(db[di + 1], db[di] + 1),
+                            hb[i]:max(hb[i + 1], hb[i] + 1),
+                            wb[j]:max(wb[j + 1], wb[j] + 1)]
+                    out = out.at[:, :, di, i, j].set(
+                        jnp.max(win, axis=(2, 3, 4)))
+        return out
+
+    return apply("fractional_max_pool3d", f, x)
+
+
+def bilinear(x1, x2, weight, bias=None, name=None):
+    """out[n, o] = x1[n, i] W[o, i, j] x2[n, j] (+ b) — reference
+    nn/functional/common.py bilinear."""
+    def f(a, b, w, *rest):
+        out = jnp.einsum("ni,oij,nj->no", a, w, b)
+        if rest:
+            out = out + rest[0]
+        return out
+
+    args = [x1, x2, weight] + ([bias] if bias is not None else [])
+    return apply("bilinear", f, *args)
+
+
+def channel_shuffle(x, groups, data_format="NCHW", name=None):
+    def f(a):
+        if data_format == "NCHW":
+            n, c, h, w = a.shape
+            return a.reshape(n, groups, c // groups, h, w).transpose(
+                0, 2, 1, 3, 4).reshape(n, c, h, w)
+        n, h, w, c = a.shape
+        return a.reshape(n, h, w, groups, c // groups).transpose(
+            0, 1, 2, 4, 3).reshape(n, h, w, c)
+
+    return apply("channel_shuffle", f, x)
+
+
+# ----------------------------------------------------------------- losses
+
+
+def _reduce_loss(loss, reduction):
+    if reduction == "mean":
+        return jnp.mean(loss)
+    if reduction == "sum":
+        return jnp.sum(loss)
+    return loss
+
+
+def soft_margin_loss(input, label, reduction="mean", name=None):
+    """log(1 + exp(-label * input)) (reference soft_margin_loss)."""
+    def f(x, y):
+        return _reduce_loss(jnp.log1p(jnp.exp(-y.astype(x.dtype) * x)),
+                            reduction)
+
+    return apply("soft_margin_loss", f, input, label)
+
+
+def multi_label_soft_margin_loss(input, label, weight=None,
+                                 reduction="mean", name=None):
+    def f(x, y, *w):
+        y = y.astype(x.dtype)
+        term = y * jax.nn.log_sigmoid(x) + (1 - y) * jax.nn.log_sigmoid(-x)
+        if w:
+            term = term * w[0]
+        return _reduce_loss(-jnp.mean(term, axis=-1), reduction)
+
+    args = [input, label] + ([weight] if weight is not None else [])
+    return apply("multi_label_soft_margin_loss", f, *args)
+
+
+def multi_margin_loss(input, label, p=1, margin=1.0, weight=None,
+                      reduction="mean", name=None):
+    def f(x, y, *w):
+        n, c = x.shape
+        correct = jnp.take_along_axis(x, y[:, None].astype(jnp.int32),
+                                      axis=1)
+        m = jnp.maximum(0.0, margin - correct + x) ** p
+        if w:
+            m = m * w[0][y.astype(jnp.int32)][:, None]
+        # the true-class term is margin^p; zero it explicitly
+        m = m * (1 - jax.nn.one_hot(y.astype(jnp.int32), c, dtype=x.dtype))
+        return _reduce_loss(jnp.sum(m, axis=1) / c, reduction)
+
+    args = [input, label] + ([weight] if weight is not None else [])
+    return apply("multi_margin_loss", f, *args)
+
+
+def gaussian_nll_loss(input, label, variance, full=False, epsilon=1e-6,
+                      reduction="mean", name=None):
+    def f(mu, y, var):
+        var = jnp.maximum(var, epsilon)
+        loss = 0.5 * (jnp.log(var) + (y - mu) ** 2 / var)
+        if full:
+            loss = loss + 0.5 * jnp.log(jnp.asarray(2 * np.pi, mu.dtype))
+        return _reduce_loss(loss, reduction)
+
+    return apply("gaussian_nll_loss", f, input, label, variance)
+
+
+def triplet_margin_with_distance_loss(input, positive, negative,
+                                      distance_function=None, margin=1.0,
+                                      swap=False, reduction="mean",
+                                      name=None):
+    from paddle_tpu.tensor import Tensor
+
+    def default_dist(a, b):
+        return jnp.sqrt(jnp.sum((a - b) ** 2, axis=-1) + 1e-12)
+
+    def f(a, p, ng):
+        if distance_function is not None:
+            def dist(u, v):
+                out = distance_function(Tensor._from_value(u),
+                                        Tensor._from_value(v))
+                return out._value if isinstance(out, Tensor) else out
+        else:
+            dist = default_dist
+        dp = dist(a, p)
+        dn = dist(a, ng)
+        if swap:
+            dn = jnp.minimum(dn, dist(p, ng))
+        return _reduce_loss(jnp.maximum(0.0, dp - dn + margin), reduction)
+
+    return apply("triplet_margin_with_distance_loss", f, input, positive,
+                 negative)
+
+
+def hsigmoid_loss(input, label, num_classes, weight, bias=None,
+                  path_table=None, path_code=None, is_sparse=False,
+                  name=None):
+    """Hierarchical sigmoid over the DEFAULT complete binary tree
+    (reference hsigmoid_loss; custom path tables via path_table/path_code).
+    """
+    if path_table is not None or path_code is not None:
+        raise NotImplementedError(
+            "custom-tree hsigmoid (path_table/path_code) is not "
+            "implemented; the default complete-binary-tree mode is")
+
+    depth = int(np.ceil(np.log2(max(num_classes, 2))))
+
+    def f(x, y, w, *rest):
+        b = rest[0] if rest else None
+        # node index walk of the complete binary tree: label+num_classes
+        # is the leaf code; ancestors are successive right-shifts
+        code = y.astype(jnp.int32) + num_classes
+        losses = 0.0
+        for d in range(depth):
+            parent = code >> (d + 1)
+            # leaves sit at VARYING depth in the complete tree: once the
+            # walk passes the root (parent < 1) there is no decision —
+            # mask the step or a node index of -1 would wrap to the last
+            # weight row and corrupt that node's gradient
+            valid = (parent >= 1).astype(x.dtype)
+            is_right = ((code >> d) & 1).astype(x.dtype)
+            node = jnp.maximum(parent - 1, 0)
+            logit = jnp.einsum("nf,nf->n", x, w[node])
+            if b is not None:
+                logit = logit + b[node]
+            losses = losses - valid * (
+                is_right * jax.nn.log_sigmoid(logit)
+                + (1 - is_right) * jax.nn.log_sigmoid(-logit))
+        return losses[:, None]
+
+    args = [input, label, weight] + ([bias] if bias is not None else [])
+    return apply("hsigmoid_loss", f, *args)
+
+
+def rnnt_loss(input, label, input_lengths, label_lengths, blank=0,
+              fastemit_lambda=0.0, reduction="mean", name=None):
+    """RNN-Transducer loss (reference rnnt_loss): exact alpha-recursion
+    over the (T, U) lattice in log space, lax.scan over T.
+
+    FastEmit regularization is NOT implemented: the reference signature
+    defaults fastemit_lambda=0.001, but silently ignoring it would train
+    a different objective — here the default is 0.0 and a non-zero value
+    raises."""
+    if fastemit_lambda:
+        raise NotImplementedError(
+            "FastEmit regularization (fastemit_lambda != 0) is not "
+            "implemented in this build")
+    def f(logits, labels, ilen, llen):
+        # logits [B, T, U+1, V] log-probs; labels [B, U]
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        B, T, U1, V = logp.shape
+        U = U1 - 1
+        blank_lp = logp[..., blank]                       # [B, T, U+1]
+        lab = labels.astype(jnp.int32)
+        emit_lp = jnp.take_along_axis(
+            logp[:, :, :U, :], lab[:, None, :, None], axis=3)[..., 0]
+        if U == 0:
+            # empty-label lattice: keep one dummy -inf emit column so the
+            # (always-traced) emit branch of the u-scan stays indexable
+            emit_lp = jnp.full((B, T, 1), -1e30, logp.dtype)
+        # alpha over t, scanned; u-axis vectorized with a cummax-style
+        neg_inf = jnp.asarray(-1e30, logp.dtype)
+
+        def t_step(alpha_prev, t):
+            # horizontal (blank) move from t-1, same u
+            horiz = alpha_prev + blank_lp[:, t - 1, :]
+
+            # vertical (emit) moves happen within the same t: sequential
+            # over u, expressed as a small scan
+            def u_step(carry, u):
+                ui = jnp.clip(u - 1, 0, emit_lp.shape[2] - 1)
+                val = jnp.where(
+                    u == 0, horiz[:, 0],
+                    jnp.logaddexp(horiz[:, u],
+                                  carry + emit_lp[:, t, ui]))
+                return val, val
+
+            _, cols = jax.lax.scan(u_step, jnp.full((B,), neg_inf),
+                                   jnp.arange(U1))
+            alpha_t = jnp.swapaxes(cols, 0, 1)
+            return alpha_t, alpha_t
+
+        # t = 0 row: only emits
+        def u0_step(carry, u):
+            ui = jnp.clip(u - 1, 0, emit_lp.shape[2] - 1)
+            val = jnp.where(u == 0, jnp.zeros((B,), logp.dtype),
+                            carry + emit_lp[:, 0, ui])
+            return val, val
+
+        _, cols0 = jax.lax.scan(u0_step, jnp.full((B,), neg_inf),
+                                jnp.arange(U1))
+        alpha0 = jnp.swapaxes(cols0, 0, 1)
+
+        def scan_body(alpha, t):
+            alpha_t, _ = t_step(alpha, t)
+            return alpha_t, alpha_t
+
+        _, alphas = jax.lax.scan(scan_body, alpha0, jnp.arange(1, T))
+        alphas = jnp.concatenate([alpha0[None], alphas], axis=0)  # [T,B,U+1]
+        tl = (ilen - 1).astype(jnp.int32)
+        ul = llen.astype(jnp.int32)
+        final = alphas[tl, jnp.arange(B), ul] \
+            + blank_lp[jnp.arange(B), tl, ul]
+        loss = -final
+        return _reduce_loss(loss, reduction)
+
+    return apply("rnnt_loss", f, input, label, input_lengths, label_lengths)

@@ -1,0 +1,692 @@
+"""r4 nn-layer closure (reference python/paddle/nn/layer/*): the 47
+layer classes the reference's nn __all__ carries that were still
+missing — thin classes over the (mostly pre-existing) functionals, plus
+the seq2seq decoding pair (BeamSearchDecoder / dynamic_decode) and
+AdaptiveLogSoftmaxWithLoss.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.nn import functional as F
+from paddle_tpu.nn import initializer as I
+from paddle_tpu.nn.layer_base import Layer
+from paddle_tpu.tensor import Tensor
+
+
+# ------------------------------------------------------------------- norms
+
+
+class InstanceNorm1D(Layer):
+    """nn/layer/norm.py InstanceNorm1D (NCL)."""
+
+    def __init__(self, num_features, epsilon=1e-5, momentum=0.9,
+                 weight_attr=None, bias_attr=None, data_format="NCL",
+                 name=None):
+        super().__init__()
+        self._epsilon = epsilon
+        self.scale = (None if weight_attr is False else
+                      self.create_parameter(
+                          [num_features], attr=weight_attr,
+                          default_initializer=I.Constant(1.0)))
+        self.bias = (None if bias_attr is False else self.create_parameter(
+            [num_features], attr=bias_attr, is_bias=True))
+
+    def forward(self, x):
+        return F.instance_norm(x, weight=self.scale, bias=self.bias,
+                               eps=self._epsilon)
+
+
+class InstanceNorm3D(InstanceNorm1D):
+    """nn/layer/norm.py InstanceNorm3D (NCDHW)."""
+
+    def __init__(self, num_features, epsilon=1e-5, momentum=0.9,
+                 weight_attr=None, bias_attr=None, data_format="NCDHW",
+                 name=None):
+        super().__init__(num_features, epsilon, momentum, weight_attr,
+                         bias_attr)
+
+
+# ------------------------------------------------------------- up/pad/shape
+
+
+class UpsamplingNearest2D(Layer):
+    def __init__(self, size=None, scale_factor=None, data_format="NCHW",
+                 name=None):
+        super().__init__()
+        self._kw = dict(size=size, scale_factor=scale_factor,
+                        mode="nearest", data_format=data_format)
+
+    def forward(self, x):
+        return F.interpolate(x, **self._kw)
+
+
+class UpsamplingBilinear2D(Layer):
+    def __init__(self, size=None, scale_factor=None, data_format="NCHW",
+                 name=None):
+        super().__init__()
+        self._kw = dict(size=size, scale_factor=scale_factor,
+                        mode="bilinear", align_corners=True,
+                        data_format=data_format)
+
+    def forward(self, x):
+        return F.interpolate(x, **self._kw)
+
+
+class _PadNd(Layer):
+    def __init__(self, padding, mode="constant", value=0.0,
+                 data_format="NCL", name=None):
+        super().__init__()
+        if isinstance(padding, int):
+            # int padding expands over every spatial edge (paddle Pad*D)
+            nspatial = {"NCL": 1, "NLC": 1, "NCHW": 2, "NHWC": 2,
+                        "NCDHW": 3, "NDHWC": 3}[data_format]
+            padding = [padding] * (2 * nspatial)
+        self._padding = padding
+        self._mode = mode
+        self._value = value
+        self._data_format = data_format
+
+    def forward(self, x):
+        return F.pad(x, self._padding, mode=self._mode, value=self._value,
+                     data_format=self._data_format)
+
+    def extra_repr(self):
+        return (f"padding={self._padding}, mode={self._mode}, "
+                f"value={self._value}, data_format={self._data_format}")
+
+
+class Pad1D(_PadNd):
+    pass
+
+
+class Pad3D(_PadNd):
+    def __init__(self, padding, mode="constant", value=0.0,
+                 data_format="NCDHW", name=None):
+        super().__init__(padding, mode, value, data_format)
+
+
+class ZeroPad1D(_PadNd):
+    def __init__(self, padding, data_format="NCL", name=None):
+        super().__init__(padding, "constant", 0.0, data_format)
+
+
+class ZeroPad2D(_PadNd):
+    def __init__(self, padding, data_format="NCHW", name=None):
+        super().__init__(padding, "constant", 0.0, data_format)
+
+
+class ZeroPad3D(_PadNd):
+    def __init__(self, padding, data_format="NCDHW", name=None):
+        super().__init__(padding, "constant", 0.0, data_format)
+
+
+class Unflatten(Layer):
+    def __init__(self, axis, shape, name=None):
+        super().__init__()
+        self._axis = axis
+        self._shape = list(shape)
+
+    def forward(self, x):
+        ax = self._axis % len(x.shape)
+        new = (list(x.shape[:ax]) + self._shape
+               + list(x.shape[ax + 1:]))
+        return x.reshape(new)
+
+
+class Softmax2D(Layer):
+    """Softmax over the channel axis of NCHW input."""
+
+    def forward(self, x):
+        assert len(x.shape) == 4
+        return F.softmax(x, axis=-3)
+
+
+class ChannelShuffle(Layer):
+    def __init__(self, groups, data_format="NCHW", name=None):
+        super().__init__()
+        self._groups = groups
+        self._data_format = data_format
+
+    def forward(self, x):
+        return F.channel_shuffle(x, self._groups, self._data_format)
+
+
+class PixelUnshuffle(Layer):
+    def __init__(self, downscale_factor, data_format="NCHW", name=None):
+        super().__init__()
+        self._factor = downscale_factor
+        self._data_format = data_format
+
+    def forward(self, x):
+        return F.pixel_unshuffle(x, self._factor, self._data_format)
+
+
+class Unfold(Layer):
+    def __init__(self, kernel_sizes, strides=1, paddings=0, dilations=1,
+                 name=None):
+        super().__init__()
+        self._kw = dict(kernel_sizes=kernel_sizes, strides=strides,
+                        paddings=paddings, dilations=dilations)
+
+    def forward(self, x):
+        return F.unfold(x, **self._kw)
+
+
+class Fold(Layer):
+    def __init__(self, output_sizes, kernel_sizes, strides=1, paddings=0,
+                 dilations=1, name=None):
+        super().__init__()
+        self._kw = dict(output_sizes=output_sizes,
+                        kernel_sizes=kernel_sizes, strides=strides,
+                        paddings=paddings, dilations=dilations)
+
+    def forward(self, x):
+        return F.fold(x, **self._kw)
+
+
+# ----------------------------------------------------------- conv transpose
+
+
+class Conv1DTranspose(Layer):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, output_padding=0, groups=1, dilation=1,
+                 weight_attr=None, bias_attr=None, data_format="NCL"):
+        super().__init__()
+        k = kernel_size if isinstance(kernel_size, int) else kernel_size[0]
+        bound = 1.0 / math.sqrt(in_channels * k)
+        self.weight = self.create_parameter(
+            [in_channels, out_channels // groups, k], attr=weight_attr,
+            default_initializer=I.Uniform(-bound, bound))
+        self.bias = (None if bias_attr is False else self.create_parameter(
+            [out_channels], attr=bias_attr, is_bias=True))
+        self._kw = dict(stride=stride, padding=padding,
+                        output_padding=output_padding, groups=groups,
+                        dilation=dilation, data_format=data_format)
+
+    def forward(self, x, output_size=None):
+        return F.conv1d_transpose(x, self.weight, bias=self.bias,
+                                  output_size=output_size, **self._kw)
+
+
+class Conv3DTranspose(Layer):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, output_padding=0, groups=1, dilation=1,
+                 weight_attr=None, bias_attr=None, data_format="NCDHW"):
+        super().__init__()
+        if isinstance(kernel_size, int):
+            kernel_size = (kernel_size,) * 3
+        fan = in_channels * int(np.prod(kernel_size))
+        bound = 1.0 / math.sqrt(fan)
+        self.weight = self.create_parameter(
+            [in_channels, out_channels // groups] + list(kernel_size),
+            attr=weight_attr, default_initializer=I.Uniform(-bound, bound))
+        self.bias = (None if bias_attr is False else self.create_parameter(
+            [out_channels], attr=bias_attr, is_bias=True))
+        self._kw = dict(stride=stride, padding=padding,
+                        output_padding=output_padding, groups=groups,
+                        dilation=dilation, data_format=data_format)
+
+    def forward(self, x, output_size=None):
+        return F.conv3d_transpose(x, self.weight, bias=self.bias,
+                                  output_size=output_size, **self._kw)
+
+
+# ------------------------------------------------------------------ pooling
+
+
+class _PoolNd(Layer):
+    def __init__(self, fn, **kw):
+        super().__init__()
+        self._fn = fn
+        self._kw = kw
+
+    def forward(self, x):
+        return self._fn(x, **self._kw)
+
+
+class MaxPool3D(_PoolNd):
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 return_mask=False, ceil_mode=False, data_format="NCDHW",
+                 name=None):
+        super().__init__(F.max_pool3d, kernel_size=kernel_size,
+                         stride=stride, padding=padding,
+                         ceil_mode=ceil_mode, data_format=data_format)
+
+
+class AvgPool3D(_PoolNd):
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 ceil_mode=False, exclusive=True, divisor_override=None,
+                 data_format="NCDHW", name=None):
+        super().__init__(F.avg_pool3d, kernel_size=kernel_size,
+                         stride=stride, padding=padding,
+                         ceil_mode=ceil_mode, exclusive=exclusive,
+                         data_format=data_format)
+
+
+class AdaptiveAvgPool3D(_PoolNd):
+    def __init__(self, output_size, data_format="NCDHW", name=None):
+        super().__init__(F.adaptive_avg_pool3d, output_size=output_size,
+                         data_format=data_format)
+
+
+class AdaptiveMaxPool3D(_PoolNd):
+    def __init__(self, output_size, return_mask=False, name=None):
+        super().__init__(F.adaptive_max_pool3d, output_size=output_size)
+
+
+class AdaptiveMaxPool1D(_PoolNd):
+    def __init__(self, output_size, return_mask=False, name=None):
+        super().__init__(F.adaptive_max_pool1d, output_size=output_size,
+                         return_mask=return_mask)
+
+
+class MaxUnPool1D(_PoolNd):
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 data_format="NCL", output_size=None, name=None):
+        super().__init__(F.max_unpool1d, kernel_size=kernel_size,
+                         stride=stride, padding=padding,
+                         output_size=output_size)
+
+    def forward(self, x, indices):
+        return self._fn(x, indices, **self._kw)
+
+
+class MaxUnPool2D(MaxUnPool1D):
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 data_format="NCHW", output_size=None, name=None):
+        _PoolNd.__init__(self, F.max_unpool2d, kernel_size=kernel_size,
+                         stride=stride, padding=padding,
+                         output_size=output_size)
+
+
+class MaxUnPool3D(MaxUnPool1D):
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 data_format="NCDHW", output_size=None, name=None):
+        _PoolNd.__init__(self, F.max_unpool3d, kernel_size=kernel_size,
+                         stride=stride, padding=padding,
+                         output_size=output_size)
+
+
+class FractionalMaxPool2D(_PoolNd):
+    def __init__(self, output_size, kernel_size=None, random_u=None,
+                 return_mask=False, name=None):
+        super().__init__(F.fractional_max_pool2d, output_size=output_size,
+                         random_u=random_u)
+
+
+class FractionalMaxPool3D(_PoolNd):
+    def __init__(self, output_size, kernel_size=None, random_u=None,
+                 return_mask=False, name=None):
+        super().__init__(F.fractional_max_pool3d, output_size=output_size,
+                         random_u=random_u)
+
+
+class LPPool1D(_PoolNd):
+    def __init__(self, norm_type, kernel_size, stride=None, padding=0,
+                 ceil_mode=False, data_format="NCL", name=None):
+        super().__init__(F.lp_pool1d, norm_type=norm_type,
+                         kernel_size=kernel_size, stride=stride,
+                         padding=padding, ceil_mode=ceil_mode,
+                         data_format=data_format)
+
+
+class LPPool2D(_PoolNd):
+    def __init__(self, norm_type, kernel_size, stride=None, padding=0,
+                 ceil_mode=False, data_format="NCHW", name=None):
+        super().__init__(F.lp_pool2d, norm_type=norm_type,
+                         kernel_size=kernel_size, stride=stride,
+                         padding=padding, ceil_mode=ceil_mode,
+                         data_format=data_format)
+
+
+# --------------------------------------------------------------- misc layers
+
+
+class CosineSimilarity(Layer):
+    def __init__(self, axis=1, eps=1e-8):
+        super().__init__()
+        self._axis = axis
+        self._eps = eps
+
+    def forward(self, x1, x2):
+        return F.cosine_similarity(x1, x2, axis=self._axis, eps=self._eps)
+
+
+class PairwiseDistance(Layer):
+    def __init__(self, p=2.0, epsilon=1e-6, keepdim=False, name=None):
+        super().__init__()
+        self._kw = dict(p=p, epsilon=epsilon, keepdim=keepdim)
+
+    def forward(self, x, y):
+        return F.pairwise_distance(x, y, **self._kw)
+
+
+class Dropout3D(Layer):
+    def __init__(self, p=0.5, data_format="NCDHW", name=None):
+        super().__init__()
+        self._p = p
+        self._data_format = data_format
+
+    def forward(self, x):
+        axis = [0, 1] if self._data_format == "NCDHW" else [0, 4]
+        return F.dropout(x, p=self._p, axis=axis, training=self.training)
+
+
+class RReLU(Layer):
+    def __init__(self, lower=1 / 8.0, upper=1 / 3.0, name=None):
+        super().__init__()
+        self._lower, self._upper = lower, upper
+
+    def forward(self, x):
+        return F.rrelu(x, self._lower, self._upper,
+                       training=self.training)
+
+
+class Bilinear(Layer):
+    def __init__(self, in1_features, in2_features, out_features,
+                 weight_attr=None, bias_attr=None, name=None):
+        super().__init__()
+        bound = 1.0 / math.sqrt(in1_features)
+        self.weight = self.create_parameter(
+            [out_features, in1_features, in2_features], attr=weight_attr,
+            default_initializer=I.Uniform(-bound, bound))
+        self.bias = (None if bias_attr is False else self.create_parameter(
+            [1, out_features], attr=bias_attr, is_bias=True))
+
+    def forward(self, x1, x2):
+        return F.bilinear(x1, x2, self.weight, self.bias)
+
+
+# ------------------------------------------------------------------- losses
+
+
+class _LossLayer(Layer):
+    def __init__(self, fn, **kw):
+        super().__init__()
+        self._fn = fn
+        self._kw = kw
+
+    def forward(self, *args):
+        return self._fn(*args, **self._kw)
+
+
+class SoftMarginLoss(_LossLayer):
+    def __init__(self, reduction="mean", name=None):
+        super().__init__(F.soft_margin_loss, reduction=reduction)
+
+
+class MultiLabelSoftMarginLoss(_LossLayer):
+    def __init__(self, weight=None, reduction="mean", name=None):
+        super().__init__(F.multi_label_soft_margin_loss, weight=weight,
+                         reduction=reduction)
+
+
+class MultiMarginLoss(_LossLayer):
+    def __init__(self, p=1, margin=1.0, weight=None, reduction="mean",
+                 name=None):
+        super().__init__(F.multi_margin_loss, p=p, margin=margin,
+                         weight=weight, reduction=reduction)
+
+
+class GaussianNLLLoss(_LossLayer):
+    def __init__(self, full=False, epsilon=1e-6, reduction="mean",
+                 name=None):
+        super().__init__(F.gaussian_nll_loss, full=full, epsilon=epsilon,
+                         reduction=reduction)
+
+
+class TripletMarginWithDistanceLoss(_LossLayer):
+    def __init__(self, distance_function=None, margin=1.0, swap=False,
+                 reduction="mean", name=None):
+        super().__init__(F.triplet_margin_with_distance_loss,
+                         distance_function=distance_function,
+                         margin=margin, swap=swap, reduction=reduction)
+
+
+class PoissonNLLLoss(_LossLayer):
+    def __init__(self, log_input=True, full=False, epsilon=1e-8,
+                 reduction="mean", name=None):
+        super().__init__(F.poisson_nll_loss, log_input=log_input,
+                         full=full, epsilon=epsilon, reduction=reduction)
+
+
+class CTCLoss(Layer):
+    def __init__(self, blank=0, reduction="mean"):
+        super().__init__()
+        self._blank = blank
+        self._reduction = reduction
+
+    def forward(self, log_probs, labels, input_lengths, label_lengths,
+                norm_by_times=False):
+        return F.ctc_loss(log_probs, labels, input_lengths, label_lengths,
+                          blank=self._blank, reduction=self._reduction)
+
+
+class RNNTLoss(Layer):
+    def __init__(self, blank=0, fastemit_lambda=0.001, reduction="mean",
+                 name=None):
+        super().__init__()
+        self._kw = dict(blank=blank, fastemit_lambda=fastemit_lambda,
+                        reduction=reduction)
+
+    def forward(self, input, label, input_lengths, label_lengths):
+        return F.rnnt_loss(input, label, input_lengths, label_lengths,
+                           **self._kw)
+
+
+class HSigmoidLoss(Layer):
+    def __init__(self, feature_size, num_classes, weight_attr=None,
+                 bias_attr=None, is_custom=False, is_sparse=False,
+                 name=None):
+        super().__init__()
+        if is_custom:
+            raise NotImplementedError(
+                "custom-tree hsigmoid needs path_table/path_code support")
+        self._num_classes = num_classes
+        bound = 1.0 / math.sqrt(feature_size)
+        self.weight = self.create_parameter(
+            [num_classes - 1, feature_size], attr=weight_attr,
+            default_initializer=I.Uniform(-bound, bound))
+        self.bias = (None if bias_attr is False else self.create_parameter(
+            [num_classes - 1], attr=bias_attr, is_bias=True))
+
+    def forward(self, input, label):
+        return F.hsigmoid_loss(input, label, self._num_classes,
+                               self.weight, self.bias)
+
+
+class AdaptiveLogSoftmaxWithLoss(Layer):
+    """nn/layer/loss.py AdaptiveLogSoftmaxWithLoss: frequency-adaptive
+    hierarchical softmax (head + shortlist clusters)."""
+
+    def __init__(self, in_features, n_classes, cutoffs, div_value=4.0,
+                 head_bias=False, name=None):
+        super().__init__()
+        cutoffs = list(cutoffs)
+        assert cutoffs == sorted(cutoffs) and cutoffs[-1] < n_classes
+        self.cutoffs = cutoffs + [n_classes]
+        self.n_clusters = len(cutoffs)
+        self.head_size = cutoffs[0] + self.n_clusters
+        self.head_weight = self.create_parameter(
+            [in_features, self.head_size],
+            default_initializer=I.XavierUniform())
+        self.head_bias = (self.create_parameter(
+            [self.head_size], is_bias=True) if head_bias else None)
+        self.tail_weights = []
+        for i in range(self.n_clusters):
+            hsz = max(1, int(in_features / (div_value ** (i + 1))))
+            osz = self.cutoffs[i + 1] - self.cutoffs[i]
+            w1 = self.create_parameter(
+                [in_features, hsz], default_initializer=I.XavierUniform())
+            w2 = self.create_parameter(
+                [hsz, osz], default_initializer=I.XavierUniform())
+            setattr(self, f"tail_{i}_proj", w1)
+            setattr(self, f"tail_{i}_out", w2)
+            self.tail_weights.append((w1, w2))
+
+    def _all_params(self):
+        ps = [self.head_weight]
+        if self.head_bias is not None:
+            ps.append(self.head_bias)
+        for w1, w2 in self.tail_weights:
+            ps.extend([w1, w2])
+        return ps
+
+    def _raw_log_prob(self, xv, pv):
+        """Full [B, n_classes] log-prob table from raw arrays — runs
+        UNDER apply() so every parameter is a tape input and backward
+        reaches the head and tail weights."""
+        import jax
+
+        it = iter(pv)
+        hw = next(it)
+        hb = next(it) if self.head_bias is not None else None
+        h = xv @ hw + (hb if hb is not None else 0.0)
+        hl = jax.nn.log_softmax(h, axis=-1)
+        c0 = self.cutoffs[0]
+        parts = [hl[:, :c0]]
+        for i in range(self.n_clusters):
+            w1 = next(it)
+            w2 = next(it)
+            tail = jax.nn.log_softmax((xv @ w1) @ w2, axis=-1)
+            parts.append(hl[:, c0 + i:c0 + i + 1] + tail)
+        return jnp.concatenate(parts, axis=1)
+
+    def forward(self, input, label):
+        lab = np.asarray(label.numpy()).astype(np.int32)
+
+        def f(xv, *pv):
+            lp = self._raw_log_prob(xv, pv)
+            picked = jnp.take_along_axis(
+                lp, jnp.asarray(lab)[:, None], axis=1)[:, 0]
+            return picked, -jnp.mean(picked)
+
+        out, loss = F.apply("adaptive_log_softmax", f, input,
+                            *self._all_params())
+        return out, loss
+
+    def log_prob(self, input):
+        return F.apply("adaptive_log_softmax_table",
+                       lambda xv, *pv: self._raw_log_prob(xv, pv),
+                       input, *self._all_params())
+
+    def predict(self, input):
+        return Tensor._from_value(
+            jnp.argmax(self.log_prob(input)._value, axis=-1))
+
+
+# ------------------------------------------------------- seq2seq decoding
+
+
+class RNNCellBase(Layer):
+    """nn/layer/rnn.py RNNCellBase: user-defined cell base with initial
+    state helpers."""
+
+    def get_initial_states(self, batch_ref, shape=None, dtype=None,
+                           init_value=0.0, batch_dim_idx=0):
+        batch = batch_ref.shape[batch_dim_idx]
+        hidden = shape or [self.hidden_size]
+        if isinstance(hidden, int):
+            hidden = [hidden]
+        return Tensor._from_value(jnp.full(
+            (batch,) + tuple(hidden), init_value, jnp.float32))
+
+
+class BeamSearchDecoder(Layer):
+    """nn/layer/rnn.py BeamSearchDecoder: beam expansion over an RNN cell
+    with an output projection; used through dynamic_decode."""
+
+    def __init__(self, cell, start_token, end_token, beam_size,
+                 embedding_fn=None, output_fn=None):
+        super().__init__()
+        self.cell = cell
+        self.start_token = start_token
+        self.end_token = end_token
+        self.beam_size = beam_size
+        self.embedding_fn = embedding_fn
+        self.output_fn = output_fn
+
+    def _emb(self, ids):
+        if self.embedding_fn is not None:
+            return self.embedding_fn(ids)
+        return ids
+
+    def _logits(self, cell_out):
+        return (self.output_fn(cell_out) if self.output_fn is not None
+                else cell_out)
+
+
+def dynamic_decode(decoder, inits=None, max_step_num=100,
+                   output_time_major=False, impute_finished=False,
+                   is_test=False, return_length=False, **kwargs):
+    """nn/decode.py dynamic_decode: run a BeamSearchDecoder until every
+    beam emits end_token or max_step_num is reached. Eager loop (decode
+    is inherently sequential; each cell step is one compiled program).
+
+    Returns (token ids [B, beam, T] , per-beam log-prob scores), plus
+    sequence lengths when ``return_length``.
+    """
+    cell = decoder.cell
+    K = decoder.beam_size
+    state = inits
+    # batch inferred from the initial state pytree
+    leaves = state if isinstance(state, (list, tuple)) else [state]
+    batch = leaves[0].shape[0]
+
+    ids = np.full((batch, K, 0), decoder.end_token, np.int64)
+    scores = np.zeros((batch, K), np.float64)
+    scores[:, 1:] = -1e9          # first expansion comes from beam 0 only
+    finished = np.zeros((batch, K), bool)
+    lengths = np.zeros((batch, K), np.int64)
+
+    def tile_state(s):
+        return [Tensor._from_value(jnp.repeat(t._value, K, axis=0))
+                for t in (s if isinstance(s, (list, tuple)) else [s])]
+
+    beam_state = tile_state(state)
+    tokens = np.full((batch * K,), decoder.start_token, np.int64)
+
+    for step in range(max_step_num):
+        inp = decoder._emb(Tensor._from_value(jnp.asarray(tokens)))
+        out, beam_state = cell(inp, beam_state)
+        logits = decoder._logits(out)
+        logp = np.asarray(F.log_softmax(logits, axis=-1).numpy()
+                          ).reshape(batch, K, -1).astype(np.float64)
+        V = logp.shape[-1]
+        # finished beams only extend with end_token at zero cost
+        logp[finished] = -1e9
+        logp[finished, decoder.end_token] = 0.0
+        total = scores[:, :, None] + logp          # [B, K, V]
+        flat = total.reshape(batch, K * V)
+        top = np.argsort(-flat, axis=1)[:, :K]
+        new_scores = np.take_along_axis(flat, top, axis=1)
+        src_beam = top // V
+        new_tok = top % V
+        ids = np.concatenate(
+            [np.take_along_axis(ids, src_beam[:, :, None], axis=1),
+             new_tok[:, :, None]], axis=2)
+        was_fin = np.take_along_axis(finished, src_beam, axis=1)
+        lengths = np.take_along_axis(lengths, src_beam, axis=1) + (
+            ~was_fin).astype(np.int64)
+        finished = was_fin | (new_tok == decoder.end_token)
+        scores = new_scores
+        # regather cell state rows by source beam
+        gather = (np.arange(batch)[:, None] * K + src_beam).reshape(-1)
+        beam_state = [Tensor._from_value(t._value[jnp.asarray(gather)])
+                      for t in beam_state]
+        tokens = new_tok.reshape(-1)
+        if finished.all():
+            break
+
+    ids_t = Tensor(ids)
+    scores_t = Tensor(scores.astype(np.float32))
+    if output_time_major:
+        ids_t = Tensor(np.moveaxis(ids, 2, 0))
+    if return_length:
+        return ids_t, scores_t, Tensor(lengths)
+    return ids_t, scores_t

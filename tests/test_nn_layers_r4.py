@@ -1,0 +1,264 @@
+"""r4 nn-layer closure tests: every newly added layer runs, the heavier
+ones (unpool, adaptive log-softmax, RNNT, beam search) are checked
+numerically (reference python/paddle/nn/layer/*)."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+import paddle_tpu.nn.functional as F
+
+
+def _t(shape, seed=0):
+    return paddle.to_tensor(np.random.default_rng(seed).normal(
+        size=shape).astype(np.float32))
+
+
+def test_pads_and_shapes():
+    x = _t((2, 3, 8))
+    assert nn.Pad1D([1, 2])(x).shape == [2, 3, 11]
+    assert nn.ZeroPad1D([1, 1])(x).shape == [2, 3, 10]
+    x2 = _t((2, 3, 4, 4))
+    assert nn.ZeroPad2D(1)(x2).shape == [2, 3, 6, 6]
+    x3 = _t((1, 2, 3, 4, 4))
+    assert nn.Pad3D(1)(x3).shape == [1, 2, 5, 6, 6]
+    assert nn.ZeroPad3D(1)(x3).shape == [1, 2, 5, 6, 6]
+    assert nn.Unflatten(1, [3, 2])(_t((2, 6))).shape == [2, 3, 2]
+    out = nn.Softmax2D()(x2)
+    np.testing.assert_allclose(np.asarray(out.numpy()).sum(1), 1.0,
+                               rtol=1e-5)
+
+
+def test_upsampling_and_instance_norms():
+    x = _t((1, 2, 4, 4))
+    assert nn.UpsamplingNearest2D(scale_factor=2)(x).shape == [1, 2, 8, 8]
+    assert nn.UpsamplingBilinear2D(size=(6, 6))(x).shape == [1, 2, 6, 6]
+    x1 = _t((2, 3, 16))
+    out = nn.InstanceNorm1D(3)(x1).numpy()
+    np.testing.assert_allclose(out.mean(-1), 0.0, atol=1e-5)
+    x3 = _t((1, 2, 4, 4, 4))
+    out3 = nn.InstanceNorm3D(2)(x3)
+    assert out3.shape == [1, 2, 4, 4, 4]
+
+
+def test_pool3d_family():
+    x = _t((1, 2, 4, 8, 8))
+    assert nn.MaxPool3D(2)(x).shape == [1, 2, 2, 4, 4]
+    assert nn.AvgPool3D(2)(x).shape == [1, 2, 2, 4, 4]
+    assert nn.AdaptiveAvgPool3D(2)(x).shape == [1, 2, 2, 2, 2]
+    assert nn.AdaptiveMaxPool3D(2)(x).shape == [1, 2, 2, 2, 2]
+    x1 = _t((1, 2, 8))
+    assert nn.AdaptiveMaxPool1D(4)(x1).shape == [1, 2, 4]
+    assert nn.LPPool1D(2, 2)(x1).shape == [1, 2, 4]
+    assert nn.LPPool2D(2, 2)(_t((1, 2, 4, 4))).shape == [1, 2, 2, 2]
+    assert nn.FractionalMaxPool2D(3)(_t((1, 2, 7, 7))).shape == [1, 2, 3, 3]
+    assert nn.FractionalMaxPool3D(2)(
+        _t((1, 1, 5, 5, 5))).shape == [1, 1, 2, 2, 2]
+
+
+def test_max_unpool_round_trip():
+    x = _t((2, 3, 8, 8))
+    out, mask = F.max_pool_with_mask(x, 2, 2, 0, nd=2)
+    rec = nn.MaxUnPool2D(2)(out, mask)
+    assert rec.shape == [2, 3, 8, 8]
+    rr = np.asarray(rec.numpy())
+    oo = np.asarray(out.numpy())
+    # the maxima land back at their argmax positions, zeros elsewhere
+    np.testing.assert_allclose(np.sort(rr[rr != 0]), np.sort(oo.ravel()))
+    # re-pooling the sparse reconstruction: zeros dominate negative maxima
+    pooled_again = F.max_pool2d(rec, 2)
+    np.testing.assert_allclose(np.asarray(pooled_again.numpy()),
+                               np.maximum(oo, 0.0), rtol=1e-6)
+
+
+def test_misc_layers():
+    a, b = _t((4, 6), 1), _t((4, 6), 2)
+    cs = nn.CosineSimilarity(axis=1)(a, b)
+    assert cs.shape == [4]
+    pd = nn.PairwiseDistance()(a, b)
+    assert (np.asarray(pd.numpy()) >= 0).all()
+    bl = nn.Bilinear(6, 6, 3)
+    assert bl(a, b).shape == [4, 3]
+    assert nn.ChannelShuffle(2)(_t((1, 4, 2, 2))).shape == [1, 4, 2, 2]
+    assert nn.PixelUnshuffle(2)(_t((1, 1, 4, 4))).shape == [1, 4, 2, 2]
+    d3 = nn.Dropout3D(0.5)
+    d3.eval()
+    x5 = _t((1, 2, 2, 2, 2))
+    np.testing.assert_allclose(np.asarray(d3(x5).numpy()),
+                               np.asarray(x5.numpy()))
+    r = nn.RReLU()
+    r.eval()
+    out = np.asarray(r(paddle.to_tensor(
+        np.asarray([-1.0, 2.0], np.float32))).numpy())
+    np.testing.assert_allclose(out, [-(1 / 8 + 1 / 3) / 2, 2.0], rtol=1e-5)
+    assert nn.Unfold(2)(_t((1, 2, 4, 4))).shape[1] == 8
+    assert nn.Conv1DTranspose(3, 4, 3)(_t((1, 3, 8))).shape[1] == 4
+    assert nn.Conv3DTranspose(2, 3, 2)(_t((1, 2, 3, 3, 3))).shape[1] == 3
+
+
+def test_loss_layers():
+    x = _t((4, 5), 3)
+    y = paddle.to_tensor((np.arange(4) % 5).astype(np.int64))
+    for loss in (nn.MultiMarginLoss(), nn.SoftMarginLoss(),
+                 nn.GaussianNLLLoss()):
+        pass
+    assert float(nn.MultiMarginLoss()(x, y).numpy()) > 0
+    yb = paddle.to_tensor(np.sign(np.random.default_rng(4).normal(
+        size=(4, 5))).astype(np.float32))
+    assert float(nn.SoftMarginLoss()(x, yb).numpy()) > 0
+    ml = paddle.to_tensor((np.random.default_rng(5).random((4, 5)) > 0.5
+                           ).astype(np.float32))
+    assert float(nn.MultiLabelSoftMarginLoss()(x, ml).numpy()) > 0
+    var = paddle.to_tensor(np.ones((4, 5), np.float32))
+    assert np.isfinite(float(nn.GaussianNLLLoss()(x, _t((4, 5), 6),
+                                                  var).numpy()))
+    t = nn.TripletMarginWithDistanceLoss(margin=0.5)
+    assert float(t(_t((3, 4), 7), _t((3, 4), 8), _t((3, 4), 9)).numpy()) >= 0
+    p = nn.PoissonNLLLoss()
+    assert np.isfinite(float(p(_t((3, 4), 10),
+                               paddle.to_tensor(np.ones((3, 4),
+                                                        np.float32))).numpy()))
+    h = nn.HSigmoidLoss(8, 6)
+    lbl = paddle.to_tensor((np.arange(4) % 6).astype(np.int64))
+    out = h(_t((4, 8), 11), lbl)
+    assert out.shape == [4, 1] and (np.asarray(out.numpy()) > 0).all()
+
+
+def test_rnnt_loss_degenerate_equals_nll():
+    """U=0 (empty label): the RNNT lattice is a pure blank path, so the
+    loss is -sum_t log P(blank | t)."""
+    rng = np.random.default_rng(0)
+    B, T, V = 2, 4, 5
+    logits = rng.normal(size=(B, T, 1, V)).astype(np.float32)
+    x = paddle.to_tensor(logits)
+    labels = paddle.to_tensor(np.zeros((B, 0), np.int32))
+    il = paddle.to_tensor(np.full((B,), T, np.int32))
+    ll = paddle.to_tensor(np.zeros((B,), np.int32))
+    loss = float(F.rnnt_loss(x, labels, il, ll, reduction="mean").numpy())
+    lp = np.asarray(jnp.log(jnp.exp(logits) / jnp.exp(logits).sum(
+        -1, keepdims=True)))
+    ref = -lp[:, :, 0, 0].sum(1).mean()
+    np.testing.assert_allclose(loss, ref, rtol=1e-5)
+
+
+def test_adaptive_log_softmax():
+    paddle.seed(0)
+    m = nn.AdaptiveLogSoftmaxWithLoss(16, 20, cutoffs=[5, 10])
+    x = _t((6, 16), 12)
+    y = paddle.to_tensor(np.asarray([0, 4, 6, 9, 12, 19], np.int64))
+    out, loss = m(x, y)
+    assert out.shape == [6] and float(loss.numpy()) > 0
+    lp = m.log_prob(x)
+    assert lp.shape == [6, 20]
+    np.testing.assert_allclose(np.exp(np.asarray(lp.numpy())).sum(1), 1.0,
+                               rtol=1e-4)
+    # the picked entries match the full log_prob table
+    np.testing.assert_allclose(
+        np.asarray(out.numpy()),
+        np.take_along_axis(np.asarray(lp.numpy()),
+                           np.asarray(y.numpy())[:, None], 1)[:, 0],
+        rtol=1e-5)
+    pred = m.predict(x)
+    np.testing.assert_array_equal(
+        np.asarray(pred.numpy()),
+        np.argmax(np.asarray(lp.numpy()), axis=1))
+
+
+def test_beam_search_decodes_argmax_sequence():
+    """A cell whose logits are input-independent must decode the argmax
+    token repeatedly; beam search recovers it as the top beam."""
+    V, H = 7, 7
+
+    class ConstCell(nn.RNNCellBase):
+        hidden_size = H
+
+        def __init__(self, logits):
+            super().__init__()
+            self._logits = paddle.to_tensor(logits)
+
+        def forward(self, inputs, states):
+            (h,) = states
+            batch = inputs.shape[0]
+            out = paddle.to_tensor(np.tile(
+                np.asarray(self._logits.numpy())[None], (batch, 1)))
+            return out, [h]
+
+    logits = np.zeros((V,), np.float32)
+    logits[3] = 4.0       # dominant token
+    logits[0] = 2.0       # end token is second-best
+    cell = ConstCell(logits)
+    dec = nn.BeamSearchDecoder(cell, start_token=1, end_token=0,
+                               beam_size=3)
+    h0 = paddle.zeros((2, H))
+    ids, scores = nn.dynamic_decode(dec, inits=[h0], max_step_num=4)
+    assert ids.shape == [2, 3, 4]
+    np.testing.assert_array_equal(np.asarray(ids.numpy())[:, 0, :], 3)
+    s = np.asarray(scores.numpy())
+    assert (s[:, 0] >= s[:, 1]).all() and (s[:, 1] >= s[:, 2]).all()
+
+
+def test_adaptive_log_softmax_trains():
+    """The loss must reach the head and tail weights (a detached forward
+    would leave every grad None)."""
+    import paddle_tpu.optimizer as opt
+
+    paddle.seed(1)
+    m = nn.AdaptiveLogSoftmaxWithLoss(8, 12, cutoffs=[4])
+    o = opt.Adam(learning_rate=5e-2, parameters=m.parameters())
+    x = _t((16, 8), 13)
+    y = paddle.to_tensor((np.arange(16) % 12).astype(np.int64))
+    first = last = None
+    for _ in range(15):
+        _, loss = m(x, y)
+        loss.backward()
+        o.step()
+        o.clear_grad()
+        v = float(loss.numpy())
+        first = first if first is not None else v
+        last = v
+    assert last < first * 0.8, (first, last)
+
+
+def test_hsigmoid_non_power_of_two_depth():
+    """Labels at shallower leaves must NOT pick up a phantom decision
+    against the last internal node (the masked-walk fix)."""
+    paddle.seed(2)
+    m = nn.HSigmoidLoss(4, 6)
+    x = _t((1, 4), 14)
+    # label 0 -> leaf code 6: exactly two decisions (6->3->1)
+    out = float(m(x, paddle.to_tensor(np.asarray([0], np.int64))).numpy())
+    w = np.asarray(m.weight.numpy())
+    b = np.asarray(m.bias.numpy())
+    xv = np.asarray(x.numpy())[0]
+
+    def sig(z):
+        return 1 / (1 + np.exp(-z))
+
+    # walk: node 3-1=2 with bit0 of 6 (=0), node 1-1=0 with bit1 of 6 (=1)
+    l2 = xv @ w[2] + b[2]
+    l0 = xv @ w[0] + b[0]
+    ref = -(np.log(1 - sig(l2)) + np.log(sig(l0)))
+    np.testing.assert_allclose(out, ref, rtol=1e-5)
+
+
+def test_adaptive_pools_non_divisible():
+    x = _t((1, 2, 7))
+    assert nn.AdaptiveMaxPool1D(3)(x).shape == [1, 2, 3]
+    x3 = _t((1, 2, 5, 7, 9))
+    assert nn.AdaptiveAvgPool3D(2)(x3).shape == [1, 2, 2, 2, 2]
+    assert nn.AdaptiveMaxPool3D(3)(x3).shape == [1, 2, 3, 3, 3]
+    with pytest.raises(NotImplementedError):
+        F.fractional_max_pool2d(_t((1, 1, 4, 4)), 2, return_mask=True)
+    with pytest.raises(NotImplementedError):
+        F.rnnt_loss(_t((1, 2, 1, 3)), paddle.to_tensor(
+            np.zeros((1, 0), np.int32)),
+            paddle.to_tensor(np.asarray([2], np.int32)),
+            paddle.to_tensor(np.asarray([0], np.int32)),
+            fastemit_lambda=0.001)
+
+
+def test_unflatten_negative_axis():
+    assert nn.Unflatten(-1, [3, 2])(_t((2, 6))).shape == [2, 3, 2]
