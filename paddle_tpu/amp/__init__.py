@@ -1,3 +1,14 @@
 from paddle_tpu.amp.auto_cast import amp_guard, amp_state, auto_cast, decorate  # noqa: F401
 from paddle_tpu.amp.grad_scaler import AmpScaler, GradScaler  # noqa: F401
 from paddle_tpu.amp import debugging  # noqa: F401
+
+
+def is_float16_supported(device=None):
+    """fp16 compute support (reference amp/__init__.py): TPU-class chips
+    and CPU both execute fp16 through XLA (bf16 is the NATIVE fast path
+    on TPU — see amp_lists)."""
+    return True
+
+
+def is_bfloat16_supported(device=None):
+    return True

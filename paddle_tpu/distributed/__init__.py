@@ -67,3 +67,37 @@ from paddle_tpu.distributed.store import (  # noqa: F401,E402
     create_or_get_global_tcp_store,
 )
 from paddle_tpu.distributed import rpc  # noqa: F401,E402
+from paddle_tpu.distributed import launch  # noqa: F401,E402
+from paddle_tpu.distributed import io  # noqa: F401,E402
+from paddle_tpu.distributed.api_r4 import (  # noqa: F401,E402
+    CountFilterEntry,
+    DistAttr,
+    InMemoryDataset,
+    ParallelMode,
+    ProbabilityEntry,
+    QueueDataset,
+    ReduceType,
+    ShardingStage1,
+    ShardingStage2,
+    ShardingStage3,
+    ShowClickEntry,
+    Strategy,
+    alltoall_single,
+    broadcast_object_list,
+    destroy_process_group,
+    gather,
+    get_backend,
+    gloo_barrier,
+    gloo_init_parallel_env,
+    gloo_release,
+    irecv,
+    is_available,
+    isend,
+    scatter_object_list,
+    shard_dataloader,
+    shard_optimizer,
+    shard_scaler,
+    spawn,
+    split,
+    unshard_dtensor,
+)

@@ -1,0 +1,448 @@
+"""r4 distributed-namespace closure (reference python/paddle/distributed/
+__init__.py __all__): the remaining surface — object collectives, async
+p2p aliases, spawn, the auto-parallel shard_* helpers, parity enums, and
+the PS-dataset tokens (documented scope cut, loud on use).
+"""
+
+from __future__ import annotations
+
+import pickle
+
+import numpy as np
+
+from paddle_tpu.tensor import Tensor
+
+__all__ = [
+    "isend", "irecv", "gather", "alltoall_single",
+    "broadcast_object_list", "scatter_object_list", "ParallelMode",
+    "destroy_process_group", "is_available", "get_backend", "ReduceType",
+    "Strategy", "DistAttr", "split", "spawn", "gloo_init_parallel_env",
+    "gloo_barrier", "gloo_release", "shard_optimizer", "shard_scaler",
+    "shard_dataloader", "unshard_dtensor", "ShardingStage1",
+    "ShardingStage2", "ShardingStage3", "QueueDataset", "InMemoryDataset",
+    "CountFilterEntry", "ShowClickEntry", "ProbabilityEntry",
+]
+
+
+# ------------------------------------------------------------ collectives
+
+
+def _rank(group=None):
+    from paddle_tpu.distributed.env import get_rank
+
+    if group is not None and hasattr(group, "ranks") and group.ranks:
+        world = get_rank()
+        return list(group.ranks).index(world) if world in group.ranks else -1
+    return get_rank()
+
+
+def _world(group=None):
+    from paddle_tpu.distributed.env import get_world_size
+
+    if group is not None and hasattr(group, "ranks") and group.ranks:
+        return len(group.ranks)
+    return get_world_size()
+
+
+def isend(tensor, dst, group=None):
+    """Async send alias (communication/send.py isend): our send returns a
+    waitable Task already — sync_op=False is the async spelling."""
+    from paddle_tpu.distributed.collective import send
+
+    return send(tensor, dst, group=group, sync_op=False)
+
+
+def irecv(tensor, src=None, group=None):
+    from paddle_tpu.distributed.collective import recv
+
+    return recv(tensor, src, group=group, sync_op=False)
+
+
+def _is_multiproc():
+    from paddle_tpu.distributed.collective import _is_multiproc as f
+
+    return f()
+
+
+def gather(tensor, gather_list=None, dst=0, group=None, sync_op=True):
+    """communication/gather.py: all ranks contribute, dst receives the
+    list. XLA has no rooted gather — all_gather then keep on dst (the
+    reference's gloo path does the same). Single-controller mode follows
+    the house stacked-[world, ...] convention; a non-stacked tensor is
+    treated as replicated (every logical rank holds it)."""
+    from paddle_tpu.distributed.collective import all_gather
+    from paddle_tpu.distributed.env import get_world_size
+
+    if _is_multiproc():
+        tmp = []
+        task = all_gather(tmp, tensor, group=group, sync_op=sync_op)
+        if gather_list is not None and _rank(group) == dst:
+            gather_list.extend(tmp)
+        return task
+    world = get_world_size()
+    if gather_list is not None:
+        if tensor._value.ndim > 0 and tensor._value.shape[0] == world:
+            gather_list.extend(
+                Tensor._from_value(tensor._value[r]) for r in range(world))
+        else:
+            gather_list.extend(tensor for _ in range(world))
+    return None
+
+
+def alltoall_single(out_tensor, in_tensor, in_split_sizes=None,
+                    out_split_sizes=None, group=None, sync_op=True):
+    """communication/all_to_all.py alltoall_single: one tensor split
+    row-wise across ranks."""
+    import jax.numpy as jnp
+
+    n = _world(group)
+    if in_split_sizes is not None or out_split_sizes is not None:
+        raise NotImplementedError(
+            "uneven alltoall_single splits are unsupported (XLA all_to_all "
+            "is equal-split); pad to equal splits")
+    if _is_multiproc():
+        from paddle_tpu.distributed.collective import all_to_all
+
+        ins = list(in_tensor.chunk(n, axis=0))
+        outs = []
+        task = all_to_all(outs, ins, group=group, sync_op=sync_op)
+        out_tensor._replace_value(jnp.concatenate(
+            [t._value for t in outs], axis=0))
+        return task
+    # single-controller stacked [world, rows, ...]: rank r's rows split
+    # into world chunks; out[r] = concat_s(chunk r of rank s)
+    v = in_tensor._value
+    if v.ndim < 2 or v.shape[0] != n or v.shape[1] % n:
+        raise ValueError(
+            "single-controller alltoall_single wants the stacked "
+            f"[world, rows, ...] layout with rows % world == 0; got "
+            f"{tuple(v.shape)} for world {n}")
+    chunks = v.reshape((n, n, v.shape[1] // n) + v.shape[2:])
+    out_tensor._replace_value(
+        jnp.swapaxes(chunks, 0, 1).reshape(v.shape))
+    return None
+
+
+def _obj_to_tensor(obj, capacity):
+    payload = pickle.dumps(obj)
+    if len(payload) > capacity - 8:
+        raise ValueError(f"object of {len(payload)} bytes exceeds the "
+                         f"{capacity}-byte object-collective buffer")
+    buf = np.zeros((capacity,), np.uint8)
+    buf[:8] = np.frombuffer(np.uint64(len(payload)).tobytes(), np.uint8)
+    buf[8:8 + len(payload)] = np.frombuffer(payload, np.uint8)
+    return Tensor(buf)
+
+
+def _tensor_to_obj(t):
+    buf = np.asarray(t.numpy())
+    n = int(np.frombuffer(buf[:8].tobytes(), np.uint64)[0])
+    return pickle.loads(buf[8:8 + n].tobytes())
+
+
+_OBJ_CAPACITY = 1 << 20
+
+
+def broadcast_object_list(object_list, src=0, group=None):
+    """communication/broadcast.py broadcast_object_list: pickle through a
+    fixed uint8 buffer (the reference serializes through tensors too)."""
+    if not _is_multiproc():
+        # one logical program: src's objects are ALREADY in object_list
+        return
+    from paddle_tpu.distributed.collective import broadcast
+
+    for i in range(len(object_list)):
+        t = _obj_to_tensor(object_list[i]
+                           if _rank(group) == src else None,
+                           _OBJ_CAPACITY)
+        broadcast(t, src, group=group)
+        object_list[i] = _tensor_to_obj(t)
+
+
+def scatter_object_list(out_object_list, in_object_list=None, src=0,
+                        group=None):
+    if not _is_multiproc():
+        out_object_list.clear()
+        out_object_list.append(in_object_list[_rank(group)]
+                               if in_object_list else None)
+        return
+    from paddle_tpu.distributed.collective import scatter
+
+    t = Tensor(np.zeros((_OBJ_CAPACITY,), np.uint8))
+    ins = ([_obj_to_tensor(o, _OBJ_CAPACITY) for o in in_object_list]
+           if _rank(group) == src and in_object_list else None)
+    scatter(t, ins, src, group=group)
+    out_object_list.clear()
+    out_object_list.append(_tensor_to_obj(t))
+
+
+def destroy_process_group(group=None):
+    """communication/group.py destroy_process_group."""
+    # groups are lightweight rank-partition descriptors here; nothing to
+    # tear down beyond forgetting them
+    return None
+
+
+def is_available():
+    """True — the XLA-collective backend is always compiled in."""
+    return True
+
+
+def get_backend(group=None):
+    """The comm backend name (reference returns NCCL/GLOO/...)."""
+    return "XCCL"  # XLA collectives over ICI/DCN
+
+
+class DistAttr:
+    """TensorDistAttr parity (phi/core/distributed/auto_parallel/
+    dist_attr.h): records the mesh + per-dim sharding of a DistTensor.
+    On this substrate the live carrier is the NamedSharding on the
+    jax.Array; DistAttr is the descriptor view."""
+
+    def __init__(self, mesh=None, sharding_specs=None):
+        self.process_mesh = mesh
+        self.sharding_specs = list(sharding_specs or [])
+
+    def __repr__(self):
+        return (f"DistAttr(mesh={self.process_mesh}, "
+                f"sharding_specs={self.sharding_specs})")
+
+
+class ParallelMode:
+    """fleet/base/topology.py ParallelMode enum parity."""
+
+    DATA_PARALLEL = 0
+    TENSOR_PARALLEL = 1
+    PIPELINE_PARALLEL = 2
+    SHARDING_PARALLEL = 3
+    SEGMENT_PARALLEL = 4
+
+
+class ReduceType:
+    """auto_parallel placement reduce types (kRedSum...)."""
+
+    kRedSum = 0
+    kRedMax = 1
+    kRedMin = 2
+    kRedProd = 3
+    kRedAvg = 4
+    kRedAny = 5
+    kRedAll = 6
+
+
+def split(x, size, operation, axis=0, num_partitions=1, gather_out=True,
+          weight_attr=None, bias_attr=None, name=None):
+    raise NotImplementedError(
+        "paddle.distributed.split (legacy static mp splitter) is "
+        "superseded here by fleet.meta_parallel's ColumnParallelLinear/"
+        "RowParallelLinear/VocabParallelEmbedding — construct those "
+        "directly (fleet/mp_layers.py)")
+
+
+# ---------------------------------------------------------------- spawn
+
+
+def spawn(func, args=(), nprocs=-1, join=True, daemon=False, **options):
+    """launch/spawn parity: run ``func`` in ``nprocs`` spawned processes
+    on this host. The heavyweight rendezvous (coordinator env, device
+    split) belongs to ``python -m paddle_tpu.distributed.launch``; spawn
+    covers the in-script API with PADDLE_* env preset per rank."""
+    import multiprocessing as mp
+    import os
+
+    if nprocs <= 0:
+        nprocs = int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+    ctx = mp.get_context("spawn")
+    procs = []
+    for rank in range(nprocs):
+        p = ctx.Process(target=_spawn_entry,
+                        args=(func, args, rank, nprocs), daemon=daemon)
+        p.start()
+        procs.append(p)
+    if join:
+        for p in procs:
+            p.join()
+        bad = [p.exitcode for p in procs if p.exitcode != 0]
+        if bad:
+            raise RuntimeError(f"spawned processes failed: {bad}")
+    return procs
+
+
+def _spawn_entry(func, args, rank, nprocs):
+    import os
+
+    os.environ["PADDLE_TRAINER_ID"] = str(rank)
+    os.environ["PADDLE_TRAINERS_NUM"] = str(nprocs)
+    func(*args)
+
+
+def gloo_init_parallel_env(rank_id, rank_num, server_endpoint):
+    """CPU-bootstrap parity: the TCPStore rendezvous covers gloo's role."""
+    import os
+
+    os.environ.setdefault("PADDLE_TRAINER_ID", str(rank_id))
+    os.environ.setdefault("PADDLE_TRAINERS_NUM", str(rank_num))
+
+
+def gloo_barrier():
+    from paddle_tpu.distributed.collective import barrier
+
+    barrier()
+
+
+def gloo_release():
+    return None
+
+
+# ------------------------------------------------- auto-parallel shard_*
+
+
+class ShardingStage1:
+    """dist.ShardingStage1 marker (auto_parallel/api.py): optimizer-state
+    sharding level for shard_optimizer."""
+
+    def __init__(self, axis=None, mesh=None):
+        self.axis = axis
+        self.mesh = mesh
+
+
+class ShardingStage2(ShardingStage1):
+    pass
+
+
+class ShardingStage3(ShardingStage1):
+    pass
+
+
+def shard_optimizer(optimizer, shard_fn=None):
+    """auto_parallel/api.py shard_optimizer: optimizer states follow the
+    parameters' placements. On this substrate that IS the default —
+    states are created with zeros_like(param), inheriting NamedSharding —
+    so the wrapper validates and (optionally) applies shard_fn to future
+    states via a creation hook."""
+    if shard_fn is not None:
+        orig_init = optimizer._init_state
+
+        def wrapped(p):
+            state = orig_init(p)
+            return {k: shard_fn(k, p, v) for k, v in state.items()}
+
+        optimizer._init_state = wrapped
+    return optimizer
+
+
+def shard_scaler(scaler):
+    """auto_parallel/api.py shard_scaler: the GradScaler state is scalar
+    (replicated by construction) — returned as-is."""
+    return scaler
+
+
+def shard_dataloader(dataloader, meshes, shard_dims=None,
+                     input_keys=None):
+    """auto_parallel/api.py shard_dataloader: yield batches with their
+    leading dim sharded over the mesh's data axis."""
+    from paddle_tpu.distributed.auto_parallel import (
+        Replicate,
+        Shard,
+        shard_tensor,
+    )
+
+    mesh = meshes[0] if isinstance(meshes, (list, tuple)) else meshes
+    ndim = len(mesh.shape)
+    # shard_dims picks WHICH mesh axis carries the batch dim (name or
+    # index); default = the first axis
+    if isinstance(shard_dims, str):
+        axis_idx = list(mesh.dim_names).index(shard_dims)
+    elif isinstance(shard_dims, int):
+        axis_idx = shard_dims
+    else:
+        axis_idx = 0
+    placements = [Replicate()] * ndim
+    placements[axis_idx] = Shard(0)
+
+    def _shard_one(t):
+        return shard_tensor(t, mesh, placements) if isinstance(t, Tensor) \
+            else t
+
+    class _Sharded:
+        def __iter__(self):
+            for batch in dataloader:
+                if isinstance(batch, dict):
+                    keys = input_keys or batch.keys()
+                    yield {k: (_shard_one(v) if k in keys else v)
+                           for k, v in batch.items()}
+                elif isinstance(batch, (list, tuple)):
+                    yield type(batch)(_shard_one(t) for t in batch)
+                else:
+                    yield _shard_one(batch)
+
+        def __len__(self):
+            return len(dataloader)
+
+    return _Sharded()
+
+
+def unshard_dtensor(dist_tensor):
+    """auto_parallel/api.py unshard_dtensor: gather to a replicated dense
+    tensor."""
+    import jax
+
+    v = dist_tensor._value if isinstance(dist_tensor, Tensor) else dist_tensor
+    return Tensor(np.asarray(jax.device_get(v)))
+
+
+class Strategy:
+    """auto_parallel Strategy (dist.Strategy, api.py to_static knobs) —
+    carries the same config sections as the fleet DistributedStrategy."""
+
+    def __init__(self, config=None):
+        from paddle_tpu.distributed.fleet.fleet import DistributedStrategy
+
+        self._inner = DistributedStrategy()
+        for k, v in (config or {}).items():
+            setattr(self._inner, k, v)
+
+    def __getattr__(self, k):
+        return getattr(self.__dict__["_inner"], k)
+
+    def __setattr__(self, k, v):
+        if k == "_inner":
+            self.__dict__[k] = v
+        else:
+            setattr(self.__dict__["_inner"], k, v)
+
+
+# ------------------------------------------------------- PS-stack tokens
+
+
+def _ps_scope_cut(name):
+    raise NotImplementedError(
+        f"{name} belongs to the brpc parameter-server data stack "
+        "(paddle/fluid/framework data_feed), which is a documented scope "
+        "cut of the TPU build (NOTES/COMPONENTS PS rows); use "
+        "paddle.io.Dataset/DataLoader")
+
+
+class QueueDataset:
+    def __init__(self, *a, **k):
+        _ps_scope_cut("QueueDataset")
+
+
+class InMemoryDataset:
+    def __init__(self, *a, **k):
+        _ps_scope_cut("InMemoryDataset")
+
+
+class CountFilterEntry:
+    def __init__(self, *a, **k):
+        _ps_scope_cut("CountFilterEntry")
+
+
+class ShowClickEntry:
+    def __init__(self, *a, **k):
+        _ps_scope_cut("ShowClickEntry")
+
+
+class ProbabilityEntry:
+    def __init__(self, *a, **k):
+        _ps_scope_cut("ProbabilityEntry")

@@ -1,0 +1,20 @@
+"""paddle.distributed.io parity (reference distributed/io.py): save/load
+helpers for distributed training programs — served by the framework's
+save/load plus the distributed checkpoint API."""
+
+from paddle_tpu.distributed.checkpoint import (  # noqa: F401
+    load_state_dict,
+    save_state_dict,
+)
+from paddle_tpu.framework.io import load, save  # noqa: F401
+
+
+def save_persistables(exe, dirname, main_program=None, filename=None):
+    raise NotImplementedError(
+        "static-program persistable saving: use paddle.save on state "
+        "dicts or dist.save_state_dict for sharded checkpoints")
+
+
+def load_persistables(exe, dirname, main_program=None, filename=None):
+    raise NotImplementedError(
+        "use paddle.load / dist.load_state_dict")

@@ -28,3 +28,29 @@ from paddle_tpu.io.sampler import (  # noqa: F401
     WeightedRandomSampler,
 )
 from paddle_tpu.io.dataloader import DataLoader, default_collate_fn  # noqa: F401
+
+
+class WorkerInfo:
+    """paddle.io.get_worker_info payload (reference io/dataloader/worker.py
+    WorkerInfo): populated inside DataLoader worker processes."""
+
+    def __init__(self, id, num_workers, dataset):  # noqa: A002
+        self.id = id
+        self.num_workers = num_workers
+        self.dataset = dataset
+
+
+import threading as _threading
+
+_worker_info_tls = _threading.local()
+
+
+def _set_worker_info(info):
+    _worker_info_tls.info = info
+
+
+def get_worker_info():
+    """None in the main process; a WorkerInfo inside a DataLoader
+    worker thread/process (reference contract). Thread-local — the
+    threaded worker pool runs in-process."""
+    return getattr(_worker_info_tls, "info", None)

@@ -148,6 +148,10 @@ class DataLoader:
             task_q.put(None)
 
         def worker(worker_id):
+            from paddle_tpu.io import WorkerInfo, _set_worker_info
+
+            _set_worker_info(WorkerInfo(worker_id, self.num_workers,
+                                        self.dataset))
             if self.worker_init_fn is not None:
                 self.worker_init_fn(worker_id)
             while True:
@@ -251,6 +255,10 @@ class DataLoader:
             if not ring:
                 os._exit(1)
             try:
+                from paddle_tpu.io import WorkerInfo, _set_worker_info
+
+                _set_worker_info(WorkerInfo(wid, self.num_workers,
+                                            self.dataset))
                 if self.worker_init_fn is not None:
                     self.worker_init_fn(wid)
                 for idx, b in my_batches:

@@ -6,3 +6,37 @@ from paddle_tpu.jit import sot  # noqa: F401
 from paddle_tpu.jit.sot import symbolic_translate  # noqa: F401
 
 from paddle_tpu.ops.control_flow import case, cond, switch_case, while_loop  # noqa: F401,E402
+from paddle_tpu.jit.serialization import TranslatedLayer  # noqa: F401,E402
+
+_SOT_LOG_LEVEL = 0
+_CODE_LEVEL = 0
+
+
+def set_verbosity(level=0, also_to_stdout=False):
+    """paddle.jit.set_verbosity parity (dy2static logging knob)."""
+    global _SOT_LOG_LEVEL
+    _SOT_LOG_LEVEL = int(level)
+
+
+def set_code_level(level=100, also_to_stdout=False):
+    """paddle.jit.set_code_level parity: transformed-code dump level."""
+    global _CODE_LEVEL
+    _CODE_LEVEL = int(level)
+
+
+def enable_to_static(enable_to_static_bool=True):
+    """paddle.jit.enable_to_static parity: globally toggles whether
+    @to_static functions capture or fall through to eager."""
+    from paddle_tpu.jit import api as _api
+
+    _api._GLOBAL_TO_STATIC_ENABLED = bool(enable_to_static_bool)
+
+
+_IGNORED_MODULES = set()
+
+
+def ignore_module(modules):
+    """paddle.jit.ignore_module parity: modules the SOT capture skips
+    (their frames always run eagerly)."""
+    for m in (modules if isinstance(modules, (list, tuple)) else [modules]):
+        _IGNORED_MODULES.add(getattr(m, "__name__", str(m)))

@@ -29,6 +29,9 @@ from paddle_tpu.nn.layer_base import Layer
 from paddle_tpu.tensor import Tensor
 
 
+_GLOBAL_TO_STATIC_ENABLED = True
+
+
 class StaticFunction:
     """Callable wrapping (layer?, fn) with a cached jax.jit program."""
 
@@ -85,6 +88,11 @@ class StaticFunction:
         return list(p.values()), [t for t in b.values() if t is not None]
 
     def __call__(self, *args, **kwargs):
+        if not _GLOBAL_TO_STATIC_ENABLED:
+            # paddle.jit.enable_to_static(False): captured functions run
+            # eagerly, exactly as the reference's global toggle does
+            # (self._fn is already bound when wrapping a layer method)
+            return self._fn(*args, **kwargs)
         if not self._full_graph:
             # SOT-style contract: constructs tracing can't swallow fall back
             # to eager instead of erroring (paddle's full_graph=False)

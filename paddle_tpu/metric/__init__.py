@@ -192,3 +192,19 @@ class Auc(Metric):
 
     def name(self):
         return self._name
+
+
+def accuracy(input, label, k=1, correct=None, total=None, name=None):
+    """paddle.metric.accuracy functional (reference metric/metrics.py):
+    top-k accuracy over softmax/logit input [N, C]."""
+    import jax.numpy as jnp
+
+    from paddle_tpu.core.dispatch import apply
+
+    def f(x, y):
+        topk = jnp.argsort(-x, axis=-1)[:, :k]
+        y = y.reshape(-1, 1).astype(topk.dtype)
+        hit = jnp.any(topk == y, axis=1)
+        return jnp.mean(hit.astype(jnp.float32)).reshape(1)
+
+    return apply("accuracy", f, input, label, differentiable=False)

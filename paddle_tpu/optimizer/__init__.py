@@ -12,3 +12,11 @@ from paddle_tpu.optimizer.optimizer import (  # noqa: F401
     Optimizer,
     RMSProp,
 )
+from paddle_tpu.optimizer.extra_optimizers import (  # noqa: F401,E402
+    ASGD,
+    Adadelta,
+    LBFGS,
+    NAdam,
+    RAdam,
+    Rprop,
+)

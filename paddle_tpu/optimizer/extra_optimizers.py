@@ -1,0 +1,277 @@
+"""r4 optimizer closure (reference python/paddle/optimizer/{asgd,radam,
+adadelta,rprop,nadam,lbfgs}.py): the six remaining __all__ optimizers on
+the shared Optimizer base. Each update rule is a jitted-per-shape jnp
+composition like the in-file family (XLA fuses the elementwise chain).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from paddle_tpu.optimizer.optimizer import Optimizer
+from paddle_tpu.tensor import Tensor
+
+__all__ = ["ASGD", "RAdam", "Adadelta", "Rprop", "NAdam", "LBFGS"]
+
+
+class Adadelta(Optimizer):
+    """adadelta.py: accumulated squared grads + squared update trick."""
+
+    def __init__(self, learning_rate=0.001, epsilon=1e-6, rho=0.95,
+                 parameters=None, weight_decay=None, grad_clip=None,
+                 multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay,
+                         grad_clip, name, multi_precision)
+        self._epsilon = epsilon
+        self._rho = rho
+
+    def _init_state(self, p):
+        base = self._master(p)
+        ref = base if base is not None else p._value
+        return {"avg_sq_grad": jnp.zeros_like(ref),
+                "avg_sq_update": jnp.zeros_like(ref)}
+
+    def _apply_one(self, param, grad, lr, state, wd):
+        rho = jnp.asarray(self._rho, param.dtype)
+        eps = jnp.asarray(self._epsilon, param.dtype)
+        g = grad + jnp.asarray(wd, param.dtype) * param
+        asg = rho * state["avg_sq_grad"] + (1 - rho) * g * g
+        update = g * jnp.sqrt(state["avg_sq_update"] + eps) / jnp.sqrt(
+            asg + eps)
+        asu = rho * state["avg_sq_update"] + (1 - rho) * update * update
+        return (param - lr.astype(param.dtype) * update,
+                {"avg_sq_grad": asg, "avg_sq_update": asu})
+
+
+class ASGD(Optimizer):
+    """asgd.py: averaged SGD — plain SGD step plus a running average of
+    the iterates; the AVERAGED weights are what the reference exposes via
+    the d/y accumulators (simplified polyak averaging here)."""
+
+    def __init__(self, learning_rate=0.001, batch_num=1, parameters=None,
+                 weight_decay=None, grad_clip=None, multi_precision=False,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay,
+                         grad_clip, name, multi_precision)
+
+    def _init_state(self, p):
+        base = self._master(p)
+        ref = base if base is not None else p._value
+        return {"avg": jnp.array(ref), "n": jnp.zeros((), jnp.float32)}
+
+    def _apply_one(self, param, grad, lr, state, wd):
+        g = grad + jnp.asarray(wd, param.dtype) * param
+        p_new = param - lr.astype(param.dtype) * g
+        n = state["n"] + 1
+        avg = state["avg"] + (p_new - state["avg"]) / n.astype(param.dtype)
+        return p_new, {"avg": avg, "n": n}
+
+    def averaged_params(self):
+        """The polyak-averaged iterates (reference exposes them through
+        the ASGD accumulators)."""
+        return [Tensor._from_value(self._state[id(p)]["avg"])
+                for p in self._parameter_list if id(p) in self._state]
+
+
+class Rprop(Optimizer):
+    """rprop.py: resilient propagation — sign-based per-element step
+    sizes grown/shrunk on gradient-sign agreement."""
+
+    def __init__(self, learning_rate=0.001, learning_rate_range=(1e-5, 50),
+                 parameters=None, etas=(0.5, 1.2), grad_clip=None,
+                 multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, None, grad_clip, name,
+                         multi_precision)
+        self._lr_min, self._lr_max = learning_rate_range
+        self._eta_neg, self._eta_pos = etas
+
+    def _init_state(self, p):
+        base = self._master(p)
+        ref = base if base is not None else p._value
+        return {"prev_grad": jnp.zeros_like(ref),
+                "step_size": jnp.full_like(ref, float(self.get_lr()))}
+
+    def _apply_one(self, param, grad, lr, state, wd):
+        sign = jnp.sign(grad * state["prev_grad"])
+        grow = jnp.asarray(self._eta_pos, param.dtype)
+        shrink = jnp.asarray(self._eta_neg, param.dtype)
+        step = jnp.where(sign > 0, state["step_size"] * grow,
+                         jnp.where(sign < 0, state["step_size"] * shrink,
+                                   state["step_size"]))
+        step = jnp.clip(step, self._lr_min, self._lr_max)
+        # on sign flip the reference zeroes the grad (no step this round)
+        g_eff = jnp.where(sign < 0, 0.0, jnp.sign(grad))
+        p_new = param - step * g_eff
+        prev = jnp.where(sign < 0, 0.0, grad)
+        return p_new, {"prev_grad": prev, "step_size": step}
+
+
+class RAdam(Optimizer):
+    """radam.py: rectified Adam — variance-rectification term switches
+    between SGDm and Adam per step."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=None,
+                 grad_clip=None, multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay,
+                         grad_clip, name, multi_precision)
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+
+    def _init_state(self, p):
+        base = self._master(p)
+        ref = base if base is not None else p._value
+        return {"m": jnp.zeros_like(ref), "v": jnp.zeros_like(ref),
+                "t": jnp.zeros((), jnp.float32)}
+
+    def _apply_one(self, param, grad, lr, state, wd):
+        b1 = jnp.asarray(self._beta1, param.dtype)
+        b2 = jnp.asarray(self._beta2, param.dtype)
+        eps = jnp.asarray(self._epsilon, param.dtype)
+        g = grad + jnp.asarray(wd, param.dtype) * param
+        t = state["t"] + 1
+        tt = t.astype(param.dtype)
+        m = b1 * state["m"] + (1 - b1) * g
+        v = b2 * state["v"] + (1 - b2) * g * g
+        m_hat = m / (1 - b1 ** tt)
+        rho_inf = 2.0 / (1 - b2) - 1.0
+        rho_t = rho_inf - 2.0 * tt * b2 ** tt / (1 - b2 ** tt)
+        r = jnp.sqrt(((rho_t - 4) * (rho_t - 2) * rho_inf)
+                     / ((rho_inf - 4) * (rho_inf - 2) * rho_t))
+        v_hat = jnp.sqrt(v / (1 - b2 ** tt)) + eps
+        lr_c = lr.astype(param.dtype)
+        adam_step = lr_c * r * m_hat / v_hat
+        sgd_step = lr_c * m_hat
+        p_new = param - jnp.where(rho_t > 5.0, adam_step, sgd_step)
+        return p_new, {"m": m, "v": v, "t": t}
+
+
+class NAdam(Optimizer):
+    """nadam.py: Adam with Nesterov momentum (momentum-decay schedule
+    mu_t per Dozat 2016)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, momentum_decay=0.004, parameters=None,
+                 weight_decay=None, grad_clip=None, multi_precision=False,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay,
+                         grad_clip, name, multi_precision)
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+        self._psi = momentum_decay
+
+    def _init_state(self, p):
+        base = self._master(p)
+        ref = base if base is not None else p._value
+        return {"m": jnp.zeros_like(ref), "v": jnp.zeros_like(ref),
+                "mu_prod": jnp.ones((), jnp.float32),
+                "t": jnp.zeros((), jnp.float32)}
+
+    def _apply_one(self, param, grad, lr, state, wd):
+        b1 = jnp.asarray(self._beta1, param.dtype)
+        b2 = jnp.asarray(self._beta2, param.dtype)
+        eps = jnp.asarray(self._epsilon, param.dtype)
+        g = grad + jnp.asarray(wd, param.dtype) * param
+        t = state["t"] + 1
+        tt = t.astype(param.dtype)
+        mu_t = b1 * (1 - 0.5 * 0.96 ** (tt * self._psi))
+        mu_next = b1 * (1 - 0.5 * 0.96 ** ((tt + 1) * self._psi))
+        mu_prod = state["mu_prod"].astype(param.dtype) * mu_t
+        m = b1 * state["m"] + (1 - b1) * g
+        v = b2 * state["v"] + (1 - b2) * g * g
+        m_hat = (mu_next * m / (1 - mu_prod * mu_next)
+                 + (1 - mu_t) * g / (1 - mu_prod))
+        v_hat = v / (1 - b2 ** tt)
+        p_new = param - lr.astype(param.dtype) * m_hat / (
+            jnp.sqrt(v_hat) + eps)
+        return p_new, {"m": m, "v": v,
+                       "mu_prod": mu_prod.astype(jnp.float32), "t": t}
+
+
+class LBFGS(Optimizer):
+    """lbfgs.py: limited-memory BFGS over the FLAT parameter vector with
+    a closure; the two-loop recursion with fixed learning-rate steps
+    (``line_search_fn=None``, the reference default). strong_wolfe line
+    search is not implemented and raises."""
+
+    def __init__(self, learning_rate=1.0, max_iter=20, tolerance_grad=1e-7,
+                 tolerance_change=1e-9, history_size=100,
+                 line_search_fn=None, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        if line_search_fn is not None:
+            raise NotImplementedError(
+                "LBFGS line_search_fn='strong_wolfe' is not implemented; "
+                "use the default fixed-step mode (line_search_fn=None)")
+        super().__init__(learning_rate, parameters, weight_decay,
+                         grad_clip, name)
+        self._max_iter = max_iter
+        self._tol_grad = tolerance_grad
+        self._tol_change = tolerance_change
+        self._history = history_size
+        self._s, self._y = [], []
+        self._prev_flat_g = None
+        self._prev_flat_x = None
+
+    def _flat(self, vals):
+        return jnp.concatenate([v.reshape(-1) for v in vals])
+
+    def _unflat(self, flat):
+        out, ofs = [], 0
+        for p in self._parameter_list:
+            n = int(jnp.size(p._value))
+            out.append(flat[ofs:ofs + n].reshape(p._value.shape))
+            ofs += n
+        return out
+
+    def step(self, closure=None):
+        if closure is None:
+            raise ValueError("LBFGS.step requires a closure computing "
+                             "the loss (reference contract)")
+        from paddle_tpu.autograd import no_grad
+
+        loss = None
+        for _ in range(self._max_iter):
+            loss = closure()
+            g = self._flat([p.grad._value if p.grad is not None
+                            else jnp.zeros_like(p._value)
+                            for p in self._parameter_list])
+            if float(jnp.max(jnp.abs(g))) <= self._tol_grad:
+                break
+            x = self._flat([p._value for p in self._parameter_list])
+            if self._prev_flat_g is not None:
+                s = x - self._prev_flat_x
+                yv = g - self._prev_flat_g
+                if float(jnp.dot(s, yv)) > 1e-10:
+                    self._s.append(s)
+                    self._y.append(yv)
+                    if len(self._s) > self._history:
+                        self._s.pop(0)
+                        self._y.pop(0)
+            # two-loop recursion
+            q = g
+            alphas = []
+            for s, yv in zip(reversed(self._s), reversed(self._y)):
+                rho = 1.0 / jnp.dot(yv, s)
+                a = rho * jnp.dot(s, q)
+                q = q - a * yv
+                alphas.append((a, rho, s, yv))
+            if self._s:
+                gamma = (jnp.dot(self._s[-1], self._y[-1])
+                         / jnp.dot(self._y[-1], self._y[-1]))
+                q = q * gamma
+            for a, rho, s, yv in reversed(alphas):
+                b = rho * jnp.dot(yv, q)
+                q = q + (a - b) * s
+            direction = -q
+            step = jnp.asarray(float(self.get_lr()), x.dtype)
+            x_new = x + step * direction
+            if float(jnp.max(jnp.abs(x_new - x))) <= self._tol_change:
+                break
+            # the curvature pair wants the POINT WHERE g WAS EVALUATED:
+            # next iteration s = x_next - x (x_new stored via params)
+            self._prev_flat_x = x
+            self._prev_flat_g = g
+            with no_grad():
+                for p, v in zip(self._parameter_list,
+                                self._unflat(x_new)):
+                    p._replace_value(v)
+                    p.clear_grad()
+        return loss
