@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import json
 import os
+import sys
 import time
 
 import numpy as np
@@ -509,16 +510,12 @@ def bench_chip_ceilings(on_tpu):
     print(json.dumps(out))
 
 
-def _probe_backend(timeout_s=180):
+def _probe_once(timeout_s):
     """Resolve the platform name in a THROWAWAY subprocess with a timeout.
 
     On the tunneled chip a dead tunnel makes jax.devices() hang forever
     (not raise); probing in-process would hang this whole bench with zero
-    output for the driver to record. The subprocess inherits the same
-    tunnel config, so a DEAD-at-probe-time tunnel is reliably caught; a
-    tunnel that flaps dead between probe exit and the benches' first
-    backend use can still hang the parent — that residual window is
-    accepted (an in-process watchdog can't preempt a hung PJRT call).
+    output for the driver to record.
     """
     import subprocess
     import sys
@@ -539,49 +536,149 @@ def _probe_backend(timeout_s=180):
         return None
     except Exception:
         # TimeoutExpired, but also OSError/MemoryError spawning the probe:
-        # every probe failure must fall through to the bench_error line —
+        # every probe failure must fall through to the caller's retry loop —
         # an uncaught exception here reproduces the zero-output hang this
         # guard exists to prevent
         return None
 
 
+def _probe_backend(attempts=5, timeout_s=120, backoff_s=45):
+    """Probe with retries + backoff (worst case ~13 min: 5 x 120 s hung
+    probes + 4 x 45 s sleeps; a LIVE backend answers the first probe in
+    seconds).
+
+    r4's single 180 s probe met one tunnel flap and the WHOLE round's bench
+    record became `bench_error` (VERDICT r4 weak #2). Liveness flaps on a
+    scale of minutes, so several spaced attempts recover most outages.
+    """
+    for i in range(attempts):
+        plat = _probe_once(timeout_s)
+        if plat is not None:
+            return plat
+        if i < attempts - 1:
+            print(json.dumps({
+                "metric": "bench_probe_retry", "attempt": i + 1,
+                "sleep_s": backoff_s}), flush=True)
+            time.sleep(backoff_s)
+    return None
+
+
+_BENCHES = {}  # name -> fn; registration order is execution order
+
+
+def _register(fn):
+    _BENCHES[fn.__name__] = fn
+    return fn
+
+
+for _f in (bench_chip_ceilings, bench_resnet50, bench_bert, bench_ernie,
+           bench_fused_adamw, bench_fused_adamw_trainstep,
+           bench_fused_rms_norm, bench_llama13b_layer, bench_gpt3_1p3b,
+           bench_gpt):  # headline LAST (tail-parsed by the driver)
+    _register(_f)
+
+
+def _run_one_child(name, plat):
+    """Child-process entry: run a single bench against a pre-probed platform."""
+    if plat == "cpu":
+        # pin: the axon sitecustomize may have set jax_platforms to
+        # "axon,cpu" at interpreter start; first backend use would dial the
+        # (possibly dead) tunnel despite the cpu vote.
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    from paddle_tpu.device import is_tpu_like_platform
+
+    _BENCHES[name](is_tpu_like_platform(plat))
+
+
 def main():
     # probe BEFORE any paddle_tpu/jax-touching import: import-time device
     # touches would hang this process on a dead tunnel before the guard runs
+    import subprocess
+    import sys
+
     plat = _probe_backend()
     if plat is None:
         print(json.dumps({
             "metric": "bench_error", "value": 0, "unit": "none",
             "vs_baseline": None,
             "error": "device backend unreachable (dead tunnel?) - "
-                     "probe subprocess hung/failed",
+                     "probe retries exhausted",
         }))
         return
+
+    # Each bench runs in its OWN subprocess with a timeout: a tunnel flap
+    # mid-bench kills only that bench, and every completed bench's JSON is
+    # already on our stdout — partial results always land (VERDICT r4 #1b).
+    per_bench_timeout = float(os.environ.get("BENCH_TIMEOUT", "900"))
+    env = dict(os.environ)
+    env.setdefault("JAX_COMPILATION_CACHE_DIR",
+                   os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "build", "jax_cache"))
     if plat == "cpu":
-        # pin the PARENT too: the axon sitecustomize may have set the
-        # in-config jax_platforms to "axon,cpu" at interpreter start, in
-        # which case the benches' first backend use would still dial the
-        # tunnel despite the probe having voted cpu (probe env != parent
-        # config). Import alone doesn't init backends, so the pin holds.
-        import jax
+        env.pop("PALLAS_AXON_POOL_IPS", None)
 
-        jax.config.update("jax_platforms", "cpu")
-    from paddle_tpu.device import is_tpu_like_platform
-
-    on_tpu = is_tpu_like_platform(plat)
-
-    import gc
-
-    for fn in (bench_chip_ceilings, bench_resnet50, bench_bert, bench_ernie,
-               bench_fused_adamw, bench_fused_adamw_trainstep,
-               bench_fused_rms_norm, bench_llama13b_layer, bench_gpt3_1p3b):
+    names = list(_BENCHES)
+    for i, name in enumerate(names):
         try:
-            fn(on_tpu)
-        except Exception as e:  # secondary metrics must not kill the headline
-            print(json.dumps({"metric": fn.__name__, "error": str(e)[:200]}))
-        gc.collect()  # big per-bench device state must not leak forward
-    bench_gpt(on_tpu)  # headline LAST (tail-parsed by the driver)
+            r = subprocess.run(
+                [sys.executable, os.path.abspath(__file__),
+                 "--one", name, "--plat", plat],
+                capture_output=True, text=True,
+                timeout=per_bench_timeout, env=env)
+            for line in r.stdout.splitlines():
+                if line.startswith("{"):
+                    print(line, flush=True)
+            if r.returncode != 0:
+                err = (r.stderr or "").strip().splitlines()
+                print(json.dumps({
+                    "metric": name,
+                    "error": (err[-1] if err else f"rc={r.returncode}")[:300],
+                }), flush=True)
+        except subprocess.TimeoutExpired as e:
+            out = e.stdout or ""
+            out = out.decode(errors="replace") if isinstance(out, bytes) else out
+            for line in out.splitlines():
+                if line.startswith("{"):
+                    print(line, flush=True)
+            print(json.dumps({
+                "metric": name,
+                "error": f"timeout after {per_bench_timeout:.0f}s "
+                         "(tunnel flap mid-bench?)",
+            }), flush=True)
+            if i < len(names) - 1:
+                # a hang usually means the tunnel died: re-probe (with the
+                # full retry budget) before burning 900 s on each remaining
+                # bench against a dead backend
+                plat2 = _probe_backend()
+                if plat2 is None:
+                    for rest in names[i + 1:]:
+                        print(json.dumps({
+                            "metric": rest,
+                            "error": "skipped: backend unreachable after "
+                                     "mid-run flap",
+                        }), flush=True)
+                    return
+                plat = plat2
+                if plat == "cpu":
+                    # the axon sitecustomize re-dials the (dead) tunnel in
+                    # any child whose env carries this var, even against a
+                    # cpu vote — remaining children must not inherit it
+                    env.pop("PALLAS_AXON_POOL_IPS", None)
+        except Exception as e:
+            print(json.dumps({"metric": name, "error": str(e)[:300]}),
+                  flush=True)
 
 
 if __name__ == "__main__":
-    main()
+    if "--one" in sys.argv:
+        import argparse
+
+        ap = argparse.ArgumentParser()
+        ap.add_argument("--one", required=True)
+        ap.add_argument("--plat", required=True)
+        a = ap.parse_args()
+        _run_one_child(a.one, a.plat)
+    else:
+        main()
