@@ -72,13 +72,56 @@ def to_device_memory(arr):
 
 
 def _offload_state(optimizer):
+    mesh = getattr(optimizer, "_sharding_mesh", None)
+    axis = getattr(optimizer, "_sharding_axis", None)
+
+    def park(v):
+        if not hasattr(v, "shape"):
+            return v
+        if mesh is None or isinstance(v.sharding, NamedSharding):
+            return to_host_memory(v)
+        # uncommitted/single-device state joining a sharded (multi-device)
+        # program: park it with the MESH's device set — ZeRO layout for
+        # vectors (sharded over the dp axis), replicated scalars — so the
+        # compiled step sees one consistent device set
+        spec = (_shard_spec_for(v.shape, mesh.shape[axis], axis)
+                if v.ndim > 0 else P())
+        try:
+            return jax.device_put(
+                v, NamedSharding(mesh, spec, memory_kind="pinned_host"))
+        except Exception:
+            return jax.device_put(v, NamedSharding(mesh, spec))
+
     for key, st in list(optimizer._state.items()):
-        optimizer._state[key] = {
-            k: to_host_memory(v) if hasattr(v, "shape") else v
-            for k, v in st.items()
-        }
+        optimizer._state[key] = {k: park(v) for k, v in st.items()}
     for key, mv in list(optimizer._master_weights.items()):
-        optimizer._master_weights[key] = to_host_memory(mv)
+        optimizer._master_weights[key] = park(mv)
+
+
+def _wrap_forward_param_fetch(model):
+    """Stage-3 offload eager path: stream host-resident params to device at
+    forward entry (the on-demand gather). Inside a jit trace the values are
+    tracers, not host arrays, so the fetch is a no-op there."""
+    orig_forward = model.forward
+    params = list(model.parameters())  # collected once at wrap time
+
+    def forward(*args, **kwargs):
+        parked = [p for p in params
+                  if hasattr(p._value, "sharding") and getattr(
+                      p._value.sharding, "memory_kind", None)
+                  == "pinned_host"]
+        if parked:
+            # ONE batched transfer (not N blocking copies): jax overlaps
+            # the per-array host->device streams inside a single call
+            fetched = jax.device_put(
+                [p._value for p in parked],
+                [p._value.sharding.with_memory_kind("device")
+                 for p in parked])
+            for p, v in zip(parked, fetched):
+                p._replace_value(v)
+        return orig_forward(*args, **kwargs)
+
+    model.forward = forward
 
 
 def group_sharded_parallel(model, optimizer, level, scaler=None, group=None,
@@ -109,6 +152,7 @@ def group_sharded_parallel(model, optimizer, level, scaler=None, group=None,
         mesh = _env.get_world_mesh()
         axis = "world"
         optimizer._sharding_axis = axis
+    optimizer._sharding_mesh = mesh
 
     if mesh.shape[axis] > 1:
         # stage >=1: shard existing optimizer states + fp32 master weights
@@ -128,6 +172,21 @@ def group_sharded_parallel(model, optimizer, level, scaler=None, group=None,
         # eager step and jit.TrainStep both keep them there across updates
         optimizer._offload = True
         _offload_state(optimizer)
+        if level == "p_g_os":
+            # stage-3 offload: PARAMS also rest in pinned host memory
+            # (reference group_sharded_storage.py:48,121 convert_cpu) and
+            # are gathered/streamed to device on demand at forward entry;
+            # Optimizer.step / TrainStep re-park them after the update
+            optimizer._offload_params = True
+            optimizer._param_host_sh = {}
+            for p in model.parameters():
+                p._replace_value(to_host_memory(p._value))
+                # record the park layout: TrainStep bakes its param
+                # out_shardings from THIS map, not from p._value at build
+                # time — an eager warmup forward may have migrated params
+                # to device right before the first compiled step
+                optimizer._param_host_sh[id(p)] = p._value.sharding
+            _wrap_forward_param_fetch(model)
     if scaler is not None:
         return model, optimizer, scaler
     return model, optimizer

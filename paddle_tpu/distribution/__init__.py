@@ -285,6 +285,23 @@ class Gamma(Distribution):
 
         return apply("gamma_log_prob", f, value)
 
+    @property
+    def mean(self):
+        return _wrap(jnp.broadcast_to(self.concentration / self.rate,
+                                      self.batch_shape))
+
+    @property
+    def variance(self):
+        return _wrap(jnp.broadcast_to(self.concentration / self.rate ** 2,
+                                      self.batch_shape))
+
+    def entropy(self):
+        from jax.scipy.special import digamma, gammaln
+
+        a, b = self.concentration, self.rate
+        h = a - jnp.log(b) + gammaln(a) + (1 - a) * digamma(a)
+        return _wrap(jnp.broadcast_to(h, self.batch_shape))
+
 
 class Exponential(Distribution):
     def __init__(self, rate, name=None):
@@ -454,3 +471,66 @@ def _kl_beta(p, q):
           + (a1 - a2) * digamma(a1) + (b1 - b2) * digamma(b1)
           + (a2 - a1 + b2 - b1) * digamma(a1 + b1))
     return _wrap(kl)
+
+
+@register_kl(Gamma, Gamma)
+def _kl_gamma(p, q):
+    from jax.scipy.special import digamma, gammaln
+
+    a1, b1, a2, b2 = p.concentration, p.rate, q.concentration, q.rate
+    kl = ((a1 - a2) * digamma(a1) - gammaln(a1) + gammaln(a2)
+          + a2 * (jnp.log(b1) - jnp.log(b2)) + a1 * (b2 / b1 - 1.0))
+    return _wrap(kl)
+
+
+@register_kl(Exponential, Exponential)
+def _kl_exponential(p, q):
+    r = q.rate / p.rate
+    return _wrap(jnp.log(p.rate) - jnp.log(q.rate) + r - 1.0)
+
+
+# transform machinery + completion distributions (round 5) — imported last:
+# they subclass/register against the classes above
+from paddle_tpu.distribution import transform  # noqa: E402
+from paddle_tpu.distribution.transform import (  # noqa: E402,F401
+    AbsTransform,
+    AffineTransform,
+    ChainTransform,
+    ExpTransform,
+    IndependentTransform,
+    PowerTransform,
+    ReshapeTransform,
+    SigmoidTransform,
+    SoftmaxTransform,
+    StackTransform,
+    StickBreakingTransform,
+    TanhTransform,
+    Transform,
+)
+from paddle_tpu.distribution.extra import (  # noqa: E402,F401
+    Binomial,
+    Cauchy,
+    Chi2,
+    ContinuousBernoulli,
+    ExponentialFamily,
+    Geometric,
+    Independent,
+    LKJCholesky,
+    MultivariateNormal,
+    Poisson,
+    StudentT,
+    TransformedDistribution,
+)
+
+__all__ = [  # class parity with reference distribution/__init__.py __all__
+    'Bernoulli', 'Beta', 'Binomial', 'Categorical', 'Cauchy', 'Chi2',
+    'ContinuousBernoulli', 'Dirichlet', 'Distribution', 'Exponential',
+    'ExponentialFamily', 'Gamma', 'Geometric', 'Gumbel', 'Independent',
+    'Laplace', 'LKJCholesky', 'LogNormal', 'Multinomial',
+    'MultivariateNormal', 'Normal', 'Poisson', 'StudentT',
+    'TransformedDistribution', 'Uniform', 'kl_divergence', 'register_kl',
+    'AbsTransform', 'AffineTransform', 'ChainTransform', 'ExpTransform',
+    'IndependentTransform', 'PowerTransform', 'ReshapeTransform',
+    'SigmoidTransform', 'SoftmaxTransform', 'StackTransform',
+    'StickBreakingTransform', 'TanhTransform', 'Transform',
+]

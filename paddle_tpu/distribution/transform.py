@@ -1,0 +1,672 @@
+"""paddle.distribution.transform parity — variable transforms with log-det
+Jacobians (reference: python/paddle/distribution/transform.py:59 Transform,
+:350 AbsTransform, :422 AffineTransform, :504 ChainTransform, :629
+ExpTransform, :678 IndependentTransform, :773 PowerTransform, :837
+ReshapeTransform, :960 SigmoidTransform, :1003 SoftmaxTransform, :1059
+StackTransform, :1179 StickBreakingTransform, :1245 TanhTransform).
+
+tpu-native design: each transform's math is a pure jnp function (jit- and
+grad-compatible); the public methods accept/return paddle Tensors through
+the same dispatch boundary as the rest of the op library.
+"""
+
+from __future__ import annotations
+
+import enum
+import math
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.tensor import Tensor
+
+__all__ = [
+    "Type",
+    "Transform",
+    "AbsTransform",
+    "AffineTransform",
+    "ChainTransform",
+    "ExpTransform",
+    "IndependentTransform",
+    "PowerTransform",
+    "ReshapeTransform",
+    "SigmoidTransform",
+    "SoftmaxTransform",
+    "StackTransform",
+    "StickBreakingTransform",
+    "TanhTransform",
+]
+
+
+def _val(x):
+    if isinstance(x, Tensor):
+        return x._value
+    return jnp.asarray(x, jnp.float32)
+
+
+def _wrap(v):
+    return Tensor._from_value(v)
+
+
+class _Variable:
+    """Domain/codomain descriptor (reference variable.py): event rank +
+    discreteness + a membership check used by TransformedDistribution to
+    track how many rightmost dims a transform consumes."""
+
+    def __init__(self, is_discrete=False, event_rank=0, constraint=None):
+        self.is_discrete = is_discrete
+        self.event_rank = event_rank
+        self._constraint = constraint or (lambda x: jnp.full(jnp.shape(x), True))
+
+    def constraint(self, x):
+        return self._constraint(_val(x))
+
+
+real = _Variable(False, 0, lambda x: jnp.isfinite(x))
+positive = _Variable(False, 0, lambda x: x > 0)
+
+
+def _independent_var(base, rank):
+    return _Variable(base.is_discrete, base.event_rank + rank,
+                     base._constraint)
+
+
+real_vector = _independent_var(real, 1)
+
+
+class Type(enum.Enum):
+    BIJECTION = "bijection"      # 1-1 and onto
+    INJECTION = "injection"      # 1-1 but not onto
+    SURJECTION = "surjection"    # onto but not 1-1
+    OTHER = "other"
+
+    @classmethod
+    def is_injective(cls, t):
+        return t in (cls.BIJECTION, cls.INJECTION)
+
+
+class Transform:
+    """Differentiable transform of random variables, characterized by
+    ``forward``, ``inverse`` and the log-det-Jacobians of both directions.
+
+    Subclasses implement ``_forward``/``_inverse`` (jnp in, jnp out) and at
+    least one of ``_forward_log_det_jacobian`` / ``_inverse_log_det_jacobian``
+    (the other is derived by negation at the mapped point), plus
+    ``_forward_shape``/``_inverse_shape`` when the shape changes.
+    """
+
+    _type = Type.INJECTION
+
+    @classmethod
+    def _is_injective(cls):
+        return Type.is_injective(cls._type)
+
+    def __call__(self, input):
+        from paddle_tpu.distribution import Distribution
+        from paddle_tpu.distribution.extra import TransformedDistribution
+
+        if isinstance(input, Distribution):
+            return TransformedDistribution(input, [self])
+        if isinstance(input, Transform):
+            return ChainTransform([self, input])
+        return self.forward(input)
+
+    # -- public Tensor-boundary API ----------------------------------------
+    # Every public method routes through the op dispatch (`apply`) with the
+    # input AND the transform's Tensor parameters as positional tape
+    # inputs, so eager autograd flows to both — the same contract the rest
+    # of the op library keeps. The jnp-level internals read parameters via
+    # `._value`, which `swap_values` rebinds to the traced primals.
+    def _tensor_params(self):
+        return []
+
+    def _apply(self, opname, raw, x):
+        from paddle_tpu.core.dispatch import apply
+        from paddle_tpu.jit.functional import swap_values
+
+        params = self._tensor_params()
+
+        def f(v, *pvals):
+            with swap_values(params, list(pvals)):
+                return raw(v)
+
+        return apply(opname, f, x, *params)
+
+    def forward(self, x):
+        return self._apply("transform_forward", self._forward, x)
+
+    def inverse(self, y):
+        return self._apply("transform_inverse", self._inverse, y)
+
+    def forward_log_det_jacobian(self, x):
+        return self._apply("transform_fldj", self._call_forward_ldj, x)
+
+    def inverse_log_det_jacobian(self, y):
+        return self._apply("transform_ildj", self._call_inverse_ldj, y)
+
+    def forward_shape(self, shape):
+        return tuple(self._forward_shape(tuple(shape)))
+
+    def inverse_shape(self, shape):
+        return tuple(self._inverse_shape(tuple(shape)))
+
+    # -- jnp-level plumbing -------------------------------------------------
+    def _call_forward_ldj(self, x):
+        if hasattr(self, "_forward_log_det_jacobian"):
+            return self._forward_log_det_jacobian(x)
+        if hasattr(self, "_inverse_log_det_jacobian"):
+            return -self._inverse_log_det_jacobian(self._forward(x))
+        raise NotImplementedError(
+            f"{type(self).__name__} implements neither direction of "
+            "log_det_jacobian")
+
+    def _call_inverse_ldj(self, y):
+        if hasattr(self, "_inverse_log_det_jacobian"):
+            return self._inverse_log_det_jacobian(y)
+        if hasattr(self, "_forward_log_det_jacobian"):
+            return -self._forward_log_det_jacobian(self._inverse(y))
+        raise NotImplementedError(
+            f"{type(self).__name__} implements neither direction of "
+            "log_det_jacobian")
+
+    def _forward(self, x):
+        raise NotImplementedError
+
+    def _inverse(self, y):
+        raise NotImplementedError
+
+    def _forward_shape(self, shape):
+        return shape
+
+    def _inverse_shape(self, shape):
+        return shape
+
+    @property
+    def _domain(self):
+        return real
+
+    @property
+    def _codomain(self):
+        return real
+
+
+class AbsTransform(Transform):
+    """y = |x|; non-injective, ``inverse(y)`` returns the set inverse
+    ``(-y, y)`` (reference transform.py:350)."""
+
+    _type = Type.SURJECTION
+
+    def _forward(self, x):
+        return jnp.abs(x)
+
+    def _inverse(self, y):
+        return -y, y
+
+    def _inverse_log_det_jacobian(self, y):
+        zero = jnp.zeros((), _val(y).dtype)
+        return zero, zero
+
+    @property
+    def _codomain(self):
+        return positive
+
+
+class AffineTransform(Transform):
+    """y = loc + scale * x (reference transform.py:422)."""
+
+    _type = Type.BIJECTION
+
+    def __init__(self, loc, scale):
+        if not isinstance(loc, Tensor):
+            raise TypeError(f"Expected 'loc' is a Tensor, but got {type(loc)}")
+        if not isinstance(scale, Tensor):
+            raise TypeError(
+                f"Expected scale is a Tensor, but got {type(scale)}")
+        self._loc = loc
+        self._scale = scale
+
+    @property
+    def loc(self):
+        return self._loc
+
+    @property
+    def scale(self):
+        return self._scale
+
+    def _tensor_params(self):
+        return [self._loc, self._scale]
+
+    def _forward(self, x):
+        return self._loc._value + self._scale._value * x
+
+    def _inverse(self, y):
+        return (y - self._loc._value) / self._scale._value
+
+    def _forward_log_det_jacobian(self, x):
+        return jnp.broadcast_to(jnp.log(jnp.abs(self._scale._value)),
+                                self._forward_shape(jnp.shape(x)))
+
+    def _forward_shape(self, shape):
+        return jnp.broadcast_shapes(shape, tuple(self._loc.shape),
+                                    tuple(self._scale.shape))
+
+    _inverse_shape = _forward_shape
+
+
+class ChainTransform(Transform):
+    """Function composition of transforms, applied left-to-right in
+    ``forward`` (reference transform.py:504)."""
+
+    def __init__(self, transforms):
+        if not isinstance(transforms, (list, tuple)):
+            raise TypeError("transforms must be a sequence of Transform")
+        for t in transforms:
+            if not isinstance(t, Transform):
+                raise TypeError(f"not a Transform: {t!r}")
+        self.transforms = list(transforms)
+
+    @classmethod
+    def _tp(cls, transforms):
+        types = {t._type for t in transforms}
+        if types <= {Type.BIJECTION}:
+            return Type.BIJECTION
+        if types <= {Type.BIJECTION, Type.INJECTION}:
+            return Type.INJECTION
+        if types <= {Type.BIJECTION, Type.SURJECTION}:
+            return Type.SURJECTION
+        return Type.OTHER
+
+    @property
+    def _type(self):
+        return self._tp(self.transforms)
+
+    def _is_injective(self):
+        return Type.is_injective(self._type)
+
+    def _tensor_params(self):
+        return [p for t in self.transforms for p in t._tensor_params()]
+
+    def _forward(self, x):
+        for t in self.transforms:
+            x = t._forward(x)
+        return x
+
+    def _inverse(self, y):
+        for t in reversed(self.transforms):
+            y = t._inverse(y)
+        return y
+
+    def _forward_log_det_jacobian(self, x):
+        # accumulate per-transform contributions, summing over the event
+        # dims each transform introduces so ranks stay consistent
+        value = 0.0
+        event_rank = max(t._domain.event_rank for t in self.transforms) \
+            if self.transforms else 0
+        for t in self.transforms:
+            value = value + _sum_rightmost(
+                t._call_forward_ldj(x), event_rank - t._domain.event_rank)
+            x = t._forward(x)
+            event_rank += t._codomain.event_rank - t._domain.event_rank
+        return value
+
+    def _forward_shape(self, shape):
+        for t in self.transforms:
+            shape = t._forward_shape(shape)
+        return shape
+
+    def _inverse_shape(self, shape):
+        for t in reversed(self.transforms):
+            shape = t._inverse_shape(shape)
+        return shape
+
+    @property
+    def _domain(self):
+        return self.transforms[0]._domain if self.transforms else real
+
+    @property
+    def _codomain(self):
+        return self.transforms[-1]._codomain if self.transforms else real
+
+
+class ExpTransform(Transform):
+    """y = exp(x) (reference transform.py:629)."""
+
+    _type = Type.BIJECTION
+
+    def _forward(self, x):
+        return jnp.exp(x)
+
+    def _inverse(self, y):
+        return jnp.log(y)
+
+    def _forward_log_det_jacobian(self, x):
+        return x
+
+    @property
+    def _codomain(self):
+        return positive
+
+
+class IndependentTransform(Transform):
+    """Reinterpret the rightmost ``reinterpreted_batch_rank`` batch dims of a
+    base transform as event dims — the log-det sums over them (reference
+    transform.py:678)."""
+
+    def __init__(self, base, reinterpreted_batch_rank):
+        if not isinstance(base, Transform):
+            raise TypeError("base must be a Transform")
+        if reinterpreted_batch_rank < 1:
+            raise ValueError("reinterpreted_batch_rank must be >= 1")
+        self._base = base
+        self._reinterpreted_batch_rank = int(reinterpreted_batch_rank)
+
+    @property
+    def _type(self):
+        return self._base._type
+
+    def _is_injective(self):
+        return self._base._is_injective()
+
+    def _tensor_params(self):
+        return self._base._tensor_params()
+
+    def _forward(self, x):
+        return self._base._forward(x)
+
+    def _inverse(self, y):
+        return self._base._inverse(y)
+
+    def _forward_log_det_jacobian(self, x):
+        return _sum_rightmost(self._base._call_forward_ldj(x),
+                              self._reinterpreted_batch_rank)
+
+    def _forward_shape(self, shape):
+        return self._base._forward_shape(shape)
+
+    def _inverse_shape(self, shape):
+        return self._base._inverse_shape(shape)
+
+    @property
+    def _domain(self):
+        return _independent_var(self._base._domain,
+                                self._reinterpreted_batch_rank)
+
+    @property
+    def _codomain(self):
+        return _independent_var(self._base._codomain,
+                                self._reinterpreted_batch_rank)
+
+
+class PowerTransform(Transform):
+    """y = x ** power on the positive reals (reference transform.py:773)."""
+
+    _type = Type.BIJECTION
+
+    def __init__(self, power):
+        if not isinstance(power, Tensor):
+            raise TypeError(
+                f"Expected 'power' is a Tensor, but got {type(power)}")
+        self._power = power
+
+    @property
+    def power(self):
+        return self._power
+
+    def _tensor_params(self):
+        return [self._power]
+
+    def _forward(self, x):
+        return jnp.power(x, self._power._value)
+
+    def _inverse(self, y):
+        return jnp.power(y, 1.0 / self._power._value)
+
+    def _forward_log_det_jacobian(self, x):
+        p = self._power._value
+        return jnp.log(jnp.abs(p * jnp.power(x, p - 1)))
+
+    def _forward_shape(self, shape):
+        return jnp.broadcast_shapes(shape, tuple(self._power.shape))
+
+    _inverse_shape = _forward_shape
+
+    @property
+    def _domain(self):
+        return positive
+
+    @property
+    def _codomain(self):
+        return positive
+
+
+class ReshapeTransform(Transform):
+    """Reshape the event part of the input (reference transform.py:837)."""
+
+    _type = Type.BIJECTION
+
+    def __init__(self, in_event_shape, out_event_shape):
+        in_event_shape = tuple(in_event_shape)
+        out_event_shape = tuple(out_event_shape)
+        if math.prod(in_event_shape) != math.prod(out_event_shape):
+            raise ValueError(
+                f"in_event_shape {in_event_shape} and out_event_shape "
+                f"{out_event_shape} have different numbers of elements")
+        self._in_event_shape = in_event_shape
+        self._out_event_shape = out_event_shape
+
+    @property
+    def in_event_shape(self):
+        return self._in_event_shape
+
+    @property
+    def out_event_shape(self):
+        return self._out_event_shape
+
+    def _forward(self, x):
+        batch = jnp.shape(x)[: jnp.ndim(x) - len(self._in_event_shape)]
+        return jnp.reshape(x, batch + self._out_event_shape)
+
+    def _inverse(self, y):
+        batch = jnp.shape(y)[: jnp.ndim(y) - len(self._out_event_shape)]
+        return jnp.reshape(y, batch + self._in_event_shape)
+
+    def _forward_log_det_jacobian(self, x):
+        batch = jnp.shape(x)[: jnp.ndim(x) - len(self._in_event_shape)]
+        return jnp.zeros(batch, dtype=x.dtype)
+
+    def _forward_shape(self, shape):
+        n = len(self._in_event_shape)
+        if tuple(shape[len(shape) - n:]) != self._in_event_shape:
+            raise ValueError(
+                f"shape {shape} does not end with {self._in_event_shape}")
+        return tuple(shape[: len(shape) - n]) + self._out_event_shape
+
+    def _inverse_shape(self, shape):
+        n = len(self._out_event_shape)
+        if tuple(shape[len(shape) - n:]) != self._out_event_shape:
+            raise ValueError(
+                f"shape {shape} does not end with {self._out_event_shape}")
+        return tuple(shape[: len(shape) - n]) + self._in_event_shape
+
+    @property
+    def _domain(self):
+        return _independent_var(real, len(self._in_event_shape))
+
+    @property
+    def _codomain(self):
+        return _independent_var(real, len(self._out_event_shape))
+
+
+class SigmoidTransform(Transform):
+    """y = sigmoid(x) (reference transform.py:960)."""
+
+    _type = Type.BIJECTION
+
+    def _forward(self, x):
+        return jax.nn.sigmoid(x)
+
+    def _inverse(self, y):
+        return jnp.log(y) - jnp.log1p(-y)
+
+    def _forward_log_det_jacobian(self, x):
+        # log σ'(x) = -softplus(-x) - softplus(x), computed stably
+        return -jax.nn.softplus(-x) - jax.nn.softplus(x)
+
+    @property
+    def _codomain(self):
+        return _Variable(False, 0, lambda x: (x > 0) & (x < 1))
+
+
+class SoftmaxTransform(Transform):
+    """y = softmax(x) over the last axis; not injective (reference
+    transform.py:1003). ``inverse`` maps back to the log-probability
+    representative."""
+
+    _type = Type.OTHER
+
+    def _forward(self, x):
+        return jax.nn.softmax(x, axis=-1)
+
+    def _inverse(self, y):
+        return jnp.log(y)
+
+    @property
+    def _domain(self):
+        return _independent_var(real, 1)
+
+    @property
+    def _codomain(self):
+        return _independent_var(_Variable(False, 0, lambda x: x > 0), 1)
+
+
+class StackTransform(Transform):
+    """Apply a sequence of transforms to slices along ``axis``
+    (reference transform.py:1059)."""
+
+    def __init__(self, transforms, axis=0):
+        if not transforms or not all(
+                isinstance(t, Transform) for t in transforms):
+            raise TypeError("transforms must be non-empty Transforms")
+        self._transforms = list(transforms)
+        self._axis = int(axis)
+
+    @property
+    def transforms(self):
+        return self._transforms
+
+    @property
+    def axis(self):
+        return self._axis
+
+    def _is_injective(self):
+        return all(t._is_injective() for t in self._transforms)
+
+    def _tensor_params(self):
+        return [p for t in self._transforms for p in t._tensor_params()]
+
+    def _split(self, x):
+        n = len(self._transforms)
+        return [jnp.squeeze(s, self._axis)
+                for s in jnp.split(x, n, axis=self._axis)]
+
+    def _forward(self, x):
+        return jnp.stack(
+            [t._forward(v) for t, v in zip(self._transforms, self._split(x))],
+            axis=self._axis)
+
+    def _inverse(self, y):
+        return jnp.stack(
+            [t._inverse(v) for t, v in zip(self._transforms, self._split(y))],
+            axis=self._axis)
+
+    def _forward_log_det_jacobian(self, x):
+        return jnp.stack(
+            [t._call_forward_ldj(v)
+             for t, v in zip(self._transforms, self._split(x))],
+            axis=self._axis)
+
+    @property
+    def _domain(self):
+        return _independent_var(real, 1)
+
+    @property
+    def _codomain(self):
+        return _independent_var(real, 1)
+
+
+class StickBreakingTransform(Transform):
+    """Unconstrained R^{K-1} -> K-simplex via stick-breaking
+    (reference transform.py:1179)."""
+
+    _type = Type.INJECTION
+
+    def _forward(self, x):
+        # offset logistic: z_i = sigmoid(x_i - log(K - i)), remainder product
+        k = jnp.shape(x)[-1]
+        offset = jnp.log(jnp.arange(k, 0, -1, dtype=x.dtype))
+        z = jax.nn.sigmoid(x - offset)
+        zpad = jnp.concatenate([z, jnp.ones_like(z[..., :1])], axis=-1)
+        one_minus = jnp.concatenate(
+            [jnp.ones_like(z[..., :1]), 1 - z], axis=-1)
+        return zpad * jnp.cumprod(one_minus, axis=-1)
+
+    def _inverse(self, y):
+        y_crop = y[..., :-1]
+        k = jnp.shape(y_crop)[-1]
+        offset = jnp.log(jnp.arange(k, 0, -1, dtype=y.dtype))
+        sf = 1 - jnp.cumsum(y_crop, axis=-1)
+        sf = jnp.concatenate([jnp.ones_like(y_crop[..., :1]), sf[..., :-1]],
+                             axis=-1)
+        return jnp.log(y_crop / sf) - jnp.log1p(-y_crop / sf) + offset
+
+    def _forward_log_det_jacobian(self, x):
+        k = jnp.shape(x)[-1]
+        offset = jnp.log(jnp.arange(k, 0, -1, dtype=x.dtype))
+        z = jax.nn.sigmoid(x - offset)
+        sf = jnp.cumprod(1 - z, axis=-1) / (1 - z)  # remainder BEFORE step i
+        detail = jnp.log(z) + jnp.log1p(-z) + jnp.log(sf)
+        return jnp.sum(detail, axis=-1)
+
+    def _forward_shape(self, shape):
+        if not shape:
+            raise ValueError("input must have at least one dim")
+        return tuple(shape[:-1]) + (shape[-1] + 1,)
+
+    def _inverse_shape(self, shape):
+        if not shape or shape[-1] < 2:
+            raise ValueError("last dim must be >= 2")
+        return tuple(shape[:-1]) + (shape[-1] - 1,)
+
+    @property
+    def _domain(self):
+        return _independent_var(real, 1)
+
+    @property
+    def _codomain(self):
+        return _independent_var(_Variable(False, 0, lambda x: x > 0), 1)
+
+
+class TanhTransform(Transform):
+    """y = tanh(x) (reference transform.py:1245)."""
+
+    _type = Type.BIJECTION
+
+    def _forward(self, x):
+        return jnp.tanh(x)
+
+    def _inverse(self, y):
+        return jnp.arctanh(y)
+
+    def _forward_log_det_jacobian(self, x):
+        # log(1 - tanh(x)^2) = 2 (log 2 - x - softplus(-2x)), stable
+        return 2.0 * (math.log(2.0) - x - jax.nn.softplus(-2.0 * x))
+
+    @property
+    def _codomain(self):
+        return _Variable(False, 0, lambda x: (x > -1) & (x < 1))
+
+
+def _sum_rightmost(value, n):
+    if n == 0:
+        return value
+    return jnp.sum(value, axis=tuple(range(-n, 0)))

@@ -347,7 +347,17 @@ class TrainStep:
             st_sh = [jax.tree_util.tree_map(shard_of, st) for st in opt_states]
             mv_sh = [shard_of(mv) if mv is not None else None
                      for mv in master_vals]
-            self._offload_sh = (st_sh, mv_sh)
+            # stage-3 offload: params ALSO rest in pinned host; pin their
+            # outputs to the RECORDED park layout (not p._value's current
+            # sharding — an eager warmup forward may have fetched params to
+            # device, and baking that in would keep them device-resident
+            # forever) so the hot loop never migrates them
+            host_sh = getattr(self._opt, "_param_host_sh", {})
+            pv_sh = [host_sh.get(id(p), shard_of(p._value))
+                     if getattr(self._opt, "_offload_params", False)
+                     else None
+                     for p in self._params]
+            self._offload_sh = (st_sh, mv_sh, pv_sh)
             if jax.default_backend() == "cpu":
                 # CPU PJRT can't annotate host placement inside compiled
                 # programs (annotate_device_placement unimplemented): fall
@@ -356,7 +366,7 @@ class TrainStep:
                 self._offload_post = True
                 self._offload_sh = None
             else:
-                out_shardings = (None, [None] * len(self._params), st_sh,
+                out_shardings = (None, pv_sh, st_sh,
                                  mv_sh, [None] * n_buffers,
                                  (None, None, None) if has_scaler else None,
                                  None)
@@ -368,10 +378,11 @@ class TrainStep:
     def _step(self, param_vals, opt_states, master_vals, buffer_vals,
               batch_vals, lr, key, scale=None):
         if self._offload_sh is not None:
-            # ZeRO offload: stream pinned-host states/masters to device for
-            # the update (XLA overlaps the PCIe copies with compute); the
-            # jit's out_shardings pin the results back to host
-            st_sh, mv_sh = self._offload_sh
+            # ZeRO offload: stream pinned-host states/masters (and stage-3
+            # params) to device for the update (XLA overlaps the PCIe
+            # copies with compute); the jit's out_shardings pin the results
+            # back to host
+            st_sh, mv_sh, pv_sh = self._offload_sh
 
             def to_dev(v, sh):
                 if sh is None or sh.memory_kind in (None, "device"):
@@ -382,6 +393,8 @@ class TrainStep:
                           for st, sh in zip(opt_states, st_sh)]
             master_vals = [mv if mv is None else to_dev(mv, sh)
                            for mv, sh in zip(master_vals, mv_sh)]
+            param_vals = [to_dev(pv, sh)
+                          for pv, sh in zip(param_vals, pv_sh)]
         params = self._params
         _, buffers_dict = collect_state(self._model)
         buffers = [b for b in buffers_dict.values() if b is not None]
@@ -594,6 +607,8 @@ class TrainStep:
                           for st in opt_states]
             master_vals = [mv if mv is None else to_device_memory(mv)
                            for mv in master_vals]
+            if getattr(self._opt, "_offload_params", False):
+                param_vals = [to_device_memory(pv) for pv in param_vals]
         from paddle_tpu.amp import debugging as _dbg
 
         if _dbg.check_numerics_enabled():
@@ -622,6 +637,7 @@ class TrainStep:
                 param_vals, opt_states, master_vals, buffer_vals, batch_vals,
                 lr, key, scale
             )
+        offload_params = getattr(self._opt, "_offload_params", False)
         for p, v in zip(params, new_params):
             p._replace_value(v)
         if self._offload_post:
@@ -633,6 +649,9 @@ class TrainStep:
             ]
             new_masters = [mv if mv is None else to_host_memory(mv)
                            for mv in new_masters]
+            if offload_params:
+                for p in params:
+                    p._replace_value(to_host_memory(p._value))
         for p, st in zip(params, new_states):
             self._opt._state[id(p)] = st
         for p, mv in zip(params, new_masters):

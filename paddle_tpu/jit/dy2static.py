@@ -10,9 +10,14 @@ predicate is a Tensor are rewritten into ``paddle.static.nn.cond`` /
 Python predicates keep exact Python semantics through the same runtime
 helpers.
 
-Unsupported inside a transformed block (left untransformed, as in eager):
-``return`` / ``break`` / ``continue`` — matching the subset the builder
-documents; the reference handles these with early-exit flags.
+Early exits are supported the way the reference's transformers do it
+(return_transformer.py, break_continue_transformer.py): ``break`` /
+``continue`` become loop flags with guarded continuations
+(_LoopEscapeRewriter), and ``return`` inside control flow becomes a
+function-level flag + value pair (_ReturnRewriter) — loops break on the
+flag, trailing statements are guarded, and the function tail returns the
+captured value. Returns inside ``try``/``with`` keep python semantics
+(real early exit; enclosing tensor-loops stay eager).
 """
 
 from __future__ import annotations
@@ -159,6 +164,43 @@ def _traced_while(cond_fn: Callable, body_fn: Callable, vars: Tuple):
     # keep them out of the carry, re-inject UNDEF each iteration (the
     # body assigns them before use; their post-loop value is dropped)
     undef = {i for i, v in enumerate(vars) if v is UNDEF}
+    if undef:
+        # …except slots the body DEFINES (probe once abstractly): those are
+        # real carries — e.g. the captured early-return value of the return
+        # rewrite — and dropping them would lose the value after the loop.
+        # They start as zeros of the probed aval (sound: reads are only
+        # reachable under the defining flag, convert_ifelse's fill rule).
+        import jax
+
+        live_idx = [i for i in range(len(vars)) if i not in undef]
+        tset = {i for i in live_idx if isinstance(vars[i], Tensor)}
+        tvals = [vars[i]._value for i in sorted(tset)]
+
+        def _probe(*tv):
+            it = iter(tv)
+            full = [Tensor._from_value(next(it)) if i in tset else vars[i]
+                    for i in range(len(vars))]
+            out = body_fn(*full)
+            return [None if o is UNDEF else o for o in out]
+
+        try:
+            probe_out = jax.eval_shape(_probe, *tvals)
+        except Exception:
+            probe_out = [None] * len(vars)  # probe failed: old behavior
+        defined = {i for i in undef
+                   if i < len(probe_out) and probe_out[i] is not None}
+        if defined:
+            import jax.numpy as jnp
+
+            def _sd(x):
+                return x._value if isinstance(x, Tensor) else x
+
+            vars = tuple(
+                Tensor._from_value(jnp.zeros(_sd(probe_out[i]).shape,
+                                             _sd(probe_out[i]).dtype))
+                if i in defined else v
+                for i, v in enumerate(vars))
+            undef = undef - defined
     if undef:
         live = [v for i, v in enumerate(vars) if i not in undef]
 
@@ -412,6 +454,93 @@ def _assign(name, value):
 
 def _const(v):
     return ast.Constant(value=v)
+
+
+def finalize_ret(flag, val):
+    """Function-tail helper after the return rewrite: the captured early
+    return value, or None when no return ran (python fall-off). With a
+    traced flag the value is the cond-filled output — data-dependent
+    "return or fall off" cannot widen to None in a fixed-shape program, so
+    the fill semantics of convert_ifelse apply (documented there)."""
+    if val is UNDEF:
+        return None
+    return val
+
+
+class _ReturnRewriter:
+    """Rewrite ``return X`` inside control flow into
+    ``<val> = X; <flag> = True`` (reference
+    jit/dy2static/transformers/return_transformer.py). Enclosing loops get
+    ``if <flag>: break`` appended to their body (the break/continue
+    rewriter then compiles it), and statements after a construct that may
+    set the flag are guarded by ``if not <flag>: ...``."""
+
+    def __init__(self, flag: str, val: str):
+        self.flag = flag
+        self.val = val
+
+    def _guard(self, rest: List[ast.stmt]) -> ast.If:
+        return ast.If(
+            test=_dy2s_call("logical_not", _name(self.flag, ast.Load())),
+            body=rest, orelse=[])
+
+    def rewrite_function(self, body: List[ast.stmt]) -> List[ast.stmt]:
+        new, _ = self._block(body)
+        return new
+
+    def _block(self, stmts: List[ast.stmt]):
+        """Returns (new_stmts, may_set_flag)."""
+        out: List[ast.stmt] = []
+        for i, s in enumerate(stmts):
+            if isinstance(s, ast.Return):
+                out.append(_assign(self.val,
+                                   s.value if s.value is not None
+                                   else _const(None)))
+                out.append(_assign(self.flag, _const(True)))
+                return out, True  # rest of the block is unreachable
+            if isinstance(s, ast.If):
+                body2, e1 = self._block(s.body)
+                orelse2, e2 = self._block(s.orelse)
+                if e1 or e2:
+                    out.append(ast.If(test=s.test,
+                                      body=body2 or [ast.Pass()],
+                                      orelse=orelse2))
+                    rest, _ = self._block(stmts[i + 1:])
+                    if rest:
+                        out.append(self._guard(rest))
+                    return out, True
+                out.append(s)
+            elif isinstance(s, (ast.While, ast.For)):
+                body2, e = self._block(s.body)
+                if e:
+                    # the loop must STOP iterating once the flag is set:
+                    # an if-break the escape rewriter then compiles
+                    body2.append(ast.If(
+                        test=_name(self.flag, ast.Load()),
+                        body=[ast.Break()], orelse=[]))
+                    s2 = (ast.While(test=s.test, body=body2,
+                                    orelse=s.orelse)
+                          if isinstance(s, ast.While) else
+                          ast.For(target=s.target, iter=s.iter,
+                                  body=body2, orelse=s.orelse))
+                    out.append(s2)
+                    rest, _ = self._block(stmts[i + 1:])
+                    if rest:
+                        out.append(self._guard(rest))
+                    return out, True
+                out.append(s)
+            else:
+                # Try/With keep real-return semantics; nested functions own
+                # their returns
+                out.append(s)
+        return out, False
+
+
+def _has_early_return(body: List[ast.stmt]) -> bool:
+    """Any Return nested inside an If/While/For of this function body."""
+    return any(
+        isinstance(s, (ast.If, ast.While, ast.For)) and _has_return([s])
+        for s in body)
 
 
 class _LoopEscapeRewriter:
@@ -726,9 +855,23 @@ def ast_transform(fn: Callable):
     if not isinstance(fdef, (ast.FunctionDef, ast.AsyncFunctionDef)):
         return None
     fdef.decorator_list = []  # the decorator is being applied right now
+    early = _has_early_return(fdef.body)
+    if early:
+        # return-inside-control-flow -> flag + captured value, BEFORE the
+        # control-flow pass so the generated flag ifs/loop breaks compile
+        flag, val = "__flag_ret", "__flag_retval"
+        rr = _ReturnRewriter(flag, val)
+        fdef.body = (
+            [_assign(flag, _const(False)),
+             _assign(val, ast.Attribute(value=_name("_dy2s", ast.Load()),
+                                        attr="UNDEF", ctx=ast.Load()))]
+            + rr.rewrite_function(fdef.body)
+            + [ast.Return(value=_dy2s_call(
+                "finalize_ret", _name(flag, ast.Load()),
+                _name(val, ast.Load())))])
     t = ControlFlowTransformer()
     new_tree = t.visit(tree)
-    if t._n == 0:
+    if t._n == 0 and not early:
         return fn  # nothing to rewrite
     ast.fix_missing_locations(new_tree)
     import paddle_tpu.jit.dy2static as _dy2s_mod

@@ -307,9 +307,10 @@ def _reduce_loss(loss, reduction):
 
 
 def soft_margin_loss(input, label, reduction="mean", name=None):
-    """log(1 + exp(-label * input)) (reference soft_margin_loss)."""
+    """log(1 + exp(-label * input)) (reference soft_margin_loss);
+    softplus form so large misclassified logits don't overflow fp32."""
     def f(x, y):
-        return _reduce_loss(jnp.log1p(jnp.exp(-y.astype(x.dtype) * x)),
+        return _reduce_loss(jax.nn.softplus(-y.astype(x.dtype) * x),
                             reduction)
 
     return apply("soft_margin_loss", f, input, label)

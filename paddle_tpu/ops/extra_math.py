@@ -69,6 +69,26 @@ def as_real(x, name=None):
 # --------------------------------------------------------------------- norms
 
 
+def guarded_root(s, porder, epsilon=1e-12):
+    """s ** (1/p) whose FORWARD is exact (||0|| == 0, no eps bias) and
+    whose backward applies the epsilon divide-guard the reference p_norm
+    kernel uses, so the grad at s == 0 is finite (0) instead of nan."""
+
+    @jax.custom_vjp
+    def root(sv):
+        return sv ** (1.0 / porder)
+
+    def root_fwd(sv):
+        return root(sv), sv
+
+    def root_bwd(sv, ct):
+        return (ct * (1.0 / porder)
+                * (sv + epsilon) ** (1.0 / porder - 1.0),)
+
+    root.defvjp(root_fwd, root_bwd)
+    return root(s)
+
+
 @register_op("p_norm")
 def p_norm(x, porder=2.0, axis=-1, epsilon=1e-12, keepdim=False,
            asvector=False, name=None):
@@ -81,8 +101,11 @@ def p_norm(x, porder=2.0, axis=-1, epsilon=1e-12, keepdim=False,
             return jnp.min(jnp.abs(v), axis=ax, keepdims=keepdim)
         if porder == 0:
             return jnp.sum((v != 0).astype(a.dtype), axis=ax, keepdims=keepdim)
+        # epsilon guards ONLY the backward's s**(1/p - 1) divide (the
+        # reference kernel's use); adding it to the forward value biases
+        # the norm by eps^(1/p) — e.g. ||0||_2 == 1e-6 (ADVICE r4)
         s = jnp.sum(jnp.abs(v) ** porder, axis=ax, keepdims=keepdim)
-        return (s + epsilon) ** (1.0 / porder)
+        return guarded_root(s, porder, epsilon)
 
     return apply("p_norm", f, x)
 

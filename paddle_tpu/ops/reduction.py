@@ -119,19 +119,22 @@ def count_nonzero(x, axis=None, keepdim=False, name=None):
 
 @register_op("norm", category="reduction")
 def norm(x, p="fro", axis=None, keepdim=False, name=None):
+    from paddle_tpu.ops.extra_math import guarded_root
+
     def f(a):
         ax = _norm_axis(axis)
         if p == "fro" or (p == 2 and ax is None):
-            return jnp.sqrt(jnp.sum(jnp.square(a), axis=ax, keepdims=keepdim))
+            return guarded_root(
+                jnp.sum(jnp.square(a), axis=ax, keepdims=keepdim), 2.0)
         if p == float("inf"):
             return jnp.max(jnp.abs(a), axis=ax, keepdims=keepdim)
         if p == float("-inf"):
             return jnp.min(jnp.abs(a), axis=ax, keepdims=keepdim)
         if p == 0:
             return jnp.sum((a != 0).astype(a.dtype), axis=ax, keepdims=keepdim)
-        return jnp.power(
-            jnp.sum(jnp.power(jnp.abs(a), p), axis=ax, keepdims=keepdim), 1.0 / p
-        )
+        return guarded_root(
+            jnp.sum(jnp.power(jnp.abs(a), p), axis=ax, keepdims=keepdim),
+            float(p))
 
     return apply("norm", f, x)
 

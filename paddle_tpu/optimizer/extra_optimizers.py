@@ -44,33 +44,48 @@ class Adadelta(Optimizer):
 
 
 class ASGD(Optimizer):
-    """asgd.py: averaged SGD — plain SGD step plus a running average of
-    the iterates; the AVERAGED weights are what the reference exposes via
-    the d/y accumulators (simplified polyak averaging here)."""
+    """asgd.py: stochastic average gradient (SAG) — the reference update
+    (optimizer/asgd.py:36-44): with n = batch_num gradient slots,
+    i = step % n:  d <- d - y_i + g;  y_i <- g;
+    x <- x - lr * (d / min(step+1, n) + wd * x). batch_num=1 degenerates
+    to plain SGD. The y buffer is one [n, *param] array so the whole
+    update stays a fixed-shape XLA program."""
 
     def __init__(self, learning_rate=0.001, batch_num=1, parameters=None,
                  weight_decay=None, grad_clip=None, multi_precision=False,
                  name=None):
+        if batch_num < 1:
+            raise ValueError(f"batch_num must be >= 1, got {batch_num}")
+        self._batch_num = int(batch_num)
         super().__init__(learning_rate, parameters, weight_decay,
                          grad_clip, name, multi_precision)
 
     def _init_state(self, p):
         base = self._master(p)
         ref = base if base is not None else p._value
-        return {"avg": jnp.array(ref), "n": jnp.zeros((), jnp.float32)}
+        state = {"d": jnp.zeros_like(ref),
+                 "m": jnp.zeros((), jnp.float32)}
+        if self._batch_num > 1:
+            state["ys"] = jnp.zeros((self._batch_num,) + ref.shape,
+                                    ref.dtype)
+        return state
 
     def _apply_one(self, param, grad, lr, state, wd):
-        g = grad + jnp.asarray(wd, param.dtype) * param
-        p_new = param - lr.astype(param.dtype) * g
-        n = state["n"] + 1
-        avg = state["avg"] + (p_new - state["avg"]) / n.astype(param.dtype)
-        return p_new, {"avg": avg, "n": n}
-
-    def averaged_params(self):
-        """The polyak-averaged iterates (reference exposes them through
-        the ASGD accumulators)."""
-        return [Tensor._from_value(self._state[id(p)]["avg"])
-                for p in self._parameter_list if id(p) in self._state]
+        n = self._batch_num
+        m = state["m"]
+        g = grad
+        if n == 1:
+            d = g
+            new_state = {"d": d, "m": m + 1}
+        else:
+            i = (m.astype(jnp.int32)) % n
+            y_i = state["ys"][i]
+            d = state["d"] - y_i + g
+            new_state = {"d": d, "m": m + 1,
+                         "ys": state["ys"].at[i].set(g)}
+        denom = jnp.minimum(m + 1.0, float(n)).astype(param.dtype)
+        step_dir = d / denom + jnp.asarray(wd, param.dtype) * param
+        return param - lr.astype(param.dtype) * step_dir, new_state
 
 
 class Rprop(Optimizer):

@@ -151,6 +151,10 @@ class Optimizer:
                 p._replace_value(new_target.astype(p.dtype))
             else:
                 p._replace_value(new_target)
+            if getattr(self, "_offload_params", False):
+                # stage-3 offload: params rest in pinned host between
+                # steps; the forward wrapper streams them back on demand
+                p._replace_value(to_host_memory(p._value))
 
     def minimize(self, loss, startup_program=None, parameters=None, no_grad_set=None):
         if getattr(loss, "_is_static_var", False):

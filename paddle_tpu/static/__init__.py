@@ -212,24 +212,29 @@ def record_static_op(name, raw_fn, args, kwargs):
                 out.append(a)
         return out
 
-    in_avals = {v.name: v._aval() for v in vars_in}
-
     def shaped(avmap):
         res = raw_fn(*template(avmap), **kwargs)
         return res
 
-    out_res = jax.eval_shape(shaped, in_avals)
+    out_res = jax.eval_shape(shaped, {v.name: v._aval() for v in vars_in})
     multi = isinstance(out_res, (tuple, list))
     out_avals = list(out_res) if multi else [out_res]
-    # dynamic-batch heuristic: inputs with a -1 leading dim traced as 1;
-    # an output whose leading dim came out 1 under that probe keeps the
-    # dynamic marker (the reference keeps -1 through shape inference)
-    dyn_batch = any(v.shape and v.shape[0] < 0 for v in vars_in)
+    # dynamic-dim detection by DOUBLE probe: trace dynamic input dims as 1
+    # and as 2; an output dim is dynamic iff it tracked the probe (differs
+    # between the two traces). A genuinely size-1 output dim (keepdim
+    # reductions, reshape-to-[1,...]) stays 1 under both probes and keeps
+    # its real size — the single-probe heuristic mislabeled it (ADVICE r4).
+    dyn_batch = any(any(d < 0 for d in v.shape) for v in vars_in)
+    if dyn_batch:
+        out_res2 = jax.eval_shape(
+            shaped, {v.name: v._aval(2) for v in vars_in})
+        out_avals2 = list(out_res2) if multi else [out_res2]
+    else:
+        out_avals2 = out_avals
     outs = []
-    for av in out_avals:
-        shape = list(av.shape)
-        if dyn_batch and shape and shape[0] == 1:
-            shape[0] = -1
+    for av, av2 in zip(out_avals, out_avals2):
+        shape = [-1 if d != d2 else d
+                 for d, d2 in zip(av.shape, av2.shape)]
         v = Variable(prog, prog._fresh("tmp"), shape, av.dtype,
                      stop_gradient=all(x.stop_gradient for x in vars_in))
         prog.vars[v.name] = v

@@ -1,0 +1,100 @@
+"""Pins for the four ADVICE r4 findings: ASGD d/y accumulators,
+soft_margin_loss overflow, static dynamic-dim double probe, p_norm forward
+epsilon bias."""
+
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+
+
+def test_asgd_matches_manual_sag():
+    """ASGD must implement the reference recurrence (optimizer/asgd.py:36):
+    d <- d - y_i + g; y_i <- g; x <- x - lr * d / min(m+1, n)."""
+    import paddle_tpu.optimizer as opt
+
+    n = 3
+    lr = 0.1
+    w0 = np.array([1.0, -2.0], np.float32)
+    p = paddle.to_tensor(w0.copy())
+    p.stop_gradient = False
+    p.trainable = True
+    o = opt.ASGD(learning_rate=lr, batch_num=n, parameters=[p])
+
+    grads = [np.array(g, np.float32) for g in
+             ([0.5, 1.0], [-1.0, 2.0], [2.0, -1.0], [0.25, 0.5],
+              [1.0, 1.0])]
+    # manual reference
+    x = w0.copy()
+    d = np.zeros(2, np.float32)
+    ys = np.zeros((n, 2), np.float32)
+    for m, g in enumerate(grads):
+        i = m % n
+        d = d - ys[i] + g
+        ys[i] = g
+        x = x - lr * d / min(m + 1, n)
+
+    for g in grads:
+        p._grad = paddle.to_tensor(g)._value
+        o.step()
+    np.testing.assert_allclose(np.asarray(p.numpy()), x, rtol=1e-5)
+
+
+def test_asgd_batch_num_1_is_sgd():
+    import paddle_tpu.optimizer as opt
+
+    p = paddle.to_tensor(np.array([1.0], np.float32))
+    p.stop_gradient = False
+    p.trainable = True
+    o = opt.ASGD(learning_rate=0.5, batch_num=1, parameters=[p])
+    p._grad = paddle.to_tensor(np.array([2.0], np.float32))._value
+    o.step()
+    np.testing.assert_allclose(np.asarray(p.numpy()), [0.0], atol=1e-6)
+
+
+def test_soft_margin_loss_large_logits_finite():
+    x = paddle.to_tensor(np.array([200.0, -200.0], np.float32))
+    y = paddle.to_tensor(np.array([-1.0, 1.0], np.float32))
+    out = F.soft_margin_loss(x, y, reduction="none")
+    v = np.asarray(out.numpy())
+    assert np.isfinite(v).all()
+    np.testing.assert_allclose(v, [200.0, 200.0], rtol=1e-5)
+    # well-classified side ~ 0
+    out2 = F.soft_margin_loss(x, paddle.to_tensor(
+        np.array([1.0, -1.0], np.float32)), reduction="mean")
+    assert float(np.asarray(out2.numpy())) < 1e-5
+
+
+def test_p_norm_zero_vector_unbiased_with_finite_grad():
+    z = paddle.to_tensor(np.zeros(4, np.float32))
+    z.stop_gradient = False
+    out = paddle.norm(z, p=2)
+    assert float(np.asarray(out.numpy())) == 0.0  # was eps^(1/p) = 1e-3
+    out.backward()
+    assert np.isfinite(np.asarray(z.grad.numpy())).all()
+    # nonzero vector: exact value, exact grad
+    x = paddle.to_tensor(np.array([3.0, 4.0], np.float32))
+    x.stop_gradient = False
+    nrm = paddle.norm(x, p=2)
+    np.testing.assert_allclose(float(np.asarray(nrm.numpy())), 5.0,
+                               rtol=1e-6)
+    nrm.backward()
+    np.testing.assert_allclose(np.asarray(x.grad.numpy()), [0.6, 0.8],
+                               rtol=1e-4)
+
+
+def test_static_keepdim_dim_not_mislabeled_dynamic():
+    """A genuinely size-1 leading output dim must keep size 1 in the
+    recorded Variable shape even when an input has a dynamic (-1) leading
+    dim (ADVICE r4: single-probe collision)."""
+    import paddle_tpu.static as static
+
+    prog = static.Program()
+    with static.program_guard(prog):
+        x = static.data("x", [-1, 8], "float32")
+        # keepdim reduction over dim 0: output leading dim is ALWAYS 1
+        red = paddle.sum(x, axis=0, keepdim=True)
+        # plain batchwise op: leading dim tracks the batch -> stays -1
+        y = paddle.relu(x)
+    assert red.shape[0] == 1, red.shape
+    assert y.shape[0] == -1, y.shape

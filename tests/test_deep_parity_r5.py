@@ -1,0 +1,148 @@
+"""r5 rotating deep-parity pins (VERDICT r4 weak #4): ~30 names sampled
+from the 418-name top-level sweep get BEHAVIORAL pins (values, not
+hasattr), checked against torch/numpy closed forms matching the reference's
+documented semantics (python/paddle/tensor/math.py, manipulation.py,
+search.py, linalg.py)."""
+
+import numpy as np
+import torch
+
+import paddle_tpu as paddle
+
+
+def t(x):
+    return paddle.to_tensor(np.asarray(x))
+
+
+def n(x):
+    return np.asarray(x.numpy())
+
+
+rng = np.random.default_rng(42)
+A = rng.standard_normal((4, 5)).astype(np.float32)
+B = rng.standard_normal((4, 5)).astype(np.float32)
+M = rng.standard_normal((3, 4, 4)).astype(np.float32)
+
+
+def tt(x):
+    return torch.tensor(x)
+
+
+def test_math_pins():
+    np.testing.assert_allclose(n(paddle.heaviside(t(A), t(B))),
+                               torch.heaviside(tt(A), tt(B)).numpy())
+    np.testing.assert_allclose(n(paddle.lerp(t(A), t(B), 0.3)),
+                               torch.lerp(tt(A), tt(B), 0.3).numpy(),
+                               rtol=1e-6)
+    np.testing.assert_allclose(n(paddle.diff(t(A), axis=1)),
+                               np.diff(A, axis=1), rtol=1e-6)
+    np.testing.assert_allclose(n(paddle.cumprod(t(A), dim=1)),
+                               np.cumprod(A, axis=1), rtol=1e-5)
+    np.testing.assert_allclose(
+        n(paddle.logcumsumexp(t(A), axis=1)),
+        torch.logcumsumexp(tt(A), dim=1).numpy(), rtol=1e-5)
+    np.testing.assert_allclose(n(paddle.trapezoid(t(A), dx=0.5, axis=1)),
+                               np.trapezoid(A, dx=0.5, axis=1), rtol=1e-5)
+    np.testing.assert_allclose(
+        n(paddle.frac(t(A))), torch.frac(tt(A)).numpy(), rtol=1e-6)
+    np.testing.assert_allclose(
+        n(paddle.nanmedian(t(np.array([[1., np.nan, 3.], [2., 4., 6.]],
+                                      np.float32)), axis=1)),
+        [2.0, 4.0])
+    np.testing.assert_allclose(n(paddle.outer(t(A[0]), t(B[0]))),
+                               np.outer(A[0], B[0]), rtol=1e-6)
+    np.testing.assert_allclose(n(paddle.inner(t(A), t(B))),
+                               np.inner(A, B), rtol=1e-5)
+
+
+def test_linalg_pins():
+    np.testing.assert_allclose(n(paddle.bmm(t(M), t(M))),
+                               np.matmul(M, M), rtol=1e-4)
+    np.testing.assert_allclose(n(paddle.kron(t(A[:2, :2]), t(B[:2, :2]))),
+                               np.kron(A[:2, :2], B[:2, :2]), rtol=1e-6)
+    np.testing.assert_allclose(
+        n(paddle.cross(t(A[:, :3]), t(B[:, :3]), axis=1)),
+        np.cross(A[:, :3], B[:, :3], axis=1), rtol=1e-5)
+    np.testing.assert_allclose(
+        n(paddle.cdist(t(A), t(B))),
+        torch.cdist(tt(A), tt(B)).numpy(), rtol=1e-4)
+    np.testing.assert_allclose(n(paddle.tril(t(A))), np.tril(A))
+    np.testing.assert_allclose(n(paddle.vander(t(A[0]), 3)),
+                               np.vander(A[0], 3), rtol=1e-5)
+    np.testing.assert_allclose(n(paddle.diag(t(A[0, :4]))),
+                               np.diag(A[0, :4]))
+
+
+def test_manipulation_pins():
+    np.testing.assert_allclose(n(paddle.flip(t(A), axis=[0])),
+                               np.flip(A, 0))
+    np.testing.assert_allclose(n(paddle.roll(t(A), shifts=2, axis=1)),
+                               np.roll(A, 2, 1))
+    np.testing.assert_allclose(
+        n(paddle.repeat_interleave(t(A), 3, axis=1)),
+        np.repeat(A, 3, axis=1))
+    np.testing.assert_allclose(n(paddle.broadcast_to(t(A[0]), [4, 5])),
+                               np.broadcast_to(A[0], (4, 5)))
+    np.testing.assert_allclose(n(paddle.expand_as(t(A[0]), t(A))),
+                               np.broadcast_to(A[0], A.shape))
+    idx = np.array([2, 0], np.int64)
+    np.testing.assert_allclose(n(paddle.index_select(t(A), t(idx), axis=1)),
+                               A[:, idx])
+    np.testing.assert_allclose(
+        n(paddle.gather_nd(t(A), t(np.array([[0, 1], [3, 4]], np.int64)))),
+        A[[0, 3], [1, 4]])
+    tk = np.array([[0, 1], [1, 0], [2, 2], [0, 0]], np.int64)
+    np.testing.assert_allclose(
+        n(paddle.take_along_axis(t(A), t(tk), axis=1)),
+        np.take_along_axis(A, tk, axis=1))
+    mask = A > 0
+    np.testing.assert_allclose(n(paddle.masked_select(t(A), t(mask))),
+                               A[mask])
+    u = paddle.unique(t(np.array([3, 1, 2, 1, 3], np.int64)))
+    np.testing.assert_allclose(n(u), [1, 2, 3])
+
+
+def test_search_sort_pins():
+    np.testing.assert_allclose(n(paddle.argsort(t(A), axis=1)),
+                               np.argsort(A, axis=1, kind="stable"))
+    edges = np.array([-1.0, 0.0, 1.0], np.float32)
+    np.testing.assert_allclose(
+        n(paddle.bucketize(t(A), t(edges))),
+        torch.bucketize(tt(A), tt(edges)).numpy())
+    sorted_seq = np.sort(A, axis=1)
+    np.testing.assert_allclose(
+        n(paddle.searchsorted(t(sorted_seq), t(B))),
+        torch.searchsorted(tt(sorted_seq), tt(B)).numpy())
+    v = np.array([1, 3, 1, 0, 3, 3], np.int64)
+    np.testing.assert_allclose(n(paddle.bincount(t(v))),
+                               np.bincount(v))
+    np.testing.assert_allclose(
+        n(paddle.histogram(t(A), bins=5, min=-2.0, max=2.0)),
+        np.histogram(A, bins=5, range=(-2, 2))[0])
+    assert bool(n(paddle.allclose(t(A), t(A + 1e-9))))
+    assert not bool(n(paddle.allclose(t(A), t(B))))
+    np.testing.assert_allclose(n(paddle.isclose(t(A), t(A + 1e-9))),
+                               np.isclose(A, A + 1e-9))
+
+
+def test_creation_and_misc_pins():
+    np.testing.assert_allclose(n(paddle.logspace(0.0, 2.0, 3)),
+                               [1.0, 10.0, 100.0], rtol=1e-5)
+    e = n(paddle.eye(3, 4))
+    np.testing.assert_allclose(e, np.eye(3, 4))
+    f = n(paddle.full([2, 2], 7.5))
+    np.testing.assert_allclose(f, np.full((2, 2), 7.5, np.float32))
+    tr = n(paddle.trace(t(A[:4, :4])))
+    np.testing.assert_allclose(tr, np.trace(A[:4, :4]), rtol=1e-5)
+    cs = n(paddle.count_nonzero(t(np.array([[0, 1], [2, 0]], np.float32)),
+                                axis=1))
+    np.testing.assert_allclose(cs, [1, 1])
+    np.testing.assert_allclose(
+        n(paddle.clip(t(A), min=-0.5, max=0.5)),
+        np.clip(A, -0.5, 0.5))
+    np.testing.assert_allclose(
+        n(paddle.rot90(t(A))), np.rot90(A))
+    np.testing.assert_allclose(
+        n(paddle.nan_to_num(t(np.array([np.nan, np.inf, -np.inf, 1.0],
+                                       np.float32)))),
+        np.nan_to_num(np.array([np.nan, np.inf, -np.inf, 1.0], np.float32)))
