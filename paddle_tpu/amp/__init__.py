@@ -12,3 +12,4 @@ def is_float16_supported(device=None):
 
 def is_bfloat16_supported(device=None):
     return True
+from paddle_tpu.amp import accuracy_compare  # noqa: F401

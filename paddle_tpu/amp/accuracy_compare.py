@@ -1,0 +1,373 @@
+"""paddle.amp accuracy_compare parity — the fp16-divergence hunting
+workflow (reference: python/paddle/amp/accuracy_compare.py:21 is_infinite,
+:28 is_allclose, :34 TensorInfo, :91 MixedPrecisionTensorInfo, :548
+parse_lines, :593 merge_tensor_info_list, :653 compare_accuracy).
+
+Differences from the reference, by design:
+- output is CSV (the reference's ExcelWriter adds an xlsxwriter dependency
+  for formatting only; the comparison core is the workflow).
+- the LOG SIDE is tpu-native: ``tensor_stats_dump`` hooks the eager op
+  dispatch and writes the same ``[PRECISION]`` lines the reference's
+  FLAGS_check_nan_inf dumps produce, so the full run-fp32 / run-O2 /
+  compare loop works inside this framework.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+
+import numpy as np
+
+__all__ = [
+    "is_infinite",
+    "is_allclose",
+    "TensorInfo",
+    "MixedPrecisionTensorInfo",
+    "parse_lines",
+    "parse_log",
+    "merge_tensor_info_list",
+    "compare_accuracy",
+    "tensor_stats_dump",
+]
+
+
+def is_infinite(value, dtype=np.float16):
+    """True when ``value`` leaves the representable range of ``dtype``."""
+    array = np.array([value]).astype(dtype)
+    return bool(np.isinf(array) or np.isnan(array))
+
+
+def is_allclose(actual, expected, atol=1e-2, rtol=1e-2):
+    return bool(np.allclose(np.array([actual]), np.array([expected]),
+                            atol=atol, rtol=rtol))
+
+
+class TensorInfo:
+    """One ``[PRECISION]`` log line (reference accuracy_compare.py:34)."""
+
+    def __init__(self):
+        self.device = None
+        self.op_type = None
+        self.tensor_name = None
+        self.dtype = None
+        self.numel = None
+        self.max_value = None
+        self.min_value = None
+        self.mean_value = None
+        self.has_inf = None
+        self.has_nan = None
+        self.num_zero = None
+
+    def __str__(self):
+        return (f"[TensorInfo] device={self.device}, op_type={self.op_type},"
+                f" tensor_name={self.tensor_name}, dtype={self.dtype}, "
+                f"numel={self.numel}, num_inf={self.has_inf}, "
+                f"num_nan={self.has_nan}, num_zero={self.num_zero}, "
+                f"max_value={self.max_value:.6f}, "
+                f"min_value={self.min_value:.6f}, "
+                f"mean_value={self.mean_value:.6f}")
+
+    def key(self):
+        return self.op_type + "/" + self.tensor_name
+
+    def init_from_string(self, line):
+        for frag in line.strip().split(" "):
+            word = frag.replace("[", "").replace("]", "").replace(",", "")
+            parts = word.split("=")
+            if len(parts) != 2:
+                continue
+            k, v = parts
+            if k == "op":
+                self.op_type = v
+            elif k == "device":
+                self.device = v
+            elif k == "tensor":
+                self.tensor_name = v
+            elif k == "dtype":
+                self.dtype = v
+            elif k == "numel":
+                self.numel = np.int64(v)
+            elif k == "max":
+                self.max_value = np.float32(v)
+            elif k == "min":
+                self.min_value = np.float32(v)
+            elif k == "mean":
+                self.mean_value = np.float32(v)
+            elif k == "num_inf":
+                self.has_inf = np.int64(v)
+            elif k == "num_nan":
+                self.has_nan = np.int64(v)
+            elif k == "num_zero":
+                self.num_zero = np.int64(v)
+
+
+class MixedPrecisionTensorInfo:
+    """Joined fp32/fp16 row + abnormality verdict (reference :91)."""
+
+    def __init__(self, fp32_tensor_info, fp16_tensor_info, fp32_idx=0,
+                 grad_scale=1.0):
+        self.is_normal = True
+        self.fp32_idx = fp32_idx
+        self.op_type = None
+        self.numel = None
+        self.fp32_tensor_name = None
+        self.fp32_dtype = None
+        self.fp32_max_value = None
+        self.fp32_min_value = None
+        self.fp32_mean_value = None
+        self.fp32_num_zero = None
+        self.scaled_fp32_max_value = None
+        self.scaled_fp32_min_value = None
+        self.fp16_tensor_name = None
+        self.fp16_dtype = None
+        self.fp16_max_value = None
+        self.fp16_min_value = None
+        self.fp16_mean_value = None
+        self.fp16_num_zero = None
+        self.fp16_has_inf = None
+        self.fp16_has_nan = None
+        self.fp32_div_fp16_max_value = None
+        self.fp32_div_fp16_min_value = None
+        self.fp32_div_fp16_mean_value = None
+
+        if fp32_tensor_info is not None:
+            self.op_type = fp32_tensor_info.op_type
+            self.numel = fp32_tensor_info.numel
+            self.fp32_num_zero = fp32_tensor_info.num_zero
+            self.fp32_tensor_name = fp32_tensor_info.tensor_name
+            self.fp32_dtype = fp32_tensor_info.dtype
+            self.fp32_max_value = fp32_tensor_info.max_value
+            self.fp32_min_value = fp32_tensor_info.min_value
+            self.fp32_mean_value = fp32_tensor_info.mean_value
+            if self.fp32_tensor_name and "GRAD" in self.fp32_tensor_name:
+                self.scaled_fp32_max_value = (grad_scale
+                                              * fp32_tensor_info.max_value)
+                self.scaled_fp32_min_value = (grad_scale
+                                              * fp32_tensor_info.min_value)
+
+        if fp16_tensor_info is not None:
+            self.op_type = fp16_tensor_info.op_type
+            self.numel = fp16_tensor_info.numel
+            self.fp16_num_zero = fp16_tensor_info.num_zero
+            self.fp16_tensor_name = fp16_tensor_info.tensor_name
+            self.fp16_dtype = fp16_tensor_info.dtype
+            self.fp16_max_value = fp16_tensor_info.max_value
+            self.fp16_min_value = fp16_tensor_info.min_value
+            self.fp16_mean_value = fp16_tensor_info.mean_value
+            self.fp16_has_inf = fp16_tensor_info.has_inf
+            self.fp16_has_nan = fp16_tensor_info.has_nan
+
+        if fp32_tensor_info is not None and fp16_tensor_info is not None:
+            assert fp32_tensor_info.op_type == fp16_tensor_info.op_type
+            assert fp32_tensor_info.numel == fp16_tensor_info.numel, (
+                f"Error:\n\tFP32 Tensor Info:{fp32_tensor_info}"
+                f"\n\tFP16 Tensor Info:{fp16_tensor_info}")
+            # NOTE: despite the field names, these hold fp16/fp32 — the
+            # reference computes exactly this into the same names
+            # (accuracy_compare.py:157 "Fp16 divided by fp32"); the names
+            # are kept for workflow/tooling parity
+            self.fp32_div_fp16_max_value = self._div(
+                self.fp16_max_value, self.fp32_max_value)
+            self.fp32_div_fp16_min_value = self._div(
+                self.fp16_min_value, self.fp32_min_value)
+            self.fp32_div_fp16_mean_value = self._div(
+                self.fp16_mean_value, self.fp32_mean_value)
+
+        self._check_normal()
+
+    @staticmethod
+    def _div(a, b):
+        if a is not None and b is not None:
+            return a / b if b != 0 else 1
+        return None
+
+    def _check_normal(self):
+        if self.numel is not None and self.numel > np.iinfo(np.int32).max:
+            self.is_normal = False
+            return
+        for value in (self.fp32_max_value, self.fp32_min_value,
+                      self.scaled_fp32_max_value, self.scaled_fp32_min_value,
+                      self.fp16_max_value, self.fp16_min_value):
+            if value is not None and is_infinite(value):
+                self.is_normal = False
+                return
+        if self.fp16_has_inf:
+            self.is_normal = False
+            return
+        if self.fp16_has_nan:
+            self.is_normal = False
+            return
+        if self.fp32_max_value is not None and \
+                self.fp16_max_value is not None:
+            if not is_allclose(self.fp16_max_value, self.fp32_max_value) or \
+                    not is_allclose(self.fp16_min_value,
+                                    self.fp32_min_value):
+                self.is_normal = False
+
+    def __str__(self):
+        def fs(v):
+            return f"{v:.6f}" if v is not None else v
+
+        s = (f"[MixedPrecisionTensorInfo] op_type={self.op_type}, "
+             f"numel={self.numel}")
+        s += (f"\n  FP32: tensor_name={self.fp32_tensor_name}, "
+              f"dtype={self.fp32_dtype}, max_value={fs(self.fp32_max_value)},"
+              f" min_value={fs(self.fp32_min_value)}, "
+              f"mean_value={fs(self.fp32_mean_value)}")
+        s += (f"\n  FP16: tensor_name={self.fp16_tensor_name}, "
+              f"dtype={self.fp16_dtype}, max_value={fs(self.fp16_max_value)},"
+              f" min_value={fs(self.fp16_min_value)}, "
+              f"mean_value={fs(self.fp16_mean_value)}, "
+              f"has_inf={self.fp16_has_inf}, has_nan={self.fp16_has_nan}")
+        return s
+
+
+def parse_lines(lines, specified_op_list=None):
+    out = []
+    for line in lines:
+        if "[PRECISION]" not in line:
+            continue
+        info = TensorInfo()
+        info.init_from_string(line)
+        if specified_op_list is None or info.op_type in specified_op_list:
+            out.append(info)
+    return out
+
+
+def parse_log(log_dir, filename, specified_op_list=None):
+    if log_dir is None or filename is None:
+        return None, False
+    path = os.path.join(log_dir, filename)
+    try:
+        with open(path) as f:
+            infos = parse_lines(f.readlines(), specified_op_list)
+    except FileNotFoundError:
+        return None, False
+    has_name = any(i.tensor_name for i in infos)
+    return infos, has_name
+
+
+def merge_tensor_info_list(fp32_tensor_info_list, fp16_tensor_info_list,
+                           grad_scale):
+    """Join fp16 rows to their fp32 twins by op/tensor key with repeat
+    counting (reference :593)."""
+    mp = []
+    if fp16_tensor_info_list is not None:
+        fp32_dict, write_count = {}, {}
+        for info in (fp32_tensor_info_list or []):
+            k = info.key()
+            c = write_count.get(k, 0)
+            write_count[k] = c + 1
+            fp32_dict[f"{k}#{c}"] = info
+        read_count = {}
+        for fp16_info in fp16_tensor_info_list:
+            k = (fp16_info.key().replace(".cast_fp16", "")
+                 .replace(".cast_fp32", ""))
+            c = read_count.get(k, 0)
+            fp32_info = fp32_dict.get(f"{k}#{c}")
+            if fp32_info is not None:
+                read_count[k] = c + 1
+            mp.append(MixedPrecisionTensorInfo(fp32_info, fp16_info, c,
+                                               grad_scale))
+    elif fp32_tensor_info_list is not None:
+        count = {}
+        for info in fp32_tensor_info_list:
+            k = info.key()
+            c = count.get(k, 0)
+            count[k] = c + 1
+            mp.append(MixedPrecisionTensorInfo(info, None, c, grad_scale))
+    return mp
+
+
+_CSV_COLS = [
+    "op_type", "numel", "fp32_tensor_name", "fp32_dtype", "fp32_max_value",
+    "fp32_min_value", "fp32_mean_value", "fp16_tensor_name", "fp16_dtype",
+    "fp16_max_value", "fp16_min_value", "fp16_mean_value", "fp16_has_inf",
+    "fp16_has_nan", "fp32_div_fp16_max_value", "fp32_div_fp16_min_value",
+    "fp32_div_fp16_mean_value", "is_normal",
+]
+
+
+def compare_accuracy(dump_path, another_dump_path, output_filename,
+                     loss_scale=1, dump_all_tensors=False):
+    """Parse per-worker [PRECISION] logs from both dump dirs, join fp32/
+    fp16 rows, and write abnormal rows (all rows with dump_all_tensors)
+    to CSV. Returns {workerlog_name: [MixedPrecisionTensorInfo]}."""
+    import csv
+
+    grad_scale = loss_scale
+    worker_logs = sorted(n for n in os.listdir(dump_path) if "worker_" in n)
+    results = {}
+    with open(output_filename, "w", newline="") as out:
+        w = csv.writer(out)
+        w.writerow(["workerlog"] + _CSV_COLS)
+        for filename in worker_logs:
+            fp32_list, _ = parse_log(dump_path, filename)
+            fp16_list, _ = parse_log(another_dump_path, filename)
+            mp_list = merge_tensor_info_list(fp32_list, fp16_list,
+                                             grad_scale)
+            results[filename] = mp_list
+            for info in mp_list:
+                if info.is_normal and not dump_all_tensors:
+                    continue
+                w.writerow([filename] + [getattr(info, c) for c in _CSV_COLS])
+    return results
+
+
+# --------------------------------------------------------- tpu-native dumps
+@contextlib.contextmanager
+def tensor_stats_dump(log_dir, worker_id=0):
+    """Write a ``worker_{id}.log`` of [PRECISION] lines — one per eager op
+    output — under ``log_dir``, in the exact format ``parse_lines`` (and
+    the reference parser) reads. Drives the compare_accuracy workflow
+    inside this framework: run fp32 under this context, run amp O1/O2
+    under it with another dir, then ``compare_accuracy(dir1, dir2, csv)``.
+    """
+    import jax.numpy as jnp
+
+    from paddle_tpu.core import dispatch
+
+    os.makedirs(log_dir, exist_ok=True)
+    path = os.path.join(log_dir, f"worker_{worker_id}.log")
+    f = open(path, "a")
+    counts = {}
+
+    def _emit(name, out):
+        vals = out if isinstance(out, tuple) else (out,)
+        for j, v in enumerate(vals):
+            if not hasattr(v, "dtype") or \
+                    not jnp.issubdtype(v.dtype, jnp.inexact):
+                continue
+            import jax
+
+            if isinstance(v, jax.core.Tracer):
+                continue  # traced values have no concrete stats
+            i = counts.get(name, 0)
+            counts[name] = i + 1
+            a = np.asarray(v, np.float32)
+            f.write(
+                f"[PRECISION] [device=tpu] op={name}, "
+                f"tensor={name}_out{j}_{i}, dtype={jnp.dtype(v.dtype).name},"
+                f" numel={a.size}, num_inf={int(np.isinf(a).sum())}, "
+                f"num_nan={int(np.isnan(a).sum())}, "
+                f"num_zero={int((a == 0).sum())}, "
+                f"max={np.nanmax(np.where(np.isinf(a), np.nan, a)) if a.size else 0:.6f}, "
+                f"min={np.nanmin(np.where(np.isinf(a), np.nan, a)) if a.size else 0:.6f}, "
+                f"mean={np.nanmean(np.where(np.isinf(a), np.nan, a)) if a.size else 0:.6f}\n")
+
+    orig = dispatch._check_numerics
+
+    def hooked(name, out):
+        try:
+            _emit(name, out)
+        except Exception:
+            pass  # stats dump must never break the op
+        return orig(name, out)
+
+    dispatch._check_numerics = hooked
+    try:
+        yield path
+    finally:
+        dispatch._check_numerics = orig
+        f.close()

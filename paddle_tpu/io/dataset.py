@@ -147,3 +147,17 @@ class ConcatDataset(Dataset):
 
     def __len__(self):
         return self._total
+
+
+def require_local_file(path, default_name):
+    """Resolve a dataset archive path: explicit path or the cache default;
+    raise with the offline hint when absent (shared by text/vision
+    datasets)."""
+    import os
+
+    path = path or os.path.expanduser(f"~/.cache/paddle_tpu/{default_name}")
+    if not os.path.exists(path):
+        raise FileNotFoundError(
+            f"{path} not found (downloads unavailable offline; pass the "
+            "reference-format archive path explicitly)")
+    return path

@@ -161,3 +161,13 @@ def viterbi_decode(potentials, transition_params, lengths,
         return scores, paths.astype(jnp.int64)
 
     return apply("viterbi_decode", f, potentials, transition_params, lengths)
+
+
+# r5 corpus closure (reference python/paddle/text/datasets/__init__.py)
+from paddle_tpu.text.datasets import (  # noqa: E402,F401
+    Conll05st,
+    Imikolov,
+    Movielens,
+    WMT14,
+    WMT16,
+)

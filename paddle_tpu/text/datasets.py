@@ -1,0 +1,504 @@
+"""Text dataset corpus closure (reference: python/paddle/text/datasets/ —
+conll05.py, imikolov.py, movielens.py, wmt14.py, wmt16.py). Same archive
+formats and __getitem__ field contracts as the reference; archives load
+from local paths (no downloads offline — tests synthesize fixtures)."""
+
+from __future__ import annotations
+
+import collections
+import gzip
+import os
+import re
+import tarfile
+import zipfile
+
+import numpy as np
+
+from paddle_tpu.io.dataset import Dataset, require_local_file as _require
+
+__all__ = ["Conll05st", "Imikolov", "Movielens", "WMT14", "WMT16"]
+
+
+# ----------------------------------------------------------------- Conll05st
+class Conll05st(Dataset):
+    """CoNLL-2005 SRL (reference conll05.py:278 __getitem__ contract:
+    9-tuple of word ids, five predicate-context windows broadcast over the
+    sentence, predicate id, mark vector, BIO label ids)."""
+
+    UNK_IDX = 0
+
+    def __init__(self, data_file=None, word_dict_file=None,
+                 verb_dict_file=None, target_dict_file=None, emb_file=None,
+                 download=True):
+        self.data_file = _require(data_file, "conll05st-tests.tar.gz")
+        self.word_dict = self._load_dict(
+            _require(word_dict_file, "wordDict.txt"))
+        self.predicate_dict = self._load_dict(
+            _require(verb_dict_file, "verbDict.txt"))
+        self.label_dict = self._load_label_dict(
+            _require(target_dict_file, "targetDict.txt"))
+        self.emb_file = emb_file
+        self._load_anno()
+
+    @staticmethod
+    def _load_dict(path):
+        d = {}
+        with open(path, "rb") as f:
+            for i, line in enumerate(f):
+                d[line.strip().decode()] = i
+        return d
+
+    @staticmethod
+    def _load_label_dict(path):
+        """The reference expands raw prop tags to B-/I- pairs
+        (conll05.py load_label_dict)."""
+        d = {}
+        index = 0
+        with open(path, "rb") as f:
+            for line in f:
+                label = line.strip().decode()
+                if label.startswith("B-"):
+                    d[label] = index
+                    d["I-" + label[2:]] = index + 1
+                    index += 2
+                elif label == "O":
+                    d[label] = index
+                    index += 1
+                else:
+                    d["B-" + label] = index
+                    d["I-" + label] = index + 1
+                    index += 2
+        if "O" not in d:
+            d["O"] = index
+        return d
+
+    def _load_anno(self):
+        self.sentences, self.predicates, self.labels = [], [], []
+        with tarfile.open(self.data_file) as tf:
+            wf = tf.extractfile(
+                "conll05st-release/test.wsj/words/test.wsj.words.gz")
+            pf = tf.extractfile(
+                "conll05st-release/test.wsj/props/test.wsj.props.gz")
+            with gzip.GzipFile(fileobj=wf) as words, \
+                    gzip.GzipFile(fileobj=pf) as props:
+                sent, seg = [], []
+                for wline, pline in zip(words, props):
+                    word = wline.strip().decode()
+                    cols = pline.strip().decode().split()
+                    if not cols:  # sentence boundary
+                        self._finish_sentence(sent, seg)
+                        sent, seg = [], []
+                    else:
+                        sent.append(word)
+                        seg.append(cols)
+                if sent:
+                    self._finish_sentence(sent, seg)
+
+    def _finish_sentence(self, sent, seg):
+        if not seg:
+            return
+        n_cols = len(seg[0])
+        columns = [[row[c] for row in seg] for c in range(n_cols)]
+        verbs = [v for v in columns[0] if v != "-"]
+        for i, lbl_col in enumerate(columns[1:]):
+            cur, inside, seq = "O", False, []
+            for tok in lbl_col:
+                if tok == "*" and not inside:
+                    seq.append("O")
+                elif tok == "*" and inside:
+                    seq.append("I-" + cur)
+                elif tok == "*)":
+                    seq.append("I-" + cur)
+                    inside = False
+                elif "(" in tok and ")" in tok:
+                    cur = tok[1:tok.find("*")]
+                    seq.append("B-" + cur)
+                    inside = False
+                elif "(" in tok:
+                    cur = tok[1:tok.find("*")]
+                    seq.append("B-" + cur)
+                    inside = True
+                else:
+                    raise RuntimeError(f"Unexpected label: {tok}")
+            self.sentences.append(list(sent))
+            self.predicates.append(verbs[i])
+            self.labels.append(seq)
+
+    def __getitem__(self, idx):
+        sentence = self.sentences[idx]
+        predicate = self.predicates[idx]
+        labels = self.labels[idx]
+        sen_len = len(sentence)
+        verb_index = labels.index("B-V")
+        mark = [0] * len(labels)
+
+        def ctx(offset, boundary):
+            j = verb_index + offset
+            if 0 <= j < len(labels) and (offset >= 0 or verb_index >= -offset):
+                mark[j] = 1
+                return sentence[j]
+            return boundary
+
+        ctx_n2 = ctx(-2, "bos")
+        ctx_n1 = ctx(-1, "bos")
+        ctx_0 = ctx(0, "bos")
+        ctx_p1 = ctx(1, "eos")
+        ctx_p2 = ctx(2, "eos")
+
+        get = lambda w: self.word_dict.get(w, self.UNK_IDX)  # noqa: E731
+        word_idx = [get(w) for w in sentence]
+        return (
+            np.array(word_idx),
+            np.array([get(ctx_n2)] * sen_len),
+            np.array([get(ctx_n1)] * sen_len),
+            np.array([get(ctx_0)] * sen_len),
+            np.array([get(ctx_p1)] * sen_len),
+            np.array([get(ctx_p2)] * sen_len),
+            np.array([self.predicate_dict.get(predicate)] * sen_len),
+            np.array(mark),
+            np.array([self.label_dict.get(l) for l in labels]),
+        )
+
+    def __len__(self):
+        return len(self.sentences)
+
+    def get_dict(self):
+        return self.word_dict, self.predicate_dict, self.label_dict
+
+    def get_embedding(self):
+        return self.emb_file
+
+
+# ------------------------------------------------------------------ Imikolov
+class Imikolov(Dataset):
+    """PTB language-model dataset (reference imikolov.py): 'NGRAM' windows
+    or 'SEQ' (src, trg) pairs over <s>/<e>-wrapped sentences; vocabulary
+    from train+valid with min_word_freq cutoff, '<unk>' last."""
+
+    def __init__(self, data_file=None, data_type="NGRAM", window_size=-1,
+                 mode="train", min_word_freq=50, download=True):
+        data_type = data_type.upper()
+        assert data_type in ("NGRAM", "SEQ"), \
+            f"data_type must be NGRAM or SEQ, got {data_type}"
+        if data_type == "NGRAM":
+            assert window_size > 0, "window_size must be > 0 for NGRAM"
+        assert mode in ("train", "test"), mode
+        self.data_file = _require(data_file, "simple-examples.tgz")
+        self.data_type = data_type
+        self.window_size = window_size
+        self.mode = mode
+        self.min_word_freq = min_word_freq
+        with tarfile.open(self.data_file) as tf:
+            self.word_idx = self._build_dict(tf)
+            self._load(tf)
+
+    _TRAIN = "./simple-examples/data/ptb.train.txt"
+    _VALID = "./simple-examples/data/ptb.valid.txt"
+
+    def _count(self, f, freq):
+        for line in f:
+            for w in line.strip().split():
+                freq[w.decode() if isinstance(w, bytes) else w] += 1
+            freq["<s>"] += 1
+            freq["<e>"] += 1
+        return freq
+
+    def _build_dict(self, tf):
+        freq = collections.defaultdict(int)
+        self._count(tf.extractfile(self._TRAIN), freq)
+        self._count(tf.extractfile(self._VALID), freq)
+        freq.pop("<unk>", None)
+        kept = [kv for kv in freq.items() if kv[1] > self.min_word_freq]
+        kept = sorted(kept, key=lambda kv: (-kv[1], kv[0]))
+        word_idx = {w: i for i, (w, _) in enumerate(kept)}
+        word_idx["<unk>"] = len(kept)
+        return word_idx
+
+    def _load(self, tf):
+        path = self._TRAIN if self.mode == "train" else self._VALID
+        unk = self.word_idx["<unk>"]
+        self.data = []
+        for line in tf.extractfile(path):
+            line = line.decode() if isinstance(line, bytes) else line
+            words = ["<s>"] + line.strip().split() + ["<e>"]
+            ids = [self.word_idx.get(w, unk) for w in words]
+            if self.data_type == "NGRAM":
+                if len(ids) >= self.window_size:
+                    for i in range(self.window_size, len(ids) + 1):
+                        self.data.append(tuple(ids[i - self.window_size:i]))
+            else:
+                self.data.append((ids[:-1], ids[1:]))
+
+    def __getitem__(self, idx):
+        return tuple(np.array(d) for d in self.data[idx])
+
+    def __len__(self):
+        return len(self.data)
+
+
+# ----------------------------------------------------------------- Movielens
+_AGE_TABLE = [1, 18, 25, 35, 45, 50, 56]
+
+
+class MovieInfo:
+    """Movie id, title and categories (reference movielens.py:31)."""
+
+    def __init__(self, index, categories, title):
+        self.index = int(index)
+        self.categories = categories
+        self.title = title
+
+    def value(self, categories_dict, movie_title_dict):
+        return [
+            [self.index],
+            [categories_dict[c] for c in self.categories],
+            [movie_title_dict[w.lower()] for w in self.title.split()],
+        ]
+
+    def __str__(self):
+        return (f"<MovieInfo id({self.index}), title({self.title}), "
+                f"categories({self.categories})>")
+
+    __repr__ = __str__
+
+
+class UserInfo:
+    """User id, gender, age bucket and job (reference movielens.py:62)."""
+
+    def __init__(self, index, gender, age, job_id):
+        self.index = int(index)
+        self.is_male = gender == "M"
+        self.age = _AGE_TABLE.index(int(age))
+        self.job_id = int(job_id)
+
+    def value(self):
+        return [[self.index], [0 if self.is_male else 1], [self.age],
+                [self.job_id]]
+
+    def __str__(self):
+        return (f"<UserInfo id({self.index}), "
+                f"gender({'M' if self.is_male else 'F'}), "
+                f"age({_AGE_TABLE[self.age]}), job({self.job_id})>")
+
+    __repr__ = __str__
+
+
+class Movielens(Dataset):
+    """ML-1M ratings (reference movielens.py): each item is user features
+    + movie features + [rating], rating rescaled to [-5, 5] via r*2-5."""
+
+    def __init__(self, data_file=None, mode="train", test_ratio=0.1,
+                 rand_seed=0, download=True):
+        self.data_file = _require(data_file, "ml-1m.zip")
+        self.mode = mode
+        self.test_ratio = test_ratio
+        np.random.seed(rand_seed)
+        self._load_meta_info()
+        self._load_data()
+
+    def _load_meta_info(self):
+        pattern = re.compile(r"^(.*)\((\d+)\)$")
+        self.movie_info, self.user_info = {}, {}
+        self.movie_title_dict, self.categories_dict = {}, {}
+        title_words, categories = set(), set()
+        with zipfile.ZipFile(self.data_file) as pkg:
+            with pkg.open("ml-1m/movies.dat") as f:
+                for line in f:
+                    line = line.decode("latin")
+                    movie_id, title, cats = line.strip().split("::")
+                    cats = cats.split("|")
+                    categories.update(cats)
+                    m = pattern.match(title)
+                    title = m.group(1) if m else title
+                    self.movie_info[int(movie_id)] = MovieInfo(
+                        index=movie_id, categories=cats, title=title)
+                    title_words.update(w.lower() for w in title.split())
+            # sorted for determinism (the reference iterates a set —
+            # id assignment there is hash-order; the CONTRACT is only
+            # "a dense id per word/category", which sorting satisfies)
+            self.movie_title_dict = {w: i for i, w in
+                                     enumerate(sorted(title_words))}
+            self.categories_dict = {c: i for i, c in
+                                    enumerate(sorted(categories))}
+            with pkg.open("ml-1m/users.dat") as f:
+                for line in f:
+                    line = line.decode("latin")
+                    uid, gender, age, job, _ = line.strip().split("::")
+                    self.user_info[int(uid)] = UserInfo(
+                        index=uid, gender=gender, age=age, job_id=job)
+
+    def _load_data(self):
+        self.data = []
+        is_test = self.mode == "test"
+        with zipfile.ZipFile(self.data_file) as pkg:
+            with pkg.open("ml-1m/ratings.dat") as f:
+                for line in f:
+                    line = line.decode("latin")
+                    if (np.random.random() < self.test_ratio) != is_test:
+                        continue
+                    uid, mov_id, rating, _ = line.strip().split("::")
+                    mov = self.movie_info[int(mov_id)]
+                    usr = self.user_info[int(uid)]
+                    self.data.append(
+                        usr.value()
+                        + mov.value(self.categories_dict,
+                                    self.movie_title_dict)
+                        + [[float(rating) * 2 - 5.0]])
+
+    def __getitem__(self, idx):
+        return tuple(np.array(d) for d in self.data[idx])
+
+    def __len__(self):
+        return len(self.data)
+
+
+# --------------------------------------------------------------------- WMT14
+START = "<s>"
+END = "<e>"
+UNK = "<unk>"
+UNK_IDX = 2
+
+
+class WMT14(Dataset):
+    """WMT14 en→fr (reference wmt14.py): tarball with {mode}/{mode}
+    tab-separated pairs + src.dict/trg.dict; items are
+    (src_ids, trg_ids, trg_ids_next)."""
+
+    def __init__(self, data_file=None, mode="train", dict_size=-1,
+                 download=True):
+        assert mode in ("train", "test", "gen"), mode
+        assert dict_size > 0, "dict_size should be set as positive number"
+        self.data_file = _require(data_file, "wmt14.tgz")
+        self.mode = mode
+        self.dict_size = dict_size
+        self._load_data()
+
+    def _load_data(self):
+        def to_dict(fd, size):
+            out = {}
+            for i, line in enumerate(fd):
+                if i >= size:
+                    break
+                out[line.strip().decode()] = i
+            return out
+
+        self.src_ids, self.trg_ids, self.trg_ids_next = [], [], []
+        with tarfile.open(self.data_file) as f:
+            names = [m.name for m in f if m.name.endswith("src.dict")]
+            assert len(names) == 1
+            self.src_dict = to_dict(f.extractfile(names[0]), self.dict_size)
+            names = [m.name for m in f if m.name.endswith("trg.dict")]
+            assert len(names) == 1
+            self.trg_dict = to_dict(f.extractfile(names[0]), self.dict_size)
+            suffix = f"{self.mode}/{self.mode}"
+            for name in [m.name for m in f if m.name.endswith(suffix)]:
+                for line in f.extractfile(name):
+                    parts = line.decode().strip().split("\t")
+                    if len(parts) != 2:
+                        continue
+                    src = [self.src_dict.get(w, UNK_IDX)
+                           for w in parts[0].split()]
+                    trg = [self.trg_dict.get(w, UNK_IDX)
+                           for w in parts[1].split()]
+                    self.src_ids.append(
+                        [self.src_dict[START]] + src + [self.src_dict[END]])
+                    self.trg_ids.append([self.trg_dict[START]] + trg)
+                    self.trg_ids_next.append(trg + [self.trg_dict[END]])
+
+    def __getitem__(self, idx):
+        return (np.array(self.src_ids[idx]), np.array(self.trg_ids[idx]),
+                np.array(self.trg_ids_next[idx]))
+
+    def __len__(self):
+        return len(self.src_ids)
+
+    def get_dict(self, reverse=False):
+        src = {v: k for k, v in self.src_dict.items()} if reverse \
+            else dict(self.src_dict)
+        trg = {v: k for k, v in self.trg_dict.items()} if reverse \
+            else dict(self.trg_dict)
+        return src, trg
+
+
+class WMT16(Dataset):
+    """WMT16 de↔en (reference wmt16.py): tarball wmt16/{train,test,val}
+    tab-separated de\\ten pairs; dictionaries built from train with
+    <s>/<e>/<unk> reserved; items are (src_ids, trg_ids, trg_ids_next)."""
+
+    def __init__(self, data_file=None, mode="train", src_dict_size=-1,
+                 trg_dict_size=-1, lang="en", download=True):
+        assert mode in ("train", "test", "val"), mode
+        assert lang in ("en", "de"), lang
+        self.data_file = _require(data_file, "wmt16.tar.gz")
+        self.mode = mode
+        self.lang = lang
+        self.src_dict_size = min(src_dict_size, self._vocab_limit(lang)) \
+            if src_dict_size > 0 else src_dict_size
+        trg_lang = "de" if lang == "en" else "en"
+        self.trg_dict_size = min(trg_dict_size, self._vocab_limit(trg_lang)) \
+            if trg_dict_size > 0 else trg_dict_size
+        assert self.src_dict_size > 3 and self.trg_dict_size > 3, \
+            "dict sizes must exceed the 3 reserved marks"
+        with tarfile.open(self.data_file) as tf:
+            # ONE pass over wmt16/train counts both language columns (the
+            # real corpus is hundreds of MB of gzip — re-decompressing per
+            # dictionary would triple construction time)
+            freqs = self._count_both(tf)
+            self.src_dict = self._freq_to_dict(freqs[lang],
+                                               self.src_dict_size)
+            self.trg_dict = self._freq_to_dict(freqs[trg_lang],
+                                               self.trg_dict_size)
+            self._load_data(tf)
+
+    def _vocab_limit(self, lang):
+        # reference TOTAL_EN_WORDS/TOTAL_DE_WORDS caps
+        return 11250 if lang == "en" else 19220
+
+    @staticmethod
+    def _count_both(tf):
+        freqs = {"en": collections.defaultdict(int),
+                 "de": collections.defaultdict(int)}
+        for line in tf.extractfile("wmt16/train"):
+            parts = line.decode().strip().split("\t")
+            if len(parts) != 2:
+                continue
+            for w in parts[0].split():
+                freqs["en"][w] += 1
+            for w in parts[1].split():
+                freqs["de"][w] += 1
+        return freqs
+
+    @staticmethod
+    def _freq_to_dict(freq, size):
+        words = sorted(freq.items(), key=lambda kv: (-kv[1], kv[0]))
+        vocab = [START, END, UNK] + [w for w, _ in words[: size - 3]]
+        return {w: i for i, w in enumerate(vocab)}
+
+    def _load_data(self, f):
+        start_id, end_id = self.src_dict[START], self.src_dict[END]
+        unk_id = self.src_dict[UNK]
+        src_col = 0 if self.lang == "en" else 1
+        trg_col = 1 - src_col
+        self.src_ids, self.trg_ids, self.trg_ids_next = [], [], []
+        for line in f.extractfile(f"wmt16/{self.mode}"):
+            parts = line.decode().strip().split("\t")
+            if len(parts) != 2:
+                continue
+            src = [self.src_dict.get(w, unk_id)
+                   for w in parts[src_col].split()]
+            trg = [self.trg_dict.get(w, unk_id)
+                   for w in parts[trg_col].split()]
+            self.src_ids.append([start_id] + src + [end_id])
+            self.trg_ids.append([start_id] + trg)
+            self.trg_ids_next.append(trg + [end_id])
+
+    def __getitem__(self, idx):
+        return (np.array(self.src_ids[idx]), np.array(self.trg_ids[idx]),
+                np.array(self.trg_ids_next[idx]))
+
+    def __len__(self):
+        return len(self.src_ids)
+
+    def get_dict(self, lang, reverse=False):
+        d = self.src_dict if lang == self.lang else self.trg_dict
+        return {v: k for k, v in d.items()} if reverse else dict(d)

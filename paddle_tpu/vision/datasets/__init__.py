@@ -159,3 +159,239 @@ class Cifar100(Cifar10):
 
     def _label_key(self):
         return b"fine_labels"
+
+
+# --- r5 corpus closure: Flowers / VOC2012 / DatasetFolder / ImageFolder ----
+IMG_EXTENSIONS = (".jpg", ".jpeg", ".png", ".ppm", ".bmp", ".pgm", ".tif",
+                  ".tiff", ".webp")
+
+
+def _pil_loader(path):
+    from PIL import Image
+
+    with open(path, "rb") as f:
+        img = Image.open(f)
+        return img.convert("RGB")
+
+
+def has_valid_extension(filename, extensions):
+    """reference folder.py is_valid_file check."""
+    return filename.lower().endswith(tuple(extensions))
+
+
+def make_dataset(directory, class_to_idx, extensions=None,
+                 is_valid_file=None):
+    """(path, class_index) samples from a class-per-subdir tree
+    (reference folder.py:43)."""
+    samples = []
+    directory = os.path.expanduser(directory)
+    if (extensions is None) == (is_valid_file is None):
+        raise ValueError(
+            "Both extensions and is_valid_file cannot be None or not "
+            "None at the same time")
+    if is_valid_file is None:
+        def is_valid_file(fn):
+            return has_valid_extension(fn, extensions)
+    for target in sorted(class_to_idx.keys()):
+        d = os.path.join(directory, target)
+        if not os.path.isdir(d):
+            continue
+        for root, _, fnames in sorted(os.walk(d, followlinks=True)):
+            for fname in sorted(fnames):
+                path = os.path.join(root, fname)
+                if is_valid_file(path):
+                    samples.append((path, class_to_idx[target]))
+    return samples
+
+
+class DatasetFolder(Dataset):
+    """Class-per-subdirectory loader (reference folder.py:207):
+    root/class_x/xxx.ext -> (sample, class_index)."""
+
+    def __init__(self, root, loader=None, extensions=None, transform=None,
+                 is_valid_file=None):
+        self.root = root
+        self.transform = transform
+        if extensions is None and is_valid_file is None:
+            extensions = IMG_EXTENSIONS
+        classes, class_to_idx = self._find_classes(root)
+        samples = make_dataset(root, class_to_idx, extensions, is_valid_file)
+        if not samples:
+            raise RuntimeError(
+                f"Found 0 directories in subfolders of: {root}\n"
+                "Supported extensions are: "
+                + ",".join(extensions or []))
+        self.loader = loader if loader is not None else _pil_loader
+        self.extensions = extensions
+        self.classes = classes
+        self.class_to_idx = class_to_idx
+        self.samples = samples
+        self.targets = [s[1] for s in samples]
+        self.dtype = "float32"
+
+    @staticmethod
+    def _find_classes(directory):
+        classes = sorted(e.name for e in os.scandir(directory)
+                         if e.is_dir())
+        return classes, {c: i for i, c in enumerate(classes)}
+
+    def __getitem__(self, index):
+        path, target = self.samples[index]
+        sample = self.loader(path)
+        if self.transform is not None:
+            sample = self.transform(sample)
+        return sample, target
+
+    def __len__(self):
+        return len(self.samples)
+
+
+class ImageFolder(Dataset):
+    """Flat/recursive image loader without labels (reference
+    folder.py:434): samples are paths, items are [image]."""
+
+    def __init__(self, root, loader=None, extensions=None, transform=None,
+                 is_valid_file=None):
+        self.root = root
+        self.transform = transform
+        if extensions is None and is_valid_file is None:
+            extensions = IMG_EXTENSIONS
+        if is_valid_file is None:
+            def is_valid_file(fn):
+                return has_valid_extension(fn, extensions)
+        samples = []
+        for dirpath, _, fnames in sorted(os.walk(root, followlinks=True)):
+            for fname in sorted(fnames):
+                path = os.path.join(dirpath, fname)
+                if is_valid_file(path):
+                    samples.append(path)
+        if not samples:
+            raise RuntimeError(
+                f"Found 0 files in subfolders of: {root}\n"
+                "Supported extensions are: " + ",".join(extensions or []))
+        self.loader = loader if loader is not None else _pil_loader
+        self.extensions = extensions
+        self.samples = samples
+
+    def __getitem__(self, index):
+        path = self.samples[index]
+        sample = self.loader(path)
+        if self.transform is not None:
+            sample = self.transform(sample)
+        return [sample]
+
+    def __len__(self):
+        return len(self.samples)
+
+
+class Flowers(Dataset):
+    """Oxford 102 Flowers (reference flowers.py:108): 102flowers.tgz +
+    imagelabels.mat + setid.mat; mode selects the reference's swapped
+    train/test id sets (tstid for train)."""
+
+    def __init__(self, data_file=None, label_file=None, setid_file=None,
+                 mode="train", transform=None, download=True, backend=None):
+        import scipy.io as scio
+
+        assert mode.lower() in ("train", "valid", "test"), mode
+        if backend is None:
+            backend = "pil"
+        if backend not in ("pil", "cv2"):
+            raise ValueError(f"Expected backend 'pil' or 'cv2', got "
+                             f"{backend}")
+        self.backend = backend
+        # official readme: tstid flags TRAIN data (more of it), trnid TEST
+        flag = {"train": "tstid", "valid": "valid",
+                "test": "trnid"}[mode.lower()]
+
+        from paddle_tpu.io.dataset import require_local_file
+
+        self.data_file = require_local_file(data_file, "102flowers.tgz")
+        label_file = require_local_file(label_file, "imagelabels.mat")
+        setid_file = require_local_file(setid_file, "setid.mat")
+        self.transform = transform
+        self._tar = tarfile.open(self.data_file)
+        self._members = {m.name: m for m in self._tar.getmembers()}
+        self.labels = scio.loadmat(label_file)["labels"][0]
+        self.indexes = scio.loadmat(setid_file)[flag][0]
+
+    def __getitem__(self, idx):
+        import io as _io
+
+        from PIL import Image
+
+        index = int(self.indexes[idx])
+        label = np.array([self.labels[index - 1]])
+        img_name = "jpg/image_%05d.jpg" % index
+        data = self._tar.extractfile(self._members[img_name]).read()
+        image = Image.open(_io.BytesIO(data))
+        if self.backend == "cv2":
+            image = np.array(image)
+        if self.transform is not None:
+            image = self.transform(image)
+        return image, label.astype("int64")
+
+    def __len__(self):
+        return len(self.indexes)
+
+
+class VOC2012(Dataset):
+    """Pascal VOC2012 segmentation (reference voc2012.py): items are
+    (image, label_mask) read from the devkit tarball via the
+    ImageSets/Segmentation/{mode}.txt index."""
+
+    SET_FILE = "VOCdevkit/VOC2012/ImageSets/Segmentation/{}.txt"
+    DATA_FILE = "VOCdevkit/VOC2012/JPEGImages/{}.jpg"
+    LABEL_FILE = "VOCdevkit/VOC2012/SegmentationClass/{}.png"
+
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 download=True, backend=None):
+        assert mode.lower() in ("train", "valid", "test"), mode
+        if backend is None:
+            backend = "pil"
+        if backend not in ("pil", "cv2"):
+            raise ValueError(f"Expected backend 'pil' or 'cv2', got "
+                             f"{backend}")
+        self.backend = backend
+        self.transform = transform
+        self.dtype = "float32"
+        from paddle_tpu.io.dataset import require_local_file
+
+        data_file = require_local_file(data_file,
+                                       "VOCtrainval_11-May-2012.tar")
+        mode_key = {"train": "train", "valid": "val", "test": "val"}[
+            mode.lower()]
+        self.data_tar = tarfile.open(data_file)
+        self.name2mem = {m.name: m for m in self.data_tar.getmembers()}
+        self.data, self.labels = [], []
+        listing = self.data_tar.extractfile(
+            self.name2mem[self.SET_FILE.format(mode_key)])
+        for line in listing:
+            name = line.decode().strip()
+            if not name:
+                continue
+            self.data.append(self.DATA_FILE.format(name))
+            self.labels.append(self.LABEL_FILE.format(name))
+
+    def __getitem__(self, idx):
+        import io as _io
+
+        from PIL import Image
+
+        data = self.data_tar.extractfile(
+            self.name2mem[self.data[idx]]).read()
+        label = self.data_tar.extractfile(
+            self.name2mem[self.labels[idx]]).read()
+        data = Image.open(_io.BytesIO(data))
+        label = Image.open(_io.BytesIO(label))
+        if self.backend == "cv2":
+            data = np.array(data)
+            label = np.array(label)
+        if self.transform is not None:
+            data = self.transform(data)
+        if self.backend == "cv2":
+            return data.astype(self.dtype), label.astype(self.dtype)
+        return data, label
+
+    def __len__(self):
+        return len(self.data)

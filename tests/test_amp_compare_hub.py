@@ -1,0 +1,133 @@
+"""r5: amp accuracy_compare workflow + hub remote resolution (VERDICT r4
+missing #6/#7). accuracy_compare drives the full fp32-vs-O1 dump/compare
+loop; hub github/gitee paths resolve through the pre-seeded cache (the
+offline-friendly shim)."""
+
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.amp.accuracy_compare import (
+    MixedPrecisionTensorInfo,
+    TensorInfo,
+    compare_accuracy,
+    is_allclose,
+    is_infinite,
+    merge_tensor_info_list,
+    parse_lines,
+    tensor_stats_dump,
+)
+
+
+def test_tensorinfo_parses_reference_line_format():
+    line = ("[PRECISION] [device=gpu] op=matmul, tensor=x.cast_fp16, "
+            "dtype=float16, numel=64, num_inf=0, num_nan=0, num_zero=2, "
+            "max=3.5, min=-1.25, mean=0.5")
+    infos = parse_lines([line, "noise line"])
+    assert len(infos) == 1
+    ti = infos[0]
+    assert ti.op_type == "matmul" and ti.tensor_name == "x.cast_fp16"
+    assert ti.numel == 64 and ti.num_zero == 2
+    assert float(ti.max_value) == 3.5
+    assert ti.key() == "matmul/x.cast_fp16"
+
+
+def test_is_infinite_and_allclose():
+    assert is_infinite(1e5)          # overflows fp16
+    assert not is_infinite(100.0)
+    assert is_allclose(1.0, 1.005)
+    assert not is_allclose(1.0, 2.0)
+
+
+def _mk_info(op, tensor, maxv, minv, has_inf=0, has_nan=0, numel=8):
+    ti = TensorInfo()
+    ti.op_type = op
+    ti.tensor_name = tensor
+    ti.dtype = "float32"
+    ti.numel = np.int64(numel)
+    ti.max_value = np.float32(maxv)
+    ti.min_value = np.float32(minv)
+    ti.mean_value = np.float32((maxv + minv) / 2)
+    ti.has_inf = np.int64(has_inf)
+    ti.has_nan = np.int64(has_nan)
+    ti.num_zero = np.int64(0)
+    return ti
+
+
+def test_merge_flags_divergence_and_overflow():
+    fp32 = [_mk_info("matmul", "out", 2.0, -2.0),
+            _mk_info("exp", "out", 50.0, 0.0)]
+    fp16 = [_mk_info("matmul", "out.cast_fp16", 2.0, -2.0),
+            _mk_info("exp", "out.cast_fp16", 70000.0, 0.0, has_inf=1)]
+    merged = merge_tensor_info_list(fp32, fp16, grad_scale=1.0)
+    assert len(merged) == 2
+    ok, bad = merged
+    assert ok.is_normal  # matched stats
+    assert not bad.is_normal  # fp16 overflow + inf
+    assert isinstance(bad, MixedPrecisionTensorInfo)
+    assert bad.fp32_div_fp16_max_value > 100  # divergence ratio visible
+
+
+def test_full_dump_compare_loop(tmp_path):
+    fp32_dir = str(tmp_path / "fp32")
+    fp16_dir = str(tmp_path / "fp16")
+    paddle.seed(0)
+    m = nn.Linear(8, 8)
+    x = paddle.to_tensor(np.random.default_rng(0)
+                         .standard_normal((4, 8)).astype(np.float32))
+    with tensor_stats_dump(fp32_dir):
+        _ = m(x)
+    with tensor_stats_dump(fp16_dir):
+        with paddle.amp.auto_cast(level="O1"):
+            _ = m(x)
+    out_csv = str(tmp_path / "cmp.csv")
+    res = compare_accuracy(fp32_dir, fp16_dir, out_csv,
+                           dump_all_tensors=True)
+    assert "worker_0.log" in res and len(res["worker_0.log"]) >= 1
+    rows = open(out_csv).read().splitlines()
+    assert rows[0].startswith("workerlog,op_type")
+    assert len(rows) >= 2
+
+
+# ------------------------------------------------------------------- hub
+HUBCONF = '''
+def small_model(scale=1.0):
+    """A tiny test entrypoint."""
+    return {"name": "small_model", "scale": scale}
+'''
+
+
+def test_hub_local_and_remote_cache(tmp_path, monkeypatch):
+    from paddle_tpu import hub
+
+    # local source
+    local = tmp_path / "repo"
+    local.mkdir()
+    (local / "hubconf.py").write_text(HUBCONF)
+    assert "small_model" in hub.list(str(local), source="local")
+    assert "tiny test" in hub.help(str(local), "small_model")
+    out = hub.load(str(local), "small_model", scale=2.0)
+    assert out == {"name": "small_model", "scale": 2.0}
+
+    # remote github source resolved from the pre-seeded cache (offline)
+    monkeypatch.setattr(hub, "HUB_DIR", str(tmp_path / "hubcache"))
+    seeded = tmp_path / "hubcache" / "owner_repo_main"
+    os.makedirs(seeded)
+    (seeded / "hubconf.py").write_text(HUBCONF)
+    assert "small_model" in hub.list("owner/repo", source="github")
+    m = hub.load("owner/repo:main", "small_model", source="github")
+    assert m["name"] == "small_model"
+    # gitee default branch is master
+    seeded2 = tmp_path / "hubcache" / "owner_repo_master"
+    os.makedirs(seeded2)
+    (seeded2 / "hubconf.py").write_text(HUBCONF)
+    assert "small_model" in hub.list("owner/repo", source="gitee")
+
+    # cache miss offline -> actionable error naming the cache path
+    with pytest.raises(RuntimeError, match="pre-seed"):
+        hub.list("owner/missing", source="github")
+    with pytest.raises(ValueError):
+        hub.list("owner/repo", source="bogus")

@@ -1,0 +1,308 @@
+"""r5 dataset corpus closure (VERDICT r4 missing #3): the reference's
+field contracts for Conll05st/Imikolov/Movielens/WMT14/WMT16 and
+Flowers/VOC2012/DatasetFolder/ImageFolder, exercised against synthesized
+fixtures in the reference archive formats (offline-friendly)."""
+
+import gzip
+import io
+import os
+import tarfile
+import zipfile
+
+import numpy as np
+import pytest
+
+from paddle_tpu.text import WMT14, WMT16, Conll05st, Imikolov, Movielens
+from paddle_tpu.vision.datasets import (
+    DatasetFolder,
+    Flowers,
+    ImageFolder,
+    VOC2012,
+)
+
+
+def _add_bytes(tf, name, data):
+    info = tarfile.TarInfo(name)
+    info.size = len(data)
+    tf.addfile(info, io.BytesIO(data))
+
+
+# ------------------------------------------------------------------- conll05
+@pytest.fixture
+def conll_files(tmp_path):
+    words = b"The\ncat\nsat\n\n"
+    # one predicate column: 'sat' is the verb, 'The cat' is A0
+    props = b"-\t(A0*\n-\t*)\nsat\t(V*)\n\n"
+    buf = io.BytesIO()
+    with tarfile.open(fileobj=buf, mode="w:gz") as tf:
+        _add_bytes(tf, "conll05st-release/test.wsj/words/test.wsj.words.gz",
+                   gzip.compress(words))
+        _add_bytes(tf, "conll05st-release/test.wsj/props/test.wsj.props.gz",
+                   gzip.compress(props))
+    data = tmp_path / "conll.tgz"
+    data.write_bytes(buf.getvalue())
+    (tmp_path / "words.txt").write_text("The\ncat\nsat\n")
+    (tmp_path / "verbs.txt").write_text("sat\n")
+    (tmp_path / "targets.txt").write_text("A0\nV\nO\n")
+    return data, tmp_path
+
+
+def test_conll05st_contract(conll_files):
+    data, d = conll_files
+    ds = Conll05st(data_file=str(data),
+                   word_dict_file=str(d / "words.txt"),
+                   verb_dict_file=str(d / "verbs.txt"),
+                   target_dict_file=str(d / "targets.txt"))
+    assert len(ds) == 1
+    item = ds[0]
+    assert len(item) == 9  # reference conll05.py:278 9-tuple
+    word_idx, n2, n1, c0, p1, p2, pred, mark, label = item
+    assert word_idx.tolist() == [0, 1, 2]
+    # verb at position 2: ctx_0 is 'sat'(2); n1='cat'(1); n2='The'(0)
+    assert c0.tolist() == [2, 2, 2]
+    assert n1.tolist() == [1, 1, 1]
+    assert n2.tolist() == [0, 0, 0]
+    assert mark.tolist() == [1, 1, 1]
+    word_dict, verb_dict, label_dict = ds.get_dict()
+    assert verb_dict == {"sat": 0}
+    # labels: B-A0 I-A0 B-V expanded ids
+    assert label.tolist() == [label_dict["B-A0"], label_dict["I-A0"],
+                              label_dict["B-V"]]
+
+
+# ------------------------------------------------------------------ imikolov
+@pytest.fixture
+def ptb_tar(tmp_path):
+    train = b"a b a b a\nb a b a c\n" * 5
+    valid = b"a b c\n" * 3
+    buf = io.BytesIO()
+    with tarfile.open(fileobj=buf, mode="w:gz") as tf:
+        _add_bytes(tf, "./simple-examples/data/ptb.train.txt", train)
+        _add_bytes(tf, "./simple-examples/data/ptb.valid.txt", valid)
+    p = tmp_path / "simple-examples.tgz"
+    p.write_bytes(buf.getvalue())
+    return p
+
+
+def test_imikolov_ngram_and_seq(ptb_tar):
+    ds = Imikolov(data_file=str(ptb_tar), data_type="NGRAM", window_size=2,
+                  mode="train", min_word_freq=1)
+    assert len(ds) > 0
+    item = ds[0]
+    assert len(item) == 2 and all(x.shape == () for x in item)
+    # every id within vocab
+    vocab_n = len(ds.word_idx)
+    flat = [int(x) for it in (ds[i] for i in range(len(ds))) for x in it]
+    assert max(flat) < vocab_n
+    assert "<unk>" in ds.word_idx and ds.word_idx["<unk>"] == vocab_n - 1
+
+    seq = Imikolov(data_file=str(ptb_tar), data_type="SEQ", mode="test",
+                   min_word_freq=1)
+    src, trg = seq[0]
+    # SEQ contract: src = <s>+ids, trg = ids+<e>, shifted by one
+    assert src.shape == trg.shape
+    assert src[0] == seq.word_idx["<s>"]
+    assert trg[-1] == seq.word_idx["<e>"]
+    np.testing.assert_array_equal(src[1:], trg[:-1])
+
+    with pytest.raises(AssertionError):
+        Imikolov(data_file=str(ptb_tar), data_type="NGRAM", window_size=-1)
+
+
+# ----------------------------------------------------------------- movielens
+@pytest.fixture
+def ml_zip(tmp_path):
+    movies = ("1::Toy Story (1995)::Animation|Comedy\n"
+              "2::Jumanji (1995)::Adventure\n").encode("latin-1")
+    users = ("1::F::1::10::48067\n2::M::25::16::70072\n").encode("latin-1")
+    ratings = ("1::1::5::978300760\n1::2::3::978302109\n"
+               "2::1::4::978301968\n").encode("latin-1")
+    p = tmp_path / "ml-1m.zip"
+    with zipfile.ZipFile(p, "w") as z:
+        z.writestr("ml-1m/movies.dat", movies)
+        z.writestr("ml-1m/users.dat", users)
+        z.writestr("ml-1m/ratings.dat", ratings)
+    return p
+
+
+def test_movielens_contract(ml_zip):
+    ds = Movielens(data_file=str(ml_zip), mode="train", test_ratio=0.0)
+    assert len(ds) == 3
+    item = ds[0]
+    # usr(4) + movie(3) + rating(1) = 8 arrays
+    assert len(item) == 8
+    uid, gender, age, job, mid, cats, title, rating = item
+    assert uid.tolist() == [1]
+    assert gender.tolist() == [1]  # F -> 1
+    assert age.tolist() == [0]     # age 1 -> bucket 0
+    assert job.tolist() == [10]
+    assert mid.tolist() == [1]
+    assert len(cats) == 2          # Animation|Comedy
+    assert len(title) == 2         # "Toy Story"
+    assert rating.tolist() == [5.0 * 2 - 5.0]
+    # test split empty at ratio 0
+    assert len(Movielens(data_file=str(ml_zip), mode="test",
+                         test_ratio=0.0)) == 0
+
+
+# ---------------------------------------------------------------- wmt14 / 16
+@pytest.fixture
+def wmt14_tar(tmp_path):
+    dict_txt = b"<s>\n<e>\n<unk>\nhello\nworld\n"
+    pairs = b"hello world\tbonjour monde\nhello\tbonjour\n"
+    buf = io.BytesIO()
+    with tarfile.open(fileobj=buf, mode="w:gz") as tf:
+        _add_bytes(tf, "wmt14/src.dict", dict_txt)
+        _add_bytes(tf, "wmt14/trg.dict",
+                   b"<s>\n<e>\n<unk>\nbonjour\nmonde\n")
+        _add_bytes(tf, "wmt14/train/train", pairs)
+        _add_bytes(tf, "wmt14/test/test", pairs[:25])
+    p = tmp_path / "wmt14.tgz"
+    p.write_bytes(buf.getvalue())
+    return p
+
+
+def test_wmt14_contract(wmt14_tar):
+    ds = WMT14(data_file=str(wmt14_tar), mode="train", dict_size=5)
+    assert len(ds) == 2
+    src, trg, trg_next = ds[0]
+    assert src.tolist() == [0, 3, 4, 1]       # <s> hello world <e>
+    assert trg.tolist() == [0, 3, 4]          # <s> bonjour monde
+    assert trg_next.tolist() == [3, 4, 1]     # bonjour monde <e>
+    d_src, d_trg = ds.get_dict()
+    assert d_src["hello"] == 3
+    r_src, _ = ds.get_dict(reverse=True)
+    assert r_src[3] == "hello"
+    with pytest.raises(AssertionError):
+        WMT14(data_file=str(wmt14_tar), mode="train", dict_size=-1)
+
+
+@pytest.fixture
+def wmt16_tar(tmp_path):
+    # wmt16/{train,test,val}: "en\tde" columns (reference wmt16.py src_col)
+    train = b"hello world\thallo welt\nhello\thallo\n" * 3
+    buf = io.BytesIO()
+    with tarfile.open(fileobj=buf, mode="w:gz") as tf:
+        _add_bytes(tf, "wmt16/train", train)
+        _add_bytes(tf, "wmt16/test", train[:22])
+        _add_bytes(tf, "wmt16/val", train[:22])
+    p = tmp_path / "wmt16.tar.gz"
+    p.write_bytes(buf.getvalue())
+    return p
+
+
+def test_wmt16_contract(wmt16_tar):
+    ds = WMT16(data_file=str(wmt16_tar), mode="train", src_dict_size=10,
+               trg_dict_size=10, lang="en")
+    assert len(ds) == 6
+    src, trg, trg_next = ds[0]
+    sd = ds.get_dict("en")
+    td = ds.get_dict("de")
+    assert src[0] == sd["<s>"] and src[-1] == sd["<e>"]
+    assert trg[0] == sd["<s>"]
+    np.testing.assert_array_equal(trg[1:], trg_next[:-1])
+    assert sd["hello"] >= 3 and td["hallo"] >= 3  # after reserved marks
+    # lang='de' swaps source/target columns
+    ds_de = WMT16(data_file=str(wmt16_tar), mode="val", src_dict_size=10,
+                  trg_dict_size=10, lang="de")
+    s2, _, _ = ds_de[0]
+    assert len(ds_de) == 1
+    rev = ds_de.get_dict("de", reverse=True)
+    assert rev[int(s2[1])] == "hallo"
+
+
+# ------------------------------------------------------------ vision corpus
+def _png_bytes(w=4, h=4, color=(255, 0, 0)):
+    from PIL import Image
+
+    img = Image.new("RGB", (w, h), color)
+    buf = io.BytesIO()
+    img.save(buf, format="PNG")
+    return buf.getvalue()
+
+
+def _jpg_bytes(w=4, h=4, color=(0, 255, 0)):
+    from PIL import Image
+
+    img = Image.new("RGB", (w, h), color)
+    buf = io.BytesIO()
+    img.save(buf, format="JPEG")
+    return buf.getvalue()
+
+
+def test_dataset_folder_and_image_folder(tmp_path):
+    for cls in ("cat", "dog"):
+        d = tmp_path / "root" / cls
+        os.makedirs(d)
+        for i in range(2):
+            (d / f"{i}.png").write_bytes(_png_bytes())
+        (d / "notes.txt").write_text("skip me")
+    ds = DatasetFolder(str(tmp_path / "root"))
+    assert ds.classes == ["cat", "dog"]
+    assert ds.class_to_idx == {"cat": 0, "dog": 1}
+    assert len(ds) == 4 and ds.targets == [0, 0, 1, 1]
+    img, target = ds[0]
+    assert target == 0 and img.size == (4, 4)
+
+    flat = ImageFolder(str(tmp_path / "root"))
+    assert len(flat.samples) == 4
+    item = flat[0]
+    assert isinstance(item, list) and len(item) == 1
+
+    with pytest.raises(RuntimeError):
+        DatasetFolder(str(tmp_path / "root"), extensions=(".xyz",))
+
+
+def test_flowers_contract(tmp_path):
+    import scipy.io as sio
+
+    n = 6
+    buf = io.BytesIO()
+    with tarfile.open(fileobj=buf, mode="w:gz") as tf:
+        for i in range(1, n + 1):
+            _add_bytes(tf, "jpg/image_%05d.jpg" % i, _jpg_bytes())
+    (tmp_path / "102flowers.tgz").write_bytes(buf.getvalue())
+    sio.savemat(tmp_path / "imagelabels.mat",
+                {"labels": np.arange(1, n + 1)[None, :]})
+    sio.savemat(tmp_path / "setid.mat",
+                {"tstid": np.array([[1, 2, 3, 4]]),
+                 "trnid": np.array([[5, 6]]),
+                 "valid": np.array([[5]])})
+    ds = Flowers(data_file=str(tmp_path / "102flowers.tgz"),
+                 label_file=str(tmp_path / "imagelabels.mat"),
+                 setid_file=str(tmp_path / "setid.mat"), mode="train")
+    assert len(ds) == 4  # tstid flags TRAIN (reference quirk)
+    img, label = ds[0]
+    assert label.tolist() == [1] and img.size == (4, 4)
+    test = Flowers(data_file=str(tmp_path / "102flowers.tgz"),
+                   label_file=str(tmp_path / "imagelabels.mat"),
+                   setid_file=str(tmp_path / "setid.mat"), mode="test",
+                   backend="cv2")
+    assert len(test) == 2
+    arr, label = test[0]
+    assert isinstance(arr, np.ndarray) and label.tolist() == [5]
+
+
+def test_voc2012_contract(tmp_path):
+    buf = io.BytesIO()
+    with tarfile.open(fileobj=buf, mode="w") as tf:
+        _add_bytes(tf, "VOCdevkit/VOC2012/ImageSets/Segmentation/train.txt",
+                   b"img1\nimg2\n")
+        _add_bytes(tf, "VOCdevkit/VOC2012/ImageSets/Segmentation/val.txt",
+                   b"img1\n")
+        for name in ("img1", "img2"):
+            _add_bytes(tf, f"VOCdevkit/VOC2012/JPEGImages/{name}.jpg",
+                       _jpg_bytes())
+            _add_bytes(tf,
+                       f"VOCdevkit/VOC2012/SegmentationClass/{name}.png",
+                       _png_bytes())
+    p = tmp_path / "voc.tar"
+    p.write_bytes(buf.getvalue())
+    ds = VOC2012(data_file=str(p), mode="train")
+    assert len(ds) == 2
+    img, mask = ds[0]
+    assert img.size == (4, 4) and mask.size == (4, 4)
+    cv = VOC2012(data_file=str(p), mode="valid", backend="cv2")
+    assert len(cv) == 1
+    arr, m = cv[0]
+    assert isinstance(arr, np.ndarray) and arr.dtype == np.float32
