@@ -330,7 +330,8 @@ def tensor_stats_dump(log_dir, worker_id=0):
 
     os.makedirs(log_dir, exist_ok=True)
     path = os.path.join(log_dir, f"worker_{worker_id}.log")
-    f = open(path, "a")
+    f = open(path, "w")  # one context = one run; stale lines would
+    # mis-pair the repeat-count join
     counts = {}
 
     def _emit(name, out):

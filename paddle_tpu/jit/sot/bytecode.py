@@ -765,6 +765,161 @@ class OpcodeExecutor:
         self.push(d)
         return None
 
+    def op_BUILD_SET(self, inst):
+        n = inst.arg
+        items = self.stack[len(self.stack) - n:] if n else []
+        del self.stack[len(self.stack) - n:]
+        if any(isinstance(v, SymTensor) for v in items):
+            raise BytecodeUnsupported("set of symbolic tensors")
+        self.push(set(items))
+        return None
+
+    def op_SET_ADD(self, inst):
+        v = self.pop()
+        if isinstance(v, SymTensor):
+            raise BytecodeUnsupported("set of symbolic tensors")
+        self.stack[-inst.arg].add(v)
+        return None
+
+    def op_SET_UPDATE(self, inst):
+        seq = self.pop()
+        if isinstance(seq, SymTensor):
+            raise BytecodeUnsupported("set update from symbolic tensor")
+        items = list(seq)
+        if any(isinstance(v, SymTensor) for v in items):
+            raise BytecodeUnsupported("set of symbolic tensors")
+        self.stack[-inst.arg].update(items)
+        return None
+
+    def op_MAP_ADD(self, inst):
+        v = self.pop()
+        k = self.pop()
+        if isinstance(k, SymTensor):
+            raise BytecodeUnsupported("symbolic dict key")
+        self.stack[-inst.arg][k] = v
+        return None
+
+    def op_DICT_UPDATE(self, inst):
+        d = self.pop()
+        self.stack[-inst.arg].update(d)
+        return None
+
+    def op_DICT_MERGE(self, inst):
+        d = self.pop()
+        target = self.stack[-inst.arg]
+        for k in d:
+            if k in target:
+                raise BytecodeUnsupported("duplicate **kwargs key")
+        target.update(d)
+        return None
+
+    def op_BUILD_CONST_KEY_MAP(self, inst):
+        keys = self.pop()
+        n = inst.arg
+        vals = self.stack[len(self.stack) - n:] if n else []
+        del self.stack[len(self.stack) - n:]
+        self.push(dict(zip(keys, vals)))
+        return None
+
+    def op_BUILD_STRING(self, inst):
+        n = inst.arg
+        parts = self.stack[len(self.stack) - n:] if n else []
+        del self.stack[len(self.stack) - n:]
+        self.push("".join(parts))
+        return None
+
+    def op_FORMAT_VALUE(self, inst):
+        # arg: low 2 bits conversion (0 none, 1 str, 2 repr, 3 ascii),
+        # bit 2: format spec on stack
+        flags = inst.arg
+        spec = self.pop() if flags & 0x04 else ""
+        v = self.pop()
+        if isinstance(v, SymTensor):
+            # formatting needs the concrete value: graph break, reseed
+            self.tracer.breaks += 1
+            v = self.tracer.materialize(v)
+        conv = flags & 0x03
+        if conv == 1:
+            v = str(v)
+        elif conv == 2:
+            v = repr(v)
+        elif conv == 3:
+            v = ascii(v)
+        self.push(format(v, spec))
+        return None
+
+    def op_UNPACK_EX(self, inst):
+        seq = self.pop()
+        if isinstance(seq, SymTensor):
+            raise BytecodeUnsupported("starred unpack of symbolic tensor")
+        items = list(seq)
+        before = inst.arg & 0xFF
+        after = inst.arg >> 8
+        if len(items) < before + after:
+            raise BytecodeUnsupported("unpack_ex arity mismatch")
+        rest = items[before:len(items) - after if after else len(items)]
+        tail = items[len(items) - after:] if after else []
+        for it in reversed(tail):
+            self.push(it)
+        self.push(rest)
+        for it in reversed(items[:before]):
+            self.push(it)
+        return None
+
+    def op_DELETE_SUBSCR(self, inst):
+        idx = self.pop()
+        obj = self.pop()
+        if isinstance(obj, SymTensor):
+            raise BytecodeUnsupported("delete on symbolic tensor")
+        del obj[idx]
+        return None
+
+    def op_CALL_FUNCTION_EX(self, inst):
+        # 3.12 layout deep->top: NULL, callable, args-iterable, (kwargs);
+        # the compiler always emits PUSH_NULL for the deep slot here
+        kwargs = self.pop() if inst.arg & 0x01 else {}
+        args = self.pop()
+        fn = self.pop()
+        deep = self.pop()
+        if deep is not _NULL:
+            raise BytecodeUnsupported("unexpected CALL_FUNCTION_EX layout")
+        if isinstance(args, SymTensor):
+            raise BytecodeUnsupported("*args from symbolic tensor")
+        args = tuple(args)
+        if isinstance(fn, _BoundSym):
+            self.push(self.call_method(fn.name, fn.sym, list(args), kwargs))
+            return None
+        self.push(self.call_value(fn, args, dict(kwargs)))
+        return None
+
+    def op_MAKE_FUNCTION(self, inst):
+        # 3.12: flags in arg select extra stack operands under the code
+        import types as _types
+
+        code = self.pop()
+        closure = self.pop() if inst.arg & 0x08 else None
+        annotations = self.pop() if inst.arg & 0x04 else None
+        kwdefaults = self.pop() if inst.arg & 0x02 else None
+        defaults = self.pop() if inst.arg & 0x01 else None
+        if closure is not None:
+            # cell creation (MAKE_CELL) is outside the supported opcode
+            # set, so a closure tuple here came from an unsupported path
+            raise BytecodeUnsupported("MAKE_FUNCTION with closure")
+        if code.co_flags & 0x20:  # CO_GENERATOR: genexpr/generator body
+            # would run natively and could consume symbolic tensors
+            # through its iterator — decline so the frame falls back
+            raise BytecodeUnsupported("MAKE_FUNCTION of generator code")
+        fn = _types.FunctionType(code, self.fn.__globals__,
+                                 code.co_name, defaults, closure)
+        if kwdefaults is not None:
+            fn.__kwdefaults__ = dict(kwdefaults)
+        if annotations is not None:
+            # 3.10+: a FLAT (name1, val1, name2, val2, ...) tuple
+            fn.__annotations__ = dict(zip(annotations[::2],
+                                          annotations[1::2]))
+        self.push(fn)
+        return None
+
     def op_BUILD_SLICE(self, inst):
         if inst.arg == 3:
             step = self.pop()
@@ -803,13 +958,17 @@ class OpcodeExecutor:
         return None
 
     def op_CALL(self, inst):
-        # 3.12 stack layout deep->top: self_or_NULL, callable, args
-        # (dis renders the producing loads as "NULL|self + name")
+        # 3.12 stack layout deep->top: two call slots, then args. The
+        # executor's own LOAD_GLOBAL/LOAD_ATTR normalize their pushes to
+        # [NULL(deep), callable(upper)]; bare callables from
+        # MAKE_FUNCTION arrive as [callable(deep), self(upper)] — the
+        # branch below dispatches on which slot holds NULL.
         argc = inst.arg
         args = self.stack[len(self.stack) - argc:] if argc else []
         del self.stack[len(self.stack) - argc:]
-        fn = self.pop()
-        self_or_null = self.pop()
+        upper = self.pop()   # callable (normalized) or first arg (bare)
+        deep = self.pop()    # NULL (normalized) or callable (bare)
+        fn, self_or_null = upper, deep
         kwnames = self.kwnames
         self.kwnames = ()
         kwargs = {}
@@ -823,8 +982,12 @@ class OpcodeExecutor:
         if self_or_null is _NULL:
             self.push(self.call_value(fn, tuple(args), kwargs))
         else:
-            # unbound method with explicit self
-            self.push(self.call_value(fn, (self_or_null,) + tuple(args),
+            # true 3.12 layout [callable(deep), self(top)]: the DEEPER
+            # slot is the callable and the upper one its first argument —
+            # produced by MAKE_FUNCTION + iterator (genexprs) etc.; the
+            # executor's own LOAD_GLOBAL/LOAD_ATTR normalize to the
+            # NULL-deep branch above
+            self.push(self.call_value(self_or_null, (fn,) + tuple(args),
                                       kwargs))
         return None
 

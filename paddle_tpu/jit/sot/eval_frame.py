@@ -1,0 +1,152 @@
+"""PEP-523 eval-frame entry for the SOT tier (reference:
+paddle/fluid/pybind/eval_frame.c:439 + jit/sot's eval_frame_callback).
+
+Two cooperating pieces:
+
+- ``_sot_eval_frame`` (native/src/sot_eval_frame.c): a CPython extension
+  installing a custom frame evaluator. It runs in DETECTION mode — it
+  always delegates to the default evaluator (this libpython does not
+  export the 3.12 frame-teardown internals a skipping evaluator needs)
+  and fires a callback the first time a watched code object's frame
+  enters.
+- this module: the callback patches the discovered function's
+  ``__code__`` with a dispatch stub, so every SUBSEQUENT call — through
+  any alias, bound method, or callback reference — routes through
+  ``symbolic_translate`` without the call sites ever seeing a decorator.
+
+``capture(fn)`` applies the same ``__code__`` patch eagerly (no hook
+needed); ``enable(watch=[...])`` arms the PEP-523 discovery path.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import subprocess
+import sys
+import sysconfig
+import types
+from typing import Callable, Optional
+
+_SRC = os.path.join(os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__)))), "native", "src", "sot_eval_frame.c")
+_BUILD_DIR = os.path.join(os.path.dirname(_SRC), os.pardir, "_build")
+
+_ext = None
+_ext_err: Optional[str] = None
+
+_REGISTRY: dict = {}
+_PATCHED: dict = {}  # key -> (func, original code)
+
+
+def _build_ext():
+    """Compile + import the extension module, cached by source hash."""
+    global _ext, _ext_err
+    if _ext is not None or _ext_err is not None:
+        return _ext
+    try:
+        with open(_SRC, "rb") as f:
+            digest = hashlib.sha256(f.read()).hexdigest()[:16]
+        build_dir = os.path.abspath(_BUILD_DIR)
+        os.makedirs(build_dir, exist_ok=True)
+        so = os.path.join(build_dir, f"_sot_eval_frame_{digest}.so")
+        if not os.path.exists(so):
+            inc = sysconfig.get_paths()["include"]
+            cmd = ["gcc", "-O2", "-fPIC", "-shared", f"-I{inc}",
+                   _SRC, "-o", so]
+            r = subprocess.run(cmd, capture_output=True, text=True,
+                               timeout=120)
+            if r.returncode != 0:
+                _ext_err = r.stderr[-2000:]
+                return None
+        import importlib.util
+
+        spec = importlib.util.spec_from_file_location("_sot_eval_frame", so)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        _ext = mod
+    except Exception as e:  # toolchain missing etc.
+        _ext_err = str(e)
+        return None
+    return _ext
+
+
+def _dispatch(key, args, kwargs):
+    return _REGISTRY[key](*args, **kwargs)
+
+
+def capture(func: Callable) -> bool:
+    """Route all existing references to ``func`` through the SOT tier by
+    swapping its ``__code__`` for a dispatch stub. Returns False (and
+    leaves the function untouched) for closures — a stub cannot satisfy
+    their free variables."""
+    from paddle_tpu.jit.sot import symbolic_translate
+
+    if getattr(func, "__closure__", None):
+        return False
+    key = f"{func.__module__}.{func.__qualname__}:{id(func)}"
+    if key in _PATCHED:
+        return True
+    original = types.FunctionType(func.__code__, func.__globals__,
+                                  func.__name__, func.__defaults__,
+                                  func.__closure__)
+    original.__kwdefaults__ = func.__kwdefaults__
+    _REGISTRY[key] = symbolic_translate(original)
+    src = ("def _stub(*args, **kwargs):\n"
+           "    from paddle_tpu.jit.sot import eval_frame as _ef\n"
+           f"    return _ef._dispatch({key!r}, args, kwargs)\n")
+    ns: dict = {}
+    exec(src, ns)
+    _PATCHED[key] = (func, func.__code__)
+    func.__code__ = ns["_stub"].__code__
+    return True
+
+
+def release(func: Callable) -> bool:
+    """Undo ``capture``: restore the original code object."""
+    for key, (f, code) in list(_PATCHED.items()):
+        if f is func:
+            func.__code__ = code
+            del _PATCHED[key]
+            _REGISTRY.pop(key, None)
+            return True
+    return False
+
+
+def sot_stats_of(func: Callable) -> Optional[dict]:
+    """sot_stats for a captured (code-patched) function."""
+    from paddle_tpu.jit.sot import sot_stats
+
+    for key, (f, _) in _PATCHED.items():
+        if f is func:
+            return sot_stats(_REGISTRY[key])
+    return None
+
+
+def enable(watch=(), callback: Optional[Callable] = None) -> bool:
+    """Arm the PEP-523 discovery hook for the given functions. On each
+    watched function's FIRST call the hook fires and ``capture`` patches
+    it; the first call itself still runs eagerly (detection mode — see
+    the C source for why this build cannot skip evaluation)."""
+    ext = _build_ext()
+    if ext is None:
+        return False
+    if callback is None:
+        def callback(func):
+            code = func.__code__  # the WATCHED (pre-patch) code object
+            if capture(func):
+                ext.unwatch(code)  # one-shot per code object
+
+    ext.install(callback)
+    for fn in watch:
+        ext.watch(fn.__code__)
+    return True
+
+
+def disable() -> None:
+    if _ext is not None:
+        _ext.uninstall()
+
+
+def build_error() -> Optional[str]:
+    return _ext_err

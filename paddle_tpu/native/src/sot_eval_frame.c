@@ -1,0 +1,142 @@
+/* PEP-523 frame-evaluation hook for the SOT tier (reference:
+ * paddle/fluid/pybind/eval_frame.c:439 eval_frame_callback /
+ * _PyInterpreterState_SetEvalFrameFunc).
+ *
+ * DETECTION-MODE design, deliberate: this build's libpython does not
+ * export the 3.12 frame-teardown internals (_PyEval_FrameClearAndPop /
+ * _PyFrame_ClearExceptCode), so an evaluator that SKIPS
+ * _PyEval_EvalFrameDefault cannot dispose of the interpreter frame and
+ * would corrupt the datastack. Instead the custom evaluator ALWAYS
+ * delegates to the default evaluator, and — for code objects registered
+ * via watch() — first fires a Python callback with the frame's function
+ * object. The Python side (jit/sot/eval_frame.py) patches that
+ * function's __code__ so every SUBSEQUENT call routes through the SOT
+ * bytecode translator: automatic, decorator-free capture with PEP 523 as
+ * the discovery mechanism, safe on any CPython 3.12 binary.
+ */
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+
+#if PY_VERSION_HEX >= 0x030c0000 && PY_VERSION_HEX < 0x030d0000
+
+#define Py_BUILD_CORE
+#include <internal/pycore_frame.h>
+#undef Py_BUILD_CORE
+
+static PyObject *g_callback = NULL; /* callable(func) -> None */
+static PyObject *g_watched = NULL;  /* set of code objects */
+static int g_in_callback = 0;       /* re-entrancy guard (GIL-serialized) */
+
+static PyObject *
+custom_eval(PyThreadState *ts, struct _PyInterpreterFrame *frame,
+            int throwflag)
+{
+    if (!throwflag && !g_in_callback && g_callback && g_watched) {
+        PyCodeObject *code = frame->f_code;
+        int c = PySet_Contains(g_watched, (PyObject *)code);
+        if (c < 0) {
+            PyErr_Clear();
+        } else if (c > 0 && frame->f_funcobj != NULL) {
+            g_in_callback = 1;
+            PyObject *r =
+                PyObject_CallOneArg(g_callback, frame->f_funcobj);
+            g_in_callback = 0;
+            if (r == NULL)
+                PyErr_Clear(); /* discovery must never break the call */
+            else
+                Py_DECREF(r);
+        }
+    }
+    return _PyEval_EvalFrameDefault(ts, frame, throwflag);
+}
+
+static PyObject *
+py_install(PyObject *self, PyObject *cb)
+{
+    if (!PyCallable_Check(cb)) {
+        PyErr_SetString(PyExc_TypeError, "callback must be callable");
+        return NULL;
+    }
+    Py_XDECREF(g_callback);
+    g_callback = Py_NewRef(cb);
+    if (g_watched == NULL)
+        g_watched = PySet_New(NULL);
+    _PyInterpreterState_SetEvalFrameFunc(PyInterpreterState_Get(),
+                                         custom_eval);
+    Py_RETURN_NONE;
+}
+
+static PyObject *
+py_uninstall(PyObject *self, PyObject *noargs)
+{
+    _PyInterpreterState_SetEvalFrameFunc(PyInterpreterState_Get(),
+                                         _PyEval_EvalFrameDefault);
+    Py_CLEAR(g_callback);
+    Py_RETURN_NONE;
+}
+
+static PyObject *
+py_watch(PyObject *self, PyObject *code)
+{
+    if (g_watched == NULL)
+        g_watched = PySet_New(NULL);
+    if (PySet_Add(g_watched, code) < 0)
+        return NULL;
+    Py_RETURN_NONE;
+}
+
+static PyObject *
+py_unwatch(PyObject *self, PyObject *code)
+{
+    if (g_watched != NULL && PySet_Discard(g_watched, code) < 0)
+        return NULL;
+    Py_RETURN_NONE;
+}
+
+static PyObject *
+py_installed(PyObject *self, PyObject *noargs)
+{
+    _PyFrameEvalFunction cur =
+        _PyInterpreterState_GetEvalFrameFunc(PyInterpreterState_Get());
+    return PyBool_FromLong(cur == custom_eval);
+}
+
+static PyMethodDef methods[] = {
+    {"install", py_install, METH_O,
+     "install(callback): set the PEP-523 evaluator; callback(func) fires "
+     "once per watched-code frame entry"},
+    {"uninstall", py_uninstall, METH_NOARGS, "restore the default evaluator"},
+    {"watch", py_watch, METH_O, "watch(code): register a code object"},
+    {"unwatch", py_unwatch, METH_O, "unwatch(code)"},
+    {"installed", py_installed, METH_NOARGS,
+     "is the custom evaluator active"},
+    {NULL, NULL, 0, NULL},
+};
+
+#else /* non-3.12: module loads but reports unsupported */
+
+static PyObject *
+py_unsupported(PyObject *self, PyObject *args)
+{
+    PyErr_SetString(PyExc_RuntimeError,
+                    "sot eval-frame hook is built for CPython 3.12");
+    return NULL;
+}
+
+static PyMethodDef methods[] = {
+    {"install", py_unsupported, METH_O, ""},
+    {NULL, NULL, 0, NULL},
+};
+
+#endif
+
+static struct PyModuleDef moduledef = {
+    PyModuleDef_HEAD_INIT, "_sot_eval_frame",
+    "PEP-523 discovery hook for the SOT tier", -1, methods,
+};
+
+PyMODINIT_FUNC
+PyInit__sot_eval_frame(void)
+{
+    return PyModule_Create(&moduledef);
+}

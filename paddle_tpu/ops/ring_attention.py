@@ -170,15 +170,20 @@ def _ring_local_custom(axis_name, causal, scale):
     return ring_local
 
 
-def _ring_attention_local(q, k, v, axis_name, causal, scale):
+def _ring_attention_local(q, k, v, axis_name, causal, scale,
+                          backward="flash"):
     """Runs on each device inside shard_map; q/k/v are LOCAL seq blocks.
 
-    Default: the hand-scheduled custom-VJP ring (flash bwd blocks).
-    ``PADDLE_TPU_RING_AUTODIFF=1`` keeps the old autodiff-through-scan
-    backward for A/B measurement."""
+    backward="flash": the hand-scheduled custom-VJP ring (fast reverse
+    AD, but custom_vjp blocks forward-mode). backward="autodiff":
+    differentiate through the checkpointed scan (jvp/hessian-capable,
+    slower reverse). The env var PADDLE_TPU_RING_AUTODIFF=1 remains as a
+    process-wide default override for A/B measurement."""
     import os
 
-    if os.environ.get("PADDLE_TPU_RING_AUTODIFF") == "1":
+    if backward == "autodiff" or (
+            backward == "flash"
+            and os.environ.get("PADDLE_TPU_RING_AUTODIFF") == "1"):
         out, _ = _ring_forward_blocks(q, k, v, axis_name, causal, scale)
         return out
     return _ring_local_custom(axis_name, causal, float(scale))(q, k, v)
@@ -186,25 +191,35 @@ def _ring_attention_local(q, k, v, axis_name, causal, scale):
 
 def ring_attention(q, k, v, *, mesh: Mesh, axis: str = "sep",
                    causal: bool = True, scale: Optional[float] = None,
-                   batch_axis: Optional[str] = "dp"):
+                   batch_axis: Optional[str] = "dp",
+                   backward: str = "flash"):
     """Exact attention with the sequence dim sharded over ``axis``.
 
     q, k, v: [B, S, H, D] jax arrays (global view, S sharded over ``axis``).
     Returns [B, S, H, D] with the same sharding.
+    backward: "flash" (hand-scheduled custom VJP — fast reverse AD) or
+    "autodiff" (differentiate-through-scan — needed per-call by workloads
+    that take jvp/hessian THROUGH this op, without flipping the whole
+    process the way the env override does).
     """
+    if backward not in ("flash", "autodiff"):
+        raise ValueError(f"backward must be 'flash' or 'autodiff', "
+                         f"got {backward!r}")
     if scale is None:
         scale = 1.0 / math.sqrt(q.shape[-1])
     b_ax = batch_axis if (batch_axis and batch_axis in mesh.shape) else None
     spec = P(b_ax, axis, None, None)
     fn = functools.partial(
-        _ring_attention_local, axis_name=axis, causal=causal, scale=scale)
+        _ring_attention_local, axis_name=axis, causal=causal, scale=scale,
+        backward=backward)
     return shard_map(
         fn, mesh, in_specs=(spec, spec, spec), out_specs=spec,
     )(q, k, v)
 
 
 def ring_flash_attention(query, key, value, dropout=0.0, causal=True,
-                         mesh=None, axis="sep", training=True, name=None):
+                         mesh=None, axis="sep", training=True, name=None,
+                         backward="flash"):
     """Tensor-level entry (paddle flash_attention-shaped signature)."""
     from paddle_tpu.core.dispatch import apply
     from paddle_tpu.distributed.fleet import topology as topo
@@ -219,7 +234,8 @@ def ring_flash_attention(query, key, value, dropout=0.0, causal=True,
         mesh = hcg.get_mesh()
 
     def f(qv, kv, vv):
-        out = ring_attention(qv, kv, vv, mesh=mesh, axis=axis, causal=causal)
+        out = ring_attention(qv, kv, vv, mesh=mesh, axis=axis, causal=causal,
+                             backward=backward)
         if dropout > 0.0 and training:
             # output dropout, matching the flash path's approximation
             keep = jax.random.bernoulli(rng.next_key(), 1.0 - dropout,

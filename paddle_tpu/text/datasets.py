@@ -292,7 +292,10 @@ class Movielens(Dataset):
         self.data_file = _require(data_file, "ml-1m.zip")
         self.mode = mode
         self.test_ratio = test_ratio
-        np.random.seed(rand_seed)
+        # private stream: seeding the process-global numpy RNG as a
+        # construction side effect would silently de-randomize unrelated
+        # code (weight init, other splits)
+        self._split_rng = np.random.RandomState(rand_seed)
         self._load_meta_info()
         self._load_data()
 
@@ -334,7 +337,8 @@ class Movielens(Dataset):
             with pkg.open("ml-1m/ratings.dat") as f:
                 for line in f:
                     line = line.decode("latin")
-                    if (np.random.random() < self.test_ratio) != is_test:
+                    if (self._split_rng.random_sample() < self.test_ratio) \
+                            != is_test:
                         continue
                     uid, mov_id, rating, _ = line.strip().split("::")
                     mov = self.movie_info[int(mov_id)]

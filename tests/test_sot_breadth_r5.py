@@ -1,0 +1,156 @@
+"""r5 SOT breadth (VERDICT r4 missing #5): new opcode handlers (sets,
+dict merges, f-strings, starred unpack/call, MAKE_FUNCTION) through the
+bytecode tier, plus the PEP-523 eval-frame discovery entry (detection
+mode; reference eval_frame.c:439)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.jit.sot import sot_stats, symbolic_translate
+
+
+def t(v):
+    return paddle.to_tensor(np.asarray(v, np.float32))
+
+
+def _check(fn, *args):
+    eager = fn(*args)
+    wrapped = symbolic_translate(fn)
+    got = wrapped(*args)
+    np.testing.assert_allclose(np.asarray(got.numpy()),
+                               np.asarray(eager.numpy()), rtol=1e-5)
+    return wrapped
+
+
+def test_build_set_and_update():
+    def f(x):
+        axes = {0}
+        axes.add(1)
+        axes.update({1, 0})
+        return paddle.sum(x) * len(axes)
+
+    w = _check(f, t([1.0, 2.0]))
+    assert sot_stats(w)["bytecode"]
+
+
+def test_dict_merge_and_const_key_map():
+    def f(x):
+        base = {"a": 1.0, "b": 2.0}
+        extra = {"c": 3.0}
+        merged = {**base, **extra}
+        return x * sum(merged.values())
+
+    w = _check(f, t([1.0]))
+    assert sot_stats(w)["bytecode"]
+
+
+def test_dict_comprehension_map_add():
+    def f(x):
+        scales = {i: float(i + 1) for i in range(3)}
+        return x * scales[2]
+
+    w = _check(f, t([2.0]))
+    assert sot_stats(w)["bytecode"]
+
+
+def test_fstring_on_python_values():
+    def f(x, n=3):
+        label = f"scale_{n}x"
+        return x * float(len(label))
+
+    w = _check(f, t([1.0]))
+    assert sot_stats(w)["bytecode"]
+
+
+def test_unpack_ex():
+    def f(x):
+        first, *rest = [1.0, 2.0, 3.0, 4.0]
+        return x * (first + rest[-1])
+
+    w = _check(f, t([1.0]))
+    assert sot_stats(w)["bytecode"]
+
+
+def test_call_function_ex_star_args():
+    def f(x):
+        args = (x, x)
+        kw = {"y": 2.0}
+
+        def g(a, b, y=1.0):
+            return a + b * y
+
+        return paddle.sum(g(*args, **kw))
+
+    # inner def needs MAKE_FUNCTION + CALL_FUNCTION_EX
+    w = _check(f, t([1.0, 2.0]))
+    assert sot_stats(w)["bytecode"]
+
+
+def test_make_function_with_defaults():
+    def f(x):
+        def scale(v, k=3.0):
+            return v * k
+
+        return paddle.sum(scale(x))
+
+    w = _check(f, t([1.0, 2.0]))
+    assert sot_stats(w)["bytecode"]
+
+
+class TestEvalFrameEntry:
+    def test_capture_patches_all_references(self):
+        from paddle_tpu.jit.sot import eval_frame as ef
+
+        def fn(x):
+            return paddle.sum(x * 2.0)
+
+        alias = fn
+        x = t([1.0, 2.0, 3.0])
+        eager = float(fn(x).numpy())
+        assert ef.capture(fn)
+        try:
+            got = float(alias(x).numpy())  # pre-capture alias
+            assert abs(got - eager) < 1e-5
+            st = ef.sot_stats_of(fn)
+            assert st is not None and st["bytecode"]
+        finally:
+            assert ef.release(fn)
+        # released: original code restored
+        assert ef.sot_stats_of(fn) is None
+        assert abs(float(fn(x).numpy()) - eager) < 1e-5
+
+    def test_capture_declines_closures(self):
+        from paddle_tpu.jit.sot import eval_frame as ef
+
+        k = 2.0
+
+        def fn(x):
+            return x * k
+
+        assert not ef.capture(fn)
+
+    def test_pep523_discovery_hook(self):
+        from paddle_tpu.jit.sot import eval_frame as ef
+
+        ext = ef._build_ext()
+        if ext is None:
+            pytest.skip(f"extension unavailable: {ef.build_error()}")
+
+        def auto(x):
+            return paddle.mean(x + 1.0)
+
+        x = t([1.0, 3.0])
+        try:
+            assert ef.enable(watch=[auto])
+            assert ext.installed()
+            v1 = float(auto(x).numpy())   # detection call (eager)
+            assert any(f is auto for f, _ in ef._PATCHED.values())
+            v2 = float(auto(x).numpy())   # routed through SOT
+            assert abs(v1 - v2) < 1e-6
+            st = ef.sot_stats_of(auto)
+            assert st is not None
+        finally:
+            ef.disable()
+            ef.release(auto)
+        assert not ext.installed()
