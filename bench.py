@@ -297,9 +297,11 @@ def bench_resnet50(on_tpu):
 
     if on_tpu:
         # NHWC end-to-end (channels on the 128-lane minor axis — no layout
-        # transposes), batch 128, bf16 input pipeline: r2's NCHW batch-64
-        # config measured 9.5% MFU, dominated by XLA-inserted transposes
-        batch, hw, iters = 256, 224, 10
+        # transposes), bf16 input pipeline: r2's NCHW batch-64 config
+        # measured 9.5% MFU, dominated by XLA-inserted transposes.
+        # RESNET_BENCH_BATCH drives tools/resnet_mfu_audit.py's sweep.
+        batch = int(os.environ.get("RESNET_BENCH_BATCH", "256"))
+        hw, iters = 224, 10
         model = resnet50(data_format="NHWC")
     else:
         from paddle_tpu.vision.models.resnet import resnet18
