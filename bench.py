@@ -180,10 +180,17 @@ def bench_gpt3_1p3b_sweep(on_tpu):
         env.update(BENCH_1P3B_BATCH=b, BENCH_1P3B_SEQ=s,
                    BENCH_1P3B_REMAT=remat, BENCH_1P3B_ITERS="4")
         env.pop("BENCH_1P3B_SWEEP", None)
-        r = subprocess.run(
-            [sys.executable, os.path.abspath(__file__),
-             "--one", "bench_gpt3_1p3b", "--plat", "tpu"],
-            capture_output=True, text=True, timeout=900, env=env)
+        try:
+            r = subprocess.run(
+                [sys.executable, os.path.abspath(__file__),
+                 "--one", "bench_gpt3_1p3b", "--plat", "tpu"],
+                capture_output=True, text=True, timeout=900, env=env)
+        except subprocess.TimeoutExpired:
+            # one hung candidate (tunnel flap / pathological config) must
+            # not abort the remaining sweep
+            print(json.dumps({"config": f"b{b}_s{s}_{remat}",
+                              "error": "timeout after 900s"}))
+            continue
         for line in r.stdout.splitlines():
             if line.startswith("{"):
                 print(json.dumps({"config": f"b{b}_s{s}_{remat}",

@@ -416,11 +416,20 @@ def register_kl(cls_p, cls_q):
 
 
 def kl_divergence(p: Distribution, q: Distribution):
-    for (cp, cq), fn in _KL_REGISTRY.items():
-        if isinstance(p, cp) and isinstance(q, cq):
-            return fn(p, q)
-    raise NotImplementedError(
-        f"no KL registered for ({type(p).__name__}, {type(q).__name__})")
+    # most-specific match wins (the reference's dispatch behavior): rank
+    # candidates by MRO distance from the concrete types so a user's
+    # (MyDist, MyDist) registration beats a base-class catch-all like
+    # (ExponentialFamily, ExponentialFamily) regardless of insert order
+    matches = [(cp, cq, fn) for (cp, cq), fn in _KL_REGISTRY.items()
+               if isinstance(p, cp) and isinstance(q, cq)]
+    if not matches:
+        raise NotImplementedError(
+            f"no KL registered for ({type(p).__name__}, {type(q).__name__})")
+    _, _, fn = min(
+        matches,
+        key=lambda m: (type(p).__mro__.index(m[0])
+                       + type(q).__mro__.index(m[1])))
+    return fn(p, q)
 
 
 @register_kl(Normal, Normal)

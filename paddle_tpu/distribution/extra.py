@@ -683,6 +683,71 @@ def _kl_continuous_bernoulli(p, q):
                  + p._log_norm() - q._log_norm())
 
 
+@register_kl(ExponentialFamily, ExponentialFamily)
+def _kl_expfamily_expfamily(p, q):
+    """Generic same-family KL via the Bregman divergence of the log
+    normalizer (reference kl.py _kl_expfamily_expfamily, same autodiff
+    trick with jax.grad in place of paddle.grad):
+    KL(p||q) = F(θq) - F(θp) - <θq - θp, ∇F(θp)>."""
+    if type(p) is not type(q):
+        raise NotImplementedError(
+            "generic exponential-family KL needs p and q of the same type")
+    tp = tuple(jnp.asarray(t, jnp.float32) for t in p._natural_parameters)
+    tq = tuple(jnp.asarray(t, jnp.float32) for t in q._natural_parameters)
+    fp = p._log_normalizer(*tp)
+    fq = q._log_normalizer(*tq)
+    grads = jax.grad(lambda ts: jnp.sum(p._log_normalizer(*ts)))(tp)
+    kl = fq - fp
+    ev = len(q.event_shape)
+    for tqi, tpi, g in zip(tq, tp, grads):
+        # inner product over EVENT dims (the reference sums each term
+        # rightmost by the event rank)
+        kl = kl - _sum_rightmost((tqi - tpi) * g, ev)
+    return _wrap(kl)
+
+
+def _register_closed_form_kls():
+    """Same-family closed forms the reference's kl.py registers."""
+    from paddle_tpu.distribution import (
+        Dirichlet,
+        Laplace,
+        LogNormal,
+        Normal,
+    )
+
+    @register_kl(Laplace, Laplace)
+    def _kl_laplace(p, q):
+        # closed form: log(bq/bp) + |mup-muq|/bq
+        #              + bp/bq * exp(-|mup-muq|/bp) - 1
+        scale_ratio = p.scale / q.scale
+        loc_abs_diff = jnp.abs(p.loc - q.loc)
+        t1 = -jnp.log(scale_ratio)
+        t2 = loc_abs_diff / q.scale
+        t3 = scale_ratio * jnp.exp(-loc_abs_diff / p.scale)
+        return _wrap(t1 + t2 + t3 - 1.0)
+
+    @register_kl(LogNormal, LogNormal)
+    def _kl_lognormal(p, q):
+        # KL(LogNormal) == KL of the underlying Normals: delegate so the
+        # (Normal, Normal) path's parameter-gradient support carries over
+        return kl_divergence(p._normal, q._normal)
+
+    @register_kl(Dirichlet, Dirichlet)
+    def _kl_dirichlet(p, q):
+        a, b = p.concentration, q.concentration
+        sum_a = jnp.sum(a, -1)
+        t1 = gammaln(sum_a) - jnp.sum(gammaln(a), -1)
+        t2 = -(gammaln(jnp.sum(b, -1)) - jnp.sum(gammaln(b), -1))
+        t3 = jnp.sum((a - b) * (digamma(a)
+                                - digamma(sum_a)[..., None]), -1)
+        return _wrap(t1 + t2 + t3)
+
+    _ = Normal  # imported for symmetry; Normal-Normal already registered
+
+
+_register_closed_form_kls()
+
+
 def _mvlgamma(a, p):
     """Multivariate log-gamma: log Γ_p(a)."""
     i = jnp.arange(p, dtype=jnp.float32)
