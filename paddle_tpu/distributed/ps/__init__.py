@@ -216,13 +216,24 @@ class MemoryDenseTable:
 # ---------------------------------------------------------------- PS server
 
 _TABLES: Dict[int, object] = {}
+# local multi-shard simulation: each namespace is one "server process"
+# worth of tables (in rpc mode every OS process naturally has its own)
+_NAMESPACES: Dict[str, Dict[int, object]] = {"default": _TABLES}
 
 
-def _server_handle(op: str, table_id: int, payload: bytes):
+def _server_handle(op: str, table_id: int, payload: bytes,
+                   namespace: str = "default"):
     """The service entry point — importable module-level function so it is
     callable through distributed.rpc (PsService::service parity)."""
     args = pickle.loads(payload)
-    table = _TABLES[table_id]
+    tables = _NAMESPACES.setdefault(namespace, {})
+    if op == "create_sparse":
+        tables[table_id] = MemorySparseTable(table_id, **args)
+        return b""
+    if op == "create_dense":
+        tables[table_id] = MemoryDenseTable(table_id, **args)
+        return b""
+    table = tables[table_id]
     if op == "pull_sparse":
         return pickle.dumps(table.pull(args["ids"]))
     if op == "push_sparse":
@@ -248,10 +259,11 @@ def _server_handle(op: str, table_id: int, payload: bytes):
 
 class PSServer:
     """Hosts tables; in rpc mode the process must have called
-    dist.rpc.init_rpc(name=...) so trainers can address it."""
+    dist.rpc.init_rpc(name=...) so trainers can address it. ``namespace``
+    isolates table sets for in-process multi-shard setups."""
 
-    def __init__(self):
-        self._tables = _TABLES
+    def __init__(self, namespace: str = "default"):
+        self._tables = _NAMESPACES.setdefault(namespace, {})
 
     def add_sparse_table(self, table_id, dim, accessor="adagrad", **kw):
         self._tables[table_id] = MemorySparseTable(table_id, dim, accessor,
@@ -267,19 +279,47 @@ class PSClient:
     """PSClient parity (ps_client.h:64): pull/push against a server by rpc
     worker name, or in-process when server_name is None (local mode)."""
 
-    def __init__(self, server_name: Optional[str] = None, timeout=60):
+    def __init__(self, server_name: Optional[str] = None, timeout=60,
+                 namespace: str = "default"):
         self.server_name = server_name
         self.timeout = timeout
+        self.namespace = namespace
 
     def _call(self, op, table_id, **args):
         payload = pickle.dumps(args)
         if self.server_name is None:
-            return _server_handle(op, table_id, payload)
+            return _server_handle(op, table_id, payload, self.namespace)
         from paddle_tpu.distributed import rpc
 
         return rpc.rpc_sync(self.server_name, _server_handle,
-                            args=(op, table_id, payload),
+                            args=(op, table_id, payload, self.namespace),
                             timeout=self.timeout)
+
+    def _call_async(self, op, table_id, **args):
+        """Future-returning form (reference async push mode)."""
+        payload = pickle.dumps(args)
+        if self.server_name is None:
+            class _Done:
+                def __init__(self, v):
+                    self._v = v
+
+                def wait(self):
+                    return self._v
+
+            return _Done(_server_handle(op, table_id, payload,
+                                        self.namespace))
+        from paddle_tpu.distributed import rpc
+
+        return rpc.rpc_async(self.server_name, _server_handle,
+                             args=(op, table_id, payload, self.namespace),
+                             timeout=self.timeout)
+
+    def create_sparse_table(self, table_id, dim, accessor="adagrad", **kw):
+        self._call("create_sparse", table_id, dim=dim, accessor=accessor,
+                   **kw)
+
+    def create_dense_table(self, table_id, dim, lr=0.05, **kw):
+        self._call("create_dense", table_id, dim=dim, lr=lr, **kw)
 
     def pull_sparse(self, table_id, ids) -> np.ndarray:
         return pickle.loads(self._call("pull_sparse", table_id,
@@ -308,3 +348,149 @@ class PSClient:
 
     def table_size(self, table_id) -> int:
         return pickle.loads(self._call("size", table_id))
+
+
+# ------------------------------------------------------- sharded scale-out
+class ShardedPSClient:
+    """Key-sharded PS over N servers (the reference's brpc scale-out shape:
+    ps_client.h:64 routes each request to the shard owning the key; dense
+    parameters partition into contiguous per-server blocks).
+
+    ``shards`` is a list of PSClient — each either rpc-backed (its own OS
+    process) or a namespaced local client (in-process drills). Sparse ids
+    route by ``id % n_shards``; pulls fan out (async) and reassemble in
+    the caller's order; pushes can be fire-and-forget (``async_push``)
+    with ``barrier()`` draining the pending futures — the reference's
+    async-pusher trainer mode."""
+
+    def __init__(self, shards: List[PSClient]):
+        if not shards:
+            raise ValueError("need at least one shard")
+        self.shards = list(shards)
+        self._pending: List[object] = []
+
+    @property
+    def n_shards(self):
+        return len(self.shards)
+
+    # -- table management (applies to every shard) --------------------------
+    def create_sparse_table(self, table_id, dim, accessor="adagrad", **kw):
+        seed = kw.pop("seed", 0)
+        for i, sh in enumerate(self.shards):
+            # per-shard seed: lazy rows must not be identical across shards
+            sh.create_sparse_table(table_id, dim=dim, accessor=accessor,
+                                   seed=seed + i, **dict(kw))
+
+    def _dense_split(self, dim):
+        n = self.n_shards
+        base, rem = divmod(dim, n)
+        sizes = [base + (1 if i < rem else 0) for i in range(n)]
+        return sizes
+
+    def create_dense_table(self, table_id, dim, lr=0.05, **kw):
+        sizes = self._dense_split(dim)
+        seed = kw.pop("seed", 0)
+        for i, (sh, size) in enumerate(zip(self.shards, sizes)):
+            # per-shard seed: the partitioned init must not repeat blocks
+            sh.create_dense_table(table_id, dim=size, lr=lr, seed=seed + i,
+                                  **dict(kw))
+
+    # -- sparse ------------------------------------------------------------
+    def _route(self, ids):
+        ids = [int(i) for i in ids]  # materialize once: generators welcome
+        per = [[] for _ in range(self.n_shards)]
+        pos = [[] for _ in range(self.n_shards)]
+        for j, i in enumerate(ids):
+            s = i % self.n_shards
+            per[s].append(i)
+            pos[s].append(j)
+        return ids, per, pos
+
+    def pull_sparse(self, table_id, ids) -> np.ndarray:
+        ids, per, pos = self._route(ids)
+        futs = [
+            (sh_pos, sh._call_async("pull_sparse", table_id, ids=sh_ids))
+            for sh_ids, sh_pos, sh in zip(per, pos, self.shards) if sh_ids
+        ]
+        out = None
+        for sh_pos, fut in futs:
+            rows = pickle.loads(fut.wait())
+            if out is None:
+                out = np.zeros((len(ids), rows.shape[1]), rows.dtype)
+            out[sh_pos] = rows
+        if out is None:  # empty request keeps the array contract
+            out = np.zeros((0, 0), np.float32)
+        return out
+
+    def push_sparse(self, table_id, ids, grads, show_clicks=None,
+                    async_push=False):
+        grads = np.asarray(grads, np.float32)
+        _, per, pos = self._route(ids)
+        futs = []
+        for sh_ids, sh_pos, sh in zip(per, pos, self.shards):
+            if not sh_ids:
+                continue
+            sc = ([show_clicks[j] for j in sh_pos]
+                  if show_clicks is not None else None)
+            futs.append(sh._call_async("push_sparse", table_id, ids=sh_ids,
+                                       grads=grads[sh_pos],
+                                       show_clicks=sc))
+        if async_push:
+            self._pending.extend(futs)
+        else:
+            for fut in futs:  # fan-out first, ONE round-trip of latency
+                fut.wait()
+
+    # -- dense -------------------------------------------------------------
+    def pull_dense(self, table_id) -> np.ndarray:
+        futs = [sh._call_async("pull_dense", table_id)
+                for sh in self.shards]
+        return np.concatenate([pickle.loads(f.wait()) for f in futs])
+
+    def push_dense(self, table_id, grad, async_push=False):
+        grad = np.asarray(grad, np.float32)
+        # the split is derived from the gradient length, NOT from state
+        # recorded at create time — any client instance can push to a
+        # table another client created
+        sizes = self._dense_split(len(grad))
+        futs = []
+        off = 0
+        for sh, size in zip(self.shards, sizes):
+            futs.append(sh._call_async("push_dense", table_id,
+                                       grad=grad[off:off + size]))
+            off += size
+        if async_push:
+            self._pending.extend(futs)
+        else:
+            for fut in futs:  # fan-out first, ONE round-trip of latency
+                fut.wait()
+
+    # -- lifecycle ---------------------------------------------------------
+    def barrier(self):
+        """Drain pending async pushes (reference barrier_with_table).
+        The pending list is cleared even when a wait raises — stale
+        futures must not poison every later barrier."""
+        pending, self._pending = self._pending, []
+        first_err = None
+        for fut in pending:
+            try:
+                fut.wait()
+            except Exception as e:
+                if first_err is None:
+                    first_err = e
+        if first_err is not None:
+            raise first_err
+
+    def shrink(self, table_id, threshold=0.0) -> int:
+        return sum(s.shrink(table_id, threshold) for s in self.shards)
+
+    def table_size(self, table_id) -> int:
+        return sum(s.table_size(table_id) for s in self.shards)
+
+    def save(self, table_id, path):
+        for i, sh in enumerate(self.shards):
+            sh.save(table_id, f"{path}.shard{i}")
+
+    def load(self, table_id, path):
+        for i, sh in enumerate(self.shards):
+            sh.load(table_id, f"{path}.shard{i}")
