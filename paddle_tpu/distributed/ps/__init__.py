@@ -254,6 +254,8 @@ def _server_handle(op: str, table_id: int, payload: bytes,
         return b""
     if op == "size":
         return pickle.dumps(table.size())
+    if op == "dim":
+        return pickle.dumps(int(table.accessor.dim))
     raise ValueError(f"unknown ps op {op}")
 
 
@@ -418,8 +420,10 @@ class ShardedPSClient:
             if out is None:
                 out = np.zeros((len(ids), rows.shape[1]), rows.dtype)
             out[sh_pos] = rows
-        if out is None:  # empty request keeps the array contract
-            out = np.zeros((0, 0), np.float32)
+        if out is None:  # empty request keeps the (0, dim) array contract
+            dim = pickle.loads(
+                self.shards[0]._call("dim", table_id))
+            out = np.zeros((0, dim), np.float32)
         return out
 
     def push_sparse(self, table_id, ids, grads, show_clicks=None,

@@ -3,9 +3,11 @@ decoder-serving fused ops — masked_multihead_attention / block_multihead
 _attention in incubate/nn/functional — re-expressed as cached attention +
 a sampling loop; SURVEY §2.6 'decoder-serving included').
 
-Greedy / temperature / top-k sampling. The prefill step processes the whole
-prompt once and fills the per-layer KV caches; each decode step then runs a
-single-token forward against the cached keys/values."""
+Greedy / temperature / top-k / top-p sampling and beam search (the
+reference GenerationMixin's strategy set). The prefill step processes the
+whole prompt once and fills the per-layer KV caches; each decode step then
+runs a single-token forward against the cached keys/values; beam search
+reorders the caches by beam origin each step."""
 
 from __future__ import annotations
 
@@ -18,7 +20,7 @@ from paddle_tpu.tensor import Tensor
 
 
 def _sample_next(logits_np: np.ndarray, temperature: float, top_k: int,
-                 rand) -> np.ndarray:
+                 rand, top_p: float = 1.0) -> np.ndarray:
     """logits [B, V] -> next ids [B]."""
     if temperature <= 0.0:
         return logits_np.argmax(-1)
@@ -27,16 +29,46 @@ def _sample_next(logits_np: np.ndarray, temperature: float, top_k: int,
         top_k = min(top_k, logits.shape[-1])
         kth = np.partition(logits, -top_k, axis=-1)[:, -top_k][:, None]
         logits = np.where(logits < kth, -np.inf, logits)
+    if 0.0 < top_p < 1.0:
+        # nucleus: keep the smallest prefix of the sorted distribution
+        # whose mass exceeds top_p (the top token always survives)
+        order = np.argsort(-logits, axis=-1)
+        sorted_logits = np.take_along_axis(logits, order, axis=-1)
+        sl = sorted_logits - sorted_logits.max(-1, keepdims=True)
+        sp = np.exp(sl)
+        sp /= sp.sum(-1, keepdims=True)
+        cum = np.cumsum(sp, axis=-1)
+        cut = cum - sp > top_p           # tokens fully past the nucleus
+        # (strict >: boundary tokens whose prefix mass EQUALS top_p stay)
+        sorted_logits = np.where(cut, -np.inf, sorted_logits)
+        inv = np.argsort(order, axis=-1)
+        logits = np.take_along_axis(sorted_logits, inv, axis=-1)
     logits = logits - logits.max(-1, keepdims=True)
     probs = np.exp(logits)
     probs /= probs.sum(-1, keepdims=True)
     return np.array([rand.choice(probs.shape[-1], p=p) for p in probs])
 
 
+def _normalize_prompt(model, input_ids, max_new_tokens):
+    """Shared prompt normalization + window guard for every strategy."""
+    ids_np = np.asarray(input_ids.numpy()
+                        if isinstance(input_ids, Tensor) else input_ids)
+    if ids_np.ndim == 1:
+        ids_np = ids_np[None, :]
+    prompt_len = ids_np.shape[1]
+    max_pos = getattr(getattr(model, "config", None),
+                      "max_position_embeddings", None)
+    if max_pos is not None and prompt_len + max_new_tokens > max_pos:
+        raise ValueError(
+            f"prompt ({prompt_len}) + max_new_tokens ({max_new_tokens}) "
+            f"exceeds max_position_embeddings ({max_pos})")
+    return ids_np
+
+
 def greedy_or_sample(model, input_ids, num_layers: int,
                      max_new_tokens: int = 32, temperature: float = 1.0,
                      top_k: int = 0, eos_token_id: Optional[int] = None,
-                     seed: Optional[int] = None):
+                     seed: Optional[int] = None, top_p: float = 1.0):
     """Generate tokens autoregressively. ``model(input_ids, position_ids,
     caches)`` must return (logits, new_caches) when caches is given.
 
@@ -45,18 +77,10 @@ def greedy_or_sample(model, input_ids, num_layers: int,
     model.eval()
     rand = np.random.default_rng(seed)
     try:
-        ids_np = np.asarray(input_ids.numpy()
-                            if isinstance(input_ids, Tensor) else input_ids)
-        if ids_np.ndim == 1:
-            ids_np = ids_np[None, :]
+        ids_np = _normalize_prompt(model, input_ids, max_new_tokens)
         B, prompt_len = ids_np.shape
         if max_new_tokens <= 0:
             return paddle.to_tensor(ids_np.astype(np.int64))
-        max_pos = getattr(model.config, "max_position_embeddings", None)
-        if max_pos is not None and prompt_len + max_new_tokens > max_pos:
-            raise ValueError(
-                f"prompt ({prompt_len}) + max_new_tokens ({max_new_tokens}) "
-                f"exceeds max_position_embeddings ({max_pos})")
 
         with paddle.no_grad():
             # prefill: whole prompt, empty caches
@@ -65,7 +89,7 @@ def greedy_or_sample(model, input_ids, num_layers: int,
                 paddle.to_tensor(ids_np.astype(np.int32)), None, caches)
             next_np = _sample_next(
                 np.asarray(logits.numpy())[:, -1].astype(np.float64),
-                temperature, top_k, rand)
+                temperature, top_k, rand, top_p)
             out = [ids_np, next_np[:, None]]
             finished = np.zeros(B, dtype=bool)
             if eos_token_id is not None:
@@ -80,13 +104,143 @@ def greedy_or_sample(model, input_ids, num_layers: int,
                     tok, paddle.to_tensor(np.array([pos], np.int32)), caches)
                 next_np = _sample_next(
                     np.asarray(logits.numpy())[:, -1].astype(np.float64),
-                    temperature, top_k, rand)
+                    temperature, top_k, rand, top_p)
                 if eos_token_id is not None:
                     next_np = np.where(finished, eos_token_id, next_np)
                     finished |= next_np == eos_token_id
                 out.append(next_np[:, None])
         return paddle.to_tensor(
             np.concatenate(out, axis=1).astype(np.int64))
+    finally:
+        if was_training:
+            model.train()
+
+
+def _reorder_caches(caches, origin):
+    """Gather each cache tensor's batch rows by beam origin indices."""
+    idx = paddle.to_tensor(origin.astype(np.int64))
+    out = []
+    for k, v in caches:
+        if k is None:
+            out.append((k, v))
+        else:
+            out.append((paddle.index_select(k, idx, axis=0),
+                        paddle.index_select(v, idx, axis=0)))
+    return out
+
+
+def _tile_caches(caches, num_beams):
+    """Repeat each cache row num_beams times (prefill -> beam expansion)."""
+    out = []
+    for k, v in caches:
+        if k is None:
+            out.append((k, v))
+        else:
+            b = k.shape[0]
+            idx = paddle.to_tensor(
+                np.repeat(np.arange(b), num_beams).astype(np.int64))
+            out.append((paddle.index_select(k, idx, axis=0),
+                        paddle.index_select(v, idx, axis=0)))
+    return out
+
+
+def beam_search(model, input_ids, num_layers: int, max_new_tokens: int = 32,
+                num_beams: int = 4, length_penalty: float = 1.0,
+                eos_token_id: Optional[int] = None):
+    """Beam search over the cached decode loop (reference GenerationMixin
+    beam_search semantics: running beams scored by summed log-probs,
+    finished-at-eos hypotheses ranked by score / len**length_penalty;
+    2*num_beams candidates per step so eos'd beams have live spares).
+
+    Returns [B, prompt+new] ids of the best hypothesis per batch row
+    (right-padded with eos/0 when it finished early)."""
+    was_training = model.training
+    model.eval()
+    try:
+        ids_np = _normalize_prompt(model, input_ids, max_new_tokens)
+        B, prompt_len = ids_np.shape
+        if max_new_tokens <= 0:
+            return paddle.to_tensor(ids_np.astype(np.int64))
+
+        def logp_of(logits):
+            l = np.asarray(logits.numpy())[:, -1].astype(np.float64)
+            l = l - l.max(-1, keepdims=True)
+            return l - np.log(np.exp(l).sum(-1, keepdims=True))
+
+        with paddle.no_grad():
+            caches = [(None, None)] * num_layers
+            logits, caches = model(
+                paddle.to_tensor(ids_np.astype(np.int32)), None, caches)
+            lp = logp_of(logits)                       # [B, V]
+            V = lp.shape[-1]
+            # seed beams from the top-num_beams first tokens per row
+            top = np.argsort(-lp, axis=-1)[:, :num_beams]      # [B, nb]
+            beam_scores = np.take_along_axis(lp, top, axis=-1)  # [B, nb]
+            beam_tokens = top[..., None]               # [B, nb, 1]
+            caches = _tile_caches(caches, num_beams)
+            done = [[] for _ in range(B)]              # (score, tokens)
+
+            def maybe_finish(b, score, toks):
+                done[b].append(
+                    (score / (len(toks) ** length_penalty), toks))
+
+            alive = np.ones((B, num_beams), dtype=bool)
+            for step in range(1, max_new_tokens + 1):
+                if eos_token_id is not None:
+                    for b in range(B):
+                        for k in range(num_beams):
+                            if alive[b, k] and \
+                                    beam_tokens[b, k, -1] == eos_token_id:
+                                maybe_finish(b, beam_scores[b, k],
+                                             list(beam_tokens[b, k]))
+                                alive[b, k] = False
+                                beam_scores[b, k] = -np.inf
+                if step == max_new_tokens or not alive.any():
+                    break
+                pos = prompt_len + step - 1
+                flat_tok = beam_tokens[:, :, -1].reshape(-1)
+                logits, caches = model(
+                    paddle.to_tensor(flat_tok[:, None].astype(np.int32)),
+                    paddle.to_tensor(np.array([pos], np.int32)), caches)
+                lp = logp_of(logits).reshape(B, num_beams, V)
+                cand = beam_scores[..., None] + lp      # [B, nb, V]
+                flat = cand.reshape(B, -1)
+                top2 = np.argsort(-flat, axis=-1)[:, : 2 * num_beams]
+                new_scores = np.full((B, num_beams), -np.inf)
+                new_tokens = np.zeros((B, num_beams, step + 1), np.int64)
+                origin = np.zeros((B, num_beams), np.int64)
+                for b in range(B):
+                    k = 0
+                    for c in top2[b]:
+                        if k == num_beams:
+                            break
+                        src, tok = divmod(int(c), V)
+                        if not np.isfinite(flat[b, c]):
+                            continue
+                        new_scores[b, k] = flat[b, c]
+                        new_tokens[b, k] = np.concatenate(
+                            [beam_tokens[b, src], [tok]])
+                        origin[b, k] = b * num_beams + src
+                        k += 1
+                beam_scores, beam_tokens = new_scores, new_tokens
+                alive = np.isfinite(beam_scores)
+                caches = _reorder_caches(caches, origin.reshape(-1))
+
+            # finalize the surviving beams
+            for b in range(B):
+                for k in range(num_beams):
+                    if np.isfinite(beam_scores[b, k]):
+                        maybe_finish(b, beam_scores[b, k],
+                                     list(beam_tokens[b, k]))
+
+        pad = eos_token_id if eos_token_id is not None else 0
+        total = prompt_len + max_new_tokens
+        out = np.full((B, total), pad, np.int64)
+        out[:, :prompt_len] = ids_np
+        for b in range(B):
+            best = max(done[b], key=lambda h: h[0])[1]
+            out[b, prompt_len:prompt_len + len(best)] = best
+        return paddle.to_tensor(out)
     finally:
         if was_training:
             model.train()

@@ -113,6 +113,8 @@ def test_sharded_embedding_model_trains(sharded):
 def test_two_rpc_server_processes():
     """Real scale-out drill: two PS server OS processes behind the
     TCPStore rpc, one sharded client routing between them."""
+    import os
+    import socket
     import subprocess
     import sys
     import time
@@ -120,7 +122,11 @@ def test_two_rpc_server_processes():
     from paddle_tpu.distributed import rpc
     from paddle_tpu.distributed.store import TCPStore
 
-    port = 29741
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     worker = r"""
 import sys
 import paddle_tpu.distributed.rpc as rpc
@@ -136,7 +142,7 @@ while True:  # the poller thread serves; parent terminates us
 """ % port
     store = TCPStore("127.0.0.1", port, is_master=True)
     procs = [subprocess.Popen([sys.executable, "-c", worker, str(r)],
-                              cwd="/root/repo")
+                              cwd=repo_root)
              for r in (1, 2)]
     try:
         rpc.init_rpc("trainer", rank=0, world_size=3, store=store)
