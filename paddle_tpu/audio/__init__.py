@@ -14,3 +14,4 @@ from paddle_tpu.audio.backends import (  # noqa: F401
     load,
     save,
 )
+from paddle_tpu.audio import datasets  # noqa: F401,E402

@@ -1,0 +1,166 @@
+"""paddle.audio.datasets parity (reference: python/paddle/audio/datasets/
+dataset.py AudioClassificationDataset, esc50.py, tess.py). Offline:
+datasets read a LOCAL extracted tree (pass data_dir=); tests synthesize
+tiny wavs through the framework's own wave backend."""
+
+from __future__ import annotations
+
+import csv
+import os
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from paddle_tpu.audio import backends, features
+from paddle_tpu.io.dataset import Dataset
+from paddle_tpu.tensor import Tensor
+
+__all__ = ["AudioClassificationDataset", "ESC50", "TESS"]
+
+feat_funcs = {
+    "raw": None,
+    "melspectrogram": features.MelSpectrogram,
+    "mfcc": features.MFCC,
+    "logmelspectrogram": features.LogMelSpectrogram,
+    "spectrogram": features.Spectrogram,
+}
+
+
+class AudioClassificationDataset(Dataset):
+    """(waveform-or-feature, label) pairs over a file list (reference
+    dataset.py:28): feat_type routes through the audio feature layers."""
+
+    def __init__(self, files: List[str], labels: List[int],
+                 feat_type: str = "raw", sample_rate: Optional[int] = None,
+                 **kwargs):
+        # sample_rate (when given) overrides the file rate for FEATURE
+        # construction — the wave backend does no resampling, matching
+        # the reference (which reads the file rate per item)
+        if feat_type not in feat_funcs:
+            raise RuntimeError(
+                f"Unknown feat_type: {feat_type}, it must be one in "
+                f"{list(feat_funcs.keys())}")
+        self.files = files
+        self.labels = labels
+        self.feat_type = feat_type
+        self.sample_rate = sample_rate
+        self.feat_config = kwargs
+        self._feat_layers = {}  # sample_rate -> constructed feature layer
+
+    def _convert_to_record(self, idx):
+        import paddle_tpu as paddle
+
+        file, label = self.files[idx], self.labels[idx]
+        waveform, sample_rate = backends.load(file)
+        wav = np.asarray(waveform.numpy()
+                         if isinstance(waveform, Tensor) else waveform)
+        if wav.ndim == 2:
+            wav = wav[0]
+        x = paddle.to_tensor(wav.astype(np.float32))
+        feat_cls = feat_funcs[self.feat_type]
+        if feat_cls is not None:
+            if self.sample_rate is not None:
+                sample_rate = self.sample_rate  # explicit override
+            layer = self._feat_layers.get(sample_rate)
+            if layer is None:
+                # construct ONCE per sample rate: the mel filterbank is
+                # the data-path hot cost, not something to rebuild per item
+                import inspect
+
+                kwargs = dict(self.feat_config)
+                if "sr" in inspect.signature(feat_cls.__init__).parameters:
+                    kwargs.setdefault("sr", sample_rate)
+                layer = feat_cls(**kwargs)
+                self._feat_layers[sample_rate] = layer
+            x = layer(paddle.unsqueeze(x, 0))
+            x = paddle.squeeze(x, 0)
+        return x, np.int64(label)
+
+    def __getitem__(self, idx):
+        return self._convert_to_record(idx)
+
+    def __len__(self):
+        return len(self.files)
+
+
+class ESC50(AudioClassificationDataset):
+    """ESC-50 environmental sounds (reference esc50.py): 5-fold CSV meta;
+    mode='train' takes folds != split, else fold == split."""
+
+    audio_path = os.path.join("ESC-50-master", "audio")
+    meta = os.path.join("ESC-50-master", "meta", "esc50.csv")
+
+    def __init__(self, mode: str = "train", split: int = 1,
+                 feat_type: str = "raw", data_dir: Optional[str] = None,
+                 archive=None, **kwargs):
+        data_dir = data_dir or os.path.expanduser("~/.cache/paddle_tpu")
+        if not os.path.isdir(os.path.join(data_dir, self.audio_path)):
+            raise FileNotFoundError(
+                f"{os.path.join(data_dir, self.audio_path)} not found "
+                "(downloads unavailable offline; pass data_dir= pointing "
+                "at the extracted ESC-50-master tree)")
+        files, labels = self._get_data(data_dir, mode, split)
+        super().__init__(files, labels, feat_type, **kwargs)
+
+    def _get_data(self, data_dir, mode, split) -> Tuple[List[str],
+                                                        List[int]]:
+        files, labels = [], []
+        with open(os.path.join(data_dir, self.meta), newline="") as f:
+            reader = csv.DictReader(f)
+            for row in reader:
+                fold, target = int(row["fold"]), int(row["target"])
+                keep = (fold != split) if mode == "train" else (fold == split)
+                if keep:
+                    files.append(os.path.join(data_dir, self.audio_path,
+                                              row["filename"]))
+                    labels.append(target)
+        return files, labels
+
+
+class TESS(AudioClassificationDataset):
+    """TESS emotional speech (reference tess.py): labels parsed from the
+    third filename token; index-round-robin folds, mode='train' takes
+    folds != split."""
+
+    audio_path = "TESS_Toronto_emotional_speech_set"
+    label_list = ["angry", "disgust", "fear", "happy", "neutral", "ps",
+                  "sad"]
+
+    def __init__(self, mode: str = "train", n_folds: int = 5,
+                 split: int = 1, feat_type: str = "raw",
+                 data_dir: Optional[str] = None, archive=None, **kwargs):
+        assert isinstance(n_folds, int) and n_folds >= 1, (
+            f"the n_folds should be integer and n_folds >= 1, "
+            f"but got {n_folds}")
+        data_dir = data_dir or os.path.expanduser("~/.cache/paddle_tpu")
+        root = os.path.join(data_dir, self.audio_path)
+        if not os.path.isdir(root):
+            raise FileNotFoundError(
+                f"{root} not found (downloads unavailable offline; pass "
+                "data_dir= pointing at the extracted TESS tree)")
+        files, labels = self._get_data(root, mode, n_folds, split)
+        super().__init__(files, labels, feat_type, **kwargs)
+
+    def _get_data(self, root, mode, n_folds, split):
+        wav_files = []
+        for r, _, fs in sorted(os.walk(root)):
+            for fname in sorted(fs):
+                if fname.endswith(".wav"):
+                    wav_files.append(os.path.join(r, fname))
+        files, labels = [], []
+        for idx, path in enumerate(wav_files):
+            # <speaker>_<word>_<emotion>.wav
+            base = os.path.basename(path)[:-len(".wav")]
+            parts = base.split("_")
+            if len(parts) < 3 or parts[2].lower() not in self.label_list:
+                raise ValueError(
+                    f"unexpected TESS wav name {os.path.basename(path)!r}: "
+                    f"want <speaker>_<word>_<emotion>.wav with emotion in "
+                    f"{self.label_list}")
+            target = self.label_list.index(parts[2].lower())
+            fold = idx % n_folds + 1
+            keep = (fold != split) if mode == "train" else (fold == split)
+            if keep:
+                files.append(path)
+                labels.append(target)
+        return files, labels

@@ -1,0 +1,88 @@
+"""r5 audio dataset corpus (reference python/paddle/audio/datasets/):
+AudioClassificationDataset feat routing, ESC50 CSV folds, TESS
+filename-parsed labels — fixtures written through the framework's own
+wave backend."""
+
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.audio import backends
+from paddle_tpu.audio.datasets import ESC50, TESS, AudioClassificationDataset
+
+
+def _write_wav(path, freq=440.0, sr=16000, n=800):
+    t = np.arange(n) / sr
+    wav = (0.5 * np.sin(2 * np.pi * freq * t)).astype(np.float32)
+    backends.save(str(path), paddle.to_tensor(wav[None, :]), sr)
+
+
+@pytest.fixture
+def esc50_tree(tmp_path):
+    audio = tmp_path / "ESC-50-master" / "audio"
+    meta = tmp_path / "ESC-50-master" / "meta"
+    os.makedirs(audio)
+    os.makedirs(meta)
+    rows = ["filename,fold,target,category,esc10,src_file,take"]
+    for i in range(10):
+        name = f"1-{i}-A-{i % 5}.wav"
+        _write_wav(audio / name, freq=200 + 40 * i)
+        rows.append(f"{name},{i % 5 + 1},{i % 5},cat{i % 5},False,{i},A")
+    (meta / "esc50.csv").write_text("\n".join(rows) + "\n")
+    return tmp_path
+
+
+def test_esc50_folds_and_items(esc50_tree):
+    train = ESC50(mode="train", split=1, data_dir=str(esc50_tree))
+    dev = ESC50(mode="dev", split=1, data_dir=str(esc50_tree))
+    assert len(train) == 8 and len(dev) == 2  # fold1 = 2 of 10
+    wav, label = train[0]
+    assert wav.shape[-1] == 800 and 0 <= int(label) < 5
+    # no overlap between splits
+    assert not (set(train.files) & set(dev.files))
+
+
+def test_esc50_feature_routing(esc50_tree):
+    ds = ESC50(mode="dev", split=1, data_dir=str(esc50_tree),
+               feat_type="mfcc", n_mfcc=13, n_fft=256)
+    feat, label = ds[0]
+    assert feat.shape[0] == 13  # [n_mfcc, frames]
+    ds2 = ESC50(mode="dev", split=1, data_dir=str(esc50_tree),
+                feat_type="logmelspectrogram", n_fft=256, n_mels=32)
+    feat2, _ = ds2[0]
+    assert feat2.shape[0] == 32
+    with pytest.raises(RuntimeError):
+        AudioClassificationDataset([], [], feat_type="bogus")
+
+
+@pytest.fixture
+def tess_tree(tmp_path):
+    root = tmp_path / "TESS_Toronto_emotional_speech_set"
+    emotions = ["angry", "happy", "sad", "neutral", "fear"]
+    os.makedirs(root)
+    for i in range(10):
+        emo = emotions[i % len(emotions)]
+        _write_wav(root / f"OAF_word{i}_{emo}.wav", freq=150 + 25 * i)
+    return tmp_path
+
+
+def test_tess_labels_and_folds(tess_tree):
+    train = TESS(mode="train", n_folds=5, split=1, data_dir=str(tess_tree))
+    dev = TESS(mode="dev", n_folds=5, split=1, data_dir=str(tess_tree))
+    assert len(train) == 8 and len(dev) == 2
+    labels = sorted({int(l) for _, l in
+                     ((train[i]) for i in range(len(train)))})
+    assert all(0 <= l < len(TESS.label_list) for l in labels)
+    wav, _ = train[0]
+    assert wav.shape[-1] == 800
+    with pytest.raises(AssertionError):
+        TESS(n_folds=0, data_dir=str(tess_tree))
+
+
+def test_missing_tree_raises(tmp_path):
+    with pytest.raises(FileNotFoundError):
+        ESC50(data_dir=str(tmp_path))
+    with pytest.raises(FileNotFoundError):
+        TESS(data_dir=str(tmp_path))
