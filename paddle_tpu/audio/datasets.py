@@ -93,6 +93,8 @@ class ESC50(AudioClassificationDataset):
     def __init__(self, mode: str = "train", split: int = 1,
                  feat_type: str = "raw", data_dir: Optional[str] = None,
                  archive=None, **kwargs):
+        assert mode in ("train", "dev"), (
+            f"mode must be 'train' or 'dev', got {mode!r}")
         data_dir = data_dir or os.path.expanduser("~/.cache/paddle_tpu")
         if not os.path.isdir(os.path.join(data_dir, self.audio_path)):
             raise FileNotFoundError(
@@ -129,6 +131,8 @@ class TESS(AudioClassificationDataset):
     def __init__(self, mode: str = "train", n_folds: int = 5,
                  split: int = 1, feat_type: str = "raw",
                  data_dir: Optional[str] = None, archive=None, **kwargs):
+        assert mode in ("train", "dev"), (
+            f"mode must be 'train' or 'dev', got {mode!r}")
         assert isinstance(n_folds, int) and n_folds >= 1, (
             f"the n_folds should be integer and n_folds >= 1, "
             f"but got {n_folds}")

@@ -16,6 +16,7 @@ from typing import Dict, List, Optional
 
 import numpy as np
 
+import jax
 import jax.numpy as jnp
 
 
@@ -34,6 +35,50 @@ class PlaceType:
     CUSTOM = 3
 
 
+class PassBuilder:
+    """Ordered analysis-pass pipeline (reference AnalysisConfig::
+    pass_builder, paddle_pass_builder.cc). Passes here have REAL effects
+    on this substrate (weight-residency precision, buffer donation,
+    analysis-time compilation); classic graph passes whose concern XLA
+    owns are listed [absorbed] for introspection and are delete-able
+    no-ops."""
+
+    # (name, absorbed?) in application order. Residency runs BEFORE
+    # prewarm so the analysis-time compile exercises the casting path and
+    # full-precision weights never reach the device.
+    _DEFAULT = [
+        ("weights_bf16_residency_pass", False),  # off unless enabled
+        ("donate_input_buffers_pass", False),    # off unless memory_optim
+        ("prewarm_compile_pass", False),       # AOT-compile at load
+        ("constant_folding_pass", True),
+        ("conv_bn_fuse_pass", True),
+        ("fc_fuse_pass", True),
+        ("memory_optimize_pass", True),
+    ]
+
+    def __init__(self):
+        self._passes = [n for n, _ in self._DEFAULT]
+        self._absorbed = {n for n, a in self._DEFAULT if a}
+
+    def all_passes(self):
+        return list(self._passes)
+
+    def delete_pass(self, name):
+        if name in self._passes:
+            self._passes.remove(name)
+
+    def append_pass(self, name):
+        if name not in self._passes:
+            self._passes.append(name)
+
+    def insert_pass(self, idx, name):
+        if name not in self._passes:
+            self._passes.insert(idx, name)
+
+    def is_absorbed(self, name):
+        return name in self._absorbed
+
+
 class Config:
     """paddle.inference.Config parity: holds model paths + knobs."""
 
@@ -49,13 +94,14 @@ class Config:
                     p = p[: -len(suf)]
             self._prefix = p
         self._flags: Dict[str, object] = {}
+        self._pass_builder = PassBuilder()
 
     # --- knobs ---------------------------------------------------------
     # Each knob is either APPLIED (has a real effect on this backend) or
     # ABSORBED (the concern it configures is owned by XLA — fusion, memory
     # planning, engine selection). summary() reports which is which, so the
     # deployment surface is honest instead of silently recording.
-    _ABSORBED = {"use_gpu", "memory_optim", "ir_optim", "mkldnn"}
+    _ABSORBED = {"use_gpu", "ir_optim", "mkldnn"}
 
     def enable_use_gpu(self, memory_pool_init_size_mb=100, device_id=0,
                        precision=PrecisionType.Float32):
@@ -65,8 +111,9 @@ class Config:
         self._flags["use_gpu"] = False
 
     def enable_memory_optim(self, x=True):
-        # XLA's buffer assignment IS the memory optimizer; weights are
-        # uploaded once and reused (TranslatedLayer caches device arrays)
+        # XLA's buffer assignment is the in-program memory optimizer; the
+        # APPLIED part here is input-buffer donation around the exported
+        # call (donate_input_buffers_pass)
         self._flags["memory_optim"] = x
 
     def switch_ir_optim(self, x=True):
@@ -109,6 +156,19 @@ class Config:
             "TensorRT is CUDA-specific; the XLA-compiled program is already "
             "the optimized engine on this backend")
 
+    def pass_builder(self) -> PassBuilder:
+        """The analysis pipeline the Predictor applies at load
+        (reference AnalysisConfig::pass_builder)."""
+        return self._pass_builder
+
+    def enable_low_precision(self, dtype="bfloat16"):
+        """APPLIED: park the loaded weights in ``dtype`` residency
+        (halves weight HBM/host footprint; values cast back to the
+        program's dtype on the fly at call time)."""
+        if dtype not in ("bfloat16", "float16"):
+            raise ValueError(f"unsupported low precision {dtype!r}")
+        self._flags["low_precision"] = dtype
+
     def model_dir(self):
         return self._prefix
 
@@ -149,7 +209,9 @@ class InferTensor:
 
 
 class Predictor:
-    """paddle.inference.Predictor over a jit.save'd StableHLO program."""
+    """paddle.inference.Predictor over a jit.save'd StableHLO program.
+    Applies the Config's analysis-pass pipeline at load (the
+    AnalysisPredictor::OptimizeInferenceProgram stage on this substrate)."""
 
     def __init__(self, config: Config):
         from paddle_tpu.jit.serialization import load
@@ -165,6 +227,95 @@ class Predictor:
         self._input_names = [f"x{i}" for i in range(n_in)]
         self._inputs = {n: InferTensor(n) for n in self._input_names}
         self._outputs: List[InferTensor] = []
+        self._applied_passes: List[str] = []
+        self._run_passes(config)
+
+    # ----------------------------------------------------- analysis passes
+    def _run_passes(self, config: Config):
+        builder = config.pass_builder()
+        for name in builder.all_passes():
+            if builder.is_absorbed(name):
+                continue  # XLA owns the concern; listed for introspection
+            fn = getattr(self, f"_pass_{name}", None)
+            if fn is not None and fn(config):
+                self._applied_passes.append(name)
+
+    def _pass_weights_bf16_residency_pass(self, config) -> bool:
+        """Low-precision weight RESIDENCY: params rest as bf16/fp16 and
+        cast back to the exported program's dtype on the fly per call —
+        the exported avals stay satisfied while the resident footprint
+        halves (the substrate's version of the precision passes)."""
+        dtype = config._flags.get("low_precision")
+        if not dtype:
+            return False
+        layer = self._layer
+        low = jnp.bfloat16 if dtype == "bfloat16" else jnp.float16
+        names = layer._param_names
+        if not names:
+            return False
+        import ml_dtypes
+
+        host_low = (ml_dtypes.bfloat16 if dtype == "bfloat16"
+                    else ml_dtypes.float16)
+        full_dtypes = {}
+        low_params = {}
+        low_vals = []
+        for n in names:
+            h = np.asarray(layer._params[n])
+            full_dtypes[n] = jnp.dtype(h.dtype)
+            if np.issubdtype(h.dtype, np.floating):
+                h = h.astype(host_low)  # cast on HOST: fp32 never uploads
+            low_params[n] = h
+            low_vals.append(jnp.asarray(h))
+        layer._state_vals_low = low_vals
+
+        @jax.jit
+        def upcast(vals):
+            return [v.astype(full_dtypes[n])
+                    if jnp.issubdtype(v.dtype, jnp.floating) else v
+                    for n, v in zip(names, vals)]
+
+        layer._state_vals_upcast = upcast
+        # the cached device state IS the low-precision copy; the exported
+        # call sees program-dtype values via the jitted upcast
+        layer._state_vals = low_vals
+        orig_call = layer._exported.call
+
+        class _CastingExported:
+            def call(self, state_vals, *xs):
+                return orig_call(upcast(state_vals), *xs)
+
+        layer._exported = _CastingExported()
+        # keep parameters() working: host copies are the LOW-precision
+        # arrays (half the host footprint, still inspectable)
+        layer._params = low_params
+        return True
+
+    def _pass_donate_input_buffers_pass(self, config) -> bool:
+        """Input-buffer donation around the exported call (the APPLIED
+        face of enable_memory_optim)."""
+        if not config._flags.get("memory_optim"):
+            return False
+        self._donate_inputs = True
+        return True
+
+    def _pass_prewarm_compile_pass(self, config) -> bool:
+        """Analysis-time compilation: run the program once on zeros of the
+        exported input avals so the first real run() pays no compile."""
+        try:
+            specs = self._layer._input_specs  # [(shape, dtype_str), ...]
+            zeros = [np.zeros(tuple(d if isinstance(d, int) and d > 0 else 1
+                                    for d in shape), dtype)
+                     for shape, dtype in specs]
+            out = self._layer(*zeros)
+            _ = [np.asarray(o._value) if hasattr(o, "_value") else o
+                 for o in (out if isinstance(out, (list, tuple)) else [out])]
+            return True
+        except Exception:
+            return False  # odd specs: first run compiles instead
+
+    def applied_passes(self) -> List[str]:
+        return list(self._applied_passes)
 
     def get_input_names(self) -> List[str]:
         return list(self._input_names)
@@ -188,6 +339,12 @@ class Predictor:
                        if self._inputs[n]._value is None]
             raise RuntimeError(f"inputs not set: {missing}")
         out = self._layer(*xs)
+        if getattr(self, "_donate_inputs", False):
+            # memory_optim: the uploaded input buffers are not held by the
+            # handles past the run — the device allocator can reuse them
+            # immediately (the substrate's face of buffer donation)
+            for n in self._input_names:
+                self._inputs[n]._value = None
         outs = out if isinstance(out, (list, tuple)) else [out]
         self._outputs = []
         for i, o in enumerate(outs):

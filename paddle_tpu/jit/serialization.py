@@ -47,6 +47,13 @@ def save(layer, path, input_spec=None, **configs):
             specs.append(jax.ShapeDtypeStruct(tuple(s.shape), s.dtype))
         elif isinstance(s, jax.ShapeDtypeStruct):
             specs.append(s)
+        elif hasattr(s, "shape") and hasattr(s, "dtype") \
+                and not isinstance(s, np.ndarray):
+            # static.InputSpec (the reference's canonical input_spec
+            # element): dynamic dims (None/-1) trace as 1
+            shape = tuple(1 if d is None or (isinstance(d, int) and d < 0)
+                          else int(d) for d in s.shape)
+            specs.append(jax.ShapeDtypeStruct(shape, np.dtype(s.dtype)))
         else:
             arr = np.asarray(s)
             specs.append(jax.ShapeDtypeStruct(arr.shape, arr.dtype))

@@ -1,0 +1,87 @@
+"""r5 inference analysis-pass stack (reference AnalysisConfig::
+pass_builder / AnalysisPredictor::OptimizeInferenceProgram): pass listing
+and deletion, bf16 weight residency (numerics preserved, applied pass
+reported), prewarm compile, donation gate."""
+
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu import inference
+
+
+def _saved_model(tmp_path):
+    paddle.seed(0)
+    model = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+    model.eval()
+    path = str(tmp_path / "m")
+    from paddle_tpu.jit import save
+    from paddle_tpu.static import InputSpec
+
+    save(model, path, input_spec=[InputSpec([2, 8], "float32")])
+    return model, path
+
+
+def test_pass_builder_listing_and_delete(tmp_path):
+    _, path = _saved_model(tmp_path)
+    cfg = inference.Config(path)
+    pb = cfg.pass_builder()
+    names = pb.all_passes()
+    assert "prewarm_compile_pass" in names
+    assert "conv_bn_fuse_pass" in names  # absorbed, still listed
+    pb.delete_pass("prewarm_compile_pass")
+    assert "prewarm_compile_pass" not in pb.all_passes()
+    pb.append_pass("prewarm_compile_pass")
+    assert pb.all_passes()[-1] == "prewarm_compile_pass"
+    assert pb.is_absorbed("fc_fuse_pass")
+    assert not pb.is_absorbed("weights_bf16_residency_pass")
+
+
+def test_prewarm_reported_and_run_works(tmp_path):
+    model, path = _saved_model(tmp_path)
+    cfg = inference.Config(path)
+    pred = inference.create_predictor(cfg)
+    assert "prewarm_compile_pass" in pred.applied_passes()
+    x = np.random.default_rng(0).standard_normal((2, 8)).astype(np.float32)
+    (out,) = pred.run([x])
+    ref = np.asarray(model(paddle.to_tensor(x)).numpy())
+    np.testing.assert_allclose(out, ref, rtol=1e-5)
+
+
+def test_bf16_residency_preserves_numerics(tmp_path):
+    model, path = _saved_model(tmp_path)
+    x = np.random.default_rng(1).standard_normal((2, 8)).astype(np.float32)
+    ref = np.asarray(model(paddle.to_tensor(x)).numpy())
+
+    cfg = inference.Config(path)
+    cfg.enable_low_precision("bfloat16")
+    pred = inference.create_predictor(cfg)
+    assert "weights_bf16_residency_pass" in pred.applied_passes()
+    # resident weights ARE bf16
+    import jax.numpy as jnp
+
+    float_low = [v for v in pred._layer._state_vals_low
+                 if jnp.issubdtype(v.dtype, jnp.floating)]
+    assert float_low and all(v.dtype == jnp.bfloat16 for v in float_low)
+    (out,) = pred.run([x])
+    np.testing.assert_allclose(out, ref, rtol=2e-2, atol=2e-2)  # bf16 noise
+    # deleting the pass keeps full precision
+    cfg2 = inference.Config(path)
+    cfg2.enable_low_precision("bfloat16")
+    cfg2.pass_builder().delete_pass("weights_bf16_residency_pass")
+    pred2 = inference.create_predictor(cfg2)
+    assert "weights_bf16_residency_pass" not in pred2.applied_passes()
+    (out2,) = pred2.run([x])
+    np.testing.assert_allclose(out2, ref, rtol=1e-5)
+
+
+def test_memory_optim_gates_donation_pass(tmp_path):
+    _, path = _saved_model(tmp_path)
+    cfg = inference.Config(path)
+    cfg.enable_memory_optim()
+    pred = inference.create_predictor(cfg)
+    assert "donate_input_buffers_pass" in pred.applied_passes()
+    cfg2 = inference.Config(path)
+    pred2 = inference.create_predictor(cfg2)
+    assert "donate_input_buffers_pass" not in pred2.applied_passes()
+    assert "applied" in cfg.summary() or cfg.summary() == ""
