@@ -249,7 +249,6 @@ class Predictor:
         if not dtype:
             return False
         layer = self._layer
-        low = jnp.bfloat16 if dtype == "bfloat16" else jnp.float16
         names = layer._param_names
         if not names:
             return False
@@ -339,10 +338,11 @@ class Predictor:
                        if self._inputs[n]._value is None]
             raise RuntimeError(f"inputs not set: {missing}")
         out = self._layer(*xs)
-        if getattr(self, "_donate_inputs", False):
-            # memory_optim: the uploaded input buffers are not held by the
-            # handles past the run — the device allocator can reuse them
-            # immediately (the substrate's face of buffer donation)
+        if getattr(self, "_donate_inputs", False) and inputs is not None:
+            # memory_optim: in the list-call form (fresh inputs per run)
+            # the uploaded buffers are not held past the run — the device
+            # allocator reuses them immediately. The HANDLE protocol keeps
+            # its buffers (set-once, run-repeatedly is documented usage).
             for n in self._input_names:
                 self._inputs[n]._value = None
         outs = out if isinstance(out, (list, tuple)) else [out]

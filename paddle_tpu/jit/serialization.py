@@ -50,10 +50,26 @@ def save(layer, path, input_spec=None, **configs):
         elif hasattr(s, "shape") and hasattr(s, "dtype") \
                 and not isinstance(s, np.ndarray):
             # static.InputSpec (the reference's canonical input_spec
-            # element): dynamic dims (None/-1) trace as 1
-            shape = tuple(1 if d is None or (isinstance(d, int) and d < 0)
-                          else int(d) for d in s.shape)
-            specs.append(jax.ShapeDtypeStruct(shape, np.dtype(s.dtype)))
+            # element): dynamic dims (None/-1) become SYMBOLIC export
+            # dimensions, so the saved program accepts any size there —
+            # the reference's any-batch semantics, not a frozen 1
+            dyn = [d is None or (isinstance(d, int) and d < 0)
+                   for d in s.shape]
+            if any(dyn):
+                names = []
+                shape_parts = []
+                for i, (d, is_dyn) in enumerate(zip(s.shape, dyn)):
+                    if is_dyn:
+                        nm = f"_d{len(specs)}_{i}"
+                        names.append(nm)
+                        shape_parts.append(nm)
+                    else:
+                        shape_parts.append(str(int(d)))
+                sym = jax.export.symbolic_shape(", ".join(shape_parts))
+                specs.append(jax.ShapeDtypeStruct(sym, np.dtype(s.dtype)))
+            else:
+                specs.append(jax.ShapeDtypeStruct(
+                    tuple(int(d) for d in s.shape), np.dtype(s.dtype)))
         else:
             arr = np.asarray(s)
             specs.append(jax.ShapeDtypeStruct(arr.shape, arr.dtype))
@@ -96,8 +112,12 @@ def save(layer, path, input_spec=None, **configs):
     with open(path + ".meta", "wb") as f:
         pickle.dump({
             "param_names": names,
-            "input_specs": [(tuple(s.shape), str(np.dtype(s.dtype)))
-                            for s in specs],
+            "input_specs": [
+                # symbolic export dims serialize as -1 (dynamic marker)
+                (tuple(int(d) if str(d).isdigit() else -1
+                       for d in (str(x) for x in s.shape)),
+                 str(np.dtype(s.dtype)))
+                for s in specs],
         }, f, protocol=4)
 
 

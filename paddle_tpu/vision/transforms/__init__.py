@@ -2,6 +2,7 @@
 
 from __future__ import annotations
 
+import math
 import random
 
 import numpy as np
@@ -218,3 +219,166 @@ class Transpose(BaseTransform):
 
     def _apply_image(self, img):
         return np.transpose(F._as_hwc(img), self.order)
+
+
+class SaturationTransform(BaseTransform):
+    """reference transforms.py:980 — factor sampled in
+    [max(0, 1-value), 1+value]."""
+
+    def __init__(self, value, keys=None):
+        super().__init__(keys)
+        self.value = value
+
+    def _apply_image(self, img):
+        if self.value == 0:
+            return img
+        f = random.uniform(max(0.0, 1 - self.value), 1 + self.value)
+        return F.adjust_saturation(img, f)
+
+
+class HueTransform(BaseTransform):
+    """reference transforms.py:1022 — shift sampled in [-value, value],
+    value <= 0.5."""
+
+    def __init__(self, value, keys=None):
+        super().__init__(keys)
+        if not 0 <= value <= 0.5:
+            raise ValueError("hue value must be in [0, 0.5]")
+        self.value = value
+
+    def _apply_image(self, img):
+        if self.value == 0:
+            return img
+        return F.adjust_hue(img, random.uniform(-self.value, self.value))
+
+
+class ColorJitter(BaseTransform):
+    """reference transforms.py:1067 — brightness/contrast/saturation/hue
+    jitters applied in random order."""
+
+    def __init__(self, brightness=0, contrast=0, saturation=0, hue=0,
+                 keys=None):
+        super().__init__(keys)
+        self.transforms = [
+            BrightnessTransform(brightness),
+            ContrastTransform(contrast),
+            SaturationTransform(saturation),
+            HueTransform(hue),
+        ]
+
+    def _apply_image(self, img):
+        order = list(range(4))
+        random.shuffle(order)
+        for i in order:
+            img = self.transforms[i](img)
+        return img
+
+
+class RandomAffine(BaseTransform):
+    """reference transforms.py:1385 — random rotation/translate/scale/
+    shear in one affine warp."""
+
+    def __init__(self, degrees, translate=None, scale=None, shear=None,
+                 interpolation="nearest", fill=0, center=None, keys=None):
+        super().__init__(keys)
+        self.degrees = ((-degrees, degrees)
+                        if isinstance(degrees, (int, float)) else
+                        tuple(degrees))
+        self.translate = translate
+        self.scale = scale
+        self.shear = shear
+        self.interpolation = interpolation
+        self.fill = fill
+        self.center = center
+
+    def _apply_image(self, img):
+        img_hwc = F._as_hwc(img)
+        H, W = img_hwc.shape[:2]
+        angle = random.uniform(*self.degrees)
+        if self.translate is not None:
+            tx = random.uniform(-self.translate[0], self.translate[0]) * W
+            ty = random.uniform(-self.translate[1], self.translate[1]) * H
+        else:
+            tx = ty = 0.0
+        scale = (random.uniform(*self.scale)
+                 if self.scale is not None else 1.0)
+        if self.shear is None:
+            shear = (0.0, 0.0)
+        elif isinstance(self.shear, (int, float)):
+            shear = (random.uniform(-self.shear, self.shear), 0.0)
+        else:
+            sh = list(self.shear)
+            shear = ((random.uniform(sh[0], sh[1]), 0.0) if len(sh) == 2
+                     else (random.uniform(sh[0], sh[1]),
+                           random.uniform(sh[2], sh[3])))
+        return F.affine(img_hwc, angle, (tx, ty), scale, shear,
+                        self.interpolation, self.fill, self.center)
+
+
+class RandomPerspective(BaseTransform):
+    """reference transforms.py:1650 — with probability ``prob``, warp by
+    corners jittered up to distortion_scale."""
+
+    def __init__(self, prob=0.5, distortion_scale=0.5,
+                 interpolation="nearest", fill=0, keys=None):
+        super().__init__(keys)
+        self.prob = prob
+        self.distortion_scale = distortion_scale
+        self.interpolation = interpolation
+        self.fill = fill
+
+    def _apply_image(self, img):
+        if random.random() >= self.prob:
+            return img
+        arr = F._as_hwc(img)
+        H, W = arr.shape[:2]
+        dx = self.distortion_scale * W / 2
+        dy = self.distortion_scale * H / 2
+        start = [(0, 0), (W - 1, 0), (W - 1, H - 1), (0, H - 1)]
+        end = [(random.uniform(0, dx), random.uniform(0, dy)),
+               (W - 1 - random.uniform(0, dx), random.uniform(0, dy)),
+               (W - 1 - random.uniform(0, dx), H - 1 - random.uniform(0, dy)),
+               (random.uniform(0, dx), H - 1 - random.uniform(0, dy))]
+        return F.perspective(arr, start, end, self.interpolation, self.fill)
+
+
+class RandomErasing(BaseTransform):
+    """reference transforms.py:1832 — erase a random region with value /
+    random noise."""
+
+    def __init__(self, prob=0.5, scale=(0.02, 0.33), ratio=(0.3, 3.3),
+                 value=0, inplace=False, keys=None):
+        super().__init__(keys)
+        self.prob = prob
+        self.scale = scale
+        self.ratio = ratio
+        self.value = value
+        self.inplace = inplace
+
+    def _apply_image(self, img):
+        if random.random() >= self.prob:
+            return img
+        arr = F._as_hwc(img)
+        H, W, C = arr.shape
+        area = H * W
+        for _ in range(10):
+            target = random.uniform(*self.scale) * area
+            ar = math.exp(random.uniform(math.log(self.ratio[0]),
+                                         math.log(self.ratio[1])))
+            h = int(round(math.sqrt(target * ar)))
+            w = int(round(math.sqrt(target / ar)))
+            if h < H and w < W and h > 0 and w > 0:
+                i = random.randint(0, H - h)
+                j = random.randint(0, W - w)
+                if self.value == "random":
+                    rng = np.random.default_rng()
+                    if arr.dtype == np.uint8:
+                        v = rng.integers(0, 256, (h, w, C),
+                                         dtype=np.uint8)
+                    else:
+                        v = rng.standard_normal((h, w, C)) \
+                            .astype(arr.dtype)
+                else:
+                    v = self.value
+                return F.erase(arr, i, j, h, w, v, self.inplace)
+        return arr

@@ -155,3 +155,181 @@ def rotate(img, angle, interpolation="nearest", expand=False, center=None, fill=
     out = np.full_like(img, fill)
     out[valid] = img[yi[valid], xi[valid]]
     return out
+
+
+def adjust_saturation(img, factor):
+    """Blend toward the grayscale image (reference functional
+    adjust_saturation): factor 0 = gray, 1 = original."""
+    arr = _as_hwc(img)
+    if arr.shape[-1] == 1:
+        return arr  # grayscale: saturation is undefined/no-op
+    arr = arr.astype(np.float32)
+    gray = (0.299 * arr[..., 0] + 0.587 * arr[..., 1]
+            + 0.114 * arr[..., 2])[..., None]
+    out = gray + factor * (arr - gray)
+    return np.clip(out, 0, 255 if img.dtype == np.uint8 else None) \
+        .astype(img.dtype) if isinstance(img, np.ndarray) else out
+
+
+def _rgb_to_hsv(arr):
+    r, g, b = arr[..., 0], arr[..., 1], arr[..., 2]
+    maxc = np.max(arr, -1)
+    minc = np.min(arr, -1)
+    v = maxc
+    delta = maxc - minc
+    s = np.where(maxc > 0, delta / np.maximum(maxc, 1e-12), 0.0)
+    dz = np.maximum(delta, 1e-12)
+    rc = (maxc - r) / dz
+    gc = (maxc - g) / dz
+    bc = (maxc - b) / dz
+    h = np.where(r == maxc, bc - gc,
+                 np.where(g == maxc, 2.0 + rc - bc, 4.0 + gc - rc))
+    h = (h / 6.0) % 1.0
+    h = np.where(delta == 0, 0.0, h)
+    return np.stack([h, s, v], -1)
+
+
+def _hsv_to_rgb(hsv):
+    h, s, v = hsv[..., 0], hsv[..., 1], hsv[..., 2]
+    i = np.floor(h * 6.0)
+    f = h * 6.0 - i
+    p = v * (1.0 - s)
+    q = v * (1.0 - s * f)
+    t = v * (1.0 - s * (1.0 - f))
+    i = i.astype(np.int32) % 6
+    out = np.zeros(hsv.shape, np.float32)
+    for idx, (rr, gg, bb) in enumerate(
+            [(v, t, p), (q, v, p), (p, v, t), (p, q, v), (t, p, v),
+             (v, p, q)]):
+        m = i == idx
+        out[..., 0] = np.where(m, rr, out[..., 0])
+        out[..., 1] = np.where(m, gg, out[..., 1])
+        out[..., 2] = np.where(m, bb, out[..., 2])
+    return out
+
+
+def adjust_hue(img, factor):
+    """Shift hue by ``factor`` (in [-0.5, 0.5] of the hue circle)."""
+    if not -0.5 <= factor <= 0.5:
+        raise ValueError("hue factor must be in [-0.5, 0.5]")
+    arr = _as_hwc(img)
+    if arr.shape[-1] == 1:
+        return arr  # grayscale: hue is undefined/no-op
+    arr = arr.astype(np.float32)
+    scale = 255.0 if img.dtype == np.uint8 else 1.0
+    hsv = _rgb_to_hsv(arr / scale)
+    hsv[..., 0] = (hsv[..., 0] + factor) % 1.0
+    out = _hsv_to_rgb(hsv) * scale
+    return np.clip(out, 0, 255 if img.dtype == np.uint8 else None) \
+        .astype(img.dtype)
+
+
+def erase(img, i, j, h, w, v, inplace=False):
+    """Set img[i:i+h, j:j+w] to value v (reference functional erase)."""
+    arr = _as_hwc(img)
+    if not inplace:
+        arr = arr.copy()
+    arr[i:i + h, j:j + w] = v
+    return arr
+
+
+def _inverse_map_sample(arr, inv_coeffs, interpolation="nearest", fill=0):
+    """Sample ``arr`` through an inverse coordinate map.
+
+    inv_coeffs: callable (x_out, y_out) -> (x_src, y_src) arrays."""
+    H, W = arr.shape[:2]
+    ys, xs = np.meshgrid(np.arange(H, dtype=np.float32),
+                         np.arange(W, dtype=np.float32), indexing="ij")
+    sx, sy = inv_coeffs(xs, ys)
+    if interpolation == "bilinear":
+        x0 = np.floor(sx).astype(np.int64)
+        y0 = np.floor(sy).astype(np.int64)
+        wx = (sx - x0)[..., None]
+        wy = (sy - y0)[..., None]
+
+        def at(yy, xx):
+            valid = (yy >= 0) & (yy < H) & (xx >= 0) & (xx < W)
+            yc = np.clip(yy, 0, H - 1)
+            xc = np.clip(xx, 0, W - 1)
+            px = arr[yc, xc].astype(np.float32)
+            return np.where(valid[..., None], px, np.float32(fill))
+
+        out = ((1 - wy) * ((1 - wx) * at(y0, x0) + wx * at(y0, x0 + 1))
+               + wy * ((1 - wx) * at(y0 + 1, x0) + wx * at(y0 + 1, x0 + 1)))
+    else:
+        xr = np.round(sx).astype(np.int64)
+        yr = np.round(sy).astype(np.int64)
+        valid = (yr >= 0) & (yr < H) & (xr >= 0) & (xr < W)
+        yc = np.clip(yr, 0, H - 1)
+        xc = np.clip(xr, 0, W - 1)
+        out = np.where(valid[..., None],
+                       arr[yc, xc].astype(np.float32), np.float32(fill))
+    if arr.dtype == np.uint8:
+        out = np.clip(out, 0, 255)
+    return out.astype(arr.dtype)
+
+
+def affine(img, angle=0.0, translate=(0, 0), scale=1.0, shear=(0.0, 0.0),
+           interpolation="nearest", fill=0, center=None):
+    """Affine warp (reference functional affine): rotate/translate/scale/
+    shear about ``center``, inverse-mapped so every output pixel samples
+    its source."""
+    import math as _m
+
+    arr = _as_hwc(img)
+    H, W = arr.shape[:2]
+    if center is None:
+        center = ((W - 1) * 0.5, (H - 1) * 0.5)
+    if np.isscalar(shear):
+        shear = (float(shear), 0.0)
+    rot = _m.radians(angle)
+    sx, sy = (_m.radians(s) for s in shear)
+    cx, cy = center
+    tx, ty = translate
+    # forward matrix M = T(center) R S Sh T(-center) + translate; invert
+    a = _m.cos(rot - sy) / _m.cos(sy)
+    b = -_m.cos(rot - sy) * _m.tan(sx) / _m.cos(sy) - _m.sin(rot)
+    c = _m.sin(rot - sy) / _m.cos(sy)
+    d = -_m.sin(rot - sy) * _m.tan(sx) / _m.cos(sy) + _m.cos(rot)
+    M = np.array([[scale * a, scale * b], [scale * c, scale * d]],
+                 np.float64)
+    Minv = np.linalg.inv(M)
+
+    def inv(xo, yo):
+        xr = xo - cx - tx
+        yr = yo - cy - ty
+        xs = Minv[0, 0] * xr + Minv[0, 1] * yr + cx
+        ys = Minv[1, 0] * xr + Minv[1, 1] * yr + cy
+        return xs.astype(np.float32), ys.astype(np.float32)
+
+    return _inverse_map_sample(arr, inv, interpolation, fill)
+
+
+def _perspective_coeffs(startpoints, endpoints):
+    """8 homography coefficients mapping endpoints -> startpoints
+    (the INVERSE map, as sampling wants)."""
+    a = []
+    b = []
+    for (sx, sy), (ex, ey) in zip(startpoints, endpoints):
+        a.append([ex, ey, 1, 0, 0, 0, -sx * ex, -sx * ey])
+        a.append([0, 0, 0, ex, ey, 1, -sy * ex, -sy * ey])
+        b += [sx, sy]
+    coeffs, *_ = np.linalg.lstsq(np.asarray(a, np.float64),
+                                 np.asarray(b, np.float64), rcond=None)
+    return coeffs
+
+
+def perspective(img, startpoints, endpoints, interpolation="nearest",
+                fill=0):
+    """Perspective warp by 4 point correspondences (reference functional
+    perspective)."""
+    arr = _as_hwc(img)
+    co = _perspective_coeffs(startpoints, endpoints)
+
+    def inv(xo, yo):
+        den = co[6] * xo + co[7] * yo + 1.0
+        xs = (co[0] * xo + co[1] * yo + co[2]) / den
+        ys = (co[3] * xo + co[4] * yo + co[5]) / den
+        return xs.astype(np.float32), ys.astype(np.float32)
+
+    return _inverse_map_sample(arr, inv, interpolation, fill)

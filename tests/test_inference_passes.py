@@ -85,3 +85,45 @@ def test_memory_optim_gates_donation_pass(tmp_path):
     pred2 = inference.create_predictor(cfg2)
     assert "donate_input_buffers_pass" not in pred2.applied_passes()
     assert "applied" in cfg.summary() or cfg.summary() == ""
+
+
+def test_dynamic_batch_inputspec_roundtrip(tmp_path):
+    """InputSpec([None, 8]) must export a program accepting ANY batch
+    (symbolic export dims, the reference's any-batch semantics)."""
+    import paddle_tpu.nn as nn
+    from paddle_tpu.jit import save
+    from paddle_tpu.static import InputSpec
+
+    paddle.seed(3)
+    model = nn.Sequential(nn.Linear(8, 4))
+    model.eval()
+    path = str(tmp_path / "dyn")
+    save(model, path, input_spec=[InputSpec([None, 8], "float32")])
+    pred = inference.create_predictor(inference.Config(path))
+    for batch in (1, 2, 32):
+        x = np.random.default_rng(batch).standard_normal(
+            (batch, 8)).astype(np.float32)
+        (out,) = pred.run([x])
+        ref = np.asarray(model(paddle.to_tensor(x)).numpy())
+        np.testing.assert_allclose(out, ref, rtol=1e-5)
+
+
+def test_donation_preserves_handle_protocol(tmp_path):
+    """Set-handles-once + run() repeatedly must keep working under
+    enable_memory_optim (donation only applies to the list-call form)."""
+    _, path = _saved_model(tmp_path)
+    cfg = inference.Config(path)
+    cfg.enable_memory_optim()
+    pred = inference.create_predictor(cfg)
+    x = np.random.default_rng(0).standard_normal((2, 8)).astype(np.float32)
+    h = pred.get_input_handle("x0")
+    h.copy_from_cpu(x)
+    pred.run()
+    out1 = pred.get_output_handle("out0").copy_to_cpu()
+    pred.run()  # handle buffers must survive
+    out2 = pred.get_output_handle("out0").copy_to_cpu()
+    np.testing.assert_allclose(out1, out2)
+    # list form: buffers released after run
+    (out3,) = pred.run([x])
+    assert pred._inputs["x0"]._value is None
+    np.testing.assert_allclose(out3, out1, rtol=1e-6)

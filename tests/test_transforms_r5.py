@@ -1,0 +1,99 @@
+"""r5 vision transforms closure (reference transforms.py:980 Saturation,
+:1022 Hue, :1067 ColorJitter, :1385 RandomAffine, :1650 RandomPerspective,
+:1832 RandomErasing) — analytic oracles: saturation-0 = grayscale, hue
+half-turn red->cyan, identity affine/perspective = identity, 90-degree
+affine = rot90, erase zeroes the region."""
+
+import numpy as np
+
+from paddle_tpu.vision import transforms as T
+from paddle_tpu.vision.transforms import functional as F
+
+
+def _img():
+    rng = np.random.default_rng(0)
+    return rng.integers(0, 255, (16, 12, 3)).astype(np.uint8)
+
+
+def test_adjust_saturation_zero_is_grayscale():
+    img = _img()
+    out = F.adjust_saturation(img, 0.0)
+    assert np.ptp(out.astype(np.int32), axis=-1).max() <= 1  # channels equal
+    same = F.adjust_saturation(img, 1.0)
+    np.testing.assert_allclose(same, img, atol=1)
+
+
+def test_adjust_hue_half_turn_red_to_cyan():
+    red = np.zeros((2, 2, 3), np.uint8)
+    red[..., 0] = 255
+    cyan = F.adjust_hue(red, 0.5)
+    assert cyan[0, 0, 0] < 10 and cyan[0, 0, 1] > 245 and cyan[0, 0, 2] > 245
+    back = F.adjust_hue(red, 0.0)
+    np.testing.assert_allclose(back, red, atol=1)
+    try:
+        F.adjust_hue(red, 0.7)
+        assert False
+    except ValueError:
+        pass
+
+
+def test_affine_identity_and_rot90():
+    img = _img()
+    ident = F.affine(img, angle=0.0)
+    np.testing.assert_array_equal(ident, img)
+    sq = img[:12, :12]
+    rot = F.affine(sq, angle=90.0, interpolation="nearest")
+    # same angle convention as the repo's existing F.rotate
+    np.testing.assert_array_equal(rot, F.rotate(sq, 90))
+    np.testing.assert_array_equal(rot, np.rot90(sq, -1))
+
+
+def test_perspective_identity_and_shift():
+    img = _img()
+    H, W = img.shape[:2]
+    corners = [(0, 0), (W - 1, 0), (W - 1, H - 1), (0, H - 1)]
+    ident = F.perspective(img, corners, corners)
+    np.testing.assert_array_equal(ident, img)
+    # shifting endpoints right by 2 samples source from the left
+    shifted = F.perspective(
+        img, corners, [(x + 2, y) for x, y in corners])
+    np.testing.assert_array_equal(shifted[:, 2:], img[:, :-2])
+
+
+def test_erase_region():
+    img = _img()
+    out = F.erase(img, 2, 3, 4, 5, 0)
+    assert (out[2:6, 3:8] == 0).all()
+    assert (out[:2] == img[:2]).all()
+    assert (img[2:6, 3:8] != 0).any()  # not inplace by default
+
+
+def test_transform_classes_run_and_change_or_preserve():
+    import random
+
+    random.seed(0)
+    img = _img()
+    for t in (T.SaturationTransform(0.4), T.HueTransform(0.2),
+              T.ColorJitter(0.3, 0.3, 0.3, 0.2),
+              T.RandomAffine(15, translate=(0.1, 0.1), scale=(0.8, 1.2),
+                             shear=5),
+              T.RandomPerspective(prob=1.0, distortion_scale=0.3),
+              T.RandomErasing(prob=1.0)):
+        out = t(img)
+        assert out.shape == img.shape, type(t).__name__
+        assert out.dtype == img.dtype, type(t).__name__
+    # prob=0 transforms are identity
+    np.testing.assert_array_equal(T.RandomErasing(prob=0.0)(img), img)
+    np.testing.assert_array_equal(T.RandomPerspective(prob=0.0)(img), img)
+    erased = T.RandomErasing(prob=1.0)(img)
+    assert (erased == 0).any()
+
+
+def test_compose_pipeline_with_new_transforms():
+    import random
+
+    random.seed(1)
+    pipe = T.Compose([T.Resize(14), T.ColorJitter(0.2, 0.2, 0.2, 0.1),
+                      T.RandomErasing(prob=1.0), T.ToTensor()])
+    out = pipe(_img())
+    assert tuple(out.shape)[0] == 3  # CHW tensor out
