@@ -42,6 +42,8 @@ def save(layer, path, input_spec=None, **configs):
         raise ValueError("jit.save requires input_spec (example inputs)")
 
     specs = []
+    _sym_scope = None  # ONE scope for every dynamic dim (mixing scopes
+    # across specs is an export error)
     for s in input_spec:
         if isinstance(s, Tensor):
             specs.append(jax.ShapeDtypeStruct(tuple(s.shape), s.dtype))
@@ -56,16 +58,20 @@ def save(layer, path, input_spec=None, **configs):
             dyn = [d is None or (isinstance(d, int) and d < 0)
                    for d in s.shape]
             if any(dyn):
-                names = []
+                if _sym_scope is None:
+                    _sym_scope = jax.export.SymbolicScope()
                 shape_parts = []
                 for i, (d, is_dyn) in enumerate(zip(s.shape, dyn)):
                     if is_dyn:
-                        nm = f"_d{len(specs)}_{i}"
-                        names.append(nm)
-                        shape_parts.append(nm)
+                        # dims at the SAME axis position unify across
+                        # inputs (the shared-batch contract): a model
+                        # combining two dynamic-batch inputs stays
+                        # shape-checkable
+                        shape_parts.append(f"_dyn{i}")
                     else:
                         shape_parts.append(str(int(d)))
-                sym = jax.export.symbolic_shape(", ".join(shape_parts))
+                sym = jax.export.symbolic_shape(", ".join(shape_parts),
+                                                scope=_sym_scope)
                 specs.append(jax.ShapeDtypeStruct(sym, np.dtype(s.dtype)))
             else:
                 specs.append(jax.ShapeDtypeStruct(

@@ -1,0 +1,111 @@
+"""Deformable convolution v1/v2 (reference: python/paddle/vision/ops.py:753
+deform_conv2d, :960 DeformConv2D; CUDA kernel
+paddle/phi/kernels/gpu/deformable_conv_kernel.cu).
+
+tpu-native design: instead of the reference's per-thread im2col gather
+kernel, each of the K = kh*kw kernel taps becomes one VECTORIZED bilinear
+sample of the whole feature map at offset positions (pure jnp gather —
+differentiable through offsets, mask, weights and input), followed by a
+grouped 1x1 contraction per tap. The K-loop is a static Python loop (K is
+a compile-time constant), so XLA sees K fused gather+matmul stages — MXU
+work stays in the contractions, no scalar loops."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.core.dispatch import apply
+from paddle_tpu.ops.registry import register_op
+
+
+def _pair(v):
+    return (v, v) if isinstance(v, int) else tuple(v)
+
+
+def _bilinear_sample_nchw(x, py, px):
+    """Sample x [N, C, H, W] at float positions py/px [N, Ho, Wo] with
+    zero padding outside; returns [N, C, Ho, Wo]."""
+    N, C, H, W = x.shape
+    y0 = jnp.floor(py)
+    x0 = jnp.floor(px)
+    wy = py - y0
+    wx = px - x0
+
+    def tap(yy, xx):
+        valid = ((yy >= 0) & (yy <= H - 1) & (xx >= 0) & (xx <= W - 1))
+        yc = jnp.clip(yy, 0, H - 1).astype(jnp.int32)
+        xc = jnp.clip(xx, 0, W - 1).astype(jnp.int32)
+        flat = x.reshape(N, C, H * W)
+        idx = (yc * W + xc).reshape(N, 1, -1)
+        g = jnp.take_along_axis(flat, jnp.broadcast_to(
+            idx, (N, C, idx.shape[-1])), axis=2)
+        g = g.reshape(N, C, *yy.shape[1:])
+        return jnp.where(valid[:, None], g, 0.0)
+
+    out = ((1 - wy) * (1 - wx))[:, None] * tap(y0, x0) \
+        + ((1 - wy) * wx)[:, None] * tap(y0, x0 + 1) \
+        + (wy * (1 - wx))[:, None] * tap(y0 + 1, x0) \
+        + (wy * wx)[:, None] * tap(y0 + 1, x0 + 1)
+    return out
+
+
+@register_op("deformable_conv")
+def deform_conv2d(x, offset, weight, bias=None, stride=1, padding=0,
+                  dilation=1, deformable_groups=1, groups=1, mask=None,
+                  name=None):
+    """v2 when ``mask`` is given, v1 otherwise.
+
+    x [N, Cin, H, W]; offset [N, 2*dg*kh*kw, Ho, Wo] (y/x interleaved per
+    tap, reference layout); weight [Cout, Cin/groups, kh, kw];
+    mask [N, dg*kh*kw, Ho, Wo]."""
+    sh, sw = _pair(stride)
+    ph, pw = _pair(padding)
+    dh, dw = _pair(dilation)
+
+    def f(xv, offv, wv, *rest):
+        it = iter(rest)
+        bv = next(it) if bias is not None else None
+        mv = next(it) if mask is not None else None
+        N, Cin, H, W = xv.shape
+        Cout, _, kh, kw = wv.shape
+        K = kh * kw
+        dg = deformable_groups
+        Ho = (H + 2 * ph - (dh * (kh - 1) + 1)) // sh + 1
+        Wo = (W + 2 * pw - (dw * (kw - 1) + 1)) // sw + 1
+        offv = offv.reshape(N, dg, K, 2, Ho, Wo)
+        if mv is not None:
+            mv = mv.reshape(N, dg, K, Ho, Wo)
+
+        base_y = (jnp.arange(Ho) * sh - ph)[:, None]           # [Ho, 1]
+        base_x = (jnp.arange(Wo) * sw - pw)[None, :]           # [1, Wo]
+        cg = Cin // dg
+        xg = xv.reshape(N, dg, cg, H, W).reshape(N * dg, cg, H, W)
+
+        sampled = []
+        for k in range(K):
+            ky, kx = divmod(k, kw)
+            py = (base_y + ky * dh)[None] + offv[:, :, k, 0]   # [N,dg,Ho,Wo]
+            px = (base_x + kx * dw)[None] + offv[:, :, k, 1]
+            s = _bilinear_sample_nchw(
+                xg, py.reshape(N * dg, Ho, Wo), px.reshape(N * dg, Ho, Wo))
+            s = s.reshape(N, dg, cg, Ho, Wo)
+            if mv is not None:
+                s = s * mv[:, :, k][:, :, None]
+            sampled.append(s.reshape(N, Cin, Ho, Wo))
+        # [N, K, Cin, Ho, Wo] -> grouped contraction with weight taps
+        col = jnp.stack(sampled, axis=1)
+        g = groups
+        cing = Cin // g
+        coutg = Cout // g
+        col = col.reshape(N, K, g, cing, Ho, Wo)
+        wk = wv.reshape(g, coutg, cing, kh * kw)
+        out = jnp.einsum("nkgchw,gock->ngohw", col, wk,
+                         preferred_element_type=jnp.float32)
+        out = out.reshape(N, Cout, Ho, Wo).astype(xv.dtype)
+        if bv is not None:
+            out = out + bv.reshape(1, -1, 1, 1)
+        return out
+
+    args = [a for a in (bias, mask) if a is not None]
+    return apply("deformable_conv", f, x, offset, weight, *args)

@@ -79,3 +79,141 @@ def nms(boxes, iou_threshold=0.3, scores=None, category_idxs=None,
     if top_k is not None:
         keep = keep[:top_k]
     return paddle.to_tensor(keep)
+
+
+# --- r5 namespace closure (reference python/paddle/vision/ops.py) ----------
+from paddle_tpu.ops.deform_conv import deform_conv2d  # noqa: E402,F401
+from paddle_tpu.ops.detection_ops import (  # noqa: E402,F401
+    box_coder,
+    generate_proposals,
+    matrix_nms,
+    prior_box,
+    psroi_pool,
+    roi_align,
+    roi_pool,
+    yolo_box,
+    yolo_loss,
+)
+
+
+def distribute_fpn_proposals(fpn_rois, min_level, max_level, refer_level,
+                             refer_scale, pixel_offset=False, rois_num=None,
+                             name=None):
+    """Assign RoIs to FPN levels by scale (reference vision/ops.py:1156):
+    level = floor(log2(sqrt(area)/refer_scale) + refer_level). Returns
+    (multi_rois list, restore_ind, rois_num_per_level or None)."""
+    import numpy as np
+
+    import paddle_tpu as paddle
+
+    rois = np.asarray(fpn_rois.numpy() if hasattr(fpn_rois, "numpy")
+                      else fpn_rois, np.float32)
+    off = 1.0 if pixel_offset else 0.0
+    w = rois[:, 2] - rois[:, 0] + off
+    h = rois[:, 3] - rois[:, 1] + off
+    scale = np.sqrt(np.maximum(w * h, 1e-12))
+    level = np.floor(np.log2(scale / float(refer_scale) + 1e-8)
+                     + refer_level)
+    level = np.clip(level, min_level, max_level).astype(np.int64)
+    # per-roi image index from the per-image counts (reference contract:
+    # rois_num_per_level is a LIST of [batch] count tensors, one per level)
+    if rois_num is not None:
+        counts = np.asarray(rois_num.numpy() if hasattr(rois_num, "numpy")
+                            else rois_num, np.int64).ravel()
+        img_of = np.repeat(np.arange(len(counts)), counts)
+    else:
+        counts = None
+        img_of = None
+    multi_rois, nums_per_level = [], []
+    order = []
+    for lv in range(min_level, max_level + 1):
+        idx = np.where(level == lv)[0]
+        order.append(idx)
+        multi_rois.append(paddle.to_tensor(rois[idx]))
+        if counts is not None:
+            per_img = np.bincount(img_of[idx], minlength=len(counts))
+            nums_per_level.append(
+                paddle.to_tensor(per_img.astype(np.int32)))
+    order = np.concatenate(order) if order else np.zeros(0, np.int64)
+    restore_ind = np.empty_like(order)
+    restore_ind[order] = np.arange(len(order))
+    restore = paddle.to_tensor(restore_ind.reshape(-1, 1))
+    return multi_rois, restore, (nums_per_level if counts is not None
+                                 else None)
+
+
+def read_file(filename, name=None):
+    """Raw file bytes as a uint8 tensor (reference vision/ops.py:1301)."""
+    import numpy as np
+
+    import paddle_tpu as paddle
+
+    with open(filename, "rb") as f:
+        data = f.read()
+    return paddle.to_tensor(np.frombuffer(data, np.uint8).copy())
+
+
+def decode_jpeg(x, mode="unchanged", name=None):
+    """Decode a JPEG byte tensor to CHW uint8 (reference vision/
+    ops.py:1344); PIL is the host decoder on this substrate."""
+    import io
+
+    import numpy as np
+    from PIL import Image
+
+    import paddle_tpu as paddle
+
+    data = bytes(np.asarray(x.numpy() if hasattr(x, "numpy") else x,
+                            np.uint8))
+    img = Image.open(io.BytesIO(data))
+    if mode == "gray":
+        img = img.convert("L")
+    elif mode in ("rgb", "RGB"):
+        img = img.convert("RGB")
+    arr = np.asarray(img)
+    if arr.ndim == 2:
+        arr = arr[None]
+    else:
+        arr = arr.transpose(2, 0, 1)
+    return paddle.to_tensor(arr.copy())
+
+
+def __getattr__(name):
+    # lazy re-exports that PRESERVE class identity (isinstance against
+    # paddle.vision.ops.DeformConv2D must hold); defined in vision/layers
+    # to join the nn.Layer machinery without an import cycle here
+    if name in ("DeformConv2D", "ConvNormActivation"):
+        from paddle_tpu.vision import layers as _layers
+
+        return getattr(_layers, name)
+    raise AttributeError(name)
+
+
+class RoIAlign:
+    def __init__(self, output_size, spatial_scale=1.0):
+        self.output_size = output_size
+        self.spatial_scale = spatial_scale
+
+    def __call__(self, x, boxes, boxes_num, aligned=True):
+        return roi_align(x, boxes, boxes_num, self.output_size,
+                         self.spatial_scale, aligned=aligned)
+
+
+class RoIPool:
+    def __init__(self, output_size, spatial_scale=1.0):
+        self.output_size = output_size
+        self.spatial_scale = spatial_scale
+
+    def __call__(self, x, boxes, boxes_num):
+        return roi_pool(x, boxes, boxes_num, self.output_size,
+                        self.spatial_scale)
+
+
+class PSRoIPool:
+    def __init__(self, output_size, spatial_scale=1.0):
+        self.output_size = output_size
+        self.spatial_scale = spatial_scale
+
+    def __call__(self, x, boxes, boxes_num):
+        return psroi_pool(x, boxes, boxes_num, self.output_size,
+                          self.spatial_scale)

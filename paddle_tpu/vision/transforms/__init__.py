@@ -371,7 +371,9 @@ class RandomErasing(BaseTransform):
                 i = random.randint(0, H - h)
                 j = random.randint(0, W - w)
                 if self.value == "random":
-                    rng = np.random.default_rng()
+                    # seeded from the random module so random.seed()
+                    # reproduces fill noise like every other transform
+                    rng = np.random.default_rng(random.getrandbits(32))
                     if arr.dtype == np.uint8:
                         v = rng.integers(0, 256, (h, w, C),
                                          dtype=np.uint8)

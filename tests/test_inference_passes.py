@@ -127,3 +127,33 @@ def test_donation_preserves_handle_protocol(tmp_path):
     (out3,) = pred.run([x])
     assert pred._inputs["x0"]._value is None
     np.testing.assert_allclose(out3, out1, rtol=1e-6)
+
+
+def test_multi_dynamic_inputspec_export(tmp_path):
+    """Two dynamic-dim inputs must share one symbolic scope (r5 review:
+    separate scopes crashed export)."""
+    import paddle_tpu.nn as nn
+    from paddle_tpu.jit import save
+    from paddle_tpu.static import InputSpec
+
+    class TwoIn(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.a = nn.Linear(8, 4)
+            self.b = nn.Linear(4, 4)
+
+        def forward(self, x, y):
+            return self.a(x) + self.b(y)
+
+    paddle.seed(5)
+    m = TwoIn()
+    m.eval()
+    path = str(tmp_path / "two")
+    save(m, path, input_spec=[InputSpec([None, 8], "float32"),
+                              InputSpec([None, 4], "float32")])
+    pred = inference.create_predictor(inference.Config(path))
+    for b in (1, 3):
+        x = np.ones((b, 8), np.float32)
+        y = np.ones((b, 4), np.float32)
+        (out,) = pred.run([x, y])
+        assert out.shape == (b, 4)

@@ -1,0 +1,150 @@
+"""r5 vision.ops closure (reference vision/ops.py:753 deform_conv2d, :960
+DeformConv2D, :1156 distribute_fpn_proposals, :1301 read_file, :1344
+decode_jpeg, :1810 ConvNormActivation + RoI class wrappers). The deform
+oracles are analytic: zero offsets == standard conv; integer offsets ==
+conv over the shifted image; the v2 mask is linear."""
+
+import numpy as np
+import pytest
+
+import jax
+import paddle_tpu as paddle
+from paddle_tpu.vision import ops as V
+
+
+def t(x):
+    return paddle.to_tensor(np.asarray(x))
+
+
+def _conv_ref(x, w, stride=1, padding=0):
+    return np.asarray(jax.lax.conv_general_dilated(
+        x, w, (stride, stride), [(padding, padding)] * 2,
+        dimension_numbers=("NCHW", "OIHW", "NCHW")))
+
+
+def test_deform_conv_zero_offset_is_conv():
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((2, 4, 8, 8)).astype(np.float32)
+    w = rng.standard_normal((6, 4, 3, 3)).astype(np.float32) * 0.2
+    off = np.zeros((2, 2 * 9, 6, 6), np.float32)
+    out = np.asarray(V.deform_conv2d(t(x), t(off), t(w)).numpy())
+    np.testing.assert_allclose(out, _conv_ref(x, w), rtol=1e-4, atol=1e-5)
+
+
+def test_deform_conv_integer_offset_shifts():
+    rng = np.random.default_rng(1)
+    x = rng.standard_normal((1, 2, 10, 10)).astype(np.float32)
+    w = rng.standard_normal((3, 2, 3, 3)).astype(np.float32) * 0.2
+    # constant offset (dy=1, dx=0) on every tap == conv over x shifted up
+    off = np.zeros((1, 18, 8, 8), np.float32)
+    off[:, 0::2] = 1.0  # y components
+    out = np.asarray(V.deform_conv2d(t(x), t(off), t(w)).numpy())
+    x_shift = np.zeros_like(x)
+    x_shift[:, :, :-1] = x[:, :, 1:]
+    ref = _conv_ref(x_shift, w)
+    # interior matches exactly (border rows touch the zero pad)
+    np.testing.assert_allclose(out[:, :, :-1], ref[:, :, :-1],
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_deform_conv_v2_mask_linear_and_grads():
+    rng = np.random.default_rng(2)
+    x = rng.standard_normal((1, 2, 6, 6)).astype(np.float32)
+    w = rng.standard_normal((2, 2, 3, 3)).astype(np.float32) * 0.3
+    off = rng.standard_normal((1, 18, 4, 4)).astype(np.float32) * 0.3
+    mask = np.full((1, 9, 4, 4), 0.5, np.float32)
+    full = np.asarray(V.deform_conv2d(
+        t(x), t(off), t(w), mask=t(np.ones_like(mask))).numpy())
+    half = np.asarray(V.deform_conv2d(t(x), t(off), t(w),
+                                      mask=t(mask)).numpy())
+    np.testing.assert_allclose(half, 0.5 * full, rtol=1e-4, atol=1e-6)
+    # grads flow to offsets (the point of deformable conv)
+    xo, oo, wo = t(x), t(off), t(w)
+    for v in (xo, oo, wo):
+        v.stop_gradient = False
+    loss = paddle.sum(V.deform_conv2d(xo, oo, wo) ** 2)
+    loss.backward()
+    assert np.isfinite(np.asarray(oo.grad.numpy())).all()
+    assert float(np.abs(np.asarray(oo.grad.numpy())).max()) > 0
+
+
+def test_deform_conv_layer_and_groups():
+    paddle.seed(0)
+    layer = V.DeformConv2D(4, 6, 3, padding=1, groups=2,
+                           deformable_groups=2)
+    x = t(np.random.default_rng(3).standard_normal(
+        (1, 4, 6, 6)).astype(np.float32))
+    off = t(np.zeros((1, 2 * 2 * 9, 6, 6), np.float32))
+    out = layer(x, off)
+    assert tuple(out.shape) == (1, 6, 6, 6)
+
+
+def test_distribute_fpn_proposals():
+    rois = np.array([[0, 0, 10, 10],       # small -> low level
+                     [0, 0, 224, 224],     # refer scale -> refer level
+                     [0, 0, 500, 500]],    # large -> high level
+                    np.float32)
+    multi, restore, nums = V.distribute_fpn_proposals(
+        t(rois), 2, 5, 4, 224, rois_num=t(np.array([3], np.int32)))
+    assert len(multi) == 4
+    sizes = [m.shape[0] for m in multi]
+    assert sum(sizes) == 3
+    assert multi[0].shape[0] == 1          # the small roi at level 2
+    assert multi[2].shape[0] == 1          # 224 -> level 4
+    r = np.asarray(restore.numpy()).ravel()
+    cat = np.concatenate([np.asarray(m.numpy()) for m in multi if m.shape[0]])
+    np.testing.assert_allclose(cat[r], rois)
+    assert nums is not None
+
+
+def test_read_file_decode_jpeg(tmp_path):
+    from PIL import Image
+
+    img = np.zeros((8, 8, 3), np.uint8)
+    img[..., 0] = 200
+    p = str(tmp_path / "t.jpg")
+    Image.fromarray(img).save(p, quality=95)
+    data = V.read_file(p)
+    assert data.dtype == paddle.uint8 or "uint8" in str(data.dtype)
+    chw = V.decode_jpeg(data)
+    assert tuple(chw.shape) == (3, 8, 8)
+    arr = np.asarray(chw.numpy())
+    assert arr[0].mean() > 150 and arr[1].mean() < 60
+    gray = V.decode_jpeg(data, mode="gray")
+    assert tuple(gray.shape) == (1, 8, 8)
+
+
+def test_conv_norm_activation_and_roi_wrappers():
+    import paddle_tpu.nn as nn
+
+    paddle.seed(0)
+    block = V.ConvNormActivation(3, 8, 3, stride=2)
+    x = t(np.random.default_rng(4).standard_normal(
+        (2, 3, 8, 8)).astype(np.float32))
+    out = block(x)
+    assert tuple(out.shape) == (2, 8, 4, 4)
+    assert float(np.asarray(out.numpy()).min()) >= 0  # ReLU applied
+
+    feat = t(np.random.default_rng(5).standard_normal(
+        (1, 4, 16, 16)).astype(np.float32))
+    boxes = t(np.array([[0, 0, 8, 8], [4, 4, 12, 12]], np.float32))
+    bn = t(np.array([2], np.int32))
+    ra = V.RoIAlign(output_size=4)(feat, boxes, bn)
+    assert tuple(ra.shape) == (2, 4, 4, 4)
+    rp = V.RoIPool(output_size=4)(feat, boxes, bn)
+    assert tuple(rp.shape) == (2, 4, 4, 4)
+
+
+def test_conv_norm_activation_no_norm_bias():
+    block = V.ConvNormActivation(3, 4, 3, norm_layer=None,
+                                 activation_layer=None)
+    x = t(np.zeros((1, 3, 6, 6), np.float32))
+    out = block(x)
+    assert tuple(out.shape) == (1, 4, 6, 6)
+
+
+def test_ops_class_identity():
+    m = V.DeformConv2D(2, 2, 3)
+    assert isinstance(m, V.DeformConv2D)
+    b = V.ConvNormActivation(2, 2)
+    assert isinstance(b, V.ConvNormActivation)
