@@ -143,3 +143,37 @@ def test_return_none_fall_off():
 
     check(f, t([1.0]))
     check(f, t([-1.0]))
+
+
+def test_return_inside_nested_while():
+    """The flag must break BOTH loop levels (the rewriter appends an
+    if-flag-break per enclosing loop)."""
+    def f(x):
+        i = paddle.to_tensor(0.0)
+        while i < 4.0:
+            j = paddle.to_tensor(0.0)
+            while j < 4.0:
+                x = x + 1.0
+                if paddle.sum(x) > 5.0:
+                    return x * 100.0
+                j = j + 1.0
+            i = i + 1.0
+        return x
+
+    check(f, t([0.0]))      # returns mid-inner-loop
+    check(f, t([-100.0]))   # runs both loops to completion
+
+
+def test_return_inside_for_over_tensor():
+    """for over a TENSOR iterates rows (graph break per row); an early
+    return inside must still capture the right value."""
+    def f(m):
+        acc = paddle.to_tensor(0.0)
+        for row in m:
+            acc = acc + paddle.sum(row)
+            if acc > 2.5:
+                return acc * 10.0
+        return acc
+
+    check(f, t([[1.0, 1.0], [1.0, 1.0], [5.0, 5.0]]))  # early at row 2
+    check(f, t([[0.1, 0.1], [0.1, 0.1]]))              # completes
