@@ -640,7 +640,10 @@ def _probe_once(timeout_s):
         return None
 
 
-def _probe_backend(attempts=5, timeout_s=120, backoff_s=45):
+_PROBE_ATTEMPTS = 5
+
+
+def _probe_backend(attempts=_PROBE_ATTEMPTS, timeout_s=120, backoff_s=45):
     """Probe with retries + backoff (worst case ~13 min: 5 x 120 s hung
     probes + 4 x 45 s sleeps; a LIVE backend answers the first probe in
     seconds).
@@ -698,6 +701,7 @@ def main():
     import subprocess
     import sys
 
+    t_probe = time.time()
     plat = _probe_backend()
     if plat is None:
         print(json.dumps({
@@ -705,6 +709,8 @@ def main():
             "vs_baseline": None,
             "error": "device backend unreachable (dead tunnel?) - "
                      "probe retries exhausted",
+            "probe_attempts": _PROBE_ATTEMPTS,
+            "probe_wall_s": round(time.time() - t_probe, 1),
         }))
         return
 
