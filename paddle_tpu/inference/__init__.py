@@ -161,6 +161,16 @@ class Config:
         (reference AnalysisConfig::pass_builder)."""
         return self._pass_builder
 
+    def to_scheduler_config(self, **overrides):
+        """Bridge the deployment knobs into a serving ``SchedulerConfig``
+        (the APPLIED face of these flags on the continuous-batching tier):
+        ``enable_memory_optim`` drives paged-KV preemption-on-exhaustion and
+        ``enable_low_precision`` sets the KV-cache residency dtype. Keyword
+        overrides win over bridged values."""
+        from paddle_tpu.serving import SchedulerConfig
+
+        return SchedulerConfig.from_inference_config(self, **overrides)
+
     def enable_low_precision(self, dtype="bfloat16"):
         """APPLIED: park the loaded weights in ``dtype`` residency
         (halves weight HBM/host footprint; values cast back to the

@@ -44,7 +44,7 @@ from paddle_tpu.models.kv_cache import (  # noqa: F401
     StaticCacheSlot,
     make_static_cache,
 )
-from paddle_tpu.models.serving import DecodeEngine  # noqa: F401
+from paddle_tpu.models.serving import DecodeEngine, SlotStep  # noqa: F401
 from paddle_tpu.models.vit import (  # noqa: F401
     ViTConfig,
     VisionTransformer,

@@ -49,6 +49,19 @@ def _sample_next(logits_np: np.ndarray, temperature: float, top_k: int,
     return np.array([rand.choice(probs.shape[-1], p=p) for p in probs])
 
 
+def trim_at_eos(prompt: np.ndarray, gen: np.ndarray,
+                eos_token_id: Optional[int]) -> np.ndarray:
+    """prompt [P] + generated [G] -> one sequence cut just AFTER the first
+    EOS in the completion (EOS kept). Shared by the DecodeEngine and the
+    serving scheduler so 'finished' means the same thing everywhere."""
+    seq = np.concatenate([prompt, gen])
+    if eos_token_id is not None:
+        hits = np.where(gen == eos_token_id)[0]
+        if hits.size:
+            seq = seq[:len(prompt) + hits[0] + 1]
+    return seq
+
+
 def _normalize_prompt(model, input_ids, max_new_tokens):
     """Shared prompt normalization + window guard for every strategy."""
     ids_np = np.asarray(input_ids.numpy()
@@ -68,11 +81,15 @@ def _normalize_prompt(model, input_ids, max_new_tokens):
 def greedy_or_sample(model, input_ids, num_layers: int,
                      max_new_tokens: int = 32, temperature: float = 1.0,
                      top_k: int = 0, eos_token_id: Optional[int] = None,
-                     seed: Optional[int] = None, top_p: float = 1.0):
+                     seed: Optional[int] = None, top_p: float = 1.0,
+                     on_token=None):
     """Generate tokens autoregressively. ``model(input_ids, position_ids,
     caches)`` must return (logits, new_caches) when caches is given.
 
-    temperature<=0 means greedy decoding. Returns [B, prompt+new] ids."""
+    temperature<=0 means greedy decoding. ``on_token`` (optional) streams
+    each step's sampled ids ([B] ndarray) as they are produced — the eager
+    counterpart of the serving tier's per-request token callbacks.
+    Returns [B, prompt+new] ids."""
     was_training = model.training
     model.eval()
     rand = np.random.default_rng(seed)
@@ -91,6 +108,8 @@ def greedy_or_sample(model, input_ids, num_layers: int,
                 np.asarray(logits.numpy())[:, -1].astype(np.float64),
                 temperature, top_k, rand, top_p)
             out = [ids_np, next_np[:, None]]
+            if on_token is not None:
+                on_token(next_np.copy())
             finished = np.zeros(B, dtype=bool)
             if eos_token_id is not None:
                 finished |= next_np == eos_token_id
@@ -109,6 +128,8 @@ def greedy_or_sample(model, input_ids, num_layers: int,
                     next_np = np.where(finished, eos_token_id, next_np)
                     finished |= next_np == eos_token_id
                 out.append(next_np[:, None])
+                if on_token is not None:
+                    on_token(next_np.copy())
         return paddle.to_tensor(
             np.concatenate(out, axis=1).astype(np.int64))
     finally:

@@ -166,34 +166,74 @@ def make_static_cache(num_layers: int, batch: int, max_len: int,
     return slots
 
 
+class KVPoolExhausted(RuntimeError):
+    """Raised when the block pool cannot cover a request; the serving
+    scheduler catches this to preempt instead of OOM-ing."""
+
+
 class BlockAllocator:
     """Host-side free-list allocator for KV pool blocks (the vLLM block
-    manager role). Pure bookkeeping — device state is only the block table."""
+    manager role). Pure bookkeeping — device state is only the block table.
+
+    Hardened for the serving tier: every block id is tracked as free OR
+    allocated, double-free (and freeing a block the allocator never owned)
+    raises, and occupancy/fragmentation stats feed ``ServingMetrics``."""
 
     def __init__(self, num_blocks: int, block_size: int):
         self.block_size = block_size
         self.num_blocks = num_blocks
         self._free = list(range(num_blocks - 1, -1, -1))
+        self._allocated: set = set()
 
     def num_free(self) -> int:
         return len(self._free)
 
+    @property
+    def num_free_blocks(self) -> int:
+        return len(self._free)
+
+    @property
+    def num_used_blocks(self) -> int:
+        return len(self._allocated)
+
+    def utilization(self) -> float:
+        """Fraction of the pool currently allocated to sequences."""
+        return len(self._allocated) / max(self.num_blocks, 1)
+
+    def fragmentation(self, live_tokens: int) -> float:
+        """Internal fragmentation: fraction of allocated token capacity not
+        holding a live token (tail slack of partially-filled blocks)."""
+        cap = len(self._allocated) * self.block_size
+        if cap <= 0:
+            return 0.0
+        return max(0.0, 1.0 - live_tokens / cap)
+
+    def _pop_free(self) -> int:
+        b = self._free.pop()
+        self._allocated.add(b)
+        return b
+
     def allocate(self, n_tokens: int) -> List[int]:
         need = (n_tokens + self.block_size - 1) // self.block_size
         if need > len(self._free):
-            raise RuntimeError(
+            raise KVPoolExhausted(
                 f"KV pool exhausted: need {need} blocks, {len(self._free)} free")
-        return [self._free.pop() for _ in range(need)]
+        return [self._pop_free() for _ in range(need)]
 
     def extend(self, blocks: List[int], cur_tokens: int, add_tokens: int):
         """Grow a sequence's block list to cover add_tokens more tokens."""
         have = len(blocks) * self.block_size
         while cur_tokens + add_tokens > have:
             if not self._free:
-                raise RuntimeError("KV pool exhausted on extend")
-            blocks.append(self._free.pop())
+                raise KVPoolExhausted("KV pool exhausted on extend")
+            blocks.append(self._pop_free())
             have += self.block_size
         return blocks
 
     def free(self, blocks: List[int]):
-        self._free.extend(blocks)
+        for b in blocks:
+            if b not in self._allocated:
+                raise RuntimeError(
+                    f"double free: block {b} is not currently allocated")
+            self._allocated.remove(b)
+            self._free.append(b)

@@ -230,12 +230,12 @@ class LlamaForCausalLM(nn.Layer):
         return logits
 
     def generate(self, input_ids, max_new_tokens=32, temperature=1.0,
-                 top_k=0, eos_token_id=None, seed=None):
+                 top_k=0, eos_token_id=None, seed=None, on_token=None):
         from paddle_tpu.models.generation import greedy_or_sample
 
         return greedy_or_sample(self, input_ids, self.config.num_layers,
                                 max_new_tokens, temperature, top_k,
-                                eos_token_id, seed)
+                                eos_token_id, seed, on_token=on_token)
 
     def hybrid_parallel_plan(self, mp_size, pp_axis="pp", mp_axis="mp"):
         """One-program dp x mp x pp Engine route (BASELINE.md config #5:

@@ -43,6 +43,56 @@ def _bucket(n: int, lo: int = 16) -> int:
     return b
 
 
+class SlotStep:
+    """The ONE compiled serving step: model chunk (prefill of any bucketed
+    width, or a single decode token per slot) + in-graph sampling at each
+    sequence's last valid logit row.
+
+    Shared kernel path for ``DecodeEngine`` (static whole-batch loop) and the
+    continuous-batching scheduler (``paddle_tpu.serving``): one instance owns
+    one jit program cache, so prefill buckets and the fixed-shape decode step
+    each compile once and are reused across requests/admissions. Cache
+    buffers are donated — callers must thread caches through and never reuse
+    a cache argument after the call."""
+
+    def __init__(self, model, temperature: float = 0.0, top_k: int = 0):
+        self.model = model
+        self.temperature = float(temperature)
+        self.top_k = int(top_k)
+        self._sf = StaticFunction(self._forward_sample, layer=model,
+                                  donate_args=True)
+
+    def __call__(self, ids, position_ids, caches, gather_idx):
+        return self._sf(ids, position_ids, caches, gather_idx)
+
+    def num_programs(self):
+        """Entries in the jit program cache (recompile accounting)."""
+        return self._sf._jitted._cache_size()
+
+    def _forward_sample(self, ids, position_ids, caches, gather_idx):
+        logits, new_caches = self.model(ids, position_ids, caches)
+        temp, k = self.temperature, self.top_k
+        key = rng.next_key() if temp > 0 else None
+
+        def pick(lv, gi):
+            last = jnp.take_along_axis(
+                lv, gi[:, None, None].astype(jnp.int32),
+                axis=1)[:, 0, :]  # [B, V]
+            l = last.astype(jnp.float32)
+            if temp <= 0:
+                return jnp.argmax(l, axis=-1).astype(jnp.int32)
+            l = l / max(temp, 1e-6)
+            if k and k > 0:
+                kk = min(k, l.shape[-1])
+                kth = jax.lax.top_k(l, kk)[0][..., -1:]
+                l = jnp.where(l < kth, -jnp.inf, l)
+            return jax.random.categorical(key, l, axis=-1).astype(jnp.int32)
+
+        next_ids = apply("sample_next", pick, logits, gather_idx,
+                         differentiable=False)
+        return next_ids, new_caches
+
+
 class DecodeEngine:
     """Continuous-decode engine over a causal LM.
 
@@ -70,38 +120,11 @@ class DecodeEngine:
         self.block_size = block_size
         self.num_blocks = num_blocks
         self.cache_dtype = cache_dtype
-        # donate_args: the decode loop threads cache buffers through the
-        # compiled step and never reuses an input array after the call, so
-        # the KV caches update in place (no 2x cache residency)
-        self._sf = StaticFunction(self._forward_sample, layer=model,
-                                  donate_args=True)
-
-    # ---- compiled step -------------------------------------------------
-
-    def _forward_sample(self, ids, position_ids, caches, gather_idx):
-        """One model chunk (prefill or single decode token) + in-graph
-        sampling of the next id at each sequence's last valid logit row."""
-        logits, new_caches = self.model(ids, position_ids, caches)
-        temp, k = self.temperature, self.top_k
-        key = rng.next_key() if temp > 0 else None
-
-        def pick(lv, gi):
-            last = jnp.take_along_axis(
-                lv, gi[:, None, None].astype(jnp.int32),
-                axis=1)[:, 0, :]  # [B, V]
-            l = last.astype(jnp.float32)
-            if temp <= 0:
-                return jnp.argmax(l, axis=-1).astype(jnp.int32)
-            l = l / max(temp, 1e-6)
-            if k and k > 0:
-                kk = min(k, l.shape[-1])
-                kth = jax.lax.top_k(l, kk)[0][..., -1:]
-                l = jnp.where(l < kth, -jnp.inf, l)
-            return jax.random.categorical(key, l, axis=-1).astype(jnp.int32)
-
-        next_ids = apply("sample_next", pick, logits, gather_idx,
-                         differentiable=False)
-        return next_ids, new_caches
+        # SlotStep donates args: the decode loop threads cache buffers
+        # through the compiled step and never reuses an input array after
+        # the call, so the KV caches update in place (no 2x cache residency)
+        self._step = SlotStep(model, temperature=temperature, top_k=top_k)
+        self._sf = self._step._sf  # back-compat alias (recompile tests)
 
     # ---- cache construction -------------------------------------------
 
@@ -206,14 +229,12 @@ class DecodeEngine:
                         finished |= step_np == eos_token_id
                     out_tokens.append(step_np)
 
+            from paddle_tpu.models.generation import trim_at_eos
+
             gen = np.stack(out_tokens, axis=1)  # [B, T]
             results = []
             for i in range(B):
-                seq = np.concatenate([ids_np[i, :lens[i]], gen[i]])
-                if eos_token_id is not None:
-                    hits = np.where(gen[i] == eos_token_id)[0]
-                    if hits.size:
-                        seq = seq[:lens[i] + hits[0] + 1]
+                seq = trim_at_eos(ids_np[i, :lens[i]], gen[i], eos_token_id)
                 results.append(seq.astype(np.int64))
             if self.use_paged:
                 for blks in blocks:

@@ -244,6 +244,20 @@ class Profiler:
         lines = ["host event summary", f"{'name':<40}{'calls':>8}{'total(ms)':>12}"]
         for name, s in sorted(stats.items(), key=lambda kv: -kv[1]["total_ms"]):
             lines.append(f"{name:<40}{s['calls']:>8}{s['total_ms']:>12.3f}")
+        # serving line items: the continuous-batching scheduler's spans
+        # (serving.prefill / serving.decode_step / serving.preempt) get a
+        # dedicated block with per-call means, so a serving run's iteration
+        # profile is readable at a glance
+        serving = {n: s for n, s in stats.items() if n.startswith("serving.")}
+        if serving:
+            lines.append("serving spans")
+            lines.append(
+                f"{'span':<40}{'calls':>8}{'total(ms)':>12}{'mean(ms)':>12}")
+            for name, s in sorted(serving.items(),
+                                  key=lambda kv: -kv[1]["total_ms"]):
+                mean = s["total_ms"] / max(s["calls"], 1)
+                lines.append(f"{name:<40}{s['calls']:>8}"
+                             f"{s['total_ms']:>12.3f}{mean:>12.3f}")
         if self._step_times:
             import numpy as np
 

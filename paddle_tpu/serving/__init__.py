@@ -1,0 +1,48 @@
+"""paddle_tpu.serving — continuous-batching inference serving.
+
+The deployment tier above ``models.serving.DecodeEngine`` (reference:
+AnalysisPredictor + the Paddle Serving ecosystem's request brokering),
+re-designed for the TPU substrate: a request queue with admission control,
+an iteration-level (Orca-style) scheduler over a fixed-shape slot grid so
+the decode step never recompiles, a vLLM-style paged KV pool with
+preemption-on-exhaustion, per-token streaming, and a serving metrics
+registry (TTFT/TPOT, tokens/s, KV utilization).
+
+    queue → scheduler → slot grid → paged KV pool
+                 │
+                 └── ServingMetrics / profiler spans
+
+Typical use::
+
+    from paddle_tpu.serving import ContinuousBatchingScheduler, SchedulerConfig
+    sched = ContinuousBatchingScheduler(model, SchedulerConfig(
+        max_num_seqs=8, max_seq_len=512, block_size=16))
+    rid = sched.add_request(prompt_ids, max_new_tokens=64,
+                            on_token=lambda rid, tok: ...)
+    outputs = sched.run()          # or sched.step() under your own loop
+"""
+
+from paddle_tpu.serving.metrics import Histogram, ServingMetrics  # noqa: F401
+from paddle_tpu.serving.request import (  # noqa: F401
+    QueueFull,
+    Request,
+    RequestOutput,
+    RequestQueue,
+    RequestState,
+    SchedulerConfig,
+)
+from paddle_tpu.serving.scheduler import (  # noqa: F401
+    ContinuousBatchingScheduler,
+)
+
+__all__ = [
+    "ContinuousBatchingScheduler",
+    "Histogram",
+    "QueueFull",
+    "Request",
+    "RequestOutput",
+    "RequestQueue",
+    "RequestState",
+    "SchedulerConfig",
+    "ServingMetrics",
+]

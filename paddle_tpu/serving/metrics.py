@@ -1,0 +1,118 @@
+"""Serving metrics registry: counters, gauges, latency histograms.
+
+The observability face of the serving tier (queue depth, slot occupancy,
+KV-block utilization/fragmentation, preemptions, TTFT/TPOT, tokens/s),
+snapshot-able as one JSON-able dict for benchmarks and dashboards. Host
+spans for prefill/decode/preempt ride ``paddle_tpu.profiler.RecordEvent``
+from the scheduler, so a ``Profiler`` run shows serving line items."""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional
+
+
+class Histogram:
+    """Bounded reservoir of observations with percentile summaries."""
+
+    def __init__(self, max_samples: int = 4096):
+        self._vals: List[float] = []
+        self._max = max_samples
+        self.count = 0
+        self.total = 0.0
+
+    def record(self, v: float):
+        self.count += 1
+        self.total += v
+        if len(self._vals) < self._max:
+            self._vals.append(v)
+        else:  # keep a deterministic stride-reservoir of the stream
+            self._vals[self.count % self._max] = v
+
+    def summary(self) -> Dict[str, float]:
+        if not self._vals:
+            return {"count": 0}
+        import numpy as np
+
+        a = np.asarray(self._vals, float)
+        return {
+            "count": self.count,
+            "mean": float(a.mean()),
+            "p50": float(np.percentile(a, 50)),
+            "p90": float(np.percentile(a, 90)),
+            "p99": float(np.percentile(a, 99)),
+            "max": float(a.max()),
+        }
+
+
+class ServingMetrics:
+    """Counters + gauges + histograms for one scheduler instance."""
+
+    def __init__(self):
+        self.t_start = time.perf_counter()
+        # counters
+        self.requests_received = 0
+        self.requests_finished = 0
+        self.requests_rejected = 0
+        self.preemptions = 0
+        self.prefill_tokens = 0
+        self.generated_tokens = 0
+        self.decode_steps = 0
+        self.prefills = 0
+        # gauges (refreshed by the scheduler each iteration)
+        self.queue_depth = 0
+        self.running = 0
+        self.free_blocks = 0
+        self.total_blocks = 0
+        self.kv_utilization = 0.0
+        self.kv_fragmentation = 0.0
+        # latency histograms (seconds)
+        self.ttft = Histogram()
+        self.tpot = Histogram()
+        self.step_time = Histogram()
+
+    # ---- scheduler hooks ----------------------------------------------
+    def observe_gauges(self, *, queue_depth: int, running: int, allocator,
+                       live_tokens: int):
+        self.queue_depth = queue_depth
+        self.running = running
+        self.free_blocks = allocator.num_free_blocks
+        self.total_blocks = allocator.num_blocks
+        self.kv_utilization = allocator.utilization()
+        self.kv_fragmentation = allocator.fragmentation(live_tokens)
+
+    def observe_finish(self, req):
+        """Fold one finished request's latency profile in."""
+        self.requests_finished += 1
+        out = req.output()
+        if out.ttft_s is not None:
+            self.ttft.record(out.ttft_s)
+        if out.tpot_s is not None:
+            self.tpot.record(out.tpot_s)
+
+    # ---- reading -------------------------------------------------------
+    def tokens_per_s(self) -> float:
+        dt = time.perf_counter() - self.t_start
+        return self.generated_tokens / dt if dt > 0 else 0.0
+
+    def snapshot(self) -> Dict[str, object]:
+        return {
+            "requests_received": self.requests_received,
+            "requests_finished": self.requests_finished,
+            "requests_rejected": self.requests_rejected,
+            "preemptions": self.preemptions,
+            "prefill_tokens": self.prefill_tokens,
+            "generated_tokens": self.generated_tokens,
+            "decode_steps": self.decode_steps,
+            "prefills": self.prefills,
+            "queue_depth": self.queue_depth,
+            "running": self.running,
+            "free_blocks": self.free_blocks,
+            "total_blocks": self.total_blocks,
+            "kv_utilization": round(self.kv_utilization, 4),
+            "kv_fragmentation": round(self.kv_fragmentation, 4),
+            "tokens_per_s": round(self.tokens_per_s(), 2),
+            "ttft_s": self.ttft.summary(),
+            "tpot_s": self.tpot.summary(),
+            "step_time_s": self.step_time.summary(),
+        }

@@ -1,0 +1,200 @@
+"""Serving request lifecycle: admission config, per-request state, queue.
+
+The deployment tier's request surface (reference: AnalysisPredictor +
+Paddle Serving's request brokering) re-designed for iteration-level
+scheduling: a ``Request`` lives through QUEUED → RUNNING → (PREEMPTED →
+QUEUED →)* → FINISHED, carrying its generated prefix across preemptions so
+a resume is a pure recompute (vLLM-style recompute preemption — freed KV
+blocks are re-filled from ``prompt + generated`` on the next admission).
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from enum import Enum
+from typing import Callable, List, Optional
+
+import numpy as np
+
+
+class RequestState(Enum):
+    QUEUED = 0
+    RUNNING = 1
+    PREEMPTED = 2
+    FINISHED = 3
+
+
+class QueueFull(RuntimeError):
+    """Admission control: the wait queue is at max_queue_size."""
+
+
+@dataclass
+class SchedulerConfig:
+    """Knobs for the continuous-batching scheduler.
+
+    ``max_num_seqs`` is the slot-grid width: the decode step is compiled
+    ONCE for exactly this batch shape and every iteration runs it, so
+    admissions/retirements never change the program. ``num_blocks`` sizes
+    the paged KV pool (default: enough for every slot at ``max_seq_len``,
+    i.e. preemption only under an explicitly tightened pool)."""
+
+    max_num_seqs: int = 8
+    max_queue_size: int = 256
+    max_seq_len: int = 512
+    block_size: int = 16
+    num_blocks: Optional[int] = None
+    max_new_tokens: int = 32          # per-request default cap
+    eos_token_id: Optional[int] = None
+    temperature: float = 0.0
+    top_k: int = 0
+    cache_dtype: str = "float32"
+    enable_preemption: bool = True
+    prefill_bucket: int = 16          # smallest prefill width bucket
+
+    @property
+    def max_blocks_per_seq(self) -> int:
+        return -(-self.max_seq_len // self.block_size)
+
+    @property
+    def total_blocks(self) -> int:
+        if self.num_blocks is not None:
+            return self.num_blocks
+        return self.max_num_seqs * self.max_blocks_per_seq
+
+    @classmethod
+    def from_inference_config(cls, config, **overrides) -> "SchedulerConfig":
+        """Bridge ``paddle.inference.Config`` deployment knobs into serving
+        scheduler knobs (the APPLIED face of ``enable_memory_optim`` and
+        ``enable_low_precision`` on the serving tier):
+
+        - ``enable_memory_optim(x)``  → ``enable_preemption=x`` (paged-KV
+          preemption IS the serving-tier memory optimization: graceful
+          degradation instead of OOM when the block pool runs dry);
+        - ``enable_low_precision(d)`` → ``cache_dtype=d`` (KV pool rests in
+          the reduced precision — the dominant serving-memory consumer).
+        """
+        kw = {}
+        flags = getattr(config, "_flags", {})
+        if "memory_optim" in flags:
+            kw["enable_preemption"] = bool(flags["memory_optim"])
+        lp = flags.get("low_precision")
+        if lp:
+            kw["cache_dtype"] = lp
+        kw.update(overrides)
+        return cls(**kw)
+
+
+@dataclass
+class RequestOutput:
+    """Final (or streaming-snapshot) result of one request."""
+
+    request_id: int
+    prompt_ids: np.ndarray            # [P] int64, the original prompt
+    generated_ids: np.ndarray         # [G] int64, incl. the EOS if hit
+    finish_reason: Optional[str]      # "eos" | "length" | None (running)
+    ttft_s: Optional[float]           # time-to-first-token
+    tpot_s: Optional[float]           # mean time-per-output-token (after 1st)
+    num_preemptions: int
+
+    @property
+    def token_ids(self) -> np.ndarray:
+        """prompt + completion (DecodeEngine.generate's return contract)."""
+        return np.concatenate([self.prompt_ids, self.generated_ids])
+
+
+@dataclass
+class Request:
+    """One in-flight generation request (host-side bookkeeping only)."""
+
+    request_id: int
+    prompt_ids: np.ndarray            # [P] int64/int32
+    max_new_tokens: int
+    eos_token_id: Optional[int]
+    priority: int = 0                 # higher = more important
+    on_token: Optional[Callable[[int, int], None]] = None  # (rid, token)
+    state: RequestState = RequestState.QUEUED
+    out_tokens: List[int] = field(default_factory=list)
+    num_preemptions: int = 0
+    arrival_t: float = field(default_factory=time.perf_counter)
+    first_token_t: Optional[float] = None
+    finish_t: Optional[float] = None
+    finish_reason: Optional[str] = None
+    blocks: List[int] = field(default_factory=list)   # live KV blocks
+    slot: int = -1
+
+    @property
+    def resume_ids(self) -> np.ndarray:
+        """Prompt for (re-)prefill: original prompt + generated prefix, so a
+        preempted request recomputes its KV and continues token-for-token."""
+        if not self.out_tokens:
+            return np.asarray(self.prompt_ids, np.int64)
+        return np.concatenate([np.asarray(self.prompt_ids, np.int64),
+                               np.asarray(self.out_tokens, np.int64)])
+
+    @property
+    def num_generated(self) -> int:
+        return len(self.out_tokens)
+
+    def emit(self, token: int):
+        """Record one generated token (streaming callback + TTFT stamp)."""
+        now = time.perf_counter()
+        if self.first_token_t is None:
+            self.first_token_t = now
+        self.out_tokens.append(int(token))
+        if self.on_token is not None:
+            self.on_token(self.request_id, int(token))
+
+    def finish(self, reason: str):
+        self.state = RequestState.FINISHED
+        self.finish_reason = reason
+        self.finish_t = time.perf_counter()
+
+    def output(self) -> RequestOutput:
+        ttft = (self.first_token_t - self.arrival_t
+                if self.first_token_t is not None else None)
+        tpot = None
+        if self.finish_t is not None and len(self.out_tokens) > 1:
+            tpot = ((self.finish_t - self.first_token_t)
+                    / (len(self.out_tokens) - 1))
+        return RequestOutput(
+            request_id=self.request_id,
+            prompt_ids=np.asarray(self.prompt_ids, np.int64),
+            generated_ids=np.asarray(self.out_tokens, np.int64),
+            finish_reason=self.finish_reason,
+            ttft_s=ttft, tpot_s=tpot,
+            num_preemptions=self.num_preemptions)
+
+
+class RequestQueue:
+    """Bounded wait queue with priority ordering and resume-first placement.
+
+    Pop order: highest ``priority`` first; within a priority class,
+    preempted requests resume before fresh arrivals (they hold generated
+    prefixes whose latency budget is already spent), then FIFO."""
+
+    def __init__(self, max_size: int = 256):
+        self.max_size = max_size
+        self._items: List[Request] = []
+        self._seq = 0
+
+    def __len__(self) -> int:
+        return len(self._items)
+
+    def push(self, req: Request, force: bool = False):
+        if not force and len(self._items) >= self.max_size:
+            raise QueueFull(
+                f"wait queue full ({self.max_size}); rejecting request "
+                f"{req.request_id}")
+        req.state = RequestState.QUEUED
+        self._seq += 1
+        self._items.append(req)
+        self._items.sort(key=lambda r: (-r.priority,
+                                        0 if r.num_preemptions else 1,
+                                        r.arrival_t))
+
+    def peek(self) -> Optional[Request]:
+        return self._items[0] if self._items else None
+
+    def pop(self) -> Request:
+        return self._items.pop(0)

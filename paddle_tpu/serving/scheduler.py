@@ -1,0 +1,346 @@
+"""Continuous (iteration-level) batching over a fixed-shape slot grid.
+
+Orca-style scheduling on a vLLM-style paged KV pool, TPU-first:
+
+- The decode step is ONE compiled XLA program over ``[max_num_seqs, 1]``
+  token ids + per-layer ``PagedCacheSlot`` pools. Admissions, retirements
+  and preemptions only rewrite the (host-side) block table / position /
+  token arrays — the program never recompiles in steady state.
+- Admission runs a prefill-then-pack path: a new request prefills alone at
+  a bucketed prompt width (compiles once per bucket), writing its K/V into
+  the SHARED block pool through its own block-table row; packing into the
+  grid is then a pure host-side table update.
+- When the ``BlockAllocator`` runs dry mid-decode, the lowest-priority
+  (then youngest) running sequence is preempted: its blocks are freed and
+  the request re-queued carrying its generated prefix, to be recomputed on
+  a later admission. Graceful degradation instead of OOM.
+- Every generated token streams to the request's ``on_token`` callback the
+  iteration it is sampled; TTFT/TPOT are stamped per request and fold into
+  ``ServingMetrics``.
+"""
+
+from __future__ import annotations
+
+import time as _time
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.models.kv_cache import (
+    BlockAllocator,
+    KVPoolExhausted,
+    PagedCacheSlot,
+)
+from paddle_tpu.models.serving import SlotStep, _bucket
+from paddle_tpu.profiler import RecordEvent
+from paddle_tpu.serving.metrics import ServingMetrics
+from paddle_tpu.serving.request import (
+    Request,
+    RequestOutput,
+    RequestQueue,
+    RequestState,
+    SchedulerConfig,
+)
+
+
+class ContinuousBatchingScheduler:
+    """Iteration-level scheduler around one causal-LM's compiled slot step.
+
+    ``model(input_ids, position_ids, caches)`` must return
+    ``(logits, new_caches)`` when caches are given (the GPTForCausalLM /
+    LlamaForCausalLM serving contract — same as ``DecodeEngine``)."""
+
+    def __init__(self, model, config: Optional[SchedulerConfig] = None,
+                 metrics: Optional[ServingMetrics] = None):
+        self.config = cfg = config or SchedulerConfig()
+        mcfg = model.config
+        self.model = model
+        self.num_layers = mcfg.num_layers
+        self.num_kv_heads = (getattr(mcfg, "num_key_value_heads", None)
+                             or mcfg.num_heads)
+        self.head_dim = mcfg.hidden_size // mcfg.num_heads
+        max_pos = getattr(mcfg, "max_position_embeddings", cfg.max_seq_len)
+        self.max_seq_len = min(cfg.max_seq_len, max_pos)
+        self.metrics = metrics or ServingMetrics()
+        self._step_fn = SlotStep(model, temperature=cfg.temperature,
+                                 top_k=cfg.top_k)
+        self.allocator = BlockAllocator(cfg.total_blocks, cfg.block_size)
+
+        S, MB = cfg.max_num_seqs, cfg.max_blocks_per_seq
+        # host-side slot grid: which request runs where, its block-table row
+        # and current length. Device state is ONLY the per-layer K/V pools.
+        self._slots: List[Optional[Request]] = [None] * S
+        self._table = np.full((S, MB), -1, np.int32)
+        self._pos = np.zeros(S, np.int32)
+        self._next_tok = np.zeros(S, np.int32)   # token to feed next step
+        self._pools = [
+            (paddle.zeros([cfg.total_blocks, cfg.block_size,
+                           self.num_kv_heads, self.head_dim],
+                          dtype=cfg.cache_dtype),
+             paddle.zeros([cfg.total_blocks, cfg.block_size,
+                           self.num_kv_heads, self.head_dim],
+                          dtype=cfg.cache_dtype))
+            for _ in range(self.num_layers)]
+        self.queue = RequestQueue(cfg.max_queue_size)
+        self._next_rid = 0
+        self._finished: Dict[int, RequestOutput] = {}
+        self._events: List[tuple] = []   # (rid, token) stream buffer
+
+    # ---- admission -----------------------------------------------------
+
+    def add_request(self, prompt_ids, max_new_tokens: Optional[int] = None,
+                    eos_token_id: Optional[int] = None, priority: int = 0,
+                    on_token=None) -> int:
+        """Enqueue one prompt. Raises ``QueueFull`` past max_queue_size and
+        ``ValueError`` for requests that can never fit the pool/window."""
+        ids = np.asarray(prompt_ids).reshape(-1).astype(np.int64)
+        mnt = (self.config.max_new_tokens
+               if max_new_tokens is None else int(max_new_tokens))
+        if mnt < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        eos = (self.config.eos_token_id
+               if eos_token_id is None else eos_token_id)
+        total = len(ids) + mnt
+        cap = self.allocator.num_blocks * self.config.block_size
+        if total > self.max_seq_len or total > cap:
+            raise ValueError(
+                f"request needs {total} tokens but the window/pool caps at "
+                f"{min(self.max_seq_len, cap)}")
+        rid = self._next_rid
+        self._next_rid += 1
+        req = Request(request_id=rid, prompt_ids=ids, max_new_tokens=mnt,
+                      eos_token_id=eos, priority=priority, on_token=on_token)
+        try:
+            self.queue.push(req)
+        except Exception:
+            self.metrics.requests_rejected += 1
+            raise
+        self.metrics.requests_received += 1
+        return rid
+
+    # ---- internals -----------------------------------------------------
+
+    def _live_tokens(self) -> int:
+        return int(sum(self._pos[s] for s in range(len(self._slots))
+                       if self._slots[s] is not None))
+
+    def _caches(self, table: np.ndarray, pos: np.ndarray):
+        """Fresh per-layer PagedCacheSlots over the shared pools. Table/pos
+        tensors are rebuilt per call (args are donated into the compiled
+        step, and a donated pytree must not repeat a buffer)."""
+        return [PagedCacheSlot(kp, vp, paddle.to_tensor(table),
+                               paddle.to_tensor(pos))
+                for kp, vp in self._pools]
+
+    def _store_pools(self, caches):
+        self._pools = [(c.k_pool, c.v_pool) for c in caches]
+
+    def _retire(self, slot: int, reason: str):
+        req = self._slots[slot]
+        req.finish(reason)
+        self.allocator.free(req.blocks)
+        req.blocks = []
+        req.slot = -1
+        self._slots[slot] = None
+        self._table[slot] = -1
+        self._pos[slot] = 0
+        self._next_tok[slot] = 0
+        self.metrics.observe_finish(req)
+        self._finished[req.request_id] = req.output()
+        return req
+
+    def _preempt_victim(self, exclude_slot: int = -1) -> Optional[int]:
+        """Pick the running sequence to evict: lowest priority, then the
+        youngest (latest request id) — it has the least sunk compute."""
+        best, best_key = None, None
+        for s, req in enumerate(self._slots):
+            if req is None or s == exclude_slot:
+                continue
+            key = (req.priority, -req.request_id)
+            if best_key is None or key < best_key:
+                best, best_key = s, key
+        return best
+
+    def _preempt(self, slot: int):
+        req = self._slots[slot]
+        with RecordEvent("serving.preempt"):
+            self.allocator.free(req.blocks)
+            req.blocks = []
+            req.slot = -1
+            req.num_preemptions += 1
+            req.state = RequestState.PREEMPTED
+            self._slots[slot] = None
+            self._table[slot] = -1
+            self._pos[slot] = 0
+            self._next_tok[slot] = 0
+            # force=True: an evicted request must never be REJECTED by its
+            # own admission control — it was already admitted once
+            self.queue.push(req, force=True)
+        self.metrics.preemptions += 1
+
+    def _ensure_decode_capacity(self, slot: int) -> bool:
+        """Guarantee the slot can write one more token; preempt other
+        sequences (or finally the slot itself) when the pool is dry.
+        False = the slot itself was evicted."""
+        req = self._slots[slot]
+        while True:
+            try:
+                before = len(req.blocks)
+                self.allocator.extend(req.blocks, int(self._pos[slot]), 1)
+                for j in range(before, len(req.blocks)):
+                    self._table[slot, j] = req.blocks[j]
+                return True
+            except KVPoolExhausted:
+                if not self.config.enable_preemption:
+                    raise
+                victim = self._preempt_victim(exclude_slot=slot)
+                if victim is None:
+                    self._preempt(slot)      # last resort: evict itself
+                    return False
+                self._preempt(victim)
+
+    def _admit(self) -> List[Request]:
+        """Fill free slots from the queue via prefill-then-pack."""
+        finished = []
+        while len(self.queue):
+            slot = next((s for s, r in enumerate(self._slots) if r is None),
+                        None)
+            if slot is None:
+                break
+            nxt = self.queue.peek()
+            ids = nxt.resume_ids
+            try:
+                blocks = self.allocator.allocate(len(ids))
+            except KVPoolExhausted:
+                break                        # running seqs keep precedence
+            req = self.queue.pop()
+            req.blocks = blocks
+            req.slot = slot
+            req.state = RequestState.RUNNING
+            P = len(ids)
+            Pb = min(_bucket(P, self.config.prefill_bucket), self.max_seq_len)
+            ids_np = np.zeros((1, Pb), np.int32)
+            ids_np[0, :P] = ids
+            row = np.full((1, self.config.max_blocks_per_seq), -1, np.int32)
+            row[0, :len(blocks)] = blocks
+            with RecordEvent("serving.prefill"), paddle.no_grad():
+                caches = [PagedCacheSlot(kp, vp, paddle.to_tensor(row),
+                                         paddle.zeros([1], dtype="int32"))
+                          for kp, vp in self._pools]
+                next_ids, caches = self._step_fn(
+                    paddle.to_tensor(ids_np),
+                    paddle.to_tensor(np.arange(Pb, dtype=np.int32)),
+                    caches,
+                    paddle.to_tensor(np.array([P - 1], np.int32)))
+                self._store_pools(caches)
+            tok = int(np.asarray(next_ids.numpy())[0])
+            self.metrics.prefills += 1
+            self.metrics.prefill_tokens += P
+            # pack into the grid
+            self._slots[slot] = req
+            self._table[slot] = row[0]
+            self._pos[slot] = P
+            self._next_tok[slot] = tok
+            req.emit(tok)
+            self._events.append((req.request_id, tok))
+            self.metrics.generated_tokens += 1
+            if req.eos_token_id is not None and tok == req.eos_token_id:
+                finished.append(self._retire(slot, "eos"))
+            elif req.num_generated >= req.max_new_tokens:
+                finished.append(self._retire(slot, "length"))
+        return finished
+
+    def _decode_once(self) -> List[Request]:
+        """One fixed-shape decode iteration over every running slot."""
+        S = self.config.max_num_seqs
+        running = [s for s in range(S) if self._slots[s] is not None]
+        if not running:
+            return []
+        for s in running:
+            if self._slots[s] is None:
+                continue                     # evicted by an earlier slot
+            self._ensure_decode_capacity(s)
+        # capacity assurance may have preempted ANY slot, incl. later ones
+        running = [s for s in running if self._slots[s] is not None]
+        if not running:
+            return []
+        with RecordEvent("serving.decode_step"), paddle.no_grad():
+            tok = self._next_tok.reshape(S, 1).astype(np.int32)
+            pos = self._pos.reshape(S, 1).astype(np.int32)
+            caches = self._caches(self._table, self._pos)
+            next_ids, caches = self._step_fn(
+                paddle.to_tensor(tok), paddle.to_tensor(pos), caches,
+                paddle.to_tensor(np.zeros(S, np.int32)))
+            self._store_pools(caches)
+        step_np = np.asarray(next_ids.numpy())
+        self.metrics.decode_steps += 1
+        finished = []
+        for s in running:
+            req = self._slots[s]
+            self._pos[s] += 1                # fed token is now cached
+            t = int(step_np[s])
+            self._next_tok[s] = t
+            req.emit(t)
+            self._events.append((req.request_id, t))
+            self.metrics.generated_tokens += 1
+            if req.eos_token_id is not None and t == req.eos_token_id:
+                finished.append(self._retire(s, "eos"))
+            elif req.num_generated >= req.max_new_tokens:
+                finished.append(self._retire(s, "length"))
+        return finished
+
+    # ---- public loop ---------------------------------------------------
+
+    def has_unfinished(self) -> bool:
+        return bool(len(self.queue)) or any(
+            r is not None for r in self._slots)
+
+    def step(self) -> List[RequestOutput]:
+        """One scheduler iteration: admit into free slots (prefill), then
+        one decode step; returns outputs finishing this iteration."""
+        was_training = self.model.training
+        self.model.eval()
+        t0 = _time.perf_counter()
+        try:
+            done = self._admit()
+            done += self._decode_once()
+        finally:
+            if was_training:
+                self.model.train()
+        self.metrics.step_time.record(_time.perf_counter() - t0)
+        self.metrics.observe_gauges(
+            queue_depth=len(self.queue),
+            running=sum(r is not None for r in self._slots),
+            allocator=self.allocator, live_tokens=self._live_tokens())
+        return [r.output() for r in done]
+
+    def run(self) -> Dict[int, RequestOutput]:
+        """Drain: step until queue and slots are empty; outputs by rid."""
+        while self.has_unfinished():
+            self.step()
+        return dict(self._finished)
+
+    def stream(self):
+        """Iterator face of streaming: yield ``(request_id, token)`` events
+        in generation order while driving the scheduler until it drains."""
+        while self._events:
+            yield self._events.pop(0)
+        while self.has_unfinished():
+            self.step()
+            while self._events:
+                yield self._events.pop(0)
+
+    def generate(self, prompts: Sequence, max_new_tokens=None,
+                 eos_token_id=None) -> List[np.ndarray]:
+        """Batch convenience mirroring ``DecodeEngine.generate``: returns
+        prompt+completion per request, in submission order."""
+        rids = [self.add_request(p, max_new_tokens=max_new_tokens,
+                                 eos_token_id=eos_token_id)
+                for p in prompts]
+        outs = self.run()
+        return [outs[r].token_ids for r in rids]
+
+    def num_programs(self):
+        """Compiled-program count (recompile accounting for tests)."""
+        return self._step_fn.num_programs()

@@ -1,0 +1,153 @@
+#!/usr/bin/env python
+"""Synthetic serving-load benchmark for the continuous-batching scheduler.
+
+Fully offline: a seeded Poisson arrival process with mixed prompt/output
+lengths drives ``paddle_tpu.serving.ContinuousBatchingScheduler`` on a tiny
+GPT under ``JAX_PLATFORMS=cpu``, and the run's ``ServingMetrics`` snapshot
+(TTFT/TPOT histograms, tokens/s, KV utilization/fragmentation, preemption
+count) is written as one JSON artifact — the serving trajectory the perf
+axis tracks across rounds.
+
+Arrivals are measured in scheduler ITERATIONS (virtual time), not wall
+seconds: the load shape is reproducible on any host speed, while the
+latency histograms still record real wall time on this host.
+
+  python tools/serve_bench.py --smoke           # fast CI check, tiny load
+  python tools/serve_bench.py --requests 64 --rate 0.7 --tight-pool
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO_ROOT not in sys.path:
+    sys.path.insert(0, REPO_ROOT)
+
+
+def run_load(num_requests: int = 16, rate: float = 0.5, seed: int = 0,
+             max_num_seqs: int = 4, block_size: int = 8,
+             num_blocks=None, max_seq_len: int = 64,
+             prompt_lens=(4, 20), new_tokens=(4, 12),
+             num_layers: int = 2) -> dict:
+    """Run one synthetic load; returns the JSON-able artifact dict.
+
+    ``rate`` is the mean number of arrivals per scheduler iteration.
+    ``num_blocks`` (when set) tightens the KV pool below the fit-everything
+    default so preemption is part of the measured trajectory."""
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu.models import GPTForCausalLM, gpt_tiny
+    from paddle_tpu.serving import ContinuousBatchingScheduler, SchedulerConfig
+
+    paddle.seed(7)
+    model = GPTForCausalLM(gpt_tiny(num_layers=num_layers))
+    cfg = SchedulerConfig(max_num_seqs=max_num_seqs,
+                          max_seq_len=max_seq_len, block_size=block_size,
+                          num_blocks=num_blocks)
+    sched = ContinuousBatchingScheduler(model, cfg)
+
+    rng = np.random.default_rng(seed)
+    # Poisson arrivals in virtual (iteration) time, mixed lengths
+    gaps = rng.exponential(1.0 / max(rate, 1e-6), num_requests)
+    arrive_at = np.cumsum(gaps)
+    plens = rng.integers(prompt_lens[0], prompt_lens[1] + 1, num_requests)
+    nnew = rng.integers(new_tokens[0], new_tokens[1] + 1, num_requests)
+    prompts = [rng.integers(0, 1000, int(p)) for p in plens]
+
+    stream_counts = {}
+
+    def on_token(rid, tok):
+        stream_counts[rid] = stream_counts.get(rid, 0) + 1
+
+    t0 = time.perf_counter()
+    it, injected = 0, 0
+    while injected < num_requests or sched.has_unfinished():
+        while injected < num_requests and arrive_at[injected] <= it:
+            sched.add_request(prompts[injected],
+                              max_new_tokens=int(nnew[injected]),
+                              on_token=on_token)
+            injected += 1
+        sched.step()
+        it += 1
+        if it > 100000:
+            raise RuntimeError("serving load did not drain")
+    wall = time.perf_counter() - t0
+
+    outs = dict(sched._finished)
+    assert len(outs) == num_requests, "every request must finish"
+    # streaming contract: callbacks saw exactly the generated tokens
+    for rid, out in outs.items():
+        assert stream_counts.get(rid, 0) == len(out.generated_ids)
+
+    snap = sched.metrics.snapshot()
+    return {
+        "bench": "serving_continuous_batching",
+        "config": {
+            "num_requests": num_requests, "rate": rate, "seed": seed,
+            "max_num_seqs": max_num_seqs, "block_size": block_size,
+            "num_blocks": cfg.total_blocks, "max_seq_len": max_seq_len,
+            "prompt_lens": list(prompt_lens), "new_tokens": list(new_tokens),
+            "num_layers": num_layers,
+        },
+        "iterations": it,
+        "wall_s": round(wall, 3),
+        "compiled_programs": sched.num_programs(),
+        "metrics": snap,
+    }
+
+
+def main(argv=None) -> dict:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--smoke", action="store_true",
+                    help="tiny fast load (CI tier)")
+    ap.add_argument("--requests", type=int, default=24)
+    ap.add_argument("--rate", type=float, default=0.5)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--max-num-seqs", type=int, default=4)
+    ap.add_argument("--block-size", type=int, default=8)
+    ap.add_argument("--tight-pool", action="store_true",
+                    help="size the KV pool below worst-case so preemption "
+                         "is exercised")
+    ap.add_argument("--out", default=None,
+                    help="artifact path (default: BENCH_serving_<mode>.json "
+                         "at the repo root)")
+    args = ap.parse_args(argv)
+
+    # offline by construction: this bench must never dial an accelerator
+    # (hard-set, not setdefault — the env may already carry a device platform)
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+
+    if args.smoke:
+        kw = dict(num_requests=6, rate=1.0, seed=args.seed,
+                  max_num_seqs=2, block_size=8, max_seq_len=64,
+                  prompt_lens=(4, 10), new_tokens=(3, 6), num_layers=1)
+    else:
+        kw = dict(num_requests=args.requests, rate=args.rate,
+                  seed=args.seed, max_num_seqs=args.max_num_seqs,
+                  block_size=args.block_size)
+    if args.tight_pool:
+        # pool for roughly half the slots at full depth -> forced preemption
+        mb = -(-kw.get("max_seq_len", 64) // kw["block_size"])
+        kw["num_blocks"] = max(mb, kw["max_num_seqs"] * mb // 2)
+
+    artifact = run_load(**kw)
+    mode = "smoke" if args.smoke else "load"
+    out_path = args.out or os.path.join(REPO_ROOT,
+                                        f"BENCH_serving_{mode}.json")
+    with open(out_path, "w") as f:
+        json.dump(artifact, f, indent=2)
+    print(json.dumps({"metric": "serving_tokens_per_s",
+                      "value": artifact["metrics"]["tokens_per_s"],
+                      "unit": "tokens/s", "artifact": out_path}))
+    return artifact
+
+
+if __name__ == "__main__":
+    main(sys.argv[1:])
