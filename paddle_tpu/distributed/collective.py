@@ -29,7 +29,22 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from paddle_tpu.distributed import env as _env
+from paddle_tpu.profiler import RecordEvent, TracerEventType
 from paddle_tpu.tensor import Tensor
+
+
+def _comm_span(fn):
+    """Host Communication span around an eager collective: a Profiler run
+    shows comm.* line items (calls/total/mean) in its [Communication]
+    block, matching the reference's Communication tracer category."""
+    name = f"comm.{fn.__name__}"
+
+    @functools.wraps(fn)
+    def wrapper(*args, **kwargs):
+        with RecordEvent(name, TracerEventType.Communication):
+            return fn(*args, **kwargs)
+
+    return wrapper
 
 
 class ReduceOp:
@@ -277,6 +292,7 @@ _allreduce_impl = functools.partial(
     jax.jit, static_argnames=("op", "seg", "gsizes"))(_allreduce_segments)
 
 
+@_comm_span
 def all_reduce(tensor: Tensor, op=ReduceOp.SUM, group: Optional[Group] = None,
                sync_op=True):
     """In-place all-reduce over the per-rank axis (paddle semantics)."""
@@ -294,6 +310,7 @@ def all_reduce(tensor: Tensor, op=ReduceOp.SUM, group: Optional[Group] = None,
     return _Task()
 
 
+@_comm_span
 def all_gather(tensor_list: List[Tensor], tensor: Tensor,
                group: Optional[Group] = None, sync_op=True):
     """Gather each group peer's slice; fills tensor_list (paddle API shape).
@@ -349,6 +366,7 @@ def _local_index_maps(group: Group):
     return peers, local
 
 
+@_comm_span
 def reduce_scatter(tensor: Tensor, tensor_or_tensor_list, op=ReduceOp.SUM,
                    group: Optional[Group] = None, sync_op=True):
     """Per-rank input [world, gsize, ...] -> per-rank output [world, ...]:
@@ -385,6 +403,7 @@ def reduce_scatter(tensor: Tensor, tensor_or_tensor_list, op=ReduceOp.SUM,
     return _Task()
 
 
+@_comm_span
 def all_to_all(out_tensor_list, in_tensor_list, group: Optional[Group] = None,
                sync_op=True):
     """paddle.distributed.alltoall: group member i sends in[j] to member j."""
@@ -420,6 +439,7 @@ def all_to_all(out_tensor_list, in_tensor_list, group: Optional[Group] = None,
 alltoall = all_to_all
 
 
+@_comm_span
 def broadcast(tensor: Tensor, src: int, group: Optional[Group] = None, sync_op=True):
     """Within each partition group, every rank takes the value of the rank at
     ``src``'s local position (SPMD per-group broadcast; for the default world
@@ -451,6 +471,7 @@ def broadcast(tensor: Tensor, src: int, group: Optional[Group] = None, sync_op=T
     return _Task()
 
 
+@_comm_span
 def reduce(tensor: Tensor, dst: int, op=ReduceOp.SUM, group: Optional[Group] = None,
            sync_op=True):
     """Only global rank ``dst`` receives the reduced value of its group;
@@ -474,6 +495,7 @@ def reduce(tensor: Tensor, dst: int, op=ReduceOp.SUM, group: Optional[Group] = N
     return _Task()
 
 
+@_comm_span
 def scatter(tensor: Tensor, tensor_list=None, src=0, group: Optional[Group] = None,
             sync_op=True):
     """Each rank r receives tensor_list[local(r)] *as held by its group's src
@@ -503,6 +525,7 @@ def scatter(tensor: Tensor, tensor_list=None, src=0, group: Optional[Group] = No
     return _Task()
 
 
+@_comm_span
 def send(tensor: Tensor, dst: int, group=None, sync_op=True):
     if _is_multiproc():
         # symmetric exchange: every process contributes its buffer; the
@@ -515,6 +538,7 @@ def send(tensor: Tensor, dst: int, group=None, sync_op=True):
     return _Task()
 
 
+@_comm_span
 def recv(tensor: Tensor, src: int, group=None, sync_op=True):
     """Match the oldest buffered send addressed to this rank from ``src``.
 
@@ -544,6 +568,7 @@ def recv(tensor: Tensor, src: int, group=None, sync_op=True):
 _p2p_buffer: list = []
 
 
+@_comm_span
 def barrier(group=None):
     if _is_multiproc():
         _multiproc_allreduce(np.zeros((), np.float32), "sum")

@@ -94,13 +94,25 @@ class DataLoader:
     # ------------------------------------------------------------------ iter
     def __iter__(self):
         if self._iterable_mode:
-            yield from self._iter_iterable()
+            inner = self._iter_iterable()
         elif self.num_workers > 0 and self.use_process_workers:
-            yield from self._iter_process()
+            inner = self._iter_process()
         elif self.num_workers > 0:
-            yield from self._iter_threaded()
+            inner = self._iter_threaded()
         else:
-            yield from self._iter_sync()
+            inner = self._iter_sync()
+        # dataloader.next spans: the time the CONSUMER waits for each batch
+        # (fetch+collate inline, or queue wait under workers) — the
+        # input-bound share of a training step in a Profiler run
+        from paddle_tpu.profiler import RecordEvent, TracerEventType
+
+        while True:
+            with RecordEvent("dataloader.next", TracerEventType.Dataloader):
+                try:
+                    batch = next(inner)
+                except StopIteration:
+                    return
+            yield batch
 
     def _fetch(self, batch_indices):
         samples = [self.dataset[i] for i in batch_indices]

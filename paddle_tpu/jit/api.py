@@ -11,6 +11,7 @@ single jitted step — the TPU equivalent of the reference's Dy2Static whole
 from __future__ import annotations
 
 import functools
+import time
 import os
 from typing import Callable, Optional
 
@@ -26,7 +27,22 @@ from paddle_tpu.jit.functional import (
     tree_wrap,
 )
 from paddle_tpu.nn.layer_base import Layer
+from paddle_tpu.observability.compile_tracker import (
+    abstract_signature,
+    get_compile_tracker,
+    next_tracked_name,
+)
 from paddle_tpu.tensor import Tensor
+
+
+def _jit_cache_size(jitted) -> int:
+    cs = getattr(jitted, "_cache_size", None)
+    if cs is None:
+        return 0
+    try:
+        return int(cs())
+    except Exception:
+        return 0
 
 
 _GLOBAL_TO_STATIC_ENABLED = True
@@ -37,15 +53,19 @@ class StaticFunction:
 
     def __init__(self, fn: Callable, layer: Optional[Layer] = None,
                  full_graph: bool = True, donate_buffers: bool = False,
-                 donate_args: bool = False):
+                 donate_args: bool = False, name: Optional[str] = None):
         """``donate_buffers`` donates the layer's buffer values (safe when no
         caller holds the previous values — they are replaced by the call's
         write-back). ``donate_args`` donates the positional-argument buffers:
         only for callers that never reuse an argument array after the call
-        (e.g. the serving decode loop threading KV caches through)."""
+        (e.g. the serving decode loop threading KV caches through).
+        ``name`` labels this program cache in the CompileTracker."""
         self._fn = fn
         self._layer = layer
         self._full_graph = full_graph
+        self._tracker_name = next_tracked_name(
+            name or getattr(fn, "__qualname__",
+                            getattr(fn, "__name__", "fn")))
         functools.update_wrapper(self, fn, updated=[])
         donate = ()
         if donate_buffers:
@@ -104,7 +124,34 @@ class StaticFunction:
                 return self._fn(*args, **kwargs)
         return self._call_impl(*args, **kwargs)
 
+    def _program_count(self) -> int:
+        """Total cached programs across this wrapper's jit objects."""
+        n, seen = 0, set()
+        for j in (self._jitted, self._jitted_nodonate,
+                  self._jitted_checked):
+            if j is None or id(j) in seen:
+                continue
+            seen.add(id(j))
+            n += _jit_cache_size(j)
+        return n
+
     def _call_impl(self, *args, **kwargs):
+        # CompileTracker probe: program-cache growth across the call means
+        # jax traced+compiled a fresh XLA program for these abstract shapes
+        n0 = self._program_count()
+        import time as _time
+
+        t0 = _time.perf_counter()
+        try:
+            return self._run_impl(*args, **kwargs)
+        finally:
+            grown = self._program_count() - n0
+            if grown > 0:
+                get_compile_tracker().record(
+                    self._tracker_name, _time.perf_counter() - t0,
+                    abstract_signature(args, kwargs), n_programs=grown)
+
+    def _run_impl(self, *args, **kwargs):
         from paddle_tpu.autograd import tape as _tape
 
         params, buffers = self._state_tensors()
@@ -332,6 +379,8 @@ class TrainStep:
             _offload_state(optimizer)
         self._donate_argnums = (0, 1, 2) if donate else ()
         self._jitted = None  # built at first call (out_shardings need state)
+        self._tracker_name = next_tracked_name(
+            f"TrainStep[{type(model).__name__}]")
 
     def _build_jit(self, opt_states, master_vals, n_buffers, has_scaler):
         """Compile-time layout: when the optimizer is ZeRO-offloaded, pin the
@@ -580,7 +629,32 @@ class TrainStep:
             return loss_t, tree_wrap(aux_vals)
         return loss_t
 
+    def _program_count(self) -> int:
+        n, seen = 0, set()
+        for j in (self._jitted, getattr(self, "_jitted_checked", None),
+                  self._fused_jitted):
+            if j is None or id(j) in seen:
+                continue
+            seen.add(id(j))
+            n += _jit_cache_size(j)
+        return n
+
     def __call__(self, *batch):
+        from paddle_tpu.profiler import RecordEvent, TracerEventType
+
+        n0 = self._program_count()
+        t0 = time.perf_counter()
+        try:
+            with RecordEvent("train.step", TracerEventType.ProfileStep):
+                return self._call_inner(*batch)
+        finally:
+            grown = self._program_count() - n0
+            if grown > 0:
+                get_compile_tracker().record(
+                    self._tracker_name, time.perf_counter() - t0,
+                    abstract_signature(batch), n_programs=grown)
+
+    def _call_inner(self, *batch):
         if self._fused_mode:
             return self._fused_call(batch)
         params = self._params

@@ -99,11 +99,14 @@ def greedy_or_sample(model, input_ids, num_layers: int,
         if max_new_tokens <= 0:
             return paddle.to_tensor(ids_np.astype(np.int64))
 
+        from paddle_tpu.profiler import RecordEvent, TracerEventType
+
         with paddle.no_grad():
             # prefill: whole prompt, empty caches
             caches = [(None, None)] * num_layers
-            logits, caches = model(
-                paddle.to_tensor(ids_np.astype(np.int32)), None, caches)
+            with RecordEvent("generation.prefill", TracerEventType.Forward):
+                logits, caches = model(
+                    paddle.to_tensor(ids_np.astype(np.int32)), None, caches)
             next_np = _sample_next(
                 np.asarray(logits.numpy())[:, -1].astype(np.float64),
                 temperature, top_k, rand, top_p)
@@ -119,8 +122,11 @@ def greedy_or_sample(model, input_ids, num_layers: int,
                     break
                 pos = prompt_len + step - 1
                 tok = paddle.to_tensor(out[-1].astype(np.int32))
-                logits, caches = model(
-                    tok, paddle.to_tensor(np.array([pos], np.int32)), caches)
+                with RecordEvent("generation.decode_step",
+                                 TracerEventType.Forward):
+                    logits, caches = model(
+                        tok, paddle.to_tensor(np.array([pos], np.int32)),
+                        caches)
                 next_np = _sample_next(
                     np.asarray(logits.numpy())[:, -1].astype(np.float64),
                     temperature, top_k, rand, top_p)

@@ -60,10 +60,15 @@ class SlotStep:
         self.temperature = float(temperature)
         self.top_k = int(top_k)
         self._sf = StaticFunction(self._forward_sample, layer=model,
-                                  donate_args=True)
+                                  donate_args=True, name="serving.SlotStep")
 
     def __call__(self, ids, position_ids, caches, gather_idx):
         return self._sf(ids, position_ids, caches, gather_idx)
+
+    @property
+    def tracker_name(self) -> str:
+        """This step's key in the process-wide CompileTracker."""
+        return self._sf._tracker_name
 
     def num_programs(self):
         """Entries in the jit program cache (recompile accounting)."""

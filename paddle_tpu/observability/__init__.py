@@ -1,0 +1,62 @@
+"""paddle_tpu.observability — framework-wide telemetry.
+
+One surface answering "why did this step take 900 ms" across training,
+serving, and distributed code:
+
+- **MetricsRegistry** (``metrics.py``): Counter/Gauge/Histogram primitives
+  with a process-wide default registry, JSON snapshots, and Prometheus
+  text-exposition export. The serving tier's ``ServingMetrics`` is built on
+  these (one private registry per scheduler instance).
+- **CompileTracker** (``compile_tracker.py``): every jit entry point
+  (``to_static`` StaticFunctions, ``TrainStep``, the serving ``SlotStep``)
+  reports program-cache growth here — compile counts, wall time, triggering
+  abstract shapes — and ``mark_steady()`` turns any further compile into a
+  loud ``RecompileStorm`` warning. The TPU failure mode this exists for is
+  silent recompilation.
+- **Trace spans** live in ``paddle_tpu.profiler`` (``RecordEvent``); the
+  training step, optimizer update, collectives, dataloader, and serving
+  scheduler all emit them, and ``Profiler.export_report()`` merges host
+  spans with metric snapshots into one artifact.
+
+Typical use::
+
+    from paddle_tpu.observability import get_registry, get_compile_tracker
+    reg = get_registry()
+    reg.counter("my_events_total").inc()
+    print(reg.prometheus_text())
+
+    tracker = get_compile_tracker()
+    ...warmup...
+    tracker.mark_steady()            # further compiles warn loudly
+    assert tracker.steady_state_recompiles() == 0
+"""
+
+from paddle_tpu.observability.compile_tracker import (  # noqa: F401
+    CompileEvent,
+    CompileTracker,
+    RecompileStorm,
+    abstract_signature,
+    get_compile_tracker,
+)
+from paddle_tpu.observability.metrics import (  # noqa: F401
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    get_registry,
+    parse_prometheus_text,
+)
+
+__all__ = [
+    "CompileEvent",
+    "CompileTracker",
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "MetricsRegistry",
+    "RecompileStorm",
+    "abstract_signature",
+    "get_compile_tracker",
+    "get_registry",
+    "parse_prometheus_text",
+]

@@ -1,0 +1,319 @@
+"""Process-wide metrics primitives: Counter / Gauge / Histogram + registry.
+
+The framework-wide observability surface (hoisted and generalized from the
+serving tier's ``ServingMetrics``): every layer — serving, training, jit,
+distributed — registers its counters into a ``MetricsRegistry`` that can be
+snapshot as one JSON-able dict or exported in the Prometheus text-exposition
+format (histograms render as Prometheus ``summary`` families with quantile
+lines). A process-wide default registry (``get_registry()``) backs the
+CompileTracker and the profiler's merged report; subsystems that need
+per-instance isolation (one ``ServingMetrics`` per scheduler) build their own
+private registry with the same primitives.
+
+Histogram semantics: a **deterministic reservoir** (Algorithm R with a fixed
+per-instance PRNG) that stays a uniform sample of the WHOLE stream — unlike a
+ring buffer, old observations are never systematically evicted, so the
+percentiles and the exact running ``count``/``mean`` describe the same
+population.
+"""
+
+from __future__ import annotations
+
+import random
+import re
+import threading
+from collections import OrderedDict
+from typing import Dict, Optional
+
+_NAME_RE = re.compile(r"[^a-zA-Z0-9_:]")
+
+
+def sanitize_metric_name(name: str) -> str:
+    """Prometheus metric name charset: [a-zA-Z_:][a-zA-Z0-9_:]*."""
+    name = _NAME_RE.sub("_", name)
+    if not name or name[0].isdigit():
+        name = "_" + name
+    return name
+
+
+class Counter:
+    """Monotonically increasing value."""
+
+    def __init__(self, name: str, description: str = "", unit: str = ""):
+        self.name = name
+        self.description = description
+        self.unit = unit
+        self._value = 0.0
+        self._lock = threading.Lock()
+
+    def inc(self, n: float = 1.0):
+        if n < 0:
+            raise ValueError(f"counter {self.name} cannot decrease (inc {n})")
+        with self._lock:
+            self._value += n
+
+    @property
+    def value(self) -> float:
+        return self._value
+
+    def expose(self):
+        return [("counter", self.name, None, self._value)]
+
+
+class Gauge:
+    """Instantaneous value, settable up or down."""
+
+    def __init__(self, name: str, description: str = "", unit: str = ""):
+        self.name = name
+        self.description = description
+        self.unit = unit
+        self._value = 0.0
+        self._lock = threading.Lock()
+
+    def set(self, v: float):
+        with self._lock:
+            self._value = float(v)
+
+    def inc(self, n: float = 1.0):
+        with self._lock:
+            self._value += n
+
+    def dec(self, n: float = 1.0):
+        self.inc(-n)
+
+    @property
+    def value(self) -> float:
+        return self._value
+
+    def expose(self):
+        return [("gauge", self.name, None, self._value)]
+
+
+class Histogram:
+    """Deterministic uniform reservoir over the full observation stream.
+
+    ``count``/``total`` (and thus ``mean``) are EXACT over every recorded
+    value; ``min``/``max`` are tracked exactly too. Percentiles come from an
+    Algorithm-R reservoir driven by a fixed-seed per-instance PRNG: once the
+    reservoir is full, observation ``i`` replaces a random slot with
+    probability ``max_samples / i`` — the reservoir stays a uniform sample of
+    ALL observations so far (a ring buffer, by contrast, only remembers the
+    last window, silently divorcing the percentiles from ``count``/``mean``).
+    Deterministic: the same stream always yields the same summary.
+    """
+
+    def __init__(self, max_samples: int = 4096, seed: int = 0x5EED,
+                 name: str = "histogram", description: str = "",
+                 unit: str = ""):
+        self.name = name
+        self.description = description
+        self.unit = unit
+        self._vals = []
+        self._max_samples = int(max_samples)
+        self._rng = random.Random(seed)
+        self.count = 0
+        self.total = 0.0
+        self.min_seen: Optional[float] = None
+        self.max_seen: Optional[float] = None
+
+    def record(self, v: float):
+        v = float(v)
+        self.count += 1
+        self.total += v
+        if self.min_seen is None or v < self.min_seen:
+            self.min_seen = v
+        if self.max_seen is None or v > self.max_seen:
+            self.max_seen = v
+        if len(self._vals) < self._max_samples:
+            self._vals.append(v)
+        else:
+            j = self._rng.randrange(self.count)
+            if j < self._max_samples:
+                self._vals[j] = v
+
+    # kept for API familiarity with prometheus clients
+    observe = record
+
+    def quantile(self, q: float) -> Optional[float]:
+        if not self._vals:
+            return None
+        import numpy as np
+
+        return float(np.percentile(np.asarray(self._vals, float), q * 100))
+
+    def summary(self) -> Dict[str, float]:
+        """Self-consistent digest: count/mean/max are exact over the stream,
+        percentiles are the reservoir's (a uniform sample of that stream)."""
+        if not self.count:
+            return {"count": 0}
+        import numpy as np
+
+        a = np.asarray(self._vals, float)
+        return {
+            "count": self.count,
+            "mean": self.total / self.count,
+            "p50": float(np.percentile(a, 50)),
+            "p90": float(np.percentile(a, 90)),
+            "p99": float(np.percentile(a, 99)),
+            "max": self.max_seen,
+        }
+
+    def expose(self):
+        rows = []
+        for q in (0.5, 0.9, 0.99):
+            v = self.quantile(q)
+            if v is not None:
+                rows.append(("summary", self.name, q, v))
+        rows.append(("summary", f"{self.name}_sum", None, self.total))
+        rows.append(("summary", f"{self.name}_count", None, self.count))
+        return rows
+
+
+class MetricsRegistry:
+    """Named metric collection with get-or-create semantics.
+
+    ``namespace`` prefixes every metric's exposition name (``serving_...``).
+    Creating the same name twice returns the SAME metric object; asking for
+    an existing name with a different kind raises.
+    """
+
+    def __init__(self, namespace: str = ""):
+        self.namespace = namespace
+        self._metrics: "OrderedDict[str, object]" = OrderedDict()
+        self._lock = threading.Lock()
+
+    # ------------------------------------------------------------ creation
+    def _full_name(self, name: str) -> str:
+        full = f"{self.namespace}_{name}" if self.namespace else name
+        return sanitize_metric_name(full)
+
+    def _get_or_create(self, kind, name, **kw):
+        full = self._full_name(name)
+        with self._lock:
+            m = self._metrics.get(full)
+            if m is not None:
+                if not isinstance(m, kind):
+                    raise TypeError(
+                        f"metric {full!r} already registered as "
+                        f"{type(m).__name__}, requested {kind.__name__}")
+                return m
+            m = kind(name=full, **kw)
+            self._metrics[full] = m
+            return m
+
+    def counter(self, name, description: str = "", unit: str = "") -> Counter:
+        return self._get_or_create(Counter, name, description=description,
+                                   unit=unit)
+
+    def gauge(self, name, description: str = "", unit: str = "") -> Gauge:
+        return self._get_or_create(Gauge, name, description=description,
+                                   unit=unit)
+
+    def histogram(self, name, description: str = "", unit: str = "",
+                  max_samples: int = 4096, seed: int = 0x5EED) -> Histogram:
+        return self._get_or_create(Histogram, name, description=description,
+                                   unit=unit, max_samples=max_samples,
+                                   seed=seed)
+
+    # ------------------------------------------------------------- reading
+    def get(self, name):
+        return self._metrics.get(self._full_name(name))
+
+    def __contains__(self, name):
+        return self._full_name(name) in self._metrics
+
+    def __len__(self):
+        return len(self._metrics)
+
+    def unregister(self, name):
+        self._metrics.pop(self._full_name(name), None)
+
+    def snapshot(self) -> Dict[str, object]:
+        """One JSON-able dict: counters/gauges -> value, histograms ->
+        summary() digest."""
+        out = {}
+        for full, m in self._metrics.items():
+            if isinstance(m, Histogram):
+                out[full] = m.summary()
+            else:
+                out[full] = m.value
+        return out
+
+    def prometheus_text(self) -> str:
+        """Prometheus text-exposition format (0.0.4). Histograms are emitted
+        as ``summary`` families (quantile series + _sum/_count)."""
+        lines = []
+        for full, m in self._metrics.items():
+            rows = m.expose()
+            mtype = rows[0][0]
+            if m.description:
+                lines.append(f"# HELP {full} {m.description}")
+            lines.append(f"# TYPE {full} {mtype}")
+            for _, name, quantile, value in rows:
+                if quantile is not None:
+                    lines.append(f'{name}{{quantile="{quantile}"}} '
+                                 f"{format_value(value)}")
+                else:
+                    lines.append(f"{name} {format_value(value)}")
+        return "\n".join(lines) + "\n"
+
+
+def format_value(v) -> str:
+    f = float(v)
+    if f == int(f) and abs(f) < 1e15:
+        return str(int(f))
+    return repr(f)
+
+
+def parse_prometheus_text(text: str) -> Dict[str, dict]:
+    """Minimal parser for the exposition subset ``prometheus_text`` emits —
+    the round-trip oracle for tests and a convenience for local tooling.
+
+    Returns ``{family: {"type": t, "value": v}}`` for counters/gauges and
+    ``{family: {"type": "summary", "quantiles": {q: v}, "sum": s,
+    "count": c}}`` for summaries.
+    """
+    families: Dict[str, dict] = {}
+    types: Dict[str, str] = {}
+    for line in text.splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        if line.startswith("# TYPE "):
+            _, _, name, mtype = line.split(None, 3)
+            types[name] = mtype
+            families.setdefault(name, {"type": mtype})
+            if mtype == "summary":
+                families[name].setdefault("quantiles", {})
+            continue
+        if line.startswith("#"):
+            continue
+        name_part, _, value_part = line.rpartition(" ")
+        value = float(value_part)
+        if "{" in name_part:
+            name, _, labels = name_part.partition("{")
+            labels = labels.rstrip("}")
+            fam = families.setdefault(name, {"type": types.get(name)})
+            m = re.search(r'quantile="([^"]+)"', labels)
+            if m:
+                fam.setdefault("quantiles", {})[float(m.group(1))] = value
+            continue
+        name = name_part
+        if name.endswith("_sum") and types.get(name[:-4]) == "summary":
+            families.setdefault(name[:-4], {})["sum"] = value
+        elif name.endswith("_count") and types.get(name[:-6]) == "summary":
+            families.setdefault(name[:-6], {})["count"] = value
+        else:
+            fam = families.setdefault(name, {"type": types.get(name)})
+            fam["value"] = value
+    return families
+
+
+_default_registry = MetricsRegistry()
+
+
+def get_registry() -> MetricsRegistry:
+    """The process-wide default registry (framework-internal metrics:
+    compile tracking, jax backend compiles, anything without per-instance
+    isolation needs)."""
+    return _default_registry

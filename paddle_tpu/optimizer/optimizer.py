@@ -98,6 +98,12 @@ class Optimizer:
     # ------------------------------------------------------------------- step
     @no_grad()
     def step(self):
+        from paddle_tpu.profiler import RecordEvent, TracerEventType
+
+        with RecordEvent("optimizer.step", TracerEventType.Optimization):
+            self._step_impl()
+
+    def _step_impl(self):
         lr = jnp.asarray(self.get_lr(), jnp.float32)
         self._step_count += 1
         offload = getattr(self, "_offload", False)

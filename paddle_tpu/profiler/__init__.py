@@ -11,6 +11,7 @@ profiler_statistic.py."""
 from __future__ import annotations
 
 import contextlib
+import itertools
 import json
 import os
 import threading
@@ -119,13 +120,19 @@ def make_scheduler(*, closed: int, ready: int, record: int, repeat: int = 0,
     return schedule
 
 
+_export_seq = itertools.count()
+
+
 def export_chrome_tracing(dir_name: str, worker_name: Optional[str] = None):
-    """on_trace_ready factory writing chrome trace json."""
+    """on_trace_ready factory writing chrome trace json. Filenames carry a
+    process-wide monotonic suffix so two snapshots landing within the same
+    wall-clock second never overwrite each other."""
 
     def handler(prof: "Profiler"):
         os.makedirs(dir_name, exist_ok=True)
         name = worker_name or f"host_{os.getpid()}"
-        path = os.path.join(dir_name, f"{name}_{int(time.time())}.json")
+        path = os.path.join(
+            dir_name, f"{name}_{int(time.time())}_{next(_export_seq)}.json")
         with open(path, "w") as f:
             json.dump({"traceEvents": prof._last_events}, f)
         prof._exported_path = path
@@ -202,9 +209,12 @@ class Profiler:
         if self._scheduler:
             new_state = self._scheduler(self.step_num)
             if new_state != self._state:
-                if self._state in (ProfilerState.RECORD,
-                                   ProfilerState.RECORD_AND_RETURN) and \
-                        new_state == ProfilerState.CLOSED:
+                recording = (ProfilerState.RECORD,
+                             ProfilerState.RECORD_AND_RETURN)
+                # snapshot on ANY exit from a recording state (CLOSED *or*
+                # READY) — a RECORD→READY transition used to silently drop
+                # every event of the window it just recorded
+                if self._state in recording and new_state not in recording:
                     self._snapshot()
                 self._state = new_state
                 self._sync_recorder()
@@ -234,30 +244,50 @@ class Profiler:
         with open(path, "w") as f:
             json.dump({"traceEvents": self._last_events}, f)
 
-    def summary(self, sorted_by=None, op_detail=True, thread_sep=False,
-                time_unit="ms"):
+    def _event_stats(self):
+        """name -> {calls, total_ms, cat} over the last snapshot."""
         stats = {}
         for e in self._last_events:
-            s = stats.setdefault(e["name"], {"calls": 0, "total_ms": 0.0})
+            s = stats.setdefault(e["name"], {"calls": 0, "total_ms": 0.0,
+                                             "cat": e.get("cat",
+                                                          "UserDefined")})
             s["calls"] += 1
             s["total_ms"] += e["dur"] / 1000.0
+        return stats
+
+    @staticmethod
+    def _span_block(title, items):
+        lines = [title,
+                 f"{'span':<40}{'calls':>8}{'total(ms)':>12}{'mean(ms)':>12}"]
+        for name, s in sorted(items.items(), key=lambda kv: -kv[1]["total_ms"]):
+            mean = s["total_ms"] / max(s["calls"], 1)
+            lines.append(f"{name:<40}{s['calls']:>8}"
+                         f"{s['total_ms']:>12.3f}{mean:>12.3f}")
+        return lines
+
+    def summary(self, sorted_by=None, op_detail=True, thread_sep=False,
+                time_unit="ms"):
+        stats = self._event_stats()
         lines = ["host event summary", f"{'name':<40}{'calls':>8}{'total(ms)':>12}"]
         for name, s in sorted(stats.items(), key=lambda kv: -kv[1]["total_ms"]):
             lines.append(f"{name:<40}{s['calls']:>8}{s['total_ms']:>12.3f}")
+        # per-category blocks (TracerEventType): the training step, optimizer
+        # update, collectives and dataloader each get their own table with
+        # per-call means — not just the serving prefix
+        by_cat = {}
+        for name, s in stats.items():
+            by_cat.setdefault(s["cat"], {})[name] = s
+        for cat in sorted(by_cat):
+            if cat == "UserDefined":
+                continue  # generic spans stay in the overall table
+            lines += self._span_block(f"[{cat}] spans", by_cat[cat])
         # serving line items: the continuous-batching scheduler's spans
         # (serving.prefill / serving.decode_step / serving.preempt) get a
         # dedicated block with per-call means, so a serving run's iteration
         # profile is readable at a glance
         serving = {n: s for n, s in stats.items() if n.startswith("serving.")}
         if serving:
-            lines.append("serving spans")
-            lines.append(
-                f"{'span':<40}{'calls':>8}{'total(ms)':>12}{'mean(ms)':>12}")
-            for name, s in sorted(serving.items(),
-                                  key=lambda kv: -kv[1]["total_ms"]):
-                mean = s["total_ms"] / max(s["calls"], 1)
-                lines.append(f"{name:<40}{s['calls']:>8}"
-                             f"{s['total_ms']:>12.3f}{mean:>12.3f}")
+            lines += self._span_block("serving spans", serving)
         if self._step_times:
             import numpy as np
 
@@ -268,6 +298,40 @@ class Profiler:
                 f"p99 {np.percentile(st, 99):.2f} ms")
         report = "\n".join(lines)
         print(report)
+        return report
+
+    def export_report(self, path: Optional[str] = None, *,
+                      include_metrics: bool = True, registries=None):
+        """One merged observability artifact: host spans (per name AND per
+        category), step times, metric snapshots (the process-wide registry
+        plus any extra registries, e.g. a scheduler's ServingMetrics), and
+        the CompileTracker's per-function compile accounting. Written as
+        JSON when ``path`` is given; always returned as a dict."""
+        stats = self._event_stats()
+        by_cat = {}
+        for name, s in stats.items():
+            by_cat.setdefault(s["cat"], {})[name] = dict(s)
+        report = {
+            "host_events": list(self._last_events),
+            "spans": {n: dict(s) for n, s in stats.items()},
+            "categories": by_cat,
+            "step_times_s": list(self._step_times),
+        }
+        if include_metrics:
+            from paddle_tpu.observability import (
+                get_compile_tracker,
+                get_registry,
+            )
+
+            metrics = {"default": get_registry().snapshot()}
+            for i, reg in enumerate(registries or ()):
+                snap = reg.snapshot() if hasattr(reg, "snapshot") else dict(reg)
+                metrics[getattr(reg, "namespace", "") or f"extra_{i}"] = snap
+            report["metrics"] = metrics
+            report["compiles"] = get_compile_tracker().snapshot()
+        if path is not None:
+            with open(path, "w") as f:
+                json.dump(report, f, indent=2, default=str)
         return report
 
 

@@ -22,7 +22,11 @@ Typical use::
     outputs = sched.run()          # or sched.step() under your own loop
 """
 
-from paddle_tpu.serving.metrics import Histogram, ServingMetrics  # noqa: F401
+from paddle_tpu.serving.metrics import (  # noqa: F401
+    Histogram,
+    MetricsRegistry,
+    ServingMetrics,
+)
 from paddle_tpu.serving.request import (  # noqa: F401
     QueueFull,
     Request,
@@ -38,6 +42,7 @@ from paddle_tpu.serving.scheduler import (  # noqa: F401
 __all__ = [
     "ContinuousBatchingScheduler",
     "Histogram",
+    "MetricsRegistry",
     "QueueFull",
     "Request",
     "RequestOutput",

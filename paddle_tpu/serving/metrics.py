@@ -1,75 +1,78 @@
-"""Serving metrics registry: counters, gauges, latency histograms.
+"""Serving metrics: counters, gauges, latency histograms — registry-backed.
 
 The observability face of the serving tier (queue depth, slot occupancy,
-KV-block utilization/fragmentation, preemptions, TTFT/TPOT, tokens/s),
-snapshot-able as one JSON-able dict for benchmarks and dashboards. Host
-spans for prefill/decode/preempt ride ``paddle_tpu.profiler.RecordEvent``
-from the scheduler, so a ``Profiler`` run shows serving line items."""
+KV-block utilization/fragmentation, preemptions, TTFT/TPOT, tokens/s). Since
+the observability PR, every value lives in a ``MetricsRegistry``
+(``paddle_tpu.observability``): one private ``serving``-namespaced registry
+per ``ServingMetrics`` instance (schedulers must not share counters), so the
+same numbers are snapshot-able as one JSON dict AND exportable in Prometheus
+text-exposition format via ``prometheus_text()``. The attribute API the
+scheduler uses (``metrics.preemptions += 1``) is preserved through
+properties over the registry metrics. Host spans for prefill/decode/preempt
+ride ``paddle_tpu.profiler.RecordEvent`` from the scheduler, so a
+``Profiler`` run shows serving line items.
+"""
 
 from __future__ import annotations
 
 import time
-from typing import Dict, List, Optional
+from typing import Dict, Optional
 
+from paddle_tpu.observability.metrics import (  # noqa: F401 (re-export)
+    Histogram,
+    MetricsRegistry,
+)
 
-class Histogram:
-    """Bounded reservoir of observations with percentile summaries."""
-
-    def __init__(self, max_samples: int = 4096):
-        self._vals: List[float] = []
-        self._max = max_samples
-        self.count = 0
-        self.total = 0.0
-
-    def record(self, v: float):
-        self.count += 1
-        self.total += v
-        if len(self._vals) < self._max:
-            self._vals.append(v)
-        else:  # keep a deterministic stride-reservoir of the stream
-            self._vals[self.count % self._max] = v
-
-    def summary(self) -> Dict[str, float]:
-        if not self._vals:
-            return {"count": 0}
-        import numpy as np
-
-        a = np.asarray(self._vals, float)
-        return {
-            "count": self.count,
-            "mean": float(a.mean()),
-            "p50": float(np.percentile(a, 50)),
-            "p90": float(np.percentile(a, 90)),
-            "p99": float(np.percentile(a, 99)),
-            "max": float(a.max()),
-        }
+_COUNTERS = (
+    ("requests_received", "requests accepted into the queue"),
+    ("requests_finished", "requests fully decoded"),
+    ("requests_rejected", "requests refused by admission control"),
+    ("preemptions", "sequences evicted on KV-pool exhaustion"),
+    ("prefill_tokens", "prompt tokens processed by prefill"),
+    ("generated_tokens", "tokens sampled"),
+    ("decode_steps", "fixed-shape decode iterations"),
+    ("prefills", "prefill passes (admissions + resume recomputes)"),
+)
+_GAUGES = (
+    ("queue_depth", "requests waiting for a slot"),
+    ("running", "occupied slots"),
+    ("free_blocks", "free KV blocks"),
+    ("total_blocks", "KV pool size in blocks"),
+    ("kv_utilization", "fraction of KV blocks in use"),
+    ("kv_fragmentation", "tail slack inside allocated blocks"),
+)
 
 
 class ServingMetrics:
-    """Counters + gauges + histograms for one scheduler instance."""
+    """Counters + gauges + histograms for one scheduler instance.
 
-    def __init__(self):
+    ``registry`` defaults to a fresh private ``MetricsRegistry`` namespaced
+    ``serving`` — pass a shared registry to aggregate several schedulers
+    into one exposition surface (their counters then merge).
+    """
+
+    def __init__(self, registry: Optional[MetricsRegistry] = None):
         self.t_start = time.perf_counter()
-        # counters
-        self.requests_received = 0
-        self.requests_finished = 0
-        self.requests_rejected = 0
-        self.preemptions = 0
-        self.prefill_tokens = 0
-        self.generated_tokens = 0
-        self.decode_steps = 0
-        self.prefills = 0
-        # gauges (refreshed by the scheduler each iteration)
-        self.queue_depth = 0
-        self.running = 0
-        self.free_blocks = 0
-        self.total_blocks = 0
-        self.kv_utilization = 0.0
-        self.kv_fragmentation = 0.0
+        self._registry = (MetricsRegistry(namespace="serving")
+                          if registry is None else registry)
+        self._counters = {n: self._registry.counter(n, d)
+                          for n, d in _COUNTERS}
+        self._gauges = {n: self._registry.gauge(n, d) for n, d in _GAUGES}
         # latency histograms (seconds)
-        self.ttft = Histogram()
-        self.tpot = Histogram()
-        self.step_time = Histogram()
+        self.ttft = self._registry.histogram(
+            "ttft_seconds", "time to first token", unit="s")
+        self.tpot = self._registry.histogram(
+            "tpot_seconds", "time per output token", unit="s")
+        self.step_time = self._registry.histogram(
+            "step_time_seconds", "scheduler iteration wall time", unit="s")
+
+    @property
+    def registry(self) -> MetricsRegistry:
+        return self._registry
+
+    def prometheus_text(self) -> str:
+        """Prometheus text exposition of every serving metric."""
+        return self._registry.prometheus_text()
 
     # ---- scheduler hooks ----------------------------------------------
     def observe_gauges(self, *, queue_depth: int, running: int, allocator,
@@ -116,3 +119,34 @@ class ServingMetrics:
             "tpot_s": self.tpot.summary(),
             "step_time_s": self.step_time.summary(),
         }
+
+
+def _counter_property(name):
+    def _get(self):
+        return int(self._counters[name].value)
+
+    def _set(self, v):
+        # the scheduler writes `metrics.x += 1`: translate the read-modify-
+        # write into a monotonic inc on the registry counter
+        self._counters[name].inc(v - self._counters[name].value)
+
+    return property(_get, _set)
+
+
+def _gauge_property(name):
+    def _get(self):
+        v = self._gauges[name].value
+        return int(v) if float(v).is_integer() and name not in (
+            "kv_utilization", "kv_fragmentation") else v
+
+    def _set(self, v):
+        self._gauges[name].set(v)
+
+    return property(_get, _set)
+
+
+for _n, _ in _COUNTERS:
+    setattr(ServingMetrics, _n, _counter_property(_n))
+for _n, _ in _GAUGES:
+    setattr(ServingMetrics, _n, _gauge_property(_n))
+del _n, _

@@ -344,3 +344,27 @@ class ContinuousBatchingScheduler:
     def num_programs(self):
         """Compiled-program count (recompile accounting for tests)."""
         return self._step_fn.num_programs()
+
+    # ---- compile observability ----------------------------------------
+
+    def mark_steady(self):
+        """Declare warmup over: any further compile of this scheduler's
+        step (prefill bucket or decode grid) is a steady-state recompile —
+        the CompileTracker counts it and warns RecompileStorm loudly."""
+        from paddle_tpu.observability import get_compile_tracker
+
+        get_compile_tracker().mark_steady(self._step_fn.tracker_name)
+
+    def compile_stats(self) -> Dict[str, object]:
+        """This scheduler's CompileTracker accounting: total compiles of
+        its slot step and how many happened after ``mark_steady()`` — the
+        zero-steady-state-recompile guarantee is pinned through this."""
+        from paddle_tpu.observability import get_compile_tracker
+
+        t = get_compile_tracker()
+        name = self._step_fn.tracker_name
+        return {
+            "fn": name,
+            "compiles": t.compiles(name),
+            "steady_state_recompiles": t.steady_state_recompiles(name),
+        }
