@@ -95,6 +95,7 @@ from paddle_tpu import hapi  # noqa: E402,F401
 from paddle_tpu.hapi.model import Model  # noqa: E402,F401
 from paddle_tpu import profiler  # noqa: E402,F401
 from paddle_tpu import observability  # noqa: E402,F401
+from paddle_tpu import checkpoint  # noqa: E402,F401
 from paddle_tpu import fft  # noqa: E402,F401
 from paddle_tpu import distribution  # noqa: E402,F401
 from paddle_tpu import sparse  # noqa: E402,F401

@@ -1,0 +1,66 @@
+"""paddle_tpu.checkpoint — fault-tolerant checkpoint lifecycle.
+
+The paper's production story (fleet/elastic surviving preemption at
+millions-of-users scale) needs more than a shard writer: it needs a
+*lifecycle* in which a checkpoint is either fully committed or invisible,
+captures the FULL train state, and auto-resumes after a crash. This
+subsystem supplies it on top of ``distributed.checkpoint``'s
+reshard-on-load shard store:
+
+- **CheckpointManager** (``manager.py``): atomic commit protocol
+  (tmp-dir write + fsync -> checksummed manifest -> atomic rename ->
+  ``COMMITTED`` marker), async snapshot-then-write with backpressure,
+  ``latest()`` that skips torn/bit-flipped checkpoints, keep-last-N /
+  keep-every-K retention GC, and ``checkpoint_*`` metrics + trace spans
+  through the observability registry.
+- **TrainState capture** (``state.py``): params, optimizer slots + fp32
+  masters, LR scheduler, ``framework.random`` RNG stream, dataloader
+  epoch/offset, and the step counter — resume is bit-identical.
+- **Manifest/integrity** (``manifest.py``): per-shard sizes + crc32,
+  fsync discipline, commit markers.
+
+Typical training loop::
+
+    mgr = checkpoint.CheckpointManager("ckpts", keep_last_n=3)
+    if mgr.latest():
+        start = mgr.restore(train_step=step_fn, dataloader=loader).step + 1
+    for step in range(start, total):
+        loss = step_fn(x, y)
+        if step % 500 == 0:
+            mgr.save(step, train_step=step_fn, dataloader=loader,
+                     async_save=True)   # snapshot now, stream in background
+"""
+
+from paddle_tpu.checkpoint.manager import (  # noqa: F401
+    CheckpointInfo,
+    CheckpointManager,
+    RestoreResult,
+    SimulatedCrash,
+)
+from paddle_tpu.checkpoint.manifest import (  # noqa: F401
+    COMMITTED_FILE,
+    MANIFEST_FILE,
+    build_manifest,
+    is_committed,
+    read_manifest,
+    verify_dir,
+)
+from paddle_tpu.checkpoint.state import (  # noqa: F401
+    capture_state,
+    restore_state,
+)
+
+__all__ = [
+    "COMMITTED_FILE",
+    "MANIFEST_FILE",
+    "CheckpointInfo",
+    "CheckpointManager",
+    "RestoreResult",
+    "SimulatedCrash",
+    "build_manifest",
+    "capture_state",
+    "is_committed",
+    "read_manifest",
+    "restore_state",
+    "verify_dir",
+]

@@ -1,0 +1,133 @@
+"""Commit manifest + integrity layer for checkpoint directories.
+
+A checkpoint directory is COMMITTED when it contains:
+
+- every shard / metadata file the writer produced,
+- ``MANIFEST.json`` — per-file sizes + crc32 checksums over the payload set,
+- the ``COMMITTED`` marker, dropped only after the directory was atomically
+  renamed into its final name with everything above fsynced.
+
+``verify_dir`` re-derives the integrity claim from disk: a torn write shows
+up as a missing file or short size, a bit-flip as a crc mismatch. The
+manager uses it to make ``latest()`` skip corrupt checkpoints and fall back
+to the previous commit.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import zlib
+from typing import List, Tuple
+
+MANIFEST_FILE = "MANIFEST.json"
+COMMITTED_FILE = "COMMITTED"
+MANIFEST_FORMAT = 1
+
+# bookkeeping files excluded from the manifest's payload set
+_NON_PAYLOAD = {MANIFEST_FILE, COMMITTED_FILE}
+
+
+def file_crc32(path: str, chunk: int = 1 << 20) -> int:
+    crc = 0
+    with open(path, "rb") as f:
+        while True:
+            buf = f.read(chunk)
+            if not buf:
+                return crc & 0xFFFFFFFF
+            crc = zlib.crc32(buf, crc)
+
+
+def fsync_file(path: str) -> None:
+    fd = os.open(path, os.O_RDONLY)
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+def fsync_dir(path: str) -> None:
+    """Flush directory entries (renames/creates) to stable storage."""
+    fd = os.open(path, os.O_RDONLY | getattr(os, "O_DIRECTORY", 0))
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass  # some filesystems refuse dir fsync; rename is still ordered
+    finally:
+        os.close(fd)
+
+
+def payload_files(dirpath: str) -> List[str]:
+    return sorted(
+        f for f in os.listdir(dirpath)
+        if f not in _NON_PAYLOAD and
+        os.path.isfile(os.path.join(dirpath, f))
+    )
+
+
+def build_manifest(dirpath: str, step: int) -> dict:
+    """Checksum every payload file currently in ``dirpath``."""
+    files = {}
+    for name in payload_files(dirpath):
+        p = os.path.join(dirpath, name)
+        files[name] = {"size": os.path.getsize(p), "crc32": file_crc32(p)}
+    return {"format": MANIFEST_FORMAT, "step": int(step), "files": files}
+
+
+def write_manifest(dirpath: str, manifest: dict) -> None:
+    p = os.path.join(dirpath, MANIFEST_FILE)
+    with open(p + ".tmp", "w") as f:
+        json.dump(manifest, f, indent=1, sort_keys=True)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(p + ".tmp", p)
+
+
+def read_manifest(dirpath: str) -> dict | None:
+    p = os.path.join(dirpath, MANIFEST_FILE)
+    if not os.path.exists(p):
+        return None
+    try:
+        with open(p) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
+def mark_committed(dirpath: str, step: int) -> None:
+    """Drop the COMMITTED marker — the last, smallest write of the commit
+    protocol. A kill before this leaves the directory discoverably torn."""
+    p = os.path.join(dirpath, COMMITTED_FILE)
+    with open(p, "w") as f:
+        f.write(f"step={int(step)}\n")
+        f.flush()
+        os.fsync(f.fileno())
+    fsync_dir(dirpath)
+
+
+def is_committed(dirpath: str) -> bool:
+    return os.path.exists(os.path.join(dirpath, COMMITTED_FILE))
+
+
+def verify_dir(dirpath: str, level: str = "full") -> Tuple[bool, List[str]]:
+    """Check a checkpoint directory against its manifest.
+
+    ``level``: ``"quick"`` checks existence + size (cheap, catches torn
+    writes); ``"full"`` additionally recomputes crc32 per file (catches
+    bit-flips). Returns ``(ok, problems)``."""
+    problems: List[str] = []
+    manifest = read_manifest(dirpath)
+    if manifest is None:
+        return False, [f"{MANIFEST_FILE} missing or unreadable"]
+    for name, want in manifest.get("files", {}).items():
+        p = os.path.join(dirpath, name)
+        if not os.path.exists(p):
+            problems.append(f"{name}: missing")
+            continue
+        size = os.path.getsize(p)
+        if size != want["size"]:
+            problems.append(f"{name}: size {size} != {want['size']}")
+            continue
+        if level == "full" and file_crc32(p) != want["crc32"]:
+            problems.append(f"{name}: crc32 mismatch (bit corruption)")
+    return not problems, problems

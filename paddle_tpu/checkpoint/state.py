@@ -1,0 +1,218 @@
+"""Full train-state capture/restore — the TrainState side of the manager.
+
+A checkpoint is only useful for fault tolerance if resume is *bit-identical*:
+params, optimizer slots (+ fp32 masters), LR scheduler, the framework RNG
+stream, the dataloader position, and the step counter must all round-trip.
+This module maps that state onto the sharded tensor store
+(``distributed.checkpoint``) plus one small JSON sidecar:
+
+- tensor payloads (params / optimizer slots / masters) go through
+  ``save_state_dict`` under namespaced keys (``model.*``, ``optim.state.i.*``,
+  ``optim.master.i``) — sharded arrays keep their reshard-on-load metadata;
+- host scalars (step, LR scheduler state, RNG key bits + counter, dataloader
+  epoch/offset, loss-scaler state) live in ``train_state.json``.
+
+Restore materializes optimizer slots FROM the checkpoint metadata (shape +
+dtype), so a freshly built optimizer that has never stepped resumes exactly
+where the crashed run stopped.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, Optional, Tuple
+
+EXTRA_FILE = "train_state.json"
+STATE_FORMAT = 1
+
+
+def _tensor(v):
+    from paddle_tpu.tensor import Tensor
+
+    return v if isinstance(v, Tensor) else Tensor._from_value(v)
+
+
+def _resolve_targets(model=None, optimizer=None, train_step=None):
+    """A TrainStep carries both the model and the optimizer; explicit
+    arguments win so callers can checkpoint a subset."""
+    if train_step is not None:
+        model = model if model is not None else train_step._model
+        optimizer = optimizer if optimizer is not None else train_step._opt
+    return model, optimizer
+
+
+def _optimizer_tree(opt) -> Dict:
+    """Optimizer slots + masters as a nested dict of Tensors, keyed by
+    parameter INDEX (stable across processes; param names may not be)."""
+    tree: Dict = {"state": {}, "master": {}}
+    for i, p in enumerate(opt._parameter_list):
+        st = opt._state.get(id(p))
+        if st:
+            tree["state"][str(i)] = {k: _tensor(v) for k, v in st.items()}
+        mw = opt._master_weights.get(id(p))
+        if mw is not None:
+            tree["master"][str(i)] = _tensor(mw)
+    if not tree["state"]:
+        del tree["state"]
+    if not tree["master"]:
+        del tree["master"]
+    return tree
+
+
+def capture_state(step: int, model=None, optimizer=None, train_step=None,
+                  dataloader=None, state: Optional[Dict] = None,
+                  extra: Optional[Dict] = None) -> Tuple[Dict, Dict]:
+    """Build ``(tensor_tree, extra_json)`` for one checkpoint.
+
+    ``state`` is an escape hatch: any extra dict of Tensors (EMA shadows,
+    custom buffers) saved under ``user.*``."""
+    from paddle_tpu.framework import random as rng
+
+    tree: Dict = {}
+    model, optimizer = _resolve_targets(model, optimizer, train_step)
+    if model is not None:
+        tree["model"] = dict(model.state_dict())
+    if optimizer is not None:
+        ot = _optimizer_tree(optimizer)
+        if ot:
+            tree["optim"] = ot
+    if state:
+        tree["user"] = dict(state)
+
+    extra_json: Dict = {"format": STATE_FORMAT, "step": int(step),
+                        "rng": rng.rng_state_to_host()}
+    if optimizer is not None:
+        from paddle_tpu.optimizer import lr as lr_mod
+
+        opt_extra: Dict = {"step_count": int(optimizer._step_count)}
+        if isinstance(optimizer._lr, lr_mod.LRScheduler):
+            opt_extra["lr_scheduler"] = _jsonable(
+                optimizer._lr.state_dict())
+        extra_json["optimizer"] = opt_extra
+    if dataloader is not None and hasattr(dataloader, "state_dict"):
+        extra_json["dataloader"] = dataloader.state_dict()
+    if train_step is not None:
+        sc = train_step.checkpoint_extra()
+        if sc:
+            extra_json["train_step"] = sc
+    if extra:
+        extra_json["user"] = _jsonable(extra)
+    return tree, extra_json
+
+
+def _jsonable(obj):
+    """Round-trip guard: reject non-serializable scheduler/user state loudly
+    at SAVE time, not at resume time."""
+    return json.loads(json.dumps(obj))
+
+
+def write_extra(dirpath: str, extra_json: Dict) -> None:
+    p = os.path.join(dirpath, EXTRA_FILE)
+    with open(p + ".tmp", "w") as f:
+        json.dump(extra_json, f, indent=1, sort_keys=True)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(p + ".tmp", p)
+
+
+def read_extra(dirpath: str) -> Dict:
+    p = os.path.join(dirpath, EXTRA_FILE)
+    if not os.path.exists(p):
+        return {}
+    with open(p) as f:
+        return json.load(f)
+
+
+def _zeros_target(tm):
+    """Materialize a load target from checkpoint metadata (shape + dtype) —
+    lets restore fill optimizer slots the live optimizer hasn't built yet."""
+    import jax.numpy as jnp
+
+    from paddle_tpu.distributed.checkpoint import _np_dtype
+
+    return _tensor(jnp.zeros(tuple(tm.global_shape),
+                             dtype=_np_dtype(tm.dtype)))
+
+
+def restore_state(path: str, model=None, optimizer=None, train_step=None,
+                  dataloader=None, state: Optional[Dict] = None,
+                  restore_rng: Optional[bool] = None) -> Dict:
+    """Fill the given objects in place from a committed checkpoint dir.
+
+    ``restore_rng`` defaults to True for training resumes (optimizer or
+    train_step present) and False for weight-only loads (e.g. a serving
+    hot-reload must not clobber the server's sampling stream). Returns the
+    checkpoint's extra dict (step counter, user extras...)."""
+    from paddle_tpu.distributed.checkpoint import (
+        get_checkpoint_metadata,
+        load_state_dict,
+    )
+    from paddle_tpu.framework import random as rng
+
+    model, optimizer = _resolve_targets(model, optimizer, train_step)
+    extra = read_extra(path)
+    md = get_checkpoint_metadata(path)
+    names = md.state_dict_metadata
+
+    tree: Dict = {}
+    if model is not None:
+        want = dict(model.state_dict())
+        missing = [k for k in want if f"model.{k}" not in names]
+        if missing:
+            raise KeyError(
+                f"checkpoint {path} lacks model tensors {missing[:5]}"
+                f"{'...' if len(missing) > 5 else ''}")
+        tree["model"] = want
+    opt_targets: Dict = {}
+    if optimizer is not None:
+        ot: Dict = {"state": {}, "master": {}}
+        for i, p in enumerate(optimizer._parameter_list):
+            prefix = f"optim.state.{i}."
+            slots = {n[len(prefix):]: tm for n, tm in names.items()
+                     if n.startswith(prefix)}
+            if slots:
+                ot["state"][str(i)] = {k: _zeros_target(tm)
+                                       for k, tm in slots.items()}
+                opt_targets[i] = p
+            mk = f"optim.master.{i}"
+            if mk in names:
+                ot["master"][str(i)] = _zeros_target(names[mk])
+        if not ot["state"]:
+            del ot["state"]
+        if not ot["master"]:
+            del ot["master"]
+        if ot:
+            tree["optim"] = ot
+    if state:
+        tree["user"] = dict(state)
+
+    if tree:
+        load_state_dict(tree, path)
+
+    if optimizer is not None:
+        for i, p in opt_targets.items():
+            optimizer._state[id(p)] = {
+                k: t._value for k, t in tree["optim"]["state"][str(i)].items()
+            }
+        for i_s, t in tree.get("optim", {}).get("master", {}).items():
+            p = optimizer._parameter_list[int(i_s)]
+            optimizer._master_weights[id(p)] = t._value
+        opt_extra = extra.get("optimizer", {})
+        optimizer._step_count = int(opt_extra.get("step_count",
+                                                  optimizer._step_count))
+        if "lr_scheduler" in opt_extra:
+            from paddle_tpu.optimizer import lr as lr_mod
+
+            if isinstance(optimizer._lr, lr_mod.LRScheduler):
+                optimizer._lr.set_state_dict(opt_extra["lr_scheduler"])
+    if dataloader is not None and hasattr(dataloader, "set_state_dict") and \
+            "dataloader" in extra:
+        dataloader.set_state_dict(extra["dataloader"])
+    if train_step is not None and "train_step" in extra:
+        train_step.apply_checkpoint_extra(extra["train_step"])
+    if restore_rng is None:
+        restore_rng = optimizer is not None or train_step is not None
+    if restore_rng and "rng" in extra:
+        rng.rng_state_from_host(extra["rng"])
+    return extra

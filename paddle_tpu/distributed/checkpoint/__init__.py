@@ -11,6 +11,7 @@ background thread (the tensorstore-style async checkpoint path)."""
 
 from __future__ import annotations
 
+import atexit
 import os
 import threading
 from typing import Dict, Optional
@@ -26,6 +27,7 @@ from paddle_tpu.tensor import Tensor
 
 _METADATA_FILE = "0.metadata"
 _pending: list = []
+_pending_errors: list = []
 
 
 def _process_index() -> int:
@@ -75,36 +77,17 @@ def _flatten(state_dict, prefix=""):
     return flat
 
 
-def save_state_dict(state_dict: Dict, path: str, process_group=None,
-                    coordinator_rank: int = 0, async_save: bool = False,
-                    **kwargs) -> None:
-    """Write sharded checkpoint at ``path`` (a directory)."""
+def _plan_writes(state_dict: Dict, path: str, coordinator_rank: int = 0):
+    """Phase 1 of a save: snapshot device state to host and plan file writes.
+
+    Copies every addressable shard to host memory (``np.asarray``) NOW, so
+    the caller may keep training — donated/replaced device buffers can no
+    longer tear the checkpoint. Returns ``(writes, md)`` where ``writes`` is
+    a list of ``(abs_file_path, np.ndarray)`` and ``md`` is this process's
+    metadata fragment. No file is touched."""
     import jax
 
-    wait_async_save()  # never race an in-flight async writer's files
-    os.makedirs(path, exist_ok=True)
     pidx = _process_index()
-    # clear this process's stale fragment + shard files from any prior save;
-    # the coordinator additionally clears fragments of processes beyond the
-    # current world (world shrank between saves)
-    own = {f"{pidx}.metadata"}
-    if pidx == coordinator_rank:
-        n_proc = jax.process_count()
-        for p in _metadata_paths(path):
-            frag_idx = os.path.basename(p).split(".")[0]
-            if frag_idx.isdigit() and int(frag_idx) >= n_proc:
-                own.add(os.path.basename(p))
-    for frag in own:
-        fp = os.path.join(path, frag)
-        if os.path.exists(fp):
-            with open(fp) as f:
-                old = Metadata.from_json(f.read())
-            for tm in old.state_dict_metadata.values():
-                for shard in tm.shards:
-                    sf = os.path.join(path, shard.file_name)
-                    if os.path.exists(sf):
-                        os.remove(sf)
-            os.remove(fp)
     flat = _flatten(state_dict)
     md = Metadata()
     writes = []  # (file, np.ndarray)
@@ -146,17 +129,82 @@ def save_state_dict(state_dict: Dict, path: str, process_group=None,
             writes.append((os.path.join(path, fn), local))
         if tm.shards:
             md.state_dict_metadata[name] = tm
+    return writes, md
+
+
+def _write_files(path: str, writes, md: Metadata, pidx: int,
+                 fsync: bool = False) -> int:
+    """Phase 2 of a save: stream planned shards + this process's metadata
+    fragment to disk. With ``fsync`` every file is flushed to stable storage
+    before its tmp-name is renamed in (the crash-safe CheckpointManager
+    path). Returns total bytes written."""
+    total = 0
+    for fn, arr in writes:
+        with open(fn + ".npy", "wb") as f:
+            np.save(f, arr, allow_pickle=False)
+            if fsync:
+                f.flush()
+                os.fsync(f.fileno())
+        total += os.path.getsize(fn + ".npy")
+        os.replace(fn + ".npy", fn)
+    # one metadata fragment per process; load merges all fragments
+    frag = os.path.join(path, f"{pidx}.metadata")
+    with open(frag + ".tmp", "w") as f:
+        f.write(md.to_json())
+        if fsync:
+            f.flush()
+            os.fsync(f.fileno())
+    total += os.path.getsize(frag + ".tmp")
+    os.replace(frag + ".tmp", frag)
+    return total
+
+
+def save_state_dict(state_dict: Dict, path: str, process_group=None,
+                    coordinator_rank: int = 0, async_save: bool = False,
+                    **kwargs) -> None:
+    """Write sharded checkpoint at ``path`` (a directory)."""
+    import jax
+
+    wait_async_save()  # never race an in-flight async writer's files
+    os.makedirs(path, exist_ok=True)
+    pidx = _process_index()
+    # clear this process's stale fragment + shard files from any prior save;
+    # the coordinator additionally clears fragments of processes beyond the
+    # current world (world shrank between saves)
+    own = {f"{pidx}.metadata"}
+    if pidx == coordinator_rank:
+        n_proc = jax.process_count()
+        for p in _metadata_paths(path):
+            frag_idx = os.path.basename(p).split(".")[0]
+            if frag_idx.isdigit() and int(frag_idx) >= n_proc:
+                own.add(os.path.basename(p))
+    for frag in own:
+        fp = os.path.join(path, frag)
+        if os.path.exists(fp):
+            with open(fp) as f:
+                old = Metadata.from_json(f.read())
+            for tm in old.state_dict_metadata.values():
+                for shard in tm.shards:
+                    sf = os.path.join(path, shard.file_name)
+                    if os.path.exists(sf):
+                        os.remove(sf)
+            os.remove(fp)
+    # device -> host snapshot happens HERE, synchronously: async mode only
+    # defers the file I/O, so training may resume (and donate the old
+    # buffers) the moment this call returns
+    writes, md = _plan_writes(state_dict, path, coordinator_rank)
 
     def do_writes():
-        for fn, arr in writes:
-            np.save(fn + ".npy", arr, allow_pickle=False)
-            os.replace(fn + ".npy", fn)
-        # one metadata fragment per process; load merges all fragments
-        with open(os.path.join(path, f"{pidx}.metadata"), "w") as f:
-            f.write(md.to_json())
+        _write_files(path, writes, md, pidx)
 
     if async_save:
-        t = threading.Thread(target=do_writes, daemon=True)
+        def guarded():
+            try:
+                do_writes()
+            except BaseException as e:  # surfaced by wait_async_save
+                _pending_errors.append(e)
+
+        t = threading.Thread(target=guarded, daemon=True)
         t.start()
         _pending.append(t)
     else:
@@ -164,8 +212,19 @@ def save_state_dict(state_dict: Dict, path: str, process_group=None,
 
 
 def wait_async_save():
+    """Block until every in-flight async save has fully landed on disk.
+    Re-raises the first background-writer error, if any. Registered via
+    ``atexit`` so a process exit cannot drop in-flight shard writes."""
     while _pending:
         _pending.pop().join()
+    if _pending_errors:
+        raise _pending_errors.pop(0)
+
+
+# durability: `save_state_dict(async_save=True)` followed by interpreter
+# exit must not tear the checkpoint — daemon writer threads would be killed
+# mid-write without this flush
+atexit.register(wait_async_save)
 
 
 def _np_dtype(name: str) -> np.dtype:
@@ -250,7 +309,15 @@ def load_state_dict(state_dict: Dict, path: str, process_group=None,
             else:
                 full = _read_region(path, tm, _full_region(tm.global_shape))
                 if isinstance(cur, jax.Array):
-                    new = jax.device_put(full.astype(cur.dtype), cur.sharding)
+                    if cur.committed:
+                        new = jax.device_put(full.astype(cur.dtype),
+                                             cur.sharding)
+                    else:
+                        # keep UNcommitted arrays uncommitted: device_put
+                        # pins a sharding into the jit cache key, so an
+                        # in-place weight load (serving hot-reload) would
+                        # silently recompile every program using the param
+                        new = jax.numpy.asarray(full.astype(cur.dtype))
                 else:
                     new = jax.numpy.asarray(full)
             target._replace_value(new)

@@ -55,6 +55,7 @@ class ElasticManager:
         self._stop = threading.Event()
         self._hb_thread: Optional[threading.Thread] = None
         self._generation_at_start = self._generation()
+        self._ckpt_manager = None
 
     # ------------------------------------------------------------ membership
     def _generation(self) -> int:
@@ -106,6 +107,41 @@ class ElasticManager:
                 continue
         return alive
 
+    # ----------------------------------------------------------- checkpoint
+    def attach_checkpoint(self, manager) -> None:
+        """Pair this manager with a ``checkpoint.CheckpointManager`` so
+        elastic restarts resume from the last committed step instead of
+        restarting from scratch."""
+        self._ckpt_manager = manager
+
+    def last_committed_step(self, publish: bool = True) -> int:
+        """The newest committed (checksum-verified) checkpoint step, or -1.
+        With ``publish`` the step is also written to the store so the
+        post-restart generation can read it before its own manager exists."""
+        step = -1
+        if self._ckpt_manager is not None:
+            info = self._ckpt_manager.latest()
+            if info is not None:
+                step = info.step
+        if publish:
+            try:
+                self.store.set("elastic/resume_step", str(step).encode())
+            except Exception:
+                pass  # a flaky store must not block the restart protocol
+        return step
+
+    def resume_step(self) -> int:
+        """Read the resume step published by the pre-restart generation
+        (falls back to this process's own attached manager, then -1)."""
+        try:
+            if self.store.check("elastic/resume_step"):
+                return int(self.store.get("elastic/resume_step").decode())
+        except Exception:
+            pass
+        if self._ckpt_manager is not None:
+            return self.last_committed_step(publish=False)
+        return -1
+
     # ------------------------------------------------------------- lifecycle
     def watch(self) -> str:
         """One poll step: detect scale events (generation bump by a joining /
@@ -123,6 +159,14 @@ class ElasticManager:
         """Exit with the protocol code so the launcher relaunches us. The
         current alive membership is written to PADDLE_ELASTIC_WORLD_FILE (if
         set) so the supervisor respawns with the post-scale world size."""
+        if self._ckpt_manager is not None:
+            try:
+                # flush any in-flight async save, then advertise the commit
+                # the relaunched world should resume from
+                self._ckpt_manager.wait()
+            except Exception:
+                pass  # a torn in-flight save is skipped by latest()
+            self.last_committed_step(publish=True)
         world_file = os.environ.get("PADDLE_ELASTIC_WORLD_FILE")
         if world_file:
             try:

@@ -9,12 +9,60 @@ from paddle_tpu.distributed.checkpoint import (  # noqa: F401
 from paddle_tpu.framework.io import load, save  # noqa: F401
 
 
+def _program_persistables(main_program):
+    """Persistable state of a static Program = its scope (param values +
+    optimizer slots persisted across Executor.run calls), as Tensors."""
+    import numpy as np
+
+    from paddle_tpu.static import default_main_program
+    from paddle_tpu.tensor import Tensor
+
+    prog = main_program if main_program is not None else \
+        default_main_program()
+    state = {}
+    for name, val in prog.scope.items():
+        if isinstance(val, Tensor):
+            state[name] = val
+            continue
+        try:
+            arr = np.asarray(val)
+        except Exception:
+            continue  # non-array scope entries aren't persistable
+        if arr.dtype == object:
+            continue
+        state[name] = Tensor._from_value(arr)
+    return prog, state
+
+
 def save_persistables(exe, dirname, main_program=None, filename=None):
-    raise NotImplementedError(
-        "static-program persistable saving: use paddle.save on state "
-        "dicts or dist.save_state_dict for sharded checkpoints")
+    """Commit a static program's persistables (params + optimizer state in
+    its scope) as an atomic checkpoint under ``dirname`` — a thin wrapper
+    over ``paddle_tpu.checkpoint.CheckpointManager`` (reference
+    distributed/io.py save_persistables surface; ``exe``/``filename`` kept
+    for signature parity)."""
+    from paddle_tpu.checkpoint import CheckpointManager
+
+    prog, state = _program_persistables(main_program)
+    if not state:
+        raise ValueError("program has no persistable state to save")
+    mgr = CheckpointManager(dirname, keep_last_n=1)
+    info = mgr.latest(verify=False)
+    mgr.save((info.step + 1) if info else 0, state=state)
 
 
 def load_persistables(exe, dirname, main_program=None, filename=None):
-    raise NotImplementedError(
-        "use paddle.load / dist.load_state_dict")
+    """Load the latest committed persistables checkpoint back into the
+    program's scope (checksum-verified, skips torn commits)."""
+    from paddle_tpu.checkpoint import CheckpointManager
+    from paddle_tpu.tensor import Tensor
+
+    prog, state = _program_persistables(main_program)
+    if not state:
+        raise ValueError("program has no persistable state to load into")
+    mgr = CheckpointManager(dirname, keep_last_n=1)
+    mgr.restore(state=state, restore_rng=False)
+    for name, t in state.items():
+        # Tensor-valued scope entries were filled in place by the restore;
+        # raw-array entries get the loaded value written back
+        if not isinstance(prog.scope[name], Tensor):
+            prog.scope[name] = t._value

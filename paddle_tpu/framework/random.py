@@ -61,6 +61,40 @@ def next_key():
     return sub
 
 
+def rng_state_to_host() -> dict:
+    """Serialize the framework RNG state to a JSON-able dict (checkpointing:
+    key bits + split counter + key impl, enough for bit-identical resume)."""
+    import numpy as np
+
+    key, counter = get_rng_state()
+    data = np.asarray(jax.random.key_data(key))
+    try:
+        impl = str(jax.random.key_impl(key))
+    except Exception:
+        impl = None
+    return {"key_data": data.tolist(), "dtype": str(data.dtype),
+            "impl": impl, "counter": int(counter)}
+
+
+def rng_state_from_host(st: dict) -> None:
+    """Restore the framework RNG from ``rng_state_to_host`` output. The
+    subsequent ``next_key`` stream is bit-identical to the capture point."""
+    import numpy as np
+
+    data = jax.numpy.asarray(
+        np.asarray(st["key_data"], dtype=st.get("dtype", "uint32")))
+    key = None
+    impl = st.get("impl")
+    if impl:
+        try:
+            key = jax.random.wrap_key_data(data, impl=impl)
+        except Exception:
+            key = None  # impl string from another jax version: use default
+    if key is None:
+        key = jax.random.wrap_key_data(data)
+    set_rng_state((key, int(st.get("counter", 0))))
+
+
 def np_rng():
     """A numpy Generator seeded from the framework RNG stream — host-side
     randomness (data pipeline shuffles, graph sampling) that reproduces

@@ -177,7 +177,12 @@ class Model:
 
     def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
             eval_freq=1, log_freq=10, save_dir=None, save_freq=1, verbose=2,
-            drop_last=False, shuffle=True, num_workers=0, callbacks=None):
+            drop_last=False, shuffle=True, num_workers=0, callbacks=None,
+            checkpoint_dir=None, checkpoint_freq=1):
+        """``checkpoint_dir`` turns on crash-safe auto-resume: full train
+        state (params + optimizer + RNG + epoch) commits atomically every
+        ``checkpoint_freq`` epochs, and a later ``fit`` against the same dir
+        restores the last commit and continues from the next epoch."""
         loader = self._as_loader(train_data, batch_size, shuffle, num_workers,
                                  drop_last)
         eval_loader = self._as_loader(eval_data, batch_size, False, num_workers)
@@ -187,9 +192,19 @@ class Model:
             log_freq=log_freq, verbose=verbose, save_freq=save_freq,
             save_dir=save_dir, metrics=[m.name() for m in self._metrics],
         )
+        start_epoch = 0
+        ckpt_mgr = None
+        if checkpoint_dir is not None:
+            from paddle_tpu.checkpoint import CheckpointManager
+
+            ckpt_mgr = CheckpointManager(checkpoint_dir)
+            if ckpt_mgr.latest() is not None:
+                res = ckpt_mgr.restore(model=self.network,
+                                       optimizer=self._optimizer)
+                start_epoch = res.step + 1
         self.stop_training = False
         cbks.on_train_begin()
-        for epoch in range(epochs):
+        for epoch in range(start_epoch, epochs):
             if self.stop_training:
                 break
             cbks.on_epoch_begin(epoch)
@@ -203,6 +218,9 @@ class Model:
                 logs = self._metric_logs(loss[0])
                 cbks.on_train_batch_end(step, logs)
             cbks.on_epoch_end(epoch, logs)
+            if ckpt_mgr is not None and (epoch + 1) % checkpoint_freq == 0:
+                ckpt_mgr.save(epoch, model=self.network,
+                              optimizer=self._optimizer)
             if eval_loader is not None and (epoch + 1) % eval_freq == 0:
                 self._run_eval(eval_loader, cbks)
         cbks.on_train_end()
@@ -265,6 +283,30 @@ class Model:
         if not reset_optimizer and self._optimizer is not None and \
                 os.path.exists(opt_path):
             self._optimizer.set_state_dict(paddle.load(opt_path))
+
+    def save_checkpoint(self, dirname, step, async_save=False, **kwargs):
+        """Atomically commit full train state (network + optimizer + RNG)
+        at ``step`` under ``dirname`` via the checkpoint manager."""
+        from paddle_tpu.checkpoint import CheckpointManager
+
+        mgr = CheckpointManager(dirname, **kwargs)
+        mgr.save(step, model=self.network, optimizer=self._optimizer,
+                 async_save=async_save)
+        if async_save:
+            mgr.wait()  # a method-local manager can't defer past its scope
+        return mgr
+
+    def load_checkpoint(self, dirname, step=None):
+        """Restore the latest committed (or a specific) checkpoint; returns
+        the restored step, or -1 when the dir has no usable commit."""
+        from paddle_tpu.checkpoint import CheckpointManager
+
+        mgr = CheckpointManager(dirname)
+        if step is None and mgr.latest() is None:
+            return -1
+        res = mgr.restore(step=step, model=self.network,
+                          optimizer=self._optimizer)
+        return res.step
 
     def parameters(self, *args, **kwargs):
         return self.network.parameters(*args, **kwargs)

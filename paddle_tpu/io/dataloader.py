@@ -83,6 +83,24 @@ class DataLoader:
                     dataset, shuffle=shuffle, batch_size=batch_size,
                     drop_last=drop_last,
                 )
+        # checkpointable cursor: epoch count + batches consumed this epoch
+        self._epoch = 0
+        self._offset = 0
+        self._resume_skip = 0
+
+    # ------------------------------------------------------- checkpointing
+    def state_dict(self):
+        """Data position for full-train-state checkpoints: completed epochs
+        + batches consumed in the current one."""
+        return {"epoch": int(self._epoch), "offset": int(self._offset)}
+
+    def set_state_dict(self, state):
+        """Resume mid-epoch: the next ``__iter__`` skips ``offset`` batches
+        (indices are drawn but samples aren't materialized on the sync path)
+        so the stream continues where the checkpoint left off."""
+        self._epoch = int(state.get("epoch", 0))
+        self._offset = int(state.get("offset", 0))
+        self._resume_skip = self._offset
 
     def __len__(self):
         if self._iterable_mode:
@@ -93,6 +111,10 @@ class DataLoader:
 
     # ------------------------------------------------------------------ iter
     def __iter__(self):
+        skip = self._resume_skip
+        self._resume_skip = 0
+        if not skip:
+            self._offset = 0  # fresh epoch; a resume keeps its cursor
         if self._iterable_mode:
             inner = self._iter_iterable()
         elif self.num_workers > 0 and self.use_process_workers:
@@ -100,7 +122,18 @@ class DataLoader:
         elif self.num_workers > 0:
             inner = self._iter_threaded()
         else:
-            inner = self._iter_sync()
+            inner = self._iter_sync(skip)
+            skip = 0  # sync path skips on indices, without fetching
+        # worker paths: drain the already-consumed prefix (fetched but
+        # discarded — resume correctness over warm-up cost)
+        while skip > 0:
+            try:
+                next(inner)
+            except StopIteration:
+                self._epoch += 1
+                self._offset = 0
+                return
+            skip -= 1
         # dataloader.next spans: the time the CONSUMER waits for each batch
         # (fetch+collate inline, or queue wait under workers) — the
         # input-bound share of a training step in a Profiler run
@@ -111,19 +144,22 @@ class DataLoader:
                 try:
                     batch = next(inner)
                 except StopIteration:
+                    self._epoch += 1
+                    self._offset = 0
                     return
+            self._offset += 1
             yield batch
 
     def _fetch(self, batch_indices):
         samples = [self.dataset[i] for i in batch_indices]
         return self.collate_fn(samples)
 
-    def _iter_sync(self):
+    def _iter_sync(self, skip: int = 0):
         if self.batch_sampler is None:
-            for i in range(len(self.dataset)):
+            for i in range(skip, len(self.dataset)):
                 yield self.dataset[i]
             return
-        for batch_indices in self.batch_sampler:
+        for batch_indices in itertools.islice(self.batch_sampler, skip, None):
             yield self._fetch(batch_indices)
 
     def _iter_iterable(self):

@@ -629,6 +629,30 @@ class TrainStep:
             return loss_t, tree_wrap(aux_vals)
         return loss_t
 
+    # ------------------------------------------------------- checkpointing
+    def checkpoint_extra(self):
+        """Host-side state beyond model+optimizer that a bit-identical
+        resume needs: the in-graph GradScaler counters (scale / good / bad
+        live as device arrays between steps)."""
+        if self._scaler is None:
+            return None
+        sc, good, bad = self._scaler_state
+        return {"loss_scale": float(sc), "good_steps": int(good),
+                "bad_steps": int(bad)}
+
+    def apply_checkpoint_extra(self, extra):
+        if self._scaler is None or not extra:
+            return
+        self._scaler_state = (
+            jnp.asarray(extra["loss_scale"], jnp.float32),
+            jnp.asarray(extra["good_steps"], jnp.int32),
+            jnp.asarray(extra["bad_steps"], jnp.int32),
+        )
+        s = self._scaler
+        s._scale = float(extra["loss_scale"])
+        s._good_steps = int(extra["good_steps"])
+        s._bad_steps = int(extra["bad_steps"])
+
     def _program_count(self) -> int:
         n, seen = 0, set()
         for j in (self._jitted, getattr(self, "_jitted_checked", None),

@@ -345,6 +345,34 @@ class ContinuousBatchingScheduler:
         """Compiled-program count (recompile accounting for tests)."""
         return self._step_fn.num_programs()
 
+    # ---- weight hot-reload --------------------------------------------
+
+    def reload_weights(self, source, step: Optional[int] = None,
+                       verify="full") -> int:
+        """Hot-reload model weights from a committed training checkpoint —
+        the serving half of continuous training: a trainer commits through
+        ``checkpoint.CheckpointManager``, the server picks the commit up
+        between iterations without rebuilding the scheduler.
+
+        ``source`` is a CheckpointManager or a checkpoint root path; the
+        newest committed checkpoint (checksum-verified, torn commits are
+        skipped) is loaded unless ``step`` pins one. Weight shapes must
+        match — the compiled slot step is reused, so NO recompile happens.
+        In-flight sequences keep their already-written KV blocks (their next
+        tokens mix cache prefixes from the old weights; preempt or drain
+        first for strict per-request consistency). Returns the loaded step.
+        """
+        from paddle_tpu.checkpoint import CheckpointManager
+        from paddle_tpu.profiler import RecordEvent, TracerEventType
+
+        mgr = source if isinstance(source, CheckpointManager) \
+            else CheckpointManager(str(source))
+        with RecordEvent("serving.reload_weights",
+                         TracerEventType.UserDefined):
+            res = mgr.restore(step=step, model=self.model, verify=verify,
+                              restore_rng=False)
+        return res.step
+
     # ---- compile observability ----------------------------------------
 
     def mark_steady(self):

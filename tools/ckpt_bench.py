@@ -1,0 +1,160 @@
+#!/usr/bin/env python
+"""Checkpoint-lifecycle benchmark: save throughput, train-step stall, resume.
+
+Offline and deterministic: a synthetic parameter set of configurable size is
+driven through ``paddle_tpu.checkpoint.CheckpointManager`` under
+``JAX_PLATFORMS=cpu``, measuring the three numbers the fault-tolerance story
+lives on:
+
+- **save throughput** — committed bytes/s for a full sync save (snapshot +
+  fsynced shard writes + manifest + atomic commit);
+- **snapshot stall** — how long ``save(async_save=True)`` blocks a training
+  loop (device->host snapshot only; the writer streams in background), plus
+  the backpressure stall when a second save lands on an in-flight writer;
+- **resume latency** — ``latest()`` discovery + checksum verify + full
+  restore into freshly built model/optimizer state.
+
+  python tools/ckpt_bench.py --smoke        # fast CI artifact
+  python tools/ckpt_bench.py --mb 256       # heavier state
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import time
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO_ROOT not in sys.path:
+    sys.path.insert(0, REPO_ROOT)
+
+
+def _build_state(total_mb: float, n_tensors: int):
+    """A model+optimizer-shaped workload: n params plus two AdamW moments
+    each — 3x the param bytes, like real full-state checkpoints."""
+    import numpy as np
+
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+
+    per = max(int(total_mb * (1 << 20) / 4 / max(n_tensors, 1) / 3), 16)
+    side = max(int(per ** 0.5), 4)
+    paddle.seed(0)
+    layers = [nn.Linear(side, side) for _ in range(n_tensors)]
+
+    class Net(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            for i, l in enumerate(layers):
+                setattr(self, f"l{i}", l)
+
+        def forward(self, x):
+            for i in range(n_tensors):
+                x = getattr(self, f"l{i}")(x)
+            return x
+
+    net = Net()
+    opt = paddle.optimizer.AdamW(parameters=net.parameters(),
+                                 learning_rate=1e-3)
+    x = paddle.to_tensor(
+        np.random.default_rng(0).standard_normal((8, side),
+                                                 dtype=np.float32))
+    loss = net(x).mean()
+    loss.backward()
+    opt.step()  # materialize moments so the checkpoint carries them
+    return net, opt, x
+
+
+def _dir_bytes(d: str) -> int:
+    return sum(os.path.getsize(os.path.join(d, f)) for f in os.listdir(d))
+
+
+def run_bench(total_mb: float = 32.0, n_tensors: int = 8,
+              steps: int = 4) -> dict:
+    """Run one lifecycle measurement; returns the JSON-able artifact."""
+    import paddle_tpu as paddle
+    from paddle_tpu.checkpoint import CheckpointManager
+
+    net, opt, x = _build_state(total_mb, n_tensors)
+    root = tempfile.mkdtemp(prefix="ckpt_bench_")
+    mgr = CheckpointManager(root, keep_last_n=2)
+
+    # --- sync save throughput
+    t0 = time.perf_counter()
+    path = mgr.save(0, model=net, optimizer=opt)
+    sync_s = time.perf_counter() - t0
+    nbytes = _dir_bytes(path)
+
+    # --- async snapshot stall: the time save() holds the "train loop"
+    def train_step():
+        loss = net(x).mean()
+        loss.backward()
+        opt.step()
+
+    stalls, step_s = [], []
+    for s in range(1, steps + 1):
+        t0 = time.perf_counter()
+        mgr.save(s, model=net, optimizer=opt, async_save=True)
+        stalls.append(time.perf_counter() - t0)
+        t0 = time.perf_counter()
+        train_step()
+        step_s.append(time.perf_counter() - t0)
+    mgr.wait()
+    # first stall has no writer in flight (pure snapshot); later ones carry
+    # writer backpressure when the step is faster than the disk
+    snapshot_stall_s = stalls[0]
+    max_stall_s = max(stalls)
+
+    # --- resume latency into a fresh model/opt
+    net2, opt2, _ = _build_state(total_mb, n_tensors)
+    t0 = time.perf_counter()
+    res = mgr.restore(model=net2, optimizer=opt2)
+    resume_s = time.perf_counter() - t0
+
+    reg = __import__("paddle_tpu.observability",
+                     fromlist=["get_registry"]).get_registry()
+    return {
+        "workload": {"state_mb": round(nbytes / (1 << 20), 3),
+                     "n_tensors": n_tensors, "async_steps": steps},
+        "save_throughput_mb_s": round(nbytes / (1 << 20) / sync_s, 3),
+        "sync_save_s": round(sync_s, 4),
+        "snapshot_stall_s": round(snapshot_stall_s, 4),
+        "max_stall_s": round(max_stall_s, 4),
+        "mean_train_step_s": round(sum(step_s) / len(step_s), 4),
+        "resume_latency_s": round(resume_s, 4),
+        "resumed_step": res.step,
+        "metrics": {k: v for k, v in reg.snapshot().items()
+                    if k.startswith("checkpoint_")},
+    }
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--smoke", action="store_true",
+                    help="tiny fast run writing BENCH_ckpt_smoke.json")
+    ap.add_argument("--mb", type=float, default=32.0,
+                    help="approximate full-state size in MB")
+    ap.add_argument("--tensors", type=int, default=8)
+    ap.add_argument("--steps", type=int, default=4)
+    ap.add_argument("--out", default=None)
+    args = ap.parse_args()
+    if args.smoke:
+        art = run_bench(total_mb=2.0, n_tensors=4, steps=2)
+        out = args.out or os.path.join(REPO_ROOT, "BENCH_ckpt_smoke.json")
+    else:
+        art = run_bench(total_mb=args.mb, n_tensors=args.tensors,
+                        steps=args.steps)
+        out = args.out or os.path.join(
+            REPO_ROOT, f"BENCH_ckpt_{int(args.mb)}mb.json")
+    with open(out, "w") as f:
+        json.dump(art, f, indent=1)
+    print(json.dumps(art, indent=1))
+    print(f"wrote {out}")
+
+
+if __name__ == "__main__":
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    main()
