@@ -673,6 +673,40 @@ def bench_ckpt(on_tpu):
     }))
 
 
+def bench_train(on_tpu):
+    """Zero-stall training hot path: double-buffered device prefetch +
+    donated input buffers + dispatch-ahead (nonblocking) loss reads vs the
+    fully synchronous single-buffered loop, on the GPT fixture
+    (tools/train_bench.run_bench). CPU runs the deterministic smoke mode,
+    which also ASSERTS the hot path is not slower and that prefetch
+    collapsed the input stall; the artifact (BENCH_train_*.json) carries
+    the full stall breakdown + donation evidence."""
+    import sys
+
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from tools.train_bench import run_bench
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    if on_tpu:
+        art = run_bench(on_tpu=True, steps=30, smoke=False,
+                        out_path=os.path.join(here, "BENCH_train_tpu.json"))
+    else:
+        art = run_bench(on_tpu=False, steps=20, smoke=True,
+                        out_path=os.path.join(here, "BENCH_train_smoke.json"))
+    print(json.dumps({
+        "metric": "train_hotpath_speedup",
+        "value": art["speedup_ratio"],
+        "unit": "x vs single-buffered ({} -> {} steps/s)".format(
+            art["baseline"]["steps_per_s"], art["hot"]["steps_per_s"]),
+        "vs_baseline": art["speedup_ratio"],
+        "train_input_stall_seconds": art["train_input_stall_seconds"],
+        "train_sync_stall_seconds": art["train_sync_stall_seconds"],
+        "losses_bit_identical": art["losses_bit_identical"],
+        "donated_inputs_deleted_frac":
+            art["hot"]["donation"].get("input_buffers_deleted_frac"),
+    }))
+
+
 def bench_chip_ceilings(on_tpu):
     """Measured MFU denominators (VERDICT r3 weak #1): what this chip/XLA
     build actually sustains on big matmuls and convs — tools/chip_ceiling.py
@@ -723,18 +757,27 @@ def _probe_once(timeout_s):
         return None
 
 
-_PROBE_ATTEMPTS = 5
+def _probe_attempts() -> int:
+    """FLAGS_bench_probe_attempts: how many spaced probe attempts before
+    giving up on the device backend. Default 1 — BENCH_r05 burned 780 s of
+    retries against a dead tunnel before erroring; a failed probe now falls
+    back to CPU immediately (with a note in the JSON stream) and the env
+    var restores the old patient behavior when flaps are expected."""
+    try:
+        return max(1, int(os.environ.get("FLAGS_bench_probe_attempts", "1")))
+    except ValueError:
+        return 1
 
 
-def _probe_backend(attempts=_PROBE_ATTEMPTS, timeout_s=120, backoff_s=45):
-    """Probe with retries + backoff (worst case ~13 min: 5 x 120 s hung
-    probes + 4 x 45 s sleeps; a LIVE backend answers the first probe in
-    seconds).
+_PROBE_ATTEMPTS = _probe_attempts()
 
-    r4's single 180 s probe met one tunnel flap and the WHOLE round's bench
-    record became `bench_error` (VERDICT r4 weak #2). Liveness flaps on a
-    scale of minutes, so several spaced attempts recover most outages.
-    """
+
+def _probe_backend(attempts=None, timeout_s=120, backoff_s=45):
+    """Probe with FLAGS_bench_probe_attempts retries (a LIVE backend answers
+    the first probe in seconds; retries only matter across tunnel flaps,
+    which recover on a scale of minutes)."""
+    if attempts is None:
+        attempts = _probe_attempts()
     for i in range(attempts):
         plat = _probe_once(timeout_s)
         if plat is not None:
@@ -763,6 +806,7 @@ for _f in (bench_chip_ceilings, bench_resnet50, bench_bert, bench_ernie,
            bench_serving,
            bench_observability,
            bench_ckpt,
+           bench_train,
            bench_gpt):  # headline LAST (tail-parsed by the driver)
     _register(_f)
 
@@ -790,24 +834,41 @@ def main():
     t_probe = time.time()
     plat = _probe_backend()
     if plat is None:
+        # fast-fail CPU fallback: the round still produces a full artifact
+        # (cpu-named metrics) instead of 780 s of dead-tunnel retries and
+        # one bench_error line (BENCH_r05)
         print(json.dumps({
-            "metric": "bench_error", "value": 0, "unit": "none",
+            "metric": "bench_probe_fallback", "value": 0, "unit": "none",
             "vs_baseline": None,
-            "error": "device backend unreachable (dead tunnel?) - "
-                     "probe retries exhausted",
-            "probe_attempts": _PROBE_ATTEMPTS,
+            "fallback": "cpu",
+            "note": "device backend unreachable (dead tunnel?) - "
+                    "continuing on CPU; raise FLAGS_bench_probe_attempts "
+                    "to wait out flaps",
+            "probe_attempts": _probe_attempts(),
             "probe_wall_s": round(time.time() - t_probe, 1),
-        }))
-        return
+        }), flush=True)
+        plat = "cpu"
 
     # Each bench runs in its OWN subprocess with a timeout: a tunnel flap
     # mid-bench kills only that bench, and every completed bench's JSON is
     # already on our stdout — partial results always land (VERDICT r4 #1b).
     per_bench_timeout = float(os.environ.get("BENCH_TIMEOUT", "900"))
     env = dict(os.environ)
-    env.setdefault("JAX_COMPILATION_CACHE_DIR",
-                   os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                                "build", "jax_cache"))
+    if "JAX_COMPILATION_CACHE_DIR" not in env:
+        # version-stamped cache dir (auto-wiped on framework/jax mismatch —
+        # the NOTES-r7 stale-AOT guard); loaded by file path because this
+        # parent process must stay jax/paddle_tpu-import-free
+        import importlib.util as _ilu
+
+        _spec = _ilu.spec_from_file_location(
+            "_pt_compile_cache",
+            os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         "paddle_tpu", "utils", "compile_cache.py"))
+        _cc = _ilu.module_from_spec(_spec)
+        _spec.loader.exec_module(_cc)
+        env["JAX_COMPILATION_CACHE_DIR"] = _cc.ensure_compile_cache_dir(
+            os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         "build", "jax_cache"))
     if plat == "cpu":
         env.pop("PALLAS_AXON_POOL_IPS", None)
 
@@ -845,13 +906,16 @@ def main():
                 # bench against a dead backend
                 plat2 = _probe_backend()
                 if plat2 is None:
-                    for rest in names[i + 1:]:
-                        print(json.dumps({
-                            "metric": rest,
-                            "error": "skipped: backend unreachable after "
-                                     "mid-run flap",
-                        }), flush=True)
-                    return
+                    # same fast-fail contract as startup: finish the round
+                    # on CPU rather than dropping the remaining benches
+                    print(json.dumps({
+                        "metric": "bench_probe_fallback", "value": 0,
+                        "unit": "none", "vs_baseline": None,
+                        "fallback": "cpu",
+                        "note": "backend unreachable after mid-run flap; "
+                                "remaining benches run on CPU",
+                    }), flush=True)
+                    plat2 = "cpu"
                 plat = plat2
                 if plat == "cpu":
                     # the axon sitecustomize re-dials the (dead) tunnel in

@@ -366,19 +366,29 @@ class DistModel:
     def dist_main_program(self, mode=None):  # introspection parity
         return self._step
 
-    def _shard_batch(self, t: Tensor) -> Tensor:
+    def input_sharding(self, value):
+        """The NamedSharding a batch leaf of this shape gets (batch rows
+        over the data axis), or None when it stays replicated. This is the
+        per-leaf callable a ``DevicePrefetcher`` wants: the background
+        stage then lands batches already in the step's input layout and
+        ``_shard_batch``'s device_put degenerates to a no-op."""
         if self._mesh is None or self._batch_axis is None:
-            return t
+            return None
+        if value.ndim == 0 or value.shape[0] % self._mesh.get_dim_size(
+                self._batch_axis) != 0:
+            return None
+        jm = self._mesh.jax_mesh()
+        spec = P(self._batch_axis, *([None] * (value.ndim - 1)))
+        return NamedSharding(jm, spec)
+
+    def _shard_batch(self, t: Tensor) -> Tensor:
         v = t._value
         # only shard elements whose leading dim actually divides over the
         # batch axis (scalars / broadcast masks stay replicated)
-        if v.ndim == 0 or v.shape[0] % self._mesh.get_dim_size(
-                self._batch_axis) != 0:
+        sh = self.input_sharding(v)
+        if sh is None:
             return t
-        jm = self._mesh.jax_mesh()
-        spec = P(self._batch_axis, *([None] * (v.ndim - 1)))
-        return Tensor._from_value(
-            jax.device_put(v, NamedSharding(jm, spec)))
+        return Tensor._from_value(jax.device_put(v, sh))
 
     def __call__(self, *batch):
         batch = [b if isinstance(b, Tensor) else Tensor(b) for b in batch]
@@ -485,16 +495,37 @@ class Engine:
         return self._dist_model
 
     def fit(self, train_data, epochs=1, steps_per_epoch=None, verbose=0,
-            log_freq=10):
+            log_freq=10, device_prefetch=0):
+        """Dispatch-ahead fit: per-step losses stay ON DEVICE during the
+        epoch (jax dispatch is async, so the loop never blocks on step N to
+        enqueue step N+1) and are pulled to host once per epoch — the sync
+        wall lands in ``train_sync_stall_seconds`` once instead of every
+        step. ``device_prefetch`` > 0 additionally stages batches onto the
+        mesh (with the step's input sharding) from a background thread."""
+        import time as _time
+
+        from paddle_tpu.observability.train_stall import record_sync_stall
+
         dm = self.prepare(train_data, "train")
         dm.train()
+        data = train_data
+        if device_prefetch:
+            from paddle_tpu.io.dataloader import DevicePrefetcher
+
+            if not isinstance(data, DevicePrefetcher):
+                data = DevicePrefetcher(data, depth=device_prefetch,
+                                        sharding=dm.input_sharding)
         for _ in range(epochs):
-            for step, batch in enumerate(train_data):
+            device_losses = []
+            for step, batch in enumerate(data):
                 if steps_per_epoch is not None and step >= steps_per_epoch:
                     break
                 batch = batch if isinstance(batch, (list, tuple)) else [batch]
-                loss = dm(*batch)
-                self.history.append(float(np.asarray(loss.numpy())))
+                device_losses.append(dm(*batch))
+            t0 = _time.perf_counter()
+            self.history.extend(
+                float(np.asarray(loss.numpy())) for loss in device_losses)
+            record_sync_stall(_time.perf_counter() - t0)
         return self.history
 
     def evaluate(self, eval_data, steps=None):

@@ -98,28 +98,128 @@ def _offload_state(optimizer):
         optimizer._master_weights[key] = park(mv)
 
 
-def _wrap_forward_param_fetch(model):
-    """Stage-3 offload eager path: stream host-resident params to device at
-    forward entry (the on-demand gather). Inside a jit trace the values are
-    tracers, not host arrays, so the fetch is a no-op there."""
+def _parked(p):
+    v = p._value
+    return (hasattr(v, "sharding")
+            and getattr(v.sharding, "memory_kind", None) == "pinned_host")
+
+
+def _fetch_group(params):
+    """Dispatch ONE batched host->device transfer for a param group.
+    ``jax.device_put`` returns immediately (async copy via the memories
+    API); compute that consumes a param blocks only on ITS buffer, so a
+    group dispatched early streams over PCIe while earlier layers run."""
+    parked = [p for p in params if _parked(p)]
+    if not parked:
+        return
+    fetched = jax.device_put(
+        [p._value for p in parked],
+        [p._value.sharding.with_memory_kind("device") for p in parked])
+    for p, v in zip(parked, fetched):
+        p._replace_value(v)
+
+
+def _wrap_forward_param_fetch(model, lookahead: int = 1):
+    """Stage-3 offload eager path: stream host-resident params to device
+    with OVERLAPPED per-layer prefetch. Execution-ordered param groups (one
+    per param-owning sublayer) get forward pre-hooks; when layer *k* is
+    about to run, the fetch frontier is advanced to *k + lookahead* — so
+    layer *k+1*'s PCIe copy is dispatched before layer *k*'s compute and
+    its latency hides behind it (the reference's segment-aware prefetch,
+    group_sharded_stage3.py). Inside a jit trace the values are tracers,
+    not pinned-host arrays, so every fetch is a no-op there.
+
+    ``PADDLE_TPU_OFFLOAD_OVERLAP=0`` falls back to the old fetch-everything
+    -at-entry behavior (also used when the model exposes no param-owning
+    sublayers). ``offload_fetch_overlap_ratio`` records the share of groups
+    whose dispatch preceded their own layer's pre-hook."""
+    import os
+
     orig_forward = model.forward
     params = list(model.parameters())  # collected once at wrap time
 
+    groups = []  # (layer, [params]) in registration == execution order
+    grouped_ids = set()
+    for layer in model.sublayers(include_self=True):
+        own = [p for p in layer._parameters.values()
+               if p is not None and id(p) not in grouped_ids]
+        if own:
+            grouped_ids.update(id(p) for p in own)
+            groups.append((layer, own))
+
+    overlap_on = (os.environ.get("PADDLE_TPU_OFFLOAD_OVERLAP", "1") != "0"
+                  and len(groups) > 1)
+    if not overlap_on:
+        def forward(*args, **kwargs):
+            _fetch_group(params)
+            return orig_forward(*args, **kwargs)
+
+        model.forward = forward
+        return
+
+    # per-forward frontier state (reset at each top-level entry); "armed"
+    # only on the eager parked path, so trace-time hook firings (where
+    # params are tracers) never move the frontier or skew the ratio
+    state = {"frontier": 0, "overlapped": 0, "total": 0, "armed": False}
+    index_of = {id(layer): i for i, (layer, _) in enumerate(groups)}
+
+    def advance(upto):
+        while state["frontier"] <= min(upto, len(groups) - 1):
+            _, group = groups[state["frontier"]]
+            if any(_parked(p) for p in group):
+                from paddle_tpu.profiler import RecordEvent, TracerEventType
+
+                with RecordEvent("offload.prefetch",
+                                 TracerEventType.UserDefined):
+                    _fetch_group(group)
+            state["frontier"] += 1
+
+    def pre_hook(layer, inputs):
+        if not state["armed"]:
+            return None
+        i = index_of.get(id(layer))
+        if i is None:
+            return None
+        # a group whose fetch was dispatched BEFORE its own hook fired was
+        # hidden behind earlier compute — the overlap the metric proves
+        # (group 0 never counts: nothing computes ahead of it)
+        state["total"] += 1
+        if 0 < i < state["frontier"]:
+            state["overlapped"] += 1
+        advance(i + lookahead)
+        return None
+
+    for layer, _ in groups:
+        layer.register_forward_pre_hook(pre_hook)
+
     def forward(*args, **kwargs):
-        parked = [p for p in params
-                  if hasattr(p._value, "sharding") and getattr(
-                      p._value.sharding, "memory_kind", None)
-                  == "pinned_host"]
-        if parked:
-            # ONE batched transfer (not N blocking copies): jax overlaps
-            # the per-array host->device streams inside a single call
-            fetched = jax.device_put(
-                [p._value for p in parked],
-                [p._value.sharding.with_memory_kind("device")
-                 for p in parked])
-            for p, v in zip(parked, fetched):
-                p._replace_value(v)
-        return orig_forward(*args, **kwargs)
+        armed = any(_parked(p) for p in params)
+        if armed:
+            state["frontier"] = 0
+            state["overlapped"] = 0
+            state["total"] = 0
+            state["armed"] = True
+            # dispatch the first window now: group 0 is needed immediately,
+            # groups 1..lookahead stream behind group 0's compute
+            advance(lookahead)
+        try:
+            out = orig_forward(*args, **kwargs)
+        finally:
+            if armed:
+                state["armed"] = False
+                if state["total"]:
+                    from paddle_tpu.observability.train_stall import (
+                        set_offload_overlap_ratio,
+                    )
+
+                    set_offload_overlap_ratio(
+                        state["overlapped"] / state["total"])
+                # safety net: a sublayer invoked functionally (bypassing
+                # __call__) never fires its hook — fetch any park-resident
+                # leftovers so the step's backward/update sees them on
+                # device like the pre-overlap entry fetch did
+                _fetch_group(params)
+        return out
 
     model.forward = forward
 

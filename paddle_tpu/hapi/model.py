@@ -88,7 +88,18 @@ class Model:
                 else:
                     # (TrainStep.__call__ already ran any _post_step_hook)
                     metrics = self._update_metrics(outputs, labels)
-                    return [float(np.asarray(loss.numpy()))], metrics
+                    # the loss read is the loop's one device sync — meter
+                    # it so export_report shows the sync-bound share
+                    import time as _time
+
+                    from paddle_tpu.observability.train_stall import (
+                        record_sync_stall,
+                    )
+
+                    t0 = _time.perf_counter()
+                    val = float(np.asarray(loss.numpy()))
+                    record_sync_stall(_time.perf_counter() - t0)
+                    return [val], metrics
         outputs = self.network(*inputs)
         loss = self._compute_loss(outputs, labels)
         loss.backward()
@@ -178,15 +189,29 @@ class Model:
     def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
             eval_freq=1, log_freq=10, save_dir=None, save_freq=1, verbose=2,
             drop_last=False, shuffle=True, num_workers=0, callbacks=None,
-            checkpoint_dir=None, checkpoint_freq=1):
+            checkpoint_dir=None, checkpoint_freq=1, device_prefetch=0):
         """``checkpoint_dir`` turns on crash-safe auto-resume: full train
         state (params + optimizer + RNG + epoch) commits atomically every
         ``checkpoint_freq`` epochs, and a later ``fit`` against the same dir
-        restores the last commit and continues from the next epoch."""
+        restores the last commit and continues from the next epoch.
+
+        ``device_prefetch`` > 0 wraps the train loader in a
+        :class:`paddle_tpu.io.DevicePrefetcher` of that depth: a background
+        stage moves the NEXT batch to device while the current step runs,
+        so the per-step input wait collapses to a queue pop (metered as
+        ``train_input_stall_seconds``)."""
         loader = self._as_loader(train_data, batch_size, shuffle, num_workers,
                                  drop_last)
+        if device_prefetch and loader is not None:
+            from paddle_tpu.io.dataloader import DevicePrefetcher
+
+            if not isinstance(loader, DevicePrefetcher):
+                loader = DevicePrefetcher(loader, depth=device_prefetch)
         eval_loader = self._as_loader(eval_data, batch_size, False, num_workers)
-        steps = len(loader) if hasattr(loader, "__len__") else None
+        try:
+            steps = len(loader)
+        except TypeError:  # length-less iterable (possibly prefetch-wrapped)
+            steps = None
         cbks = config_callbacks(
             callbacks, model=self, epochs=epochs, steps=steps,
             log_freq=log_freq, verbose=verbose, save_freq=save_freq,

@@ -27,7 +27,11 @@ from paddle_tpu.io.sampler import (  # noqa: F401
     SubsetRandomSampler,
     WeightedRandomSampler,
 )
-from paddle_tpu.io.dataloader import DataLoader, default_collate_fn  # noqa: F401
+from paddle_tpu.io.dataloader import (  # noqa: F401
+    DataLoader,
+    DevicePrefetcher,
+    default_collate_fn,
+)
 
 
 class WorkerInfo:

@@ -6,6 +6,11 @@ loading slow. On TPU the device transfer is the cost; numpy collation releases
 the GIL, so worker *threads* + a bounded prefetch queue give the same overlap
 without IPC. The optional C++ packing core (paddle_tpu/lib/libpt_dataloader)
 accelerates batch assembly for large samples.
+
+``DevicePrefetcher`` is the last pipeline stage: it overlaps the
+host->device transfer itself with the training step (the workers above only
+overlap host-side fetch/collate), so a zero-stall loop reads device-resident
+batches off a queue.
 """
 
 from __future__ import annotations
@@ -50,6 +55,153 @@ class _SentinelType:
 
 
 _END = _SentinelType()
+
+
+class _PrefetchError:
+    def __init__(self, exc: BaseException):
+        self.exc = exc
+
+
+class DevicePrefetcher:
+    """Double-buffered device prefetch stage over any batch iterable.
+
+    A background thread pulls batches from ``loader`` and dispatches their
+    host->device transfer (``jax.device_put`` with the step's input
+    ``sharding``) while the current step computes, keeping up to ``depth``
+    device-resident batches queued. The consumer's ``next()`` becomes a
+    queue pop, and the wait it does pay is recorded as
+    ``train_input_stall_seconds`` — the input-bound share of the loop.
+
+    ``depth=0`` is the single-buffered reference path: no thread, the
+    fetch+transfer runs inline on the consumer (and is charged to the same
+    stall metric), which is exactly what ``tools/train_bench.py`` measures
+    the overlap win against.
+
+    ``sharding`` is ``None`` (commit to the default device), a
+    ``jax.sharding.Sharding`` applied to every array leaf, or a callable
+    ``leaf_value -> sharding-or-None`` (per-leaf placement, e.g. batch-axis
+    sharding only for leaves whose leading dim divides).
+
+    Checkpointing: ``state_dict()`` counts batches the CONSUMER took, not
+    batches pulled into the buffer, so a mid-epoch save/resume replays the
+    identical sequence with no off-by-``depth`` skip. Single consumer per
+    prefetcher.
+    """
+
+    def __init__(self, loader, depth: int = 2, sharding=None):
+        self.loader = loader
+        self.depth = max(int(depth), 0)
+        self.sharding = sharding
+        inner_state = getattr(loader, "state_dict", None)
+        st = inner_state() if callable(inner_state) else {}
+        self._epoch = int(st.get("epoch", 0))
+        self._consumed = int(st.get("offset", 0))
+        # wrapping an already-resumed loader keeps its mid-epoch cursor
+        self._resumed = self._consumed > 0
+
+    # ------------------------------------------------------- checkpointing
+    def state_dict(self):
+        return {"epoch": int(self._epoch), "offset": int(self._consumed)}
+
+    def set_state_dict(self, state):
+        self._epoch = int(state.get("epoch", 0))
+        self._consumed = int(state.get("offset", 0))
+        self._resumed = True
+        inner = getattr(self.loader, "set_state_dict", None)
+        if callable(inner):
+            inner(state)
+
+    def __len__(self):
+        return len(self.loader)
+
+    # ------------------------------------------------------------ transfer
+    def _to_device(self, batch):
+        import jax
+
+        def put(v):
+            val = v._value if isinstance(v, Tensor) else v
+            if not hasattr(val, "shape"):
+                return v
+            sh = self.sharding(val) if callable(self.sharding) \
+                else self.sharding
+            out = jax.device_put(val, sh) if sh is not None \
+                else jax.device_put(val)
+            return Tensor._from_value(out) if isinstance(v, Tensor) \
+                else out
+        return jax.tree_util.tree_map(
+            put, batch, is_leaf=lambda x: isinstance(x, Tensor))
+
+    # ---------------------------------------------------------------- iter
+    def __iter__(self):
+        from paddle_tpu.observability.train_stall import (
+            prefetched_batches_counter,
+            record_input_stall,
+        )
+        from paddle_tpu.profiler import RecordEvent, TracerEventType
+
+        if self._resumed:
+            self._resumed = False  # a resume keeps its mid-epoch cursor
+        else:
+            self._consumed = 0  # fresh epoch (mirrors DataLoader.__iter__)
+        if self.depth == 0:
+            # inline single-buffered path: transfer on the consumer, fully
+            # exposed — the stall metric shows what prefetch removes
+            for batch in self.loader:
+                t0 = time.perf_counter()
+                out = self._to_device(batch)
+                record_input_stall(time.perf_counter() - t0)
+                self._consumed += 1
+                yield out
+            self._epoch += 1
+            self._consumed = 0
+            return
+
+        q: "queue.Queue" = queue.Queue(maxsize=self.depth)
+        stop = threading.Event()
+
+        def producer():
+            try:
+                for batch in self.loader:
+                    with RecordEvent("train.prefetch",
+                                     TracerEventType.Dataloader):
+                        out = self._to_device(batch)
+                    prefetched_batches_counter().inc()
+                    while not stop.is_set():
+                        try:
+                            q.put(out, timeout=0.2)
+                            break
+                        except queue.Full:
+                            continue
+                    if stop.is_set():
+                        return
+                q.put(_END)
+            except BaseException as e:  # surface in the consumer
+                q.put(_PrefetchError(e))
+
+        t = threading.Thread(target=producer, daemon=True,
+                             name="DevicePrefetcher")
+        t.start()
+        try:
+            while True:
+                t0 = time.perf_counter()
+                item = q.get()
+                record_input_stall(time.perf_counter() - t0)
+                if item is _END:
+                    self._epoch += 1
+                    self._consumed = 0
+                    return
+                if isinstance(item, _PrefetchError):
+                    raise item.exc
+                self._consumed += 1
+                yield item
+        finally:
+            stop.set()
+            # unblock a producer stuck on a full queue
+            try:
+                while True:
+                    q.get_nowait()
+            except queue.Empty:
+                pass
 
 
 class DataLoader:

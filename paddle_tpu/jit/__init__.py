@@ -1,6 +1,12 @@
 """paddle_tpu.jit (parity: python/paddle/jit)."""
 
-from paddle_tpu.jit.api import StaticFunction, TrainStep, not_to_static, to_static  # noqa: F401
+from paddle_tpu.jit.api import (  # noqa: F401
+    NonBlockingStepResult,
+    StaticFunction,
+    TrainStep,
+    not_to_static,
+    to_static,
+)
 from paddle_tpu.jit.serialization import load, save  # noqa: F401
 from paddle_tpu.jit import sot  # noqa: F401
 from paddle_tpu.jit.sot import symbolic_translate  # noqa: F401

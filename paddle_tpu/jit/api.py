@@ -297,6 +297,74 @@ def not_to_static(fn):
     return fn
 
 
+class _DonatedValue:
+    """Payload installed into a batch Tensor after its buffer was donated
+    into a compiled TrainStep: ANY further use raises. This makes the
+    donate_inputs contract enforced rather than advisory — on backends
+    where XLA aliases the buffer jax already marks it deleted, but where
+    the donation is unusable (no same-shaped output; CPU) the array would
+    silently stay readable and a caller could come to depend on it."""
+
+    __slots__ = ()
+
+    def __getattr__(self, name):
+        raise RuntimeError(
+            "this Tensor's buffer was donated to a compiled TrainStep "
+            "(donate_inputs=True) and must not be reused; copy the batch "
+            "before the step if you need it afterwards")
+
+
+class NonBlockingStepResult:
+    """A TrainStep's outputs left ON DEVICE: jax dispatch is asynchronous,
+    so holding this object costs nothing — the loop can dispatch the next
+    step immediately. Reading the loss as a host number is the only sync,
+    and the wall it blocks is metered as ``train_sync_stall_seconds`` (a
+    dispatch-ahead loop pays it once per log window, not once per step)."""
+
+    __slots__ = ("_loss_val", "_aux_vals", "_has_aux")
+
+    def __init__(self, loss_val, aux_vals=None, has_aux=False):
+        self._loss_val = loss_val
+        self._aux_vals = aux_vals
+        self._has_aux = has_aux
+
+    @property
+    def loss(self) -> "Tensor":
+        """The device-resident loss (no host sync)."""
+        return Tensor._from_value(self._loss_val)
+
+    @property
+    def aux(self):
+        """The device-resident aux pytree (no host sync); None w/o has_aux."""
+        return tree_wrap(self._aux_vals) if self._has_aux else None
+
+    def loss_value(self) -> float:
+        """Host float of the loss — blocks until the step (and everything
+        dispatched before it) completes; the wait is metered."""
+        import numpy as _np
+
+        from paddle_tpu.observability.train_stall import record_sync_stall
+
+        t0 = time.perf_counter()
+        v = float(_np.asarray(self._loss_val))
+        record_sync_stall(time.perf_counter() - t0)
+        return v
+
+    def __float__(self):
+        return self.loss_value()
+
+    def block(self):
+        """Wait for the step to retire without pulling values to host."""
+        import jax as _jax
+
+        from paddle_tpu.observability.train_stall import record_sync_stall
+
+        t0 = time.perf_counter()
+        _jax.block_until_ready(self._loss_val)
+        record_sync_stall(time.perf_counter() - t0)
+        return self
+
+
 class TrainStep:
     """One fully-jitted training step: forward + backward + optimizer update.
 
@@ -310,10 +378,25 @@ class TrainStep:
     """
 
     def __init__(self, model: Layer, loss_fn: Callable, optimizer,
-                 donate: bool = True, scaler=None, has_aux: bool = False):
+                 donate: bool = True, scaler=None, has_aux: bool = False,
+                 donate_inputs: bool = False, nonblocking: bool = False):
         """``has_aux``: loss_fn returns (loss, aux) — aux (any Tensor pytree,
         e.g. model outputs for metrics) is threaded out of the compiled step
-        and returned alongside the loss."""
+        and returned alongside the loss.
+
+        ``donate``: donate the param/optimizer/master/scaler state buffers
+        into the compiled step so the update happens in place — without it a
+        step holds state twice (old + new) at its peak.
+
+        ``donate_inputs``: ALSO donate the batch buffers. Only for callers
+        that feed each step a fresh batch and never touch it again (a
+        ``DevicePrefetcher`` loop); the caller's batch Tensors are dead
+        after the call — re-reading one raises jax's deleted-array error.
+        An alias-safety audit copies any batch leaf that would donate the
+        same buffer twice (``step(x, x)``) or that aliases donated state.
+
+        ``nonblocking``: return a :class:`NonBlockingStepResult` instead of
+        a loss Tensor, keeping the loop fully dispatch-ahead."""
         self._model = model
         self._loss_fn = loss_fn
         self._opt = optimizer
@@ -377,7 +460,19 @@ class TrainStep:
             from paddle_tpu.distributed.sharding import _offload_state
 
             _offload_state(optimizer)
-        self._donate_argnums = (0, 1, 2) if donate else ()
+        # donation layout over _step's positional args:
+        #   0 param_vals, 1 opt_states, 2 master_vals, 3 buffer_vals,
+        #   4 batch_vals, 5 lr, 6 key, 7 scale
+        # state donation covers 0/1/2 (+7, the in-graph scaler counters:
+        # a fresh tuple is returned every step so the old one has no
+        # reader); buffers (3) stay undonated — they are re-read by the
+        # eager model between steps (eval/forward outside the jit).
+        self._donate_inputs = bool(donate_inputs)
+        self._nonblocking = bool(nonblocking)
+        self._donate_argnums = (0, 1, 2, 7) if donate else ()
+        if donate and donate_inputs:
+            self._donate_argnums += (4,)
+        self._last_donated = None  # shells of last call's donated buffers
         self._jitted = None  # built at first call (out_shardings need state)
         self._tracker_name = next_tracked_name(
             f"TrainStep[{type(model).__name__}]")
@@ -663,6 +758,65 @@ class TrainStep:
             n += _jit_cache_size(j)
         return n
 
+    # ------------------------------------------------------- donation audit
+    def _audit_donated_inputs(self, batch_vals, param_vals, opt_states,
+                              master_vals, scale):
+        """Alias-safety audit for ``donate_inputs``: a donated pytree must
+        not contain the same buffer twice (XLA rejects double donation at
+        execute time), and a batch leaf must not alias a donated state
+        buffer. Offending leaves are defensively copied (metered)."""
+        seen = set()
+        for v in param_vals:
+            seen.add(id(v))
+        for tree in (opt_states, master_vals, scale):
+            for v in jax.tree_util.tree_leaves(tree):
+                seen.add(id(v))
+        copies = 0
+
+        def guard(v):
+            nonlocal copies
+            if not isinstance(v, jax.Array):
+                return v
+            if id(v) in seen:
+                copies += 1
+                return jnp.copy(v)
+            seen.add(id(v))
+            return v
+
+        out = jax.tree_util.tree_map(guard, batch_vals)
+        if copies:
+            from paddle_tpu.observability.train_stall import (
+                donation_copy_counter,
+            )
+
+            donation_copy_counter().inc(copies)
+        return out
+
+    def donation_report(self) -> dict:
+        """Cache-probe evidence that donation actually engaged: after a
+        donated call the input buffers are deleted (jax marks them dead
+        whether or not the backend aliased them — the caller-visible
+        contract is identical). Fractions are over the LAST call."""
+
+        def frac_deleted(vals):
+            leaves = [v for v in jax.tree_util.tree_leaves(vals)
+                      if hasattr(v, "is_deleted")]
+            if not leaves:
+                return None
+            return sum(1 for v in leaves if v.is_deleted()) / len(leaves)
+
+        rep = {"donate_argnums": tuple(self._donate_argnums),
+               "donate_inputs": self._donate_inputs,
+               # the caller-side guard always engages with donate_inputs,
+               # even where XLA found the donation unusable (frac 0.0)
+               "inputs_guarded": self._donate_inputs}
+        if self._last_donated is not None:
+            rep["state_buffers_deleted_frac"] = frac_deleted(
+                self._last_donated.get("params"))
+            rep["input_buffers_deleted_frac"] = frac_deleted(
+                self._last_donated.get("batch"))
+        return rep
+
     def __call__(self, *batch):
         from paddle_tpu.profiler import RecordEvent, TracerEventType
 
@@ -692,6 +846,9 @@ class TrainStep:
         lr = jnp.asarray(self._opt.get_lr(), jnp.float32)
         key = rng.next_key()
         scale = self._scaler_state if self._scaler is not None else None
+        if self._donate_inputs and 4 in self._donate_argnums:
+            batch_vals = self._audit_donated_inputs(
+                batch_vals, param_vals, opt_states, master_vals, scale)
         if self._jitted is None:
             self._build_jit(opt_states, master_vals, len(buffer_vals),
                             scale is not None)
@@ -730,11 +887,33 @@ class TrainStep:
                     batch_vals, lr, key, scale)
             err.throw()
         else:
-            (loss_val, new_params, new_states, new_masters, new_buffer_vals,
-             new_scaler_state, aux_vals) = self._jitted(
-                param_vals, opt_states, master_vals, buffer_vals, batch_vals,
-                lr, key, scale
-            )
+            # train.dispatch: HOST time to enqueue the compiled step — in a
+            # dispatch-ahead loop this (plus the input pop) is the whole
+            # per-step host cost; device completion is read later
+            from paddle_tpu.profiler import RecordEvent as _RE
+            from paddle_tpu.profiler import TracerEventType as _TET
+
+            with _RE("train.dispatch", _TET.Operator):
+                (loss_val, new_params, new_states, new_masters,
+                 new_buffer_vals, new_scaler_state, aux_vals) = self._jitted(
+                    param_vals, opt_states, master_vals, buffer_vals,
+                    batch_vals, lr, key, scale
+                )
+            if self._donate_argnums:
+                # deleted-buffer shells: donation_report()'s evidence
+                self._last_donated = {
+                    "params": list(param_vals),
+                    "batch": (batch_vals if self._donate_inputs else None),
+                }
+            if self._donate_inputs and 4 in self._donate_argnums:
+                # enforce the contract on the caller's handles: donated
+                # batch Tensors are dead, and a re-read must RAISE even on
+                # backends where the donation was unusable and jax left
+                # the buffer alive (dropping the ref frees it either way)
+                for leaf in jax.tree_util.tree_leaves(
+                        batch, is_leaf=lambda x: isinstance(x, Tensor)):
+                    if isinstance(leaf, Tensor):
+                        leaf._replace_value(_DonatedValue())
         offload_params = getattr(self._opt, "_offload_params", False)
         for p, v in zip(params, new_params):
             p._replace_value(v)
@@ -765,6 +944,8 @@ class TrainStep:
         hook = getattr(self._opt, "_post_step_hook", None)
         if hook is not None:
             hook()  # e.g. ASP re-masking (the wrapper's step() is bypassed)
+        if self._nonblocking:
+            return NonBlockingStepResult(loss_val, aux_vals, self._has_aux)
         loss_t = Tensor._from_value(loss_val)
         if self._has_aux:
             return loss_t, tree_wrap(aux_vals)

@@ -46,6 +46,12 @@ from paddle_tpu.observability.metrics import (  # noqa: F401
     get_registry,
     parse_prometheus_text,
 )
+from paddle_tpu.observability.train_stall import (  # noqa: F401
+    record_input_stall,
+    record_sync_stall,
+    set_offload_overlap_ratio,
+    stall_snapshot,
+)
 
 __all__ = [
     "CompileEvent",
@@ -59,4 +65,8 @@ __all__ = [
     "get_compile_tracker",
     "get_registry",
     "parse_prometheus_text",
+    "record_input_stall",
+    "record_sync_stall",
+    "set_offload_overlap_ratio",
+    "stall_snapshot",
 ]

@@ -68,8 +68,17 @@ def abstract_signature(*trees, limit: int = 32) -> Tuple[str, ...]:
     for leaf in leaves:
         if isinstance(leaf, Tensor):
             leaf = leaf._value
-        shape = getattr(leaf, "shape", None)
-        dtype = getattr(leaf, "dtype", None)
+        try:
+            shape = getattr(leaf, "shape", None)
+            dtype = getattr(leaf, "dtype", None)
+        except RuntimeError:
+            # a buffer consumed by the call being signed (donated batch
+            # Tensors guard their payload): sign it by type, post-mortem
+            out.append(type(leaf).__name__)
+            if len(out) >= limit:
+                out.append("...")
+                break
+            continue
         if shape is not None and dtype is not None:
             out.append(f"{dtype}[{','.join(str(d) for d in shape)}]")
         else:

@@ -1,0 +1,112 @@
+"""Version-stamped JAX persistent compilation cache.
+
+NOTES r7: a ``build/jax_cache`` populated by an older framework/jax build
+replayed AOT executables with WRONG NUMERICS into the serving tests, and the
+only cure was knowing to ``rm -rf`` it by hand. This module makes the cache
+self-invalidating: the directory carries a ``CACHE_KEY.json`` stamp of the
+framework + jax/jaxlib versions that filled it, and ``ensure_compile_cache_dir``
+wipes the contents whenever the stamp no longer matches the running build.
+
+Deliberately import-light: no ``jax`` import (versions come from package
+metadata), no ``paddle_tpu`` import (the framework version is parsed out of
+``paddle_tpu/version/__init__.py`` as text) — so ``tests/conftest.py`` and
+``bench.py`` can run it BEFORE any env-var pinning or backend init, via
+``importlib.util.spec_from_file_location`` on this file.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+
+STAMP_NAME = "CACHE_KEY.json"
+
+
+def _framework_version() -> str:
+    version_py = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "version", "__init__.py")
+    try:
+        with open(version_py) as f:
+            m = re.search(r"full_version\s*=\s*['\"]([^'\"]+)['\"]", f.read())
+        return m.group(1) if m else "unknown"
+    except OSError:
+        return "unknown"
+
+
+def _dist_version(name: str) -> str:
+    try:
+        import importlib.metadata as md
+
+        return md.version(name)
+    except Exception:
+        return "unknown"
+
+
+def cache_key() -> dict:
+    """The stamp contents: every component whose change can invalidate a
+    serialized XLA executable for our purposes."""
+    return {
+        "paddle_tpu": _framework_version(),
+        "jax": _dist_version("jax"),
+        "jaxlib": _dist_version("jaxlib"),
+    }
+
+
+def ensure_compile_cache_dir(path: str) -> str:
+    """Create/validate ``path`` as a stamped compilation cache dir.
+
+    A missing or mismatching ``CACHE_KEY.json`` wipes every cache entry in
+    the directory and writes a fresh stamp, so stale AOT replays from an
+    older build can never poison a run. Returns ``path`` (always usable),
+    or the path unchanged if the directory cannot be created (read-only
+    checkouts degrade to jax's no-persistent-cache behavior).
+    """
+    key = cache_key()
+    stamp_path = os.path.join(path, STAMP_NAME)
+    try:
+        os.makedirs(path, exist_ok=True)
+    except OSError:
+        return path
+    stale = True
+    try:
+        with open(stamp_path) as f:
+            stale = json.load(f) != key
+    except (OSError, ValueError):
+        stale = True
+    if stale:
+        for name in os.listdir(path):
+            if name == STAMP_NAME:
+                continue
+            full = os.path.join(path, name)
+            try:
+                if os.path.isdir(full):
+                    import shutil
+
+                    shutil.rmtree(full, ignore_errors=True)
+                else:
+                    os.unlink(full)
+            except OSError:
+                pass  # a concurrently-held entry; jax will overwrite it
+        tmp = stamp_path + ".tmp"
+        try:
+            with open(tmp, "w") as f:
+                json.dump(key, f, indent=1, sort_keys=True)
+            os.replace(tmp, stamp_path)
+        except OSError:
+            pass
+    return path
+
+
+def load_by_path():
+    """How callers that must not import ``paddle_tpu`` (conftest before env
+    pinning, bench.py's jax-free parent) are expected to load this module —
+    documented here so the idiom stays greppable::
+
+        import importlib.util
+        spec = importlib.util.spec_from_file_location(
+            "_pt_compile_cache", ".../paddle_tpu/utils/compile_cache.py")
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+    """
+    raise NotImplementedError("see docstring; this is documentation only")

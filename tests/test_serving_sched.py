@@ -30,6 +30,22 @@ from paddle_tpu.serving import (
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
+@pytest.fixture(autouse=True, scope="module")
+def _no_aot_replay():
+    """XLA:CPU AOT replay corrupts these decode programs' NUMERICS (wrong
+    generated tokens) even when the persistent cache was written by the
+    SAME jax build in the same session — the NOTES-r7 'stale cache' flake
+    was this, and version-stamping the dir (utils/compile_cache.py) cannot
+    catch a same-version unsound replay. Serving tests therefore compile
+    fresh; the rest of the suite keeps the persistent-cache speedup."""
+    import jax
+
+    old = jax.config.jax_enable_compilation_cache
+    jax.config.update("jax_enable_compilation_cache", False)
+    yield
+    jax.config.update("jax_enable_compilation_cache", old)
+
+
 @pytest.fixture(scope="module")
 def model():
     paddle.seed(7)
