@@ -171,6 +171,14 @@ class Config:
 
         return SchedulerConfig.from_inference_config(self, **overrides)
 
+    def enable_prefix_caching(self, x=True):
+        """APPLIED (serving tier): radix-tree KV reuse over the paged pool —
+        prompts sharing a cached prefix (system prompts, few-shot templates)
+        skip prefilling it; bridged into
+        ``SchedulerConfig.enable_prefix_caching`` by
+        ``to_scheduler_config()``."""
+        self._flags["prefix_caching"] = bool(x)
+
     def enable_low_precision(self, dtype="bfloat16"):
         """APPLIED: park the loaded weights in ``dtype`` residency
         (halves weight HBM/host footprint; values cast back to the

@@ -954,9 +954,9 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None, dropout_p=0.
     On TPU this routes to the Pallas flash kernel (ops/pallas/flash_attention);
     elsewhere falls back to an XLA-fused reference implementation.
     """
-    from paddle_tpu.ops.pallas import flash_attention as fa
+    from paddle_tpu.ops.pallas import scaled_dot_product_attention as sdpa
 
-    return fa.scaled_dot_product_attention(
+    return sdpa(
         query, key, value, attn_mask=attn_mask, dropout_p=dropout_p,
         is_causal=is_causal, training=training,
     )

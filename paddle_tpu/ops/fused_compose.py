@@ -316,7 +316,7 @@ def multihead_matmul(input, w, bias, bias_qk=None, transpose_q=False,
 def fused_dot_product_attention(q, k, v, mask=None, scaling_factor=None,
                                 dropout_probability=0.0, is_training=True,
                                 is_causal_masking=False, name=None):
-    from paddle_tpu.ops.pallas.flash_attention import scaled_dot_product_attention
+    from paddle_tpu.ops.pallas import scaled_dot_product_attention
     return scaled_dot_product_attention(q, k, v, attn_mask=mask,
                                         is_causal=is_causal_masking)
 
@@ -341,7 +341,7 @@ def flash_attn_qkvpacked(qkv, fixed_seed_offset=None, attn_mask=None,
 def flash_attn_unpadded(q, k, v, cu_seqlens_q, cu_seqlens_k, max_seqlen_q,
                         max_seqlen_k, scale=None, dropout=0.0, causal=False,
                         return_softmax=False, name=None):
-    from paddle_tpu.ops.pallas.flash_attention import flash_attn_unpadded as fu
+    from paddle_tpu.ops.pallas import flash_attn_unpadded as fu
     return fu(q, k, v, cu_seqlens_q, cu_seqlens_k, max_seqlen_q, max_seqlen_k,
               scale=scale, causal=causal)
 
@@ -351,7 +351,7 @@ def flash_attn_varlen_qkvpacked(qkv, cu_seqlens_q, cu_seqlens_k, max_seqlen_q,
                                 max_seqlen_k, scale=None, dropout=0.0,
                                 causal=False, return_softmax=False, name=None):
     qs, ks, vs = (Tensor._from_value(qkv._value[:, i]) for i in range(3))
-    from paddle_tpu.ops.pallas.flash_attention import flash_attn_unpadded as fu
+    from paddle_tpu.ops.pallas import flash_attn_unpadded as fu
     return fu(qs, ks, vs, cu_seqlens_q, cu_seqlens_k, max_seqlen_q,
               max_seqlen_k, scale=scale, causal=causal)
 
@@ -386,7 +386,7 @@ def flash_attn_with_sparse_mask(q, k, v, attn_mask_start_row_indices,
 def memory_efficient_attention(query, key, value, bias=None, cu_seqlens_q=None,
                                cu_seqlens_k=None, causal=False, dropout_p=0.0,
                                scale=None, training=True, name=None):
-    from paddle_tpu.ops.pallas.flash_attention import scaled_dot_product_attention
+    from paddle_tpu.ops.pallas import scaled_dot_product_attention
     return scaled_dot_product_attention(query, key, value, attn_mask=bias,
                                         is_causal=causal)
 
@@ -428,7 +428,7 @@ def fused_multi_transformer(x, ln_scales, ln_biases, qkv_weights, qkv_biases,
     fused_multi_transformer_op.cu). Layer loop of pre-LN attention + FFN;
     XLA fuses each block."""
     from paddle_tpu.nn import functional as F
-    from paddle_tpu.ops.pallas.flash_attention import scaled_dot_product_attention
+    from paddle_tpu.ops.pallas import scaled_dot_product_attention
     h = x
     n_layers = len(qkv_weights)
     for i in range(n_layers):

@@ -59,7 +59,7 @@ def ulysses_attention(q, k, v, *, mesh: Mesh, axis: str = "sep",
     if scale is None:
         scale = 1.0 / math.sqrt(D)
 
-    from paddle_tpu.ops.pallas.flash_attention import flash_attention_fwd
+    from paddle_tpu.ops.pallas import flash_attention_fwd
 
     def inner(q_, k_, v_):
         qh = _a2a_seq_to_heads(q_, axis)

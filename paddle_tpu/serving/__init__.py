@@ -5,8 +5,9 @@ AnalysisPredictor + the Paddle Serving ecosystem's request brokering),
 re-designed for the TPU substrate: a request queue with admission control,
 an iteration-level (Orca-style) scheduler over a fixed-shape slot grid so
 the decode step never recompiles, a vLLM-style paged KV pool with
-preemption-on-exhaustion, per-token streaming, and a serving metrics
-registry (TTFT/TPOT, tokens/s, KV utilization).
+preemption-on-exhaustion, automatic prefix caching (radix-tree KV reuse —
+see ``prefix_cache/``), per-token streaming, and a serving metrics
+registry (TTFT/TPOT, tokens/s, KV utilization, prefix hit rate).
 
     queue → scheduler → slot grid → paged KV pool
                  │
@@ -35,6 +36,11 @@ from paddle_tpu.serving.request import (  # noqa: F401
     RequestState,
     SchedulerConfig,
 )
+from paddle_tpu.serving.prefix_cache import (  # noqa: F401
+    PrefixCache,
+    RadixTree,
+    RefCountingBlockAllocator,
+)
 from paddle_tpu.serving.scheduler import (  # noqa: F401
     ContinuousBatchingScheduler,
 )
@@ -43,7 +49,10 @@ __all__ = [
     "ContinuousBatchingScheduler",
     "Histogram",
     "MetricsRegistry",
+    "PrefixCache",
     "QueueFull",
+    "RadixTree",
+    "RefCountingBlockAllocator",
     "Request",
     "RequestOutput",
     "RequestQueue",

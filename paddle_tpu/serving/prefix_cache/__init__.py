@@ -1,0 +1,63 @@
+"""paddle_tpu.serving.prefix_cache — automatic prefix caching over the
+paged KV pool.
+
+Design note. Real serving traffic is TTFT-dominated and massively
+prefix-shared (system prompts, few-shot templates, multi-turn prefixes);
+recomputing a shared prefix per request is the single biggest avoidable
+cost in the serving tier. This package turns that reuse into an LRU cache
+problem, combining vLLM's block-level sharing (PagedAttention: ref-counted
+blocks + copy-on-write) with SGLang's RadixAttention (a radix tree keyed on
+token-id sequences):
+
+- ``RefCountingBlockAllocator`` extends the paged pool's ``BlockAllocator``
+  with per-block refcounts — a block may simultaneously back several
+  running sequences AND the cache — plus an eviction callback so cached
+  blocks are reclaimed LRU-first only under real pool pressure. Retired
+  KV is "free capacity in waiting": it costs nothing until the pool runs
+  short, and preempting/retiring one sharer can never free a block another
+  sharer or the tree still references.
+- ``RadixTree`` quantizes cached sequences to pool blocks (one node = one
+  ``block_size`` token chunk = one block), so a longest-prefix match IS a
+  ready-made block-table prefix. Insert happens on request retire and
+  preempt (a preempted request's own resume becomes a cache hit); eviction
+  is leaves-first, LRU by an access clock.
+- ``PrefixCache`` coordinates the refcount protocol, the copy-on-write
+  worker (``copy_block_in_pools`` — forking the one partial block a
+  full-prompt hit must rewrite), and the observability counters
+  (``prefix_cache_hit/miss_tokens_total``,
+  ``prefix_cache_evicted_blocks_total``, hit-rate gauge).
+
+The scheduler matches each admission against the tree, pins the hit
+blocks into the request's block-table row, and prefills **only the
+uncached suffix** (absolute position ids, cache ``pos`` = matched length).
+Block tables and positions are data, not shapes — suffix buckets reuse the
+same compiled prefill programs, and the one-compiled-decode-program
+invariant (``scheduler.compile_stats()`` zero steady-state recompiles)
+holds with the cache on. Correctness bar: outputs are token-identical with
+the cache on vs off, including under forced eviction and preempt-resume
+(pinned in ``tests/test_prefix_cache.py``).
+
+Enable with ``SchedulerConfig(enable_prefix_caching=True)`` or
+``inference.Config.enable_prefix_caching()`` →
+``Config.to_scheduler_config()``.
+"""
+
+from paddle_tpu.serving.prefix_cache.allocator import (  # noqa: F401
+    RefCountingBlockAllocator,
+)
+from paddle_tpu.serving.prefix_cache.cache import (  # noqa: F401
+    PrefixCache,
+    copy_block_in_pools,
+)
+from paddle_tpu.serving.prefix_cache.radix import (  # noqa: F401
+    RadixNode,
+    RadixTree,
+)
+
+__all__ = [
+    "PrefixCache",
+    "RadixNode",
+    "RadixTree",
+    "RefCountingBlockAllocator",
+    "copy_block_in_pools",
+]

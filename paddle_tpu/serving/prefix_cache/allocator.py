@@ -1,0 +1,108 @@
+"""Ref-counting block allocator: the sharing-aware face of the KV pool.
+
+Extends ``models.kv_cache.BlockAllocator`` (the vLLM block-manager role)
+with the three capabilities block-level prefix sharing needs:
+
+- **Reference counts**: a block can back several sequences at once (and the
+  radix tree on top). ``free()`` becomes a decref — the block only returns
+  to the free list when the LAST holder lets go, so preempting or retiring
+  one sharer can never invalidate another sharer's (or the cache's) KV.
+- **Copy-on-write bookkeeping**: ``is_shared()`` tells a writer it must fork
+  a block before mutating it (the scheduler performs the actual pool copy —
+  device state never lives here).
+- **Eviction-under-pressure hook**: when the free list runs short, the
+  allocator first asks its ``evict_cb`` (the prefix cache) to release
+  cached-but-unreferenced blocks, LRU-first, and only raises
+  ``KVPoolExhausted`` once there is genuinely nothing left to reclaim.
+  Cached blocks are therefore "free capacity in waiting": they cost nothing
+  until the pool is actually under pressure.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional
+
+from paddle_tpu.models.kv_cache import BlockAllocator, KVPoolExhausted
+
+__all__ = ["RefCountingBlockAllocator"]
+
+
+class RefCountingBlockAllocator(BlockAllocator):
+    """``BlockAllocator`` with per-block refcounts and cache-eviction reclaim.
+
+    The base-class invariants survive: every block is free XOR allocated,
+    releasing a block that is not allocated raises (double free), and the
+    occupancy/fragmentation stats keep working — a shared block counts once
+    toward ``num_used_blocks`` regardless of how many holders it has.
+    """
+
+    def __init__(self, num_blocks: int, block_size: int,
+                 evict_cb: Optional[Callable[[int], int]] = None):
+        super().__init__(num_blocks, block_size)
+        self._ref: Dict[int, int] = {}
+        # evict_cb(min_blocks_wanted) -> number of cache entries released;
+        # 0 means the cache has nothing more to give (stop asking)
+        self._evict_cb = evict_cb
+
+    def set_evict_cb(self, cb: Optional[Callable[[int], int]]):
+        self._evict_cb = cb
+
+    # ---- refcount surface ---------------------------------------------
+
+    def ref_count(self, block: int) -> int:
+        return self._ref.get(block, 0)
+
+    def is_shared(self, block: int) -> bool:
+        """True when a write to ``block`` needs copy-on-write first."""
+        return self._ref.get(block, 0) > 1
+
+    def incref(self, block: int):
+        if block not in self._allocated:
+            raise RuntimeError(
+                f"incref on block {block} which is not allocated")
+        self._ref[block] += 1
+
+    def decref(self, block: int):
+        if block not in self._allocated:
+            raise RuntimeError(
+                f"double free: block {block} is not currently allocated")
+        self._ref[block] -= 1
+        if self._ref[block] <= 0:
+            del self._ref[block]
+            self._allocated.remove(block)
+            self._free.append(block)
+
+    # ---- BlockAllocator surface, sharing-aware ------------------------
+
+    def _pop_free(self) -> int:
+        b = super()._pop_free()
+        self._ref[b] = 1
+        return b
+
+    def free(self, blocks: List[int]):
+        """Release one holder's references (NOT necessarily the blocks):
+        the scheduler's retire/preempt path keeps calling ``free`` and the
+        pool stays correct under sharing."""
+        for b in blocks:
+            self.decref(b)
+
+    def _reclaim(self, need_blocks: int):
+        """Evict cached blocks until ``need_blocks`` are free or the cache
+        runs dry. Progress is 'cache released entries', not 'blocks freed':
+        an entry whose block is still pinned by a live sequence frees
+        nothing, but the next-LRU entry might."""
+        while len(self._free) < need_blocks and self._evict_cb is not None:
+            if self._evict_cb(need_blocks - len(self._free)) <= 0:
+                break
+
+    def allocate(self, n_tokens: int) -> List[int]:
+        need = (n_tokens + self.block_size - 1) // self.block_size
+        self._reclaim(need)
+        return super().allocate(n_tokens)
+
+    def extend(self, blocks: List[int], cur_tokens: int, add_tokens: int):
+        have = len(blocks) * self.block_size
+        need = -(-max(cur_tokens + add_tokens - have, 0) // self.block_size)
+        if need:
+            self._reclaim(need)
+        return super().extend(blocks, cur_tokens, add_tokens)

@@ -1,0 +1,160 @@
+"""PrefixCache: glue between the radix tree, the ref-counting allocator,
+the scheduler, and the observability registry.
+
+Reference protocol (who holds a block and why):
+
+- admission ``match_and_pin``: every matched block gets ``incref`` — the
+  request's pin. The tree keeps its own reference, so a later eviction of
+  the tree entry cannot free a block a running sequence still reads.
+- retire/preempt ``insert``: the tree adopts any block it does not already
+  have a node for (``incref``), then the scheduler's ``allocator.free``
+  drops the request's references. Chunks already cached deduplicate — the
+  request's duplicate block simply goes back to the free list.
+- pressure ``_evict_for``: the allocator calls back here when the free
+  list runs short; LRU leaves are dropped (``decref``) until enough blocks
+  are actually free, preferring leaves whose block is not pinned by a
+  running sequence.
+- ``flush``: weight hot-swap (``reload_weights``) drops everything —
+  cached KV from old weights must never mix into new-weight decodes.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from paddle_tpu.serving.prefix_cache.allocator import (
+    RefCountingBlockAllocator,
+)
+from paddle_tpu.serving.prefix_cache.radix import RadixTree
+from paddle_tpu.tensor import Tensor
+
+__all__ = ["PrefixCache", "copy_block_in_pools"]
+
+
+def copy_block_in_pools(pools, src_block: int, dst_block: int):
+    """Copy-on-write worker: duplicate one block's K/V rows into a fresh
+    block across every layer's pool. Device-side (one fused scatter per
+    pool); returns the new pools list. Needed because a partial block of a
+    cached prefix cannot be written in place — the cache (and any other
+    sharer) still reads the original, and even a same-token rewrite from a
+    differently-bucketed prefill program is not guaranteed bit-identical."""
+    out = []
+    for kp, vp in pools:
+        kv, vv = kp._value, vp._value
+        out.append((Tensor._from_value(kv.at[dst_block].set(kv[src_block])),
+                    Tensor._from_value(vv.at[dst_block].set(vv[src_block]))))
+    return out
+
+
+class PrefixCache:
+    """Automatic prefix caching over one scheduler's paged KV pool."""
+
+    def __init__(self, allocator: RefCountingBlockAllocator,
+                 block_size: int, registry=None):
+        self.allocator = allocator
+        self.block_size = int(block_size)
+        self.tree = RadixTree(block_size)
+        allocator.set_evict_cb(self._evict_for)
+        self._hit_tokens = 0
+        self._miss_tokens = 0
+        self._evicted_blocks = 0
+        self._reg = registry
+        if registry is not None:
+            self._c_hit = registry.counter(
+                "prefix_cache_hit_tokens_total",
+                "prompt tokens served from the prefix cache")
+            self._c_miss = registry.counter(
+                "prefix_cache_miss_tokens_total",
+                "prompt tokens that had to be prefilled")
+            self._c_evicted = registry.counter(
+                "prefix_cache_evicted_blocks_total",
+                "cached blocks dropped under pool pressure")
+            self._g_hit_rate = registry.gauge(
+                "prefix_cache_hit_rate",
+                "hit_tokens / (hit_tokens + miss_tokens)")
+            self._g_cached = registry.gauge(
+                "prefix_cache_cached_blocks", "blocks retained in the tree")
+
+    # ---- admission side -------------------------------------------------
+
+    def match_and_pin(self, tokens: Sequence[int]) -> List[int]:
+        """Longest cached block-aligned prefix of ``tokens``; every returned
+        block is pinned (incref'd) for the caller. Unpin with ``unpin`` if
+        admission aborts, or hand them to the request's block list (the
+        scheduler's normal free path releases them)."""
+        blocks = self.tree.match(tokens)
+        for b in blocks:
+            self.allocator.incref(b)
+        return blocks
+
+    def unpin(self, blocks: Sequence[int]):
+        for b in blocks:
+            self.allocator.decref(b)
+
+    def record_admission(self, hit_tokens: int, miss_tokens: int):
+        self._hit_tokens += int(hit_tokens)
+        self._miss_tokens += int(miss_tokens)
+        if self._reg is not None:
+            if hit_tokens:
+                self._c_hit.inc(hit_tokens)
+            if miss_tokens:
+                self._c_miss.inc(miss_tokens)
+            self._g_hit_rate.set(self.hit_rate())
+            self._g_cached.set(len(self.tree))
+
+    # ---- release side ---------------------------------------------------
+
+    def insert(self, tokens: Sequence[int], blocks: Sequence[int]):
+        """Adopt a retiring/preempted sequence's cached blocks into the
+        tree. ``tokens`` must be exactly the token values whose K/V the
+        blocks hold (i.e. the first ``pos`` fed tokens); only full blocks
+        are cached."""
+        adopted = self.tree.insert(tokens, blocks)
+        for b in adopted:
+            self.allocator.incref(b)
+        if self._reg is not None:
+            self._g_cached.set(len(self.tree))
+
+    # ---- pressure / invalidation ---------------------------------------
+
+    def _evict_for(self, want_blocks: int) -> int:
+        """Allocator pressure callback: drop LRU leaves until ``want_blocks``
+        could plausibly be freed. Prefers leaves whose block has no other
+        holder (those actually free memory); returns entries released."""
+        released = self.tree.evict_lru(
+            max_nodes=max(1, int(want_blocks)),
+            prefer=lambda n: self.allocator.ref_count(n.block) > 1)
+        for b in released:
+            self.allocator.decref(b)
+        self._evicted_blocks += len(released)
+        if self._reg is not None and released:
+            self._c_evicted.inc(len(released))
+            self._g_cached.set(len(self.tree))
+        return len(released)
+
+    def flush(self) -> int:
+        """Drop the whole tree (weight hot-swap). Blocks still pinned by
+        running sequences survive until those sequences release them."""
+        released = self.tree.flush()
+        for b in released:
+            self.allocator.decref(b)
+        if self._reg is not None:
+            self._g_cached.set(0)
+        return len(released)
+
+    # ---- reading --------------------------------------------------------
+
+    def hit_rate(self) -> float:
+        total = self._hit_tokens + self._miss_tokens
+        return self._hit_tokens / total if total else 0.0
+
+    def stats(self) -> dict:
+        return {
+            "hit_tokens": self._hit_tokens,
+            "miss_tokens": self._miss_tokens,
+            "hit_rate": round(self.hit_rate(), 4),
+            "evicted_blocks": self._evicted_blocks,
+            "cached_blocks": len(self.tree),
+        }

@@ -1,0 +1,162 @@
+"""Radix tree over token-id sequences at block granularity.
+
+SGLang's RadixAttention insight reduced cross-request prefix reuse to an
+LRU-cache problem: key the retained KV by the token sequence that produced
+it, longest-prefix-match new prompts against the structure, evict from the
+leaves when memory is needed. Here the tree is quantized to KV-pool blocks
+(each node = exactly ``block_size`` tokens = one pool block), which makes
+the mapping onto the paged pool trivial — a matched path IS a block-table
+prefix — and keeps insert/match O(tokens / block_size) dict hops.
+
+The tree does pure bookkeeping: it never touches device memory and never
+frees blocks itself. ``PrefixCache`` coordinates the allocator refcounts
+(the tree's adoption of a block is one reference; eviction drops it).
+
+Eviction is leaves-first (an inner node's block is, by construction, a
+prefix of some cached sequence and must outlive its extensions), LRU by a
+monotonic access clock stamped on the whole path at every match/insert.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+__all__ = ["RadixNode", "RadixTree"]
+
+
+class RadixNode:
+    """One cached block: ``key`` is its block-sized token chunk, ``block``
+    the pool block holding that chunk's K/V."""
+
+    __slots__ = ("key", "block", "parent", "children", "last_access")
+
+    def __init__(self, key: Optional[tuple], block: int,
+                 parent: Optional["RadixNode"]):
+        self.key = key
+        self.block = block
+        self.parent = parent
+        self.children: Dict[tuple, "RadixNode"] = {}
+        self.last_access = 0
+
+    def is_leaf(self) -> bool:
+        return not self.children
+
+
+class RadixTree:
+    """Block-granular token-sequence trie with LRU leaf eviction."""
+
+    def __init__(self, block_size: int):
+        self.block_size = int(block_size)
+        self.root = RadixNode(key=None, block=-1, parent=None)
+        self._clock = 0
+        self._num_nodes = 0
+
+    # ---- introspection -------------------------------------------------
+
+    def __len__(self) -> int:
+        return self._num_nodes
+
+    def num_blocks(self) -> int:
+        return self._num_nodes
+
+    def _chunks(self, tokens: Sequence[int]):
+        bs = self.block_size
+        n_full = len(tokens) // bs
+        for i in range(n_full):
+            yield tuple(int(t) for t in tokens[i * bs:(i + 1) * bs])
+
+    def _tick(self) -> int:
+        self._clock += 1
+        return self._clock
+
+    # ---- core operations ------------------------------------------------
+
+    def match(self, tokens: Sequence[int]) -> List[int]:
+        """Longest cached prefix of ``tokens``, as pool block ids (block-
+        aligned: covers ``len(result) * block_size`` tokens). Touches the
+        matched path's LRU stamps."""
+        now = self._tick()
+        node, blocks = self.root, []
+        for chunk in self._chunks(tokens):
+            child = node.children.get(chunk)
+            if child is None:
+                break
+            child.last_access = now
+            blocks.append(child.block)
+            node = child
+        return blocks
+
+    def insert(self, tokens: Sequence[int], blocks: Sequence[int]) -> List[int]:
+        """Record a cached sequence. ``blocks[i]`` must hold the K/V of the
+        i-th full block chunk of ``tokens``. Chunks already present are
+        deduplicated (the tree keeps its existing block — content is
+        identical by construction, K/V of a token depends only on its
+        prefix). Returns the block ids the tree newly ADOPTED; the caller
+        owns taking a reference on each."""
+        now = self._tick()
+        node, adopted = self.root, []
+        for i, chunk in enumerate(self._chunks(tokens)):
+            if i >= len(blocks):
+                break
+            child = node.children.get(chunk)
+            if child is None:
+                child = RadixNode(key=chunk, block=int(blocks[i]),
+                                  parent=node)
+                node.children[chunk] = child
+                self._num_nodes += 1
+                adopted.append(child.block)
+            child.last_access = now
+            node = child
+        return adopted
+
+    # ---- eviction --------------------------------------------------------
+
+    def leaves(self) -> List[RadixNode]:
+        out, stack = [], list(self.root.children.values())
+        while stack:
+            n = stack.pop()
+            if n.is_leaf():
+                out.append(n)
+            else:
+                stack.extend(n.children.values())
+        return out
+
+    def remove(self, node: RadixNode) -> int:
+        """Unlink one LEAF node; returns its block id (the caller drops the
+        tree's reference on it)."""
+        if node.children:
+            raise ValueError("only leaf nodes can be evicted")
+        del node.parent.children[node.key]
+        self._num_nodes -= 1
+        return node.block
+
+    def evict_lru(self, max_nodes: int = 1,
+                  prefer=None) -> List[int]:
+        """Evict up to ``max_nodes`` leaves, LRU-first. ``prefer(node)``
+        (optional) returns a sort prefix — e.g. 'is this block actually
+        reclaimable' — so pinned blocks are only dropped when nothing
+        better remains. Returns the released block ids."""
+        released = []
+        for _ in range(max_nodes):
+            cand = self.leaves()
+            if not cand:
+                break
+            if prefer is not None:
+                cand.sort(key=lambda n: (prefer(n), n.last_access))
+            else:
+                cand.sort(key=lambda n: n.last_access)
+            released.append(self.remove(cand[0]))
+        return released
+
+    def flush(self) -> List[int]:
+        """Drop every node (weight hot-swap invalidates all cached KV).
+        Returns every block id the tree was holding."""
+        released = []
+        stack = list(self.root.children.values())
+        while stack:
+            n = stack.pop()
+            released.append(n.block)
+            stack.extend(n.children.values())
+        self.root.children.clear()
+        self._num_nodes = 0
+        return released

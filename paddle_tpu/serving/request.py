@@ -50,6 +50,7 @@ class SchedulerConfig:
     top_k: int = 0
     cache_dtype: str = "float32"
     enable_preemption: bool = True
+    enable_prefix_caching: bool = False   # radix-tree KV reuse across requests
     prefill_bucket: int = 16          # smallest prefill width bucket
 
     @property
@@ -72,7 +73,10 @@ class SchedulerConfig:
           preemption IS the serving-tier memory optimization: graceful
           degradation instead of OOM when the block pool runs dry);
         - ``enable_low_precision(d)`` → ``cache_dtype=d`` (KV pool rests in
-          the reduced precision — the dominant serving-memory consumer).
+          the reduced precision — the dominant serving-memory consumer);
+        - ``enable_prefix_caching(x)`` → ``enable_prefix_caching=x``
+          (radix-tree KV reuse over the paged pool: shared prompt prefixes
+          skip prefill entirely).
         """
         kw = {}
         flags = getattr(config, "_flags", {})
@@ -81,6 +85,8 @@ class SchedulerConfig:
         lp = flags.get("low_precision")
         if lp:
             kw["cache_dtype"] = lp
+        if "prefix_caching" in flags:
+            kw["enable_prefix_caching"] = bool(flags["prefix_caching"])
         kw.update(overrides)
         return cls(**kw)
 

@@ -35,6 +35,11 @@ from paddle_tpu.models.kv_cache import (
 from paddle_tpu.models.serving import SlotStep, _bucket
 from paddle_tpu.profiler import RecordEvent
 from paddle_tpu.serving.metrics import ServingMetrics
+from paddle_tpu.serving.prefix_cache import (
+    PrefixCache,
+    RefCountingBlockAllocator,
+    copy_block_in_pools,
+)
 from paddle_tpu.serving.request import (
     Request,
     RequestOutput,
@@ -65,7 +70,17 @@ class ContinuousBatchingScheduler:
         self.metrics = metrics or ServingMetrics()
         self._step_fn = SlotStep(model, temperature=cfg.temperature,
                                  top_k=cfg.top_k)
-        self.allocator = BlockAllocator(cfg.total_blocks, cfg.block_size)
+        if cfg.enable_prefix_caching:
+            # sharing-aware pool + radix tree: admissions match cached
+            # prefixes and prefill only the uncached suffix
+            self.allocator = RefCountingBlockAllocator(
+                cfg.total_blocks, cfg.block_size)
+            self.prefix_cache: Optional[PrefixCache] = PrefixCache(
+                self.allocator, cfg.block_size,
+                registry=self.metrics.registry)
+        else:
+            self.allocator = BlockAllocator(cfg.total_blocks, cfg.block_size)
+            self.prefix_cache = None
 
         S, MB = cfg.max_num_seqs, cfg.max_blocks_per_seq
         # host-side slot grid: which request runs where, its block-table row
@@ -136,9 +151,24 @@ class ContinuousBatchingScheduler:
     def _store_pools(self, caches):
         self._pools = [(c.k_pool, c.v_pool) for c in caches]
 
+    def _cache_insert_on_release(self, req: Request, slot: int):
+        """Donate a releasing sequence's cached KV to the radix tree (insert
+        on retire AND preempt — a preempted request's own resume becomes a
+        cache hit). Must run BEFORE ``allocator.free``: the tree increfs the
+        blocks it adopts, so the free below only drops the request's pin."""
+        if self.prefix_cache is None or not req.blocks:
+            return
+        pos = int(self._pos[slot])   # tokens whose K/V the blocks hold
+        if pos <= 0:
+            return
+        seq = np.concatenate([np.asarray(req.prompt_ids, np.int64),
+                              np.asarray(req.out_tokens, np.int64)])[:pos]
+        self.prefix_cache.insert(seq, req.blocks)
+
     def _retire(self, slot: int, reason: str):
         req = self._slots[slot]
         req.finish(reason)
+        self._cache_insert_on_release(req, slot)
         self.allocator.free(req.blocks)
         req.blocks = []
         req.slot = -1
@@ -165,6 +195,7 @@ class ContinuousBatchingScheduler:
     def _preempt(self, slot: int):
         req = self._slots[slot]
         with RecordEvent("serving.preempt"):
+            self._cache_insert_on_release(req, slot)
             self.allocator.free(req.blocks)
             req.blocks = []
             req.slot = -1
@@ -201,8 +232,18 @@ class ContinuousBatchingScheduler:
                 self._preempt(victim)
 
     def _admit(self) -> List[Request]:
-        """Fill free slots from the queue via prefill-then-pack."""
+        """Fill free slots from the queue via prefill-then-pack.
+
+        With prefix caching on, each prompt is first matched against the
+        radix tree: hit blocks are pinned straight into the block-table row
+        and only the uncached SUFFIX is prefilled (absolute position ids,
+        cache pos = matched length — data, not shapes, so the same compiled
+        prefill buckets serve hits and misses). A full-prompt hit keeps one
+        token to recompute (the last prompt token produces the first sampled
+        logit), which partially rewrites the final shared block — that block
+        is forked copy-on-write before the write."""
         finished = []
+        bs = self.config.block_size
         while len(self.queue):
             slot = next((s for s, r in enumerate(self._slots) if r is None),
                         None)
@@ -210,33 +251,59 @@ class ContinuousBatchingScheduler:
                 break
             nxt = self.queue.peek()
             ids = nxt.resume_ids
+            P = len(ids)
+            hit_blocks: List[int] = []
+            matched = 0
+            if self.prefix_cache is not None:
+                with RecordEvent("serving.prefix_match"):
+                    hit_blocks = self.prefix_cache.match_and_pin(ids)
+                matched = min(len(hit_blocks) * bs, P - 1)
+            # full-prompt hit ⇒ the last shared block gets partially
+            # rewritten (the one recomputed token) ⇒ fork it first
+            cow = matched < len(hit_blocks) * bs
+            need_blocks = -(-P // bs) - len(hit_blocks) + (1 if cow else 0)
             try:
-                blocks = self.allocator.allocate(len(ids))
+                fresh = (self.allocator.allocate(need_blocks * bs)
+                         if need_blocks > 0 else [])
             except KVPoolExhausted:
+                if hit_blocks:
+                    self.prefix_cache.unpin(hit_blocks)
                 break                        # running seqs keep precedence
             req = self.queue.pop()
+            blocks = list(hit_blocks)
+            if cow:
+                new_b = fresh.pop(0)
+                self._pools = copy_block_in_pools(
+                    self._pools, blocks[-1], new_b)
+                self.allocator.decref(blocks[-1])   # drop pin on the original
+                blocks[-1] = new_b
+            blocks += fresh
             req.blocks = blocks
             req.slot = slot
             req.state = RequestState.RUNNING
-            P = len(ids)
-            Pb = min(_bucket(P, self.config.prefill_bucket), self.max_seq_len)
+            S = P - matched                  # uncached suffix to prefill
+            Pb = min(_bucket(S, self.config.prefill_bucket), self.max_seq_len)
             ids_np = np.zeros((1, Pb), np.int32)
-            ids_np[0, :P] = ids
+            ids_np[0, :S] = ids[matched:]
             row = np.full((1, self.config.max_blocks_per_seq), -1, np.int32)
             row[0, :len(blocks)] = blocks
             with RecordEvent("serving.prefill"), paddle.no_grad():
-                caches = [PagedCacheSlot(kp, vp, paddle.to_tensor(row),
-                                         paddle.zeros([1], dtype="int32"))
-                          for kp, vp in self._pools]
+                caches = [PagedCacheSlot(
+                    kp, vp, paddle.to_tensor(row),
+                    paddle.to_tensor(np.array([matched], np.int32)))
+                    for kp, vp in self._pools]
                 next_ids, caches = self._step_fn(
                     paddle.to_tensor(ids_np),
-                    paddle.to_tensor(np.arange(Pb, dtype=np.int32)),
+                    paddle.to_tensor(np.arange(matched, matched + Pb,
+                                               dtype=np.int32)),
                     caches,
-                    paddle.to_tensor(np.array([P - 1], np.int32)))
+                    paddle.to_tensor(np.array([S - 1], np.int32)))
                 self._store_pools(caches)
             tok = int(np.asarray(next_ids.numpy())[0])
             self.metrics.prefills += 1
-            self.metrics.prefill_tokens += P
+            self.metrics.prefill_tokens += S
+            if self.prefix_cache is not None:
+                self.prefix_cache.record_admission(matched, S)
             # pack into the grid
             self._slots[slot] = req
             self._table[slot] = row[0]
@@ -345,6 +412,13 @@ class ContinuousBatchingScheduler:
         """Compiled-program count (recompile accounting for tests)."""
         return self._step_fn.num_programs()
 
+    def prefix_cache_stats(self) -> Optional[Dict[str, object]]:
+        """Hit/miss/eviction accounting of the prefix cache (None when
+        ``enable_prefix_caching`` is off)."""
+        if self.prefix_cache is None:
+            return None
+        return self.prefix_cache.stats()
+
     # ---- weight hot-reload --------------------------------------------
 
     def reload_weights(self, source, step: Optional[int] = None,
@@ -360,7 +434,9 @@ class ContinuousBatchingScheduler:
         match — the compiled slot step is reused, so NO recompile happens.
         In-flight sequences keep their already-written KV blocks (their next
         tokens mix cache prefixes from the old weights; preempt or drain
-        first for strict per-request consistency). Returns the loaded step.
+        first for strict per-request consistency). The prefix cache is
+        FLUSHED — cached KV from the old weights must never seed a
+        new-weight decode. Returns the loaded step.
         """
         from paddle_tpu.checkpoint import CheckpointManager
         from paddle_tpu.profiler import RecordEvent, TracerEventType
@@ -371,6 +447,8 @@ class ContinuousBatchingScheduler:
                          TracerEventType.UserDefined):
             res = mgr.restore(step=step, model=self.model, verify=verify,
                               restore_rng=False)
+        if self.prefix_cache is not None:
+            self.prefix_cache.flush()
         return res.step
 
     # ---- compile observability ----------------------------------------
