@@ -16,7 +16,22 @@ serving, and distributed code:
 - **Trace spans** live in ``paddle_tpu.profiler`` (``RecordEvent``); the
   training step, optimizer update, collectives, dataloader, and serving
   scheduler all emit them, and ``Profiler.export_report()`` merges host
-  spans with metric snapshots into one artifact.
+  spans with metric snapshots into one artifact. Every literal span name is
+  registered (owner + category) in ``span_manifest.py``; the
+  ``tools/check_spans.py`` lint keeps the manifest and the code in sync.
+- **Request lifecycle tracing** (``request_trace.py``): per-request linked
+  spans keyed by ``request_id`` across the serving scheduler — queued →
+  admit (prefix match + prefill) → running → preempted/resumed → done —
+  with gapless phase durations (they sum to E2E latency), chrome-trace and
+  JSON export.
+- **Serving stall attribution + flight recorder** (``serving_stall.py``):
+  ``serving_host_stall_seconds{phase=...}`` mirrors ``train_stall.py`` for
+  the serving hot loop (admission / radix_match / block_accounting /
+  streaming / sampling_sync), plus a per-step ring buffer dumped on demand
+  or on alarm (``TTFTBreachStorm``, ``EvictionThrash``).
+- **Live endpoint** (``endpoint.py``): stdlib-http ``/metrics`` (Prometheus
+  text across registries) + ``/debug/requests`` (live request table, stall
+  breakdown, SLO accounting, flight-recorder dump) + ``/healthz``.
 
 Typical use::
 
@@ -38,6 +53,9 @@ from paddle_tpu.observability.compile_tracker import (  # noqa: F401
     abstract_signature,
     get_compile_tracker,
 )
+from paddle_tpu.observability.endpoint import (  # noqa: F401
+    ObservabilityEndpoint,
+)
 from paddle_tpu.observability.metrics import (  # noqa: F401
     Counter,
     Gauge,
@@ -45,6 +63,17 @@ from paddle_tpu.observability.metrics import (  # noqa: F401
     MetricsRegistry,
     get_registry,
     parse_prometheus_text,
+)
+from paddle_tpu.observability.request_trace import (  # noqa: F401
+    RequestTrace,
+    RequestTracer,
+)
+from paddle_tpu.observability.serving_stall import (  # noqa: F401
+    EvictionThrash,
+    FlightRecorder,
+    STALL_PHASES,
+    ServingStall,
+    TTFTBreachStorm,
 )
 from paddle_tpu.observability.train_stall import (  # noqa: F401
     record_input_stall,
@@ -57,10 +86,18 @@ __all__ = [
     "CompileEvent",
     "CompileTracker",
     "Counter",
+    "EvictionThrash",
+    "FlightRecorder",
     "Gauge",
     "Histogram",
     "MetricsRegistry",
+    "ObservabilityEndpoint",
     "RecompileStorm",
+    "RequestTrace",
+    "RequestTracer",
+    "STALL_PHASES",
+    "ServingStall",
+    "TTFTBreachStorm",
     "abstract_signature",
     "get_compile_tracker",
     "get_registry",

@@ -1,0 +1,176 @@
+"""Live introspection endpoint: ``/metrics`` + ``/debug/requests``.
+
+A stdlib-only (``http.server``) HTTP surface over the observability layer —
+the production-metrics idiom of the vLLM/SGLang serving lineage (scrape a
+``/metrics`` Prometheus page, curl a debug page when a request is slow)
+without adding any dependency:
+
+- ``GET /metrics``        Prometheus text exposition concatenated across
+                          every attached ``MetricsRegistry`` (the process-
+                          wide default registry is always included first —
+                          compile tracking, train stalls — then e.g. each
+                          scheduler's ServingMetrics registry).
+- ``GET /debug/requests`` JSON from every attached debug source — for a
+                          scheduler: the live request table (state, phase,
+                          tokens, slot, preemptions, age), recent completed
+                          traces, the stall breakdown, SLO accounting, and
+                          the flight-recorder ring (``?last=N`` trims it).
+- ``GET /healthz``        liveness probe (200 "ok").
+
+The server runs on a daemon thread (``ThreadingHTTPServer``), binds
+``127.0.0.1`` and an ephemeral port by default, and never touches the
+device: every handler reads host-side state the scheduler already keeps, so
+a scrape cannot stall a decode step.
+
+Typical use::
+
+    ep = ObservabilityEndpoint()
+    ep.add_scheduler(sched)          # registry + debug_state in one call
+    host, port = ep.start()
+    ... requests serve ...           # curl http://host:port/metrics
+    ep.stop()
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, Dict, List, Optional, Tuple
+from urllib.parse import parse_qs, urlparse
+
+from paddle_tpu.observability.metrics import MetricsRegistry, get_registry
+
+__all__ = ["ObservabilityEndpoint"]
+
+
+class ObservabilityEndpoint:
+    """One process's scrape + debug HTTP surface."""
+
+    def __init__(self, registries: Optional[List[MetricsRegistry]] = None,
+                 host: str = "127.0.0.1", port: int = 0,
+                 include_default_registry: bool = True):
+        self._registries: List[MetricsRegistry] = []
+        if include_default_registry:
+            self._registries.append(get_registry())
+        for r in registries or ():
+            self.add_registry(r)
+        self._debug_sources: "Dict[str, Callable[[], dict]]" = {}
+        self._host = host
+        self._port = int(port)
+        self._server: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+
+    # --------------------------------------------------------- attachment
+    def add_registry(self, registry: MetricsRegistry):
+        if registry not in self._registries:
+            self._registries.append(registry)
+
+    def add_debug_source(self, name: str, fn: Callable[[], dict]):
+        """``fn()`` -> JSON-able dict, rendered under ``name`` in
+        ``/debug/requests``."""
+        self._debug_sources[str(name)] = fn
+
+    def add_scheduler(self, scheduler, name: Optional[str] = None):
+        """Attach a ContinuousBatchingScheduler: its metrics registry feeds
+        ``/metrics`` and its ``debug_state()`` feeds ``/debug/requests``."""
+        self.add_registry(scheduler.metrics.registry)
+        self.add_debug_source(name or f"scheduler{len(self._debug_sources)}",
+                              scheduler.debug_state)
+        return self
+
+    # ------------------------------------------------------------ content
+    def metrics_text(self) -> str:
+        return "".join(r.prometheus_text() for r in self._registries)
+
+    def debug_requests(self, last: Optional[int] = None) -> dict:
+        out = {}
+        for name, fn in self._debug_sources.items():
+            try:
+                state = fn()
+            except Exception as e:  # a broken source must not 500 the page
+                state = {"error": f"{type(e).__name__}: {e}"}
+            if last and isinstance(state, dict):
+                fr = state.get("flight_recorder")
+                if isinstance(fr, list):
+                    state = dict(state, flight_recorder=fr[-last:])
+            out[name] = state
+        return out
+
+    # ---------------------------------------------------------- lifecycle
+    def start(self) -> Tuple[str, int]:
+        if self._server is not None:
+            return self.address
+        ep = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):  # silence per-request stderr lines
+                pass
+
+            def _send(self, code: int, body: str, ctype: str):
+                data = body.encode()
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def do_GET(self):
+                url = urlparse(self.path)
+                if url.path == "/metrics":
+                    self._send(200, ep.metrics_text(),
+                               "text/plain; version=0.0.4")
+                elif url.path == "/debug/requests":
+                    q = parse_qs(url.query)
+                    last = None
+                    if "last" in q:
+                        try:
+                            last = int(q["last"][0])
+                        except ValueError:
+                            pass
+                    body = json.dumps(ep.debug_requests(last=last),
+                                      default=str, indent=2)
+                    self._send(200, body, "application/json")
+                elif url.path == "/healthz":
+                    self._send(200, "ok", "text/plain")
+                else:
+                    self._send(404, json.dumps(
+                        {"error": "not found", "routes":
+                         ["/metrics", "/debug/requests", "/healthz"]}),
+                        "application/json")
+
+        self._server = ThreadingHTTPServer((self._host, self._port), Handler)
+        self._server.daemon_threads = True
+        self._thread = threading.Thread(target=self._server.serve_forever,
+                                        name="obs-endpoint", daemon=True)
+        self._thread.start()
+        return self.address
+
+    @property
+    def address(self) -> Tuple[str, int]:
+        if self._server is None:
+            return (self._host, self._port)
+        host, port = self._server.server_address[:2]
+        return (host, port)
+
+    @property
+    def url(self) -> str:
+        host, port = self.address
+        return f"http://{host}:{port}"
+
+    def stop(self):
+        if self._server is not None:
+            self._server.shutdown()
+            self._server.server_close()
+            self._server = None
+            if self._thread is not None:
+                self._thread.join(timeout=5)
+                self._thread = None
+
+    def __enter__(self):
+        self.start()
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False

@@ -36,8 +36,68 @@ def sanitize_metric_name(name: str) -> str:
     return name
 
 
-class Counter:
-    """Monotonically increasing value."""
+def label_string(labels) -> str:
+    """Canonical ``k="v",k2="v2"`` rendering (keys sorted, values escaped)
+    — the exposition inside the braces and the snapshot-key suffix."""
+    parts = []
+    for k in sorted(labels):
+        v = str(labels[k]).replace("\\", r"\\").replace('"', r'\"')
+        parts.append(f'{sanitize_metric_name(str(k))}="{v}"')
+    return ",".join(parts)
+
+
+class _Labeled:
+    """Shared label-family machinery for Counter/Gauge.
+
+    ``metric.labels(phase="admission")`` returns a CHILD metric of the same
+    kind that shares the parent's family name and exposes as
+    ``name{phase="admission"}``. The unlabeled parent series is suppressed
+    from exposition once children exist (Prometheus convention: a labeled
+    family has no bare series) unless the parent itself was written to.
+    """
+
+    def _init_labels(self):
+        self._children: "OrderedDict[str, object]" = OrderedDict()
+        self._labels: Optional[Dict[str, str]] = None
+        self._touched = False
+
+    def labels(self, **labels):
+        if not labels:
+            return self
+        if self._labels is not None:
+            raise ValueError(
+                f"{self.name}: labels() on an already-labeled child")
+        key = label_string(labels)
+        with self._lock:
+            child = self._children.get(key)
+            if child is None:
+                child = type(self)(name=self.name,
+                                   description=self.description,
+                                   unit=self.unit)
+                child._labels = {str(k): str(v) for k, v in labels.items()}
+                self._children[key] = child
+            return child
+
+    def _expose_rows(self, kind):
+        rows = []
+        if self._touched or not self._children:
+            rows.append((kind, self.name, self._labels, self._value))
+        for child in self._children.values():
+            rows.append((kind, self.name, child._labels, child._value))
+        return rows
+
+    def _snapshot_items(self, full):
+        """(key, value) pairs for MetricsRegistry.snapshot()."""
+        items = []
+        if self._touched or not self._children:
+            items.append((full, self._value))
+        for key, child in self._children.items():
+            items.append((f"{full}{{{key}}}", child._value))
+        return items
+
+
+class Counter(_Labeled):
+    """Monotonically increasing value (optionally a labeled family)."""
 
     def __init__(self, name: str, description: str = "", unit: str = ""):
         self.name = name
@@ -45,23 +105,25 @@ class Counter:
         self.unit = unit
         self._value = 0.0
         self._lock = threading.Lock()
+        self._init_labels()
 
     def inc(self, n: float = 1.0):
         if n < 0:
             raise ValueError(f"counter {self.name} cannot decrease (inc {n})")
         with self._lock:
             self._value += n
+            self._touched = True
 
     @property
     def value(self) -> float:
         return self._value
 
     def expose(self):
-        return [("counter", self.name, None, self._value)]
+        return self._expose_rows("counter")
 
 
-class Gauge:
-    """Instantaneous value, settable up or down."""
+class Gauge(_Labeled):
+    """Instantaneous value, settable up or down (optionally labeled)."""
 
     def __init__(self, name: str, description: str = "", unit: str = ""):
         self.name = name
@@ -69,14 +131,17 @@ class Gauge:
         self.unit = unit
         self._value = 0.0
         self._lock = threading.Lock()
+        self._init_labels()
 
     def set(self, v: float):
         with self._lock:
             self._value = float(v)
+            self._touched = True
 
     def inc(self, n: float = 1.0):
         with self._lock:
             self._value += n
+            self._touched = True
 
     def dec(self, n: float = 1.0):
         self.inc(-n)
@@ -86,7 +151,7 @@ class Gauge:
         return self._value
 
     def expose(self):
-        return [("gauge", self.name, None, self._value)]
+        return self._expose_rows("gauge")
 
 
 class Histogram:
@@ -163,7 +228,7 @@ class Histogram:
         for q in (0.5, 0.9, 0.99):
             v = self.quantile(q)
             if v is not None:
-                rows.append(("summary", self.name, q, v))
+                rows.append(("summary", self.name, {"quantile": str(q)}, v))
         rows.append(("summary", f"{self.name}_sum", None, self.total))
         rows.append(("summary", f"{self.name}_count", None, self.count))
         return rows
@@ -229,19 +294,20 @@ class MetricsRegistry:
         self._metrics.pop(self._full_name(name), None)
 
     def snapshot(self) -> Dict[str, object]:
-        """One JSON-able dict: counters/gauges -> value, histograms ->
-        summary() digest."""
+        """One JSON-able dict: counters/gauges -> value (labeled children as
+        ``name{k="v"}`` keys), histograms -> summary() digest."""
         out = {}
         for full, m in self._metrics.items():
             if isinstance(m, Histogram):
                 out[full] = m.summary()
             else:
-                out[full] = m.value
+                out.update(m._snapshot_items(full))
         return out
 
     def prometheus_text(self) -> str:
         """Prometheus text-exposition format (0.0.4). Histograms are emitted
-        as ``summary`` families (quantile series + _sum/_count)."""
+        as ``summary`` families (quantile series + _sum/_count); labeled
+        Counter/Gauge families render one ``name{k="v"}`` line per child."""
         lines = []
         for full, m in self._metrics.items():
             rows = m.expose()
@@ -249,9 +315,9 @@ class MetricsRegistry:
             if m.description:
                 lines.append(f"# HELP {full} {m.description}")
             lines.append(f"# TYPE {full} {mtype}")
-            for _, name, quantile, value in rows:
-                if quantile is not None:
-                    lines.append(f'{name}{{quantile="{quantile}"}} '
+            for _, name, labels, value in rows:
+                if labels:
+                    lines.append(f"{name}{{{label_string(labels)}}} "
                                  f"{format_value(value)}")
                 else:
                     lines.append(f"{name} {format_value(value)}")
@@ -271,7 +337,9 @@ def parse_prometheus_text(text: str) -> Dict[str, dict]:
 
     Returns ``{family: {"type": t, "value": v}}`` for counters/gauges and
     ``{family: {"type": "summary", "quantiles": {q: v}, "sum": s,
-    "count": c}}`` for summaries.
+    "count": c}}`` for summaries. Labeled Counter/Gauge series land under
+    ``{family: {"series": {'k="v"': value}, "labeled": [(labels_dict, v)]}}``
+    — the round-trip face of ``Counter.labels()``/``Gauge.labels()``.
     """
     families: Dict[str, dict] = {}
     types: Dict[str, str] = {}
@@ -294,9 +362,17 @@ def parse_prometheus_text(text: str) -> Dict[str, dict]:
             name, _, labels = name_part.partition("{")
             labels = labels.rstrip("}")
             fam = families.setdefault(name, {"type": types.get(name)})
-            m = re.search(r'quantile="([^"]+)"', labels)
-            if m:
-                fam.setdefault("quantiles", {})[float(m.group(1))] = value
+            parsed = {k: v.replace(r"\"", '"').replace(r"\\", "\\")
+                      for k, v in
+                      re.findall(r'([a-zA-Z0-9_:]+)="((?:[^"\\]|\\.)*)"',
+                                 labels)}
+            if types.get(name) == "summary" and "quantile" in parsed:
+                fam.setdefault("quantiles", {})[
+                    float(parsed["quantile"])] = value
+            else:
+                fam.setdefault("series", {})[
+                    label_string(parsed)] = value
+                fam.setdefault("labeled", []).append((parsed, value))
             continue
         name = name_part
         if name.endswith("_sum") and types.get(name[:-4]) == "summary":

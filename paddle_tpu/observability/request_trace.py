@@ -1,0 +1,248 @@
+"""Per-request lifecycle tracing for the serving tier.
+
+Aggregate TTFT/TPOT histograms say a request WAS slow; this module says WHY.
+Every request admitted to the continuous-batching scheduler carries one
+``RequestTrace``: a gapless timeline of **top-level phases** —
+
+    queued -> admit -> running -> (preempted -> admit -> running)* -> done
+
+— whose durations partition ``[arrival, finish]`` exactly (each transition
+closes the old phase and opens the new one at the SAME timestamp, so the
+phase durations sum to the measured E2E latency by construction), plus
+**nested sub-spans** inside a phase (``prefix_match``, ``prefill``,
+``sampling_sync``) and **instant events** (per-token marks are deliberately
+NOT recorded — a 2k-token decode must not allocate 2k dicts; the running
+phase carries the token count instead).
+
+The ``RequestTracer`` owns the traces of one scheduler, keyed by
+``request_id`` (the correlation ID threaded through admission, prefix
+matching, decode, preemption and streaming), keeps a bounded ring of
+completed traces, and exports:
+
+- ``chrome_trace()`` — one Chrome ``traceEvents`` JSON where each request is
+  a *track* (tid = request id): load it next to the profiler's host-span
+  trace and the request timeline lines up with the scheduler iterations.
+- ``to_json()`` — plain per-request dicts (phase durations, sub-span
+  aggregates, counters) for artifacts and the ``/debug/requests`` endpoint.
+
+Disabled (``RequestTracer(enabled=False)``) every hook is a cheap early
+return and the scheduler's token stream is bit-identical either way —
+tracing observes the host timeline, never the model.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import OrderedDict
+from typing import Dict, List, Optional
+
+__all__ = [
+    "PHASE_ADMIT",
+    "PHASE_DONE",
+    "PHASE_PREEMPTED",
+    "PHASE_QUEUED",
+    "PHASE_RUNNING",
+    "RequestTrace",
+    "RequestTracer",
+]
+
+# top-level lifecycle phases (gapless partition of arrival..finish)
+PHASE_QUEUED = "queued"          # waiting for a slot (incl. re-queue waits)
+PHASE_ADMIT = "admit"            # prefix match + suffix prefill + packing
+PHASE_RUNNING = "running"        # in the decode slot grid
+PHASE_PREEMPTED = "preempted"    # evicted, waiting to resume
+PHASE_DONE = "done"              # terminal marker (zero-width)
+
+_PHASES = (PHASE_QUEUED, PHASE_ADMIT, PHASE_RUNNING, PHASE_PREEMPTED)
+
+
+class RequestTrace:
+    """One request's lifecycle timeline (host-side, perf_counter domain)."""
+
+    __slots__ = ("request_id", "phases", "subspans", "events", "meta",
+                 "_cur_phase", "_cur_t0", "arrival_t", "finish_t")
+
+    def __init__(self, request_id: int, t: Optional[float] = None, **meta):
+        t = time.perf_counter() if t is None else t
+        self.request_id = request_id
+        self.arrival_t = t
+        self.finish_t: Optional[float] = None
+        # list of (phase, t0, t1) closed segments, in time order
+        self.phases: List[tuple] = []
+        # name -> [count, total_s] aggregated nested sub-spans
+        self.subspans: Dict[str, list] = {}
+        # small instant events: (name, t, meta)
+        self.events: List[tuple] = []
+        self.meta: Dict[str, object] = dict(meta)
+        self._cur_phase = PHASE_QUEUED
+        self._cur_t0 = t
+
+    # ------------------------------------------------------------ writing
+    def transition(self, phase: str, t: Optional[float] = None):
+        """Close the current top-level phase and open ``phase`` at the same
+        instant — the invariant that makes phase durations sum to E2E."""
+        t = time.perf_counter() if t is None else t
+        self.phases.append((self._cur_phase, self._cur_t0, t))
+        self._cur_phase = phase
+        self._cur_t0 = t
+        if phase == PHASE_DONE:
+            self.finish_t = t
+
+    def subspan(self, name: str, seconds: float):
+        """Aggregate one nested sub-span (lives INSIDE a top-level phase;
+        excluded from the E2E partition)."""
+        agg = self.subspans.get(name)
+        if agg is None:
+            self.subspans[name] = [1, float(seconds)]
+        else:
+            agg[0] += 1
+            agg[1] += float(seconds)
+
+    def event(self, name: str, t: Optional[float] = None, **meta):
+        self.events.append((name, time.perf_counter() if t is None else t,
+                            meta))
+
+    def note(self, **meta):
+        self.meta.update(meta)
+
+    # ------------------------------------------------------------ reading
+    @property
+    def current_phase(self) -> str:
+        return self._cur_phase
+
+    def e2e_s(self) -> Optional[float]:
+        if self.finish_t is None:
+            return None
+        return self.finish_t - self.arrival_t
+
+    def phase_durations(self) -> Dict[str, float]:
+        """Total seconds per top-level phase. For a finished trace these sum
+        to ``e2e_s()`` exactly (same-timestamp transitions, no gaps)."""
+        out: Dict[str, float] = {}
+        for phase, t0, t1 in self.phases:
+            if phase == PHASE_DONE:
+                continue
+            out[phase] = out.get(phase, 0.0) + (t1 - t0)
+        return out
+
+    def phase_count(self, phase: str) -> int:
+        return sum(1 for p, _, _ in self.phases if p == phase)
+
+    def to_dict(self) -> Dict[str, object]:
+        d = self.phase_durations()
+        return {
+            "request_id": self.request_id,
+            "arrival_t": self.arrival_t,
+            "finish_t": self.finish_t,
+            "e2e_s": self.e2e_s(),
+            "phase": self._cur_phase,
+            "phases": [{"phase": p, "t0": t0, "dur_s": t1 - t0}
+                       for p, t0, t1 in self.phases if p != PHASE_DONE],
+            "phase_totals_s": d,
+            "subspans": {n: {"calls": c, "total_s": s}
+                         for n, (c, s) in self.subspans.items()},
+            "events": [{"name": n, "t": t, **m} for n, t, m in self.events],
+            **self.meta,
+        }
+
+
+class RequestTracer:
+    """Correlation-ID span store for one scheduler instance.
+
+    Live traces are keyed by request id; finished traces move into a bounded
+    ring (``max_completed``) so a long-running server's tracer stays O(ring),
+    not O(requests served)."""
+
+    def __init__(self, enabled: bool = True, max_completed: int = 256):
+        self.enabled = bool(enabled)
+        self.max_completed = int(max_completed)
+        self._live: "OrderedDict[int, RequestTrace]" = OrderedDict()
+        self._done: "OrderedDict[int, RequestTrace]" = OrderedDict()
+        self._lock = threading.Lock()
+        self._epoch = time.perf_counter()
+
+    # ------------------------------------------------------------ lifecycle
+    def start(self, request_id: int, t: Optional[float] = None,
+              **meta) -> Optional[RequestTrace]:
+        if not self.enabled:
+            return None
+        tr = RequestTrace(request_id, t=t, **meta)
+        with self._lock:
+            self._live[request_id] = tr
+        return tr
+
+    def get(self, request_id: int) -> Optional[RequestTrace]:
+        if not self.enabled:
+            return None
+        return self._live.get(request_id) or self._done.get(request_id)
+
+    def finish(self, request_id: int, t: Optional[float] = None):
+        """Terminal transition + move to the completed ring."""
+        if not self.enabled:
+            return
+        with self._lock:
+            tr = self._live.pop(request_id, None)
+            if tr is None:
+                return
+            tr.transition(PHASE_DONE, t)
+            self._done[request_id] = tr
+            while len(self._done) > self.max_completed:
+                self._done.popitem(last=False)
+
+    # -------------------------------------------------------------- reading
+    def live(self) -> List[RequestTrace]:
+        with self._lock:
+            return list(self._live.values())
+
+    def completed(self) -> List[RequestTrace]:
+        with self._lock:
+            return list(self._done.values())
+
+    def to_json(self, include_live: bool = True) -> List[Dict[str, object]]:
+        out = [t.to_dict() for t in self.completed()]
+        if include_live:
+            out += [t.to_dict() for t in self.live()]
+        return out
+
+    # synthetic pid for the request tracks (a chrome trace wants integer
+    # pids; the name metadata labels it "serving requests" in the viewer)
+    _PID = 1
+
+    def chrome_trace(self) -> Dict[str, object]:
+        """Chrome ``traceEvents`` with one track per request (tid=request
+        id under a synthetic "serving requests" process): complete ("X")
+        events for every closed phase, instant ("i") events for the rest.
+        Timestamps are microseconds since the tracer's epoch, the same
+        domain as one process's profiler spans."""
+        pid = self._PID
+        ev: List[dict] = [{"name": "process_name", "ph": "M", "pid": pid,
+                           "tid": 0, "args": {"name": "serving requests"}}]
+        e0 = self._epoch
+        for tr in self.completed() + self.live():
+            tid = int(tr.request_id)
+            ev.append({"name": "thread_name", "ph": "M", "pid": pid,
+                       "tid": tid,
+                       "args": {"name": f"request {tr.request_id}"}})
+            for phase, t0, t1 in tr.phases:
+                if phase == PHASE_DONE:
+                    continue
+                ev.append({
+                    "name": f"req.{phase}", "cat": "request", "ph": "X",
+                    "pid": pid, "tid": tid,
+                    "ts": (t0 - e0) * 1e6, "dur": (t1 - t0) * 1e6,
+                    "args": {"request_id": tr.request_id},
+                })
+            for name, t, meta in tr.events:
+                ev.append({"name": f"req.{name}", "cat": "request",
+                           "ph": "i", "s": "t", "pid": pid, "tid": tid,
+                           "ts": (t - e0) * 1e6,
+                           "args": {"request_id": tr.request_id, **meta}})
+        return {"traceEvents": ev}
+
+    def export_chrome_trace(self, path: str) -> str:
+        import json
+
+        with open(path, "w") as f:
+            json.dump(self.chrome_trace(), f)
+        return path

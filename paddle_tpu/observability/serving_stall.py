@@ -1,0 +1,210 @@
+"""Serving hot-path host-stall attribution + per-step flight recorder.
+
+The serving mirror of ``train_stall.py``: ROADMAP item 4 (the async
+zero-bubble serving engine) removes host-side scheduling work from the
+critical path between device decode steps — this module ships the
+MEASUREMENT first, so that refactor's win is provable rather than asserted.
+
+- ``serving_host_stall_seconds{phase=...}`` — one labeled counter family
+  (the first user of ``Counter.labels``) attributing every second the
+  scheduler's ``step()`` spends on host work to a phase:
+
+    * ``admission``       queue pops, request setup, slot packing
+    * ``radix_match``     prefix-cache matching + pin bookkeeping
+    * ``block_accounting``KV block alloc/extend/COW/preempt table rewrites
+    * ``streaming``       per-token emit + user ``on_token`` callbacks
+    * ``sampling_sync``   blocking ``.numpy()`` reads of sampled tokens —
+                          the host<->device serialization the async engine
+                          will overlap
+
+- ``FlightRecorder`` — a bounded ring of per-step records (slot occupancy,
+  prefill/decode token split, preemptions, cache hits, queue depth, free
+  blocks): the last-N-iterations picture you dump when something is already
+  wrong, on demand (``/debug/requests``) or on alarm.
+
+- Alarms, RecompileStorm-style (loud warnings, not log lines):
+  ``TTFTBreachStorm`` when ``streak`` consecutive finished requests breach
+  the TTFT SLO, ``EvictionThrash`` when the prefix cache evicts in most of
+  the recent steps (admissions and evictions are fighting over the pool).
+  Both capture a flight-recorder dump at alarm time (``last_alarm_dump``).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import warnings
+from collections import deque
+from contextlib import contextmanager
+from typing import Dict, List, Optional
+
+from paddle_tpu.observability.metrics import MetricsRegistry, get_registry
+
+__all__ = [
+    "AlarmMonitors",
+    "EvictionThrash",
+    "FlightRecorder",
+    "STALL_PHASES",
+    "ServingStall",
+    "TTFTBreachStorm",
+]
+
+STALL_PHASES = ("admission", "radix_match", "block_accounting", "streaming",
+                "sampling_sync")
+
+_STALL = "host_stall_seconds"
+
+
+class TTFTBreachStorm(UserWarning):
+    """Consecutive requests finished over the TTFT SLO target."""
+
+
+class EvictionThrash(UserWarning):
+    """The prefix cache is evicting on most recent steps (pool thrash)."""
+
+
+class ServingStall:
+    """Phase-attributed host-stall accounting over one registry.
+
+    ``registry=None`` records into the process-wide default registry under
+    the full name ``serving_host_stall_seconds``; a scheduler passes its own
+    ``serving``-namespaced ServingMetrics registry so the breakdown rides
+    that instance's snapshot/prometheus surface instead.
+    """
+
+    def __init__(self, registry: Optional[MetricsRegistry] = None):
+        if registry is None:
+            registry = get_registry()
+            name = f"serving_{_STALL}"
+        else:
+            # a serving-namespaced registry already prefixes "serving_"
+            name = _STALL if registry.namespace else f"serving_{_STALL}"
+        self._family = registry.counter(
+            name, "seconds of host-side scheduling work on the serving "
+                  "critical path, by phase", unit="s")
+        self._phase = {p: self._family.labels(phase=p)
+                       for p in STALL_PHASES}
+
+    def record(self, phase: str, seconds: float):
+        c = self._phase.get(phase)
+        if c is None:
+            raise KeyError(f"unknown serving stall phase {phase!r} "
+                           f"(known: {STALL_PHASES})")
+        c.inc(max(float(seconds), 0.0))
+
+    @contextmanager
+    def timed(self, phase: str):
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.record(phase, time.perf_counter() - t0)
+
+    def seconds(self, phase: str) -> float:
+        return self._phase[phase].value
+
+    def total(self) -> float:
+        return sum(c.value for c in self._phase.values())
+
+    def snapshot(self) -> Dict[str, float]:
+        out = {p: self._phase[p].value for p in STALL_PHASES}
+        out["total"] = self.total()
+        return out
+
+
+class FlightRecorder:
+    """Bounded ring of per-step scheduler records, dumpable on demand.
+
+    One ``record_step(**fields)`` per scheduler iteration; the ring holds
+    the last ``max_steps``. ``dump()`` returns a JSON-able list (oldest
+    first). Alarm hooks snapshot the ring into ``last_alarm_dump`` so the
+    iterations AROUND the incident survive even after the ring rolls on.
+    """
+
+    def __init__(self, max_steps: int = 256):
+        self.max_steps = int(max_steps)
+        self._ring: deque = deque(maxlen=self.max_steps)
+        self._lock = threading.Lock()
+        self._step = 0
+        self.last_alarm_dump: Optional[Dict[str, object]] = None
+
+    def record_step(self, **fields):
+        with self._lock:
+            self._step += 1
+            fields["step"] = self._step
+            fields["t"] = time.perf_counter()
+            self._ring.append(fields)
+
+    def __len__(self) -> int:
+        return len(self._ring)
+
+    @property
+    def steps_recorded(self) -> int:
+        return self._step
+
+    def dump(self, last: Optional[int] = None) -> List[dict]:
+        with self._lock:
+            rows = list(self._ring)
+        return rows[-last:] if last else rows
+
+    def alarm(self, kind: str, reason: str):
+        """Freeze the ring around an incident (called by alarm monitors)."""
+        self.last_alarm_dump = {
+            "kind": kind, "reason": reason, "t": time.perf_counter(),
+            "steps": self.dump(),
+        }
+
+
+class AlarmMonitors:
+    """TTFT-breach-storm and eviction-thrash detectors over scheduler
+    signals; owned by the scheduler, firing loud warnings + flight dumps."""
+
+    def __init__(self, flight: Optional[FlightRecorder] = None, *,
+                 ttft_streak: int = 4, thrash_window: int = 32,
+                 thrash_frac: float = 0.5):
+        self.flight = flight
+        self.ttft_streak = int(ttft_streak)
+        self._breach_run = 0
+        self._storm_fired = False
+        self.thrash_window = int(thrash_window)
+        self.thrash_frac = float(thrash_frac)
+        self._evict_steps: deque = deque(maxlen=self.thrash_window)
+        self._thrash_fired = False
+
+    # ---- TTFT breach storm --------------------------------------------
+    def observe_ttft(self, breached: bool, ttft_s, target_s):
+        if not breached:
+            self._breach_run = 0
+            self._storm_fired = False
+            return
+        self._breach_run += 1
+        if self._breach_run >= self.ttft_streak and not self._storm_fired:
+            self._storm_fired = True
+            reason = (f"{self._breach_run} consecutive requests breached "
+                      f"the TTFT SLO ({ttft_s:.3f}s latest vs "
+                      f"{target_s:.3f}s target)")
+            if self.flight is not None:
+                self.flight.alarm("ttft_breach_storm", reason)
+            warnings.warn(TTFTBreachStorm(
+                f"TTFT breach storm: {reason} — inspect the flight-recorder "
+                f"dump (queue depth vs prefill head-of-line vs preemption)"),
+                stacklevel=3)
+
+    # ---- eviction thrash ----------------------------------------------
+    def observe_evictions(self, evicted_blocks_this_step: int):
+        self._evict_steps.append(1 if evicted_blocks_this_step > 0 else 0)
+        if len(self._evict_steps) < self.thrash_window:
+            return
+        frac = sum(self._evict_steps) / len(self._evict_steps)
+        if frac >= self.thrash_frac and not self._thrash_fired:
+            self._thrash_fired = True
+            reason = (f"prefix cache evicted blocks in {frac:.0%} of the "
+                      f"last {len(self._evict_steps)} steps")
+            if self.flight is not None:
+                self.flight.alarm("eviction_thrash", reason)
+            warnings.warn(EvictionThrash(
+                f"eviction thrash: {reason} — the KV pool is too small for "
+                f"the working set; admissions and cached prefixes are "
+                f"fighting over blocks"), stacklevel=3)
+        elif frac < self.thrash_frac:
+            self._thrash_fired = False

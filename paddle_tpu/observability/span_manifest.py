@@ -1,0 +1,50 @@
+"""Registry of every host trace span the framework emits.
+
+Each literal ``RecordEvent("<name>")`` under ``paddle_tpu/`` must have an
+entry here carrying an **owner** (the subsystem answerable for the span —
+where a profiler regression gets routed) and a **category** (the
+``TracerEventType``-style grouping ``Profiler.summary()`` renders). The
+``tools/check_spans.py`` lint (a tier-1 test) enforces both directions:
+an emitted span missing from the manifest fails, and a manifest entry no
+span emits anymore fails — the manifest can neither lag nor rot.
+
+Call sites that build the span name at runtime (e.g. the eager collectives'
+``comm.<op>``) register their FILE + name prefix in ``DYNAMIC_SPANS``; the
+lint requires every non-literal ``RecordEvent(...)`` call site to appear
+there, so dynamic names stay deliberate rather than accidental.
+"""
+
+from __future__ import annotations
+
+# span name -> {owner, category}; categories match the TracerEventType
+# grouping the profiler renders (UserDefined spans sit in the main table).
+SPAN_MANIFEST = {
+    # checkpoint subsystem
+    "checkpoint.snapshot": {"owner": "checkpoint", "category": "UserDefined"},
+    "checkpoint.write": {"owner": "checkpoint", "category": "UserDefined"},
+    "checkpoint.commit": {"owner": "checkpoint", "category": "UserDefined"},
+    "checkpoint.restore": {"owner": "checkpoint", "category": "UserDefined"},
+    # data pipeline
+    "dataloader.next": {"owner": "io", "category": "Dataloader"},
+    "train.prefetch": {"owner": "io", "category": "Dataloader"},
+    # training hot path
+    "train.step": {"owner": "jit", "category": "ProfileStep"},
+    "optimizer.step": {"owner": "optimizer", "category": "Optimization"},
+    "offload.prefetch": {"owner": "distributed", "category": "UserDefined"},
+    # eager generation
+    "generation.prefill": {"owner": "models", "category": "Forward"},
+    "generation.decode_step": {"owner": "models", "category": "Forward"},
+    # serving scheduler
+    "serving.prefill": {"owner": "serving", "category": "Forward"},
+    "serving.decode_step": {"owner": "serving", "category": "Forward"},
+    "serving.preempt": {"owner": "serving", "category": "UserDefined"},
+    "serving.prefix_match": {"owner": "serving", "category": "UserDefined"},
+    "serving.reload_weights": {"owner": "serving",
+                               "category": "UserDefined"},
+}
+
+# file (repo-relative, /-separated) -> name prefix of its runtime-built
+# spans. One entry per non-literal RecordEvent(...) call site.
+DYNAMIC_SPANS = {
+    "paddle_tpu/distributed/collective.py": "comm.",
+}

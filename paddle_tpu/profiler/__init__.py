@@ -301,12 +301,16 @@ class Profiler:
         return report
 
     def export_report(self, path: Optional[str] = None, *,
-                      include_metrics: bool = True, registries=None):
+                      include_metrics: bool = True, registries=None,
+                      request_tracers=None):
         """One merged observability artifact: host spans (per name AND per
         category), step times, metric snapshots (the process-wide registry
         plus any extra registries, e.g. a scheduler's ServingMetrics), and
-        the CompileTracker's per-function compile accounting. Written as
-        JSON when ``path`` is given; always returned as a dict."""
+        the CompileTracker's per-function compile accounting. Pass the
+        serving scheduler's ``RequestTracer``(s) via ``request_tracers`` to
+        fold per-request lifecycle timelines (phase durations, sub-spans)
+        into the same artifact. Written as JSON when ``path`` is given;
+        always returned as a dict."""
         stats = self._event_stats()
         by_cat = {}
         for name, s in stats.items():
@@ -317,6 +321,8 @@ class Profiler:
             "categories": by_cat,
             "step_times_s": list(self._step_times),
         }
+        if request_tracers:
+            report["request_traces"] = [t.to_json() for t in request_tracers]
         if include_metrics:
             from paddle_tpu.observability import (
                 get_compile_tracker,

@@ -7,11 +7,17 @@ an iteration-level (Orca-style) scheduler over a fixed-shape slot grid so
 the decode step never recompiles, a vLLM-style paged KV pool with
 preemption-on-exhaustion, automatic prefix caching (radix-tree KV reuse —
 see ``prefix_cache/``), per-token streaming, and a serving metrics
-registry (TTFT/TPOT, tokens/s, KV utilization, prefix hit rate).
+registry (TTFT/TPOT, tokens/s, KV utilization, prefix hit rate), plus
+full request-lifecycle observability: per-request trace spans keyed by
+``request_id``, ``serving_host_stall_seconds{phase=...}`` attribution,
+SLO/goodput accounting, a per-step flight recorder, and a live
+``/metrics`` + ``/debug/requests`` endpoint (``sched.start_endpoint()``).
 
     queue → scheduler → slot grid → paged KV pool
                  │
-                 └── ServingMetrics / profiler spans
+                 ├── ServingMetrics / profiler spans / SLO + goodput
+                 └── RequestTracer / ServingStall / FlightRecorder
+                       └── ObservabilityEndpoint (/metrics, /debug/requests)
 
 Typical use::
 

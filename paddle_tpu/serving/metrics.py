@@ -11,6 +11,15 @@ scheduler uses (``metrics.preemptions += 1``) is preserved through
 properties over the registry metrics. Host spans for prefill/decode/preempt
 ride ``paddle_tpu.profiler.RecordEvent`` from the scheduler, so a
 ``Profiler`` run shows serving line items.
+
+SLO / goodput accounting (``configure_slo``): configurable TTFT/TPOT
+targets become ``slo_*_target_seconds`` gauges, every finished request is
+judged against them, breaches count into the labeled
+``slo_breach_total{kind=...,cause=...}`` family — the CAUSE attributed from
+the request's lifecycle trace (queue wait vs prefill vs preemption), which
+is the whole point: an SLO page that already says why — and the goodput
+gauge tracks the fraction of generated tokens that belong to SLO-compliant
+requests (the DistServe/vLLM "goodput, not throughput" serving yardstick).
 """
 
 from __future__ import annotations
@@ -21,6 +30,11 @@ from typing import Dict, Optional
 from paddle_tpu.observability.metrics import (  # noqa: F401 (re-export)
     Histogram,
     MetricsRegistry,
+)
+from paddle_tpu.observability.request_trace import (
+    PHASE_ADMIT,
+    PHASE_PREEMPTED,
+    PHASE_QUEUED,
 )
 
 _COUNTERS = (
@@ -51,7 +65,9 @@ class ServingMetrics:
     into one exposition surface (their counters then merge).
     """
 
-    def __init__(self, registry: Optional[MetricsRegistry] = None):
+    def __init__(self, registry: Optional[MetricsRegistry] = None,
+                 ttft_slo_s: Optional[float] = None,
+                 tpot_slo_s: Optional[float] = None):
         self.t_start = time.perf_counter()
         self._registry = (MetricsRegistry(namespace="serving")
                           if registry is None else registry)
@@ -65,6 +81,106 @@ class ServingMetrics:
             "tpot_seconds", "time per output token", unit="s")
         self.step_time = self._registry.histogram(
             "step_time_seconds", "scheduler iteration wall time", unit="s")
+        self.ttft_slo_s: Optional[float] = None
+        self.tpot_slo_s: Optional[float] = None
+        self._slo_breach = None
+        if ttft_slo_s is not None or tpot_slo_s is not None:
+            self.configure_slo(ttft_slo_s, tpot_slo_s)
+
+    # ---- SLO / goodput -------------------------------------------------
+    def configure_slo(self, ttft_slo_s: Optional[float] = None,
+                      tpot_slo_s: Optional[float] = None):
+        """Arm SLO accounting: every finished request is judged against the
+        targets; breaches count by (kind, attributed cause) and goodput
+        tracks the token fraction within SLO."""
+        self.ttft_slo_s = ttft_slo_s
+        self.tpot_slo_s = tpot_slo_s
+        reg = self._registry
+        if ttft_slo_s is not None:
+            reg.gauge("slo_ttft_target_seconds",
+                      "configured TTFT SLO target", unit="s").set(ttft_slo_s)
+        if tpot_slo_s is not None:
+            reg.gauge("slo_tpot_target_seconds",
+                      "configured TPOT SLO target", unit="s").set(tpot_slo_s)
+        self._slo_breach = reg.counter(
+            "slo_breach_total",
+            "finished requests over an SLO target, by kind and attributed "
+            "cause")
+        self._good_tokens = reg.counter(
+            "goodput_tokens_total",
+            "generated tokens of requests that met every configured SLO")
+        self._judged_tokens = reg.counter(
+            "slo_judged_tokens_total",
+            "generated tokens of finished requests judged against the SLO")
+        self._goodput = reg.gauge(
+            "goodput_ratio",
+            "goodput_tokens_total / slo_judged_tokens_total")
+
+    @staticmethod
+    def _ttft_cause(trace) -> str:
+        """Dominant pre-first-token phase: the first token is sampled at the
+        end of the first admit (prefill) phase, so TTFT splits into queue
+        wait vs admission/prefill work."""
+        if trace is None:
+            return "unattributed"
+        queued = admit = 0.0
+        for phase, t0, t1 in trace.phases:
+            if phase == PHASE_QUEUED:
+                queued += t1 - t0
+            elif phase == PHASE_ADMIT:
+                admit += t1 - t0
+                break                     # first token lands here
+        return "queue_wait" if queued >= admit else "prefill"
+
+    @staticmethod
+    def _tpot_cause(trace, req) -> str:
+        if getattr(req, "num_preemptions", 0) > 0 or (
+                trace is not None
+                and any(p == PHASE_PREEMPTED for p, _, _ in trace.phases)):
+            return "preemption"
+        return "decode"
+
+    def observe_slo(self, req, out, trace=None) -> Dict[str, object]:
+        """Judge one finished request; returns the verdict the scheduler
+        feeds into its alarm monitors."""
+        verdict = {"ttft_breach": False, "tpot_breach": False,
+                   "ttft_s": out.ttft_s, "tpot_s": out.tpot_s}
+        if self._slo_breach is None:
+            return verdict
+        if (self.ttft_slo_s is not None and out.ttft_s is not None
+                and out.ttft_s > self.ttft_slo_s):
+            verdict["ttft_breach"] = True
+            verdict["ttft_cause"] = self._ttft_cause(trace)
+            self._slo_breach.labels(kind="ttft",
+                                    cause=verdict["ttft_cause"]).inc()
+        if (self.tpot_slo_s is not None and out.tpot_s is not None
+                and out.tpot_s > self.tpot_slo_s):
+            verdict["tpot_breach"] = True
+            verdict["tpot_cause"] = self._tpot_cause(trace, req)
+            self._slo_breach.labels(kind="tpot",
+                                    cause=verdict["tpot_cause"]).inc()
+        tokens = len(out.generated_ids)
+        self._judged_tokens.inc(tokens)
+        if not (verdict["ttft_breach"] or verdict["tpot_breach"]):
+            self._good_tokens.inc(tokens)
+        judged = self._judged_tokens.value
+        self._goodput.set(self._good_tokens.value / judged if judged else 1.0)
+        return verdict
+
+    def slo_snapshot(self) -> Dict[str, object]:
+        if self._slo_breach is None:
+            return {"configured": False}
+        breaches = {key: child.value
+                    for key, child in self._slo_breach._children.items()}
+        return {
+            "configured": True,
+            "ttft_slo_s": self.ttft_slo_s,
+            "tpot_slo_s": self.tpot_slo_s,
+            "goodput_ratio": round(self._goodput.value, 4),
+            "goodput_tokens": int(self._good_tokens.value),
+            "judged_tokens": int(self._judged_tokens.value),
+            "breaches": breaches,
+        }
 
     @property
     def registry(self) -> MetricsRegistry:
@@ -84,14 +200,16 @@ class ServingMetrics:
         self.kv_utilization = allocator.utilization()
         self.kv_fragmentation = allocator.fragmentation(live_tokens)
 
-    def observe_finish(self, req):
-        """Fold one finished request's latency profile in."""
+    def observe_finish(self, req, trace=None) -> Dict[str, object]:
+        """Fold one finished request's latency profile in; returns the SLO
+        verdict (breach flags + attributed causes) for the alarm monitors."""
         self.requests_finished += 1
         out = req.output()
         if out.ttft_s is not None:
             self.ttft.record(out.ttft_s)
         if out.tpot_s is not None:
             self.tpot.record(out.tpot_s)
+        return self.observe_slo(req, out, trace=trace)
 
     # ---- reading -------------------------------------------------------
     def tokens_per_s(self) -> float:
@@ -118,6 +236,7 @@ class ServingMetrics:
             "ttft_s": self.ttft.summary(),
             "tpot_s": self.tpot.summary(),
             "step_time_s": self.step_time.summary(),
+            "slo": self.slo_snapshot(),
         }
 
 

@@ -60,6 +60,7 @@ class PrefixCache:
         self._hit_tokens = 0
         self._miss_tokens = 0
         self._evicted_blocks = 0
+        self._evict_listener = None
         self._reg = registry
         if registry is not None:
             self._c_hit = registry.counter(
@@ -132,7 +133,14 @@ class PrefixCache:
         if self._reg is not None and released:
             self._c_evicted.inc(len(released))
             self._g_cached.set(len(self.tree))
+        if self._evict_listener is not None and released:
+            self._evict_listener(len(released))
         return len(released)
+
+    def set_evict_listener(self, cb):
+        """``cb(n_blocks)`` on every pressure eviction — the scheduler's
+        flight recorder and eviction-thrash alarm subscribe here."""
+        self._evict_listener = cb
 
     def flush(self) -> int:
         """Drop the whole tree (weight hot-swap). Blocks still pinned by

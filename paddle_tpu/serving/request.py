@@ -52,6 +52,15 @@ class SchedulerConfig:
     enable_preemption: bool = True
     enable_prefix_caching: bool = False   # radix-tree KV reuse across requests
     prefill_bucket: int = 16          # smallest prefill width bucket
+    # ---- observability (request-lifecycle tracing, SLO, flight recorder).
+    # Tracing is host-side bookkeeping only: the token stream is identical
+    # on vs off (pinned in tests) and the overhead is held <5%.
+    enable_request_tracing: bool = True
+    trace_ring: int = 256             # completed RequestTraces retained
+    flight_recorder_steps: int = 256  # per-step ring buffer depth
+    ttft_slo_s: Optional[float] = None    # None = SLO accounting off
+    tpot_slo_s: Optional[float] = None
+    ttft_breach_streak: int = 4       # consecutive breaches -> alarm
 
     @property
     def max_blocks_per_seq(self) -> int:

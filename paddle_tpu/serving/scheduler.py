@@ -17,6 +17,13 @@ Orca-style scheduling on a vLLM-style paged KV pool, TPU-first:
 - Every generated token streams to the request's ``on_token`` callback the
   iteration it is sampled; TTFT/TPOT are stamped per request and fold into
   ``ServingMetrics``.
+- Request-lifecycle observability rides the same loop: a ``RequestTracer``
+  keys linked phase spans off ``request_id`` (queued → admit → running →
+  preempted/resumed → done), every second of host-side scheduling work is
+  attributed to ``serving_host_stall_seconds{phase=...}``, a per-step
+  flight recorder keeps the last-N-iterations picture, SLO targets turn
+  into goodput/breach accounting, and ``start_endpoint()`` serves it all
+  over ``/metrics`` + ``/debug/requests``.
 """
 
 from __future__ import annotations
@@ -33,6 +40,17 @@ from paddle_tpu.models.kv_cache import (
     PagedCacheSlot,
 )
 from paddle_tpu.models.serving import SlotStep, _bucket
+from paddle_tpu.observability.request_trace import (
+    PHASE_ADMIT,
+    PHASE_PREEMPTED,
+    PHASE_RUNNING,
+    RequestTracer,
+)
+from paddle_tpu.observability.serving_stall import (
+    AlarmMonitors,
+    FlightRecorder,
+    ServingStall,
+)
 from paddle_tpu.profiler import RecordEvent
 from paddle_tpu.serving.metrics import ServingMetrics
 from paddle_tpu.serving.prefix_cache import (
@@ -101,6 +119,21 @@ class ContinuousBatchingScheduler:
         self._next_rid = 0
         self._finished: Dict[int, RequestOutput] = {}
         self._events: List[tuple] = []   # (rid, token) stream buffer
+        # ---- request-lifecycle observability ---------------------------
+        # request_id is the correlation ID threaded through every layer:
+        # the tracer's lifecycle spans, the stall breakdown, the flight
+        # recorder, and SLO breach attribution all key off it.
+        self.tracer = RequestTracer(enabled=cfg.enable_request_tracing,
+                                    max_completed=cfg.trace_ring)
+        self.stall = ServingStall(self.metrics.registry)
+        self.flight = FlightRecorder(cfg.flight_recorder_steps)
+        self._alarms = AlarmMonitors(self.flight,
+                                     ttft_streak=cfg.ttft_breach_streak)
+        if cfg.ttft_slo_s is not None or cfg.tpot_slo_s is not None:
+            self.metrics.configure_slo(cfg.ttft_slo_s, cfg.tpot_slo_s)
+        self._step_evicted = 0           # eviction-thrash signal, per step
+        if self.prefix_cache is not None:
+            self.prefix_cache.set_evict_listener(self._on_evicted_blocks)
 
     # ---- admission -----------------------------------------------------
 
@@ -132,7 +165,14 @@ class ContinuousBatchingScheduler:
             self.metrics.requests_rejected += 1
             raise
         self.metrics.requests_received += 1
+        # trace timeline anchored at the request's own arrival stamp so
+        # phase durations and TTFT/E2E share one clock origin
+        self.tracer.start(rid, t=req.arrival_t, prompt_tokens=len(ids),
+                          priority=priority)
         return rid
+
+    def _on_evicted_blocks(self, n: int):
+        self._step_evicted += n
 
     # ---- internals -----------------------------------------------------
 
@@ -176,7 +216,19 @@ class ContinuousBatchingScheduler:
         self._table[slot] = -1
         self._pos[slot] = 0
         self._next_tok[slot] = 0
-        self.metrics.observe_finish(req)
+        trace = self.tracer.get(req.request_id)
+        if trace is not None:
+            trace.note(finish_reason=reason,
+                       generated_tokens=req.num_generated,
+                       num_preemptions=req.num_preemptions)
+        # close the trace at the request's finish stamp BEFORE judging SLO
+        # — breach-cause attribution reads the completed phase timeline
+        self.tracer.finish(req.request_id, t=req.finish_t)
+        verdict = self.metrics.observe_finish(req, trace=trace)
+        if self.metrics.ttft_slo_s is not None:
+            self._alarms.observe_ttft(verdict["ttft_breach"],
+                                      verdict["ttft_s"],
+                                      self.metrics.ttft_slo_s)
         self._finished[req.request_id] = req.output()
         return req
 
@@ -209,6 +261,11 @@ class ContinuousBatchingScheduler:
             # own admission control — it was already admitted once
             self.queue.push(req, force=True)
         self.metrics.preemptions += 1
+        trace = self.tracer.get(req.request_id)
+        if trace is not None:
+            trace.transition(PHASE_PREEMPTED)
+            trace.event("preempt", slot=slot,
+                        generated_tokens=req.num_generated)
 
     def _ensure_decode_capacity(self, slot: int) -> bool:
         """Guarantee the slot can write one more token; preempt other
@@ -241,10 +298,22 @@ class ContinuousBatchingScheduler:
         prefill buckets serve hits and misses). A full-prompt hit keeps one
         token to recompute (the last prompt token produces the first sampled
         logit), which partially rewrites the final shared block — that block
-        is forked copy-on-write before the write."""
+        is forked copy-on-write before the write.
+
+        Host-stall attribution: each admission's host time is split into
+        ``radix_match`` (tree match + pin), ``block_accounting`` (alloc +
+        COW + table row), ``sampling_sync`` (the blocking read of the first
+        sampled token), ``streaming`` (emit + callback) and ``admission``
+        (everything else: queue pop, request setup, packing, retire
+        bookkeeping). Prefill device dispatch is excluded — it is compute,
+        not host scheduling; it shows up as the request's ``prefill``
+        sub-span instead."""
         finished = []
         bs = self.config.block_size
+        pc = _time.perf_counter
         while len(self.queue):
+            it_t0 = pc()
+            radix_s = block_s = sync_s = stream_s = prefill_s = 0.0
             slot = next((s for s, r in enumerate(self._slots) if r is None),
                         None)
             if slot is None:
@@ -255,13 +324,16 @@ class ContinuousBatchingScheduler:
             hit_blocks: List[int] = []
             matched = 0
             if self.prefix_cache is not None:
+                t0 = pc()
                 with RecordEvent("serving.prefix_match"):
                     hit_blocks = self.prefix_cache.match_and_pin(ids)
                 matched = min(len(hit_blocks) * bs, P - 1)
+                radix_s = pc() - t0
             # full-prompt hit ⇒ the last shared block gets partially
             # rewritten (the one recomputed token) ⇒ fork it first
             cow = matched < len(hit_blocks) * bs
             need_blocks = -(-P // bs) - len(hit_blocks) + (1 if cow else 0)
+            t0 = pc()
             try:
                 fresh = (self.allocator.allocate(need_blocks * bs)
                          if need_blocks > 0 else [])
@@ -269,7 +341,15 @@ class ContinuousBatchingScheduler:
                 if hit_blocks:
                     self.prefix_cache.unpin(hit_blocks)
                 break                        # running seqs keep precedence
+            block_s += pc() - t0
             req = self.queue.pop()
+            trace = self.tracer.get(req.request_id)
+            if trace is not None:
+                trace.transition(PHASE_ADMIT)
+                if req.num_preemptions:
+                    trace.event("resumed",
+                                preemptions=req.num_preemptions)
+            t0 = pc()
             blocks = list(hit_blocks)
             if cow:
                 new_b = fresh.pop(0)
@@ -287,6 +367,8 @@ class ContinuousBatchingScheduler:
             ids_np[0, :S] = ids[matched:]
             row = np.full((1, self.config.max_blocks_per_seq), -1, np.int32)
             row[0, :len(blocks)] = blocks
+            block_s += pc() - t0
+            t0 = pc()
             with RecordEvent("serving.prefill"), paddle.no_grad():
                 caches = [PagedCacheSlot(
                     kp, vp, paddle.to_tensor(row),
@@ -299,7 +381,10 @@ class ContinuousBatchingScheduler:
                     caches,
                     paddle.to_tensor(np.array([S - 1], np.int32)))
                 self._store_pools(caches)
+            prefill_s = pc() - t0
+            t0 = pc()
             tok = int(np.asarray(next_ids.numpy())[0])
+            sync_s = pc() - t0
             self.metrics.prefills += 1
             self.metrics.prefill_tokens += S
             if self.prefix_cache is not None:
@@ -309,27 +394,51 @@ class ContinuousBatchingScheduler:
             self._table[slot] = row[0]
             self._pos[slot] = P
             self._next_tok[slot] = tok
+            if trace is not None:
+                trace.note(cached_tokens=matched, prefilled_tokens=S)
+                trace.subspan("prefix_match", radix_s)
+                trace.subspan("prefill", prefill_s)
+                trace.subspan("sampling_sync", sync_s)
+                trace.transition(PHASE_RUNNING)
+            t0 = pc()
             req.emit(tok)
+            stream_s = pc() - t0
             self._events.append((req.request_id, tok))
             self.metrics.generated_tokens += 1
             if req.eos_token_id is not None and tok == req.eos_token_id:
                 finished.append(self._retire(slot, "eos"))
             elif req.num_generated >= req.max_new_tokens:
                 finished.append(self._retire(slot, "length"))
+            # attribute this admission's host time (device prefill excluded)
+            self.stall.record("radix_match", radix_s)
+            self.stall.record("block_accounting", block_s)
+            self.stall.record("sampling_sync", sync_s)
+            self.stall.record("streaming", stream_s)
+            self.stall.record(
+                "admission",
+                (pc() - it_t0) - radix_s - block_s - sync_s - stream_s
+                - prefill_s)
         return finished
 
     def _decode_once(self) -> List[Request]:
-        """One fixed-shape decode iteration over every running slot."""
+        """One fixed-shape decode iteration over every running slot.
+
+        Stall attribution: the capacity loop (block extends + preemption
+        table rewrites) is ``block_accounting``, the blocking token read is
+        ``sampling_sync``, per-token emit/callbacks are ``streaming`` — the
+        exact host seams the async-engine refactor (ROADMAP 4) overlaps."""
         S = self.config.max_num_seqs
         running = [s for s in range(S) if self._slots[s] is not None]
         if not running:
             return []
-        for s in running:
-            if self._slots[s] is None:
-                continue                     # evicted by an earlier slot
-            self._ensure_decode_capacity(s)
-        # capacity assurance may have preempted ANY slot, incl. later ones
-        running = [s for s in running if self._slots[s] is not None]
+        pc = _time.perf_counter
+        with self.stall.timed("block_accounting"):
+            for s in running:
+                if self._slots[s] is None:
+                    continue                 # evicted by an earlier slot
+                self._ensure_decode_capacity(s)
+            # capacity assurance may have preempted ANY slot, incl. later
+            running = [s for s in running if self._slots[s] is not None]
         if not running:
             return []
         with RecordEvent("serving.decode_step"), paddle.no_grad():
@@ -340,21 +449,27 @@ class ContinuousBatchingScheduler:
                 paddle.to_tensor(tok), paddle.to_tensor(pos), caches,
                 paddle.to_tensor(np.zeros(S, np.int32)))
             self._store_pools(caches)
+        t0 = pc()
         step_np = np.asarray(next_ids.numpy())
+        self.stall.record("sampling_sync", pc() - t0)
         self.metrics.decode_steps += 1
         finished = []
+        stream_s = 0.0
         for s in running:
             req = self._slots[s]
             self._pos[s] += 1                # fed token is now cached
             t = int(step_np[s])
             self._next_tok[s] = t
+            t0 = pc()
             req.emit(t)
+            stream_s += pc() - t0
             self._events.append((req.request_id, t))
             self.metrics.generated_tokens += 1
             if req.eos_token_id is not None and t == req.eos_token_id:
                 finished.append(self._retire(s, "eos"))
             elif req.num_generated >= req.max_new_tokens:
                 finished.append(self._retire(s, "length"))
+        self.stall.record("streaming", stream_s)
         return finished
 
     # ---- public loop ---------------------------------------------------
@@ -365,10 +480,18 @@ class ContinuousBatchingScheduler:
 
     def step(self) -> List[RequestOutput]:
         """One scheduler iteration: admit into free slots (prefill), then
-        one decode step; returns outputs finishing this iteration."""
+        one decode step; returns outputs finishing this iteration. Each
+        iteration also lands one flight-recorder record (occupancy, token
+        split, preemptions, cache activity) and feeds the alarm monitors."""
         was_training = self.model.training
         self.model.eval()
         t0 = _time.perf_counter()
+        pre_prefill = self.metrics.prefill_tokens
+        pre_gen = self.metrics.generated_tokens
+        pre_preempt = self.metrics.preemptions
+        pre_hit = (self.prefix_cache._hit_tokens
+                   if self.prefix_cache is not None else 0)
+        self._step_evicted = 0
         try:
             done = self._admit()
             done += self._decode_once()
@@ -380,6 +503,20 @@ class ContinuousBatchingScheduler:
             queue_depth=len(self.queue),
             running=sum(r is not None for r in self._slots),
             allocator=self.allocator, live_tokens=self._live_tokens())
+        self.flight.record_step(
+            running=sum(r is not None for r in self._slots),
+            queue_depth=len(self.queue),
+            free_blocks=self.allocator.num_free_blocks,
+            prefill_tokens=self.metrics.prefill_tokens - pre_prefill,
+            generated_tokens=self.metrics.generated_tokens - pre_gen,
+            preemptions=self.metrics.preemptions - pre_preempt,
+            cache_hit_tokens=((self.prefix_cache._hit_tokens
+                               if self.prefix_cache is not None else 0)
+                              - pre_hit),
+            evicted_blocks=self._step_evicted,
+            finished=len(done))
+        if self.prefix_cache is not None:
+            self._alarms.observe_evictions(self._step_evicted)
         return [r.output() for r in done]
 
     def run(self) -> Dict[int, RequestOutput]:
@@ -418,6 +555,67 @@ class ContinuousBatchingScheduler:
         if self.prefix_cache is None:
             return None
         return self.prefix_cache.stats()
+
+    # ---- live introspection -------------------------------------------
+
+    def debug_state(self) -> Dict[str, object]:
+        """The ``/debug/requests`` payload: live request table (running +
+        queued), lifecycle traces, host-stall breakdown, SLO accounting,
+        flight-recorder ring (+ frozen alarm dump), prefix-cache and
+        compile stats. Host-side state only — reading it never syncs the
+        device, so a scrape cannot stall a decode step."""
+        now = _time.perf_counter()
+
+        def _row(req, state, slot):
+            return {
+                "request_id": req.request_id, "state": state, "slot": slot,
+                "priority": req.priority,
+                "prompt_tokens": int(len(req.prompt_ids)),
+                "generated_tokens": req.num_generated,
+                "max_new_tokens": req.max_new_tokens,
+                "num_preemptions": req.num_preemptions,
+                "age_s": round(now - req.arrival_t, 6),
+                "kv_blocks": len(req.blocks),
+                "phase": (self.tracer.get(req.request_id).current_phase
+                          if self.tracer.enabled
+                          and self.tracer.get(req.request_id) is not None
+                          else None),
+            }
+
+        rows = [_row(req, "RUNNING", s)
+                for s, req in enumerate(self._slots) if req is not None]
+        rows += [_row(req, req.state.name, -1) for req in self.queue._items]
+        return {
+            "requests": rows,
+            "queue_depth": len(self.queue),
+            "running": sum(r is not None for r in self._slots),
+            "stall_seconds": self.stall.snapshot(),
+            "slo": self.metrics.slo_snapshot(),
+            "flight_recorder": self.flight.dump(),
+            "flight_alarm": self.flight.last_alarm_dump,
+            "traces": {
+                "live": [t.to_dict() for t in self.tracer.live()],
+                "completed": self.tracer.to_json(include_live=False)[-32:],
+            },
+            "prefix_cache": self.prefix_cache_stats(),
+            "compile": self.compile_stats(),
+        }
+
+    def export_request_trace(self, path: str) -> str:
+        """Write the request-lifecycle chrome trace (one track per request)
+        — open in Perfetto / chrome://tracing next to a profiler export."""
+        return self.tracer.export_chrome_trace(path)
+
+    def start_endpoint(self, host: str = "127.0.0.1", port: int = 0):
+        """Serve this scheduler's ``/metrics`` + ``/debug/requests`` over a
+        background stdlib-http server; returns the started
+        ``ObservabilityEndpoint`` (``.url``, ``.stop()``)."""
+        from paddle_tpu.observability import ObservabilityEndpoint
+
+        ep = ObservabilityEndpoint(host=host, port=port)
+        ep.add_scheduler(self)
+        ep.start()
+        return ep
 
     # ---- weight hot-reload --------------------------------------------
 

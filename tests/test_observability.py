@@ -27,6 +27,19 @@ from paddle_tpu.observability.metrics import Histogram
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
+@pytest.fixture(autouse=True, scope="module")
+def _no_aot_replay():
+    """This module drives a serving workload (the overhead budget test runs
+    serve_bench's run_load): same fence as test_serving_sched — XLA:CPU AOT
+    replay corrupts decode-program numerics, so compile fresh here."""
+    import jax
+
+    old = jax.config.jax_enable_compilation_cache
+    jax.config.update("jax_enable_compilation_cache", False)
+    yield
+    jax.config.update("jax_enable_compilation_cache", old)
+
+
 # ------------------------------------------------------------ registry
 
 def test_counter_gauge_semantics():

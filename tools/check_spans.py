@@ -1,0 +1,143 @@
+#!/usr/bin/env python
+"""Span-name manifest lint: every trace span has an owner, no entry rots.
+
+Scans ``paddle_tpu/`` for ``RecordEvent(...)`` call sites and reconciles
+them against ``paddle_tpu.observability.span_manifest``:
+
+- a literal span name emitted but not registered      -> FAIL (who owns it?)
+- a registered span name no call site emits anymore   -> FAIL (stale entry)
+- a non-literal (runtime-built) call site whose file
+  is not declared in ``DYNAMIC_SPANS``                -> FAIL (undeclared
+  dynamic span names would silently dodge the manifest)
+
+Runs standalone (``python tools/check_spans.py``, exit code 0/1) and as a
+tier-1 test (``tests/test_check_spans.py``). Pure text scan — no jax, no
+imports of the scanned modules — so it is fast and environment-proof.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import re
+import sys
+from typing import Dict, List
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO_ROOT not in sys.path:
+    sys.path.insert(0, REPO_ROOT)
+
+# literal first arg: RecordEvent("name" ...
+_LITERAL = re.compile(r'RecordEvent\(\s*([fub]*)"([^"]+)"')
+# any call site (to find the non-literal ones by subtraction)
+_ANY = re.compile(r"RecordEvent\(\s*([^)\s,]+)")
+
+
+def scan_spans(root: str) -> Dict[str, object]:
+    """Walk ``root`` for .py files; return literal span names (with their
+    files) and non-literal call sites."""
+    literals: Dict[str, List[str]] = {}
+    dynamic_sites: List[Dict[str, object]] = []
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        for fn in filenames:
+            # the registry itself names spans in prose, not as call sites
+            if not fn.endswith(".py") or fn == "span_manifest.py":
+                continue
+            path = os.path.join(dirpath, fn)
+            rel = os.path.relpath(path, os.path.dirname(root)).replace(
+                os.sep, "/")
+            with open(path, encoding="utf-8") as f:
+                for lineno, line in enumerate(f, 1):
+                    if "RecordEvent(" not in line:
+                        continue
+                    # class/def/import lines are not call sites
+                    stripped = line.strip()
+                    if stripped.startswith(("class ", "def ", "from ",
+                                            "import ", "#")):
+                        continue
+                    m = _LITERAL.search(line)
+                    if m:
+                        prefix, name = m.groups()
+                        if "f" in prefix:      # f-string: treat as dynamic
+                            dynamic_sites.append(
+                                {"file": rel, "line": lineno,
+                                 "arg": f'f"{name}"'})
+                        else:
+                            literals.setdefault(name, []).append(
+                                f"{rel}:{lineno}")
+                        continue
+                    m = _ANY.search(line)
+                    if m:
+                        dynamic_sites.append({"file": rel, "line": lineno,
+                                              "arg": m.group(1)})
+    return {"literals": literals, "dynamic_sites": dynamic_sites}
+
+
+def check_spans(root: str, manifest: Dict[str, dict],
+                dynamic: Dict[str, str]) -> Dict[str, object]:
+    """Reconcile a scan against a manifest; returns the full report with
+    ``ok`` plus the three violation lists."""
+    scan = scan_spans(root)
+    literals = scan["literals"]
+    unregistered = sorted(n for n in literals if n not in manifest)
+    stale = sorted(n for n in manifest if n not in literals)
+    undeclared_dynamic = [s for s in scan["dynamic_sites"]
+                          if s["file"] not in dynamic]
+    malformed = sorted(
+        n for n, entry in manifest.items()
+        if not (isinstance(entry, dict) and entry.get("owner")
+                and entry.get("category")))
+    return {
+        "ok": not (unregistered or stale or undeclared_dynamic or malformed),
+        "spans_emitted": {n: sites for n, sites in sorted(literals.items())},
+        "dynamic_sites": scan["dynamic_sites"],
+        "unregistered": unregistered,
+        "stale": stale,
+        "undeclared_dynamic": undeclared_dynamic,
+        "malformed_entries": malformed,
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--root", default=os.path.join(REPO_ROOT, "paddle_tpu"),
+                    help="package directory to scan")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the full report as JSON")
+    args = ap.parse_args(argv)
+
+    from paddle_tpu.observability.span_manifest import (
+        DYNAMIC_SPANS,
+        SPAN_MANIFEST,
+    )
+
+    report = check_spans(args.root, SPAN_MANIFEST, DYNAMIC_SPANS)
+    if args.json:
+        print(json.dumps(report, indent=2))
+    else:
+        n = len(report["spans_emitted"])
+        if report["ok"]:
+            print(f"check_spans: OK — {n} literal spans registered, "
+                  f"{len(report['dynamic_sites'])} declared dynamic sites")
+        else:
+            for name in report["unregistered"]:
+                sites = ", ".join(report["spans_emitted"][name])
+                print(f"UNREGISTERED span {name!r} ({sites}) — add it to "
+                      f"observability/span_manifest.py with an owner")
+            for name in report["stale"]:
+                print(f"STALE manifest entry {name!r} — no call site emits "
+                      f"it anymore; remove it")
+            for s in report["undeclared_dynamic"]:
+                print(f"UNDECLARED dynamic RecordEvent at {s['file']}:"
+                      f"{s['line']} (arg {s['arg']}) — register the file in "
+                      f"DYNAMIC_SPANS")
+            for name in report["malformed_entries"]:
+                print(f"MALFORMED manifest entry {name!r} — needs non-empty "
+                      f"owner and category")
+    return 0 if report["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
