@@ -643,8 +643,9 @@ def bench_serving_prefix(on_tpu):
         art = run_prefix_suite(num_requests=8, prompt_len=192, max_new=4,
                                max_num_seqs=2, block_size=16,
                                max_seq_len=256, num_layers=2)
-    with open(os.path.join(here, "BENCH_serving_prefix.json"), "w") as f:
-        json.dump(art, f, indent=2)
+    from tools.bench_io import write_bench_json
+
+    write_bench_json(os.path.join(here, "BENCH_serving_prefix.json"), art)
     top = str(max(art["config"]["ratios"]))
     print(json.dumps({
         "metric": "serving_prefix_ttft_reduction_pct",
@@ -776,6 +777,37 @@ def bench_chip_ceilings(on_tpu):
     print(json.dumps(out))
 
 
+def bench_lint(on_tpu):
+    """graft_lint wall time: the six-checker static-analysis suite over
+    paddle_tpu/ + tools/ must stay cheap enough to live in the default
+    tier-1 run — hard budget 10 s for the full-repo pass. Runs in a
+    subprocess exactly as tier-1 invokes it (stdlib-only: no jax import,
+    so the number is pure analysis cost)."""
+    import subprocess
+    import sys
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    t0 = time.perf_counter()
+    r = subprocess.run(
+        [sys.executable, os.path.join(here, "tools", "lint.py"), "--json"],
+        capture_output=True, text=True, timeout=120)
+    dt = time.perf_counter() - t0
+    assert r.returncode == 0, \
+        f"lint found non-baselined findings:\n{r.stdout[-2000:]}"
+    rep = json.loads(r.stdout)
+    assert dt < 10.0, f"full-repo lint took {dt:.1f}s (budget 10s)"
+    print(json.dumps({
+        "metric": "lint_wall_s",
+        "value": round(dt, 2),
+        "unit": f"s full-repo ({rep['files_scanned']} files, "
+                f"{len(rep['rules'])} rules; budget 10)",
+        "vs_baseline": None,
+        "findings_baselined": rep["counts"]["baselined"],
+        "findings_suppressed": rep["counts"]["suppressed"],
+        "within_budget": dt < 10.0,
+    }))
+
+
 def _probe_once(timeout_s):
     """Resolve the platform name in a THROWAWAY subprocess with a timeout.
 
@@ -859,6 +891,7 @@ for _f in (bench_chip_ceilings, bench_resnet50, bench_bert, bench_ernie,
            bench_observability,
            bench_ckpt,
            bench_train,
+           bench_lint,
            bench_gpt):  # headline LAST (tail-parsed by the driver)
     _register(_f)
 

@@ -34,6 +34,7 @@ from typing import Dict, List, NamedTuple, Optional
 
 from paddle_tpu.checkpoint import manifest as mf
 from paddle_tpu.checkpoint import state as st
+from paddle_tpu.observability.annotations import guarded_by
 
 _STEP_RE = re.compile(r"^step_(\d+)$")
 _TMP_SUFFIX = ".tmp"
@@ -78,7 +79,16 @@ class CheckpointManager:
     ``keep_last_n``: retain the newest N commits (0 = keep all).
     ``keep_every_k``: additionally retain every commit whose step is a
     multiple of K forever (0 = none) — the "weekly archive" knob.
+
+    Thread contract: the async writer thread and the caller hand off
+    through three fields — the writer handle, its failure, and the
+    in-flight tmp dir (``gc()`` runs ON the writer thread while the caller
+    may be planning the next save) — all guarded by ``_state_lock``.
     """
+
+    _writer: guarded_by("_state_lock")
+    _writer_err: guarded_by("_state_lock")
+    _active_tmp: guarded_by("_state_lock")
 
     def __init__(self, root: str, keep_last_n: int = 3, keep_every_k: int = 0,
                  registry=None):
@@ -112,6 +122,7 @@ class CheckpointManager:
             "save() wait on a prior in-flight save", "s")
         self._m_restore_s = reg.histogram(
             "checkpoint_restore_seconds", "restore wall", "s")
+        self._state_lock = threading.Lock()
         self._writer: Optional[threading.Thread] = None
         self._writer_err: Optional[BaseException] = None
         self._active_tmp: Optional[str] = None  # in-flight writer's dir
@@ -207,26 +218,30 @@ class CheckpointManager:
         self._m_snap_s.observe(snap_s)
         pidx = _process_index()
 
-        self._active_tmp = tmp
+        with self._state_lock:
+            self._active_tmp = tmp
 
         def _write_and_commit():
             try:
                 self._write_and_commit(tmp, final, step, writes, md,
                                        extra_json, pidx, t0)
             finally:
-                self._active_tmp = None
+                with self._state_lock:
+                    self._active_tmp = None
 
         if async_save:
             def guarded():
                 try:
                     _write_and_commit()
                 except BaseException as e:
-                    self._writer_err = e
+                    with self._state_lock:
+                        self._writer_err = e
 
             t = threading.Thread(target=guarded, daemon=True,
                                  name=f"ckpt-writer-step{step}")
             t.start()
-            self._writer = t
+            with self._state_lock:
+                self._writer = t
         else:
             _write_and_commit()
         return final
@@ -254,10 +269,12 @@ class CheckpointManager:
 
     def wait(self) -> None:
         """Join the in-flight async writer; re-raise its failure, if any."""
-        t, self._writer = self._writer, None
+        with self._state_lock:
+            t, self._writer = self._writer, None
         if t is not None:
-            t.join()
-        err, self._writer_err = self._writer_err, None
+            t.join()            # never joins while holding the state lock
+        with self._state_lock:
+            err, self._writer_err = self._writer_err, None
         if err is not None:
             raise err
 
@@ -311,10 +328,12 @@ class CheckpointManager:
                 removed.append(s)
                 self._m_gc.inc()
         newest = committed[-1] if committed else None
+        with self._state_lock:
+            active_tmp = self._active_tmp
         for name in os.listdir(self.root):
             d = os.path.join(self.root, name)
             if name.endswith(_TMP_SUFFIX) and os.path.isdir(d):
-                if d == self._active_tmp:
+                if d == active_tmp:
                     continue  # an in-flight async writer owns this dir
                 shutil.rmtree(d, ignore_errors=True)
                 continue

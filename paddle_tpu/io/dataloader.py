@@ -26,6 +26,7 @@ import numpy as np
 
 from paddle_tpu.io.dataset import Dataset, IterableDataset
 from paddle_tpu.io.sampler import BatchSampler
+from paddle_tpu.observability.annotations import hot_path
 from paddle_tpu.tensor import Tensor
 
 
@@ -132,6 +133,8 @@ class DevicePrefetcher:
             put, batch, is_leaf=lambda x: isinstance(x, Tensor))
 
     # ---------------------------------------------------------------- iter
+    @hot_path(reason="the zero-stall loop's input side: consumer pop + "
+                     "producer H2D dispatch")
     def __iter__(self):
         from paddle_tpu.observability.train_stall import (
             prefetched_batches_counter,

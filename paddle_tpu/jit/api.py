@@ -27,6 +27,7 @@ from paddle_tpu.jit.functional import (
     tree_wrap,
 )
 from paddle_tpu.nn.layer_base import Layer
+from paddle_tpu.observability.annotations import hot_path
 from paddle_tpu.observability.compile_tracker import (
     abstract_signature,
     get_compile_tracker,
@@ -690,6 +691,7 @@ class TrainStep:
         self._fused_jitted = jax.jit(step, donate_argnums=(0, 1, 2, 3, 4),
                                      static_argnums=(10,))
 
+    @hot_path(reason="FusedAdamW flat-mode dispatch path")
     def _fused_call(self, batch):
         opt = self._opt
         params = self._params
@@ -832,6 +834,7 @@ class TrainStep:
                     self._tracker_name, time.perf_counter() - t0,
                     abstract_signature(batch), n_programs=grown)
 
+    @hot_path(reason="per-step dispatch: host work here serializes steps")
     def _call_inner(self, *batch):
         if self._fused_mode:
             return self._fused_call(batch)
@@ -902,7 +905,11 @@ class TrainStep:
             if self._donate_argnums:
                 # deleted-buffer shells: donation_report()'s evidence
                 self._last_donated = {
+                    # graft-lint: disable-next=donation-alias (the deleted
+                    # shells ARE donation_report()'s cache-probe evidence)
                     "params": list(param_vals),
+                    # graft-lint: disable-next=donation-alias (same: shells
+                    # are probed via is_deleted(), contents never read)
                     "batch": (batch_vals if self._donate_inputs else None),
                 }
             if self._donate_inputs and 4 in self._donate_argnums:

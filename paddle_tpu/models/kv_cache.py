@@ -17,6 +17,7 @@ python/paddle/incubate/nn/functional/ — re-designed TPU-first:
 from __future__ import annotations
 
 import math
+import threading
 from collections import namedtuple
 from typing import List, Optional
 
@@ -24,6 +25,7 @@ import jax
 import jax.numpy as jnp
 
 from paddle_tpu.core.dispatch import apply
+from paddle_tpu.observability.annotations import guarded_by, holds_lock
 from paddle_tpu.tensor import Tensor
 
 # k, v: [B, max_len, KVH, D]; pos: [B] int32 — number of tokens already cached
@@ -177,37 +179,54 @@ class BlockAllocator:
 
     Hardened for the serving tier: every block id is tracked as free OR
     allocated, double-free (and freeing a block the allocator never owned)
-    raises, and occupancy/fragmentation stats feed ``ServingMetrics``."""
+    raises, and occupancy/fragmentation stats feed ``ServingMetrics``.
+
+    Thread contract: the scheduler thread allocates/frees while the
+    ObservabilityEndpoint thread reads occupancy stats (and the async
+    serving engine will run admission and decode accounting concurrently)
+    — free list and allocated set live under a reentrant ``_lock``."""
+
+    _free: guarded_by("_lock")
+    _allocated: guarded_by("_lock")
 
     def __init__(self, num_blocks: int, block_size: int):
         self.block_size = block_size
         self.num_blocks = num_blocks
+        # reentrant: allocate() -> _pop_free(), and the ref-counting
+        # subclass's eviction callback re-enters through decref()
+        self._lock = threading.RLock()
         self._free = list(range(num_blocks - 1, -1, -1))
         self._allocated: set = set()
 
     def num_free(self) -> int:
-        return len(self._free)
+        with self._lock:
+            return len(self._free)
 
     @property
     def num_free_blocks(self) -> int:
-        return len(self._free)
+        with self._lock:
+            return len(self._free)
 
     @property
     def num_used_blocks(self) -> int:
-        return len(self._allocated)
+        with self._lock:
+            return len(self._allocated)
 
     def utilization(self) -> float:
         """Fraction of the pool currently allocated to sequences."""
-        return len(self._allocated) / max(self.num_blocks, 1)
+        with self._lock:
+            return len(self._allocated) / max(self.num_blocks, 1)
 
     def fragmentation(self, live_tokens: int) -> float:
         """Internal fragmentation: fraction of allocated token capacity not
         holding a live token (tail slack of partially-filled blocks)."""
-        cap = len(self._allocated) * self.block_size
+        with self._lock:
+            cap = len(self._allocated) * self.block_size
         if cap <= 0:
             return 0.0
         return max(0.0, 1.0 - live_tokens / cap)
 
+    @holds_lock("_lock")
     def _pop_free(self) -> int:
         b = self._free.pop()
         self._allocated.add(b)
@@ -215,25 +234,29 @@ class BlockAllocator:
 
     def allocate(self, n_tokens: int) -> List[int]:
         need = (n_tokens + self.block_size - 1) // self.block_size
-        if need > len(self._free):
-            raise KVPoolExhausted(
-                f"KV pool exhausted: need {need} blocks, {len(self._free)} free")
-        return [self._pop_free() for _ in range(need)]
+        with self._lock:
+            if need > len(self._free):
+                raise KVPoolExhausted(
+                    f"KV pool exhausted: need {need} blocks, "
+                    f"{len(self._free)} free")
+            return [self._pop_free() for _ in range(need)]
 
     def extend(self, blocks: List[int], cur_tokens: int, add_tokens: int):
         """Grow a sequence's block list to cover add_tokens more tokens."""
         have = len(blocks) * self.block_size
-        while cur_tokens + add_tokens > have:
-            if not self._free:
-                raise KVPoolExhausted("KV pool exhausted on extend")
-            blocks.append(self._pop_free())
-            have += self.block_size
+        with self._lock:
+            while cur_tokens + add_tokens > have:
+                if not self._free:
+                    raise KVPoolExhausted("KV pool exhausted on extend")
+                blocks.append(self._pop_free())
+                have += self.block_size
         return blocks
 
     def free(self, blocks: List[int]):
-        for b in blocks:
-            if b not in self._allocated:
-                raise RuntimeError(
-                    f"double free: block {b} is not currently allocated")
-            self._allocated.remove(b)
-            self._free.append(b)
+        with self._lock:
+            for b in blocks:
+                if b not in self._allocated:
+                    raise RuntimeError(
+                        f"double free: block {b} is not currently allocated")
+                self._allocated.remove(b)
+                self._free.append(b)

@@ -1,0 +1,97 @@
+"""Source annotations read by the static-analysis suite (tools/graft_lint).
+
+These are deliberately inert at runtime — a decorator that returns its
+function unchanged, a declaration object that only carries a string — so
+annotating a hot loop costs nothing. Their value is that ``tools/lint.py``
+machine-checks the contract they state:
+
+- ``@hot_path`` marks a function as sitting on a latency-critical loop
+  (the serving scheduler's admit/decode iteration, the TrainStep dispatch
+  path). The ``host-sync-in-hot-loop`` checker then rejects blocking
+  host<->device syncs (``.numpy()``, ``.item()``, ``np.asarray(tensor)``,
+  ``block_until_ready``) inside it unless they are metered under a
+  ``stall.timed(...)`` block or explicitly suppressed with a reason.
+
+- ``attr: guarded_by("_lock")`` in a class body declares that ``self.attr``
+  is shared mutable state owned by ``self._lock``. The ``guarded-by``
+  checker then requires every access outside ``__init__`` to sit inside
+  ``with self._lock:`` (or in a method declared ``@holds_lock("_lock")``).
+
+- ``@holds_lock("_lock")`` marks a method whose CALLER is responsible for
+  holding the named lock (private helpers invoked under an already-held
+  lock, or init-time helpers that run before the object is published).
+
+Usage::
+
+    from paddle_tpu.observability.annotations import (
+        guarded_by, holds_lock, hot_path)
+
+    class Ring:
+        _items: guarded_by("_lock")
+
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._items = []
+
+        def push(self, x):
+            with self._lock:
+                self._items.append(x)
+
+        @holds_lock("_lock")
+        def _drop_oldest_locked(self):
+            self._items.pop(0)
+
+    @hot_path
+    def _decode_once(self):
+        ...
+"""
+
+from __future__ import annotations
+
+__all__ = ["GuardedBy", "guarded_by", "holds_lock", "hot_path"]
+
+
+def hot_path(fn=None, *, reason: str = ""):
+    """Mark a function as hot-loop code (checked by host-sync-in-hot-loop).
+
+    Usable bare (``@hot_path``) or with a reason (``@hot_path(reason=...)``).
+    Returns the function unchanged apart from a marker attribute."""
+
+    def mark(f):
+        f.__graft_hot_path__ = reason or True
+        return f
+
+    return mark if fn is None else mark(fn)
+
+
+class GuardedBy:
+    """Declaration object for ``attr: guarded_by("lockname")`` annotations.
+
+    Carries only the lock attribute's name; it never wraps or intercepts the
+    attribute (the enforcement is static, in tools/graft_lint)."""
+
+    __slots__ = ("lock",)
+
+    def __init__(self, lock: str):
+        self.lock = str(lock)
+
+    def __repr__(self) -> str:  # shows up in __annotations__ introspection
+        return f"guarded_by({self.lock!r})"
+
+
+def guarded_by(lock: str) -> GuardedBy:
+    """Declare (in annotation position) that an attribute is protected by
+    the named lock attribute of the same object."""
+    return GuardedBy(lock)
+
+
+def holds_lock(lock: str):
+    """Mark a method as called only while ``self.<lock>`` is already held
+    (or before the object is visible to other threads). The guarded-by
+    checker trusts the marker instead of requiring a ``with`` block."""
+
+    def mark(f):
+        f.__graft_holds_lock__ = str(lock)
+        return f
+
+    return mark

@@ -25,6 +25,8 @@ import threading
 from collections import OrderedDict
 from typing import Dict, Optional
 
+from paddle_tpu.observability.annotations import guarded_by, holds_lock
+
 _NAME_RE = re.compile(r"[^a-zA-Z0-9_:]")
 
 
@@ -54,8 +56,16 @@ class _Labeled:
     ``name{phase="admission"}``. The unlabeled parent series is suppressed
     from exposition once children exist (Prometheus convention: a labeled
     family has no bare series) unless the parent itself was written to.
+
+    Thread contract: the scheduler thread creates children via ``labels()``
+    while the ObservabilityEndpoint thread iterates them for exposition —
+    both sides must hold ``_lock`` or the scrape dies with "OrderedDict
+    mutated during iteration".
     """
 
+    _children: guarded_by("_lock")
+
+    @holds_lock("_lock")  # runs inside __init__, before publication
     def _init_labels(self):
         self._children: "OrderedDict[str, object]" = OrderedDict()
         self._labels: Optional[Dict[str, str]] = None
@@ -80,18 +90,22 @@ class _Labeled:
 
     def _expose_rows(self, kind):
         rows = []
-        if self._touched or not self._children:
-            rows.append((kind, self.name, self._labels, self._value))
-        for child in self._children.values():
+        with self._lock:
+            children = list(self._children.values())
+            if self._touched or not children:
+                rows.append((kind, self.name, self._labels, self._value))
+        for child in children:
             rows.append((kind, self.name, child._labels, child._value))
         return rows
 
     def _snapshot_items(self, full):
         """(key, value) pairs for MetricsRegistry.snapshot()."""
         items = []
-        if self._touched or not self._children:
-            items.append((full, self._value))
-        for key, child in self._children.items():
+        with self._lock:
+            children = list(self._children.items())
+            if self._touched or not children:
+                items.append((full, self._value))
+        for key, child in children:
             items.append((f"{full}{{{key}}}", child._value))
         return items
 
@@ -165,7 +179,13 @@ class Histogram:
     ALL observations so far (a ring buffer, by contrast, only remembers the
     last window, silently divorcing the percentiles from ``count``/``mean``).
     Deterministic: the same stream always yields the same summary.
+
+    Thread contract: recorded from hot loops while the endpoint thread
+    snapshots — the reservoir (slot replacement!) is guarded, and readers
+    take a consistent copy before touching numpy.
     """
+
+    _vals: guarded_by("_lock")
 
     def __init__(self, max_samples: int = 4096, seed: int = 0x5EED,
                  name: str = "histogram", description: str = "",
@@ -173,6 +193,7 @@ class Histogram:
         self.name = name
         self.description = description
         self.unit = unit
+        self._lock = threading.Lock()
         self._vals = []
         self._max_samples = int(max_samples)
         self._rng = random.Random(seed)
@@ -183,44 +204,50 @@ class Histogram:
 
     def record(self, v: float):
         v = float(v)
-        self.count += 1
-        self.total += v
-        if self.min_seen is None or v < self.min_seen:
-            self.min_seen = v
-        if self.max_seen is None or v > self.max_seen:
-            self.max_seen = v
-        if len(self._vals) < self._max_samples:
-            self._vals.append(v)
-        else:
-            j = self._rng.randrange(self.count)
-            if j < self._max_samples:
-                self._vals[j] = v
+        with self._lock:
+            self.count += 1
+            self.total += v
+            if self.min_seen is None or v < self.min_seen:
+                self.min_seen = v
+            if self.max_seen is None or v > self.max_seen:
+                self.max_seen = v
+            if len(self._vals) < self._max_samples:
+                self._vals.append(v)
+            else:
+                j = self._rng.randrange(self.count)
+                if j < self._max_samples:
+                    self._vals[j] = v
 
     # kept for API familiarity with prometheus clients
     observe = record
 
     def quantile(self, q: float) -> Optional[float]:
-        if not self._vals:
+        with self._lock:
+            vals = list(self._vals)
+        if not vals:
             return None
         import numpy as np
 
-        return float(np.percentile(np.asarray(self._vals, float), q * 100))
+        return float(np.percentile(np.asarray(vals, float), q * 100))
 
     def summary(self) -> Dict[str, float]:
         """Self-consistent digest: count/mean/max are exact over the stream,
         percentiles are the reservoir's (a uniform sample of that stream)."""
-        if not self.count:
-            return {"count": 0}
+        with self._lock:
+            if not self.count:
+                return {"count": 0}
+            vals = list(self._vals)
+            count, total, max_seen = self.count, self.total, self.max_seen
         import numpy as np
 
-        a = np.asarray(self._vals, float)
+        a = np.asarray(vals, float)
         return {
-            "count": self.count,
-            "mean": self.total / self.count,
+            "count": count,
+            "mean": total / count,
             "p50": float(np.percentile(a, 50)),
             "p90": float(np.percentile(a, 90)),
             "p99": float(np.percentile(a, 99)),
-            "max": self.max_seen,
+            "max": max_seen,
         }
 
     def expose(self):
@@ -240,7 +267,14 @@ class MetricsRegistry:
     ``namespace`` prefixes every metric's exposition name (``serving_...``).
     Creating the same name twice returns the SAME metric object; asking for
     an existing name with a different kind raises.
+
+    Thread contract: subsystems create metrics lazily from their own
+    threads while the ObservabilityEndpoint snapshots/exposes the registry
+    — every reader of ``_metrics`` takes the lock and copies, or a scrape
+    mid-creation dies with "OrderedDict mutated during iteration".
     """
+
+    _metrics: guarded_by("_lock")
 
     def __init__(self, namespace: str = ""):
         self.namespace = namespace
@@ -282,22 +316,28 @@ class MetricsRegistry:
 
     # ------------------------------------------------------------- reading
     def get(self, name):
-        return self._metrics.get(self._full_name(name))
+        with self._lock:
+            return self._metrics.get(self._full_name(name))
 
     def __contains__(self, name):
-        return self._full_name(name) in self._metrics
+        with self._lock:
+            return self._full_name(name) in self._metrics
 
     def __len__(self):
-        return len(self._metrics)
+        with self._lock:
+            return len(self._metrics)
 
     def unregister(self, name):
-        self._metrics.pop(self._full_name(name), None)
+        with self._lock:
+            self._metrics.pop(self._full_name(name), None)
 
     def snapshot(self) -> Dict[str, object]:
         """One JSON-able dict: counters/gauges -> value (labeled children as
         ``name{k="v"}`` keys), histograms -> summary() digest."""
+        with self._lock:
+            metrics = list(self._metrics.items())
         out = {}
-        for full, m in self._metrics.items():
+        for full, m in metrics:
             if isinstance(m, Histogram):
                 out[full] = m.summary()
             else:
@@ -308,8 +348,10 @@ class MetricsRegistry:
         """Prometheus text-exposition format (0.0.4). Histograms are emitted
         as ``summary`` families (quantile series + _sum/_count); labeled
         Counter/Gauge families render one ``name{k="v"}`` line per child."""
+        with self._lock:
+            metrics = list(self._metrics.items())
         lines = []
-        for full, m in self._metrics.items():
+        for full, m in metrics:
             rows = m.expose()
             mtype = rows[0][0]
             if m.description:

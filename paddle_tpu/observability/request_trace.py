@@ -37,6 +37,8 @@ import time
 from collections import OrderedDict
 from typing import Dict, List, Optional
 
+from paddle_tpu.observability.annotations import guarded_by
+
 __all__ = [
     "PHASE_ADMIT",
     "PHASE_DONE",
@@ -152,7 +154,13 @@ class RequestTracer:
 
     Live traces are keyed by request id; finished traces move into a bounded
     ring (``max_completed``) so a long-running server's tracer stays O(ring),
-    not O(requests served)."""
+    not O(requests served).
+
+    Thread contract: the scheduler thread writes while the endpoint thread
+    reads (``/debug/requests``) — both dicts live under ``_lock``."""
+
+    _live: guarded_by("_lock")
+    _done: guarded_by("_lock")
 
     def __init__(self, enabled: bool = True, max_completed: int = 256):
         self.enabled = bool(enabled)
@@ -175,7 +183,8 @@ class RequestTracer:
     def get(self, request_id: int) -> Optional[RequestTrace]:
         if not self.enabled:
             return None
-        return self._live.get(request_id) or self._done.get(request_id)
+        with self._lock:
+            return self._live.get(request_id) or self._done.get(request_id)
 
     def finish(self, request_id: int, t: Optional[float] = None):
         """Terminal transition + move to the completed ring."""

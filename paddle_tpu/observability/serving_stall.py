@@ -38,6 +38,7 @@ from collections import deque
 from contextlib import contextmanager
 from typing import Dict, List, Optional
 
+from paddle_tpu.observability.annotations import guarded_by
 from paddle_tpu.observability.metrics import MetricsRegistry, get_registry
 
 __all__ = [
@@ -119,14 +120,22 @@ class FlightRecorder:
     the last ``max_steps``. ``dump()`` returns a JSON-able list (oldest
     first). Alarm hooks snapshot the ring into ``last_alarm_dump`` so the
     iterations AROUND the incident survive even after the ring rolls on.
+
+    Thread contract: the scheduler thread records while the endpoint
+    thread dumps — ring, step counter, and the frozen alarm snapshot are
+    all touched under ``_lock``.
     """
+
+    _ring: guarded_by("_lock")
+    _step: guarded_by("_lock")
+    _last_alarm: guarded_by("_lock")
 
     def __init__(self, max_steps: int = 256):
         self.max_steps = int(max_steps)
         self._ring: deque = deque(maxlen=self.max_steps)
         self._lock = threading.Lock()
         self._step = 0
-        self.last_alarm_dump: Optional[Dict[str, object]] = None
+        self._last_alarm: Optional[Dict[str, object]] = None
 
     def record_step(self, **fields):
         with self._lock:
@@ -136,11 +145,18 @@ class FlightRecorder:
             self._ring.append(fields)
 
     def __len__(self) -> int:
-        return len(self._ring)
+        with self._lock:
+            return len(self._ring)
 
     @property
     def steps_recorded(self) -> int:
-        return self._step
+        with self._lock:
+            return self._step
+
+    @property
+    def last_alarm_dump(self) -> Optional[Dict[str, object]]:
+        with self._lock:
+            return self._last_alarm
 
     def dump(self, last: Optional[int] = None) -> List[dict]:
         with self._lock:
@@ -149,10 +165,12 @@ class FlightRecorder:
 
     def alarm(self, kind: str, reason: str):
         """Freeze the ring around an incident (called by alarm monitors)."""
-        self.last_alarm_dump = {
-            "kind": kind, "reason": reason, "t": time.perf_counter(),
-            "steps": self.dump(),
-        }
+        dump = self.dump()
+        with self._lock:
+            self._last_alarm = {
+                "kind": kind, "reason": reason, "t": time.perf_counter(),
+                "steps": dump,
+            }
 
 
 class AlarmMonitors:

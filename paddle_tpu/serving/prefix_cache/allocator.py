@@ -23,6 +23,7 @@ from __future__ import annotations
 from typing import Callable, Dict, List, Optional
 
 from paddle_tpu.models.kv_cache import BlockAllocator, KVPoolExhausted
+from paddle_tpu.observability.annotations import guarded_by, holds_lock
 
 __all__ = ["RefCountingBlockAllocator"]
 
@@ -34,7 +35,16 @@ class RefCountingBlockAllocator(BlockAllocator):
     releasing a block that is not allocated raises (double free), and the
     occupancy/fragmentation stats keep working — a shared block counts once
     toward ``num_used_blocks`` regardless of how many holders it has.
+
+    The refcount table shares the base class's reentrant ``_lock`` (one
+    lock, one consistency domain: a block's free/allocated state and its
+    refcount must change together). The eviction callback runs WITH the
+    lock held — it re-enters through ``decref``, which the RLock permits,
+    and the lock ordering is always allocator -> radix tree, never the
+    reverse.
     """
+
+    _ref: guarded_by("_lock")
 
     def __init__(self, num_blocks: int, block_size: int,
                  evict_cb: Optional[Callable[[int], int]] = None):
@@ -50,30 +60,35 @@ class RefCountingBlockAllocator(BlockAllocator):
     # ---- refcount surface ---------------------------------------------
 
     def ref_count(self, block: int) -> int:
-        return self._ref.get(block, 0)
+        with self._lock:
+            return self._ref.get(block, 0)
 
     def is_shared(self, block: int) -> bool:
         """True when a write to ``block`` needs copy-on-write first."""
-        return self._ref.get(block, 0) > 1
+        with self._lock:
+            return self._ref.get(block, 0) > 1
 
     def incref(self, block: int):
-        if block not in self._allocated:
-            raise RuntimeError(
-                f"incref on block {block} which is not allocated")
-        self._ref[block] += 1
+        with self._lock:
+            if block not in self._allocated:
+                raise RuntimeError(
+                    f"incref on block {block} which is not allocated")
+            self._ref[block] += 1
 
     def decref(self, block: int):
-        if block not in self._allocated:
-            raise RuntimeError(
-                f"double free: block {block} is not currently allocated")
-        self._ref[block] -= 1
-        if self._ref[block] <= 0:
-            del self._ref[block]
-            self._allocated.remove(block)
-            self._free.append(block)
+        with self._lock:
+            if block not in self._allocated:
+                raise RuntimeError(
+                    f"double free: block {block} is not currently allocated")
+            self._ref[block] -= 1
+            if self._ref[block] <= 0:
+                del self._ref[block]
+                self._allocated.remove(block)
+                self._free.append(block)
 
     # ---- BlockAllocator surface, sharing-aware ------------------------
 
+    @holds_lock("_lock")
     def _pop_free(self) -> int:
         b = super()._pop_free()
         self._ref[b] = 1
@@ -83,9 +98,11 @@ class RefCountingBlockAllocator(BlockAllocator):
         """Release one holder's references (NOT necessarily the blocks):
         the scheduler's retire/preempt path keeps calling ``free`` and the
         pool stays correct under sharing."""
-        for b in blocks:
-            self.decref(b)
+        with self._lock:
+            for b in blocks:
+                self.decref(b)
 
+    @holds_lock("_lock")
     def _reclaim(self, need_blocks: int):
         """Evict cached blocks until ``need_blocks`` are free or the cache
         runs dry. Progress is 'cache released entries', not 'blocks freed':
@@ -97,12 +114,14 @@ class RefCountingBlockAllocator(BlockAllocator):
 
     def allocate(self, n_tokens: int) -> List[int]:
         need = (n_tokens + self.block_size - 1) // self.block_size
-        self._reclaim(need)
-        return super().allocate(n_tokens)
+        with self._lock:
+            self._reclaim(need)
+            return super().allocate(n_tokens)
 
     def extend(self, blocks: List[int], cur_tokens: int, add_tokens: int):
         have = len(blocks) * self.block_size
         need = -(-max(cur_tokens + add_tokens - have, 0) // self.block_size)
-        if need:
-            self._reclaim(need)
-        return super().extend(blocks, cur_tokens, add_tokens)
+        with self._lock:
+            if need:
+                self._reclaim(need)
+            return super().extend(blocks, cur_tokens, add_tokens)

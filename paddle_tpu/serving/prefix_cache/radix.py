@@ -19,7 +19,10 @@ monotonic access clock stamped on the whole path at every match/insert.
 
 from __future__ import annotations
 
+import threading
 from typing import Dict, List, Optional, Sequence, Tuple
+
+from paddle_tpu.observability.annotations import guarded_by, holds_lock
 
 __all__ = ["RadixNode", "RadixTree"]
 
@@ -43,10 +46,23 @@ class RadixNode:
 
 
 class RadixTree:
-    """Block-granular token-sequence trie with LRU leaf eviction."""
+    """Block-granular token-sequence trie with LRU leaf eviction.
+
+    Thread contract: admission matching and release-side inserts will run
+    on different threads once the async serving engine lands, and the
+    allocator's pressure callback walks the tree mid-allocation — the node
+    structure lives under a reentrant ``_lock`` (eviction paths re-enter
+    via ``remove``). Lock ordering is allocator -> tree: the one path that
+    touches both (pressure eviction, incl. its ``prefer`` callback reading
+    refcounts) always enters through the allocator first."""
+
+    root: guarded_by("_lock")
+    _clock: guarded_by("_lock")
+    _num_nodes: guarded_by("_lock")
 
     def __init__(self, block_size: int):
         self.block_size = int(block_size)
+        self._lock = threading.RLock()
         self.root = RadixNode(key=None, block=-1, parent=None)
         self._clock = 0
         self._num_nodes = 0
@@ -54,10 +70,12 @@ class RadixTree:
     # ---- introspection -------------------------------------------------
 
     def __len__(self) -> int:
-        return self._num_nodes
+        with self._lock:
+            return self._num_nodes
 
     def num_blocks(self) -> int:
-        return self._num_nodes
+        with self._lock:
+            return self._num_nodes
 
     def _chunks(self, tokens: Sequence[int]):
         bs = self.block_size
@@ -65,6 +83,7 @@ class RadixTree:
         for i in range(n_full):
             yield tuple(int(t) for t in tokens[i * bs:(i + 1) * bs])
 
+    @holds_lock("_lock")
     def _tick(self) -> int:
         self._clock += 1
         return self._clock
@@ -75,16 +94,17 @@ class RadixTree:
         """Longest cached prefix of ``tokens``, as pool block ids (block-
         aligned: covers ``len(result) * block_size`` tokens). Touches the
         matched path's LRU stamps."""
-        now = self._tick()
-        node, blocks = self.root, []
-        for chunk in self._chunks(tokens):
-            child = node.children.get(chunk)
-            if child is None:
-                break
-            child.last_access = now
-            blocks.append(child.block)
-            node = child
-        return blocks
+        with self._lock:
+            now = self._tick()
+            node, blocks = self.root, []
+            for chunk in self._chunks(tokens):
+                child = node.children.get(chunk)
+                if child is None:
+                    break
+                child.last_access = now
+                blocks.append(child.block)
+                node = child
+            return blocks
 
     def insert(self, tokens: Sequence[int], blocks: Sequence[int]) -> List[int]:
         """Record a cached sequence. ``blocks[i]`` must hold the K/V of the
@@ -93,42 +113,45 @@ class RadixTree:
         identical by construction, K/V of a token depends only on its
         prefix). Returns the block ids the tree newly ADOPTED; the caller
         owns taking a reference on each."""
-        now = self._tick()
-        node, adopted = self.root, []
-        for i, chunk in enumerate(self._chunks(tokens)):
-            if i >= len(blocks):
-                break
-            child = node.children.get(chunk)
-            if child is None:
-                child = RadixNode(key=chunk, block=int(blocks[i]),
-                                  parent=node)
-                node.children[chunk] = child
-                self._num_nodes += 1
-                adopted.append(child.block)
-            child.last_access = now
-            node = child
-        return adopted
+        with self._lock:
+            now = self._tick()
+            node, adopted = self.root, []
+            for i, chunk in enumerate(self._chunks(tokens)):
+                if i >= len(blocks):
+                    break
+                child = node.children.get(chunk)
+                if child is None:
+                    child = RadixNode(key=chunk, block=int(blocks[i]),
+                                      parent=node)
+                    node.children[chunk] = child
+                    self._num_nodes += 1
+                    adopted.append(child.block)
+                child.last_access = now
+                node = child
+            return adopted
 
     # ---- eviction --------------------------------------------------------
 
     def leaves(self) -> List[RadixNode]:
-        out, stack = [], list(self.root.children.values())
-        while stack:
-            n = stack.pop()
-            if n.is_leaf():
-                out.append(n)
-            else:
-                stack.extend(n.children.values())
-        return out
+        with self._lock:
+            out, stack = [], list(self.root.children.values())
+            while stack:
+                n = stack.pop()
+                if n.is_leaf():
+                    out.append(n)
+                else:
+                    stack.extend(n.children.values())
+            return out
 
     def remove(self, node: RadixNode) -> int:
         """Unlink one LEAF node; returns its block id (the caller drops the
         tree's reference on it)."""
-        if node.children:
-            raise ValueError("only leaf nodes can be evicted")
-        del node.parent.children[node.key]
-        self._num_nodes -= 1
-        return node.block
+        with self._lock:
+            if node.children:
+                raise ValueError("only leaf nodes can be evicted")
+            del node.parent.children[node.key]
+            self._num_nodes -= 1
+            return node.block
 
     def evict_lru(self, max_nodes: int = 1,
                   prefer=None) -> List[int]:
@@ -137,26 +160,28 @@ class RadixTree:
         reclaimable' — so pinned blocks are only dropped when nothing
         better remains. Returns the released block ids."""
         released = []
-        for _ in range(max_nodes):
-            cand = self.leaves()
-            if not cand:
-                break
-            if prefer is not None:
-                cand.sort(key=lambda n: (prefer(n), n.last_access))
-            else:
-                cand.sort(key=lambda n: n.last_access)
-            released.append(self.remove(cand[0]))
+        with self._lock:
+            for _ in range(max_nodes):
+                cand = self.leaves()
+                if not cand:
+                    break
+                if prefer is not None:
+                    cand.sort(key=lambda n: (prefer(n), n.last_access))
+                else:
+                    cand.sort(key=lambda n: n.last_access)
+                released.append(self.remove(cand[0]))
         return released
 
     def flush(self) -> List[int]:
         """Drop every node (weight hot-swap invalidates all cached KV).
         Returns every block id the tree was holding."""
-        released = []
-        stack = list(self.root.children.values())
-        while stack:
-            n = stack.pop()
-            released.append(n.block)
-            stack.extend(n.children.values())
-        self.root.children.clear()
-        self._num_nodes = 0
-        return released
+        with self._lock:
+            released = []
+            stack = list(self.root.children.values())
+            while stack:
+                n = stack.pop()
+                released.append(n.block)
+                stack.extend(n.children.values())
+            self.root.children.clear()
+            self._num_nodes = 0
+            return released

@@ -40,6 +40,7 @@ from paddle_tpu.models.kv_cache import (
     PagedCacheSlot,
 )
 from paddle_tpu.models.serving import SlotStep, _bucket
+from paddle_tpu.observability.annotations import hot_path
 from paddle_tpu.observability.request_trace import (
     PHASE_ADMIT,
     PHASE_PREEMPTED,
@@ -267,6 +268,7 @@ class ContinuousBatchingScheduler:
             trace.event("preempt", slot=slot,
                         generated_tokens=req.num_generated)
 
+    @hot_path(reason="runs per decode iteration under block_accounting")
     def _ensure_decode_capacity(self, slot: int) -> bool:
         """Guarantee the slot can write one more token; preempt other
         sequences (or finally the slot itself) when the pool is dry.
@@ -288,6 +290,7 @@ class ContinuousBatchingScheduler:
                     return False
                 self._preempt(victim)
 
+    @hot_path(reason="admission host work delays every running decode")
     def _admit(self) -> List[Request]:
         """Fill free slots from the queue via prefill-then-pack.
 
@@ -383,6 +386,10 @@ class ContinuousBatchingScheduler:
                 self._store_pools(caches)
             prefill_s = pc() - t0
             t0 = pc()
+            # the ONE deliberate admission sync: the first sampled token
+            # decides eos/packing. Timed manually (sync_s also feeds the
+            # trace subspan) and recorded as sampling_sync below.
+            # graft-lint: disable-next=host-sync-in-hot-loop (metered)
             tok = int(np.asarray(next_ids.numpy())[0])
             sync_s = pc() - t0
             self.metrics.prefills += 1
@@ -420,6 +427,7 @@ class ContinuousBatchingScheduler:
                 - prefill_s)
         return finished
 
+    @hot_path(reason="the decode-loop iteration itself")
     def _decode_once(self) -> List[Request]:
         """One fixed-shape decode iteration over every running slot.
 
@@ -449,9 +457,8 @@ class ContinuousBatchingScheduler:
                 paddle.to_tensor(tok), paddle.to_tensor(pos), caches,
                 paddle.to_tensor(np.zeros(S, np.int32)))
             self._store_pools(caches)
-        t0 = pc()
-        step_np = np.asarray(next_ids.numpy())
-        self.stall.record("sampling_sync", pc() - t0)
+        with self.stall.timed("sampling_sync"):
+            step_np = np.asarray(next_ids.numpy())
         self.metrics.decode_steps += 1
         finished = []
         stream_s = 0.0
@@ -478,6 +485,7 @@ class ContinuousBatchingScheduler:
         return bool(len(self.queue)) or any(
             r is not None for r in self._slots)
 
+    @hot_path(reason="one scheduler iteration: admit + decode")
     def step(self) -> List[RequestOutput]:
         """One scheduler iteration: admit into free slots (prefill), then
         one decode step; returns outputs finishing this iteration. Each

@@ -1,0 +1,606 @@
+"""graft_lint: the framework-invariant static-analysis suite as tier-1.
+
+Three layers of pinning:
+
+1. Fixture tests per rule — one known-bad and one known-clean snippet per
+   checker, run through the real driver machinery (no jax devices needed:
+   the suite is stdlib-ast only).
+2. Suppression + baseline round trips — ``# graft-lint: disable=...`` in
+   its three forms, and the accepted-findings baseline absorbing exactly
+   the findings it records (a NEW finding still fails).
+3. The acceptance bar, both directions: ``python tools/lint.py`` over the
+   real repo exits 0 with zero non-baselined findings, and seeding a
+   known-bad construct makes it exit non-zero with a correct file:line.
+
+Plus regression tests for the real bugs the first full-repo run surfaced
+(unguarded registry/histogram/flight-recorder state shared with the
+ObservabilityEndpoint scrape thread).
+"""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+import threading
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+from tools.graft_lint import Baseline, run_lint  # noqa: E402
+from tools.graft_lint.core import Module  # noqa: E402
+
+
+def _lint(tmp_path, rules=None, baseline=None):
+    """Run the suite over the tmp fixture tree; returns (report, findings
+    as dicts)."""
+    report = run_lint(str(tmp_path), [str(tmp_path)], rules=rules,
+                      baseline_path=baseline
+                      or str(tmp_path / "no_baseline.json"))
+    report.pop("_finding_objs")
+    return report
+
+
+def _write(tmp_path, name, src):
+    p = tmp_path / name
+    p.write_text(textwrap.dedent(src))
+    return p
+
+
+def _rules_hit(report, rule):
+    return [f for f in report["findings"] if f["rule"] == rule
+            and not f["suppressed"] and not f["baselined"]]
+
+
+# ---------------------------------------------------------------- fixtures
+
+def test_tracing_hazard_bad_and_clean(tmp_path):
+    _write(tmp_path, "mod.py", """
+        import jax.numpy as jnp
+        import numpy as np
+
+        def to_static(fn):
+            return fn
+
+        def helper(x):
+            return x.item() + 1          # hazard, reachable via traced()
+
+        @to_static
+        def traced(x):
+            if bool(x):                   # hazard: bool() on traced value
+                return helper(x)
+            return jnp.sum(x)             # clean: stays in jnp
+
+        def eager_only(x):
+            return np.asarray(x).item()   # NOT reachable from a trace root
+    """)
+    report = _lint(tmp_path, rules=["tracing-hazard"])
+    hits = _rules_hit(report, "tracing-hazard")
+    symbols = {f["symbol"] for f in hits}
+    assert "helper" in symbols            # call-graph reachability
+    assert "traced" in symbols            # direct hazard in the root
+    assert "eager_only" not in symbols    # eager code is out of scope
+    assert all(f["file"] == "mod.py" and f["line"] > 0 for f in hits)
+
+
+def test_recompile_hazard_bad_and_clean(tmp_path):
+    _write(tmp_path, "mod.py", """
+        import numpy as np
+
+        def _bucket(n, lo=16):
+            b = lo
+            while b < n:
+                b *= 2
+            return b
+
+        class Sched:
+            def bad(self, ids):
+                P = len(ids)
+                a = np.zeros((1, P), np.int32)      # raw data-dep width
+                return self._step_fn(a)
+
+            def good(self, ids):
+                Pb = min(_bucket(len(ids)), 512)
+                a = np.zeros((1, Pb), np.int32)     # bucketed: clean
+                return self._step_fn(a)
+
+            def no_jit_here(self, ids):
+                return np.zeros((len(ids),))        # no jit callsite: clean
+    """)
+    report = _lint(tmp_path, rules=["recompile-hazard"])
+    hits = _rules_hit(report, "recompile-hazard")
+    assert [f["symbol"] for f in hits] == ["Sched.bad"]
+
+
+def test_host_sync_in_hot_loop_bad_and_clean(tmp_path):
+    _write(tmp_path, "mod.py", """
+        import numpy as np
+
+        def hot_path(fn=None, **kw):
+            def mark(f):
+                return f
+            return mark if fn is None else fn
+
+        class Loop:
+            @hot_path
+            def decode(self, t):
+                bad = np.asarray(t.numpy())          # unmetered sync
+                with self.stall.timed("sampling_sync"):
+                    ok = np.asarray(t.numpy())       # metered: allowed
+                return bad, ok
+
+            def not_hot(self, t):
+                return t.numpy()                     # unannotated: clean
+    """)
+    report = _lint(tmp_path, rules=["host-sync-in-hot-loop"])
+    hits = _rules_hit(report, "host-sync-in-hot-loop")
+    assert hits and all(f["symbol"] == "Loop.decode" for f in hits)
+    # only the unmetered line fires (np.asarray + .numpy on one line)
+    assert {f["line"] for f in hits} == {min(f["line"] for f in hits)}
+
+
+def test_guarded_by_bad_and_clean(tmp_path):
+    _write(tmp_path, "mod.py", """
+        import threading
+
+        def guarded_by(lock):
+            return lock
+
+        def holds_lock(lock):
+            def mark(f):
+                return f
+            return mark
+
+        class Ring:
+            _items: guarded_by("_lock")
+
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._items = []                 # exempt: __init__
+
+            def bad_push(self, x):
+                self._items.append(x)            # unguarded
+
+            def good_push(self, x):
+                with self._lock:
+                    self._items.append(x)
+
+            @holds_lock("_lock")
+            def _pop_locked(self):
+                return self._items.pop()         # caller holds the lock
+
+        class SubRing(Ring):
+            def bad_sub(self):
+                return len(self._items)          # inherited declaration
+    """)
+    report = _lint(tmp_path, rules=["guarded-by"])
+    hits = _rules_hit(report, "guarded-by")
+    assert {f["symbol"] for f in hits} == {"Ring.bad_push", "SubRing.bad_sub"}
+
+
+def test_donation_alias_bad_and_clean(tmp_path):
+    _write(tmp_path, "mod.py", """
+        import jax
+
+        class Step:
+            def __init__(self, fn, donate):
+                self._donate_argnums = (0, 2) if donate else ()
+                self._jitted = jax.jit(
+                    fn, donate_argnums=self._donate_argnums)
+
+            def bad(self, x, y, z):
+                out = self._jitted(x, y, z)
+                return out + x               # x (argnum 0) re-read
+
+            def good(self, x, y, z):
+                out = self._jitted(x, y, z)
+                x = out * 2                  # rebind kills the taint
+                return x + y                 # y (argnum 1) is not donated
+    """)
+    report = _lint(tmp_path, rules=["donation-alias"])
+    hits = _rules_hit(report, "donation-alias")
+    assert [f["symbol"] for f in hits] == ["Step.bad"]
+    assert "`x`" in hits[0]["message"]
+
+
+# ------------------------------------------------- suppressions + baseline
+
+def test_suppression_forms(tmp_path):
+    _write(tmp_path, "mod.py", """
+        def hot_path(fn):
+            return fn
+
+        class A:
+            @hot_path
+            def f(self, t):
+                a = t.numpy()  # graft-lint: disable=host-sync-in-hot-loop
+                # graft-lint: disable-next=host-sync-in-hot-loop (reason
+                # may span further comment lines before the code line)
+                b = t.numpy()
+                c = t.numpy()
+                return a, b, c
+    """)
+    report = _lint(tmp_path, rules=["host-sync-in-hot-loop"])
+    hits = _rules_hit(report, "host-sync-in-hot-loop")
+    assert len(hits) == 1                 # only the un-suppressed line
+    assert report["counts"]["suppressed"] == 2
+
+    _write(tmp_path, "mod.py", """
+        # graft-lint: disable-file=host-sync-in-hot-loop
+        def hot_path(fn):
+            return fn
+
+        class A:
+            @hot_path
+            def f(self, t):
+                return t.numpy()
+    """)
+    report = _lint(tmp_path, rules=["host-sync-in-hot-loop"])
+    assert report["ok"]
+    assert report["counts"]["suppressed"] == 1
+
+
+def test_baseline_round_trip(tmp_path):
+    src = """
+        def hot_path(fn):
+            return fn
+
+        class A:
+            @hot_path
+            def f(self, t):
+                return t.numpy()
+    """
+    _write(tmp_path, "mod.py", src)
+    bl = tmp_path / "baseline.json"
+    report = run_lint(str(tmp_path), [str(tmp_path)],
+                      baseline_path=str(bl))
+    assert not report["ok"]
+    Baseline.write(str(bl), report["_finding_objs"])
+
+    # same findings -> absorbed, exit clean
+    report2 = _lint(tmp_path, baseline=str(bl))
+    assert report2["ok"]
+    assert report2["counts"]["baselined"] == 1
+
+    # a NEW finding of the same rule/file is NOT absorbed (counted entries)
+    _write(tmp_path, "mod.py", src + """
+            @hot_path
+            def g(self, t):
+                return t.numpy()
+    """)
+    report3 = _lint(tmp_path, baseline=str(bl))
+    assert not report3["ok"]
+    assert report3["counts"]["baselined"] == 1
+    assert report3["counts"]["failing"] == 1
+
+
+def test_fingerprint_survives_line_shifts(tmp_path):
+    """Baseline entries are line-free: edits above a finding don't
+    invalidate it."""
+    _write(tmp_path, "mod.py", """
+        def hot_path(fn):
+            return fn
+
+        class A:
+            @hot_path
+            def f(self, t):
+                return t.numpy()
+    """)
+    bl = tmp_path / "baseline.json"
+    report = run_lint(str(tmp_path), [str(tmp_path)], baseline_path=str(bl))
+    Baseline.write(str(bl), report["_finding_objs"])
+    _write(tmp_path, "mod.py", """
+        # a new comment block
+        # shifting every line below it
+        def hot_path(fn):
+            return fn
+
+        class A:
+            @hot_path
+            def f(self, t):
+                return t.numpy()
+    """)
+    report2 = _lint(tmp_path, baseline=str(bl))
+    assert report2["ok"] and report2["counts"]["baselined"] == 1
+
+
+def test_span_checker_runs_in_suite():
+    """The folded-in sixth checker reconciles the real manifest through
+    the one lint entry point."""
+    report = run_lint(REPO, [os.path.join(REPO, "paddle_tpu")],
+                      rules=["span-manifest"])
+    report.pop("_finding_objs")
+    assert report["ok"], report["findings"]
+    assert report["rules"] == ["span-manifest"]
+
+
+# ----------------------------------------------- acceptance: both directions
+
+def test_lint_repo_exits_zero():
+    """Direction 1: the shipped tree is clean (every finding fixed,
+    suppressed with a reason, or explicitly baselined)."""
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "lint.py"), "--json"],
+        capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0, r.stdout[-3000:]
+    rep = json.loads(r.stdout)
+    assert rep["ok"] and rep["files_scanned"] > 200
+    assert len(rep["rules"]) == 6
+
+
+def test_lint_catches_seeded_bad_construct(tmp_path):
+    """Direction 2: a known-bad construct (unguarded guarded_by write, and
+    a .item() in a hot decode loop) exits non-zero with correct
+    file:line findings."""
+    src = textwrap.dedent("""
+        import threading
+
+        def guarded_by(lock):
+            return lock
+
+        def hot_path(fn):
+            return fn
+
+        class Sched:
+            _slots: guarded_by("_lock")
+
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._slots = []
+
+            @hot_path
+            def _decode_once(self, next_ids):
+                self._slots.append(1)
+                return next_ids.item()
+    """)
+    bad = tmp_path / "bad.py"
+    bad.write_text(src)
+    lines = src.splitlines()
+    slots_line = lines.index("        self._slots.append(1)") + 1
+    item_line = lines.index("        return next_ids.item()") + 1
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "lint.py"),
+         "--root", str(tmp_path), "--baseline", str(tmp_path / "bl.json")],
+        capture_output=True, text=True, timeout=120)
+    assert r.returncode != 0
+    assert f"bad.py:{slots_line}" in r.stdout       # guarded-by
+    assert f"bad.py:{item_line}" in r.stdout        # host-sync-in-hot-loop
+    assert "[guarded-by]" in r.stdout
+    assert "[host-sync-in-hot-loop]" in r.stdout
+
+
+def test_changed_mode_scopes_findings(tmp_path):
+    """--changed machinery: findings restricted to the given file set."""
+    _write(tmp_path, "one.py", """
+        def hot_path(fn):
+            return fn
+
+        class A:
+            @hot_path
+            def f(self, t):
+                return t.numpy()
+    """)
+    _write(tmp_path, "two.py", """
+        def hot_path(fn):
+            return fn
+
+        class B:
+            @hot_path
+            def g(self, t):
+                return t.numpy()
+    """)
+    report = run_lint(str(tmp_path), [str(tmp_path)],
+                      baseline_path=str(tmp_path / "bl.json"),
+                      changed_files=["one.py"])
+    report.pop("_finding_objs")
+    assert {f["file"] for f in report["findings"]} == {"one.py"}
+
+
+def test_parse_error_is_a_finding(tmp_path):
+    (tmp_path / "broken.py").write_text("def f(:\n")
+    report = _lint(tmp_path)
+    assert not report["ok"]
+    assert report["findings"][0]["rule"] == "parse-error"
+
+
+def test_module_suppression_parsing():
+    m = Module("x.py", "x.py",
+               "a = 1  # graft-lint: disable=r1,r2\n"
+               "# graft-lint: disable-file=r3\n")
+    assert m.is_suppressed("r1", 1) and m.is_suppressed("r2", 1)
+    assert not m.is_suppressed("r1", 2)
+    assert m.is_suppressed("r3", 99)     # file-wide, any line
+
+
+# ------------------------------------------ regressions from the first run
+
+def test_registry_scrape_during_metric_creation_regression():
+    """FIXED by this PR: MetricsRegistry.snapshot()/prometheus_text() read
+    ``_metrics`` (and label families read ``_children``) without the lock,
+    so an endpoint scrape racing lazy metric creation died with
+    "OrderedDict mutated during iteration". Hammer both sides."""
+    from paddle_tpu.observability.metrics import MetricsRegistry
+
+    reg = MetricsRegistry("lint_regression")
+    errs = []
+    stop = threading.Event()
+
+    def creator():
+        i = 0
+        fam = reg.counter("family")
+        while not stop.is_set() and i < 30000:
+            reg.counter(f"c{i}").inc()
+            fam.labels(k=str(i)).inc()
+            if i % 3 == 0:
+                reg.histogram(f"h{i}").record(i)
+            i += 1
+
+    def scraper():
+        try:
+            while not stop.is_set():
+                reg.snapshot()
+                reg.prometheus_text()
+        except RuntimeError as e:        # the pre-fix failure mode
+            errs.append(e)
+
+    threads = [threading.Thread(target=creator, daemon=True),
+               threading.Thread(target=scraper, daemon=True),
+               threading.Thread(target=scraper, daemon=True)]
+    for t in threads:
+        t.start()
+    threads[0].join(timeout=30)
+    stop.set()
+    for t in threads[1:]:
+        t.join(timeout=10)
+    assert not errs, f"scrape raced metric creation: {errs[0]!r}"
+
+
+def test_histogram_concurrent_record_is_exact():
+    """FIXED by this PR: Histogram had no lock — concurrent record() lost
+    count/total updates and the reservoir raced summary()'s numpy read.
+    With the lock, count/total are exact under contention."""
+    from paddle_tpu.observability.metrics import Histogram
+
+    h = Histogram(max_samples=256)
+    N, T = 20000, 4
+    errs = []
+
+    def writer():
+        try:
+            for i in range(N):
+                h.record(1.0)
+                if i % 500 == 0:
+                    h.summary()
+        except Exception as e:
+            errs.append(e)
+
+    threads = [threading.Thread(target=writer) for _ in range(T)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+    assert not errs
+    assert h.count == N * T
+    assert h.total == float(N * T)
+    assert h.summary()["count"] == N * T
+
+
+def test_flight_recorder_concurrent_alarm_and_dump():
+    """FIXED by this PR: FlightRecorder.__len__/alarm touched the ring and
+    the frozen alarm snapshot without the lock."""
+    from paddle_tpu.observability.serving_stall import FlightRecorder
+
+    fr = FlightRecorder(max_steps=64)
+    errs = []
+
+    def stepper():
+        try:
+            for i in range(5000):
+                fr.record_step(i=i)
+                if i % 50 == 0:
+                    fr.alarm("test", f"at {i}")
+        except Exception as e:
+            errs.append(e)
+
+    def reader():
+        try:
+            for _ in range(2000):
+                len(fr)
+                fr.dump(last=8)
+                _ = fr.last_alarm_dump
+                _ = fr.steps_recorded
+        except Exception as e:
+            errs.append(e)
+
+    threads = [threading.Thread(target=stepper),
+               threading.Thread(target=reader),
+               threading.Thread(target=reader)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+    assert not errs
+    assert fr.steps_recorded == 5000
+    assert fr.last_alarm_dump is not None
+    assert fr.last_alarm_dump["kind"] == "test"
+
+
+def test_request_tracer_get_concurrent_with_finish():
+    """FIXED by this PR: RequestTracer.get() read the live/done dicts
+    without the lock while finish() rebalanced them."""
+    from paddle_tpu.observability.request_trace import RequestTracer
+
+    tr = RequestTracer(enabled=True, max_completed=32)
+    errs = []
+
+    def lifecycle():
+        try:
+            for i in range(4000):
+                tr.start(i)
+                tr.finish(i)
+        except Exception as e:
+            errs.append(e)
+
+    def getter():
+        try:
+            for i in range(8000):
+                tr.get(i % 4000)
+        except Exception as e:
+            errs.append(e)
+
+    threads = [threading.Thread(target=lifecycle),
+               threading.Thread(target=getter)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+    assert not errs
+
+
+def test_annotations_are_runtime_inert():
+    from paddle_tpu.observability.annotations import (
+        guarded_by,
+        holds_lock,
+        hot_path,
+    )
+
+    @hot_path
+    def f():
+        return 41
+
+    @hot_path(reason="why")
+    def g():
+        return 42
+
+    @holds_lock("_lock")
+    def h():
+        return 43
+
+    assert f() == 41 and g() == 42 and h() == 43
+    assert f.__graft_hot_path__ is True
+    assert g.__graft_hot_path__ == "why"
+    assert h.__graft_holds_lock__ == "_lock"
+    assert guarded_by("_lock").lock == "_lock"
+    assert "guarded_by" in repr(guarded_by("_lock"))
+
+
+def test_bench_json_canonicalization(tmp_path):
+    """Satellite: bench artifacts write with sorted keys + stable floats,
+    so a no-change re-run is a no-diff."""
+    from tools.bench_io import canonical, write_bench_json
+
+    art_a = {"b": 0.1 + 0.2, "a": [3.0, {"z": 1, "y": 2.0000000001}],
+             "n": None, "t": True}
+    art_b = {"t": True, "n": None,
+             "a": [3, {"y": 2.0, "z": 1}], "b": 0.3}
+    p1, p2 = tmp_path / "a.json", tmp_path / "b.json"
+    write_bench_json(str(p1), art_a)
+    write_bench_json(str(p2), art_b)
+    assert p1.read_text() == p2.read_text()      # byte-identical
+    assert canonical(float("nan")) == "nan"
+    assert canonical(0.123456789) == 0.123457
+    assert canonical(66.0) == 66
+    assert json.loads(p1.read_text())["b"] == 0.3

@@ -362,7 +362,10 @@ def test_serve_bench_prefix_share_writes_artifact(tmp_path):
     assert on_disk["baseline_no_cache"]["0.9"]["prefix_cache"] is None
     assert on_disk["prefill_tokens_saved_at_top_share"] > 0
     assert "ttft_reduction_pct_at_top_share" in on_disk
-    assert artifact == on_disk
+    # the on-disk form is the canonicalized artifact (sorted keys, stable
+    # floats — no-change re-runs must be no-diff)
+    from tools.bench_io import canonical, write_bench_json
+
+    assert on_disk == canonical(artifact)
     root_art = os.path.join(REPO, "BENCH_serving_prefix.json")
-    with open(root_art, "w") as f:
-        json.dump(on_disk, f, indent=2)
+    write_bench_json(root_art, on_disk)

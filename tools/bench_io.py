@@ -1,0 +1,51 @@
+"""Deterministic bench-artifact JSON: sorted keys, stable floats.
+
+``BENCH_*.json`` files are checked in as the perf trajectory, so their
+diffs should be signal. Historically a re-run could rewrite the file with
+reordered keys (dicts assembled on different code paths) and full-precision
+float repr noise (``0.30000000000000004``), producing churn-only commits.
+``write_bench_json`` canonicalizes both:
+
+- keys are emitted sorted at every nesting level;
+- floats are rounded to 6 significant digits (measurements here are
+  timings and ratios — nothing carries 17 significant digits of meaning),
+  with non-finite values stringified so the artifact stays valid JSON;
+- a trailing newline, so text tools diff cleanly.
+
+A no-change re-run therefore produces a byte-identical file, and a real
+perf delta still shows up as a real diff.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+
+
+def canonical(obj, sig_digits: int = 6):
+    """Recursively canonicalize an artifact tree for stable serialization."""
+    if isinstance(obj, dict):
+        return {str(k): canonical(v, sig_digits) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [canonical(v, sig_digits) for v in obj]
+    if isinstance(obj, bool) or obj is None:
+        return obj
+    if isinstance(obj, float):
+        if not math.isfinite(obj):
+            return repr(obj)            # "inf"/"nan": keep JSON valid
+        if obj == 0.0:
+            return 0.0
+        rounded = float(f"{obj:.{sig_digits}g}")
+        # integral floats render as ints ("3.0" -> 3): repr-stable across
+        # runs and platforms
+        return int(rounded) if rounded == int(rounded) \
+            and abs(rounded) < 1e15 else rounded
+    return obj
+
+
+def write_bench_json(path: str, artifact, indent: int = 2) -> str:
+    """Write one canonicalized artifact; returns the path."""
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(canonical(artifact), f, indent=indent, sort_keys=True)
+        f.write("\n")
+    return path

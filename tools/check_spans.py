@@ -1,18 +1,14 @@
 #!/usr/bin/env python
-"""Span-name manifest lint: every trace span has an owner, no entry rots.
+"""Span-name manifest lint — thin shim over tools/graft_lint/spancheck.py.
 
-Scans ``paddle_tpu/`` for ``RecordEvent(...)`` call sites and reconciles
-them against ``paddle_tpu.observability.span_manifest``:
+The implementation moved into the graft_lint suite (it runs there as the
+``span-manifest`` checker, one of six under ``python tools/lint.py``).
+This entry point keeps the PR-6 contract working unchanged:
 
-- a literal span name emitted but not registered      -> FAIL (who owns it?)
-- a registered span name no call site emits anymore   -> FAIL (stale entry)
-- a non-literal (runtime-built) call site whose file
-  is not declared in ``DYNAMIC_SPANS``                -> FAIL (undeclared
-  dynamic span names would silently dodge the manifest)
+    python tools/check_spans.py [--root DIR] [--json]   # exit 0/1
 
-Runs standalone (``python tools/check_spans.py``, exit code 0/1) and as a
-tier-1 test (``tests/test_check_spans.py``). Pure text scan — no jax, no
-imports of the scanned modules — so it is fast and environment-proof.
+and re-exports ``scan_spans`` / ``check_spans`` for callers that import
+the tool directly (tests/test_check_spans.py loads this file by path).
 """
 
 from __future__ import annotations
@@ -20,84 +16,16 @@ from __future__ import annotations
 import argparse
 import json
 import os
-import re
 import sys
-from typing import Dict, List
 
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 if REPO_ROOT not in sys.path:
     sys.path.insert(0, REPO_ROOT)
 
-# literal first arg: RecordEvent("name" ...
-_LITERAL = re.compile(r'RecordEvent\(\s*([fub]*)"([^"]+)"')
-# any call site (to find the non-literal ones by subtraction)
-_ANY = re.compile(r"RecordEvent\(\s*([^)\s,]+)")
-
-
-def scan_spans(root: str) -> Dict[str, object]:
-    """Walk ``root`` for .py files; return literal span names (with their
-    files) and non-literal call sites."""
-    literals: Dict[str, List[str]] = {}
-    dynamic_sites: List[Dict[str, object]] = []
-    for dirpath, dirnames, filenames in os.walk(root):
-        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
-        for fn in filenames:
-            # the registry itself names spans in prose, not as call sites
-            if not fn.endswith(".py") or fn == "span_manifest.py":
-                continue
-            path = os.path.join(dirpath, fn)
-            rel = os.path.relpath(path, os.path.dirname(root)).replace(
-                os.sep, "/")
-            with open(path, encoding="utf-8") as f:
-                for lineno, line in enumerate(f, 1):
-                    if "RecordEvent(" not in line:
-                        continue
-                    # class/def/import lines are not call sites
-                    stripped = line.strip()
-                    if stripped.startswith(("class ", "def ", "from ",
-                                            "import ", "#")):
-                        continue
-                    m = _LITERAL.search(line)
-                    if m:
-                        prefix, name = m.groups()
-                        if "f" in prefix:      # f-string: treat as dynamic
-                            dynamic_sites.append(
-                                {"file": rel, "line": lineno,
-                                 "arg": f'f"{name}"'})
-                        else:
-                            literals.setdefault(name, []).append(
-                                f"{rel}:{lineno}")
-                        continue
-                    m = _ANY.search(line)
-                    if m:
-                        dynamic_sites.append({"file": rel, "line": lineno,
-                                              "arg": m.group(1)})
-    return {"literals": literals, "dynamic_sites": dynamic_sites}
-
-
-def check_spans(root: str, manifest: Dict[str, dict],
-                dynamic: Dict[str, str]) -> Dict[str, object]:
-    """Reconcile a scan against a manifest; returns the full report with
-    ``ok`` plus the three violation lists."""
-    scan = scan_spans(root)
-    literals = scan["literals"]
-    unregistered = sorted(n for n in literals if n not in manifest)
-    stale = sorted(n for n in manifest if n not in literals)
-    undeclared_dynamic = [s for s in scan["dynamic_sites"]
-                          if s["file"] not in dynamic]
-    malformed = sorted(
-        n for n, entry in manifest.items()
-        if not (isinstance(entry, dict) and entry.get("owner")
-                and entry.get("category")))
-    return {
-        "ok": not (unregistered or stale or undeclared_dynamic or malformed),
-        "spans_emitted": {n: sites for n, sites in sorted(literals.items())},
-        "dynamic_sites": scan["dynamic_sites"],
-        "unregistered": unregistered,
-        "stale": stale,
-        "undeclared_dynamic": undeclared_dynamic,
-        "malformed_entries": malformed,
-    }
+from tools.graft_lint.spancheck import (  # noqa: E402,F401  (re-exports)
+    check_spans,
+    scan_spans,
+)
 
 
 def main(argv=None) -> int:

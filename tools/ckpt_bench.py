@@ -149,8 +149,9 @@ def main():
                         steps=args.steps)
         out = args.out or os.path.join(
             REPO_ROOT, f"BENCH_ckpt_{int(args.mb)}mb.json")
-    with open(out, "w") as f:
-        json.dump(art, f, indent=1)
+    from tools.bench_io import write_bench_json
+
+    write_bench_json(out, art)
     print(json.dumps(art, indent=1))
     print(f"wrote {out}")
 

@@ -1,0 +1,103 @@
+"""graft_lint: framework-invariant static analysis for this codebase.
+
+Six checkers over a shared stdlib-``ast`` module graph (no jax import, no
+execution of scanned code), each targeting an invariant the framework
+otherwise only defends at runtime:
+
+- ``tracing-hazard``        host-value escapes reachable from jit trace
+                            roots (the build-time twin of a trace crash)
+- ``recompile-hazard``      data-dependent shapes at jit callsites without
+                            bucketing (static RecompileStorm)
+- ``host-sync-in-hot-loop`` blocking syncs inside ``@hot_path`` sections
+- ``guarded-by``            lock discipline over declared shared state
+- ``donation-alias``        donated jit buffers re-read after the call
+- ``span-manifest``         RecordEvent names vs. span_manifest.py
+
+Driver: ``python tools/lint.py`` (``--json``, ``--changed``,
+``--baseline``, ``--write-baseline``). Suppression:
+``# graft-lint: disable=<rule>`` (same line), ``disable-next=``,
+``disable-file=``. Accepted pre-existing findings live in
+``tools/graft_lint/baseline.json``.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Dict, List, Optional
+
+from tools.graft_lint.callgraph import FunctionIndex
+from tools.graft_lint.check_donation import DonationAliasChecker
+from tools.graft_lint.check_hostsync import HostSyncChecker
+from tools.graft_lint.check_locks import GuardedByChecker
+from tools.graft_lint.check_recompile import RecompileHazardChecker
+from tools.graft_lint.check_tracing import TracingHazardChecker
+from tools.graft_lint.core import Baseline, Finding, ModuleGraph
+from tools.graft_lint.spancheck import SpanManifestChecker
+
+__all__ = ["ALL_CHECKERS", "Baseline", "Finding", "ModuleGraph",
+           "default_baseline_path", "run_lint"]
+
+ALL_CHECKERS = (
+    TracingHazardChecker,
+    RecompileHazardChecker,
+    HostSyncChecker,
+    GuardedByChecker,
+    DonationAliasChecker,
+    SpanManifestChecker,
+)
+
+
+def default_baseline_path() -> str:
+    return os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "baseline.json")
+
+
+def run_lint(repo_root: str, roots: List[str],
+             rules: Optional[List[str]] = None,
+             baseline_path: Optional[str] = None,
+             changed_files: Optional[List[str]] = None) -> Dict[str, object]:
+    """Run the suite; returns the JSON-able report.
+
+    ``rules``: restrict to these rule names (default: all).
+    ``changed_files``: repo-relative paths — findings outside them are
+    dropped (the ``--changed`` fast path for pre-commit use).
+    """
+    t0 = time.perf_counter()
+    graph = ModuleGraph(repo_root, roots)
+    index = FunctionIndex(graph)
+    findings: List[Finding] = list(graph.parse_errors)
+    checkers = [c() for c in ALL_CHECKERS
+                if rules is None or c.rule in rules]
+    for checker in checkers:
+        findings.extend(checker.run(graph, index))
+    findings.sort(key=lambda f: (f.file, f.line, f.col, f.rule))
+
+    for f in findings:
+        mod = graph.by_rel.get(f.file)
+        if mod is not None and mod.is_suppressed(f.rule, f.line):
+            f.suppressed = True
+
+    if changed_files is not None:
+        changed = set(changed_files)
+        findings = [f for f in findings if f.file in changed]
+
+    baseline = Baseline.load(baseline_path or default_baseline_path())
+    baseline.apply(findings)
+
+    failing = [f for f in findings if not f.suppressed and not f.baselined]
+    return {
+        "ok": not failing,
+        "roots": [os.path.relpath(r, repo_root) for r in graph.roots],
+        "files_scanned": len(graph.modules),
+        "rules": [c.rule for c in checkers],
+        "wall_s": round(time.perf_counter() - t0, 3),
+        "counts": {
+            "total": len(findings),
+            "failing": len(failing),
+            "suppressed": sum(f.suppressed for f in findings),
+            "baselined": sum(f.baselined for f in findings),
+        },
+        "findings": [f.to_dict() for f in findings],
+        "_finding_objs": findings,       # stripped before JSON output
+    }

@@ -1,0 +1,251 @@
+"""Function index + conservative intra-repo call graph for graft_lint.
+
+One pass over the ``ModuleGraph`` builds, per module:
+
+- every function/method (``FuncInfo``) with its decorators, enclosing
+  class, and annotation markers (``@hot_path``, ``@holds_lock("...")``);
+- per-class ``guarded_by`` declarations (``attr: guarded_by("_lock")`` in
+  the class body) merged across statically-resolvable base classes;
+- a name-resolution service that turns a ``Call`` node into the
+  ``FuncInfo`` it targets, for the three shapes that cover the codebase:
+  ``helper(...)`` (same module / from-import), ``self.method(...)``
+  (same class + resolvable bases), and ``mod.func(...)`` (module alias).
+
+Resolution is deliberately conservative: a call that cannot be resolved
+statically (``self._fn(...)``, callbacks, chained attributes) simply adds
+no edge. Checkers that walk reachability (tracing-hazard) therefore see a
+sound-but-incomplete graph — they can miss, they do not hallucinate.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from tools.graft_lint.core import Module, ModuleGraph, func_tail_name
+
+__all__ = ["ClassInfo", "FuncInfo", "FunctionIndex"]
+
+
+class FuncInfo:
+    """One function or method definition."""
+
+    __slots__ = ("module", "node", "name", "class_name", "decorators",
+                 "holds_lock", "is_hot", "hot_reason")
+
+    def __init__(self, module: Module, node: ast.AST, name: str,
+                 class_name: Optional[str]):
+        self.module = module
+        self.node = node
+        self.name = name
+        self.class_name = class_name
+        self.decorators: List[str] = []
+        self.holds_lock: Optional[str] = None
+        self.is_hot = False
+        self.hot_reason = ""
+        for dec in node.decorator_list:
+            call = dec if not isinstance(dec, ast.Call) else dec.func
+            tail = func_tail_name(call)
+            if tail:
+                self.decorators.append(tail)
+            if tail == "hot_path":
+                self.is_hot = True
+            if tail == "holds_lock" and isinstance(dec, ast.Call) \
+                    and dec.args and isinstance(dec.args[0], ast.Constant):
+                self.holds_lock = str(dec.args[0].value)
+
+    @property
+    def qualname(self) -> str:
+        return (f"{self.class_name}.{self.name}" if self.class_name
+                else self.name)
+
+    @property
+    def ref(self) -> str:
+        return f"{self.module.rel}::{self.qualname}"
+
+    def __repr__(self) -> str:
+        return f"FuncInfo({self.ref})"
+
+
+class ClassInfo:
+    """One class definition: methods, bases, guarded-by declarations."""
+
+    __slots__ = ("module", "node", "name", "methods", "base_names",
+                 "guarded")
+
+    def __init__(self, module: Module, node: ast.ClassDef):
+        self.module = module
+        self.node = node
+        self.name = node.name
+        self.methods: Dict[str, FuncInfo] = {}
+        self.base_names: List[str] = []
+        for b in node.bases:
+            tail = func_tail_name(b)
+            if tail:
+                self.base_names.append(tail)
+        # attr -> lock attr, from `attr: guarded_by("lock")` in the body
+        self.guarded: Dict[str, str] = {}
+        for stmt in node.body:
+            if not isinstance(stmt, ast.AnnAssign):
+                continue
+            ann = stmt.annotation
+            if isinstance(ann, ast.Call) \
+                    and func_tail_name(ann.func) == "guarded_by" \
+                    and ann.args and isinstance(ann.args[0], ast.Constant) \
+                    and isinstance(stmt.target, ast.Name):
+                self.guarded[stmt.target.id] = str(ann.args[0].value)
+
+
+class FunctionIndex:
+    """All functions/classes across the graph + call resolution."""
+
+    def __init__(self, graph: ModuleGraph):
+        self.graph = graph
+        # (module.rel, qualname) -> FuncInfo
+        self.funcs: Dict[Tuple[str, str], FuncInfo] = {}
+        # (module.rel, class name) -> ClassInfo
+        self.classes: Dict[Tuple[str, str], ClassInfo] = {}
+        # module-level functions per module: rel -> {name: FuncInfo}
+        self.module_funcs: Dict[str, Dict[str, FuncInfo]] = {}
+        for mod in graph.modules:
+            self._index_module(mod)
+
+    # ------------------------------------------------------------ indexing
+    def _index_module(self, mod: Module):
+        top = self.module_funcs.setdefault(mod.rel, {})
+        for node in mod.tree.body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                fi = FuncInfo(mod, node, node.name, None)
+                top[node.name] = fi
+                self.funcs[(mod.rel, fi.qualname)] = fi
+            elif isinstance(node, ast.ClassDef):
+                ci = ClassInfo(mod, node)
+                self.classes[(mod.rel, ci.name)] = ci
+                for stmt in node.body:
+                    if isinstance(stmt, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef)):
+                        fi = FuncInfo(mod, stmt, stmt.name, ci.name)
+                        ci.methods[stmt.name] = fi
+                        self.funcs[(mod.rel, fi.qualname)] = fi
+
+    # ----------------------------------------------------------- class MRO
+    def resolve_class(self, mod: Module, name: str) -> Optional[ClassInfo]:
+        ci = self.classes.get((mod.rel, name))
+        if ci is not None:
+            return ci
+        target = mod.imports.get(name)
+        if target and "." in target:
+            owner, cls = target.rsplit(".", 1)
+            owner_mod = self.graph.by_modname.get(owner)
+            if owner_mod is not None:
+                return self.classes.get((owner_mod.rel, cls))
+        return None
+
+    def class_chain(self, ci: ClassInfo) -> List[ClassInfo]:
+        """The class plus statically-resolvable bases (depth-first)."""
+        out, stack, seen = [], [ci], set()
+        while stack:
+            c = stack.pop(0)
+            if id(c) in seen:
+                continue
+            seen.add(id(c))
+            out.append(c)
+            for base in c.base_names:
+                bc = self.resolve_class(c.module, base)
+                if bc is not None:
+                    stack.append(bc)
+        return out
+
+    def guarded_attrs(self, ci: ClassInfo) -> Dict[str, str]:
+        """guarded_by declarations of a class, bases included (a subclass
+        inherits the parent's lock discipline)."""
+        merged: Dict[str, str] = {}
+        for c in reversed(self.class_chain(ci)):
+            merged.update(c.guarded)
+        return merged
+
+    def find_method(self, ci: ClassInfo, name: str) -> Optional[FuncInfo]:
+        for c in self.class_chain(ci):
+            fi = c.methods.get(name)
+            if fi is not None:
+                return fi
+        return None
+
+    # ------------------------------------------------------ call resolution
+    def resolve_call(self, caller: FuncInfo,
+                     call: ast.Call) -> Optional[FuncInfo]:
+        fn = call.func
+        mod = caller.module
+        if isinstance(fn, ast.Name):
+            # same-module helper, or a from-import of a repo function
+            local = self.module_funcs.get(mod.rel, {}).get(fn.id)
+            if local is not None:
+                return local
+            target = mod.imports.get(fn.id)
+            if target and "." in target:
+                owner, func = target.rsplit(".", 1)
+                owner_mod = self.graph.by_modname.get(owner)
+                if owner_mod is not None:
+                    return self.module_funcs.get(owner_mod.rel,
+                                                 {}).get(func)
+            return None
+        if isinstance(fn, ast.Attribute):
+            if isinstance(fn.value, ast.Name) and fn.value.id == "self" \
+                    and caller.class_name:
+                ci = self.classes.get((mod.rel, caller.class_name))
+                if ci is not None:
+                    return self.find_method(ci, fn.attr)
+                return None
+            if isinstance(fn.value, ast.Name):
+                # module-alias call: np.foo / rng.traced_key
+                target = mod.imports.get(fn.value.id)
+                if target:
+                    owner_mod = self.graph.by_modname.get(target)
+                    if owner_mod is not None:
+                        return self.module_funcs.get(owner_mod.rel,
+                                                     {}).get(fn.attr)
+        return None
+
+    def calls_of(self, fi: FuncInfo) -> List[Tuple[ast.Call, Optional[
+            "FuncInfo"]]]:
+        """Every Call in the function body (nested defs included) with its
+        resolution (None when not statically resolvable)."""
+        out = []
+        for node in ast.walk(fi.node):
+            if isinstance(node, ast.Call):
+                out.append((node, self.resolve_call(fi, node)))
+        return out
+
+    def reachable_from(self, roots: List[FuncInfo]) -> Dict[FuncInfo, List[
+            "FuncInfo"]]:
+        """BFS closure over resolvable calls. Returns {func: path} where
+        path is the root-to-func chain (root first, func excluded)."""
+        paths: Dict[FuncInfo, List[FuncInfo]] = {r: [] for r in roots}
+        queue = list(roots)
+        while queue:
+            cur = queue.pop(0)
+            for _, callee in self.calls_of(cur):
+                if callee is None or callee in paths:
+                    continue
+                paths[callee] = paths[cur] + [cur]
+                queue.append(callee)
+        return paths
+
+    # --------------------------------------------------------- conveniences
+    def hot_functions(self) -> List[FuncInfo]:
+        return [f for f in self.funcs.values() if f.is_hot]
+
+    def enclosing_symbol(self, mod: Module, lineno: int) -> str:
+        """Best-effort Class.method containing a line (for findings that
+        are located during raw tree walks)."""
+        best, best_span = "", None
+        for (rel, qual), fi in self.funcs.items():
+            if rel != mod.rel:
+                continue
+            node = fi.node
+            end = getattr(node, "end_lineno", node.lineno)
+            if node.lineno <= lineno <= end:
+                span = end - node.lineno
+                if best_span is None or span < best_span:
+                    best, best_span = qual, span
+        return best

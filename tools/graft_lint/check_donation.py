@@ -1,0 +1,203 @@
+"""donation-alias: donated buffers re-read after the jitted call.
+
+``jax.jit(..., donate_argnums=...)`` hands the argument's buffer to XLA:
+after the call the caller's binding is a deleted shell (or, on backends
+where the donation was unusable, silently stale — the worse outcome). PR
+4's runtime alias audit catches the double-donation case when it executes;
+this checker catches the re-read case before it ships: at every callsite
+of a jit-compiled attribute whose ``donate_argnums`` is statically
+resolvable, a donated positional argument that is a plain name must not be
+read again on any path following the call (a fresh re-assignment kills the
+taint).
+
+Resolution of ``donate_argnums``: literal tuples/ints at the ``jax.jit``
+site, or — when the site passes a variable (``donate_argnums=donate``) or
+an attribute (``self._donate_argnums``) — the UNION of integer literals
+across that binding's assignments in the same scope. Over-approximating
+the donated set errs toward reporting a re-read, which is the safe
+direction; intentional reads of deleted shells (donation evidence) carry
+an inline suppression with the reason.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set
+
+from tools.graft_lint.callgraph import FunctionIndex
+from tools.graft_lint.core import Finding, ModuleGraph, func_tail_name
+
+RULE = "donation-alias"
+
+
+def _parent_map(root: ast.AST) -> Dict[ast.AST, ast.AST]:
+    parents = {}
+    for node in ast.walk(root):
+        for child in ast.iter_child_nodes(node):
+            parents[child] = node
+    return parents
+
+
+def _int_literals(node: ast.AST) -> Set[int]:
+    return {n.value for n in ast.walk(node)
+            if isinstance(n, ast.Constant) and isinstance(n.value, int)
+            and not isinstance(n.value, bool)}
+
+
+def _is_jax_jit_call(call: ast.Call, module) -> bool:
+    fn = call.func
+    if isinstance(fn, ast.Attribute) and fn.attr == "jit" \
+            and isinstance(fn.value, ast.Name):
+        return module.imports.get(fn.value.id, "") == "jax"
+    if isinstance(fn, ast.Name):
+        return module.imports.get(fn.id, "") == "jax.jit"
+    return False
+
+
+def _resolve_argnums(expr: ast.AST, scopes: List[ast.AST]) -> Optional[
+        Set[int]]:
+    """Donated argnum set for the ``donate_argnums=`` expression. Literal
+    containers resolve exactly; Name/self-attribute references resolve to
+    the union of int literals across their assignments in ``scopes``."""
+    if isinstance(expr, (ast.Tuple, ast.List, ast.Constant)):
+        return _int_literals(expr)
+    name = None
+    if isinstance(expr, ast.Name):
+        def match(t):
+            return isinstance(t, ast.Name) and t.id == expr.id
+        name = expr.id
+    elif isinstance(expr, ast.Attribute) \
+            and isinstance(expr.value, ast.Name) and expr.value.id == "self":
+        def match(t):
+            return (isinstance(t, ast.Attribute) and t.attr == expr.attr
+                    and isinstance(t.value, ast.Name)
+                    and t.value.id == "self")
+        name = expr.attr
+    if name is None:
+        return None
+    out: Set[int] = set()
+    found = False
+    for scope in scopes:
+        for node in ast.walk(scope):
+            if isinstance(node, ast.Assign) \
+                    and any(match(t) for t in node.targets):
+                out |= _int_literals(node.value)
+                found = True
+            elif isinstance(node, ast.AugAssign) and match(node.target):
+                out |= _int_literals(node.value)
+                found = True
+    return out if found else None
+
+
+def _stmts_after(call: ast.Call, parents: Dict[ast.AST, ast.AST],
+                 func_node: ast.AST) -> List[ast.stmt]:
+    """Statements that can execute after the call: trailing siblings of the
+    call's statement in its block, escaping to enclosing blocks unless the
+    block terminates first (return/raise/break/continue)."""
+    node = call
+    while node in parents and not isinstance(node, ast.stmt):
+        node = parents[node]
+    out: List[ast.stmt] = []
+    stmt: ast.AST = node
+    while stmt is not func_node and stmt in parents:
+        parent = parents[stmt]
+        block = None
+        for field in ("body", "orelse", "finalbody", "handlers"):
+            seq = getattr(parent, field, None)
+            if isinstance(seq, list) and stmt in seq:
+                block = seq
+                break
+        if block is not None:
+            tail = block[block.index(stmt) + 1:]
+            out.extend(tail)
+            if any(isinstance(s, (ast.Return, ast.Raise, ast.Break,
+                                  ast.Continue)) for s in tail):
+                break
+        stmt = parent
+    return out
+
+
+class DonationAliasChecker:
+    rule = RULE
+    description = ("donated jit arguments re-read after the call "
+                   "(deleted/stale buffers)")
+
+    def run(self, graph: ModuleGraph, index: FunctionIndex) -> List[Finding]:
+        findings: List[Finding] = []
+        for ci in index.classes.values():
+            donating = self._donating_attrs(ci)
+            if not donating:
+                continue
+            for fi in ci.methods.values():
+                self._check_function(fi, donating, findings)
+        return findings
+
+    def _donating_attrs(self, ci) -> Dict[str, Set[int]]:
+        """{attr: donated argnums} for `self.X = jax.jit(..., donate_...)`
+        assignments anywhere in the class."""
+        out: Dict[str, Set[int]] = {}
+        method_nodes = [m.node for m in ci.methods.values()]
+        for fn_node in method_nodes:
+            for node in ast.walk(fn_node):
+                if not (isinstance(node, ast.Assign)
+                        and isinstance(node.value, ast.Call)
+                        and _is_jax_jit_call(node.value, ci.module)):
+                    continue
+                argnums = None
+                for kw in node.value.keywords:
+                    if kw.arg == "donate_argnums":
+                        argnums = _resolve_argnums(
+                            kw.value, [fn_node] + method_nodes)
+                if not argnums:
+                    continue
+                for t in node.targets:
+                    if isinstance(t, ast.Attribute) \
+                            and isinstance(t.value, ast.Name) \
+                            and t.value.id == "self":
+                        out[t.attr] = (out.get(t.attr, set()) | argnums)
+        return out
+
+    def _check_function(self, fi, donating: Dict[str, Set[int]],
+                        findings: List[Finding]):
+        parents = _parent_map(fi.node)
+        for node in ast.walk(fi.node):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and isinstance(node.func.value, ast.Name)
+                    and node.func.value.id == "self"
+                    and node.func.attr in donating):
+                continue
+            argnums = donating[node.func.attr]
+            after = _stmts_after(node, parents, fi.node)
+            for i in sorted(argnums):
+                if i >= len(node.args):
+                    continue
+                arg = node.args[i]
+                if not isinstance(arg, ast.Name):
+                    continue
+                hit = self._first_reread(arg.id, after)
+                if hit is not None:
+                    findings.append(Finding(
+                        RULE, fi.module.rel, hit.lineno, hit.col_offset,
+                        f"`{arg.id}` was donated (argnum {i}) into "
+                        f"`self.{node.func.attr}(...)` at line "
+                        f"{node.lineno} and is read again after the call — "
+                        f"the buffer is deleted (or silently stale where "
+                        f"XLA could not alias it); rebind before reuse or "
+                        f"copy before the call", symbol=fi.qualname))
+        return findings
+
+    @staticmethod
+    def _first_reread(name: str, stmts: List[ast.stmt]) -> Optional[ast.AST]:
+        for stmt in stmts:
+            loads = [n for n in ast.walk(stmt)
+                     if isinstance(n, ast.Name) and n.id == name
+                     and isinstance(n.ctx, ast.Load)]
+            if loads:
+                return min(loads, key=lambda n: (n.lineno, n.col_offset))
+            stores = [n for n in ast.walk(stmt)
+                      if isinstance(n, ast.Name) and n.id == name
+                      and isinstance(n.ctx, ast.Store)]
+            if stores:
+                return None                 # re-assigned: taint killed
+        return None

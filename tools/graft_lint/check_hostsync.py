@@ -1,0 +1,105 @@
+"""host-sync-in-hot-loop: blocking host<->device reads inside @hot_path.
+
+The serving scheduler's admit/decode iteration and the TrainStep dispatch
+path are annotated ``@hot_path``: every second they spend blocked on the
+device is a second no decode step is running — the stall class PRs 4/6
+built ``train_sync_stall_seconds`` / ``serving_host_stall_seconds`` to
+measure. This checker rejects the blocking constructs statically:
+``.numpy()`` / ``.item()`` / ``.tolist()``, ``jax.device_get`` /
+``block_until_ready``, and implicit ``np.asarray(tensor)`` /
+``np.array(tensor)`` syncs.
+
+A sync wrapped in a ``with <stall>.timed("phase"):`` block is allowed —
+that is the metered, deliberate sync (e.g. the one sampled-token read per
+decode step). Anything else needs a ``# graft-lint:
+disable=host-sync-in-hot-loop`` with a reason, which is exactly the
+review conversation the rule exists to force.
+
+Lexical scope: the checker looks at the annotated function body itself
+(nested defs included). Helpers a hot function calls should be annotated
+``@hot_path`` themselves when they sit on the same critical path.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from tools.graft_lint.callgraph import FunctionIndex
+from tools.graft_lint.core import Finding, ModuleGraph
+
+RULE = "host-sync-in-hot-loop"
+
+_SYNC_ATTRS = {"numpy", "item", "tolist", "block_until_ready", "device_get"}
+_NUMPY_FUNCS = {"asarray", "array"}
+
+
+def _is_host_literal(node: ast.AST) -> bool:
+    return isinstance(node, (ast.Constant, ast.List, ast.Tuple, ast.Dict))
+
+
+def _numpy_aliases(mod) -> set:
+    return {alias for alias, target in mod.imports.items()
+            if target == "numpy" or target.startswith("numpy.")}
+
+
+def _is_timed_with(node: ast.With) -> bool:
+    """``with x.timed("phase"):`` — the metered-sync escape hatch."""
+    for item in node.items:
+        ce = item.context_expr
+        if isinstance(ce, ast.Call) and isinstance(ce.func, ast.Attribute) \
+                and ce.func.attr == "timed":
+            return True
+    return False
+
+
+class _SyncVisitor(ast.NodeVisitor):
+    def __init__(self, fi, findings: List[Finding]):
+        self.fi = fi
+        self.findings = findings
+        self.np_aliases = _numpy_aliases(fi.module)
+        self._timed_depth = 0
+
+    def visit_With(self, node: ast.With):
+        timed = _is_timed_with(node)
+        if timed:
+            self._timed_depth += 1
+        self.generic_visit(node)
+        if timed:
+            self._timed_depth -= 1
+
+    def _flag(self, node: ast.AST, what: str):
+        if self._timed_depth:
+            return                       # metered sync: allowed by design
+        self.findings.append(Finding(
+            RULE, self.fi.module.rel, node.lineno, node.col_offset,
+            f"{what} blocks the host inside @hot_path "
+            f"{self.fi.qualname} — meter it under a stall.timed(...) "
+            f"block, move it off the critical path, or suppress with a "
+            f"reason", symbol=self.fi.qualname))
+
+    def visit_Call(self, node: ast.Call):
+        fn = node.func
+        if isinstance(fn, ast.Attribute) and fn.attr in _SYNC_ATTRS:
+            self._flag(node, f"`.{fn.attr}()` host sync")
+        elif isinstance(fn, ast.Name) and fn.id in _SYNC_ATTRS:
+            self._flag(node, f"`{fn.id}()` host sync")
+        elif isinstance(fn, ast.Attribute) and fn.attr in _NUMPY_FUNCS \
+                and isinstance(fn.value, ast.Name) \
+                and fn.value.id in self.np_aliases \
+                and node.args and not _is_host_literal(node.args[0]):
+            self._flag(node, f"implicit `{fn.value.id}.{fn.attr}(...)` sync "
+                             f"on a non-literal value")
+        self.generic_visit(node)
+
+
+class HostSyncChecker:
+    rule = RULE
+    description = ("blocking host<->device syncs inside @hot_path functions "
+                   "(unless metered under stall.timed)")
+
+    def run(self, graph: ModuleGraph, index: FunctionIndex) -> List[Finding]:
+        findings: List[Finding] = []
+        for fi in index.hot_functions():
+            _SyncVisitor(fi, findings).visit(fi.node)
+        return findings

@@ -1,0 +1,95 @@
+"""guarded-by: lock discipline over declared shared state.
+
+Classes whose instances are touched by more than one thread (the metrics
+registry scraped by the ObservabilityEndpoint while the scheduler writes,
+the flight-recorder ring, the request tracer, the checkpoint writer's
+handoff state, the KV block allocator / radix tree once the async engine
+lands) declare their shared attributes in the class body::
+
+    class FlightRecorder:
+        _ring: guarded_by("_lock")
+
+and this checker enforces the declaration: every ``self._ring`` access in
+any method of the class (or a subclass — declarations are inherited) must
+sit lexically inside ``with self._lock:``, be in ``__init__``/``__new__``
+(construction happens-before publication), or be in a method marked
+``@holds_lock("_lock")`` (caller holds the lock — the ``*_locked`` helper
+idiom, machine-checked instead of a naming convention).
+
+Known limitation (documented, deliberate): accesses from OUTSIDE the
+declaring class (``other.flight._ring``) are not tracked — the discipline
+is that guarded attributes are private and touched through the owning
+class's methods.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List
+
+from tools.graft_lint.callgraph import FuncInfo, FunctionIndex
+from tools.graft_lint.core import Finding, ModuleGraph
+
+RULE = "guarded-by"
+
+_EXEMPT_METHODS = {"__init__", "__new__"}
+
+
+class _AccessVisitor(ast.NodeVisitor):
+    def __init__(self, fi: FuncInfo, guarded: Dict[str, str],
+                 findings: List[Finding]):
+        self.fi = fi
+        self.guarded = guarded
+        self.findings = findings
+        self._held: List[str] = []       # lock attrs currently held
+
+    def _with_locks(self, node: ast.With) -> List[str]:
+        out = []
+        for item in node.items:
+            ce = item.context_expr
+            if isinstance(ce, ast.Attribute) \
+                    and isinstance(ce.value, ast.Name) \
+                    and ce.value.id == "self":
+                out.append(ce.attr)
+        return out
+
+    def visit_With(self, node: ast.With):
+        locks = self._with_locks(node)
+        self._held.extend(locks)
+        self.generic_visit(node)
+        for _ in locks:
+            self._held.pop()
+
+    def visit_Attribute(self, node: ast.Attribute):
+        if isinstance(node.value, ast.Name) and node.value.id == "self":
+            lock = self.guarded.get(node.attr)
+            if lock is not None and lock not in self._held \
+                    and self.fi.holds_lock != lock:
+                kind = ("write to" if isinstance(node.ctx,
+                                                 (ast.Store, ast.Del))
+                        else "read of")
+                self.findings.append(Finding(
+                    RULE, self.fi.module.rel, node.lineno, node.col_offset,
+                    f"unguarded {kind} `self.{node.attr}` (declared "
+                    f"guarded_by(\"{lock}\")) — wrap in `with "
+                    f"self.{lock}:` or mark the method "
+                    f"@holds_lock(\"{lock}\")", symbol=self.fi.qualname))
+        self.generic_visit(node)
+
+
+class GuardedByChecker:
+    rule = RULE
+    description = ("accesses to guarded_by-declared shared attributes "
+                   "outside the owning lock")
+
+    def run(self, graph: ModuleGraph, index: FunctionIndex) -> List[Finding]:
+        findings: List[Finding] = []
+        for ci in index.classes.values():
+            guarded = index.guarded_attrs(ci)
+            if not guarded:
+                continue
+            for name, fi in ci.methods.items():
+                if name in _EXEMPT_METHODS:
+                    continue
+                _AccessVisitor(fi, guarded, findings).visit(fi.node)
+        return findings

@@ -1,0 +1,156 @@
+"""recompile-hazard: data-dependent shapes flowing at jit callsites.
+
+The runtime twin of this rule is the CompileTracker's RecompileStorm
+alarm: every distinct abstract shape hitting a jit entry compiles a fresh
+XLA program, so a shape that derives from ``len(prompt)`` (or ``.shape``
+of a data-dependent array) recompiles per request — the exact failure the
+serving tier's ``_bucket()`` padding exists to prevent. This checker is
+the static form: inside any function that CALLS a known jit entry
+(``self._jitted`` / ``self._step_fn`` / ``self._sf`` ...), it taints
+values derived from ``len(...)`` / ``.shape`` and flags array
+constructions (``np.zeros`` / ``full`` / ``empty`` / ``ones``,
+``reshape``) whose shape argument is tainted — unless the value passed
+through a bucketing helper (any call whose name contains ``bucket``),
+which launders the taint by construction.
+
+Scope is deliberately per-function (no inter-procedural taint): the
+hazard pattern this catches is "computed a raw data-dependent width and
+built the jit input from it in the same scope", which is how every real
+instance in this codebase has looked.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Set
+
+from tools.graft_lint.callgraph import FunctionIndex
+from tools.graft_lint.core import Finding, ModuleGraph, func_tail_name
+
+RULE = "recompile-hazard"
+
+# attribute names that hold jit-compiled callables in this codebase
+JIT_CALLABLE_ATTRS = {"_jitted", "_jitted_checked", "_jitted_nodonate",
+                      "_fused_jitted", "_step_fn", "_sf"}
+
+# shape-taking constructors: flag when the SHAPE argument (arg 0) is tainted
+_SHAPE_CTORS = {"zeros", "ones", "full", "empty"}
+
+
+def _is_jit_callsite(call: ast.Call) -> bool:
+    fn = call.func
+    return isinstance(fn, ast.Attribute) and fn.attr in JIT_CALLABLE_ATTRS
+
+
+def _names_in(node: ast.AST) -> Set[str]:
+    return {n.id for n in ast.walk(node) if isinstance(n, ast.Name)}
+
+
+class _Taint:
+    """len()/.shape taint over one function body, bucket-call laundering."""
+
+    def __init__(self, func_node: ast.AST):
+        self.tainted: Set[str] = set()
+        self.func_node = func_node
+        self._fixpoint()
+
+    def expr_tainted(self, node: ast.AST) -> bool:
+        """Does this expression derive from len()/.shape (without passing
+        through a bucketing helper)?"""
+        if isinstance(node, ast.Call):
+            tail = func_tail_name(node.func) or ""
+            if "bucket" in tail:
+                return False                      # sanitizer: clean result
+            if tail == "len":
+                return True
+            return any(self.expr_tainted(a) for a in node.args) or any(
+                self.expr_tainted(k.value) for k in node.keywords)
+        if isinstance(node, ast.Attribute):
+            if node.attr == "shape":
+                return True
+            return self.expr_tainted(node.value)
+        if isinstance(node, ast.Name):
+            return node.id in self.tainted
+        return any(self.expr_tainted(c) for c in ast.iter_child_nodes(node)
+                   if isinstance(c, ast.expr))
+
+    def _targets(self, t: ast.AST) -> Set[str]:
+        if isinstance(t, ast.Name):
+            return {t.id}
+        if isinstance(t, (ast.Tuple, ast.List)):
+            out: Set[str] = set()
+            for e in t.elts:
+                out |= self._targets(e)
+            return out
+        return set()
+
+    def _fixpoint(self):
+        changed = True
+        while changed:
+            changed = False
+            for node in ast.walk(self.func_node):
+                value, targets = None, set()
+                if isinstance(node, ast.Assign):
+                    value = node.value
+                    for t in node.targets:
+                        targets |= self._targets(t)
+                elif isinstance(node, ast.AugAssign):
+                    value = node.value
+                    targets = self._targets(node.target)
+                elif isinstance(node, ast.AnnAssign) and node.value:
+                    value = node.value
+                    targets = self._targets(node.target)
+                if value is None or not targets:
+                    continue
+                if targets <= self.tainted:
+                    continue
+                if self.expr_tainted(value):
+                    self.tainted |= targets
+                    changed = True
+
+
+class RecompileHazardChecker:
+    rule = RULE
+    description = ("array shapes derived from len()/.shape feeding jit "
+                   "callsites without a bucketing helper")
+
+    def run(self, graph: ModuleGraph, index: FunctionIndex) -> List[Finding]:
+        findings: List[Finding] = []
+        for fi in index.funcs.values():
+            has_jit = any(_is_jit_callsite(c) for c in ast.walk(fi.node)
+                          if isinstance(c, ast.Call))
+            if not has_jit:
+                continue
+            taint = _Taint(fi.node)
+            for node in ast.walk(fi.node):
+                if not isinstance(node, ast.Call):
+                    continue
+                fn = node.func
+                tail = func_tail_name(fn) or ""
+                shape_arg = None
+                if tail in _SHAPE_CTORS and isinstance(fn, ast.Attribute) \
+                        and node.args:
+                    shape_arg = node.args[0]
+                elif tail == "reshape" and node.args:
+                    # x.reshape(dims...) and mod.reshape(x, dims)
+                    args = (node.args[1:]
+                            if isinstance(fn, ast.Attribute)
+                            and isinstance(fn.value, ast.Name)
+                            and fn.value.id in fi.module.imports
+                            else node.args)
+                    if args and any(taint.expr_tainted(a) for a in args):
+                        shape_arg = args[0]
+                        findings.append(self._finding(fi, node, "reshape"))
+                        continue
+                if shape_arg is not None and taint.expr_tainted(shape_arg):
+                    findings.append(self._finding(fi, node, tail))
+        return findings
+
+    def _finding(self, fi, node: ast.Call, ctor: str) -> Finding:
+        return Finding(
+            RULE, fi.module.rel, node.lineno, node.col_offset,
+            f"`{ctor}` shape derives from len()/.shape in a function that "
+            f"drives a jit entry — every distinct value compiles a fresh "
+            f"program (RecompileStorm); route the width through a bucketing "
+            f"helper (e.g. _bucket()) or a fixed grid dimension",
+            symbol=fi.qualname)

@@ -1,0 +1,109 @@
+"""tracing-hazard: host-value escapes inside jit-traced code.
+
+Inside a function that jax traces, a Python-level read of a tensor's VALUE
+(``.item()``, ``.numpy()``, ``.tolist()``, ``np.asarray(tensor)``,
+``bool(tensor)`` / ``float(tensor)`` — including the implicit ``bool`` of
+``if tensor:``) either crashes at trace time or, worse, silently bakes one
+traced value into the compiled program as a constant. The reference
+framework catches this class at build time via its kernel-registration /
+DDim checks; here the checker walks the static call graph from the known
+jit trace roots — ``StaticFunction._traced``, ``TrainStep._step``,
+``SlotStep._forward_sample``, plus anything decorated ``@to_static`` — and
+flags host-value escapes in any reachable function.
+
+Conservative by construction: calls that cannot be resolved statically
+(``self._fn``, callbacks) add no reachability, so the checker under-
+approximates the traced surface rather than spraying false positives over
+eager code.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from tools.graft_lint.callgraph import FunctionIndex
+from tools.graft_lint.core import Finding, ModuleGraph
+
+RULE = "tracing-hazard"
+
+# (module-rel path, qualname) roots that jax traces directly
+TRACED_ROOTS = (
+    ("paddle_tpu/jit/api.py", "StaticFunction._traced"),
+    ("paddle_tpu/jit/api.py", "TrainStep._step"),
+    ("paddle_tpu/models/serving.py", "SlotStep._forward_sample"),
+)
+
+# decorators that mark a function as a jit entry (its body is traced)
+TRACED_DECORATORS = {"to_static"}
+
+_SYNC_ATTRS = {"item", "tolist"}
+_NUMPY_FUNCS = {"asarray", "array"}
+
+
+def _is_host_literal(node: ast.AST) -> bool:
+    """Arguments that are obviously host data (literals), where
+    ``np.asarray`` is plain construction, not a tensor sync."""
+    return isinstance(node, (ast.Constant, ast.List, ast.Tuple, ast.Dict))
+
+
+def _numpy_aliases(mod) -> set:
+    return {alias for alias, target in mod.imports.items()
+            if target == "numpy" or target.startswith("numpy.")}
+
+
+class _HazardVisitor(ast.NodeVisitor):
+    def __init__(self, fi, chain: str, findings: List[Finding]):
+        self.fi = fi
+        self.chain = chain
+        self.findings = findings
+        self.np_aliases = _numpy_aliases(fi.module)
+
+    def _flag(self, node: ast.AST, what: str):
+        self.findings.append(Finding(
+            RULE, self.fi.module.rel, node.lineno, node.col_offset,
+            f"{what} inside jit-traced code ({self.chain}) — host-value "
+            f"escape breaks tracing or bakes a traced value in as a "
+            f"constant; keep the computation in jnp/lax ops",
+            symbol=self.fi.qualname))
+
+    def visit_Call(self, node: ast.Call):
+        fn = node.func
+        if isinstance(fn, ast.Attribute):
+            if fn.attr in _SYNC_ATTRS:
+                self._flag(node, f"`.{fn.attr}()`")
+            elif fn.attr == "numpy" and not node.args:
+                self._flag(node, "`.numpy()`")
+            elif fn.attr in _NUMPY_FUNCS \
+                    and isinstance(fn.value, ast.Name) \
+                    and fn.value.id in self.np_aliases \
+                    and node.args and not _is_host_literal(node.args[0]):
+                self._flag(node, f"`{fn.value.id}.{fn.attr}(...)` on a "
+                                 f"non-literal value")
+        elif isinstance(fn, ast.Name) and fn.id in ("bool", "float") \
+                and node.args and not _is_host_literal(node.args[0]):
+            self._flag(node, f"`{fn.id}(...)` on a non-literal value")
+        self.generic_visit(node)
+
+
+class TracingHazardChecker:
+    rule = RULE
+    description = ("host-value escapes (.item/.numpy/np.asarray/bool/float) "
+                   "in functions reachable from jit trace roots")
+
+    def run(self, graph: ModuleGraph, index: FunctionIndex) -> List[Finding]:
+        roots = []
+        for rel, qual in TRACED_ROOTS:
+            fi = index.funcs.get((rel, qual))
+            if fi is not None:
+                roots.append(fi)
+        for fi in index.funcs.values():
+            if TRACED_DECORATORS & set(fi.decorators):
+                roots.append(fi)
+        findings: List[Finding] = []
+        for fi, path in index.reachable_from(roots).items():
+            chain = " -> ".join(p.qualname for p in (path + [fi])[-3:])
+            chain = f"reachable via {chain}" if path else \
+                f"jit trace root {fi.qualname}"
+            _HazardVisitor(fi, chain, findings).visit(fi.node)
+        return findings

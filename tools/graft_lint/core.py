@@ -1,0 +1,290 @@
+"""graft_lint core: module graph, findings, suppressions, baseline.
+
+The shared substrate every checker runs on:
+
+- ``ModuleGraph`` parses every ``.py`` file under the requested roots ONCE
+  (stdlib ``ast`` only — no jax, no imports of the scanned code, so the
+  whole suite runs in a plain CPython in well under the 10 s tier-1
+  budget) and keeps, per module: the AST, the raw source lines, the import
+  alias map, and the per-line suppression table.
+
+- ``Finding`` is one diagnostic anchored at ``file:line:col`` with the
+  enclosing ``Class.method`` symbol. The (rule, file, symbol, message)
+  tuple — deliberately line-free, so unrelated edits above a finding do
+  not invalidate it — is the fingerprint the baseline matches on.
+
+- Suppressions: a trailing ``# graft-lint: disable=rule1,rule2`` silences
+  those rules on that line, ``disable-next=`` on the following line, and
+  ``disable-file=`` for the whole file. ``disable=all`` works. Suppressed
+  findings are counted (visible in ``--json``) but never fail the run.
+
+- Baseline: ``baseline.json`` holds fingerprints of accepted pre-existing
+  findings with a count per fingerprint. A lint run subtracts matches and
+  fails only on NEW findings; ``--write-baseline`` regenerates the file
+  from the current state.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import os
+import re
+from typing import Dict, List, Optional, Set, Tuple
+
+__all__ = [
+    "Baseline",
+    "Finding",
+    "Module",
+    "ModuleGraph",
+    "dotted_name",
+    "func_tail_name",
+]
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*graft-lint:\s*(disable(?:-next|-file)?)\s*=\s*"
+    r"([A-Za-z0-9_\-]+(?:\s*,\s*[A-Za-z0-9_\-]+)*)")
+
+_SKIP_DIRS = {"__pycache__", ".git", "build", "node_modules"}
+
+
+class Finding:
+    """One diagnostic: rule + location + message (+ enclosing symbol)."""
+
+    __slots__ = ("rule", "file", "line", "col", "message", "symbol",
+                 "suppressed", "baselined")
+
+    def __init__(self, rule: str, file: str, line: int, col: int,
+                 message: str, symbol: str = ""):
+        self.rule = rule
+        self.file = file
+        self.line = int(line)
+        self.col = int(col)
+        self.message = message
+        self.symbol = symbol
+        self.suppressed = False
+        self.baselined = False
+
+    def fingerprint(self) -> Tuple[str, str, str, str]:
+        """Line-independent identity used by the baseline."""
+        return (self.rule, self.file, self.symbol, self.message)
+
+    def to_dict(self) -> Dict[str, object]:
+        return {"rule": self.rule, "file": self.file, "line": self.line,
+                "col": self.col, "symbol": self.symbol,
+                "message": self.message, "suppressed": self.suppressed,
+                "baselined": self.baselined}
+
+    def render(self) -> str:
+        sym = f" (in {self.symbol})" if self.symbol else ""
+        return (f"{self.file}:{self.line}:{self.col}: [{self.rule}] "
+                f"{self.message}{sym}")
+
+    def __repr__(self) -> str:
+        return f"Finding({self.render()!r})"
+
+
+def dotted_name(node: ast.AST) -> Optional[str]:
+    """``a.b.c`` for Name/Attribute chains, else None."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def func_tail_name(node: ast.AST) -> Optional[str]:
+    """The final identifier of a call target (``x.y.bucket`` -> ``bucket``,
+    ``bucket`` -> ``bucket``); None for computed targets."""
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+class Module:
+    """One parsed source file: AST + lines + imports + suppressions."""
+
+    def __init__(self, path: str, rel: str, source: str):
+        self.path = path
+        self.rel = rel                      # repo-relative, '/'-separated
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = ast.parse(source, filename=rel)
+        # python module name ("paddle_tpu.serving.scheduler")
+        mod = rel[:-3] if rel.endswith(".py") else rel
+        self.is_package = mod.endswith("/__init__")
+        if self.is_package:
+            mod = mod[: -len("/__init__")]
+        self.modname = mod.replace("/", ".")
+        # alias -> dotted target. "import numpy as np" => np -> numpy;
+        # "from paddle_tpu.models.serving import _bucket as bkt"
+        #   => bkt -> paddle_tpu.models.serving._bucket
+        self.imports: Dict[str, str] = {}
+        self._collect_imports()
+        # line -> set of suppressed rules ("all" suppresses everything)
+        self.line_suppress: Dict[int, Set[str]] = {}
+        self.file_suppress: Set[str] = set()
+        self._collect_suppressions()
+
+    def _collect_imports(self):
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    self.imports[a.asname or a.name.split(".")[0]] = (
+                        a.name if a.asname else a.name.split(".")[0])
+            elif isinstance(node, ast.ImportFrom) and node.module:
+                if node.level:      # relative import: resolve on the package
+                    # a package __init__ is one level shallower than its path
+                    up = node.level - 1 if self.is_package else node.level
+                    pkg = (self.modname if up == 0
+                           else self.modname.rsplit(".", up)[0])
+                    base = f"{pkg}.{node.module}"
+                else:
+                    base = node.module
+                for a in node.names:
+                    if a.name == "*":
+                        continue
+                    self.imports[a.asname or a.name] = f"{base}.{a.name}"
+
+    def _collect_suppressions(self):
+        for lineno, line in enumerate(self.lines, 1):
+            if "graft-lint" not in line:
+                continue
+            for m in _SUPPRESS_RE.finditer(line):
+                kind = m.group(1)
+                rules = {r.strip() for r in m.group(2).split(",") if r.strip()}
+                if kind == "disable-file":
+                    self.file_suppress |= rules
+                elif kind == "disable-next":
+                    # bind to the next CODE line (skip blank/comment lines,
+                    # so a directive may span multiple comment lines)
+                    target = lineno + 1
+                    while target <= len(self.lines):
+                        stripped = self.lines[target - 1].strip()
+                        if stripped and not stripped.startswith("#"):
+                            break
+                        target += 1
+                    self.line_suppress.setdefault(target, set()).update(rules)
+                else:
+                    self.line_suppress.setdefault(lineno, set()).update(rules)
+
+    def is_suppressed(self, rule: str, line: int) -> bool:
+        if rule in self.file_suppress or "all" in self.file_suppress:
+            return True
+        rules = self.line_suppress.get(line)
+        return bool(rules) and (rule in rules or "all" in rules)
+
+
+class ModuleGraph:
+    """Every parsed module under the scan roots, keyed by repo-relative
+    path and by python module name."""
+
+    def __init__(self, repo_root: str, roots: List[str]):
+        self.repo_root = os.path.abspath(repo_root)
+        self.roots = [os.path.abspath(r) for r in roots]
+        self.modules: List[Module] = []
+        self.by_rel: Dict[str, Module] = {}
+        self.by_modname: Dict[str, Module] = {}
+        self.parse_errors: List[Finding] = []
+        self._load()
+
+    def _load(self):
+        seen = set()
+        for root in self.roots:
+            if os.path.isfile(root):
+                self._add_file(root, seen)
+                continue
+            for dirpath, dirnames, filenames in os.walk(root):
+                dirnames[:] = [d for d in sorted(dirnames)
+                               if d not in _SKIP_DIRS]
+                for fn in sorted(filenames):
+                    if fn.endswith(".py"):
+                        self._add_file(os.path.join(dirpath, fn), seen)
+
+    def _add_file(self, path: str, seen: set):
+        path = os.path.abspath(path)
+        if path in seen:
+            return
+        seen.add(path)
+        rel = os.path.relpath(path, self.repo_root).replace(os.sep, "/")
+        try:
+            with open(path, encoding="utf-8") as f:
+                source = f.read()
+            mod = Module(path, rel, source)
+        except SyntaxError as e:
+            self.parse_errors.append(Finding(
+                "parse-error", rel, e.lineno or 1, e.offset or 0,
+                f"syntax error: {e.msg}"))
+            return
+        except (OSError, UnicodeDecodeError) as e:
+            self.parse_errors.append(Finding(
+                "parse-error", rel, 1, 0, f"unreadable: {e}"))
+            return
+        self.modules.append(mod)
+        self.by_rel[rel] = mod
+        self.by_modname[mod.modname] = mod
+
+
+class Baseline:
+    """Accepted pre-existing findings, matched by fingerprint with counts.
+
+    File format (checked in, reviewed like code)::
+
+        {"version": 1,
+         "entries": [{"rule": ..., "file": ..., "symbol": ...,
+                      "message": ..., "count": 2}, ...]}
+    """
+
+    def __init__(self, entries: Optional[Dict[Tuple, int]] = None):
+        self.entries: Dict[Tuple, int] = dict(entries or {})
+
+    @classmethod
+    def load(cls, path: str) -> "Baseline":
+        if not os.path.exists(path):
+            return cls()
+        with open(path, encoding="utf-8") as f:
+            text = f.read()
+        if not text.strip():
+            return cls()                   # empty file = empty baseline
+        data = json.loads(text)
+        entries: Dict[Tuple, int] = {}
+        for e in data.get("entries", ()):
+            key = (e["rule"], e["file"], e.get("symbol", ""), e["message"])
+            entries[key] = entries.get(key, 0) + int(e.get("count", 1))
+        return cls(entries)
+
+    def apply(self, findings: List[Finding]) -> None:
+        """Mark matching findings as baselined, consuming counts so N
+        accepted instances never absorb an N+1-th new one."""
+        budget = dict(self.entries)
+        for f in findings:
+            if f.suppressed:
+                continue
+            key = f.fingerprint()
+            if budget.get(key, 0) > 0:
+                budget[key] -= 1
+                f.baselined = True
+
+    @staticmethod
+    def write(path: str, findings: List[Finding]) -> int:
+        """Regenerate the baseline from the current (unsuppressed) findings.
+        Returns the number of entries written."""
+        counts: Dict[Tuple, int] = {}
+        for f in findings:
+            if f.suppressed:
+                continue
+            counts[f.fingerprint()] = counts.get(f.fingerprint(), 0) + 1
+        entries = [
+            {"rule": k[0], "file": k[1], "symbol": k[2], "message": k[3],
+             "count": n}
+            for k, n in sorted(counts.items())]
+        with open(path, "w", encoding="utf-8") as f:
+            json.dump({"version": 1, "entries": entries}, f, indent=2,
+                      sort_keys=True)
+            f.write("\n")
+        return len(entries)

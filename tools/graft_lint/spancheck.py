@@ -1,0 +1,168 @@
+"""span-manifest: every trace span has an owner, no entry rots.
+
+The PR-6 ``tools/check_spans.py`` lint, folded into graft_lint as its
+sixth checker (``check_spans.py`` stays as a thin shim for existing
+invocations). Scans ``paddle_tpu/`` for ``RecordEvent(...)`` call sites
+and reconciles them against ``observability/span_manifest.py``:
+
+- a literal span name emitted but not registered      -> FAIL (who owns it?)
+- a registered span name no call site emits anymore   -> FAIL (stale entry)
+- a non-literal (runtime-built) call site whose file
+  is not declared in ``DYNAMIC_SPANS``                -> FAIL (undeclared
+  dynamic span names would silently dodge the manifest)
+
+The manifest is read STATICALLY (``ast.literal_eval`` on the module's two
+dict assignments), so the lint driver never imports ``paddle_tpu`` — and
+therefore never imports jax — keeping the whole suite inside its wall-time
+budget.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import Dict, List, Tuple
+
+from tools.graft_lint.core import Finding
+
+RULE = "span-manifest"
+
+# literal first arg: RecordEvent("name" ...
+_LITERAL = re.compile(r'RecordEvent\(\s*([fub]*)"([^"]+)"')
+# any call site (to find the non-literal ones by subtraction)
+_ANY = re.compile(r"RecordEvent\(\s*([^)\s,]+)")
+
+
+def scan_spans(root: str) -> Dict[str, object]:
+    """Walk ``root`` for .py files; return literal span names (with their
+    files) and non-literal call sites."""
+    literals: Dict[str, List[str]] = {}
+    dynamic_sites: List[Dict[str, object]] = []
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        for fn in sorted(filenames):
+            # the registry itself names spans in prose, not as call sites
+            if not fn.endswith(".py") or fn == "span_manifest.py":
+                continue
+            path = os.path.join(dirpath, fn)
+            rel = os.path.relpath(path, os.path.dirname(root)).replace(
+                os.sep, "/")
+            with open(path, encoding="utf-8") as f:
+                for lineno, line in enumerate(f, 1):
+                    if "RecordEvent(" not in line:
+                        continue
+                    # class/def/import lines are not call sites
+                    stripped = line.strip()
+                    if stripped.startswith(("class ", "def ", "from ",
+                                            "import ", "#")):
+                        continue
+                    m = _LITERAL.search(line)
+                    if m:
+                        prefix, name = m.groups()
+                        if "f" in prefix:      # f-string: treat as dynamic
+                            dynamic_sites.append(
+                                {"file": rel, "line": lineno,
+                                 "arg": f'f"{name}"'})
+                        else:
+                            literals.setdefault(name, []).append(
+                                f"{rel}:{lineno}")
+                        continue
+                    m = _ANY.search(line)
+                    if m:
+                        dynamic_sites.append({"file": rel, "line": lineno,
+                                              "arg": m.group(1)})
+    return {"literals": literals, "dynamic_sites": dynamic_sites}
+
+
+def check_spans(root: str, manifest: Dict[str, dict],
+                dynamic: Dict[str, str]) -> Dict[str, object]:
+    """Reconcile a scan against a manifest; returns the full report with
+    ``ok`` plus the violation lists."""
+    scan = scan_spans(root)
+    literals = scan["literals"]
+    unregistered = sorted(n for n in literals if n not in manifest)
+    stale = sorted(n for n in manifest if n not in literals)
+    undeclared_dynamic = [s for s in scan["dynamic_sites"]
+                          if s["file"] not in dynamic]
+    malformed = sorted(
+        n for n, entry in manifest.items()
+        if not (isinstance(entry, dict) and entry.get("owner")
+                and entry.get("category")))
+    return {
+        "ok": not (unregistered or stale or undeclared_dynamic or malformed),
+        "spans_emitted": {n: sites for n, sites in sorted(literals.items())},
+        "dynamic_sites": scan["dynamic_sites"],
+        "unregistered": unregistered,
+        "stale": stale,
+        "undeclared_dynamic": undeclared_dynamic,
+        "malformed_entries": malformed,
+    }
+
+
+def load_manifest_static(package_root: str) -> Tuple[Dict, Dict]:
+    """``(SPAN_MANIFEST, DYNAMIC_SPANS)`` parsed from the manifest module
+    WITHOUT importing it (both are literal dicts by construction)."""
+    path = os.path.join(package_root, "observability", "span_manifest.py")
+    with open(path, encoding="utf-8") as f:
+        tree = ast.parse(f.read(), filename=path)
+    out = {"SPAN_MANIFEST": {}, "DYNAMIC_SPANS": {}}
+    for node in tree.body:
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Name) and t.id in out:
+                    out[t.id] = ast.literal_eval(node.value)
+    return out["SPAN_MANIFEST"], out["DYNAMIC_SPANS"]
+
+
+def manifest_rel(package_root: str, repo_root: str) -> str:
+    return os.path.relpath(
+        os.path.join(package_root, "observability", "span_manifest.py"),
+        repo_root).replace(os.sep, "/")
+
+
+class SpanManifestChecker:
+    """graft_lint face of the span lint. Runs once per scan root that
+    carries a span manifest (in this repo: ``paddle_tpu/``); roots without
+    one (``tools/``, test fixtures) are skipped."""
+
+    rule = RULE
+    description = ("RecordEvent span names reconciled against "
+                   "observability/span_manifest.py (owners, staleness, "
+                   "declared dynamic sites)")
+
+    def run(self, graph, index) -> List[Finding]:
+        findings: List[Finding] = []
+        for root in graph.roots:
+            mpath = os.path.join(root, "observability", "span_manifest.py")
+            if not os.path.exists(mpath):
+                continue
+            manifest, dynamic = load_manifest_static(root)
+            report = check_spans(root, manifest, dynamic)
+            man_rel = manifest_rel(root, graph.repo_root)
+            for name in report["unregistered"]:
+                # scan paths are already relative to the root's parent,
+                # i.e. repo-relative when scanning <repo>/paddle_tpu
+                site = report["spans_emitted"][name][0]
+                f, _, line = site.partition(":")
+                findings.append(Finding(
+                    RULE, f, int(line or 1), 0,
+                    f"unregistered span {name!r} — add it to "
+                    f"observability/span_manifest.py with an owner",
+                    symbol=name))
+            for name in report["stale"]:
+                findings.append(Finding(
+                    RULE, man_rel, 1, 0,
+                    f"stale manifest entry {name!r} — no call site emits "
+                    f"it anymore; remove it", symbol=name))
+            for s in report["undeclared_dynamic"]:
+                findings.append(Finding(
+                    RULE, str(s["file"]), int(s["line"]), 0,
+                    f"non-literal RecordEvent (arg {s['arg']}) in a file "
+                    f"not declared in DYNAMIC_SPANS", symbol=""))
+            for name in report["malformed_entries"]:
+                findings.append(Finding(
+                    RULE, man_rel, 1, 0,
+                    f"malformed manifest entry {name!r} — needs non-empty "
+                    f"owner and category", symbol=name))
+        return findings

@@ -35,6 +35,8 @@ REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 if REPO_ROOT not in sys.path:
     sys.path.insert(0, REPO_ROOT)
 
+from tools.bench_io import write_bench_json  # noqa: E402
+
 
 def run_load(num_requests: int = 16, rate: float = 0.5, seed: int = 0,
              max_num_seqs: int = 4, block_size: int = 8,
@@ -470,8 +472,7 @@ def run_observability_suite(smoke: bool = True, out_dir: str = REPO_ROOT,
         "metrics": art["metrics"],
     }
     out_path = os.path.join(out_dir, "BENCH_serving_obs.json")
-    with open(out_path, "w") as f:
-        json.dump(artifact, f, indent=2)
+    write_bench_json(out_path, artifact)
     artifact["artifact"] = out_path
     return artifact
 
@@ -538,8 +539,7 @@ def main(argv=None) -> dict:
         artifact = run_prefix_suite(**kw)
         out_path = args.out or os.path.join(REPO_ROOT,
                                             "BENCH_serving_prefix.json")
-        with open(out_path, "w") as f:
-            json.dump(artifact, f, indent=2)
+        write_bench_json(out_path, artifact)
         top = str(max(artifact["config"]["ratios"]))
         print(json.dumps({
             "metric": "serving_prefix_ttft_reduction_pct",
@@ -577,8 +577,7 @@ def main(argv=None) -> dict:
     with open(reqtrace_path, "w") as f:
         json.dump(artifact.pop("request_trace"), f)
     artifact.pop("scrape_sample", None)
-    with open(out_path, "w") as f:
-        json.dump(artifact, f, indent=2)
+    write_bench_json(out_path, artifact)
     with open(prom_path, "w") as f:
         f.write(prom_text)
     print(json.dumps({"metric": "serving_tokens_per_s",

@@ -199,8 +199,9 @@ def run_bench(on_tpu: bool = False, steps: int = 20, warmup: int = 3,
         "ratio_ok": ratio >= RATIO_NOISE_FLOOR,
     }
     if out_path:
-        with open(out_path, "w") as f:
-            json.dump(art, f, indent=2)
+        from tools.bench_io import write_bench_json
+
+        write_bench_json(out_path, art)
         art["artifact"] = out_path
     if smoke:
         assert identical, \
