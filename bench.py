@@ -697,6 +697,52 @@ def bench_observability(on_tpu):
     }))
 
 
+def bench_serving_chaos(on_tpu):
+    """Serving resilience under deterministic chaos
+    (tools/serve_bench.run_chaos_suite): goodput across a seeded fault-rate
+    sweep (must degrade monotonically, never erratically), a transient
+    fault-window run whose surviving token streams are bit-identical to the
+    fault-free baseline with per-iteration throughput recovered after the
+    window, a cancellation scenario, and the disarmed-``inject()`` overhead
+    budget (<1% of serving wall). Host-path measurement — CPU-sized
+    everywhere; the artifact is BENCH_serving_chaos.json."""
+    import sys
+
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from tools.serve_bench import run_chaos_suite
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    art = run_chaos_suite(smoke=True, out_dir=here)
+    assert art["goodput_monotone"], (
+        "goodput must degrade monotonically with fault rate: %s"
+        % {r: v["goodput"] for r, v in art["goodput_vs_fault_rate"].items()})
+    rec = art["window_recovery"]
+    assert rec["token_identical_after_faults"], (
+        "transient faults perturbed surviving token streams")
+    assert rec["recovered_within_5pct"], (
+        "post-window throughput off by %.2f%% (budget 5%%)"
+        % rec["recovery_gap_pct"])
+    assert art["disarmed_inject"]["within_budget"], (
+        "disarmed inject() costs %.4f%% of serving wall (budget 1%%)"
+        % art["disarmed_inject"]["overhead_pct"])
+    rates = art["config"]["fault_rates"]
+    print(json.dumps({
+        "metric": "serving_chaos_goodput_min",
+        "value": min(art["goodput_vs_fault_rate"][str(r)]["goodput"]
+                     for r in rates),
+        "unit": f"min goodput over fault rates {rates}",
+        "vs_baseline": None,  # first round with a resilience trajectory
+        "goodput_by_rate": {str(r): art["goodput_vs_fault_rate"][str(r)]
+                            ["goodput"] for r in rates},
+        "recovery_gap_pct": rec["recovery_gap_pct"],
+        "token_identical_after_faults":
+            rec["token_identical_after_faults"],
+        "disarmed_inject_overhead_pct":
+            art["disarmed_inject"]["overhead_pct"],
+        "within_budget": art["within_budget"],
+    }))
+
+
 def bench_ckpt(on_tpu):
     """Checkpoint lifecycle: sync save throughput, async snapshot stall
     (the train-step pause a background save costs), and cold resume
@@ -889,6 +935,7 @@ for _f in (bench_chip_ceilings, bench_resnet50, bench_bert, bench_ernie,
            bench_serving,
            bench_serving_prefix,
            bench_observability,
+           bench_serving_chaos,
            bench_ckpt,
            bench_train,
            bench_lint,

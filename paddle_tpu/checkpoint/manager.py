@@ -35,6 +35,7 @@ from typing import Dict, List, NamedTuple, Optional
 from paddle_tpu.checkpoint import manifest as mf
 from paddle_tpu.checkpoint import state as st
 from paddle_tpu.observability.annotations import guarded_by
+from paddle_tpu.resilience import inject
 
 _STEP_RE = re.compile(r"^step_(\d+)$")
 _TMP_SUFFIX = ".tmp"
@@ -66,8 +67,10 @@ def _flush_all_managers():
             m.wait()
         except SimulatedCrash:
             pass
+        # graft-lint: disable-next=swallowed-exception (interpreter exit
+        # path: a failed flush must not turn shutdown into a crash loop)
         except Exception:
-            pass  # exit path: never turn a flush into a crash loop
+            pass
 
 
 atexit.register(_flush_all_managers)
@@ -257,8 +260,12 @@ class CheckpointManager:
             self._m_bytes.inc(n_bytes)
         self._maybe_fail("before_commit")  # shards written, nothing visible
         with RecordEvent("checkpoint.commit", TracerEventType.UserDefined):
+            # seeded chaos hooks mirroring _maybe_fail's fixed points: a
+            # FaultPlan can kill the manifest write or the atomic rename
+            inject("ckpt.manifest_write")
             mf.write_manifest(tmp, mf.build_manifest(tmp, step))
             mf.fsync_dir(tmp)
+            inject("ckpt.rename")
             os.rename(tmp, final)
             mf.fsync_dir(self.root)
             self._maybe_fail("before_marker")  # renamed but not committed

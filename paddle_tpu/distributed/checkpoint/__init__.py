@@ -138,8 +138,13 @@ def _write_files(path: str, writes, md: Metadata, pidx: int,
     fragment to disk. With ``fsync`` every file is flushed to stable storage
     before its tmp-name is renamed in (the crash-safe CheckpointManager
     path). Returns total bytes written."""
+    from paddle_tpu.resilience import inject
+
     total = 0
     for fn, arr in writes:
+        # chaos hook: a fault here models a crash/ENOSPC mid-shard — the
+        # commit protocol must leave the previous checkpoint restorable
+        inject("ckpt.shard_write")
         with open(fn + ".npy", "wb") as f:
             np.save(f, arr, allow_pickle=False)
             if fsync:

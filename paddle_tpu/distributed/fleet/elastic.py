@@ -103,6 +103,8 @@ class ElasticManager:
                     t = float(self.store.get(key).decode())
                     if now - t < timeout:
                         alive.append(r)
+            # graft-lint: disable-next=swallowed-exception (a rank whose
+            # heartbeat can't be read IS a dead rank — that's the answer)
             except Exception:
                 continue
         return alive
@@ -126,8 +128,10 @@ class ElasticManager:
         if publish:
             try:
                 self.store.set("elastic/resume_step", str(step).encode())
+            # graft-lint: disable-next=swallowed-exception (a flaky store
+            # must not block the restart protocol; local fallback answers)
             except Exception:
-                pass  # a flaky store must not block the restart protocol
+                pass
         return step
 
     def resume_step(self) -> int:
@@ -136,6 +140,8 @@ class ElasticManager:
         try:
             if self.store.check("elastic/resume_step"):
                 return int(self.store.get("elastic/resume_step").decode())
+        # graft-lint: disable-next=swallowed-exception (store may be gone
+        # across the restart boundary; the local manager fallback answers)
         except Exception:
             pass
         if self._ckpt_manager is not None:
@@ -164,8 +170,10 @@ class ElasticManager:
                 # flush any in-flight async save, then advertise the commit
                 # the relaunched world should resume from
                 self._ckpt_manager.wait()
+            # graft-lint: disable-next=swallowed-exception (pre-restart
+            # exit path: a torn in-flight save is skipped by latest())
             except Exception:
-                pass  # a torn in-flight save is skipped by latest()
+                pass
             self.last_committed_step(publish=True)
         world_file = os.environ.get("PADDLE_ELASTIC_WORLD_FILE")
         if world_file:
@@ -173,6 +181,8 @@ class ElasticManager:
                 n = max(len(self.alive_members()), 1)
                 with open(world_file, "w") as f:
                     f.write(str(min(max(n, self.np_lo), self.np_hi)))
+            # graft-lint: disable-next=swallowed-exception (advisory world
+            # hint on the exit path; the supervisor has its own default)
             except Exception:
                 pass
         self.stop()

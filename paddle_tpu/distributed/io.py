@@ -26,7 +26,7 @@ def _program_persistables(main_program):
             continue
         try:
             arr = np.asarray(val)
-        except Exception:
+        except (TypeError, ValueError):
             continue  # non-array scope entries aren't persistable
         if arr.dtype == object:
             continue

@@ -174,6 +174,8 @@ class TCPStore:
                     c.close()
             if self._lib is not None and self._server:
                 self._lib.tcpstore_server_stop(self._server)
+        # graft-lint: disable-next=swallowed-exception (__del__ during
+        # interpreter teardown: raising here aborts unrelated cleanup)
         except Exception:
             pass
 

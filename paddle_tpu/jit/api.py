@@ -446,8 +446,8 @@ class TrainStep:
                 from paddle_tpu.incubate.optimizer import FusedAdamW
 
                 self._fused_mode = isinstance(optimizer, FusedAdamW)
-            except Exception:
-                pass
+            except ImportError:
+                pass  # incubate tree absent: fused mode simply stays off
         # eager state init so shapes are known before trace; master weights
         # (multi_precision) materialize here so the jitted step carries them
         if not self._fused_mode:

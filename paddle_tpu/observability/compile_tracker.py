@@ -242,5 +242,5 @@ def _attach_jax_monitoring(registry: _metrics.MetricsRegistry):
 
         monitoring.register_event_duration_secs_listener(_listener)
         _monitoring_attached = True
-    except Exception:
-        pass
+    except (ImportError, AttributeError):
+        pass  # this jax build has no monitoring API: tracking stays manual

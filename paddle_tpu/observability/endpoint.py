@@ -15,7 +15,14 @@ without adding any dependency:
                           tokens, slot, preemptions, age), recent completed
                           traces, the stall breakdown, SLO accounting, and
                           the flight-recorder ring (``?last=N`` trims it).
-- ``GET /healthz``        liveness probe (200 "ok").
+- ``GET /healthz``        truthful health: the worst state across every
+                          attached health source, as a plain-text body —
+                          ``ok`` / ``degraded`` (shed ladder engaged) /
+                          ``draining`` with HTTP 200 (the process IS
+                          alive), ``dead`` with 503 when a scheduler's
+                          driver thread has exited with work pending (or
+                          a health source itself raises). With no sources
+                          attached it stays a bare liveness 200 "ok".
 
 The server runs on a daemon thread (``ThreadingHTTPServer``), binds
 ``127.0.0.1`` and an ephemeral port by default, and never touches the
@@ -56,6 +63,7 @@ class ObservabilityEndpoint:
         for r in registries or ():
             self.add_registry(r)
         self._debug_sources: "Dict[str, Callable[[], dict]]" = {}
+        self._health_sources: "Dict[str, Callable[[], dict]]" = {}
         self._host = host
         self._port = int(port)
         self._server: Optional[ThreadingHTTPServer] = None
@@ -71,12 +79,21 @@ class ObservabilityEndpoint:
         ``/debug/requests``."""
         self._debug_sources[str(name)] = fn
 
+    def add_health_source(self, name: str, fn: Callable[[], dict]):
+        """``fn()`` -> dict with a ``"state"`` key in
+        ``ok|degraded|draining|dead``; ``/healthz`` reports the worst state
+        across all sources. A source that raises counts as ``dead``."""
+        self._health_sources[str(name)] = fn
+
     def add_scheduler(self, scheduler, name: Optional[str] = None):
         """Attach a ContinuousBatchingScheduler: its metrics registry feeds
-        ``/metrics`` and its ``debug_state()`` feeds ``/debug/requests``."""
+        ``/metrics``, ``debug_state()`` feeds ``/debug/requests``, and
+        ``health()`` feeds ``/healthz``."""
         self.add_registry(scheduler.metrics.registry)
-        self.add_debug_source(name or f"scheduler{len(self._debug_sources)}",
-                              scheduler.debug_state)
+        key = name or f"scheduler{len(self._debug_sources)}"
+        self.add_debug_source(key, scheduler.debug_state)
+        if hasattr(scheduler, "health"):
+            self.add_health_source(key, scheduler.health)
         return self
 
     # ------------------------------------------------------------ content
@@ -96,6 +113,26 @@ class ObservabilityEndpoint:
                     state = dict(state, flight_recorder=fr[-last:])
             out[name] = state
         return out
+
+    _HEALTH_ORDER = ("ok", "degraded", "draining", "dead")
+
+    def health(self) -> Tuple[int, str]:
+        """Aggregate ``(http_code, body)`` for ``/healthz``: the worst
+        state any source reports. ``dead`` is the only non-200 — degraded
+        and draining processes are still alive and still serving (a k8s
+        liveness probe must not kill a box for shedding load)."""
+        worst = 0
+        for fn in self._health_sources.values():
+            try:
+                state = str(fn().get("state", "ok"))
+            except Exception:
+                state = "dead"       # a health source that can't answer
+                                     # IS the failure it exists to report
+            if state not in self._HEALTH_ORDER:
+                state = "dead"
+            worst = max(worst, self._HEALTH_ORDER.index(state))
+        body = self._HEALTH_ORDER[worst]
+        return (503 if body == "dead" else 200), body
 
     # ---------------------------------------------------------- lifecycle
     def start(self) -> Tuple[str, int]:
@@ -132,7 +169,8 @@ class ObservabilityEndpoint:
                                       default=str, indent=2)
                     self._send(200, body, "application/json")
                 elif url.path == "/healthz":
-                    self._send(200, "ok", "text/plain")
+                    code, body = ep.health()
+                    self._send(code, body, "text/plain")
                 else:
                     self._send(404, json.dumps(
                         {"error": "not found", "routes":

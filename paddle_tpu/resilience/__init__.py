@@ -1,0 +1,51 @@
+"""Serving/checkpoint resilience: deterministic fault injection, the
+transient-vs-fatal error contract, and graceful-degradation machinery.
+
+See ``faults`` (FaultPlan / inject / classify_error) and ``degradation``
+(DegradationLadder / StepWatchdog / StallStorm). Stdlib-only."""
+
+from paddle_tpu.resilience.degradation import (
+    DegradationLadder,
+    LEVEL_FLUSH,
+    LEVEL_OK,
+    LEVEL_REJECT,
+    LEVEL_SHRINK,
+    LEVELS,
+    StallStorm,
+    StepWatchdog,
+)
+from paddle_tpu.resilience.faults import (
+    FaultInjector,
+    FaultPlan,
+    FaultRule,
+    InjectedFault,
+    SITES,
+    arm,
+    classify_error,
+    disarm,
+    fault_plan,
+    get_injector,
+    inject,
+)
+
+__all__ = [
+    "DegradationLadder",
+    "FaultInjector",
+    "FaultPlan",
+    "FaultRule",
+    "InjectedFault",
+    "LEVELS",
+    "LEVEL_FLUSH",
+    "LEVEL_OK",
+    "LEVEL_REJECT",
+    "LEVEL_SHRINK",
+    "SITES",
+    "StallStorm",
+    "StepWatchdog",
+    "arm",
+    "classify_error",
+    "disarm",
+    "fault_plan",
+    "get_injector",
+    "inject",
+]

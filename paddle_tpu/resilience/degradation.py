@@ -1,0 +1,165 @@
+"""Graceful degradation: an ordered shed ladder + a step-latency watchdog.
+
+Under pressure a serving engine should get *worse*, not *dead*, and it
+should get worse in a fixed, documented order:
+
+    ok  ->  flush_cache  ->  shrink_admission  ->  reject
+
+1. **flush_cache** — drop the prefix cache. Cached blocks are pure
+   opportunism (they only accelerate future admissions); reclaiming them
+   is free correctness-wise and often clears the pressure outright.
+2. **shrink_admission** — stop admitting *fresh* requests into the batch
+   (preempted residents still resume: they already hold a slot's worth of
+   progress and re-queue at the front by policy).
+3. **reject** — refuse new ``add_request`` calls with
+   ``SchedulerOverloaded`` so backpressure reaches the caller instead of
+   growing an unbounded queue.
+
+The ladder escalates immediately when occupancy crosses a threshold but
+de-escalates one rung at a time, only after ``cooldown_steps``
+consecutive observations below ``recover_at`` — hysteresis, so an
+occupancy level that oscillates around a threshold does not flap the
+cache or the admission gate every step.
+
+``StepWatchdog`` is the hang detector: decode steps are metronomic by
+construction (one compiled program, fixed shapes), so a step that takes
+``factor``x the EWMA of recent steps — ``streak`` times in a row — is a
+stall storm (host contention, device flake, allocator thrash), not noise.
+It fires a ``StallStorm`` warning and freezes the flight recorder, same
+alarm discipline as ``TTFTBreachStorm``/``EvictionThrash`` in PR 6.
+"""
+
+from __future__ import annotations
+
+import warnings
+from typing import Optional, Tuple
+
+__all__ = [
+    "DegradationLadder",
+    "LEVELS",
+    "LEVEL_FLUSH",
+    "LEVEL_OK",
+    "LEVEL_REJECT",
+    "LEVEL_SHRINK",
+    "StallStorm",
+    "StepWatchdog",
+]
+
+LEVELS = ("ok", "flush_cache", "shrink_admission", "reject")
+LEVEL_OK = 0
+LEVEL_FLUSH = 1
+LEVEL_SHRINK = 2
+LEVEL_REJECT = 3
+
+
+class StallStorm(UserWarning):
+    """Decode step latency blew past the watchdog bound repeatedly."""
+
+
+class DegradationLadder:
+    """Maps a pressure signal (0..1 pool/queue occupancy) to a shed level.
+
+    Escalation is immediate (jumping straight to ``reject`` under a
+    pressure spike is correct — the cheaper rungs engage on the way
+    through in the same observation). De-escalation is one rung per
+    ``cooldown_steps`` consecutive calm observations."""
+
+    def __init__(self, flush_at: float = 0.90, shrink_at: float = 0.95,
+                 reject_at: float = 0.98, recover_at: float = 0.80,
+                 cooldown_steps: int = 4):
+        if not (recover_at < flush_at <= shrink_at <= reject_at):
+            raise ValueError(
+                f"ladder thresholds must satisfy recover_at < flush_at <= "
+                f"shrink_at <= reject_at, got {recover_at}/{flush_at}/"
+                f"{shrink_at}/{reject_at}")
+        self.flush_at = float(flush_at)
+        self.shrink_at = float(shrink_at)
+        self.reject_at = float(reject_at)
+        self.recover_at = float(recover_at)
+        self.cooldown_steps = int(cooldown_steps)
+        self.level = LEVEL_OK
+        self._calm = 0
+        self.transitions = 0
+
+    @property
+    def state(self) -> str:
+        return LEVELS[self.level]
+
+    def _target(self, pressure: float) -> int:
+        if pressure >= self.reject_at:
+            return LEVEL_REJECT
+        if pressure >= self.shrink_at:
+            return LEVEL_SHRINK
+        if pressure >= self.flush_at:
+            return LEVEL_FLUSH
+        return LEVEL_OK
+
+    def observe(self, pressure: float) -> Tuple[int, int]:
+        """Fold one pressure sample; returns ``(old_level, new_level)``."""
+        old = self.level
+        target = self._target(pressure)
+        if target > self.level:
+            self.level = target
+            self._calm = 0
+        elif self.level > LEVEL_OK and pressure < self.recover_at:
+            self._calm += 1
+            if self._calm >= self.cooldown_steps:
+                self.level -= 1
+                self._calm = 0
+        else:
+            self._calm = 0
+        if self.level != old:
+            self.transitions += 1
+        return old, self.level
+
+
+class StepWatchdog:
+    """Flags decode steps that are pathologically slow vs their own EWMA.
+
+    ``observe(step_s)`` returns True when that step counted as slow. A
+    streak of ``streak`` slow steps fires one ``StallStorm`` warning (and
+    freezes ``flight`` if given); the streak then resets so a persistent
+    stall alarms once per storm, not once per step. Slow samples are NOT
+    folded into the EWMA — a storm must not teach the watchdog that
+    storms are normal."""
+
+    def __init__(self, factor: float = 8.0, min_history: int = 16,
+                 streak: int = 3, abs_s: Optional[float] = None,
+                 flight=None):
+        self.factor = float(factor)
+        self.min_history = int(min_history)
+        self.streak = int(streak)
+        self.abs_s = abs_s
+        self.flight = flight
+        self.ewma: Optional[float] = None
+        self.samples = 0
+        self.slow_steps = 0
+        self.storms = 0
+        self._run = 0
+
+    def observe(self, step_s: float) -> bool:
+        slow = False
+        if self.abs_s is not None and step_s > self.abs_s:
+            slow = True
+        elif (self.samples >= self.min_history and self.ewma is not None
+                and step_s > self.factor * self.ewma):
+            slow = True
+        if slow:
+            self.slow_steps += 1
+            self._run += 1
+            if self._run >= self.streak:
+                self.storms += 1
+                self._run = 0
+                reason = (f"{self.streak} consecutive decode steps over "
+                          f"{self.factor:g}x EWMA "
+                          f"(last {step_s * 1e3:.1f}ms, "
+                          f"ewma {(self.ewma or 0) * 1e3:.1f}ms)")
+                if self.flight is not None:
+                    self.flight.alarm("stall_storm", reason)
+                warnings.warn(StallStorm(reason), stacklevel=3)
+        else:
+            self._run = 0
+            self.ewma = (step_s if self.ewma is None
+                         else 0.9 * self.ewma + 0.1 * step_s)
+            self.samples += 1
+        return slow

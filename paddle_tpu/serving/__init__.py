@@ -12,6 +12,11 @@ full request-lifecycle observability: per-request trace spans keyed by
 ``request_id``, ``serving_host_stall_seconds{phase=...}`` attribution,
 SLO/goodput accounting, a per-step flight recorder, and a live
 ``/metrics`` + ``/debug/requests`` endpoint (``sched.start_endpoint()``).
+Resilience (``paddle_tpu.resilience``) threads one failure-semantics
+contract through the loop: deterministic fault injection at named sites,
+transient-fault retry with per-request K budgets, ``cancel()`` /
+deadlines / queue TTL, a flush-cache → shrink-admission → reject
+degradation ladder, a step-latency watchdog, and a truthful ``/healthz``.
 
     queue → scheduler → slot grid → paged KV pool
                  │
@@ -41,6 +46,7 @@ from paddle_tpu.serving.request import (  # noqa: F401
     RequestQueue,
     RequestState,
     SchedulerConfig,
+    SchedulerOverloaded,
 )
 from paddle_tpu.serving.prefix_cache import (  # noqa: F401
     PrefixCache,
@@ -64,5 +70,6 @@ __all__ = [
     "RequestQueue",
     "RequestState",
     "SchedulerConfig",
+    "SchedulerOverloaded",
     "ServingMetrics",
 ]

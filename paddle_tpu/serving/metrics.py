@@ -46,6 +46,7 @@ _COUNTERS = (
     ("generated_tokens", "tokens sampled"),
     ("decode_steps", "fixed-shape decode iterations"),
     ("prefills", "prefill passes (admissions + resume recomputes)"),
+    ("requests_failed", "requests retired after repeated step faults"),
 )
 _GAUGES = (
     ("queue_depth", "requests waiting for a slot"),
@@ -54,6 +55,8 @@ _GAUGES = (
     ("total_blocks", "KV pool size in blocks"),
     ("kv_utilization", "fraction of KV blocks in use"),
     ("kv_fragmentation", "tail slack inside allocated blocks"),
+    ("degradation_level", "shed-ladder rung: 0 ok, 1 flush_cache, "
+                          "2 shrink_admission, 3 reject"),
 )
 
 
@@ -81,6 +84,16 @@ class ServingMetrics:
             "tpot_seconds", "time per output token", unit="s")
         self.step_time = self._registry.histogram(
             "step_time_seconds", "scheduler iteration wall time", unit="s")
+        # resilience: labeled families (site/outcome, cause) — exported as
+        # serving_faults_total{site=...,outcome=...} etc.
+        self._faults = self._registry.counter(
+            "faults_total",
+            "faults observed at injection-site granularity, by outcome "
+            "(fired / request_failed / fatal)")
+        self._cancelled = self._registry.counter(
+            "requests_cancelled_total",
+            "requests removed before completion, by cause "
+            "(user / deadline / queue_ttl)")
         self.ttft_slo_s: Optional[float] = None
         self.tpot_slo_s: Optional[float] = None
         self._slo_breach = None
@@ -200,6 +213,25 @@ class ServingMetrics:
         self.kv_utilization = allocator.utilization()
         self.kv_fragmentation = allocator.fragmentation(live_tokens)
 
+    def observe_fault(self, site: str, outcome: str = "fired"):
+        """Count one fault observation at ``site`` (an injection-site name
+        or an exception-derived label). Outcomes: ``fired`` for every
+        observed transient fault, ``request_failed`` when a request hits
+        its K-consecutive budget, ``fatal`` just before a re-raise."""
+        self._faults.labels(site=site, outcome=outcome).inc()
+
+    def observe_cancel(self, cause: str):
+        """Count one cancellation: ``user`` | ``deadline`` | ``queue_ttl``."""
+        self._cancelled.labels(cause=cause).inc()
+
+    def faults_snapshot(self) -> Dict[str, float]:
+        return {key: child.value
+                for key, child in self._faults._children.items()}
+
+    def cancelled_snapshot(self) -> Dict[str, float]:
+        return {key: child.value
+                for key, child in self._cancelled._children.items()}
+
     def observe_finish(self, req, trace=None) -> Dict[str, object]:
         """Fold one finished request's latency profile in; returns the SLO
         verdict (breach flags + attributed causes) for the alarm monitors."""
@@ -226,6 +258,10 @@ class ServingMetrics:
             "generated_tokens": self.generated_tokens,
             "decode_steps": self.decode_steps,
             "prefills": self.prefills,
+            "requests_failed": self.requests_failed,
+            "requests_cancelled": self.cancelled_snapshot(),
+            "faults": self.faults_snapshot(),
+            "degradation_level": self.degradation_level,
             "queue_depth": self.queue_depth,
             "running": self.running,
             "free_blocks": self.free_blocks,

@@ -68,6 +68,13 @@ class RefCountingBlockAllocator(BlockAllocator):
         with self._lock:
             return self._ref.get(block, 0) > 1
 
+    def sole_holder_count(self, blocks: List[int]) -> int:
+        """How many of ``blocks`` have exactly one holder. One lock
+        acquisition for the whole batch — the shed ladder asks this once
+        per step for the full cached-block set."""
+        with self._lock:
+            return sum(1 for b in blocks if self._ref.get(b, 0) == 1)
+
     def incref(self, block: int):
         with self._lock:
             if block not in self._allocated:

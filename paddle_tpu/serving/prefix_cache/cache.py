@@ -154,6 +154,13 @@ class PrefixCache:
 
     # ---- reading --------------------------------------------------------
 
+    def reclaimable_blocks(self) -> int:
+        """Cached blocks whose ONLY holder is the tree (refcount 1) —
+        memory one ``allocate()`` call reclaims on demand without touching
+        any live sequence. The shed ladder subtracts these from pool
+        pressure: a pool full of evictable cache is not a pressured pool."""
+        return self.allocator.sole_holder_count(self.tree.blocks())
+
     def hit_rate(self) -> float:
         total = self._hit_tokens + self._miss_tokens
         return self._hit_tokens / total if total else 0.0

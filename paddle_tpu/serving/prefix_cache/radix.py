@@ -77,6 +77,17 @@ class RadixTree:
         with self._lock:
             return self._num_nodes
 
+    def blocks(self) -> List[int]:
+        """Pool block ids of every cached node (point-in-time snapshot)."""
+        with self._lock:
+            out: List[int] = []
+            stack = list(self.root.children.values())
+            while stack:
+                n = stack.pop()
+                out.append(n.block)
+                stack.extend(n.children.values())
+            return out
+
     def _chunks(self, tokens: Sequence[int]):
         bs = self.block_size
         n_full = len(tokens) // bs

@@ -23,10 +23,24 @@ class RequestState(Enum):
     RUNNING = 1
     PREEMPTED = 2
     FINISHED = 3
+    CANCELLED = 4
+    FAILED = 5
+
+
+# finish reasons that are not a natural completion: the request was
+# removed by policy (cancel/deadline/TTL) or retired after repeated
+# faults. Everything else ("eos"/"length") counts toward goodput.
+CANCEL_REASONS = ("cancelled", "deadline", "queue_ttl")
+FAILED_REASON = "failed"
 
 
 class QueueFull(RuntimeError):
     """Admission control: the wait queue is at max_queue_size."""
+
+
+class SchedulerOverloaded(RuntimeError):
+    """Load shedding: the degradation ladder reached ``reject`` (or the
+    scheduler is draining) — the caller should back off or route away."""
 
 
 @dataclass
@@ -61,6 +75,22 @@ class SchedulerConfig:
     ttft_slo_s: Optional[float] = None    # None = SLO accounting off
     tpot_slo_s: Optional[float] = None
     ttft_breach_streak: int = 4       # consecutive breaches -> alarm
+    # ---- resilience (fault retry, deadlines, shedding). The fault knobs
+    # only matter when errors actually occur; the shed thresholds are
+    # fractions of max(pool occupancy, queue fill).
+    queue_ttl_s: Optional[float] = None   # evict QUEUED requests older
+    max_step_faults: int = 3          # K consecutive faults -> "failed"
+    retry_backoff_s: float = 0.0      # base backoff between step retries
+    enable_degradation: bool = True   # shed ladder + watchdog on/off
+    shed_flush_occupancy: float = 0.90
+    shed_shrink_occupancy: float = 0.95
+    shed_reject_occupancy: float = 0.98
+    shed_recover_occupancy: float = 0.80
+    shed_cooldown_steps: int = 4
+    watchdog_factor: float = 8.0      # step > factor*EWMA counts slow
+    watchdog_min_history: int = 16    # steps of EWMA warmup before arming
+    watchdog_streak: int = 3          # consecutive slow steps -> StallStorm
+    watchdog_abs_s: Optional[float] = None  # absolute per-step bound
 
     @property
     def max_blocks_per_seq(self) -> int:
@@ -107,7 +137,8 @@ class RequestOutput:
     request_id: int
     prompt_ids: np.ndarray            # [P] int64, the original prompt
     generated_ids: np.ndarray         # [G] int64, incl. the EOS if hit
-    finish_reason: Optional[str]      # "eos" | "length" | None (running)
+    finish_reason: Optional[str]      # "eos"|"length"|"cancelled"|"deadline"
+                                      # |"queue_ttl"|"failed"|None (running)
     ttft_s: Optional[float]           # time-to-first-token
     tpot_s: Optional[float]           # mean time-per-output-token (after 1st)
     num_preemptions: int
@@ -137,6 +168,17 @@ class Request:
     finish_reason: Optional[str] = None
     blocks: List[int] = field(default_factory=list)   # live KV blocks
     slot: int = -1
+    deadline_s: Optional[float] = None  # wall budget from arrival; None=∞
+    consecutive_faults: int = 0       # step faults since last clean step
+
+    @property
+    def done(self) -> bool:
+        return self.state in (RequestState.FINISHED, RequestState.CANCELLED,
+                              RequestState.FAILED)
+
+    def past_deadline(self, now: float) -> bool:
+        return (self.deadline_s is not None
+                and now - self.arrival_t > self.deadline_s)
 
     @property
     def resume_ids(self) -> np.ndarray:
@@ -161,7 +203,12 @@ class Request:
             self.on_token(self.request_id, int(token))
 
     def finish(self, reason: str):
-        self.state = RequestState.FINISHED
+        if reason in CANCEL_REASONS:
+            self.state = RequestState.CANCELLED
+        elif reason == FAILED_REASON:
+            self.state = RequestState.FAILED
+        else:
+            self.state = RequestState.FINISHED
         self.finish_reason = reason
         self.finish_t = time.perf_counter()
 
@@ -213,3 +260,10 @@ class RequestQueue:
 
     def pop(self) -> Request:
         return self._items.pop(0)
+
+    def remove(self, request_id: int) -> Optional[Request]:
+        """Pull one request out of the queue by id (cancel / TTL sweep)."""
+        for i, r in enumerate(self._items):
+            if r.request_id == request_id:
+                return self._items.pop(i)
+        return None

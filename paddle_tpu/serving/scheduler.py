@@ -24,6 +24,15 @@ Orca-style scheduling on a vLLM-style paged KV pool, TPU-first:
   flight recorder keeps the last-N-iterations picture, SLO targets turn
   into goodput/breach accounting, and ``start_endpoint()`` serves it all
   over ``/metrics`` + ``/debug/requests``.
+- Failure semantics (``paddle_tpu.resilience``): every fault surface is
+  behind a named ``inject()`` site, step faults classify transient vs
+  fatal — transients retry with bounded backoff and retire the affected
+  request as ``failed`` after K consecutive faults instead of poisoning
+  the batch; requests carry deadlines and can be ``cancel()``-ed at any
+  lifecycle stage (slot + blocks freed, peers token-identical); pressure
+  drives a flush-cache → shrink-admission → reject degradation ladder; a
+  step-latency watchdog fires ``StallStorm``; ``health()`` reports
+  ``ok|degraded|draining|dead`` truthfully for ``/healthz``.
 """
 
 from __future__ import annotations
@@ -44,6 +53,7 @@ from paddle_tpu.observability.annotations import hot_path
 from paddle_tpu.observability.request_trace import (
     PHASE_ADMIT,
     PHASE_PREEMPTED,
+    PHASE_QUEUED,
     PHASE_RUNNING,
     RequestTracer,
 )
@@ -53,6 +63,17 @@ from paddle_tpu.observability.serving_stall import (
     ServingStall,
 )
 from paddle_tpu.profiler import RecordEvent
+from paddle_tpu.resilience import (
+    DegradationLadder,
+    InjectedFault,
+    LEVEL_OK,
+    LEVEL_REJECT,
+    LEVEL_SHRINK,
+    StepWatchdog,
+    classify_error,
+    get_injector,
+    inject,
+)
 from paddle_tpu.serving.metrics import ServingMetrics
 from paddle_tpu.serving.prefix_cache import (
     PrefixCache,
@@ -65,6 +86,7 @@ from paddle_tpu.serving.request import (
     RequestQueue,
     RequestState,
     SchedulerConfig,
+    SchedulerOverloaded,
 )
 
 
@@ -135,31 +157,80 @@ class ContinuousBatchingScheduler:
         self._step_evicted = 0           # eviction-thrash signal, per step
         if self.prefix_cache is not None:
             self.prefix_cache.set_evict_listener(self._on_evicted_blocks)
+        # ---- resilience ------------------------------------------------
+        self._ladder: Optional[DegradationLadder] = None
+        self._watchdog: Optional[StepWatchdog] = None
+        if cfg.enable_degradation:
+            self._ladder = DegradationLadder(
+                flush_at=cfg.shed_flush_occupancy,
+                shrink_at=cfg.shed_shrink_occupancy,
+                reject_at=cfg.shed_reject_occupancy,
+                recover_at=cfg.shed_recover_occupancy,
+                cooldown_steps=cfg.shed_cooldown_steps)
+            self._watchdog = StepWatchdog(
+                factor=cfg.watchdog_factor,
+                min_history=cfg.watchdog_min_history,
+                streak=cfg.watchdog_streak,
+                abs_s=cfg.watchdog_abs_s,
+                flight=self.flight)
+        self._draining = False           # start_drain(): finish, admit no new
+        self._driver = None              # optional driver thread, for health
+        self._step_faults: Dict[str, int] = {}   # site -> count, per step
 
     # ---- admission -----------------------------------------------------
 
     def add_request(self, prompt_ids, max_new_tokens: Optional[int] = None,
                     eos_token_id: Optional[int] = None, priority: int = 0,
-                    on_token=None) -> int:
-        """Enqueue one prompt. Raises ``QueueFull`` past max_queue_size and
-        ``ValueError`` for requests that can never fit the pool/window."""
-        ids = np.asarray(prompt_ids).reshape(-1).astype(np.int64)
+                    on_token=None, deadline_s: Optional[float] = None) -> int:
+        """Enqueue one prompt. Raises ``ValueError`` for malformed requests
+        (empty prompt, non-integer tokens, ``max_new_tokens < 1``, prompts
+        that can never fit the window/pool), ``QueueFull`` past
+        max_queue_size, and ``SchedulerOverloaded`` while draining or when
+        the degradation ladder has reached ``reject``. ``deadline_s`` is a
+        wall-clock budget from arrival: a request still unfinished past it
+        is cancelled (reason ``deadline``) at the next step."""
+        ids = np.asarray(prompt_ids).reshape(-1)
+        if ids.dtype.kind not in "iu":
+            raise ValueError(
+                f"prompt_ids must be integer token ids, got dtype "
+                f"{ids.dtype}")
+        ids = ids.astype(np.int64)
+        if ids.size == 0:
+            raise ValueError("prompt must contain at least one token")
         mnt = (self.config.max_new_tokens
                if max_new_tokens is None else int(max_new_tokens))
         if mnt < 1:
             raise ValueError("max_new_tokens must be >= 1")
+        if deadline_s is not None and deadline_s <= 0:
+            raise ValueError("deadline_s must be positive (or None)")
         eos = (self.config.eos_token_id
                if eos_token_id is None else eos_token_id)
+        if len(ids) > self.max_seq_len:
+            raise ValueError(
+                f"prompt is {len(ids)} tokens but the largest prefill "
+                f"bucket is {self.max_seq_len} (max_seq_len)")
         total = len(ids) + mnt
         cap = self.allocator.num_blocks * self.config.block_size
         if total > self.max_seq_len or total > cap:
             raise ValueError(
                 f"request needs {total} tokens but the window/pool caps at "
                 f"{min(self.max_seq_len, cap)}")
+        if self._draining:
+            self.metrics.requests_rejected += 1
+            raise SchedulerOverloaded(
+                "scheduler is draining; not accepting new requests")
+        if self._ladder is not None and self._ladder.level >= LEVEL_REJECT:
+            self.metrics.requests_rejected += 1
+            raise SchedulerOverloaded(
+                f"overloaded: degradation ladder at {self._ladder.state!r} "
+                f"(kv_utilization="
+                f"{self.allocator.utilization():.2f}, "
+                f"queue_depth={len(self.queue)})")
         rid = self._next_rid
         self._next_rid += 1
         req = Request(request_id=rid, prompt_ids=ids, max_new_tokens=mnt,
-                      eos_token_id=eos, priority=priority, on_token=on_token)
+                      eos_token_id=eos, priority=priority, on_token=on_token,
+                      deadline_s=deadline_s)
         try:
             self.queue.push(req)
         except Exception:
@@ -204,7 +275,18 @@ class ContinuousBatchingScheduler:
             return
         seq = np.concatenate([np.asarray(req.prompt_ids, np.int64),
                               np.asarray(req.out_tokens, np.int64)])[:pos]
-        self.prefix_cache.insert(seq, req.blocks)
+        try:
+            inject("serving.prefix_insert")
+            self.prefix_cache.insert(seq, req.blocks)
+        except Exception as exc:
+            # cache donation is best-effort: a transient fault just skips
+            # the insert (the caller's free() still releases the blocks —
+            # no leak, only a missed future hit). Fatal errors propagate.
+            site = self._fault_site(exc, "serving.prefix_insert")
+            if classify_error(exc) == "fatal":
+                self.metrics.observe_fault(site, "fatal")
+                raise
+            self._note_fault(site)
 
     def _retire(self, slot: int, reason: str):
         req = self._slots[slot]
@@ -225,13 +307,98 @@ class ContinuousBatchingScheduler:
         # close the trace at the request's finish stamp BEFORE judging SLO
         # — breach-cause attribution reads the completed phase timeline
         self.tracer.finish(req.request_id, t=req.finish_t)
-        verdict = self.metrics.observe_finish(req, trace=trace)
-        if self.metrics.ttft_slo_s is not None:
-            self._alarms.observe_ttft(verdict["ttft_breach"],
-                                      verdict["ttft_s"],
-                                      self.metrics.ttft_slo_s)
+        if reason in ("eos", "length"):
+            # only natural completions count toward requests_finished /
+            # goodput — a cancelled or failed request is not good tokens
+            verdict = self.metrics.observe_finish(req, trace=trace)
+            if self.metrics.ttft_slo_s is not None:
+                self._alarms.observe_ttft(verdict["ttft_breach"],
+                                          verdict["ttft_s"],
+                                          self.metrics.ttft_slo_s)
         self._finished[req.request_id] = req.output()
         return req
+
+    def _finalize_off_grid(self, req: Request, reason: str) -> Request:
+        """Terminal bookkeeping for a request that holds NO slot and NO
+        blocks (queued cancel/TTL, or a fault before packing)."""
+        req.finish(reason)
+        trace = self.tracer.get(req.request_id)
+        if trace is not None:
+            trace.note(finish_reason=reason,
+                       generated_tokens=req.num_generated,
+                       num_preemptions=req.num_preemptions)
+        self.tracer.finish(req.request_id, t=req.finish_t)
+        self._finished[req.request_id] = req.output()
+        return req
+
+    # ---- cancellation / deadlines -------------------------------------
+
+    def cancel(self, request_id: int, cause: str = "user") -> RequestOutput:
+        """Cancel one request wherever it lives. Queued: removed outright.
+        Running: its KV is donated to the prefix cache (valid work), its
+        blocks and slot are freed — concurrent requests' token streams are
+        untouched (per-slot decode rows are independent). Already-terminal
+        requests return their stored output (idempotent). The returned
+        ``RequestOutput`` carries the tokens generated so far with
+        ``finish_reason`` ``cancelled|deadline|queue_ttl``."""
+        reason = "cancelled" if cause == "user" else cause
+        if request_id in self._finished:
+            return self._finished[request_id]
+        queued = self.queue.remove(request_id)
+        if queued is not None:
+            self.metrics.observe_cancel(cause)
+            return self._finalize_off_grid(queued, reason).output()
+        for s, req in enumerate(self._slots):
+            if req is not None and req.request_id == request_id:
+                self.metrics.observe_cancel(cause)
+                return self._retire(s, reason).output()
+        raise KeyError(f"unknown request_id {request_id}")
+
+    def start_drain(self):
+        """Stop admitting new requests (``SchedulerOverloaded``); everything
+        already queued or running finishes normally. ``health()`` reports
+        ``draining`` until the engine empties."""
+        self._draining = True
+
+    def attach_driver(self, thread):
+        """Register the thread driving ``step()`` so ``health()`` can report
+        ``dead`` (non-200 /healthz) when it exits with work still pending —
+        instead of a healthz that says ok while nothing decodes."""
+        self._driver = thread
+
+    def _sweep_expired(self) -> List[Request]:
+        """Cancel requests past their deadline (queued OR running) and
+        queued requests older than ``queue_ttl_s``. Runs at step start."""
+        cfg = self.config
+        now = _time.perf_counter()
+        swept: List[Request] = []
+        for req in list(self.queue._items):
+            if req.past_deadline(now):
+                self.cancel(req.request_id, cause="deadline")
+                swept.append(req)
+            elif (cfg.queue_ttl_s is not None
+                    and now - req.arrival_t > cfg.queue_ttl_s):
+                self.cancel(req.request_id, cause="queue_ttl")
+                swept.append(req)
+        for s in range(len(self._slots)):
+            req = self._slots[s]
+            if req is not None and req.past_deadline(now):
+                self.cancel(req.request_id, cause="deadline")
+                swept.append(req)
+        return swept
+
+    # ---- fault absorption ---------------------------------------------
+
+    def _fault_site(self, exc: BaseException, default: str) -> str:
+        return exc.site if isinstance(exc, InjectedFault) else default
+
+    def _note_fault(self, site: str):
+        self.metrics.observe_fault(site, "fired")
+        self._step_faults[site] = self._step_faults.get(site, 0) + 1
+
+    def _fault_budget_exhausted(self, req: Request) -> bool:
+        req.consecutive_faults += 1
+        return req.consecutive_faults >= self.config.max_step_faults
 
     def _preempt_victim(self, exclude_slot: int = -1) -> Optional[int]:
         """Pick the running sequence to evict: lowest priority, then the
@@ -277,6 +444,9 @@ class ContinuousBatchingScheduler:
         while True:
             try:
                 before = len(req.blocks)
+                # extend() is idempotent for a given pos, so a fault here
+                # (absorbed by the decode retry loop) re-runs cleanly
+                inject("serving.block_alloc")
                 self.allocator.extend(req.blocks, int(self._pos[slot]), 1)
                 for j in range(before, len(req.blocks)):
                     self._table[slot, j] = req.blocks[j]
@@ -322,6 +492,21 @@ class ContinuousBatchingScheduler:
             if slot is None:
                 break
             nxt = self.queue.peek()
+            if (self._ladder is not None
+                    and self._ladder.level >= LEVEL_SHRINK
+                    and self._pool_pressure()
+                    >= self.config.shed_recover_occupancy
+                    and nxt.num_preemptions == 0):
+                # shed ladder rung 2: no FRESH admissions while the POOL is
+                # the pressured resource. Preempted residents still resume —
+                # their latency budget is spent and their eviction already
+                # relieved the pool. The pressure guard matters twice over:
+                # queue pressure alone must never gate admission (admitting
+                # from the queue is the only way a queue drains), and
+                # cache-only blocks must not count as pool pressure (gated
+                # admission never allocates, and allocation is the only
+                # eviction trigger) — either one livelocks.
+                break
             ids = nxt.resume_ids
             P = len(ids)
             hit_blocks: List[int] = []
@@ -338,12 +523,31 @@ class ContinuousBatchingScheduler:
             need_blocks = -(-P // bs) - len(hit_blocks) + (1 if cow else 0)
             t0 = pc()
             try:
+                inject("serving.block_alloc")
                 fresh = (self.allocator.allocate(need_blocks * bs)
                          if need_blocks > 0 else [])
             except KVPoolExhausted:
                 if hit_blocks:
                     self.prefix_cache.unpin(hit_blocks)
                 break                        # running seqs keep precedence
+            except Exception as exc:
+                # nothing allocated yet: drop the pins and triage. A
+                # transient fault leaves the request queued (retried next
+                # step) until its K-consecutive-fault budget runs out.
+                if hit_blocks:
+                    self.prefix_cache.unpin(hit_blocks)
+                site = self._fault_site(exc, "serving.block_alloc")
+                if classify_error(exc) == "fatal":
+                    self.metrics.observe_fault(site, "fatal")
+                    raise
+                self._note_fault(site)
+                if self._fault_budget_exhausted(nxt):
+                    self.queue.pop()
+                    self.metrics.observe_fault(site, "request_failed")
+                    self.metrics.requests_failed += 1
+                    finished.append(self._finalize_off_grid(nxt, "failed"))
+                    continue
+                break
             block_s += pc() - t0
             req = self.queue.pop()
             trace = self.tracer.get(req.request_id)
@@ -372,18 +576,44 @@ class ContinuousBatchingScheduler:
             row[0, :len(blocks)] = blocks
             block_s += pc() - t0
             t0 = pc()
-            with RecordEvent("serving.prefill"), paddle.no_grad():
-                caches = [PagedCacheSlot(
-                    kp, vp, paddle.to_tensor(row),
-                    paddle.to_tensor(np.array([matched], np.int32)))
-                    for kp, vp in self._pools]
-                next_ids, caches = self._step_fn(
-                    paddle.to_tensor(ids_np),
-                    paddle.to_tensor(np.arange(matched, matched + Pb,
-                                               dtype=np.int32)),
-                    caches,
-                    paddle.to_tensor(np.array([S - 1], np.int32)))
-                self._store_pools(caches)
+            try:
+                inject("serving.prefill")
+                with RecordEvent("serving.prefill"), paddle.no_grad():
+                    caches = [PagedCacheSlot(
+                        kp, vp, paddle.to_tensor(row),
+                        paddle.to_tensor(np.array([matched], np.int32)))
+                        for kp, vp in self._pools]
+                    next_ids, caches = self._step_fn(
+                        paddle.to_tensor(ids_np),
+                        paddle.to_tensor(np.arange(matched, matched + Pb,
+                                                   dtype=np.int32)),
+                        caches,
+                        paddle.to_tensor(np.array([S - 1], np.int32)))
+                    self._store_pools(caches)
+            except Exception as exc:
+                # the request is popped and holds blocks but is NOT packed
+                # into the grid: release everything (free() drops fresh
+                # blocks and decrefs cache pins alike) and either requeue
+                # for a clean re-prefill or fail it past its budget.
+                self.allocator.free(req.blocks)
+                req.blocks = []
+                req.slot = -1
+                site = self._fault_site(exc, "serving.prefill")
+                if classify_error(exc) == "fatal":
+                    self.metrics.observe_fault(site, "fatal")
+                    raise
+                self._note_fault(site)
+                if self._fault_budget_exhausted(req):
+                    self.metrics.observe_fault(site, "request_failed")
+                    self.metrics.requests_failed += 1
+                    finished.append(self._finalize_off_grid(req, "failed"))
+                else:
+                    self.queue.push(req, force=True)
+                    if trace is not None:
+                        trace.transition(PHASE_QUEUED)
+                        trace.event("prefill_fault", site=site,
+                                    consecutive=req.consecutive_faults)
+                continue
             prefill_s = pc() - t0
             t0 = pc()
             # the ONE deliberate admission sync: the first sampled token
@@ -401,6 +631,7 @@ class ContinuousBatchingScheduler:
             self._table[slot] = row[0]
             self._pos[slot] = P
             self._next_tok[slot] = tok
+            req.consecutive_faults = 0   # clean admission resets the budget
             if trace is not None:
                 trace.note(cached_tokens=matched, prefilled_tokens=S)
                 trace.subspan("prefix_match", radix_s)
@@ -427,6 +658,32 @@ class ContinuousBatchingScheduler:
                 - prefill_s)
         return finished
 
+    def _absorb_step_fault(self, exc: BaseException, running: List[int],
+                           attempt: int) -> List[Request]:
+        """Triage one decode-step fault. Fatal errors re-raise. Transient
+        ones charge every running request's K-consecutive budget, retire
+        the over-budget ones as ``failed`` (their slots simply drop out of
+        the retry — the batch is not poisoned), back off, and let the
+        caller retry. Returns the requests failed by this fault."""
+        site = self._fault_site(exc, "serving.decode_step")
+        if classify_error(exc) == "fatal":
+            self.metrics.observe_fault(site, "fatal")
+            raise exc
+        self._note_fault(site)
+        failed: List[Request] = []
+        for s in running:
+            req = self._slots[s]
+            if req is None:
+                continue
+            if self._fault_budget_exhausted(req):
+                self.metrics.observe_fault(site, "request_failed")
+                self.metrics.requests_failed += 1
+                failed.append(self._retire(s, "failed"))
+        backoff = self.config.retry_backoff_s
+        if backoff > 0:
+            _time.sleep(min(backoff * (2 ** attempt), 1.0))
+        return failed
+
     @hot_path(reason="the decode-loop iteration itself")
     def _decode_once(self) -> List[Request]:
         """One fixed-shape decode iteration over every running slot.
@@ -434,36 +691,54 @@ class ContinuousBatchingScheduler:
         Stall attribution: the capacity loop (block extends + preemption
         table rewrites) is ``block_accounting``, the blocking token read is
         ``sampling_sync``, per-token emit/callbacks are ``streaming`` — the
-        exact host seams the async-engine refactor (ROADMAP 4) overlaps."""
+        exact host seams the async-engine refactor (ROADMAP 4) overlaps.
+
+        Fault contract: everything up to and including the blocking token
+        read sits inside the retry envelope. The injection point fires
+        BEFORE the dispatch consumes (donates) the pools, and the capacity
+        extend is idempotent per position — so a retried step replays
+        against identical state and surviving sequences stay
+        token-identical to a fault-free run."""
         S = self.config.max_num_seqs
-        running = [s for s in range(S) if self._slots[s] is not None]
-        if not running:
-            return []
         pc = _time.perf_counter
-        with self.stall.timed("block_accounting"):
-            for s in running:
-                if self._slots[s] is None:
-                    continue                 # evicted by an earlier slot
-                self._ensure_decode_capacity(s)
-            # capacity assurance may have preempted ANY slot, incl. later
-            running = [s for s in running if self._slots[s] is not None]
-        if not running:
-            return []
-        with RecordEvent("serving.decode_step"), paddle.no_grad():
-            tok = self._next_tok.reshape(S, 1).astype(np.int32)
-            pos = self._pos.reshape(S, 1).astype(np.int32)
-            caches = self._caches(self._table, self._pos)
-            next_ids, caches = self._step_fn(
-                paddle.to_tensor(tok), paddle.to_tensor(pos), caches,
-                paddle.to_tensor(np.zeros(S, np.int32)))
-            self._store_pools(caches)
-        with self.stall.timed("sampling_sync"):
-            step_np = np.asarray(next_ids.numpy())
+        finished: List[Request] = []
+        attempt = 0
+        while True:
+            running = [s for s in range(S) if self._slots[s] is not None]
+            if not running:
+                return finished
+            try:
+                with self.stall.timed("block_accounting"):
+                    for s in running:
+                        if self._slots[s] is None:
+                            continue         # evicted by an earlier slot
+                        self._ensure_decode_capacity(s)
+                    # capacity assurance may have preempted ANY slot
+                    running = [s for s in running
+                               if self._slots[s] is not None]
+                if not running:
+                    return finished
+                inject("serving.decode_step")
+                with RecordEvent("serving.decode_step"), paddle.no_grad():
+                    tok = self._next_tok.reshape(S, 1).astype(np.int32)
+                    pos = self._pos.reshape(S, 1).astype(np.int32)
+                    caches = self._caches(self._table, self._pos)
+                    next_ids, caches = self._step_fn(
+                        paddle.to_tensor(tok), paddle.to_tensor(pos), caches,
+                        paddle.to_tensor(np.zeros(S, np.int32)))
+                    self._store_pools(caches)
+                with self.stall.timed("sampling_sync"):
+                    step_np = np.asarray(next_ids.numpy())
+            except Exception as exc:
+                finished += self._absorb_step_fault(exc, running, attempt)
+                attempt += 1
+                continue
+            break
         self.metrics.decode_steps += 1
-        finished = []
         stream_s = 0.0
         for s in running:
             req = self._slots[s]
+            req.consecutive_faults = 0       # a clean step resets budgets
             self._pos[s] += 1                # fed token is now cached
             t = int(step_np[s])
             self._next_tok[s] = t
@@ -500,18 +775,24 @@ class ContinuousBatchingScheduler:
         pre_hit = (self.prefix_cache._hit_tokens
                    if self.prefix_cache is not None else 0)
         self._step_evicted = 0
+        self._step_faults = {}
+        done = self._sweep_expired()
+        level = self._apply_degradation()
         try:
-            done = self._admit()
+            done += self._admit()
             done += self._decode_once()
         finally:
             if was_training:
                 self.model.train()
-        self.metrics.step_time.record(_time.perf_counter() - t0)
+        step_s = _time.perf_counter() - t0
+        self.metrics.step_time.record(step_s)
+        if self._watchdog is not None:
+            self._watchdog.observe(step_s)
         self.metrics.observe_gauges(
             queue_depth=len(self.queue),
             running=sum(r is not None for r in self._slots),
             allocator=self.allocator, live_tokens=self._live_tokens())
-        self.flight.record_step(
+        record = dict(
             running=sum(r is not None for r in self._slots),
             queue_depth=len(self.queue),
             free_blocks=self.allocator.num_free_blocks,
@@ -523,9 +804,50 @@ class ContinuousBatchingScheduler:
                               - pre_hit),
             evicted_blocks=self._step_evicted,
             finished=len(done))
+        # armed/fired injection state and shed level land in the flight
+        # ring ONLY when active — fault-free dumps stay byte-stable
+        inj = get_injector()
+        if inj.armed:
+            record["fault_plan"] = list(inj.armed_sites)
+        if self._step_faults:
+            record["faults"] = sum(self._step_faults.values())
+            record["fault_sites"] = dict(self._step_faults)
+        if level > LEVEL_OK:
+            record["degradation"] = level
+        self.flight.record_step(**record)
         if self.prefix_cache is not None:
             self._alarms.observe_evictions(self._step_evicted)
         return [r.output() for r in done]
+
+    def _pool_pressure(self) -> float:
+        """Pool pressure for the shed ladder: allocated blocks MINUS the
+        prefix cache's reclaimable ones. A block whose only holder is the
+        radix tree is freed on demand by the allocator's evict callback —
+        a warm cache is not load. Counting it would hold the ladder up
+        forever: admission gets gated, gated admission never allocates,
+        and allocation is the only thing that evicts (livelock)."""
+        used = self.allocator.num_used_blocks
+        if self.prefix_cache is not None and used:
+            used -= self.prefix_cache.reclaimable_blocks()
+        return used / max(self.allocator.num_blocks, 1)
+
+    def _apply_degradation(self) -> int:
+        """Fold pool/queue pressure into the shed ladder; flush the prefix
+        cache when first stepping onto the ladder. Returns the level."""
+        if self._ladder is None:
+            return LEVEL_OK
+        cfg = self.config
+        pressure = max(
+            self._pool_pressure(),
+            len(self.queue) / cfg.max_queue_size if cfg.max_queue_size
+            else 0.0)
+        old, new = self._ladder.observe(pressure)
+        if (new > LEVEL_OK >= old and self.prefix_cache is not None):
+            # rung 1 (crossed in any escalation): cached blocks are pure
+            # opportunism — reclaim them before touching live requests
+            self.prefix_cache.flush()
+        self.metrics.degradation_level = new
+        return new
 
     def run(self) -> Dict[int, RequestOutput]:
         """Drain: step until queue and slots are empty; outputs by rid."""
@@ -565,6 +887,31 @@ class ContinuousBatchingScheduler:
         return self.prefix_cache.stats()
 
     # ---- live introspection -------------------------------------------
+
+    def health(self) -> Dict[str, object]:
+        """Truthful health for ``/healthz``. Precedence: ``dead`` (an
+        attached driver thread exited with work still pending) >
+        ``draining`` > ``degraded`` (shed ladder engaged) > ``ok``."""
+        state = "ok"
+        if (self._driver is not None and not self._driver.is_alive()
+                and self.has_unfinished()):
+            state = "dead"
+        elif self._draining:
+            state = "draining"
+        elif self._ladder is not None and self._ladder.level > LEVEL_OK:
+            state = "degraded"
+        return {
+            "state": state,
+            "degradation": (self._ladder.state if self._ladder is not None
+                            else "ok"),
+            "queue_depth": len(self.queue),
+            "running": sum(r is not None for r in self._slots),
+            "kv_utilization": round(self.allocator.utilization(), 4),
+            "slow_steps": (self._watchdog.slow_steps
+                           if self._watchdog is not None else 0),
+            "stall_storms": (self._watchdog.storms
+                             if self._watchdog is not None else 0),
+        }
 
     def debug_state(self) -> Dict[str, object]:
         """The ``/debug/requests`` payload: live request table (running +
@@ -607,6 +954,8 @@ class ContinuousBatchingScheduler:
             },
             "prefix_cache": self.prefix_cache_stats(),
             "compile": self.compile_stats(),
+            "health": self.health(),
+            "fault_injection": get_injector().snapshot(),
         }
 
     def export_request_trace(self, path: str) -> str:
@@ -649,6 +998,14 @@ class ContinuousBatchingScheduler:
 
         mgr = source if isinstance(source, CheckpointManager) \
             else CheckpointManager(str(source))
+        try:
+            # before restore touches the model: a fault here leaves the
+            # old weights fully intact and the prefix cache valid
+            inject("serving.weight_reload")
+        except Exception as exc:
+            self.metrics.observe_fault(
+                self._fault_site(exc, "serving.weight_reload"), "fired")
+            raise
         with RecordEvent("serving.reload_weights",
                          TracerEventType.UserDefined):
             res = mgr.restore(step=step, model=self.model, verify=verify,

@@ -39,8 +39,8 @@ def _git_commit():
                              capture_output=True, text=True, timeout=5)
         if out.returncode == 0:
             return out.stdout.strip()
-    except Exception:
-        pass
+    except (OSError, subprocess.SubprocessError):
+        pass  # no git / not a checkout / timed out: report Unknown
     return "Unknown"
 
 

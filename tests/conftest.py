@@ -20,14 +20,19 @@ if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
-# Persistent compile cache (VERDICT r4 weak #5): repeat suite runs amortize
-# the XLA compiles that dominate wall-clock. The dir is stamped with the
-# framework+jax versions and auto-wiped on mismatch (NOTES r7: a stale cache
-# replayed wrong-numerics AOT executables into the serving tests), so no
-# manual `rm -rf build/jax_cache` is ever needed. PADDLE_TPU_TEST_NO_CACHE=1
-# opts out entirely. Loaded by file path: importing paddle_tpu here would
-# initialize jax before the env pinning above.
-if os.environ.get("PADDLE_TPU_TEST_NO_CACHE") != "1":
+# Persistent compile cache: OFF for tests by default, PADDLE_TPU_TEST_CACHE=1
+# opts in. The stamped dir (framework+jax versions, auto-wiped on mismatch)
+# was built after NOTES r7's stale-cache corruption, but stamping cannot
+# catch the residual hole: the SAME build's cache occasionally replays an
+# XLA:CPU AOT executable with wrong numerics (decode programs with donated
+# buffers; the per-module _no_aot_replay fences protect the serving modules'
+# own compiles, not executables replayed earlier in the process). Measured on
+# the tier-1 box: ~3 corrupt runs in 22 with the cache vs 0 in 8 without,
+# while a cold-cache full suite costs only ~3% more wall than a warm one —
+# determinism of the primary gate wins. Benches keep the cache (bench.py
+# wires it independently). Loaded by file path: importing paddle_tpu here
+# would initialize jax before the env pinning above.
+if os.environ.get("PADDLE_TPU_TEST_CACHE") == "1":
     import importlib.util as _ilu
 
     _repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))

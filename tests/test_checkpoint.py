@@ -143,6 +143,38 @@ def test_kill_between_rename_and_marker_falls_back(tmp_path, rng):
     assert mgr.latest().step == 1
 
 
+@pytest.mark.parametrize("site", ["ckpt.shard_write", "ckpt.manifest_write",
+                                  "ckpt.rename"])
+def test_injected_fault_during_save_falls_back(tmp_path, rng, site):
+    """Chaos drill over every write-path injection site: a fault at shard
+    fsync, MANIFEST write, or the commit rename must leave step 1 as the
+    newest committed checkpoint, and a fresh manager must recover and
+    commit normally afterwards — the same contract the SimulatedCrash
+    fail-point tests pin, now reachable from a seeded FaultPlan."""
+    from paddle_tpu.resilience import FaultPlan, InjectedFault, fault_plan
+
+    m, opt = _make_train()
+    x = paddle.to_tensor(rng.standard_normal((8, 4)).astype(np.float32))
+    _step(m, opt, x)
+    mgr = CheckpointManager(str(tmp_path))
+    mgr.save(1, model=m, optimizer=opt)
+
+    _step(m, opt, x)
+    with fault_plan(FaultPlan(seed=0).on(site, at=1, kind="fatal")):
+        with pytest.raises(InjectedFault):
+            mgr.save(2, model=m, optimizer=opt)
+    # step_2 must be invisible: absent entirely, or present uncommitted
+    assert not (os.path.isdir(mgr.step_dir(2))
+                and is_committed(mgr.step_dir(2)))
+    assert mgr.latest().step == 1
+
+    # a NEW manager (fresh process after the fault) recovers and commits
+    mgr2 = CheckpointManager(str(tmp_path))
+    assert mgr2.latest().step == 1
+    mgr2.save(2, model=m, optimizer=opt)
+    assert mgr2.latest().step == 2 and is_committed(mgr2.step_dir(2))
+
+
 def test_bit_flipped_shard_detected_and_skipped(tmp_path, rng):
     """ISSUE acceptance: a bit-flipped shard file leaves latest() at the
     previous commit (crc32 mismatch), which loads bit-identical."""

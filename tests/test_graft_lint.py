@@ -208,6 +208,66 @@ def test_donation_alias_bad_and_clean(tmp_path):
 
 # ------------------------------------------------- suppressions + baseline
 
+def test_swallowed_exception_bad_and_clean(tmp_path):
+    _write(tmp_path, "mod.py", """
+        import logging
+
+        def bare(x):
+            try:
+                return x()
+            except:                       # bad: bare except, no re-raise
+                pass
+
+        def broad_silent(x):
+            try:
+                return x()
+            except Exception:             # bad: swallows silently
+                pass
+
+        def broad_tuple(x):
+            try:
+                return x()
+            except (ValueError, Exception):   # bad: tuple hides the broad
+                pass
+
+        def bare_reraise(x):
+            try:
+                return x()
+            except:                       # clean: re-raises
+                raise
+
+        def broad_handled(x):
+            try:
+                return x()
+            except Exception as e:        # clean: the handler DOES something
+                logging.warning("x failed: %s", e)
+                return None
+
+        def narrow(x):
+            try:
+                return x()
+            except ValueError:            # clean: narrow type may be silent
+                pass
+    """)
+    report = _lint(tmp_path, rules=["swallowed-exception"])
+    hits = _rules_hit(report, "swallowed-exception")
+    symbols = {f["symbol"] for f in hits}
+    assert symbols == {"bare", "broad_silent", "broad_tuple"}
+    assert all(f["line"] > 0 for f in hits)
+
+    _write(tmp_path, "mod.py", """
+        def f(x):
+            try:
+                return x()
+            # graft-lint: disable-next=swallowed-exception (fixture: the
+            # teardown path must not crash)
+            except Exception:
+                pass
+    """)
+    report = _lint(tmp_path, rules=["swallowed-exception"])
+    assert report["ok"] and report["counts"]["suppressed"] == 1
+
+
 def test_suppression_forms(tmp_path):
     _write(tmp_path, "mod.py", """
         def hot_path(fn):
@@ -328,7 +388,7 @@ def test_lint_repo_exits_zero():
     assert r.returncode == 0, r.stdout[-3000:]
     rep = json.loads(r.stdout)
     assert rep["ok"] and rep["files_scanned"] > 200
-    assert len(rep["rules"]) == 6
+    assert len(rep["rules"]) == 7
 
 
 def test_lint_catches_seeded_bad_construct(tmp_path):

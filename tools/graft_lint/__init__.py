@@ -1,7 +1,7 @@
 """graft_lint: framework-invariant static analysis for this codebase.
 
-Six checkers over a shared stdlib-``ast`` module graph (no jax import, no
-execution of scanned code), each targeting an invariant the framework
+Seven checkers over a shared stdlib-``ast`` module graph (no jax import,
+no execution of scanned code), each targeting an invariant the framework
 otherwise only defends at runtime:
 
 - ``tracing-hazard``        host-value escapes reachable from jit trace
@@ -12,6 +12,8 @@ otherwise only defends at runtime:
 - ``guarded-by``            lock discipline over declared shared state
 - ``donation-alias``        donated jit buffers re-read after the call
 - ``span-manifest``         RecordEvent names vs. span_manifest.py
+- ``swallowed-exception``   bare ``except:`` / do-nothing broad catches
+                            that defeat transient-vs-fatal classification
 
 Driver: ``python tools/lint.py`` (``--json``, ``--changed``,
 ``--baseline``, ``--write-baseline``). Suppression:
@@ -28,6 +30,7 @@ from typing import Dict, List, Optional
 
 from tools.graft_lint.callgraph import FunctionIndex
 from tools.graft_lint.check_donation import DonationAliasChecker
+from tools.graft_lint.check_excepts import SwallowedExceptionChecker
 from tools.graft_lint.check_hostsync import HostSyncChecker
 from tools.graft_lint.check_locks import GuardedByChecker
 from tools.graft_lint.check_recompile import RecompileHazardChecker
@@ -45,6 +48,7 @@ ALL_CHECKERS = (
     GuardedByChecker,
     DonationAliasChecker,
     SpanManifestChecker,
+    SwallowedExceptionChecker,
 )
 
 

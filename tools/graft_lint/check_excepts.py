@@ -1,0 +1,115 @@
+"""swallowed-exception: bare ``except:`` and silently-dropped broad catches.
+
+The resilience layer's whole premise is that errors are CLASSIFIED —
+transient faults retry with a bounded budget, fatal ones propagate loudly
+(``paddle_tpu.resilience.classify_error``). An ``except:`` or an
+``except Exception: pass`` on a fault path defeats that contract twice
+over: it eats the fatal errors the classifier would have surfaced, and a
+bare ``except:`` additionally traps ``KeyboardInterrupt`` / ``SystemExit``
+so the process can't even be killed cleanly out of the broken state.
+
+Two shapes are flagged:
+
+- a bare ``except:`` handler, unless its body re-raises — catching
+  everything is only defensible to annotate-and-propagate;
+- an ``except Exception`` / ``except BaseException`` handler (alone or in
+  a tuple) whose body does NOTHING: only ``pass``, a constant expression,
+  ``continue`` or ``break``. A broad catch that logs, counts a metric,
+  converts, or falls back is real handling and passes.
+
+Deliberate swallows (interpreter-exit flush paths, best-effort cleanup)
+take a ``# graft-lint: disable=swallowed-exception`` with the reason in
+parens — the review conversation the rule exists to force.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional
+
+from tools.graft_lint.callgraph import FunctionIndex
+from tools.graft_lint.core import Finding, ModuleGraph
+
+RULE = "swallowed-exception"
+
+_BROAD = {"Exception", "BaseException"}
+
+
+def _is_broad(expr: Optional[ast.AST]) -> bool:
+    """``except Exception`` / ``except BaseException``, bare or in a tuple
+    (matched by tail name, so ``builtins.Exception`` counts too)."""
+    if expr is None:
+        return False
+    if isinstance(expr, ast.Tuple):
+        return any(_is_broad(e) for e in expr.elts)
+    if isinstance(expr, ast.Attribute):
+        return expr.attr in _BROAD
+    return isinstance(expr, ast.Name) and expr.id in _BROAD
+
+
+def _reraises(handler: ast.ExceptHandler) -> bool:
+    return any(isinstance(n, ast.Raise) for n in ast.walk(handler))
+
+
+def _is_noop_stmt(stmt: ast.stmt) -> bool:
+    if isinstance(stmt, (ast.Pass, ast.Continue, ast.Break)):
+        return True
+    # docstring-style or `...` statements do not handle anything
+    return isinstance(stmt, ast.Expr) and isinstance(stmt.value, ast.Constant)
+
+
+def _swallows(handler: ast.ExceptHandler) -> bool:
+    return all(_is_noop_stmt(s) for s in handler.body)
+
+
+class _ExceptVisitor(ast.NodeVisitor):
+    def __init__(self, rel: str, findings: List[Finding]):
+        self.rel = rel
+        self.findings = findings
+        self._stack: List[str] = []
+
+    def _symbol(self) -> str:
+        return ".".join(self._stack)
+
+    def visit_ClassDef(self, node: ast.ClassDef):
+        self._stack.append(node.name)
+        self.generic_visit(node)
+        self._stack.pop()
+
+    def visit_FunctionDef(self, node):
+        self._stack.append(node.name)
+        self.generic_visit(node)
+        self._stack.pop()
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def _flag(self, node: ast.ExceptHandler, message: str):
+        self.findings.append(Finding(
+            RULE, self.rel, node.lineno, node.col_offset, message,
+            symbol=self._symbol()))
+
+    def visit_ExceptHandler(self, node: ast.ExceptHandler):
+        if node.type is None and not _reraises(node):
+            self._flag(node,
+                       "bare `except:` traps every error including "
+                       "KeyboardInterrupt/SystemExit — name the exceptions "
+                       "(classify transient vs fatal), re-raise, or "
+                       "suppress with a reason")
+        elif _is_broad(node.type) and _swallows(node):
+            self._flag(node,
+                       "broad `except Exception` whose body does nothing "
+                       "silently swallows fatal errors — handle, narrow "
+                       "the type, re-raise, or suppress with a reason")
+        self.generic_visit(node)
+
+
+class SwallowedExceptionChecker:
+    rule = RULE
+    description = ("bare `except:` handlers and do-nothing broad "
+                   "`except Exception` swallows")
+
+    def run(self, graph: ModuleGraph, index: FunctionIndex) -> List[Finding]:
+        findings: List[Finding] = []
+        for mod in graph.modules:
+            _ExceptVisitor(mod.rel, findings).visit(mod.tree)
+        return findings

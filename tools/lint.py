@@ -1,5 +1,5 @@
 #!/usr/bin/env python
-"""graft_lint driver: one entry point for all six static checkers.
+"""graft_lint driver: one entry point for all seven static checkers.
 
     python tools/lint.py                  # paddle_tpu/ + tools/, exit 0/1
     python tools/lint.py --json           # full machine-readable report

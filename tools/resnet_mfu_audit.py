@@ -181,8 +181,8 @@ def main():
                         mfu = json.loads(line).get("mfu_pct")
                         if mfu is not None:
                             best_mfu = max(best_mfu or 0.0, float(mfu))
-                    except Exception:
-                        pass
+                    except (ValueError, TypeError, AttributeError):
+                        pass  # non-JSON or shapeless line: not a result
             if not emitted:
                 print(json.dumps({
                     "batch": b,

@@ -18,9 +18,18 @@ JSON/.prom exports; ``--observability`` runs the fully-instrumented
 condition (tracing + SLO + live endpoint scraped mid-run) and the
 on-vs-off overhead/token-identity measurement -> BENCH_serving_obs.json.
 
+``--chaos`` runs the resilience suite (seeded fault-rate sweep,
+fault-window recovery with token identity, cancellations, disarmed-inject
+overhead budget) -> BENCH_serving_chaos.json; ``--fault-rate``/
+``--cancel-rate`` run one chaos scenario at those rates. Every mode
+leaves a truthful artifact: a run that dies mid-bench writes the partial
+JSON with ``"completed": false`` plus the error before re-raising.
+
   python tools/serve_bench.py --smoke           # fast CI check, tiny load
   python tools/serve_bench.py --requests 64 --rate 0.7 --tight-pool
   python tools/serve_bench.py --smoke --observability
+  python tools/serve_bench.py --smoke --chaos
+  python tools/serve_bench.py --smoke --fault-rate 0.25 --cancel-rate 0.2
 """
 
 from __future__ import annotations
@@ -244,6 +253,283 @@ def run_prefix_suite(ratios=(0.0, 0.5, 0.9), **kw) -> dict:
         "prefill_tokens_saved_at_top_share":
             baseline["prefill_tokens"] - share[top]["prefill_tokens"],
     }
+
+
+def run_chaos_load(num_requests: int = 12, rate: float = 0.8, seed: int = 0,
+                   max_num_seqs: int = 2, block_size: int = 8,
+                   num_blocks=None, max_seq_len: int = 64,
+                   prompt_lens=(4, 10), new_tokens=(6, 10),
+                   num_layers: int = 1,
+                   fault_rate: float = 0.0, cancel_rate: float = 0.0,
+                   fault_window=None,
+                   fault_sites=("serving.decode_step", "serving.prefill",
+                                "serving.block_alloc"),
+                   deadline_s=None, max_step_faults: int = 3) -> dict:
+    """One synthetic load under seeded chaos; returns the artifact dict.
+
+    ``fault_rate`` arms a seeded ``FaultPlan`` (per-hit probability) on
+    ``fault_sites`` — only inside ``fault_window`` (an iteration range)
+    when given, else for the whole run. ``cancel_rate`` cancels that
+    fraction of requests (seeded choice) a few iterations after arrival.
+    Every request must reach a terminal state (done/cancelled/failed, or
+    rejected at admission) and the KV pool must drain to fully free —
+    both asserted here, so a fault that leaks ever fails the bench."""
+    import hashlib
+    from collections import Counter
+
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu.models import GPTForCausalLM, gpt_tiny
+    from paddle_tpu.resilience import FaultPlan, arm, disarm, get_injector
+    from paddle_tpu.serving import (
+        ContinuousBatchingScheduler,
+        SchedulerConfig,
+        SchedulerOverloaded,
+    )
+
+    paddle.seed(7)
+    model = GPTForCausalLM(gpt_tiny(num_layers=num_layers))
+    cfg = SchedulerConfig(max_num_seqs=max_num_seqs,
+                          max_seq_len=max_seq_len, block_size=block_size,
+                          num_blocks=num_blocks,
+                          max_step_faults=max_step_faults)
+    sched = ContinuousBatchingScheduler(model, cfg)
+
+    rng = np.random.default_rng(seed)
+    gaps = rng.exponential(1.0 / max(rate, 1e-6), num_requests)
+    arrive_at = np.cumsum(gaps)
+    plens = rng.integers(prompt_lens[0], prompt_lens[1] + 1, num_requests)
+    nnew = rng.integers(new_tokens[0], new_tokens[1] + 1, num_requests)
+    prompts = [rng.integers(0, 1000, int(p)) for p in plens]
+    # cancellation schedule from an independent seeded stream so the load
+    # shape (arrivals/lengths) is identical across cancel_rate settings
+    crng = np.random.default_rng(seed + 1)
+    will_cancel = crng.random(num_requests) < cancel_rate
+    cancel_delay = crng.integers(1, 5, num_requests)
+
+    plan = None
+    if fault_rate > 0:
+        plan = FaultPlan(seed=seed)
+        for site in fault_sites:
+            plan.on(site, prob=fault_rate)
+    window = fault_window if fault_window is not None else (0, 10 ** 9)
+
+    tok_box = [0]
+    stream_counts = {}
+
+    def on_token(rid, tok):
+        stream_counts[rid] = stream_counts.get(rid, 0) + 1
+        tok_box[0] += 1
+
+    tokens_per_it = []
+    pending_cancels = []
+    rejected = 0
+    armed = False
+    inj_snap = None
+    t0 = time.perf_counter()
+    it, injected = 0, 0
+    try:
+        while injected < num_requests or sched.has_unfinished():
+            if plan is not None:
+                if not armed and window[0] <= it < window[1]:
+                    arm(plan)
+                    armed = True
+                if armed and it >= window[1]:
+                    inj_snap = get_injector().snapshot()
+                    disarm()
+                    armed = False
+            while injected < num_requests and arrive_at[injected] <= it:
+                i = injected
+                try:
+                    rid = sched.add_request(prompts[i],
+                                            max_new_tokens=int(nnew[i]),
+                                            on_token=on_token,
+                                            deadline_s=deadline_s)
+                    if will_cancel[i]:
+                        pending_cancels.append((it + int(cancel_delay[i]),
+                                                rid))
+                except SchedulerOverloaded:
+                    rejected += 1
+                injected += 1
+            for entry in list(pending_cancels):
+                if entry[0] <= it:
+                    sched.cancel(entry[1])  # idempotent if already done
+                    pending_cancels.remove(entry)
+            tok_box[0] = 0
+            sched.step()
+            tokens_per_it.append(tok_box[0])
+            it += 1
+            if it > 100000:
+                raise RuntimeError("chaos load did not drain")
+    finally:
+        if armed:
+            inj_snap = get_injector().snapshot()
+        disarm()
+    wall = time.perf_counter() - t0
+
+    outs = dict(sched._finished)
+    # no fault may leak a request: terminal state for every admitted one
+    assert len(outs) + rejected == num_requests, (
+        f"{num_requests - rejected - len(outs)} requests leaked")
+    census = Counter(o.finish_reason for o in outs.values())
+    # ...nor a KV block: after drain the pool is fully free again
+    if sched.prefix_cache is not None:
+        sched.prefix_cache.flush()
+    assert sched.allocator.num_free_blocks == cfg.total_blocks, (
+        f"block leak: {sched.allocator.num_free_blocks}/{cfg.total_blocks} "
+        f"free after drain")
+
+    digest = hashlib.sha1()
+    for rid in sorted(outs):
+        digest.update(np.asarray(outs[rid].token_ids, np.int64).tobytes())
+    done = census.get("eos", 0) + census.get("length", 0)
+    return {
+        "bench": "serving_chaos_load",
+        "config": {
+            "num_requests": num_requests, "rate": rate, "seed": seed,
+            "max_num_seqs": max_num_seqs, "block_size": block_size,
+            "num_blocks": cfg.total_blocks, "max_seq_len": max_seq_len,
+            "prompt_lens": list(prompt_lens), "new_tokens": list(new_tokens),
+            "num_layers": num_layers, "fault_rate": fault_rate,
+            "cancel_rate": cancel_rate,
+            "fault_window": list(window) if fault_window else None,
+            "fault_sites": list(fault_sites), "deadline_s": deadline_s,
+            "max_step_faults": max_step_faults,
+        },
+        "iterations": it,
+        "wall_s": round(wall, 3),
+        "census": dict(census),
+        "rejected": rejected,
+        "goodput": round(done / num_requests, 4),
+        "tokens_per_iteration": tokens_per_it,
+        "outputs_sha1": digest.hexdigest(),
+        "fault_injection": inj_snap,
+        "faults_by_site": sched.metrics.faults_snapshot(),
+        "cancelled_by_cause": sched.metrics.cancelled_snapshot(),
+        "health": sched.health(),
+        "metrics": sched.metrics.snapshot(),
+    }
+
+
+def measure_inject_overhead(load_art: dict) -> dict:
+    """Disarmed-injection overhead, attributed against a measured run.
+
+    ``inject()`` unarmed is one global load + one ``is None`` test; its
+    unit cost is measured in a tight loop and multiplied by the number of
+    injection-point crossings the given run actually drove (1 decode-step
+    + ``max_num_seqs`` block-alloc checks per iteration, 2 per prefill) —
+    an upper bound pinned <1% of the run's wall by the chaos suite."""
+    import time as _time
+
+    from paddle_tpu.resilience import get_injector, inject
+
+    assert not get_injector().armed, "overhead must be measured disarmed"
+    N = 200000
+    t0 = _time.perf_counter()
+    for _ in range(N):
+        inject("serving.decode_step")
+    per_call_s = (_time.perf_counter() - t0) / N
+    cfgd = load_art["config"]
+    m = load_art["metrics"]
+    n_calls = (load_art["iterations"] * (1 + cfgd["max_num_seqs"])
+               + m["prefills"] * 2)
+    overhead_pct = 100.0 * per_call_s * n_calls / max(
+        load_art["wall_s"], 1e-9)
+    return {
+        "per_call_ns": round(per_call_s * 1e9, 1),
+        "n_calls": int(n_calls),
+        "overhead_pct": round(overhead_pct, 4),
+        "wall_s": load_art["wall_s"],
+        "within_budget": overhead_pct < 1.0,
+    }
+
+
+def run_chaos_suite(smoke: bool = True, out_dir: str = REPO_ROOT,
+                    fault_rates=(0.0, 0.1, 0.25, 0.4),
+                    cancel_rate: float = 0.25) -> dict:
+    """The BENCH_serving_chaos artifact: goodput under a seeded fault-rate
+    sweep, a fault-window run proving throughput recovery + token identity
+    after transient storms, a cancellation run, and the disarmed-inject
+    overhead budget (<1%). Writes ``BENCH_serving_chaos.json``."""
+    kw = (dict(num_requests=12, rate=0.8, max_num_seqs=2, block_size=8,
+               max_seq_len=64, prompt_lens=(4, 10), new_tokens=(6, 10),
+               num_layers=1)
+          if smoke else
+          dict(num_requests=32, rate=0.6, max_num_seqs=4, block_size=8,
+               max_seq_len=128, prompt_lens=(8, 24), new_tokens=(8, 16),
+               num_layers=2))
+
+    baseline = run_chaos_load(fault_rate=0.0, cancel_rate=0.0, **kw)
+
+    sweep = {}
+    for f in fault_rates:
+        art = baseline if f == 0.0 else run_chaos_load(fault_rate=f, **kw)
+        sweep[str(f)] = {
+            "goodput": art["goodput"],
+            "census": art["census"],
+            "iterations": art["iterations"],
+            "faults_by_site": art["faults_by_site"],
+            "requests_failed": art["metrics"]["requests_failed"],
+        }
+    goodputs = [sweep[str(f)]["goodput"] for f in fault_rates]
+    monotone = all(a >= b - 1e-9 for a, b in zip(goodputs, goodputs[1:]))
+
+    # fault window: transient decode-step faults over a bounded iteration
+    # range; retries must absorb every one (token identity vs the fault-
+    # free run) and per-iteration throughput must recover after the window
+    window = (4, 12) if smoke else (8, 24)
+    windowed = run_chaos_load(fault_rate=0.3, fault_window=window,
+                              fault_sites=("serving.decode_step",),
+                              max_step_faults=6, **kw)
+
+    def busy_median(ts):
+        nz = sorted(t for t in ts if t > 0)
+        return nz[len(nz) // 2] if nz else 0
+
+    post = busy_median(windowed["tokens_per_iteration"][window[1]:])
+    base = busy_median(baseline["tokens_per_iteration"])
+    recovery_gap_pct = 100.0 * abs(post - base) / max(base, 1e-9)
+    token_identical = (windowed["outputs_sha1"]
+                       == baseline["outputs_sha1"])
+
+    cancels = run_chaos_load(fault_rate=0.0, cancel_rate=cancel_rate, **kw)
+    overhead = measure_inject_overhead(baseline)
+
+    artifact = {
+        "bench": "serving_chaos",
+        "config": {**kw, "fault_rates": list(fault_rates),
+                   "cancel_rate": cancel_rate,
+                   "fault_window": list(window), "seed": 0},
+        "goodput_vs_fault_rate": sweep,
+        "goodput_monotone": monotone,
+        "window_recovery": {
+            "window": list(window),
+            "post_window_tokens_per_it": post,
+            "baseline_tokens_per_it": base,
+            "recovery_gap_pct": round(recovery_gap_pct, 2),
+            "recovered_within_5pct": recovery_gap_pct < 5.0,
+            "token_identical_after_faults": token_identical,
+            "faults": windowed["fault_injection"],
+            "iterations": {"chaos": windowed["iterations"],
+                           "baseline": baseline["iterations"]},
+        },
+        "cancellation": {
+            "cancel_rate": cancel_rate,
+            "census": cancels["census"],
+            "cancelled_by_cause": cancels["cancelled_by_cause"],
+            "goodput": cancels["goodput"],
+        },
+        "disarmed_inject": overhead,
+        "within_budget": (monotone and token_identical
+                          and recovery_gap_pct < 5.0
+                          and overhead["within_budget"]),
+        "completed": True,
+    }
+    out_path = os.path.join(out_dir, "BENCH_serving_chaos.json")
+    write_bench_json(out_path, artifact)
+    artifact["artifact"] = out_path
+    return artifact
 
 
 def measure_observability_overhead(**load_kw) -> dict:
@@ -470,6 +756,7 @@ def run_observability_suite(smoke: bool = True, out_dir: str = REPO_ROOT,
         "within_budget": (overhead["token_identical"]
                           and overhead["measured_overhead_pct"] < 5.0),
         "metrics": art["metrics"],
+        "completed": True,
     }
     out_path = os.path.join(out_dir, "BENCH_serving_obs.json")
     write_bench_json(out_path, artifact)
@@ -497,6 +784,16 @@ def main(argv=None) -> dict:
                     help="fully-instrumented run (tracing + SLO + live "
                          "endpoint scrape) + on-vs-off overhead/token-"
                          "identity measurement -> BENCH_serving_obs.json")
+    ap.add_argument("--chaos", action="store_true",
+                    help="resilience suite: seeded fault-rate sweep, "
+                         "fault-window recovery, cancellations, disarmed-"
+                         "inject overhead -> BENCH_serving_chaos.json")
+    ap.add_argument("--fault-rate", type=float, default=0.0,
+                    help="single chaos run: per-hit probability of an "
+                         "injected transient fault at the serving sites")
+    ap.add_argument("--cancel-rate", type=float, default=0.0,
+                    help="single chaos run: fraction of requests cancelled "
+                         "shortly after arrival (seeded choice)")
     ap.add_argument("--out", default=None,
                     help="artifact path (default: BENCH_serving_<mode>.json "
                          "at the repo root)")
@@ -507,9 +804,72 @@ def main(argv=None) -> dict:
     os.environ["JAX_PLATFORMS"] = "cpu"
     os.environ.pop("PALLAS_AXON_POOL_IPS", None)
 
-    if args.observability:
-        out_dir = (os.path.dirname(args.out) or "." if args.out
-                   else REPO_ROOT)
+    chaos = args.chaos or args.fault_rate > 0 or args.cancel_rate > 0
+    mode = ("chaos" if chaos else "obs" if args.observability else
+            "prefix" if args.prefix_share else
+            "smoke" if args.smoke else "load")
+    out_path = args.out or os.path.join(REPO_ROOT,
+                                        f"BENCH_serving_{mode}.json")
+    try:
+        return _run_mode(args, mode, out_path)
+    except BaseException as exc:
+        # a bench that dies mid-run must leave a truthful partial artifact
+        # (completed: false + the error), never a stale or missing one
+        write_bench_json(out_path, {
+            "bench": f"serving_{mode}",
+            "completed": False,
+            "error": f"{type(exc).__name__}: {exc}",
+            "config": dict(vars(args)),
+        })
+        raise
+
+
+def _run_mode(args, mode: str, out_path: str) -> dict:
+    if mode == "chaos":
+        if args.fault_rate > 0 or args.cancel_rate > 0:
+            # single scenario at the requested rates
+            kw = (dict(num_requests=12, rate=0.8, seed=args.seed,
+                       max_num_seqs=2, block_size=8)
+                  if args.smoke else
+                  dict(num_requests=args.requests, rate=args.rate,
+                       seed=args.seed, max_num_seqs=args.max_num_seqs,
+                       block_size=args.block_size))
+            artifact = run_chaos_load(fault_rate=args.fault_rate,
+                                      cancel_rate=args.cancel_rate, **kw)
+            artifact["completed"] = True
+            write_bench_json(out_path, artifact)
+            print(json.dumps({
+                "metric": "serving_chaos_goodput",
+                "value": artifact["goodput"],
+                "unit": "fraction of requests finished ok under chaos",
+                "census": artifact["census"],
+                "rejected": artifact["rejected"],
+                "artifact": out_path,
+            }))
+            return artifact
+        artifact = run_chaos_suite(
+            smoke=args.smoke,
+            out_dir=os.path.dirname(out_path) or ".")
+        rates = artifact["config"]["fault_rates"]
+        print(json.dumps({
+            "metric": "serving_chaos_goodput_min",
+            "value": min(artifact["goodput_vs_fault_rate"][str(r)]
+                         ["goodput"] for r in rates),
+            "unit": f"min goodput over fault rates {rates}",
+            "goodput_monotone": artifact["goodput_monotone"],
+            "recovery_gap_pct":
+                artifact["window_recovery"]["recovery_gap_pct"],
+            "token_identical_after_faults":
+                artifact["window_recovery"]["token_identical_after_faults"],
+            "disarmed_inject_overhead_pct":
+                artifact["disarmed_inject"]["overhead_pct"],
+            "within_budget": artifact["within_budget"],
+            "artifact": artifact["artifact"],
+        }))
+        return artifact
+
+    if mode == "obs":
+        out_dir = os.path.dirname(out_path) or "."
         artifact = run_observability_suite(smoke=args.smoke,
                                            out_dir=out_dir)
         print(json.dumps({
@@ -525,7 +885,7 @@ def main(argv=None) -> dict:
         }))
         return artifact
 
-    if args.prefix_share:
+    if mode == "prefix":
         # prompts must be long enough that prefill is compute-bound (the
         # win is skipped prefill FLOPs); a 192-token prompt vs a ~32-token
         # suffix is a ~64x attention-compute gap even on the CPU smoke
@@ -537,8 +897,7 @@ def main(argv=None) -> dict:
                    max_num_seqs=args.max_num_seqs, block_size=16,
                    max_seq_len=512, num_layers=2, seed=args.seed))
         artifact = run_prefix_suite(**kw)
-        out_path = args.out or os.path.join(REPO_ROOT,
-                                            "BENCH_serving_prefix.json")
+        artifact["completed"] = True
         write_bench_json(out_path, artifact)
         top = str(max(artifact["config"]["ratios"]))
         print(json.dumps({
@@ -565,9 +924,7 @@ def main(argv=None) -> dict:
         kw["num_blocks"] = max(mb, kw["max_num_seqs"] * mb // 2)
 
     artifact = run_load(**kw)
-    mode = "smoke" if args.smoke else "load"
-    out_path = args.out or os.path.join(REPO_ROOT,
-                                        f"BENCH_serving_{mode}.json")
+    artifact["completed"] = True
     stem = out_path[:-5] if out_path.endswith(".json") else out_path
     prom_text = artifact.pop("prometheus_text")
     prom_path = stem + ".prom"
