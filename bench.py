@@ -743,6 +743,51 @@ def bench_serving_chaos(on_tpu):
     }))
 
 
+def bench_serving_async(on_tpu):
+    """Async zero-bubble serving engine: the dispatch-ahead depth sweep
+    (tools/serve_bench.py --depth 0 1 2). Per depth: wall, decode TPOT,
+    and the host-stall share of wall; token streams must be bit-identical
+    across depths with zero steady-state recompiles. Runs in a fresh
+    subprocess because the determinism flags the cross-depth sha oracle
+    needs (single-threaded XLA:CPU) must be set before jax initialises —
+    this process has already imported jax. Artifact:
+    BENCH_serving_async.json."""
+    import subprocess
+    import sys
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    env = dict(os.environ)
+    # serve_bench setdefaults the same flags; hard-set here so a stray
+    # inherited XLA_FLAGS can't break the identity oracle
+    env["XLA_FLAGS"] = ("--xla_cpu_multi_thread_eigen=false "
+                        "intra_op_parallelism_threads=1")
+    cmd = [sys.executable, os.path.join(here, "tools", "serve_bench.py"),
+           "--depth", "0", "1", "2"]
+    if not on_tpu:
+        cmd.append("--smoke")
+    subprocess.run(cmd, cwd=here, env=env, check=True)
+    with open(os.path.join(here, "BENCH_serving_async.json")) as f:
+        art = json.load(f)
+    assert art["completed"], "async sweep died mid-bench"
+    assert art["token_identical_across_depths"], (
+        "token streams diverged across dispatch depths")
+    print(json.dumps({
+        "metric": "serving_async_host_stall_share_cut",
+        "value": art["host_stall_share_cut_x"],
+        "unit": "x reduction of host-stall share of wall, best async "
+                "depth vs depth 0",
+        "vs_baseline": None,  # first round with an async-engine trajectory
+        "tpot_improvement_pct": art["tpot_improvement_pct"],
+        "tpot_ms_by_depth": {d: r["tpot_ms"]
+                             for d, r in art["per_depth"].items()},
+        "stall_share_pct_by_depth": {d: r["host_stall_share_pct"]
+                                     for d, r in art["per_depth"].items()},
+        "token_identical_across_depths":
+            art["token_identical_across_depths"],
+        "within_budget": art["within_budget"],
+    }))
+
+
 def bench_ckpt(on_tpu):
     """Checkpoint lifecycle: sync save throughput, async snapshot stall
     (the train-step pause a background save costs), and cold resume
@@ -936,6 +981,7 @@ for _f in (bench_chip_ceilings, bench_resnet50, bench_bert, bench_ernie,
            bench_serving_prefix,
            bench_observability,
            bench_serving_chaos,
+           bench_serving_async,
            bench_ckpt,
            bench_train,
            bench_lint,

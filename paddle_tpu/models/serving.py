@@ -43,6 +43,24 @@ def _bucket(n: int, lo: int = 16) -> int:
     return b
 
 
+def splice_carry(carry, values, mask):
+    """Patch slots of the device-resident token carry without syncing it.
+
+    ``carry`` is the ``[S]`` int32 ``next_ids`` of the last dispatched step
+    (or a host-built seed); ``values`` is ``[S]`` or a broadcastable ``[1]``
+    (an admission prefill's single sampled token); ``mask`` is ``[S]`` bool,
+    True where ``values`` wins. Used by the dispatch-ahead scheduler to
+    inject a newly admitted request's first token into the decode chain
+    while earlier steps are still in flight.
+
+    This is an eager cached op over fixed shapes (``where`` dispatches one
+    XLA executable per shape/dtype signature and reuses it), so it adds no
+    tracked compiled program and cannot recompile in steady state — the
+    one-compiled-decode-program invariant is untouched at every
+    ``dispatch_depth``."""
+    return paddle.where(mask, values, carry)
+
+
 class SlotStep:
     """The ONE compiled serving step: model chunk (prefill of any bucketed
     width, or a single decode token per slot) + in-graph sampling at each
@@ -53,14 +71,31 @@ class SlotStep:
     one jit program cache, so prefill buckets and the fixed-shape decode step
     each compile once and are reused across requests/admissions. Cache
     buffers are donated — callers must thread caches through and never reuse
-    a cache argument after the call."""
+    a cache argument after the call.
 
-    def __init__(self, model, temperature: float = 0.0, top_k: int = 0):
+    Carry contract (dispatch-ahead decode): ``next_ids`` is a device-
+    resident ``[B]`` int32 array sampled in-graph, so a caller can feed it
+    straight back as the NEXT step's ``ids`` without a host round-trip —
+    reshape it to ``[B, 1]`` first (``paddle.reshape`` allocates a fresh
+    buffer, so the donated decode input never aliases the carry a drain
+    thread still has to read). ``splice_carry`` patches admission tokens
+    into the carry on device.
+
+    ``donate=False`` opts out of arg donation: on TPU donation is a
+    compile-time aliasing hint and composes with async dispatch, but
+    XLA:CPU executes a donated call SYNCHRONOUSLY (the runtime hands the
+    buffer over on the host), which would re-serialize a dispatch-ahead
+    pipeline — the async scheduler trades transient double cache
+    residency for overlap there."""
+
+    def __init__(self, model, temperature: float = 0.0, top_k: int = 0,
+                 donate: bool = True):
         self.model = model
         self.temperature = float(temperature)
         self.top_k = int(top_k)
         self._sf = StaticFunction(self._forward_sample, layer=model,
-                                  donate_args=True, name="serving.SlotStep")
+                                  donate_args=donate,
+                                  name="serving.SlotStep")
 
     def __call__(self, ids, position_ids, caches, gather_idx):
         return self._sf(ids, position_ids, caches, gather_idx)

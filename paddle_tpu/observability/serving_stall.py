@@ -15,7 +15,19 @@ MEASUREMENT first, so that refactor's win is provable rather than asserted.
     * ``streaming``       per-token emit + user ``on_token`` callbacks
     * ``sampling_sync``   blocking ``.numpy()`` reads of sampled tokens —
                           the host<->device serialization the async engine
-                          will overlap
+                          overlaps at ``dispatch_depth > 0``
+    * ``dispatch``        host work building/enqueueing a device step in
+                          the async engine (tensor staging, carry splice,
+                          in-flight bookkeeping) — the residual critical-
+                          path cost once the sync itself is overlapped.
+                          The compiled-step invocation is excluded: it is
+                          compute dispatch, not host scheduling (the same
+                          rule that keeps prefill out of the family)
+
+  The async engine's background drain thread meters its own device wait
+  separately as ``serving_drain_wait_seconds`` (``record("drain", s)``):
+  that wait overlaps in-flight decode, so it is deliberately NOT part of
+  the critical-path stall family or its snapshot total.
 
 - ``FlightRecorder`` — a bounded ring of per-step records (slot occupancy,
   prefill/decode token split, preemptions, cache hits, queue depth, free
@@ -51,9 +63,10 @@ __all__ = [
 ]
 
 STALL_PHASES = ("admission", "radix_match", "block_accounting", "streaming",
-                "sampling_sync")
+                "sampling_sync", "dispatch")
 
 _STALL = "host_stall_seconds"
+_DRAIN = "drain_wait_seconds"
 
 
 class TTFTBreachStorm(UserWarning):
@@ -77,20 +90,34 @@ class ServingStall:
         if registry is None:
             registry = get_registry()
             name = f"serving_{_STALL}"
+            drain = f"serving_{_DRAIN}"
         else:
             # a serving-namespaced registry already prefixes "serving_"
-            name = _STALL if registry.namespace else f"serving_{_STALL}"
+            pre = "" if registry.namespace else "serving_"
+            name = pre + _STALL
+            drain = pre + _DRAIN
         self._family = registry.counter(
             name, "seconds of host-side scheduling work on the serving "
                   "critical path, by phase", unit="s")
         self._phase = {p: self._family.labels(phase=p)
                        for p in STALL_PHASES}
+        # the async engine's drain thread blocks on the device HERE instead
+        # of on the critical path — a separate counter, not a stall phase:
+        # folding it into the family would re-count overlapped device time
+        # as host stall and erase exactly the win the family measures
+        self._drain_wait = registry.counter(
+            drain, "seconds the background drain thread spent blocked on "
+                   "device token fetches (overlapped with in-flight "
+                   "decode — NOT critical-path host stall)", unit="s")
 
     def record(self, phase: str, seconds: float):
+        if phase == "drain":
+            self._drain_wait.inc(max(float(seconds), 0.0))
+            return
         c = self._phase.get(phase)
         if c is None:
             raise KeyError(f"unknown serving stall phase {phase!r} "
-                           f"(known: {STALL_PHASES})")
+                           f"(known: {STALL_PHASES} + 'drain')")
         c.inc(max(float(seconds), 0.0))
 
     @contextmanager
@@ -103,6 +130,12 @@ class ServingStall:
 
     def seconds(self, phase: str) -> float:
         return self._phase[phase].value
+
+    @property
+    def drain_wait_seconds(self) -> float:
+        """Device wait accumulated by the async drain thread (overlapped
+        time — excluded from ``total()``/``snapshot()`` by design)."""
+        return self._drain_wait.value
 
     def total(self) -> float:
         return sum(c.value for c in self._phase.values())

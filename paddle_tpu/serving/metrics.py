@@ -57,6 +57,10 @@ _GAUGES = (
     ("kv_fragmentation", "tail slack inside allocated blocks"),
     ("degradation_level", "shed-ladder rung: 0 ok, 1 flush_cache, "
                           "2 shrink_admission, 3 reject"),
+    ("dispatch_depth", "configured async lookahead: device steps kept in "
+                       "flight before their tokens are synced (0 = "
+                       "synchronous baseline)"),
+    ("in_flight_steps", "dispatched-but-undrained device steps right now"),
 )
 
 
@@ -205,13 +209,16 @@ class ServingMetrics:
 
     # ---- scheduler hooks ----------------------------------------------
     def observe_gauges(self, *, queue_depth: int, running: int, allocator,
-                       live_tokens: int):
+                       live_tokens: int, dispatch_depth: int = 0,
+                       in_flight_steps: int = 0):
         self.queue_depth = queue_depth
         self.running = running
         self.free_blocks = allocator.num_free_blocks
         self.total_blocks = allocator.num_blocks
         self.kv_utilization = allocator.utilization()
         self.kv_fragmentation = allocator.fragmentation(live_tokens)
+        self.dispatch_depth = dispatch_depth
+        self.in_flight_steps = in_flight_steps
 
     def observe_fault(self, site: str, outcome: str = "fired"):
         """Count one fault observation at ``site`` (an injection-site name
@@ -268,6 +275,8 @@ class ServingMetrics:
             "total_blocks": self.total_blocks,
             "kv_utilization": round(self.kv_utilization, 4),
             "kv_fragmentation": round(self.kv_fragmentation, 4),
+            "dispatch_depth": self.dispatch_depth,
+            "in_flight_steps": self.in_flight_steps,
             "tokens_per_s": round(self.tokens_per_s(), 2),
             "ttft_s": self.ttft.summary(),
             "tpot_s": self.tpot.summary(),

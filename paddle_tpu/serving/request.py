@@ -66,6 +66,15 @@ class SchedulerConfig:
     enable_preemption: bool = True
     enable_prefix_caching: bool = False   # radix-tree KV reuse across requests
     prefill_bucket: int = 16          # smallest prefill width bucket
+    # ---- async engine (dispatch-ahead decode). ``dispatch_depth`` N keeps
+    # up to N device steps in flight before their sampled tokens are
+    # synced: 0 is the fully synchronous metered baseline; >= 1 dispatches
+    # step N+1 from the device-resident token carry while a background
+    # drain thread fetches step N's tokens. Retire/EOS, preemption,
+    # cancellation and fault retries are resolved at drain time — outputs
+    # stay bit-identical to depth 0 (pinned in tests), only streaming
+    # callbacks and finish notifications land up to N steps later.
+    dispatch_depth: int = 0
     # ---- observability (request-lifecycle tracing, SLO, flight recorder).
     # Tracing is host-side bookkeeping only: the token stream is identical
     # on vs off (pinned in tests) and the overhead is held <5%.

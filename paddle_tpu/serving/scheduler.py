@@ -33,12 +33,27 @@ Orca-style scheduling on a vLLM-style paged KV pool, TPU-first:
   drives a flush-cache → shrink-admission → reject degradation ladder; a
   step-latency watchdog fires ``StallStorm``; ``health()`` reports
   ``ok|degraded|draining|dead`` truthfully for ``/healthz``.
+- ``dispatch_depth > 0`` turns the loop into an ASYNC engine: decode step
+  N+1 is dispatched from the device-resident token carry before step N's
+  tokens are synced, a background drain thread performs the only
+  remaining D2H readback (one small token fetch per step), and admission
+  / radix matching / block accounting overlap in-flight decode instead of
+  serializing between steps. Host state splits into a COMMITTED view
+  (``_pos``/``_next_tok``, advanced at drain) and a DISPATCHED view
+  (``_disp_pos``/``_disp_emitted``, advanced at dispatch); retire/EOS,
+  preemption, cancellation, degradation and fault retries resolve at
+  drain time with bounded staleness — the token streams stay bit-identical
+  to depth 0 and the ONE compiled decode program never recompiles in
+  steady state at any depth.
 """
 
 from __future__ import annotations
 
+import threading
 import time as _time
-from typing import Dict, List, Optional, Sequence
+import weakref
+from collections import deque
+from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -48,8 +63,12 @@ from paddle_tpu.models.kv_cache import (
     KVPoolExhausted,
     PagedCacheSlot,
 )
-from paddle_tpu.models.serving import SlotStep, _bucket
-from paddle_tpu.observability.annotations import hot_path
+from paddle_tpu.models.serving import SlotStep, _bucket, splice_carry
+from paddle_tpu.observability.annotations import (
+    guarded_by,
+    holds_lock,
+    hot_path,
+)
 from paddle_tpu.observability.request_trace import (
     PHASE_ADMIT,
     PHASE_PREEMPTED,
@@ -90,12 +109,49 @@ from paddle_tpu.serving.request import (
 )
 
 
+class _InFlight:
+    """One dispatched-but-undrained device step: the device-resident
+    sampled ids plus the (slot, request) snapshot they belong to. The
+    drain thread fetches ``next_ids`` off the critical path and commits
+    the tokens against the snapshot (retired slots discard as stale)."""
+
+    __slots__ = ("kind", "next_ids", "slots")
+
+    def __init__(self, kind: str, next_ids, slots):
+        self.kind = kind          # "decode" | "admit"
+        self.next_ids = next_ids  # device int32: [S] (decode) / [1] (admit)
+        self.slots = slots        # [(slot, Request), ...] at dispatch time
+
+
+def _drain_worker(sched_ref):
+    """Background drain loop: fetch the oldest in-flight step's tokens
+    (the device wait lands HERE, overlapped with the next dispatched
+    step) and commit them under the engine lock. Holds only a weak
+    reference between iterations so an abandoned scheduler can be
+    garbage-collected — the thread then exits on its next wakeup."""
+    while True:
+        sched = sched_ref()
+        if sched is None or sched._drain_stop:
+            return
+        entry = sched._next_drainable()
+        if entry is not None:
+            sched._drain_one(entry)
+        del sched, entry
+
+
 class ContinuousBatchingScheduler:
     """Iteration-level scheduler around one causal-LM's compiled slot step.
 
     ``model(input_ids, position_ids, caches)`` must return
     ``(logits, new_caches)`` when caches are given (the GPTForCausalLM /
     LlamaForCausalLM serving contract — same as ``DecodeEngine``)."""
+
+    # shared with the drain thread; every access outside __init__ holds
+    # the engine lock (lexically or via @holds_lock) — pinned by graft_lint
+    _inflight: guarded_by("_elock")
+    _carry: guarded_by("_elock")
+    _done_async: guarded_by("_elock")
+    _drain_exc: guarded_by("_elock")
 
     def __init__(self, model, config: Optional[SchedulerConfig] = None,
                  metrics: Optional[ServingMetrics] = None):
@@ -109,8 +165,22 @@ class ContinuousBatchingScheduler:
         max_pos = getattr(mcfg, "max_position_embeddings", cfg.max_seq_len)
         self.max_seq_len = min(cfg.max_seq_len, max_pos)
         self.metrics = metrics or ServingMetrics()
+        # donation keeps the KV pools single-resident, and on TPU it is a
+        # compile-time aliasing hint that composes with async dispatch —
+        # so the TPU engine donates at every depth. XLA:CPU however
+        # executes donated calls SYNCHRONOUSLY (the runtime hands buffers
+        # over on the host), which would hide the device time inside the
+        # dispatch call and re-serialize a dispatch-ahead pipeline; and
+        # because donation changes the compiled executable (and thus
+        # float rounding on near-tied logits), it must be uniform across
+        # depths for the bit-identical-tokens guarantee to hold. CPU
+        # therefore never donates here: transient double pool residency
+        # bought overlap AND one executable for every dispatch_depth.
+        import jax
+
+        self._donate = jax.default_backend() != "cpu"
         self._step_fn = SlotStep(model, temperature=cfg.temperature,
-                                 top_k=cfg.top_k)
+                                 top_k=cfg.top_k, donate=self._donate)
         if cfg.enable_prefix_caching:
             # sharing-aware pool + radix tree: admissions match cached
             # prefixes and prefill only the uncached suffix
@@ -176,6 +246,26 @@ class ContinuousBatchingScheduler:
         self._draining = False           # start_drain(): finish, admit no new
         self._driver = None              # optional driver thread, for health
         self._step_faults: Dict[str, int] = {}   # site -> count, per step
+        # ---- async engine (dispatch-ahead decode) ----------------------
+        # ``_pos``/``_next_tok`` above are the COMMITTED view (advanced
+        # when a step's tokens drain); ``_disp_pos``/``_disp_emitted`` are
+        # the DISPATCHED view (advanced when a step is enqueued on the
+        # device) — depth 0 keeps them in lockstep. ``_carry`` is the last
+        # dispatched step's device-resident [S] sampled ids, fed straight
+        # back as the next step's input without a host round-trip; a slot
+        # whose full token budget is in flight is FROZEN (excluded from
+        # dispatch, table row masked) so speculation never outruns the
+        # request's validated block budget.
+        self.dispatch_depth = max(0, int(cfg.dispatch_depth))
+        self._disp_pos = np.zeros(S, np.int32)
+        self._disp_emitted = np.zeros(S, np.int32)
+        self._elock = threading.Condition(threading.RLock())
+        self._inflight: deque = deque()          # _InFlight, FIFO
+        self._carry = None
+        self._done_async: List[Request] = []     # retired at drain time
+        self._drain_exc: Optional[BaseException] = None
+        self._drain_thread: Optional[threading.Thread] = None
+        self._drain_stop = False
 
     # ---- admission -----------------------------------------------------
 
@@ -253,12 +343,19 @@ class ContinuousBatchingScheduler:
                        if self._slots[s] is not None))
 
     def _caches(self, table: np.ndarray, pos: np.ndarray):
-        """Fresh per-layer PagedCacheSlots over the shared pools. Table/pos
-        tensors are rebuilt per call (args are donated into the compiled
-        step, and a donated pytree must not repeat a buffer)."""
-        return [PagedCacheSlot(kp, vp, paddle.to_tensor(table),
-                               paddle.to_tensor(pos))
-                for kp, vp in self._pools]
+        """Fresh per-layer PagedCacheSlots over the shared pools. When args
+        are donated into the compiled step the table/pos tensors must be
+        rebuilt per layer (a donated pytree must not repeat a buffer); a
+        non-donating step shares ONE tensor across layers — 2 host->device
+        transfers per decode step instead of 2*num_layers, which matters on
+        the dispatch-ahead hot path where staging is the critical-path
+        cost."""
+        if self._donate:
+            return [PagedCacheSlot(kp, vp, paddle.to_tensor(table),
+                                   paddle.to_tensor(pos))
+                    for kp, vp in self._pools]
+        t, p = paddle.to_tensor(table), paddle.to_tensor(pos)
+        return [PagedCacheSlot(kp, vp, t, p) for kp, vp in self._pools]
 
     def _store_pools(self, caches):
         self._pools = [(c.k_pool, c.v_pool) for c in caches]
@@ -299,6 +396,8 @@ class ContinuousBatchingScheduler:
         self._table[slot] = -1
         self._pos[slot] = 0
         self._next_tok[slot] = 0
+        self._disp_pos[slot] = 0
+        self._disp_emitted[slot] = 0
         trace = self.tracer.get(req.request_id)
         if trace is not None:
             trace.note(finish_reason=reason,
@@ -340,19 +439,29 @@ class ContinuousBatchingScheduler:
         untouched (per-slot decode rows are independent). Already-terminal
         requests return their stored output (idempotent). The returned
         ``RequestOutput`` carries the tokens generated so far with
-        ``finish_reason`` ``cancelled|deadline|queue_ttl``."""
+        ``finish_reason`` ``cancelled|deadline|queue_ttl``.
+
+        At ``dispatch_depth > 0`` the in-flight pipeline drains first:
+        tokens already dispatched commit before the cancel point, so a
+        cancel between ``step()`` calls lands on exactly the state the
+        synchronous engine would have — and a request that finishes
+        naturally during the drain returns its stored output (idempotent)
+        instead of being cancelled."""
         reason = "cancelled" if cause == "user" else cause
-        if request_id in self._finished:
-            return self._finished[request_id]
-        queued = self.queue.remove(request_id)
-        if queued is not None:
-            self.metrics.observe_cancel(cause)
-            return self._finalize_off_grid(queued, reason).output()
-        for s, req in enumerate(self._slots):
-            if req is not None and req.request_id == request_id:
+        with self._elock:
+            if self._inflight:
+                self._drain_all()
+            if request_id in self._finished:
+                return self._finished[request_id]
+            queued = self.queue.remove(request_id)
+            if queued is not None:
                 self.metrics.observe_cancel(cause)
-                return self._retire(s, reason).output()
-        raise KeyError(f"unknown request_id {request_id}")
+                return self._finalize_off_grid(queued, reason).output()
+            for s, req in enumerate(self._slots):
+                if req is not None and req.request_id == request_id:
+                    self.metrics.observe_cancel(cause)
+                    return self._retire(s, reason).output()
+            raise KeyError(f"unknown request_id {request_id}")
 
     def start_drain(self):
         """Stop admitting new requests (``SchedulerOverloaded``); everything
@@ -425,6 +534,8 @@ class ContinuousBatchingScheduler:
             self._table[slot] = -1
             self._pos[slot] = 0
             self._next_tok[slot] = 0
+            self._disp_pos[slot] = 0
+            self._disp_emitted[slot] = 0
             # force=True: an evicted request must never be REJECTED by its
             # own admission control — it was already admitted once
             self.queue.push(req, force=True)
@@ -436,22 +547,35 @@ class ContinuousBatchingScheduler:
                         generated_tokens=req.num_generated)
 
     @hot_path(reason="runs per decode iteration under block_accounting")
+    @holds_lock("_elock")
     def _ensure_decode_capacity(self, slot: int) -> bool:
-        """Guarantee the slot can write one more token; preempt other
-        sequences (or finally the slot itself) when the pool is dry.
+        """Guarantee the slot can write one more token (at its DISPATCHED
+        position — capacity must cover in-flight speculation); preempt
+        other sequences (or finally the slot itself) when the pool is dry.
         False = the slot itself was evicted."""
         req = self._slots[slot]
         while True:
+            if req is None or self._slots[slot] is not req:
+                return False             # drained away mid-assurance
             try:
                 before = len(req.blocks)
                 # extend() is idempotent for a given pos, so a fault here
                 # (absorbed by the decode retry loop) re-runs cleanly
                 inject("serving.block_alloc")
-                self.allocator.extend(req.blocks, int(self._pos[slot]), 1)
+                self.allocator.extend(req.blocks,
+                                      int(self._disp_pos[slot]), 1)
                 for j in range(before, len(req.blocks)):
                     self._table[slot, j] = req.blocks[j]
                 return True
             except KVPoolExhausted:
+                if self._inflight:
+                    # async engine: committing the in-flight steps may
+                    # retire slots and free blocks — drain and retry
+                    # before evicting a live victim (preemption must act
+                    # on committed state only)
+                    self._drain_all()
+                    req = self._slots[slot]
+                    continue
                 if not self.config.enable_preemption:
                     raise
                 victim = self._preempt_victim(exclude_slot=slot)
@@ -480,7 +604,9 @@ class ContinuousBatchingScheduler:
         (everything else: queue pop, request setup, packing, retire
         bookkeeping). Prefill device dispatch is excluded — it is compute,
         not host scheduling; it shows up as the request's ``prefill``
-        sub-span instead."""
+        sub-span instead. At ``dispatch_depth > 0`` the first-token sync
+        is replaced by ``dispatch`` (carry splice + enqueue) and the token
+        commits on the drain thread."""
         finished = []
         bs = self.config.block_size
         pc = _time.perf_counter
@@ -579,10 +705,16 @@ class ContinuousBatchingScheduler:
             try:
                 inject("serving.prefill")
                 with RecordEvent("serving.prefill"), paddle.no_grad():
-                    caches = [PagedCacheSlot(
-                        kp, vp, paddle.to_tensor(row),
-                        paddle.to_tensor(np.array([matched], np.int32)))
-                        for kp, vp in self._pools]
+                    if self._donate:
+                        caches = [PagedCacheSlot(
+                            kp, vp, paddle.to_tensor(row),
+                            paddle.to_tensor(np.array([matched], np.int32)))
+                            for kp, vp in self._pools]
+                    else:
+                        rt = paddle.to_tensor(row)
+                        mt = paddle.to_tensor(np.array([matched], np.int32))
+                        caches = [PagedCacheSlot(kp, vp, rt, mt)
+                                  for kp, vp in self._pools]
                     next_ids, caches = self._step_fn(
                         paddle.to_tensor(ids_np),
                         paddle.to_tensor(np.arange(matched, matched + Pb,
@@ -615,38 +747,54 @@ class ContinuousBatchingScheduler:
                                     consecutive=req.consecutive_faults)
                 continue
             prefill_s = pc() - t0
-            t0 = pc()
-            # the ONE deliberate admission sync: the first sampled token
-            # decides eos/packing. Timed manually (sync_s also feeds the
-            # trace subspan) and recorded as sampling_sync below.
-            # graft-lint: disable-next=host-sync-in-hot-loop (metered)
-            tok = int(np.asarray(next_ids.numpy())[0])
-            sync_s = pc() - t0
             self.metrics.prefills += 1
             self.metrics.prefill_tokens += S
             if self.prefix_cache is not None:
                 self.prefix_cache.record_admission(matched, S)
-            # pack into the grid
+            # pack into the grid: the slot is live the moment its prefill
+            # is in flight (committed token lands at sync/drain below)
             self._slots[slot] = req
             self._table[slot] = row[0]
             self._pos[slot] = P
-            self._next_tok[slot] = tok
+            self._disp_pos[slot] = P
+            self._disp_emitted[slot] = req.num_generated + 1
+            self._next_tok[slot] = 0
             req.consecutive_faults = 0   # clean admission resets the budget
             if trace is not None:
                 trace.note(cached_tokens=matched, prefilled_tokens=S)
                 trace.subspan("prefix_match", radix_s)
                 trace.subspan("prefill", prefill_s)
-                trace.subspan("sampling_sync", sync_s)
                 trace.transition(PHASE_RUNNING)
-            t0 = pc()
-            req.emit(tok)
-            stream_s = pc() - t0
-            self._events.append((req.request_id, tok))
-            self.metrics.generated_tokens += 1
-            if req.eos_token_id is not None and tok == req.eos_token_id:
-                finished.append(self._retire(slot, "eos"))
-            elif req.num_generated >= req.max_new_tokens:
-                finished.append(self._retire(slot, "length"))
+            dispatch_s = 0.0
+            if self.dispatch_depth:
+                # dispatch-ahead: splice the on-device first token into
+                # the decode carry and let the drain thread fetch it —
+                # emit/EOS/length land at commit time (bounded staleness)
+                t0 = pc()
+                self._splice_admit(slot, next_ids)
+                self._enqueue(_InFlight("admit", next_ids, [(slot, req)]))
+                dispatch_s = pc() - t0
+                self.stall.record("dispatch", dispatch_s)
+                if trace is not None:
+                    trace.subspan("dispatch", dispatch_s)
+            else:
+                # the ONE deliberate admission sync: the first sampled
+                # token decides eos/packing — drained through the same
+                # metered helper as the batch decode path
+                arr, sync_s = self._fetch_tokens(next_ids)
+                if trace is not None:
+                    trace.subspan("sampling_sync", sync_s)
+                tok = int(arr[0])
+                self._next_tok[slot] = tok
+                t0 = pc()
+                req.emit(tok)
+                stream_s = pc() - t0
+                self._events.append((req.request_id, tok))
+                self.metrics.generated_tokens += 1
+                if req.eos_token_id is not None and tok == req.eos_token_id:
+                    finished.append(self._retire(slot, "eos"))
+                elif req.num_generated >= req.max_new_tokens:
+                    finished.append(self._retire(slot, "length"))
             # attribute this admission's host time (device prefill excluded)
             self.stall.record("radix_match", radix_s)
             self.stall.record("block_accounting", block_s)
@@ -655,7 +803,7 @@ class ContinuousBatchingScheduler:
             self.stall.record(
                 "admission",
                 (pc() - it_t0) - radix_s - block_s - sync_s - stream_s
-                - prefill_s)
+                - prefill_s - dispatch_s)
         return finished
 
     def _absorb_step_fault(self, exc: BaseException, running: List[int],
@@ -685,61 +833,263 @@ class ContinuousBatchingScheduler:
         return failed
 
     @hot_path(reason="the decode-loop iteration itself")
+    @holds_lock("_elock")
     def _decode_once(self) -> List[Request]:
-        """One fixed-shape decode iteration over every running slot.
+        """One SYNCHRONOUS fixed-shape decode iteration (depth 0): every
+        running slot dispatches, the sampled tokens are fetched inline
+        through the shared metered drain helper, and the step commits
+        immediately.
 
         Stall attribution: the capacity loop (block extends + preemption
         table rewrites) is ``block_accounting``, the blocking token read is
         ``sampling_sync``, per-token emit/callbacks are ``streaming`` — the
-        exact host seams the async-engine refactor (ROADMAP 4) overlaps.
+        exact host seams ``dispatch_depth > 0`` overlaps.
 
         Fault contract: everything up to and including the blocking token
         read sits inside the retry envelope. The injection point fires
         BEFORE the dispatch consumes (donates) the pools, and the capacity
         extend is idempotent per position — so a retried step replays
         against identical state and surviving sequences stay
-        token-identical to a fault-free run."""
-        S = self.config.max_num_seqs
-        pc = _time.perf_counter
+        token-identical to a fault-free run. A fault AFTER dispatch rolls
+        the dispatched view back so the replay targets identical
+        positions."""
         finished: List[Request] = []
         attempt = 0
         while True:
-            running = [s for s in range(S) if self._slots[s] is not None]
-            if not running:
+            pairs = self._live_pairs()
+            if not pairs:
                 return finished
+            dispatched = False
             try:
                 with self.stall.timed("block_accounting"):
-                    for s in running:
-                        if self._slots[s] is None:
+                    for s, req in pairs:
+                        if self._slots[s] is not req:
                             continue         # evicted by an earlier slot
                         self._ensure_decode_capacity(s)
                     # capacity assurance may have preempted ANY slot
-                    running = [s for s in running
-                               if self._slots[s] is not None]
-                if not running:
+                    pairs = self._live_pairs()
+                if not pairs:
                     return finished
-                inject("serving.decode_step")
-                with RecordEvent("serving.decode_step"), paddle.no_grad():
-                    tok = self._next_tok.reshape(S, 1).astype(np.int32)
-                    pos = self._pos.reshape(S, 1).astype(np.int32)
-                    caches = self._caches(self._table, self._pos)
-                    next_ids, caches = self._step_fn(
-                        paddle.to_tensor(tok), paddle.to_tensor(pos), caches,
-                        paddle.to_tensor(np.zeros(S, np.int32)))
-                    self._store_pools(caches)
-                with self.stall.timed("sampling_sync"):
-                    step_np = np.asarray(next_ids.numpy())
+                next_ids, _disp_s = self._dispatch_decode(pairs)
+                dispatched = True
+                arr, _sync_s = self._fetch_tokens(next_ids)
             except Exception as exc:
-                finished += self._absorb_step_fault(exc, running, attempt)
+                if dispatched:
+                    # tokens were lost after the dispatch advanced the
+                    # dispatched view: roll it back so the retry replays
+                    # the identical step
+                    for s, _r in pairs:
+                        self._disp_pos[s] -= 1
+                        self._disp_emitted[s] -= 1
+                    self._carry = None
+                finished += self._absorb_step_fault(
+                    exc, [s for s, _r in pairs], attempt)
                 attempt += 1
                 continue
             break
         self.metrics.decode_steps += 1
+        finished += self._commit_decode(pairs, arr, metered=True)
+        return finished
+
+    # ---- async engine (dispatch-ahead decode) --------------------------
+
+    def _live_pairs(self) -> List[Tuple[int, Request]]:
+        """Slots eligible for the next decode dispatch: occupied AND not
+        frozen (a frozen slot already has its full ``max_new_tokens``
+        budget in flight — dispatching more would write past the block
+        budget the request was admitted with)."""
+        return [(s, r) for s, r in enumerate(self._slots)
+                if r is not None
+                and int(self._disp_emitted[s]) < r.max_new_tokens]
+
+    def _disp_table(self) -> np.ndarray:
+        """Block table for the next dispatch: frozen slots get a masked
+        (-1) row — the paged write kernel drops -1-table writes, so their
+        speculative K/V is discarded instead of overrunning the row."""
+        frozen = [s for s, r in enumerate(self._slots)
+                  if r is not None
+                  and int(self._disp_emitted[s]) >= r.max_new_tokens]
+        if not frozen:
+            return self._table
+        tbl = self._table.copy()
+        tbl[frozen] = -1
+        return tbl
+
+    @holds_lock("_elock")
+    def _decode_ids(self):
+        """Token ids [S, 1] for the next decode dispatch: the device-
+        resident carry when one exists (no host round-trip), else the
+        committed host tokens. ``paddle.reshape`` allocates a fresh
+        buffer, so donating the result never invalidates the carry the
+        drain thread still has to read."""
+        S = self.config.max_num_seqs
+        if self._carry is not None:
+            return paddle.reshape(self._carry, [S, 1])
+        return paddle.to_tensor(self._next_tok.reshape(S, 1)
+                                .astype(np.int32))
+
+    @hot_path(reason="stages one decode step on device without syncing it")
+    @holds_lock("_elock")
+    def _dispatch_decode(self, pairs):
+        """Dispatch ONE fixed-shape decode step over the slot grid;
+        returns ``(next_ids, host_s)`` — the device-resident sampled ids
+        and the host-scheduling seconds spent around the compiled call
+        (staging, table masking, carry/bookkeeping). The compiled-step
+        invocation itself is excluded from ``host_s``: it is compute
+        dispatch, not host scheduling — the same rule that keeps prefill
+        out of the stall family. The dispatched view advances only after
+        the dispatch succeeds (a faulted dispatch retries against
+        identical state), and the injection point fires before the pools
+        are donated — replay is token-identical."""
+        S = self.config.max_num_seqs
+        pc = _time.perf_counter
+        t0 = pc()
+        inject("serving.decode_step")
+        with RecordEvent("serving.decode_step"), paddle.no_grad():
+            ids = self._decode_ids()
+            pos = self._disp_pos.reshape(S, 1).astype(np.int32)
+            # fresh copy: _disp_pos is mutated in place right below, and a
+            # long-lived host buffer crossing the jax boundary while a
+            # dispatched-but-unexecuted step still refers to it is exactly
+            # the stale-transfer hazard async dispatch exposes
+            caches = self._caches(self._disp_table(), self._disp_pos.copy())
+            t_call = pc()
+            next_ids, caches = self._step_fn(
+                ids, paddle.to_tensor(pos), caches,
+                paddle.to_tensor(np.zeros(S, np.int32)))
+            call_s = pc() - t_call
+            self._store_pools(caches)
+        for s, _req in pairs:
+            self._disp_pos[s] += 1
+            self._disp_emitted[s] += 1
+        if self.dispatch_depth:
+            self._carry = next_ids
+        return next_ids, (pc() - t0) - call_s
+
+    @hot_path(reason="the engine's only blocking D2H read — every sampled-"
+                     "token fetch (admission, batch decode, drain thread) "
+                     "funnels through this one metered helper")
+    def _fetch_tokens(self, next_ids, phase: str = "sampling_sync"):
+        """THE single metered token-readback site (the two pre-async call
+        sites — admission first-token and batch decode — plus the drain
+        thread all land here, so stall accounting cannot diverge between
+        paths). ``phase="sampling_sync"`` meters critical-path stall;
+        ``phase="drain"`` routes to the overlapped drain-wait counter.
+        Returns ``(tokens_np, seconds_blocked)``."""
+        t0 = _time.perf_counter()
+        with self.stall.timed(phase):
+            arr = np.asarray(next_ids.numpy())
+        return arr, _time.perf_counter() - t0
+
+    @holds_lock("_elock")
+    def _splice_admit(self, slot: int, next_ids):
+        """Patch an admission prefill's on-device first token into the
+        decode carry so the next dispatched step consumes it without a
+        host round-trip (seeding the carry from committed host tokens if
+        no step is in flight yet)."""
+        S = self.config.max_num_seqs
+        if self._carry is None:
+            self._carry = paddle.to_tensor(self._next_tok.astype(np.int32))
+        mask = np.zeros(S, bool)
+        mask[slot] = True
+        self._carry = splice_carry(self._carry, next_ids,
+                                   paddle.to_tensor(mask))
+
+    @holds_lock("_elock")
+    def _enqueue(self, entry: _InFlight):
+        self._inflight.append(entry)
+        self._elock.notify_all()
+        self._ensure_drain_thread()
+
+    def _ensure_drain_thread(self):
+        t = self._drain_thread
+        if t is not None and t.is_alive():
+            return
+        t = threading.Thread(target=_drain_worker,
+                             args=(weakref.ref(self),),
+                             name="serving-drain", daemon=True)
+        self._drain_thread = t
+        t.start()
+
+    def _next_drainable(self, timeout: float = 0.05):
+        """(drain thread) the oldest in-flight entry, or None after a
+        bounded wait — the worker re-checks scheduler liveness between
+        waits so it can exit when the scheduler is dropped."""
+        with self._elock:
+            if not self._inflight:
+                self._elock.wait(timeout)
+            return self._inflight[0] if self._inflight else None
+
+    @hot_path(reason="drain-thread commit: fetch off the critical path, "
+                     "then host bookkeeping under the engine lock")
+    def _drain_one(self, entry: _InFlight):
+        """(drain thread) fetch one in-flight step's tokens — the device
+        wait overlaps whatever the scheduler thread is doing — then commit
+        them under the engine lock. A fetch/commit failure poisons the
+        pipeline (``_drain_exc``) and surfaces on the scheduler thread at
+        its next barrier."""
+        try:
+            arr, _ = self._fetch_tokens(entry.next_ids, phase="drain")
+            exc: Optional[BaseException] = None
+        except BaseException as e:        # noqa: BLE001 — must not die silently
+            arr, exc = None, e
+        with self._elock:
+            try:
+                if exc is None:
+                    self._done_async += self._commit_entry(entry, arr)
+                else:
+                    self._drain_exc = exc
+            except BaseException as e:    # noqa: BLE001
+                self._drain_exc = e
+            finally:
+                if self._inflight and self._inflight[0] is entry:
+                    self._inflight.popleft()
+                self._elock.notify_all()
+
+    @holds_lock("_elock")
+    def _commit_entry(self, entry: _InFlight, arr) -> List[Request]:
+        if entry.kind == "admit":
+            slot, req = entry.slots[0]
+            return self._commit_admit_token(slot, req, int(arr[0]))
+        self.metrics.decode_steps += 1
+        return self._commit_decode(entry.slots, arr, metered=False)
+
+    @holds_lock("_elock")
+    def _commit_admit_token(self, slot: int, req: Request,
+                            tok: int) -> List[Request]:
+        """Commit an admission's drained first token (depth > 0): emit,
+        stamp, and retire on EOS/length — exactly what the synchronous
+        path does inline."""
+        done: List[Request] = []
+        if self._slots[slot] is not req or req.done:
+            return done                  # retired while in flight: stale
+        self._next_tok[slot] = tok
+        req.emit(tok)
+        self._events.append((req.request_id, tok))
+        self.metrics.generated_tokens += 1
+        if req.eos_token_id is not None and tok == req.eos_token_id:
+            done.append(self._retire(slot, "eos"))
+        elif req.num_generated >= req.max_new_tokens:
+            done.append(self._retire(slot, "length"))
+        return done
+
+    @holds_lock("_elock")
+    def _commit_decode(self, pairs, step_np, metered: bool) -> List[Request]:
+        """Commit one decode step's tokens: advance the COMMITTED view,
+        emit, retire EOS/length. Tokens for a slot whose request was
+        retired (or replaced) after this step was dispatched are stale
+        speculation and are discarded — that identity check IS the
+        bounded-staleness contract. ``metered`` folds emit time into the
+        critical-path ``streaming`` stall (inline depth-0 commits only;
+        drain-thread commits overlap decode and must not count)."""
+        pc = _time.perf_counter
         stream_s = 0.0
-        for s in running:
-            req = self._slots[s]
-            req.consecutive_faults = 0       # a clean step resets budgets
-            self._pos[s] += 1                # fed token is now cached
+        done: List[Request] = []
+        for s, req in pairs:
+            if self._slots[s] is not req or req.done:
+                continue                 # retired/cancelled in flight
+            req.consecutive_faults = 0   # a clean step resets budgets
+            self._pos[s] += 1            # fed token is now cached
             t = int(step_np[s])
             self._next_tok[s] = t
             t0 = pc()
@@ -748,24 +1098,138 @@ class ContinuousBatchingScheduler:
             self._events.append((req.request_id, t))
             self.metrics.generated_tokens += 1
             if req.eos_token_id is not None and t == req.eos_token_id:
-                finished.append(self._retire(s, "eos"))
+                done.append(self._retire(s, "eos"))
             elif req.num_generated >= req.max_new_tokens:
-                finished.append(self._retire(s, "length"))
-        self.stall.record("streaming", stream_s)
-        return finished
+                done.append(self._retire(s, "length"))
+        if metered:
+            self.stall.record("streaming", stream_s)
+        return done
+
+    @holds_lock("_elock")
+    def _raise_drain_exc(self):
+        """Surface a drain-thread failure on the scheduler thread."""
+        if self._drain_exc is not None:
+            exc = self._drain_exc
+            self._drain_exc = None
+            raise exc
+
+    @holds_lock("_elock")
+    def _drain_all(self):
+        """Barrier: wait until every in-flight step has committed, then
+        drop the device carry so the next dispatch rebuilds its inputs
+        from committed host state. Runs before any action that must see
+        (or mutate) committed-only state: preemption, cancellation and
+        deadline sweeps, fault absorption, weight reload, shutdown."""
+        while self._inflight and self._drain_exc is None:
+            self._ensure_drain_thread()
+            self._elock.wait(0.2)
+        self._carry = None
+        self._raise_drain_exc()
+
+    @holds_lock("_elock")
+    def _backpressure(self):
+        """Bound the lookahead to ``dispatch_depth`` undrained steps.
+        Together with the one-decode-dispatch-per-``step()`` cadence this
+        is what makes a cancel between steps token-identical to depth 0:
+        after k calls exactly k decode steps have been dispatched, and the
+        cancel barrier commits all of them first."""
+        while (len(self._inflight) > self.dispatch_depth
+               and self._drain_exc is None):
+            self._ensure_drain_thread()
+            self._elock.wait(0.2)
+        self._raise_drain_exc()
+
+    @hot_path(reason="the async decode iteration: dispatch, never sync")
+    @holds_lock("_elock")
+    def _decode_dispatch_once(self) -> bool:
+        """(depth > 0) dispatch one decode step over the live slots and
+        enqueue it for the drain thread; never blocks on tokens. A
+        dispatch fault drains the pipeline first (committing the clean
+        in-flight steps and resetting fault budgets), charges budgets,
+        and retries from committed host state — token-identical replay,
+        the same contract as the synchronous envelope. Returns False when
+        there was nothing to dispatch."""
+        attempt = 0
+        while True:
+            pairs = self._live_pairs()
+            if not pairs:
+                return False
+            try:
+                with self.stall.timed("block_accounting"):
+                    for s, req in pairs:
+                        if self._slots[s] is not req:
+                            continue     # evicted/drained by earlier slot
+                        self._ensure_decode_capacity(s)
+                    pairs = self._live_pairs()
+                if not pairs:
+                    return False
+                next_ids, disp_s = self._dispatch_decode(pairs)
+            except Exception as exc:
+                self._drain_all()
+                self._done_async += self._absorb_step_fault(
+                    exc, [s for s, _r in pairs], attempt)
+                attempt += 1
+                continue
+            t0 = _time.perf_counter()
+            self._enqueue(_InFlight("decode", next_ids, pairs))
+            self.stall.record(
+                "dispatch", disp_s + (_time.perf_counter() - t0))
+            return True
+
+    @holds_lock("_elock")
+    def _collect_async_done(self) -> List[Request]:
+        done, self._done_async = self._done_async, []
+        return done
+
+    def shutdown(self) -> Dict[str, int]:
+        """Quiesce the engine — the crash-path contract the bench's
+        partial-artifact writer relies on: drain every in-flight step (no
+        orphaned device work), stop the drain thread, then cancel
+        everything still queued or running so every KV block returns to
+        the pool. Idempotent; returns drain/cancel counts."""
+        with self._elock:
+            drained = len(self._inflight)
+            try:
+                self._drain_all()
+            except BaseException:        # noqa: BLE001
+                # a poisoned pipeline must still not leak: entries hold
+                # only device token arrays, dropping them frees nothing
+                # block-shaped — the cancels below release the KV
+                self._inflight.clear()
+                self._carry = None
+            self._drain_stop = True
+            self._elock.notify_all()
+        cancelled = 0
+        for req in list(self.queue._items):
+            self.cancel(req.request_id, cause="user")
+            cancelled += 1
+        for s in range(len(self._slots)):
+            if self._slots[s] is not None:
+                self.cancel(self._slots[s].request_id, cause="user")
+                cancelled += 1
+        return {"drained_in_flight": drained, "cancelled": cancelled}
 
     # ---- public loop ---------------------------------------------------
 
     def has_unfinished(self) -> bool:
-        return bool(len(self.queue)) or any(
-            r is not None for r in self._slots)
+        with self._elock:
+            return (bool(len(self.queue))
+                    or any(r is not None for r in self._slots)
+                    or bool(self._inflight))
 
     @hot_path(reason="one scheduler iteration: admit + decode")
     def step(self) -> List[RequestOutput]:
         """One scheduler iteration: admit into free slots (prefill), then
         one decode step; returns outputs finishing this iteration. Each
         iteration also lands one flight-recorder record (occupancy, token
-        split, preemptions, cache activity) and feeds the alarm monitors."""
+        split, preemptions, cache activity) and feeds the alarm monitors.
+
+        At ``dispatch_depth > 0`` the decode step is DISPATCHED, not
+        synced: the iteration ends at the backpressure gate (≤ depth
+        undrained steps) and outputs whose final token drained this
+        iteration are collected from the drain thread — a request can
+        finish up to ``depth`` iterations after its last token was
+        dispatched, never later than the next barrier."""
         was_training = self.model.training
         self.model.eval()
         t0 = _time.perf_counter()
@@ -779,19 +1243,45 @@ class ContinuousBatchingScheduler:
         done = self._sweep_expired()
         level = self._apply_degradation()
         try:
-            done += self._admit()
-            done += self._decode_once()
+            with self._elock:
+                if self.dispatch_depth == 0:
+                    done += self._admit()
+                    done += self._decode_once()
+                else:
+                    self._raise_drain_exc()
+                    done += self._admit()
+                    if not self._decode_dispatch_once() and self._inflight:
+                        # nothing dispatchable but steps still in flight
+                        # (workload tail / every slot at its budget):
+                        # drain so retires land and run() converges
+                        self._drain_all()
+                    else:
+                        self._backpressure()
+                done += self._collect_async_done()
         finally:
             if was_training:
                 self.model.train()
+        # a request can retire twice in one iteration's view (e.g. its
+        # final token drained during a sweep's cancel barrier AND was
+        # collected from the drain thread) — report each once
+        outs: List[RequestOutput] = []
+        seen = set()
+        for r in done:
+            if r.request_id not in seen:
+                seen.add(r.request_id)
+                outs.append(r.output())
         step_s = _time.perf_counter() - t0
         self.metrics.step_time.record(step_s)
         if self._watchdog is not None:
             self._watchdog.observe(step_s)
+        with self._elock:
+            in_flight = len(self._inflight)
         self.metrics.observe_gauges(
             queue_depth=len(self.queue),
             running=sum(r is not None for r in self._slots),
-            allocator=self.allocator, live_tokens=self._live_tokens())
+            allocator=self.allocator, live_tokens=self._live_tokens(),
+            dispatch_depth=self.dispatch_depth,
+            in_flight_steps=in_flight)
         record = dict(
             running=sum(r is not None for r in self._slots),
             queue_depth=len(self.queue),
@@ -803,7 +1293,12 @@ class ContinuousBatchingScheduler:
                                if self.prefix_cache is not None else 0)
                               - pre_hit),
             evicted_blocks=self._step_evicted,
-            finished=len(done))
+            finished=len(outs))
+        # engine fields land in the flight ring ONLY at depth > 0 —
+        # synchronous-baseline dumps stay byte-stable
+        if self.dispatch_depth:
+            record["dispatch_depth"] = self.dispatch_depth
+            record["in_flight_steps"] = in_flight
         # armed/fired injection state and shed level land in the flight
         # ring ONLY when active — fault-free dumps stay byte-stable
         inj = get_injector()
@@ -817,7 +1312,7 @@ class ContinuousBatchingScheduler:
         self.flight.record_step(**record)
         if self.prefix_cache is not None:
             self._alarms.observe_evictions(self._step_evicted)
-        return [r.output() for r in done]
+        return outs
 
     def _pool_pressure(self) -> float:
         """Pool pressure for the shed ladder: allocated blocks MINUS the
@@ -940,8 +1435,16 @@ class ContinuousBatchingScheduler:
         rows = [_row(req, "RUNNING", s)
                 for s, req in enumerate(self._slots) if req is not None]
         rows += [_row(req, req.state.name, -1) for req in self.queue._items]
+        with self._elock:
+            engine = {
+                "dispatch_depth": self.dispatch_depth,
+                "in_flight_steps": len(self._inflight),
+                "drain_wait_seconds": round(
+                    self.stall.drain_wait_seconds, 6),
+            }
         return {
             "requests": rows,
+            "engine": engine,
             "queue_depth": len(self.queue),
             "running": sum(r is not None for r in self._slots),
             "stall_seconds": self.stall.snapshot(),
@@ -996,6 +1499,11 @@ class ContinuousBatchingScheduler:
         from paddle_tpu.checkpoint import CheckpointManager
         from paddle_tpu.profiler import RecordEvent, TracerEventType
 
+        with self._elock:
+            if self._inflight:
+                # commit everything dispatched against the OLD weights
+                # before the restore swaps parameters under the step
+                self._drain_all()
         mgr = source if isinstance(source, CheckpointManager) \
             else CheckpointManager(str(source))
         try:

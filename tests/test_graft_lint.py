@@ -142,6 +142,49 @@ def test_host_sync_in_hot_loop_bad_and_clean(tmp_path):
     assert {f["line"] for f in hits} == {min(f["line"] for f in hits)}
 
 
+def test_host_sync_transitive_helper(tmp_path):
+    """The dispatch-path hazard: a readback hidden one call away from a
+    @hot_path function must fire (with the call chain named), while the
+    reduced-strictness transitive scan skips the np.asarray heuristic
+    (helpers legitimately shape host arrays) and honors the metered
+    escape hatch."""
+    _write(tmp_path, "mod.py", """
+        import numpy as np
+
+        def hot_path(fn=None, **kw):
+            def mark(f):
+                return f
+            return mark if fn is None else fn
+
+        class Engine:
+            @hot_path
+            def _dispatch_decode(self, t):
+                return self._stage(t)
+
+            def _stage(self, t):
+                host = np.asarray([1, 2])        # host shaping: clean
+                pos = np.asarray(host)           # heuristic off: clean
+                return t.numpy(), pos            # unmetered sync: fires
+
+            def _metered(self, t):
+                with self.stall.timed("drain"):
+                    return t.numpy()             # metered: clean
+
+            @hot_path
+            def _commit(self, t):
+                return self._metered(t)
+
+            def _unreached(self, t):
+                return t.numpy()                 # not on a hot path: clean
+    """)
+    report = _lint(tmp_path, rules=["host-sync-in-hot-loop"])
+    hits = _rules_hit(report, "host-sync-in-hot-loop")
+    assert len(hits) == 1
+    assert hits[0]["symbol"] == "Engine._stage"
+    assert "reached from @hot_path via Engine._dispatch_decode" \
+        in hits[0]["message"]
+
+
 def test_guarded_by_bad_and_clean(tmp_path):
     _write(tmp_path, "mod.py", """
         import threading
@@ -430,6 +473,49 @@ def test_lint_catches_seeded_bad_construct(tmp_path):
     assert f"bad.py:{item_line}" in r.stdout        # host-sync-in-hot-loop
     assert "[guarded-by]" in r.stdout
     assert "[host-sync-in-hot-loop]" in r.stdout
+
+
+def test_lint_seeded_dispatch_helper_sync_both_directions(tmp_path):
+    """The async-engine shape, pinned both ways through the real driver:
+    a helper called from the hot dispatch path that syncs unmetered exits
+    non-zero with the helper's file:line; metering the same sync under
+    stall.timed makes the tree exit zero."""
+    tmpl = textwrap.dedent("""
+        def hot_path(fn=None, **kw):
+            def mark(f):
+                return f
+            return mark if fn is None else fn
+
+        class Engine:
+            @hot_path
+            def _dispatch_decode(self, t):
+                return self._fetch(t)
+
+            def _fetch(self, t):
+                %s
+    """)
+    bad_body = "return t.numpy()"
+    good_body = ("with self.stall.timed(\"drain\"):\n"
+                 "            return t.numpy()")
+    bad = tmp_path / "engine.py"
+    bad.write_text(tmpl % bad_body)
+    line = (tmpl % bad_body).splitlines().index(
+        f"        {bad_body}") + 1
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "lint.py"),
+         "--root", str(tmp_path), "--baseline", str(tmp_path / "bl.json")],
+        capture_output=True, text=True, timeout=120)
+    assert r.returncode != 0
+    assert f"engine.py:{line}" in r.stdout
+    assert "[host-sync-in-hot-loop]" in r.stdout
+    assert "reached from @hot_path" in r.stdout
+
+    bad.write_text(tmpl % good_body)
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "lint.py"),
+         "--root", str(tmp_path), "--baseline", str(tmp_path / "bl.json")],
+        capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0, r.stdout[-2000:]
 
 
 def test_changed_mode_scopes_findings(tmp_path):

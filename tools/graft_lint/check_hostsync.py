@@ -15,9 +15,18 @@ decode step). Anything else needs a ``# graft-lint:
 disable=host-sync-in-hot-loop`` with a reason, which is exactly the
 review conversation the rule exists to force.
 
-Lexical scope: the checker looks at the annotated function body itself
-(nested defs included). Helpers a hot function calls should be annotated
-``@hot_path`` themselves when they sit on the same critical path.
+Scope: the annotated function body itself (nested defs included) gets the
+full scan, and every helper statically REACHABLE from a hot function gets
+a reduced-strictness scan — only the unambiguous sync constructs
+(``.numpy()`` / ``.item()`` / ``.tolist()`` / ``block_until_ready`` /
+``device_get``), not the ``np.asarray`` heuristic, because a transitive
+helper legitimately shapes host arrays all day. This is what makes an
+unmetered readback smuggled into the dispatch path through one level of
+indirection (the async-engine hazard: a helper called from
+``_dispatch_decode`` quietly syncing the step it just staged) a tier-1
+failure instead of a blind spot. The call graph is conservative
+(callgraph.py): unresolvable calls add no edge — the pass can miss, it
+does not hallucinate.
 """
 
 from __future__ import annotations
@@ -54,9 +63,13 @@ def _is_timed_with(node: ast.With) -> bool:
 
 
 class _SyncVisitor(ast.NodeVisitor):
-    def __init__(self, fi, findings: List[Finding]):
+    def __init__(self, fi, findings: List[Finding], via=None):
+        """``via``: the hot-root-first call chain that reaches ``fi`` when
+        this is the reduced-strictness transitive scan; None for the
+        directly-annotated scan (full strictness incl. the np heuristic)."""
         self.fi = fi
         self.findings = findings
+        self.via = via
         self.np_aliases = _numpy_aliases(fi.module)
         self._timed_depth = 0
 
@@ -71,12 +84,17 @@ class _SyncVisitor(ast.NodeVisitor):
     def _flag(self, node: ast.AST, what: str):
         if self._timed_depth:
             return                       # metered sync: allowed by design
+        if self.via is None:
+            where = f"inside @hot_path {self.fi.qualname}"
+        else:
+            chain = " -> ".join(f.qualname for f in self.via)
+            where = (f"in {self.fi.qualname}, reached from @hot_path via "
+                     f"{chain}")
         self.findings.append(Finding(
             RULE, self.fi.module.rel, node.lineno, node.col_offset,
-            f"{what} blocks the host inside @hot_path "
-            f"{self.fi.qualname} — meter it under a stall.timed(...) "
-            f"block, move it off the critical path, or suppress with a "
-            f"reason", symbol=self.fi.qualname))
+            f"{what} blocks the host {where} — meter it under a "
+            f"stall.timed(...) block, move it off the critical path, or "
+            f"suppress with a reason", symbol=self.fi.qualname))
 
     def visit_Call(self, node: ast.Call):
         fn = node.func
@@ -84,7 +102,8 @@ class _SyncVisitor(ast.NodeVisitor):
             self._flag(node, f"`.{fn.attr}()` host sync")
         elif isinstance(fn, ast.Name) and fn.id in _SYNC_ATTRS:
             self._flag(node, f"`{fn.id}()` host sync")
-        elif isinstance(fn, ast.Attribute) and fn.attr in _NUMPY_FUNCS \
+        elif self.via is None \
+                and isinstance(fn, ast.Attribute) and fn.attr in _NUMPY_FUNCS \
                 and isinstance(fn.value, ast.Name) \
                 and fn.value.id in self.np_aliases \
                 and node.args and not _is_host_literal(node.args[0]):
@@ -96,10 +115,20 @@ class _SyncVisitor(ast.NodeVisitor):
 class HostSyncChecker:
     rule = RULE
     description = ("blocking host<->device syncs inside @hot_path functions "
-                   "(unless metered under stall.timed)")
+                   "or helpers they statically reach (unless metered under "
+                   "stall.timed)")
 
     def run(self, graph: ModuleGraph, index: FunctionIndex) -> List[Finding]:
         findings: List[Finding] = []
-        for fi in index.hot_functions():
+        hot = index.hot_functions()
+        for fi in hot:
             _SyncVisitor(fi, findings).visit(fi.node)
+        # transitive pass: helpers a hot function reaches get the reduced
+        # scan (unambiguous sync attrs only) — a readback hidden one call
+        # away from the dispatch path must fail the same as an inline one
+        hot_set = set(hot)
+        for fi, path in index.reachable_from(hot).items():
+            if fi in hot_set:
+                continue
+            _SyncVisitor(fi, findings, via=path).visit(fi.node)
         return findings
