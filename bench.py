@@ -788,6 +788,51 @@ def bench_serving_async(on_tpu):
     }))
 
 
+def bench_serving_router(on_tpu):
+    """Fault-tolerant multi-replica serving
+    (tools/serve_bench.run_router_suite): N supervised scheduler replicas
+    behind the cache-aware health-gated router. Measures tokens/s vs one
+    replica, the replica-kill failover drill (every accepted request
+    terminal, survivor token streams bit-identical to the single-replica
+    oracle, zero block leaks, goodput recovered to >=95% of the pre-kill
+    baseline after supervised restart), and the prefix-affinity hit-rate
+    win over round-robin placement. Host-path measurement — CPU-sized;
+    the artifact is BENCH_serving_router.json."""
+    import sys
+
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from tools.serve_bench import run_router_suite
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    art = run_router_suite(smoke=True, out_dir=here, num_replicas=3)
+    kd = art["kill_drill"]
+    assert kd["token_identical_to_single_replica"], (
+        "failover perturbed token streams vs the single-replica oracle")
+    assert kd["goodput"] == 1.0, (
+        "requests lost across the replica kill: census %s" % kd["census"])
+    assert kd["recovered_95pct"], (
+        "post-kill throughput recovered only %.1f%% of baseline "
+        "(budget 95%%)" % kd["recovery_pct_of_baseline"])
+    avr = art["affinity_vs_round_robin"]
+    assert avr["affinity_not_worse"], (
+        "affinity routing hit rate %.4f below round-robin %.4f"
+        % (avr["hit_rate_affinity"], avr["hit_rate_round_robin"]))
+    print(json.dumps({
+        "metric": "serving_router_recovery_pct",
+        "value": kd["recovery_pct_of_baseline"],
+        "unit": "% of pre-kill tokens/iteration regained after replica "
+                "kill + supervised restart",
+        "vs_baseline": None,  # first round with a multi-replica trajectory
+        "token_identical_to_single_replica":
+            kd["token_identical_to_single_replica"],
+        "goodput": kd["goodput"],
+        "requests_failed_over": kd["requests_failed_over"],
+        "speedup_x": art["scaling"]["speedup_x"],
+        "affinity_hit_rate_win": avr["hit_rate_win"],
+        "within_budget": art["within_budget"],
+    }))
+
+
 def bench_ckpt(on_tpu):
     """Checkpoint lifecycle: sync save throughput, async snapshot stall
     (the train-step pause a background save costs), and cold resume
@@ -982,6 +1027,7 @@ for _f in (bench_chip_ceilings, bench_resnet50, bench_bert, bench_ernie,
            bench_observability,
            bench_serving_chaos,
            bench_serving_async,
+           bench_serving_router,
            bench_ckpt,
            bench_train,
            bench_lint,

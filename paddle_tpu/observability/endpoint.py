@@ -15,6 +15,11 @@ without adding any dependency:
                           tokens, slot, preemptions, age), recent completed
                           traces, the stall breakdown, SLO accounting, and
                           the flight-recorder ring (``?last=N`` trims it).
+- ``GET /debug/replicas`` JSON fleet view from every attached
+                          ``ServingRouter`` (``add_router``): per-replica
+                          health/breaker/generation/load + prefix-cache
+                          stats, supervisor reap/restart accounting, and
+                          the router's failover counters.
 - ``GET /healthz``        truthful health: the worst state across every
                           attached health source, as a plain-text body —
                           ``ok`` / ``degraded`` (shed ladder engaged) /
@@ -64,6 +69,7 @@ class ObservabilityEndpoint:
             self.add_registry(r)
         self._debug_sources: "Dict[str, Callable[[], dict]]" = {}
         self._health_sources: "Dict[str, Callable[[], dict]]" = {}
+        self._replica_sources: "Dict[str, Callable[[], dict]]" = {}
         self._host = host
         self._port = int(port)
         self._server: Optional[ThreadingHTTPServer] = None
@@ -96,6 +102,21 @@ class ObservabilityEndpoint:
             self.add_health_source(key, scheduler.health)
         return self
 
+    def add_router(self, router, name: Optional[str] = None):
+        """Attach a ``ServingRouter``: its router-level registry (fault
+        counters + per-replica labeled gauges) plus every replica
+        scheduler's registry feed ``/metrics``, its fleet ``health()``
+        feeds ``/healthz``, and ``debug_state()`` feeds both
+        ``/debug/requests`` and the dedicated ``/debug/replicas`` page."""
+        self.add_registry(router.metrics.registry)
+        for rep in router.replicas:
+            self.add_registry(rep.sched.metrics.registry)
+        key = name or f"router{len(self._replica_sources)}"
+        self.add_debug_source(key, router.debug_state)
+        self.add_health_source(key, router.health)
+        self._replica_sources[key] = router.debug_state
+        return self
+
     # ------------------------------------------------------------ content
     def metrics_text(self) -> str:
         return "".join(r.prometheus_text() for r in self._registries)
@@ -112,6 +133,18 @@ class ObservabilityEndpoint:
                 if isinstance(fr, list):
                     state = dict(state, flight_recorder=fr[-last:])
             out[name] = state
+        return out
+
+    def debug_replicas(self) -> dict:
+        """The ``/debug/replicas`` payload: per-router replica tables
+        (health, breaker, generation, load, prefix-cache stats) and
+        supervisor/failover accounting."""
+        out = {}
+        for name, fn in self._replica_sources.items():
+            try:
+                out[name] = fn()
+            except Exception as e:  # a broken source must not 500 the page
+                out[name] = {"error": f"{type(e).__name__}: {e}"}
         return out
 
     _HEALTH_ORDER = ("ok", "degraded", "draining", "dead")
@@ -168,13 +201,18 @@ class ObservabilityEndpoint:
                     body = json.dumps(ep.debug_requests(last=last),
                                       default=str, indent=2)
                     self._send(200, body, "application/json")
+                elif url.path == "/debug/replicas":
+                    body = json.dumps(ep.debug_replicas(),
+                                      default=str, indent=2)
+                    self._send(200, body, "application/json")
                 elif url.path == "/healthz":
                     code, body = ep.health()
                     self._send(code, body, "text/plain")
                 else:
                     self._send(404, json.dumps(
                         {"error": "not found", "routes":
-                         ["/metrics", "/debug/requests", "/healthz"]}),
+                         ["/metrics", "/debug/requests",
+                          "/debug/replicas", "/healthz"]}),
                         "application/json")
 
         self._server = ThreadingHTTPServer((self._host, self._port), Handler)

@@ -63,6 +63,9 @@ SITES = (
     "ckpt.shard_write",       # per shard file inside the checkpoint writer
     "ckpt.manifest_write",    # before MANIFEST.json is written
     "ckpt.rename",            # before the atomic tmp -> final rename
+    "router.route",           # before a routing decision places a request
+    "replica.step",           # per replica-driver scheduler iteration
+    "replica.healthcheck",    # per supervisor health probe of one replica
 )
 
 

@@ -56,8 +56,15 @@ from paddle_tpu.serving.prefix_cache import (  # noqa: F401
 from paddle_tpu.serving.scheduler import (  # noqa: F401
     ContinuousBatchingScheduler,
 )
+from paddle_tpu.serving.router import (  # noqa: F401
+    CircuitBreaker,
+    ReplicaSupervisor,
+    ServingReplica,
+    ServingRouter,
+)
 
 __all__ = [
+    "CircuitBreaker",
     "ContinuousBatchingScheduler",
     "Histogram",
     "MetricsRegistry",
@@ -65,6 +72,7 @@ __all__ = [
     "QueueFull",
     "RadixTree",
     "RefCountingBlockAllocator",
+    "ReplicaSupervisor",
     "Request",
     "RequestOutput",
     "RequestQueue",
@@ -72,4 +80,6 @@ __all__ = [
     "SchedulerConfig",
     "SchedulerOverloaded",
     "ServingMetrics",
+    "ServingReplica",
+    "ServingRouter",
 ]

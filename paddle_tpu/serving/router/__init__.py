@@ -1,0 +1,20 @@
+"""Fault-tolerant multi-replica serving front end.
+
+``ServingRouter`` places requests over N in-process scheduler replicas
+(health-gated, prefix-cache-affine, least-loaded), ``ReplicaSupervisor``
+probes/reaps/restarts them, and replica death is a recoverable event:
+committed-view failover re-queues in-flight work on survivors with
+token-identical outputs. See ``router.py`` for the full semantics.
+"""
+
+from .replica import ServingReplica
+from .router import POLICIES, ServingRouter
+from .supervisor import CircuitBreaker, ReplicaSupervisor
+
+__all__ = [
+    "CircuitBreaker",
+    "POLICIES",
+    "ReplicaSupervisor",
+    "ServingReplica",
+    "ServingRouter",
+]

@@ -1,0 +1,234 @@
+"""One supervised scheduler replica: driver loop, death accounting, restart.
+
+A ``ServingReplica`` wraps one ``ContinuousBatchingScheduler`` behind the
+process-death semantics the router needs: stepping it funnels through one
+``step()`` that classifies failures (a transient fault skips the iteration;
+a fatal error — or an injected ``replica.step`` fault of kind ``fatal`` —
+marks the replica DEAD, the in-process stand-in for a crashed replica
+process), records a last-step heartbeat for the supervisor's hang
+detection, and supports ``restart()``: a fresh scheduler from the factory
+with an optional ``reload_weights()`` warm-up, bumping ``generation`` so
+stale request-id mappings from the dead incarnation can never alias the
+new one.
+
+Two driving modes share the same semantics:
+
+- inline: the router's ``step()`` drives every live replica one iteration
+  per call on the caller's thread — fully deterministic, what the chaos
+  drill and the bench use;
+- threaded: ``start_driver()`` spawns a daemon loop calling the same
+  ``step()``; the thread is registered via ``attach_driver`` so the
+  scheduler's own ``health()`` also turns truthfully ``dead`` if the loop
+  exits with work pending.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Dict, Optional
+
+from paddle_tpu.observability.annotations import guarded_by
+from paddle_tpu.resilience import classify_error, inject
+
+__all__ = ["ServingReplica"]
+
+
+class ServingReplica:
+    """One scheduler + its life/death bookkeeping. ``factory`` builds a
+    fresh ``ContinuousBatchingScheduler`` (used at construction and by
+    every ``restart()``); replicas built from one factory are functionally
+    identical, which is what makes failover token-identical."""
+
+    # shared between the driving thread (router loop or driver thread),
+    # the supervisor's probe thread, and submitters — pinned by graft_lint
+    _dead: guarded_by("_lock")
+    _dead_exc: guarded_by("_lock")
+    _last_step_t: guarded_by("_lock")
+    _steps: guarded_by("_lock")
+    _transient_faults: guarded_by("_lock")
+    _generation: guarded_by("_lock")
+    _reloading: guarded_by("_lock")
+    _stop_flag: guarded_by("_lock")
+
+    def __init__(self, replica_id: int, factory: Callable[[], object]):
+        self.replica_id = int(replica_id)
+        self._factory = factory
+        self.sched = factory()
+        self._lock = threading.Lock()
+        self._dead = False
+        self._dead_exc: Optional[BaseException] = None
+        self._last_step_t = time.monotonic()
+        self._steps = 0
+        self._transient_faults = 0
+        self._generation = 0
+        self._reloading = False
+        self._stop_flag = False
+        self._thread: Optional[threading.Thread] = None
+
+    # ---- driving -------------------------------------------------------
+
+    def step(self):
+        """One scheduler iteration with replica-death semantics. Returns
+        the iteration's finished ``RequestOutput``s ([] when dead). A
+        transient failure (injected ``replica.step`` transient, retryable
+        runtime flake) skips the iteration and is counted; anything fatal
+        kills the replica — its in-flight and queued work stays intact on
+        the scheduler object for the supervisor to export."""
+        with self._lock:
+            if self._dead:
+                return []
+        sched = self.sched
+        try:
+            inject("replica.step")
+            outs = sched.step()
+        except BaseException as exc:  # noqa: BLE001 — triaged right below
+            if classify_error(exc) == "transient":
+                sched.metrics.observe_fault("replica.step", "fired")
+                with self._lock:
+                    self._transient_faults += 1
+                return []
+            sched.metrics.observe_fault("replica.step", "fatal")
+            self.crash(exc)
+            return []
+        with self._lock:
+            self._steps += 1
+            self._last_step_t = time.monotonic()
+        return outs
+
+    def crash(self, exc: Optional[BaseException] = None):
+        """Mark the replica dead (a fatal fault did this, or a chaos drill
+        calls it directly — the deterministic replica-kill switch). The
+        scheduler object survives with its committed state; dispatched
+        steps keep draining on its background thread, so a later
+        ``export_restartable()`` sees every committed token."""
+        with self._lock:
+            if not self._dead:
+                self._dead = True
+                self._dead_exc = exc if exc is not None else RuntimeError(
+                    f"replica {self.replica_id} killed")
+
+    # ---- restart -------------------------------------------------------
+
+    def restart(self, warmup_source=None, reload_step: Optional[int] = None,
+                verify: str = "full"):
+        """Bring up a fresh scheduler from the factory (the dead one must
+        already have been exported) and optionally warm its weights from a
+        committed checkpoint via ``reload_weights``. Bumps ``generation``
+        so request-id mappings from the dead incarnation cannot alias."""
+        sched = self._factory()
+        if warmup_source is not None:
+            sched.reload_weights(warmup_source, step=reload_step,
+                                 verify=verify)
+        self.sched = sched
+        with self._lock:
+            self._dead = False
+            self._dead_exc = None
+            self._generation += 1
+            self._steps = 0
+            self._last_step_t = time.monotonic()
+        return sched
+
+    # ---- rolling-reload gate ------------------------------------------
+
+    def begin_reload(self):
+        """Take the replica out of routing (it keeps finishing its own
+        work) for a zero-downtime weight reload."""
+        with self._lock:
+            self._reloading = True
+
+    def end_reload(self):
+        with self._lock:
+            self._reloading = False
+
+    # ---- reading -------------------------------------------------------
+
+    @property
+    def dead(self) -> bool:
+        with self._lock:
+            return self._dead
+
+    @property
+    def dead_exc(self) -> Optional[BaseException]:
+        with self._lock:
+            return self._dead_exc
+
+    @property
+    def generation(self) -> int:
+        with self._lock:
+            return self._generation
+
+    @property
+    def reloading(self) -> bool:
+        with self._lock:
+            return self._reloading
+
+    def idle_age_s(self) -> float:
+        """Seconds since the last completed step — the supervisor's hang
+        signal when the scheduler still has unfinished work."""
+        with self._lock:
+            t = self._last_step_t
+        return time.monotonic() - t
+
+    def health(self) -> Dict[str, object]:
+        """The scheduler's truthful ``health()`` overlaid with replica-
+        level death/reload state and supervision counters."""
+        with self._lock:
+            dead = self._dead
+            dead_exc = self._dead_exc
+            generation = self._generation
+            steps = self._steps
+            faults = self._transient_faults
+            reloading = self._reloading
+        h = self.sched.health()
+        if dead:
+            h["state"] = "dead"
+        elif reloading:
+            h["state"] = "draining"
+        h["replica_id"] = self.replica_id
+        h["generation"] = generation
+        h["steps"] = steps
+        h["transient_faults"] = faults
+        h["idle_age_s"] = round(self.idle_age_s(), 6)
+        if dead_exc is not None:
+            h["dead_reason"] = f"{type(dead_exc).__name__}: {dead_exc}"
+        return h
+
+    # ---- threaded driver ----------------------------------------------
+
+    def start_driver(self, idle_sleep_s: float = 0.002) -> threading.Thread:
+        """Spawn a daemon loop driving ``step()``; registered with the
+        scheduler so its ``/healthz`` also reports ``dead`` if the loop
+        exits with work pending."""
+        with self._lock:
+            self._stop_flag = False
+        t = threading.Thread(target=self._drive, args=(idle_sleep_s,),
+                             name=f"replica-{self.replica_id}-driver",
+                             daemon=True)
+        self._thread = t
+        self.sched.attach_driver(t)
+        t.start()
+        return t
+
+    def _drive(self, idle_sleep_s: float):
+        while True:
+            with self._lock:
+                if self._stop_flag or self._dead:
+                    return
+            if self.sched.has_unfinished():
+                self.step()
+            else:
+                time.sleep(idle_sleep_s)
+
+    def stop_driver(self, timeout: float = 5.0):
+        with self._lock:
+            self._stop_flag = True
+        t = self._thread
+        if t is not None:
+            t.join(timeout)
+            self._thread = None
+
+    @property
+    def driver_alive(self) -> bool:
+        t = self._thread
+        return t is not None and t.is_alive()

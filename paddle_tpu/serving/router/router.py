@@ -1,0 +1,541 @@
+"""Cache-aware, health-gated front-end router over N scheduler replicas.
+
+``ServingRouter`` is the "millions of users" seam named in ROADMAP item 1:
+an in-process front end over N ``ContinuousBatchingScheduler`` replicas
+(one per factory call) that owns admission, placement, supervision, and
+failover. Requests enter through ``submit()`` and come back as ordinary
+``RequestOutput``s from ``step()``/``run()`` under *router* request ids —
+a caller cannot tell whether its request survived a replica death, except
+by reading the failover counters.
+
+Placement (policy ``affinity``, the default) composes three concerns in
+strict precedence order:
+
+1. **health** — only replicas the supervisor calls routable (alive, not
+   reloading, breaker not open, scheduler not draining) are candidates;
+2. **prefix affinity** — requests whose first ``affinity_tokens`` prompt
+   tokens match a previously routed request are pinned to the replica
+   whose radix tree holds that prefix (SGLang cache-aware routing), but
+   only while that replica is routable AND fully "ok": a degraded replica
+   loses its affinity traffic before it breaches SLOs, which is the
+   ladder's whole point;
+3. **least-loaded** — everything else (new prefixes, evicted bindings)
+   goes to the replica with the fewest queued + running requests,
+   preferring state "ok" over "degraded".
+
+**Token-identical failover.** When the supervisor reaps a dead replica it
+hands back every in-flight and queued request as a committed-view spec
+(prompt + tokens already *committed*, never tokens merely dispatched).
+``_failover`` re-queues each spec on a survivor via ``import_resumed``,
+which replays prompt+prefix exactly like a recompute-preemption resume —
+and greedy decode is batch/placement/timing-independent, so the resumed
+stream is bit-identical to a single-replica oracle. The original arrival
+timestamp rides along, so deadlines and queue-TTL keep measuring from
+first admission: failover never silently refreshes a request's budget.
+
+Rolling reload (``rolling_reload``) drains one replica at a time behind
+the router — its traffic shifts to peers via the ``reloading`` gate, it
+finishes its own work, hot-swaps weights via ``reload_weights()`` (no
+recompile), and rejoins before the next replica starts. Zero downtime:
+the router keeps serving throughout.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Dict, List, Optional
+
+import numpy as np
+
+from paddle_tpu.observability.annotations import guarded_by
+from paddle_tpu.profiler import RecordEvent
+from paddle_tpu.resilience import classify_error, inject
+from paddle_tpu.serving.metrics import ServingMetrics
+from paddle_tpu.serving.request import (QueueFull, RequestOutput,
+                                        SchedulerOverloaded)
+
+from .replica import ServingReplica
+from .supervisor import ReplicaSupervisor
+
+__all__ = ["ServingRouter"]
+
+POLICIES = ("affinity", "least_loaded", "round_robin")
+
+
+class _RouterRecord:
+    """Router-side bookkeeping for one live request."""
+
+    __slots__ = ("router_rid", "replica_id", "replica_rid", "on_token",
+                 "affinity_key")
+
+    def __init__(self, router_rid: int, replica_id: int, replica_rid: int,
+                 on_token, affinity_key):
+        self.router_rid = router_rid
+        self.replica_id = replica_id
+        self.replica_rid = replica_rid
+        self.on_token = on_token
+        self.affinity_key = affinity_key
+
+
+class ServingRouter:
+    """Front-end over N supervised scheduler replicas. ``factory()`` must
+    build a fresh, functionally identical ``ContinuousBatchingScheduler``
+    on every call (construction and restarts both use it)."""
+
+    # the router is driven by one loop but submitted to from any thread,
+    # while the supervisor's probes and the observability scrape read —
+    # all mapping state lives under one lock (pinned by graft_lint)
+    _records: guarded_by("_lock")
+    _by_replica: guarded_by("_lock")
+    _finished: guarded_by("_lock")
+    _affinity: guarded_by("_lock")
+    _rr_next: guarded_by("_lock")
+    _next_rid: guarded_by("_lock")
+    _steps: guarded_by("_lock")
+    _failovers: guarded_by("_lock")
+    _failed_over: guarded_by("_lock")
+
+    def __init__(self, factory: Callable[[], object], num_replicas: int = 2,
+                 *, policy: str = "affinity",
+                 affinity_tokens: Optional[int] = None,
+                 cooldown_s: float = 1.0,
+                 probe_fail_threshold: int = 3,
+                 hang_abs_s: float = 30.0,
+                 hang_factor: float = 50.0,
+                 restart_dead: bool = True,
+                 warmup_source=None,
+                 probe_every: int = 1):
+        if policy not in POLICIES:
+            raise ValueError(f"unknown policy {policy!r} "
+                             f"(known: {', '.join(POLICIES)})")
+        if num_replicas < 1:
+            raise ValueError("num_replicas must be >= 1")
+        self.policy = policy
+        self.replicas = [ServingReplica(i, factory)
+                         for i in range(int(num_replicas))]
+        # one "serving"-namespaced registry at the router level: the
+        # router-site fault counters land in serving_faults_total and the
+        # per-replica gauges ride the same scrape
+        self.metrics = ServingMetrics()
+        self.supervisor = ReplicaSupervisor(
+            self.replicas,
+            cooldown_s=cooldown_s,
+            probe_fail_threshold=probe_fail_threshold,
+            hang_abs_s=hang_abs_s,
+            hang_factor=hang_factor,
+            restart=restart_dead,
+            warmup_source=warmup_source,
+            metrics=self.metrics,
+            on_failover=self._failover_cb)
+        self.probe_every = max(1, int(probe_every))
+        if affinity_tokens is None:
+            affinity_tokens = int(self.replicas[0].sched.config.block_size)
+        self.affinity_tokens = int(affinity_tokens)
+
+        reg = self.metrics.registry
+        self._routed_total = reg.counter(
+            "router_requests_routed_total",
+            "placements by replica and routing decision")
+        self._failovers_total = reg.counter(
+            "router_failovers_total", "replica-death failover events")
+        self._failed_over_total = reg.counter(
+            "router_requests_failed_over_total",
+            "requests re-queued onto a survivor")
+        self._reloads_total = reg.counter(
+            "router_rolling_reloads_total",
+            "zero-downtime rolling weight reloads completed")
+
+        self._lock = threading.RLock()
+        self._records: Dict[int, _RouterRecord] = {}
+        # (replica_id, generation, replica_rid) -> router_rid; generation
+        # is in the key because a restarted scheduler reuses rids from 0
+        self._by_replica: Dict[tuple, int] = {}
+        self._finished: Dict[int, RequestOutput] = {}
+        self._affinity: Dict[tuple, int] = {}
+        self._rr_next = 0
+        self._next_rid = 0
+        self._steps = 0
+        self._failovers = 0
+        self._failed_over = 0
+
+    # ---- placement -----------------------------------------------------
+
+    def _affinity_key(self, prompt_ids: np.ndarray):
+        ids = np.asarray(prompt_ids).reshape(-1)
+        if len(ids) < self.affinity_tokens:
+            return None
+        return tuple(int(t) for t in ids[: self.affinity_tokens])
+
+    def _load(self, rep: ServingReplica) -> int:
+        sched = rep.sched
+        return len(sched.queue) + sum(
+            1 for r in sched._slots if r is not None)
+
+    def _place(self, key) -> List[tuple]:
+        """Ordered (replica, decision) candidates for one request. Health
+        gates first; affinity only redirects among healthy replicas."""
+        live = [r for r in self.replicas if self.supervisor.routable(r)]
+        if not live:
+            return []
+        by_load = sorted(
+            live, key=lambda r: (r.sched.health()["state"] != "ok",
+                                 self._load(r), r.replica_id))
+        if self.policy == "round_robin":
+            with self._lock:
+                start = self._rr_next
+                self._rr_next += 1
+            order = [live[(start + i) % len(live)] for i in range(len(live))]
+            return [(r, "round_robin") for r in order]
+        if self.policy == "least_loaded" or key is None:
+            return [(r, "least_loaded") for r in by_load]
+        with self._lock:
+            bound = self._affinity.get(key)
+        if bound is not None:
+            for rep in live:
+                if (rep.replica_id == bound
+                        and rep.sched.health()["state"] == "ok"):
+                    rest = [(r, "affinity_spill") for r in by_load
+                            if r.replica_id != bound]
+                    return [(rep, "affinity_hit")] + rest
+            # bound replica dead/degraded/draining: rebind elsewhere
+            return [(r, "affinity_fallback") for r in by_load]
+        return [(r, "affinity_new") for r in by_load]
+
+    # ---- admission -----------------------------------------------------
+
+    def submit(self, prompt_ids, max_new_tokens: Optional[int] = None,
+               eos_token_id: Optional[int] = None, priority: int = 0,
+               on_token=None, deadline_s: Optional[float] = None) -> int:
+        """Route one request onto a replica; returns the ROUTER request id
+        (stable across failover). Raises ``ValueError`` for malformed
+        requests, ``SchedulerOverloaded`` when no replica is routable or
+        every candidate refused admission."""
+        with RecordEvent("router.route"):
+            try:
+                inject("router.route")
+            except BaseException as exc:  # noqa: BLE001 — triaged below
+                if classify_error(exc) == "transient":
+                    # a lost routing RPC: the request was never accepted
+                    # anywhere, so retrying the placement here is safe
+                    self.metrics.observe_fault("router.route", "fired")
+                else:
+                    self.metrics.observe_fault("router.route", "fatal")
+                    raise
+            key = self._affinity_key(prompt_ids)
+            candidates = self._place(key)
+            if not candidates:
+                self.metrics.requests_rejected += 1
+                raise SchedulerOverloaded("no routable replica")
+            with self._lock:
+                router_rid = self._next_rid
+                self._next_rid += 1
+            wrapped = self._wrap_cb(router_rid, on_token)
+            last_exc: Optional[BaseException] = None
+            for rep, decision in candidates:
+                try:
+                    replica_rid = rep.sched.add_request(
+                        prompt_ids, max_new_tokens=max_new_tokens,
+                        eos_token_id=eos_token_id, priority=priority,
+                        on_token=wrapped, deadline_s=deadline_s)
+                except (QueueFull, SchedulerOverloaded) as exc:
+                    last_exc = exc       # this replica is full: spill over
+                    continue
+                self._register(router_rid, rep, replica_rid, wrapped, key,
+                               decision)
+                return router_rid
+            self.metrics.requests_rejected += 1
+            raise SchedulerOverloaded(
+                f"all {len(candidates)} routable replicas refused "
+                f"admission") from last_exc
+
+    def _wrap_cb(self, router_rid: int, on_token):
+        """Stream callbacks cross the rid remap too: the caller sees its
+        router rid, never a replica-local one."""
+        if on_token is None:
+            return None
+
+        def _cb(_replica_rid: int, token: int) -> None:
+            on_token(router_rid, token)
+
+        return _cb
+
+    def _register(self, router_rid: int, rep: ServingReplica,
+                  replica_rid: int, wrapped, key, decision: str) -> None:
+        with self._lock:
+            rec = _RouterRecord(router_rid, rep.replica_id, replica_rid,
+                                wrapped, key)
+            self._records[router_rid] = rec
+            self._by_replica[(rep.replica_id, rep.generation,
+                              replica_rid)] = router_rid
+            if key is not None and self.policy == "affinity":
+                self._affinity[key] = rep.replica_id
+        self.metrics.requests_received += 1
+        self._routed_total.labels(replica=str(rep.replica_id),
+                                  decision=decision).inc()
+
+    # ---- driving -------------------------------------------------------
+
+    def step(self) -> List[RequestOutput]:
+        """One router iteration: step every live replica one scheduler
+        iteration, collect finishes under router rids, then (every
+        ``probe_every`` steps) run one supervision pass — which is where
+        hang detection, reaping, and failover actually happen."""
+        done: List[RequestOutput] = []
+        for rep in self.replicas:
+            if rep.dead:
+                continue
+            for out in rep.step():
+                ro = self._collect(rep, out)
+                if ro is not None:
+                    done.append(ro)
+        with self._lock:
+            self._steps += 1
+            steps = self._steps
+        if steps % self.probe_every == 0:
+            self.supervisor.probe_all()
+        return done
+
+    def _collect(self, rep: ServingReplica,
+                 out: RequestOutput) -> Optional[RequestOutput]:
+        """Remap one replica-local finish to its router rid and retire it.
+        Unknown rids (a request already failed over, or replica-internal
+        work) are dropped — the failed-over copy will finish elsewhere."""
+        with self._lock:
+            router_rid = self._by_replica.pop(
+                (rep.replica_id, rep.generation, out.request_id), None)
+            if router_rid is None:
+                return None
+            self._records.pop(router_rid, None)
+            ro = RequestOutput(
+                request_id=router_rid,
+                prompt_ids=out.prompt_ids,
+                generated_ids=out.generated_ids,
+                finish_reason=out.finish_reason,
+                ttft_s=out.ttft_s,
+                tpot_s=out.tpot_s,
+                num_preemptions=out.num_preemptions)
+            self._finished[router_rid] = ro
+        if out.finish_reason in ("eos", "length"):
+            self.metrics.requests_finished += 1
+        elif out.finish_reason == "failed":
+            self.metrics.requests_failed += 1
+        elif out.finish_reason is not None:
+            self.metrics.observe_cancel(out.finish_reason)
+        self.metrics.generated_tokens += int(len(out.generated_ids))
+        return ro
+
+    def has_unfinished(self) -> bool:
+        with self._lock:
+            return bool(self._records)
+
+    def run(self, max_iterations: int = 200_000) -> Dict[int, RequestOutput]:
+        """Drive until every accepted request reached a terminal state;
+        returns EVERY finished output so far (not just this call's), so
+        work retired while e.g. ``rolling_reload`` pumped steps internally
+        is never missing from the result."""
+        it = 0
+        while self.has_unfinished():
+            it += 1
+            if it > max_iterations:
+                raise RuntimeError(
+                    f"router did not converge in {max_iterations} "
+                    f"iterations; debug: {self.debug_state()['router']}")
+            self.step()
+        with self._lock:
+            return dict(self._finished)
+
+    # ---- failover ------------------------------------------------------
+
+    def _failover_cb(self, rep: ServingReplica, gen: int,
+                     specs: List[Dict[str, object]]) -> None:
+        """Supervisor callback after reaping ``rep`` (which carried
+        generation ``gen`` when it died): re-queue every exported
+        committed-view spec on a survivor. Replay via ``import_resumed``
+        is the recompute-preemption path, so the completed stream is
+        token-identical to a single-replica run, and the carried
+        ``arrival_t`` keeps deadlines measured from first admission."""
+        if not specs:
+            with self._lock:
+                self._failovers += 1
+            self._failovers_total.inc()
+            return
+        with RecordEvent("router.failover"):
+            moved = 0
+            for spec in specs:
+                with self._lock:
+                    router_rid = self._by_replica.pop(
+                        (rep.replica_id, gen, spec["request_id"]), None)
+                    rec = (self._records.get(router_rid)
+                           if router_rid is not None else None)
+                if rec is None:
+                    continue
+                survivor = self._pick_survivor(rep, rec.affinity_key)
+                if survivor is None:
+                    self._fail_unrecoverable(rec, spec)
+                    continue
+                # import outside self._lock: add/import takes the
+                # scheduler's engine lock, and lock order must stay
+                # scheduler-after-router everywhere
+                new_rrid = survivor.sched.import_resumed(
+                    spec, on_token=rec.on_token)
+                with self._lock:
+                    rec.replica_id = survivor.replica_id
+                    rec.replica_rid = new_rrid
+                    self._by_replica[(survivor.replica_id,
+                                      survivor.generation, new_rrid)] = \
+                        rec.router_rid
+                    if (rec.affinity_key is not None
+                            and self.policy == "affinity"):
+                        self._affinity[rec.affinity_key] = \
+                            survivor.replica_id
+                moved += 1
+                self._failed_over_total.inc()
+            with self._lock:
+                self._failovers += 1
+                self._failed_over += moved
+            self._failovers_total.inc()
+
+    def _pick_survivor(self, dead: ServingReplica,
+                       key) -> Optional[ServingReplica]:
+        """Survivor choice mirrors placement: routable peers first (by
+        health-then-load), then the restarted replica itself (its breaker
+        is open, but re-queueing beats losing the request — this is
+        recovery traffic, not new admission)."""
+        live = [r for r in self.replicas
+                if r is not dead and self.supervisor.routable(r)]
+        if not live and not dead.dead:
+            live = [dead]                 # restarted: its own survivor
+        if not live:
+            return None
+        if key is not None and self.policy == "affinity":
+            with self._lock:
+                bound = self._affinity.get(key)
+            for rep in live:
+                if (rep.replica_id == bound
+                        and rep.sched.health()["state"] == "ok"):
+                    return rep
+        return min(live, key=lambda r: (r.sched.health()["state"] != "ok",
+                                        self._load(r), r.replica_id))
+
+    def _fail_unrecoverable(self, rec: _RouterRecord,
+                            spec: Dict[str, object]) -> None:
+        """No survivor at all: retire the request with an attributed
+        terminal state rather than losing it silently."""
+        out = RequestOutput(
+            request_id=rec.router_rid,
+            prompt_ids=np.asarray(spec["prompt_ids"], np.int64),
+            generated_ids=np.asarray(spec.get("out_tokens", ()), np.int64),
+            finish_reason="failed",
+            ttft_s=None, tpot_s=None,
+            num_preemptions=int(spec.get("num_preemptions", 0)))
+        with self._lock:
+            self._records.pop(rec.router_rid, None)
+            self._finished[rec.router_rid] = out
+        self.metrics.requests_failed += 1
+
+    # ---- chaos / control ----------------------------------------------
+
+    def crash_replica(self, replica_id: int) -> None:
+        """Deterministic replica kill (the chaos drill's switch). The
+        next supervision pass reaps and fails over."""
+        self.replicas[replica_id].crash()
+
+    def cancel(self, router_rid: int, cause: str = "cancelled") -> bool:
+        with self._lock:
+            rec = self._records.get(router_rid)
+        if rec is None:
+            return False
+        rep = self.replicas[rec.replica_id]
+        return bool(rep.sched.cancel(rec.replica_rid, cause=cause))
+
+    def rolling_reload(self, source, step: Optional[int] = None,
+                       verify: str = "full") -> List[int]:
+        """Zero-downtime weight rollout: one replica at a time leaves the
+        routing set (``reloading`` gate), finishes its own work while
+        peers absorb new traffic, hot-swaps weights, rejoins. The router
+        keeps stepping throughout — no request ever waits on the reload."""
+        loaded: List[int] = []
+        with RecordEvent("router.reload"):
+            for rep in self.replicas:
+                if rep.dead:
+                    continue
+                rep.begin_reload()
+                try:
+                    while rep.sched.has_unfinished():
+                        self.step()       # peers keep serving; rep drains
+                    loaded.append(int(rep.sched.reload_weights(
+                        source, step=step, verify=verify)))
+                finally:
+                    rep.end_reload()
+                self._reloads_total.inc()
+        return loaded
+
+    def shutdown(self) -> Dict[str, int]:
+        totals = {"drained_in_flight": 0, "cancelled": 0}
+        for rep in self.replicas:
+            rep.stop_driver(timeout=2.0)
+            if rep.dead:
+                continue
+            counts = rep.sched.shutdown()
+            for k in totals:
+                totals[k] += int(counts.get(k, 0))
+        return totals
+
+    # ---- reading -------------------------------------------------------
+
+    def get_finished(self, router_rid: int) -> Optional[RequestOutput]:
+        with self._lock:
+            return self._finished.get(router_rid)
+
+    def health(self) -> Dict[str, object]:
+        """Fleet health: "dead" with zero routable replicas, "ok" only
+        when every replica is routable and individually ok."""
+        states = []
+        routable = 0
+        for rep in self.replicas:
+            h = rep.health()
+            states.append(h["state"])
+            if self.supervisor.routable(rep):
+                routable += 1
+        if routable == 0:
+            state = "dead"
+        elif (routable == len(self.replicas)
+                and all(s == "ok" for s in states)):
+            state = "ok"
+        else:
+            state = "degraded"   # quarantined/degraded replicas in fleet
+        return {"state": state, "replicas": len(self.replicas),
+                "routable": routable, "replica_states": states}
+
+    def debug_state(self) -> Dict[str, object]:
+        """The ``/debug/replicas`` payload: per-replica health + breaker +
+        load + cache stats, and the router's own mapping/failover view."""
+        reps = []
+        for rep in self.replicas:
+            h = rep.health()
+            row = {
+                "replica_id": rep.replica_id,
+                "state": h["state"],
+                "generation": rep.generation,
+                "breaker": self.supervisor.breakers[rep.replica_id].state(),
+                "load": None if rep.dead else self._load(rep),
+                "steps": h.get("steps"),
+                "transient_faults": h.get("transient_faults"),
+            }
+            pc = rep.sched.prefix_cache
+            if pc is not None and not rep.dead:
+                row["prefix_cache"] = pc.stats()
+            reps.append(row)
+        with self._lock:
+            router = {
+                "policy": self.policy,
+                "affinity_tokens": self.affinity_tokens,
+                "live_requests": len(self._records),
+                "finished_requests": len(self._finished),
+                "affinity_bindings": len(self._affinity),
+                "failovers": self._failovers,
+                "requests_failed_over": self._failed_over,
+                "steps": self._steps,
+            }
+        return {"router": router, "replicas": reps,
+                "supervisor": self.supervisor.snapshot()}

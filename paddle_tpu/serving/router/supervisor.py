@@ -1,0 +1,291 @@
+"""Replica supervision: health probes, hang/crash detection, reap + restart.
+
+The ``ReplicaSupervisor`` is the router's control loop. Each ``probe_all()``
+pass over the replica set does, in order:
+
+1. **hang detection** — a replica that has unfinished work but has not
+   completed a step for much longer than its own ``StepWatchdog`` EWMA
+   (or an absolute floor when no EWMA exists yet) is declared crashed;
+   a wedged driver is indistinguishable from a dead one at the routing
+   layer, and the watchdog's storm counter already proved the step-time
+   signal is trustworthy;
+2. **reap** — a dead replica's scheduler is drained of its committed view
+   (``export_restartable()``: every in-flight and queued request as a
+   prompt + committed-token-prefix spec, all KV blocks freed), its
+   circuit breaker is tripped open, the replica is optionally restarted
+   from the factory with a ``reload_weights()`` warm-up, and the exported
+   specs are handed to the router's failover callback for re-queue on
+   survivors — replay from the committed view is exactly the recompute-
+   preemption path, so survivor outputs are token-identical;
+3. **probe** — ``replica.healthcheck`` injection point, then the replica's
+   truthful ``health()``; outcomes feed the per-replica circuit breaker
+   and are mirrored into per-replica labeled gauges on the router's
+   metrics registry so ``/metrics`` shows the fleet at a glance.
+
+The ``CircuitBreaker`` is time-based: a trip opens it for ``cooldown_s``;
+after cooldown it half-opens, and the next successful probe closes it.
+While open, the router will not place new work on the replica even if its
+scheduler looks healthy — a just-restarted replica earns traffic back by
+probing clean, it does not get it by default.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Sequence
+
+from paddle_tpu.observability.annotations import guarded_by
+from paddle_tpu.resilience import classify_error, inject
+
+from .replica import ServingReplica
+
+__all__ = ["CircuitBreaker", "ReplicaSupervisor"]
+
+
+class CircuitBreaker:
+    """Per-replica admission breaker: closed → open (trip) → half_open
+    (after ``cooldown_s``) → closed (successful probe). ``clock`` is
+    injectable so tests step time deterministically."""
+
+    _state: guarded_by("_lock")
+    _opened_t: guarded_by("_lock")
+    _probe_failures: guarded_by("_lock")
+
+    def __init__(self, cooldown_s: float = 1.0,
+                 probe_fail_threshold: int = 3,
+                 clock: Callable[[], float] = time.monotonic):
+        self.cooldown_s = float(cooldown_s)
+        self.probe_fail_threshold = int(probe_fail_threshold)
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._state = "closed"
+        self._opened_t = 0.0
+        self._probe_failures = 0
+        self._trips = 0
+
+    def state(self) -> str:
+        """Current state; lazily transitions open → half_open once the
+        cooldown has elapsed."""
+        with self._lock:
+            if (self._state == "open"
+                    and self._clock() - self._opened_t >= self.cooldown_s):
+                self._state = "half_open"
+            return self._state
+
+    def record_open(self) -> None:
+        """Trip the breaker (replica death / reap)."""
+        with self._lock:
+            self._state = "open"
+            self._opened_t = self._clock()
+            self._probe_failures = 0
+            self._trips += 1
+
+    def record_probe(self, ok: bool) -> None:
+        """Feed one probe outcome. A clean probe closes the breaker only
+        from half_open — during cooldown the replica stays quarantined no
+        matter what its scheduler reports. Repeated failures trip it."""
+        state = self.state()          # applies the cooldown transition
+        with self._lock:
+            if ok:
+                self._probe_failures = 0
+                if state == "half_open":
+                    self._state = "closed"
+                return
+            self._probe_failures += 1
+            if (state == "half_open"
+                    or self._probe_failures >= self.probe_fail_threshold):
+                self._state = "open"
+                self._opened_t = self._clock()
+                self._probe_failures = 0
+                self._trips += 1
+
+    def allows(self) -> bool:
+        """May the router place new work here? half_open admits (that IS
+        the trial traffic); only open blocks."""
+        return self.state() != "open"
+
+    @property
+    def trips(self) -> int:
+        with self._lock:
+            return self._trips
+
+
+class ReplicaSupervisor:
+    """Probes replicas, detects hangs, reaps the dead, and hands their
+    exported committed-view specs to ``on_failover(replica, generation,
+    specs)`` for re-queue. Restart-before-failover ordering matters: with
+    one replica, the restarted incarnation is its own survivor."""
+
+    _reaped: guarded_by("_lock")
+    _probes: guarded_by("_lock")
+    _restarts: guarded_by("_lock")
+
+    def __init__(self, replicas: Sequence[ServingReplica], *,
+                 cooldown_s: float = 1.0,
+                 probe_fail_threshold: int = 3,
+                 hang_abs_s: float = 30.0,
+                 hang_factor: float = 50.0,
+                 restart: bool = True,
+                 warmup_source=None,
+                 metrics=None,
+                 on_failover: Optional[Callable] = None,
+                 clock: Callable[[], float] = time.monotonic):
+        self.replicas = list(replicas)
+        self.hang_abs_s = float(hang_abs_s)
+        self.hang_factor = float(hang_factor)
+        self.restart_policy = bool(restart)
+        self.warmup_source = warmup_source
+        self.metrics = metrics
+        self.on_failover = on_failover
+        self.breakers: Dict[int, CircuitBreaker] = {
+            rep.replica_id: CircuitBreaker(
+                cooldown_s=cooldown_s,
+                probe_fail_threshold=probe_fail_threshold,
+                clock=clock)
+            for rep in self.replicas
+        }
+        self._lock = threading.Lock()
+        # replica_id -> generation already reaped; a restart bumps the
+        # generation, which naturally re-arms reaping for the new life
+        self._reaped: Dict[int, int] = {}
+        self._probes = 0
+        self._restarts = 0
+
+    # ---- health probing ------------------------------------------------
+
+    def probe(self, rep: ServingReplica) -> Dict[str, object]:
+        """One health probe of one replica through the
+        ``replica.healthcheck`` injection point. A transient injected
+        fault models a lost/timed-out probe: counted as a probe failure
+        against the breaker, reported as state "unknown"."""
+        br = self.breakers[rep.replica_id]
+        try:
+            inject("replica.healthcheck")
+        except BaseException as exc:  # noqa: BLE001 — triaged right below
+            if self.metrics is not None:
+                self.metrics.observe_fault("replica.healthcheck", "fired")
+            if classify_error(exc) != "transient":
+                raise
+            br.record_probe(False)
+            with self._lock:
+                self._probes += 1
+            return {"replica_id": rep.replica_id, "state": "unknown",
+                    "breaker": br.state()}
+        h = rep.health()
+        # only death (or a lost probe, above) counts against the breaker:
+        # "degraded" replicas shed load through the ladder and "draining"
+        # is a deliberate reload/drain state the routing gate already
+        # excludes — tripping the breaker on either would quarantine a
+        # replica for doing exactly what it was asked to do
+        br.record_probe(h["state"] != "dead")
+        with self._lock:
+            self._probes += 1
+        h["breaker"] = br.state()
+        self._export_gauges(rep, h, br)
+        return h
+
+    def _export_gauges(self, rep: ServingReplica, h: Dict[str, object],
+                       br: CircuitBreaker) -> None:
+        """Mirror one replica's health into per-replica labeled gauges on
+        the router's metrics registry (skipped when metrics is absent)."""
+        if self.metrics is None:
+            return
+        reg = self.metrics.registry
+        label = str(rep.replica_id)
+        up = 0.0 if h["state"] == "dead" else 1.0
+        reg.gauge("router_replica_up",
+                  "1 while the replica is routable-alive"
+                  ).labels(replica=label).set(up)
+        breaker_code = {"closed": 0.0, "half_open": 1.0, "open": 2.0}
+        reg.gauge("router_replica_breaker",
+                  "circuit state: 0 closed, 1 half_open, 2 open"
+                  ).labels(replica=label).set(breaker_code[br.state()])
+        reg.gauge("router_replica_generation",
+                  "restart count of this replica slot"
+                  ).labels(replica=label).set(float(rep.generation))
+        sched = rep.sched
+        reg.gauge("router_replica_queue_depth",
+                  "waiting requests on the replica's scheduler"
+                  ).labels(replica=label).set(float(len(sched.queue)))
+        reg.gauge("router_replica_degradation_level",
+                  "degradation-ladder level reported by the replica"
+                  ).labels(replica=label).set(
+                      float(h.get("degradation_level", 0)))
+        reg.gauge("router_replica_generated_tokens",
+                  "tokens generated by the replica's scheduler"
+                  ).labels(replica=label).set(
+                      float(sched.metrics.generated_tokens))
+
+    # ---- hang + death handling ----------------------------------------
+
+    def _hung(self, rep: ServingReplica) -> bool:
+        """Unfinished work + no completed step for far longer than the
+        replica's own EWMA step time (absolute floor when cold)."""
+        if rep.dead or not rep.sched.has_unfinished():
+            return False
+        idle = rep.idle_age_s()
+        wd = getattr(rep.sched, "_watchdog", None)
+        ewma = wd.ewma if wd is not None else None
+        if ewma is not None and ewma > 0.0:
+            return idle > min(self.hang_abs_s,
+                              max(self.hang_factor * ewma, 0.05))
+        return idle > self.hang_abs_s
+
+    def probe_all(self) -> List[Dict[str, object]]:
+        """One supervision pass: hang-check, reap the dead, probe all."""
+        report = []
+        for rep in self.replicas:
+            if self._hung(rep):
+                rep.crash(RuntimeError(
+                    f"replica {rep.replica_id} hung: "
+                    f"{rep.idle_age_s():.3f}s since last step "
+                    f"with unfinished work"))
+            if rep.dead:
+                with self._lock:
+                    reaped_gen = self._reaped.get(rep.replica_id)
+                if reaped_gen != rep.generation:
+                    self._reap(rep)
+            report.append(self.probe(rep))
+        return report
+
+    def _reap(self, rep: ServingReplica) -> None:
+        """Drain a dead replica's committed view, free its KV pool, trip
+        its breaker, optionally restart it, then hand the exported specs
+        to the failover callback."""
+        gen = rep.generation
+        with self._lock:
+            self._reaped[rep.replica_id] = gen
+        specs = rep.sched.export_restartable()
+        self.breakers[rep.replica_id].record_open()
+        if self.restart_policy:
+            rep.restart(warmup_source=self.warmup_source)
+            with self._lock:
+                self._restarts += 1
+            if self.metrics is not None:
+                self.metrics.registry.counter(
+                    "router_replica_restarts_total",
+                    "dead replicas restarted by the supervisor").inc()
+        if self.on_failover is not None:
+            self.on_failover(rep, gen, specs)
+
+    # ---- routing gate --------------------------------------------------
+
+    def routable(self, rep: ServingReplica) -> bool:
+        """May the router place NEW work on this replica? Health gates
+        compose: alive, not mid-reload, breaker not open, scheduler not
+        draining."""
+        return (not rep.dead
+                and not rep.reloading
+                and self.breakers[rep.replica_id].allows()
+                and not rep.sched.is_draining)
+
+    def snapshot(self) -> Dict[str, object]:
+        with self._lock:
+            return {
+                "probes": self._probes,
+                "restarts": self._restarts,
+                "reaped": dict(self._reaped),
+                "breakers": {rid: br.state()
+                             for rid, br in self.breakers.items()},
+            }

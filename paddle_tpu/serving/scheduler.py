@@ -305,33 +305,40 @@ class ContinuousBatchingScheduler:
             raise ValueError(
                 f"request needs {total} tokens but the window/pool caps at "
                 f"{min(self.max_seq_len, cap)}")
-        if self._draining:
-            self.metrics.requests_rejected += 1
-            raise SchedulerOverloaded(
-                "scheduler is draining; not accepting new requests")
-        if self._ladder is not None and self._ladder.level >= LEVEL_REJECT:
-            self.metrics.requests_rejected += 1
-            raise SchedulerOverloaded(
-                f"overloaded: degradation ladder at {self._ladder.state!r} "
-                f"(kv_utilization="
-                f"{self.allocator.utilization():.2f}, "
-                f"queue_depth={len(self.queue)})")
-        rid = self._next_rid
-        self._next_rid += 1
-        req = Request(request_id=rid, prompt_ids=ids, max_new_tokens=mnt,
-                      eos_token_id=eos, priority=priority, on_token=on_token,
-                      deadline_s=deadline_s)
-        try:
-            self.queue.push(req)
-        except Exception:
-            self.metrics.requests_rejected += 1
-            raise
-        self.metrics.requests_received += 1
-        # trace timeline anchored at the request's own arrival stamp so
-        # phase durations and TTFT/E2E share one clock origin
-        self.tracer.start(rid, t=req.arrival_t, prompt_tokens=len(ids),
-                          priority=priority)
-        return rid
+        # admission mutates queue/rid state shared with whichever thread
+        # drives step() — a router thread submits while replica drivers
+        # decode, so the whole accept-or-reject decision runs under the
+        # (reentrant) engine lock
+        with self._elock:
+            if self._draining:
+                self.metrics.requests_rejected += 1
+                raise SchedulerOverloaded(
+                    "scheduler is draining; not accepting new requests")
+            if (self._ladder is not None
+                    and self._ladder.level >= LEVEL_REJECT):
+                self.metrics.requests_rejected += 1
+                raise SchedulerOverloaded(
+                    f"overloaded: degradation ladder at "
+                    f"{self._ladder.state!r} (kv_utilization="
+                    f"{self.allocator.utilization():.2f}, "
+                    f"queue_depth={len(self.queue)})")
+            rid = self._next_rid
+            self._next_rid += 1
+            req = Request(request_id=rid, prompt_ids=ids,
+                          max_new_tokens=mnt, eos_token_id=eos,
+                          priority=priority, on_token=on_token,
+                          deadline_s=deadline_s)
+            try:
+                self.queue.push(req)
+            except Exception:
+                self.metrics.requests_rejected += 1
+                raise
+            self.metrics.requests_received += 1
+            # trace timeline anchored at the request's own arrival stamp so
+            # phase durations and TTFT/E2E share one clock origin
+            self.tracer.start(rid, t=req.arrival_t, prompt_tokens=len(ids),
+                              priority=priority)
+            return rid
 
     def _on_evicted_blocks(self, n: int):
         self._step_evicted += n
@@ -468,6 +475,12 @@ class ContinuousBatchingScheduler:
         already queued or running finishes normally. ``health()`` reports
         ``draining`` until the engine empties."""
         self._draining = True
+
+    @property
+    def is_draining(self) -> bool:
+        """True after ``start_drain()`` (or export): finishing existing
+        work, admitting nothing new — routers must place elsewhere."""
+        return self._draining
 
     def attach_driver(self, thread):
         """Register the thread driving ``step()`` so ``health()`` can report
@@ -1208,6 +1221,105 @@ class ContinuousBatchingScheduler:
                 self.cancel(self._slots[s].request_id, cause="user")
                 cancelled += 1
         return {"drained_in_flight": drained, "cancelled": cancelled}
+
+    # ---- replica failover (router drain/export hooks) ------------------
+
+    def export_restartable(self) -> List[Dict[str, object]]:
+        """Decommission this scheduler and return every accepted-but-
+        unfinished request as a restartable spec — the router's
+        token-identical failover source. Committed work is preserved: the
+        in-flight pipeline drains first (the drain thread is independent of
+        any dead driver thread, so already-dispatched steps still land),
+        then each queued or running request is exported carrying its
+        prompt, its COMMITTED generated prefix, and its ORIGINAL
+        arrival/deadline budget. Replaying ``prompt + prefix`` on a
+        survivor is the same pure-recompute path as preemption resume, so
+        the continued stream is bit-identical to an uninterrupted run.
+        Every KV block returns to the pool and the prefix cache is flushed:
+        after export the pool is provably leak-free and the scheduler
+        admits nothing new (``_draining``)."""
+        specs: List[Dict[str, object]] = []
+        with self._elock:
+            try:
+                self._drain_all()
+            except BaseException:        # noqa: BLE001 — poisoned pipeline:
+                # committed state up to the poison point is still exact;
+                # dropping undrained entries loses only device-resident
+                # speculation no caller ever observed
+                self._inflight.clear()
+                self._carry = None
+            self._draining = True
+            self._drain_stop = True
+            self._elock.notify_all()
+            for req in list(self.queue._items):
+                self.queue.remove(req.request_id)
+                specs.append(self._export_spec(req))
+            for s in range(len(self._slots)):
+                req = self._slots[s]
+                if req is None:
+                    continue
+                specs.append(self._export_spec(req))
+                self.allocator.free(req.blocks)
+                req.blocks = []
+                req.slot = -1
+                self._slots[s] = None
+                self._table[s] = -1
+                self._pos[s] = 0
+                self._next_tok[s] = 0
+                self._disp_pos[s] = 0
+                self._disp_emitted[s] = 0
+        if self.prefix_cache is not None:
+            self.prefix_cache.flush()
+        return specs
+
+    @staticmethod
+    def _export_spec(req: Request) -> Dict[str, object]:
+        return {
+            "request_id": req.request_id,
+            "prompt_ids": np.asarray(req.prompt_ids, np.int64).copy(),
+            "out_tokens": list(req.out_tokens),
+            "max_new_tokens": req.max_new_tokens,
+            "eos_token_id": req.eos_token_id,
+            "priority": req.priority,
+            "arrival_t": req.arrival_t,
+            "first_token_t": req.first_token_t,
+            "deadline_s": req.deadline_s,
+            "num_preemptions": req.num_preemptions,
+        }
+
+    def import_resumed(self, spec: Dict[str, object], on_token=None) -> int:
+        """Adopt one exported spec (see ``export_restartable``): the
+        request enters this scheduler's queue carrying its committed
+        generated prefix (the next admission prefills
+        ``prompt + prefix`` — the preemption-resume path, token-identical)
+        and its ORIGINAL arrival clock, so ``deadline_s`` and queue-TTL
+        keep measuring from first admission, not from the failover.
+        Bypasses admission control (``force=True``): the request was
+        already accepted once, a survivor must not re-reject it. Returns
+        this scheduler's request id for it."""
+        with self._elock:
+            rid = self._next_rid
+            self._next_rid += 1
+            req = Request(
+                request_id=rid,
+                prompt_ids=np.asarray(spec["prompt_ids"], np.int64),
+                max_new_tokens=int(spec["max_new_tokens"]),
+                eos_token_id=spec.get("eos_token_id"),
+                priority=int(spec.get("priority", 0)),
+                on_token=on_token,
+                deadline_s=spec.get("deadline_s"))
+            req.out_tokens = list(spec.get("out_tokens", ()))
+            req.arrival_t = float(spec["arrival_t"])
+            req.first_token_t = spec.get("first_token_t")
+            # resume-first queue placement + honest accounting: a failover
+            # replay IS a recompute resume
+            req.num_preemptions = int(spec.get("num_preemptions", 0)) + 1
+            self.queue.push(req, force=True)
+            self.metrics.requests_received += 1
+            self.tracer.start(rid, t=req.arrival_t,
+                              prompt_tokens=len(req.prompt_ids),
+                              priority=req.priority)
+            return rid
 
     # ---- public loop ---------------------------------------------------
 

@@ -21,15 +21,23 @@ on-vs-off overhead/token-identity measurement -> BENCH_serving_obs.json.
 ``--chaos`` runs the resilience suite (seeded fault-rate sweep,
 fault-window recovery with token identity, cancellations, disarmed-inject
 overhead budget) -> BENCH_serving_chaos.json; ``--fault-rate``/
-``--cancel-rate`` run one chaos scenario at those rates. Every mode
-leaves a truthful artifact: a run that dies mid-bench writes the partial
-JSON with ``"completed": false`` plus the error before re-raising.
+``--cancel-rate`` run one chaos scenario at those rates.
+
+``--replicas N`` runs the multi-replica router suite (tokens/s scaling vs
+1 replica, a ``--kill-at T`` replica-kill failover drill with token
+identity vs the single-replica oracle + goodput recovery-to-baseline,
+prefix-affinity hit rate vs round-robin) -> BENCH_serving_router.json.
+
+Every mode leaves a truthful artifact: a run that dies mid-bench quiesces
+every live scheduler/replica and writes the partial JSON with
+``"completed": false`` plus the error before re-raising.
 
   python tools/serve_bench.py --smoke           # fast CI check, tiny load
   python tools/serve_bench.py --requests 64 --rate 0.7 --tight-pool
   python tools/serve_bench.py --smoke --observability
   python tools/serve_bench.py --smoke --chaos
   python tools/serve_bench.py --smoke --fault-rate 0.25 --cancel-rate 0.2
+  python tools/serve_bench.py --smoke --replicas 3 --kill-at 6
 """
 
 from __future__ import annotations
@@ -53,10 +61,46 @@ from tools.bench_io import write_bench_json  # noqa: E402
 # pipeline would otherwise leave device work and blocks in flight
 _LIVE_SCHEDS: "weakref.WeakSet" = weakref.WeakSet()
 
+# routers a bench runner constructs: on a mid-bench death every replica
+# behind every live router must quiesce too (the router-mode acceptance
+# criterion: partial-artifact-on-death quiesces EVERY replica)
+_LIVE_ROUTERS: "weakref.WeakSet" = weakref.WeakSet()
+
 
 def _track(sched):
     _LIVE_SCHEDS.add(sched)
     return sched
+
+
+def _track_router(router):
+    _LIVE_ROUTERS.add(router)
+    return router
+
+
+def _quiesce_live_routers() -> list:
+    """Crash-path cleanup for router mode: shut every live router down
+    (drivers stopped, every replica scheduler drained + cancelled) and
+    report per-replica leak counts. Never raises."""
+    report = []
+    for router in list(_LIVE_ROUTERS):
+        entry = {"replicas": len(router.replicas),
+                 "drained_in_flight": None, "cancelled": None,
+                 "blocks_leaked": None, "error": None}
+        try:
+            counts = router.shutdown()
+            entry.update(counts)
+            leaked = 0
+            for rep in router.replicas:
+                sched = rep.sched
+                if sched.prefix_cache is not None:
+                    sched.prefix_cache.flush()
+                leaked += (sched.config.total_blocks
+                           - sched.allocator.num_free_blocks)
+            entry["blocks_leaked"] = leaked
+        except BaseException as exc:  # noqa: BLE001
+            entry["error"] = f"{type(exc).__name__}: {exc}"
+        report.append(entry)
+    return report
 
 
 def _quiesce_live_schedulers() -> list:
@@ -743,6 +787,291 @@ def run_chaos_suite(smoke: bool = True, out_dir: str = REPO_ROOT,
     return artifact
 
 
+def run_router_load(num_replicas: int = 3, num_requests: int = 18,
+                    rate: float = 1.0, seed: int = 0,
+                    max_num_seqs: int = 2, block_size: int = 8,
+                    max_seq_len: int = 64, num_layers: int = 1,
+                    prompt_lens=(4, 12), new_tokens=(4, 8),
+                    prefix_groups: int = 0, prefix_len: int = 16,
+                    policy: str = "affinity",
+                    kill_at=None, kill_replica: int = 0,
+                    cooldown_s: float = 0.02,
+                    enable_prefix_caching: bool = True) -> dict:
+    """One synthetic Poisson load through a ``ServingRouter``; returns the
+    artifact dict.
+
+    ``prefix_groups > 0`` makes requests share long prompt prefixes in
+    round-robin groups (the cache-affinity workload: with ``affinity``
+    routing each group pins to one replica's radix tree). ``kill_at`` (an
+    iteration index) crashes ``kill_replica`` mid-run — the supervisor
+    reaps it, fails its work over to survivors, and restarts it; every
+    accepted request must still reach a terminal state, the dead replica's
+    pool must come back leak-free, and the rid-ordered token digest is
+    comparable against a 1-replica run of the same workload (greedy
+    streams are placement-independent — the failover identity oracle)."""
+    import hashlib
+    from collections import Counter
+
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu.models import GPTForCausalLM, gpt_tiny
+    from paddle_tpu.serving import (
+        ContinuousBatchingScheduler,
+        SchedulerConfig,
+        SchedulerOverloaded,
+        ServingRouter,
+    )
+
+    paddle.seed(7)
+    model = GPTForCausalLM(gpt_tiny(num_layers=num_layers))
+
+    def factory():
+        return _track(ContinuousBatchingScheduler(model, SchedulerConfig(
+            max_num_seqs=max_num_seqs, max_seq_len=max_seq_len,
+            block_size=block_size,
+            enable_prefix_caching=enable_prefix_caching)))
+
+    router = _track_router(ServingRouter(
+        factory, num_replicas=num_replicas, policy=policy,
+        cooldown_s=cooldown_s, affinity_tokens=block_size))
+
+    rng = np.random.default_rng(seed)
+    gaps = rng.exponential(1.0 / max(rate, 1e-6), num_requests)
+    arrive_at = np.cumsum(gaps)
+    plens = rng.integers(prompt_lens[0], prompt_lens[1] + 1, num_requests)
+    nnew = rng.integers(new_tokens[0], new_tokens[1] + 1, num_requests)
+    if prefix_groups > 0:
+        shared = [rng.integers(0, 1000, prefix_len)
+                  for _ in range(prefix_groups)]
+        # seeded RANDOM group per request — a cyclic i%groups assignment
+        # would accidentally align with round-robin placement and hide
+        # the affinity win the suite measures
+        grp = rng.integers(0, prefix_groups, num_requests)
+        prompts = [np.concatenate([shared[int(grp[i])],
+                                   rng.integers(0, 1000, int(p))])
+                   for i, p in enumerate(plens)]
+    else:
+        prompts = [rng.integers(0, 1000, int(p)) for p in plens]
+
+    tok_box = [0]
+    stream_counts = {}
+
+    def on_token(rid, tok):
+        stream_counts[rid] = stream_counts.get(rid, 0) + 1
+        tok_box[0] += 1
+
+    tokens_per_it = []
+    rejected = 0
+    killed_at_it = None
+    t0 = time.perf_counter()
+    it, injected = 0, 0
+    rids = []
+    while injected < num_requests or router.has_unfinished():
+        while injected < num_requests and arrive_at[injected] <= it:
+            i = injected
+            try:
+                rids.append(router.submit(prompts[i],
+                                          max_new_tokens=int(nnew[i]),
+                                          on_token=on_token))
+            except SchedulerOverloaded:
+                rejected += 1
+            injected += 1
+        if kill_at is not None and it == kill_at:
+            router.crash_replica(kill_replica)
+            killed_at_it = it
+        tok_box[0] = 0
+        router.step()
+        tokens_per_it.append(tok_box[0])
+        it += 1
+        if it > 100000:
+            raise RuntimeError("router load did not drain")
+    wall = time.perf_counter() - t0
+    router.shutdown()
+
+    outs = {rid: router.get_finished(rid) for rid in rids}
+    missing = [rid for rid, o in outs.items() if o is None]
+    assert not missing, f"requests without terminal state: {missing}"
+    census = Counter(o.finish_reason for o in outs.values())
+    # streaming across failover: callbacks saw each generated token once
+    for rid, out in outs.items():
+        assert stream_counts.get(rid, 0) == len(out.generated_ids), (
+            f"rid {rid}: streamed {stream_counts.get(rid, 0)} vs "
+            f"{len(out.generated_ids)} generated")
+    # zero leaks on EVERY replica pool, the reaped-and-restarted one
+    # included (its old pool was freed by export_restartable)
+    for rep in router.replicas:
+        sched = rep.sched
+        if sched.prefix_cache is not None:
+            sched.prefix_cache.flush()
+        assert (sched.allocator.num_free_blocks
+                == sched.config.total_blocks), (
+            f"replica {rep.replica_id} leaked "
+            f"{sched.config.total_blocks - sched.allocator.num_free_blocks}"
+            f" blocks")
+
+    digest = hashlib.sha1()
+    for rid in sorted(outs):
+        digest.update(np.asarray(outs[rid].token_ids, np.int64).tobytes())
+    done = census.get("eos", 0) + census.get("length", 0)
+
+    # aggregate prefix-cache hit rate over every replica that served
+    hit = miss = 0
+    for rep in router.replicas:
+        pc = rep.sched.prefix_cache
+        if pc is not None:
+            s = pc.stats()
+            hit += s["hit_tokens"]
+            miss += s["miss_tokens"]
+    dbg = router.debug_state()
+    gen_tokens = int(router.metrics.generated_tokens)
+    return {
+        "bench": "serving_router_load",
+        "config": {
+            "num_replicas": num_replicas, "num_requests": num_requests,
+            "rate": rate, "seed": seed, "max_num_seqs": max_num_seqs,
+            "block_size": block_size, "max_seq_len": max_seq_len,
+            "num_layers": num_layers, "prompt_lens": list(prompt_lens),
+            "new_tokens": list(new_tokens), "prefix_groups": prefix_groups,
+            "prefix_len": prefix_len, "policy": policy,
+            "kill_at": kill_at, "kill_replica": kill_replica,
+            "enable_prefix_caching": enable_prefix_caching,
+        },
+        "iterations": it,
+        "wall_s": round(wall, 3),
+        "tokens_per_s": round(gen_tokens / wall, 2) if wall > 0 else None,
+        "census": dict(census),
+        "rejected": rejected,
+        "goodput": round(done / num_requests, 4),
+        "tokens_per_iteration": tokens_per_it,
+        "killed_at_iteration": killed_at_it,
+        "outputs_sha1": digest.hexdigest(),
+        "prefix_cache_hit_rate": round(hit / (hit + miss), 4)
+                                 if (hit + miss) else None,
+        "router": dbg["router"],
+        "replicas": dbg["replicas"],
+        "supervisor": dbg["supervisor"],
+        "faults_by_site": router.metrics.faults_snapshot(),
+        "health": router.health(),
+        "metrics": router.metrics.snapshot(),
+    }
+
+
+def _busy_median(ts):
+    nz = sorted(t for t in ts if t > 0)
+    return nz[len(nz) // 2] if nz else 0
+
+
+def run_router_suite(smoke: bool = True, out_dir: str = REPO_ROOT,
+                     num_replicas: int = 3, kill_at=None) -> dict:
+    """The BENCH_serving_router artifact: multi-replica scaling vs one
+    replica, a replica-kill drill (token identity vs the 1-replica oracle,
+    goodput dip + recovery-to-baseline, zero leaks), and the prefix-
+    affinity hit-rate win vs round-robin. Writes
+    ``BENCH_serving_router.json``."""
+    kw = (dict(num_requests=24, rate=1.2, max_num_seqs=2, block_size=8,
+               max_seq_len=64, num_layers=1, prompt_lens=(4, 12),
+               new_tokens=(5, 8))
+          if smoke else
+          dict(num_requests=48, rate=1.0, max_num_seqs=4, block_size=8,
+               max_seq_len=128, num_layers=2, prompt_lens=(6, 24),
+               new_tokens=(8, 16)))
+    if kill_at is None:
+        kill_at = 6 if smoke else 12
+
+    # the single-replica oracle doubles as the scaling baseline
+    single = run_router_load(num_replicas=1, policy="affinity", **kw)
+
+    killed = run_router_load(num_replicas=num_replicas, policy="affinity",
+                             kill_at=kill_at, kill_replica=0, **kw)
+    token_identical = killed["outputs_sha1"] == single["outputs_sha1"]
+
+    # goodput dip + recovery: per-iteration token throughput around the
+    # kill. Recovery is the best SUSTAINED (busy-median window) post-kill
+    # throughput vs the pre-kill baseline — the run's tail is drain-down
+    # (arrivals exhausted, last requests finishing), which measures load,
+    # not capacity; what the drill must prove is that the fleet RETURNS
+    # to baseline once the restarted replica rejoins.
+    ts = killed["tokens_per_iteration"]
+    k = killed["killed_at_iteration"]
+    pre = _busy_median(ts[:k]) if k else 0
+    post_tail = _busy_median(ts[k:]) if k is not None else 0
+    W = 4
+    post_windows = ([_busy_median(ts[i:i + W])
+                     for i in range(k, max(k + 1, len(ts) - W + 1))]
+                    if k is not None else [])
+    post_best = max(post_windows, default=0)
+    recovery_pct = min(100.0, 100.0 * post_best / max(pre, 1e-9))
+    recovery_it = None
+    if k is not None and pre > 0:
+        for i, m in enumerate(post_windows):
+            if m >= 0.95 * pre:
+                recovery_it = i
+                break
+
+    # affinity vs round-robin on a shared-prefix workload: same load, same
+    # replicas, only the placement policy differs — the hit-rate gap is
+    # pure routing
+    akw = dict(kw)
+    akw["num_requests"] = max(kw["num_requests"], 12)
+    affinity = run_router_load(num_replicas=num_replicas, policy="affinity",
+                               prefix_groups=num_replicas,
+                               prefix_len=2 * kw["block_size"], **akw)
+    rr = run_router_load(num_replicas=num_replicas, policy="round_robin",
+                         prefix_groups=num_replicas,
+                         prefix_len=2 * kw["block_size"], **akw)
+    hit_aff = affinity["prefix_cache_hit_rate"] or 0.0
+    hit_rr = rr["prefix_cache_hit_rate"] or 0.0
+
+    artifact = {
+        "bench": "serving_router",
+        "config": {**kw, "num_replicas": num_replicas, "kill_at": kill_at,
+                   "seed": 0},
+        "scaling": {
+            "tokens_per_s_1_replica": single["tokens_per_s"],
+            "tokens_per_s_n_replicas": killed["tokens_per_s"],
+            "speedup_x": round(killed["tokens_per_s"]
+                               / max(single["tokens_per_s"], 1e-9), 3),
+            "note": "CPU smoke shares one host core budget across "
+                    "replicas; the number reports the router's overhead/"
+                    "scaling shape, device parallelism is the TPU story",
+        },
+        "kill_drill": {
+            "killed_at_iteration": k,
+            "goodput": killed["goodput"],
+            "census": killed["census"],
+            "token_identical_to_single_replica": token_identical,
+            "pre_kill_tokens_per_it": pre,
+            "post_kill_tail_tokens_per_it": post_tail,
+            "post_kill_best_window_tokens_per_it": post_best,
+            "recovery_pct_of_baseline": round(recovery_pct, 2),
+            "recovered_95pct": recovery_pct >= 95.0,
+            "recovery_time_iterations": recovery_it,
+            "failovers": killed["router"]["failovers"],
+            "requests_failed_over": killed["router"]["requests_failed_over"],
+            "restarts": killed["supervisor"]["restarts"],
+            "breakers_after": killed["supervisor"]["breakers"],
+            "replica_generations": [r["generation"]
+                                    for r in killed["replicas"]],
+        },
+        "affinity_vs_round_robin": {
+            "hit_rate_affinity": hit_aff,
+            "hit_rate_round_robin": hit_rr,
+            "hit_rate_win": round(hit_aff - hit_rr, 4),
+            "affinity_not_worse": hit_aff >= hit_rr - 1e-9,
+            "routed_decisions": affinity["router"],
+        },
+        "within_budget": (token_identical and recovery_pct >= 95.0
+                          and killed["goodput"] == 1.0
+                          and hit_aff >= hit_rr - 1e-9),
+        "completed": True,
+    }
+    out_path = os.path.join(out_dir, "BENCH_serving_router.json")
+    write_bench_json(out_path, artifact)
+    artifact["artifact"] = out_path
+    return artifact
+
+
 def measure_observability_overhead(**load_kw) -> dict:
     """Metrics-path overhead on the serving smoke workload.
 
@@ -1010,6 +1339,15 @@ def main(argv=None) -> dict:
                          "given no values): per-depth wall/TPOT/host-stall "
                          "share + cross-depth token identity -> "
                          "BENCH_serving_async.json")
+    ap.add_argument("--replicas", type=int, default=None,
+                    help="multi-replica router suite over N scheduler "
+                         "replicas: tokens/s scaling vs 1 replica, "
+                         "replica-kill failover drill (token identity, "
+                         "goodput recovery), affinity-vs-round-robin "
+                         "hit rate -> BENCH_serving_router.json")
+    ap.add_argument("--kill-at", type=int, default=None,
+                    help="router suite: crash replica 0 at this iteration "
+                         "of the kill drill (default: mid-run)")
     ap.add_argument("--flush-us", type=float, default=400.0,
                     help="modeled per-token client stream flush for the "
                          "--depth sweep, microseconds")
@@ -1024,7 +1362,8 @@ def main(argv=None) -> dict:
     os.environ.pop("PALLAS_AXON_POOL_IPS", None)
 
     chaos = args.chaos or args.fault_rate > 0 or args.cancel_rate > 0
-    mode = ("async" if args.depth is not None else
+    mode = ("router" if args.replicas is not None else
+            "async" if args.depth is not None else
             "chaos" if chaos else "obs" if args.observability else
             "prefix" if args.prefix_share else
             "smoke" if args.smoke else "load")
@@ -1049,6 +1388,7 @@ def main(argv=None) -> dict:
             "bench": f"serving_{mode}",
             "completed": False,
             "error": f"{type(exc).__name__}: {exc}",
+            "quiesced_routers": _quiesce_live_routers(),
             "quiesced_schedulers": _quiesce_live_schedulers(),
             "config": dict(vars(args)),
         })
@@ -1056,6 +1396,28 @@ def main(argv=None) -> dict:
 
 
 def _run_mode(args, mode: str, out_path: str) -> dict:
+    if mode == "router":
+        artifact = run_router_suite(
+            smoke=args.smoke,
+            num_replicas=max(2, args.replicas),
+            kill_at=args.kill_at,
+            out_dir=os.path.dirname(out_path) or ".")
+        print(json.dumps({
+            "metric": "serving_router_recovery_pct",
+            "value": artifact["kill_drill"]["recovery_pct_of_baseline"],
+            "unit": "% of pre-kill per-iteration token throughput after "
+                    "a replica kill + supervised restart",
+            "token_identical_to_single_replica":
+                artifact["kill_drill"]["token_identical_to_single_replica"],
+            "goodput": artifact["kill_drill"]["goodput"],
+            "speedup_x": artifact["scaling"]["speedup_x"],
+            "affinity_hit_rate_win":
+                artifact["affinity_vs_round_robin"]["hit_rate_win"],
+            "within_budget": artifact["within_budget"],
+            "artifact": artifact["artifact"],
+        }))
+        return artifact
+
     if mode == "async":
         depths = tuple(args.depth) if args.depth else (0, 1, 2)
         artifact = run_async_sweep(
