@@ -944,6 +944,60 @@ def bench_lint(on_tpu):
     }))
 
 
+def bench_compare(on_tpu):
+    """PR-over-PR perf drift: diff every regenerated ``BENCH_*.json`` on
+    disk against its committed (HEAD) version with
+    ``tools/bench_compare.py``. Informational here — shared-host timing
+    noise must not flake the bench round, so ``within_budget`` stays
+    true and regressions are REPORTED per artifact; the CLI
+    (exit-nonzero) is the gate reviewers run across PR boundaries."""
+    import glob
+    import subprocess
+    import sys
+    import tempfile
+
+    from tools.bench_compare import compare_files
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    per_artifact = {}
+    compared = regressed = 0
+    for path in sorted(glob.glob(os.path.join(here, "BENCH_*.json"))):
+        rel = os.path.basename(path)
+        r = subprocess.run(["git", "show", f"HEAD:{rel}"], cwd=here,
+                           capture_output=True, text=True, timeout=60)
+        if r.returncode != 0:
+            per_artifact[rel] = "new (no committed baseline)"
+            continue
+        with tempfile.NamedTemporaryFile("w", suffix=".json",
+                                         delete=False) as f:
+            f.write(r.stdout)
+            old_path = f.name
+        try:
+            rep = compare_files(old_path, path)
+        except Exception as e:
+            per_artifact[rel] = f"uncomparable: {type(e).__name__}"
+            continue
+        finally:
+            os.unlink(old_path)
+        compared += 1
+        regressed += bool(rep["regressions"])
+        per_artifact[rel] = {
+            "regressions": [x["metric"] for x in rep["regressions"]],
+            "improvements": len(rep["improvements"]),
+            "within_tolerance": len(rep["drift"]),
+        }
+    print(json.dumps({
+        "metric": "bench_compare_artifacts_regressed",
+        "value": regressed,
+        "unit": f"of {compared} committed artifacts beyond 25% tolerance "
+                "vs HEAD (informational; gate = tools/bench_compare.py "
+                "exit status)",
+        "vs_baseline": None,
+        "per_artifact": per_artifact,
+        "within_budget": True,
+    }))
+
+
 def _probe_once(timeout_s):
     """Resolve the platform name in a THROWAWAY subprocess with a timeout.
 
@@ -1031,6 +1085,7 @@ for _f in (bench_chip_ceilings, bench_resnet50, bench_bert, bench_ernie,
            bench_ckpt,
            bench_train,
            bench_lint,
+           bench_compare,
            bench_gpt):  # headline LAST (tail-parsed by the driver)
     _register(_f)
 

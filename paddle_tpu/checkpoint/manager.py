@@ -221,6 +221,15 @@ class CheckpointManager:
         self._m_snap_s.observe(snap_s)
         pidx = _process_index()
 
+        # account the snapshot staging copies for as long as the writer
+        # holds them (async: until the background commit releases)
+        from paddle_tpu.observability.device_memory import (
+            get_device_ledger,
+            tree_nbytes,
+        )
+        staging = get_device_ledger().register(
+            "checkpoint_staging", f"step{step}", tree_nbytes(tree))
+
         with self._state_lock:
             self._active_tmp = tmp
 
@@ -229,6 +238,7 @@ class CheckpointManager:
                 self._write_and_commit(tmp, final, step, writes, md,
                                        extra_json, pidx, t0)
             finally:
+                staging.release()
                 with self._state_lock:
                     self._active_tmp = None
 

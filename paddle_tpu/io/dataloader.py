@@ -142,21 +142,45 @@ class DevicePrefetcher:
         )
         from paddle_tpu.profiler import RecordEvent, TracerEventType
 
+        from paddle_tpu.observability.device_memory import (
+            get_device_ledger,
+            tree_nbytes,
+        )
+
         if self._resumed:
             self._resumed = False  # a resume keeps its mid-epoch cursor
         else:
             self._consumed = 0  # fresh epoch (mirrors DataLoader.__iter__)
+
+        # device-ledger accounting: the prefetch stage owns up to
+        # depth queued + 1 in-hand device-resident batches. Sized once
+        # from the first transferred batch, released when the iterator
+        # winds down — nothing per-batch beyond an `is None` check.
+        ledger_handle = None
+
+        def _account(out):
+            nonlocal ledger_handle
+            if ledger_handle is None:
+                ledger_handle = get_device_ledger().register(
+                    "prefetch_buffers", "DevicePrefetcher",
+                    tree_nbytes(out) * (self.depth + 1))
+
         if self.depth == 0:
             # inline single-buffered path: transfer on the consumer, fully
             # exposed — the stall metric shows what prefetch removes
-            for batch in self.loader:
-                t0 = time.perf_counter()
-                out = self._to_device(batch)
-                record_input_stall(time.perf_counter() - t0)
-                self._consumed += 1
-                yield out
-            self._epoch += 1
-            self._consumed = 0
+            try:
+                for batch in self.loader:
+                    t0 = time.perf_counter()
+                    out = self._to_device(batch)
+                    record_input_stall(time.perf_counter() - t0)
+                    _account(out)
+                    self._consumed += 1
+                    yield out
+                self._epoch += 1
+                self._consumed = 0
+            finally:
+                if ledger_handle is not None:
+                    ledger_handle.release()
             return
 
         q: "queue.Queue" = queue.Queue(maxsize=self.depth)
@@ -195,9 +219,12 @@ class DevicePrefetcher:
                     return
                 if isinstance(item, _PrefetchError):
                     raise item.exc
+                _account(item)
                 self._consumed += 1
                 yield item
         finally:
+            if ledger_handle is not None:
+                ledger_handle.release()
             stop.set()
             # unblock a producer stuck on a full queue
             try:

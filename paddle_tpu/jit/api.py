@@ -33,6 +33,7 @@ from paddle_tpu.observability.compile_tracker import (
     get_compile_tracker,
     next_tracked_name,
 )
+from paddle_tpu.observability.program_inventory import get_program_inventory
 from paddle_tpu.tensor import Tensor
 
 
@@ -73,6 +74,8 @@ class StaticFunction:
             donate += (1,)
         if donate_args:
             donate += (2,)
+        self._donate_argnums = donate
+        self._seen_programs = 0   # ProgramInventory capture high-water mark
         self._jitted = jax.jit(self._traced, static_argnames=("training",),
                                donate_argnums=donate)
         self._jitted_checked = None  # built lazily when nan/inf debug is on
@@ -195,6 +198,19 @@ class StaticFunction:
                 out_vals, new_buffer_vals = self._jitted(
                     param_vals, buffer_vals, arg_vals, kwarg_vals, key,
                     training)
+                # ProgramInventory capture: cache growth means this call
+                # compiled a fresh program — record its specs (shape-only;
+                # donated leaves are aval-readable shells by now) so cost
+                # analysis can re-lower it later without touching the
+                # runtime cache. One int compare per steady-state call.
+                n_now = _jit_cache_size(self._jitted)
+                if n_now != self._seen_programs:
+                    self._seen_programs = n_now
+                    get_program_inventory().capture(
+                        self._tracker_name, "static_function", self._jitted,
+                        (param_vals, buffer_vals, arg_vals, kwarg_vals, key),  # graft-lint: disable=donation-alias
+                        {"training": training},
+                        donate_argnums=self._donate_argnums)
             for b, v in zip(buffers, new_buffer_vals):
                 b._replace_value(v)
             return tree_wrap(out_vals)
@@ -474,6 +490,8 @@ class TrainStep:
         if donate and donate_inputs:
             self._donate_argnums += (4,)
         self._last_donated = None  # shells of last call's donated buffers
+        self._seen_programs = 0    # ProgramInventory capture high-water mark
+        self._ledger_handles = None  # weights/slots/masters, registered once
         self._jitted = None  # built at first call (out_shardings need state)
         self._tracker_name = next_tracked_name(
             f"TrainStep[{type(model).__name__}]")
@@ -921,6 +939,34 @@ class TrainStep:
                         batch, is_leaf=lambda x: isinstance(x, Tensor)):
                     if isinstance(leaf, Tensor):
                         leaf._replace_value(_DonatedValue())
+        # device observability: record this step's program specs on cache
+        # growth (cost inventory) and account weights / optimizer slots /
+        # fp32 masters with the device ledger exactly once — steady-state
+        # cost is one int compare and one is-None check
+        n_now = _jit_cache_size(self._jitted)
+        if n_now != self._seen_programs:
+            self._seen_programs = n_now
+            get_program_inventory().capture(
+                self._tracker_name, "train_step", self._jitted,
+                (param_vals, opt_states, master_vals, buffer_vals,  # graft-lint: disable=donation-alias
+                 batch_vals, lr, key, scale),  # graft-lint: disable=donation-alias
+                donate_argnums=self._donate_argnums)
+        if self._ledger_handles is None:
+            from paddle_tpu.observability.device_memory import (
+                get_device_ledger,
+                tree_nbytes,
+            )
+
+            led = get_device_ledger()
+            self._ledger_handles = (
+                led.register("model_weights", self._tracker_name,
+                             tree_nbytes(new_params)),
+                led.register("optimizer_slots", self._tracker_name,
+                             tree_nbytes(new_states)),
+                led.register("fp32_masters", self._tracker_name,
+                             tree_nbytes([m for m in new_masters
+                                          if m is not None])),
+            )
         offload_params = getattr(self._opt, "_offload_params", False)
         for p, v in zip(params, new_params):
             p._replace_value(v)
@@ -957,3 +1003,14 @@ class TrainStep:
         if self._has_aux:
             return loss_t, tree_wrap(aux_vals)
         return loss_t
+
+    def __del__(self):
+        # return this step's weights/slots/masters bytes to the ledger so
+        # short-lived TrainSteps (bench phases, tests) don't accumulate;
+        # release() is idempotent, but interpreter teardown may reach the
+        # ledger after its module globals are already gone
+        for h in (getattr(self, "_ledger_handles", None) or ()):
+            try:
+                h.release()
+            except Exception:  # graft-lint: disable=swallowed-exception
+                pass

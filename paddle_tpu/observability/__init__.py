@@ -29,9 +29,23 @@ serving, and distributed code:
   the serving hot loop (admission / radix_match / block_accounting /
   streaming / sampling_sync), plus a per-step ring buffer dumped on demand
   or on alarm (``TTFTBreachStorm``, ``EvictionThrash``).
+- **Device memory ledger** (``device_memory.py``): every framework-owned
+  device allocation site (KV pool, prefix-pinned blocks, weights,
+  optimizer slots, fp32 masters, prefetch double-buffers, checkpoint
+  staging) registers an owner-tagged footprint →
+  ``device_memory_bytes{owner=...}`` live/watermark gauges, a queryable
+  census, and OOM forensics (owner census + flight-recorder tail attached
+  to the failing exception).
+- **Program inventory** (``program_inventory.py``): XLA
+  ``cost_analysis()``/``memory_analysis()`` for every compiled executable
+  the CompileTracker sees (TrainStep, SlotStep decode, prefill buckets) —
+  FLOPs, bytes accessed, peak temp memory, donation map — plus the
+  ``DeviceTimeSampler`` + ``roofline_utilization`` pair that turns them
+  into ``train_mfu`` / ``serving_decode_bandwidth_util``.
 - **Live endpoint** (``endpoint.py``): stdlib-http ``/metrics`` (Prometheus
-  text across registries) + ``/debug/requests`` (live request table, stall
-  breakdown, SLO accounting, flight-recorder dump) + ``/healthz``.
+  text across registries) + ``/debug`` index (``/debug/requests``,
+  ``/debug/replicas``, ``/debug/programs``, ``/debug/memory``) +
+  ``/healthz``.
 
 Typical use::
 
@@ -53,6 +67,13 @@ from paddle_tpu.observability.compile_tracker import (  # noqa: F401
     abstract_signature,
     get_compile_tracker,
 )
+from paddle_tpu.observability.device_memory import (  # noqa: F401
+    DeviceMemoryLedger,
+    LedgerHandle,
+    OWNERS,
+    get_device_ledger,
+    tree_nbytes,
+)
 from paddle_tpu.observability.endpoint import (  # noqa: F401
     ObservabilityEndpoint,
 )
@@ -63,6 +84,13 @@ from paddle_tpu.observability.metrics import (  # noqa: F401
     MetricsRegistry,
     get_registry,
     parse_prometheus_text,
+)
+from paddle_tpu.observability.program_inventory import (  # noqa: F401
+    DeviceTimeSampler,
+    ProgramInventory,
+    chip_specs,
+    get_program_inventory,
+    roofline_utilization,
 )
 from paddle_tpu.observability.request_trace import (  # noqa: F401
     RequestTrace,
@@ -86,12 +114,17 @@ __all__ = [
     "CompileEvent",
     "CompileTracker",
     "Counter",
+    "DeviceMemoryLedger",
+    "DeviceTimeSampler",
     "EvictionThrash",
     "FlightRecorder",
     "Gauge",
     "Histogram",
+    "LedgerHandle",
     "MetricsRegistry",
+    "OWNERS",
     "ObservabilityEndpoint",
+    "ProgramInventory",
     "RecompileStorm",
     "RequestTrace",
     "RequestTracer",
@@ -99,9 +132,14 @@ __all__ = [
     "ServingStall",
     "TTFTBreachStorm",
     "abstract_signature",
+    "chip_specs",
     "get_compile_tracker",
+    "get_device_ledger",
+    "get_program_inventory",
     "get_registry",
     "parse_prometheus_text",
+    "roofline_utilization",
+    "tree_nbytes",
     "record_input_stall",
     "record_sync_stall",
     "set_offload_overlap_ratio",

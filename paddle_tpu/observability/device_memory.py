@@ -1,0 +1,300 @@
+"""DeviceMemoryLedger: owner-tagged accounting of framework device bytes.
+
+Every framework-owned device allocation site registers its footprint here
+under an *owner* tag — KV-pool blocks, prefix-cache pinned blocks, model
+weights, optimizer slots, fp32 masters, prefetcher double-buffers,
+checkpoint snapshot staging — so "where do HBM bytes go" has one queryable
+answer (`/debug/memory`, `device_memory_bytes{owner=...}` gauges) instead
+of a post-mortem guess.
+
+Design rules (the same hot-path discipline as the rest of observability/):
+
+- **Coarse logical bookkeeping, not a per-buffer allocator shim.** Sites
+  register once at construction (or resize at the few places a footprint
+  legitimately changes, e.g. prefix-cache pin/evict) and release on
+  teardown. Nothing here runs per decode step or per training microstep,
+  so the <5% observability overhead budget is untouched.
+- **Owners can overlay.** Prefix-cache pinned blocks are a *view into*
+  the KV pool, not extra HBM — they register with ``overlay=True`` and
+  are excluded from the primary census sum so the census keeps matching
+  the pool+weights ground truth (pinned by test).
+- **OOM gets forensics, not a bare exception.** ``attach_forensics``
+  stamps the failing exception with the full owner census plus an
+  optional flight-recorder tail, and keeps the report on the ledger for
+  later scrape — the difference between "allocation failed" and "the KV
+  pool is 94% of HBM and the prefix cache pinned half of it".
+
+Ledgers are instantiable (a serving scheduler accounts on its own
+metrics registry so replica tests stay independent); train-side owners
+(TrainStep weights/optimizer slots, prefetcher, checkpoint staging) use
+the process-default ledger from ``get_device_ledger()``.
+"""
+
+from __future__ import annotations
+
+import threading
+import traceback
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from paddle_tpu.observability.metrics import MetricsRegistry, get_registry
+from paddle_tpu.profiler import RecordEvent
+
+__all__ = [
+    "DeviceMemoryLedger",
+    "LedgerHandle",
+    "OWNERS",
+    "get_device_ledger",
+    "tree_nbytes",
+]
+
+# Canonical owner tags. ``register`` accepts any string (new subsystems
+# should not need a ledger patch to account themselves), but these are the
+# tags the framework's own allocation sites use and the ones the docs and
+# the ledger-bypass lint rule talk about.
+OWNERS = (
+    "kv_pool",
+    "prefix_cache_pinned",
+    "model_weights",
+    "optimizer_slots",
+    "fp32_masters",
+    "prefetch_buffers",
+    "checkpoint_staging",
+)
+
+
+def _leaf_nbytes(leaf) -> int:
+    """Byte size of one array-ish leaf without touching device data.
+
+    Works on jax arrays (including donated/deleted shells — ``nbytes``
+    is aval-derived), numpy arrays, Tensors (unwrapped via ``_value``),
+    and ShapeDtypeStructs; anything non-array contributes 0.
+    """
+    import jax
+
+    v = leaf
+    if not isinstance(v, (jax.Array, np.ndarray)) and v is not None:
+        # unwrap Tensor-style holders only: jax arrays expose their own
+        # `_value` (a host materialization that RAISES on donated shells)
+        v = getattr(v, "_value", v)
+    if v is None:
+        return 0
+    nb = getattr(v, "nbytes", None)
+    if nb is not None:
+        try:
+            return int(nb)
+        except (TypeError, ValueError):
+            pass
+    shape = getattr(v, "shape", None)
+    dtype = getattr(v, "dtype", None)
+    if shape is None or dtype is None:
+        return 0
+    try:
+        return int(np.prod(shape, dtype=np.int64)) * np.dtype(dtype).itemsize
+    except (TypeError, ValueError):
+        return 0
+
+
+def tree_nbytes(tree) -> int:
+    """Total bytes across every array leaf of a pytree (no device sync)."""
+    import jax
+
+    return sum(_leaf_nbytes(leaf) for leaf in jax.tree_util.tree_leaves(tree))
+
+
+class LedgerHandle:
+    """One registered allocation: resize when the footprint changes,
+    release on teardown. Idempotent release; resize after release is a
+    no-op (teardown races in tests should not resurrect bytes)."""
+
+    __slots__ = ("owner", "name", "nbytes", "overlay", "_ledger", "_released")
+
+    def __init__(self, ledger: "DeviceMemoryLedger", owner: str, name: str,
+                 nbytes: int, overlay: bool):
+        self._ledger = ledger
+        self.owner = owner
+        self.name = name
+        self.nbytes = int(nbytes)
+        self.overlay = overlay
+        self._released = False
+
+    def resize(self, nbytes: int) -> None:
+        self._ledger._resize(self, int(nbytes))
+
+    def release(self) -> None:
+        self._ledger._release(self)
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging aid
+        state = "released" if self._released else f"{self.nbytes}B"
+        return f"LedgerHandle({self.owner}/{self.name}: {state})"
+
+
+class DeviceMemoryLedger:
+    """Owner-tagged live-bytes/watermark accounting with gauge export.
+
+    Thread contract: all mutation goes through one internal lock — sites
+    register/resize from the scheduler thread, the drain thread never
+    touches the ledger, and the endpoint scrape thread only reads
+    through ``census()``/``live_bytes()`` which also take the lock.
+    """
+
+    def __init__(self, registry: Optional[MetricsRegistry] = None):
+        self._lock = threading.Lock()
+        self._handles: List[LedgerHandle] = []
+        self._watermark: Dict[str, int] = {}
+        self._reg = registry
+        self.last_oom: Optional[dict] = None
+        if registry is not None:
+            self._g_live = registry.gauge(
+                "device_memory_bytes",
+                "live framework-owned device bytes per owner", unit="bytes")
+            self._g_peak = registry.gauge(
+                "device_memory_watermark_bytes",
+                "high-watermark of device_memory_bytes per owner",
+                unit="bytes")
+        else:
+            self._g_live = self._g_peak = None
+
+    # ---- registration ---------------------------------------------------
+
+    def register(self, owner: str, name: str, nbytes: int,
+                 overlay: bool = False) -> LedgerHandle:
+        """Account ``nbytes`` of device memory under ``owner``.
+
+        ``overlay=True`` marks bytes that alias another owner's
+        allocation (prefix-pinned KV blocks live inside the kv_pool):
+        they get their own gauge series but are excluded from the
+        primary census sum.
+        """
+        h = LedgerHandle(self, str(owner), str(name), nbytes, bool(overlay))
+        with self._lock:
+            self._handles.append(h)
+            self._bump_locked(h.owner)
+        return h
+
+    def register_arrays(self, owner: str, name: str, tree,
+                        overlay: bool = False) -> LedgerHandle:
+        """``register`` sized from the array leaves of a pytree."""
+        return self.register(owner, name, tree_nbytes(tree), overlay=overlay)
+
+    def _resize(self, h: LedgerHandle, nbytes: int) -> None:
+        with self._lock:
+            if h._released:
+                return
+            h.nbytes = nbytes
+            self._bump_locked(h.owner)
+
+    def _release(self, h: LedgerHandle) -> None:
+        with self._lock:
+            if h._released:
+                return
+            h._released = True
+            try:
+                self._handles.remove(h)
+            except ValueError:  # pragma: no cover - double bookkeeping bug
+                pass
+            self._bump_locked(h.owner)
+
+    def _bump_locked(self, owner: str) -> None:
+        live = sum(h.nbytes for h in self._handles if h.owner == owner)
+        peak = max(self._watermark.get(owner, 0), live)
+        self._watermark[owner] = peak
+        if self._g_live is not None:
+            self._g_live.labels(owner=owner).set(live)
+            self._g_peak.labels(owner=owner).set(peak)
+
+    # ---- queries --------------------------------------------------------
+
+    def live_bytes(self, owner: Optional[str] = None,
+                   include_overlays: bool = False) -> int:
+        with self._lock:
+            return sum(
+                h.nbytes for h in self._handles
+                if (owner is None or h.owner == owner)
+                and (include_overlays or not h.overlay))
+
+    def watermark_bytes(self, owner: str) -> int:
+        with self._lock:
+            return self._watermark.get(owner, 0)
+
+    def census(self) -> Dict[str, dict]:
+        """Per-owner accounting: ``{owner: {bytes, watermark_bytes,
+        entries, overlay}}``. Overlay owners are reported (they answer
+        "who pinned what") but carry ``overlay: True`` so consumers can
+        sum primaries against a pool+weights ground truth."""
+        with self._lock:
+            out: Dict[str, dict] = {}
+            for h in self._handles:
+                row = out.setdefault(h.owner, {
+                    "bytes": 0, "entries": 0, "overlay": h.overlay,
+                    "watermark_bytes": self._watermark.get(h.owner, 0),
+                })
+                row["bytes"] += h.nbytes
+                row["entries"] += 1
+            for owner, peak in self._watermark.items():
+                out.setdefault(owner, {
+                    "bytes": 0, "entries": 0, "overlay": False,
+                    "watermark_bytes": peak,
+                })
+            return out
+
+    def census_report(self) -> dict:
+        """The ``/debug/memory`` face: census plus roll-up totals."""
+        census = self.census()
+        primary = sum(r["bytes"] for r in census.values() if not r["overlay"])
+        return {
+            "owners": census,
+            "total_bytes": primary,
+            "total_bytes_with_overlays":
+                sum(r["bytes"] for r in census.values()),
+            "last_oom": self.last_oom,
+        }
+
+    # ---- OOM forensics --------------------------------------------------
+
+    def oom_report(self, reason: str,
+                   flight_tail: Optional[list] = None) -> dict:
+        """Build (and retain) the allocation-failure forensics dump: the
+        full owner census at failure time plus the flight-recorder tail —
+        everything needed to answer "who was holding HBM when the
+        allocator said no" without reproducing the failure."""
+        with RecordEvent("device.oom_forensics"):
+            report = {
+                "reason": str(reason),
+                "census": self.census(),
+                "live_bytes_total": self.live_bytes(),
+                "flight_recorder_tail": list(flight_tail or ()),
+            }
+        self.last_oom = report
+        return report
+
+    def attach_forensics(self, exc: BaseException,
+                         flight_tail: Optional[list] = None) -> dict:
+        """Stamp ``exc`` with the owner census so the failure surfaces
+        with forensics attached instead of a bare exception; returns the
+        report. Never raises — forensics must not mask the real error."""
+        try:
+            report = self.oom_report(
+                f"{type(exc).__name__}: {exc}", flight_tail=flight_tail)
+            exc.device_memory_census = report  # type: ignore[attr-defined]
+            return report
+        except Exception:  # pragma: no cover - forensics must stay silent
+            return {"reason": "forensics-failed",
+                    "error": traceback.format_exc(limit=2)}
+
+
+_default_ledger: Optional[DeviceMemoryLedger] = None
+_default_lock = threading.Lock()
+
+
+def get_device_ledger() -> DeviceMemoryLedger:
+    """Process-default ledger on the default metrics registry (train-side
+    owners: TrainStep weights/optimizer slots, prefetcher, checkpoint
+    staging). Serving schedulers build their own on their per-instance
+    registry."""
+    global _default_ledger
+    with _default_lock:
+        if _default_ledger is None:
+            _default_ledger = DeviceMemoryLedger(registry=get_registry())
+        return _default_ledger

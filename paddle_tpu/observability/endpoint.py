@@ -20,6 +20,20 @@ without adding any dependency:
                           health/breaker/generation/load + prefix-cache
                           stats, supervisor reap/restart accounting, and
                           the router's failover counters.
+- ``GET /debug/programs`` JSON compiled-program inventory: every
+                          executable the process compiled (train steps,
+                          static functions, serving decode/prefill
+                          buckets) with its argument signature and XLA
+                          cost analysis — FLOPs, bytes accessed, peak
+                          temp memory, buffer/donation sizes.
+                          ``?analyze=0`` skips cost analysis (listing
+                          only, never compiles).
+- ``GET /debug/memory``   JSON device-memory census: owner-tagged live
+                          bytes and watermarks from the process-default
+                          ``DeviceMemoryLedger`` plus every attached
+                          scheduler's ledger, including any retained
+                          OOM-forensics report.
+- ``GET /debug``          JSON index of every debug route above.
 - ``GET /healthz``        truthful health: the worst state across every
                           attached health source, as a plain-text body —
                           ``ok`` / ``degraded`` (shed ladder engaged) /
@@ -70,6 +84,7 @@ class ObservabilityEndpoint:
         self._debug_sources: "Dict[str, Callable[[], dict]]" = {}
         self._health_sources: "Dict[str, Callable[[], dict]]" = {}
         self._replica_sources: "Dict[str, Callable[[], dict]]" = {}
+        self._memory_sources: "Dict[str, Callable[[], dict]]" = {}
         self._host = host
         self._port = int(port)
         self._server: Optional[ThreadingHTTPServer] = None
@@ -91,15 +106,24 @@ class ObservabilityEndpoint:
         across all sources. A source that raises counts as ``dead``."""
         self._health_sources[str(name)] = fn
 
+    def add_memory_source(self, name: str, fn: Callable[[], dict]):
+        """``fn()`` -> a ``DeviceMemoryLedger.census_report()``-shaped
+        dict, rendered under ``name`` in ``/debug/memory``."""
+        self._memory_sources[str(name)] = fn
+
     def add_scheduler(self, scheduler, name: Optional[str] = None):
         """Attach a ContinuousBatchingScheduler: its metrics registry feeds
-        ``/metrics``, ``debug_state()`` feeds ``/debug/requests``, and
-        ``health()`` feeds ``/healthz``."""
+        ``/metrics``, ``debug_state()`` feeds ``/debug/requests``,
+        ``health()`` feeds ``/healthz``, and (when device observability is
+        on) its ledger census feeds ``/debug/memory``."""
         self.add_registry(scheduler.metrics.registry)
         key = name or f"scheduler{len(self._debug_sources)}"
         self.add_debug_source(key, scheduler.debug_state)
         if hasattr(scheduler, "health"):
             self.add_health_source(key, scheduler.health)
+        ledger = getattr(scheduler, "device_ledger", None)
+        if ledger is not None:
+            self.add_memory_source(key, ledger.census_report)
         return self
 
     def add_router(self, router, name: Optional[str] = None):
@@ -146,6 +170,48 @@ class ObservabilityEndpoint:
             except Exception as e:  # a broken source must not 500 the page
                 out[name] = {"error": f"{type(e).__name__}: {e}"}
         return out
+
+    def debug_programs(self, analyze: bool = True) -> dict:
+        """The ``/debug/programs`` payload: the process-wide compiled-
+        program inventory with XLA cost analysis (FLOPs / bytes accessed /
+        peak temp memory / buffer+donation sizes) per executable."""
+        from paddle_tpu.observability.program_inventory import (
+            get_program_inventory,
+        )
+
+        return get_program_inventory().snapshot(analyze=analyze)
+
+    def debug_memory(self) -> dict:
+        """The ``/debug/memory`` payload: owner-tagged device-byte census
+        from the process-default ledger (train-side owners) plus every
+        attached scheduler's ledger."""
+        from paddle_tpu.observability.device_memory import get_device_ledger
+
+        out = {"default": get_device_ledger().census_report()}
+        for name, fn in self._memory_sources.items():
+            try:
+                out[name] = fn()
+            except Exception as e:  # a broken source must not 500 the page
+                out[name] = {"error": f"{type(e).__name__}: {e}"}
+        return out
+
+    DEBUG_ROUTES = {
+        "/metrics": "Prometheus text exposition across attached registries",
+        "/debug": "this index",
+        "/debug/requests": "live request tables, traces, stall breakdown, "
+                           "flight recorder (?last=N)",
+        "/debug/replicas": "per-router replica fleet view",
+        "/debug/programs": "compiled-program inventory with XLA cost "
+                           "analysis (?analyze=0 to skip analysis)",
+        "/debug/memory": "owner-tagged device-memory census + OOM "
+                         "forensics",
+        "/healthz": "worst health state across attached sources",
+    }
+
+    def debug_index(self) -> dict:
+        """The ``/debug`` payload: every registered route with a one-line
+        description, so the debug surface is discoverable from a curl."""
+        return {"routes": dict(self.DEBUG_ROUTES)}
 
     _HEALTH_ORDER = ("ok", "degraded", "draining", "dead")
 
@@ -205,14 +271,28 @@ class ObservabilityEndpoint:
                     body = json.dumps(ep.debug_replicas(),
                                       default=str, indent=2)
                     self._send(200, body, "application/json")
+                elif url.path == "/debug/programs":
+                    q = parse_qs(url.query)
+                    analyze = q.get("analyze", ["1"])[0] not in ("0",
+                                                                 "false")
+                    body = json.dumps(ep.debug_programs(analyze=analyze),
+                                      default=str, indent=2)
+                    self._send(200, body, "application/json")
+                elif url.path == "/debug/memory":
+                    body = json.dumps(ep.debug_memory(),
+                                      default=str, indent=2)
+                    self._send(200, body, "application/json")
+                elif url.path in ("/debug", "/debug/"):
+                    body = json.dumps(ep.debug_index(),
+                                      default=str, indent=2)
+                    self._send(200, body, "application/json")
                 elif url.path == "/healthz":
                     code, body = ep.health()
                     self._send(code, body, "text/plain")
                 else:
                     self._send(404, json.dumps(
-                        {"error": "not found", "routes":
-                         ["/metrics", "/debug/requests",
-                          "/debug/replicas", "/healthz"]}),
+                        {"error": "not found",
+                         "routes": sorted(ep.DEBUG_ROUTES)}),
                         "application/json")
 
         self._server = ThreadingHTTPServer((self._host, self._port), Handler)

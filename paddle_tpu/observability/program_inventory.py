@@ -1,0 +1,425 @@
+"""ProgramInventory: XLA cost/memory analysis for every compiled program.
+
+The CompileTracker already knows *when* each executable compiled
+(TrainStep, SlotStep decode, every prefill bucket); this module records
+*what each one costs*: FLOPs, bytes accessed, peak temp memory, argument
+and output buffer sizes, and the donation (aliasing) map — the numbers
+ROADMAP items 1 and 3 state their acceptance bars in.
+
+How it stays off the hot path:
+
+- **Capture is shape-only.** The jit wrappers call ``capture`` exactly
+  once per newly compiled program (they detect program-cache growth, the
+  same probe the CompileTracker uses) and hand over ShapeDtypeStruct
+  pytrees — no device buffers are retained, so donation and pool
+  rotation are untouched.
+- **Analysis is lazy and AOT.** ``analyze`` re-lowers the jitted
+  function against the captured specs via ``jit(...).lower().compile()``
+  and reads XLA's ``cost_analysis()`` / ``memory_analysis()``. AOT
+  lowering does NOT grow the wrapper's runtime program cache, so the
+  zero-steady-state-recompile invariant (and its RecompileStorm alarm)
+  cannot trip from a `/debug/programs` scrape. Results are cached on the
+  entry; the jitted reference is dropped after a successful analysis.
+
+``DeviceTimeSampler`` is the roofline's other half: host-timestamped
+decode step times that stay honest at every ``dispatch_depth`` (span =
+dispatch→drain-completion, inter = consecutive drain completions; the
+min of the two medians is the step-time estimate that is right in both
+regimes). Combined with inventory FLOPs/bytes and ``chip_specs()``
+peaks, ``roofline_utilization`` yields ``train_mfu`` and
+``serving_decode_bandwidth_util``.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import warnings
+from collections import deque
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from paddle_tpu.observability.metrics import MetricsRegistry, get_registry
+from paddle_tpu.profiler import RecordEvent
+
+__all__ = [
+    "DeviceTimeSampler",
+    "ProgramEntry",
+    "ProgramInventory",
+    "chip_specs",
+    "get_program_inventory",
+    "roofline_utilization",
+]
+
+_DTYPE_SHORT = {
+    "float32": "f32", "float64": "f64", "float16": "f16",
+    "bfloat16": "bf16", "int32": "i32", "int64": "i64", "int8": "i8",
+    "uint32": "u32", "uint8": "u8", "bool": "b1", "complex64": "c64",
+}
+
+
+def _spec_of(v):
+    """ShapeDtypeStruct of one call-argument leaf (no device access —
+    ``shape``/``dtype`` are aval-derived and stay readable on donated
+    shells). Python scalars get their numpy-promoted dtype, which is a
+    close-enough stand-in for jax weak types at cost-analysis fidelity."""
+    import jax
+
+    if isinstance(v, jax.ShapeDtypeStruct):
+        return v
+    if not isinstance(v, (jax.Array, np.ndarray)):
+        # unwrap Tensor-style holders only: jax arrays expose their own
+        # `_value` (a host materialization that RAISES on donated shells)
+        v = getattr(v, "_value", v)
+    shape = getattr(v, "shape", None)
+    dtype = getattr(v, "dtype", None)
+    if shape is None or dtype is None:
+        arr = np.asarray(v)
+        shape, dtype = arr.shape, arr.dtype
+    try:
+        dtype = np.dtype(dtype)
+    except TypeError:
+        pass    # jax extended dtype (e.g. typed PRNG keys): use as-is
+    return jax.ShapeDtypeStruct(tuple(shape), dtype)
+
+
+def _tree_specs(tree):
+    import jax
+
+    return jax.tree_util.tree_map(_spec_of, tree)
+
+
+def _signature(spec_trees) -> Tuple[str, ...]:
+    import jax
+
+    out = []
+    for leaf in jax.tree_util.tree_leaves(spec_trees):
+        try:
+            name = np.dtype(leaf.dtype).name
+        except TypeError:
+            name = str(leaf.dtype)
+        short = _DTYPE_SHORT.get(name, name)
+        dims = ",".join(str(d) for d in leaf.shape)
+        out.append(f"{short}[{dims}]")
+    return tuple(out)
+
+
+# ---------------------------------------------------------------- chip peaks
+
+# Public per-chip peak specs (TFLOP/s dense bf16/fp32-equivalent, HBM GB/s).
+# The CPU row is a deliberately modest host-class nominal so smoke-bench
+# roofline numbers land in (0, 1] instead of being meaningless; real runs
+# override via BENCH_PEAK_TFLOPS / BENCH_PEAK_MEMBW_GBS.
+_CHIP_TABLE = {
+    "tpu v4": (275.0, 1228.0),
+    "tpu v5 lite": (197.0, 819.0),
+    "tpu v5e": (197.0, 819.0),
+    "tpu v5p": (459.0, 2765.0),
+    "tpu v6 lite": (918.0, 1640.0),
+    "tpu v6e": (918.0, 1640.0),
+    "cpu": (0.25, 25.0),
+}
+
+
+def chip_specs(device_kind: Optional[str] = None) -> Dict[str, Any]:
+    """Peak FLOPs/bandwidth for the current (or named) chip.
+
+    Resolution order: ``BENCH_PEAK_TFLOPS``/``BENCH_PEAK_MEMBW_GBS`` env
+    overrides > known-chip table match on ``device_kind`` > the v5e
+    default (same default ``tools/chip_ceiling.py`` reports against).
+    """
+    if device_kind is None:
+        try:
+            import jax
+
+            device_kind = jax.devices()[0].device_kind
+        except Exception:
+            device_kind = "cpu"
+    kind = str(device_kind).lower()
+    tflops, membw = _CHIP_TABLE.get("tpu v5e")
+    for key, row in _CHIP_TABLE.items():
+        if key in kind or kind in key:
+            tflops, membw = row
+            break
+    tflops = float(os.environ.get("BENCH_PEAK_TFLOPS", tflops))
+    membw = float(os.environ.get("BENCH_PEAK_MEMBW_GBS", membw))
+    return {"device_kind": str(device_kind),
+            "peak_tflops": tflops, "peak_membw_gbs": membw}
+
+
+def roofline_utilization(flops: float, bytes_accessed: float,
+                         step_seconds: float,
+                         specs: Optional[dict] = None) -> Dict[str, Any]:
+    """MFU + bandwidth utilization of one program at a measured step time.
+
+    Raw ratios are reported alongside the clamped ``(0, 1]`` gauges: a
+    raw value > 1 means the peak spec is wrong (or the step time was
+    under-measured), which is itself a finding worth surfacing.
+    """
+    specs = specs or chip_specs()
+    step_seconds = max(float(step_seconds), 1e-12)
+    mfu_raw = float(flops) / step_seconds / (specs["peak_tflops"] * 1e12)
+    bw_raw = (float(bytes_accessed) / step_seconds
+              / (specs["peak_membw_gbs"] * 1e9))
+    return {
+        "mfu": min(1.0, mfu_raw),
+        "mfu_raw": mfu_raw,
+        "bandwidth_util": min(1.0, bw_raw),
+        "bandwidth_util_raw": bw_raw,
+        "flops_per_s": float(flops) / step_seconds,
+        "bytes_per_s": float(bytes_accessed) / step_seconds,
+        "chip": specs,
+    }
+
+
+# ------------------------------------------------------------- the inventory
+
+class ProgramEntry:
+    """One compiled executable: captured call specs + lazy XLA analysis."""
+
+    __slots__ = ("name", "kind", "signature", "specs", "static_kwargs",
+                 "donate_argnums", "jitted", "analysis")
+
+    def __init__(self, name, kind, signature, specs, static_kwargs,
+                 donate_argnums, jitted):
+        self.name = name
+        self.kind = kind
+        self.signature = signature
+        self.specs = specs
+        self.static_kwargs = dict(static_kwargs or {})
+        self.donate_argnums = tuple(donate_argnums or ())
+        self.jitted = jitted          # dropped after successful analysis
+        self.analysis: Optional[dict] = None
+
+
+def _normalize_cost(ca) -> dict:
+    if isinstance(ca, (list, tuple)):
+        ca = ca[0] if ca else {}
+    ca = dict(ca or {})
+    return {
+        "flops": float(ca.get("flops", 0.0) or 0.0),
+        "bytes_accessed": float(ca.get("bytes accessed", 0.0) or 0.0),
+        "transcendentals": float(ca.get("transcendentals", 0.0) or 0.0),
+    }
+
+
+class ProgramInventory:
+    """Process-wide registry of compiled-program costs.
+
+    Thread contract: ``capture`` is called from whatever thread runs the
+    jit wrapper (scheduler thread, train loop); ``snapshot``/``analyze``
+    from the endpoint scrape thread or a bench — one lock covers the
+    entry list, and analysis itself runs outside the lock (XLA compile
+    can take seconds; holding the lock would stall capture).
+    """
+
+    def __init__(self, registry: Optional[MetricsRegistry] = None):
+        self._lock = threading.Lock()
+        self._entries: List[ProgramEntry] = []
+        self._by_key: Dict[Tuple[str, Tuple[str, ...]], ProgramEntry] = {}
+        self._reg = registry
+        self.enabled = os.environ.get(
+            "PADDLE_TPU_PROGRAM_INVENTORY", "1") != "0"
+
+    # ---- capture (jit-wrapper side) ------------------------------------
+
+    def capture(self, name: str, kind: str, jitted, arg_trees,
+                static_kwargs: Optional[dict] = None,
+                donate_argnums=()) -> Optional[ProgramEntry]:
+        """Record one newly compiled program's call shape.
+
+        ``arg_trees`` is the positional-argument tuple as passed to the
+        jitted callable (values or ShapeDtypeStructs — converted to
+        specs immediately, nothing is retained). Deduped on
+        ``(name, signature)``; tolerant of already-consumed buffers (a
+        capture that cannot read a shape is skipped, never raised)."""
+        if not self.enabled:
+            return None
+        try:
+            specs = tuple(_tree_specs(t) for t in arg_trees)
+            sig = _signature(specs)
+        except Exception:
+            return None
+        key = (name, sig)
+        with self._lock:
+            hit = self._by_key.get(key)
+            if hit is not None:
+                return hit
+            entry = ProgramEntry(name, kind, sig, specs, static_kwargs,
+                                 donate_argnums, jitted)
+            self._entries.append(entry)
+            self._by_key[key] = entry
+        return entry
+
+    # ---- analysis (scrape/bench side) ----------------------------------
+
+    def analyze(self, entry: ProgramEntry) -> dict:
+        """XLA cost + memory analysis for one entry (cached).
+
+        AOT ``lower().compile()`` against the captured specs: a separate
+        executable from the wrapper's runtime cache, so the tracked
+        program count — and the zero-steady-state-recompile invariant —
+        is untouched. The donated-buffer usability warning XLA:CPU emits
+        for AOT donation hints is suppressed (expected, not actionable).
+        """
+        if entry.analysis is not None:
+            return entry.analysis
+        jitted = entry.jitted
+        if jitted is None:
+            entry.analysis = {"error": "jitted function no longer available"}
+            return entry.analysis
+        try:
+            with RecordEvent("device.program_analysis"), \
+                    warnings.catch_warnings():
+                warnings.simplefilter("ignore")
+                compiled = jitted.lower(
+                    *entry.specs, **entry.static_kwargs).compile()
+                out = _normalize_cost(compiled.cost_analysis())
+                try:
+                    ma = compiled.memory_analysis()
+                except Exception:
+                    ma = None
+                if ma is not None:
+                    out.update({
+                        "argument_bytes":
+                            int(getattr(ma, "argument_size_in_bytes", 0)),
+                        "output_bytes":
+                            int(getattr(ma, "output_size_in_bytes", 0)),
+                        "alias_bytes":
+                            int(getattr(ma, "alias_size_in_bytes", 0)),
+                        "peak_temp_bytes":
+                            int(getattr(ma, "temp_size_in_bytes", 0)),
+                    })
+            entry.analysis = out
+            entry.jitted = None       # analysis cached; drop the strong ref
+        except Exception as exc:
+            entry.analysis = {"error": f"{type(exc).__name__}: {exc}"}
+        return entry.analysis
+
+    # ---- queries --------------------------------------------------------
+
+    def entries(self, name_contains: Optional[str] = None,
+                kind: Optional[str] = None) -> List[ProgramEntry]:
+        with self._lock:
+            out = list(self._entries)
+        if name_contains is not None:
+            out = [e for e in out if name_contains in e.name]
+        if kind is not None:
+            out = [e for e in out if e.kind == kind]
+        return out
+
+    def snapshot(self, analyze: bool = True) -> dict:
+        """The ``/debug/programs`` face; publishes ``compiled_program_*``
+        gauges as a side effect when a registry is attached."""
+        rows = []
+        for i, e in enumerate(self.entries()):
+            row = {
+                "name": e.name,
+                "kind": e.kind,
+                "signature": list(e.signature),
+                "static_kwargs": {k: repr(v)
+                                  for k, v in e.static_kwargs.items()},
+                "donate_argnums": list(e.donate_argnums),
+            }
+            if analyze:
+                row["analysis"] = self.analyze(e)
+            elif e.analysis is not None:
+                row["analysis"] = e.analysis
+            rows.append(row)
+            an = row.get("analysis") or {}
+            if self._reg is not None and "flops" in an:
+                labels = {"program": f"{e.name}/{i}"}
+                self._reg.gauge(
+                    "compiled_program_flops",
+                    "XLA cost-analysis FLOPs per program"
+                ).labels(**labels).set(an["flops"])
+                self._reg.gauge(
+                    "compiled_program_bytes_accessed",
+                    "XLA cost-analysis bytes accessed per program",
+                    unit="bytes").labels(**labels).set(an["bytes_accessed"])
+                self._reg.gauge(
+                    "compiled_program_peak_temp_bytes",
+                    "XLA peak temp allocation per program",
+                    unit="bytes").labels(**labels).set(
+                        an.get("peak_temp_bytes", 0))
+        if self._reg is not None:
+            self._reg.gauge(
+                "compiled_program_count",
+                "programs known to the inventory").set(len(rows))
+        return {"programs": rows, "count": len(rows)}
+
+    def reset(self) -> None:
+        """Test hygiene: forget every captured program."""
+        with self._lock:
+            self._entries.clear()
+            self._by_key.clear()
+
+
+_inventory: Optional[ProgramInventory] = None
+_inv_lock = threading.Lock()
+
+
+def get_program_inventory() -> ProgramInventory:
+    global _inventory
+    with _inv_lock:
+        if _inventory is None:
+            _inventory = ProgramInventory(registry=get_registry())
+        return _inventory
+
+
+# ------------------------------------------------------- device step timing
+
+class DeviceTimeSampler:
+    """Async-safe decode step-time estimation from host timestamps.
+
+    Two sampled series, both O(1) per observation and bounded:
+
+    - **span**: dispatch → drain-completion of the same step. At
+      ``dispatch_depth=0`` this IS the device step (the fetch blocks
+      inline); at depth>0 it mis-counts in either direction (queue
+      wait inflates it; a fetch landing on an already-finished step
+      deflates it).
+    - **inter**: delta between consecutive completions. In a full
+      depth>0 pipeline this converges to the true device step; at
+      depth 0 it over-counts by host commit work between steps.
+
+    The consumer picks by regime (the scheduler knows its
+    ``dispatch_depth``: span at depth 0, inter at depth>0);
+    ``snapshot()``'s generic ``step_time_s`` falls back to the min of
+    the two medians. No device markers, no extra syncs, no behavior
+    change (pure host timestamping ⇒ tokens bit-identical with the
+    sampler on or off).
+    """
+
+    def __init__(self, window: int = 256):
+        self._lock = threading.Lock()
+        self._spans = deque(maxlen=window)
+        self._inters = deque(maxlen=window)
+        self._last_complete: Optional[float] = None
+        self._count = 0
+
+    def observe(self, t_dispatch: float, t_complete: float) -> None:
+        span = max(0.0, t_complete - t_dispatch)
+        with self._lock:
+            self._spans.append(span)
+            if self._last_complete is not None:
+                delta = t_complete - self._last_complete
+                if 0.0 < delta < 10.0:     # drop idle gaps between bursts
+                    self._inters.append(delta)
+            self._last_complete = t_complete
+            self._count += 1
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            spans, inters = list(self._spans), list(self._inters)
+            count = self._count
+        med_span = float(np.median(spans)) if spans else None
+        med_inter = float(np.median(inters)) if inters else None
+        candidates = [v for v in (med_span, med_inter) if v is not None]
+        return {
+            "steps_observed": count,
+            "span_median_s": med_span,
+            "inter_completion_median_s": med_inter,
+            "step_time_s": min(candidates) if candidates else None,
+        }

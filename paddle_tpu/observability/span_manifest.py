@@ -45,6 +45,11 @@ SPAN_MANIFEST = {
     "router.route": {"owner": "serving", "category": "UserDefined"},
     "router.failover": {"owner": "serving", "category": "UserDefined"},
     "router.reload": {"owner": "serving", "category": "UserDefined"},
+    # device-side observability (HBM ledger + program inventory)
+    "device.oom_forensics": {"owner": "observability",
+                             "category": "UserDefined"},
+    "device.program_analysis": {"owner": "observability",
+                                "category": "UserDefined"},
 }
 
 # file (repo-relative, /-separated) -> name prefix of its runtime-built

@@ -77,6 +77,23 @@ class PrefixCache:
                 "hit_tokens / (hit_tokens + miss_tokens)")
             self._g_cached = registry.gauge(
                 "prefix_cache_cached_blocks", "blocks retained in the tree")
+        self._ledger_handle = None    # device-ledger overlay, optional
+        self._ledger_block_bytes = 0
+
+    def attach_device_ledger(self, ledger, block_bytes: int):
+        """Mirror the tree's pinned-block footprint into the device-memory
+        ledger as an OVERLAY owner (the bytes live inside the kv_pool
+        allocation — they answer "who pinned what", not "extra HBM").
+        Updated at exactly the sites that already move ``_g_cached``."""
+        self._ledger_block_bytes = int(block_bytes)
+        self._ledger_handle = ledger.register(
+            "prefix_cache_pinned", "radix_tree_blocks",
+            len(self.tree) * self._ledger_block_bytes, overlay=True)
+
+    def _ledger_update(self):
+        if self._ledger_handle is not None:
+            self._ledger_handle.resize(
+                len(self.tree) * self._ledger_block_bytes)
 
     # ---- admission side -------------------------------------------------
 
@@ -117,6 +134,7 @@ class PrefixCache:
             self.allocator.incref(b)
         if self._reg is not None:
             self._g_cached.set(len(self.tree))
+        self._ledger_update()
 
     # ---- pressure / invalidation ---------------------------------------
 
@@ -133,6 +151,8 @@ class PrefixCache:
         if self._reg is not None and released:
             self._c_evicted.inc(len(released))
             self._g_cached.set(len(self.tree))
+        if released:
+            self._ledger_update()
         if self._evict_listener is not None and released:
             self._evict_listener(len(released))
         return len(released)
@@ -150,6 +170,7 @@ class PrefixCache:
             self.allocator.decref(b)
         if self._reg is not None:
             self._g_cached.set(0)
+        self._ledger_update()
         return len(released)
 
     # ---- reading --------------------------------------------------------

@@ -84,6 +84,11 @@ class SchedulerConfig:
     ttft_slo_s: Optional[float] = None    # None = SLO accounting off
     tpot_slo_s: Optional[float] = None
     ttft_breach_streak: int = 4       # consecutive breaches -> alarm
+    # Device-side observability: HBM ledger (owner-tagged device bytes,
+    # OOM forensics) + decode step-time sampling for roofline gauges.
+    # Host-side bookkeeping only — tokens are bit-identical on vs off at
+    # every dispatch_depth (pinned in tests).
+    enable_device_observability: bool = True
     # ---- resilience (fault retry, deadlines, shedding). The fault knobs
     # only matter when errors actually occur; the shed thresholds are
     # fractions of max(pool occupancy, queue fill).

@@ -311,6 +311,52 @@ def test_swallowed_exception_bad_and_clean(tmp_path):
     assert report["ok"] and report["counts"]["suppressed"] == 1
 
 
+def test_ledger_bypass_bad_and_clean(tmp_path):
+    _write(tmp_path, "mod.py", """
+        import numpy as np
+        import paddle_tpu as paddle
+
+        class BypassingPool:
+            def __init__(self, n):
+                # bad: device pool allocation, class never touches the
+                # ledger -> device_memory_bytes census under-counts
+                self._pools = [paddle.zeros([n, 16], dtype="float32")]
+
+        class AccountedPool:
+            def __init__(self, n, ledger):
+                self._pools = [paddle.zeros([n, 16], dtype="float32")]
+                self._ledger_handle = ledger.register(
+                    "kv_pool", "pools", n * 16 * 4)
+
+        class HostSidePool:
+            def __init__(self, n):
+                # clean: numpy is host memory, not a device allocation
+                self._pool = np.zeros((n, 16), np.float32)
+
+        class PoolingLayer:
+            def __init__(self):
+                # clean: an nn pooling layer, not an array allocation
+                self.avg_pool = object()
+    """)
+    report = _lint(tmp_path, rules=["ledger-bypass"])
+    hits = _rules_hit(report, "ledger-bypass")
+    assert len(hits) == 1
+    assert hits[0]["symbol"].endswith("BypassingPool")
+    assert "BypassingPool" in hits[0]["message"]
+    assert hits[0]["line"] > 0
+
+    # staging-marker spelling is covered too
+    _write(tmp_path, "mod.py", """
+        import jax.numpy as jnp
+
+        class Snapshotter:
+            def grab(self, tree):
+                self._staging = jnp.zeros((4,))   # bad: unledgered staging
+    """)
+    report = _lint(tmp_path, rules=["ledger-bypass"])
+    assert len(_rules_hit(report, "ledger-bypass")) == 1
+
+
 def test_suppression_forms(tmp_path):
     _write(tmp_path, "mod.py", """
         def hot_path(fn):
@@ -431,7 +477,7 @@ def test_lint_repo_exits_zero():
     assert r.returncode == 0, r.stdout[-3000:]
     rep = json.loads(r.stdout)
     assert rep["ok"] and rep["files_scanned"] > 200
-    assert len(rep["rules"]) == 7
+    assert len(rep["rules"]) == 8
 
 
 def test_lint_catches_seeded_bad_construct(tmp_path):

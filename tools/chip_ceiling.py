@@ -69,6 +69,20 @@ def conv_ceiling(batch=128, hw=28, cin=256, cout=256, iters=20,
     return flops / dt / 1e12
 
 
+def membw_ceiling(mb=512, iters=20, dtype=jnp.float32):
+    """Sustained GB/s of a streaming triad ``h = h * c + w`` over an
+    ``mb``-MiB array (reads h and w, writes h: 3 touches per element) —
+    the HBM-bandwidth roofline denominator that
+    ``serving_decode_bandwidth_util`` divides by when the nominal table
+    in ``chip_specs()`` is being audited."""
+    n = int(mb * 2 ** 20 / np.dtype(np.float32).itemsize)
+    k = jax.random.PRNGKey(2)
+    h = jax.random.normal(k, (n,), dtype)
+    w = jax.random.normal(k, (n,), dtype) * 1e-3
+    dt = _time_chained(lambda h_, w_: h_ * 0.999 + w_, h, w, iters)
+    return 3.0 * h.nbytes / dt / 1e9
+
+
 def measure(iters=10):
     """r4 sweep on the tunneled v5e (in-graph chained loop, host-scalar
     sync): matmul 162.9 TF/s @ n=16384 (82.7% of the 197 nominal peak;
@@ -79,7 +93,10 @@ def measure(iters=10):
     denominator for ResNet, the ideal one is the hardware's."""
     # best of 2: the tunnel has transient throughput collapses (NOTES_r3
     # "never believe a single slow bench") — a ceiling is a MAX by meaning
+    from paddle_tpu.observability.program_inventory import chip_specs
+
     best = lambda f: max(f(), f())
+    nominal = chip_specs()
     return {
         "ceiling_matmul_tflops": round(
             best(lambda: matmul_ceiling(16384, iters=iters)), 1),
@@ -87,6 +104,13 @@ def measure(iters=10):
             best(lambda: conv_ceiling(256, 28, 256, 256, iters=iters)), 1),
         "ceiling_conv_ideal_tflops": round(
             best(lambda: conv_ceiling(256, 28, 1024, 1024, iters=iters)), 1),
+        "ceiling_membw_gbs": round(
+            best(lambda: membw_ceiling(iters=iters)), 1),
+        # the nominal table the roofline gauges (train_mfu,
+        # serving_decode_bandwidth_util) divide by — emitted side by side
+        # so a drifting toolchain shows up as measured-vs-nominal skew
+        "nominal_peak_tflops": nominal["peak_tflops"],
+        "nominal_peak_membw_gbs": nominal["peak_membw_gbs"],
         "device": str(jax.devices()[0].device_kind),
     }
 

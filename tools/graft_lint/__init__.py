@@ -1,6 +1,6 @@
 """graft_lint: framework-invariant static analysis for this codebase.
 
-Seven checkers over a shared stdlib-``ast`` module graph (no jax import,
+Eight checkers over a shared stdlib-``ast`` module graph (no jax import,
 no execution of scanned code), each targeting an invariant the framework
 otherwise only defends at runtime:
 
@@ -14,6 +14,9 @@ otherwise only defends at runtime:
 - ``span-manifest``         RecordEvent names vs. span_manifest.py
 - ``swallowed-exception``   bare ``except:`` / do-nothing broad catches
                             that defeat transient-vs-fatal classification
+- ``ledger-bypass``         device allocations for tracked owners in
+                            classes that never touch the memory ledger
+                            (silent device_memory_bytes under-counting)
 
 Driver: ``python tools/lint.py`` (``--json``, ``--changed``,
 ``--baseline``, ``--write-baseline``). Suppression:
@@ -32,6 +35,7 @@ from tools.graft_lint.callgraph import FunctionIndex
 from tools.graft_lint.check_donation import DonationAliasChecker
 from tools.graft_lint.check_excepts import SwallowedExceptionChecker
 from tools.graft_lint.check_hostsync import HostSyncChecker
+from tools.graft_lint.check_ledger import LedgerBypassChecker
 from tools.graft_lint.check_locks import GuardedByChecker
 from tools.graft_lint.check_recompile import RecompileHazardChecker
 from tools.graft_lint.check_tracing import TracingHazardChecker
@@ -49,6 +53,7 @@ ALL_CHECKERS = (
     DonationAliasChecker,
     SpanManifestChecker,
     SwallowedExceptionChecker,
+    LedgerBypassChecker,
 )
 
 

@@ -1,0 +1,125 @@
+"""ledger-bypass: device allocations for tracked owners off the ledger.
+
+The DeviceMemoryLedger's census is only as honest as its coverage:
+``device_memory_bytes{owner}`` must account for (>=95% of) framework-owned
+device bytes, which is pinned by a runtime test against the serving
+pool+weights ground truth — but a NEW allocation site silently erodes that
+guarantee until someone reruns the accounting. This checker is the static
+guard: inside any class that constructs a device-memory carrier under a
+tracked-owner attribute name (``*pool*``, ``*staging*``, ``*buffer*`` —
+the spelling the framework's own owner sites use) via a device-array
+constructor (``paddle/jnp/jax`` ``zeros``/``ones``/``full``/``empty``/
+``*_like``/``to_tensor``/``device_put``), the class must reference the
+ledger somewhere (register the bytes, hold a handle, or attach one) —
+otherwise the census drifts from ground truth.
+
+Scope is per-class on purpose: registration legitimately lives in a
+different method than the allocation (``__init__`` allocates,
+``attach_device_ledger`` registers), but a class with no ledger reference
+at all cannot be accounting its bytes anywhere. Host-side numpy buffers
+and nn pooling layers (``nn.AvgPool2D``) are not device allocations and
+are not flagged.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from tools.graft_lint.callgraph import FunctionIndex
+from tools.graft_lint.core import Finding, ModuleGraph, func_tail_name
+
+RULE = "ledger-bypass"
+
+# attribute-name fragments the framework's tracked owners live under
+_OWNER_MARKERS = ("pool", "staging", "buffer")
+
+# device-array constructors (host numpy is not device memory)
+_ALLOC_TAILS = {"zeros", "ones", "full", "empty", "zeros_like",
+                "ones_like", "full_like", "empty_like", "to_tensor",
+                "device_put"}
+_DEVICE_MODULES = {"paddle", "jnp", "jax", "paddle_tpu"}
+
+
+def _is_device_alloc(call: ast.Call) -> bool:
+    fn = call.func
+    tail = func_tail_name(fn) or ""
+    if tail not in _ALLOC_TAILS:
+        return False
+    if tail == "device_put":
+        return True
+    # require a device-module receiver: paddle.zeros / jnp.full / ...
+    return (isinstance(fn, ast.Attribute)
+            and isinstance(fn.value, ast.Name)
+            and fn.value.id in _DEVICE_MODULES)
+
+
+def _self_attr_target(node: ast.AST):
+    """``self.<attr>`` assignment target, or None."""
+    if (isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "self"):
+        return node.attr
+    return None
+
+
+def _class_references_ledger(cls: ast.ClassDef) -> bool:
+    """Any identifier mentioning the ledger anywhere in the class body:
+    ``DeviceMemoryLedger``, ``get_device_ledger``, ``self.device_ledger``,
+    ``attach_device_ledger``, a held ``*_ledger_handle`` — registration,
+    handle storage, and attachment all count as accounting."""
+    for node in ast.walk(cls):
+        name = None
+        if isinstance(node, ast.Name):
+            name = node.id
+        elif isinstance(node, ast.Attribute):
+            name = node.attr
+        if name is not None and "ledger" in name.lower():
+            return True
+    return False
+
+
+class LedgerBypassChecker:
+    rule = RULE
+    description = ("device allocations under tracked-owner attribute "
+                   "names in classes that never touch the device-memory "
+                   "ledger")
+
+    def run(self, graph: ModuleGraph, index: FunctionIndex) -> List[Finding]:
+        findings: List[Finding] = []
+        for mod in graph.modules:
+            for cls in ast.walk(mod.tree):
+                if not isinstance(cls, ast.ClassDef):
+                    continue
+                if _class_references_ledger(cls):
+                    continue
+                findings.extend(self._scan_class(mod, cls))
+        return findings
+
+    def _scan_class(self, mod, cls: ast.ClassDef) -> List[Finding]:
+        out: List[Finding] = []
+        for node in ast.walk(cls):
+            targets = []
+            if isinstance(node, ast.Assign):
+                targets, value = node.targets, node.value
+            elif isinstance(node, ast.AnnAssign) and node.value is not None:
+                targets, value = [node.target], node.value
+            else:
+                continue
+            attrs = [a for a in map(_self_attr_target, targets)
+                     if a is not None
+                     and any(m in a.lower() for m in _OWNER_MARKERS)]
+            if not attrs:
+                continue
+            if not any(_is_device_alloc(c) for c in ast.walk(value)
+                       if isinstance(c, ast.Call)):
+                continue
+            out.append(Finding(
+                RULE, mod.rel, node.lineno, node.col_offset,
+                f"`self.{attrs[0]}` holds a device allocation but class "
+                f"`{cls.name}` never references the DeviceMemoryLedger — "
+                f"register the bytes under their owner tag (ledger."
+                f"register/register_arrays) or the device_memory_bytes "
+                f"census silently under-counts",
+                symbol=f"{mod.rel}:{cls.name}"))
+        return out

@@ -127,6 +127,38 @@ def _quiesce_live_schedulers() -> list:
     return report
 
 
+def _device_observability_fields(sched, wall_s: float) -> dict:
+    """Summarise ``sched.device_observability()`` into the asserted bench
+    fields: KV bytes per token, decode-program bandwidth utilization, and
+    the share of wall time the device spent inside decode steps."""
+    dev = sched.device_observability()
+    if not dev.get("enabled"):
+        return {"enabled": False}
+    st = dev.get("device_step_time") or {}
+    step_s = st.get("step_time_s")
+    steps = st.get("steps_observed") or 0
+    share = (min(1.0, steps * step_s / wall_s)
+             if step_s and wall_s > 0 else None)
+    out = {
+        "enabled": True,
+        "kv_bytes_per_token": dev.get("kv_bytes_per_token"),
+        "decode_steps_observed": steps,
+        "decode_device_step_seconds": step_s,
+        "decode_device_time_share": share,
+        "serving_decode_bandwidth_util": dev.get("decode_bandwidth_util"),
+        "decode_mfu": dev.get("decode_mfu"),
+        "chip": dev.get("chip"),
+        "memory_census_total_bytes":
+            (dev.get("memory") or {}).get("total_bytes"),
+    }
+    prog = dev.get("decode_program")
+    if isinstance(prog, dict):
+        out["decode_program"] = {
+            k: prog.get(k) for k in ("name", "flops", "bytes_accessed",
+                                     "peak_temp_bytes")}
+    return out
+
+
 def run_load(num_requests: int = 16, rate: float = 0.5, seed: int = 0,
              max_num_seqs: int = 4, block_size: int = 8,
              num_blocks=None, max_seq_len: int = 64,
@@ -202,6 +234,13 @@ def run_load(num_requests: int = 16, rate: float = 0.5, seed: int = 0,
     wall = time.perf_counter() - t0
     if endpoint is not None:
         endpoint.stop()
+    # snapshot the rate metrics BEFORE roofline attribution: tokens_per_s
+    # divides by metrics uptime, and the attribution's AOT cost analysis
+    # would silently inflate that denominator
+    snap = sched.metrics.snapshot()
+    # roofline attribution BEFORE shutdown (needs the live scheduler):
+    # sampled decode device-time × the decode program's cost analysis
+    device_obs = _device_observability_fields(sched, wall)
     sched.shutdown()      # stop the drain thread; everything has finished
 
     outs = dict(sched._finished)
@@ -215,7 +254,6 @@ def run_load(num_requests: int = 16, rate: float = 0.5, seed: int = 0,
     for rid in sorted(outs):
         digest.update(np.asarray(outs[rid].token_ids, np.int64).tobytes())
 
-    snap = sched.metrics.snapshot()
     return {
         "bench": "serving_continuous_batching",
         "config": {
@@ -236,6 +274,7 @@ def run_load(num_requests: int = 16, rate: float = 0.5, seed: int = 0,
         "slo": sched.metrics.slo_snapshot(),
         "flight_recorder_tail": sched.flight.dump(last=8),
         "outputs_sha1": digest.hexdigest(),
+        "device_observability": device_obs,
         "n_scrapes": n_scrapes,
         "scrape_sample": scrape_sample,
         # request-lifecycle chrome trace (request_id-correlated spans) —
@@ -312,6 +351,7 @@ def _run_async_load(depth: int, num_requests: int = 32,
         for o in sched.step():
             outs[o.request_id] = o.generated_ids
     wall = time.perf_counter() - t0
+    device_obs = _device_observability_fields(sched, wall)
     sched.shutdown()
 
     assert len(outs) == num_requests, "every measured request must finish"
@@ -334,6 +374,7 @@ def _run_async_load(depth: int, num_requests: int = 32,
         "stall_phases_s": phases,
         "drain_wait_s": round(sched.stall.drain_wait_seconds - drain0, 4),
         "outputs_sha1": digest.hexdigest(),
+        "device_observability": device_obs,
         "compile_stats": cs,
         "steady_state_recompiles": cs["steady_state_recompiles"],
     }
@@ -1105,9 +1146,10 @@ def measure_observability_overhead(**load_kw) -> dict:
         h.record(0.001 * i)
     per_op_s = (_time.perf_counter() - t0) / (3 * iters)
 
-    # per scheduler iteration: 1 step_time record + 6 gauge sets; per token:
-    # ~2 counter incs; per prefill: 2; per finish: 2 histogram records + 1
-    n_ops = (art["iterations"] * 7
+    # per scheduler iteration: 1 step_time record + 6 gauge sets + 1
+    # device-time sampler observe; per token: ~2 counter incs; per
+    # prefill: 2; per finish: 2 histogram records + 1
+    n_ops = (art["iterations"] * 8
              + m["generated_tokens"] * 2
              + m["prefills"] * 2
              + m["requests_finished"] * 3)
@@ -1539,6 +1581,14 @@ def _run_mode(args, mode: str, out_path: str) -> dict:
         kw["num_blocks"] = max(mb, kw["max_num_seqs"] * mb // 2)
 
     artifact = run_load(**kw)
+    # device-side observability is load-bearing in this artifact: the
+    # roofline fields must be present and sane, not silently absent
+    dev = artifact["device_observability"]
+    assert dev["enabled"] and dev["kv_bytes_per_token"] > 0, dev
+    bw = dev["serving_decode_bandwidth_util"]
+    assert bw is not None and 0.0 < bw <= 1.0, dev
+    share = dev["decode_device_time_share"]
+    assert share is not None and 0.0 < share <= 1.0, dev
     artifact["completed"] = True
     stem = out_path[:-5] if out_path.endswith(".json") else out_path
     prom_text = artifact.pop("prometheus_text")
@@ -1554,7 +1604,10 @@ def _run_mode(args, mode: str, out_path: str) -> dict:
         f.write(prom_text)
     print(json.dumps({"metric": "serving_tokens_per_s",
                       "value": artifact["metrics"]["tokens_per_s"],
-                      "unit": "tokens/s", "artifact": out_path,
+                      "unit": "tokens/s",
+                      "serving_decode_bandwidth_util": bw,
+                      "kv_bytes_per_token": dev["kv_bytes_per_token"],
+                      "artifact": out_path,
                       "prometheus": prom_path,
                       "request_trace": reqtrace_path}))
     return artifact

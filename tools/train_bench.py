@@ -155,6 +155,24 @@ def _run_phase(on_tpu: bool, *, steps: int, warmup: int, depth: int,
     for _ in it:
         pass
     stalls = _stall_delta(m0, stall_snapshot())
+    # roofline attribution while the TrainStep is alive: the compiled
+    # step's cost-analysis FLOPs over the measured per-step wall and the
+    # chip's nominal peak (tools/chip_ceiling.py audits the denominator)
+    from paddle_tpu.observability.program_inventory import (
+        get_program_inventory,
+        roofline_utilization,
+    )
+
+    inv = get_program_inventory()
+    mfu = bw_util = chip = None
+    train_entries = inv.entries(kind="train_step")
+    if train_entries and wall > 0:
+        an = inv.analyze(train_entries[-1])
+        if "flops" in an:
+            roof = roofline_utilization(an["flops"], an["bytes_accessed"],
+                                        wall / steps)
+            mfu, bw_util = roof["mfu"], roof["bandwidth_util"]
+            chip = roof["chip"]
     return {
         "prefetch_depth": depth,
         "donate_inputs": donate_inputs,
@@ -164,6 +182,9 @@ def _run_phase(on_tpu: bool, *, steps: int, warmup: int, depth: int,
         "input_stall_s": stalls["train_input_stall_seconds"],
         "sync_stall_s": stalls["train_sync_stall_seconds"],
         "prefetched_batches": stalls["train_prefetched_batches_total"],
+        "train_mfu": mfu,
+        "train_bandwidth_util": bw_util,
+        "chip": chip,
         "losses": losses,
         "donation": step.donation_report(),
     }
@@ -195,6 +216,8 @@ def run_bench(on_tpu: bool = False, steps: int = 20, warmup: int = 3,
         "train_input_stall_seconds": hot["input_stall_s"],
         "train_sync_stall_seconds": hot["sync_stall_s"],
         "input_stall_frac_of_wall": round(input_stall_frac, 4),
+        "train_mfu": hot["train_mfu"],
+        "train_bandwidth_util": hot["train_bandwidth_util"],
         "losses_bit_identical": identical,
         "ratio_ok": ratio >= RATIO_NOISE_FLOOR,
     }
@@ -213,6 +236,9 @@ def run_bench(on_tpu: bool = False, steps: int = 20, warmup: int = 3,
         assert input_stall_frac < STALL_FRAC_LIMIT, (
             f"prefetch did not collapse the input stall: "
             f"{hot['input_stall_s']} s over {hot['wall_s']} s wall")
+        mfu = art["train_mfu"]
+        assert mfu is not None and 0.0 < mfu <= 1.0, (
+            f"train_mfu must be attributable and in (0, 1]: {mfu}")
     return art
 
 
