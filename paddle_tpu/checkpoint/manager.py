@@ -34,10 +34,18 @@ from typing import Dict, List, NamedTuple, Optional
 
 from paddle_tpu.checkpoint import manifest as mf
 from paddle_tpu.checkpoint import state as st
-from paddle_tpu.observability.annotations import guarded_by
+from paddle_tpu.observability.annotations import (guarded_by, lock_order,
+                                                  thread_role)
 from paddle_tpu.resilience import inject
 
 _STEP_RE = re.compile(r"^step_(\d+)$")
+
+# Checked by graft_lint (lock-order): the writer-handoff lock is a leaf —
+# held only for the three-field swap, never while recording metrics (the
+# scrape thread holds metric locks; nesting the other way would let a slow
+# scrape stall every save()/wait() handoff).
+lock_order("Counter._lock", "<", "CheckpointManager._state_lock")
+lock_order("Histogram._lock", "<", "CheckpointManager._state_lock")
 _TMP_SUFFIX = ".tmp"
 
 
@@ -243,6 +251,7 @@ class CheckpointManager:
                     self._active_tmp = None
 
         if async_save:
+            @thread_role("ckpt-writer")
             def guarded():
                 try:
                     _write_and_commit()

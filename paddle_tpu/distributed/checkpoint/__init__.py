@@ -23,6 +23,7 @@ from paddle_tpu.distributed.checkpoint.metadata import (
     Metadata,
     TensorMetadata,
 )
+from paddle_tpu.observability.annotations import thread_role
 from paddle_tpu.tensor import Tensor
 
 _METADATA_FILE = "0.metadata"
@@ -203,6 +204,7 @@ def save_state_dict(state_dict: Dict, path: str, process_group=None,
         _write_files(path, writes, md, pidx)
 
     if async_save:
+        @thread_role("dist-ckpt-writer")
         def guarded():
             try:
                 do_writes()

@@ -16,6 +16,8 @@ import threading
 import time
 from typing import Optional
 
+from paddle_tpu.observability.annotations import thread_role
+
 # exit-code protocol (manager.py:32-39)
 ELASTIC_EXIT_CODE = 101  # relaunch me with the new world
 ELASTIC_AUTO_PARALLEL_EXIT_CODE = 102
@@ -85,6 +87,7 @@ class ElasticManager:
                                                daemon=True)
             self._hb_thread.start()
 
+    @thread_role("elastic-heartbeat")
     def _heartbeat(self):
         while not self._stop.wait(self._interval):
             try:

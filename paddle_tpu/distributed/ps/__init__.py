@@ -173,8 +173,13 @@ class MemorySparseTable:
         return len(self._rows)
 
     def save(self, path):
-        with self._lock, open(path, "wb") as f:
-            pickle.dump({int(k): v for k, v in self._rows.items()}, f)
+        # snapshot under the lock, serialise OUTSIDE it: rows are mutated
+        # in place by push(), so the copies make the dump consistent while
+        # pull/push from trainer threads keep running during the file I/O
+        with self._lock:
+            snap = {int(k): v.copy() for k, v in self._rows.items()}
+        with open(path, "wb") as f:
+            pickle.dump(snap, f)
 
     def load(self, path):
         with open(path, "rb") as f:

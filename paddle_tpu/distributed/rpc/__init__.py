@@ -15,7 +15,9 @@ import threading
 import time
 import uuid
 from dataclasses import dataclass
-from typing import Any, Optional
+from typing import Any, Dict, Optional
+
+from paddle_tpu.observability.annotations import guarded_by, thread_role
 
 _agent: Optional["_RpcAgent"] = None
 
@@ -52,6 +54,10 @@ class _Future:
 
 
 class _RpcAgent:
+    # outstanding-call table: inserted by caller threads (`call`), swept
+    # by the poller — two writer threads, hence the lock
+    _futures: guarded_by("_flock")
+
     def __init__(self, name, rank, world_size, store):
         self.name = name
         self.rank = rank
@@ -68,10 +74,12 @@ class _RpcAgent:
         self._pfx = f"rpc/{self.session}"
         self.store.set(f"{self._pfx}/worker/{rank}", name.encode())
         self._stop = threading.Event()
-        self._futures = {}
+        self._flock = threading.Lock()
+        self._futures: Dict[str, _Future] = {}
         self._poller = threading.Thread(target=self._poll, daemon=True)
         self._poller.start()
 
+    @thread_role("rpc-poll")
     def _poll(self):
         seq_seen = 0
         while not self._stop.is_set():
@@ -89,15 +97,20 @@ class _RpcAgent:
                                pickle.dumps(result))
                 seq_seen += 1
                 continue
-            # results for my outstanding calls
-            for req_id, fut in list(self._futures.items()):
+            # results for my outstanding calls: snapshot under the lock,
+            # talk to the store OUTSIDE it (network waits must not stall
+            # callers inserting futures), delete back under the lock
+            with self._flock:
+                pending = list(self._futures.items())
+            for req_id, fut in pending:
                 rkey = f"{self._pfx}/res/{req_id}"
                 if self.store.check(rkey):
                     ok, value = pickle.loads(self.store.get(rkey))
                     self.store.delete_key(rkey)
                     fut._set(value if ok else None,
                              None if ok else value)
-                    del self._futures[req_id]
+                    with self._flock:
+                        self._futures.pop(req_id, None)
             time.sleep(0.005)
 
     def resolve(self, to) -> int:
@@ -113,7 +126,8 @@ class _RpcAgent:
         rank = self.resolve(to)
         req_id = uuid.uuid4().hex
         fut = _Future(default_timeout=timeout)
-        self._futures[req_id] = fut
+        with self._flock:
+            self._futures[req_id] = fut
         n = self.store.add(f"{self._pfx}/seq/{rank}", 1) - 1
         self.store.set(
             f"{self._pfx}/req/{rank}/{n}",

@@ -14,6 +14,7 @@ import threading
 from typing import Optional
 
 from paddle_tpu import native
+from paddle_tpu.observability.annotations import thread_role
 
 _GLOBAL_STORE: Optional["TCPStore"] = None
 
@@ -219,6 +220,7 @@ class _PyServer:
         self.port = self._srv.getsockname()[1]
         threading.Thread(target=self._accept, daemon=True).start()
 
+    @thread_role("store-accept")
     def _accept(self):
         while True:
             try:
@@ -228,6 +230,7 @@ class _PyServer:
             threading.Thread(target=self._serve, args=(conn,),
                              daemon=True).start()
 
+    @thread_role("store-serve")
     def _serve(self, conn):
         def recv(n):
             out = b""

@@ -31,6 +31,7 @@ import threading
 from typing import Any, Callable, Optional
 
 from paddle_tpu.framework import flags as _flags
+from paddle_tpu.observability.annotations import thread_role
 
 _flags.define_flag(
     "FLAGS_comm_timeout_s", 300.0,
@@ -87,6 +88,7 @@ def run_with_watchdog(fn: Callable[[], Any], *, timeout: Optional[float] = None,
     error: list = []
     done = threading.Event()
 
+    @thread_role("watchdog")
     def worker():
         try:
             result.append(fn())

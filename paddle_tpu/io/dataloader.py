@@ -26,7 +26,7 @@ import numpy as np
 
 from paddle_tpu.io.dataset import Dataset, IterableDataset
 from paddle_tpu.io.sampler import BatchSampler
-from paddle_tpu.observability.annotations import hot_path
+from paddle_tpu.observability.annotations import hot_path, thread_role
 from paddle_tpu.tensor import Tensor
 
 
@@ -186,6 +186,7 @@ class DevicePrefetcher:
         q: "queue.Queue" = queue.Queue(maxsize=self.depth)
         stop = threading.Event()
 
+        @thread_role("prefetch-producer")
         def producer():
             try:
                 for batch in self.loader:
@@ -377,6 +378,7 @@ class DataLoader:
         for _ in range(self.num_workers):
             task_q.put(None)
 
+        @thread_role("loader-worker")
         def worker(worker_id):
             from paddle_tpu.io import WorkerInfo, _set_worker_info
 

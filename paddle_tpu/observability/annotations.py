@@ -21,6 +21,22 @@ machine-checks the contract they state:
   holding the named lock (private helpers invoked under an already-held
   lock, or init-time helpers that run before the object is published).
 
+- ``lock_order("A._lock", "<", "B._lock")`` (module level, assigned to a
+  constant or bare) declares a global acquisition order between two locks:
+  the left lock is acquired BEFORE the right one whenever both are held.
+  The ``lock-order`` checker builds the whole-program acquisition graph
+  and fails any path that acquires the left lock while already holding
+  the right one — the machine-checked form of the prose "allocator ->
+  tree, never the reverse" comments. Lock names are dotted suffixes of
+  ``module.Class.attr`` (``"RadixTree._lock"`` is enough when unique).
+
+- ``@thread_role("drain")`` names the thread role a function runs under
+  (it is a ``threading.Thread`` target, or only ever called from one).
+  The ``thread-role`` checker seeds roles from these markers plus every
+  ``Thread(target=...)`` spawn site, propagates them over the call graph,
+  and flags shared attributes written from a background role with no lock
+  held and no ``guarded_by`` declaration.
+
 Usage::
 
     from paddle_tpu.observability.annotations import (
@@ -48,7 +64,8 @@ Usage::
 
 from __future__ import annotations
 
-__all__ = ["GuardedBy", "guarded_by", "holds_lock", "hot_path"]
+__all__ = ["GuardedBy", "LockOrder", "guarded_by", "holds_lock", "hot_path",
+           "lock_order", "thread_role"]
 
 
 def hot_path(fn=None, *, reason: str = ""):
@@ -92,6 +109,45 @@ def holds_lock(lock: str):
 
     def mark(f):
         f.__graft_holds_lock__ = str(lock)
+        return f
+
+    return mark
+
+
+class LockOrder:
+    """Declaration object for a global lock-acquisition order.
+
+    ``lock_order("A._lock", "<", "B._lock")`` states that whenever both
+    locks are held by one thread, the left one was acquired first. Carries
+    only the two dotted lock names; enforcement is static (the
+    ``lock-order`` checker fails any call path that acquires the left
+    lock while the right one is already held)."""
+
+    __slots__ = ("first", "second")
+
+    def __init__(self, first: str, op: str, second: str):
+        if op != "<":
+            raise ValueError(f"lock_order op must be '<', got {op!r}")
+        self.first = str(first)
+        self.second = str(second)
+
+    def __repr__(self) -> str:
+        return f"lock_order({self.first!r}, '<', {self.second!r})"
+
+
+def lock_order(first: str, op: str, second: str) -> LockOrder:
+    """Declare (at module level) that ``first`` is always acquired before
+    ``second``. Names are dotted suffixes of ``module.Class.attr``."""
+    return LockOrder(first, op, second)
+
+
+def thread_role(name: str):
+    """Name the thread role a function runs under (``Thread`` target or
+    helper only ever called from that thread). Read by the ``thread-role``
+    checker; returns the function unchanged apart from a marker."""
+
+    def mark(f):
+        f.__graft_thread_role__ = str(name)
         return f
 
     return mark

@@ -25,9 +25,18 @@ import threading
 from collections import OrderedDict
 from typing import Dict, Optional
 
-from paddle_tpu.observability.annotations import guarded_by, holds_lock
+from paddle_tpu.observability.annotations import (guarded_by, holds_lock,
+                                                  lock_order)
 
 _NAME_RE = re.compile(r"[^a-zA-Z0-9_:]")
+
+# Checked by graft_lint (lock-order): registry-before-metric. Scrapes
+# snapshot the table under the registry lock, then read each metric's
+# lock OUTSIDE it; a metric path that re-entered the registry while
+# holding its own lock would deadlock against ``_get_or_create``.
+lock_order("MetricsRegistry._lock", "<", "Counter._lock")
+lock_order("MetricsRegistry._lock", "<", "Gauge._lock")
+lock_order("MetricsRegistry._lock", "<", "Histogram._lock")
 
 
 def sanitize_metric_name(name: str) -> str:

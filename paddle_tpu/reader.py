@@ -9,6 +9,8 @@ import itertools
 import queue as _queue
 import threading
 
+from paddle_tpu.observability.annotations import thread_role
+
 __all__ = ["cache", "map_readers", "shuffle", "chain", "compose",
            "buffered", "firstn", "xmap_readers", "multiprocess_reader",
            "ComposeNotAligned"]
@@ -104,6 +106,7 @@ def buffered(reader, size):
         q = _queue.Queue(maxsize=size)
         err = []
 
+        @thread_role("reader-fill")
         def fill():
             try:
                 for d in reader():
@@ -145,6 +148,7 @@ def xmap_readers(mapper, reader, process_num, buffer_size, order=False):
         done = object()
         errors = []
 
+        @thread_role("reader-worker")
         def worker():
             try:
                 while True:

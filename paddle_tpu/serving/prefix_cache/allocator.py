@@ -23,9 +23,16 @@ from __future__ import annotations
 from typing import Callable, Dict, List, Optional
 
 from paddle_tpu.models.kv_cache import BlockAllocator, KVPoolExhausted
-from paddle_tpu.observability.annotations import guarded_by, holds_lock
+from paddle_tpu.observability.annotations import (guarded_by, holds_lock,
+                                                  lock_order)
 
 __all__ = ["RefCountingBlockAllocator"]
+
+# Checked by graft_lint (lock-order): the one path touching both locks —
+# pressure eviction, incl. its `prefer` callback reading refcounts — always
+# enters through the allocator first; taking the allocator lock while
+# holding the tree lock is the deadlock direction.
+lock_order("BlockAllocator._lock", "<", "RadixTree._lock")
 
 
 class RefCountingBlockAllocator(BlockAllocator):
@@ -41,7 +48,7 @@ class RefCountingBlockAllocator(BlockAllocator):
     refcount must change together). The eviction callback runs WITH the
     lock held — it re-enters through ``decref``, which the RLock permits,
     and the lock ordering is always allocator -> radix tree, never the
-    reverse.
+    reverse (declared below via ``lock_order`` and enforced by graft_lint).
     """
 
     _ref: guarded_by("_lock")

@@ -54,7 +54,8 @@ class RadixTree:
     structure lives under a reentrant ``_lock`` (eviction paths re-enter
     via ``remove``). Lock ordering is allocator -> tree: the one path that
     touches both (pressure eviction, incl. its ``prefer`` callback reading
-    refcounts) always enters through the allocator first."""
+    refcounts) always enters through the allocator first — declared as a
+    checked ``lock_order`` in ``allocator.py``, enforced by graft_lint."""
 
     root: guarded_by("_lock")
     _clock: guarded_by("_lock")

@@ -28,7 +28,7 @@ import threading
 import time
 from typing import Callable, Dict, Optional
 
-from paddle_tpu.observability.annotations import guarded_by
+from paddle_tpu.observability.annotations import guarded_by, thread_role
 from paddle_tpu.resilience import classify_error, inject
 
 __all__ = ["ServingReplica"]
@@ -210,6 +210,7 @@ class ServingReplica:
         t.start()
         return t
 
+    @thread_role("replica-drive")
     def _drive(self, idle_sleep_s: float):
         while True:
             with self._lock:

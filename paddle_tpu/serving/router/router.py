@@ -47,7 +47,7 @@ from typing import Callable, Dict, List, Optional
 
 import numpy as np
 
-from paddle_tpu.observability.annotations import guarded_by
+from paddle_tpu.observability.annotations import guarded_by, lock_order
 from paddle_tpu.profiler import RecordEvent
 from paddle_tpu.resilience import classify_error, inject
 from paddle_tpu.serving.metrics import ServingMetrics
@@ -58,6 +58,12 @@ from .replica import ServingReplica
 from .supervisor import ReplicaSupervisor
 
 __all__ = ["ServingRouter"]
+
+# Checked by graft_lint (lock-order): every call into a replica's scheduler
+# (add_request / import_resumed — both take the engine lock) happens OUTSIDE
+# the router's bookkeeping lock; taking the engine lock while holding the
+# router lock would deadlock against scheduler-thread callbacks.
+lock_order("ContinuousBatchingScheduler._elock", "<", "ServingRouter._lock")
 
 POLICIES = ("affinity", "least_loaded", "round_robin")
 
@@ -374,8 +380,8 @@ class ServingRouter:
                     self._fail_unrecoverable(rec, spec)
                     continue
                 # import outside self._lock: add/import takes the
-                # scheduler's engine lock, and lock order must stay
-                # scheduler-after-router everywhere
+                # scheduler's engine lock, and the module-level
+                # lock_order declaration forbids nesting it inside ours
                 new_rrid = survivor.sched.import_resumed(
                     spec, on_token=rec.on_token)
                 with self._lock:

@@ -68,6 +68,7 @@ from paddle_tpu.observability.annotations import (
     guarded_by,
     holds_lock,
     hot_path,
+    thread_role,
 )
 from paddle_tpu.observability.device_memory import (
     DeviceMemoryLedger,
@@ -134,6 +135,7 @@ class _InFlight:
         self.t_dispatch = _time.perf_counter()   # DeviceTimeSampler anchor
 
 
+@thread_role("serving-drain")
 def _drain_worker(sched_ref):
     """Background drain loop: fetch the oldest in-flight step's tokens
     (the device wait lands HERE, overlapped with the next dispatched
@@ -855,13 +857,21 @@ class ContinuousBatchingScheduler:
                 - prefill_s - dispatch_s)
         return finished
 
+    @holds_lock("_elock")
     def _absorb_step_fault(self, exc: BaseException, running: List[int],
                            attempt: int) -> List[Request]:
         """Triage one decode-step fault. Fatal errors re-raise. Transient
         ones charge every running request's K-consecutive budget, retire
         the over-budget ones as ``failed`` (their slots simply drop out of
         the retry — the batch is not poisoned), back off, and let the
-        caller retry. Returns the requests failed by this fault."""
+        caller retry. Returns the requests failed by this fault.
+
+        The backoff is an ``_elock.wait``, not a ``time.sleep``: a
+        Condition wait RELEASES the engine lock while sleeping, so
+        ``add_request``/``cancel``/``shutdown`` proceed during a fault
+        backoff instead of stalling behind it (and ``notify_all`` wakes
+        the backoff early). Both callers re-read live state after the
+        absorb, so interleaved mutation is safe."""
         site = self._fault_site(exc, "serving.decode_step")
         if classify_error(exc) == "fatal":
             self.metrics.observe_fault(site, "fatal")
@@ -878,7 +888,7 @@ class ContinuousBatchingScheduler:
                 failed.append(self._retire(s, "failed"))
         backoff = self.config.retry_backoff_s
         if backoff > 0:
-            _time.sleep(min(backoff * (2 ** attempt), 1.0))
+            self._elock.wait(min(backoff * (2 ** attempt), 1.0))
         return failed
 
     @hot_path(reason="the decode-loop iteration itself")

@@ -477,7 +477,13 @@ def test_lint_repo_exits_zero():
     assert r.returncode == 0, r.stdout[-3000:]
     rep = json.loads(r.stdout)
     assert rep["ok"] and rep["files_scanned"] > 200
-    assert len(rep["rules"]) == 8
+    assert len(rep["rules"]) == 11
+    assert rep["schema"] == "graft-lint-report/2"
+    assert rep["audits"] == ["stale-suppression"]
+    # every reported finding carries a content-addressed fingerprint
+    for f in rep["findings"]:
+        assert len(f["fingerprint"]) == 16
+        int(f["fingerprint"], 16)
 
 
 def test_lint_catches_seeded_bad_construct(tmp_path):
@@ -757,6 +763,8 @@ def test_annotations_are_runtime_inert():
         guarded_by,
         holds_lock,
         hot_path,
+        lock_order,
+        thread_role,
     )
 
     @hot_path
@@ -771,12 +779,21 @@ def test_annotations_are_runtime_inert():
     def h():
         return 43
 
-    assert f() == 41 and g() == 42 and h() == 43
+    @thread_role("drain")
+    def k():
+        return 44
+
+    assert f() == 41 and g() == 42 and h() == 43 and k() == 44
     assert f.__graft_hot_path__ is True
     assert g.__graft_hot_path__ == "why"
     assert h.__graft_holds_lock__ == "_lock"
+    assert k.__graft_thread_role__ == "drain"
     assert guarded_by("_lock").lock == "_lock"
     assert "guarded_by" in repr(guarded_by("_lock"))
+    decl = lock_order("A._la", "<", "B._lb")
+    assert decl.first == "A._la" and decl.second == "B._lb"
+    with pytest.raises(ValueError):
+        lock_order("A._la", ">", "B._lb")   # only "<" is a valid op
 
 
 def test_bench_json_canonicalization(tmp_path):
@@ -796,3 +813,596 @@ def test_bench_json_canonicalization(tmp_path):
     assert canonical(0.123456789) == 0.123457
     assert canonical(66.0) == 66
     assert json.loads(p1.read_text())["b"] == 0.3
+
+
+# ------------------------------------- concurrency checkers (PR: lint-conc)
+
+def test_lock_order_cycle_bad_and_clean(tmp_path):
+    """ABBA inversion across two methods is a lock-order cycle; a
+    consistent nesting order is clean."""
+    src = """
+        import threading
+
+        class Pair:
+            def __init__(self):
+                self._la = threading.Lock()
+                self._lb = threading.Lock()
+
+            def ab(self):
+                with self._la:
+                    with self._lb:
+                        pass
+
+            def ba(self):
+                with self._lb:
+                    with self._la:
+                        pass
+    """
+    _write(tmp_path, "bad_cycle.py", src)
+    report = _lint(tmp_path, rules=["lock-order"])
+    hits = _rules_hit(report, "lock-order")
+    assert hits, report["findings"]
+    assert "cycle" in hits[0]["message"]
+    inner_lines = [i + 1 for i, ln in
+                   enumerate(textwrap.dedent(src).splitlines())
+                   if ln.strip() in ("with self._lb:", "with self._la:")
+                   and "    with" in ln[8:]]
+    # the finding anchors at one of the two inner (second) acquisitions
+    assert any(h["line"] in inner_lines for h in hits), (hits, inner_lines)
+
+    (tmp_path / "bad_cycle.py").unlink()
+    _write(tmp_path, "clean_order.py", """
+        import threading
+
+        class Pair:
+            def __init__(self):
+                self._la = threading.Lock()
+                self._lb = threading.Lock()
+
+            def ab(self):
+                with self._la:
+                    with self._lb:
+                        pass
+
+            def ab2(self):
+                with self._la:
+                    with self._lb:
+                        pass
+    """)
+    report = _lint(tmp_path, rules=["lock-order"])
+    assert not _rules_hit(report, "lock-order")
+
+
+def test_lock_order_transitive_cycle_through_helper(tmp_path):
+    """The inversion hides behind a call: f holds A and calls g, which
+    takes B while another path nests B then A. The whole-program
+    may-acquire propagation still finds the cycle."""
+    _write(tmp_path, "transitive_cycle.py", """
+        import threading
+
+        class Pair:
+            def __init__(self):
+                self._la = threading.Lock()
+                self._lb = threading.Lock()
+
+            def f(self):
+                with self._la:
+                    self._takes_b()
+
+            def _takes_b(self):
+                with self._lb:
+                    pass
+
+            def ba(self):
+                with self._lb:
+                    with self._la:
+                        pass
+    """)
+    report = _lint(tmp_path, rules=["lock-order"])
+    hits = _rules_hit(report, "lock-order")
+    assert hits and "cycle" in hits[0]["message"]
+
+
+def test_lock_order_declaration_enforced(tmp_path):
+    """A checked ``lock_order`` declaration: acquiring the declared-first
+    lock while holding the declared-second one is a violation at the
+    acquisition site; the compliant nesting is clean, and a declaration
+    naming a lock that does not exist is itself a finding."""
+    src = """
+        import threading
+
+        def lock_order(first, op, second):
+            return (first, op, second)
+
+        lock_order("Pair._la", "<", "Pair._lb")
+
+        class Pair:
+            def __init__(self):
+                self._la = threading.Lock()
+                self._lb = threading.Lock()
+
+            def bad(self):
+                with self._lb:
+                    with self._la:
+                        pass
+    """
+    _write(tmp_path, "decl_violation.py", src)
+    report = _lint(tmp_path, rules=["lock-order"])
+    hits = _rules_hit(report, "lock-order")
+    assert hits, report["findings"]
+    viol = [h for h in hits if "declared" in h["message"]
+            or "lock_order" in h["message"]]
+    assert viol
+    bad_line = [i + 1 for i, ln in
+                enumerate(textwrap.dedent(src).splitlines())
+                if ln.strip() == "with self._la:"][0]
+    assert any(h["line"] == bad_line for h in viol), (viol, bad_line)
+
+    (tmp_path / "decl_violation.py").unlink()
+    _write(tmp_path, "decl_clean.py", """
+        import threading
+
+        def lock_order(first, op, second):
+            return (first, op, second)
+
+        lock_order("Pair._la", "<", "Pair._lb")
+
+        class Pair:
+            def __init__(self):
+                self._la = threading.Lock()
+                self._lb = threading.Lock()
+
+            def good(self):
+                with self._la:
+                    with self._lb:
+                        pass
+    """)
+    report = _lint(tmp_path, rules=["lock-order"])
+    assert not _rules_hit(report, "lock-order")
+
+    (tmp_path / "decl_clean.py").unlink()
+    _write(tmp_path, "decl_unknown.py", """
+        def lock_order(first, op, second):
+            return (first, op, second)
+
+        lock_order("Ghost._lock", "<", "Phantom._lock")
+    """)
+    report = _lint(tmp_path, rules=["lock-order"])
+    hits = _rules_hit(report, "lock-order")
+    assert hits and "unknown lock" in hits[0]["message"]
+
+
+def test_thread_role_two_role_write_bad_and_clean(tmp_path):
+    """A spawn target writing an undeclared attribute with no lock held is
+    the two-role write; the same write under the lock is clean."""
+    src = """
+        import threading
+
+        class Worker:
+            def __init__(self):
+                self.state = 0
+                self._lock = threading.Lock()
+                self._t = threading.Thread(target=self._run, name="bg")
+                self._t.start()
+
+            def _run(self):
+                self.state = 1
+    """
+    _write(tmp_path, "bad_roles.py", src)
+    report = _lint(tmp_path, rules=["thread-role"])
+    hits = _rules_hit(report, "thread-role")
+    assert hits, report["findings"]
+    bad_line = [i + 1 for i, ln in
+                enumerate(textwrap.dedent(src).splitlines())
+                if ln.strip() == "self.state = 1"][0]
+    assert hits[0]["line"] == bad_line
+    assert "'bg'" in hits[0]["message"]
+    assert "guarded_by" in hits[0]["message"]
+
+    (tmp_path / "bad_roles.py").unlink()
+    _write(tmp_path, "clean_roles.py", """
+        import threading
+
+        class Worker:
+            def __init__(self):
+                self.state = 0
+                self._lock = threading.Lock()
+                self._t = threading.Thread(target=self._run, name="bg")
+                self._t.start()
+
+            def _run(self):
+                with self._lock:
+                    self.state = 1
+    """)
+    report = _lint(tmp_path, rules=["thread-role"])
+    assert not _rules_hit(report, "thread-role")
+
+
+def test_thread_role_propagates_through_calls(tmp_path):
+    """The write sits two calls below the spawn target; role reachability
+    still tags it."""
+    _write(tmp_path, "deep_roles.py", """
+        import threading
+
+        class Worker:
+            def __init__(self):
+                self.n = 0
+                self._t = threading.Thread(target=self._run, name="drain")
+                self._t.start()
+
+            def _run(self):
+                self._step()
+
+            def _step(self):
+                self.n += 1
+    """)
+    report = _lint(tmp_path, rules=["thread-role"])
+    hits = _rules_hit(report, "thread-role")
+    assert hits and "`self.n`" in hits[0]["message"]
+    assert "'drain'" in hits[0]["message"]
+
+
+def test_blocking_under_lock_bad_and_clean(tmp_path):
+    """sleep/join/queue-get under a held lock is flagged at the blocking
+    call; bounded waits and metered stalls escape."""
+    src = """
+        import queue
+        import threading
+        import time
+
+        class Box:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._q = queue.Queue()
+                self._t = threading.Thread(target=self._run)
+
+            def _run(self):
+                pass
+
+            def bad_sleep(self):
+                with self._lock:
+                    time.sleep(0.1)
+
+            def bad_join(self):
+                with self._lock:
+                    self._t.join()
+
+            def bad_queue(self):
+                with self._lock:
+                    return self._q.get()
+    """
+    _write(tmp_path, "bad_blocking.py", src)
+    report = _lint(tmp_path, rules=["blocking-under-lock"])
+    hits = _rules_hit(report, "blocking-under-lock")
+    lines = textwrap.dedent(src).splitlines()
+    for needle in ("time.sleep(0.1)", "self._t.join()",
+                   "return self._q.get()"):
+        ln = [i + 1 for i, s in enumerate(lines) if s.strip() == needle][0]
+        assert any(h["line"] == ln for h in hits), (needle, hits)
+    assert all("Box._lock" in h["message"] for h in hits)
+
+    (tmp_path / "bad_blocking.py").unlink()
+    _write(tmp_path, "clean_blocking.py", """
+        import queue
+        import threading
+        import time
+
+        class Box:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._q = queue.Queue()
+                self._t = threading.Thread(target=self._run)
+                self.stall = None
+
+            def _run(self):
+                pass
+
+            def sleep_outside(self):
+                with self._lock:
+                    n = 1
+                time.sleep(0.1)
+                return n
+
+            def bounded_join(self):
+                with self._lock:
+                    self._t.join(timeout=1.0)
+
+            def bounded_queue(self):
+                with self._lock:
+                    return self._q.get(timeout=0.5)
+
+            def metered(self):
+                with self._lock:
+                    with self.stall.timed("drain"):
+                        time.sleep(0.1)
+    """)
+    report = _lint(tmp_path, rules=["blocking-under-lock"])
+    assert not _rules_hit(report, "blocking-under-lock")
+
+
+def test_blocking_under_lock_transitive_through_helper(tmp_path):
+    """The sleep hides in a helper; the lock-held call site is flagged
+    with the chain to the origin."""
+    src = """
+        import threading
+        import time
+
+        class Box:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def caller(self):
+                with self._lock:
+                    self._nap()
+
+            def _nap(self):
+                time.sleep(0.5)
+    """
+    _write(tmp_path, "transitive_block.py", src)
+    report = _lint(tmp_path, rules=["blocking-under-lock"])
+    hits = _rules_hit(report, "blocking-under-lock")
+    assert hits, report["findings"]
+    call_line = [i + 1 for i, ln in
+                 enumerate(textwrap.dedent(src).splitlines())
+                 if ln.strip() == "self._nap()"][0]
+    assert hits[0]["line"] == call_line
+    assert "may block" in hits[0]["message"]
+    assert "_nap" in hits[0]["message"]
+
+
+def test_condition_wait_on_held_lock_is_not_blocking(tmp_path):
+    """``cond.wait()`` on the lock you hold RELEASES it while sleeping —
+    the scheduler's backoff idiom must stay clean."""
+    _write(tmp_path, "cond_wait.py", """
+        import threading
+
+        class Engine:
+            def __init__(self):
+                self._elock = threading.Condition(threading.RLock())
+
+            def backoff(self):
+                with self._elock:
+                    self._elock.wait(0.2)
+    """)
+    report = _lint(tmp_path, rules=["blocking-under-lock"])
+    assert not _rules_hit(report, "blocking-under-lock")
+
+
+def test_stale_suppression_audit(tmp_path):
+    """A ``disable`` comment that silences nothing is flagged; one that
+    suppresses a real finding is not; a docstring that merely MENTIONS
+    the directive syntax is not audited."""
+    src = '''
+        """Module doc. Example: # graft-lint: disable=guarded-by inline."""
+        import threading
+
+        def guarded_by(lock):
+            return lock
+
+        class A:
+            _x: guarded_by("_lock")
+
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._x = 0
+
+            def bad(self):
+                self._x = 1  # graft-lint: disable=guarded-by
+
+            def fine(self):
+                return 2  # graft-lint: disable=guarded-by
+    '''
+    _write(tmp_path, "stale.py", src)
+    report = _lint(tmp_path)          # full run: the audit is active
+    stale = _rules_hit(report, "stale-suppression")
+    assert len(stale) == 1, report["findings"]
+    dead_line = [i + 1 for i, ln in
+                 enumerate(textwrap.dedent(src).splitlines())
+                 if "return 2" in ln][0]
+    assert stale[0]["line"] == dead_line
+    assert "matches no finding" in stale[0]["message"]
+    # the used suppression still works: no unsuppressed guarded-by finding
+    assert not _rules_hit(report, "guarded-by")
+
+
+def test_stale_audit_skipped_on_partial_runs(tmp_path):
+    """``disable=all`` can only be audited when every rule ran; a rules
+    subset must not flag it."""
+    _write(tmp_path, "partial.py", """
+        def f():
+            return 1  # graft-lint: disable=all
+    """)
+    report = _lint(tmp_path, rules=["guarded-by"])
+    assert not _rules_hit(report, "stale-suppression")
+    report = _lint(tmp_path)
+    assert len(_rules_hit(report, "stale-suppression")) == 1
+
+
+def test_rules_concurrency_group_alias(tmp_path):
+    """--rules concurrency expands to the four concurrency rules."""
+    from tools.graft_lint import RULE_GROUPS, expand_rules
+
+    _write(tmp_path, "empty.py", "x = 1\n")
+    report = _lint(tmp_path, rules=["concurrency"])
+    assert set(report["rules"]) == {"lock-order", "thread-role",
+                                    "blocking-under-lock", "guarded-by"}
+    assert report["audits"] == []     # the audit needs a full run
+    assert expand_rules(["concurrency", "guarded-by"]) \
+        == list(RULE_GROUPS["concurrency"])
+    assert expand_rules(None) is None
+
+
+def test_lint_seeded_concurrency_bad_constructs(tmp_path):
+    """Acceptance direction 2 for the new checkers, through the real
+    driver: a seeded sleep-under-lock, an undeclared two-role write, and
+    a lock-order inversion exit non-zero with correct file:line."""
+    src = textwrap.dedent("""
+        import threading
+        import time
+
+        class Bad:
+            def __init__(self):
+                self.count = 0
+                self._la = threading.Lock()
+                self._lb = threading.Lock()
+                self._t = threading.Thread(target=self._drain, name="drain")
+
+            def _drain(self):
+                self.count += 1
+
+            def sleepy(self):
+                with self._la:
+                    time.sleep(0.1)
+
+            def ab(self):
+                with self._la:
+                    with self._lb:
+                        pass
+
+            def ba(self):
+                with self._lb:
+                    with self._la:
+                        pass
+    """)
+    bad = tmp_path / "bad_conc.py"
+    bad.write_text(src)
+    lines = src.splitlines()
+    write_line = lines.index("        self.count += 1") + 1
+    sleep_line = lines.index("            time.sleep(0.1)") + 1
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "lint.py"),
+         "--root", str(tmp_path), "--rules", "concurrency",
+         "--baseline", str(tmp_path / "bl.json")],
+        capture_output=True, text=True, timeout=120)
+    assert r.returncode != 0
+    assert f"bad_conc.py:{write_line}" in r.stdout     # thread-role
+    assert f"bad_conc.py:{sleep_line}" in r.stdout     # blocking-under-lock
+    assert "[thread-role]" in r.stdout
+    assert "[blocking-under-lock]" in r.stdout
+    assert "[lock-order]" in r.stdout
+
+
+# ---------------------------- regressions from the concurrency-rule triage
+
+def _rpc_double(x):
+    return x * 2
+
+
+class _FakeKV:
+    """In-memory TCPStore lookalike for driving _RpcAgent in-process."""
+
+    def __init__(self):
+        self._d = {}
+        self._lock = threading.Lock()
+
+    def set(self, k, v):
+        with self._lock:
+            self._d[k] = v
+
+    def get(self, k):
+        with self._lock:
+            return self._d[k]
+
+    def check(self, k):
+        with self._lock:
+            return k in self._d
+
+    def delete_key(self, k):
+        with self._lock:
+            self._d.pop(k, None)
+
+    def add(self, k, n):
+        with self._lock:
+            v = int(self._d.get(k, 0)) + n
+            self._d[k] = v
+            return v
+
+    def wait(self, k):
+        pass
+
+
+def test_rpc_future_table_locked_handoff_regression():
+    """FIXED by this PR (found by the thread-role rule): ``_RpcAgent``'s
+    outstanding-call table was inserted by caller threads and swept by
+    the poller with NO lock — a caller's dict insert racing the poller's
+    iteration killed the poll thread with RuntimeError and every future
+    after it timed out. Hammer both sides through a self-call loop."""
+    from paddle_tpu.distributed.rpc import _RpcAgent
+
+    agent = _RpcAgent("w0", 0, 1, _FakeKV())
+    try:
+        results, errs = {}, []
+
+        def caller(base):
+            try:
+                futs = [(base + i,
+                         agent.call(0, _rpc_double, (base + i,), {}))
+                        for i in range(25)]
+                for x, fut in futs:
+                    results[x] = fut.wait(timeout=60)
+            except Exception as e:
+                errs.append(e)
+
+        threads = [threading.Thread(target=caller, args=(1000 * t,))
+                   for t in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=120)
+        assert not errs, errs[0]
+        assert len(results) == 100
+        assert all(results[x] == 2 * x for x in results)
+        with agent._flock:
+            assert not agent._futures   # every future swept exactly once
+    finally:
+        agent.shutdown()
+
+
+def test_sparse_table_save_is_consistent_snapshot_regression(tmp_path):
+    """FIXED by this PR (found by the blocking-under-lock rule):
+    ``MemorySparseTable.save`` pickled to disk while HOLDING the table
+    lock, stalling every pull/push for the file I/O. It now snapshots
+    row COPIES under the lock and serialises outside — saves racing
+    in-place row mutation must load back complete, well-formed tables."""
+    import pickle
+
+    import numpy as np
+
+    from paddle_tpu.distributed.ps import MemorySparseTable
+
+    table = MemorySparseTable(0, dim=4)
+    stop = threading.Event()
+    errs = []
+
+    def pusher():
+        try:
+            i = 0
+            while not stop.is_set():
+                ids = np.arange(32) + (i % 8) * 32
+                table.pull(ids)
+                grads = np.full((32, 4), 0.01, np.float32)
+                table.push(ids, grads)
+                i += 1
+        except Exception as e:
+            errs.append(e)
+
+    threads = [threading.Thread(target=pusher, daemon=True)
+               for _ in range(2)]
+    for t in threads:
+        t.start()
+    try:
+        path = str(tmp_path / "table.pkl")
+        for _ in range(10):
+            table.save(path)
+            with open(path, "rb") as f:
+                rows = pickle.load(f)
+            assert rows              # snapshot is complete + parseable
+            for k, v in rows.items():
+                assert isinstance(k, int)
+                row = np.asarray(v, np.float32)
+                assert row.ndim == 1 and np.isfinite(row).all()
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(timeout=30)
+    assert not errs, errs[0]

@@ -326,3 +326,47 @@ def test_serve_bench_smoke_writes_artifact(tmp_path):
     root_art = os.path.join(REPO, "BENCH_serving_smoke.json")
     with open(root_art, "w") as f:
         json.dump(on_disk, f, indent=2)
+
+
+# ------------------------------------------- fault-backoff lock regression
+
+def test_fault_backoff_releases_engine_lock(model):
+    """FIXED by this PR (found by graft_lint's blocking-under-lock rule):
+    ``_absorb_step_fault`` backed off with ``time.sleep`` while holding
+    ``_elock``, so every ``add_request``/``cancel``/``shutdown`` stalled
+    behind a fault backoff. The backoff is now ``_elock.wait`` — a
+    Condition wait releases the engine lock while sleeping and wakes
+    early on ``notify_all``."""
+    import threading
+    import time
+
+    from paddle_tpu.resilience.faults import InjectedFault
+
+    sched = ContinuousBatchingScheduler(
+        model, SchedulerConfig(max_num_seqs=2, max_seq_len=32, block_size=8,
+                               retry_backoff_s=5.0))
+    got_lock = threading.Event()
+    release_times = []
+
+    def contender():
+        with sched._elock:
+            got_lock.set()
+            release_times.append(time.perf_counter())
+            sched._elock.notify_all()   # wake the backoff early
+
+    t = threading.Thread(target=contender, daemon=True)
+    exc = InjectedFault("serving.decode_step", 1, kind="transient")
+    t0 = time.perf_counter()
+    with sched._elock:
+        t.start()
+        failed = sched._absorb_step_fault(exc, running=[], attempt=0)
+        absorbed_at = time.perf_counter()
+    t.join(timeout=10)
+    assert failed == []
+    # the contender acquired the lock DURING the backoff (with the old
+    # sleep-under-lock it could not run until after absorb returned), and
+    # its notify_all cut the 1 s capped wait short
+    assert got_lock.is_set()
+    assert release_times and release_times[0] <= absorbed_at
+    assert absorbed_at - t0 < 0.9, (
+        f"backoff held the engine lock for {absorbed_at - t0:.2f}s")

@@ -1,6 +1,6 @@
 """graft_lint: framework-invariant static analysis for this codebase.
 
-Eight checkers over a shared stdlib-``ast`` module graph (no jax import,
+Eleven checkers over a shared stdlib-``ast`` module graph (no jax import,
 no execution of scanned code), each targeting an invariant the framework
 otherwise only defends at runtime:
 
@@ -17,33 +17,50 @@ otherwise only defends at runtime:
 - ``ledger-bypass``         device allocations for tracked owners in
                             classes that never touch the memory ledger
                             (silent device_memory_bytes under-counting)
+- ``lock-order``            whole-program lock-acquisition graph: ABBA
+                            cycles + declared ``lock_order(...)`` orders
+- ``thread-role``           shared-attribute writes from background
+                            thread roles with no lock and no guarded_by
+- ``blocking-under-lock``   joins / queue waits / sleeps / syncs / file
+                            I/O performed while a lock is held
 
-Driver: ``python tools/lint.py`` (``--json``, ``--changed``,
-``--baseline``, ``--write-baseline``). Suppression:
-``# graft-lint: disable=<rule>`` (same line), ``disable-next=``,
-``disable-file=``. Accepted pre-existing findings live in
-``tools/graft_lint/baseline.json``.
+plus the ``stale-suppression`` audit: a ``# graft-lint: disable`` comment
+that silences nothing (for rules active in the run) is itself a finding —
+dead suppressions otherwise swallow the next real diagnostic on the line.
+
+``--rules`` accepts group aliases (``concurrency`` = lock-order +
+thread-role + blocking-under-lock + guarded-by). Driver: ``python
+tools/lint.py`` (``--json``, ``--changed``, ``--baseline``,
+``--write-baseline``). Suppression: ``# graft-lint: disable=<rule>``
+(same line), ``disable-next=``, ``disable-file=``. Accepted pre-existing
+findings live in ``tools/graft_lint/baseline.json``.
 """
 
 from __future__ import annotations
 
+import io
 import os
 import time
-from typing import Dict, List, Optional
+import tokenize
+from typing import Dict, List, Optional, Set
 
 from tools.graft_lint.callgraph import FunctionIndex
+from tools.graft_lint.check_blocking import BlockingUnderLockChecker
 from tools.graft_lint.check_donation import DonationAliasChecker
 from tools.graft_lint.check_excepts import SwallowedExceptionChecker
 from tools.graft_lint.check_hostsync import HostSyncChecker
 from tools.graft_lint.check_ledger import LedgerBypassChecker
+from tools.graft_lint.check_lockorder import LockOrderChecker
 from tools.graft_lint.check_locks import GuardedByChecker
 from tools.graft_lint.check_recompile import RecompileHazardChecker
+from tools.graft_lint.check_threadroles import ThreadRoleChecker
 from tools.graft_lint.check_tracing import TracingHazardChecker
 from tools.graft_lint.core import Baseline, Finding, ModuleGraph
 from tools.graft_lint.spancheck import SpanManifestChecker
 
 __all__ = ["ALL_CHECKERS", "Baseline", "Finding", "ModuleGraph",
-           "default_baseline_path", "run_lint"]
+           "RULE_GROUPS", "STALE_RULE", "default_baseline_path",
+           "expand_rules", "run_lint"]
 
 ALL_CHECKERS = (
     TracingHazardChecker,
@@ -54,12 +71,98 @@ ALL_CHECKERS = (
     SpanManifestChecker,
     SwallowedExceptionChecker,
     LedgerBypassChecker,
+    LockOrderChecker,
+    ThreadRoleChecker,
+    BlockingUnderLockChecker,
 )
+
+STALE_RULE = "stale-suppression"
+
+# group aliases usable anywhere a rule name is (--rules concurrency)
+RULE_GROUPS: Dict[str, tuple] = {
+    "concurrency": (LockOrderChecker.rule, ThreadRoleChecker.rule,
+                    BlockingUnderLockChecker.rule, GuardedByChecker.rule),
+}
+
+
+def expand_rules(rules: Optional[List[str]]) -> Optional[List[str]]:
+    """Replace group aliases with their member rules (order-preserving,
+    deduplicated); None stays None (= all rules)."""
+    if rules is None:
+        return None
+    out: List[str] = []
+    for r in rules:
+        for name in RULE_GROUPS.get(r, (r,)):
+            if name not in out:
+                out.append(name)
+    return out
 
 
 def default_baseline_path() -> str:
     return os.path.join(os.path.dirname(os.path.abspath(__file__)),
                         "baseline.json")
+
+
+def _real_comment_lines(source: str) -> Optional[Set[int]]:
+    """Lines whose ``graft-lint`` marker sits in an actual COMMENT token —
+    a docstring that merely *mentions* the directive syntax is not a
+    suppression anyone relies on, so it must not be audited as stale.
+    None on tokenize failure (treat every line as auditable)."""
+    out: Set[int] = set()
+    try:
+        for tok in tokenize.generate_tokens(io.StringIO(source).readline):
+            if tok.type == tokenize.COMMENT and "graft-lint" in tok.string:
+                out.add(tok.start[0])
+    except (tokenize.TokenError, IndentationError, SyntaxError):
+        return None
+    return out
+
+
+def _stale_suppressions(graph: ModuleGraph, findings: List[Finding],
+                        active_rules: set,
+                        full_run: bool) -> List[tuple]:
+    """The audit: mark directives used by the suppressed findings, then
+    flag every auditable directive that silenced nothing. A directive is
+    auditable only when every rule it names was actually checked this
+    run (``all`` needs a full run) and it lives in a real comment.
+    Returns ``(finding, directive)`` pairs: the caller must never let a
+    directive suppress its OWN stale finding (a dead ``disable=all``
+    would otherwise swallow the very diagnostic auditing it)."""
+    for f in findings:
+        if not f.suppressed:
+            continue
+        mod = graph.by_rel.get(f.file)
+        if mod is None:
+            continue
+        for d in mod.directives:
+            if f.rule in d.rules or "all" in d.rules:
+                if d.kind == "disable-file" or d.target == f.line:
+                    d.used = True
+    known = active_rules | {STALE_RULE}
+    out: List[tuple] = []
+    for mod in graph.modules:
+        comment_lines: Optional[Set[int]] = None
+        scanned = False
+        for d in mod.directives:
+            if d.used:
+                continue
+            named = d.rules - {"all"}
+            if not (named <= known):
+                continue                 # placeholder/unknown rule names
+            if "all" in d.rules and not full_run:
+                continue
+            if not scanned:
+                comment_lines = _real_comment_lines(mod.source)
+                scanned = True
+            if comment_lines is not None and d.line not in comment_lines:
+                continue                 # docstring mention, not a comment
+            rules_s = ",".join(sorted(d.rules))
+            out.append((Finding(
+                STALE_RULE, mod.rel, d.line, 0,
+                f"suppression `# graft-lint: {d.kind}={rules_s}` matches "
+                f"no finding — it is dead weight that would silently "
+                f"swallow the next real diagnostic; remove it"), d))
+    return out
 
 
 def run_lint(repo_root: str, roots: List[str],
@@ -68,11 +171,12 @@ def run_lint(repo_root: str, roots: List[str],
              changed_files: Optional[List[str]] = None) -> Dict[str, object]:
     """Run the suite; returns the JSON-able report.
 
-    ``rules``: restrict to these rule names (default: all).
-    ``changed_files``: repo-relative paths — findings outside them are
-    dropped (the ``--changed`` fast path for pre-commit use).
+    ``rules``: restrict to these rule names or group aliases (default:
+    all). ``changed_files``: repo-relative paths — findings outside them
+    are dropped (the ``--changed`` fast path for pre-commit use).
     """
     t0 = time.perf_counter()
+    rules = expand_rules(rules)
     graph = ModuleGraph(repo_root, roots)
     index = FunctionIndex(graph)
     findings: List[Finding] = list(graph.parse_errors)
@@ -80,12 +184,33 @@ def run_lint(repo_root: str, roots: List[str],
                 if rules is None or c.rule in rules]
     for checker in checkers:
         findings.extend(checker.run(graph, index))
-    findings.sort(key=lambda f: (f.file, f.line, f.col, f.rule))
 
     for f in findings:
         mod = graph.by_rel.get(f.file)
         if mod is not None and mod.is_suppressed(f.rule, f.line):
             f.suppressed = True
+
+    if rules is None or STALE_RULE in rules:
+        stale = _stale_suppressions(
+            graph, findings, {c.rule for c in checkers},
+            full_run=rules is None)
+        for f, own in stale:
+            mod = graph.by_rel.get(f.file)
+            if mod is None:
+                continue
+            # a DIFFERENT directive may silence the audit (disable-next
+            # on the line above, or a file-wide opt-out); the audited
+            # directive itself never suppresses its own stale finding
+            for d in mod.directives:
+                if d is own:
+                    continue
+                if (STALE_RULE in d.rules or "all" in d.rules) and \
+                        (d.kind == "disable-file" or d.target == f.line):
+                    f.suppressed = True
+                    break
+        findings.extend(f for f, _ in stale)
+
+    findings.sort(key=lambda f: (f.file, f.line, f.col, f.rule))
 
     if changed_files is not None:
         changed = set(changed_files)
@@ -96,10 +221,13 @@ def run_lint(repo_root: str, roots: List[str],
 
     failing = [f for f in findings if not f.suppressed and not f.baselined]
     return {
+        "schema": "graft-lint-report/2",
         "ok": not failing,
         "roots": [os.path.relpath(r, repo_root) for r in graph.roots],
         "files_scanned": len(graph.modules),
         "rules": [c.rule for c in checkers],
+        "audits": [STALE_RULE] if (rules is None or STALE_RULE in rules)
+        else [],
         "wall_s": round(time.perf_counter() - t0, 3),
         "counts": {
             "total": len(findings),

@@ -31,7 +31,7 @@ class FuncInfo:
     """One function or method definition."""
 
     __slots__ = ("module", "node", "name", "class_name", "decorators",
-                 "holds_lock", "is_hot", "hot_reason")
+                 "holds_lock", "is_hot", "hot_reason", "thread_role")
 
     def __init__(self, module: Module, node: ast.AST, name: str,
                  class_name: Optional[str]):
@@ -43,6 +43,7 @@ class FuncInfo:
         self.holds_lock: Optional[str] = None
         self.is_hot = False
         self.hot_reason = ""
+        self.thread_role: Optional[str] = None
         for dec in node.decorator_list:
             call = dec if not isinstance(dec, ast.Call) else dec.func
             tail = func_tail_name(call)
@@ -53,6 +54,9 @@ class FuncInfo:
             if tail == "holds_lock" and isinstance(dec, ast.Call) \
                     and dec.args and isinstance(dec.args[0], ast.Constant):
                 self.holds_lock = str(dec.args[0].value)
+            if tail == "thread_role" and isinstance(dec, ast.Call) \
+                    and dec.args and isinstance(dec.args[0], ast.Constant):
+                self.thread_role = str(dec.args[0].value)
 
     @property
     def qualname(self) -> str:

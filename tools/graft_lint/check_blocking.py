@@ -1,0 +1,114 @@
+"""blocking-under-lock: operations that stall every waiter of a lock.
+
+A lock delimits a critical section; a blocking operation inside one
+transfers the block to EVERY thread that touches the lock — the drain
+thread sleeping under the engine lock stalls ``add_request``, a
+checkpoint ``Thread.join`` under the state lock stalls ``gc()``, an
+unmetered device sync under the router lock stalls failover. Flagged
+while any lock is statically held (lexical ``with self._lock:`` blocks
+plus the ``@holds_lock`` entry set):
+
+- host syncs (``.numpy()`` / ``.item()`` / ``.tolist()`` /
+  ``block_until_ready`` / ``device_get``) and jit dispatch through a
+  ``jax.jit``-assigned attribute (first call = compile under the lock);
+- ``time.sleep``;
+- ``Thread.join()`` and ``Queue.get()``/``put()`` on receivers whose
+  type is inferred (``self._writer = threading.Thread(...)``, locals
+  aliasing such attrs) — ``",".join()`` and ``dict.get()`` never match;
+- ``.wait()`` without a timeout, EXCEPT on the held lock itself: a
+  ``Condition.wait`` releases the lock it waits on, which is the
+  sanctioned bounded-wait idiom;
+- file I/O (``open``, ``os.fsync``/``rename``/``replace``).
+
+Escape hatches, in the spirit of check_hostsync: a timeout argument
+bounds the wait (``join(timeout=...)``, ``get(timeout=...)``,
+``block=False``); a ``with x.timed(...):`` block marks a metered,
+deliberate stall. Everything else needs a release-then-wait restructure
+or a ``# graft-lint: disable=blocking-under-lock`` with a reason — the
+review conversation the rule exists to force.
+
+A transitive pass mirrors the host-sync checker's reduced strictness:
+call sites holding a lock whose (conservatively resolved) callee may
+reach an unbounded sync / sleep / join / queue wait are flagged with the
+call chain, so hiding the block one helper away still fails tier-1.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+from tools.graft_lint.callgraph import FuncInfo, FunctionIndex
+from tools.graft_lint.concurrency import TRANSITIVE_KINDS, concurrency_index
+from tools.graft_lint.core import Finding, ModuleGraph
+
+RULE = "blocking-under-lock"
+
+# label, origin function, next hop toward the origin (None = local)
+_Rep = Tuple[str, FuncInfo, Optional[FuncInfo]]
+
+
+class BlockingUnderLockChecker:
+    rule = RULE
+    description = ("blocking operations (host syncs, sleep, joins, queue "
+                   "waits, file I/O, jit dispatch) while a lock is held, "
+                   "unless timeout-bounded or metered under stall.timed")
+
+    def run(self, graph: ModuleGraph, index: FunctionIndex) -> List[Finding]:
+        conc = concurrency_index(graph, index)
+        findings: List[Finding] = []
+
+        for fi in index.funcs.values():
+            for op in conc.summary(fi).ops:
+                if op.held and not op.escaped:
+                    locks = ", ".join(sorted(k.display for k in op.held))
+                    findings.append(Finding(
+                        RULE, fi.module.rel, op.node.lineno,
+                        op.node.col_offset,
+                        f"{op.label} while holding {locks} — release the "
+                        f"lock first, bound it with a timeout, or meter "
+                        f"it under a stall.timed(...) block",
+                        symbol=fi.qualname))
+
+        # transitive pass: which functions may block (reduced op set,
+        # un-escaped, not already under their own lock — those are
+        # reported locally above)?
+        rep: Dict[FuncInfo, _Rep] = {}
+        for fi in index.funcs.values():
+            for op in conc.summary(fi).ops:
+                if op.kind in TRANSITIVE_KINDS and not op.escaped \
+                        and not op.held:
+                    rep[fi] = (op.label, fi, None)
+                    break
+        changed = True
+        while changed:
+            changed = False
+            for fi in index.funcs.values():
+                if fi in rep:
+                    continue
+                for _, callee, _ in conc.summary(fi).call_sites:
+                    r = rep.get(callee)
+                    if r is not None:
+                        rep[fi] = (r[0], r[1], callee)
+                        changed = True
+                        break
+
+        for fi in index.funcs.values():
+            for node, callee, held in conc.summary(fi).call_sites:
+                if not held or callee not in rep:
+                    continue
+                label, origin, _ = rep[callee]
+                chain: List[FuncInfo] = [callee]
+                while chain[-1] is not origin:
+                    nxt = rep[chain[-1]][2]
+                    if nxt is None or nxt in chain:
+                        break
+                    chain.append(nxt)
+                via = " -> ".join(f.qualname for f in chain)
+                locks = ", ".join(sorted(k.display for k in held))
+                findings.append(Finding(
+                    RULE, fi.module.rel, node.lineno, node.col_offset,
+                    f"calls {via} which may block ({label} in "
+                    f"{origin.ref}) while holding {locks} — release the "
+                    f"lock before the call, bound the wait, or meter it",
+                    symbol=fi.qualname))
+        return findings

@@ -1,0 +1,219 @@
+"""lock-order: whole-program lock-acquisition graph — cycles + orders.
+
+Every lock acquisition (``with self._lock:``, ``.acquire()``, plus the
+``@holds_lock`` entry set) is lifted into a global graph with an edge
+``A -> B`` whenever ``B`` is acquired — directly or transitively through
+any resolvable call chain (``may_acquire``) — while ``A`` is held.
+Re-acquiring a held lock adds no edge (the RLock/Condition reentrancy
+idiom the engine lock relies on). Two failure classes:
+
+- **cycles**: a strongly-connected component in the graph means two call
+  paths acquire the same locks in opposite orders — the classic ABBA
+  deadlock, flagged at the acquisition site even though no test will
+  ever reliably reproduce it.
+
+- **declared-order violations**: ``lock_order("A._lock", "<",
+  "B._lock")`` (observability/annotations.py) states A is acquired
+  before B whenever both are held; any edge ``B -> A`` is a finding.
+  This is the machine-checked replacement for the prose "allocator ->
+  tree, never the reverse" comments. Declarations naming a lock that
+  does not exist (typo), matching more than one lock (underqualified
+  suffix), or contradicting another declaration are findings too — a
+  declaration that silently matches nothing checks nothing.
+
+Lock identity is canonicalised to the base-most class defining the attr
+(concurrency.py), so a subclass acquiring an inherited lock and its base
+acquiring the same lock are one node, and declarations may name either
+class.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Tuple
+
+from tools.graft_lint.callgraph import FuncInfo, FunctionIndex
+from tools.graft_lint.concurrency import LockKey, concurrency_index
+from tools.graft_lint.core import Finding, ModuleGraph
+
+RULE = "lock-order"
+
+_Edge = Tuple[LockKey, LockKey]
+_Site = Tuple[FuncInfo, ast.AST, Optional[FuncInfo]]
+
+
+def _sccs(nodes: List[LockKey],
+          adj: Dict[LockKey, List[LockKey]]) -> List[List[LockKey]]:
+    """Iterative Tarjan — returns strongly-connected components."""
+    index_of: Dict[LockKey, int] = {}
+    low: Dict[LockKey, int] = {}
+    on_stack: Dict[LockKey, bool] = {}
+    stack: List[LockKey] = []
+    out: List[List[LockKey]] = []
+    counter = [0]
+
+    for root in nodes:
+        if root in index_of:
+            continue
+        work = [(root, iter(adj.get(root, ())))]
+        index_of[root] = low[root] = counter[0]
+        counter[0] += 1
+        stack.append(root)
+        on_stack[root] = True
+        while work:
+            v, it = work[-1]
+            advanced = False
+            for w in it:
+                if w not in index_of:
+                    index_of[w] = low[w] = counter[0]
+                    counter[0] += 1
+                    stack.append(w)
+                    on_stack[w] = True
+                    work.append((w, iter(adj.get(w, ()))))
+                    advanced = True
+                    break
+                if on_stack.get(w):
+                    low[v] = min(low[v], index_of[w])
+            if advanced:
+                continue
+            work.pop()
+            if work:
+                pv = work[-1][0]
+                low[pv] = min(low[pv], low[v])
+            if low[v] == index_of[v]:
+                comp = []
+                while True:
+                    w = stack.pop()
+                    on_stack[w] = False
+                    comp.append(w)
+                    if w is v:
+                        break
+                out.append(comp)
+    return out
+
+
+class LockOrderChecker:
+    rule = RULE
+    description = ("lock-acquisition cycles (ABBA deadlocks) and "
+                   "violations of declared lock_order(...) constraints")
+
+    def run(self, graph: ModuleGraph, index: FunctionIndex) -> List[Finding]:
+        conc = concurrency_index(graph, index)
+        findings: List[Finding] = []
+        may = conc.may_acquire()
+        # register declared-but-never-acquired locks so lock_order names
+        # resolve even for a lock only ever taken via .acquire()/helpers
+        for ci in index.classes.values():
+            for attr in conc.lock_attrs(ci):
+                conc.lock_key(ci, attr)
+
+        edges: Dict[_Edge, List[_Site]] = {}
+        for fi in index.funcs.values():
+            s = conc.summary(fi)
+            for lock, node, held in s.acquisitions:
+                for a in held:
+                    if a != lock:
+                        edges.setdefault((a, lock), []).append((fi, node,
+                                                                None))
+            for node, callee, held in s.call_sites:
+                if not held:
+                    continue
+                for b in may.get(callee, ()):
+                    if b in held:
+                        continue             # reentrant through the call
+                    for a in held:
+                        edges.setdefault((a, b), []).append((fi, node,
+                                                             callee))
+
+        # ---- cycles --------------------------------------------------
+        adj: Dict[LockKey, List[LockKey]] = {}
+        nodes: List[LockKey] = []
+        seen = set()
+        for (a, b) in edges:
+            adj.setdefault(a, []).append(b)
+            for k in (a, b):
+                if k not in seen:
+                    seen.add(k)
+                    nodes.append(k)
+        for comp in _sccs(nodes, adj):
+            if len(comp) < 2:
+                continue
+            comp_set = set(comp)
+            intra = sorted(
+                ((a, b) for (a, b) in edges
+                 if a in comp_set and b in comp_set),
+                key=lambda e: (e[0].display, e[1].display))
+            legs = []
+            for (a, b) in intra[:4]:
+                fi, node, via = edges[(a, b)][0]
+                hop = f" via {via.qualname}" if via is not None else ""
+                legs.append(f"{a.display} -> {b.display} at "
+                            f"{fi.module.rel}:{node.lineno}{hop}")
+            names = ", ".join(sorted(k.display for k in comp))
+            fi, node, _ = edges[intra[0]][0]
+            findings.append(Finding(
+                RULE, fi.module.rel, node.lineno, node.col_offset,
+                f"lock-acquisition cycle among {{{names}}}: "
+                f"{'; '.join(legs)} — two call paths take these locks in "
+                f"opposite orders (ABBA deadlock); pick one order and "
+                f"declare it with lock_order(...)",
+                symbol=fi.qualname))
+
+        # ---- declarations --------------------------------------------
+        decls = conc.declared_orders()
+        resolved = []
+        for d in decls:
+            sym = index.enclosing_symbol(d.module, d.node.lineno)
+            if d.op != "<":
+                findings.append(Finding(
+                    RULE, d.module.rel, d.node.lineno, d.node.col_offset,
+                    f"lock_order op must be '<', got {d.op!r}", symbol=sym))
+                continue
+            sides = []
+            ok = True
+            for name in (d.first, d.second):
+                hits = conc.match_lock(name)
+                if not hits:
+                    findings.append(Finding(
+                        RULE, d.module.rel, d.node.lineno,
+                        d.node.col_offset,
+                        f"lock_order names unknown lock {name!r} — no "
+                        f"`module.Class.attr` in the scanned code ends "
+                        f"with it (typo, or the lock moved)", symbol=sym))
+                    ok = False
+                elif len(hits) > 1:
+                    cands = ", ".join(sorted(
+                        min(k.aliases) for k in hits)[:4])
+                    findings.append(Finding(
+                        RULE, d.module.rel, d.node.lineno,
+                        d.node.col_offset,
+                        f"lock_order name {name!r} is ambiguous — matches "
+                        f"{len(hits)} locks ({cands}); qualify the suffix",
+                        symbol=sym))
+                    ok = False
+                else:
+                    sides.append(hits[0])
+            if ok:
+                resolved.append((sides[0], sides[1], d))
+
+        pairs = {(f, s): d for f, s, d in resolved}
+        for f, s, d in resolved:
+            other = pairs.get((s, f))
+            if other is not None and (s.display, f.display) \
+                    < (f.display, s.display):
+                findings.append(Finding(
+                    RULE, d.module.rel, d.node.lineno, d.node.col_offset,
+                    f"contradictory lock_order declarations: "
+                    f"{f.display} < {s.display} (here) but "
+                    f"{s.display} < {f.display} at {other.where}",
+                    symbol=index.enclosing_symbol(d.module, d.node.lineno)))
+            for fi, node, via in edges.get((s, f), ())[:3]:
+                hop = f" (via {via.qualname})" if via is not None else ""
+                findings.append(Finding(
+                    RULE, fi.module.rel, node.lineno, node.col_offset,
+                    f"acquires {f.display}{hop} while holding {s.display} "
+                    f"— violates lock_order(\"{d.first}\", '<', "
+                    f"\"{d.second}\") declared at {d.where}; release "
+                    f"{s.display} before taking {f.display}",
+                    symbol=fi.qualname))
+        return findings

@@ -1,0 +1,91 @@
+"""thread-role: background-thread writes to unprotected shared state.
+
+The guarded-by checker enforces locking for attributes someone REMEMBERED
+to declare. This checker closes the other half of the gap: it finds the
+shared mutable state nobody declared. Roles are seeded from every
+``threading.Thread(target=...)`` spawn site (target resolved to the
+method / module function / nested ``def`` it names) and from explicit
+``@thread_role("...")`` markers, then propagated over the conservative
+call graph: everything a drain thread's target reaches runs on the drain
+thread. Any ``self.attr`` write (plain store, augmented assign, item
+store, or an in-place mutator call like ``.append``/``.update``) executed
+by a background role with
+
+- no lock lexically held at the write,
+- no ``@holds_lock`` on the method, and
+- no ``guarded_by`` declaration for the attribute (those are the
+  guarded-by checker's jurisdiction)
+
+is a finding: the attribute is written on ≥2 threads (the background role
+plus whatever the main thread does with the object) with zero
+synchronisation. ``__init__``/``__new__`` are exempt (construction
+happens-before publication). The fix the message asks for — declare
+``guarded_by`` and take the lock, or confine the state to one thread —
+is exactly the decision the race would otherwise make at 3am.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Set
+
+from tools.graft_lint.callgraph import FuncInfo, FunctionIndex
+from tools.graft_lint.concurrency import concurrency_index
+from tools.graft_lint.core import Finding, ModuleGraph
+
+RULE = "thread-role"
+
+_EXEMPT = {"__init__", "__new__"}
+
+
+class ThreadRoleChecker:
+    rule = RULE
+    description = ("self-attribute writes reachable from a background "
+                   "thread role with no lock held and no guarded_by "
+                   "declaration")
+
+    def run(self, graph: ModuleGraph, index: FunctionIndex) -> List[Finding]:
+        conc = concurrency_index(graph, index)
+        findings: List[Finding] = []
+
+        roles: Dict[FuncInfo, Set[str]] = {}
+        for sp in conc.thread_spawns():
+            if sp.target is not None:
+                roles.setdefault(sp.target, set()).add(sp.role)
+        for fi in index.funcs.values():
+            if fi.thread_role:
+                roles.setdefault(fi, set()).add(fi.thread_role)
+
+        queue = list(roles)
+        while queue:
+            cur = queue.pop(0)
+            cur_roles = roles[cur]
+            for _, callee, _ in conc.summary(cur).call_sites:
+                have = roles.setdefault(callee, set())
+                if not (cur_roles <= have):
+                    have |= cur_roles
+                    queue.append(callee)
+
+        for fi, rs in sorted(roles.items(), key=lambda kv: kv[0].ref):
+            if fi.name in _EXEMPT or fi.holds_lock:
+                continue
+            ci = conc.class_of(fi)
+            guarded = index.guarded_attrs(ci) if ci is not None else {}
+            summary = conc.summary(fi)
+            seen_attrs = set()
+            for attr, node, held in summary.writes:
+                if held or attr in guarded or attr in seen_attrs:
+                    continue
+                if ci is not None \
+                        and conc.chain_attr_type(ci, attr) == "Lock":
+                    continue             # lock attrs are set up pre-publish
+                seen_attrs.add(attr)
+                role_list = ", ".join(sorted(rs))
+                findings.append(Finding(
+                    RULE, fi.module.rel, node.lineno, node.col_offset,
+                    f"`self.{attr}` is written on thread role(s) "
+                    f"'{role_list}' (in addition to the main thread) with "
+                    f"no lock held and no guarded_by declaration — "
+                    f"declare `{attr}: guarded_by(\"<lock>\")` and guard "
+                    f"the write, or confine it to one thread",
+                    symbol=fi.qualname))
+        return findings

@@ -1,0 +1,752 @@
+"""Shared concurrency substrate for the whole-program checkers.
+
+Built once per lint run on top of the ``FunctionIndex`` (cached on the
+index object — the three concurrency checkers share it), this module
+answers the questions all of them need:
+
+- **Lock identity.** A lock is ``(owning class, attr)`` canonicalised to
+  the base-most class in the inheritance chain that defines the attribute
+  (``RefCountingBlockAllocator._lock`` IS ``BlockAllocator._lock`` — one
+  runtime object, one node in the acquisition graph). Every chain class
+  contributes an alias ``module.Class.attr`` name so ``lock_order``
+  declarations match by dotted suffix against any of them.
+
+- **Attribute/local types.** A single pass over each class records what
+  ``self.attr`` is assigned from: ``threading.Lock/RLock/Condition`` →
+  lock, ``threading.Thread`` → thread, ``queue.Queue`` → queue,
+  ``jax.jit(...)`` → jit entry point, ``SomeIndexedClass(...)`` → that
+  class. This powers receiver typing (``self._writer.join()`` is a
+  Thread join, ``",".join()`` is not) and the extended call resolution
+  ``self._tree.insert(...)`` → ``RadixTree.insert`` that the base
+  callgraph deliberately does not attempt.
+
+- **Per-function summaries.** One visitor pass per function computes,
+  with the lexically-held lock set threaded through (``with self._lock:``
+  blocks plus the ``@holds_lock`` entry set): every lock acquisition and
+  the locks held at it, every resolvable call site and the locks held at
+  it, every blocking operation (host sync, ``time.sleep``, ``Thread
+  .join``, ``Queue.get/put``, untimed ``wait``, file I/O, jit dispatch)
+  with its escape-hatch state (``with x.timed(...)`` metering, timeout
+  arguments, ``Condition.wait`` on the held lock), and every write to a
+  ``self`` attribute. ``.acquire()`` records an acquisition event but no
+  held region (the lexical ``with`` form is the checked discipline).
+
+- **Whole-program propagation.** ``may_acquire`` is the fixed point of
+  "locks this function may take, callees included"; ``thread_spawns``
+  finds every ``threading.Thread(target=...)`` site and resolves the
+  target (``self.method``, module function, or a nested ``def`` — the
+  latter gets a synthetic ``FuncInfo``), naming the role from the
+  target's ``@thread_role`` marker, the constant ``name=`` kwarg, or the
+  target's own name.
+
+Everything here is conservative in the callgraph.py sense: an
+unresolvable receiver or callee contributes nothing — the checkers can
+miss, they do not hallucinate.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, FrozenSet, List, Optional, Set, Tuple
+
+from tools.graft_lint.callgraph import ClassInfo, FuncInfo, FunctionIndex
+from tools.graft_lint.check_hostsync import _is_timed_with
+from tools.graft_lint.core import Module, ModuleGraph, func_tail_name
+
+__all__ = ["BlockingOp", "ConcurrencyIndex", "FuncSummary", "LockKey",
+           "OrderDecl", "ThreadSpawn", "concurrency_index"]
+
+_LOCK_CTORS = {"Lock", "RLock", "Condition", "Semaphore", "BoundedSemaphore"}
+_THREAD_CTORS = {"Thread", "Timer"}
+_QUEUE_CTORS = {"Queue", "LifoQueue", "PriorityQueue", "SimpleQueue"}
+_JIT_CTORS = {"jit", "pjit"}
+_SYNC_ATTRS = {"numpy", "item", "tolist", "block_until_ready", "device_get"}
+_FILE_IO = {"fsync", "rename", "replace"}       # on the os module
+# method calls that mutate the receiver in place — counted as writes to
+# the underlying self attribute by the thread-role checker
+_MUTATORS = {"append", "extend", "insert", "pop", "popleft", "appendleft",
+             "remove", "clear", "update", "add", "discard", "setdefault"}
+
+# blocking-op kinds that propagate transitively (a helper containing one
+# makes every lock-held call site reaching it a finding); `wait`,
+# `file-io` and `jit-dispatch` stay local-only to keep the transitive
+# pass high-signal, mirroring check_hostsync's reduced strictness
+TRANSITIVE_KINDS = {"host-sync", "sleep", "thread-join", "queue-wait"}
+
+
+class LockKey:
+    """Canonical identity of one lock attribute (interned per index)."""
+
+    __slots__ = ("mod_rel", "cls", "attr", "aliases")
+
+    def __init__(self, mod_rel: str, cls: str, attr: str):
+        self.mod_rel = mod_rel
+        self.cls = cls
+        self.attr = attr
+        self.aliases: Set[str] = set()   # full dotted module.Class.attr names
+
+    @property
+    def display(self) -> str:
+        return f"{self.cls}.{self.attr}"
+
+    def __eq__(self, other) -> bool:
+        return (isinstance(other, LockKey)
+                and (self.mod_rel, self.cls, self.attr)
+                == (other.mod_rel, other.cls, other.attr))
+
+    def __hash__(self) -> int:
+        return hash((self.mod_rel, self.cls, self.attr))
+
+    def __repr__(self) -> str:
+        return f"LockKey({self.display})"
+
+
+class BlockingOp:
+    """One potentially-blocking operation inside a function."""
+
+    __slots__ = ("kind", "label", "node", "held", "escaped")
+
+    def __init__(self, kind: str, label: str, node: ast.AST,
+                 held: FrozenSet[LockKey], escaped: bool):
+        self.kind = kind                 # host-sync / sleep / thread-join /
+        self.label = label               # queue-wait / wait / file-io /
+        self.node = node                 # jit-dispatch
+        self.held = held
+        self.escaped = escaped
+
+
+class FuncSummary:
+    """Everything the concurrency checkers need from one function body."""
+
+    __slots__ = ("acquisitions", "call_sites", "ops", "writes")
+
+    def __init__(self):
+        # (lock, node, locks held at the acquisition — lock excluded)
+        self.acquisitions: List[Tuple[LockKey, ast.AST,
+                                      FrozenSet[LockKey]]] = []
+        # (call node, resolved callee, locks held at the call)
+        self.call_sites: List[Tuple[ast.Call, FuncInfo,
+                                    FrozenSet[LockKey]]] = []
+        self.ops: List[BlockingOp] = []
+        # (attr, node, lock held at the write?)
+        self.writes: List[Tuple[str, ast.AST, bool]] = []
+
+
+class OrderDecl:
+    """One parsed ``lock_order(first, "<", second)`` declaration."""
+
+    __slots__ = ("first", "op", "second", "module", "node")
+
+    def __init__(self, first: str, op: str, second: str, module: Module,
+                 node: ast.Call):
+        self.first = first
+        self.op = op
+        self.second = second
+        self.module = module
+        self.node = node
+
+    @property
+    def where(self) -> str:
+        return f"{self.module.rel}:{self.node.lineno}"
+
+
+class ThreadSpawn:
+    """One ``threading.Thread(target=...)`` site with a resolved target."""
+
+    __slots__ = ("spawner", "node", "target", "role")
+
+    def __init__(self, spawner: FuncInfo, node: ast.Call,
+                 target: Optional[FuncInfo], role: str):
+        self.spawner = spawner
+        self.node = node
+        self.target = target             # None when not statically resolvable
+        self.role = role
+
+
+def _has_timeout_kw(call: ast.Call) -> bool:
+    for kw in call.keywords:
+        if kw.arg == "timeout" and not (isinstance(kw.value, ast.Constant)
+                                        and kw.value.value is None):
+            return True
+        if kw.arg == "block" and isinstance(kw.value, ast.Constant) \
+                and kw.value.value is False:
+            return True
+    return False
+
+
+def _wait_bounded(call: ast.Call) -> bool:
+    """join()/wait(): any positional or timeout kwarg bounds the wait."""
+    return bool(call.args) or _has_timeout_kw(call)
+
+
+def _queue_bounded(call: ast.Call) -> bool:
+    """get()/put(): timeout=, block=False, a second positional (timeout),
+    or a falsy first positional (block) make it non-/bounded-blocking."""
+    if _has_timeout_kw(call):
+        return True
+    if len(call.args) >= 2:
+        return True
+    return bool(call.args) and isinstance(call.args[0], ast.Constant) \
+        and call.args[0].value is False
+
+
+class ConcurrencyIndex:
+    """Lock identities, attr/local types, summaries, spawns, declarations."""
+
+    def __init__(self, graph: ModuleGraph, index: FunctionIndex):
+        self.graph = graph
+        self.index = index
+        # (mod.rel, class) -> {attr: tag}; tag is "Lock"/"Thread"/"Queue"/
+        # "JitFn", a ClassInfo, or None (conflicting assignments)
+        self._attr_types: Dict[Tuple[str, str], Dict[str, object]] = {}
+        self._lock_attrs: Dict[Tuple[str, str], Set[str]] = {}
+        self._lock_keys: Dict[Tuple[str, str, str], LockKey] = {}
+        self._summaries: Dict[FuncInfo, FuncSummary] = {}
+        self._may_acquire: Optional[Dict[FuncInfo, FrozenSet[LockKey]]] = None
+        self._spawns: Optional[List[ThreadSpawn]] = None
+        self._decls: Optional[List[OrderDecl]] = None
+        for ci in index.classes.values():
+            self._build_attr_types(ci)
+
+    # ----------------------------------------------------------- attr types
+    def _ctor_tag(self, mod: Module, call: ast.Call) -> object:
+        fn = call.func
+        tail = func_tail_name(fn)
+        if tail is None:
+            return None
+        qual = None
+        if isinstance(fn, ast.Attribute) and isinstance(fn.value, ast.Name):
+            qual = mod.imports.get(fn.value.id, fn.value.id)
+        elif isinstance(fn, ast.Name):
+            qual = mod.imports.get(fn.id)
+        if tail in _LOCK_CTORS and qual \
+                and (qual == "threading" or qual.startswith("threading.")):
+            return "Lock"
+        if tail in _THREAD_CTORS and qual \
+                and (qual == "threading" or qual.startswith("threading.")):
+            return "Thread"
+        if tail in _QUEUE_CTORS and qual \
+                and (qual == "queue" or qual.startswith("queue.")):
+            return "Queue"
+        if tail in _JIT_CTORS:
+            return "JitFn"
+        if isinstance(fn, ast.Name):
+            target = self.index.resolve_class(mod, fn.id)
+            if target is not None:
+                return target
+        elif isinstance(fn, ast.Attribute) and isinstance(fn.value, ast.Name):
+            owner = mod.imports.get(fn.value.id)
+            owner_mod = self.graph.by_modname.get(owner) if owner else None
+            if owner_mod is not None:
+                return self.index.classes.get((owner_mod.rel, tail))
+        return None
+
+    def _build_attr_types(self, ci: ClassInfo):
+        key = (ci.module.rel, ci.name)
+        if key in self._attr_types:
+            return
+        types: Dict[str, object] = {}
+        conflict: Set[str] = set()
+
+        def note(attr: str, tag: object):
+            if tag is None or attr in conflict:
+                return
+            prev = types.get(attr)
+            if prev is None:
+                types[attr] = tag
+            elif prev is not tag and prev != tag:
+                conflict.add(attr)
+                types.pop(attr, None)
+
+        for m in ci.methods.values():
+            for node in ast.walk(m.node):
+                if not isinstance(node, ast.Assign):
+                    continue
+                for tgt in node.targets:
+                    pairs = []
+                    if isinstance(tgt, ast.Tuple) \
+                            and isinstance(node.value, ast.Tuple) \
+                            and len(tgt.elts) == len(node.value.elts):
+                        pairs = list(zip(tgt.elts, node.value.elts))
+                    else:
+                        pairs = [(tgt, node.value)]
+                    for t, v in pairs:
+                        if isinstance(t, ast.Attribute) \
+                                and isinstance(t.value, ast.Name) \
+                                and t.value.id == "self" \
+                                and isinstance(v, ast.Call):
+                            note(t.attr, self._ctor_tag(ci.module, v))
+        self._attr_types[key] = types
+
+    def class_of(self, fi: FuncInfo) -> Optional[ClassInfo]:
+        if fi.class_name is None:
+            return None
+        return self.index.classes.get((fi.module.rel, fi.class_name))
+
+    def chain_attr_type(self, ci: ClassInfo, attr: str) -> object:
+        for c in self.index.class_chain(ci):
+            self._build_attr_types(c)
+            tag = self._attr_types.get((c.module.rel, c.name), {}).get(attr)
+            if tag is not None:
+                return tag
+        return None
+
+    # -------------------------------------------------------- lock identity
+    def lock_attrs(self, ci: ClassInfo) -> Set[str]:
+        """Attrs of the chain treated as locks: assigned from a threading
+        lock constructor, named as a ``guarded_by`` guard, or named in a
+        ``@holds_lock`` marker."""
+        key = (ci.module.rel, ci.name)
+        cached = self._lock_attrs.get(key)
+        if cached is not None:
+            return cached
+        out: Set[str] = set()
+        for c in self.index.class_chain(ci):
+            self._build_attr_types(c)
+            ats = self._attr_types.get((c.module.rel, c.name), {})
+            out |= {a for a, tag in ats.items() if tag == "Lock"}
+            out |= set(c.guarded.values())
+            out |= {m.holds_lock for m in c.methods.values() if m.holds_lock}
+        self._lock_attrs[key] = out
+        return out
+
+    def _defines_attr(self, c: ClassInfo, attr: str) -> bool:
+        self._build_attr_types(c)
+        if attr in self._attr_types.get((c.module.rel, c.name), {}):
+            return True
+        if attr in c.guarded.values():
+            return True
+        return any(m.holds_lock == attr for m in c.methods.values())
+
+    def lock_key(self, ci: ClassInfo, attr: str) -> LockKey:
+        """Canonical lock for ``(class, attr)``: the base-most chain class
+        that defines the attr (subclass and base share one runtime lock)."""
+        chain = self.index.class_chain(ci)
+        candidates = [c for c in chain if self._defines_attr(c, attr)]
+        canon = candidates[-1] if candidates else chain[0]
+        ident = (canon.module.rel, canon.name, attr)
+        key = self._lock_keys.get(ident)
+        if key is None:
+            key = self._lock_keys[ident] = LockKey(*ident)
+        key.aliases |= {f"{c.module.modname}.{c.name}.{attr}" for c in chain}
+        return key
+
+    def all_lock_keys(self) -> List[LockKey]:
+        return list(self._lock_keys.values())
+
+    def is_lock_attr(self, ci: Optional[ClassInfo], attr: str) -> bool:
+        if ci is not None and attr in self.lock_attrs(ci):
+            return True
+        return "lock" in attr.lower()    # naming-convention fallback
+
+    def with_lock(self, fi: FuncInfo, expr: ast.AST) -> Optional[LockKey]:
+        """The lock a ``with`` context item acquires, if it is one."""
+        if isinstance(expr, ast.Attribute) and isinstance(expr.value, ast.Name) \
+                and expr.value.id == "self":
+            ci = self.class_of(fi)
+            if ci is not None and self.is_lock_attr(ci, expr.attr):
+                return self.lock_key(ci, expr.attr)
+        return None
+
+    def entry_held(self, fi: FuncInfo) -> FrozenSet[LockKey]:
+        if fi.holds_lock:
+            ci = self.class_of(fi)
+            if ci is not None:
+                return frozenset((self.lock_key(ci, fi.holds_lock),))
+        return frozenset()
+
+    # ----------------------------------------------------- call resolution
+    def resolve_call_ext(self, caller: FuncInfo,
+                         call: ast.Call) -> Optional[FuncInfo]:
+        """Base resolution plus attr-typed receivers: ``self._tree.m(...)``
+        via the inferred class of ``self._tree``, and ``self._step_fn(...)``
+        to the inferred class's ``__call__``."""
+        fi = self.index.resolve_call(caller, call)
+        if fi is not None:
+            return fi
+        ci = self.class_of(caller)
+        if ci is None:
+            return None
+        fn = call.func
+        if isinstance(fn, ast.Attribute) and isinstance(fn.value, ast.Attribute) \
+                and isinstance(fn.value.value, ast.Name) \
+                and fn.value.value.id == "self":
+            tag = self.chain_attr_type(ci, fn.value.attr)
+            if isinstance(tag, ClassInfo):
+                return self.index.find_method(tag, fn.attr)
+        elif isinstance(fn, ast.Attribute) and isinstance(fn.value, ast.Name) \
+                and fn.value.id == "self":
+            tag = self.chain_attr_type(ci, fn.attr)
+            if isinstance(tag, ClassInfo):
+                return self.index.find_method(tag, "__call__")
+        return None
+
+    # ------------------------------------------------------- summaries
+    def summary(self, fi: FuncInfo) -> FuncSummary:
+        s = self._summaries.get(fi)
+        if s is None:
+            s = self._summaries[fi] = FuncSummary()
+            _SummaryVisitor(self, fi, s).visit(fi.node)
+        return s
+
+    # -------------------------------------------------- whole-program views
+    def may_acquire(self) -> Dict[FuncInfo, FrozenSet[LockKey]]:
+        """Fixed point of locks a function may take, callees included."""
+        if self._may_acquire is not None:
+            return self._may_acquire
+        funcs = list(self.index.funcs.values())
+        acq: Dict[FuncInfo, Set[LockKey]] = {}
+        callees: Dict[FuncInfo, List[FuncInfo]] = {}
+        for fi in funcs:
+            s = self.summary(fi)
+            acq[fi] = {lock for lock, _, _ in s.acquisitions}
+            callees[fi] = [c for _, c, _ in s.call_sites if c is not None]
+        changed = True
+        while changed:
+            changed = False
+            for fi in funcs:
+                mine = acq[fi]
+                for g in callees[fi]:
+                    extra = acq.get(g, ())
+                    if not (extra <= mine):
+                        mine |= extra
+                        changed = True
+        self._may_acquire = {fi: frozenset(s) for fi, s in acq.items()}
+        return self._may_acquire
+
+    def _is_thread_ctor(self, mod: Module, fn: ast.AST) -> bool:
+        tail = func_tail_name(fn)
+        if tail not in _THREAD_CTORS:
+            return False
+        if isinstance(fn, ast.Attribute) and isinstance(fn.value, ast.Name):
+            qual = mod.imports.get(fn.value.id, fn.value.id)
+            return qual == "threading" or qual.startswith("threading.")
+        if isinstance(fn, ast.Name):
+            qual = mod.imports.get(fn.id)
+            return bool(qual) and qual.startswith("threading.")
+        return False
+
+    def _resolve_spawn_target(self, fi: FuncInfo,
+                              target: ast.AST) -> Optional[FuncInfo]:
+        mod = fi.module
+        if isinstance(target, ast.Attribute) \
+                and isinstance(target.value, ast.Name) \
+                and target.value.id == "self":
+            ci = self.class_of(fi)
+            if ci is not None:
+                return self.index.find_method(ci, target.attr)
+            return None
+        if isinstance(target, ast.Name):
+            # a nested def inside the spawning function (the dataloader
+            # producer/worker idiom) — synthesise a FuncInfo for it, with
+            # the spawner's class so closed-over `self` resolves
+            for node in ast.walk(fi.node):
+                if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                        and node is not fi.node and node.name == target.id:
+                    return FuncInfo(mod, node, node.name, fi.class_name)
+            local = self.index.module_funcs.get(mod.rel, {}).get(target.id)
+            if local is not None:
+                return local
+            imp = mod.imports.get(target.id)
+            if imp and "." in imp:
+                owner, func = imp.rsplit(".", 1)
+                owner_mod = self.graph.by_modname.get(owner)
+                if owner_mod is not None:
+                    return self.index.module_funcs.get(owner_mod.rel,
+                                                       {}).get(func)
+            return None
+        if isinstance(target, ast.Attribute) \
+                and isinstance(target.value, ast.Name):
+            owner = mod.imports.get(target.value.id)
+            owner_mod = self.graph.by_modname.get(owner) if owner else None
+            if owner_mod is not None:
+                return self.index.module_funcs.get(owner_mod.rel,
+                                                   {}).get(target.attr)
+        return None
+
+    def thread_spawns(self) -> List[ThreadSpawn]:
+        """Every ``Thread(target=...)`` site, with targets resolved and
+        roles named (target's ``@thread_role`` > constant ``name=`` kwarg
+        > target function name)."""
+        if self._spawns is not None:
+            return self._spawns
+        out: List[ThreadSpawn] = []
+        for fi in self.index.funcs.values():
+            for node in ast.walk(fi.node):
+                if not (isinstance(node, ast.Call)
+                        and self._is_thread_ctor(fi.module, node.func)):
+                    continue
+                target_expr = None
+                name_kw = None
+                for kw in node.keywords:
+                    if kw.arg == "target":
+                        target_expr = kw.value
+                    elif kw.arg == "name":
+                        name_kw = kw.value
+                if target_expr is None:
+                    continue
+                target = self._resolve_spawn_target(fi, target_expr)
+                role = None
+                if target is not None and target.thread_role:
+                    role = target.thread_role
+                elif isinstance(name_kw, ast.Constant):
+                    role = str(name_kw.value)
+                elif target is not None:
+                    role = target.name.lstrip("_")
+                else:
+                    tail = func_tail_name(target_expr)
+                    role = (tail or "thread").lstrip("_")
+                out.append(ThreadSpawn(fi, node, target, role))
+        self._spawns = out
+        return out
+
+    def declared_orders(self) -> List[OrderDecl]:
+        """Every module-level ``lock_order(a, op, b)`` call with constant
+        arguments. Declarations are a module-level contract (the runtime
+        annotation is inert), so only top-level statements are scanned —
+        function and class bodies are skipped, which keeps this pass off
+        the full-repo hot path."""
+        if self._decls is not None:
+            return self._decls
+        out: List[OrderDecl] = []
+        skip = (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)
+        for mod in self.graph.modules:
+            for stmt in mod.tree.body:
+                if isinstance(stmt, skip):
+                    continue
+                for node in ast.walk(stmt):
+                    if isinstance(node, ast.Call) \
+                            and func_tail_name(node.func) == "lock_order" \
+                            and len(node.args) == 3 \
+                            and all(isinstance(a, ast.Constant)
+                                    for a in node.args):
+                        out.append(OrderDecl(str(node.args[0].value),
+                                             str(node.args[1].value),
+                                             str(node.args[2].value),
+                                             mod, node))
+        self._decls = out
+        return out
+
+    def match_lock(self, name: str) -> List[LockKey]:
+        """Locks whose dotted ``module.Class.attr`` name ends with the
+        declared suffix (``"RadixTree._lock"`` matches the full name)."""
+        hits = []
+        for key in self._lock_keys.values():
+            for alias in key.aliases:
+                if alias == name or alias.endswith("." + name):
+                    hits.append(key)
+                    break
+        return hits
+
+
+class _SummaryVisitor(ast.NodeVisitor):
+    """One pass: held-set tracking + acquisitions/calls/ops/writes."""
+
+    def __init__(self, conc: ConcurrencyIndex, fi: FuncInfo, out: FuncSummary):
+        self.conc = conc
+        self.fi = fi
+        self.out = out
+        self.ci = conc.class_of(fi)
+        self.root = fi.node
+        self.held: List[LockKey] = list(conc.entry_held(fi))
+        self.timed = 0
+        # best-effort local-variable types for receiver checks, tracked
+        # incrementally in visit_Assign (assignments precede uses in any
+        # code that runs) — no separate pre-walk of the function body
+        self.locals: Dict[str, object] = {}
+        self._time_aliases = {a for a, t in fi.module.imports.items()
+                              if t == "time"}
+        self._os_aliases = {a for a, t in fi.module.imports.items()
+                            if t == "os"}
+        self._sleep_names = {a for a, t in fi.module.imports.items()
+                             if t == "time.sleep"}
+
+    # nested defs/lambdas/classes run later, not under the lexical locks
+    def visit_FunctionDef(self, node):
+        if node is self.root:
+            self.generic_visit(node)
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Lambda(self, node):
+        pass
+
+    def visit_ClassDef(self, node):
+        pass
+
+    def visit_With(self, node: ast.With):
+        timed = _is_timed_with(node)
+        pushed = 0
+        for item in node.items:
+            self.visit(item.context_expr)
+            lk = self.conc.with_lock(self.fi, item.context_expr)
+            if lk is not None:
+                self.out.acquisitions.append(
+                    (lk, item.context_expr,
+                     frozenset(k for k in self.held if k != lk)))
+                self.held.append(lk)
+                pushed += 1
+        if timed:
+            self.timed += 1
+        for stmt in node.body:
+            self.visit(stmt)
+        if timed:
+            self.timed -= 1
+        for _ in range(pushed):
+            self.held.pop()
+
+    visit_AsyncWith = visit_With
+
+    # ------------------------------------------------------------- writes
+    def _note_write(self, attr: str, node: ast.AST):
+        self.out.writes.append((attr, node, bool(self.held)))
+
+    def visit_Attribute(self, node: ast.Attribute):
+        if isinstance(node.ctx, (ast.Store, ast.Del)) \
+                and isinstance(node.value, ast.Name) \
+                and node.value.id == "self":
+            self._note_write(node.attr, node)
+        self.generic_visit(node)
+
+    def visit_Subscript(self, node: ast.Subscript):
+        if isinstance(node.ctx, (ast.Store, ast.Del)) \
+                and isinstance(node.value, ast.Attribute) \
+                and isinstance(node.value.value, ast.Name) \
+                and node.value.value.id == "self":
+            self._note_write(node.value.attr, node)
+        self.generic_visit(node)
+
+    # ------------------------------------------------------------- locals
+    def _local_tag(self, v: ast.AST) -> object:
+        if isinstance(v, ast.Call):
+            return self.conc._ctor_tag(self.fi.module, v)
+        if isinstance(v, ast.Attribute) and isinstance(v.value, ast.Name) \
+                and v.value.id == "self" and self.ci is not None:
+            return self.conc.chain_attr_type(self.ci, v.attr)
+        if isinstance(v, ast.Name):
+            return self.locals.get(v.id)
+        return None
+
+    def visit_Assign(self, node: ast.Assign):
+        # direct constructor calls and aliases of typed self attributes
+        # (tuple unpacking included — the writer-handoff swap idiom)
+        self.generic_visit(node)
+        for tgt in node.targets:
+            if isinstance(tgt, ast.Tuple) \
+                    and isinstance(node.value, ast.Tuple) \
+                    and len(tgt.elts) == len(node.value.elts):
+                pairs = list(zip(tgt.elts, node.value.elts))
+            else:
+                pairs = [(tgt, node.value)]
+            for t, v in pairs:
+                if isinstance(t, ast.Name):
+                    tag = self._local_tag(v)
+                    if tag is not None:
+                        self.locals[t.id] = tag
+
+    # --------------------------------------------------------------- calls
+    def _recv_tag(self, expr: ast.AST) -> object:
+        if isinstance(expr, ast.Attribute) and isinstance(expr.value, ast.Name) \
+                and expr.value.id == "self" and self.ci is not None:
+            return self.conc.chain_attr_type(self.ci, expr.attr)
+        if isinstance(expr, ast.Name):
+            return self.locals.get(expr.id)
+        return None
+
+    def _op(self, kind: str, label: str, node: ast.AST, escaped: bool):
+        self.out.ops.append(BlockingOp(
+            kind, label, node, frozenset(self.held),
+            escaped or self.timed > 0))
+
+    def _scan_blocking(self, node: ast.Call):
+        fn = node.func
+        if not isinstance(fn, (ast.Attribute, ast.Name)):
+            return
+        tail = func_tail_name(fn)
+        if isinstance(fn, ast.Attribute):
+            recv = fn.value
+            # `.acquire()` on a self lock attr: acquisition event (no
+            # held region — the lexical `with` form is the discipline)
+            if tail == "acquire" and isinstance(recv, ast.Attribute) \
+                    and isinstance(recv.value, ast.Name) \
+                    and recv.value.id == "self" and self.ci is not None \
+                    and self.conc.is_lock_attr(self.ci, recv.attr):
+                lk = self.conc.lock_key(self.ci, recv.attr)
+                self.out.acquisitions.append(
+                    (lk, node, frozenset(k for k in self.held if k != lk)))
+                return
+            if tail in _SYNC_ATTRS:
+                self._op("host-sync", f"`.{tail}()` host sync", node, False)
+                return
+            if tail == "sleep" and isinstance(recv, ast.Name) \
+                    and recv.id in self._time_aliases:
+                self._op("sleep", "`time.sleep(...)`", node, False)
+                return
+            if tail == "join":
+                if self._recv_tag(recv) == "Thread":
+                    self._op("thread-join", "`Thread.join()`", node,
+                             _wait_bounded(node))
+                return
+            if tail in ("get", "put"):
+                if self._recv_tag(recv) == "Queue":
+                    self._op("queue-wait", f"`Queue.{tail}()`", node,
+                             _queue_bounded(node))
+                return
+            if tail == "wait":
+                # Condition.wait on a HELD lock releases it while waiting
+                # — that is the sanctioned bounded-wait idiom, not a
+                # blocking op under the lock
+                lk = None
+                if isinstance(recv, ast.Attribute) \
+                        and isinstance(recv.value, ast.Name) \
+                        and recv.value.id == "self" and self.ci is not None \
+                        and self.conc.is_lock_attr(self.ci, recv.attr):
+                    lk = self.conc.lock_key(self.ci, recv.attr)
+                if lk is not None and lk in self.held:
+                    return
+                self._op("wait", "`.wait()` without timeout", node,
+                         _wait_bounded(node))
+                return
+            if tail in _FILE_IO and isinstance(recv, ast.Name) \
+                    and recv.id in self._os_aliases:
+                self._op("file-io", f"`os.{tail}(...)`", node, False)
+                return
+        else:                            # bare Name call
+            if fn.id == "open":
+                self._op("file-io", "`open(...)`", node, False)
+                return
+            if fn.id in self._sleep_names:
+                self._op("sleep", "`time.sleep(...)`", node, False)
+                return
+        # jit dispatch through a jitted self attribute or local
+        if isinstance(fn, ast.Attribute) and isinstance(fn.value, ast.Name) \
+                and fn.value.id == "self" and self.ci is not None \
+                and self.conc.chain_attr_type(self.ci, fn.attr) == "JitFn":
+            self._op("jit-dispatch",
+                     f"jit dispatch `self.{fn.attr}(...)`", node, False)
+        elif isinstance(fn, ast.Name) and self.locals.get(fn.id) == "JitFn":
+            self._op("jit-dispatch", f"jit dispatch `{fn.id}(...)`",
+                     node, False)
+
+    def visit_Call(self, node: ast.Call):
+        self._scan_blocking(node)
+        fn = node.func
+        if isinstance(fn, ast.Attribute) and fn.attr in _MUTATORS \
+                and isinstance(fn.value, ast.Attribute) \
+                and isinstance(fn.value.value, ast.Name) \
+                and fn.value.value.id == "self":
+            self._note_write(fn.value.attr, node)
+        callee = self.conc.resolve_call_ext(self.fi, node)
+        if callee is not None:
+            self.out.call_sites.append((node, callee, frozenset(self.held)))
+        self.generic_visit(node)
+
+
+def concurrency_index(graph: ModuleGraph,
+                      index: FunctionIndex) -> ConcurrencyIndex:
+    """The per-run shared instance (cached on the FunctionIndex)."""
+    conc = getattr(index, "_graft_concurrency", None)
+    if conc is None or conc.graph is not graph:
+        conc = ConcurrencyIndex(graph, index)
+        index._graft_concurrency = conc
+    return conc

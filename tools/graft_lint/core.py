@@ -27,6 +27,7 @@ The shared substrate every checker runs on:
 from __future__ import annotations
 
 import ast
+import hashlib
 import json
 import os
 import re
@@ -34,6 +35,7 @@ from typing import Dict, List, Optional, Set, Tuple
 
 __all__ = [
     "Baseline",
+    "Directive",
     "Finding",
     "Module",
     "ModuleGraph",
@@ -69,11 +71,18 @@ class Finding:
         """Line-independent identity used by the baseline."""
         return (self.rule, self.file, self.symbol, self.message)
 
+    def stable_id(self) -> str:
+        """Line-independent hex id (SARIF partialFingerprints-style) —
+        stable across edits above the finding, for CI result tracking."""
+        raw = "\x1f".join(self.fingerprint()).encode("utf-8")
+        return hashlib.sha1(raw).hexdigest()[:16]
+
     def to_dict(self) -> Dict[str, object]:
         return {"rule": self.rule, "file": self.file, "line": self.line,
                 "col": self.col, "symbol": self.symbol,
                 "message": self.message, "suppressed": self.suppressed,
-                "baselined": self.baselined}
+                "baselined": self.baselined,
+                "fingerprint": self.stable_id()}
 
     def render(self) -> str:
         sym = f" (in {self.symbol})" if self.symbol else ""
@@ -106,6 +115,26 @@ def func_tail_name(node: ast.AST) -> Optional[str]:
     return None
 
 
+class Directive:
+    """One suppression comment, tracked for the stale-suppression audit.
+
+    ``line`` is where the comment sits; ``target`` is the code line it
+    suppresses findings on (None for ``disable-file``). ``used`` is set
+    by ``run_lint`` when the directive silences at least one finding —
+    a directive that silences nothing is dead weight that will silently
+    swallow the NEXT real finding on that line, so it fails the run."""
+
+    __slots__ = ("kind", "line", "rules", "target", "used")
+
+    def __init__(self, kind: str, line: int, rules: Set[str],
+                 target: Optional[int]):
+        self.kind = kind                 # disable / disable-next / -file
+        self.line = line
+        self.rules = rules
+        self.target = target
+        self.used = False
+
+
 class Module:
     """One parsed source file: AST + lines + imports + suppressions."""
 
@@ -129,6 +158,7 @@ class Module:
         # line -> set of suppressed rules ("all" suppresses everything)
         self.line_suppress: Dict[int, Set[str]] = {}
         self.file_suppress: Set[str] = set()
+        self.directives: List[Directive] = []
         self._collect_suppressions()
 
     def _collect_imports(self):
@@ -160,6 +190,8 @@ class Module:
                 rules = {r.strip() for r in m.group(2).split(",") if r.strip()}
                 if kind == "disable-file":
                     self.file_suppress |= rules
+                    self.directives.append(
+                        Directive(kind, lineno, rules, None))
                 elif kind == "disable-next":
                     # bind to the next CODE line (skip blank/comment lines,
                     # so a directive may span multiple comment lines)
@@ -170,8 +202,12 @@ class Module:
                             break
                         target += 1
                     self.line_suppress.setdefault(target, set()).update(rules)
+                    self.directives.append(
+                        Directive(kind, lineno, rules, target))
                 else:
                     self.line_suppress.setdefault(lineno, set()).update(rules)
+                    self.directives.append(
+                        Directive(kind, lineno, rules, lineno))
 
     def is_suppressed(self, rule: str, line: int) -> bool:
         if rule in self.file_suppress or "all" in self.file_suppress:

@@ -1,10 +1,13 @@
 #!/usr/bin/env python
-"""graft_lint driver: one entry point for all seven static checkers.
+"""graft_lint driver: one entry point for all eleven static checkers.
 
     python tools/lint.py                  # paddle_tpu/ + tools/, exit 0/1
     python tools/lint.py --json           # full machine-readable report
     python tools/lint.py --changed        # only files changed vs git HEAD
     python tools/lint.py --rules guarded-by,span-manifest
+    python tools/lint.py --rules concurrency   # group alias (lock-order,
+                                          # thread-role, blocking-under-
+                                          # lock, guarded-by)
     python tools/lint.py --write-baseline # accept current findings
 
 Runs on stdlib only (ast + regex text scans — no jax, no import of the
@@ -31,6 +34,8 @@ if REPO_ROOT not in sys.path:
 from tools.graft_lint import (  # noqa: E402
     ALL_CHECKERS,
     Baseline,
+    RULE_GROUPS,
+    STALE_RULE,
     default_baseline_path,
     run_lint,
 )
@@ -61,7 +66,8 @@ def main(argv=None) -> int:
                     help="directory (or file) to scan; repeatable "
                          "(default: paddle_tpu/ and tools/)")
     ap.add_argument("--rules", default=None,
-                    help="comma-separated rule subset")
+                    help="comma-separated rule subset; group aliases "
+                         "(e.g. 'concurrency') expand to their members")
     ap.add_argument("--json", action="store_true",
                     help="emit the full report as JSON")
     ap.add_argument("--changed", action="store_true",
@@ -78,6 +84,10 @@ def main(argv=None) -> int:
     if args.list_rules:
         for c in ALL_CHECKERS:
             print(f"{c.rule:24s} {c.description}")
+        print(f"{STALE_RULE:24s} suppression comments matching zero "
+              f"findings (audit — always on for full runs)")
+        for name, members in sorted(RULE_GROUPS.items()):
+            print(f"{name:24s} group = {', '.join(members)}")
         return 0
 
     roots = args.root or [os.path.join(REPO_ROOT, "paddle_tpu"),
