@@ -833,6 +833,55 @@ def bench_serving_router(on_tpu):
     }))
 
 
+def bench_serving_fleet_trace(on_tpu):
+    """Fleet-wide observability
+    (tools/serve_bench.run_fleet_trace_suite): the replica-kill drill
+    with journey tracing and the router's timeline sampler on. Asserts
+    every accepted request got exactly ONE journey track, every
+    failed-over request's track carries the explicit ``req.failover``
+    span (the survivor continued the same timeline), and the forced
+    flight-recorder alarm produced a correlated postmortem bundle
+    through the wired auto-capture path. Host-path measurement —
+    CPU-sized; the artifact is BENCH_serving_fleet_trace.json plus the
+    journey chrome trace BENCH_serving_fleet_journeys.json."""
+    import sys
+
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from tools.serve_bench import run_fleet_trace_suite
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    art = run_fleet_trace_suite(smoke=True, out_dir=here, num_replicas=3)
+    assert art["journey_coverage"] == 1.0, (
+        "requests without a journey: %d tracked of %d accepted"
+        % (art["journeys_tracked"],
+           art["config"]["num_requests"]))
+    assert art["requests_failed_over"] > 0, (
+        "kill drill failed nothing over — the cross-replica track is "
+        "untested")
+    assert art["failover_track_coverage"] == 1.0, (
+        "failed-over requests missing the req.failover span on their "
+        "journey track")
+    assert art["one_track_per_request"], (
+        "journey chrome trace emitted duplicate/missing request tracks")
+    assert art["postmortems"]["captures"] >= 2, (
+        "expected breaker_open + forced-alarm bundles, got %s"
+        % art["postmortems"])
+    assert art["forced_alarm_bundle"]["kind"] == "ttft_breach_storm", (
+        art["forced_alarm_bundle"])
+    assert art["timeline"]["samples_taken"] >= 3, art["timeline"]
+    print(json.dumps({
+        "metric": "serving_fleet_journey_coverage",
+        "value": art["journey_coverage"],
+        "unit": "fraction of accepted requests with a cross-replica "
+                "journey track in the fleet chrome trace",
+        "failover_track_coverage": art["failover_track_coverage"],
+        "requests_failed_over": art["requests_failed_over"],
+        "postmortem_captures": art["postmortems"]["captures"],
+        "timeline_samples": art["timeline"]["samples_taken"],
+        "within_budget": art["within_budget"],
+    }))
+
+
 def bench_ckpt(on_tpu):
     """Checkpoint lifecycle: sync save throughput, async snapshot stall
     (the train-step pause a background save costs), and cold resume
@@ -1084,6 +1133,7 @@ for _f in (bench_chip_ceilings, bench_resnet50, bench_bert, bench_ernie,
            bench_serving_chaos,
            bench_serving_async,
            bench_serving_router,
+           bench_serving_fleet_trace,
            bench_ckpt,
            bench_train,
            bench_lint,

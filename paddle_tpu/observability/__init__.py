@@ -42,10 +42,17 @@ serving, and distributed code:
   FLOPs, bytes accessed, peak temp memory, donation map — plus the
   ``DeviceTimeSampler`` + ``roofline_utilization`` pair that turns them
   into ``train_mfu`` / ``serving_decode_bandwidth_util``.
+- **Fleet observability** (``fleet.py``): cross-replica request journeys
+  (``FleetTracer`` — one chrome-trace track per router request spanning
+  failovers), tiered metrics time-series history (``MetricsTimeline`` —
+  1 s raw / 10 s / 60 s rings over every registry), and automated
+  postmortem bundles (``PostmortemStore`` — one correlated artifact per
+  alarm: timeline window + flight tail + journeys + breaker state +
+  device census).
 - **Live endpoint** (``endpoint.py``): stdlib-http ``/metrics`` (Prometheus
   text across registries) + ``/debug`` index (``/debug/requests``,
-  ``/debug/replicas``, ``/debug/programs``, ``/debug/memory``) +
-  ``/healthz``.
+  ``/debug/replicas``, ``/debug/programs``, ``/debug/memory``,
+  ``/debug/timeline``, ``/debug/postmortem``) + ``/healthz``.
 
 Typical use::
 
@@ -77,10 +84,17 @@ from paddle_tpu.observability.device_memory import (  # noqa: F401
 from paddle_tpu.observability.endpoint import (  # noqa: F401
     ObservabilityEndpoint,
 )
+from paddle_tpu.observability.fleet import (  # noqa: F401
+    FleetTracer,
+    Journey,
+    MetricsTimeline,
+    PostmortemStore,
+)
 from paddle_tpu.observability.metrics import (  # noqa: F401
     Counter,
     Gauge,
     Histogram,
+    MetricsCardinalityOverflow,
     MetricsRegistry,
     get_registry,
     parse_prometheus_text,
@@ -117,13 +131,18 @@ __all__ = [
     "DeviceMemoryLedger",
     "DeviceTimeSampler",
     "EvictionThrash",
+    "FleetTracer",
     "FlightRecorder",
     "Gauge",
     "Histogram",
+    "Journey",
     "LedgerHandle",
+    "MetricsCardinalityOverflow",
     "MetricsRegistry",
+    "MetricsTimeline",
     "OWNERS",
     "ObservabilityEndpoint",
+    "PostmortemStore",
     "ProgramInventory",
     "RecompileStorm",
     "RequestTrace",

@@ -85,6 +85,8 @@ class ObservabilityEndpoint:
         self._health_sources: "Dict[str, Callable[[], dict]]" = {}
         self._replica_sources: "Dict[str, Callable[[], dict]]" = {}
         self._memory_sources: "Dict[str, Callable[[], dict]]" = {}
+        self._timelines: Dict[str, object] = {}     # MetricsTimeline
+        self._postmortems: Dict[str, object] = {}   # PostmortemStore
         self._host = host
         self._port = int(port)
         self._server: Optional[ThreadingHTTPServer] = None
@@ -111,11 +113,22 @@ class ObservabilityEndpoint:
         dict, rendered under ``name`` in ``/debug/memory``."""
         self._memory_sources[str(name)] = fn
 
+    def add_timeline(self, name: str, timeline):
+        """Attach a ``MetricsTimeline``; queryable under ``name`` via
+        ``/debug/timeline?metric=...&last=N&tier=...``."""
+        self._timelines[str(name)] = timeline
+
+    def add_postmortem(self, name: str, store):
+        """Attach a ``PostmortemStore``; ``/debug/postmortem`` captures an
+        on-demand bundle from it and returns everything retained."""
+        self._postmortems[str(name)] = store
+
     def add_scheduler(self, scheduler, name: Optional[str] = None):
         """Attach a ContinuousBatchingScheduler: its metrics registry feeds
         ``/metrics``, ``debug_state()`` feeds ``/debug/requests``,
-        ``health()`` feeds ``/healthz``, and (when device observability is
-        on) its ledger census feeds ``/debug/memory``."""
+        ``health()`` feeds ``/healthz``, (when device observability is
+        on) its ledger census feeds ``/debug/memory``, and its timeline /
+        postmortem stores feed ``/debug/timeline`` + ``/debug/postmortem``."""
         self.add_registry(scheduler.metrics.registry)
         key = name or f"scheduler{len(self._debug_sources)}"
         self.add_debug_source(key, scheduler.debug_state)
@@ -124,14 +137,20 @@ class ObservabilityEndpoint:
         ledger = getattr(scheduler, "device_ledger", None)
         if ledger is not None:
             self.add_memory_source(key, ledger.census_report)
+        if getattr(scheduler, "timeline", None) is not None:
+            self.add_timeline(key, scheduler.timeline)
+        if getattr(scheduler, "postmortems", None) is not None:
+            self.add_postmortem(key, scheduler.postmortems)
         return self
 
     def add_router(self, router, name: Optional[str] = None):
         """Attach a ``ServingRouter``: its router-level registry (fault
         counters + per-replica labeled gauges) plus every replica
         scheduler's registry feed ``/metrics``, its fleet ``health()``
-        feeds ``/healthz``, and ``debug_state()`` feeds both
-        ``/debug/requests`` and the dedicated ``/debug/replicas`` page."""
+        feeds ``/healthz``, ``debug_state()`` feeds both
+        ``/debug/requests`` and the dedicated ``/debug/replicas`` page,
+        and its fleet timeline / postmortem stores feed
+        ``/debug/timeline`` + ``/debug/postmortem``."""
         self.add_registry(router.metrics.registry)
         for rep in router.replicas:
             self.add_registry(rep.sched.metrics.registry)
@@ -139,6 +158,10 @@ class ObservabilityEndpoint:
         self.add_debug_source(key, router.debug_state)
         self.add_health_source(key, router.health)
         self._replica_sources[key] = router.debug_state
+        if getattr(router, "timeline", None) is not None:
+            self.add_timeline(key, router.timeline)
+        if getattr(router, "postmortems", None) is not None:
+            self.add_postmortem(key, router.postmortems)
         return self
 
     # ------------------------------------------------------------ content
@@ -195,6 +218,42 @@ class ObservabilityEndpoint:
                 out[name] = {"error": f"{type(e).__name__}: {e}"}
         return out
 
+    def debug_timeline(self, metric: Optional[str] = None,
+                       last: Optional[int] = None,
+                       tier: str = "raw") -> dict:
+        """The ``/debug/timeline`` payload. Without ``metric``: per-store
+        tier summaries + available metric names. With ``metric``: the
+        ``[(t, value)]`` series from every attached timeline that has it."""
+        out = {}
+        for name, tl in self._timelines.items():
+            try:
+                if metric is None:
+                    out[name] = {"summary": tl.snapshot(),
+                                 "metrics": tl.metric_names()}
+                else:
+                    out[name] = {"metric": metric, "tier": tier,
+                                 "points": tl.query(metric, last=last,
+                                                    tier=tier)}
+            except Exception as e:  # a broken source must not 500 the page
+                out[name] = {"error": f"{type(e).__name__}: {e}"}
+        return out
+
+    def debug_postmortem(self, capture: bool = True) -> dict:
+        """The ``/debug/postmortem`` payload: optionally freeze one
+        on-demand bundle per attached store (default), then return every
+        retained bundle — the mid-incident "give me everything" curl."""
+        out = {}
+        for name, store in self._postmortems.items():
+            try:
+                if capture:
+                    store.capture("on_demand", "requested via "
+                                  "/debug/postmortem", force=True)
+                out[name] = {"summary": store.summary(),
+                             "bundles": store.bundles()}
+            except Exception as e:  # a broken source must not 500 the page
+                out[name] = {"error": f"{type(e).__name__}: {e}"}
+        return out
+
     DEBUG_ROUTES = {
         "/metrics": "Prometheus text exposition across attached registries",
         "/debug": "this index",
@@ -205,6 +264,12 @@ class ObservabilityEndpoint:
                            "analysis (?analyze=0 to skip analysis)",
         "/debug/memory": "owner-tagged device-memory census + OOM "
                          "forensics",
+        "/debug/timeline": "metrics time-series history "
+                           "(?metric=NAME&last=N&tier=raw|10s|60s; no "
+                           "metric lists names + retention)",
+        "/debug/postmortem": "correlated incident bundles; captures an "
+                             "on-demand bundle first (?capture=0 to only "
+                             "list)",
         "/healthz": "worst health state across attached sources",
     }
 
@@ -280,6 +345,28 @@ class ObservabilityEndpoint:
                     self._send(200, body, "application/json")
                 elif url.path == "/debug/memory":
                     body = json.dumps(ep.debug_memory(),
+                                      default=str, indent=2)
+                    self._send(200, body, "application/json")
+                elif url.path == "/debug/timeline":
+                    q = parse_qs(url.query)
+                    metric = q.get("metric", [None])[0]
+                    tier = q.get("tier", ["raw"])[0]
+                    last = None
+                    if "last" in q:
+                        try:
+                            last = int(q["last"][0])
+                        except ValueError:
+                            pass
+                    body = json.dumps(
+                        ep.debug_timeline(metric=metric, last=last,
+                                          tier=tier),
+                        default=str, indent=2)
+                    self._send(200, body, "application/json")
+                elif url.path == "/debug/postmortem":
+                    q = parse_qs(url.query)
+                    capture = q.get("capture", ["1"])[0] not in ("0",
+                                                                 "false")
+                    body = json.dumps(ep.debug_postmortem(capture=capture),
                                       default=str, indent=2)
                     self._send(200, body, "application/json")
                 elif url.path in ("/debug", "/debug/"):

@@ -1,0 +1,558 @@
+"""Fleet-wide observability: journeys, metrics timelines, postmortems.
+
+PR 11's multi-replica router broke the "one request = one timeline"
+property: a failover via ``export_restartable()`` → ``import_resumed()``
+used to produce two disjoint ``RequestTracer`` histories on two replicas,
+and every registry is a point-in-time snapshot with no history to answer
+"what changed in the 30 s before this breach". This module restores both
+properties at the fleet level:
+
+- ``FleetTracer`` / ``Journey`` — the router stamps every request with a
+  journey context (route decision, affinity outcome, replica id,
+  generation). On failover the reaped spec carries the request's trace
+  snapshot, ``import_resumed()`` continues the SAME timeline on the
+  survivor (with an explicit ``failover`` phase bridging export → import),
+  and the journey records the replica hop plus router-side ``route`` /
+  ``spill`` / ``reap`` / ``replay`` spans — all anchored to the request's
+  original arrival stamp. ``chrome_trace()`` renders ONE track per router
+  request spanning every replica it touched.
+
+- ``MetricsTimeline`` — a background sampler (thread role
+  ``fleet-sample``) snapshots every attached source (serving registries,
+  router fleet gauges, device ledger, stall phases) into bounded
+  in-memory rings with tiered downsampling (1 s raw / 10 s / 60 s by
+  default), queryable per metric (``/debug/timeline?metric=...&last=N``)
+  and dumpable to JSONL. Sources are plain callables returning JSON-able
+  dicts; numeric leaves are flattened to dotted metric names.
+
+- ``PostmortemStore`` — when any alarm fires (``TTFTBreachStorm``,
+  ``EvictionThrash``, ``StallStorm``, breaker open, ``KVPoolExhausted``)
+  or on demand (``/debug/postmortem``), freeze one correlated bundle: the
+  triggering alarm, the timeline window around it, the flight-recorder
+  tail, affected request journeys, degradation/breaker state, and the
+  device-memory census — one artifact that answers "why" without a live
+  session.
+
+Lock discipline (pinned by graft_lint): every class here collects its
+inputs OUTSIDE its own lock (sources, context providers, trace lookups)
+and only touches its ring/table under it, so no lock-order edge points
+back into the scheduler or router locks that call in.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from collections import deque
+from typing import Callable, Dict, List, Optional, Tuple
+
+from paddle_tpu.observability.annotations import guarded_by, thread_role
+from paddle_tpu.profiler import RecordEvent
+
+__all__ = [
+    "FleetTracer",
+    "Journey",
+    "JOURNEY_SPANS",
+    "MetricsTimeline",
+    "PostmortemStore",
+    "TIMELINE_TIERS",
+]
+
+# router-side journey span names (the fleet half of the request timeline)
+JOURNEY_SPANS = ("route", "spill", "reap", "replay")
+
+
+# --------------------------------------------------------------- journeys
+
+class Journey:
+    """One request's cross-replica itinerary, keyed by ROUTER request id.
+
+    ``segments`` records every (replica_id, generation, replica_rid) the
+    request lived on, in order; ``spans`` records the router-side work
+    (route/spill/reap/replay) as ``(name, t0, t1, args)`` tuples in the
+    same absolute ``perf_counter`` domain as ``RequestTrace`` phases, so
+    one chrome track can interleave both."""
+
+    __slots__ = ("router_rid", "arrival_t", "finish_t", "segments",
+                 "spans", "meta")
+
+    def __init__(self, router_rid: int, t: Optional[float] = None, **meta):
+        self.router_rid = int(router_rid)
+        self.arrival_t = time.perf_counter() if t is None else float(t)
+        self.finish_t: Optional[float] = None
+        # [{"replica_id", "generation", "replica_rid", "t"}], oldest first
+        self.segments: List[Dict[str, object]] = []
+        self.spans: List[tuple] = []
+        self.meta: Dict[str, object] = dict(meta)
+
+    @property
+    def failovers(self) -> int:
+        return max(0, len(self.segments) - 1)
+
+    def current_segment(self) -> Optional[Dict[str, object]]:
+        return self.segments[-1] if self.segments else None
+
+    def to_dict(self) -> Dict[str, object]:
+        return {
+            "router_rid": self.router_rid,
+            "arrival_t": self.arrival_t,
+            "finish_t": self.finish_t,
+            "failovers": self.failovers,
+            "segments": [dict(s) for s in self.segments],
+            "spans": [{"name": n, "t0": t0, "dur_s": t1 - t0, **args}
+                      for n, t0, t1, args in self.spans],
+            **self.meta,
+        }
+
+
+class FleetTracer:
+    """Journey store for one router: live journeys by router rid plus a
+    bounded ring of finished ones (mirroring ``RequestTracer``'s shape).
+
+    Thread contract: the router's driving loop and submitter threads
+    write while the endpoint/postmortem threads read — both tables live
+    under ``_lock``. Span/segment recording mutates the Journey object
+    under the same lock (journeys are never handed out for mutation)."""
+
+    _live: guarded_by("_lock")
+    _done: guarded_by("_lock")
+
+    def __init__(self, enabled: bool = True, max_completed: int = 512):
+        self.enabled = bool(enabled)
+        self.max_completed = int(max_completed)
+        self._live: Dict[int, Journey] = {}
+        self._done: "deque[Journey]" = deque(maxlen=self.max_completed)
+        self._lock = threading.Lock()
+        self._epoch = time.perf_counter()
+
+    # ---------------------------------------------------------- lifecycle
+    def start(self, router_rid: int, *, t: Optional[float] = None,
+              replica_id: int, generation: int, replica_rid: int,
+              decision: str, **meta) -> Optional[Journey]:
+        """Stamp one routed request with its journey context. ``t`` is the
+        request's router-side arrival (the ``route`` span's start)."""
+        if not self.enabled:
+            return None
+        now = time.perf_counter()
+        j = Journey(router_rid, t=t, decision=decision, **meta)
+        j.segments.append({"replica_id": int(replica_id),
+                           "generation": int(generation),
+                           "replica_rid": int(replica_rid), "t": now})
+        j.spans.append(("route", j.arrival_t, now,
+                        {"replica": int(replica_id), "decision": decision}))
+        if decision in ("affinity_spill", "affinity_fallback"):
+            # the placement left the bound replica: a zero-width marker at
+            # the route decision, distinguishable from the route span
+            j.spans.append(("spill", now, now, {"decision": decision}))
+        with self._lock:
+            self._live[j.router_rid] = j
+        return j
+
+    def record_span(self, router_rid: int, name: str, t0: float, t1: float,
+                    **args) -> None:
+        """Append one router-side span (``reap``/``replay``/...) to a live
+        journey; unknown rids are dropped (already finished/failed)."""
+        if not self.enabled:
+            return
+        with self._lock:
+            j = self._live.get(router_rid)
+            if j is not None:
+                j.spans.append((name, float(t0), float(t1), args))
+
+    def move(self, router_rid: int, *, replica_id: int, generation: int,
+             replica_rid: int, t: Optional[float] = None) -> None:
+        """Record a failover hop: the request now lives on
+        ``(replica_id, generation, replica_rid)``."""
+        if not self.enabled:
+            return
+        t = time.perf_counter() if t is None else float(t)
+        with self._lock:
+            j = self._live.get(router_rid)
+            if j is not None:
+                j.segments.append({"replica_id": int(replica_id),
+                                   "generation": int(generation),
+                                   "replica_rid": int(replica_rid), "t": t})
+
+    def finish(self, router_rid: int, t: Optional[float] = None,
+               **meta) -> None:
+        if not self.enabled:
+            return
+        t = time.perf_counter() if t is None else float(t)
+        with self._lock:
+            j = self._live.pop(router_rid, None)
+            if j is None:
+                return
+            j.finish_t = t
+            j.meta.update(meta)
+            self._done.append(j)
+
+    # ------------------------------------------------------------ reading
+    def get(self, router_rid: int) -> Optional[Journey]:
+        with self._lock:
+            j = self._live.get(router_rid)
+            if j is not None:
+                return j
+            for d in self._done:
+                if d.router_rid == router_rid:
+                    return d
+            return None
+
+    def journeys(self) -> List[Journey]:
+        """Completed then live, oldest first — a consistent snapshot."""
+        with self._lock:
+            return list(self._done) + list(self._live.values())
+
+    def to_json(self, last: Optional[int] = None) -> List[Dict[str, object]]:
+        rows = [j.to_dict() for j in self.journeys()]
+        return rows[-last:] if last else rows
+
+    # synthetic pid for the fleet tracks (mirrors RequestTracer._PID — a
+    # different pid so both traces can be merged into one viewer session)
+    _PID = 2
+
+    def chrome_trace(self, resolve: Optional[Callable] = None
+                     ) -> Dict[str, object]:
+        """One chrome ``traceEvents`` JSON with ONE track per router
+        request spanning every replica it touched. ``resolve(segment)``
+        maps a journey segment to the ``RequestTrace`` holding its phase
+        timeline (the router passes a replica-tracer lookup); because a
+        failover RESUMES the same timeline on the survivor, the LAST
+        resolvable segment already carries the full cross-replica phase
+        history — including the explicit ``failover`` phase. Router-side
+        route/spill/reap/replay spans interleave on the same track,
+        anchored to the request's original arrival."""
+        pid = self._PID
+        ev: List[dict] = [{"name": "process_name", "ph": "M", "pid": pid,
+                           "tid": 0, "args": {"name": "fleet journeys"}}]
+        e0 = self._epoch
+        now = time.perf_counter()
+        for j in self.journeys():
+            tid = int(j.router_rid)
+            path = "→".join(str(s["replica_id"]) for s in j.segments)
+            ev.append({"name": "thread_name", "ph": "M", "pid": pid,
+                       "tid": tid,
+                       "args": {"name": f"request {j.router_rid} "
+                                        f"(replica {path})"}})
+            # request phase timeline from the owning replica's tracer:
+            # walk segments newest-first, first resolvable one wins (it
+            # holds the whole resumed history)
+            tr = None
+            if resolve is not None:
+                for seg in reversed(j.segments):
+                    tr = resolve(seg)
+                    if tr is not None:
+                        break
+            if tr is not None:
+                end = j.finish_t if j.finish_t is not None else now
+                for phase, t0, t1 in list(tr.phases):
+                    if phase == "done":
+                        continue
+                    ev.append({
+                        "name": f"req.{phase}", "cat": "journey",
+                        "ph": "X", "pid": pid, "tid": tid,
+                        "ts": (t0 - e0) * 1e6, "dur": (t1 - t0) * 1e6,
+                        "args": {"router_rid": j.router_rid},
+                    })
+                if tr.finish_t is None:
+                    # live request mid-incident: open final span to "now"
+                    ev.append({
+                        "name": f"req.{tr.current_phase}", "cat": "journey",
+                        "ph": "X", "pid": pid, "tid": tid,
+                        "ts": (tr._cur_t0 - e0) * 1e6,
+                        "dur": max(end - tr._cur_t0, 0.0) * 1e6,
+                        "args": {"router_rid": j.router_rid, "open": True},
+                    })
+            for name, t0, t1, args in j.spans:
+                ev.append({
+                    "name": f"router.{name}", "cat": "router", "ph": "X",
+                    "pid": pid, "tid": tid,
+                    "ts": (t0 - e0) * 1e6, "dur": (t1 - t0) * 1e6,
+                    "args": {"router_rid": j.router_rid, **args},
+                })
+        return {"traceEvents": ev}
+
+
+# ---------------------------------------------------------- metrics rings
+
+# (tier name, sample interval seconds, samples retained). Raw keeps two
+# minutes at 1 Hz; the 10 s tier an hour; the 60 s tier a day.
+TIMELINE_TIERS = (("raw", 1.0, 120), ("10s", 10.0, 360), ("60s", 60.0, 1440))
+
+
+def _flatten(obj, prefix: str, out: Dict[str, float]) -> None:
+    if isinstance(obj, dict):
+        for k, v in obj.items():
+            key = f"{prefix}.{k}" if prefix else str(k)
+            _flatten(v, key, out)
+    elif isinstance(obj, bool):
+        out[prefix] = 1.0 if obj else 0.0
+    elif isinstance(obj, (int, float)):
+        out[prefix] = float(obj)
+
+
+class MetricsTimeline:
+    """Tiered time-series recorder over point-in-time metric sources.
+
+    Every ``sample_once()`` reads each source (a callable returning a
+    JSON-able dict — registry snapshots, stall breakdowns, fleet gauges),
+    flattens the numeric leaves to ``source.dotted.path`` names, and
+    appends one ``(t, values)`` row per tier whose interval has elapsed.
+    Rings are bounded deques, so retention is O(sum of tier capacities)
+    regardless of uptime. ``start(interval_s)`` spawns the background
+    sampler thread (role ``fleet-sample``); schedulers/routers leave it
+    off by default and benches/tests drive ``sample_once()`` inline.
+
+    Thread contract: the sampler thread writes while the endpoint and
+    postmortem threads query — rings and tier cursors live under
+    ``_lock``; source callables run OUTSIDE it (they take their own
+    registry locks)."""
+
+    _rings: guarded_by("_lock")
+    _last_t: guarded_by("_lock")
+    _samples: guarded_by("_lock")
+    _names: guarded_by("_lock")
+
+    def __init__(self, tiers: Tuple = TIMELINE_TIERS):
+        self.tiers = tuple((str(n), float(iv), int(cap))
+                           for n, iv, cap in tiers)
+        self._sources: Dict[str, Callable[[], dict]] = {}
+        self._lock = threading.Lock()
+        self._rings: Dict[str, deque] = {
+            n: deque(maxlen=cap) for n, _, cap in self.tiers}
+        self._last_t: Dict[str, Optional[float]] = {
+            n: None for n, _, _ in self.tiers}
+        self._samples = 0
+        self._names: set = set()
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        self.interval_s: float = 0.0
+
+    # --------------------------------------------------------- attachment
+    def add_source(self, name: str, fn: Callable[[], dict]) -> None:
+        """Register one snapshot source; sources added while the sampler
+        runs join at the next tick (the dict is replaced, not mutated)."""
+        srcs = dict(self._sources)
+        srcs[str(name)] = fn
+        self._sources = srcs
+
+    # ----------------------------------------------------------- sampling
+    def sample_once(self, t: Optional[float] = None) -> Dict[str, float]:
+        """One synchronous sampling pass; returns the flattened values.
+        Collection runs outside ``_lock`` so a slow source can never
+        block a concurrent query, only delay its own tick."""
+        t = time.perf_counter() if t is None else float(t)
+        values: Dict[str, float] = {}
+        with RecordEvent("fleet.sample"):
+            for name, fn in self._sources.items():
+                try:
+                    _flatten(fn(), name, values)
+                except Exception as e:  # a broken source must not kill
+                    values[f"{name}.sample_error"] = 1.0
+                    values.setdefault("_errors", 0.0)
+                    values["_errors"] += 1.0
+                    del e
+        with self._lock:
+            self._samples += 1
+            self._names.update(values)
+            for name, interval, _ in self.tiers:
+                last = self._last_t[name]
+                if last is None or t - last >= interval - 1e-9:
+                    self._rings[name].append((t, values))
+                    self._last_t[name] = t
+        return values
+
+    # ----------------------------------------------------- sampler thread
+    def start(self, interval_s: float = 1.0) -> threading.Thread:
+        """Spawn the background sampler (idempotent)."""
+        if self._thread is not None and self._thread.is_alive():
+            return self._thread
+        self.interval_s = float(interval_s)
+        self._stop.clear()
+        th = threading.Thread(target=self._run, name="fleet-sample",
+                              daemon=True)
+        self._thread = th
+        th.start()
+        return th
+
+    @thread_role("fleet-sample")
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            self.sample_once()
+            self._stop.wait(self.interval_s)
+
+    def stop(self, timeout: float = 5.0) -> None:
+        self._stop.set()
+        th = self._thread
+        if th is not None:
+            th.join(timeout)
+            self._thread = None
+
+    # ------------------------------------------------------------ reading
+    @property
+    def samples_taken(self) -> int:
+        with self._lock:
+            return self._samples
+
+    def metric_names(self) -> List[str]:
+        with self._lock:
+            return sorted(self._names)
+
+    def query(self, metric: str, last: Optional[int] = None,
+              tier: str = "raw") -> List[Tuple[float, float]]:
+        """``[(t, value)]`` for one flattened metric name, oldest first.
+        Samples missing the metric are skipped (a source added later)."""
+        with self._lock:
+            if tier not in self._rings:
+                raise KeyError(f"unknown tier {tier!r} "
+                               f"(known: {[n for n, _, _ in self.tiers]})")
+            rows = list(self._rings[tier])
+        out = [(t, vals[metric]) for t, vals in rows if metric in vals]
+        return out[-last:] if last else out
+
+    def window(self, last_s: float = 30.0, t: Optional[float] = None,
+               tier: str = "raw") -> List[Dict[str, object]]:
+        """Full samples inside ``[t - last_s, t]`` — the postmortem's
+        "what changed right before this" view."""
+        t = time.perf_counter() if t is None else float(t)
+        with self._lock:
+            rows = list(self._rings.get(tier, ()))
+        return [{"t": st, "values": vals} for st, vals in rows
+                if t - last_s <= st <= t]
+
+    def snapshot(self) -> Dict[str, object]:
+        with self._lock:
+            return {
+                "samples_taken": self._samples,
+                "interval_s": self.interval_s,
+                "sampler_alive": (self._thread is not None
+                                  and self._thread.is_alive()),
+                "metrics": len(self._names),
+                "tiers": {n: {"interval_s": iv, "capacity": cap,
+                              "retained": len(self._rings[n])}
+                          for n, iv, cap in self.tiers},
+            }
+
+    def dump_jsonl(self, path: str, tier: str = "raw") -> str:
+        """One JSON object per line: ``{"t": ..., "values": {...}}``."""
+        with self._lock:
+            if tier not in self._rings:
+                raise KeyError(f"unknown tier {tier!r}")
+            rows = list(self._rings[tier])
+        with open(path, "w") as f:
+            for t, vals in rows:
+                f.write(json.dumps({"t": t, "values": vals},
+                                   sort_keys=True) + "\n")
+        return path
+
+
+# ------------------------------------------------------------- postmortems
+
+class PostmortemStore:
+    """Bounded ring of correlated incident bundles.
+
+    ``capture(kind, reason)`` freezes one bundle from the registered
+    context providers (timeline window, flight tail, journeys, breaker /
+    degradation state, device census — whatever the owner attached) plus
+    the triggering alarm. Auto-capture hooks call it on every alarm
+    (``TTFTBreachStorm`` / ``EvictionThrash`` / ``StallStorm`` via the
+    flight recorder, breaker-open via the supervisor, ``KVPoolExhausted``
+    from the scheduler's step); ``/debug/postmortem`` calls it on demand.
+    A per-kind refractory window (``min_interval_s``) keeps an alarm that
+    re-fires every step from flooding the ring — suppressed captures are
+    counted, not silently dropped.
+
+    Thread contract: bundles are BUILT outside ``_lock`` (providers take
+    their own locks) and appended under it; readers copy under it."""
+
+    _bundles: guarded_by("_lock")
+    _captures: guarded_by("_lock")
+    _suppressed: guarded_by("_lock")
+    _last_t: guarded_by("_lock")
+
+    def __init__(self, max_bundles: int = 8, min_interval_s: float = 1.0):
+        self.max_bundles = int(max_bundles)
+        self.min_interval_s = float(min_interval_s)
+        self._providers: Dict[str, Callable[[], object]] = {}
+        self._lock = threading.Lock()
+        self._bundles: "deque[dict]" = deque(maxlen=self.max_bundles)
+        self._captures = 0
+        self._suppressed = 0
+        self._last_t: Dict[str, float] = {}
+
+    def add_context(self, name: str, fn: Callable[[], object]) -> None:
+        """Register one context provider; its return value lands in every
+        bundle under ``name`` (errors are captured, never raised)."""
+        provs = dict(self._providers)
+        provs[str(name)] = fn
+        self._providers = provs
+
+    def capture(self, kind: str, reason: str,
+                alarm: Optional[dict] = None,
+                force: bool = False) -> Optional[Dict[str, object]]:
+        """Freeze one bundle; returns it, or None when suppressed by the
+        per-kind refractory window (on-demand captures pass ``force``)."""
+        t = time.perf_counter()
+        with self._lock:
+            last = self._last_t.get(kind)
+            if (not force and last is not None
+                    and t - last < self.min_interval_s):
+                self._suppressed += 1
+                return None
+            self._last_t[kind] = t
+        with RecordEvent("fleet.postmortem"):
+            with self._lock:
+                seq = self._captures
+                self._captures += 1
+            bundle: Dict[str, object] = {
+                "seq": seq, "kind": str(kind), "reason": str(reason),
+                "t": t,
+            }
+            if alarm is not None:
+                bundle["alarm"] = alarm
+            for name, fn in self._providers.items():
+                try:
+                    bundle[name] = fn()
+                except Exception as e:  # a broken provider must not kill
+                    bundle[name] = {"error": f"{type(e).__name__}: {e}"}
+            with self._lock:
+                self._bundles.append(bundle)
+        return bundle
+
+    # ------------------------------------------------------------ reading
+    def bundles(self) -> List[Dict[str, object]]:
+        with self._lock:
+            return list(self._bundles)
+
+    def last(self) -> Optional[Dict[str, object]]:
+        with self._lock:
+            return self._bundles[-1] if self._bundles else None
+
+    @property
+    def captures(self) -> int:
+        with self._lock:
+            return self._captures
+
+    @property
+    def suppressed(self) -> int:
+        with self._lock:
+            return self._suppressed
+
+    def summary(self) -> Dict[str, object]:
+        """Light index for debug pages (kinds + counts, not the payloads
+        — one bundle can hold a full flight ring)."""
+        with self._lock:
+            return {
+                "captures": self._captures,
+                "suppressed": self._suppressed,
+                "retained": len(self._bundles),
+                "capacity": self.max_bundles,
+                "kinds": [{"seq": b["seq"], "kind": b["kind"],
+                           "reason": b["reason"], "t": b["t"]}
+                          for b in self._bundles],
+            }
+
+    def dump(self, path: str) -> str:
+        with open(path, "w") as f:
+            json.dump(self.bundles(), f, default=str)
+        return path

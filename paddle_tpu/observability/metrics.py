@@ -22,6 +22,7 @@ from __future__ import annotations
 import random
 import re
 import threading
+import warnings
 from collections import OrderedDict
 from typing import Dict, Optional
 
@@ -49,12 +50,31 @@ def sanitize_metric_name(name: str) -> str:
 
 def label_string(labels) -> str:
     """Canonical ``k="v",k2="v2"`` rendering (keys sorted, values escaped)
-    — the exposition inside the braces and the snapshot-key suffix."""
+    — the exposition inside the braces and the snapshot-key suffix.
+    Escaping follows the Prometheus text format: backslash FIRST (or the
+    other escapes' backslashes get doubled), then ``"``, then newline —
+    a raw newline in a label value would split the exposition line."""
     parts = []
     for k in sorted(labels):
-        v = str(labels[k]).replace("\\", r"\\").replace('"', r'\"')
+        v = (str(labels[k]).replace("\\", r"\\").replace('"', r'\"')
+             .replace("\n", r"\n"))
         parts.append(f'{sanitize_metric_name(str(k))}="{v}"')
     return ",".join(parts)
+
+
+_LABEL_UNESCAPES = {"\\": "\\", '"': '"', "n": "\n"}
+
+
+def _unescape_label_value(v: str) -> str:
+    """Single left-to-right pass — sequential ``str.replace`` calls corrupt
+    combined escapes (``\\\\\\"`` decodes as ``\\"`` , not ``\\`` + ``"``)."""
+    return re.sub(r"\\(.)",
+                  lambda m: _LABEL_UNESCAPES.get(m.group(1), m.group(0)), v)
+
+
+class MetricsCardinalityOverflow(UserWarning):
+    """A labeled family hit its per-family label-set cap; new label sets
+    are collapsing into the ``overflow="true"`` sink series."""
 
 
 class _Labeled:
@@ -70,15 +90,32 @@ class _Labeled:
     while the ObservabilityEndpoint thread iterates them for exposition —
     both sides must hold ``_lock`` or the scrape dies with "OrderedDict
     mutated during iteration".
+
+    Cardinality guard: a family caps its distinct label sets at
+    ``max_label_sets`` (default 256). Past the cap, NEW label sets collapse
+    into one ``overflow="true"`` sink child (known sets keep their own
+    series), a per-family drop counter ticks, and ONE
+    ``MetricsCardinalityOverflow`` warning fires — so a request-id-shaped
+    label bug degrades loudly instead of growing the registry without
+    bound.
     """
 
     _children: guarded_by("_lock")
+    _overflow_dropped: guarded_by("_lock")
+    _overflow_warned: guarded_by("_lock")
+
+    # per-family distinct-label-set cap (class attr: override per metric
+    # object before first labels() call if a family truly needs more)
+    max_label_sets = 256
+    _OVERFLOW_KEY = 'overflow="true"'
 
     @holds_lock("_lock")  # runs inside __init__, before publication
     def _init_labels(self):
         self._children: "OrderedDict[str, object]" = OrderedDict()
         self._labels: Optional[Dict[str, str]] = None
         self._touched = False
+        self._overflow_dropped = 0
+        self._overflow_warned = False
 
     def labels(self, **labels):
         if not labels:
@@ -87,15 +124,37 @@ class _Labeled:
             raise ValueError(
                 f"{self.name}: labels() on an already-labeled child")
         key = label_string(labels)
+        warn = False
         with self._lock:
             child = self._children.get(key)
             if child is None:
-                child = type(self)(name=self.name,
-                                   description=self.description,
-                                   unit=self.unit)
-                child._labels = {str(k): str(v) for k, v in labels.items()}
-                self._children[key] = child
-            return child
+                if (len(self._children) >= self.max_label_sets
+                        and key != self._OVERFLOW_KEY):
+                    self._overflow_dropped += 1
+                    warn = not self._overflow_warned
+                    self._overflow_warned = True
+                    key = self._OVERFLOW_KEY
+                    labels = {"overflow": "true"}
+                    child = self._children.get(key)
+                if child is None:
+                    child = type(self)(name=self.name,
+                                       description=self.description,
+                                       unit=self.unit)
+                    child._labels = {str(k): str(v)
+                                     for k, v in labels.items()}
+                    self._children[key] = child
+        if warn:  # outside the lock: warning filters can run user code
+            warnings.warn(MetricsCardinalityOverflow(
+                f"metric family {self.name!r} hit its label-set cap "
+                f"({self.max_label_sets}); new label sets now collapse "
+                f'into {self.name}{{overflow="true"}}'), stacklevel=2)
+        return child
+
+    @property
+    def overflow_dropped(self) -> int:
+        """How many ``labels()`` calls were collapsed into the sink."""
+        with self._lock:
+            return self._overflow_dropped
 
     def _expose_rows(self, kind):
         rows = []
@@ -413,7 +472,7 @@ def parse_prometheus_text(text: str) -> Dict[str, dict]:
             name, _, labels = name_part.partition("{")
             labels = labels.rstrip("}")
             fam = families.setdefault(name, {"type": types.get(name)})
-            parsed = {k: v.replace(r"\"", '"').replace(r"\\", "\\")
+            parsed = {k: _unescape_label_value(v)
                       for k, v in
                       re.findall(r'([a-zA-Z0-9_:]+)="((?:[^"\\]|\\.)*)"',
                                  labels)}

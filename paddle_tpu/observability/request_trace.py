@@ -42,6 +42,7 @@ from paddle_tpu.observability.annotations import guarded_by
 __all__ = [
     "PHASE_ADMIT",
     "PHASE_DONE",
+    "PHASE_FAILOVER",
     "PHASE_PREEMPTED",
     "PHASE_QUEUED",
     "PHASE_RUNNING",
@@ -54,9 +55,11 @@ PHASE_QUEUED = "queued"          # waiting for a slot (incl. re-queue waits)
 PHASE_ADMIT = "admit"            # prefix match + suffix prefill + packing
 PHASE_RUNNING = "running"        # in the decode slot grid
 PHASE_PREEMPTED = "preempted"    # evicted, waiting to resume
+PHASE_FAILOVER = "failover"      # exported off a dead replica, being moved
 PHASE_DONE = "done"              # terminal marker (zero-width)
 
-_PHASES = (PHASE_QUEUED, PHASE_ADMIT, PHASE_RUNNING, PHASE_PREEMPTED)
+_PHASES = (PHASE_QUEUED, PHASE_ADMIT, PHASE_RUNNING, PHASE_PREEMPTED,
+           PHASE_FAILOVER)
 
 
 class RequestTrace:
@@ -133,20 +136,72 @@ class RequestTrace:
 
     def to_dict(self) -> Dict[str, object]:
         d = self.phase_durations()
+        rows = [{"phase": p, "t0": t0, "dur_s": t1 - t0}
+                for p, t0, t1 in self.phases if p != PHASE_DONE]
+        if self.finish_t is None:
+            # in-flight request: synthesize the still-open final span up to
+            # "now" so a postmortem taken mid-incident shows where it is
+            now = time.perf_counter()
+            rows.append({"phase": self._cur_phase, "t0": self._cur_t0,
+                         "dur_s": max(now - self._cur_t0, 0.0),
+                         "open": True})
+            d[self._cur_phase] = (d.get(self._cur_phase, 0.0)
+                                  + max(now - self._cur_t0, 0.0))
         return {
             "request_id": self.request_id,
             "arrival_t": self.arrival_t,
             "finish_t": self.finish_t,
             "e2e_s": self.e2e_s(),
             "phase": self._cur_phase,
-            "phases": [{"phase": p, "t0": t0, "dur_s": t1 - t0}
-                       for p, t0, t1 in self.phases if p != PHASE_DONE],
+            "phases": rows,
             "phase_totals_s": d,
             "subspans": {n: {"calls": c, "total_s": s}
                          for n, (c, s) in self.subspans.items()},
             "events": [{"name": n, "t": t, **m} for n, t, m in self.events],
             **self.meta,
         }
+
+    # --------------------------------------------------------- portability
+    def export_snapshot(self, t: Optional[float] = None) -> Dict[str, object]:
+        """Portable trace state for a restartable-request spec: everything a
+        survivor replica needs to continue the SAME timeline after failover.
+        ``export_t`` closes the open phase — ``resume()`` bridges it to the
+        import instant with an explicit ``failover`` phase, keeping the
+        gapless sum-to-E2E invariant across replicas."""
+        t = time.perf_counter() if t is None else t
+        return {
+            "request_id": self.request_id,
+            "arrival_t": self.arrival_t,
+            "phases": [list(p) for p in self.phases],
+            "open_phase": self._cur_phase,
+            "open_t0": self._cur_t0,
+            "export_t": t,
+            "subspans": {n: list(agg) for n, agg in self.subspans.items()},
+            "events": [list(e) for e in self.events],
+            "meta": dict(self.meta),
+        }
+
+    @classmethod
+    def from_snapshot(cls, request_id: int, snap: Dict[str, object],
+                      t: Optional[float] = None, **meta) -> "RequestTrace":
+        """Rebuild a trace on the survivor: prior closed phases, the phase
+        that was open at export closed AT export time, then one gapless
+        ``failover`` phase spanning [export_t, import_t], reopening as
+        ``queued`` (the resumed request re-enters the survivor's queue)."""
+        t = time.perf_counter() if t is None else t
+        tr = cls(request_id, t=snap["arrival_t"], **dict(snap.get("meta", {})))
+        tr.phases = [tuple(p) for p in snap.get("phases", ())]
+        export_t = float(snap["export_t"])
+        tr.phases.append((snap["open_phase"], float(snap["open_t0"]),
+                          export_t))
+        tr.phases.append((PHASE_FAILOVER, export_t, t))
+        tr._cur_phase = PHASE_QUEUED
+        tr._cur_t0 = t
+        tr.subspans = {n: list(agg)
+                       for n, agg in snap.get("subspans", {}).items()}
+        tr.events = [tuple(e) for e in snap.get("events", ())]
+        tr.meta.update(meta)
+        return tr
 
 
 class RequestTracer:
@@ -199,6 +254,35 @@ class RequestTracer:
             while len(self._done) > self.max_completed:
                 self._done.popitem(last=False)
 
+    # ------------------------------------------------------ fleet failover
+    def export_snapshot(self, request_id: int,
+                        t: Optional[float] = None
+                        ) -> Optional[Dict[str, object]]:
+        """Portable snapshot of a live trace, removed from this tracer (the
+        replica is dead; the request's timeline travels with its spec)."""
+        if not self.enabled:
+            return None
+        with self._lock:
+            tr = self._live.pop(request_id, None)
+        if tr is None:
+            return None
+        return tr.export_snapshot(t)
+
+    def resume(self, request_id: int, snap: Optional[Dict[str, object]],
+               t: Optional[float] = None, **meta) -> Optional[RequestTrace]:
+        """Continue an exported timeline on THIS tracer under the survivor's
+        request id — the cross-replica half of "one request = one timeline".
+        Falls back to a fresh ``start()`` when the spec carries no snapshot
+        (tracing was off on the dead replica, or an old-format spec)."""
+        if not self.enabled:
+            return None
+        if snap is None:
+            return self.start(request_id, t=t, **meta)
+        tr = RequestTrace.from_snapshot(request_id, snap, t=t, **meta)
+        with self._lock:
+            self._live[request_id] = tr
+        return tr
+
     # -------------------------------------------------------------- reading
     def live(self) -> List[RequestTrace]:
         with self._lock:
@@ -228,6 +312,7 @@ class RequestTracer:
         ev: List[dict] = [{"name": "process_name", "ph": "M", "pid": pid,
                            "tid": 0, "args": {"name": "serving requests"}}]
         e0 = self._epoch
+        now = time.perf_counter()
         for tr in self.completed() + self.live():
             tid = int(tr.request_id)
             ev.append({"name": "thread_name", "ph": "M", "pid": pid,
@@ -241,6 +326,16 @@ class RequestTracer:
                     "pid": pid, "tid": tid,
                     "ts": (t0 - e0) * 1e6, "dur": (t1 - t0) * 1e6,
                     "args": {"request_id": tr.request_id},
+                })
+            if tr.finish_t is None:
+                # live request: its still-open final span, drawn up to "now",
+                # so a mid-incident export shows where every request is stuck
+                ev.append({
+                    "name": f"req.{tr.current_phase}", "cat": "request",
+                    "ph": "X", "pid": pid, "tid": tid,
+                    "ts": (tr._cur_t0 - e0) * 1e6,
+                    "dur": max(now - tr._cur_t0, 0.0) * 1e6,
+                    "args": {"request_id": tr.request_id, "open": True},
                 })
             for name, t, meta in tr.events:
                 ev.append({"name": f"req.{name}", "cat": "request",

@@ -162,6 +162,8 @@ class FlightRecorder:
     _ring: guarded_by("_lock")
     _step: guarded_by("_lock")
     _last_alarm: guarded_by("_lock")
+    _on_alarm: guarded_by("_lock")
+    _cb_errors: guarded_by("_lock")
 
     def __init__(self, max_steps: int = 256):
         self.max_steps = int(max_steps)
@@ -169,6 +171,15 @@ class FlightRecorder:
         self._lock = threading.Lock()
         self._step = 0
         self._last_alarm: Optional[Dict[str, object]] = None
+        self._on_alarm = None
+        self._cb_errors = 0
+
+    def set_alarm_callback(self, cb) -> None:
+        """``cb(kind, reason, alarm_dict)`` runs on every ``alarm()`` —
+        the postmortem auto-capture hook. One callback slot (last wins);
+        invoked OUTSIDE ``_lock`` so it may snapshot anything."""
+        with self._lock:
+            self._on_alarm = cb
 
     def record_step(self, **fields):
         with self._lock:
@@ -187,6 +198,11 @@ class FlightRecorder:
             return self._step
 
     @property
+    def alarm_callback_errors(self) -> int:
+        with self._lock:
+            return self._cb_errors
+
+    @property
     def last_alarm_dump(self) -> Optional[Dict[str, object]]:
         with self._lock:
             return self._last_alarm
@@ -197,13 +213,27 @@ class FlightRecorder:
         return rows[-last:] if last else rows
 
     def alarm(self, kind: str, reason: str):
-        """Freeze the ring around an incident (called by alarm monitors)."""
+        """Freeze the ring around an incident (called by alarm monitors);
+        then fire the registered postmortem callback, outside the lock —
+        it snapshots state that takes its own locks."""
         dump = self.dump()
+        alarm = {
+            "kind": kind, "reason": reason, "t": time.perf_counter(),
+            "steps": dump,
+        }
         with self._lock:
-            self._last_alarm = {
-                "kind": kind, "reason": reason, "t": time.perf_counter(),
-                "steps": dump,
-            }
+            self._last_alarm = alarm
+            cb = self._on_alarm
+        if cb is not None:
+            try:
+                cb(kind, reason, alarm)
+            except Exception as e:
+                # the capture path must never kill the alarm; the frozen
+                # snapshot records that its auto-capture failed and why
+                err = f"{type(e).__name__}: {e}"
+                with self._lock:
+                    self._cb_errors += 1
+                    alarm["capture_error"] = err
 
 
 class AlarmMonitors:

@@ -45,6 +45,11 @@ SPAN_MANIFEST = {
     "router.route": {"owner": "serving", "category": "UserDefined"},
     "router.failover": {"owner": "serving", "category": "UserDefined"},
     "router.reload": {"owner": "serving", "category": "UserDefined"},
+    "router.journey": {"owner": "serving", "category": "UserDefined"},
+    # fleet observability (timeline sampler + postmortem capture)
+    "fleet.sample": {"owner": "observability", "category": "UserDefined"},
+    "fleet.postmortem": {"owner": "observability",
+                         "category": "UserDefined"},
     # device-side observability (HBM ledger + program inventory)
     "device.oom_forensics": {"owner": "observability",
                              "category": "UserDefined"},

@@ -89,6 +89,12 @@ class SchedulerConfig:
     # Host-side bookkeeping only — tokens are bit-identical on vs off at
     # every dispatch_depth (pinned in tests).
     enable_device_observability: bool = True
+    # Fleet observability: metrics time-series recorder + postmortem
+    # bundles. ``timeline_interval_s`` > 0 spawns the background sampler
+    # thread (role ``fleet-sample``); 0 leaves sampling to the owner
+    # (router sampler, bench, or inline ``timeline.sample_once()``).
+    timeline_interval_s: float = 0.0
+    postmortem_bundles: int = 8       # correlated incident bundles retained
     # ---- resilience (fault retry, deadlines, shedding). The fault knobs
     # only matter when errors actually occur; the shed thresholds are
     # fractions of max(pool occupancy, queue fill).

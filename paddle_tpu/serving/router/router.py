@@ -42,12 +42,16 @@ the router keeps serving throughout.
 
 from __future__ import annotations
 
+import json
 import threading
+import time
 from typing import Callable, Dict, List, Optional
 
 import numpy as np
 
 from paddle_tpu.observability.annotations import guarded_by, lock_order
+from paddle_tpu.observability.fleet import (FleetTracer, MetricsTimeline,
+                                            PostmortemStore)
 from paddle_tpu.profiler import RecordEvent
 from paddle_tpu.resilience import classify_error, inject
 from paddle_tpu.serving.metrics import ServingMetrics
@@ -110,7 +114,9 @@ class ServingRouter:
                  hang_factor: float = 50.0,
                  restart_dead: bool = True,
                  warmup_source=None,
-                 probe_every: int = 1):
+                 probe_every: int = 1,
+                 journey_tracing: bool = True,
+                 timeline_interval_s: float = 0.0):
         if policy not in POLICIES:
             raise ValueError(f"unknown policy {policy!r} "
                              f"(known: {', '.join(POLICIES)})")
@@ -132,7 +138,8 @@ class ServingRouter:
             restart=restart_dead,
             warmup_source=warmup_source,
             metrics=self.metrics,
-            on_failover=self._failover_cb)
+            on_failover=self._failover_cb,
+            on_incident=self._incident_cb)
         self.probe_every = max(1, int(probe_every))
         if affinity_tokens is None:
             affinity_tokens = int(self.replicas[0].sched.config.block_size)
@@ -163,6 +170,56 @@ class ServingRouter:
         self._steps = 0
         self._failovers = 0
         self._failed_over = 0
+
+        # ---- fleet observability ---------------------------------------
+        # Journeys key off the ROUTER rid (stable across failover): one
+        # track per request spanning replicas. The timeline scrapes the
+        # router registry plus every replica's (closures read ``rep.sched``
+        # at sample time, so restarts are tracked). Postmortem bundles
+        # auto-capture on breaker-open (supervisor ``on_incident``) and on
+        # every replica flight-recorder alarm, correlated fleet-wide.
+        self.fleet = FleetTracer(enabled=journey_tracing)
+        self.timeline = MetricsTimeline()
+        self.timeline.add_source("router", self.metrics.snapshot)
+        for rep in self.replicas:
+            self.timeline.add_source(
+                f"replica{rep.replica_id}",
+                lambda rep=rep: rep.sched.metrics.snapshot())
+            self.timeline.add_source(
+                f"replica{rep.replica_id}_stall",
+                lambda rep=rep: rep.sched.stall.snapshot())
+        self.postmortems = PostmortemStore()
+        self.postmortems.add_context("router", self.debug_state)
+        self.postmortems.add_context("journeys",
+                                     lambda: self.fleet.to_json(last=32))
+        self.postmortems.add_context(
+            "timeline_window", lambda: self.timeline.window(last_s=30.0))
+        for rep in self.replicas:
+            self.postmortems.add_context(
+                f"replica{rep.replica_id}_flight",
+                lambda rep=rep: rep.sched.flight.dump(last=16))
+            self._bind_flight_alarm(rep)
+        if timeline_interval_s > 0:
+            self.timeline.start(timeline_interval_s)
+
+    def _bind_flight_alarm(self, rep: ServingReplica) -> None:
+        """Point a replica scheduler's flight-recorder alarms at the
+        ROUTER's postmortem store (replacing the scheduler-local capture):
+        a TTFT storm on one replica freezes a fleet-wide bundle."""
+        rep.sched.flight.set_alarm_callback(
+            lambda kind, reason, alarm, rep=rep:
+            self.postmortems.capture(
+                kind, f"replica {rep.replica_id}: {reason}",
+                alarm={k: alarm[k] for k in ("kind", "reason", "t")}))
+
+    def _incident_cb(self, kind: str, reason: str) -> None:
+        """Supervisor incident hook (breaker open after a reap): one
+        correlated fleet bundle per incident. Restarts swap in a fresh
+        scheduler, so re-point every live replica's flight alarms here."""
+        for rep in self.replicas:
+            if not rep.dead:
+                self._bind_flight_alarm(rep)
+        self.postmortems.capture(kind, reason)
 
     # ---- placement -----------------------------------------------------
 
@@ -216,6 +273,7 @@ class ServingRouter:
         (stable across failover). Raises ``ValueError`` for malformed
         requests, ``SchedulerOverloaded`` when no replica is routable or
         every candidate refused admission."""
+        route_t0 = time.perf_counter()   # the journey's arrival anchor
         with RecordEvent("router.route"):
             try:
                 inject("router.route")
@@ -248,6 +306,14 @@ class ServingRouter:
                     continue
                 self._register(router_rid, rep, replica_rid, wrapped, key,
                                decision)
+                # journey stamp, outside self._lock (FleetTracer has its
+                # own lock); the route span runs arrival -> placement
+                with RecordEvent("router.journey"):
+                    self.fleet.start(
+                        router_rid, t=route_t0,
+                        replica_id=rep.replica_id,
+                        generation=rep.generation,
+                        replica_rid=replica_rid, decision=decision)
                 return router_rid
             self.metrics.requests_rejected += 1
             raise SchedulerOverloaded(
@@ -293,6 +359,8 @@ class ServingRouter:
             for out in rep.step():
                 ro = self._collect(rep, out)
                 if ro is not None:
+                    self.fleet.finish(ro.request_id,
+                                      finish_reason=ro.finish_reason)
                     done.append(ro)
         with self._lock:
             self._steps += 1
@@ -367,6 +435,7 @@ class ServingRouter:
             return
         with RecordEvent("router.failover"):
             moved = 0
+            reap_t = time.perf_counter()   # specs in hand: the reap landed
             for spec in specs:
                 with self._lock:
                     router_rid = self._by_replica.pop(
@@ -382,8 +451,26 @@ class ServingRouter:
                 # import outside self._lock: add/import takes the
                 # scheduler's engine lock, and the module-level
                 # lock_order declaration forbids nesting it inside ours
+                imp_t0 = time.perf_counter()
                 new_rrid = survivor.sched.import_resumed(
                     spec, on_token=rec.on_token)
+                imp_t1 = time.perf_counter()
+                # journey: the reap span runs export -> callback (the spec
+                # carries its export stamp), replay wraps the re-queue,
+                # and the hop lands the request on the survivor's segment
+                trace_snap = spec.get("trace") or {}
+                self.fleet.record_span(
+                    rec.router_rid, "reap",
+                    float(trace_snap.get("export_t", reap_t)), reap_t,
+                    replica=rep.replica_id, generation=gen)
+                self.fleet.record_span(
+                    rec.router_rid, "replay", imp_t0, imp_t1,
+                    replica=survivor.replica_id,
+                    committed_tokens=len(spec.get("out_tokens", ())))
+                self.fleet.move(
+                    rec.router_rid, replica_id=survivor.replica_id,
+                    generation=survivor.generation, replica_rid=new_rrid,
+                    t=imp_t1)
                 with self._lock:
                     rec.replica_id = survivor.replica_id
                     rec.replica_rid = new_rrid
@@ -437,6 +524,7 @@ class ServingRouter:
         with self._lock:
             self._records.pop(rec.router_rid, None)
             self._finished[rec.router_rid] = out
+        self.fleet.finish(rec.router_rid, finish_reason="failed")
         self.metrics.requests_failed += 1
 
     # ---- chaos / control ----------------------------------------------
@@ -477,6 +565,7 @@ class ServingRouter:
         return loaded
 
     def shutdown(self) -> Dict[str, int]:
+        self.timeline.stop()
         totals = {"drained_in_flight": 0, "cancelled": 0}
         for rep in self.replicas:
             rep.stop_driver(timeout=2.0)
@@ -488,6 +577,32 @@ class ServingRouter:
         return totals
 
     # ---- reading -------------------------------------------------------
+
+    def _resolve_segment(self, seg: Dict[str, object]):
+        """Journey segment -> the RequestTrace holding its phase timeline.
+        A segment from a dead generation resolves to None (that tracer is
+        gone) — but its history lives on in the survivor's resumed trace,
+        so the newest resolvable segment still renders the full journey."""
+        replica_id = int(seg["replica_id"])
+        if not 0 <= replica_id < len(self.replicas):
+            return None
+        rep = self.replicas[replica_id]
+        if rep.generation != int(seg["generation"]) or rep.dead:
+            return None
+        return rep.sched.tracer.get(int(seg["replica_rid"]))
+
+    def export_fleet_trace(self, path: Optional[str] = None):
+        """The fleet chrome trace: ONE track per router request spanning
+        every replica it touched — request phases (incl. the explicit
+        ``failover`` phase) interleaved with router route/spill/reap/replay
+        spans, all anchored to the request's original arrival. Returns the
+        trace dict, or writes it to ``path`` and returns the path."""
+        trace = self.fleet.chrome_trace(self._resolve_segment)
+        if path is None:
+            return trace
+        with open(path, "w") as f:
+            json.dump(trace, f)
+        return path
 
     def get_finished(self, router_rid: int) -> Optional[RequestOutput]:
         with self._lock:
@@ -544,4 +659,10 @@ class ServingRouter:
                 "steps": self._steps,
             }
         return {"router": router, "replicas": reps,
-                "supervisor": self.supervisor.snapshot()}
+                "supervisor": self.supervisor.snapshot(),
+                "journeys": {
+                    "tracked": len(self.fleet.journeys()),
+                    "enabled": self.fleet.enabled,
+                },
+                "timeline": self.timeline.snapshot(),
+                "postmortems": self.postmortems.summary()}

@@ -130,6 +130,7 @@ class ReplicaSupervisor:
                  warmup_source=None,
                  metrics=None,
                  on_failover: Optional[Callable] = None,
+                 on_incident: Optional[Callable] = None,
                  clock: Callable[[], float] = time.monotonic):
         self.replicas = list(replicas)
         self.hang_abs_s = float(hang_abs_s)
@@ -138,6 +139,9 @@ class ReplicaSupervisor:
         self.warmup_source = warmup_source
         self.metrics = metrics
         self.on_failover = on_failover
+        # ``on_incident(kind, reason)`` fires once per reap, AFTER restart
+        # and failover settle — the router's postmortem auto-capture hook
+        self.on_incident = on_incident
         self.breakers: Dict[int, CircuitBreaker] = {
             rep.replica_id: CircuitBreaker(
                 cooldown_s=cooldown_s,
@@ -268,6 +272,13 @@ class ReplicaSupervisor:
                     "dead replicas restarted by the supervisor").inc()
         if self.on_failover is not None:
             self.on_failover(rep, gen, specs)
+        if self.on_incident is not None:
+            # after restart + failover: the bundle captures the settled
+            # post-incident fleet (breaker open, requests re-homed)
+            self.on_incident(
+                "breaker_open",
+                f"replica {rep.replica_id} reaped (generation {gen}, "
+                f"{len(specs)} requests exported)")
 
     # ---- routing gate --------------------------------------------------
 

@@ -74,6 +74,7 @@ from paddle_tpu.observability.device_memory import (
     DeviceMemoryLedger,
     tree_nbytes,
 )
+from paddle_tpu.observability.fleet import MetricsTimeline, PostmortemStore
 from paddle_tpu.observability.program_inventory import (
     DeviceTimeSampler,
     chip_specs,
@@ -304,6 +305,43 @@ class ContinuousBatchingScheduler:
                 self.prefix_cache.attach_device_ledger(
                     self.device_ledger,
                     self._kv_bytes_per_token * cfg.block_size)
+        # ---- fleet observability (timeline + postmortems) --------------
+        # The timeline records registry/stall/ledger history; postmortems
+        # freeze one correlated bundle on every alarm (flight-recorder
+        # alarms via the callback below, KVPoolExhausted in step()) and on
+        # demand. Standalone schedulers sample inline or via the sampler
+        # thread (timeline_interval_s > 0); under a router the router's
+        # own timeline also scrapes this registry fleet-wide.
+        self.timeline = MetricsTimeline()
+        self.timeline.add_source("serving", self.metrics.snapshot)
+        self.timeline.add_source("stall", self.stall.snapshot)
+        if self.device_ledger is not None:
+            self.timeline.add_source("device", self.device_ledger.census)
+        self.postmortems = PostmortemStore(max_bundles=cfg.postmortem_bundles)
+        self.postmortems.add_context("flight_tail",
+                                     lambda: self.flight.dump(last=32))
+        self.postmortems.add_context(
+            "flight_alarm", lambda: self.flight.last_alarm_dump)
+        self.postmortems.add_context("requests",
+                                     lambda: self.tracer.to_json()[-32:])
+        self.postmortems.add_context("metrics", self.metrics.snapshot)
+        self.postmortems.add_context("health", self.health)
+        self.postmortems.add_context(
+            "timeline_window", lambda: self.timeline.window(last_s=30.0))
+        if self.device_ledger is not None:
+            self.postmortems.add_context("device_memory",
+                                         self.device_ledger.census)
+        self.flight.set_alarm_callback(self._alarm_postmortem)
+        if cfg.timeline_interval_s > 0:
+            self.timeline.start(cfg.timeline_interval_s)
+
+    def _alarm_postmortem(self, kind: str, reason: str, alarm: dict):
+        """FlightRecorder alarm hook: one auto-captured bundle per alarm
+        (TTFTBreachStorm / EvictionThrash / StallStorm all land here). The
+        bundle carries the alarm WITHOUT its frozen step ring — the
+        ``flight_alarm`` context already snapshots that."""
+        self.postmortems.capture(
+            kind, reason, alarm={k: alarm[k] for k in ("kind", "reason", "t")})
 
     # ---- admission -----------------------------------------------------
 
@@ -1256,6 +1294,7 @@ class ContinuousBatchingScheduler:
         orphaned device work), stop the drain thread, then cancel
         everything still queued or running so every KV block returns to
         the pool. Idempotent; returns drain/cancel counts."""
+        self.timeline.stop()
         with self._elock:
             drained = len(self._inflight)
             try:
@@ -1307,14 +1346,24 @@ class ContinuousBatchingScheduler:
             self._draining = True
             self._drain_stop = True
             self._elock.notify_all()
+            export_t = _time.perf_counter()
             for req in list(self.queue._items):
                 self.queue.remove(req.request_id)
-                specs.append(self._export_spec(req))
+                spec = self._export_spec(req)
+                spec["trace"] = self.tracer.export_snapshot(
+                    req.request_id, t=export_t)
+                specs.append(spec)
             for s in range(len(self._slots)):
                 req = self._slots[s]
                 if req is None:
                     continue
-                specs.append(self._export_spec(req))
+                spec = self._export_spec(req)
+                # the request's timeline travels with its spec: the
+                # survivor's tracer continues it through an explicit
+                # ``failover`` phase — one request, one timeline
+                spec["trace"] = self.tracer.export_snapshot(
+                    req.request_id, t=export_t)
+                specs.append(spec)
                 self.allocator.free(req.blocks)
                 req.blocks = []
                 req.slot = -1
@@ -1372,9 +1421,13 @@ class ContinuousBatchingScheduler:
             req.num_preemptions = int(spec.get("num_preemptions", 0)) + 1
             self.queue.push(req, force=True)
             self.metrics.requests_received += 1
-            self.tracer.start(rid, t=req.arrival_t,
-                              prompt_tokens=len(req.prompt_ids),
-                              priority=req.priority)
+            # continue the exported timeline (explicit ``failover`` phase
+            # bridging export -> here) when the spec carries one; a fresh
+            # trace otherwise (old-format spec, tracing off on the donor)
+            self.tracer.resume(rid, spec.get("trace"), t=req.arrival_t
+                               if spec.get("trace") is None else None,
+                               prompt_tokens=len(req.prompt_ids),
+                               priority=req.priority)
             return rid
 
     # ---- public loop ---------------------------------------------------
@@ -1429,10 +1482,12 @@ class ContinuousBatchingScheduler:
         except KVPoolExhausted as exc:
             # allocation failure surfaces WITH forensics: the full owner
             # census + the flight-recorder tail ride on the exception
-            # (``exc.device_memory_census``) instead of a bare message
+            # (``exc.device_memory_census``) instead of a bare message,
+            # and one correlated postmortem bundle freezes for later
             if self.device_ledger is not None:
                 self.device_ledger.attach_forensics(
                     exc, flight_tail=self.flight.dump(last=8))
+            self.postmortems.capture("kv_pool_exhausted", str(exc))
             raise
         finally:
             if was_training:
@@ -1635,6 +1690,8 @@ class ContinuousBatchingScheduler:
             "compile": self.compile_stats(),
             "health": self.health(),
             "fault_injection": get_injector().snapshot(),
+            "timeline": self.timeline.snapshot(),
+            "postmortems": self.postmortems.summary(),
         }
 
     def export_request_trace(self, path: str) -> str:

@@ -1,0 +1,281 @@
+"""Fleet observability unit faces: journey tracking (FleetTracer), tiered
+metrics time-series history (MetricsTimeline), correlated postmortem
+bundles (PostmortemStore), and the /debug/timeline + /debug/postmortem
+endpoint routes.
+
+The integration face — a real kill drill producing one cross-replica
+journey with bit-identical tokens — lives in tests/test_router.py; these
+tests pin the primitives' contracts deterministically (explicit
+timestamps, no model, no threads unless the test is about the sampler).
+"""
+
+import json
+import time
+import urllib.request
+
+import pytest
+
+from paddle_tpu.observability import (
+    FleetTracer,
+    MetricsTimeline,
+    ObservabilityEndpoint,
+    PostmortemStore,
+    RequestTracer,
+)
+from paddle_tpu.observability.fleet import JOURNEY_SPANS, TIMELINE_TIERS
+from paddle_tpu.observability.request_trace import PHASE_ADMIT, PHASE_RUNNING
+
+
+# --------------------------------------------------------------- journeys
+
+def _journey(ft, rid=7, decision="least_loaded"):
+    return ft.start(rid, t=100.0, replica_id=0, generation=0,
+                    replica_rid=11, decision=decision)
+
+
+def test_journey_lifecycle_segments_and_spans():
+    ft = FleetTracer()
+    j = _journey(ft)
+    assert j.failovers == 0 and j.arrival_t == 100.0
+    assert j.current_segment()["replica_id"] == 0
+    # route span is anchored to arrival; no spill for a direct placement
+    assert [n for n, *_ in j.spans] == ["route"]
+    assert j.spans[0][1] == 100.0
+    ft.record_span(7, "reap", 101.0, 101.2, replica=0)
+    ft.move(7, replica_id=2, generation=1, replica_rid=31, t=101.5)
+    ft.record_span(7, "replay", 101.5, 101.8, committed_tokens=3)
+    ft.finish(7, t=103.0, finish_reason="stop")
+    assert ft.get(7).failovers == 1
+    d = ft.get(7).to_dict()
+    assert d["finish_t"] == 103.0 and d["finish_reason"] == "stop"
+    assert [s["replica_id"] for s in d["segments"]] == [0, 2]
+    names = [s["name"] for s in d["spans"]]
+    assert names == ["route", "reap", "replay"]
+    assert all(n in JOURNEY_SPANS for n in names)
+    reap = d["spans"][1]
+    assert reap["dur_s"] == pytest.approx(0.2) and reap["replica"] == 0
+    # finished journeys leave the live table but stay queryable
+    assert ft.journeys() == [ft.get(7)]
+    # spans/moves for unknown (already finished) rids are dropped, not kept
+    ft.record_span(7, "reap", 0, 1)
+    ft.move(7, replica_id=1, generation=0, replica_rid=1)
+    assert ft.get(7).failovers == 1
+
+
+def test_journey_spill_marker_and_disabled_noop():
+    ft = FleetTracer()
+    _journey(ft, rid=1, decision="affinity_spill")
+    names = [n for n, *_ in ft.get(1).spans]
+    assert names == ["route", "spill"]
+    spill = ft.get(1).spans[1]
+    assert spill[1] == spill[2]          # zero-width marker
+    off = FleetTracer(enabled=False)
+    assert _journey(off) is None
+    off.record_span(7, "reap", 0, 1)
+    off.move(7, replica_id=0, generation=0, replica_rid=0)
+    off.finish(7)
+    assert off.journeys() == [] and off.to_json() == []
+
+
+def test_journey_ring_bound_and_to_json_last():
+    ft = FleetTracer(max_completed=2)
+    for rid in range(4):
+        _journey(ft, rid=rid)
+        ft.finish(rid, t=101.0)
+    assert [j.router_rid for j in ft.journeys()] == [2, 3]
+    assert ft.get(0) is None
+    assert [r["router_rid"] for r in ft.to_json(last=1)] == [3]
+
+
+def test_fleet_chrome_trace_resolves_replica_timeline():
+    """One fleet track interleaves the owning replica's request phases
+    (resolved newest-segment-first) with the router-side journey spans;
+    a live request gets an open final span."""
+    ft = FleetTracer()
+    _journey(ft, rid=5)
+    ft.move(5, replica_id=1, generation=0, replica_rid=21, t=102.0)
+    # the survivor's tracer holds the (resumed) full phase history
+    tracer = RequestTracer()
+    tr = tracer.start(21, t=100.0)
+    tr.transition(PHASE_ADMIT, t=100.5)
+    tr.transition(PHASE_RUNNING, t=101.0)
+
+    seen = []
+
+    def resolve(seg):
+        seen.append(seg["replica_id"])
+        return tracer.get(seg["replica_rid"]) if seg["replica_id"] == 1 \
+            else None
+
+    ct = ft.chrome_trace(resolve)
+    assert seen == [1]                   # newest-first, first hit wins
+    ev = [e for e in ct["traceEvents"] if e.get("tid") == 5]
+    names = [e["name"] for e in ev if e.get("ph") == "X"]
+    assert "req.queued" in names and "req.admit" in names
+    assert "router.route" in names
+    meta = [e for e in ev if e.get("ph") == "M"]
+    assert len(meta) == 1
+    assert meta[0]["args"]["name"] == "request 5 (replica 0→1)"
+    live = [e for e in ev if e.get("args", {}).get("open")]
+    assert len(live) == 1 and live[0]["name"] == "req.running"
+    # no resolver: journey spans only, still one labeled track
+    ct2 = ft.chrome_trace()
+    names2 = {e["name"] for e in ct2["traceEvents"]
+              if e.get("tid") == 5 and e.get("ph") == "X"}
+    assert names2 == {"router.route"}
+
+
+# ---------------------------------------------------------------- timeline
+
+def test_timeline_tiered_retention_and_query():
+    tl = MetricsTimeline(tiers=(("raw", 1.0, 3), ("10s", 10.0, 8)))
+    state = {"x": 0}
+    tl.add_source("src", lambda: {"x": state["x"], "nested": {"y": 2},
+                                  "flag": True, "label": "ignored"})
+    for i in range(12):
+        state["x"] = i
+        tl.sample_once(t=1000.0 + i)
+    assert tl.samples_taken == 12
+    # raw ring is bounded: only the newest 3 of 12 one-second ticks
+    raw = tl.query("src.x")
+    assert raw == [(1009.0, 9.0), (1010.0, 10.0), (1011.0, 11.0)]
+    assert tl.query("src.x", last=1) == [(1011.0, 11.0)]
+    # the 10s tier downsampled: first tick then the first one >= 10s later
+    assert tl.query("src.x", tier="10s") == [(1000.0, 0.0), (1010.0, 10.0)]
+    # numeric leaves flatten to dotted names; bools coerce; strings drop
+    assert tl.query("src.nested.y", last=1) == [(1011.0, 2.0)]
+    assert tl.query("src.flag", last=1) == [(1011.0, 1.0)]
+    assert set(tl.metric_names()) == {"src.x", "src.nested.y", "src.flag"}
+    with pytest.raises(KeyError):
+        tl.query("src.x", tier="60s")    # not a tier of THIS timeline
+    snap = tl.snapshot()
+    assert snap["tiers"]["raw"]["retained"] == 3
+    assert snap["tiers"]["10s"]["capacity"] == 8
+    assert not snap["sampler_alive"]
+    # default tiers are the documented 1s/10s/60s ladder
+    assert [n for n, _, _ in MetricsTimeline().tiers] == \
+        [n for n, _, _ in TIMELINE_TIERS]
+
+
+def test_timeline_window_and_dump_jsonl(tmp_path):
+    tl = MetricsTimeline(tiers=(("raw", 1.0, 16),))
+    tl.add_source("m", lambda: {"v": 1})
+    for i in range(6):
+        tl.sample_once(t=2000.0 + i)
+    win = tl.window(last_s=2.5, t=2005.0)
+    assert [w["t"] for w in win] == [2003.0, 2004.0, 2005.0]
+    assert win[0]["values"] == {"m.v": 1.0}
+    p = tl.dump_jsonl(str(tmp_path / "tl.jsonl"))
+    rows = [json.loads(line) for line in open(p)]
+    assert len(rows) == 6 and rows[-1] == {"t": 2005.0,
+                                           "values": {"m.v": 1.0}}
+    with pytest.raises(KeyError):
+        tl.dump_jsonl(str(tmp_path / "no.jsonl"), tier="60s")
+
+
+def test_timeline_broken_source_isolated():
+    tl = MetricsTimeline(tiers=(("raw", 1.0, 4),))
+    tl.add_source("good", lambda: {"v": 7})
+    tl.add_source("bad", lambda: 1 / 0)
+    vals = tl.sample_once(t=3000.0)
+    assert vals["good.v"] == 7.0
+    assert vals["bad.sample_error"] == 1.0 and vals["_errors"] == 1.0
+    # the good source's series is intact despite its broken neighbor
+    assert tl.query("good.v") == [(3000.0, 7.0)]
+
+
+def test_timeline_background_sampler_thread():
+    tl = MetricsTimeline(tiers=(("raw", 0.0, 64),))
+    tl.add_source("s", lambda: {"v": 1})
+    th = tl.start(interval_s=0.005)
+    assert th is tl.start(interval_s=0.005)      # idempotent
+    deadline = time.monotonic() + 5.0
+    while tl.samples_taken < 3 and time.monotonic() < deadline:
+        time.sleep(0.005)
+    assert tl.samples_taken >= 3
+    assert tl.snapshot()["sampler_alive"]
+    tl.stop()
+    assert not tl.snapshot()["sampler_alive"]
+    taken = tl.samples_taken
+    time.sleep(0.03)
+    assert tl.samples_taken == taken             # really stopped
+
+
+# -------------------------------------------------------------- postmortems
+
+def test_postmortem_capture_refractory_and_force():
+    pm = PostmortemStore(max_bundles=2, min_interval_s=60.0)
+    pm.add_context("ctx", lambda: {"depth": 3})
+    b = pm.capture("ttft_breach_storm", "p50 breached",
+                   alarm={"kind": "ttft_breach_storm", "t": 1.0})
+    assert b["kind"] == "ttft_breach_storm" and b["seq"] == 0
+    assert b["ctx"] == {"depth": 3} and b["alarm"]["t"] == 1.0
+    # same kind inside the refractory window: suppressed (counted, None)
+    assert pm.capture("ttft_breach_storm", "again") is None
+    assert pm.suppressed == 1 and pm.captures == 1
+    # a DIFFERENT kind has its own window
+    assert pm.capture("eviction_thrash", "thrash")["seq"] == 1
+    # force (the on-demand path) bypasses the window
+    assert pm.capture("ttft_breach_storm", "forced", force=True)["seq"] == 2
+    assert pm.captures == 3
+    # ring bound: oldest bundle fell off
+    assert [x["seq"] for x in pm.bundles()] == [1, 2]
+    assert pm.last()["reason"] == "forced"
+    s = pm.summary()
+    assert s["captures"] == 3 and s["suppressed"] == 1
+    assert s["retained"] == 2 and s["capacity"] == 2
+    assert [k["kind"] for k in s["kinds"]] == ["eviction_thrash",
+                                               "ttft_breach_storm"]
+
+
+def test_postmortem_broken_provider_isolated_and_dump(tmp_path):
+    pm = PostmortemStore()
+    pm.add_context("good", lambda: {"ok": 1})
+    pm.add_context("bad", lambda: (_ for _ in ()).throw(RuntimeError("boom")))
+    b = pm.capture("breaker_open", "replica 0 reaped")
+    assert b["good"] == {"ok": 1}
+    assert b["bad"] == {"error": "RuntimeError: boom"}
+    p = pm.dump(str(tmp_path / "pm.json"))
+    rows = json.load(open(p))
+    assert len(rows) == 1 and rows[0]["kind"] == "breaker_open"
+
+
+# ------------------------------------------------------------------ endpoint
+
+def test_endpoint_timeline_and_postmortem_routes():
+    tl = MetricsTimeline(tiers=(("raw", 1.0, 8),))
+    tl.add_source("src", lambda: {"depth": 4})
+    for i in range(3):
+        tl.sample_once(t=100.0 + i)
+    pm = PostmortemStore()
+    pm.add_context("ctx", lambda: {"n": 1})
+    pm.capture("stall_storm", "decode stalled")
+    ep = ObservabilityEndpoint(include_default_registry=False)
+    ep.add_timeline("tl0", tl)
+    ep.add_postmortem("pm0", pm)
+    ep.start()
+    try:
+        def get(path):
+            return json.loads(urllib.request.urlopen(
+                ep.url + path, timeout=10).read().decode())
+
+        idx = get("/debug/timeline")
+        assert idx["tl0"]["metrics"] == ["src.depth"]
+        assert idx["tl0"]["summary"]["samples_taken"] == 3
+        series = get("/debug/timeline?metric=src.depth&last=2")
+        assert series["tl0"]["points"] == [[101.0, 4.0], [102.0, 4.0]]
+        assert get("/debug/timeline?metric=x&tier=nope")["tl0"]["error"]
+        # list-only first: the existing bundle, no on-demand capture
+        listed = get("/debug/postmortem?capture=0")
+        assert listed["pm0"]["summary"]["captures"] == 1
+        assert listed["pm0"]["bundles"][0]["kind"] == "stall_storm"
+        # default GET freezes one on-demand bundle per store
+        full = get("/debug/postmortem")
+        assert full["pm0"]["summary"]["captures"] == 2
+        assert full["pm0"]["bundles"][-1]["kind"] == "on_demand"
+        assert full["pm0"]["bundles"][-1]["ctx"] == {"n": 1}
+        # both routes are discoverable from the index
+        routes = get("/debug")["routes"]
+        assert "/debug/timeline" in routes and "/debug/postmortem" in routes
+    finally:
+        ep.stop()

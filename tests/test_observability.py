@@ -376,3 +376,99 @@ def test_observability_overhead_under_budget():
     res = sb.measure_observability_overhead()
     assert res["overhead_pct"] < 5.0, res
     assert res["n_ops"] > 0 and res["per_op_ns"] > 0
+
+
+# ------------------------------------ label escaping + cardinality guard
+
+def test_hostile_label_values_round_trip():
+    """Label values containing ``"``, ``\\``, and newlines must survive the
+    exposition round-trip byte-exact — escape on write, unescape on parse
+    (regression: the old unescape corrupted combined escapes and a raw
+    newline split the exposition line)."""
+    from paddle_tpu.observability.metrics import label_string
+
+    hostile = [
+        'plain',
+        'has "quotes" inside',
+        'back\\slash',
+        'trailing backslash\\',
+        'line\nbreak',
+        '\\"combined\\" escapes',
+        '\\n literal-backslash-n',
+        'all three: "q" \\b\\ and\nnewline',
+    ]
+    reg = MetricsRegistry(namespace="h")
+    c = reg.counter("hostile_total", "hostile label values")
+    for i, v in enumerate(hostile):
+        c.labels(value=v).inc(i + 1)
+    text = reg.prometheus_text()
+    # the exposition stays line-structured: one series line per value
+    assert len([ln for ln in text.splitlines()
+                if ln.startswith("h_hostile_total{")]) == len(hostile)
+    parsed = parse_prometheus_text(text)
+    got = {labels["value"]: val
+           for labels, val in parsed["h_hostile_total"]["labeled"]}
+    assert got == {v: float(i + 1) for i, v in enumerate(hostile)}
+    # snapshot keys stay canonical + parse back to the same values
+    snap = reg.snapshot()
+    for i, v in enumerate(hostile):
+        key = f"h_hostile_total{{{label_string({'value': v})}}}"
+        assert snap[key] == float(i + 1)
+
+
+def test_label_cardinality_cap_both_sides():
+    """Below the cap every label set gets its own series; past it new sets
+    collapse into the ``overflow="true"`` sink with a counted drop and ONE
+    loud warning — and previously-seen sets still resolve to their own
+    children."""
+    from paddle_tpu.observability.metrics import MetricsCardinalityOverflow
+
+    reg = MetricsRegistry(namespace="cap")
+    c = reg.counter("shards_total", "per-shard events")
+    c.max_label_sets = 8
+
+    # below the cap: distinct children, no warning
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        for i in range(8):
+            c.labels(shard=str(i)).inc()
+    assert c.overflow_dropped == 0
+    assert c.labels(shard="3") is c.labels(shard="3")
+
+    # past the cap: the sink absorbs NEW sets, one warning total
+    with pytest.warns(MetricsCardinalityOverflow):
+        over1 = c.labels(shard="8")
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")       # second overflow: silent
+        over2 = c.labels(shard="9")
+        # known sets still hit their own child, not the sink
+        assert c.labels(shard="5") is not over1
+    assert over1 is over2                    # one shared sink child
+    over1.inc(5)
+    assert c.overflow_dropped == 2
+
+    snap = reg.snapshot()
+    assert snap['cap_shards_total{overflow="true"}'] == 5.0
+    assert snap['cap_shards_total{shard="3"}'] == 1.0
+    assert 'cap_shards_total{shard="9"}' not in snap
+    # the sink rides the normal exposition too
+    parsed = parse_prometheus_text(reg.prometheus_text())
+    series = parsed["cap_shards_total"]["series"]
+    assert series['overflow="true"'] == 5.0
+    assert len(series) == 9                  # 8 real + 1 sink
+
+
+def test_gauge_cardinality_cap():
+    """The guard covers Gauge families too (shared _Labeled machinery)."""
+    from paddle_tpu.observability.metrics import MetricsCardinalityOverflow
+
+    reg = MetricsRegistry(namespace="g")
+    g = reg.gauge("depth")
+    g.max_label_sets = 2
+    g.labels(q="a").set(1)
+    g.labels(q="b").set(2)
+    with pytest.warns(MetricsCardinalityOverflow):
+        g.labels(q="c").set(7)
+    snap = reg.snapshot()
+    assert snap['g_depth{overflow="true"}'] == 7.0
+    assert g.overflow_dropped == 1

@@ -464,3 +464,92 @@ def test_full_observability_overhead_and_token_identity():
     res = sb.measure_tracing_overhead(repeats=1)
     assert res["token_identical"], res["outputs_sha1"]
     assert res["attributed_overhead_pct"] < 5.0, res
+
+
+# ---------------------------------------------- live export + failover resume
+
+def test_live_trace_export_includes_open_final_span():
+    """A trace exported mid-flight (postmortem taken during an incident)
+    shows the still-open phase up to "now" — not a timeline that appears
+    to stop at the last transition."""
+    tracer = RequestTracer()
+    t0 = time.perf_counter()
+    tr = tracer.start(42, t=t0 - 1.0, prompt_tokens=3)
+    tr.transition(PHASE_ADMIT, t=t0 - 0.5)
+    tr.transition(PHASE_RUNNING, t=t0 - 0.25)
+    d = tr.to_dict()
+    assert d["finish_t"] is None and d["phase"] == PHASE_RUNNING
+    open_rows = [r for r in d["phases"] if r.get("open")]
+    assert len(open_rows) == 1
+    assert open_rows[0]["phase"] == PHASE_RUNNING
+    assert open_rows[0]["t0"] == pytest.approx(t0 - 0.25)
+    assert open_rows[0]["dur_s"] >= 0.25
+    # the open remainder is folded into the totals, so the totals cover
+    # the full arrival->now window even though the request hasn't finished
+    assert sum(d["phase_totals_s"].values()) >= 1.0
+    # closed rows never carry the marker
+    assert all("open" not in r for r in d["phases"] if r is not open_rows[0])
+    # to_json(include_live=True) carries the same synthesized row
+    rows = tracer.to_json()
+    assert any(r.get("open") for r in rows[-1]["phases"])
+    # chrome_trace renders the live request with an open final X span
+    ct = tracer.chrome_trace()
+    live_spans = [e for e in ct["traceEvents"]
+                  if e.get("tid") == 42 and e.get("ph") == "X"
+                  and e.get("args", {}).get("open")]
+    assert len(live_spans) == 1
+    assert live_spans[0]["name"] == "req.running"
+    assert live_spans[0]["dur"] > 0
+
+
+def test_export_snapshot_resume_failover_gapless():
+    """The cross-replica half of "one request = one timeline": a snapshot
+    exported off a dead replica, resumed on a survivor, yields ONE trace
+    whose phases still partition E2E exactly — with an explicit gapless
+    ``failover`` phase bridging export -> import."""
+    from paddle_tpu.observability.request_trace import PHASE_FAILOVER
+
+    dead = RequestTracer()
+    tr = dead.start(5, t=100.0, prompt_tokens=4, priority=1)
+    tr.transition(PHASE_ADMIT, t=100.5)
+    tr.subspan("prefill", 0.2)
+    tr.transition(PHASE_RUNNING, t=101.0)
+    tr.event("resumed", t=101.1)
+    snap = dead.export_snapshot(5, t=101.5)
+    assert snap is not None and snap["export_t"] == 101.5
+    assert snap["open_phase"] == PHASE_RUNNING
+    # the export REMOVED the trace from the dead tracer
+    assert dead.get(5) is None and dead.live() == []
+
+    survivor = RequestTracer()
+    tr2 = survivor.resume(9, snap, t=102.0, replica_hop=1)
+    assert survivor.get(9) is tr2
+    # prior history survived the hop
+    assert tr2.arrival_t == 100.0
+    assert tr2.phase_count(PHASE_ADMIT) == 1
+    assert tr2.subspans["prefill"] == [1, 0.2]
+    assert any(n == "resumed" for n, _, _ in tr2.events)
+    # failover phase bridges export -> import exactly
+    fo = [(p, t0, t1) for p, t0, t1 in tr2.phases if p == PHASE_FAILOVER]
+    assert fo == [(PHASE_FAILOVER, 101.5, 102.0)]
+    # resumed request re-enters the survivor's queue
+    assert tr2.current_phase == PHASE_QUEUED
+    tr2.transition(PHASE_ADMIT, t=102.5)
+    tr2.transition(PHASE_RUNNING, t=103.0)
+    survivor.finish(9, t=104.0)
+    done = survivor.completed()[0]
+    d = done.phase_durations()
+    assert d[PHASE_FAILOVER] == 0.5
+    assert sum(d.values()) == pytest.approx(done.e2e_s(), abs=1e-9)
+    assert done.e2e_s() == 4.0
+
+
+def test_resume_without_snapshot_falls_back_to_start():
+    survivor = RequestTracer()
+    tr = survivor.resume(3, None, t=50.0, prompt_tokens=2)
+    assert tr is not None and tr.arrival_t == 50.0
+    assert tr.current_phase == PHASE_QUEUED
+    assert tr.phase_count("failover") == 0
+    off = RequestTracer(enabled=False)
+    assert off.resume(3, {"arrival_t": 0.0}) is None
+    assert off.export_snapshot(3) is None

@@ -476,3 +476,97 @@ def test_serve_bench_router_mode_quiesces_replicas_on_death(
     assert q["replicas"] == 2
     assert q["cancelled"] >= 1
     assert q["blocks_leaked"] == 0
+
+
+# ------------------------------------------- fleet journey kill drill
+
+@pytest.mark.parametrize("depth", [0, 2])
+def test_kill_drill_single_journey_track_token_identical(model, depth):
+    """The fleet-observability drill: kill a replica mid-decode with
+    journey tracing + the metrics sampler enabled. Every failed-over
+    request must render as EXACTLY ONE fleet-trace track carrying an
+    explicit ``failover`` phase plus router reap/replay spans, its phase
+    durations must still sum to E2E (the gapless invariant survives the
+    replica hop), and the tokens must stay bit-identical to the
+    single-replica oracle at dispatch_depth 0 and 2."""
+    prompts = _prompts(6, seed=11)
+    max_new = 6
+    refs = _oracle(model, prompts, max_new, dispatch_depth=depth)
+
+    router = _router(model, n=3, sched={"dispatch_depth": depth},
+                     timeline_interval_s=0.005)
+    rids = [router.submit(p, max_new_tokens=max_new) for p in prompts]
+    router.timeline.sample_once()        # deterministic inline samples
+    for _ in range(3):
+        router.step()
+        router.timeline.sample_once()
+
+    router.crash_replica(0)
+    router.step()                        # supervisor reaps + fails over
+    router.timeline.sample_once()
+
+    guard = 3000
+    while router.has_unfinished():
+        router.step()
+        guard -= 1
+        assert guard > 0, "router did not drain after the kill"
+    results = {rid: router.get_finished(rid) for rid in rids}
+
+    # token identity with the full observability stack on
+    for rid, ref in zip(rids, refs):
+        np.testing.assert_array_equal(results[rid].token_ids, ref)
+
+    dbg = router.debug_state()
+    assert dbg["router"]["failovers"] == 1
+    moved = dbg["router"]["requests_failed_over"]
+    assert moved >= 1
+
+    # one journey per request; the moved ones carry the replica hop
+    journeys = {j.router_rid: j for j in router.fleet.journeys()}
+    assert sorted(journeys) == sorted(rids)
+    hopped = [j for j in journeys.values() if j.failovers > 0]
+    assert len(hopped) == moved
+
+    trace = router.export_fleet_trace()
+    ev = trace["traceEvents"]
+    for j in hopped:
+        tid = j.router_rid
+        # exactly ONE track for the failed-over request
+        tracks = [e for e in ev if e.get("ph") == "M"
+                  and e.get("name") == "thread_name"
+                  and e.get("tid") == tid]
+        assert len(tracks) == 1
+        names = {e["name"] for e in ev
+                 if e.get("ph") == "X" and e.get("tid") == tid}
+        # the explicit failover span links the replica segments, and the
+        # router-side spans frame it on the same single track
+        assert "req.failover" in names
+        assert {"router.route", "router.reap", "router.replay"} <= names
+
+        # gapless across the hop: phase durations sum to E2E on the
+        # survivor's resumed trace, which holds the WHOLE timeline
+        seg = j.segments[-1]
+        rep = router.replicas[seg["replica_id"]]
+        tr = rep.sched.tracer.get(seg["replica_rid"])
+        assert tr is not None and tr.finish_t is not None
+        total = sum(tr.phase_durations().values())
+        assert total == pytest.approx(tr.e2e_s(), abs=1e-6)
+        assert tr.phase_count("failover") == 1
+
+    # the sampler actually ran (inline + background thread) and recorded
+    # queryable per-replica history; the breaker-open incident captured
+    # one correlated postmortem bundle
+    assert router.timeline.samples_taken >= 4
+    assert any(m.startswith("replica0.") or m.startswith("router.")
+               for m in router.timeline.metric_names())
+    assert router.postmortems.captures >= 1
+    kinds = [b["kind"] for b in router.postmortems.bundles()]
+    assert "breaker_open" in kinds
+    bundle = [b for b in router.postmortems.bundles()
+              if b["kind"] == "breaker_open"][-1]
+    assert "journeys" in bundle and "timeline_window" in bundle
+    assert "router" in bundle
+
+    router.shutdown()
+    assert not router.timeline.snapshot()["sampler_alive"]
+    _pools_clean(router)

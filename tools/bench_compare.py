@@ -67,7 +67,7 @@ _LOWER_MARKERS = (
 _HIGHER_MARKERS = (
     "tokens_per_s", "steps_per_s", "images_per_s", "per_s", "speedup",
     "ratio", "hit_rate", "goodput", "util", "mfu", "tflops", "gbs",
-    "recovery_pct", "ceiling", "bandwidth",
+    "recovery_pct", "ceiling", "bandwidth", "coverage",
 )
 
 

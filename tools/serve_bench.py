@@ -837,7 +837,8 @@ def run_router_load(num_replicas: int = 3, num_requests: int = 18,
                     policy: str = "affinity",
                     kill_at=None, kill_replica: int = 0,
                     cooldown_s: float = 0.02,
-                    enable_prefix_caching: bool = True) -> dict:
+                    enable_prefix_caching: bool = True,
+                    router_kw=None, on_drained=None) -> dict:
     """One synthetic Poisson load through a ``ServingRouter``; returns the
     artifact dict.
 
@@ -875,7 +876,8 @@ def run_router_load(num_replicas: int = 3, num_requests: int = 18,
 
     router = _track_router(ServingRouter(
         factory, num_replicas=num_replicas, policy=policy,
-        cooldown_s=cooldown_s, affinity_tokens=block_size))
+        cooldown_s=cooldown_s, affinity_tokens=block_size,
+        **(router_kw or {})))
 
     rng = np.random.default_rng(seed)
     gaps = rng.exponential(1.0 / max(rate, 1e-6), num_requests)
@@ -928,6 +930,11 @@ def run_router_load(num_replicas: int = 3, num_requests: int = 18,
         if it > 100000:
             raise RuntimeError("router load did not drain")
     wall = time.perf_counter() - t0
+    if on_drained is not None:
+        # hook for suites that need the LIVE fleet after the drain (the
+        # fleet-trace suite exports journeys and forces an alarm here —
+        # after shutdown the replica tracers are no longer resolvable)
+        on_drained(router)
     router.shutdown()
 
     outs = {rid: router.get_finished(rid) for rid in rids}
@@ -1113,6 +1120,102 @@ def run_router_suite(smoke: bool = True, out_dir: str = REPO_ROOT,
     return artifact
 
 
+def run_fleet_trace_suite(smoke: bool = True, out_dir: str = REPO_ROOT,
+                          num_replicas: int = 3, kill_at=None) -> dict:
+    """The BENCH_serving_fleet_trace artifact: the replica-kill drill
+    re-run with journey tracing and the router's timeline sampler on.
+    Exports ONE chrome trace with one track per router request spanning
+    the failover (route/reap/replay spans interleaved with the resumed
+    replica phase timeline, including the explicit ``failover`` phase),
+    plus one postmortem bundle captured through the REAL alarm path — a
+    forced flight-recorder alarm on a survivor replica, not a direct
+    ``capture()`` call. Writes ``BENCH_serving_fleet_trace.json`` and the
+    journey chrome artifact ``BENCH_serving_fleet_journeys.json``."""
+    kw = (dict(num_requests=12, rate=1.2, max_num_seqs=2, block_size=8,
+               max_seq_len=64, num_layers=1, prompt_lens=(4, 12),
+               new_tokens=(5, 8))
+          if smoke else
+          dict(num_requests=32, rate=1.0, max_num_seqs=4, block_size=8,
+               max_seq_len=128, num_layers=2, prompt_lens=(6, 24),
+               new_tokens=(8, 16)))
+    if kill_at is None:
+        kill_at = 4 if smoke else 10
+
+    box = {}
+
+    def on_drained(router):
+        # must run while the fleet is LIVE: export_fleet_trace resolves
+        # journey segments against replica tracers, and the forced alarm
+        # exercises the wired flight-callback -> router-store path
+        router.replicas[-1].sched.flight.alarm(
+            "ttft_breach_storm", "forced by serve_bench --replicas "
+            "(artifact demonstration, not a real breach)")
+        for _ in range(3):
+            router.timeline.sample_once()
+        box["trace"] = router.export_fleet_trace()
+        box["journeys"] = router.fleet.to_json()
+        box["timeline"] = router.timeline.snapshot()
+        box["postmortems"] = router.postmortems.summary()
+        box["bundle"] = router.postmortems.last()
+
+    art = run_router_load(num_replicas=num_replicas, policy="affinity",
+                          kill_at=kill_at, kill_replica=0,
+                          router_kw={"timeline_interval_s": 0.05},
+                          on_drained=on_drained, **kw)
+
+    trace, journeys = box["trace"], box["journeys"]
+    hopped = [j for j in journeys if j["failovers"] > 0]
+    tids_meta = [e["tid"] for e in trace["traceEvents"]
+                 if e.get("ph") == "M" and e["name"] == "thread_name"]
+    failover_tids = {e["tid"] for e in trace["traceEvents"]
+                     if e.get("ph") == "X" and e["name"] == "req.failover"}
+    accepted = kw["num_requests"] - art["rejected"]
+    journey_coverage = len(journeys) / max(accepted, 1)
+    failover_coverage = (
+        len(failover_tids & {j["router_rid"] for j in hopped})
+        / max(len(hopped), 1))
+
+    trace_path = os.path.join(out_dir, "BENCH_serving_fleet_journeys.json")
+    with open(trace_path, "w") as f:
+        json.dump(trace, f)
+
+    bundle = box["bundle"] or {}
+    artifact = {
+        "bench": "serving_fleet_trace",
+        "config": {**kw, "num_replicas": num_replicas, "kill_at": kill_at,
+                   "seed": 0},
+        "journey_trace_artifact": os.path.basename(trace_path),
+        "journey_trace_events": len(trace["traceEvents"]),
+        "journeys_tracked": len(journeys),
+        "journey_coverage": round(journey_coverage, 4),
+        "requests_failed_over": len(hopped),
+        "failover_track_coverage": round(failover_coverage, 4),
+        "one_track_per_request": len(tids_meta) == len(set(tids_meta))
+                                 == len(journeys),
+        "goodput": art["goodput"],
+        "timeline": box["timeline"],
+        "postmortems": box["postmortems"],
+        "forced_alarm_bundle": {
+            "kind": bundle.get("kind"),
+            "reason": bundle.get("reason"),
+            "context_keys": sorted(k for k in bundle
+                                   if k not in ("seq", "kind", "reason",
+                                                "t", "alarm")),
+        },
+        "within_budget": (journey_coverage == 1.0
+                          and failover_coverage == 1.0
+                          and len(hopped) > 0
+                          and art["goodput"] == 1.0
+                          and box["postmortems"]["captures"] >= 2
+                          and box["timeline"]["samples_taken"] >= 3),
+        "completed": True,
+    }
+    out_path = os.path.join(out_dir, "BENCH_serving_fleet_trace.json")
+    write_bench_json(out_path, artifact)
+    artifact["artifact"] = out_path
+    return artifact
+
+
 def measure_observability_overhead(**load_kw) -> dict:
     """Metrics-path overhead on the serving smoke workload.
 
@@ -1248,6 +1351,37 @@ def measure_tracing_overhead(repeats: int = 2, **load_kw) -> dict:
                            evicted_blocks=0, finished=0)
     flight_s = (_time.perf_counter() - t0) / N
 
+    # fleet-layer primitives (router journeys, timeline sampler,
+    # postmortem capture) — charged at the rates a fleet-on deployment
+    # drives them: one journey per request, a 1 Hz sampler over the wall,
+    # one alarm-triggered bundle per run
+    from paddle_tpu.observability import (
+        FleetTracer,
+        MetricsTimeline,
+        PostmortemStore,
+    )
+
+    ft = FleetTracer()
+    t0 = _time.perf_counter()
+    for i in range(N):
+        ft.start(i, replica_id=0, generation=0, replica_rid=i,
+                 decision="least_loaded")
+        ft.finish(i)
+    journey_s = (_time.perf_counter() - t0) / N
+    M = 2000
+    tl = MetricsTimeline()
+    tl.add_source("bench", lambda: {"depth": 1.0, "nested": {"v": 2.0}})
+    t0 = _time.perf_counter()
+    for _ in range(M):
+        tl.sample_once()
+    sample_s = (_time.perf_counter() - t0) / M
+    pm = PostmortemStore(max_bundles=4)
+    pm.add_context("bench", lambda: {"state": 1})
+    t0 = _time.perf_counter()
+    for _ in range(M):
+        pm.capture("bench", "unit-cost loop", force=True)
+    capture_s = (_time.perf_counter() - t0) / M
+
     art = min(runs["on"], key=lambda a: a["wall_s"])
     m = art["metrics"]
     n_ops = {
@@ -1258,10 +1392,16 @@ def measure_tracing_overhead(repeats: int = 2, **load_kw) -> dict:
         # re-admissions ride the prefills count too
         "transition": m["prefills"] * 2 + m["requests_finished"],
         "subspan": m["prefills"] * 3,
+        "journey": m["requests_finished"],
+        "timeline_sample": int(art["wall_s"]) + 1,
+        "postmortem_capture": 1,
     }
     attributed_s = (n_ops["flight"] * flight_s + n_ops["stall"] * stall_s
                     + n_ops["transition"] * transition_s
-                    + n_ops["subspan"] * subspan_s)
+                    + n_ops["subspan"] * subspan_s
+                    + n_ops["journey"] * journey_s
+                    + n_ops["timeline_sample"] * sample_s
+                    + n_ops["postmortem_capture"] * capture_s)
     # endpoint scrapes happen between steps: charge their measured wall
     scrape_s = 0.0
     if art["n_scrapes"]:
@@ -1287,7 +1427,10 @@ def measure_tracing_overhead(repeats: int = 2, **load_kw) -> dict:
         "unit_ns": {"transition": round(transition_s * 1e9, 1),
                     "subspan": round(subspan_s * 1e9, 1),
                     "stall_record": round(stall_s * 1e9, 1),
-                    "flight_record": round(flight_s * 1e9, 1)},
+                    "flight_record": round(flight_s * 1e9, 1),
+                    "journey": round(journey_s * 1e9, 1),
+                    "timeline_sample": round(sample_s * 1e9, 1),
+                    "postmortem_capture": round(capture_s * 1e9, 1)},
         "n_ops": n_ops,
         "n_scrapes": art["n_scrapes"],
         "wall_s": art["wall_s"],
@@ -1386,7 +1529,11 @@ def main(argv=None) -> dict:
                          "replicas: tokens/s scaling vs 1 replica, "
                          "replica-kill failover drill (token identity, "
                          "goodput recovery), affinity-vs-round-robin "
-                         "hit rate -> BENCH_serving_router.json")
+                         "hit rate -> BENCH_serving_router.json; also "
+                         "runs the fleet-observability drill (cross-"
+                         "replica journey chrome trace + forced-alarm "
+                         "postmortem bundle) -> "
+                         "BENCH_serving_fleet_trace.json")
     ap.add_argument("--kill-at", type=int, default=None,
                     help="router suite: crash replica 0 at this iteration "
                          "of the kill drill (default: mid-run)")
@@ -1444,6 +1591,17 @@ def _run_mode(args, mode: str, out_path: str) -> dict:
             num_replicas=max(2, args.replicas),
             kill_at=args.kill_at,
             out_dir=os.path.dirname(out_path) or ".")
+        fleet = run_fleet_trace_suite(
+            smoke=args.smoke,
+            num_replicas=max(2, args.replicas),
+            kill_at=args.kill_at,
+            out_dir=os.path.dirname(out_path) or ".")
+        artifact["fleet_trace"] = {
+            "artifact": fleet["artifact"],
+            "journey_coverage": fleet["journey_coverage"],
+            "failover_track_coverage": fleet["failover_track_coverage"],
+            "within_budget": fleet["within_budget"],
+        }
         print(json.dumps({
             "metric": "serving_router_recovery_pct",
             "value": artifact["kill_drill"]["recovery_pct_of_baseline"],
@@ -1455,6 +1613,7 @@ def _run_mode(args, mode: str, out_path: str) -> dict:
             "speedup_x": artifact["scaling"]["speedup_x"],
             "affinity_hit_rate_win":
                 artifact["affinity_vs_round_robin"]["hit_rate_win"],
+            "journey_coverage": fleet["journey_coverage"],
             "within_budget": artifact["within_budget"],
             "artifact": artifact["artifact"],
         }))
