@@ -882,6 +882,52 @@ def bench_serving_fleet_trace(on_tpu):
     }))
 
 
+def bench_serving_sharded(on_tpu):
+    """Sharded multi-chip serving (tools/serve_bench sharded mode): one
+    replica's compiled decode program lowered over a tp=2 device mesh
+    with a head-sharded KV pool, plus a 2x tp=2 DeviceGroupPlan router
+    fleet on disjoint device groups. Asserts the sharded token streams
+    are bit-identical to the single-device oracle, the KV pool's bytes
+    split exactly 1/tp per chip in the per-device ledger census, and the
+    fleet's replica device sets are disjoint (the r15 colocated-
+    contention fix). Runs via serve_bench's fresh-subprocess respawn so
+    the emulated mesh's --xla_force_host_platform_device_count lands
+    before jax initializes — CPU-sized; the artifact is
+    BENCH_serving_sharded.json."""
+    import sys
+
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from tools import serve_bench
+
+    art = serve_bench.main(["--smoke", "--tp", "2", "--replicas", "2"])
+    assert art["completed"], art.get("error")
+    assert art["sharded"]["token_identical_to_oracle"], (
+        "tp=2 decode diverged from the single-device oracle")
+    assert art["sharded"]["kv_split"]["chips"] == 2, art["sharded"]["kv_split"]
+    assert art["sharded"]["kv_split"]["max_fraction"] == 0.5, (
+        "KV pool bytes not split 1/tp per chip: %s"
+        % art["sharded"]["kv_split"])
+    assert art["fleet"]["disjoint_replica_device_sets"], (
+        "DeviceGroupPlan fleet placed replicas on overlapping devices: %s"
+        % art["fleet"]["replica_device_sets"])
+    assert art["fleet"]["token_identical_to_oracle"], (
+        "fleet token streams diverged from the oracle")
+    print(json.dumps({
+        "metric": "serving_sharded_tokens_per_s",
+        "value": art["sharded"]["tokens_per_s"],
+        "unit": "tokens/s, one replica over a tp=2 emulated mesh "
+                "(dispatch overhead on CPU, not chip scaling)",
+        "vs_baseline": None,  # first round with a sharded trajectory
+        "token_identical_to_oracle":
+            art["sharded"]["token_identical_to_oracle"],
+        "kv_split_max_fraction": art["sharded"]["kv_split"]["max_fraction"],
+        "disjoint_replica_device_sets":
+            art["fleet"]["disjoint_replica_device_sets"],
+        "fleet_tokens_per_s": art["fleet"]["tokens_per_s"],
+        "within_budget": art["within_budget"],
+    }))
+
+
 def bench_ckpt(on_tpu):
     """Checkpoint lifecycle: sync save throughput, async snapshot stall
     (the train-step pause a background save costs), and cold resume
@@ -1134,6 +1180,7 @@ for _f in (bench_chip_ceilings, bench_resnet50, bench_bert, bench_ernie,
            bench_serving_async,
            bench_serving_router,
            bench_serving_fleet_trace,
+           bench_serving_sharded,
            bench_ckpt,
            bench_train,
            bench_lint,

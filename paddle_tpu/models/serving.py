@@ -109,8 +109,15 @@ class SlotStep:
         """Entries in the jit program cache (recompile accounting)."""
         return self._sf._jitted._cache_size()
 
+    def _model_call(self, ids, position_ids, caches):
+        """The model-forward half of the compiled step. Subclasses override
+        this to re-stage the forward (e.g. ``ShardedSlotStep`` lowers it
+        under a device mesh with sharding-constraint seams) while inheriting
+        the in-graph sampling and the jit program cache unchanged."""
+        return self.model(ids, position_ids, caches)
+
     def _forward_sample(self, ids, position_ids, caches, gather_idx):
-        logits, new_caches = self.model(ids, position_ids, caches)
+        logits, new_caches = self._model_call(ids, position_ids, caches)
         temp, k = self.temperature, self.top_k
         key = rng.next_key() if temp > 0 else None
 

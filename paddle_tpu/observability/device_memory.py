@@ -46,6 +46,7 @@ __all__ = [
     "LedgerHandle",
     "OWNERS",
     "get_device_ledger",
+    "tree_device_nbytes",
     "tree_nbytes",
 ]
 
@@ -103,20 +104,64 @@ def tree_nbytes(tree) -> int:
     return sum(_leaf_nbytes(leaf) for leaf in jax.tree_util.tree_leaves(tree))
 
 
+def tree_device_nbytes(tree) -> Dict[str, int]:
+    """Per-device RESIDENT bytes of a pytree: ``{device_str: bytes}``.
+
+    Walks each jax array's ``addressable_shards`` so a head-sharded KV
+    pool attributes ~1/tp of its bytes to each chip while a replicated
+    weight attributes its FULL size to every chip it lives on — the sum
+    over devices is physical HBM, which for replicated arrays exceeds
+    the logical ``tree_nbytes`` on purpose. Shard sizes are aval-derived
+    (no device sync); leaves whose placement can't be read (donated
+    shells, numpy, scalars) are attributed to ``"unknown"``.
+    """
+    import jax
+
+    out: Dict[str, int] = {}
+    for leaf in jax.tree_util.tree_leaves(tree):
+        v = leaf
+        if not isinstance(v, (jax.Array, np.ndarray)) and v is not None:
+            v = getattr(v, "_value", v)
+        total = _leaf_nbytes(leaf)
+        if total == 0:
+            continue
+        shards = getattr(v, "addressable_shards", None)
+        placed = False
+        if shards is not None:
+            try:
+                for sh in shards:
+                    nb = int(np.prod(sh.data.shape, dtype=np.int64)
+                             ) * np.dtype(sh.data.dtype).itemsize
+                    key = str(sh.device)
+                    out[key] = out.get(key, 0) + nb
+                    placed = True
+            except Exception:
+                placed = False
+        if not placed:
+            out["unknown"] = out.get("unknown", 0) + total
+    return out
+
+
 class LedgerHandle:
     """One registered allocation: resize when the footprint changes,
     release on teardown. Idempotent release; resize after release is a
     no-op (teardown races in tests should not resurrect bytes)."""
 
-    __slots__ = ("owner", "name", "nbytes", "overlay", "_ledger", "_released")
+    __slots__ = ("owner", "name", "nbytes", "overlay", "devices",
+                 "_ledger", "_released")
 
     def __init__(self, ledger: "DeviceMemoryLedger", owner: str, name: str,
-                 nbytes: int, overlay: bool):
+                 nbytes: int, overlay: bool,
+                 devices: Optional[Dict[str, int]] = None):
         self._ledger = ledger
         self.owner = owner
         self.name = name
         self.nbytes = int(nbytes)
         self.overlay = overlay
+        # per-device resident bytes ({device_str: bytes}); None = placement
+        # unknown (plain-size registrations). Mutated only under the
+        # ledger's lock (resize scales it proportionally).
+        self.devices = dict(devices) if devices else None
         self._released = False
 
     def resize(self, nbytes: int) -> None:
@@ -143,6 +188,7 @@ class DeviceMemoryLedger:
         self._lock = threading.Lock()
         self._handles: List[LedgerHandle] = []
         self._watermark: Dict[str, int] = {}
+        self._devices_seen: Dict[str, set] = {}
         self._reg = registry
         self.last_oom: Optional[dict] = None
         if registry is not None:
@@ -159,15 +205,20 @@ class DeviceMemoryLedger:
     # ---- registration ---------------------------------------------------
 
     def register(self, owner: str, name: str, nbytes: int,
-                 overlay: bool = False) -> LedgerHandle:
+                 overlay: bool = False,
+                 devices: Optional[Dict[str, int]] = None) -> LedgerHandle:
         """Account ``nbytes`` of device memory under ``owner``.
 
         ``overlay=True`` marks bytes that alias another owner's
         allocation (prefix-pinned KV blocks live inside the kv_pool):
         they get their own gauge series but are excluded from the
-        primary census sum.
+        primary census sum. ``devices`` optionally attributes the bytes
+        per chip (``{device_str: bytes}``) for the
+        ``device_memory_bytes{owner,device}`` series and the per-chip
+        census — sharded pools pass their real shard map.
         """
-        h = LedgerHandle(self, str(owner), str(name), nbytes, bool(overlay))
+        h = LedgerHandle(self, str(owner), str(name), nbytes, bool(overlay),
+                         devices=devices)
         with self._lock:
             self._handles.append(h)
             self._bump_locked(h.owner)
@@ -175,13 +226,23 @@ class DeviceMemoryLedger:
 
     def register_arrays(self, owner: str, name: str, tree,
                         overlay: bool = False) -> LedgerHandle:
-        """``register`` sized from the array leaves of a pytree."""
-        return self.register(owner, name, tree_nbytes(tree), overlay=overlay)
+        """``register`` sized from the array leaves of a pytree, with
+        per-device attribution read off the arrays' actual shardings."""
+        return self.register(owner, name, tree_nbytes(tree), overlay=overlay,
+                             devices=tree_device_nbytes(tree))
 
     def _resize(self, h: LedgerHandle, nbytes: int) -> None:
         with self._lock:
             if h._released:
                 return
+            if h.devices and h.nbytes > 0:
+                # footprint changed but the placement layout didn't
+                # (prefix-cache pins grow/shrink INSIDE the sharded pool):
+                # scale the per-device split proportionally
+                scale = nbytes / h.nbytes
+                h.devices = {d: int(b * scale) for d, b in h.devices.items()}
+            elif h.devices is not None and h.nbytes == 0:
+                h.devices = None
             h.nbytes = nbytes
             self._bump_locked(h.owner)
 
@@ -200,9 +261,26 @@ class DeviceMemoryLedger:
         live = sum(h.nbytes for h in self._handles if h.owner == owner)
         peak = max(self._watermark.get(owner, 0), live)
         self._watermark[owner] = peak
+        per_dev = self._device_bytes_locked(owner)
+        # keep emitting 0 for devices this owner USED to occupy so a
+        # release/reshard doesn't leave a stale gauge sample behind
+        seen = self._devices_seen.setdefault(owner, set())
+        seen.update(per_dev)
         if self._g_live is not None:
             self._g_live.labels(owner=owner).set(live)
             self._g_peak.labels(owner=owner).set(peak)
+            for dev in seen:
+                self._g_live.labels(owner=owner, device=dev).set(
+                    per_dev.get(dev, 0))
+
+    def _device_bytes_locked(self, owner: str) -> Dict[str, int]:
+        out: Dict[str, int] = {}
+        for h in self._handles:
+            if h.owner != owner or not h.devices:
+                continue
+            for dev, nb in h.devices.items():
+                out[dev] = out.get(dev, 0) + nb
+        return out
 
     # ---- queries --------------------------------------------------------
 
@@ -232,6 +310,10 @@ class DeviceMemoryLedger:
                 })
                 row["bytes"] += h.nbytes
                 row["entries"] += 1
+                if h.devices:
+                    devs = row.setdefault("devices", {})
+                    for dev, nb in h.devices.items():
+                        devs[dev] = devs.get(dev, 0) + nb
             for owner, peak in self._watermark.items():
                 out.setdefault(owner, {
                     "bytes": 0, "entries": 0, "overlay": False,
@@ -240,14 +322,24 @@ class DeviceMemoryLedger:
             return out
 
     def census_report(self) -> dict:
-        """The ``/debug/memory`` face: census plus roll-up totals."""
+        """The ``/debug/memory`` face: census plus roll-up totals and the
+        per-chip sum over primary (non-overlay) owners — physical resident
+        bytes per device, so replicated weights count fully on every chip
+        they occupy while sharded pools contribute ~1/tp each."""
         census = self.census()
         primary = sum(r["bytes"] for r in census.values() if not r["overlay"])
+        per_device: Dict[str, int] = {}
+        for r in census.values():
+            if r["overlay"]:
+                continue
+            for dev, nb in r.get("devices", {}).items():
+                per_device[dev] = per_device.get(dev, 0) + nb
         return {
             "owners": census,
             "total_bytes": primary,
             "total_bytes_with_overlays":
                 sum(r["bytes"] for r in census.values()),
+            "per_device": per_device,
             "last_oom": self.last_oom,
         }
 

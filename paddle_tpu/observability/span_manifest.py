@@ -41,6 +41,10 @@ SPAN_MANIFEST = {
     "serving.prefix_match": {"owner": "serving", "category": "UserDefined"},
     "serving.reload_weights": {"owner": "serving",
                                "category": "UserDefined"},
+    # sharded serving (tensor-parallel mesh placement at replica build)
+    "serving.shard_weights": {"owner": "serving",
+                              "category": "UserDefined"},
+    "serving.shard_pool": {"owner": "serving", "category": "UserDefined"},
     # multi-replica router front end
     "router.route": {"owner": "serving", "category": "UserDefined"},
     "router.failover": {"owner": "serving", "category": "UserDefined"},

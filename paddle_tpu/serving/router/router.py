@@ -71,6 +71,11 @@ lock_order("ContinuousBatchingScheduler._elock", "<", "ServingRouter._lock")
 
 POLICIES = ("affinity", "least_loaded", "round_robin")
 
+# device_ownership="warn" fires at most once per process (colocated
+# replicas are the NORM on single-device dev boxes; one loud pointer at
+# DeviceGroupPlan beats a warning per router construction in a test run)
+_OWNERSHIP_WARNED = False
+
 
 class _RouterRecord:
     """Router-side bookkeeping for one live request."""
@@ -88,9 +93,13 @@ class _RouterRecord:
 
 
 class ServingRouter:
-    """Front-end over N supervised scheduler replicas. ``factory()`` must
-    build a fresh, functionally identical ``ContinuousBatchingScheduler``
-    on every call (construction and restarts both use it)."""
+    """Front-end over N supervised scheduler replicas. ``factory`` is one
+    callable (every replica built identically) or a sequence of callables,
+    one per replica (``DeviceGroupPlan.replica_factories``: each closes
+    over its own device group). Either way a factory must build a fresh,
+    functionally identical ``ContinuousBatchingScheduler`` on every call —
+    construction and supervisor restarts both use it, and replica i always
+    restarts through factory i."""
 
     # the router is driven by one loop but submitted to from any thread,
     # while the supervisor's probes and the observability scrape read —
@@ -105,7 +114,7 @@ class ServingRouter:
     _failovers: guarded_by("_lock")
     _failed_over: guarded_by("_lock")
 
-    def __init__(self, factory: Callable[[], object], num_replicas: int = 2,
+    def __init__(self, factory, num_replicas: int = 2,
                  *, policy: str = "affinity",
                  affinity_tokens: Optional[int] = None,
                  cooldown_s: float = 1.0,
@@ -116,15 +125,40 @@ class ServingRouter:
                  warmup_source=None,
                  probe_every: int = 1,
                  journey_tracing: bool = True,
-                 timeline_interval_s: float = 0.0):
+                 timeline_interval_s: float = 0.0,
+                 device_ownership: str = "warn"):
         if policy not in POLICIES:
             raise ValueError(f"unknown policy {policy!r} "
                              f"(known: {', '.join(POLICIES)})")
-        if num_replicas < 1:
-            raise ValueError("num_replicas must be >= 1")
+        if device_ownership not in ("off", "warn", "error"):
+            raise ValueError(f"device_ownership must be off|warn|error, "
+                             f"got {device_ownership!r}")
+        # ``factory`` is either one callable (every replica built the same
+        # way — the pre-sharding behavior) or a sequence with one factory
+        # per replica (DeviceGroupPlan.replica_factories: replica i's
+        # factory closes over device group i, so supervisor restarts
+        # deterministically rebuild it on the SAME chips)
+        if callable(factory):
+            if num_replicas < 1:
+                raise ValueError("num_replicas must be >= 1")
+            factories = [factory] * int(num_replicas)
+        else:
+            factories = list(factory)
+            if not factories or not all(callable(f) for f in factories):
+                raise ValueError("factory must be a callable or a "
+                                 "non-empty sequence of callables")
+            # num_replicas is derived from the sequence; an explicit
+            # non-default value must agree (2 is the signature default and
+            # can't be told apart from "unset")
+            if num_replicas not in (2, len(factories)):
+                raise ValueError(
+                    f"num_replicas ({num_replicas}) != number of "
+                    f"factories ({len(factories)})")
+            num_replicas = len(factories)
         self.policy = policy
-        self.replicas = [ServingReplica(i, factory)
-                         for i in range(int(num_replicas))]
+        self.replicas = [ServingReplica(i, f)
+                         for i, f in enumerate(factories)]
+        self._check_device_ownership(device_ownership)
         # one "serving"-namespaced registry at the router level: the
         # router-site fault counters land in serving_faults_total and the
         # per-replica gauges ride the same scrape
@@ -201,6 +235,48 @@ class ServingRouter:
             self._bind_flight_alarm(rep)
         if timeline_interval_s > 0:
             self.timeline.start(timeline_interval_s)
+
+    def _check_device_ownership(self, mode: str) -> None:
+        """Validate that replicas own disjoint device sets (the silent
+        failure the r15 bench measured: N colocated replicas on ONE chip
+        ran SLOWER than one replica, 133→40 tok/s). ``warn`` (default)
+        warns once per process; ``error`` raises; ``off`` skips. Reads
+        each scheduler's committed shardings via ``device_set()`` —
+        duck-typed schedulers without it are skipped."""
+        if mode == "off":
+            return
+        owned: Dict[int, frozenset] = {}
+        for rep in self.replicas:
+            getter = getattr(rep.sched, "device_set", None)
+            if getter is None:
+                continue
+            try:
+                owned[rep.replica_id] = frozenset(getter())
+            except (AttributeError, TypeError):
+                continue  # duck-typed scheduler; ownership not knowable
+        overlaps = []
+        ids = sorted(owned)
+        for i, a in enumerate(ids):
+            for b in ids[i + 1:]:
+                shared = owned[a] & owned[b]
+                if shared:
+                    overlaps.append(
+                        (a, b, sorted(str(d) for d in shared)))
+        if not overlaps:
+            return
+        msg = ("ServingRouter replicas share devices — they will contend "
+               "for the same chips instead of scaling (use "
+               "serving.sharded.DeviceGroupPlan for disjoint groups): "
+               + "; ".join(f"replica {a} & {b} on {devs}"
+                           for a, b, devs in overlaps))
+        if mode == "error":
+            raise ValueError(msg)
+        global _OWNERSHIP_WARNED
+        if not _OWNERSHIP_WARNED:
+            _OWNERSHIP_WARNED = True
+            import warnings
+
+            warnings.warn(msg, RuntimeWarning, stacklevel=3)
 
     def _bind_flight_alarm(self, rep: ServingReplica) -> None:
         """Point a replica scheduler's flight-recorder alarms at the

@@ -168,7 +168,8 @@ class ContinuousBatchingScheduler:
     _drain_exc: guarded_by("_elock")
 
     def __init__(self, model, config: Optional[SchedulerConfig] = None,
-                 metrics: Optional[ServingMetrics] = None):
+                 metrics: Optional[ServingMetrics] = None,
+                 sharding=None):
         self.config = cfg = config or SchedulerConfig()
         mcfg = model.config
         self.model = model
@@ -193,8 +194,20 @@ class ContinuousBatchingScheduler:
         import jax
 
         self._donate = jax.default_backend() != "cpu"
-        self._step_fn = SlotStep(model, temperature=cfg.temperature,
-                                 top_k=cfg.top_k, donate=self._donate)
+        # ``sharding`` (duck-typed: serving.sharded.TensorParallelSharding
+        # or anything with prepare_model/make_step/shard_pools/describe) —
+        # one replica spans a device mesh. Weights are committed to the
+        # mesh BEFORE the step is built so the jit entry collects sharded
+        # param values from the first call; written once here, read-only
+        # for the scheduler's lifetime.
+        self.sharding = sharding
+        if sharding is not None:
+            sharding.prepare_model(model)
+            self._step_fn = sharding.make_step(model, cfg,
+                                               donate=self._donate)
+        else:
+            self._step_fn = SlotStep(model, temperature=cfg.temperature,
+                                     top_k=cfg.top_k, donate=self._donate)
         if cfg.enable_prefix_caching:
             # sharing-aware pool + radix tree: admissions match cached
             # prefixes and prefill only the uncached suffix
@@ -222,6 +235,11 @@ class ContinuousBatchingScheduler:
                            self.num_kv_heads, self.head_dim],
                           dtype=cfg.cache_dtype))
             for _ in range(self.num_layers)]
+        if sharding is not None:
+            # head-shard the K/V pools over the replica's mesh (~1/tp of
+            # the KV bytes per chip); block tables and positions stay tiny
+            # replicated host uploads
+            self._pools = sharding.shard_pools(self._pools)
         self.queue = RequestQueue(cfg.max_queue_size)
         self._next_rid = 0
         self._finished: Dict[int, RequestOutput] = {}
@@ -291,8 +309,11 @@ class ContinuousBatchingScheduler:
         if cfg.enable_device_observability:
             self.device_ledger = DeviceMemoryLedger(
                 registry=self.metrics.registry)
-            self.device_ledger.register(
-                "kv_pool", "paged_kv_pools", pool_bytes)
+            # register_arrays (not plain register): reads the pools' real
+            # shardings so a sharded replica's per-chip census shows the
+            # ~1/tp KV split
+            self.device_ledger.register_arrays(
+                "kv_pool", "paged_kv_pools", self._pools)
             self.device_ledger.register_arrays(
                 "model_weights", "serving_model",
                 [p for p in model.parameters()])
@@ -1780,6 +1801,27 @@ class ContinuousBatchingScheduler:
         }
 
     # ---- device-side observability ------------------------------------
+
+    def device_set(self) -> frozenset:
+        """The devices this replica's state actually lives on — read off
+        the KV pools' (and weights') committed shardings, so it is ground
+        truth whether the scheduler is sharded or not (unsharded arrays
+        report their single device). Used by ``ServingRouter`` to validate
+        that replicas own disjoint chips."""
+        devs: set = set()
+        for kp, vp in self._pools:
+            for t in (kp, vp):
+                try:
+                    devs.update(t._value.sharding.device_set)
+                except AttributeError:
+                    pass  # non-committed value (e.g. a stubbed pool)
+        for p in self.model.parameters():
+            try:
+                devs.update(p._value.sharding.device_set)
+                break  # all params live on one mesh; first is enough
+            except AttributeError:
+                pass  # uncommitted host value; keep looking
+        return frozenset(devs)
 
     def device_observability(self, analyze: bool = True) -> Dict[str, object]:
         """Roofline-attributed device snapshot: sampled decode step time ×

@@ -1,0 +1,40 @@
+"""paddle_tpu.serving.sharded — one serving replica across a device mesh.
+
+Tensor-parallel serving (Megatron-style TP as deployed in vLLM's
+multi-GPU serving path, re-grounded in GSPMD): the one compiled decode
+step lowers under a ``Mesh(("tp",))``, attention heads and the paged KV
+pool shard over the chips, and ``DeviceGroupPlan`` carves the visible
+devices into disjoint per-replica groups so router replicas stop
+contending for one chip (the r15 colocated-contention fix).
+
+    plan = DeviceGroupPlan(tp=2, replicas=2)
+    router = ServingRouter(
+        plan.replica_factories(lambda sh: make_sched(sharding=sh)),
+        num_replicas=2)
+
+or a single sharded replica::
+
+    sched = ContinuousBatchingScheduler(
+        model, cfg, sharding=TensorParallelSharding(tp=4))
+
+Default ``plan="exact"`` keeps tokens bit-identical to the
+single-device oracle (no cross-device sum reassociation);
+``plan="megatron"`` is the textbook row-parallel layout
+(float-tolerance only). See ``step.py`` for the full contract.
+"""
+
+from paddle_tpu.serving.sharded.mesh import DeviceGroupPlan  # noqa: F401
+from paddle_tpu.serving.sharded.step import (  # noqa: F401
+    ShardedSlotStep,
+    TensorParallelSharding,
+    plan_param_specs,
+    shard_model_params,
+)
+
+__all__ = [
+    "DeviceGroupPlan",
+    "ShardedSlotStep",
+    "TensorParallelSharding",
+    "plan_param_specs",
+    "shard_model_params",
+]

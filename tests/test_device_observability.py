@@ -451,7 +451,11 @@ def test_bench_compare_classify_directions():
     assert bc.classify("ttft_p50_s") == "lower"
     assert bc.classify("decode_device_step_seconds") == "lower"
     assert bc.classify("config.num_requests") is None
-    assert bc.classify("kv_bytes_per_token") is None
+    # sharded-serving classes: KV footprint per token and the largest
+    # per-chip share of the pool's bytes both regress by growing
+    assert bc.classify("kv_bytes_per_token") == "lower"
+    assert bc.classify("sharded.kv_split.max_fraction") == "lower"
+    assert bc.classify("sharded.kv_split.expected_fraction") is None
 
 
 def test_bench_compare_regressions_both_directions():

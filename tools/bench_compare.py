@@ -63,6 +63,10 @@ _LOWER_MARKERS = (
     "stall", "overhead", "ttft", "tpot", "latency", "wall_s", "wall_ms",
     "_seconds", "_ms", "snapshot_s", "save_s", "restore_s", "evicted",
     "preemptions", "recompiles", "breach", "fault",
+    # sharded serving: the largest per-chip share of the KV pool's bytes
+    # can only sit at or above 1/tp — growth is shard imbalance
+    "max_fraction",
+    "kv_bytes_per_token",
 )
 _HIGHER_MARKERS = (
     "tokens_per_s", "steps_per_s", "images_per_s", "per_s", "speedup",

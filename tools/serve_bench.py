@@ -1489,6 +1489,216 @@ def run_observability_suite(smoke: bool = True, out_dir: str = REPO_ROOT,
     return artifact
 
 
+def _respawn_sharded(args, tp: int, replicas: int, out_path: str) -> dict:
+    """Parent half of the sharded mode: re-exec this script in a clean
+    subprocess whose XLA_FLAGS force an emulated mesh of tp*replicas CPU
+    devices (min 2 so tp=1 still runs on a real multi-device world). The
+    child prints the one-line metric JSON and writes the artifact; we
+    stream its output through and re-load the artifact."""
+    import subprocess
+
+    world = max(2, tp * replicas)
+    env = dict(os.environ)
+    env["SERVE_BENCH_SHARDED_CHILD"] = "1"
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    # deterministic single-thread eigen like the async sweep: the sharded
+    # suite compares token streams against the single-device oracle
+    env.setdefault("JAX_DEFAULT_MATMUL_PRECISION", "highest")
+    flags = [f for f in env.get("XLA_FLAGS", "").split()
+             if "host_platform_device_count" not in f]
+    flags.append(f"--xla_force_host_platform_device_count={world}")
+    env["XLA_FLAGS"] = " ".join(flags)
+    env["PYTHONPATH"] = (REPO_ROOT + os.pathsep + env["PYTHONPATH"]
+                         if env.get("PYTHONPATH") else REPO_ROOT)
+    argv = [sys.executable, os.path.abspath(__file__),
+            "--tp", str(tp), "--replicas", str(replicas),
+            "--seed", str(args.seed), "--out", out_path]
+    if args.smoke:
+        argv.append("--smoke")
+    proc = subprocess.run(argv, env=env)
+    if proc.returncode != 0:
+        raise RuntimeError(
+            f"sharded bench subprocess exited {proc.returncode} "
+            f"(its partial artifact, if any, is at {out_path})")
+    with open(out_path) as f:
+        return json.load(f)
+
+
+def run_sharded_suite(tp: int = 2, replicas: int = 1, smoke: bool = True,
+                      seed: int = 0, out_dir: str = REPO_ROOT,
+                      out_path=None) -> dict:
+    """Sharded serving measurement on the (emulated) multi-device world.
+
+    Three conditions, all on identically-seeded models:
+
+    1. **oracle** — one unsharded single-device replica (the reference
+       token streams and the throughput baseline);
+    2. **sharded** — one replica over a tp-device mesh: token identity
+       vs the oracle, per-chip memory census (the KV split must be
+       ~1/tp per chip), decode bandwidth-util attribution;
+    3. **fleet** (replicas > 1) — a DeviceGroupPlan router fleet on
+       DISJOINT device groups: aggregate throughput + per-replica
+       device sets (the r15 colocated-contention fix, structurally
+       verified).
+
+    Emulated-mesh caveat recorded in the artifact: forced CPU "devices"
+    share the same host cores, so cross-condition tokens/s on CPU
+    measures dispatch overhead, not chip scaling — the structural
+    claims (identity, split, disjointness) are the gated ones.
+    """
+    import numpy as np
+
+    import jax
+
+    import paddle_tpu as paddle
+    from paddle_tpu.models import GPTForCausalLM, gpt_tiny
+    from paddle_tpu.observability.device_memory import (
+        tree_device_nbytes, tree_nbytes)
+    from paddle_tpu.serving import (ContinuousBatchingScheduler,
+                                    SchedulerConfig, ServingRouter)
+    from paddle_tpu.serving.sharded import DeviceGroupPlan
+
+    devices = jax.devices()
+    need = max(2, tp * replicas)
+    assert len(devices) >= need, (
+        f"sharded suite needs {need} devices, found {len(devices)} "
+        f"(run through serve_bench --tp, which forces the emulated mesh)")
+
+    num_requests = 8 if smoke else 24
+    max_new = 6 if smoke else 12
+    rng = np.random.default_rng(seed)
+    prompts = [rng.integers(0, 1000, int(n))
+               for n in rng.integers(4, 14, num_requests)]
+
+    def build(sharding=None):
+        paddle.seed(7)
+        model = GPTForCausalLM(gpt_tiny(num_layers=2))
+        return _track(ContinuousBatchingScheduler(
+            model, SchedulerConfig(max_num_seqs=4, max_seq_len=64,
+                                   block_size=8),
+            sharding=sharding))
+
+    def timed_run(sched):
+        t0 = time.perf_counter()
+        outs = sched.generate(prompts, max_new_tokens=max_new)
+        wall = time.perf_counter() - t0
+        toks = sum(len(o) - len(p) for o, p in zip(outs, prompts))
+        return outs, wall, toks
+
+    # ---- 1. single-device oracle --------------------------------------
+    oracle = build()
+    ref_outs, oracle_wall, oracle_toks = timed_run(oracle)
+    oracle.shutdown()
+
+    # ---- 2. one sharded replica ---------------------------------------
+    plan = DeviceGroupPlan(tp=tp, replicas=max(1, replicas))
+    sched = build(plan.sharding(0))
+    outs, wall, toks = timed_run(sched)
+    identical = all(np.array_equal(a, b) for a, b in zip(ref_outs, outs))
+    census = sched.device_ledger.census_report()
+    kv_dev = census["owners"]["kv_pool"].get("devices", {})
+    kv_total = tree_nbytes(sched._pools)
+    fracs = {d: b / kv_total for d, b in kv_dev.items()} if kv_total else {}
+    weights_dev = tree_device_nbytes(
+        [p for p in sched.model.parameters()])
+    dev_fields = _device_observability_fields(sched, wall)
+    sharded = {
+        "tp": tp,
+        "devices": [str(d) for d in sched.device_set()],
+        "tokens_per_s": toks / wall if wall > 0 else None,
+        "wall_s": wall,
+        "token_identical_to_oracle": identical,
+        "per_chip_memory_bytes": census["per_device"],
+        "kv_split": {
+            "per_chip_bytes": kv_dev,
+            "total_bytes": kv_total,
+            "expected_fraction": 1.0 / tp,
+            "max_fraction": max(fracs.values()) if fracs else None,
+            "chips": len(kv_dev),
+        },
+        "weights_per_chip_bytes": weights_dev,
+        "device_observability": dev_fields,
+    }
+    sched.shutdown()
+
+    # ---- 3. disjoint fleet (replicas > 1) -----------------------------
+    fleet = None
+    if replicas > 1:
+        def make_replica(sh):
+            paddle.seed(7)
+            model = GPTForCausalLM(gpt_tiny(num_layers=2))
+            return _track(ContinuousBatchingScheduler(
+                model, SchedulerConfig(max_num_seqs=4, max_seq_len=64,
+                                       block_size=8),
+                sharding=sh))
+
+        router = _track_router(ServingRouter(
+            plan.replica_factories(make_replica),
+            cooldown_s=0.05, device_ownership="error"))
+        sets = [sorted(str(d) for d in rep.sched.device_set())
+                for rep in router.replicas]
+        flat = [d for s in sets for d in s]
+        t0 = time.perf_counter()
+        rids = [router.submit(p, max_new_tokens=max_new) for p in prompts]
+        done = {}
+        guard = 100000
+        while len(done) < len(rids) and guard:
+            for o in router.step():
+                done[o.request_id] = o
+            guard -= 1
+        fleet_wall = time.perf_counter() - t0
+        assert guard, "fleet drain stalled"
+        fleet_tokens = sum(len(done[r].token_ids) - len(p)
+                           for r, p in zip(rids, prompts))
+        fleet_identical = all(
+            np.array_equal(done[r].token_ids, ref)
+            for r, ref in zip(rids, ref_outs))
+        fleet = {
+            "replicas": replicas,
+            "replica_device_sets": sets,
+            "disjoint_replica_device_sets": len(set(flat)) == len(flat),
+            "tokens_per_s": fleet_tokens / fleet_wall
+            if fleet_wall > 0 else None,
+            "wall_s": fleet_wall,
+            "token_identical_to_oracle": fleet_identical,
+            "group_plan": plan.describe(),
+        }
+        router.shutdown()
+
+    within = (identical
+              and sharded["kv_split"]["chips"] == tp
+              and (fleet is None or
+                   (fleet["disjoint_replica_device_sets"]
+                    and fleet["token_identical_to_oracle"])))
+    artifact = {
+        "bench": "serving_sharded",
+        "config": {
+            "tp": tp, "replicas": replicas, "smoke": smoke, "seed": seed,
+            "num_requests": num_requests, "max_new_tokens": max_new,
+            "plan": "exact",
+            "world_devices": [str(d) for d in devices],
+            "emulated_cpu_mesh": jax.default_backend() == "cpu",
+            "throughput_caveat":
+                "emulated CPU devices share host cores; tokens/s here "
+                "measures dispatch overhead, not chip scaling",
+        },
+        "oracle": {
+            "tokens_per_s": oracle_toks / oracle_wall
+            if oracle_wall > 0 else None,
+            "wall_s": oracle_wall,
+        },
+        "sharded": sharded,
+        "fleet": fleet,
+        "within_budget": within,
+        "completed": True,
+    }
+    path = out_path or os.path.join(out_dir, "BENCH_serving_sharded.json")
+    write_bench_json(path, artifact)
+    artifact["artifact"] = path
+    return artifact
+
+
 def main(argv=None) -> dict:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--smoke", action="store_true",
@@ -1524,6 +1734,16 @@ def main(argv=None) -> dict:
                          "given no values): per-depth wall/TPOT/host-stall "
                          "share + cross-depth token identity -> "
                          "BENCH_serving_async.json")
+    ap.add_argument("--tp", type=int, default=None,
+                    help="sharded serving suite: one replica spans a "
+                         "tp-device mesh (tensor-parallel attention/MLP + "
+                         "head-sharded KV pool); with --replicas R, a "
+                         "DeviceGroupPlan fleet of R disjoint tp-device "
+                         "groups behind the router. Respawns itself in a "
+                         "fresh subprocess with "
+                         "--xla_force_host_platform_device_count so the "
+                         "emulated mesh exists before jax initializes -> "
+                         "BENCH_serving_sharded.json")
     ap.add_argument("--replicas", type=int, default=None,
                     help="multi-replica router suite over N scheduler "
                          "replicas: tokens/s scaling vs 1 replica, "
@@ -1551,7 +1771,10 @@ def main(argv=None) -> dict:
     os.environ.pop("PALLAS_AXON_POOL_IPS", None)
 
     chaos = args.chaos or args.fault_rate > 0 or args.cancel_rate > 0
-    mode = ("router" if args.replicas is not None else
+    # --tp wins over --replicas: "--tp 2 --replicas 2" is the sharded
+    # FLEET (disjoint 2-device groups), not the colocated router suite
+    mode = ("sharded" if args.tp is not None else
+            "router" if args.replicas is not None else
             "async" if args.depth is not None else
             "chaos" if chaos else "obs" if args.observability else
             "prefix" if args.prefix_share else
@@ -1585,6 +1808,34 @@ def main(argv=None) -> dict:
 
 
 def _run_mode(args, mode: str, out_path: str) -> dict:
+    if mode == "sharded":
+        tp = max(1, int(args.tp))
+        replicas = max(1, int(args.replicas or 1))
+        if os.environ.get("SERVE_BENCH_SHARDED_CHILD") != "1":
+            # the emulated mesh must exist BEFORE jax initializes, and this
+            # process (or a caller embedding us) may already have a live
+            # backend — respawn into a fresh interpreter with the forced
+            # host device count (the auto_tuner trial-subprocess pattern)
+            return _respawn_sharded(args, tp, replicas, out_path)
+        artifact = run_sharded_suite(
+            tp=tp, replicas=replicas, smoke=args.smoke, seed=args.seed,
+            out_dir=os.path.dirname(out_path) or ".", out_path=out_path)
+        print(json.dumps({
+            "metric": "serving_sharded_tokens_per_s",
+            "value": artifact["sharded"]["tokens_per_s"],
+            "unit": f"tokens/s, one replica over a tp={tp} emulated mesh",
+            "token_identical_to_oracle":
+                artifact["sharded"]["token_identical_to_oracle"],
+            "kv_split_max_fraction":
+                artifact["sharded"]["kv_split"]["max_fraction"],
+            "disjoint_replica_device_sets":
+                (artifact.get("fleet") or {}).get(
+                    "disjoint_replica_device_sets"),
+            "within_budget": artifact["within_budget"],
+            "artifact": artifact["artifact"],
+        }))
+        return artifact
+
     if mode == "router":
         artifact = run_router_suite(
             smoke=args.smoke,
