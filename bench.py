@@ -882,6 +882,50 @@ def bench_serving_fleet_trace(on_tpu):
     }))
 
 
+def bench_serving_stepprofile(on_tpu):
+    """In-step profiling (tools/serve_bench.run_stepprofile_suite): an
+    on-demand device-trace capture over live scheduler steps, attributing
+    decode-step device time to the named regions inside the ONE compiled
+    program. Asserts attribution coverage >= 0.9 of measured step device
+    time with kv_gather/attention/mlp/sampling all present, the capture
+    compiled zero new programs, and the zero-sync telemetry invariants
+    (tokens bit-identical + equal program counts with telemetry on vs
+    off at dispatch_depth 0 and 2). CPU-sized; the artifact is
+    BENCH_serving_stepprofile.json."""
+    import sys
+
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from tools.serve_bench import run_stepprofile_suite
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    art = run_stepprofile_suite(steps=6, smoke=True, out_dir=here)
+    assert art["capture_enabled"], art.get("capture_error")
+    assert art["region_coverage"] >= 0.9, (
+        "named regions cover only %.3f of measured decode device time"
+        % art["region_coverage"])
+    for r in ("kv_gather", "attention", "mlp", "sampling"):
+        assert art["region_share_%s" % r] > 0, (
+            "region %r missing from the decode attribution: %s"
+            % (r, art["region_shares"]))
+    assert not art["capture_compiled_programs"], (
+        "capture_step_profile grew the compiled-program count")
+    inv = art["telemetry_invariants"]
+    assert all(v["token_identical"] and v["programs_equal"]
+               for v in inv.values()), inv
+    assert art["within_budget"], art
+    print(json.dumps({
+        "metric": "serving_stepprofile_coverage",
+        "value": art["region_coverage"],
+        "unit": "fraction of decode-step device time attributed to "
+                "named regions",
+        "region_share_kv_gather": art["region_share_kv_gather"],
+        "region_share_attention": art["region_share_attention"],
+        "region_share_mlp": art["region_share_mlp"],
+        "region_share_sampling": art["region_share_sampling"],
+        "within_budget": art["within_budget"],
+    }))
+
+
 def bench_serving_sharded(on_tpu):
     """Sharded multi-chip serving (tools/serve_bench sharded mode): one
     replica's compiled decode program lowered over a tp=2 device mesh
@@ -1180,6 +1224,7 @@ for _f in (bench_chip_ceilings, bench_resnet50, bench_bert, bench_ernie,
            bench_serving_async,
            bench_serving_router,
            bench_serving_fleet_trace,
+           bench_serving_stepprofile,
            bench_serving_sharded,
            bench_ckpt,
            bench_train,

@@ -34,6 +34,7 @@ from paddle_tpu.observability.compile_tracker import (
     next_tracked_name,
 )
 from paddle_tpu.observability.program_inventory import get_program_inventory
+from paddle_tpu.observability.step_profile import region
 from paddle_tpu.tensor import Tensor
 
 
@@ -567,74 +568,78 @@ class TrainStep:
             for p in params:
                 p._grad = None
                 p.stop_gradient = False
-            res = self._loss_fn(self._model, *args)
-            loss, aux = res if self._has_aux else (res, None)
-            aux_vals = tree_unwrap(aux)
-            if scale is not None:
-                (loss * scale[0].astype(loss.dtype)).backward()
-            else:
-                loss.backward()
-            grads = [p._grad for p in params]
+            with region("forward"):
+                res = self._loss_fn(self._model, *args)
+                loss, aux = res if self._has_aux else (res, None)
+                aux_vals = tree_unwrap(aux)
+            with region("backward"):
+                if scale is not None:
+                    (loss * scale[0].astype(loss.dtype)).backward()
+                else:
+                    loss.backward()
+                grads = [p._grad for p in params]
             # don't let grad tracers outlive the trace: a later eager
             # backward/step would consume leaked tracers
             for p in params:
                 p._grad = None
             new_buffer_vals = [b._value for b in buffers]
             loss_val = loss._value
-        found_inf = None
-        new_scaler_state = None
-        if scale is not None:
-            scale_v, good, bad = scale
-            # unscale + joint finiteness check (scaler.unscale_ semantics)
-            inv = (1.0 / scale_v).astype(jnp.float32)
-            grads = [None if g is None else g.astype(jnp.float32) * inv
-                     for g in grads]
-            finite = jnp.asarray(True)
-            for g in grads:
-                if g is not None:
-                    finite = jnp.logical_and(finite,
-                                             jnp.all(jnp.isfinite(g)))
-            found_inf = jnp.logical_not(finite)
-            # dynamic scale update, in-graph (GradScaler.update semantics)
-            s = self._scaler
-            bad2 = jnp.where(found_inf, bad + 1, 0)
-            good2 = jnp.where(found_inf, 0, good + 1)
-            dec = bad2 >= s._decr_every_n
-            inc = good2 >= s._incr_every_n_steps
-            scale2 = jnp.where(
-                dec, jnp.maximum(scale_v * s._decr_ratio, 1.0),
-                jnp.where(inc, scale_v * s._incr_ratio, scale_v))
-            new_scaler_state = (scale2,
-                                jnp.where(inc, 0, good2).astype(jnp.int32),
-                                jnp.where(dec, 0, bad2).astype(jnp.int32))
-        # grad clip (pure, works on tracers)
-        if self._opt._grad_clip is not None:
-            grads = self._opt._grad_clip._clip_arrays(grads)
-        new_params, new_states, new_masters = [], [], []
-        for p, pv, g, st, mv in zip(params, param_vals, grads, opt_states,
-                                    master_vals):
-            if g is None:
-                new_params.append(pv)
-                new_states.append(st)
-                new_masters.append(mv)
-                continue
-            target = mv if mv is not None else pv
-            np_, ns = self._opt._apply_one(
-                target, g.astype(target.dtype), lr, st,
-                self._opt._decay_for(p)
-            )
-            if found_inf is not None:
-                # skip the whole update on non-finite grads (scaler.step)
-                np_ = jnp.where(found_inf, target, np_)
-                ns = jax.tree_util.tree_map(
-                    lambda new, old: jnp.where(found_inf, old, new), ns, st)
-            if mv is not None:  # update fp32 master, cast back to param dtype
-                new_masters.append(np_)
-                new_params.append(np_.astype(pv.dtype))
-            else:
-                new_masters.append(None)
-                new_params.append(np_)
-            new_states.append(ns)
+        with region("optimizer"):
+            found_inf = None
+            new_scaler_state = None
+            if scale is not None:
+                scale_v, good, bad = scale
+                # unscale + joint finiteness check (scaler.unscale_ semantics)
+                inv = (1.0 / scale_v).astype(jnp.float32)
+                grads = [None if g is None else g.astype(jnp.float32) * inv
+                         for g in grads]
+                finite = jnp.asarray(True)
+                for g in grads:
+                    if g is not None:
+                        finite = jnp.logical_and(finite,
+                                                 jnp.all(jnp.isfinite(g)))
+                found_inf = jnp.logical_not(finite)
+                # dynamic scale update, in-graph (GradScaler.update semantics)
+                s = self._scaler
+                bad2 = jnp.where(found_inf, bad + 1, 0)
+                good2 = jnp.where(found_inf, 0, good + 1)
+                dec = bad2 >= s._decr_every_n
+                inc = good2 >= s._incr_every_n_steps
+                scale2 = jnp.where(
+                    dec, jnp.maximum(scale_v * s._decr_ratio, 1.0),
+                    jnp.where(inc, scale_v * s._incr_ratio, scale_v))
+                new_scaler_state = (scale2,
+                                    jnp.where(inc, 0, good2).astype(jnp.int32),
+                                    jnp.where(dec, 0, bad2).astype(jnp.int32))
+            # grad clip (pure, works on tracers)
+            if self._opt._grad_clip is not None:
+                grads = self._opt._grad_clip._clip_arrays(grads)
+            new_params, new_states, new_masters = [], [], []
+            for p, pv, g, st, mv in zip(params, param_vals, grads, opt_states,
+                                        master_vals):
+                if g is None:
+                    new_params.append(pv)
+                    new_states.append(st)
+                    new_masters.append(mv)
+                    continue
+                target = mv if mv is not None else pv
+                np_, ns = self._opt._apply_one(
+                    target, g.astype(target.dtype), lr, st,
+                    self._opt._decay_for(p)
+                )
+                if found_inf is not None:
+                    # skip the whole update on non-finite grads (scaler.step)
+                    np_ = jnp.where(found_inf, target, np_)
+                    ns = jax.tree_util.tree_map(
+                        lambda new, old: jnp.where(found_inf, old, new),
+                        ns, st)
+                if mv is not None:  # update fp32 master, cast to param dtype
+                    new_masters.append(np_)
+                    new_params.append(np_.astype(pv.dtype))
+                else:
+                    new_masters.append(None)
+                    new_params.append(np_)
+                new_states.append(ns)
         return (loss_val, new_params, new_states, new_masters,
                 new_buffer_vals, new_scaler_state, aux_vals)
 

@@ -33,6 +33,7 @@ from paddle_tpu.distributed.fleet.mp_layers import (
 )
 from paddle_tpu.models import kv_cache
 from paddle_tpu.nn import initializer as I
+from paddle_tpu.observability.step_profile import region
 from paddle_tpu.nn.param_attr import ParamAttr
 from paddle_tpu.ops.pallas.flash_attention import scaled_dot_product_attention
 
@@ -215,13 +216,18 @@ class GPTDecoderLayer(nn.Layer):
         self._cfg = cfg
 
     def forward(self, x, cache=None):
-        a = self.attn(self.ln_1(x), cache)
-        new_cache = None
-        if cache is not None:
-            a, new_cache = a
-        x = x + self.dropout(a)
-        x = x + self.dropout(self.mlp(self.ln_2(x)))
-        x = _seq_constrain(x, self._cfg)
+        # step_profile regions: ln/residual ride their sublayer's region
+        # so the in-step attribution covers (nearly) every op the layer
+        # emits — kv_gather nests inside attention and wins the leaf share
+        with region("attention"):
+            a = self.attn(self.ln_1(x), cache)
+            new_cache = None
+            if cache is not None:
+                a, new_cache = a
+            x = x + self.dropout(a)
+        with region("mlp"):
+            x = x + self.dropout(self.mlp(self.ln_2(x)))
+            x = _seq_constrain(x, self._cfg)
         return (x, new_cache) if cache is not None else x
 
 
@@ -234,7 +240,8 @@ class GPTModel(nn.Layer):
         self.ln_f = nn.LayerNorm(cfg.hidden_size, epsilon=cfg.layer_norm_eps)
 
     def forward(self, input_ids, position_ids=None, caches=None):
-        h = self.embeddings(input_ids, position_ids)
+        with region("embed"):
+            h = self.embeddings(input_ids, position_ids)
         new_caches = [] if caches is not None else None
         remat = self.config.recompute if (self.config.recompute
                                           and self.training
@@ -252,7 +259,8 @@ class GPTModel(nn.Layer):
                               policy=None if remat == "full" else remat)
             else:
                 h = blk(h)
-        h = self.ln_f(h)
+        with region("logits"):
+            h = self.ln_f(h)
         return (h, new_caches) if caches is not None else h
 
 
@@ -274,11 +282,12 @@ class GPTForCausalLM(nn.Layer):
             h, new_caches = self.gpt(input_ids, position_ids, caches)
         else:
             h = self.gpt(input_ids, position_ids)
-        if self.config.tie_word_embeddings:
-            w = self.gpt.embeddings.word_embeddings.weight  # [V, H] mp-sharded on V
-            logits = paddle.matmul(h, w, transpose_y=True)
-        else:
-            logits = self.lm_head(h)
+        with region("logits"):
+            if self.config.tie_word_embeddings:
+                w = self.gpt.embeddings.word_embeddings.weight  # [V, H] mp-sharded on V
+                logits = paddle.matmul(h, w, transpose_y=True)
+            else:
+                logits = self.lm_head(h)
         if caches is not None:
             return logits, new_caches
         return logits

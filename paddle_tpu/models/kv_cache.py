@@ -26,6 +26,7 @@ import jax.numpy as jnp
 
 from paddle_tpu.core.dispatch import apply
 from paddle_tpu.observability.annotations import guarded_by, holds_lock
+from paddle_tpu.observability.step_profile import region
 from paddle_tpu.tensor import Tensor
 
 # k, v: [B, max_len, KVH, D]; pos: [B] int32 — number of tokens already cached
@@ -72,8 +73,9 @@ def _static_cache_raw(qv, kv, vv, ck, cv, pos):
                 cb, nb.astype(cb.dtype), (p, 0, 0))
         return jax.vmap(w1)(c, new, pos)
 
-    ck2 = write(ck, kv)
-    cv2 = write(cv, vv)
+    with region("kv_gather"):
+        ck2 = write(ck, kv)
+        cv2 = write(cv, vv)
     out = _masked_attention(qv, _repeat_kv(ck2, n_heads),
                             _repeat_kv(cv2, n_heads), pos)
     return out, ck2, cv2, pos + qv.shape[1]
@@ -119,17 +121,17 @@ def _paged_cache_raw(qv, kv, vv, k_pool, v_pool, block_table, pos):
             mode="drop",
         ).reshape(pool.shape)
 
-    k_pool2 = write(k_pool, kv)
-    v_pool2 = write(v_pool, vv)
-
     # gather this sequence's pages into a contiguous [B, L, KVH, D] view
     def gather(pool):
         safe = jnp.maximum(block_table, 0)                       # [B, MB]
         pages = pool[safe]                                       # [B, MB, bs, H, D]
         return pages.reshape(B, L, *pool.shape[2:])
 
-    keys = gather(k_pool2)
-    values = gather(v_pool2)
+    with region("kv_gather"):
+        k_pool2 = write(k_pool, kv)
+        v_pool2 = write(v_pool, vv)
+        keys = gather(k_pool2)
+        values = gather(v_pool2)
     out = _masked_attention(qv, _repeat_kv(keys, n_heads),
                             _repeat_kv(values, n_heads), pos)
     return out, k_pool2, v_pool2, pos + s

@@ -33,6 +33,7 @@ from paddle_tpu.models.kv_cache import (
     StaticCacheSlot,
     make_static_cache,
 )
+from paddle_tpu.observability.step_profile import region
 from paddle_tpu.tensor import Tensor
 
 
@@ -59,6 +60,34 @@ def splice_carry(carry, values, mask):
     one-compiled-decode-program invariant is untouched at every
     ``dispatch_depth``."""
     return paddle.where(mask, values, carry)
+
+
+def _telemetry_stats(lv, gi, pos, blk, paged: bool):
+    """On-device step-telemetry block: f32[4] =
+    [active-slot count, mean sampled-token entropy (nats),
+     mean sampled-token max-prob, kv blocks touched].
+
+    Pure function of tensors the compiled step already produces (logits,
+    gather index, post-step cache positions, block table), so fusing it
+    into the step adds no new program and no host sync — the stats array
+    rides the existing drain fetch. Never feeds back into sampling, which
+    keeps tokens bit-identical with telemetry on or off."""
+    last = jnp.take_along_axis(
+        lv, gi[:, None, None].astype(jnp.int32), axis=1)[:, 0, :]  # [B, V]
+    logp = jax.nn.log_softmax(last.astype(jnp.float32), axis=-1)
+    p = jnp.exp(logp)
+    ent = -(p * logp).sum(axis=-1)                                 # [B]
+    pmax = p.max(axis=-1)                                          # [B]
+    active = (pos > 0)
+    n = jnp.maximum(active.sum(), 1).astype(jnp.float32)
+    occ = active.sum().astype(jnp.float32)
+    mean_ent = (ent * active).sum() / n
+    mean_pmax = (pmax * active).sum() / n
+    if paged:
+        blocks = (blk >= 0).sum().astype(jnp.float32)
+    else:
+        blocks = jnp.maximum(blk, 0).sum().astype(jnp.float32)
+    return jnp.stack([occ, mean_ent, mean_pmax, blocks])
 
 
 class SlotStep:
@@ -89,10 +118,13 @@ class SlotStep:
     residency for overlap there."""
 
     def __init__(self, model, temperature: float = 0.0, top_k: int = 0,
-                 donate: bool = True):
+                 donate: bool = True, telemetry: bool = True):
         self.model = model
         self.temperature = float(temperature)
         self.top_k = int(top_k)
+        # in-program telemetry (``_telemetry_stats``) is baked into the
+        # compiled step at construction; it changes outputs, not programs
+        self.telemetry = bool(telemetry)
         self._sf = StaticFunction(self._forward_sample, layer=model,
                                   donate_args=donate,
                                   name="serving.SlotStep")
@@ -135,9 +167,19 @@ class SlotStep:
                 l = jnp.where(l < kth, -jnp.inf, l)
             return jax.random.categorical(key, l, axis=-1).astype(jnp.int32)
 
-        next_ids = apply("sample_next", pick, logits, gather_idx,
-                         differentiable=False)
-        return next_ids, new_caches
+        with region("sampling"):
+            next_ids = apply("sample_next", pick, logits, gather_idx,
+                             differentiable=False)
+        stats = None
+        if self.telemetry:
+            c0 = new_caches[0]
+            paged = hasattr(c0, "block_table")
+            blk = c0.block_table if paged else c0.pos
+            with region("telemetry"):
+                stats = apply("step_telemetry", _telemetry_stats, logits,
+                              gather_idx, c0.pos, blk,
+                              differentiable=False, paged=paged)
+        return next_ids, stats, new_caches
 
 
 class DecodeEngine:
@@ -246,7 +288,8 @@ class DecodeEngine:
                 ids = paddle.to_tensor(ids_np.astype(np.int32))
                 pos_ids = paddle.to_tensor(np.arange(Pb, dtype=np.int32))
                 gather = paddle.to_tensor(lens - 1)
-                next_ids, caches = self._sf(ids, pos_ids, caches, gather)
+                next_ids, _stats, caches = self._sf(ids, pos_ids, caches,
+                                                    gather)
                 # prefill advanced pos by the padded width; the true valid
                 # length is the prompt length (pad rows are masked out).
                 # Per-layer pos copies: donated pytrees must not repeat a
@@ -268,7 +311,8 @@ class DecodeEngine:
                     p = paddle.reshape(paddle.to_tensor(cur_lens), [B, 1])
                     # fresh every step: args are donated to the compiled call
                     zero_gather = paddle.to_tensor(np.zeros(B, np.int32))
-                    next_ids, caches = self._sf(tok, p, caches, zero_gather)
+                    next_ids, _stats, caches = self._sf(tok, p, caches,
+                                                        zero_gather)
                     cur_lens += 1
                     step_np = np.asarray(next_ids.numpy())
                     if eos_token_id is not None:

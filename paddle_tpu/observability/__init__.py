@@ -49,10 +49,20 @@ serving, and distributed code:
   postmortem bundles (``PostmortemStore`` — one correlated artifact per
   alarm: timeline window + flight tail + journeys + breaker state +
   device census).
+- **In-step profiling** (``step_profile.py``): named regions
+  (``region("kv_gather")`` over ``jax.named_scope``, declared in
+  ``REGION_MANIFEST`` and linted like spans) annotate the serving decode
+  and train-step bodies; ``StepProfiler.capture`` wraps
+  ``jax.profiler.trace`` around K steps and attributes measured device
+  time per region per compiled program — region shares, per-region bytes
+  estimates, and the decode roofline decomposed by region. A zero-sync
+  in-program telemetry block (slot occupancy, sampled-token entropy /
+  max-prob, kv blocks touched) rides the existing token drain.
 - **Live endpoint** (``endpoint.py``): stdlib-http ``/metrics`` (Prometheus
   text across registries) + ``/debug`` index (``/debug/requests``,
   ``/debug/replicas``, ``/debug/programs``, ``/debug/memory``,
-  ``/debug/timeline``, ``/debug/postmortem``) + ``/healthz``.
+  ``/debug/timeline``, ``/debug/postmortem``, ``/debug/stepprofile``) +
+  ``/healthz``.
 
 Typical use::
 
@@ -117,6 +127,16 @@ from paddle_tpu.observability.serving_stall import (  # noqa: F401
     ServingStall,
     TTFTBreachStorm,
 )
+from paddle_tpu.observability.step_profile import (  # noqa: F401
+    REGION_MANIFEST,
+    REGION_PREFIX,
+    StepProfiler,
+    attribute_trace,
+    load_trace_events,
+    parse_hlo_instruction_bytes,
+    parse_hlo_instruction_regions,
+    region,
+)
 from paddle_tpu.observability.train_stall import (  # noqa: F401
     record_input_stall,
     record_sync_stall,
@@ -144,13 +164,21 @@ __all__ = [
     "ObservabilityEndpoint",
     "PostmortemStore",
     "ProgramInventory",
+    "REGION_MANIFEST",
+    "REGION_PREFIX",
     "RecompileStorm",
     "RequestTrace",
     "RequestTracer",
     "STALL_PHASES",
     "ServingStall",
+    "StepProfiler",
     "TTFTBreachStorm",
     "abstract_signature",
+    "attribute_trace",
+    "load_trace_events",
+    "parse_hlo_instruction_bytes",
+    "parse_hlo_instruction_regions",
+    "region",
     "chip_specs",
     "get_compile_tracker",
     "get_device_ledger",

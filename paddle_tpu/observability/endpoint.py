@@ -33,6 +33,11 @@ without adding any dependency:
                           ``DeviceMemoryLedger`` plus every attached
                           scheduler's ledger, including any retained
                           OOM-forensics report.
+- ``GET /debug/stepprofile`` JSON latest in-step profile per attached
+                          scheduler: named-region device-time shares from
+                          the last ``capture_step_profile`` run plus the
+                          zero-sync in-program telemetry snapshot. Read-
+                          only — scraping never starts a device trace.
 - ``GET /debug``          JSON index of every debug route above.
 - ``GET /healthz``        truthful health: the worst state across every
                           attached health source, as a plain-text body —
@@ -87,6 +92,7 @@ class ObservabilityEndpoint:
         self._memory_sources: "Dict[str, Callable[[], dict]]" = {}
         self._timelines: Dict[str, object] = {}     # MetricsTimeline
         self._postmortems: Dict[str, object] = {}   # PostmortemStore
+        self._stepprofile_sources: "Dict[str, Callable[[], dict]]" = {}
         self._host = host
         self._port = int(port)
         self._server: Optional[ThreadingHTTPServer] = None
@@ -123,12 +129,19 @@ class ObservabilityEndpoint:
         on-demand bundle from it and returns everything retained."""
         self._postmortems[str(name)] = store
 
+    def add_stepprofile_source(self, name: str, fn: Callable[[], dict]):
+        """``fn()`` -> a ``step_profile_state()``-shaped dict (latest
+        named-region capture + telemetry), rendered under ``name`` in
+        ``/debug/stepprofile``. Must never touch the device."""
+        self._stepprofile_sources[str(name)] = fn
+
     def add_scheduler(self, scheduler, name: Optional[str] = None):
         """Attach a ContinuousBatchingScheduler: its metrics registry feeds
         ``/metrics``, ``debug_state()`` feeds ``/debug/requests``,
         ``health()`` feeds ``/healthz``, (when device observability is
-        on) its ledger census feeds ``/debug/memory``, and its timeline /
-        postmortem stores feed ``/debug/timeline`` + ``/debug/postmortem``."""
+        on) its ledger census feeds ``/debug/memory``, its timeline /
+        postmortem stores feed ``/debug/timeline`` + ``/debug/postmortem``,
+        and ``step_profile_state()`` feeds ``/debug/stepprofile``."""
         self.add_registry(scheduler.metrics.registry)
         key = name or f"scheduler{len(self._debug_sources)}"
         self.add_debug_source(key, scheduler.debug_state)
@@ -141,6 +154,8 @@ class ObservabilityEndpoint:
             self.add_timeline(key, scheduler.timeline)
         if getattr(scheduler, "postmortems", None) is not None:
             self.add_postmortem(key, scheduler.postmortems)
+        if hasattr(scheduler, "step_profile_state"):
+            self.add_stepprofile_source(key, scheduler.step_profile_state)
         return self
 
     def add_router(self, router, name: Optional[str] = None):
@@ -238,6 +253,20 @@ class ObservabilityEndpoint:
                 out[name] = {"error": f"{type(e).__name__}: {e}"}
         return out
 
+    def debug_stepprofile(self) -> dict:
+        """The ``/debug/stepprofile`` payload: each attached scheduler's
+        latest named-region capture summary + telemetry snapshot. Read-
+        only host state — a scrape NEVER triggers a capture (captures run
+        a device trace; start them from ``capture_step_profile`` /
+        ``serve_bench --profile-steps``)."""
+        out = {}
+        for name, fn in self._stepprofile_sources.items():
+            try:
+                out[name] = fn()
+            except Exception as e:  # a broken source must not 500 the page
+                out[name] = {"error": f"{type(e).__name__}: {e}"}
+        return out
+
     def debug_postmortem(self, capture: bool = True) -> dict:
         """The ``/debug/postmortem`` payload: optionally freeze one
         on-demand bundle per attached store (default), then return every
@@ -270,6 +299,9 @@ class ObservabilityEndpoint:
         "/debug/postmortem": "correlated incident bundles; captures an "
                              "on-demand bundle first (?capture=0 to only "
                              "list)",
+        "/debug/stepprofile": "latest named-region step-profile capture + "
+                              "in-program telemetry (read-only; never "
+                              "triggers a capture)",
         "/healthz": "worst health state across attached sources",
     }
 
@@ -361,6 +393,10 @@ class ObservabilityEndpoint:
                         ep.debug_timeline(metric=metric, last=last,
                                           tier=tier),
                         default=str, indent=2)
+                    self._send(200, body, "application/json")
+                elif url.path == "/debug/stepprofile":
+                    body = json.dumps(ep.debug_stepprofile(),
+                                      default=str, indent=2)
                     self._send(200, body, "application/json")
                 elif url.path == "/debug/postmortem":
                     q = parse_qs(url.query)

@@ -179,7 +179,7 @@ class ProgramEntry:
     """One compiled executable: captured call specs + lazy XLA analysis."""
 
     __slots__ = ("name", "kind", "signature", "specs", "static_kwargs",
-                 "donate_argnums", "jitted", "analysis")
+                 "donate_argnums", "jitted", "analysis", "hlo")
 
     def __init__(self, name, kind, signature, specs, static_kwargs,
                  donate_argnums, jitted):
@@ -191,6 +191,7 @@ class ProgramEntry:
         self.donate_argnums = tuple(donate_argnums or ())
         self.jitted = jitted          # dropped after successful analysis
         self.analysis: Optional[dict] = None
+        self.hlo: Optional[str] = None  # optimized-HLO text, kept by analyze
 
 
 def _normalize_cost(ca) -> dict:
@@ -275,6 +276,14 @@ class ProgramInventory:
                 warnings.simplefilter("ignore")
                 compiled = jitted.lower(
                     *entry.specs, **entry.static_kwargs).compile()
+                try:
+                    # optimized-HLO text carries op_name metadata (the
+                    # named_scope paths step_profile attributes against);
+                    # kept on the entry so region attribution still works
+                    # after the jitted ref is dropped below
+                    entry.hlo = compiled.as_text()
+                except Exception:
+                    entry.hlo = None
                 out = _normalize_cost(compiled.cost_analysis())
                 try:
                     ma = compiled.memory_analysis()
@@ -296,6 +305,17 @@ class ProgramInventory:
         except Exception as exc:
             entry.analysis = {"error": f"{type(exc).__name__}: {exc}"}
         return entry.analysis
+
+    def hlo_text(self, entry: ProgramEntry) -> Optional[str]:
+        """Optimized-HLO text for one entry (cached on the entry).
+
+        Rides the same AOT compile ``analyze`` performs; ``None`` when
+        the program can no longer be lowered (jitted ref already dropped
+        by an earlier analyze on an older-schema entry, or compile
+        failure — recorded in ``entry.analysis['error']``)."""
+        if entry.hlo is None:
+            self.analyze(entry)
+        return entry.hlo
 
     # ---- queries --------------------------------------------------------
 

@@ -89,6 +89,12 @@ class SchedulerConfig:
     # Host-side bookkeeping only — tokens are bit-identical on vs off at
     # every dispatch_depth (pinned in tests).
     enable_device_observability: bool = True
+    # In-program step telemetry: a tiny on-device stats block (slot
+    # occupancy, sampled-token entropy/max-prob, kv blocks touched)
+    # appended to the compiled step's outputs and fetched by the existing
+    # token drain — zero extra steady-state host syncs, zero new compiled
+    # programs, tokens bit-identical on vs off (pinned in tests).
+    enable_step_telemetry: bool = True
     # Fleet observability: metrics time-series recorder + postmortem
     # bundles. ``timeline_interval_s`` > 0 spawns the background sampler
     # thread (role ``fleet-sample``); 0 leaves sampling to the owner

@@ -93,6 +93,11 @@ from paddle_tpu.observability.serving_stall import (
     FlightRecorder,
     ServingStall,
 )
+from paddle_tpu.observability.step_profile import (
+    StepProfiler,
+    parse_hlo_instruction_bytes,
+    parse_hlo_instruction_regions,
+)
 from paddle_tpu.profiler import RecordEvent
 from paddle_tpu.resilience import (
     DegradationLadder,
@@ -127,12 +132,13 @@ class _InFlight:
     drain thread fetches ``next_ids`` off the critical path and commits
     the tokens against the snapshot (retired slots discard as stale)."""
 
-    __slots__ = ("kind", "next_ids", "slots", "t_dispatch")
+    __slots__ = ("kind", "next_ids", "slots", "stats", "t_dispatch")
 
-    def __init__(self, kind: str, next_ids, slots):
+    def __init__(self, kind: str, next_ids, slots, stats=None):
         self.kind = kind          # "decode" | "admit"
         self.next_ids = next_ids  # device int32: [S] (decode) / [1] (admit)
         self.slots = slots        # [(slot, Request), ...] at dispatch time
+        self.stats = stats        # device f32[4] telemetry block (or None)
         self.t_dispatch = _time.perf_counter()   # DeviceTimeSampler anchor
 
 
@@ -166,6 +172,7 @@ class ContinuousBatchingScheduler:
     _carry: guarded_by("_elock")
     _done_async: guarded_by("_elock")
     _drain_exc: guarded_by("_elock")
+    _last_telemetry: guarded_by("_elock")
 
     def __init__(self, model, config: Optional[SchedulerConfig] = None,
                  metrics: Optional[ServingMetrics] = None,
@@ -207,7 +214,8 @@ class ContinuousBatchingScheduler:
                                                donate=self._donate)
         else:
             self._step_fn = SlotStep(model, temperature=cfg.temperature,
-                                     top_k=cfg.top_k, donate=self._donate)
+                                     top_k=cfg.top_k, donate=self._donate,
+                                     telemetry=cfg.enable_step_telemetry)
         if cfg.enable_prefix_caching:
             # sharing-aware pool + radix tree: admissions match cached
             # prefixes and prefill only the uncached suffix
@@ -296,6 +304,9 @@ class ContinuousBatchingScheduler:
         self._carry = None
         self._done_async: List[Request] = []     # retired at drain time
         self._drain_exc: Optional[BaseException] = None
+        # last drained in-program telemetry block (None until the first
+        # step with cfg.enable_step_telemetry lands)
+        self._last_telemetry: Optional[dict] = None
         self._drain_thread: Optional[threading.Thread] = None
         self._drain_stop = False
         # ---- device-side observability (HBM ledger + roofline) ---------
@@ -352,6 +363,15 @@ class ContinuousBatchingScheduler:
         if self.device_ledger is not None:
             self.postmortems.add_context("device_memory",
                                          self.device_ledger.census)
+        # ---- in-step profiling (named-region attribution) ---------------
+        # ``capture_step_profile`` builds the StepProfiler lazily (it needs
+        # compiled-program HLO, which only exists after the first step);
+        # postmortem bundles attach the LATEST capture only (bounded).
+        self.step_profiler: Optional[StepProfiler] = None
+        self.postmortems.add_context(
+            "step_profile",
+            lambda: (self.step_profiler.last_summary
+                     if self.step_profiler is not None else None))
         self.flight.set_alarm_callback(self._alarm_postmortem)
         if cfg.timeline_interval_s > 0:
             self.timeline.start(cfg.timeline_interval_s)
@@ -825,7 +845,7 @@ class ContinuousBatchingScheduler:
                         mt = paddle.to_tensor(np.array([matched], np.int32))
                         caches = [PagedCacheSlot(kp, vp, rt, mt)
                                   for kp, vp in self._pools]
-                    next_ids, caches = self._step_fn(
+                    next_ids, stats, caches = self._step_fn(
                         paddle.to_tensor(ids_np),
                         paddle.to_tensor(np.arange(matched, matched + Pb,
                                                    dtype=np.int32)),
@@ -882,6 +902,8 @@ class ContinuousBatchingScheduler:
                 # emit/EOS/length land at commit time (bounded staleness)
                 t0 = pc()
                 self._splice_admit(slot, next_ids)
+                # admit stats are a [1]-batch prefill view — not tracked;
+                # steady-state telemetry comes from the decode entries
                 self._enqueue(_InFlight("admit", next_ids, [(slot, req)]))
                 dispatch_s = pc() - t0
                 self.stall.record("dispatch", dispatch_s)
@@ -891,7 +913,7 @@ class ContinuousBatchingScheduler:
                 # the ONE deliberate admission sync: the first sampled
                 # token decides eos/packing — drained through the same
                 # metered helper as the batch decode path
-                arr, sync_s = self._fetch_tokens(next_ids)
+                arr, _stats_np, sync_s = self._fetch_tokens(next_ids)
                 if trace is not None:
                     trace.subspan("sampling_sync", sync_s)
                 tok = int(arr[0])
@@ -989,9 +1011,10 @@ class ContinuousBatchingScheduler:
                 if not pairs:
                     return finished
                 t_disp = _time.perf_counter()
-                next_ids, _disp_s = self._dispatch_decode(pairs)
+                next_ids, stats, _disp_s = self._dispatch_decode(pairs)
                 dispatched = True
-                arr, _sync_s = self._fetch_tokens(next_ids)
+                arr, stats_np, _sync_s = self._fetch_tokens(next_ids,
+                                                            stats=stats)
                 if self._device_time is not None:
                     # depth 0: the inline fetch blocks until the device is
                     # done, so dispatch→fetch-return IS the step time
@@ -1011,6 +1034,8 @@ class ContinuousBatchingScheduler:
                 continue
             break
         self.metrics.decode_steps += 1
+        if stats_np is not None:
+            self._note_telemetry(stats_np)
         finished += self._commit_decode(pairs, arr, metered=True)
         return finished
 
@@ -1055,8 +1080,9 @@ class ContinuousBatchingScheduler:
     @holds_lock("_elock")
     def _dispatch_decode(self, pairs):
         """Dispatch ONE fixed-shape decode step over the slot grid;
-        returns ``(next_ids, host_s)`` — the device-resident sampled ids
-        and the host-scheduling seconds spent around the compiled call
+        returns ``(next_ids, stats, host_s)`` — the device-resident
+        sampled ids, the in-program telemetry block (None when off), and
+        the host-scheduling seconds spent around the compiled call
         (staging, table masking, carry/bookkeeping). The compiled-step
         invocation itself is excluded from ``host_s``: it is compute
         dispatch, not host scheduling — the same rule that keeps prefill
@@ -1077,7 +1103,7 @@ class ContinuousBatchingScheduler:
             # the stale-transfer hazard async dispatch exposes
             caches = self._caches(self._disp_table(), self._disp_pos.copy())
             t_call = pc()
-            next_ids, caches = self._step_fn(
+            next_ids, stats, caches = self._step_fn(
                 ids, paddle.to_tensor(pos), caches,
                 paddle.to_tensor(np.zeros(S, np.int32)))
             call_s = pc() - t_call
@@ -1087,22 +1113,28 @@ class ContinuousBatchingScheduler:
             self._disp_emitted[s] += 1
         if self.dispatch_depth:
             self._carry = next_ids
-        return next_ids, (pc() - t0) - call_s
+        return next_ids, stats, (pc() - t0) - call_s
 
     @hot_path(reason="the engine's only blocking D2H read — every sampled-"
                      "token fetch (admission, batch decode, drain thread) "
                      "funnels through this one metered helper")
-    def _fetch_tokens(self, next_ids, phase: str = "sampling_sync"):
+    def _fetch_tokens(self, next_ids, phase: str = "sampling_sync",
+                      stats=None):
         """THE single metered token-readback site (the two pre-async call
         sites — admission first-token and batch decode — plus the drain
         thread all land here, so stall accounting cannot diverge between
         paths). ``phase="sampling_sync"`` meters critical-path stall;
         ``phase="drain"`` routes to the overlapped drain-wait counter.
-        Returns ``(tokens_np, seconds_blocked)``."""
+        ``stats`` (the step's in-program telemetry block) rides the SAME
+        blocking read — by the time the tokens are host-visible the step
+        has completed, so the stats copy adds no extra device sync.
+        Returns ``(tokens_np, stats_np_or_None, seconds_blocked)``."""
         t0 = _time.perf_counter()
         with self.stall.timed(phase):
             arr = np.asarray(next_ids.numpy())
-        return arr, _time.perf_counter() - t0
+            stats_np = (None if stats is None
+                        else np.asarray(stats.numpy()))
+        return arr, stats_np, _time.perf_counter() - t0
 
     @holds_lock("_elock")
     def _splice_admit(self, slot: int, next_ids):
@@ -1152,7 +1184,9 @@ class ContinuousBatchingScheduler:
         pipeline (``_drain_exc``) and surfaces on the scheduler thread at
         its next barrier."""
         try:
-            arr, _ = self._fetch_tokens(entry.next_ids, phase="drain")
+            arr, stats_np, _ = self._fetch_tokens(entry.next_ids,
+                                                  phase="drain",
+                                                  stats=entry.stats)
             exc: Optional[BaseException] = None
             if entry.kind == "decode" and self._device_time is not None:
                 # fetch-return = step completion: pure host timestamping,
@@ -1160,10 +1194,12 @@ class ContinuousBatchingScheduler:
                 self._device_time.observe(entry.t_dispatch,
                                           _time.perf_counter())
         except BaseException as e:        # noqa: BLE001 — must not die silently
-            arr, exc = None, e
+            arr, stats_np, exc = None, None, e
         with self._elock:
             try:
                 if exc is None:
+                    if stats_np is not None:
+                        self._note_telemetry(stats_np)
                     self._done_async += self._commit_entry(entry, arr)
                 else:
                     self._drain_exc = exc
@@ -1291,7 +1327,7 @@ class ContinuousBatchingScheduler:
                     pairs = self._live_pairs()
                 if not pairs:
                     return False
-                next_ids, disp_s = self._dispatch_decode(pairs)
+                next_ids, stats, disp_s = self._dispatch_decode(pairs)
             except Exception as exc:
                 self._drain_all()
                 self._done_async += self._absorb_step_fault(
@@ -1299,7 +1335,7 @@ class ContinuousBatchingScheduler:
                 attempt += 1
                 continue
             t0 = _time.perf_counter()
-            self._enqueue(_InFlight("decode", next_ids, pairs))
+            self._enqueue(_InFlight("decode", next_ids, pairs, stats=stats))
             self.stall.record(
                 "dispatch", disp_s + (_time.perf_counter() - t0))
             return True
@@ -1891,3 +1927,87 @@ class ContinuousBatchingScheduler:
             "decode_device_step_seconds",
             "sampled decode device step time", unit="seconds").set(step_s)
         return out
+
+    # ---- in-step profiling (named-region attribution) ------------------
+
+    @holds_lock("_elock")
+    def _note_telemetry(self, stats_np):
+        """(commit path) fold one drained decode step's in-program
+        telemetry block into the latest-value snapshot. Pure host
+        bookkeeping on an already-fetched array."""
+        prev = self._last_telemetry
+        self._last_telemetry = {
+            "active_slots": float(stats_np[0]),
+            "occupancy": float(stats_np[0]) / max(self.config.max_num_seqs,
+                                                  1),
+            "mean_entropy": float(stats_np[1]),
+            "mean_max_prob": float(stats_np[2]),
+            "kv_blocks": float(stats_np[3]),
+            "steps": (0 if prev is None else prev["steps"]) + 1,
+        }
+
+    def telemetry_snapshot(self) -> Optional[dict]:
+        """Latest drained in-program telemetry block (None until the
+        first decode step lands with ``enable_step_telemetry``)."""
+        with self._elock:
+            return (None if self._last_telemetry is None
+                    else dict(self._last_telemetry))
+
+    def drain_in_flight(self):
+        """Public pipeline barrier: commit every in-flight step. The
+        step-profiler runs this between traced steps so a capture at
+        ``dispatch_depth > 0`` measures whole executed steps instead of
+        cutting the trace mid-pipeline."""
+        with self._elock:
+            self._drain_all()
+
+    def _profile_programs(self) -> List[dict]:
+        """Program rows for ``attribute_trace``: every compiled program of
+        this step (prefill buckets + decode), each with its HLO-derived
+        instruction→region map. The decode program ([S, 1] token grid) is
+        marked primary and leads the list — module-name collisions between
+        prefill and decode executables resolve in its favor."""
+        inv = get_program_inventory()
+        want = f"i32[{self.config.max_num_seqs},1]"
+        rows: List[dict] = []
+        for e in inv.entries(name_contains=self._step_fn.tracker_name):
+            hlo = inv.hlo_text(e)
+            if not hlo:
+                continue
+            module, regions = parse_hlo_instruction_regions(hlo)
+            row = {"name": e.name, "module": module, "regions": regions,
+                   "nbytes": parse_hlo_instruction_bytes(hlo)}
+            if want in e.signature:
+                an = inv.analyze(e)
+                if "flops" in an:
+                    row["flops"] = an["flops"]
+                    row["bytes_accessed"] = an["bytes_accessed"]
+                row["primary"] = True
+                rows.insert(0, row)
+            else:
+                rows.append(row)
+        return rows
+
+    def capture_step_profile(self, steps: int = 8) -> dict:
+        """On-demand in-step profile: trace ``steps`` scheduler steps
+        under ``jax.profiler.trace`` and attribute device time to the
+        named regions of each compiled program (region shares, per-region
+        bytes estimates, the decode roofline decomposed by region).
+        Expensive (device trace + parse) — bench/debug path only, never
+        the hot loop. The summary is retained for ``/debug/stepprofile``
+        and postmortem bundles."""
+        if self.step_profiler is None:
+            self.step_profiler = StepProfiler(
+                self.step, self._profile_programs,
+                barrier=self.drain_in_flight)
+        return self.step_profiler.capture(steps=steps)
+
+    def step_profile_state(self) -> Dict[str, object]:
+        """Endpoint-facing snapshot: the latest capture + telemetry.
+        NEVER touches the device (no trace, no sync) — safe to scrape."""
+        return {
+            "telemetry_enabled": bool(self.config.enable_step_telemetry),
+            "telemetry": self.telemetry_snapshot(),
+            "last_capture": (self.step_profiler.last_summary
+                             if self.step_profiler is not None else None),
+        }

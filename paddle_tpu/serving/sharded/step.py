@@ -49,6 +49,7 @@ from paddle_tpu.core.dispatch import apply
 from paddle_tpu.models import kv_cache
 from paddle_tpu.models.gpt import _seq_constrain
 from paddle_tpu.models.serving import SlotStep
+from paddle_tpu.observability.step_profile import region
 from paddle_tpu.profiler import RecordEvent
 
 __all__ = ["ShardedSlotStep", "TensorParallelSharding",
@@ -132,13 +133,13 @@ class ShardedSlotStep(SlotStep):
 
     def __init__(self, model, mesh: Mesh, plan: str = "exact",
                  temperature: float = 0.0, top_k: int = 0,
-                 donate: bool = True):
+                 donate: bool = True, telemetry: bool = True):
         if plan not in _PLANS:
             raise ValueError(f"unknown sharding plan {plan!r}")
         self.mesh = mesh
         self.plan = plan
         super().__init__(model, temperature=temperature, top_k=top_k,
-                         donate=donate)
+                         donate=donate, telemetry=telemetry)
 
     # ---- seams ---------------------------------------------------------
 
@@ -147,28 +148,34 @@ class ShardedSlotStep(SlotStep):
         all-gather / psum seam), ``_seam(x, None, None, "tp")`` keeps a
         dim sharded. Traced inside the compiled step only."""
         ns = NamedSharding(self.mesh, P(*spec))
-        return apply(
-            "sharding_constraint",
-            lambda v: jax.lax.with_sharding_constraint(v, ns), x)
+        with region("tp_gather"):
+            return apply(
+                "sharding_constraint",
+                lambda v: jax.lax.with_sharding_constraint(v, ns), x)
 
     # ---- the composed forward -----------------------------------------
 
     def _model_call(self, ids, position_ids, caches):
         model = self.model
         gpt = model.gpt
-        h = gpt.embeddings(ids, position_ids)
+        with region("embed"):
+            h = gpt.embeddings(ids, position_ids)
         new_caches = []
         for blk, cache in zip(gpt.h, caches):
             h, nc = self._layer(blk, h, cache)
             new_caches.append(nc)
-        h = gpt.ln_f(h)
-        return self._logits(model, gpt, h), new_caches
+        with region("logits"):
+            h = gpt.ln_f(h)
+            logits = self._logits(model, gpt, h)
+        return logits, new_caches
 
     def _layer(self, blk, x, cache):
-        a, nc = self._attn(blk.attn, blk.ln_1(x), cache)
-        x = x + blk.dropout(a)
-        x = x + blk.dropout(self._mlp(blk.mlp, blk.ln_2(x)))
-        x = _seq_constrain(x, blk._cfg)
+        with region("attention"):
+            a, nc = self._attn(blk.attn, blk.ln_1(x), cache)
+            x = x + blk.dropout(a)
+        with region("mlp"):
+            x = x + blk.dropout(self._mlp(blk.mlp, blk.ln_2(x)))
+            x = _seq_constrain(x, blk._cfg)
         return x, nc
 
     def _attn(self, attn, hidden, cache):
@@ -272,7 +279,9 @@ class TensorParallelSharding:
     def make_step(self, model, cfg, donate: bool = True):
         return ShardedSlotStep(model, mesh=self.mesh, plan=self.plan,
                                temperature=cfg.temperature, top_k=cfg.top_k,
-                               donate=donate)
+                               donate=donate,
+                               telemetry=getattr(
+                                   cfg, "enable_step_telemetry", True))
 
     def shard_pools(self, pools):
         """Partition the paged K/V pools' head dim over the mesh. Eager

@@ -477,7 +477,7 @@ def test_lint_repo_exits_zero():
     assert r.returncode == 0, r.stdout[-3000:]
     rep = json.loads(r.stdout)
     assert rep["ok"] and rep["files_scanned"] > 200
-    assert len(rep["rules"]) == 11
+    assert len(rep["rules"]) == 12
     assert rep["schema"] == "graft-lint-report/2"
     assert rep["audits"] == ["stale-suppression"]
     # every reported finding carries a content-addressed fingerprint

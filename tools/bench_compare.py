@@ -16,6 +16,14 @@ classifies each metric by direction from its name:
 - **lower is better**: ``*_s`` / ``*_ms`` / ``*_seconds``, ``*stall*``,
   ``ttft*`` / ``tpot*``, ``*overhead*`` — a rise beyond tolerance is a
   regression;
+- **direction-neutral**: per-region composition fields from the in-step
+  profiler (``region_share_*``, ``region_shares.*``, ``group_shares.*``,
+  ``region_bytes_est.*``, ``bandwidth_util_by_region.*``,
+  ``aux_modules.*``) — device time moving from attention to mlp is a mix
+  change whose goodness depends on the PR, so these are reported in an
+  ``informational`` list (old/new/rel) and never gate. The scalar
+  ``region_coverage`` stays gated higher-is-better: losing attribution
+  coverage IS a regression;
 - everything else (counts, configs, bytes, shas) is compared for drift
   but never fails the gate — changing ``num_requests`` is a workload
   change, not a perf regression, and it shows up as ``noncomparable``.
@@ -73,14 +81,31 @@ _HIGHER_MARKERS = (
     "ratio", "hit_rate", "goodput", "util", "mfu", "tflops", "gbs",
     "recovery_pct", "ceiling", "bandwidth", "coverage",
 )
+# in-step region composition: a share shifting between regions is a mix
+# change whose goodness depends on the PR under review, so these leaves
+# are direction-neutral — surfaced with old/new values, never gated.
+# Checked FIRST (against the full dotted path, since e.g. the leaf under
+# ``region_shares.`` is just the region name) so a region named after a
+# directional marker can never be gated by accident.
+_INFORMATIONAL_MARKERS = (
+    "region_share", "region_shares.", "group_shares.",
+    "region_bytes_est.", "bandwidth_util_by_region.", "aux_modules.",
+)
 
 
 def classify(path: str) -> Optional[str]:
     """Direction of a metric from its dotted path: ``"higher"``,
-    ``"lower"``, or ``None`` (not a gated perf metric). Only the LEAF
-    key decides — parent keys like ``goodput_vs_fault_rate`` must not
-    poison the direction of the ``goodput`` inside them."""
-    low = path.lower().split(".")[-1].split("[")[0]
+    ``"lower"``, ``"info"`` (direction-neutral region composition), or
+    ``None`` (not a gated perf metric). Directional markers match only
+    the LEAF key — parent keys like ``goodput_vs_fault_rate`` must not
+    poison the direction of the ``goodput`` inside them; informational
+    markers match the full path, because a region-share leaf is just the
+    region's name."""
+    full = path.lower()
+    for m in _INFORMATIONAL_MARKERS:
+        if m in full:
+            return "info"
+    low = full.split(".")[-1].split("[")[0]
     for m in _GOODNESS_MARKERS:
         if m in low:
             return "higher"
@@ -121,6 +146,7 @@ def compare(old: dict, new: dict, tolerance: float = 0.25,
     regressions: List[dict] = []
     improvements: List[dict] = []
     drift: List[dict] = []
+    informational: List[dict] = []
     noncomparable: List[str] = []
     for path in sorted(set(a) & set(b)):
         va, vb = a[path], b[path]
@@ -132,6 +158,9 @@ def compare(old: dict, new: dict, tolerance: float = 0.25,
                "rel_change": round(rel, 4) if rel != float("inf") else None}
         if direction is None:
             noncomparable.append(path)
+            continue
+        if direction == "info":
+            informational.append(row)
             continue
         material = abs(vb - va) > abs_floor
         bad = material and (rel < -tolerance if direction == "higher"
@@ -151,6 +180,7 @@ def compare(old: dict, new: dict, tolerance: float = 0.25,
         "regressions": regressions,
         "improvements": improvements,
         "drift": drift,
+        "informational": informational,
         "noncomparable": noncomparable,
         "missing": sorted(set(a) - set(b)),
         "added": sorted(set(b) - set(a)),
@@ -204,9 +234,13 @@ def main(argv=None) -> int:
         for r in rep["improvements"]:
             print(f"improved   {r['metric']}: {r['old']} -> {r['new']} "
                   f"({pct(r)})")
+        for r in rep["informational"]:
+            print(f"info       {r['metric']}: {r['old']} -> {r['new']} "
+                  f"({pct(r)})")
         print(f"{len(rep['regressions'])} regressions, "
               f"{len(rep['improvements'])} improvements, "
               f"{len(rep['drift'])} within tolerance, "
+              f"{len(rep['informational'])} informational region shifts, "
               f"{len(rep['noncomparable'])} non-gated changes "
               f"(tolerance {rep['tolerance']:.0%})")
     return 0 if rep["ok"] else 1

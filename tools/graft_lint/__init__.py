@@ -1,6 +1,6 @@
 """graft_lint: framework-invariant static analysis for this codebase.
 
-Eleven checkers over a shared stdlib-``ast`` module graph (no jax import,
+Twelve checkers over a shared stdlib-``ast`` module graph (no jax import,
 no execution of scanned code), each targeting an invariant the framework
 otherwise only defends at runtime:
 
@@ -12,6 +12,8 @@ otherwise only defends at runtime:
 - ``guarded-by``            lock discipline over declared shared state
 - ``donation-alias``        donated jit buffers re-read after the call
 - ``span-manifest``         RecordEvent names vs. span_manifest.py
+- ``region-manifest``       region(...) profiling annotations vs.
+                            step_profile.py's REGION_MANIFEST
 - ``swallowed-exception``   bare ``except:`` / do-nothing broad catches
                             that defeat transient-vs-fatal classification
 - ``ledger-bypass``         device allocations for tracked owners in
@@ -56,6 +58,7 @@ from tools.graft_lint.check_recompile import RecompileHazardChecker
 from tools.graft_lint.check_threadroles import ThreadRoleChecker
 from tools.graft_lint.check_tracing import TracingHazardChecker
 from tools.graft_lint.core import Baseline, Finding, ModuleGraph
+from tools.graft_lint.regioncheck import RegionManifestChecker
 from tools.graft_lint.spancheck import SpanManifestChecker
 
 __all__ = ["ALL_CHECKERS", "Baseline", "Finding", "ModuleGraph",
@@ -69,6 +72,7 @@ ALL_CHECKERS = (
     GuardedByChecker,
     DonationAliasChecker,
     SpanManifestChecker,
+    RegionManifestChecker,
     SwallowedExceptionChecker,
     LedgerBypassChecker,
     LockOrderChecker,

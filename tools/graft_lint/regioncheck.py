@@ -1,0 +1,161 @@
+"""region-manifest: every named profiling region has an owner, no entry
+rots.
+
+Sibling of ``spancheck`` for the in-step profiling regions: scans
+``paddle_tpu/`` for ``region("...")`` call sites and reconciles them
+against ``observability/step_profile.py``'s ``REGION_MANIFEST``:
+
+- a literal region name annotated but not declared   -> FAIL (who owns
+  the region-level regression?)
+- a declared region no call site annotates anymore   -> FAIL (stale
+  entry: its bench share silently reads 0 and looks like a perf win)
+- a non-literal (runtime-built) region name          -> FAIL (regions
+  are a closed vocabulary; ``region()`` itself raises on unknown names
+  at trace time, but only the lint catches names that never trace)
+
+Like the span lint, the manifest is read STATICALLY (``ast.literal_eval``
+on the module's dict assignment) so the driver never imports
+``paddle_tpu`` or jax.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import Dict, List
+
+from tools.graft_lint.core import Finding
+
+RULE = "region-manifest"
+
+# literal first (and only) arg: region("name")  — the lookbehind keeps
+# _read_region(...) / _full_region(...) and method calls out
+_LITERAL = re.compile(r'(?<![A-Za-z0-9_.])region\(\s*"([^"]+)"\s*\)')
+# any bare region( call site (to find the non-literal ones by subtraction)
+_ANY = re.compile(r"(?<![A-Za-z0-9_.])region\(\s*([^)\s,]+)")
+
+
+def scan_regions(root: str) -> Dict[str, object]:
+    """Walk ``root`` for .py files; return literal region names (with
+    their call sites) and non-literal call sites."""
+    literals: Dict[str, List[str]] = {}
+    dynamic_sites: List[Dict[str, object]] = []
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        for fn in sorted(filenames):
+            # the manifest module names regions in prose and in its own
+            # wrapper definition, not as annotation sites
+            if not fn.endswith(".py") or fn == "step_profile.py":
+                continue
+            path = os.path.join(dirpath, fn)
+            rel = os.path.relpath(path, os.path.dirname(root)).replace(
+                os.sep, "/")
+            with open(path, encoding="utf-8") as f:
+                for lineno, line in enumerate(f, 1):
+                    if "region(" not in line:
+                        continue
+                    stripped = line.strip()
+                    # def/class/import lines and RST-literal docstring
+                    # mentions (``region("...")``) are not call sites
+                    if stripped.startswith(("class ", "def ", "from ",
+                                            "import ", "#")) or \
+                            "``" in line:
+                        continue
+                    m = _LITERAL.search(line)
+                    if m:
+                        literals.setdefault(m.group(1), []).append(
+                            f"{rel}:{lineno}")
+                        continue
+                    m = _ANY.search(line)
+                    if m:
+                        dynamic_sites.append({"file": rel, "line": lineno,
+                                              "arg": m.group(1)})
+    return {"literals": literals, "dynamic_sites": dynamic_sites}
+
+
+def check_regions(root: str, manifest: Dict[str, dict]) -> Dict[str, object]:
+    """Reconcile a scan against the manifest; full report with ``ok``."""
+    scan = scan_regions(root)
+    literals = scan["literals"]
+    undeclared = sorted(n for n in literals if n not in manifest)
+    stale = sorted(n for n in manifest if n not in literals)
+    malformed = sorted(
+        n for n, entry in manifest.items()
+        if not (isinstance(entry, dict) and entry.get("owner")
+                and entry.get("category")))
+    return {
+        "ok": not (undeclared or stale or scan["dynamic_sites"]
+                   or malformed),
+        "regions_annotated": {n: s for n, s in sorted(literals.items())},
+        "dynamic_sites": scan["dynamic_sites"],
+        "undeclared": undeclared,
+        "stale": stale,
+        "malformed_entries": malformed,
+    }
+
+
+def load_manifest_static(package_root: str) -> Dict[str, dict]:
+    """``REGION_MANIFEST`` parsed from step_profile.py WITHOUT importing
+    it (a literal dict by construction)."""
+    path = os.path.join(package_root, "observability", "step_profile.py")
+    with open(path, encoding="utf-8") as f:
+        tree = ast.parse(f.read(), filename=path)
+    for node in tree.body:
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Name) and t.id == "REGION_MANIFEST":
+                    return ast.literal_eval(node.value)
+    return {}
+
+
+def manifest_rel(package_root: str, repo_root: str) -> str:
+    return os.path.relpath(
+        os.path.join(package_root, "observability", "step_profile.py"),
+        repo_root).replace(os.sep, "/")
+
+
+class RegionManifestChecker:
+    """graft_lint face of the region lint. Runs once per scan root that
+    carries a region manifest (in this repo: ``paddle_tpu/``); roots
+    without one (``tools/``, test fixtures) are skipped."""
+
+    rule = RULE
+    description = ("region(...) profiling annotations reconciled against "
+                   "observability/step_profile.py REGION_MANIFEST "
+                   "(owners, staleness, literal-only names)")
+
+    def run(self, graph, index) -> List[Finding]:
+        findings: List[Finding] = []
+        for root in graph.roots:
+            mpath = os.path.join(root, "observability", "step_profile.py")
+            if not os.path.exists(mpath):
+                continue
+            manifest = load_manifest_static(root)
+            report = check_regions(root, manifest)
+            man_rel = manifest_rel(root, graph.repo_root)
+            for name in report["undeclared"]:
+                site = report["regions_annotated"][name][0]
+                f, _, line = site.partition(":")
+                findings.append(Finding(
+                    RULE, f, int(line or 1), 0,
+                    f"undeclared region {name!r} — add it to "
+                    f"REGION_MANIFEST in observability/step_profile.py "
+                    f"with an owner", symbol=name))
+            for name in report["stale"]:
+                findings.append(Finding(
+                    RULE, man_rel, 1, 0,
+                    f"stale REGION_MANIFEST entry {name!r} — no call "
+                    f"site annotates it anymore; remove it", symbol=name))
+            for s in report["dynamic_sites"]:
+                findings.append(Finding(
+                    RULE, str(s["file"]), int(s["line"]), 0,
+                    f"non-literal region name (arg {s['arg']}) — region "
+                    f"names are a closed vocabulary; use a declared "
+                    f"literal", symbol=""))
+            for name in report["malformed_entries"]:
+                findings.append(Finding(
+                    RULE, man_rel, 1, 0,
+                    f"malformed REGION_MANIFEST entry {name!r} — needs "
+                    f"non-empty owner and category", symbol=name))
+        return findings

@@ -1489,6 +1489,146 @@ def run_observability_suite(smoke: bool = True, out_dir: str = REPO_ROOT,
     return artifact
 
 
+def run_stepprofile_load(steps: int = 6, num_layers: int = 2,
+                         max_num_seqs: int = 4, dispatch_depth: int = 0,
+                         seed: int = 0, telemetry: bool = True,
+                         decode_tokens: int = 48) -> dict:
+    """One seeded serving load held in steady decode while the scheduler's
+    StepProfiler captures ``steps`` iterations (``steps=0`` skips the
+    capture — the telemetry-invariant conditions). The grid is filled and
+    every admission retired BEFORE the capture window so the traced steps
+    are pure decode — the program whose region shares the artifact gates."""
+    import hashlib
+
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu.models import GPTForCausalLM, gpt_tiny
+    from paddle_tpu.serving import ContinuousBatchingScheduler, SchedulerConfig
+
+    paddle.seed(7)
+    model = GPTForCausalLM(gpt_tiny(num_layers=num_layers))
+    cfg = SchedulerConfig(max_num_seqs=max_num_seqs, max_seq_len=64,
+                          block_size=8, dispatch_depth=dispatch_depth,
+                          enable_step_telemetry=telemetry)
+    sched = _track(ContinuousBatchingScheduler(model, cfg))
+    rng = np.random.default_rng(seed)
+    prompts = [rng.integers(0, 1000, int(n))
+               for n in rng.integers(4, 12, max_num_seqs)]
+    for p in prompts:
+        sched.add_request(p, max_new_tokens=decode_tokens)
+    for _ in range(max_num_seqs + 2):     # admit everything: grid full
+        sched.step()
+    programs_before = sched.num_programs()
+    t0 = time.perf_counter()
+    summary = (sched.capture_step_profile(steps=steps)
+               if steps > 0 else None)
+    capture_s = time.perf_counter() - t0
+    while sched.has_unfinished():
+        sched.step()
+    telemetry_snap = sched.telemetry_snapshot()
+    programs_after = sched.num_programs()
+    outs = dict(sched._finished)
+    digest = hashlib.sha1()
+    for rid in sorted(outs):
+        digest.update(np.asarray(outs[rid].token_ids, np.int64).tobytes())
+    sched.shutdown()
+    return {
+        "config": {"steps": steps, "num_layers": num_layers,
+                   "max_num_seqs": max_num_seqs,
+                   "dispatch_depth": dispatch_depth, "seed": seed,
+                   "telemetry": telemetry,
+                   "decode_tokens": decode_tokens},
+        "capture": summary,
+        "capture_s": round(capture_s, 3),
+        "telemetry": telemetry_snap,
+        "programs_before_capture": programs_before,
+        "programs_after": programs_after,
+        "outputs_sha1": digest.hexdigest(),
+    }
+
+
+# the decode regions the stepprofile artifact promotes to first-class
+# gate fields (bench_compare reports region_share_* leaves)
+STEPPROFILE_GATED_REGIONS = ("kv_gather", "attention", "mlp", "sampling")
+
+
+def run_stepprofile_suite(steps: int = 6, smoke: bool = True,
+                          out_dir: str = REPO_ROOT, seed: int = 0) -> dict:
+    """The BENCH_serving_stepprofile artifact: in-step named-region
+    attribution of the compiled decode program.
+
+    One captured run (device trace around ``steps`` scheduler steps →
+    per-region device-time shares + the region-decomposed decode
+    roofline + the zero-sync telemetry block), plus the invariant
+    conditions the ISSUE pins: telemetry on vs off at dispatch_depth 0
+    and 2 — token streams bit-identical, compiled-program count
+    unchanged, and the capture itself must not have compiled anything."""
+    layers = 1 if smoke else 2
+    seqs = 2 if smoke else 4
+    base = run_stepprofile_load(steps=steps, num_layers=layers,
+                                max_num_seqs=seqs, dispatch_depth=0,
+                                seed=seed, telemetry=True)
+    summary = base["capture"] or {}
+    shares = summary.get("region_shares", {})
+
+    invariants = {}
+    for depth in (0, 2):
+        pair = {}
+        for tele in (True, False):
+            art = run_stepprofile_load(steps=0, num_layers=layers,
+                                       max_num_seqs=seqs,
+                                       dispatch_depth=depth, seed=seed,
+                                       telemetry=tele, decode_tokens=12)
+            pair[tele] = art
+        invariants[f"depth{depth}"] = {
+            "token_identical":
+                pair[True]["outputs_sha1"] == pair[False]["outputs_sha1"],
+            "programs_equal": (pair[True]["programs_after"]
+                               == pair[False]["programs_after"]),
+            "programs": {"on": pair[True]["programs_after"],
+                         "off": pair[False]["programs_after"]},
+            "telemetry_on": pair[True]["telemetry"],
+        }
+    inv_ok = all(v["token_identical"] and v["programs_equal"]
+                 for v in invariants.values())
+    capture_compiled = (base["programs_after"]
+                        != base["programs_before_capture"])
+
+    artifact = {
+        "bench": "serving_stepprofile",
+        "config": {"steps": steps, "smoke": smoke, "seed": seed,
+                   "num_layers": layers, "max_num_seqs": seqs},
+        # first-class gate fields (bench_compare reads these leaves)
+        "region_coverage": summary.get("coverage", 0.0),
+        **{f"region_share_{r}": shares.get(r, 0.0)
+           for r in STEPPROFILE_GATED_REGIONS},
+        "region_shares": shares,
+        "group_shares": summary.get("group_shares", {}),
+        "aux_modules": summary.get("aux_modules", {}),
+        "decode_roofline": summary.get("decode_roofline"),
+        "primary_program": summary.get("primary_program"),
+        "capture_enabled": bool(summary.get("enabled")),
+        "capture_error": summary.get("error"),
+        "capture_s": base["capture_s"],
+        "trace_events": summary.get("trace_events"),
+        "telemetry": base["telemetry"],
+        "telemetry_invariants": invariants,
+        "capture_compiled_programs": capture_compiled,
+        "within_budget": (
+            bool(summary.get("enabled"))
+            and summary.get("coverage", 0.0) >= 0.9
+            and all(shares.get(r, 0.0) > 0.0
+                    for r in STEPPROFILE_GATED_REGIONS)
+            and inv_ok and not capture_compiled),
+        "completed": True,
+    }
+    out_path = os.path.join(out_dir, "BENCH_serving_stepprofile.json")
+    write_bench_json(out_path, artifact)
+    artifact["artifact"] = out_path
+    return artifact
+
+
 def _respawn_sharded(args, tp: int, replicas: int, out_path: str) -> dict:
     """Parent half of the sharded mode: re-exec this script in a clean
     subprocess whose XLA_FLAGS force an emulated mesh of tp*replicas CPU
@@ -1719,6 +1859,12 @@ def main(argv=None) -> dict:
                     help="fully-instrumented run (tracing + SLO + live "
                          "endpoint scrape) + on-vs-off overhead/token-"
                          "identity measurement -> BENCH_serving_obs.json")
+    ap.add_argument("--profile-steps", type=int, default=None,
+                    help="in-step profile: capture a device trace around "
+                         "K scheduler steps and attribute decode device "
+                         "time to named regions (kv_gather/attention/mlp/"
+                         "sampling/...), plus telemetry on-vs-off "
+                         "invariants -> BENCH_serving_stepprofile.json")
     ap.add_argument("--chaos", action="store_true",
                     help="resilience suite: seeded fault-rate sweep, "
                          "fault-window recovery, cancellations, disarmed-"
@@ -1777,6 +1923,7 @@ def main(argv=None) -> dict:
             "router" if args.replicas is not None else
             "async" if args.depth is not None else
             "chaos" if chaos else "obs" if args.observability else
+            "stepprofile" if args.profile_steps is not None else
             "prefix" if args.prefix_share else
             "smoke" if args.smoke else "load")
     if mode == "async":
@@ -1947,6 +2094,27 @@ def _run_mode(args, mode: str, out_path: str) -> dict:
             "attributed_pct": artifact["overhead"][
                 "attributed_overhead_pct"],
             "token_identical": artifact["overhead"]["token_identical"],
+            "within_budget": artifact["within_budget"],
+            "artifact": artifact["artifact"],
+        }))
+        return artifact
+
+    if mode == "stepprofile":
+        artifact = run_stepprofile_suite(
+            steps=max(1, args.profile_steps), smoke=args.smoke,
+            seed=args.seed, out_dir=os.path.dirname(out_path) or ".")
+        print(json.dumps({
+            "metric": "serving_stepprofile_coverage",
+            "value": artifact["region_coverage"],
+            "unit": "fraction of decode-step device time attributed to "
+                    "named regions",
+            "region_share_kv_gather": artifact["region_share_kv_gather"],
+            "region_share_attention": artifact["region_share_attention"],
+            "region_share_mlp": artifact["region_share_mlp"],
+            "region_share_sampling": artifact["region_share_sampling"],
+            "telemetry_invariants_ok": all(
+                v["token_identical"] and v["programs_equal"]
+                for v in artifact["telemetry_invariants"].values()),
             "within_budget": artifact["within_budget"],
             "artifact": artifact["artifact"],
         }))

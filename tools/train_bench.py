@@ -190,6 +190,77 @@ def _run_phase(on_tpu: bool, *, steps: int, warmup: int, depth: int,
     }
 
 
+def run_region_breakdown(on_tpu: bool, steps: int = 4) -> dict:
+    """In-step device-time attribution for the compiled TrainStep.
+
+    Captures a device trace around ``steps`` live train iterations and
+    attributes the program's measured device time to the named regions
+    annotating ``TrainStep._step`` — the ``forward``/``backward``/
+    ``optimizer`` phase groups, with the model-body leaf regions
+    (embed/attention/mlp/logits) nested under forward/backward."""
+    from paddle_tpu.jit.api import TrainStep
+    from paddle_tpu.observability.program_inventory import (
+        get_program_inventory,
+    )
+    from paddle_tpu.observability.step_profile import (
+        StepProfiler,
+        parse_hlo_instruction_bytes,
+        parse_hlo_instruction_regions,
+    )
+
+    model, loss_fn, optimizer, cfg, batch, seqlen = _build(on_tpu)
+    step = TrainStep(model, loss_fn, optimizer, nonblocking=True)
+    batches = list(SyntheticBatches(2 + steps, batch, seqlen,
+                                    cfg.vocab_size, host_work=0))
+    for x, y in batches[:2]:              # compile + settle
+        step(x, y).loss_value()
+
+    inv = get_program_inventory()
+
+    def programs():
+        rows = []
+        entries = inv.entries(kind="train_step")
+        for e in entries:
+            hlo = inv.hlo_text(e)
+            if not hlo:
+                continue
+            module, regions = parse_hlo_instruction_regions(hlo)
+            row = {"name": e.name, "module": module, "regions": regions,
+                   "nbytes": parse_hlo_instruction_bytes(hlo)}
+            an = inv.analyze(e)
+            if "flops" in an:
+                row["flops"] = an["flops"]
+                row["bytes_accessed"] = an["bytes_accessed"]
+            if e is entries[-1]:
+                row["primary"] = True
+                rows.insert(0, row)
+            else:
+                rows.append(row)
+        return rows
+
+    state = {"i": 0}
+
+    def one_step():
+        x, y = batches[2 + state["i"] % steps]
+        state["i"] += 1
+        step(x, y).loss_value()
+
+    summary = StepProfiler(one_step, programs).capture(steps=steps)
+    groups = summary.get("group_shares", {})
+    return {
+        "enabled": bool(summary.get("enabled")),
+        "error": summary.get("error"),
+        "coverage": summary.get("coverage", 0.0),
+        "region_shares": summary.get("region_shares", {}),
+        "group_shares": groups,
+        "region_share_forward": groups.get("forward", 0.0),
+        "region_share_backward": groups.get("backward", 0.0),
+        "region_share_optimizer": groups.get("optimizer", 0.0),
+        "aux_modules": summary.get("aux_modules", {}),
+        "roofline": summary.get("decode_roofline"),
+    }
+
+
 def run_bench(on_tpu: bool = False, steps: int = 20, warmup: int = 3,
               depth: int = 2, host_work: int = 2,
               io_latency_s: float = 0.004, smoke: bool = False,
@@ -203,6 +274,7 @@ def run_bench(on_tpu: bool = False, steps: int = 20, warmup: int = 3,
     ratio = hot["steps_per_s"] / baseline["steps_per_s"]
     identical = baseline.pop("losses") == hot.pop("losses")
     input_stall_frac = hot["input_stall_s"] / max(hot["wall_s"], 1e-9)
+    profile = run_region_breakdown(on_tpu)
     art = {
         "bench": "train_hotpath",
         "mode": "smoke" if smoke else ("tpu" if on_tpu else "cpu"),
@@ -220,6 +292,12 @@ def run_bench(on_tpu: bool = False, steps: int = 20, warmup: int = 3,
         "train_bandwidth_util": hot["train_bandwidth_util"],
         "losses_bit_identical": identical,
         "ratio_ok": ratio >= RATIO_NOISE_FLOOR,
+        # in-step device-time attribution of the compiled TrainStep
+        "region_profile": profile,
+        "region_coverage": profile["coverage"],
+        "region_share_forward": profile["region_share_forward"],
+        "region_share_backward": profile["region_share_backward"],
+        "region_share_optimizer": profile["region_share_optimizer"],
     }
     if out_path:
         from tools.bench_io import write_bench_json
@@ -239,6 +317,11 @@ def run_bench(on_tpu: bool = False, steps: int = 20, warmup: int = 3,
         mfu = art["train_mfu"]
         assert mfu is not None and 0.0 < mfu <= 1.0, (
             f"train_mfu must be attributable and in (0, 1]: {mfu}")
+        if profile["enabled"]:
+            for g in ("forward", "backward", "optimizer"):
+                assert profile["group_shares"].get(g, 0.0) > 0.0, (
+                    f"train step profile missing the {g!r} phase: "
+                    f"{profile['group_shares']}")
     return art
 
 
