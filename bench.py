@@ -907,6 +907,11 @@ def bench_serving_stepprofile(on_tpu):
         assert art["region_share_%s" % r] > 0, (
             "region %r missing from the decode attribution: %s"
             % (r, art["region_shares"]))
+    for r in ("prefill_chunk", "spec_verify"):
+        assert art["region_share_%s" % r] > 0, (
+            "region %r missing from the chunked+spec capture: %s"
+            % (r, art["spec_capture"]))
+    assert art["spec_capture"]["region_coverage"] >= 0.9, art["spec_capture"]
     assert not art["capture_compiled_programs"], (
         "capture_step_profile grew the compiled-program count")
     inv = art["telemetry_invariants"]
@@ -922,6 +927,70 @@ def bench_serving_stepprofile(on_tpu):
         "region_share_attention": art["region_share_attention"],
         "region_share_mlp": art["region_share_mlp"],
         "region_share_sampling": art["region_share_sampling"],
+        "within_budget": art["within_budget"],
+    }))
+
+
+def bench_serving_chunked(on_tpu):
+    """Chunked prefill (tools/serve_bench.run_chunked_suite): the same
+    seeded prefill-storm workload run unchunked, chunked, and
+    chunked+speculative. Asserts all three token streams bit-identical,
+    zero steady-state recompiles with the features on, and the decoder
+    cohort's inter-token gap tail (max or p95) cut by chunking — the
+    prefill bubble bounded by the chunk width instead of the longest
+    admitted prompt. CPU-sized; the artifact is
+    BENCH_serving_chunked.json."""
+    import sys
+
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from tools.serve_bench import run_chunked_suite
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    art = run_chunked_suite(chunk_size=16, smoke=True, out_dir=here)
+    assert art["token_identical"], (
+        "chunked/spec token streams diverged from the unchunked baseline")
+    assert art["steady_state_recompiles"] == 0, art["chunked"][
+        "compile_stats"]
+    assert art["within_budget"], art
+    print(json.dumps({
+        "metric": "serving_chunked_gap_max_cut",
+        "value": art["decoder_gap_max_cut_x"],
+        "unit": "x reduction of the decoder cohort's worst inter-token "
+                "gap under a prefill storm, chunked vs unchunked",
+        "gap_p95_cut_x": art["decoder_gap_p95_cut_x"],
+        "token_identical": art["token_identical"],
+        "within_budget": art["within_budget"],
+    }))
+
+
+def bench_serving_spec(on_tpu):
+    """Speculative decoding (tools/serve_bench.run_spec_suite): the
+    n-gram self-speculation accept-rate sweep over draft depths on a
+    repetitive-continuation workload. Asserts every depth's token stream
+    is bit-identical to the autoregressive baseline, tokens per verify
+    step > 1 at the best depth (the decode critical path batched), and
+    zero steady-state recompiles. CPU-sized; the artifact is
+    BENCH_serving_spec.json."""
+    import sys
+
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from tools.serve_bench import run_spec_suite
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    art = run_spec_suite(spec_ks=(2, 4), smoke=True, out_dir=here)
+    assert art["token_identical"], (
+        "speculative token streams diverged from the autoregressive "
+        "baseline")
+    assert art["tokens_per_step"] > 1.0, art["sweep"]
+    assert art["steady_state_recompiles"] == 0, art["sweep"]
+    assert art["within_budget"], art
+    print(json.dumps({
+        "metric": "serving_spec_tokens_per_step",
+        "value": art["tokens_per_step"],
+        "unit": "tokens per verify step at best draft depth "
+                "k=%d" % art["best_k"],
+        "spec_accept_rate": art["spec_accept_rate"],
+        "step_cut_x": art["step_cut_x"],
         "within_budget": art["within_budget"],
     }))
 
@@ -1225,6 +1294,8 @@ for _f in (bench_chip_ceilings, bench_resnet50, bench_bert, bench_ernie,
            bench_serving_router,
            bench_serving_fleet_trace,
            bench_serving_stepprofile,
+           bench_serving_chunked,
+           bench_serving_spec,
            bench_serving_sharded,
            bench_ckpt,
            bench_train,

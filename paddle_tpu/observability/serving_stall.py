@@ -23,6 +23,9 @@ MEASUREMENT first, so that refactor's win is provable rather than asserted.
                           The compiled-step invocation is excluded: it is
                           compute dispatch, not host scheduling (the same
                           rule that keeps prefill out of the family)
+    * ``spec_propose``    host-side draft-token proposal (the n-gram
+                          suffix match over each slot's committed
+                          context) ahead of a speculative verify step
 
   The async engine's background drain thread meters its own device wait
   separately as ``serving_drain_wait_seconds`` (``record("drain", s)``):
@@ -63,7 +66,7 @@ __all__ = [
 ]
 
 STALL_PHASES = ("admission", "radix_match", "block_accounting", "streaming",
-                "sampling_sync", "dispatch")
+                "sampling_sync", "dispatch", "spec_propose")
 
 _STALL = "host_stall_seconds"
 _DRAIN = "drain_wait_seconds"

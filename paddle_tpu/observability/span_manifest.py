@@ -38,6 +38,7 @@ SPAN_MANIFEST = {
     "serving.prefill": {"owner": "serving", "category": "Forward"},
     "serving.decode_step": {"owner": "serving", "category": "Forward"},
     "serving.preempt": {"owner": "serving", "category": "UserDefined"},
+    "serving.spec_propose": {"owner": "serving", "category": "UserDefined"},
     "serving.prefix_match": {"owner": "serving", "category": "UserDefined"},
     "serving.reload_weights": {"owner": "serving",
                                "category": "UserDefined"},

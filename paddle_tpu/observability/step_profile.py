@@ -78,6 +78,9 @@ REGION_MANIFEST = {
     "logits": {"owner": "models", "category": "Forward"},
     "sampling": {"owner": "serving", "category": "Forward"},
     "telemetry": {"owner": "serving", "category": "UserDefined"},
+    # chunked prefill + speculative decoding (serving/spec/)
+    "prefill_chunk": {"owner": "serving", "category": "Forward"},
+    "spec_verify": {"owner": "serving", "category": "Forward"},
     # tensor-parallel layout seams (all-gather / psum boundaries)
     "tp_gather": {"owner": "serving", "category": "Forward"},
     # train step phases (TrainStep._step)

@@ -75,6 +75,22 @@ class SchedulerConfig:
     # stay bit-identical to depth 0 (pinned in tests), only streaming
     # callbacks and finish notifications land up to N steps later.
     dispatch_depth: int = 0
+    # ---- latency subsystem (serving/spec/): chunked prefill + speculative
+    # decoding. ``prefill_chunk_size`` > 0 splits every admission prefill
+    # into fixed-width [1, C] chunks run from the decode loop (at most
+    # ``prefill_chunks_per_step`` per iteration) so long prompts stop
+    # head-of-line-blocking in-flight decodes; the chunk offset is data,
+    # not a shape — one compiled chunk program, zero steady-state
+    # recompiles. ``spec_k`` > 0 turns each decode iteration into one
+    # [S, 1+k] verification step over n-gram-proposed draft tokens with
+    # in-program rejection sampling (tokens/step > 1 at any positive
+    # accept rate). Both are greedy-only (temperature == 0, validated at
+    # scheduler construction) and token-identical to the plain engine.
+    prefill_chunk_size: int = 0       # 0 = whole-prompt prefill (off)
+    prefill_chunks_per_step: int = 1  # chunk budget per scheduler step
+    spec_k: int = 0                   # draft tokens per step; 0 = off
+    spec_ngram_max: int = 3           # longest suffix n-gram matched
+    spec_ngram_min: int = 1
     # ---- observability (request-lifecycle tracing, SLO, flight recorder).
     # Tracing is host-side bookkeeping only: the token stream is identical
     # on vs off (pinned in tests) and the overhead is held <5%.
@@ -196,6 +212,19 @@ class Request:
     slot: int = -1
     deadline_s: Optional[float] = None  # wall budget from arrival; None=∞
     consecutive_faults: int = 0       # step faults since last clean step
+    # chunked-prefill frontier: tokens of ``resume_ids`` whose KV is
+    # already written (prefix-cache hit + completed chunks). -1 = not
+    # mid-prefill. Host data only — preemption resets it (resume is a
+    # clean re-prefill, which may re-hit the donated chunk KV) and
+    # ``export_restartable`` ships it as forensic context.
+    prefill_pos: int = -1
+
+    @property
+    def is_prefilling(self) -> bool:
+        """True while admitted but not fully prefilled (chunked admission):
+        the slot holds blocks and a growing KV prefix but must not join a
+        decode dispatch yet."""
+        return self.prefill_pos >= 0
 
     @property
     def done(self) -> bool:

@@ -124,6 +124,11 @@ from paddle_tpu.serving.request import (
     SchedulerConfig,
     SchedulerOverloaded,
 )
+from paddle_tpu.serving.spec import (
+    ChunkPrefillStep,
+    NgramProposer,
+    SpecVerifyStep,
+)
 
 
 class _InFlight:
@@ -216,6 +221,39 @@ class ContinuousBatchingScheduler:
             self._step_fn = SlotStep(model, temperature=cfg.temperature,
                                      top_k=cfg.top_k, donate=self._donate,
                                      telemetry=cfg.enable_step_telemetry)
+        # ---- latency subsystem (serving/spec/): chunked prefill +
+        # speculative decoding. Both steps wrap self._step_fn's
+        # ``_model_call`` seam, so a sharded step chunks/verifies under
+        # its mesh unchanged; each owns its own jit cache, folded into
+        # num_programs()/mark_steady()/compile_stats() below.
+        self._chunk_size = 0
+        self._chunk_step: Optional[ChunkPrefillStep] = None
+        self._spec_step: Optional[SpecVerifyStep] = None
+        self._proposer = None
+        if cfg.prefill_chunk_size or cfg.spec_k:
+            if cfg.temperature > 0:
+                raise ValueError(
+                    "chunked prefill / speculative decoding are greedy-only "
+                    "(temperature == 0): speculative acceptance compares "
+                    "drafts against the model's argmax, and a chunked "
+                    "prefill must sample once per admission, not per chunk")
+            if cfg.prefill_chunk_size:
+                self._chunk_size = min(
+                    _bucket(max(int(cfg.prefill_chunk_size), 1),
+                            cfg.prefill_bucket),
+                    self.max_seq_len)
+                self._chunk_step = ChunkPrefillStep(self._step_fn,
+                                                    donate=self._donate)
+            if cfg.spec_k:
+                self._spec_step = SpecVerifyStep(self._step_fn,
+                                                 donate=self._donate)
+                self._proposer = NgramProposer(max_n=cfg.spec_ngram_max,
+                                               min_n=cfg.spec_ngram_min)
+        self._step_chunked_tokens = 0    # chunk pump tokens, per step
+        self._spec_steps = 0             # verify-step accounting
+        self._spec_proposed = 0
+        self._spec_accepted = 0
+        self._spec_emitted = 0
         if cfg.enable_prefix_caching:
             # sharing-aware pool + radix tree: admissions match cached
             # prefixes and prefill only the uncached suffix
@@ -516,6 +554,7 @@ class ContinuousBatchingScheduler:
         self.allocator.free(req.blocks)
         req.blocks = []
         req.slot = -1
+        req.prefill_pos = -1
         self._slots[slot] = None
         self._table[slot] = -1
         self._pos[slot] = 0
@@ -658,6 +697,10 @@ class ContinuousBatchingScheduler:
             self.allocator.free(req.blocks)
             req.blocks = []
             req.slot = -1
+            # a mid-prefill victim resumes via a clean chunked re-prefill;
+            # its completed-chunk KV was just donated to the radix tree,
+            # so the resume's prefix match recovers the frontier for free
+            req.prefill_pos = -1
             req.num_preemptions += 1
             req.state = RequestState.PREEMPTED
             self._slots[slot] = None
@@ -678,11 +721,16 @@ class ContinuousBatchingScheduler:
 
     @hot_path(reason="runs per decode iteration under block_accounting")
     @holds_lock("_elock")
-    def _ensure_decode_capacity(self, slot: int) -> bool:
-        """Guarantee the slot can write one more token (at its DISPATCHED
+    def _ensure_decode_capacity(self, slot: int, tokens: int = 1) -> bool:
+        """Guarantee the slot can write ``tokens`` more (at its DISPATCHED
         position — capacity must cover in-flight speculation); preempt
         other sequences (or finally the slot itself) when the pool is dry.
-        False = the slot itself was evicted."""
+        ``tokens`` > 1 is the speculative-verify case (the carry token
+        plus k drafts write in one call), clamped to the block-table
+        row's capacity — overflow writes drop in-kernel and only ever
+        carry tokens the commit clamps away. False = the slot itself was
+        evicted."""
+        cap = self.config.max_blocks_per_seq * self.config.block_size
         req = self._slots[slot]
         while True:
             if req is None or self._slots[slot] is not req:
@@ -692,8 +740,10 @@ class ContinuousBatchingScheduler:
                 # extend() is idempotent for a given pos, so a fault here
                 # (absorbed by the decode retry loop) re-runs cleanly
                 inject("serving.block_alloc")
+                add = max(1, min(int(tokens),
+                                 cap - int(self._disp_pos[slot])))
                 self.allocator.extend(req.blocks,
-                                      int(self._disp_pos[slot]), 1)
+                                      int(self._disp_pos[slot]), add)
                 for j in range(before, len(req.blocks)):
                     self._table[slot, j] = req.blocks[j]
                 return True
@@ -825,12 +875,38 @@ class ContinuousBatchingScheduler:
             req.slot = slot
             req.state = RequestState.RUNNING
             S = P - matched                  # uncached suffix to prefill
-            Pb = min(_bucket(S, self.config.prefill_bucket), self.max_seq_len)
-            ids_np = np.zeros((1, Pb), np.int32)
-            ids_np[0, :S] = ids[matched:]
             row = np.full((1, self.config.max_blocks_per_seq), -1, np.int32)
             row[0, :len(blocks)] = blocks
             block_s += pc() - t0
+            if self._chunk_step is not None:
+                # chunked admission: pack the slot MID-PREFILL (frontier =
+                # the prefix-cache hit) and return — the chunk pump
+                # advances it from the decode loop, bounded per step.
+                # Until the final chunk samples the first token the slot
+                # is excluded from decode dispatch and its table row is
+                # masked, so no decode write can land inside an
+                # incomplete prefill.
+                self._slots[slot] = req
+                self._table[slot] = row[0]
+                self._pos[slot] = matched
+                self._disp_pos[slot] = matched
+                self._disp_emitted[slot] = req.num_generated
+                self._next_tok[slot] = 0
+                req.prefill_pos = matched
+                if self.prefix_cache is not None:
+                    self.prefix_cache.record_admission(matched, S)
+                if trace is not None:
+                    trace.note(cached_tokens=matched, prefilled_tokens=S,
+                               chunk_size=self._chunk_size)
+                    trace.subspan("prefix_match", radix_s)
+                self.stall.record("radix_match", radix_s)
+                self.stall.record("block_accounting", block_s)
+                self.stall.record(
+                    "admission", (pc() - it_t0) - radix_s - block_s)
+                continue
+            Pb = min(_bucket(S, self.config.prefill_bucket), self.max_seq_len)
+            ids_np = np.zeros((1, Pb), np.int32)
+            ids_np[0, :S] = ids[matched:]
             t0 = pc()
             try:
                 inject("serving.prefill")
@@ -938,6 +1014,144 @@ class ContinuousBatchingScheduler:
                 - prefill_s - dispatch_s)
         return finished
 
+    @hot_path(reason="bounded per-step prefill work fused into the decode "
+                     "loop — the chunk budget IS the TPOT protection")
+    @holds_lock("_elock")
+    def _prefill_chunks(self) -> List[Request]:
+        """Advance mid-prefill slots by at most ``prefill_chunks_per_step``
+        fixed-width ``[1, C]`` chunks (FCFS: lowest request id first, so
+        one prefill finishes before the next starts). The chunk offset is
+        data (cache ``pos`` + absolute position ids) — one compiled chunk
+        program serves every offset. Non-final chunks discard their
+        sampled id without a host sync; the final chunk's token follows
+        the admission first-token path (sync fetch at depth 0, carry
+        splice + drain commit at depth > 0) and the request transitions
+        to RUNNING."""
+        finished: List[Request] = []
+        if self._chunk_step is None:
+            return finished
+        C = self._chunk_size
+        budget = max(1, int(self.config.prefill_chunks_per_step))
+        pc = _time.perf_counter
+        while budget > 0:
+            cand = [(r.request_id, s) for s, r in enumerate(self._slots)
+                    if r is not None and r.is_prefilling]
+            if not cand:
+                return finished
+            slot = min(cand)[1]
+            req = self._slots[slot]
+            trace = self.tracer.get(req.request_id)
+            ids = req.resume_ids
+            P = len(ids)
+            off = int(req.prefill_pos)
+            n = min(C, P - off)
+            final = off + n >= P
+            ids_np = np.zeros((1, C), np.int32)
+            ids_np[0, :n] = ids[off:off + n]
+            row = self._table[slot:slot + 1].copy()
+            posv = np.array([off], np.int32)
+            t0 = pc()
+            try:
+                inject("serving.prefill")
+                with RecordEvent("serving.prefill"), paddle.no_grad():
+                    if self._donate:
+                        caches = [PagedCacheSlot(
+                            kp, vp, paddle.to_tensor(row),
+                            paddle.to_tensor(posv))
+                            for kp, vp in self._pools]
+                    else:
+                        rt = paddle.to_tensor(row)
+                        mt = paddle.to_tensor(posv)
+                        caches = [PagedCacheSlot(kp, vp, rt, mt)
+                                  for kp, vp in self._pools]
+                    next_ids, caches = self._chunk_step(
+                        paddle.to_tensor(ids_np),
+                        paddle.to_tensor(np.arange(off, off + C,
+                                                   dtype=np.int32)),
+                        caches,
+                        paddle.to_tensor(np.array([n - 1], np.int32)))
+                    self._store_pools(caches)
+            except Exception as exc:
+                site = self._fault_site(exc, "serving.prefill")
+                if classify_error(exc) == "fatal":
+                    self.metrics.observe_fault(site, "fatal")
+                    raise
+                self._note_fault(site)
+                # release the slot for a clean re-prefill (or terminal
+                # fail). Completed-chunk KV is donated to the radix tree
+                # first, so the retry's prefix match can recover the
+                # frontier instead of recomputing it.
+                self._cache_insert_on_release(req, slot)
+                self.allocator.free(req.blocks)
+                req.blocks = []
+                req.slot = -1
+                req.prefill_pos = -1
+                self._slots[slot] = None
+                self._table[slot] = -1
+                self._pos[slot] = 0
+                self._next_tok[slot] = 0
+                self._disp_pos[slot] = 0
+                self._disp_emitted[slot] = 0
+                if self._fault_budget_exhausted(req):
+                    self.metrics.observe_fault(site, "request_failed")
+                    self.metrics.requests_failed += 1
+                    finished.append(self._finalize_off_grid(req, "failed"))
+                elif not req.done:
+                    self.queue.push(req, force=True)
+                    if trace is not None:
+                        trace.transition(PHASE_QUEUED)
+                        trace.event("prefill_fault", site=site,
+                                    consecutive=req.consecutive_faults)
+                budget -= 1
+                continue
+            chunk_s = pc() - t0
+            self.metrics.prefill_tokens += n
+            self._step_chunked_tokens += n
+            req.prefill_pos = off + n
+            self._pos[slot] = off + n
+            self._disp_pos[slot] = off + n
+            if trace is not None:
+                # per-chunk events keep TTFT attribution truthful when a
+                # prefill spans several scheduler steps
+                trace.event("prefill_chunk", offset=off, size=n)
+                trace.subspan("prefill", chunk_s)
+            budget -= 1
+            if not final:
+                continue
+            # final chunk: the request leaves the prefilling state and its
+            # sampled token is the first output — same contract as the
+            # whole-prompt admission prefill
+            req.prefill_pos = -1
+            req.consecutive_faults = 0
+            self.metrics.prefills += 1
+            self._disp_emitted[slot] = req.num_generated + 1
+            if trace is not None:
+                trace.transition(PHASE_RUNNING)
+            if self.dispatch_depth and self._spec_step is None:
+                t0 = pc()
+                self._splice_admit(slot, next_ids)
+                self._enqueue(_InFlight("admit", next_ids, [(slot, req)]))
+                dispatch_s = pc() - t0
+                self.stall.record("dispatch", dispatch_s)
+                if trace is not None:
+                    trace.subspan("dispatch", dispatch_s)
+            else:
+                arr, _stats_np, sync_s = self._fetch_tokens(next_ids)
+                if trace is not None:
+                    trace.subspan("sampling_sync", sync_s)
+                tok = int(arr[0])
+                self._next_tok[slot] = tok
+                t0 = pc()
+                req.emit(tok)
+                self.stall.record("streaming", pc() - t0)
+                self._events.append((req.request_id, tok))
+                self.metrics.generated_tokens += 1
+                if req.eos_token_id is not None and tok == req.eos_token_id:
+                    finished.append(self._retire(slot, "eos"))
+                elif req.num_generated >= req.max_new_tokens:
+                    finished.append(self._retire(slot, "length"))
+        return finished
+
     @holds_lock("_elock")
     def _absorb_step_fault(self, exc: BaseException, running: List[int],
                            attempt: int) -> List[Request]:
@@ -1039,24 +1253,195 @@ class ContinuousBatchingScheduler:
         finished += self._commit_decode(pairs, arr, metered=True)
         return finished
 
+    # ---- speculative decoding (serving/spec/) --------------------------
+
+    @hot_path(reason="the speculative decode iteration: one [S, 1+k] "
+                     "verify call commits up to k+1 tokens per slot")
+    @holds_lock("_elock")
+    def _spec_decode_once(self) -> List[Request]:
+        """One speculative decode iteration: host proposals (n-gram
+        suffix match over each slot's committed context), ONE batched
+        ``[S, 1+k]`` verify dispatch, one token fetch (greedy rows +
+        in-program accept counts ride the same ``[S, k+2]`` read — zero
+        extra host syncs), bulk commit of each slot's accepted prefix
+        plus the model's bonus token.
+
+        Speculation's accepted length is DATA the next step's positions
+        depend on, so the verify path is synchronous at every
+        ``dispatch_depth``: in-flight async work (admission first tokens)
+        drains first, and the carry is dropped after commit — the token
+        streams stay bit-identical to the plain engine at depth 0 and >0
+        alike. Steps where no slot has a proposal fall back to the plain
+        ``[S, 1]`` decode program (both programs are warmed and pinned)."""
+        finished: List[Request] = []
+        if self._inflight:
+            self._drain_all()
+        k = int(self.config.spec_k)
+        S = self.config.max_num_seqs
+        attempt = 0
+        while True:
+            pairs = self._live_pairs()
+            if not pairs:
+                return finished
+            props = np.zeros((S, k), np.int32)
+            plen = np.zeros(S, np.int32)
+            with self.stall.timed("spec_propose"), \
+                    RecordEvent("serving.spec_propose"):
+                for s, req in pairs:
+                    p = self._proposer.propose(req.resume_ids, k)
+                    if p is not None and len(p):
+                        props[s, :len(p)] = p
+                        plen[s] = len(p)
+                        self._spec_proposed += len(p)
+            if not plen.any():
+                # nothing proposed anywhere: a k-wide verify would be
+                # pure overhead — run the plain decode program instead
+                out = finished + self._decode_once()
+                self._carry = None
+                return out
+            try:
+                with self.stall.timed("block_accounting"):
+                    for s, req in pairs:
+                        if self._slots[s] is not req:
+                            continue
+                        self._ensure_decode_capacity(s, tokens=k + 1)
+                    pairs = self._live_pairs()
+                if not pairs:
+                    return finished
+                t_disp = _time.perf_counter()
+                out_dev = self._dispatch_spec(props)
+                arr, _stats_np, _sync_s = self._fetch_tokens(out_dev)
+                if self._device_time is not None:
+                    self._device_time.observe(t_disp, _time.perf_counter())
+            except Exception as exc:
+                finished += self._absorb_step_fault(
+                    exc, [s for s, _r in pairs], attempt)
+                attempt += 1
+                continue
+            break
+        self.metrics.decode_steps += 1
+        self._spec_steps += 1
+        finished += self._commit_spec(pairs, arr, plen)
+        # committed state is complete and exact — rebuild the next
+        # dispatch's inputs from host state rather than the carry
+        self._carry = None
+        return finished
+
+    @hot_path(reason="stages one [S, 1+k] verify step on device")
+    @holds_lock("_elock")
+    def _dispatch_spec(self, props: np.ndarray):
+        """Dispatch ONE fixed-shape verification step: ids[:, 0] is each
+        slot's committed carry token, ids[:, 1:] the (padded) drafts, at
+        absolute positions ``disp_pos .. disp_pos+k`` (clamped to the
+        window — tail positions past it belong to rejected drafts whose
+        tokens the commit clamps away, and their KV writes drop
+        in-kernel). Mid-prefill and frozen slots keep their masked table
+        rows, so speculation never writes into them."""
+        S, k = self.config.max_num_seqs, int(self.config.spec_k)
+        inject("serving.decode_step")
+        with RecordEvent("serving.decode_step"), paddle.no_grad():
+            ids = np.zeros((S, k + 1), np.int32)
+            ids[:, 0] = self._next_tok
+            ids[:, 1:] = props
+            pos = (self._disp_pos[:, None]
+                   + np.arange(k + 1, dtype=np.int32)[None, :])
+            np.clip(pos, 0, self.max_seq_len - 1, out=pos)
+            caches = self._caches(self._disp_table(), self._disp_pos.copy())
+            out, caches = self._spec_step(
+                paddle.to_tensor(ids),
+                paddle.to_tensor(pos.astype(np.int32)), caches)
+            self._store_pools(caches)
+        return out
+
+    @holds_lock("_elock")
+    def _commit_spec(self, pairs, arr, plen) -> List[Request]:
+        """Commit one verify step: ``arr`` is the fetched ``[S, k+2]``
+        block (greedy tokens ``g_0..g_k``, then the device accept count).
+        Each slot emits its accepted prefix plus the model's own next
+        token — ``e = min(accept+1, proposal_len+1, remaining budget)``,
+        truncated at EOS — so every emitted token is the model's argmax
+        given the tokens before it: exactly the autoregressive stream.
+        The committed and dispatched views advance together (the verify
+        path is synchronous), and the last emitted token becomes the next
+        step's carry token."""
+        k = int(self.config.spec_k)
+        pc = _time.perf_counter
+        stream_s = 0.0
+        done: List[Request] = []
+        for s, req in pairs:
+            if self._slots[s] is not req or req.done:
+                continue                 # retired/cancelled: stale
+            req.consecutive_faults = 0
+            g = arr[s, :k + 1]
+            accept = min(int(arr[s, k + 1]), int(plen[s]))
+            self._spec_accepted += accept
+            e = min(accept + 1, req.max_new_tokens - req.num_generated)
+            emitted = 0
+            retired = False
+            for i in range(e):
+                t = int(g[i])
+                t0 = pc()
+                req.emit(t)
+                stream_s += pc() - t0
+                self._events.append((req.request_id, t))
+                self.metrics.generated_tokens += 1
+                emitted = i + 1
+                if req.eos_token_id is not None and t == req.eos_token_id:
+                    retired = True
+                    break
+            self._spec_emitted += emitted
+            self._pos[s] += emitted      # emitted-1 cached + 1 fed next
+            self._disp_pos[s] = self._pos[s]
+            self._next_tok[s] = int(g[emitted - 1])
+            self._disp_emitted[s] = req.num_generated
+            if retired:
+                done.append(self._retire(s, "eos"))
+            elif req.num_generated >= req.max_new_tokens:
+                done.append(self._retire(s, "length"))
+        self.stall.record("streaming", stream_s)
+        return done
+
+    def spec_stats(self) -> Optional[Dict[str, float]]:
+        """Speculation accounting (None when ``spec_k`` is 0):
+        verify-step count, proposed/accepted draft tokens, the accept
+        rate, and mean emitted tokens per verify step. Overall
+        tokens-per-decode-step (including no-proposal fallback steps) is
+        ``metrics.generated_tokens / metrics.decode_steps``."""
+        if self._spec_step is None:
+            return None
+        return {
+            "verify_steps": self._spec_steps,
+            "proposed_tokens": self._spec_proposed,
+            "accepted_tokens": self._spec_accepted,
+            "accept_rate": (self._spec_accepted / self._spec_proposed
+                            if self._spec_proposed else 0.0),
+            "emitted_tokens": self._spec_emitted,
+            "tokens_per_verify_step": (self._spec_emitted / self._spec_steps
+                                       if self._spec_steps else 0.0),
+        }
+
     # ---- async engine (dispatch-ahead decode) --------------------------
 
     def _live_pairs(self) -> List[Tuple[int, Request]]:
-        """Slots eligible for the next decode dispatch: occupied AND not
+        """Slots eligible for the next decode dispatch: occupied, not
         frozen (a frozen slot already has its full ``max_new_tokens``
         budget in flight — dispatching more would write past the block
-        budget the request was admitted with)."""
+        budget the request was admitted with), and not mid-prefill (a
+        chunked admission's slot must not decode until its final chunk
+        has sampled the first token)."""
         return [(s, r) for s, r in enumerate(self._slots)
-                if r is not None
+                if r is not None and not r.is_prefilling
                 and int(self._disp_emitted[s]) < r.max_new_tokens]
 
     def _disp_table(self) -> np.ndarray:
-        """Block table for the next dispatch: frozen slots get a masked
-        (-1) row — the paged write kernel drops -1-table writes, so their
-        speculative K/V is discarded instead of overrunning the row."""
+        """Block table for the next dispatch: frozen and mid-prefill slots
+        get a masked (-1) row — the paged write kernel drops -1-table
+        writes, so their speculative K/V is discarded instead of
+        overrunning the row (or corrupting a half-built prefill)."""
         frozen = [s for s, r in enumerate(self._slots)
                   if r is not None
-                  and int(self._disp_emitted[s]) >= r.max_new_tokens]
+                  and (r.is_prefilling
+                       or int(self._disp_emitted[s]) >= r.max_new_tokens)]
         if not frozen:
             return self._table
         tbl = self._table.copy()
@@ -1447,6 +1832,11 @@ class ContinuousBatchingScheduler:
             "first_token_t": req.first_token_t,
             "deadline_s": req.deadline_s,
             "num_preemptions": req.num_preemptions,
+            # chunk frontier at export time (-1 unless mid-prefill):
+            # forensic context for the failover — the survivor's replay
+            # re-prefills prompt+prefix from scratch either way, so the
+            # continued stream stays token-identical
+            "prefill_pos": req.prefill_pos,
         }
 
     def import_resumed(self, spec: Dict[str, object], on_token=None) -> int:
@@ -1517,6 +1907,7 @@ class ContinuousBatchingScheduler:
         pre_hit = (self.prefix_cache._hit_tokens
                    if self.prefix_cache is not None else 0)
         self._step_evicted = 0
+        self._step_chunked_tokens = 0
         self._step_faults = {}
         done = self._sweep_expired()
         level = self._apply_degradation()
@@ -1524,11 +1915,22 @@ class ContinuousBatchingScheduler:
             with self._elock:
                 if self.dispatch_depth == 0:
                     done += self._admit()
-                    done += self._decode_once()
+                    done += self._prefill_chunks()
+                    if self._spec_step is not None:
+                        done += self._spec_decode_once()
+                    else:
+                        done += self._decode_once()
                 else:
                     self._raise_drain_exc()
                     done += self._admit()
-                    if not self._decode_dispatch_once() and self._inflight:
+                    done += self._prefill_chunks()
+                    if self._spec_step is not None:
+                        # speculation's accepted length is data the next
+                        # step's positions depend on: the verify path is
+                        # synchronous (it drains in-flight work first)
+                        done += self._spec_decode_once()
+                    elif (not self._decode_dispatch_once()
+                            and self._inflight):
                         # nothing dispatchable but steps still in flight
                         # (workload tail / every slot at its budget):
                         # drain so retires land and run() converges
@@ -1587,6 +1989,9 @@ class ContinuousBatchingScheduler:
         if self.dispatch_depth:
             record["dispatch_depth"] = self.dispatch_depth
             record["in_flight_steps"] = in_flight
+        # chunk-pump split lands ONLY when chunking is on (same rule)
+        if self._chunk_step is not None:
+            record["chunked_tokens"] = self._step_chunked_tokens
         # armed/fired injection state and shed level land in the flight
         # ring ONLY when active — fault-free dumps stay byte-stable
         inj = get_injector()
@@ -1658,9 +2063,20 @@ class ContinuousBatchingScheduler:
         outs = self.run()
         return [outs[r].token_ids for r in rids]
 
+    def _step_fns(self):
+        """Every compiled step this scheduler owns: the slot step, plus
+        the chunk-prefill and spec-verify steps when enabled — recompile
+        accounting and profiling cover all of them."""
+        fns = [self._step_fn]
+        if self._chunk_step is not None:
+            fns.append(self._chunk_step)
+        if self._spec_step is not None:
+            fns.append(self._spec_step)
+        return fns
+
     def num_programs(self):
         """Compiled-program count (recompile accounting for tests)."""
-        return self._step_fn.num_programs()
+        return sum(f.num_programs() for f in self._step_fns())
 
     def prefix_cache_stats(self) -> Optional[Dict[str, object]]:
         """Hit/miss/eviction accounting of the prefix cache (None when
@@ -1820,7 +2236,9 @@ class ContinuousBatchingScheduler:
         the CompileTracker counts it and warns RecompileStorm loudly."""
         from paddle_tpu.observability import get_compile_tracker
 
-        get_compile_tracker().mark_steady(self._step_fn.tracker_name)
+        t = get_compile_tracker()
+        for fn in self._step_fns():
+            t.mark_steady(fn.tracker_name)
 
     def compile_stats(self) -> Dict[str, object]:
         """This scheduler's CompileTracker accounting: total compiles of
@@ -1829,11 +2247,12 @@ class ContinuousBatchingScheduler:
         from paddle_tpu.observability import get_compile_tracker
 
         t = get_compile_tracker()
-        name = self._step_fn.tracker_name
+        names = [fn.tracker_name for fn in self._step_fns()]
         return {
-            "fn": name,
-            "compiles": t.compiles(name),
-            "steady_state_recompiles": t.steady_state_recompiles(name),
+            "fn": names[0] if len(names) == 1 else names,
+            "compiles": sum(t.compiles(n) for n in names),
+            "steady_state_recompiles": sum(
+                t.steady_state_recompiles(n) for n in names),
         }
 
     # ---- device-side observability ------------------------------------
@@ -1970,22 +2389,23 @@ class ContinuousBatchingScheduler:
         inv = get_program_inventory()
         want = f"i32[{self.config.max_num_seqs},1]"
         rows: List[dict] = []
-        for e in inv.entries(name_contains=self._step_fn.tracker_name):
-            hlo = inv.hlo_text(e)
-            if not hlo:
-                continue
-            module, regions = parse_hlo_instruction_regions(hlo)
-            row = {"name": e.name, "module": module, "regions": regions,
-                   "nbytes": parse_hlo_instruction_bytes(hlo)}
-            if want in e.signature:
-                an = inv.analyze(e)
-                if "flops" in an:
-                    row["flops"] = an["flops"]
-                    row["bytes_accessed"] = an["bytes_accessed"]
-                row["primary"] = True
-                rows.insert(0, row)
-            else:
-                rows.append(row)
+        for fn in self._step_fns():
+            for e in inv.entries(name_contains=fn.tracker_name):
+                hlo = inv.hlo_text(e)
+                if not hlo:
+                    continue
+                module, regions = parse_hlo_instruction_regions(hlo)
+                row = {"name": e.name, "module": module, "regions": regions,
+                       "nbytes": parse_hlo_instruction_bytes(hlo)}
+                if fn is self._step_fn and want in e.signature:
+                    an = inv.analyze(e)
+                    if "flops" in an:
+                        row["flops"] = an["flops"]
+                        row["bytes_accessed"] = an["bytes_accessed"]
+                    row["primary"] = True
+                    rows.insert(0, row)
+                else:
+                    rows.append(row)
         return rows
 
     def capture_step_profile(self, steps: int = 8) -> dict:

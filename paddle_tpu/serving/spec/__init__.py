@@ -1,0 +1,51 @@
+"""paddle_tpu.serving.spec — serving latency subsystem (ROADMAP item 2).
+
+Two cooperating levers over the continuous-batching scheduler's ONE
+compiled decode step, both preserving the token-identity oracle and the
+zero-steady-state-recompile invariant:
+
+- **Chunked prefill** (``ChunkPrefillStep``): admission prefills run as a
+  sequence of fixed-width ``[1, C]`` chunks fused into the decode loop —
+  per-iteration prefill work is bounded (``prefill_chunks_per_step``), so
+  one long prompt no longer head-of-line-blocks every in-flight decode.
+  The chunk offset is DATA (cache ``pos`` + absolute position ids), not a
+  shape: one compiled chunk program serves every offset of every prompt.
+  Composes with the prefix cache (only the uncached suffix is chunked)
+  and with preemption/export (a mid-prefill request's chunk frontier is
+  host state — eviction re-queues it and the already-written chunk KV is
+  donated to the radix tree like any other released sequence).
+
+- **Speculative decoding** (``Proposer`` → ``SpecVerifyStep``): a host
+  proposer (default ``NgramProposer``, a prompt+generated suffix matcher;
+  a draft model plugs in through the same protocol) guesses up to ``k``
+  tokens per slot; ONE batched ``[S, 1+k]`` slot-step call scores the
+  carry token plus all drafts, and acceptance (greedy rejection
+  sampling: longest prefix where each draft matches the model's argmax)
+  is computed INSIDE the compiled program next to the existing on-device
+  sampler — the accept counts ride the one existing token fetch, adding
+  zero host syncs. Accepted tokens commit in bulk (> 1 token per decode
+  step at any positive accept rate); outputs stay token-identical to
+  autoregressive decode because every emitted token is the model's own
+  greedy pick.
+
+Both steps wrap the owning ``SlotStep._model_call`` seam, so a sharded
+scheduler (``serving.sharded``) chunks and verifies under the same device
+mesh with no extra plumbing, and both annotate first-class step-profile
+regions (``prefill_chunk`` / ``spec_verify``) for device-time attribution.
+"""
+
+from paddle_tpu.serving.spec.proposer import (  # noqa: F401
+    NgramProposer,
+    Proposer,
+)
+from paddle_tpu.serving.spec.steps import (  # noqa: F401
+    ChunkPrefillStep,
+    SpecVerifyStep,
+)
+
+__all__ = [
+    "ChunkPrefillStep",
+    "NgramProposer",
+    "Proposer",
+    "SpecVerifyStep",
+]

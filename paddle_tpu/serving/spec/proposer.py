@@ -1,0 +1,76 @@
+"""Draft-token proposers for speculative decoding.
+
+A ``Proposer`` is the pluggable host-side half of the subsystem: given a
+request's committed token context it guesses up to ``k`` next tokens; the
+compiled ``SpecVerifyStep`` then scores every guess in one batched call.
+Proposals are pure speculation — a wrong guess costs one wasted logit
+row, never a wrong output token — so proposers are free to be cheap and
+heuristic. ``NgramProposer`` is the self-speculation default (no draft
+model, no extra device work); a learned draft model drops in behind the
+same ``propose`` signature.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Protocol, runtime_checkable
+
+import numpy as np
+
+__all__ = ["NgramProposer", "Proposer"]
+
+
+@runtime_checkable
+class Proposer(Protocol):
+    """Protocol for draft-token sources.
+
+    ``context`` is the request's committed ``prompt + generated`` token
+    ids (1-D int array, oldest first); return up to ``k`` proposed next
+    tokens (1-D int array) or ``None`` when there is nothing worth
+    proposing. Returning fewer than ``k`` tokens is fine — the scheduler
+    pads the verify call and clamps acceptance to the proposal length."""
+
+    def propose(self, context: np.ndarray, k: int) -> Optional[np.ndarray]:
+        ...
+
+
+class NgramProposer:
+    """Prompt+generated suffix matcher (n-gram self-speculation).
+
+    Finds the most recent earlier occurrence of the longest suffix
+    n-gram (``max_n`` down to ``min_n``) of the context and proposes the
+    tokens that followed it — the classic lookahead heuristic that turns
+    repetitive continuations (code, structured text, greedy loops) into
+    multi-token decode steps. Pure host-side numpy over a context that is
+    already host-resident; no device work, no state."""
+
+    def __init__(self, max_n: int = 3, min_n: int = 1):
+        if min_n < 1 or max_n < min_n:
+            raise ValueError(
+                f"need 1 <= min_n <= max_n, got min_n={min_n} "
+                f"max_n={max_n}")
+        self.max_n = int(max_n)
+        self.min_n = int(min_n)
+
+    def propose(self, context: np.ndarray, k: int) -> Optional[np.ndarray]:
+        ctx = np.asarray(context).reshape(-1)
+        L = len(ctx)
+        if k < 1 or L < self.min_n + 1:
+            return None
+        for n in range(min(self.max_n, L - 1), self.min_n - 1, -1):
+            suffix = ctx[L - n:]
+            # candidate start positions of an earlier (proper) occurrence:
+            # the match must end before the context does, so at least one
+            # follower token exists to propose
+            starts = np.arange(L - n)
+            if len(starts) == 0:
+                continue
+            windows = ctx[starts[:, None] + np.arange(n)[None, :]]
+            hits = np.flatnonzero((windows == suffix).all(axis=1))
+            if len(hits) == 0:
+                continue
+            follow = int(hits[-1]) + n      # most recent occurrence wins
+            out = ctx[follow:follow + k]
+            if len(out) == 0:
+                continue
+            return out.astype(np.int64)
+        return None

@@ -80,6 +80,8 @@ _HIGHER_MARKERS = (
     "tokens_per_s", "steps_per_s", "images_per_s", "per_s", "speedup",
     "ratio", "hit_rate", "goodput", "util", "mfu", "tflops", "gbs",
     "recovery_pct", "ceiling", "bandwidth", "coverage",
+    # speculative decoding: acceptance and multi-token decode throughput
+    "accept_rate", "tokens_per_step", "tokens_per_verify_step",
 )
 # in-step region composition: a share shifting between regions is a mix
 # change whose goodness depends on the PR under review, so these leaves

@@ -1492,12 +1492,19 @@ def run_observability_suite(smoke: bool = True, out_dir: str = REPO_ROOT,
 def run_stepprofile_load(steps: int = 6, num_layers: int = 2,
                          max_num_seqs: int = 4, dispatch_depth: int = 0,
                          seed: int = 0, telemetry: bool = True,
-                         decode_tokens: int = 48) -> dict:
+                         decode_tokens: int = 48, chunk_size: int = 0,
+                         spec_k: int = 0, storm: int = 0) -> dict:
     """One seeded serving load held in steady decode while the scheduler's
     StepProfiler captures ``steps`` iterations (``steps=0`` skips the
     capture — the telemetry-invariant conditions). The grid is filled and
     every admission retired BEFORE the capture window so the traced steps
-    are pure decode — the program whose region shares the artifact gates."""
+    are pure decode — the program whose region shares the artifact gates.
+
+    ``chunk_size``/``spec_k`` turn the serving/spec/ subsystem on;
+    ``storm`` injects that many long prompts right before the capture so
+    the traced window contains live ``prefill_chunk`` and ``spec_verify``
+    executions (one slot is kept free for them), with every program shape
+    warmed beforehand so the capture still compiles nothing."""
     import hashlib
 
     import numpy as np
@@ -1510,15 +1517,36 @@ def run_stepprofile_load(steps: int = 6, num_layers: int = 2,
     model = GPTForCausalLM(gpt_tiny(num_layers=num_layers))
     cfg = SchedulerConfig(max_num_seqs=max_num_seqs, max_seq_len=64,
                           block_size=8, dispatch_depth=dispatch_depth,
-                          enable_step_telemetry=telemetry)
+                          enable_step_telemetry=telemetry,
+                          prefill_chunk_size=chunk_size, spec_k=spec_k)
     sched = _track(ContinuousBatchingScheduler(model, cfg))
     rng = np.random.default_rng(seed)
-    prompts = [rng.integers(0, 1000, int(n))
-               for n in rng.integers(4, 12, max_num_seqs)]
-    for p in prompts:
+    if spec_k:
+        # repetitive continuations: the n-gram proposer keeps proposing,
+        # so the capture window is verify steps, not fallback decode
+        pats = [rng.integers(2, 40, 5) for _ in range(max_num_seqs)]
+        prompts = [np.concatenate([p, p]) for p in pats]
+    else:
+        prompts = [rng.integers(0, 1000, int(n))
+                   for n in rng.integers(4, 12, max_num_seqs)]
+    if chunk_size or spec_k:
+        # warm the chunk/fallback/verify programs SEQUENTIALLY (a random
+        # context alone exercises the no-proposal [S,1] fallback; the
+        # repetitive slots below warm the verify grid) so neither the
+        # capture nor the post-capture drain compiles anything
+        sched.add_request(rng.integers(0, 1000, 20), max_new_tokens=4)
+        while sched.has_unfinished():
+            sched.step()
+    n_base = max_num_seqs - 1 if storm else max_num_seqs
+    for p in prompts[:n_base]:
         sched.add_request(p, max_new_tokens=decode_tokens)
     for _ in range(max_num_seqs + 2):     # admit everything: grid full
         sched.step()
+    if storm:
+        # long prompts landing NOW: their chunked prefill runs inside
+        # the captured steps through the spare slot
+        for _ in range(storm):
+            sched.add_request(rng.integers(0, 1000, 48), max_new_tokens=4)
     programs_before = sched.num_programs()
     t0 = time.perf_counter()
     summary = (sched.capture_step_profile(steps=steps)
@@ -1527,6 +1555,7 @@ def run_stepprofile_load(steps: int = 6, num_layers: int = 2,
     while sched.has_unfinished():
         sched.step()
     telemetry_snap = sched.telemetry_snapshot()
+    spec_stats = sched.spec_stats()
     programs_after = sched.num_programs()
     outs = dict(sched._finished)
     digest = hashlib.sha1()
@@ -1538,10 +1567,13 @@ def run_stepprofile_load(steps: int = 6, num_layers: int = 2,
                    "max_num_seqs": max_num_seqs,
                    "dispatch_depth": dispatch_depth, "seed": seed,
                    "telemetry": telemetry,
-                   "decode_tokens": decode_tokens},
+                   "decode_tokens": decode_tokens,
+                   "chunk_size": chunk_size, "spec_k": spec_k,
+                   "storm": storm},
         "capture": summary,
         "capture_s": round(capture_s, 3),
         "telemetry": telemetry_snap,
+        "spec_stats": spec_stats,
         "programs_before_capture": programs_before,
         "programs_after": programs_after,
         "outputs_sha1": digest.hexdigest(),
@@ -1551,6 +1583,9 @@ def run_stepprofile_load(steps: int = 6, num_layers: int = 2,
 # the decode regions the stepprofile artifact promotes to first-class
 # gate fields (bench_compare reports region_share_* leaves)
 STEPPROFILE_GATED_REGIONS = ("kv_gather", "attention", "mlp", "sampling")
+# chunked-prefill / spec-verify regions, gated from the second capture
+# (the one run with the serving/spec/ subsystem on and a storm in-window)
+STEPPROFILE_SPEC_REGIONS = ("prefill_chunk", "spec_verify")
 
 
 def run_stepprofile_suite(steps: int = 6, smoke: bool = True,
@@ -1571,6 +1606,26 @@ def run_stepprofile_suite(steps: int = 6, smoke: bool = True,
                                 seed=seed, telemetry=True)
     summary = base["capture"] or {}
     shares = summary.get("region_shares", {})
+
+    # second capture with chunked prefill + speculative decoding ON and
+    # a prompt storm landing inside the traced window: the new
+    # prefill_chunk / spec_verify regions must attribute first-class
+    spec_base = run_stepprofile_load(steps=steps, num_layers=layers,
+                                     max_num_seqs=2, dispatch_depth=0,
+                                     seed=seed, telemetry=True,
+                                     decode_tokens=24, chunk_size=16,
+                                     spec_k=3, storm=2)
+    spec_sum = spec_base["capture"] or {}
+    spec_shares = spec_sum.get("region_shares", {})
+    spec_groups = spec_sum.get("group_shares", {})
+    # prefill_chunk wraps the whole chunk forward, so its model-internal
+    # ops attribute to nested leaves (attention/mlp/...) under the
+    # prefill_chunk GROUP; the leaf share carries only the chunk's own
+    # ops — first-class means present under either view
+    spec_region = {r: max(spec_shares.get(r, 0.0), spec_groups.get(r, 0.0))
+                   for r in STEPPROFILE_SPEC_REGIONS}
+    spec_capture_compiled = (spec_base["programs_after"]
+                             != spec_base["programs_before_capture"])
 
     invariants = {}
     for depth in (0, 2):
@@ -1603,6 +1658,18 @@ def run_stepprofile_suite(steps: int = 6, smoke: bool = True,
         "region_coverage": summary.get("coverage", 0.0),
         **{f"region_share_{r}": shares.get(r, 0.0)
            for r in STEPPROFILE_GATED_REGIONS},
+        **{f"region_share_{r}": spec_region.get(r, 0.0)
+           for r in STEPPROFILE_SPEC_REGIONS},
+        "spec_capture": {
+            "region_coverage": spec_sum.get("coverage", 0.0),
+            "region_shares": spec_shares,
+            "group_shares": spec_groups,
+            "spec_stats": spec_base["spec_stats"],
+            "capture_enabled": bool(spec_sum.get("enabled")),
+            "capture_error": spec_sum.get("error"),
+            "capture_compiled_programs": spec_capture_compiled,
+            "programs": spec_base["programs_after"],
+        },
         "region_shares": shares,
         "group_shares": summary.get("group_shares", {}),
         "aux_modules": summary.get("aux_modules", {}),
@@ -1620,10 +1687,302 @@ def run_stepprofile_suite(steps: int = 6, smoke: bool = True,
             and summary.get("coverage", 0.0) >= 0.9
             and all(shares.get(r, 0.0) > 0.0
                     for r in STEPPROFILE_GATED_REGIONS)
-            and inv_ok and not capture_compiled),
+            and bool(spec_sum.get("enabled"))
+            and spec_sum.get("coverage", 0.0) >= 0.9
+            and all(spec_region.get(r, 0.0) > 0.0
+                    for r in STEPPROFILE_SPEC_REGIONS)
+            and inv_ok and not capture_compiled
+            and not spec_capture_compiled),
         "completed": True,
     }
     out_path = os.path.join(out_dir, "BENCH_serving_stepprofile.json")
+    write_bench_json(out_path, artifact)
+    artifact["artifact"] = out_path
+    return artifact
+
+
+# ------------------------------------------------------------------------
+# chunked prefill + speculative decoding (paddle_tpu/serving/spec/)
+
+def _run_storm_load(chunk_size: int = 0, spec_k: int = 0,
+                    num_decoders: int = 2, num_storm: int = 3,
+                    storm_prompt_len: int = 96, decode_tokens: int = 48,
+                    num_layers: int = 2, seed: int = 0) -> dict:
+    """One prefill-storm trajectory: ``num_decoders`` short-prompt
+    requests decode continuously while ``num_storm`` long prompts land
+    mid-run through the one spare slot. The decoder cohort's inter-token
+    gap distribution IS the bubble measurement: an unchunked admission
+    prefills a storm prompt in one long compiled call between decode
+    steps (every decoder stalls behind it), a chunked admission amortizes
+    the same work over bounded ``[1, C]`` chunk steps. Every program
+    shape is warmed on a throwaway request pair and ``mark_steady()``
+    pins the rest of the run, so the gaps measure steady-state
+    scheduling — the artifact also records that zero steady-state
+    recompiles happened with the features on."""
+    import hashlib
+
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu.models import GPTForCausalLM, gpt_tiny
+    from paddle_tpu.serving import ContinuousBatchingScheduler, SchedulerConfig
+
+    paddle.seed(7)
+    model = GPTForCausalLM(gpt_tiny(num_layers=num_layers))
+    cfg = SchedulerConfig(max_num_seqs=num_decoders + 1, max_seq_len=128,
+                          block_size=8, prefill_chunk_size=chunk_size,
+                          spec_k=spec_k)
+    sched = _track(ContinuousBatchingScheduler(model, cfg))
+    rng = np.random.default_rng(seed)
+    # repetitive decoder prompts (greedy continuations an n-gram proposer
+    # can predict — the spec_k identity leg exercises real accepts)
+    pat = rng.integers(2, 40, 8)
+    decoders = [np.concatenate([pat, pat]) for _ in range(num_decoders)]
+    storms = [rng.integers(0, 1000, storm_prompt_len)
+              for _ in range(num_storm)]
+
+    # warm every program shape out-of-band, then pin the measured phase
+    # as steady. Sequential on purpose: the random-context request runs
+    # ALONE so its no-proposal steps exercise the [S,1] fallback program
+    # (a concurrent repetitive slot would keep proposals flowing and
+    # leave it cold), then the repetitive one warms the verify grid.
+    sched.add_request(rng.integers(0, 1000, storm_prompt_len),
+                      max_new_tokens=4)
+    while sched.has_unfinished():
+        sched.step()
+    sched.add_request(np.concatenate([pat, pat]), max_new_tokens=6)
+    while sched.has_unfinished():
+        sched.step()
+    sched.mark_steady()
+
+    stamps = {}
+
+    def on_token(rid, tok):
+        stamps.setdefault(rid, []).append(time.perf_counter())
+
+    dec_rids = [sched.add_request(p, max_new_tokens=decode_tokens,
+                                  on_token=on_token) for p in decoders]
+    for _ in range(num_decoders + 3):   # cohort reaches steady decode
+        sched.step()
+    storm_t0 = time.perf_counter()
+    for p in storms:
+        sched.add_request(p, max_new_tokens=4)
+    it = 0
+    while sched.has_unfinished():
+        sched.step()
+        it += 1
+        if it > 100000:
+            raise RuntimeError("storm load did not drain")
+    wall = time.perf_counter() - storm_t0
+    snap = sched.metrics.snapshot()
+    cs = sched.compile_stats()
+    spec = sched.spec_stats()
+    sched.shutdown()
+
+    outs = dict(sched._finished)
+    digest = hashlib.sha1()
+    for rid in sorted(outs):
+        digest.update(np.asarray(outs[rid].token_ids, np.int64).tobytes())
+    # decoder inter-token gaps observed AFTER the storm landed — the
+    # window where an unchunked engine's prefill bubble shows up
+    gaps = []
+    for rid in dec_rids:
+        ts = [t for t in stamps.get(rid, ())]
+        gaps.extend(b - a for a, b in zip(ts, ts[1:]) if b > storm_t0)
+    gaps_ms = sorted(g * 1e3 for g in gaps)
+
+    def pct(p):
+        if not gaps_ms:
+            return None
+        return round(gaps_ms[min(len(gaps_ms) - 1,
+                                 int(p * (len(gaps_ms) - 1)))], 4)
+
+    tpots = [outs[r].tpot_s for r in dec_rids
+             if outs[r].tpot_s is not None]
+    return {
+        "config": {"chunk_size": chunk_size, "spec_k": spec_k,
+                   "num_decoders": num_decoders, "num_storm": num_storm,
+                   "storm_prompt_len": storm_prompt_len,
+                   "decode_tokens": decode_tokens,
+                   "num_layers": num_layers, "seed": seed},
+        "wall_s": round(wall, 3),
+        "iterations": it,
+        "decoder_gap_p50_ms": pct(0.50),
+        "decoder_gap_p95_ms": pct(0.95),
+        "decoder_gap_max_ms": pct(1.0),
+        "decoder_tpot_ms": (round(sum(tpots) / len(tpots) * 1e3, 4)
+                            if tpots else None),
+        "gap_samples": len(gaps_ms),
+        "metrics": {k: snap[k] for k in
+                    ("prefills", "prefill_tokens", "decode_steps",
+                     "generated_tokens", "preemptions") if k in snap},
+        "compile_stats": cs,
+        "compiled_programs": sched.num_programs(),
+        "spec_stats": spec,
+        "outputs_sha1": digest.hexdigest(),
+    }
+
+
+def run_chunked_suite(chunk_size: int = 16, smoke: bool = True,
+                      out_dir: str = REPO_ROOT, seed: int = 0,
+                      spec_k: int = 3) -> dict:
+    """BENCH_serving_chunked.json: the prefill-bubble kill, measured.
+
+    Three runs of the same seeded prefill-storm workload — unchunked
+    baseline, chunked, and chunked+speculative — pinning (a) bit-identical
+    token streams across all three (the subsystem's token-identity
+    contract), (b) the decoder cohort's worst inter-token gap cut by
+    chunking (the bubble is bounded by the chunk width instead of the
+    longest admitted prompt), and (c) zero steady-state recompiles with
+    the features on."""
+    kw = dict(num_decoders=2, num_storm=2 if smoke else 3,
+              storm_prompt_len=96, decode_tokens=32 if smoke else 48,
+              num_layers=2, seed=seed)
+    off = _run_storm_load(chunk_size=0, spec_k=0, **kw)
+    on = _run_storm_load(chunk_size=chunk_size, spec_k=0, **kw)
+    both = _run_storm_load(chunk_size=chunk_size, spec_k=spec_k, **kw)
+
+    identical = (off["outputs_sha1"] == on["outputs_sha1"]
+                 == both["outputs_sha1"])
+    gap_cut = (off["decoder_gap_max_ms"] / on["decoder_gap_max_ms"]
+               if on["decoder_gap_max_ms"] else None)
+    p95_cut = (off["decoder_gap_p95_ms"] / on["decoder_gap_p95_ms"]
+               if on["decoder_gap_p95_ms"] else None)
+    recompiles = (on["compile_stats"]["steady_state_recompiles"]
+                  + both["compile_stats"]["steady_state_recompiles"])
+    artifact = {
+        "bench": "serving_chunked",
+        "config": {"chunk_size": chunk_size, "spec_k": spec_k,
+                   "smoke": smoke, "seed": seed, **kw},
+        "unchunked": off,
+        "chunked": on,
+        "chunked_plus_spec": both,
+        "token_identical": identical,
+        "decoder_gap_max_cut_x": (round(gap_cut, 3)
+                                  if gap_cut is not None else None),
+        "decoder_gap_p95_cut_x": (round(p95_cut, 3)
+                                  if p95_cut is not None else None),
+        "steady_state_recompiles": recompiles,
+        # the bubble cut must show in the gap tail (max OR p95: the CPU
+        # smoke's tiny model leaves little compute headroom, and one
+        # noisy max sample must not flip the gate)
+        "within_budget": (identical and recompiles == 0
+                          and ((gap_cut or 0) > 1.0
+                               or (p95_cut or 0) > 1.0)),
+        "completed": True,
+    }
+    out_path = os.path.join(out_dir, "BENCH_serving_chunked.json")
+    write_bench_json(out_path, artifact)
+    artifact["artifact"] = out_path
+    return artifact
+
+
+def _run_spec_load(spec_k: int, num_requests: int = 4,
+                   max_new: int = 32, num_layers: int = 2,
+                   seed: int = 0) -> dict:
+    """One seeded repetitive-continuation workload (the n-gram proposer's
+    favorable regime) at a given draft depth; ``spec_k=0`` is the
+    autoregressive baseline. Two batches with ``mark_steady()`` between
+    them pin zero steady-state recompiles; decode_steps counts every
+    device step, so the cross-k step reduction is the compile-independent
+    win measurement."""
+    import hashlib
+
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu.models import GPTForCausalLM, gpt_tiny
+    from paddle_tpu.serving import ContinuousBatchingScheduler, SchedulerConfig
+
+    paddle.seed(7)
+    model = GPTForCausalLM(gpt_tiny(num_layers=num_layers))
+    cfg = SchedulerConfig(max_num_seqs=2, max_seq_len=64, block_size=8,
+                          spec_k=spec_k)
+    sched = _track(ContinuousBatchingScheduler(model, cfg))
+    rng = np.random.default_rng(seed)
+    pats = [rng.integers(2, 40, 6) for _ in range(num_requests)]
+    prompts = [np.concatenate([p, p, p]) for p in pats]
+
+    # warm both decode programs before pinning steady state: a strictly
+    # ascending prompt (no n-gram repeats) exercises the no-proposal
+    # [S,1] fallback, the repetitive one the [S,1+k] verify grid
+    sched.generate([np.arange(18, dtype=np.int64) + 100],
+                   max_new_tokens=4)
+    sched.generate(prompts[:1], max_new_tokens=4)
+    sched.mark_steady()
+    steps0 = sched.metrics.snapshot()["decode_steps"]
+    t0 = time.perf_counter()
+    outs = sched.generate(prompts, max_new_tokens=max_new)
+    wall = time.perf_counter() - t0
+    snap = sched.metrics.snapshot()
+    cs = sched.compile_stats()
+    spec = sched.spec_stats()
+    sched.shutdown()
+    digest = hashlib.sha1()
+    for o in outs:
+        digest.update(np.asarray(o, np.int64).tobytes())
+    return {
+        "spec_k": spec_k,
+        "wall_s": round(wall, 3),
+        "decode_steps": snap["decode_steps"] - steps0,
+        "generated_tokens": sum(len(o) - len(p)
+                                for o, p in zip(outs, prompts)),
+        "compile_stats": cs,
+        "spec_stats": spec,
+        "outputs_sha1": digest.hexdigest(),
+    }
+
+
+def run_spec_suite(spec_ks=(2, 4), smoke: bool = True,
+                   out_dir: str = REPO_ROOT, seed: int = 0) -> dict:
+    """BENCH_serving_spec.json: the accept-rate sweep.
+
+    The same seeded workload decoded autoregressively (``k=0``) and at
+    each draft depth in ``spec_ks``; per depth the artifact reports the
+    proposal accept rate, tokens per verify step (> 1 is the batching
+    win), and the device-step reduction vs the baseline — all under
+    bit-identical token streams and zero steady-state recompiles."""
+    kw = dict(num_requests=3 if smoke else 6, max_new=24 if smoke else 32,
+              num_layers=2, seed=seed)
+    base = _run_spec_load(0, **kw)
+    sweep = {}
+    for k in spec_ks:
+        run = _run_spec_load(int(k), **kw)
+        st = run["spec_stats"] or {}
+        sweep[str(k)] = {
+            **run,
+            "spec_accept_rate": st.get("accept_rate"),
+            "tokens_per_step": st.get("tokens_per_verify_step"),
+            "step_cut_x": (round(base["decode_steps"]
+                                 / run["decode_steps"], 3)
+                           if run["decode_steps"] else None),
+            "token_identical_to_baseline":
+                run["outputs_sha1"] == base["outputs_sha1"],
+        }
+    identical = all(v["token_identical_to_baseline"]
+                    for v in sweep.values())
+    recompiles = sum(v["compile_stats"]["steady_state_recompiles"]
+                     for v in sweep.values())
+    best_k = max(sweep, key=lambda k: sweep[k]["tokens_per_step"] or 0)
+    artifact = {
+        "bench": "serving_spec",
+        "config": {"spec_ks": list(spec_ks), "smoke": smoke, "seed": seed,
+                   **kw},
+        "baseline": base,
+        "sweep": sweep,
+        "best_k": int(best_k),
+        "spec_accept_rate": sweep[best_k]["spec_accept_rate"],
+        "tokens_per_step": sweep[best_k]["tokens_per_step"],
+        "step_cut_x": sweep[best_k]["step_cut_x"],
+        "token_identical": identical,
+        "steady_state_recompiles": recompiles,
+        "within_budget": (
+            identical and recompiles == 0
+            and (sweep[best_k]["tokens_per_step"] or 0) > 1.0
+            and (sweep[best_k]["spec_accept_rate"] or 0) > 0.3),
+        "completed": True,
+    }
+    out_path = os.path.join(out_dir, "BENCH_serving_spec.json")
     write_bench_json(out_path, artifact)
     artifact["artifact"] = out_path
     return artifact
@@ -1906,6 +2265,18 @@ def main(argv=None) -> dict:
     ap.add_argument("--flush-us", type=float, default=400.0,
                     help="modeled per-token client stream flush for the "
                          "--depth sweep, microseconds")
+    ap.add_argument("--chunk-size", type=int, default=None,
+                    help="chunked-prefill suite: prefill-storm workload, "
+                         "unchunked vs chunked-at-N decoder-cohort inter-"
+                         "token gaps, token identity, zero steady-state "
+                         "recompiles -> BENCH_serving_chunked.json")
+    ap.add_argument("--spec-k", type=int, default=None,
+                    help="speculative-decoding suite: accept-rate sweep "
+                         "over draft depths (this value and 2), tokens/"
+                         "verify-step, device-step cut vs autoregressive, "
+                         "token identity -> BENCH_serving_spec.json; "
+                         "combined with --chunk-size it is the chunked "
+                         "suite's chunked+spec identity leg instead")
     ap.add_argument("--out", default=None,
                     help="artifact path (default: BENCH_serving_<mode>.json "
                          "at the repo root)")
@@ -1925,6 +2296,8 @@ def main(argv=None) -> dict:
             "chaos" if chaos else "obs" if args.observability else
             "stepprofile" if args.profile_steps is not None else
             "prefix" if args.prefix_share else
+            "chunked" if args.chunk_size is not None else
+            "spec" if args.spec_k is not None else
             "smoke" if args.smoke else "load")
     if mode == "async":
         # the cross-depth sha oracle needs run-to-run-deterministic XLA:CPU
@@ -2115,6 +2488,46 @@ def _run_mode(args, mode: str, out_path: str) -> dict:
             "telemetry_invariants_ok": all(
                 v["token_identical"] and v["programs_equal"]
                 for v in artifact["telemetry_invariants"].values()),
+            "within_budget": artifact["within_budget"],
+            "artifact": artifact["artifact"],
+        }))
+        return artifact
+
+    if mode == "chunked":
+        artifact = run_chunked_suite(
+            chunk_size=max(1, args.chunk_size), smoke=args.smoke,
+            seed=args.seed, spec_k=args.spec_k or 3,
+            out_dir=os.path.dirname(out_path) or ".")
+        print(json.dumps({
+            "metric": "serving_chunked_gap_max_cut",
+            "value": artifact["decoder_gap_max_cut_x"],
+            "unit": "x reduction of the decoder cohort's worst inter-"
+                    "token gap under a prefill storm, chunked vs "
+                    "unchunked",
+            "gap_p95_cut_x": artifact["decoder_gap_p95_cut_x"],
+            "token_identical": artifact["token_identical"],
+            "steady_state_recompiles":
+                artifact["steady_state_recompiles"],
+            "within_budget": artifact["within_budget"],
+            "artifact": artifact["artifact"],
+        }))
+        return artifact
+
+    if mode == "spec":
+        ks = sorted({2, max(1, args.spec_k)})
+        artifact = run_spec_suite(
+            spec_ks=ks, smoke=args.smoke, seed=args.seed,
+            out_dir=os.path.dirname(out_path) or ".")
+        print(json.dumps({
+            "metric": "serving_spec_tokens_per_step",
+            "value": artifact["tokens_per_step"],
+            "unit": f"tokens per verify step at best draft depth "
+                    f"k={artifact['best_k']}",
+            "spec_accept_rate": artifact["spec_accept_rate"],
+            "step_cut_x": artifact["step_cut_x"],
+            "token_identical": artifact["token_identical"],
+            "steady_state_recompiles":
+                artifact["steady_state_recompiles"],
             "within_budget": artifact["within_budget"],
             "artifact": artifact["artifact"],
         }))
